@@ -1,0 +1,28 @@
+"""Test rig: force JAX onto a virtual 8-device CPU mesh.
+
+SURVEY.md §4: the TPU-native distributed-test strategy is JAX's CPU backend
+with ``--xla_force_host_platform_device_count=8`` — real SPMD on one host.
+Must run before jax initializes its backends, hence top of conftest.
+"""
+
+import os
+
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Pallas kernels run in interpret mode on CPU.
+os.environ.setdefault("VLLM_TPU_PALLAS_INTERPRET", "1")
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def cpu_devices():
+    import jax
+
+    devices = jax.devices()
+    assert len(devices) >= 8, f"expected 8 virtual CPU devices, got {devices}"
+    return devices

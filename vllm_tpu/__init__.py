@@ -1,0 +1,45 @@
+"""vllm-tpu: a TPU-native LLM inference and serving framework.
+
+Public API mirrors the reference's top level (``vllm/__init__.py``):
+``LLM``, ``SamplingParams``, ``EngineArgs``, ``AsyncLLM``, output types.
+Imports are lazy so that importing the package stays cheap.
+"""
+
+from typing import TYPE_CHECKING, Any
+
+__version__ = "0.1.0"
+
+_LAZY = {
+    "LLM": ("vllm_tpu.entrypoints.llm", "LLM"),
+    "AsyncLLM": ("vllm_tpu.engine.async_llm", "AsyncLLM"),
+    "LLMEngine": ("vllm_tpu.engine.llm_engine", "LLMEngine"),
+    "EngineArgs": ("vllm_tpu.engine.arg_utils", "EngineArgs"),
+    "SamplingParams": ("vllm_tpu.sampling_params", "SamplingParams"),
+    "RequestOutput": ("vllm_tpu.outputs", "RequestOutput"),
+    "CompletionOutput": ("vllm_tpu.outputs", "CompletionOutput"),
+    "PoolingRequestOutput": ("vllm_tpu.outputs", "PoolingRequestOutput"),
+    "EngineConfig": ("vllm_tpu.config", "EngineConfig"),
+    "ModelRegistry": ("vllm_tpu.models.registry", "ModelRegistry"),
+}
+
+if TYPE_CHECKING:
+    from vllm_tpu.config import EngineConfig
+    from vllm_tpu.engine.arg_utils import EngineArgs
+    from vllm_tpu.engine.async_llm import AsyncLLM
+    from vllm_tpu.engine.llm_engine import LLMEngine
+    from vllm_tpu.entrypoints.llm import LLM
+    from vllm_tpu.models.registry import ModelRegistry
+    from vllm_tpu.outputs import CompletionOutput, PoolingRequestOutput, RequestOutput
+    from vllm_tpu.sampling_params import SamplingParams
+
+
+def __getattr__(name: str) -> Any:
+    if name in _LAZY:
+        import importlib
+
+        module, attr = _LAZY[name]
+        return getattr(importlib.import_module(module), attr)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+
+
+__all__ = ["__version__", *_LAZY]

@@ -1,0 +1,238 @@
+"""Configuration dataclasses for vllm-tpu.
+
+The reference aggregates 30 frozen dataclasses into ``VllmConfig``
+(``vllm/config/vllm.py:269``); we keep the same decomposition at the scale
+this framework needs, in one module to start. All cross-validation happens in
+``__post_init__`` or ``EngineConfig.finalize``.
+"""
+
+from __future__ import annotations
+
+import hashlib
+from dataclasses import dataclass, field
+from typing import Any, Literal
+
+from vllm_tpu.logger import init_logger
+
+logger = init_logger(__name__)
+
+
+@dataclass
+class ModelConfig:
+    """What model to run and how to interpret it.
+
+    Reference analog: ``vllm/config/model.py`` (ModelConfig).
+    """
+
+    model: str = "meta-llama/Meta-Llama-3-8B"
+    tokenizer: str | None = None
+    trust_remote_code: bool = False
+    dtype: str = "bfloat16"  # "bfloat16" | "float32" | "float16"
+    seed: int = 0
+    max_model_len: int | None = None  # None -> derive from HF config
+    revision: str | None = None
+    # "auto" streams real weights from safetensors; "dummy" random-initializes
+    # (reference: load_format="dummy", model_loader/dummy_loader.py) so engine
+    # tests need no checkpoints.
+    load_format: Literal["auto", "dummy"] = "auto"
+    # Populated by the loader from the HF config.
+    hf_config: Any = None
+    # Optional override dict applied on top of the HF config (tests).
+    hf_overrides: dict[str, Any] | None = None
+
+    def __post_init__(self) -> None:
+        if self.tokenizer is None:
+            self.tokenizer = self.model
+
+    @property
+    def jax_dtype(self):
+        import jax.numpy as jnp
+
+        return {
+            "bfloat16": jnp.bfloat16,
+            "float32": jnp.float32,
+            "float16": jnp.float16,
+        }[self.dtype]
+
+
+@dataclass
+class CacheConfig:
+    """KV-cache geometry. Reference analog: ``vllm/config/cache.py``."""
+
+    block_size: int = 16  # tokens per KV block
+    # Fraction of free HBM given to the KV cache (after weights+activations).
+    gpu_memory_utilization: float = 0.9
+    # Explicit block count override (tests / CPU runs). None -> profile.
+    num_gpu_blocks_override: int | None = None
+    enable_prefix_caching: bool = True
+    # KV cache dtype: "auto" follows model dtype.
+    cache_dtype: str = "auto"
+    # Populated at engine init after profiling.
+    num_gpu_blocks: int | None = None
+
+    def __post_init__(self) -> None:
+        if self.block_size & (self.block_size - 1):
+            raise ValueError(f"block_size must be a power of 2, got {self.block_size}")
+
+
+@dataclass
+class ParallelConfig:
+    """Device-mesh topology.
+
+    Reference analog: ``vllm/config/parallel.py``; rank layout
+    ``ExternalDP x DP x PP x PCP x TP`` (``parallel_state.py:1560``). On TPU
+    these become named mesh axes consumed by GSPMD rather than process groups.
+    """
+
+    tensor_parallel_size: int = 1
+    data_parallel_size: int = 1
+    pipeline_parallel_size: int = 1
+    expert_parallel_size: int = 1
+    # Context parallelism (sequence sharding) axis size.
+    context_parallel_size: int = 1
+    enable_expert_parallel: bool = False
+    # Backend for engine<->worker transport: in-proc by default on TPU since
+    # one host drives all local chips via a single jax client.
+    distributed_executor_backend: Literal["uniproc", "mp"] = "uniproc"
+
+    @property
+    def world_size(self) -> int:
+        return (
+            self.tensor_parallel_size
+            * self.data_parallel_size
+            * self.pipeline_parallel_size
+            * self.context_parallel_size
+        )
+
+
+@dataclass
+class SchedulerConfig:
+    """Token-budget continuous-batching knobs.
+
+    Reference analog: ``vllm/config/scheduler.py``; semantics of
+    ``vllm/v1/core/sched/scheduler.py:352``.
+    """
+
+    max_num_batched_tokens: int = 8192  # per-step token budget
+    max_num_seqs: int = 256  # max concurrent requests in a step
+    max_model_len: int = 8192  # mirrored from ModelConfig at finalize
+    enable_chunked_prefill: bool = True
+    # Long-prefill throttle (reference: long_prefill_token_threshold).
+    long_prefill_token_threshold: int = 0
+    policy: Literal["fcfs", "priority"] = "fcfs"
+
+    def __post_init__(self) -> None:
+        if self.max_num_batched_tokens < 1:
+            raise ValueError("max_num_batched_tokens must be >= 1")
+
+
+@dataclass
+class DeviceConfig:
+    """Which jax backend to run on. "auto" picks the default jax backend."""
+
+    device: Literal["auto", "tpu", "cpu"] = "auto"
+
+
+@dataclass
+class SpeculativeConfig:
+    """Speculative decoding. Reference analog: ``vllm/config/speculative.py``."""
+
+    method: Literal["ngram", "eagle", "draft_model", "suffix"] | None = None
+    num_speculative_tokens: int = 0
+    # ngram proposer window
+    prompt_lookup_max: int = 4
+    prompt_lookup_min: int = 1
+    model: str | None = None  # draft model path for eagle/draft_model
+
+    @property
+    def enabled(self) -> bool:
+        return self.method is not None and self.num_speculative_tokens > 0
+
+
+@dataclass
+class LoRAConfig:
+    """Reference analog: ``vllm/config/lora.py``."""
+
+    max_lora_rank: int = 16
+    max_loras: int = 1
+    enable_lora: bool = False
+
+
+@dataclass
+class ObservabilityConfig:
+    collect_detailed_traces: bool = False
+    otlp_traces_endpoint: str | None = None
+    log_stats: bool = True
+    log_stats_interval_s: float = 10.0
+
+
+@dataclass
+class CompilationConfig:
+    """Bucketing for the persistent-jit step (replaces CUDA-graph capture
+    lists + ``cudagraph_dispatcher`` in the reference)."""
+
+    # Token-count buckets for the unified fwd step; actual list derived at
+    # finalize from max_num_batched_tokens if empty.
+    token_buckets: list[int] = field(default_factory=list)
+    # Request-count buckets for decode-state tensors.
+    request_buckets: list[int] = field(default_factory=list)
+    # Precompile all buckets at startup (vs lazily on first use).
+    precompile: bool = False
+
+    @staticmethod
+    def _pow2_buckets(lo: int, hi: int) -> list[int]:
+        out = []
+        v = lo
+        while v < hi:
+            out.append(v)
+            v *= 2
+        out.append(hi)
+        return out
+
+    def finalize(self, sched: SchedulerConfig) -> None:
+        if not self.token_buckets:
+            self.token_buckets = self._pow2_buckets(
+                16, max(16, sched.max_num_batched_tokens)
+            )
+        if not self.request_buckets:
+            self.request_buckets = self._pow2_buckets(8, max(8, sched.max_num_seqs))
+
+
+@dataclass
+class EngineConfig:
+    """Aggregate of everything the engine needs (reference: ``VllmConfig``)."""
+
+    model_config: ModelConfig = field(default_factory=ModelConfig)
+    cache_config: CacheConfig = field(default_factory=CacheConfig)
+    parallel_config: ParallelConfig = field(default_factory=ParallelConfig)
+    scheduler_config: SchedulerConfig = field(default_factory=SchedulerConfig)
+    device_config: DeviceConfig = field(default_factory=DeviceConfig)
+    speculative_config: SpeculativeConfig = field(default_factory=SpeculativeConfig)
+    lora_config: LoRAConfig = field(default_factory=LoRAConfig)
+    observability_config: ObservabilityConfig = field(default_factory=ObservabilityConfig)
+    compilation_config: CompilationConfig = field(default_factory=CompilationConfig)
+
+    def finalize(self) -> "EngineConfig":
+        """Cross-validate and derive dependent fields. Idempotent."""
+        mc, sc = self.model_config, self.scheduler_config
+        if mc.max_model_len is not None:
+            sc.max_model_len = mc.max_model_len
+        if not sc.enable_chunked_prefill:
+            sc.max_num_batched_tokens = max(sc.max_num_batched_tokens, sc.max_model_len)
+        self.compilation_config.finalize(sc)
+        if self.speculative_config.enabled and self.parallel_config.pipeline_parallel_size > 1:
+            raise ValueError("speculative decoding is incompatible with pipeline parallelism")
+        return self
+
+    def compute_hash(self) -> str:
+        """Stable hash of the config (used to key compile caches)."""
+        parts = []
+        for f in (
+            self.model_config,
+            self.cache_config,
+            self.parallel_config,
+            self.scheduler_config,
+            self.compilation_config,
+        ):
+            parts.append(repr(f))
+        return hashlib.sha256("|".join(parts).encode()).hexdigest()[:16]

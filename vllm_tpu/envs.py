@@ -1,0 +1,71 @@
+"""Environment flags for vllm-tpu.
+
+Analog of the reference's ``vllm/envs.py`` (739 lazy env vars) at the scale
+this framework needs: lazily evaluated, cached after first read, all flags
+prefixed ``VLLM_TPU_``.
+"""
+
+from __future__ import annotations
+
+import os
+from collections.abc import Callable
+from typing import Any
+
+_cache: dict[str, Any] = {}
+
+
+def _bool(name: str, default: bool) -> Callable[[], bool]:
+    def read() -> bool:
+        return os.environ.get(name, "1" if default else "0") not in ("0", "false", "False", "")
+
+    return read
+
+
+def _int(name: str, default: int) -> Callable[[], int]:
+    def read() -> int:
+        return int(os.environ.get(name, str(default)))
+
+    return read
+
+
+def _str(name: str, default: str | None) -> Callable[[], str | None]:
+    def read() -> str | None:
+        return os.environ.get(name, default)
+
+    return read
+
+
+# Flag registry: name -> lazy reader.
+_readers: dict[str, Callable[[], Any]] = {
+    # Logging
+    "VLLM_TPU_LOGGING_LEVEL": _str("VLLM_TPU_LOGGING_LEVEL", "INFO"),
+    "VLLM_TPU_CONFIGURE_LOGGING": _bool("VLLM_TPU_CONFIGURE_LOGGING", True),
+    # Engine
+    "VLLM_TPU_ENABLE_MULTIPROCESSING": _bool("VLLM_TPU_ENABLE_MULTIPROCESSING", False),
+    "VLLM_TPU_ENGINE_ITERATION_TIMEOUT_S": _int("VLLM_TPU_ENGINE_ITERATION_TIMEOUT_S", 60),
+    # Compilation / runner
+    "VLLM_TPU_DISABLE_PALLAS": _bool("VLLM_TPU_DISABLE_PALLAS", False),
+    "VLLM_TPU_PALLAS_INTERPRET": _bool("VLLM_TPU_PALLAS_INTERPRET", False),
+    "VLLM_TPU_COMPILE_CACHE_DIR": _str("VLLM_TPU_COMPILE_CACHE_DIR", None),
+    # Profiling
+    "VLLM_TPU_PROFILER_DIR": _str("VLLM_TPU_PROFILER_DIR", None),
+    # API server
+    "VLLM_TPU_API_KEY": _str("VLLM_TPU_API_KEY", None),
+    # Testing
+    "VLLM_TPU_USE_CPU_BACKEND": _bool("VLLM_TPU_USE_CPU_BACKEND", False),
+}
+
+
+def __getattr__(name: str) -> Any:
+    if name in _cache:
+        return _cache[name]
+    if name in _readers:
+        value = _readers[name]()
+        _cache[name] = value
+        return value
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+
+
+def refresh() -> None:
+    """Drop the cache (tests that mutate os.environ call this)."""
+    _cache.clear()

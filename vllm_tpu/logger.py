@@ -1,0 +1,39 @@
+"""Logging for vllm-tpu.
+
+Mirrors the role of the reference's ``vllm/logger.py`` (env-configurable
+package logger) in a minimal, idiomatic form.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import sys
+
+_FORMAT = "%(levelname)s %(asctime)s [%(name)s:%(lineno)d] %(message)s"
+_DATE_FORMAT = "%m-%d %H:%M:%S"
+
+_root_configured = False
+
+
+def _configure_root() -> None:
+    global _root_configured
+    if _root_configured:
+        return
+    _root_configured = True
+    root = logging.getLogger("vllm_tpu")
+    level_name = os.environ.get("VLLM_TPU_LOGGING_LEVEL", "INFO").upper()
+    root.setLevel(getattr(logging, level_name, logging.INFO))
+    if os.environ.get("VLLM_TPU_CONFIGURE_LOGGING", "1") != "0":
+        handler = logging.StreamHandler(sys.stderr)
+        handler.setFormatter(logging.Formatter(_FORMAT, _DATE_FORMAT))
+        root.addHandler(handler)
+    root.propagate = False
+
+
+def init_logger(name: str) -> logging.Logger:
+    """Return a logger under the ``vllm_tpu`` hierarchy."""
+    _configure_root()
+    if not name.startswith("vllm_tpu"):
+        name = f"vllm_tpu.{name}"
+    return logging.getLogger(name)

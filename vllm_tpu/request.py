@@ -1,0 +1,176 @@
+"""Engine-internal request state machine.
+
+Reference analog: ``vllm/v1/request.py`` — status enum, computed-token
+tracking, spec-token buffers. Device-agnostic by design.
+"""
+
+from __future__ import annotations
+
+import enum
+import time
+from dataclasses import dataclass, field
+from typing import TYPE_CHECKING, Any
+
+from vllm_tpu.sampling_params import SamplingParams
+
+if TYPE_CHECKING:
+    from vllm_tpu.core.kv_cache_utils import BlockHash
+
+
+class RequestStatus(enum.IntEnum):
+    WAITING = 0
+    RUNNING = 1
+    PREEMPTED = 2
+    FINISHED_STOPPED = 3
+    FINISHED_LENGTH_CAPPED = 4
+    FINISHED_ABORTED = 5
+    FINISHED_IGNORED = 6
+
+    @staticmethod
+    def is_finished(status: "RequestStatus") -> bool:
+        return status >= RequestStatus.FINISHED_STOPPED
+
+
+_FINISH_REASON = {
+    RequestStatus.FINISHED_STOPPED: "stop",
+    RequestStatus.FINISHED_LENGTH_CAPPED: "length",
+    RequestStatus.FINISHED_ABORTED: "abort",
+    RequestStatus.FINISHED_IGNORED: "length",
+}
+
+
+@dataclass
+class EngineCoreRequest:
+    """Wire format frontend -> engine core (reference: v1/engine/__init__.py)."""
+
+    request_id: str
+    prompt_token_ids: list[int]
+    sampling_params: SamplingParams
+    arrival_time: float = field(default_factory=time.monotonic)
+    eos_token_id: int | None = None
+    priority: int = 0
+    lora_name: str | None = None
+    # Multimodal placeholders (feature ring 1).
+    mm_inputs: list[Any] | None = None
+
+
+class Request:
+    """Scheduler-side request state."""
+
+    def __init__(
+        self,
+        request_id: str,
+        prompt_token_ids: list[int],
+        sampling_params: SamplingParams,
+        eos_token_id: int | None = None,
+        arrival_time: float | None = None,
+        priority: int = 0,
+        lora_name: str | None = None,
+        block_hasher: Any = None,
+    ) -> None:
+        self.request_id = request_id
+        self.prompt_token_ids = prompt_token_ids
+        self.sampling_params = sampling_params
+        self.eos_token_id = eos_token_id
+        self.arrival_time = arrival_time if arrival_time is not None else time.monotonic()
+        self.priority = priority
+        self.lora_name = lora_name
+
+        self.status = RequestStatus.WAITING
+        self.stop_reason: int | str | None = None
+
+        # prompt + generated tokens, grown in place.
+        self._all_token_ids: list[int] = list(prompt_token_ids)
+        self.num_prompt_tokens = len(prompt_token_ids)
+        # Tokens whose KV is computed and resident in the cache.
+        self.num_computed_tokens = 0
+        # Prefix-cache hit length at first schedule (stats).
+        self.num_cached_tokens = -1
+        # Draft tokens proposed for this request, verified next step.
+        self.spec_token_ids: list[int] = []
+        # Number of scheduler preemptions (stats).
+        self.num_preemptions = 0
+
+        # Content-addressed block hashes for prefix caching; maintained
+        # incrementally as tokens append (reference: kv_cache_utils
+        # get_request_block_hasher).
+        self.block_hashes: list["BlockHash"] = []
+        self._block_hasher = block_hasher
+        if block_hasher is not None:
+            self.block_hashes = block_hasher(self)
+
+    @classmethod
+    def from_engine_core_request(
+        cls, req: EngineCoreRequest, block_hasher: Any = None
+    ) -> "Request":
+        return cls(
+            request_id=req.request_id,
+            prompt_token_ids=req.prompt_token_ids,
+            sampling_params=req.sampling_params,
+            eos_token_id=req.eos_token_id,
+            arrival_time=req.arrival_time,
+            priority=req.priority,
+            lora_name=req.lora_name,
+            block_hasher=block_hasher,
+        )
+
+    # ------------------------------------------------------------------
+    # Token accessors
+    # ------------------------------------------------------------------
+
+    @property
+    def all_token_ids(self) -> list[int]:
+        return self._all_token_ids
+
+    @property
+    def num_tokens(self) -> int:
+        return len(self._all_token_ids)
+
+    @property
+    def num_output_tokens(self) -> int:
+        return len(self._all_token_ids) - self.num_prompt_tokens
+
+    @property
+    def output_token_ids(self) -> list[int]:
+        return self._all_token_ids[self.num_prompt_tokens :]
+
+    @property
+    def num_tokens_with_spec(self) -> int:
+        return len(self._all_token_ids) + len(self.spec_token_ids)
+
+    def append_output_token_ids(self, token_ids: int | list[int]) -> None:
+        if isinstance(token_ids, int):
+            self._all_token_ids.append(token_ids)
+        else:
+            self._all_token_ids.extend(token_ids)
+        if self._block_hasher is not None:
+            new_hashes = self._block_hasher(self)
+            self.block_hashes.extend(new_hashes)
+
+    # ------------------------------------------------------------------
+    # Lifecycle
+    # ------------------------------------------------------------------
+
+    @property
+    def is_finished(self) -> bool:
+        return RequestStatus.is_finished(self.status)
+
+    def get_finished_reason(self) -> str | None:
+        return _FINISH_REASON.get(self.status)
+
+    @property
+    def max_tokens(self) -> int:
+        mt = self.sampling_params.max_tokens
+        assert mt is not None
+        return mt
+
+    @property
+    def use_structured_output(self) -> bool:
+        so = self.sampling_params.structured_outputs
+        return so is not None and so.is_set
+
+    def __repr__(self) -> str:
+        return (
+            f"Request(id={self.request_id}, status={self.status.name}, "
+            f"tokens={self.num_tokens}, computed={self.num_computed_tokens})"
+        )

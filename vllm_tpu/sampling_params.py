@@ -1,0 +1,108 @@
+"""Per-request sampling parameters.
+
+Reference analog: ``vllm/sampling_params.py`` (SamplingParams). The sampler
+pipeline order they feed (reference ``vllm/v1/sample/sampler.py:22-60``):
+allowed-tokens -> bad words -> logit processors -> penalties -> temperature
+-> min-p -> top-k/top-p -> sample -> logprobs.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from enum import IntEnum
+from typing import Any
+
+
+class RequestOutputKind(IntEnum):
+    CUMULATIVE = 0  # full text so far on every stream event
+    DELTA = 1  # only newly generated text
+    FINAL_ONLY = 2  # one output at completion
+
+
+@dataclass
+class StructuredOutputParams:
+    """Grammar-constrained decoding spec (reference: GuidedDecodingParams)."""
+
+    json_schema: dict[str, Any] | str | None = None
+    regex: str | None = None
+    grammar: str | None = None
+    choice: list[str] | None = None
+
+    @property
+    def is_set(self) -> bool:
+        return any(
+            v is not None for v in (self.json_schema, self.regex, self.grammar, self.choice)
+        )
+
+
+@dataclass
+class SamplingParams:
+    n: int = 1
+    temperature: float = 1.0
+    top_p: float = 1.0
+    top_k: int = 0  # 0 or -1 -> disabled
+    min_p: float = 0.0
+    presence_penalty: float = 0.0
+    frequency_penalty: float = 0.0
+    repetition_penalty: float = 1.0
+    max_tokens: int | None = 16
+    min_tokens: int = 0
+    stop: list[str] = field(default_factory=list)
+    stop_token_ids: list[int] = field(default_factory=list)
+    ignore_eos: bool = False
+    skip_special_tokens: bool = True
+    include_stop_str_in_output: bool = False
+    logprobs: int | None = None
+    prompt_logprobs: int | None = None
+    seed: int | None = None
+    detokenize: bool = True
+    output_kind: RequestOutputKind = RequestOutputKind.CUMULATIVE
+    bad_words: list[str] = field(default_factory=list)
+    allowed_token_ids: list[int] | None = None
+    logit_bias: dict[int, float] | None = None
+    structured_outputs: StructuredOutputParams | None = None
+    # Extension hook carried through untouched.
+    extra_args: dict[str, Any] | None = None
+
+    def __post_init__(self) -> None:
+        if isinstance(self.stop, str):
+            self.stop = [self.stop]
+        if self.temperature < 0:
+            raise ValueError(f"temperature must be >= 0, got {self.temperature}")
+        if not 0 < self.top_p <= 1:
+            raise ValueError(f"top_p must be in (0, 1], got {self.top_p}")
+        if self.top_k < -1:
+            raise ValueError(f"top_k must be >= -1, got {self.top_k}")
+        if self.top_k == -1:
+            self.top_k = 0
+        if not 0 <= self.min_p <= 1:
+            raise ValueError(f"min_p must be in [0, 1], got {self.min_p}")
+        if self.max_tokens is not None and self.max_tokens < 1:
+            raise ValueError(f"max_tokens must be >= 1, got {self.max_tokens}")
+        if self.min_tokens < 0:
+            raise ValueError(f"min_tokens must be >= 0, got {self.min_tokens}")
+        if not -2 <= self.presence_penalty <= 2:
+            raise ValueError("presence_penalty must be in [-2, 2]")
+        if not -2 <= self.frequency_penalty <= 2:
+            raise ValueError("frequency_penalty must be in [-2, 2]")
+        if self.repetition_penalty <= 0:
+            raise ValueError("repetition_penalty must be > 0")
+
+    @property
+    def sampling_type(self) -> str:
+        return "greedy" if self.temperature == 0.0 else "random"
+
+    @property
+    def all_stop_token_ids(self) -> set[int]:
+        return set(self.stop_token_ids)
+
+
+def beam_search_params(beam_width: int, max_tokens: int) -> SamplingParams:
+    """Params used internally by beam search (greedy logprobs expansion)."""
+    return SamplingParams(
+        n=1,
+        temperature=0.0,
+        logprobs=2 * beam_width,
+        max_tokens=max_tokens,
+        output_kind=RequestOutputKind.FINAL_ONLY,
+    )
