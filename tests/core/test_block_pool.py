@@ -1,0 +1,103 @@
+"""BlockPool / FreeKVCacheBlockQueue unit tests.
+
+Protocol modeled on reference ``tests/v1/core/test_kv_cache_utils.py`` and
+``test_prefix_caching.py`` pool-level cases.
+"""
+
+import pytest
+
+from vllm_tpu.core.block_pool import BlockPool
+from vllm_tpu.core.kv_cache_utils import (
+    NONE_HASH,
+    FreeKVCacheBlockQueue,
+    KVCacheBlock,
+    hash_block_tokens,
+)
+
+
+def test_free_queue_order_and_removal():
+    blocks = [KVCacheBlock(block_id=i) for i in range(5)]
+    q = FreeKVCacheBlockQueue(blocks)
+    assert q.num_free_blocks == 5
+    q.remove(blocks[2])
+    assert q.num_free_blocks == 4
+    assert [b.block_id for b in q.get_all_free_blocks()] == [0, 1, 3, 4]
+    assert q.popleft().block_id == 0
+    q.append(blocks[2])
+    assert [b.block_id for b in q.get_all_free_blocks()] == [1, 3, 4, 2]
+
+
+def test_free_queue_empty_pop_raises():
+    q = FreeKVCacheBlockQueue([KVCacheBlock(block_id=0)])
+    q.popleft()
+    with pytest.raises(AssertionError):
+        q.popleft()
+
+
+def test_hash_chain_distinguishes_prefixes():
+    h1 = hash_block_tokens(NONE_HASH, [1, 2, 3, 4])
+    h2 = hash_block_tokens(NONE_HASH, [1, 2, 3, 5])
+    h3 = hash_block_tokens(h1, [9, 9, 9, 9])
+    h4 = hash_block_tokens(h2, [9, 9, 9, 9])
+    assert h1 != h2
+    # Same block content under different prefixes must differ.
+    assert h3 != h4
+    # Deterministic.
+    assert h1 == hash_block_tokens(NONE_HASH, [1, 2, 3, 4])
+    # Extra keys (LoRA) change identity.
+    assert h1 != hash_block_tokens(NONE_HASH, [1, 2, 3, 4], ("adapter",))
+
+
+def test_block_pool_allocate_free_cycle():
+    pool = BlockPool(num_blocks=11)
+    assert pool.get_num_free_blocks() == 10  # block 0 is the null block
+    blocks = pool.get_new_blocks(10)
+    assert pool.get_num_free_blocks() == 0
+    assert all(b.ref_cnt == 1 for b in blocks)
+    with pytest.raises(RuntimeError):
+        pool.get_new_blocks(1)
+    pool.free_blocks(blocks)
+    assert pool.get_num_free_blocks() == 10
+
+
+def test_block_pool_caching_and_eviction():
+    pool = BlockPool(num_blocks=4)
+    blocks = pool.get_new_blocks(3)
+    hashes = [hash_block_tokens(NONE_HASH, [i] * 4) for i in range(3)]
+    pool.cache_full_blocks(blocks, hashes, 0, 3)
+    assert pool.get_cached_block(hashes[1]) is blocks[1]
+
+    # Free: blocks go back to the queue but stay cached.
+    pool.free_blocks(list(reversed(blocks)))
+    assert pool.get_cached_block(hashes[0]) is blocks[0]
+
+    # touch() pulls a cached free block back into use.
+    pool.touch([blocks[0]])
+    assert blocks[0].ref_cnt == 1
+    assert pool.get_num_free_blocks() == 2
+
+    # Reallocating the remaining free blocks evicts their cache entries
+    # (freed tail-first above: eviction order is blocks[2] then blocks[1]).
+    got = pool.get_new_blocks(1)
+    assert got[0] is blocks[2]
+    assert pool.get_cached_block(hashes[2]) is None
+    assert pool.get_cached_block(hashes[1]) is blocks[1]
+
+
+def test_block_pool_reset_prefix_cache():
+    pool = BlockPool(num_blocks=4)
+    blocks = pool.get_new_blocks(2)
+    hashes = [hash_block_tokens(NONE_HASH, [i] * 4) for i in range(2)]
+    pool.cache_full_blocks(blocks, hashes, 0, 2)
+    # In-use blocks -> refuse.
+    assert not pool.reset_prefix_cache()
+    pool.free_blocks(blocks)
+    assert pool.reset_prefix_cache()
+    assert pool.get_cached_block(hashes[0]) is None
+
+
+def test_null_block_never_allocated():
+    pool = BlockPool(num_blocks=3)
+    blocks = pool.get_new_blocks(2)
+    assert all(b.block_id != 0 for b in blocks)
+    assert pool.null_block.is_null

@@ -1,0 +1,87 @@
+"""Constructors for core-logic tests without any model/device.
+
+Mirrors the reference protocol of ``tests/v1/core/utils.py:42
+create_scheduler()`` — a real Scheduler over synthetic config/requests.
+"""
+
+from __future__ import annotations
+
+from vllm_tpu.config import CacheConfig, SchedulerConfig
+from vllm_tpu.core.kv_cache_utils import make_block_hasher
+from vllm_tpu.core.scheduler import Scheduler
+from vllm_tpu.request import Request
+from vllm_tpu.sampling_params import SamplingParams
+
+EOS = 2
+
+
+def create_scheduler(
+    max_num_seqs: int = 16,
+    max_num_batched_tokens: int = 8192,
+    num_blocks: int = 1000,
+    block_size: int = 16,
+    max_model_len: int = 2048,
+    enable_prefix_caching: bool = True,
+    policy: str = "fcfs",
+) -> Scheduler:
+    sched_config = SchedulerConfig(
+        max_num_batched_tokens=max_num_batched_tokens,
+        max_num_seqs=max_num_seqs,
+        max_model_len=max_model_len,
+        policy=policy,
+    )
+    cache_config = CacheConfig(
+        block_size=block_size,
+        enable_prefix_caching=enable_prefix_caching,
+    )
+    cache_config.num_gpu_blocks = num_blocks
+    return Scheduler(sched_config, cache_config)
+
+
+_counter = 0
+
+
+def create_request(
+    prompt_len: int = 32,
+    max_tokens: int = 16,
+    block_size: int = 16,
+    prompt_token_ids: list[int] | None = None,
+    priority: int = 0,
+    stop_token_ids: list[int] | None = None,
+    min_tokens: int = 0,
+    ignore_eos: bool = False,
+    request_id: str | None = None,
+) -> Request:
+    global _counter
+    _counter += 1
+    if prompt_token_ids is None:
+        # Deterministic but distinct prompts.
+        prompt_token_ids = [(_counter * 7919 + i) % 30000 + 10 for i in range(prompt_len)]
+    return Request(
+        request_id=request_id or f"req-{_counter}",
+        prompt_token_ids=prompt_token_ids,
+        sampling_params=SamplingParams(
+            max_tokens=max_tokens,
+            temperature=0.0,
+            stop_token_ids=stop_token_ids or [],
+            min_tokens=min_tokens,
+            ignore_eos=ignore_eos,
+        ),
+        eos_token_id=EOS,
+        priority=priority,
+        block_hasher=make_block_hasher(block_size),
+    )
+
+
+def make_runner_output(scheduler_output, token_id: int = 100, spec: dict | None = None):
+    """Fabricate a ModelRunnerOutput sampling `token_id` for every request
+    that reached its last scheduled token."""
+    from vllm_tpu.core.sched_output import ModelRunnerOutput
+
+    req_ids = [r.req_id for r in scheduler_output.scheduled_new_reqs]
+    req_ids += list(scheduler_output.scheduled_cached_reqs.req_ids)
+    return ModelRunnerOutput(
+        req_ids=req_ids,
+        sampled_token_ids=[[token_id] for _ in req_ids],
+        draft_token_ids=spec or {},
+    )

@@ -1,0 +1,155 @@
+"""Global block pool: free list + content-addressed prefix cache.
+
+Reference analog: ``vllm/v1/core/block_pool.py:130``. Owns every physical
+KV block; the KVCacheManager asks it for new blocks, returns freed ones, and
+registers full blocks under their content hash for reuse.
+"""
+
+from __future__ import annotations
+
+from vllm_tpu.core.kv_cache_utils import (
+    BlockHash,
+    BlockHashWithGroupId,
+    FreeKVCacheBlockQueue,
+    KVCacheBlock,
+)
+from vllm_tpu.logger import init_logger
+
+logger = init_logger(__name__)
+
+
+class BlockPool:
+    def __init__(self, num_blocks: int, enable_caching: bool = True) -> None:
+        assert num_blocks > 0
+        self.num_blocks = num_blocks
+        self.enable_caching = enable_caching
+
+        self.blocks = [KVCacheBlock(block_id=i) for i in range(num_blocks)]
+        # Block 0 is the null block: a permanent placeholder pointed at by
+        # token positions whose KV is not resident (e.g. skipped sliding-
+        # window prefix). Never allocated, never cached.
+        self.null_block = self.blocks[0]
+        self.null_block.is_null = True
+        self.null_block.ref_cnt = 1
+
+        self.free_block_queue = FreeKVCacheBlockQueue(self.blocks[1:])
+        # hash -> {block_id -> block}: multiple blocks may share content when
+        # the same prefix was computed concurrently.
+        self.cached_block_hash_to_block: dict[
+            BlockHashWithGroupId, dict[int, KVCacheBlock]
+        ] = {}
+
+    # ------------------------------------------------------------------
+    # Prefix-cache lookup / registration
+    # ------------------------------------------------------------------
+
+    def get_cached_block(
+        self, block_hash: BlockHash, group_id: int = 0
+    ) -> KVCacheBlock | None:
+        entry = self.cached_block_hash_to_block.get(
+            BlockHashWithGroupId(block_hash, group_id)
+        )
+        if not entry:
+            return None
+        return next(iter(entry.values()))
+
+    def cache_full_blocks(
+        self,
+        blocks: list[KVCacheBlock],
+        block_hashes: list[BlockHash],
+        num_cached_blocks: int,
+        num_full_blocks: int,
+        group_id: int = 0,
+    ) -> None:
+        """Register blocks [num_cached, num_full) under their content hashes.
+
+        Reference: ``block_pool.py:211 cache_full_blocks``.
+        """
+        if not self.enable_caching:
+            return
+        for i in range(num_cached_blocks, num_full_blocks):
+            block = blocks[i]
+            if block.is_null:
+                continue
+            assert block.block_hash is None, (
+                f"block {block.block_id} is already cached"
+            )
+            key = BlockHashWithGroupId(block_hashes[i], group_id)
+            block.block_hash = key
+            self.cached_block_hash_to_block.setdefault(key, {})[block.block_id] = block
+
+    # ------------------------------------------------------------------
+    # Allocation / free
+    # ------------------------------------------------------------------
+
+    def get_num_free_blocks(self) -> int:
+        return self.free_block_queue.num_free_blocks
+
+    def get_new_blocks(self, num_blocks: int) -> list[KVCacheBlock]:
+        """Pop blocks from the free queue, evicting their stale cache entries.
+
+        Reference: ``block_pool.py:322``.
+        """
+        if num_blocks > self.get_num_free_blocks():
+            raise RuntimeError(
+                f"asked for {num_blocks} blocks, only "
+                f"{self.get_num_free_blocks()} free"
+            )
+        out = []
+        for _ in range(num_blocks):
+            block = self.free_block_queue.popleft()
+            self._maybe_evict_cached_block(block)
+            assert block.ref_cnt == 0
+            block.incr_ref()
+            out.append(block)
+        return out
+
+    def _maybe_evict_cached_block(self, block: KVCacheBlock) -> bool:
+        key = block.block_hash
+        if key is None:
+            return False
+        entry = self.cached_block_hash_to_block.get(key)
+        if entry is not None:
+            entry.pop(block.block_id, None)
+            if not entry:
+                del self.cached_block_hash_to_block[key]
+        block.reset_hash()
+        return True
+
+    def touch(self, blocks: list[KVCacheBlock]) -> None:
+        """Re-reference cache-hit blocks; a hit block with ref 0 sits in the
+        free queue and must be pulled out (reference: ``block_pool.py touch``)."""
+        for block in blocks:
+            if block.ref_cnt == 0 and not block.is_null:
+                self.free_block_queue.remove(block)
+            block.incr_ref()
+
+    def free_blocks(self, ordered_blocks: list[KVCacheBlock]) -> None:
+        """Deref blocks; those reaching 0 go to the free-queue tail in the
+        given order (caller passes tail-first for LRU-friendly eviction)."""
+        for block in ordered_blocks:
+            block.decr_ref()
+            assert block.ref_cnt >= 0, f"double-free of block {block.block_id}"
+            if block.ref_cnt == 0 and not block.is_null:
+                self.free_block_queue.append(block)
+
+    def reset_prefix_cache(self) -> bool:
+        """Drop every cached mapping; only safe when nothing is running.
+        Reference: ``block_pool.py reset_prefix_cache``."""
+        num_used = self.num_blocks - 1 - self.get_num_free_blocks()
+        if num_used > 0:
+            logger.warning(
+                "cannot reset prefix cache: %d blocks still referenced", num_used
+            )
+            return False
+        self.cached_block_hash_to_block.clear()
+        for block in self.blocks:
+            block.reset_hash()
+        return True
+
+    # Stats ------------------------------------------------------------
+
+    @property
+    def usage(self) -> float:
+        usable = self.num_blocks - 1
+        return 1.0 - self.get_num_free_blocks() / usable if usable else 0.0

@@ -1,0 +1,183 @@
+"""Request-level KV block allocation with prefix-cache reuse.
+
+Reference analog: ``vllm/v1/core/kv_cache_manager.py:106``. Round-1 scope is
+a single full-attention KV group (the reference's UnitaryKVCacheCoordinator
+path); the interface leaves room for hybrid groups (sliding window, mamba).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from math import ceil
+
+from vllm_tpu.core.block_pool import BlockPool
+from vllm_tpu.core.kv_cache_utils import KVCacheBlock
+from vllm_tpu.logger import init_logger
+from vllm_tpu.request import Request
+
+logger = init_logger(__name__)
+
+
+@dataclass
+class PrefixCacheStats:
+    requests: int = 0
+    queries: int = 0  # tokens eligible for lookup
+    hits: int = 0  # tokens served from cache
+
+    def observe(self, queries: int, hits: int) -> None:
+        self.requests += 1
+        self.queries += queries
+        self.hits += hits
+
+    @property
+    def hit_rate(self) -> float:
+        return self.hits / self.queries if self.queries else 0.0
+
+
+class KVCacheManager:
+    def __init__(
+        self,
+        num_blocks: int,
+        block_size: int,
+        enable_caching: bool = True,
+    ) -> None:
+        self.block_size = block_size
+        self.enable_caching = enable_caching
+        self.block_pool = BlockPool(num_blocks, enable_caching)
+
+        self.req_to_blocks: dict[str, list[KVCacheBlock]] = {}
+        # How many leading blocks of each request are already registered in
+        # the prefix cache (avoids re-hashing on every allocate).
+        self.num_cached_blocks: dict[str, int] = {}
+        self.prefix_cache_stats = PrefixCacheStats()
+
+    # ------------------------------------------------------------------
+    # Prefix cache lookup (waiting -> running transition)
+    # ------------------------------------------------------------------
+
+    def get_computed_blocks(self, request: Request) -> tuple[list[KVCacheBlock], int]:
+        """Longest cached prefix for a new request.
+
+        Caps the hit at ``num_tokens - 1`` so at least one token is actually
+        scheduled (the model must produce logits for sampling) — reference:
+        ``find_longest_cache_hit`` semantics in ``kv_cache_utils.py``.
+        """
+        if not self.enable_caching or not request.block_hashes:
+            return [], 0
+        max_hit_blocks = (request.num_tokens - 1) // self.block_size
+        hit_blocks: list[KVCacheBlock] = []
+        for block_hash in request.block_hashes[:max_hit_blocks]:
+            block = self.block_pool.get_cached_block(block_hash)
+            if block is None:
+                break
+            hit_blocks.append(block)
+        num_hit_tokens = len(hit_blocks) * self.block_size
+        self.prefix_cache_stats.observe(request.num_tokens, num_hit_tokens)
+        return hit_blocks, num_hit_tokens
+
+    # ------------------------------------------------------------------
+    # Slot allocation (every scheduling of a request)
+    # ------------------------------------------------------------------
+
+    def allocate_slots(
+        self,
+        request: Request,
+        num_new_tokens: int,
+        new_computed_blocks: list[KVCacheBlock] | None = None,
+        num_new_computed_tokens: int = 0,
+        num_lookahead_tokens: int = 0,
+    ) -> list[KVCacheBlock] | None:
+        """Ensure the request has blocks covering its tokens after this step.
+
+        Returns the newly-allocated blocks, or None if the pool cannot
+        satisfy the request (caller preempts). Reference:
+        ``kv_cache_manager.py allocate_slots``.
+        """
+        assert num_new_tokens > 0
+        new_computed_blocks = new_computed_blocks or []
+
+        req_blocks = self.req_to_blocks.setdefault(request.request_id, [])
+        num_computed_tokens = request.num_computed_tokens + num_new_computed_tokens
+        # Lookahead covers speculative positions whose KV lands this step.
+        num_required_blocks = ceil(
+            (num_computed_tokens + num_new_tokens + num_lookahead_tokens)
+            / self.block_size
+        )
+        num_new_blocks = (
+            num_required_blocks - len(req_blocks) - len(new_computed_blocks)
+        )
+
+        # Cache-hit blocks with ref 0 sit in the free queue; touching them
+        # consumes free capacity, so subtract them from the availability check.
+        num_evictable_hits = sum(
+            1 for b in new_computed_blocks if b.ref_cnt == 0 and not b.is_null
+        )
+        if (
+            num_new_blocks
+            > self.block_pool.get_num_free_blocks() - num_evictable_hits
+        ):
+            return None
+
+        # Commit the cache hits.
+        if new_computed_blocks:
+            self.block_pool.touch(new_computed_blocks)
+            req_blocks.extend(new_computed_blocks)
+            self.num_cached_blocks[request.request_id] = len(req_blocks)
+
+        new_blocks: list[KVCacheBlock] = []
+        if num_new_blocks > 0:
+            new_blocks = self.block_pool.get_new_blocks(num_new_blocks)
+            req_blocks.extend(new_blocks)
+
+        if self.enable_caching:
+            self._cache_full_blocks(request, num_computed_tokens + num_new_tokens)
+        return new_blocks
+
+    def _cache_full_blocks(self, request: Request, num_tokens_after_step: int) -> None:
+        """Register every block that becomes full this step. Speculative
+        (unverified) positions are never cached — the caller passes only
+        confirmed token counts."""
+        num_full = min(
+            num_tokens_after_step // self.block_size, len(request.block_hashes)
+        )
+        num_cached = self.num_cached_blocks.get(request.request_id, 0)
+        if num_full <= num_cached:
+            return
+        self.block_pool.cache_full_blocks(
+            self.req_to_blocks[request.request_id],
+            request.block_hashes,
+            num_cached_blocks=num_cached,
+            num_full_blocks=num_full,
+        )
+        self.num_cached_blocks[request.request_id] = num_full
+
+    # ------------------------------------------------------------------
+    # Free
+    # ------------------------------------------------------------------
+
+    def free(self, request: Request) -> None:
+        """Release all blocks. Freed tail-first so eviction consumes the end
+        of the sequence before its (more reusable) prefix."""
+        blocks = self.req_to_blocks.pop(request.request_id, [])
+        self.num_cached_blocks.pop(request.request_id, None)
+        self.block_pool.free_blocks(list(reversed(blocks)))
+
+    # ------------------------------------------------------------------
+    # Introspection
+    # ------------------------------------------------------------------
+
+    def get_block_ids(self, request_id: str) -> list[int]:
+        return [b.block_id for b in self.req_to_blocks.get(request_id, [])]
+
+    def get_num_free_blocks(self) -> int:
+        return self.block_pool.get_num_free_blocks()
+
+    @property
+    def usage(self) -> float:
+        return self.block_pool.usage
+
+    def reset_prefix_cache(self) -> bool:
+        ok = self.block_pool.reset_prefix_cache()
+        if ok:
+            self.prefix_cache_stats = PrefixCacheStats()
+        return ok

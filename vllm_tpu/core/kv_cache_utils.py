@@ -1,0 +1,268 @@
+"""KV-cache block bookkeeping primitives.
+
+Reference analog: ``vllm/v1/core/kv_cache_utils.py`` — content-addressed
+block hashing for the prefix cache, the free-block queue with O(1) removal,
+and KV-cache sizing helpers. All host-side, device-agnostic.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import struct
+from dataclasses import dataclass, field
+from typing import TYPE_CHECKING, Callable, NamedTuple, Optional
+
+if TYPE_CHECKING:
+    from vllm_tpu.request import Request
+
+# A block hash is the digest of (parent_hash, tokens_in_block[, extra]).
+# bytes keeps it stable across processes (unlike builtin hash()).
+BlockHash = bytes
+
+
+class BlockHashWithGroupId(NamedTuple):
+    """Prefix-cache key: hash is per-content, group disambiguates KV groups
+    (hybrid models cache full-attention and sliding-window layers
+    separately)."""
+
+    block_hash: BlockHash
+    group_id: int
+
+
+# Root of every hash chain. Distinct from any real digest.
+NONE_HASH: BlockHash = b"\x00" * 16
+
+
+def hash_block_tokens(
+    parent_hash: BlockHash,
+    token_ids: "list[int] | tuple[int, ...]",
+    extra_keys: tuple | None = None,
+) -> BlockHash:
+    """Chain-hash one full block of tokens onto its parent.
+
+    Reference: ``kv_cache_utils.py hash_block_tokens``. The chain makes a
+    block's identity cover its entire prefix, so a dict lookup is a full
+    prefix match.
+    """
+    h = hashlib.blake2b(digest_size=16)
+    h.update(parent_hash)
+    h.update(struct.pack(f"<{len(token_ids)}q", *token_ids))
+    if extra_keys:
+        h.update(repr(extra_keys).encode())
+    return h.digest()
+
+
+def make_block_hasher(block_size: int) -> Callable[["Request"], list[BlockHash]]:
+    """Return an incremental hasher: called after tokens append, it returns
+    hashes for any newly-completed full blocks past ``request.block_hashes``.
+
+    Reference: ``kv_cache_utils.py get_request_block_hasher``.
+    """
+
+    def hasher(request: "Request") -> list[BlockHash]:
+        start = len(request.block_hashes)
+        prev = request.block_hashes[-1] if request.block_hashes else NONE_HASH
+        tokens = request.all_token_ids
+        num_full = len(tokens) // block_size
+        out: list[BlockHash] = []
+        extra = _request_extra_keys(request)
+        for i in range(start, num_full):
+            prev = hash_block_tokens(
+                prev, tokens[i * block_size : (i + 1) * block_size], extra
+            )
+            out.append(prev)
+        return out
+
+    return hasher
+
+
+def _request_extra_keys(request: "Request") -> tuple | None:
+    """Keys that change KV content beyond token ids (LoRA adapter, mm
+    hashes). Reference: ``generate_block_hash_extra_keys``."""
+    if request.lora_name is not None:
+        return (request.lora_name,)
+    return None
+
+
+@dataclass
+class KVCacheBlock:
+    """One physical block's bookkeeping entry.
+
+    Reference: ``kv_cache_utils.py:114``. Doubly-linked free-list pointers
+    live inline so eviction-order removal is O(1).
+    """
+
+    block_id: int
+    ref_cnt: int = 0
+    block_hash: Optional[BlockHashWithGroupId] = None
+    prev_free_block: Optional["KVCacheBlock"] = None
+    next_free_block: Optional["KVCacheBlock"] = None
+    # True only for the null block (block 0, permanent placeholder).
+    is_null: bool = False
+
+    def incr_ref(self) -> None:
+        self.ref_cnt += 1
+
+    def decr_ref(self) -> None:
+        self.ref_cnt -= 1
+
+    def reset_hash(self) -> None:
+        self.block_hash = None
+
+    def __repr__(self) -> str:
+        return f"KVCacheBlock(id={self.block_id}, ref={self.ref_cnt})"
+
+
+class FreeKVCacheBlockQueue:
+    """Doubly-linked LRU free list with O(1) append/popleft/remove.
+
+    Blocks are freed in reverse-request order so that the *tail* blocks of a
+    freed sequence are evicted before its head — preserving long prefixes in
+    the cache as long as possible (reference: ``FreeKVCacheBlockQueue``
+    docstring, ``kv_cache_utils.py:162``).
+    """
+
+    def __init__(self, blocks: list[KVCacheBlock]) -> None:
+        self.num_free_blocks = len(blocks)
+        # Sentinel head/tail keep edge cases out of the hot path.
+        self._head = KVCacheBlock(block_id=-1)
+        self._tail = KVCacheBlock(block_id=-2)
+        self._head.next_free_block = self._tail
+        self._tail.prev_free_block = self._head
+        for b in blocks:
+            self.append(b)
+        self.num_free_blocks = len(blocks)
+
+    def popleft(self) -> KVCacheBlock:
+        block = self._head.next_free_block
+        assert block is not None and block is not self._tail, "free queue is empty"
+        self.remove(block)
+        return block
+
+    def remove(self, block: KVCacheBlock) -> None:
+        prev, nxt = block.prev_free_block, block.next_free_block
+        assert prev is not None and nxt is not None, (
+            f"block {block.block_id} is not in the free queue"
+        )
+        prev.next_free_block = nxt
+        nxt.prev_free_block = prev
+        block.prev_free_block = block.next_free_block = None
+        self.num_free_blocks -= 1
+
+    def append(self, block: KVCacheBlock) -> None:
+        last = self._tail.prev_free_block
+        assert last is not None
+        last.next_free_block = block
+        block.prev_free_block = last
+        block.next_free_block = self._tail
+        self._tail.prev_free_block = block
+        self.num_free_blocks += 1
+
+    def get_all_free_blocks(self) -> list[KVCacheBlock]:
+        out = []
+        cur = self._head.next_free_block
+        while cur is not self._tail:
+            assert cur is not None
+            out.append(cur)
+            cur = cur.next_free_block
+        return out
+
+
+@dataclass
+class KVCacheSpec:
+    """Per-layer cache requirement (reference: ``vllm/v1/kv_cache_interface.py``).
+
+    ``page_size_bytes`` drives KV sizing; the worker allocates
+    ``num_blocks`` pages per layer.
+    """
+
+    block_size: int
+    num_kv_heads: int
+    head_size: int
+    dtype_bytes: int
+
+    @property
+    def page_size_bytes(self) -> int:
+        # K and V planes.
+        return 2 * self.block_size * self.num_kv_heads * self.head_size * self.dtype_bytes
+
+    def max_memory_usage_bytes(self, max_model_len: int) -> int:
+        import math
+
+        return math.ceil(max_model_len / self.block_size) * self.page_size_bytes
+
+
+@dataclass
+class FullAttentionSpec(KVCacheSpec):
+    sliding_window: int | None = None
+
+
+@dataclass
+class SlidingWindowSpec(KVCacheSpec):
+    sliding_window: int = 4096
+
+    def max_memory_usage_bytes(self, max_model_len: int) -> int:
+        import math
+
+        window = min(self.sliding_window, max_model_len)
+        # +1 block: the window straddles block boundaries.
+        return (math.ceil(window / self.block_size) + 1) * self.page_size_bytes
+
+
+@dataclass
+class MambaSpec(KVCacheSpec):
+    """SSM state: one fixed-size page per request, block_size = max_model_len
+    so the whole state is a single 'block'."""
+
+    state_shape: tuple = ()
+
+    @property
+    def page_size_bytes(self) -> int:
+        n = 1
+        for d in self.state_shape:
+            n *= d
+        return n * self.dtype_bytes
+
+
+@dataclass
+class KVCacheGroupSpec:
+    """Layers sharing one block-table/allocation group."""
+
+    layer_names: list[str]
+    kv_cache_spec: KVCacheSpec
+
+
+@dataclass
+class KVCacheConfig:
+    """Engine-wide cache plan (reference: ``kv_cache_interface.py:735``)."""
+
+    num_blocks: int
+    kv_cache_groups: list[KVCacheGroupSpec] = field(default_factory=list)
+
+
+def get_kv_cache_config_from_specs(
+    specs: dict[str, KVCacheSpec],
+    available_memory_bytes: int,
+    num_blocks_override: int | None = None,
+) -> KVCacheConfig:
+    """Size the cache: group layers by identical spec, divide free memory by
+    the per-token footprint. Round-1 scope: uniform specs → one group.
+
+    Reference: ``get_kv_cache_config`` (``kv_cache_utils.py``).
+    """
+    assert specs, "model exposed no KV cache specs"
+    groups: dict[tuple, KVCacheGroupSpec] = {}
+    for name, spec in specs.items():
+        key = (type(spec).__name__, spec.block_size, spec.num_kv_heads, spec.head_size, spec.dtype_bytes)
+        if key not in groups:
+            groups[key] = KVCacheGroupSpec([], spec)
+        groups[key].layer_names.append(name)
+
+    page_bytes_all_layers = sum(
+        g.kv_cache_spec.page_size_bytes * len(g.layer_names) for g in groups.values()
+    )
+    if num_blocks_override is not None:
+        num_blocks = num_blocks_override
+    else:
+        num_blocks = max(1, available_memory_bytes // page_bytes_all_layers)
+    return KVCacheConfig(num_blocks=num_blocks, kv_cache_groups=list(groups.values()))

@@ -1,0 +1,120 @@
+"""Wire formats between scheduler and model runner.
+
+Reference analogs: ``vllm/v1/core/sched/output.py`` (SchedulerOutput) and
+``vllm/v1/outputs.py`` (ModelRunnerOutput, EngineCoreOutputs).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any
+
+from vllm_tpu.sampling_params import SamplingParams
+
+
+@dataclass
+class NewRequestData:
+    """Everything the runner needs to admit a request it has never seen."""
+
+    req_id: str
+    prompt_token_ids: list[int]
+    sampling_params: SamplingParams
+    block_ids: list[int]
+    num_computed_tokens: int
+    lora_name: str | None = None
+    mm_inputs: list[Any] | None = None
+
+
+@dataclass
+class CachedRequestData:
+    """Delta for requests the runner already tracks (SoA layout like the
+    reference's CachedRequestData)."""
+
+    req_ids: list[str] = field(default_factory=list)
+    resumed_from_preemption: list[bool] = field(default_factory=list)
+    # All token ids, only populated for resumed requests (the runner's copy
+    # went stale across preemption); None otherwise.
+    resumed_req_token_ids: list[list[int] | None] = field(default_factory=list)
+    new_block_ids: list[list[int]] = field(default_factory=list)
+    num_computed_tokens: list[int] = field(default_factory=list)
+
+    @property
+    def num_reqs(self) -> int:
+        return len(self.req_ids)
+
+
+@dataclass
+class SchedulerOutput:
+    scheduled_new_reqs: list[NewRequestData] = field(default_factory=list)
+    scheduled_cached_reqs: CachedRequestData = field(default_factory=CachedRequestData)
+    # req_id -> tokens to run this step (includes spec tokens being verified).
+    num_scheduled_tokens: dict[str, int] = field(default_factory=dict)
+    total_num_scheduled_tokens: int = 0
+    # req_id -> draft token ids scheduled for verification this step.
+    scheduled_spec_decode_tokens: dict[str, list[int]] = field(default_factory=dict)
+    # Requests that finished/aborted since the last step (runner state cleanup).
+    finished_req_ids: set[str] = field(default_factory=set)
+    # Structured output: req_id -> row index into the grammar bitmask.
+    structured_output_request_ids: dict[str, int] = field(default_factory=dict)
+    grammar_bitmask: Any = None
+
+    @property
+    def num_reqs(self) -> int:
+        return len(self.scheduled_new_reqs) + self.scheduled_cached_reqs.num_reqs
+
+
+@dataclass
+class LogprobsLists:
+    """Flat logprobs for sampled tokens (reference: v1/outputs.py)."""
+
+    logprob_token_ids: list[list[int]]  # per sampled token: top ids (+sampled)
+    logprobs: list[list[float]]
+    sampled_token_ranks: list[int]
+
+
+@dataclass
+class ModelRunnerOutput:
+    req_ids: list[str] = field(default_factory=list)
+    # Per request: tokens sampled this step ([] => no sample, e.g. partial
+    # prefill; >1 with spec decode).
+    sampled_token_ids: list[list[int]] = field(default_factory=list)
+    logprobs: LogprobsLists | None = None
+    # req_id -> per-position top-logprobs for prompt tokens.
+    prompt_logprobs: dict[str, Any] = field(default_factory=dict)
+    # Draft tokens proposed this step for next-step verification.
+    draft_token_ids: dict[str, list[int]] = field(default_factory=dict)
+    # Pooling-model outputs keyed by req_id.
+    pooler_outputs: dict[str, Any] = field(default_factory=dict)
+
+
+EMPTY_MODEL_RUNNER_OUTPUT = ModelRunnerOutput()
+
+
+@dataclass
+class EngineCoreOutput:
+    req_id: str
+    new_token_ids: list[int]
+    finish_reason: str | None = None
+    stop_reason: int | str | None = None
+    new_logprobs: Any = None
+    num_cached_tokens: int = 0
+    events: list[Any] | None = None
+
+
+@dataclass
+class SchedulerStats:
+    """Per-step snapshot (reference: v1/metrics/stats.py)."""
+
+    num_running_reqs: int = 0
+    num_waiting_reqs: int = 0
+    kv_cache_usage: float = 0.0
+    prefix_cache_queries: int = 0
+    prefix_cache_hits: int = 0
+    num_preempted_reqs: int = 0
+
+
+@dataclass
+class EngineCoreOutputs:
+    outputs: list[EngineCoreOutput] = field(default_factory=list)
+    scheduler_stats: SchedulerStats | None = None
+    timestamp: float = 0.0

@@ -1,0 +1,439 @@
+"""Token-budget continuous-batching scheduler.
+
+Reference analog: ``vllm/v1/core/sched/scheduler.py`` (schedule :352,
+update_from_output :1290). Semantics ported faithfully — they are
+device-independent and battle-tested:
+
+- ONE token budget per step covering prefill and decode uniformly; a
+  request's step size is ``num_tokens_with_spec - num_computed_tokens``
+  capped by the remaining budget (chunked prefill falls out of the cap).
+- Running requests are served before waiting ones; allocation failure
+  preempts the lowest-priority running request (the list tail) and retries.
+- Waiting requests enter only while budget and max_num_seqs allow; a new
+  request's cached prefix is discovered here (prefix cache lookup).
+- ``update_from_output`` advances computed-token counts, applies spec-decode
+  accept/reject, performs stop checks, frees finished requests, and emits
+  per-request EngineCoreOutputs.
+"""
+
+from __future__ import annotations
+
+import time
+from collections import deque
+from typing import Iterable
+
+from vllm_tpu.config import CacheConfig, SchedulerConfig
+from vllm_tpu.core.kv_cache_manager import KVCacheManager
+from vllm_tpu.core.sched_output import (
+    CachedRequestData,
+    EngineCoreOutput,
+    EngineCoreOutputs,
+    ModelRunnerOutput,
+    NewRequestData,
+    SchedulerOutput,
+    SchedulerStats,
+)
+from vllm_tpu.logger import init_logger
+from vllm_tpu.request import Request, RequestStatus
+
+logger = init_logger(__name__)
+
+
+class RequestQueue:
+    """FCFS by default; priority policy orders by (priority, arrival).
+
+    Reference: ``vllm/v1/core/sched/request_queue.py``.
+    """
+
+    def __init__(self, policy: str = "fcfs") -> None:
+        self.policy = policy
+        self._q: deque[Request] = deque()
+
+    def add(self, request: Request) -> None:
+        if self.policy == "priority":
+            # Insertion sort keeps the deque ordered; queues are short
+            # relative to step cost.
+            key = (request.priority, request.arrival_time)
+            for i, r in enumerate(self._q):
+                if key < (r.priority, r.arrival_time):
+                    self._q.insert(i, request)
+                    return
+        self._q.append(request)
+
+    def prepend(self, request: Request) -> None:
+        """Resumed-preempted requests go to the head (FCFS) or re-sort."""
+        if self.policy == "priority":
+            self.add(request)
+        else:
+            self._q.appendleft(request)
+
+    def peek(self) -> Request:
+        return self._q[0]
+
+    def popleft(self) -> Request:
+        return self._q.popleft()
+
+    def remove(self, request: Request) -> None:
+        self._q.remove(request)
+
+    def __len__(self) -> int:
+        return len(self._q)
+
+    def __bool__(self) -> bool:
+        return bool(self._q)
+
+    def __iter__(self):
+        return iter(self._q)
+
+
+class Scheduler:
+    def __init__(
+        self,
+        scheduler_config: SchedulerConfig,
+        cache_config: CacheConfig,
+        structured_output_manager=None,
+    ) -> None:
+        self.config = scheduler_config
+        self.cache_config = cache_config
+        assert cache_config.num_gpu_blocks is not None, (
+            "CacheConfig.num_gpu_blocks must be set before Scheduler init"
+        )
+        self.kv_cache_manager = KVCacheManager(
+            num_blocks=cache_config.num_gpu_blocks,
+            block_size=cache_config.block_size,
+            enable_caching=cache_config.enable_prefix_caching,
+        )
+        self.block_size = cache_config.block_size
+        self.structured_output_manager = structured_output_manager
+
+        self.requests: dict[str, Request] = {}
+        self.waiting = RequestQueue(scheduler_config.policy)
+        self.running: list[Request] = []
+        # Requests finished since the last schedule() — the runner drops
+        # their persistent-batch state on the next step.
+        self.finished_req_ids: set[str] = set()
+        self._num_preempted_in_step = 0
+
+    # ------------------------------------------------------------------
+    # Request lifecycle
+    # ------------------------------------------------------------------
+
+    def add_request(self, request: Request) -> None:
+        self.requests[request.request_id] = request
+        request.status = RequestStatus.WAITING
+        self.waiting.add(request)
+
+    def finish_requests(
+        self, request_ids: str | Iterable[str], status: RequestStatus
+    ) -> list[Request]:
+        """External finish (abort, stop-string hit detected by the frontend
+        detokenizer). Reference: ``scheduler.py finish_requests``."""
+        if isinstance(request_ids, str):
+            request_ids = (request_ids,)
+        finished = []
+        for req_id in request_ids:
+            request = self.requests.get(req_id)
+            if request is None or request.is_finished:
+                continue
+            if request.status == RequestStatus.RUNNING:
+                self.running.remove(request)
+            elif request.status == RequestStatus.WAITING:
+                self.waiting.remove(request)
+            request.status = status
+            self._free_request(request)
+            finished.append(request)
+        return finished
+
+    def _free_request(self, request: Request) -> None:
+        self.kv_cache_manager.free(request)
+        self.finished_req_ids.add(request.request_id)
+        del self.requests[request.request_id]
+
+    def has_unfinished_requests(self) -> bool:
+        return bool(self.running) or bool(self.waiting)
+
+    def get_num_unfinished_requests(self) -> int:
+        return len(self.running) + len(self.waiting)
+
+    # ------------------------------------------------------------------
+    # schedule()
+    # ------------------------------------------------------------------
+
+    def schedule(self) -> SchedulerOutput:
+        self._num_preempted_in_step = 0
+        token_budget = self.config.max_num_batched_tokens
+        num_scheduled_tokens: dict[str, int] = {}
+        scheduled_spec_tokens: dict[str, list[int]] = {}
+        scheduled_new_reqs: list[NewRequestData] = []
+        cached = CachedRequestData()
+        # Blocks allocated this step per running request (delta to runner).
+        new_blocks_per_req: dict[str, list[int]] = {}
+        preempted_in_step: set[str] = set()
+
+        # Phase 1: running requests, in order (decode + in-flight prefills).
+        req_index = 0
+        while req_index < len(self.running) and token_budget > 0:
+            request = self.running[req_index]
+            num_new_tokens = request.num_tokens_with_spec - request.num_computed_tokens
+            if self.config.long_prefill_token_threshold > 0:
+                num_new_tokens = min(
+                    num_new_tokens, self.config.long_prefill_token_threshold
+                )
+            num_new_tokens = min(num_new_tokens, token_budget)
+            num_new_tokens = min(
+                num_new_tokens,
+                self.config.max_model_len - request.num_computed_tokens,
+            )
+            if num_new_tokens <= 0:
+                req_index += 1
+                continue
+
+            # Allocate, preempting the tail of `running` on failure.
+            while True:
+                new_blocks = self.kv_cache_manager.allocate_slots(
+                    request, num_new_tokens
+                )
+                if new_blocks is not None:
+                    break
+                if not self.running:
+                    break
+                victim = self.running.pop()
+                self._preempt(victim)
+                preempted_in_step.add(victim.request_id)
+                if victim is request:
+                    new_blocks = None
+                    break
+            if new_blocks is None:
+                # The request itself was preempted; scheduling continues with
+                # whatever remains.
+                break
+
+            # Trim speculative tokens that no longer fit the scheduled window.
+            if request.spec_token_ids:
+                num_scheduled_spec = (
+                    request.num_computed_tokens + num_new_tokens - request.num_tokens
+                )
+                if num_scheduled_spec > 0:
+                    scheduled_spec_tokens[request.request_id] = (
+                        request.spec_token_ids[:num_scheduled_spec]
+                    )
+
+            num_scheduled_tokens[request.request_id] = num_new_tokens
+            token_budget -= num_new_tokens
+            new_blocks_per_req[request.request_id] = [
+                b.block_id for b in new_blocks
+            ]
+            req_index += 1
+
+        # Phase 2: admit waiting requests.
+        while (
+            self.waiting
+            and token_budget > 0
+            and len(self.running) < self.config.max_num_seqs
+        ):
+            request = self.waiting.peek()
+
+            # Structured-output grammar still compiling -> leave in queue.
+            if request.use_structured_output and self.structured_output_manager:
+                if not self.structured_output_manager.is_ready(request):
+                    break
+
+            # Prefix-cache hit discovery (only before first schedule;
+            # resumed-preempted requests keep their progress at 0 and may
+            # re-hit the cache too).
+            new_computed_blocks, num_new_computed_tokens = (
+                self.kv_cache_manager.get_computed_blocks(request)
+                if request.num_computed_tokens == 0
+                else ([], 0)
+            )
+            num_new_tokens = (
+                request.num_tokens
+                - request.num_computed_tokens
+                - num_new_computed_tokens
+            )
+            if self.config.long_prefill_token_threshold > 0:
+                num_new_tokens = min(
+                    num_new_tokens, self.config.long_prefill_token_threshold
+                )
+            num_new_tokens = min(num_new_tokens, token_budget)
+            assert num_new_tokens > 0
+
+            new_blocks = self.kv_cache_manager.allocate_slots(
+                request,
+                num_new_tokens,
+                new_computed_blocks=new_computed_blocks,
+                num_new_computed_tokens=num_new_computed_tokens,
+            )
+            if new_blocks is None:
+                break  # out of KV space; don't preempt running for waiting
+
+            self.waiting.popleft()
+            resumed = request.status == RequestStatus.PREEMPTED
+            request.status = RequestStatus.RUNNING
+            self.running.append(request)
+            if request.num_cached_tokens < 0:
+                request.num_cached_tokens = num_new_computed_tokens
+            request.num_computed_tokens += num_new_computed_tokens
+
+            all_block_ids = self.kv_cache_manager.get_block_ids(request.request_id)
+            if resumed or request.request_id in preempted_in_step:
+                cached.req_ids.append(request.request_id)
+                cached.resumed_from_preemption.append(True)
+                cached.resumed_req_token_ids.append(list(request.all_token_ids))
+                cached.new_block_ids.append(all_block_ids)
+                cached.num_computed_tokens.append(request.num_computed_tokens)
+                preempted_in_step.discard(request.request_id)
+            else:
+                scheduled_new_reqs.append(
+                    NewRequestData(
+                        req_id=request.request_id,
+                        prompt_token_ids=request.prompt_token_ids,
+                        sampling_params=request.sampling_params,
+                        block_ids=all_block_ids,
+                        num_computed_tokens=request.num_computed_tokens,
+                        lora_name=request.lora_name,
+                    )
+                )
+            num_scheduled_tokens[request.request_id] = num_new_tokens
+            token_budget -= num_new_tokens
+
+        # Phase 3: cached-request records for already-running requests.
+        for request in self.running:
+            req_id = request.request_id
+            if req_id not in num_scheduled_tokens or req_id in (
+                r.req_id for r in scheduled_new_reqs
+            ):
+                continue
+            if req_id in cached.req_ids:
+                continue  # resumed this step, already recorded
+            cached.req_ids.append(req_id)
+            cached.resumed_from_preemption.append(False)
+            cached.resumed_req_token_ids.append(None)
+            cached.new_block_ids.append(new_blocks_per_req.get(req_id, []))
+            cached.num_computed_tokens.append(request.num_computed_tokens)
+
+        total = sum(num_scheduled_tokens.values())
+        output = SchedulerOutput(
+            scheduled_new_reqs=scheduled_new_reqs,
+            scheduled_cached_reqs=cached,
+            num_scheduled_tokens=num_scheduled_tokens,
+            total_num_scheduled_tokens=total,
+            scheduled_spec_decode_tokens=scheduled_spec_tokens,
+            finished_req_ids=self.finished_req_ids,
+        )
+        self.finished_req_ids = set()
+        return output
+
+    def _preempt(self, request: Request) -> None:
+        self.kv_cache_manager.free(request)
+        request.status = RequestStatus.PREEMPTED
+        request.num_computed_tokens = 0
+        request.num_preemptions += 1
+        request.spec_token_ids = []
+        self._num_preempted_in_step += 1
+        self.waiting.prepend(request)
+
+    # ------------------------------------------------------------------
+    # update_from_output()
+    # ------------------------------------------------------------------
+
+    def update_from_output(
+        self,
+        scheduler_output: SchedulerOutput,
+        runner_output: ModelRunnerOutput,
+    ) -> EngineCoreOutputs:
+        outputs: list[EngineCoreOutput] = []
+        spec_scheduled = scheduler_output.scheduled_spec_decode_tokens
+
+        for req_index, req_id in enumerate(runner_output.req_ids):
+            request = self.requests.get(req_id)
+            if request is None:
+                continue  # finished externally between schedule and update
+            num_tokens_scheduled = scheduler_output.num_scheduled_tokens.get(req_id)
+            if num_tokens_scheduled is None:
+                continue
+
+            generated = runner_output.sampled_token_ids[req_index]
+            scheduled_spec = spec_scheduled.get(req_id, [])
+
+            request.num_computed_tokens += num_tokens_scheduled
+            if scheduled_spec:
+                # Verification: len(generated) = accepted drafts + 1 bonus.
+                # Rejected draft positions hold garbage KV; roll computed
+                # count back so they are recomputed (reference:
+                # scheduler.py:1290 spec-token accounting).
+                num_rejected = len(scheduled_spec) + 1 - len(generated)
+                assert num_rejected >= 0
+                request.num_computed_tokens -= num_rejected
+            request.spec_token_ids = []
+
+            new_token_ids: list[int] = []
+            stopped = False
+            for tok in generated:
+                request.append_output_token_ids(tok)
+                new_token_ids.append(tok)
+                stopped = self._check_stop(request)
+                if stopped:
+                    break
+
+            if req_id in runner_output.draft_token_ids:
+                request.spec_token_ids = runner_output.draft_token_ids[req_id]
+
+            if stopped:
+                self.running.remove(request)
+                self._free_request(request)
+
+            if new_token_ids or stopped:
+                outputs.append(
+                    EngineCoreOutput(
+                        req_id=req_id,
+                        new_token_ids=new_token_ids,
+                        finish_reason=request.get_finished_reason(),
+                        stop_reason=request.stop_reason,
+                        num_cached_tokens=max(request.num_cached_tokens, 0),
+                    )
+                )
+
+        return EngineCoreOutputs(
+            outputs=outputs,
+            scheduler_stats=self.make_stats(),
+            timestamp=time.monotonic(),
+        )
+
+    def _check_stop(self, request: Request) -> bool:
+        """Stop conditions checked engine-side (stop *strings* are checked in
+        the frontend detokenizer). Reference: ``vllm/v1/core/sched/utils.py
+        check_stop``."""
+        params = request.sampling_params
+        if (
+            request.num_tokens >= self.config.max_model_len
+            or request.num_output_tokens >= request.max_tokens
+        ):
+            request.status = RequestStatus.FINISHED_LENGTH_CAPPED
+            return True
+        if request.num_output_tokens < params.min_tokens:
+            return False
+        last = request.all_token_ids[-1]
+        if not params.ignore_eos and last == request.eos_token_id:
+            request.status = RequestStatus.FINISHED_STOPPED
+            return True
+        if last in params.all_stop_token_ids:
+            request.status = RequestStatus.FINISHED_STOPPED
+            request.stop_reason = last
+            return True
+        return False
+
+    # ------------------------------------------------------------------
+    # Stats
+    # ------------------------------------------------------------------
+
+    def make_stats(self) -> SchedulerStats:
+        stats = self.kv_cache_manager.prefix_cache_stats
+        return SchedulerStats(
+            num_running_reqs=len(self.running),
+            num_waiting_reqs=len(self.waiting),
+            kv_cache_usage=self.kv_cache_manager.usage,
+            prefix_cache_queries=stats.queries,
+            prefix_cache_hits=stats.hits,
+            num_preempted_reqs=self._num_preempted_in_step,
+        )
