@@ -1,0 +1,88 @@
+"""Helpers for model-level tests: tiny checkpoints + hand-built attention
+metadata (single request, contiguous blocks from 1)."""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax.numpy as jnp
+
+
+def tiny_llama_config(**overrides):
+    from transformers import LlamaConfig
+
+    kwargs = dict(
+        vocab_size=128,
+        hidden_size=64,
+        intermediate_size=128,
+        num_hidden_layers=2,
+        num_attention_heads=4,
+        num_key_value_heads=2,
+        max_position_embeddings=256,
+        rms_norm_eps=1e-6,
+        tie_word_embeddings=False,
+    )
+    kwargs.update(overrides)
+    return LlamaConfig(**kwargs)
+
+
+def tiny_llama_dir(path, **overrides) -> str:
+    """Random-weight tiny HF llama saved as safetensors."""
+    import torch
+    from transformers import LlamaForCausalLM
+
+    torch.manual_seed(0)
+    model = LlamaForCausalLM(tiny_llama_config(**overrides))
+    model = model.to(torch.float32)
+    model.save_pretrained(str(path), safe_serialization=True)
+    return str(path)
+
+
+def _kv_cache(model, num_blocks: int, block_size: int, dtype=jnp.float32):
+    return jnp.zeros(
+        (model.num_layers, num_blocks, block_size, 2 * model.num_kv_heads, model.head_dim),
+        dtype,
+    )
+
+
+def build_prefill_metadata(model, t: int, block_size: int = 4, num_blocks: int = 64):
+    """Single request occupying blocks 1..ceil(t/bs), positions 0..t-1."""
+    from vllm_tpu.ops.attention import AttentionMetadata
+
+    n_blocks_used = -(-t // block_size)
+    positions = np.arange(t, dtype=np.int32)
+    block_ids = np.arange(1, n_blocks_used + 1, dtype=np.int32)
+    slot_mapping = block_ids[positions // block_size] * block_size + positions % block_size
+    block_tables = np.zeros((1, max(n_blocks_used, 1) + 2), np.int32)
+    block_tables[0, :n_blocks_used] = block_ids
+    md = AttentionMetadata(
+        positions=jnp.asarray(positions),
+        slot_mapping=jnp.asarray(slot_mapping, jnp.int32),
+        block_tables=jnp.asarray(block_tables),
+        seq_lens=jnp.asarray([t], jnp.int32),
+        query_start_loc=jnp.asarray([0, t], jnp.int32),
+        token_req_idx=jnp.zeros(t, jnp.int32),
+        logits_indices=jnp.asarray([t - 1], jnp.int32),
+    )
+    return md, _kv_cache(model, num_blocks, block_size)
+
+
+def build_decode_metadata(model, pos: int, block_size: int = 4):
+    """One new token at position `pos` for the same single request."""
+    from vllm_tpu.ops.attention import AttentionMetadata
+
+    seq_len = pos + 1
+    n_blocks_used = -(-seq_len // block_size)
+    block_ids = np.arange(1, n_blocks_used + 1, dtype=np.int32)
+    slot = block_ids[pos // block_size] * block_size + pos % block_size
+    block_tables = np.zeros((1, n_blocks_used + 2), np.int32)
+    block_tables[0, :n_blocks_used] = block_ids
+    return AttentionMetadata(
+        positions=jnp.asarray([pos], jnp.int32),
+        slot_mapping=jnp.asarray([slot], jnp.int32),
+        block_tables=jnp.asarray(block_tables),
+        seq_lens=jnp.asarray([seq_len], jnp.int32),
+        query_start_loc=jnp.asarray([0, 1], jnp.int32),
+        token_req_idx=jnp.zeros(1, jnp.int32),
+        logits_indices=jnp.asarray([0], jnp.int32),
+    )

@@ -1,0 +1,24 @@
+"""Activations. Reference analog: ``vllm/model_executor/layers/activation.py``
+(``SiluAndMul`` :118 etc.) — hand-fused CUDA there, plain jnp here (XLA
+fuses elementwise chains into the surrounding matmuls).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def silu_and_mul(x: jnp.ndarray) -> jnp.ndarray:
+    """Input [..., 2F]: silu(x[..., :F]) * x[..., F:]."""
+    gate, up = jnp.split(x, 2, axis=-1)
+    return jax.nn.silu(gate) * up
+
+
+def gelu_and_mul(x: jnp.ndarray, approximate: str = "tanh") -> jnp.ndarray:
+    gate, up = jnp.split(x, 2, axis=-1)
+    return jax.nn.gelu(gate, approximate=approximate == "tanh") * up
+
+
+def gelu_new(x: jnp.ndarray) -> jnp.ndarray:
+    return jax.nn.gelu(x, approximate=True)

@@ -1,0 +1,27 @@
+"""RMSNorm. Reference analog: ``vllm/model_executor/layers/layernorm.py:38``.
+
+On TPU this is a plain jnp expression — XLA fuses it into neighboring ops,
+which is what the reference's CUDA ``rms_norm``/``fused_add_rms_norm``
+kernels exist to do by hand.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def rms_norm(x: jnp.ndarray, weight: jnp.ndarray, eps: float = 1e-6) -> jnp.ndarray:
+    orig_dtype = x.dtype
+    x32 = x.astype(jnp.float32)
+    var = jnp.mean(x32 * x32, axis=-1, keepdims=True)
+    out = x32 * jnp.reciprocal(jnp.sqrt(var + eps))
+    return (out * weight.astype(jnp.float32)).astype(orig_dtype)
+
+
+def fused_add_rms_norm(
+    x: jnp.ndarray, residual: jnp.ndarray, weight: jnp.ndarray, eps: float = 1e-6
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Returns (normed(x + residual), x + residual) — the residual-stream
+    update used between sublayers."""
+    residual = x + residual
+    return rms_norm(residual, weight, eps), residual

@@ -1,0 +1,129 @@
+"""Rotary position embeddings.
+
+Reference analog: ``vllm/model_executor/layers/rotary_embedding/`` (base
+:118 plus ~15 scaling variants). We implement the HF "rotate_half"
+convention exactly so logits match transformers numerics, with the scaling
+variants the round-1 model zoo needs: none, linear, llama3, yarn.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any
+
+import jax.numpy as jnp
+import numpy as np
+
+
+def _base_inv_freq(head_dim: int, theta: float, rotary_dim: int | None = None) -> np.ndarray:
+    rd = rotary_dim or head_dim
+    return 1.0 / (theta ** (np.arange(0, rd, 2, dtype=np.float64) / rd))
+
+
+def _llama3_scale(inv_freq: np.ndarray, scaling: dict[str, Any]) -> np.ndarray:
+    """Llama-3.1 frequency-dependent scaling (transformers
+    ``_compute_llama3_parameters``)."""
+    factor = scaling["factor"]
+    low = scaling.get("low_freq_factor", 1.0)
+    high = scaling.get("high_freq_factor", 4.0)
+    orig_len = scaling.get("original_max_position_embeddings", 8192)
+
+    wavelen = 2 * math.pi / inv_freq
+    low_wavelen = orig_len / low
+    high_wavelen = orig_len / high
+    scaled = np.where(wavelen > low_wavelen, inv_freq / factor, inv_freq)
+    smooth = (orig_len / wavelen - low) / (high - low)
+    smoothed = (1 - smooth) / factor * inv_freq + smooth * inv_freq
+    mid = (wavelen <= low_wavelen) & (wavelen >= high_wavelen)
+    return np.where(mid, smoothed, scaled)
+
+
+def _yarn_scale(
+    inv_freq: np.ndarray, scaling: dict[str, Any], head_dim: int, theta: float
+) -> tuple[np.ndarray, float]:
+    """YaRN (NTK-by-parts) scaling; returns (inv_freq, mscale)."""
+    factor = scaling["factor"]
+    orig_len = scaling.get("original_max_position_embeddings", 4096)
+    beta_fast = scaling.get("beta_fast", 32)
+    beta_slow = scaling.get("beta_slow", 1)
+
+    def find_dim(num_rot: float) -> float:
+        return (
+            head_dim * math.log(orig_len / (num_rot * 2 * math.pi))
+        ) / (2 * math.log(theta))
+
+    lo = max(math.floor(find_dim(beta_fast)), 0)
+    hi = min(math.ceil(find_dim(beta_slow)), head_dim - 1)
+    ramp = np.clip(
+        (np.arange(head_dim // 2, dtype=np.float64) - lo) / max(hi - lo, 1e-3), 0, 1
+    )
+    mask = 1.0 - ramp
+    scaled = inv_freq / factor * (1 - mask) + inv_freq * mask
+    mscale = scaling.get("mscale", 1.0)
+    attn_factor = scaling.get("attn_factor", 1.0)
+    m = (0.1 * math.log(factor) + 1.0) * attn_factor if factor > 1 else 1.0 * attn_factor
+    _ = mscale
+    return scaled, m
+
+
+class RotaryEmbedding:
+    """Precomputes cos/sin tables up to ``max_position``; applied by gather
+    at runtime positions (ragged batch friendly)."""
+
+    def __init__(
+        self,
+        head_dim: int,
+        max_position: int,
+        theta: float = 10000.0,
+        rope_scaling: dict[str, Any] | None = None,
+        rotary_dim: int | None = None,
+        dtype=jnp.float32,
+    ) -> None:
+        self.head_dim = head_dim
+        self.rotary_dim = rotary_dim or head_dim
+        inv_freq = _base_inv_freq(head_dim, theta, rotary_dim)
+        mscale = 1.0
+        if rope_scaling:
+            rope_type = rope_scaling.get("rope_type", rope_scaling.get("type", "default"))
+            if rope_type == "llama3":
+                inv_freq = _llama3_scale(inv_freq, rope_scaling)
+            elif rope_type == "linear":
+                inv_freq = inv_freq / rope_scaling["factor"]
+            elif rope_type == "yarn":
+                inv_freq, mscale = _yarn_scale(
+                    inv_freq, rope_scaling, self.rotary_dim, theta
+                )
+            elif rope_type in ("default", "dynamic"):
+                pass  # dynamic NTK beyond max_position: out of round-1 scope
+            else:
+                raise NotImplementedError(f"rope_type {rope_type}")
+
+        t = np.arange(max_position, dtype=np.float64)
+        freqs = np.outer(t, inv_freq)  # [P, rd/2]
+        self.cos = jnp.asarray(np.cos(freqs) * mscale, dtype=dtype)
+        self.sin = jnp.asarray(np.sin(freqs) * mscale, dtype=dtype)
+
+    def __call__(
+        self, positions: jnp.ndarray, q: jnp.ndarray, k: jnp.ndarray
+    ) -> tuple[jnp.ndarray, jnp.ndarray]:
+        """positions [T]; q [T, H, D]; k [T, KH, D] (rotate_half layout)."""
+        cos = self.cos[positions][:, None, :]  # [T, 1, rd/2]
+        sin = self.sin[positions][:, None, :]
+        q = _apply_rotate_half(q, cos, sin, self.rotary_dim)
+        k = _apply_rotate_half(k, cos, sin, self.rotary_dim)
+        return q, k
+
+
+def _apply_rotate_half(
+    x: jnp.ndarray, cos: jnp.ndarray, sin: jnp.ndarray, rotary_dim: int
+) -> jnp.ndarray:
+    dtype = x.dtype
+    rot, rest = x[..., :rotary_dim], x[..., rotary_dim:]
+    x1 = rot[..., : rotary_dim // 2].astype(jnp.float32)
+    x2 = rot[..., rotary_dim // 2 :].astype(jnp.float32)
+    out1 = x1 * cos - x2 * sin
+    out2 = x2 * cos + x1 * sin
+    rot_out = jnp.concatenate([out1, out2], axis=-1).astype(dtype)
+    if rest.shape[-1]:
+        return jnp.concatenate([rot_out, rest], axis=-1)
+    return rot_out

@@ -1,0 +1,61 @@
+"""Model interface: what the runner needs from every architecture.
+
+Reference analog: the implicit contract of ``vllm/model_executor/models/``
+(compose layers, expose KV specs, load weights). Here it is explicit and
+functional: params are pytrees, ``apply`` is a pure function traced under
+``jax.jit``, and layer stacking (leading ``L`` axis + ``lax.scan``) keeps
+compile time flat in depth.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Protocol
+
+import jax.numpy as jnp
+
+from vllm_tpu.core.kv_cache_utils import KVCacheSpec
+from vllm_tpu.ops.attention import AttentionMetadata
+
+
+class Model(Protocol):
+    """A model family implements this protocol (structural typing)."""
+
+    # Architecture facts the runner sizes buffers from.
+    num_layers: int
+    num_kv_heads: int
+    head_dim: int
+    vocab_size: int
+    hidden_size: int
+
+    def init_dummy_params(self, rng: Any, dtype: Any) -> Any:
+        """Random-init params (reference: load_format='dummy')."""
+        ...
+
+    def load_params(self, path: str, dtype: Any, sharding: Any | None = None) -> Any:
+        """Stream safetensors from a local checkout into (sharded) params."""
+        ...
+
+    def apply(
+        self,
+        params: Any,
+        kv_cache: jnp.ndarray,
+        input_ids: jnp.ndarray,
+        md: AttentionMetadata,
+    ) -> tuple[jnp.ndarray, jnp.ndarray]:
+        """Forward over the ragged token batch.
+
+        Returns (hidden [T, hidden_size], updated kv_cache). KV write happens
+        inside (fused with attention on the Pallas path).
+        """
+        ...
+
+    def compute_logits(self, params: Any, hidden: jnp.ndarray) -> jnp.ndarray:
+        """hidden [N, hidden_size] -> logits [N, vocab] (f32)."""
+        ...
+
+    def get_kv_cache_spec(self, block_size: int, dtype_bytes: int) -> dict[str, KVCacheSpec]:
+        ...
+
+    def param_shardings(self, mesh_axes: dict[str, str]) -> Any:
+        """PartitionSpec pytree matching params (GSPMD TP annotations)."""
+        ...

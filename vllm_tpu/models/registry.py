@@ -1,0 +1,49 @@
+"""Architecture registry.
+
+Reference analog: ``vllm/model_executor/models/registry.py:70`` (320+
+architectures over lazy imports). Keyed by the HF ``architectures[0]``
+string; entries are lazy so importing the registry stays cheap.
+"""
+
+from __future__ import annotations
+
+import importlib
+from typing import Any
+
+from vllm_tpu.logger import init_logger
+
+logger = init_logger(__name__)
+
+# arch name -> (module, class)
+_REGISTRY: dict[str, tuple[str, str]] = {
+    "LlamaForCausalLM": ("vllm_tpu.models.llama", "LlamaForCausalLM"),
+    "MistralForCausalLM": ("vllm_tpu.models.llama", "MistralForCausalLM"),
+    "Qwen2ForCausalLM": ("vllm_tpu.models.llama", "Qwen2ForCausalLM"),
+}
+
+
+class ModelRegistry:
+    @staticmethod
+    def register(arch: str, module: str, cls: str) -> None:
+        """Out-of-tree model plugin hook (reference: plugin system)."""
+        _REGISTRY[arch] = (module, cls)
+
+    @staticmethod
+    def get_supported_archs() -> list[str]:
+        return sorted(_REGISTRY)
+
+    @staticmethod
+    def resolve(hf_config: Any) -> type:
+        archs = getattr(hf_config, "architectures", None) or []
+        for arch in archs:
+            if arch in _REGISTRY:
+                module, cls = _REGISTRY[arch]
+                return getattr(importlib.import_module(module), cls)
+        raise ValueError(
+            f"no supported architecture in {archs}; supported: "
+            f"{ModelRegistry.get_supported_archs()}"
+        )
+
+
+def get_model_class(hf_config: Any) -> type:
+    return ModelRegistry.resolve(hf_config)

@@ -1,0 +1,132 @@
+"""Paged attention over an HBM block table — XLA reference implementation.
+
+This replaces the reference's CUDA paged attention + KV insert pipeline
+(``csrc/attention/paged_attention_v1/v2.cu``, ``reshape_and_cache_flash`` in
+``csrc/cache_kernels.cu``) with a TPU-native design:
+
+- ONE ragged layout for prefill and decode alike: the step processes a flat
+  ``[T]`` token batch spanning all scheduled requests (chunked prefills and
+  single-token decodes mixed), exactly like the reference's unified v1
+  scheduler feeds its workers.
+- KV insert is a static-shape scatter into the paged cache via a per-token
+  ``slot_mapping``; padded tokens target slot 0 (the null block, a write-only
+  garbage page — never read).
+- The implementation here is pure XLA (gather + masked softmax), correct on
+  any backend and used for CPU tests; the Pallas flash-decode kernel in
+  ``ops/ragged_paged_attention.py`` is the TPU fast path with identical
+  semantics.
+
+KV cache layout per layer: ``[num_blocks, block_size, 2*KH, head_dim]``
+with K heads in ``[:KH]`` and V heads in ``[KH:]`` — one block's KV is a
+contiguous page, which is what the Pallas kernel DMAs per block-table entry.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+
+
+@jax.tree_util.register_dataclass
+@dataclass
+class AttentionMetadata:
+    """Device-side per-step attention inputs (all padded to bucket sizes).
+
+    Shapes: T = padded token count, R = padded request count,
+    B = padded blocks-per-request.
+    """
+
+    positions: jnp.ndarray  # [T] i32, position of each token in its sequence
+    slot_mapping: jnp.ndarray  # [T] i32, flat cache slot = block_id*bs + off
+    block_tables: jnp.ndarray  # [R, B] i32
+    seq_lens: jnp.ndarray  # [R] i32, context length incl. this step's tokens
+    query_start_loc: jnp.ndarray  # [R+1] i32, ragged row offsets into [T]
+    token_req_idx: jnp.ndarray  # [T] i32, owning request row per token
+    # [R] i32: index into [T] of each request's last scheduled token (rows
+    # beyond the live request count point at 0 and are masked downstream).
+    logits_indices: jnp.ndarray
+
+
+def write_kv(
+    kv_cache: jnp.ndarray,  # [NB, BS, 2*KH, D]
+    k: jnp.ndarray,  # [T, KH, D]
+    v: jnp.ndarray,  # [T, KH, D]
+    slot_mapping: jnp.ndarray,  # [T]
+) -> jnp.ndarray:
+    """Scatter this step's K/V into their paged slots."""
+    nb, bs, kh2, d = kv_cache.shape
+    kv_new = jnp.concatenate([k, v], axis=1)  # [T, 2KH, D]
+    flat = kv_cache.reshape(nb * bs, kh2, d)
+    flat = flat.at[slot_mapping].set(kv_new.astype(kv_cache.dtype))
+    return flat.reshape(nb, bs, kh2, d)
+
+
+def paged_attention(
+    q: jnp.ndarray,
+    kv_cache: jnp.ndarray,
+    md: AttentionMetadata,
+    scale: float,
+    *,
+    sliding_window: int | None = None,
+) -> jnp.ndarray:
+    """Backend dispatcher: Pallas ragged kernel on TPU, XLA reference
+    elsewhere (and under VLLM_TPU_DISABLE_PALLAS)."""
+    import vllm_tpu.envs as envs
+
+    if not envs.VLLM_TPU_DISABLE_PALLAS:
+        try:
+            from vllm_tpu.ops.ragged_paged_attention import ragged_paged_attention
+
+            return ragged_paged_attention(
+                q, kv_cache, md, scale, sliding_window=sliding_window
+            )
+        except ImportError:
+            pass
+    return ref_ragged_paged_attention(
+        q, kv_cache, md, scale, sliding_window=sliding_window
+    )
+
+
+def ref_ragged_paged_attention(
+    q: jnp.ndarray,  # [T, H, D]
+    kv_cache: jnp.ndarray,  # [NB, BS, 2*KH, D] (already contains this step's KV)
+    md: AttentionMetadata,
+    scale: float,
+    *,
+    sliding_window: int | None = None,
+) -> jnp.ndarray:
+    """Gather-based masked attention. Each token attends to its request's
+    cached context up to and including its own position (causal)."""
+    t, h, d = q.shape
+    nb, bs, kh2, _ = kv_cache.shape
+    kh = kh2 // 2
+    groups = h // kh
+
+    # [R, B, BS, 2KH, D] -> [R, C, 2KH, D]; C = padded context length.
+    pages = kv_cache[md.block_tables]
+    r, b = md.block_tables.shape
+    ctx = b * bs
+    kv_req = pages.reshape(r, ctx, kh2, d)
+    k_all = kv_req[:, :, :kh]
+    v_all = kv_req[:, :, kh:]
+
+    # Per-token gather of the owning request's context.
+    k_t = k_all[md.token_req_idx]  # [T, C, KH, D]
+    v_t = v_all[md.token_req_idx]
+
+    qg = q.reshape(t, kh, groups, d).astype(jnp.float32)
+    scores = jnp.einsum("tkgd,tckd->tkgc", qg, k_t.astype(jnp.float32)) * scale
+
+    ctx_pos = jnp.arange(ctx, dtype=jnp.int32)[None, :]  # [1, C]
+    causal = ctx_pos <= md.positions[:, None]  # [T, C]
+    if sliding_window is not None:
+        causal &= ctx_pos > (md.positions[:, None] - sliding_window)
+    scores = jnp.where(causal[:, None, None, :], scores, -jnp.inf)
+
+    probs = jax.nn.softmax(scores, axis=-1)
+    # Fully-masked rows (padding tokens) produce NaN-free zeros:
+    probs = jnp.where(jnp.isnan(probs), 0.0, probs)
+    out = jnp.einsum("tkgc,tckd->tkgd", probs, v_t.astype(jnp.float32))
+    return out.reshape(t, h, d).astype(q.dtype)
