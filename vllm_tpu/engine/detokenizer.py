@@ -1,0 +1,94 @@
+"""Incremental detokenization with stop-string scanning.
+
+Reference analog: ``vllm/v1/engine/detokenizer.py``. The offset algorithm
+(prefix_offset/read_offset, hold back while the tail decodes to U+FFFD)
+makes streaming byte-level BPE safe: a delta is only emitted once the
+accumulated tokens decode to a stable string.
+"""
+
+from __future__ import annotations
+
+from vllm_tpu.sampling_params import SamplingParams
+
+_REPLACEMENT = "�"
+# How many trailing prompt tokens seed the decode window.
+_INITIAL_WINDOW = 5
+
+
+class IncrementalDetokenizer:
+    def __init__(
+        self,
+        tokenizer,
+        prompt_token_ids: list[int],
+        params: SamplingParams,
+    ) -> None:
+        self.tokenizer = tokenizer
+        self.skip_special = params.skip_special_tokens
+        self.stop = params.stop
+        self.include_stop = params.include_stop_str_in_output
+        self.token_ids: list[int] = list(prompt_token_ids)
+        self.prompt_len = len(prompt_token_ids)
+        self.prefix_offset = max(self.prompt_len - _INITIAL_WINDOW, 0)
+        self.read_offset = self.prompt_len
+        self.output_text = ""
+        # Index up to which stop-string search has already cleared.
+        self._stop_checked = 0
+
+    @property
+    def output_token_ids(self) -> list[int]:
+        return self.token_ids[self.prompt_len :]
+
+    def update(self, new_token_ids: list[int]) -> str | None:
+        """Append tokens, grow output text. Returns the matched stop string
+        if one fired (output_text is already truncated), else None."""
+        if self.tokenizer is None:
+            self.token_ids.extend(new_token_ids)
+            return None
+        for tok in new_token_ids:
+            self.token_ids.append(tok)
+            self._decode_tail()
+        return self._check_stop_strings()
+
+    def _decode_tail(self) -> None:
+        tok = self.tokenizer
+        prefix_text = tok.decode(
+            self.token_ids[self.prefix_offset : self.read_offset],
+            skip_special_tokens=self.skip_special,
+        )
+        full_text = tok.decode(
+            self.token_ids[self.prefix_offset :],
+            skip_special_tokens=self.skip_special,
+        )
+        if len(full_text) > len(prefix_text) and not full_text.endswith(_REPLACEMENT):
+            self.output_text += full_text[len(prefix_text) :]
+            self.prefix_offset = self.read_offset
+            self.read_offset = len(self.token_ids)
+
+    def _check_stop_strings(self) -> str | None:
+        if not self.stop or len(self.output_text) == self._stop_checked:
+            return None
+        # Re-scan a window that covers strings straddling the old boundary.
+        max_stop = max(len(s) for s in self.stop)
+        start = max(self._stop_checked - max_stop + 1, 0)
+        best: tuple[int, str] | None = None
+        for s in self.stop:
+            idx = self.output_text.find(s, start)
+            if idx != -1 and (best is None or idx < best[0]):
+                best = (idx, s)
+        self._stop_checked = len(self.output_text)
+        if best is None:
+            return None
+        idx, s = best
+        self.output_text = self.output_text[: idx + len(s)] if self.include_stop else self.output_text[:idx]
+        return s
+
+    def get_next_output_text(self, finished: bool, delta: bool, sent: int) -> tuple[str, int]:
+        """Streaming helper: with byte-level BPE the final chars may only
+        stabilize at finish; hold back a small tail until then."""
+        holdback = 0 if finished else _INITIAL_WINDOW
+        stable = len(self.output_text) - holdback
+        if delta:
+            if stable > sent:
+                return self.output_text[sent:stable], stable
+            return "", sent
+        return self.output_text[: max(stable, 0)], sent

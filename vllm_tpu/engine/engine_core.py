@@ -1,0 +1,68 @@
+"""EngineCore: the schedule -> execute -> update inner loop.
+
+Reference analog: ``vllm/v1/engine/core.py:91`` (step :402). The process
+wrapper (ZMQ busy loop) lives in ``engine/core_proc.py``; this class is the
+in-proc core both paths share.
+"""
+
+from __future__ import annotations
+
+from typing import Iterable
+
+from vllm_tpu.config import EngineConfig
+from vllm_tpu.core.kv_cache_utils import make_block_hasher
+from vllm_tpu.core.sched_output import EngineCoreOutputs
+from vllm_tpu.core.scheduler import Scheduler
+from vllm_tpu.engine.executor import Executor
+from vllm_tpu.logger import init_logger
+from vllm_tpu.request import EngineCoreRequest, Request, RequestStatus
+
+logger = init_logger(__name__)
+
+
+class EngineCore:
+    def __init__(self, config: EngineConfig, executor_class: type[Executor] | None = None) -> None:
+        self.config = config.finalize()
+        executor_class = executor_class or Executor.get_class(config)
+        self.executor = executor_class(config)
+        num_blocks = self.executor.initialize()
+        config.cache_config.num_gpu_blocks = num_blocks
+
+        self.scheduler = Scheduler(
+            config.scheduler_config,
+            config.cache_config,
+            structured_output_manager=self._make_structured_output_manager(),
+        )
+        self._block_hasher = (
+            make_block_hasher(config.cache_config.block_size)
+            if config.cache_config.enable_prefix_caching
+            else None
+        )
+
+    def _make_structured_output_manager(self):
+        return None  # wired in feature ring 1
+
+    # ------------------------------------------------------------------
+
+    def add_request(self, request: EngineCoreRequest) -> None:
+        req = Request.from_engine_core_request(request, self._block_hasher)
+        self.scheduler.add_request(req)
+
+    def abort_requests(self, request_ids: Iterable[str]) -> None:
+        self.scheduler.finish_requests(request_ids, RequestStatus.FINISHED_ABORTED)
+
+    def has_unfinished_requests(self) -> bool:
+        return self.scheduler.has_unfinished_requests()
+
+    def step(self) -> EngineCoreOutputs:
+        if not self.scheduler.has_unfinished_requests():
+            return EngineCoreOutputs()
+        scheduler_output = self.scheduler.schedule()
+        runner_output = self.executor.execute_model(scheduler_output)
+        return self.scheduler.update_from_output(scheduler_output, runner_output)
+
+    def reset_prefix_cache(self) -> bool:
+        return self.scheduler.kv_cache_manager.reset_prefix_cache()
+
+    def shutdown(self) -> None:
+        self.executor.shutdown()

@@ -1,0 +1,63 @@
+"""Executor: engine-core -> worker dispatch.
+
+Reference analog: ``vllm/v1/executor/`` (abstract.py:37). On TPU the
+uniproc executor is the primary path — one jax client drives every local
+chip via GSPMD, so the reference's process-per-GPU MultiprocExecutor
+topology collapses; a multi-host executor (one engine, N hosts) arrives
+with the distributed runtime.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+from vllm_tpu.config import EngineConfig
+from vllm_tpu.core.sched_output import ModelRunnerOutput, SchedulerOutput
+from vllm_tpu.worker.worker import Worker
+
+
+class Executor:
+    @staticmethod
+    def get_class(config: EngineConfig) -> type["Executor"]:
+        backend = config.parallel_config.distributed_executor_backend
+        if backend == "uniproc":
+            return UniProcExecutor
+        raise NotImplementedError(f"executor backend {backend}")
+
+    def __init__(self, config: EngineConfig) -> None:
+        self.config = config
+
+    def initialize(self) -> int:
+        raise NotImplementedError
+
+    def execute_model(self, scheduler_output: SchedulerOutput) -> ModelRunnerOutput:
+        raise NotImplementedError
+
+    def collective_rpc(self, method: str, *args: Any, **kwargs: Any) -> list[Any]:
+        raise NotImplementedError
+
+    def shutdown(self) -> None:
+        pass
+
+
+class UniProcExecutor(Executor):
+    def __init__(self, config: EngineConfig) -> None:
+        super().__init__(config)
+        mesh = None
+        if config.parallel_config.world_size > 1:
+            from vllm_tpu.parallel.mesh import build_mesh
+
+            mesh = build_mesh(config.parallel_config)
+        self.worker = Worker(config, mesh=mesh)
+
+    def initialize(self) -> int:
+        num_blocks = self.worker.initialize()
+        self.worker.compile_or_warm_up_model()
+        return num_blocks
+
+    def execute_model(self, scheduler_output: SchedulerOutput) -> ModelRunnerOutput:
+        return self.worker.execute_model(scheduler_output)
+
+    def collective_rpc(self, method: str, *args: Any, **kwargs: Any) -> list[Any]:
+        fn: Callable = getattr(self.worker, method)
+        return [fn(*args, **kwargs)]

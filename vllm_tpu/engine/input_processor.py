@@ -1,0 +1,114 @@
+"""Prompt -> EngineCoreRequest: tokenization + validation.
+
+Reference analog: ``vllm/v1/engine/input_processor.py:234 process_inputs``.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Union
+
+from vllm_tpu.config import EngineConfig
+from vllm_tpu.logger import init_logger
+from vllm_tpu.request import EngineCoreRequest
+from vllm_tpu.sampling_params import SamplingParams
+
+logger = init_logger(__name__)
+
+# A prompt is a string, a dict {"prompt_token_ids": [...]}, or a dict
+# {"prompt": "..."} (reference: TextPrompt/TokensPrompt).
+PromptType = Union[str, dict]
+
+
+def get_tokenizer(model_config) -> Any:
+    from transformers import AutoTokenizer
+
+    return AutoTokenizer.from_pretrained(
+        model_config.tokenizer,
+        revision=model_config.revision,
+        trust_remote_code=model_config.trust_remote_code,
+    )
+
+
+class InputProcessor:
+    def __init__(self, config: EngineConfig, tokenizer: Any | None = None) -> None:
+        self.config = config
+        self._tokenizer = tokenizer
+        self._tokenizer_loaded = tokenizer is not None
+
+    @property
+    def tokenizer(self) -> Any | None:
+        if not self._tokenizer_loaded:
+            self._tokenizer_loaded = True
+            try:
+                self._tokenizer = get_tokenizer(self.config.model_config)
+            except Exception as e:  # tokenizer-less checkpoints (tests)
+                logger.warning(
+                    "no usable tokenizer for %s (%s: %s); only token-id "
+                    "prompts will be accepted",
+                    self.config.model_config.tokenizer,
+                    type(e).__name__,
+                    e,
+                )
+                self._tokenizer = None
+        return self._tokenizer
+
+    def process(
+        self,
+        request_id: str,
+        prompt: PromptType,
+        params: SamplingParams,
+        arrival_time: float | None = None,
+        priority: int = 0,
+    ) -> EngineCoreRequest:
+        if isinstance(prompt, str):
+            prompt_text: str | None = prompt
+            tokenizer = self.tokenizer
+            if tokenizer is None:
+                raise ValueError("no tokenizer; pass prompt_token_ids")
+            prompt_token_ids = tokenizer.encode(prompt)
+        elif isinstance(prompt, dict):
+            if "prompt_token_ids" in prompt:
+                prompt_token_ids = list(prompt["prompt_token_ids"])
+                prompt_text = prompt.get("prompt")
+            elif "prompt" in prompt:
+                return self.process(
+                    request_id, prompt["prompt"], params, arrival_time, priority
+                )
+            else:
+                raise ValueError(f"invalid prompt dict keys: {list(prompt)}")
+        else:
+            raise TypeError(f"invalid prompt type {type(prompt)}")
+
+        max_len = self.config.scheduler_config.max_model_len
+        if not prompt_token_ids:
+            raise ValueError("empty prompt")
+        if len(prompt_token_ids) >= max_len:
+            raise ValueError(
+                f"prompt ({len(prompt_token_ids)} tokens) is longer than "
+                f"max_model_len-1 ({max_len - 1})"
+            )
+
+        params = self._finalize_params(params, len(prompt_token_ids))
+        eos_token_id = None
+        if self.tokenizer is not None:
+            eos_token_id = self.tokenizer.eos_token_id
+
+        req = EngineCoreRequest(
+            request_id=request_id,
+            prompt_token_ids=prompt_token_ids,
+            sampling_params=params,
+            arrival_time=arrival_time if arrival_time is not None else time.monotonic(),
+            eos_token_id=eos_token_id,
+            priority=priority,
+        )
+        req.prompt_text = prompt_text  # carried for outputs
+        return req
+
+    def _finalize_params(self, params: SamplingParams, prompt_len: int) -> SamplingParams:
+        from dataclasses import replace
+
+        max_len = self.config.scheduler_config.max_model_len
+        cap = max_len - prompt_len
+        max_tokens = params.max_tokens if params.max_tokens is not None else cap
+        return replace(params, max_tokens=min(max_tokens, cap))

@@ -1,0 +1,74 @@
+"""Synchronous engine for offline batch inference.
+
+Reference analog: ``vllm/v1/engine/llm_engine.py`` (step :287).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+from vllm_tpu.config import EngineConfig
+from vllm_tpu.engine.engine_core import EngineCore
+from vllm_tpu.engine.input_processor import InputProcessor, PromptType
+from vllm_tpu.engine.output_processor import OutputProcessor
+from vllm_tpu.logger import init_logger
+from vllm_tpu.outputs import RequestOutput
+from vllm_tpu.sampling_params import SamplingParams
+
+logger = init_logger(__name__)
+
+
+class LLMEngine:
+    def __init__(self, config: EngineConfig) -> None:
+        self.config = config
+        self.engine_core = EngineCore(config)
+        self.input_processor = InputProcessor(config)
+        self.output_processor = OutputProcessor(self.input_processor.tokenizer)
+
+    @classmethod
+    def from_engine_args(cls, engine_args: Any) -> "LLMEngine":
+        return cls(engine_args.create_engine_config())
+
+    @property
+    def tokenizer(self):
+        return self.input_processor.tokenizer
+
+    # ------------------------------------------------------------------
+
+    def add_request(
+        self,
+        request_id: str,
+        prompt: PromptType,
+        params: SamplingParams | None = None,
+        priority: int = 0,
+    ) -> None:
+        params = params if params is not None else SamplingParams()
+        core_req = self.input_processor.process(request_id, prompt, params, priority=priority)
+        self.output_processor.add_request(
+            request_id,
+            getattr(core_req, "prompt_text", None),
+            core_req.prompt_token_ids,
+            core_req.sampling_params,
+            core_req.arrival_time,
+        )
+        self.engine_core.add_request(core_req)
+
+    def abort_request(self, request_ids: list[str]) -> None:
+        self.engine_core.abort_requests(request_ids)
+        self.output_processor.abort_requests(request_ids)
+
+    def step(self) -> list[RequestOutput]:
+        outputs = self.engine_core.step()
+        processed = self.output_processor.process_outputs(outputs.outputs)
+        if processed.reqs_to_abort:
+            self.engine_core.abort_requests(processed.reqs_to_abort)
+        return processed.request_outputs
+
+    def has_unfinished_requests(self) -> bool:
+        return (
+            self.engine_core.has_unfinished_requests()
+            or self.output_processor.get_num_unfinished_requests() > 0
+        )
+
+    def shutdown(self) -> None:
+        self.engine_core.shutdown()

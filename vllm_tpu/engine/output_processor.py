@@ -1,0 +1,178 @@
+"""Engine-core outputs -> user-facing RequestOutputs.
+
+Reference analog: ``vllm/v1/engine/output_processor.py:413`` — per-request
+frontend state (detokenizer, logprobs assembly, metrics), stop-string
+aborts flowing back to the engine core.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import Any
+
+from vllm_tpu.core.sched_output import EngineCoreOutput
+from vllm_tpu.engine.detokenizer import IncrementalDetokenizer
+from vllm_tpu.outputs import (
+    CompletionOutput,
+    Logprob,
+    RequestMetrics,
+    RequestOutput,
+)
+from vllm_tpu.sampling_params import RequestOutputKind, SamplingParams
+
+
+class RequestState:
+    def __init__(
+        self,
+        request_id: str,
+        prompt_text: str | None,
+        prompt_token_ids: list[int],
+        params: SamplingParams,
+        tokenizer: Any,
+        arrival_time: float,
+        queue: Any | None = None,
+    ) -> None:
+        self.request_id = request_id
+        self.prompt_text = prompt_text
+        self.prompt_token_ids = prompt_token_ids
+        self.params = params
+        self.detokenizer = IncrementalDetokenizer(
+            tokenizer if params.detokenize else None, prompt_token_ids, params
+        )
+        self.metrics = RequestMetrics(arrival_time=arrival_time)
+        self.logprobs: list[dict[int, Logprob]] = []
+        self.num_sent_chars = 0
+        self.queue = queue  # per-request asyncio queue (streaming mode)
+
+    def make_request_output(
+        self, new_token_ids: list[int], finish_reason: str | None, stop_reason
+    ) -> RequestOutput | None:
+        kind = self.params.output_kind
+        finished = finish_reason is not None
+        if kind == RequestOutputKind.FINAL_ONLY and not finished:
+            return None
+
+        delta = kind == RequestOutputKind.DELTA
+        text, self.num_sent_chars = self.detokenizer.get_next_output_text(
+            finished, delta, self.num_sent_chars
+        )
+        if delta:
+            token_ids = new_token_ids
+            logprobs = self.logprobs[-len(new_token_ids) :] if self.params.logprobs else None
+        else:
+            token_ids = self.detokenizer.output_token_ids
+            logprobs = self.logprobs if self.params.logprobs else None
+
+        completion = CompletionOutput(
+            index=0,
+            text=text,
+            token_ids=token_ids,
+            logprobs=logprobs,
+            finish_reason=finish_reason,
+            stop_reason=stop_reason,
+        )
+        return RequestOutput(
+            request_id=self.request_id,
+            prompt=self.prompt_text,
+            prompt_token_ids=self.prompt_token_ids,
+            outputs=[completion],
+            finished=finished,
+            metrics=self.metrics,
+        )
+
+
+@dataclass
+class ProcessedOutputs:
+    request_outputs: list[RequestOutput] = field(default_factory=list)
+    reqs_to_abort: list[str] = field(default_factory=list)
+
+
+class OutputProcessor:
+    def __init__(self, tokenizer: Any | None = None) -> None:
+        self.tokenizer = tokenizer
+        self.request_states: dict[str, RequestState] = {}
+
+    def add_request(
+        self,
+        request_id: str,
+        prompt_text: str | None,
+        prompt_token_ids: list[int],
+        params: SamplingParams,
+        arrival_time: float,
+        queue: Any | None = None,
+    ) -> RequestState:
+        state = RequestState(
+            request_id,
+            prompt_text,
+            prompt_token_ids,
+            params,
+            self.tokenizer,
+            arrival_time,
+            queue,
+        )
+        self.request_states[request_id] = state
+        return state
+
+    def abort_requests(self, request_ids) -> None:
+        for rid in request_ids:
+            self.request_states.pop(rid, None)
+
+    def get_num_unfinished_requests(self) -> int:
+        return len(self.request_states)
+
+    def process_outputs(
+        self,
+        engine_core_outputs: list[EngineCoreOutput],
+        logprobs_lists=None,
+    ) -> ProcessedOutputs:
+        result = ProcessedOutputs()
+        now = time.monotonic()
+        for eco in engine_core_outputs:
+            state = self.request_states.get(eco.req_id)
+            if state is None:
+                continue  # aborted earlier
+
+            if state.metrics.first_token_time is None and eco.new_token_ids:
+                state.metrics.first_token_time = now
+
+            stop_str = state.detokenizer.update(eco.new_token_ids)
+            finish_reason = eco.finish_reason
+            stop_reason = eco.stop_reason
+            if stop_str is not None and finish_reason is None:
+                # Stop string hit client-side: finish here, abort engine-side.
+                finish_reason = "stop"
+                stop_reason = stop_str
+                result.reqs_to_abort.append(eco.req_id)
+
+            if eco.new_logprobs is not None:
+                self._append_logprobs(state, eco)
+
+            out = state.make_request_output(
+                eco.new_token_ids, finish_reason, stop_reason
+            )
+            if out is not None:
+                if state.queue is not None:
+                    state.queue.put_nowait(out)
+                else:
+                    result.request_outputs.append(out)
+
+            if finish_reason is not None:
+                state.metrics.finished_time = now
+                del self.request_states[eco.req_id]
+        return result
+
+    def _append_logprobs(self, state: RequestState, eco: EngineCoreOutput) -> None:
+        """eco.new_logprobs: one (topk_ids, topk_vals, sampled_token_id,
+        sampled_lp, sampled_rank) tuple per new token."""
+        for entry in eco.new_logprobs:
+            topk_ids, topk_vals, sampled_tok, sampled_lp, sampled_rank = entry
+            d: dict[int, Logprob] = {}
+            k = state.params.logprobs or 0
+            for rank, (tid, lp) in enumerate(zip(topk_ids[:k], topk_vals[:k])):
+                d[int(tid)] = Logprob(logprob=float(lp), rank=rank + 1)
+            if sampled_tok not in d:
+                d[int(sampled_tok)] = Logprob(
+                    logprob=float(sampled_lp), rank=int(sampled_rank) + 1
+                )
+            state.logprobs.append(d)

@@ -1,0 +1,104 @@
+"""The offline `LLM` API.
+
+Reference analog: ``vllm/entrypoints/llm.py:106`` (generate :446, chat,
+_run_engine :1839).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Sequence, Union
+
+from vllm_tpu.engine.arg_utils import EngineArgs
+from vllm_tpu.engine.input_processor import PromptType
+from vllm_tpu.engine.llm_engine import LLMEngine
+from vllm_tpu.logger import init_logger
+from vllm_tpu.outputs import RequestOutput
+from vllm_tpu.sampling_params import SamplingParams
+
+logger = init_logger(__name__)
+
+
+class LLM:
+    def __init__(self, model: str, **kwargs: Any) -> None:
+        engine_args = EngineArgs(model=model, **kwargs)
+        self.llm_engine = LLMEngine.from_engine_args(engine_args)
+        self._request_counter = 0
+
+    def get_tokenizer(self):
+        return self.llm_engine.tokenizer
+
+    # ------------------------------------------------------------------
+
+    def generate(
+        self,
+        prompts: Union[PromptType, Sequence[PromptType]],
+        sampling_params: Union[SamplingParams, Sequence[SamplingParams], None] = None,
+        use_tqdm: bool = False,
+    ) -> list[RequestOutput]:
+        if isinstance(prompts, (str, dict)):
+            prompts = [prompts]
+        n = len(prompts)
+        if sampling_params is None:
+            sampling_params = SamplingParams()
+        if isinstance(sampling_params, SamplingParams):
+            params_list = [sampling_params] * n
+        else:
+            if len(sampling_params) != n:
+                raise ValueError("len(sampling_params) != len(prompts)")
+            params_list = list(sampling_params)
+
+        request_ids = []
+        for prompt, params in zip(prompts, params_list):
+            rid = str(self._request_counter)
+            self._request_counter += 1
+            request_ids.append(rid)
+            self.llm_engine.add_request(rid, prompt, params)
+        return self._run_engine(request_ids, use_tqdm)
+
+    def chat(
+        self,
+        messages: list[dict] | list[list[dict]],
+        sampling_params: SamplingParams | None = None,
+        chat_template: str | None = None,
+        add_generation_prompt: bool = True,
+    ) -> list[RequestOutput]:
+        """Apply the tokenizer chat template, then generate."""
+        tokenizer = self.get_tokenizer()
+        if tokenizer is None:
+            raise ValueError("chat() requires a tokenizer")
+        if messages and isinstance(messages[0], dict):
+            messages = [messages]  # type: ignore[list-item]
+        prompts = [
+            {
+                "prompt_token_ids": tokenizer.apply_chat_template(
+                    conv,
+                    chat_template=chat_template,
+                    add_generation_prompt=add_generation_prompt,
+                )
+            }
+            for conv in messages
+        ]
+        return self.generate(prompts, sampling_params)
+
+    # ------------------------------------------------------------------
+
+    def _run_engine(self, request_ids: list[str], use_tqdm: bool) -> list[RequestOutput]:
+        finished: dict[str, RequestOutput] = {}
+        t0 = time.monotonic()
+        n_tokens = 0
+        while self.llm_engine.has_unfinished_requests():
+            for out in self.llm_engine.step():
+                if out.finished:
+                    finished[out.request_id] = out
+                    n_tokens += len(out.outputs[0].token_ids)
+        dt = time.monotonic() - t0
+        if dt > 0 and n_tokens:
+            logger.info(
+                "generated %d tokens for %d requests in %.2fs (%.1f tok/s)",
+                n_tokens, len(finished), dt, n_tokens / dt,
+            )
+        return [finished[rid] for rid in request_ids if rid in finished]
+
+    def shutdown(self) -> None:
+        self.llm_engine.shutdown()

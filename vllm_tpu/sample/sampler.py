@@ -1,0 +1,130 @@
+"""In-jit sampler over padded request rows.
+
+Reference analog: ``vllm/v1/sample/sampler.py`` (pipeline order documented
+:22-60) + the CUDA sampling kernels in ``csrc/sampler.cu`` — here the whole
+pipeline is one traced function; XLA fuses it behind the logits matmul.
+
+Pipeline: penalties -> logit bias/allowed tokens (grammar bitmask enters the
+same way) -> temperature -> top-k -> top-p -> min-p -> Gumbel-max sample,
+with greedy rows (temperature 0) taking argmax. Gumbel-max avoids a full
+cumulative-sort sample: sampling = argmax(logits/T + Gumbel noise) after the
+top-k/top-p mask, which is exactly categorical sampling over the masked
+distribution (the Model-Runner-V2 trick, ``docs/design/model_runner_v2.md``).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+
+_NEG_INF = jnp.float32(-1e30)
+
+
+@jax.tree_util.register_dataclass
+@dataclass
+class SamplingMetadata:
+    """Per-request-row sampling state, padded to the request bucket [R]."""
+
+    temperature: jnp.ndarray  # [R] f32; 0 => greedy
+    top_k: jnp.ndarray  # [R] i32; 0 => disabled
+    top_p: jnp.ndarray  # [R] f32; 1 => disabled
+    min_p: jnp.ndarray  # [R] f32; 0 => disabled
+    # Penalties ([R] f32); neutral values 0/0/1 disable.
+    presence_penalty: jnp.ndarray
+    frequency_penalty: jnp.ndarray
+    repetition_penalty: jnp.ndarray
+    # Per-row PRNG keys [R, 2] u32 (seeded requests get stable streams).
+    prng_keys: jnp.ndarray
+    # [R, V] i32 output-token counts; empty placeholder when no penalties
+    # are active in the batch (static `needs_penalties` selects the trace).
+    output_token_counts: jnp.ndarray
+    prompt_token_mask: jnp.ndarray  # [R, V] bool, or empty placeholder
+
+
+def apply_penalties(logits: jnp.ndarray, md: SamplingMetadata) -> jnp.ndarray:
+    """Repetition / presence / frequency penalties (HF/OpenAI semantics,
+    reference: ``vllm/v1/sample/ops/penalties.py``)."""
+    counts = md.output_token_counts.astype(jnp.float32)  # [R, V]
+    seen_out = counts > 0
+    seen_any = seen_out | md.prompt_token_mask
+    rep = md.repetition_penalty[:, None]
+    logits = jnp.where(
+        seen_any & (logits > 0), logits / rep, jnp.where(seen_any, logits * rep, logits)
+    )
+    logits = logits - md.frequency_penalty[:, None] * counts
+    logits = logits - md.presence_penalty[:, None] * seen_out.astype(jnp.float32)
+    return logits
+
+
+def _mask_top_k(logits: jnp.ndarray, top_k: jnp.ndarray) -> jnp.ndarray:
+    v = logits.shape[-1]
+    # Per-row threshold: value of the k-th largest logit. Full sort once,
+    # gather per-row kth value (top_k is per-request).
+    sorted_desc = jnp.sort(logits, axis=-1)[:, ::-1]  # [R, V]
+    k = jnp.where(top_k > 0, top_k, v).astype(jnp.int32)
+    kth = jnp.take_along_axis(sorted_desc, (k - 1)[:, None], axis=-1)  # [R, 1]
+    return jnp.where(logits < kth, _NEG_INF, logits)
+
+
+def _mask_top_p_min_p(
+    logits: jnp.ndarray, top_p: jnp.ndarray, min_p: jnp.ndarray
+) -> jnp.ndarray:
+    probs = jax.nn.softmax(logits, axis=-1)
+    sorted_probs = jnp.sort(probs, axis=-1)[:, ::-1]
+    cumsum = jnp.cumsum(sorted_probs, axis=-1)
+    # Smallest prefix with cumulative mass >= top_p stays; find per-row
+    # probability threshold.
+    keep_sorted = cumsum - sorted_probs < top_p[:, None]
+    # Threshold = min prob among kept sorted entries.
+    thresh_p = jnp.min(jnp.where(keep_sorted, sorted_probs, 2.0), axis=-1)  # [R]
+    keep = probs >= thresh_p[:, None]
+    # min-p: drop tokens below min_p * max_prob.
+    max_p = jnp.max(probs, axis=-1)
+    keep &= probs >= (min_p * max_p)[:, None]
+    return jnp.where(keep, logits, _NEG_INF)
+
+
+def sample(
+    logits: jnp.ndarray,  # [R, V] f32
+    md: SamplingMetadata,
+    *,
+    needs_penalties: bool = False,
+    needs_top_k: bool = True,
+    needs_top_p_min_p: bool = True,
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Returns (sampled [R] i32, logprobs [R, V] f32 log-softmax of the
+    pre-masking distribution — what logprob reporting uses).
+
+    The ``needs_*`` flags are static: an all-greedy or vanilla-temperature
+    batch skips the [R, V] sorts entirely (separate jit trace per combo).
+    """
+    raw_logprobs = jax.nn.log_softmax(logits, axis=-1)
+
+    if needs_penalties:
+        logits = apply_penalties(logits, md)
+
+    greedy = md.temperature == 0.0
+    temp = jnp.where(greedy, 1.0, md.temperature)
+    scaled = logits / temp[:, None]
+    if needs_top_k:
+        scaled = _mask_top_k(scaled, md.top_k)
+    if needs_top_p_min_p:
+        scaled = _mask_top_p_min_p(scaled, md.top_p, md.min_p)
+
+    noise = _per_row_gumbel(md.prng_keys, logits.shape[-1])
+    random_pick = jnp.argmax(scaled + noise, axis=-1).astype(jnp.int32)
+    greedy_pick = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    sampled = jnp.where(greedy, greedy_pick, random_pick)
+    return sampled, raw_logprobs
+
+
+def _per_row_gumbel(prng_keys: jnp.ndarray, vocab: int) -> jnp.ndarray:
+    def one(key_pair):
+        key = jax.random.PRNGKey(0)
+        key = jax.random.fold_in(key, key_pair[0])
+        key = jax.random.fold_in(key, key_pair[1])
+        return jax.random.gumbel(key, (vocab,), jnp.float32)
+
+    return jax.vmap(one)(prng_keys)

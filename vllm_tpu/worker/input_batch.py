@@ -1,0 +1,181 @@
+"""Persistent host-side batch state, fixed row per request.
+
+Reference analog: ``vllm/v1/worker/gpu_input_batch.py`` with the Model
+Runner V2 refinement (``docs/design/model_runner_v2.md``): each request owns
+a stable dense row; removal swap-condenses from the tail so per-step input
+assembly is contiguous numpy slicing (the host has ONE core on TPU VMs —
+everything here is vectorized, no per-token Python).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from vllm_tpu.core.sched_output import NewRequestData
+from vllm_tpu.sampling_params import SamplingParams
+
+
+class CachedRequestState:
+    __slots__ = (
+        "req_id",
+        "sampling_params",
+        "num_computed_tokens",
+        "num_tokens",
+        "generated",
+        "in_batch_row",
+    )
+
+    def __init__(self, req_id: str, sampling_params: SamplingParams) -> None:
+        self.req_id = req_id
+        self.sampling_params = sampling_params
+        self.num_computed_tokens = 0
+        self.num_tokens = 0
+        self.generated = 0  # sampled so far (drives seeded PRNG streams)
+        self.in_batch_row = -1
+
+
+class InputBatch:
+    def __init__(
+        self,
+        max_num_reqs: int,
+        max_model_len: int,
+        max_blocks_per_req: int,
+    ) -> None:
+        self.max_num_reqs = max_num_reqs
+        self.max_model_len = max_model_len
+        self.max_blocks_per_req = max_blocks_per_req
+
+        self.num_reqs = 0
+        self.req_ids: list[str | None] = [None] * max_num_reqs
+        self.req_states: dict[str, CachedRequestState] = {}
+
+        n, m = max_num_reqs, max_model_len
+        self.token_ids = np.zeros((n, m), dtype=np.int32)
+        self.num_tokens = np.zeros(n, dtype=np.int32)
+        self.num_computed_tokens = np.zeros(n, dtype=np.int32)
+        self.block_table = np.zeros((n, max_blocks_per_req), dtype=np.int32)
+        self.num_blocks = np.zeros(n, dtype=np.int32)
+
+        # Sampling columns.
+        self.temperature = np.zeros(n, dtype=np.float32)
+        self.top_k = np.zeros(n, dtype=np.int32)
+        self.top_p = np.ones(n, dtype=np.float32)
+        self.min_p = np.zeros(n, dtype=np.float32)
+        self.presence_penalty = np.zeros(n, dtype=np.float32)
+        self.frequency_penalty = np.zeros(n, dtype=np.float32)
+        self.repetition_penalty = np.ones(n, dtype=np.float32)
+        self.seeds = np.zeros(n, dtype=np.uint32)
+        self.num_logprobs = np.zeros(n, dtype=np.int32)  # 0 => off
+
+    # ------------------------------------------------------------------
+
+    def add_request(self, data: NewRequestData) -> int:
+        row = self.num_reqs
+        assert row < self.max_num_reqs
+        self.num_reqs += 1
+        req_id = data.req_id
+        self.req_ids[row] = req_id
+
+        state = CachedRequestState(req_id, data.sampling_params)
+        state.in_batch_row = row
+        state.num_computed_tokens = data.num_computed_tokens
+        state.num_tokens = len(data.prompt_token_ids)
+        self.req_states[req_id] = state
+
+        n_tok = len(data.prompt_token_ids)
+        self.token_ids[row, :n_tok] = data.prompt_token_ids
+        self.num_tokens[row] = n_tok
+        self.num_computed_tokens[row] = data.num_computed_tokens
+        nb = len(data.block_ids)
+        self.block_table[row, :nb] = data.block_ids
+        self.num_blocks[row] = nb
+
+        p = data.sampling_params
+        self.temperature[row] = p.temperature
+        self.top_k[row] = p.top_k
+        self.top_p[row] = p.top_p
+        self.min_p[row] = p.min_p
+        self.presence_penalty[row] = p.presence_penalty
+        self.frequency_penalty[row] = p.frequency_penalty
+        self.repetition_penalty[row] = p.repetition_penalty
+        seed = p.seed if p.seed is not None else (0xC0FFEE ^ hash(req_id))
+        self.seeds[row] = np.uint32(seed & 0xFFFFFFFF)
+        self.num_logprobs[row] = p.logprobs or 0
+        return row
+
+    def remove_request(self, req_id: str) -> None:
+        state = self.req_states.pop(req_id, None)
+        if state is None:
+            return
+        row = state.in_batch_row
+        last = self.num_reqs - 1
+        if row != last:
+            # Swap-condense: move the tail row into the vacated slot.
+            moved_id = self.req_ids[last]
+            assert moved_id is not None
+            for col in (
+                self.token_ids,
+                self.block_table,
+            ):
+                col[row] = col[last]
+            for vec in (
+                self.num_tokens,
+                self.num_computed_tokens,
+                self.num_blocks,
+                self.temperature,
+                self.top_k,
+                self.top_p,
+                self.min_p,
+                self.presence_penalty,
+                self.frequency_penalty,
+                self.repetition_penalty,
+                self.seeds,
+                self.num_logprobs,
+            ):
+                vec[row] = vec[last]
+            self.req_ids[row] = moved_id
+            self.req_states[moved_id].in_batch_row = row
+        self.req_ids[last] = None
+        self.num_reqs -= 1
+
+    # ------------------------------------------------------------------
+    # Per-step updates (CachedRequestData application)
+    # ------------------------------------------------------------------
+
+    def append_block_ids(self, req_id: str, new_block_ids: list[int]) -> None:
+        row = self.req_states[req_id].in_batch_row
+        nb = self.num_blocks[row]
+        self.block_table[row, nb : nb + len(new_block_ids)] = new_block_ids
+        self.num_blocks[row] = nb + len(new_block_ids)
+
+    def reset_for_resume(
+        self, req_id: str, token_ids: list[int], block_ids: list[int], num_computed: int
+    ) -> None:
+        """Preemption-resume: block table and computed count restart."""
+        state = self.req_states[req_id]
+        row = state.in_batch_row
+        self.token_ids[row, : len(token_ids)] = token_ids
+        self.num_tokens[row] = len(token_ids)
+        state.num_tokens = len(token_ids)
+        self.block_table[row, : len(block_ids)] = block_ids
+        self.num_blocks[row] = len(block_ids)
+        self.num_computed_tokens[row] = num_computed
+        state.num_computed_tokens = num_computed
+
+    def set_num_computed(self, req_id: str, num_computed: int) -> None:
+        state = self.req_states[req_id]
+        self.num_computed_tokens[state.in_batch_row] = num_computed
+        state.num_computed_tokens = num_computed
+
+    def append_token(self, req_id: str, token_id: int) -> None:
+        state = self.req_states[req_id]
+        row = state.in_batch_row
+        n = self.num_tokens[row]
+        if n < self.max_model_len:
+            self.token_ids[row, n] = token_id
+        self.num_tokens[row] = n + 1
+        state.num_tokens = int(n) + 1
+        state.generated += 1
+
+    def row_of(self, req_id: str) -> int:
+        return self.req_states[req_id].in_batch_row

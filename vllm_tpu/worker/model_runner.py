@@ -1,0 +1,387 @@
+"""TPU model runner: persistent-jit step over bucketed ragged batches.
+
+Reference analog: ``vllm/v1/worker/gpu_model_runner.py`` (7.1k LoC of CUDA
+graph + torch.compile machinery). The TPU design collapses most of it
+(SURVEY.md §7): ONE jitted step function per (tokens, reqs, blocks) bucket
+replaces CUDA-graph capture/dispatch; XLA recompiles per bucket and caches.
+Host work per step is pure vectorized numpy (single host core).
+
+Step dataflow:
+  host: scheduler output -> persistent InputBatch diff -> flat padded arrays
+  device (jit): embed -> L x (norm/qkv/rope/KV-insert/paged-attn/mlp)
+                -> gather last-token hidden -> logits -> sample
+  host: fetch sampled ids -> ModelRunnerOutput
+"""
+
+from __future__ import annotations
+
+import bisect
+from dataclasses import dataclass
+from functools import partial
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from vllm_tpu.config import EngineConfig
+from vllm_tpu.core.sched_output import ModelRunnerOutput, SchedulerOutput
+from vllm_tpu.logger import init_logger
+from vllm_tpu.ops.attention import AttentionMetadata
+from vllm_tpu.sample.sampler import SamplingMetadata, sample
+from vllm_tpu.worker.input_batch import InputBatch
+
+logger = init_logger(__name__)
+
+
+@jax.tree_util.register_dataclass
+@dataclass
+class StepInputs:
+    token_ids: jnp.ndarray  # [T] i32
+    md: AttentionMetadata
+    sampling: SamplingMetadata
+
+
+def _bucket(value: int, buckets: list[int]) -> int:
+    i = bisect.bisect_left(buckets, value)
+    if i == len(buckets):
+        raise ValueError(f"{value} exceeds largest bucket {buckets[-1]}")
+    return buckets[i]
+
+
+class ModelRunner:
+    def __init__(
+        self,
+        config: EngineConfig,
+        model: Any,
+        params: Any,
+        num_kv_blocks: int,
+        mesh: Any | None = None,
+    ) -> None:
+        self.config = config
+        self.model = model
+        self.params = params
+        self.mesh = mesh
+        sched = config.scheduler_config
+        cache = config.cache_config
+        self.block_size = cache.block_size
+
+        self.max_blocks_per_req = -(-sched.max_model_len // cache.block_size)
+        self.input_batch = InputBatch(
+            max_num_reqs=sched.max_num_seqs,
+            max_model_len=sched.max_model_len,
+            max_blocks_per_req=self.max_blocks_per_req,
+        )
+
+        comp = config.compilation_config
+        self.token_buckets = comp.token_buckets
+        self.request_buckets = comp.request_buckets
+        self.block_buckets = comp._pow2_buckets(
+            min(16, self.max_blocks_per_req), self.max_blocks_per_req
+        )
+
+        kv_shape = (
+            model.num_layers,
+            num_kv_blocks,
+            cache.block_size,
+            2 * model.num_kv_heads,
+            model.head_dim,
+        )
+        kv_dtype = (
+            model.dtype if cache.cache_dtype == "auto" else jnp.dtype(cache.cache_dtype)
+        )
+        kv_sharding = None
+        if mesh is not None:
+            from jax.sharding import NamedSharding
+
+            kv_sharding = NamedSharding(mesh, model.kv_cache_sharding())
+        self.kv_cache = (
+            jnp.zeros(kv_shape, kv_dtype)
+            if kv_sharding is None
+            else jax.device_put(jnp.zeros(kv_shape, kv_dtype), kv_sharding)
+        )
+        logger.info(
+            "KV cache allocated: %s %s (%.2f GiB)",
+            kv_shape,
+            kv_dtype,
+            np.prod(kv_shape) * jnp.dtype(kv_dtype).itemsize / 2**30,
+        )
+
+        # kv_cache is arg 1 and is donated back as output 0 (in-place reuse).
+        self._step_fn = jax.jit(
+            self._step,
+            static_argnames=(
+                "needs_penalties",
+                "needs_top_k",
+                "needs_top_p_min_p",
+                "num_logprobs",
+            ),
+            donate_argnums=(1,),
+        )
+
+    # ------------------------------------------------------------------
+    # Jitted step
+    # ------------------------------------------------------------------
+
+    def _step(
+        self,
+        params,
+        kv_cache,
+        inputs: StepInputs,
+        *,
+        needs_penalties: bool,
+        needs_top_k: bool,
+        needs_top_p_min_p: bool,
+        num_logprobs: int,
+    ):
+        hidden, kv_cache = self.model.apply(
+            params, kv_cache, inputs.token_ids, inputs.md
+        )
+        last = hidden[inputs.md.logits_indices]  # [R, D]
+        logits = self.model.compute_logits(params, last)  # [R, V] f32
+        sampled, raw_logprobs = sample(
+            logits,
+            inputs.sampling,
+            needs_penalties=needs_penalties,
+            needs_top_k=needs_top_k,
+            needs_top_p_min_p=needs_top_p_min_p,
+        )
+        if num_logprobs > 0:
+            topk_vals, topk_ids = jax.lax.top_k(raw_logprobs, num_logprobs)
+            sampled_lp = jnp.take_along_axis(
+                raw_logprobs, sampled[:, None], axis=-1
+            )[:, 0]
+            sampled_rank = jnp.sum(
+                raw_logprobs > sampled_lp[:, None], axis=-1
+            ).astype(jnp.int32)
+            lp = (topk_vals, topk_ids, sampled_lp, sampled_rank)
+        else:
+            lp = None
+        return kv_cache, sampled, lp
+
+    # ------------------------------------------------------------------
+    # Host side
+    # ------------------------------------------------------------------
+
+    def _update_states(self, so: SchedulerOutput) -> None:
+        for req_id in so.finished_req_ids:
+            self.input_batch.remove_request(req_id)
+        cached = so.scheduled_cached_reqs
+        for i, req_id in enumerate(cached.req_ids):
+            if cached.resumed_from_preemption[i]:
+                tokens = cached.resumed_req_token_ids[i]
+                assert tokens is not None
+                self.input_batch.reset_for_resume(
+                    req_id, tokens, cached.new_block_ids[i], cached.num_computed_tokens[i]
+                )
+            else:
+                if cached.new_block_ids[i]:
+                    self.input_batch.append_block_ids(req_id, cached.new_block_ids[i])
+                self.input_batch.set_num_computed(
+                    req_id, cached.num_computed_tokens[i]
+                )
+        for new in so.scheduled_new_reqs:
+            self.input_batch.add_request(new)
+
+    def _prepare_inputs(self, so: SchedulerOutput):
+        batch = self.input_batch
+        num_sched = so.num_scheduled_tokens
+        rows: list[int] = []
+        req_order: list[str] = []
+        for row in range(batch.num_reqs):
+            rid = batch.req_ids[row]
+            if rid in num_sched:
+                rows.append(row)
+                req_order.append(rid)  # type: ignore[arg-type]
+        r_live = len(rows)
+        t_live = so.total_num_scheduled_tokens
+
+        t_pad = _bucket(max(t_live, 1), self.token_buckets)
+        r_pad = _bucket(max(r_live, 1), self.request_buckets)
+        max_blocks = max(
+            (int(batch.num_blocks[row]) for row in rows), default=1
+        )
+        b_pad = _bucket(max(max_blocks, 1), self.block_buckets)
+
+        token_ids = np.zeros(t_pad, np.int32)
+        positions = np.zeros(t_pad, np.int32)
+        slot_mapping = np.zeros(t_pad, np.int32)
+        token_req_idx = np.full(t_pad, max(r_pad - 1, 0), np.int32)
+        seq_lens = np.zeros(r_pad, np.int32)
+        query_start_loc = np.zeros(r_pad + 1, np.int32)
+        logits_indices = np.zeros(r_pad, np.int32)
+        do_sample = np.zeros(r_pad, bool)
+        block_tables = np.zeros((r_pad, b_pad), np.int32)
+
+        bs = self.block_size
+        offset = 0
+        for i, row in enumerate(rows):
+            rid = req_order[i]
+            n = num_sched[rid]
+            start = int(batch.num_computed_tokens[row])
+            token_ids[offset : offset + n] = batch.token_ids[row, start : start + n]
+            pos = np.arange(start, start + n, dtype=np.int32)
+            positions[offset : offset + n] = pos
+            bt_row = batch.block_table[row]
+            slot_mapping[offset : offset + n] = bt_row[pos // bs] * bs + pos % bs
+            token_req_idx[offset : offset + n] = i
+            seq_lens[i] = start + n
+            query_start_loc[i + 1] = offset + n
+            logits_indices[i] = offset + n - 1
+            do_sample[i] = start + n >= int(batch.num_tokens[row])
+            nb = int(batch.num_blocks[row])
+            block_tables[i, :nb] = bt_row[:nb]
+            offset += n
+        query_start_loc[r_live + 1 :] = offset
+
+        md = AttentionMetadata(
+            positions=jnp.asarray(positions),
+            slot_mapping=jnp.asarray(slot_mapping),
+            block_tables=jnp.asarray(block_tables),
+            seq_lens=jnp.asarray(seq_lens),
+            query_start_loc=jnp.asarray(query_start_loc),
+            token_req_idx=jnp.asarray(token_req_idx),
+            logits_indices=jnp.asarray(logits_indices),
+        )
+
+        # Sampling metadata for the live rows.
+        idx = np.asarray(rows, np.int64)
+        def gather(col, pad_value=0):
+            out = np.full(r_pad, pad_value, col.dtype)
+            if r_live:
+                out[:r_live] = col[idx]
+            return out
+
+        temperature = gather(batch.temperature)
+        top_k = gather(batch.top_k)
+        top_p = gather(batch.top_p, 1.0)
+        min_p = gather(batch.min_p)
+        presence = gather(batch.presence_penalty)
+        frequency = gather(batch.frequency_penalty)
+        repetition = gather(batch.repetition_penalty, 1.0)
+        seeds = gather(batch.seeds)
+        gen_counts = np.zeros(r_pad, np.uint32)
+        for i, row in enumerate(rows):
+            gen_counts[i] = batch.req_states[req_order[i]].generated
+        prng_keys = np.stack([seeds, gen_counts], axis=1)
+
+        needs_penalties = bool(
+            np.any(presence[:r_live] != 0)
+            or np.any(frequency[:r_live] != 0)
+            or np.any(repetition[:r_live] != 1.0)
+        )
+        if needs_penalties:
+            counts, prompt_mask = self._penalty_tensors(rows, r_pad)
+        else:
+            counts = np.zeros((0, 0), np.int32)
+            prompt_mask = np.zeros((0, 0), bool)
+
+        sampling = SamplingMetadata(
+            temperature=jnp.asarray(temperature),
+            top_k=jnp.asarray(top_k),
+            top_p=jnp.asarray(top_p),
+            min_p=jnp.asarray(min_p),
+            presence_penalty=jnp.asarray(presence),
+            frequency_penalty=jnp.asarray(frequency),
+            repetition_penalty=jnp.asarray(repetition),
+            prng_keys=jnp.asarray(prng_keys),
+            output_token_counts=jnp.asarray(counts),
+            prompt_token_mask=jnp.asarray(prompt_mask),
+        )
+
+        flags = dict(
+            needs_penalties=needs_penalties,
+            needs_top_k=bool(np.any(top_k[:r_live] > 0)),
+            needs_top_p_min_p=bool(
+                np.any(top_p[:r_live] < 1.0) or np.any(min_p[:r_live] > 0)
+            ),
+            num_logprobs=int(np.max(gather(batch.num_logprobs)[:r_live], initial=0)),
+        )
+        inputs = StepInputs(
+            token_ids=jnp.asarray(token_ids), md=md, sampling=sampling
+        )
+        return inputs, req_order, do_sample[:r_live], flags
+
+    def _penalty_tensors(self, rows: list[int], r_pad: int):
+        """[R, V] output-token counts + prompt-token mask, built host-side
+        only for penalty-bearing batches (rare path)."""
+        batch = self.input_batch
+        v = self.model.vocab_size
+        counts = np.zeros((r_pad, v), np.int32)
+        prompt_mask = np.zeros((r_pad, v), bool)
+        for i, row in enumerate(rows):
+            state = batch.req_states[batch.req_ids[row]]
+            n_tok = int(batch.num_tokens[row])
+            n_prompt = n_tok - state.generated
+            prompt_mask[i, batch.token_ids[row, :n_prompt]] = True
+            out_ids = batch.token_ids[row, n_prompt:n_tok]
+            np.add.at(counts[i], out_ids, 1)
+        return counts, prompt_mask
+
+    # ------------------------------------------------------------------
+
+    def execute_model(self, so: SchedulerOutput) -> ModelRunnerOutput:
+        self._update_states(so)
+        if so.total_num_scheduled_tokens == 0:
+            return ModelRunnerOutput()
+        inputs, req_order, do_sample, flags = self._prepare_inputs(so)
+        self.kv_cache, sampled, lp = self._step_fn(
+            self.params, self.kv_cache, inputs, **flags
+        )
+        sampled_np = np.asarray(jax.device_get(sampled))
+
+        out = ModelRunnerOutput(req_ids=req_order)
+        lp_np = None
+        if lp is not None:
+            lp_np = [np.asarray(jax.device_get(x)) for x in lp]
+        for i, rid in enumerate(req_order):
+            if do_sample[i]:
+                tok = int(sampled_np[i])
+                self.input_batch.append_token(rid, tok)
+                out.sampled_token_ids.append([tok])
+            else:
+                out.sampled_token_ids.append([])
+        if lp_np is not None:
+            from vllm_tpu.core.sched_output import LogprobsLists
+
+            topk_vals, topk_ids, sampled_lp, sampled_rank = lp_np
+            out.logprobs = LogprobsLists(
+                logprob_token_ids=topk_ids[: len(req_order)].tolist(),
+                logprobs=topk_vals[: len(req_order)].tolist(),
+                sampled_token_ranks=sampled_rank[: len(req_order)].tolist(),
+                sampled_logprobs=sampled_lp[: len(req_order)].tolist(),
+            )
+        return out
+
+    # ------------------------------------------------------------------
+
+    def profile_run(self) -> None:
+        """Compile + run the largest bucket (memory high-water mark).
+        Reference analog: ``gpu_model_runner.py profile_run :5846``."""
+        so = _dummy_scheduler_output(
+            min(
+                self.config.scheduler_config.max_num_batched_tokens,
+                self.config.scheduler_config.max_model_len,
+            )
+        )
+        self.execute_model(so)
+        self.input_batch.remove_request("__profile__")
+
+
+def _dummy_scheduler_output(num_tokens: int) -> SchedulerOutput:
+    from vllm_tpu.core.sched_output import NewRequestData
+    from vllm_tpu.sampling_params import SamplingParams
+
+    return SchedulerOutput(
+        scheduled_new_reqs=[
+            NewRequestData(
+                req_id="__profile__",
+                prompt_token_ids=[1] * num_tokens,
+                sampling_params=SamplingParams(max_tokens=1),
+                block_ids=[0],
+                num_computed_tokens=0,
+            )
+        ],
+        num_scheduled_tokens={"__profile__": num_tokens},
+        total_num_scheduled_tokens=num_tokens,
+    )

@@ -1,0 +1,148 @@
+"""Per-host worker: device init, model load, KV sizing, runner ownership.
+
+Reference analog: ``vllm/v1/worker/gpu_worker.py`` (init_device :237,
+load_model :336, determine_available_memory :352). On TPU one worker drives
+all local chips through a single jax client + GSPMD mesh, so there is no
+per-device process fanout on a host (the reference needs one worker process
+per GPU).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from vllm_tpu.config import EngineConfig
+from vllm_tpu.core.kv_cache_utils import get_kv_cache_config_from_specs
+from vllm_tpu.core.sched_output import ModelRunnerOutput, SchedulerOutput
+from vllm_tpu.logger import init_logger
+from vllm_tpu.models.registry import get_model_class
+from vllm_tpu.worker.model_runner import ModelRunner
+
+logger = init_logger(__name__)
+
+# Fraction of the post-weights free HBM held back for activations and XLA
+# temporaries when profiling data is unavailable.
+_ACTIVATION_HEADROOM = 0.08
+
+
+def load_hf_config(model_config) -> Any:
+    if model_config.hf_config is not None:
+        return model_config.hf_config
+    from transformers import AutoConfig
+
+    cfg = AutoConfig.from_pretrained(
+        model_config.model,
+        revision=model_config.revision,
+        trust_remote_code=model_config.trust_remote_code,
+    )
+    if model_config.hf_overrides:
+        for k, v in model_config.hf_overrides.items():
+            setattr(cfg, k, v)
+    model_config.hf_config = cfg
+    return cfg
+
+
+class Worker:
+    def __init__(self, config: EngineConfig, mesh: Any | None = None) -> None:
+        self.config = config
+        self.mesh = mesh
+        self.model: Any = None
+        self.params: Any = None
+        self.runner: ModelRunner | None = None
+
+    # ------------------------------------------------------------------
+
+    def init_device(self) -> None:
+        dev_cfg = self.config.device_config.device
+        if dev_cfg != "auto":
+            jax.config.update("jax_default_device", jax.devices(dev_cfg)[0])
+        self.device = jax.devices()[0]
+        logger.info("worker device: %s (backend %s)", self.device, jax.default_backend())
+
+    def load_model(self) -> None:
+        mc = self.config.model_config
+        hf_config = load_hf_config(mc)
+        if mc.max_model_len is None:
+            mc.max_model_len = getattr(hf_config, "max_position_embeddings", 8192)
+        self.config.scheduler_config.max_model_len = mc.max_model_len
+        model_cls = get_model_class(hf_config)
+        self.model = model_cls(hf_config, dtype=mc.jax_dtype)
+
+        shardings = None
+        if self.mesh is not None:
+            from jax.sharding import NamedSharding
+
+            specs = self.model.param_shardings()
+            shardings = jax.tree_util.tree_map(
+                lambda s: NamedSharding(self.mesh, s),
+                specs,
+                is_leaf=lambda x: not isinstance(x, dict),
+            )
+        if mc.load_format == "dummy":
+            from vllm_tpu.models.loader import init_dummy_params
+
+            self.params = init_dummy_params(self.model, mc.seed, mc.jax_dtype, shardings)
+        else:
+            self.params = self.model.load_params(mc.model, mc.jax_dtype, shardings)
+
+    # ------------------------------------------------------------------
+
+    def determine_num_kv_blocks(self) -> int:
+        """KV sizing (reference: determine_available_memory + profile_run).
+
+        Uses device memory stats when the backend reports them (TPU does);
+        falls back to a fixed small pool on CPU test backends.
+        """
+        cache = self.config.cache_config
+        if cache.num_gpu_blocks_override is not None:
+            return cache.num_gpu_blocks_override
+
+        specs = self.model.get_kv_cache_spec(
+            cache.block_size, jnp.dtype(self.config.model_config.jax_dtype).itemsize
+        )
+        stats = getattr(self.device, "memory_stats", lambda: None)()
+        if not stats or "bytes_limit" not in stats:
+            logger.warning("no device memory stats; defaulting to 512 KV blocks")
+            return 512
+
+        limit = stats["bytes_limit"] * cache.gpu_memory_utilization
+        in_use = stats.get("bytes_in_use", 0)
+        free_for_kv = (limit - in_use) * (1 - _ACTIVATION_HEADROOM)
+        if free_for_kv <= 0:
+            raise RuntimeError(
+                f"no HBM left for KV cache (limit={limit}, in_use={in_use})"
+            )
+        kv_config = get_kv_cache_config_from_specs(specs, int(free_for_kv))
+        logger.info(
+            "KV sizing: %.2f GiB free -> %d blocks of %d tokens",
+            free_for_kv / 2**30,
+            kv_config.num_blocks,
+            cache.block_size,
+        )
+        return kv_config.num_blocks
+
+    def initialize(self) -> int:
+        """Full startup; returns the KV block count for the scheduler."""
+        self.init_device()
+        self.load_model()
+        num_blocks = self.determine_num_kv_blocks()
+        self.config.cache_config.num_gpu_blocks = num_blocks
+        self.runner = ModelRunner(
+            self.config, self.model, self.params, num_blocks, self.mesh
+        )
+        return num_blocks
+
+    def compile_or_warm_up_model(self) -> None:
+        if self.config.compilation_config.precompile:
+            assert self.runner is not None
+            self.runner.profile_run()
+
+    # ------------------------------------------------------------------
+
+    def execute_model(self, scheduler_output: SchedulerOutput) -> ModelRunnerOutput:
+        assert self.runner is not None
+        return self.runner.execute_model(scheduler_output)
