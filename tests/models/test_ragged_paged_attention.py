@@ -1,0 +1,128 @@
+"""Kernel-tier tests (SURVEY.md §4 tier 3): our XLA ragged paged attention
+reference vs the JAX-bundled TPU kernel's own reference implementation —
+proves the interleaved KV layout and metadata mapping feed the Pallas fast
+path correctly (the Pallas kernel itself is validated against the same
+reference upstream and in the on-TPU smoke run).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from vllm_tpu.ops.attention import (
+    AttentionMetadata,
+    ref_ragged_paged_attention,
+    write_kv,
+)
+
+
+def _random_case(rng, num_seqs, q_lens, kv_lens, kh, h, d, bs, num_blocks):
+    """Build a mixed prefill/decode batch. q tokens are the LAST q_len
+    tokens of each request's kv_len context."""
+    assert len(q_lens) == len(kv_lens) == num_seqs
+    t = int(sum(q_lens))
+    q = jnp.asarray(rng.standard_normal((t, h, d)), jnp.float32)
+
+    max_blocks = max(-(-kv // bs) for kv in kv_lens) + 1
+    block_tables = np.zeros((num_seqs, max_blocks), np.int32)
+    kv_cache = jnp.asarray(
+        rng.standard_normal((num_blocks, bs, 2 * kh, d)), jnp.float32
+    )
+
+    positions = np.zeros(t, np.int32)
+    token_req_idx = np.zeros(t, np.int32)
+    slot_mapping = np.zeros(t, np.int32)
+    seq_lens = np.asarray(kv_lens, np.int32)
+    query_start_loc = np.zeros(num_seqs + 1, np.int32)
+
+    next_block = 1
+    offset = 0
+    for i in range(num_seqs):
+        nb = -(-kv_lens[i] // bs)
+        blocks = np.arange(next_block, next_block + nb, dtype=np.int32)
+        next_block += nb
+        block_tables[i, :nb] = blocks
+        pos = np.arange(kv_lens[i] - q_lens[i], kv_lens[i], dtype=np.int32)
+        positions[offset : offset + q_lens[i]] = pos
+        token_req_idx[offset : offset + q_lens[i]] = i
+        slot_mapping[offset : offset + q_lens[i]] = blocks[pos // bs] * bs + pos % bs
+        offset += q_lens[i]
+        query_start_loc[i + 1] = offset
+    assert next_block <= num_blocks
+
+    md = AttentionMetadata(
+        positions=jnp.asarray(positions),
+        slot_mapping=jnp.asarray(slot_mapping),
+        block_tables=jnp.asarray(block_tables),
+        seq_lens=jnp.asarray(seq_lens),
+        query_start_loc=jnp.asarray(query_start_loc),
+        token_req_idx=jnp.asarray(token_req_idx),
+        logits_indices=jnp.asarray(query_start_loc[1:] - 1),
+        num_seqs=jnp.asarray([num_seqs], jnp.int32),
+    )
+    # Insert this step's K/V at the q token slots so cache + metadata agree.
+    k_new = jnp.asarray(rng.standard_normal((t, kh, d)), jnp.float32)
+    v_new = jnp.asarray(rng.standard_normal((t, kh, d)), jnp.float32)
+    kv_cache = write_kv(kv_cache, k_new, v_new, md.slot_mapping)
+    return q, kv_cache, md
+
+
+CASES = [
+    # (q_lens, kv_lens): pure decode, pure prefill, mixed, chunked prefill
+    ([1, 1, 1], [17, 40, 5]),
+    ([16, 24], [16, 24]),
+    ([1, 13, 1, 8], [33, 13, 9, 30]),
+    ([8], [32]),  # chunked prefill: last 8 tokens of a 32-token context
+]
+
+
+@pytest.mark.parametrize("q_lens,kv_lens", CASES)
+@pytest.mark.parametrize("kh,h", [(2, 4), (1, 1)])
+def test_ref_matches_bundled_kernel_reference(q_lens, kv_lens, kh, h):
+    from jax.experimental.pallas.ops.tpu.ragged_paged_attention import (
+        ref_ragged_paged_attention as bundled_ref,
+    )
+
+    rng = np.random.default_rng(0)
+    d, bs = 32, 8
+    q, kv_cache, md = _random_case(
+        rng, len(q_lens), q_lens, kv_lens, kh, h, d, bs, num_blocks=64
+    )
+    scale = d ** -0.5
+
+    ours = ref_ragged_paged_attention(q, kv_cache, md, scale)
+    theirs = bundled_ref(
+        q, kv_cache, md.seq_lens, md.block_tables, md.query_start_loc,
+        np.asarray([len(q_lens)], np.int32), sm_scale=scale,
+    )
+    t_live = int(sum(q_lens))
+    np.testing.assert_allclose(
+        np.asarray(ours)[:t_live], np.asarray(theirs), rtol=2e-5, atol=2e-5
+    )
+
+
+@pytest.mark.parametrize("q_lens,kv_lens", [([1, 5], [40, 25])])
+def test_sliding_window(q_lens, kv_lens):
+    from jax.experimental.pallas.ops.tpu.ragged_paged_attention import (
+        ref_ragged_paged_attention as bundled_ref,
+    )
+
+    rng = np.random.default_rng(1)
+    kh, h, d, bs = 2, 4, 32, 8
+    q, kv_cache, md = _random_case(
+        rng, len(q_lens), q_lens, kv_lens, kh, h, d, bs, num_blocks=64
+    )
+    scale = d ** -0.5
+    ours = ref_ragged_paged_attention(q, kv_cache, md, scale, sliding_window=16)
+    theirs = bundled_ref(
+        q, kv_cache, md.seq_lens, md.block_tables, md.query_start_loc,
+        np.asarray([len(q_lens)], np.int32), sm_scale=scale, sliding_window=16,
+    )
+    t_live = int(sum(q_lens))
+    np.testing.assert_allclose(
+        np.asarray(ours)[:t_live], np.asarray(theirs), rtol=2e-5, atol=2e-5
+    )

@@ -63,6 +63,7 @@ def build_prefill_metadata(model, t: int, block_size: int = 4, num_blocks: int =
         query_start_loc=jnp.asarray([0, t], jnp.int32),
         token_req_idx=jnp.zeros(t, jnp.int32),
         logits_indices=jnp.asarray([t - 1], jnp.int32),
+        num_seqs=jnp.asarray([1], jnp.int32),
     )
     return md, _kv_cache(model, num_blocks, block_size)
 
@@ -85,4 +86,5 @@ def build_decode_metadata(model, pos: int, block_size: int = 4):
         query_start_loc=jnp.asarray([0, 1], jnp.int32),
         token_req_idx=jnp.zeros(1, jnp.int32),
         logits_indices=jnp.asarray([0], jnp.int32),
+        num_seqs=jnp.asarray([1], jnp.int32),
     )

@@ -1,0 +1,159 @@
+"""Benchmark harness: latency / throughput / serve.
+
+Protocol mirrors the reference's `vllm bench {latency,throughput,serve}`
+(``vllm/benchmarks/``, .buildkite/performance-benchmarks-descriptions.md):
+  latency    — fixed batch, fixed in/out lengths, e2e seconds per batch
+  throughput — N prompts, continuous batching, req/s + tok/s
+  serve      — Poisson arrivals at --qps against the AsyncLLM engine,
+               TTFT / ITL / e2e percentiles
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import time
+
+import numpy as np
+
+
+def _build_llm(args):
+    from vllm_tpu.engine.arg_utils import EngineArgs
+    from vllm_tpu.entrypoints.llm import LLM
+
+    return LLM.from_engine_args(EngineArgs.from_cli_args(args))
+
+
+def _prompts(n: int, input_len: int, vocab: int = 30000):
+    return [
+        {"prompt_token_ids": [(7 * i + j) % vocab for j in range(input_len)]}
+        for i in range(n)
+    ]
+
+
+def _emit(result: dict, json_out: str | None):
+    print(json.dumps(result, indent=2))
+    if json_out:
+        with open(json_out, "w") as f:
+            json.dump(result, f)
+
+
+def run_bench(args) -> dict:
+    from vllm_tpu.sampling_params import SamplingParams
+
+    params = SamplingParams(
+        temperature=0.0, max_tokens=args.output_len, ignore_eos=True
+    )
+    if args.mode == "serve":
+        return _run_serve(args, params)
+
+    llm = _build_llm(args)
+    # Warmup compile.
+    llm.generate(
+        _prompts(2, args.input_len),
+        SamplingParams(temperature=0.0, max_tokens=2, ignore_eos=True),
+    )
+
+    if args.mode == "latency":
+        prompts = _prompts(args.batch_size, args.input_len)
+        iters = []
+        for _ in range(5):
+            t0 = time.monotonic()
+            llm.generate(prompts, params)
+            iters.append(time.monotonic() - t0)
+        result = {
+            "mode": "latency",
+            "batch_size": args.batch_size,
+            "input_len": args.input_len,
+            "output_len": args.output_len,
+            "mean_s": float(np.mean(iters)),
+            "median_s": float(np.median(iters)),
+            "p99_s": float(np.percentile(iters, 99)),
+        }
+    else:  # throughput
+        prompts = _prompts(args.num_prompts, args.input_len)
+        t0 = time.monotonic()
+        outs = llm.generate(prompts, params)
+        dt = time.monotonic() - t0
+        n_out = sum(len(o.outputs[0].token_ids) for o in outs)
+        n_in = sum(len(o.prompt_token_ids) for o in outs)
+        result = {
+            "mode": "throughput",
+            "num_prompts": args.num_prompts,
+            "elapsed_s": dt,
+            "requests_per_s": args.num_prompts / dt,
+            "output_tokens_per_s": n_out / dt,
+            "total_tokens_per_s": (n_in + n_out) / dt,
+        }
+    _emit(result, args.json_out)
+    llm.shutdown()
+    return result
+
+
+def _run_serve(args, params) -> dict:
+    """Poisson-arrival serving benchmark against an in-proc AsyncLLM."""
+    from vllm_tpu.engine.arg_utils import AsyncEngineArgs
+    from vllm_tpu.engine.async_llm import AsyncLLM
+
+    fields = {f.name for f in __import__("dataclasses").fields(AsyncEngineArgs)}
+    engine_args = AsyncEngineArgs(
+        **{k: v for k, v in vars(args).items() if k in fields}
+    )
+    from dataclasses import replace
+
+    from vllm_tpu.sampling_params import RequestOutputKind
+
+    params = replace(params, output_kind=RequestOutputKind.DELTA)
+    engine = AsyncLLM.from_engine_args(engine_args)
+    prompts = _prompts(args.num_prompts, args.input_len)
+    rng = np.random.default_rng(0)
+
+    async def one(i, prompt, start_at, stats):
+        await asyncio.sleep(max(0.0, start_at - time.monotonic()))
+        t0 = time.monotonic()
+        first = None
+        last = t0
+        itls = []
+        async for out in engine.generate(prompt, params, f"bench-{i}"):
+            t = time.monotonic()
+            if first is None:
+                first = t - t0
+            else:
+                itls.append(t - last)
+            last = t
+        stats.append((first, itls, last - t0))
+
+    async def driver():
+        stats: list = []
+        t0 = time.monotonic()
+        offsets = (
+            np.cumsum(rng.exponential(1.0 / args.qps, len(prompts)))
+            if args.qps > 0 else np.zeros(len(prompts))
+        )
+        await asyncio.gather(*[
+            one(i, p, t0 + offsets[i], stats) for i, p in enumerate(prompts)
+        ])
+        return stats, time.monotonic() - t0
+
+    stats, wall = asyncio.run(driver())
+    ttfts = [s[0] for s in stats if s[0] is not None]
+    itls = [x for s in stats for x in s[1]]
+    e2es = [s[2] for s in stats]
+    result = {
+        "mode": "serve",
+        "qps": args.qps,
+        "num_prompts": args.num_prompts,
+        "elapsed_s": wall,
+        "request_throughput": len(stats) / wall,
+        "output_token_throughput": sum(len(s[1]) + 1 for s in stats) / wall,
+        "ttft_mean_s": float(np.mean(ttfts)) if ttfts else None,
+        "ttft_p50_s": float(np.median(ttfts)) if ttfts else None,
+        "ttft_p99_s": float(np.percentile(ttfts, 99)) if ttfts else None,
+        "itl_mean_s": float(np.mean(itls)) if itls else None,
+        "itl_p50_s": float(np.median(itls)) if itls else None,
+        "itl_p99_s": float(np.percentile(itls, 99)) if itls else None,
+        "e2e_p50_s": float(np.median(e2es)) if e2es else None,
+    }
+    _emit(result, args.json_out)
+    engine.shutdown()
+    return result

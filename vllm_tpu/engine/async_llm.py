@@ -1,0 +1,192 @@
+"""AsyncLLM: per-request async-generator API for serving.
+
+Reference analog: ``vllm/v1/engine/async_llm.py:70`` (generate :524,
+_run_output_handler :637). The reference splits frontend and engine core
+into separate processes over ZMQ; here the engine core runs in a background
+*thread* — the jitted TPU step releases the GIL while the device works, so
+the asyncio event loop stays responsive without a process hop (the reference
+needs the split because its scheduler hot loop is GIL-bound CPU work
+feeding many GPU worker processes). A ZMQ proc split can layer on top for
+DP; the AsyncLLM surface is identical either way.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import queue
+import threading
+from typing import Any, AsyncGenerator
+
+from vllm_tpu.config import EngineConfig
+from vllm_tpu.engine.engine_core import EngineCore
+from vllm_tpu.engine.input_processor import InputProcessor, PromptType
+from vllm_tpu.engine.output_processor import OutputProcessor
+from vllm_tpu.logger import init_logger
+from vllm_tpu.outputs import RequestOutput
+from vllm_tpu.sampling_params import RequestOutputKind, SamplingParams
+
+logger = init_logger(__name__)
+
+
+class EngineDeadError(RuntimeError):
+    """Reference analog: ``vllm/v1/engine/exceptions.py:9``."""
+
+
+class AsyncStream:
+    """Thread-safe per-request output stream.
+
+    Reference analog: ``RequestOutputCollector`` (async_llm.py). The engine
+    thread calls ``put_nowait`` (the OutputProcessor treats it like a queue);
+    delivery hops onto the consumer's event loop via call_soon_threadsafe so
+    the awaiting generator wakes up.
+    """
+
+    def __init__(self, loop: asyncio.AbstractEventLoop) -> None:
+        self._loop = loop
+        self._queue: asyncio.Queue = asyncio.Queue()
+
+    def put_nowait(self, item: Any) -> None:
+        if self._loop.is_closed():  # pragma: no cover - shutdown race
+            return
+        self._loop.call_soon_threadsafe(self._queue.put_nowait, item)
+
+    async def get(self) -> Any:
+        return await self._queue.get()
+
+
+class AsyncLLM:
+    def __init__(self, config: EngineConfig, start: bool = True) -> None:
+        self.config = config
+        self.engine_core = EngineCore(config)
+        self.input_processor = InputProcessor(config)
+        self.output_processor = OutputProcessor(self.input_processor.tokenizer)
+        self.stat_loggers: list[Any] = []
+
+        self._input_queue: queue.Queue = queue.Queue()
+        self._loop: asyncio.AbstractEventLoop | None = None
+        self._dead = False
+        self._shutdown = threading.Event()
+        self._thread: threading.Thread | None = None
+        if start:
+            self.start()
+
+    @classmethod
+    def from_engine_args(cls, engine_args: Any) -> "AsyncLLM":
+        return cls(engine_args.create_engine_config())
+
+    @property
+    def tokenizer(self):
+        return self.input_processor.tokenizer
+
+    def start(self) -> None:
+        if self._thread is None:
+            self._thread = threading.Thread(
+                target=self._busy_loop, name="engine-core", daemon=True
+            )
+            self._thread.start()
+
+    # ------------------------------------------------------------------
+    # Client side (event loop)
+    # ------------------------------------------------------------------
+
+    async def generate(
+        self,
+        prompt: PromptType,
+        sampling_params: SamplingParams,
+        request_id: str,
+        priority: int = 0,
+    ) -> AsyncGenerator[RequestOutput, None]:
+        """Feed a request and yield RequestOutputs as tokens arrive."""
+        if self._dead:
+            raise EngineDeadError("engine core died")
+        self._loop = asyncio.get_running_loop()
+        core_req = self.input_processor.process(
+            request_id, prompt, sampling_params, priority=priority
+        )
+        out_q = AsyncStream(asyncio.get_running_loop())
+        self.output_processor.add_request(
+            request_id,
+            getattr(core_req, "prompt_text", None),
+            core_req.prompt_token_ids,
+            core_req.sampling_params,
+            core_req.arrival_time,
+            queue=out_q,
+        )
+        self._input_queue.put(("add", core_req))
+        finished = False
+        try:
+            while True:
+                item = await out_q.get()
+                if isinstance(item, Exception):
+                    raise item
+                yield item
+                if item.finished:
+                    finished = True
+                    return
+        finally:
+            # Generator dropped early (client disconnect) -> abort.
+            if not finished:
+                self._input_queue.put(("abort", [request_id]))
+                self.output_processor.abort_requests([request_id])
+
+    async def abort(self, request_id: str) -> None:
+        self._input_queue.put(("abort", [request_id]))
+        self.output_processor.abort_requests([request_id])
+
+    # ------------------------------------------------------------------
+    # Engine side (background thread)
+    # ------------------------------------------------------------------
+
+    def _busy_loop(self) -> None:
+        try:
+            while not self._shutdown.is_set():
+                self._drain_input_queue(
+                    block=not self.engine_core.has_unfinished_requests()
+                )
+                if self._shutdown.is_set():
+                    return
+                if not self.engine_core.has_unfinished_requests():
+                    continue
+                outputs = self.engine_core.step()
+                # process_outputs delivers straight into each request's
+                # AsyncStream (thread-safe); nothing to re-publish here.
+                processed = self.output_processor.process_outputs(
+                    outputs.outputs
+                )
+                if processed.reqs_to_abort:
+                    self.engine_core.abort_requests(processed.reqs_to_abort)
+                for logger_ in self.stat_loggers:
+                    logger_.record(
+                        scheduler_stats=outputs.scheduler_stats,
+                        iteration_stats=processed.iteration_stats,
+                    )
+        except Exception as e:  # engine death -> fail all waiters
+            logger.exception("engine core loop died: %s", e)
+            self._dead = True
+            err = EngineDeadError(f"engine core died: {e!r}")
+            for state in list(self.output_processor.request_states.values()):
+                if state.queue is not None:
+                    state.queue.put_nowait(err)
+
+    def _drain_input_queue(self, block: bool) -> None:
+        try:
+            op, payload = self._input_queue.get(timeout=0.1 if block else 0)
+        except queue.Empty:
+            return
+        while True:
+            if op == "add":
+                self.engine_core.add_request(payload)
+            elif op == "abort":
+                self.engine_core.abort_requests(payload)
+            try:
+                op, payload = self._input_queue.get_nowait()
+            except queue.Empty:
+                return
+
+    # ------------------------------------------------------------------
+
+    def shutdown(self) -> None:
+        self._shutdown.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+        self.engine_core.shutdown()

@@ -41,6 +41,7 @@ class RequestState:
             tokenizer if params.detokenize else None, prompt_token_ids, params
         )
         self.metrics = RequestMetrics(arrival_time=arrival_time)
+        self.last_token_time = arrival_time
         self.logprobs: list[dict[int, Logprob]] = []
         self.num_sent_chars = 0
         self.queue = queue  # per-request asyncio queue (streaming mode)
@@ -86,6 +87,7 @@ class RequestState:
 class ProcessedOutputs:
     request_outputs: list[RequestOutput] = field(default_factory=list)
     reqs_to_abort: list[str] = field(default_factory=list)
+    iteration_stats: Any = None
 
 
 class OutputProcessor:
@@ -126,15 +128,27 @@ class OutputProcessor:
         engine_core_outputs: list[EngineCoreOutput],
         logprobs_lists=None,
     ) -> ProcessedOutputs:
+        from vllm_tpu.metrics.stats import IterationStats
+
         result = ProcessedOutputs()
+        stats = result.iteration_stats = IterationStats()
         now = time.monotonic()
         for eco in engine_core_outputs:
             state = self.request_states.get(eco.req_id)
             if state is None:
                 continue  # aborted earlier
 
-            if state.metrics.first_token_time is None and eco.new_token_ids:
-                state.metrics.first_token_time = now
+            if eco.new_token_ids:
+                stats.num_generation_tokens += len(eco.new_token_ids)
+                if state.metrics.first_token_time is None:
+                    state.metrics.first_token_time = now
+                    stats.num_prompt_tokens += len(state.prompt_token_ids)
+                    stats.ttfts.append(now - state.metrics.arrival_time)
+                else:
+                    stats.inter_token_latencies.append(
+                        now - state.last_token_time
+                    )
+                state.last_token_time = now
 
             stop_str = state.detokenizer.update(eco.new_token_ids)
             finish_reason = eco.finish_reason
@@ -148,6 +162,14 @@ class OutputProcessor:
             if eco.new_logprobs is not None:
                 self._append_logprobs(state, eco)
 
+            if finish_reason is not None:
+                state.metrics.finished_time = now
+                stats.e2e_latencies.append(now - state.metrics.arrival_time)
+                # Pop BEFORE delivering the final output: once the client
+                # sees `finished` it may re-use the request id; popping
+                # after delivery could delete the successor's state.
+                self.request_states.pop(eco.req_id, None)
+
             out = state.make_request_output(
                 eco.new_token_ids, finish_reason, stop_reason
             )
@@ -156,10 +178,6 @@ class OutputProcessor:
                     state.queue.put_nowait(out)
                 else:
                     result.request_outputs.append(out)
-
-            if finish_reason is not None:
-                state.metrics.finished_time = now
-                del self.request_states[eco.req_id]
         return result
 
     def _append_logprobs(self, state: RequestState, eco: EngineCoreOutput) -> None:
@@ -175,4 +193,7 @@ class OutputProcessor:
                 d[int(sampled_tok)] = Logprob(
                     logprob=float(sampled_lp), rank=int(sampled_rank) + 1
                 )
+            if self.tokenizer is not None and state.params.detokenize:
+                for tid, lp in d.items():
+                    lp.decoded_token = self.tokenizer.decode([tid])
             state.logprobs.append(d)

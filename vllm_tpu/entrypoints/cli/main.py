@@ -1,0 +1,88 @@
+"""`vllm-tpu` CLI: serve / complete / bench.
+
+Reference analog: ``vllm/entrypoints/cli/main.py`` (`vllm serve/chat/
+complete/bench`, serve.py:37 ServeSubcommand).
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+from vllm_tpu.engine.arg_utils import AsyncEngineArgs, EngineArgs
+
+
+def _add_serve(sub: argparse._SubParsersAction) -> None:
+    p = sub.add_parser("serve", help="Start the OpenAI-compatible server")
+    p.add_argument("model_tag", nargs="?", help="model name or path")
+    p.add_argument("--host", default="0.0.0.0")
+    p.add_argument("--port", type=int, default=8000)
+    AsyncEngineArgs.add_cli_args(p)
+    p.set_defaults(func=_run_serve)
+
+
+def _run_serve(args: argparse.Namespace) -> None:
+    from vllm_tpu.entrypoints.openai.api_server import run_server
+
+    engine_args = AsyncEngineArgs.from_cli_args(args)
+    if args.model_tag:
+        engine_args.model = args.model_tag
+    run_server(engine_args, host=args.host, port=args.port)
+
+
+def _add_complete(sub: argparse._SubParsersAction) -> None:
+    p = sub.add_parser("complete", help="One-shot offline completion")
+    p.add_argument("model_tag", nargs="?")
+    p.add_argument("--prompt", required=True)
+    p.add_argument("--max-tokens", type=int, default=64)
+    p.add_argument("--temperature", type=float, default=0.0)
+    EngineArgs.add_cli_args(p)
+    p.set_defaults(func=_run_complete)
+
+
+def _run_complete(args: argparse.Namespace) -> None:
+    from vllm_tpu.entrypoints.llm import LLM
+    from vllm_tpu.sampling_params import SamplingParams
+
+    engine_args = EngineArgs.from_cli_args(args)
+    if args.model_tag:
+        engine_args.model = args.model_tag
+    llm = LLM.from_engine_args(engine_args)
+    outs = llm.generate(
+        [args.prompt],
+        SamplingParams(temperature=args.temperature, max_tokens=args.max_tokens),
+    )
+    print(outs[0].outputs[0].text)
+
+
+def _add_bench(sub: argparse._SubParsersAction) -> None:
+    p = sub.add_parser("bench", help="Benchmarks (latency/throughput/serve)")
+    p.add_argument("mode", choices=["latency", "throughput", "serve"])
+    p.add_argument("--json", dest="json_out", default=None)
+    EngineArgs.add_cli_args(p)
+    p.add_argument("--num-prompts", type=int, default=100)
+    p.add_argument("--input-len", type=int, default=32)
+    p.add_argument("--output-len", type=int, default=128)
+    p.add_argument("--batch-size", type=int, default=8)
+    p.add_argument("--qps", type=float, default=0.0, help="serve mode request rate (0=inf)")
+    p.set_defaults(func=_run_bench)
+
+
+def _run_bench(args: argparse.Namespace) -> None:
+    from vllm_tpu.benchmarks.run import run_bench
+
+    run_bench(args)
+
+
+def main(argv: list[str] | None = None) -> None:
+    parser = argparse.ArgumentParser(prog="vllm-tpu")
+    sub = parser.add_subparsers(required=True)
+    _add_serve(sub)
+    _add_complete(sub)
+    _add_bench(sub)
+    args = parser.parse_args(argv)
+    args.func(args)
+
+
+if __name__ == "__main__":
+    main(sys.argv[1:])

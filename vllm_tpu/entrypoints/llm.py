@@ -25,6 +25,13 @@ class LLM:
         self.llm_engine = LLMEngine.from_engine_args(engine_args)
         self._request_counter = 0
 
+    @classmethod
+    def from_engine_args(cls, engine_args: EngineArgs) -> "LLM":
+        llm = cls.__new__(cls)
+        llm.llm_engine = LLMEngine.from_engine_args(engine_args)
+        llm._request_counter = 0
+        return llm
+
     def get_tokenizer(self):
         return self.llm_engine.tokenizer
 

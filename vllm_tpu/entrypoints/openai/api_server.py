@@ -1,0 +1,395 @@
+"""OpenAI-compatible HTTP server (aiohttp).
+
+Reference analog: ``vllm/entrypoints/openai/api_server.py:671 run_server``
+(FastAPI/uvicorn there; this image carries aiohttp). Endpoints:
+
+  POST /v1/completions          (stream + non-stream)
+  POST /v1/chat/completions     (stream + non-stream)
+  GET  /v1/models
+  GET  /health /ping
+  GET  /metrics                 (Prometheus text format)
+
+Streaming uses SSE (``data: {...}\\n\\n`` ... ``data: [DONE]``), matching the
+OpenAI wire format.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+from typing import Any, AsyncIterator
+
+from aiohttp import web
+
+from vllm_tpu.engine.async_llm import AsyncLLM, EngineDeadError
+from vllm_tpu.entrypoints.openai.protocol import (
+    ChatCompletionRequest,
+    CompletionRequest,
+    ValidationError,
+    now,
+    random_id,
+)
+from vllm_tpu.logger import init_logger
+from vllm_tpu.outputs import RequestOutput
+
+logger = init_logger(__name__)
+
+ENGINE_KEY = web.AppKey("engine", AsyncLLM)
+MODEL_KEY = web.AppKey("model_name", str)
+METRICS_KEY = web.AppKey("metrics", object)
+
+
+def _error(status: int, message: str, err_type: str = "invalid_request_error"):
+    return web.json_response(
+        {"error": {"message": message, "type": err_type, "code": status}},
+        status=status,
+    )
+
+
+# ----------------------------------------------------------------------
+# /v1/completions
+# ----------------------------------------------------------------------
+
+
+async def handle_completions(request: web.Request) -> web.StreamResponse:
+    engine: AsyncLLM = request.app[ENGINE_KEY]
+    try:
+        body = await request.json()
+        req = CompletionRequest.from_json(body)
+    except (json.JSONDecodeError, ValidationError, TypeError, ValueError) as e:
+        return _error(400, str(e))
+
+    prompts = _normalize_prompts(req.prompt)
+    if req.n < 1:
+        return _error(400, "'n' must be >= 1")
+    if req.stream and (len(prompts) != 1 or req.n != 1):
+        return _error(400, "streaming supports a single prompt with n=1")
+    params = req.to_sampling_params(req.stream)
+    req_id = random_id("cmpl")
+
+    if req.stream:
+        return await _stream_completion(request, engine, req, prompts[0], params, req_id)
+
+    # n>1: fan out one engine request per sample (parallel sampling; the
+    # reference's ParentRequest aggregation, entrypoints-side here). Choices
+    # are prompt-major: index = prompt_idx * n + sample_idx.
+    from dataclasses import replace as _replace
+
+    jobs = []
+    for i, p in enumerate(prompts):
+        for j in range(req.n):
+            sp = params
+            if params.seed is not None and req.n > 1:
+                sp = _replace(params, seed=params.seed + j)
+            jobs.append(_collect(engine, p, sp, f"{req_id}-{i}-{j}"))
+    try:
+        results = await asyncio.gather(*jobs)
+    except EngineDeadError as e:
+        return _error(500, str(e), "internal_error")
+    choices = []
+    n_prompt = n_out = 0
+    for idx, out in enumerate(results):
+        c = out.outputs[0]
+        text = c.text
+        if req.echo and out.prompt is not None:
+            text = out.prompt + text
+        choices.append({
+            "index": idx,
+            "text": text,
+            "logprobs": _completion_logprobs(c) if req.logprobs else None,
+            "finish_reason": c.finish_reason or "stop",
+        })
+        if idx % req.n == 0:
+            n_prompt += len(out.prompt_token_ids)
+        n_out += len(c.token_ids)
+    return web.json_response({
+        "id": req_id,
+        "object": "text_completion",
+        "created": now(),
+        "model": req.model or request.app[MODEL_KEY],
+        "choices": choices,
+        "usage": {
+            "prompt_tokens": n_prompt,
+            "completion_tokens": n_out,
+            "total_tokens": n_prompt + n_out,
+        },
+    })
+
+
+async def _stream_completion(
+    request, engine, req, prompt, params, req_id
+) -> web.StreamResponse:
+    resp = _sse_response(request)
+    await resp.prepare(request)
+    model = req.model or request.app[MODEL_KEY]
+    try:
+        async for out in engine.generate(prompt, params, req_id):
+            c = out.outputs[0]
+            if c.text or out.finished:
+                chunk = {
+                    "id": req_id,
+                    "object": "text_completion",
+                    "created": now(),
+                    "model": model,
+                    "choices": [{
+                        "index": 0,
+                        "text": c.text,
+                        "logprobs": None,
+                        "finish_reason": c.finish_reason if out.finished else None,
+                    }],
+                }
+                await _sse_send(resp, chunk)
+    except (ConnectionResetError, asyncio.CancelledError):
+        return resp
+    except EngineDeadError as e:
+        await _sse_send(resp, {"error": {"message": str(e)}})
+    await _sse_done(resp)
+    return resp
+
+
+# ----------------------------------------------------------------------
+# /v1/chat/completions
+# ----------------------------------------------------------------------
+
+
+async def handle_chat_completions(request: web.Request) -> web.StreamResponse:
+    engine: AsyncLLM = request.app[ENGINE_KEY]
+    try:
+        body = await request.json()
+        req = ChatCompletionRequest.from_json(body)
+    except (json.JSONDecodeError, ValidationError, TypeError, ValueError) as e:
+        return _error(400, str(e))
+
+    tokenizer = engine.tokenizer
+    if tokenizer is None:
+        return _error(400, "server has no tokenizer; chat API unavailable")
+    try:
+        prompt_ids = tokenizer.apply_chat_template(
+            req.messages,
+            chat_template=req.chat_template,
+            add_generation_prompt=req.add_generation_prompt,
+        )
+    except Exception as e:
+        return _error(400, f"chat template failed: {e}")
+
+    if req.n < 1:
+        return _error(400, "'n' must be >= 1")
+    if req.stream and req.n != 1:
+        return _error(400, "streaming supports n=1")
+    params = req.to_sampling_params(req.stream)
+    req_id = random_id("chatcmpl")
+    prompt = {"prompt_token_ids": list(prompt_ids)}
+    model = req.model or request.app[MODEL_KEY]
+
+    if req.stream:
+        resp = _sse_response(request)
+        await resp.prepare(request)
+        first = True
+        try:
+            async for out in engine.generate(prompt, params, req_id):
+                c = out.outputs[0]
+                delta: dict[str, Any] = {}
+                if first:
+                    delta["role"] = "assistant"
+                    first = False
+                if c.text:
+                    delta["content"] = c.text
+                if delta or out.finished:
+                    await _sse_send(resp, {
+                        "id": req_id,
+                        "object": "chat.completion.chunk",
+                        "created": now(),
+                        "model": model,
+                        "choices": [{
+                            "index": 0,
+                            "delta": delta,
+                            "finish_reason": c.finish_reason if out.finished else None,
+                        }],
+                    })
+        except (ConnectionResetError, asyncio.CancelledError):
+            return resp
+        except EngineDeadError as e:
+            await _sse_send(resp, {"error": {"message": str(e)}})
+        await _sse_done(resp)
+        return resp
+
+    from dataclasses import replace as _replace
+
+    jobs = []
+    for j in range(req.n):
+        sp = params
+        if params.seed is not None and req.n > 1:
+            sp = _replace(params, seed=params.seed + j)
+        jobs.append(_collect(engine, prompt, sp, f"{req_id}-{j}"))
+    try:
+        results = await asyncio.gather(*jobs)
+    except EngineDeadError as e:
+        return _error(500, str(e), "internal_error")
+    choices = [{
+        "index": j,
+        "message": {"role": "assistant", "content": out.outputs[0].text},
+        "logprobs": _chat_logprobs(out.outputs[0]) if req.logprobs else None,
+        "finish_reason": out.outputs[0].finish_reason or "stop",
+    } for j, out in enumerate(results)]
+    n_out = sum(len(out.outputs[0].token_ids) for out in results)
+    return web.json_response({
+        "id": req_id,
+        "object": "chat.completion",
+        "created": now(),
+        "model": model,
+        "choices": choices,
+        "usage": {
+            "prompt_tokens": len(results[0].prompt_token_ids),
+            "completion_tokens": n_out,
+            "total_tokens": len(results[0].prompt_token_ids) + n_out,
+        },
+    })
+
+
+# ----------------------------------------------------------------------
+# misc endpoints
+# ----------------------------------------------------------------------
+
+
+async def handle_models(request: web.Request) -> web.Response:
+    return web.json_response({
+        "object": "list",
+        "data": [{
+            "id": request.app[MODEL_KEY],
+            "object": "model",
+            "created": now(),
+            "owned_by": "vllm-tpu",
+        }],
+    })
+
+
+async def handle_health(request: web.Request) -> web.Response:
+    engine: AsyncLLM = request.app[ENGINE_KEY]
+    if engine._dead:
+        return web.Response(status=503, text="engine dead")
+    return web.Response(text="OK")
+
+
+async def handle_metrics(request: web.Request) -> web.Response:
+    reg = request.app.get(METRICS_KEY)
+    text = reg.render() if reg is not None else ""
+    return web.Response(text=text, content_type="text/plain")
+
+
+# ----------------------------------------------------------------------
+# plumbing
+# ----------------------------------------------------------------------
+
+
+def _normalize_prompts(prompt: Any) -> list[Any]:
+    if isinstance(prompt, str):
+        return [prompt]
+    if isinstance(prompt, list):
+        if not prompt:
+            raise ValidationError("empty prompt")
+        if isinstance(prompt[0], int):
+            return [{"prompt_token_ids": prompt}]
+        if isinstance(prompt[0], str):
+            return list(prompt)
+        if isinstance(prompt[0], list):
+            return [{"prompt_token_ids": p} for p in prompt]
+    raise ValidationError("prompt must be str | [str] | [int] | [[int]]")
+
+
+async def _collect(engine, prompt, params, req_id) -> RequestOutput:
+    final = None
+    async for out in engine.generate(prompt, params, req_id):
+        final = out
+    assert final is not None
+    return final
+
+
+def _completion_logprobs(c) -> dict | None:
+    """`c.logprobs[i]` is the top-k dict for sampled token `c.token_ids[i]`."""
+    if not c.logprobs:
+        return None
+    token_logprobs, tokens, top = [], [], []
+    for tid, lp_dict in zip(c.token_ids, c.logprobs):
+        sampled = lp_dict.get(tid)
+        if sampled is None:
+            continue
+        tokens.append(sampled.decoded_token or str(tid))
+        token_logprobs.append(sampled.logprob)
+        top.append({
+            (lp.decoded_token or str(t)): lp.logprob
+            for t, lp in lp_dict.items()
+        })
+    return {
+        "tokens": tokens,
+        "token_logprobs": token_logprobs,
+        "top_logprobs": top,
+        "text_offset": [],
+    }
+
+
+def _chat_logprobs(c) -> dict | None:
+    if not c.logprobs:
+        return None
+    content = []
+    for tid, lp_dict in zip(c.token_ids, c.logprobs):
+        sampled = lp_dict.get(tid)
+        if sampled is None:
+            continue
+        content.append({
+            "token": sampled.decoded_token or str(tid),
+            "logprob": sampled.logprob,
+            "top_logprobs": [
+                {"token": lp.decoded_token or str(t), "logprob": lp.logprob}
+                for t, lp in lp_dict.items()
+            ],
+        })
+    return {"content": content}
+
+
+def _sse_response(request) -> web.StreamResponse:
+    return web.StreamResponse(
+        status=200,
+        headers={
+            "Content-Type": "text/event-stream",
+            "Cache-Control": "no-cache",
+            "Connection": "keep-alive",
+        },
+    )
+
+
+async def _sse_send(resp: web.StreamResponse, obj: dict) -> None:
+    await resp.write(f"data: {json.dumps(obj)}\n\n".encode())
+
+
+async def _sse_done(resp: web.StreamResponse) -> None:
+    await resp.write(b"data: [DONE]\n\n")
+    await resp.write_eof()
+
+
+def build_app(engine: AsyncLLM, model_name: str, metrics=None) -> web.Application:
+    app = web.Application()
+    app[ENGINE_KEY] = engine
+    app[MODEL_KEY] = model_name
+    if metrics is not None:
+        app[METRICS_KEY] = metrics
+    app.router.add_post("/v1/completions", handle_completions)
+    app.router.add_post("/v1/chat/completions", handle_chat_completions)
+    app.router.add_get("/v1/models", handle_models)
+    app.router.add_get("/health", handle_health)
+    app.router.add_get("/ping", handle_health)
+    app.router.add_get("/metrics", handle_metrics)
+    return app
+
+
+def run_server(engine_args, host: str = "0.0.0.0", port: int = 8000) -> None:
+    from vllm_tpu.metrics.prometheus import PrometheusRegistry
+
+    engine = AsyncLLM.from_engine_args(engine_args)
+    metrics = PrometheusRegistry(engine)
+    engine.stat_loggers.append(metrics)
+    app = build_app(engine, engine_args.model, metrics)
+    logger.info("serving %s on %s:%d", engine_args.model, host, port)
+    try:
+        web.run_app(app, host=host, port=port, print=None)
+    finally:
+        engine.shutdown()

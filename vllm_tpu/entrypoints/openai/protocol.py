@@ -1,0 +1,192 @@
+"""OpenAI API request/response schemas.
+
+Reference analog: ``vllm/entrypoints/openai/protocol.py`` (pydantic models).
+This build uses plain dataclasses + explicit validation — the image carries
+no pydantic/fastapi; the server is aiohttp.
+"""
+
+from __future__ import annotations
+
+import time
+import uuid
+from dataclasses import dataclass, field
+from typing import Any
+
+from vllm_tpu.sampling_params import RequestOutputKind, SamplingParams
+
+
+class ValidationError(ValueError):
+    pass
+
+
+def _get(d: dict, key: str, typ, default=None):
+    v = d.get(key, default)
+    if v is None:
+        return None
+    if typ is float and isinstance(v, int):
+        v = float(v)
+    if not isinstance(v, typ):
+        raise ValidationError(f"'{key}' must be {typ}, got {type(v).__name__}")
+    return v
+
+
+@dataclass
+class CompletionRequest:
+    model: str
+    prompt: Any  # str | list[str] | list[int] | list[list[int]]
+    max_tokens: int = 16
+    temperature: float = 1.0
+    top_p: float = 1.0
+    top_k: int = 0
+    min_p: float = 0.0
+    n: int = 1
+    stream: bool = False
+    stop: list[str] = field(default_factory=list)
+    presence_penalty: float = 0.0
+    frequency_penalty: float = 0.0
+    repetition_penalty: float = 1.0
+    logprobs: int | None = None
+    echo: bool = False
+    seed: int | None = None
+    ignore_eos: bool = False
+    min_tokens: int = 0
+
+    @classmethod
+    def from_json(cls, d: dict) -> "CompletionRequest":
+        if "prompt" not in d:
+            raise ValidationError("'prompt' is required")
+        stop = d.get("stop") or []
+        if isinstance(stop, str):
+            stop = [stop]
+        return cls(
+            model=str(d.get("model", "")),
+            prompt=d["prompt"],
+            max_tokens=_get(d, "max_tokens", int, 16),
+            temperature=_get(d, "temperature", (int, float), 1.0),
+            top_p=_get(d, "top_p", (int, float), 1.0),
+            top_k=_get(d, "top_k", int, 0),
+            min_p=_get(d, "min_p", (int, float), 0.0),
+            n=_get(d, "n", int, 1),
+            stream=bool(d.get("stream", False)),
+            stop=stop,
+            presence_penalty=_get(d, "presence_penalty", (int, float), 0.0),
+            frequency_penalty=_get(d, "frequency_penalty", (int, float), 0.0),
+            repetition_penalty=_get(d, "repetition_penalty", (int, float), 1.0),
+            logprobs=_get(d, "logprobs", int),
+            echo=bool(d.get("echo", False)),
+            seed=_get(d, "seed", int),
+            ignore_eos=bool(d.get("ignore_eos", False)),
+            min_tokens=_get(d, "min_tokens", int, 0),
+        )
+
+    def to_sampling_params(self, stream: bool) -> SamplingParams:
+        return SamplingParams(
+            max_tokens=self.max_tokens,
+            temperature=float(self.temperature),
+            top_p=float(self.top_p),
+            top_k=self.top_k,
+            min_p=float(self.min_p),
+            stop=list(self.stop),
+            presence_penalty=float(self.presence_penalty),
+            frequency_penalty=float(self.frequency_penalty),
+            repetition_penalty=float(self.repetition_penalty),
+            logprobs=self.logprobs,
+            seed=self.seed,
+            ignore_eos=self.ignore_eos,
+            min_tokens=self.min_tokens,
+            output_kind=(
+                RequestOutputKind.DELTA if stream
+                else RequestOutputKind.FINAL_ONLY
+            ),
+        )
+
+
+@dataclass
+class ChatCompletionRequest:
+    model: str
+    messages: list[dict]
+    max_tokens: int = 4096
+    temperature: float = 1.0
+    top_p: float = 1.0
+    top_k: int = 0
+    min_p: float = 0.0
+    n: int = 1
+    stream: bool = False
+    stop: list[str] = field(default_factory=list)
+    presence_penalty: float = 0.0
+    frequency_penalty: float = 0.0
+    repetition_penalty: float = 1.0
+    logprobs: bool = False
+    top_logprobs: int | None = None
+    seed: int | None = None
+    ignore_eos: bool = False
+    min_tokens: int = 0
+    chat_template: str | None = None
+    add_generation_prompt: bool = True
+
+    @classmethod
+    def from_json(cls, d: dict) -> "ChatCompletionRequest":
+        msgs = d.get("messages")
+        if not isinstance(msgs, list) or not msgs:
+            raise ValidationError("'messages' must be a non-empty list")
+        for m in msgs:
+            if not isinstance(m, dict) or "role" not in m:
+                raise ValidationError("each message needs a 'role'")
+        stop = d.get("stop") or []
+        if isinstance(stop, str):
+            stop = [stop]
+        mt = d.get("max_tokens", d.get("max_completion_tokens", 4096))
+        return cls(
+            model=str(d.get("model", "")),
+            messages=msgs,
+            max_tokens=int(mt),
+            temperature=_get(d, "temperature", (int, float), 1.0),
+            top_p=_get(d, "top_p", (int, float), 1.0),
+            top_k=_get(d, "top_k", int, 0),
+            min_p=_get(d, "min_p", (int, float), 0.0),
+            n=_get(d, "n", int, 1),
+            stream=bool(d.get("stream", False)),
+            stop=stop,
+            presence_penalty=_get(d, "presence_penalty", (int, float), 0.0),
+            frequency_penalty=_get(d, "frequency_penalty", (int, float), 0.0),
+            repetition_penalty=_get(d, "repetition_penalty", (int, float), 1.0),
+            logprobs=bool(d.get("logprobs", False)),
+            top_logprobs=_get(d, "top_logprobs", int),
+            seed=_get(d, "seed", int),
+            ignore_eos=bool(d.get("ignore_eos", False)),
+            min_tokens=_get(d, "min_tokens", int, 0),
+            chat_template=d.get("chat_template"),
+            add_generation_prompt=bool(d.get("add_generation_prompt", True)),
+        )
+
+    def to_sampling_params(self, stream: bool) -> SamplingParams:
+        n_logprobs = None
+        if self.logprobs:
+            n_logprobs = self.top_logprobs or 1
+        return SamplingParams(
+            max_tokens=self.max_tokens,
+            temperature=float(self.temperature),
+            top_p=float(self.top_p),
+            top_k=self.top_k,
+            min_p=float(self.min_p),
+            stop=list(self.stop),
+            presence_penalty=float(self.presence_penalty),
+            frequency_penalty=float(self.frequency_penalty),
+            repetition_penalty=float(self.repetition_penalty),
+            logprobs=n_logprobs,
+            seed=self.seed,
+            ignore_eos=self.ignore_eos,
+            min_tokens=self.min_tokens,
+            output_kind=(
+                RequestOutputKind.DELTA if stream
+                else RequestOutputKind.FINAL_ONLY
+            ),
+        )
+
+
+def random_id(prefix: str) -> str:
+    return f"{prefix}-{uuid.uuid4().hex}"
+
+
+def now() -> int:
+    return int(time.time())

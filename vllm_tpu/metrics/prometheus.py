@@ -1,0 +1,171 @@
+"""Prometheus metrics (text exposition, no client-library dependency).
+
+Reference analog: ``vllm/v1/metrics/prometheus.py`` + the metric definitions
+in ``vllm/v1/metrics/loggers.py``; same metric names where they map, so
+vLLM dashboards point at this server unchanged.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any
+
+from vllm_tpu.core.sched_output import SchedulerStats
+
+
+class Counter:
+    def __init__(self, name: str, doc: str) -> None:
+        self.name, self.doc, self.value = name, doc, 0.0
+
+    def inc(self, v: float = 1.0) -> None:
+        self.value += v
+
+    def render(self) -> str:
+        return (
+            f"# HELP {self.name} {self.doc}\n# TYPE {self.name} counter\n"
+            f"{self.name} {self.value}\n"
+        )
+
+
+class Gauge:
+    def __init__(self, name: str, doc: str) -> None:
+        self.name, self.doc, self.value = name, doc, 0.0
+
+    def set(self, v: float) -> None:
+        self.value = v
+
+    def render(self) -> str:
+        return (
+            f"# HELP {self.name} {self.doc}\n# TYPE {self.name} gauge\n"
+            f"{self.name} {self.value}\n"
+        )
+
+
+class Histogram:
+    def __init__(self, name: str, doc: str, buckets: list[float]) -> None:
+        self.name, self.doc = name, doc
+        self.buckets = sorted(buckets)
+        self.counts = [0] * len(self.buckets)
+        self.total = 0
+        self.sum = 0.0
+
+    def observe(self, v: float) -> None:
+        self.total += 1
+        self.sum += v
+        for i, b in enumerate(self.buckets):
+            if v <= b:
+                self.counts[i] += 1
+
+    def render(self) -> str:
+        out = [
+            f"# HELP {self.name} {self.doc}",
+            f"# TYPE {self.name} histogram",
+        ]
+        for b, c in zip(self.buckets, self.counts):
+            out.append(f'{self.name}_bucket{{le="{b}"}} {c}')
+        out.append(f'{self.name}_bucket{{le="+Inf"}} {self.total}')
+        out.append(f"{self.name}_sum {self.sum}")
+        out.append(f"{self.name}_count {self.total}")
+        return "\n".join(out) + "\n"
+
+
+class PrometheusRegistry:
+    """StatLogger + /metrics renderer."""
+
+    def __init__(self, engine: Any = None) -> None:
+        self.num_running = Gauge(
+            "vllm:num_requests_running", "Number of running requests")
+        self.num_waiting = Gauge(
+            "vllm:num_requests_waiting", "Number of waiting requests")
+        self.kv_usage = Gauge(
+            "vllm:gpu_cache_usage_perc", "KV cache usage fraction")
+        self.prefix_queries = Counter(
+            "vllm:prefix_cache_queries", "Prefix-cache block queries")
+        self.prefix_hits = Counter(
+            "vllm:prefix_cache_hits", "Prefix-cache block hits")
+        self.preempted = Counter(
+            "vllm:num_preemptions", "Cumulative preemptions")
+        self.generation_tokens = Counter(
+            "vllm:generation_tokens", "Cumulative generated tokens")
+        self.prompt_tokens = Counter(
+            "vllm:prompt_tokens", "Cumulative prefilled tokens")
+        self.ttft = Histogram(
+            "vllm:time_to_first_token_seconds", "TTFT",
+            [0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0])
+        self.tpot = Histogram(
+            "vllm:time_per_output_token_seconds", "Inter-token latency",
+            [0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0])
+        self.e2e = Histogram(
+            "vllm:e2e_request_latency_seconds", "Request E2E latency",
+            [0.1, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0, 120.0])
+        self._metrics = [
+            self.num_running, self.num_waiting, self.kv_usage,
+            self.prefix_queries, self.prefix_hits, self.preempted,
+            self.generation_tokens, self.prompt_tokens,
+            self.ttft, self.tpot, self.e2e,
+        ]
+        self._last_prefix = (0, 0)
+
+    # StatLoggerBase interface -----------------------------------------
+
+    def record(self, scheduler_stats: SchedulerStats | None,
+               iteration_stats: Any | None = None) -> None:
+        if scheduler_stats is not None:
+            s = scheduler_stats
+            self.num_running.set(s.num_running_reqs)
+            self.num_waiting.set(s.num_waiting_reqs)
+            self.kv_usage.set(s.kv_cache_usage)
+            lq, lh = self._last_prefix
+            self.prefix_queries.inc(max(0, s.prefix_cache_queries - lq))
+            self.prefix_hits.inc(max(0, s.prefix_cache_hits - lh))
+            self._last_prefix = (s.prefix_cache_queries, s.prefix_cache_hits)
+            self.preempted.inc(s.num_preempted_reqs)
+        if iteration_stats is not None:
+            self.generation_tokens.inc(iteration_stats.num_generation_tokens)
+            self.prompt_tokens.inc(iteration_stats.num_prompt_tokens)
+            for t in iteration_stats.ttfts:
+                self.ttft.observe(t)
+            for t in iteration_stats.inter_token_latencies:
+                self.tpot.observe(t)
+            for t in iteration_stats.e2e_latencies:
+                self.e2e.observe(t)
+
+    def render(self) -> str:
+        return "".join(m.render() for m in self._metrics)
+
+
+class LoggingStatLogger:
+    """Console stats every `interval` seconds (reference:
+    ``v1/metrics/loggers.py:99 LoggingStatLogger``)."""
+
+    def __init__(self, interval: float = 10.0) -> None:
+        from vllm_tpu.logger import init_logger
+
+        self._logger = init_logger("vllm_tpu.metrics")
+        self.interval = interval
+        self._last = time.monotonic()
+        self._gen_tokens = 0
+        self._prompt_tokens = 0
+
+    def record(self, scheduler_stats: SchedulerStats | None,
+               iteration_stats: Any | None = None) -> None:
+        if iteration_stats is not None:
+            self._gen_tokens += iteration_stats.num_generation_tokens
+            self._prompt_tokens += iteration_stats.num_prompt_tokens
+        nowt = time.monotonic()
+        if nowt - self._last < self.interval or scheduler_stats is None:
+            return
+        dt = nowt - self._last
+        self._logger.info(
+            "tput: %.1f gen tok/s, %.1f prefill tok/s | running %d waiting %d"
+            " | kv %.1f%% | prefix hit %.1f%%",
+            self._gen_tokens / dt,
+            self._prompt_tokens / dt,
+            scheduler_stats.num_running_reqs,
+            scheduler_stats.num_waiting_reqs,
+            100 * scheduler_stats.kv_cache_usage,
+            100 * scheduler_stats.prefix_cache_hits
+            / max(1, scheduler_stats.prefix_cache_queries),
+        )
+        self._gen_tokens = self._prompt_tokens = 0
+        self._last = nowt

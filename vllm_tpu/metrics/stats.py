@@ -1,0 +1,15 @@
+"""Frontend iteration stats (reference: ``vllm/v1/metrics/stats.py``
+IterationStats — assembled client-side from engine-core outputs)."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+
+@dataclass
+class IterationStats:
+    num_generation_tokens: int = 0
+    num_prompt_tokens: int = 0
+    ttfts: list[float] = field(default_factory=list)
+    inter_token_latencies: list[float] = field(default_factory=list)
+    e2e_latencies: list[float] = field(default_factory=list)

@@ -12,13 +12,14 @@ This replaces the reference's CUDA paged attention + KV insert pipeline
   ``slot_mapping``; padded tokens target slot 0 (the null block, a write-only
   garbage page — never read).
 - The implementation here is pure XLA (gather + masked softmax), correct on
-  any backend and used for CPU tests; the Pallas flash-decode kernel in
+  any backend and used for CPU tests; the Pallas flash-decode kernel behind
   ``ops/ragged_paged_attention.py`` is the TPU fast path with identical
   semantics.
 
-KV cache layout per layer: ``[num_blocks, block_size, 2*KH, head_dim]``
-with K heads in ``[:KH]`` and V heads in ``[KH:]`` — one block's KV is a
-contiguous page, which is what the Pallas kernel DMAs per block-table entry.
+KV cache layout per layer: ``[num_blocks, block_size, 2*KH, head_dim]`` with
+K/V heads INTERLEAVED on axis 2 (``0::2`` = K, ``1::2`` = V) so one block's
+per-head K,V pair is contiguous — the layout the TPU flash kernel DMAs per
+block-table entry.
 """
 
 from __future__ import annotations
@@ -47,17 +48,20 @@ class AttentionMetadata:
     # [R] i32: index into [T] of each request's last scheduled token (rows
     # beyond the live request count point at 0 and are masked downstream).
     logits_indices: jnp.ndarray
+    num_seqs: jnp.ndarray  # [1] i32, live (unpadded) request count
 
 
 def write_kv(
-    kv_cache: jnp.ndarray,  # [NB, BS, 2*KH, D]
+    kv_cache: jnp.ndarray,  # [NB, BS, 2*KH, D] interleaved
     k: jnp.ndarray,  # [T, KH, D]
     v: jnp.ndarray,  # [T, KH, D]
     slot_mapping: jnp.ndarray,  # [T]
 ) -> jnp.ndarray:
-    """Scatter this step's K/V into their paged slots."""
+    """Scatter this step's K/V into their paged slots (interleaved heads)."""
     nb, bs, kh2, d = kv_cache.shape
-    kv_new = jnp.concatenate([k, v], axis=1)  # [T, 2KH, D]
+    t, kh, _ = k.shape
+    # [T, KH, 2, D] -> [T, 2KH, D] gives k0,v0,k1,v1,... along axis 1.
+    kv_new = jnp.stack([k, v], axis=2).reshape(t, kh2, d)
     flat = kv_cache.reshape(nb * bs, kh2, d)
     flat = flat.at[slot_mapping].set(kv_new.astype(kv_cache.dtype))
     return flat.reshape(nb, bs, kh2, d)
@@ -75,18 +79,22 @@ def paged_attention(
     elsewhere (and under VLLM_TPU_DISABLE_PALLAS)."""
     import vllm_tpu.envs as envs
 
-    if not envs.VLLM_TPU_DISABLE_PALLAS:
-        try:
-            from vllm_tpu.ops.ragged_paged_attention import ragged_paged_attention
+    # The flash kernel's m/l accumulators use 128-lane stores; head dims
+    # that don't fill a lane tile (e.g. 64) take the XLA path.
+    kernel_ok = q.shape[-1] % 128 == 0
+    if not envs.VLLM_TPU_DISABLE_PALLAS and kernel_ok and _on_tpu():
+        from vllm_tpu.ops.ragged_paged_attention import ragged_paged_attention
 
-            return ragged_paged_attention(
-                q, kv_cache, md, scale, sliding_window=sliding_window
-            )
-        except ImportError:
-            pass
+        return ragged_paged_attention(
+            q, kv_cache, md, scale, sliding_window=sliding_window
+        )
     return ref_ragged_paged_attention(
         q, kv_cache, md, scale, sliding_window=sliding_window
     )
+
+
+def _on_tpu() -> bool:
+    return jax.default_backend() == "tpu"
 
 
 def ref_ragged_paged_attention(
@@ -109,8 +117,8 @@ def ref_ragged_paged_attention(
     r, b = md.block_tables.shape
     ctx = b * bs
     kv_req = pages.reshape(r, ctx, kh2, d)
-    k_all = kv_req[:, :, :kh]
-    v_all = kv_req[:, :, kh:]
+    k_all = kv_req[:, :, 0::2]
+    v_all = kv_req[:, :, 1::2]
 
     # Per-token gather of the owning request's context.
     k_t = k_all[md.token_req_idx]  # [T, C, KH, D]

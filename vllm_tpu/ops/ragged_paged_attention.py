@@ -1,0 +1,48 @@
+"""TPU fast path: flash ragged paged attention (Pallas).
+
+Replaces ``csrc/attention/paged_attention_v1/v2.cu`` + the varlen
+FlashAttention call in the reference's CUDA backend
+(``vllm/v1/attention/backends/flash_attn.py:597``) with the tuned Pallas
+flash kernel that ships with JAX (``jax.experimental.pallas.ops.tpu.
+ragged_paged_attention``): online-softmax over KV pages DMA'd from HBM by
+block-table entry, mixed prefill+decode in one ragged launch, grid tuned per
+TPU generation.
+
+Our engine-side contract (``ops/attention.py AttentionMetadata``) maps 1:1
+onto the kernel's interface:
+  block_tables -> page_indices, seq_lens -> kv_lens,
+  query_start_loc -> cu_q_lens, num_seqs -> num_seqs;
+the interleaved ``[NB, BS, 2*KH, D]`` cache layout is exactly the kernel's
+``kv_pages`` layout. The kernel requires each request's scheduled tokens to
+be the last ``q_len`` of its ``kv_len`` context — which is precisely what
+chunked prefill + decode scheduling produces.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax.experimental.pallas.ops.tpu.ragged_paged_attention import (
+    ragged_paged_attention as _pallas_rpa,
+)
+
+from vllm_tpu.ops.attention import AttentionMetadata
+
+
+def ragged_paged_attention(
+    q: jnp.ndarray,  # [T, H, D]
+    kv_cache: jnp.ndarray,  # [NB, BS, 2*KH, D] interleaved
+    md: AttentionMetadata,
+    scale: float,
+    *,
+    sliding_window: int | None = None,
+) -> jnp.ndarray:
+    return _pallas_rpa(
+        q,
+        kv_cache,
+        md.seq_lens,
+        md.block_tables,
+        md.query_start_loc,
+        md.num_seqs,
+        sm_scale=scale,
+        sliding_window=sliding_window,
+    )

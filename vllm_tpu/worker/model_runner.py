@@ -242,6 +242,7 @@ class ModelRunner:
             query_start_loc=jnp.asarray(query_start_loc),
             token_req_idx=jnp.asarray(token_req_idx),
             logits_indices=jnp.asarray(logits_indices),
+            num_seqs=jnp.asarray([r_live], jnp.int32),
         )
 
         # Sampling metadata for the live rows.
