@@ -83,8 +83,10 @@ def main() -> None:
         for i in range(n_req)
     ]
 
-    # Warmup: compiles the step buckets.
-    llm.generate(prompts[:2], SamplingParams(temperature=0.0, max_tokens=4, ignore_eos=True))
+    # Warmup: one full dress-rehearsal pass so every (tokens, reqs, blocks)
+    # bucket the timed run touches is already compiled (first XLA compile of
+    # each bucket is 5-40s; the staggered prefill->decode ramp visits many).
+    llm.generate(prompts, params)
 
     t0 = time.monotonic()
     outs = llm.generate(prompts, params)

@@ -247,6 +247,14 @@ def test_iteration_stats_flow(async_engine):
                 pass
 
         asyncio.run(run())
+        # Stats are recorded by the engine thread just after delivering the
+        # final output; give it a beat.
+        import time
+
+        for _ in range(50):
+            if reg.e2e.total >= 1:
+                break
+            time.sleep(0.05)
         assert reg.generation_tokens.value >= 5
         assert reg.prompt_tokens.value >= 3
         assert reg.ttft.total >= 1

@@ -1,0 +1,135 @@
+"""Fused Mixture-of-Experts layer.
+
+Reference analog: ``vllm/model_executor/layers/fused_moe/`` — the CUDA stack
+there is a modular-kernel framework (routing topk ``csrc/moe/
+topk_softmax_kernels.cu``, token permute/align ``moe_align_sum_kernels.cu``,
+grouped GEMM experts, all2all dispatch managers). The TPU design collapses
+to two paths with one semantic:
+
+- **grouped path** (TPU): sort tokens by expert, megablox grouped matmul
+  (``jax.experimental.pallas.ops.tpu.megablox.gmm``) over the ragged groups,
+  unsort + weighted combine. This is the moe_align + grouped-GEMM pipeline
+  as one Pallas kernel family.
+- **dense path** (any backend, and the multi-device GSPMD path): one-hot
+  dispatch einsum over the expert axis. With experts sharded over a mesh
+  axis XLA turns the combine into the EP psum — the reference's all2all
+  prepare/finalize managers (``all2all.py``) become sharding annotations.
+
+Routing matches the reference semantics (softmax -> top-k -> optional
+renormalize; ``fused_moe/layer.py select_experts``).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def select_experts(
+    router_logits: jnp.ndarray,  # [T, E] (pre-softmax)
+    top_k: int,
+    renormalize: bool = True,
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Returns (weights [T, k] f32, expert_ids [T, k] i32)."""
+    probs = jax.nn.softmax(router_logits.astype(jnp.float32), axis=-1)
+    weights, ids = jax.lax.top_k(probs, top_k)
+    if renormalize:
+        weights = weights / jnp.sum(weights, axis=-1, keepdims=True)
+    return weights, ids.astype(jnp.int32)
+
+
+def _dense_moe(
+    hidden: jnp.ndarray,  # [T, D]
+    w_gate: jnp.ndarray,  # [E, D, F]
+    w_up: jnp.ndarray,  # [E, D, F]
+    w_down: jnp.ndarray,  # [E, F, D]
+    weights: jnp.ndarray,  # [T, k]
+    expert_ids: jnp.ndarray,  # [T, k]
+) -> jnp.ndarray:
+    """One-hot dispatch: every expert sees every token, masked combine.
+    FLOP-wasteful on one chip but exactly what GSPMD wants for EP: with
+    ``w_*`` sharded on the expert axis each device computes only its
+    experts and the combine lowers to a psum over the EP axis."""
+    e = w_gate.shape[0]
+    x = hidden.astype(w_gate.dtype)
+    # [T, E] combine weights (0 for non-selected experts).
+    onehot = jax.nn.one_hot(expert_ids, e, dtype=hidden.dtype)  # [T, k, E]
+    combine = jnp.einsum("tk,tke->te", weights.astype(hidden.dtype), onehot)
+
+    gate = jnp.einsum("td,edf->etf", x, w_gate)
+    up = jnp.einsum("td,edf->etf", x, w_up)
+    act = jax.nn.silu(gate) * up
+    out = jnp.einsum("etf,efd->etd", act, w_down)  # [E, T, D]
+    return jnp.einsum("etd,te->td", out, combine.astype(out.dtype))
+
+
+def _grouped_moe(
+    hidden: jnp.ndarray,
+    w_gate: jnp.ndarray,
+    w_up: jnp.ndarray,
+    w_down: jnp.ndarray,
+    weights: jnp.ndarray,
+    expert_ids: jnp.ndarray,
+    *,
+    interpret: bool = False,
+) -> jnp.ndarray:
+    """Sort-by-expert + megablox grouped matmul (single-device fast path)."""
+    from jax.experimental.pallas.ops.tpu.megablox import gmm
+
+    t, d = hidden.shape
+    e = w_gate.shape[0]
+    k = expert_ids.shape[1]
+    flat_experts = expert_ids.reshape(-1)  # [T*k]
+    order = jnp.argsort(flat_experts)  # stable
+    token_idx = order // k  # source token of each sorted slot
+    x_sorted = hidden[token_idx]  # [T*k, D]
+    group_sizes = jnp.bincount(flat_experts, length=e).astype(jnp.int32)
+
+    # gmm tiles rows by 128: pad the row dim, book the pad rows on the last
+    # group (their garbage output is dropped by the unsort gather below).
+    m = t * k
+    m_pad = -(-m // 128) * 128
+    if m_pad != m:
+        x_sorted = jnp.pad(x_sorted, ((0, m_pad - m), (0, 0)))
+        group_sizes = group_sizes.at[e - 1].add(m_pad - m)
+
+    mm = partial(gmm, preferred_element_type=jnp.float32, interpret=interpret)
+    gate = mm(x_sorted, w_gate, group_sizes)
+    up = mm(x_sorted, w_up, group_sizes)
+    act = (jax.nn.silu(gate) * up).astype(hidden.dtype)
+    out_sorted = mm(act, w_down, group_sizes).astype(jnp.float32)  # [M, D]
+
+    # Unsort and combine with routing weights.
+    inv = jnp.argsort(order)
+    out = out_sorted[inv].reshape(t, k, d)
+    return jnp.einsum(
+        "tkd,tk->td", out, weights.astype(jnp.float32)
+    ).astype(hidden.dtype)
+
+
+def fused_moe(
+    hidden: jnp.ndarray,  # [T, D]
+    router_weight: jnp.ndarray,  # [D, E]
+    w_gate: jnp.ndarray,  # [E, D, F]
+    w_up: jnp.ndarray,  # [E, D, F]
+    w_down: jnp.ndarray,  # [E, F, D]
+    top_k: int,
+    renormalize: bool = True,
+    use_grouped: bool | None = None,
+) -> jnp.ndarray:
+    """Router + experts + combine. ``use_grouped=None`` auto-selects the
+    megablox path on single-device TPU, dense one-hot otherwise."""
+    router_logits = hidden.astype(jnp.float32) @ router_weight.astype(jnp.float32)
+    weights, expert_ids = select_experts(router_logits, top_k, renormalize)
+    if use_grouped is None:
+        # Grouped megablox is the single-device fast path; under a multi-
+        # device mesh the dense one-hot path is the GSPMD/EP formulation.
+        use_grouped = (
+            jax.default_backend() == "tpu" and jax.device_count() == 1
+        )
+    if use_grouped:
+        return _grouped_moe(hidden, w_gate, w_up, w_down, weights, expert_ids)
+    return _dense_moe(hidden, w_gate, w_up, w_down, weights, expert_ids)

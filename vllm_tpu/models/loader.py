@@ -57,9 +57,11 @@ def load_safetensors_params(
     weight_map = model.hf_weight_map()
     L = model.num_layers
 
-    # dest leaf -> either array or list[L] of per-layer arrays.
+    # dest leaf -> either array, list[L] (layer-stacked), or dict
+    # (layer, expert) -> array (two-level stack, MoE experts).
     staged: dict[str, Any] = {}
     stacked: dict[str, list] = {}
+    stacked2: dict[str, dict] = {}
     seen = set()
 
     for file in _iter_safetensor_files(path):
@@ -73,10 +75,15 @@ def load_safetensors_params(
                     arr = arr.view(jnp.bfloat16)
                 if transpose:
                     arr = arr.T
-                parts = dest.rsplit(".", 1)
-                if len(parts) == 2 and parts[1].isdigit():
-                    base, idx = parts[0], int(parts[1])
-                    stacked.setdefault(base, [None] * L)[idx] = arr
+                parts = dest.split(".")
+                if len(parts) >= 3 and parts[-1].isdigit() and parts[-2].isdigit():
+                    base = ".".join(parts[:-2])
+                    stacked2.setdefault(base, {})[
+                        (int(parts[-2]), int(parts[-1]))
+                    ] = arr
+                elif len(parts) >= 2 and parts[-1].isdigit():
+                    base = ".".join(parts[:-1])
+                    stacked.setdefault(base, [None] * L)[int(parts[-1])] = arr
                 else:
                     staged[dest] = arr
                 seen.add(hf_name)
@@ -109,6 +116,14 @@ def load_safetensors_params(
     for base, arrs in stacked.items():
         assert all(a is not None for a in arrs), f"missing layers for {base}"
         put(base, np.stack(arrs, axis=0))
+    for base, items in stacked2.items():
+        n_outer = max(i for i, _ in items) + 1
+        n_inner = max(j for _, j in items) + 1
+        assert len(items) == n_outer * n_inner, f"missing entries for {base}"
+        put(base, np.stack([
+            np.stack([items[(i, j)] for j in range(n_inner)], axis=0)
+            for i in range(n_outer)
+        ], axis=0))
 
     n_params = sum(x.size for x in jax.tree.leaves(params))
     logger.info("loaded %d params (%.2f GB) from %s", n_params,

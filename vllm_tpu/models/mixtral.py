@@ -1,0 +1,145 @@
+"""Mixtral (sparse-MoE Llama-family decoder).
+
+Reference analog: ``vllm/model_executor/models/mixtral.py`` (MixtralMoE
+using the FusedMoE layer). Attention/norm/rope are inherited from the Llama
+graph; the dense MLP is replaced by the fused MoE layer with layer-stacked
+expert weights ``[L, E, ...]`` (scan layout, experts shardable over a mesh
+axis for EP — SURVEY.md §2.4).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from vllm_tpu.layers.layernorm import rms_norm
+from vllm_tpu.layers.moe import fused_moe
+from vllm_tpu.layers.rotary import _apply_rotate_half
+from vllm_tpu.models.llama import LlamaForCausalLM
+from vllm_tpu.ops.attention import AttentionMetadata, paged_attention, write_kv
+
+
+class MixtralForCausalLM(LlamaForCausalLM):
+    def __init__(self, hf_config: Any, dtype=jnp.bfloat16) -> None:
+        super().__init__(hf_config, dtype)
+        self.num_experts = hf_config.num_local_experts
+        self.top_k = hf_config.num_experts_per_tok
+        self.sliding_window = getattr(hf_config, "sliding_window", None)
+        # EP toggle: experts sharded over the tp axis (vLLM
+        # enable_expert_parallel semantics) vs FFN-dim sharding.
+        self.expert_parallel = False
+
+    # ------------------------------------------------------------------
+
+    def init_dummy_params(self, rng: jax.Array, dtype=None) -> dict:
+        import math
+
+        dtype = dtype or self.dtype
+        params = super().init_dummy_params(rng, dtype)
+        layers = params["layers"]
+        for name in ("wgate", "wup", "wdown"):
+            del layers[name]
+        L, D, F, E = (
+            self.num_layers,
+            self.hidden_size,
+            self.intermediate_size,
+            self.num_experts,
+        )
+        keys = jax.random.split(jax.random.fold_in(rng, 1), 4)
+
+        def init(key, shape, fan_in):
+            return (
+                jax.random.normal(key, shape, jnp.float32) / math.sqrt(fan_in)
+            ).astype(dtype)
+
+        layers["router"] = init(keys[0], (L, D, E), D)
+        layers["we_gate"] = init(keys[1], (L, E, D, F), D)
+        layers["we_up"] = init(keys[2], (L, E, D, F), D)
+        layers["we_down"] = init(keys[3], (L, E, F, D), F)
+        return params
+
+    def hf_weight_map(self) -> dict:
+        m = super().hf_weight_map()
+        # Drop dense-MLP entries; add router + per-expert weights.
+        for i in range(self.num_layers):
+            for name in ("gate_proj", "up_proj", "down_proj"):
+                m.pop(f"model.layers.{i}.mlp.{name}.weight", None)
+            m[f"model.layers.{i}.block_sparse_moe.gate.weight"] = (
+                f"layers.router.{i}", True)
+            for j in range(self.num_experts):
+                base = f"model.layers.{i}.block_sparse_moe.experts.{j}"
+                m[f"{base}.w1.weight"] = (f"layers.we_gate.{i}.{j}", True)
+                m[f"{base}.w3.weight"] = (f"layers.we_up.{i}.{j}", True)
+                m[f"{base}.w2.weight"] = (f"layers.we_down.{i}.{j}", True)
+        return m
+
+    # ------------------------------------------------------------------
+
+    def apply(
+        self,
+        params: dict,
+        kv_cache: jnp.ndarray,
+        input_ids: jnp.ndarray,
+        md: AttentionMetadata,
+    ) -> tuple[jnp.ndarray, jnp.ndarray]:
+        x = params["embed"][input_ids].astype(self.dtype)
+        t = x.shape[0]
+        H, KH, Dh = self.num_heads, self.num_kv_heads, self.head_dim
+        rope_cos, rope_sin = self.rope.cos, self.rope.sin
+
+        def layer_fn(x, inputs):
+            lp, kv = inputs
+            h = rms_norm(x, lp["input_norm"], self.rms_eps)
+            q = (h @ lp["wq"]).reshape(t, H, Dh)
+            k = (h @ lp["wk"]).reshape(t, KH, Dh)
+            v = (h @ lp["wv"]).reshape(t, KH, Dh)
+            cos = rope_cos[md.positions][:, None, :]
+            sin = rope_sin[md.positions][:, None, :]
+            q = _apply_rotate_half(q, cos, sin, self.rope.rotary_dim)
+            k = _apply_rotate_half(k, cos, sin, self.rope.rotary_dim)
+            kv = write_kv(kv, k, v, md.slot_mapping)
+            attn = paged_attention(
+                q, kv, md, self.scale, sliding_window=self.sliding_window
+            )
+            x = x + attn.reshape(t, H * Dh) @ lp["wo"]
+
+            h2 = rms_norm(x, lp["post_norm"], self.rms_eps)
+            moe_out = fused_moe(
+                h2,
+                lp["router"],
+                lp["we_gate"],
+                lp["we_up"],
+                lp["we_down"],
+                top_k=self.top_k,
+                use_grouped=None if not self.expert_parallel else False,
+            )
+            return x + moe_out, kv
+
+        x, new_kv = jax.lax.scan(layer_fn, x, (params["layers"], kv_cache))
+        x = rms_norm(x, params["final_norm"], self.rms_eps)
+        return x, new_kv
+
+    # ------------------------------------------------------------------
+
+    def param_shardings(self, data_axis: str | None = None, model_axis: str = "tp") -> dict:
+        out = super().param_shardings(data_axis, model_axis)
+        layers = out["layers"]
+        for name in ("wgate", "wup", "wdown"):
+            del layers[name]
+        tp = model_axis
+        layers["router"] = P(None, None, None)
+        if self.expert_parallel:
+            # EP: experts distributed over the tp axis, dense per-expert
+            # weights; combine becomes a psum over tp.
+            layers["we_gate"] = P(None, tp, None, None)
+            layers["we_up"] = P(None, tp, None, None)
+            layers["we_down"] = P(None, tp, None, None)
+        else:
+            # TP within every expert (Megatron FFN sharding).
+            layers["we_gate"] = P(None, None, None, tp)
+            layers["we_up"] = P(None, None, None, tp)
+            layers["we_down"] = P(None, None, tp, None)
+        return out

@@ -19,6 +19,7 @@ _REGISTRY: dict[str, tuple[str, str]] = {
     "LlamaForCausalLM": ("vllm_tpu.models.llama", "LlamaForCausalLM"),
     "MistralForCausalLM": ("vllm_tpu.models.llama", "MistralForCausalLM"),
     "Qwen2ForCausalLM": ("vllm_tpu.models.llama", "Qwen2ForCausalLM"),
+    "MixtralForCausalLM": ("vllm_tpu.models.mixtral", "MixtralForCausalLM"),
 }
 
 

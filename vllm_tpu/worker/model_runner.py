@@ -16,8 +16,6 @@ Step dataflow:
 from __future__ import annotations
 
 import bisect
-from dataclasses import dataclass
-from functools import partial
 from typing import Any
 
 import jax
@@ -32,14 +30,6 @@ from vllm_tpu.sample.sampler import SamplingMetadata, sample
 from vllm_tpu.worker.input_batch import InputBatch
 
 logger = init_logger(__name__)
-
-
-@jax.tree_util.register_dataclass
-@dataclass
-class StepInputs:
-    token_ids: jnp.ndarray  # [T] i32
-    md: AttentionMetadata
-    sampling: SamplingMetadata
 
 
 def _bucket(value: int, buckets: list[int]) -> int:
@@ -67,6 +57,12 @@ class ModelRunner:
         self.block_size = cache.block_size
 
         self.max_blocks_per_req = -(-sched.max_model_len // cache.block_size)
+        # Device-resident empty placeholders (avoid a per-step 0-byte upload;
+        # each device_put is a full tunnel/PCIe roundtrip).
+        self._empty_penalty = (
+            jnp.zeros((0, 0), jnp.int32),
+            jnp.zeros((0, 0), bool),
+        )
         self.input_batch = InputBatch(
             max_num_reqs=sched.max_num_seqs,
             max_model_len=sched.max_model_len,
@@ -111,6 +107,9 @@ class ModelRunner:
         self._step_fn = jax.jit(
             self._step,
             static_argnames=(
+                "t_pad",
+                "r_pad",
+                "b_pad",
                 "needs_penalties",
                 "needs_top_k",
                 "needs_top_p_min_p",
@@ -123,25 +122,78 @@ class ModelRunner:
     # Jitted step
     # ------------------------------------------------------------------
 
+    @staticmethod
+    def _unpack(ibuf, fbuf, counts, prompt_mask, t, r, b):
+        """Split the two packed host buffers back into metadata pytrees.
+
+        One contiguous i32 upload + one f32 upload per step instead of ~12
+        separate device_puts — host->device latency (not bandwidth) is the
+        cost on TPU hosts, so transfers are batched. Slices are static; XLA
+        folds them into the consumers.
+        """
+        o = 0
+
+        def take(n):
+            nonlocal o
+            out = ibuf[o : o + n]
+            o += n
+            return out
+
+        token_ids = take(t)
+        md = AttentionMetadata(
+            positions=take(t),
+            slot_mapping=take(t),
+            token_req_idx=take(t),
+            seq_lens=take(r),
+            query_start_loc=take(r + 1),
+            logits_indices=take(r),
+            num_seqs=take(1),
+            block_tables=take(r * b).reshape(r, b),
+        )
+        top_k = take(r)
+        prng_keys = jax.lax.bitcast_convert_type(
+            take(2 * r).reshape(r, 2), jnp.uint32
+        )
+        sampling = SamplingMetadata(
+            temperature=fbuf[0:r],
+            top_p=fbuf[r : 2 * r],
+            min_p=fbuf[2 * r : 3 * r],
+            presence_penalty=fbuf[3 * r : 4 * r],
+            frequency_penalty=fbuf[4 * r : 5 * r],
+            repetition_penalty=fbuf[5 * r : 6 * r],
+            top_k=top_k,
+            prng_keys=prng_keys,
+            output_token_counts=counts,
+            prompt_token_mask=prompt_mask,
+        )
+        return token_ids, md, sampling
+
     def _step(
         self,
         params,
         kv_cache,
-        inputs: StepInputs,
+        ibuf,
+        fbuf,
+        counts,
+        prompt_mask,
         *,
+        t_pad: int,
+        r_pad: int,
+        b_pad: int,
         needs_penalties: bool,
         needs_top_k: bool,
         needs_top_p_min_p: bool,
         num_logprobs: int,
     ):
-        hidden, kv_cache = self.model.apply(
-            params, kv_cache, inputs.token_ids, inputs.md
+        token_ids, md, sampling = self._unpack(
+            ibuf, fbuf, counts, prompt_mask, t_pad, r_pad, b_pad
         )
-        last = hidden[inputs.md.logits_indices]  # [R, D]
+        hidden, kv_cache = self.model.apply(params, kv_cache, token_ids, md)
+        last = hidden[md.logits_indices]  # [R, D]
         logits = self.model.compute_logits(params, last)  # [R, V] f32
         sampled, raw_logprobs = sample(
             logits,
-            inputs.sampling,
+            sampling,
             needs_penalties=needs_penalties,
             needs_top_k=needs_top_k,
             needs_top_p_min_p=needs_top_p_min_p,
@@ -203,15 +255,23 @@ class ModelRunner:
         )
         b_pad = _bucket(max(max_blocks, 1), self.block_buckets)
 
-        token_ids = np.zeros(t_pad, np.int32)
-        positions = np.zeros(t_pad, np.int32)
-        slot_mapping = np.zeros(t_pad, np.int32)
-        token_req_idx = np.full(t_pad, max(r_pad - 1, 0), np.int32)
-        seq_lens = np.zeros(r_pad, np.int32)
-        query_start_loc = np.zeros(r_pad + 1, np.int32)
-        logits_indices = np.zeros(r_pad, np.int32)
+        # Packed i32 buffer; layout must match _unpack.
+        t, r, b = t_pad, r_pad, b_pad
+        ibuf = np.zeros(4 * t + (r + 1) + 2 * r + r + 2 * r + 1 + r * b, np.int32)
+        token_ids = ibuf[0:t]
+        positions = ibuf[t : 2 * t]
+        slot_mapping = ibuf[2 * t : 3 * t]
+        token_req_idx = ibuf[3 * t : 4 * t]
+        o = 4 * t
+        seq_lens = ibuf[o : o + r]; o += r
+        query_start_loc = ibuf[o : o + r + 1]; o += r + 1
+        logits_indices = ibuf[o : o + r]; o += r
+        ibuf[o] = r_live; o += 1
+        block_tables = ibuf[o : o + r * b].reshape(r, b); o += r * b
+        top_k = ibuf[o : o + r]; o += r
+        prng = ibuf[o : o + 2 * r].view(np.uint32).reshape(r, 2)
+        token_req_idx[:] = max(r_pad - 1, 0)
         do_sample = np.zeros(r_pad, bool)
-        block_tables = np.zeros((r_pad, b_pad), np.int32)
 
         bs = self.block_size
         offset = 0
@@ -234,37 +294,26 @@ class ModelRunner:
             offset += n
         query_start_loc[r_live + 1 :] = offset
 
-        md = AttentionMetadata(
-            positions=jnp.asarray(positions),
-            slot_mapping=jnp.asarray(slot_mapping),
-            block_tables=jnp.asarray(block_tables),
-            seq_lens=jnp.asarray(seq_lens),
-            query_start_loc=jnp.asarray(query_start_loc),
-            token_req_idx=jnp.asarray(token_req_idx),
-            logits_indices=jnp.asarray(logits_indices),
-            num_seqs=jnp.asarray([r_live], jnp.int32),
-        )
-
-        # Sampling metadata for the live rows.
+        # Packed f32 sampling buffer: 6 R-vectors; layout must match _unpack.
         idx = np.asarray(rows, np.int64)
-        def gather(col, pad_value=0):
-            out = np.full(r_pad, pad_value, col.dtype)
-            if r_live:
-                out[:r_live] = col[idx]
-            return out
+        fbuf = np.zeros(6 * r, np.float32)
 
-        temperature = gather(batch.temperature)
-        top_k = gather(batch.top_k)
-        top_p = gather(batch.top_p, 1.0)
-        min_p = gather(batch.min_p)
-        presence = gather(batch.presence_penalty)
-        frequency = gather(batch.frequency_penalty)
-        repetition = gather(batch.repetition_penalty, 1.0)
-        seeds = gather(batch.seeds)
-        gen_counts = np.zeros(r_pad, np.uint32)
+        def gather_into(dst, col, pad_value=0):
+            dst[:] = pad_value
+            if r_live:
+                dst[:r_live] = col[idx]
+            return dst
+
+        temperature = gather_into(fbuf[0:r], batch.temperature)
+        top_p = gather_into(fbuf[r : 2 * r], batch.top_p, 1.0)
+        min_p = gather_into(fbuf[2 * r : 3 * r], batch.min_p)
+        presence = gather_into(fbuf[3 * r : 4 * r], batch.presence_penalty)
+        frequency = gather_into(fbuf[4 * r : 5 * r], batch.frequency_penalty)
+        repetition = gather_into(fbuf[5 * r : 6 * r], batch.repetition_penalty, 1.0)
+        gather_into(top_k, batch.top_k)
+        gather_into(prng[:, 0], batch.seeds)
         for i, row in enumerate(rows):
-            gen_counts[i] = batch.req_states[req_order[i]].generated
-        prng_keys = np.stack([seeds, gen_counts], axis=1)
+            prng[i, 1] = batch.req_states[req_order[i]].generated
 
         needs_penalties = bool(
             np.any(presence[:r_live] != 0)
@@ -272,36 +321,25 @@ class ModelRunner:
             or np.any(repetition[:r_live] != 1.0)
         )
         if needs_penalties:
-            counts, prompt_mask = self._penalty_tensors(rows, r_pad)
+            counts_np, mask_np = self._penalty_tensors(rows, r_pad)
+            counts, prompt_mask = jnp.asarray(counts_np), jnp.asarray(mask_np)
         else:
-            counts = np.zeros((0, 0), np.int32)
-            prompt_mask = np.zeros((0, 0), bool)
+            counts, prompt_mask = self._empty_penalty
 
-        sampling = SamplingMetadata(
-            temperature=jnp.asarray(temperature),
-            top_k=jnp.asarray(top_k),
-            top_p=jnp.asarray(top_p),
-            min_p=jnp.asarray(min_p),
-            presence_penalty=jnp.asarray(presence),
-            frequency_penalty=jnp.asarray(frequency),
-            repetition_penalty=jnp.asarray(repetition),
-            prng_keys=jnp.asarray(prng_keys),
-            output_token_counts=jnp.asarray(counts),
-            prompt_token_mask=jnp.asarray(prompt_mask),
-        )
-
+        num_logprobs = 0
+        if r_live:
+            num_logprobs = int(np.max(batch.num_logprobs[idx], initial=0))
+        dims = dict(t_pad=t_pad, r_pad=r_pad, b_pad=b_pad)
         flags = dict(
             needs_penalties=needs_penalties,
             needs_top_k=bool(np.any(top_k[:r_live] > 0)),
             needs_top_p_min_p=bool(
                 np.any(top_p[:r_live] < 1.0) or np.any(min_p[:r_live] > 0)
             ),
-            num_logprobs=int(np.max(gather(batch.num_logprobs)[:r_live], initial=0)),
+            num_logprobs=num_logprobs,
         )
-        inputs = StepInputs(
-            token_ids=jnp.asarray(token_ids), md=md, sampling=sampling
-        )
-        return inputs, req_order, do_sample[:r_live], flags
+        arrays = (jnp.asarray(ibuf), jnp.asarray(fbuf), counts, prompt_mask)
+        return arrays, req_order, do_sample[:r_live], dims | flags
 
     def _penalty_tensors(self, rows: list[int], r_pad: int):
         """[R, V] output-token counts + prompt-token mask, built host-side
@@ -325,9 +363,9 @@ class ModelRunner:
         self._update_states(so)
         if so.total_num_scheduled_tokens == 0:
             return ModelRunnerOutput()
-        inputs, req_order, do_sample, flags = self._prepare_inputs(so)
+        arrays, req_order, do_sample, flags = self._prepare_inputs(so)
         self.kv_cache, sampled, lp = self._step_fn(
-            self.params, self.kv_cache, inputs, **flags
+            self.params, self.kv_cache, *arrays, **flags
         )
         sampled_np = np.asarray(jax.device_get(sampled))
 
