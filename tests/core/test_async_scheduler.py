@@ -1,0 +1,151 @@
+"""Async (lag-1) scheduler unit tests.
+
+Reference analog: ``tests/v1/core/test_scheduler.py`` protocol — real
+Scheduler, synthetic requests, no model. Checks placeholder accounting,
+the lag-1 bound, preempt/resume interaction, and stale-step isolation.
+"""
+
+from __future__ import annotations
+
+import pytest
+
+from vllm_tpu.config import CacheConfig, SchedulerConfig
+from vllm_tpu.core.async_scheduler import AsyncScheduler
+from vllm_tpu.core.sched_output import ModelRunnerOutput
+from vllm_tpu.request import EngineCoreRequest, Request, RequestStatus
+from vllm_tpu.sampling_params import SamplingParams
+
+
+def make_scheduler(num_blocks=64, block_size=4, max_seqs=8, budget=64):
+    sched_cfg = SchedulerConfig(
+        max_num_batched_tokens=budget,
+        max_num_seqs=max_seqs,
+        max_model_len=128,
+        async_scheduling=True,
+    )
+    cache_cfg = CacheConfig(block_size=block_size)
+    cache_cfg.num_gpu_blocks = num_blocks
+    return AsyncScheduler(sched_cfg, cache_cfg)
+
+
+def make_request(rid: str, prompt_len: int, max_tokens: int = 16) -> Request:
+    core = EngineCoreRequest(
+        request_id=rid,
+        prompt_token_ids=list(range(prompt_len)),
+        sampling_params=SamplingParams(max_tokens=max_tokens, ignore_eos=True),
+    )
+    return Request.from_engine_core_request(core, None)
+
+
+def run_out(so, token: int = 7) -> ModelRunnerOutput:
+    """Runner output sampling `token` for every scheduled request (every
+    step in these tests completes its request's known tokens)."""
+    rids = list(so.num_scheduled_tokens)
+    return ModelRunnerOutput(
+        req_ids=rids,
+        sampled_token_ids=[[token] for _ in rids],
+    )
+
+
+def test_lag1_placeholder_accounting():
+    s = make_scheduler()
+    req = make_request("a", prompt_len=6)
+    s.add_request(req)
+
+    so1 = s.schedule()  # full prefill + sample
+    assert so1.num_scheduled_tokens == {"a": 6}
+    assert req.num_computed_tokens == 6
+    assert req.num_output_placeholders == 1
+
+    # Schedule ahead before so1's output: one pending-token decode.
+    so2 = s.schedule()
+    assert so2.num_scheduled_tokens == {"a": 1}
+    assert req.num_computed_tokens == 7
+    assert req.num_output_placeholders == 2
+
+    # Third schedule yields nothing (lag bound).
+    so3 = s.schedule()
+    assert so3.total_num_scheduled_tokens == 0
+
+    # so1's token materializes -> one more step can be scheduled.
+    s.update_from_output(so1, run_out(so1))
+    assert req.num_output_placeholders == 1
+    assert req.num_tokens == 7
+    so4 = s.schedule()
+    assert so4.num_scheduled_tokens == {"a": 1}
+
+
+def test_finish_while_in_flight_discards_stale_output():
+    s = make_scheduler()
+    req = make_request("a", prompt_len=4, max_tokens=1)
+    s.add_request(req)
+    so1 = s.schedule()
+    so2 = s.schedule()  # speculative extra decode, in flight
+    out1 = run_out(so1)
+    s.update_from_output(so1, out1)
+    # max_tokens=1 -> finished at so1's output; so2 is stale.
+    assert req.is_finished
+    assert "a" not in s.requests
+    # Stale step drains without crashing or resurrecting the request.
+    s.update_from_output(so2, run_out(so2))
+    assert "a" not in s.requests
+    assert not s.has_unfinished_requests()
+
+
+def test_id_reuse_isolated_from_stale_step():
+    s = make_scheduler()
+    req = make_request("a", prompt_len=4, max_tokens=1)
+    s.add_request(req)
+    so1 = s.schedule()
+    so2 = s.schedule()
+    s.update_from_output(so1, run_out(so1))
+    # New request reuses the id before the stale step drains.
+    req_b = make_request("a", prompt_len=3)
+    s.add_request(req_b)
+    s.update_from_output(so2, run_out(so2))
+    # The stale output must not advance or mutate the new request.
+    assert req_b.num_tokens == 3
+    assert req_b.num_output_placeholders == 0
+    assert req_b.num_computed_tokens == 0
+
+
+def test_preempted_with_inflight_token_waits_for_materialize():
+    s = make_scheduler(num_blocks=8, block_size=4, budget=32)
+    a = make_request("a", prompt_len=8)
+    s.add_request(a)
+    so1 = s.schedule()
+    assert a.num_output_placeholders == 1
+
+    # Preempt a while its sampled token is in flight.
+    s.running.remove(a)
+    s._preempt(a)
+    assert a.num_output_placeholders == 1  # preserved
+
+    # Resume guard: 'a' must not re-prefill before the token materializes.
+    so2 = s.schedule()
+    assert "a" not in so2.num_scheduled_tokens
+
+    s.update_from_output(so1, run_out(so1))
+    assert a.num_output_placeholders == 0
+    assert a.num_tokens == 9  # token preserved across preemption
+
+    so3 = s.schedule()
+    assert so3.num_scheduled_tokens == {"a": 9}  # full re-prefill
+
+
+def test_sync_mode_unchanged():
+    from vllm_tpu.core.scheduler import Scheduler
+
+    cfg = SchedulerConfig(max_num_batched_tokens=64, max_num_seqs=8,
+                          max_model_len=128, async_scheduling=False)
+    cache = CacheConfig(block_size=4)
+    cache.num_gpu_blocks = 64
+    s = Scheduler(cfg, cache)
+    req = make_request("a", prompt_len=6)
+    s.add_request(req)
+    so1 = s.schedule()
+    # Sync: computed does not advance until update.
+    assert req.num_computed_tokens == 0
+    assert req.num_output_placeholders == 0
+    s.update_from_output(so1, run_out(so1))
+    assert req.num_computed_tokens == 6

@@ -116,6 +116,9 @@ class SchedulerConfig:
     max_num_batched_tokens: int = 8192  # per-step token budget
     max_num_seqs: int = 256  # max concurrent requests in a step
     max_model_len: int = 8192  # mirrored from ModelConfig at finalize
+    # Lag-1 pipelined scheduling (schedule step N+1 before step N's tokens
+    # reach the host); forced off when spec decode is on.
+    async_scheduling: bool = True
     enable_chunked_prefill: bool = True
     # Long-prefill throttle (reference: long_prefill_token_threshold).
     long_prefill_token_threshold: int = 0

@@ -57,6 +57,12 @@ class SchedulerOutput:
     # Structured output: req_id -> row index into the grammar bitmask.
     structured_output_request_ids: dict[str, int] = field(default_factory=dict)
     grammar_bitmask: Any = None
+    # In-proc identity of each scheduled Request at schedule time. Async
+    # scheduling leaves steps in flight after a request finishes; if a NEW
+    # request reuses the id before the stale step drains, update_from_output
+    # must not attribute the stale output to it. (Scheduler-local; never
+    # crosses the wire — update runs in the scheduler's process.)
+    req_refs: dict[str, Any] = field(default_factory=dict)
 
     @property
     def num_reqs(self) -> int:

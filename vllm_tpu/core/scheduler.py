@@ -87,6 +87,10 @@ class RequestQueue:
 
 
 class Scheduler:
+    # Async subclass advances computed counts at schedule time instead of
+    # update time (lag-1 pipelining); several accounting paths branch on it.
+    async_scheduling = False
+
     def __init__(
         self,
         scheduler_config: SchedulerConfig,
@@ -137,7 +141,10 @@ class Scheduler:
                 continue
             if request.status == RequestStatus.RUNNING:
                 self.running.remove(request)
-            elif request.status == RequestStatus.WAITING:
+            elif request.status in (
+                RequestStatus.WAITING,
+                RequestStatus.PREEMPTED,  # preempted requests sit in waiting
+            ):
                 self.waiting.remove(request)
             request.status = status
             self._free_request(request)
@@ -170,11 +177,29 @@ class Scheduler:
         new_blocks_per_req: dict[str, list[int]] = {}
         preempted_in_step: set[str] = set()
 
+        # Start index (pre-step num_computed) per request; async scheduling
+        # advances num_computed_tokens at schedule time, so phase 3 must use
+        # these captured values, not the live counter.
+        starts: dict[str, int] = {}
+
         # Phase 1: running requests, in order (decode + in-flight prefills).
         req_index = 0
         while req_index < len(self.running) and token_budget > 0:
             request = self.running[req_index]
-            num_new_tokens = request.num_tokens_with_spec - request.num_computed_tokens
+            # Lag-1 bound: the runner's device-side token feedback reads the
+            # immediately previous step's sampled array, so at most two
+            # sampling steps may be in flight per request.
+            if request.num_output_placeholders >= 2:
+                req_index += 1
+                continue
+            # num_output_placeholders is 0 in sync mode; in async mode it
+            # lets a decode whose last token is still in flight be scheduled
+            # one position ahead (the runner feeds the token on device).
+            num_new_tokens = (
+                request.num_tokens_with_spec
+                + request.num_output_placeholders
+                - request.num_computed_tokens
+            )
             if self.config.long_prefill_token_threshold > 0:
                 num_new_tokens = min(
                     num_new_tokens, self.config.long_prefill_token_threshold
@@ -223,6 +248,8 @@ class Scheduler:
             new_blocks_per_req[request.request_id] = [
                 b.block_id for b in new_blocks
             ]
+            starts[request.request_id] = request.num_computed_tokens
+            self._after_schedule(request, num_new_tokens)
             req_index += 1
 
         # Phase 2: admit waiting requests.
@@ -232,6 +259,11 @@ class Scheduler:
             and len(self.running) < self.config.max_num_seqs
         ):
             request = self.waiting.peek()
+
+            # Async scheduling: a preempted request with an in-flight output
+            # token must wait for it to materialize before re-prefilling.
+            if request.num_output_placeholders > 0:
+                break
 
             # Structured-output grammar still compiling -> leave in queue.
             if request.use_structured_output and self.structured_output_manager:
@@ -296,6 +328,8 @@ class Scheduler:
                 )
             num_scheduled_tokens[request.request_id] = num_new_tokens
             token_budget -= num_new_tokens
+            starts[request.request_id] = request.num_computed_tokens
+            self._after_schedule(request, num_new_tokens)
 
         # Phase 3: cached-request records for already-running requests.
         for request in self.running:
@@ -310,7 +344,9 @@ class Scheduler:
             cached.resumed_from_preemption.append(False)
             cached.resumed_req_token_ids.append(None)
             cached.new_block_ids.append(new_blocks_per_req.get(req_id, []))
-            cached.num_computed_tokens.append(request.num_computed_tokens)
+            cached.num_computed_tokens.append(
+                starts.get(req_id, request.num_computed_tokens)
+            )
 
         total = sum(num_scheduled_tokens.values())
         output = SchedulerOutput(
@@ -320,14 +356,27 @@ class Scheduler:
             total_num_scheduled_tokens=total,
             scheduled_spec_decode_tokens=scheduled_spec_tokens,
             finished_req_ids=self.finished_req_ids,
+            req_refs={
+                rid: self.requests[rid] for rid in num_scheduled_tokens
+            },
         )
         self.finished_req_ids = set()
         return output
+
+    def _after_schedule(self, request: Request, num_new_tokens: int) -> None:
+        """Hook run right after a request is scheduled this step. The async
+        scheduler advances num_computed_tokens here (reference:
+        ``_update_after_schedule``); the sync scheduler advances in
+        update_from_output."""
 
     def _preempt(self, request: Request) -> None:
         self.kv_cache_manager.free(request)
         request.status = RequestStatus.PREEMPTED
         request.num_computed_tokens = 0
+        # num_output_placeholders is intentionally preserved: an in-flight
+        # sampled token still materializes via update_from_output, and the
+        # resume guard below waits for it (else the resumed prefill would
+        # re-sample an already-sampled position).
         request.num_preemptions += 1
         request.spec_token_ids = []
         self._num_preempted_in_step += 1
@@ -347,8 +396,13 @@ class Scheduler:
 
         for req_index, req_id in enumerate(runner_output.req_ids):
             request = self.requests.get(req_id)
-            if request is None:
-                continue  # finished externally between schedule and update
+            if request is None or (
+                scheduler_output.req_refs
+                and scheduler_output.req_refs.get(req_id) is not request
+            ):
+                # Finished externally between schedule and update, or the id
+                # was reused by a new request while this step was in flight.
+                continue
             num_tokens_scheduled = scheduler_output.num_scheduled_tokens.get(req_id)
             if num_tokens_scheduled is None:
                 continue
@@ -356,7 +410,12 @@ class Scheduler:
             generated = runner_output.sampled_token_ids[req_index]
             scheduled_spec = spec_scheduled.get(req_id, [])
 
-            request.num_computed_tokens += num_tokens_scheduled
+            if not self.async_scheduling:
+                request.num_computed_tokens += num_tokens_scheduled
+            elif generated:
+                request.num_output_placeholders = max(
+                    0, request.num_output_placeholders - len(generated)
+                )
             if scheduled_spec:
                 # Verification: len(generated) = accepted drafts + 1 bonus.
                 # Rejected draft positions hold garbage KV; roll computed
@@ -380,7 +439,12 @@ class Scheduler:
                 request.spec_token_ids = runner_output.draft_token_ids[req_id]
 
             if stopped:
-                self.running.remove(request)
+                # Async scheduling: the request may have been preempted
+                # between this step's dispatch and now (it sits in waiting).
+                if request in self.running:
+                    self.running.remove(request)
+                else:
+                    self.waiting.remove(request)
                 self._free_request(request)
 
             if new_token_ids or stopped:

@@ -47,6 +47,7 @@ class EngineArgs:
     max_num_seqs: int = 256
     enable_chunked_prefill: bool = True
     scheduling_policy: str = "fcfs"
+    async_scheduling: bool = True
 
     tensor_parallel_size: int = 1
     data_parallel_size: int = 1
@@ -102,6 +103,7 @@ class EngineArgs:
                 max_num_seqs=self.max_num_seqs,
                 enable_chunked_prefill=self.enable_chunked_prefill,
                 policy=self.scheduling_policy,  # type: ignore[arg-type]
+                async_scheduling=self.async_scheduling,
             ),
             device_config=DeviceConfig(device=self.device),  # type: ignore[arg-type]
             speculative_config=SpeculativeConfig(

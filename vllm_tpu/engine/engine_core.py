@@ -7,6 +7,7 @@ in-proc core both paths share.
 
 from __future__ import annotations
 
+from collections import deque
 from typing import Iterable
 
 from vllm_tpu.config import EngineConfig
@@ -28,7 +29,26 @@ class EngineCore:
         num_blocks = self.executor.initialize()
         config.cache_config.num_gpu_blocks = num_blocks
 
-        self.scheduler = Scheduler(
+        # Async (lag-1 pipelined) scheduling hides the device->host fetch
+        # behind the next step's compute. Spec decode needs draft tokens on
+        # the host between steps, so it forces the sync scheduler.
+        self.async_scheduling = (
+            config.scheduler_config.async_scheduling
+            and not config.speculative_config.enabled
+        )
+        scheduler_cls: type[Scheduler] = Scheduler
+        if self.async_scheduling:
+            from vllm_tpu.core.async_scheduler import AsyncScheduler
+
+            scheduler_cls = AsyncScheduler
+        self._inflight: deque = deque()
+        self._max_inflight = (
+            min(2, self.executor.max_concurrent_batches)
+            if self.async_scheduling
+            else 1
+        )
+
+        self.scheduler = scheduler_cls(
             config.scheduler_config,
             config.cache_config,
             structured_output_manager=self._make_structured_output_manager(),
@@ -52,13 +72,33 @@ class EngineCore:
         self.scheduler.finish_requests(request_ids, RequestStatus.FINISHED_ABORTED)
 
     def has_unfinished_requests(self) -> bool:
-        return self.scheduler.has_unfinished_requests()
+        return bool(self._inflight) or self.scheduler.has_unfinished_requests()
 
     def step(self) -> EngineCoreOutputs:
-        if not self.scheduler.has_unfinished_requests():
+        """One engine iteration.
+
+        Sync mode: schedule -> execute -> update (reference ``core.py:402``).
+        Async mode: keep up to 2 steps in flight — dispatch step N+1 before
+        fetching step N's tokens, so the host->device->host turnaround of a
+        step overlaps the next step's compute (reference
+        ``step_with_batch_queue`` core.py:443 + AsyncScheduler).
+        """
+        while (
+            len(self._inflight) < self._max_inflight
+            and self.scheduler.has_unfinished_requests()
+        ):
+            scheduler_output = self.scheduler.schedule()
+            if scheduler_output.total_num_scheduled_tokens == 0:
+                # Not dispatched: hand the drained finished ids back so the
+                # runner still drops those rows on the next dispatched step.
+                self.scheduler.finished_req_ids |= scheduler_output.finished_req_ids
+                break
+            handle = self.executor.dispatch(scheduler_output)
+            self._inflight.append((scheduler_output, handle))
+        if not self._inflight:
             return EngineCoreOutputs()
-        scheduler_output = self.scheduler.schedule()
-        runner_output = self.executor.execute_model(scheduler_output)
+        scheduler_output, handle = self._inflight.popleft()
+        runner_output = self.executor.finalize(handle)
         return self.scheduler.update_from_output(scheduler_output, runner_output)
 
     def reset_prefix_cache(self) -> bool:

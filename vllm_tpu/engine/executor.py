@@ -33,6 +33,18 @@ class Executor:
     def execute_model(self, scheduler_output: SchedulerOutput) -> ModelRunnerOutput:
         raise NotImplementedError
 
+    # Async pipelining (lag-1): dispatch enqueues device work and returns a
+    # handle; finalize syncs and returns the ModelRunnerOutput.
+    def dispatch(self, scheduler_output: SchedulerOutput) -> Any:
+        raise NotImplementedError
+
+    def finalize(self, handle: Any) -> ModelRunnerOutput:
+        raise NotImplementedError
+
+    @property
+    def max_concurrent_batches(self) -> int:
+        return 1
+
     def collective_rpc(self, method: str, *args: Any, **kwargs: Any) -> list[Any]:
         raise NotImplementedError
 
@@ -57,6 +69,18 @@ class UniProcExecutor(Executor):
 
     def execute_model(self, scheduler_output: SchedulerOutput) -> ModelRunnerOutput:
         return self.worker.execute_model(scheduler_output)
+
+    def dispatch(self, scheduler_output: SchedulerOutput) -> Any:
+        assert self.worker.runner is not None
+        return self.worker.runner.dispatch(scheduler_output)
+
+    def finalize(self, handle: Any) -> ModelRunnerOutput:
+        assert self.worker.runner is not None
+        return self.worker.runner.finalize(handle)
+
+    @property
+    def max_concurrent_batches(self) -> int:
+        return 2
 
     def collective_rpc(self, method: str, *args: Any, **kwargs: Any) -> list[Any]:
         fn: Callable = getattr(self.worker, method)

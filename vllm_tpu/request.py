@@ -88,6 +88,10 @@ class Request:
         self.num_cached_tokens = -1
         # Draft tokens proposed for this request, verified next step.
         self.spec_token_ids: list[int] = []
+        # Async scheduling: sampling steps dispatched but whose output token
+        # has not yet been materialized host-side (reference:
+        # v1/core/sched/async_scheduler.py num_output_placeholders).
+        self.num_output_placeholders = 0
         # Number of scheduler preemptions (stats).
         self.num_preemptions = 0
 

@@ -32,6 +32,22 @@ from vllm_tpu.worker.input_batch import InputBatch
 logger = init_logger(__name__)
 
 
+class StepHandle:
+    """A dispatched-but-not-fetched step (device arrays + row bookkeeping)."""
+
+    def __init__(self, req_order=None, do_sample=None, sampled=None, lp=None,
+                 row_states=None, empty: bool = False) -> None:
+        self.req_order = req_order or []
+        self.do_sample = do_sample
+        self.sampled = sampled
+        self.lp = lp
+        # CachedRequestState identities at dispatch time: finalize only folds
+        # a token into a row still owned by the same request instance (the
+        # id may have been reused while this step was in flight).
+        self.row_states = row_states or []
+        self.empty = empty
+
+
 def _bucket(value: int, buckets: list[int]) -> int:
     i = bisect.bisect_left(buckets, value)
     if i == len(buckets):
@@ -75,6 +91,15 @@ class ModelRunner:
         self.block_buckets = comp._pow2_buckets(
             min(16, self.max_blocks_per_req), self.max_blocks_per_req
         )
+        # Async-scheduling state: the previous dispatched step's sampled
+        # device array + its request->row mapping (token feedback source).
+        # _last_sampled is kept padded to the LARGEST request bucket so the
+        # jitted step sees one prev_sampled shape (else every bucket
+        # transition would recompile: current-bucket x previous-bucket).
+        self._last_sampled = None
+        self._max_r = self.request_buckets[-1]
+        self._zero_sampled = jnp.zeros(self._max_r, jnp.int32)
+        self._prev_rows: dict[str, int] = {}
 
         kv_shape = (
             model.num_layers,
@@ -154,6 +179,9 @@ class ModelRunner:
         prng_keys = jax.lax.bitcast_convert_type(
             take(2 * r).reshape(r, 2), jnp.uint32
         )
+        # Async scheduling: per-row index into the previous step's sampled
+        # array for rows whose input token is still in flight (-1 = none).
+        feedback = take(r)
         sampling = SamplingMetadata(
             temperature=fbuf[0:r],
             top_p=fbuf[r : 2 * r],
@@ -166,7 +194,7 @@ class ModelRunner:
             output_token_counts=counts,
             prompt_token_mask=prompt_mask,
         )
-        return token_ids, md, sampling
+        return token_ids, md, sampling, feedback
 
     def _step(
         self,
@@ -176,6 +204,7 @@ class ModelRunner:
         fbuf,
         counts,
         prompt_mask,
+        prev_sampled,
         *,
         t_pad: int,
         r_pad: int,
@@ -185,9 +214,19 @@ class ModelRunner:
         needs_top_p_min_p: bool,
         num_logprobs: int,
     ):
-        token_ids, md, sampling = self._unpack(
+        token_ids, md, sampling, feedback = self._unpack(
             ibuf, fbuf, counts, prompt_mask, t_pad, r_pad, b_pad
         )
+        # Device-side token feedback (async scheduling): a decode row whose
+        # input token was sampled by the still-in-flight previous step reads
+        # it straight from that step's device output — the host never waits.
+        needs_fb = feedback >= 0
+        prev_tok = prev_sampled[jnp.clip(feedback, 0, prev_sampled.shape[0] - 1)]
+        last_pos = jnp.maximum(md.query_start_loc[1:] - 1, 0)  # [r]
+        # Rows without feedback scatter out of bounds (dropped) so padded
+        # rows sharing a last_pos cannot clobber a live row's fed token.
+        idx = jnp.where(needs_fb, last_pos, t_pad)
+        token_ids = token_ids.at[idx].set(prev_tok, mode="drop")
         hidden, kv_cache = self.model.apply(params, kv_cache, token_ids, md)
         last = hidden[md.logits_indices]  # [R, D]
         logits = self.model.compute_logits(params, last)  # [R, V] f32
@@ -257,7 +296,9 @@ class ModelRunner:
 
         # Packed i32 buffer; layout must match _unpack.
         t, r, b = t_pad, r_pad, b_pad
-        ibuf = np.zeros(4 * t + (r + 1) + 2 * r + r + 2 * r + 1 + r * b, np.int32)
+        # seq_lens(r) + qsl(r+1) + logits_idx(r) + num_seqs(1) + bt(r*b)
+        # + top_k(r) + prng(2r) + feedback(r)
+        ibuf = np.zeros(4 * t + 6 * r + (r + 1) + 1 + r * b, np.int32)
         token_ids = ibuf[0:t]
         positions = ibuf[t : 2 * t]
         slot_mapping = ibuf[2 * t : 3 * t]
@@ -269,16 +310,27 @@ class ModelRunner:
         ibuf[o] = r_live; o += 1
         block_tables = ibuf[o : o + r * b].reshape(r, b); o += r * b
         top_k = ibuf[o : o + r]; o += r
-        prng = ibuf[o : o + 2 * r].view(np.uint32).reshape(r, 2)
+        prng = ibuf[o : o + 2 * r].view(np.uint32).reshape(r, 2); o += 2 * r
+        feedback = ibuf[o : o + r]
+        feedback[:] = -1
         token_req_idx[:] = max(r_pad - 1, 0)
         do_sample = np.zeros(r_pad, bool)
 
         bs = self.block_size
         offset = 0
+        pending_rows: list[int] = []
         for i, row in enumerate(rows):
             rid = req_order[i]
             n = num_sched[rid]
             start = int(batch.num_computed_tokens[row])
+            if start + n > int(batch.num_tokens[row]):
+                # Last token still in flight (async scheduling, lag 1):
+                # fed on device from the previous step's sampled array.
+                prev_row = self._prev_rows.get(rid, -1)
+                assert start + n == int(batch.num_tokens[row]) + 1 and prev_row >= 0, (
+                    rid, start, n, int(batch.num_tokens[row]), prev_row)
+                feedback[i] = prev_row
+                pending_rows.append(i)
             token_ids[offset : offset + n] = batch.token_ids[row, start : start + n]
             pos = np.arange(start, start + n, dtype=np.int32)
             positions[offset : offset + n] = pos
@@ -314,6 +366,10 @@ class ModelRunner:
         gather_into(prng[:, 0], batch.seeds)
         for i, row in enumerate(rows):
             prng[i, 1] = batch.req_states[req_order[i]].generated
+        for i in pending_rows:
+            # The in-flight token hasn't been appended yet; bump the PRNG
+            # counter so this step's Gumbel stream doesn't repeat.
+            prng[i, 1] += 1
 
         needs_penalties = bool(
             np.any(presence[:r_live] != 0)
@@ -359,24 +415,56 @@ class ModelRunner:
 
     # ------------------------------------------------------------------
 
-    def execute_model(self, so: SchedulerOutput) -> ModelRunnerOutput:
+    def dispatch(self, so: SchedulerOutput) -> "StepHandle":
+        """Upload inputs and enqueue the jitted step; returns immediately
+        with device-array handles (no host sync). The async engine pipeline
+        dispatches step N+1 before finalizing step N."""
         self._update_states(so)
         if so.total_num_scheduled_tokens == 0:
-            return ModelRunnerOutput()
+            return StepHandle(empty=True)
         arrays, req_order, do_sample, flags = self._prepare_inputs(so)
+        prev = self._last_sampled if self._last_sampled is not None else self._zero_sampled
         self.kv_cache, sampled, lp = self._step_fn(
-            self.params, self.kv_cache, *arrays, **flags
+            self.params, self.kv_cache, *arrays, prev, **flags
         )
-        sampled_np = np.asarray(jax.device_get(sampled))
+        self._last_sampled = (
+            sampled
+            if sampled.shape[0] == self._max_r
+            else jnp.pad(sampled, (0, self._max_r - sampled.shape[0]))
+        )
+        self._prev_rows = {rid: i for i, rid in enumerate(req_order)}
+        # Kick off the D2H copy now: it runs as soon as the step completes,
+        # so finalize()'s device_get is a no-op wait instead of paying the
+        # full host<->device round trip on the critical path.
+        sampled.copy_to_host_async()
+        if lp is not None:
+            for x in lp:
+                x.copy_to_host_async()
+        return StepHandle(
+            req_order=req_order, do_sample=do_sample, sampled=sampled, lp=lp,
+            row_states=[self.input_batch.req_states[r] for r in req_order],
+        )
+
+    def finalize(self, handle: "StepHandle") -> ModelRunnerOutput:
+        """Fetch the sampled tokens of a dispatched step and fold them into
+        host state (the only host<->device sync of the step)."""
+        if handle.empty:
+            return ModelRunnerOutput()
+        req_order, do_sample = handle.req_order, handle.do_sample
+        sampled_np = np.asarray(jax.device_get(handle.sampled))
+        lp_np = None
+        if handle.lp is not None:
+            lp_np = [np.asarray(jax.device_get(x)) for x in handle.lp]
 
         out = ModelRunnerOutput(req_ids=req_order)
-        lp_np = None
-        if lp is not None:
-            lp_np = [np.asarray(jax.device_get(x)) for x in lp]
         for i, rid in enumerate(req_order):
             if do_sample[i]:
                 tok = int(sampled_np[i])
-                self.input_batch.append_token(rid, tok)
+                # The request may have finished (async: stop detected while
+                # this step was in flight) and its row dropped — or even
+                # replaced by a new request reusing the id (identity check).
+                if self.input_batch.req_states.get(rid) is handle.row_states[i]:
+                    self.input_batch.append_token(rid, tok)
                 out.sampled_token_ids.append([tok])
             else:
                 out.sampled_token_ids.append([])
@@ -391,6 +479,9 @@ class ModelRunner:
                 sampled_logprobs=sampled_lp[: len(req_order)].tolist(),
             )
         return out
+
+    def execute_model(self, so: SchedulerOutput) -> ModelRunnerOutput:
+        return self.finalize(self.dispatch(so))
 
     # ------------------------------------------------------------------
 
