@@ -125,3 +125,24 @@ def test_max_tokens_one(llm):
         SamplingParams(temperature=0.0, max_tokens=1, ignore_eos=True),
     )
     assert len(out.outputs[0].token_ids) == 1
+
+
+def test_async_penalties_match_sync(tiny_llama):
+    """Async pipelining feeds the in-flight token device-side; penalties
+    must still count it (greedy + penalties => async == sync)."""
+    from vllm_tpu import LLM, SamplingParams
+
+    prompts = [{"prompt_token_ids": [5, 6, 7, 5, 6, 7, 5, 6]}]
+    params = SamplingParams(
+        temperature=0.0, max_tokens=12, ignore_eos=True,
+        repetition_penalty=1.3, presence_penalty=0.5, frequency_penalty=0.2,
+    )
+    res = {}
+    for mode in (True, False):
+        llm = LLM(
+            model=tiny_llama, dtype="float32", max_model_len=128,
+            block_size=16, num_gpu_blocks_override=64, max_num_seqs=8,
+            max_num_batched_tokens=128, async_scheduling=mode,
+        )
+        res[mode] = [o.outputs[0].token_ids for o in llm.generate(prompts, params)]
+    assert res[True] == res[False]

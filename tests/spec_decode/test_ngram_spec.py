@@ -1,0 +1,144 @@
+"""Spec decode tests: ngram proposer, rejection sampler, e2e equivalence.
+
+Reference analog: ``tests/v1/spec_decode/`` (proposer unit tests) +
+greedy-equivalence protocol (spec decode must not change greedy output).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from vllm_tpu.spec_decode.ngram_proposer import NgramProposer
+
+
+def test_ngram_basic_match():
+    p = NgramProposer(1, 3, num_speculative_tokens=3)
+    # history: ... [5 6] 9 9 [5 6] -> propose what followed [5 6]: 9 9
+    hist = np.array([1, 5, 6, 9, 9, 5, 6], np.int32)
+    assert p.propose(hist) == [9, 9, 5]
+
+
+def test_ngram_no_match():
+    p = NgramProposer(2, 3, 4)
+    assert p.propose(np.array([1, 2, 3, 4, 5], np.int32)) == []
+
+
+def test_ngram_prefers_longest_and_most_recent():
+    p = NgramProposer(1, 2, 2)
+    # bigram [7 8] occurs twice; most recent occurrence first.
+    hist = np.array([7, 8, 1, 7, 8, 2, 7, 8], np.int32)
+    assert p.propose(hist) == [2, 7]
+
+
+# ----------------------------------------------------------------------
+
+
+def _sampling_md(r, temperature):
+    from vllm_tpu.sample.sampler import SamplingMetadata
+
+    return SamplingMetadata(
+        temperature=jnp.full((r,), temperature, jnp.float32),
+        top_k=jnp.zeros((r,), jnp.int32),
+        top_p=jnp.ones((r,), jnp.float32),
+        min_p=jnp.zeros((r,), jnp.float32),
+        presence_penalty=jnp.zeros((r,), jnp.float32),
+        frequency_penalty=jnp.zeros((r,), jnp.float32),
+        repetition_penalty=jnp.ones((r,), jnp.float32),
+        prng_keys=jnp.stack(
+            [jnp.arange(r, dtype=jnp.uint32), jnp.zeros(r, jnp.uint32)], axis=1
+        ),
+        output_token_counts=jnp.zeros((0, 0), jnp.int32),
+        prompt_token_mask=jnp.zeros((0, 0), bool),
+    )
+
+
+def test_rejection_greedy_accept_all():
+    from vllm_tpu.sample.rejection_sampler import rejection_sample
+
+    r, s, v = 2, 3, 16
+    logits = np.full((r, s + 1, v), -10.0, np.float32)
+    targets = [[3, 5, 7, 9], [2, 4, 6, 8]]
+    for i in range(r):
+        for j in range(s + 1):
+            logits[i, j, targets[i][j]] = 10.0
+    drafts = jnp.asarray([t[:s] for t in targets], jnp.int32)
+    out, num = rejection_sample(
+        jnp.asarray(logits), drafts, jnp.full((r,), s, jnp.int32),
+        _sampling_md(r, 0.0), needs_top_k=False, needs_top_p_min_p=False,
+    )
+    np.testing.assert_array_equal(np.asarray(num), [s + 1, s + 1])
+    np.testing.assert_array_equal(np.asarray(out), targets)
+
+
+def test_rejection_greedy_first_mismatch():
+    from vllm_tpu.sample.rejection_sampler import rejection_sample
+
+    r, s, v = 1, 3, 16
+    logits = np.full((r, s + 1, v), -10.0, np.float32)
+    # target argmax: [3, 5, 7, 9]; drafts [3, 6, 7] -> accept 1, replace with 5
+    for j, t in enumerate([3, 5, 7, 9]):
+        logits[0, j, t] = 10.0
+    out, num = rejection_sample(
+        jnp.asarray(logits), jnp.asarray([[3, 6, 7]], jnp.int32),
+        jnp.asarray([3], jnp.int32), _sampling_md(r, 0.0),
+        needs_top_k=False, needs_top_p_min_p=False,
+    )
+    assert int(num[0]) == 2
+    assert np.asarray(out)[0, :2].tolist() == [3, 5]
+
+
+def test_rejection_random_statistics():
+    """Sampled rows: acceptance of draft d is ~p(d); output distribution
+    stays unbiased (d emitted with prob p(d) overall for a 2-token vocab)."""
+    from vllm_tpu.sample.rejection_sampler import rejection_sample
+
+    r, s, v = 512, 1, 2
+    p_draft = 0.7
+    logits = np.zeros((r, s + 1, v), np.float32)
+    logits[:, :, 0] = np.log(p_draft)
+    logits[:, :, 1] = np.log(1 - p_draft)
+    out, num = rejection_sample(
+        jnp.asarray(logits), jnp.zeros((r, s), jnp.int32),
+        jnp.full((r,), s, jnp.int32), _sampling_md(r, 1.0),
+        needs_top_k=False, needs_top_p_min_p=False,
+    )
+    out, num = np.asarray(out), np.asarray(num)
+    # First output token == draft (0) should appear with prob ~p_draft.
+    first = out[:, 0]
+    rate = (first == 0).mean()
+    assert abs(rate - p_draft) < 0.08, rate
+
+
+# ----------------------------------------------------------------------
+
+
+def test_e2e_greedy_spec_equals_no_spec(tmp_path):
+    from tests.models.utils import tiny_llama_dir
+    from vllm_tpu import LLM, SamplingParams
+
+    path = tiny_llama_dir(tmp_path / "ck")
+    prompts = [
+        # Repetitive prompts so the ngram proposer actually fires.
+        {"prompt_token_ids": [5, 6, 7, 5, 6, 7, 5, 6]},
+        {"prompt_token_ids": [9, 9, 9, 9, 9, 9]},
+        {"prompt_token_ids": [3, 1, 4, 1, 5, 9, 2, 6]},
+    ]
+    params = SamplingParams(temperature=0.0, max_tokens=16, ignore_eos=True)
+
+    results = {}
+    for use_spec in (False, True):
+        kwargs = dict(
+            dtype="float32", max_model_len=128, block_size=16,
+            num_gpu_blocks_override=64, max_num_seqs=8,
+            max_num_batched_tokens=128,
+        )
+        if use_spec:
+            kwargs.update(speculative_method="ngram", num_speculative_tokens=3)
+        llm = LLM(model=path, **kwargs)
+        outs = llm.generate(prompts, params)
+        results[use_spec] = [o.outputs[0].token_ids for o in outs]
+
+    assert results[True] == results[False]

@@ -1,0 +1,137 @@
+"""In-jit rejection sampler for speculative-decode verification.
+
+Reference analog: ``vllm/v1/sample/rejection_sampler.py:37`` (CUDA kernels
+there; one traced function here). Semantics:
+
+- Greedy rows (temperature 0): accept drafts while they match the target
+  argmax; the first mismatch is replaced by the target token. If all S
+  drafts match, the bonus token (target at the last position) is appended.
+- Sampling rows: drafts are deterministic proposals (n-gram lookup), i.e.
+  proposal q = one-hot, so draft j is accepted with probability
+  p_j(draft_j); on rejection the recovery token is sampled from p_j with
+  the draft token masked out (standard max(0, p-q) renormalization for a
+  one-hot q). All-accepted rows sample the bonus from the last position.
+
+Returns (out_tokens [R, S+1], num_out [R]): row i emits
+out_tokens[i, :num_out[i]].
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from vllm_tpu.sample.sampler import (
+    SamplingMetadata,
+    _mask_top_k,
+    _mask_top_p_min_p,
+    _NEG_INF,
+    apply_penalties,
+)
+
+
+def _per_pos_uniform(prng_keys: jnp.ndarray, s1: int) -> jnp.ndarray:
+    """[R, S+1] uniforms + [R, S+1] gumbel streams from per-row keys."""
+
+    def one(key_pair):
+        key = jax.random.PRNGKey(0)
+        key = jax.random.fold_in(key, key_pair[0])
+        key = jax.random.fold_in(key, key_pair[1])
+        ku, kg = jax.random.split(key)
+        return jax.random.uniform(ku, (s1,)), kg
+
+    return jax.vmap(one)(prng_keys)
+
+
+def rejection_sample(
+    logits: jnp.ndarray,  # [R, S+1, V] f32
+    draft_ids: jnp.ndarray,  # [R, S] i32
+    num_draft: jnp.ndarray,  # [R] i32, valid drafts per row
+    md: SamplingMetadata,
+    *,
+    needs_penalties: bool = False,
+    needs_top_k: bool,
+    needs_top_p_min_p: bool,
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    r, s1, v = logits.shape
+    s = s1 - 1
+    pos = jnp.arange(s1, dtype=jnp.int32)[None, :]  # [1, S+1]
+
+    if needs_penalties:
+        # Step-start token counts applied at every verify position (same
+        # granularity as the sync sampler, which also uses counts as of the
+        # step's start; intra-step accepted drafts are not re-counted).
+        from dataclasses import replace
+
+        md_rep = replace(
+            md,
+            repetition_penalty=jnp.repeat(md.repetition_penalty, s1, axis=0),
+            frequency_penalty=jnp.repeat(md.frequency_penalty, s1, axis=0),
+            presence_penalty=jnp.repeat(md.presence_penalty, s1, axis=0),
+            output_token_counts=jnp.repeat(md.output_token_counts, s1, axis=0),
+            prompt_token_mask=jnp.repeat(md.prompt_token_mask, s1, axis=0),
+        )
+        logits = apply_penalties(
+            logits.reshape(r * s1, v), md_rep
+        ).reshape(r, s1, v)
+
+    # Target (greedy) tokens per position.
+    tgt = jnp.argmax(logits, axis=-1).astype(jnp.int32)  # [R, S+1]
+
+    # Masked/scaled distribution per position for sampling rows.
+    greedy = md.temperature == 0.0
+    temp = jnp.where(greedy, 1.0, md.temperature)
+    scaled = logits / temp[:, None, None]
+    flat = scaled.reshape(r * s1, v)
+    rep = lambda x: jnp.repeat(x, s1, axis=0)  # noqa: E731 [R] -> [R*S1]
+    if needs_top_k:
+        flat = _mask_top_k(flat, rep(md.top_k))
+    if needs_top_p_min_p:
+        flat = _mask_top_p_min_p(flat, rep(md.top_p), rep(md.min_p))
+    probs = jax.nn.softmax(flat, axis=-1).reshape(r, s1, v)  # [R, S+1, V]
+
+    uniforms, gumbel_keys = _per_pos_uniform(md.prng_keys, s1)
+
+    # Acceptance per draft position.
+    draft_pad = jnp.concatenate(
+        [draft_ids, jnp.zeros((r, 1), jnp.int32)], axis=1
+    )  # [R, S+1] (last col unused)
+    p_draft = jnp.take_along_axis(probs, draft_pad[:, :, None], axis=2)[:, :, 0]
+    accept_random = uniforms < p_draft  # [R, S+1]
+    accept_greedy = draft_pad == tgt
+    accept = jnp.where(greedy[:, None], accept_greedy, accept_random)
+    valid = pos < num_draft[:, None]  # only real draft positions can accept
+    accept &= valid
+
+    # Number of leading accepted drafts.
+    acc = jnp.sum(jnp.cumprod(accept.astype(jnp.int32), axis=1), axis=1)  # [R]
+
+    # Replacement/bonus token at position `acc` for each row.
+    rec_probs = jnp.take_along_axis(
+        probs, acc[:, None, None], axis=1
+    )[:, 0]  # [R, V] distribution at the first non-accepted position
+    rec_draft = jnp.take_along_axis(draft_pad, acc[:, None], axis=1)[:, 0]
+    # Mask the rejected draft token out (only when acc < num_draft, i.e. an
+    # actual rejection; the bonus position keeps the full distribution).
+    rejected = acc < num_draft
+    rec_logits = jnp.log(jnp.clip(rec_probs, 1e-30, None))
+    rec_logits = jnp.where(
+        (jnp.arange(v)[None, :] == rec_draft[:, None]) & rejected[:, None],
+        _NEG_INF,
+        rec_logits,
+    )
+
+    def g_one(kg, row_pos):
+        key = jax.random.fold_in(kg, row_pos)
+        return jax.random.gumbel(key, (v,), jnp.float32)
+
+    noise = jax.vmap(g_one)(gumbel_keys, acc)
+    rec_random = jnp.argmax(rec_logits + noise, axis=-1).astype(jnp.int32)
+    rec_greedy = jnp.take_along_axis(tgt, acc[:, None], axis=1)[:, 0]
+    rec_tok = jnp.where(greedy, rec_greedy, rec_random)
+
+    # Assemble outputs: accepted drafts then the recovery/bonus token.
+    out = jnp.where(pos < acc[:, None], draft_pad, 0)
+    out = jnp.where(pos == acc[:, None], rec_tok[:, None], out)
+    num_out = acc + 1
+    return out, num_out

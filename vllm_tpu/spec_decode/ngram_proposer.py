@@ -1,0 +1,46 @@
+"""N-gram prompt-lookup draft proposer (host-side).
+
+Reference analog: ``vllm/v1/spec_decode/ngram_proposer.py:12`` — find the
+most recent occurrence of the trailing n-gram in the request's token
+history and propose the tokens that followed it. Pure host logic over the
+persistent batch's numpy token buffer; no device work.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+class NgramProposer:
+    def __init__(self, prompt_lookup_min: int = 1, prompt_lookup_max: int = 3,
+                 num_speculative_tokens: int = 4) -> None:
+        assert prompt_lookup_min >= 1
+        assert prompt_lookup_max >= prompt_lookup_min
+        self.min_n = prompt_lookup_min
+        self.max_n = prompt_lookup_max
+        self.k = num_speculative_tokens
+
+    def propose(self, token_ids: np.ndarray) -> list[int]:
+        """token_ids: 1-D history (prompt + generated). Returns up to k
+        draft tokens (empty when no n-gram match)."""
+        total = len(token_ids)
+        # Longest n first: more context -> higher acceptance.
+        for n in range(self.max_n, self.min_n - 1, -1):
+            if total < n + 1:
+                continue
+            suffix = token_ids[total - n:]
+            # Scan candidate positions right-to-left (most recent first);
+            # vectorized window compare.
+            windows = np.lib.stride_tricks.sliding_window_view(
+                token_ids[:-1], n
+            )  # [total-n, n]
+            # (The [:-1] slice above already excludes the trailing suffix
+            # matching itself: window starts only reach total-1-n.)
+            matches = np.nonzero((windows == suffix).all(axis=1))[0]
+            if len(matches) == 0:
+                continue
+            start = int(matches[-1]) + n
+            drafts = token_ids[start : start + self.k]
+            if len(drafts) > 0:
+                return [int(t) for t in drafts]
+        return []

@@ -36,11 +36,12 @@ class StepHandle:
     """A dispatched-but-not-fetched step (device arrays + row bookkeeping)."""
 
     def __init__(self, req_order=None, do_sample=None, sampled=None, lp=None,
-                 row_states=None, empty: bool = False) -> None:
+                 row_states=None, empty: bool = False, spec: bool = False) -> None:
         self.req_order = req_order or []
         self.do_sample = do_sample
-        self.sampled = sampled
+        self.sampled = sampled  # [R] ids, or (out_tokens [R,S+1], num_out [R])
         self.lp = lp
+        self.spec = spec
         # CachedRequestState identities at dispatch time: finalize only folds
         # a token into a row still owned by the same request instance (the
         # id may have been reused while this step was in flight).
@@ -101,6 +102,19 @@ class ModelRunner:
         self._zero_sampled = jnp.zeros(self._max_r, jnp.int32)
         self._prev_rows: dict[str, int] = {}
 
+        # Speculative decoding (ngram drafting is pure host logic; the
+        # verification rejection-sampler runs inside the jitted step).
+        spec = config.speculative_config
+        self.num_spec = spec.num_speculative_tokens if spec.enabled else 0
+        self.proposer = None
+        if spec.enabled and spec.method == "ngram":
+            from vllm_tpu.spec_decode.ngram_proposer import NgramProposer
+
+            self.proposer = NgramProposer(
+                spec.prompt_lookup_min, spec.prompt_lookup_max,
+                spec.num_speculative_tokens,
+            )
+
         kv_shape = (
             model.num_layers,
             num_kv_blocks,
@@ -139,6 +153,7 @@ class ModelRunner:
                 "needs_top_k",
                 "needs_top_p_min_p",
                 "num_logprobs",
+                "num_spec",
             ),
             donate_argnums=(1,),
         )
@@ -148,7 +163,7 @@ class ModelRunner:
     # ------------------------------------------------------------------
 
     @staticmethod
-    def _unpack(ibuf, fbuf, counts, prompt_mask, t, r, b):
+    def _unpack(ibuf, fbuf, counts, prompt_mask, t, r, b, num_spec=0):
         """Split the two packed host buffers back into metadata pytrees.
 
         One contiguous i32 upload + one f32 upload per step instead of ~12
@@ -165,6 +180,7 @@ class ModelRunner:
             return out
 
         token_ids = take(t)
+        s = num_spec
         md = AttentionMetadata(
             positions=take(t),
             slot_mapping=take(t),
@@ -182,6 +198,13 @@ class ModelRunner:
         # Async scheduling: per-row index into the previous step's sampled
         # array for rows whose input token is still in flight (-1 = none).
         feedback = take(r)
+        spec = None
+        if s > 0:
+            spec = dict(
+                num_draft=take(r),
+                draft_ids=take(r * s).reshape(r, s),
+                sample_pos=take(r * (s + 1)).reshape(r, s + 1),
+            )
         sampling = SamplingMetadata(
             temperature=fbuf[0:r],
             top_p=fbuf[r : 2 * r],
@@ -194,7 +217,7 @@ class ModelRunner:
             output_token_counts=counts,
             prompt_token_mask=prompt_mask,
         )
-        return token_ids, md, sampling, feedback
+        return token_ids, md, sampling, feedback, spec
 
     def _step(
         self,
@@ -213,9 +236,10 @@ class ModelRunner:
         needs_top_k: bool,
         needs_top_p_min_p: bool,
         num_logprobs: int,
+        num_spec: int = 0,
     ):
-        token_ids, md, sampling, feedback = self._unpack(
-            ibuf, fbuf, counts, prompt_mask, t_pad, r_pad, b_pad
+        token_ids, md, sampling, feedback, spec = self._unpack(
+            ibuf, fbuf, counts, prompt_mask, t_pad, r_pad, b_pad, num_spec
         )
         # Device-side token feedback (async scheduling): a decode row whose
         # input token was sampled by the still-in-flight previous step reads
@@ -227,7 +251,36 @@ class ModelRunner:
         # rows sharing a last_pos cannot clobber a live row's fed token.
         idx = jnp.where(needs_fb, last_pos, t_pad)
         token_ids = token_ids.at[idx].set(prev_tok, mode="drop")
+        if needs_penalties:
+            # The fed in-flight token isn't in the host-built counts yet;
+            # add it here so async penalties match sync semantics.
+            from dataclasses import replace as _replace
+
+            counts2 = sampling.output_token_counts.at[
+                jnp.arange(r_pad), prev_tok
+            ].add(needs_fb.astype(jnp.int32))
+            sampling = _replace(sampling, output_token_counts=counts2)
         hidden, kv_cache = self.model.apply(params, kv_cache, token_ids, md)
+        if num_spec > 0:
+            # Spec-decode verification: logits at every draft position plus
+            # the bonus position, rejection-sampled in one traced pass.
+            from vllm_tpu.sample.rejection_sampler import rejection_sample
+
+            r, s1 = spec["sample_pos"].shape
+            flat_pos = spec["sample_pos"].reshape(-1)
+            logits3 = self.model.compute_logits(
+                params, hidden[flat_pos]
+            ).reshape(r, s1, -1)
+            out_tokens, num_out = rejection_sample(
+                logits3,
+                spec["draft_ids"],
+                spec["num_draft"],
+                sampling,
+                needs_penalties=needs_penalties,
+                needs_top_k=needs_top_k,
+                needs_top_p_min_p=needs_top_p_min_p,
+            )
+            return kv_cache, (out_tokens, num_out), None
         last = hidden[md.logits_indices]  # [R, D]
         logits = self.model.compute_logits(params, last)  # [R, V] f32
         sampled, raw_logprobs = sample(
@@ -296,9 +349,17 @@ class ModelRunner:
 
         # Packed i32 buffer; layout must match _unpack.
         t, r, b = t_pad, r_pad, b_pad
+        # Spec sections appear only on steps that verify drafts (separate
+        # trace either way since num_spec is static).
+        spec_map = so.scheduled_spec_decode_tokens
+        s = self.num_spec if spec_map else 0
+        spec_len = (r + r * s + r * (s + 1)) if s else 0
         # seq_lens(r) + qsl(r+1) + logits_idx(r) + num_seqs(1) + bt(r*b)
-        # + top_k(r) + prng(2r) + feedback(r)
-        ibuf = np.zeros(4 * t + 6 * r + (r + 1) + 1 + r * b, np.int32)
+        # + top_k(r) + prng(2r) + feedback(r) [+ num_draft(r) + draft(r*s)
+        # + sample_pos(r*(s+1))]
+        ibuf = np.zeros(
+            4 * t + 6 * r + (r + 1) + 1 + r * b + spec_len, np.int32
+        )
         token_ids = ibuf[0:t]
         positions = ibuf[t : 2 * t]
         slot_mapping = ibuf[2 * t : 3 * t]
@@ -311,8 +372,12 @@ class ModelRunner:
         block_tables = ibuf[o : o + r * b].reshape(r, b); o += r * b
         top_k = ibuf[o : o + r]; o += r
         prng = ibuf[o : o + 2 * r].view(np.uint32).reshape(r, 2); o += 2 * r
-        feedback = ibuf[o : o + r]
+        feedback = ibuf[o : o + r]; o += r
         feedback[:] = -1
+        if s:
+            num_draft = ibuf[o : o + r]; o += r
+            draft_ids = ibuf[o : o + r * s].reshape(r, s); o += r * s
+            sample_pos = ibuf[o : o + r * (s + 1)].reshape(r, s + 1)
         token_req_idx[:] = max(r_pad - 1, 0)
         do_sample = np.zeros(r_pad, bool)
 
@@ -323,15 +388,41 @@ class ModelRunner:
             rid = req_order[i]
             n = num_sched[rid]
             start = int(batch.num_computed_tokens[row])
-            if start + n > int(batch.num_tokens[row]):
+            known = int(batch.num_tokens[row])
+            drafts = spec_map.get(rid) if s else None
+            if drafts:
+                # Draft tokens being verified run as regular input tokens
+                # after the known prefix; every draft position plus the
+                # bonus position gets sampled.
+                n_known = min(n, known - start)
+                nd = min(len(drafts), n - n_known)
+                token_ids[offset : offset + n_known] = (
+                    batch.token_ids[row, start : start + n_known]
+                )
+                token_ids[offset + n_known : offset + n] = drafts[:nd]
+                num_draft[i] = nd
+                base = offset + n - 1 - nd
+                sample_pos[i, : nd + 1] = np.arange(base, base + nd + 1)
+                sample_pos[i, nd + 1 :] = base + nd
+            elif start + n > known:
                 # Last token still in flight (async scheduling, lag 1):
                 # fed on device from the previous step's sampled array.
                 prev_row = self._prev_rows.get(rid, -1)
-                assert start + n == int(batch.num_tokens[row]) + 1 and prev_row >= 0, (
-                    rid, start, n, int(batch.num_tokens[row]), prev_row)
+                assert start + n == known + 1 and prev_row >= 0, (
+                    rid, start, n, known, prev_row)
                 feedback[i] = prev_row
                 pending_rows.append(i)
-            token_ids[offset : offset + n] = batch.token_ids[row, start : start + n]
+                token_ids[offset : offset + n] = (
+                    batch.token_ids[row, start : start + n]
+                )
+                if s:
+                    sample_pos[i, :] = offset + n - 1
+            else:
+                token_ids[offset : offset + n] = (
+                    batch.token_ids[row, start : start + n]
+                )
+                if s:
+                    sample_pos[i, :] = offset + n - 1
             pos = np.arange(start, start + n, dtype=np.int32)
             positions[offset : offset + n] = pos
             bt_row = batch.block_table[row]
@@ -383,7 +474,7 @@ class ModelRunner:
             counts, prompt_mask = self._empty_penalty
 
         num_logprobs = 0
-        if r_live:
+        if r_live and not s:
             num_logprobs = int(np.max(batch.num_logprobs[idx], initial=0))
         dims = dict(t_pad=t_pad, r_pad=r_pad, b_pad=b_pad)
         flags = dict(
@@ -393,6 +484,7 @@ class ModelRunner:
                 np.any(top_p[:r_live] < 1.0) or np.any(min_p[:r_live] > 0)
             ),
             num_logprobs=num_logprobs,
+            num_spec=s,
         )
         arrays = (jnp.asarray(ibuf), jnp.asarray(fbuf), counts, prompt_mask)
         return arrays, req_order, do_sample[:r_live], dims | flags
@@ -427,22 +519,26 @@ class ModelRunner:
         self.kv_cache, sampled, lp = self._step_fn(
             self.params, self.kv_cache, *arrays, prev, **flags
         )
-        self._last_sampled = (
-            sampled
-            if sampled.shape[0] == self._max_r
-            else jnp.pad(sampled, (0, self._max_r - sampled.shape[0]))
-        )
-        self._prev_rows = {rid: i for i, rid in enumerate(req_order)}
+        is_spec = flags["num_spec"] > 0
+        if not is_spec:
+            self._last_sampled = (
+                sampled
+                if sampled.shape[0] == self._max_r
+                else jnp.pad(sampled, (0, self._max_r - sampled.shape[0]))
+            )
+            self._prev_rows = {rid: i for i, rid in enumerate(req_order)}
         # Kick off the D2H copy now: it runs as soon as the step completes,
         # so finalize()'s device_get is a no-op wait instead of paying the
         # full host<->device round trip on the critical path.
-        sampled.copy_to_host_async()
+        for x in sampled if is_spec else (sampled,):
+            x.copy_to_host_async()
         if lp is not None:
             for x in lp:
                 x.copy_to_host_async()
         return StepHandle(
             req_order=req_order, do_sample=do_sample, sampled=sampled, lp=lp,
             row_states=[self.input_batch.req_states[r] for r in req_order],
+            spec=is_spec,
         )
 
     def finalize(self, handle: "StepHandle") -> ModelRunnerOutput:
@@ -451,7 +547,11 @@ class ModelRunner:
         if handle.empty:
             return ModelRunnerOutput()
         req_order, do_sample = handle.req_order, handle.do_sample
-        sampled_np = np.asarray(jax.device_get(handle.sampled))
+        if handle.spec:
+            out_tokens = np.asarray(jax.device_get(handle.sampled[0]))
+            num_out = np.asarray(jax.device_get(handle.sampled[1]))
+        else:
+            sampled_np = np.asarray(jax.device_get(handle.sampled))
         lp_np = None
         if handle.lp is not None:
             lp_np = [np.asarray(jax.device_get(x)) for x in handle.lp]
@@ -459,13 +559,32 @@ class ModelRunner:
         out = ModelRunnerOutput(req_ids=req_order)
         for i, rid in enumerate(req_order):
             if do_sample[i]:
-                tok = int(sampled_np[i])
+                toks = (
+                    [int(x) for x in out_tokens[i, : num_out[i]]]
+                    if handle.spec
+                    else [int(sampled_np[i])]
+                )
                 # The request may have finished (async: stop detected while
                 # this step was in flight) and its row dropped — or even
                 # replaced by a new request reusing the id (identity check).
                 if self.input_batch.req_states.get(rid) is handle.row_states[i]:
-                    self.input_batch.append_token(rid, tok)
-                out.sampled_token_ids.append([tok])
+                    for tok in toks:
+                        self.input_batch.append_token(rid, tok)
+                    # Logprobs aren't emitted on draft-carrying steps (the
+                    # scheduler's per-token logprob contract is single-token)
+                    # so logprob-requesting requests opt out of drafting.
+                    wants_logprobs = (
+                        handle.row_states[i].sampling_params.logprobs is not None
+                    )
+                    if self.proposer is not None and not wants_logprobs:
+                        row = self.input_batch.row_of(rid)
+                        n_tok = int(self.input_batch.num_tokens[row])
+                        drafts = self.proposer.propose(
+                            self.input_batch.token_ids[row, :n_tok]
+                        )
+                        if drafts:
+                            out.draft_token_ids[rid] = drafts
+                out.sampled_token_ids.append(toks)
             else:
                 out.sampled_token_ids.append([])
         if lp_np is not None:
