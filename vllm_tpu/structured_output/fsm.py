@@ -1,0 +1,304 @@
+"""Character-level regex -> DFA compiler (self-contained).
+
+Reference analog: the role xgrammar/outlines-core play for
+``vllm/v1/structured_output/`` — this build carries no grammar dependency,
+so a compact Thompson-construction NFA + subset-construction DFA over a
+practical regex subset lives here:
+
+  literals, escapes (\\d \\w \\s \\n \\t \\. and punct), ``.``,
+  char classes ``[a-z^...]``, grouping ``( )``, alternation ``|``,
+  quantifiers ``* + ? {m} {m,} {m,n}``.
+
+States are dense ints; the DFA exposes ``step(state, char) -> state|-1``
+and ``is_accept(state)`` — what the token-level backend needs to walk
+vocabulary strings.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+EPS = None  # epsilon edge marker
+
+
+@dataclass
+class _NFA:
+    start: int
+    accept: int
+
+
+class _Builder:
+    """Recursive-descent regex parser emitting an epsilon-NFA."""
+
+    def __init__(self, pattern: str) -> None:
+        self.p = pattern
+        self.i = 0
+        # edges[state] = list[(charset|EPS, dst)]; charset = frozenset of chars
+        self.edges: list[list] = []
+
+    def new_state(self) -> int:
+        self.edges.append([])
+        return len(self.edges) - 1
+
+    def add_edge(self, a: int, label, b: int) -> None:
+        self.edges[a].append((label, b))
+
+    # ---- grammar: alt -> concat ('|' concat)* ; concat -> rep* ;
+    #      rep -> atom quant? ; atom -> char | class | '(' alt ')' | '.'
+
+    def parse(self) -> _NFA:
+        nfa = self._alt()
+        if self.i != len(self.p):
+            raise ValueError(f"regex parse error at {self.i}: {self.p!r}")
+        return nfa
+
+    def _peek(self) -> str | None:
+        return self.p[self.i] if self.i < len(self.p) else None
+
+    def _alt(self) -> _NFA:
+        branches = [self._concat()]
+        while self._peek() == "|":
+            self.i += 1
+            branches.append(self._concat())
+        if len(branches) == 1:
+            return branches[0]
+        s, a = self.new_state(), self.new_state()
+        for b in branches:
+            self.add_edge(s, EPS, b.start)
+            self.add_edge(b.accept, EPS, a)
+        return _NFA(s, a)
+
+    def _concat(self) -> _NFA:
+        parts: list[_NFA] = []
+        while self._peek() is not None and self._peek() not in "|)":
+            parts.append(self._rep())
+        if not parts:
+            s = self.new_state()
+            return _NFA(s, s)
+        for x, y in zip(parts, parts[1:]):
+            self.add_edge(x.accept, EPS, y.start)
+        return _NFA(parts[0].start, parts[-1].accept)
+
+    def _rep(self) -> _NFA:
+        atom = self._atom()
+        c = self._peek()
+        if c == "*":
+            self.i += 1
+            s, a = self.new_state(), self.new_state()
+            self.add_edge(s, EPS, atom.start)
+            self.add_edge(s, EPS, a)
+            self.add_edge(atom.accept, EPS, atom.start)
+            self.add_edge(atom.accept, EPS, a)
+            return _NFA(s, a)
+        if c == "+":
+            self.i += 1
+            a = self.new_state()
+            self.add_edge(atom.accept, EPS, atom.start)
+            self.add_edge(atom.accept, EPS, a)
+            return _NFA(atom.start, a)
+        if c == "?":
+            self.i += 1
+            s, a = self.new_state(), self.new_state()
+            self.add_edge(s, EPS, atom.start)
+            self.add_edge(s, EPS, a)
+            self.add_edge(atom.accept, EPS, a)
+            return _NFA(s, a)
+        if c == "{":
+            j = self.p.index("}", self.i)
+            spec = self.p[self.i + 1 : j]
+            self.i = j + 1
+            if "," in spec:
+                lo_s, hi_s = spec.split(",", 1)
+                lo, hi = int(lo_s), (int(hi_s) if hi_s else None)
+            else:
+                lo = hi = int(spec)
+            return self._repeat(atom, lo, hi)
+        return atom
+
+    def _clone(self, nfa: _NFA) -> _NFA:
+        """Deep-copy a sub-NFA (for {m,n} expansion)."""
+        reach = set()
+        stack = [nfa.start]
+        while stack:
+            s = stack.pop()
+            if s in reach:
+                continue
+            reach.add(s)
+            for _, d in self.edges[s]:
+                stack.append(d)
+        remap = {s: self.new_state() for s in sorted(reach)}
+        for s in reach:
+            for label, d in list(self.edges[s]):
+                if d in remap:
+                    self.add_edge(remap[s], label, remap[d])
+        return _NFA(remap[nfa.start], remap[nfa.accept])
+
+    def _repeat(self, atom: _NFA, lo: int, hi: int | None) -> _NFA:
+        parts = [atom] + [self._clone(atom) for _ in range(max(lo, 1) - 1)]
+        if hi is None:  # {m,} -> m copies, last one looping
+            last = parts[-1]
+            self.add_edge(last.accept, EPS, last.start)
+        else:
+            for _ in range(hi - lo):
+                parts.append(self._clone(atom))
+        s = self.new_state()
+        a = self.new_state()
+        self.add_edge(s, EPS, parts[0].start)
+        if lo == 0:
+            self.add_edge(s, EPS, a)
+        for idx, part in enumerate(parts):
+            nxt = parts[idx + 1] if idx + 1 < len(parts) else None
+            if nxt is not None:
+                self.add_edge(part.accept, EPS, nxt.start)
+            if idx + 1 >= lo:
+                self.add_edge(part.accept, EPS, a)
+        return _NFA(s, a)
+
+    _CLASSES = {
+        "d": frozenset("0123456789"),
+        "w": frozenset(
+            "abcdefghijklmnopqrstuvwxyzABCDEFGHIJKLMNOPQRSTUVWXYZ0123456789_"
+        ),
+        "s": frozenset(" \t\n\r\f\v"),
+        "n": frozenset("\n"),
+        "t": frozenset("\t"),
+        "r": frozenset("\r"),
+    }
+    # Printable ASCII + common whitespace as the "." / negation universe.
+    UNIVERSE = frozenset(chr(c) for c in range(32, 127)) | frozenset("\t\n\r")
+
+    def _escape(self) -> frozenset:
+        c = self.p[self.i]
+        self.i += 1
+        if c in self._CLASSES:
+            return self._CLASSES[c]
+        if c in ("D", "W", "S"):
+            return self.UNIVERSE - self._CLASSES[c.lower()]
+        return frozenset(c)
+
+    def _char_class(self) -> frozenset:
+        assert self.p[self.i] == "["
+        self.i += 1
+        negate = self._peek() == "^"
+        if negate:
+            self.i += 1
+        chars: set = set()
+        first = True
+        while self._peek() != "]" or first:
+            first = False
+            c = self.p[self.i]
+            self.i += 1
+            if c == "\\":
+                chars |= self._escape()
+                continue
+            if self._peek() == "-" and self.i + 1 < len(self.p) and self.p[self.i + 1] != "]":
+                hi = self.p[self.i + 1]
+                self.i += 2
+                chars |= {chr(x) for x in range(ord(c), ord(hi) + 1)}
+            else:
+                chars.add(c)
+        self.i += 1  # ']'
+        return frozenset(self.UNIVERSE - chars if negate else chars)
+
+    def _atom(self) -> _NFA:
+        c = self.p[self.i]
+        if c == "(":
+            self.i += 1
+            inner = self._alt()
+            assert self.p[self.i] == ")", f"unbalanced paren at {self.i}"
+            self.i += 1
+            return inner
+        s, a = self.new_state(), self.new_state()
+        if c == ".":
+            self.i += 1
+            self.add_edge(s, self.UNIVERSE, a)
+        elif c == "[":
+            self.add_edge(s, self._char_class(), a)
+        elif c == "\\":
+            self.i += 1
+            self.add_edge(s, self._escape(), a)
+        else:
+            self.i += 1
+            self.add_edge(s, frozenset(c), a)
+        return _NFA(s, a)
+
+
+class DFA:
+    """Subset-construction DFA with dense transition dicts."""
+
+    def __init__(self, pattern: str) -> None:
+        b = _Builder(pattern)
+        nfa = b.parse()
+        edges = b.edges
+
+        def eps_closure(states: frozenset) -> frozenset:
+            stack, seen = list(states), set(states)
+            while stack:
+                s = stack.pop()
+                for label, d in edges[s]:
+                    if label is EPS and d not in seen:
+                        seen.add(d)
+                        stack.append(d)
+            return frozenset(seen)
+
+        start = eps_closure(frozenset([nfa.start]))
+        self.transitions: list[dict[str, int]] = []
+        self.accepts: list[bool] = []
+        index = {start: 0}
+        self.transitions.append({})
+        self.accepts.append(nfa.accept in start)
+        work = [start]
+        while work:
+            cur = work.pop()
+            ci = index[cur]
+            # char -> set of nfa states
+            moves: dict[str, set] = {}
+            for s in cur:
+                for label, d in edges[s]:
+                    if label is EPS:
+                        continue
+                    for ch in label:
+                        moves.setdefault(ch, set()).add(d)
+            for ch, dsts in moves.items():
+                nxt = eps_closure(frozenset(dsts))
+                if nxt not in index:
+                    index[nxt] = len(self.transitions)
+                    self.transitions.append({})
+                    self.accepts.append(nfa.accept in nxt)
+                    work.append(nxt)
+                self.transitions[ci][ch] = index[nxt]
+
+    @property
+    def num_states(self) -> int:
+        return len(self.transitions)
+
+    def step(self, state: int, char: str) -> int:
+        """-1 = dead."""
+        return self.transitions[state].get(char, -1)
+
+    def walk(self, state: int, text: str) -> int:
+        for ch in text:
+            state = self.step(state, ch)
+            if state < 0:
+                return -1
+        return state
+
+    def is_accept(self, state: int) -> bool:
+        return state >= 0 and self.accepts[state]
+
+    def can_reach_accept(self, state: int) -> bool:
+        """Liveness: some suffix leads to accept (precomputed lazily)."""
+        if not hasattr(self, "_live"):
+            n = self.num_states
+            live = [self.accepts[i] for i in range(n)]
+            changed = True
+            while changed:
+                changed = False
+                for i in range(n):
+                    if not live[i] and any(
+                        live[d] for d in self.transitions[i].values()
+                    ):
+                        live[i] = True
+                        changed = True
+            self._live = live
+        return state >= 0 and self._live[state]
