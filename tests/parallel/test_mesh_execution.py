@@ -1,0 +1,125 @@
+"""Mesh-execution tests: the GSPMD shardings actually run on >1 device.
+
+Protocol of the reference's ``tests/distributed/`` e2e parity tests
+(multi-GPU greedy output == single-GPU output), realized the TPU-native way:
+real SPMD on the 8-device virtual CPU mesh (SURVEY §4), asserting greedy
+token equality between tp>1 and tp=1 engines.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding
+from jax.sharding import PartitionSpec as P
+
+from tests.models.utils import tiny_llama_dir
+from vllm_tpu import LLM, SamplingParams
+from vllm_tpu.config import ParallelConfig
+from vllm_tpu.parallel.mesh import build_mesh
+
+
+@pytest.fixture(scope="module")
+def tiny_llama(tmp_path_factory):
+    # 4 kv heads so head axes divide tp in {1, 2, 4}.
+    return tiny_llama_dir(
+        tmp_path_factory.mktemp("tiny_llama_mesh"), num_key_value_heads=4
+    )
+
+
+def _generate(model_dir: str, tp: int, prompts, max_tokens: int = 8):
+    llm = LLM(
+        model=model_dir,
+        dtype="float32",
+        max_model_len=128,
+        block_size=16,
+        num_gpu_blocks_override=64,
+        max_num_seqs=8,
+        max_num_batched_tokens=128,
+        tensor_parallel_size=tp,
+    )
+    params = SamplingParams(temperature=0.0, max_tokens=max_tokens, ignore_eos=True)
+    outs = llm.generate([{"prompt_token_ids": p} for p in prompts], params)
+    return [o.outputs[0].token_ids for o in outs]
+
+
+def test_build_mesh_axes():
+    mesh = build_mesh(
+        ParallelConfig(tensor_parallel_size=4, data_parallel_size=2)
+    )
+    assert mesh.axis_names == ("dp", "pp", "cp", "tp")
+    assert dict(zip(mesh.axis_names, mesh.devices.shape)) == {
+        "dp": 2, "pp": 1, "cp": 1, "tp": 4,
+    }
+
+
+@pytest.mark.parametrize("tp", [2, 4])
+def test_llm_generate_tp_parity(tiny_llama, tp):
+    """Greedy decode through the full engine must be identical at tp>1."""
+    rng = np.random.default_rng(3)
+    prompts = [rng.integers(10, 120, size=n).tolist() for n in (11, 5, 17)]
+    ref = _generate(tiny_llama, 1, prompts)
+    got = _generate(tiny_llama, tp, prompts)
+    assert got == ref
+
+
+def test_model_step_tp4_logits_close(tiny_llama):
+    """Model-level parity: sharded forward logits == single-device logits.
+
+    Exercises param_shardings / kv_cache_sharding directly (reference
+    analog: tests/distributed/test_comm_ops.py-level coverage).
+    """
+    from tests.models.utils import build_prefill_metadata, _kv_cache
+    from vllm_tpu.models.llama import LlamaForCausalLM
+    from vllm_tpu.worker.worker import load_hf_config
+    from transformers import AutoConfig
+
+    cfg = AutoConfig.from_pretrained(tiny_llama)
+    model = LlamaForCausalLM(cfg, dtype=jnp.float32)
+    params = model.load_params(tiny_llama, jnp.float32, None)
+    t = 12
+    token_ids = jnp.asarray(np.arange(t, dtype=np.int32) % cfg.vocab_size)
+    md, kv = build_prefill_metadata(model, t, block_size=16, num_blocks=8)
+
+    hidden, _ = model.apply(params, kv, token_ids, md)
+    ref_logits = model.compute_logits(params, hidden)
+
+    mesh = Mesh(np.asarray(jax.devices()[:4]).reshape(1, 4), ("dp", "tp"))
+    shardings = jax.tree_util.tree_map(
+        lambda s: NamedSharding(mesh, s),
+        model.param_shardings(),
+        is_leaf=lambda x: not isinstance(x, dict),
+    )
+    params_sh = jax.tree_util.tree_map(jax.device_put, params, shardings)
+    kv_sh = jax.device_put(kv, NamedSharding(mesh, model.kv_cache_sharding()))
+
+    def fwd(params, kv, token_ids, md):
+        hidden, kv = model.apply(params, kv, token_ids, md)
+        return model.compute_logits(params, hidden)
+
+    with mesh:
+        got = jax.jit(fwd)(params_sh, kv_sh, token_ids, md)
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(ref_logits), rtol=2e-4, atol=2e-4
+    )
+
+
+def test_mixtral_tp2_parity(tmp_path_factory):
+    """MoE path (dense one-hot EP formulation) under tp=2 == tp=1 greedy."""
+    from tests.models.test_mixtral import tiny_mixtral_config
+    import torch
+    from transformers import MixtralForCausalLM
+
+    torch.manual_seed(0)
+    hf = MixtralForCausalLM(tiny_mixtral_config()).to(torch.float32)
+    path = str(tmp_path_factory.mktemp("tiny_mixtral_mesh"))
+    hf.save_pretrained(path, safe_serialization=True)
+
+    rng = np.random.default_rng(5)
+    prompts = [rng.integers(10, 120, size=n).tolist() for n in (9, 14)]
+    ref = _generate(path, 1, prompts, max_tokens=6)
+    got = _generate(path, 2, prompts, max_tokens=6)
+    assert got == ref
