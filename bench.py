@@ -19,6 +19,9 @@ import sys
 import time
 
 os.environ.setdefault("VLLM_TPU_LOG_LEVEL", "WARNING")
+# The bench model is synthetic; never touch the HF hub (zero egress here —
+# the retry loop alone wastes ~40s).
+os.environ.setdefault("HF_HUB_OFFLINE", "1")
 
 BASELINE_TOK_S_PER_CHIP = 2000.0
 
@@ -88,9 +91,26 @@ def main() -> None:
     # each bucket is 5-40s; the staggered prefill->decode ramp visits many).
     llm.generate(prompts, params)
 
+    try:
+        runner = llm.llm_engine.engine_core.executor.worker.runner
+        runner.timing = {k: 0 if k == "steps" else 0.0
+                         for k in runner.timing}
+    except AttributeError:
+        runner = None
+
     t0 = time.monotonic()
     outs = llm.generate(prompts, params)
     dt = time.monotonic() - t0
+
+    if os.environ.get("VLLM_TPU_STEP_TIMING") and runner is not None:
+        tm = dict(runner.timing)
+        n = max(tm.pop("steps"), 1)
+        print(
+            f"[step timing] steps={n} "
+            + " ".join(f"{k}={v / n * 1e3:.2f}ms" for k, v in tm.items())
+            + f" wall={dt / n * 1e3:.2f}ms/step",
+            file=sys.stderr,
+        )
 
     n_out = sum(len(o.outputs[0].token_ids) for o in outs)
     import jax

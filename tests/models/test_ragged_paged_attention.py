@@ -1,8 +1,8 @@
-"""Kernel-tier tests (SURVEY.md §4 tier 3): our XLA ragged paged attention
-reference vs the JAX-bundled TPU kernel's own reference implementation —
-proves the interleaved KV layout and metadata mapping feed the Pallas fast
-path correctly (the Pallas kernel itself is validated against the same
-reference upstream and in the on-TPU smoke run).
+"""Kernel-tier tests (SURVEY.md §4 tier 3): the XLA ragged paged attention
+reference and the in-repo Pallas kernel (``ops/rpa_kernel.py``, interpret
+mode on CPU) against the JAX-bundled reference implementation — over
+prefill/decode mixes, layer indexing, head_dim {64, 128}, sliding window,
+and the LSE output contract (``csrc/attention/merge_attn_states.cu``).
 """
 
 from __future__ import annotations
@@ -15,12 +15,24 @@ import jax.numpy as jnp
 
 from vllm_tpu.ops.attention import (
     AttentionMetadata,
+    kv_cache_shape,
+    packed_kv_layout,
     ref_ragged_paged_attention,
     write_kv,
 )
 
 
-def _random_case(rng, num_seqs, q_lens, kv_lens, kh, h, d, bs, num_blocks):
+def _to_interleaved(kv_layer, d):
+    """Convert one layer of the framework cache to the JAX-bundled
+    reference's interleaved [NB, BS, 2*KH, D] layout."""
+    nb, bs, rows, lanes = kv_layer.shape
+    if not packed_kv_layout(d):
+        return kv_layer
+    return kv_layer.reshape(nb, bs, rows, 2, d).reshape(nb, bs, 2 * rows, d)
+
+
+def _random_case(rng, num_seqs, q_lens, kv_lens, kh, h, d, bs, num_blocks,
+                 num_layers=1, layer=0):
     """Build a mixed prefill/decode batch. q tokens are the LAST q_len
     tokens of each request's kv_len context."""
     assert len(q_lens) == len(kv_lens) == num_seqs
@@ -30,7 +42,8 @@ def _random_case(rng, num_seqs, q_lens, kv_lens, kh, h, d, bs, num_blocks):
     max_blocks = max(-(-kv // bs) for kv in kv_lens) + 1
     block_tables = np.zeros((num_seqs, max_blocks), np.int32)
     kv_cache = jnp.asarray(
-        rng.standard_normal((num_blocks, bs, 2 * kh, d)), jnp.float32
+        rng.standard_normal(kv_cache_shape(num_layers, num_blocks, bs, kh, d)),
+        jnp.float32,
     )
 
     positions = np.zeros(t, np.int32)
@@ -67,8 +80,20 @@ def _random_case(rng, num_seqs, q_lens, kv_lens, kh, h, d, bs, num_blocks):
     # Insert this step's K/V at the q token slots so cache + metadata agree.
     k_new = jnp.asarray(rng.standard_normal((t, kh, d)), jnp.float32)
     v_new = jnp.asarray(rng.standard_normal((t, kh, d)), jnp.float32)
-    kv_cache = write_kv(kv_cache, k_new, v_new, md.slot_mapping)
+    kv_cache = write_kv(kv_cache, jnp.int32(layer), k_new, v_new, md.slot_mapping)
     return q, kv_cache, md
+
+
+def _bundled_ref(q, kv_layer, md, n_seqs, **kw):
+    from jax.experimental.pallas.ops.tpu.ragged_paged_attention import (
+        ref_ragged_paged_attention as bundled,
+    )
+
+    kv_layer = _to_interleaved(kv_layer, q.shape[-1])
+    return bundled(
+        q, kv_layer, md.seq_lens, md.block_tables, md.query_start_loc,
+        np.asarray([n_seqs], np.int32), **kw,
+    )
 
 
 CASES = [
@@ -83,10 +108,6 @@ CASES = [
 @pytest.mark.parametrize("q_lens,kv_lens", CASES)
 @pytest.mark.parametrize("kh,h", [(2, 4), (1, 1)])
 def test_ref_matches_bundled_kernel_reference(q_lens, kv_lens, kh, h):
-    from jax.experimental.pallas.ops.tpu.ragged_paged_attention import (
-        ref_ragged_paged_attention as bundled_ref,
-    )
-
     rng = np.random.default_rng(0)
     d, bs = 32, 8
     q, kv_cache, md = _random_case(
@@ -94,11 +115,8 @@ def test_ref_matches_bundled_kernel_reference(q_lens, kv_lens, kh, h):
     )
     scale = d ** -0.5
 
-    ours = ref_ragged_paged_attention(q, kv_cache, md, scale)
-    theirs = bundled_ref(
-        q, kv_cache, md.seq_lens, md.block_tables, md.query_start_loc,
-        np.asarray([len(q_lens)], np.int32), sm_scale=scale,
-    )
+    ours = ref_ragged_paged_attention(q, kv_cache, jnp.int32(0), md, scale)
+    theirs = _bundled_ref(q, kv_cache[0], md, len(q_lens), sm_scale=scale)
     t_live = int(sum(q_lens))
     np.testing.assert_allclose(
         np.asarray(ours)[:t_live], np.asarray(theirs), rtol=2e-5, atol=2e-5
@@ -107,22 +125,138 @@ def test_ref_matches_bundled_kernel_reference(q_lens, kv_lens, kh, h):
 
 @pytest.mark.parametrize("q_lens,kv_lens", [([1, 5], [40, 25])])
 def test_sliding_window(q_lens, kv_lens):
-    from jax.experimental.pallas.ops.tpu.ragged_paged_attention import (
-        ref_ragged_paged_attention as bundled_ref,
-    )
-
     rng = np.random.default_rng(1)
     kh, h, d, bs = 2, 4, 32, 8
     q, kv_cache, md = _random_case(
         rng, len(q_lens), q_lens, kv_lens, kh, h, d, bs, num_blocks=64
     )
     scale = d ** -0.5
-    ours = ref_ragged_paged_attention(q, kv_cache, md, scale, sliding_window=16)
-    theirs = bundled_ref(
-        q, kv_cache, md.seq_lens, md.block_tables, md.query_start_loc,
-        np.asarray([len(q_lens)], np.int32), sm_scale=scale, sliding_window=16,
+    ours = ref_ragged_paged_attention(
+        q, kv_cache, jnp.int32(0), md, scale, sliding_window=16
+    )
+    theirs = _bundled_ref(
+        q, kv_cache[0], md, len(q_lens), sm_scale=scale, sliding_window=16
     )
     t_live = int(sum(q_lens))
     np.testing.assert_allclose(
         np.asarray(ours)[:t_live], np.asarray(theirs), rtol=2e-5, atol=2e-5
+    )
+
+
+def test_ref_layer_indexing():
+    """The layer argument selects the right slice of the stacked cache."""
+    rng = np.random.default_rng(2)
+    kh, h, d, bs = 2, 4, 32, 8
+    q, kv_cache, md = _random_case(
+        rng, 2, [1, 4], [9, 12], kh, h, d, bs, num_blocks=16,
+        num_layers=3, layer=2,
+    )
+    ours = ref_ragged_paged_attention(q, kv_cache, jnp.int32(2), md, d**-0.5)
+    theirs = _bundled_ref(q, kv_cache[2], md, 2, sm_scale=d**-0.5)
+    np.testing.assert_allclose(
+        np.asarray(ours)[:5], np.asarray(theirs), rtol=2e-5, atol=2e-5
+    )
+
+
+# ----------------------------------------------------------------------
+# In-repo Pallas kernel (interpret mode on CPU)
+# ----------------------------------------------------------------------
+
+
+def _run_kernel(q, kv_cache, layer, md, scale, **kw):
+    from vllm_tpu.ops.rpa_kernel import ragged_paged_attention
+
+    return ragged_paged_attention(
+        q,
+        kv_cache,
+        jnp.asarray([layer], jnp.int32),
+        md.seq_lens,
+        md.block_tables,
+        md.query_start_loc,
+        md.num_seqs,
+        sm_scale=scale,
+        interpret=True,
+        num_kv_pages_per_block=2,
+        num_queries_per_block=8,
+        **kw,
+    )
+
+
+@pytest.mark.parametrize("q_lens,kv_lens", CASES)
+@pytest.mark.parametrize("d", [64, 128])
+def test_pallas_kernel_interpret(q_lens, kv_lens, d):
+    rng = np.random.default_rng(3)
+    kh, h, bs = 2, 4, 8
+    q, kv_cache, md = _random_case(
+        rng, len(q_lens), q_lens, kv_lens, kh, h, d, bs, num_blocks=64
+    )
+    scale = d ** -0.5
+    got = _run_kernel(q, kv_cache, 0, md, scale)
+    want = _bundled_ref(q, kv_cache[0], md, len(q_lens), sm_scale=scale)
+    t_live = int(sum(q_lens))
+    np.testing.assert_allclose(
+        np.asarray(got)[:t_live], np.asarray(want), rtol=2e-4, atol=2e-4
+    )
+
+
+def test_pallas_kernel_layer_indexing():
+    rng = np.random.default_rng(4)
+    kh, h, d, bs = 2, 4, 64, 8
+    q, kv_cache, md = _random_case(
+        rng, 2, [1, 6], [11, 14], kh, h, d, bs, num_blocks=16,
+        num_layers=3, layer=1,
+    )
+    scale = d ** -0.5
+    got = _run_kernel(q, kv_cache, 1, md, scale)
+    want = _bundled_ref(q, kv_cache[1], md, 2, sm_scale=scale)
+    np.testing.assert_allclose(
+        np.asarray(got)[:7], np.asarray(want), rtol=2e-4, atol=2e-4
+    )
+
+
+def test_pallas_kernel_sliding_window():
+    rng = np.random.default_rng(5)
+    kh, h, d, bs = 2, 4, 128, 8
+    q_lens, kv_lens = [1, 5], [40, 25]
+    q, kv_cache, md = _random_case(
+        rng, 2, q_lens, kv_lens, kh, h, d, bs, num_blocks=64
+    )
+    scale = d ** -0.5
+    got = _run_kernel(q, kv_cache, 0, md, scale, sliding_window=16)
+    want = _bundled_ref(
+        q, kv_cache[0], md, 2, sm_scale=scale, sliding_window=16
+    )
+    np.testing.assert_allclose(
+        np.asarray(got)[:6], np.asarray(want), rtol=2e-4, atol=2e-4
+    )
+
+
+def test_pallas_kernel_lse():
+    """LSE output equals log-sum-exp of the masked scaled scores."""
+    rng = np.random.default_rng(6)
+    kh, h, d, bs = 2, 4, 64, 8
+    q_lens, kv_lens = [1, 7, 3], [19, 23, 3]
+    q, kv_cache, md = _random_case(
+        rng, 3, q_lens, kv_lens, kh, h, d, bs, num_blocks=64
+    )
+    scale = d ** -0.5
+    got, lse = _run_kernel(q, kv_cache, 0, md, scale, return_lse=True)
+    t_live = int(sum(q_lens))
+
+    # Reference LSE from the gather path's scores.
+    pages = kv_cache[0][md.block_tables]
+    r, b = md.block_tables.shape
+    ctx = b * bs
+    kv_req = pages.reshape(r, ctx, 2 * kh, d)
+    k_all = kv_req[:, :, 0::2]
+    k_t = k_all[np.asarray(md.token_req_idx)]
+    qg = np.asarray(q).reshape(-1, kh, h // kh, d)
+    scores = np.einsum("tkgd,tckd->tkgc", qg, np.asarray(k_t)) * scale
+    ctx_pos = np.arange(ctx)[None, :]
+    causal = ctx_pos <= np.asarray(md.positions)[:, None]
+    scores = np.where(causal[:, None, None, :], scores, -np.inf)
+    want_lse = np.log(np.sum(np.exp(scores), axis=-1)).reshape(-1, h)
+
+    np.testing.assert_allclose(
+        np.asarray(lse)[:t_live], want_lse[:t_live], rtol=2e-4, atol=2e-4
     )

@@ -39,8 +39,13 @@ def tiny_llama_dir(path, **overrides) -> str:
 
 
 def _kv_cache(model, num_blocks: int, block_size: int, dtype=jnp.float32):
+    from vllm_tpu.ops.attention import kv_cache_shape
+
     return jnp.zeros(
-        (model.num_layers, num_blocks, block_size, 2 * model.num_kv_heads, model.head_dim),
+        kv_cache_shape(
+            model.num_layers, num_blocks, block_size, model.num_kv_heads,
+            model.head_dim,
+        ),
         dtype,
     )
 

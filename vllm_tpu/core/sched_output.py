@@ -117,7 +117,7 @@ class SchedulerStats:
     kv_cache_usage: float = 0.0
     prefix_cache_queries: int = 0
     prefix_cache_hits: int = 0
-    num_preempted_reqs: int = 0
+    num_preempted_reqs: int = 0  # cumulative since engine start
 
 
 @dataclass

@@ -116,7 +116,10 @@ class Scheduler:
         # Requests finished since the last schedule() — the runner drops
         # their persistent-batch state on the next step.
         self.finished_req_ids: set[str] = set()
-        self._num_preempted_in_step = 0
+        # Cumulative preemption count (loggers export deltas; a per-step
+        # counter would lose events when async lag-1 runs two schedule()
+        # calls between logger updates).
+        self._num_preempted_total = 0
 
     # ------------------------------------------------------------------
     # Request lifecycle
@@ -167,7 +170,6 @@ class Scheduler:
     # ------------------------------------------------------------------
 
     def schedule(self) -> SchedulerOutput:
-        self._num_preempted_in_step = 0
         token_budget = self.config.max_num_batched_tokens
         num_scheduled_tokens: dict[str, int] = {}
         scheduled_spec_tokens: dict[str, list[int]] = {}
@@ -181,6 +183,18 @@ class Scheduler:
         # advances num_computed_tokens at schedule time, so phase 3 must use
         # these captured values, not the live counter.
         starts: dict[str, int] = {}
+
+        # Spec-decode steps disable logprobs for the whole batch (the
+        # runner's per-token logprob contract is single-token), so while ANY
+        # request wants logprobs, drop pending drafts at the authoritative
+        # point — schedule time — rather than trusting the runner's
+        # finalize-time view, which races with request admission.
+        if any(r.spec_token_ids for r in self.running) and any(
+            r.sampling_params.logprobs is not None
+            for r in (*self.running, *self.waiting)
+        ):
+            for r in self.running:
+                r.spec_token_ids = []
 
         # Phase 1: running requests, in order (decode + in-flight prefills).
         req_index = 0
@@ -379,7 +393,7 @@ class Scheduler:
         # re-sample an already-sampled position).
         request.num_preemptions += 1
         request.spec_token_ids = []
-        self._num_preempted_in_step += 1
+        self._num_preempted_total += 1
         self.waiting.prepend(request)
 
     # ------------------------------------------------------------------
@@ -520,5 +534,5 @@ class Scheduler:
             kv_cache_usage=self.kv_cache_manager.usage,
             prefix_cache_queries=stats.queries,
             prefix_cache_hits=stats.hits,
-            num_preempted_reqs=self._num_preempted_in_step,
+            num_preempted_reqs=self._num_preempted_total,
         )

@@ -139,15 +139,22 @@ class AsyncLLM:
 
     def _busy_loop(self) -> None:
         try:
+            stalled = False
             while not self._shutdown.is_set():
+                # `stalled`: unfinished requests exist but the last step()
+                # dispatched nothing and produced nothing (e.g. a prompt
+                # whose KV footprint can't be allocated yet). Block on the
+                # input queue with a timeout instead of hot-spinning.
                 self._drain_input_queue(
-                    block=not self.engine_core.has_unfinished_requests()
+                    block=stalled
+                    or not self.engine_core.has_unfinished_requests()
                 )
                 if self._shutdown.is_set():
                     return
                 if not self.engine_core.has_unfinished_requests():
                     continue
                 outputs = self.engine_core.step()
+                stalled = not outputs.outputs and not self.engine_core._inflight
                 # process_outputs delivers straight into each request's
                 # AsyncStream (thread-safe); nothing to re-publish here.
                 processed = self.output_processor.process_outputs(

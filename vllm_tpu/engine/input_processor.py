@@ -88,6 +88,18 @@ class InputProcessor:
                 f"prompt ({len(prompt_token_ids)} tokens) is longer than "
                 f"max_model_len-1 ({max_len - 1})"
             )
+        # A prompt whose KV footprint exceeds the whole cache could never be
+        # scheduled — the engine would spin on it forever. Reject upfront.
+        cache = self.config.cache_config
+        if cache.num_gpu_blocks is not None:
+            # Block 0 is the reserved null block (never allocatable).
+            capacity = (cache.num_gpu_blocks - 1) * cache.block_size
+            if len(prompt_token_ids) + 1 > capacity:
+                raise ValueError(
+                    f"prompt ({len(prompt_token_ids)} tokens) exceeds total "
+                    f"KV cache capacity ({capacity} tokens); raise "
+                    f"gpu_memory_utilization or num_gpu_blocks_override"
+                )
 
         params = self._finalize_params(params, len(prompt_token_ids))
         eos_token_id = None

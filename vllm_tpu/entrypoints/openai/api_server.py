@@ -310,11 +310,13 @@ def _completion_logprobs(c) -> dict | None:
         return None
     token_logprobs, tokens, top = [], [], []
     for tid, lp_dict in zip(c.token_ids, c.logprobs):
+        # Keep arrays aligned with token positions: a position whose sampled
+        # logprob is missing gets a null entry rather than being dropped.
         sampled = lp_dict.get(tid)
-        if sampled is None:
-            continue
-        tokens.append(sampled.decoded_token or str(tid))
-        token_logprobs.append(sampled.logprob)
+        tokens.append(
+            (sampled.decoded_token if sampled else None) or str(tid)
+        )
+        token_logprobs.append(sampled.logprob if sampled else None)
         top.append({
             (lp.decoded_token or str(t)): lp.logprob
             for t, lp in lp_dict.items()
@@ -332,12 +334,12 @@ def _chat_logprobs(c) -> dict | None:
         return None
     content = []
     for tid, lp_dict in zip(c.token_ids, c.logprobs):
+        # Null placeholder instead of dropping: keeps content aligned with
+        # the generated token positions.
         sampled = lp_dict.get(tid)
-        if sampled is None:
-            continue
         content.append({
-            "token": sampled.decoded_token or str(tid),
-            "logprob": sampled.logprob,
+            "token": (sampled.decoded_token if sampled else None) or str(tid),
+            "logprob": sampled.logprob if sampled else None,
             "top_logprobs": [
                 {"token": lp.decoded_token or str(t), "logprob": lp.logprob}
                 for t, lp in lp_dict.items()

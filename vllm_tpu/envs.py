@@ -49,6 +49,8 @@ _readers: dict[str, Callable[[], Any]] = {
     "VLLM_TPU_COMPILE_CACHE_DIR": _str("VLLM_TPU_COMPILE_CACHE_DIR", None),
     # Profiling
     "VLLM_TPU_PROFILER_DIR": _str("VLLM_TPU_PROFILER_DIR", None),
+    # Per-step host/device time breakdown accumulated in ModelRunner.timing.
+    "VLLM_TPU_STEP_TIMING": _bool("VLLM_TPU_STEP_TIMING", False),
     # API server
     "VLLM_TPU_API_KEY": _str("VLLM_TPU_API_KEY", None),
     # Testing

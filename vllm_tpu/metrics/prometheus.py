@@ -105,6 +105,7 @@ class PrometheusRegistry:
             self.ttft, self.tpot, self.e2e,
         ]
         self._last_prefix = (0, 0)
+        self._last_preempted = 0
 
     # StatLoggerBase interface -----------------------------------------
 
@@ -119,7 +120,8 @@ class PrometheusRegistry:
             self.prefix_queries.inc(max(0, s.prefix_cache_queries - lq))
             self.prefix_hits.inc(max(0, s.prefix_cache_hits - lh))
             self._last_prefix = (s.prefix_cache_queries, s.prefix_cache_hits)
-            self.preempted.inc(s.num_preempted_reqs)
+            self.preempted.inc(max(0, s.num_preempted_reqs - self._last_preempted))
+            self._last_preempted = s.num_preempted_reqs
         if iteration_stats is not None:
             self.generation_tokens.inc(iteration_stats.num_generation_tokens)
             self.prompt_tokens.inc(iteration_stats.num_prompt_tokens)

@@ -166,8 +166,9 @@ class LlamaForCausalLM:
         rope_cos, rope_sin = self.rope.cos, self.rope.sin
         bias = self.attention_bias
 
-        def layer_fn(x, inputs):
-            lp, kv = inputs
+        def layer_fn(carry, inputs):
+            x, kv = carry
+            lp, li = inputs
             h = rms_norm(x, lp["input_norm"], self.rms_eps)
 
             q = h @ lp["wq"]
@@ -186,9 +187,9 @@ class LlamaForCausalLM:
             q = _apply_rotate_half(q, cos, sin, self.rope.rotary_dim)
             k = _apply_rotate_half(k, cos, sin, self.rope.rotary_dim)
 
-            kv = write_kv(kv, k, v, md.slot_mapping)
+            kv = write_kv(kv, li, k, v, md.slot_mapping)
             attn = paged_attention(
-                q, kv, md, self.scale, sliding_window=self.sliding_window
+                q, kv, li, md, self.scale, sliding_window=self.sliding_window
             )
             x = x + attn.reshape(t, H * Dh) @ lp["wo"]
 
@@ -196,11 +197,17 @@ class LlamaForCausalLM:
             gate = h2 @ lp["wgate"]
             up = h2 @ lp["wup"]
             x = x + silu_and_mul(jnp.concatenate([gate, up], axis=-1)) @ lp["wdown"]
-            return x, kv
+            return (x, kv), None
 
-        # Scan over the layer stack: the per-layer KV slice goes in as xs and
-        # comes back updated as ys (donation keeps it in place).
-        x, new_kv = jax.lax.scan(layer_fn, x, (params["layers"], kv_cache))
+        # Scan over the layer stack with the WHOLE cache in the carry: the
+        # per-layer scatter + page gathers touch only live slots, and the
+        # donated buffer is updated in place (per-layer xs/ys would
+        # double-buffer the cache and copy a full layer per iteration).
+        (x, new_kv), _ = jax.lax.scan(
+            layer_fn,
+            (x, kv_cache),
+            (params["layers"], jnp.arange(self.num_layers, dtype=jnp.int32)),
+        )
         x = rms_norm(x, params["final_norm"], self.rms_eps)
         return x, new_kv
 

@@ -90,8 +90,9 @@ class MixtralForCausalLM(LlamaForCausalLM):
         H, KH, Dh = self.num_heads, self.num_kv_heads, self.head_dim
         rope_cos, rope_sin = self.rope.cos, self.rope.sin
 
-        def layer_fn(x, inputs):
-            lp, kv = inputs
+        def layer_fn(carry, inputs):
+            x, kv = carry
+            lp, li = inputs
             h = rms_norm(x, lp["input_norm"], self.rms_eps)
             q = (h @ lp["wq"]).reshape(t, H, Dh)
             k = (h @ lp["wk"]).reshape(t, KH, Dh)
@@ -100,9 +101,9 @@ class MixtralForCausalLM(LlamaForCausalLM):
             sin = rope_sin[md.positions][:, None, :]
             q = _apply_rotate_half(q, cos, sin, self.rope.rotary_dim)
             k = _apply_rotate_half(k, cos, sin, self.rope.rotary_dim)
-            kv = write_kv(kv, k, v, md.slot_mapping)
+            kv = write_kv(kv, li, k, v, md.slot_mapping)
             attn = paged_attention(
-                q, kv, md, self.scale, sliding_window=self.sliding_window
+                q, kv, li, md, self.scale, sliding_window=self.sliding_window
             )
             x = x + attn.reshape(t, H * Dh) @ lp["wo"]
 
@@ -116,9 +117,14 @@ class MixtralForCausalLM(LlamaForCausalLM):
                 top_k=self.top_k,
                 use_grouped=None if not self.expert_parallel else False,
             )
-            return x + moe_out, kv
+            return (x + moe_out, kv), None
 
-        x, new_kv = jax.lax.scan(layer_fn, x, (params["layers"], kv_cache))
+        # Whole cache in the carry: in-place paged KV (see models/llama.py).
+        (x, new_kv), _ = jax.lax.scan(
+            layer_fn,
+            (x, kv_cache),
+            (params["layers"], jnp.arange(self.num_layers, dtype=jnp.int32)),
+        )
         x = rms_norm(x, params["final_norm"], self.rms_eps)
         return x, new_kv
 

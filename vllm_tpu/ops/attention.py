@@ -1,4 +1,4 @@
-"""Paged attention over an HBM block table — XLA reference implementation.
+"""Paged attention over an HBM block table.
 
 This replaces the reference's CUDA paged attention + KV insert pipeline
 (``csrc/attention/paged_attention_v1/v2.cu``, ``reshape_and_cache_flash`` in
@@ -8,18 +8,21 @@ This replaces the reference's CUDA paged attention + KV insert pipeline
   ``[T]`` token batch spanning all scheduled requests (chunked prefills and
   single-token decodes mixed), exactly like the reference's unified v1
   scheduler feeds its workers.
+- The KV cache is ONE donated buffer ``[L, NB, BS, 2*KH, D]`` carried
+  through the model's layer scan — every op here takes the full cache plus
+  a layer index, so XLA updates it in place (scanning per-layer slices
+  instead would double-buffer the cache and copy a full layer per step).
 - KV insert is a static-shape scatter into the paged cache via a per-token
-  ``slot_mapping``; padded tokens target slot 0 (the null block, a write-only
-  garbage page — never read).
-- The implementation here is pure XLA (gather + masked softmax), correct on
-  any backend and used for CPU tests; the Pallas flash-decode kernel behind
-  ``ops/ragged_paged_attention.py`` is the TPU fast path with identical
-  semantics.
+  ``slot_mapping``; padded tokens target slot 0 (the null block, a
+  write-only garbage page — never read).
+- ``ref_ragged_paged_attention`` is pure XLA (gather + masked softmax),
+  correct on any backend and used for CPU tests; ``ops/rpa_kernel.py`` is
+  the in-repo Pallas flash kernel with identical semantics (the TPU fast
+  path).
 
-KV cache layout per layer: ``[num_blocks, block_size, 2*KH, head_dim]`` with
-K/V heads INTERLEAVED on axis 2 (``0::2`` = K, ``1::2`` = V) so one block's
-per-head K,V pair is contiguous — the layout the TPU flash kernel DMAs per
-block-table entry.
+K/V heads are INTERLEAVED on axis 3 (``0::2`` = K, ``1::2`` = V) so one
+block's per-head K,V pair is contiguous — the layout the flash kernel DMAs
+per block-table entry.
 """
 
 from __future__ import annotations
@@ -51,45 +54,83 @@ class AttentionMetadata:
     num_seqs: jnp.ndarray  # [1] i32, live (unpadded) request count
 
 
+def packed_kv_layout(head_dim: int) -> bool:
+    """True when K/V pair-pack on the lane axis instead of interleaving
+    heads. head_dim below the 128-lane tile (64) cannot be DMA'd or
+    memref-sliced by Mosaic, so such models store ``[.., KH, 2*D]`` rows
+    (k||v contiguous, a full 128-lane tile for D=64)."""
+    return head_dim % 128 != 0
+
+
+def kv_cache_shape(
+    num_layers: int, num_blocks: int, block_size: int, num_kv_heads: int,
+    head_dim: int,
+) -> tuple[int, int, int, int, int]:
+    """Framework-wide KV cache geometry (one donated 5-D buffer)."""
+    if packed_kv_layout(head_dim):
+        return (num_layers, num_blocks, block_size, num_kv_heads, 2 * head_dim)
+    return (num_layers, num_blocks, block_size, 2 * num_kv_heads, head_dim)
+
+
 def write_kv(
-    kv_cache: jnp.ndarray,  # [NB, BS, 2*KH, D] interleaved
+    kv_cache: jnp.ndarray,  # [L, NB, BS, 2*KH, D] or packed [L, NB, BS, KH, 2D]
+    layer: jnp.ndarray,  # scalar i32
     k: jnp.ndarray,  # [T, KH, D]
     v: jnp.ndarray,  # [T, KH, D]
     slot_mapping: jnp.ndarray,  # [T]
 ) -> jnp.ndarray:
-    """Scatter this step's K/V into their paged slots (interleaved heads)."""
-    nb, bs, kh2, d = kv_cache.shape
-    t, kh, _ = k.shape
-    # [T, KH, 2, D] -> [T, 2KH, D] gives k0,v0,k1,v1,... along axis 1.
-    kv_new = jnp.stack([k, v], axis=2).reshape(t, kh2, d)
-    flat = kv_cache.reshape(nb * bs, kh2, d)
-    flat = flat.at[slot_mapping].set(kv_new.astype(kv_cache.dtype))
-    return flat.reshape(nb, bs, kh2, d)
+    """Scatter this step's K/V into layer `layer`'s paged slots (in place
+    when the cache is a donated scan carry)."""
+    nl, nb, bs, rows, lanes = kv_cache.shape
+    t, kh, d = k.shape
+    if packed_kv_layout(d):
+        # [T, KH, 2D]: k||v per head on the lane axis.
+        kv_new = jnp.concatenate([k, v], axis=-1)
+    else:
+        # [T, KH, 2, D] -> [T, 2KH, D] gives k0,v0,k1,v1,... along axis 1.
+        kv_new = jnp.stack([k, v], axis=2).reshape(t, rows, lanes)
+    flat = kv_cache.reshape(nl * nb * bs, rows, lanes)
+    flat = flat.at[layer * (nb * bs) + slot_mapping].set(
+        kv_new.astype(kv_cache.dtype)
+    )
+    return flat.reshape(nl, nb, bs, rows, lanes)
 
 
 def paged_attention(
     q: jnp.ndarray,
-    kv_cache: jnp.ndarray,
+    kv_cache: jnp.ndarray,  # [L, NB, BS, 2*KH, D]
+    layer: jnp.ndarray,  # scalar i32
     md: AttentionMetadata,
     scale: float,
     *,
     sliding_window: int | None = None,
+    k_scale: float | None = None,
+    v_scale: float | None = None,
 ) -> jnp.ndarray:
-    """Backend dispatcher: Pallas ragged kernel on TPU, XLA reference
+    """Backend dispatcher: in-repo Pallas flash kernel on TPU, XLA reference
     elsewhere (and under VLLM_TPU_DISABLE_PALLAS)."""
     import vllm_tpu.envs as envs
 
-    # The flash kernel's m/l accumulators use 128-lane stores; head dims
-    # that don't fill a lane tile (e.g. 64) take the XLA path.
-    kernel_ok = q.shape[-1] % 128 == 0
+    kernel_ok = q.shape[-1] in (64, 128, 256)
     if not envs.VLLM_TPU_DISABLE_PALLAS and kernel_ok and _on_tpu():
-        from vllm_tpu.ops.ragged_paged_attention import ragged_paged_attention
+        from vllm_tpu.ops.rpa_kernel import ragged_paged_attention
 
         return ragged_paged_attention(
-            q, kv_cache, md, scale, sliding_window=sliding_window
+            q,
+            kv_cache,
+            jnp.asarray(layer, jnp.int32).reshape(1),
+            md.seq_lens,
+            md.block_tables,
+            md.query_start_loc,
+            md.num_seqs,
+            sm_scale=scale,
+            sliding_window=sliding_window,
+            k_scale=k_scale,
+            v_scale=v_scale,
         )
     return ref_ragged_paged_attention(
-        q, kv_cache, md, scale, sliding_window=sliding_window
+        q, kv_cache, layer, md, scale, sliding_window=sliding_window,
+        k_scale=k_scale, v_scale=v_scale,
     )
 
 
@@ -99,33 +140,45 @@ def _on_tpu() -> bool:
 
 def ref_ragged_paged_attention(
     q: jnp.ndarray,  # [T, H, D]
-    kv_cache: jnp.ndarray,  # [NB, BS, 2*KH, D] (already contains this step's KV)
+    kv_cache: jnp.ndarray,  # [L, NB, BS, 2*KH, D] (already holds this step's KV)
+    layer: jnp.ndarray,  # scalar i32
     md: AttentionMetadata,
     scale: float,
     *,
     sliding_window: int | None = None,
+    k_scale: float | None = None,
+    v_scale: float | None = None,
 ) -> jnp.ndarray:
     """Gather-based masked attention. Each token attends to its request's
     cached context up to and including its own position (causal)."""
     t, h, d = q.shape
-    nb, bs, kh2, _ = kv_cache.shape
-    kh = kh2 // 2
+    nl, nb, bs, rows, lanes = kv_cache.shape
+    packed = packed_kv_layout(d)
+    kh = rows if packed else rows // 2
     groups = h // kh
 
-    # [R, B, BS, 2KH, D] -> [R, C, 2KH, D]; C = padded context length.
-    pages = kv_cache[md.block_tables]
+    # Gather only the referenced pages of this layer: [R, B, BS, rows, lanes].
+    pages = kv_cache[layer, md.block_tables]
     r, b = md.block_tables.shape
     ctx = b * bs
-    kv_req = pages.reshape(r, ctx, kh2, d)
-    k_all = kv_req[:, :, 0::2]
-    v_all = kv_req[:, :, 1::2]
+    kv_req = pages.reshape(r, ctx, rows, lanes)
+    if packed:
+        k_all = kv_req[:, :, :, :d]
+        v_all = kv_req[:, :, :, d:]
+    else:
+        k_all = kv_req[:, :, 0::2]
+        v_all = kv_req[:, :, 1::2]
 
     # Per-token gather of the owning request's context.
-    k_t = k_all[md.token_req_idx]  # [T, C, KH, D]
-    v_t = v_all[md.token_req_idx]
+    k_t = k_all[md.token_req_idx].astype(jnp.float32)  # [T, C, KH, D]
+    v_t = v_all[md.token_req_idx].astype(jnp.float32)
+    if k_scale is not None:
+        k_t = k_t * k_scale
+    if v_scale is not None:
+        v_t = v_t * v_scale
 
     qg = q.reshape(t, kh, groups, d).astype(jnp.float32)
-    scores = jnp.einsum("tkgd,tckd->tkgc", qg, k_t.astype(jnp.float32)) * scale
+    scores = jnp.einsum("tkgd,tckd->tkgc", qg, k_t) * scale
 
     ctx_pos = jnp.arange(ctx, dtype=jnp.int32)[None, :]  # [1, C]
     causal = ctx_pos <= md.positions[:, None]  # [T, C]
@@ -136,5 +189,5 @@ def ref_ragged_paged_attention(
     probs = jax.nn.softmax(scores, axis=-1)
     # Fully-masked rows (padding tokens) produce NaN-free zeros:
     probs = jnp.where(jnp.isnan(probs), 0.0, probs)
-    out = jnp.einsum("tkgc,tckd->tkgd", probs, v_t.astype(jnp.float32))
+    out = jnp.einsum("tkgc,tckd->tkgd", probs, v_t)
     return out.reshape(t, h, d).astype(q.dtype)

@@ -52,6 +52,7 @@ def rejection_sample(
     needs_penalties: bool = False,
     needs_top_k: bool,
     needs_top_p_min_p: bool,
+    needs_gumbel: bool = True,
 ) -> tuple[jnp.ndarray, jnp.ndarray]:
     r, s1, v = logits.shape
     s = s1 - 1
@@ -78,6 +79,20 @@ def rejection_sample(
     # Target (greedy) tokens per position.
     tgt = jnp.argmax(logits, axis=-1).astype(jnp.int32)  # [R, S+1]
 
+    draft_pad = jnp.concatenate(
+        [draft_ids, jnp.zeros((r, 1), jnp.int32)], axis=1
+    )  # [R, S+1] (last col unused)
+
+    if not needs_gumbel:
+        # Statically all-greedy verification: accept while drafts match the
+        # target argmax; no distributions, uniforms, or noise needed.
+        accept = (draft_pad == tgt) & (pos < num_draft[:, None])
+        acc = jnp.sum(jnp.cumprod(accept.astype(jnp.int32), axis=1), axis=1)
+        rec_tok = jnp.take_along_axis(tgt, acc[:, None], axis=1)[:, 0]
+        out = jnp.where(pos < acc[:, None], draft_pad, 0)
+        out = jnp.where(pos == acc[:, None], rec_tok[:, None], out)
+        return out, acc + 1
+
     # Masked/scaled distribution per position for sampling rows.
     greedy = md.temperature == 0.0
     temp = jnp.where(greedy, 1.0, md.temperature)
@@ -93,9 +108,6 @@ def rejection_sample(
     uniforms, gumbel_keys = _per_pos_uniform(md.prng_keys, s1)
 
     # Acceptance per draft position.
-    draft_pad = jnp.concatenate(
-        [draft_ids, jnp.zeros((r, 1), jnp.int32)], axis=1
-    )  # [R, S+1] (last col unused)
     p_draft = jnp.take_along_axis(probs, draft_pad[:, :, None], axis=2)[:, :, 0]
     accept_random = uniforms < p_draft  # [R, S+1]
     accept_greedy = draft_pad == tgt

@@ -93,17 +93,27 @@ def sample(
     needs_penalties: bool = False,
     needs_top_k: bool = True,
     needs_top_p_min_p: bool = True,
+    needs_gumbel: bool = True,
 ) -> tuple[jnp.ndarray, jnp.ndarray]:
     """Returns (sampled [R] i32, logprobs [R, V] f32 log-softmax of the
     pre-masking distribution — what logprob reporting uses).
 
     The ``needs_*`` flags are static: an all-greedy or vanilla-temperature
-    batch skips the [R, V] sorts entirely (separate jit trace per combo).
+    batch skips the [R, V] sorts — and, with ``needs_gumbel=False``, the
+    [R, V] Gumbel draw — entirely (separate jit trace per combo). An
+    all-greedy batch (the throughput-bench shape) is a single fused
+    argmax behind the logits matmul.
     """
     raw_logprobs = jax.nn.log_softmax(logits, axis=-1)
 
     if needs_penalties:
         logits = apply_penalties(logits, md)
+
+    greedy_pick = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    if not needs_gumbel:
+        # Statically all-greedy: temperature scaling, masking, and noise
+        # cannot change an argmax; skip them (~5 [R, V] passes saved).
+        return greedy_pick, raw_logprobs
 
     greedy = md.temperature == 0.0
     temp = jnp.where(greedy, 1.0, md.temperature)
@@ -115,7 +125,6 @@ def sample(
 
     noise = _per_row_gumbel(md.prng_keys, logits.shape[-1])
     random_pick = jnp.argmax(scaled + noise, axis=-1).astype(jnp.int32)
-    greedy_pick = jnp.argmax(logits, axis=-1).astype(jnp.int32)
     sampled = jnp.where(greedy, greedy_pick, random_pick)
     return sampled, raw_logprobs
 

@@ -134,12 +134,15 @@ class _Builder:
         return _NFA(remap[nfa.start], remap[nfa.accept])
 
     def _repeat(self, atom: _NFA, lo: int, hi: int | None) -> _NFA:
+        if hi is not None and hi == 0:  # {0} / {0,0}: empty match only
+            s = self.new_state()
+            return _NFA(s, s)
         parts = [atom] + [self._clone(atom) for _ in range(max(lo, 1) - 1)]
         if hi is None:  # {m,} -> m copies, last one looping
             last = parts[-1]
             self.add_edge(last.accept, EPS, last.start)
-        else:
-            for _ in range(hi - lo):
+        else:  # bounded: exactly max(hi, 1) copies total
+            for _ in range(max(hi, 1) - max(lo, 1)):
                 parts.append(self._clone(atom))
         s = self.new_state()
         a = self.new_state()

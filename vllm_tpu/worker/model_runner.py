@@ -16,6 +16,7 @@ Step dataflow:
 from __future__ import annotations
 
 import bisect
+import time
 from typing import Any
 
 import jax
@@ -115,11 +116,13 @@ class ModelRunner:
                 spec.num_speculative_tokens,
             )
 
-        kv_shape = (
+        from vllm_tpu.ops.attention import kv_cache_shape
+
+        kv_shape = kv_cache_shape(
             model.num_layers,
             num_kv_blocks,
             cache.block_size,
-            2 * model.num_kv_heads,
+            model.num_kv_heads,
             model.head_dim,
         )
         kv_dtype = (
@@ -152,11 +155,19 @@ class ModelRunner:
                 "needs_penalties",
                 "needs_top_k",
                 "needs_top_p_min_p",
+                "needs_gumbel",
                 "num_logprobs",
                 "num_spec",
             ),
             donate_argnums=(1,),
         )
+        # Step-time breakdown (host prep / dispatch / finalize wait), enabled
+        # by VLLM_TPU_STEP_TIMING=1; read via .timing after a run.
+        from vllm_tpu import envs
+
+        self._timing_enabled = envs.VLLM_TPU_STEP_TIMING
+        self.timing = {"prep_s": 0.0, "dispatch_s": 0.0, "wait_s": 0.0,
+                       "steps": 0}
 
     # ------------------------------------------------------------------
     # Jitted step
@@ -235,6 +246,7 @@ class ModelRunner:
         needs_penalties: bool,
         needs_top_k: bool,
         needs_top_p_min_p: bool,
+        needs_gumbel: bool,
         num_logprobs: int,
         num_spec: int = 0,
     ):
@@ -279,6 +291,7 @@ class ModelRunner:
                 needs_penalties=needs_penalties,
                 needs_top_k=needs_top_k,
                 needs_top_p_min_p=needs_top_p_min_p,
+                needs_gumbel=needs_gumbel,
             )
             return kv_cache, (out_tokens, num_out), None
         last = hidden[md.logits_indices]  # [R, D]
@@ -289,6 +302,7 @@ class ModelRunner:
             needs_penalties=needs_penalties,
             needs_top_k=needs_top_k,
             needs_top_p_min_p=needs_top_p_min_p,
+            needs_gumbel=needs_gumbel,
         )
         if num_logprobs > 0:
             topk_vals, topk_ids = jax.lax.top_k(raw_logprobs, num_logprobs)
@@ -477,12 +491,18 @@ class ModelRunner:
         if r_live and not s:
             num_logprobs = int(np.max(batch.num_logprobs[idx], initial=0))
         dims = dict(t_pad=t_pad, r_pad=r_pad, b_pad=b_pad)
+        # Masking flags only consider sampling rows: greedy rows take a raw
+        # argmax, so an all-greedy batch (the throughput-bench shape) skips
+        # every [R, V] sort and the Gumbel draw (static trace selection).
+        nongreedy = temperature[:r_live] > 0.0
         flags = dict(
             needs_penalties=needs_penalties,
-            needs_top_k=bool(np.any(top_k[:r_live] > 0)),
+            needs_top_k=bool(np.any(top_k[:r_live][nongreedy] > 0)),
             needs_top_p_min_p=bool(
-                np.any(top_p[:r_live] < 1.0) or np.any(min_p[:r_live] > 0)
+                np.any(top_p[:r_live][nongreedy] < 1.0)
+                or np.any(min_p[:r_live][nongreedy] > 0)
             ),
+            needs_gumbel=bool(np.any(nongreedy)),
             num_logprobs=num_logprobs,
             num_spec=s,
         )
@@ -511,14 +531,21 @@ class ModelRunner:
         """Upload inputs and enqueue the jitted step; returns immediately
         with device-array handles (no host sync). The async engine pipeline
         dispatches step N+1 before finalizing step N."""
+        t0 = time.perf_counter() if self._timing_enabled else 0.0
         self._update_states(so)
         if so.total_num_scheduled_tokens == 0:
             return StepHandle(empty=True)
         arrays, req_order, do_sample, flags = self._prepare_inputs(so)
+        if self._timing_enabled:
+            t1 = time.perf_counter()
+            self.timing["prep_s"] += t1 - t0
         prev = self._last_sampled if self._last_sampled is not None else self._zero_sampled
         self.kv_cache, sampled, lp = self._step_fn(
             self.params, self.kv_cache, *arrays, prev, **flags
         )
+        if self._timing_enabled:
+            self.timing["dispatch_s"] += time.perf_counter() - t1
+            self.timing["steps"] += 1
         is_spec = flags["num_spec"] > 0
         if not is_spec:
             self._last_sampled = (
@@ -546,6 +573,7 @@ class ModelRunner:
         host state (the only host<->device sync of the step)."""
         if handle.empty:
             return ModelRunnerOutput()
+        t0 = time.perf_counter() if self._timing_enabled else 0.0
         req_order, do_sample = handle.req_order, handle.do_sample
         if handle.spec:
             out_tokens = np.asarray(jax.device_get(handle.sampled[0]))
@@ -555,8 +583,18 @@ class ModelRunner:
         lp_np = None
         if handle.lp is not None:
             lp_np = [np.asarray(jax.device_get(x)) for x in handle.lp]
+        if self._timing_enabled:
+            self.timing["wait_s"] += time.perf_counter() - t0
 
         out = ModelRunnerOutput(req_ids=req_order)
+        # Logprobs aren't emitted on draft-carrying steps (the scheduler's
+        # per-token logprob contract is single-token), and a spec step
+        # disables logprobs for the WHOLE batch — so drafting is suppressed
+        # for everyone while any live request wants logprobs, keeping that
+        # request's logprob rows aligned with its tokens.
+        batch_has_logprobs = bool(
+            np.any(self.input_batch.num_logprobs[: self.input_batch.num_reqs] > 0)
+        )
         for i, rid in enumerate(req_order):
             if do_sample[i]:
                 toks = (
@@ -570,13 +608,7 @@ class ModelRunner:
                 if self.input_batch.req_states.get(rid) is handle.row_states[i]:
                     for tok in toks:
                         self.input_batch.append_token(rid, tok)
-                    # Logprobs aren't emitted on draft-carrying steps (the
-                    # scheduler's per-token logprob contract is single-token)
-                    # so logprob-requesting requests opt out of drafting.
-                    wants_logprobs = (
-                        handle.row_states[i].sampling_params.logprobs is not None
-                    )
-                    if self.proposer is not None and not wants_logprobs:
+                    if self.proposer is not None and not batch_has_logprobs:
                         row = self.input_batch.row_of(rid)
                         n_tok = int(self.input_batch.num_tokens[row])
                         drafts = self.proposer.propose(

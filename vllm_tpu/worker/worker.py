@@ -28,6 +28,50 @@ logger = init_logger(__name__)
 # temporaries when profiling data is unavailable.
 _ACTIVATION_HEADROOM = 0.08
 
+# Per-chip HBM by device kind, for backends that expose no memory_stats()
+# (v5e via the PJRT tunnel reports none). Reference analog: the profiling
+# path of ``gpu_worker.py determine_available_memory :352`` — on TPU the
+# capacity is a property of the chip generation, so a table is exact where
+# profiling would only re-measure it.
+_HBM_BYTES_BY_DEVICE_KIND = {
+    "TPU v2": 8 << 30,
+    "TPU v3": 16 << 30,
+    "TPU v4": 32 << 30,
+    "TPU v5 lite": 16 << 30,  # v5e
+    "TPU v5e": 16 << 30,
+    "TPU v5": 95 << 30,  # v5p
+    "TPU v5p": 95 << 30,
+    "TPU v6 lite": 32 << 30,  # v6e / Trillium
+    "TPU v6e": 32 << 30,
+    "TPU7x": 192 << 30,
+}
+
+
+def _device_hbm_bytes(device) -> int | None:
+    kind = getattr(device, "device_kind", "") or ""
+    if kind in _HBM_BYTES_BY_DEVICE_KIND:
+        return _HBM_BYTES_BY_DEVICE_KIND[kind]
+    # Longest-prefix match tolerates suffixes like "TPU v5 lite chip".
+    best = None
+    for k, v in _HBM_BYTES_BY_DEVICE_KIND.items():
+        if kind.startswith(k) and (best is None or len(k) > best[0]):
+            best = (len(k), v)
+    return best[1] if best else None
+
+
+def _per_device_param_bytes(params, device) -> int:
+    """Bytes of model weights resident on `device` (shard-exact)."""
+    total = 0
+    for leaf in jax.tree_util.tree_leaves(params):
+        shards = getattr(leaf, "addressable_shards", None)
+        if shards:
+            total += sum(
+                s.data.nbytes for s in shards if s.device == device
+            )
+        else:
+            total += leaf.nbytes
+    return total
+
 
 def load_hf_config(model_config) -> Any:
     if model_config.hf_config is not None:
@@ -105,12 +149,27 @@ class Worker:
             cache.block_size, jnp.dtype(self.config.model_config.jax_dtype).itemsize
         )
         stats = getattr(self.device, "memory_stats", lambda: None)()
-        if not stats or "bytes_limit" not in stats:
-            logger.warning("no device memory stats; defaulting to 512 KV blocks")
-            return 512
-
-        limit = stats["bytes_limit"] * cache.gpu_memory_utilization
-        in_use = stats.get("bytes_in_use", 0)
+        if stats and "bytes_limit" in stats:
+            limit = stats["bytes_limit"] * cache.gpu_memory_utilization
+            in_use = stats.get("bytes_in_use", 0)
+        else:
+            # Backend reports no stats (v5e over the tunnel): size from the
+            # chip generation's HBM capacity and the weights we just placed.
+            hbm = _device_hbm_bytes(self.device)
+            if hbm is None:
+                logger.warning(
+                    "no device memory stats and unknown device kind %r; "
+                    "defaulting to 512 KV blocks",
+                    getattr(self.device, "device_kind", None),
+                )
+                return 512
+            limit = hbm * cache.gpu_memory_utilization
+            in_use = _per_device_param_bytes(self.params, self.device)
+            logger.info(
+                "KV sizing from device kind %r: %.2f GiB HBM, "
+                "%.2f GiB weights on chip",
+                self.device.device_kind, hbm / 2**30, in_use / 2**30,
+            )
         free_for_kv = (limit - in_use) * (1 - _ACTIVATION_HEADROOM)
         if free_for_kv <= 0:
             raise RuntimeError(
