@@ -16,12 +16,14 @@ from vllm_tpu.request import EngineCoreRequest, Request, RequestStatus
 from vllm_tpu.sampling_params import SamplingParams
 
 
-def make_scheduler(num_blocks=64, block_size=4, max_seqs=8, budget=64):
+def make_scheduler(num_blocks=64, block_size=4, max_seqs=8, budget=64,
+                   depth=2):
     sched_cfg = SchedulerConfig(
         max_num_batched_tokens=budget,
         max_num_seqs=max_seqs,
         max_model_len=128,
         async_scheduling=True,
+        async_pipeline_depth=depth,
     )
     cache_cfg = CacheConfig(block_size=block_size)
     cache_cfg.num_gpu_blocks = num_blocks
@@ -73,6 +75,32 @@ def test_lag1_placeholder_accounting():
     assert req.num_tokens == 7
     so4 = s.schedule()
     assert so4.num_scheduled_tokens == {"a": 1}
+
+
+def test_pipeline_depth3_placeholder_bound():
+    """At depth 3 a request may run three sampling steps ahead; penalties
+    cap it at 2 (the in-jit count correction covers one in-flight token)."""
+    s = make_scheduler(depth=3)
+    req = make_request("a", prompt_len=6)
+    s.add_request(req)
+    for want in (6, 1, 1):
+        so = s.schedule()
+        assert so.num_scheduled_tokens == {"a": want}
+    assert req.num_output_placeholders == 3
+    assert s.schedule().total_num_scheduled_tokens == 0
+
+    s2 = make_scheduler(depth=3)
+    core = EngineCoreRequest(
+        request_id="p",
+        prompt_token_ids=list(range(6)),
+        sampling_params=SamplingParams(
+            max_tokens=16, ignore_eos=True, presence_penalty=0.5
+        ),
+    )
+    s2.add_request(Request.from_engine_core_request(core, None))
+    assert s2.schedule().num_scheduled_tokens == {"p": 6}
+    assert s2.schedule().num_scheduled_tokens == {"p": 1}
+    assert s2.schedule().total_num_scheduled_tokens == 0
 
 
 def test_finish_while_in_flight_discards_stale_output():

@@ -38,6 +38,44 @@ def tiny_llama_dir(path, **overrides) -> str:
     return str(path)
 
 
+def tiny_tokenizer(vocab_size: int = 128):
+    """A real (BPE) fast tokenizer built offline — no hub access needed.
+
+    Trained on an ASCII corpus so grammar tests have quotes, braces,
+    digits, and letters in-vocabulary.
+    """
+    from tokenizers import Tokenizer, decoders, models, pre_tokenizers, trainers
+    from transformers import PreTrainedTokenizerFast
+
+    tok = Tokenizer(models.BPE(unk_token="<unk>"))
+    tok.pre_tokenizer = pre_tokenizers.Split("", "isolated")
+    # Concatenate tokens verbatim on decode (the default BPE decoder joins
+    # with spaces, which would disagree with the grammar's per-token view).
+    tok.decoder = decoders.Fuse()
+    corpus = [
+        'abcdefghijklmnopqrstuvwxyz 0123456789 {}[]":,.- truefalsenull'
+        'ABCDEFGHIJKLMNOPQRSTUVWXYZ',
+        '{"name": "abc", "age": 42} [1, 2, 3] yes no maybe red green blue',
+    ]
+    trainer = trainers.BpeTrainer(
+        vocab_size=vocab_size - 3,
+        special_tokens=["<unk>", "<s>", "</s>"],
+        show_progress=False,
+    )
+    tok.train_from_iterator(corpus, trainer)
+    return PreTrainedTokenizerFast(
+        tokenizer_object=tok,
+        unk_token="<unk>", bos_token="<s>", eos_token="</s>",
+    )
+
+
+def tiny_llama_dir_with_tokenizer(path, **overrides) -> str:
+    """tiny_llama_dir + a saved fast tokenizer (text prompts work)."""
+    d = tiny_llama_dir(path, **overrides)
+    tiny_tokenizer().save_pretrained(d)
+    return d
+
+
 def _kv_cache(model, num_blocks: int, block_size: int, dtype=jnp.float32):
     from vllm_tpu.ops.attention import kv_cache_shape
 

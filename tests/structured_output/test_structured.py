@@ -1,0 +1,210 @@
+"""Structured-output tests: token-grammar compilation, JSON-schema regex,
+and grammar-constrained generation through the full engine.
+
+Reference analog: ``tests/v1/structured_output/`` + entrypoint-level guided
+decoding tests.
+"""
+
+from __future__ import annotations
+
+import json
+import re
+
+import numpy as np
+import pytest
+
+from tests.models.utils import tiny_llama_dir_with_tokenizer, tiny_tokenizer
+from vllm_tpu.sampling_params import SamplingParams, StructuredOutputParams
+from vllm_tpu.structured_output.fsm import DFA
+from vllm_tpu.structured_output.json_schema import (
+    any_json_value_regex,
+    build_regex_from_schema,
+)
+from vllm_tpu.structured_output.token_grammar import (
+    TokenGrammar,
+    TokenVocabulary,
+)
+
+
+@pytest.fixture(scope="module")
+def vocab():
+    return TokenVocabulary(tiny_tokenizer())
+
+
+# ----------------------------------------------------------------------
+# Token grammar unit tier
+# ----------------------------------------------------------------------
+
+
+def test_token_grammar_matches_char_walk(vocab):
+    """token_table[s, v] must equal walking token v's string from s."""
+    dfa = DFA("(ab|cd)*e?")
+    g = TokenGrammar(dfa, vocab)
+    rng = np.random.default_rng(0)
+    n_sample = min(vocab.vocab_size, 200)
+    for v in rng.choice(vocab.vocab_size, n_sample, replace=False):
+        s_tok = vocab.strings[v]
+        for state in range(dfa.num_states):
+            want = dfa.walk(state, s_tok) if s_tok else -1
+            if want >= 0 and not dfa.can_reach_accept(want):
+                want = -1
+            assert g.token_table[state, v] == want, (v, s_tok, state)
+
+
+def test_token_grammar_mask_bits(vocab):
+    dfa = DFA("[0-9]+")
+    g = TokenGrammar(dfa, vocab)
+    for state in range(g.num_states):
+        for v in range(vocab.vocab_size):
+            bit = (g.masks[state, v // 32] >> (v % 32)) & 1
+            allowed = g.token_table[state, v] >= 0
+            if v == vocab.eos_token_id:
+                assert bool(bit) == dfa.is_accept(state)
+            else:
+                assert bool(bit) == allowed, (state, v, vocab.strings[v])
+
+
+def test_eos_only_in_accept_states(vocab):
+    g = TokenGrammar(DFA("ab"), vocab)
+    eos = vocab.eos_token_id
+    accept_bits = [
+        (g.masks[s, eos // 32] >> (eos % 32)) & 1 for s in range(g.num_states)
+    ]
+    assert any(accept_bits) and not all(accept_bits)
+
+
+# ----------------------------------------------------------------------
+# JSON schema -> regex
+# ----------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("schema,good,bad", [
+    ({"type": "integer"}, ["0", "-17", "123"], ["01", "1.5", "abc"]),
+    ({"type": "boolean"}, ["true", "false"], ["True", "1"]),
+    ({"type": "string"}, ['"hi"', '""', '"a b"'], ['hi', '"']),
+    ({"enum": ["red", "green"]}, ['"red"', '"green"'], ['"blue"']),
+    ({"type": "array", "items": {"type": "integer"}},
+     ["[]", "[1]", "[1, 2, 3]"], ["[", "[1,]"]),
+    ({"type": "object",
+      "properties": {"name": {"type": "string"}, "age": {"type": "integer"}}},
+     ['{"name": "ab", "age": 3}', '{"name":"x","age":0}'],
+     ['{"age": 3, "name": "ab"}', '{}']),
+])
+def test_schema_regex_accepts(schema, good, bad):
+    rx = build_regex_from_schema(schema)
+    dfa = DFA(rx)
+    for s in good:
+        assert dfa.is_accept(dfa.walk(0, s)), (schema, s, rx)
+    for s in bad:
+        assert not dfa.is_accept(dfa.walk(0, s)), (schema, s)
+
+
+def test_any_json_value_regex():
+    dfa = DFA(any_json_value_regex(depth=2))
+    for s in ['1', '"x"', 'true', 'null', '[1, "a"]', '{"k": 1}',
+              '{"k": [1, 2]}']:
+        assert dfa.is_accept(dfa.walk(0, s)), s
+    for s in ['{', '[1,]', 'truex']:
+        assert not dfa.is_accept(dfa.walk(0, s)), s
+
+
+# ----------------------------------------------------------------------
+# Engine e2e (CPU): generation obeys the grammar
+# ----------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def llm(tmp_path_factory):
+    from vllm_tpu import LLM
+
+    d = tiny_llama_dir_with_tokenizer(
+        tmp_path_factory.mktemp("tiny_llama_so")
+    )
+    return LLM(
+        model=d, dtype="float32", max_model_len=128, block_size=16,
+        num_gpu_blocks_override=64, max_num_seqs=8,
+        max_num_batched_tokens=128,
+    )
+
+
+def test_guided_regex_e2e(llm):
+    # Bounded so the grammar itself forces completion within max_tokens.
+    rx = "(ab|cd){1,3}e"
+    outs = llm.generate(
+        ["xyz"],
+        SamplingParams(
+            temperature=0.0, max_tokens=24,
+            structured_outputs=StructuredOutputParams(regex=rx),
+        ),
+    )
+    text = outs[0].outputs[0].text
+    assert re.fullmatch(rx, text), repr(text)
+
+
+def test_guided_choice_e2e(llm):
+    outs = llm.generate(
+        ["pick a color:"],
+        SamplingParams(
+            temperature=0.8, seed=3, max_tokens=16,
+            structured_outputs=StructuredOutputParams(
+                choice=["red", "green", "blue"]
+            ),
+        ),
+    )
+    assert outs[0].outputs[0].text in ("red", "green", "blue")
+
+
+def test_guided_json_schema_e2e(llm):
+    # Bounded value types so generation must terminate inside max_tokens.
+    schema = {
+        "type": "object",
+        "properties": {
+            "ok": {"type": "boolean"},
+            "color": {"enum": ["red", "green"]},
+        },
+    }
+    outs = llm.generate(
+        ["give me json:"],
+        SamplingParams(
+            temperature=0.0, max_tokens=48,
+            structured_outputs=StructuredOutputParams(json_schema=schema),
+        ),
+    )
+    text = outs[0].outputs[0].text
+    parsed = json.loads(text)
+    assert isinstance(parsed["ok"], bool), repr(text)
+    assert parsed["color"] in ("red", "green")
+
+
+def test_bad_grammar_fails_request_not_engine(llm):
+    """A grammar that fails to compile aborts that request with a finish
+    record (no client hang) and leaves the engine serving."""
+    outs = llm.generate(
+        ["x"],
+        SamplingParams(
+            temperature=0.0, max_tokens=8,
+            structured_outputs=StructuredOutputParams(regex="(unclosed"),
+        ),
+    )
+    assert outs[0].finished
+    assert outs[0].outputs[0].finish_reason == "abort"
+    # Engine still healthy.
+    ok = llm.generate(
+        [{"prompt_token_ids": [5, 6]}],
+        SamplingParams(temperature=0.0, max_tokens=4, ignore_eos=True),
+    )
+    assert len(ok[0].outputs[0].token_ids) == 4
+
+
+def test_mixed_constrained_and_free_batch(llm):
+    """A structured request sharing a batch with unconstrained ones."""
+    params = [
+        SamplingParams(
+            temperature=0.0, max_tokens=12,
+            structured_outputs=StructuredOutputParams(regex="[0-9]+"),
+        ),
+        SamplingParams(temperature=0.0, max_tokens=12, ignore_eos=True),
+    ]
+    outs = llm.generate(["n:", "free"], params)
+    assert re.fullmatch("[0-9]+", outs[0].outputs[0].text)
+    assert len(outs[1].outputs[0].token_ids) == 12

@@ -116,9 +116,15 @@ class SchedulerConfig:
     max_num_batched_tokens: int = 8192  # per-step token budget
     max_num_seqs: int = 256  # max concurrent requests in a step
     max_model_len: int = 8192  # mirrored from ModelConfig at finalize
-    # Lag-1 pipelined scheduling (schedule step N+1 before step N's tokens
+    # Lag-N pipelined scheduling (schedule step N+k before step N's tokens
     # reach the host); forced off when spec decode is on.
     async_scheduling: bool = True
+    # Max steps in flight (device + D2H) at once. Each extra step hides one
+    # host->device->host turnaround behind compute; tokens are fed
+    # device-side from the previous step's sampled array, so any depth is
+    # exact for greedy/seeded sampling (penalty-bearing requests are capped
+    # at 2 in flight — the device-side count correction covers one token).
+    async_pipeline_depth: int = 6
     enable_chunked_prefill: bool = True
     # Long-prefill throttle (reference: long_prefill_token_threshold).
     long_prefill_token_threshold: int = 0

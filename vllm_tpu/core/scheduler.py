@@ -120,6 +120,14 @@ class Scheduler:
         # counter would lose events when async lag-1 runs two schedule()
         # calls between logger updates).
         self._num_preempted_total = 0
+        # Requests failed engine-side (e.g. grammar compile error) awaiting
+        # an output record to the frontend.
+        self._failed_requests: list[Request] = []
+        # Request ids of the last non-empty (dispatched) step: the runner's
+        # device-side token feedback reads the immediately previous step's
+        # sampled array, so a request with in-flight tokens that MISSED that
+        # step (depth cap, budget) must wait for host materialization.
+        self._last_step_req_ids: set[str] = set()
 
     # ------------------------------------------------------------------
     # Request lifecycle
@@ -158,6 +166,8 @@ class Scheduler:
         self.kv_cache_manager.free(request)
         self.finished_req_ids.add(request.request_id)
         del self.requests[request.request_id]
+        if request.use_structured_output and self.structured_output_manager:
+            self.structured_output_manager.release(request)
 
     def has_unfinished_requests(self) -> bool:
         return bool(self.running) or bool(self.waiting)
@@ -190,9 +200,11 @@ class Scheduler:
         # point — schedule time — rather than trusting the runner's
         # finalize-time view, which races with request admission.
         if any(r.spec_token_ids for r in self.running) and any(
-            r.sampling_params.logprobs is not None
+            r.sampling_params.logprobs is not None or r.use_structured_output
             for r in (*self.running, *self.waiting)
         ):
+            # (Also incompatible with structured output: the rejection
+            # sampler has no grammar-mask path.)
             for r in self.running:
                 r.spec_token_ids = []
 
@@ -200,10 +212,31 @@ class Scheduler:
         req_index = 0
         while req_index < len(self.running) and token_budget > 0:
             request = self.running[req_index]
-            # Lag-1 bound: the runner's device-side token feedback reads the
-            # immediately previous step's sampled array, so at most two
-            # sampling steps may be in flight per request.
-            if request.num_output_placeholders >= 2:
+            # Pipeline bound: each in-flight step feeds its input token
+            # device-side from the immediately previous step's sampled
+            # array, so chaining is exact at any depth. Penalty-bearing
+            # requests cap at 2 — the in-jit token-count correction covers
+            # exactly one not-yet-materialized token.
+            p = request.sampling_params
+            if request.use_structured_output:
+                # The next step's grammar bitmask depends on the in-flight
+                # token's FSM transition — no scheduling ahead.
+                depth_cap = 1
+            elif (p.presence_penalty or p.frequency_penalty
+                  or p.repetition_penalty != 1.0):
+                depth_cap = 2
+            else:
+                depth_cap = self.config.async_pipeline_depth
+            if request.num_output_placeholders >= depth_cap:
+                req_index += 1
+                continue
+            # In-flight tokens are only recoverable device-side from the
+            # immediately previous dispatched step; a request that skipped
+            # it waits until its tokens materialize host-side.
+            if (
+                request.num_output_placeholders > 0
+                and request.request_id not in self._last_step_req_ids
+            ):
                 req_index += 1
                 continue
             # num_output_placeholders is 0 in sync mode; in async mode it
@@ -281,7 +314,23 @@ class Scheduler:
 
             # Structured-output grammar still compiling -> leave in queue.
             if request.use_structured_output and self.structured_output_manager:
-                if not self.structured_output_manager.is_ready(request):
+                try:
+                    ready = self.structured_output_manager.is_ready(request)
+                except Exception as e:
+                    # Grammar failed to compile: fail this request, don't
+                    # kill the engine loop.
+                    logger.error(
+                        "grammar compile failed for %s: %s",
+                        request.request_id, e,
+                    )
+                    self.waiting.popleft()
+                    request.status = RequestStatus.FINISHED_ABORTED
+                    self._free_request(request)
+                    # Surface the failure to the frontend on the next
+                    # update (otherwise the client would hang forever).
+                    self._failed_requests.append(request)
+                    continue
+                if not ready:
                     break
 
             # Prefix-cache hit discovery (only before first schedule;
@@ -362,6 +411,17 @@ class Scheduler:
                 starts.get(req_id, request.num_computed_tokens)
             )
 
+        # Structured output: ship each constrained request's current
+        # device-mask-table row (the runner gathers the bitmask on device).
+        structured_rows: dict[str, int] = {}
+        if self.structured_output_manager is not None:
+            for rid in num_scheduled_tokens:
+                req = self.requests[rid]
+                if req.use_structured_output:
+                    structured_rows[rid] = (
+                        self.structured_output_manager.state_row(req)
+                    )
+
         total = sum(num_scheduled_tokens.values())
         output = SchedulerOutput(
             scheduled_new_reqs=scheduled_new_reqs,
@@ -369,12 +429,15 @@ class Scheduler:
             num_scheduled_tokens=num_scheduled_tokens,
             total_num_scheduled_tokens=total,
             scheduled_spec_decode_tokens=scheduled_spec_tokens,
+            structured_output_request_ids=structured_rows,
             finished_req_ids=self.finished_req_ids,
             req_refs={
                 rid: self.requests[rid] for rid in num_scheduled_tokens
             },
         )
         self.finished_req_ids = set()
+        if total > 0:
+            self._last_step_req_ids = set(num_scheduled_tokens)
         return output
 
     def _after_schedule(self, request: Request, num_new_tokens: int) -> None:
@@ -442,9 +505,21 @@ class Scheduler:
 
             new_token_ids: list[int] = []
             stopped = False
+            structured = (
+                request.use_structured_output
+                and self.structured_output_manager is not None
+            )
             for tok in generated:
                 request.append_output_token_ids(tok)
                 new_token_ids.append(tok)
+                if structured:
+                    self.structured_output_manager.advance(request, tok)
+                    if request.fsm_state < 0:
+                        # Grammar cannot continue (e.g. complete and only
+                        # EOS remained): terminate.
+                        request.status = RequestStatus.FINISHED_STOPPED
+                        stopped = True
+                        break
                 stopped = self._check_stop(request)
                 if stopped:
                     break
@@ -493,6 +568,35 @@ class Scheduler:
                     )
                 )
 
+        # Surface engine-side failures (e.g. grammar compile errors) so the
+        # frontend releases the waiting client.
+        self._drain_failed_into(outputs)
+
+        return EngineCoreOutputs(
+            outputs=outputs,
+            scheduler_stats=self.make_stats(),
+            timestamp=time.monotonic(),
+        )
+
+    def _drain_failed_into(self, outputs: list[EngineCoreOutput]) -> None:
+        for request in self._failed_requests:
+            outputs.append(
+                EngineCoreOutput(
+                    req_id=request.request_id,
+                    new_token_ids=[],
+                    finish_reason=request.get_finished_reason(),
+                    stop_reason=request.stop_reason,
+                )
+            )
+        self._failed_requests = []
+
+    def drain_failed(self) -> EngineCoreOutputs | None:
+        """Failure records when no step is running to carry them
+        (e.g. the failed request was the only one)."""
+        if not self._failed_requests:
+            return None
+        outputs: list[EngineCoreOutput] = []
+        self._drain_failed_into(outputs)
         return EngineCoreOutputs(
             outputs=outputs,
             scheduler_stats=self.make_stats(),

@@ -43,15 +43,25 @@ class EngineCore:
             scheduler_cls = AsyncScheduler
         self._inflight: deque = deque()
         self._max_inflight = (
-            min(2, self.executor.max_concurrent_batches)
+            min(
+                config.scheduler_config.async_pipeline_depth,
+                self.executor.max_concurrent_batches,
+            )
             if self.async_scheduling
             else 1
         )
 
+        self.structured_output_manager = self._make_structured_output_manager()
         self.scheduler = scheduler_cls(
             config.scheduler_config,
             config.cache_config,
-            structured_output_manager=self._make_structured_output_manager(),
+            structured_output_manager=self.structured_output_manager,
+        )
+        # The runner gathers grammar bitmasks from a device-resident table
+        # it syncs from the manager (in-proc share; becomes an RPC-shipped
+        # table under a future proc split).
+        self.executor.collective_rpc(
+            "set_structured_output_manager", self.structured_output_manager
         )
         self._block_hasher = (
             make_block_hasher(config.cache_config.block_size)
@@ -60,7 +70,18 @@ class EngineCore:
         )
 
     def _make_structured_output_manager(self):
-        return None  # wired in feature ring 1
+        from vllm_tpu.engine.input_processor import get_tokenizer
+        from vllm_tpu.structured_output import StructuredOutputManager
+
+        model_config = self.config.model_config
+
+        def tokenizer_factory():
+            try:
+                return get_tokenizer(model_config)
+            except Exception:
+                return None
+
+        return StructuredOutputManager(tokenizer_factory)
 
     # ------------------------------------------------------------------
 
@@ -96,7 +117,8 @@ class EngineCore:
             handle = self.executor.dispatch(scheduler_output)
             self._inflight.append((scheduler_output, handle))
         if not self._inflight:
-            return EngineCoreOutputs()
+            failed = self.scheduler.drain_failed()
+            return failed if failed is not None else EngineCoreOutputs()
         scheduler_output, handle = self._inflight.popleft()
         runner_output = self.executor.finalize(handle)
         return self.scheduler.update_from_output(scheduler_output, runner_output)
@@ -105,4 +127,6 @@ class EngineCore:
         return self.scheduler.kv_cache_manager.reset_prefix_cache()
 
     def shutdown(self) -> None:
+        if self.structured_output_manager is not None:
+            self.structured_output_manager.shutdown()
         self.executor.shutdown()

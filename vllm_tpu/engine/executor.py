@@ -80,7 +80,7 @@ class UniProcExecutor(Executor):
 
     @property
     def max_concurrent_batches(self) -> int:
-        return 2
+        return self.config.scheduler_config.async_pipeline_depth
 
     def collective_rpc(self, method: str, *args: Any, **kwargs: Any) -> list[Any]:
         fn: Callable = getattr(self.worker, method)

@@ -12,7 +12,40 @@ import uuid
 from dataclasses import dataclass, field
 from typing import Any
 
-from vllm_tpu.sampling_params import RequestOutputKind, SamplingParams
+from vllm_tpu.sampling_params import (
+    RequestOutputKind,
+    SamplingParams,
+    StructuredOutputParams,
+)
+
+
+def _structured_outputs(d: dict) -> StructuredOutputParams | None:
+    """OpenAI ``response_format`` plus the reference's ``guided_*``
+    extension fields -> StructuredOutputParams."""
+    rf = d.get("response_format")
+    if isinstance(rf, dict):
+        t = rf.get("type")
+        if t == "json_object":
+            return StructuredOutputParams(json_schema="{}")
+        if t == "json_schema":
+            schema = (rf.get("json_schema") or {}).get("schema")
+            if not isinstance(schema, dict):
+                raise ValidationError(
+                    "response_format.json_schema.schema must be an object"
+                )
+            return StructuredOutputParams(json_schema=schema)
+        if t not in (None, "text"):
+            raise ValidationError(f"unsupported response_format type {t!r}")
+    if d.get("guided_regex") is not None:
+        return StructuredOutputParams(regex=str(d["guided_regex"]))
+    if d.get("guided_json") is not None:
+        return StructuredOutputParams(json_schema=d["guided_json"])
+    if d.get("guided_choice") is not None:
+        choice = d["guided_choice"]
+        if not isinstance(choice, list) or not choice:
+            raise ValidationError("guided_choice must be a non-empty list")
+        return StructuredOutputParams(choice=[str(c) for c in choice])
+    return None
 
 
 class ValidationError(ValueError):
@@ -50,6 +83,7 @@ class CompletionRequest:
     seed: int | None = None
     ignore_eos: bool = False
     min_tokens: int = 0
+    structured_outputs: Any = None
 
     @classmethod
     def from_json(cls, d: dict) -> "CompletionRequest":
@@ -77,6 +111,7 @@ class CompletionRequest:
             seed=_get(d, "seed", int),
             ignore_eos=bool(d.get("ignore_eos", False)),
             min_tokens=_get(d, "min_tokens", int, 0),
+            structured_outputs=_structured_outputs(d),
         )
 
     def to_sampling_params(self, stream: bool) -> SamplingParams:
@@ -94,6 +129,7 @@ class CompletionRequest:
             seed=self.seed,
             ignore_eos=self.ignore_eos,
             min_tokens=self.min_tokens,
+            structured_outputs=self.structured_outputs,
             output_kind=(
                 RequestOutputKind.DELTA if stream
                 else RequestOutputKind.FINAL_ONLY
@@ -123,6 +159,7 @@ class ChatCompletionRequest:
     min_tokens: int = 0
     chat_template: str | None = None
     add_generation_prompt: bool = True
+    structured_outputs: Any = None
 
     @classmethod
     def from_json(cls, d: dict) -> "ChatCompletionRequest":
@@ -157,6 +194,7 @@ class ChatCompletionRequest:
             min_tokens=_get(d, "min_tokens", int, 0),
             chat_template=d.get("chat_template"),
             add_generation_prompt=bool(d.get("add_generation_prompt", True)),
+            structured_outputs=_structured_outputs(d),
         )
 
     def to_sampling_params(self, stream: bool) -> SamplingParams:
@@ -177,6 +215,7 @@ class ChatCompletionRequest:
             seed=self.seed,
             ignore_eos=self.ignore_eos,
             min_tokens=self.min_tokens,
+            structured_outputs=self.structured_outputs,
             output_kind=(
                 RequestOutputKind.DELTA if stream
                 else RequestOutputKind.FINAL_ONLY

@@ -94,6 +94,10 @@ class Request:
         self.num_output_placeholders = 0
         # Number of scheduler preemptions (stats).
         self.num_preemptions = 0
+        # Structured output: compiled-grammar future + current DFA state
+        # (managed by StructuredOutputManager; -1 = dead).
+        self.grammar_future: Any = None
+        self.fsm_state = 0
 
         # Content-addressed block hashes for prefix caching; maintained
         # incrementally as tokens append (reference: kv_cache_utils

@@ -1,0 +1,239 @@
+"""Structured (grammar-constrained) output manager.
+
+Reference analog: ``vllm/v1/structured_output/__init__.py:35``
+(StructuredOutputManager: async grammar compile + per-step token bitmask).
+
+TPU-native dataflow: compiled grammars' per-state packed bitmasks are
+uploaded ONCE into a device-resident mask table owned by the model runner;
+a scheduler step ships only each constrained request's global state row
+index (an int in the packed step buffer), and the jitted sampler gathers
+and unpacks the row on device. No [R, V]-sized host work or upload happens
+per step (the reference uploads a fresh bitmask tensor every step).
+
+Grammars are content-addressed: requests with the same spec share one
+compiled TokenGrammar (and its table rows).
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from concurrent.futures import Future, ThreadPoolExecutor
+from typing import Any
+
+from vllm_tpu.logger import init_logger
+from vllm_tpu.sampling_params import StructuredOutputParams
+
+logger = init_logger(__name__)
+
+
+def spec_to_regex(so: StructuredOutputParams) -> str:
+    from vllm_tpu.structured_output.json_schema import (
+        _escape_literal,
+        any_json_value_regex,
+        build_regex_from_schema,
+    )
+
+    if so.regex is not None:
+        return so.regex
+    if so.choice is not None:
+        return "(" + "|".join(_escape_literal(c) for c in so.choice) + ")"
+    if so.json_schema is not None:
+        if so.json_schema in ("", {}, "{}"):  # json_object mode
+            return any_json_value_regex()
+        return build_regex_from_schema(so.json_schema)
+    if so.grammar is not None:
+        raise ValueError(
+            "EBNF grammars are not supported; use regex/json_schema/choice"
+        )
+    raise ValueError("empty structured output spec")
+
+
+def _spec_key(so: StructuredOutputParams) -> str:
+    return json.dumps(
+        {
+            "json": so.json_schema if isinstance(so.json_schema, str)
+            else json.dumps(so.json_schema, sort_keys=True)
+            if so.json_schema is not None else None,
+            "regex": so.regex,
+            "choice": so.choice,
+            "grammar": so.grammar,
+        },
+        sort_keys=True,
+    )
+
+
+class StructuredOutputManager:
+    def __init__(self, tokenizer_factory) -> None:
+        # Lazy: the tokenizer (and vocab decode pass) loads on the first
+        # structured request, not at engine startup.
+        self._tokenizer_factory = tokenizer_factory
+        self._vocab = None
+        self._grammars: dict[str, Future] = {}
+        self._pool = ThreadPoolExecutor(
+            max_workers=2, thread_name_prefix="grammar-compile"
+        )
+        self._lock = threading.Lock()
+        # Device mask-table allocation: row 0 is the all-ones
+        # (unconstrained) row; grammars get contiguous row ranges from a
+        # free list. Ranges of evicted (zero-ref) grammars are reused
+        # without moving live grammars' rows (in-flight steps hold row
+        # indices, so offsets must be stable).
+        self.table_rows = 4096
+        self._free_ranges: list[tuple[int, int]] = [(1, self.table_rows)]
+        self._refcounts: dict[str, int] = {}
+        # Grammars not yet uploaded to the device table; the runner drains
+        # this via take_pending_uploads().
+        self._pending_uploads: list[Any] = []
+        self.version = 0  # bumped per finished compile (runner sync check)
+
+    # ------------------------------------------------------------------
+
+    def _get_vocab(self):
+        if self._vocab is None:
+            from vllm_tpu.structured_output.token_grammar import (
+                TokenVocabulary,
+            )
+
+            tokenizer = self._tokenizer_factory()
+            if tokenizer is None:
+                raise ValueError(
+                    "structured output requires a tokenizer (none loaded)"
+                )
+            self._vocab = TokenVocabulary(tokenizer)
+        return self._vocab
+
+    def _compile(self, so: StructuredOutputParams):
+        from vllm_tpu.structured_output.fsm import DFA
+        from vllm_tpu.structured_output.token_grammar import TokenGrammar
+
+        regex = spec_to_regex(so)
+        grammar = TokenGrammar(DFA(regex), self._get_vocab())
+        with self._lock:
+            grammar.row_offset = self._alloc_rows(grammar.num_states)
+            self._pending_uploads.append(grammar)
+            self.version += 1
+        logger.info(
+            "compiled grammar (%d states) for %r", grammar.num_states,
+            regex[:80],
+        )
+        return grammar
+
+    def grammar_init(self, request) -> None:
+        """Kick off (or join) the async compile for a request's grammar."""
+        so = request.sampling_params.structured_outputs
+        key = _spec_key(so)
+        with self._lock:
+            fut = self._grammars.get(key)
+            if fut is None:
+                fut = self._pool.submit(self._compile, so)
+                self._grammars[key] = fut
+            self._refcounts[key] = self._refcounts.get(key, 0) + 1
+        request.grammar_key = key
+        request.grammar_future = fut
+        request.fsm_state = 0
+
+    def is_ready(self, request) -> bool:
+        fut = getattr(request, "grammar_future", None)
+        if fut is None:
+            self.grammar_init(request)
+            fut = request.grammar_future
+        if not fut.done():
+            return False
+        if fut.exception() is not None:
+            # Don't poison the cache: a later request with the same spec
+            # retries the compile (the failure may be transient).
+            with self._lock:
+                if self._grammars.get(request.grammar_key) is fut:
+                    del self._grammars[request.grammar_key]
+        fut.result()  # surface compile errors
+        return True
+
+    def release(self, request) -> None:
+        """A structured request finished; its grammar becomes evictable
+        once no live request references it."""
+        key = getattr(request, "grammar_key", None)
+        if key is None:
+            return
+        with self._lock:
+            n = self._refcounts.get(key, 0) - 1
+            if n <= 0:
+                self._refcounts.pop(key, None)
+            else:
+                self._refcounts[key] = n
+
+    def _alloc_rows(self, n: int) -> int:
+        """First-fit range allocation (lock held); evicts zero-ref
+        grammars under pressure. Raises if the table can't fit `n` — which
+        fails only the requesting request(s), not the engine."""
+        for attempt in range(2):
+            for i, (lo, hi) in enumerate(self._free_ranges):
+                if hi - lo >= n:
+                    if hi - lo == n:
+                        del self._free_ranges[i]
+                    else:
+                        self._free_ranges[i] = (lo + n, hi)
+                    return lo
+            if attempt == 0:
+                self._evict_unreferenced()
+        raise RuntimeError(
+            f"grammar mask table full ({self.table_rows} rows): "
+            f"cannot fit a {n}-state grammar"
+        )
+
+    def _evict_unreferenced(self) -> None:
+        for key in list(self._grammars):
+            if self._refcounts.get(key, 0) > 0:
+                continue
+            fut = self._grammars[key]
+            if not fut.done() or fut.exception() is not None:
+                continue
+            g = fut.result()
+            del self._grammars[key]
+            self._free_ranges.append(
+                (g.row_offset, g.row_offset + g.num_states)
+            )
+        # Merge adjacent free ranges.
+        self._free_ranges.sort()
+        merged: list[tuple[int, int]] = []
+        for lo, hi in self._free_ranges:
+            if merged and merged[-1][1] >= lo:
+                merged[-1] = (merged[-1][0], max(merged[-1][1], hi))
+            else:
+                merged.append((lo, hi))
+        self._free_ranges = merged
+
+    def grammar_of(self, request):
+        return request.grammar_future.result()
+
+    # ------------------------------------------------------------------
+    # Scheduler-side per-step interface
+    # ------------------------------------------------------------------
+
+    def state_row(self, request) -> int:
+        """Global device-table row for the request's current FSM state
+        (0 = unconstrained, used for dead states to avoid masking)."""
+        g = self.grammar_of(request)
+        state = getattr(request, "fsm_state", 0)
+        if state < 0:
+            return 0
+        return g.row_offset + state
+
+    def advance(self, request, token_id: int) -> None:
+        g = self.grammar_of(request)
+        request.fsm_state = g.next_state(
+            getattr(request, "fsm_state", 0), token_id
+        )
+
+    # ------------------------------------------------------------------
+    # Runner-side sync
+    # ------------------------------------------------------------------
+
+    def take_pending_uploads(self):
+        with self._lock:
+            out = self._pending_uploads
+            self._pending_uploads = []
+            return out
+
+    def shutdown(self) -> None:
+        self._pool.shutdown(wait=False, cancel_futures=True)

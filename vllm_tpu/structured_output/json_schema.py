@@ -1,0 +1,100 @@
+"""JSON-schema -> regex compiler for DFA-constrained decoding.
+
+Reference analog: the role outlines-core's ``build_regex_from_schema``
+plays for ``vllm/v1/structured_output/backend_outlines.py``. Supports the
+practical schema subset (primitive types, enum/const, arrays, nested
+objects, anyOf); free-form JSON ("json_object" mode, or untyped schema
+nodes) is expanded to a bounded-nesting-depth value grammar, since a DFA
+cannot express unbounded recursion.
+
+Limitations (documented, validated against tests): every declared property
+is emitted in declaration order (optional-property elision is not encoded),
+and string ``pattern``/length constraints are not enforced.
+"""
+
+from __future__ import annotations
+
+import json
+import re
+from typing import Any
+
+# Bounded whitespace: an unbounded [ \n\t]* lets a constrained greedy model
+# emit whitespace forever (the classic guided-decoding trap); two chars of
+# slack parse everything practical and keep the DFA finite-progress.
+_WS = "[ \n\t]{0,2}"
+# Built with REAL control characters (the fsm regex dialect has no \xNN
+# escapes — a raw-string "\x00" would be the four literal chars \, x, 0, 0).
+_STRING = (
+    '"([^"\\\\' + chr(0) + "-" + chr(31) + "]"  # any char but quote/backslash/ctrl
+    + '|\\\\["\\\\/bfnrtu])*"'  # \" \\ \/ \b \f \n \r \t \u
+)
+_INTEGER = r"-?(0|[1-9][0-9]*)"
+_NUMBER = r"-?(0|[1-9][0-9]*)(\.[0-9]+)?([eE][+-]?[0-9]+)?"
+_BOOLEAN = r"(true|false)"
+_NULL = r"null"
+
+
+def _escape_literal(text: str) -> str:
+    return re.sub(r"([\\^$.|?*+()\[\]{}])", r"\\\1", text)
+
+
+def _json_literal(value: Any) -> str:
+    return _escape_literal(json.dumps(value))
+
+
+def any_json_value_regex(depth: int = 3) -> str:
+    """Free-form JSON value with nesting bounded at `depth` levels."""
+    leaf = f"({_STRING}|{_NUMBER}|{_BOOLEAN}|{_NULL})"
+    value = leaf
+    for _ in range(depth):
+        arr = rf"\[{_WS}({value}({_WS},{_WS}{value})*)?{_WS}\]"
+        obj = rf"\{{{_WS}({_STRING}{_WS}:{_WS}{value}({_WS},{_WS}{_STRING}{_WS}:{_WS}{value})*)?{_WS}\}}"
+        value = f"({leaf}|{arr}|{obj})"
+    return value
+
+
+def build_regex_from_schema(schema: dict[str, Any] | str) -> str:
+    if isinstance(schema, str):
+        schema = json.loads(schema)
+    assert isinstance(schema, dict)
+    return _node(schema)
+
+
+def _node(s: dict[str, Any]) -> str:
+    if "enum" in s:
+        return "(" + "|".join(_json_literal(v) for v in s["enum"]) + ")"
+    if "const" in s:
+        return _json_literal(s["const"])
+    if "anyOf" in s or "oneOf" in s:
+        opts = s.get("anyOf") or s.get("oneOf")
+        return "(" + "|".join(_node(o) for o in opts) + ")"
+    t = s.get("type")
+    if isinstance(t, list):
+        return "(" + "|".join(_node({**s, "type": ti}) for ti in t) + ")"
+    if t == "string":
+        return _STRING
+    if t == "integer":
+        return _INTEGER
+    if t == "number":
+        return _NUMBER
+    if t == "boolean":
+        return _BOOLEAN
+    if t == "null":
+        return _NULL
+    if t == "array":
+        items = s.get("items")
+        inner = _node(items) if isinstance(items, dict) else any_json_value_regex()
+        lo = s.get("minItems", 0)
+        if lo and lo > 0:
+            body = inner + (rf"({_WS},{_WS}{inner})" + "{" + str(lo - 1) + ",}")
+            return rf"\[{_WS}{body}{_WS}\]"
+        return rf"\[{_WS}({inner}({_WS},{_WS}{inner})*)?{_WS}\]"
+    if t == "object" and "properties" in s:
+        parts = []
+        for name, sub in s["properties"].items():
+            key = _escape_literal(json.dumps(name))
+            parts.append(f"{key}{_WS}:{_WS}{_node(sub)}")
+        body = (_WS + "," + _WS).join(parts)
+        return rf"\{{{_WS}{body}{_WS}\}}"
+    # Untyped / free-form node.
+    return any_json_value_regex()

@@ -1,0 +1,138 @@
+"""Token-level grammar: character DFA -> vocabulary transition table + masks.
+
+Reference analog: the role xgrammar's compiled ``Grammar`` + per-step
+``fill_next_token_bitmask`` play for ``vllm/v1/structured_output/``
+(``backend_xgrammar.py``). This build carries no grammar dependency: the
+character-level DFA comes from ``fsm.py`` and is lifted to token level here
+by walking every vocabulary string through the DFA **vectorized over
+(state, token) with numpy** — L gather rounds of an [S, V] state matrix
+instead of S*V Python walks.
+
+Products, per grammar:
+- ``token_table`` [S, V] i32: DFA state after emitting token v from state s
+  (-1 = token not allowed: walk dies or lands where accept is unreachable).
+- ``masks`` [S, W] uint32 (W = ceil(V/32)): packed allowed-token bits per
+  state, with the EOS bit set exactly in accepting states. These rows live
+  device-resident in the runner's mask table; a step ships only each row's
+  state index.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from vllm_tpu.structured_output.fsm import DFA
+
+
+class TokenVocabulary:
+    """Per-tokenizer cache: decoded string of every vocab id.
+
+    Special tokens decode to "" (never allowed by a grammar); eos is
+    handled separately via the accept-state bit.
+    """
+
+    def __init__(self, tokenizer) -> None:
+        self.vocab_size = len(tokenizer)
+        self.eos_token_id = tokenizer.eos_token_id
+        special = set(tokenizer.all_special_ids or [])
+        # Batch single-token decodes: convert_ids_to_tokens + cleanup is
+        # ~10x faster than per-id decode() and preserves leading spaces.
+        toks = tokenizer.convert_ids_to_tokens(list(range(self.vocab_size)))
+        strings: list[str] = []
+        for i, tok in enumerate(toks):
+            if i in special or tok is None:
+                strings.append("")
+                continue
+            strings.append(
+                tokenizer.convert_tokens_to_string([tok])
+            )
+        self.strings = strings
+
+
+def compile_token_grammar(
+    dfa: DFA, vocab: TokenVocabulary
+) -> tuple[np.ndarray, np.ndarray]:
+    """Returns (token_table [S, V] i32, masks [S, W] u32)."""
+    S = dfa.num_states
+    V = vocab.vocab_size
+
+    # DFA alphabet -> dense symbol ids. Symbol 0 = "unknown char" (dead).
+    alphabet = sorted({c for t in dfa.transitions for c in t})
+    sym_of = {c: i + 1 for i, c in enumerate(alphabet)}
+    A = len(alphabet) + 1
+
+    # Char-level transition matrix [S, A]; unknown char kills.
+    trans = np.full((S, A), -1, np.int32)
+    for s, t in enumerate(dfa.transitions):
+        for c, d in t.items():
+            trans[s, sym_of[c]] = d
+
+    # Tokens as padded symbol sequences [V, L]; PAD = -1 (= token ended).
+    lens = np.fromiter(
+        (len(s) for s in vocab.strings), np.int32, count=V
+    )
+    L = int(lens.max(initial=1))
+    syms = np.full((V, L), -1, np.int16)
+    for v, s in enumerate(vocab.strings):
+        if not s:
+            continue
+        syms[v, : len(s)] = [sym_of.get(c, 0) for c in s]
+
+    # Vectorized walk: state[s, v] after consuming j chars of token v.
+    state = np.broadcast_to(
+        np.arange(S, dtype=np.int32)[:, None], (S, V)
+    ).copy()
+    empty = lens == 0  # special / empty tokens: never allowed
+    for j in range(L):
+        col = syms[:, j]  # [V]
+        active = col >= 0  # token still has chars
+        if not active.any():
+            break
+        alive = state >= 0
+        step_to = trans[
+            np.clip(state, 0, S - 1), np.clip(col, 0, A - 1)[None, :]
+        ]  # [S, V]
+        state = np.where(active[None, :] & alive, step_to, state)
+
+    # A token is allowed iff the walk survived AND lands somewhere accept
+    # is still reachable, and the token is non-empty.
+    live = np.asarray(
+        [dfa.can_reach_accept(i) for i in range(S)], bool
+    )
+    landed_live = (state >= 0) & live[np.clip(state, 0, S - 1)]
+    allowed = landed_live & ~empty[None, :]  # [S, V]
+    token_table = np.where(allowed, state, -1).astype(np.int32)
+
+    # Pack to uint32 bitmask rows (bit v%32 of word v//32 = token v, the
+    # layout the in-jit unpack expects); set the EOS bit in accepting states.
+    W = -(-V // 32)
+    padded = np.zeros((S, W * 32), bool)
+    padded[:, :V] = allowed
+    if vocab.eos_token_id is not None:
+        accepts = np.asarray(dfa.accepts, bool)
+        padded[:, vocab.eos_token_id] = accepts
+    masks = (
+        padded.reshape(S, W, 32).astype(np.uint32)
+        << np.arange(32, dtype=np.uint32)
+    ).sum(axis=-1, dtype=np.uint32)
+    return token_table, masks
+
+
+class TokenGrammar:
+    """A compiled grammar instance shared by all requests using the same
+    spec (content-addressed by the manager)."""
+
+    def __init__(self, dfa: DFA, vocab: TokenVocabulary) -> None:
+        self.vocab_size = vocab.vocab_size
+        self.eos_token_id = vocab.eos_token_id
+        self.token_table, self.masks = compile_token_grammar(dfa, vocab)
+        self.num_states = self.token_table.shape[0]
+        # Assigned by the manager when uploaded into the device mask table.
+        self.row_offset: int = -1
+
+    def next_state(self, state: int, token_id: int) -> int:
+        if token_id == self.eos_token_id:
+            return state
+        if token_id >= self.token_table.shape[1] or state < 0:
+            return -1
+        return int(self.token_table[state, token_id])

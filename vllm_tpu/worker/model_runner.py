@@ -99,9 +99,19 @@ class ModelRunner:
         # jitted step sees one prev_sampled shape (else every bucket
         # transition would recompile: current-bucket x previous-bucket).
         self._last_sampled = None
+        self._max_pipeline_depth = sched.async_pipeline_depth
         self._max_r = self.request_buckets[-1]
         self._zero_sampled = jnp.zeros(self._max_r, jnp.int32)
         self._prev_rows: dict[str, int] = {}
+
+        # Structured output: device-resident packed-bitmask table, one row
+        # per (grammar, state); row 0 = all-ones (unconstrained). Synced
+        # from the StructuredOutputManager when new grammars compile; a
+        # step ships only per-row state indices (see _prepare_inputs).
+        self.structured_output_manager: Any = None
+        self._grammar_version = -1
+        self._mask_w = -(-model.vocab_size // 32)
+        self._mask_table = None  # jnp [manager.table_rows, W] uint32
 
         # Speculative decoding (ngram drafting is pure host logic; the
         # verification rejection-sampler runs inside the jitted step).
@@ -156,6 +166,7 @@ class ModelRunner:
                 "needs_top_k",
                 "needs_top_p_min_p",
                 "needs_gumbel",
+                "needs_grammar",
                 "num_logprobs",
                 "num_spec",
             ),
@@ -209,6 +220,9 @@ class ModelRunner:
         # Async scheduling: per-row index into the previous step's sampled
         # array for rows whose input token is still in flight (-1 = none).
         feedback = take(r)
+        # Structured output: per-row index into the device mask table
+        # (0 = unconstrained row).
+        grammar_rows = take(r)
         spec = None
         if s > 0:
             spec = dict(
@@ -228,7 +242,7 @@ class ModelRunner:
             output_token_counts=counts,
             prompt_token_mask=prompt_mask,
         )
-        return token_ids, md, sampling, feedback, spec
+        return token_ids, md, sampling, feedback, grammar_rows, spec
 
     def _step(
         self,
@@ -239,6 +253,7 @@ class ModelRunner:
         counts,
         prompt_mask,
         prev_sampled,
+        mask_table,
         *,
         t_pad: int,
         r_pad: int,
@@ -247,10 +262,11 @@ class ModelRunner:
         needs_top_k: bool,
         needs_top_p_min_p: bool,
         needs_gumbel: bool,
+        needs_grammar: bool,
         num_logprobs: int,
         num_spec: int = 0,
     ):
-        token_ids, md, sampling, feedback, spec = self._unpack(
+        token_ids, md, sampling, feedback, grammar_rows, spec = self._unpack(
             ibuf, fbuf, counts, prompt_mask, t_pad, r_pad, b_pad, num_spec
         )
         # Device-side token feedback (async scheduling): a decode row whose
@@ -296,6 +312,17 @@ class ModelRunner:
             return kv_cache, (out_tokens, num_out), None
         last = hidden[md.logits_indices]  # [R, D]
         logits = self.model.compute_logits(params, last)  # [R, V] f32
+        if needs_grammar:
+            # Gather each row's packed grammar bitmask from the
+            # device-resident table and unpack bits (bit v%32 of word v//32
+            # = token v); -inf out disallowed tokens before sampling.
+            rows = mask_table[grammar_rows]  # [R, W] u32
+            bits = (
+                rows[:, :, None]
+                >> jnp.arange(32, dtype=jnp.uint32)[None, None, :]
+            ) & jnp.uint32(1)
+            allowed = bits.reshape(r_pad, -1)[:, : logits.shape[-1]] != 0
+            logits = jnp.where(allowed, logits, jnp.float32(-1e30))
         sampled, raw_logprobs = sample(
             logits,
             sampling,
@@ -369,10 +396,10 @@ class ModelRunner:
         s = self.num_spec if spec_map else 0
         spec_len = (r + r * s + r * (s + 1)) if s else 0
         # seq_lens(r) + qsl(r+1) + logits_idx(r) + num_seqs(1) + bt(r*b)
-        # + top_k(r) + prng(2r) + feedback(r) [+ num_draft(r) + draft(r*s)
-        # + sample_pos(r*(s+1))]
+        # + top_k(r) + prng(2r) + feedback(r) + grammar_rows(r)
+        # [+ num_draft(r) + draft(r*s) + sample_pos(r*(s+1))]
         ibuf = np.zeros(
-            4 * t + 6 * r + (r + 1) + 1 + r * b + spec_len, np.int32
+            4 * t + 7 * r + (r + 1) + 1 + r * b + spec_len, np.int32
         )
         token_ids = ibuf[0:t]
         positions = ibuf[t : 2 * t]
@@ -388,6 +415,9 @@ class ModelRunner:
         prng = ibuf[o : o + 2 * r].view(np.uint32).reshape(r, 2); o += 2 * r
         feedback = ibuf[o : o + r]; o += r
         feedback[:] = -1
+        grammar_rows = ibuf[o : o + r]; o += r
+        for i, rid in enumerate(req_order):
+            grammar_rows[i] = so.structured_output_request_ids.get(rid, 0)
         if s:
             num_draft = ibuf[o : o + r]; o += r
             draft_ids = ibuf[o : o + r * s].reshape(r, s); o += r * s
@@ -419,13 +449,19 @@ class ModelRunner:
                 sample_pos[i, : nd + 1] = np.arange(base, base + nd + 1)
                 sample_pos[i, nd + 1 :] = base + nd
             elif start + n > known:
-                # Last token still in flight (async scheduling, lag 1):
-                # fed on device from the previous step's sampled array.
+                # Latest token(s) still in flight (async pipelining): the
+                # input token for this step is fed on device from the
+                # immediately previous step's sampled array. Earlier
+                # in-flight tokens were inputs to earlier in-flight steps,
+                # so only the newest matters here; `lag` tracks how many
+                # sampled tokens the host state is behind (bumps the PRNG
+                # counter so seeded streams don't repeat).
+                lag = start + n - known
                 prev_row = self._prev_rows.get(rid, -1)
-                assert start + n == known + 1 and prev_row >= 0, (
+                assert lag < self._max_pipeline_depth + 1 and prev_row >= 0, (
                     rid, start, n, known, prev_row)
                 feedback[i] = prev_row
-                pending_rows.append(i)
+                pending_rows.append((i, lag))
                 token_ids[offset : offset + n] = (
                     batch.token_ids[row, start : start + n]
                 )
@@ -471,10 +507,10 @@ class ModelRunner:
         gather_into(prng[:, 0], batch.seeds)
         for i, row in enumerate(rows):
             prng[i, 1] = batch.req_states[req_order[i]].generated
-        for i in pending_rows:
-            # The in-flight token hasn't been appended yet; bump the PRNG
-            # counter so this step's Gumbel stream doesn't repeat.
-            prng[i, 1] += 1
+        for i, lag in pending_rows:
+            # The in-flight token(s) haven't been appended yet; advance the
+            # PRNG counter so this step's Gumbel stream doesn't repeat.
+            prng[i, 1] += lag
 
         needs_penalties = bool(
             np.any(presence[:r_live] != 0)
@@ -503,6 +539,7 @@ class ModelRunner:
                 or np.any(min_p[:r_live][nongreedy] > 0)
             ),
             needs_gumbel=bool(np.any(nongreedy)),
+            needs_grammar=bool(so.structured_output_request_ids),
             num_logprobs=num_logprobs,
             num_spec=s,
         )
@@ -525,6 +562,29 @@ class ModelRunner:
             np.add.at(counts[i], out_ids, 1)
         return counts, prompt_mask
 
+    def _sync_grammar_table(self) -> None:
+        """Fold newly compiled grammars' per-state mask rows into the
+        device-resident table (amortized: once per new grammar, never per
+        step)."""
+        mgr = self.structured_output_manager
+        assert mgr is not None, "structured request without a manager"
+        version = mgr.version  # capture before draining (compile races)
+        if version == self._grammar_version:
+            return
+        if self._mask_table is None:
+            init = np.zeros((mgr.table_rows, self._mask_w), np.uint32)
+            init[0, :] = 0xFFFFFFFF  # row 0: unconstrained
+            self._mask_table = jnp.asarray(init)
+        for g in mgr.take_pending_uploads():
+            lo, hi = g.row_offset, g.row_offset + g.num_states
+            rows = np.zeros((g.num_states, self._mask_w), np.uint32)
+            w = min(self._mask_w, g.masks.shape[1])
+            rows[:, :w] = g.masks[:, :w]
+            self._mask_table = self._mask_table.at[lo:hi].set(
+                jnp.asarray(rows)
+            )
+        self._grammar_version = version
+
     # ------------------------------------------------------------------
 
     def dispatch(self, so: SchedulerOutput) -> "StepHandle":
@@ -536,12 +596,16 @@ class ModelRunner:
         if so.total_num_scheduled_tokens == 0:
             return StepHandle(empty=True)
         arrays, req_order, do_sample, flags = self._prepare_inputs(so)
+        mask_table = None
+        if flags["needs_grammar"]:
+            self._sync_grammar_table()
+            mask_table = self._mask_table
         if self._timing_enabled:
             t1 = time.perf_counter()
             self.timing["prep_s"] += t1 - t0
         prev = self._last_sampled if self._last_sampled is not None else self._zero_sampled
         self.kv_cache, sampled, lp = self._step_fn(
-            self.params, self.kv_cache, *arrays, prev, **flags
+            self.params, self.kv_cache, *arrays, prev, mask_table, **flags
         )
         if self._timing_enabled:
             self.timing["dispatch_s"] += time.perf_counter() - t1

@@ -205,3 +205,7 @@ class Worker:
     def execute_model(self, scheduler_output: SchedulerOutput) -> ModelRunnerOutput:
         assert self.runner is not None
         return self.runner.execute_model(scheduler_output)
+
+    def set_structured_output_manager(self, manager: Any) -> None:
+        assert self.runner is not None
+        self.runner.structured_output_manager = manager
