@@ -1,0 +1,147 @@
+"""Quantization tests: weight-only INT8/FP8 matmuls + FP8 KV cache.
+
+Reference analog: ``tests/quantization/`` + ``tests/kernels/quantization``
+(scheme-level numerics, then HF-parity-with-tolerance, SURVEY §4 tier 4).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from tests.models.utils import build_prefill_metadata, tiny_llama_dir
+from vllm_tpu.layers.quant import (
+    QuantizedLinear,
+    qmm,
+    quantize_jnp,
+    quantize_np,
+)
+
+
+@pytest.mark.parametrize("method,rtol", [("int8", 0.02), ("fp8", 0.10)])
+def test_quantize_roundtrip_error(method, rtol):
+    rng = np.random.default_rng(0)
+    w = rng.standard_normal((4, 64, 96)).astype(np.float32)
+    q, scale = quantize_np(w, method)
+    deq = q.astype(np.float32) * scale[:, None, :]
+    err = np.abs(deq - w).max()
+    assert err < rtol * np.abs(w).max()
+
+
+@pytest.mark.parametrize("method", ["int8", "fp8"])
+def test_qmm_matches_dense(method):
+    rng = np.random.default_rng(1)
+    x = jnp.asarray(rng.standard_normal((8, 64)), jnp.float32)
+    w = jnp.asarray(rng.standard_normal((64, 96)), jnp.float32)
+    ql = quantize_jnp(w, method)
+    got = qmm(x, ql)
+    want = x @ w
+    rel = float(jnp.max(jnp.abs(got - want)) / jnp.max(jnp.abs(want)))
+    assert rel < 0.08, rel
+    # Plain arrays pass through.
+    np.testing.assert_allclose(np.asarray(qmm(x, w)), np.asarray(want))
+
+
+def test_np_jnp_quantize_agree():
+    rng = np.random.default_rng(2)
+    w = rng.standard_normal((2, 32, 48)).astype(np.float32)
+    qn, sn = quantize_np(w, "int8")
+    ql = quantize_jnp(jnp.asarray(w), "int8")
+    np.testing.assert_allclose(np.asarray(ql.scale), sn, rtol=1e-6)
+    assert np.abs(np.asarray(ql.q, np.int32) - qn.astype(np.int32)).max() <= 1
+
+
+@pytest.fixture(scope="module")
+def ckpt(tmp_path_factory):
+    return tiny_llama_dir(tmp_path_factory.mktemp("tiny_llama_quant"))
+
+
+@pytest.mark.parametrize("method", ["int8", "fp8"])
+def test_quantized_model_logits_close(ckpt, method):
+    """HF-parity-with-tolerance: quantized greedy logits track the f32
+    model's (reference: tests/quantization accuracy protocol)."""
+    from transformers import AutoConfig
+
+    from vllm_tpu.models.llama import LlamaForCausalLM
+
+    cfg = AutoConfig.from_pretrained(ckpt)
+    ref_model = LlamaForCausalLM(cfg, dtype=jnp.float32)
+    ref_params = ref_model.load_params(ckpt, jnp.float32)
+    qmodel = LlamaForCausalLM(cfg, dtype=jnp.float32, quantization=method)
+    qparams = qmodel.load_params(ckpt, jnp.float32)
+
+    # Quantized leaves really are quantized.
+    assert isinstance(qparams["layers"]["wq"], QuantizedLinear)
+    if method == "int8":
+        assert qparams["layers"]["wq"].q.dtype == jnp.int8
+
+    t = 12
+    token_ids = jnp.asarray(np.arange(t) % cfg.vocab_size, jnp.int32)
+    md, kv = build_prefill_metadata(ref_model, t, block_size=16, num_blocks=8)
+    hidden, _ = ref_model.apply(ref_params, kv, token_ids, md)
+    ref_logits = np.asarray(ref_model.compute_logits(ref_params, hidden))
+
+    md, kv = build_prefill_metadata(qmodel, t, block_size=16, num_blocks=8)
+    qhidden, _ = qmodel.apply(qparams, kv, token_ids, md)
+    q_logits = np.asarray(qmodel.compute_logits(qparams, qhidden))
+
+    scale = np.abs(ref_logits).max()
+    assert np.abs(q_logits - ref_logits).max() < 0.15 * scale
+    # Greedy decisions overwhelmingly agree.
+    agree = (q_logits.argmax(-1) == ref_logits.argmax(-1)).mean()
+    assert agree >= 0.9, agree
+
+
+def test_quantized_e2e_generates(ckpt):
+    from vllm_tpu import LLM, SamplingParams
+
+    llm = LLM(
+        model=ckpt, dtype="float32", quantization="int8", max_model_len=128,
+        block_size=16, num_gpu_blocks_override=64, max_num_seqs=4,
+        max_num_batched_tokens=128,
+    )
+    outs = llm.generate(
+        [{"prompt_token_ids": [3, 14, 15]}],
+        SamplingParams(temperature=0.0, max_tokens=8, ignore_eos=True),
+    )
+    assert len(outs[0].outputs[0].token_ids) == 8
+
+
+def test_fp8_kv_cache_attention_close():
+    """FP8 KV pages dequantize to ~the f32 attention output."""
+    from vllm_tpu.ops.attention import (
+        ref_ragged_paged_attention,
+        write_kv,
+    )
+    from tests.models.test_ragged_paged_attention import _random_case
+
+    rng = np.random.default_rng(3)
+    kh, h, d, bs = 2, 4, 32, 8
+    q, kv_f32, md = _random_case(
+        rng, 2, [1, 5], [9, 13], kh, h, d, bs, num_blocks=16
+    )
+    kv_f8 = kv_f32.astype(jnp.float8_e4m3fn)
+    ref = ref_ragged_paged_attention(q, kv_f32, jnp.int32(0), md, d**-0.5)
+    got = ref_ragged_paged_attention(
+        q, kv_f8, jnp.int32(0), md, d**-0.5, k_scale=1.0, v_scale=1.0
+    )
+    np.testing.assert_allclose(
+        np.asarray(got)[:6], np.asarray(ref)[:6], rtol=0.15, atol=0.15
+    )
+
+
+def test_fp8_kv_e2e_generates(ckpt):
+    from vllm_tpu import LLM, SamplingParams
+
+    llm = LLM(
+        model=ckpt, dtype="float32", kv_cache_dtype="fp8", max_model_len=128,
+        block_size=16, num_gpu_blocks_override=64, max_num_seqs=4,
+        max_num_batched_tokens=128,
+    )
+    outs = llm.generate(
+        [{"prompt_token_ids": [3, 14, 15, 9]}],
+        SamplingParams(temperature=0.0, max_tokens=8, ignore_eos=True),
+    )
+    assert len(outs[0].outputs[0].token_ids) == 8

@@ -88,11 +88,9 @@ def test_model_step_tp4_logits_close(tiny_llama):
     ref_logits = model.compute_logits(params, hidden)
 
     mesh = Mesh(np.asarray(jax.devices()[:4]).reshape(1, 4), ("dp", "tp"))
-    shardings = jax.tree_util.tree_map(
-        lambda s: NamedSharding(mesh, s),
-        model.param_shardings(),
-        is_leaf=lambda x: not isinstance(x, dict),
-    )
+    from vllm_tpu.parallel.mesh import named_shardings
+
+    shardings = named_shardings(mesh, model.param_shardings())
     params_sh = jax.tree_util.tree_map(jax.device_put, params, shardings)
     kv_sh = jax.device_put(kv, NamedSharding(mesh, model.kv_cache_sharding()))
 

@@ -31,6 +31,9 @@ class ModelConfig:
     seed: int = 0
     max_model_len: int | None = None  # None -> derive from HF config
     revision: str | None = None
+    # Weight-only quantization: None | "int8" | "fp8" (per-output-channel,
+    # applied at load; reference: vllm/model_executor/layers/quantization/).
+    quantization: str | None = None
     # "auto" streams real weights from safetensors; "dummy" random-initializes
     # (reference: load_format="dummy", model_loader/dummy_loader.py) so engine
     # tests need no checkpoints.
@@ -43,6 +46,14 @@ class ModelConfig:
     def __post_init__(self) -> None:
         if self.tokenizer is None:
             self.tokenizer = self.model
+        if self.quantization is not None:
+            from vllm_tpu.layers.quant import QUANT_METHODS
+
+            if self.quantization not in QUANT_METHODS:
+                raise ValueError(
+                    f"unknown quantization {self.quantization!r}; "
+                    f"supported: {QUANT_METHODS}"
+                )
 
     @property
     def jax_dtype(self):
@@ -65,14 +76,31 @@ class CacheConfig:
     # Explicit block count override (tests / CPU runs). None -> profile.
     num_gpu_blocks_override: int | None = None
     enable_prefix_caching: bool = True
-    # KV cache dtype: "auto" follows model dtype.
+    # KV cache dtype: "auto" follows model dtype; "fp8"/"fp8_e4m3" and
+    # "fp8_e5m2" store KV in 8 bits (2x context capacity; kernels
+    # dequantize pages on the fly).
     cache_dtype: str = "auto"
+
+    @property
+    def jax_cache_dtype(self):
+        import jax.numpy as jnp
+
+        return {
+            "fp8": jnp.float8_e4m3fn,
+            "fp8_e4m3": jnp.float8_e4m3fn,
+            "fp8_e5m2": jnp.float8_e5m2,
+        }.get(self.cache_dtype, self.cache_dtype)
     # Populated at engine init after profiling.
     num_gpu_blocks: int | None = None
 
     def __post_init__(self) -> None:
         if self.block_size & (self.block_size - 1):
             raise ValueError(f"block_size must be a power of 2, got {self.block_size}")
+        if self.cache_dtype not in (
+            "auto", "fp8", "fp8_e4m3", "fp8_e5m2", "bfloat16", "float16",
+            "float32",
+        ):
+            raise ValueError(f"unknown cache_dtype {self.cache_dtype!r}")
 
 
 @dataclass

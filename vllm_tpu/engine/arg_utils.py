@@ -36,6 +36,7 @@ class EngineArgs:
     max_model_len: int | None = None
     load_format: str = "auto"
     revision: str | None = None
+    quantization: str | None = None
 
     block_size: int = 16
     gpu_memory_utilization: float = 0.9
@@ -81,6 +82,7 @@ class EngineArgs:
                 max_model_len=self.max_model_len,
                 load_format=self.load_format,  # type: ignore[arg-type]
                 revision=self.revision,
+                quantization=self.quantization,
                 hf_config=self.hf_config,
                 hf_overrides=self.hf_overrides,
             ),

@@ -33,9 +33,15 @@ from jax.sharding import PartitionSpec as P
 from vllm_tpu.core.kv_cache_utils import FullAttentionSpec, KVCacheSpec
 from vllm_tpu.layers.activation import silu_and_mul
 from vllm_tpu.layers.layernorm import rms_norm
+from vllm_tpu.layers.quant import QuantizedLinear, qmm, quantize_jnp
 from vllm_tpu.layers.rotary import RotaryEmbedding, _apply_rotate_half
 from vllm_tpu.logger import init_logger
-from vllm_tpu.ops.attention import AttentionMetadata, paged_attention, write_kv
+from vllm_tpu.ops.attention import (
+    AttentionMetadata,
+    kv_dequant_scale,
+    paged_attention,
+    write_kv,
+)
 
 logger = init_logger(__name__)
 
@@ -43,11 +49,16 @@ logger = init_logger(__name__)
 class LlamaForCausalLM:
     # Subclass hooks (Qwen2 etc.)
     attention_bias = False
+    # Weight-only quantized matmuls (per-output-channel int8/fp8); norms,
+    # embeddings, and lm_head stay in the model dtype.
+    QUANT_KEYS = ("wq", "wk", "wv", "wo", "wgate", "wup", "wdown")
 
-    def __init__(self, hf_config: Any, dtype=jnp.bfloat16) -> None:
+    def __init__(self, hf_config: Any, dtype=jnp.bfloat16,
+                 quantization: str | None = None) -> None:
         c = hf_config
         self.hf_config = c
         self.dtype = dtype
+        self.quantization = quantization
         self.num_layers = c.num_hidden_layers
         self.hidden_size = c.hidden_size
         self.num_heads = c.num_attention_heads
@@ -104,6 +115,9 @@ class LlamaForCausalLM:
             layers["bq"] = jnp.zeros((L, H * Dh), dtype)
             layers["bk"] = jnp.zeros((L, KH * Dh), dtype)
             layers["bv"] = jnp.zeros((L, KH * Dh), dtype)
+        if self.quantization:
+            for k in self.QUANT_KEYS:
+                layers[k] = quantize_jnp(layers[k], self.quantization)
         params = {
             "embed": init(keys[7], (V, D), D),
             "layers": layers,
@@ -171,9 +185,9 @@ class LlamaForCausalLM:
             lp, li = inputs
             h = rms_norm(x, lp["input_norm"], self.rms_eps)
 
-            q = h @ lp["wq"]
-            k = h @ lp["wk"]
-            v = h @ lp["wv"]
+            q = qmm(h, lp["wq"])
+            k = qmm(h, lp["wk"])
+            v = qmm(h, lp["wv"])
             if bias:
                 q = q + lp["bq"]
                 k = k + lp["bk"]
@@ -188,15 +202,17 @@ class LlamaForCausalLM:
             k = _apply_rotate_half(k, cos, sin, self.rope.rotary_dim)
 
             kv = write_kv(kv, li, k, v, md.slot_mapping)
+            kv_scale = kv_dequant_scale(kv, k.dtype)
             attn = paged_attention(
-                q, kv, li, md, self.scale, sliding_window=self.sliding_window
+                q, kv, li, md, self.scale, sliding_window=self.sliding_window,
+                k_scale=kv_scale, v_scale=kv_scale,
             )
-            x = x + attn.reshape(t, H * Dh) @ lp["wo"]
+            x = x + qmm(attn.reshape(t, H * Dh), lp["wo"])
 
             h2 = rms_norm(x, lp["post_norm"], self.rms_eps)
-            gate = h2 @ lp["wgate"]
-            up = h2 @ lp["wup"]
-            x = x + silu_and_mul(jnp.concatenate([gate, up], axis=-1)) @ lp["wdown"]
+            gate = qmm(h2, lp["wgate"])
+            up = qmm(h2, lp["wup"])
+            x = x + qmm(silu_and_mul(jnp.concatenate([gate, up], axis=-1)), lp["wdown"])
             return (x, kv), None
 
         # Scan over the layer stack with the WHOLE cache in the carry: the
@@ -247,6 +263,11 @@ class LlamaForCausalLM:
         }
         if self.attention_bias:
             layers |= {"bq": P(None, tp), "bk": P(None, tp), "bv": P(None, tp)}
+        if self.quantization:
+            # Scale vectors shard like the weight's output axis.
+            for k in self.QUANT_KEYS:
+                w = layers[k]
+                layers[k] = QuantizedLinear(q=w, scale=P(w[0], w[-1]))
         out = {
             "embed": P(tp, None),
             "layers": layers,
@@ -264,8 +285,9 @@ class LlamaForCausalLM:
 class MistralForCausalLM(LlamaForCausalLM):
     """Same graph; sliding window when configured."""
 
-    def __init__(self, hf_config: Any, dtype=jnp.bfloat16) -> None:
-        super().__init__(hf_config, dtype)
+    def __init__(self, hf_config: Any, dtype=jnp.bfloat16,
+                 quantization: str | None = None) -> None:
+        super().__init__(hf_config, dtype, quantization)
         self.sliding_window = getattr(hf_config, "sliding_window", None)
 
 

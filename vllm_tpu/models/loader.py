@@ -93,6 +93,12 @@ def load_safetensors_params(
         raise ValueError(f"checkpoint missing {len(missing)} weights, e.g. {sorted(missing)[:3]}")
 
     params: dict = {}
+    quant_method = getattr(model, "quantization", None)
+    quant_paths = (
+        {f"layers.{k}" for k in getattr(model, "QUANT_KEYS", ())}
+        if quant_method
+        else set()
+    )
 
     def put(leaf_path: str, arr: np.ndarray) -> None:
         sharding = None
@@ -106,6 +112,16 @@ def load_safetensors_params(
                     ok = False
                     break
             sharding = node if ok else None
+        if leaf_path in quant_paths:
+            from vllm_tpu.layers.quant import QuantizedLinear, quantize_np
+
+            qn, sn = quantize_np(arr, quant_method)
+            q, sc = jnp.asarray(qn), jnp.asarray(sn)
+            if sharding is not None:
+                q = jax.device_put(q, sharding.q)
+                sc = jax.device_put(sc, sharding.scale)
+            _set_path(params, leaf_path, QuantizedLinear(q=q, scale=sc))
+            return
         x = jnp.asarray(arr, dtype=dtype)
         if sharding is not None:
             x = jax.device_put(x, sharding)

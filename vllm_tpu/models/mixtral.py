@@ -19,12 +19,25 @@ from vllm_tpu.layers.layernorm import rms_norm
 from vllm_tpu.layers.moe import fused_moe
 from vllm_tpu.layers.rotary import _apply_rotate_half
 from vllm_tpu.models.llama import LlamaForCausalLM
-from vllm_tpu.ops.attention import AttentionMetadata, paged_attention, write_kv
+from vllm_tpu.ops.attention import (
+    AttentionMetadata,
+    kv_dequant_scale,
+    paged_attention,
+    write_kv,
+)
 
 
 class MixtralForCausalLM(LlamaForCausalLM):
-    def __init__(self, hf_config: Any, dtype=jnp.bfloat16) -> None:
-        super().__init__(hf_config, dtype)
+    def __init__(self, hf_config: Any, dtype=jnp.bfloat16,
+                 quantization: str | None = None) -> None:
+        if quantization:
+            from vllm_tpu.logger import init_logger
+
+            init_logger(__name__).warning(
+                "weight quantization is not yet supported for MoE models; "
+                "running %s unquantized", type(self).__name__,
+            )
+        super().__init__(hf_config, dtype, quantization=None)
         self.num_experts = hf_config.num_local_experts
         self.top_k = hf_config.num_experts_per_tok
         self.sliding_window = getattr(hf_config, "sliding_window", None)
@@ -102,8 +115,10 @@ class MixtralForCausalLM(LlamaForCausalLM):
             q = _apply_rotate_half(q, cos, sin, self.rope.rotary_dim)
             k = _apply_rotate_half(k, cos, sin, self.rope.rotary_dim)
             kv = write_kv(kv, li, k, v, md.slot_mapping)
+            kv_scale = kv_dequant_scale(kv, k.dtype)
             attn = paged_attention(
-                q, kv, li, md, self.scale, sliding_window=self.sliding_window
+                q, kv, li, md, self.scale, sliding_window=self.sliding_window,
+                k_scale=kv_scale, v_scale=kv_scale,
             )
             x = x + attn.reshape(t, H * Dh) @ lp["wo"]
 

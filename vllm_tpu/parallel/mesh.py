@@ -45,3 +45,18 @@ def build_mesh(parallel_config: ParallelConfig, devices=None) -> Mesh:
     mesh = Mesh(grid, (AXIS_DP, AXIS_PP, AXIS_CP, AXIS_TP))
     logger.info("device mesh: %s", dict(zip(mesh.axis_names, mesh.devices.shape)))
     return mesh
+
+
+def named_shardings(mesh: Mesh, specs):
+    """PartitionSpec pytree -> NamedSharding pytree.
+
+    Descends registered dataclass nodes (e.g. QuantizedLinear), treating
+    only PartitionSpec values as leaves.
+    """
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    return jax.tree_util.tree_map(
+        lambda s: NamedSharding(mesh, s),
+        specs,
+        is_leaf=lambda x: isinstance(x, PartitionSpec),
+    )

@@ -136,7 +136,9 @@ class ModelRunner:
             model.head_dim,
         )
         kv_dtype = (
-            model.dtype if cache.cache_dtype == "auto" else jnp.dtype(cache.cache_dtype)
+            model.dtype
+            if cache.cache_dtype == "auto"
+            else jnp.dtype(cache.jax_cache_dtype)
         )
         kv_sharding = None
         if mesh is not None:

@@ -114,18 +114,15 @@ class Worker:
             mc.max_model_len = getattr(hf_config, "max_position_embeddings", 8192)
         self.config.scheduler_config.max_model_len = mc.max_model_len
         model_cls = get_model_class(hf_config)
-        self.model = model_cls(hf_config, dtype=mc.jax_dtype)
+        self.model = model_cls(
+            hf_config, dtype=mc.jax_dtype, quantization=mc.quantization
+        )
 
         shardings = None
         if self.mesh is not None:
-            from jax.sharding import NamedSharding
+            from vllm_tpu.parallel.mesh import named_shardings
 
-            specs = self.model.param_shardings()
-            shardings = jax.tree_util.tree_map(
-                lambda s: NamedSharding(self.mesh, s),
-                specs,
-                is_leaf=lambda x: not isinstance(x, dict),
-            )
+            shardings = named_shardings(self.mesh, self.model.param_shardings())
         if mc.load_format == "dummy":
             from vllm_tpu.models.loader import init_dummy_params
 
@@ -145,8 +142,13 @@ class Worker:
         if cache.num_gpu_blocks_override is not None:
             return cache.num_gpu_blocks_override
 
+        kv_dtype = (
+            self.config.model_config.jax_dtype
+            if cache.cache_dtype == "auto"
+            else cache.jax_cache_dtype
+        )
         specs = self.model.get_kv_cache_spec(
-            cache.block_size, jnp.dtype(self.config.model_config.jax_dtype).itemsize
+            cache.block_size, jnp.dtype(kv_dtype).itemsize
         )
         stats = getattr(self.device, "memory_stats", lambda: None)()
         if stats and "bytes_limit" in stats:
