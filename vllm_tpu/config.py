@@ -92,6 +92,9 @@ class CacheConfig:
         }.get(self.cache_dtype, self.cache_dtype)
     # Populated at engine init after profiling.
     num_gpu_blocks: int | None = None
+    # Populated at model load from the model's attention window (None =
+    # full attention); drives out-of-window block freeing.
+    sliding_window: int | None = None
 
     def __post_init__(self) -> None:
         if self.block_size & (self.block_size - 1):

@@ -126,11 +126,14 @@ class BlockPool:
 
     def free_blocks(self, ordered_blocks: list[KVCacheBlock]) -> None:
         """Deref blocks; those reaching 0 go to the free-queue tail in the
-        given order (caller passes tail-first for LRU-friendly eviction)."""
+        given order (caller passes tail-first for LRU-friendly eviction).
+        Null-block stand-ins (sliding-window freed slots) are skipped."""
         for block in ordered_blocks:
+            if block.is_null:
+                continue
             block.decr_ref()
             assert block.ref_cnt >= 0, f"double-free of block {block.block_id}"
-            if block.ref_cnt == 0 and not block.is_null:
+            if block.ref_cnt == 0:
                 self.free_block_queue.append(block)
 
     def reset_prefix_cache(self) -> bool:

@@ -40,12 +40,24 @@ class KVCacheManager:
         num_blocks: int,
         block_size: int,
         enable_caching: bool = True,
+        sliding_window: int | None = None,
     ) -> None:
         self.block_size = block_size
+        # Sliding-window models free blocks that fall fully out of the
+        # window (reference: single_type_kv_cache_manager.py:507
+        # SlidingWindowManager.remove_skipped_blocks) — prefix caching is
+        # disabled for them (a cached block may be a freed null stand-in;
+        # the reference's window-aware hit logic is future work).
+        self.sliding_window = sliding_window
+        if sliding_window is not None:
+            enable_caching = False  # safety net; the worker flips the flag
         self.enable_caching = enable_caching
         self.block_pool = BlockPool(num_blocks, enable_caching)
 
         self.req_to_blocks: dict[str, list[KVCacheBlock]] = {}
+        # Sliding window: first not-yet-freed block index per request, so
+        # each block is nulled exactly once (no O(seq_len) rescans).
+        self._first_live_blk: dict[str, int] = {}
         # How many leading blocks of each request are already registered in
         # the prefix cache (avoids re-hashing on every allocate).
         self.num_cached_blocks: dict[str, int] = {}
@@ -129,9 +141,45 @@ class KVCacheManager:
             new_blocks = self.block_pool.get_new_blocks(num_new_blocks)
             req_blocks.extend(new_blocks)
 
+        if self.sliding_window is not None:
+            self._free_out_of_window(request, req_blocks)
         if self.enable_caching:
             self._cache_full_blocks(request, num_computed_tokens + num_new_tokens)
         return new_blocks
+
+    def _free_out_of_window(
+        self, request: Request, req_blocks: list[KVCacheBlock]
+    ) -> None:
+        """Replace blocks wholly below the attention window with the null
+        block and return them to the pool. Freed entries stay in the
+        runner's block table; reads of them are window-masked, and the
+        slots are never written again.
+
+        The floor uses only ROLLBACK-PROOF tokens: the pre-step computed
+        count minus in-flight placeholders and pending drafts (async
+        scheduling advances counts optimistically; spec verification can
+        roll computed back within the current step's range)."""
+        confirmed = (
+            request.num_computed_tokens
+            - request.num_output_placeholders
+            - len(request.spec_token_ids)
+        )
+        # Query at position p attends keys in (p - window, p].
+        first_needed_tok = max(0, confirmed - self.sliding_window + 1)
+        first_needed_blk = min(
+            first_needed_tok // self.block_size, len(req_blocks)
+        )
+        null = self.block_pool.null_block
+        start = self._first_live_blk.get(request.request_id, 0)
+        for i in range(start, first_needed_blk):
+            b = req_blocks[i]
+            if b.is_null:
+                continue
+            req_blocks[i] = null
+            self.block_pool.free_blocks([b])
+        self._first_live_blk[request.request_id] = max(
+            start, first_needed_blk
+        )
 
     def _cache_full_blocks(self, request: Request, num_tokens_after_step: int) -> None:
         """Register every block that becomes full this step. Speculative
@@ -160,6 +208,7 @@ class KVCacheManager:
         of the sequence before its (more reusable) prefix."""
         blocks = self.req_to_blocks.pop(request.request_id, [])
         self.num_cached_blocks.pop(request.request_id, None)
+        self._first_live_blk.pop(request.request_id, None)
         self.block_pool.free_blocks(list(reversed(blocks)))
 
     # ------------------------------------------------------------------

@@ -106,6 +106,7 @@ class Scheduler:
             num_blocks=cache_config.num_gpu_blocks,
             block_size=cache_config.block_size,
             enable_caching=cache_config.enable_prefix_caching,
+            sliding_window=cache_config.sliding_window,
         )
         self.block_size = cache_config.block_size
         self.structured_output_manager = structured_output_manager

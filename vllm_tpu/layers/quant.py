@@ -33,38 +33,34 @@ class QuantizedLinear:
     scale: jnp.ndarray
 
 
-def quantize_np(arr: np.ndarray, method: str) -> tuple[np.ndarray, np.ndarray]:
-    """Host-side quantization (loader path). ``arr [..., in, out]``."""
-    import ml_dtypes
-
-    arr = np.asarray(arr, np.float32)
-    amax = np.abs(arr).max(axis=-2, keepdims=True)
+def _quantize(arr, method: str, xp, int8_t, fp8_t):
+    """Shared scheme (one implementation for host and device paths)."""
+    arr = arr.astype(xp.float32) if xp is jnp else np.asarray(arr, np.float32)
+    amax = xp.abs(arr).max(axis=-2, keepdims=True)
     qmax = 127.0 if method == "int8" else 448.0
-    scale = np.maximum(amax / qmax, 1e-8).astype(np.float32)
+    scale = xp.maximum(amax / qmax, 1e-8)
     q = arr / scale
     if method == "int8":
-        q = np.rint(q).clip(-127, 127).astype(np.int8)
+        q = xp.rint(q).clip(-127, 127).astype(int8_t)
     elif method == "fp8":
-        q = q.astype(ml_dtypes.float8_e4m3fn)
+        q = q.astype(fp8_t)
     else:
         raise ValueError(f"unknown quantization method {method!r}")
     return q, scale.squeeze(-2)
 
 
+def quantize_np(arr: np.ndarray, method: str) -> tuple[np.ndarray, np.ndarray]:
+    """Host-side quantization (loader path). ``arr [..., in, out]``."""
+    import ml_dtypes
+
+    q, scale = _quantize(arr, method, np, np.int8, ml_dtypes.float8_e4m3fn)
+    return q, scale.astype(np.float32)
+
+
 def quantize_jnp(arr: jnp.ndarray, method: str) -> QuantizedLinear:
     """Device-side quantization (dummy-weight path)."""
-    arr = arr.astype(jnp.float32)
-    amax = jnp.abs(arr).max(axis=-2, keepdims=True)
-    qmax = 127.0 if method == "int8" else 448.0
-    scale = jnp.maximum(amax / qmax, 1e-8)
-    q = arr / scale
-    if method == "int8":
-        q = jnp.rint(q).clip(-127, 127).astype(jnp.int8)
-    elif method == "fp8":
-        q = q.astype(jnp.float8_e4m3fn)
-    else:
-        raise ValueError(f"unknown quantization method {method!r}")
-    return QuantizedLinear(q=q, scale=scale.squeeze(-2))
+    q, scale = _quantize(arr, method, jnp, jnp.int8, jnp.float8_e4m3fn)
+    return QuantizedLinear(q=q, scale=scale)
 
 
 def qmm(x: jnp.ndarray, w) -> jnp.ndarray:

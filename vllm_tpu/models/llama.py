@@ -202,7 +202,7 @@ class LlamaForCausalLM:
             k = _apply_rotate_half(k, cos, sin, self.rope.rotary_dim)
 
             kv = write_kv(kv, li, k, v, md.slot_mapping)
-            kv_scale = kv_dequant_scale(kv, k.dtype)
+            kv_scale = kv_dequant_scale(kv)
             attn = paged_attention(
                 q, kv, li, md, self.scale, sliding_window=self.sliding_window,
                 k_scale=kv_scale, v_scale=kv_scale,
@@ -236,12 +236,23 @@ class LlamaForCausalLM:
     # ------------------------------------------------------------------
 
     def get_kv_cache_spec(self, block_size: int, dtype_bytes: int) -> dict[str, KVCacheSpec]:
-        spec = FullAttentionSpec(
-            block_size=block_size,
-            num_kv_heads=self.num_kv_heads,
-            head_size=self.head_dim,
-            dtype_bytes=dtype_bytes,
-        )
+        if self.sliding_window is not None:
+            from vllm_tpu.core.kv_cache_utils import SlidingWindowSpec
+
+            spec: KVCacheSpec = SlidingWindowSpec(
+                block_size=block_size,
+                num_kv_heads=self.num_kv_heads,
+                head_size=self.head_dim,
+                dtype_bytes=dtype_bytes,
+                sliding_window=self.sliding_window,
+            )
+        else:
+            spec = FullAttentionSpec(
+                block_size=block_size,
+                num_kv_heads=self.num_kv_heads,
+                head_size=self.head_dim,
+                dtype_bytes=dtype_bytes,
+            )
         return {f"layers.{i}": spec for i in range(self.num_layers)}
 
     def param_shardings(self, data_axis: str | None = None, model_axis: str = "tp") -> dict:

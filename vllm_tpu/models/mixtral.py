@@ -115,7 +115,7 @@ class MixtralForCausalLM(LlamaForCausalLM):
             q = _apply_rotate_half(q, cos, sin, self.rope.rotary_dim)
             k = _apply_rotate_half(k, cos, sin, self.rope.rotary_dim)
             kv = write_kv(kv, li, k, v, md.slot_mapping)
-            kv_scale = kv_dequant_scale(kv, k.dtype)
+            kv_scale = kv_dequant_scale(kv)
             attn = paged_attention(
                 q, kv, li, md, self.scale, sliding_window=self.sliding_window,
                 k_scale=kv_scale, v_scale=kv_scale,

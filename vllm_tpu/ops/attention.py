@@ -72,12 +72,10 @@ def kv_cache_shape(
     return (num_layers, num_blocks, block_size, 2 * num_kv_heads, head_dim)
 
 
-def kv_dequant_scale(kv_cache, compute_dtype) -> float | None:
+def kv_dequant_scale(kv_cache) -> float | None:
     """Dequant scale for quantized (fp8) KV pages: values are cast, not
     scaled, on insert, so the scale is 1.0; None = no dequant needed."""
-    import jax.numpy as _jnp
-
-    if kv_cache.dtype in (_jnp.float8_e4m3fn, _jnp.float8_e5m2):
+    if kv_cache.dtype in (jnp.float8_e4m3fn, jnp.float8_e5m2):
         return 1.0
     return None
 

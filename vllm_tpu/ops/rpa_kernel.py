@@ -125,6 +125,21 @@ def _rpa_kernel(
     q_len_start = q_blk_idx * num_q_per_blk
     q_len_end = q_len_start + num_q_per_blk
 
+    def seq_start_blk(seq_idx):
+        """First KV block the window can reach for this seq's queries.
+
+        A function of seq_idx ONLY (not the q block) so the prefetch chain
+        and the compute loop always agree on the DMA sequence. The seq's
+        lowest query position is kv_len - q_len; its window floor is that
+        minus (window - 1)."""
+        if sliding_window is None:
+            return 0
+        q_len = cu_q_lens_ref[seq_idx + 1] - cu_q_lens_ref[seq_idx]
+        first_tok = jnp.maximum(
+            kv_lens_ref[seq_idx] - q_len - (sliding_window - 1), 0
+        )
+        return first_tok // num_kv_per_blk
+
     def make_page_copy(heads_blk_idx, seq_idx, kv_blk_idx, buf_idx):
         start_page = kv_blk_idx * num_kv_pages_per_blk
         end_page = jnp.minimum(
@@ -186,7 +201,10 @@ def _rpa_kernel(
 
     @pl.when(heads_blk_idx + q_blk_idx == 0)
     def prefetch_first_kv_blk():
-        make_page_copy(heads_blk_idx, init_seq_idx, 0, init_buf_idx).start()
+        make_page_copy(
+            heads_blk_idx, init_seq_idx, seq_start_blk(init_seq_idx),
+            init_buf_idx,
+        ).start()
 
     def is_cur_q_blk_needed(q_states):
         done, cur_seq_idx, _ = q_states
@@ -206,7 +224,6 @@ def _rpa_kernel(
                                   cur_buf_idx):
             next_kv_blk_idx = kv_blk_idx + 1
             is_last_kv_blk = next_kv_blk_idx * num_kv_per_blk >= kv_len
-            next_kv_blk_idx = lax.select(is_last_kv_blk, 0, next_kv_blk_idx)
             is_seq_end_in_blk = q_end <= q_len_end
             next_seq_idx = lax.select(
                 is_last_kv_blk,
@@ -215,6 +232,9 @@ def _rpa_kernel(
             )
             is_last_seq = next_seq_idx == num_seqs
             next_seq_idx = lax.select(is_last_seq, 0, next_seq_idx)
+            next_kv_blk_idx = lax.select(
+                is_last_kv_blk, seq_start_blk(next_seq_idx), next_kv_blk_idx
+            )
             next_heads_blk_idx = lax.select(
                 is_last_seq, heads_blk_idx + 1, heads_blk_idx
             )
@@ -222,7 +242,7 @@ def _rpa_kernel(
             return next_heads_blk_idx, next_seq_idx, next_kv_blk_idx, next_buf_idx
 
         def flash_attention(q, k, v, head_l_ref, head_m_ref, head_acc_ref, *,
-                            kv_blk_idx):
+                            kv_blk_idx, start_blk):
             assert q.shape == (num_q_per_blk * num_q_heads_per_kv_head, head_dim)
             assert k.shape == v.shape == (num_kv_per_blk, head_dim)
             kv_len_start = kv_blk_idx * num_kv_per_blk
@@ -235,7 +255,8 @@ def _rpa_kernel(
 
             def load_with_init(ref, init_val):
                 return jnp.where(
-                    kv_blk_idx == 0, jnp.full_like(ref, init_val), ref[...]
+                    kv_blk_idx == start_blk, jnp.full_like(ref, init_val),
+                    ref[...],
                 )
 
             # KV rows beyond kv_len are garbage; zero them so the
@@ -397,13 +418,15 @@ def _rpa_kernel(
                             + num_q_heads_per_kv_head, :
                         ],
                         kv_blk_idx=kv_blk_idx,
+                        start_blk=cur_start_blk,
                     )
             return kv_blk_idx + 1, next_buf_idx
 
+        cur_start_blk = seq_start_blk(cur_seq_idx)
         _, next_buf_idx = lax.while_loop(
             is_valid_kv_blk_in_cur_seq,
             compute_with_kv_blk_in_cur_seq,
-            (0, cur_buf_idx),
+            (cur_start_blk, cur_buf_idx),
         )
         next_seq_idx = lax.select(q_end <= q_len_end, cur_seq_idx + 1,
                                   cur_seq_idx)
