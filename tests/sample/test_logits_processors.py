@@ -1,0 +1,153 @@
+"""Logits processors through the full engine: logit_bias, bad_words,
+allowed_token_ids, and min_tokens EOS suppression.
+
+Reference analog: ``vllm/v1/sample/logits_processor/`` behavior tests.
+"""
+
+from __future__ import annotations
+
+import pytest
+
+from tests.models.utils import tiny_llama_dir_with_tokenizer
+from vllm_tpu import LLM, SamplingParams
+
+
+@pytest.fixture(scope="module")
+def llm(tmp_path_factory):
+    d = tiny_llama_dir_with_tokenizer(tmp_path_factory.mktemp("tiny_lp"))
+    return LLM(
+        model=d, dtype="float32", max_model_len=128, block_size=16,
+        num_gpu_blocks_override=64, max_num_seqs=8,
+        max_num_batched_tokens=128,
+    )
+
+
+def test_logit_bias_forces_token(llm):
+    forced = 42
+    outs = llm.generate(
+        [{"prompt_token_ids": [5, 9]}],
+        SamplingParams(
+            temperature=0.0, max_tokens=4, ignore_eos=True,
+            logit_bias={forced: 100.0},
+        ),
+    )
+    assert outs[0].outputs[0].token_ids == [forced] * 4
+
+
+def test_logit_bias_negative_bans_token(llm):
+    base = llm.generate(
+        [{"prompt_token_ids": [5, 9]}],
+        SamplingParams(temperature=0.0, max_tokens=1, ignore_eos=True),
+    )[0].outputs[0].token_ids[0]
+    banned = llm.generate(
+        [{"prompt_token_ids": [5, 9]}],
+        SamplingParams(
+            temperature=0.0, max_tokens=1, ignore_eos=True,
+            logit_bias={base: -100.0},
+        ),
+    )[0].outputs[0].token_ids[0]
+    assert banned != base
+
+
+def test_allowed_token_ids_restricts(llm):
+    allowed = [7, 11, 13]
+    outs = llm.generate(
+        [{"prompt_token_ids": [5, 9]}],
+        SamplingParams(
+            temperature=0.8, seed=1, max_tokens=8, ignore_eos=True,
+            allowed_token_ids=allowed,
+        ),
+    )
+    assert all(t in allowed for t in outs[0].outputs[0].token_ids)
+
+
+def test_allowlist_mixed_with_plain_row(llm):
+    """Regression: a batch mixing allowlisted and plain rows must not
+    crash sizing, and the plain row stays unrestricted."""
+    outs = llm.generate(
+        [{"prompt_token_ids": [5, 9]}, {"prompt_token_ids": [5, 9]}],
+        [
+            SamplingParams(
+                temperature=0.8, seed=1, max_tokens=6, ignore_eos=True,
+                allowed_token_ids=[7, 11],
+            ),
+            SamplingParams(temperature=0.0, max_tokens=6, ignore_eos=True),
+        ],
+    )
+    assert all(t in (7, 11) for t in outs[0].outputs[0].token_ids)
+    plain = llm.generate(
+        [{"prompt_token_ids": [5, 9]}],
+        SamplingParams(temperature=0.0, max_tokens=6, ignore_eos=True),
+    )[0].outputs[0].token_ids
+    assert outs[1].outputs[0].token_ids == plain
+
+
+def test_min_tokens_suppresses_eos(llm):
+    eos = llm.llm_engine.tokenizer.eos_token_id
+    outs = llm.generate(
+        [{"prompt_token_ids": [5, 9]}],
+        SamplingParams(
+            temperature=0.0, max_tokens=12, min_tokens=10,
+            logit_bias={eos: 100.0},  # EOS would win every step otherwise
+        ),
+    )
+    toks = outs[0].outputs[0].token_ids
+    # EOS masked for the first 10 tokens, then the bias makes it win.
+    assert len(toks) == 11
+    assert toks[-1] == eos
+    assert all(t != eos for t in toks[:-1])
+
+
+def test_bad_words_never_generated(llm):
+    # Find the natural greedy continuation, then ban its text form.
+    base = llm.generate(
+        ["ab"], SamplingParams(temperature=0.0, max_tokens=6, ignore_eos=True)
+    )[0].outputs[0]
+    tok = llm.llm_engine.tokenizer
+    first_text = tok.decode([base.token_ids[0]])
+    outs = llm.generate(
+        ["ab"],
+        SamplingParams(
+            temperature=0.0, max_tokens=6, ignore_eos=True,
+            bad_words=[first_text],
+        ),
+    )
+    assert outs[0].outputs[0].token_ids[0] != base.token_ids[0]
+
+
+def test_multi_token_bad_word_suffix_match(llm):
+    """A 2-token bad word only bans the 2nd token after the 1st appears."""
+    base = llm.generate(
+        [{"prompt_token_ids": [5, 9]}],
+        SamplingParams(temperature=0.0, max_tokens=3, ignore_eos=True),
+    )[0].outputs[0].token_ids
+    tok = llm.llm_engine.tokenizer
+    bad = tok.decode(base[:2])
+    outs = llm.generate(
+        [{"prompt_token_ids": [5, 9]}],
+        SamplingParams(
+            temperature=0.0, max_tokens=3, ignore_eos=True, bad_words=[bad]
+        ),
+    )
+    got = outs[0].outputs[0].token_ids
+    # Sequence may start the same but must diverge at the banned position.
+    assert got[:2] != base[:2]
+
+
+def test_mixed_batch_processors_and_plain(llm):
+    outs = llm.generate(
+        [{"prompt_token_ids": [5, 9]}, {"prompt_token_ids": [5, 9]}],
+        [
+            SamplingParams(
+                temperature=0.0, max_tokens=4, ignore_eos=True,
+                logit_bias={42: 100.0},
+            ),
+            SamplingParams(temperature=0.0, max_tokens=4, ignore_eos=True),
+        ],
+    )
+    assert outs[0].outputs[0].token_ids == [42] * 4
+    plain = llm.generate(
+        [{"prompt_token_ids": [5, 9]}],
+        SamplingParams(temperature=0.0, max_tokens=4, ignore_eos=True),
+    )[0].outputs[0].token_ids
+    assert outs[1].outputs[0].token_ids == plain

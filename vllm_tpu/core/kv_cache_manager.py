@@ -109,6 +109,12 @@ class KVCacheManager:
         new_computed_blocks = new_computed_blocks or []
 
         req_blocks = self.req_to_blocks.setdefault(request.request_id, [])
+        # Reclaim this request's own out-of-window blocks BEFORE the
+        # availability check, so a full pool with reclaimable blocks does
+        # not spuriously preempt (entries become null stand-ins; list
+        # length, and thus the required-block math, is unchanged).
+        if self.sliding_window is not None:
+            self._free_out_of_window(request, req_blocks)
         num_computed_tokens = request.num_computed_tokens + num_new_computed_tokens
         # Lookahead covers speculative positions whose KV lands this step.
         num_required_blocks = ceil(
@@ -141,8 +147,6 @@ class KVCacheManager:
             new_blocks = self.block_pool.get_new_blocks(num_new_blocks)
             req_blocks.extend(new_blocks)
 
-        if self.sliding_window is not None:
-            self._free_out_of_window(request, req_blocks)
         if self.enable_caching:
             self._cache_full_blocks(request, num_computed_tokens + num_new_tokens)
         return new_blocks

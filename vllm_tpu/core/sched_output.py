@@ -23,6 +23,7 @@ class NewRequestData:
     num_computed_tokens: int
     lora_name: str | None = None
     mm_inputs: list[Any] | None = None
+    eos_token_id: int | None = None
 
 
 @dataclass

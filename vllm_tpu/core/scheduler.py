@@ -39,6 +39,13 @@ from vllm_tpu.request import Request, RequestStatus
 logger = init_logger(__name__)
 
 
+def _needs_logits_processors(p) -> bool:
+    return bool(
+        p.logit_bias or p.bad_words or p.bad_words_token_ids
+        or p.allowed_token_ids is not None or p.min_tokens
+    )
+
+
 class RequestQueue:
     """FCFS by default; priority policy orders by (priority, arrival).
 
@@ -201,11 +208,14 @@ class Scheduler:
         # point — schedule time — rather than trusting the runner's
         # finalize-time view, which races with request admission.
         if any(r.spec_token_ids for r in self.running) and any(
-            r.sampling_params.logprobs is not None or r.use_structured_output
+            r.sampling_params.logprobs is not None
+            or r.use_structured_output
+            or _needs_logits_processors(r.sampling_params)
             for r in (*self.running, *self.waiting)
         ):
-            # (Also incompatible with structured output: the rejection
-            # sampler has no grammar-mask path.)
+            # (Also incompatible with structured output and logits
+            # processors: the rejection sampler applies neither grammar
+            # masks nor bias/ban adjustments.)
             for r in self.running:
                 r.spec_token_ids = []
 
@@ -219,9 +229,12 @@ class Scheduler:
             # requests cap at 2 — the in-jit token-count correction covers
             # exactly one not-yet-materialized token.
             p = request.sampling_params
-            if request.use_structured_output:
-                # The next step's grammar bitmask depends on the in-flight
-                # token's FSM transition — no scheduling ahead.
+            if request.use_structured_output or (
+                p.bad_words_token_ids
+                and any(len(seq) > 1 for seq in p.bad_words_token_ids)
+            ):
+                # The next step's grammar bitmask / bad-words suffix match
+                # depends on the in-flight token — no scheduling ahead.
                 depth_cap = 1
             elif (p.presence_penalty or p.frequency_penalty
                   or p.repetition_penalty != 1.0):
@@ -388,6 +401,7 @@ class Scheduler:
                         block_ids=all_block_ids,
                         num_computed_tokens=request.num_computed_tokens,
                         lora_name=request.lora_name,
+                        eos_token_id=request.eos_token_id,
                     )
                 )
             num_scheduled_tokens[request.request_id] = num_new_tokens

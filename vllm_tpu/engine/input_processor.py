@@ -123,4 +123,23 @@ class InputProcessor:
         max_len = self.config.scheduler_config.max_model_len
         cap = max_len - prompt_len
         max_tokens = params.max_tokens if params.max_tokens is not None else cap
-        return replace(params, max_tokens=min(max_tokens, cap))
+        bad_words_token_ids = params.bad_words_token_ids
+        if params.bad_words and bad_words_token_ids is None:
+            if self.tokenizer is None:
+                raise ValueError("bad_words requires a tokenizer")
+            # Both surface forms (word-initial and mid-text) like the
+            # reference (vllm/v1/sample/logits_processor bad-words prep).
+            seqs = []
+            for w in params.bad_words:
+                for variant in (w, " " + w):
+                    ids = self.tokenizer.encode(
+                        variant, add_special_tokens=False
+                    )
+                    if ids and ids not in seqs:
+                        seqs.append(ids)
+            bad_words_token_ids = seqs
+        return replace(
+            params,
+            max_tokens=min(max_tokens, cap),
+            bad_words_token_ids=bad_words_token_ids,
+        )

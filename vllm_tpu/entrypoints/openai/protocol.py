@@ -19,6 +19,31 @@ from vllm_tpu.sampling_params import (
 )
 
 
+def _token_id_list(d: dict, key: str) -> list[int] | None:
+    v = d.get(key)
+    if v is None:
+        return None
+    if not isinstance(v, list):
+        raise ValidationError(f"{key} must be a list of token ids")
+    try:
+        return [int(t) for t in v]
+    except (TypeError, ValueError):
+        raise ValidationError(f"{key} must contain integers") from None
+
+
+def _logit_bias(d: dict) -> dict[int, float] | None:
+    """OpenAI logit_bias: {"<token id>": bias} with string keys."""
+    lb = d.get("logit_bias")
+    if lb is None:
+        return None
+    if not isinstance(lb, dict):
+        raise ValidationError("logit_bias must be an object")
+    try:
+        return {int(k): float(v) for k, v in lb.items()}
+    except (TypeError, ValueError) as e:
+        raise ValidationError(f"invalid logit_bias: {e}") from None
+
+
 def _structured_outputs(d: dict) -> StructuredOutputParams | None:
     """OpenAI ``response_format`` plus the reference's ``guided_*``
     extension fields -> StructuredOutputParams."""
@@ -84,6 +109,9 @@ class CompletionRequest:
     ignore_eos: bool = False
     min_tokens: int = 0
     structured_outputs: Any = None
+    logit_bias: dict[int, float] | None = None
+    bad_words: list[str] = field(default_factory=list)
+    allowed_token_ids: list[int] | None = None
 
     @classmethod
     def from_json(cls, d: dict) -> "CompletionRequest":
@@ -112,6 +140,9 @@ class CompletionRequest:
             ignore_eos=bool(d.get("ignore_eos", False)),
             min_tokens=_get(d, "min_tokens", int, 0),
             structured_outputs=_structured_outputs(d),
+            logit_bias=_logit_bias(d),
+            bad_words=list(d.get("bad_words") or []),
+            allowed_token_ids=_token_id_list(d, "allowed_token_ids"),
         )
 
     def to_sampling_params(self, stream: bool) -> SamplingParams:
@@ -130,6 +161,9 @@ class CompletionRequest:
             ignore_eos=self.ignore_eos,
             min_tokens=self.min_tokens,
             structured_outputs=self.structured_outputs,
+            logit_bias=self.logit_bias,
+            bad_words=self.bad_words,
+            allowed_token_ids=self.allowed_token_ids,
             output_kind=(
                 RequestOutputKind.DELTA if stream
                 else RequestOutputKind.FINAL_ONLY
@@ -160,6 +194,9 @@ class ChatCompletionRequest:
     chat_template: str | None = None
     add_generation_prompt: bool = True
     structured_outputs: Any = None
+    logit_bias: dict[int, float] | None = None
+    bad_words: list[str] = field(default_factory=list)
+    allowed_token_ids: list[int] | None = None
 
     @classmethod
     def from_json(cls, d: dict) -> "ChatCompletionRequest":
@@ -195,6 +232,9 @@ class ChatCompletionRequest:
             chat_template=d.get("chat_template"),
             add_generation_prompt=bool(d.get("add_generation_prompt", True)),
             structured_outputs=_structured_outputs(d),
+            logit_bias=_logit_bias(d),
+            bad_words=list(d.get("bad_words") or []),
+            allowed_token_ids=_token_id_list(d, "allowed_token_ids"),
         )
 
     def to_sampling_params(self, stream: bool) -> SamplingParams:
@@ -216,6 +256,9 @@ class ChatCompletionRequest:
             ignore_eos=self.ignore_eos,
             min_tokens=self.min_tokens,
             structured_outputs=self.structured_outputs,
+            logit_bias=self.logit_bias,
+            bad_words=self.bad_words,
+            allowed_token_ids=self.allowed_token_ids,
             output_kind=(
                 RequestOutputKind.DELTA if stream
                 else RequestOutputKind.FINAL_ONLY

@@ -58,6 +58,8 @@ class SamplingParams:
     detokenize: bool = True
     output_kind: RequestOutputKind = RequestOutputKind.CUMULATIVE
     bad_words: list[str] = field(default_factory=list)
+    # Filled by the input processor (tokenized bad_words variants).
+    bad_words_token_ids: list[list[int]] | None = None
     allowed_token_ids: list[int] | None = None
     logit_bias: dict[int, float] | None = None
     structured_outputs: StructuredOutputParams | None = None
@@ -67,6 +69,19 @@ class SamplingParams:
     def __post_init__(self) -> None:
         if isinstance(self.stop, str):
             self.stop = [self.stop]
+        if self.logit_bias is not None and len(self.logit_bias) > 512:
+            raise ValueError("logit_bias supports at most 512 entries")
+        if self.allowed_token_ids is not None:
+            if not self.allowed_token_ids:
+                raise ValueError("allowed_token_ids must be non-empty")
+            if len(self.allowed_token_ids) > 512:
+                raise ValueError(
+                    "allowed_token_ids supports at most 512 entries"
+                )
+            if not all(isinstance(t, int) for t in self.allowed_token_ids):
+                raise ValueError("allowed_token_ids must be integers")
+        if len(self.bad_words) > 128:
+            raise ValueError("bad_words supports at most 128 entries")
         if self.temperature < 0:
             raise ValueError(f"temperature must be >= 0, got {self.temperature}")
         if not 0 < self.top_p <= 1:

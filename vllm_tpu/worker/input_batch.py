@@ -23,15 +23,29 @@ class CachedRequestState:
         "num_tokens",
         "generated",
         "in_batch_row",
+        "eos_token_id",
+        "needs_logit_adjust",
     )
 
-    def __init__(self, req_id: str, sampling_params: SamplingParams) -> None:
+    def __init__(self, req_id: str, sampling_params: SamplingParams,
+                 eos_token_id: int | None = None) -> None:
         self.req_id = req_id
         self.sampling_params = sampling_params
         self.num_computed_tokens = 0
         self.num_tokens = 0
         self.generated = 0  # sampled so far (drives seeded PRNG streams)
         self.in_batch_row = -1
+        self.eos_token_id = eos_token_id
+        p = sampling_params
+        # Per-request logits-processor work (bias / bans / min-tokens EOS
+        # suppression); cached so the no-adjustment common path costs one
+        # bool check per row.
+        self.needs_logit_adjust = bool(
+            p.logit_bias
+            or p.bad_words_token_ids
+            or (p.min_tokens and (eos_token_id is not None
+                                  or p.stop_token_ids))
+        )
 
 
 class InputBatch:
@@ -76,7 +90,9 @@ class InputBatch:
         req_id = data.req_id
         self.req_ids[row] = req_id
 
-        state = CachedRequestState(req_id, data.sampling_params)
+        state = CachedRequestState(
+            req_id, data.sampling_params, data.eos_token_id
+        )
         state.in_batch_row = row
         state.num_computed_tokens = data.num_computed_tokens
         state.num_tokens = len(data.prompt_token_ids)

@@ -100,6 +100,8 @@ class ModelRunner:
         # transition would recompile: current-bucket x previous-bucket).
         self._last_sampled = None
         self._max_pipeline_depth = sched.async_pipeline_depth
+        # Sparse logits-processor entry-count buckets (static trace dims).
+        self._adj_buckets = [4, 16, 64, 512]
         self._max_r = self.request_buckets[-1]
         self._zero_sampled = jnp.zeros(self._max_r, jnp.int32)
         self._prev_rows: dict[str, int] = {}
@@ -171,6 +173,8 @@ class ModelRunner:
                 "needs_grammar",
                 "num_logprobs",
                 "num_spec",
+                "num_adj",
+                "num_allow",
             ),
             donate_argnums=(1,),
         )
@@ -187,7 +191,8 @@ class ModelRunner:
     # ------------------------------------------------------------------
 
     @staticmethod
-    def _unpack(ibuf, fbuf, counts, prompt_mask, t, r, b, num_spec=0):
+    def _unpack(ibuf, fbuf, counts, prompt_mask, t, r, b, num_spec=0,
+                num_adj=0, num_allow=0):
         """Split the two packed host buffers back into metadata pytrees.
 
         One contiguous i32 upload + one f32 upload per step instead of ~12
@@ -225,6 +230,15 @@ class ModelRunner:
         # Structured output: per-row index into the device mask table
         # (0 = unconstrained row).
         grammar_rows = take(r)
+        # Logits processors: sparse per-row (token id, value) adjustments
+        # (logit_bias, banned bad-words continuations, min-tokens EOS
+        # suppression; padding id = vocab size -> dropped by the scatter)
+        # and per-row allowed-token whitelists.
+        adj_ids = take(r * num_adj).reshape(r, num_adj) if num_adj else None
+        allow_ids = (
+            take(r * num_allow).reshape(r, num_allow) if num_allow else None
+        )
+        allow_active = take(r) if num_allow else None
         spec = None
         if s > 0:
             spec = dict(
@@ -232,6 +246,11 @@ class ModelRunner:
                 draft_ids=take(r * s).reshape(r, s),
                 sample_pos=take(r * (s + 1)).reshape(r, s + 1),
             )
+        adj_vals = (
+            fbuf[6 * r : 6 * r + r * num_adj].reshape(r, num_adj)
+            if num_adj
+            else None
+        )
         sampling = SamplingMetadata(
             temperature=fbuf[0:r],
             top_p=fbuf[r : 2 * r],
@@ -244,7 +263,8 @@ class ModelRunner:
             output_token_counts=counts,
             prompt_token_mask=prompt_mask,
         )
-        return token_ids, md, sampling, feedback, grammar_rows, spec
+        logit_adjust = (adj_ids, adj_vals, allow_ids, allow_active)
+        return token_ids, md, sampling, feedback, grammar_rows, logit_adjust, spec
 
     def _step(
         self,
@@ -267,9 +287,13 @@ class ModelRunner:
         needs_grammar: bool,
         num_logprobs: int,
         num_spec: int = 0,
+        num_adj: int = 0,
+        num_allow: int = 0,
     ):
-        token_ids, md, sampling, feedback, grammar_rows, spec = self._unpack(
-            ibuf, fbuf, counts, prompt_mask, t_pad, r_pad, b_pad, num_spec
+        (token_ids, md, sampling, feedback, grammar_rows, logit_adjust,
+         spec) = self._unpack(
+            ibuf, fbuf, counts, prompt_mask, t_pad, r_pad, b_pad, num_spec,
+            num_adj, num_allow,
         )
         # Device-side token feedback (async scheduling): a decode row whose
         # input token was sampled by the still-in-flight previous step reads
@@ -325,6 +349,20 @@ class ModelRunner:
             ) & jnp.uint32(1)
             allowed = bits.reshape(r_pad, -1)[:, : logits.shape[-1]] != 0
             logits = jnp.where(allowed, logits, jnp.float32(-1e30))
+        adj_ids, adj_vals, allow_ids, allow_active = logit_adjust
+        if num_adj > 0:
+            # Sparse scatter-add: bias entries carry their bias, bans carry
+            # -1e30; padded entries (id = vocab) drop.
+            logits = logits.at[
+                jnp.arange(r_pad)[:, None], adj_ids
+            ].add(adj_vals, mode="drop")
+        if num_allow > 0:
+            allow = jnp.zeros(logits.shape, bool)
+            allow = allow.at[
+                jnp.arange(r_pad)[:, None], allow_ids
+            ].set(True, mode="drop")
+            allow = allow | (allow_active == 0)[:, None]
+            logits = jnp.where(allow, logits, jnp.float32(-1e30))
         sampled, raw_logprobs = sample(
             logits,
             sampling,
@@ -397,11 +435,37 @@ class ModelRunner:
         spec_map = so.scheduled_spec_decode_tokens
         s = self.num_spec if spec_map else 0
         spec_len = (r + r * s + r * (s + 1)) if s else 0
+
+        # Logits processors: sparse per-row adjustments + allowlists,
+        # bucketed so the trace count stays bounded.
+        adj_lists, allow_lists = self._logit_adjustments(
+            rows, req_order, num_sched
+        )
+        cap = self._adj_buckets[-1]
+        num_adj = 0
+        if adj_lists is not None:
+            widest = max(len(a) for a in adj_lists)
+            if widest > cap:
+                logger.warning(
+                    "logit adjustments truncated: %d entries > %d cap",
+                    widest, cap,
+                )
+                adj_lists = [a[:cap] for a in adj_lists]
+                widest = cap
+            num_adj = _bucket(widest, self._adj_buckets)
+        num_allow = 0
+        if allow_lists is not None:
+            widest = max(
+                (len(a) for a in allow_lists if a is not None), default=0
+            )
+            num_allow = _bucket(min(widest, cap), self._adj_buckets)
+        lp_len = r * num_adj + (r * num_allow + r if num_allow else 0)
         # seq_lens(r) + qsl(r+1) + logits_idx(r) + num_seqs(1) + bt(r*b)
         # + top_k(r) + prng(2r) + feedback(r) + grammar_rows(r)
+        # [+ adj_ids(r*num_adj)] [+ allow_ids(r*num_allow) + allow_flag(r)]
         # [+ num_draft(r) + draft(r*s) + sample_pos(r*(s+1))]
         ibuf = np.zeros(
-            4 * t + 7 * r + (r + 1) + 1 + r * b + spec_len, np.int32
+            4 * t + 7 * r + (r + 1) + 1 + r * b + lp_len + spec_len, np.int32
         )
         token_ids = ibuf[0:t]
         positions = ibuf[t : 2 * t]
@@ -420,6 +484,21 @@ class ModelRunner:
         grammar_rows = ibuf[o : o + r]; o += r
         for i, rid in enumerate(req_order):
             grammar_rows[i] = so.structured_output_request_ids.get(rid, 0)
+        v_pad = self.model.vocab_size  # out-of-range id -> scatter drop
+        if num_adj:
+            adj_ids = ibuf[o : o + r * num_adj].reshape(r, num_adj); o += r * num_adj
+            adj_ids[:] = v_pad
+            for i, lst in enumerate(adj_lists):
+                for j, (tok, _val) in enumerate(lst):
+                    adj_ids[i, j] = tok
+        if num_allow:
+            allow_ids = ibuf[o : o + r * num_allow].reshape(r, num_allow); o += r * num_allow
+            allow_ids[:] = v_pad
+            allow_flag = ibuf[o : o + r]; o += r
+            for i, lst in enumerate(allow_lists):
+                if lst is not None:
+                    allow_flag[i] = 1
+                    allow_ids[i, : len(lst)] = lst
         if s:
             num_draft = ibuf[o : o + r]; o += r
             draft_ids = ibuf[o : o + r * s].reshape(r, s); o += r * s
@@ -489,9 +568,15 @@ class ModelRunner:
             offset += n
         query_start_loc[r_live + 1 :] = offset
 
-        # Packed f32 sampling buffer: 6 R-vectors; layout must match _unpack.
+        # Packed f32 sampling buffer: 6 R-vectors (+ optional adjustment
+        # values); layout must match _unpack.
         idx = np.asarray(rows, np.int64)
-        fbuf = np.zeros(6 * r, np.float32)
+        fbuf = np.zeros(6 * r + r * num_adj, np.float32)
+        if num_adj:
+            adj_vals = fbuf[6 * r :].reshape(r, num_adj)
+            for i, lst in enumerate(adj_lists):
+                for j, (_tok, val) in enumerate(lst):
+                    adj_vals[i, j] = val
 
         def gather_into(dst, col, pad_value=0):
             dst[:] = pad_value
@@ -544,9 +629,74 @@ class ModelRunner:
             needs_grammar=bool(so.structured_output_request_ids),
             num_logprobs=num_logprobs,
             num_spec=s,
+            num_adj=num_adj,
+            num_allow=num_allow,
         )
         arrays = (jnp.asarray(ibuf), jnp.asarray(fbuf), counts, prompt_mask)
         return arrays, req_order, do_sample[:r_live], dims | flags
+
+    def _logit_adjustments(self, rows: list[int], req_order: list[str],
+                           num_sched: dict[str, int]):
+        """Per-row sparse logits-processor inputs (reference:
+        ``vllm/v1/sample/logits_processor/``): logit_bias entries, banned
+        bad-words continuations (suffix-matched against the row's tokens),
+        min-tokens EOS/stop suppression, and allowed-token whitelists.
+        Returns (adj_lists, allow_lists), each None when inactive."""
+        batch = self.input_batch
+        any_adj = any(
+            batch.req_states[rid].needs_logit_adjust for rid in req_order
+        )
+        any_allow = any(
+            batch.req_states[rid].sampling_params.allowed_token_ids
+            is not None
+            for rid in req_order
+        )
+        adj_lists = [] if any_adj else None
+        allow_lists = [] if any_allow else None
+        if not any_adj and not any_allow:
+            return None, None
+        ban = -1e30
+        for i, rid in enumerate(req_order):
+            state = batch.req_states[rid]
+            p = state.sampling_params
+            if any_adj:
+                lst: list[tuple[int, float]] = []
+                if state.needs_logit_adjust:
+                    if p.logit_bias:
+                        lst.extend(
+                            (int(t), float(v)) for t, v in p.logit_bias.items()
+                        )
+                    if p.min_tokens:
+                        # Output index of the token sampled THIS step; under
+                        # async pipelining the host's `generated` count lags
+                        # by the in-flight steps, so derive it from the
+                        # scheduled position instead.
+                        row = rows[i]
+                        prompt_len = state.num_tokens - state.generated
+                        outputs_before = (
+                            int(batch.num_computed_tokens[row])
+                            + num_sched[rid]
+                            - prompt_len
+                        )
+                        if outputs_before < p.min_tokens:
+                            if state.eos_token_id is not None:
+                                lst.append((state.eos_token_id, ban))
+                            lst.extend((t, ban) for t in p.stop_token_ids)
+                    if p.bad_words_token_ids:
+                        row = rows[i]
+                        n_tok = int(batch.num_tokens[row])
+                        toks = batch.token_ids[row, :n_tok]
+                        for seq in p.bad_words_token_ids:
+                            k = len(seq) - 1
+                            if k == 0 or (
+                                n_tok >= k
+                                and list(toks[n_tok - k :]) == seq[:-1]
+                            ):
+                                lst.append((seq[-1], ban))
+                adj_lists.append(lst)
+            if any_allow:
+                allow_lists.append(p.allowed_token_ids)
+        return adj_lists, allow_lists
 
     def _penalty_tensors(self, rows: list[int], r_pad: int):
         """[R, V] output-token counts + prompt-token mask, built host-side
