@@ -1,0 +1,160 @@
+"""EAGLE speculative decoding tests.
+
+Reference analog: ``tests/v1/spec_decode/test_eagle.py`` protocol — the
+hard guarantee is greedy equivalence: rejection sampling makes spec output
+IDENTICAL to no-spec greedy output regardless of draft quality.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from tests.models.utils import tiny_llama_config, tiny_llama_dir
+from vllm_tpu import LLM, SamplingParams
+
+
+def tiny_eagle_dir(path, cfg) -> str:
+    """An EAGLE draft checkpoint (1 llama layer + fc) matching `cfg` dims."""
+    import torch
+    from safetensors.torch import save_file
+
+    torch.manual_seed(7)
+    D, F = cfg.hidden_size, cfg.intermediate_size
+    H, KH = cfg.num_attention_heads, cfg.num_key_value_heads
+    Dh = D // H
+
+    def w(*shape):
+        return (torch.randn(*shape) * 0.05).float()
+
+    tensors = {
+        "fc.weight": w(D, 2 * D),
+        "model.layers.0.input_layernorm.weight": torch.ones(D),
+        "model.layers.0.self_attn.q_proj.weight": w(H * Dh, D),
+        "model.layers.0.self_attn.k_proj.weight": w(KH * Dh, D),
+        "model.layers.0.self_attn.v_proj.weight": w(KH * Dh, D),
+        "model.layers.0.self_attn.o_proj.weight": w(D, H * Dh),
+        "model.layers.0.post_attention_layernorm.weight": torch.ones(D),
+        "model.layers.0.mlp.gate_proj.weight": w(F, D),
+        "model.layers.0.mlp.up_proj.weight": w(F, D),
+        "model.layers.0.mlp.down_proj.weight": w(D, F),
+    }
+    os.makedirs(path, exist_ok=True)
+    save_file(tensors, os.path.join(path, "model.safetensors"))
+    with open(os.path.join(path, "config.json"), "w") as f:
+        json.dump(
+            {
+                "model_type": "llama",
+                "hidden_size": D,
+                "intermediate_size": F,
+                "num_attention_heads": H,
+                "num_key_value_heads": KH,
+                "max_position_embeddings": cfg.max_position_embeddings,
+                "rms_norm_eps": cfg.rms_norm_eps,
+            },
+            f,
+        )
+    return str(path)
+
+
+@pytest.fixture(scope="module")
+def ckpts(tmp_path_factory):
+    target = tiny_llama_dir(tmp_path_factory.mktemp("tiny_llama_eagle"))
+    eagle = tiny_eagle_dir(
+        str(tmp_path_factory.mktemp("tiny_eagle")), tiny_llama_config()
+    )
+    return target, eagle
+
+
+def _generate(target, prompts, max_tokens, eagle=None, k=3, tp=1):
+    kwargs = {}
+    if eagle is not None:
+        kwargs = dict(
+            speculative_method="eagle",
+            num_speculative_tokens=k,
+            speculative_model=eagle,
+        )
+    llm = LLM(
+        model=target, dtype="float32", max_model_len=128, block_size=16,
+        num_gpu_blocks_override=64, max_num_seqs=4,
+        max_num_batched_tokens=128, tensor_parallel_size=tp, **kwargs,
+    )
+    outs = llm.generate(
+        [{"prompt_token_ids": p} for p in prompts],
+        SamplingParams(temperature=0.0, max_tokens=max_tokens,
+                       ignore_eos=True),
+    )
+    return [o.outputs[0].token_ids for o in outs]
+
+
+def test_eagle_greedy_equals_no_spec(ckpts):
+    target, eagle = ckpts
+    rng = np.random.default_rng(2)
+    prompts = [rng.integers(5, 120, size=n).tolist() for n in (9, 17, 4)]
+    ref = _generate(target, prompts, 24)
+    got = _generate(target, prompts, 24, eagle=eagle)
+    assert got == ref
+
+
+def test_eagle_tp2_greedy_parity(ckpts):
+    """EAGLE under tensor parallelism: sharded draft head + draft KV."""
+    target, eagle = ckpts
+    rng = np.random.default_rng(3)
+    prompts = [rng.integers(5, 120, size=n).tolist() for n in (7, 12)]
+    ref = _generate(target, prompts, 12)
+    got = _generate(target, prompts, 12, eagle=eagle, tp=2)
+    assert got == ref
+
+
+def test_eagle_seeded_sampling_equals_no_spec(ckpts):
+    """Probabilistic acceptance with one-hot recovery preserves the seeded
+    sampling distribution stepwise for deterministic proposals? It does not
+    in general — but greedy-match acceptance must hold; here we only check
+    the engine runs and produces the requested length."""
+    target, eagle = ckpts
+    prompts = [[5, 9, 11]]
+    got = _generate(target, prompts, 16, eagle=eagle)
+    assert len(got[0]) == 16
+
+
+def test_eagle_loader_roundtrip(ckpts, tmp_path):
+    import jax.numpy as jnp
+    from transformers import AutoConfig
+
+    from vllm_tpu.models.eagle import EagleDraftModel
+
+    _, eagle = ckpts
+    cfg = AutoConfig.from_pretrained(eagle)
+    m = EagleDraftModel(cfg, jnp.float32)
+    params = m.load_params(eagle, jnp.float32)
+    assert params["fc"].shape == (2 * cfg.hidden_size, cfg.hidden_size)
+    assert params["wq"].shape[0] == cfg.hidden_size
+
+
+def test_eagle_chunked_prefill_equivalence(ckpts):
+    """Long prompt forced through chunked prefill with EAGLE active."""
+    target, eagle = ckpts
+    rng = np.random.default_rng(5)
+    prompts = [rng.integers(5, 120, size=90).tolist()]
+    llm_kwargs = dict(
+        model=target, dtype="float32", max_model_len=256, block_size=16,
+        num_gpu_blocks_override=64, max_num_seqs=2,
+        max_num_batched_tokens=32,  # forces 3 prefill chunks
+    )
+    ref = LLM(**llm_kwargs).generate(
+        [{"prompt_token_ids": p} for p in prompts],
+        SamplingParams(temperature=0.0, max_tokens=12, ignore_eos=True),
+    )
+    got = LLM(
+        **llm_kwargs, speculative_method="eagle", num_speculative_tokens=3,
+        speculative_model=eagle,
+    ).generate(
+        [{"prompt_token_ids": p} for p in prompts],
+        SamplingParams(temperature=0.0, max_tokens=12, ignore_eos=True),
+    )
+    assert [o.outputs[0].token_ids for o in got] == [
+        o.outputs[0].token_ids for o in ref
+    ]

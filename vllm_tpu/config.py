@@ -157,6 +157,9 @@ class SchedulerConfig:
     # at 2 in flight — the device-side count correction covers one token).
     async_pipeline_depth: int = 6
     enable_chunked_prefill: bool = True
+    # Slots allocated beyond the scheduled tokens (EAGLE writes draft KV at
+    # speculative positions); set at EngineConfig.finalize.
+    num_lookahead_tokens: int = 0
     # Long-prefill throttle (reference: long_prefill_token_threshold).
     long_prefill_token_threshold: int = 0
     policy: Literal["fcfs", "priority"] = "fcfs"
@@ -259,6 +262,13 @@ class EngineConfig:
             sc.max_model_len = mc.max_model_len
         if not sc.enable_chunked_prefill:
             sc.max_num_batched_tokens = max(sc.max_num_batched_tokens, sc.max_model_len)
+        if (
+            self.speculative_config.enabled
+            and self.speculative_config.method == "eagle"
+        ):
+            sc.num_lookahead_tokens = (
+                self.speculative_config.num_speculative_tokens
+            )
         self.compilation_config.finalize(sc)
         if self.speculative_config.enabled and self.parallel_config.pipeline_parallel_size > 1:
             raise ValueError("speculative decoding is incompatible with pipeline parallelism")

@@ -119,6 +119,9 @@ class SchedulerStats:
     prefix_cache_queries: int = 0
     prefix_cache_hits: int = 0
     num_preempted_reqs: int = 0  # cumulative since engine start
+    # Spec decode (cumulative): proposed draft tokens and accepted ones.
+    spec_num_draft_tokens: int = 0
+    spec_num_accepted_tokens: int = 0
 
 
 @dataclass

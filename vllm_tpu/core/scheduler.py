@@ -128,6 +128,9 @@ class Scheduler:
         # counter would lose events when async lag-1 runs two schedule()
         # calls between logger updates).
         self._num_preempted_total = 0
+        # Cumulative spec-decode accounting (acceptance-rate metric).
+        self._spec_num_draft_tokens = 0
+        self._spec_num_accepted_tokens = 0
         # Requests failed engine-side (e.g. grammar compile error) awaiting
         # an output record to the frontend.
         self._failed_requests: list[Request] = []
@@ -277,7 +280,8 @@ class Scheduler:
             # Allocate, preempting the tail of `running` on failure.
             while True:
                 new_blocks = self.kv_cache_manager.allocate_slots(
-                    request, num_new_tokens
+                    request, num_new_tokens,
+                    num_lookahead_tokens=self.config.num_lookahead_tokens,
                 )
                 if new_blocks is not None:
                     break
@@ -372,6 +376,7 @@ class Scheduler:
                 num_new_tokens,
                 new_computed_blocks=new_computed_blocks,
                 num_new_computed_tokens=num_new_computed_tokens,
+                num_lookahead_tokens=self.config.num_lookahead_tokens,
             )
             if new_blocks is None:
                 break  # out of KV space; don't preempt running for waiting
@@ -509,6 +514,8 @@ class Scheduler:
                     0, request.num_output_placeholders - len(generated)
                 )
             if scheduled_spec:
+                self._spec_num_draft_tokens += len(scheduled_spec)
+                self._spec_num_accepted_tokens += max(0, len(generated) - 1)
                 # Verification: len(generated) = accepted drafts + 1 bonus.
                 # Rejected draft positions hold garbage KV; roll computed
                 # count back so they are recomputed (reference:
@@ -654,4 +661,6 @@ class Scheduler:
             prefix_cache_queries=stats.queries,
             prefix_cache_hits=stats.hits,
             num_preempted_reqs=self._num_preempted_total,
+            spec_num_draft_tokens=self._spec_num_draft_tokens,
+            spec_num_accepted_tokens=self._spec_num_accepted_tokens,
         )

@@ -60,6 +60,7 @@ class EngineArgs:
 
     speculative_method: str | None = None
     num_speculative_tokens: int = 0
+    speculative_model: str | None = None
 
     enable_lora: bool = False
     max_lora_rank: int = 16
@@ -111,6 +112,7 @@ class EngineArgs:
             speculative_config=SpeculativeConfig(
                 method=self.speculative_method,  # type: ignore[arg-type]
                 num_speculative_tokens=self.num_speculative_tokens,
+                model=self.speculative_model,
             ),
             lora_config=LoRAConfig(
                 enable_lora=self.enable_lora, max_lora_rank=self.max_lora_rank

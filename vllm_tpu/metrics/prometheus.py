@@ -83,6 +83,12 @@ class PrometheusRegistry:
             "vllm:prefix_cache_queries", "Prefix-cache block queries")
         self.prefix_hits = Counter(
             "vllm:prefix_cache_hits", "Prefix-cache block hits")
+        self.spec_draft = Counter(
+            "vllm:spec_decode_num_draft_tokens",
+            "Speculative draft tokens proposed")
+        self.spec_accepted = Counter(
+            "vllm:spec_decode_num_accepted_tokens",
+            "Speculative draft tokens accepted")
         self.preempted = Counter(
             "vllm:num_preemptions", "Cumulative preemptions")
         self.generation_tokens = Counter(
@@ -101,11 +107,13 @@ class PrometheusRegistry:
         self._metrics = [
             self.num_running, self.num_waiting, self.kv_usage,
             self.prefix_queries, self.prefix_hits, self.preempted,
+            self.spec_draft, self.spec_accepted,
             self.generation_tokens, self.prompt_tokens,
             self.ttft, self.tpot, self.e2e,
         ]
         self._last_prefix = (0, 0)
         self._last_preempted = 0
+        self._last_spec = (0, 0)
 
     # StatLoggerBase interface -----------------------------------------
 
@@ -122,6 +130,12 @@ class PrometheusRegistry:
             self._last_prefix = (s.prefix_cache_queries, s.prefix_cache_hits)
             self.preempted.inc(max(0, s.num_preempted_reqs - self._last_preempted))
             self._last_preempted = s.num_preempted_reqs
+            ld, la = self._last_spec
+            self.spec_draft.inc(max(0, s.spec_num_draft_tokens - ld))
+            self.spec_accepted.inc(max(0, s.spec_num_accepted_tokens - la))
+            self._last_spec = (
+                s.spec_num_draft_tokens, s.spec_num_accepted_tokens,
+            )
         if iteration_stats is not None:
             self.generation_tokens.inc(iteration_stats.num_generation_tokens)
             self.prompt_tokens.inc(iteration_stats.num_prompt_tokens)

@@ -1,0 +1,201 @@
+"""EAGLE draft model: one decoder layer conditioned on target hidden states.
+
+Reference analog: ``vllm/v1/spec_decode/eagle.py:10`` (EagleProposer) and
+the EAGLE checkpoint format (a single llama-style decoder layer plus an
+``fc`` that fuses [token embedding ; target hidden] -> hidden). The draft
+model runs INSIDE the target's jitted step (no extra dispatch): each step
+it processes the same ragged token batch as the target — inputs shifted by
+one position, so position p consumes (token p+1, target hidden p) — to
+maintain its own single-layer paged KV cache, then chains
+``num_speculative_tokens`` greedy single-position decodes to propose
+drafts. Embedding and lm_head are shared with the target model.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+from vllm_tpu.layers.activation import silu_and_mul
+from vllm_tpu.layers.layernorm import rms_norm
+from vllm_tpu.layers.rotary import RotaryEmbedding, _apply_rotate_half
+from vllm_tpu.ops.attention import (
+    AttentionMetadata,
+    kv_cache_shape,
+    paged_attention,
+    write_kv,
+)
+
+
+class EagleDraftModel:
+    """Functional single-layer draft net over the target's embed/lm_head."""
+
+    def __init__(self, hf_config: Any, dtype=jnp.bfloat16) -> None:
+        c = hf_config
+        self.dtype = dtype
+        self.hidden_size = c.hidden_size
+        self.num_heads = c.num_attention_heads
+        self.num_kv_heads = getattr(
+            c, "num_key_value_heads", c.num_attention_heads
+        )
+        self.head_dim = (
+            getattr(c, "head_dim", None)
+            or c.hidden_size // c.num_attention_heads
+        )
+        self.intermediate_size = c.intermediate_size
+        self.rms_eps = getattr(c, "rms_norm_eps", 1e-6)
+        self.scale = 1.0 / math.sqrt(self.head_dim)
+        self.rope = RotaryEmbedding(
+            head_dim=self.head_dim,
+            max_position=getattr(c, "max_position_embeddings", 8192),
+            theta=getattr(c, "rope_theta", 10000.0),
+            rope_scaling=getattr(c, "rope_scaling", None),
+        )
+
+    # ------------------------------------------------------------------
+
+    def init_dummy_params(self, rng: jax.Array, dtype=None) -> dict:
+        dtype = dtype or self.dtype
+        D, H, KH, Dh, F = (
+            self.hidden_size, self.num_heads, self.num_kv_heads,
+            self.head_dim, self.intermediate_size,
+        )
+        keys = jax.random.split(rng, 8)
+
+        def init(key, shape, fan_in):
+            return (
+                jax.random.normal(key, shape, jnp.float32) / math.sqrt(fan_in)
+            ).astype(dtype)
+
+        return {
+            "fc": init(keys[0], (2 * D, D), 2 * D),
+            "input_norm": jnp.ones((D,), dtype),
+            "wq": init(keys[1], (D, H * Dh), D),
+            "wk": init(keys[2], (D, KH * Dh), D),
+            "wv": init(keys[3], (D, KH * Dh), D),
+            "wo": init(keys[4], (H * Dh, D), H * Dh),
+            "post_norm": jnp.ones((D,), dtype),
+            "wgate": init(keys[5], (D, F), D),
+            "wup": init(keys[6], (D, F), D),
+            "wdown": init(keys[7], (F, D), F),
+        }
+
+    def load_params(self, path: str, dtype=None) -> dict:
+        """EAGLE checkpoint: llama layer-0 names + ``fc.weight``."""
+        import numpy as np
+        from safetensors import safe_open
+
+        from vllm_tpu.models.loader import _iter_safetensor_files
+
+        dtype = dtype or self.dtype
+        name_map = {
+            "fc.weight": ("fc", True),
+            "model.layers.0.input_layernorm.weight": ("input_norm", False),
+            "model.layers.0.self_attn.q_proj.weight": ("wq", True),
+            "model.layers.0.self_attn.k_proj.weight": ("wk", True),
+            "model.layers.0.self_attn.v_proj.weight": ("wv", True),
+            "model.layers.0.self_attn.o_proj.weight": ("wo", True),
+            "model.layers.0.post_attention_layernorm.weight": ("post_norm", False),
+            "model.layers.0.mlp.gate_proj.weight": ("wgate", True),
+            "model.layers.0.mlp.up_proj.weight": ("wup", True),
+            "model.layers.0.mlp.down_proj.weight": ("wdown", True),
+            # Alternate flat naming some EAGLE exports use.
+            "layers.0.input_layernorm.weight": ("input_norm", False),
+            "layers.0.self_attn.q_proj.weight": ("wq", True),
+            "layers.0.self_attn.k_proj.weight": ("wk", True),
+            "layers.0.self_attn.v_proj.weight": ("wv", True),
+            "layers.0.self_attn.o_proj.weight": ("wo", True),
+            "layers.0.post_attention_layernorm.weight": ("post_norm", False),
+            "layers.0.mlp.gate_proj.weight": ("wgate", True),
+            "layers.0.mlp.up_proj.weight": ("wup", True),
+            "layers.0.mlp.down_proj.weight": ("wdown", True),
+        }
+        params: dict = {}
+        for file in _iter_safetensor_files(path):
+            with safe_open(file, framework="numpy") as f:
+                for hf_name in f.keys():
+                    if hf_name not in name_map:
+                        continue
+                    dest, transpose = name_map[hf_name]
+                    arr = f.get_tensor(hf_name)
+                    if arr.dtype == np.uint16:
+                        arr = arr.view(jnp.bfloat16)
+                    if transpose:
+                        arr = arr.T
+                    params[dest] = jnp.asarray(arr, dtype)
+        missing = {"fc", "wq", "wk", "wv", "wo", "wgate", "wup", "wdown"} - set(params)
+        if missing:
+            raise ValueError(f"EAGLE checkpoint missing {sorted(missing)}")
+        params.setdefault("input_norm", jnp.ones((self.hidden_size,), dtype))
+        params.setdefault("post_norm", jnp.ones((self.hidden_size,), dtype))
+        return params
+
+    def param_shardings(self, model_axis: str = "tp") -> dict:
+        """Same Megatron TP plan as one llama layer (no L stacking)."""
+        from jax.sharding import PartitionSpec as P
+
+        tp = model_axis
+        return {
+            "fc": P(None, None),
+            "input_norm": P(None),
+            "wq": P(None, tp),
+            "wk": P(None, tp),
+            "wv": P(None, tp),
+            "wo": P(tp, None),
+            "post_norm": P(None),
+            "wgate": P(None, tp),
+            "wup": P(None, tp),
+            "wdown": P(tp, None),
+        }
+
+    def kv_cache_sharding(self, model_axis: str = "tp"):
+        from jax.sharding import PartitionSpec as P
+
+        return P(None, None, None, model_axis, None)
+
+    def kv_shape(self, num_blocks: int, block_size: int):
+        return kv_cache_shape(
+            1, num_blocks, block_size, self.num_kv_heads, self.head_dim
+        )
+
+    # ------------------------------------------------------------------
+
+    def forward(
+        self,
+        params: dict,
+        embed: jnp.ndarray,  # [V, D] target embedding (shared)
+        draft_kv: jnp.ndarray,  # [1, NB, BS, ., .]
+        token_ids: jnp.ndarray,  # [T] (shifted: token p+1 at position p)
+        target_hidden: jnp.ndarray,  # [T, D]
+        md: AttentionMetadata,
+    ) -> tuple[jnp.ndarray, jnp.ndarray]:
+        """One draft pass over a ragged batch. Returns (hidden [T, D],
+        updated draft_kv)."""
+        t = token_ids.shape[0]
+        H, KH, Dh = self.num_heads, self.num_kv_heads, self.head_dim
+        emb = embed[token_ids].astype(self.dtype)
+        x = jnp.concatenate(
+            [emb, target_hidden.astype(self.dtype)], axis=-1
+        ) @ params["fc"]
+
+        h = rms_norm(x, params["input_norm"], self.rms_eps)
+        q = (h @ params["wq"]).reshape(t, H, Dh)
+        k = (h @ params["wk"]).reshape(t, KH, Dh)
+        v = (h @ params["wv"]).reshape(t, KH, Dh)
+        cos = self.rope.cos[md.positions][:, None, :]
+        sin = self.rope.sin[md.positions][:, None, :]
+        q = _apply_rotate_half(q, cos, sin, self.rope.rotary_dim)
+        k = _apply_rotate_half(k, cos, sin, self.rope.rotary_dim)
+        draft_kv = write_kv(draft_kv, jnp.int32(0), k, v, md.slot_mapping)
+        attn = paged_attention(q, draft_kv, jnp.int32(0), md, self.scale)
+        x = x + attn.reshape(t, H * Dh) @ params["wo"]
+        h2 = rms_norm(x, params["post_norm"], self.rms_eps)
+        gate = h2 @ params["wgate"]
+        up = h2 @ params["wup"]
+        x = x + silu_and_mul(
+            jnp.concatenate([gate, up], axis=-1)
+        ) @ params["wdown"]
+        return x, draft_kv

@@ -25,6 +25,7 @@ class CachedRequestState:
         "in_batch_row",
         "eos_token_id",
         "needs_logit_adjust",
+        "logit_bias_items",
     )
 
     def __init__(self, req_id: str, sampling_params: SamplingParams,
@@ -45,6 +46,11 @@ class CachedRequestState:
             or p.bad_words_token_ids
             or (p.min_tokens and (eos_token_id is not None
                                   or p.stop_token_ids))
+        )
+        self.logit_bias_items = (
+            [(int(t), float(v)) for t, v in p.logit_bias.items()]
+            if p.logit_bias
+            else []
         )
 
 

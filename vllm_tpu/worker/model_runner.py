@@ -48,6 +48,7 @@ class StepHandle:
         # id may have been reused while this step was in flight).
         self.row_states = row_states or []
         self.empty = empty
+        self.drafts = None  # EAGLE proposals [R, K] (device array)
 
 
 def _bucket(value: int, buckets: list[int]) -> int:
@@ -65,6 +66,8 @@ class ModelRunner:
         params: Any,
         num_kv_blocks: int,
         mesh: Any | None = None,
+        draft_model: Any | None = None,
+        draft_params: Any | None = None,
     ) -> None:
         self.config = config
         self.model = model
@@ -115,11 +118,16 @@ class ModelRunner:
         self._mask_w = -(-model.vocab_size // 32)
         self._mask_table = None  # jnp [manager.table_rows, W] uint32
 
-        # Speculative decoding (ngram drafting is pure host logic; the
-        # verification rejection-sampler runs inside the jitted step).
+        # Speculative decoding: ngram drafting is pure host logic; EAGLE
+        # drafting runs INSIDE the jitted step (draft prefill over the same
+        # ragged batch + a greedy chain); the verification
+        # rejection-sampler runs in-jit for both.
         spec = config.speculative_config
         self.num_spec = spec.num_speculative_tokens if spec.enabled else 0
         self.proposer = None
+        self.draft_model = None
+        self.draft_params = None
+        self.draft_kv = None
         if spec.enabled and spec.method == "ngram":
             from vllm_tpu.spec_decode.ngram_proposer import NgramProposer
 
@@ -127,6 +135,12 @@ class ModelRunner:
                 spec.prompt_lookup_min, spec.prompt_lookup_max,
                 spec.num_speculative_tokens,
             )
+        elif spec.enabled and spec.method == "eagle":
+            assert draft_model is not None and draft_params is not None, (
+                "eagle spec decode needs a loaded draft model"
+            )
+            self.draft_model = draft_model
+            self.draft_params = draft_params
 
         from vllm_tpu.ops.attention import kv_cache_shape
 
@@ -159,7 +173,26 @@ class ModelRunner:
             np.prod(kv_shape) * jnp.dtype(kv_dtype).itemsize / 2**30,
         )
 
-        # kv_cache is arg 1 and is donated back as output 0 (in-place reuse).
+        if self.draft_model is not None:
+            dkv_shape = self.draft_model.kv_shape(
+                num_kv_blocks, cache.block_size
+            )
+            self.draft_kv = jnp.zeros(dkv_shape, kv_dtype)
+            if mesh is not None:
+                from jax.sharding import NamedSharding
+
+                self.draft_kv = jax.device_put(
+                    self.draft_kv,
+                    NamedSharding(mesh, self.draft_model.kv_cache_sharding()),
+                )
+            logger.info(
+                "EAGLE draft KV cache allocated: %s (%.2f GiB)",
+                dkv_shape,
+                np.prod(dkv_shape) * jnp.dtype(kv_dtype).itemsize / 2**30,
+            )
+
+        # kv_cache (arg 1) and the draft KV (arg 2, when present) are
+        # donated back as outputs (in-place reuse).
         self._step_fn = jax.jit(
             self._step,
             static_argnames=(
@@ -176,7 +209,7 @@ class ModelRunner:
                 "num_adj",
                 "num_allow",
             ),
-            donate_argnums=(1,),
+            donate_argnums=(1, 2) if self.draft_model is not None else (1,),
         )
         # Step-time breakdown (host prep / dispatch / finalize wait), enabled
         # by VLLM_TPU_STEP_TIMING=1; read via .timing after a run.
@@ -190,8 +223,7 @@ class ModelRunner:
     # Jitted step
     # ------------------------------------------------------------------
 
-    @staticmethod
-    def _unpack(ibuf, fbuf, counts, prompt_mask, t, r, b, num_spec=0,
+    def _unpack(self, ibuf, fbuf, counts, prompt_mask, t, r, b, num_spec=0,
                 num_adj=0, num_allow=0):
         """Split the two packed host buffers back into metadata pytrees.
 
@@ -239,6 +271,9 @@ class ModelRunner:
             take(r * num_allow).reshape(r, num_allow) if num_allow else None
         )
         allow_active = take(r) if num_allow else None
+        # EAGLE: per-row next KNOWN token for the draft's shifted input at
+        # the anchor position (-1 = use the freshly emitted token).
+        draft_next = take(r) if self.draft_model is not None else None
         spec = None
         if s > 0:
             spec = dict(
@@ -264,12 +299,14 @@ class ModelRunner:
             prompt_token_mask=prompt_mask,
         )
         logit_adjust = (adj_ids, adj_vals, allow_ids, allow_active)
-        return token_ids, md, sampling, feedback, grammar_rows, logit_adjust, spec
+        return (token_ids, md, sampling, feedback, grammar_rows, logit_adjust,
+                draft_next, spec)
 
     def _step(
         self,
         params,
         kv_cache,
+        draft_kv,
         ibuf,
         fbuf,
         counts,
@@ -291,7 +328,7 @@ class ModelRunner:
         num_allow: int = 0,
     ):
         (token_ids, md, sampling, feedback, grammar_rows, logit_adjust,
-         spec) = self._unpack(
+         draft_next, spec) = self._unpack(
             ibuf, fbuf, counts, prompt_mask, t_pad, r_pad, b_pad, num_spec,
             num_adj, num_allow,
         )
@@ -335,7 +372,16 @@ class ModelRunner:
                 needs_top_p_min_p=needs_top_p_min_p,
                 needs_gumbel=needs_gumbel,
             )
-            return kv_cache, (out_tokens, num_out), None
+            drafts = None
+            if self.draft_model is not None:
+                rows_r = jnp.arange(r_pad)
+                anchor = spec["sample_pos"][rows_r, num_out - 1]
+                emitted = out_tokens[rows_r, num_out - 1]
+                drafts, draft_kv = self._eagle_drafts(
+                    params, draft_kv, token_ids, hidden, md, anchor,
+                    emitted, draft_next, r_pad,
+                )
+            return kv_cache, draft_kv, (out_tokens, num_out), None, drafts
         last = hidden[md.logits_indices]  # [R, D]
         logits = self.model.compute_logits(params, last)  # [R, V] f32
         if needs_grammar:
@@ -371,6 +417,14 @@ class ModelRunner:
             needs_top_p_min_p=needs_top_p_min_p,
             needs_gumbel=needs_gumbel,
         )
+        drafts = None
+        if self.draft_model is not None and num_logprobs == 0:
+            # (finalize discards drafts for logprob batches anyway — skip
+            # the draft compute entirely; num_logprobs is static.)
+            drafts, draft_kv = self._eagle_drafts(
+                params, draft_kv, token_ids, hidden, md,
+                md.logits_indices, sampled, draft_next, r_pad,
+            )
         if num_logprobs > 0:
             topk_vals, topk_ids = jax.lax.top_k(raw_logprobs, num_logprobs)
             sampled_lp = jnp.take_along_axis(
@@ -382,7 +436,70 @@ class ModelRunner:
             lp = (topk_vals, topk_ids, sampled_lp, sampled_rank)
         else:
             lp = None
-        return kv_cache, sampled, lp
+        return kv_cache, draft_kv, sampled, lp, drafts
+
+    def _eagle_drafts(self, params, draft_kv, token_ids, hidden, md,
+                      anchor, emitted, draft_next, r_pad):
+        """In-jit EAGLE proposal (reference: vllm/v1/spec_decode/eagle.py).
+
+        1. Draft prefill over this step's ragged batch with inputs shifted
+           one position (position p consumes token p+1 + target hidden p),
+           maintaining the single-layer draft KV cache. The anchor position
+           (each row's last emitted token's predecessor) gets the freshly
+           emitted token — device-side — as its shifted input.
+        2. Greedy chain of num_spec single-position draft decodes, feeding
+           the draft's own hidden states forward and writing draft KV into
+           the lookahead slots the scheduler allocated.
+        """
+        dm, dp = self.draft_model, self.draft_params
+        k_spec = self.num_spec
+        bs = self.block_size
+        rows_r = jnp.arange(r_pad)
+        num_live = md.num_seqs[0]
+
+        # Shifted inputs: next in-buffer token within the same request.
+        nxt = jnp.roll(token_ids, -1)
+        same = jnp.concatenate(
+            [md.token_req_idx[1:] == md.token_req_idx[:-1],
+             jnp.zeros((1,), bool)]
+        )
+        shifted = jnp.where(same, nxt, 0)
+        # Anchor override: the emitted token (or, for chunked prefills, the
+        # next known prompt token shipped from the host). Padded rows
+        # scatter out of bounds (dropped).
+        anchor_tok = jnp.where(draft_next >= 0, draft_next, emitted)
+        anchor_idx = jnp.where(rows_r < num_live, anchor, token_ids.shape[0])
+        shifted = shifted.at[anchor_idx].set(anchor_tok, mode="drop")
+
+        embed = params["embed"]
+        h_d, draft_kv = dm.forward(dp, embed, draft_kv, shifted, hidden, md)
+        d_tok = jnp.argmax(
+            self.model.compute_logits(params, h_d[anchor]), axis=-1
+        ).astype(jnp.int32)
+        drafts = [d_tok]
+        h_prev = h_d[anchor]  # [R, D]
+        pos0 = md.positions[anchor]
+        for k in range(1, k_spec):
+            p = pos0 + k
+            slot = md.block_tables[rows_r, p // bs] * bs + p % bs
+            md_k = AttentionMetadata(
+                positions=p,
+                slot_mapping=slot,
+                block_tables=md.block_tables,
+                seq_lens=p + 1,
+                query_start_loc=jnp.arange(r_pad + 1, dtype=jnp.int32),
+                token_req_idx=rows_r.astype(jnp.int32),
+                logits_indices=rows_r.astype(jnp.int32),
+                num_seqs=md.num_seqs,
+            )
+            h_prev, draft_kv = dm.forward(
+                dp, embed, draft_kv, d_tok, h_prev, md_k
+            )
+            d_tok = jnp.argmax(
+                self.model.compute_logits(params, h_prev), axis=-1
+            ).astype(jnp.int32)
+            drafts.append(d_tok)
+        return jnp.stack(drafts, axis=1), draft_kv
 
     # ------------------------------------------------------------------
     # Host side
@@ -460,12 +577,15 @@ class ModelRunner:
             )
             num_allow = _bucket(min(widest, cap), self._adj_buckets)
         lp_len = r * num_adj + (r * num_allow + r if num_allow else 0)
+        eagle_len = r if self.draft_model is not None else 0
         # seq_lens(r) + qsl(r+1) + logits_idx(r) + num_seqs(1) + bt(r*b)
         # + top_k(r) + prng(2r) + feedback(r) + grammar_rows(r)
         # [+ adj_ids(r*num_adj)] [+ allow_ids(r*num_allow) + allow_flag(r)]
         # [+ num_draft(r) + draft(r*s) + sample_pos(r*(s+1))]
         ibuf = np.zeros(
-            4 * t + 7 * r + (r + 1) + 1 + r * b + lp_len + spec_len, np.int32
+            4 * t + 7 * r + (r + 1) + 1 + r * b + lp_len + eagle_len
+            + spec_len,
+            np.int32,
         )
         token_ids = ibuf[0:t]
         positions = ibuf[t : 2 * t]
@@ -499,6 +619,9 @@ class ModelRunner:
                 if lst is not None:
                     allow_flag[i] = 1
                     allow_ids[i, : len(lst)] = lst
+        if self.draft_model is not None:
+            draft_next = ibuf[o : o + r]; o += r
+            draft_next[:] = -1
         if s:
             num_draft = ibuf[o : o + r]; o += r
             draft_ids = ibuf[o : o + r * s].reshape(r, s); o += r * s
@@ -562,7 +685,12 @@ class ModelRunner:
             seq_lens[i] = start + n
             query_start_loc[i + 1] = offset + n
             logits_indices[i] = offset + n - 1
-            do_sample[i] = start + n >= int(batch.num_tokens[row])
+            will_sample = start + n >= int(batch.num_tokens[row])
+            do_sample[i] = will_sample
+            if self.draft_model is not None and not will_sample:
+                # Chunked prefill: the draft's anchor input token is the
+                # next (known) prompt token, not a sampled one.
+                draft_next[i] = batch.token_ids[row, start + n]
             nb = int(batch.num_blocks[row])
             block_tables[i, :nb] = bt_row[:nb]
             offset += n
@@ -660,12 +788,10 @@ class ModelRunner:
             state = batch.req_states[rid]
             p = state.sampling_params
             if any_adj:
+                # Bans (hard guarantees) first: width truncation at the
+                # bucket cap drops trailing bias entries, never bans.
                 lst: list[tuple[int, float]] = []
                 if state.needs_logit_adjust:
-                    if p.logit_bias:
-                        lst.extend(
-                            (int(t), float(v)) for t, v in p.logit_bias.items()
-                        )
                     if p.min_tokens:
                         # Output index of the token sampled THIS step; under
                         # async pipelining the host's `generated` count lags
@@ -693,6 +819,8 @@ class ModelRunner:
                                 and list(toks[n_tok - k :]) == seq[:-1]
                             ):
                                 lst.append((seq[-1], ban))
+                    if p.logit_bias:
+                        lst.extend(state.logit_bias_items)
                 adj_lists.append(lst)
             if any_allow:
                 allow_lists.append(p.allowed_token_ids)
@@ -756,8 +884,9 @@ class ModelRunner:
             t1 = time.perf_counter()
             self.timing["prep_s"] += t1 - t0
         prev = self._last_sampled if self._last_sampled is not None else self._zero_sampled
-        self.kv_cache, sampled, lp = self._step_fn(
-            self.params, self.kv_cache, *arrays, prev, mask_table, **flags
+        self.kv_cache, self.draft_kv, sampled, lp, drafts = self._step_fn(
+            self.params, self.kv_cache, self.draft_kv, *arrays, prev,
+            mask_table, **flags,
         )
         if self._timing_enabled:
             self.timing["dispatch_s"] += time.perf_counter() - t1
@@ -778,11 +907,15 @@ class ModelRunner:
         if lp is not None:
             for x in lp:
                 x.copy_to_host_async()
-        return StepHandle(
+        if drafts is not None:
+            drafts.copy_to_host_async()
+        handle = StepHandle(
             req_order=req_order, do_sample=do_sample, sampled=sampled, lp=lp,
             row_states=[self.input_batch.req_states[r] for r in req_order],
             spec=is_spec,
         )
+        handle.drafts = drafts
+        return handle
 
     def finalize(self, handle: "StepHandle") -> ModelRunnerOutput:
         """Fetch the sampled tokens of a dispatched step and fold them into
@@ -799,6 +932,11 @@ class ModelRunner:
         lp_np = None
         if handle.lp is not None:
             lp_np = [np.asarray(jax.device_get(x)) for x in handle.lp]
+        drafts_np = (
+            np.asarray(jax.device_get(handle.drafts))
+            if handle.drafts is not None
+            else None
+        )
         if self._timing_enabled:
             self.timing["wait_s"] += time.perf_counter() - t0
 
@@ -832,6 +970,10 @@ class ModelRunner:
                         )
                         if drafts:
                             out.draft_token_ids[rid] = drafts
+                    elif drafts_np is not None and not batch_has_logprobs:
+                        out.draft_token_ids[rid] = [
+                            int(x) for x in drafts_np[i]
+                        ]
                 out.sampled_token_ids.append(toks)
             else:
                 out.sampled_token_ids.append([])

@@ -143,6 +143,44 @@ class Worker:
         else:
             self.params = self.model.load_params(mc.model, mc.jax_dtype, shardings)
 
+        self.draft_model = None
+        self.draft_params = None
+        spec = self.config.speculative_config
+        if spec.enabled and spec.method == "eagle":
+            self._load_eagle(spec, mc)
+
+    def _load_eagle(self, spec, mc) -> None:
+        """Load the EAGLE draft head (reference: eagle.py load path)."""
+        import jax
+
+        from vllm_tpu.models.eagle import EagleDraftModel
+
+        if spec.model:
+            from transformers import AutoConfig
+
+            draft_cfg = AutoConfig.from_pretrained(spec.model)
+            self.draft_model = EagleDraftModel(draft_cfg, mc.jax_dtype)
+            self.draft_params = self.draft_model.load_params(
+                spec.model, mc.jax_dtype
+            )
+        else:
+            # Dummy draft head with the target's dims (benches/tests).
+            assert mc.load_format == "dummy", (
+                "eagle spec decode needs speculative_config.model"
+            )
+            self.draft_model = EagleDraftModel(mc.hf_config, mc.jax_dtype)
+            self.draft_params = self.draft_model.init_dummy_params(
+                jax.random.PRNGKey(mc.seed + 1), mc.jax_dtype
+            )
+        if self.mesh is not None:
+            # Shard the draft head like the target (TP over heads/ffn).
+            from vllm_tpu.parallel.mesh import named_shardings
+
+            sh = named_shardings(self.mesh, self.draft_model.param_shardings())
+            self.draft_params = jax.tree_util.tree_map(
+                lambda x, sp: jax.device_put(x, sp), self.draft_params, sh
+            )
+
     # ------------------------------------------------------------------
 
     def determine_num_kv_blocks(self) -> int:
@@ -163,6 +201,16 @@ class Worker:
         specs = self.model.get_kv_cache_spec(
             cache.block_size, jnp.dtype(kv_dtype).itemsize
         )
+        if self.draft_model is not None:
+            # EAGLE's single-layer draft KV comes out of the same budget.
+            from vllm_tpu.core.kv_cache_utils import FullAttentionSpec
+
+            specs["eagle_draft"] = FullAttentionSpec(
+                block_size=cache.block_size,
+                num_kv_heads=self.draft_model.num_kv_heads,
+                head_size=self.draft_model.head_dim,
+                dtype_bytes=jnp.dtype(kv_dtype).itemsize,
+            )
         stats = getattr(self.device, "memory_stats", lambda: None)()
         if stats and "bytes_limit" in stats:
             limit = stats["bytes_limit"] * cache.gpu_memory_utilization
@@ -206,7 +254,8 @@ class Worker:
         num_blocks = self.determine_num_kv_blocks()
         self.config.cache_config.num_gpu_blocks = num_blocks
         self.runner = ModelRunner(
-            self.config, self.model, self.params, num_blocks, self.mesh
+            self.config, self.model, self.params, num_blocks, self.mesh,
+            draft_model=self.draft_model, draft_params=self.draft_params,
         )
         return num_blocks
 
