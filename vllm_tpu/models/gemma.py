@@ -1,0 +1,247 @@
+"""Gemma-2 / Gemma-3 (text) decoders.
+
+Reference analog: ``vllm/model_executor/models/gemma2.py`` / ``gemma3.py``.
+Gemma differences from the Llama graph, all handled here:
+
+- embedding scaled by sqrt(hidden_size);
+- zero-centered RMSNorm weights (``x_norm * (1 + w)``) — folded to
+  ``(1 + w)`` at load time so the shared :func:`rms_norm` applies;
+- FOUR norms per layer (pre/post attention, pre/post feedforward), with
+  the post norms applied to the sublayer OUTPUT before the residual add;
+- GeGLU MLP (tanh-approximated GELU gate);
+- alternating sliding-window / full-attention layers — the per-layer
+  window rides the ``lax.scan`` as a traced scalar into the attention
+  kernel (0 = full);
+- attention scale from ``query_pre_attn_scalar``;
+- Gemma-2: attention and final-logit soft-capping;
+- Gemma-3: per-head q/k RMSNorm and DUAL rope tables — local (windowed)
+  layers use ``rope_local_base_freq``, global layers the scaled long-rope;
+- tied embeddings.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import PartitionSpec as P
+
+from vllm_tpu.layers.activation import gelu_and_mul
+from vllm_tpu.layers.layernorm import rms_norm
+from vllm_tpu.layers.rotary import RotaryEmbedding, _apply_rotate_half
+from vllm_tpu.models.llama import LlamaForCausalLM
+from vllm_tpu.ops.attention import (
+    AttentionMetadata,
+    kv_dequant_scale,
+    paged_attention,
+    write_kv,
+)
+
+_NORM_KEYS = (
+    "input_norm", "post_attn_norm", "pre_ffn_norm", "post_ffn_norm",
+    "q_norm", "k_norm",
+)
+
+
+class Gemma2ForCausalLM(LlamaForCausalLM):
+    attn_soft_cap: float | None = None
+    final_soft_cap: float | None = None
+
+    def __init__(self, hf_config: Any, dtype=jnp.bfloat16,
+                 quantization: str | None = None) -> None:
+        if quantization:
+            from vllm_tpu.logger import init_logger
+
+            init_logger(__name__).warning(
+                "weight quantization not yet supported for %s; running "
+                "unquantized", type(self).__name__,
+            )
+        super().__init__(hf_config, dtype, None)
+        c = hf_config
+        self.scale = getattr(c, "query_pre_attn_scalar", self.head_dim) ** -0.5
+        self.attn_soft_cap = getattr(c, "attn_logit_softcapping", None)
+        self.final_soft_cap = getattr(c, "final_logit_softcapping", None)
+        self.tie_embeddings = True
+        self.window = getattr(c, "sliding_window", None)
+        # Cache-level window stays None: alternating layers include FULL
+        # attention, so no block can be freed (hybrid groups are future
+        # work); correctness comes from the per-layer mask.
+        self.sliding_window = None
+
+    # ------------------------------------------------------------------
+
+    def _layer_window(self, li: jnp.ndarray) -> jnp.ndarray:
+        """Per-layer window as a traced scalar (0 = full attention).
+        Gemma-2: even-indexed layers are windowed."""
+        if self.window is None:
+            return jnp.int32(0)
+        return jnp.where(li % 2 == 0, jnp.int32(self.window), jnp.int32(0))
+
+    def _rope(self, li, positions):
+        cos = self.rope.cos[positions][:, None, :]
+        sin = self.rope.sin[positions][:, None, :]
+        return cos, sin
+
+    def init_dummy_params(self, rng: jax.Array, dtype=None) -> dict:
+        params = super().init_dummy_params(rng, dtype)
+        dtype = dtype or self.dtype
+        L, D = self.num_layers, self.hidden_size
+        layers = params["layers"]
+        layers["post_attn_norm"] = jnp.ones((L, D), dtype)
+        layers["pre_ffn_norm"] = jnp.ones((L, D), dtype)
+        layers["post_ffn_norm"] = jnp.ones((L, D), dtype)
+        del layers["post_norm"]  # gemma's 4-norm layout replaces it
+        params.pop("lm_head", None)
+        return params
+
+    def hf_weight_map(self) -> dict:
+        m = super().hf_weight_map()
+        m.pop("lm_head.weight", None)
+        for i in range(self.num_layers):
+            # Gemma's post_attention_layernorm is OUR post-attention-output
+            # norm; pre/post feedforward norms are additional.
+            m[f"model.layers.{i}.post_attention_layernorm.weight"] = (
+                f"layers.post_attn_norm.{i}", False)
+            m[f"model.layers.{i}.pre_feedforward_layernorm.weight"] = (
+                f"layers.pre_ffn_norm.{i}", False)
+            m[f"model.layers.{i}.post_feedforward_layernorm.weight"] = (
+                f"layers.post_ffn_norm.{i}", False)
+        return m
+
+    def postprocess_weight(self, dest: str, arr: np.ndarray) -> np.ndarray:
+        """Zero-centered norms -> multiplicative form (1 + w). Only the
+        small norm vectors are cast/copied; projections pass through."""
+        leaf = dest.split(".")[-2] if dest.split(".")[-1].isdigit() else dest
+        name = leaf.split(".")[-1]
+        if name in _NORM_KEYS or dest == "final_norm":
+            return np.asarray(arr, np.float32) + 1.0
+        return arr
+
+    def param_shardings(self, data_axis: str | None = None,
+                        model_axis: str = "tp") -> dict:
+        out = super().param_shardings(data_axis, model_axis)
+        layers = out["layers"]
+        layers["post_attn_norm"] = P(None, None)
+        layers["pre_ffn_norm"] = P(None, None)
+        layers["post_ffn_norm"] = P(None, None)
+        del layers["post_norm"]
+        return out
+
+    # ------------------------------------------------------------------
+
+    def apply(
+        self,
+        params: dict,
+        kv_cache: jnp.ndarray,
+        input_ids: jnp.ndarray,
+        md: AttentionMetadata,
+    ) -> tuple[jnp.ndarray, jnp.ndarray]:
+        x = params["embed"][input_ids].astype(self.dtype)
+        x = x * jnp.asarray(
+            math.sqrt(self.hidden_size), self.dtype
+        )
+        t = x.shape[0]
+        H, KH, Dh = self.num_heads, self.num_kv_heads, self.head_dim
+
+        def layer_fn(carry, inputs):
+            x, kv = carry
+            lp, li = inputs
+            h = rms_norm(x, lp["input_norm"], self.rms_eps)
+            q = (h @ lp["wq"]).reshape(t, H, Dh)
+            k = (h @ lp["wk"]).reshape(t, KH, Dh)
+            v = (h @ lp["wv"]).reshape(t, KH, Dh)
+            if self.qk_norm:
+                q = rms_norm(q, lp["q_norm"], self.rms_eps)
+                k = rms_norm(k, lp["k_norm"], self.rms_eps)
+            cos, sin = self._rope(li, md.positions)
+            q = _apply_rotate_half(q, cos, sin, Dh)
+            k = _apply_rotate_half(k, cos, sin, Dh)
+            kv = write_kv(kv, li, k, v, md.slot_mapping)
+            attn = paged_attention(
+                q, kv, li, md, self.scale,
+                sliding_window=self._layer_window(li),
+                soft_cap=self.attn_soft_cap,
+                k_scale=kv_dequant_scale(kv), v_scale=kv_dequant_scale(kv),
+            )
+            attn_out = attn.reshape(t, H * Dh) @ lp["wo"]
+            x = x + rms_norm(attn_out, lp["post_attn_norm"], self.rms_eps)
+
+            h2 = rms_norm(x, lp["pre_ffn_norm"], self.rms_eps)
+            gate = h2 @ lp["wgate"]
+            up = h2 @ lp["wup"]
+            mlp = gelu_and_mul(
+                jnp.concatenate([gate, up], axis=-1)
+            ) @ lp["wdown"]
+            x = x + rms_norm(mlp, lp["post_ffn_norm"], self.rms_eps)
+            return (x, kv), None
+
+        (x, new_kv), _ = jax.lax.scan(
+            layer_fn,
+            (x, kv_cache),
+            (params["layers"], jnp.arange(self.num_layers, dtype=jnp.int32)),
+        )
+        x = rms_norm(x, params["final_norm"], self.rms_eps)
+        return x, new_kv
+
+    def compute_logits(self, params: dict, hidden: jnp.ndarray) -> jnp.ndarray:
+        logits = (hidden @ params["embed"].T.astype(hidden.dtype)).astype(
+            jnp.float32
+        )
+        if self.final_soft_cap is not None:
+            cap = self.final_soft_cap
+            logits = cap * jnp.tanh(logits / cap)
+        return logits
+
+
+class Gemma3ForCausalLM(Gemma2ForCausalLM):
+    """Gemma-3 text: q/k norms, 5-local:1-global window pattern, dual rope
+    (local layers use ``rope_local_base_freq``), no soft-capping."""
+
+    qk_norm = True
+
+    def __init__(self, hf_config: Any, dtype=jnp.bfloat16,
+                 quantization: str | None = None) -> None:
+        c = getattr(hf_config, "text_config", hf_config)
+        super().__init__(c, dtype, quantization)
+        self.attn_soft_cap = None
+        self.final_soft_cap = getattr(c, "final_logit_softcapping", None)
+        # Sliding unless every `pattern`-th layer (1-indexed) is global.
+        self.window_pattern = getattr(c, "sliding_window_pattern", 6)
+        layer_types = getattr(c, "layer_types", None)
+        self._full_layers = (
+            [i for i, tpe in enumerate(layer_types)
+             if tpe == "full_attention"]
+            if layer_types
+            else [i for i in range(self.num_layers)
+                  if (i + 1) % self.window_pattern == 0]
+        )
+        # Local (windowed) layers rotate with their own base frequency.
+        self.rope_local = RotaryEmbedding(
+            head_dim=self.head_dim,
+            max_position=self.max_position,
+            theta=getattr(c, "rope_local_base_freq", 10000.0),
+            rope_scaling=None,
+        )
+
+    def _layer_window(self, li: jnp.ndarray) -> jnp.ndarray:
+        if self.window is None:
+            return jnp.int32(0)
+        full = jnp.zeros((self.num_layers,), jnp.int32)
+        for i in self._full_layers:
+            full = full.at[i].set(1)
+        return jnp.where(full[li] == 1, jnp.int32(0), jnp.int32(self.window))
+
+    def _rope(self, li, positions):
+        is_full = jnp.isin(
+            li, jnp.asarray(self._full_layers or [-1], jnp.int32)
+        )
+        cos_g = self.rope.cos[positions][:, None, :]
+        sin_g = self.rope.sin[positions][:, None, :]
+        cos_l = self.rope_local.cos[positions][:, None, :]
+        sin_l = self.rope_local.sin[positions][:, None, :]
+        cos = jnp.where(is_full, cos_g, cos_l)
+        sin = jnp.where(is_full, sin_g, sin_l)
+        return cos, sin

@@ -47,8 +47,10 @@ logger = init_logger(__name__)
 
 
 class LlamaForCausalLM:
-    # Subclass hooks (Qwen2 etc.)
+    # Subclass hooks (Qwen2/Qwen3 etc.)
     attention_bias = False
+    # Per-head RMSNorm on q/k after projection (Qwen3, Gemma-3).
+    qk_norm = False
     # Weight-only quantized matmuls (per-output-channel int8/fp8); norms,
     # embeddings, and lm_head stay in the model dtype.
     QUANT_KEYS = ("wq", "wk", "wv", "wo", "wgate", "wup", "wdown")
@@ -115,6 +117,9 @@ class LlamaForCausalLM:
             layers["bq"] = jnp.zeros((L, H * Dh), dtype)
             layers["bk"] = jnp.zeros((L, KH * Dh), dtype)
             layers["bv"] = jnp.zeros((L, KH * Dh), dtype)
+        if self.qk_norm:
+            layers["q_norm"] = jnp.ones((L, Dh), dtype)
+            layers["k_norm"] = jnp.ones((L, Dh), dtype)
         if self.quantization:
             for k in self.QUANT_KEYS:
                 layers[k] = quantize_jnp(layers[k], self.quantization)
@@ -151,6 +156,11 @@ class LlamaForCausalLM:
                 "self_attn.q_proj.bias": ("bq", False),
                 "self_attn.k_proj.bias": ("bk", False),
                 "self_attn.v_proj.bias": ("bv", False),
+            }
+        if self.qk_norm:
+            per_layer |= {
+                "self_attn.q_norm.weight": ("q_norm", False),
+                "self_attn.k_norm.weight": ("k_norm", False),
             }
         for i in range(self.num_layers):
             for hf_name, (ours, transpose) in per_layer.items():
@@ -195,6 +205,9 @@ class LlamaForCausalLM:
             q = q.reshape(t, H, Dh)
             k = k.reshape(t, KH, Dh)
             v = v.reshape(t, KH, Dh)
+            if self.qk_norm:
+                q = rms_norm(q, lp["q_norm"], self.rms_eps)
+                k = rms_norm(k, lp["k_norm"], self.rms_eps)
 
             cos = rope_cos[md.positions][:, None, :]
             sin = rope_sin[md.positions][:, None, :]
@@ -274,6 +287,8 @@ class LlamaForCausalLM:
         }
         if self.attention_bias:
             layers |= {"bq": P(None, tp), "bk": P(None, tp), "bv": P(None, tp)}
+        if self.qk_norm:
+            layers |= {"q_norm": P(None, None), "k_norm": P(None, None)}
         if self.quantization:
             # Scale vectors shard like the weight's output axis.
             for k in self.QUANT_KEYS:
@@ -304,3 +319,12 @@ class MistralForCausalLM(LlamaForCausalLM):
 
 class Qwen2ForCausalLM(LlamaForCausalLM):
     attention_bias = True
+
+
+class Qwen3ForCausalLM(LlamaForCausalLM):
+    """Llama graph + per-head q/k RMSNorm, decoupled head_dim.
+
+    Reference analog: ``vllm/model_executor/models/qwen3.py``.
+    """
+
+    qk_norm = True

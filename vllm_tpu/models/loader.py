@@ -66,11 +66,15 @@ def load_safetensors_params(
 
     for file in _iter_safetensor_files(path):
         with safe_open(file, framework="numpy") as f:
-            for hf_name in f.keys():
+            for raw_name in f.keys():
+                # Multimodal wrappers (e.g. Gemma3ForConditionalGeneration)
+                # nest the decoder under language_model.*; vision-tower
+                # tensors simply miss the map and are skipped.
+                hf_name = raw_name.removeprefix("language_model.")
                 if hf_name not in weight_map:
                     continue
                 dest, transpose = weight_map[hf_name]
-                arr = f.get_tensor(hf_name)
+                arr = f.get_tensor(raw_name)
                 if arr.dtype == np.uint16:  # bfloat16 via numpy view
                     arr = arr.view(jnp.bfloat16)
                 if transpose:
@@ -100,7 +104,11 @@ def load_safetensors_params(
         else set()
     )
 
+    postprocess = getattr(model, "postprocess_weight", None)
+
     def put(leaf_path: str, arr: np.ndarray) -> None:
+        if postprocess is not None:
+            arr = postprocess(leaf_path, arr)
         sharding = None
         if shardings is not None:
             node = shardings

@@ -40,6 +40,7 @@ class MixtralForCausalLM(LlamaForCausalLM):
         super().__init__(hf_config, dtype, quantization=None)
         self.num_experts = hf_config.num_local_experts
         self.top_k = hf_config.num_experts_per_tok
+        self.renormalize = True
         self.sliding_window = getattr(hf_config, "sliding_window", None)
         # EP toggle: experts sharded over the tp axis (vLLM
         # enable_expert_parallel semantics) vs FFN-dim sharding.
@@ -110,6 +111,9 @@ class MixtralForCausalLM(LlamaForCausalLM):
             q = (h @ lp["wq"]).reshape(t, H, Dh)
             k = (h @ lp["wk"]).reshape(t, KH, Dh)
             v = (h @ lp["wv"]).reshape(t, KH, Dh)
+            if self.qk_norm:
+                q = rms_norm(q, lp["q_norm"], self.rms_eps)
+                k = rms_norm(k, lp["k_norm"], self.rms_eps)
             cos = rope_cos[md.positions][:, None, :]
             sin = rope_sin[md.positions][:, None, :]
             q = _apply_rotate_half(q, cos, sin, self.rope.rotary_dim)
@@ -130,6 +134,7 @@ class MixtralForCausalLM(LlamaForCausalLM):
                 lp["we_up"],
                 lp["we_down"],
                 top_k=self.top_k,
+                renormalize=self.renormalize,
                 use_grouped=None if not self.expert_parallel else False,
             )
             return (x + moe_out, kv), None

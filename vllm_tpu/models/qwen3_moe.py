@@ -1,0 +1,46 @@
+"""Qwen3-MoE decoder (GPT-OSS-class sparse MoE breadth).
+
+Reference analog: ``vllm/model_executor/models/qwen3_moe.py``. The Mixtral
+graph (fused MoE with layer-stacked expert weights) plus Qwen3's per-head
+q/k RMSNorm and decoupled head_dim; router normalization follows the
+config's ``norm_topk_prob``.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax.numpy as jnp
+
+from vllm_tpu.models.mixtral import MixtralForCausalLM
+
+
+class Qwen3MoeForCausalLM(MixtralForCausalLM):
+    qk_norm = True
+
+    def __init__(self, hf_config: Any, dtype=jnp.bfloat16,
+                 quantization: str | None = None) -> None:
+        c = hf_config
+        # Mixtral reads num_local_experts; Qwen3Moe calls it num_experts.
+        if not hasattr(c, "num_local_experts"):
+            c.num_local_experts = c.num_experts
+        super().__init__(c, dtype, quantization)
+        self.renormalize = bool(getattr(c, "norm_topk_prob", True))
+        self.sliding_window = None
+
+    def hf_weight_map(self) -> dict:
+        m = super().hf_weight_map()
+        for i in range(self.num_layers):
+            # Qwen3Moe naming: mlp.gate (router) + mlp.experts.{j}.*_proj.
+            del m[f"model.layers.{i}.block_sparse_moe.gate.weight"]
+            m[f"model.layers.{i}.mlp.gate.weight"] = (
+                f"layers.router.{i}", True)
+            for j in range(self.num_experts):
+                old = f"model.layers.{i}.block_sparse_moe.experts.{j}"
+                for k in ("w1", "w2", "w3"):
+                    del m[f"{old}.{k}.weight"]
+                new = f"model.layers.{i}.mlp.experts.{j}"
+                m[f"{new}.gate_proj.weight"] = (f"layers.we_gate.{i}.{j}", True)
+                m[f"{new}.up_proj.weight"] = (f"layers.we_up.{i}.{j}", True)
+                m[f"{new}.down_proj.weight"] = (f"layers.we_down.{i}.{j}", True)
+        return m

@@ -19,6 +19,11 @@ _REGISTRY: dict[str, tuple[str, str]] = {
     "LlamaForCausalLM": ("vllm_tpu.models.llama", "LlamaForCausalLM"),
     "MistralForCausalLM": ("vllm_tpu.models.llama", "MistralForCausalLM"),
     "Qwen2ForCausalLM": ("vllm_tpu.models.llama", "Qwen2ForCausalLM"),
+    "Qwen3ForCausalLM": ("vllm_tpu.models.llama", "Qwen3ForCausalLM"),
+    "Qwen3MoeForCausalLM": ("vllm_tpu.models.qwen3_moe", "Qwen3MoeForCausalLM"),
+    "Gemma2ForCausalLM": ("vllm_tpu.models.gemma", "Gemma2ForCausalLM"),
+    "Gemma3ForCausalLM": ("vllm_tpu.models.gemma", "Gemma3ForCausalLM"),
+    "Gemma3ForConditionalGeneration": ("vllm_tpu.models.gemma", "Gemma3ForCausalLM"),
     "MixtralForCausalLM": ("vllm_tpu.models.mixtral", "MixtralForCausalLM"),
 }
 

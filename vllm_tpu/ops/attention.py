@@ -111,7 +111,8 @@ def paged_attention(
     md: AttentionMetadata,
     scale: float,
     *,
-    sliding_window: int | None = None,
+    sliding_window=None,
+    soft_cap: float | None = None,
     k_scale: float | None = None,
     v_scale: float | None = None,
 ) -> jnp.ndarray:
@@ -133,12 +134,13 @@ def paged_attention(
             md.num_seqs,
             sm_scale=scale,
             sliding_window=sliding_window,
+            soft_cap=soft_cap,
             k_scale=k_scale,
             v_scale=v_scale,
         )
     return ref_ragged_paged_attention(
         q, kv_cache, layer, md, scale, sliding_window=sliding_window,
-        k_scale=k_scale, v_scale=v_scale,
+        soft_cap=soft_cap, k_scale=k_scale, v_scale=v_scale,
     )
 
 
@@ -153,7 +155,8 @@ def ref_ragged_paged_attention(
     md: AttentionMetadata,
     scale: float,
     *,
-    sliding_window: int | None = None,
+    sliding_window=None,
+    soft_cap: float | None = None,
     k_scale: float | None = None,
     v_scale: float | None = None,
 ) -> jnp.ndarray:
@@ -187,11 +190,16 @@ def ref_ragged_paged_attention(
 
     qg = q.reshape(t, kh, groups, d).astype(jnp.float32)
     scores = jnp.einsum("tkgd,tckd->tkgc", qg, k_t) * scale
+    if soft_cap is not None:
+        scores = soft_cap * jnp.tanh(scores / soft_cap)
 
     ctx_pos = jnp.arange(ctx, dtype=jnp.int32)[None, :]  # [1, C]
     causal = ctx_pos <= md.positions[:, None]  # [T, C]
     if sliding_window is not None:
-        causal &= ctx_pos > (md.positions[:, None] - sliding_window)
+        # Accepts a python int OR a traced scalar (0 = full attention),
+        # so a layer scan can alternate windowed/full layers.
+        win = jnp.asarray(sliding_window, jnp.int32)
+        causal &= (ctx_pos > (md.positions[:, None] - win)) | (win <= 0)
     scores = jnp.where(causal[:, None, None, :], scores, -jnp.inf)
 
     probs = jax.nn.softmax(scores, axis=-1)

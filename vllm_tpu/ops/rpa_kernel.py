@@ -84,6 +84,8 @@ def _rpa_kernel(
     seq_buf_idx_ref,  # [2] mutable (seq_idx, buf_idx) carried across grid
     num_seqs_ref,  # [1]
     layer_ref,  # [1]
+    window_ref,  # [1] i32 sliding window; 0 = full attention (dynamic so a
+    #            layer scan can alternate windowed/full layers, e.g. Gemma)
     # Inputs
     q_ref,  # [num_q_per_blk, num_q_heads_per_blk, head_dim]
     kv_pages_hbm_ref,  # [L, NB, page_size, num_combined_kv_heads, head_dim]
@@ -91,7 +93,6 @@ def _rpa_kernel(
     o_ref,  # [num_q_per_blk, num_q_heads_per_blk, head_dim]
     *rest,
     sm_scale: float,
-    sliding_window: int | None,
     soft_cap: float | None,
     mask_value: float,
     k_scale: float | None,
@@ -132,13 +133,12 @@ def _rpa_kernel(
         and the compute loop always agree on the DMA sequence. The seq's
         lowest query position is kv_len - q_len; its window floor is that
         minus (window - 1)."""
-        if sliding_window is None:
-            return 0
+        window = window_ref[0]
         q_len = cu_q_lens_ref[seq_idx + 1] - cu_q_lens_ref[seq_idx]
         first_tok = jnp.maximum(
-            kv_lens_ref[seq_idx] - q_len - (sliding_window - 1), 0
+            kv_lens_ref[seq_idx] - q_len - (window - 1), 0
         )
-        return first_tok // num_kv_per_blk
+        return jnp.where(window > 0, first_tok // num_kv_per_blk, 0)
 
     def make_page_copy(heads_blk_idx, seq_idx, kv_blk_idx, buf_idx):
         start_page = kv_blk_idx * num_kv_pages_per_blk
@@ -293,10 +293,11 @@ def _rpa_kernel(
                 1,
             )
             causal_mask = row_ids < col_ids
-            if sliding_window is not None:
-                causal_mask = jnp.logical_or(
-                    causal_mask, row_ids - sliding_window >= col_ids
-                )
+            window = window_ref[0]
+            causal_mask = jnp.logical_or(
+                causal_mask,
+                (row_ids - window >= col_ids) & (window > 0),
+            )
             if soft_cap is not None:
                 qk = soft_cap * jnp.tanh(qk / soft_cap)
             qk += jnp.where(causal_mask, mask_value, 0.0)
@@ -505,7 +506,7 @@ def _min_heads_per_blk(num_q_heads, num_combined_kv_heads, q_dtype, kv_dtype):
     jax.jit,
     static_argnames=[
         "sm_scale", "mask_value", "num_kv_pages_per_block",
-        "num_queries_per_block", "vmem_limit_bytes", "sliding_window",
+        "num_queries_per_block", "vmem_limit_bytes",
         "soft_cap", "k_scale", "v_scale", "return_lse", "interpret",
     ],
 )
@@ -519,7 +520,7 @@ def ragged_paged_attention(
     num_seqs: jax.Array,  # i32[1]
     *,
     sm_scale: float = 1.0,
-    sliding_window: int | None = None,
+    sliding_window=None,  # int | traced i32 scalar | None; 0/None = full
     soft_cap: float | None = None,
     mask_value: float | None = None,
     k_scale: float | None = None,
@@ -614,6 +615,9 @@ def ragged_paged_attention(
         pltpu.VMEM((num_q_per_blk, num_q_heads_per_blk, head_dim),
                    jnp.float32),  # acc
     ]
+    window = jnp.asarray(
+        0 if sliding_window is None else sliding_window, jnp.int32
+    ).reshape(1)
     scalar_prefetches = (
         kv_lens,
         page_indices,
@@ -621,12 +625,12 @@ def ragged_paged_attention(
         jnp.array((0, 0), jnp.int32),  # seq_idx, buf_idx
         num_seqs,
         layer.astype(jnp.int32).reshape(1),
+        window,
     )
     kernel = pl.pallas_call(
         functools.partial(
             _rpa_kernel,
             sm_scale=sm_scale,
-            sliding_window=sliding_window,
             soft_cap=soft_cap,
             mask_value=mask_value,
             k_scale=k_scale,

@@ -418,9 +418,11 @@ class ModelRunner:
             needs_gumbel=needs_gumbel,
         )
         drafts = None
-        if self.draft_model is not None and num_logprobs == 0:
-            # (finalize discards drafts for logprob batches anyway — skip
-            # the draft compute entirely; num_logprobs is static.)
+        if self.draft_model is not None:
+            # Runs even on logprob batches (whose drafts finalize discards):
+            # the draft prefill maintains the draft KV cache for every
+            # computed position — skipping it would leave permanent holes
+            # that poison later proposals.
             drafts, draft_kv = self._eagle_drafts(
                 params, draft_kv, token_ids, hidden, md,
                 md.logits_indices, sampled, draft_next, r_pad,
