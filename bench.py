@@ -92,7 +92,10 @@ def main() -> None:
     llm.generate(prompts, params)
 
     try:
-        runner = llm.llm_engine.engine_core.executor.worker.runner
+        # engine_core is an InprocClient wrapping the real EngineCore.
+        runner = (
+            llm.llm_engine.engine_core.engine_core.executor.worker.runner
+        )
         runner.timing = {k: 0 if k == "steps" else 0.0
                          for k in runner.timing}
     except AttributeError:

@@ -1,0 +1,157 @@
+"""Engine proc split: msgpack serialization, ZMQ engine-core process,
+MP client parity with in-proc, engine-dead propagation.
+
+Reference analog: ``tests/v1/engine/test_engine_core_client.py``.
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+import time
+
+import numpy as np
+import pytest
+
+from tests.models.utils import tiny_llama_dir
+from vllm_tpu import LLM, SamplingParams
+from vllm_tpu.core.sched_output import (
+    EngineCoreOutput,
+    EngineCoreOutputs,
+    SchedulerStats,
+)
+from vllm_tpu.engine import serial_utils
+from vllm_tpu.request import EngineCoreRequest
+from vllm_tpu.sampling_params import (
+    RequestOutputKind,
+    SamplingParams as SP,
+    StructuredOutputParams,
+)
+
+
+def test_serialization_roundtrip_request():
+    req = EngineCoreRequest(
+        request_id="r1",
+        prompt_token_ids=[1, 2, 3],
+        sampling_params=SP(
+            temperature=0.5, top_k=7, max_tokens=9, seed=3,
+            stop=["x"], logit_bias={4: 1.5},
+            structured_outputs=StructuredOutputParams(regex="ab+"),
+            output_kind=RequestOutputKind.DELTA,
+        ),
+        eos_token_id=2,
+        priority=1,
+    )
+    req.prompt_text = "hi"
+    got = serial_utils.decode(serial_utils.encode(req))
+    assert got.request_id == "r1"
+    assert got.prompt_token_ids == [1, 2, 3]
+    p = got.sampling_params
+    assert (p.temperature, p.top_k, p.max_tokens, p.seed) == (0.5, 7, 9, 3)
+    assert p.stop == ["x"]
+    assert p.logit_bias == {4: 1.5}
+    assert p.structured_outputs.regex == "ab+"
+    assert p.output_kind is RequestOutputKind.DELTA
+    assert got.prompt_text == "hi"
+
+
+def test_serialization_roundtrip_outputs():
+    outs = EngineCoreOutputs(
+        outputs=[
+            EngineCoreOutput(
+                req_id="a", new_token_ids=[5, 6], finish_reason="stop",
+                new_logprobs=[([1, 2], [-0.1, -0.2], 5, -0.1, 0)],
+            )
+        ],
+        scheduler_stats=SchedulerStats(num_running_reqs=2, kv_cache_usage=0.5),
+        timestamp=123.0,
+    )
+    got = serial_utils.decode(serial_utils.encode(outs))
+    assert got.outputs[0].req_id == "a"
+    assert got.outputs[0].new_token_ids == [5, 6]
+    assert got.outputs[0].finish_reason == "stop"
+    lp = got.outputs[0].new_logprobs[0]
+    assert lp[0] == [1, 2] and lp[2] == 5
+    assert got.scheduler_stats.num_running_reqs == 2
+
+
+@pytest.fixture(scope="module")
+def ckpt(tmp_path_factory):
+    return tiny_llama_dir(tmp_path_factory.mktemp("tiny_llama_mp"))
+
+
+def _llm(ckpt, backend):
+    return LLM(
+        model=ckpt, dtype="float32", max_model_len=128, block_size=16,
+        num_gpu_blocks_override=64, max_num_seqs=4,
+        max_num_batched_tokens=128,
+        distributed_executor_backend=backend,
+    )
+
+
+def test_mp_engine_matches_inproc(ckpt):
+    rng = np.random.default_rng(0)
+    prompts = [
+        {"prompt_token_ids": rng.integers(5, 120, size=n).tolist()}
+        for n in (7, 13, 3)
+    ]
+    sp = SamplingParams(temperature=0.0, max_tokens=10, ignore_eos=True)
+    ref = [
+        o.outputs[0].token_ids for o in _llm(ckpt, "uniproc").generate(prompts, sp)
+    ]
+    llm = _llm(ckpt, "mp")
+    try:
+        got = [o.outputs[0].token_ids for o in llm.generate(prompts, sp)]
+    finally:
+        llm.llm_engine.shutdown()
+    assert got == ref
+
+
+def test_mp_async_llm_stream(ckpt):
+    """AsyncLLM over the MP client: streamed tokens match sync greedy."""
+    import asyncio
+
+    from vllm_tpu.engine.arg_utils import AsyncEngineArgs
+    from vllm_tpu.engine.async_llm import AsyncLLM
+
+    prompt = {"prompt_token_ids": [5, 9, 11]}
+    sp = SamplingParams(temperature=0.0, max_tokens=6, ignore_eos=True)
+    ref = _llm(ckpt, "uniproc").generate([prompt], sp)[0].outputs[0].token_ids
+
+    engine = AsyncLLM.from_engine_args(
+        AsyncEngineArgs(
+            model=ckpt, dtype="float32", max_model_len=128, block_size=16,
+            num_gpu_blocks_override=64, max_num_seqs=4,
+            max_num_batched_tokens=128, distributed_executor_backend="mp",
+        )
+    )
+
+    async def run():
+        final = None
+        async for out in engine.generate(prompt, sp, "req-1"):
+            final = out
+        return final
+
+    try:
+        final = asyncio.run(run())
+    finally:
+        engine.shutdown()
+    assert final is not None and final.finished
+    assert final.outputs[0].token_ids == ref
+
+
+def test_mp_engine_dead_error(ckpt):
+    from vllm_tpu.engine.core_client import EngineDeadError
+
+    llm = _llm(ckpt, "mp")
+    client = llm.llm_engine.engine_core
+    os.kill(client._proc.pid, signal.SIGKILL)
+    deadline = time.monotonic() + 10
+    with pytest.raises(EngineDeadError):
+        while time.monotonic() < deadline:
+            llm.llm_engine.add_request(
+                "x", {"prompt_token_ids": [1, 2]},
+                SamplingParams(max_tokens=2),
+            )
+            llm.llm_engine.step()
+            time.sleep(0.1)

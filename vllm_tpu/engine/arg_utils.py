@@ -55,6 +55,7 @@ class EngineArgs:
     pipeline_parallel_size: int = 1
     context_parallel_size: int = 1
     enable_expert_parallel: bool = False
+    distributed_executor_backend: str = "uniproc"
 
     device: str = "auto"
 
@@ -100,6 +101,7 @@ class EngineArgs:
                 pipeline_parallel_size=self.pipeline_parallel_size,
                 context_parallel_size=self.context_parallel_size,
                 enable_expert_parallel=self.enable_expert_parallel,
+                distributed_executor_backend=self.distributed_executor_backend,  # type: ignore[arg-type]
             ),
             scheduler_config=SchedulerConfig(
                 max_num_batched_tokens=self.max_num_batched_tokens,

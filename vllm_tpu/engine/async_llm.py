@@ -18,7 +18,7 @@ import threading
 from typing import Any, AsyncGenerator
 
 from vllm_tpu.config import EngineConfig
-from vllm_tpu.engine.engine_core import EngineCore
+from vllm_tpu.engine.core_client import make_client
 from vllm_tpu.engine.input_processor import InputProcessor, PromptType
 from vllm_tpu.engine.output_processor import OutputProcessor
 from vllm_tpu.logger import init_logger
@@ -57,7 +57,7 @@ class AsyncStream:
 class AsyncLLM:
     def __init__(self, config: EngineConfig, start: bool = True) -> None:
         self.config = config
-        self.engine_core = EngineCore(config)
+        self.engine_core = make_client(config.finalize())
         self.input_processor = InputProcessor(config)
         self.output_processor = OutputProcessor(self.input_processor.tokenizer)
         self.stat_loggers: list[Any] = []
@@ -153,8 +153,8 @@ class AsyncLLM:
                     return
                 if not self.engine_core.has_unfinished_requests():
                     continue
-                outputs = self.engine_core.step()
-                stalled = not outputs.outputs and not self.engine_core._inflight
+                outputs = self.engine_core.get_output(timeout=0.2)
+                stalled = not outputs.outputs and not self.engine_core.inflight
                 # process_outputs delivers straight into each request's
                 # AsyncStream (thread-safe); nothing to re-publish here.
                 processed = self.output_processor.process_outputs(

@@ -1,0 +1,241 @@
+"""EngineCore clients: in-process and multiprocess (ZMQ) variants.
+
+Reference analog: ``vllm/v1/engine/core_client.py`` (InprocClient :274,
+SyncMPClient :716, AsyncMPClient :887). One client interface serves both
+the sync LLMEngine and the AsyncLLM thread loop:
+
+- ``add_request`` / ``abort_requests`` feed work in;
+- ``get_output(timeout)`` returns the next EngineCoreOutputs (None on
+  timeout — MP mode blocks on the socket, in-proc mode runs a step);
+- ``has_unfinished_requests`` is tracked client-side in MP mode (adds
+  minus finish records) so the frontend never round-trips for it.
+
+Engine death surfaces as EngineDeadError from any call.
+"""
+
+from __future__ import annotations
+
+import atexit
+import os
+import pickle
+import tempfile
+import uuid
+from typing import Any
+
+from vllm_tpu.config import EngineConfig
+from vllm_tpu.core.sched_output import EngineCoreOutputs
+from vllm_tpu.logger import init_logger
+from vllm_tpu.request import EngineCoreRequest
+
+logger = init_logger(__name__)
+
+
+class EngineDeadError(RuntimeError):
+    """Reference analog: ``vllm/v1/engine/exceptions.py:9``."""
+
+
+def make_client(config: EngineConfig):
+    from vllm_tpu import envs
+
+    mp = (
+        envs.VLLM_TPU_ENABLE_MULTIPROCESSING
+        or config.parallel_config.distributed_executor_backend == "mp"
+    )
+    return MPClient(config) if mp else InprocClient(config)
+
+
+class InprocClient:
+    """Direct in-process EngineCore (the default single-host path)."""
+
+    def __init__(self, config: EngineConfig) -> None:
+        from vllm_tpu.engine.engine_core import EngineCore
+
+        self.engine_core = EngineCore(config)
+
+    def add_request(self, req: EngineCoreRequest) -> None:
+        self.engine_core.add_request(req)
+
+    def abort_requests(self, request_ids: list[str]) -> None:
+        self.engine_core.abort_requests(request_ids)
+
+    def get_output(self, timeout: float | None = None) -> EngineCoreOutputs:
+        return self.engine_core.step()
+
+    def has_unfinished_requests(self) -> bool:
+        return self.engine_core.has_unfinished_requests()
+
+    def reset_prefix_cache(self) -> bool:
+        return self.engine_core.reset_prefix_cache()
+
+    @property
+    def inflight(self) -> bool:
+        return bool(self.engine_core._inflight)
+
+    def shutdown(self) -> None:
+        self.engine_core.shutdown()
+
+
+class MPClient:
+    """Engine core in a spawned process, msgpack over ipc ZMQ sockets."""
+
+    def __init__(self, config: EngineConfig, ready_timeout_s: float = 600.0):
+        import multiprocessing
+
+        import zmq
+
+        from vllm_tpu.engine import core_proc, serial_utils
+
+        self._serial = serial_utils
+        self._proc_mod = core_proc
+        self._run_dir = run_dir = tempfile.mkdtemp(prefix="vllm-tpu-ipc-")
+        suffix = uuid.uuid4().hex[:8]
+        input_addr = f"ipc://{run_dir}/input-{suffix}.sock"
+        output_addr = f"ipc://{run_dir}/output-{suffix}.sock"
+
+        self._ctx = zmq.Context(1)
+        self._input = self._ctx.socket(zmq.PUSH)
+        self._input.bind(input_addr)
+        self._output = self._ctx.socket(zmq.PULL)
+        self._output.bind(output_addr)
+
+        mp_ctx = multiprocessing.get_context("spawn")
+        self._proc = mp_ctx.Process(
+            target=core_proc.run_engine_core,
+            args=(pickle.dumps(config), input_addr, output_addr),
+            name="vllm-tpu-engine-core",
+            daemon=True,
+        )
+        self._proc.start()
+        atexit.register(self.shutdown)
+
+        self._dead = False
+        # Live request ids (id-keyed so an abort racing an in-flight
+        # engine-side finish record cannot double-count).
+        self._live: set[str] = set()
+        self._pending: list[list[bytes]] = []  # OUT frames read early
+        # Block until the engine proc finishes init (model load + KV
+        # sizing + warm-up can take minutes on first compile).
+        frames = self._recv(timeout_ms=int(ready_timeout_s * 1000))
+        if frames is None or frames[0] != core_proc.MSG_READY:
+            raise EngineDeadError(
+                "engine core process failed to initialize"
+            )
+        ready = serial_utils.decode(frames[1])
+        config.cache_config.num_gpu_blocks = ready["num_gpu_blocks"]
+        logger.info(
+            "engine core proc up (pid %s, %d KV blocks)",
+            self._proc.pid, ready["num_gpu_blocks"],
+        )
+
+    # ------------------------------------------------------------------
+
+    def _recv(self, timeout_ms: int) -> list[bytes] | None:
+        """One message, honoring death of the engine process."""
+        deadline = timeout_ms
+        step = 200
+        while True:
+            if self._output.poll(min(step, max(deadline, 0))):
+                frames = self._output.recv_multipart()
+                if frames[0] == self._proc_mod.MSG_DEAD:
+                    self._dead = True
+                    raise EngineDeadError(
+                        f"engine core died:\n{frames[1].decode()}"
+                    )
+                return frames
+            deadline -= step
+            if not self._proc.is_alive():
+                self._dead = True
+                raise EngineDeadError(
+                    f"engine core process exited (code "
+                    f"{self._proc.exitcode})"
+                )
+            if deadline <= 0:
+                return None
+
+    def _check_alive(self) -> None:
+        if self._dead or not self._proc.is_alive():
+            self._dead = True
+            raise EngineDeadError("engine core process is not running")
+
+    # ------------------------------------------------------------------
+
+    def add_request(self, req: EngineCoreRequest) -> None:
+        self._check_alive()
+        self._input.send_multipart(
+            [self._proc_mod.MSG_ADD, self._serial.encode(req)]
+        )
+        self._live.add(req.request_id)
+
+    def abort_requests(self, request_ids: list[str]) -> None:
+        if self._dead or not request_ids:
+            return
+        self._input.send_multipart(
+            [self._proc_mod.MSG_ABORT, self._serial.encode(list(request_ids))]
+        )
+        # Aborted requests produce no further outputs.
+        for rid in request_ids:
+            self._live.discard(rid)
+
+    def get_output(self, timeout: float | None = None) -> EngineCoreOutputs:
+        """Next batch of outputs; empty EngineCoreOutputs on timeout."""
+        self._check_alive()
+        if self._pending:
+            frames = self._pending.pop(0)
+        else:
+            frames = self._recv(
+                timeout_ms=int(
+                    (timeout if timeout is not None else 0.2) * 1000
+                )
+            )
+        if frames is None:
+            return EngineCoreOutputs()
+        assert frames[0] == self._proc_mod.MSG_OUTPUTS, frames[0]
+        outputs: EngineCoreOutputs = self._serial.decode(frames[1])
+        for o in outputs.outputs:
+            if o.finish_reason is not None:
+                self._live.discard(o.req_id)
+        return outputs
+
+    def has_unfinished_requests(self) -> bool:
+        return bool(self._live)
+
+    def reset_prefix_cache(self) -> bool:
+        self._check_alive()
+        self._input.send_multipart(
+            [self._proc_mod.MSG_UTILITY, b"reset_prefix_cache"]
+        )
+        # Outputs may interleave ahead of the reply; buffer them.
+        for _ in range(1000):
+            frames = self._recv(timeout_ms=30_000)
+            if frames is None:
+                break
+            if frames[0] == self._proc_mod.MSG_UTILITY_REPLY:
+                return self._serial.decode(frames[1])
+            self._pending.append(frames)
+        raise EngineDeadError("utility call got no reply")
+
+    @property
+    def inflight(self) -> bool:
+        # The proc steps autonomously; treat unfinished work as in flight.
+        return bool(self._live)
+
+    def shutdown(self) -> None:
+        if getattr(self, "_proc", None) is None:
+            return
+        try:
+            if self._proc.is_alive():
+                self._input.send_multipart([self._proc_mod.MSG_SHUTDOWN])
+                self._proc.join(timeout=5)
+            if self._proc.is_alive():
+                self._proc.terminate()
+                self._proc.join(timeout=2)
+        except Exception:
+            pass
+        finally:
+            self._input.close(linger=0)
+            self._output.close(linger=0)
+            self._ctx.term()
+            self._proc = None
+            import shutil
+
+            shutil.rmtree(self._run_dir, ignore_errors=True)

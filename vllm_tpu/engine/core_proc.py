@@ -1,0 +1,106 @@
+"""EngineCoreProc: the engine core in its own OS process, driven over ZMQ.
+
+Reference analog: ``vllm/v1/engine/core.py:806`` (EngineCoreProc,
+run_busy_loop :1164, engine-dead propagation :1358). The process owns the
+TPU (jax initializes here, never in the frontend); the frontend talks
+msgpack over a pair of ipc sockets. One loop thread serves both sockets:
+it drains the input socket (blocking with a timeout when idle, non-blocking
+while requests are in flight), steps the core, and pushes outputs.
+"""
+
+from __future__ import annotations
+
+import pickle
+import traceback
+
+from vllm_tpu.logger import init_logger
+
+# Wire message types (frame 0).
+MSG_ADD = b"ADD"
+MSG_ABORT = b"ABORT"
+MSG_SHUTDOWN = b"SHUTDOWN"
+MSG_UTILITY = b"UTIL"
+MSG_READY = b"READY"
+MSG_OUTPUTS = b"OUT"
+MSG_DEAD = b"DEAD"
+MSG_UTILITY_REPLY = b"UTILREP"
+
+
+def run_engine_core(config_bytes: bytes, input_addr: str,
+                    output_addr: str) -> None:
+    """Process entry point (spawn target)."""
+    import os
+
+    # Honor the parent's platform selection BEFORE any backend init (test
+    # rigs force CPU; the TPU plugin's sitecustomize would otherwise win).
+    if os.environ.get("JAX_PLATFORMS"):
+        import jax
+
+        try:
+            jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+        except Exception:
+            pass
+
+    import zmq
+
+    from vllm_tpu.engine import serial_utils
+    from vllm_tpu.engine.engine_core import EngineCore
+
+    logger = init_logger("vllm_tpu.engine.core_proc")
+    ctx = zmq.Context(1)
+    inp = ctx.socket(zmq.PULL)
+    inp.connect(input_addr)
+    out = ctx.socket(zmq.PUSH)
+    out.connect(output_addr)
+
+    core = None
+    try:
+        config = pickle.loads(config_bytes)
+        core = EngineCore(config)
+        out.send_multipart([
+            MSG_READY,
+            serial_utils.encode(
+                {"num_gpu_blocks": config.cache_config.num_gpu_blocks}
+            ),
+        ])
+
+        while True:
+            busy = core.has_unfinished_requests()
+            # Idle: block on input (bounded so shutdown stays responsive).
+            timeout = 0 if busy else 200
+            while inp.poll(timeout):
+                frames = inp.recv_multipart()
+                kind = frames[0]
+                if kind == MSG_ADD:
+                    core.add_request(serial_utils.decode(frames[1]))
+                elif kind == MSG_ABORT:
+                    core.abort_requests(serial_utils.decode(frames[1]))
+                elif kind == MSG_UTILITY:
+                    method = frames[1].decode()
+                    result = getattr(core, method)()
+                    out.send_multipart([
+                        MSG_UTILITY_REPLY, serial_utils.encode(result)
+                    ])
+                elif kind == MSG_SHUTDOWN:
+                    return
+                timeout = 0
+            if not core.has_unfinished_requests():
+                continue
+            outputs = core.step()
+            if outputs.outputs:
+                out.send_multipart(
+                    [MSG_OUTPUTS, serial_utils.encode(outputs)]
+                )
+    except Exception:
+        tb = traceback.format_exc()
+        logger.error("engine core proc died:\n%s", tb)
+        try:
+            out.send_multipart([MSG_DEAD, tb.encode()])
+        except Exception:
+            pass
+    finally:
+        if core is not None:
+            core.shutdown()
+        inp.close(linger=0)
+        out.close(linger=0)
+        ctx.term()

@@ -20,7 +20,10 @@ class Executor:
     @staticmethod
     def get_class(config: EngineConfig) -> type["Executor"]:
         backend = config.parallel_config.distributed_executor_backend
-        if backend == "uniproc":
+        if backend in ("uniproc", "mp"):
+            # "mp" splits the ENGINE into its own process (core_client /
+            # core_proc); inside that process one jax client still drives
+            # all local chips, so the worker executor stays uniproc.
             return UniProcExecutor
         raise NotImplementedError(f"executor backend {backend}")
 

@@ -8,7 +8,7 @@ from __future__ import annotations
 from typing import Any
 
 from vllm_tpu.config import EngineConfig
-from vllm_tpu.engine.engine_core import EngineCore
+from vllm_tpu.engine.core_client import make_client
 from vllm_tpu.engine.input_processor import InputProcessor, PromptType
 from vllm_tpu.engine.output_processor import OutputProcessor
 from vllm_tpu.logger import init_logger
@@ -21,7 +21,9 @@ logger = init_logger(__name__)
 class LLMEngine:
     def __init__(self, config: EngineConfig) -> None:
         self.config = config
-        self.engine_core = EngineCore(config)
+        # In-proc EngineCore by default; a spawned ZMQ engine process when
+        # multiprocessing is enabled (reference: EngineCoreClient).
+        self.engine_core = make_client(config.finalize())
         self.input_processor = InputProcessor(config)
         self.output_processor = OutputProcessor(self.input_processor.tokenizer)
 
@@ -58,7 +60,7 @@ class LLMEngine:
         self.output_processor.abort_requests(request_ids)
 
     def step(self) -> list[RequestOutput]:
-        outputs = self.engine_core.step()
+        outputs = self.engine_core.get_output()
         processed = self.output_processor.process_outputs(outputs.outputs)
         if processed.reqs_to_abort:
             self.engine_core.abort_requests(processed.reqs_to_abort)

@@ -1,0 +1,82 @@
+"""msgpack wire serialization for the engine proc split.
+
+Reference analog: ``vllm/v1/serial_utils.py:136`` (MsgpackEncoder /
+MsgpackDecoder). The wire set is the closed family of dataclasses crossing
+the frontend <-> engine-core boundary; anything else is a bug, so encoding
+is strict (no pickle fallback).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+import msgpack
+
+from vllm_tpu.core.sched_output import (
+    EngineCoreOutput,
+    EngineCoreOutputs,
+    SchedulerStats,
+)
+from vllm_tpu.request import EngineCoreRequest
+from vllm_tpu.sampling_params import (
+    RequestOutputKind,
+    SamplingParams,
+    StructuredOutputParams,
+)
+
+_WIRE_TYPES: dict[str, type] = {
+    t.__name__: t
+    for t in (
+        SamplingParams,
+        StructuredOutputParams,
+        EngineCoreRequest,
+        EngineCoreOutput,
+        EngineCoreOutputs,
+        SchedulerStats,
+    )
+}
+_FIELDS = {
+    name: {f.name for f in dataclasses.fields(t)}
+    for name, t in _WIRE_TYPES.items()
+}
+
+
+def _default(o: Any) -> Any:
+    if dataclasses.is_dataclass(o) and type(o).__name__ in _WIRE_TYPES:
+        # vars() also captures dynamically attached attrs (prompt_text).
+        return {"__dc__": type(o).__name__, "f": dict(vars(o))}
+    if isinstance(o, RequestOutputKind):
+        return int(o)
+    if isinstance(o, set):
+        return {"__set__": list(o)}
+    if isinstance(o, tuple):
+        return list(o)
+    raise TypeError(f"unserializable wire object: {type(o)!r}")
+
+
+def _object_hook(d: dict) -> Any:
+    if "__dc__" in d:
+        cls = _WIRE_TYPES[d["__dc__"]]
+        fields = _FIELDS[d["__dc__"]]
+        data = d["f"]
+        obj = cls(**{k: v for k, v in data.items() if k in fields})
+        for k, v in data.items():
+            if k not in fields:
+                setattr(obj, k, v)
+        if isinstance(obj, SamplingParams):
+            obj.output_kind = RequestOutputKind(obj.output_kind)
+        return obj
+    if "__set__" in d:
+        return set(d["__set__"])
+    return d
+
+
+def encode(obj: Any) -> bytes:
+    return msgpack.packb(obj, default=_default, use_bin_type=True)
+
+
+def decode(data: bytes) -> Any:
+    return msgpack.unpackb(
+        data, object_hook=_object_hook, raw=False, strict_map_key=False
+    )

@@ -68,9 +68,14 @@ def load_safetensors_params(
         with safe_open(file, framework="numpy") as f:
             for raw_name in f.keys():
                 # Multimodal wrappers (e.g. Gemma3ForConditionalGeneration)
-                # nest the decoder under language_model.*; vision-tower
-                # tensors simply miss the map and are skipped.
+                # nest the decoder under language_model.* (legacy) or
+                # model.language_model.* (transformers >= 4.52); vision-
+                # tower tensors simply miss the map and are skipped.
                 hf_name = raw_name.removeprefix("language_model.")
+                if hf_name.startswith("model.language_model."):
+                    hf_name = "model." + hf_name.removeprefix(
+                        "model.language_model."
+                    )
                 if hf_name not in weight_map:
                     continue
                 dest, transpose = weight_map[hf_name]
