@@ -102,9 +102,15 @@ def test_mp_engine_matches_inproc(ckpt):
     llm = _llm(ckpt, "mp")
     try:
         got = [o.outputs[0].token_ids for o in llm.generate(prompts, sp)]
+        assert got == ref
+        # Utility RPCs over the wire: sleep/wake roundtrip preserves output.
+        assert llm.sleep(1)
+        assert llm.llm_engine.engine_core.is_sleeping()
+        assert llm.wake_up()
+        again = [o.outputs[0].token_ids for o in llm.generate(prompts, sp)]
+        assert again == ref
     finally:
         llm.llm_engine.shutdown()
-    assert got == ref
 
 
 def test_mp_async_llm_stream(ckpt):

@@ -1,0 +1,71 @@
+"""Sleep mode + RL weight reload (reference: gpu_worker.py sleep :158,
+update_weights :978; EngineCore.sleep core.py:673).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from tests.models.utils import tiny_llama_dir
+from vllm_tpu import LLM, SamplingParams
+
+
+@pytest.fixture(scope="module")
+def ckpt(tmp_path_factory):
+    return tiny_llama_dir(tmp_path_factory.mktemp("tiny_llama_sleep"))
+
+
+def _mk(ckpt):
+    return LLM(
+        model=ckpt, dtype="float32", max_model_len=128, block_size=16,
+        num_gpu_blocks_override=64, max_num_seqs=4,
+        max_num_batched_tokens=128,
+    )
+
+
+def _gen(llm, seed=0):
+    rng = np.random.default_rng(seed)
+    prompts = [{"prompt_token_ids": rng.integers(5, 120, size=9).tolist()}]
+    outs = llm.generate(
+        prompts,
+        SamplingParams(temperature=0.0, max_tokens=8, ignore_eos=True),
+    )
+    return outs[0].outputs[0].token_ids
+
+
+@pytest.mark.parametrize("level", [1, 2])
+def test_sleep_wake_roundtrip(ckpt, level):
+    llm = _mk(ckpt)
+    before = _gen(llm)
+    runner = llm.llm_engine.engine_core.engine_core.executor.worker.runner
+    assert llm.sleep(level)
+    assert runner.params is None and runner.kv_cache is None
+    assert llm.llm_engine.engine_core.is_sleeping()
+    assert llm.wake_up()
+    assert not llm.llm_engine.engine_core.is_sleeping()
+    after = _gen(llm)
+    assert after == before
+
+
+def test_update_weights_changes_outputs(ckpt, tmp_path_factory):
+    import torch
+    from transformers import LlamaForCausalLM
+
+    from tests.models.utils import tiny_llama_config
+
+    # A second checkpoint with different weights.
+    torch.manual_seed(123)
+    other = str(tmp_path_factory.mktemp("tiny_llama_sleep_b"))
+    LlamaForCausalLM(tiny_llama_config()).to(torch.float32).save_pretrained(
+        other, safe_serialization=True
+    )
+
+    llm = _mk(ckpt)
+    before = _gen(llm)
+    assert llm.update_weights(other)
+    after = _gen(llm)
+    assert after != before
+    # Swap back: original outputs return (weights fully replaced in place).
+    assert llm.update_weights(ckpt)
+    assert _gen(llm) == before

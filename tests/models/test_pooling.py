@@ -1,0 +1,132 @@
+"""Pooling/embedding tests: last/mean pooling parity vs HF hidden states,
+LLM.embed, and the /v1/embeddings endpoint.
+
+Reference analog: ``tests/models/language/pooling`` protocol.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from tests.models.utils import tiny_llama_dir
+from vllm_tpu import LLM
+from vllm_tpu.sampling_params import PoolingParams
+
+
+@pytest.fixture(scope="module")
+def ckpt(tmp_path_factory):
+    return tiny_llama_dir(tmp_path_factory.mktemp("tiny_llama_pool"))
+
+
+@pytest.fixture(scope="module")
+def llm(ckpt):
+    return LLM(
+        model=ckpt, dtype="float32", max_model_len=128, block_size=16,
+        num_gpu_blocks_override=64, max_num_seqs=4,
+        max_num_batched_tokens=64,
+    )
+
+
+def hf_hidden(ckpt, input_ids):
+    import torch
+    from transformers import AutoModelForCausalLM
+
+    model = AutoModelForCausalLM.from_pretrained(
+        ckpt, torch_dtype=torch.float32
+    )
+    model.eval()
+    with torch.no_grad():
+        out = model.model(torch.tensor([input_ids]))
+    return out.last_hidden_state[0].numpy()  # post final-norm
+
+
+@pytest.mark.parametrize("ptype", ["last", "mean"])
+def test_pooling_matches_hf(ckpt, llm, ptype):
+    rng = np.random.default_rng(0)
+    ids = rng.integers(5, 120, size=13).tolist()
+    h = hf_hidden(ckpt, ids)
+    want = h[-1] if ptype == "last" else h.mean(axis=0)
+
+    out = llm.embed(
+        [{"prompt_token_ids": ids}],
+        PoolingParams(pooling_type=ptype, normalize=False),
+    )[0]
+    got = np.asarray(out.pooled)
+    np.testing.assert_allclose(got, want, rtol=2e-3, atol=2e-3)
+
+
+def test_normalized_embedding(llm):
+    out = llm.embed(
+        [{"prompt_token_ids": [5, 9, 11]}], PoolingParams(normalize=True)
+    )[0]
+    assert abs(np.linalg.norm(out.pooled) - 1.0) < 1e-5
+
+
+def test_chunked_prefill_last_pooling(ckpt, llm):
+    """Prompt longer than one scheduler chunk: last pooling still matches
+    the full-context HF hidden state."""
+    rng = np.random.default_rng(1)
+    ids = rng.integers(5, 120, size=100).tolist()  # > 64-token budget
+    want = hf_hidden(ckpt, ids)[-1]
+    out = llm.embed(
+        [{"prompt_token_ids": ids}],
+        PoolingParams(pooling_type="last", normalize=False),
+    )[0]
+    np.testing.assert_allclose(
+        np.asarray(out.pooled), want, rtol=2e-3, atol=2e-3
+    )
+
+
+def test_embed_mixed_with_generation(llm):
+    """Pooling and generation interleave in the same engine."""
+    from vllm_tpu import SamplingParams
+
+    gen = llm.generate(
+        [{"prompt_token_ids": [4, 8]}],
+        SamplingParams(temperature=0.0, max_tokens=4, ignore_eos=True),
+    )
+    emb = llm.embed([{"prompt_token_ids": [4, 8]}])
+    assert len(gen[0].outputs[0].token_ids) == 4
+    assert emb[0].pooled is not None
+
+
+def test_embeddings_endpoint(ckpt):
+    import asyncio
+
+    from aiohttp.test_utils import TestClient, TestServer
+
+    from vllm_tpu.engine.arg_utils import AsyncEngineArgs
+    from vllm_tpu.engine.async_llm import AsyncLLM
+    from vllm_tpu.entrypoints.openai.api_server import build_app
+
+    engine = AsyncLLM.from_engine_args(
+        AsyncEngineArgs(
+            model=ckpt, dtype="float32", max_model_len=128, block_size=16,
+            num_gpu_blocks_override=64, max_num_seqs=4,
+            max_num_batched_tokens=64,
+        )
+    )
+
+    async def run():
+        app = build_app(engine, "tiny")
+        client = TestClient(TestServer(app))
+        await client.start_server()
+        try:
+            resp = await client.post(
+                "/v1/embeddings", json={"input": [[5, 9, 11]], "model": "tiny"}
+            )
+            assert resp.status == 200, await resp.text()
+            body = await resp.json()
+            assert body["object"] == "list"
+            assert len(body["data"]) == 1
+            vec = body["data"][0]["embedding"]
+            assert len(vec) == 64  # hidden size
+            assert abs(np.linalg.norm(vec) - 1.0) < 1e-5
+        finally:
+            await client.close()
+
+    try:
+        asyncio.run(run())
+    finally:
+        engine.shutdown()

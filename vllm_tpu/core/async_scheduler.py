@@ -24,7 +24,10 @@ class AsyncScheduler(Scheduler):
 
     def _after_schedule(self, request: Request, num_new_tokens: int) -> None:
         request.num_computed_tokens += num_new_tokens
-        if request.num_computed_tokens >= request.num_tokens:
+        if (
+            request.num_computed_tokens >= request.num_tokens
+            and request.pooling_params is None  # pooling never samples
+        ):
             # This step samples an output token that is not yet known
             # host-side.
             request.num_output_placeholders += 1

@@ -24,6 +24,7 @@ class NewRequestData:
     lora_name: str | None = None
     mm_inputs: list[Any] | None = None
     eos_token_id: int | None = None
+    pooling_params: Any = None
 
 
 @dataclass
@@ -107,6 +108,8 @@ class EngineCoreOutput:
     new_logprobs: Any = None
     num_cached_tokens: int = 0
     events: list[Any] | None = None
+    # Pooling/embedding result (final chunk of a pooling request).
+    pooled: list[float] | None = None
 
 
 @dataclass

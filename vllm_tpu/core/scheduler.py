@@ -354,9 +354,16 @@ class Scheduler:
             # Prefix-cache hit discovery (only before first schedule;
             # resumed-preempted requests keep their progress at 0 and may
             # re-hit the cache too).
+            is_mean_pooling = (
+                request.pooling_params is not None
+                and request.pooling_params.pooling_type == "mean"
+            )
+            # Mean pooling averages the hidden states of the tokens that
+            # actually run through the model this step — a prefix-cache hit
+            # or a split prompt would silently average a suffix only.
             new_computed_blocks, num_new_computed_tokens = (
                 self.kv_cache_manager.get_computed_blocks(request)
-                if request.num_computed_tokens == 0
+                if request.num_computed_tokens == 0 and not is_mean_pooling
                 else ([], 0)
             )
             num_new_tokens = (
@@ -370,6 +377,10 @@ class Scheduler:
                 )
             num_new_tokens = min(num_new_tokens, token_budget)
             assert num_new_tokens > 0
+            if is_mean_pooling and num_new_tokens < (
+                request.num_tokens - request.num_computed_tokens
+            ):
+                break  # wait for a step with budget for the whole prompt
 
             new_blocks = self.kv_cache_manager.allocate_slots(
                 request,
@@ -407,6 +418,7 @@ class Scheduler:
                         num_computed_tokens=request.num_computed_tokens,
                         lora_name=request.lora_name,
                         eos_token_id=request.eos_token_id,
+                        pooling_params=request.pooling_params,
                     )
                 )
             num_scheduled_tokens[request.request_id] = num_new_tokens
@@ -506,6 +518,30 @@ class Scheduler:
 
             generated = runner_output.sampled_token_ids[req_index]
             scheduled_spec = spec_scheduled.get(req_id, [])
+
+            if request.pooling_params is not None:
+                # Pooling request: no tokens are ever emitted; it finishes
+                # when the final chunk's pooled vector arrives.
+                if not self.async_scheduling:
+                    request.num_computed_tokens += num_tokens_scheduled
+                request.num_output_placeholders = 0
+                pooled = runner_output.pooler_outputs.get(req_id)
+                if pooled is not None:
+                    request.status = RequestStatus.FINISHED_STOPPED
+                    if request in self.running:
+                        self.running.remove(request)
+                    else:
+                        self.waiting.remove(request)
+                    self._free_request(request)
+                    outputs.append(
+                        EngineCoreOutput(
+                            req_id=req_id,
+                            new_token_ids=[],
+                            finish_reason=request.get_finished_reason(),
+                            pooled=pooled,
+                        )
+                    )
+                continue
 
             if not self.async_scheduling:
                 request.num_computed_tokens += num_tokens_scheduled

@@ -95,13 +95,15 @@ class AsyncLLM:
         sampling_params: SamplingParams,
         request_id: str,
         priority: int = 0,
+        pooling_params=None,
     ) -> AsyncGenerator[RequestOutput, None]:
         """Feed a request and yield RequestOutputs as tokens arrive."""
         if self._dead:
             raise EngineDeadError("engine core died")
         self._loop = asyncio.get_running_loop()
         core_req = self.input_processor.process(
-            request_id, prompt, sampling_params, priority=priority
+            request_id, prompt, sampling_params, priority=priority,
+            pooling_params=pooling_params,
         )
         out_q = AsyncStream(asyncio.get_running_loop())
         self.output_processor.add_request(

@@ -67,6 +67,18 @@ class InprocClient:
     def reset_prefix_cache(self) -> bool:
         return self.engine_core.reset_prefix_cache()
 
+    def sleep(self, level: int = 1) -> bool:
+        return self.engine_core.sleep(level)
+
+    def wake_up(self) -> bool:
+        return self.engine_core.wake_up()
+
+    def is_sleeping(self) -> bool:
+        return self.engine_core.is_sleeping()
+
+    def update_weights(self, path: str) -> bool:
+        return self.engine_core.update_weights(path)
+
     @property
     def inflight(self) -> bool:
         return bool(self.engine_core._inflight)
@@ -199,20 +211,38 @@ class MPClient:
     def has_unfinished_requests(self) -> bool:
         return bool(self._live)
 
-    def reset_prefix_cache(self) -> bool:
+    def _utility(self, method: str, *args, timeout_ms: int = 600_000):
+        """Blocking engine-core method call over the socket pair."""
         self._check_alive()
-        self._input.send_multipart(
-            [self._proc_mod.MSG_UTILITY, b"reset_prefix_cache"]
-        )
+        self._input.send_multipart([
+            self._proc_mod.MSG_UTILITY,
+            method.encode(),
+            self._serial.encode(list(args)),
+        ])
         # Outputs may interleave ahead of the reply; buffer them.
         for _ in range(1000):
-            frames = self._recv(timeout_ms=30_000)
+            frames = self._recv(timeout_ms=timeout_ms)
             if frames is None:
                 break
             if frames[0] == self._proc_mod.MSG_UTILITY_REPLY:
                 return self._serial.decode(frames[1])
             self._pending.append(frames)
-        raise EngineDeadError("utility call got no reply")
+        raise EngineDeadError(f"utility call {method} got no reply")
+
+    def reset_prefix_cache(self) -> bool:
+        return self._utility("reset_prefix_cache", timeout_ms=30_000)
+
+    def sleep(self, level: int = 1) -> bool:
+        return self._utility("sleep", level)
+
+    def wake_up(self) -> bool:
+        return self._utility("wake_up")
+
+    def is_sleeping(self) -> bool:
+        return self._utility("is_sleeping", timeout_ms=30_000)
+
+    def update_weights(self, path: str) -> bool:
+        return self._utility("update_weights", path)
 
     @property
     def inflight(self) -> bool:

@@ -77,7 +77,12 @@ def run_engine_core(config_bytes: bytes, input_addr: str,
                     core.abort_requests(serial_utils.decode(frames[1]))
                 elif kind == MSG_UTILITY:
                     method = frames[1].decode()
-                    result = getattr(core, method)()
+                    args = (
+                        serial_utils.decode(frames[2])
+                        if len(frames) > 2
+                        else []
+                    )
+                    result = getattr(core, method)(*args)
                     out.send_multipart([
                         MSG_UTILITY_REPLY, serial_utils.encode(result)
                     ])

@@ -126,6 +126,42 @@ class EngineCore:
     def reset_prefix_cache(self) -> bool:
         return self.scheduler.kv_cache_manager.reset_prefix_cache()
 
+    # ------------------------------------------------------------------
+    # Sleep / wake / weight reload (reference: core.py:673 sleep, :711
+    # wake_up; gpu_worker.py:978 update_weights)
+    # ------------------------------------------------------------------
+
+    def sleep(self, level: int = 1) -> bool:
+        assert not self.scheduler.has_unfinished_requests(), (
+            "cannot sleep with unfinished requests"
+        )
+        # Drain in-flight steps scheduled past the last finish (their
+        # outputs are stale and identity-guarded away).
+        while self._inflight:
+            self.step()
+        # The KV cache is discarded; any cached prefixes are invalid.
+        self.scheduler.kv_cache_manager.reset_prefix_cache()
+        self.executor.collective_rpc("sleep", level)
+        self._asleep = True
+        return True
+
+    def wake_up(self) -> bool:
+        self.executor.collective_rpc("wake_up")
+        self._asleep = False
+        return True
+
+    def is_sleeping(self) -> bool:
+        return getattr(self, "_asleep", False)
+
+    def update_weights(self, path: str) -> bool:
+        assert not self.scheduler.has_unfinished_requests(), (
+            "cannot swap weights with unfinished requests"
+        )
+        while self._inflight:
+            self.step()
+        self.executor.collective_rpc("update_weights", path)
+        return True
+
     def shutdown(self) -> None:
         if self.structured_output_manager is not None:
             self.structured_output_manager.shutdown()

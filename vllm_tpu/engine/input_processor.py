@@ -60,6 +60,7 @@ class InputProcessor:
         params: SamplingParams,
         arrival_time: float | None = None,
         priority: int = 0,
+        pooling_params=None,
     ) -> EngineCoreRequest:
         if isinstance(prompt, str):
             prompt_text: str | None = prompt
@@ -73,7 +74,8 @@ class InputProcessor:
                 prompt_text = prompt.get("prompt")
             elif "prompt" in prompt:
                 return self.process(
-                    request_id, prompt["prompt"], params, arrival_time, priority
+                    request_id, prompt["prompt"], params, arrival_time,
+                    priority, pooling_params,
                 )
             else:
                 raise ValueError(f"invalid prompt dict keys: {list(prompt)}")
@@ -101,6 +103,17 @@ class InputProcessor:
                     f"gpu_memory_utilization or num_gpu_blocks_override"
                 )
 
+        if pooling_params is not None:
+            if (
+                pooling_params.pooling_type == "mean"
+                and len(prompt_token_ids)
+                > self.config.scheduler_config.max_num_batched_tokens
+            ):
+                raise ValueError(
+                    "mean pooling requires the prompt to fit one scheduler "
+                    f"chunk ({self.config.scheduler_config.max_num_batched_tokens} tokens)"
+                )
+            params = SamplingParams(max_tokens=1)
         params = self._finalize_params(params, len(prompt_token_ids))
         eos_token_id = None
         if self.tokenizer is not None:
@@ -113,6 +126,7 @@ class InputProcessor:
             arrival_time=arrival_time if arrival_time is not None else time.monotonic(),
             eos_token_id=eos_token_id,
             priority=priority,
+            pooling_params=pooling_params,
         )
         req.prompt_text = prompt_text  # carried for outputs
         return req

@@ -43,9 +43,13 @@ class LLMEngine:
         prompt: PromptType,
         params: SamplingParams | None = None,
         priority: int = 0,
+        pooling_params=None,
     ) -> None:
         params = params if params is not None else SamplingParams()
-        core_req = self.input_processor.process(request_id, prompt, params, priority=priority)
+        core_req = self.input_processor.process(
+            request_id, prompt, params, priority=priority,
+            pooling_params=pooling_params,
+        )
         self.output_processor.add_request(
             request_id,
             getattr(core_req, "prompt_text", None),

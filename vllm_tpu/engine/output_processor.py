@@ -173,6 +173,8 @@ class OutputProcessor:
             out = state.make_request_output(
                 eco.new_token_ids, finish_reason, stop_reason
             )
+            if out is not None and eco.pooled is not None:
+                out.pooled = eco.pooled
             if out is not None:
                 if state.queue is not None:
                     state.queue.put_nowait(out)

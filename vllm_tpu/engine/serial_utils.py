@@ -20,6 +20,7 @@ from vllm_tpu.core.sched_output import (
 )
 from vllm_tpu.request import EngineCoreRequest
 from vllm_tpu.sampling_params import (
+    PoolingParams,
     RequestOutputKind,
     SamplingParams,
     StructuredOutputParams,
@@ -30,6 +31,7 @@ _WIRE_TYPES: dict[str, type] = {
     for t in (
         SamplingParams,
         StructuredOutputParams,
+        PoolingParams,
         EngineCoreRequest,
         EngineCoreOutput,
         EngineCoreOutputs,

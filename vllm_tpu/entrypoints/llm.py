@@ -35,6 +35,42 @@ class LLM:
     def get_tokenizer(self):
         return self.llm_engine.tokenizer
 
+    def embed(
+        self,
+        prompts,
+        pooling_params=None,
+        use_tqdm: bool = False,
+    ) -> list[RequestOutput]:
+        """Prompt embeddings via prompt-only forward + pooling
+        (reference: ``LLM.embed``). Returns RequestOutputs whose ``pooled``
+        field holds the embedding vector."""
+        from vllm_tpu.sampling_params import PoolingParams
+
+        if isinstance(prompts, (str, dict)):
+            prompts = [prompts]
+        pooling_params = pooling_params or PoolingParams()
+        request_ids = []
+        for prompt in prompts:
+            rid = str(self._request_counter)
+            self._request_counter += 1
+            self.llm_engine.add_request(
+                rid, prompt, SamplingParams(max_tokens=1),
+                pooling_params=pooling_params,
+            )
+            request_ids.append(rid)
+        return self._run_engine(request_ids, use_tqdm)
+
+    # Sleep mode / RL weight updates (reference: LLM.sleep/wake_up,
+    # collective_rpc update_weights).
+    def sleep(self, level: int = 1) -> bool:
+        return self.llm_engine.engine_core.sleep(level)
+
+    def wake_up(self) -> bool:
+        return self.llm_engine.engine_core.wake_up()
+
+    def update_weights(self, path: str) -> bool:
+        return self.llm_engine.engine_core.update_weights(path)
+
     # ------------------------------------------------------------------
 
     def generate(

@@ -251,6 +251,64 @@ async def handle_chat_completions(request: web.Request) -> web.StreamResponse:
 # ----------------------------------------------------------------------
 
 
+async def handle_embeddings(request: web.Request) -> web.Response:
+    """OpenAI /v1/embeddings (reference: openai embeddings API)."""
+    engine: AsyncLLM = request.app[ENGINE_KEY]
+    try:
+        body = await request.json()
+    except json.JSONDecodeError:
+        return _error(400, "invalid JSON body")
+    try:
+        inputs = body.get("input")
+        if inputs is None:
+            raise ValidationError("'input' is required")
+        prompts = _normalize_prompts(inputs)
+        from vllm_tpu.sampling_params import PoolingParams, SamplingParams
+
+        pooling = PoolingParams(
+            pooling_type=body.get("pooling_type", "last"),
+            normalize=bool(body.get("normalize", True)),
+        )
+    except ValidationError as e:
+        return _error(400, str(e))
+
+    async def one(prompt):
+        rid = random_id("embd")
+        final = None
+        async for out in engine.generate(
+            prompt, SamplingParams(max_tokens=1), rid,
+            pooling_params=pooling,
+        ):
+            final = out
+        if final is None or final.pooled is None:
+            raise RuntimeError("pooling request produced no embedding")
+        return final
+
+    import asyncio
+
+    try:
+        finals = await asyncio.gather(*(one(p) for p in prompts))
+    except (ValueError, TypeError) as e:
+        return _error(400, str(e))
+    data = []
+    total_tokens = 0
+    for i, final in enumerate(finals):
+        total_tokens += len(final.prompt_token_ids)
+        data.append({
+            "object": "embedding",
+            "index": i,
+            "embedding": final.pooled,
+        })
+    return web.json_response({
+        "object": "list",
+        "data": data,
+        "model": request.app[MODEL_KEY],
+        "usage": {
+            "prompt_tokens": total_tokens, "total_tokens": total_tokens,
+        },
+    })
+
+
 async def handle_models(request: web.Request) -> web.Response:
     return web.json_response({
         "object": "list",
@@ -375,6 +433,7 @@ def build_app(engine: AsyncLLM, model_name: str, metrics=None) -> web.Applicatio
     if metrics is not None:
         app[METRICS_KEY] = metrics
     app.router.add_post("/v1/completions", handle_completions)
+    app.router.add_post("/v1/embeddings", handle_embeddings)
     app.router.add_post("/v1/chat/completions", handle_chat_completions)
     app.router.add_get("/v1/models", handle_models)
     app.router.add_get("/health", handle_health)

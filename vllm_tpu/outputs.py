@@ -44,6 +44,8 @@ class RequestOutput:
     prompt_logprobs: LogprobsList | None = None
     num_cached_tokens: int = 0
     metrics: "RequestMetrics | None" = None
+    # Pooling/embedding result (embed requests).
+    pooled: list[float] | None = None
 
 
 @dataclass

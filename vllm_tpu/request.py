@@ -52,6 +52,8 @@ class EngineCoreRequest:
     lora_name: str | None = None
     # Multimodal placeholders (feature ring 1).
     mm_inputs: list[Any] | None = None
+    # Pooling/embedding request (None = generation).
+    pooling_params: Any = None
 
 
 class Request:
@@ -67,6 +69,7 @@ class Request:
         priority: int = 0,
         lora_name: str | None = None,
         block_hasher: Any = None,
+        pooling_params: Any = None,
     ) -> None:
         self.request_id = request_id
         self.prompt_token_ids = prompt_token_ids
@@ -75,6 +78,7 @@ class Request:
         self.arrival_time = arrival_time if arrival_time is not None else time.monotonic()
         self.priority = priority
         self.lora_name = lora_name
+        self.pooling_params = pooling_params
 
         self.status = RequestStatus.WAITING
         self.stop_reason: int | str | None = None
@@ -116,6 +120,7 @@ class Request:
             prompt_token_ids=req.prompt_token_ids,
             sampling_params=req.sampling_params,
             eos_token_id=req.eos_token_id,
+            pooling_params=req.pooling_params,
             arrival_time=req.arrival_time,
             priority=req.priority,
             lora_name=req.lora_name,

@@ -36,6 +36,20 @@ class StructuredOutputParams:
 
 
 @dataclass
+class PoolingParams:
+    """Embedding/pooling request parameters (reference:
+    ``vllm/pooling_params.py``). Causal-LM pooling: hidden state of the
+    last token or the masked mean over the prompt."""
+
+    pooling_type: str = "last"  # "last" | "mean"
+    normalize: bool = True
+
+    def __post_init__(self) -> None:
+        if self.pooling_type not in ("last", "mean"):
+            raise ValueError(f"unknown pooling_type {self.pooling_type!r}")
+
+
+@dataclass
 class SamplingParams:
     n: int = 1
     temperature: float = 1.0

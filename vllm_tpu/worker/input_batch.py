@@ -26,12 +26,15 @@ class CachedRequestState:
         "eos_token_id",
         "needs_logit_adjust",
         "logit_bias_items",
+        "pooling_params",
     )
 
     def __init__(self, req_id: str, sampling_params: SamplingParams,
-                 eos_token_id: int | None = None) -> None:
+                 eos_token_id: int | None = None,
+                 pooling_params=None) -> None:
         self.req_id = req_id
         self.sampling_params = sampling_params
+        self.pooling_params = pooling_params
         self.num_computed_tokens = 0
         self.num_tokens = 0
         self.generated = 0  # sampled so far (drives seeded PRNG streams)
@@ -97,7 +100,8 @@ class InputBatch:
         self.req_ids[row] = req_id
 
         state = CachedRequestState(
-            req_id, data.sampling_params, data.eos_token_id
+            req_id, data.sampling_params, data.eos_token_id,
+            getattr(data, "pooling_params", None),
         )
         state.in_batch_row = row
         state.num_computed_tokens = data.num_computed_tokens

@@ -49,6 +49,7 @@ class StepHandle:
         self.row_states = row_states or []
         self.empty = empty
         self.drafts = None  # EAGLE proposals [R, K] (device array)
+        self.pooled = None  # (last [R, D], mean [R, D]) pooling outputs
 
 
 def _bucket(value: int, buckets: list[int]) -> int:
@@ -102,6 +103,8 @@ class ModelRunner:
         # jitted step sees one prev_sampled shape (else every bucket
         # transition would recompile: current-bucket x previous-bucket).
         self._last_sampled = None
+        self._host_params = None
+        self._host_draft = None
         self._max_pipeline_depth = sched.async_pipeline_depth
         # Sparse logits-processor entry-count buckets (static trace dims).
         self._adj_buckets = [4, 16, 64, 512]
@@ -204,6 +207,7 @@ class ModelRunner:
                 "needs_top_p_min_p",
                 "needs_gumbel",
                 "needs_grammar",
+                "needs_pooling",
                 "num_logprobs",
                 "num_spec",
                 "num_adj",
@@ -322,7 +326,8 @@ class ModelRunner:
         needs_top_p_min_p: bool,
         needs_gumbel: bool,
         needs_grammar: bool,
-        num_logprobs: int,
+        needs_pooling: bool = False,
+        num_logprobs: int = 0,
         num_spec: int = 0,
         num_adj: int = 0,
         num_allow: int = 0,
@@ -381,8 +386,26 @@ class ModelRunner:
                     params, draft_kv, token_ids, hidden, md, anchor,
                     emitted, draft_next, r_pad,
                 )
-            return kv_cache, draft_kv, (out_tokens, num_out), None, drafts
+            return kv_cache, draft_kv, (out_tokens, num_out), None, drafts, None
         last = hidden[md.logits_indices]  # [R, D]
+        pooled = None
+        if needs_pooling:
+            # "last" pooling = the gathered last-token hidden; "mean" is a
+            # masked segment mean (live tokens only; single-chunk prompts,
+            # enforced at admission). Both shipped; finalize picks per
+            # request.
+            t_live_dev = md.query_start_loc[md.num_seqs[0]]
+            valid = jnp.arange(token_ids.shape[0]) < t_live_dev
+            seg = jnp.where(valid, md.token_req_idx, r_pad)
+            sums = jnp.zeros((r_pad, hidden.shape[-1]), jnp.float32)
+            sums = sums.at[seg].add(
+                hidden.astype(jnp.float32), mode="drop"
+            )
+            counts_seg = jnp.maximum(
+                md.query_start_loc[1:] - md.query_start_loc[:-1], 1
+            )
+            mean = sums / counts_seg[:, None]
+            pooled = (last.astype(jnp.float32), mean)
         logits = self.model.compute_logits(params, last)  # [R, V] f32
         if needs_grammar:
             # Gather each row's packed grammar bitmask from the
@@ -438,7 +461,7 @@ class ModelRunner:
             lp = (topk_vals, topk_ids, sampled_lp, sampled_rank)
         else:
             lp = None
-        return kv_cache, draft_kv, sampled, lp, drafts
+        return kv_cache, draft_kv, sampled, lp, drafts, pooled
 
     def _eagle_drafts(self, params, draft_kv, token_ids, hidden, md,
                       anchor, emitted, draft_next, r_pad):
@@ -757,6 +780,10 @@ class ModelRunner:
             ),
             needs_gumbel=bool(np.any(nongreedy)),
             needs_grammar=bool(so.structured_output_request_ids),
+            needs_pooling=any(
+                batch.req_states[rid].pooling_params is not None
+                for rid in req_order
+            ),
             num_logprobs=num_logprobs,
             num_spec=s,
             num_adj=num_adj,
@@ -886,7 +913,8 @@ class ModelRunner:
             t1 = time.perf_counter()
             self.timing["prep_s"] += t1 - t0
         prev = self._last_sampled if self._last_sampled is not None else self._zero_sampled
-        self.kv_cache, self.draft_kv, sampled, lp, drafts = self._step_fn(
+        (self.kv_cache, self.draft_kv, sampled, lp, drafts,
+         pooled) = self._step_fn(
             self.params, self.kv_cache, self.draft_kv, *arrays, prev,
             mask_table, **flags,
         )
@@ -911,12 +939,16 @@ class ModelRunner:
                 x.copy_to_host_async()
         if drafts is not None:
             drafts.copy_to_host_async()
+        if pooled is not None:
+            for x in pooled:
+                x.copy_to_host_async()
         handle = StepHandle(
             req_order=req_order, do_sample=do_sample, sampled=sampled, lp=lp,
             row_states=[self.input_batch.req_states[r] for r in req_order],
             spec=is_spec,
         )
         handle.drafts = drafts
+        handle.pooled = pooled
         return handle
 
     def finalize(self, handle: "StepHandle") -> ModelRunnerOutput:
@@ -939,6 +971,11 @@ class ModelRunner:
             if handle.drafts is not None
             else None
         )
+        pooled_np = (
+            tuple(np.asarray(jax.device_get(x)) for x in handle.pooled)
+            if handle.pooled is not None
+            else None
+        )
         if self._timing_enabled:
             self.timing["wait_s"] += time.perf_counter() - t0
 
@@ -952,6 +989,23 @@ class ModelRunner:
             np.any(self.input_batch.num_logprobs[: self.input_batch.num_reqs] > 0)
         )
         for i, rid in enumerate(req_order):
+            state_i = handle.row_states[i]
+            if (
+                pooled_np is not None
+                and do_sample[i]
+                and state_i.pooling_params is not None
+            ):
+                pp = state_i.pooling_params
+                vec = (
+                    pooled_np[1][i]
+                    if pp.pooling_type == "mean"
+                    else pooled_np[0][i]
+                )
+                if pp.normalize:
+                    vec = vec / max(float(np.linalg.norm(vec)), 1e-12)
+                out.pooler_outputs[rid] = [float(x) for x in vec]
+                out.sampled_token_ids.append([])
+                continue
             if do_sample[i]:
                 toks = (
                     [int(x) for x in out_tokens[i, : num_out[i]]]
@@ -993,6 +1047,134 @@ class ModelRunner:
 
     def execute_model(self, so: SchedulerOutput) -> ModelRunnerOutput:
         return self.finalize(self.dispatch(so))
+
+    # ------------------------------------------------------------------
+    # Sleep / wake / weight reload
+    # ------------------------------------------------------------------
+
+    def sleep(self, level: int = 1) -> None:
+        """Release device memory (reference: ``gpu_worker.py sleep :158``,
+        CuMem VMM offload). Level 1 offloads weights to host RAM and
+        discards the KV cache; level 2 discards the weights too (wake needs
+        a reload source). TPU-native: jax.device_get + buffer deletion —
+        no custom allocator needed."""
+        import jax
+
+        if level >= 2:
+            self._host_params = None
+        else:
+            self._host_params = jax.device_get(self.params)
+        for leaf in jax.tree_util.tree_leaves(self.params):
+            leaf.delete()
+        self.params = None
+        for leaf in jax.tree_util.tree_leaves(self.kv_cache):
+            leaf.delete()
+        self.kv_cache = None
+        if self.draft_kv is not None:
+            self._host_draft = jax.device_get(self.draft_params) if level < 2 else None
+            for leaf in jax.tree_util.tree_leaves(
+                (self.draft_params, self.draft_kv)
+            ):
+                leaf.delete()
+            self.draft_params = None
+            self.draft_kv = None
+        self._last_sampled = None
+        logger.info("runner asleep (level %d)", level)
+
+    def wake_up(self, params=None, draft_params=None) -> None:
+        """Restore device state. ``params`` (device-ready, e.g. freshly
+        loaded) overrides the host copy — required after a level-2 sleep."""
+        import jax
+
+        if params is not None:
+            self.params = params
+        else:
+            assert self._host_params is not None, (
+                "level-2 sleep requires reload params"
+            )
+            self.params = self._put_params(self._host_params)
+        self._host_params = None
+        cache = self.config.cache_config
+        from vllm_tpu.ops.attention import kv_cache_shape
+
+        kv_shape = kv_cache_shape(
+            self.model.num_layers, cache.num_gpu_blocks, cache.block_size,
+            self.model.num_kv_heads, self.model.head_dim,
+        )
+        kv_dtype = (
+            self.model.dtype
+            if cache.cache_dtype == "auto"
+            else jnp.dtype(cache.jax_cache_dtype)
+        )
+        self.kv_cache = jnp.zeros(kv_shape, kv_dtype)
+        if self.mesh is not None:
+            from jax.sharding import NamedSharding
+
+            self.kv_cache = jax.device_put(
+                self.kv_cache,
+                NamedSharding(self.mesh, self.model.kv_cache_sharding()),
+            )
+        if self.draft_model is not None:
+            if draft_params is not None:
+                self.draft_params = draft_params
+            else:
+                assert self._host_draft is not None
+                if self.mesh is None:
+                    self.draft_params = jax.tree_util.tree_map(
+                        jnp.asarray, self._host_draft
+                    )
+                else:
+                    from vllm_tpu.parallel.mesh import named_shardings
+
+                    dsh = named_shardings(
+                        self.mesh, self.draft_model.param_shardings()
+                    )
+                    self.draft_params = jax.tree_util.tree_map(
+                        lambda x, sp: jax.device_put(jnp.asarray(x), sp),
+                        self._host_draft, dsh,
+                    )
+            self._host_draft = None
+            self.draft_kv = jnp.zeros(
+                self.draft_model.kv_shape(
+                    cache.num_gpu_blocks, cache.block_size
+                ),
+                kv_dtype,
+            )
+        logger.info("runner awake")
+
+    def _put_params(self, host_tree):
+        import jax
+
+        if self.mesh is None:
+            return jax.tree_util.tree_map(jnp.asarray, host_tree)
+        from vllm_tpu.parallel.mesh import named_shardings
+
+        shardings = named_shardings(self.mesh, self.model.param_shardings())
+        return jax.tree_util.tree_map(
+            lambda x, sp: jax.device_put(jnp.asarray(x), sp),
+            host_tree, shardings,
+        )
+
+    def update_weights(self, path: str) -> None:
+        """In-place weight swap for RL rollouts (reference:
+        ``gpu_worker.py update_weights :978``). Loads a new checkpoint with
+        the existing shardings; KV cache survives (same model geometry)."""
+        import jax
+
+        shardings = None
+        if self.mesh is not None:
+            from vllm_tpu.parallel.mesh import named_shardings
+
+            shardings = named_shardings(
+                self.mesh, self.model.param_shardings()
+            )
+        old = self.params
+        self.params = self.model.load_params(
+            path, self.model.dtype, shardings
+        )
+        for leaf in jax.tree_util.tree_leaves(old):
+            leaf.delete()
+        logger.info("weights updated from %s", path)
 
     # ------------------------------------------------------------------
 

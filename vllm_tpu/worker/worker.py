@@ -273,3 +273,43 @@ class Worker:
     def set_structured_output_manager(self, manager: Any) -> None:
         assert self.runner is not None
         self.runner.structured_output_manager = manager
+
+    def sleep(self, level: int = 1) -> None:
+        assert self.runner is not None
+        self.runner.sleep(level)
+
+    def wake_up(self) -> None:
+        assert self.runner is not None
+        runner = self.runner
+        params = None
+        draft_params = None
+        if runner._host_params is None:
+            # Level-2 sleep discarded the weights: reload from source.
+            mc = self.config.model_config
+            shardings = None
+            if self.mesh is not None:
+                from vllm_tpu.parallel.mesh import named_shardings
+
+                shardings = named_shardings(
+                    self.mesh, self.model.param_shardings()
+                )
+            if mc.load_format == "dummy":
+                from vllm_tpu.models.loader import init_dummy_params
+
+                params = init_dummy_params(
+                    self.model, mc.seed, mc.jax_dtype, shardings
+                )
+            else:
+                params = self.model.load_params(
+                    mc.model, mc.jax_dtype, shardings
+                )
+            self.params = params
+            if runner.draft_model is not None and runner._host_draft is None:
+                spec = self.config.speculative_config
+                self._load_eagle(spec, mc)
+                draft_params = self.draft_params
+        runner.wake_up(params=params, draft_params=draft_params)
+
+    def update_weights(self, path: str) -> None:
+        assert self.runner is not None
+        self.runner.update_weights(path)
