@@ -1,0 +1,186 @@
+"""Auxiliary surfaces: Anthropic Messages API, run-batch, profiler RPC,
+NaN detection flag.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+
+import pytest
+
+from tests.models.utils import tiny_llama_dir_with_tokenizer
+from vllm_tpu.engine.arg_utils import AsyncEngineArgs
+from vllm_tpu.engine.async_llm import AsyncLLM
+
+
+@pytest.fixture(scope="module")
+def ckpt(tmp_path_factory):
+    return tiny_llama_dir_with_tokenizer(tmp_path_factory.mktemp("tiny_aux"))
+
+
+@pytest.fixture(scope="module")
+def engine(ckpt):
+    e = AsyncLLM.from_engine_args(
+        AsyncEngineArgs(
+            model=ckpt, dtype="float32", max_model_len=128, block_size=16,
+            num_gpu_blocks_override=64, max_num_seqs=4,
+            max_num_batched_tokens=128,
+        )
+    )
+    yield e
+    e.shutdown()
+
+
+async def _client(engine):
+    from aiohttp.test_utils import TestClient, TestServer
+
+    from vllm_tpu.entrypoints.openai.api_server import build_app
+
+    client = TestClient(TestServer(build_app(engine, "tiny")))
+    await client.start_server()
+    return client
+
+
+def test_anthropic_messages(engine):
+    async def run():
+        client = await _client(engine)
+        try:
+            resp = await client.post("/v1/messages", json={
+                "model": "tiny", "max_tokens": 6,
+                "messages": [{"role": "user", "content": "ab"}],
+            })
+            assert resp.status == 200, await resp.text()
+            body = await resp.json()
+            assert body["type"] == "message"
+            assert body["role"] == "assistant"
+            assert body["content"][0]["type"] == "text"
+            assert body["stop_reason"] in (
+                "end_turn", "max_tokens", "stop_sequence"
+            )
+            assert body["usage"]["output_tokens"] >= 1
+        finally:
+            await client.close()
+
+    asyncio.run(run())
+
+
+def test_anthropic_messages_stream(engine):
+    async def run():
+        client = await _client(engine)
+        try:
+            resp = await client.post("/v1/messages", json={
+                "model": "tiny", "max_tokens": 5, "stream": True,
+                "messages": [{"role": "user", "content": "ab"}],
+            })
+            assert resp.status == 200
+            text = (await resp.read()).decode()
+            events = [
+                line.split(": ", 1)[1]
+                for line in text.splitlines()
+                if line.startswith("event: ")
+            ]
+            assert events[0] == "message_start"
+            assert "content_block_delta" in events
+            assert events[-1] == "message_stop"
+        finally:
+            await client.close()
+
+    asyncio.run(run())
+
+
+def test_anthropic_validation(engine):
+    async def run():
+        client = await _client(engine)
+        try:
+            resp = await client.post("/v1/messages", json={
+                "model": "tiny",
+                "messages": [{"role": "user", "content": "x"}],
+            })  # missing max_tokens
+            assert resp.status == 400
+        finally:
+            await client.close()
+
+    asyncio.run(run())
+
+
+def test_run_batch(ckpt, tmp_path):
+    from vllm_tpu.engine.arg_utils import EngineArgs
+    from vllm_tpu.engine.llm_engine import LLMEngine
+    from vllm_tpu.entrypoints.run_batch import run_batch
+
+    inp = tmp_path / "in.jsonl"
+    outp = tmp_path / "out.jsonl"
+    lines = [
+        {"custom_id": "c1", "method": "POST", "url": "/v1/completions",
+         "body": {"prompt": "ab", "max_tokens": 4, "temperature": 0.0,
+                  "ignore_eos": True}},
+        {"custom_id": "c2", "method": "POST", "url": "/v1/chat/completions",
+         "body": {"messages": [{"role": "user", "content": "hi"}],
+                  "max_tokens": 4, "temperature": 0.0}},
+        {"custom_id": "c3", "method": "POST", "url": "/v1/embeddings",
+         "body": {"input": "ab"}},
+        {"custom_id": "bad", "method": "POST", "url": "/v1/unknown",
+         "body": {}},
+    ]
+    inp.write_text("\n".join(json.dumps(x) for x in lines))
+
+    engine = LLMEngine.from_engine_args(
+        EngineArgs(
+            model=ckpt, dtype="float32", max_model_len=128, block_size=16,
+            num_gpu_blocks_override=64, max_num_seqs=4,
+            max_num_batched_tokens=128,
+        )
+    )
+    try:
+        stats = run_batch(engine, str(inp), str(outp), "tiny")
+    finally:
+        engine.shutdown()
+    assert stats == {"total": 4, "succeeded": 3, "failed": 1}
+    recs = [json.loads(x) for x in outp.read_text().splitlines()]
+    by_id = {r["custom_id"]: r for r in recs}
+    assert by_id["c1"]["response"]["body"]["object"] == "text_completion"
+    assert by_id["c2"]["response"]["body"]["choices"][0]["message"]["role"] == "assistant"
+    assert len(by_id["c3"]["response"]["body"]["data"][0]["embedding"]) == 64
+    assert by_id["bad"]["error"]["code"] == 400
+
+
+def test_profiler_rpc(ckpt, tmp_path):
+    from vllm_tpu import LLM, SamplingParams
+
+    llm = LLM(
+        model=ckpt, dtype="float32", max_model_len=128, block_size=16,
+        num_gpu_blocks_override=64, max_num_seqs=4,
+        max_num_batched_tokens=128,
+    )
+    trace_dir = str(tmp_path / "trace")
+    client = llm.llm_engine.engine_core
+    assert client.start_profile(trace_dir)
+    llm.generate(
+        [{"prompt_token_ids": [5, 9]}],
+        SamplingParams(temperature=0.0, max_tokens=2, ignore_eos=True),
+    )
+    assert client.stop_profile()
+    import os
+
+    assert any(os.scandir(trace_dir)), "no trace written"
+
+
+def test_nan_check_flag(ckpt, monkeypatch):
+    from vllm_tpu import LLM, SamplingParams, envs
+
+    monkeypatch.setenv("VLLM_TPU_NAN_CHECK", "1")
+    envs.refresh()
+    try:
+        llm = LLM(
+            model=ckpt, dtype="float32", max_model_len=128, block_size=16,
+            num_gpu_blocks_override=64, max_num_seqs=4,
+            max_num_batched_tokens=128,
+        )
+        outs = llm.generate(
+            [{"prompt_token_ids": [5, 9]}],
+            SamplingParams(temperature=0.0, max_tokens=4, ignore_eos=True),
+        )
+        assert len(outs[0].outputs[0].token_ids) == 4
+    finally:
+        envs.refresh()

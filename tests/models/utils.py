@@ -63,10 +63,15 @@ def tiny_tokenizer(vocab_size: int = 128):
         show_progress=False,
     )
     tok.train_from_iterator(corpus, trainer)
-    return PreTrainedTokenizerFast(
+    wrapped = PreTrainedTokenizerFast(
         tokenizer_object=tok,
         unk_token="<unk>", bos_token="<s>", eos_token="</s>",
     )
+    wrapped.chat_template = (
+        "{% for m in messages %}{{ m['role'] }}: {{ m['content'] }}\n"
+        "{% endfor %}{% if add_generation_prompt %}assistant:{% endif %}"
+    )
+    return wrapped
 
 
 def tiny_llama_dir_with_tokenizer(path, **overrides) -> str:

@@ -213,6 +213,7 @@ class Scheduler:
         if any(r.spec_token_ids for r in self.running) and any(
             r.sampling_params.logprobs is not None
             or r.use_structured_output
+            or r.pooling_params is not None
             or _needs_logits_processors(r.sampling_params)
             for r in (*self.running, *self.waiting)
         ):

@@ -79,6 +79,12 @@ class InprocClient:
     def update_weights(self, path: str) -> bool:
         return self.engine_core.update_weights(path)
 
+    def start_profile(self, trace_dir: str | None = None) -> bool:
+        return self.engine_core.start_profile(trace_dir)
+
+    def stop_profile(self) -> bool:
+        return self.engine_core.stop_profile()
+
     @property
     def inflight(self) -> bool:
         return bool(self.engine_core._inflight)
@@ -225,7 +231,12 @@ class MPClient:
             if frames is None:
                 break
             if frames[0] == self._proc_mod.MSG_UTILITY_REPLY:
-                return self._serial.decode(frames[1])
+                reply = self._serial.decode(frames[1])
+                if "error" in reply:
+                    raise RuntimeError(
+                        f"engine utility {method} failed: {reply['error']}"
+                    )
+                return reply["ok"]
             self._pending.append(frames)
         raise EngineDeadError(f"utility call {method} got no reply")
 
@@ -243,6 +254,12 @@ class MPClient:
 
     def update_weights(self, path: str) -> bool:
         return self._utility("update_weights", path)
+
+    def start_profile(self, trace_dir: str | None = None) -> bool:
+        return self._utility("start_profile", trace_dir, timeout_ms=30_000)
+
+    def stop_profile(self) -> bool:
+        return self._utility("stop_profile", timeout_ms=60_000)
 
     @property
     def inflight(self) -> bool:

@@ -82,7 +82,13 @@ def run_engine_core(config_bytes: bytes, input_addr: str,
                         if len(frames) > 2
                         else []
                     )
-                    result = getattr(core, method)(*args)
+                    # A failing utility (e.g. sleep with active requests,
+                    # bad reload path) fails the CALL, not the engine.
+                    try:
+                        result = {"ok": getattr(core, method)(*args)}
+                    except Exception as e:
+                        logger.error("utility %s failed: %s", method, e)
+                        result = {"error": f"{type(e).__name__}: {e}"}
                     out.send_multipart([
                         MSG_UTILITY_REPLY, serial_utils.encode(result)
                     ])

@@ -162,6 +162,14 @@ class EngineCore:
         self.executor.collective_rpc("update_weights", path)
         return True
 
+    def start_profile(self, trace_dir: str | None = None) -> bool:
+        self.executor.collective_rpc("start_profile", trace_dir)
+        return True
+
+    def stop_profile(self) -> bool:
+        self.executor.collective_rpc("stop_profile")
+        return True
+
     def shutdown(self) -> None:
         if self.structured_output_manager is not None:
             self.structured_output_manager.shutdown()

@@ -104,14 +104,17 @@ class InputProcessor:
                 )
 
         if pooling_params is not None:
+            sc = self.config.scheduler_config
+            chunk_cap = sc.max_num_batched_tokens
+            if sc.long_prefill_token_threshold > 0:
+                chunk_cap = min(chunk_cap, sc.long_prefill_token_threshold)
             if (
                 pooling_params.pooling_type == "mean"
-                and len(prompt_token_ids)
-                > self.config.scheduler_config.max_num_batched_tokens
+                and len(prompt_token_ids) > chunk_cap
             ):
                 raise ValueError(
                     "mean pooling requires the prompt to fit one scheduler "
-                    f"chunk ({self.config.scheduler_config.max_num_batched_tokens} tokens)"
+                    f"chunk ({chunk_cap} tokens)"
                 )
             params = SamplingParams(max_tokens=1)
         params = self._finalize_params(params, len(prompt_token_ids))

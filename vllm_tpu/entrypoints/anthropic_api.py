@@ -1,0 +1,173 @@
+"""Anthropic Messages API endpoint (/v1/messages).
+
+Reference analog: ``vllm/entrypoints/anthropic/`` — the same engine serves
+an Anthropic-shaped surface: messages + system prompt through the chat
+template, token/stop accounting mapped to Anthropic stop reasons, and the
+event-stream protocol (message_start / content_block_delta / ... /
+message_stop) for streaming.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any
+
+from aiohttp import web
+
+from vllm_tpu.entrypoints.openai.protocol import ValidationError, random_id
+from vllm_tpu.sampling_params import RequestOutputKind, SamplingParams
+
+_STOP_MAP = {"stop": "end_turn", "length": "max_tokens", "abort": "end_turn"}
+
+
+def _content_text(content: Any) -> str:
+    if isinstance(content, str):
+        return content
+    if isinstance(content, list):
+        return "".join(
+            b.get("text", "") for b in content if b.get("type") == "text"
+        )
+    raise ValidationError("message content must be a string or block list")
+
+
+def parse_messages_request(d: dict, tokenizer) -> tuple[dict, SamplingParams]:
+    if tokenizer is None:
+        raise ValidationError("the Anthropic API requires a tokenizer")
+    msgs = d.get("messages")
+    if not isinstance(msgs, list) or not msgs:
+        raise ValidationError("'messages' must be a non-empty list")
+    max_tokens = d.get("max_tokens")
+    if not isinstance(max_tokens, int) or max_tokens < 1:
+        raise ValidationError("'max_tokens' must be a positive integer")
+
+    conv = []
+    if d.get("system"):
+        conv.append({"role": "system", "content": _content_text(d["system"])})
+    for m in msgs:
+        if m.get("role") not in ("user", "assistant"):
+            raise ValidationError(f"invalid role {m.get('role')!r}")
+        conv.append(
+            {"role": m["role"], "content": _content_text(m.get("content"))}
+        )
+    token_ids = tokenizer.apply_chat_template(
+        conv, add_generation_prompt=True
+    )
+    params = SamplingParams(
+        max_tokens=max_tokens,
+        temperature=float(d.get("temperature", 1.0)),
+        top_p=float(d.get("top_p", 1.0)),
+        top_k=int(d.get("top_k", 0) or 0),
+        stop=list(d.get("stop_sequences") or []),
+        output_kind=(
+            RequestOutputKind.DELTA
+            if d.get("stream")
+            else RequestOutputKind.FINAL_ONLY
+        ),
+    )
+    return {"prompt_token_ids": token_ids}, params
+
+
+def _stop_reason(out) -> str:
+    c = out.outputs[0]
+    if c.finish_reason == "stop" and isinstance(c.stop_reason, str):
+        return "stop_sequence"
+    return _STOP_MAP.get(c.finish_reason or "stop", "end_turn")
+
+
+async def handle_messages(request: web.Request) -> web.Response:
+    from vllm_tpu.entrypoints.openai.api_server import (
+        ENGINE_KEY,
+        MODEL_KEY,
+        _error,
+    )
+
+    engine = request.app[ENGINE_KEY]
+    try:
+        body = await request.json()
+    except json.JSONDecodeError:
+        return _error(400, "invalid JSON body")
+    try:
+        prompt, params = parse_messages_request(body, engine.tokenizer)
+    except (ValidationError, ValueError, TypeError) as e:
+        return _error(400, str(e))
+
+    rid = random_id("msg")
+    model_name = request.app[MODEL_KEY]
+
+    if not body.get("stream"):
+        final = None
+        async for out in engine.generate(prompt, params, rid):
+            final = out
+        assert final is not None
+        c = final.outputs[0]
+        return web.json_response({
+            "id": rid,
+            "type": "message",
+            "role": "assistant",
+            "model": model_name,
+            "content": [{"type": "text", "text": c.text}],
+            "stop_reason": _stop_reason(final),
+            "stop_sequence": (
+                c.stop_reason if isinstance(c.stop_reason, str) else None
+            ),
+            "usage": {
+                "input_tokens": len(final.prompt_token_ids),
+                "output_tokens": len(c.token_ids),
+            },
+        })
+
+    # Streaming: the Anthropic event-stream protocol.
+    resp = web.StreamResponse(
+        status=200,
+        headers={
+            "Content-Type": "text/event-stream",
+            "Cache-Control": "no-cache",
+        },
+    )
+    await resp.prepare(request)
+
+    async def send(event: str, data: dict) -> None:
+        await resp.write(
+            f"event: {event}\ndata: {json.dumps(data)}\n\n".encode()
+        )
+
+    await send("message_start", {
+        "type": "message_start",
+        "message": {
+            "id": rid, "type": "message", "role": "assistant",
+            "model": model_name, "content": [],
+            "stop_reason": None, "usage": {"input_tokens": 0,
+                                           "output_tokens": 0},
+        },
+    })
+    await send("content_block_start", {
+        "type": "content_block_start", "index": 0,
+        "content_block": {"type": "text", "text": ""},
+    })
+    n_out = 0
+    n_in = 0
+    last = None
+    async for out in engine.generate(prompt, params, rid):
+        last = out
+        n_in = len(out.prompt_token_ids)
+        c = out.outputs[0]
+        n_out += len(c.token_ids)
+        if c.text:
+            await send("content_block_delta", {
+                "type": "content_block_delta", "index": 0,
+                "delta": {"type": "text_delta", "text": c.text},
+            })
+    await send("content_block_stop", {
+        "type": "content_block_stop", "index": 0,
+    })
+    await send("message_delta", {
+        "type": "message_delta",
+        "delta": {
+            "stop_reason": _stop_reason(last) if last else "end_turn",
+            "stop_sequence": None,
+        },
+        "usage": {"input_tokens": n_in, "output_tokens": n_out},
+    })
+    await send("message_stop", {"type": "message_stop"})
+    await resp.write_eof()
+    return resp

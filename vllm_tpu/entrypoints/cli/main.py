@@ -74,12 +74,35 @@ def _run_bench(args: argparse.Namespace) -> None:
     run_bench(args)
 
 
+def _add_run_batch(sub: argparse._SubParsersAction) -> None:
+    p = sub.add_parser(
+        "run-batch", help="Run an OpenAI batch-format JSONL file offline"
+    )
+    p.add_argument("-i", "--input-file", required=True)
+    p.add_argument("-o", "--output-file", required=True)
+    EngineArgs.add_cli_args(p)
+    p.set_defaults(func=_run_run_batch)
+
+
+def _run_run_batch(args: argparse.Namespace) -> None:
+    from vllm_tpu.engine.llm_engine import LLMEngine
+    from vllm_tpu.entrypoints.run_batch import run_batch
+
+    engine_args = EngineArgs.from_cli_args(args)
+    engine = LLMEngine.from_engine_args(engine_args)
+    try:
+        run_batch(engine, args.input_file, args.output_file, engine_args.model)
+    finally:
+        engine.shutdown()
+
+
 def main(argv: list[str] | None = None) -> None:
     parser = argparse.ArgumentParser(prog="vllm-tpu")
     sub = parser.add_subparsers(required=True)
     _add_serve(sub)
     _add_complete(sub)
     _add_bench(sub)
+    _add_run_batch(sub)
     args = parser.parse_args(argv)
     args.func(args)
 

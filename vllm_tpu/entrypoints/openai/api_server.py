@@ -269,7 +269,7 @@ async def handle_embeddings(request: web.Request) -> web.Response:
             pooling_type=body.get("pooling_type", "last"),
             normalize=bool(body.get("normalize", True)),
         )
-    except ValidationError as e:
+    except (ValidationError, ValueError, TypeError) as e:
         return _error(400, str(e))
 
     async def one(prompt):
@@ -319,6 +319,18 @@ async def handle_models(request: web.Request) -> web.Response:
             "owned_by": "vllm-tpu",
         }],
     })
+
+
+async def handle_start_profile(request: web.Request) -> web.Response:
+    engine: AsyncLLM = request.app[ENGINE_KEY]
+    engine.engine_core.start_profile()
+    return web.json_response({"status": "profiling started"})
+
+
+async def handle_stop_profile(request: web.Request) -> web.Response:
+    engine: AsyncLLM = request.app[ENGINE_KEY]
+    engine.engine_core.stop_profile()
+    return web.json_response({"status": "profiling stopped"})
 
 
 async def handle_health(request: web.Request) -> web.Response:
@@ -434,6 +446,11 @@ def build_app(engine: AsyncLLM, model_name: str, metrics=None) -> web.Applicatio
         app[METRICS_KEY] = metrics
     app.router.add_post("/v1/completions", handle_completions)
     app.router.add_post("/v1/embeddings", handle_embeddings)
+    from vllm_tpu.entrypoints.anthropic_api import handle_messages
+
+    app.router.add_post("/v1/messages", handle_messages)
+    app.router.add_post("/start_profile", handle_start_profile)
+    app.router.add_post("/stop_profile", handle_stop_profile)
     app.router.add_post("/v1/chat/completions", handle_chat_completions)
     app.router.add_get("/v1/models", handle_models)
     app.router.add_get("/health", handle_health)

@@ -1,0 +1,152 @@
+"""Offline batch runner for OpenAI batch-format JSONL files.
+
+Reference analog: ``vllm/entrypoints/openai/run_batch.py`` (`vllm
+run-batch`). Input lines follow the OpenAI batch request shape::
+
+    {"custom_id": "...", "method": "POST",
+     "url": "/v1/chat/completions" | "/v1/completions" | "/v1/embeddings",
+     "body": {...}}
+
+All requests feed one engine with continuous batching; results are written
+as OpenAI batch response lines in input order.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any
+
+from vllm_tpu.engine.llm_engine import LLMEngine
+from vllm_tpu.entrypoints.openai.protocol import (
+    ChatCompletionRequest,
+    CompletionRequest,
+    ValidationError,
+    random_id,
+)
+from vllm_tpu.logger import init_logger
+from vllm_tpu.sampling_params import PoolingParams
+
+logger = init_logger(__name__)
+
+
+def _prompt_for(engine: LLMEngine, url: str, body: dict):
+    """(prompt, sampling_params, pooling_params) for one batch line."""
+    if url == "/v1/chat/completions":
+        req = ChatCompletionRequest.from_json(body)
+        tokenizer = engine.tokenizer
+        if tokenizer is None:
+            raise ValidationError("chat completions require a tokenizer")
+        token_ids = tokenizer.apply_chat_template(
+            req.messages, add_generation_prompt=req.add_generation_prompt
+        )
+        return {"prompt_token_ids": token_ids}, req.to_sampling_params(False), None
+    if url == "/v1/completions":
+        req = CompletionRequest.from_json(body)
+        prompt = req.prompt
+        if isinstance(prompt, list) and prompt and isinstance(prompt[0], int):
+            prompt = {"prompt_token_ids": prompt}
+        if not isinstance(prompt, (str, dict)):
+            raise ValidationError("batch mode supports one prompt per line")
+        return prompt, req.to_sampling_params(False), None
+    if url == "/v1/embeddings":
+        inputs = body.get("input")
+        if isinstance(inputs, list) and inputs and isinstance(inputs[0], int):
+            inputs = {"prompt_token_ids": inputs}
+        if not isinstance(inputs, (str, dict)):
+            raise ValidationError("batch embeddings take one input per line")
+        from vllm_tpu.sampling_params import SamplingParams
+
+        return inputs, SamplingParams(max_tokens=1), PoolingParams()
+    raise ValidationError(f"unsupported batch url {url!r}")
+
+
+def _response_body(url: str, model: str, out) -> dict:
+    c = out.outputs[0]
+    if url == "/v1/embeddings":
+        return {
+            "object": "list",
+            "model": model,
+            "data": [{"object": "embedding", "index": 0,
+                      "embedding": out.pooled}],
+            "usage": {"prompt_tokens": len(out.prompt_token_ids),
+                      "total_tokens": len(out.prompt_token_ids)},
+        }
+    choice: dict[str, Any] = {
+        "index": 0,
+        "finish_reason": c.finish_reason,
+    }
+    if url == "/v1/chat/completions":
+        obj = "chat.completion"
+        choice["message"] = {"role": "assistant", "content": c.text}
+    else:
+        obj = "text_completion"
+        choice["text"] = c.text
+    return {
+        "id": random_id("cmpl"),
+        "object": obj,
+        "model": model,
+        "choices": [choice],
+        "usage": {
+            "prompt_tokens": len(out.prompt_token_ids),
+            "completion_tokens": len(c.token_ids),
+            "total_tokens": len(out.prompt_token_ids) + len(c.token_ids),
+        },
+    }
+
+
+def run_batch(engine: LLMEngine, input_path: str, output_path: str,
+              model_name: str) -> dict:
+    """Returns {total, succeeded, failed}."""
+    lines = []
+    with open(input_path) as f:
+        for raw in f:
+            raw = raw.strip()
+            if raw:
+                lines.append(json.loads(raw))
+
+    records: list[dict] = []
+    pending: dict[str, int] = {}  # request id -> line index
+    for i, line in enumerate(lines):
+        custom_id = line.get("custom_id", f"line-{i}")
+        records.append({"id": random_id("batch_req"),
+                        "custom_id": custom_id, "response": None,
+                        "error": None})
+        try:
+            url = line.get("url", "/v1/completions")
+            prompt, params, pooling = _prompt_for(
+                engine, url, line.get("body") or {}
+            )
+            rid = f"batch-{i}"
+            engine.add_request(rid, prompt, params, pooling_params=pooling)
+            pending[rid] = i
+            records[i]["_url"] = url
+        except (ValidationError, ValueError, TypeError) as e:
+            records[i]["error"] = {"code": 400, "message": str(e)}
+
+    while engine.has_unfinished_requests():
+        for out in engine.step():
+            if not out.finished:
+                continue
+            i = pending.get(out.request_id)
+            if i is None:
+                continue
+            records[i]["response"] = {
+                "status_code": 200,
+                "body": _response_body(
+                    records[i].pop("_url"), model_name, out
+                ),
+            }
+
+    n_ok = 0
+    with open(output_path, "w") as f:
+        for rec in records:
+            rec.pop("_url", None)
+            if rec["response"] is not None:
+                n_ok += 1
+            f.write(json.dumps(rec) + "\n")
+    logger.info(
+        "batch complete: %d/%d succeeded -> %s",
+        n_ok, len(records), output_path,
+    )
+    return {"total": len(records), "succeeded": n_ok,
+            "failed": len(records) - n_ok}

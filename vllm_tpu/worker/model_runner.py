@@ -50,6 +50,7 @@ class StepHandle:
         self.empty = empty
         self.drafts = None  # EAGLE proposals [R, K] (device array)
         self.pooled = None  # (last [R, D], mean [R, D]) pooling outputs
+        self.nan_count = None  # device scalar when VLLM_TPU_NAN_CHECK
 
 
 def _bucket(value: int, buckets: list[int]) -> int:
@@ -145,54 +146,11 @@ class ModelRunner:
             self.draft_model = draft_model
             self.draft_params = draft_params
 
-        from vllm_tpu.ops.attention import kv_cache_shape
-
-        kv_shape = kv_cache_shape(
-            model.num_layers,
-            num_kv_blocks,
-            cache.block_size,
-            model.num_kv_heads,
-            model.head_dim,
-        )
-        kv_dtype = (
-            model.dtype
-            if cache.cache_dtype == "auto"
-            else jnp.dtype(cache.jax_cache_dtype)
-        )
-        kv_sharding = None
-        if mesh is not None:
-            from jax.sharding import NamedSharding
-
-            kv_sharding = NamedSharding(mesh, model.kv_cache_sharding())
-        self.kv_cache = (
-            jnp.zeros(kv_shape, kv_dtype)
-            if kv_sharding is None
-            else jax.device_put(jnp.zeros(kv_shape, kv_dtype), kv_sharding)
-        )
-        logger.info(
-            "KV cache allocated: %s %s (%.2f GiB)",
-            kv_shape,
-            kv_dtype,
-            np.prod(kv_shape) * jnp.dtype(kv_dtype).itemsize / 2**30,
-        )
+        self.num_kv_blocks = num_kv_blocks
+        self.kv_cache = self._alloc_kv_cache()
 
         if self.draft_model is not None:
-            dkv_shape = self.draft_model.kv_shape(
-                num_kv_blocks, cache.block_size
-            )
-            self.draft_kv = jnp.zeros(dkv_shape, kv_dtype)
-            if mesh is not None:
-                from jax.sharding import NamedSharding
-
-                self.draft_kv = jax.device_put(
-                    self.draft_kv,
-                    NamedSharding(mesh, self.draft_model.kv_cache_sharding()),
-                )
-            logger.info(
-                "EAGLE draft KV cache allocated: %s (%.2f GiB)",
-                dkv_shape,
-                np.prod(dkv_shape) * jnp.dtype(kv_dtype).itemsize / 2**30,
-            )
+            self.draft_kv = self._alloc_draft_kv()
 
         # kv_cache (arg 1) and the draft KV (arg 2, when present) are
         # donated back as outputs (in-place reuse).
@@ -220,6 +178,7 @@ class ModelRunner:
         from vllm_tpu import envs
 
         self._timing_enabled = envs.VLLM_TPU_STEP_TIMING
+        self._nan_check = envs.VLLM_TPU_NAN_CHECK
         self.timing = {"prep_s": 0.0, "dispatch_s": 0.0, "wait_s": 0.0,
                        "steps": 0}
 
@@ -367,6 +326,9 @@ class ModelRunner:
             logits3 = self.model.compute_logits(
                 params, hidden[flat_pos]
             ).reshape(r, s1, -1)
+            spec_nan = (
+                jnp.isnan(logits3).sum() if self._nan_check else None
+            )
             out_tokens, num_out = rejection_sample(
                 logits3,
                 spec["draft_ids"],
@@ -386,8 +348,10 @@ class ModelRunner:
                     params, draft_kv, token_ids, hidden, md, anchor,
                     emitted, draft_next, r_pad,
                 )
-            return kv_cache, draft_kv, (out_tokens, num_out), None, drafts, None
+            return (kv_cache, draft_kv, (out_tokens, num_out), None, drafts,
+                    None, spec_nan)
         last = hidden[md.logits_indices]  # [R, D]
+        nan_count = None
         pooled = None
         if needs_pooling:
             # "last" pooling = the gathered last-token hidden; "mean" is a
@@ -407,6 +371,8 @@ class ModelRunner:
             mean = sums / counts_seg[:, None]
             pooled = (last.astype(jnp.float32), mean)
         logits = self.model.compute_logits(params, last)  # [R, V] f32
+        if self._nan_check:
+            nan_count = jnp.isnan(logits).sum()
         if needs_grammar:
             # Gather each row's packed grammar bitmask from the
             # device-resident table and unpack bits (bit v%32 of word v//32
@@ -461,7 +427,7 @@ class ModelRunner:
             lp = (topk_vals, topk_ids, sampled_lp, sampled_rank)
         else:
             lp = None
-        return kv_cache, draft_kv, sampled, lp, drafts, pooled
+        return kv_cache, draft_kv, sampled, lp, drafts, pooled, nan_count
 
     def _eagle_drafts(self, params, draft_kv, token_ids, hidden, md,
                       anchor, emitted, draft_next, r_pad):
@@ -913,8 +879,8 @@ class ModelRunner:
             t1 = time.perf_counter()
             self.timing["prep_s"] += t1 - t0
         prev = self._last_sampled if self._last_sampled is not None else self._zero_sampled
-        (self.kv_cache, self.draft_kv, sampled, lp, drafts,
-         pooled) = self._step_fn(
+        (self.kv_cache, self.draft_kv, sampled, lp, drafts, pooled,
+         nan_count) = self._step_fn(
             self.params, self.kv_cache, self.draft_kv, *arrays, prev,
             mask_table, **flags,
         )
@@ -949,6 +915,7 @@ class ModelRunner:
         )
         handle.drafts = drafts
         handle.pooled = pooled
+        handle.nan_count = nan_count
         return handle
 
     def finalize(self, handle: "StepHandle") -> ModelRunnerOutput:
@@ -978,6 +945,13 @@ class ModelRunner:
         )
         if self._timing_enabled:
             self.timing["wait_s"] += time.perf_counter() - t0
+        if handle.nan_count is not None:
+            n_nan = int(jax.device_get(handle.nan_count))
+            if n_nan:
+                logger.error(
+                    "NaNs detected in step logits: %d values (reference "
+                    "analog: _get_nans_in_logits)", n_nan,
+                )
 
         out = ModelRunnerOutput(req_ids=req_order)
         # Logprobs aren't emitted on draft-carrying steps (the scheduler's
@@ -1081,6 +1055,66 @@ class ModelRunner:
         self._last_sampled = None
         logger.info("runner asleep (level %d)", level)
 
+    def _kv_dtype(self):
+        cache = self.config.cache_config
+        return (
+            self.model.dtype
+            if cache.cache_dtype == "auto"
+            else jnp.dtype(cache.jax_cache_dtype)
+        )
+
+    def _alloc_kv_cache(self):
+        """The ONE place KV geometry/dtype/sharding is decided (used at
+        init and after wake)."""
+        from vllm_tpu.ops.attention import kv_cache_shape
+
+        cache = self.config.cache_config
+        kv_shape = kv_cache_shape(
+            self.model.num_layers,
+            self.num_kv_blocks,
+            cache.block_size,
+            self.model.num_kv_heads,
+            self.model.head_dim,
+        )
+        kv_dtype = self._kv_dtype()
+        kv = jnp.zeros(kv_shape, kv_dtype)
+        if self.mesh is not None:
+            from jax.sharding import NamedSharding
+
+            kv = jax.device_put(
+                kv, NamedSharding(self.mesh, self.model.kv_cache_sharding())
+            )
+        logger.info(
+            "KV cache allocated: %s %s (%.2f GiB)",
+            kv_shape,
+            kv_dtype,
+            np.prod(kv_shape) * jnp.dtype(kv_dtype).itemsize / 2**30,
+        )
+        return kv
+
+    def _alloc_draft_kv(self):
+        cache = self.config.cache_config
+        dkv_shape = self.draft_model.kv_shape(
+            self.num_kv_blocks, cache.block_size
+        )
+        dkv = jnp.zeros(dkv_shape, self._kv_dtype())
+        if self.mesh is not None:
+            from jax.sharding import NamedSharding
+
+            dkv = jax.device_put(
+                dkv,
+                NamedSharding(
+                    self.mesh, self.draft_model.kv_cache_sharding()
+                ),
+            )
+        logger.info(
+            "EAGLE draft KV cache allocated: %s (%.2f GiB)",
+            dkv_shape,
+            np.prod(dkv_shape) * jnp.dtype(self._kv_dtype()).itemsize
+            / 2**30,
+        )
+        return dkv
+
     def wake_up(self, params=None, draft_params=None) -> None:
         """Restore device state. ``params`` (device-ready, e.g. freshly
         loaded) overrides the host copy — required after a level-2 sleep."""
@@ -1094,26 +1128,7 @@ class ModelRunner:
             )
             self.params = self._put_params(self._host_params)
         self._host_params = None
-        cache = self.config.cache_config
-        from vllm_tpu.ops.attention import kv_cache_shape
-
-        kv_shape = kv_cache_shape(
-            self.model.num_layers, cache.num_gpu_blocks, cache.block_size,
-            self.model.num_kv_heads, self.model.head_dim,
-        )
-        kv_dtype = (
-            self.model.dtype
-            if cache.cache_dtype == "auto"
-            else jnp.dtype(cache.jax_cache_dtype)
-        )
-        self.kv_cache = jnp.zeros(kv_shape, kv_dtype)
-        if self.mesh is not None:
-            from jax.sharding import NamedSharding
-
-            self.kv_cache = jax.device_put(
-                self.kv_cache,
-                NamedSharding(self.mesh, self.model.kv_cache_sharding()),
-            )
+        self.kv_cache = self._alloc_kv_cache()
         if self.draft_model is not None:
             if draft_params is not None:
                 self.draft_params = draft_params
@@ -1134,12 +1149,7 @@ class ModelRunner:
                         self._host_draft, dsh,
                     )
             self._host_draft = None
-            self.draft_kv = jnp.zeros(
-                self.draft_model.kv_shape(
-                    cache.num_gpu_blocks, cache.block_size
-                ),
-                kv_dtype,
-            )
+            self.draft_kv = self._alloc_draft_kv()
         logger.info("runner awake")
 
     def _put_params(self, host_tree):

@@ -313,3 +313,22 @@ class Worker:
     def update_weights(self, path: str) -> None:
         assert self.runner is not None
         self.runner.update_weights(path)
+
+    def start_profile(self, trace_dir: str | None = None) -> None:
+        """JAX profiler (xplane/TensorBoard) start — reference:
+        ``gpu_worker.py profile :866`` torch-profiler RPC."""
+        import jax
+
+        from vllm_tpu import envs
+
+        trace_dir = (
+            trace_dir or envs.VLLM_TPU_PROFILER_DIR or "/tmp/vllm-tpu-trace"
+        )
+        jax.profiler.start_trace(trace_dir)
+        logger.info("profiler started -> %s", trace_dir)
+
+    def stop_profile(self) -> None:
+        import jax
+
+        jax.profiler.stop_trace()
+        logger.info("profiler stopped")
