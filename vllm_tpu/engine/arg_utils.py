@@ -65,6 +65,7 @@ class EngineArgs:
 
     enable_lora: bool = False
     max_lora_rank: int = 16
+    max_loras: int = 4
 
     disable_log_stats: bool = False
     precompile: bool = False
@@ -117,7 +118,9 @@ class EngineArgs:
                 model=self.speculative_model,
             ),
             lora_config=LoRAConfig(
-                enable_lora=self.enable_lora, max_lora_rank=self.max_lora_rank
+                enable_lora=self.enable_lora,
+                max_lora_rank=self.max_lora_rank,
+                max_loras=self.max_loras,
             ),
             observability_config=ObservabilityConfig(
                 log_stats=not self.disable_log_stats

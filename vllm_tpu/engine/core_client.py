@@ -79,6 +79,15 @@ class InprocClient:
     def update_weights(self, path: str) -> bool:
         return self.engine_core.update_weights(path)
 
+    def add_lora(self, name: str, path: str) -> bool:
+        return self.engine_core.add_lora(name, path)
+
+    def remove_lora(self, name: str) -> bool:
+        return self.engine_core.remove_lora(name)
+
+    def list_loras(self) -> list[str]:
+        return self.engine_core.list_loras()
+
     def start_profile(self, trace_dir: str | None = None) -> bool:
         return self.engine_core.start_profile(trace_dir)
 
@@ -254,6 +263,15 @@ class MPClient:
 
     def update_weights(self, path: str) -> bool:
         return self._utility("update_weights", path)
+
+    def add_lora(self, name: str, path: str) -> bool:
+        return self._utility("add_lora", name, path)
+
+    def remove_lora(self, name: str) -> bool:
+        return self._utility("remove_lora", name, timeout_ms=30_000)
+
+    def list_loras(self) -> list[str]:
+        return self._utility("list_loras", timeout_ms=30_000)
 
     def start_profile(self, trace_dir: str | None = None) -> bool:
         return self._utility("start_profile", trace_dir, timeout_ms=30_000)

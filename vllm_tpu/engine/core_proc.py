@@ -72,7 +72,29 @@ def run_engine_core(config_bytes: bytes, input_addr: str,
                 frames = inp.recv_multipart()
                 kind = frames[0]
                 if kind == MSG_ADD:
-                    core.add_request(serial_utils.decode(frames[1]))
+                    req = serial_utils.decode(frames[1])
+                    try:
+                        core.add_request(req)
+                    except Exception as e:
+                        # Reject THIS request; the engine keeps serving.
+                        logger.error(
+                            "add_request %s failed: %s", req.request_id, e
+                        )
+                        from vllm_tpu.core.sched_output import (
+                            EngineCoreOutput,
+                            EngineCoreOutputs,
+                        )
+
+                        out.send_multipart([
+                            MSG_OUTPUTS,
+                            serial_utils.encode(EngineCoreOutputs(
+                                outputs=[EngineCoreOutput(
+                                    req_id=req.request_id,
+                                    new_token_ids=[],
+                                    finish_reason="abort",
+                                )],
+                            )),
+                        ])
                 elif kind == MSG_ABORT:
                     core.abort_requests(serial_utils.decode(frames[1]))
                 elif kind == MSG_UTILITY:

@@ -68,6 +68,7 @@ class EngineCore:
             if config.cache_config.enable_prefix_caching
             else None
         )
+        self._lora_names: set[str] = set()
 
     def _make_structured_output_manager(self):
         from vllm_tpu.engine.input_processor import get_tokenizer
@@ -86,6 +87,13 @@ class EngineCore:
     # ------------------------------------------------------------------
 
     def add_request(self, request: EngineCoreRequest) -> None:
+        if request.lora_name is not None and (
+            request.lora_name not in self._lora_names
+        ):
+            raise ValueError(
+                f"unknown LoRA adapter {request.lora_name!r}; "
+                f"loaded: {sorted(self._lora_names)}"
+            )
         req = Request.from_engine_core_request(request, self._block_hasher)
         self.scheduler.add_request(req)
 
@@ -161,6 +169,20 @@ class EngineCore:
             self.step()
         self.executor.collective_rpc("update_weights", path)
         return True
+
+    def add_lora(self, name: str, path: str) -> bool:
+        ok = self.executor.collective_rpc("add_lora", name, path)[0]
+        if ok:
+            self._lora_names.add(name)
+        return ok
+
+    def remove_lora(self, name: str) -> bool:
+        ok = self.executor.collective_rpc("remove_lora", name)[0]
+        self._lora_names.discard(name)
+        return ok
+
+    def list_loras(self) -> list[str]:
+        return self.executor.collective_rpc("list_loras")[0]
 
     def start_profile(self, trace_dir: str | None = None) -> bool:
         self.executor.collective_rpc("start_profile", trace_dir)

@@ -44,12 +44,14 @@ class LLMEngine:
         params: SamplingParams | None = None,
         priority: int = 0,
         pooling_params=None,
+        lora_name: str | None = None,
     ) -> None:
         params = params if params is not None else SamplingParams()
         core_req = self.input_processor.process(
             request_id, prompt, params, priority=priority,
             pooling_params=pooling_params,
         )
+        core_req.lora_name = lora_name
         self.output_processor.add_request(
             request_id,
             getattr(core_req, "prompt_text", None),

@@ -73,11 +73,15 @@ class LLM:
 
     # ------------------------------------------------------------------
 
+    def add_lora(self, name: str, path: str) -> bool:
+        return self.llm_engine.engine_core.add_lora(name, path)
+
     def generate(
         self,
         prompts: Union[PromptType, Sequence[PromptType]],
         sampling_params: Union[SamplingParams, Sequence[SamplingParams], None] = None,
         use_tqdm: bool = False,
+        lora_name: str | None = None,
     ) -> list[RequestOutput]:
         if isinstance(prompts, (str, dict)):
             prompts = [prompts]
@@ -96,7 +100,9 @@ class LLM:
             rid = str(self._request_counter)
             self._request_counter += 1
             request_ids.append(rid)
-            self.llm_engine.add_request(rid, prompt, params)
+            self.llm_engine.add_request(
+                rid, prompt, params, lora_name=lora_name
+            )
         return self._run_engine(request_ids, use_tqdm)
 
     def chat(

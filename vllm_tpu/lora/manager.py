@@ -1,0 +1,195 @@
+"""LoRA adapter manager: PEFT checkpoint loading into batched slots.
+
+Reference analog: ``vllm/lora/model_manager.py`` (LoRAModelManager) +
+``worker_manager.py``. Adapter weights live INSIDE the model's param tree
+as extra layer-stacked leaves (``lora_a_wq`` [L, S, in, r], ...), so the
+``lax.scan`` layer loop and the persistent jit see one stable pytree;
+adding an adapter is a slot-indexed device update, never a recompile.
+Slot 0 is the reserved null adapter (zeros).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any
+
+import jax.numpy as jnp
+import numpy as np
+
+from vllm_tpu.logger import init_logger
+
+logger = init_logger(__name__)
+
+# projection key -> HF module name in PEFT checkpoints
+_MODULE_MAP = {
+    "wq": "q_proj",
+    "wk": "k_proj",
+    "wv": "v_proj",
+    "wo": "o_proj",
+    "wgate": "gate_proj",
+    "wup": "up_proj",
+    "wdown": "down_proj",
+}
+
+
+def _weight_dims(leaf) -> tuple[int, int]:
+    """(in, out) dims of a (possibly quantized) [L, in, out] weight."""
+    from vllm_tpu.layers.quant import QuantizedLinear
+
+    arr = leaf.q if isinstance(leaf, QuantizedLinear) else leaf
+    return arr.shape[-2], arr.shape[-1]
+
+
+class LoRAManager:
+    def __init__(self, model: Any, params: dict, max_loras: int,
+                 max_rank: int) -> None:
+        self.model = model
+        self.params = params
+        self.max_rank = max_rank
+        self.num_slots = max_loras + 1  # slot 0 = null adapter
+        self._slots: dict[str, int] = {}
+
+        L = model.num_layers
+        layers = params["layers"]
+        for key in model.QUANT_KEYS:
+            d_in, d_out = _weight_dims(layers[key])
+            layers[f"lora_a_{key}"] = jnp.zeros(
+                (L, self.num_slots, d_in, max_rank), model.dtype
+            )
+            layers[f"lora_b_{key}"] = jnp.zeros(
+                (L, self.num_slots, max_rank, d_out), model.dtype
+            )
+        params["lora_scaling"] = jnp.zeros((self.num_slots,), jnp.float32)
+
+    # ------------------------------------------------------------------
+
+    def slot_of(self, lora_name: str | None) -> int:
+        if lora_name is None:
+            return 0
+        slot = self._slots.get(lora_name)
+        if slot is None:
+            raise ValueError(f"unknown LoRA adapter {lora_name!r}")
+        return slot
+
+    def list_loras(self) -> list[str]:
+        return sorted(self._slots)
+
+    def remove_lora(self, name: str) -> bool:
+        slot = self._slots.pop(name, None)
+        if slot is None:
+            return False
+        # Zero the slot so a future occupant that targets fewer modules
+        # cannot inherit stale deltas.
+        layers = self.params["layers"]
+        for key in self.model.QUANT_KEYS:
+            for prefix in ("lora_a_", "lora_b_"):
+                k = f"{prefix}{key}"
+                layers[k] = layers[k].at[:, slot].set(0.0)
+        self.params["lora_scaling"] = (
+            self.params["lora_scaling"].at[slot].set(0.0)
+        )
+        return True
+
+    def add_lora(self, name: str, path: str) -> bool:
+        """Load a PEFT adapter directory into a free slot."""
+        if name in self._slots:
+            return False
+        used = set(self._slots.values())
+        free = [s for s in range(1, self.num_slots) if s not in used]
+        if not free:
+            raise RuntimeError(
+                f"no free LoRA slots ({self.num_slots - 1} max)"
+            )
+        slot = free[0]
+
+        cfg_path = os.path.join(path, "adapter_config.json")
+        alpha, rank = self.max_rank, self.max_rank
+        if os.path.exists(cfg_path):
+            with open(cfg_path) as f:
+                cfg = json.load(f)
+            alpha = cfg.get("lora_alpha", alpha)
+            rank = cfg.get("r", rank)
+        if rank > self.max_rank:
+            raise ValueError(
+                f"adapter rank {rank} > max_lora_rank {self.max_rank}"
+            )
+
+        tensors = self._read_adapter(path)
+        L = self.model.num_layers
+        layers = self.params["layers"]
+        n_matched = 0
+        for key, module in _MODULE_MAP.items():
+            a_key, b_key = f"lora_a_{key}", f"lora_b_{key}"
+            if a_key not in layers:
+                continue
+            a_stack = np.zeros(
+                (L, layers[a_key].shape[-2], self.max_rank), np.float32
+            )
+            b_stack = np.zeros(
+                (L, self.max_rank, layers[b_key].shape[-1]), np.float32
+            )
+            found = False
+            for i in range(L):
+                a = tensors.get(f"layers.{i}.{module}.lora_A")
+                b = tensors.get(f"layers.{i}.{module}.lora_B")
+                if a is None or b is None:
+                    continue
+                found = True
+                # PEFT stores lora_A [r, in], lora_B [out, r].
+                a_stack[i, :, : a.shape[0]] = a.T
+                b_stack[i, : b.shape[1], :] = b.T
+            if found:
+                n_matched += 1
+                layers[a_key] = layers[a_key].at[:, slot].set(
+                    jnp.asarray(a_stack, layers[a_key].dtype)
+                )
+                layers[b_key] = layers[b_key].at[:, slot].set(
+                    jnp.asarray(b_stack, layers[b_key].dtype)
+                )
+        if n_matched == 0:
+            raise ValueError(
+                f"adapter at {path} matched no supported modules "
+                f"({sorted(_MODULE_MAP.values())}); check target_modules"
+            )
+        self.params["lora_scaling"] = (
+            self.params["lora_scaling"].at[slot].set(alpha / rank)
+        )
+        self._slots[name] = slot
+        logger.info(
+            "LoRA %r loaded into slot %d (rank %d, alpha %s)",
+            name, slot, rank, alpha,
+        )
+        return True
+
+    @staticmethod
+    def _read_adapter(path: str) -> dict[str, np.ndarray]:
+        """{ 'layers.{i}.{module}.lora_A'|'...lora_B' -> array }."""
+        from safetensors import safe_open
+
+        file = os.path.join(path, "adapter_model.safetensors")
+        if not os.path.exists(file):
+            raise FileNotFoundError(f"no adapter_model.safetensors in {path}")
+        out: dict[str, np.ndarray] = {}
+        with safe_open(file, framework="numpy") as f:
+            for name in f.keys():
+                # e.g. base_model.model.model.layers.0.self_attn.q_proj
+                #        .lora_A.weight
+                if ".lora_A." not in name and ".lora_B." not in name:
+                    continue
+                marker = ".layers."
+                idx = name.find(marker)
+                if idx < 0:
+                    continue
+                rest = name[idx + len(marker):]  # "0.self_attn.q_proj..."
+                parts = rest.split(".")
+                layer_i = parts[0]
+                module = parts[-3]  # q_proj etc.
+                kind = "lora_A" if ".lora_A." in name else "lora_B"
+                arr = f.get_tensor(name)
+                if arr.dtype == np.uint16:
+                    arr = arr.view(jnp.bfloat16)
+                out[f"layers.{layer_i}.{module}.{kind}"] = np.asarray(
+                    arr, np.float32
+                )
+        return out

@@ -47,6 +47,7 @@ _NORM_KEYS = (
 
 
 class Gemma2ForCausalLM(LlamaForCausalLM):
+    supports_lora = False  # custom apply() does not take adapter deltas yet
     attn_soft_cap: float | None = None
     final_soft_cap: float | None = None
 
@@ -138,6 +139,7 @@ class Gemma2ForCausalLM(LlamaForCausalLM):
         kv_cache: jnp.ndarray,
         input_ids: jnp.ndarray,
         md: AttentionMetadata,
+        token_lora_slot: jnp.ndarray | None = None,  # unused (no LoRA yet)
     ) -> tuple[jnp.ndarray, jnp.ndarray]:
         x = params["embed"][input_ids].astype(self.dtype)
         x = x * jnp.asarray(

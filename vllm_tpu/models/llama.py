@@ -34,6 +34,7 @@ from vllm_tpu.core.kv_cache_utils import FullAttentionSpec, KVCacheSpec
 from vllm_tpu.layers.activation import silu_and_mul
 from vllm_tpu.layers.layernorm import rms_norm
 from vllm_tpu.layers.quant import QuantizedLinear, qmm, quantize_jnp
+from vllm_tpu.lora.layers import lora_delta
 from vllm_tpu.layers.rotary import RotaryEmbedding, _apply_rotate_half
 from vllm_tpu.logger import init_logger
 from vllm_tpu.ops.attention import (
@@ -51,6 +52,10 @@ class LlamaForCausalLM:
     attention_bias = False
     # Per-head RMSNorm on q/k after projection (Qwen3, Gemma-3).
     qk_norm = False
+    # Set by the worker when LoRA serving is enabled; the runner then adds
+    # stacked adapter leaves to the param tree and ships per-token slots.
+    enable_lora = False
+    supports_lora = True
     # Weight-only quantized matmuls (per-output-channel int8/fp8); norms,
     # embeddings, and lm_head stay in the model dtype.
     QUANT_KEYS = ("wq", "wk", "wv", "wo", "wgate", "wup", "wdown")
@@ -182,6 +187,7 @@ class LlamaForCausalLM:
         kv_cache: jnp.ndarray,  # [L, NB, BS, 2*KH, Dh]
         input_ids: jnp.ndarray,  # [T]
         md: AttentionMetadata,
+        token_lora_slot: jnp.ndarray | None = None,  # [T] i32 (LoRA)
     ) -> tuple[jnp.ndarray, jnp.ndarray]:
         x = params["embed"][input_ids].astype(self.dtype)  # [T, D]
         t = x.shape[0]
@@ -189,15 +195,26 @@ class LlamaForCausalLM:
 
         rope_cos, rope_sin = self.rope.cos, self.rope.sin
         bias = self.attention_bias
+        use_lora = self.enable_lora and token_lora_slot is not None
+        lora_scale = params.get("lora_scaling")
+
+        def proj(h, lp, key):
+            out = qmm(h, lp[key])
+            if use_lora:
+                out = out + lora_delta(
+                    h, lp[f"lora_a_{key}"], lp[f"lora_b_{key}"],
+                    token_lora_slot, lora_scale,
+                )
+            return out
 
         def layer_fn(carry, inputs):
             x, kv = carry
             lp, li = inputs
             h = rms_norm(x, lp["input_norm"], self.rms_eps)
 
-            q = qmm(h, lp["wq"])
-            k = qmm(h, lp["wk"])
-            v = qmm(h, lp["wv"])
+            q = proj(h, lp, "wq")
+            k = proj(h, lp, "wk")
+            v = proj(h, lp, "wv")
             if bias:
                 q = q + lp["bq"]
                 k = k + lp["bk"]
@@ -220,12 +237,15 @@ class LlamaForCausalLM:
                 q, kv, li, md, self.scale, sliding_window=self.sliding_window,
                 k_scale=kv_scale, v_scale=kv_scale,
             )
-            x = x + qmm(attn.reshape(t, H * Dh), lp["wo"])
+            x = x + proj(attn.reshape(t, H * Dh), lp, "wo")
 
             h2 = rms_norm(x, lp["post_norm"], self.rms_eps)
-            gate = qmm(h2, lp["wgate"])
-            up = qmm(h2, lp["wup"])
-            x = x + qmm(silu_and_mul(jnp.concatenate([gate, up], axis=-1)), lp["wdown"])
+            gate = proj(h2, lp, "wgate")
+            up = proj(h2, lp, "wup")
+            x = x + proj(
+                silu_and_mul(jnp.concatenate([gate, up], axis=-1)),
+                lp, "wdown",
+            )
             return (x, kv), None
 
         # Scan over the layer stack with the WHOLE cache in the carry: the

@@ -28,6 +28,7 @@ from vllm_tpu.ops.attention import (
 
 
 class MixtralForCausalLM(LlamaForCausalLM):
+    supports_lora = False  # MoE expert adapters are future work
     def __init__(self, hf_config: Any, dtype=jnp.bfloat16,
                  quantization: str | None = None) -> None:
         if quantization:
@@ -98,6 +99,7 @@ class MixtralForCausalLM(LlamaForCausalLM):
         kv_cache: jnp.ndarray,
         input_ids: jnp.ndarray,
         md: AttentionMetadata,
+        token_lora_slot: jnp.ndarray | None = None,  # unused (no LoRA yet)
     ) -> tuple[jnp.ndarray, jnp.ndarray]:
         x = params["embed"][input_ids].astype(self.dtype)
         t = x.shape[0]

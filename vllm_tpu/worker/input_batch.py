@@ -89,6 +89,7 @@ class InputBatch:
         self.repetition_penalty = np.ones(n, dtype=np.float32)
         self.seeds = np.zeros(n, dtype=np.uint32)
         self.num_logprobs = np.zeros(n, dtype=np.int32)  # 0 => off
+        self.lora_slot = np.zeros(n, dtype=np.int32)  # 0 => no adapter
 
     # ------------------------------------------------------------------
 
@@ -157,6 +158,7 @@ class InputBatch:
                 self.repetition_penalty,
                 self.seeds,
                 self.num_logprobs,
+                self.lora_slot,
             ):
                 vec[row] = vec[last]
             self.req_ids[row] = moved_id

@@ -146,6 +146,20 @@ class ModelRunner:
             self.draft_model = draft_model
             self.draft_params = draft_params
 
+        self.lora_manager = None
+        if config.lora_config.enable_lora:
+            from vllm_tpu.lora.manager import LoRAManager
+
+            if not getattr(model, "supports_lora", False):
+                raise ValueError(
+                    f"{type(model).__name__} does not support LoRA serving"
+                )
+            model.enable_lora = True
+            self.lora_manager = LoRAManager(
+                model, self.params, config.lora_config.max_loras,
+                config.lora_config.max_lora_rank,
+            )
+
         self.num_kv_blocks = num_kv_blocks
         self.kv_cache = self._alloc_kv_cache()
 
@@ -237,6 +251,8 @@ class ModelRunner:
         # EAGLE: per-row next KNOWN token for the draft's shifted input at
         # the anchor position (-1 = use the freshly emitted token).
         draft_next = take(r) if self.draft_model is not None else None
+        # LoRA: adapter slot per token (0 = none).
+        token_lora = take(t) if self.lora_manager is not None else None
         spec = None
         if s > 0:
             spec = dict(
@@ -263,7 +279,7 @@ class ModelRunner:
         )
         logit_adjust = (adj_ids, adj_vals, allow_ids, allow_active)
         return (token_ids, md, sampling, feedback, grammar_rows, logit_adjust,
-                draft_next, spec)
+                draft_next, token_lora, spec)
 
     def _step(
         self,
@@ -292,7 +308,7 @@ class ModelRunner:
         num_allow: int = 0,
     ):
         (token_ids, md, sampling, feedback, grammar_rows, logit_adjust,
-         draft_next, spec) = self._unpack(
+         draft_next, token_lora, spec) = self._unpack(
             ibuf, fbuf, counts, prompt_mask, t_pad, r_pad, b_pad, num_spec,
             num_adj, num_allow,
         )
@@ -315,7 +331,9 @@ class ModelRunner:
                 jnp.arange(r_pad), prev_tok
             ].add(needs_fb.astype(jnp.int32))
             sampling = _replace(sampling, output_token_counts=counts2)
-        hidden, kv_cache = self.model.apply(params, kv_cache, token_ids, md)
+        hidden, kv_cache = self.model.apply(
+            params, kv_cache, token_ids, md, token_lora_slot=token_lora
+        )
         if num_spec > 0:
             # Spec-decode verification: logits at every draft position plus
             # the bonus position, rejection-sampled in one traced pass.
@@ -514,7 +532,11 @@ class ModelRunner:
                     req_id, cached.num_computed_tokens[i]
                 )
         for new in so.scheduled_new_reqs:
-            self.input_batch.add_request(new)
+            row = self.input_batch.add_request(new)
+            if self.lora_manager is not None:
+                self.input_batch.lora_slot[row] = self.lora_manager.slot_of(
+                    new.lora_name
+                )
 
     def _prepare_inputs(self, so: SchedulerOutput):
         batch = self.input_batch
@@ -569,13 +591,14 @@ class ModelRunner:
             num_allow = _bucket(min(widest, cap), self._adj_buckets)
         lp_len = r * num_adj + (r * num_allow + r if num_allow else 0)
         eagle_len = r if self.draft_model is not None else 0
+        lora_len = t if self.lora_manager is not None else 0
         # seq_lens(r) + qsl(r+1) + logits_idx(r) + num_seqs(1) + bt(r*b)
         # + top_k(r) + prng(2r) + feedback(r) + grammar_rows(r)
         # [+ adj_ids(r*num_adj)] [+ allow_ids(r*num_allow) + allow_flag(r)]
         # [+ num_draft(r) + draft(r*s) + sample_pos(r*(s+1))]
         ibuf = np.zeros(
             4 * t + 7 * r + (r + 1) + 1 + r * b + lp_len + eagle_len
-            + spec_len,
+            + lora_len + spec_len,
             np.int32,
         )
         token_ids = ibuf[0:t]
@@ -613,6 +636,8 @@ class ModelRunner:
         if self.draft_model is not None:
             draft_next = ibuf[o : o + r]; o += r
             draft_next[:] = -1
+        if self.lora_manager is not None:
+            token_lora = ibuf[o : o + t]; o += t
         if s:
             num_draft = ibuf[o : o + r]; o += r
             draft_ids = ibuf[o : o + r * s].reshape(r, s); o += r * s
@@ -673,6 +698,8 @@ class ModelRunner:
             bt_row = batch.block_table[row]
             slot_mapping[offset : offset + n] = bt_row[pos // bs] * bs + pos % bs
             token_req_idx[offset : offset + n] = i
+            if self.lora_manager is not None:
+                token_lora[offset : offset + n] = batch.lora_slot[row]
             seq_lens[i] = start + n
             query_start_loc[i + 1] = offset + n
             logits_indices[i] = offset + n - 1
@@ -1179,11 +1206,25 @@ class ModelRunner:
                 self.mesh, self.model.param_shardings()
             )
         old = self.params
-        self.params = self.model.load_params(
-            path, self.model.dtype, shardings
+        new = self.model.load_params(path, self.model.dtype, shardings)
+        if self.lora_manager is not None:
+            # Adapter slots are runtime state, not checkpoint state: carry
+            # them (and the scaling vector) into the new tree.
+            for key, leaf in old["layers"].items():
+                if key.startswith("lora_"):
+                    new["layers"][key] = leaf
+            new["lora_scaling"] = old["lora_scaling"]
+        self.params = new
+        kept = (
+            {id(leaf) for leaf in jax.tree_util.tree_leaves(new)}
+            if self.lora_manager is not None
+            else set()
         )
         for leaf in jax.tree_util.tree_leaves(old):
-            leaf.delete()
+            if id(leaf) not in kept:
+                leaf.delete()
+        if self.lora_manager is not None:
+            self.lora_manager.params = new
         logger.info("weights updated from %s", path)
 
     # ------------------------------------------------------------------

@@ -314,6 +314,20 @@ class Worker:
         assert self.runner is not None
         self.runner.update_weights(path)
 
+    def add_lora(self, name: str, path: str) -> bool:
+        assert self.runner is not None and self.runner.lora_manager is not None, (
+            "LoRA serving requires enable_lora=True"
+        )
+        return self.runner.lora_manager.add_lora(name, path)
+
+    def remove_lora(self, name: str) -> bool:
+        assert self.runner is not None and self.runner.lora_manager is not None
+        return self.runner.lora_manager.remove_lora(name)
+
+    def list_loras(self) -> list[str]:
+        assert self.runner is not None and self.runner.lora_manager is not None
+        return self.runner.lora_manager.list_loras()
+
     def start_profile(self, trace_dir: str | None = None) -> None:
         """JAX profiler (xplane/TensorBoard) start — reference:
         ``gpu_worker.py profile :866`` torch-profiler RPC."""
