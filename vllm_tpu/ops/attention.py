@@ -159,9 +159,17 @@ def ref_ragged_paged_attention(
     soft_cap: float | None = None,
     k_scale: float | None = None,
     v_scale: float | None = None,
+    return_lse: bool = False,
+    ctx_stride: int = 1,
+    ctx_phase: int = 0,
 ) -> jnp.ndarray:
     """Gather-based masked attention. Each token attends to its request's
-    cached context up to and including its own position (causal)."""
+    cached context up to and including its own position (causal).
+
+    ``ctx_stride``/``ctx_phase`` describe striped context-parallel shards:
+    local page j holds global page ``j * stride + phase`` (stride 1 = the
+    whole context). ``return_lse=True`` additionally returns the
+    per-(token, head) logsumexp — the ``merge_attn_states`` contract."""
     t, h, d = q.shape
     nl, nb, bs, rows, lanes = kv_cache.shape
     packed = packed_kv_layout(d)
@@ -193,7 +201,10 @@ def ref_ragged_paged_attention(
     if soft_cap is not None:
         scores = soft_cap * jnp.tanh(scores / soft_cap)
 
-    ctx_pos = jnp.arange(ctx, dtype=jnp.int32)[None, :]  # [1, C]
+    local = jnp.arange(ctx, dtype=jnp.int32)
+    ctx_pos = (
+        ((local // bs) * ctx_stride + ctx_phase) * bs + local % bs
+    )[None, :]  # [1, C] global positions of the local context slots
     causal = ctx_pos <= md.positions[:, None]  # [T, C]
     if sliding_window is not None:
         # Accepts a python int OR a traced scalar (0 = full attention),
@@ -206,4 +217,8 @@ def ref_ragged_paged_attention(
     # Fully-masked rows (padding tokens) produce NaN-free zeros:
     probs = jnp.where(jnp.isnan(probs), 0.0, probs)
     out = jnp.einsum("tkgc,tckd->tkgd", probs, v_t)
-    return out.reshape(t, h, d).astype(q.dtype)
+    out = out.reshape(t, h, d).astype(q.dtype)
+    if not return_lse:
+        return out
+    lse = jax.scipy.special.logsumexp(scores, axis=-1)  # [T, KH, G]
+    return out, lse.reshape(t, h)

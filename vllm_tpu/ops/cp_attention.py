@@ -1,0 +1,101 @@
+"""Context-parallel paged attention: KV shards + LSE merge over a mesh axis.
+
+Reference analog: DCP — decode context parallelism (``vllm/distributed``
+``_DCP`` group, ``cp_kv_cache_interleave_size`` striping, and the
+``csrc/attention/merge_attn_states.cu`` LSE-weighted combine;
+``v1/worker/cp_utils.py:30`` requires backends to return decode LSE).
+
+TPU-native formulation: the paged KV cache of a long sequence is striped
+round-robin across the ``cp`` mesh axis (global page ``g`` lives on rank
+``g % cp`` at local index ``g // cp``); queries are replicated over cp.
+Under ``shard_map`` each rank attends over its local pages only —
+emitting the partial output and its logsumexp — and the partials combine
+with three tiny collectives (pmax / psum / psum), never materializing the
+full context anywhere:
+
+    m   = pmax(lse)                      # global max for stability
+    w   = exp(lse - m)
+    out = psum(w * out_local) / psum(w)
+
+This is exact: each rank's ``out_local`` is softmax-normalized within its
+shard, so ``w`` re-weights shards by their share of the global partition
+function.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from vllm_tpu.ops.attention import (
+    AttentionMetadata,
+    ref_ragged_paged_attention,
+)
+
+
+def merge_attn_states(
+    outs: jnp.ndarray,  # [P, T, H, D] partial attention outputs
+    lses: jnp.ndarray,  # [P, T, H] partial logsumexps
+) -> jnp.ndarray:
+    """LSE-weighted combine of partial attention states (the
+    ``merge_attn_states.cu`` contract, host-mesh-free variant)."""
+    m = jnp.max(lses, axis=0, keepdims=True)  # [1, T, H]
+    w = jnp.exp(lses - m)  # [P, T, H]
+    den = jnp.sum(w, axis=0)  # [T, H]
+    num = jnp.sum(w[..., None] * outs.astype(jnp.float32), axis=0)
+    out = jnp.where(den[..., None] > 0, num / den[..., None], 0.0)
+    return out.astype(outs.dtype)
+
+
+def cp_paged_attention(
+    q: jnp.ndarray,  # [T, H, D] (replicated over cp)
+    kv_local: jnp.ndarray,  # [L, NB_local, BS, rows, lanes] this rank's shard
+    layer: jnp.ndarray,
+    md_local: AttentionMetadata,  # per-rank metadata (local block tables)
+    scale: float,
+    *,
+    axis_name: str = "cp",
+    sliding_window=None,
+    soft_cap: float | None = None,
+) -> jnp.ndarray:
+    """Runs INSIDE shard_map over `axis_name`. Local partial attention +
+    cross-rank LSE merge; every rank returns the identical full output."""
+    cp = jax.lax.psum(1, axis_name)
+    rank = jax.lax.axis_index(axis_name)
+
+    out, lse = ref_ragged_paged_attention(
+        q, kv_local, layer, md_local, scale,
+        sliding_window=sliding_window, soft_cap=soft_cap,
+        return_lse=True, ctx_stride=cp, ctx_phase=rank,
+    )
+    m = jax.lax.pmax(lse, axis_name)  # [T, H]
+    w = jnp.exp(lse - m)
+    den = jax.lax.psum(w, axis_name)
+    num = jax.lax.psum(
+        w[..., None] * out.astype(jnp.float32), axis_name
+    )
+    merged = jnp.where(den[..., None] > 0, num / den[..., None], 0.0)
+    return merged.astype(q.dtype)
+
+
+def stripe_metadata(
+    block_tables, seq_lens, positions, cp: int,
+):
+    """Host helper: global (contiguous-page) metadata -> per-rank striped
+    metadata arrays.
+
+    Global page index g maps to rank ``g % cp``, local index ``g // cp``.
+    Returns (local_block_tables [cp, R, ceil(B/cp)],) — seq_lens and
+    positions stay GLOBAL (the mask is computed from global positions via
+    ctx_stride/ctx_phase).
+    """
+    import numpy as np
+
+    bt = np.asarray(block_tables)
+    r, b = bt.shape
+    b_local = -(-b // cp)
+    out = np.zeros((cp, r, b_local), bt.dtype)
+    for p in range(cp):
+        pages = bt[:, p::cp]
+        out[p, :, : pages.shape[1]] = pages
+    return out
