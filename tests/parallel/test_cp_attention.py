@@ -12,11 +12,10 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding
 from jax.sharding import PartitionSpec as P
 
+from tests.models.test_ragged_paged_attention import _random_case
 from vllm_tpu.ops.attention import (
-    AttentionMetadata,
     kv_cache_shape,
     ref_ragged_paged_attention,
-    write_kv,
 )
 from vllm_tpu.ops.cp_attention import (
     cp_paged_attention,
@@ -55,49 +54,6 @@ def test_merge_attn_states_exact():
     np.testing.assert_allclose(np.asarray(got), full, rtol=1e-5, atol=1e-5)
 
 
-def _global_case(rng, q_lens, kv_lens, kh, h, d, bs, num_blocks):
-    """Contiguous-page single-device case (ground truth)."""
-    n_seqs = len(q_lens)
-    t = int(sum(q_lens))
-    q = jnp.asarray(rng.standard_normal((t, h, d)), jnp.float32)
-    max_blocks = max(-(-kv // bs) for kv in kv_lens)
-    block_tables = np.zeros((n_seqs, max_blocks), np.int32)
-    kv = jnp.asarray(
-        rng.standard_normal(kv_cache_shape(1, num_blocks, bs, kh, d)),
-        jnp.float32,
-    )
-    positions = np.zeros(t, np.int32)
-    tri = np.zeros(t, np.int32)
-    sm = np.zeros(t, np.int32)
-    qsl = np.zeros(n_seqs + 1, np.int32)
-    nxt, off = 1, 0
-    for i in range(n_seqs):
-        nb = -(-kv_lens[i] // bs)
-        blocks = np.arange(nxt, nxt + nb, dtype=np.int32)
-        nxt += nb
-        block_tables[i, :nb] = blocks
-        pos = np.arange(kv_lens[i] - q_lens[i], kv_lens[i], dtype=np.int32)
-        positions[off : off + q_lens[i]] = pos
-        tri[off : off + q_lens[i]] = i
-        sm[off : off + q_lens[i]] = blocks[pos // bs] * bs + pos % bs
-        off += q_lens[i]
-        qsl[i + 1] = off
-    md = AttentionMetadata(
-        positions=jnp.asarray(positions),
-        slot_mapping=jnp.asarray(sm),
-        block_tables=jnp.asarray(block_tables),
-        seq_lens=jnp.asarray(kv_lens, dtype=jnp.int32),
-        query_start_loc=jnp.asarray(qsl),
-        token_req_idx=jnp.asarray(tri),
-        logits_indices=jnp.asarray(qsl[1:] - 1),
-        num_seqs=jnp.asarray([n_seqs], jnp.int32),
-    )
-    k_new = jnp.asarray(rng.standard_normal((t, kh, d)), jnp.float32)
-    v_new = jnp.asarray(rng.standard_normal((t, kh, d)), jnp.float32)
-    kv = write_kv(kv, jnp.int32(0), k_new, v_new, md.slot_mapping)
-    return q, kv, md
-
-
 @pytest.mark.parametrize("cp", [2, 4])
 def test_cp_attention_matches_single_device(cp):
     """Striped KV shards over a cp mesh axis + LSE merge == full attention.
@@ -111,32 +67,22 @@ def test_cp_attention_matches_single_device(cp):
     rng = np.random.default_rng(1)
     kh, h, d, bs = 2, 4, 32, 8
     q_lens, kv_lens = [1, 9, 1], [53, 33, 17]
-    q, kv_global, md = _global_case(
-        rng, q_lens, kv_lens, kh, h, d, bs, num_blocks=32
+    q, kv_global, md = _random_case(
+        rng, len(q_lens), q_lens, kv_lens, kh, h, d, bs, num_blocks=32
     )
     want = ref_ragged_paged_attention(q, kv_global, jnp.int32(0), md,
                                       d ** -0.5)
 
-    # Build per-rank caches: local page j of rank p = global page j*cp+p
-    # as referenced through the block table (per-request page sequence).
-    r, b = md.block_tables.shape
-    b_local = -(-b // cp)
-    nb_local = 1 + r * b_local  # block 0 + per-request local pages
+    # Per-rank caches and local tables from the striping helper.
+    local_bt, placement = stripe_metadata(md.block_tables, cp)
+    r, b_local = local_bt.shape[1:]
+    nb_local = max(len(pl) for pl in placement)
     kv_np = np.asarray(kv_global)
     local_kv = np.zeros((cp,) + kv_cache_shape(1, nb_local, bs, kh, d),
                         np.float32)
-    local_bt = np.zeros((cp, r, b_local), np.int32)
-    bt = np.asarray(md.block_tables)
     for p in range(cp):
-        nxt = 1
-        for i in range(r):
-            pages = bt[i, p::cp]  # this request's pages on rank p
-            for j, g in enumerate(pages):
-                if g == 0:  # page id 0 = padding in the global table
-                    continue
-                local_kv[p, 0, nxt] = kv_np[0, g]
-                local_bt[p, i, j] = nxt
-                nxt += 1
+        for slot, g in enumerate(placement[p]):
+            local_kv[p, 0, slot] = kv_np[0, g]
 
     mesh = Mesh(np.asarray(jax.devices()[:cp]), ("cp",))
     q_rep = jax.device_put(q, NamedSharding(mesh, P()))
@@ -176,8 +122,19 @@ def test_cp_attention_matches_single_device(cp):
 
 
 def test_stripe_metadata_helper():
-    bt = np.arange(1, 13).reshape(2, 6)
-    out = stripe_metadata(bt, None, None, cp=2)
-    assert out.shape == (2, 2, 3)
-    np.testing.assert_array_equal(out[0, 0], [1, 3, 5])
-    np.testing.assert_array_equal(out[1, 0], [2, 4, 6])
+    bt = np.asarray([[5, 12, 3, 7], [9, 5, 0, 0]])
+    local_bt, placement = stripe_metadata(bt, cp=2)
+    assert local_bt.shape == (2, 2, 2)
+    # Rank 0 holds context pages 0 and 2 of each request, remapped to
+    # first-come local slots (0 stays the null page).
+    assert placement[0][local_bt[0, 0, 0]] == 5
+    assert placement[0][local_bt[0, 0, 1]] == 3
+    assert placement[1][local_bt[1, 0, 0]] == 12
+    assert placement[1][local_bt[1, 0, 1]] == 7
+    # Request 1 stripes [9] to rank 0 and [5] to rank 1: the same global
+    # page may live on several ranks when requests stripe it differently
+    # (shared-prefix duplication under CP).
+    assert placement[0][local_bt[0, 1, 0]] == 9
+    assert placement[1][local_bt[1, 1, 0]] == 5
+    # Padding columns stay null.
+    assert local_bt[1, 1, 1] == 0

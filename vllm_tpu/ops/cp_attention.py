@@ -57,13 +57,23 @@ def cp_paged_attention(
     axis_name: str = "cp",
     sliding_window=None,
     soft_cap: float | None = None,
+    local_attention_fn=None,
 ) -> jnp.ndarray:
     """Runs INSIDE shard_map over `axis_name`. Local partial attention +
-    cross-rank LSE merge; every rank returns the identical full output."""
+    cross-rank LSE merge; every rank returns the identical full output.
+
+    The local partial currently uses the XLA reference path (which
+    understands striped context positions); teaching the Pallas flash
+    kernel ctx_stride/ctx_phase + explicit query positions is the
+    outstanding fast-path work. ``local_attention_fn`` overrides the
+    local computation (must return ``(out, lse)``)."""
     cp = jax.lax.psum(1, axis_name)
     rank = jax.lax.axis_index(axis_name)
 
-    out, lse = ref_ragged_paged_attention(
+    local = local_attention_fn or (
+        lambda *a, **kw: ref_ragged_paged_attention(*a, **kw)
+    )
+    out, lse = local(
         q, kv_local, layer, md_local, scale,
         sliding_window=sliding_window, soft_cap=soft_cap,
         return_lse=True, ctx_stride=cp, ctx_phase=rank,
@@ -78,24 +88,39 @@ def cp_paged_attention(
     return merged.astype(q.dtype)
 
 
-def stripe_metadata(
-    block_tables, seq_lens, positions, cp: int,
-):
-    """Host helper: global (contiguous-page) metadata -> per-rank striped
-    metadata arrays.
+def stripe_metadata(block_tables, cp: int):
+    """Host helper: global block tables -> per-rank striped local tables
+    plus the page placement map for building each rank's local cache.
 
-    Global page index g maps to rank ``g % cp``, local index ``g // cp``.
-    Returns (local_block_tables [cp, R, ceil(B/cp)],) — seq_lens and
-    positions stay GLOBAL (the mask is computed from global positions via
-    ctx_stride/ctx_phase).
+    Striping is by PER-REQUEST context position (vLLM's
+    ``cp_kv_cache_interleave_size=1`` semantics): a request's k-th context
+    page lives on rank ``k % cp`` at local table column ``k // cp`` —
+    exactly the layout the attention mask's ``ctx_stride``/``ctx_phase``
+    mapping assumes. Global page ids are remapped to LOCAL cache slots,
+    assigned first-come per rank (slot 0 stays the null page).
+
+    Returns ``(local_block_tables [cp, R, ceil(B/cp)] i32,
+    placement [cp][local_slot] -> global_page_id list)``: rank p's local
+    cache must hold global page ``placement[p][s]`` at slot ``s``.
     """
     import numpy as np
 
     bt = np.asarray(block_tables)
     r, b = bt.shape
     b_local = -(-b // cp)
-    out = np.zeros((cp, r, b_local), bt.dtype)
+    local_bt = np.zeros((cp, r, b_local), np.int32)
+    placement: list[list[int]] = [[0] for _ in range(cp)]  # slot 0 = null
+    local_of: list[dict[int, int]] = [{0: 0} for _ in range(cp)]
     for p in range(cp):
-        pages = bt[:, p::cp]
-        out[p, :, : pages.shape[1]] = pages
-    return out
+        for i in range(r):
+            for j, g in enumerate(bt[i, p::cp]):
+                g = int(g)
+                if g == 0:  # padding in the global table
+                    continue
+                slot = local_of[p].get(g)
+                if slot is None:
+                    slot = len(placement[p])
+                    placement[p].append(g)
+                    local_of[p][g] = slot
+                local_bt[p, i, j] = slot
+    return local_bt, placement
