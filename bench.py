@@ -77,6 +77,9 @@ def main() -> None:
         max_model_len=2048,
         max_num_batched_tokens=1024,
         max_num_seqs=min(n_req, 128),
+        # In-jit multi-step decode amortizes per-launch host/tunnel
+        # overhead; exact for greedy (tests/engine/test_multi_step.py).
+        num_decode_steps=int(os.environ.get("VLLM_TPU_BENCH_DECODE_STEPS", 4)),
     )
     params = SamplingParams(
         temperature=0.0, max_tokens=output_len, ignore_eos=True

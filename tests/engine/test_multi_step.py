@@ -1,0 +1,103 @@
+"""In-jit multi-step decode: K tokens per launch must be EXACTLY
+equivalent to single-step decoding (greedy and seeded sampling), and must
+fall back cleanly around prefill, logprobs, and feature-bearing requests.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from tests.models.utils import tiny_llama_dir
+from vllm_tpu import LLM, SamplingParams
+
+
+@pytest.fixture(scope="module")
+def ckpt(tmp_path_factory):
+    return tiny_llama_dir(tmp_path_factory.mktemp("tiny_llama_ms"))
+
+
+def _mk(ckpt, k=1, **kw):
+    return LLM(
+        model=ckpt, dtype="float32", max_model_len=128, block_size=16,
+        num_gpu_blocks_override=64, max_num_seqs=4,
+        max_num_batched_tokens=128, num_decode_steps=k, **kw,
+    )
+
+
+def _prompts(sizes, seed=0):
+    rng = np.random.default_rng(seed)
+    return [
+        {"prompt_token_ids": rng.integers(5, 120, size=n).tolist()}
+        for n in sizes
+    ]
+
+
+def test_greedy_equivalence(ckpt):
+    prompts = _prompts((7, 13, 3))
+    sp = SamplingParams(temperature=0.0, max_tokens=21, ignore_eos=True)
+    ref = [o.outputs[0].token_ids for o in _mk(ckpt).generate(prompts, sp)]
+    got = [o.outputs[0].token_ids for o in _mk(ckpt, k=4).generate(prompts, sp)]
+    assert got == ref
+
+
+def test_seeded_sampling_equivalence(ckpt):
+    prompts = _prompts((5, 9), seed=1)
+    sp = SamplingParams(
+        temperature=0.9, top_k=20, top_p=0.95, seed=7, max_tokens=18,
+        ignore_eos=True,
+    )
+    ref = [o.outputs[0].token_ids for o in _mk(ckpt).generate(prompts, sp)]
+    got = [o.outputs[0].token_ids for o in _mk(ckpt, k=4).generate(prompts, sp)]
+    assert got == ref
+
+
+def test_eos_and_max_tokens_respected(ckpt):
+    """Chains overshooting a stop are trimmed: max_tokens not a multiple
+    of K still yields exactly max_tokens."""
+    prompts = _prompts((6,), seed=2)
+    sp = SamplingParams(temperature=0.0, max_tokens=10, ignore_eos=True)
+    out = _mk(ckpt, k=4).generate(prompts, sp)[0].outputs[0]
+    assert len(out.token_ids) == 10
+    assert out.finish_reason == "length"
+
+
+def test_feature_request_disables_chaining(ckpt):
+    """A logprobs request forces K=1 steps but everything stays correct."""
+    prompts = _prompts((4, 8), seed=3)
+    params = [
+        SamplingParams(temperature=0.0, max_tokens=8, ignore_eos=True,
+                       logprobs=2),
+        SamplingParams(temperature=0.0, max_tokens=8, ignore_eos=True),
+    ]
+    ref = _mk(ckpt).generate(prompts, params)
+    got = _mk(ckpt, k=4).generate(prompts, params)
+    for a, b in zip(got, ref):
+        assert a.outputs[0].token_ids == b.outputs[0].token_ids
+    assert got[0].outputs[0].logprobs is not None
+
+
+def test_staggered_arrivals(ckpt):
+    """Requests admitted at different times (prefill interleaves with
+    chained decode) still match single-step output."""
+    sp = SamplingParams(temperature=0.0, max_tokens=12, ignore_eos=True)
+    prompts = _prompts((9, 14, 5, 11), seed=4)
+
+    def run(k):
+        llm = _mk(ckpt, k=k)
+        eng = llm.llm_engine
+        # Feed the first two, step a few times, then feed the rest.
+        for i, p in enumerate(prompts[:2]):
+            eng.add_request(str(i), p, sp)
+        for _ in range(3):
+            eng.step()
+        for i, p in enumerate(prompts[2:], start=2):
+            eng.add_request(str(i), p, sp)
+        outs = {}
+        while eng.has_unfinished_requests():
+            for o in eng.step():
+                if o.finished:
+                    outs[o.request_id] = o.outputs[0].token_ids
+        return [outs[str(i)] for i in range(4)]
+
+    assert run(4) == run(1)

@@ -157,6 +157,13 @@ class SchedulerConfig:
     # at 2 in flight — the device-side count correction covers one token).
     async_pipeline_depth: int = 6
     enable_chunked_prefill: bool = True
+    # In-jit multi-step decode (reference analog: vLLM v0
+    # --num-scheduler-steps): when every scheduled request is a pure
+    # decode, run up to N sequential decode iterations inside ONE jitted
+    # launch, emitting N tokens per request per host round trip. Exact for
+    # greedy and seeded sampling; steps carrying prefill, spec, pooling,
+    # grammar, logprobs, or logits processors fall back to 1.
+    num_decode_steps: int = 1
     # Slots allocated beyond the scheduled tokens (EAGLE writes draft KV at
     # speculative positions); set at EngineConfig.finalize.
     num_lookahead_tokens: int = 0
@@ -272,6 +279,11 @@ class EngineConfig:
         self.compilation_config.finalize(sc)
         if self.speculative_config.enabled and self.parallel_config.pipeline_parallel_size > 1:
             raise ValueError("speculative decoding is incompatible with pipeline parallelism")
+        if self.speculative_config.enabled and sc.num_decode_steps > 1:
+            raise ValueError(
+                "num_decode_steps > 1 is incompatible with speculative "
+                "decoding (spec already emits multiple tokens per step)"
+            )
         return self
 
     def compute_hash(self) -> str:

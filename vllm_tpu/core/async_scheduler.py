@@ -28,6 +28,10 @@ class AsyncScheduler(Scheduler):
             request.num_computed_tokens >= request.num_tokens
             and request.pooling_params is None  # pooling never samples
         ):
-            # This step samples an output token that is not yet known
-            # host-side.
-            request.num_output_placeholders += 1
+            # This step samples output token(s) not yet known host-side.
+            # In-jit multi-step decode samples K per launch; the chained
+            # tokens' KV is written in-jit, so computed advances with them.
+            k = getattr(self, "_decode_k", 1)
+            request.num_output_placeholders += k
+            request.num_computed_tokens += k - 1
+            request.num_inflight_steps += 1

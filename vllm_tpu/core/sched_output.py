@@ -56,6 +56,8 @@ class SchedulerOutput:
     scheduled_spec_decode_tokens: dict[str, list[int]] = field(default_factory=dict)
     # Requests that finished/aborted since the last step (runner state cleanup).
     finished_req_ids: set[str] = field(default_factory=set)
+    # In-jit multi-step decode: tokens sampled per request this step.
+    num_decode_steps: int = 1
     # Structured output: req_id -> row index into the grammar bitmask.
     structured_output_request_ids: dict[str, int] = field(default_factory=dict)
     grammar_bitmask: Any = None

@@ -205,6 +205,41 @@ class Scheduler:
         # these captured values, not the live counter.
         starts: dict[str, int] = {}
 
+        # In-jit multi-step decode: eligible only when EVERY live request
+        # is a pure single-token decode with no feature that needs host
+        # work between tokens (async only — the sync path advances counts
+        # at update time).
+        decode_k = 1
+        cfg_k = self.config.num_decode_steps
+        if cfg_k > 1 and self.async_scheduling and not self.waiting:
+            def _plain_decode(r):
+                p = r.sampling_params
+                return (
+                    r.pooling_params is None
+                    and not r.spec_token_ids
+                    and p.logprobs is None
+                    and not r.use_structured_output
+                    and not _needs_logits_processors(p)
+                    and not (p.presence_penalty or p.frequency_penalty
+                             or p.repetition_penalty != 1.0)
+                    and (r.num_tokens_with_spec + r.num_output_placeholders
+                         - r.num_computed_tokens) == 1
+                )
+
+            if self.running and all(map(_plain_decode, self.running)):
+                # The k-th sampled token of a row lands at position
+                # computed + k; near max_model_len fall back to single
+                # steps rather than compiling intermediate chain lengths
+                # (num_decode_steps is a static jit arg — only two traces
+                # ever exist: 1 and cfg_k).
+                room = min(
+                    self.config.max_model_len - r.num_computed_tokens - 1
+                    for r in self.running
+                )
+                if room >= cfg_k:
+                    decode_k = cfg_k
+        self._decode_k = decode_k
+
         # Spec-decode steps disable logprobs for the whole batch (the
         # runner's per-token logprob contract is single-token), so while ANY
         # request wants logprobs, drop pending drafts at the authoritative
@@ -245,7 +280,7 @@ class Scheduler:
                 depth_cap = 2
             else:
                 depth_cap = self.config.async_pipeline_depth
-            if request.num_output_placeholders >= depth_cap:
+            if request.num_inflight_steps >= depth_cap:
                 req_index += 1
                 continue
             # In-flight tokens are only recoverable device-side from the
@@ -282,7 +317,10 @@ class Scheduler:
             while True:
                 new_blocks = self.kv_cache_manager.allocate_slots(
                     request, num_new_tokens,
-                    num_lookahead_tokens=self.config.num_lookahead_tokens,
+                    num_lookahead_tokens=max(
+                        self.config.num_lookahead_tokens,
+                        self._decode_k - 1,
+                    ),
                 )
                 if new_blocks is not None:
                     break
@@ -457,6 +495,7 @@ class Scheduler:
 
         total = sum(num_scheduled_tokens.values())
         output = SchedulerOutput(
+            num_decode_steps=self._decode_k,
             scheduled_new_reqs=scheduled_new_reqs,
             scheduled_cached_reqs=cached,
             num_scheduled_tokens=num_scheduled_tokens,
@@ -549,6 +588,9 @@ class Scheduler:
             elif generated:
                 request.num_output_placeholders = max(
                     0, request.num_output_placeholders - len(generated)
+                )
+                request.num_inflight_steps = max(
+                    0, request.num_inflight_steps - 1
                 )
             if scheduled_spec:
                 self._spec_num_draft_tokens += len(scheduled_spec)

@@ -49,6 +49,7 @@ class EngineArgs:
     enable_chunked_prefill: bool = True
     scheduling_policy: str = "fcfs"
     async_scheduling: bool = True
+    num_decode_steps: int = 1
 
     tensor_parallel_size: int = 1
     data_parallel_size: int = 1
@@ -110,6 +111,7 @@ class EngineArgs:
                 enable_chunked_prefill=self.enable_chunked_prefill,
                 policy=self.scheduling_policy,  # type: ignore[arg-type]
                 async_scheduling=self.async_scheduling,
+                num_decode_steps=self.num_decode_steps,
             ),
             device_config=DeviceConfig(device=self.device),  # type: ignore[arg-type]
             speculative_config=SpeculativeConfig(

@@ -184,6 +184,7 @@ class ModelRunner:
                 "num_spec",
                 "num_adj",
                 "num_allow",
+                "num_decode_steps",
             ),
             donate_argnums=(1, 2) if self.draft_model is not None else (1,),
         )
@@ -306,6 +307,7 @@ class ModelRunner:
         num_spec: int = 0,
         num_adj: int = 0,
         num_allow: int = 0,
+        num_decode_steps: int = 1,
     ):
         (token_ids, md, sampling, feedback, grammar_rows, logit_adjust,
          draft_next, token_lora, spec) = self._unpack(
@@ -424,6 +426,44 @@ class ModelRunner:
             needs_top_p_min_p=needs_top_p_min_p,
             needs_gumbel=needs_gumbel,
         )
+        if num_decode_steps > 1:
+            # In-jit multi-step decode: chain K-1 more single-position
+            # iterations, feeding each sampled token back device-side.
+            # Scheduler guarantees every row is a plain decode (no spec /
+            # grammar / processors / penalties / logprobs / pooling).
+            from dataclasses import replace as _dreplace
+
+            outs = [sampled]
+            tok = sampled
+            pos0 = md.positions[md.logits_indices]  # current input position
+            # Per-row adapter slot = the slot of the row's last token.
+            row_lora = (
+                token_lora[md.logits_indices]
+                if token_lora is not None
+                else None
+            )
+            for k in range(1, num_decode_steps):
+                # Position of the token sampled last iteration.
+                md_k = self._single_pos_metadata(md, pos0 + k, r_pad)
+                hidden_k, kv_cache = self.model.apply(
+                    params, kv_cache, tok, md_k, token_lora_slot=row_lora
+                )
+                logits_k = self.model.compute_logits(params, hidden_k)
+                sampling_k = _dreplace(
+                    sampling,
+                    prng_keys=sampling.prng_keys.at[:, 1].add(k),
+                )
+                tok, _ = sample(
+                    logits_k,
+                    sampling_k,
+                    needs_penalties=False,
+                    needs_top_k=needs_top_k,
+                    needs_top_p_min_p=needs_top_p_min_p,
+                    needs_gumbel=needs_gumbel,
+                )
+                outs.append(tok)
+            sampled = jnp.stack(outs, axis=1)  # [R, K]
+
         drafts = None
         if self.draft_model is not None:
             # Runs even on logprob batches (whose drafts finalize discards):
@@ -489,18 +529,7 @@ class ModelRunner:
         h_prev = h_d[anchor]  # [R, D]
         pos0 = md.positions[anchor]
         for k in range(1, k_spec):
-            p = pos0 + k
-            slot = md.block_tables[rows_r, p // bs] * bs + p % bs
-            md_k = AttentionMetadata(
-                positions=p,
-                slot_mapping=slot,
-                block_tables=md.block_tables,
-                seq_lens=p + 1,
-                query_start_loc=jnp.arange(r_pad + 1, dtype=jnp.int32),
-                token_req_idx=rows_r.astype(jnp.int32),
-                logits_indices=rows_r.astype(jnp.int32),
-                num_seqs=md.num_seqs,
-            )
+            md_k = self._single_pos_metadata(md, pos0 + k, r_pad)
             h_prev, draft_kv = dm.forward(
                 dp, embed, draft_kv, d_tok, h_prev, md_k
             )
@@ -678,7 +707,10 @@ class ModelRunner:
                 # counter so seeded streams don't repeat).
                 lag = start + n - known
                 prev_row = self._prev_rows.get(rid, -1)
-                assert lag < self._max_pipeline_depth + 1 and prev_row >= 0, (
+                max_lag = self._max_pipeline_depth * max(
+                    1, self.config.scheduler_config.num_decode_steps
+                )
+                assert lag <= max_lag and prev_row >= 0, (
                     rid, start, n, known, prev_row)
                 feedback[i] = prev_row
                 pending_rows.append((i, lag))
@@ -781,9 +813,27 @@ class ModelRunner:
             num_spec=s,
             num_adj=num_adj,
             num_allow=num_allow,
+            num_decode_steps=so.num_decode_steps,
         )
         arrays = (jnp.asarray(ibuf), jnp.asarray(fbuf), counts, prompt_mask)
         return arrays, req_order, do_sample[:r_live], dims | flags
+
+    def _single_pos_metadata(self, md, p, r_pad):
+        """Per-row single-position AttentionMetadata (decode chain /
+        EAGLE chain): query at position p[row], same block tables."""
+        bs = self.block_size
+        rows_r = jnp.arange(r_pad, dtype=jnp.int32)
+        slot = md.block_tables[rows_r, p // bs] * bs + p % bs
+        return AttentionMetadata(
+            positions=p,
+            slot_mapping=slot,
+            block_tables=md.block_tables,
+            seq_lens=p + 1,
+            query_start_loc=jnp.arange(r_pad + 1, dtype=jnp.int32),
+            token_req_idx=rows_r,
+            logits_indices=rows_r,
+            num_seqs=md.num_seqs,
+        )
 
     def _logit_adjustments(self, rows: list[int], req_order: list[str],
                            num_sched: dict[str, int]):
@@ -916,10 +966,13 @@ class ModelRunner:
             self.timing["steps"] += 1
         is_spec = flags["num_spec"] > 0
         if not is_spec:
+            # Multi-step decode returns [R, K]; the feedback source for the
+            # next step is the LAST sampled column.
+            last_col = sampled[:, -1] if sampled.ndim == 2 else sampled
             self._last_sampled = (
-                sampled
-                if sampled.shape[0] == self._max_r
-                else jnp.pad(sampled, (0, self._max_r - sampled.shape[0]))
+                last_col
+                if last_col.shape[0] == self._max_r
+                else jnp.pad(last_col, (0, self._max_r - last_col.shape[0]))
             )
             self._prev_rows = {rid: i for i, rid in enumerate(req_order)}
         # Kick off the D2H copy now: it runs as soon as the step completes,
@@ -1008,11 +1061,12 @@ class ModelRunner:
                 out.sampled_token_ids.append([])
                 continue
             if do_sample[i]:
-                toks = (
-                    [int(x) for x in out_tokens[i, : num_out[i]]]
-                    if handle.spec
-                    else [int(sampled_np[i])]
-                )
+                if handle.spec:
+                    toks = [int(x) for x in out_tokens[i, : num_out[i]]]
+                elif sampled_np.ndim == 2:  # multi-step decode [R, K]
+                    toks = [int(x) for x in sampled_np[i]]
+                else:
+                    toks = [int(sampled_np[i])]
                 # The request may have finished (async: stop detected while
                 # this step was in flight) and its row dropped — or even
                 # replaced by a new request reusing the id (identity check).
