@@ -1,0 +1,129 @@
+"""Tool-call and reasoning parser tests (reference: tests/tool_use and
+reasoning parser suites)."""
+
+from __future__ import annotations
+
+import json
+
+import pytest
+
+from vllm_tpu.parsers import get_reasoning_parser, get_tool_parser
+
+
+def test_hermes_tool_parse():
+    p = get_tool_parser("hermes")
+    text = (
+        'Let me check the weather.\n<tool_call>\n'
+        '{"name": "get_weather", "arguments": {"city": "Paris"}}\n'
+        '</tool_call>\n<tool_call>'
+        '{"name": "get_time", "arguments": {}}</tool_call>'
+    )
+    out = p.parse(text)
+    assert [t.name for t in out.tool_calls] == ["get_weather", "get_time"]
+    assert json.loads(out.tool_calls[0].arguments) == {"city": "Paris"}
+    assert out.content == "Let me check the weather."
+    assert out.tool_calls[0].to_openai()["type"] == "function"
+
+
+def test_hermes_ignores_bad_json():
+    p = get_tool_parser("hermes")
+    out = p.parse("<tool_call>{not json}</tool_call>ok")
+    assert out.tool_calls == []
+    assert out.content == "ok"
+
+
+def test_json_tool_parse():
+    p = get_tool_parser("llama3_json")
+    out = p.parse('{"name": "f", "parameters": {"x": 1}}')
+    assert len(out.tool_calls) == 1
+    assert out.tool_calls[0].name == "f"
+    assert json.loads(out.tool_calls[0].arguments) == {"x": 1}
+    assert out.content is None
+
+    out = p.parse('```json\n[{"name": "a", "arguments": {}}]\n```')
+    assert [t.name for t in out.tool_calls] == ["a"]
+
+    out = p.parse("just prose")
+    assert out.tool_calls == [] and out.content == "just prose"
+
+
+def test_reasoning_full():
+    p = get_reasoning_parser("qwen3")
+    reasoning, content = p.parse_full(
+        "<think>\nstep 1\nstep 2\n</think>\nThe answer is 4."
+    )
+    assert reasoning == "step 1\nstep 2"
+    assert content == "The answer is 4."
+    # No think block: all content.
+    p2 = get_reasoning_parser("qwen3")
+    assert p2.parse_full("plain") == (None, "plain")
+
+
+def test_reasoning_implicit_start():
+    p = get_reasoning_parser("deepseek_r1")
+    reasoning, content = p.parse_full("thinking...</think>done")
+    assert reasoning == "thinking..."
+    assert content == "done"
+
+
+def test_reasoning_streaming_deltas():
+    p = get_reasoning_parser("qwen3")
+    # Marker split across deltas.
+    chunks = ["<th", "ink>abc", "def</th", "ink>ANS", "WER"]
+    reasoning, content = "", ""
+    for c in chunks:
+        r = p.parse_delta(c)
+        reasoning += r.reasoning_delta
+        content += r.content_delta
+    assert reasoning == "abcdef"
+    assert content == "ANSWER"
+
+
+def test_chat_endpoint_tool_plumbing(tmp_path_factory):
+    """Endpoint-level: tools flow into the template and the parser shapes
+    the response message (model output forced via logit_bias is unneeded —
+    we only assert plumbing doesn't break and content passes through)."""
+    import asyncio
+
+    from aiohttp.test_utils import TestClient, TestServer
+
+    from tests.models.utils import tiny_llama_dir_with_tokenizer
+    from vllm_tpu.engine.arg_utils import AsyncEngineArgs
+    from vllm_tpu.engine.async_llm import AsyncLLM
+    from vllm_tpu.entrypoints.openai.api_server import build_app
+
+    d = tiny_llama_dir_with_tokenizer(tmp_path_factory.mktemp("tiny_tools"))
+    engine = AsyncLLM.from_engine_args(
+        AsyncEngineArgs(
+            model=d, dtype="float32", max_model_len=128, block_size=16,
+            num_gpu_blocks_override=64, max_num_seqs=4,
+            max_num_batched_tokens=128,
+        )
+    )
+
+    async def run():
+        app = build_app(engine, "tiny", tool_parser="hermes",
+                        reasoning_parser="qwen3")
+        client = TestClient(TestServer(app))
+        await client.start_server()
+        try:
+            resp = await client.post("/v1/chat/completions", json={
+                "model": "tiny", "max_tokens": 6,
+                "messages": [{"role": "user", "content": "hi"}],
+                "tools": [{
+                    "type": "function",
+                    "function": {"name": "f", "parameters": {}},
+                }],
+            })
+            assert resp.status == 200, await resp.text()
+            body = await resp.json()
+            msg = body["choices"][0]["message"]
+            assert msg["role"] == "assistant"
+            assert "tool_calls" not in msg or msg["tool_calls"]
+        finally:
+            await client.close()
+
+    try:
+        asyncio.run(run())
+    finally:
+        engine.shutdown()

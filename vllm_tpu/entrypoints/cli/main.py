@@ -17,6 +17,10 @@ def _add_serve(sub: argparse._SubParsersAction) -> None:
     p.add_argument("model_tag", nargs="?", help="model name or path")
     p.add_argument("--host", default="0.0.0.0")
     p.add_argument("--port", type=int, default=8000)
+    p.add_argument("--tool-call-parser", default=None,
+                   help="tool-call output parser (hermes/json/...)")
+    p.add_argument("--reasoning-parser", default=None,
+                   help="reasoning splitter (deepseek_r1/qwen3/think)")
     AsyncEngineArgs.add_cli_args(p)
     p.set_defaults(func=_run_serve)
 
@@ -27,7 +31,11 @@ def _run_serve(args: argparse.Namespace) -> None:
     engine_args = AsyncEngineArgs.from_cli_args(args)
     if args.model_tag:
         engine_args.model = args.model_tag
-    run_server(engine_args, host=args.host, port=args.port)
+    run_server(
+        engine_args, host=args.host, port=args.port,
+        tool_parser=args.tool_call_parser,
+        reasoning_parser=args.reasoning_parser,
+    )
 
 
 def _add_complete(sub: argparse._SubParsersAction) -> None:

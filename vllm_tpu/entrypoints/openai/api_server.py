@@ -37,6 +37,8 @@ logger = init_logger(__name__)
 ENGINE_KEY = web.AppKey("engine", AsyncLLM)
 MODEL_KEY = web.AppKey("model_name", str)
 METRICS_KEY = web.AppKey("metrics", object)
+TOOL_PARSER_KEY = web.AppKey("tool_parser", str)
+REASONING_PARSER_KEY = web.AppKey("reasoning_parser", str)
 
 
 def _error(status: int, message: str, err_type: str = "invalid_request_error"):
@@ -164,10 +166,14 @@ async def handle_chat_completions(request: web.Request) -> web.StreamResponse:
     if tokenizer is None:
         return _error(400, "server has no tokenizer; chat API unavailable")
     try:
+        template_kwargs = {}
+        if req.tools:
+            template_kwargs["tools"] = req.tools
         prompt_ids = tokenizer.apply_chat_template(
             req.messages,
             chat_template=req.chat_template,
             add_generation_prompt=req.add_generation_prompt,
+            **template_kwargs,
         )
     except Exception as e:
         return _error(400, f"chat template failed: {e}")
@@ -225,12 +231,40 @@ async def handle_chat_completions(request: web.Request) -> web.StreamResponse:
         results = await asyncio.gather(*jobs)
     except EngineDeadError as e:
         return _error(500, str(e), "internal_error")
-    choices = [{
-        "index": j,
-        "message": {"role": "assistant", "content": out.outputs[0].text},
-        "logprobs": _chat_logprobs(out.outputs[0]) if req.logprobs else None,
-        "finish_reason": out.outputs[0].finish_reason or "stop",
-    } for j, out in enumerate(results)]
+    tool_parser_name = request.app.get(TOOL_PARSER_KEY)
+    reasoning_name = request.app.get(REASONING_PARSER_KEY)
+    choices = []
+    for j, out in enumerate(results):
+        c = out.outputs[0]
+        message: dict[str, Any] = {"role": "assistant", "content": c.text}
+        finish = c.finish_reason or "stop"
+        if reasoning_name:
+            from vllm_tpu.parsers import get_reasoning_parser
+
+            reasoning, content = get_reasoning_parser(
+                reasoning_name
+            ).parse_full(message["content"] or "")
+            message["content"] = content or None
+            if reasoning:
+                message["reasoning_content"] = reasoning
+        if req.tools and tool_parser_name:
+            from vllm_tpu.parsers import get_tool_parser
+
+            parsed = get_tool_parser(tool_parser_name).parse(
+                message["content"] or ""
+            )
+            if parsed.tool_calls:
+                message["content"] = parsed.content
+                message["tool_calls"] = [
+                    t.to_openai() for t in parsed.tool_calls
+                ]
+                finish = "tool_calls"
+        choices.append({
+            "index": j,
+            "message": message,
+            "logprobs": _chat_logprobs(c) if req.logprobs else None,
+            "finish_reason": finish,
+        })
     n_out = sum(len(out.outputs[0].token_ids) for out in results)
     return web.json_response({
         "id": req_id,
@@ -438,12 +472,18 @@ async def _sse_done(resp: web.StreamResponse) -> None:
     await resp.write_eof()
 
 
-def build_app(engine: AsyncLLM, model_name: str, metrics=None) -> web.Application:
+def build_app(engine: AsyncLLM, model_name: str, metrics=None,
+              tool_parser: str | None = None,
+              reasoning_parser: str | None = None) -> web.Application:
     app = web.Application()
     app[ENGINE_KEY] = engine
     app[MODEL_KEY] = model_name
     if metrics is not None:
         app[METRICS_KEY] = metrics
+    if tool_parser:
+        app[TOOL_PARSER_KEY] = tool_parser
+    if reasoning_parser:
+        app[REASONING_PARSER_KEY] = reasoning_parser
     app.router.add_post("/v1/completions", handle_completions)
     app.router.add_post("/v1/embeddings", handle_embeddings)
     from vllm_tpu.entrypoints.anthropic_api import handle_messages
@@ -459,13 +499,18 @@ def build_app(engine: AsyncLLM, model_name: str, metrics=None) -> web.Applicatio
     return app
 
 
-def run_server(engine_args, host: str = "0.0.0.0", port: int = 8000) -> None:
+def run_server(engine_args, host: str = "0.0.0.0", port: int = 8000,
+               tool_parser: str | None = None,
+               reasoning_parser: str | None = None) -> None:
     from vllm_tpu.metrics.prometheus import PrometheusRegistry
 
     engine = AsyncLLM.from_engine_args(engine_args)
     metrics = PrometheusRegistry(engine)
     engine.stat_loggers.append(metrics)
-    app = build_app(engine, engine_args.model, metrics)
+    app = build_app(
+        engine, engine_args.model, metrics,
+        tool_parser=tool_parser, reasoning_parser=reasoning_parser,
+    )
     logger.info("serving %s on %s:%d", engine_args.model, host, port)
     try:
         web.run_app(app, host=host, port=port, print=None)

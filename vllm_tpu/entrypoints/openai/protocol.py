@@ -194,6 +194,8 @@ class ChatCompletionRequest:
     chat_template: str | None = None
     add_generation_prompt: bool = True
     structured_outputs: Any = None
+    tools: list[dict] | None = None
+    tool_choice: Any = "auto"
     logit_bias: dict[int, float] | None = None
     bad_words: list[str] = field(default_factory=list)
     allowed_token_ids: list[int] | None = None
@@ -232,6 +234,8 @@ class ChatCompletionRequest:
             chat_template=d.get("chat_template"),
             add_generation_prompt=bool(d.get("add_generation_prompt", True)),
             structured_outputs=_structured_outputs(d),
+            tools=d.get("tools"),
+            tool_choice=d.get("tool_choice", "auto"),
             logit_bias=_logit_bias(d),
             bad_words=list(d.get("bad_words") or []),
             allowed_token_ids=_token_id_list(d, "allowed_token_ids"),

@@ -1,0 +1,18 @@
+"""Output parsers: tool calls and reasoning-stream splitting.
+
+Reference analog: ``vllm/tool_parsers/`` (42 per-model parsers) and
+``vllm/reasoning/`` — this build ships the two format families that cover
+the supported model zoo (Hermes/Qwen ``<tool_call>`` JSON blocks and bare
+JSON function calls; DeepSeek-R1-style ``<think>`` reasoning splitting),
+behind the same registry seam the reference uses.
+"""
+
+from vllm_tpu.parsers.reasoning import ReasoningParser, get_reasoning_parser
+from vllm_tpu.parsers.tools import ToolParser, get_tool_parser
+
+__all__ = [
+    "ReasoningParser",
+    "ToolParser",
+    "get_reasoning_parser",
+    "get_tool_parser",
+]

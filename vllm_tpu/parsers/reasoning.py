@@ -1,0 +1,111 @@
+"""Reasoning-stream splitting (DeepSeek-R1 / Qwen3 `<think>` style).
+
+Reference analog: ``vllm/reasoning/`` — separates chain-of-thought between
+the think markers from the final answer, in both one-shot and streaming
+(delta) modes.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+
+@dataclass
+class ReasoningChunk:
+    reasoning_delta: str = ""
+    content_delta: str = ""
+
+
+class ReasoningParser:
+    """Stateful splitter: text inside ``start``..``end`` markers is
+    reasoning; everything after the end marker is content. Models that
+    open a think block implicitly (R1 emits no ``<think>``) are handled by
+    ``implicit_start=True``."""
+
+    def __init__(self, start: str = "<think>", end: str = "</think>",
+                 implicit_start: bool = False) -> None:
+        self.start = start
+        self.end = end
+        self._in_think = implicit_start
+        self._started = implicit_start
+        self._buf = ""  # holdback for marker split across deltas
+
+    # ------------------------------------------------------------------
+
+    def parse_full(self, text: str) -> tuple[str | None, str]:
+        """(reasoning_content | None, content) for a complete response."""
+        t = text
+        if not self._started and t.lstrip().startswith(self.start):
+            t = t.lstrip()[len(self.start):]
+            started = True
+        else:
+            started = self._started
+        if not started:
+            return None, text
+        if self.end in t:
+            reasoning, content = t.split(self.end, 1)
+            return reasoning.strip("\n"), content.lstrip("\n")
+        return t.strip("\n"), ""
+
+    # ------------------------------------------------------------------
+
+    def parse_delta(self, delta: str) -> ReasoningChunk:
+        """Streaming: classify this delta's characters. Holds back text
+        that could be a partial marker."""
+        out = ReasoningChunk()
+        self._buf += delta
+        while self._buf:
+            if not self._started:
+                stripped = self._buf.lstrip()
+                if stripped.startswith(self.start):
+                    pad = len(self._buf) - len(stripped)
+                    self._buf = self._buf[pad + len(self.start):]
+                    self._started = True
+                    self._in_think = True
+                    continue
+                if self.start.startswith(stripped) or not stripped:
+                    return out  # could still become the start marker
+                # No think block: everything is content.
+                self._started = True
+                self._in_think = False
+                continue
+            if self._in_think:
+                idx = self._buf.find(self.end)
+                if idx >= 0:
+                    out.reasoning_delta += self._buf[:idx]
+                    self._buf = self._buf[idx + len(self.end):].lstrip("\n")
+                    self._in_think = False
+                    continue
+                # Emit all but a potential partial end marker.
+                keep = self._longest_suffix_prefix(self._buf, self.end)
+                emit = len(self._buf) - keep
+                out.reasoning_delta += self._buf[:emit]
+                self._buf = self._buf[emit:]
+                return out
+            out.content_delta += self._buf
+            self._buf = ""
+        return out
+
+    @staticmethod
+    def _longest_suffix_prefix(text: str, marker: str) -> int:
+        for n in range(min(len(text), len(marker) - 1), 0, -1):
+            if marker.startswith(text[-n:]):
+                return n
+        return 0
+
+
+_REASONING_PARSERS = {
+    "deepseek_r1": lambda: ReasoningParser(implicit_start=True),
+    "qwen3": lambda: ReasoningParser(),
+    "think": lambda: ReasoningParser(),
+}
+
+
+def get_reasoning_parser(name: str) -> ReasoningParser:
+    try:
+        return _REASONING_PARSERS[name]()
+    except KeyError:
+        raise ValueError(
+            f"unknown reasoning parser {name!r}; "
+            f"available: {sorted(_REASONING_PARSERS)}"
+        ) from None

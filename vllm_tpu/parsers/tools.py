@@ -1,0 +1,117 @@
+"""Tool-call output parsing (OpenAI function calling).
+
+Reference analog: ``vllm/tool_parsers/`` — parses the model's generated
+text into OpenAI ``tool_calls`` entries. Two families cover the supported
+zoo:
+
+- ``hermes``: ``<tool_call>{"name": ..., "arguments": {...}}</tool_call>``
+  blocks (Hermes, Qwen2.5/3, many fine-tunes);
+- ``json``: the whole message is one bare JSON object (or array) of
+  ``{"name", "arguments"|"parameters"}`` (Llama-3.1 JSON tool format).
+"""
+
+from __future__ import annotations
+
+import json
+import re
+import uuid
+from dataclasses import dataclass, field
+
+
+@dataclass
+class ToolCall:
+    name: str
+    arguments: str  # JSON-encoded string (OpenAI wire format)
+    id: str = field(
+        default_factory=lambda: f"call_{uuid.uuid4().hex[:24]}"
+    )
+
+    def to_openai(self) -> dict:
+        return {
+            "id": self.id,
+            "type": "function",
+            "function": {"name": self.name, "arguments": self.arguments},
+        }
+
+
+@dataclass
+class ParsedToolOutput:
+    content: str | None
+    tool_calls: list[ToolCall]
+
+
+class ToolParser:
+    def parse(self, text: str) -> ParsedToolOutput:  # pragma: no cover
+        raise NotImplementedError
+
+
+def _coerce_call(obj: dict) -> ToolCall | None:
+    name = obj.get("name")
+    if not isinstance(name, str):
+        return None
+    args = obj.get("arguments", obj.get("parameters", {}))
+    if isinstance(args, str):
+        args_str = args
+    else:
+        args_str = json.dumps(args)
+    return ToolCall(name=name, arguments=args_str)
+
+
+class HermesToolParser(ToolParser):
+    _BLOCK = re.compile(r"<tool_call>\s*(.*?)\s*</tool_call>", re.S)
+
+    def parse(self, text: str) -> ParsedToolOutput:
+        calls: list[ToolCall] = []
+        for block in self._BLOCK.findall(text):
+            try:
+                obj = json.loads(block)
+            except json.JSONDecodeError:
+                continue
+            call = _coerce_call(obj) if isinstance(obj, dict) else None
+            if call is not None:
+                calls.append(call)
+        content = self._BLOCK.sub("", text).strip()
+        return ParsedToolOutput(content=content or None, tool_calls=calls)
+
+
+class JsonToolParser(ToolParser):
+    """The whole message is one JSON object/array of calls (Llama-3.1)."""
+
+    def parse(self, text: str) -> ParsedToolOutput:
+        stripped = text.strip()
+        # Tolerate ```json fences.
+        fence = re.match(r"```(?:json)?\s*(.*?)\s*```$", stripped, re.S)
+        if fence:
+            stripped = fence.group(1)
+        try:
+            obj = json.loads(stripped)
+        except json.JSONDecodeError:
+            return ParsedToolOutput(content=text, tool_calls=[])
+        items = obj if isinstance(obj, list) else [obj]
+        calls = []
+        for item in items:
+            if isinstance(item, dict):
+                call = _coerce_call(item)
+                if call is not None:
+                    calls.append(call)
+        if calls:
+            return ParsedToolOutput(content=None, tool_calls=calls)
+        return ParsedToolOutput(content=text, tool_calls=[])
+
+
+_TOOL_PARSERS = {
+    "hermes": HermesToolParser,
+    "qwen": HermesToolParser,
+    "json": JsonToolParser,
+    "llama3_json": JsonToolParser,
+}
+
+
+def get_tool_parser(name: str) -> ToolParser:
+    try:
+        return _TOOL_PARSERS[name]()
+    except KeyError:
+        raise ValueError(
+            f"unknown tool parser {name!r}; available: "
+            f"{sorted(_TOOL_PARSERS)}"
+        ) from None
