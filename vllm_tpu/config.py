@@ -95,6 +95,10 @@ class CacheConfig:
     # Populated at model load from the model's attention window (None =
     # full attention); drives out-of-window block freeing.
     sliding_window: int | None = None
+    # External KV store ("host_offload" = content-addressed host-RAM tier
+    # reloading evicted prefixes; seam for disaggregated prefill).
+    kv_connector: str | None = None
+    kv_connector_cache_gb: float = 4.0
 
     def __post_init__(self) -> None:
         if self.block_size & (self.block_size - 1):

@@ -58,6 +58,10 @@ class SchedulerOutput:
     finished_req_ids: set[str] = field(default_factory=set)
     # In-jit multi-step decode: tokens sampled per request this step.
     num_decode_steps: int = 1
+    # KV connector: req_id -> (device block ids, content keys) to LOAD
+    # into the cache before this step runs (saves flow separately via an
+    # eager engine->worker RPC at free time).
+    kv_connector_load: dict[str, tuple] = field(default_factory=dict)
     # Structured output: req_id -> row index into the grammar bitmask.
     structured_output_request_ids: dict[str, int] = field(default_factory=dict)
     grammar_bitmask: Any = None

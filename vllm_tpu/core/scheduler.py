@@ -103,6 +103,7 @@ class Scheduler:
         scheduler_config: SchedulerConfig,
         cache_config: CacheConfig,
         structured_output_manager=None,
+        kv_connector=None,
     ) -> None:
         self.config = scheduler_config
         self.cache_config = cache_config
@@ -117,6 +118,9 @@ class Scheduler:
         )
         self.block_size = cache_config.block_size
         self.structured_output_manager = structured_output_manager
+        self.kv_connector = kv_connector
+        # (block_ids, keys) save records awaiting shipment to the runner.
+        self._pending_kv_saves: list[tuple] = []
 
         self.requests: dict[str, Request] = {}
         self.waiting = RequestQueue(scheduler_config.policy)
@@ -173,7 +177,36 @@ class Scheduler:
             finished.append(request)
         return finished
 
+    def take_pending_kv_saves(self) -> list[tuple]:
+        out = self._pending_kv_saves
+        self._pending_kv_saves = []
+        return out
+
     def _free_request(self, request: Request) -> None:
+        if (
+            self.kv_connector is not None
+            and request.block_hashes
+            and request.pooling_params is None
+        ):
+            block_ids = self.kv_cache_manager.get_block_ids(
+                request.request_id
+            )
+            # Only blocks whose KV was actually computed (an abort can
+            # leave allocated-but-unwritten blocks behind hashed slots).
+            confirmed_blocks = max(
+                0,
+                request.num_computed_tokens
+                - request.num_output_placeholders,
+            ) // self.block_size
+            idxs = self.kv_connector.request_finished(request.block_hashes)
+            save = [
+                (block_ids[i], request.block_hashes[i])
+                for i in idxs
+                if i < min(len(block_ids), confirmed_blocks)
+                and block_ids[i] != 0
+            ]
+            if save:
+                self._pending_kv_saves.extend(save)
         self.kv_cache_manager.free(request)
         self.finished_req_ids.add(request.request_id)
         del self.requests[request.request_id]
@@ -204,6 +237,7 @@ class Scheduler:
         # advances num_computed_tokens at schedule time, so phase 3 must use
         # these captured values, not the live counter.
         starts: dict[str, int] = {}
+        kv_connector_load: dict[str, tuple] = {}
 
         # In-jit multi-step decode: eligible only when EVERY live request
         # is a pure single-token decode with no feature that needs host
@@ -405,6 +439,28 @@ class Scheduler:
                 if request.num_computed_tokens == 0 and not is_mean_pooling
                 else ([], 0)
             )
+            # External KV tier: whole blocks beyond the device hit.
+            num_external_tokens = 0
+            if (
+                self.kv_connector is not None
+                and request.num_computed_tokens == 0
+                and request.block_hashes
+            ):
+                num_external_tokens = (
+                    self.kv_connector.get_num_new_matched_tokens(
+                        request.block_hashes, num_new_computed_tokens,
+                        self.block_size,
+                    )
+                )
+                # Leave at least one token to schedule.
+                cap = request.num_tokens - 1 - num_new_computed_tokens
+                num_external_tokens = max(
+                    0,
+                    min(num_external_tokens, cap)
+                    // self.block_size * self.block_size,
+                )
+                num_new_computed_tokens += num_external_tokens
+
             num_new_tokens = (
                 request.num_tokens
                 - request.num_computed_tokens
@@ -430,6 +486,26 @@ class Scheduler:
             )
             if new_blocks is None:
                 break  # out of KV space; don't preempt running for waiting
+
+            if num_external_tokens:
+                # The blocks covering the external span (right after the
+                # device-cache hit) must be filled by the runner before
+                # this step runs.
+                req_blocks = self.kv_cache_manager.req_to_blocks[
+                    request.request_id
+                ]
+                dev_blocks = (
+                    num_new_computed_tokens - num_external_tokens
+                ) // self.block_size
+                ext_blocks = num_external_tokens // self.block_size
+                load_ids = [
+                    b.block_id
+                    for b in req_blocks[dev_blocks : dev_blocks + ext_blocks]
+                ]
+                keys = list(
+                    request.block_hashes[dev_blocks : dev_blocks + ext_blocks]
+                )
+                kv_connector_load[request.request_id] = (load_ids, keys)
 
             self.waiting.popleft()
             resumed = request.status == RequestStatus.PREEMPTED
@@ -496,6 +572,7 @@ class Scheduler:
         total = sum(num_scheduled_tokens.values())
         output = SchedulerOutput(
             num_decode_steps=self._decode_k,
+            kv_connector_load=kv_connector_load,
             scheduled_new_reqs=scheduled_new_reqs,
             scheduled_cached_reqs=cached,
             num_scheduled_tokens=num_scheduled_tokens,

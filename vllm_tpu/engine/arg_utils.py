@@ -43,6 +43,8 @@ class EngineArgs:
     num_gpu_blocks_override: int | None = None
     enable_prefix_caching: bool = True
     kv_cache_dtype: str = "auto"
+    kv_connector: str | None = None
+    kv_connector_cache_gb: float = 4.0
 
     max_num_batched_tokens: int = 8192
     max_num_seqs: int = 256
@@ -96,6 +98,8 @@ class EngineArgs:
                 num_gpu_blocks_override=self.num_gpu_blocks_override,
                 enable_prefix_caching=self.enable_prefix_caching,
                 cache_dtype=self.kv_cache_dtype,
+                kv_connector=self.kv_connector,
+                kv_connector_cache_gb=self.kv_connector_cache_gb,
             ),
             parallel_config=ParallelConfig(
                 tensor_parallel_size=self.tensor_parallel_size,

@@ -51,11 +51,27 @@ class EngineCore:
             else 1
         )
 
+        from vllm_tpu.kv_connector import make_kv_connector
+
+        self.kv_connector = make_kv_connector(
+            config.cache_config.kv_connector,
+            config.cache_config.kv_connector_cache_gb,
+        )
+        if (
+            self.kv_connector is not None
+            and not config.cache_config.enable_prefix_caching
+        ):
+            logger.warning(
+                "kv connector disabled: requires prefix caching (content "
+                "hashes)"
+            )
+            self.kv_connector = None
         self.structured_output_manager = self._make_structured_output_manager()
         self.scheduler = scheduler_cls(
             config.scheduler_config,
             config.cache_config,
             structured_output_manager=self.structured_output_manager,
+            kv_connector=self.kv_connector,
         )
         # The runner gathers grammar bitmasks from a device-resident table
         # it syncs from the manager (in-proc share; becomes an RPC-shipped
@@ -63,6 +79,8 @@ class EngineCore:
         self.executor.collective_rpc(
             "set_structured_output_manager", self.structured_output_manager
         )
+        if self.kv_connector is not None:
+            self.executor.collective_rpc("set_kv_connector", self.kv_connector)
         self._block_hasher = (
             make_block_hasher(config.cache_config.block_size)
             if config.cache_config.enable_prefix_caching
@@ -112,6 +130,13 @@ class EngineCore:
         step overlaps the next step's compute (reference
         ``step_with_batch_queue`` core.py:443 + AsyncScheduler).
         """
+        if self.kv_connector is not None:
+            # Persist freed requests' blocks BEFORE any new scheduling can
+            # hand those blocks to someone else (in-flight steps were
+            # scheduled before the free, so the payload is still intact).
+            saves = self.scheduler.take_pending_kv_saves()
+            if saves:
+                self.executor.collective_rpc("kv_connector_save", saves)
         while (
             len(self._inflight) < self._max_inflight
             and self.scheduler.has_unfinished_requests()

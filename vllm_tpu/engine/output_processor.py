@@ -175,6 +175,8 @@ class OutputProcessor:
             )
             if out is not None and eco.pooled is not None:
                 out.pooled = eco.pooled
+            if out is not None and eco.num_cached_tokens:
+                out.num_cached_tokens = eco.num_cached_tokens
             if out is not None:
                 if state.queue is not None:
                     state.queue.put_nowait(out)

@@ -165,9 +165,14 @@ async def handle_chat_completions(request: web.Request) -> web.StreamResponse:
     tokenizer = engine.tokenizer
     if tokenizer is None:
         return _error(400, "server has no tokenizer; chat API unavailable")
+    if isinstance(req.tool_choice, dict):
+        return _error(
+            400, "forced tool_choice is not supported; use 'auto' or 'none'"
+        )
+    tools_active = bool(req.tools) and req.tool_choice != "none"
     try:
         template_kwargs = {}
-        if req.tools:
+        if tools_active:
             template_kwargs["tools"] = req.tools
         prompt_ids = tokenizer.apply_chat_template(
             req.messages,
@@ -191,6 +196,33 @@ async def handle_chat_completions(request: web.Request) -> web.StreamResponse:
         resp = _sse_response(request)
         await resp.prepare(request)
         first = True
+        reasoning_name = request.app.get(REASONING_PARSER_KEY)
+        tool_parser_name = request.app.get(TOOL_PARSER_KEY)
+        if reasoning_name is not None:
+            from vllm_tpu.parsers import get_reasoning_parser
+
+            reasoning = get_reasoning_parser(reasoning_name)
+        else:
+            reasoning = None
+        # With tools active the text must be parsed as a whole: buffer and
+        # emit the parsed message in one final chunk (parity with the
+        # non-streaming path beats streaming raw <tool_call> markers).
+        buffer_tools = tools_active and tool_parser_name is not None
+        buffered = ""
+
+        async def emit(delta: dict, finish: str | None) -> None:
+            await _sse_send(resp, {
+                "id": req_id,
+                "object": "chat.completion.chunk",
+                "created": now(),
+                "model": model,
+                "choices": [{
+                    "index": 0,
+                    "delta": delta,
+                    "finish_reason": finish,
+                }],
+            })
+
         try:
             async for out in engine.generate(prompt, params, req_id):
                 c = out.outputs[0]
@@ -198,20 +230,44 @@ async def handle_chat_completions(request: web.Request) -> web.StreamResponse:
                 if first:
                     delta["role"] = "assistant"
                     first = False
-                if c.text:
-                    delta["content"] = c.text
+                text = c.text or ""
+                if buffer_tools:
+                    buffered += text
+                elif reasoning is not None and text:
+                    chunk = reasoning.parse_delta(text)
+                    if chunk.reasoning_delta:
+                        delta["reasoning_content"] = chunk.reasoning_delta
+                    if chunk.content_delta:
+                        delta["content"] = chunk.content_delta
+                elif text:
+                    delta["content"] = text
+                finish = c.finish_reason if out.finished else None
+                if out.finished and buffer_tools:
+                    from vllm_tpu.parsers import (
+                        get_reasoning_parser,
+                        get_tool_parser,
+                    )
+
+                    content = buffered
+                    if reasoning_name:
+                        r, content = get_reasoning_parser(
+                            reasoning_name
+                        ).parse_full(content)
+                        if r:
+                            delta["reasoning_content"] = r
+                    parsed = get_tool_parser(tool_parser_name).parse(content)
+                    if parsed.tool_calls:
+                        finish = "tool_calls"
+                        delta["tool_calls"] = [
+                            {"index": i, **t.to_openai()}
+                            for i, t in enumerate(parsed.tool_calls)
+                        ]
+                        if parsed.content:
+                            delta["content"] = parsed.content
+                    elif content:
+                        delta["content"] = content
                 if delta or out.finished:
-                    await _sse_send(resp, {
-                        "id": req_id,
-                        "object": "chat.completion.chunk",
-                        "created": now(),
-                        "model": model,
-                        "choices": [{
-                            "index": 0,
-                            "delta": delta,
-                            "finish_reason": c.finish_reason if out.finished else None,
-                        }],
-                    })
+                    await emit(delta, finish)
         except (ConnectionResetError, asyncio.CancelledError):
             return resp
         except EngineDeadError as e:
@@ -247,7 +303,7 @@ async def handle_chat_completions(request: web.Request) -> web.StreamResponse:
             message["content"] = content or None
             if reasoning:
                 message["reasoning_content"] = reasoning
-        if req.tools and tool_parser_name:
+        if tools_active and tool_parser_name:
             from vllm_tpu.parsers import get_tool_parser
 
             parsed = get_tool_parser(tool_parser_name).parse(

@@ -1,0 +1,38 @@
+"""KV connectors: external KV cache stores (offload tiers, disaggregated
+prefill transfer).
+
+Reference analog: ``vllm/distributed/kv_transfer/kv_connector/v1/base.py``
+(KVConnectorBase_V1) — the same split of roles:
+
+- scheduler side: ``get_num_new_matched_tokens`` (how much of a new
+  request's prefix the external store can supply beyond the device prefix
+  cache) and ``request_finished`` (which blocks to persist);
+- worker side: ``load_blocks`` / ``save_blocks`` moving block payloads
+  between the device cache and the external medium.
+
+``host_offload`` ships in-tree: a content-addressed host-RAM tier that
+survives device prefix-cache eviction. Disaggregated prefill over DCN
+plugs into the same seam.
+"""
+
+from vllm_tpu.kv_connector.base import KVConnectorBase
+from vllm_tpu.kv_connector.host_offload import HostOffloadKVConnector
+
+_CONNECTORS = {
+    "host_offload": HostOffloadKVConnector,
+}
+
+
+def make_kv_connector(name: str | None, cache_gb: float = 4.0):
+    if name is None:
+        return None
+    try:
+        return _CONNECTORS[name](max_bytes=int(cache_gb * (1 << 30)))
+    except KeyError:
+        raise ValueError(
+            f"unknown kv connector {name!r}; available: "
+            f"{sorted(_CONNECTORS)}"
+        ) from None
+
+
+__all__ = ["KVConnectorBase", "HostOffloadKVConnector", "make_kv_connector"]

@@ -1,0 +1,41 @@
+"""KV connector interface (reference: KVConnectorBase_V1 roles,
+``kv_connector/v1/base.py:170`` — get_num_new_matched_tokens :450,
+build/save/load hooks :299-:506)."""
+
+from __future__ import annotations
+
+from typing import Any, Sequence
+
+
+class KVConnectorBase:
+    # ------------------------------------------------------------------
+    # Scheduler side
+    # ------------------------------------------------------------------
+
+    def get_num_new_matched_tokens(
+        self, block_hashes: Sequence[Any], num_device_computed_tokens: int,
+        block_size: int,
+    ) -> int:
+        """Tokens (whole blocks) the store can supply BEYOND the device
+        prefix-cache hit. Returns 0 when nothing extra is available."""
+        raise NotImplementedError
+
+    def request_finished(self, block_hashes: Sequence[Any]) -> list[int]:
+        """Hook at request free time. Returns the indices (into the
+        request's block list) whose payload should be persisted."""
+        raise NotImplementedError
+
+    # ------------------------------------------------------------------
+    # Worker side
+    # ------------------------------------------------------------------
+
+    def save_blocks(self, keys: Sequence[Any], payloads) -> None:
+        """Persist block payloads (host arrays) under content keys."""
+        raise NotImplementedError
+
+    def load_blocks(self, keys: Sequence[Any]):
+        """Payloads for keys (all must be present)."""
+        raise NotImplementedError
+
+    def stats(self) -> dict:
+        return {}

@@ -1,0 +1,86 @@
+"""Host-RAM KV offload tier.
+
+Reference analog: ``vllm/v1/kv_offload`` (CPU backend) +
+``kv_connector/v1/offloading_connector.py``. Finished requests' full KV
+blocks are persisted to host memory keyed by their content hash (the same
+chained blake2b hashes the device prefix cache uses), with LRU eviction
+under a byte budget. A new request whose prefix misses the device cache
+but hits the host store gets those blocks DMA'd back instead of
+recomputing the prefill.
+"""
+
+from __future__ import annotations
+
+from collections import OrderedDict
+from typing import Any, Sequence
+
+import numpy as np
+
+from vllm_tpu.kv_connector.base import KVConnectorBase
+from vllm_tpu.logger import init_logger
+
+logger = init_logger(__name__)
+
+
+class HostOffloadKVConnector(KVConnectorBase):
+    def __init__(self, max_bytes: int) -> None:
+        self.max_bytes = max_bytes
+        self._store: OrderedDict[Any, np.ndarray] = OrderedDict()
+        self._bytes = 0
+        self.hits = 0
+        self.queries = 0
+
+    # ------------------------------------------------------------------
+
+    def get_num_new_matched_tokens(
+        self, block_hashes: Sequence[Any], num_device_computed_tokens: int,
+        block_size: int,
+    ) -> int:
+        start = num_device_computed_tokens // block_size
+        n = 0
+        for h in list(block_hashes)[start:]:
+            if h not in self._store:
+                break
+            self._store.move_to_end(h)  # LRU touch
+            n += 1
+        self.queries += 1
+        if n:
+            self.hits += 1
+        return n * block_size
+
+    def request_finished(self, block_hashes: Sequence[Any]) -> list[int]:
+        # Persist every full (hashed) block not already stored.
+        return [
+            i for i, h in enumerate(block_hashes) if h not in self._store
+        ]
+
+    # ------------------------------------------------------------------
+
+    def save_blocks(self, keys: Sequence[Any], payloads) -> None:
+        for key, payload in zip(keys, payloads):
+            if key in self._store:
+                continue
+            # Own the memory: the caller may hand views into one big D2H
+            # batch, which would pin the whole batch past eviction.
+            arr = np.ascontiguousarray(payload)
+            self._store[key] = arr
+            self._bytes += arr.nbytes
+        while self._bytes > self.max_bytes and self._store:
+            _, evicted = self._store.popitem(last=False)
+            self._bytes -= evicted.nbytes
+
+    def load_blocks(self, keys: Sequence[Any]):
+        out = []
+        for key in keys:
+            arr = self._store[key]
+            self._store.move_to_end(key)
+            out.append(arr)
+        return out
+
+    def stats(self) -> dict:
+        return {
+            "blocks": len(self._store),
+            "bytes": self._bytes,
+            "queries": self.queries,
+            "hits": self.hits,
+        }

@@ -146,6 +146,11 @@ class ModelRunner:
             self.draft_model = draft_model
             self.draft_params = draft_params
 
+        self.kv_connector = None
+        self._kv_load_fn = jax.jit(
+            lambda kv, ids, vals: kv.at[:, ids].set(vals),
+            donate_argnums=(0,),
+        )
         self.lora_manager = None
         if config.lora_config.enable_lora:
             from vllm_tpu.lora.manager import LoRAManager
@@ -818,6 +823,44 @@ class ModelRunner:
         arrays = (jnp.asarray(ibuf), jnp.asarray(fbuf), counts, prompt_mask)
         return arrays, req_order, do_sample[:r_live], dims | flags
 
+    def kv_connector_save(self, entries: list[tuple]) -> None:
+        """Persist (block_id, key) payloads to the external store. Runs
+        before any scheduling that could hand the freed blocks to another
+        request, so the pre-extraction content is intact (in-flight steps
+        never touch freed blocks)."""
+        assert self.kv_connector is not None
+        ids = jnp.asarray([bid for bid, _ in entries], jnp.int32)
+        payloads = np.asarray(jax.device_get(self.kv_cache[:, ids]))
+        # [L, N, BS, rows, lanes] -> per-block [L, BS, rows, lanes]
+        self.kv_connector.save_blocks(
+            [key for _, key in entries],
+            [payloads[:, i] for i in range(payloads.shape[1])],
+        )
+
+    def _kv_connector_loads(self, load_map: dict) -> None:
+        """Fill freshly allocated blocks from the external store before
+        the step that reads them enqueues. Block counts pad to power-of-2
+        buckets (padding scatters zeros into the write-only null block 0)
+        so the jitted scatter compiles a bounded set of variants."""
+        assert self.kv_connector is not None
+        for rid, (block_ids, keys) in load_map.items():
+            arrs = self.kv_connector.load_blocks(keys)
+            vals = np.stack(arrs, axis=1)  # [L, N, BS, ...]
+            n = vals.shape[1]
+            n_pad = 1 << (n - 1).bit_length()
+            ids = np.zeros(n_pad, np.int32)
+            ids[:n] = block_ids
+            if n_pad != n:
+                pad = np.zeros(
+                    vals.shape[:1] + (n_pad - n,) + vals.shape[2:],
+                    vals.dtype,
+                )
+                vals = np.concatenate([vals, pad], axis=1)
+            self.kv_cache = self._kv_load_fn(
+                self.kv_cache, jnp.asarray(ids),
+                jnp.asarray(vals).astype(self.kv_cache.dtype),
+            )
+
     def _single_pos_metadata(self, md, p, r_pad):
         """Per-row single-position AttentionMetadata (decode chain /
         EAGLE chain): query at position p[row], same block tables."""
@@ -947,6 +990,8 @@ class ModelRunner:
         self._update_states(so)
         if so.total_num_scheduled_tokens == 0:
             return StepHandle(empty=True)
+        if so.kv_connector_load:
+            self._kv_connector_loads(so.kv_connector_load)
         arrays, req_order, do_sample, flags = self._prepare_inputs(so)
         mask_table = None
         if flags["needs_grammar"]:

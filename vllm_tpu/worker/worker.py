@@ -314,6 +314,14 @@ class Worker:
         assert self.runner is not None
         self.runner.update_weights(path)
 
+    def set_kv_connector(self, connector) -> None:
+        assert self.runner is not None
+        self.runner.kv_connector = connector
+
+    def kv_connector_save(self, entries: list[tuple]) -> None:
+        assert self.runner is not None
+        self.runner.kv_connector_save(entries)
+
     def add_lora(self, name: str, path: str) -> bool:
         assert self.runner is not None and self.runner.lora_manager is not None, (
             "LoRA serving requires enable_lora=True"
