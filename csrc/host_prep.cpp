@@ -1,0 +1,77 @@
+// Native host-side step-input assembly.
+//
+// Reference analog: the role csrc/ plays for the reference's runtime —
+// host-native code where Python costs real latency. The TPU host has one
+// core driving every chip; the per-step ragged-batch assembly
+// (ModelRunner._prepare_inputs) is its hot loop. This implements the
+// per-row fill — token copy, positions, paged slot mapping, block tables,
+// ragged offsets — over raw numpy buffers, called via ctypes (no pybind11
+// in the image; plain C ABI).
+//
+// Build: vllm_tpu/native compiles this with `g++ -O3 -shared -fPIC` into
+// a cached shared object on first use.
+
+#include <cstdint>
+#include <cstring>
+
+extern "C" {
+
+// All output buffers are pre-zeroed by the caller and sized to the padded
+// bucket; the fill touches only live entries. `bt_src_stride` /
+// `tok_src_stride` are ELEMENT strides of the persistent batch's 2-D
+// arrays. Returns the total number of live tokens written.
+int32_t fill_step_inputs(
+    // persistent batch state
+    const int32_t* batch_tokens, int64_t tok_src_stride,
+    const int32_t* batch_block_table, int64_t bt_src_stride,
+    const int32_t* batch_num_blocks,
+    // per-scheduled-row triples
+    const int32_t* rows, const int32_t* starts, const int32_t* counts,
+    const int32_t* known_tokens,
+    int32_t n_rows, int32_t block_size, int32_t bt_dst_width,
+    // outputs
+    int32_t* token_ids, int32_t* positions, int32_t* slot_mapping,
+    int32_t* token_req_idx, int32_t* seq_lens, int32_t* query_start_loc,
+    int32_t* logits_indices, uint8_t* do_sample, int32_t* block_tables_out,
+    int32_t* lora_slots_out /* nullable */, const int32_t* batch_lora_slot) {
+  int32_t offset = 0;
+  for (int32_t i = 0; i < n_rows; ++i) {
+    const int32_t row = rows[i];
+    const int32_t start = starts[i];
+    const int32_t n = counts[i];
+    const int32_t known = known_tokens[row];
+    const int32_t* tok_src = batch_tokens + (int64_t)row * tok_src_stride;
+    const int32_t* bt_row =
+        batch_block_table + (int64_t)row * bt_src_stride;
+
+    // Token copy (feedback rows read past `known`; the device overwrites
+    // the fed position, so copying the stale value is harmless).
+    std::memcpy(token_ids + offset, tok_src + start,
+                (size_t)n * sizeof(int32_t));
+
+    for (int32_t j = 0; j < n; ++j) {
+      const int32_t pos = start + j;
+      positions[offset + j] = pos;
+      slot_mapping[offset + j] =
+          bt_row[pos / block_size] * block_size + pos % block_size;
+      token_req_idx[offset + j] = i;
+    }
+    if (lora_slots_out != nullptr) {
+      const int32_t slot = batch_lora_slot[row];
+      for (int32_t j = 0; j < n; ++j) lora_slots_out[offset + j] = slot;
+    }
+
+    seq_lens[i] = start + n;
+    query_start_loc[i + 1] = offset + n;
+    logits_indices[i] = offset + n - 1;
+    do_sample[i] = (start + n >= known) ? 1 : 0;
+
+    const int32_t nb = batch_num_blocks[row];
+    std::memcpy(block_tables_out + (int64_t)i * bt_dst_width, bt_row,
+                (size_t)nb * sizeof(int32_t));
+    offset += n;
+  }
+  return offset;
+}
+
+}  // extern "C"

@@ -1,0 +1,94 @@
+"""Native (C++) runtime components, compiled on demand.
+
+Reference analog: the reference builds ``csrc/`` into torch extensions at
+install time; here the host-side pieces compile with the system toolchain
+into a cached shared object on first use (no pybind11 — plain C ABI via
+ctypes). Device code stays Pallas/XLA by design (SURVEY §7).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import hashlib
+import os
+import subprocess
+import tempfile
+
+from vllm_tpu.logger import init_logger
+
+logger = init_logger(__name__)
+
+_LIB = None
+_TRIED = False
+
+
+def _source_path() -> str:
+    return os.path.join(
+        os.path.dirname(os.path.dirname(os.path.dirname(__file__))),
+        "csrc", "host_prep.cpp",
+    )
+
+
+def _build(src: str) -> str:
+    with open(src, "rb") as f:
+        digest = hashlib.sha256(f.read()).hexdigest()[:16]
+    cache_dir = os.path.join(
+        tempfile.gettempdir(), f"vllm-tpu-native-{os.getuid()}"
+    )
+    os.makedirs(cache_dir, exist_ok=True)
+    out = os.path.join(cache_dir, f"host_prep-{digest}.so")
+    if not os.path.exists(out):
+        # Unique temp name: concurrent cold-cache builders must not write
+        # the same file (os.replace stays atomic either way).
+        tmp = f"{out}.build.{os.getpid()}"
+        subprocess.run(
+            ["g++", "-O3", "-shared", "-fPIC", "-o", tmp, src],
+            check=True, capture_output=True,
+        )
+        os.replace(tmp, out)
+        logger.info("built native host_prep -> %s", out)
+    return out
+
+
+def get_host_prep():
+    """The ctypes handle to fill_step_inputs, or None when the toolchain
+    is unavailable (pure-Python fallback stays correct)."""
+    global _LIB, _TRIED
+    if _LIB is not None or _TRIED:
+        return _LIB
+    _TRIED = True
+    try:
+        lib = ctypes.CDLL(_build(_source_path()))
+    except Exception as e:  # no g++ / sandbox / missing source
+        logger.warning("native host_prep unavailable (%s); using python", e)
+        return None
+    i32p = ctypes.POINTER(ctypes.c_int32)
+    u8p = ctypes.POINTER(ctypes.c_uint8)
+    lib.fill_step_inputs.restype = ctypes.c_int32
+    lib.fill_step_inputs.argtypes = [
+        i32p, ctypes.c_int64,  # batch tokens + stride
+        i32p, ctypes.c_int64,  # batch block table + stride
+        i32p,                  # batch num_blocks
+        i32p, i32p, i32p, i32p,  # rows, starts, counts, known
+        ctypes.c_int32, ctypes.c_int32, ctypes.c_int32,
+        i32p, i32p, i32p, i32p, i32p, i32p, i32p, u8p, i32p,
+        i32p, i32p,            # lora out (nullable), batch lora slots
+    ]
+    _LIB = lib
+    return _LIB
+
+
+def ptr(arr):
+    import numpy as np
+
+    assert arr.dtype == np.int32 and arr.flags.c_contiguous, (
+        arr.dtype, arr.flags.c_contiguous,
+    )
+    return arr.ctypes.data_as(ctypes.POINTER(ctypes.c_int32))
+
+
+def ptr_u8(arr):
+    import numpy as np
+
+    assert arr.dtype == np.uint8 and arr.flags.c_contiguous
+    return arr.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8))

@@ -199,6 +199,12 @@ class ModelRunner:
 
         self._timing_enabled = envs.VLLM_TPU_STEP_TIMING
         self._nan_check = envs.VLLM_TPU_NAN_CHECK
+        # Native (C++) step-input assembly; None -> python loop.
+        self._native_prep = None
+        if not envs.VLLM_TPU_DISABLE_NATIVE_PREP:
+            from vllm_tpu.native import get_host_prep
+
+            self._native_prep = get_host_prep()
         self.timing = {"prep_s": 0.0, "dispatch_s": 0.0, "wait_s": 0.0,
                        "steps": 0}
 
@@ -682,7 +688,54 @@ class ModelRunner:
         bs = self.block_size
         offset = 0
         pending_rows: list[int] = []
-        for i, row in enumerate(rows):
+        use_native = self._native_prep is not None and not s
+        if use_native:
+            from vllm_tpu.native import ptr, ptr_u8
+
+            rows_np = np.asarray(rows, np.int32)
+            starts_np = batch.num_computed_tokens[rows_np]  # owned copy
+            counts_np = np.asarray(
+                [num_sched[rid] for rid in req_order], np.int32
+            )
+            ds_u8 = np.zeros(r_pad, np.uint8)
+            lora_ptr = (
+                ptr(token_lora) if self.lora_manager is not None else None
+            )
+            offset = int(self._native_prep.fill_step_inputs(
+                ptr(batch.token_ids), batch.token_ids.shape[1],
+                ptr(batch.block_table), batch.block_table.shape[1],
+                ptr(batch.num_blocks),
+                ptr(rows_np), ptr(starts_np), ptr(counts_np),
+                ptr(batch.num_tokens),
+                np.int32(r_live), np.int32(bs), np.int32(b),
+                ptr(token_ids), ptr(positions), ptr(slot_mapping),
+                ptr(token_req_idx), ptr(seq_lens), ptr(query_start_loc),
+                ptr(logits_indices), ptr_u8(ds_u8), ptr(block_tables),
+                lora_ptr, ptr(batch.lora_slot),
+            ))
+            do_sample[:r_live] = ds_u8[:r_live].astype(bool)
+            # Rows whose latest tokens are still in flight (device-side
+            # feedback) — the native fill copied stale values there, which
+            # the jitted step overwrites.
+            ends = starts_np + counts_np
+            known_live = batch.num_tokens[rows_np]
+            for i in np.nonzero(ends > known_live)[0]:
+                rid = req_order[i]
+                lag = int(ends[i] - known_live[i])
+                prev_row = self._prev_rows.get(rid, -1)
+                max_lag = self._max_pipeline_depth * max(
+                    1, self.config.scheduler_config.num_decode_steps
+                )
+                assert lag <= max_lag and prev_row >= 0, (
+                    rid, lag, prev_row)
+                feedback[i] = prev_row
+                pending_rows.append((int(i), lag))
+            if self.draft_model is not None:
+                for i in np.nonzero(~do_sample[:r_live])[0]:
+                    row = rows[i]
+                    end = int(ends[i])
+                    draft_next[i] = batch.token_ids[row, end]
+        for i, row in enumerate(rows) if not use_native else ():
             rid = req_order[i]
             n = num_sched[rid]
             start = int(batch.num_computed_tokens[row])
