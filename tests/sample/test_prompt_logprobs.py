@@ -1,0 +1,71 @@
+"""Prompt logprobs vs HF full-context log-softmax, including chunked
+prefill assembly (reference: prompt_logprobs protocol)."""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from tests.models.utils import tiny_llama_dir
+from vllm_tpu import LLM, SamplingParams
+
+
+@pytest.fixture(scope="module")
+def ckpt(tmp_path_factory):
+    return tiny_llama_dir(tmp_path_factory.mktemp("tiny_llama_plp"))
+
+
+def hf_prompt_logprobs(ckpt, ids):
+    import torch
+    from transformers import AutoModelForCausalLM
+
+    model = AutoModelForCausalLM.from_pretrained(
+        ckpt, torch_dtype=torch.float32
+    )
+    model.eval()
+    with torch.no_grad():
+        logits = model(torch.tensor([ids])).logits[0]
+    lp = torch.log_softmax(logits, dim=-1)
+    # Position i's token logprob comes from logits at i-1.
+    return [float(lp[i - 1, ids[i]]) for i in range(1, len(ids))]
+
+
+@pytest.mark.parametrize("budget", [128, 16])  # 16 forces chunked prefill
+def test_prompt_logprobs_match_hf(ckpt, budget):
+    rng = np.random.default_rng(0)
+    ids = rng.integers(5, 120, size=23).tolist()
+    want = hf_prompt_logprobs(ckpt, ids)
+
+    llm = LLM(
+        model=ckpt, dtype="float32", max_model_len=128, block_size=16,
+        num_gpu_blocks_override=64, max_num_seqs=4,
+        max_num_batched_tokens=budget,
+    )
+    out = llm.generate(
+        [{"prompt_token_ids": ids}],
+        SamplingParams(temperature=0.0, max_tokens=2, prompt_logprobs=3,
+                       ignore_eos=True),
+    )[0]
+    plp = out.prompt_logprobs
+    assert plp is not None
+    assert plp[0] is None  # no predictor for position 0
+    assert len(plp) == len(ids)
+    got = [plp[i][ids[i]].logprob for i in range(1, len(ids))]
+    np.testing.assert_allclose(got, want, rtol=2e-3, atol=2e-3)
+    # top-k entries are sorted best-first and include ranks.
+    top = plp[1]
+    ranked = sorted(top.values(), key=lambda x: x.rank)
+    assert ranked[0].rank == 1
+
+
+def test_prompt_logprobs_off_by_default(ckpt):
+    llm = LLM(
+        model=ckpt, dtype="float32", max_model_len=128, block_size=16,
+        num_gpu_blocks_override=64, max_num_seqs=4,
+        max_num_batched_tokens=128,
+    )
+    out = llm.generate(
+        [{"prompt_token_ids": [5, 9, 11]}],
+        SamplingParams(temperature=0.0, max_tokens=2, ignore_eos=True),
+    )[0]
+    assert out.prompt_logprobs is None

@@ -116,6 +116,9 @@ class EngineCoreOutput:
     events: list[Any] | None = None
     # Pooling/embedding result (final chunk of a pooling request).
     pooled: list[float] | None = None
+    # Prompt logprobs covered by this step's chunk:
+    # (chunk_start, [(topk_ids, topk_vals, token, token_lp, rank), ...]).
+    prompt_logprobs_delta: Any = None
 
 
 @dataclass

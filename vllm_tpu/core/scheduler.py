@@ -280,7 +280,8 @@ class Scheduler:
         # point — schedule time — rather than trusting the runner's
         # finalize-time view, which races with request admission.
         if any(r.spec_token_ids for r in self.running) and any(
-            r.sampling_params.logprobs is not None
+            r.sampling_params.prompt_logprobs is not None
+            or r.sampling_params.logprobs is not None
             or r.use_structured_output
             or r.pooling_params is not None
             or _needs_logits_processors(r.sampling_params)
@@ -705,6 +706,8 @@ class Scheduler:
             if req_id in runner_output.draft_token_ids:
                 request.spec_token_ids = runner_output.draft_token_ids[req_id]
 
+            prompt_lp_delta = runner_output.prompt_logprobs.get(req_id)
+
             if stopped:
                 # Async scheduling: the request may have been preempted
                 # between this step's dispatch and now (it sits in waiting).
@@ -714,7 +717,7 @@ class Scheduler:
                     self.waiting.remove(request)
                 self._free_request(request)
 
-            if new_token_ids or stopped:
+            if new_token_ids or stopped or prompt_lp_delta is not None:
                 new_logprobs = None
                 lp = runner_output.logprobs
                 if (
@@ -742,6 +745,7 @@ class Scheduler:
                         finish_reason=request.get_finished_reason(),
                         stop_reason=request.stop_reason,
                         new_logprobs=new_logprobs,
+                        prompt_logprobs_delta=prompt_lp_delta,
                         num_cached_tokens=max(request.num_cached_tokens, 0),
                     )
                 )

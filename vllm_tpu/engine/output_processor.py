@@ -43,6 +43,11 @@ class RequestState:
         self.metrics = RequestMetrics(arrival_time=arrival_time)
         self.last_token_time = arrival_time
         self.logprobs: list[dict[int, Logprob]] = []
+        # Prompt logprobs: None for position 0, then one dict per prompt
+        # token (assembled across prefill chunks).
+        self.prompt_logprobs: list | None = (
+            [None] if params.prompt_logprobs is not None else None
+        )
         self.num_sent_chars = 0
         self.queue = queue  # per-request asyncio queue (streaming mode)
 
@@ -79,6 +84,7 @@ class RequestState:
             prompt_token_ids=self.prompt_token_ids,
             outputs=[completion],
             finished=finished,
+            prompt_logprobs=self.prompt_logprobs,
             metrics=self.metrics,
         )
 
@@ -161,6 +167,11 @@ class OutputProcessor:
 
             if eco.new_logprobs is not None:
                 self._append_logprobs(state, eco)
+            if (
+                eco.prompt_logprobs_delta is not None
+                and state.prompt_logprobs is not None
+            ):
+                self._append_prompt_logprobs(state, eco.prompt_logprobs_delta)
 
             if finish_reason is not None:
                 state.metrics.finished_time = now
@@ -183,6 +194,25 @@ class OutputProcessor:
                 else:
                     result.request_outputs.append(out)
         return result
+
+    def _append_prompt_logprobs(self, state: RequestState, delta) -> None:
+        """delta = (chunk_start, entries); entries cover prompt tokens
+        chunk_start+1 .. chunk_start+len (position 0 has no predictor)."""
+        _chunk_start, entries = delta
+        for entry in entries:
+            topk_ids, topk_vals, tok, tok_lp, tok_rank = entry
+            d: dict[int, Logprob] = {}
+            k = state.params.prompt_logprobs or 0
+            for rank, (tid, lp) in enumerate(zip(topk_ids[:k], topk_vals[:k])):
+                d[int(tid)] = Logprob(logprob=float(lp), rank=rank + 1)
+            if tok not in d:
+                d[int(tok)] = Logprob(
+                    logprob=float(tok_lp), rank=int(tok_rank) + 1
+                )
+            if self.tokenizer is not None and state.params.detokenize:
+                for tid, lp in d.items():
+                    lp.decoded_token = self.tokenizer.decode([tid])
+            state.prompt_logprobs.append(d)
 
     def _append_logprobs(self, state: RequestState, eco: EngineCoreOutput) -> None:
         """eco.new_logprobs: one (topk_ids, topk_vals, sampled_token_id,

@@ -51,6 +51,8 @@ class StepHandle:
         self.drafts = None  # EAGLE proposals [R, K] (device array)
         self.pooled = None  # (last [R, D], mean [R, D]) pooling outputs
         self.nan_count = None  # device scalar when VLLM_TPU_NAN_CHECK
+        self.prompt_lp = None  # (vals, ids, tok_lp, rank) over [T]
+        self.prompt_rows = None  # [(row_i, offset, start, n, prompt_len)]
 
 
 def _bucket(value: int, buckets: list[int]) -> int:
@@ -186,6 +188,7 @@ class ModelRunner:
                 "needs_grammar",
                 "needs_pooling",
                 "num_logprobs",
+                "num_prompt_logprobs",
                 "num_spec",
                 "num_adj",
                 "num_allow",
@@ -213,7 +216,7 @@ class ModelRunner:
     # ------------------------------------------------------------------
 
     def _unpack(self, ibuf, fbuf, counts, prompt_mask, t, r, b, num_spec=0,
-                num_adj=0, num_allow=0):
+                num_adj=0, num_allow=0, num_prompt_logprobs=0):
         """Split the two packed host buffers back into metadata pytrees.
 
         One contiguous i32 upload + one f32 upload per step instead of ~12
@@ -265,6 +268,9 @@ class ModelRunner:
         draft_next = take(r) if self.draft_model is not None else None
         # LoRA: adapter slot per token (0 = none).
         token_lora = take(t) if self.lora_manager is not None else None
+        # Prompt logprobs: the TRUE successor token per position (a
+        # chunk's last position's successor is not in this buffer).
+        plp_next = take(t) if num_prompt_logprobs else None
         spec = None
         if s > 0:
             spec = dict(
@@ -291,7 +297,7 @@ class ModelRunner:
         )
         logit_adjust = (adj_ids, adj_vals, allow_ids, allow_active)
         return (token_ids, md, sampling, feedback, grammar_rows, logit_adjust,
-                draft_next, token_lora, spec)
+                draft_next, token_lora, plp_next, spec)
 
     def _step(
         self,
@@ -315,15 +321,16 @@ class ModelRunner:
         needs_grammar: bool,
         needs_pooling: bool = False,
         num_logprobs: int = 0,
+        num_prompt_logprobs: int = 0,
         num_spec: int = 0,
         num_adj: int = 0,
         num_allow: int = 0,
         num_decode_steps: int = 1,
     ):
         (token_ids, md, sampling, feedback, grammar_rows, logit_adjust,
-         draft_next, token_lora, spec) = self._unpack(
+         draft_next, token_lora, plp_next, spec) = self._unpack(
             ibuf, fbuf, counts, prompt_mask, t_pad, r_pad, b_pad, num_spec,
-            num_adj, num_allow,
+            num_adj, num_allow, num_prompt_logprobs,
         )
         # Device-side token feedback (async scheduling): a decode row whose
         # input token was sampled by the still-in-flight previous step reads
@@ -380,10 +387,27 @@ class ModelRunner:
                     emitted, draft_next, r_pad,
                 )
             return (kv_cache, draft_kv, (out_tokens, num_out), None, drafts,
-                    None, spec_nan)
+                    None, spec_nan, None)
         last = hidden[md.logits_indices]  # [R, D]
         nan_count = None
         pooled = None
+        prompt_lp = None
+        if num_prompt_logprobs > 0:
+            # Per-POSITION next-token logprobs over the whole chunk: the
+            # [T, V] logits matmul is the inherent cost of the feature.
+            full_lp = jax.nn.log_softmax(
+                self.model.compute_logits(params, hidden), axis=-1
+            )  # [T, V]
+            pk_vals, pk_ids = jax.lax.top_k(full_lp, num_prompt_logprobs)
+            # True successor per position, shipped from the host (a
+            # chunk's last position's successor is not in this buffer).
+            tok_lp = jnp.take_along_axis(
+                full_lp, plp_next[:, None], axis=-1
+            )[:, 0]
+            tok_rank = jnp.sum(
+                full_lp > tok_lp[:, None], axis=-1
+            ).astype(jnp.int32)
+            prompt_lp = (pk_vals, pk_ids, tok_lp, tok_rank)
         if needs_pooling:
             # "last" pooling = the gathered last-token hidden; "mean" is a
             # masked segment mean (live tokens only; single-chunk prompts,
@@ -496,7 +520,8 @@ class ModelRunner:
             lp = (topk_vals, topk_ids, sampled_lp, sampled_rank)
         else:
             lp = None
-        return kv_cache, draft_kv, sampled, lp, drafts, pooled, nan_count
+        return (kv_cache, draft_kv, sampled, lp, drafts, pooled, nan_count,
+                prompt_lp)
 
     def _eagle_drafts(self, params, draft_kv, token_ids, hidden, md,
                       anchor, emitted, draft_next, r_pad):
@@ -632,13 +657,32 @@ class ModelRunner:
         lp_len = r * num_adj + (r * num_allow + r if num_allow else 0)
         eagle_len = r if self.draft_model is not None else 0
         lora_len = t if self.lora_manager is not None else 0
+        # Prompt logprobs: rows whose chunk covers prompt-token positions
+        # (offsets derivable pre-fill from the running count sum).
+        num_prompt_lp = 0
+        prompt_rows: list[tuple] = []
+        if not s:
+            run_off = 0
+            for i, row in enumerate(rows):
+                state = batch.req_states[req_order[i]]
+                n = num_sched[req_order[i]]
+                k = state.sampling_params.prompt_logprobs or 0
+                if k:
+                    start = int(batch.num_computed_tokens[row])
+                    prompt_len = state.num_tokens - state.generated
+                    count = max(0, min(start + n, prompt_len - 1) - start)
+                    if count:
+                        num_prompt_lp = max(num_prompt_lp, k)
+                        prompt_rows.append((i, row, run_off, start, count, k))
+                run_off += n
+        plp_len = t if num_prompt_lp else 0
         # seq_lens(r) + qsl(r+1) + logits_idx(r) + num_seqs(1) + bt(r*b)
         # + top_k(r) + prng(2r) + feedback(r) + grammar_rows(r)
         # [+ adj_ids(r*num_adj)] [+ allow_ids(r*num_allow) + allow_flag(r)]
         # [+ num_draft(r) + draft(r*s) + sample_pos(r*(s+1))]
         ibuf = np.zeros(
             4 * t + 7 * r + (r + 1) + 1 + r * b + lp_len + eagle_len
-            + lora_len + spec_len,
+            + lora_len + plp_len + spec_len,
             np.int32,
         )
         token_ids = ibuf[0:t]
@@ -678,6 +722,12 @@ class ModelRunner:
             draft_next[:] = -1
         if self.lora_manager is not None:
             token_lora = ibuf[o : o + t]; o += t
+        if num_prompt_lp:
+            plp_next = ibuf[o : o + t]; o += t
+            for (i, row, off, start, count, k) in prompt_rows:
+                plp_next[off : off + count] = batch.token_ids[
+                    row, start + 1 : start + 1 + count
+                ]
         if s:
             num_draft = ibuf[o : o + r]; o += r
             draft_ids = ibuf[o : o + r * s].reshape(r, s); o += r * s
@@ -868,13 +918,15 @@ class ModelRunner:
                 for rid in req_order
             ),
             num_logprobs=num_logprobs,
+            num_prompt_logprobs=num_prompt_lp,
             num_spec=s,
             num_adj=num_adj,
             num_allow=num_allow,
             num_decode_steps=so.num_decode_steps,
         )
         arrays = (jnp.asarray(ibuf), jnp.asarray(fbuf), counts, prompt_mask)
-        return arrays, req_order, do_sample[:r_live], dims | flags
+        return (arrays, req_order, do_sample[:r_live], dims | flags,
+                prompt_rows)
 
     def kv_connector_save(self, entries: list[tuple]) -> None:
         """Persist (block_id, key) payloads to the external store. Runs
@@ -1045,7 +1097,8 @@ class ModelRunner:
             return StepHandle(empty=True)
         if so.kv_connector_load:
             self._kv_connector_loads(so.kv_connector_load)
-        arrays, req_order, do_sample, flags = self._prepare_inputs(so)
+        (arrays, req_order, do_sample, flags,
+         prompt_rows) = self._prepare_inputs(so)
         mask_table = None
         if flags["needs_grammar"]:
             self._sync_grammar_table()
@@ -1055,7 +1108,7 @@ class ModelRunner:
             self.timing["prep_s"] += t1 - t0
         prev = self._last_sampled if self._last_sampled is not None else self._zero_sampled
         (self.kv_cache, self.draft_kv, sampled, lp, drafts, pooled,
-         nan_count) = self._step_fn(
+         nan_count, prompt_lp) = self._step_fn(
             self.params, self.kv_cache, self.draft_kv, *arrays, prev,
             mask_table, **flags,
         )
@@ -1086,6 +1139,9 @@ class ModelRunner:
         if pooled is not None:
             for x in pooled:
                 x.copy_to_host_async()
+        if prompt_lp is not None:
+            for x in prompt_lp:
+                x.copy_to_host_async()
         handle = StepHandle(
             req_order=req_order, do_sample=do_sample, sampled=sampled, lp=lp,
             row_states=[self.input_batch.req_states[r] for r in req_order],
@@ -1094,6 +1150,10 @@ class ModelRunner:
         handle.drafts = drafts
         handle.pooled = pooled
         handle.nan_count = nan_count
+        handle.prompt_lp = prompt_lp
+        handle.prompt_rows = (
+            prompt_rows if flags["num_prompt_logprobs"] else None
+        )
         return handle
 
     def finalize(self, handle: "StepHandle") -> ModelRunnerOutput:
@@ -1116,6 +1176,10 @@ class ModelRunner:
             if handle.drafts is not None
             else None
         )
+        if handle.prompt_lp is not None and handle.prompt_rows:
+            pk_vals, pk_ids, tok_lp, tok_rank = (
+                np.asarray(jax.device_get(x)) for x in handle.prompt_lp
+            )
         pooled_np = (
             tuple(np.asarray(jax.device_get(x)) for x in handle.pooled)
             if handle.pooled is not None
@@ -1132,6 +1196,23 @@ class ModelRunner:
                 )
 
         out = ModelRunnerOutput(req_ids=req_order)
+        if handle.prompt_lp is not None and handle.prompt_rows:
+            for (i, row, off, start, count, k) in handle.prompt_rows:
+                rid = req_order[i]
+                if self.input_batch.req_states.get(rid) is not handle.row_states[i]:
+                    continue
+                entries = []
+                for j in range(count):
+                    p = off + j
+                    tok = int(self.input_batch.token_ids[row, start + 1 + j])
+                    entries.append((
+                        [int(x) for x in pk_ids[p, :k]],
+                        [float(x) for x in pk_vals[p, :k]],
+                        tok,
+                        float(tok_lp[p]),
+                        int(tok_rank[p]),
+                    ))
+                out.prompt_logprobs[rid] = (start, entries)
         # Logprobs aren't emitted on draft-carrying steps (the scheduler's
         # per-token logprob contract is single-token), and a spec step
         # disables logprobs for the WHOLE batch — so drafting is suppressed
