@@ -1,0 +1,171 @@
+"""Data-parallel engine topology: coordinator, LB client, wave lockstep.
+
+Reference analog: ``vllm/v1/distributed/test_internal_lb_dp.py`` semantics
+(DP engines on one host, least-loaded routing) scaled to the CPU test rig.
+"""
+
+from __future__ import annotations
+
+import time
+
+import numpy as np
+import pytest
+
+from tests.models.utils import tiny_llama_dir
+from vllm_tpu import LLM, SamplingParams
+
+
+@pytest.fixture(scope="module")
+def ckpt(tmp_path_factory):
+    return tiny_llama_dir(tmp_path_factory.mktemp("tiny_llama_dp"))
+
+
+def _llm(ckpt, **kw):
+    return LLM(
+        model=ckpt, dtype="float32", max_model_len=128, block_size=16,
+        num_gpu_blocks_override=64, max_num_seqs=4,
+        max_num_batched_tokens=128, **kw,
+    )
+
+
+def test_dp_generate_matches_single_engine(ckpt):
+    rng = np.random.default_rng(0)
+    prompts = [
+        {"prompt_token_ids": rng.integers(5, 120, size=n).tolist()}
+        for n in (7, 13, 3, 9, 5, 11)
+    ]
+    sp = SamplingParams(temperature=0.0, max_tokens=8, ignore_eos=True)
+    ref_llm = _llm(ckpt)
+    ref = [o.outputs[0].token_ids for o in ref_llm.generate(prompts, sp)]
+    ref_llm.llm_engine.shutdown()
+
+    llm = _llm(ckpt, data_parallel_engines=2)
+    try:
+        client = llm.llm_engine.engine_core
+        from vllm_tpu.engine.core_client import DPLBClient
+
+        assert isinstance(client, DPLBClient)
+        got = [o.outputs[0].token_ids for o in llm.generate(prompts, sp)]
+        assert got == ref
+        # Utility broadcast reaches every engine.
+        assert llm.sleep(1)
+        assert llm.wake_up()
+        again = [o.outputs[0].token_ids for o in llm.generate(prompts, sp)]
+        assert again == ref
+    finally:
+        llm.llm_engine.shutdown()
+
+
+def test_dp_routing_spreads_load(ckpt):
+    """Both engines receive requests when many arrive at once."""
+    llm = _llm(ckpt, data_parallel_engines=2)
+    try:
+        client = llm.llm_engine.engine_core
+        seen: set[int] = set()
+        orig_add = client.add_request
+
+        def spy(req):
+            orig_add(req)
+            seen.add(client._live[req.request_id])
+
+        client.add_request = spy
+        prompts = [{"prompt_token_ids": [5, 9, 11, 3]} for _ in range(8)]
+        sp = SamplingParams(temperature=0.0, max_tokens=4, ignore_eos=True)
+        llm.generate(prompts, sp)
+        assert seen == {0, 1}
+    finally:
+        llm.llm_engine.shutdown()
+
+
+def test_coordinator_wave_tracking():
+    """Coordinator counts waves and publishes load snapshots."""
+    import multiprocessing
+    import tempfile
+    import uuid
+
+    import zmq
+
+    from vllm_tpu.engine import coordinator, serial_utils
+
+    run_dir = tempfile.mkdtemp(prefix="coord-test-")
+    suffix = uuid.uuid4().hex[:8]
+    report_addr = f"ipc://{run_dir}/rep-{suffix}.sock"
+    pub_addr = f"ipc://{run_dir}/pub-{suffix}.sock"
+    proc = multiprocessing.get_context("spawn").Process(
+        target=coordinator.run_coordinator,
+        args=(report_addr, pub_addr, 2),
+        daemon=True,
+    )
+    proc.start()
+    ctx = zmq.Context(1)
+    push = ctx.socket(zmq.PUSH)
+    push.connect(report_addr)
+    sub = ctx.socket(zmq.SUB)
+    sub.connect(pub_addr)
+    sub.setsockopt(zmq.SUBSCRIBE, coordinator.TOPIC)
+
+    def latest_state(deadline=5.0):
+        state = None
+        end = time.monotonic() + deadline
+        while time.monotonic() < end:
+            if sub.poll(100):
+                while sub.poll(0):
+                    state = serial_utils.decode(sub.recv_multipart()[1])
+                return state
+        return state
+
+    try:
+        # Engine 0 reports work: a wave begins. (Generous first deadline:
+        # the spawned coordinator re-imports the package, which can take
+        # seconds on a loaded machine.)
+        push.send(serial_utils.encode(
+            {"engine_id": 0, "waiting": 2, "running": 1}
+        ))
+        state = latest_state(deadline=30.0)
+        assert state is not None
+        assert state["global_unfinished"] is True
+        assert state["loads"]["0"] == [2, 1]
+        wave0 = state["wave"]
+        # Engine 0 drains: the wave completes.
+        push.send(serial_utils.encode(
+            {"engine_id": 0, "waiting": 0, "running": 0}
+        ))
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline:
+            state = latest_state()
+            if state and not state["global_unfinished"]:
+                break
+        assert state["global_unfinished"] is False
+        assert state["wave"] == wave0 + 1
+        push.send(serial_utils.encode({"shutdown": True}))
+        proc.join(timeout=5)
+        assert not proc.is_alive()
+    finally:
+        push.close(linger=0)
+        sub.close(linger=0)
+        ctx.term()
+        if proc.is_alive():
+            proc.terminate()
+
+
+def test_dp_lockstep_dummy_batches(ckpt):
+    """With lockstep on, an idle engine dummy-steps while the other works."""
+    llm = _llm(ckpt, data_parallel_engines=2, data_parallel_lockstep=True)
+    try:
+        client = llm.llm_engine.engine_core
+        # Route everything to engine 0 by pinning the router.
+        client._coord_loads = [0, 10**6]
+
+        def no_drain():
+            pass
+
+        client._drain_loads = no_drain
+        prompts = [{"prompt_token_ids": [5, 9, 11, 3]} for _ in range(3)]
+        sp = SamplingParams(temperature=0.0, max_tokens=16, ignore_eos=True)
+        out = llm.generate(prompts, sp)
+        assert all(len(o.outputs[0].token_ids) == 16 for o in out)
+        # Engine 1 stayed idle yet alive (its dummy steps run on-device);
+        # the run finishing at all with lockstep on is the functional
+        # check — a deadlocked rank would hang the busy-loop.
+    finally:
+        llm.llm_engine.shutdown()

@@ -69,3 +69,51 @@ def test_prompt_logprobs_off_by_default(ckpt):
         SamplingParams(temperature=0.0, max_tokens=2, ignore_eos=True),
     )[0]
     assert out.prompt_logprobs is None
+
+
+def test_prompt_logprobs_zero_k(ckpt):
+    """prompt_logprobs=0: one entry per position holding only the actual
+    token's logprob (vLLM semantics)."""
+    rng = np.random.default_rng(1)
+    ids = rng.integers(5, 120, size=11).tolist()
+    want = hf_prompt_logprobs(ckpt, ids)
+    llm = LLM(
+        model=ckpt, dtype="float32", max_model_len=128, block_size=16,
+        num_gpu_blocks_override=64, max_num_seqs=4,
+        max_num_batched_tokens=128,
+    )
+    out = llm.generate(
+        [{"prompt_token_ids": ids}],
+        SamplingParams(temperature=0.0, max_tokens=1, prompt_logprobs=0,
+                       ignore_eos=True),
+    )[0]
+    plp = out.prompt_logprobs
+    assert plp is not None and len(plp) == len(ids)
+    for i in range(1, len(ids)):
+        assert set(plp[i]) == {ids[i]}  # ONLY the actual token
+    got = [plp[i][ids[i]].logprob for i in range(1, len(ids))]
+    np.testing.assert_allclose(got, want, rtol=2e-3, atol=2e-3)
+
+
+def test_prompt_logprobs_full_despite_prefix_cache(ckpt):
+    """A prefix-cache hit must not swallow prompt-logprob positions: the
+    second identical request still gets one entry per prompt token."""
+    rng = np.random.default_rng(2)
+    ids = rng.integers(5, 120, size=19).tolist()
+    llm = LLM(
+        model=ckpt, dtype="float32", max_model_len=128, block_size=16,
+        num_gpu_blocks_override=64, max_num_seqs=4,
+        max_num_batched_tokens=128,
+    )
+    sp = SamplingParams(temperature=0.0, max_tokens=1, prompt_logprobs=2,
+                        ignore_eos=True)
+    # Warm the prefix cache without prompt logprobs...
+    llm.generate([{"prompt_token_ids": ids}],
+                 SamplingParams(temperature=0.0, max_tokens=1,
+                                ignore_eos=True))
+    # ...then the plp request must still cover every position.
+    out = llm.generate([{"prompt_token_ids": ids}], sp)[0]
+    plp = out.prompt_logprobs
+    assert plp is not None
+    assert len(plp) == len(ids)
+    assert all(plp[i] for i in range(1, len(ids)))

@@ -126,6 +126,15 @@ class ParallelConfig:
     # Context parallelism (sequence sharding) axis size.
     context_parallel_size: int = 1
     enable_expert_parallel: bool = False
+    # Engine-level data parallelism (the reference's DP: one engine-core
+    # process per rank + coordinator, ``vllm/v1/engine/coordinator.py``).
+    # Distinct from ``data_parallel_size``, which is the in-mesh GSPMD
+    # batch-sharding axis within ONE engine.
+    data_parallel_engines: int = 1
+    # MoE wave lockstep: idle DP engines run dummy batches while any rank
+    # has work, so expert groups spanning DP ranks keep their collectives
+    # alive (reference ``DPEngineCoreProc.run_busy_loop``).
+    data_parallel_lockstep: bool = False
     # Backend for engine<->worker transport: in-proc by default on TPU since
     # one host drives all local chips via a single jax client.
     distributed_executor_backend: Literal["uniproc", "mp"] = "uniproc"

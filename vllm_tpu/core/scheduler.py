@@ -435,9 +435,19 @@ class Scheduler:
             # Mean pooling averages the hidden states of the tokens that
             # actually run through the model this step — a prefix-cache hit
             # or a split prompt would silently average a suffix only.
+            # Prompt logprobs likewise need logits for EVERY prompt
+            # position, so cache hits must not skip prefill compute
+            # (reference: prompt_logprobs forces recompute of cached
+            # tokens).
+            wants_prompt_lp = (
+                request.sampling_params is not None
+                and request.sampling_params.prompt_logprobs is not None
+            )
             new_computed_blocks, num_new_computed_tokens = (
                 self.kv_cache_manager.get_computed_blocks(request)
-                if request.num_computed_tokens == 0 and not is_mean_pooling
+                if request.num_computed_tokens == 0
+                and not is_mean_pooling
+                and not wants_prompt_lp
                 else ([], 0)
             )
             # External KV tier: whole blocks beyond the device hit.

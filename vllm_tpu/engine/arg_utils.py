@@ -59,6 +59,8 @@ class EngineArgs:
     context_parallel_size: int = 1
     enable_expert_parallel: bool = False
     distributed_executor_backend: str = "uniproc"
+    data_parallel_engines: int = 1
+    data_parallel_lockstep: bool = False
 
     device: str = "auto"
 
@@ -108,6 +110,8 @@ class EngineArgs:
                 context_parallel_size=self.context_parallel_size,
                 enable_expert_parallel=self.enable_expert_parallel,
                 distributed_executor_backend=self.distributed_executor_backend,  # type: ignore[arg-type]
+                data_parallel_engines=self.data_parallel_engines,
+                data_parallel_lockstep=self.data_parallel_lockstep,
             ),
             scheduler_config=SchedulerConfig(
                 max_num_batched_tokens=self.max_num_batched_tokens,

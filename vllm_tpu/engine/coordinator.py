@@ -1,0 +1,115 @@
+"""DP coordinator: load tracking + MoE wave lockstep across DP engines.
+
+Reference analog: ``vllm/v1/engine/coordinator.py`` (DPCoordinator) and the
+wave logic in ``DPEngineCoreProc.run_busy_loop`` (``core.py:1790``,
+``execute_dummy_batch`` ``core.py:731``).
+
+Topology: each DP rank runs one engine-core process with its own device
+mesh (TP/EP inside the rank rides ICI under GSPMD; DP ranks are separate
+slices). The coordinator is a small separate process:
+
+- engines PUSH load reports ``{engine_id, waiting, running}`` after every
+  busy-loop iteration;
+- the coordinator PUBlishes ``{loads, wave, global_unfinished}`` snapshots
+  to the frontend (for least-loaded routing) and back to the engines (for
+  wave lockstep).
+
+Wave semantics: a *wave* is a maximal period during which at least one
+engine has unfinished work. Engines configured for lockstep (MoE with
+expert groups spanning DP ranks) run dummy batches while idle inside a
+wave, so cross-rank collectives always have all participants; the wave
+counter increments when the last engine drains, which tells engines to
+stop dummy-stepping.
+
+Wave boundaries here are ADVISORY, not a synchronization barrier: ranks
+observe ``global_unfinished`` transitions at different times, so around a
+wave edge one rank may run an extra dummy step another has skipped. That
+is safe in this architecture because each engine's device collectives are
+confined to its own mesh (a dummy step is a self-contained program, not a
+cross-engine rendezvous). True EP-across-DP on TPU belongs to a single
+multi-host jax mesh (the in-mesh ``data_parallel_size`` axis), where the
+SPMD program itself keeps ranks in lockstep — the reference needs wave
+numbers attached to requests because its DP ranks rendezvous in NCCL
+all2alls outside any compiler-managed program; XLA-managed meshes don't.
+"""
+
+from __future__ import annotations
+
+import time
+
+# PUB topic (single topic; subscribers subscribe to everything).
+TOPIC = b"dp"
+
+
+def run_coordinator(report_addr: str, pub_addr: str,
+                    num_engines: int) -> None:
+    """Process entry point (spawn target)."""
+    import zmq
+
+    from vllm_tpu.engine import serial_utils
+    from vllm_tpu.logger import init_logger
+
+    logger = init_logger("vllm_tpu.engine.coordinator")
+    ctx = zmq.Context(1)
+    report = ctx.socket(zmq.PULL)
+    report.bind(report_addr)
+    pub = ctx.socket(zmq.PUB)
+    pub.bind(pub_addr)
+
+    loads: dict[int, tuple[int, int]] = {
+        i: (0, 0) for i in range(num_engines)
+    }
+    # Requests the frontend has accepted but engines may not have dequeued
+    # yet: counting them keeps the wave open across the client->engine hop
+    # (the reference attaches wave numbers to requests for the same race).
+    client_inflight = 0
+    wave = 0
+    global_unfinished = False
+    last_pub = 0.0
+
+    def publish() -> None:
+        pub.send_multipart([
+            TOPIC,
+            serial_utils.encode({
+                "loads": {str(k): list(v) for k, v in loads.items()},
+                "wave": wave,
+                "global_unfinished": global_unfinished,
+            }),
+        ])
+
+    try:
+        while True:
+            changed = False
+            if report.poll(100):
+                while report.poll(0):
+                    msg = serial_utils.decode(report.recv())
+                    if msg.get("shutdown"):
+                        return
+                    if "client_inflight" in msg:
+                        client_inflight = int(msg["client_inflight"])
+                    else:
+                        eid = int(msg["engine_id"])
+                        loads[eid] = (
+                            int(msg["waiting"]), int(msg["running"])
+                        )
+                    changed = True
+            now_unfinished = (
+                client_inflight > 0
+                or any(w + r > 0 for w, r in loads.values())
+            )
+            if global_unfinished and not now_unfinished:
+                # Wave complete: every engine drained.
+                wave += 1
+                changed = True
+                logger.debug("wave %d complete", wave)
+            global_unfinished = now_unfinished
+            now = time.monotonic()
+            # Publish on change, plus a 1 Hz heartbeat so late subscribers
+            # converge (PUB/SUB drops messages sent before a SUB connects).
+            if changed or now - last_pub > 1.0:
+                publish()
+                last_pub = now
+    finally:
+        report.close(linger=0)
+        pub.close(linger=0)
+        ctx.term()

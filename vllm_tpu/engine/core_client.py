@@ -1,8 +1,8 @@
 """EngineCore clients: in-process and multiprocess (ZMQ) variants.
 
 Reference analog: ``vllm/v1/engine/core_client.py`` (InprocClient :274,
-SyncMPClient :716, AsyncMPClient :887). One client interface serves both
-the sync LLMEngine and the AsyncLLM thread loop:
+SyncMPClient :716, AsyncMPClient :887, DPLBAsyncMPClient :1317). One client
+interface serves both the sync LLMEngine and the AsyncLLM thread loop:
 
 - ``add_request`` / ``abort_requests`` feed work in;
 - ``get_output(timeout)`` returns the next EngineCoreOutputs (None on
@@ -37,6 +37,8 @@ class EngineDeadError(RuntimeError):
 def make_client(config: EngineConfig):
     from vllm_tpu import envs
 
+    if config.parallel_config.data_parallel_engines > 1:
+        return DPLBClient(config)
     mp = (
         envs.VLLM_TPU_ENABLE_MULTIPROCESSING
         or config.parallel_config.distributed_executor_backend == "mp"
@@ -102,62 +104,17 @@ class InprocClient:
         self.engine_core.shutdown()
 
 
-class MPClient:
-    """Engine core in a spawned process, msgpack over ipc ZMQ sockets."""
+class _ZMQClientBase:
+    """Shared socket plumbing for the MP clients.
 
-    def __init__(self, config: EngineConfig, ready_timeout_s: float = 600.0):
-        import multiprocessing
-
-        import zmq
-
-        from vllm_tpu.engine import core_proc, serial_utils
-
-        self._serial = serial_utils
-        self._proc_mod = core_proc
-        self._run_dir = run_dir = tempfile.mkdtemp(prefix="vllm-tpu-ipc-")
-        suffix = uuid.uuid4().hex[:8]
-        input_addr = f"ipc://{run_dir}/input-{suffix}.sock"
-        output_addr = f"ipc://{run_dir}/output-{suffix}.sock"
-
-        self._ctx = zmq.Context(1)
-        self._input = self._ctx.socket(zmq.PUSH)
-        self._input.bind(input_addr)
-        self._output = self._ctx.socket(zmq.PULL)
-        self._output.bind(output_addr)
-
-        mp_ctx = multiprocessing.get_context("spawn")
-        self._proc = mp_ctx.Process(
-            target=core_proc.run_engine_core,
-            args=(pickle.dumps(config), input_addr, output_addr),
-            name="vllm-tpu-engine-core",
-            daemon=True,
-        )
-        self._proc.start()
-        atexit.register(self.shutdown)
-
-        self._dead = False
-        # Live request ids (id-keyed so an abort racing an in-flight
-        # engine-side finish record cannot double-count).
-        self._live: set[str] = set()
-        self._pending: list[list[bytes]] = []  # OUT frames read early
-        # Block until the engine proc finishes init (model load + KV
-        # sizing + warm-up can take minutes on first compile).
-        frames = self._recv(timeout_ms=int(ready_timeout_s * 1000))
-        if frames is None or frames[0] != core_proc.MSG_READY:
-            raise EngineDeadError(
-                "engine core process failed to initialize"
-            )
-        ready = serial_utils.decode(frames[1])
-        config.cache_config.num_gpu_blocks = ready["num_gpu_blocks"]
-        logger.info(
-            "engine core proc up (pid %s, %d KV blocks)",
-            self._proc.pid, ready["num_gpu_blocks"],
-        )
-
-    # ------------------------------------------------------------------
+    Subclass contract: set ``_serial``, ``_proc_mod``, ``_ctx``,
+    ``_output`` (shared PULL), ``_procs`` (list of engine processes),
+    ``_pending``, ``_dead``; implement ``_utility`` (single-engine call vs
+    broadcast) and ``_on_finished`` (drop a finished request id).
+    """
 
     def _recv(self, timeout_ms: int) -> list[bytes] | None:
-        """One message, honoring death of the engine process."""
+        """One message, honoring death of any engine process."""
         deadline = timeout_ms
         step = 200
         while True:
@@ -170,38 +127,16 @@ class MPClient:
                     )
                 return frames
             deadline -= step
-            if not self._proc.is_alive():
+            if any(not p.is_alive() for p in self._procs):
                 self._dead = True
-                raise EngineDeadError(
-                    f"engine core process exited (code "
-                    f"{self._proc.exitcode})"
-                )
+                raise EngineDeadError("an engine core process exited")
             if deadline <= 0:
                 return None
 
     def _check_alive(self) -> None:
-        if self._dead or not self._proc.is_alive():
+        if self._dead or any(not p.is_alive() for p in self._procs):
             self._dead = True
             raise EngineDeadError("engine core process is not running")
-
-    # ------------------------------------------------------------------
-
-    def add_request(self, req: EngineCoreRequest) -> None:
-        self._check_alive()
-        self._input.send_multipart(
-            [self._proc_mod.MSG_ADD, self._serial.encode(req)]
-        )
-        self._live.add(req.request_id)
-
-    def abort_requests(self, request_ids: list[str]) -> None:
-        if self._dead or not request_ids:
-            return
-        self._input.send_multipart(
-            [self._proc_mod.MSG_ABORT, self._serial.encode(list(request_ids))]
-        )
-        # Aborted requests produce no further outputs.
-        for rid in request_ids:
-            self._live.discard(rid)
 
     def get_output(self, timeout: float | None = None) -> EngineCoreOutputs:
         """Next batch of outputs; empty EngineCoreOutputs on timeout."""
@@ -220,34 +155,67 @@ class MPClient:
         outputs: EngineCoreOutputs = self._serial.decode(frames[1])
         for o in outputs.outputs:
             if o.finish_reason is not None:
-                self._live.discard(o.req_id)
+                self._on_finished(o.req_id)
         return outputs
 
-    def has_unfinished_requests(self) -> bool:
-        return bool(self._live)
-
-    def _utility(self, method: str, *args, timeout_ms: int = 600_000):
-        """Blocking engine-core method call over the socket pair."""
-        self._check_alive()
-        self._input.send_multipart([
-            self._proc_mod.MSG_UTILITY,
-            method.encode(),
-            self._serial.encode(list(args)),
-        ])
-        # Outputs may interleave ahead of the reply; buffer them.
-        for _ in range(1000):
+    def _collect_utility_replies(
+        self, method: str, count: int, timeout_ms: int
+    ) -> list[dict]:
+        """Read ``count`` UTILITY_REPLY frames, buffering interleaved
+        outputs. ALWAYS drains all ``count`` replies (stray replies left on
+        the shared socket would crash the next get_output)."""
+        replies: list[dict] = []
+        for _ in range(100_000):
+            if len(replies) == count:
+                break
             frames = self._recv(timeout_ms=timeout_ms)
             if frames is None:
                 break
             if frames[0] == self._proc_mod.MSG_UTILITY_REPLY:
-                reply = self._serial.decode(frames[1])
-                if "error" in reply:
-                    raise RuntimeError(
-                        f"engine utility {method} failed: {reply['error']}"
-                    )
-                return reply["ok"]
-            self._pending.append(frames)
-        raise EngineDeadError(f"utility call {method} got no reply")
+                replies.append(self._serial.decode(frames[1]))
+            else:
+                self._pending.append(frames)
+        if len(replies) != count:
+            raise EngineDeadError(
+                f"utility call {method}: {len(replies)}/{count} replies"
+            )
+        errors = [r["error"] for r in replies if "error" in r]
+        if errors:
+            raise RuntimeError(
+                f"engine utility {method} failed: {'; '.join(errors)}"
+            )
+        return replies
+
+    # -- engine-core utility surface (same signatures on every client) --
+
+    def _utility(self, method: str, *args, timeout_ms: int = 600_000):
+        raise NotImplementedError
+
+    def _on_finished(self, req_id: str) -> None:
+        raise NotImplementedError
+
+    def _teardown(self, sockets: list) -> None:
+        """Shared shutdown tail: SHUTDOWN + join/terminate every engine
+        proc, close sockets, remove the ipc dir."""
+        try:
+            for sock, proc in zip(self._inputs, self._procs):
+                if proc.is_alive():
+                    sock.send_multipart([self._proc_mod.MSG_SHUTDOWN])
+            for proc in self._procs:
+                proc.join(timeout=5)
+                if proc.is_alive():
+                    proc.terminate()
+                    proc.join(timeout=2)
+        except Exception:
+            pass
+        finally:
+            for sock in sockets:
+                sock.close(linger=0)
+            self._ctx.term()
+            self._procs = []
+            import shutil
+
+            shutil.rmtree(self._run_dir, ignore_errors=True)
 
     def reset_prefix_cache(self) -> bool:
         return self._utility("reset_prefix_cache", timeout_ms=30_000)
@@ -279,6 +247,96 @@ class MPClient:
     def stop_profile(self) -> bool:
         return self._utility("stop_profile", timeout_ms=60_000)
 
+
+class MPClient(_ZMQClientBase):
+    """Engine core in a spawned process, msgpack over ipc ZMQ sockets."""
+
+    def __init__(self, config: EngineConfig, ready_timeout_s: float = 600.0):
+        import multiprocessing
+
+        import zmq
+
+        from vllm_tpu.engine import core_proc, serial_utils
+
+        self._serial = serial_utils
+        self._proc_mod = core_proc
+        self._run_dir = run_dir = tempfile.mkdtemp(prefix="vllm-tpu-ipc-")
+        suffix = uuid.uuid4().hex[:8]
+        input_addr = f"ipc://{run_dir}/input-{suffix}.sock"
+        output_addr = f"ipc://{run_dir}/output-{suffix}.sock"
+
+        self._ctx = zmq.Context(1)
+        self._input = self._ctx.socket(zmq.PUSH)
+        self._input.bind(input_addr)
+        self._output = self._ctx.socket(zmq.PULL)
+        self._output.bind(output_addr)
+
+        mp_ctx = multiprocessing.get_context("spawn")
+        self._proc = mp_ctx.Process(
+            target=core_proc.run_engine_core,
+            args=(pickle.dumps(config), input_addr, output_addr),
+            name="vllm-tpu-engine-core",
+            daemon=True,
+        )
+        self._proc.start()
+        self._procs = [self._proc]
+        self._inputs = [self._input]
+        atexit.register(self.shutdown)
+
+        self._dead = False
+        # Live request ids (id-keyed so an abort racing an in-flight
+        # engine-side finish record cannot double-count).
+        self._live: set[str] = set()
+        self._pending: list[list[bytes]] = []  # OUT frames read early
+        # Block until the engine proc finishes init (model load + KV
+        # sizing + warm-up can take minutes on first compile).
+        frames = self._recv(timeout_ms=int(ready_timeout_s * 1000))
+        if frames is None or frames[0] != core_proc.MSG_READY:
+            raise EngineDeadError(
+                "engine core process failed to initialize"
+            )
+        ready = serial_utils.decode(frames[1])
+        config.cache_config.num_gpu_blocks = ready["num_gpu_blocks"]
+        logger.info(
+            "engine core proc up (pid %s, %d KV blocks)",
+            self._proc.pid, ready["num_gpu_blocks"],
+        )
+
+    # ------------------------------------------------------------------
+
+    def add_request(self, req: EngineCoreRequest) -> None:
+        self._check_alive()
+        self._input.send_multipart(
+            [self._proc_mod.MSG_ADD, self._serial.encode(req)]
+        )
+        self._live.add(req.request_id)
+
+    def abort_requests(self, request_ids: list[str]) -> None:
+        if self._dead or not request_ids:
+            return
+        self._input.send_multipart(
+            [self._proc_mod.MSG_ABORT, self._serial.encode(list(request_ids))]
+        )
+        # Aborted requests produce no further outputs.
+        for rid in request_ids:
+            self._live.discard(rid)
+
+    def _on_finished(self, req_id: str) -> None:
+        self._live.discard(req_id)
+
+    def has_unfinished_requests(self) -> bool:
+        return bool(self._live)
+
+    def _utility(self, method: str, *args, timeout_ms: int = 600_000):
+        """Blocking engine-core method call over the socket pair."""
+        self._check_alive()
+        self._input.send_multipart([
+            self._proc_mod.MSG_UTILITY,
+            method.encode(),
+            self._serial.encode(list(args)),
+        ])
+        return self._collect_utility_replies(method, 1, timeout_ms)[0]["ok"]
+
     @property
     def inflight(self) -> bool:
         # The proc steps autonomously; treat unfinished work as in flight.
@@ -287,20 +345,207 @@ class MPClient:
     def shutdown(self) -> None:
         if getattr(self, "_proc", None) is None:
             return
+        self._teardown([self._input, self._output])
+        self._proc = None
+
+
+class DPLBClient(_ZMQClientBase):
+    """Data-parallel load-balancing client: N engine-core procs + a
+    coordinator proc, least-loaded request routing.
+
+    Reference analog: ``vllm/v1/engine/core_client.py:1317``
+    (DPLBAsyncMPClient) + ``coordinator.py``. Each engine PUSHes outputs to
+    one shared PULL socket (fan-in); requests are routed per-engine over
+    dedicated PUSH sockets. Routing load is tracked client-side (adds minus
+    finishes per engine — exact, since every request passes through this
+    client), with coordinator snapshots merged in as a correction for any
+    engine-side queue growth (e.g. long prefills held in waiting).
+    The client also reports its total in-flight count to the coordinator so
+    a request in flight to an engine keeps the wave open (the reference
+    attaches wave numbers to requests for the same race).
+    """
+
+    def __init__(self, config: EngineConfig, ready_timeout_s: float = 600.0):
+        import copy
+        import multiprocessing
+
+        import zmq
+
+        from vllm_tpu.engine import coordinator, core_proc, serial_utils
+
+        self._serial = serial_utils
+        self._proc_mod = core_proc
+        pc = config.parallel_config
+        self._num_engines = n = pc.data_parallel_engines
+        self._run_dir = run_dir = tempfile.mkdtemp(prefix="vllm-tpu-dp-")
+        suffix = uuid.uuid4().hex[:8]
+        output_addr = f"ipc://{run_dir}/out-{suffix}.sock"
+        report_addr = f"ipc://{run_dir}/rep-{suffix}.sock"
+        pub_addr = f"ipc://{run_dir}/pub-{suffix}.sock"
+
+        self._ctx = zmq.Context(1)
+        self._output = self._ctx.socket(zmq.PULL)
+        self._output.bind(output_addr)
+        self._sub = self._ctx.socket(zmq.SUB)
+        self._sub.connect(pub_addr)
+        self._sub.setsockopt(zmq.SUBSCRIBE, coordinator.TOPIC)
+        self._report = self._ctx.socket(zmq.PUSH)
+        self._report.connect(report_addr)
+        # Bounded-blocking send: a silently dropped FINAL report (count 0)
+        # would leave the coordinator's wave open forever with lockstep
+        # engines dummy-stepping; 50 ms covers any transient HWM stall
+        # without ever meaningfully stalling routing.
+        self._report.setsockopt(zmq.SNDTIMEO, 50)
+
+        mp_ctx = multiprocessing.get_context("spawn")
+        self._coord = mp_ctx.Process(
+            target=coordinator.run_coordinator,
+            args=(report_addr, pub_addr, n),
+            name="vllm-tpu-dp-coordinator",
+            daemon=True,
+        )
+        self._coord.start()
+
+        # Each engine is a full single-engine config: the per-engine mesh
+        # (tp/ep/...) stays as configured; DP fan-out happens here.
+        self._inputs = []
+        self._procs = []
+        for eid in range(n):
+            engine_config = copy.deepcopy(config)
+            engine_config.parallel_config.data_parallel_engines = 1
+            input_addr = f"ipc://{run_dir}/in{eid}-{suffix}.sock"
+            sock = self._ctx.socket(zmq.PUSH)
+            sock.bind(input_addr)
+            self._inputs.append(sock)
+            proc = mp_ctx.Process(
+                target=core_proc.run_engine_core,
+                args=(pickle.dumps(engine_config), input_addr, output_addr),
+                kwargs=dict(
+                    engine_id=eid,
+                    coord_report_addr=report_addr,
+                    coord_pub_addr=pub_addr,
+                    lockstep=pc.data_parallel_lockstep,
+                ),
+                name=f"vllm-tpu-engine-core-dp{eid}",
+                daemon=True,
+            )
+            proc.start()
+            self._procs.append(proc)
+        atexit.register(self.shutdown)
+
+        self._dead = False
+        self._live: dict[str, int] = {}  # req_id -> engine_id
+        # Exact per-engine in-flight (adds minus finishes seen here).
+        self._engine_inflight = [0] * n
+        self._coord_loads = [0] * n
+        self._pending: list[list[bytes]] = []
+        ready = 0
+        blocks: list[int] = []
+        deadline_ms = int(ready_timeout_s * 1000)
+        while ready < n:
+            frames = self._recv(timeout_ms=deadline_ms)
+            if frames is None or frames[0] != core_proc.MSG_READY:
+                raise EngineDeadError(
+                    "DP engine core processes failed to initialize"
+                )
+            blocks.append(
+                serial_utils.decode(frames[1])["num_gpu_blocks"]
+            )
+            ready += 1
+        config.cache_config.num_gpu_blocks = min(blocks)
+        logger.info(
+            "%d DP engine cores up (KV blocks per engine: %s)", n, blocks
+        )
+
+    # ------------------------------------------------------------------
+
+    def _drain_loads(self) -> None:
+        """Fold coordinator snapshots into the routing correction term.
+        Never resets the client-side in-flight counts — those are exact."""
+        while self._sub.poll(0):
+            frames = self._sub.recv_multipart()
+            state = self._serial.decode(frames[1])
+            for eid_s, (w, r) in state["loads"].items():
+                self._coord_loads[int(eid_s)] = w + r
+
+    def _report_inflight(self) -> None:
+        """Tell the coordinator how many requests this client has live, so
+        requests in flight to an engine keep the wave open."""
         try:
-            if self._proc.is_alive():
-                self._input.send_multipart([self._proc_mod.MSG_SHUTDOWN])
-                self._proc.join(timeout=5)
-            if self._proc.is_alive():
-                self._proc.terminate()
-                self._proc.join(timeout=2)
+            self._report.send(self._serial.encode(
+                {"client_inflight": len(self._live)}
+            ))
         except Exception:
             pass
-        finally:
-            self._input.close(linger=0)
-            self._output.close(linger=0)
-            self._ctx.term()
-            self._proc = None
-            import shutil
 
-            shutil.rmtree(self._run_dir, ignore_errors=True)
+    def add_request(self, req: EngineCoreRequest) -> None:
+        self._check_alive()
+        self._drain_loads()
+        eid = min(
+            range(self._num_engines),
+            key=lambda i: self._engine_inflight[i] + self._coord_loads[i],
+        )
+        self._live[req.request_id] = eid
+        self._engine_inflight[eid] += 1
+        self._report_inflight()  # before the add: wave opens first
+        self._inputs[eid].send_multipart(
+            [self._proc_mod.MSG_ADD, self._serial.encode(req)]
+        )
+
+    def abort_requests(self, request_ids: list[str]) -> None:
+        if self._dead or not request_ids:
+            return
+        by_engine: dict[int, list[str]] = {}
+        for rid in request_ids:
+            eid = self._live.pop(rid, None)
+            if eid is not None:
+                self._engine_inflight[eid] -= 1
+                by_engine.setdefault(eid, []).append(rid)
+        for eid, rids in by_engine.items():
+            self._inputs[eid].send_multipart(
+                [self._proc_mod.MSG_ABORT, self._serial.encode(rids)]
+            )
+        self._report_inflight()
+
+    def _on_finished(self, req_id: str) -> None:
+        eid = self._live.pop(req_id, None)
+        if eid is not None:
+            self._engine_inflight[eid] -= 1
+            self._report_inflight()
+
+    def has_unfinished_requests(self) -> bool:
+        return bool(self._live)
+
+    def _utility(self, method: str, *args, timeout_ms: int = 600_000):
+        """Broadcast to all engines; returns the lowest engine id's result.
+        All replies are drained even on error (stray replies on the shared
+        socket would corrupt the output stream)."""
+        self._check_alive()
+        for sock in self._inputs:
+            sock.send_multipart([
+                self._proc_mod.MSG_UTILITY,
+                method.encode(),
+                self._serial.encode(list(args)),
+            ])
+        replies = self._collect_utility_replies(
+            method, self._num_engines, timeout_ms
+        )
+        replies.sort(key=lambda r: r.get("engine_id", 0))
+        return replies[0]["ok"]
+
+    @property
+    def inflight(self) -> bool:
+        return bool(self._live)
+
+    def shutdown(self) -> None:
+        if not getattr(self, "_procs", None):
+            return
+        try:
+            if self._coord.is_alive():
+                self._coord.terminate()
+                self._coord.join(timeout=2)
+        except Exception:
+            pass
+        self._teardown(
+            [*self._inputs, self._output, self._sub, self._report]
+        )

@@ -27,8 +27,17 @@ MSG_UTILITY_REPLY = b"UTILREP"
 
 
 def run_engine_core(config_bytes: bytes, input_addr: str,
-                    output_addr: str) -> None:
-    """Process entry point (spawn target)."""
+                    output_addr: str, engine_id: int = 0,
+                    coord_report_addr: str | None = None,
+                    coord_pub_addr: str | None = None,
+                    lockstep: bool = False) -> None:
+    """Process entry point (spawn target).
+
+    With ``coord_*`` addresses set this is the DP variant (reference
+    ``DPEngineCoreProc``, ``core.py:1622``): the proc reports its load to
+    the coordinator after every iteration and, when ``lockstep`` is on,
+    runs dummy batches while other DP ranks still have work in the wave.
+    """
     import os
 
     # Honor the parent's platform selection BEFORE any backend init (test
@@ -53,6 +62,41 @@ def run_engine_core(config_bytes: bytes, input_addr: str,
     out = ctx.socket(zmq.PUSH)
     out.connect(output_addr)
 
+    # DP coordinator plumbing (absent for the single-engine path).
+    coord_push = coord_sub = None
+    if coord_report_addr is not None:
+        from vllm_tpu.engine.coordinator import TOPIC
+
+        coord_push = ctx.socket(zmq.PUSH)
+        coord_push.connect(coord_report_addr)
+        coord_sub = ctx.socket(zmq.SUB)
+        coord_sub.connect(coord_pub_addr)
+        coord_sub.setsockopt(zmq.SUBSCRIBE, TOPIC)
+    last_load: tuple[int, int] | None = None
+    global_unfinished = False
+
+    def report_load() -> None:
+        nonlocal last_load
+        if coord_push is None:
+            return
+        load = core.get_load()
+        if load != last_load:
+            coord_push.send(serial_utils.encode({
+                "engine_id": engine_id,
+                "waiting": load[0],
+                "running": load[1],
+            }))
+            last_load = load
+
+    def drain_coordinator() -> None:
+        nonlocal global_unfinished
+        if coord_sub is None:
+            return
+        while coord_sub.poll(0):
+            frames = coord_sub.recv_multipart()
+            state = serial_utils.decode(frames[1])
+            global_unfinished = bool(state["global_unfinished"])
+
     core = None
     try:
         config = pickle.loads(config_bytes)
@@ -60,14 +104,17 @@ def run_engine_core(config_bytes: bytes, input_addr: str,
         out.send_multipart([
             MSG_READY,
             serial_utils.encode(
-                {"num_gpu_blocks": config.cache_config.num_gpu_blocks}
+                {"num_gpu_blocks": config.cache_config.num_gpu_blocks,
+                 "engine_id": engine_id}
             ),
         ])
 
         while True:
             busy = core.has_unfinished_requests()
             # Idle: block on input (bounded so shutdown stays responsive).
-            timeout = 0 if busy else 200
+            # Mid-wave idle ranks poll non-blocking: they must keep pace
+            # with the busy ranks' step rate, not the 5 Hz idle tick.
+            timeout = 0 if busy or (lockstep and global_unfinished) else 200
             while inp.poll(timeout):
                 frames = inp.recv_multipart()
                 kind = frames[0]
@@ -107,19 +154,31 @@ def run_engine_core(config_bytes: bytes, input_addr: str,
                     # A failing utility (e.g. sleep with active requests,
                     # bad reload path) fails the CALL, not the engine.
                     try:
-                        result = {"ok": getattr(core, method)(*args)}
+                        result = {"ok": getattr(core, method)(*args),
+                                  "engine_id": engine_id}
                     except Exception as e:
                         logger.error("utility %s failed: %s", method, e)
-                        result = {"error": f"{type(e).__name__}: {e}"}
+                        result = {"error": f"{type(e).__name__}: {e}",
+                                  "engine_id": engine_id}
                     out.send_multipart([
                         MSG_UTILITY_REPLY, serial_utils.encode(result)
                     ])
                 elif kind == MSG_SHUTDOWN:
                     return
                 timeout = 0
+            drain_coordinator()
+            # Report BEFORE stepping: step() can block inside a cross-rank
+            # collective, and idle ranks only join once the coordinator has
+            # seen this rank's load (reference: DPEngineCoreProc reports at
+            # the top of the busy loop).
+            report_load()
             if not core.has_unfinished_requests():
+                if lockstep and global_unfinished:
+                    # Other DP ranks are mid-wave: keep collectives alive.
+                    core.execute_dummy_batch()
                 continue
             outputs = core.step()
+            report_load()
             if outputs.outputs:
                 out.send_multipart(
                     [MSG_OUTPUTS, serial_utils.encode(outputs)]
@@ -136,4 +195,7 @@ def run_engine_core(config_bytes: bytes, input_addr: str,
             core.shutdown()
         inp.close(linger=0)
         out.close(linger=0)
+        if coord_push is not None:
+            coord_push.close(linger=0)
+            coord_sub.close(linger=0)
         ctx.term()

@@ -121,6 +121,20 @@ class EngineCore:
     def has_unfinished_requests(self) -> bool:
         return bool(self._inflight) or self.scheduler.has_unfinished_requests()
 
+    def get_load(self) -> tuple[int, int]:
+        """(num_waiting, num_running) for the DP coordinator.
+        Reference analog: SchedulerStats counts in EngineCoreOutputs."""
+        return (
+            len(self.scheduler.waiting),
+            len(self.scheduler.running) + len(self._inflight),
+        )
+
+    def execute_dummy_batch(self) -> None:
+        """One no-request device step, so idle DP ranks keep participating
+        in cross-rank collectives during a wave (reference: ``core.py:731``
+        ``execute_dummy_batch``)."""
+        self.executor.collective_rpc("execute_dummy_batch")
+
     def step(self) -> EngineCoreOutputs:
         """One engine iteration.
 

@@ -197,9 +197,14 @@ class OutputProcessor:
 
     def _append_prompt_logprobs(self, state: RequestState, delta) -> None:
         """delta = (chunk_start, entries); entries cover prompt tokens
-        chunk_start+1 .. chunk_start+len (position 0 has no predictor)."""
-        _chunk_start, entries = delta
-        for entry in entries:
+        chunk_start+1 .. chunk_start+len (position 0 has no predictor).
+
+        Placement is by absolute position, not append: a preempted request
+        re-runs prefill from 0 and re-emits chunks already delivered, and
+        those must overwrite, not duplicate."""
+        chunk_start, entries = delta
+        for j, entry in enumerate(entries):
+            idx = chunk_start + 1 + j
             topk_ids, topk_vals, tok, tok_lp, tok_rank = entry
             d: dict[int, Logprob] = {}
             k = state.params.prompt_logprobs or 0
@@ -212,7 +217,9 @@ class OutputProcessor:
             if self.tokenizer is not None and state.params.detokenize:
                 for tid, lp in d.items():
                     lp.decoded_token = self.tokenizer.decode([tid])
-            state.prompt_logprobs.append(d)
+            while len(state.prompt_logprobs) <= idx:
+                state.prompt_logprobs.append(None)
+            state.prompt_logprobs[idx] = d
 
     def _append_logprobs(self, state: RequestState, eco: EngineCoreOutput) -> None:
         """eco.new_logprobs: one (topk_ids, topk_vals, sampled_token_id,

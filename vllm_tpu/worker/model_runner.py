@@ -666,14 +666,16 @@ class ModelRunner:
             for i, row in enumerate(rows):
                 state = batch.req_states[req_order[i]]
                 n = num_sched[req_order[i]]
-                k = state.sampling_params.prompt_logprobs or 0
-                if k:
+                pl = state.sampling_params.prompt_logprobs
+                if pl is not None:
                     start = int(batch.num_computed_tokens[row])
                     prompt_len = state.num_tokens - state.generated
                     count = max(0, min(start + n, prompt_len - 1) - start)
                     if count:
-                        num_prompt_lp = max(num_prompt_lp, k)
-                        prompt_rows.append((i, row, run_off, start, count, k))
+                        # k=0 still needs the true-token logprob: compute
+                        # top-1 on device, slice [:0] host-side.
+                        num_prompt_lp = max(num_prompt_lp, pl, 1)
+                        prompt_rows.append((i, row, run_off, start, count, pl))
                 run_off += n
         plp_len = t if num_prompt_lp else 0
         # seq_lens(r) + qsl(r+1) + logits_idx(r) + num_seqs(1) + bt(r*b)
@@ -1472,6 +1474,13 @@ class ModelRunner:
             )
         )
         self.execute_model(so)
+        self.input_batch.remove_request("__profile__")
+
+    def execute_dummy_batch(self) -> None:
+        """Smallest-bucket step with a throwaway request: keeps an idle DP
+        rank stepping in lockstep with busy ranks (cross-rank collectives
+        need all participants). Reference: ``core.py:731``."""
+        self.execute_model(_dummy_scheduler_output(1))
         self.input_batch.remove_request("__profile__")
 
 

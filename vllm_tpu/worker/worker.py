@@ -270,6 +270,11 @@ class Worker:
         assert self.runner is not None
         return self.runner.execute_model(scheduler_output)
 
+    def execute_dummy_batch(self) -> None:
+        """One 1-token no-op device step (DP wave lockstep; ``core.py:731``)."""
+        assert self.runner is not None
+        self.runner.execute_dummy_batch()
+
     def set_structured_output_manager(self, manager: Any) -> None:
         assert self.runner is not None
         self.runner.structured_output_manager = manager
