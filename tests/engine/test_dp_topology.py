@@ -121,10 +121,17 @@ def test_coordinator_wave_tracking():
         push.send(serial_utils.encode(
             {"engine_id": 0, "waiting": 2, "running": 1}
         ))
-        state = latest_state(deadline=30.0)
+        # Wait for the snapshot that REFLECTS the report (earlier all-zero
+        # heartbeats may be queued ahead of it).
+        state = None
+        end = time.monotonic() + 60
+        while time.monotonic() < end:
+            s = latest_state(5.0)
+            if s and s["loads"]["0"] == [2, 1]:
+                state = s
+                break
         assert state is not None
         assert state["global_unfinished"] is True
-        assert state["loads"]["0"] == [2, 1]
         wave0 = state["wave"]
         # Engine 0 drains: the wave completes.
         push.send(serial_utils.encode(
@@ -153,13 +160,9 @@ def test_dp_lockstep_dummy_batches(ckpt):
     llm = _llm(ckpt, data_parallel_engines=2, data_parallel_lockstep=True)
     try:
         client = llm.llm_engine.engine_core
-        # Route everything to engine 0 by pinning the router.
-        client._coord_loads = [0, 10**6]
-
-        def no_drain():
-            pass
-
-        client._drain_loads = no_drain
+        # Route everything to engine 0 by pinning the router (routing key
+        # is the client-side per-engine in-flight count).
+        client._engine_inflight = [0, 10**6]
         prompts = [{"prompt_token_ids": [5, 9, 11, 3]} for _ in range(3)]
         sp = SamplingParams(temperature=0.0, max_tokens=16, ignore_eos=True)
         out = llm.generate(prompts, sp)

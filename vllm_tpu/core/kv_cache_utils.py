@@ -198,6 +198,20 @@ class FullAttentionSpec(KVCacheSpec):
 
 
 @dataclass
+class MLAAttentionSpec(KVCacheSpec):
+    """Latent-compressed cache (reference: ``kv_cache_interface.py:323``):
+    ONE latent row per token (c_kv || k_pe) shared by all heads — no K/V
+    planes. ``head_size`` is the latent width (kv_lora_rank + rope dim)."""
+
+    @property
+    def page_size_bytes(self) -> int:
+        return (
+            self.block_size * self.num_kv_heads * self.head_size
+            * self.dtype_bytes
+        )
+
+
+@dataclass
 class SlidingWindowSpec(KVCacheSpec):
     sliding_window: int = 4096
 

@@ -456,6 +456,7 @@ class Scheduler:
                 self.kv_connector is not None
                 and request.num_computed_tokens == 0
                 and request.block_hashes
+                and not wants_prompt_lp  # external hits skip compute too
             ):
                 num_external_tokens = (
                     self.kv_connector.get_num_new_matched_tokens(

@@ -397,17 +397,27 @@ class DPLBClient(_ZMQClientBase):
         # without ever meaningfully stalling routing.
         self._report.setsockopt(zmq.SNDTIMEO, 50)
 
-        mp_ctx = multiprocessing.get_context("spawn")
+        self._mp_ctx = mp_ctx = multiprocessing.get_context("spawn")
+        self._coord_args = (report_addr, pub_addr, n)
         self._coord = mp_ctx.Process(
             target=coordinator.run_coordinator,
-            args=(report_addr, pub_addr, n),
+            args=self._coord_args,
             name="vllm-tpu-dp-coordinator",
             daemon=True,
         )
         self._coord.start()
+        self._coord_respawns = 0
 
         # Each engine is a full single-engine config: the per-engine mesh
-        # (tp/ep/...) stays as configured; DP fan-out happens here.
+        # (tp/ep/...) stays as configured; DP fan-out happens here. On a
+        # multi-chip TPU host each engine is pinned to a disjoint chip
+        # subset (libtpu locks chips per process); multi-host DP instead
+        # runs one engine per host with no pinning needed.
+        chips_per_engine = pc.world_size
+        pin_chips = (
+            os.environ.get("JAX_PLATFORMS", "").lower() not in ("cpu",)
+            and "TPU_VISIBLE_DEVICES" not in os.environ
+        )
         self._inputs = []
         self._procs = []
         for eid in range(n):
@@ -417,6 +427,18 @@ class DPLBClient(_ZMQClientBase):
             sock = self._ctx.socket(zmq.PUSH)
             sock.bind(input_addr)
             self._inputs.append(sock)
+            extra_env = (
+                {
+                    "TPU_VISIBLE_DEVICES": ",".join(
+                        str(c) for c in range(
+                            eid * chips_per_engine,
+                            (eid + 1) * chips_per_engine,
+                        )
+                    ),
+                }
+                if pin_chips
+                else {}
+            )
             proc = mp_ctx.Process(
                 target=core_proc.run_engine_core,
                 args=(pickle.dumps(engine_config), input_addr, output_addr),
@@ -425,6 +447,7 @@ class DPLBClient(_ZMQClientBase):
                     coord_report_addr=report_addr,
                     coord_pub_addr=pub_addr,
                     lockstep=pc.data_parallel_lockstep,
+                    extra_env=extra_env,
                 ),
                 name=f"vllm-tpu-engine-core-dp{eid}",
                 daemon=True,
@@ -435,9 +458,15 @@ class DPLBClient(_ZMQClientBase):
 
         self._dead = False
         self._live: dict[str, int] = {}  # req_id -> engine_id
-        # Exact per-engine in-flight (adds minus finishes seen here).
+        # Exact per-engine in-flight (adds minus finishes seen here) —
+        # the routing metric. Coordinator snapshots are kept for the wave
+        # state and observability only: they cover a SUBSET of the same
+        # requests, so summing them in would double-count.
         self._engine_inflight = [0] * n
         self._coord_loads = [0] * n
+        # Last inflight count that failed to send (retried on later calls
+        # so a dropped final 0 cannot wedge the wave open).
+        self._report_unsent: int | None = None
         self._pending: list[list[bytes]] = []
         ready = 0
         blocks: list[int] = []
@@ -460,30 +489,63 @@ class DPLBClient(_ZMQClientBase):
     # ------------------------------------------------------------------
 
     def _drain_loads(self) -> None:
-        """Fold coordinator snapshots into the routing correction term.
-        Never resets the client-side in-flight counts — those are exact."""
+        """Record coordinator snapshots (wave state / observability)."""
         while self._sub.poll(0):
             frames = self._sub.recv_multipart()
             state = self._serial.decode(frames[1])
             for eid_s, (w, r) in state["loads"].items():
                 self._coord_loads[int(eid_s)] = w + r
 
+    def _check_coordinator(self) -> None:
+        """The coordinator is supervision, not the data path: if it dies,
+        respawn it (a dead coordinator would otherwise silently freeze the
+        wave state and leave lockstep ranks dummy-stepping forever)."""
+        if self._coord.is_alive():
+            return
+        self._coord_respawns += 1
+        logger.warning(
+            "DP coordinator died (exit %s); respawning (#%d)",
+            self._coord.exitcode, self._coord_respawns,
+        )
+        from vllm_tpu.engine import coordinator
+
+        self._coord = self._mp_ctx.Process(
+            target=coordinator.run_coordinator,
+            args=self._coord_args,
+            name="vllm-tpu-dp-coordinator",
+            daemon=True,
+        )
+        self._coord.start()
+        # Re-seed the fresh coordinator's client view.
+        self._report_unsent = len(self._live)
+
     def _report_inflight(self) -> None:
         """Tell the coordinator how many requests this client has live, so
-        requests in flight to an engine keep the wave open."""
+        requests in flight to an engine keep the wave open. A failed send
+        (50 ms SNDTIMEO) is retried on later calls — dropping the final
+        count-0 report would wedge the wave open with lockstep engines
+        dummy-stepping forever."""
+        self._report_unsent = len(self._live)
+        self._flush_report()
+
+    def _flush_report(self) -> None:
+        if self._report_unsent is None:
+            return
+        self._check_coordinator()
         try:
             self._report.send(self._serial.encode(
-                {"client_inflight": len(self._live)}
+                {"client_inflight": self._report_unsent}
             ))
+            self._report_unsent = None
         except Exception:
-            pass
+            pass  # keep _report_unsent; retried on the next call
 
     def add_request(self, req: EngineCoreRequest) -> None:
         self._check_alive()
         self._drain_loads()
         eid = min(
             range(self._num_engines),
-            key=lambda i: self._engine_inflight[i] + self._coord_loads[i],
+            key=lambda i: self._engine_inflight[i],
         )
         self._live[req.request_id] = eid
         self._engine_inflight[eid] += 1
@@ -513,7 +575,12 @@ class DPLBClient(_ZMQClientBase):
             self._engine_inflight[eid] -= 1
             self._report_inflight()
 
+    def get_output(self, timeout: float | None = None) -> EngineCoreOutputs:
+        self._flush_report()  # retry a dropped inflight report
+        return super().get_output(timeout)
+
     def has_unfinished_requests(self) -> bool:
+        self._flush_report()  # retry a dropped inflight report
         return bool(self._live)
 
     def _utility(self, method: str, *args, timeout_ms: int = 600_000):

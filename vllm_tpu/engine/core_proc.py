@@ -30,7 +30,8 @@ def run_engine_core(config_bytes: bytes, input_addr: str,
                     output_addr: str, engine_id: int = 0,
                     coord_report_addr: str | None = None,
                     coord_pub_addr: str | None = None,
-                    lockstep: bool = False) -> None:
+                    lockstep: bool = False,
+                    extra_env: dict[str, str] | None = None) -> None:
     """Process entry point (spawn target).
 
     With ``coord_*`` addresses set this is the DP variant (reference
@@ -39,6 +40,11 @@ def run_engine_core(config_bytes: bytes, input_addr: str,
     runs dummy batches while other DP ranks still have work in the wave.
     """
     import os
+
+    # Per-engine device assignment (DP on one multi-chip host: each engine
+    # owns a disjoint chip subset) must land before any backend init.
+    for k, v in (extra_env or {}).items():
+        os.environ[k] = v
 
     # Honor the parent's platform selection BEFORE any backend init (test
     # rigs force CPU; the TPU plugin's sitecustomize would otherwise win).

@@ -110,6 +110,30 @@ def _grouped_moe(
     ).astype(hidden.dtype)
 
 
+def fused_experts(
+    hidden: jnp.ndarray,  # [T, D]
+    w_gate: jnp.ndarray,  # [E, D, F]
+    w_up: jnp.ndarray,  # [E, D, F]
+    w_down: jnp.ndarray,  # [E, F, D]
+    weights: jnp.ndarray,  # [T, k] f32 combine weights
+    expert_ids: jnp.ndarray,  # [T, k] i32
+    use_grouped: bool | None = None,
+) -> jnp.ndarray:
+    """Experts + combine for pre-computed routing (custom gating schemes —
+    DeepSeek group-limited / sigmoid-bias routing — share the expert
+    compute). ``use_grouped=None`` auto-selects the megablox path on
+    single-device TPU, dense one-hot otherwise."""
+    if use_grouped is None:
+        # Grouped megablox is the single-device fast path; under a multi-
+        # device mesh the dense one-hot path is the GSPMD/EP formulation.
+        use_grouped = (
+            jax.default_backend() == "tpu" and jax.device_count() == 1
+        )
+    if use_grouped:
+        return _grouped_moe(hidden, w_gate, w_up, w_down, weights, expert_ids)
+    return _dense_moe(hidden, w_gate, w_up, w_down, weights, expert_ids)
+
+
 def fused_moe(
     hidden: jnp.ndarray,  # [T, D]
     router_weight: jnp.ndarray,  # [D, E]
@@ -120,16 +144,9 @@ def fused_moe(
     renormalize: bool = True,
     use_grouped: bool | None = None,
 ) -> jnp.ndarray:
-    """Router + experts + combine. ``use_grouped=None`` auto-selects the
-    megablox path on single-device TPU, dense one-hot otherwise."""
+    """Router + experts + combine (softmax top-k routing)."""
     router_logits = hidden.astype(jnp.float32) @ router_weight.astype(jnp.float32)
     weights, expert_ids = select_experts(router_logits, top_k, renormalize)
-    if use_grouped is None:
-        # Grouped megablox is the single-device fast path; under a multi-
-        # device mesh the dense one-hot path is the GSPMD/EP formulation.
-        use_grouped = (
-            jax.default_backend() == "tpu" and jax.device_count() == 1
-        )
-    if use_grouped:
-        return _grouped_moe(hidden, w_gate, w_up, w_down, weights, expert_ids)
-    return _dense_moe(hidden, w_gate, w_up, w_down, weights, expert_ids)
+    return fused_experts(
+        hidden, w_gate, w_up, w_down, weights, expert_ids, use_grouped
+    )

@@ -59,10 +59,20 @@ def _yarn_scale(
     )
     mask = 1.0 - ramp
     scaled = inv_freq / factor * (1 - mask) + inv_freq * mask
-    mscale = scaling.get("mscale", 1.0)
-    attn_factor = scaling.get("attn_factor", 1.0)
-    m = (0.1 * math.log(factor) + 1.0) * attn_factor if factor > 1 else 1.0 * attn_factor
-    _ = mscale
+    attn_factor = scaling.get("attn_factor", scaling.get("attention_factor", 1.0)) or 1.0
+
+    def get_mscale(scale: float, mscale: float = 1.0) -> float:
+        return 0.1 * mscale * math.log(scale) + 1.0 if scale > 1 else 1.0
+
+    if "mscale" in scaling and "mscale_all_dim" in scaling:
+        # DeepSeek yarn: the mscale ratio (HF _compute_yarn_parameters).
+        m = (
+            get_mscale(factor, scaling["mscale"])
+            / get_mscale(factor, scaling["mscale_all_dim"])
+            * attn_factor
+        )
+    else:
+        m = get_mscale(factor) * attn_factor
     return scaled, m
 
 

@@ -91,8 +91,11 @@ def load_safetensors_params(
                         (int(parts[-2]), int(parts[-1]))
                     ] = arr
                 elif len(parts) >= 2 and parts[-1].isdigit():
+                    # Stack length is inferred (not num_layers): models with
+                    # heterogeneous layer groups (e.g. DeepSeek's dense
+                    # prefix + MoE rest) keep stacks of differing lengths.
                     base = ".".join(parts[:-1])
-                    stacked.setdefault(base, [None] * L)[int(parts[-1])] = arr
+                    stacked.setdefault(base, {})[int(parts[-1])] = arr
                 else:
                     staged[dest] = arr
                 seen.add(hf_name)
@@ -142,9 +145,10 @@ def load_safetensors_params(
 
     for dest, arr in staged.items():
         put(dest, arr)
-    for base, arrs in stacked.items():
-        assert all(a is not None for a in arrs), f"missing layers for {base}"
-        put(base, np.stack(arrs, axis=0))
+    for base, by_idx in stacked.items():
+        n = max(by_idx) + 1
+        assert len(by_idx) == n, f"missing layers for {base}"
+        put(base, np.stack([by_idx[i] for i in range(n)], axis=0))
     for base, items in stacked2.items():
         n_outer = max(i for i, _ in items) + 1
         n_inner = max(j for _, j in items) + 1

@@ -25,6 +25,8 @@ _REGISTRY: dict[str, tuple[str, str]] = {
     "Gemma3ForCausalLM": ("vllm_tpu.models.gemma", "Gemma3ForCausalLM"),
     "Gemma3ForConditionalGeneration": ("vllm_tpu.models.gemma", "Gemma3ForCausalLM"),
     "MixtralForCausalLM": ("vllm_tpu.models.mixtral", "MixtralForCausalLM"),
+    "DeepseekV2ForCausalLM": ("vllm_tpu.models.deepseek", "DeepseekV2ForCausalLM"),
+    "DeepseekV3ForCausalLM": ("vllm_tpu.models.deepseek", "DeepseekV3ForCausalLM"),
 }
 
 

@@ -1,0 +1,95 @@
+"""Multi-head Latent Attention (MLA) over the paged cache.
+
+Reference analog: ``csrc/attention/mla/`` decode kernels +
+``vllm/model_executor/layers/attention/mla_attention.py:318`` and the
+``MLAAttentionSpec`` cache contract (``vllm/v1/kv_cache_interface.py:323``).
+
+MLA caches ONE latent row per token per layer instead of per-head K/V:
+``latent = [c_kv (kv_lora_rank) || k_pe (qk_rope_head_dim)]`` — e.g.
+512+64=576 numbers vs 2*KH*Dh for MHA, an ~10-50x KV-memory cut, which is
+the whole point of the scheme (DeepSeek-V2, arXiv:2405.04434).
+
+The TPU formulation runs fully *absorbed* for both prefill and decode:
+
+- queries are mapped into latent space once per step
+  (``q_lat = q_nope @ W_uk``), giving ``q_abs = [q_lat || q_pe]`` of width
+  ``kv_lora_rank + rope_dim`` per head;
+- attention scores are plain dot products against the cached latent rows
+  (MQA shape: ONE shared "KV head");
+- the context value is ``probs @ c_kv`` — i.e. the first ``kv_lora_rank``
+  lanes of the cached row — mapped back per head by W_uv *outside* this op
+  (absorbed into the output projection by the model).
+
+This keeps the cache minimal and needs no K/V re-expansion for chunked
+prefill: the absorbed math is exact at every query position. The CUDA
+reference instead materializes full per-head K/V for prefill and uses
+separate decode kernels (flashmla/cutlass_mla); on TPU one ragged gather
+formulation covers both, and XLA fuses the surrounding einsums.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from vllm_tpu.ops.attention import AttentionMetadata
+
+
+def mla_kv_cache_shape(
+    num_layers: int, num_blocks: int, block_size: int, latent_dim: int
+) -> tuple[int, int, int, int, int]:
+    """[L, NB, BS, 1, latent] — one shared latent 'head', no K/V planes."""
+    return (num_layers, num_blocks, block_size, 1, latent_dim)
+
+
+def write_latent(
+    kv_cache: jnp.ndarray,  # [L, NB, BS, 1, DL]
+    layer: jnp.ndarray,  # scalar i32
+    latent: jnp.ndarray,  # [T, DL]  (c_kv || k_pe, rope already applied)
+    slot_mapping: jnp.ndarray,  # [T]
+) -> jnp.ndarray:
+    """Scatter this step's latent rows into the paged slots (in place when
+    the cache is a donated scan carry)."""
+    nl, nb, bs, one, dl = kv_cache.shape
+    flat = kv_cache.reshape(nl * nb * bs, one, dl)
+    flat = flat.at[layer * (nb * bs) + slot_mapping].set(
+        latent[:, None, :].astype(kv_cache.dtype)
+    )
+    return flat.reshape(nl, nb, bs, one, dl)
+
+
+def mla_paged_attention(
+    q_abs: jnp.ndarray,  # [T, H, DL] absorbed queries (q_lat || q_pe)
+    kv_cache: jnp.ndarray,  # [L, NB, BS, 1, DL]
+    layer: jnp.ndarray,  # scalar i32
+    md: AttentionMetadata,
+    scale: float,
+    value_dim: int,  # = kv_lora_rank: lanes of the latent that act as V
+) -> jnp.ndarray:
+    """Ragged causal attention in latent space -> [T, H, value_dim].
+
+    MQA structure (one shared latent row per position); the per-head value
+    up-projection W_uv is applied by the caller. XLA path — a Pallas MLA
+    kernel (rpa_kernel fork with kh=1, score width DL, value width
+    ``value_dim``) is the optimization seam.
+    """
+    t, h, dl = q_abs.shape
+    nl, nb, bs, _one, _dl = kv_cache.shape
+
+    pages = kv_cache[layer, md.block_tables]  # [R, B, BS, 1, DL]
+    r, b = md.block_tables.shape
+    ctx = b * bs
+    lat_req = pages.reshape(r, ctx, dl)
+    lat_t = lat_req[md.token_req_idx].astype(jnp.float32)  # [T, C, DL]
+
+    qf = q_abs.astype(jnp.float32)
+    scores = jnp.einsum("thd,tcd->thc", qf, lat_t) * scale
+
+    local = jnp.arange(ctx, dtype=jnp.int32)[None, :]
+    causal = local <= md.positions[:, None]  # [T, C]
+    scores = jnp.where(causal[:, None, :], scores, -jnp.inf)
+
+    probs = jax.nn.softmax(scores, axis=-1)
+    probs = jnp.where(jnp.isnan(probs), 0.0, probs)  # padding rows
+    out = jnp.einsum("thc,tcd->thd", probs, lat_t[..., :value_dim])
+    return out.astype(q_abs.dtype)

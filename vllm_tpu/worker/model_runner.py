@@ -1331,13 +1331,19 @@ class ModelRunner:
         from vllm_tpu.ops.attention import kv_cache_shape
 
         cache = self.config.cache_config
-        kv_shape = kv_cache_shape(
-            self.model.num_layers,
-            self.num_kv_blocks,
-            cache.block_size,
-            self.model.num_kv_heads,
-            self.model.head_dim,
-        )
+        custom_shape = getattr(self.model, "kv_cache_shape", None)
+        if custom_shape is not None:
+            # Model-defined geometry (MLA latent cache: one shared row per
+            # token instead of K/V planes).
+            kv_shape = custom_shape(self.num_kv_blocks, cache.block_size)
+        else:
+            kv_shape = kv_cache_shape(
+                self.model.num_layers,
+                self.num_kv_blocks,
+                cache.block_size,
+                self.model.num_kv_heads,
+                self.model.head_dim,
+            )
         kv_dtype = self._kv_dtype()
         kv = jnp.zeros(kv_shape, kv_dtype)
         if self.mesh is not None:
