@@ -456,7 +456,10 @@ class Scheduler:
                 self.kv_connector is not None
                 and request.num_computed_tokens == 0
                 and request.block_hashes
-                and not wants_prompt_lp  # external hits skip compute too
+                # External hits skip compute too: same exclusions as the
+                # device prefix-cache path above.
+                and not wants_prompt_lp
+                and not is_mean_pooling
             ):
                 num_external_tokens = (
                     self.kv_connector.get_num_new_matched_tokens(

@@ -50,6 +50,19 @@ def run_coordinator(report_addr: str, pub_addr: str,
     from vllm_tpu.logger import init_logger
 
     logger = init_logger("vllm_tpu.engine.coordinator")
+
+    # A predecessor killed uncleanly (OOM/SIGKILL) leaves its ipc socket
+    # files behind, and bind() on them raises EADDRINUSE — which would
+    # turn the client's respawn loop into instantly-dying processes.
+    import os
+
+    for addr in (report_addr, pub_addr):
+        if addr.startswith("ipc://"):
+            try:
+                os.unlink(addr[len("ipc://"):])
+            except FileNotFoundError:
+                pass
+
     ctx = zmq.Context(1)
     report = ctx.socket(zmq.PULL)
     report.bind(report_addr)

@@ -356,13 +356,14 @@ class DPLBClient(_ZMQClientBase):
     Reference analog: ``vllm/v1/engine/core_client.py:1317``
     (DPLBAsyncMPClient) + ``coordinator.py``. Each engine PUSHes outputs to
     one shared PULL socket (fan-in); requests are routed per-engine over
-    dedicated PUSH sockets. Routing load is tracked client-side (adds minus
-    finishes per engine — exact, since every request passes through this
-    client), with coordinator snapshots merged in as a correction for any
-    engine-side queue growth (e.g. long prefills held in waiting).
-    The client also reports its total in-flight count to the coordinator so
-    a request in flight to an engine keeps the wave open (the reference
-    attaches wave numbers to requests for the same race).
+    dedicated PUSH sockets. Routing load is tracked client-side only (adds
+    minus finishes per engine — exact, since every request passes through
+    this client); coordinator snapshots feed the wave state and
+    observability, not routing (they cover a subset of the same requests,
+    so summing them in would double-count). The client also reports its
+    total in-flight count to the coordinator so a request in flight to an
+    engine keeps the wave open (the reference attaches wave numbers to
+    requests for the same race).
     """
 
     def __init__(self, config: EngineConfig, ready_timeout_s: float = 600.0):

@@ -1,0 +1,316 @@
+"""Mamba2 (pure-SSM decoder, e.g. mamba2-130m..2.7b, Codestral Mamba).
+
+Reference analog: ``vllm/model_executor/models/mamba2.py`` + the
+``MambaSpec``/``MambaManager`` constant-size state contract. HF semantics
+(``transformers/models/mamba2/modeling_mamba2.py`` torch_forward) are
+matched exactly; the recurrence runs as one segment-aware associative
+scan over the flat ragged batch (``ops/mamba.py``).
+
+State cache (NOT paged — O(1) per request):
+
+    {"conv": [L, NB, conv_dim, K-1] f32, "ssm": [L, NB, H, P, N] f32}
+
+``NB`` request slots; a request's slot is its single MambaSpec block id
+(block_size is overridden to max_model_len by the worker for pure-SSM
+models, so every request holds exactly one block). Prefix caching is
+disabled — SSM state is not content-addressable per block.
+
+Param tree::
+
+    embed          [V, D]
+    layers/        every leaf stacked [L, ...]
+      norm         [L, D]
+      in_proj      [L, D, I + conv_dim + H]   (gate | xBC | dt)
+      conv_w       [L, conv_dim, K]   conv_b [L, conv_dim]
+      dt_bias      [L, H]   a_log [L, H]   d_skip [L, H]
+      gated_norm   [L, I]
+      out_proj     [L, I, D]
+    final_norm     [D]            (lm_head = embed.T when tied)
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from vllm_tpu.core.kv_cache_utils import KVCacheSpec, MambaSpec
+from vllm_tpu.layers.layernorm import rms_norm
+from vllm_tpu.logger import init_logger
+from vllm_tpu.ops.attention import AttentionMetadata
+from vllm_tpu.ops.mamba import ragged_causal_conv, ragged_ssd_scan
+
+logger = init_logger(__name__)
+
+
+class Mamba2ForCausalLM:
+    supports_lora = False
+    enable_lora = False
+    # Pure-SSM: the worker flips the cache to one-block-per-request and
+    # disables prefix caching when it sees this.
+    is_stateful_ssm = True
+
+    def __init__(self, hf_config: Any, dtype=jnp.bfloat16,
+                 quantization: str | None = None) -> None:
+        if quantization:
+            logger.warning(
+                "weight quantization is not yet supported for SSM models; "
+                "running %s unquantized", type(self).__name__,
+            )
+        c = hf_config
+        self.hf_config = c
+        self.dtype = dtype
+        self.quantization = None
+        self.num_layers = c.num_hidden_layers
+        self.hidden_size = c.hidden_size
+        self.vocab_size = c.vocab_size
+        self.rms_eps = getattr(c, "layer_norm_epsilon", 1e-5)
+        self.tie_embeddings = getattr(c, "tie_word_embeddings", True)
+
+        self.num_heads = c.num_heads
+        self.head_dim = c.head_dim  # SSM head dim (P), not attention
+        self.num_kv_heads = 1  # protocol filler; cache is the SSM state
+        self.state_size = c.state_size  # N
+        self.n_groups = getattr(c, "n_groups", 1)
+        self.conv_kernel = c.conv_kernel  # K
+        self.intermediate = int(getattr(c, "expand", 2) * c.hidden_size)
+        assert self.intermediate == self.num_heads * self.head_dim, (
+            "intermediate_size must equal num_heads * head_dim"
+        )
+        self.conv_dim = (
+            self.intermediate + 2 * self.n_groups * self.state_size
+        )
+        self.use_conv_bias = getattr(c, "use_conv_bias", True)
+        self.use_bias = getattr(c, "use_bias", False)
+        lo, hi = getattr(c, "time_step_limit", (0.0, float("inf")))
+        self.dt_limit = (float(lo), float(hi))
+
+    # ------------------------------------------------------------------
+    # Params
+    # ------------------------------------------------------------------
+
+    def init_dummy_params(self, rng: jax.Array, dtype=None) -> dict:
+        dtype = dtype or self.dtype
+        L, D, I, H = (
+            self.num_layers, self.hidden_size, self.intermediate,
+            self.num_heads,
+        )
+        proj = I + self.conv_dim + H
+        keys = jax.random.split(rng, 6)
+
+        def init(key, shape, fan_in):
+            return (
+                jax.random.normal(key, shape, jnp.float32)
+                / math.sqrt(fan_in)
+            ).astype(dtype)
+
+        layers = {
+            "norm": jnp.ones((L, D), dtype),
+            "in_proj": init(keys[0], (L, D, proj), D),
+            "conv_w": init(keys[1], (L, self.conv_dim, self.conv_kernel), self.conv_kernel),
+            "dt_bias": jnp.ones((L, H), dtype),
+            "a_log": jnp.log(
+                jnp.broadcast_to(
+                    jnp.arange(1, H + 1, dtype=jnp.float32), (L, H)
+                )
+            ).astype(dtype),
+            "d_skip": jnp.ones((L, H), dtype),
+            "gated_norm": jnp.ones((L, I), dtype),
+            "out_proj": init(keys[2], (L, I, D), I),
+        }
+        if self.use_conv_bias:
+            layers["conv_b"] = jnp.zeros((L, self.conv_dim), dtype)
+        params = {
+            "embed": init(keys[3], (self.vocab_size, D), D),
+            "layers": layers,
+            "final_norm": jnp.ones((D,), dtype),
+        }
+        if not self.tie_embeddings:
+            params["lm_head"] = init(keys[4], (D, self.vocab_size), D)
+        return params
+
+    def hf_weight_map(self) -> dict:
+        m = {
+            "backbone.embeddings.weight": ("embed", False),
+            "backbone.norm_f.weight": ("final_norm", False),
+        }
+        if not self.tie_embeddings:
+            m["lm_head.weight"] = ("lm_head", True)
+        per_layer = {
+            "norm.weight": ("norm", False),
+            "mixer.in_proj.weight": ("in_proj", True),
+            "mixer.conv1d.weight": ("conv_w", False),  # [C,1,K] squeezed
+            "mixer.dt_bias": ("dt_bias", False),
+            "mixer.A_log": ("a_log", False),
+            "mixer.D": ("d_skip", False),
+            "mixer.norm.weight": ("gated_norm", False),
+            "mixer.out_proj.weight": ("out_proj", True),
+        }
+        if self.use_conv_bias:
+            per_layer["mixer.conv1d.bias"] = ("conv_b", False)
+        for i in range(self.num_layers):
+            for hf_name, (ours, tr) in per_layer.items():
+                m[f"backbone.layers.{i}.{hf_name}"] = (f"layers.{ours}.{i}", tr)
+        return m
+
+    def postprocess_weight(self, leaf_path: str, arr):
+        if leaf_path == "layers.conv_w":
+            return arr.squeeze(2)  # [L, C, 1, K] -> [L, C, K]
+        return arr
+
+    def load_params(self, path: str, dtype=None, shardings: Any | None = None) -> dict:
+        from vllm_tpu.models.loader import load_safetensors_params
+
+        return load_safetensors_params(self, path, dtype or self.dtype, shardings)
+
+    # ------------------------------------------------------------------
+    # Forward
+    # ------------------------------------------------------------------
+
+    def apply(
+        self,
+        params: dict,
+        kv_cache: dict,  # {"conv": [L,NB,C,K-1], "ssm": [L,NB,H,P,N]}
+        input_ids: jnp.ndarray,  # [T]
+        md: AttentionMetadata,
+        token_lora_slot: jnp.ndarray | None = None,  # unused
+    ) -> tuple[jnp.ndarray, dict]:
+        x = params["embed"][input_ids].astype(self.dtype)
+        t = x.shape[0]
+        I, H, Pd, N = (
+            self.intermediate, self.num_heads, self.head_dim,
+            self.state_size,
+        )
+        G = self.n_groups
+
+        # Per-request state slot = the single MambaSpec block.
+        slots = md.block_tables[:, 0]  # [R]
+        # Fresh sequences (chunk starts at position 0) seed zero state.
+        first_pos = md.positions[jnp.clip(md.query_start_loc[:-1], 0, t - 1)]
+        fresh = first_pos == 0  # [R]
+
+        def layer_fn(carry, inputs):
+            x, conv_c, ssm_c = carry
+            lp, li = inputs
+            h = rms_norm(x, lp["norm"], self.rms_eps)
+            proj = h @ lp["in_proj"]
+            gate = proj[:, :I]
+            x_bc = proj[:, I : I + self.conv_dim]
+            dt_raw = proj[:, I + self.conv_dim :]  # [T, H]
+
+            conv_seed = jnp.where(
+                fresh[:, None, None], 0.0, conv_c[li, slots]
+            )  # [R, C, K-1]
+            x_bc_conv, new_conv = ragged_causal_conv(
+                x_bc, conv_seed, lp["conv_w"],
+                lp.get("conv_b"), md.token_req_idx, md.query_start_loc,
+            )
+            x_bc_conv = jax.nn.silu(x_bc_conv.astype(jnp.float32))
+
+            xs = x_bc_conv[:, :I].reshape(t, H, Pd)
+            b = x_bc_conv[:, I : I + G * N].reshape(t, G, N)
+            c = x_bc_conv[:, I + G * N :].reshape(t, G, N)
+            rep = H // G
+            b = jnp.repeat(b, rep, axis=1)  # [T, H, N]
+            c = jnp.repeat(c, rep, axis=1)
+
+            dt = jax.nn.softplus(
+                dt_raw.astype(jnp.float32) + lp["dt_bias"].astype(jnp.float32)
+            )
+            dt = jnp.clip(dt, self.dt_limit[0], self.dt_limit[1])
+
+            ssm_seed = jnp.where(
+                fresh[:, None, None, None], 0.0, ssm_c[li, slots]
+            )  # [R, H, P, N]
+            y, new_ssm = ragged_ssd_scan(
+                xs, dt, lp["a_log"].astype(jnp.float32), b, c, ssm_seed,
+                md.token_req_idx, md.query_start_loc,
+            )
+            y = y + lp["d_skip"].astype(y.dtype)[None, :, None] * xs
+
+            # Gated RMSNorm over the full intermediate vector (HF
+            # MambaRMSNormGated): y * silu(gate), then normalize.
+            yf = y.reshape(t, I).astype(jnp.float32)
+            yf = yf * jax.nn.silu(gate.astype(jnp.float32))
+            var = jnp.mean(yf * yf, axis=-1, keepdims=True)
+            yf = yf * jax.lax.rsqrt(var + self.rms_eps)
+            yf = (lp["gated_norm"].astype(jnp.float32) * yf).astype(self.dtype)
+
+            x = x + yf @ lp["out_proj"]
+            conv_c = conv_c.at[li, slots].set(new_conv)
+            ssm_c = ssm_c.at[li, slots].set(new_ssm)
+            return (x, conv_c, ssm_c), None
+
+        (x, conv_c, ssm_c), _ = jax.lax.scan(
+            layer_fn,
+            (x, kv_cache["conv"], kv_cache["ssm"]),
+            (params["layers"], jnp.arange(self.num_layers, dtype=jnp.int32)),
+        )
+        x = rms_norm(x, params["final_norm"], self.rms_eps)
+        return x, {"conv": conv_c, "ssm": ssm_c}
+
+    def compute_logits(self, params: dict, hidden: jnp.ndarray) -> jnp.ndarray:
+        head = params["embed"].T if self.tie_embeddings else params["lm_head"]
+        return (hidden @ head.astype(hidden.dtype)).astype(jnp.float32)
+
+    # ------------------------------------------------------------------
+    # Runner contracts
+    # ------------------------------------------------------------------
+
+    def _state_elems_per_layer(self) -> int:
+        return (
+            self.conv_dim * (self.conv_kernel - 1)
+            + self.num_heads * self.head_dim * self.state_size
+        )
+
+    def get_kv_cache_spec(self, block_size: int, dtype_bytes: int) -> dict[str, KVCacheSpec]:
+        # State is kept in f32 regardless of cache dtype (recurrence
+        # stability; HF keeps ssm_states f32 too).
+        spec = MambaSpec(
+            block_size=block_size,
+            num_kv_heads=self.num_heads,
+            head_size=self.head_dim,
+            dtype_bytes=4,
+            state_shape=(self._state_elems_per_layer(),),
+        )
+        return {f"layers.{i}": spec for i in range(self.num_layers)}
+
+    def alloc_kv_cache(self, num_blocks: int, block_size: int, dtype) -> dict:
+        L, K = self.num_layers, self.conv_kernel
+        return {
+            "conv": jnp.zeros(
+                (L, num_blocks, self.conv_dim, K - 1), jnp.float32
+            ),
+            "ssm": jnp.zeros(
+                (L, num_blocks, self.num_heads, self.head_dim,
+                 self.state_size),
+                jnp.float32,
+            ),
+        }
+
+    def param_shardings(self, data_axis: str | None = None, model_axis: str = "tp") -> dict:
+        """Replicated for now: the in_proj output axis interleaves
+        gate/xBC/dt segments, so head-sharding needs a segment-aware
+        split (future work — mirrors the reference's Mamba TP gap)."""
+        layers = {k: P(*([None] * 3)) for k in ("in_proj", "conv_w", "out_proj")}
+        for k in ("norm", "dt_bias", "a_log", "d_skip", "gated_norm"):
+            layers[k] = P(None, None)
+        if self.use_conv_bias:
+            layers["conv_b"] = P(None, None)
+        out = {
+            "embed": P(None, None),
+            "layers": layers,
+            "final_norm": P(None),
+        }
+        if not self.tie_embeddings:
+            out["lm_head"] = P(None, None)
+        return out
+
+    def kv_cache_sharding(self, model_axis: str = "tp") -> dict:
+        return {
+            "conv": P(None, None, None, None),
+            "ssm": P(None, None, None, None, None),
+        }

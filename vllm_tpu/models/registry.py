@@ -27,6 +27,7 @@ _REGISTRY: dict[str, tuple[str, str]] = {
     "MixtralForCausalLM": ("vllm_tpu.models.mixtral", "MixtralForCausalLM"),
     "DeepseekV2ForCausalLM": ("vllm_tpu.models.deepseek", "DeepseekV2ForCausalLM"),
     "DeepseekV3ForCausalLM": ("vllm_tpu.models.deepseek", "DeepseekV3ForCausalLM"),
+    "Mamba2ForCausalLM": ("vllm_tpu.models.mamba2", "Mamba2ForCausalLM"),
 }
 
 

@@ -1,0 +1,128 @@
+"""Mamba2 (SSD) selective-state ops over the ragged token batch.
+
+Reference analog: ``csrc/mamba/mamba_ssm/selective_scan_fwd.cu`` (Mamba1)
+and the Mamba2 kernels the reference imports from ``mamba_ssm``; cache
+contract ``MambaSpec`` (``vllm/v1/kv_cache_interface.py:531``) and the
+per-request constant-size state of ``MambaManager``.
+
+TPU-first formulation: ONE flat ragged [T] token batch (mixed chunked
+prefills + decodes, same layout the attention path uses) processed by
+
+- a gather-based causal depthwise conv whose left context comes from the
+  per-request cached conv tail, and
+- a segment-aware ``jax.lax.associative_scan`` over the flat axis for the
+  SSD recurrence ``H_t = a_t H_{t-1} + dt_t B_t x_t^T`` — a_t is scalar
+  per head in Mamba2, so the whole recurrence is a first-order linear
+  scan; request boundaries reset the decay (a=0) and seed the cached
+  state into the first element, which makes one scan exact across all
+  requests in the batch.
+
+The state cache is request-slot addressed (slot = the request's single
+MambaSpec block id), not paged: SSM state is O(1) in sequence length —
+that is the point of the architecture.
+
+The O(T·H·P·N) materialization of ``dBx`` is the correctness-first
+choice; the chunked SSD matmul formulation (intra-chunk attention-like
+GEMMs + inter-chunk state scan) is the optimization seam for long
+prefills, same role the fused ``mamba_chunk_scan`` kernels play on CUDA.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def ragged_causal_conv(
+    x: jnp.ndarray,  # [T, C] conv inputs (this step, pre-activation)
+    conv_state: jnp.ndarray,  # [R, C, K-1] cached tail per request (seeded)
+    weight: jnp.ndarray,  # [C, K] depthwise taps (tap K-1 = current token)
+    bias: jnp.ndarray | None,  # [C]
+    token_req_idx: jnp.ndarray,  # [T] owning request row
+    query_start_loc: jnp.ndarray,  # [R+1] ragged offsets
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Causal depthwise conv with cached left context.
+
+    Returns (y [T, C], new_conv_state [R, C, K-1]) where the new state is
+    each request's last K-1 conv inputs (zero-padded history preserved).
+    """
+    t, c = x.shape
+    k = weight.shape[1]
+    ts = jnp.arange(t, dtype=jnp.int32)
+    chunk_start = query_start_loc[token_req_idx]  # [T] flat chunk starts
+    pos_in_chunk = ts - chunk_start
+
+    def window_at(s: jnp.ndarray) -> jnp.ndarray:
+        """Conv input s steps back from each token: from this chunk when
+        available, else from the request's cached tail."""
+        in_chunk = pos_in_chunk >= s
+        from_flat = x[jnp.clip(ts - s, 0)]
+        # Cached tail col K-2 is the newest pre-chunk input.
+        col = jnp.clip(k - 1 - s + pos_in_chunk, 0, k - 2)
+        from_state = conv_state[token_req_idx, :, col]
+        return jnp.where(in_chunk[:, None], from_flat, from_state)
+
+    # win[:, j] = input (k-1-j) steps back; j = k-1 is the current token.
+    win = jnp.stack([window_at(k - 1 - j) for j in range(k)], axis=1)
+    y = jnp.einsum("tjc,cj->tc", win.astype(jnp.float32),
+                   weight.astype(jnp.float32))
+    if bias is not None:
+        y = y + bias.astype(jnp.float32)
+
+    # New tail per request: the window (minus the oldest column) at each
+    # request's last scheduled token.
+    last = jnp.maximum(query_start_loc[1:] - 1, 0)  # [R]
+    new_state = win[last][:, 1:, :].transpose(0, 2, 1)  # [R, C, K-1]
+    return y.astype(x.dtype), new_state.astype(conv_state.dtype)
+
+
+def ragged_ssd_scan(
+    x: jnp.ndarray,  # [T, H, P] conv-activated inputs
+    dt: jnp.ndarray,  # [T, H] softplus-ed, clamped step sizes
+    a_log: jnp.ndarray,  # [H] A_log parameter (A = -exp(A_log))
+    b: jnp.ndarray,  # [T, H, N] input gates (group-expanded)
+    c: jnp.ndarray,  # [T, H, N] output gates (group-expanded)
+    h0: jnp.ndarray,  # [R, H, P, N] cached state per request (seeded)
+    token_req_idx: jnp.ndarray,  # [T]
+    query_start_loc: jnp.ndarray,  # [R+1]
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Segment-aware first-order linear scan (the SSD recurrence).
+
+    Returns (y [T, H, P], new_state [R, H, P, N] at each request's last
+    scheduled token).
+    """
+    t = x.shape[0]
+    xf = x.astype(jnp.float32)
+    dtf = dt.astype(jnp.float32)
+    af = -jnp.exp(a_log.astype(jnp.float32))  # [H], negative
+    decay = jnp.exp(dtf * af[None, :])  # [T, H]
+
+    # dBx contribution per token.
+    u = (
+        (dtf[..., None] * b.astype(jnp.float32))[:, :, None, :]
+        * xf[..., None]
+    )  # [T, H, P, N]
+
+    # Request boundaries: zero the decay (no cross-request flow) and fold
+    # the cached state into the first element of each segment.
+    ts = jnp.arange(t, dtype=jnp.int32)
+    is_first = ts == query_start_loc[token_req_idx]
+    h0_tok = h0[token_req_idx]  # [T, H, P, N]
+    u = u + jnp.where(
+        is_first[:, None, None, None],
+        decay[..., None, None] * h0_tok,
+        0.0,
+    )
+    decay = jnp.where(is_first[:, None], 0.0, decay)
+
+    def combine(left, right):
+        a1, u1 = left
+        a2, u2 = right
+        return a1 * a2, a2[..., None, None] * u1 + u2
+
+    _, h_all = jax.lax.associative_scan(combine, (decay, u), axis=0)
+    y = jnp.einsum("thpn,thn->thp", h_all, c.astype(jnp.float32))
+
+    last = jnp.maximum(query_start_loc[1:] - 1, 0)
+    new_state = h_all[last]  # [R, H, P, N]
+    return y.astype(x.dtype), new_state.astype(h0.dtype)

@@ -1331,32 +1331,44 @@ class ModelRunner:
         from vllm_tpu.ops.attention import kv_cache_shape
 
         cache = self.config.cache_config
-        custom_shape = getattr(self.model, "kv_cache_shape", None)
-        if custom_shape is not None:
-            # Model-defined geometry (MLA latent cache: one shared row per
-            # token instead of K/V planes).
-            kv_shape = custom_shape(self.num_kv_blocks, cache.block_size)
-        else:
-            kv_shape = kv_cache_shape(
-                self.model.num_layers,
-                self.num_kv_blocks,
-                cache.block_size,
-                self.model.num_kv_heads,
-                self.model.head_dim,
-            )
         kv_dtype = self._kv_dtype()
-        kv = jnp.zeros(kv_shape, kv_dtype)
+        custom_alloc = getattr(self.model, "alloc_kv_cache", None)
+        custom_shape = getattr(self.model, "kv_cache_shape", None)
+        if custom_alloc is not None:
+            # Model-defined state pytree (SSM conv+state buffers).
+            kv = custom_alloc(self.num_kv_blocks, cache.block_size, kv_dtype)
+        else:
+            if custom_shape is not None:
+                # Model-defined geometry (MLA latent cache: one shared row
+                # per token instead of K/V planes).
+                kv_shape = custom_shape(self.num_kv_blocks, cache.block_size)
+            else:
+                kv_shape = kv_cache_shape(
+                    self.model.num_layers,
+                    self.num_kv_blocks,
+                    cache.block_size,
+                    self.model.num_kv_heads,
+                    self.model.head_dim,
+                )
+            kv = jnp.zeros(kv_shape, kv_dtype)
         if self.mesh is not None:
             from jax.sharding import NamedSharding
 
-            kv = jax.device_put(
-                kv, NamedSharding(self.mesh, self.model.kv_cache_sharding())
+            kv = jax.tree.map(
+                lambda leaf, spec: jax.device_put(
+                    leaf, NamedSharding(self.mesh, spec)
+                ),
+                kv,
+                self.model.kv_cache_sharding(),
+                is_leaf=lambda n: isinstance(n, jnp.ndarray),
             )
         logger.info(
-            "KV cache allocated: %s %s (%.2f GiB)",
-            kv_shape,
-            kv_dtype,
-            np.prod(kv_shape) * jnp.dtype(kv_dtype).itemsize / 2**30,
+            "KV cache allocated: %s (%.2f GiB)",
+            jax.tree.map(lambda a: (a.shape, str(a.dtype)), kv),
+            sum(
+                leaf.size * leaf.dtype.itemsize
+                for leaf in jax.tree_util.tree_leaves(kv)
+            ) / 2**30,
         )
         return kv
 

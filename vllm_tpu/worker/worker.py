@@ -251,6 +251,16 @@ class Worker:
         """Full startup; returns the KV block count for the scheduler."""
         self.init_device()
         self.load_model()
+        if getattr(self.model, "is_stateful_ssm", False):
+            # Pure-SSM models: constant-size per-request state, so one
+            # "block" = the whole sequence (reference MambaSpec block_size
+            # semantics) and prefix caching is meaningless (state is not
+            # content-addressable per block).
+            cache = self.config.cache_config
+            cache.block_size = self.config.model_config.max_model_len
+            if cache.enable_prefix_caching:
+                logger.info("prefix caching disabled for SSM model")
+                cache.enable_prefix_caching = False
         num_blocks = self.determine_num_kv_blocks()
         self.config.cache_config.num_gpu_blocks = num_blocks
         self.runner = ModelRunner(
