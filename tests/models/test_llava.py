@@ -1,0 +1,199 @@
+"""Llava multimodal tests: HF greedy parity (full + chunked prefill),
+encoder-cache budget behavior, placeholder expansion.
+
+Protocol of the reference's ``tests/models/multimodal`` (tiny-config HF
+parity) + ``tests/v1/core`` encoder-budget unit tests.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+
+def tiny_llava_config():
+    from transformers import CLIPVisionConfig, LlamaConfig, LlavaConfig
+
+    vision = CLIPVisionConfig(
+        hidden_size=32,
+        intermediate_size=64,
+        num_hidden_layers=3,
+        num_attention_heads=4,
+        image_size=16,
+        patch_size=8,
+        num_channels=3,
+    )
+    text = LlamaConfig(
+        vocab_size=128,
+        hidden_size=64,
+        intermediate_size=128,
+        num_hidden_layers=2,
+        num_attention_heads=4,
+        num_key_value_heads=2,
+        max_position_embeddings=256,
+        tie_word_embeddings=False,
+    )
+    return LlavaConfig(
+        vision_config=vision,
+        text_config=text,
+        image_token_index=99,
+        vision_feature_layer=-2,
+        vision_feature_select_strategy="default",
+        projector_hidden_act="gelu",
+    )
+
+
+@pytest.fixture(scope="module")
+def tiny_llava(tmp_path_factory):
+    import torch
+    from transformers import LlavaForConditionalGeneration
+
+    torch.manual_seed(0)
+    model = LlavaForConditionalGeneration(tiny_llava_config()).to(
+        torch.float32
+    )
+    path = tmp_path_factory.mktemp("tiny_llava")
+    model.save_pretrained(str(path), safe_serialization=True)
+    return str(path)
+
+
+IMG_TOK = 99
+N_PATCH = 4  # (16/8)^2
+
+
+def _pixels(seed):
+    rng = np.random.default_rng(seed)
+    return rng.standard_normal((3, 16, 16)).astype(np.float32)
+
+
+def _hf_generate(path, expanded_ids, pixel_list, max_new):
+    import torch
+    from transformers import LlavaForConditionalGeneration
+
+    hf = LlavaForConditionalGeneration.from_pretrained(
+        path, torch_dtype=torch.float32
+    )
+    hf.eval()
+    with torch.no_grad():
+        out = hf.generate(
+            input_ids=torch.tensor([expanded_ids]),
+            pixel_values=torch.tensor(np.stack(pixel_list)),
+            max_new_tokens=max_new,
+            do_sample=False,
+        )
+    return out[0][len(expanded_ids):].tolist()
+
+
+@pytest.mark.parametrize("budget", [128, 8])  # 8 chunks across the image
+def test_llava_e2e_greedy_matches_hf(tiny_llava, budget):
+    from vllm_tpu import LLM, SamplingParams
+
+    px = _pixels(1)
+    prompt = [5, 11, IMG_TOK, 23, 42]
+    expanded = [5, 11] + [IMG_TOK] * N_PATCH + [23, 42]
+    want = _hf_generate(tiny_llava, expanded, [px], 6)
+
+    llm = LLM(
+        model=tiny_llava, dtype="float32", max_model_len=128, block_size=16,
+        num_gpu_blocks_override=64, max_num_seqs=4,
+        max_num_batched_tokens=budget,
+    )
+    [out] = llm.generate(
+        [{
+            "prompt_token_ids": prompt,
+            "multi_modal_data": {"image": px},
+        }],
+        SamplingParams(temperature=0.0, max_tokens=6, ignore_eos=True),
+    )
+    assert out.outputs[0].token_ids == want
+
+
+def test_llava_two_images_and_text_only_mix(tiny_llava):
+    """Two images in one prompt + a text-only request in the same batch."""
+    from vllm_tpu import LLM, SamplingParams
+
+    px1, px2 = _pixels(2), _pixels(3)
+    prompt = [5, IMG_TOK, 7, IMG_TOK, 9]
+    expanded = (
+        [5] + [IMG_TOK] * N_PATCH + [7] + [IMG_TOK] * N_PATCH + [9]
+    )
+    want = _hf_generate(tiny_llava, expanded, [px1, px2], 5)
+
+    llm = LLM(
+        model=tiny_llava, dtype="float32", max_model_len=128, block_size=16,
+        num_gpu_blocks_override=64, max_num_seqs=4,
+        max_num_batched_tokens=128,
+    )
+    outs = llm.generate(
+        [
+            {
+                "prompt_token_ids": prompt,
+                "multi_modal_data": {"image": [px1, px2]},
+            },
+            {"prompt_token_ids": [8, 6, 4]},
+        ],
+        SamplingParams(temperature=0.0, max_tokens=5, ignore_eos=True),
+    )
+    assert outs[0].outputs[0].token_ids == want
+    assert len(outs[1].outputs[0].token_ids) == 5
+
+
+def test_encoder_cache_manager_budget():
+    from vllm_tpu.core.encoder_cache_manager import EncoderCacheManager
+
+    m = EncoderCacheManager(10)
+    assert m.can_allocate(10) and not m.can_allocate(11)
+    m.allocate("a", 0, 6)
+    assert m.has("a", 0)
+    assert not m.can_allocate(6)
+    m.allocate("b", 0, 4)
+    assert not m.can_allocate(1)
+    assert m.free_input("a", 0)
+    assert not m.free_input("a", 0)  # double-free is a no-op
+    assert m.can_allocate(6)
+    m.allocate("b", 1, 5)
+    assert sorted(m.free_request("b")) == [("b", 0), ("b", 1)]
+    assert m.used == 0
+
+
+def test_encoder_budget_trims_chunk(tiny_llava):
+    """With budget for one image, a two-image prompt still completes:
+    the second span waits for the first encoder output to be freed."""
+    from vllm_tpu import LLM, SamplingParams
+    from vllm_tpu.engine.arg_utils import EngineArgs
+
+    px1, px2 = _pixels(4), _pixels(5)
+    prompt = [5, IMG_TOK, 7, IMG_TOK, 9]
+    expanded = (
+        [5] + [IMG_TOK] * N_PATCH + [7] + [IMG_TOK] * N_PATCH + [9]
+    )
+    want = _hf_generate(tiny_llava, expanded, [px1, px2], 4)
+
+    llm = LLM(
+        model=tiny_llava, dtype="float32", max_model_len=128, block_size=16,
+        num_gpu_blocks_override=64, max_num_seqs=4,
+        max_num_batched_tokens=128,
+    )
+    # Shrink the encoder budget to exactly one image.
+    core = llm.llm_engine.engine_core.engine_core
+    core.scheduler.encoder_cache_manager.budget = N_PATCH
+    [out] = llm.generate(
+        [{
+            "prompt_token_ids": prompt,
+            "multi_modal_data": {"image": [px1, px2]},
+        }],
+        SamplingParams(temperature=0.0, max_tokens=4, ignore_eos=True),
+    )
+    assert out.outputs[0].token_ids == want
+
+
+def test_expand_mm_prompt_validation():
+    from vllm_tpu.multimodal import expand_mm_prompt
+
+    with pytest.raises(ValueError, match="placeholder"):
+        expand_mm_prompt([1, 2, 3], [_pixels(0)], 99, 4, 16)
+    ids, mm = expand_mm_prompt(
+        [1, 99, 2], [_pixels(0)], 99, 4, 16
+    )
+    assert ids == [1, 99, 99, 99, 99, 2]
+    assert mm[0].offset == 1 and mm[0].num_tokens == 4

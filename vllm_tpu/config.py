@@ -182,6 +182,9 @@ class SchedulerConfig:
     num_lookahead_tokens: int = 0
     # Long-prefill throttle (reference: long_prefill_token_threshold).
     long_prefill_token_threshold: int = 0
+    # Multimodal encoder-output cache budget in encoder tokens (reference:
+    # EncoderCacheManager / max_num_encoder_input_tokens).
+    encoder_cache_budget: int = 4096
     policy: Literal["fcfs", "priority"] = "fcfs"
 
     def __post_init__(self) -> None:

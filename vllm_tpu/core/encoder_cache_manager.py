@@ -1,0 +1,41 @@
+"""Budgeted cache of multimodal-encoder outputs shared across steps.
+
+Reference analog: ``vllm/v1/core/encoder_cache_manager.py`` (381 LoC).
+The scheduler allocates space (in encoder tokens) before scheduling the
+placeholder span; the worker holds the actual device arrays and drops
+them on the free list the scheduler ships in SchedulerOutput.
+"""
+
+from __future__ import annotations
+
+
+class EncoderCacheManager:
+    def __init__(self, budget_tokens: int) -> None:
+        self.budget = budget_tokens
+        self.used = 0
+        # (req_id, input_index) -> size in encoder tokens
+        self.cached: dict[tuple[str, int], int] = {}
+
+    def has(self, req_id: str, idx: int) -> bool:
+        return (req_id, idx) in self.cached
+
+    def can_allocate(self, num_tokens: int) -> bool:
+        return self.used + num_tokens <= self.budget
+
+    def allocate(self, req_id: str, idx: int, num_tokens: int) -> None:
+        assert (req_id, idx) not in self.cached
+        self.cached[(req_id, idx)] = num_tokens
+        self.used += num_tokens
+
+    def free_input(self, req_id: str, idx: int) -> bool:
+        size = self.cached.pop((req_id, idx), None)
+        if size is None:
+            return False
+        self.used -= size
+        return True
+
+    def free_request(self, req_id: str) -> list[tuple[str, int]]:
+        keys = [k for k in self.cached if k[0] == req_id]
+        for k in keys:
+            self.used -= self.cached.pop(k)
+        return keys

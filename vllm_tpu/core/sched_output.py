@@ -65,6 +65,11 @@ class SchedulerOutput:
     # Structured output: req_id -> row index into the grammar bitmask.
     structured_output_request_ids: dict[str, int] = field(default_factory=dict)
     grammar_bitmask: Any = None
+    # Multimodal: req_id -> mm-input indexes whose encoder must run this
+    # step (budget already reserved), and encoder-cache entries the worker
+    # should drop (spans fully computed / request gone).
+    scheduled_encoder_inputs: dict[str, list[int]] = field(default_factory=dict)
+    free_encoder_input_ids: list[tuple[str, int]] = field(default_factory=list)
     # In-proc identity of each scheduled Request at schedule time. Async
     # scheduling leaves steps in flight after a request finishes; if a NEW
     # request reuses the id before the stale step drains, update_from_output

@@ -122,6 +122,15 @@ class Scheduler:
         # (block_ids, keys) save records awaiting shipment to the runner.
         self._pending_kv_saves: list[tuple] = []
 
+        from vllm_tpu.core.encoder_cache_manager import EncoderCacheManager
+
+        self.encoder_cache_manager = EncoderCacheManager(
+            scheduler_config.encoder_cache_budget
+        )
+        # Worker-side encoder-cache entries to drop, shipped on the next
+        # SchedulerOutput.
+        self._pending_encoder_frees: list[tuple[str, int]] = []
+
         self.requests: dict[str, Request] = {}
         self.waiting = RequestQueue(scheduler_config.policy)
         self.running: list[Request] = []
@@ -183,10 +192,12 @@ class Scheduler:
         return out
 
     def _free_request(self, request: Request) -> None:
+        self._free_encoder_for_request(request)
         if (
             self.kv_connector is not None
             and request.block_hashes
             and request.pooling_params is None
+            and not request.mm_inputs  # hashes don't cover image content
         ):
             block_ids = self.kv_cache_manager.get_block_ids(
                 request.request_id
@@ -227,6 +238,7 @@ class Scheduler:
         token_budget = self.config.max_num_batched_tokens
         num_scheduled_tokens: dict[str, int] = {}
         scheduled_spec_tokens: dict[str, list[int]] = {}
+        enc_sched: dict[str, list[int]] = {}
         scheduled_new_reqs: list[NewRequestData] = []
         cached = CachedRequestData()
         # Blocks allocated this step per running request (delta to runner).
@@ -344,7 +356,14 @@ class Scheduler:
                 num_new_tokens,
                 self.config.max_model_len - request.num_computed_tokens,
             )
+            # Encoder gate: reserve encoder-cache space for any image span
+            # this chunk covers; trims the chunk when the budget is full
+            # (reference: _try_schedule_encoder_inputs).
+            num_new_tokens, enc_new = self._try_schedule_encoder(
+                request, request.num_computed_tokens, num_new_tokens
+            )
             if num_new_tokens <= 0:
+                self._rollback_encoder(request, enc_new)
                 req_index += 1
                 continue
 
@@ -370,6 +389,7 @@ class Scheduler:
             if new_blocks is None:
                 # The request itself was preempted; scheduling continues with
                 # whatever remains.
+                self._rollback_encoder(request, enc_new)
                 break
 
             # Trim speculative tokens that no longer fit the scheduled window.
@@ -387,6 +407,8 @@ class Scheduler:
             new_blocks_per_req[request.request_id] = [
                 b.block_id for b in new_blocks
             ]
+            if enc_new:
+                enc_sched.setdefault(request.request_id, []).extend(enc_new)
             starts[request.request_id] = request.num_computed_tokens
             self._after_schedule(request, num_new_tokens)
             req_index += 1
@@ -443,11 +465,16 @@ class Scheduler:
                 request.sampling_params is not None
                 and request.sampling_params.prompt_logprobs is not None
             )
+            # Multimodal prompts are excluded from prefix caching: block
+            # hashes cover token ids only, and placeholder ids are
+            # identical across different images (hashing mm content into
+            # the blocks is the fix — future work).
             new_computed_blocks, num_new_computed_tokens = (
                 self.kv_cache_manager.get_computed_blocks(request)
                 if request.num_computed_tokens == 0
                 and not is_mean_pooling
                 and not wants_prompt_lp
+                and not request.mm_inputs
                 else ([], 0)
             )
             # External KV tier: whole blocks beyond the device hit.
@@ -460,6 +487,7 @@ class Scheduler:
                 # device prefix-cache path above.
                 and not wants_prompt_lp
                 and not is_mean_pooling
+                and not request.mm_inputs
             ):
                 num_external_tokens = (
                     self.kv_connector.get_num_new_matched_tokens(
@@ -487,9 +515,20 @@ class Scheduler:
                 )
             num_new_tokens = min(num_new_tokens, token_budget)
             assert num_new_tokens > 0
+            # Encoder gate (see phase 1). The window starts after any
+            # device-cache / external-tier hit.
+            num_new_tokens, enc_new = self._try_schedule_encoder(
+                request,
+                request.num_computed_tokens + num_new_computed_tokens,
+                num_new_tokens,
+            )
+            if num_new_tokens <= 0:
+                self._rollback_encoder(request, enc_new)
+                break  # encoder budget exhausted; wait for frees
             if is_mean_pooling and num_new_tokens < (
                 request.num_tokens - request.num_computed_tokens
             ):
+                self._rollback_encoder(request, enc_new)
                 break  # wait for a step with budget for the whole prompt
 
             new_blocks = self.kv_cache_manager.allocate_slots(
@@ -500,6 +539,7 @@ class Scheduler:
                 num_lookahead_tokens=self.config.num_lookahead_tokens,
             )
             if new_blocks is None:
+                self._rollback_encoder(request, enc_new)
                 break  # out of KV space; don't preempt running for waiting
 
             if num_external_tokens:
@@ -547,12 +587,15 @@ class Scheduler:
                         block_ids=all_block_ids,
                         num_computed_tokens=request.num_computed_tokens,
                         lora_name=request.lora_name,
+                        mm_inputs=request.mm_inputs or None,
                         eos_token_id=request.eos_token_id,
                         pooling_params=request.pooling_params,
                     )
                 )
             num_scheduled_tokens[request.request_id] = num_new_tokens
             token_budget -= num_new_tokens
+            if enc_new:
+                enc_sched.setdefault(request.request_id, []).extend(enc_new)
             starts[request.request_id] = request.num_computed_tokens
             self._after_schedule(request, num_new_tokens)
 
@@ -594,6 +637,8 @@ class Scheduler:
             total_num_scheduled_tokens=total,
             scheduled_spec_decode_tokens=scheduled_spec_tokens,
             structured_output_request_ids=structured_rows,
+            scheduled_encoder_inputs=enc_sched,
+            free_encoder_input_ids=self._take_encoder_frees(),
             finished_req_ids=self.finished_req_ids,
             req_refs={
                 rid: self.requests[rid] for rid in num_scheduled_tokens
@@ -604,6 +649,59 @@ class Scheduler:
             self._last_step_req_ids = set(num_scheduled_tokens)
         return output
 
+    # ------------------------------------------------------------------
+    # Multimodal encoder scheduling
+    # ------------------------------------------------------------------
+
+    def _try_schedule_encoder(
+        self, request: Request, start: int, num_new: int
+    ) -> tuple[int, list[int]]:
+        """Reserve encoder-cache budget for image spans intersecting
+        [start, start+num_new). When the budget cannot hold a span's
+        output, the chunk is trimmed to end just before that span.
+        Returns (trimmed num_new, tentatively allocated input indexes) —
+        the caller commits them only if the request is actually scheduled.
+        """
+        if not request.mm_inputs:
+            return num_new, []
+        rid = request.request_id
+        allocated: list[int] = []
+        for i, mm in enumerate(request.mm_inputs):
+            off, n = mm.offset, mm.num_tokens
+            if off + n <= start:
+                continue  # fully computed in earlier chunks
+            if off >= start + num_new:
+                break
+            if self.encoder_cache_manager.has(rid, i):
+                continue
+            if not self.encoder_cache_manager.can_allocate(n):
+                num_new = max(0, off - start)
+                break
+            self.encoder_cache_manager.allocate(rid, i, n)
+            allocated.append(i)
+        # Drop reservations that fell outside the trimmed window.
+        keep: list[int] = []
+        for i in allocated:
+            mm = request.mm_inputs[i]
+            if mm.offset < start + num_new and mm.offset + mm.num_tokens > start:
+                keep.append(i)
+            else:
+                self.encoder_cache_manager.free_input(rid, i)
+        return num_new, keep
+
+    def _rollback_encoder(self, request: Request, idxs: list[int]) -> None:
+        for i in idxs:
+            self.encoder_cache_manager.free_input(request.request_id, i)
+
+    def _free_encoder_for_request(self, request: Request) -> None:
+        freed = self.encoder_cache_manager.free_request(request.request_id)
+        self._pending_encoder_frees.extend(freed)
+
+    def _take_encoder_frees(self) -> list[tuple[str, int]]:
+        out = self._pending_encoder_frees
+        self._pending_encoder_frees = []
+        return out
+
     def _after_schedule(self, request: Request, num_new_tokens: int) -> None:
         """Hook run right after a request is scheduled this step. The async
         scheduler advances num_computed_tokens here (reference:
@@ -612,6 +710,9 @@ class Scheduler:
 
     def _preempt(self, request: Request) -> None:
         self.kv_cache_manager.free(request)
+        # Encoder outputs are tied to computed positions; a resume restarts
+        # prefill from 0 and re-encodes.
+        self._free_encoder_for_request(request)
         request.status = RequestStatus.PREEMPTED
         request.num_computed_tokens = 0
         # num_output_placeholders is intentionally preserved: an in-flight
@@ -763,6 +864,20 @@ class Scheduler:
                         num_cached_tokens=max(request.num_cached_tokens, 0),
                     )
                 )
+
+        # Encoder-cache eviction: spans whose every placeholder position is
+        # now computed no longer need their encoder output.
+        for req_id in scheduler_output.num_scheduled_tokens:
+            request = self.requests.get(req_id)
+            if request is None or not request.mm_inputs:
+                continue
+            done_to = request.num_computed_tokens
+            for i, mm in enumerate(request.mm_inputs):
+                if (
+                    mm.offset + mm.num_tokens <= done_to
+                    and self.encoder_cache_manager.free_input(req_id, i)
+                ):
+                    self._pending_encoder_frees.append((req_id, i))
 
         # Surface engine-side failures (e.g. grammar compile errors) so the
         # frontend releases the waiting client.

@@ -157,9 +157,14 @@ class EngineCore:
         ):
             scheduler_output = self.scheduler.schedule()
             if scheduler_output.total_num_scheduled_tokens == 0:
-                # Not dispatched: hand the drained finished ids back so the
-                # runner still drops those rows on the next dispatched step.
+                # Not dispatched: hand the drained finished ids (and any
+                # encoder-cache frees) back so the runner still gets them
+                # on the next dispatched step.
                 self.scheduler.finished_req_ids |= scheduler_output.finished_req_ids
+                self.scheduler._pending_encoder_frees = (
+                    scheduler_output.free_encoder_input_ids
+                    + self.scheduler._pending_encoder_frees
+                )
                 break
             handle = self.executor.dispatch(scheduler_output)
             self._inflight.append((scheduler_output, handle))

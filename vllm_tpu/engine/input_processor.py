@@ -35,6 +35,23 @@ class InputProcessor:
         self.config = config
         self._tokenizer = tokenizer
         self._tokenizer_loaded = tokenizer is not None
+        self._mm_info_cache: dict | None = None
+
+    def _mm_info(self) -> dict:
+        """Placeholder-expansion facts from the model class (weights are
+        never loaded in the frontend)."""
+        if self._mm_info_cache is None:
+            from vllm_tpu.models.registry import get_model_class
+            from vllm_tpu.worker.worker import load_hf_config
+
+            hf_config = load_hf_config(self.config.model_config)
+            cls = get_model_class(hf_config)
+            if not getattr(cls, "is_multimodal", False):
+                raise ValueError(
+                    f"{cls.__name__} does not accept multi_modal_data"
+                )
+            self._mm_info_cache = cls(hf_config).mm_info()
+        return self._mm_info_cache
 
     @property
     def tokenizer(self) -> Any | None:
@@ -73,14 +90,44 @@ class InputProcessor:
                 prompt_token_ids = list(prompt["prompt_token_ids"])
                 prompt_text = prompt.get("prompt")
             elif "prompt" in prompt:
-                return self.process(
-                    request_id, prompt["prompt"], params, arrival_time,
-                    priority, pooling_params,
-                )
+                inner = prompt["prompt"]
+                tokenizer = self.tokenizer
+                if not isinstance(inner, str) or tokenizer is None:
+                    raise ValueError("no tokenizer; pass prompt_token_ids")
+                prompt_text = inner
+                prompt_token_ids = tokenizer.encode(inner)
             else:
                 raise ValueError(f"invalid prompt dict keys: {list(prompt)}")
         else:
             raise TypeError(f"invalid prompt type {type(prompt)}")
+
+        mm_inputs = None
+        mm_data = prompt.get("multi_modal_data") if isinstance(prompt, dict) else None
+        if mm_data:
+            from vllm_tpu.multimodal import expand_mm_prompt
+
+            images = mm_data.get("image")
+            if images is None:
+                raise ValueError(
+                    f"unsupported multi_modal_data keys: {list(mm_data)}"
+                )
+            if not isinstance(images, list):
+                images = [images]
+            info = self._mm_info()
+            # A span larger than the whole encoder budget could never be
+            # scheduled — the engine would trim its chunk to zero forever.
+            budget = self.config.scheduler_config.encoder_cache_budget
+            if info["tokens_per_image"] > budget:
+                raise ValueError(
+                    f"one image needs {info['tokens_per_image']} encoder "
+                    f"tokens but encoder_cache_budget is {budget}"
+                )
+            prompt_token_ids, mm_inputs = expand_mm_prompt(
+                prompt_token_ids, images,
+                image_token_id=info["image_token_id"],
+                tokens_per_image=info["tokens_per_image"],
+                image_size=info["image_size"],
+            )
 
         max_len = self.config.scheduler_config.max_model_len
         if not prompt_token_ids:
@@ -130,6 +177,7 @@ class InputProcessor:
             eos_token_id=eos_token_id,
             priority=priority,
             pooling_params=pooling_params,
+            mm_inputs=mm_inputs,
         )
         req.prompt_text = prompt_text  # carried for outputs
         return req

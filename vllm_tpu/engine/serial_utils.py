@@ -12,12 +12,14 @@ import dataclasses
 from typing import Any
 
 import msgpack
+import numpy as np
 
 from vllm_tpu.core.sched_output import (
     EngineCoreOutput,
     EngineCoreOutputs,
     SchedulerStats,
 )
+from vllm_tpu.multimodal import MMInput
 from vllm_tpu.request import EngineCoreRequest
 from vllm_tpu.sampling_params import (
     PoolingParams,
@@ -36,6 +38,7 @@ _WIRE_TYPES: dict[str, type] = {
         EngineCoreOutput,
         EngineCoreOutputs,
         SchedulerStats,
+        MMInput,
     )
 }
 _FIELDS = {
@@ -54,6 +57,13 @@ def _default(o: Any) -> Any:
         return {"__set__": list(o)}
     if isinstance(o, tuple):
         return list(o)
+    if isinstance(o, np.ndarray):
+        # Pixel arrays (multimodal inputs) cross the wire as raw bytes.
+        return {
+            "__nd__": o.dtype.str,
+            "s": list(o.shape),
+            "b": o.tobytes(),
+        }
     raise TypeError(f"unserializable wire object: {type(o)!r}")
 
 
@@ -71,6 +81,10 @@ def _object_hook(d: dict) -> Any:
         return obj
     if "__set__" in d:
         return set(d["__set__"])
+    if "__nd__" in d:
+        return np.frombuffer(d["b"], dtype=np.dtype(d["__nd__"])).reshape(
+            d["s"]
+        )
     return d
 
 

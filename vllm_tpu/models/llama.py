@@ -188,8 +188,13 @@ class LlamaForCausalLM:
         input_ids: jnp.ndarray,  # [T]
         md: AttentionMetadata,
         token_lora_slot: jnp.ndarray | None = None,  # [T] i32 (LoRA)
+        inputs_embeds: jnp.ndarray | None = None,  # [T, D] (multimodal merge)
     ) -> tuple[jnp.ndarray, jnp.ndarray]:
-        x = params["embed"][input_ids].astype(self.dtype)  # [T, D]
+        x = (
+            inputs_embeds.astype(self.dtype)
+            if inputs_embeds is not None
+            else params["embed"][input_ids].astype(self.dtype)
+        )  # [T, D]
         t = x.shape[0]
         H, KH, Dh = self.num_heads, self.num_kv_heads, self.head_dim
 

@@ -1,0 +1,344 @@
+"""Llava-style vision-language model: CLIP ViT tower + MLP projector +
+Llama-family decoder.
+
+Reference analog: ``vllm/model_executor/models/llava.py`` + the CLIP
+tower (``clip.py``). TPU-first shape discipline: the vision tower runs as
+its own fixed-shape jit (one image geometry -> one compilation), its
+output embeddings are cached on device by the worker (EncoderCacheManager
+budget), and the decoder consumes them as a [T, D] overlay merged into
+the token embedding stream at placeholder positions inside the jitted
+step — the language graph never sees dynamic image shapes.
+
+Param tree::
+
+    language/   (the wrapped decoder's tree, unchanged)
+    vision/
+      patch_embed [Dv, 3, p, p]   class_emb [Dv]   pos_emb [N+1, Dv]
+      pre_ln_w/b [Dv]
+      layers/    stacked [Lv, ...]: ln1_w/b, wq/wk/wv/wo, bq/bk/bv/bo,
+                 ln2_w/b, fc1 [Dv,Di], fc1_b, fc2 [Di,Dv], fc2_b
+    projector/  w1 [Dv, Dt]  b1 [Dt]  w2 [Dt, Dt]  b2 [Dt]
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from vllm_tpu.logger import init_logger
+from vllm_tpu.ops.attention import AttentionMetadata
+
+logger = init_logger(__name__)
+
+# text-config model_type -> decoder class (resolved lazily).
+_TEXT_ARCHS = {
+    "llama": ("vllm_tpu.models.llama", "LlamaForCausalLM"),
+    "mistral": ("vllm_tpu.models.llama", "MistralForCausalLM"),
+    "qwen2": ("vllm_tpu.models.llama", "Qwen2ForCausalLM"),
+}
+
+
+def _layer_norm(x, w, b, eps=1e-5):
+    xf = x.astype(jnp.float32)
+    mu = xf.mean(-1, keepdims=True)
+    var = ((xf - mu) ** 2).mean(-1, keepdims=True)
+    return ((xf - mu) * jax.lax.rsqrt(var + eps) * w + b).astype(x.dtype)
+
+
+def _quick_gelu(x):
+    return x * jax.nn.sigmoid(1.702 * x)
+
+
+class LlavaForConditionalGeneration:
+    is_multimodal = True
+    supports_lora = False
+    enable_lora = False
+
+    def __init__(self, hf_config: Any, dtype=jnp.bfloat16,
+                 quantization: str | None = None) -> None:
+        if quantization:
+            logger.warning(
+                "weight quantization is not yet supported for multimodal "
+                "models; running %s unquantized", type(self).__name__,
+            )
+        self.hf_config = hf_config
+        self.dtype = dtype
+        self.quantization = None
+        tc, vc = hf_config.text_config, hf_config.vision_config
+        import importlib
+
+        mod, cls = _TEXT_ARCHS.get(tc.model_type, _TEXT_ARCHS["llama"])
+        self.lang = getattr(importlib.import_module(mod), cls)(tc, dtype)
+
+        # Runner contracts proxy the decoder (the KV cache is its).
+        self.num_layers = self.lang.num_layers
+        self.num_kv_heads = self.lang.num_kv_heads
+        self.head_dim = self.lang.head_dim
+        self.hidden_size = self.lang.hidden_size
+        self.vocab_size = self.lang.vocab_size
+        self.sliding_window = self.lang.sliding_window
+
+        # Vision geometry.
+        self.image_size = vc.image_size
+        self.patch_size = vc.patch_size
+        self.num_patches = (vc.image_size // vc.patch_size) ** 2
+        self.vision_dim = vc.hidden_size
+        self.vision_heads = vc.num_attention_heads
+        self.vision_layers = vc.num_hidden_layers
+        self.vision_intermediate = vc.intermediate_size
+        self.vision_ln_eps = getattr(vc, "layer_norm_eps", 1e-5)
+        self.image_token_id = hf_config.image_token_index
+        feature_layer = getattr(hf_config, "vision_feature_layer", -2)
+        # hidden_states[-2] = output of layer Lv-1 (run all but the last).
+        self.vision_run_layers = self.vision_layers + 1 + feature_layer
+        strategy = getattr(
+            hf_config, "vision_feature_select_strategy", "default"
+        )
+        self.drop_cls = strategy == "default"
+        self.tokens_per_image = (
+            self.num_patches if self.drop_cls else self.num_patches + 1
+        )
+
+    # Input-processor contract (frontend side, no weights needed).
+    def mm_info(self) -> dict:
+        return {
+            "image_token_id": self.image_token_id,
+            "tokens_per_image": self.tokens_per_image,
+            "image_size": self.image_size,
+        }
+
+    # ------------------------------------------------------------------
+    # Params
+    # ------------------------------------------------------------------
+
+    def init_dummy_params(self, rng: jax.Array, dtype=None) -> dict:
+        dtype = dtype or self.dtype
+        Dv, Di, Lv = (
+            self.vision_dim, self.vision_intermediate, self.vision_layers,
+        )
+        Dt = self.hidden_size
+        p = self.patch_size
+        key = iter(jax.random.split(rng, 32))
+
+        def init(shape, fan_in):
+            return (
+                jax.random.normal(next(key), shape, jnp.float32)
+                / math.sqrt(fan_in)
+            ).astype(dtype)
+
+        vision = {
+            "patch_embed": init((Dv, 3, p, p), 3 * p * p),
+            "class_emb": init((Dv,), Dv),
+            "pos_emb": init((self.num_patches + 1, Dv), Dv),
+            "pre_ln_w": jnp.ones((Dv,), dtype),
+            "pre_ln_b": jnp.zeros((Dv,), dtype),
+            "layers": {
+                "ln1_w": jnp.ones((Lv, Dv), dtype),
+                "ln1_b": jnp.zeros((Lv, Dv), dtype),
+                "wq": init((Lv, Dv, Dv), Dv),
+                "wk": init((Lv, Dv, Dv), Dv),
+                "wv": init((Lv, Dv, Dv), Dv),
+                "wo": init((Lv, Dv, Dv), Dv),
+                "bq": jnp.zeros((Lv, Dv), dtype),
+                "bk": jnp.zeros((Lv, Dv), dtype),
+                "bv": jnp.zeros((Lv, Dv), dtype),
+                "bo": jnp.zeros((Lv, Dv), dtype),
+                "ln2_w": jnp.ones((Lv, Dv), dtype),
+                "ln2_b": jnp.zeros((Lv, Dv), dtype),
+                "fc1": init((Lv, Dv, Di), Dv),
+                "fc1_b": jnp.zeros((Lv, Di), dtype),
+                "fc2": init((Lv, Di, Dv), Di),
+                "fc2_b": jnp.zeros((Lv, Dv), dtype),
+            },
+        }
+        projector = {
+            "w1": init((Dv, Dt), Dv),
+            "b1": jnp.zeros((Dt,), dtype),
+            "w2": init((Dt, Dt), Dt),
+            "b2": jnp.zeros((Dt,), dtype),
+        }
+        return {
+            "language": self.lang.init_dummy_params(next(key), dtype),
+            "vision": vision,
+            "projector": projector,
+        }
+
+    def hf_weight_map(self) -> dict:
+        # Decoder names arrive prefix-stripped by the loader
+        # (model.language_model.* -> model.*), so the lang map applies
+        # as-is with destinations nested under "language.".
+        m = {
+            hf: (f"language.{dest}", tr)
+            for hf, (dest, tr) in self.lang.hf_weight_map().items()
+        }
+        # Both HF naming eras are registered (the loader requires every
+        # DESTINATION filled, not every name): old-style checkpoints use
+        # "vision_tower.*", new-style nests under "model.".
+        for vt in ("vision_tower.vision_model",
+                   "model.vision_tower.vision_model"):
+            m |= {
+                f"{vt}.embeddings.patch_embedding.weight": (
+                    "vision.patch_embed", False),
+                f"{vt}.embeddings.class_embedding": (
+                    "vision.class_emb", False),
+                f"{vt}.embeddings.position_embedding.weight": (
+                    "vision.pos_emb", False),
+                f"{vt}.pre_layrnorm.weight": ("vision.pre_ln_w", False),
+                f"{vt}.pre_layrnorm.bias": ("vision.pre_ln_b", False),
+            }
+            per_layer = {
+                "layer_norm1.weight": ("ln1_w", False),
+                "layer_norm1.bias": ("ln1_b", False),
+                "self_attn.q_proj.weight": ("wq", True),
+                "self_attn.k_proj.weight": ("wk", True),
+                "self_attn.v_proj.weight": ("wv", True),
+                "self_attn.out_proj.weight": ("wo", True),
+                "self_attn.q_proj.bias": ("bq", False),
+                "self_attn.k_proj.bias": ("bk", False),
+                "self_attn.v_proj.bias": ("bv", False),
+                "self_attn.out_proj.bias": ("bo", False),
+                "layer_norm2.weight": ("ln2_w", False),
+                "layer_norm2.bias": ("ln2_b", False),
+                "mlp.fc1.weight": ("fc1", True),
+                "mlp.fc1.bias": ("fc1_b", False),
+                "mlp.fc2.weight": ("fc2", True),
+                "mlp.fc2.bias": ("fc2_b", False),
+            }
+            for i in range(self.vision_layers):
+                for hf_name, (ours, tr) in per_layer.items():
+                    m[f"{vt}.encoder.layers.{i}.{hf_name}"] = (
+                        f"vision.layers.{ours}.{i}", tr)
+        for mp in ("multi_modal_projector", "model.multi_modal_projector"):
+            m |= {
+                f"{mp}.linear_1.weight": ("projector.w1", True),
+                f"{mp}.linear_1.bias": ("projector.b1", False),
+                f"{mp}.linear_2.weight": ("projector.w2", True),
+                f"{mp}.linear_2.bias": ("projector.b2", False),
+            }
+        return m
+
+    def load_params(self, path: str, dtype=None, shardings: Any | None = None) -> dict:
+        from vllm_tpu.models.loader import load_safetensors_params
+
+        return load_safetensors_params(self, path, dtype or self.dtype, shardings)
+
+    # ------------------------------------------------------------------
+    # Vision tower
+    # ------------------------------------------------------------------
+
+    def encode_images(self, params: dict, pixels: jnp.ndarray) -> jnp.ndarray:
+        """[B, 3, S, S] f32 -> [B, tokens_per_image, D_text]."""
+        v = params["vision"]
+        bsz = pixels.shape[0]
+        p, s = self.patch_size, self.image_size
+        n = s // p
+        Dv = self.vision_dim
+
+        # Patch "conv" as a matmul (stride == kernel).
+        patches = (
+            pixels.astype(self.dtype)
+            .reshape(bsz, 3, n, p, n, p)
+            .transpose(0, 2, 4, 1, 3, 5)
+            .reshape(bsz, n * n, 3 * p * p)
+        )
+        w = v["patch_embed"].reshape(Dv, 3 * p * p).T
+        x = patches @ w  # [B, N, Dv]
+        cls = jnp.broadcast_to(v["class_emb"], (bsz, 1, Dv)).astype(x.dtype)
+        x = jnp.concatenate([cls, x], axis=1) + v["pos_emb"].astype(x.dtype)
+        x = _layer_norm(x, v["pre_ln_w"], v["pre_ln_b"], self.vision_ln_eps)
+
+        hv = self.vision_heads
+        dh = Dv // hv
+        scale = dh ** -0.5
+        seq = x.shape[1]
+
+        def layer_fn(x, lp):
+            h = _layer_norm(x, lp["ln1_w"], lp["ln1_b"], self.vision_ln_eps)
+            q = (h @ lp["wq"] + lp["bq"]).reshape(bsz, seq, hv, dh)
+            k = (h @ lp["wk"] + lp["bk"]).reshape(bsz, seq, hv, dh)
+            val = (h @ lp["wv"] + lp["bv"]).reshape(bsz, seq, hv, dh)
+            scores = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+            probs = jax.nn.softmax(scores.astype(jnp.float32), axis=-1)
+            attn = jnp.einsum(
+                "bhqk,bkhd->bqhd", probs.astype(val.dtype), val
+            ).reshape(bsz, seq, Dv)
+            x = x + attn @ lp["wo"] + lp["bo"]
+            h = _layer_norm(x, lp["ln2_w"], lp["ln2_b"], self.vision_ln_eps)
+            x = x + _quick_gelu(h @ lp["fc1"] + lp["fc1_b"]) @ lp["fc2"] + lp["fc2_b"]
+            return x, None
+
+        # Feature layer -2: run all but the last ViT layer.
+        n_run = self.vision_run_layers
+        sliced = jax.tree.map(lambda a: a[:n_run], v["layers"])
+        x, _ = jax.lax.scan(layer_fn, x, sliced)
+
+        if self.drop_cls:
+            x = x[:, 1:]
+        pj = params["projector"]
+        x = jax.nn.gelu(x @ pj["w1"] + pj["b1"], approximate=False)
+        return x @ pj["w2"] + pj["b2"]  # [B, N, D_text]
+
+    # ------------------------------------------------------------------
+    # Decoder delegation
+    # ------------------------------------------------------------------
+
+    def apply(
+        self,
+        params: dict,
+        kv_cache: jnp.ndarray,
+        input_ids: jnp.ndarray,
+        md: AttentionMetadata,
+        token_lora_slot: jnp.ndarray | None = None,
+        mm_embeds: jnp.ndarray | None = None,  # [T, D_text]
+        mm_mask: jnp.ndarray | None = None,  # [T] bool
+    ) -> tuple[jnp.ndarray, jnp.ndarray]:
+        lp = params["language"]
+        emb = lp["embed"][input_ids].astype(self.dtype)
+        if mm_embeds is not None:
+            emb = jnp.where(
+                mm_mask[:, None], mm_embeds.astype(emb.dtype), emb
+            )
+        return self.lang.apply(
+            lp, kv_cache, input_ids, md, inputs_embeds=emb
+        )
+
+    def compute_logits(self, params: dict, hidden: jnp.ndarray) -> jnp.ndarray:
+        return self.lang.compute_logits(params["language"], hidden)
+
+    def get_kv_cache_spec(self, block_size: int, dtype_bytes: int):
+        return self.lang.get_kv_cache_spec(block_size, dtype_bytes)
+
+    def param_shardings(self, data_axis: str | None = None, model_axis: str = "tp") -> dict:
+        # Vision tower + projector replicated (they are a tiny fraction of
+        # the FLOPs); decoder uses its own TP plan.
+        vec, mat = P(None, None), P(None, None, None)
+        vision = {
+            "patch_embed": P(None, None, None, None),
+            "class_emb": P(None),
+            "pos_emb": P(None, None),
+            "pre_ln_w": P(None),
+            "pre_ln_b": P(None),
+            "layers": {
+                k: (mat if k in ("wq", "wk", "wv", "wo", "fc1", "fc2") else vec)
+                for k in (
+                    "ln1_w", "ln1_b", "wq", "wk", "wv", "wo", "bq", "bk",
+                    "bv", "bo", "ln2_w", "ln2_b", "fc1", "fc1_b", "fc2",
+                    "fc2_b",
+                )
+            },
+        }
+        return {
+            "language": self.lang.param_shardings(data_axis, model_axis),
+            "vision": vision,
+            "projector": {
+                "w1": P(None, None), "b1": P(None),
+                "w2": P(None, None), "b2": P(None),
+            },
+        }
+
+    def kv_cache_sharding(self, model_axis: str = "tp") -> P:
+        return self.lang.kv_cache_sharding(model_axis)

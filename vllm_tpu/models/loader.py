@@ -100,7 +100,13 @@ def load_safetensors_params(
                     staged[dest] = arr
                 seen.add(hf_name)
 
-    missing = set(weight_map) - seen
+    # Completeness is judged by DESTINATION, not HF name: several HF
+    # naming styles may map to one leaf (old/new multimodal prefixes) and
+    # exactly one needs to be present.
+    seen_dests = {weight_map[n][0] for n in seen}
+    missing = {
+        d for d, _ in weight_map.values() if d not in seen_dests
+    }
     if missing:
         raise ValueError(f"checkpoint missing {len(missing)} weights, e.g. {sorted(missing)[:3]}")
 

@@ -235,9 +235,7 @@ class Mamba2ForCausalLM:
             # MambaRMSNormGated): y * silu(gate), then normalize.
             yf = y.reshape(t, I).astype(jnp.float32)
             yf = yf * jax.nn.silu(gate.astype(jnp.float32))
-            var = jnp.mean(yf * yf, axis=-1, keepdims=True)
-            yf = yf * jax.lax.rsqrt(var + self.rms_eps)
-            yf = (lp["gated_norm"].astype(jnp.float32) * yf).astype(self.dtype)
+            yf = rms_norm(yf, lp["gated_norm"], self.rms_eps).astype(self.dtype)
 
             x = x + yf @ lp["out_proj"]
             conv_c = conv_c.at[li, slots].set(new_conv)

@@ -1,0 +1,107 @@
+"""Multimodal input pipeline: image preprocessing + placeholder expansion.
+
+Reference analog: ``vllm/multimodal/`` (MultiModalRegistry ``registry.py:98``,
+BaseMultiModalProcessor ``processing/processor.py:972``) collapsed to the
+TPU-first essentials: a model class exposes ``mm_info()`` (placeholder
+token, tokens-per-image, preprocessing geometry) and
+``process_mm_prompt()`` expands the prompt and packages fixed-shape pixel
+arrays. Everything downstream (scheduler encoder budget, worker encoder
+cache, embedding merge inside the jitted step) works on
+``MMInput(offset, num_tokens, pixel_values)`` placeholders — static
+shapes, no dynamic vision graphs under jit.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any
+
+import numpy as np
+
+# OpenAI-CLIP normalization (the Llava/vision-tower default).
+CLIP_MEAN = np.asarray([0.48145466, 0.4578275, 0.40821073], np.float32)
+CLIP_STD = np.asarray([0.26862954, 0.26130258, 0.27577711], np.float32)
+
+
+@dataclass
+class MMInput:
+    """One placeholder span in the expanded prompt + its pixel data."""
+
+    offset: int  # first placeholder position in the expanded prompt
+    num_tokens: int  # number of placeholder positions (= encoder tokens)
+    pixel_values: Any = field(repr=False, default=None)  # np [3, H, W] f32
+
+
+def preprocess_image(
+    image: Any, image_size: int,
+    mean: np.ndarray = CLIP_MEAN, std: np.ndarray = CLIP_STD,
+) -> np.ndarray:
+    """HWC uint8 / PIL / ready-made CHW float -> normalized [3, S, S] f32.
+
+    A CHW float32 array of the right size passes through untouched (the
+    caller already ran an HF processor — the parity-exact path).
+    """
+    arr = np.asarray(image)
+    if (
+        arr.ndim == 3
+        and arr.shape[0] == 3
+        and arr.dtype in (np.float32, np.float64)
+    ):
+        if arr.shape[1:] != (image_size, image_size):
+            raise ValueError(
+                f"preprocessed pixel_values must be [3, {image_size}, "
+                f"{image_size}], got {arr.shape}"
+            )
+        return arr.astype(np.float32)
+    if arr.ndim != 3 or arr.shape[2] != 3:
+        raise ValueError(f"expected HWC RGB image, got shape {arr.shape}")
+    if arr.shape[:2] != (image_size, image_size):
+        try:
+            from PIL import Image
+
+            arr = np.asarray(
+                Image.fromarray(arr.astype(np.uint8)).resize(
+                    (image_size, image_size), Image.BICUBIC
+                )
+            )
+        except ImportError as e:
+            raise ValueError(
+                f"image must be pre-resized to {image_size}x{image_size} "
+                "(PIL unavailable for resizing)"
+            ) from e
+    x = arr.astype(np.float32) / 255.0
+    x = (x - mean) / std
+    return x.transpose(2, 0, 1)  # CHW
+
+
+def expand_mm_prompt(
+    prompt_token_ids: list[int],
+    images: list[Any],
+    image_token_id: int,
+    tokens_per_image: int,
+    image_size: int,
+) -> tuple[list[int], list[MMInput]]:
+    """Replace each image placeholder token with ``tokens_per_image``
+    copies; returns (expanded ids, MMInput per image, in order)."""
+    positions = [
+        i for i, t in enumerate(prompt_token_ids) if t == image_token_id
+    ]
+    if len(positions) != len(images):
+        raise ValueError(
+            f"prompt has {len(positions)} image placeholder(s) but "
+            f"{len(images)} image(s) were provided"
+        )
+    out: list[int] = []
+    mm_inputs: list[MMInput] = []
+    img_iter = iter(images)
+    for i, tok in enumerate(prompt_token_ids):
+        if tok == image_token_id:
+            mm_inputs.append(MMInput(
+                offset=len(out),
+                num_tokens=tokens_per_image,
+                pixel_values=preprocess_image(next(img_iter), image_size),
+            ))
+            out.extend([image_token_id] * tokens_per_image)
+        else:
+            out.append(tok)
+    return out, mm_inputs

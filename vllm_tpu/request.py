@@ -70,6 +70,7 @@ class Request:
         lora_name: str | None = None,
         block_hasher: Any = None,
         pooling_params: Any = None,
+        mm_inputs: list[Any] | None = None,
     ) -> None:
         self.request_id = request_id
         self.prompt_token_ids = prompt_token_ids
@@ -79,6 +80,7 @@ class Request:
         self.priority = priority
         self.lora_name = lora_name
         self.pooling_params = pooling_params
+        self.mm_inputs = mm_inputs or []
 
         self.status = RequestStatus.WAITING
         self.stop_reason: int | str | None = None
@@ -128,6 +130,7 @@ class Request:
             priority=req.priority,
             lora_name=req.lora_name,
             block_hasher=block_hasher,
+            mm_inputs=req.mm_inputs,
         )
 
     # ------------------------------------------------------------------

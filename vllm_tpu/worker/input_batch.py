@@ -27,6 +27,7 @@ class CachedRequestState:
         "needs_logit_adjust",
         "logit_bias_items",
         "pooling_params",
+        "mm_inputs",
     )
 
     def __init__(self, req_id: str, sampling_params: SamplingParams,
@@ -40,6 +41,7 @@ class CachedRequestState:
         self.generated = 0  # sampled so far (drives seeded PRNG streams)
         self.in_batch_row = -1
         self.eos_token_id = eos_token_id
+        self.mm_inputs = None  # multimodal placeholder spans + pixels
         p = sampling_params
         # Per-request logits-processor work (bias / bans / min-tokens EOS
         # suppression); cached so the no-adjustment common path costs one
@@ -104,6 +106,7 @@ class InputBatch:
             req_id, data.sampling_params, data.eos_token_id,
             getattr(data, "pooling_params", None),
         )
+        state.mm_inputs = getattr(data, "mm_inputs", None)
         state.in_batch_row = row
         state.num_computed_tokens = data.num_computed_tokens
         state.num_tokens = len(data.prompt_token_ids)

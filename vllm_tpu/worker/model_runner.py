@@ -200,6 +200,14 @@ class ModelRunner:
         # by VLLM_TPU_STEP_TIMING=1; read via .timing after a run.
         from vllm_tpu import envs
 
+        # Multimodal: device-side encoder-output cache keyed by
+        # (req_id, mm_input_index); budget enforced scheduler-side.
+        self.is_mm = getattr(self.model, "is_multimodal", False)
+        self._mm_cache: dict[tuple[str, int], jax.Array] = {}
+        self._encode_fn = (
+            jax.jit(self.model.encode_images) if self.is_mm else None
+        )
+
         self._timing_enabled = envs.VLLM_TPU_STEP_TIMING
         self._nan_check = envs.VLLM_TPU_NAN_CHECK
         # Native (C++) step-input assembly; None -> python loop.
@@ -310,6 +318,8 @@ class ModelRunner:
         prompt_mask,
         prev_sampled,
         mask_table,
+        mm_embeds=None,  # [T, D] encoder-output overlay (multimodal)
+        mm_mask=None,  # [T] bool, True at overlaid positions
         *,
         t_pad: int,
         r_pad: int,
@@ -351,8 +361,14 @@ class ModelRunner:
                 jnp.arange(r_pad), prev_tok
             ].add(needs_fb.astype(jnp.int32))
             sampling = _replace(sampling, output_token_counts=counts2)
+        mm_kw = (
+            {"mm_embeds": mm_embeds, "mm_mask": mm_mask}
+            if mm_embeds is not None
+            else {}
+        )
         hidden, kv_cache = self.model.apply(
-            params, kv_cache, token_ids, md, token_lora_slot=token_lora
+            params, kv_cache, token_ids, md, token_lora_slot=token_lora,
+            **mm_kw,
         )
         if num_spec > 0:
             # Spec-decode verification: logits at every draft position plus
@@ -603,6 +619,23 @@ class ModelRunner:
                     new.lora_name
                 )
 
+    def _run_encoders(self, so: SchedulerOutput) -> None:
+        """Drop freed encoder outputs, run newly scheduled ones (one jit
+        per image geometry; outputs stay on device until their placeholder
+        span is fully computed)."""
+        for key in so.free_encoder_input_ids:
+            self._mm_cache.pop(tuple(key), None)
+        for rid, idxs in so.scheduled_encoder_inputs.items():
+            state = self.input_batch.req_states.get(rid)
+            if state is None or not state.mm_inputs:
+                logger.error("encoder scheduled for unknown request %s", rid)
+                continue
+            for i in idxs:
+                pixels = jnp.asarray(state.mm_inputs[i].pixel_values)
+                self._mm_cache[(rid, i)] = self._encode_fn(
+                    self.params, pixels[None]
+                )[0]
+
     def _prepare_inputs(self, so: SchedulerOutput):
         batch = self.input_batch
         num_sched = so.num_scheduled_tokens
@@ -678,6 +711,29 @@ class ModelRunner:
                         prompt_rows.append((i, row, run_off, start, count, pl))
                 run_off += n
         plp_len = t if num_prompt_lp else 0
+        # Multimodal: placeholder positions covered this step get their
+        # embeddings overlaid from the device-side encoder cache.
+        mm_mask_np = None
+        mm_spans: list[tuple] = []
+        if self.is_mm:
+            mm_mask_np = np.zeros(t, bool)
+            run_off = 0
+            for i, row in enumerate(rows):
+                state = batch.req_states[req_order[i]]
+                n = num_sched[req_order[i]]
+                if state.mm_inputs:
+                    start = int(batch.num_computed_tokens[row])
+                    for idx, mm in enumerate(state.mm_inputs):
+                        lo = max(mm.offset, start)
+                        hi = min(mm.offset + mm.num_tokens, start + n)
+                        if lo < hi:
+                            dst = run_off + (lo - start)
+                            mm_mask_np[dst : dst + hi - lo] = True
+                            mm_spans.append((
+                                dst, (req_order[i], idx), lo - mm.offset,
+                                hi - lo,
+                            ))
+                run_off += n
         # seq_lens(r) + qsl(r+1) + logits_idx(r) + num_seqs(1) + bt(r*b)
         # + top_k(r) + prng(2r) + feedback(r) + grammar_rows(r)
         # [+ adj_ids(r*num_adj)] [+ allow_ids(r*num_allow) + allow_flag(r)]
@@ -927,8 +983,24 @@ class ModelRunner:
             num_decode_steps=so.num_decode_steps,
         )
         arrays = (jnp.asarray(ibuf), jnp.asarray(fbuf), counts, prompt_mask)
+        mm_arrays = None
+        if self.is_mm:
+            # Overlay assembled device-side from cached encoder outputs —
+            # the embeddings never round-trip through the host.
+            overlay = jnp.zeros((t_pad, self.model.hidden_size),
+                                self.model.dtype)
+            for dst, key, src0, ln in mm_spans:
+                emb = self._mm_cache.get(key)
+                if emb is None:
+                    logger.error("missing encoder output for %s", key)
+                    continue
+                overlay = jax.lax.dynamic_update_slice(
+                    overlay, emb[src0 : src0 + ln].astype(overlay.dtype),
+                    (dst, 0),
+                )
+            mm_arrays = (overlay, jnp.asarray(mm_mask_np))
         return (arrays, req_order, do_sample[:r_live], dims | flags,
-                prompt_rows)
+                prompt_rows, mm_arrays)
 
     def kv_connector_save(self, entries: list[tuple]) -> None:
         """Persist (block_id, key) payloads to the external store. Runs
@@ -1099,8 +1171,10 @@ class ModelRunner:
             return StepHandle(empty=True)
         if so.kv_connector_load:
             self._kv_connector_loads(so.kv_connector_load)
+        if self.is_mm:
+            self._run_encoders(so)
         (arrays, req_order, do_sample, flags,
-         prompt_rows) = self._prepare_inputs(so)
+         prompt_rows, mm_arrays) = self._prepare_inputs(so)
         mask_table = None
         if flags["needs_grammar"]:
             self._sync_grammar_table()
@@ -1109,10 +1183,15 @@ class ModelRunner:
             t1 = time.perf_counter()
             self.timing["prep_s"] += t1 - t0
         prev = self._last_sampled if self._last_sampled is not None else self._zero_sampled
+        mm_kwargs = (
+            {"mm_embeds": mm_arrays[0], "mm_mask": mm_arrays[1]}
+            if mm_arrays is not None
+            else {}
+        )
         (self.kv_cache, self.draft_kv, sampled, lp, drafts, pooled,
          nan_count, prompt_lp) = self._step_fn(
             self.params, self.kv_cache, self.draft_kv, *arrays, prev,
-            mask_table, **flags,
+            mask_table, **mm_kwargs, **flags,
         )
         if self._timing_enabled:
             self.timing["dispatch_s"] += time.perf_counter() - t1
