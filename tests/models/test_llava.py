@@ -173,10 +173,9 @@ def test_encoder_budget_trims_chunk(tiny_llava):
         model=tiny_llava, dtype="float32", max_model_len=128, block_size=16,
         num_gpu_blocks_override=64, max_num_seqs=4,
         max_num_batched_tokens=128,
+        # Budget for exactly one image: the second span must wait.
+        encoder_cache_budget=N_PATCH,
     )
-    # Shrink the encoder budget to exactly one image.
-    core = llm.llm_engine.engine_core.engine_core
-    core.scheduler.encoder_cache_manager.budget = N_PATCH
     [out] = llm.generate(
         [{
             "prompt_token_ids": prompt,

@@ -135,6 +135,10 @@ class ParallelConfig:
     # has work, so expert groups spanning DP ranks keep their collectives
     # alive (reference ``DPEngineCoreProc.run_busy_loop``).
     data_parallel_lockstep: bool = False
+    # Microbatches per pipelined step (0 -> pipeline_parallel_size). More
+    # microbatches shrink in-step bubbles at the cost of smaller per-tick
+    # matmuls; the engine's in-flight step queue fills the rest.
+    pipeline_microbatches: int = 0
     # Backend for engine<->worker transport: in-proc by default on TPU since
     # one host drives all local chips via a single jax client.
     distributed_executor_backend: Literal["uniproc", "mp"] = "uniproc"

@@ -52,6 +52,7 @@ class EngineArgs:
     scheduling_policy: str = "fcfs"
     async_scheduling: bool = True
     num_decode_steps: int = 1
+    encoder_cache_budget: int = 4096
 
     tensor_parallel_size: int = 1
     data_parallel_size: int = 1
@@ -61,6 +62,7 @@ class EngineArgs:
     distributed_executor_backend: str = "uniproc"
     data_parallel_engines: int = 1
     data_parallel_lockstep: bool = False
+    pipeline_microbatches: int = 0
 
     device: str = "auto"
 
@@ -112,6 +114,7 @@ class EngineArgs:
                 distributed_executor_backend=self.distributed_executor_backend,  # type: ignore[arg-type]
                 data_parallel_engines=self.data_parallel_engines,
                 data_parallel_lockstep=self.data_parallel_lockstep,
+                pipeline_microbatches=self.pipeline_microbatches,
             ),
             scheduler_config=SchedulerConfig(
                 max_num_batched_tokens=self.max_num_batched_tokens,
@@ -120,6 +123,7 @@ class EngineArgs:
                 policy=self.scheduling_policy,  # type: ignore[arg-type]
                 async_scheduling=self.async_scheduling,
                 num_decode_steps=self.num_decode_steps,
+                encoder_cache_budget=self.encoder_cache_budget,
             ),
             device_config=DeviceConfig(device=self.device),  # type: ignore[arg-type]
             speculative_config=SpeculativeConfig(

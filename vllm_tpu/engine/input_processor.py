@@ -50,7 +50,7 @@ class InputProcessor:
                 raise ValueError(
                     f"{cls.__name__} does not accept multi_modal_data"
                 )
-            self._mm_info_cache = cls(hf_config).mm_info()
+            self._mm_info_cache = cls.mm_info(hf_config)
         return self._mm_info_cache
 
     @property

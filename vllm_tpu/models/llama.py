@@ -59,6 +59,13 @@ class LlamaForCausalLM:
     # Weight-only quantized matmuls (per-output-channel int8/fp8); norms,
     # embeddings, and lm_head stay in the model dtype.
     QUANT_KEYS = ("wq", "wk", "wv", "wo", "wgate", "wup", "wdown")
+    # Pipeline parallelism (set by the worker): stage count over the 'pp'
+    # mesh axis, microbatch count, and the mesh for shard_map. The layer
+    # stack's leading axis is sharded over 'pp'; a collective-permute
+    # microbatch pipeline runs inside one jit (``_apply_pp``).
+    pp_size = 1
+    pp_microbatches = 0  # 0 -> pp_size
+    pp_mesh = None
 
     def __init__(self, hf_config: Any, dtype=jnp.bfloat16,
                  quantization: str | None = None) -> None:
@@ -195,13 +202,35 @@ class LlamaForCausalLM:
             if inputs_embeds is not None
             else params["embed"][input_ids].astype(self.dtype)
         )  # [T, D]
-        t = x.shape[0]
-        H, KH, Dh = self.num_heads, self.num_kv_heads, self.head_dim
+        if self.pp_size > 1:
+            return self._apply_pp(params, kv_cache, x, md)
+        layer_fn = self._make_layer_fn(
+            md, x.shape[0],
+            token_lora_slot=token_lora_slot,
+            lora_scale=params.get("lora_scaling"),
+        )
+        # Scan over the layer stack with the WHOLE cache in the carry: the
+        # per-layer scatter + page gathers touch only live slots, and the
+        # donated buffer is updated in place (per-layer xs/ys would
+        # double-buffer the cache and copy a full layer per iteration).
+        (x, new_kv), _ = jax.lax.scan(
+            layer_fn,
+            (x, kv_cache),
+            (params["layers"], jnp.arange(self.num_layers, dtype=jnp.int32)),
+        )
+        x = rms_norm(x, params["final_norm"], self.rms_eps)
+        return x, new_kv
 
+    def _make_layer_fn(self, md: AttentionMetadata, t: int, *,
+                       token_lora_slot=None, lora_scale=None,
+                       attn_fn=paged_attention):
+        """One decoder layer as a ``lax.scan`` body over (lp, layer_idx)
+        with carry (hidden, kv_cache); shared by the plain and pipelined
+        forward paths."""
+        H, KH, Dh = self.num_heads, self.num_kv_heads, self.head_dim
         rope_cos, rope_sin = self.rope.cos, self.rope.sin
         bias = self.attention_bias
         use_lora = self.enable_lora and token_lora_slot is not None
-        lora_scale = params.get("lora_scaling")
 
         def proj(h, lp, key):
             out = qmm(h, lp[key])
@@ -238,7 +267,7 @@ class LlamaForCausalLM:
 
             kv = write_kv(kv, li, k, v, md.slot_mapping)
             kv_scale = kv_dequant_scale(kv)
-            attn = paged_attention(
+            attn = attn_fn(
                 q, kv, li, md, self.scale, sliding_window=self.sliding_window,
                 k_scale=kv_scale, v_scale=kv_scale,
             )
@@ -253,17 +282,127 @@ class LlamaForCausalLM:
             )
             return (x, kv), None
 
-        # Scan over the layer stack with the WHOLE cache in the carry: the
-        # per-layer scatter + page gathers touch only live slots, and the
-        # donated buffer is updated in place (per-layer xs/ys would
-        # double-buffer the cache and copy a full layer per iteration).
-        (x, new_kv), _ = jax.lax.scan(
-            layer_fn,
-            (x, kv_cache),
-            (params["layers"], jnp.arange(self.num_layers, dtype=jnp.int32)),
+        return layer_fn
+
+    def _apply_pp(
+        self,
+        params: dict,
+        kv_cache: jnp.ndarray,  # [L, ...] sharded P('pp', ...) on axis 0
+        x: jnp.ndarray,  # [T, D] embedded inputs (replicated)
+        md: AttentionMetadata,
+    ) -> tuple[jnp.ndarray, jnp.ndarray]:
+        """Collective-permute microbatch pipeline over the 'pp' mesh axis.
+
+        Reference analog: PP layer-range partitioning + send/recv of
+        IntermediateTensors (``parallel_state.py:821,916``) and the
+        batch-queue bubble fill (``core.py:443``). The TPU formulation is
+        the classic GSPMD pipeline: each stage holds L/S layers (leading
+        stack axis sharded over 'pp'), M microbatches flow through
+        M+S-1 ticks inside ONE jitted program, activations hop stages via
+        ``lax.ppermute`` over ICI. Bubbles across steps are additionally
+        filled by the engine's in-flight step queue (async_pipeline_depth),
+        which plays the role of the reference's batch queue.
+
+        KV correctness across microbatches: microbatch m reaches stage s at
+        tick s+m, strictly after m-1's KV for that stage's layers was
+        written at tick s+m-1, so causal attention over the step's own
+        tokens sees exactly the prefix KV it would in the unpipelined scan.
+        The attention inside the pipeline takes the XLA reference path (the
+        Pallas kernel's per-request descriptors assume the full [T] batch;
+        a microbatch-aware kernel is the optimization seam).
+        """
+        from functools import partial as _partial
+
+        from jax.sharding import PartitionSpec as P
+
+        from vllm_tpu.ops.attention import ref_ragged_paged_attention
+
+        S = self.pp_size
+        mesh = self.pp_mesh
+        assert mesh is not None, "pp_mesh must be set for pipeline parallel"
+        assert self.num_layers % S == 0, (
+            f"num_layers {self.num_layers} not divisible by pp={S}"
         )
-        x = rms_norm(x, params["final_norm"], self.rms_eps)
-        return x, new_kv
+        t, d = x.shape
+        m = self.pp_microbatches or S
+        while t % m:
+            m //= 2  # token buckets are powers of two
+        m = max(m, 1)
+        tm = t // m
+        ls = self.num_layers // S
+
+        chunks = x.reshape(m, tm, d)
+        pos_m = md.positions.reshape(m, tm)
+        slot_m = md.slot_mapping.reshape(m, tm)
+        tri_m = md.token_req_idx.reshape(m, tm)
+
+        def attn_ref(q, kv, li, md_m, scale, **kw):
+            return ref_ragged_paged_attention(q, kv, li, md_m, scale, **kw)
+
+        @_partial(
+            jax.shard_map,
+            mesh=mesh,
+            in_specs=(P("pp"), P("pp"), P(), P(), P(), P(), P(), P(), P(),
+                      P(), P()),
+            out_specs=(P(), P("pp")),
+            axis_names={"pp"},
+        )
+        def run(layers_local, kv_local, chunks, pos_m, slot_m, tri_m,
+                block_tables, seq_lens, qsl, logits_idx, num_seqs):
+            stage = jax.lax.axis_index("pp")
+            varying = _partial(jax.lax.pcast, axis_name=("pp",), to="varying")
+            buf = varying(jnp.zeros((tm, d), x.dtype))
+            outs = varying(jnp.zeros((m, tm, d), x.dtype))
+            li_local = jnp.arange(ls, dtype=jnp.int32)
+
+            def tick(carry, tk):
+                buf, outs, kv_l = carry
+                mb = jnp.clip(tk - stage, 0, m - 1)
+                valid = (tk - stage >= 0) & (tk - stage < m)
+                cur = jnp.where(stage == 0, chunks[jnp.clip(tk, 0, m - 1)], buf)
+                md_m = AttentionMetadata(
+                    positions=pos_m[mb],
+                    # Invalid (bubble) ticks scatter into the write-only
+                    # null slot 0 instead of corrupting live pages.
+                    slot_mapping=jnp.where(valid, slot_m[mb], 0),
+                    block_tables=block_tables,
+                    seq_lens=seq_lens,
+                    query_start_loc=qsl,
+                    token_req_idx=tri_m[mb],
+                    logits_indices=logits_idx,
+                    num_seqs=num_seqs,
+                )
+                layer_fn = self._make_layer_fn(md_m, tm, attn_fn=attn_ref)
+                (cur, kv_l), _ = jax.lax.scan(
+                    layer_fn, (cur, kv_l), (layers_local, li_local)
+                )
+                out_idx = tk - (S - 1)
+                do = (stage == S - 1) & (out_idx >= 0) & (out_idx < m)
+                upd = jax.lax.dynamic_update_index_in_dim(
+                    outs, cur, jnp.clip(out_idx, 0, m - 1), 0
+                )
+                outs = jnp.where(do, upd, outs)
+                nxt = jax.lax.ppermute(
+                    cur, "pp", [(i, (i + 1) % S) for i in range(S)]
+                )
+                return (nxt, outs, kv_l), None
+
+            (buf, outs, kv_local), _ = jax.lax.scan(
+                tick, (buf, outs, kv_local),
+                jnp.arange(m + S - 1, dtype=jnp.int32),
+            )
+            # Only the last stage holds real outputs; broadcast them.
+            outs = jnp.where(stage == S - 1, outs, jnp.zeros_like(outs))
+            outs = jax.lax.psum(outs, "pp")
+            return outs.reshape(t, d), kv_local
+
+        hidden, new_kv = run(
+            params["layers"], kv_cache, chunks, pos_m, slot_m, tri_m,
+            md.block_tables, md.seq_lens, md.query_start_loc,
+            md.logits_indices, md.num_seqs,
+        )
+        hidden = rms_norm(hidden, params["final_norm"], self.rms_eps)
+        return hidden, new_kv
 
     def compute_logits(self, params: dict, hidden: jnp.ndarray) -> jnp.ndarray:
         head = params["embed"].T if self.tie_embeddings else params["lm_head"]
@@ -319,6 +458,16 @@ class LlamaForCausalLM:
             for k in self.QUANT_KEYS:
                 w = layers[k]
                 layers[k] = QuantizedLinear(q=w, scale=P(w[0], w[-1]))
+        if self.pp_size > 1:
+            # Layer stacks: leading L axis over the 'pp' stage axis.
+            def stage(spec):
+                if isinstance(spec, QuantizedLinear):
+                    return QuantizedLinear(
+                        q=stage(spec.q), scale=stage(spec.scale)
+                    )
+                return P("pp", *spec[1:])
+
+            layers = {k: stage(v) for k, v in layers.items()}
         out = {
             "embed": P(tp, None),
             "layers": layers,
@@ -329,8 +478,10 @@ class LlamaForCausalLM:
         return out
 
     def kv_cache_sharding(self, model_axis: str = "tp") -> P:
-        """KV heads sharded over TP: [L, NB, BS, 2*KH(tp), Dh]."""
-        return P(None, None, None, model_axis, None)
+        """KV heads sharded over TP: [L, NB, BS, 2*KH(tp), Dh]; the layer
+        axis over 'pp' stages when pipelined."""
+        lead = "pp" if self.pp_size > 1 else None
+        return P(lead, None, None, model_axis, None)
 
 
 class MistralForCausalLM(LlamaForCausalLM):

@@ -93,8 +93,13 @@ class LlavaForConditionalGeneration:
         self.vision_ln_eps = getattr(vc, "layer_norm_eps", 1e-5)
         self.image_token_id = hf_config.image_token_index
         feature_layer = getattr(hf_config, "vision_feature_layer", -2)
-        # hidden_states[-2] = output of layer Lv-1 (run all but the last).
-        self.vision_run_layers = self.vision_layers + 1 + feature_layer
+        # HF hidden_states indexing: hs[0] is the embedding output, hs[k]
+        # the output of layer k; negative indexes count from hs[Lv].
+        self.vision_run_layers = (
+            feature_layer
+            if feature_layer >= 0
+            else self.vision_layers + 1 + feature_layer
+        )
         strategy = getattr(
             hf_config, "vision_feature_select_strategy", "default"
         )
@@ -103,12 +108,20 @@ class LlavaForConditionalGeneration:
             self.num_patches if self.drop_cls else self.num_patches + 1
         )
 
-    # Input-processor contract (frontend side, no weights needed).
-    def mm_info(self) -> dict:
+    # Input-processor contract (frontend side: config facts only, no
+    # model construction, no device arrays).
+    @classmethod
+    def mm_info(cls, hf_config: Any) -> dict:
+        vc = hf_config.vision_config
+        num_patches = (vc.image_size // vc.patch_size) ** 2
+        drop_cls = (
+            getattr(hf_config, "vision_feature_select_strategy", "default")
+            == "default"
+        )
         return {
-            "image_token_id": self.image_token_id,
-            "tokens_per_image": self.tokens_per_image,
-            "image_size": self.image_size,
+            "image_token_id": hf_config.image_token_index,
+            "tokens_per_image": num_patches if drop_cls else num_patches + 1,
+            "image_size": vc.image_size,
         }
 
     # ------------------------------------------------------------------

@@ -117,6 +117,25 @@ class Worker:
         self.model = model_cls(
             hf_config, dtype=mc.jax_dtype, quantization=mc.quantization
         )
+        pc = self.config.parallel_config
+        if pc.pipeline_parallel_size > 1:
+            from vllm_tpu.models.llama import LlamaForCausalLM
+
+            if getattr(type(self.model), "apply", None) is not LlamaForCausalLM.apply:
+                raise ValueError(
+                    f"{type(self.model).__name__} does not support pipeline "
+                    "parallelism yet (Llama-family only)"
+                )
+            if self.config.lora_config.enable_lora:
+                raise ValueError(
+                    "LoRA serving is not supported with pipeline "
+                    "parallelism yet (adapter deltas are not threaded "
+                    "through the pipelined layer scan)"
+                )
+            assert self.mesh is not None, "pp requires a device mesh"
+            self.model.pp_size = pc.pipeline_parallel_size
+            self.model.pp_microbatches = pc.pipeline_microbatches
+            self.model.pp_mesh = self.mesh
         # The model decides whether it really uses a window (some HF
         # configs carry sliding_window for archs that ignore it).
         window = getattr(self.model, "sliding_window", None)
