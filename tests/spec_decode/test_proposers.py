@@ -1,0 +1,252 @@
+"""Medusa / suffix / draft-model proposers: unit semantics + e2e greedy
+equivalence (spec decode must never change greedy output).
+
+Reference analog: ``tests/v1/spec_decode/`` (medusa.py, suffix_decoding.py,
+draft_model.py coverage).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+
+# ----------------------------------------------------------------------
+# Suffix proposer
+# ----------------------------------------------------------------------
+
+
+def test_suffix_own_history_match():
+    from vllm_tpu.spec_decode.suffix_proposer import SuffixProposer
+
+    p = SuffixProposer(3, max_depth=4, min_depth=2)
+    hist = np.array([1, 5, 6, 9, 9, 2, 5, 6], np.int64)
+    # Suffix [5, 6] occurred before, followed by 9 9 2.
+    assert p.propose(hist) == [9, 9, 2]
+
+
+def test_suffix_corpus_match():
+    from vllm_tpu.spec_decode.suffix_proposer import SuffixProposer
+
+    p = SuffixProposer(4, max_depth=4, min_depth=2)
+    p.observe_finished(np.array([7, 8, 3, 4, 5, 6], np.int64))
+    # No self-match in history; corpus continues [7, 8] with 3 4 5 6.
+    assert p.propose(np.array([1, 2, 7, 8], np.int64)) == [3, 4, 5, 6]
+
+
+def test_suffix_prefers_longer_match():
+    from vllm_tpu.spec_decode.suffix_proposer import SuffixProposer
+
+    p = SuffixProposer(2, max_depth=4, min_depth=2)
+    p.observe_finished(np.array([1, 7, 8, 50, 50], np.int64))
+    p.observe_finished(np.array([2, 1, 7, 8, 60, 60], np.int64))
+    # [2, 1, 7, 8] (depth 4, second seq) beats [7, 8] (depth 2, first).
+    assert p.propose(np.array([9, 2, 1, 7, 8], np.int64)) == [60, 60]
+
+
+def test_suffix_corpus_eviction():
+    from vllm_tpu.spec_decode.suffix_proposer import SuffixProposer
+
+    p = SuffixProposer(2, corpus_token_cap=10)
+    for base in range(5):
+        p.observe_finished(np.arange(base, base + 6, dtype=np.int64))
+    assert p._corpus_tokens <= 10 + 6  # at most one seq over cap
+
+
+# ----------------------------------------------------------------------
+# Medusa heads
+# ----------------------------------------------------------------------
+
+
+def test_medusa_propose_known_heads():
+    from vllm_tpu.spec_decode.medusa import MedusaHeads
+
+    d, v, k = 4, 8, 2
+    m = MedusaHeads(k, d, v, dtype=jnp.float32)
+    mp = m.init_dummy_params(jax.random.PRNGKey(0))
+    # Zero residual, head k maps feature j to token j + k + 1.
+    head_w = np.zeros((k, d, v), np.float32)
+    for hk in range(k):
+        for j in range(d):
+            head_w[hk, j, (j + hk + 1) % v] = 1.0
+    mp = {
+        "res_w": jnp.zeros((k, d, d), jnp.float32),
+        "res_b": jnp.full((k, d), -100.0, jnp.float32),  # silu(-100) ~ 0
+        "head_w": jnp.asarray(head_w),
+    }
+    hidden = jnp.asarray(np.eye(d)[:3], jnp.float32)  # rows 0,1,2 one-hot
+    drafts = np.asarray(m.propose(mp, hidden))
+    assert drafts.shape == (3, k)
+    for r in range(3):
+        for hk in range(k):
+            assert drafts[r, hk] == (r + hk + 1) % v
+
+
+def test_medusa_checkpoint_roundtrip(tmp_path):
+    from safetensors.numpy import save_file
+
+    from vllm_tpu.spec_decode.medusa import MedusaHeads
+
+    d, v, k = 4, 8, 2
+    rng = np.random.default_rng(0)
+    tensors = {}
+    for hk in range(k):
+        tensors[f"{hk}.0.linear.weight"] = rng.standard_normal(
+            (d, d)
+        ).astype(np.float32)
+        tensors[f"{hk}.0.linear.bias"] = rng.standard_normal(d).astype(
+            np.float32
+        )
+        tensors[f"{hk}.1.weight"] = rng.standard_normal((v, d)).astype(
+            np.float32
+        )
+    save_file(tensors, str(tmp_path / "model.safetensors"))
+    m = MedusaHeads(k, d, v, dtype=jnp.float32)
+    mp = m.load_params(str(tmp_path))
+    np.testing.assert_allclose(
+        np.asarray(mp["res_w"][1]), tensors["1.0.linear.weight"].T,
+        rtol=1e-6,
+    )
+    np.testing.assert_allclose(
+        np.asarray(mp["head_w"][0]), tensors["0.1.weight"].T, rtol=1e-6
+    )
+    out = m.propose(mp, jnp.ones((2, d), jnp.float32))
+    assert out.shape == (2, k)
+
+
+# ----------------------------------------------------------------------
+# E2E greedy equivalence (per method)
+# ----------------------------------------------------------------------
+
+
+def _run(path, prompts, **spec_kwargs):
+    from vllm_tpu import LLM, SamplingParams
+
+    kwargs = dict(
+        dtype="float32", max_model_len=128, block_size=16,
+        num_gpu_blocks_override=64, max_num_seqs=8,
+        max_num_batched_tokens=128,
+    )
+    kwargs.update(spec_kwargs)
+    llm = LLM(model=path, **kwargs)
+    outs = llm.generate(
+        prompts,
+        SamplingParams(temperature=0.0, max_tokens=16, ignore_eos=True),
+    )
+    return [o.outputs[0].token_ids for o in outs]
+
+
+@pytest.fixture(scope="module")
+def equiv_rig(tmp_path_factory):
+    from tests.models.utils import tiny_llama_dir
+
+    path = tiny_llama_dir(tmp_path_factory.mktemp("tiny_llama_spec"))
+    prompts = [
+        {"prompt_token_ids": [5, 6, 7, 5, 6, 7, 5, 6]},
+        {"prompt_token_ids": [9, 9, 9, 9, 9, 9]},
+        {"prompt_token_ids": [3, 1, 4, 1, 5, 9, 2, 6]},
+    ]
+    return path, prompts, _run(path, prompts)
+
+
+def test_suffix_e2e_equivalence(equiv_rig):
+    path, prompts, ref = equiv_rig
+    got = _run(
+        path, prompts,
+        speculative_method="suffix", num_speculative_tokens=3,
+    )
+    assert got == ref
+
+
+def test_draft_model_e2e_equivalence(equiv_rig):
+    path, prompts, ref = equiv_rig
+    # The draft IS the target model: proposals should be exact, and the
+    # output must still be identical.
+    got = _run(
+        path, prompts,
+        speculative_method="draft_model", speculative_model=path,
+        num_speculative_tokens=3,
+    )
+    assert got == ref
+
+
+def test_medusa_e2e_equivalence(equiv_rig, tmp_path):
+    from safetensors.numpy import save_file
+    from transformers import AutoConfig
+
+    path, prompts, ref = equiv_rig
+    cfg = AutoConfig.from_pretrained(path)
+    d, v, k = cfg.hidden_size, cfg.vocab_size, 3
+    rng = np.random.default_rng(1)
+    tensors = {}
+    for hk in range(k):
+        tensors[f"{hk}.0.linear.weight"] = (
+            rng.standard_normal((d, d)).astype(np.float32) * 0.02
+        )
+        tensors[f"{hk}.0.linear.bias"] = np.zeros(d, np.float32)
+        tensors[f"{hk}.1.weight"] = (
+            rng.standard_normal((v, d)).astype(np.float32) * 0.02
+        )
+    heads_dir = tmp_path / "medusa"
+    heads_dir.mkdir()
+    save_file(tensors, str(heads_dir / "model.safetensors"))
+    # Untrained heads: almost everything gets rejected, but the greedy
+    # output must be unchanged (rejection-sampler correctness).
+    got = _run(
+        path, prompts,
+        speculative_method="medusa", speculative_model=str(heads_dir),
+        num_speculative_tokens=k,
+    )
+    assert got == ref
+
+
+def test_draft_model_tp_mesh(equiv_rig):
+    """Draft-model spec on a TP mesh (exercises draft KV sharding)."""
+    path, prompts, ref = equiv_rig
+    got = _run(
+        path, prompts,
+        speculative_method="draft_model", speculative_model=path,
+        num_speculative_tokens=3, tensor_parallel_size=2,
+    )
+    assert got == ref
+
+
+def test_medusa_survives_sleep_wake(equiv_rig, tmp_path):
+    from safetensors.numpy import save_file
+    from transformers import AutoConfig
+
+    from vllm_tpu import LLM, SamplingParams
+
+    path, prompts, ref = equiv_rig
+    cfg = AutoConfig.from_pretrained(path)
+    d, v, k = cfg.hidden_size, cfg.vocab_size, 2
+    rng = np.random.default_rng(2)
+    tensors = {}
+    for hk in range(k):
+        tensors[f"{hk}.0.linear.weight"] = (
+            rng.standard_normal((d, d)).astype(np.float32) * 0.02
+        )
+        tensors[f"{hk}.0.linear.bias"] = np.zeros(d, np.float32)
+        tensors[f"{hk}.1.weight"] = (
+            rng.standard_normal((v, d)).astype(np.float32) * 0.02
+        )
+    heads_dir = tmp_path / "medusa_sw"
+    heads_dir.mkdir()
+    save_file(tensors, str(heads_dir / "model.safetensors"))
+    llm = LLM(
+        model=path, dtype="float32", max_model_len=128, block_size=16,
+        num_gpu_blocks_override=64, max_num_seqs=8,
+        max_num_batched_tokens=128,
+        speculative_method="medusa", speculative_model=str(heads_dir),
+        num_speculative_tokens=k,
+    )
+    sp = SamplingParams(temperature=0.0, max_tokens=16, ignore_eos=True)
+    first = [o.outputs[0].token_ids for o in llm.generate(prompts, sp)]
+    assert first == ref
+    assert llm.sleep(1)
+    assert llm.wake_up()
+    again = [o.outputs[0].token_ids for o in llm.generate(prompts, sp)]
+    assert again == ref

@@ -207,12 +207,15 @@ class DeviceConfig:
 class SpeculativeConfig:
     """Speculative decoding. Reference analog: ``vllm/config/speculative.py``."""
 
-    method: Literal["ngram", "eagle", "draft_model", "suffix"] | None = None
+    method: Literal[
+        "ngram", "eagle", "draft_model", "suffix", "medusa"
+    ] | None = None
     num_speculative_tokens: int = 0
     # ngram proposer window
     prompt_lookup_max: int = 4
     prompt_lookup_min: int = 1
-    model: str | None = None  # draft model path for eagle/draft_model
+    # Draft checkpoint path: EAGLE head / full draft model / medusa heads.
+    model: str | None = None
 
     @property
     def enabled(self) -> bool:
@@ -291,10 +294,13 @@ class EngineConfig:
             sc.max_num_batched_tokens = max(sc.max_num_batched_tokens, sc.max_model_len)
         if (
             self.speculative_config.enabled
-            and self.speculative_config.method == "eagle"
+            and self.speculative_config.method in ("eagle", "draft_model")
         ):
+            # In-jit draft chains write draft KV at speculative positions:
+            # EAGLE's chain reaches pos0+k-1, a draft model's pos0+k.
             sc.num_lookahead_tokens = (
                 self.speculative_config.num_speculative_tokens
+                + (1 if self.speculative_config.method == "draft_model" else 0)
             )
         self.compilation_config.finalize(sc)
         if self.speculative_config.enabled and self.parallel_config.pipeline_parallel_size > 1:

@@ -1,0 +1,57 @@
+"""Draft-model speculative decoding: a full small LM as proposer.
+
+Reference analog: ``vllm/v1/spec_decode/draft_model.py``. Unlike EAGLE
+(one layer conditioned on target hidden states), the draft is a complete
+independent model with its own embeddings, lm_head, and multi-layer paged
+KV cache. It shares the target's block tables/slot geometry (its cache is
+allocated with the same block count), runs a prefill over each step's
+ragged batch to keep its KV current, then chains greedy single-position
+decodes inside the target's jitted step to produce drafts.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+from vllm_tpu.ops.attention import kv_cache_shape
+
+
+class DraftLM:
+    """Eagle-interface-compatible wrapper around a full decoder."""
+
+    def __init__(self, hf_config: Any, dtype=jnp.bfloat16) -> None:
+        from vllm_tpu.models.registry import get_model_class
+
+        self.lm = get_model_class(hf_config)(hf_config, dtype)
+        self.num_layers = self.lm.num_layers
+        self.num_kv_heads = self.lm.num_kv_heads
+        self.head_dim = self.lm.head_dim
+        self.hidden_size = self.lm.hidden_size
+        self.dtype = dtype
+
+    def load_params(self, path: str, dtype=None) -> dict:
+        return self.lm.load_params(path, dtype or self.dtype)
+
+    def init_dummy_params(self, rng: jax.Array, dtype=None) -> dict:
+        return self.lm.init_dummy_params(rng, dtype or self.dtype)
+
+    def param_shardings(self, *a, **kw):
+        return self.lm.param_shardings(*a, **kw)
+
+    def kv_cache_sharding(self, *a, **kw):
+        return self.lm.kv_cache_sharding(*a, **kw)
+
+    def kv_shape(self, num_blocks: int, block_size: int):
+        return kv_cache_shape(
+            self.num_layers, num_blocks, block_size, self.num_kv_heads,
+            self.head_dim,
+        )
+
+    def apply(self, params: dict, kv, token_ids, md):
+        return self.lm.apply(params, kv, token_ids, md)
+
+    def compute_logits_own(self, params: dict, hidden) -> jnp.ndarray:
+        return self.lm.compute_logits(params, hidden)

@@ -1,0 +1,102 @@
+"""Medusa speculative decoding: parallel prediction heads.
+
+Reference analog: ``vllm/v1/spec_decode/medusa.py:18``. Each head k is a
+residual block + vocab projection predicting the token at offset k+1 from
+the LAST accepted position's hidden state — no draft KV, no extra forward
+passes: the heads run inside the target's jitted step on the already-
+computed hidden states (one [R, D] x [D, V] matmul per head), and the
+existing multi-position verification path checks the proposals next step.
+
+Checkpoint format (FasterDecoding medusa heads): safetensors with keys
+``{k}.0.linear.weight|bias`` (residual block) and ``{k}.1.weight``
+(vocab head), optionally prefixed ``medusa_head.``.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+class MedusaHeads:
+    """K parallel draft heads over the target's hidden states."""
+
+    def __init__(self, num_heads: int, hidden_size: int, vocab_size: int,
+                 dtype=jnp.bfloat16) -> None:
+        self.num_heads = num_heads
+        self.hidden_size = hidden_size
+        self.vocab_size = vocab_size
+        self.dtype = dtype
+
+    def init_dummy_params(self, rng: jax.Array) -> dict:
+        k, d, v = self.num_heads, self.hidden_size, self.vocab_size
+        k1, k2 = jax.random.split(rng)
+
+        def init(key, shape, fan_in):
+            return (
+                jax.random.normal(key, shape, jnp.float32)
+                / math.sqrt(fan_in)
+            ).astype(self.dtype)
+
+        return {
+            "res_w": init(k1, (k, d, d), d),
+            "res_b": jnp.zeros((k, d), self.dtype),
+            "head_w": init(k2, (k, d, v), d),
+        }
+
+    def load_params(self, path: str) -> dict:
+        from vllm_tpu.models.loader import _iter_safetensor_files
+
+        from safetensors import safe_open
+
+        k, d, v = self.num_heads, self.hidden_size, self.vocab_size
+        res_w = np.zeros((k, d, d), np.float32)
+        res_b = np.zeros((k, d), np.float32)
+        head_w = np.zeros((k, d, v), np.float32)
+        seen = set()
+        for file in _iter_safetensor_files(path):
+            with safe_open(file, framework="numpy") as f:
+                for raw in f.keys():
+                    name = raw.removeprefix("medusa_head.")
+                    parts = name.split(".")
+                    if not parts[0].isdigit():
+                        continue
+                    i = int(parts[0])
+                    if i >= k:
+                        continue
+                    arr = f.get_tensor(raw)
+                    if arr.dtype == np.uint16:
+                        arr = arr.view(jnp.bfloat16).astype(np.float32)
+                    if name.endswith("0.linear.weight"):
+                        res_w[i] = arr.T
+                    elif name.endswith("0.linear.bias"):
+                        res_b[i] = arr
+                    elif name.endswith("1.weight") or name.endswith(
+                        "1.linear.weight"
+                    ):
+                        head_w[i] = arr.T
+                    else:
+                        continue
+                    seen.add(name)
+        if not seen:
+            raise ValueError(f"no medusa head weights found in {path}")
+        return {
+            "res_w": jnp.asarray(res_w, self.dtype),
+            "res_b": jnp.asarray(res_b, self.dtype),
+            "head_w": jnp.asarray(head_w, self.dtype),
+        }
+
+    def propose(self, mp: dict, hidden: jnp.ndarray) -> jnp.ndarray:
+        """hidden [R, D] (last accepted position) -> greedy drafts [R, K]."""
+        h = hidden.astype(self.dtype)
+        # Residual SiLU block per head, then vocab argmax.
+        hk = h[None] + jax.nn.silu(
+            jnp.einsum("rd,kde->kre", h, mp["res_w"])
+            + mp["res_b"][:, None, :]
+        )  # [K, R, D]
+        logits = jnp.einsum("kre,kev->krv", hk, mp["head_w"])
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32).T  # [R, K]

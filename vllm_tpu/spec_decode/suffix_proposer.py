@@ -1,0 +1,77 @@
+"""Suffix speculative decoding: continuation lookup over past responses.
+
+Reference analog: ``vllm/v1/spec_decode/suffix_decoding.py:9``. The
+reference builds a suffix tree over recent responses; this implementation
+keeps the same semantics — propose the continuation that followed the
+longest matching suffix of the current context, searching the request's
+own history first and then a bounded corpus of recently finished
+generations — with vectorized window scans over the bounded corpus in
+place of an automaton (host-side, no device work).
+"""
+
+from __future__ import annotations
+
+from collections import deque
+
+import numpy as np
+
+
+class SuffixProposer:
+    def __init__(self, num_speculative_tokens: int, max_depth: int = 8,
+                 min_depth: int = 2, corpus_token_cap: int = 65536) -> None:
+        self.k = num_speculative_tokens
+        self.max_depth = max_depth
+        self.min_depth = min_depth
+        self.cap = corpus_token_cap
+        self._corpus: deque[np.ndarray] = deque()
+        self._corpus_tokens = 0
+
+    def observe_finished(self, token_ids: np.ndarray) -> None:
+        """Fold a finished request's full token history into the corpus."""
+        if len(token_ids) < self.min_depth + 1:
+            return
+        self._corpus.append(np.asarray(token_ids, np.int64).copy())
+        self._corpus_tokens += len(token_ids)
+        while self._corpus_tokens > self.cap and len(self._corpus) > 1:
+            self._corpus_tokens -= len(self._corpus.popleft())
+
+    @staticmethod
+    def _match_continuation(
+        seq: np.ndarray, suffix: np.ndarray, k: int,
+        exclude_tail: bool,
+    ) -> list[int] | None:
+        n = len(suffix)
+        limit = len(seq) - (n if exclude_tail else 0)
+        if limit < n:
+            return None
+        windows = np.lib.stride_tricks.sliding_window_view(seq[:limit], n)
+        hits = np.nonzero((windows == suffix).all(axis=1))[0]
+        # Most recent occurrence with at least one continuation token.
+        for pos in hits[::-1]:
+            start = int(pos) + n
+            cont = seq[start : start + k]
+            if len(cont):
+                return [int(t) for t in cont]
+        return None
+
+    def propose(self, token_ids: np.ndarray) -> list[int]:
+        history = np.asarray(token_ids, np.int64)
+        for n in range(self.max_depth, self.min_depth - 1, -1):
+            if len(history) < n:
+                continue
+            suffix = history[-n:]
+            # Own history first (prompt-lookup), excluding the trailing
+            # suffix matching itself...
+            cont = self._match_continuation(
+                history, suffix, self.k, exclude_tail=True
+            )
+            if cont:
+                return cont
+            # ...then the cross-request corpus, newest first.
+            for seq in reversed(self._corpus):
+                cont = self._match_continuation(
+                    seq, suffix, self.k, exclude_tail=False
+                )
+                if cont:
+                    return cont
+        return []

@@ -134,6 +134,9 @@ class ModelRunner:
         self.draft_model = None
         self.draft_params = None
         self.draft_kv = None
+        self.medusa = None
+        self.medusa_params = None
+        self._in_jit_drafts = self._eagle_drafts
         if spec.enabled and spec.method == "ngram":
             from vllm_tpu.spec_decode.ngram_proposer import NgramProposer
 
@@ -141,12 +144,37 @@ class ModelRunner:
                 spec.prompt_lookup_min, spec.prompt_lookup_max,
                 spec.num_speculative_tokens,
             )
-        elif spec.enabled and spec.method == "eagle":
+        elif spec.enabled and spec.method == "suffix":
+            from vllm_tpu.spec_decode.suffix_proposer import SuffixProposer
+
+            self.proposer = SuffixProposer(spec.num_speculative_tokens)
+        elif spec.enabled and spec.method == "medusa":
+            from vllm_tpu.spec_decode.medusa import MedusaHeads
+
+            self.medusa = MedusaHeads(
+                spec.num_speculative_tokens, model.hidden_size,
+                model.vocab_size, model.dtype,
+            )
+            if spec.model:
+                self.medusa_params = self.medusa.load_params(spec.model)
+            else:
+                assert config.model_config.load_format == "dummy", (
+                    "medusa spec decode needs speculative_config.model"
+                )
+                self.medusa_params = self.medusa.init_dummy_params(
+                    jax.random.PRNGKey(config.model_config.seed + 2)
+                )
+            # Heads ride the params tree so they flow through the jit (a
+            # captured array would be folded into the executable).
+            self.params = {**self.params, "medusa": self.medusa_params}
+        elif spec.enabled and spec.method in ("eagle", "draft_model"):
             assert draft_model is not None and draft_params is not None, (
-                "eagle spec decode needs a loaded draft model"
+                f"{spec.method} spec decode needs a loaded draft model"
             )
             self.draft_model = draft_model
             self.draft_params = draft_params
+            if spec.method == "draft_model":
+                self._in_jit_drafts = self._draft_lm_drafts
 
         self.kv_connector = None
         self._kv_load_fn = jax.jit(
@@ -398,9 +426,15 @@ class ModelRunner:
                 rows_r = jnp.arange(r_pad)
                 anchor = spec["sample_pos"][rows_r, num_out - 1]
                 emitted = out_tokens[rows_r, num_out - 1]
-                drafts, draft_kv = self._eagle_drafts(
+                drafts, draft_kv = self._in_jit_drafts(
                     params, draft_kv, token_ids, hidden, md, anchor,
                     emitted, draft_next, r_pad,
+                )
+            elif self.medusa is not None:
+                rows_r = jnp.arange(r_pad)
+                anchor = spec["sample_pos"][rows_r, num_out - 1]
+                drafts = self.medusa.propose(
+                    params["medusa"], hidden[anchor]
                 )
             return (kv_cache, draft_kv, (out_tokens, num_out), None, drafts,
                     None, spec_nan, None)
@@ -521,10 +555,12 @@ class ModelRunner:
             # the draft prefill maintains the draft KV cache for every
             # computed position — skipping it would leave permanent holes
             # that poison later proposals.
-            drafts, draft_kv = self._eagle_drafts(
+            drafts, draft_kv = self._in_jit_drafts(
                 params, draft_kv, token_ids, hidden, md,
                 md.logits_indices, sampled, draft_next, r_pad,
             )
+        elif self.medusa is not None:
+            drafts = self.medusa.propose(params["medusa"], last)
         if num_logprobs > 0:
             topk_vals, topk_ids = jax.lax.top_k(raw_logprobs, num_logprobs)
             sampled_lp = jnp.take_along_axis(
@@ -591,12 +627,52 @@ class ModelRunner:
             drafts.append(d_tok)
         return jnp.stack(drafts, axis=1), draft_kv
 
+    def _draft_lm_drafts(self, params, draft_kv, token_ids, hidden, md,
+                         anchor, emitted, draft_next, r_pad):
+        """In-jit draft-model proposal (reference:
+        ``vllm/v1/spec_decode/draft_model.py``).
+
+        1. Draft prefill over this step's ragged batch (UNshifted — the
+           draft is an independent LM at the same positions), maintaining
+           its own multi-layer paged KV in the target's block geometry.
+        2. Feed the freshly emitted token at the next position, then chain
+           ``num_spec`` greedy decodes through the full draft model,
+           writing its KV into the scheduler's lookahead slots.
+        """
+        dm, dp = self.draft_model, self.draft_params
+        _, draft_kv = dm.apply(dp, draft_kv, token_ids, md)
+        pos0 = md.positions[anchor]
+        tok = jnp.where(draft_next >= 0, draft_next, emitted)
+        drafts = []
+        for k in range(self.num_spec):
+            md_k = self._single_pos_metadata(md, pos0 + 1 + k, r_pad)
+            h1, draft_kv = dm.apply(dp, draft_kv, tok, md_k)
+            tok = jnp.argmax(
+                dm.compute_logits_own(dp, h1), axis=-1
+            ).astype(jnp.int32)
+            drafts.append(tok)
+        return jnp.stack(drafts, axis=1), draft_kv
+
     # ------------------------------------------------------------------
     # Host side
     # ------------------------------------------------------------------
 
     def _update_states(self, so: SchedulerOutput) -> None:
         for req_id in so.finished_req_ids:
+            # Suffix decoding: finished generations feed the cross-request
+            # continuation corpus.
+            state = self.input_batch.req_states.get(req_id)
+            if (
+                state is not None
+                and state.in_batch_row >= 0
+                and self.proposer is not None
+                and hasattr(self.proposer, "observe_finished")
+            ):
+                row = state.in_batch_row
+                n_tok = int(self.input_batch.num_tokens[row])
+                self.proposer.observe_finished(
+                    self.input_batch.token_ids[row, :n_tok]
+                )
             self.input_batch.remove_request(req_id)
         cached = so.scheduled_cached_reqs
         for i, req_id in enumerate(cached.req_ids):
@@ -1486,6 +1562,18 @@ class ModelRunner:
                 "level-2 sleep requires reload params"
             )
             self.params = self._put_params(self._host_params)
+        if self.medusa is not None and "medusa" not in self.params:
+            # Level-2 wake reloads the target checkpoint, which has no
+            # draft heads: reload them from their own source.
+            spec = self.config.speculative_config
+            mp = (
+                self.medusa.load_params(spec.model)
+                if spec.model
+                else self.medusa.init_dummy_params(
+                    jax.random.PRNGKey(self.config.model_config.seed + 2)
+                )
+            )
+            self.params = {**self.params, "medusa": mp}
         self._host_params = None
         self.kv_cache = self._alloc_kv_cache()
         if self.draft_model is not None:
@@ -1511,6 +1599,22 @@ class ModelRunner:
             self.draft_kv = self._alloc_draft_kv()
         logger.info("runner awake")
 
+    def _full_param_shardings(self):
+        """Model shardings plus runner-grafted trees (medusa heads)."""
+        from jax.sharding import PartitionSpec as P
+
+        specs = self.model.param_shardings()
+        if self.medusa is not None:
+            specs = {
+                **specs,
+                "medusa": {
+                    "res_w": P(None, None, None),
+                    "res_b": P(None, None),
+                    "head_w": P(None, None, None),
+                },
+            }
+        return specs
+
     def _put_params(self, host_tree):
         import jax
 
@@ -1518,7 +1622,7 @@ class ModelRunner:
             return jax.tree_util.tree_map(jnp.asarray, host_tree)
         from vllm_tpu.parallel.mesh import named_shardings
 
-        shardings = named_shardings(self.mesh, self.model.param_shardings())
+        shardings = named_shardings(self.mesh, self._full_param_shardings())
         return jax.tree_util.tree_map(
             lambda x, sp: jax.device_put(jnp.asarray(x), sp),
             host_tree, shardings,
@@ -1539,6 +1643,7 @@ class ModelRunner:
             )
         old = self.params
         new = self.model.load_params(path, self.model.dtype, shardings)
+        carried = False
         if self.lora_manager is not None:
             # Adapter slots are runtime state, not checkpoint state: carry
             # them (and the scaling vector) into the new tree.
@@ -1546,10 +1651,15 @@ class ModelRunner:
                 if key.startswith("lora_"):
                     new["layers"][key] = leaf
             new["lora_scaling"] = old["lora_scaling"]
+            carried = True
+        if self.medusa is not None:
+            # Draft heads are not part of the target checkpoint.
+            new["medusa"] = old["medusa"]
+            carried = True
         self.params = new
         kept = (
             {id(leaf) for leaf in jax.tree_util.tree_leaves(new)}
-            if self.lora_manager is not None
+            if carried
             else set()
         )
         for leaf in jax.tree_util.tree_leaves(old):

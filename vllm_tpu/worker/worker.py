@@ -167,6 +167,8 @@ class Worker:
         spec = self.config.speculative_config
         if spec.enabled and spec.method == "eagle":
             self._load_eagle(spec, mc)
+        elif spec.enabled and spec.method == "draft_model":
+            self._load_draft_lm(spec, mc)
 
     def _load_eagle(self, spec, mc) -> None:
         """Load the EAGLE draft head (reference: eagle.py load path)."""
@@ -200,6 +202,37 @@ class Worker:
                 lambda x, sp: jax.device_put(x, sp), self.draft_params, sh
             )
 
+    def _load_draft_lm(self, spec, mc) -> None:
+        """Load a full small LM as the draft proposer (reference:
+        ``vllm/v1/spec_decode/draft_model.py``)."""
+        import jax
+
+        from vllm_tpu.spec_decode.draft_model import DraftLM
+
+        if spec.model:
+            from transformers import AutoConfig
+
+            draft_cfg = AutoConfig.from_pretrained(spec.model)
+            self.draft_model = DraftLM(draft_cfg, mc.jax_dtype)
+            self.draft_params = self.draft_model.load_params(
+                spec.model, mc.jax_dtype
+            )
+        else:
+            assert mc.load_format == "dummy", (
+                "draft_model spec decode needs speculative_config.model"
+            )
+            self.draft_model = DraftLM(mc.hf_config, mc.jax_dtype)
+            self.draft_params = self.draft_model.init_dummy_params(
+                jax.random.PRNGKey(mc.seed + 1), mc.jax_dtype
+            )
+        if self.mesh is not None:
+            from vllm_tpu.parallel.mesh import named_shardings
+
+            sh = named_shardings(self.mesh, self.draft_model.param_shardings())
+            self.draft_params = jax.tree_util.tree_map(
+                lambda x, sp: jax.device_put(x, sp), self.draft_params, sh
+            )
+
     # ------------------------------------------------------------------
 
     def determine_num_kv_blocks(self) -> int:
@@ -221,15 +254,17 @@ class Worker:
             cache.block_size, jnp.dtype(kv_dtype).itemsize
         )
         if self.draft_model is not None:
-            # EAGLE's single-layer draft KV comes out of the same budget.
+            # The draft KV (1 layer for EAGLE, the full stack for a
+            # draft model) comes out of the same budget.
             from vllm_tpu.core.kv_cache_utils import FullAttentionSpec
 
-            specs["eagle_draft"] = FullAttentionSpec(
-                block_size=cache.block_size,
-                num_kv_heads=self.draft_model.num_kv_heads,
-                head_size=self.draft_model.head_dim,
-                dtype_bytes=jnp.dtype(kv_dtype).itemsize,
-            )
+            for i in range(getattr(self.draft_model, "num_layers", 1)):
+                specs[f"draft_{i}"] = FullAttentionSpec(
+                    block_size=cache.block_size,
+                    num_kv_heads=self.draft_model.num_kv_heads,
+                    head_size=self.draft_model.head_dim,
+                    dtype_bytes=jnp.dtype(kv_dtype).itemsize,
+                )
         stats = getattr(self.device, "memory_stats", lambda: None)()
         if stats and "bytes_limit" in stats:
             limit = stats["bytes_limit"] * cache.gpu_memory_utilization
