@@ -139,6 +139,13 @@ class ParallelConfig:
     # microbatches shrink in-step bubbles at the cost of smaller per-tick
     # matmuls; the engine's in-flight step queue fills the rest.
     pipeline_microbatches: int = 0
+    # EPLB (expert-parallel load balancing, reference vllm/distributed/
+    # eplb/): accumulate per-expert token counts and re-pack experts onto
+    # EP groups every eplb_window steps.
+    enable_eplb: bool = False
+    eplb_window: int = 32
+    # EP group count for balancing (0 -> the expert-sharding axis size).
+    eplb_num_groups: int = 0
     # Backend for engine<->worker transport: in-proc by default on TPU since
     # one host drives all local chips via a single jax client.
     distributed_executor_backend: Literal["uniproc", "mp"] = "uniproc"

@@ -63,6 +63,9 @@ class EngineArgs:
     data_parallel_engines: int = 1
     data_parallel_lockstep: bool = False
     pipeline_microbatches: int = 0
+    enable_eplb: bool = False
+    eplb_window: int = 32
+    eplb_num_groups: int = 0
 
     device: str = "auto"
 
@@ -115,6 +118,9 @@ class EngineArgs:
                 data_parallel_engines=self.data_parallel_engines,
                 data_parallel_lockstep=self.data_parallel_lockstep,
                 pipeline_microbatches=self.pipeline_microbatches,
+                enable_eplb=self.enable_eplb,
+                eplb_window=self.eplb_window,
+                eplb_num_groups=self.eplb_num_groups,
             ),
             scheduler_config=SchedulerConfig(
                 max_num_batched_tokens=self.max_num_batched_tokens,

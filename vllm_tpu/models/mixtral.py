@@ -16,7 +16,7 @@ import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
 from vllm_tpu.layers.layernorm import rms_norm
-from vllm_tpu.layers.moe import fused_moe
+from vllm_tpu.layers.moe import fused_experts, select_experts
 from vllm_tpu.layers.rotary import _apply_rotate_half
 from vllm_tpu.models.llama import LlamaForCausalLM
 from vllm_tpu.ops.attention import (
@@ -29,6 +29,12 @@ from vllm_tpu.ops.attention import (
 
 class MixtralForCausalLM(LlamaForCausalLM):
     supports_lora = False  # MoE expert adapters are future work
+    supports_eplb = True
+    # Set by the worker when EPLB is on: routing stays in logical expert
+    # ids, a per-layer [E] map redirects to physical slots, and apply()
+    # returns per-layer logical-expert token counts as a third output.
+    enable_eplb = False
+
     def __init__(self, hf_config: Any, dtype=jnp.bfloat16,
                  quantization: str | None = None) -> None:
         if quantization:
@@ -54,6 +60,14 @@ class MixtralForCausalLM(LlamaForCausalLM):
 
         dtype = dtype or self.dtype
         params = super().init_dummy_params(rng, dtype)
+        if self.enable_eplb:
+            # Identity logical->physical map (must exist in the dummy tree
+            # too: the shardings tree includes it, and a meshed dummy init
+            # tree_maps the two together).
+            params["layers"]["eplb_l2p"] = jnp.tile(
+                jnp.arange(self.num_experts, dtype=jnp.int32),
+                (self.num_layers, 1),
+            )
         layers = params["layers"]
         for name in ("wgate", "wup", "wdown"):
             del layers[name]
@@ -129,25 +143,49 @@ class MixtralForCausalLM(LlamaForCausalLM):
             x = x + attn.reshape(t, H * Dh) @ lp["wo"]
 
             h2 = rms_norm(x, lp["post_norm"], self.rms_eps)
-            moe_out = fused_moe(
+            logits = (
+                h2.astype(jnp.float32) @ lp["router"].astype(jnp.float32)
+            )
+            weights, ids = select_experts(
+                logits, self.top_k, self.renormalize
+            )
+            counts_l = None
+            if self.enable_eplb:
+                # Load statistics in LOGICAL expert ids over LIVE tokens
+                # only (pad slots all route identically and would drown
+                # the real signal); the l2p table redirects dispatch to
+                # the balanced physical layout.
+                live = (
+                    jnp.arange(t)
+                    < md.query_start_loc[md.num_seqs[0]]
+                )
+                contrib = jnp.broadcast_to(
+                    live[:, None], ids.shape
+                ).astype(jnp.int32)
+                counts_l = jnp.zeros(
+                    self.num_experts, jnp.int32
+                ).at[ids.reshape(-1)].add(contrib.reshape(-1))
+                ids = lp["eplb_l2p"][ids]
+            moe_out = fused_experts(
                 h2,
-                lp["router"],
                 lp["we_gate"],
                 lp["we_up"],
                 lp["we_down"],
-                top_k=self.top_k,
-                renormalize=self.renormalize,
+                weights,
+                ids,
                 use_grouped=None if not self.expert_parallel else False,
             )
-            return (x + moe_out, kv), None
+            return (x + moe_out, kv), counts_l
 
         # Whole cache in the carry: in-place paged KV (see models/llama.py).
-        (x, new_kv), _ = jax.lax.scan(
+        (x, new_kv), counts = jax.lax.scan(
             layer_fn,
             (x, kv_cache),
             (params["layers"], jnp.arange(self.num_layers, dtype=jnp.int32)),
         )
         x = rms_norm(x, params["final_norm"], self.rms_eps)
+        if self.enable_eplb:
+            return x, new_kv, counts  # counts [L, E]
         return x, new_kv
 
     # ------------------------------------------------------------------
@@ -170,4 +208,6 @@ class MixtralForCausalLM(LlamaForCausalLM):
             layers["we_gate"] = P(None, None, None, tp)
             layers["we_up"] = P(None, None, None, tp)
             layers["we_down"] = P(None, None, tp, None)
+        if self.enable_eplb:
+            layers["eplb_l2p"] = P(None, None)
         return out

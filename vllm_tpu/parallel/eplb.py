@@ -1,0 +1,128 @@
+"""EPLB: expert-parallel load balancing.
+
+Reference analog: ``vllm/distributed/eplb/`` (``EplbState``
+``eplb_state.py:210``, ``rearrange_expert_weights_inplace``
+``rebalance_execute.py``, policies under ``eplb/policy/``). The TPU
+formulation: expert weights live as stacked ``[L, E, ...]`` arrays whose
+expert axis is sharded over the EP mesh axis, so "moving" an expert is a
+permutation of that axis (XLA reshards via ICI collectives on the next
+``device_put``); routing stays in LOGICAL expert ids and a per-layer
+logical->physical map (a [L, E] table in the params tree) redirects the
+dispatch — the reference's physical/logical indirection, minus the NCCL
+point-to-point weight shuffle.
+
+Statistics come from the jitted step itself: MoE layers emit per-layer
+logical-expert token counts as an extra output, the runner accumulates
+them host-side, and every ``eplb_window`` steps the greedy policy packs
+experts onto EP groups by descending load (the reference's balanced
+bin-packing policy without redundant-expert replication).
+
+Scope note: with the current DENSE one-hot EP formulation every device
+computes its full expert shard regardless of routing, so rebalancing
+changes correctness-neutral layout only — the mechanism pays off once the
+ragged grouped-GEMM dispatch (megablox) runs under EP sharding, where
+per-device work is proportional to the tokens routed to its experts.
+This module is that seam: statistics, policy, and the weight/router
+remap are in place and tested for exactness.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from vllm_tpu.logger import init_logger
+
+logger = init_logger(__name__)
+
+
+def balanced_assignment(loads: np.ndarray, num_groups: int) -> np.ndarray:
+    """Pack E experts into ``num_groups`` equal-size groups, balancing
+    summed load. Returns ``phys_to_logical`` [E]: physical slot p (group
+    p // (E/num_groups)) holds logical expert phys_to_logical[p].
+    """
+    e = len(loads)
+    assert e % num_groups == 0
+    per = e // num_groups
+    order = np.argsort(-loads, kind="stable")  # hot experts first
+    group_load = np.zeros(num_groups)
+    group_members: list[list[int]] = [[] for _ in range(num_groups)]
+    for expert in order:
+        # Least-loaded group with a free slot.
+        candidates = [
+            g for g in range(num_groups) if len(group_members[g]) < per
+        ]
+        g = min(candidates, key=lambda g: group_load[g])
+        group_members[g].append(int(expert))
+        group_load[g] += loads[expert]
+    return np.concatenate([np.asarray(m, np.int32) for m in group_members])
+
+
+class EplbState:
+    """Host-side load accumulator + rebalance policy."""
+
+    def __init__(self, num_layers: int, num_experts: int, ep_size: int,
+                 window: int = 32) -> None:
+        self.counts = np.zeros((num_layers, num_experts), np.int64)
+        self.ep_size = ep_size
+        self.window = window
+        self.steps = 0
+        self.num_rebalances = 0
+
+    def update(self, step_counts: np.ndarray) -> None:
+        self.counts += step_counts.astype(np.int64)
+        self.steps += 1
+
+    @property
+    def due(self) -> bool:
+        return self.window > 0 and self.steps >= self.window
+
+    def make_perms(self) -> np.ndarray:
+        """Per-layer physical->logical expert maps [L, E]; resets the
+        accumulation window."""
+        perms = np.stack([
+            balanced_assignment(self.counts[layer], self.ep_size)
+            for layer in range(self.counts.shape[0])
+        ])
+        self.num_rebalances += 1
+        # Achieved (post-balance) imbalance of the NEW assignment — the
+        # quantity that is layout-independent and meaningful after any
+        # number of prior rebalances.
+        rows = np.arange(self.counts.shape[0])[:, None]
+        group_loads = self.counts[rows, perms].reshape(
+            self.counts.shape[0], self.ep_size, -1
+        ).sum(-1)
+        post = group_loads.max(-1) / np.maximum(
+            self.counts.sum(-1) / self.ep_size, 1
+        )
+        logger.info(
+            "EPLB rebalance #%d: max group load %.2fx mean after balancing",
+            self.num_rebalances, float(post.mean()),
+        )
+        self.counts[:] = 0
+        self.steps = 0
+        return perms
+
+
+def invert_perms(phys_to_logical: np.ndarray) -> np.ndarray:
+    """[L, E] physical->logical -> logical->physical."""
+    l, e = phys_to_logical.shape
+    inv = np.empty_like(phys_to_logical)
+    rows = np.arange(l)[:, None]
+    inv[rows, phys_to_logical] = np.arange(e, dtype=phys_to_logical.dtype)
+    return inv
+
+
+def permute_expert_weights(layers: dict, phys_to_logical: np.ndarray) -> dict:
+    """Reorder the expert axis of the stacked expert weights so physical
+    slot p holds logical expert phys_to_logical[l, p] (XLA reshards over
+    the EP axis on placement)."""
+    import jax.numpy as jnp
+
+    out = dict(layers)
+    idx = jnp.asarray(phys_to_logical)  # [L, E]
+    for key in ("we_gate", "we_up", "we_down"):
+        w = layers[key]  # [L, E, ...]
+        out[key] = jnp.take_along_axis(
+            w, idx.reshape(idx.shape + (1,) * (w.ndim - 2)), axis=1
+        )
+    return out

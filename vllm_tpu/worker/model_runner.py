@@ -53,6 +53,7 @@ class StepHandle:
         self.nan_count = None  # device scalar when VLLM_TPU_NAN_CHECK
         self.prompt_lp = None  # (vals, ids, tok_lp, rank) over [T]
         self.prompt_rows = None  # [(row_i, offset, start, n, prompt_len)]
+        self.moe_counts = None  # [L, E] expert token counts (EPLB)
 
 
 def _bucket(value: int, buckets: list[int]) -> int:
@@ -175,6 +176,47 @@ class ModelRunner:
             self.draft_params = draft_params
             if spec.method == "draft_model":
                 self._in_jit_drafts = self._draft_lm_drafts
+
+        # EPLB: logical->physical expert indirection + load accumulator.
+        self._eplb = getattr(model, "enable_eplb", False)
+        self.eplb_state = None
+        if self._eplb:
+            from vllm_tpu.parallel.eplb import EplbState
+
+            pc = config.parallel_config
+            groups = pc.eplb_num_groups or (
+                pc.tensor_parallel_size
+                if pc.enable_expert_parallel
+                else max(pc.expert_parallel_size, 1)
+            )
+            if model.num_experts % groups:
+                raise ValueError(
+                    f"eplb groups ({groups}) must divide num_experts "
+                    f"({model.num_experts})"
+                )
+            window = pc.eplb_window
+            if groups == 1:
+                # One group = nothing to balance: keep the statistics
+                # (metrics) but never pay the weight shuffle.
+                logger.warning(
+                    "EPLB enabled with a single expert group; collecting "
+                    "load stats only (no rebalancing)"
+                )
+                window = 0
+            self.eplb_state = EplbState(
+                model.num_layers, model.num_experts, groups,
+                window=window,
+            )
+            if "eplb_l2p" not in self.params["layers"]:
+                # Checkpoint loads have no map leaf (dummy init does).
+                ident = jnp.tile(
+                    jnp.arange(model.num_experts, dtype=jnp.int32),
+                    (model.num_layers, 1),
+                )
+                self.params = {
+                    **self.params,
+                    "layers": {**self.params["layers"], "eplb_l2p": ident},
+                }
 
         self.kv_connector = None
         self._kv_load_fn = jax.jit(
@@ -394,10 +436,15 @@ class ModelRunner:
             if mm_embeds is not None
             else {}
         )
-        hidden, kv_cache = self.model.apply(
+        moe_counts = None
+        out = self.model.apply(
             params, kv_cache, token_ids, md, token_lora_slot=token_lora,
             **mm_kw,
         )
+        if self._eplb:
+            hidden, kv_cache, moe_counts = out  # counts [L, E]
+        else:
+            hidden, kv_cache = out
         if num_spec > 0:
             # Spec-decode verification: logits at every draft position plus
             # the bonus position, rejection-sampled in one traced pass.
@@ -437,7 +484,7 @@ class ModelRunner:
                     params["medusa"], hidden[anchor]
                 )
             return (kv_cache, draft_kv, (out_tokens, num_out), None, drafts,
-                    None, spec_nan, None)
+                    None, spec_nan, None, moe_counts)
         last = hidden[md.logits_indices]  # [R, D]
         nan_count = None
         pooled = None
@@ -530,9 +577,14 @@ class ModelRunner:
             for k in range(1, num_decode_steps):
                 # Position of the token sampled last iteration.
                 md_k = self._single_pos_metadata(md, pos0 + k, r_pad)
-                hidden_k, kv_cache = self.model.apply(
+                out_k = self.model.apply(
                     params, kv_cache, tok, md_k, token_lora_slot=row_lora
                 )
+                if self._eplb:
+                    hidden_k, kv_cache, counts_k = out_k
+                    moe_counts = moe_counts + counts_k
+                else:
+                    hidden_k, kv_cache = out_k
                 logits_k = self.model.compute_logits(params, hidden_k)
                 sampling_k = _dreplace(
                     sampling,
@@ -573,7 +625,7 @@ class ModelRunner:
         else:
             lp = None
         return (kv_cache, draft_kv, sampled, lp, drafts, pooled, nan_count,
-                prompt_lp)
+                prompt_lp, moe_counts)
 
     def _eagle_drafts(self, params, draft_kv, token_ids, hidden, md,
                       anchor, emitted, draft_next, r_pad):
@@ -626,6 +678,37 @@ class ModelRunner:
             ).astype(jnp.int32)
             drafts.append(d_tok)
         return jnp.stack(drafts, axis=1), draft_kv
+
+    def _rebalance_experts(self) -> None:
+        """Re-pack experts onto EP groups by accumulated load (reference:
+        ``rearrange_expert_weights_inplace`` + router remap). Runs between
+        steps; in-flight async steps keep their internally consistent old
+        (weights, map) pair."""
+        from vllm_tpu.parallel.eplb import (
+            invert_perms,
+            permute_expert_weights,
+        )
+
+        perms = self.eplb_state.make_perms()  # [L, E] phys -> logical
+        old_layers = self.params["layers"]
+        # The weights currently sit in the PREVIOUS physical layout:
+        # compose the new logical target through the current l2p map so
+        # new slot p = logical[perms[p]] regardless of prior rebalances.
+        cur_l2p = np.asarray(jax.device_get(old_layers["eplb_l2p"]))
+        rows = np.arange(perms.shape[0])[:, None]
+        take_idx = cur_l2p[rows, perms].astype(np.int32)
+        new_layers = permute_expert_weights(old_layers, take_idx)
+        new_layers["eplb_l2p"] = jnp.asarray(invert_perms(perms))
+        if self.mesh is not None:
+            # Keep the EP/TP shardings after the permutation gather.
+            from jax.sharding import NamedSharding
+
+            specs = self.model.param_shardings()["layers"]
+            for key in ("we_gate", "we_up", "we_down"):
+                new_layers[key] = jax.device_put(
+                    new_layers[key], NamedSharding(self.mesh, specs[key])
+                )
+        self.params = {**self.params, "layers": new_layers}
 
     def _draft_lm_drafts(self, params, draft_kv, token_ids, hidden, md,
                          anchor, emitted, draft_next, r_pad):
@@ -1265,7 +1348,7 @@ class ModelRunner:
             else {}
         )
         (self.kv_cache, self.draft_kv, sampled, lp, drafts, pooled,
-         nan_count, prompt_lp) = self._step_fn(
+         nan_count, prompt_lp, moe_counts) = self._step_fn(
             self.params, self.kv_cache, self.draft_kv, *arrays, prev,
             mask_table, **mm_kwargs, **flags,
         )
@@ -1308,6 +1391,7 @@ class ModelRunner:
         handle.pooled = pooled
         handle.nan_count = nan_count
         handle.prompt_lp = prompt_lp
+        handle.moe_counts = moe_counts
         handle.prompt_rows = (
             prompt_rows if flags["num_prompt_logprobs"] else None
         )
@@ -1351,6 +1435,12 @@ class ModelRunner:
                     "NaNs detected in step logits: %d values (reference "
                     "analog: _get_nans_in_logits)", n_nan,
                 )
+        if handle.moe_counts is not None and self.eplb_state is not None:
+            self.eplb_state.update(
+                np.asarray(jax.device_get(handle.moe_counts))
+            )
+            if self.eplb_state.due:
+                self._rebalance_experts()
 
         out = ModelRunnerOutput(req_ids=req_order)
         if handle.prompt_lp is not None and handle.prompt_rows:
@@ -1562,6 +1652,12 @@ class ModelRunner:
                 "level-2 sleep requires reload params"
             )
             self.params = self._put_params(self._host_params)
+        if self._eplb and "eplb_l2p" not in self.params["layers"]:
+            # Level-2 wake reloaded logical-order weights: identity map.
+            self.params["layers"]["eplb_l2p"] = jnp.tile(
+                jnp.arange(self.model.num_experts, dtype=jnp.int32),
+                (self.model.num_layers, 1),
+            )
         if self.medusa is not None and "medusa" not in self.params:
             # Level-2 wake reloads the target checkpoint, which has no
             # draft heads: reload them from their own source.
@@ -1656,6 +1752,15 @@ class ModelRunner:
             # Draft heads are not part of the target checkpoint.
             new["medusa"] = old["medusa"]
             carried = True
+        if self._eplb:
+            # Fresh checkpoints arrive in LOGICAL expert order: reset the
+            # indirection to identity (and the load window with it).
+            new["layers"]["eplb_l2p"] = jnp.tile(
+                jnp.arange(self.model.num_experts, dtype=jnp.int32),
+                (self.model.num_layers, 1),
+            )
+            self.eplb_state.counts[:] = 0
+            self.eplb_state.steps = 0
         self.params = new
         kept = (
             {id(leaf) for leaf in jax.tree_util.tree_leaves(new)}

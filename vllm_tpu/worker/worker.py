@@ -118,6 +118,13 @@ class Worker:
             hf_config, dtype=mc.jax_dtype, quantization=mc.quantization
         )
         pc = self.config.parallel_config
+        if pc.enable_eplb:
+            if not getattr(self.model, "supports_eplb", False):
+                raise ValueError(
+                    f"{type(self.model).__name__} does not support EPLB "
+                    "(MoE models with stacked expert weights only)"
+                )
+            self.model.enable_eplb = True
         if pc.pipeline_parallel_size > 1:
             from vllm_tpu.models.llama import LlamaForCausalLM
 
