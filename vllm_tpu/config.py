@@ -196,6 +196,12 @@ class SchedulerConfig:
     # Multimodal encoder-output cache budget in encoder tokens (reference:
     # EncoderCacheManager / max_num_encoder_input_tokens).
     encoder_cache_budget: int = 4096
+    # Cascade (shared-prefix) attention: compute the common-prefix part of
+    # attention once per step and LSE-merge with per-request suffixes
+    # (reference: gpu_model_runner.py cascade path). Off by default: the
+    # cascade path is the XLA formulation, which can lose to the Pallas
+    # flash kernel unless the shared prefix dominates the context.
+    enable_cascade_attention: bool = False
     policy: Literal["fcfs", "priority"] = "fcfs"
 
     def __post_init__(self) -> None:

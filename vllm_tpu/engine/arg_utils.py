@@ -53,6 +53,7 @@ class EngineArgs:
     async_scheduling: bool = True
     num_decode_steps: int = 1
     encoder_cache_budget: int = 4096
+    enable_cascade_attention: bool = False
 
     tensor_parallel_size: int = 1
     data_parallel_size: int = 1
@@ -130,6 +131,7 @@ class EngineArgs:
                 async_scheduling=self.async_scheduling,
                 num_decode_steps=self.num_decode_steps,
                 encoder_cache_budget=self.encoder_cache_budget,
+                enable_cascade_attention=self.enable_cascade_attention,
             ),
             device_config=DeviceConfig(device=self.device),  # type: ignore[arg-type]
             speculative_config=SpeculativeConfig(

@@ -64,9 +64,10 @@ class MixtralForCausalLM(LlamaForCausalLM):
             # Identity logical->physical map (must exist in the dummy tree
             # too: the shardings tree includes it, and a meshed dummy init
             # tree_maps the two together).
-            params["layers"]["eplb_l2p"] = jnp.tile(
-                jnp.arange(self.num_experts, dtype=jnp.int32),
-                (self.num_layers, 1),
+            from vllm_tpu.parallel.eplb import identity_l2p
+
+            params["layers"]["eplb_l2p"] = identity_l2p(
+                self.num_layers, self.num_experts
             )
         layers = params["layers"]
         for name in ("wgate", "wup", "wdown"):

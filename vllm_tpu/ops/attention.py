@@ -27,7 +27,7 @@ per block-table entry.
 
 from __future__ import annotations
 
-from dataclasses import dataclass
+from dataclasses import dataclass, field
 
 import jax
 import jax.numpy as jnp
@@ -52,6 +52,15 @@ class AttentionMetadata:
     # beyond the live request count point at 0 and are masked downstream).
     logits_indices: jnp.ndarray
     num_seqs: jnp.ndarray  # [1] i32, live (unpadded) request count
+    # Cascade attention (reference: ``gpu_model_runner.py:2367`` +
+    # ``merge_attn_states.cu``): when every live request shares this many
+    # leading block-table entries, attention over that common prefix is
+    # computed once (no per-token KV duplication) and LSE-merged with the
+    # per-request suffix. STATIC (part of the jit signature; the runner
+    # buckets it to bound trace count).
+    num_common_prefix_blocks: int = field(
+        default=0, metadata=dict(static=True)
+    )
 
 
 def packed_kv_layout(head_dim: int) -> bool:
@@ -120,6 +129,13 @@ def paged_attention(
     elsewhere (and under VLLM_TPU_DISABLE_PALLAS)."""
     import vllm_tpu.envs as envs
 
+    if md.num_common_prefix_blocks > 0:
+        # Shared-prefix decode: XLA cascade formulation (a cascade-aware
+        # Pallas kernel is the optimization seam).
+        return cascade_ref_attention(
+            q, kv_cache, layer, md, scale, sliding_window=sliding_window,
+            soft_cap=soft_cap, k_scale=k_scale, v_scale=v_scale,
+        )
     kernel_ok = q.shape[-1] in (64, 128, 256)
     if not envs.VLLM_TPU_DISABLE_PALLAS and kernel_ok and _on_tpu():
         from vllm_tpu.ops.rpa_kernel import ragged_paged_attention
@@ -222,3 +238,81 @@ def ref_ragged_paged_attention(
         return out
     lse = jax.scipy.special.logsumexp(scores, axis=-1)  # [T, KH, G]
     return out, lse.reshape(t, h)
+
+
+def cascade_ref_attention(
+    q: jnp.ndarray,  # [T, H, D]
+    kv_cache: jnp.ndarray,
+    layer: jnp.ndarray,
+    md: AttentionMetadata,  # num_common_prefix_blocks > 0
+    scale: float,
+    *,
+    sliding_window=None,
+    soft_cap: float | None = None,
+    k_scale: float | None = None,
+    v_scale: float | None = None,
+) -> jnp.ndarray:
+    """Shared-prefix (cascade) attention: every live request's first
+    ``num_common_prefix_blocks`` block-table entries are identical, so the
+    prefix KV is gathered ONCE (no [T, C] per-token duplication), attended
+    by the whole batch, and LSE-merged with the per-request suffix
+    attention (reference: ``gpu_model_runner.py:2367`` cascade path +
+    ``csrc/attention/merge_attn_states.cu``)."""
+    from vllm_tpu.ops.cp_attention import merge_attn_states
+
+    ncb = md.num_common_prefix_blocks
+    t, h, d = q.shape
+    nl, nb, bs, rows, lanes = kv_cache.shape
+    packed = packed_kv_layout(d)
+    kh = rows if packed else rows // 2
+    groups = h // kh
+
+    # ---- common prefix: one shared gather ----
+    pages_c = kv_cache[layer, md.block_tables[0, :ncb]]
+    cp = ncb * bs
+    kv_c = pages_c.reshape(cp, rows, lanes)
+    if packed:
+        k_c, v_c = kv_c[:, :, :d], kv_c[:, :, d:]
+    else:
+        k_c, v_c = kv_c[:, 0::2], kv_c[:, 1::2]
+    k_c = k_c.astype(jnp.float32)
+    v_c = v_c.astype(jnp.float32)
+    if k_scale is not None:
+        k_c = k_c * k_scale
+    if v_scale is not None:
+        v_c = v_c * v_scale
+
+    qg = q.reshape(t, kh, groups, d).astype(jnp.float32)
+    scores = jnp.einsum("tkgd,ckd->tkgc", qg, k_c) * scale
+    if soft_cap is not None:
+        scores = soft_cap * jnp.tanh(scores / soft_cap)
+    ctx_pos = jnp.arange(cp, dtype=jnp.int32)[None, :]
+    causal = ctx_pos <= md.positions[:, None]
+    if sliding_window is not None:
+        win = jnp.asarray(sliding_window, jnp.int32)
+        causal &= (ctx_pos > (md.positions[:, None] - win)) | (win <= 0)
+    scores = jnp.where(causal[:, None, None, :], scores, -jnp.inf)
+    probs = jax.nn.softmax(scores, axis=-1)
+    probs = jnp.where(jnp.isnan(probs), 0.0, probs)
+    out_c = jnp.einsum("tkgc,ckd->tkgd", probs, v_c).reshape(t, h, d)
+    lse_c = jax.scipy.special.logsumexp(scores, axis=-1).reshape(t, h)
+
+    # ---- per-request suffix: the plain ragged path over the remaining
+    # blocks, with context positions offset past the prefix ----
+    import dataclasses as _dc
+
+    md_suffix = _dc.replace(
+        md,
+        block_tables=md.block_tables[:, ncb:],
+        num_common_prefix_blocks=0,
+    )
+    out_s, lse_s = ref_ragged_paged_attention(
+        q, kv_cache, layer, md_suffix, scale,
+        sliding_window=sliding_window, soft_cap=soft_cap,
+        k_scale=k_scale, v_scale=v_scale, return_lse=True,
+        ctx_phase=ncb,
+    )
+    return merge_attn_states(
+        jnp.stack([out_c.astype(jnp.float32), out_s.astype(jnp.float32)]),
+        jnp.stack([lse_c, lse_s]),
+    ).astype(q.dtype)

@@ -103,6 +103,26 @@ class EplbState:
         return perms
 
 
+def identity_l2p(num_layers: int, num_experts: int):
+    """Identity logical->physical map [L, E] (the initial layout, and the
+    reset target after weight reloads)."""
+    import jax.numpy as jnp
+
+    return jnp.tile(
+        jnp.arange(num_experts, dtype=jnp.int32), (num_layers, 1)
+    )
+
+
+def expert_weight_bytes(layers: dict) -> int:
+    """Bytes of the stacked expert weights — the transient extra HBM a
+    rebalance needs while in-flight steps still hold the old copy."""
+    return sum(
+        layers[k].size * layers[k].dtype.itemsize
+        for k in ("we_gate", "we_up", "we_down")
+        if k in layers
+    )
+
+
 def invert_perms(phys_to_logical: np.ndarray) -> np.ndarray:
     """[L, E] physical->logical -> logical->physical."""
     l, e = phys_to_logical.shape

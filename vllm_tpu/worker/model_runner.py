@@ -181,7 +181,7 @@ class ModelRunner:
         self._eplb = getattr(model, "enable_eplb", False)
         self.eplb_state = None
         if self._eplb:
-            from vllm_tpu.parallel.eplb import EplbState
+            from vllm_tpu.parallel.eplb import EplbState, identity_l2p
 
             pc = config.parallel_config
             groups = pc.eplb_num_groups or (
@@ -209,9 +209,8 @@ class ModelRunner:
             )
             if "eplb_l2p" not in self.params["layers"]:
                 # Checkpoint loads have no map leaf (dummy init does).
-                ident = jnp.tile(
-                    jnp.arange(model.num_experts, dtype=jnp.int32),
-                    (model.num_layers, 1),
+                ident = identity_l2p(
+                    model.num_layers, model.num_experts
                 )
                 self.params = {
                     **self.params,
@@ -263,6 +262,7 @@ class ModelRunner:
                 "num_adj",
                 "num_allow",
                 "num_decode_steps",
+                "cascade_blocks",
             ),
             donate_argnums=(1, 2) if self.draft_model is not None else (1,),
         )
@@ -294,7 +294,8 @@ class ModelRunner:
     # ------------------------------------------------------------------
 
     def _unpack(self, ibuf, fbuf, counts, prompt_mask, t, r, b, num_spec=0,
-                num_adj=0, num_allow=0, num_prompt_logprobs=0):
+                num_adj=0, num_allow=0, num_prompt_logprobs=0,
+                cascade_blocks=0):
         """Split the two packed host buffers back into metadata pytrees.
 
         One contiguous i32 upload + one f32 upload per step instead of ~12
@@ -321,6 +322,7 @@ class ModelRunner:
             logits_indices=take(r),
             num_seqs=take(1),
             block_tables=take(r * b).reshape(r, b),
+            num_common_prefix_blocks=cascade_blocks,
         )
         top_k = take(r)
         prng_keys = jax.lax.bitcast_convert_type(
@@ -406,11 +408,12 @@ class ModelRunner:
         num_adj: int = 0,
         num_allow: int = 0,
         num_decode_steps: int = 1,
+        cascade_blocks: int = 0,
     ):
         (token_ids, md, sampling, feedback, grammar_rows, logit_adjust,
          draft_next, token_lora, plp_next, spec) = self._unpack(
             ibuf, fbuf, counts, prompt_mask, t_pad, r_pad, b_pad, num_spec,
-            num_adj, num_allow, num_prompt_logprobs,
+            num_adj, num_allow, num_prompt_logprobs, cascade_blocks,
         )
         # Device-side token feedback (async scheduling): a decode row whose
         # input token was sampled by the still-in-flight previous step reads
@@ -1120,8 +1123,25 @@ class ModelRunner:
         # Masking flags only consider sampling rows: greedy rows take a raw
         # argmax, so an all-greedy batch (the throughput-bench shape) skips
         # every [R, V] sort and the Gumbel draw (static trace selection).
+        # Cascade attention: longest block-table prefix shared by EVERY
+        # live row, bucketed to powers of two (static jit arg). Worth it
+        # only with several requests and >= 2 shared blocks.
+        cascade_blocks = 0
+        if (
+            self.config.scheduler_config.enable_cascade_attention
+            and not s
+            and r_live >= 2
+        ):
+            tables = batch.block_table[rows]  # [r_live, max_b]
+            min_blocks = int(batch.num_blocks[rows].min())
+            same = (tables[:, : min_blocks] == tables[0, : min_blocks]).all(0)
+            ncb = int(np.argmin(same)) if not same.all() else min_blocks
+            ncb = min(ncb, min_blocks - 1)  # keep >= 1 suffix block
+            if ncb >= 2:
+                cascade_blocks = 1 << (ncb.bit_length() - 1)  # floor pow2
         nongreedy = temperature[:r_live] > 0.0
         flags = dict(
+            cascade_blocks=cascade_blocks,
             needs_penalties=needs_penalties,
             needs_top_k=bool(np.any(top_k[:r_live][nongreedy] > 0)),
             needs_top_p_min_p=bool(
@@ -1654,9 +1674,9 @@ class ModelRunner:
             self.params = self._put_params(self._host_params)
         if self._eplb and "eplb_l2p" not in self.params["layers"]:
             # Level-2 wake reloaded logical-order weights: identity map.
-            self.params["layers"]["eplb_l2p"] = jnp.tile(
-                jnp.arange(self.model.num_experts, dtype=jnp.int32),
-                (self.model.num_layers, 1),
+            from vllm_tpu.parallel.eplb import identity_l2p
+            self.params["layers"]["eplb_l2p"] = identity_l2p(
+                self.model.num_layers, self.model.num_experts
             )
         if self.medusa is not None and "medusa" not in self.params:
             # Level-2 wake reloads the target checkpoint, which has no
@@ -1755,9 +1775,9 @@ class ModelRunner:
         if self._eplb:
             # Fresh checkpoints arrive in LOGICAL expert order: reset the
             # indirection to identity (and the load window with it).
-            new["layers"]["eplb_l2p"] = jnp.tile(
-                jnp.arange(self.model.num_experts, dtype=jnp.int32),
-                (self.model.num_layers, 1),
+            from vllm_tpu.parallel.eplb import identity_l2p
+            new["layers"]["eplb_l2p"] = identity_l2p(
+                self.model.num_layers, self.model.num_experts
             )
             self.eplb_state.counts[:] = 0
             self.eplb_state.steps = 0

@@ -294,6 +294,23 @@ class Worker:
                 "%.2f GiB weights on chip",
                 self.device.device_kind, hbm / 2**30, in_use / 2**30,
             )
+        if self.config.parallel_config.enable_eplb:
+            # Online rebalancing transiently holds BOTH expert-weight
+            # copies (in-flight steps pin the old one): reserve that
+            # headroom so the first mid-serving rebalance cannot OOM.
+            from vllm_tpu.parallel.eplb import expert_weight_bytes
+
+            reserve = expert_weight_bytes(
+                self.params.get("layers", {})
+                if isinstance(self.params, dict)
+                else {}
+            )
+            if reserve:
+                logger.info(
+                    "EPLB: reserving %.2f GiB for rebalance double-"
+                    "residency", reserve / 2**30,
+                )
+                in_use += reserve
         free_for_kv = (limit - in_use) * (1 - _ACTIVATION_HEADROOM)
         if free_for_kv <= 0:
             raise RuntimeError(
