@@ -20,6 +20,9 @@ if "xla_force_host_platform_device_count" not in _flags:
 os.environ["JAX_PLATFORMS"] = "cpu"
 # Pallas kernels run in interpret mode on CPU.
 os.environ.setdefault("VLLM_TPU_PALLAS_INTERPRET", "1")
+# Tests must not append to the real ~/.config usage log (the telemetry
+# test overrides the path explicitly).
+os.environ.setdefault("VLLM_TPU_NO_USAGE_STATS", "1")
 
 import jax  # noqa: E402
 

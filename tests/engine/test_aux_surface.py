@@ -184,3 +184,68 @@ def test_nan_check_flag(ckpt, monkeypatch):
         assert len(outs[0].outputs[0].token_ids) == 4
     finally:
         envs.refresh()
+
+
+def test_serve_bench_qps_sweep(tmp_path):
+    """vllm-tpu bench serve --qps-sweep: one engine, per-QPS stats."""
+    import argparse
+    import json
+
+    from tests.models.utils import tiny_llama_dir
+    from vllm_tpu.benchmarks.run import run_bench
+
+    path = tiny_llama_dir(tmp_path / "ck")
+    out = str(tmp_path / "sweep.json")
+    args = argparse.Namespace(
+        mode="serve", model=path, dtype="float32", max_model_len=128,
+        block_size=16, num_gpu_blocks_override=64, max_num_seqs=8,
+        max_num_batched_tokens=128, num_prompts=4, input_len=8,
+        output_len=4, batch_size=2, qps=0.0, qps_sweep="50,0",
+        json_out=out,
+    )
+    result = run_bench(args)
+    assert result["mode"] == "serve_sweep"
+    assert [p["qps"] for p in result["points"]] == [50.0, 0.0]
+    for p in result["points"]:
+        assert p["ttft_p50_s"] is not None
+        assert p["request_throughput"] > 0
+    assert json.load(open(out))["mode"] == "serve_sweep"
+
+
+def test_usage_telemetry_opt_out(tmp_path, monkeypatch):
+    import json
+    import os
+
+    from vllm_tpu.engine.arg_utils import EngineArgs
+    from vllm_tpu.usage import record_usage
+
+    from tests.models.utils import tiny_llama_config
+
+    config = EngineArgs(
+        model="dummy", load_format="dummy",
+        hf_config=tiny_llama_config(architectures=["LlamaForCausalLM"]),
+        dtype="float32",
+    ).create_engine_config()
+    stats = tmp_path / "usage.jsonl"
+    monkeypatch.setenv("VLLM_TPU_USAGE_STATS_PATH", str(stats))
+    # conftest opts the whole suite out; opt back in for this test.
+    monkeypatch.setenv("VLLM_TPU_NO_USAGE_STATS", "0")
+    from vllm_tpu import envs as _envs0
+
+    _envs0._cache.pop("VLLM_TPU_NO_USAGE_STATS", None)
+    record_usage(config, context="test")
+    entry = json.loads(stats.read_text().strip())
+    assert entry["architectures"] == ["LlamaForCausalLM"]
+    assert entry["context"] == "test"
+    assert "model" not in entry  # no paths/names recorded
+
+    os.unlink(stats)
+    monkeypatch.setenv("VLLM_TPU_NO_USAGE_STATS", "1")
+    # envs are cached; clear so the opt-out is visible.
+    from vllm_tpu import envs as _envs
+
+    _envs._cache.pop("VLLM_TPU_NO_USAGE_STATS", None)
+    record_usage(config, context="test")
+    assert not stats.exists()
+    monkeypatch.delenv("VLLM_TPU_NO_USAGE_STATS")
+    _envs._cache.pop("VLLM_TPU_NO_USAGE_STATS", None)

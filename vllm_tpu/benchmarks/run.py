@@ -91,7 +91,13 @@ def run_bench(args) -> dict:
 
 
 def _run_serve(args, params) -> dict:
-    """Poisson-arrival serving benchmark against an in-proc AsyncLLM."""
+    """Poisson-arrival serving benchmark against an in-proc AsyncLLM.
+
+    ``--qps-sweep "1,4,16,0"`` runs the reference's QPS grid (0 = inf,
+    i.e. all requests at t=0) against ONE engine and emits a combined
+    table — the ``vllm bench serve`` sweep protocol
+    (performance-benchmarks-descriptions.md:25-37).
+    """
     from vllm_tpu.engine.arg_utils import AsyncEngineArgs
     from vllm_tpu.engine.async_llm import AsyncLLM
 
@@ -105,7 +111,33 @@ def _run_serve(args, params) -> dict:
 
     params = replace(params, output_kind=RequestOutputKind.DELTA)
     engine = AsyncLLM.from_engine_args(engine_args)
-    prompts = _prompts(args.num_prompts, args.input_len)
+    try:
+        sweep = getattr(args, "qps_sweep", None)
+        if sweep:
+            points = [float(x) for x in str(sweep).split(",") if x != ""]
+            # Warmup: absorb first-bucket jit compiles so point 1 is
+            # comparable, then reset the prefix cache between points —
+            # the prompts are identical across points, and warm-cache
+            # prefills would otherwise inflate every point after the
+            # first.
+            _serve_one(engine, args, params, qps=0.0, warmup=True)
+            results = []
+            for qps in points:
+                engine.engine_core.reset_prefix_cache()
+                results.append(_serve_one(engine, args, params, qps))
+            combined = {"mode": "serve_sweep", "points": results}
+            _emit(combined, args.json_out)
+            return combined
+        result = _serve_one(engine, args, params, args.qps)
+        _emit(result, args.json_out)
+        return result
+    finally:
+        engine.shutdown()
+
+
+def _serve_one(engine, args, params, qps: float, warmup: bool = False) -> dict:
+    n = min(4, args.num_prompts) if warmup else args.num_prompts
+    prompts = _prompts(n, args.input_len)
     rng = np.random.default_rng(0)
 
     async def one(i, prompt, start_at, stats):
@@ -127,8 +159,8 @@ def _run_serve(args, params) -> dict:
         stats: list = []
         t0 = time.monotonic()
         offsets = (
-            np.cumsum(rng.exponential(1.0 / args.qps, len(prompts)))
-            if args.qps > 0 else np.zeros(len(prompts))
+            np.cumsum(rng.exponential(1.0 / qps, len(prompts)))
+            if qps > 0 else np.zeros(len(prompts))
         )
         await asyncio.gather(*[
             one(i, p, t0 + offsets[i], stats) for i, p in enumerate(prompts)
@@ -141,7 +173,7 @@ def _run_serve(args, params) -> dict:
     e2es = [s[2] for s in stats]
     result = {
         "mode": "serve",
-        "qps": args.qps,
+        "qps": qps,
         "num_prompts": args.num_prompts,
         "elapsed_s": wall,
         "request_throughput": len(stats) / wall,
@@ -154,6 +186,4 @@ def _run_serve(args, params) -> dict:
         "itl_p99_s": float(np.percentile(itls, 99)) if itls else None,
         "e2e_p50_s": float(np.median(e2es)) if e2es else None,
     }
-    _emit(result, args.json_out)
-    engine.shutdown()
     return result

@@ -36,6 +36,10 @@ class EngineDeadError(RuntimeError):
 
 def make_client(config: EngineConfig):
     from vllm_tpu import envs
+    from vllm_tpu.usage import record_usage
+
+    # Every engine frontend (sync LLMEngine AND AsyncLLM) converges here.
+    record_usage(config, context="engine")
 
     if config.parallel_config.data_parallel_engines > 1:
         return DPLBClient(config)

@@ -73,6 +73,11 @@ def _add_bench(sub: argparse._SubParsersAction) -> None:
     p.add_argument("--output-len", type=int, default=128)
     p.add_argument("--batch-size", type=int, default=8)
     p.add_argument("--qps", type=float, default=0.0, help="serve mode request rate (0=inf)")
+    p.add_argument(
+        "--qps-sweep", default=None,
+        help='serve mode QPS grid, e.g. "1,4,16,0" (0=inf); one engine, '
+             "one combined result (the reference's bench serve sweep)",
+    )
     p.set_defaults(func=_run_bench)
 
 

@@ -54,6 +54,8 @@ _readers: dict[str, Callable[[], Any]] = {
     # Count NaNs in the step logits and log an error when any appear
     # (reference: _get_nans_in_logits, gpu_model_runner.py:5193).
     "VLLM_TPU_NAN_CHECK": _bool("VLLM_TPU_NAN_CHECK", False),
+    # Opt-out local usage telemetry (reference: VLLM_NO_USAGE_STATS).
+    "VLLM_TPU_NO_USAGE_STATS": _bool("VLLM_TPU_NO_USAGE_STATS", False),
     # Disable the C++ host-prep fast path (pure-python fallback).
     "VLLM_TPU_DISABLE_NATIVE_PREP": _bool("VLLM_TPU_DISABLE_NATIVE_PREP", False),
     # API server

@@ -113,16 +113,6 @@ def identity_l2p(num_layers: int, num_experts: int):
     )
 
 
-def expert_weight_bytes(layers: dict) -> int:
-    """Bytes of the stacked expert weights — the transient extra HBM a
-    rebalance needs while in-flight steps still hold the old copy."""
-    return sum(
-        layers[k].size * layers[k].dtype.itemsize
-        for k in ("we_gate", "we_up", "we_down")
-        if k in layers
-    )
-
-
 def invert_perms(phys_to_logical: np.ndarray) -> np.ndarray:
     """[L, E] physical->logical -> logical->physical."""
     l, e = phys_to_logical.shape

@@ -1,0 +1,52 @@
+"""Opt-out usage telemetry.
+
+Reference analog: ``vllm/usage/`` (UsageMessage). This environment has no
+egress, so the record lands in a local JSONL
+(``~/.config/vllm_tpu/usage_stats.jsonl``) — the transport seam is the
+only thing that changes for a hosted collector. Disable with
+``VLLM_TPU_NO_USAGE_STATS=1``. Nothing identifying is recorded: model
+ARCHITECTURE (not path), dtype, parallel topology, device kind.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+
+from vllm_tpu.logger import init_logger
+
+logger = init_logger(__name__)
+
+_DEFAULT_PATH = os.path.join(
+    os.path.expanduser("~"), ".config", "vllm_tpu", "usage_stats.jsonl"
+)
+
+
+def record_usage(config, context: str = "engine") -> None:
+    """Best-effort, never raises, no-op when opted out."""
+    from vllm_tpu import envs
+
+    if envs.VLLM_TPU_NO_USAGE_STATS:
+        return
+    try:
+        hf = getattr(config.model_config, "hf_config", None)
+        archs = list(getattr(hf, "architectures", None) or []) if hf else []
+        pc = config.parallel_config
+        entry = {
+            "ts": time.time(),
+            "context": context,
+            "architectures": archs,
+            "dtype": str(config.model_config.dtype),
+            "tp": pc.tensor_parallel_size,
+            "pp": pc.pipeline_parallel_size,
+            "dp_engines": pc.data_parallel_engines,
+            "spec_method": config.speculative_config.method,
+            "quantization": config.model_config.quantization,
+        }
+        path = os.environ.get("VLLM_TPU_USAGE_STATS_PATH", _DEFAULT_PATH)
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        with open(path, "a") as f:
+            f.write(json.dumps(entry) + "\n")
+    except Exception as e:  # telemetry must never break serving
+        logger.debug("usage record skipped: %s", e)

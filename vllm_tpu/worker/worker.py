@@ -298,13 +298,19 @@ class Worker:
             # Online rebalancing transiently holds BOTH expert-weight
             # copies (in-flight steps pin the old one): reserve that
             # headroom so the first mid-serving rebalance cannot OOM.
-            from vllm_tpu.parallel.eplb import expert_weight_bytes
-
-            reserve = expert_weight_bytes(
+            # PER-DEVICE bytes (the budget is per device; global stacked
+            # sizes would over-reserve by the TP/EP shard factor).
+            layers = (
                 self.params.get("layers", {})
                 if isinstance(self.params, dict)
                 else {}
             )
+            expert_tree = {
+                k: layers[k]
+                for k in ("we_gate", "we_up", "we_down")
+                if k in layers
+            }
+            reserve = _per_device_param_bytes(expert_tree, self.device)
             if reserve:
                 logger.info(
                     "EPLB: reserving %.2f GiB for rebalance double-"
