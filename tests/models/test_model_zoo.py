@@ -165,3 +165,53 @@ def test_greedy_e2e_matches_hf(name, tmp_path_factory):
         SamplingParams(temperature=0.0, max_tokens=n_steps, ignore_eos=True),
     )
     assert outs[0].outputs[0].token_ids == hf_tokens[len(prompt):]
+
+
+def test_qwen2_moe_e2e_greedy_matches_hf(tmp_path):
+    """Qwen2-MoE: qkv bias + sigmoid-gated shared expert."""
+    import torch
+    from transformers import Qwen2MoeConfig, Qwen2MoeForCausalLM
+
+    from vllm_tpu import LLM, SamplingParams
+
+    cfg = Qwen2MoeConfig(
+        vocab_size=128,
+        hidden_size=64,
+        intermediate_size=96,
+        moe_intermediate_size=48,
+        shared_expert_intermediate_size=80,
+        num_hidden_layers=2,
+        num_attention_heads=4,
+        num_key_value_heads=2,
+        num_experts=4,
+        num_experts_per_tok=2,
+        decoder_sparse_step=1,
+        norm_topk_prob=False,
+        max_position_embeddings=256,
+        tie_word_embeddings=False,
+    )
+    torch.manual_seed(0)
+    path = str(tmp_path / "qwen2moe")
+    Qwen2MoeForCausalLM(cfg).to(torch.float32).save_pretrained(
+        path, safe_serialization=True
+    )
+    llm = LLM(
+        model=path, dtype="float32", max_model_len=128, block_size=16,
+        num_gpu_blocks_override=64, max_num_seqs=4,
+        max_num_batched_tokens=128,
+    )
+    import numpy as np
+
+    rng = np.random.default_rng(7)
+    prompt = rng.integers(5, 120, size=9).tolist()
+    [out] = llm.generate(
+        [{"prompt_token_ids": prompt}],
+        SamplingParams(temperature=0.0, max_tokens=6, ignore_eos=True),
+    )
+    hf = Qwen2MoeForCausalLM.from_pretrained(path, torch_dtype=torch.float32)
+    hf.eval()
+    with torch.no_grad():
+        ref = hf.generate(
+            torch.tensor([prompt]), max_new_tokens=6, do_sample=False
+        )[0][len(prompt):].tolist()
+    assert out.outputs[0].token_ids == ref

@@ -123,7 +123,11 @@ def _run_serve(args, params) -> dict:
             _serve_one(engine, args, params, qps=0.0, warmup=True)
             results = []
             for qps in points:
-                engine.engine_core.reset_prefix_cache()
+                if not engine.engine_core.reset_prefix_cache():
+                    print(
+                        f"WARNING: prefix-cache reset failed before "
+                        f"qps={qps}; point may be warm-cache inflated"
+                    )
                 results.append(_serve_one(engine, args, params, qps))
             combined = {"mode": "serve_sweep", "points": results}
             _emit(combined, args.json_out)

@@ -99,6 +99,9 @@ class CacheConfig:
     # reloading evicted prefixes; seam for disaggregated prefill).
     kv_connector: str | None = None
     kv_connector_cache_gb: float = 4.0
+    # KV-cache event publishing endpoint (ZMQ PUB, e.g. tcp://*:5557) for
+    # cache-aware routers; None disables (reference: kv_events.py).
+    kv_events_endpoint: str | None = None
 
     def __post_init__(self) -> None:
         if self.block_size & (self.block_size - 1):

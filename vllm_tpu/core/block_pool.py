@@ -19,10 +19,15 @@ logger = init_logger(__name__)
 
 
 class BlockPool:
-    def __init__(self, num_blocks: int, enable_caching: bool = True) -> None:
+    def __init__(self, num_blocks: int, enable_caching: bool = True,
+                 event_sink=None, block_size: int = 16) -> None:
         assert num_blocks > 0
         self.num_blocks = num_blocks
         self.enable_caching = enable_caching
+        # KV event sink (``kv_events.KVEventPublisher.record``): block
+        # store/evict/clear notifications for cache-aware routers.
+        self.event_sink = event_sink
+        self.block_size = block_size
 
         self.blocks = [KVCacheBlock(block_id=i) for i in range(num_blocks)]
         # Block 0 is the null block: a permanent placeholder pointed at by
@@ -67,6 +72,7 @@ class BlockPool:
         """
         if not self.enable_caching:
             return
+        stored: list[bytes] = []
         for i in range(num_cached_blocks, num_full_blocks):
             block = blocks[i]
             if block.is_null:
@@ -77,6 +83,20 @@ class BlockPool:
             key = BlockHashWithGroupId(block_hashes[i], group_id)
             block.block_hash = key
             self.cached_block_hash_to_block.setdefault(key, {})[block.block_id] = block
+            stored.append(bytes(block_hashes[i]))
+        if stored and self.event_sink is not None:
+            from vllm_tpu.core.kv_events import BlockStored
+
+            parent = (
+                bytes(block_hashes[num_cached_blocks - 1])
+                if num_cached_blocks > 0
+                else None
+            )
+            self.event_sink(BlockStored(
+                block_hashes=stored,
+                parent_block_hash=parent,
+                block_size=self.block_size,
+            ))
 
     # ------------------------------------------------------------------
     # Allocation / free
@@ -109,11 +129,19 @@ class BlockPool:
         if key is None:
             return False
         entry = self.cached_block_hash_to_block.get(key)
+        removed_last = False
         if entry is not None:
             entry.pop(block.block_id, None)
             if not entry:
                 del self.cached_block_hash_to_block[key]
+                removed_last = True
         block.reset_hash()
+        if removed_last and self.event_sink is not None:
+            from vllm_tpu.core.kv_events import BlockRemoved
+
+            self.event_sink(BlockRemoved(
+                block_hashes=[bytes(key.block_hash)]
+            ))
         return True
 
     def touch(self, blocks: list[KVCacheBlock]) -> None:
@@ -148,6 +176,10 @@ class BlockPool:
         self.cached_block_hash_to_block.clear()
         for block in self.blocks:
             block.reset_hash()
+        if self.event_sink is not None:
+            from vllm_tpu.core.kv_events import AllBlocksCleared
+
+            self.event_sink(AllBlocksCleared())
         return True
 
     # Stats ------------------------------------------------------------

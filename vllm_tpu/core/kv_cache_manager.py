@@ -41,6 +41,7 @@ class KVCacheManager:
         block_size: int,
         enable_caching: bool = True,
         sliding_window: int | None = None,
+        event_sink=None,
     ) -> None:
         self.block_size = block_size
         # Sliding-window models free blocks that fall fully out of the
@@ -52,7 +53,10 @@ class KVCacheManager:
         if sliding_window is not None:
             enable_caching = False  # safety net; the worker flips the flag
         self.enable_caching = enable_caching
-        self.block_pool = BlockPool(num_blocks, enable_caching)
+        self.block_pool = BlockPool(
+            num_blocks, enable_caching,
+            event_sink=event_sink, block_size=block_size,
+        )
 
         self.req_to_blocks: dict[str, list[KVCacheBlock]] = {}
         # Sliding window: first not-yet-freed block index per request, so

@@ -1,0 +1,113 @@
+"""KV-cache event publishing for cache-aware routers.
+
+Reference analog: ``vllm/distributed/kv_events.py`` (527 LoC): external
+routers (prefix-aware load balancers, disagg-prefill placers) subscribe
+to the engine's block lifecycle — which content hashes became resident
+(BlockStored), which were evicted (BlockRemoved), and full resets
+(AllBlocksCleared) — over a ZMQ PUB socket with monotonically increasing
+sequence numbers and per-step batching.
+
+The BlockPool calls the sink synchronously (appends to a list); the
+publisher drains and PUBlishes one msgpack batch per scheduler step, so
+the hot path never blocks on the socket.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any
+
+from vllm_tpu.logger import init_logger
+
+logger = init_logger(__name__)
+
+TOPIC = b"kv-events"
+
+
+@dataclass
+class BlockStored:
+    block_hashes: list[bytes]
+    parent_block_hash: bytes | None
+    block_size: int
+
+
+@dataclass
+class BlockRemoved:
+    block_hashes: list[bytes]
+
+
+@dataclass
+class AllBlocksCleared:
+    pass
+
+
+@dataclass
+class EventBatch:
+    seq: int
+    ts: float
+    events: list[Any] = field(default_factory=list)
+
+
+def _encode_event(e) -> dict:
+    d = {"type": type(e).__name__}
+    if isinstance(e, BlockStored):
+        d |= {
+            "block_hashes": [bytes(h) for h in e.block_hashes],
+            "parent_block_hash": (
+                bytes(e.parent_block_hash) if e.parent_block_hash else None
+            ),
+            "block_size": e.block_size,
+        }
+    elif isinstance(e, BlockRemoved):
+        d |= {"block_hashes": [bytes(h) for h in e.block_hashes]}
+    return d
+
+
+class KVEventPublisher:
+    """ZMQ PUB publisher with a step-batched buffer (the BlockPool's
+    ``event_sink``)."""
+
+    def __init__(self, endpoint: str, block_size: int) -> None:
+        import zmq
+
+        self.block_size = block_size
+        self._ctx = zmq.Context(1)
+        self._pub = self._ctx.socket(zmq.PUB)
+        self._pub.bind(endpoint)
+        self._buffer: list[Any] = []
+        self._seq = 0
+        logger.info("KV events publishing on %s", endpoint)
+
+    # BlockPool sink interface ----------------------------------------
+
+    def record(self, event: Any) -> None:
+        self._buffer.append(event)
+
+    # Engine-step flush -----------------------------------------------
+
+    def flush(self) -> int:
+        """Publish buffered events as one batch; returns events sent."""
+        if not self._buffer:
+            return 0
+        events, self._buffer = self._buffer, []
+        try:  # encoding AND sending: publishing must never break serving
+            import time
+
+            import msgpack
+
+            batch = {
+                "seq": self._seq,
+                "ts": time.time(),
+                "events": [_encode_event(e) for e in events],
+            }
+            self._seq += 1
+            self._pub.send_multipart(
+                [TOPIC, msgpack.packb(batch, use_bin_type=True)]
+            )
+        except Exception as e:
+            logger.warning("KV event publish failed: %s", e)
+        return len(events)
+
+    def close(self) -> None:
+        self._pub.close(linger=0)
+        self._ctx.term()

@@ -110,11 +110,26 @@ class Scheduler:
         assert cache_config.num_gpu_blocks is not None, (
             "CacheConfig.num_gpu_blocks must be set before Scheduler init"
         )
+        # KV-cache event publishing (reference: distributed/kv_events.py):
+        # block store/evict/clear notifications for cache-aware routers,
+        # batched and PUBlished once per schedule().
+        self.kv_event_publisher = None
+        if cache_config.kv_events_endpoint:
+            from vllm_tpu.core.kv_events import KVEventPublisher
+
+            self.kv_event_publisher = KVEventPublisher(
+                cache_config.kv_events_endpoint, cache_config.block_size
+            )
         self.kv_cache_manager = KVCacheManager(
             num_blocks=cache_config.num_gpu_blocks,
             block_size=cache_config.block_size,
             enable_caching=cache_config.enable_prefix_caching,
             sliding_window=cache_config.sliding_window,
+            event_sink=(
+                self.kv_event_publisher.record
+                if self.kv_event_publisher
+                else None
+            ),
         )
         self.block_size = cache_config.block_size
         self.structured_output_manager = structured_output_manager
@@ -647,6 +662,8 @@ class Scheduler:
         self.finished_req_ids = set()
         if total > 0:
             self._last_step_req_ids = set(num_scheduled_tokens)
+        if self.kv_event_publisher is not None:
+            self.kv_event_publisher.flush()
         return output
 
     # ------------------------------------------------------------------

@@ -45,6 +45,7 @@ class EngineArgs:
     kv_cache_dtype: str = "auto"
     kv_connector: str | None = None
     kv_connector_cache_gb: float = 4.0
+    kv_events_endpoint: str | None = None
 
     max_num_batched_tokens: int = 8192
     max_num_seqs: int = 256
@@ -108,6 +109,7 @@ class EngineArgs:
                 cache_dtype=self.kv_cache_dtype,
                 kv_connector=self.kv_connector,
                 kv_connector_cache_gb=self.kv_connector_cache_gb,
+                kv_events_endpoint=self.kv_events_endpoint,
             ),
             parallel_config=ParallelConfig(
                 tensor_parallel_size=self.tensor_parallel_size,

@@ -428,6 +428,20 @@ class DPLBClient(_ZMQClientBase):
         for eid in range(n):
             engine_config = copy.deepcopy(config)
             engine_config.parallel_config.data_parallel_engines = 1
+            ep = engine_config.cache_config.kv_events_endpoint
+            if ep:
+                # Each engine binds its OWN endpoint (reference offsets
+                # the port by DP rank): tcp ports increment, ipc paths
+                # get a rank suffix.
+                if ep.startswith("tcp://") and ":" in ep.rsplit("/", 1)[-1]:
+                    host, port = ep.rsplit(":", 1)
+                    engine_config.cache_config.kv_events_endpoint = (
+                        f"{host}:{int(port) + eid}"
+                    )
+                else:
+                    engine_config.cache_config.kv_events_endpoint = (
+                        f"{ep}.dp{eid}"
+                    )
             input_addr = f"ipc://{run_dir}/in{eid}-{suffix}.sock"
             sock = self._ctx.socket(zmq.PUSH)
             sock.bind(input_addr)

@@ -16,6 +16,7 @@ from vllm_tpu.core.sched_output import EngineCoreOutputs
 from vllm_tpu.core.scheduler import Scheduler
 from vllm_tpu.engine.executor import Executor
 from vllm_tpu.logger import init_logger
+from vllm_tpu.tracing import trace_instant, trace_span
 from vllm_tpu.request import EngineCoreRequest, Request, RequestStatus
 
 logger = init_logger(__name__)
@@ -113,6 +114,10 @@ class EngineCore:
                 f"loaded: {sorted(self._lora_names)}"
             )
         req = Request.from_engine_core_request(request, self._block_hasher)
+        trace_instant(
+            "request_arrival", req_id=request.request_id,
+            prompt_tokens=len(request.prompt_token_ids),
+        )
         self.scheduler.add_request(req)
 
     def abort_requests(self, request_ids: Iterable[str]) -> None:
@@ -155,7 +160,8 @@ class EngineCore:
             len(self._inflight) < self._max_inflight
             and self.scheduler.has_unfinished_requests()
         ):
-            scheduler_output = self.scheduler.schedule()
+            with trace_span("schedule"):
+                scheduler_output = self.scheduler.schedule()
             if scheduler_output.total_num_scheduled_tokens == 0:
                 # Not dispatched: hand the drained finished ids (and any
                 # encoder-cache frees) back so the runner still gets them
@@ -166,17 +172,37 @@ class EngineCore:
                     + self.scheduler._pending_encoder_frees
                 )
                 break
-            handle = self.executor.dispatch(scheduler_output)
+            with trace_span(
+                "dispatch",
+                tokens=scheduler_output.total_num_scheduled_tokens,
+                reqs=scheduler_output.num_reqs,
+            ):
+                handle = self.executor.dispatch(scheduler_output)
             self._inflight.append((scheduler_output, handle))
         if not self._inflight:
             failed = self.scheduler.drain_failed()
             return failed if failed is not None else EngineCoreOutputs()
         scheduler_output, handle = self._inflight.popleft()
-        runner_output = self.executor.finalize(handle)
-        return self.scheduler.update_from_output(scheduler_output, runner_output)
+        with trace_span("finalize"):
+            runner_output = self.executor.finalize(handle)
+        outputs = self.scheduler.update_from_output(
+            scheduler_output, runner_output
+        )
+        for o in outputs.outputs:
+            if o.finish_reason is not None:
+                trace_instant(
+                    "request_finish", req_id=o.req_id,
+                    finish_reason=str(o.finish_reason),
+                )
+        return outputs
 
     def reset_prefix_cache(self) -> bool:
-        return self.scheduler.kv_cache_manager.reset_prefix_cache()
+        ok = self.scheduler.kv_cache_manager.reset_prefix_cache()
+        # Publish the clear even on an idle engine (no schedule() to ride):
+        # subscribed routers must not keep a stale resident-blocks view.
+        if self.scheduler.kv_event_publisher is not None:
+            self.scheduler.kv_event_publisher.flush()
+        return ok
 
     # ------------------------------------------------------------------
     # Sleep / wake / weight reload (reference: core.py:673 sleep, :711
@@ -239,4 +265,7 @@ class EngineCore:
     def shutdown(self) -> None:
         if self.structured_output_manager is not None:
             self.structured_output_manager.shutdown()
+        if self.scheduler.kv_event_publisher is not None:
+            self.scheduler.kv_event_publisher.flush()
+            self.scheduler.kv_event_publisher.close()
         self.executor.shutdown()

@@ -49,6 +49,13 @@ class MixtralForCausalLM(LlamaForCausalLM):
         self.top_k = hf_config.num_experts_per_tok
         self.renormalize = True
         self.sliding_window = getattr(hf_config, "sliding_window", None)
+        # Per-expert FFN width may differ from the dense intermediate
+        # (Qwen2-MoE's moe_intermediate_size).
+        self.moe_intermediate = getattr(
+            hf_config, "moe_intermediate_size", self.intermediate_size
+        )
+        # Sigmoid-gated shared expert (Qwen2-MoE); 0 = none (Mixtral).
+        self.shared_intermediate = 0
         # EP toggle: experts sharded over the tp axis (vLLM
         # enable_expert_parallel semantics) vs FFN-dim sharding.
         self.expert_parallel = False
@@ -75,10 +82,10 @@ class MixtralForCausalLM(LlamaForCausalLM):
         L, D, F, E = (
             self.num_layers,
             self.hidden_size,
-            self.intermediate_size,
+            self.moe_intermediate,
             self.num_experts,
         )
-        keys = jax.random.split(jax.random.fold_in(rng, 1), 4)
+        keys = jax.random.split(jax.random.fold_in(rng, 1), 8)
 
         def init(key, shape, fan_in):
             return (
@@ -89,6 +96,12 @@ class MixtralForCausalLM(LlamaForCausalLM):
         layers["we_gate"] = init(keys[1], (L, E, D, F), D)
         layers["we_up"] = init(keys[2], (L, E, D, F), D)
         layers["we_down"] = init(keys[3], (L, E, F, D), F)
+        if self.shared_intermediate:
+            Fs = self.shared_intermediate
+            layers["ws_gate"] = init(keys[4], (L, D, Fs), D)
+            layers["ws_up"] = init(keys[5], (L, D, Fs), D)
+            layers["ws_down"] = init(keys[6], (L, Fs, D), Fs)
+            layers["wsg"] = init(keys[7], (L, D, 1), D)
         return params
 
     def hf_weight_map(self) -> dict:
@@ -125,9 +138,12 @@ class MixtralForCausalLM(LlamaForCausalLM):
             x, kv = carry
             lp, li = inputs
             h = rms_norm(x, lp["input_norm"], self.rms_eps)
-            q = (h @ lp["wq"]).reshape(t, H, Dh)
-            k = (h @ lp["wk"]).reshape(t, KH, Dh)
-            v = (h @ lp["wv"]).reshape(t, KH, Dh)
+            q, k, v = h @ lp["wq"], h @ lp["wk"], h @ lp["wv"]
+            if self.attention_bias:
+                q, k, v = q + lp["bq"], k + lp["bk"], v + lp["bv"]
+            q = q.reshape(t, H, Dh)
+            k = k.reshape(t, KH, Dh)
+            v = v.reshape(t, KH, Dh)
             if self.qk_norm:
                 q = rms_norm(q, lp["q_norm"], self.rms_eps)
                 k = rms_norm(k, lp["k_norm"], self.rms_eps)
@@ -176,6 +192,15 @@ class MixtralForCausalLM(LlamaForCausalLM):
                 ids,
                 use_grouped=None if not self.expert_parallel else False,
             )
+            if self.shared_intermediate:
+                # Sigmoid-gated shared expert (Qwen2-MoE semantics).
+                from vllm_tpu.layers.activation import silu_and_mul
+
+                gate_up = jnp.concatenate(
+                    [h2 @ lp["ws_gate"], h2 @ lp["ws_up"]], -1
+                )
+                shared = silu_and_mul(gate_up) @ lp["ws_down"]
+                moe_out = moe_out + jax.nn.sigmoid(h2 @ lp["wsg"]) * shared
             return (x + moe_out, kv), counts_l
 
         # Whole cache in the carry: in-place paged KV (see models/llama.py).
@@ -209,6 +234,11 @@ class MixtralForCausalLM(LlamaForCausalLM):
             layers["we_gate"] = P(None, None, None, tp)
             layers["we_up"] = P(None, None, None, tp)
             layers["we_down"] = P(None, None, tp, None)
+        if self.shared_intermediate:
+            layers["ws_gate"] = P(None, None, tp)
+            layers["ws_up"] = P(None, None, tp)
+            layers["ws_down"] = P(None, tp, None)
+            layers["wsg"] = P(None, None, None)
         if self.enable_eplb:
             layers["eplb_l2p"] = P(None, None)
         return out

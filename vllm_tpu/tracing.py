@@ -1,0 +1,93 @@
+"""Request/engine tracing: chrome-trace (Perfetto) spans.
+
+Reference analog: ``vllm/tracing/`` (OTLP span exporter ``otel.py:19``,
+``@instrument`` on init/hot paths) — this environment ships the
+opentelemetry API but no SDK/exporter, so the collector here is
+dependency-free: spans land in chrome-trace-format JSON
+(``chrome://tracing`` / https://ui.perfetto.dev) under
+``VLLM_TPU_TRACE_DIR``, one file per process, flushed incrementally. The
+OTLP exporter is the transport seam: `trace_span` is the single
+instrumentation point to rebind.
+
+Spans cover the serving lifecycle the reference traces per request
+(arrival -> queue -> prefill -> decode -> finish) plus the engine step
+phases (schedule / dispatch / finalize).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from contextlib import contextmanager
+
+_lock = threading.Lock()
+_file = None
+_enabled: bool | None = None
+
+
+def _trace_file():
+    global _file, _enabled
+    if _enabled is None:
+        trace_dir = os.environ.get("VLLM_TPU_TRACE_DIR")
+        _enabled = bool(trace_dir)
+        if _enabled:
+            os.makedirs(trace_dir, exist_ok=True)
+            path = os.path.join(trace_dir, f"trace-{os.getpid()}.json")
+            _file = open(path, "w")
+            _file.write("[\n")
+    return _file
+
+
+def trace_enabled() -> bool:
+    _trace_file()
+    return bool(_enabled)
+
+
+def _emit(event: dict) -> None:
+    f = _trace_file()
+    if f is None:
+        return
+    with _lock:
+        f.write(json.dumps(event) + ",\n")
+        f.flush()
+
+
+@contextmanager
+def trace_span(name: str, category: str = "engine", **attrs):
+    """Complete-event span; no-op (near-zero cost) when tracing is off."""
+    if not trace_enabled():
+        yield
+        return
+    t0 = time.perf_counter_ns() // 1000  # chrome trace wants microseconds
+    try:
+        yield
+    finally:
+        t1 = time.perf_counter_ns() // 1000
+        _emit({
+            "name": name,
+            "cat": category,
+            "ph": "X",
+            "ts": t0,
+            "dur": t1 - t0,
+            "pid": os.getpid(),
+            "tid": threading.get_ident() % 2**31,
+            "args": {k: v for k, v in attrs.items() if v is not None},
+        })
+
+
+def trace_instant(name: str, category: str = "request", **attrs) -> None:
+    """Point event (request arrival, finish, preemption...)."""
+    if not trace_enabled():
+        return
+    _emit({
+        "name": name,
+        "cat": category,
+        "ph": "i",
+        "s": "p",
+        "ts": time.perf_counter_ns() // 1000,
+        "pid": os.getpid(),
+        "tid": threading.get_ident() % 2**31,
+        "args": {k: v for k, v in attrs.items() if v is not None},
+    })
