@@ -14,7 +14,7 @@ the hot path never blocks on the socket.
 
 from __future__ import annotations
 
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 from typing import Any
 
 from vllm_tpu.logger import init_logger
@@ -39,13 +39,6 @@ class BlockRemoved:
 @dataclass
 class AllBlocksCleared:
     pass
-
-
-@dataclass
-class EventBatch:
-    seq: int
-    ts: float
-    events: list[Any] = field(default_factory=list)
 
 
 def _encode_event(e) -> dict:

@@ -429,8 +429,9 @@ class DPLBClient(_ZMQClientBase):
             engine_config = copy.deepcopy(config)
             engine_config.parallel_config.data_parallel_engines = 1
             ep = engine_config.cache_config.kv_events_endpoint
-            if ep:
-                # Each engine binds its OWN endpoint (reference offsets
+            if ep and eid > 0:
+                # Each engine binds its OWN endpoint; rank 0 keeps the
+                # configured address for BOTH schemes (reference offsets
                 # the port by DP rank): tcp ports increment, ipc paths
                 # get a rank suffix.
                 if ep.startswith("tcp://") and ":" in ep.rsplit("/", 1)[-1]:

@@ -219,6 +219,9 @@ class EngineCore:
             self.step()
         # The KV cache is discarded; any cached prefixes are invalid.
         self.scheduler.kv_cache_manager.reset_prefix_cache()
+        if self.scheduler.kv_event_publisher is not None:
+            # A sleeping engine runs no schedule(): publish the clear now.
+            self.scheduler.kv_event_publisher.flush()
         self.executor.collective_rpc("sleep", level)
         self._asleep = True
         return True
