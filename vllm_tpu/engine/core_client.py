@@ -36,9 +36,11 @@ class EngineDeadError(RuntimeError):
 
 def make_client(config: EngineConfig):
     from vllm_tpu import envs
+    from vllm_tpu.plugins import load_general_plugins
     from vllm_tpu.usage import record_usage
 
     # Every engine frontend (sync LLMEngine AND AsyncLLM) converges here.
+    load_general_plugins()
     record_usage(config, context="engine")
 
     if config.parallel_config.data_parallel_engines > 1:

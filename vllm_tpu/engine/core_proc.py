@@ -60,6 +60,11 @@ def run_engine_core(config_bytes: bytes, input_addr: str,
 
     from vllm_tpu.engine import serial_utils
     from vllm_tpu.engine.engine_core import EngineCore
+    from vllm_tpu.plugins import load_general_plugins
+
+    # Spawned interpreters don't inherit the frontend's plugin state:
+    # out-of-tree registrations must happen where the model is built.
+    load_general_plugins()
 
     logger = init_logger("vllm_tpu.engine.core_proc")
     ctx = zmq.Context(1)

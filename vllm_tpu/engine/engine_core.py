@@ -217,11 +217,10 @@ class EngineCore:
         # outputs are stale and identity-guarded away).
         while self._inflight:
             self.step()
-        # The KV cache is discarded; any cached prefixes are invalid.
-        self.scheduler.kv_cache_manager.reset_prefix_cache()
-        if self.scheduler.kv_event_publisher is not None:
-            # A sleeping engine runs no schedule(): publish the clear now.
-            self.scheduler.kv_event_publisher.flush()
+        # The KV cache is discarded; any cached prefixes are invalid (the
+        # method also publishes the clear — a sleeping engine runs no
+        # schedule() to ride).
+        self.reset_prefix_cache()
         self.executor.collective_rpc("sleep", level)
         self._asleep = True
         return True

@@ -1,0 +1,55 @@
+"""Plugin discovery: entry-point loaded extensions.
+
+Reference analog: ``vllm/plugins/`` (``load_general_plugins``,
+``docs/design/plugin_system.md``). Third-party packages extend the
+framework by exposing callables under the ``vllm_tpu.plugins`` entry-point
+group; each is invoked once at engine construction and typically calls
+``ModelRegistry.register`` (out-of-tree architectures), registers a KV
+connector, or wraps a stat logger. ``VLLM_TPU_PLUGINS`` (comma-separated
+names) restricts which discovered plugins load; unset loads all.
+"""
+
+from __future__ import annotations
+
+import os
+
+from vllm_tpu.logger import init_logger
+
+logger = init_logger(__name__)
+
+PLUGIN_GROUP = "vllm_tpu.plugins"
+_loaded = False
+
+
+def load_general_plugins(force: bool = False) -> list[str]:
+    """Discover + invoke plugin entry points (idempotent per process)."""
+    global _loaded
+    if _loaded and not force:
+        return []
+    _loaded = True
+
+    from importlib.metadata import entry_points
+
+    allow = os.environ.get("VLLM_TPU_PLUGINS")
+    allowed = (
+        {n.strip() for n in allow.split(",") if n.strip()}
+        if allow is not None
+        else None
+    )
+    loaded: list[str] = []
+    try:
+        eps = entry_points(group=PLUGIN_GROUP)
+    except TypeError:  # older importlib.metadata API
+        eps = entry_points().get(PLUGIN_GROUP, [])  # type: ignore[call-arg]
+    for ep in eps:
+        if allowed is not None and ep.name not in allowed:
+            logger.info("plugin %s skipped (VLLM_TPU_PLUGINS)", ep.name)
+            continue
+        try:
+            hook = ep.load()
+            hook()
+            loaded.append(ep.name)
+            logger.info("loaded plugin %s", ep.name)
+        except Exception as e:  # one bad plugin must not kill the engine
+            logger.error("plugin %s failed to load: %s", ep.name, e)
+    return loaded
