@@ -110,6 +110,204 @@ def _grouped_moe(
     ).astype(hidden.dtype)
 
 
+def _excl_cumsum(x: jnp.ndarray) -> jnp.ndarray:
+    return jnp.concatenate([jnp.zeros((1,), x.dtype), jnp.cumsum(x)[:-1]])
+
+
+def _local_grouped_experts(
+    xs: jnp.ndarray,  # [M, D] rows sorted by local expert
+    w_gate: jnp.ndarray,  # [El, D, F]
+    w_up: jnp.ndarray,
+    w_down: jnp.ndarray,  # [El, F, D]
+    group_sizes: jnp.ndarray,  # [El] i32, sum may be < M
+    interpret: bool,
+) -> jnp.ndarray:
+    """Grouped-GEMM expert compute over expert-sorted rows.
+
+    megablox ``gmm``'s grid is ``(tiles_n, num_active_tiles, tiles_k)`` with
+    the tile count derived from ``group_sizes`` via scalar prefetch, so rows
+    past ``sum(group_sizes)`` cost nothing (their output is uninitialized —
+    callers must never read them)."""
+    from jax.experimental.pallas.ops.tpu.megablox import gmm
+
+    m, d = xs.shape
+    f = w_gate.shape[2]
+    # Row tile must divide m (callers round the buffer up to 128 rows);
+    # k/n remainders are handled in-kernel.
+    kw = dict(
+        preferred_element_type=jnp.float32,
+        interpret=interpret,
+        tiling=(min(128, m), min(128, d), min(128, f)),
+    )
+    gate = gmm(xs, w_gate, group_sizes, **kw)
+    up = gmm(xs, w_up, group_sizes, **kw)
+    act = (jax.nn.silu(gate) * up).astype(xs.dtype)
+    kw["tiling"] = (min(128, m), min(128, f), min(128, d))
+    return gmm(act, w_down, group_sizes, **kw)
+
+
+def ep_moe(
+    hidden: jnp.ndarray,  # [T, D] (replicated over the ep axis)
+    w_gate: jnp.ndarray,  # [E, D, F] (sharded over ep on dim 0)
+    w_up: jnp.ndarray,
+    w_down: jnp.ndarray,  # [E, F, D]
+    weights: jnp.ndarray,  # [T, k] f32 combine weights
+    expert_ids: jnp.ndarray,  # [T, k] i32
+    *,
+    mesh,
+    axis: str,
+    interpret: bool = False,
+    use_ragged_a2a: bool | None = None,
+) -> jnp.ndarray:
+    """Expert-parallel MoE: ragged all_to_all dispatch + grouped GEMM.
+
+    The real EP formulation the reference builds in
+    ``vllm/model_executor/layers/fused_moe/modular_kernel.py:181`` (prepare:
+    route + permute + dispatch; experts: grouped GEMM; finalize: combine) and
+    ``csrc/moe/moe_align_sum_kernels.cu`` (token alignment), done the TPU way:
+    a ``shard_map`` manual region over the ``axis`` mesh axis where
+
+    1. each device sorts its ``T/ep`` tokens' (token, k) pairs by global
+       expert id (expert ownership is contiguous, so this is also sorted by
+       destination device),
+    2. per-destination counts are exchanged (``all_gather`` of an [ep] int
+       vector) giving the full [src, dst] count matrix from which every
+       ragged offset is derived,
+    3. payload rows ride ``jax.lax.ragged_all_to_all`` (XLA's native ragged
+       dispatch collective) to the expert owners — the CPU backend has no
+       lowering for it, so tests swap in an exact all_gather emulation with
+       identical offset math,
+    4. received rows are sorted by local expert and hit the megablox grouped
+       GEMM (dynamic ``num_active_tiles``: FLOPs track the *actual* token
+       count, the worst-case static buffer costs memory only),
+    5. results ride the reverse ragged all_to_all home and are combined with
+       routing weights.
+
+    Dropless: the receive buffer is worst-case sized (``T*k`` rows), so no
+    capacity-factor token dropping — required for inference correctness.
+    """
+    ep = mesh.shape[axis]
+    t, d = hidden.shape
+    e = w_gate.shape[0]
+    k = expert_ids.shape[1]
+    if e % ep:
+        raise ValueError(f"num_experts {e} not divisible by ep size {ep}")
+    el = e // ep
+    if use_ragged_a2a is None:
+        use_ragged_a2a = jax.default_backend() == "tpu"
+
+    # Pad tokens to a multiple of ep (pad rows route to expert 0, weight 0).
+    t_pad = -(-t // ep) * ep
+    if t_pad != t:
+        hidden = jnp.pad(hidden, ((0, t_pad - t), (0, 0)))
+        weights = jnp.pad(weights, ((0, t_pad - t), (0, 0)))
+        expert_ids = jnp.pad(expert_ids, ((0, t_pad - t), (0, 0)))
+    # Worst case: every pair routes to one device. Rounded up to the gmm
+    # row tile; extra slots look like unreceived pads (sentinel expert id).
+    cap = t_pad * k
+    if cap > 128:
+        cap = -(-cap // 128) * 128
+
+    def local_fn(x, wg, wu, wd, w, ids):
+        my = jax.lax.axis_index(axis)
+        tl = x.shape[0]
+        flat = ids.reshape(-1)  # [tl*k] global expert ids
+        order = jnp.argsort(flat, stable=True)
+        x_send = x[order // k]
+        # One [E]-int all_gather carries ALL dispatch metadata: every chunk
+        # is expert-sorted, so receivers reconstruct per-row expert ids and
+        # group sizes from counts alone — no id payload collective.
+        expert_counts = jnp.bincount(flat, length=e).astype(jnp.int32)
+        g_ec = jax.lax.all_gather(expert_counts, axis)  # [src, E]
+        cm = g_ec.reshape(ep, ep, el).sum(-1)  # [src, dst] pair counts
+        send_counts = cm[my]
+        recv_counts = cm[:, my]
+        # row_excl[s, d]: offset of the chunk for d in s's send buffer;
+        # col_excl[s, d]: offset of s's chunk in d's receive buffer. The
+        # four ragged-a2a offset vectors are rows/columns of these.
+        row_excl = jnp.concatenate(
+            [jnp.zeros((ep, 1), jnp.int32), jnp.cumsum(cm, 1)[:, :-1]], 1
+        )
+        col_excl = jnp.concatenate(
+            [jnp.zeros((1, ep), jnp.int32), jnp.cumsum(cm, 0)[:-1]], 0
+        )
+
+        # Per-source counts for MY experts; their row-cumsum recovers each
+        # received row's local expert id below.
+        my_counts = jax.lax.dynamic_slice(g_ec, (0, my * el), (ep, el))
+        my_cumsum = jnp.cumsum(my_counts, axis=1)  # [src, el]
+        total_recv = jnp.sum(recv_counts)
+        j = jnp.arange(cap)
+        src = jnp.clip(
+            jnp.searchsorted(jnp.cumsum(recv_counts), j, side="right"),
+            0, ep - 1,
+        )
+        p = j - col_excl[src, my]  # position within src's chunk
+        valid = j < total_recv
+        local_eid = jnp.where(
+            valid, jnp.sum(p[:, None] >= my_cumsum[src], axis=1), el
+        )
+        group_sizes = jnp.sum(my_counts, axis=0)  # [el]
+
+        if use_ragged_a2a:
+            xr = jax.lax.ragged_all_to_all(
+                x_send, jnp.zeros((cap, d), x.dtype),
+                row_excl[my], send_counts, col_excl[my], recv_counts,
+                axis_name=axis,
+            )
+        else:
+            # Exact emulation for backends without the primitive: gather
+            # everything, assemble my receive buffer with the same layout.
+            g_x = jax.lax.all_gather(x_send, axis)  # [ep, tl*k, d]
+            pos = jnp.clip(row_excl[src, my] + p, 0, tl * k - 1)
+            xr = jnp.where(valid[:, None], g_x[src, pos], 0)
+
+        # Local alignment: sort received rows by local expert (pads sort
+        # last via the sentinel id ``el``), grouped GEMM, unsort.
+        lorder = jnp.argsort(local_eid, stable=True)
+        xs = xr[lorder]
+        ys = _local_grouped_experts(
+            xs, wg, wu, wd, group_sizes, interpret
+        ).astype(x.dtype)
+        y_unsorted = jnp.zeros_like(ys).at[lorder].set(ys)
+
+        if use_ragged_a2a:
+            y_back = jax.lax.ragged_all_to_all(
+                y_unsorted, jnp.zeros((tl * k, d), x.dtype),
+                col_excl[:, my], recv_counts, row_excl[:, my], send_counts,
+                axis_name=axis,
+            )
+        else:
+            g_y = jax.lax.all_gather(y_unsorted, axis)  # [ep, cap, d]
+            jj = jnp.arange(tl * k)
+            dst = jnp.clip(
+                jnp.searchsorted(jnp.cumsum(send_counts), jj, side="right"),
+                0, ep - 1,
+            )
+            y_back = g_y[dst, col_excl[my, dst] + (jj - row_excl[my, dst])]
+
+        y_flat = jnp.zeros_like(y_back).at[order].set(y_back)
+        y_pairs = y_flat.reshape(tl, k, d).astype(jnp.float32)
+        return jnp.einsum("tkd,tk->td", y_pairs, w).astype(x.dtype)
+
+    from jax.sharding import PartitionSpec as P
+
+    out = jax.shard_map(
+        local_fn,
+        mesh=mesh,
+        in_specs=(
+            P(axis, None), P(axis, None, None), P(axis, None, None),
+            P(axis, None, None), P(axis, None), P(axis, None),
+        ),
+        out_specs=P(axis, None),
+        axis_names=frozenset({axis}),
+        # pallas_call (gmm) does not annotate varying-mesh-axes metadata;
+        # skip the vma check rather than thread vma through the kernel.
+        check_vma=False,
+    )(hidden, w_gate, w_up, w_down, weights, expert_ids)
+    return out[:t]
+
+
 def fused_experts(
     hidden: jnp.ndarray,  # [T, D]
     w_gate: jnp.ndarray,  # [E, D, F]
@@ -118,11 +316,27 @@ def fused_experts(
     weights: jnp.ndarray,  # [T, k] f32 combine weights
     expert_ids: jnp.ndarray,  # [T, k] i32
     use_grouped: bool | None = None,
+    *,
+    ep_mesh=None,
+    ep_axis: str | None = None,
 ) -> jnp.ndarray:
     """Experts + combine for pre-computed routing (custom gating schemes —
     DeepSeek group-limited / sigmoid-bias routing — share the expert
     compute). ``use_grouped=None`` auto-selects the megablox path on
-    single-device TPU, dense one-hot otherwise."""
+    single-device TPU, dense one-hot otherwise. With ``ep_mesh``/``ep_axis``
+    set (and axis size > 1) the ragged all_to_all expert-parallel path is
+    taken instead."""
+    if ep_mesh is not None and ep_axis and ep_mesh.shape[ep_axis] > 1:
+        from vllm_tpu import envs
+
+        return ep_moe(
+            hidden, w_gate, w_up, w_down, weights, expert_ids,
+            mesh=ep_mesh, axis=ep_axis,
+            interpret=(
+                envs.VLLM_TPU_PALLAS_INTERPRET
+                or jax.default_backend() != "tpu"
+            ),
+        )
     if use_grouped is None:
         # Grouped megablox is the single-device fast path; under a multi-
         # device mesh the dense one-hot path is the GSPMD/EP formulation.

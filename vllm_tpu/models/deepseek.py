@@ -134,6 +134,7 @@ class DeepseekV2ForCausalLM:
         )
         self.num_moe_layers = self.num_layers - self.first_dense
         self.expert_parallel = False
+        self.ep_mesh = None
 
         # Interleaved rope over the decoupled rope dims; yarn mscale (the
         # DeepSeek long-context recipe) is baked into the cos/sin tables
@@ -391,6 +392,8 @@ class DeepseekV2ForCausalLM:
             routed = fused_experts(
                 h2, lp["we_gate"], lp["we_up"], lp["we_down"], weights, ids,
                 use_grouped=None if not self.expert_parallel else False,
+                ep_mesh=self.ep_mesh if self.expert_parallel else None,
+                ep_axis="tp",
             )
             out = routed
             if self.n_shared:

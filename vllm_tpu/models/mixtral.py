@@ -57,8 +57,11 @@ class MixtralForCausalLM(LlamaForCausalLM):
         # Sigmoid-gated shared expert (Qwen2-MoE); 0 = none (Mixtral).
         self.shared_intermediate = 0
         # EP toggle: experts sharded over the tp axis (vLLM
-        # enable_expert_parallel semantics) vs FFN-dim sharding.
+        # enable_expert_parallel semantics) vs FFN-dim sharding. With a
+        # mesh attached (set by the worker), the ragged all_to_all
+        # dispatch + grouped-GEMM path runs; without one, dense one-hot.
         self.expert_parallel = False
+        self.ep_mesh = None
 
     # ------------------------------------------------------------------
 
@@ -191,6 +194,8 @@ class MixtralForCausalLM(LlamaForCausalLM):
                 weights,
                 ids,
                 use_grouped=None if not self.expert_parallel else False,
+                ep_mesh=self.ep_mesh if self.expert_parallel else None,
+                ep_axis="tp",
             )
             if self.shared_intermediate:
                 # Sigmoid-gated shared expert (Qwen2-MoE semantics).

@@ -125,6 +125,26 @@ class Worker:
                     "(MoE models with stacked expert weights only)"
                 )
             self.model.enable_eplb = True
+        if pc.enable_expert_parallel:
+            if (
+                not hasattr(self.model, "expert_parallel")
+                or not getattr(self.model, "num_experts", None)
+            ):
+                raise ValueError(
+                    f"{type(self.model).__name__} is not a MoE model; "
+                    "--enable-expert-parallel needs stacked expert weights"
+                )
+            ep = pc.tensor_parallel_size
+            if self.model.num_experts % max(ep, 1):
+                raise ValueError(
+                    f"num_experts ({self.model.num_experts}) must be "
+                    f"divisible by the EP size (tp={ep})"
+                )
+            # EP rides the tp mesh axis (experts sharded over tp instead of
+            # FFN-dim sharding); the ragged all_to_all dispatch path needs
+            # the concrete mesh.
+            self.model.expert_parallel = True
+            self.model.ep_mesh = self.mesh
         if pc.pipeline_parallel_size > 1:
             from vllm_tpu.models.llama import LlamaForCausalLM
 
