@@ -28,6 +28,18 @@ import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
 
+# Persistent compilation cache: XLA recompiles dominate suite runtime on
+# the CPU backend; cached executables survive across pytest runs.
+_cache_dir = os.environ.get(
+    "VLLM_TPU_COMPILE_CACHE_DIR",
+    os.path.expanduser("~/.cache/vllm_tpu/xla_cache_tests"),
+)
+if _cache_dir:
+    os.makedirs(_cache_dir, exist_ok=True)
+    jax.config.update("jax_compilation_cache_dir", _cache_dir)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+    jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+
 import pytest  # noqa: E402
 
 

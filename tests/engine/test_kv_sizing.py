@@ -1,0 +1,85 @@
+"""Profile-based KV sizing (reference: gpu_worker.py:352
+determine_available_memory + profile_run). The TPU-native measurement is
+AOT: compile the real step at the max buckets and read XLA's memory
+analysis instead of running and sampling allocator stats."""
+
+from __future__ import annotations
+
+import numpy as np
+
+from tests.models.utils import tiny_llama_dir
+
+
+def _make_llm(model_dir, **kw):
+    from vllm_tpu import LLM
+
+    return LLM(
+        model=model_dir, dtype="float32", max_model_len=64, block_size=16,
+        num_gpu_blocks_override=32, max_num_seqs=4,
+        max_num_batched_tokens=64, **kw,
+    )
+
+
+def test_profile_step_memory_measures(tmp_path_factory):
+    """profile_step_memory returns a positive byte count on a compiled
+    max-bucket step, and the runner still serves correctly afterwards
+    (profiling must not corrupt persistent batch state)."""
+    path = tiny_llama_dir(tmp_path_factory.mktemp("tiny_kv_sizing"))
+    llm = _make_llm(path)
+    worker = llm.llm_engine.engine_core.engine_core.executor.worker
+    runner = worker.runner
+
+    act = runner.profile_step_memory()
+    assert act is not None and act > 0
+    # Persistent-batch state is clean: no leaked profile requests.
+    assert all(r is None for r in runner.input_batch.req_ids)
+
+    from vllm_tpu import SamplingParams
+
+    outs = llm.generate(
+        [{"prompt_token_ids": [3, 7, 11]}],
+        SamplingParams(temperature=0.0, max_tokens=4, ignore_eos=True),
+    )
+    assert len(outs[0].outputs[0].token_ids) == 4
+
+
+def test_sizing_uses_measured_activations(tmp_path_factory):
+    """determine_num_kv_blocks subtracts the measured peak when given one:
+    a larger activation measurement must never yield more blocks."""
+    path = tiny_llama_dir(tmp_path_factory.mktemp("tiny_kv_sizing2"))
+    llm = _make_llm(path)
+    worker = llm.llm_engine.engine_core.engine_core.executor.worker
+
+    class FakeDev:
+        device_kind = "TPU v5 lite"
+
+        def memory_stats(self):
+            return {"bytes_limit": 16 * 2**30, "bytes_in_use": 2**30}
+
+    real_dev = worker.device
+    worker.config.cache_config.num_gpu_blocks_override = None
+    worker.device = FakeDev()
+    try:
+        small = worker.determine_num_kv_blocks(activation_bytes=2**30)
+        large = worker.determine_num_kv_blocks(activation_bytes=6 * 2**30)
+        frac = worker.determine_num_kv_blocks(activation_bytes=None)
+    finally:
+        worker.device = real_dev
+        worker.config.cache_config.num_gpu_blocks_override = 32
+    assert small > large > 0
+    assert frac > 0
+
+
+def test_resize_kv_cache(tmp_path_factory):
+    path = tiny_llama_dir(tmp_path_factory.mktemp("tiny_kv_resize"))
+    llm = _make_llm(path)
+    runner = llm.llm_engine.engine_core.engine_core.executor.worker.runner
+    old_blocks = runner.num_kv_blocks
+    runner.resize_kv_cache(old_blocks + 8)
+    kv = runner.kv_cache
+    leaves = [kv] if not isinstance(kv, dict) else list(kv.values())
+    assert runner.num_kv_blocks == old_blocks + 8
+    assert any(
+        (old_blocks + 8) in leaf.shape
+        for leaf in np.atleast_1d(leaves)
+    )

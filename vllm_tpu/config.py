@@ -267,6 +267,16 @@ class CompilationConfig:
     request_buckets: list[int] = field(default_factory=list)
     # Precompile all buckets at startup (vs lazily on first use).
     precompile: bool = False
+    # Bucket budget: cap on len(token_buckets) * len(request_buckets).
+    # Derived bucket lists are thinned (every other entry, keeping both
+    # endpoints) until they fit; explicit bucket lists are never thinned.
+    # NOTE this bounds the t x r bucket grid only — the block-count bucket
+    # (b_pad) and static sampler-variant flags multiply the true worst-case
+    # executable count further; in practice a workload exercises few of
+    # those variants. More buckets = less padding waste per step but more
+    # compile time/cache pressure. The default admits the full pow2
+    # ladders at 8k tokens x 512 reqs.
+    max_step_compilations: int = 128
 
     @staticmethod
     def _pow2_buckets(lo: int, hi: int) -> list[int]:
@@ -278,13 +288,36 @@ class CompilationConfig:
         out.append(hi)
         return out
 
+    @staticmethod
+    def _thin(buckets: list[int]) -> list[int]:
+        if len(buckets) <= 2:
+            return buckets
+        return buckets[:-1:2] + [buckets[-1]]
+
     def finalize(self, sched: SchedulerConfig) -> None:
-        if not self.token_buckets:
+        explicit_t = bool(self.token_buckets)
+        explicit_r = bool(self.request_buckets)
+        if not explicit_t:
             self.token_buckets = self._pow2_buckets(
                 16, max(16, sched.max_num_batched_tokens)
             )
-        if not self.request_buckets:
+        if not explicit_r:
             self.request_buckets = self._pow2_buckets(8, max(8, sched.max_num_seqs))
+        while (
+            len(self.token_buckets) * len(self.request_buckets)
+            > self.max_step_compilations
+        ):
+            can_t = not explicit_t and len(self.token_buckets) > 2
+            can_r = not explicit_r and len(self.request_buckets) > 2
+            if not can_t and not can_r:
+                break
+            if can_t and (
+                not can_r
+                or len(self.token_buckets) >= len(self.request_buckets)
+            ):
+                self.token_buckets = self._thin(self.token_buckets)
+            else:
+                self.request_buckets = self._thin(self.request_buckets)
 
 
 @dataclass

@@ -168,6 +168,21 @@ class FreeKVCacheBlockQueue:
         return out
 
 
+def _lane_padded(n: int) -> int:
+    """Physical lane width of a minor array dim on TPU.
+
+    XLA tiles the minor dim to 128 lanes, so ``f32[..., 2, 32]`` occupies
+    ``(2, 128)`` tiles — 4x the logical bytes. Sizing must budget physical
+    bytes or the computed block count OOMs at allocation time (observed
+    with small head_dim models on v5e).
+    """
+    import jax
+
+    if jax.default_backend() != "tpu":
+        return n
+    return -(-n // 128) * 128
+
+
 @dataclass
 class KVCacheSpec:
     """Per-layer cache requirement (reference: ``vllm/v1/kv_cache_interface.py``).
@@ -183,8 +198,21 @@ class KVCacheSpec:
 
     @property
     def page_size_bytes(self) -> int:
-        # K and V planes.
-        return 2 * self.block_size * self.num_kv_heads * self.head_size * self.dtype_bytes
+        # Mirrors ops/attention.py kv_cache_shape: head_dim below the
+        # 128-lane tile pair-packs K||V on the lane axis ([.., KH, 2*D]);
+        # otherwise K/V interleave on the sublane axis ([.., 2*KH, D]).
+        # Budget the lane-padded physical bytes of the actual minor dim
+        # (second-minor sublane padding is not modeled; the sizing safety
+        # margin absorbs it).
+        from vllm_tpu.ops.attention import packed_kv_layout
+
+        if packed_kv_layout(self.head_size):
+            rows, lanes = self.num_kv_heads, 2 * self.head_size
+        else:
+            rows, lanes = 2 * self.num_kv_heads, self.head_size
+        return (
+            self.block_size * rows * _lane_padded(lanes) * self.dtype_bytes
+        )
 
     def max_memory_usage_bytes(self, max_model_len: int) -> int:
         import math
@@ -206,8 +234,8 @@ class MLAAttentionSpec(KVCacheSpec):
     @property
     def page_size_bytes(self) -> int:
         return (
-            self.block_size * self.num_kv_heads * self.head_size
-            * self.dtype_bytes
+            self.block_size * self.num_kv_heads
+            * _lane_padded(self.head_size) * self.dtype_bytes
         )
 
 

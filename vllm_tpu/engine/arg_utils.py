@@ -81,6 +81,9 @@ class EngineArgs:
 
     disable_log_stats: bool = False
     precompile: bool = False
+    # Cap on token-bucket x request-bucket step compilations (derived
+    # bucket ladders are thinned to fit; see CompilationConfig).
+    max_step_compilations: int = 128
 
     # Test/bench hook: inject an HF config object directly.
     hf_config: Any = None
@@ -149,7 +152,10 @@ class EngineArgs:
             observability_config=ObservabilityConfig(
                 log_stats=not self.disable_log_stats
             ),
-            compilation_config=CompilationConfig(precompile=self.precompile),
+            compilation_config=CompilationConfig(
+                precompile=self.precompile,
+                max_step_compilations=self.max_step_compilations,
+            ),
         )
         # If the model's max length is unknown and unset, derive after the HF
         # config loads (worker does it); default scheduler cap holds till then.

@@ -47,6 +47,8 @@ _readers: dict[str, Callable[[], Any]] = {
     "VLLM_TPU_DISABLE_PALLAS": _bool("VLLM_TPU_DISABLE_PALLAS", False),
     "VLLM_TPU_PALLAS_INTERPRET": _bool("VLLM_TPU_PALLAS_INTERPRET", False),
     "VLLM_TPU_COMPILE_CACHE_DIR": _str("VLLM_TPU_COMPILE_CACHE_DIR", None),
+    # LRU size bound for the persistent compilation cache directory.
+    "VLLM_TPU_COMPILE_CACHE_MAX_GB": _int("VLLM_TPU_COMPILE_CACHE_MAX_GB", 32),
     # Profiling
     "VLLM_TPU_PROFILER_DIR": _str("VLLM_TPU_PROFILER_DIR", None),
     # Per-step host/device time breakdown accumulated in ModelRunner.timing.
@@ -58,6 +60,10 @@ _readers: dict[str, Callable[[], Any]] = {
     "VLLM_TPU_NO_USAGE_STATS": _bool("VLLM_TPU_NO_USAGE_STATS", False),
     # Disable the C++ host-prep fast path (pure-python fallback).
     "VLLM_TPU_DISABLE_NATIVE_PREP": _bool("VLLM_TPU_DISABLE_NATIVE_PREP", False),
+    # KV sizing: measure the compiled max-bucket step's peak memory (XLA
+    # memory analysis) instead of assuming a fixed activation-headroom
+    # fraction. Costs one AOT compile at startup; 0 restores the fraction.
+    "VLLM_TPU_PROFILE_KV_SIZING": _bool("VLLM_TPU_PROFILE_KV_SIZING", True),
     # API server
     "VLLM_TPU_API_KEY": _str("VLLM_TPU_API_KEY", None),
     # Testing

@@ -1796,6 +1796,78 @@ class ModelRunner:
 
     # ------------------------------------------------------------------
 
+    def profile_step_memory(self) -> int | None:
+        """Measured activation high-water mark for KV sizing.
+
+        Reference analog: ``gpu_worker.py:352 determine_available_memory``
+        profiles a dummy max-batch run and reads allocator stats. The
+        TPU-native equivalent is ahead-of-time: lower + compile the real
+        jitted step at the LARGEST buckets (max token bucket, max request
+        bucket, max blocks/request, worst-case sampler variant: penalties +
+        top-k + top-p + Gumbel) and ask XLA for the executable's peak
+        temp-buffer footprint. This adapts automatically when spec-decode
+        draft KV, grammar tables, penalty tensors, or larger buckets grow
+        the high-water mark — unlike a device-kind table.
+
+        Returns estimated per-device activation bytes, or None when the
+        backend cannot report a memory analysis.
+        """
+        sched = self.config.scheduler_config
+        t_max = min(sched.max_num_batched_tokens, sched.max_model_len)
+        r = min(sched.max_num_seqs, t_max)
+        first = t_max - (r - 1)
+        so = _dummy_scheduler_output(
+            first, num_reqs=r, max_blocks=self.max_blocks_per_req,
+            worst_case_sampling=True,
+        )
+        try:
+            self._update_states(so)
+            (arrays, req_order, _do_sample, flags, _prompt_rows,
+             _mm) = self._prepare_inputs(so)
+            prev = self._zero_sampled
+            lowered = self._step_fn.lower(
+                self.params, self.kv_cache, self.draft_kv, *arrays, prev,
+                None, **flags,
+            )
+            ma = lowered.compile().memory_analysis()
+            if ma is None:
+                return None
+            temp = int(getattr(ma, "temp_size_in_bytes", 0))
+            out = int(getattr(ma, "output_size_in_bytes", 0))
+            alias = int(getattr(ma, "alias_size_in_bytes", 0))
+            act = temp + max(0, out - alias)
+            logger.info(
+                "profiled step memory (t=%d r=%d): temp %.2f GiB, "
+                "out-alias %.2f GiB",
+                t_max, r, temp / 2**30, max(0, out - alias) / 2**30,
+            )
+            return act
+        except Exception as exc:  # pragma: no cover - backend specific
+            logger.warning("step memory profiling unavailable: %s", exc)
+            return None
+        finally:
+            names = (
+                ["__profile__"] if r == 1
+                else [f"__profile_{i}__" for i in range(r)]
+            )
+            for rid in names:
+                try:
+                    self.input_batch.remove_request(rid)
+                except Exception:
+                    pass
+
+    def resize_kv_cache(self, num_blocks: int) -> None:
+        """Re-allocate the paged KV (and draft KV) for the measured block
+        budget; must run before any step is dispatched."""
+        if num_blocks == self.num_kv_blocks:
+            return
+        self.num_kv_blocks = num_blocks
+        self.kv_cache = None  # free before the larger alloc
+        self.kv_cache = self._alloc_kv_cache()
+        if self.draft_model is not None:
+            self.draft_kv = None
+            self.draft_kv = self._alloc_draft_kv()
+
     def profile_run(self) -> None:
         """Compile + run the largest bucket (memory high-water mark).
         Reference analog: ``gpu_model_runner.py profile_run :5846``."""
@@ -1816,20 +1888,43 @@ class ModelRunner:
         self.input_batch.remove_request("__profile__")
 
 
-def _dummy_scheduler_output(num_tokens: int) -> SchedulerOutput:
+def _dummy_scheduler_output(
+    num_tokens: int,
+    num_reqs: int = 1,
+    max_blocks: int = 1,
+    worst_case_sampling: bool = False,
+) -> SchedulerOutput:
+    """Synthetic batch: request 0 carries ``num_tokens`` prompt tokens (and
+    ``max_blocks`` block-table entries), requests 1..n-1 one token each —
+    the mixed chunked-prefill shape that maxes every bucket dimension at
+    once for memory profiling."""
     from vllm_tpu.core.sched_output import NewRequestData
     from vllm_tpu.sampling_params import SamplingParams
 
-    return SchedulerOutput(
-        scheduled_new_reqs=[
+    if worst_case_sampling:
+        sp = SamplingParams(
+            max_tokens=1, temperature=1.0, top_k=8, top_p=0.9,
+            repetition_penalty=1.1,
+        )
+    else:
+        sp = SamplingParams(max_tokens=1)
+    reqs = []
+    sched: dict[str, int] = {}
+    for i in range(num_reqs):
+        n = num_tokens if i == 0 else 1
+        rid = "__profile__" if num_reqs == 1 else f"__profile_{i}__"
+        reqs.append(
             NewRequestData(
-                req_id="__profile__",
-                prompt_token_ids=[1] * num_tokens,
-                sampling_params=SamplingParams(max_tokens=1),
-                block_ids=[0],
+                req_id=rid,
+                prompt_token_ids=[1] * n,
+                sampling_params=sp,
+                block_ids=[0] * (max_blocks if i == 0 else 1),
                 num_computed_tokens=0,
             )
-        ],
-        num_scheduled_tokens={"__profile__": num_tokens},
-        total_num_scheduled_tokens=num_tokens,
+        )
+        sched[rid] = n
+    return SchedulerOutput(
+        scheduled_new_reqs=reqs,
+        num_scheduled_tokens=sched,
+        total_num_scheduled_tokens=num_tokens + num_reqs - 1,
     )
