@@ -92,6 +92,10 @@ class CacheConfig:
         }.get(self.cache_dtype, self.cache_dtype)
     # Populated at engine init after profiling.
     num_gpu_blocks: int | None = None
+    # Context-parallel striping: the pool is split into this many colors
+    # (= cp mesh ranks); a request's k-th block comes from color k % cp.
+    # Set from ParallelConfig.context_parallel_size at engine-config build.
+    num_kv_stripes: int = 1
     # Populated at model load from the model's attention window (None =
     # full attention); drives out-of-window block freeing.
     sliding_window: int | None = None

@@ -18,11 +18,36 @@ from vllm_tpu.logger import init_logger
 logger = init_logger(__name__)
 
 
+def _count_for_color(
+    num_blocks: int, first_color: int, color: int, num_colors: int
+) -> int:
+    """How many of ``num_blocks`` round-robin allocations starting at
+    ``first_color`` land on ``color``."""
+    if num_colors == 1:
+        return num_blocks
+    offset = (color - first_color) % num_colors
+    if offset >= num_blocks:
+        return 0
+    return 1 + (num_blocks - 1 - offset) // num_colors
+
+
 class BlockPool:
+    """``num_colors > 1`` stripes the pool for context parallelism: color
+    ``c`` owns the contiguous id range ``[c*NBl, (c+1)*NBl)`` — exactly the
+    rows of the cp-sharded cache buffer resident on mesh rank ``c`` — and a
+    request's k-th context block must come from color ``k % cp`` (the
+    reference's ``cp_kv_cache_interleave_size=1`` striping). Each color's
+    first id is a reserved per-rank null block (local slot 0 on every
+    rank)."""
+
     def __init__(self, num_blocks: int, enable_caching: bool = True,
-                 event_sink=None, block_size: int = 16) -> None:
+                 event_sink=None, block_size: int = 16,
+                 num_colors: int = 1) -> None:
         assert num_blocks > 0
+        assert num_blocks % num_colors == 0, (num_blocks, num_colors)
         self.num_blocks = num_blocks
+        self.num_colors = num_colors
+        self.blocks_per_color = num_blocks // num_colors
         self.enable_caching = enable_caching
         # KV event sink (``kv_events.KVEventPublisher.record``): block
         # store/evict/clear notifications for cache-aware routers.
@@ -32,17 +57,30 @@ class BlockPool:
         self.blocks = [KVCacheBlock(block_id=i) for i in range(num_blocks)]
         # Block 0 is the null block: a permanent placeholder pointed at by
         # token positions whose KV is not resident (e.g. skipped sliding-
-        # window prefix). Never allocated, never cached.
+        # window prefix). Never allocated, never cached. Under striping,
+        # every color's first block is likewise reserved.
+        for c in range(num_colors):
+            null = self.blocks[c * self.blocks_per_color]
+            null.is_null = True
+            null.ref_cnt = 1
         self.null_block = self.blocks[0]
-        self.null_block.is_null = True
-        self.null_block.ref_cnt = 1
 
-        self.free_block_queue = FreeKVCacheBlockQueue(self.blocks[1:])
+        self._free_queues = [
+            FreeKVCacheBlockQueue(
+                self.blocks[c * self.blocks_per_color + 1:
+                            (c + 1) * self.blocks_per_color]
+            )
+            for c in range(num_colors)
+        ]
+        self.free_block_queue = self._free_queues[0]  # compat (colors=1)
         # hash -> {block_id -> block}: multiple blocks may share content when
         # the same prefix was computed concurrently.
         self.cached_block_hash_to_block: dict[
             BlockHashWithGroupId, dict[int, KVCacheBlock]
         ] = {}
+
+    def color_of(self, block_id: int) -> int:
+        return block_id // self.blocks_per_color
 
     # ------------------------------------------------------------------
     # Prefix-cache lookup / registration
@@ -103,21 +141,45 @@ class BlockPool:
     # ------------------------------------------------------------------
 
     def get_num_free_blocks(self) -> int:
-        return self.free_block_queue.num_free_blocks
+        return sum(q.num_free_blocks for q in self._free_queues)
 
-    def get_new_blocks(self, num_blocks: int) -> list[KVCacheBlock]:
-        """Pop blocks from the free queue, evicting their stale cache entries.
+    def free_by_color(self) -> list[int]:
+        return [q.num_free_blocks for q in self._free_queues]
+
+    def can_allocate(self, num_blocks: int, first_color: int = 0,
+                     evictable_by_color: list[int] | None = None) -> bool:
+        """Striped availability: the k-th of ``num_blocks`` new blocks must
+        come from color ``(first_color + k) % num_colors``."""
+        free = self.free_by_color()
+        if evictable_by_color is not None:
+            free = [f - e for f, e in zip(free, evictable_by_color)]
+        for c in range(self.num_colors):
+            needed = _count_for_color(
+                num_blocks, first_color, c, self.num_colors
+            )
+            if needed > free[c]:
+                return False
+        return True
+
+    def get_new_blocks(
+        self, num_blocks: int, first_color: int = 0
+    ) -> list[KVCacheBlock]:
+        """Pop blocks from the free queue(s), evicting their stale cache
+        entries; block k comes from color ``(first_color + k) % colors``.
 
         Reference: ``block_pool.py:322``.
         """
-        if num_blocks > self.get_num_free_blocks():
+        if not self.can_allocate(num_blocks, first_color):
             raise RuntimeError(
-                f"asked for {num_blocks} blocks, only "
-                f"{self.get_num_free_blocks()} free"
+                f"asked for {num_blocks} blocks (first_color={first_color}),"
+                f" only {self.free_by_color()} free"
             )
         out = []
-        for _ in range(num_blocks):
-            block = self.free_block_queue.popleft()
+        for k in range(num_blocks):
+            queue = self._free_queues[
+                (first_color + k) % self.num_colors
+            ]
+            block = queue.popleft()
             self._maybe_evict_cached_block(block)
             assert block.ref_cnt == 0
             block.incr_ref()
@@ -149,7 +211,7 @@ class BlockPool:
         free queue and must be pulled out (reference: ``block_pool.py touch``)."""
         for block in blocks:
             if block.ref_cnt == 0 and not block.is_null:
-                self.free_block_queue.remove(block)
+                self._free_queues[self.color_of(block.block_id)].remove(block)
             block.incr_ref()
 
     def free_blocks(self, ordered_blocks: list[KVCacheBlock]) -> None:
@@ -162,12 +224,14 @@ class BlockPool:
             block.decr_ref()
             assert block.ref_cnt >= 0, f"double-free of block {block.block_id}"
             if block.ref_cnt == 0:
-                self.free_block_queue.append(block)
+                self._free_queues[self.color_of(block.block_id)].append(block)
 
     def reset_prefix_cache(self) -> bool:
         """Drop every cached mapping; only safe when nothing is running.
         Reference: ``block_pool.py reset_prefix_cache``."""
-        num_used = self.num_blocks - 1 - self.get_num_free_blocks()
+        num_used = (
+            self.num_blocks - self.num_colors - self.get_num_free_blocks()
+        )
         if num_used > 0:
             logger.warning(
                 "cannot reset prefix cache: %d blocks still referenced", num_used
@@ -186,5 +250,5 @@ class BlockPool:
 
     @property
     def usage(self) -> float:
-        usable = self.num_blocks - 1
+        usable = self.num_blocks - self.num_colors
         return 1.0 - self.get_num_free_blocks() / usable if usable else 0.0

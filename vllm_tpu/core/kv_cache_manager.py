@@ -42,8 +42,12 @@ class KVCacheManager:
         enable_caching: bool = True,
         sliding_window: int | None = None,
         event_sink=None,
+        num_stripes: int = 1,
     ) -> None:
         self.block_size = block_size
+        # Context parallelism: a request's k-th context block comes from
+        # pool color k % num_stripes (= the cp rank holding that page).
+        self.num_stripes = num_stripes
         # Sliding-window models free blocks that fall fully out of the
         # window (reference: single_type_kv_cache_manager.py:507
         # SlidingWindowManager.remove_skipped_blocks) — prefix caching is
@@ -56,6 +60,7 @@ class KVCacheManager:
         self.block_pool = BlockPool(
             num_blocks, enable_caching,
             event_sink=event_sink, block_size=block_size,
+            num_colors=num_stripes,
         )
 
         self.req_to_blocks: dict[str, list[KVCacheBlock]] = {}
@@ -130,13 +135,17 @@ class KVCacheManager:
         )
 
         # Cache-hit blocks with ref 0 sit in the free queue; touching them
-        # consumes free capacity, so subtract them from the availability check.
-        num_evictable_hits = sum(
-            1 for b in new_computed_blocks if b.ref_cnt == 0 and not b.is_null
+        # consumes free capacity, so subtract them from the availability
+        # check (per color: a hit block occupies its own stripe's queue).
+        first_color = (
+            (len(req_blocks) + len(new_computed_blocks)) % self.num_stripes
         )
-        if (
-            num_new_blocks
-            > self.block_pool.get_num_free_blocks() - num_evictable_hits
+        evictable = [0] * self.num_stripes
+        for b in new_computed_blocks:
+            if b.ref_cnt == 0 and not b.is_null:
+                evictable[self.block_pool.color_of(b.block_id)] += 1
+        if num_new_blocks > 0 and not self.block_pool.can_allocate(
+            num_new_blocks, first_color, evictable
         ):
             return None
 
@@ -148,7 +157,9 @@ class KVCacheManager:
 
         new_blocks: list[KVCacheBlock] = []
         if num_new_blocks > 0:
-            new_blocks = self.block_pool.get_new_blocks(num_new_blocks)
+            new_blocks = self.block_pool.get_new_blocks(
+                num_new_blocks, first_color=len(req_blocks) % self.num_stripes
+            )
             req_blocks.extend(new_blocks)
 
         if self.enable_caching:

@@ -125,6 +125,7 @@ class Scheduler:
             block_size=cache_config.block_size,
             enable_caching=cache_config.enable_prefix_caching,
             sliding_window=cache_config.sliding_window,
+            num_stripes=cache_config.num_kv_stripes,
             event_sink=(
                 self.kv_event_publisher.record
                 if self.kv_event_publisher

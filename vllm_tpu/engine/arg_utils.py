@@ -110,6 +110,7 @@ class EngineArgs:
                 num_gpu_blocks_override=self.num_gpu_blocks_override,
                 enable_prefix_caching=self.enable_prefix_caching,
                 cache_dtype=self.kv_cache_dtype,
+                num_kv_stripes=self.context_parallel_size,
                 kv_connector=self.kv_connector,
                 kv_connector_cache_gb=self.kv_connector_cache_gb,
                 kv_events_endpoint=self.kv_events_endpoint,

@@ -141,8 +141,11 @@ class InputProcessor:
         # scheduled — the engine would spin on it forever. Reject upfront.
         cache = self.config.cache_config
         if cache.num_gpu_blocks is not None:
-            # Block 0 is the reserved null block (never allocatable).
-            capacity = (cache.num_gpu_blocks - 1) * cache.block_size
+            # Every pool stripe reserves its first block as a null page
+            # (one stripe = one null block when cp is off).
+            capacity = (
+                cache.num_gpu_blocks - cache.num_kv_stripes
+            ) * cache.block_size
             if len(prompt_token_ids) + 1 > capacity:
                 raise ValueError(
                     f"prompt ({len(prompt_token_ids)} tokens) exceeds total "

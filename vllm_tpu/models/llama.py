@@ -66,6 +66,11 @@ class LlamaForCausalLM:
     pp_size = 1
     pp_microbatches = 0  # 0 -> pp_size
     pp_mesh = None
+    # Context parallelism (set by the worker): the cache's block dim is
+    # sharded over the 'cp' mesh axis; attention runs striped + LSE-merged
+    # (``ops/cp_attention.cp_write_and_attend``).
+    cp_size = 1
+    cp_mesh = None
 
     def __init__(self, hf_config: Any, dtype=jnp.bfloat16,
                  quantization: str | None = None) -> None:
@@ -265,12 +270,23 @@ class LlamaForCausalLM:
             q = _apply_rotate_half(q, cos, sin, self.rope.rotary_dim)
             k = _apply_rotate_half(k, cos, sin, self.rope.rotary_dim)
 
-            kv = write_kv(kv, li, k, v, md.slot_mapping)
             kv_scale = kv_dequant_scale(kv)
-            attn = attn_fn(
-                q, kv, li, md, self.scale, sliding_window=self.sliding_window,
-                k_scale=kv_scale, v_scale=kv_scale,
-            )
+            if self.cp_size > 1:
+                from vllm_tpu.ops.cp_attention import cp_write_and_attend
+
+                kv, attn = cp_write_and_attend(
+                    kv, li, k, v, q, md, self.scale,
+                    mesh=self.cp_mesh,
+                    sliding_window=self.sliding_window,
+                    k_scale=kv_scale, v_scale=kv_scale,
+                )
+            else:
+                kv = write_kv(kv, li, k, v, md.slot_mapping)
+                attn = attn_fn(
+                    q, kv, li, md, self.scale,
+                    sliding_window=self.sliding_window,
+                    k_scale=kv_scale, v_scale=kv_scale,
+                )
             x = x + proj(attn.reshape(t, H * Dh), lp, "wo")
 
             h2 = rms_norm(x, lp["post_norm"], self.rms_eps)
@@ -479,9 +495,11 @@ class LlamaForCausalLM:
 
     def kv_cache_sharding(self, model_axis: str = "tp") -> P:
         """KV heads sharded over TP: [L, NB, BS, 2*KH(tp), Dh]; the layer
-        axis over 'pp' stages when pipelined."""
+        axis over 'pp' stages when pipelined; the block axis over 'cp'
+        under context parallelism (striped pool colors = cp ranks)."""
         lead = "pp" if self.pp_size > 1 else None
-        return P(lead, None, None, model_axis, None)
+        blocks = "cp" if self.cp_size > 1 else None
+        return P(lead, blocks, None, model_axis, None)
 
 
 class MistralForCausalLM(LlamaForCausalLM):

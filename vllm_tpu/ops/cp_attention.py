@@ -6,8 +6,14 @@ Reference analog: DCP — decode context parallelism (``vllm/distributed``
 ``v1/worker/cp_utils.py:30`` requires backends to return decode LSE).
 
 TPU-native formulation: the paged KV cache of a long sequence is striped
-round-robin across the ``cp`` mesh axis (global page ``g`` lives on rank
-``g % cp`` at local index ``g // cp``); queries are replicated over cp.
+round-robin across the ``cp`` mesh axis; queries are replicated over cp.
+Two placement conventions appear below — ``stripe_metadata``/
+``cp_paged_attention`` (standalone op): CONTEXT page k of a request on
+rank ``k % cp`` at local table column ``k // cp`` with first-come local
+slots; the engine path (``cp_write_and_attend`` + the color-striped
+BlockPool): global block id ``g`` resident on rank ``g // nb_local`` at
+local slot ``g % nb_local``, with the pool guaranteeing context position
+k gets an id of color ``k % cp``.
 Under ``shard_map`` each rank attends over its local pages only —
 emitting the partial output and its logsumexp — and the partials combine
 with three tiny collectives (pmax / psum / psum), never materializing the
@@ -47,6 +53,21 @@ def merge_attn_states(
     return out.astype(outs.dtype)
 
 
+def lse_merge_collective(
+    out: jnp.ndarray,  # [T, H, D] local partial (softmax-normalized)
+    lse: jnp.ndarray,  # [T, H] local logsumexp
+    axis_name: str,
+) -> jnp.ndarray:
+    """Cross-rank LSE-weighted merge (3 collectives); runs inside a
+    shard_map manual region. Fully-masked ranks (den 0) contribute 0."""
+    m = jax.lax.pmax(lse, axis_name)
+    w = jnp.exp(lse - m)
+    den = jax.lax.psum(w, axis_name)
+    num = jax.lax.psum(w[..., None] * out.astype(jnp.float32), axis_name)
+    merged = jnp.where(den[..., None] > 0, num / den[..., None], 0.0)
+    return merged.astype(out.dtype)
+
+
 def cp_paged_attention(
     q: jnp.ndarray,  # [T, H, D] (replicated over cp)
     kv_local: jnp.ndarray,  # [L, NB_local, BS, rows, lanes] this rank's shard
@@ -78,14 +99,92 @@ def cp_paged_attention(
         sliding_window=sliding_window, soft_cap=soft_cap,
         return_lse=True, ctx_stride=cp, ctx_phase=rank,
     )
-    m = jax.lax.pmax(lse, axis_name)  # [T, H]
-    w = jnp.exp(lse - m)
-    den = jax.lax.psum(w, axis_name)
-    num = jax.lax.psum(
-        w[..., None] * out.astype(jnp.float32), axis_name
-    )
-    merged = jnp.where(den[..., None] > 0, num / den[..., None], 0.0)
-    return merged.astype(q.dtype)
+    return lse_merge_collective(out, lse, axis_name).astype(q.dtype)
+
+
+def cp_write_and_attend(
+    kv_cache: jnp.ndarray,  # [L, NB, BS, rows, lanes], NB sharded over cp
+    layer: jnp.ndarray,
+    k: jnp.ndarray,  # [T, KH, D] (replicated over cp)
+    v: jnp.ndarray,
+    q: jnp.ndarray,  # [T, H, D]
+    md: AttentionMetadata,  # GLOBAL metadata (global block ids/slots)
+    scale: float,
+    *,
+    mesh,
+    axis: str = "cp",
+    sliding_window=None,
+    soft_cap: float | None = None,
+    k_scale: float | None = None,
+    v_scale: float | None = None,
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """One layer's KV insert + context-parallel attention, in-jit.
+
+    The engine path for CP (reference: DCP end-to-end wiring,
+    ``parallel_state.py:1608`` + ``cp_utils.py:30``): the cache's block dim
+    is GSPMD-sharded over the cp axis and the block pool is color-striped so a
+    request's k-th context block is id ``k%cp * NBl + j`` — i.e. column k
+    of the global block table always names a block resident on rank k%cp.
+    Inside a partial-manual shard_map each rank:
+
+    1. rewrites the global slot mapping to local slots, dropping writes it
+       does not own (scatter OOB drop);
+    2. builds its local block table (columns ``rank, rank+cp, ...``,
+       global id -> ``id % NBl``), so padding/null entries hit the rank's
+       reserved local null page 0;
+    3. runs local partial attention with ``ctx_stride/ctx_phase`` striped
+       positions and merges partials with the 3-collective LSE combine.
+
+    Returns ``(kv_cache, merged_out)`` with the same shardings in/out, so
+    it drops into a layer scan's donated-carry contract.
+    """
+    from jax.sharding import PartitionSpec as P
+
+    cp = mesh.shape[axis]
+    nl, nb, bs, rows, lanes = kv_cache.shape
+    nb_local = nb // cp
+    r, b = md.block_tables.shape
+    b_local = -(-b // cp)
+
+    def local_fn(kv_l, layer, k, v, q, md):
+        from vllm_tpu.ops.attention import write_kv
+        import dataclasses
+
+        rank = jax.lax.axis_index(axis)
+        # 1. Slot rewrite: global slot -> local, non-owned -> OOB (dropped).
+        g = md.slot_mapping // bs
+        off = md.slot_mapping % bs
+        owner = g // nb_local
+        local_slots = (g % nb_local) * bs + off
+        oob = nl * nb_local * bs  # beyond the whole flat buffer
+        slots = jnp.where(owner == rank, local_slots, oob)
+        kv_l = write_kv(kv_l, layer, k, v, slots)
+
+        # 2. Local block table: columns rank, rank+cp, ... of the global.
+        cols = jnp.arange(b_local) * cp + rank
+        valid = cols < b
+        gbt = md.block_tables[:, jnp.clip(cols, 0, b - 1)]
+        lbt = jnp.where(valid[None, :], gbt % nb_local, 0)
+        md_local = dataclasses.replace(md, block_tables=lbt)
+
+        # 3. Striped-position partial attention + LSE merge.
+        out, lse = ref_ragged_paged_attention(
+            q, kv_l, layer, md_local, scale,
+            sliding_window=sliding_window, soft_cap=soft_cap,
+            k_scale=k_scale, v_scale=v_scale,
+            return_lse=True, ctx_stride=cp, ctx_phase=rank,
+        )
+        return kv_l, lse_merge_collective(out, lse, axis).astype(q.dtype)
+
+    kv_spec = P(None, axis, None, None, None)
+    return jax.shard_map(
+        local_fn,
+        mesh=mesh,
+        in_specs=(kv_spec, P(), P(), P(), P(), P()),
+        out_specs=(kv_spec, P()),
+        axis_names=frozenset({axis}),
+        check_vma=False,
+    )(kv_cache, layer, k, v, q, md)
 
 
 def stripe_metadata(block_tables, cp: int):

@@ -213,6 +213,27 @@ class Worker:
             # the concrete mesh.
             self.model.expert_parallel = True
             self.model.ep_mesh = self.mesh
+        if pc.context_parallel_size > 1:
+            from vllm_tpu.models.llama import LlamaForCausalLM
+
+            if getattr(type(self.model), "apply", None) is not LlamaForCausalLM.apply:
+                raise ValueError(
+                    f"{type(self.model).__name__} does not support context "
+                    "parallelism yet (Llama-family only)"
+                )
+            if pc.pipeline_parallel_size > 1:
+                raise ValueError("cp x pp composition is not supported yet")
+            if self.config.speculative_config.enabled:
+                # The draft KV cache is sized with the cp-multiplied global
+                # block count but carries no cp sharding axis — each device
+                # would hold cp x the budgeted draft bytes.
+                raise ValueError(
+                    "context parallelism with speculative decoding is not "
+                    "supported yet"
+                )
+            assert self.mesh is not None, "cp requires a device mesh"
+            self.model.cp_size = pc.context_parallel_size
+            self.model.cp_mesh = self.mesh
         if pc.pipeline_parallel_size > 1:
             from vllm_tpu.models.llama import LlamaForCausalLM
 
@@ -351,7 +372,14 @@ class Worker:
         fallback when it does not (v5e over the tunnel).
         """
         cache = self.config.cache_config
+        cp = self.config.parallel_config.context_parallel_size
         if cache.num_gpu_blocks_override is not None:
+            if cache.num_gpu_blocks_override % max(cp, 1):
+                raise ValueError(
+                    f"num_gpu_blocks_override "
+                    f"({cache.num_gpu_blocks_override}) must be divisible "
+                    f"by context_parallel_size ({cp})"
+                )
             return cache.num_gpu_blocks_override
 
         kv_dtype = (
@@ -435,13 +463,18 @@ class Worker:
                 f"activations={activation_bytes})"
             )
         kv_config = get_kv_cache_config_from_specs(specs, int(free_for_kv))
+        num_blocks = kv_config.num_blocks
+        if cp > 1:
+            # The budget above is PER DEVICE and the cache's block dim is
+            # cp-sharded: the global pool holds cp x the per-device count.
+            num_blocks *= cp
         logger.info(
             "KV sizing: %.2f GiB free -> %d blocks of %d tokens",
             free_for_kv / 2**30,
-            kv_config.num_blocks,
+            num_blocks,
             cache.block_size,
         )
-        return kv_config.num_blocks
+        return num_blocks
 
     def initialize(self) -> int:
         """Full startup; returns the KV block count for the scheduler."""
