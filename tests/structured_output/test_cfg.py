@@ -1,0 +1,293 @@
+"""CFG-class structured output: EBNF grammars and recursive JSON schemas.
+
+Reference analog: xgrammar's CFG compilation
+(``vllm/v1/structured_output/backend_xgrammar.py:35``). The TPU build
+expands recursion depth-bounded into the finite device mask table;
+unsupported constructs fail loudly (no silent any-JSON downgrade).
+"""
+
+from __future__ import annotations
+
+import json
+
+import pytest
+
+from vllm_tpu.structured_output.ebnf import GrammarError, ebnf_to_regex
+from vllm_tpu.structured_output.fsm import DFA
+from vllm_tpu.structured_output.json_schema import (
+    SchemaError,
+    build_regex_from_schema,
+)
+
+
+def _matches(regex: str, text: str) -> bool:
+    dfa = DFA(regex)
+    return dfa.is_accept(dfa.walk(0, text))
+
+
+# ----------------------------------------------------------------------
+# EBNF
+# ----------------------------------------------------------------------
+
+ARITH = r"""
+# classic recursive arithmetic expressions
+root ::= expr
+expr ::= term (("+" | "-") term)*
+term ::= factor (("*" | "/") factor)*
+factor ::= num | "(" expr ")"
+num ::= [0-9]+
+"""
+
+
+def test_ebnf_arithmetic_recursion():
+    regex = ebnf_to_regex(ARITH, max_depth=3)
+    for good in ("1", "1+2", "3*(4+5)", "((1+2))*3", "10/2-4"):
+        assert _matches(regex, good), good
+    for bad in ("", "1+", "(1", "a+b", "1++2"):
+        assert not _matches(regex, bad), bad
+    # Depth bound: 3 re-entries of expr allows ((..)) but not ((((..)))).
+    assert not _matches(regex, "((((1))))")
+
+
+def test_ebnf_literals_classes_quantifiers():
+    g = r"""
+    root ::= greeting " "? name{1,2}
+    greeting ::= "hi" | 'hey'
+    name ::= [A-Z][a-z]+
+    """
+    regex = ebnf_to_regex(g)
+    assert _matches(regex, "hi Bob")
+    assert _matches(regex, "heyBobAnn")
+    assert not _matches(regex, "hello Bob")
+    assert not _matches(regex, "hi bob")
+
+
+def test_ebnf_escapes_and_comments():
+    g = 'root ::= "a\\nb" x*  # trailing comment\nx ::= "!"'
+    regex = ebnf_to_regex(g)
+    assert _matches(regex, "a\nb")
+    assert _matches(regex, "a\nb!!")
+
+
+def test_ebnf_json_grammar():
+    """A JSON value grammar in EBNF — the canonical CFG example."""
+    g = r"""
+    root ::= value
+    value ::= object | array | string | number | "true" | "false" | "null"
+    object ::= "{" (pair ("," pair)*)? "}"
+    pair ::= string ":" value
+    array ::= "[" (value ("," value)*)? "]"
+    string ::= "\"" [a-z]* "\""
+    number ::= [0-9]+
+    """
+    regex = ebnf_to_regex(g, max_depth=4)
+    for good in ('{"a":1}', '[1,2,3]', '{"k":{"n":[1,"x"]}}', "true"):
+        assert _matches(regex, good), good
+    for bad in ('{"a":}', "[1,", "tru"):
+        assert not _matches(regex, bad), bad
+
+
+def test_ebnf_errors():
+    with pytest.raises(GrammarError, match="root"):
+        ebnf_to_regex('start ::= "a"')
+    with pytest.raises(GrammarError, match="undefined"):
+        ebnf_to_regex("root ::= missing")
+    with pytest.raises(GrammarError, match="unsatisfiable"):
+        # Every branch recurses: empty language at any finite depth.
+        ebnf_to_regex("root ::= x\nx ::= x", max_depth=3)
+
+
+def test_ebnf_multiline_rule():
+    g = 'root ::= "a"\n  | "b"\n  | "c"'
+    regex = ebnf_to_regex(g)
+    assert all(_matches(regex, c) for c in "abc")
+    assert not _matches(regex, "d")
+
+
+# ----------------------------------------------------------------------
+# Recursive JSON schemas ($ref / $defs)
+# ----------------------------------------------------------------------
+
+TREE_SCHEMA = {
+    "$defs": {
+        "node": {
+            "type": "object",
+            "properties": {
+                "value": {"type": "integer"},
+                "children": {
+                    "type": "array",
+                    "items": {"$ref": "#/$defs/node"},
+                },
+            },
+            "required": ["value"],
+        }
+    },
+    "$ref": "#/$defs/node",
+}
+
+
+def test_recursive_schema_tree():
+    regex = build_regex_from_schema(TREE_SCHEMA, max_depth=3)
+    good = {"value": 1, "children": [{"value": 2, "children": [{"value": 3}]}]}
+    assert _matches(regex, json.dumps(good, separators=(",", ":")))
+    assert _matches(regex, '{"value":7}')
+    assert not _matches(regex, '{"children":[]}')  # missing required
+
+
+def test_recursive_schema_depth_bound():
+    regex = build_regex_from_schema(TREE_SCHEMA, max_depth=2)
+    deep = {"value": 1}
+    for _ in range(4):
+        deep = {"value": 1, "children": [deep]}
+    assert not _matches(regex, json.dumps(deep, separators=(",", ":")))
+
+
+def test_self_referential_root():
+    schema = {
+        "type": "object",
+        "properties": {
+            "name": {"type": "string"},
+            "next": {"$ref": "#"},
+        },
+        "required": ["name"],
+    }
+    regex = build_regex_from_schema(schema, max_depth=3)
+    assert _matches(regex, '{"name":"a","next":{"name":"b"}}')
+    assert _matches(regex, '{"name":"a"}')
+
+
+def test_definitions_legacy_path():
+    schema = {
+        "definitions": {"s": {"type": "string"}},
+        "type": "array",
+        "items": {"$ref": "#/definitions/s"},
+    }
+    regex = build_regex_from_schema(schema)
+    assert _matches(regex, '["a","b"]')
+    assert not _matches(regex, "[1]")
+
+
+# ----------------------------------------------------------------------
+# Optional properties, allOf, bounds
+# ----------------------------------------------------------------------
+
+def test_optional_properties_elision():
+    schema = {
+        "type": "object",
+        "properties": {
+            "a": {"type": "integer"},
+            "b": {"type": "string"},
+            "c": {"type": "boolean"},
+        },
+        "required": ["b"],
+    }
+    regex = build_regex_from_schema(schema)
+    assert _matches(regex, '{"a":1,"b":"x","c":true}')
+    assert _matches(regex, '{"b":"x"}')
+    assert _matches(regex, '{"a":1,"b":"x"}')
+    assert _matches(regex, '{"b":"x","c":false}')
+    assert not _matches(regex, '{"a":1}')  # required b missing
+    assert not _matches(regex, '{"c":true,"b":"x"}')  # declaration order
+
+
+def test_all_optional_properties():
+    schema = {
+        "type": "object",
+        "properties": {"x": {"type": "integer"}, "y": {"type": "integer"}},
+    }
+    regex = build_regex_from_schema(schema)
+    for good in ("{}", '{"x":1}', '{"y":2}', '{"x":1,"y":2}'):
+        assert _matches(regex, good), good
+    assert not _matches(regex, '{"y":2,"x":1}')
+
+
+def test_allof_merge():
+    schema = {
+        "allOf": [
+            {"type": "object", "properties": {"a": {"type": "integer"}},
+             "required": ["a"]},
+        ]
+    }
+    regex = build_regex_from_schema(schema)
+    assert _matches(regex, '{"a":3}')
+
+
+def test_max_items():
+    schema = {"type": "array", "items": {"type": "integer"}, "maxItems": 2}
+    regex = build_regex_from_schema(schema)
+    for good in ("[]", "[1]", "[1,2]"):
+        assert _matches(regex, good)
+    assert not _matches(regex, "[1,2,3]")
+
+
+# ----------------------------------------------------------------------
+# Loud failures — no silent any-JSON downgrade
+# ----------------------------------------------------------------------
+
+def test_unsupported_constructs_raise():
+    with pytest.raises(SchemaError, match="not"):
+        build_regex_from_schema({"not": {"type": "string"}})
+    with pytest.raises(SchemaError, match="patternProperties"):
+        build_regex_from_schema(
+            {"type": "object", "patternProperties": {".*": {}}}
+        )
+    with pytest.raises(SchemaError, match="external"):
+        build_regex_from_schema(
+            {"$ref": "https://example.com/schema.json"}
+        )
+    with pytest.raises(SchemaError, match="unresolvable"):
+        build_regex_from_schema({"$ref": "#/$defs/missing"})
+    with pytest.raises(SchemaError, match="unrecognized"):
+        build_regex_from_schema({"definitelyNotASchemaKey": 1})
+
+
+def test_unsatisfiable_recursion_raises():
+    schema = {
+        "$defs": {"n": {"type": "object",
+                        "properties": {"next": {"$ref": "#/$defs/n"}},
+                        "required": ["next"]}},
+        "$ref": "#/$defs/n",
+    }
+    with pytest.raises(SchemaError, match="unsatisfiable"):
+        build_regex_from_schema(schema, max_depth=3)
+
+
+def test_refinements_warn_not_fail():
+    regex = build_regex_from_schema(
+        {"type": "integer", "minimum": 3}
+    )
+    assert _matches(regex, "7")  # base type enforced, bound warned
+
+
+# ----------------------------------------------------------------------
+# E2E: EBNF-constrained generation through the engine
+# ----------------------------------------------------------------------
+
+def test_guided_ebnf_e2e(tmp_path_factory):
+    from tests.models.utils import tiny_llama_dir_with_tokenizer
+    from vllm_tpu import LLM, SamplingParams
+    from vllm_tpu.sampling_params import StructuredOutputParams
+
+    path = tiny_llama_dir_with_tokenizer(
+        tmp_path_factory.mktemp("tiny_ebnf")
+    )
+    llm = LLM(
+        model=path, dtype="float32", max_model_len=64, block_size=16,
+        num_gpu_blocks_override=32, max_num_seqs=4,
+        max_num_batched_tokens=64,
+    )
+    # ':' not '=' — the tiny test tokenizer's vocab has no '=' character.
+    g = r"""
+    root ::= pair ("," pair){0,2}
+    pair ::= [a-z]{1,3} ":" [0-9]{1,2}
+    """
+    sp = SamplingParams(
+        temperature=0.8, seed=7, max_tokens=24,
+        structured_outputs=StructuredOutputParams(grammar=g),
+    )
+    out = llm.generate(["cfg: "], sp)[0].outputs[0].text
+    import re as _re
+
+    assert _re.fullmatch(
+        r"[a-z]{1,3}:[0-9]{1,2}(,[a-z]{1,3}:[0-9]{1,2}){0,2}", out
+    ), out

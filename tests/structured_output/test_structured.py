@@ -86,9 +86,15 @@ def test_eos_only_in_accept_states(vocab):
     ({"type": "array", "items": {"type": "integer"}},
      ["[]", "[1]", "[1, 2, 3]"], ["[", "[1,]"]),
     ({"type": "object",
-      "properties": {"name": {"type": "string"}, "age": {"type": "integer"}}},
+      "properties": {"name": {"type": "string"}, "age": {"type": "integer"}},
+      "required": ["name", "age"]},
      ['{"name": "ab", "age": 3}', '{"name":"x","age":0}'],
      ['{"age": 3, "name": "ab"}', '{}']),
+    # Without "required", properties are optional (elidable in order).
+    ({"type": "object",
+      "properties": {"name": {"type": "string"}, "age": {"type": "integer"}}},
+     ['{"name": "ab", "age": 3}', '{"age": 3}', '{}'],
+     ['{"age": 3, "name": "ab"}']),
 ])
 def test_schema_regex_accepts(schema, good, bad):
     rx = build_regex_from_schema(schema)
@@ -162,6 +168,7 @@ def test_guided_json_schema_e2e(llm):
             "ok": {"type": "boolean"},
             "color": {"enum": ["red", "green"]},
         },
+        "required": ["ok", "color"],
     }
     outs = llm.generate(
         ["give me json:"],

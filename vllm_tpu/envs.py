@@ -49,6 +49,11 @@ _readers: dict[str, Callable[[], Any]] = {
     "VLLM_TPU_COMPILE_CACHE_DIR": _str("VLLM_TPU_COMPILE_CACHE_DIR", None),
     # LRU size bound for the persistent compilation cache directory.
     "VLLM_TPU_COMPILE_CACHE_MAX_GB": _int("VLLM_TPU_COMPILE_CACHE_MAX_GB", 32),
+    # Structured output: max recursion re-entries per rule/$ref when
+    # expanding context-free grammars (EBNF) and recursive JSON schemas
+    # into the finite device mask table. Deeper nesting becomes
+    # unreachable (never silently loosened).
+    "VLLM_TPU_GRAMMAR_MAX_DEPTH": _int("VLLM_TPU_GRAMMAR_MAX_DEPTH", 6),
     # Profiling
     "VLLM_TPU_PROFILER_DIR": _str("VLLM_TPU_PROFILER_DIR", None),
     # Per-step host/device time breakdown accumulated in ModelRunner.timing.

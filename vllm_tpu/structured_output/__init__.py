@@ -43,8 +43,11 @@ def spec_to_regex(so: StructuredOutputParams) -> str:
             return any_json_value_regex()
         return build_regex_from_schema(so.json_schema)
     if so.grammar is not None:
-        raise ValueError(
-            "EBNF grammars are not supported; use regex/json_schema/choice"
+        from vllm_tpu import envs
+        from vllm_tpu.structured_output.ebnf import ebnf_to_regex
+
+        return ebnf_to_regex(
+            so.grammar, max_depth=envs.VLLM_TPU_GRAMMAR_MAX_DEPTH
         )
     raise ValueError("empty structured output spec")
 

@@ -1,0 +1,261 @@
+"""EBNF (GBNF-dialect) grammar -> regex via depth-bounded expansion.
+
+Reference analog: the CFG half of xgrammar
+(``vllm/v1/structured_output/backend_xgrammar.py:35`` compiles EBNF to
+token bitmasks with a pushdown automaton). The TPU build keeps its
+device-resident finite mask-table design, so context-free recursion is
+compiled by DEPTH-BOUNDED EXPANSION: recursive rule references inline up
+to ``max_depth`` re-entries per rule; an alternation branch that would
+recurse deeper is dropped (its language beyond the bound becomes
+unreachable, never silently replaced by something looser). If every
+branch of a rule dies, compilation fails with a clear error — the request
+fails, not the engine, and never degrades to an unconstrained mask.
+
+Supported syntax (the llama.cpp GBNF core, which xgrammar also accepts):
+
+    root  ::= expr                  # rules; 'root' is the start symbol
+    expr  ::= term ("+" term)*      # sequence, grouping, alternation
+    term  ::= num | "(" expr ")"    # recursion (depth-bounded)
+    num   ::= [0-9]+                # char classes, escapes, literals
+    s     ::= "a" | 'b'             # double- or single-quoted literals
+    x     ::= y? z* w+ v{1,3}       # the usual quantifiers
+
+Comments run ``#`` to end of line. ``::=`` and ``=`` both bind rules.
+"""
+
+from __future__ import annotations
+
+import re
+
+from vllm_tpu.structured_output.json_schema import _escape_literal
+
+
+class GrammarError(ValueError):
+    """Malformed or unsupported EBNF; fails the request, not the engine."""
+
+
+_RULE_RE = re.compile(r"^\s*([A-Za-z_][A-Za-z0-9_-]*)\s*(::=|=)\s*(.*)$")
+_TOKEN_RE = re.compile(
+    r"""
+    \s*(
+        "(?:\\.|[^"\\])*"          # double-quoted literal
+      | '(?:\\.|[^'\\])*'          # single-quoted literal
+      | \[(?:\\.|[^\]\\])*\]       # char class
+      | [A-Za-z_][A-Za-z0-9_-]*    # rule reference
+      | \{\d+(?:,\d*)?\}           # {m} {m,} {m,n}
+      | [()|*+?]
+    )""",
+    re.VERBOSE,
+)
+
+_ESCAPES = {"n": "\n", "t": "\t", "r": "\r", '"': '"', "'": "'", "\\": "\\"}
+
+
+def _unescape(body: str) -> str:
+    out, i = [], 0
+    while i < len(body):
+        c = body[i]
+        if c == "\\" and i + 1 < len(body):
+            nxt = body[i + 1]
+            if nxt == "x" and i + 3 < len(body):
+                out.append(chr(int(body[i + 2 : i + 4], 16)))
+                i += 4
+                continue
+            out.append(_ESCAPES.get(nxt, nxt))
+            i += 2
+            continue
+        out.append(c)
+        i += 1
+    return "".join(out)
+
+
+def _strip_comment(line: str) -> str:
+    """Drop '#'-to-EOL comments, but not '#' inside quoted literals or
+    char classes (grammars for hashtags/hex colors are valid)."""
+    quote = None  # None | '"' | "'" | "["
+    i = 0
+    while i < len(line):
+        c = line[i]
+        if c == "\\" and quote is not None:
+            i += 2
+            continue
+        if quote is None:
+            if c == "#":
+                return line[:i]
+            if c in "\"'[":
+                quote = c
+        elif (quote == "[" and c == "]") or c == quote:
+            quote = None
+        i += 1
+    return line
+
+
+def _tokenize(src: str) -> list[str]:
+    toks, i = [], 0
+    while i < len(src):
+        m = _TOKEN_RE.match(src, i)
+        if m is None:
+            if src[i:].strip() == "":
+                break
+            raise GrammarError(f"EBNF syntax error at {src[i:i + 20]!r}")
+        toks.append(m.group(1))
+        i = m.end()
+    return toks
+
+
+# ---- AST: ("seq", [..]) ("alt", [..]) ("rep", node, lo, hi|None)
+#      ("lit", text) ("class", raw) ("ref", name)
+
+
+def _parse_rules(grammar: str) -> dict[str, tuple]:
+    rules: dict[str, tuple] = {}
+    # Join continuation lines: a line that doesn't bind a rule extends the
+    # previous rule's body.
+    pending_name, pending_body = None, []
+    for raw_line in grammar.splitlines():
+        line = _strip_comment(raw_line).rstrip()
+        if not line.strip():
+            continue
+        m = _RULE_RE.match(line)
+        if m and m.group(1) and (m.group(2)):
+            if pending_name is not None:
+                rules[pending_name] = _parse_expr(
+                    _tokenize(" ".join(pending_body)), pending_name
+                )
+            pending_name = m.group(1)
+            pending_body = [m.group(3)]
+        else:
+            if pending_name is None:
+                raise GrammarError(f"EBNF line outside a rule: {line!r}")
+            pending_body.append(line)
+    if pending_name is not None:
+        rules[pending_name] = _parse_expr(
+            _tokenize(" ".join(pending_body)), pending_name
+        )
+    if "root" not in rules:
+        raise GrammarError("EBNF grammar must define a 'root' rule")
+    return rules
+
+
+def _parse_expr(toks: list[str], rule: str) -> tuple:
+    node, rest = _parse_alt(toks, 0, rule)
+    if rest != len(toks):
+        raise GrammarError(f"trailing tokens in rule {rule!r}: {toks[rest:]}")
+    return node
+
+
+def _parse_alt(toks, i, rule):
+    branches = []
+    node, i = _parse_seq(toks, i, rule)
+    branches.append(node)
+    while i < len(toks) and toks[i] == "|":
+        node, i = _parse_seq(toks, i + 1, rule)
+        branches.append(node)
+    return (("alt", branches) if len(branches) > 1 else branches[0]), i
+
+
+def _parse_seq(toks, i, rule):
+    parts = []
+    while i < len(toks) and toks[i] not in ("|", ")"):
+        node, i = _parse_atom(toks, i, rule)
+        # Postfix quantifiers.
+        while i < len(toks) and (
+            toks[i] in ("*", "+", "?") or toks[i].startswith("{")
+        ):
+            q = toks[i]
+            i += 1
+            if q == "*":
+                node = ("rep", node, 0, None)
+            elif q == "+":
+                node = ("rep", node, 1, None)
+            elif q == "?":
+                node = ("rep", node, 0, 1)
+            else:
+                spec = q[1:-1]
+                if "," in spec:
+                    lo_s, hi_s = spec.split(",", 1)
+                    node = ("rep", node, int(lo_s),
+                            int(hi_s) if hi_s else None)
+                else:
+                    node = ("rep", node, int(spec), int(spec))
+        parts.append(node)
+    return (("seq", parts) if len(parts) != 1 else parts[0]), i
+
+
+def _parse_atom(toks, i, rule):
+    t = toks[i]
+    if t == "(":
+        node, i = _parse_alt(toks, i + 1, rule)
+        if i >= len(toks) or toks[i] != ")":
+            raise GrammarError(f"unbalanced '(' in rule {rule!r}")
+        return node, i + 1
+    if t[0] in "\"'":
+        return ("lit", _unescape(t[1:-1])), i + 1
+    if t[0] == "[":
+        return ("class", t), i + 1
+    if t in (")", "|", "*", "+", "?") or t.startswith("{"):
+        raise GrammarError(f"unexpected {t!r} in rule {rule!r}")
+    return ("ref", t), i + 1
+
+
+# ---- depth-bounded expansion to a regex string ----
+
+
+def ebnf_to_regex(grammar: str, max_depth: int = 6) -> str:
+    """Expand the grammar's ``root`` rule to a regex. Recursive references
+    re-enter each rule at most ``max_depth`` times; deeper branches are
+    dropped (None), and a rule whose every branch drops raises."""
+    rules = _parse_rules(grammar)
+
+    def expand(node, stack: tuple) -> str | None:
+        kind = node[0]
+        if kind == "lit":
+            return _escape_literal(node[1])
+        if kind == "class":
+            return node[1]
+        if kind == "ref":
+            name = node[1]
+            if name not in rules:
+                raise GrammarError(f"undefined rule {name!r}")
+            depth = sum(1 for n in stack if n == name)
+            if depth >= max_depth:
+                return None  # beyond the bound: branch dies
+            return expand(rules[name], stack + (name,))
+        if kind == "seq":
+            parts = []
+            for child in node[1]:
+                r = expand(child, stack)
+                if r is None:
+                    return None  # a dead factor kills the sequence
+                parts.append(r)
+            return "(" + "".join(parts) + ")" if parts else "()"
+        if kind == "alt":
+            branches = [expand(c, stack) for c in node[1]]
+            live = [b for b in branches if b is not None]
+            if not live:
+                return None
+            return "(" + "|".join(live) + ")"
+        if kind == "rep":
+            _, child, lo, hi = node
+            r = expand(child, stack)
+            if r is None:
+                # X{0,..} of a dead body still matches empty.
+                return "()" if lo == 0 else None
+            if lo == 0 and hi is None:
+                return f"({r})*"
+            if lo == 1 and hi is None:
+                return f"({r})+"
+            if lo == 0 and hi == 1:
+                return f"({r})?"
+            hi_s = "" if hi is None else str(hi)
+            return f"({r}){{{lo},{hi_s}}}" if hi != lo else f"({r}){{{lo}}}"
+        raise AssertionError(node)
+
+    out = expand(("ref", "root"), ())
+    if out is None:
+        raise GrammarError(
+            f"grammar is unsatisfiable within the recursion bound "
+            f"(max_depth={max_depth}): every branch of 'root' recurses "
+            "deeper; raise VLLM_TPU_GRAMMAR_MAX_DEPTH or restructure"
+        )
+    return out
