@@ -103,6 +103,9 @@ class CacheConfig:
     # reloading evicted prefixes; seam for disaggregated prefill).
     kv_connector: str | None = None
     kv_connector_cache_gb: float = 4.0
+    # "host:port" of the shared KV block store (kv_connector="remote"):
+    # the disaggregated-prefill transport between engines.
+    kv_connector_url: str | None = None
     # KV-cache event publishing endpoint (ZMQ PUB, e.g. tcp://*:5557) for
     # cache-aware routers; None disables (reference: kv_events.py).
     kv_events_endpoint: str | None = None

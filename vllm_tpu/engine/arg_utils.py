@@ -45,6 +45,7 @@ class EngineArgs:
     kv_cache_dtype: str = "auto"
     kv_connector: str | None = None
     kv_connector_cache_gb: float = 4.0
+    kv_connector_url: str | None = None
     kv_events_endpoint: str | None = None
 
     max_num_batched_tokens: int = 8192
@@ -113,6 +114,7 @@ class EngineArgs:
                 num_kv_stripes=self.context_parallel_size,
                 kv_connector=self.kv_connector,
                 kv_connector_cache_gb=self.kv_connector_cache_gb,
+                kv_connector_url=self.kv_connector_url,
                 kv_events_endpoint=self.kv_events_endpoint,
             ),
             parallel_config=ParallelConfig(

@@ -57,6 +57,7 @@ class EngineCore:
         self.kv_connector = make_kv_connector(
             config.cache_config.kv_connector,
             config.cache_config.kv_connector_cache_gb,
+            config.cache_config.kv_connector_url,
         )
         if (
             self.kv_connector is not None

@@ -18,21 +18,26 @@ plugs into the same seam.
 from vllm_tpu.kv_connector.base import KVConnectorBase
 from vllm_tpu.kv_connector.host_offload import HostOffloadKVConnector
 
-_CONNECTORS = {
-    "host_offload": HostOffloadKVConnector,
-}
 
-
-def make_kv_connector(name: str | None, cache_gb: float = 4.0):
+def make_kv_connector(
+    name: str | None, cache_gb: float = 4.0, url: str | None = None
+):
     if name is None:
         return None
-    try:
-        return _CONNECTORS[name](max_bytes=int(cache_gb * (1 << 30)))
-    except KeyError:
-        raise ValueError(
-            f"unknown kv connector {name!r}; available: "
-            f"{sorted(_CONNECTORS)}"
-        ) from None
+    if name == "host_offload":
+        return HostOffloadKVConnector(max_bytes=int(cache_gb * (1 << 30)))
+    if name == "remote":
+        from vllm_tpu.kv_connector.remote import RemoteKVConnector
+
+        if not url:
+            raise ValueError(
+                "kv_connector='remote' needs kv_connector_url='host:port'"
+            )
+        return RemoteKVConnector(url)
+    raise ValueError(
+        f"unknown kv connector {name!r}; available: "
+        "['host_offload', 'remote']"
+    )
 
 
 __all__ = ["KVConnectorBase", "HostOffloadKVConnector", "make_kv_connector"]

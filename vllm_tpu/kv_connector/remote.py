@@ -1,0 +1,330 @@
+"""TCP KV-store connector: disaggregated prefill over the network.
+
+Reference analog: ``vllm/distributed/kv_transfer/kv_connector/v1/``
+(NIXL/P2P connectors driving P->D disaggregation, ``base.py:170,299,450``).
+The TPU build's transport is a content-addressed block store over TCP
+(DCN-class links between TPU hosts): a PREFILL engine computes a prompt,
+persists its full KV blocks to the store at request finish
+(``request_finished`` -> worker ``save_blocks``); a DECODE engine admitting
+the same prompt sees the store hit via ``get_num_new_matched_tokens`` and
+DMAs the blocks into its paged cache instead of recomputing the prefill.
+Both engines speak the same connector; the store itself is a small
+threaded server (embed via ``KVStoreServer`` or run standalone with
+``python -m vllm_tpu.kv_connector.remote --port 7788``).
+
+Wire format (trusted-network assumption, like the reference's RDMA/NCCL
+transports — no auth): length-prefixed frames, each a JSON header
+(op/keys/dtypes/shapes) followed by raw array bytes.
+"""
+
+from __future__ import annotations
+
+import json
+import socket
+import struct
+import threading
+import time
+from collections import OrderedDict
+from typing import Any, Sequence
+
+import numpy as np
+
+from vllm_tpu.kv_connector.base import KVConnectorBase
+from vllm_tpu.logger import init_logger
+
+logger = init_logger(__name__)
+
+
+def _send_frame(sock: socket.socket, header: dict, blobs: list[bytes]) -> None:
+    hdr = json.dumps(header).encode()
+    # 8-byte frame length: a batched flush of large-model KV blocks can
+    # exceed 4 GiB.
+    total = 4 + len(hdr) + sum(len(b) for b in blobs)
+    sock.sendall(struct.pack(">Q", total))
+    sock.sendall(struct.pack(">I", len(hdr)))
+    sock.sendall(hdr)
+    for b in blobs:
+        sock.sendall(b)
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes:
+    buf = bytearray()
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            raise ConnectionError("kv store connection closed")
+        buf.extend(chunk)
+    return bytes(buf)
+
+
+def _recv_frame(sock: socket.socket) -> tuple[dict, bytes]:
+    (total,) = struct.unpack(">Q", _recv_exact(sock, 8))
+    payload = _recv_exact(sock, total)
+    (hlen,) = struct.unpack(">I", payload[:4])
+    header = json.loads(payload[4 : 4 + hlen])
+    return header, payload[4 + hlen :]
+
+
+def _pack_arrays(arrays) -> tuple[list[str], list[list[int]], list[bytes]]:
+    dtypes, shapes, blobs = [], [], []
+    for a in arrays:
+        a = np.ascontiguousarray(a)
+        dtypes.append(str(a.dtype))
+        shapes.append(list(a.shape))
+        blobs.append(a.tobytes())
+    return dtypes, shapes, blobs
+
+
+def _unpack_arrays(header: dict, body: bytes) -> list[np.ndarray]:
+    out, off = [], 0
+    for dt, shape in zip(header["dtypes"], header["shapes"]):
+        dtype = np.dtype(dt)
+        n = int(np.prod(shape)) * dtype.itemsize
+        out.append(
+            np.frombuffer(body[off : off + n], dtype=dtype).reshape(shape)
+        )
+        off += n
+    return out
+
+
+class KVStoreServer:
+    """Threaded content-addressed block store with LRU eviction.
+
+    A successful ``query`` LEASES the matched entries for ``lease_s``
+    seconds: eviction skips unexpired leases, closing the race where a
+    decode engine counts a store hit and a concurrent put evicts the
+    blocks before its worker loads them (the budget may transiently
+    overshoot while leases are live)."""
+
+    def __init__(
+        self, host: str = "127.0.0.1", port: int = 0,
+        max_bytes: int = 4 << 30, lease_s: float = 60.0,
+    ) -> None:
+        self.max_bytes = max_bytes
+        self.lease_s = lease_s
+        self._store: OrderedDict[str, np.ndarray] = OrderedDict()
+        self._leases: dict[str, float] = {}  # key -> expiry monotonic
+        self._bytes = 0
+        self._lock = threading.Lock()
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._sock.bind((host, port))
+        self._sock.listen(16)
+        self.host, self.port = self._sock.getsockname()
+        self._running = True
+        self._accept_thread = threading.Thread(
+            target=self._accept_loop, daemon=True
+        )
+
+    def start(self) -> "KVStoreServer":
+        self._accept_thread.start()
+        logger.info("KV store serving on %s:%d", self.host, self.port)
+        return self
+
+    def shutdown(self) -> None:
+        self._running = False
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+    # ------------------------------------------------------------------
+
+    def _accept_loop(self) -> None:
+        while self._running:
+            try:
+                conn, _ = self._sock.accept()
+            except OSError:
+                return
+            threading.Thread(
+                target=self._serve_conn, args=(conn,), daemon=True
+            ).start()
+
+    def _serve_conn(self, conn: socket.socket) -> None:
+        try:
+            conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            while True:
+                header, body = _recv_frame(conn)
+                self._handle(conn, header, body)
+        except (ConnectionError, OSError):
+            pass
+        finally:
+            conn.close()
+
+    def _handle(self, conn, header: dict, body: bytes) -> None:
+        op = header["op"]
+        keys = header.get("keys", [])
+        if op == "query":
+            with self._lock:
+                found = [k in self._store for k in keys]
+                expiry = time.monotonic() + self.lease_s
+                for k in keys:
+                    if k in self._store:
+                        self._store.move_to_end(k)
+                        self._leases[k] = expiry
+            _send_frame(conn, {"found": found}, [])
+        elif op == "missing":
+            with self._lock:
+                idx = [i for i, k in enumerate(keys) if k not in self._store]
+            _send_frame(conn, {"missing": idx}, [])
+        elif op == "put":
+            arrays = _unpack_arrays(header, body)
+            with self._lock:
+                for k, a in zip(keys, arrays):
+                    if k in self._store:
+                        continue
+                    # Own the memory: frombuffer views would pin the whole
+                    # received frame past eviction and break accounting.
+                    a = np.array(a, copy=True)
+                    self._store[k] = a
+                    self._bytes += a.nbytes
+                now = time.monotonic()
+                skipped: list[tuple[str, np.ndarray]] = []
+                while self._bytes > self.max_bytes and self._store:
+                    k, ev = self._store.popitem(last=False)
+                    if self._leases.get(k, 0) > now:
+                        skipped.append((k, ev))  # leased: hold eviction
+                        continue
+                    self._leases.pop(k, None)
+                    self._bytes -= ev.nbytes
+                for k, ev in reversed(skipped):
+                    # Leased survivors go back to the LRU head.
+                    self._store[k] = ev
+                    self._store.move_to_end(k, last=False)
+            _send_frame(conn, {"ok": True}, [])
+        elif op == "get":
+            with self._lock:
+                try:
+                    arrays = [self._store[k] for k in keys]
+                except KeyError as exc:
+                    _send_frame(conn, {"error": f"missing key {exc}"}, [])
+                    return
+                for k in keys:
+                    self._store.move_to_end(k)
+            dtypes, shapes, blobs = _pack_arrays(arrays)
+            _send_frame(
+                conn, {"dtypes": dtypes, "shapes": shapes}, blobs
+            )
+        elif op == "stats":
+            with self._lock:
+                _send_frame(
+                    conn,
+                    {"blocks": len(self._store), "bytes": self._bytes},
+                    [],
+                )
+        else:
+            _send_frame(conn, {"error": f"unknown op {op!r}"}, [])
+
+
+class RemoteKVConnector(KVConnectorBase):
+    """Client half: both the prefill and decode engines point at the same
+    store URL ("host:port")."""
+
+    def __init__(self, url: str) -> None:
+        host, _, port = url.rpartition(":")
+        self.addr = (host or "127.0.0.1", int(port))
+        self._sock: socket.socket | None = None
+        self._lock = threading.Lock()
+        self.queries = 0
+        self.hits = 0
+
+    # -- transport -----------------------------------------------------
+
+    def _rpc(self, header: dict, blobs: list[bytes]) -> tuple[dict, bytes]:
+        with self._lock:
+            if self._sock is None:
+                self._sock = socket.create_connection(self.addr, timeout=30)
+                self._sock.setsockopt(
+                    socket.IPPROTO_TCP, socket.TCP_NODELAY, 1
+                )
+            try:
+                _send_frame(self._sock, header, blobs)
+                return _recv_frame(self._sock)
+            except (ConnectionError, OSError):
+                # One reconnect attempt (store restarts are survivable).
+                try:
+                    self._sock.close()
+                except OSError:
+                    pass
+                self._sock = socket.create_connection(self.addr, timeout=30)
+                _send_frame(self._sock, header, blobs)
+                return _recv_frame(self._sock)
+
+    @staticmethod
+    def _hex(keys: Sequence[Any]) -> list[str]:
+        return [
+            k.hex() if isinstance(k, (bytes, bytearray)) else str(k)
+            for k in keys
+        ]
+
+    # -- scheduler side ------------------------------------------------
+
+    def get_num_new_matched_tokens(
+        self, block_hashes: Sequence[Any], num_device_computed_tokens: int,
+        block_size: int,
+    ) -> int:
+        start = num_device_computed_tokens // block_size
+        keys = self._hex(list(block_hashes)[start:])
+        self.queries += 1
+        if not keys:
+            return 0
+        header, _ = self._rpc({"op": "query", "keys": keys}, [])
+        n = 0
+        for found in header["found"]:
+            if not found:
+                break
+            n += 1
+        if n:
+            self.hits += 1
+        return n * block_size
+
+    def request_finished(self, block_hashes: Sequence[Any]) -> list[int]:
+        keys = self._hex(block_hashes)
+        if not keys:
+            return []
+        header, _ = self._rpc({"op": "missing", "keys": keys}, [])
+        return list(header["missing"])
+
+    # -- worker side ---------------------------------------------------
+
+    def save_blocks(self, keys: Sequence[Any], payloads) -> None:
+        dtypes, shapes, blobs = _pack_arrays(payloads)
+        self._rpc(
+            {
+                "op": "put", "keys": self._hex(keys),
+                "dtypes": dtypes, "shapes": shapes,
+            },
+            blobs,
+        )
+
+    def load_blocks(self, keys: Sequence[Any]):
+        header, body = self._rpc({"op": "get", "keys": self._hex(keys)}, [])
+        if "error" in header:
+            raise KeyError(header["error"])
+        return _unpack_arrays(header, body)
+
+    def stats(self) -> dict:
+        header, _ = self._rpc({"op": "stats"}, [])
+        header.update(queries=self.queries, hits=self.hits)
+        return header
+
+
+def main() -> None:  # pragma: no cover - CLI utility
+    import argparse
+
+    p = argparse.ArgumentParser(description="standalone KV block store")
+    p.add_argument("--host", default="0.0.0.0")
+    p.add_argument("--port", type=int, default=7788)
+    p.add_argument("--cache-gb", type=float, default=16.0)
+    args = p.parse_args()
+    server = KVStoreServer(
+        args.host, args.port, max_bytes=int(args.cache_gb * (1 << 30))
+    ).start()
+    try:
+        threading.Event().wait()
+    except KeyboardInterrupt:
+        server.shutdown()
+
+
+if __name__ == "__main__":  # pragma: no cover
+    main()

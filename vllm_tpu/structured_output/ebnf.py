@@ -201,11 +201,32 @@ def _parse_atom(toks, i, rule):
 # ---- depth-bounded expansion to a regex string ----
 
 
-def ebnf_to_regex(grammar: str, max_depth: int = 6) -> str:
+MAX_EXPANSION_CHARS = 1 << 19  # 512 KiB of regex
+
+
+def ebnf_to_regex(
+    grammar: str, max_depth: int = 6,
+    max_chars: int = MAX_EXPANSION_CHARS,
+) -> str:
     """Expand the grammar's ``root`` rule to a regex. Recursive references
     re-enter each rule at most ``max_depth`` times; deeper branches are
-    dropped (None), and a rule whose every branch drops raises."""
+    dropped (None), and a rule whose every branch drops raises.
+
+    ``max_chars`` bounds the expansion size: grammars are request-
+    controlled, and a non-recursive doubling chain (x0 ::= x1 x1; ...)
+    blows up exponentially without ever tripping the depth bound."""
     rules = _parse_rules(grammar)
+    budget = [max_chars]
+
+    def spend(r: str | None) -> str | None:
+        if r is not None:
+            budget[0] -= len(r)
+            if budget[0] < 0:
+                raise GrammarError(
+                    f"grammar expansion exceeds {max_chars} chars; "
+                    "simplify the grammar or lower the recursion depth"
+                )
+        return r
 
     def expand(node, stack: tuple) -> str | None:
         kind = node[0]
@@ -228,7 +249,7 @@ def ebnf_to_regex(grammar: str, max_depth: int = 6) -> str:
                 if r is None:
                     return None  # a dead factor kills the sequence
                 parts.append(r)
-            return "(" + "".join(parts) + ")" if parts else "()"
+            return spend("(" + "".join(parts) + ")" if parts else "()")
         if kind == "alt":
             branches = [expand(c, stack) for c in node[1]]
             live = [b for b in branches if b is not None]

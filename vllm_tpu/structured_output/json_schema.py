@@ -87,11 +87,18 @@ def any_json_value_regex(depth: int = 3) -> str:
     return value
 
 
+MAX_EXPANSION_CHARS = 1 << 19  # 512 KiB of regex
+
+
 class _Compiler:
     def __init__(self, root: dict[str, Any], max_depth: int) -> None:
         self.root = root
         self.max_depth = max_depth
         self.warned: set[str] = set()
+        # Expansion-size budget: schemas are request-controlled, and a
+        # non-recursive doubling chain of $refs blows up exponentially
+        # without tripping the depth bound.
+        self.budget = MAX_EXPANSION_CHARS
 
     # -- $ref ----------------------------------------------------------
 
@@ -128,6 +135,18 @@ class _Compiler:
     # language is empty within the recursion bound (dead branch).
 
     def node(self, s: Any, stack: tuple = ()) -> str | None:
+        out = self._node(s, stack)
+        if out is not None:
+            self.budget -= len(out)
+            if self.budget < 0:
+                raise SchemaError(
+                    f"schema expansion exceeds {MAX_EXPANSION_CHARS} "
+                    "chars; simplify the schema or lower "
+                    "VLLM_TPU_GRAMMAR_MAX_DEPTH"
+                )
+        return out
+
+    def _node(self, s: Any, stack: tuple = ()) -> str | None:
         if s is True or s == {}:
             return any_json_value_regex()
         if s is False:
@@ -143,6 +162,17 @@ class _Compiler:
         self._warn(s)
 
         if "$ref" in s:
+            annotations = {"title", "description", "default", "examples",
+                           "$schema", "$id", "$defs", "definitions"}
+            siblings = set(s) - annotations - {"$ref"}
+            if siblings:
+                # Draft 2019-09 applies $ref siblings as constraints;
+                # dropping them would loosen the language. Loud per the
+                # module contract.
+                raise SchemaError(
+                    f"$ref with sibling constraint keys "
+                    f"{sorted(siblings)} is not supported"
+                )
             ref = s["$ref"]
             depth = sum(1 for r in stack if r == ref)
             if depth >= self.max_depth:
