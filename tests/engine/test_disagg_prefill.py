@@ -57,7 +57,7 @@ def test_remote_store_roundtrip(store):
     conn_a.save_blocks(keys, payloads)
     assert conn_a.request_finished(keys) == []
 
-    # The other client sees a 2-block contiguous prefix if k3 evicted...
+    # The other client sees the full 3-block contiguous prefix.
     assert conn_b.get_num_new_matched_tokens(keys, 0, 16) == 48
     got = conn_b.load_blocks(keys)
     for want, have in zip(payloads, got):
@@ -116,6 +116,23 @@ def test_disaggregated_prefill_two_engines(ckpt, store):
     sched = d_engine.llm_engine.engine_core.engine_core.scheduler
     req_stats = sched.kv_cache_manager.prefix_cache_stats
     assert req_stats.queries > 0
+
+
+def test_store_outage_degrades_to_miss(ckpt):
+    """A dead store must degrade to recompute, never crash the engine."""
+    server = KVStoreServer(max_bytes=1 << 26).start()
+    llm = _mk(ckpt, server)
+    rng = np.random.default_rng(3)
+    prompt = {"prompt_token_ids": rng.integers(5, 120, size=32).tolist()}
+    sp = SamplingParams(temperature=0.0, max_tokens=4, ignore_eos=True)
+    first = llm.generate([prompt], sp)[0].outputs[0].token_ids
+    server.shutdown()  # store dies mid-service (live connections cut too)
+    # Nuke the device prefix cache so the engine must consult the store.
+    assert llm.llm_engine.engine_core.engine_core.reset_prefix_cache()
+    again = llm.generate([prompt], sp)[0].outputs[0].token_ids
+    assert again == first
+    conn = llm.llm_engine.engine_core.engine_core.kv_connector
+    assert conn.outages >= 1
 
 
 def test_store_eviction_under_pressure(ckpt):

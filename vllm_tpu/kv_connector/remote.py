@@ -112,6 +112,7 @@ class KVStoreServer:
         self._sock.listen(16)
         self.host, self.port = self._sock.getsockname()
         self._running = True
+        self._conns: list[socket.socket] = []
         self._accept_thread = threading.Thread(
             target=self._accept_loop, daemon=True
         )
@@ -127,6 +128,11 @@ class KVStoreServer:
             self._sock.close()
         except OSError:
             pass
+        for conn in self._conns:
+            try:
+                conn.close()
+            except OSError:
+                pass
 
     # ------------------------------------------------------------------
 
@@ -136,6 +142,7 @@ class KVStoreServer:
                 conn, _ = self._sock.accept()
             except OSError:
                 return
+            self._conns.append(conn)
             threading.Thread(
                 target=self._serve_conn, args=(conn,), daemon=True
             ).start()
@@ -226,6 +233,7 @@ class RemoteKVConnector(KVConnectorBase):
         self._sock: socket.socket | None = None
         self._lock = threading.Lock()
         self.queries = 0
+        self.outages = 0
         self.hits = 0
 
     # -- transport -----------------------------------------------------
@@ -268,7 +276,13 @@ class RemoteKVConnector(KVConnectorBase):
         self.queries += 1
         if not keys:
             return 0
-        header, _ = self._rpc({"op": "query", "keys": keys}, [])
+        try:
+            header, _ = self._rpc({"op": "query", "keys": keys}, [])
+        except (ConnectionError, OSError) as exc:
+            # A dead store degrades to a cache miss (recompute), never an
+            # engine crash.
+            self._outage(exc)
+            return 0
         n = 0
         for found in header["found"]:
             if not found:
@@ -282,22 +296,43 @@ class RemoteKVConnector(KVConnectorBase):
         keys = self._hex(block_hashes)
         if not keys:
             return []
-        header, _ = self._rpc({"op": "missing", "keys": keys}, [])
+        try:
+            header, _ = self._rpc({"op": "missing", "keys": keys}, [])
+        except (ConnectionError, OSError) as exc:
+            self._outage(exc)
+            return []  # persist nothing while the store is down
         return list(header["missing"])
+
+    def _outage(self, exc: Exception) -> None:
+        self.outages += 1
+        if self.outages <= 3 or self.outages % 100 == 0:
+            logger.warning(
+                "KV store %s unreachable (%s); degrading to cache miss "
+                "(%d outages)", self.addr, exc, self.outages,
+            )
 
     # -- worker side ---------------------------------------------------
 
     def save_blocks(self, keys: Sequence[Any], payloads) -> None:
         dtypes, shapes, blobs = _pack_arrays(payloads)
-        self._rpc(
-            {
-                "op": "put", "keys": self._hex(keys),
-                "dtypes": dtypes, "shapes": shapes,
-            },
-            blobs,
-        )
+        try:
+            self._rpc(
+                {
+                    "op": "put", "keys": self._hex(keys),
+                    "dtypes": dtypes, "shapes": shapes,
+                },
+                blobs,
+            )
+        except (ConnectionError, OSError) as exc:
+            self._outage(exc)  # lost persistence is recomputable
 
     def load_blocks(self, keys: Sequence[Any]):
+        """Unlike the scheduler-side calls, a load failure must RAISE: the
+        scheduler already marked these tokens computed, so silent zeros
+        would corrupt output. Leasing makes this unreachable short of a
+        store death between hit accounting and load (the reference's
+        invalid-block rescheduling, scheduler.py:2123, is the eventual
+        recovery path)."""
         header, body = self._rpc({"op": "get", "keys": self._hex(keys)}, [])
         if "error" in header:
             raise KeyError(header["error"])

@@ -1,0 +1,467 @@
+"""Bamba: hybrid Mamba2 + attention decoder (Jamba-class hybrid).
+
+Reference analog: ``vllm/model_executor/models/bamba.py`` and the hybrid
+KV coordination in ``vllm/v1/core/kv_cache_coordinator.py:392``
+(HybridKVCacheCoordinator: paged full-attention groups + constant-size
+Mamba groups in one model). The TPU realization keeps ONE donated cache
+pytree with both kinds of state::
+
+    {"paged": [L_attn, NB, BS, rows, lanes],   # attention layers
+     "conv":  [L_mamba, S, conv_dim, K-1],     # per-request slots
+     "ssm":   [L_mamba, S, H, P, N]}           # S = max_num_seqs
+
+Attention layers index the paged cache by their position among attention
+layers; Mamba layers read/write the request's stable state slot
+(``md.state_slots``, runner-assigned). HF semantics follow
+``transformers/models/bamba/modeling_bamba.py``: every layer is
+input_layernorm -> (mamba | attention) -> residual -> pre_ff_layernorm ->
+SwiGLU MLP -> residual; attention uses GQA with partial rotary
+(``partial_rotary_factor``).
+
+The layer stack is heterogeneous, so ``apply`` unrolls a Python loop over
+per-layer param subtrees (``layers.{i}.*``) instead of a ``lax.scan`` —
+the reference's per-layer module list, traded against the stacked-scan
+trick used by homogeneous models.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from vllm_tpu.core.kv_cache_utils import FullAttentionSpec, KVCacheSpec
+from vllm_tpu.layers.activation import silu_and_mul
+from vllm_tpu.layers.layernorm import rms_norm
+from vllm_tpu.layers.rotary import RotaryEmbedding, _apply_rotate_half
+from vllm_tpu.logger import init_logger
+from vllm_tpu.ops.attention import (
+    AttentionMetadata,
+    kv_cache_shape,
+    kv_dequant_scale,
+    paged_attention,
+    write_kv,
+)
+from vllm_tpu.ops.mamba import ragged_causal_conv, ragged_ssd_scan
+
+logger = init_logger(__name__)
+
+
+class BambaForCausalLM:
+    supports_lora = False
+    enable_lora = False
+    # Hybrid: paged attention KV + per-request Mamba slots; the worker
+    # disables prefix caching (SSM state is not content-addressable) and
+    # tells the runner to ship md.state_slots.
+    is_hybrid_ssm = True
+    # Set by the worker before alloc_kv_cache: number of Mamba state
+    # slots (= scheduler max_num_seqs).
+    max_state_slots = 256
+
+    def __init__(self, hf_config: Any, dtype=jnp.bfloat16,
+                 quantization: str | None = None) -> None:
+        if quantization:
+            logger.warning(
+                "weight quantization is not yet supported for hybrid SSM "
+                "models; running %s unquantized", type(self).__name__,
+            )
+        c = hf_config
+        self.hf_config = c
+        self.dtype = dtype
+        self.quantization = None
+        self.num_layers = c.num_hidden_layers
+        self.hidden_size = c.hidden_size
+        self.intermediate_size = c.intermediate_size
+        self.vocab_size = c.vocab_size
+        self.rms_eps = c.rms_norm_eps
+        self.tie_embeddings = getattr(c, "tie_word_embeddings", False)
+        self.max_position = getattr(c, "max_position_embeddings", 8192)
+        self.sliding_window = None
+
+        # Attention geometry.
+        self.num_heads = c.num_attention_heads
+        self.num_kv_heads = getattr(
+            c, "num_key_value_heads", c.num_attention_heads
+        )
+        self.head_dim = (
+            getattr(c, "head_dim", None) or c.hidden_size // self.num_heads
+        )
+        self.scale = self.head_dim ** -0.5
+        attn_idx = getattr(c, "attn_layer_indices", None) or []
+        self.attn_layer_indices = sorted(attn_idx)
+        if not self.attn_layer_indices:
+            raise ValueError(
+                "BambaForCausalLM needs attn_layer_indices (a pure-Mamba "
+                "stack should use Mamba2ForCausalLM)"
+            )
+        self.num_attn_layers = len(self.attn_layer_indices)
+        self.mamba_layer_indices = [
+            i for i in range(self.num_layers)
+            if i not in set(self.attn_layer_indices)
+        ]
+        rotary_dim = int(
+            self.head_dim * getattr(c, "partial_rotary_factor", 0.5)
+        )
+        self.rope = RotaryEmbedding(
+            head_dim=self.head_dim,
+            max_position=self.max_position,
+            theta=getattr(c, "rope_theta", 10000.0),
+            rope_scaling=getattr(c, "rope_scaling", None),
+            rotary_dim=rotary_dim,
+        )
+
+        # Mamba mixer geometry (HF BambaMixer == Mamba2Mixer semantics).
+        self.m_heads = c.mamba_n_heads  # H
+        self.m_head_dim = c.mamba_d_head  # P
+        self.state_size = c.mamba_d_state  # N
+        self.n_groups = c.mamba_n_groups  # G
+        self.conv_kernel = c.mamba_d_conv  # K
+        self.m_intermediate = int(c.mamba_expand * c.hidden_size)  # I
+        assert self.m_intermediate == self.m_heads * self.m_head_dim
+        self.conv_dim = (
+            self.m_intermediate + 2 * self.n_groups * self.state_size
+        )
+        self.use_conv_bias = getattr(c, "mamba_conv_bias", True)
+        lo, hi = getattr(c, "time_step_limit", (0.0, float("inf")))
+        self.dt_limit = (float(lo), float(hi))
+
+    # ------------------------------------------------------------------
+    # Params (per-layer subtrees: the stack is heterogeneous)
+    # ------------------------------------------------------------------
+
+    def _attn_layer_dummy(self, key, dtype):
+        D, H, KH, Dh = (
+            self.hidden_size, self.num_heads, self.num_kv_heads,
+            self.head_dim,
+        )
+        ks = jax.random.split(key, 4)
+
+        def init(k, shape, fan_in):
+            return (
+                jax.random.normal(k, shape, jnp.float32) / math.sqrt(fan_in)
+            ).astype(dtype)
+
+        return {
+            "wq": init(ks[0], (D, H * Dh), D),
+            "wk": init(ks[1], (D, KH * Dh), D),
+            "wv": init(ks[2], (D, KH * Dh), D),
+            "wo": init(ks[3], (H * Dh, D), H * Dh),
+        }
+
+    def _mamba_layer_dummy(self, key, dtype):
+        D, I, H = self.hidden_size, self.m_intermediate, self.m_heads
+        ks = jax.random.split(key, 3)
+
+        def init(k, shape, fan_in):
+            return (
+                jax.random.normal(k, shape, jnp.float32) / math.sqrt(fan_in)
+            ).astype(dtype)
+
+        out = {
+            "in_proj": init(ks[0], (D, I + self.conv_dim + H), D),
+            "conv_w": init(ks[1], (self.conv_dim, self.conv_kernel), 4),
+            "dt_bias": jnp.zeros((H,), dtype),
+            "a_log": jnp.zeros((H,), jnp.float32),
+            "d_skip": jnp.ones((H,), dtype),
+            "gated_norm": jnp.ones((I,), dtype),
+            "out_proj": init(ks[2], (I, D), I),
+        }
+        if self.use_conv_bias:
+            out["conv_b"] = jnp.zeros((self.conv_dim,), dtype)
+        return out
+
+    def init_dummy_params(self, rng: jax.Array, dtype=None) -> dict:
+        dtype = dtype or self.dtype
+        D, F = self.hidden_size, self.intermediate_size
+        keys = jax.random.split(rng, self.num_layers + 4)
+
+        def init(k, shape, fan_in):
+            return (
+                jax.random.normal(k, shape, jnp.float32) / math.sqrt(fan_in)
+            ).astype(dtype)
+
+        attn_set = set(self.attn_layer_indices)
+        layers: dict[str, dict] = {}
+        for i in range(self.num_layers):
+            mixer = (
+                self._attn_layer_dummy(keys[i], dtype)
+                if i in attn_set
+                else self._mamba_layer_dummy(keys[i], dtype)
+            )
+            ks = jax.random.split(jax.random.fold_in(keys[i], 7), 3)
+            layers[str(i)] = {
+                **mixer,
+                "input_norm": jnp.ones((D,), dtype),
+                "post_norm": jnp.ones((D,), dtype),
+                "wgate": init(ks[0], (D, F), D),
+                "wup": init(ks[1], (D, F), D),
+                "wdown": init(ks[2], (F, D), F),
+            }
+        params = {
+            "embed": init(keys[-1], (self.vocab_size, D), D),
+            "layers": layers,
+            "final_norm": jnp.ones((D,), dtype),
+        }
+        if not self.tie_embeddings:
+            params["lm_head"] = init(keys[-2], (D, self.vocab_size), D)
+        return params
+
+    def hf_weight_map(self) -> dict:
+        m = {
+            "model.embed_tokens.weight": ("embed", False),
+            "model.final_layernorm.weight": ("final_norm", False),
+        }
+        if not self.tie_embeddings:
+            m["lm_head.weight"] = ("lm_head", True)
+        attn_set = set(self.attn_layer_indices)
+        for i in range(self.num_layers):
+            hf = f"model.layers.{i}"
+            base = f"layers.{i}"
+            m[f"{hf}.input_layernorm.weight"] = (f"{base}.input_norm", False)
+            m[f"{hf}.pre_ff_layernorm.weight"] = (f"{base}.post_norm", False)
+            m[f"{hf}.feed_forward.gate_proj.weight"] = (f"{base}.wgate", True)
+            m[f"{hf}.feed_forward.up_proj.weight"] = (f"{base}.wup", True)
+            m[f"{hf}.feed_forward.down_proj.weight"] = (f"{base}.wdown", True)
+            if i in attn_set:
+                m[f"{hf}.self_attn.q_proj.weight"] = (f"{base}.wq", True)
+                m[f"{hf}.self_attn.k_proj.weight"] = (f"{base}.wk", True)
+                m[f"{hf}.self_attn.v_proj.weight"] = (f"{base}.wv", True)
+                m[f"{hf}.self_attn.o_proj.weight"] = (f"{base}.wo", True)
+            else:
+                m[f"{hf}.mamba.in_proj.weight"] = (f"{base}.in_proj", True)
+                m[f"{hf}.mamba.conv1d.weight"] = (f"{base}.conv_w", False)
+                m[f"{hf}.mamba.dt_bias"] = (f"{base}.dt_bias", False)
+                m[f"{hf}.mamba.A_log"] = (f"{base}.a_log", False)
+                m[f"{hf}.mamba.D"] = (f"{base}.d_skip", False)
+                m[f"{hf}.mamba.norm.weight"] = (f"{base}.gated_norm", False)
+                m[f"{hf}.mamba.out_proj.weight"] = (f"{base}.out_proj", True)
+                if self.use_conv_bias:
+                    m[f"{hf}.mamba.conv1d.bias"] = (f"{base}.conv_b", False)
+        return m
+
+    def postprocess_weight(self, leaf_path: str, arr):
+        if leaf_path.endswith(".conv_w"):
+            return arr.squeeze(1)  # [C, 1, K] -> [C, K]
+        if leaf_path.endswith(".a_log"):
+            import numpy as np
+
+            return arr.astype(np.float32)
+        return arr
+
+    def load_params(self, path: str, dtype=None, shardings=None) -> dict:
+        from vllm_tpu.models.loader import load_safetensors_params
+
+        return load_safetensors_params(self, path, dtype or self.dtype, shardings)
+
+    # ------------------------------------------------------------------
+    # Forward
+    # ------------------------------------------------------------------
+
+    def apply(
+        self,
+        params: dict,
+        kv_cache: dict,  # {"paged", "conv", "ssm"}
+        input_ids: jnp.ndarray,  # [T]
+        md: AttentionMetadata,
+        token_lora_slot: jnp.ndarray | None = None,  # unused
+    ) -> tuple[jnp.ndarray, dict]:
+        x = params["embed"][input_ids].astype(self.dtype)
+        t = x.shape[0]
+        H, KH, Dh = self.num_heads, self.num_kv_heads, self.head_dim
+        I, MH, Pd, N, G = (
+            self.m_intermediate, self.m_heads, self.m_head_dim,
+            self.state_size, self.n_groups,
+        )
+        paged, conv_c, ssm_c = (
+            kv_cache["paged"], kv_cache["conv"], kv_cache["ssm"]
+        )
+        assert md.state_slots is not None, "hybrid model needs state slots"
+        slots = md.state_slots  # [R]
+        first_pos = md.positions[jnp.clip(md.query_start_loc[:-1], 0, t - 1)]
+        fresh = first_pos == 0  # [R] fresh sequences seed zero state
+
+        rope_cos, rope_sin = self.rope.cos, self.rope.sin
+        kv_scale = kv_dequant_scale(paged)
+
+        def attn_layer(x, lp, attn_li):
+            nonlocal paged
+            h = rms_norm(x, lp["input_norm"], self.rms_eps)
+            q = (h @ lp["wq"]).reshape(t, H, Dh)
+            k = (h @ lp["wk"]).reshape(t, KH, Dh)
+            v = (h @ lp["wv"]).reshape(t, KH, Dh)
+            cos = rope_cos[md.positions][:, None, :]
+            sin = rope_sin[md.positions][:, None, :]
+            q = _apply_rotate_half(q, cos, sin, self.rope.rotary_dim)
+            k = _apply_rotate_half(k, cos, sin, self.rope.rotary_dim)
+            li = jnp.int32(attn_li)
+            paged = write_kv(paged, li, k, v, md.slot_mapping)
+            attn = paged_attention(
+                q, paged, li, md, self.scale,
+                k_scale=kv_scale, v_scale=kv_scale,
+            )
+            return x + attn.reshape(t, H * Dh) @ lp["wo"]
+
+        def mamba_layer(x, lp, m_li):
+            nonlocal conv_c, ssm_c
+            h = rms_norm(x, lp["input_norm"], self.rms_eps)
+            proj = h @ lp["in_proj"]
+            gate = proj[:, :I]
+            x_bc = proj[:, I : I + self.conv_dim]
+            dt_raw = proj[:, I + self.conv_dim :]  # [T, MH]
+
+            conv_seed = jnp.where(
+                fresh[:, None, None], 0.0, conv_c[m_li, slots]
+            )
+            x_bc_conv, new_conv = ragged_causal_conv(
+                x_bc, conv_seed, lp["conv_w"], lp.get("conv_b"),
+                md.token_req_idx, md.query_start_loc,
+            )
+            x_bc_conv = jax.nn.silu(x_bc_conv.astype(jnp.float32))
+
+            xs = x_bc_conv[:, :I].reshape(t, MH, Pd)
+            b = x_bc_conv[:, I : I + G * N].reshape(t, G, N)
+            c = x_bc_conv[:, I + G * N :].reshape(t, G, N)
+            rep = MH // G
+            b = jnp.repeat(b, rep, axis=1)
+            c = jnp.repeat(c, rep, axis=1)
+
+            dt = jax.nn.softplus(
+                dt_raw.astype(jnp.float32)
+                + lp["dt_bias"].astype(jnp.float32)
+            )
+            dt = jnp.clip(dt, self.dt_limit[0], self.dt_limit[1])
+
+            ssm_seed = jnp.where(
+                fresh[:, None, None, None], 0.0, ssm_c[m_li, slots]
+            )
+            y, new_ssm = ragged_ssd_scan(
+                xs, dt, lp["a_log"].astype(jnp.float32), b, c, ssm_seed,
+                md.token_req_idx, md.query_start_loc,
+            )
+            y = y + lp["d_skip"].astype(y.dtype)[None, :, None] * xs
+            yf = y.reshape(t, I).astype(jnp.float32)
+            yf = yf * jax.nn.silu(gate.astype(jnp.float32))
+            yf = rms_norm(yf, lp["gated_norm"], self.rms_eps).astype(self.dtype)
+            conv_c = conv_c.at[m_li, slots].set(new_conv)
+            ssm_c = ssm_c.at[m_li, slots].set(new_ssm)
+            return x + yf @ lp["out_proj"]
+
+        attn_set = set(self.attn_layer_indices)
+        attn_li = m_li = 0
+        for i in range(self.num_layers):
+            lp = params["layers"][str(i)]
+            if i in attn_set:
+                x = attn_layer(x, lp, attn_li)
+                attn_li += 1
+            else:
+                x = mamba_layer(x, lp, m_li)
+                m_li += 1
+            h2 = rms_norm(x, lp["post_norm"], self.rms_eps)
+            gate_up = jnp.concatenate([h2 @ lp["wgate"], h2 @ lp["wup"]], -1)
+            x = x + silu_and_mul(gate_up) @ lp["wdown"]
+
+        x = rms_norm(x, params["final_norm"], self.rms_eps)
+        return x, {"paged": paged, "conv": conv_c, "ssm": ssm_c}
+
+    def compute_logits(self, params: dict, hidden: jnp.ndarray) -> jnp.ndarray:
+        head = params["embed"].T if self.tie_embeddings else params["lm_head"]
+        return (hidden @ head.astype(hidden.dtype)).astype(jnp.float32)
+
+    # ------------------------------------------------------------------
+    # Runner contracts
+    # ------------------------------------------------------------------
+
+    def get_kv_cache_spec(
+        self, block_size: int, dtype_bytes: int
+    ) -> dict[str, KVCacheSpec]:
+        """Paged specs for the ATTENTION layers only; the constant-size
+        Mamba state is budgeted separately via fixed_state_bytes()."""
+        spec = FullAttentionSpec(
+            block_size=block_size,
+            num_kv_heads=self.num_kv_heads,
+            head_size=self.head_dim,
+            dtype_bytes=dtype_bytes,
+        )
+        return {f"layers.{i}": spec for i in self.attn_layer_indices}
+
+    def fixed_state_bytes(self, max_slots: int) -> int:
+        per_slot = 4 * (
+            self.conv_dim * (self.conv_kernel - 1)
+            + self.m_heads * self.m_head_dim * self.state_size
+        )
+        return len(self.mamba_layer_indices) * (max_slots + 1) * per_slot
+
+    def alloc_kv_cache(self, num_blocks: int, block_size: int, dtype) -> dict:
+        lm, k = len(self.mamba_layer_indices), self.conv_kernel
+        # +1: the last slot is scratch for padding rows (the runner points
+        # dead rows at it so their garbage writes never hit a live slot).
+        s = self.max_state_slots + 1
+        return {
+            "paged": jnp.zeros(
+                kv_cache_shape(
+                    self.num_attn_layers, num_blocks, block_size,
+                    self.num_kv_heads, self.head_dim,
+                ),
+                dtype,
+            ),
+            "conv": jnp.zeros((lm, s, self.conv_dim, self.conv_kernel - 1),
+                              jnp.float32),
+            "ssm": jnp.zeros(
+                (lm, s, self.m_heads, self.m_head_dim, self.state_size),
+                jnp.float32,
+            ),
+        }
+
+    def param_shardings(self, data_axis: str | None = None,
+                        model_axis: str = "tp") -> dict:
+        """Attention + MLP shard Megatron-style over tp; the Mamba mixer
+        stays replicated (in_proj interleaves gate/xBC/dt segments — a
+        segment-aware split is future work, mirroring the reference's
+        Mamba TP gap)."""
+        tp = model_axis
+        attn_set = set(self.attn_layer_indices)
+        layers: dict[str, dict] = {}
+        for i in range(self.num_layers):
+            lp: dict[str, P] = {
+                "input_norm": P(None),
+                "post_norm": P(None),
+                "wgate": P(None, tp),
+                "wup": P(None, tp),
+                "wdown": P(tp, None),
+            }
+            if i in attn_set:
+                lp |= {
+                    "wq": P(None, tp), "wk": P(None, tp),
+                    "wv": P(None, tp), "wo": P(tp, None),
+                }
+            else:
+                lp |= {
+                    "in_proj": P(None, None),
+                    "conv_w": P(None, None),
+                    "dt_bias": P(None),
+                    "a_log": P(None),
+                    "d_skip": P(None),
+                    "gated_norm": P(None),
+                    "out_proj": P(None, None),
+                }
+                if self.use_conv_bias:
+                    lp["conv_b"] = P(None)
+            layers[str(i)] = lp
+        out = {
+            "embed": P(None, tp),
+            "layers": layers,
+            "final_norm": P(None),
+        }
+        if not self.tie_embeddings:
+            out["lm_head"] = P(None, tp)
+        return out
+
+    def kv_cache_sharding(self, model_axis: str = "tp") -> dict:
+        return {
+            "paged": P(None, None, None, model_axis, None),
+            "conv": P(None, None, None, None),
+            "ssm": P(None, None, None, None, None),
+        }

@@ -61,6 +61,11 @@ class AttentionMetadata:
     num_common_prefix_blocks: int = field(
         default=0, metadata=dict(static=True)
     )
+    # Hybrid attention+SSM models (Jamba/Bamba-class): per-request state
+    # slot for the constant-size Mamba caches ([R] i32; None for pure
+    # attention models). Reference: HybridKVCacheCoordinator per-type
+    # groups (``kv_cache_coordinator.py:392``).
+    state_slots: jnp.ndarray | None = None
 
 
 def packed_kv_layout(head_dim: int) -> bool:

@@ -201,7 +201,7 @@ def _parse_atom(toks, i, rule):
 # ---- depth-bounded expansion to a regex string ----
 
 
-MAX_EXPANSION_CHARS = 1 << 19  # 512 KiB of regex
+MAX_EXPANSION_CHARS = 1 << 22  # 4 MiB of cumulative construction work
 
 
 def ebnf_to_regex(
@@ -212,9 +212,11 @@ def ebnf_to_regex(
     re-enter each rule at most ``max_depth`` times; deeper branches are
     dropped (None), and a rule whose every branch drops raises.
 
-    ``max_chars`` bounds the expansion size: grammars are request-
-    controlled, and a non-recursive doubling chain (x0 ::= x1 x1; ...)
-    blows up exponentially without ever tripping the depth bound."""
+    ``max_chars`` bounds CUMULATIVE construction work (every composite
+    node's output is charged, so a leaf counts once per ancestor): the
+    real DoS vector is work done, and grammars are request-controlled —
+    a doubling chain (x0 ::= x1 x1 / x0 ::= x1 | x1) blows up
+    exponentially without ever tripping the depth bound."""
     rules = _parse_rules(grammar)
     budget = [max_chars]
 
@@ -255,7 +257,7 @@ def ebnf_to_regex(
             live = [b for b in branches if b is not None]
             if not live:
                 return None
-            return "(" + "|".join(live) + ")"
+            return spend("(" + "|".join(live) + ")")
         if kind == "rep":
             _, child, lo, hi = node
             r = expand(child, stack)
@@ -263,13 +265,15 @@ def ebnf_to_regex(
                 # X{0,..} of a dead body still matches empty.
                 return "()" if lo == 0 else None
             if lo == 0 and hi is None:
-                return f"({r})*"
+                return spend(f"({r})*")
             if lo == 1 and hi is None:
-                return f"({r})+"
+                return spend(f"({r})+")
             if lo == 0 and hi == 1:
-                return f"({r})?"
+                return spend(f"({r})?")
             hi_s = "" if hi is None else str(hi)
-            return f"({r}){{{lo},{hi_s}}}" if hi != lo else f"({r}){{{lo}}}"
+            return spend(
+                f"({r}){{{lo},{hi_s}}}" if hi != lo else f"({r}){{{lo}}}"
+            )
         raise AssertionError(node)
 
     out = expand(("ref", "root"), ())

@@ -87,7 +87,7 @@ def any_json_value_regex(depth: int = 3) -> str:
     return value
 
 
-MAX_EXPANSION_CHARS = 1 << 19  # 512 KiB of regex
+MAX_EXPANSION_CHARS = 1 << 22  # 4 MiB of cumulative construction work
 
 
 class _Compiler:
@@ -95,8 +95,9 @@ class _Compiler:
         self.root = root
         self.max_depth = max_depth
         self.warned: set[str] = set()
-        # Expansion-size budget: schemas are request-controlled, and a
-        # non-recursive doubling chain of $refs blows up exponentially
+        # CUMULATIVE construction-work budget (each node's output is
+        # charged once per ancestor): schemas are request-controlled, and
+        # a non-recursive doubling chain of $refs blows up exponentially
         # without tripping the depth bound.
         self.budget = MAX_EXPANSION_CHARS
 

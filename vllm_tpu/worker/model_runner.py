@@ -263,12 +263,19 @@ class ModelRunner:
                 "num_allow",
                 "num_decode_steps",
                 "cascade_blocks",
+                "has_state_slots",
             ),
             donate_argnums=(1, 2) if self.draft_model is not None else (1,),
         )
         # Step-time breakdown (host prep / dispatch / finalize wait), enabled
         # by VLLM_TPU_STEP_TIMING=1; read via .timing after a run.
         from vllm_tpu import envs
+
+        # Hybrid attention+SSM: stable per-request Mamba state slots
+        # (reference: HybridKVCacheCoordinator per-type groups).
+        self._is_hybrid = getattr(model, "is_hybrid_ssm", False)
+        self._state_slot_free = list(range(sched.max_num_seqs - 1, -1, -1))
+        self._state_slot_of: dict[str, int] = {}
 
         # Multimodal: device-side encoder-output cache keyed by
         # (req_id, mm_input_index); budget enforced scheduler-side.
@@ -295,7 +302,7 @@ class ModelRunner:
 
     def _unpack(self, ibuf, fbuf, counts, prompt_mask, t, r, b, num_spec=0,
                 num_adj=0, num_allow=0, num_prompt_logprobs=0,
-                cascade_blocks=0):
+                cascade_blocks=0, has_state_slots=0):
         """Split the two packed host buffers back into metadata pytrees.
 
         One contiguous i32 upload + one f32 upload per step instead of ~12
@@ -358,6 +365,9 @@ class ModelRunner:
                 draft_ids=take(r * s).reshape(r, s),
                 sample_pos=take(r * (s + 1)).reshape(r, s + 1),
             )
+        if has_state_slots:
+            # Hybrid attention+SSM: per-request Mamba state slot.
+            md.state_slots = take(r)
         adj_vals = (
             fbuf[6 * r : 6 * r + r * num_adj].reshape(r, num_adj)
             if num_adj
@@ -409,11 +419,13 @@ class ModelRunner:
         num_allow: int = 0,
         num_decode_steps: int = 1,
         cascade_blocks: int = 0,
+        has_state_slots: int = 0,
     ):
         (token_ids, md, sampling, feedback, grammar_rows, logit_adjust,
          draft_next, token_lora, plp_next, spec) = self._unpack(
             ibuf, fbuf, counts, prompt_mask, t_pad, r_pad, b_pad, num_spec,
             num_adj, num_allow, num_prompt_logprobs, cascade_blocks,
+            has_state_slots,
         )
         # Device-side token feedback (async scheduling): a decode row whose
         # input token was sampled by the still-in-flight previous step reads
@@ -745,6 +757,10 @@ class ModelRunner:
 
     def _update_states(self, so: SchedulerOutput) -> None:
         for req_id in so.finished_req_ids:
+            if self._is_hybrid:
+                slot = self._state_slot_of.pop(req_id, None)
+                if slot is not None:
+                    self._state_slot_free.append(slot)
             # Suffix decoding: finished generations feed the cross-request
             # continuation corpus.
             state = self.input_batch.req_states.get(req_id)
@@ -776,6 +792,10 @@ class ModelRunner:
                 )
         for new in so.scheduled_new_reqs:
             row = self.input_batch.add_request(new)
+            if self._is_hybrid and new.req_id not in self._state_slot_of:
+                # Constant-size Mamba state slot, stable for the request's
+                # batch lifetime (rows swap on removal; slots don't).
+                self._state_slot_of[new.req_id] = self._state_slot_free.pop()
             if self.lora_manager is not None:
                 self.input_batch.lora_slot[row] = self.lora_manager.slot_of(
                     new.lora_name
@@ -900,9 +920,10 @@ class ModelRunner:
         # + top_k(r) + prng(2r) + feedback(r) + grammar_rows(r)
         # [+ adj_ids(r*num_adj)] [+ allow_ids(r*num_allow) + allow_flag(r)]
         # [+ num_draft(r) + draft(r*s) + sample_pos(r*(s+1))]
+        state_len = r if self._is_hybrid else 0
         ibuf = np.zeros(
             4 * t + 7 * r + (r + 1) + 1 + r * b + lp_len + eagle_len
-            + lora_len + plp_len + spec_len,
+            + lora_len + plp_len + spec_len + state_len,
             np.int32,
         )
         token_ids = ibuf[0:t]
@@ -952,6 +973,14 @@ class ModelRunner:
             num_draft = ibuf[o : o + r]; o += r
             draft_ids = ibuf[o : o + r * s].reshape(r, s); o += r * s
             sample_pos = ibuf[o : o + r * (s + 1)].reshape(r, s + 1)
+            o += r * (s + 1)
+        if self._is_hybrid:
+            state_slots = ibuf[o : o + r]; o += r
+            # Padding rows write to the reserved SCRATCH slot (index
+            # max_num_seqs) — slot 0 belongs to a live request.
+            state_slots[:] = self.config.scheduler_config.max_num_seqs
+            for i, rid in enumerate(req_order):
+                state_slots[i] = self._state_slot_of[rid]
         token_req_idx[:] = max(r_pad - 1, 0)
         do_sample = np.zeros(r_pad, bool)
 
@@ -1159,6 +1188,7 @@ class ModelRunner:
             num_logprobs=num_logprobs,
             num_prompt_logprobs=num_prompt_lp,
             num_spec=s,
+            has_state_slots=int(self._is_hybrid),
             num_adj=num_adj,
             num_allow=num_allow,
             num_decode_steps=so.num_decode_steps,
@@ -1236,6 +1266,7 @@ class ModelRunner:
             token_req_idx=rows_r,
             logits_indices=rows_r,
             num_seqs=md.num_seqs,
+            state_slots=md.state_slots,
         )
 
     def _logit_adjustments(self, rows: list[int], req_order: list[str],

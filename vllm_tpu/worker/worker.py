@@ -447,6 +447,15 @@ class Worker:
                     "residency", reserve / 2**30,
                 )
                 in_use += reserve
+        fixed_fn = getattr(self.model, "fixed_state_bytes", None)
+        if fixed_fn is not None:
+            # Hybrid models: constant-size Mamba slots come off the top of
+            # the budget before paged blocks are sized.
+            state = fixed_fn(self.config.scheduler_config.max_num_seqs)
+            logger.info(
+                "reserving %.2f GiB for per-request SSM state", state / 2**30
+            )
+            in_use += state
         if activation_bytes is not None:
             # Measured peak + 2% of the limit as safety margin (allocator
             # fragmentation, host-side staging buffers).
@@ -490,6 +499,23 @@ class Worker:
             if cache.enable_prefix_caching:
                 logger.info("prefix caching disabled for SSM model")
                 cache.enable_prefix_caching = False
+        if getattr(self.model, "is_hybrid_ssm", False):
+            # Hybrid attention+SSM (Jamba/Bamba-class): paged attention KV
+            # stays block-addressed, but the Mamba state is a per-request
+            # slot — prefix hits cannot restore it, so caching is off.
+            cache = self.config.cache_config
+            if cache.enable_prefix_caching:
+                logger.info("prefix caching disabled for hybrid SSM model")
+                cache.enable_prefix_caching = False
+            if self.config.speculative_config.enabled:
+                raise ValueError(
+                    "speculative decoding with hybrid SSM models is not "
+                    "supported yet (draft verification would need SSM "
+                    "state rollback)"
+                )
+            self.model.max_state_slots = (
+                self.config.scheduler_config.max_num_seqs
+            )
         cache = self.config.cache_config
         if cache.num_gpu_blocks_override is not None:
             # Explicit budget: no profiling, single allocation.
