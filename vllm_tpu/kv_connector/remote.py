@@ -112,7 +112,7 @@ class KVStoreServer:
         self._sock.listen(16)
         self.host, self.port = self._sock.getsockname()
         self._running = True
-        self._conns: list[socket.socket] = []
+        self._conns: list[tuple[socket.socket, threading.Thread]] = []
         self._accept_thread = threading.Thread(
             target=self._accept_loop, daemon=True
         )
@@ -128,7 +128,18 @@ class KVStoreServer:
             self._sock.close()
         except OSError:
             pass
-        for conn in self._conns:
+        for conn, thread in self._conns:
+            try:
+                # shutdown(2), not just close(): CPython defers the real
+                # fd close while the serve thread is blocked in recv, so
+                # close() alone leaves the TCP stream fully functional.
+                conn.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+        for conn, thread in self._conns:
+            # Joining makes the cut deterministic: a request racing the
+            # shutdown either completed before this returns or never will.
+            thread.join(timeout=5)
             try:
                 conn.close()
             except OSError:
@@ -142,15 +153,16 @@ class KVStoreServer:
                 conn, _ = self._sock.accept()
             except OSError:
                 return
-            self._conns.append(conn)
-            threading.Thread(
+            thread = threading.Thread(
                 target=self._serve_conn, args=(conn,), daemon=True
-            ).start()
+            )
+            self._conns.append((conn, thread))
+            thread.start()
 
     def _serve_conn(self, conn: socket.socket) -> None:
         try:
             conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-            while True:
+            while self._running:
                 header, body = _recv_frame(conn)
                 self._handle(conn, header, body)
         except (ConnectionError, OSError):
