@@ -128,22 +128,32 @@ class KVStoreServer:
             self._sock.close()
         except OSError:
             pass
-        for conn, thread in self._conns:
-            try:
-                # shutdown(2), not just close(): CPython defers the real
-                # fd close while the serve thread is blocked in recv, so
-                # close() alone leaves the TCP stream fully functional.
-                conn.shutdown(socket.SHUT_RDWR)
-            except OSError:
-                pass
-        for conn, thread in self._conns:
-            # Joining makes the cut deterministic: a request racing the
-            # shutdown either completed before this returns or never will.
-            thread.join(timeout=5)
-            try:
-                conn.close()
-            except OSError:
-                pass
+        # A connection accepted concurrently with the flag flip may be
+        # appended after a pass over _conns; loop until the list is stable.
+        done: set[int] = set()
+        while True:
+            batch = [cw for cw in self._conns if id(cw) not in done]
+            if not batch:
+                break
+            for conn, _thread in batch:
+                try:
+                    # shutdown(2), not just close(): CPython defers the
+                    # real fd close while the serve thread is blocked in
+                    # recv, so close() alone leaves the stream functional.
+                    conn.shutdown(socket.SHUT_RDWR)
+                except OSError:
+                    pass
+            for cw in batch:
+                conn, thread = cw
+                # Joining makes the cut deterministic: a request racing
+                # the shutdown either completed before this returns or
+                # never will.
+                thread.join(timeout=5)
+                try:
+                    conn.close()
+                except OSError:
+                    pass
+                done.add(id(cw))
 
     # ------------------------------------------------------------------
 

@@ -1,0 +1,125 @@
+"""GPTQ / AWQ checkpoint import: packed int32 tensors -> Int4Linear.
+
+Reference analog: the dequant conventions of
+``csrc/quantization/gptq/q_gemm.cu`` (AutoGPTQ layout) and ``csrc/
+quantization/awq/gemm_kernels.cu`` (AutoAWQ layout). Both store 4-bit
+weights as int32 words of 8 nibbles with group-wise (scale, zero):
+
+- GPTQ: ``qweight [K/8, N]`` packs along the INPUT dim, nibble ``k%8`` at
+  bit ``4*(k%8)``; ``qzeros [G, N/8]`` packs along the output dim the same
+  way, with the stored zero OFF BY ONE (AutoGPTQ stores ``zero-1``);
+  ``g_idx [K]`` maps rows to groups (only the trivial ``k//group`` map is
+  supported — ``desc_act=True`` reordering is rejected loudly).
+- AWQ: ``qweight [K, N/8]`` packs along the OUTPUT dim with the
+  interleaved nibble order [0, 2, 4, 6, 1, 3, 5, 7] (output column
+  ``8j+r`` lives at bit ``4*order[r]``); ``qzeros [G, N/8]`` same order,
+  no off-by-one.
+
+Both convert to the framework layout: nibbles packed two-per-byte along
+the input dim (``q[k//2]``: low nibble = even k), dequant
+``w = (nib - zero) * scale``.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+class QuantImportError(ValueError):
+    pass
+
+
+_AWQ_ORDER = np.array([0, 2, 4, 6, 1, 3, 5, 7])
+
+
+def _unpack_int32_nibbles(packed: np.ndarray, axis: int) -> np.ndarray:
+    """[..., X/8, ...] int32 -> [..., X, ...] uint8 nibbles along axis
+    (nibble i of each word at bit 4*i)."""
+    packed = packed.astype(np.uint32)
+    shifts = (4 * np.arange(8, dtype=np.uint32))
+    nibs = (packed[..., None] >> shifts) & 0xF  # [..., X/8, ..., 8]
+    nibs = np.moveaxis(nibs, -1, axis + 1 if axis >= 0 else axis)
+    shape = list(packed.shape)
+    shape[axis] *= 8
+    return nibs.reshape(shape).astype(np.uint8)
+
+
+def _pack_rows(nib: np.ndarray) -> np.ndarray:
+    """[K, N] nibbles -> [K//2, N] uint8 (low = even k, high = odd k)."""
+    return (nib[0::2, :] | (nib[1::2, :] << 4)).astype(np.uint8)
+
+
+def gptq_to_int4(
+    qweight: np.ndarray,  # [K/8, N] int32
+    qzeros: np.ndarray,  # [G, N/8] int32
+    scales: np.ndarray,  # [G, N] f16/f32
+    g_idx: np.ndarray | None = None,  # [K] int32
+    zero_bias: int = 1,  # AutoGPTQ v1 stores zero-1; gptq_v2 stores zero
+) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
+    k = qweight.shape[0] * 8
+    g = scales.shape[0]
+    group = k // g
+    if g_idx is not None and len(g_idx):
+        trivial = np.arange(k) // group
+        if not np.array_equal(np.asarray(g_idx), trivial):
+            raise QuantImportError(
+                "GPTQ act-order (desc_act=True) checkpoints are not "
+                "supported: g_idx row reordering requires activation "
+                "permutation"
+            )
+    nib = _unpack_int32_nibbles(qweight, axis=0)  # [K, N]
+    zeros = _unpack_int32_nibbles(qzeros, axis=1)  # [G, N]
+    zero = zeros.astype(np.float32) + float(zero_bias)
+    return _pack_rows(nib), np.asarray(scales, np.float32), zero
+
+
+def awq_to_int4(
+    qweight: np.ndarray,  # [K, N/8] int32
+    qzeros: np.ndarray,  # [G, N/8] int32
+    scales: np.ndarray,  # [G, N] f16/f32
+) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
+    def unpack_awq_cols(packed: np.ndarray) -> np.ndarray:
+        nibs = _unpack_int32_nibbles(packed, axis=-1)  # bit order 0..7
+        x, n8 = nibs.shape[0], nibs.shape[1] // 8
+        nibs = nibs.reshape(x, n8, 8)
+        # AutoAWQ packs column order_map[p] at bit position p, so output
+        # column c sits at bit position argsort(order_map)[c].
+        nibs = nibs[:, :, np.argsort(_AWQ_ORDER)]
+        return nibs.reshape(x, n8 * 8)
+
+    nib = unpack_awq_cols(qweight)  # [K, N]
+    zero = unpack_awq_cols(qzeros).astype(np.float32)  # [G, N]
+    return _pack_rows(nib), np.asarray(scales, np.float32), zero
+
+
+def detect_checkpoint_quant(hf_config) -> tuple[str, int, int] | None:
+    """(method, bits, zero_bias) from an HF config's quantization_config,
+    or None. zero_bias is the dequant zero offset: 1 for AutoGPTQ v1
+    checkpoints (stored zero-1), 0 for gptq_v2 and AWQ."""
+    qc = getattr(hf_config, "quantization_config", None)
+    if qc is None:
+        return None
+    if not isinstance(qc, dict):
+        qc = qc.to_dict() if hasattr(qc, "to_dict") else dict(qc)
+    method = qc.get("quant_method")
+    bits = int(qc.get("bits", 4))
+    if method not in ("gptq", "awq"):
+        raise QuantImportError(
+            f"checkpoint quantization {method!r} is not supported "
+            "(gptq/awq 4-bit only)"
+        )
+    if bits != 4:
+        raise QuantImportError(
+            f"{method} with bits={bits} is not supported (4-bit only)"
+        )
+    if method == "gptq" and qc.get("desc_act"):
+        raise QuantImportError(
+            "GPTQ desc_act=True (act-order) checkpoints are not supported"
+        )
+    fmt = qc.get("checkpoint_format", "gptq")
+    if method == "gptq" and fmt not in ("gptq", "gptq_v2"):
+        raise QuantImportError(
+            f"GPTQ checkpoint_format {fmt!r} is not supported"
+        )
+    zero_bias = 0 if (method == "awq" or fmt == "gptq_v2") else 1
+    return method, bits, zero_bias

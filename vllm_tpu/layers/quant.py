@@ -1,15 +1,22 @@
-"""Weight-only quantization: per-output-channel INT8 / FP8 linear weights.
+"""Weight-only quantization: INT8 / FP8 (per-output-channel) and INT4
+(group-wise, GPTQ/AWQ-compatible) linear weights.
 
 Reference analog: ``vllm/model_executor/layers/quantization/`` (fp8.py,
-experts_int8.py — 30+ schemes; this build starts with the two native TPU
-dtypes). Quantized weights live in the param tree as ``QuantizedLinear``
-pytree nodes — ``lax.scan`` slices their fields per layer like any stacked
-leaf — and matmuls route through :func:`qmm`, which dequantizes into the
+experts_int8.py, gptq ``csrc/quantization/gptq/q_gemm.cu``, awq). Quantized
+weights live in the param tree as ``QuantizedLinear``/``Int4Linear`` pytree
+nodes — ``lax.scan`` slices their fields per layer like any stacked leaf —
+and matmuls route through :func:`qmm`, which dequantizes into the
 activation dtype at the matmul input (XLA keeps the HBM-resident copy in
-the narrow dtype, which is the decode-bandwidth win).
+the narrow dtype, which is the decode-bandwidth win). On TPU the int4 path
+uses the Pallas w4a16 kernel (``ops/w4a16.py``: nibble unpack fused into
+the blocked matmul).
 
-Scheme: symmetric per-output-channel. ``w = q * scale[out]`` with
-``q ∈ int8 [-127, 127]`` or ``float8_e4m3fn [-448, 448]``.
+Schemes:
+- int8/fp8: symmetric per-output-channel, ``w = q * scale[out]``.
+- int4: asymmetric group-wise (the GPTQ/AWQ formulation):
+  ``w[k, n] = (nib[k, n] - zero[g, n]) * scale[g, n]``, ``g = k // G``,
+  nibbles packed two-per-byte along the input dim (``q[k//2]``: low nibble
+  = even k, high = odd k).
 """
 
 from __future__ import annotations
@@ -20,7 +27,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-QUANT_METHODS = ("int8", "fp8")
+QUANT_METHODS = ("int8", "fp8", "int4", "gptq", "awq")
 
 
 @jax.tree_util.register_dataclass
@@ -57,14 +64,110 @@ def quantize_np(arr: np.ndarray, method: str) -> tuple[np.ndarray, np.ndarray]:
     return q, scale.astype(np.float32)
 
 
-def quantize_jnp(arr: jnp.ndarray, method: str) -> QuantizedLinear:
+def quantize_jnp(arr: jnp.ndarray, method: str):
     """Device-side quantization (dummy-weight path)."""
+    if method in ("int4", "gptq", "awq"):
+        return quantize_int4_jnp(arr)
     q, scale = _quantize(arr, method, jnp, jnp.int8, jnp.float8_e4m3fn)
     return QuantizedLinear(q=q, scale=scale)
 
 
+@jax.tree_util.register_dataclass
+@dataclass
+class Int4Linear:
+    """Group-quantized int4 weight: ``q`` uint8 ``[..., K//2, N]`` (two
+    nibbles per byte along the input dim), ``scale``/``zero``
+    ``[..., G, N]`` f32 with ``G = K // group_size``."""
+
+    q: jnp.ndarray
+    scale: jnp.ndarray
+    zero: jnp.ndarray
+
+
+def unpack_int4(q: jnp.ndarray) -> jnp.ndarray:
+    """[..., K//2, N] uint8 -> [..., K, N] nibbles (uint8 0..15)."""
+    lo = q & 0xF
+    hi = q >> 4
+    stacked = jnp.stack([lo, hi], axis=-2)  # [..., K//2, 2, N]
+    return stacked.reshape(*q.shape[:-2], q.shape[-2] * 2, q.shape[-1])
+
+
+def dequant_int4(w: Int4Linear, dtype=jnp.float32) -> jnp.ndarray:
+    nib = unpack_int4(w.q).astype(jnp.float32)
+    k = nib.shape[-2]
+    g = w.scale.shape[-2]
+    group = k // g
+    scale = jnp.repeat(w.scale, group, axis=-2)
+    zero = jnp.repeat(w.zero, group, axis=-2)
+    return ((nib - zero) * scale).astype(dtype)
+
+
+def quantize_int4_np(
+    arr: np.ndarray, group_size: int = 128
+) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """Host-side asymmetric group quantization. ``arr [..., K, N]`` ->
+    (packed uint8 [..., K//2, N], scale [..., G, N], zero [..., G, N])."""
+    arr = np.asarray(arr, np.float32)
+    *lead, k, n = arr.shape
+    assert k % group_size == 0 and k % 2 == 0, (k, group_size)
+    g = k // group_size
+    grouped = arr.reshape(*lead, g, group_size, n)
+    lo = grouped.min(axis=-2)
+    hi = grouped.max(axis=-2)
+    scale = np.maximum((hi - lo) / 15.0, 1e-8)
+    zero = np.clip(np.rint(-lo / scale), 0, 15)
+    nib = np.clip(
+        np.rint(grouped / scale[..., None, :]) + zero[..., None, :], 0, 15
+    ).astype(np.uint8).reshape(*lead, k, n)
+    packed = (nib[..., 0::2, :] | (nib[..., 1::2, :] << 4)).astype(np.uint8)
+    # C-contiguous outputs: axis reductions above yield F-contiguous
+    # arrays, whose raw buffers serializers (safetensors) write verbatim.
+    return (
+        np.ascontiguousarray(packed),
+        np.ascontiguousarray(scale.astype(np.float32)),
+        np.ascontiguousarray(zero.astype(np.float32)),
+    )
+
+
+def quantize_int4_jnp(
+    arr: jnp.ndarray, group_size: int = 128
+) -> Int4Linear:
+    """Device-side int4 group quantization (dummy-weight path)."""
+    arr = arr.astype(jnp.float32)
+    *lead, k, n = arr.shape
+    if k % group_size or k % 2:
+        # Small test dims: shrink the group to the largest even divisor.
+        group_size = k if k % 2 == 0 else 1
+        if group_size == 1:
+            raise ValueError(f"int4 needs an even input dim, got {k}")
+    g = k // group_size
+    grouped = arr.reshape(*lead, g, group_size, n)
+    lo = grouped.min(axis=-2)
+    hi = grouped.max(axis=-2)
+    scale = jnp.maximum((hi - lo) / 15.0, 1e-8)
+    zero = jnp.clip(jnp.rint(-lo / scale), 0, 15)
+    nib = jnp.clip(
+        jnp.rint(grouped / scale[..., None, :]) + zero[..., None, :], 0, 15
+    ).astype(jnp.uint8).reshape(*lead, k, n)
+    packed = nib[..., 0::2, :] | (nib[..., 1::2, :] << 4)
+    return Int4Linear(q=packed, scale=scale, zero=zero)
+
+
 def qmm(x: jnp.ndarray, w) -> jnp.ndarray:
-    """``x @ w`` for plain arrays or QuantizedLinear (dequant-on-the-fly)."""
+    """``x @ w`` for plain arrays, QuantizedLinear, or Int4Linear
+    (dequant-on-the-fly)."""
     if isinstance(w, QuantizedLinear):
         return (x @ w.q.astype(x.dtype)) * w.scale.astype(x.dtype)
+    if isinstance(w, Int4Linear):
+        from vllm_tpu import envs
+
+        if (
+            jax.default_backend() == "tpu"
+            and not envs.VLLM_TPU_PALLAS_INTERPRET
+            and not envs.VLLM_TPU_DISABLE_PALLAS
+        ):
+            from vllm_tpu.ops.w4a16 import w4a16_matmul
+
+            return w4a16_matmul(x, w)
+        return (x @ dequant_int4(w, x.dtype)).astype(x.dtype)
     return x @ w

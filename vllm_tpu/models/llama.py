@@ -469,7 +469,16 @@ class LlamaForCausalLM:
             layers |= {"bq": P(None, tp), "bk": P(None, tp), "bv": P(None, tp)}
         if self.qk_norm:
             layers |= {"q_norm": P(None, None), "k_norm": P(None, None)}
-        if self.quantization:
+        from vllm_tpu.layers.quant import Int4Linear
+
+        if self.quantization in ("int4", "gptq", "awq"):
+            # Packed nibbles shard like the weight; group scale/zero
+            # shard like (group axis replicated, output axis as weight).
+            for k in self.QUANT_KEYS:
+                w = layers[k]
+                gs = P(w[0], None, w[-1])
+                layers[k] = Int4Linear(q=w, scale=gs, zero=gs)
+        elif self.quantization:
             # Scale vectors shard like the weight's output axis.
             for k in self.QUANT_KEYS:
                 w = layers[k]
@@ -480,6 +489,11 @@ class LlamaForCausalLM:
                 if isinstance(spec, QuantizedLinear):
                     return QuantizedLinear(
                         q=stage(spec.q), scale=stage(spec.scale)
+                    )
+                if isinstance(spec, Int4Linear):
+                    return Int4Linear(
+                        q=stage(spec.q), scale=stage(spec.scale),
+                        zero=stage(spec.zero),
                     )
                 return P("pp", *spec[1:])
 

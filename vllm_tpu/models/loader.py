@@ -64,6 +64,14 @@ def load_safetensors_params(
     stacked2: dict[str, dict] = {}
     seen = set()
 
+    # GPTQ/AWQ: checkpoints carry (qweight, qzeros, scales[, g_idx])
+    # INSTEAD of .weight for the quantized projections; collect the
+    # packed triples per destination and convert after the scan.
+    ckpt_quant = getattr(model, "quantization", None)
+    ckpt_quant = ckpt_quant if ckpt_quant in ("gptq", "awq") else None
+    _Q4_SUFFIXES = (".qweight", ".qzeros", ".scales", ".g_idx")
+    q4_raw: dict[str, dict[str, np.ndarray]] = {}
+
     for file in _iter_safetensor_files(path):
         with safe_open(file, framework="numpy") as f:
             for raw_name in f.keys():
@@ -76,6 +84,15 @@ def load_safetensors_params(
                     hf_name = "model." + hf_name.removeprefix(
                         "model.language_model."
                     )
+                if ckpt_quant and hf_name.endswith(_Q4_SUFFIXES):
+                    stem, _, kind = hf_name.rpartition(".")
+                    mapped = weight_map.get(stem + ".weight")
+                    if mapped is not None:
+                        q4_raw.setdefault(mapped[0], {})[kind] = (
+                            f.get_tensor(raw_name)
+                        )
+                        seen.add(stem + ".weight")
+                    continue
                 if hf_name not in weight_map:
                     continue
                 dest, transpose = weight_map[hf_name]
@@ -112,6 +129,9 @@ def load_safetensors_params(
 
     params: dict = {}
     quant_method = getattr(model, "quantization", None)
+    # int8/fp8/int4 quantize plain fp weights at load; gptq/awq normally
+    # arrive pre-packed through the q4_raw path above, but a plain fp
+    # weight for a quantized projection falls back to int4-at-load.
     quant_paths = (
         {f"layers.{k}" for k in getattr(model, "QUANT_KEYS", ())}
         if quant_method
@@ -120,34 +140,94 @@ def load_safetensors_params(
 
     postprocess = getattr(model, "postprocess_weight", None)
 
+    def _lookup_sharding(leaf_path: str):
+        if shardings is None:
+            return None
+        node = shardings
+        for p in leaf_path.split("."):
+            if isinstance(node, dict) and p in node:
+                node = node[p]
+            else:
+                return None
+        return node
+
     def put(leaf_path: str, arr: np.ndarray) -> None:
         if postprocess is not None:
             arr = postprocess(leaf_path, arr)
-        sharding = None
-        if shardings is not None:
-            node = shardings
-            ok = True
-            for p in leaf_path.split("."):
-                if isinstance(node, dict) and p in node:
-                    node = node[p]
-                else:
-                    ok = False
-                    break
-            sharding = node if ok else None
+        sharding = _lookup_sharding(leaf_path)
         if leaf_path in quant_paths:
-            from vllm_tpu.layers.quant import QuantizedLinear, quantize_np
+            if quant_method in ("int8", "fp8"):
+                from vllm_tpu.layers.quant import (
+                    QuantizedLinear,
+                    quantize_np,
+                )
 
-            qn, sn = quantize_np(arr, quant_method)
-            q, sc = jnp.asarray(qn), jnp.asarray(sn)
-            if sharding is not None:
-                q = jax.device_put(q, sharding.q)
-                sc = jax.device_put(sc, sharding.scale)
-            _set_path(params, leaf_path, QuantizedLinear(q=q, scale=sc))
+                qn, sn = quantize_np(arr, quant_method)
+                q, sc = jnp.asarray(qn), jnp.asarray(sn)
+                if sharding is not None:
+                    q = jax.device_put(q, sharding.q)
+                    sc = jax.device_put(sc, sharding.scale)
+                _set_path(params, leaf_path, QuantizedLinear(q=q, scale=sc))
+                return
+            # int4 (or gptq/awq whose checkpoint held a plain fp weight).
+            from vllm_tpu.layers.quant import quantize_int4_np
+
+            k_dim = arr.shape[-2]
+            group = 128 if k_dim % 128 == 0 else k_dim
+            qn, sn, zn = quantize_int4_np(arr, group_size=group)
+            put_int4(leaf_path, qn, sn, zn)
             return
         x = jnp.asarray(arr, dtype=dtype)
         if sharding is not None:
             x = jax.device_put(x, sharding)
         _set_path(params, leaf_path, x)
+
+    def put_int4(base: str, q, sc, z) -> None:
+        from vllm_tpu.layers.quant import Int4Linear
+
+        leaf = Int4Linear(
+            q=jnp.asarray(q), scale=jnp.asarray(sc), zero=jnp.asarray(z)
+        )
+        node = _lookup_sharding(base)
+        if isinstance(node, Int4Linear):
+            leaf = Int4Linear(
+                q=jax.device_put(leaf.q, node.q),
+                scale=jax.device_put(leaf.scale, node.scale),
+                zero=jax.device_put(leaf.zero, node.zero),
+            )
+        _set_path(params, base, leaf)
+
+    if q4_raw:
+        from vllm_tpu.layers.gptq_import import awq_to_int4, gptq_to_int4
+
+        by_base: dict[str, dict[int, tuple]] = {}
+        zero_bias = getattr(model, "quant_zero_bias", 1)
+        for dest, parts in q4_raw.items():
+            if ckpt_quant == "gptq":
+                q, sc, z = gptq_to_int4(
+                    parts["qweight"], parts["qzeros"], parts["scales"],
+                    parts.get("g_idx"), zero_bias=zero_bias,
+                )
+            else:
+                q, sc, z = awq_to_int4(
+                    parts["qweight"], parts["qzeros"], parts["scales"]
+                )
+            p = dest.split(".")
+            if p[-1].isdigit():
+                by_base.setdefault(".".join(p[:-1]), {})[int(p[-1])] = (
+                    q, sc, z
+                )
+            else:
+                put_int4(dest, q, sc, z)
+        for base, by_idx in by_base.items():
+            n = max(by_idx) + 1
+            assert len(by_idx) == n, f"missing layers for {base}"
+            put_int4(
+                base,
+                np.stack([by_idx[i][0] for i in range(n)]),
+                np.stack([by_idx[i][1] for i in range(n)]),
+                np.stack([by_idx[i][2] for i in range(n)]),
+            )
 
     for dest, arr in staged.items():
         put(dest, arr)

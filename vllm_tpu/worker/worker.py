@@ -181,10 +181,29 @@ class Worker:
         if mc.max_model_len is None:
             mc.max_model_len = getattr(hf_config, "max_position_embeddings", 8192)
         self.config.scheduler_config.max_model_len = mc.max_model_len
+        quant_zero_bias = None
+        if getattr(hf_config, "quantization_config", None) is not None:
+            # Pre-quantized checkpoint (GPTQ/AWQ): the quant method comes
+            # from the checkpoint, not the CLI.
+            from vllm_tpu.layers.gptq_import import detect_checkpoint_quant
+
+            method, _bits, quant_zero_bias = detect_checkpoint_quant(
+                hf_config
+            )
+            if mc.quantization not in (None, method):
+                raise ValueError(
+                    f"--quantization={mc.quantization} conflicts with the "
+                    f"checkpoint's quantization_config ({method})"
+                )
+            mc.quantization = method
         model_cls = get_model_class(hf_config)
         self.model = model_cls(
             hf_config, dtype=mc.jax_dtype, quantization=mc.quantization
         )
+        if quant_zero_bias is not None:
+            # gptq_v2/AWQ store the zero directly; AutoGPTQ v1 stores
+            # zero-1 (the loader passes this to the importer).
+            self.model.quant_zero_bias = quant_zero_bias
         pc = self.config.parallel_config
         if pc.enable_eplb:
             if not getattr(self.model, "supports_eplb", False):
