@@ -1,0 +1,101 @@
+"""Beam search (reference: ``vllm/entrypoints/llm.py:691`` + HF beam
+semantics: 2w expansion, cumulative-logprob ranking, length penalty)."""
+
+from __future__ import annotations
+
+import math
+
+import numpy as np
+import pytest
+
+from tests.models.utils import tiny_llama_dir_with_tokenizer
+from vllm_tpu import LLM, SamplingParams
+from vllm_tpu.sampling_params import BeamSearchParams
+
+
+@pytest.fixture(scope="module")
+def llm(tmp_path_factory):
+    path = tiny_llama_dir_with_tokenizer(
+        tmp_path_factory.mktemp("tiny_beam")
+    )
+    return LLM(
+        model=path, dtype="float32", max_model_len=64, block_size=16,
+        num_gpu_blocks_override=48, max_num_seqs=8,
+        max_num_batched_tokens=128,
+    )
+
+
+def test_beam_search_basic(llm):
+    out = llm.beam_search(
+        ["abc"], BeamSearchParams(beam_width=3, max_tokens=6,
+                                  ignore_eos=True)
+    )
+    assert len(out) == 1
+    seqs = out[0].sequences
+    assert len(seqs) == 3
+    # Ranked by score, unique candidates, full length (ignore_eos).
+    scores = [s.cum_logprob for s in seqs]
+    assert scores == sorted(scores, reverse=True)
+    assert len({tuple(s.tokens) for s in seqs}) == 3
+    assert all(len(s.tokens) == 6 for s in seqs)
+    assert all(s.text for s in seqs)
+
+
+def test_beam_reported_logprob_is_true_model_logprob(llm):
+    """The reported cumulative logprob must equal the model's actual
+    log-probability of the returned continuation (teacher-forced)."""
+    tok = llm.get_tokenizer()
+    prompt_ids = tok.encode("abc")
+    out = llm.beam_search(
+        [{"prompt_token_ids": prompt_ids}],
+        BeamSearchParams(beam_width=2, max_tokens=4, ignore_eos=True),
+    )
+    best = out[0].sequences[0]
+    full = prompt_ids + best.tokens
+    res = llm.generate(
+        [{"prompt_token_ids": full}],
+        SamplingParams(temperature=0.0, max_tokens=1, prompt_logprobs=1,
+                       ignore_eos=True),
+    )[0]
+    lp = 0.0
+    for pos in range(len(prompt_ids), len(full)):
+        entry = res.prompt_logprobs[pos]
+        lp += entry[full[pos]].logprob
+    assert math.isclose(lp, best.cum_logprob, rel_tol=1e-3, abs_tol=1e-3)
+
+
+def test_beam_beats_or_matches_greedy(llm):
+    """The best beam's sequence logprob is >= the greedy rollout's."""
+    tok = llm.get_tokenizer()
+    prompt_ids = tok.encode("ab12")
+    n = 5
+    greedy = llm.generate(
+        [{"prompt_token_ids": prompt_ids}],
+        SamplingParams(temperature=0.0, max_tokens=n, logprobs=1,
+                       ignore_eos=True),
+    )[0].outputs[0]
+    greedy_lp = sum(
+        entry[t].logprob
+        for entry, t in zip(greedy.logprobs, greedy.token_ids)
+    )
+    out = llm.beam_search(
+        [{"prompt_token_ids": prompt_ids}],
+        BeamSearchParams(beam_width=4, max_tokens=n, ignore_eos=True),
+    )
+    assert out[0].sequences[0].cum_logprob >= greedy_lp - 1e-4
+
+
+def test_beam_search_multiple_prompts(llm):
+    outs = llm.beam_search(
+        ["abc", "12 34"],
+        BeamSearchParams(beam_width=2, max_tokens=4, ignore_eos=True),
+    )
+    assert len(outs) == 2
+    assert all(len(o.sequences) == 2 for o in outs)
+
+
+def test_beam_search_deterministic(llm):
+    p = BeamSearchParams(beam_width=3, max_tokens=5, ignore_eos=True)
+    a = llm.beam_search(["xyz"], p)[0].sequences
+    b = llm.beam_search(["xyz"], p)[0].sequences
+    assert [s.tokens for s in a] == [s.tokens for s in b]

@@ -161,3 +161,43 @@ def test_mp_engine_dead_error(ckpt):
             )
             llm.llm_engine.step()
             time.sleep(0.1)
+
+
+def test_mp_engine_killed_mid_stream(ckpt):
+    """SIGKILL the engine proc while a stream is in flight: the consumer
+    gets EngineDeadError (never a hang), and the CLIENT process survives
+    to report it (reference: ENGINE_CORE_DEAD -> EngineDeadError,
+    v1/engine/exceptions.py:9 + VLLM_KEEP_ALIVE_ON_ENGINE_DEATH)."""
+    import asyncio
+
+    from vllm_tpu.engine.arg_utils import AsyncEngineArgs
+    from vllm_tpu.engine.async_llm import AsyncLLM
+    from vllm_tpu.engine.core_client import EngineDeadError
+
+    engine = AsyncLLM.from_engine_args(
+        AsyncEngineArgs(
+            model=ckpt, dtype="float32", max_model_len=128, block_size=16,
+            num_gpu_blocks_override=64, max_num_seqs=4,
+            max_num_batched_tokens=128, distributed_executor_backend="mp",
+        )
+    )
+    sp = SamplingParams(temperature=0.0, max_tokens=64, ignore_eos=True)
+
+    async def run():
+        got = 0
+        async for out in engine.generate(
+            {"prompt_token_ids": [5, 9, 11]}, sp, "req-kill"
+        ):
+            got = len(out.outputs[0].token_ids)
+            if got >= 2:  # mid-stream: kill the engine core
+                os.kill(engine.engine_core._proc.pid, signal.SIGKILL)
+        return got
+
+    try:
+        with pytest.raises(EngineDeadError):
+            asyncio.run(asyncio.wait_for(run(), timeout=30))
+    finally:
+        try:
+            engine.shutdown()
+        except EngineDeadError:
+            pass  # the proc is dead by design; shutdown must not hang

@@ -27,9 +27,11 @@ from vllm_tpu.sampling_params import RequestOutputKind, SamplingParams
 
 logger = init_logger(__name__)
 
-
-class EngineDeadError(RuntimeError):
-    """Reference analog: ``vllm/v1/engine/exceptions.py:9``."""
+# One EngineDeadError across the stack (reference:
+# ``vllm/v1/engine/exceptions.py:9``) — a caller's `except EngineDeadError`
+# must catch regardless of whether the death surfaced client- or
+# engine-side.
+from vllm_tpu.engine.core_client import EngineDeadError  # noqa: E402,F401
 
 
 class AsyncStream:

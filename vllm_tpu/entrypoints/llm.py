@@ -13,7 +13,11 @@ from vllm_tpu.engine.arg_utils import EngineArgs
 from vllm_tpu.engine.input_processor import PromptType
 from vllm_tpu.engine.llm_engine import LLMEngine
 from vllm_tpu.logger import init_logger
-from vllm_tpu.outputs import RequestOutput
+from vllm_tpu.outputs import (
+    BeamSearchOutput,
+    BeamSearchSequence,
+    RequestOutput,
+)
 from vllm_tpu.sampling_params import SamplingParams
 
 logger = init_logger(__name__)
@@ -129,6 +133,118 @@ class LLM:
             for conv in messages
         ]
         return self.generate(prompts, sampling_params)
+
+    def beam_search(
+        self,
+        prompts: Union[PromptType, Sequence[PromptType]],
+        params: "BeamSearchParams | None" = None,
+    ) -> list["BeamSearchOutput"]:
+        """Beam search (reference: ``vllm/entrypoints/llm.py:691``).
+
+        HF semantics: every step expands each live beam with its top
+        ``2*beam_width`` next-token logprobs (one engine step per beam,
+        max_tokens=1 — the prefix cache makes the re-prefill cheap),
+        keeps the ``beam_width`` best by cumulative logprob, sets
+        EOS-completed beams aside, and finally ranks completed + live
+        beams by the length-penalized score."""
+        from vllm_tpu.sampling_params import (
+            BeamSearchParams,
+            beam_search_params,
+        )
+
+        params = params or BeamSearchParams()
+        if params.temperature:
+            raise ValueError(
+                "beam search temperature scaling is not supported; scores "
+                "use the model's raw logprobs (temperature must be 0)"
+            )
+        if isinstance(prompts, (str, dict)):
+            prompts = [prompts]
+        tokenizer = self.get_tokenizer()
+        eos_id = tokenizer.eos_token_id if tokenizer is not None else None
+
+        def encode(p):
+            if isinstance(p, dict):
+                if "prompt_token_ids" in p:
+                    return list(p["prompt_token_ids"])
+                p = p["prompt"]
+            if tokenizer is None:
+                raise ValueError("string prompts need a tokenizer")
+            return tokenizer.encode(p)
+
+        w = params.beam_width
+        step_sp = beam_search_params(w)
+        # A beam at max_model_len-1 cannot take another step; it completes
+        # as-is instead of crashing the whole search at admission.
+        len_cap = self.llm_engine.config.model_config.max_model_len - 1
+
+        # Per prompt: live beams [(tokens_full, cum_lp)] + completed.
+        encoded = [encode(p) for p in prompts]
+        plen = [len(t) for t in encoded]
+        live: list[list[tuple[list[int], float]]] = [
+            [(t, 0.0)] for t in encoded
+        ]
+        done: list[list[tuple[list[int], float]]] = [[] for _ in prompts]
+
+        for _ in range(params.max_tokens):
+            flat = [
+                (i, toks, lp)
+                for i, beams in enumerate(live)
+                for toks, lp in beams
+            ]
+            if not flat:
+                break
+            outs = self.generate(
+                [{"prompt_token_ids": toks} for _, toks, _ in flat],
+                step_sp,
+            )
+            cands: list[list[tuple[list[int], float]]] = [
+                [] for _ in prompts
+            ]
+            for (i, toks, cum), out in zip(flat, outs):
+                lps = out.outputs[0].logprobs
+                if not lps:
+                    continue
+                for tok, lp in lps[0].items():
+                    cands[i].append((toks + [tok], cum + lp.logprob))
+            for i, cl in enumerate(cands):
+                cl.sort(key=lambda c: c[1], reverse=True)
+                new_live = []
+                for toks, cum in cl:
+                    hit_eos = (
+                        not params.ignore_eos
+                        and eos_id is not None
+                        and toks[-1] == eos_id
+                    )
+                    if hit_eos or len(toks) >= len_cap:
+                        done[i].append((toks, cum))
+                    elif len(new_live) < w:
+                        new_live.append((toks, cum))
+                    if len(done[i]) >= w and len(new_live) >= w:
+                        break
+                live[i] = [] if len(done[i]) >= w else new_live
+
+        def score(toks, cum, n_prompt):
+            n = len(toks) - n_prompt
+            if eos_id is not None and toks and toks[-1] == eos_id:
+                n -= 1
+            return cum / (max(n, 1) ** params.length_penalty)
+
+        results = []
+        for i in range(len(prompts)):
+            pool = done[i] + live[i]
+            pool.sort(key=lambda c: score(*c, plen[i]), reverse=True)
+            seqs = []
+            for toks, cum in pool[:w]:
+                gen = toks[plen[i]:]
+                text = (
+                    tokenizer.decode(gen) if tokenizer is not None else ""
+                )
+                seqs.append(BeamSearchSequence(
+                    tokens=gen, cum_logprob=cum, text=text,
+                ))
+            results.append(BeamSearchOutput(sequences=seqs))
+        return results
 
     # ------------------------------------------------------------------
 

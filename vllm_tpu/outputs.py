@@ -65,6 +65,21 @@ class RequestMetrics:
 
 
 @dataclass
+class BeamSearchSequence:
+    """One ranked beam (generated tokens only; reference:
+    ``vllm/beam_search.py`` BeamSearchSequence)."""
+
+    tokens: list[int]
+    cum_logprob: float
+    text: str = ""
+
+
+@dataclass
+class BeamSearchOutput:
+    sequences: list[BeamSearchSequence]
+
+
+@dataclass
 class PoolingOutput:
     """Embedding/classify result (reference: vllm/outputs.py PoolingOutput)."""
 

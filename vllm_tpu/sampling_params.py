@@ -126,12 +126,29 @@ class SamplingParams:
         return set(self.stop_token_ids)
 
 
-def beam_search_params(beam_width: int, max_tokens: int) -> SamplingParams:
-    """Params used internally by beam search (greedy logprobs expansion)."""
+@dataclass
+class BeamSearchParams:
+    """Beam search spec (reference: ``vllm/sampling_params.py``
+    BeamSearchParams; driven by ``LLM.beam_search``). Beam scores use the
+    model's raw logprobs; temperature != 0 is rejected (scaled-score
+    search is not implemented)."""
+
+    beam_width: int = 4
+    max_tokens: int = 16
+    ignore_eos: bool = False
+    temperature: float = 0.0
+    length_penalty: float = 1.0
+
+
+def beam_search_params(beam_width: int) -> SamplingParams:
+    """Per-step params used internally by ``LLM.beam_search``: one greedy
+    token, top-``2w`` logprobs (the HF expansion width), no incremental
+    detokenization."""
     return SamplingParams(
         n=1,
         temperature=0.0,
         logprobs=2 * beam_width,
-        max_tokens=max_tokens,
+        max_tokens=1,
+        ignore_eos=True,
         output_kind=RequestOutputKind.FINAL_ONLY,
     )
