@@ -97,3 +97,34 @@ def test_greedy_continuation_matches_hf(tiny_llama):
         ours_tokens.append(int(np.argmax(np.asarray(logits)[0])))
 
     assert ours_tokens == hf_tokens
+
+
+def test_unrolled_layer_loop_matches_scan(tmp_path_factory):
+    """scan_layers=False (the large-quantized-model path: scan xs layout
+    assignment copies the whole weight stack at run time) must produce
+    identical logits to the scanned path."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from transformers import AutoConfig
+
+    from tests.models.utils import build_prefill_metadata, tiny_llama_dir
+    from vllm_tpu.models.llama import LlamaForCausalLM
+
+    path = tiny_llama_dir(tmp_path_factory.mktemp("tiny_unroll"))
+    cfg = AutoConfig.from_pretrained(path)
+    model = LlamaForCausalLM(cfg, dtype=jnp.float32)
+    params = model.load_params(path, jnp.float32, None)
+    t = 12
+    ids = jnp.asarray(np.arange(t, dtype=np.int32) % cfg.vocab_size)
+    md, kv = build_prefill_metadata(model, t, block_size=16, num_blocks=8)
+    hidden, _ = model.apply(params, kv, ids, md)
+    ref = model.compute_logits(params, hidden)
+
+    model.scan_layers = False
+    md2, kv2 = build_prefill_metadata(model, t, block_size=16, num_blocks=8)
+    hidden2, _ = jax.jit(model.apply)(params, kv2, ids, md2)
+    got = model.compute_logits(params, hidden2)
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(ref), rtol=1e-5, atol=1e-5
+    )

@@ -198,6 +198,11 @@ class LLM:
                 [{"prompt_token_ids": toks} for _, toks, _ in flat],
                 step_sp,
             )
+            assert len(outs) == len(flat), (
+                f"beam step returned {len(outs)} outputs for "
+                f"{len(flat)} beams (a dropped request would silently "
+                "misalign every later beam)"
+            )
             cands: list[list[tuple[list[int], float]]] = [
                 [] for _ in prompts
             ]

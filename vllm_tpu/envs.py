@@ -49,6 +49,10 @@ _readers: dict[str, Callable[[], Any]] = {
     "VLLM_TPU_COMPILE_CACHE_DIR": _str("VLLM_TPU_COMPILE_CACHE_DIR", None),
     # LRU size bound for the persistent compilation cache directory.
     "VLLM_TPU_COMPILE_CACHE_MAX_GB": _int("VLLM_TPU_COMPILE_CACHE_MAX_GB", 32),
+    # Unroll the layer loop instead of lax.scan (scan's xs layout
+    # assignment can materialize a run-time copy of the whole weight
+    # stack; unrolling trades compile time for that transient).
+    "VLLM_TPU_UNROLL_LAYERS": _bool("VLLM_TPU_UNROLL_LAYERS", False),
     # Structured output: max recursion re-entries per rule/$ref when
     # expanding context-free grammars (EBNF) and recursive JSON schemas
     # into the finite device mask table. Deeper nesting becomes

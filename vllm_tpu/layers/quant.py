@@ -41,14 +41,22 @@ class QuantizedLinear:
 
 
 def _quantize(arr, method: str, xp, int8_t, fp8_t):
-    """Shared scheme (one implementation for host and device paths)."""
-    arr = arr.astype(xp.float32) if xp is jnp else np.asarray(arr, np.float32)
-    amax = xp.abs(arr).max(axis=-2, keepdims=True)
+    """Shared scheme (one implementation for host and device paths).
+
+    The big array ops run in the INPUT dtype on device (a full-precision
+    cast of an 8B weight stack is a multi-GiB temporary; bf16 rounding of
+    the quotient costs at most one LSB of the 8-bit code); scales are
+    always f32. The host (numpy) path keeps full f32 — it quantizes real
+    checkpoints."""
+    if xp is np:
+        arr = np.asarray(arr, np.float32)
+    amax = xp.abs(arr).max(axis=-2, keepdims=True).astype(xp.float32)
     qmax = 127.0 if method == "int8" else 448.0
     scale = xp.maximum(amax / qmax, 1e-8)
-    q = arr / scale
+    q = arr / scale.astype(arr.dtype)
     if method == "int8":
-        q = xp.rint(q).clip(-127, 127).astype(int8_t)
+        q = xp.rint(q.astype(xp.float32) if xp is np else q)
+        q = q.clip(-127, 127).astype(int8_t)
     elif method == "fp8":
         q = q.astype(fp8_t)
     else:
@@ -132,8 +140,9 @@ def quantize_int4_np(
 def quantize_int4_jnp(
     arr: jnp.ndarray, group_size: int = 128
 ) -> Int4Linear:
-    """Device-side int4 group quantization (dummy-weight path)."""
-    arr = arr.astype(jnp.float32)
+    """Device-side int4 group quantization (dummy-weight path). Big array
+    ops stay in the input dtype — an f32 cast of an 8B weight stack is a
+    multi-GiB temporary; only the [.., G, N] scales are f32."""
     *lead, k, n = arr.shape
     if k % group_size or k % 2:
         # Small test dims: shrink the group to the largest even divisor.
@@ -142,12 +151,15 @@ def quantize_int4_jnp(
             raise ValueError(f"int4 needs an even input dim, got {k}")
     g = k // group_size
     grouped = arr.reshape(*lead, g, group_size, n)
-    lo = grouped.min(axis=-2)
-    hi = grouped.max(axis=-2)
+    lo = grouped.min(axis=-2).astype(jnp.float32)
+    hi = grouped.max(axis=-2).astype(jnp.float32)
     scale = jnp.maximum((hi - lo) / 15.0, 1e-8)
     zero = jnp.clip(jnp.rint(-lo / scale), 0, 15)
     nib = jnp.clip(
-        jnp.rint(grouped / scale[..., None, :]) + zero[..., None, :], 0, 15
+        jnp.rint(
+            grouped / scale[..., None, :].astype(arr.dtype)
+        ) + zero[..., None, :].astype(arr.dtype),
+        0, 15,
     ).astype(jnp.uint8).reshape(*lead, k, n)
     packed = nib[..., 0::2, :] | (nib[..., 1::2, :] << 4)
     return Int4Linear(q=packed, scale=scale, zero=zero)

@@ -110,8 +110,36 @@ class RotaryEmbedding:
 
         t = np.arange(max_position, dtype=np.float64)
         freqs = np.outer(t, inv_freq)  # [P, rd/2]
-        self.cos = jnp.asarray(np.cos(freqs) * mscale, dtype=dtype)
-        self.sin = jnp.asarray(np.sin(freqs) * mscale, dtype=dtype)
+        # HOST arrays: they reach jit as inline constants, so lowering
+        # never needs a device fetch (a d2h read can fail under memory
+        # pressure right after large-model init on the axon tunnel).
+        self._cos_np = np.ascontiguousarray(
+            (np.cos(freqs) * mscale).astype(dtype)
+        )
+        self._sin_np = np.ascontiguousarray(
+            (np.sin(freqs) * mscale).astype(dtype)
+        )
+
+    # Small tables inline as trace literals (no device fetch at lowering);
+    # large long-context tables would bloat every bucket executable with a
+    # duplicated constant, so they stay a single shared device array.
+    _INLINE_LIMIT_BYTES = 8 << 20
+
+    @property
+    def cos(self) -> jnp.ndarray:
+        if self._cos_np.nbytes > self._INLINE_LIMIT_BYTES:
+            if not hasattr(self, "_cos_dev"):
+                self._cos_dev = jnp.asarray(self._cos_np)
+            return self._cos_dev
+        return jnp.asarray(self._cos_np)
+
+    @property
+    def sin(self) -> jnp.ndarray:
+        if self._sin_np.nbytes > self._INLINE_LIMIT_BYTES:
+            if not hasattr(self, "_sin_dev"):
+                self._sin_dev = jnp.asarray(self._sin_np)
+            return self._sin_dev
+        return jnp.asarray(self._sin_np)
 
     def __call__(
         self, positions: jnp.ndarray, q: jnp.ndarray, k: jnp.ndarray

@@ -71,6 +71,11 @@ class LlamaForCausalLM:
     # (``ops/cp_attention.cp_write_and_attend``).
     cp_size = 1
     cp_mesh = None
+    # lax.scan over the stacked layer weights vs an unrolled Python loop.
+    # Scan compiles fast and is the default; its xs layout assignment can
+    # materialize a run-time copy of the WHOLE weight stack, so large
+    # quantized models flip this off (see apply()).
+    scan_layers = True
 
     def __init__(self, hf_config: Any, dtype=jnp.bfloat16,
                  quantization: str | None = None) -> None:
@@ -119,16 +124,31 @@ class LlamaForCausalLM:
         def init(key, shape, fan_in):
             return (jax.random.normal(key, shape, jnp.float32) / math.sqrt(fan_in)).astype(dtype)
 
+        def init_w(key, shape, fan_in, name):
+            # Quantize each stacked weight AS IT IS CREATED, generating in
+            # bf16: holding the whole fp tree (or f32 temporaries) before
+            # quantizing would peak at full-precision model size — an 8B
+            # int8 dummy on a 16 GiB chip would OOM.
+            if self.quantization and name in self.QUANT_KEYS:
+                w = (
+                    jax.random.normal(key, shape, jnp.bfloat16)
+                    / math.sqrt(fan_in)
+                ).astype(jnp.bfloat16)
+                q = quantize_jnp(w, self.quantization)
+                w.delete()
+                return q
+            return init(key, shape, fan_in)
+
         layers = {
             "input_norm": jnp.ones((L, D), dtype),
-            "wq": init(keys[0], (L, D, H * Dh), D),
-            "wk": init(keys[1], (L, D, KH * Dh), D),
-            "wv": init(keys[2], (L, D, KH * Dh), D),
-            "wo": init(keys[3], (L, H * Dh, D), H * Dh),
+            "wq": init_w(keys[0], (L, D, H * Dh), D, "wq"),
+            "wk": init_w(keys[1], (L, D, KH * Dh), D, "wk"),
+            "wv": init_w(keys[2], (L, D, KH * Dh), D, "wv"),
+            "wo": init_w(keys[3], (L, H * Dh, D), H * Dh, "wo"),
             "post_norm": jnp.ones((L, D), dtype),
-            "wgate": init(keys[4], (L, D, F), D),
-            "wup": init(keys[5], (L, D, F), D),
-            "wdown": init(keys[6], (L, F, D), F),
+            "wgate": init_w(keys[4], (L, D, F), D, "wgate"),
+            "wup": init_w(keys[5], (L, D, F), D, "wup"),
+            "wdown": init_w(keys[6], (L, F, D), F, "wdown"),
         }
         if self.attention_bias:
             layers["bq"] = jnp.zeros((L, H * Dh), dtype)
@@ -137,9 +157,6 @@ class LlamaForCausalLM:
         if self.qk_norm:
             layers["q_norm"] = jnp.ones((L, Dh), dtype)
             layers["k_norm"] = jnp.ones((L, Dh), dtype)
-        if self.quantization:
-            for k in self.QUANT_KEYS:
-                layers[k] = quantize_jnp(layers[k], self.quantization)
         params = {
             "embed": init(keys[7], (V, D), D),
             "layers": layers,
@@ -214,15 +231,29 @@ class LlamaForCausalLM:
             token_lora_slot=token_lora_slot,
             lora_scale=params.get("lora_scaling"),
         )
-        # Scan over the layer stack with the WHOLE cache in the carry: the
-        # per-layer scatter + page gathers touch only live slots, and the
-        # donated buffer is updated in place (per-layer xs/ys would
-        # double-buffer the cache and copy a full layer per iteration).
-        (x, new_kv), _ = jax.lax.scan(
-            layer_fn,
-            (x, kv_cache),
-            (params["layers"], jnp.arange(self.num_layers, dtype=jnp.int32)),
-        )
+        if self.scan_layers:
+            # Scan over the layer stack with the WHOLE cache in the carry:
+            # the per-layer scatter + page gathers touch only live slots,
+            # and the donated buffer is updated in place (per-layer xs/ys
+            # would double-buffer the cache and copy a full layer per
+            # iteration).
+            (x, new_kv), _ = jax.lax.scan(
+                layer_fn,
+                (x, kv_cache),
+                (params["layers"],
+                 jnp.arange(self.num_layers, dtype=jnp.int32)),
+            )
+        else:
+            # Unrolled: scan's xs layout assignment materializes a COPY of
+            # the whole weight stack at run time — a transient the size of
+            # the model, which OOMs large quantized models that otherwise
+            # fit. The unrolled loop slices one layer at a time (bigger
+            # HLO, slower compile; the persistent cache amortizes it).
+            carry = (x, kv_cache)
+            for i in range(self.num_layers):
+                lp = jax.tree.map(lambda a: a[i], params["layers"])
+                carry, _ = layer_fn(carry, (lp, jnp.int32(i)))
+            x, new_kv = carry
         x = rms_norm(x, params["final_norm"], self.rms_eps)
         return x, new_kv
 

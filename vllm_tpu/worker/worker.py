@@ -200,6 +200,12 @@ class Worker:
         self.model = model_cls(
             hf_config, dtype=mc.jax_dtype, quantization=mc.quantization
         )
+        from vllm_tpu import envs as _envs
+
+        if _envs.VLLM_TPU_UNROLL_LAYERS and hasattr(
+            self.model, "scan_layers"
+        ):
+            self.model.scan_layers = False
         if quant_zero_bias is not None:
             # gptq_v2/AWQ store the zero directly; AutoGPTQ v1 stores
             # zero-1 (the loader passes this to the importer).
