@@ -127,3 +127,122 @@ def test_chat_endpoint_tool_plumbing(tmp_path_factory):
         asyncio.run(run())
     finally:
         engine.shutdown()
+
+
+# ----------------------------------------------------------------------
+# Llama <|python_tag|>, Mistral [TOOL_CALLS], Pythonic formats
+# (reference: vllm/tool_parsers/ llama/mistral/pythonic parsers)
+# ----------------------------------------------------------------------
+
+def test_python_tag_json_call():
+    import json
+
+    from vllm_tpu.parsers.tools import get_tool_parser
+
+    p = get_tool_parser("llama3")
+    out = p.parse(
+        '<|python_tag|>{"name": "get_weather", "arguments": '
+        '{"city": "Paris"}}'
+    )
+    assert len(out.tool_calls) == 1
+    assert out.tool_calls[0].name == "get_weather"
+    assert json.loads(out.tool_calls[0].arguments) == {"city": "Paris"}
+    assert out.content is None
+
+
+def test_python_tag_ipython_calls():
+    import json
+
+    from vllm_tpu.parsers.tools import get_tool_parser
+
+    p = get_tool_parser("llama")
+    out = p.parse(
+        "Let me check.<|python_tag|>weather.get(city=\"Paris\", days=3); "
+        "news.top(limit=5)"
+    )
+    assert [c.name for c in out.tool_calls] == ["weather.get", "news.top"]
+    assert json.loads(out.tool_calls[0].arguments) == {
+        "city": "Paris", "days": 3,
+    }
+    assert out.content == "Let me check."
+
+
+def test_python_tag_falls_back_to_bare_json():
+    from vllm_tpu.parsers.tools import get_tool_parser
+
+    out = get_tool_parser("llama3").parse(
+        '{"name": "f", "arguments": {}}'
+    )
+    assert [c.name for c in out.tool_calls] == ["f"]
+
+
+def test_mistral_tool_calls():
+    import json
+
+    from vllm_tpu.parsers.tools import get_tool_parser
+
+    p = get_tool_parser("mistral")
+    out = p.parse(
+        '[TOOL_CALLS] [{"name": "lookup", "arguments": {"q": "tpu"}}, '
+        '{"name": "sum", "arguments": {"a": 1, "b": 2}}]'
+    )
+    assert [c.name for c in out.tool_calls] == ["lookup", "sum"]
+    assert json.loads(out.tool_calls[1].arguments) == {"a": 1, "b": 2}
+    assert out.content is None
+    # No token -> plain content.
+    plain = p.parse("just text")
+    assert plain.tool_calls == [] and plain.content == "just text"
+
+
+def test_pythonic_tool_calls():
+    import json
+
+    from vllm_tpu.parsers.tools import get_tool_parser
+
+    p = get_tool_parser("pythonic")
+    out = p.parse('[get_weather(city="SF"), search(q="llm", k=2)]')
+    assert [c.name for c in out.tool_calls] == ["get_weather", "search"]
+    assert json.loads(out.tool_calls[1].arguments) == {"q": "llm", "k": 2}
+
+    none = p.parse("no calls here")
+    assert none.tool_calls == [] and none.content == "no calls here"
+
+
+def test_python_tag_semicolon_inside_string():
+    import json
+
+    from vllm_tpu.parsers.tools import get_tool_parser
+
+    out = get_tool_parser("llama3").parse(
+        '<|python_tag|>{"name": "run_sql", "arguments": '
+        '{"q": "SELECT 1; DROP TABLE t"}}'
+    )
+    assert len(out.tool_calls) == 1
+    assert json.loads(out.tool_calls[0].arguments)["q"] == (
+        "SELECT 1; DROP TABLE t"
+    )
+
+
+def test_python_tag_unparseable_payload_surfaces_as_content():
+    from vllm_tpu.parsers.tools import get_tool_parser
+
+    out = get_tool_parser("llama3").parse("<|python_tag|>@@garbage@@")
+    assert out.tool_calls == []
+    assert "@@garbage@@" in (out.content or "")
+
+
+def test_pythonic_trailing_prose_brackets():
+    from vllm_tpu.parsers.tools import get_tool_parser
+
+    out = get_tool_parser("pythonic").parse(
+        '[get_weather(city="SF")] as noted in [doc(1)]'
+    )
+    assert [c.name for c in out.tool_calls] == ["get_weather"]
+    assert "[doc(1)]" in (out.content or "")
+
+
+def test_pythonic_positional_args_rejected():
+    from vllm_tpu.parsers.tools import get_tool_parser
+
+    out = get_tool_parser("pythonic").parse('[search("llm", k=2)]')
+    assert out.tool_calls == []  # skipped, not silently mis-parameterized

@@ -158,7 +158,7 @@ class ParallelConfig:
     eplb_num_groups: int = 0
     # Backend for engine<->worker transport: in-proc by default on TPU since
     # one host drives all local chips via a single jax client.
-    distributed_executor_backend: Literal["uniproc", "mp"] = "uniproc"
+    distributed_executor_backend: Literal["uniproc", "mp", "external"] = "uniproc"
 
     @property
     def world_size(self) -> int:
@@ -239,6 +239,12 @@ class SpeculativeConfig:
     prompt_lookup_min: int = 1
     # Draft checkpoint path: EAGLE head / full draft model / medusa heads.
     model: str | None = None
+    # Suffix decoding: whether finished generations feed a CROSS-REQUEST
+    # continuation corpus. Verification keeps outputs correct either way,
+    # but drafts derived from other users' generations are an
+    # information-flow channel in multi-tenant serving (draft acceptance
+    # patterns are observable via timing) — flip off there.
+    suffix_cross_request_corpus: bool = True
 
     @property
     def enabled(self) -> bool:

@@ -75,6 +75,7 @@ class EngineArgs:
     speculative_method: str | None = None
     num_speculative_tokens: int = 0
     speculative_model: str | None = None
+    suffix_cross_request_corpus: bool = True
 
     enable_lora: bool = False
     max_lora_rank: int = 16
@@ -146,6 +147,9 @@ class EngineArgs:
                 method=self.speculative_method,  # type: ignore[arg-type]
                 num_speculative_tokens=self.num_speculative_tokens,
                 model=self.speculative_model,
+                suffix_cross_request_corpus=(
+                    self.suffix_cross_request_corpus
+                ),
             ),
             lora_config=LoRAConfig(
                 enable_lora=self.enable_lora,

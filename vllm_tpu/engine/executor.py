@@ -25,6 +25,8 @@ class Executor:
             # core_proc); inside that process one jax client still drives
             # all local chips, so the worker executor stays uniproc.
             return UniProcExecutor
+        if backend == "external":
+            return ExternalLauncherExecutor
         raise NotImplementedError(f"executor backend {backend}")
 
     def __init__(self, config: EngineConfig) -> None:
@@ -88,3 +90,23 @@ class UniProcExecutor(Executor):
     def collective_rpc(self, method: str, *args: Any, **kwargs: Any) -> list[Any]:
         fn: Callable = getattr(self.worker, method)
         return [fn(*args, **kwargs)]
+
+
+class ExternalLauncherExecutor(UniProcExecutor):
+    """Multi-host SPMD executor (reference:
+    ``ExecutorWithExternalLauncher``, ``multiproc_executor.py:102`` role).
+
+    Every HOST runs the same engine binary under an external launcher
+    (one process per host); ``jax.distributed.initialize`` joins them,
+    after which the mesh spans the GLOBAL device set and GSPMD lowers
+    cross-host collectives onto ICI/DCN. The SPMD contract: every process
+    must receive the identical request stream and make identical
+    scheduling decisions (deterministic scheduler, no per-process
+    randomness) — the reference imposes the same on its torchrun mode.
+    """
+
+    def __init__(self, config: EngineConfig) -> None:
+        from vllm_tpu.parallel.distributed import init_distributed
+
+        init_distributed()
+        super().__init__(config)

@@ -1,0 +1,88 @@
+"""Multi-host runtime: jax.distributed bootstrap + global mesh.
+
+Reference analog: ``vllm/distributed/parallel_state.py:1358``
+(init_distributed_environment over torch ProcessGroups + NCCL) and the
+``ExecutorWithExternalLauncher`` SPMD mode (``v1/executor/abstract.py``):
+every host runs the same engine binary under an external launcher; the
+TPU realization is ``jax.distributed.initialize`` — after it, every
+process sees the GLOBAL device set, ``build_mesh`` lays axes over all
+hosts, and GSPMD lowers cross-host collectives onto ICI/DCN exactly as it
+does single-host onto ICI.
+
+On real TPU pods ``jax.distributed.initialize()`` needs no arguments (the
+TPU metadata service provides coordinator/topology); elsewhere — and in
+the two-process CPU tests — the coordinator comes from env:
+
+    VLLM_TPU_DIST_COORDINATOR  host:port of process 0
+    VLLM_TPU_DIST_NUM_PROCESSES
+    VLLM_TPU_DIST_PROCESS_ID
+"""
+
+from __future__ import annotations
+
+import os
+
+import jax
+
+from vllm_tpu.logger import init_logger
+
+logger = init_logger(__name__)
+
+_initialized = False
+
+
+def init_distributed() -> None:
+    """Bootstrap the multi-process JAX runtime (idempotent).
+
+    Must run before anything initializes the XLA backend — so the check
+    for an existing runtime reads jax's distributed global state rather
+    than calling jax.process_count() (which would initialize it)."""
+    global _initialized
+    if _initialized:
+        return
+    from jax._src import distributed as _dist
+
+    if getattr(_dist.global_state, "client", None) is not None:
+        _initialized = True
+        return
+    coordinator = os.environ.get("VLLM_TPU_DIST_COORDINATOR")
+    if coordinator:
+        # Explicit multi-process launch: failures here are user errors
+        # and must propagate.
+        jax.distributed.initialize(
+            coordinator_address=coordinator,
+            num_processes=int(os.environ["VLLM_TPU_DIST_NUM_PROCESSES"]),
+            process_id=int(os.environ["VLLM_TPU_DIST_PROCESS_ID"]),
+        )
+    else:
+        # TPU pods auto-discover via metadata; anywhere else (or when the
+        # backend already initialized, e.g. a single-process launch of
+        # the external backend) degrade to uniproc semantics.
+        try:
+            jax.distributed.initialize()
+        except Exception as exc:
+            logger.info("single-process fallback (%s)", exc)
+            _initialized = True
+            return
+    _initialized = True
+    logger.info(
+        "distributed runtime: process %d/%d, %d global / %d local devices",
+        jax.process_index(), jax.process_count(),
+        len(jax.devices()), len(jax.local_devices()),
+    )
+
+
+def replicate_to_global(tree, mesh):
+    """Host data -> arrays replicated over the GLOBAL (multi-host) mesh.
+
+    Every process must call this with IDENTICAL values (the SPMD external-
+    launcher contract: same request stream, same scheduling decisions)."""
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    sharding = NamedSharding(mesh, PartitionSpec())
+    return jax.tree_util.tree_map(
+        lambda x: jax.make_array_from_callback(
+            x.shape, sharding, lambda idx: x[idx]
+        ) if hasattr(x, "shape") else x,
+        tree,
+    )

@@ -99,11 +99,182 @@ class JsonToolParser(ToolParser):
         return ParsedToolOutput(content=text, tool_calls=[])
 
 
+class PythonTagToolParser(ToolParser):
+    """Llama-3.x ``<|python_tag|>`` format: the tag introduces either a
+    JSON call or a ``module.fn(arg=..., ...)`` ipython-style call; multiple
+    calls separate with ``;``. Reference:
+    ``vllm/tool_parsers/llama_tool_parser.py``."""
+
+    TAG = "<|python_tag|>"
+    _FN = re.compile(r"^\s*([\w.]+)\((.*)\)\s*$", re.S)
+
+    def parse(self, text: str) -> ParsedToolOutput:
+        if self.TAG not in text:
+            # Llama-3.1 also emits bare-JSON calls without the tag.
+            return JsonToolParser().parse(text)
+        content, _, payload = text.partition(self.TAG)
+        calls: list[ToolCall] = []
+        for part in _split_top_level(payload, ";"):
+            part = part.strip()
+            if not part:
+                continue
+            try:
+                obj = json.loads(part)
+                call = _coerce_call(obj) if isinstance(obj, dict) else None
+            except json.JSONDecodeError:
+                call = _parse_pythonic_call(part)
+            if call is not None:
+                calls.append(call)
+        if not calls:
+            # Unparseable payload must surface as content, not vanish.
+            return ParsedToolOutput(
+                content=text.strip() or None, tool_calls=[]
+            )
+        return ParsedToolOutput(
+            content=content.strip() or None, tool_calls=calls
+        )
+
+
+class MistralToolParser(ToolParser):
+    """Mistral ``[TOOL_CALLS]`` format: the token introduces a JSON array
+    of ``{"name", "arguments"}`` objects. Reference:
+    ``vllm/tool_parsers/mistral_tool_parser.py``."""
+
+    TOKEN = "[TOOL_CALLS]"
+
+    def parse(self, text: str) -> ParsedToolOutput:
+        if self.TOKEN not in text:
+            return ParsedToolOutput(content=text, tool_calls=[])
+        content, _, payload = text.partition(self.TOKEN)
+        payload = payload.strip()
+        # The array may be followed by trailing prose; find its end.
+        try:
+            obj, end = json.JSONDecoder().raw_decode(payload)
+        except json.JSONDecodeError:
+            return ParsedToolOutput(content=text, tool_calls=[])
+        items = obj if isinstance(obj, list) else [obj]
+        calls = [
+            c for item in items if isinstance(item, dict)
+            if (c := _coerce_call(item)) is not None
+        ]
+        tail = payload[end:].strip()
+        full_content = " ".join(s for s in (content.strip(), tail) if s)
+        return ParsedToolOutput(
+            content=full_content or None, tool_calls=calls
+        )
+
+
+def _split_top_level(text: str, sep: str) -> list[str]:
+    """Split on ``sep`` only outside quotes and brackets (a semicolon
+    inside a JSON string argument must not shred the call)."""
+    parts, depth, quote, start = [], 0, None, 0
+    i = 0
+    while i < len(text):
+        c = text[i]
+        if quote is not None:
+            if c == "\\":
+                i += 2
+                continue
+            if c == quote:
+                quote = None
+        elif c in "\"'":
+            quote = c
+        elif c in "([{":
+            depth += 1
+        elif c in ")]}":
+            depth = max(0, depth - 1)
+        elif c == sep and depth == 0:
+            parts.append(text[start:i])
+            start = i + 1
+        i += 1
+    parts.append(text[start:])
+    return parts
+
+
+def _parse_pythonic_call(text: str) -> ToolCall | None:
+    """``fn_name(key=value, ...)`` with Python literals as values."""
+    import ast
+
+    m = PythonTagToolParser._FN.match(text)
+    if m is None:
+        return None
+    name, argsrc = m.group(1), m.group(2)
+    try:
+        call = ast.parse(f"f({argsrc})", mode="eval").body
+        if not isinstance(call, ast.Call) or call.args:
+            return None
+        kwargs = {
+            kw.arg: ast.literal_eval(kw.value)
+            for kw in call.keywords
+            if kw.arg is not None
+        }
+    except (SyntaxError, ValueError):
+        return None
+    return ToolCall(name=name, arguments=json.dumps(kwargs))
+
+
+class PythonicToolParser(ToolParser):
+    """Pythonic list-of-calls format: ``[fn1(a=1), fn2(b="x")]``
+    (Llama-4 / functionary style). Reference:
+    ``vllm/tool_parsers/pythonic_tool_parser.py``."""
+
+    _START = re.compile(r"\[\s*[\w.]+\(")
+
+    def parse(self, text: str) -> ParsedToolOutput:
+        import ast
+
+        m = self._START.search(text)
+        if m is None:
+            return ParsedToolOutput(content=text, tool_calls=[])
+        # A greedy regex over-matches when later brackets appear in prose;
+        # try each closing ']' until one parses as a list of calls.
+        start = m.start()
+        tree = end = None
+        for pos, c in enumerate(text[start:], start):
+            if c != "]":
+                continue
+            try:
+                cand = ast.parse(text[start : pos + 1], mode="eval").body
+            except SyntaxError:
+                continue
+            if isinstance(cand, ast.List):
+                tree, end = cand, pos + 1
+                break
+        if tree is None:
+            return ParsedToolOutput(content=text, tool_calls=[])
+        calls: list[ToolCall] = []
+        for el in tree.elts:
+            if not isinstance(el, ast.Call):
+                continue
+            if el.args:
+                # Positional arguments cannot map to a JSON object; skip
+                # rather than emit a call with silently-missing params.
+                continue
+            name = ast.unparse(el.func)
+            try:
+                kwargs = {
+                    kw.arg: ast.literal_eval(kw.value)
+                    for kw in el.keywords
+                    if kw.arg is not None
+                }
+            except ValueError:
+                continue
+            calls.append(ToolCall(name=name, arguments=json.dumps(kwargs)))
+        content = (text[:start] + text[end:]).strip()
+        return ParsedToolOutput(
+            content=content or None, tool_calls=calls
+        )
+
+
 _TOOL_PARSERS = {
     "hermes": HermesToolParser,
     "qwen": HermesToolParser,
     "json": JsonToolParser,
     "llama3_json": JsonToolParser,
+    "llama": PythonTagToolParser,
+    "llama3": PythonTagToolParser,
+    "mistral": MistralToolParser,
+    "pythonic": PythonicToolParser,
 }
 
 

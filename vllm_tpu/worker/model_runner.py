@@ -769,6 +769,9 @@ class ModelRunner:
                 and state.in_batch_row >= 0
                 and self.proposer is not None
                 and hasattr(self.proposer, "observe_finished")
+                # Multi-tenant off switch: without it, one user's
+                # generations seed another's speculative drafts.
+                and self.config.speculative_config.suffix_cross_request_corpus
             ):
                 row = state.in_batch_row
                 n_tok = int(self.input_batch.num_tokens[row])
