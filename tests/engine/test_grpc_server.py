@@ -1,0 +1,105 @@
+"""gRPC entrypoint (reference: vllm/entrypoints/grpc_server.py): JSON-
+over-gRPC generate/health/models service backed by AsyncLLM."""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import socket
+import threading
+
+import grpc
+import pytest
+
+from tests.models.utils import tiny_llama_dir
+from vllm_tpu.engine.arg_utils import AsyncEngineArgs
+
+
+@pytest.fixture(scope="module")
+def grpc_addr(tmp_path_factory):
+    ckpt = tiny_llama_dir(tmp_path_factory.mktemp("tiny_grpc"))
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    addr = f"127.0.0.1:{port}"
+    ready = threading.Event()
+    stop: list = []
+
+    def serve():
+        async def run():
+            from vllm_tpu.engine.async_llm import AsyncLLM
+            from vllm_tpu.entrypoints.grpc_server import make_server
+
+            engine = AsyncLLM.from_engine_args(AsyncEngineArgs(
+                model=ckpt, dtype="float32", max_model_len=128,
+                block_size=16, num_gpu_blocks_override=64, max_num_seqs=4,
+                max_num_batched_tokens=128,
+            ))
+            server = make_server(engine, ckpt)
+            server.add_insecure_port(addr)
+            await server.start()
+            loop = asyncio.get_running_loop()
+            stop.append(lambda: asyncio.run_coroutine_threadsafe(
+                server.stop(0.1), loop
+            ))
+            ready.set()
+            await server.wait_for_termination()
+
+        asyncio.run(run())
+
+    t = threading.Thread(target=serve, daemon=True)
+    t.start()
+    assert ready.wait(timeout=180), "grpc server failed to start"
+    yield addr
+    if stop:
+        stop[0]().result(timeout=10)
+
+
+def _ident(b: bytes) -> bytes:
+    return b
+
+
+def test_grpc_health_and_models(grpc_addr):
+    with grpc.insecure_channel(grpc_addr) as ch:
+        health = ch.unary_unary(
+            "/vllmtpu.LLM/Health", request_serializer=_ident,
+            response_deserializer=_ident,
+        )
+        assert json.loads(health(b"{}"))["status"] == "SERVING"
+        models = ch.unary_unary(
+            "/vllmtpu.LLM/Models", request_serializer=_ident,
+            response_deserializer=_ident,
+        )
+        assert len(json.loads(models(b"{}"))["models"]) == 1
+
+
+def test_grpc_generate_stream(grpc_addr):
+    with grpc.insecure_channel(grpc_addr) as ch:
+        gen = ch.unary_stream(
+            "/vllmtpu.LLM/Generate", request_serializer=_ident,
+            response_deserializer=_ident,
+        )
+        req = {
+            "prompt_token_ids": [5, 9, 11],
+            "sampling_params": {
+                "temperature": 0.0, "max_tokens": 6, "ignore_eos": True,
+            },
+        }
+        msgs = [json.loads(m) for m in gen(json.dumps(req).encode())]
+        assert msgs and msgs[-1]["finished"]
+        assert len(msgs[-1]["token_ids"]) == 6
+        assert msgs[-1]["finish_reason"] == "length"
+
+
+def test_grpc_bad_request_is_invalid_argument(grpc_addr):
+    with grpc.insecure_channel(grpc_addr) as ch:
+        gen = ch.unary_stream(
+            "/vllmtpu.LLM/Generate", request_serializer=_ident,
+            response_deserializer=_ident,
+        )
+        with pytest.raises(grpc.RpcError) as err:
+            list(gen(json.dumps({
+                "prompt_token_ids": [1],
+                "sampling_params": {"definitely_not_a_knob": 1},
+            }).encode()))
+        assert err.value.code() == grpc.StatusCode.INVALID_ARGUMENT

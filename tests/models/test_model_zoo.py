@@ -215,3 +215,76 @@ def test_qwen2_moe_e2e_greedy_matches_hf(tmp_path):
             torch.tensor([prompt]), max_new_tokens=6, do_sample=False
         )[0][len(prompt):].tolist()
     assert out.outputs[0].token_ids == ref
+
+
+def test_phi3_hf_parity(tmp_path_factory):
+    """Phi-3 fused qkv/gate_up checkpoints split at load; greedy parity."""
+    import numpy as np
+    import torch
+    from transformers import Phi3Config, Phi3ForCausalLM
+
+    from vllm_tpu import LLM, SamplingParams
+
+    cfg = Phi3Config(
+        vocab_size=128, hidden_size=64, intermediate_size=96,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=256, tie_word_embeddings=False,
+        pad_token_id=0, bos_token_id=1, eos_token_id=2,
+    )
+    torch.manual_seed(0)
+    hf = Phi3ForCausalLM(cfg).to(torch.float32).eval()
+    path = str(tmp_path_factory.mktemp("tiny_phi3"))
+    hf.save_pretrained(path, safe_serialization=True)
+    prompt = np.random.default_rng(0).integers(5, 120, size=13).tolist()
+    with torch.no_grad():
+        want = hf.generate(
+            torch.tensor([prompt]), max_new_tokens=8, do_sample=False,
+            pad_token_id=0,
+        )[0, len(prompt):].tolist()
+    llm = LLM(
+        model=path, dtype="float32", max_model_len=128, block_size=16,
+        num_gpu_blocks_override=64, max_num_seqs=4,
+        max_num_batched_tokens=128,
+    )
+    got = llm.generate(
+        [{"prompt_token_ids": prompt}],
+        SamplingParams(temperature=0.0, max_tokens=8, ignore_eos=True),
+    )[0].outputs[0].token_ids
+    assert got == want
+
+
+def test_granite_hf_parity(tmp_path_factory):
+    """Granite scalar modulation (embedding/attention/residual/logits)."""
+    import numpy as np
+    import torch
+    from transformers import GraniteConfig, GraniteForCausalLM
+
+    from vllm_tpu import LLM, SamplingParams
+
+    cfg = GraniteConfig(
+        vocab_size=128, hidden_size=64, intermediate_size=96,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=256, tie_word_embeddings=False,
+        embedding_multiplier=6.0, attention_multiplier=0.2,
+        residual_multiplier=0.5, logits_scaling=4.0,
+    )
+    torch.manual_seed(0)
+    hf = GraniteForCausalLM(cfg).to(torch.float32).eval()
+    path = str(tmp_path_factory.mktemp("tiny_granite"))
+    hf.save_pretrained(path, safe_serialization=True)
+    prompt = np.random.default_rng(1).integers(5, 120, size=11).tolist()
+    with torch.no_grad():
+        want = hf.generate(
+            torch.tensor([prompt]), max_new_tokens=8, do_sample=False,
+            pad_token_id=0,
+        )[0, len(prompt):].tolist()
+    llm = LLM(
+        model=path, dtype="float32", max_model_len=128, block_size=16,
+        num_gpu_blocks_override=64, max_num_seqs=4,
+        max_num_batched_tokens=128,
+    )
+    got = llm.generate(
+        [{"prompt_token_ids": prompt}],
+        SamplingParams(temperature=0.0, max_tokens=8, ignore_eos=True),
+    )[0].outputs[0].token_ids
+    assert got == want

@@ -68,12 +68,9 @@ kv = jax.make_array_from_callback(
     lambda idx: np.zeros(kv_shape, np.float32)[idx],
 )
 ids_host = np.arange(8, dtype=np.int32) % cfg.vocab_size
-rep = lambda x: jax.make_array_from_callback(
-    np.asarray(x).shape, NamedSharding(mesh, P()),
-    lambda idx: np.asarray(x)[idx],
+ids, md = replicate_to_global(
+    (ids_host, jax.tree.map(np.asarray, md)), mesh
 )
-ids = rep(ids_host)
-md = jax.tree.map(rep, md)
 
 def fwd(params, kv, ids, md):
     h, kv = model.apply(params, kv, ids, md)
@@ -105,7 +102,6 @@ def test_two_process_global_mesh_forward(tmp_path, n_procs):
             VLLM_TPU_DIST_PROCESS_ID=str(i),
             PYTHONPATH=os.getcwd(),
         )
-        env.pop("VLLM_TPU_PALLAS_INTERPRET", None)
         env["VLLM_TPU_PALLAS_INTERPRET"] = "1"
         procs.append(subprocess.Popen(
             [sys.executable, str(script)], env=env,

@@ -1,0 +1,136 @@
+"""gRPC serving entrypoint.
+
+Reference analog: ``vllm/entrypoints/grpc_server.py`` (an AsyncLLM-backed
+gRPC service; the reference delegates its servicer to an optional
+package). This build is self-contained: the image carries ``grpcio`` but
+no protoc python plugin, so the service uses grpc GENERIC method handlers
+with JSON payloads — schema-light, language-neutral, and streaming.
+
+Service ``vllmtpu.LLM``:
+
+- ``Generate`` (unary-stream): request ``{"prompt": str |
+  "prompt_token_ids": [int], "sampling_params": {...SamplingParams
+  fields}, "request_id": str?}``; streams ``{"request_id", "text",
+  "token_ids", "finished", "finish_reason"}`` deltas.
+- ``Health`` (unary-unary): ``{}`` -> ``{"status": "SERVING"}``.
+- ``Models`` (unary-unary): ``{}`` -> ``{"models": [name]}``.
+
+Usage: ``python -m vllm_tpu.entrypoints.grpc_server --model ... --port
+50051``; call with any gRPC client via method paths like
+``/vllmtpu.LLM/Generate`` using JSON-encoded bytes.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import uuid
+
+import grpc
+
+from vllm_tpu.engine.arg_utils import AsyncEngineArgs
+from vllm_tpu.logger import init_logger
+from vllm_tpu.sampling_params import SamplingParams
+
+logger = init_logger(__name__)
+
+_SERVICE = "vllmtpu.LLM"
+
+
+def _dumps(obj: dict) -> bytes:
+    return json.dumps(obj).encode()
+
+
+def _build_sampling_params(spec: dict) -> SamplingParams:
+    import dataclasses
+
+    fields = {f.name for f in dataclasses.fields(SamplingParams)}
+    unknown = set(spec) - fields
+    if unknown:
+        raise ValueError(f"unknown sampling_params keys: {sorted(unknown)}")
+    return SamplingParams(**spec)
+
+
+def make_server(engine, model_name: str) -> grpc.aio.Server:
+    async def generate(request: bytes, context):
+        try:
+            req = json.loads(request)
+            prompt = (
+                {"prompt_token_ids": req["prompt_token_ids"]}
+                if "prompt_token_ids" in req
+                else req["prompt"]
+            )
+            params = _build_sampling_params(req.get("sampling_params", {}))
+        except (KeyError, TypeError, ValueError, json.JSONDecodeError) as exc:
+            await context.abort(
+                grpc.StatusCode.INVALID_ARGUMENT, str(exc)
+            )
+            return
+        rid = req.get("request_id") or f"grpc-{uuid.uuid4().hex[:16]}"
+        sent = 0
+        async for out in engine.generate(prompt, params, rid):
+            comp = out.outputs[0]
+            yield _dumps({
+                "request_id": rid,
+                "text": comp.text[sent:],
+                "token_ids": list(comp.token_ids),
+                "finished": out.finished,
+                "finish_reason": comp.finish_reason,
+            })
+            sent = len(comp.text)
+
+    async def health(request: bytes, context):
+        return _dumps({"status": "SERVING"})
+
+    async def models(request: bytes, context):
+        return _dumps({"models": [model_name]})
+
+    ident = lambda b: b  # JSON bytes in/out; no protobuf schema
+    handlers = grpc.method_handlers_generic_handler(_SERVICE, {
+        "Generate": grpc.unary_stream_rpc_method_handler(
+            generate, request_deserializer=ident, response_serializer=ident
+        ),
+        "Health": grpc.unary_unary_rpc_method_handler(
+            health, request_deserializer=ident, response_serializer=ident
+        ),
+        "Models": grpc.unary_unary_rpc_method_handler(
+            models, request_deserializer=ident, response_serializer=ident
+        ),
+    })
+    server = grpc.aio.server()
+    server.add_generic_rpc_handlers((handlers,))
+    return server
+
+
+async def run_server(args) -> None:
+    from vllm_tpu.engine.async_llm import AsyncLLM
+
+    engine = AsyncLLM.from_engine_args(
+        AsyncEngineArgs(**{
+            k: v for k, v in vars(args).items()
+            if k not in ("host", "port")
+        })
+    )
+    server = make_server(engine, args.model)
+    addr = f"{args.host}:{args.port}"
+    server.add_insecure_port(addr)
+    await server.start()
+    logger.info("gRPC server listening on %s", addr)
+    try:
+        await server.wait_for_termination()
+    finally:
+        engine.shutdown()
+
+
+def main() -> None:  # pragma: no cover - CLI
+    parser = argparse.ArgumentParser(description="vllm-tpu gRPC server")
+    parser.add_argument("--host", default="0.0.0.0")
+    parser.add_argument("--port", type=int, default=50051)
+    AsyncEngineArgs.add_cli_args(parser)
+    args = parser.parse_args()
+    asyncio.run(run_server(args))
+
+
+if __name__ == "__main__":  # pragma: no cover
+    main()

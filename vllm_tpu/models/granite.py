@@ -1,0 +1,35 @@
+"""IBM Granite (Llama graph + scalar modulation).
+
+Reference analog: ``vllm/model_executor/models/granite.py``. Granite's
+only graph deltas from Llama are four scalars from the config:
+``embedding_multiplier`` scales token embeddings, ``attention_multiplier``
+REPLACES the 1/sqrt(head_dim) attention scale, ``residual_multiplier``
+scales both residual branches, and logits divide by ``logits_scaling``.
+All are woven through the stock Llama layer function via the modulation
+hooks on the base class.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax.numpy as jnp
+
+from vllm_tpu.models.llama import LlamaForCausalLM
+
+
+class GraniteForCausalLM(LlamaForCausalLM):
+    def __init__(self, hf_config: Any, dtype=jnp.bfloat16,
+                 quantization: str | None = None) -> None:
+        super().__init__(hf_config, dtype, quantization)
+        c = hf_config
+        self.embedding_multiplier = float(
+            getattr(c, "embedding_multiplier", 1.0)
+        )
+        self.residual_multiplier = float(
+            getattr(c, "residual_multiplier", 1.0)
+        )
+        self.logits_scaling = float(getattr(c, "logits_scaling", 1.0))
+        attn_mult = getattr(c, "attention_multiplier", None)
+        if attn_mult is not None:
+            self.scale = float(attn_mult)

@@ -71,6 +71,10 @@ class LlamaForCausalLM:
     # (``ops/cp_attention.cp_write_and_attend``).
     cp_size = 1
     cp_mesh = None
+    # Granite-style scalar modulation hooks (all 1.0 = plain Llama).
+    embedding_multiplier = 1.0
+    residual_multiplier = 1.0
+    logits_scaling = 1.0
     # lax.scan over the stacked layer weights vs an unrolled Python loop.
     # Scan compiles fast and is the default; its xs layout assignment can
     # materialize a run-time copy of the WHOLE weight stack, so large
@@ -224,6 +228,8 @@ class LlamaForCausalLM:
             if inputs_embeds is not None
             else params["embed"][input_ids].astype(self.dtype)
         )  # [T, D]
+        if self.embedding_multiplier != 1.0:
+            x = x * self.embedding_multiplier
         if self.pp_size > 1:
             return self._apply_pp(params, kv_cache, x, md)
         layer_fn = self._make_layer_fn(
@@ -318,12 +324,14 @@ class LlamaForCausalLM:
                     sliding_window=self.sliding_window,
                     k_scale=kv_scale, v_scale=kv_scale,
                 )
-            x = x + proj(attn.reshape(t, H * Dh), lp, "wo")
+            x = x + self.residual_multiplier * proj(
+                attn.reshape(t, H * Dh), lp, "wo"
+            )
 
             h2 = rms_norm(x, lp["post_norm"], self.rms_eps)
             gate = proj(h2, lp, "wgate")
             up = proj(h2, lp, "wup")
-            x = x + proj(
+            x = x + self.residual_multiplier * proj(
                 silu_and_mul(jnp.concatenate([gate, up], axis=-1)),
                 lp, "wdown",
             )
@@ -453,7 +461,10 @@ class LlamaForCausalLM:
 
     def compute_logits(self, params: dict, hidden: jnp.ndarray) -> jnp.ndarray:
         head = params["embed"].T if self.tie_embeddings else params["lm_head"]
-        return (hidden @ head.astype(hidden.dtype)).astype(jnp.float32)
+        logits = (hidden @ head.astype(hidden.dtype)).astype(jnp.float32)
+        if self.logits_scaling != 1.0:
+            logits = logits / self.logits_scaling  # Granite semantics
+        return logits
 
     # ------------------------------------------------------------------
     # Runner contracts

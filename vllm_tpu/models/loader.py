@@ -31,6 +31,23 @@ def _set_path(tree: dict, path: str, value: Any) -> None:
     node[parts[-1]] = value
 
 
+def _stage(dest: str, arr, staged: dict, stacked: dict, stacked2: dict) -> None:
+    """Route one converted tensor to its staging slot: direct leaf,
+    layer-stacked (trailing ``.{i}``), or (layer, expert)-stacked. Stack
+    lengths are inferred (not num_layers): models with heterogeneous layer
+    groups (e.g. DeepSeek's dense prefix + MoE rest) keep stacks of
+    differing lengths."""
+    parts = dest.split(".")
+    if len(parts) >= 3 and parts[-1].isdigit() and parts[-2].isdigit():
+        base = ".".join(parts[:-2])
+        stacked2.setdefault(base, {})[(int(parts[-2]), int(parts[-1]))] = arr
+    elif len(parts) >= 2 and parts[-1].isdigit():
+        base = ".".join(parts[:-1])
+        stacked.setdefault(base, {})[int(parts[-1])] = arr
+    else:
+        staged[dest] = arr
+
+
 def _iter_safetensor_files(path: str) -> list[str]:
     index = os.path.join(path, "model.safetensors.index.json")
     if os.path.exists(index):
@@ -93,6 +110,28 @@ def load_safetensors_params(
                         )
                         seen.add(stem + ".weight")
                     continue
+                # Fused-checkpoint split (e.g. Phi-3's qkv_proj /
+                # gate_up_proj): the model may explode one tensor into
+                # several, each then mapping normally.
+                splitter = getattr(model, "split_hf_tensor", None)
+                pieces = None
+                if splitter is not None and hf_name not in weight_map:
+                    arr0 = f.get_tensor(raw_name)
+                    if arr0.dtype == np.uint16:
+                        arr0 = arr0.view(jnp.bfloat16)
+                    pieces = splitter(hf_name, arr0)
+                if pieces:
+                    for sub_name, sub_arr in pieces:
+                        if sub_name not in weight_map:
+                            continue
+                        dest, transpose = weight_map[sub_name]
+                        _stage(
+                            dest,
+                            sub_arr.T if transpose else sub_arr,
+                            staged, stacked, stacked2,
+                        )
+                        seen.add(sub_name)
+                    continue
                 if hf_name not in weight_map:
                     continue
                 dest, transpose = weight_map[hf_name]
@@ -101,20 +140,7 @@ def load_safetensors_params(
                     arr = arr.view(jnp.bfloat16)
                 if transpose:
                     arr = arr.T
-                parts = dest.split(".")
-                if len(parts) >= 3 and parts[-1].isdigit() and parts[-2].isdigit():
-                    base = ".".join(parts[:-2])
-                    stacked2.setdefault(base, {})[
-                        (int(parts[-2]), int(parts[-1]))
-                    ] = arr
-                elif len(parts) >= 2 and parts[-1].isdigit():
-                    # Stack length is inferred (not num_layers): models with
-                    # heterogeneous layer groups (e.g. DeepSeek's dense
-                    # prefix + MoE rest) keep stacks of differing lengths.
-                    base = ".".join(parts[:-1])
-                    stacked.setdefault(base, {})[int(parts[-1])] = arr
-                else:
-                    staged[dest] = arr
+                _stage(dest, arr, staged, stacked, stacked2)
                 seen.add(hf_name)
 
     # Completeness is judged by DESTINATION, not HF name: several HF

@@ -1,0 +1,52 @@
+"""Phi-3 family (fused-projection Llama variant).
+
+Reference analog: ``vllm/model_executor/models/phi3.py`` (an alias of the
+llama graph with fused checkpoint tensors). Phi-3 stores ``qkv_proj``
+([Hq+2Hkv]*Dh rows) and ``gate_up_proj`` (2F rows) fused; the loader's
+``split_hf_tensor`` hook explodes them into the standard per-projection
+names, after which the stock Llama graph applies. Long-context variants
+using the ``longrope``/``su`` rope scaling are rejected loudly (their
+dual short/long factor tables are not implemented).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax.numpy as jnp
+
+from vllm_tpu.models.llama import LlamaForCausalLM
+
+
+class Phi3ForCausalLM(LlamaForCausalLM):
+    def __init__(self, hf_config: Any, dtype=jnp.bfloat16,
+                 quantization: str | None = None) -> None:
+        scaling = getattr(hf_config, "rope_scaling", None) or {}
+        kind = scaling.get("rope_type", scaling.get("type"))
+        if kind in ("longrope", "su"):
+            raise NotImplementedError(
+                "Phi-3 longrope scaling (dual short/long factor tables) "
+                "is not supported yet; 4k-context variants load fine"
+            )
+        super().__init__(hf_config, dtype, quantization)
+
+    def split_hf_tensor(self, hf_name: str, arr):
+        """qkv_proj -> q/k/v_proj; gate_up_proj -> gate/up_proj (HF
+        layout: rows are output features)."""
+        if hf_name.endswith(".self_attn.qkv_proj.weight"):
+            q_rows = self.num_heads * self.head_dim
+            kv_rows = self.num_kv_heads * self.head_dim
+            base = hf_name[: -len("qkv_proj.weight")]
+            return [
+                (base + "q_proj.weight", arr[:q_rows]),
+                (base + "k_proj.weight", arr[q_rows : q_rows + kv_rows]),
+                (base + "v_proj.weight", arr[q_rows + kv_rows :]),
+            ]
+        if hf_name.endswith(".mlp.gate_up_proj.weight"):
+            f = self.intermediate_size
+            base = hf_name[: -len("gate_up_proj.weight")]
+            return [
+                (base + "gate_proj.weight", arr[:f]),
+                (base + "up_proj.weight", arr[f:]),
+            ]
+        return None

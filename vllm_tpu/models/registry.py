@@ -30,6 +30,8 @@ _REGISTRY: dict[str, tuple[str, str]] = {
     "DeepseekV3ForCausalLM": ("vllm_tpu.models.deepseek", "DeepseekV3ForCausalLM"),
     "Mamba2ForCausalLM": ("vllm_tpu.models.mamba2", "Mamba2ForCausalLM"),
     "BambaForCausalLM": ("vllm_tpu.models.bamba", "BambaForCausalLM"),
+    "Phi3ForCausalLM": ("vllm_tpu.models.phi3", "Phi3ForCausalLM"),
+    "GraniteForCausalLM": ("vllm_tpu.models.granite", "GraniteForCausalLM"),
     "LlavaForConditionalGeneration": ("vllm_tpu.models.llava", "LlavaForConditionalGeneration"),
 }
 

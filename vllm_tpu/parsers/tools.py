@@ -157,6 +157,8 @@ class MistralToolParser(ToolParser):
             c for item in items if isinstance(item, dict)
             if (c := _coerce_call(item)) is not None
         ]
+        if not calls:
+            return ParsedToolOutput(content=text, tool_calls=[])
         tail = payload[end:].strip()
         full_content = " ".join(s for s in (content.strip(), tail) if s)
         return ParsedToolOutput(
@@ -260,6 +262,10 @@ class PythonicToolParser(ToolParser):
             except ValueError:
                 continue
             calls.append(ToolCall(name=name, arguments=json.dumps(kwargs)))
+        if not calls:
+            # No usable calls: the text (prose citations like "[ref(2)]"
+            # included) must survive untouched.
+            return ParsedToolOutput(content=text, tool_calls=[])
         content = (text[:start] + text[end:]).strip()
         return ParsedToolOutput(
             content=content or None, tool_calls=calls
