@@ -87,7 +87,9 @@ def test_grpc_generate_stream(grpc_addr):
         }
         msgs = [json.loads(m) for m in gen(json.dumps(req).encode())]
         assert msgs and msgs[-1]["finished"]
-        assert len(msgs[-1]["token_ids"]) == 6
+        # token_ids stream as DELTAS; the concatenation is the generation.
+        all_tokens = [t for m in msgs for t in m["token_ids"]]
+        assert len(all_tokens) == 6
         assert msgs[-1]["finish_reason"] == "length"
 
 

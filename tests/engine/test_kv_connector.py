@@ -87,3 +87,38 @@ def test_connector_matching_logic():
     # Nothing beyond the device hit.
     assert c.get_num_new_matched_tokens(["h0", "h2"], 16, 16) == 0
     assert c.request_finished(["h0", "hX"]) == [1]
+
+
+def test_failed_kv_load_reschedules_request(ckpt):
+    """A load that fails AFTER the scheduler counted the hit (store died
+    or lost the blocks in between) must reschedule the request for full
+    recompute with correct output -- request-scoped recovery, never an
+    engine crash (reference: invalid-block recovery, scheduler.py:2123)."""
+    llm = _mk(ckpt)
+    rng = np.random.default_rng(3)
+    prompt = {"prompt_token_ids": rng.integers(5, 120, size=48).tolist()}
+    first = llm.generate([prompt], SP)[0].outputs[0].token_ids
+
+    core = llm.llm_engine.engine_core.engine_core
+    assert core.reset_prefix_cache()  # force the external-store path
+    connector = core.kv_connector
+
+    real_load = connector.load_blocks
+    fail_once = {"armed": True}
+
+    def flaky_load(keys):
+        if fail_once["armed"]:
+            fail_once["armed"] = False
+            raise KeyError("store lost the blocks")
+        return real_load(keys)
+
+    connector.load_blocks = flaky_load
+    try:
+        again = llm.generate([prompt], SP)[0].outputs[0].token_ids
+    finally:
+        connector.load_blocks = real_load
+    assert again == first
+    sched = core.scheduler
+    assert sched._num_invalid_loads == 1
+    # The retried request recomputed rather than re-querying the store.
+    assert not fail_once["armed"]

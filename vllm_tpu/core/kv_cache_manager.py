@@ -107,6 +107,7 @@ class KVCacheManager:
         new_computed_blocks: list[KVCacheBlock] | None = None,
         num_new_computed_tokens: int = 0,
         num_lookahead_tokens: int = 0,
+        defer_caching_tokens: int = 0,
     ) -> list[KVCacheBlock] | None:
         """Ensure the request has blocks covering its tokens after this step.
 
@@ -163,7 +164,15 @@ class KVCacheManager:
             req_blocks.extend(new_blocks)
 
         if self.enable_caching:
-            self._cache_full_blocks(request, num_computed_tokens + num_new_tokens)
+            # ``defer_caching_tokens``: an externally-loaded span is not
+            # trustworthy until its load succeeds; registering it (or
+            # anything after it — hashes chain) now would let OTHER
+            # requests prefix-hit garbage if the load fails. The next
+            # allocate call catches registration up.
+            self._cache_full_blocks(
+                request,
+                num_computed_tokens + num_new_tokens - defer_caching_tokens,
+            )
         return new_blocks
 
     def _free_out_of_window(
@@ -221,6 +230,14 @@ class KVCacheManager:
     # ------------------------------------------------------------------
     # Free
     # ------------------------------------------------------------------
+
+    def invalidate_cached_blocks(self, request: Request) -> None:
+        """Drop the request's blocks from the prefix cache (their content
+        is garbage after a failed external KV load — a later request, or
+        this one's recompute, must not hit them)."""
+        for b in self.req_to_blocks.get(request.request_id, []):
+            self.block_pool._maybe_evict_cached_block(b)
+        self.num_cached_blocks.pop(request.request_id, None)
 
     def free(self, request: Request) -> None:
         """Release all blocks. Freed tail-first so eviction consumes the end

@@ -105,6 +105,10 @@ class ModelRunnerOutput:
     draft_token_ids: dict[str, list[int]] = field(default_factory=dict)
     # Pooling-model outputs keyed by req_id.
     pooler_outputs: dict[str, Any] = field(default_factory=dict)
+    # Requests whose external KV load failed: outputs are garbage, the
+    # scheduler reschedules them for recompute (reference: invalid-block
+    # recovery, scheduler.py:2123/2226).
+    invalid_req_ids: set[str] = field(default_factory=set)
 
 
 EMPTY_MODEL_RUNNER_OUTPUT = ModelRunnerOutput()

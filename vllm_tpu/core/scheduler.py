@@ -157,6 +157,7 @@ class Scheduler:
         # counter would lose events when async lag-1 runs two schedule()
         # calls between logger updates).
         self._num_preempted_total = 0
+        self._num_invalid_loads = 0
         # Cumulative spec-decode accounting (acceptance-rate metric).
         self._spec_num_draft_tokens = 0
         self._spec_num_accepted_tokens = 0
@@ -438,8 +439,11 @@ class Scheduler:
             request = self.waiting.peek()
 
             # Async scheduling: a preempted request with an in-flight output
-            # token must wait for it to materialize before re-prefilling.
-            if request.num_output_placeholders > 0:
+            # token must wait for it to materialize before re-prefilling —
+            # and an invalid-load recompute must wait for ALL its garbage
+            # in-flight outputs to drain (a resumed step's legit output
+            # would otherwise be indistinguishable from them).
+            if request.num_output_placeholders > 0 or request.dropping_invalid:
                 break
 
             # Structured-output grammar still compiling -> leave in queue.
@@ -498,6 +502,7 @@ class Scheduler:
             if (
                 self.kv_connector is not None
                 and request.num_computed_tokens == 0
+                and not request.skip_external_kv
                 and request.block_hashes
                 # External hits skip compute too: same exclusions as the
                 # device prefix-cache path above.
@@ -553,6 +558,15 @@ class Scheduler:
                 new_computed_blocks=new_computed_blocks,
                 num_new_computed_tokens=num_new_computed_tokens,
                 num_lookahead_tokens=self.config.num_lookahead_tokens,
+                # Hold back prefix-cache registration from the start of
+                # the externally-loaded span: its content is garbage if
+                # the load later fails (hashes chain, so everything after
+                # the span is held back too; the next allocate catches up).
+                defer_caching_tokens=(
+                    num_external_tokens + num_new_tokens
+                    if num_external_tokens
+                    else 0
+                ),
             )
             if new_blocks is None:
                 self._rollback_encoder(request, enc_new)
@@ -726,6 +740,22 @@ class Scheduler:
         ``_update_after_schedule``); the sync scheduler advances in
         update_from_output."""
 
+    def _drain_invalid(
+        self, request: Request, req_id: str, runner_output, req_index: int
+    ) -> None:
+        """Consume an invalid-epoch step's placeholders without appending
+        its garbage tokens; resume waits until the count drains to 0."""
+        generated = runner_output.sampled_token_ids[req_index]
+        request.num_output_placeholders = max(
+            0, request.num_output_placeholders - max(len(generated), 0)
+        )
+        request.num_inflight_steps = max(0, request.num_inflight_steps - 1)
+        if (
+            request.num_output_placeholders == 0
+            and request.num_inflight_steps == 0
+        ):
+            request.dropping_invalid = False
+
     def _preempt(self, request: Request) -> None:
         self.kv_cache_manager.free(request)
         # Encoder outputs are tied to computed positions; a resume restarts
@@ -765,6 +795,36 @@ class Scheduler:
                 continue
             num_tokens_scheduled = scheduler_output.num_scheduled_tokens.get(req_id)
             if num_tokens_scheduled is None:
+                continue
+            if req_id in runner_output.invalid_req_ids:
+                # External KV load failed: this step's output for the
+                # request is garbage. Reschedule via the preemption path
+                # (blocks freed, recompute from 0) — the failure stays
+                # request-scoped. Reference: _handle_invalid_blocks,
+                # scheduler.py:2226.
+                self._num_invalid_loads += 1
+                logger.warning(
+                    "rescheduling %s after failed external KV load",
+                    req_id,
+                )
+                request.skip_external_kv = True
+                request.dropping_invalid = True
+                # Belt-and-braces: registration of the external span was
+                # deferred, but evict anything this request did register.
+                self.kv_cache_manager.invalidate_cached_blocks(request)
+                if request.status == RequestStatus.RUNNING:
+                    if request in self.running:
+                        self.running.remove(request)
+                    self._preempt(request)
+                # else: already preempted (block-pressure victim between
+                # dispatch and update) — it sits in waiting once; a second
+                # _preempt would double-insert it.
+                self._drain_invalid(request, req_id, runner_output, req_index)
+                continue
+            if request.dropping_invalid:
+                # In-flight output from before an invalid-load preemption:
+                # drain its placeholders without materializing tokens.
+                self._drain_invalid(request, req_id, runner_output, req_index)
                 continue
 
             generated = runner_output.sampled_token_ids[req_index]

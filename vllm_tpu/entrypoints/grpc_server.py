@@ -68,17 +68,18 @@ def make_server(engine, model_name: str) -> grpc.aio.Server:
             )
             return
         rid = req.get("request_id") or f"grpc-{uuid.uuid4().hex[:16]}"
-        sent = 0
+        sent_text = sent_tok = 0
         async for out in engine.generate(prompt, params, rid):
             comp = out.outputs[0]
             yield _dumps({
                 "request_id": rid,
-                "text": comp.text[sent:],
-                "token_ids": list(comp.token_ids),
+                "text": comp.text[sent_text:],
+                "token_ids": list(comp.token_ids[sent_tok:]),
                 "finished": out.finished,
                 "finish_reason": comp.finish_reason,
             })
-            sent = len(comp.text)
+            sent_text = len(comp.text)
+            sent_tok = len(comp.token_ids)
 
     async def health(request: bytes, context):
         return _dumps({"status": "SERVING"})

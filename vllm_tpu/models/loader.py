@@ -115,7 +115,13 @@ def load_safetensors_params(
                 # several, each then mapping normally.
                 splitter = getattr(model, "split_hf_tensor", None)
                 pieces = None
-                if splitter is not None and hf_name not in weight_map:
+                if (
+                    splitter is not None
+                    and hf_name not in weight_map
+                    and hf_name.endswith(
+                        getattr(model, "SPLIT_SUFFIXES", ())
+                    )
+                ):
                     arr0 = f.get_tensor(raw_name)
                     if arr0.dtype == np.uint16:
                         arr0 = arr0.view(jnp.bfloat16)

@@ -19,6 +19,12 @@ from vllm_tpu.models.llama import LlamaForCausalLM
 
 
 class Phi3ForCausalLM(LlamaForCausalLM):
+    # Fused tensors the loader offers to split_hf_tensor (name gate: no
+    # disk read for other unmapped tensors).
+    SPLIT_SUFFIXES = (
+        ".self_attn.qkv_proj.weight", ".mlp.gate_up_proj.weight",
+    )
+
     def __init__(self, hf_config: Any, dtype=jnp.bfloat16,
                  quantization: str | None = None) -> None:
         scaling = getattr(hf_config, "rope_scaling", None) or {}

@@ -103,6 +103,15 @@ class Request:
         self.num_inflight_steps = 0
         # Number of scheduler preemptions (stats).
         self.num_preemptions = 0
+        # Set after a FAILED external KV load: the rescheduled request
+        # recomputes instead of re-querying the store (a store that still
+        # advertises the keys but cannot serve them would otherwise loop
+        # the request forever).
+        self.skip_external_kv = False
+        # Transient: in-flight step outputs from before an invalid-load
+        # preemption are garbage; they drain placeholders without
+        # materializing tokens, then the flag clears and resume proceeds.
+        self.dropping_invalid = False
         # Structured output: compiled-grammar future + current DFA state
         # (managed by StructuredOutputManager; -1 = dead).
         self.grammar_future: Any = None

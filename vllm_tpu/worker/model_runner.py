@@ -54,6 +54,10 @@ class StepHandle:
         self.prompt_lp = None  # (vals, ids, tok_lp, rank) over [T]
         self.prompt_rows = None  # [(row_i, offset, start, n, prompt_len)]
         self.moe_counts = None  # [L, E] expert token counts (EPLB)
+        # Requests whose external KV load failed this step: their outputs
+        # are garbage and the scheduler must reschedule them (reference:
+        # invalid-block recovery, scheduler.py:2123).
+        self.failed_loads: set[str] = set()
 
 
 def _bucket(value: int, buckets: list[int]) -> int:
@@ -1230,14 +1234,29 @@ class ModelRunner:
             [payloads[:, i] for i in range(payloads.shape[1])],
         )
 
-    def _kv_connector_loads(self, load_map: dict) -> None:
+    def _kv_connector_loads(self, load_map: dict) -> set[str]:
         """Fill freshly allocated blocks from the external store before
         the step that reads them enqueues. Block counts pad to power-of-2
         buckets (padding scatters zeros into the write-only null block 0)
-        so the jitted scatter compiles a bounded set of variants."""
+        so the jitted scatter compiles a bounded set of variants.
+
+        Returns the request ids whose load FAILED (store died between the
+        scheduler's hit accounting and now): their step output is garbage
+        and the scheduler reschedules them to recompute — a request-level
+        failure, never an engine crash (reference: scheduler.py:2123
+        invalid-block recovery)."""
         assert self.kv_connector is not None
+        failed: set[str] = set()
         for rid, (block_ids, keys) in load_map.items():
-            arrs = self.kv_connector.load_blocks(keys)
+            try:
+                arrs = self.kv_connector.load_blocks(keys)
+            except Exception as exc:
+                logger.warning(
+                    "external KV load failed for %s (%s); rescheduling "
+                    "for recompute", rid, exc,
+                )
+                failed.add(rid)
+                continue
             vals = np.stack(arrs, axis=1)  # [L, N, BS, ...]
             n = vals.shape[1]
             n_pad = 1 << (n - 1).bit_length()
@@ -1253,6 +1272,7 @@ class ModelRunner:
                 self.kv_cache, jnp.asarray(ids),
                 jnp.asarray(vals).astype(self.kv_cache.dtype),
             )
+        return failed
 
     def _single_pos_metadata(self, md, p, r_pad):
         """Per-row single-position AttentionMetadata (decode chain /
@@ -1384,8 +1404,9 @@ class ModelRunner:
         self._update_states(so)
         if so.total_num_scheduled_tokens == 0:
             return StepHandle(empty=True)
+        failed_loads: set[str] = set()
         if so.kv_connector_load:
-            self._kv_connector_loads(so.kv_connector_load)
+            failed_loads = self._kv_connector_loads(so.kv_connector_load)
         if self.is_mm:
             self._run_encoders(so)
         (arrays, req_order, do_sample, flags,
@@ -1451,6 +1472,7 @@ class ModelRunner:
         handle.prompt_rows = (
             prompt_rows if flags["num_prompt_logprobs"] else None
         )
+        handle.failed_loads = failed_loads
         return handle
 
     def finalize(self, handle: "StepHandle") -> ModelRunnerOutput:
@@ -1498,7 +1520,9 @@ class ModelRunner:
             if self.eplb_state.due:
                 self._rebalance_experts()
 
-        out = ModelRunnerOutput(req_ids=req_order)
+        out = ModelRunnerOutput(
+            req_ids=req_order, invalid_req_ids=handle.failed_loads
+        )
         if handle.prompt_lp is not None and handle.prompt_rows:
             for (i, row, off, start, count, k) in handle.prompt_rows:
                 rid = req_order[i]
