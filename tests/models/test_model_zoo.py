@@ -250,7 +250,9 @@ def test_phi3_hf_parity(tmp_path_factory):
         [{"prompt_token_ids": prompt}],
         SamplingParams(temperature=0.0, max_tokens=8, ignore_eos=True),
     )[0].outputs[0].token_ids
-    assert got == want
+    # HF generate stops at EOS; ours ran with ignore_eos -- compare the
+    # emitted prefix (non-empty by construction).
+    assert want and got[: len(want)] == want
 
 
 def test_granite_hf_parity(tmp_path_factory):
@@ -288,3 +290,89 @@ def test_granite_hf_parity(tmp_path_factory):
         SamplingParams(temperature=0.0, max_tokens=8, ignore_eos=True),
     )[0].outputs[0].token_ids
     assert got == want
+
+
+def test_phi3_longrope_hf_parity(tmp_path_factory):
+    """Phi-3 longrope (dual short/long factor tables): exact HF parity
+    for sequences inside the original window (beyond it, HF re-bases the
+    whole sequence while paged serving uses per-position tables -- the
+    reference serving semantics)."""
+    import numpy as np
+    import torch
+    from transformers import Phi3Config, Phi3ForCausalLM
+
+    from vllm_tpu import LLM, SamplingParams
+
+    rd2 = 8  # rotary_dim / 2 = head_dim / 2
+    cfg = Phi3Config(
+        vocab_size=128, hidden_size=64, intermediate_size=96,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=128,
+        original_max_position_embeddings=64,
+        rope_scaling={
+            "type": "longrope",
+            "short_factor": [1.0 + 0.05 * i for i in range(rd2)],
+            "long_factor": [2.0 + 0.3 * i for i in range(rd2)],
+        },
+        tie_word_embeddings=False,
+        pad_token_id=0, bos_token_id=1, eos_token_id=2,
+    )
+    torch.manual_seed(0)
+    hf = Phi3ForCausalLM(cfg).to(torch.float32).eval()
+    path = str(tmp_path_factory.mktemp("tiny_phi3_lr"))
+    hf.save_pretrained(path, safe_serialization=True)
+    prompt = np.random.default_rng(2).integers(5, 120, size=17).tolist()
+    with torch.no_grad():
+        want = hf.generate(
+            torch.tensor([prompt]), max_new_tokens=8, do_sample=False,
+            pad_token_id=0,
+        )[0, len(prompt):].tolist()
+    llm = LLM(
+        model=path, dtype="float32", max_model_len=64, block_size=16,
+        num_gpu_blocks_override=64, max_num_seqs=4,
+        max_num_batched_tokens=128,
+    )
+    got = llm.generate(
+        [{"prompt_token_ids": prompt}],
+        SamplingParams(temperature=0.0, max_tokens=8, ignore_eos=True),
+    )[0].outputs[0].token_ids
+    # HF generate stops at EOS; ours ran with ignore_eos --
+    # compare the emitted prefix (non-empty by construction).
+    assert want and got[: len(want)] == want
+
+
+def test_longrope_dual_tables():
+    """Rows past original_max use the LONG factors (the parity test stays
+    inside the short window, so this covers the other branch)."""
+    import math
+
+    import numpy as np
+
+    from vllm_tpu.layers.rotary import RotaryEmbedding, _base_inv_freq
+
+    rd2 = 8
+    short = [1.0 + 0.05 * i for i in range(rd2)]
+    long = [2.0 + 0.3 * i for i in range(rd2)]
+    rope = RotaryEmbedding(
+        head_dim=16, max_position=128, theta=10000.0,
+        rope_scaling={"type": "longrope", "short_factor": short,
+                      "long_factor": long},
+        original_max_position=64,
+    )
+    inv = _base_inv_freq(16, 10000.0)
+    mscale = math.sqrt(1 + math.log(128 / 64) / math.log(64))
+    for pos, factors in ((5, short), (63, short), (64, long), (100, long)):
+        want = np.cos(pos * inv / np.asarray(factors)) * mscale
+        np.testing.assert_allclose(
+            np.asarray(rope._cos_np)[pos], want, rtol=1e-5,
+            err_msg=f"pos {pos}",
+        )
+    # Missing pivot fails loudly.
+    import pytest
+
+    with pytest.raises(ValueError, match="original_max"):
+        RotaryEmbedding(
+            head_dim=16, max_position=128,
+            rope_scaling={"type": "longrope", "short_factor": short,
+                          "long_factor": long},
+        )

@@ -88,11 +88,14 @@ class RotaryEmbedding:
         rope_scaling: dict[str, Any] | None = None,
         rotary_dim: int | None = None,
         dtype=jnp.float32,
+        original_max_position: int | None = None,
     ) -> None:
         self.head_dim = head_dim
         self.rotary_dim = rotary_dim or head_dim
         inv_freq = _base_inv_freq(head_dim, theta, rotary_dim)
         mscale = 1.0
+        inv_freq_long = None  # longrope: second basis past original_max
+        original_max = max_position
         if rope_scaling:
             rope_type = rope_scaling.get("rope_type", rope_scaling.get("type", "default"))
             if rope_type == "llama3":
@@ -103,6 +106,45 @@ class RotaryEmbedding:
                 inv_freq, mscale = _yarn_scale(
                     inv_freq, rope_scaling, self.rotary_dim, theta
                 )
+            elif rope_type in ("longrope", "su"):
+                # Phi-3 long-context recipe: per-frequency SHORT factors
+                # inside the original window, LONG factors beyond, both
+                # attention-scaled. Per-POSITION table choice follows the
+                # reference serving implementation; HF instead re-bases
+                # the WHOLE sequence once its length crosses original_max
+                # (unservable with a paged cache — early K would need
+                # recompute), so outputs match HF exactly for sequences
+                # within one regime.
+                original_max = int(
+                    rope_scaling.get(
+                        "original_max_position_embeddings",
+                        original_max_position or 0,
+                    )
+                )
+                if not original_max:
+                    # Without the pivot the long table/mscale would be
+                    # silently dropped — numerically wrong, so refuse.
+                    raise ValueError(
+                        "longrope scaling needs original_max_position_"
+                        "embeddings (in rope_scaling or the model config)"
+                    )
+                short = np.asarray(
+                    rope_scaling["short_factor"], np.float64
+                )
+                long = np.asarray(rope_scaling["long_factor"], np.float64)
+                factor = rope_scaling.get(
+                    "factor", max(max_position / original_max, 1.0)
+                )
+                mscale = rope_scaling.get("attention_factor")
+                if mscale is None:
+                    mscale = (
+                        1.0 if factor <= 1.0
+                        else math.sqrt(
+                            1 + math.log(factor) / math.log(original_max)
+                        )
+                    )
+                inv_freq_long = inv_freq / long
+                inv_freq = inv_freq / short
             elif rope_type in ("default", "dynamic"):
                 pass  # dynamic NTK beyond max_position: out of round-1 scope
             else:
@@ -110,6 +152,10 @@ class RotaryEmbedding:
 
         t = np.arange(max_position, dtype=np.float64)
         freqs = np.outer(t, inv_freq)  # [P, rd/2]
+        if inv_freq_long is not None and max_position > original_max:
+            freqs[original_max:] = np.outer(
+                t[original_max:], inv_freq_long
+            )
         # HOST arrays: they reach jit as inline constants, so lowering
         # never needs a device fetch (a d2h read can fail under memory
         # pressure right after large-model init on the axon tunnel).

@@ -106,6 +106,10 @@ class LlamaForCausalLM:
             max_position=self.max_position,
             theta=getattr(c, "rope_theta", 10000.0),
             rope_scaling=getattr(c, "rope_scaling", None),
+            # Phi-3-style longrope keeps its pivot at config level.
+            original_max_position=getattr(
+                c, "original_max_position_embeddings", None
+            ),
         )
 
     # ------------------------------------------------------------------

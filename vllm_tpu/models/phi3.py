@@ -5,8 +5,8 @@ llama graph with fused checkpoint tensors). Phi-3 stores ``qkv_proj``
 ([Hq+2Hkv]*Dh rows) and ``gate_up_proj`` (2F rows) fused; the loader's
 ``split_hf_tensor`` hook explodes them into the standard per-projection
 names, after which the stock Llama graph applies. Long-context variants
-using the ``longrope``/``su`` rope scaling are rejected loudly (their
-dual short/long factor tables are not implemented).
+use the ``longrope`` dual short/long factor tables (``layers/rotary.py``:
+per-position table choice, matching the reference's serving semantics).
 """
 
 from __future__ import annotations
@@ -27,13 +27,6 @@ class Phi3ForCausalLM(LlamaForCausalLM):
 
     def __init__(self, hf_config: Any, dtype=jnp.bfloat16,
                  quantization: str | None = None) -> None:
-        scaling = getattr(hf_config, "rope_scaling", None) or {}
-        kind = scaling.get("rope_type", scaling.get("type"))
-        if kind in ("longrope", "su"):
-            raise NotImplementedError(
-                "Phi-3 longrope scaling (dual short/long factor tables) "
-                "is not supported yet; 4k-context variants load fine"
-            )
         super().__init__(hf_config, dtype, quantization)
 
     def split_hf_tensor(self, hf_name: str, arr):
