@@ -71,6 +71,12 @@ class LlamaForCausalLM:
     # (``ops/cp_attention.cp_write_and_attend``).
     cp_size = 1
     cp_mesh = None
+    # Norm placement: True = pre-norm (Llama); False = post-sublayer
+    # norms on the same weight leaves (OLMo-2).
+    pre_norm = True
+    # qk-norm over the full projected vector, pre-head-split (OLMo-2),
+    # vs the per-head qk_norm flag (Qwen3).
+    qk_norm_full = False
     # Granite-style scalar modulation hooks (all 1.0 = plain Llama).
     embedding_multiplier = 1.0
     residual_multiplier = 1.0
@@ -165,6 +171,9 @@ class LlamaForCausalLM:
         if self.qk_norm:
             layers["q_norm"] = jnp.ones((L, Dh), dtype)
             layers["k_norm"] = jnp.ones((L, Dh), dtype)
+        if self.qk_norm_full:
+            layers["q_norm"] = jnp.ones((L, H * Dh), dtype)
+            layers["k_norm"] = jnp.ones((L, KH * Dh), dtype)
         params = {
             "embed": init(keys[7], (V, D), D),
             "layers": layers,
@@ -199,7 +208,7 @@ class LlamaForCausalLM:
                 "self_attn.k_proj.bias": ("bk", False),
                 "self_attn.v_proj.bias": ("bv", False),
             }
-        if self.qk_norm:
+        if self.qk_norm or self.qk_norm_full:
             per_layer |= {
                 "self_attn.q_norm.weight": ("q_norm", False),
                 "self_attn.k_norm.weight": ("k_norm", False),
@@ -290,7 +299,13 @@ class LlamaForCausalLM:
         def layer_fn(carry, inputs):
             x, kv = carry
             lp, li = inputs
-            h = rms_norm(x, lp["input_norm"], self.rms_eps)
+            # pre_norm (Llama): norm the sublayer INPUT; post-norm archs
+            # (OLMo-2) norm the sublayer OUTPUT before the residual add,
+            # reusing the same weight leaves.
+            h = (
+                rms_norm(x, lp["input_norm"], self.rms_eps)
+                if self.pre_norm else x
+            )
 
             q = proj(h, lp, "wq")
             k = proj(h, lp, "wk")
@@ -299,6 +314,11 @@ class LlamaForCausalLM:
                 q = q + lp["bq"]
                 k = k + lp["bk"]
                 v = v + lp["bv"]
+            if self.qk_norm_full:
+                # OLMo-2: RMSNorm over the FULL projected vector,
+                # pre-head-split (vs Qwen3's per-head norm below).
+                q = rms_norm(q, lp["q_norm"], self.rms_eps)
+                k = rms_norm(k, lp["k_norm"], self.rms_eps)
             q = q.reshape(t, H, Dh)
             k = k.reshape(t, KH, Dh)
             v = v.reshape(t, KH, Dh)
@@ -328,17 +348,24 @@ class LlamaForCausalLM:
                     sliding_window=self.sliding_window,
                     k_scale=kv_scale, v_scale=kv_scale,
                 )
-            x = x + self.residual_multiplier * proj(
-                attn.reshape(t, H * Dh), lp, "wo"
-            )
+            attn_out = proj(attn.reshape(t, H * Dh), lp, "wo")
+            if not self.pre_norm:
+                attn_out = rms_norm(attn_out, lp["input_norm"], self.rms_eps)
+            x = x + self.residual_multiplier * attn_out
 
-            h2 = rms_norm(x, lp["post_norm"], self.rms_eps)
+            h2 = (
+                rms_norm(x, lp["post_norm"], self.rms_eps)
+                if self.pre_norm else x
+            )
             gate = proj(h2, lp, "wgate")
             up = proj(h2, lp, "wup")
-            x = x + self.residual_multiplier * proj(
+            ffn_out = proj(
                 silu_and_mul(jnp.concatenate([gate, up], axis=-1)),
                 lp, "wdown",
             )
+            if not self.pre_norm:
+                ffn_out = rms_norm(ffn_out, lp["post_norm"], self.rms_eps)
+            x = x + self.residual_multiplier * ffn_out
             return (x, kv), None
 
         return layer_fn
@@ -515,6 +542,9 @@ class LlamaForCausalLM:
             layers |= {"bq": P(None, tp), "bk": P(None, tp), "bv": P(None, tp)}
         if self.qk_norm:
             layers |= {"q_norm": P(None, None), "k_norm": P(None, None)}
+        if self.qk_norm_full:
+            # Full-width norm weights shard like the projection output.
+            layers |= {"q_norm": P(None, tp), "k_norm": P(None, tp)}
         from vllm_tpu.layers.quant import Int4Linear
 
         if self.quantization in ("int4", "gptq", "awq"):

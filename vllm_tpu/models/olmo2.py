@@ -1,0 +1,33 @@
+"""OLMo-2 (post-norm Llama variant).
+
+Reference analog: ``vllm/model_executor/models/olmo2.py``. The deltas
+from Llama: no pre-attention/pre-FFN norms — instead
+``post_attention_layernorm`` / ``post_feedforward_layernorm`` apply to
+the SUBLAYER OUTPUT before the residual add (the base graph's
+``pre_norm=False`` mode, reusing the input_norm/post_norm weight
+leaves), and q/k RMSNorm over the FULL projected vector pre-head-split
+(``qk_norm_full``).
+"""
+
+from __future__ import annotations
+
+from vllm_tpu.models.llama import LlamaForCausalLM
+
+
+class Olmo2ForCausalLM(LlamaForCausalLM):
+    pre_norm = False
+    qk_norm_full = True
+
+    def hf_weight_map(self) -> dict:
+        m = super().hf_weight_map()
+        # Post-norm weight names land on the repurposed leaves.
+        for i in range(self.num_layers):
+            hf = f"model.layers.{i}"
+            m.pop(f"{hf}.input_layernorm.weight", None)
+            m[f"{hf}.post_attention_layernorm.weight"] = (
+                f"layers.input_norm.{i}", False,
+            )
+            m[f"{hf}.post_feedforward_layernorm.weight"] = (
+                f"layers.post_norm.{i}", False,
+            )
+        return m

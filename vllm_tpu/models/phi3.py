@@ -11,10 +11,6 @@ per-position table choice, matching the reference's serving semantics).
 
 from __future__ import annotations
 
-from typing import Any
-
-import jax.numpy as jnp
-
 from vllm_tpu.models.llama import LlamaForCausalLM
 
 
@@ -24,10 +20,6 @@ class Phi3ForCausalLM(LlamaForCausalLM):
     SPLIT_SUFFIXES = (
         ".self_attn.qkv_proj.weight", ".mlp.gate_up_proj.weight",
     )
-
-    def __init__(self, hf_config: Any, dtype=jnp.bfloat16,
-                 quantization: str | None = None) -> None:
-        super().__init__(hf_config, dtype, quantization)
 
     def split_hf_tensor(self, hf_name: str, arr):
         """qkv_proj -> q/k/v_proj; gate_up_proj -> gate/up_proj (HF

@@ -32,6 +32,7 @@ _REGISTRY: dict[str, tuple[str, str]] = {
     "BambaForCausalLM": ("vllm_tpu.models.bamba", "BambaForCausalLM"),
     "Phi3ForCausalLM": ("vllm_tpu.models.phi3", "Phi3ForCausalLM"),
     "GraniteForCausalLM": ("vllm_tpu.models.granite", "GraniteForCausalLM"),
+    "Olmo2ForCausalLM": ("vllm_tpu.models.olmo2", "Olmo2ForCausalLM"),
     "LlavaForConditionalGeneration": ("vllm_tpu.models.llava", "LlavaForConditionalGeneration"),
 }
 
