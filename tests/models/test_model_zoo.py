@@ -412,3 +412,39 @@ def test_olmo2_hf_parity(tmp_path_factory):
         SamplingParams(temperature=0.0, max_tokens=8, ignore_eos=True),
     )[0].outputs[0].token_ids
     assert want and got[: len(want)] == want
+
+
+def test_stablelm_hf_parity(tmp_path_factory):
+    """StableLM: LayerNorm-with-bias blocks + partial rotary + qkv bias."""
+    import numpy as np
+    import torch
+    from transformers import StableLmConfig, StableLmForCausalLM
+
+    from vllm_tpu import LLM, SamplingParams
+
+    cfg = StableLmConfig(
+        vocab_size=128, hidden_size=64, intermediate_size=96,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=256, tie_word_embeddings=False,
+        partial_rotary_factor=0.5, use_qkv_bias=True, pad_token_id=0,
+    )
+    torch.manual_seed(0)
+    hf = StableLmForCausalLM(cfg).to(torch.float32).eval()
+    path = str(tmp_path_factory.mktemp("tiny_stablelm"))
+    hf.save_pretrained(path, safe_serialization=True)
+    prompt = np.random.default_rng(4).integers(5, 120, size=12).tolist()
+    with torch.no_grad():
+        want = hf.generate(
+            torch.tensor([prompt]), max_new_tokens=8, do_sample=False,
+            pad_token_id=0,
+        )[0, len(prompt):].tolist()
+    llm = LLM(
+        model=path, dtype="float32", max_model_len=128, block_size=16,
+        num_gpu_blocks_override=64, max_num_seqs=4,
+        max_num_batched_tokens=128,
+    )
+    got = llm.generate(
+        [{"prompt_token_ids": prompt}],
+        SamplingParams(temperature=0.0, max_tokens=8, ignore_eos=True),
+    )[0].outputs[0].token_ids
+    assert want and got[: len(want)] == want

@@ -18,6 +18,21 @@ def rms_norm(x: jnp.ndarray, weight: jnp.ndarray, eps: float = 1e-6) -> jnp.ndar
     return (out * weight.astype(jnp.float32)).astype(orig_dtype)
 
 
+def layer_norm(
+    x: jnp.ndarray, weight: jnp.ndarray, bias: jnp.ndarray,
+    eps: float = 1e-5,
+) -> jnp.ndarray:
+    """Classic LayerNorm (mean-subtract + bias) for the LN-based families
+    (StableLM, Starcoder2); XLA fuses it like the RMS variant."""
+    orig_dtype = x.dtype
+    x32 = x.astype(jnp.float32)
+    mean = jnp.mean(x32, axis=-1, keepdims=True)
+    var = jnp.mean(jnp.square(x32 - mean), axis=-1, keepdims=True)
+    out = (x32 - mean) * jnp.reciprocal(jnp.sqrt(var + eps))
+    out = out * weight.astype(jnp.float32) + bias.astype(jnp.float32)
+    return out.astype(orig_dtype)
+
+
 def fused_add_rms_norm(
     x: jnp.ndarray, residual: jnp.ndarray, weight: jnp.ndarray, eps: float = 1e-6
 ) -> tuple[jnp.ndarray, jnp.ndarray]:

@@ -71,6 +71,9 @@ class LlamaForCausalLM:
     # (``ops/cp_attention.cp_write_and_attend``).
     cp_size = 1
     cp_mesh = None
+    # Norm flavor: "rms" (Llama) or "layer" (StableLM-class: classic
+    # LayerNorm with bias leaves input_norm_b/post_norm_b/final_norm_b).
+    norm_type = "rms"
     # Norm placement: True = pre-norm (Llama); False = post-sublayer
     # norms on the same weight leaves (OLMo-2).
     pre_norm = True
@@ -107,11 +110,15 @@ class LlamaForCausalLM:
         self.max_position = getattr(c, "max_position_embeddings", 8192)
         self.sliding_window = None  # full attention
 
+        prf = getattr(c, "partial_rotary_factor", 1.0) or 1.0
         self.rope = RotaryEmbedding(
             head_dim=self.head_dim,
             max_position=self.max_position,
             theta=getattr(c, "rope_theta", 10000.0),
             rope_scaling=getattr(c, "rope_scaling", None),
+            # StableLM-class partial rotary: only the leading slice of
+            # each head rotates.
+            rotary_dim=int(self.head_dim * prf) if prf < 1.0 else None,
             # Phi-3-style longrope keeps its pivot at config level.
             original_max_position=getattr(
                 c, "original_max_position_embeddings", None
@@ -174,11 +181,16 @@ class LlamaForCausalLM:
         if self.qk_norm_full:
             layers["q_norm"] = jnp.ones((L, H * Dh), dtype)
             layers["k_norm"] = jnp.ones((L, KH * Dh), dtype)
+        if self.norm_type == "layer":
+            layers["input_norm_b"] = jnp.zeros((L, D), dtype)
+            layers["post_norm_b"] = jnp.zeros((L, D), dtype)
         params = {
             "embed": init(keys[7], (V, D), D),
             "layers": layers,
             "final_norm": jnp.ones((D,), dtype),
         }
+        if self.norm_type == "layer":
+            params["final_norm_b"] = jnp.zeros((D,), dtype)
         if not self.tie_embeddings:
             params["lm_head"] = init(keys[8], (D, V), D)
         return params
@@ -207,6 +219,12 @@ class LlamaForCausalLM:
                 "self_attn.q_proj.bias": ("bq", False),
                 "self_attn.k_proj.bias": ("bk", False),
                 "self_attn.v_proj.bias": ("bv", False),
+            }
+        if self.norm_type == "layer":
+            m["model.norm.bias"] = ("final_norm_b", False)
+            per_layer |= {
+                "input_layernorm.bias": ("input_norm_b", False),
+                "post_attention_layernorm.bias": ("post_norm_b", False),
             }
         if self.qk_norm or self.qk_norm_full:
             per_layer |= {
@@ -273,8 +291,15 @@ class LlamaForCausalLM:
                 lp = jax.tree.map(lambda a: a[i], params["layers"])
                 carry, _ = layer_fn(carry, (lp, jnp.int32(i)))
             x, new_kv = carry
-        x = rms_norm(x, params["final_norm"], self.rms_eps)
+        x = self._norm(x, params, "final_norm")
         return x, new_kv
+
+    def _norm(self, x, p, name: str):
+        if self.norm_type == "layer":
+            from vllm_tpu.layers.layernorm import layer_norm
+
+            return layer_norm(x, p[name], p[name + "_b"], self.rms_eps)
+        return rms_norm(x, p[name], self.rms_eps)
 
     def _make_layer_fn(self, md: AttentionMetadata, t: int, *,
                        token_lora_slot=None, lora_scale=None,
@@ -302,10 +327,7 @@ class LlamaForCausalLM:
             # pre_norm (Llama): norm the sublayer INPUT; post-norm archs
             # (OLMo-2) norm the sublayer OUTPUT before the residual add,
             # reusing the same weight leaves.
-            h = (
-                rms_norm(x, lp["input_norm"], self.rms_eps)
-                if self.pre_norm else x
-            )
+            h = self._norm(x, lp, "input_norm") if self.pre_norm else x
 
             q = proj(h, lp, "wq")
             k = proj(h, lp, "wk")
@@ -350,13 +372,10 @@ class LlamaForCausalLM:
                 )
             attn_out = proj(attn.reshape(t, H * Dh), lp, "wo")
             if not self.pre_norm:
-                attn_out = rms_norm(attn_out, lp["input_norm"], self.rms_eps)
+                attn_out = self._norm(attn_out, lp, "input_norm")
             x = x + self.residual_multiplier * attn_out
 
-            h2 = (
-                rms_norm(x, lp["post_norm"], self.rms_eps)
-                if self.pre_norm else x
-            )
+            h2 = self._norm(x, lp, "post_norm") if self.pre_norm else x
             gate = proj(h2, lp, "wgate")
             up = proj(h2, lp, "wup")
             ffn_out = proj(
@@ -364,7 +383,7 @@ class LlamaForCausalLM:
                 lp, "wdown",
             )
             if not self.pre_norm:
-                ffn_out = rms_norm(ffn_out, lp["post_norm"], self.rms_eps)
+                ffn_out = self._norm(ffn_out, lp, "post_norm")
             x = x + self.residual_multiplier * ffn_out
             return (x, kv), None
 
@@ -487,7 +506,7 @@ class LlamaForCausalLM:
             md.block_tables, md.seq_lens, md.query_start_loc,
             md.logits_indices, md.num_seqs,
         )
-        hidden = rms_norm(hidden, params["final_norm"], self.rms_eps)
+        hidden = self._norm(hidden, params, "final_norm")
         return hidden, new_kv
 
     def compute_logits(self, params: dict, hidden: jnp.ndarray) -> jnp.ndarray:
@@ -545,6 +564,11 @@ class LlamaForCausalLM:
         if self.qk_norm_full:
             # Full-width norm weights shard like the projection output.
             layers |= {"q_norm": P(None, tp), "k_norm": P(None, tp)}
+        if self.norm_type == "layer":
+            layers |= {
+                "input_norm_b": P(None, None),
+                "post_norm_b": P(None, None),
+            }
         from vllm_tpu.layers.quant import Int4Linear
 
         if self.quantization in ("int4", "gptq", "awq"):
@@ -579,6 +603,8 @@ class LlamaForCausalLM:
             "layers": layers,
             "final_norm": P(None),
         }
+        if self.norm_type == "layer":
+            out["final_norm_b"] = P(None)
         if not self.tie_embeddings:
             out["lm_head"] = P(None, tp)
         return out

@@ -33,6 +33,7 @@ _REGISTRY: dict[str, tuple[str, str]] = {
     "Phi3ForCausalLM": ("vllm_tpu.models.phi3", "Phi3ForCausalLM"),
     "GraniteForCausalLM": ("vllm_tpu.models.granite", "GraniteForCausalLM"),
     "Olmo2ForCausalLM": ("vllm_tpu.models.olmo2", "Olmo2ForCausalLM"),
+    "StableLmForCausalLM": ("vllm_tpu.models.stablelm", "StableLmForCausalLM"),
     "LlavaForConditionalGeneration": ("vllm_tpu.models.llava", "LlavaForConditionalGeneration"),
 }
 

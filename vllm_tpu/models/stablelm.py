@@ -1,0 +1,37 @@
+"""StableLM 2 (LayerNorm + partial-rotary Llama variant).
+
+Reference analog: ``vllm/model_executor/models/stablelm.py``. Deltas from
+Llama: classic LayerNorm with biases for the block/final norms (the base
+graph's ``norm_type="layer"`` mode), partial rotary
+(``partial_rotary_factor``, handled by the shared rope construction),
+and optional qkv bias. Variants using parallel residual or qk layernorm
+are rejected loudly.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax.numpy as jnp
+
+from vllm_tpu.models.llama import LlamaForCausalLM
+
+
+class StableLmForCausalLM(LlamaForCausalLM):
+    norm_type = "layer"
+
+    def __init__(self, hf_config: Any, dtype=jnp.bfloat16,
+                 quantization: str | None = None) -> None:
+        if getattr(hf_config, "use_parallel_residual", False):
+            raise NotImplementedError(
+                "StableLM parallel-residual variants are not supported"
+            )
+        if getattr(hf_config, "qk_layernorm", False):
+            raise NotImplementedError(
+                "StableLM qk_layernorm variants are not supported"
+            )
+        hf_config.attention_bias = getattr(
+            hf_config, "use_qkv_bias", False
+        )
+        super().__init__(hf_config, dtype, quantization)
+        self.rms_eps = getattr(hf_config, "layer_norm_eps", 1e-5)
