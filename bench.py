@@ -6,15 +6,19 @@ continuous batching): N requests, short prompts, long decodes, greedy.
 Metric: output tokens/sec/chip. Baseline: 2000 tok/s/chip (BASELINE.json
 north star for Llama-3-8B on v5e).
 
-Model shape: a LADDER, widest first — Llama-3.1-8B bf16 (needs >=20 GiB),
-8B INT8 (a BASELINE.json named scale config, "Llama-3-8B FP8/INT8"), 8B
-INT4, then a 1B-class fallback. The tunnel chip is SHARED and its free
-memory fluctuates between runs, so each attempt runs in a subprocess (a
-ResourceExhausted attempt leaves zombie buffers behind) and the first
-config that completes warmup is scored. ``vs_baseline`` is reported only
-for the 8B shapes — the 2000 tok/s target is defined for Llama-3-8B, and
-the 1B fallback reports null rather than an inflated ratio (VERDICT r2
-weak #1). Dummy weights (tok/s is weight-value independent).
+Model shape: a LADDER, widest first — 8B INT8 (a BASELINE.json named
+scale config, "Llama-3-8B FP8/INT8"), 8B INT4, then a 1B-class fallback.
+8B bf16 is excluded: 17.96 GiB of arguments can never fit the 15.75 GiB
+chip (deterministic AOT reject). The tunnel chip is SHARED and its real
+free memory fluctuates with other tenants (the terminal VIRTUALIZES
+allocation, so probes lie — only attempting a rung is truthful), so each
+attempt runs in a subprocess (a ResourceExhausted attempt leaves zombie
+buffers behind), 8B rungs get two attempts, failed rungs are recorded in
+``ladder_failures``, and the first config that completes warmup is
+scored. ``vs_baseline`` is reported only for the 8B shapes — the
+2000 tok/s target is defined for Llama-3-8B, and the 1B fallback reports
+null rather than an inflated ratio (VERDICT r2 weak #1). Dummy weights
+(tok/s is weight-value independent).
 
 Methodology (VERDICT r2): several timed passes; the JSON reports BEST,
 MEDIAN, and WORST. The shared-chip tunnel varies identical consecutive
@@ -48,30 +52,17 @@ PEAK_HBM = {"TPU v5 lite": 819e9, "TPU v5e": 819e9,
             "TPU v4": 1200e9, "TPU v6 lite": 1640e9}
 
 
-def _probe_free_hbm() -> int:
-    """Measured free HBM: the tunnel chip is SHARED (other tenants hold
-    memory, and no memory_stats API exists), so binary-search the largest
-    single allocation that succeeds."""
-    import jax
-    import jax.numpy as jnp
+def _pick_model() -> tuple[list, int, int, int]:
+    """(ladder of (hf_overrides, quantization), num_requests, prompt_len,
+    output_len).
 
-    lo, hi = 1, 40  # GiB (covers v4/v5p/v6e chips)
-    best = 0
-    while lo <= hi:
-        mid = (lo + hi) // 2
-        try:
-            buf = jnp.zeros((mid << 30) // 4, jnp.float32)
-            buf.block_until_ready()
-            del buf
-            best = mid
-            lo = mid + 1
-        except Exception:
-            hi = mid - 1
-    return best << 30
-
-
-def _pick_model() -> tuple[dict, str | None, int, int, int]:
-    """(hf_overrides, quantization, num_requests, prompt_len, output_len)."""
+    No free-memory probe: the axon terminal VIRTUALIZES device memory
+    (allocation probes succeed by evicting idle buffers to host — round-4
+    diagnosis measured a 60 GiB "successful" cumulative allocation on a
+    15.75 GiB chip), so the only truthful fit test is attempting the rung.
+    8B bf16 is excluded outright: its arguments alone are 17.96 GiB >
+    15.75 GiB physical — the AOT compiler rejects it deterministically.
+    """
     import jax
 
     dev = jax.devices()[0]
@@ -81,8 +72,6 @@ def _pick_model() -> tuple[dict, str | None, int, int, int]:
             num_attention_heads=8, num_key_value_heads=8, vocab_size=32000,
         )
         return [(shape, None)], 32, 32, 64
-    free = _probe_free_hbm()
-    print(f"[bench] probed free HBM: {free / 2**30:.0f} GiB", file=sys.stderr)
     shape_8b = dict(
         hidden_size=4096, intermediate_size=14336, num_hidden_layers=32,
         num_attention_heads=32, num_key_value_heads=8, vocab_size=128256,
@@ -91,17 +80,15 @@ def _pick_model() -> tuple[dict, str | None, int, int, int]:
         hidden_size=2048, intermediate_size=8192, num_hidden_layers=16,
         num_attention_heads=16, num_key_value_heads=8, vocab_size=128256,
     )
-    # Ladder of (shape, quant), widest first; the chip is SHARED and its
-    # free memory fluctuates between runs, so main() falls down the
-    # ladder on ResourceExhausted rather than trusting the probe alone.
-    ladder: list[tuple[dict, str | None]] = []
-    if free >= 20 << 30:
-        ladder.append((shape_8b, None))
-    if free >= 12 << 30:
-        ladder.append((shape_8b, "int8"))
-    if free >= 8 << 30:
-        ladder.append((shape_8b, "int4"))
-    ladder.append((shape_1b, None))
+    # Widest-first ladder; the shared chip's REAL free memory fluctuates
+    # with other tenants, so main() walks down on failure (each attempt
+    # in a fresh subprocess) and records every failed rung in the JSON's
+    # ``ladder_failures`` for auditability.
+    ladder: list[tuple[dict, str | None]] = [
+        (shape_8b, "int8"),
+        (shape_8b, "int4"),
+        (shape_1b, None),
+    ]
     return ladder, 128, 32, 128
 
 
@@ -131,32 +118,58 @@ def main() -> None:
     if picked is None and len(ladder) > 1:
         # Each attempt runs in a SUBPROCESS: a ResourceExhausted attempt
         # leaves zombie device buffers behind in its process, poisoning
-        # later attempts; process isolation resets the slate.
+        # later attempts; process isolation resets the slate. 8B rungs get
+        # two attempts each — the shared chip's real free memory moves
+        # with other tenants minute to minute.
         import subprocess
 
+        failures: list[dict] = []
         for i, (shape, quant) in enumerate(ladder):
-            env = dict(os.environ, VLLM_TPU_BENCH_CONFIG=json.dumps(
-                [shape, quant]
-            ))
-            res = subprocess.run(
-                [sys.executable, os.path.abspath(__file__)], env=env,
-                capture_output=True, text=True,
-            )
-            if res.returncode == 0 and res.stdout.strip():
-                sys.stderr.write(res.stderr)
-                print(res.stdout.strip().splitlines()[-1])
-                return
-            tail = "\n".join(res.stderr.strip().splitlines()[-6:])
-            print(
-                f"[bench] {shape['hidden_size']}-d/{quant or 'bf16'} "
-                f"attempt failed; falling back\n{tail}",
-                file=sys.stderr,
-            )
+            attempts = 2 if shape["hidden_size"] == 4096 else 1
+            for att in range(attempts):
+                env = dict(os.environ, VLLM_TPU_BENCH_CONFIG=json.dumps(
+                    [shape, quant]
+                ))
+                if failures:
+                    env["VLLM_TPU_BENCH_FAILURES"] = json.dumps(failures)
+                res = subprocess.run(
+                    [sys.executable, os.path.abspath(__file__)], env=env,
+                    capture_output=True, text=True,
+                )
+                if res.returncode == 0 and res.stdout.strip():
+                    sys.stderr.write(res.stderr)
+                    print(res.stdout.strip().splitlines()[-1])
+                    return
+                err_lines = res.stderr.strip().splitlines()
+                reason = next(
+                    (ln.strip() for ln in reversed(err_lines)
+                     if "Error" in ln or "error" in ln), "unknown"
+                )[:300]
+                failures.append({
+                    "model": f"llama-{'8B' if shape['hidden_size'] == 4096 else '1B-class'}",
+                    "quant": quant or "bf16",
+                    "attempt": att + 1,
+                    "error": reason,
+                })
+                tail = "\n".join(err_lines[-6:])
+                print(
+                    f"[bench] {shape['hidden_size']}-d/{quant or 'bf16'} "
+                    f"attempt {att + 1} failed; falling back\n{tail}",
+                    file=sys.stderr,
+                )
         raise RuntimeError("no bench configuration fits the device")
     if picked is not None:
         shape, quant = json.loads(picked)
     else:
         shape, quant = ladder[0]
+
+    if shape["hidden_size"] == 4096:
+        # 8B rungs run a leaner batch: quantized 8B weights leave only a
+        # few GiB of REAL HBM next to the other tenants, and decode at
+        # this size is weight-read-bound, so halving the batch costs far
+        # less than half the throughput while halving the KV footprint.
+        n_req = 64
+        prompts = prompts[:n_req]
 
     cfg = LlamaConfig(
         max_position_embeddings=4096, tie_word_embeddings=False, **shape
@@ -170,10 +183,11 @@ def main() -> None:
         max_model_len=2048,
         max_num_batched_tokens=512,
         max_num_seqs=min(n_req, 128),
-        # Explicit KV budget: the workload is known (n_req x 160 tokens =
-        # ~1300 blocks) and headroom is scarce next to 8B weights.
+        # Explicit KV budget: the workload is known (n_req x 160 tokens
+        # -> 10 blocks/req) and headroom is scarce next to 8B weights.
         num_gpu_blocks_override=(
-            None if shape["hidden_size"] < 1024 else 1536
+            None if shape["hidden_size"] < 1024
+            else (704 if shape["hidden_size"] == 4096 else 1536)
         ),
         # In-jit multi-step decode amortizes per-launch host/tunnel
         # overhead; exact for greedy.
@@ -273,6 +287,11 @@ def main() -> None:
         if shape["hidden_size"] == 4096
         else None
     )
+    # Failed higher rungs recorded by the parent (auditability: which
+    # configs could not run and why).
+    ladder_failures = json.loads(
+        os.environ.get("VLLM_TPU_BENCH_FAILURES", "[]")
+    )
     print(json.dumps({
         "metric": "output_tokens_per_sec_per_chip",
         "value": rate(min(times)),
@@ -282,6 +301,7 @@ def main() -> None:
         "median_value": rate(statistics.median(times)),
         "worst_pass_value": rate(max(times)),
         **extras,
+        **({"ladder_failures": ladder_failures} if ladder_failures else {}),
     }))
 
 

@@ -177,3 +177,133 @@ def test_sync_mode_unchanged():
     assert req.num_output_placeholders == 0
     s.update_from_output(so1, run_out(so1))
     assert req.num_computed_tokens == 6
+
+
+# ----------------------------------------------------------------------
+# External-KV load vs async lag-1: prefix-cache registration of an
+# externally-loaded span must wait for the load's CONFIRMATION, not just
+# for the next allocate (which under lag-1 runs before the failure is
+# known). ADVICE r3 #2.
+# ----------------------------------------------------------------------
+
+
+class _OneShotConnector:
+    """Claims a 4-block external hit for the first request only."""
+
+    def __init__(self, tokens: int):
+        self.tokens = tokens
+        self.calls = 0
+
+    def get_num_new_matched_tokens(self, block_hashes, device_hit, block_size):
+        self.calls += 1
+        return self.tokens if self.calls == 1 else 0
+
+    def request_finished(self, block_hashes):
+        return []
+
+
+def make_kv_scheduler(connector, block_size=16, num_blocks=64):
+    from vllm_tpu.core.async_scheduler import AsyncScheduler
+
+    sched_cfg = SchedulerConfig(
+        max_num_batched_tokens=256,
+        max_num_seqs=8,
+        max_model_len=256,
+        async_scheduling=True,
+        async_pipeline_depth=2,
+    )
+    cache_cfg = CacheConfig(block_size=block_size, enable_prefix_caching=True)
+    cache_cfg.num_gpu_blocks = num_blocks
+    return AsyncScheduler(sched_cfg, cache_cfg, kv_connector=connector)
+
+
+def _hashed_request(rid: str, prompt: list[int], max_tokens: int = 8):
+    from vllm_tpu.core.kv_cache_utils import make_block_hasher
+
+    return Request(
+        request_id=rid,
+        prompt_token_ids=prompt,
+        sampling_params=SamplingParams(
+            max_tokens=max_tokens, temperature=0.0, ignore_eos=True
+        ),
+        eos_token_id=None,
+        block_hasher=make_block_hasher(16),
+    )
+
+
+def test_external_span_not_registered_before_load_confirms():
+    """Failure path: a request admitted in the lag-1 window (after the
+    loading step was scheduled, before its outcome is known) must NOT
+    prefix-hit the unconfirmed external span — the old one-shot defer was
+    lifted by the very next allocate, which under async lag-1 runs before
+    update_from_output reports the failure."""
+    conn = _OneShotConnector(tokens=64)
+    s = make_kv_scheduler(conn)
+    prompt = [(i * 13) % 97 + 3 for i in range(80)]  # 5 full blocks
+
+    a = _hashed_request("a", prompt)
+    s.add_request(a)
+    so1 = s.schedule()
+    assert so1.kv_connector_load.get("a") is not None  # load scheduled
+    assert so1.num_scheduled_tokens["a"] == 16  # 80 - 64 external
+
+    # Async lag-1: 'b' arrives and the next schedule runs BEFORE the
+    # load outcome is known. Phase 1 runs a's catch-up allocate (which
+    # used to lift the defer); phase 2 admits b.
+    b = _hashed_request("b", list(prompt))
+    s.add_request(b)
+    so2 = s.schedule()
+    assert so2.num_scheduled_tokens.get("a") == 1  # optimistic decode
+    # Registration still held: b computes its full prompt, no hit on
+    # the unconfirmed (potentially garbage) span.
+    assert s.kv_cache_manager.num_cached_blocks.get("a", 0) == 0
+    new_b = [r for r in so2.scheduled_new_reqs if r.req_id == "b"]
+    assert new_b and new_b[0].num_computed_tokens == 0
+    assert so2.num_scheduled_tokens["b"] == 80
+
+    # The load failed: step-1 output is garbage, 'a' is rescheduled; 'b'
+    # is untouched (it never depended on the span).
+    s.update_from_output(
+        so1,
+        ModelRunnerOutput(
+            req_ids=["a"], sampled_token_ids=[[7]], invalid_req_ids={"a"},
+        ),
+    )
+    s.update_from_output(
+        so2,
+        ModelRunnerOutput(
+            req_ids=["a", "b"], sampled_token_ids=[[7], [8]]
+        ),
+    )
+    so3 = s.schedule()
+    # 'a' recomputes — via a legitimate prefix hit on b's blocks (b
+    # genuinely computed the same 80-token prompt in step 2), so only
+    # the 16-token tail runs. The garbage span itself was never cached.
+    assert so3.num_scheduled_tokens.get("a") == 16
+    assert s.kv_cache_manager.num_cached_blocks.get("b", 0) == 5
+
+
+def test_external_span_registers_after_clean_finalize():
+    """Success path: once the loading step finalizes clean, registration
+    catches up and a same-prefix request prefix-hits the span."""
+    conn = _OneShotConnector(tokens=64)
+    s = make_kv_scheduler(conn)
+    prompt = [(i * 17) % 91 + 3 for i in range(80)]
+
+    a = _hashed_request("a", prompt)
+    s.add_request(a)
+    so1 = s.schedule()
+    assert so1.kv_connector_load.get("a") is not None
+    s.update_from_output(
+        so1, ModelRunnerOutput(req_ids=["a"], sampled_token_ids=[[7]])
+    )
+    # Cap lifted; the next allocate registers the request's full blocks.
+    so2 = s.schedule()
+    assert so2.num_scheduled_tokens.get("a") == 1
+    assert s.kv_cache_manager.num_cached_blocks.get("a", 0) == 5
+
+    b = _hashed_request("b", list(prompt))
+    s.add_request(b)
+    so3 = s.schedule()
+    new_b = [r for r in so3.scheduled_new_reqs if r.req_id == "b"]
+    assert new_b and new_b[0].num_computed_tokens >= 64  # prefix hit

@@ -177,3 +177,52 @@ def test_bamba_cache_geometry(tiny_bamba):
     # Prefix caching is off for hybrids.
     core = llm.llm_engine.engine_core.engine_core
     assert not core.scheduler.cache_config.enable_prefix_caching
+
+
+def test_bamba_profile_paths_release_state_slots(tiny_bamba):
+    """profile_run / profile_step_memory / execute_dummy_batch admit
+    __profile__ requests that take hybrid state slots; the cleanup must
+    return them or real traffic hits an exhausted pool (ADVICE r3 #1)."""
+    llm = _mk(tiny_bamba)
+    runner = llm.llm_engine.engine_core.engine_core.executor.worker.runner
+    total = len(runner._state_slot_free) + len(runner._state_slot_of)
+    runner.profile_run()
+    runner.execute_dummy_batch()
+    runner.profile_step_memory()
+    assert len(runner._state_slot_of) == 0
+    assert len(runner._state_slot_free) == total
+    # And the engine still serves max_num_seqs concurrent requests.
+    from vllm_tpu import SamplingParams
+
+    rng = np.random.default_rng(7)
+    prompts = [
+        {"prompt_token_ids": rng.integers(5, 120, size=9).tolist()}
+        for _ in range(4)
+    ]
+    outs = llm.generate(
+        prompts, SamplingParams(temperature=0.0, max_tokens=4, ignore_eos=True)
+    )
+    assert all(len(o.outputs[0].token_ids) == 4 for o in outs)
+
+
+def test_bamba_preempted_requests_release_state_slots(tiny_bamba):
+    """A preempted-and-waiting request must not hold its SSM slot: with
+    slots == max_num_seqs, admission into capacity freed by preemption
+    would otherwise pop from an empty pool (ADVICE r3 #3)."""
+    from vllm_tpu import SamplingParams
+
+    llm = _mk(
+        tiny_bamba, block_size=4, num_gpu_blocks_override=10,
+        max_model_len=64, max_num_batched_tokens=32, max_num_seqs=2,
+    )
+    rng = np.random.default_rng(8)
+    prompts = [
+        {"prompt_token_ids": rng.integers(5, 120, size=10).tolist()}
+        for _ in range(4)
+    ]
+    sp = SamplingParams(temperature=0.0, max_tokens=16, ignore_eos=True)
+    batch = [o.outputs[0].token_ids for o in llm.generate(prompts, sp)]
+    solo = [llm.generate([p], sp)[0].outputs[0].token_ids for p in prompts]
+    assert batch == solo
+    sched = llm.llm_engine.engine_core.engine_core.scheduler
+    assert sched._num_preempted_total > 0

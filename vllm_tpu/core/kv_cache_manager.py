@@ -70,6 +70,9 @@ class KVCacheManager:
         # How many leading blocks of each request are already registered in
         # the prefix cache (avoids re-hashing on every allocate).
         self.num_cached_blocks: dict[str, int] = {}
+        # req_id -> token floor above which prefix-cache registration is
+        # held back while an external KV load is unconfirmed.
+        self.cache_reg_cap: dict[str, int] = {}
         self.prefix_cache_stats = PrefixCacheStats()
 
     # ------------------------------------------------------------------
@@ -107,7 +110,6 @@ class KVCacheManager:
         new_computed_blocks: list[KVCacheBlock] | None = None,
         num_new_computed_tokens: int = 0,
         num_lookahead_tokens: int = 0,
-        defer_caching_tokens: int = 0,
     ) -> list[KVCacheBlock] | None:
         """Ensure the request has blocks covering its tokens after this step.
 
@@ -164,15 +166,7 @@ class KVCacheManager:
             req_blocks.extend(new_blocks)
 
         if self.enable_caching:
-            # ``defer_caching_tokens``: an externally-loaded span is not
-            # trustworthy until its load succeeds; registering it (or
-            # anything after it — hashes chain) now would let OTHER
-            # requests prefix-hit garbage if the load fails. The next
-            # allocate call catches registration up.
-            self._cache_full_blocks(
-                request,
-                num_computed_tokens + num_new_tokens - defer_caching_tokens,
-            )
+            self._cache_full_blocks(request, num_computed_tokens + num_new_tokens)
         return new_blocks
 
     def _free_out_of_window(
@@ -209,10 +203,33 @@ class KVCacheManager:
             start, first_needed_blk
         )
 
+    def defer_caching_from(self, request_id: str, token_floor: int) -> None:
+        """Block prefix-cache registration at/after ``token_floor`` until
+        the external KV load covering it is CONFIRMED good.
+
+        A one-shot hold at allocate time is not enough under async lag-1
+        scheduling: schedule(N+1)'s allocate catch-up runs before
+        update_from_output(N) reports the load outcome, so it would
+        register the external span while the failure is still in flight —
+        another request admitted in step N+1 could then prefix-hit garbage
+        blocks (ADVICE r3 #2). The cap persists until the scheduler calls
+        :meth:`confirm_external_load` from update_from_output; the next
+        allocate after that catches registration up. Hashes chain, so
+        everything from the span start is held back."""
+        self.cache_reg_cap[request_id] = token_floor
+
+    def confirm_external_load(self, request_id: str) -> None:
+        """The step that performed the external load finalized clean:
+        lift the registration cap."""
+        self.cache_reg_cap.pop(request_id, None)
+
     def _cache_full_blocks(self, request: Request, num_tokens_after_step: int) -> None:
         """Register every block that becomes full this step. Speculative
         (unverified) positions are never cached — the caller passes only
         confirmed token counts."""
+        cap = self.cache_reg_cap.get(request.request_id)
+        if cap is not None:
+            num_tokens_after_step = min(num_tokens_after_step, cap)
         num_full = min(
             num_tokens_after_step // self.block_size, len(request.block_hashes)
         )
@@ -238,12 +255,14 @@ class KVCacheManager:
         for b in self.req_to_blocks.get(request.request_id, []):
             self.block_pool._maybe_evict_cached_block(b)
         self.num_cached_blocks.pop(request.request_id, None)
+        self.cache_reg_cap.pop(request.request_id, None)
 
     def free(self, request: Request) -> None:
         """Release all blocks. Freed tail-first so eviction consumes the end
         of the sequence before its (more reusable) prefix."""
         blocks = self.req_to_blocks.pop(request.request_id, [])
         self.num_cached_blocks.pop(request.request_id, None)
+        self.cache_reg_cap.pop(request.request_id, None)
         self._first_live_blk.pop(request.request_id, None)
         self.block_pool.free_blocks(list(reversed(blocks)))
 

@@ -56,6 +56,12 @@ class SchedulerOutput:
     scheduled_spec_decode_tokens: dict[str, list[int]] = field(default_factory=dict)
     # Requests that finished/aborted since the last step (runner state cleanup).
     finished_req_ids: set[str] = field(default_factory=set)
+    # Requests preempted this step and NOT resumed within it: the runner
+    # must release per-request device state (hybrid SSM slots) — a
+    # preempted request recomputes from position 0 with zero state on
+    # resume, so holding the slot while it waits both leaks capacity and
+    # can exhaust the slot pool (running + preempted > max_num_seqs).
+    preempted_req_ids: set[str] = field(default_factory=set)
     # In-jit multi-step decode: tokens sampled per request this step.
     num_decode_steps: int = 1
     # KV connector: req_id -> (device block ids, content keys) to LOAD

@@ -157,6 +157,10 @@ class Scheduler:
         # counter would lose events when async lag-1 runs two schedule()
         # calls between logger updates).
         self._num_preempted_total = 0
+        # Preempted ids from a schedule() whose output was never
+        # dispatched (zero scheduled tokens): re-delivered on the next
+        # dispatched step so the runner still releases per-request state.
+        self._pending_preempted: set[str] = set()
         self._num_invalid_loads = 0
         # Cumulative spec-decode accounting (acceptance-rate metric).
         self._spec_num_draft_tokens = 0
@@ -552,23 +556,30 @@ class Scheduler:
                 self._rollback_encoder(request, enc_new)
                 break  # wait for a step with budget for the whole prompt
 
+            if num_external_tokens:
+                # Hold back prefix-cache registration from the start of
+                # the externally-loaded span until update_from_output
+                # confirms the load (garbage otherwise; a one-shot hold
+                # would be lifted by the NEXT schedule's allocate, which
+                # under async lag-1 runs before the failure is known).
+                self.kv_cache_manager.defer_caching_from(
+                    request.request_id,
+                    request.num_computed_tokens
+                    + num_new_computed_tokens
+                    - num_external_tokens,
+                )
             new_blocks = self.kv_cache_manager.allocate_slots(
                 request,
                 num_new_tokens,
                 new_computed_blocks=new_computed_blocks,
                 num_new_computed_tokens=num_new_computed_tokens,
                 num_lookahead_tokens=self.config.num_lookahead_tokens,
-                # Hold back prefix-cache registration from the start of
-                # the externally-loaded span: its content is garbage if
-                # the load later fails (hashes chain, so everything after
-                # the span is held back too; the next allocate catches up).
-                defer_caching_tokens=(
-                    num_external_tokens + num_new_tokens
-                    if num_external_tokens
-                    else 0
-                ),
             )
             if new_blocks is None:
+                if num_external_tokens:
+                    self.kv_cache_manager.confirm_external_load(
+                        request.request_id
+                    )
                 self._rollback_encoder(request, enc_new)
                 break  # out of KV space; don't preempt running for waiting
 
@@ -670,11 +681,16 @@ class Scheduler:
             scheduled_encoder_inputs=enc_sched,
             free_encoder_input_ids=self._take_encoder_frees(),
             finished_req_ids=self.finished_req_ids,
+            # Victims preempted this step and not resumed within it (the
+            # same-step-resume case went through resumed_from_preemption),
+            # plus any carried over from undispatched schedules.
+            preempted_req_ids=self._pending_preempted | preempted_in_step,
             req_refs={
                 rid: self.requests[rid] for rid in num_scheduled_tokens
             },
         )
         self.finished_req_ids = set()
+        self._pending_preempted = set()
         if total > 0:
             self._last_step_req_ids = set(num_scheduled_tokens)
         if self.kv_event_publisher is not None:
@@ -826,6 +842,12 @@ class Scheduler:
                 # drain its placeholders without materializing tokens.
                 self._drain_invalid(request, req_id, runner_output, req_index)
                 continue
+            if req_id in scheduler_output.kv_connector_load:
+                # The step that performed this request's external KV load
+                # finalized clean: its span is trustworthy, lift the
+                # prefix-cache registration hold (the next allocate
+                # catches registration up).
+                self.kv_cache_manager.confirm_external_load(req_id)
 
             generated = runner_output.sampled_token_ids[req_index]
             scheduled_spec = spec_scheduled.get(req_id, [])

@@ -168,6 +168,9 @@ class EngineCore:
                 # encoder-cache frees) back so the runner still gets them
                 # on the next dispatched step.
                 self.scheduler.finished_req_ids |= scheduler_output.finished_req_ids
+                self.scheduler._pending_preempted |= (
+                    scheduler_output.preempted_req_ids
+                )
                 self.scheduler._pending_encoder_frees = (
                     scheduler_output.free_encoder_input_ids
                     + self.scheduler._pending_encoder_frees

@@ -759,12 +759,34 @@ class ModelRunner:
     # Host side
     # ------------------------------------------------------------------
 
+    def _release_state_slot(self, req_id: str) -> None:
+        slot = self._state_slot_of.pop(req_id, None)
+        if slot is not None:
+            self._state_slot_free.append(slot)
+
+    def _take_state_slot(self, req_id: str) -> None:
+        if req_id in self._state_slot_of:
+            return
+        if not self._state_slot_free:
+            raise RuntimeError(
+                f"hybrid state slots exhausted admitting {req_id!r}: "
+                f"{len(self._state_slot_of)} held "
+                f"({sorted(self._state_slot_of)[:8]}...) — a holder was "
+                "not released (preemption/profile leak?)"
+            )
+        self._state_slot_of[req_id] = self._state_slot_free.pop()
+
     def _update_states(self, so: SchedulerOutput) -> None:
+        if self._is_hybrid:
+            # Preempted requests recompute from position 0 with zero SSM
+            # state on resume (prefix caching is off for hybrids), so
+            # their slot is released now and re-assigned at resume —
+            # otherwise running + preempted holders can exceed the pool.
+            for req_id in so.preempted_req_ids:
+                self._release_state_slot(req_id)
         for req_id in so.finished_req_ids:
             if self._is_hybrid:
-                slot = self._state_slot_of.pop(req_id, None)
-                if slot is not None:
-                    self._state_slot_free.append(slot)
+                self._release_state_slot(req_id)
             # Suffix decoding: finished generations feed the cross-request
             # continuation corpus.
             state = self.input_batch.req_states.get(req_id)
@@ -791,6 +813,9 @@ class ModelRunner:
                 self.input_batch.reset_for_resume(
                     req_id, tokens, cached.new_block_ids[i], cached.num_computed_tokens[i]
                 )
+                if self._is_hybrid:
+                    # Fresh slot; the model reseeds zero state at pos 0.
+                    self._take_state_slot(req_id)
             else:
                 if cached.new_block_ids[i]:
                     self.input_batch.append_block_ids(req_id, cached.new_block_ids[i])
@@ -799,10 +824,10 @@ class ModelRunner:
                 )
         for new in so.scheduled_new_reqs:
             row = self.input_batch.add_request(new)
-            if self._is_hybrid and new.req_id not in self._state_slot_of:
+            if self._is_hybrid:
                 # Constant-size Mamba state slot, stable for the request's
                 # batch lifetime (rows swap on removal; slots don't).
-                self._state_slot_of[new.req_id] = self._state_slot_free.pop()
+                self._take_state_slot(new.req_id)
             if self.lora_manager is not None:
                 self.input_batch.lora_slot[row] = self.lora_manager.slot_of(
                     new.lora_name
@@ -1915,6 +1940,8 @@ class ModelRunner:
                     self.input_batch.remove_request(rid)
                 except Exception:
                     pass
+                if self._is_hybrid:
+                    self._release_state_slot(rid)
 
     def resize_kv_cache(self, num_blocks: int) -> None:
         """Re-allocate the paged KV (and draft KV) for the measured block
@@ -1939,6 +1966,8 @@ class ModelRunner:
         )
         self.execute_model(so)
         self.input_batch.remove_request("__profile__")
+        if self._is_hybrid:
+            self._release_state_slot("__profile__")
 
     def execute_dummy_batch(self) -> None:
         """Smallest-bucket step with a throwaway request: keeps an idle DP
@@ -1946,6 +1975,8 @@ class ModelRunner:
         need all participants). Reference: ``core.py:731``."""
         self.execute_model(_dummy_scheduler_output(1))
         self.input_batch.remove_request("__profile__")
+        if self._is_hybrid:
+            self._release_state_slot("__profile__")
 
 
 def _dummy_scheduler_output(
