@@ -112,3 +112,43 @@ def test_cascade_e2e_greedy_parity(tmp_path):
     llm = LLM(model=path, **kw, enable_cascade_attention=True)
     got = [o.outputs[0].token_ids for o in llm.generate(prompts, sp)]
     assert got == ref
+
+
+@pytest.mark.parametrize("cp", [2, 3])
+def test_cascade_striped_context(cp):
+    """Striping-aware cascade: per-rank cascade partials over striped
+    local tables LSE-merge to the full-context answer (the CP engine
+    path's shared-prefix formulation). Covers both ncb % cp == 0 and the
+    boundary-column case (ncb % cp != 0)."""
+    from vllm_tpu.ops.cp_attention import merge_attn_states
+
+    rng = np.random.default_rng(7)
+    q, kv, md, shared = _rig(rng, shared_blocks=3, extra_blocks=2)
+    scale = 8 ** -0.5
+    want = np.asarray(
+        ref_ragged_paged_attention(q, kv, jnp.int32(0), md, scale)
+    )
+
+    bt = np.asarray(md.block_tables)
+    r, b = bt.shape
+    b_local = -(-b // cp)
+    outs, lses = [], []
+    for rank in range(cp):
+        cols = np.arange(b_local) * cp + rank
+        valid = cols < b
+        lbt = np.where(valid[None, :], bt[:, np.clip(cols, 0, b - 1)], 0)
+        md_r = dataclasses.replace(
+            md,
+            block_tables=jnp.asarray(lbt),
+            num_common_prefix_blocks=shared,
+        )
+        o, l = cascade_ref_attention(
+            q, kv, jnp.int32(0), md_r, scale,
+            return_lse=True, ctx_stride=cp, ctx_phase=rank,
+        )
+        outs.append(np.asarray(o, np.float32))
+        lses.append(np.asarray(l))
+    got = np.asarray(merge_attn_states(
+        jnp.asarray(np.stack(outs)), jnp.asarray(np.stack(lses))
+    ))
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)

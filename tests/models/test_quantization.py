@@ -145,3 +145,57 @@ def test_fp8_kv_e2e_generates(ckpt):
         SamplingParams(temperature=0.0, max_tokens=8, ignore_eos=True),
     )
     assert len(outs[0].outputs[0].token_ids) == 8
+
+
+def test_quantized_embedding_roundtrip():
+    from vllm_tpu.layers.quant import (
+        embedding_lookup,
+        embedding_logits,
+        quantize_embedding_jnp,
+        quantize_embedding_np,
+    )
+
+    rng = np.random.default_rng(5)
+    # Rows with very different magnitudes: per-row scales must track them.
+    table = (
+        rng.standard_normal((32, 48)) * rng.uniform(0.01, 10.0, (32, 1))
+    ).astype(np.float32)
+    qe = quantize_embedding_jnp(jnp.asarray(table))
+    ids = jnp.asarray([0, 7, 31, 7], jnp.int32)
+    got = np.asarray(embedding_lookup(qe, ids, jnp.float32))
+    want = table[np.asarray(ids)]
+    rel = np.abs(got - want).max(axis=1) / np.abs(want).max(axis=1)
+    assert rel.max() < 0.02, rel
+    # np/jnp agreement.
+    qn, sn = quantize_embedding_np(table)
+    np.testing.assert_allclose(np.asarray(qe.scale), sn, rtol=1e-6)
+    assert np.abs(np.asarray(qe.q, np.int32) - qn.astype(np.int32)).max() <= 1
+    # Tied-head logits path.
+    h = jnp.asarray(rng.standard_normal((4, 48)), jnp.float32)
+    got_l = np.asarray(embedding_logits(h, qe))
+    want_l = np.asarray(h) @ table.T
+    assert np.abs(got_l - want_l).max() < 0.03 * np.abs(want_l).max()
+
+
+@pytest.mark.parametrize("method", ["int8", "int4"])
+def test_quantized_embedding_layers_e2e(ckpt, method):
+    """quantize_embedding_layers=True stores the table per-row int8 and
+    lm_head per-channel int8; greedy output matches the same model with
+    full-precision embeddings on a tiny checkpoint."""
+    from vllm_tpu import LLM, SamplingParams
+    from vllm_tpu.layers.quant import QuantizedEmbedding, QuantizedLinear
+
+    sp = SamplingParams(temperature=0.0, max_tokens=8, ignore_eos=True)
+    prompt = [{"prompt_token_ids": [3, 14, 15, 9, 2, 6]}]
+    kw = dict(
+        model=ckpt, dtype="float32", quantization=method, max_model_len=128,
+        block_size=16, num_gpu_blocks_override=64, max_num_seqs=4,
+        max_num_batched_tokens=128,
+    )
+    base = LLM(**kw).generate(prompt, sp)[0].outputs[0].token_ids
+    llm = LLM(**kw, quantize_embedding_layers=True)
+    worker = llm.llm_engine.engine_core.engine_core.executor.worker
+    assert isinstance(worker.params["embed"], QuantizedEmbedding)
+    assert isinstance(worker.params["lm_head"], QuantizedLinear)
+    got = llm.generate(prompt, sp)[0].outputs[0].token_ids
+    assert got == base

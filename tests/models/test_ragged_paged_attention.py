@@ -260,3 +260,62 @@ def test_pallas_kernel_lse():
     np.testing.assert_allclose(
         np.asarray(lse)[:t_live], want_lse[:t_live], rtol=2e-4, atol=2e-4
     )
+
+
+@pytest.mark.parametrize("cp", [2, 4])
+@pytest.mark.parametrize("d", [64, 128])
+def test_pallas_kernel_striped_context(cp, d):
+    """ctx_stride/ctx_phase striped view: per-rank kernel partials merge
+    to the full-context answer (the CP fast path's contract). Includes a
+    1-page seq so some ranks hold ZERO pages of it (dummy-block path)."""
+    import dataclasses
+
+    from vllm_tpu.ops.cp_attention import merge_attn_states
+
+    rng = np.random.default_rng(7)
+    kh, h, bs = 2, 4, 8
+    q_lens = [1, 5, 2, 1]
+    kv_lens = [40, 33, 3, 17]  # 3-token seq: 1 page -> zero on ranks > 0
+    q, kv_cache, md = _random_case(
+        rng, len(q_lens), q_lens, kv_lens, kh, h, d, bs, num_blocks=64
+    )
+    scale = d ** -0.5
+    t_live = int(sum(q_lens))
+    full = np.asarray(_run_kernel(q, kv_cache, 0, md, scale))[:t_live]
+
+    bt = np.asarray(md.block_tables)
+    b = bt.shape[1]
+    b_local = -(-b // cp)
+    outs_k, lses_k, outs_r, lses_r = [], [], [], []
+    for rank in range(cp):
+        cols = np.arange(b_local) * cp + rank
+        valid = cols < b
+        lbt = np.where(valid[None, :], bt[:, np.clip(cols, 0, b - 1)], 0)
+        md_r = dataclasses.replace(md, block_tables=jnp.asarray(lbt))
+        o_k, l_k = _run_kernel(
+            q, kv_cache, 0, md_r, scale, return_lse=True,
+            ctx_stride=cp, ctx_phase=rank,
+        )
+        o_r, l_r = ref_ragged_paged_attention(
+            q, kv_cache, jnp.int32(0), md_r, scale, return_lse=True,
+            ctx_stride=cp, ctx_phase=rank,
+        )
+        outs_k.append(np.asarray(o_k, np.float32)[:t_live])
+        lses_k.append(np.asarray(l_k)[:t_live])
+        outs_r.append(np.asarray(o_r, np.float32)[:t_live])
+        lses_r.append(np.asarray(l_r)[:t_live])
+        # Where a rank holds real context, kernel partials match the ref
+        # (fully-masked rows differ only in the -huge lse encoding).
+        live = lses_r[-1] > -1e30
+        np.testing.assert_allclose(
+            lses_k[-1][live], lses_r[-1][live], rtol=2e-4, atol=2e-4
+        )
+
+    merged_k = np.asarray(merge_attn_states(
+        jnp.asarray(np.stack(outs_k)), jnp.asarray(np.stack(lses_k))
+    ))
+    merged_r = np.asarray(merge_attn_states(
+        jnp.asarray(np.stack(outs_r)), jnp.asarray(np.stack(lses_r))
+    ))
+    np.testing.assert_allclose(merged_k, full, rtol=3e-4, atol=3e-4)
+    np.testing.assert_allclose(merged_r, full, rtol=3e-4, atol=3e-4)

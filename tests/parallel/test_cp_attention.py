@@ -62,6 +62,10 @@ def test_cp_attention_matches_single_device(cp):
     into per-rank striped caches (global page g -> rank g%cp, local slot
     g//cp), run under shard_map, compare every rank's merged output.
     """
+    _run_cp_case(cp)
+
+
+def _run_cp_case(cp):
     from jax import shard_map
 
     rng = np.random.default_rng(1)
@@ -138,3 +142,13 @@ def test_stripe_metadata_helper():
     assert placement[1][local_bt[1, 1, 0]] == 5
     # Padding columns stay null.
     assert local_bt[1, 1, 1] == 0
+
+
+@pytest.mark.parametrize("cp", [2])
+def test_cp_attention_pallas_kernel_path(cp, monkeypatch):
+    """The Pallas striped kernel (interpret mode) inside the shard_map CP
+    path matches the XLA reference path — the engine's CP fast path."""
+    from vllm_tpu import envs
+
+    monkeypatch.setitem(envs.__dict__, "VLLM_TPU_PALLAS_INTERPRET", True)
+    _run_cp_case(cp)

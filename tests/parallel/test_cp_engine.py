@@ -141,3 +141,19 @@ def test_llm_cp_prefix_cache_parity(tiny_llama):
         .kv_cache_manager.prefix_cache_stats
     )
     assert stats.hits > 0  # the second request really hit the cache
+
+
+def test_llm_cp_cascade_parity(tiny_llama):
+    """Shared-prefix batch under cp=2: the striping-aware cascade path
+    (num_common_prefix_blocks > 0 inside cp_write_and_attend) produces
+    the same greedy tokens as the single-device engine."""
+    rng = np.random.default_rng(13)
+    shared = rng.integers(10, 120, size=37).tolist()
+    prompts = [shared + rng.integers(10, 120, size=n).tolist()
+               for n in (3, 9, 6)]
+    ref = _generate(tiny_llama, prompts, max_tokens=10)
+    got = _generate(
+        tiny_llama, prompts, max_tokens=10, context_parallel_size=2,
+        enable_prefix_caching=True,
+    )
+    assert got == ref

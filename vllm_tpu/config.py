@@ -34,6 +34,10 @@ class ModelConfig:
     # Weight-only quantization: None | "int8" | "fp8" (per-output-channel,
     # applied at load; reference: vllm/model_executor/layers/quantization/).
     quantization: str | None = None
+    # Also quantize the embedding table (per-row int8) and lm_head
+    # (per-out-channel int8). Saves the 2·V·D bf16 bytes that dominate
+    # small-chip headroom on big-vocab models; off by default for quality.
+    quantize_embedding_layers: bool = False
     # "auto" streams real weights from safetensors; "dummy" random-initializes
     # (reference: load_format="dummy", model_loader/dummy_loader.py) so engine
     # tests need no checkpoints.
@@ -54,6 +58,12 @@ class ModelConfig:
                     f"unknown quantization {self.quantization!r}; "
                     f"supported: {QUANT_METHODS}"
                 )
+        if self.quantize_embedding_layers and self.quantization is None:
+            raise ValueError(
+                "quantize_embedding_layers requires a weight quantization "
+                "scheme (--quantization int8/fp8/int4/...); on its own it "
+                "would be a silent no-op"
+            )
 
     @property
     def jax_dtype(self):

@@ -37,6 +37,7 @@ class EngineArgs:
     load_format: str = "auto"
     revision: str | None = None
     quantization: str | None = None
+    quantize_embedding_layers: bool = False
 
     block_size: int = 16
     gpu_memory_utilization: float = 0.9
@@ -103,6 +104,7 @@ class EngineArgs:
                 load_format=self.load_format,  # type: ignore[arg-type]
                 revision=self.revision,
                 quantization=self.quantization,
+                quantize_embedding_layers=self.quantize_embedding_layers,
                 hf_config=self.hf_config,
                 hf_overrides=self.hf_overrides,
             ),

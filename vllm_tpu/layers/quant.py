@@ -165,6 +165,62 @@ def quantize_int4_jnp(
     return Int4Linear(q=packed, scale=scale, zero=zero)
 
 
+@jax.tree_util.register_dataclass
+@dataclass
+class QuantizedEmbedding:
+    """Per-ROW int8 embedding table: ``q`` int8 ``[V, D]``, ``scale`` f32
+    ``[V]`` (one scale per vocab row — the gather dequantizes just the
+    looked-up rows). Always int8 even for int4 models: an embedding
+    gather is bandwidth-trivial and a table lookup keeps full per-row
+    dynamic range at 1 byte/param.
+
+    Reference analog: lm_head/embedding quantization in
+    ``vllm/model_executor/layers/quantization`` (quantized lm_head
+    support); this is the TPU-shaped equivalent for the ``embed`` table."""
+
+    q: jnp.ndarray
+    scale: jnp.ndarray
+
+
+def quantize_embedding_np(arr: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
+    """Host-side per-row int8 quantization of an ``[V, D]`` table."""
+    arr = np.asarray(arr, np.float32)
+    amax = np.abs(arr).max(axis=-1, keepdims=True)
+    scale = np.maximum(amax / 127.0, 1e-8)
+    q = np.clip(np.rint(arr / scale), -127, 127).astype(np.int8)
+    return np.ascontiguousarray(q), np.ascontiguousarray(
+        scale.squeeze(-1).astype(np.float32)
+    )
+
+
+def quantize_embedding_jnp(arr: jnp.ndarray) -> QuantizedEmbedding:
+    """Device-side per-row int8 quantization (dummy-weight path)."""
+    amax = jnp.abs(arr).max(axis=-1, keepdims=True).astype(jnp.float32)
+    scale = jnp.maximum(amax / 127.0, 1e-8)
+    q = (
+        jnp.clip(jnp.rint(arr / scale.astype(arr.dtype)), -127, 127)
+        .astype(jnp.int8)
+    )
+    return QuantizedEmbedding(q=q, scale=scale.squeeze(-1))
+
+
+def embedding_lookup(embed, ids: jnp.ndarray, dtype) -> jnp.ndarray:
+    """Row gather for plain or quantized embedding tables."""
+    if isinstance(embed, QuantizedEmbedding):
+        rows = embed.q[ids].astype(dtype)
+        return rows * embed.scale[ids][:, None].astype(dtype)
+    return embed[ids].astype(dtype)
+
+
+def embedding_logits(hidden: jnp.ndarray, embed) -> jnp.ndarray:
+    """Tied lm_head: ``hidden @ embed.T`` with per-vocab-row dequant."""
+    if isinstance(embed, QuantizedEmbedding):
+        return (hidden @ embed.q.T.astype(hidden.dtype)) * embed.scale.astype(
+            hidden.dtype
+        )
+    return hidden @ embed.T.astype(hidden.dtype)
+
+
 def qmm(x: jnp.ndarray, w) -> jnp.ndarray:
     """``x @ w`` for plain arrays, QuantizedLinear, or Int4Linear
     (dequant-on-the-fly)."""

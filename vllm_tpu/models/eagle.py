@@ -176,7 +176,9 @@ class EagleDraftModel:
         updated draft_kv)."""
         t = token_ids.shape[0]
         H, KH, Dh = self.num_heads, self.num_kv_heads, self.head_dim
-        emb = embed[token_ids].astype(self.dtype)
+        from vllm_tpu.layers.quant import embedding_lookup
+
+        emb = embedding_lookup(embed, token_ids, self.dtype)
         x = jnp.concatenate(
             [emb, target_hidden.astype(self.dtype)], axis=-1
         ) @ params["fc"]

@@ -141,7 +141,9 @@ class Gemma2ForCausalLM(LlamaForCausalLM):
         md: AttentionMetadata,
         token_lora_slot: jnp.ndarray | None = None,  # unused (no LoRA yet)
     ) -> tuple[jnp.ndarray, jnp.ndarray]:
-        x = params["embed"][input_ids].astype(self.dtype)
+        from vllm_tpu.layers.quant import embedding_lookup
+
+        x = embedding_lookup(params["embed"], input_ids, self.dtype)
         x = x * jnp.asarray(
             math.sqrt(self.hidden_size), self.dtype
         )
@@ -189,7 +191,9 @@ class Gemma2ForCausalLM(LlamaForCausalLM):
         return x, new_kv
 
     def compute_logits(self, params: dict, hidden: jnp.ndarray) -> jnp.ndarray:
-        logits = (hidden @ params["embed"].T.astype(hidden.dtype)).astype(
+        from vllm_tpu.layers.quant import embedding_logits
+
+        logits = embedding_logits(hidden, params["embed"]).astype(
             jnp.float32
         )
         if self.final_soft_cap is not None:

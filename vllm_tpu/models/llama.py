@@ -33,7 +33,12 @@ from jax.sharding import PartitionSpec as P
 from vllm_tpu.core.kv_cache_utils import FullAttentionSpec, KVCacheSpec
 from vllm_tpu.layers.activation import silu_and_mul
 from vllm_tpu.layers.layernorm import rms_norm
-from vllm_tpu.layers.quant import QuantizedLinear, qmm, quantize_jnp
+from vllm_tpu.layers.quant import (
+    QuantizedEmbedding,
+    QuantizedLinear,
+    qmm,
+    quantize_jnp,
+)
 from vllm_tpu.lora.layers import lora_delta
 from vllm_tpu.layers.rotary import RotaryEmbedding, _apply_rotate_half
 from vllm_tpu.logger import init_logger
@@ -56,9 +61,19 @@ class LlamaForCausalLM:
     # stacked adapter leaves to the param tree and ships per-token slots.
     enable_lora = False
     supports_lora = True
-    # Weight-only quantized matmuls (per-output-channel int8/fp8); norms,
-    # embeddings, and lm_head stay in the model dtype.
+    # Weight-only quantized matmuls (per-output-channel int8/fp8); norms
+    # stay in the model dtype.
     QUANT_KEYS = ("wq", "wk", "wv", "wo", "wgate", "wup", "wdown")
+    # Quantize the embedding table (per-row int8, dequant on gather) and
+    # lm_head (per-out-channel int8) too. Off by default — projections
+    # dominate the FLOPs, so the quality-sensitive table/head stay full
+    # precision unless memory demands otherwise (on a 16 GiB chip an 8B
+    # model's bf16 embed+head cost 2.1 GiB). Set by the worker from
+    # ModelConfig.quantize_embedding_layers; the capability flag below
+    # marks model classes whose forward/logits paths handle
+    # QuantizedEmbedding (the worker rejects the option elsewhere).
+    quantize_embedding_layers = False
+    supports_quantized_embedding = True
     # Pipeline parallelism (set by the worker): stage count over the 'pp'
     # mesh axis, microbatch count, and the mesh for shard_map. The layer
     # stack's leading axis is sharded over 'pp'; a collective-permute
@@ -184,15 +199,31 @@ class LlamaForCausalLM:
         if self.norm_type == "layer":
             layers["input_norm_b"] = jnp.zeros((L, D), dtype)
             layers["post_norm_b"] = jnp.zeros((L, D), dtype)
+        q_extra = self.quantization and self.quantize_embedding_layers
+        if q_extra:
+            from vllm_tpu.layers.quant import quantize_embedding_jnp
+
+            w = init(keys[7], (V, D), D)
+            embed = quantize_embedding_jnp(w)
+            w.delete()
+        else:
+            embed = init(keys[7], (V, D), D)
         params = {
-            "embed": init(keys[7], (V, D), D),
+            "embed": embed,
             "layers": layers,
             "final_norm": jnp.ones((D,), dtype),
         }
         if self.norm_type == "layer":
             params["final_norm_b"] = jnp.zeros((D,), dtype)
         if not self.tie_embeddings:
-            params["lm_head"] = init(keys[8], (D, V), D)
+            if q_extra:
+                # Per-out-channel int8 regardless of the projection
+                # scheme: the head is one [D, V] GEMM per step.
+                w = init(keys[8], (D, V), D)
+                params["lm_head"] = quantize_jnp(w, "int8")
+                w.delete()
+            else:
+                params["lm_head"] = init(keys[8], (D, V), D)
         return params
 
     # HF checkpoint name -> (our path, transpose, stack-axis layer index fn)
@@ -254,10 +285,12 @@ class LlamaForCausalLM:
         token_lora_slot: jnp.ndarray | None = None,  # [T] i32 (LoRA)
         inputs_embeds: jnp.ndarray | None = None,  # [T, D] (multimodal merge)
     ) -> tuple[jnp.ndarray, jnp.ndarray]:
+        from vllm_tpu.layers.quant import embedding_lookup
+
         x = (
             inputs_embeds.astype(self.dtype)
             if inputs_embeds is not None
-            else params["embed"][input_ids].astype(self.dtype)
+            else embedding_lookup(params["embed"], input_ids, self.dtype)
         )  # [T, D]
         if self.embedding_multiplier != 1.0:
             x = x * self.embedding_multiplier
@@ -510,8 +543,13 @@ class LlamaForCausalLM:
         return hidden, new_kv
 
     def compute_logits(self, params: dict, hidden: jnp.ndarray) -> jnp.ndarray:
-        head = params["embed"].T if self.tie_embeddings else params["lm_head"]
-        logits = (hidden @ head.astype(hidden.dtype)).astype(jnp.float32)
+        from vllm_tpu.layers.quant import embedding_logits
+
+        if self.tie_embeddings:
+            logits = embedding_logits(hidden, params["embed"])
+        else:
+            logits = qmm(hidden, params["lm_head"])
+        logits = logits.astype(jnp.float32)
         if self.logits_scaling != 1.0:
             logits = logits / self.logits_scaling  # Granite semantics
         return logits
@@ -598,15 +636,24 @@ class LlamaForCausalLM:
                 return P("pp", *spec[1:])
 
             layers = {k: stage(v) for k, v in layers.items()}
+        q_extra = self.quantization and self.quantize_embedding_layers
         out = {
-            "embed": P(tp, None),
+            "embed": (
+                QuantizedEmbedding(q=P(tp, None), scale=P(tp))
+                if q_extra
+                else P(tp, None)
+            ),
             "layers": layers,
             "final_norm": P(None),
         }
         if self.norm_type == "layer":
             out["final_norm_b"] = P(None)
         if not self.tie_embeddings:
-            out["lm_head"] = P(None, tp)
+            out["lm_head"] = (
+                QuantizedLinear(q=P(None, tp), scale=P(tp))
+                if q_extra
+                else P(None, tp)
+            )
         return out
 
     def kv_cache_sharding(self, model_axis: str = "tp") -> P:

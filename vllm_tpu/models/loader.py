@@ -169,6 +169,11 @@ def load_safetensors_params(
         if quant_method
         else set()
     )
+    # Embedding/lm_head quantization (always int8 — per-row for the
+    # table, per-out-channel for the head — even under int4 projections).
+    quant_extra = bool(
+        quant_method and getattr(model, "quantize_embedding_layers", False)
+    )
 
     postprocess = getattr(model, "postprocess_weight", None)
 
@@ -187,6 +192,29 @@ def load_safetensors_params(
         if postprocess is not None:
             arr = postprocess(leaf_path, arr)
         sharding = _lookup_sharding(leaf_path)
+        if quant_extra and leaf_path == "embed":
+            from vllm_tpu.layers.quant import (
+                QuantizedEmbedding,
+                quantize_embedding_np,
+            )
+
+            qn, sn = quantize_embedding_np(arr)
+            q, sc = jnp.asarray(qn), jnp.asarray(sn)
+            if isinstance(sharding, QuantizedEmbedding):
+                q = jax.device_put(q, sharding.q)
+                sc = jax.device_put(sc, sharding.scale)
+            _set_path(params, leaf_path, QuantizedEmbedding(q=q, scale=sc))
+            return
+        if quant_extra and leaf_path == "lm_head":
+            from vllm_tpu.layers.quant import QuantizedLinear, quantize_np
+
+            qn, sn = quantize_np(arr, "int8")
+            q, sc = jnp.asarray(qn), jnp.asarray(sn)
+            if isinstance(sharding, QuantizedLinear):
+                q = jax.device_put(q, sharding.q)
+                sc = jax.device_put(sc, sharding.scale)
+            _set_path(params, leaf_path, QuantizedLinear(q=q, scale=sc))
+            return
         if leaf_path in quant_paths:
             if quant_method in ("int8", "fp8"):
                 from vllm_tpu.layers.quant import (

@@ -132,7 +132,9 @@ class MixtralForCausalLM(LlamaForCausalLM):
         md: AttentionMetadata,
         token_lora_slot: jnp.ndarray | None = None,  # unused (no LoRA yet)
     ) -> tuple[jnp.ndarray, jnp.ndarray]:
-        x = params["embed"][input_ids].astype(self.dtype)
+        from vllm_tpu.layers.quant import embedding_lookup
+
+        x = embedding_lookup(params["embed"], input_ids, self.dtype)
         t = x.shape[0]
         H, KH, Dh = self.num_heads, self.num_kv_heads, self.head_dim
         rope_cos, rope_sin = self.rope.cos, self.rope.sin

@@ -141,8 +141,47 @@ def paged_attention(
             q, kv_cache, layer, md, scale, sliding_window=sliding_window,
             soft_cap=soft_cap, k_scale=k_scale, v_scale=v_scale,
         )
+    return dispatch_ragged_attention(
+        q, kv_cache, layer, md, scale, sliding_window=sliding_window,
+        soft_cap=soft_cap, k_scale=k_scale, v_scale=v_scale,
+    )
+
+
+def _on_tpu() -> bool:
+    return jax.default_backend() == "tpu"
+
+
+def dispatch_ragged_attention(
+    q: jnp.ndarray,
+    kv_cache: jnp.ndarray,
+    layer: jnp.ndarray,
+    md: AttentionMetadata,
+    scale: float,
+    *,
+    sliding_window=None,
+    soft_cap: float | None = None,
+    k_scale: float | None = None,
+    v_scale: float | None = None,
+    return_lse: bool = False,
+    ctx_stride=1,
+    ctx_phase=0,
+    allow_interpret: bool = False,
+):
+    """THE kernel-vs-reference dispatch point (plain and striped-context
+    callers alike — eligibility rules live only here): the Pallas flash
+    kernel when it can run (TPU; or interpret mode on other backends when
+    ``allow_interpret`` and VLLM_TPU_PALLAS_INTERPRET — the CP shard_map
+    tests), else the XLA gather reference."""
+    import vllm_tpu.envs as envs
+
+    interpret = allow_interpret and bool(envs.VLLM_TPU_PALLAS_INTERPRET)
     kernel_ok = q.shape[-1] in (64, 128, 256)
-    if not envs.VLLM_TPU_DISABLE_PALLAS and kernel_ok and _on_tpu():
+    on_tpu = _on_tpu()
+    if (
+        not envs.VLLM_TPU_DISABLE_PALLAS
+        and kernel_ok
+        and (on_tpu or interpret)
+    ):
         from vllm_tpu.ops.rpa_kernel import ragged_paged_attention
 
         return ragged_paged_attention(
@@ -158,15 +197,16 @@ def paged_attention(
             soft_cap=soft_cap,
             k_scale=k_scale,
             v_scale=v_scale,
+            return_lse=return_lse,
+            interpret=interpret and not on_tpu,
+            ctx_stride=ctx_stride,
+            ctx_phase=ctx_phase,
         )
     return ref_ragged_paged_attention(
         q, kv_cache, layer, md, scale, sliding_window=sliding_window,
         soft_cap=soft_cap, k_scale=k_scale, v_scale=v_scale,
+        return_lse=return_lse, ctx_stride=ctx_stride, ctx_phase=ctx_phase,
     )
-
-
-def _on_tpu() -> bool:
-    return jax.default_backend() == "tpu"
 
 
 def ref_ragged_paged_attention(
@@ -183,14 +223,18 @@ def ref_ragged_paged_attention(
     return_lse: bool = False,
     ctx_stride: int = 1,
     ctx_phase: int = 0,
+    ctx_min_pos=0,
 ) -> jnp.ndarray:
     """Gather-based masked attention. Each token attends to its request's
     cached context up to and including its own position (causal).
 
     ``ctx_stride``/``ctx_phase`` describe striped context-parallel shards:
     local page j holds global page ``j * stride + phase`` (stride 1 = the
-    whole context). ``return_lse=True`` additionally returns the
-    per-(token, head) logsumexp — the ``merge_attn_states`` contract."""
+    whole context). ``ctx_min_pos`` additionally masks context positions
+    below it (cascade's suffix pass under striping: a rank's suffix table
+    slice can still contain one shared-prefix page). ``return_lse=True``
+    additionally returns the per-(token, head) logsumexp — the
+    ``merge_attn_states`` contract."""
     t, h, d = q.shape
     nl, nb, bs, rows, lanes = kv_cache.shape
     packed = packed_kv_layout(d)
@@ -227,6 +271,7 @@ def ref_ragged_paged_attention(
         ((local // bs) * ctx_stride + ctx_phase) * bs + local % bs
     )[None, :]  # [1, C] global positions of the local context slots
     causal = ctx_pos <= md.positions[:, None]  # [T, C]
+    causal &= ctx_pos >= jnp.asarray(ctx_min_pos, jnp.int32)
     if sliding_window is not None:
         # Accepts a python int OR a traced scalar (0 = full attention),
         # so a layer scan can alternate windowed/full layers.
@@ -256,13 +301,24 @@ def cascade_ref_attention(
     soft_cap: float | None = None,
     k_scale: float | None = None,
     v_scale: float | None = None,
+    return_lse: bool = False,
+    ctx_stride: int = 1,
+    ctx_phase=0,
 ) -> jnp.ndarray:
     """Shared-prefix (cascade) attention: every live request's first
     ``num_common_prefix_blocks`` block-table entries are identical, so the
     prefix KV is gathered ONCE (no [T, C] per-token duplication), attended
     by the whole batch, and LSE-merged with the per-request suffix
     attention (reference: ``gpu_model_runner.py:2367`` cascade path +
-    ``csrc/attention/merge_attn_states.cu``)."""
+    ``csrc/attention/merge_attn_states.cu``).
+
+    Striping-aware (``ctx_stride``/``ctx_phase``): under context
+    parallelism the shared prefix is striped across ranks like everything
+    else. ``num_common_prefix_blocks`` counts GLOBAL prefix pages; this
+    rank's slice of them is the table's first ``ceil(ncb/stride)`` columns
+    (a static bound — the per-rank count varies with the traced phase, so
+    the boundary pages are resolved by global-position masks: the prefix
+    pass masks positions >= ncb*bs, the suffix pass masks < ncb*bs)."""
     from vllm_tpu.ops.cp_attention import merge_attn_states
 
     ncb = md.num_common_prefix_blocks
@@ -271,10 +327,16 @@ def cascade_ref_attention(
     packed = packed_kv_layout(d)
     kh = rows if packed else rows // 2
     groups = h // kh
+    # Static per-rank bounds on the striped prefix: pr_max columns cover
+    # every rank's prefix pages; the suffix slice starts at pr_min (the
+    # one possibly-shared boundary column is disambiguated by masks).
+    pr_max = -(-ncb // ctx_stride)
+    pr_min = ncb // ctx_stride
+    prefix_end = ncb * bs  # first global position PAST the shared prefix
 
     # ---- common prefix: one shared gather ----
-    pages_c = kv_cache[layer, md.block_tables[0, :ncb]]
-    cp = ncb * bs
+    pages_c = kv_cache[layer, md.block_tables[0, :pr_max]]
+    cp = pr_max * bs
     kv_c = pages_c.reshape(cp, rows, lanes)
     if packed:
         k_c, v_c = kv_c[:, :, :d], kv_c[:, :, d:]
@@ -291,8 +353,13 @@ def cascade_ref_attention(
     scores = jnp.einsum("tkgd,ckd->tkgc", qg, k_c) * scale
     if soft_cap is not None:
         scores = soft_cap * jnp.tanh(scores / soft_cap)
-    ctx_pos = jnp.arange(cp, dtype=jnp.int32)[None, :]
+    local = jnp.arange(cp, dtype=jnp.int32)
+    ctx_pos = (
+        ((local // bs) * ctx_stride + jnp.asarray(ctx_phase, jnp.int32))
+        * bs + local % bs
+    )[None, :]
     causal = ctx_pos <= md.positions[:, None]
+    causal &= ctx_pos < prefix_end
     if sliding_window is not None:
         win = jnp.asarray(sliding_window, jnp.int32)
         causal &= (ctx_pos > (md.positions[:, None] - win)) | (win <= 0)
@@ -308,16 +375,24 @@ def cascade_ref_attention(
 
     md_suffix = _dc.replace(
         md,
-        block_tables=md.block_tables[:, ncb:],
+        block_tables=md.block_tables[:, pr_min:],
         num_common_prefix_blocks=0,
     )
     out_s, lse_s = ref_ragged_paged_attention(
         q, kv_cache, layer, md_suffix, scale,
         sliding_window=sliding_window, soft_cap=soft_cap,
         k_scale=k_scale, v_scale=v_scale, return_lse=True,
-        ctx_phase=ncb,
+        ctx_stride=ctx_stride,
+        # Local column j of the sliced table is absolute column j+pr_min:
+        # global page (j+pr_min)*stride + phase.
+        ctx_phase=pr_min * ctx_stride + jnp.asarray(ctx_phase, jnp.int32),
+        ctx_min_pos=prefix_end,
     )
-    return merge_attn_states(
+    out = merge_attn_states(
         jnp.stack([out_c.astype(jnp.float32), out_s.astype(jnp.float32)]),
         jnp.stack([lse_c, lse_s]),
     ).astype(q.dtype)
+    if not return_lse:
+        return out
+    lse = jnp.logaddexp(lse_c, lse_s)
+    return out, lse

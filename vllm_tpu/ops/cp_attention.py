@@ -83,23 +83,41 @@ def cp_paged_attention(
     """Runs INSIDE shard_map over `axis_name`. Local partial attention +
     cross-rank LSE merge; every rank returns the identical full output.
 
-    The local partial currently uses the XLA reference path (which
-    understands striped context positions); teaching the Pallas flash
-    kernel ctx_stride/ctx_phase + explicit query positions is the
-    outstanding fast-path work. ``local_attention_fn`` overrides the
+    The local partial runs the Pallas flash kernel (ctx_stride/ctx_phase
+    striped view, ``ops/rpa_kernel.py``) on TPU, falling back to the XLA
+    gather reference elsewhere. ``local_attention_fn`` overrides the
     local computation (must return ``(out, lse)``)."""
     cp = jax.lax.psum(1, axis_name)
     rank = jax.lax.axis_index(axis_name)
 
-    local = local_attention_fn or (
-        lambda *a, **kw: ref_ragged_paged_attention(*a, **kw)
-    )
+    local = local_attention_fn or _striped_attention
     out, lse = local(
         q, kv_local, layer, md_local, scale,
         sliding_window=sliding_window, soft_cap=soft_cap,
         return_lse=True, ctx_stride=cp, ctx_phase=rank,
     )
     return lse_merge_collective(out, lse, axis_name).astype(q.dtype)
+
+
+def _striped_attention(
+    q, kv_local, layer, md, scale, *, sliding_window=None,
+    soft_cap: float | None = None, k_scale=None, v_scale=None,
+    return_lse: bool = True, ctx_stride=1, ctx_phase=0,
+):
+    """Rank-local partial attention with the striped-context view; runs
+    INSIDE a shard_map manual region — ``ctx_phase`` is the traced rank
+    index. Kernel-vs-reference selection lives in
+    ``attention.dispatch_ragged_attention`` (interpret mode allowed here
+    so CPU-mesh CP tests exercise the kernel path)."""
+    from vllm_tpu.ops.attention import dispatch_ragged_attention
+
+    return dispatch_ragged_attention(
+        q, kv_local, layer, md, scale,
+        sliding_window=sliding_window, soft_cap=soft_cap,
+        k_scale=k_scale, v_scale=v_scale,
+        return_lse=return_lse, ctx_stride=ctx_stride, ctx_phase=ctx_phase,
+        allow_interpret=True,
+    )
 
 
 def cp_write_and_attend(
@@ -167,13 +185,25 @@ def cp_write_and_attend(
         lbt = jnp.where(valid[None, :], gbt % nb_local, 0)
         md_local = dataclasses.replace(md, block_tables=lbt)
 
-        # 3. Striped-position partial attention + LSE merge.
-        out, lse = ref_ragged_paged_attention(
-            q, kv_l, layer, md_local, scale,
-            sliding_window=sliding_window, soft_cap=soft_cap,
-            k_scale=k_scale, v_scale=v_scale,
-            return_lse=True, ctx_stride=cp, ctx_phase=rank,
-        )
+        # 3. Striped-position partial attention (Pallas fast path when
+        # available; striping-aware cascade for shared prefixes) + LSE
+        # merge.
+        if md.num_common_prefix_blocks > 0:
+            from vllm_tpu.ops.attention import cascade_ref_attention
+
+            out, lse = cascade_ref_attention(
+                q, kv_l, layer, md_local, scale,
+                sliding_window=sliding_window, soft_cap=soft_cap,
+                k_scale=k_scale, v_scale=v_scale,
+                return_lse=True, ctx_stride=cp, ctx_phase=rank,
+            )
+        else:
+            out, lse = _striped_attention(
+                q, kv_l, layer, md_local, scale,
+                sliding_window=sliding_window, soft_cap=soft_cap,
+                k_scale=k_scale, v_scale=v_scale,
+                return_lse=True, ctx_stride=cp, ctx_phase=rank,
+            )
         return kv_l, lse_merge_collective(out, lse, axis).astype(q.dtype)
 
     kv_spec = P(None, axis, None, None, None)

@@ -86,6 +86,9 @@ def _rpa_kernel(
     layer_ref,  # [1]
     window_ref,  # [1] i32 sliding window; 0 = full attention (dynamic so a
     #            layer scan can alternate windowed/full layers, e.g. Gemma)
+    ctx_ref,  # [2] i32 (stride, phase): striped context-parallel view —
+    #         local page j holds GLOBAL context page j*stride + phase
+    #         (stride 1, phase 0 = the whole context; see cp_attention.py)
     # Inputs
     q_ref,  # [num_q_per_blk, num_q_heads_per_blk, head_dim]
     kv_pages_hbm_ref,  # [L, NB, page_size, num_combined_kv_heads, head_dim]
@@ -125,6 +128,24 @@ def _rpa_kernel(
     init_buf_idx = seq_buf_idx_ref[1]
     q_len_start = q_blk_idx * num_q_per_blk
     q_len_end = q_len_start + num_q_per_blk
+    ctx_stride = ctx_ref[0]
+    ctx_phase = ctx_ref[1]
+
+    def local_ctx(seq_idx):
+        """(local page count, local context token count) of this rank's
+        stripe of the seq's context. Local page j holds global page
+        ``j*ctx_stride + ctx_phase``; only the seq's LAST global page is
+        partial. stride 1/phase 0 degenerates to (all pages, kv_len)."""
+        kv_len = kv_lens_ref[seq_idx]
+        n_gp = pl.cdiv(kv_len, page_size)
+        n_lp = jnp.where(
+            n_gp > ctx_phase,
+            lax.div(n_gp - ctx_phase + ctx_stride - 1, ctx_stride),
+            0,
+        )
+        g_last = (n_lp - 1) * ctx_stride + ctx_phase
+        last = jnp.minimum(page_size, kv_len - g_last * page_size)
+        return n_lp, jnp.where(n_lp > 0, (n_lp - 1) * page_size + last, 0)
 
     def seq_start_blk(seq_idx):
         """First KV block the window can reach for this seq's queries.
@@ -132,19 +153,23 @@ def _rpa_kernel(
         A function of seq_idx ONLY (not the q block) so the prefetch chain
         and the compute loop always agree on the DMA sequence. The seq's
         lowest query position is kv_len - q_len; its window floor is that
-        minus (window - 1)."""
+        minus (window - 1). Striped context (ctx_stride > 1) skips the
+        window fast-path: the floor is in global tokens and local pages
+        interleave, so start at 0 (the mask stays correct)."""
         window = window_ref[0]
         q_len = cu_q_lens_ref[seq_idx + 1] - cu_q_lens_ref[seq_idx]
         first_tok = jnp.maximum(
             kv_lens_ref[seq_idx] - q_len - (window - 1), 0
         )
-        return jnp.where(window > 0, first_tok // num_kv_per_blk, 0)
+        return jnp.where(
+            jnp.logical_and(window > 0, ctx_stride == 1),
+            first_tok // num_kv_per_blk,
+            0,
+        )
 
     def make_page_copy(heads_blk_idx, seq_idx, kv_blk_idx, buf_idx):
         start_page = kv_blk_idx * num_kv_pages_per_blk
-        end_page = jnp.minimum(
-            pages_per_seq, pl.cdiv(kv_lens_ref[seq_idx], page_size)
-        )
+        end_page = jnp.minimum(pages_per_seq, local_ctx(seq_idx)[0])
         if num_heads_blks == 1:
             # No heads sub-slice: a lane-dim slice on an HBM memref whose
             # head_dim is below the 128-lane tile (e.g. 64) is rejected by
@@ -219,11 +244,17 @@ def _rpa_kernel(
         q_end = cu_q_lens_ref[cur_seq_idx + 1]
         q_len = q_end - q_start
         kv_len = kv_lens_ref[cur_seq_idx]
+        # Loop bound in LOCAL context tokens; floor 1 so a rank holding
+        # ZERO pages of a short seq still runs one fully-masked block —
+        # the double-buffer prefetch chain stays uniform across ranks
+        # (skipping a seq would desync buffer ownership) and the masked
+        # pass initializes this seq's l/m/acc scratch rows.
+        local_bound = jnp.maximum(local_ctx(cur_seq_idx)[1], 1)
 
         def get_next_prefetch_ids(heads_blk_idx, cur_seq_idx, kv_blk_idx,
                                   cur_buf_idx):
             next_kv_blk_idx = kv_blk_idx + 1
-            is_last_kv_blk = next_kv_blk_idx * num_kv_per_blk >= kv_len
+            is_last_kv_blk = next_kv_blk_idx * num_kv_per_blk >= local_bound
             is_seq_end_in_blk = q_end <= q_len_end
             next_seq_idx = lax.select(
                 is_last_kv_blk,
@@ -259,12 +290,17 @@ def _rpa_kernel(
                     ref[...],
                 )
 
-            # KV rows beyond kv_len are garbage; zero them so the
-            # contraction stays NaN-free.
-            kv_mask = (
-                lax.broadcasted_iota(jnp.int32, k.shape, 0)
-                < kv_len - kv_len_start
+            # KV rows beyond the (striped) context are garbage; zero them
+            # so the contraction stays NaN-free. Position arithmetic is in
+            # GLOBAL context coordinates: local flat slot c maps to page
+            # (c // ps) * stride + phase, offset c % ps.
+            kv_flat = kv_len_start + lax.broadcasted_iota(
+                jnp.int32, k.shape, 0
             )
+            kv_gpos = (
+                (kv_flat // page_size) * ctx_stride + ctx_phase
+            ) * page_size + kv_flat % page_size
+            kv_mask = kv_gpos < kv_len
             k = jnp.where(kv_mask, k.astype(jnp.float32), 0).astype(k.dtype)
             v = jnp.where(kv_mask, v.astype(jnp.float32), 0).astype(v.dtype)
 
@@ -287,11 +323,14 @@ def _rpa_kernel(
                 )
                 // num_q_heads_per_kv_head
             )
-            col_ids = kv_len_start + lax.broadcasted_iota(
+            col_flat = kv_len_start + lax.broadcasted_iota(
                 jnp.int32,
                 (num_q_per_blk * num_q_heads_per_kv_head, num_kv_per_blk),
                 1,
             )
+            col_ids = (
+                (col_flat // page_size) * ctx_stride + ctx_phase
+            ) * page_size + col_flat % page_size
             causal_mask = row_ids < col_ids
             window = window_ref[0]
             causal_mask = jnp.logical_or(
@@ -346,7 +385,7 @@ def _rpa_kernel(
 
         def is_valid_kv_blk_in_cur_seq(kv_states):
             kv_blk_idx, _ = kv_states
-            return kv_blk_idx * num_kv_per_blk < kv_len
+            return kv_blk_idx * num_kv_per_blk < local_bound
 
         def compute_with_kv_blk_in_cur_seq(kv_states):
             kv_blk_idx, cur_buf_idx = kv_states
@@ -530,11 +569,20 @@ def ragged_paged_attention(
     vmem_limit_bytes: int | None = None,
     return_lse: bool = False,
     interpret: bool = False,
+    ctx_stride=1,
+    ctx_phase=0,
 ):
     """Mixed prefill+decode flash attention over the paged KV cache.
 
     Returns ``out [T, H, D]``, or ``(out, lse [T, H] f32)`` with
     ``return_lse=True``.
+
+    ``ctx_stride``/``ctx_phase`` (ints or traced i32 scalars) give the
+    kernel a striped context-parallel view: ``page_indices`` is a rank's
+    LOCAL table whose column j holds global context page
+    ``j*ctx_stride + ctx_phase``; ``kv_lens`` stays GLOBAL (query
+    positions derive from it). The contract matches
+    ``ref_ragged_paged_attention`` and ``cp_attention.cp_write_and_attend``.
     """
     _validate(q, kv_pages, kv_lens, page_indices, cu_q_lens, num_seqs)
     if mask_value is None:
@@ -618,6 +666,10 @@ def ragged_paged_attention(
     window = jnp.asarray(
         0 if sliding_window is None else sliding_window, jnp.int32
     ).reshape(1)
+    ctx = jnp.stack([
+        jnp.asarray(ctx_stride, jnp.int32),
+        jnp.asarray(ctx_phase, jnp.int32),
+    ])
     scalar_prefetches = (
         kv_lens,
         page_indices,
@@ -626,6 +678,7 @@ def ragged_paged_attention(
         num_seqs,
         layer.astype(jnp.int32).reshape(1),
         window,
+        ctx,
     )
     kernel = pl.pallas_call(
         functools.partial(

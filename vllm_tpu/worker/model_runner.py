@@ -1192,8 +1192,6 @@ class ModelRunner:
             self.config.scheduler_config.enable_cascade_attention
             and not s
             and r_live >= 2
-            # Cascade's shared-prefix split is not striped-context aware.
-            and self.config.parallel_config.context_parallel_size == 1
         ):
             tables = batch.block_table[rows]  # [r_live, max_b]
             min_blocks = int(batch.num_blocks[rows].min())

@@ -200,6 +200,14 @@ class Worker:
         self.model = model_cls(
             hf_config, dtype=mc.jax_dtype, quantization=mc.quantization
         )
+        if mc.quantize_embedding_layers:
+            if not getattr(self.model, "supports_quantized_embedding", False):
+                raise ValueError(
+                    f"quantize_embedding_layers is not supported by "
+                    f"{type(self.model).__name__} (its forward path "
+                    "indexes the raw embedding table)"
+                )
+            self.model.quantize_embedding_layers = True
         from vllm_tpu import envs as _envs
 
         if _envs.VLLM_TPU_UNROLL_LAYERS and hasattr(
