@@ -250,3 +250,33 @@ def test_prefix_cache_disabled():
     sched.add_request(r2)
     out2 = sched.schedule()
     assert out2.num_scheduled_tokens[r2.request_id] == 64
+
+
+def test_spec_all_or_nothing_trim():
+    """Tree spec mode: a budget that truncates the draft tree drops the
+    drafts entirely (a partial tree is unverifiable) instead of
+    scheduling a prefix of them."""
+    sched = create_scheduler(max_num_batched_tokens=4)
+    sched.config.spec_all_or_nothing = True
+    req = create_request(prompt_len=8, max_tokens=16)
+    sched.add_request(req)
+    out = sched.schedule()  # prefill chunk (4 of 8)
+    sched.update_from_output(
+        out, ModelRunnerOutput(req_ids=[req.request_id], sampled_token_ids=[[]])
+    )
+    out = sched.schedule()  # rest of prefill
+    sched.update_from_output(out, make_runner_output(out))
+    # 6 drafts + 1 input token > 4-token budget -> drafts dropped.
+    req.spec_token_ids = [11, 12, 13, 14, 15, 16]
+    out = sched.schedule()
+    assert req.request_id not in out.scheduled_spec_decode_tokens
+    assert out.num_scheduled_tokens[req.request_id] == 1
+    sched.update_from_output(out, make_runner_output(out))
+    # A budget that fits the whole tree schedules all of it.
+    sched.config.max_num_batched_tokens = 64
+    req.spec_token_ids = [11, 12, 13, 14, 15, 16]
+    out = sched.schedule()
+    assert out.scheduled_spec_decode_tokens[req.request_id] == (
+        [11, 12, 13, 14, 15, 16]
+    )
+    assert out.num_scheduled_tokens[req.request_id] == 7

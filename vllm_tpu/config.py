@@ -190,6 +190,9 @@ class SchedulerConfig:
 
     max_num_batched_tokens: int = 8192  # per-step token budget
     max_num_seqs: int = 256  # max concurrent requests in a step
+    # Tree spec verification: schedule a request's draft tokens
+    # all-or-nothing (a budget-truncated tree is unverifiable).
+    spec_all_or_nothing: bool = False
     max_model_len: int = 8192  # mirrored from ModelConfig at finalize
     # Lag-N pipelined scheduling (schedule step N+k before step N's tokens
     # reach the host); forced off when spec decode is on.
@@ -255,6 +258,15 @@ class SpeculativeConfig:
     # information-flow channel in multi-tenant serving (draft acceptance
     # patterns are observable via timing) — flip off there.
     suffix_cross_request_corpus: bool = True
+    # Tree verification (Medusa): a static branching spec like "2x2x1"
+    # — depth-d candidates = head d's top-b_d tokens, verified as a TREE
+    # in one step (tree-masked attention + rejection sampling over
+    # root-to-leaf paths). None = chain verification. Reference:
+    # v1/attention/backends/tree_attn.py. When set,
+    # num_speculative_tokens is derived (= node count) and the scheduler
+    # schedules draft trees all-or-nothing (a partial tree is
+    # unverifiable).
+    spec_tree: str | None = None
 
     @property
     def enabled(self) -> bool:
@@ -364,6 +376,24 @@ class EngineConfig:
             sc.max_model_len = mc.max_model_len
         if not sc.enable_chunked_prefill:
             sc.max_num_batched_tokens = max(sc.max_num_batched_tokens, sc.max_model_len)
+        if self.speculative_config.spec_tree is not None:
+            from vllm_tpu.spec_decode.tree import build_tree
+
+            if self.speculative_config.method != "medusa":
+                raise ValueError(
+                    "spec_tree requires the medusa proposer (per-depth "
+                    "candidate heads); chain proposers have no branches"
+                )
+            if self.parallel_config.context_parallel_size > 1:
+                raise ValueError(
+                    "spec_tree under context parallelism is not supported "
+                    "yet (the CP attention path has no tree-window part)"
+                )
+            tree = build_tree(self.speculative_config.spec_tree)
+            # The engine-level draft count is the NODE count; the head
+            # count (= depth) is derived from the spec by the runner.
+            self.speculative_config.num_speculative_tokens = tree.num_nodes
+            sc.spec_all_or_nothing = True
         if (
             self.speculative_config.enabled
             and self.speculative_config.method in ("eagle", "draft_model")

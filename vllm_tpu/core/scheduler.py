@@ -387,6 +387,17 @@ class Scheduler:
                 self._rollback_encoder(request, enc_new)
                 req_index += 1
                 continue
+            if self.config.spec_all_or_nothing and request.spec_token_ids:
+                # A truncated draft TREE is unverifiable (children would
+                # be cut mid-topology): drop the drafts BEFORE allocation
+                # so no blocks are allocated — or victims preempted — for
+                # tokens the step will not run.
+                num_spec_fit = (
+                    request.num_computed_tokens + num_new_tokens
+                    - request.num_tokens
+                )
+                if 0 < num_spec_fit < len(request.spec_token_ids):
+                    num_new_tokens -= num_spec_fit
 
             # Allocate, preempting the tail of `running` on failure.
             while True:
@@ -413,7 +424,8 @@ class Scheduler:
                 self._rollback_encoder(request, enc_new)
                 break
 
-            # Trim speculative tokens that no longer fit the scheduled window.
+            # Trim speculative tokens that no longer fit the scheduled
+            # window (all-or-nothing tree trims happened pre-allocation).
             if request.spec_token_ids:
                 num_scheduled_spec = (
                     request.num_computed_tokens + num_new_tokens - request.num_tokens

@@ -76,6 +76,7 @@ class EngineArgs:
     speculative_method: str | None = None
     num_speculative_tokens: int = 0
     speculative_model: str | None = None
+    spec_tree: str | None = None
     suffix_cross_request_corpus: bool = True
 
     enable_lora: bool = False
@@ -149,6 +150,7 @@ class EngineArgs:
                 method=self.speculative_method,  # type: ignore[arg-type]
                 num_speculative_tokens=self.num_speculative_tokens,
                 model=self.speculative_model,
+                spec_tree=self.spec_tree,
                 suffix_cross_request_corpus=(
                     self.suffix_cross_request_corpus
                 ),

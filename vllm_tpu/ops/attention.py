@@ -66,6 +66,17 @@ class AttentionMetadata:
     # attention models). Reference: HybridKVCacheCoordinator per-type
     # groups (``kv_cache_coordinator.py:392``).
     state_slots: jnp.ndarray | None = None
+    # Tree-attention spec verification (reference: tree_attn.py:255 tree
+    # bias). When set, this step's tokens are per-request draft-tree
+    # WINDOWS of static width W: ``tree_mask [T, W]`` bool says which of
+    # its own row's window tokens each query attends (ancestors + self),
+    # ``tree_window_start [T]`` is the stream index of the row's window
+    # start, and ``tree_paged`` is a pseudo-sequence view (one query per
+    # token, kv_len = committed context) for the paged-context part. See
+    # ``tree_verify_attention``.
+    tree_mask: jnp.ndarray | None = None
+    tree_window_start: jnp.ndarray | None = None
+    tree_paged: "AttentionMetadata | None" = None
 
 
 def packed_kv_layout(head_dim: int) -> bool:
@@ -134,6 +145,12 @@ def paged_attention(
     elsewhere (and under VLLM_TPU_DISABLE_PALLAS)."""
     import vllm_tpu.envs as envs
 
+    if md.tree_mask is not None:
+        # Tree-verification step: ancestor-masked window + paged context.
+        return tree_verify_attention(
+            q, kv_cache, layer, md, scale,
+            soft_cap=soft_cap, k_scale=k_scale, v_scale=v_scale,
+        )
     if md.num_common_prefix_blocks > 0:
         # Shared-prefix decode: XLA cascade formulation (a cascade-aware
         # Pallas kernel is the optimization seam).
@@ -184,6 +201,14 @@ def dispatch_ragged_attention(
     ):
         from vllm_tpu.ops.rpa_kernel import ragged_paged_attention
 
+        run_interpret = interpret and not on_tpu
+        # The tuned-block-size table is keyed by TPU generation; off-TPU
+        # interpret runs pick explicit small blocks instead.
+        blk_kw = (
+            dict(num_kv_pages_per_block=2, num_queries_per_block=8)
+            if run_interpret
+            else {}
+        )
         return ragged_paged_attention(
             q,
             kv_cache,
@@ -198,9 +223,10 @@ def dispatch_ragged_attention(
             k_scale=k_scale,
             v_scale=v_scale,
             return_lse=return_lse,
-            interpret=interpret and not on_tpu,
+            interpret=run_interpret,
             ctx_stride=ctx_stride,
             ctx_phase=ctx_phase,
+            **blk_kw,
         )
     return ref_ragged_paged_attention(
         q, kv_cache, layer, md, scale, sliding_window=sliding_window,
@@ -288,6 +314,91 @@ def ref_ragged_paged_attention(
         return out
     lse = jax.scipy.special.logsumexp(scores, axis=-1)  # [T, KH, G]
     return out, lse.reshape(t, h)
+
+
+def tree_verify_attention(
+    q: jnp.ndarray,  # [T, H, D] — T = (padded) sum of per-request windows
+    kv_cache: jnp.ndarray,
+    layer: jnp.ndarray,
+    md: AttentionMetadata,  # tree_mask/tree_window_start/tree_paged set
+    scale: float,
+    *,
+    soft_cap: float | None = None,
+    k_scale: float | None = None,
+    v_scale: float | None = None,
+) -> jnp.ndarray:
+    """Attention for a tree-verification step, in two LSE-merged parts.
+
+    Reference analog: ``vllm/v1/attention/backends/tree_attn.py`` builds a
+    [T, T] tree bias and runs one masked attention; TPU-first we split:
+
+    1. COMMITTED context: every window token sees exactly the request's
+       context BEFORE this step, regardless of its depth — so the step is
+       reshaped into one-query pseudo-sequences (``md.tree_paged``:
+       kv_len = committed length, duplicated block-table rows) and runs
+       the ordinary ragged kernel. No kernel changes; the tradeoff is the
+       context pages are DMA'd once per window token instead of once per
+       request (verify steps are a small fraction of decode time).
+    2. TREE window: each token attends its own window's ancestors + self
+       (``md.tree_mask``), a dense [T, W] attention over this step's K/V
+       read back from the just-written cache slots.
+
+    Both parts return logsumexps and merge exactly
+    (``merge_attn_states``)."""
+    from vllm_tpu.ops.cp_attention import merge_attn_states
+
+    t, h, d = q.shape
+    nl, nb, bs, rows, lanes = kv_cache.shape
+    packed = packed_kv_layout(d)
+    kh = rows if packed else rows // 2
+    groups = h // kh
+    w = md.tree_mask.shape[1]
+
+    out_c, lse_c = dispatch_ragged_attention(
+        q, kv_cache, layer, md.tree_paged, scale,
+        soft_cap=soft_cap, k_scale=k_scale, v_scale=v_scale,
+        return_lse=True, allow_interpret=True,
+    )
+
+    # Window K/V: this step's rows, read from the slots just written.
+    win_idx = jnp.clip(
+        md.tree_window_start[:, None] + jnp.arange(w, dtype=jnp.int32)[None],
+        0, md.slot_mapping.shape[0] - 1,
+    )  # [T, W] stream indices of the row's window tokens
+    w_slots = md.slot_mapping[win_idx]  # [T, W] flat cache slots
+    flat = kv_cache.reshape(nl * nb * bs, rows, lanes)
+    kv_win = flat[layer * (nb * bs) + w_slots]  # [T, W, rows, lanes]
+    if packed:
+        k_w = kv_win[..., :d]
+        v_w = kv_win[..., d:]
+    else:
+        k_w = kv_win[:, :, 0::2]
+        v_w = kv_win[:, :, 1::2]
+    k_w = k_w.astype(jnp.float32)
+    v_w = v_w.astype(jnp.float32)
+    if k_scale is not None:
+        k_w = k_w * k_scale
+    if v_scale is not None:
+        v_w = v_w * v_scale
+
+    qg = q.reshape(t, kh, groups, d).astype(jnp.float32)
+    scores = jnp.einsum("tkgd,twkd->tkgw", qg, k_w) * scale
+    if soft_cap is not None:
+        scores = soft_cap * jnp.tanh(scores / soft_cap)
+    scores = jnp.where(
+        md.tree_mask[:, None, None, :], scores, -jnp.inf
+    )
+    probs = jax.nn.softmax(scores, axis=-1)
+    probs = jnp.where(jnp.isnan(probs), 0.0, probs)
+    out_w = jnp.einsum("tkgw,twkd->tkgd", probs, v_w).reshape(t, h, d)
+    lse_w = jax.scipy.special.logsumexp(scores, axis=-1).reshape(t, h)
+
+    return merge_attn_states(
+        jnp.stack([
+            out_c.astype(jnp.float32), out_w.astype(jnp.float32)
+        ]),
+        jnp.stack([lse_c.astype(jnp.float32), lse_w]),
+    ).astype(q.dtype)
 
 
 def cascade_ref_attention(

@@ -1,0 +1,189 @@
+"""In-jit rejection sampling over a draft TREE (tree-attention spec
+verification).
+
+Reference analog: ``vllm/v1/attention/backends/tree_attn.py`` +
+SpecInfer-style multi-candidate verification. Semantics per request row:
+
+- Walk the static topology from the root. At the current node, the
+  target model's distribution (its logits were computed by the same
+  verify step, ancestor-masked) judges the node's children in draft-rank
+  order:
+  * greedy rows: the child whose token equals the target argmax is
+    accepted (at most one can match);
+  * sampling rows: recursive residual rejection — child ``c`` is
+    accepted with probability ``residual[tok_c] / sum(residual)``; a
+    rejected child's token mass is zeroed from the residual before the
+    next sibling is tried. With deterministic (delta) proposals this is
+    the standard without-replacement scheme and preserves the target
+    distribution exactly.
+- A row that rejects every child at depth ``d`` emits a RECOVERY token
+  from the (masked, renormalized) residual at that node; a row that
+  accepts a full root-to-leaf path emits a BONUS token from the leaf's
+  distribution.
+
+Returns ``(out_tokens [R, D+1], num_out [R], kv_src [R, D])`` — the
+chain-sampler output contract plus ``kv_src``: the WINDOW index of the
+accepted node at each depth, for consolidating accepted KV into
+canonical slots (the accepted path's cache rows are valid as-is: a
+node's K/V were computed over exactly its ancestor chain).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from vllm_tpu.sample.sampler import (
+    SamplingMetadata,
+    _mask_top_k,
+    _mask_top_p_min_p,
+    apply_penalties,
+)
+from vllm_tpu.spec_decode.tree import DraftTree
+
+
+def tree_rejection_sample(
+    logits: jnp.ndarray,  # [R, W, V] f32 — target logits at every window pos
+    draft_ids: jnp.ndarray,  # [R, W] i32 — window tokens (col 0 = root)
+    tree: DraftTree,
+    md: SamplingMetadata,
+    *,
+    active: jnp.ndarray | None = None,  # [R] bool: row has a full tree
+    needs_penalties: bool = False,
+    needs_top_k: bool,
+    needs_top_p_min_p: bool,
+    needs_gumbel: bool = True,
+) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    r, w, v = logits.shape
+    depth = tree.num_levels
+    rows = jnp.arange(r)
+    max_b = max(tree.branching)
+    # Static child table [W, max_b], -1-padded.
+    child_tab = np.full((w, max_b), -1, np.int32)
+    for node, cs in enumerate(tree.children):
+        child_tab[node, : len(cs)] = cs
+    child_tab = jnp.asarray(child_tab)
+
+    if needs_penalties:
+        from dataclasses import replace
+
+        rep = lambda x: jnp.repeat(x, w, axis=0)  # noqa: E731
+        md_rep = replace(
+            md,
+            repetition_penalty=rep(md.repetition_penalty),
+            frequency_penalty=rep(md.frequency_penalty),
+            presence_penalty=rep(md.presence_penalty),
+            output_token_counts=rep(md.output_token_counts),
+            prompt_token_mask=rep(md.prompt_token_mask),
+        )
+        logits = apply_penalties(
+            logits.reshape(r * w, v), md_rep
+        ).reshape(r, w, v)
+
+    greedy = md.temperature == 0.0
+    tgt_all = jnp.argmax(logits, axis=-1).astype(jnp.int32)  # [R, W]
+
+    if needs_gumbel:
+        temp = jnp.where(greedy, 1.0, md.temperature)
+        flat = (logits / temp[:, None, None]).reshape(r * w, v)
+        rep = lambda x: jnp.repeat(x, w, axis=0)  # noqa: E731
+        if needs_top_k:
+            flat = _mask_top_k(flat, rep(md.top_k))
+        if needs_top_p_min_p:
+            flat = _mask_top_p_min_p(flat, rep(md.top_p), rep(md.min_p))
+        probs_all = jax.nn.softmax(flat, axis=-1).reshape(r, w, v)
+
+        def row_key(key_pair):
+            key = jax.random.PRNGKey(0)
+            key = jax.random.fold_in(key, key_pair[0])
+            return jax.random.fold_in(key, key_pair[1])
+
+        keys = jax.vmap(row_key)(md.prng_keys)  # [R] keys
+
+    cur = jnp.zeros(r, jnp.int32)  # window idx of deepest accepted node
+    # Rows without a (full) tree accept nothing: they emit one token
+    # from the root distribution — exactly a plain decode step.
+    alive = (
+        jnp.ones(r, bool) if active is None else active.astype(bool)
+    )
+    acc_len = jnp.zeros(r, jnp.int32)
+    emits = []
+    kv_srcs = []
+    for d in range(1, depth + 1):
+        b_d = tree.branching[d - 1]
+        tgt_d = tgt_all[rows, cur]  # [R] greedy target at the current node
+        if needs_gumbel:
+            residual = probs_all[rows, cur]  # [R, V]
+        acc_hit = jnp.zeros(r, bool)
+        nxt = cur
+        chosen_tok = tgt_d
+        for rank in range(b_d):
+            c = child_tab[cur, rank]  # [R]
+            tok_c = draft_ids[rows, jnp.clip(c, 0, w - 1)]
+            if needs_gumbel:
+                m = jnp.sum(residual, axis=-1)
+                p_tok = residual[rows, tok_c]
+                key_d = jax.vmap(
+                    lambda k: jax.random.fold_in(
+                        jax.random.fold_in(k, d), rank
+                    )
+                )(keys)
+                u = jax.vmap(lambda k: jax.random.uniform(k, ()))(key_d)
+                accept_rand = u * jnp.maximum(m, 1e-30) < p_tok
+                accept = jnp.where(greedy, tok_c == tgt_d, accept_rand)
+            else:
+                accept = tok_c == tgt_d
+            hit = alive & ~acc_hit & (c >= 0) & accept
+            nxt = jnp.where(hit, c, nxt)
+            chosen_tok = jnp.where(hit, tok_c, chosen_tok)
+            acc_hit |= hit
+            if needs_gumbel:
+                # Zero the tried token's mass for later siblings/recovery
+                # (only where the row is still searching at this node).
+                searching = alive & ~acc_hit
+                residual = residual.at[rows, tok_c].multiply(
+                    jnp.where(searching, 0.0, 1.0)
+                )
+        if needs_gumbel:
+            # Recovery for rows that rejected every child: sample the
+            # residual (greedy rows take the argmax target).
+            key_rec = jax.vmap(
+                lambda k: jax.random.fold_in(jax.random.fold_in(k, d), 99)
+            )(keys)
+            noise = jax.vmap(
+                lambda k: jax.random.gumbel(k, (v,), jnp.float32)
+            )(key_rec)
+            rec_rand = jnp.argmax(
+                jnp.log(jnp.clip(residual, 1e-30, None)) + noise, axis=-1
+            ).astype(jnp.int32)
+            rec_tok = jnp.where(greedy, tgt_d, rec_rand)
+        else:
+            rec_tok = tgt_d
+        emits.append(jnp.where(acc_hit, chosen_tok, rec_tok))
+        kv_srcs.append(nxt)
+        acc_len = acc_len + (alive & acc_hit)
+        alive &= acc_hit
+        cur = nxt
+
+    # Bonus from the leaf's distribution for fully-accepted rows.
+    tgt_leaf = tgt_all[rows, cur]
+    if needs_gumbel:
+        key_b = jax.vmap(lambda k: jax.random.fold_in(k, 7777))(keys)
+        noise = jax.vmap(
+            lambda k: jax.random.gumbel(k, (v,), jnp.float32)
+        )(key_b)
+        p_leaf = probs_all[rows, cur]
+        bonus_rand = jnp.argmax(
+            jnp.log(jnp.clip(p_leaf, 1e-30, None)) + noise, axis=-1
+        ).astype(jnp.int32)
+        bonus = jnp.where(greedy, tgt_leaf, bonus_rand)
+    else:
+        bonus = tgt_leaf
+
+    out0 = jnp.stack(emits + [bonus], axis=1)  # [R, D+1]
+    num_out = acc_len + 1
+    pos = jnp.arange(depth + 1, dtype=jnp.int32)[None, :]
+    out = jnp.where(pos < num_out[:, None], out0, 0)
+    kv_src = jnp.stack(kv_srcs, axis=1)  # [R, D]
+    return out, num_out, kv_src

@@ -90,13 +90,34 @@ class MedusaHeads:
             "head_w": jnp.asarray(head_w, self.dtype),
         }
 
-    def propose(self, mp: dict, hidden: jnp.ndarray) -> jnp.ndarray:
-        """hidden [R, D] (last accepted position) -> greedy drafts [R, K]."""
+    def _head_logits(self, mp: dict, hidden: jnp.ndarray) -> jnp.ndarray:
         h = hidden.astype(self.dtype)
-        # Residual SiLU block per head, then vocab argmax.
+        # Residual SiLU block per head.
         hk = h[None] + jax.nn.silu(
             jnp.einsum("rd,kde->kre", h, mp["res_w"])
             + mp["res_b"][:, None, :]
         )  # [K, R, D]
-        logits = jnp.einsum("kre,kev->krv", hk, mp["head_w"])
+        return jnp.einsum("kre,kev->krv", hk, mp["head_w"])
+
+    def propose(self, mp: dict, hidden: jnp.ndarray) -> jnp.ndarray:
+        """hidden [R, D] (last accepted position) -> greedy drafts [R, K]."""
+        logits = self._head_logits(mp, hidden)
         return jnp.argmax(logits, axis=-1).astype(jnp.int32).T  # [R, K]
+
+    def propose_tree(self, mp: dict, hidden: jnp.ndarray, tree) -> jnp.ndarray:
+        """hidden [R, D] -> tree drafts [R, num_nodes] in window order.
+
+        Head d's top-``branching[d]`` tokens are the depth-(d+1)
+        candidates; the cartesian topology shares the candidate set
+        across all depth-d parents (node w takes rank ``tree.rank[w]``).
+        Requires ``num_heads == tree.num_levels``."""
+        logits = self._head_logits(mp, hidden)  # [K, R, V]
+        tops = [
+            jax.lax.top_k(logits[d], tree.branching[d])[1].astype(jnp.int32)
+            for d in range(tree.num_levels)
+        ]  # per depth: [R, b_d]
+        cols = [
+            tops[tree.depth[w] - 1][:, tree.rank[w]]
+            for w in range(1, tree.width)
+        ]
+        return jnp.stack(cols, axis=1)  # [R, num_nodes]

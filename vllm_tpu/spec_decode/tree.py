@@ -1,0 +1,112 @@
+"""Static draft-tree topology for tree-attention speculative verification.
+
+Reference analog: ``vllm/v1/attention/backends/tree_attn.py:32`` (tree
+bias construction :255) and ``vllm/v1/spec_decode`` tree drafting. The
+reference builds per-batch attention bias tensors on the fly; TPU-first
+the topology is STATIC (part of the jit signature): a branching spec like
+``"2x2x1"`` fixes the node count, parent links, depths, and the
+[W, W] ancestor mask at trace time, so the verify step stays a single
+compiled program.
+
+Layout: window index 0 is the ROOT (the token sampled by the previous
+step — it is re-run through the model to produce the distribution that
+judges depth-1 candidates); nodes are breadth-first after it. A
+``"b1xb2x..."`` spec is Medusa-style cartesian: every depth-(d-1) node
+has ``b_d`` children, ranked by the depth-d head's top-``b_d`` logits.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+import numpy as np
+
+
+@dataclass(frozen=True)
+class DraftTree:
+    """Static topology. ``W = 1 + num_nodes`` window positions."""
+
+    branching: tuple[int, ...]  # children per node at each depth
+    parent: tuple[int, ...]  # [W] window index of parent (root: 0)
+    depth: tuple[int, ...]  # [W] 0 for root, 1.. for nodes
+    # children[w] = window indices of w's children (ranked draft order).
+    children: tuple[tuple[int, ...], ...]
+    # For Medusa cartesian drafting: node w at depth d uses candidate
+    # rank[w] of head d (its top-b_d list), following parent's path.
+    rank: tuple[int, ...]
+
+    @property
+    def width(self) -> int:
+        return len(self.parent)
+
+    @property
+    def num_nodes(self) -> int:
+        return self.width - 1
+
+    @property
+    def num_levels(self) -> int:
+        return len(self.branching)
+
+    def ancestor_mask(self) -> np.ndarray:
+        """[W, W] bool: query window position w attends key window
+        position u iff u is w or an ancestor of w."""
+        w = self.width
+        m = np.zeros((w, w), bool)
+        for i in range(w):
+            u = i
+            m[i, i] = True
+            while u != 0:
+                u = self.parent[u]
+                m[i, u] = True
+        return m
+
+    def paths(self) -> list[list[int]]:
+        """All root-to-leaf window-index paths (excluding the root)."""
+        leaves = [
+            w for w in range(1, self.width) if not self.children[w]
+        ]
+        out = []
+        for leaf in leaves:
+            path = []
+            u = leaf
+            while u != 0:
+                path.append(u)
+                u = self.parent[u]
+            out.append(path[::-1])
+        return out
+
+
+def build_tree(spec: str) -> DraftTree:
+    """Parse ``"b1xb2x..."`` into a cartesian draft tree.
+
+    ``"1x1x1"`` degenerates to a 3-token chain (tree verification then
+    equals chain verification exactly — the equivalence tests rely on
+    this).
+    """
+    branching = tuple(int(b) for b in spec.lower().split("x"))
+    if not branching or any(b < 1 for b in branching):
+        raise ValueError(f"bad draft-tree spec {spec!r}")
+    parent = [0]
+    depth = [0]
+    rank = [0]
+    children: list[list[int]] = [[]]
+    frontier = [0]
+    for d, b in enumerate(branching, start=1):
+        nxt = []
+        for p in frontier:
+            for r in range(b):
+                w = len(parent)
+                parent.append(p)
+                depth.append(d)
+                rank.append(r)
+                children.append([])
+                children[p].append(w)
+                nxt.append(w)
+        frontier = nxt
+    return DraftTree(
+        branching=branching,
+        parent=tuple(parent),
+        depth=tuple(depth),
+        children=tuple(tuple(c) for c in children),
+        rank=tuple(rank),
+    )

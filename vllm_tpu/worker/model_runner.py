@@ -135,6 +135,25 @@ class ModelRunner:
         # rejection-sampler runs in-jit for both.
         spec = config.speculative_config
         self.num_spec = spec.num_speculative_tokens if spec.enabled else 0
+        # Tree verification: static topology; num_spec is the NODE count.
+        self.tree = None
+        if spec.enabled and spec.spec_tree is not None:
+            from vllm_tpu.spec_decode.tree import build_tree
+
+            self.tree = build_tree(spec.spec_tree)
+            assert self.num_spec == self.tree.num_nodes
+            if (
+                getattr(model, "sliding_window", None) is not None
+                # Gemma-class models keep the cache-level window None but
+                # pass real per-layer windows into the attention op.
+                or getattr(model, "window", None) is not None
+                or hasattr(model, "_layer_window")
+            ):
+                raise ValueError(
+                    "tree spec verification with sliding-window attention "
+                    "is not supported (the window floor is undefined for "
+                    "tree positions)"
+                )
         self.proposer = None
         self.draft_model = None
         self.draft_params = None
@@ -157,8 +176,10 @@ class ModelRunner:
             from vllm_tpu.spec_decode.medusa import MedusaHeads
 
             self.medusa = MedusaHeads(
-                spec.num_speculative_tokens, model.hidden_size,
-                model.vocab_size, model.dtype,
+                # Tree mode: one head per DEPTH level, not per node.
+                self.tree.num_levels if self.tree else
+                spec.num_speculative_tokens,
+                model.hidden_size, model.vocab_size, model.dtype,
             )
             if spec.model:
                 self.medusa_params = self.medusa.load_params(spec.model)
@@ -393,6 +414,117 @@ class ModelRunner:
         return (token_ids, md, sampling, feedback, grammar_rows, logit_adjust,
                 draft_next, token_lora, plp_next, spec)
 
+    def _build_tree_metadata(self, md, spec, t_pad: int, r_pad: int):
+        """In-jit tree-verify views (host prep stays the chain layout).
+
+        The step's token stream holds per-tree-row windows of
+        ``[root, node_1..node_N]`` at consecutive slots. Three rewrites:
+
+        1. positions: node tokens move to ``root_pos + depth`` (RoPE and
+           downstream causality see tree coordinates).
+        2. ``tree_paged``: a pseudo-sequence split for the paged-context
+           part — non-tree rows keep their chunk as one sequence; a tree
+           row becomes a prefix sequence ``[chunk_start..root]`` (kv_len
+           ``root_pos+1`` — true causal for the prefix, root sees itself
+           via its canonical slot) plus one single-query sequence per
+           node with the same kv bound, so nodes see context + root but
+           never sibling slots. Node pseudo-positions are capped at
+           ``root_pos`` for the reference path's position mask.
+        3. ``tree_mask``: node-vs-node ancestor mask (root excluded —
+           covered by the paged part).
+        """
+        import dataclasses
+
+        import numpy as np
+
+        tree = self.tree
+        s = tree.num_nodes
+        t = t_pad
+        base_idx = spec["sample_pos"][:, 0]  # [R] stream idx of the root
+        active = spec["num_draft"] == s  # [R] full tree scheduled
+        row = jnp.clip(md.token_req_idx, 0, r_pad - 1)  # [T]
+        tok = jnp.arange(t, dtype=jnp.int32)
+        t_live = md.query_start_loc[jnp.clip(md.num_seqs[0], 0, r_pad)]
+        live = tok < t_live
+        off = tok - base_idx[row]
+        in_nodes = active[row] & (off >= 1) & (off <= s) & live
+
+        depth_nodes = jnp.asarray(np.asarray(tree.depth[1:], np.int32))
+        off_n = jnp.clip(off - 1, 0, s - 1)
+        pos0 = md.positions
+        root_pos = pos0[jnp.clip(base_idx, 0, t - 1)]  # [R]
+        positions = jnp.where(
+            in_nodes, root_pos[row] + depth_nodes[off_n], pos0
+        )
+
+        # Pseudo-sequence split.
+        starts = ((tok == md.query_start_loc[row]) | in_nodes) & live
+        pid = jnp.cumsum(starts.astype(jnp.int32)) - 1  # [T]
+        n_pseudo = jnp.sum(starts.astype(jnp.int32))
+        idx = jnp.where(starts, pid, t)  # OOB rows dropped
+        cu = jnp.full((t + 1,), t_live, jnp.int32).at[idx].set(
+            tok, mode="drop"
+        )
+        rows_ps = jnp.zeros((t,), jnp.int32).at[idx].set(row, mode="drop")
+        kv_val = jnp.where(
+            active[row], root_pos[row] + 1, md.seq_lens[row]
+        )
+        kv_ps = jnp.zeros((t,), jnp.int32).at[idx].set(kv_val, mode="drop")
+        paged = dataclasses.replace(
+            md,
+            positions=jnp.where(in_nodes, root_pos[row], pos0),
+            block_tables=md.block_tables[rows_ps],
+            seq_lens=kv_ps,
+            query_start_loc=cu,
+            token_req_idx=jnp.clip(pid, 0, t - 1),
+            num_seqs=n_pseudo.reshape(1),
+            num_common_prefix_blocks=0,
+            state_slots=None,
+        )
+
+        node_mask = jnp.asarray(tree.ancestor_mask()[1:, 1:])  # [s, s]
+        tmask = jnp.where(
+            in_nodes[:, None], node_mask[off_n], False
+        )  # [T, s]
+        window_start = base_idx[row] + 1
+        return dataclasses.replace(
+            md, positions=positions, tree_mask=tmask,
+            tree_window_start=window_start, tree_paged=paged,
+        ), active
+
+    def _consolidate_tree_kv(
+        self, kv_cache, slot_mapping, base_idx, kv_src, num_out, active
+    ):
+        """Copy accepted nodes' KV rows to canonical slots.
+
+        An accepted node's cache rows are valid as-is (its K/V were
+        computed over exactly its ancestor chain); only their SLOTS are
+        window-ordered. The accepted path's depth-d node moves from slot
+        ``slot_mapping[base + kv_src[d-1]]`` to
+        ``slot_mapping[base + d]`` (same index when the tree degenerates
+        to a chain — the scatter is then a no-op write)."""
+        nl, nb, bs, rows, lanes = kv_cache.shape
+        depth = self.tree.num_levels
+        t = slot_mapping.shape[0]
+        d_arr = jnp.arange(depth, dtype=jnp.int32)[None, :]
+        src_slots = slot_mapping[
+            jnp.clip(base_idx[:, None] + kv_src, 0, t - 1)
+        ]  # [R, D]
+        dst_slots = slot_mapping[
+            jnp.clip(base_idx[:, None] + 1 + d_arr, 0, t - 1)
+        ]
+        valid = (d_arr < (num_out[:, None] - 1)) & active[:, None]
+        flat = kv_cache.reshape(nl * nb * bs, rows, lanes)
+        lidx = (
+            jnp.arange(nl, dtype=jnp.int32)[:, None, None] * (nb * bs)
+        )  # [L, 1, 1]
+        gathered = flat[lidx + src_slots[None]]  # [L, R, D, rows, lanes]
+        dst = jnp.where(
+            valid[None], lidx + dst_slots[None], nl * nb * bs
+        )
+        flat = flat.at[dst].set(gathered, mode="drop")
+        return flat.reshape(nl, nb, bs, rows, lanes)
+
     def _step(
         self,
         params,
@@ -450,6 +582,11 @@ class ModelRunner:
                 jnp.arange(r_pad), prev_tok
             ].add(needs_fb.astype(jnp.int32))
             sampling = _replace(sampling, output_token_counts=counts2)
+        tree_active = None
+        if num_spec > 0 and self.tree is not None:
+            md, tree_active = self._build_tree_metadata(
+                md, spec, t_pad, r_pad
+            )
         mm_kw = (
             {"mm_embeds": mm_embeds, "mm_mask": mm_mask}
             if mm_embeds is not None
@@ -477,6 +614,36 @@ class ModelRunner:
             spec_nan = (
                 jnp.isnan(logits3).sum() if self._nan_check else None
             )
+            if self.tree is not None:
+                from vllm_tpu.sample.tree_rejection import (
+                    tree_rejection_sample,
+                )
+
+                draft_full = jnp.concatenate(
+                    [jnp.zeros((r, 1), jnp.int32), spec["draft_ids"]],
+                    axis=1,
+                )
+                out_tokens, num_out, kv_src = tree_rejection_sample(
+                    logits3, draft_full, self.tree, sampling,
+                    active=tree_active,
+                    needs_penalties=needs_penalties,
+                    needs_top_k=needs_top_k,
+                    needs_top_p_min_p=needs_top_p_min_p,
+                    needs_gumbel=needs_gumbel,
+                )
+                kv_cache = self._consolidate_tree_kv(
+                    kv_cache, md.slot_mapping, spec["sample_pos"][:, 0],
+                    kv_src, num_out, tree_active,
+                )
+                anchor = jnp.clip(
+                    spec["sample_pos"][:, 0] + kv_src[:, -1],
+                    0, hidden.shape[0] - 1,
+                )
+                drafts = self.medusa.propose_tree(
+                    params["medusa"], hidden[anchor], self.tree
+                )
+                return (kv_cache, draft_kv, (out_tokens, num_out), None,
+                        drafts, None, spec_nan, None, moe_counts)
             out_tokens, num_out = rejection_sample(
                 logits3,
                 spec["draft_ids"],
@@ -631,7 +798,11 @@ class ModelRunner:
                 md.logits_indices, sampled, draft_next, r_pad,
             )
         elif self.medusa is not None:
-            drafts = self.medusa.propose(params["medusa"], last)
+            drafts = (
+                self.medusa.propose_tree(params["medusa"], last, self.tree)
+                if self.tree is not None
+                else self.medusa.propose(params["medusa"], last)
+            )
         if num_logprobs > 0:
             topk_vals, topk_ids = jax.lax.top_k(raw_logprobs, num_logprobs)
             sampled_lp = jnp.take_along_axis(
