@@ -193,6 +193,10 @@ class SchedulerConfig:
     # Tree spec verification: schedule a request's draft tokens
     # all-or-nothing (a budget-truncated tree is unverifiable).
     spec_all_or_nothing: bool = False
+    # Max draft tokens acceptable per step (tree DEPTH; 0 = all drafts).
+    # Keeps the reported acceptance-rate denominator honest: a 2x2 tree
+    # schedules 6 nodes but can accept at most 2.
+    spec_max_accept_per_step: int = 0
     max_model_len: int = 8192  # mirrored from ModelConfig at finalize
     # Lag-N pipelined scheduling (schedule step N+k before step N's tokens
     # reach the host); forced off when spec decode is on.
@@ -394,6 +398,7 @@ class EngineConfig:
             # count (= depth) is derived from the spec by the runner.
             self.speculative_config.num_speculative_tokens = tree.num_nodes
             sc.spec_all_or_nothing = True
+            sc.spec_max_accept_per_step = tree.num_levels
         if (
             self.speculative_config.enabled
             and self.speculative_config.method in ("eagle", "draft_model")

@@ -898,7 +898,14 @@ class Scheduler:
                     0, request.num_inflight_steps - 1
                 )
             if scheduled_spec:
-                self._spec_num_draft_tokens += len(scheduled_spec)
+                # Tree mode schedules num_nodes drafts but can accept at
+                # most tree-depth of them; cap the denominator so the
+                # acceptance rate stays comparable with chain mode.
+                cap = self.config.spec_max_accept_per_step
+                self._spec_num_draft_tokens += (
+                    min(len(scheduled_spec), cap) if cap
+                    else len(scheduled_spec)
+                )
                 self._spec_num_accepted_tokens += max(0, len(generated) - 1)
                 # Verification: len(generated) = accepted drafts + 1 bonus.
                 # Rejected draft positions hold garbage KV; roll computed

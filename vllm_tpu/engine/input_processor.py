@@ -36,6 +36,25 @@ class InputProcessor:
         self._tokenizer = tokenizer
         self._tokenizer_loaded = tokenizer is not None
         self._mm_info_cache: dict | None = None
+        self._encdec_info_cache: dict | None = None
+
+    def _encdec_info(self) -> dict | None:
+        """Encoder-decoder facts from the model class (None for decoder-
+        only models)."""
+        if self._encdec_info_cache is None:
+            from vllm_tpu.models.registry import get_model_class
+            from vllm_tpu.worker.worker import load_hf_config
+
+            hf_config = load_hf_config(self.config.model_config)
+            cls = get_model_class(hf_config)
+            if getattr(cls, "is_encoder_decoder", False):
+                self._encdec_info_cache = dict(
+                    decoder_start_token_id=hf_config.decoder_start_token_id,
+                    max_encoder_len=hf_config.max_position_embeddings,
+                )
+            else:
+                self._encdec_info_cache = {}
+        return self._encdec_info_cache or None
 
     def _mm_info(self) -> dict:
         """Placeholder-expansion facts from the model class (weights are
@@ -102,6 +121,24 @@ class InputProcessor:
             raise TypeError(f"invalid prompt type {type(prompt)}")
 
         mm_inputs = None
+        encdec = self._encdec_info()
+        if encdec is not None:
+            # Encoder-decoder model: the user's prompt is the ENCODER
+            # input; generation happens decoder-side from the start
+            # token. The encoder tokens ride the encoder-input plumbing
+            # (scheduled once, span = the first decoder position).
+            from vllm_tpu.multimodal import MMInput
+
+            if len(prompt_token_ids) > encdec["max_encoder_len"]:
+                raise ValueError(
+                    f"encoder input of {len(prompt_token_ids)} tokens "
+                    f"exceeds max_encoder_len={encdec['max_encoder_len']}"
+                )
+            mm_inputs = [MMInput(
+                offset=0, num_tokens=1,
+                encoder_token_ids=list(prompt_token_ids),
+            )]
+            prompt_token_ids = [encdec["decoder_start_token_id"]]
         mm_data = prompt.get("multi_modal_data") if isinstance(prompt, dict) else None
         if mm_data:
             from vllm_tpu.multimodal import expand_mm_prompt

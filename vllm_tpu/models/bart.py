@@ -1,0 +1,412 @@
+"""BART-class encoder-decoder with cross-attention KV state.
+
+Reference analog: ``vllm/model_executor/models/bart.py`` +
+``vllm/v1/core/single_type_kv_cache_manager.py:1069``
+(``CrossAttentionManager``) and ``kv_cache_interface.py:568``
+(``CrossAttentionSpec``). The reference allocates cross-attention KV in
+paged blocks sized by the encoder length; TPU-first the cross KV is a
+SLOT-ADDRESSED constant-size state (like the Mamba state slots): one
+``[L_dec, slots, S_enc_max, kv_rows, lanes]`` buffer, written ONCE per
+request when its encoder runs, read-only during decode. The engine
+plumbing rides the multimodal encoder machinery (the encoder input is
+the request's "image": scheduled once, freed with the request) and the
+hybrid-model state-slot machinery (``md.state_slots``).
+
+HF semantics (transformers ``modeling_bart.py``): post-LN residual
+blocks, learned positions with a +2 offset, ``layernorm_embedding``
+after (scaled) token+position embedding, GELU MLPs, biases everywhere,
+tied lm_head plus ``final_logits_bias``.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+from vllm_tpu.core.kv_cache_utils import FullAttentionSpec, KVCacheSpec
+from vllm_tpu.ops.attention import (
+    AttentionMetadata,
+    kv_cache_shape,
+    kv_dequant_scale,
+    packed_kv_layout,
+    paged_attention,
+    write_kv,
+)
+
+
+def _layer_norm(x, w, b, eps=1e-5):
+    xf = x.astype(jnp.float32)
+    mu = xf.mean(-1, keepdims=True)
+    var = ((xf - mu) ** 2).mean(-1, keepdims=True)
+    return (
+        (xf - mu) * jax.lax.rsqrt(var + eps) * w.astype(jnp.float32)
+        + b.astype(jnp.float32)
+    ).astype(x.dtype)
+
+
+class BartForConditionalGeneration:
+    """Encoder-decoder generation; the engine's "prompt" is the ENCODER
+    input, the decoder starts from ``decoder_start_token_id``."""
+
+    is_encoder_decoder = True
+    supports_lora = False
+    # Set by the worker before alloc_kv_cache (cross-KV slot count).
+    max_state_slots = 256
+
+    def __init__(self, hf_config: Any, dtype=jnp.bfloat16,
+                 quantization: str | None = None) -> None:
+        c = hf_config
+        self.hf_config = c
+        self.dtype = dtype
+        if quantization:
+            raise ValueError(
+                "quantization for encoder-decoder models is not wired yet"
+            )
+        self.hidden_size = c.d_model
+        self.vocab_size = c.vocab_size
+        self.enc_layers = c.encoder_layers
+        self.num_layers = c.decoder_layers  # loader/runner convention
+        self.enc_heads = c.encoder_attention_heads
+        self.num_heads = c.decoder_attention_heads
+        self.num_kv_heads = c.decoder_attention_heads  # no GQA in BART
+        self.head_dim = c.d_model // c.decoder_attention_heads
+        self.enc_ffn = c.encoder_ffn_dim
+        self.dec_ffn = c.decoder_ffn_dim
+        self.scale = self.head_dim ** -0.5
+        self.embed_scale = (
+            math.sqrt(c.d_model) if getattr(c, "scale_embedding", False)
+            else 1.0
+        )
+        self.max_position = c.max_position_embeddings
+        self.max_encoder_len = c.max_position_embeddings
+        self.decoder_start_token_id = c.decoder_start_token_id
+        self.pad_token_id = getattr(c, "pad_token_id", 0) or 0
+        self.sliding_window = None
+
+    # ------------------------------------------------------------------
+    # Params
+    # ------------------------------------------------------------------
+
+    def init_dummy_params(self, rng: jax.Array, dtype=None) -> dict:
+        dtype = dtype or self.dtype
+        D, V, Dh = self.hidden_size, self.vocab_size, self.head_dim
+        ks = iter(jax.random.split(rng, 64))
+
+        def init(shape, fan_in):
+            return (
+                jax.random.normal(next(ks), shape, jnp.float32)
+                / math.sqrt(fan_in)
+            ).astype(dtype)
+
+        def attn(le, h):
+            hd = h * Dh
+            return {
+                "wq": init((le, D, hd), D), "bq": jnp.zeros((le, hd), dtype),
+                "wk": init((le, D, hd), D), "bk": jnp.zeros((le, hd), dtype),
+                "wv": init((le, D, hd), D), "bv": jnp.zeros((le, hd), dtype),
+                "wo": init((le, hd, D), hd), "bo": jnp.zeros((le, D), dtype),
+            }
+
+        def ffn(le, f):
+            return {
+                "fc1": init((le, D, f), D), "b1": jnp.zeros((le, f), dtype),
+                "fc2": init((le, f, D), f), "b2": jnp.zeros((le, D), dtype),
+            }
+
+        def ln(le):
+            return jnp.ones((le, D), dtype), jnp.zeros((le, D), dtype)
+
+        Le, Ld = self.enc_layers, self.num_layers
+        enc = {**{f"s_{k}": v for k, v in attn(Le, self.enc_heads).items()},
+               **ffn(Le, self.enc_ffn)}
+        enc["ln1_w"], enc["ln1_b"] = ln(Le)
+        enc["ln2_w"], enc["ln2_b"] = ln(Le)
+        dec = {**{f"s_{k}": v for k, v in attn(Ld, self.num_heads).items()},
+               **{f"c_{k}": v for k, v in attn(Ld, self.num_heads).items()},
+               **ffn(Ld, self.dec_ffn)}
+        dec["ln1_w"], dec["ln1_b"] = ln(Ld)
+        dec["ln2_w"], dec["ln2_b"] = ln(Ld)
+        dec["ln3_w"], dec["ln3_b"] = ln(Ld)
+        return {
+            "embed": init((V, D), D),
+            "enc_pos": init((self.max_position + 2, D), D),
+            "dec_pos": init((self.max_position + 2, D), D),
+            "ln_emb_enc_w": jnp.ones((D,), dtype),
+            "ln_emb_enc_b": jnp.zeros((D,), dtype),
+            "ln_emb_dec_w": jnp.ones((D,), dtype),
+            "ln_emb_dec_b": jnp.zeros((D,), dtype),
+            "enc": enc,
+            "dec": dec,
+            "final_logits_bias": jnp.zeros((V,), jnp.float32),
+        }
+
+    def hf_weight_map(self) -> dict:
+        m = {
+            "model.shared.weight": ("embed", False),
+            "model.encoder.embed_positions.weight": ("enc_pos", False),
+            "model.decoder.embed_positions.weight": ("dec_pos", False),
+            "model.encoder.layernorm_embedding.weight": ("ln_emb_enc_w", False),
+            "model.encoder.layernorm_embedding.bias": ("ln_emb_enc_b", False),
+            "model.decoder.layernorm_embedding.weight": ("ln_emb_dec_w", False),
+            "model.decoder.layernorm_embedding.bias": ("ln_emb_dec_b", False),
+            "final_logits_bias": ("final_logits_bias", False),
+        }
+
+        def attn_map(hf_base, dest_base, i):
+            for hf_n, ours in (("q_proj", "q"), ("k_proj", "k"),
+                               ("v_proj", "v"), ("out_proj", "o")):
+                m[f"{hf_base}.{hf_n}.weight"] = (f"{dest_base}w{ours}.{i}", True)
+                m[f"{hf_base}.{hf_n}.bias"] = (f"{dest_base}b{ours}.{i}", False)
+
+        for i in range(self.enc_layers):
+            hf = f"model.encoder.layers.{i}"
+            attn_map(f"{hf}.self_attn", "enc.s_", i)
+            m[f"{hf}.self_attn_layer_norm.weight"] = (f"enc.ln1_w.{i}", False)
+            m[f"{hf}.self_attn_layer_norm.bias"] = (f"enc.ln1_b.{i}", False)
+            m[f"{hf}.fc1.weight"] = (f"enc.fc1.{i}", True)
+            m[f"{hf}.fc1.bias"] = (f"enc.b1.{i}", False)
+            m[f"{hf}.fc2.weight"] = (f"enc.fc2.{i}", True)
+            m[f"{hf}.fc2.bias"] = (f"enc.b2.{i}", False)
+            m[f"{hf}.final_layer_norm.weight"] = (f"enc.ln2_w.{i}", False)
+            m[f"{hf}.final_layer_norm.bias"] = (f"enc.ln2_b.{i}", False)
+        for i in range(self.num_layers):
+            hf = f"model.decoder.layers.{i}"
+            attn_map(f"{hf}.self_attn", "dec.s_", i)
+            attn_map(f"{hf}.encoder_attn", "dec.c_", i)
+            m[f"{hf}.self_attn_layer_norm.weight"] = (f"dec.ln1_w.{i}", False)
+            m[f"{hf}.self_attn_layer_norm.bias"] = (f"dec.ln1_b.{i}", False)
+            m[f"{hf}.encoder_attn_layer_norm.weight"] = (f"dec.ln2_w.{i}", False)
+            m[f"{hf}.encoder_attn_layer_norm.bias"] = (f"dec.ln2_b.{i}", False)
+            m[f"{hf}.fc1.weight"] = (f"dec.fc1.{i}", True)
+            m[f"{hf}.fc1.bias"] = (f"dec.b1.{i}", False)
+            m[f"{hf}.fc2.weight"] = (f"dec.fc2.{i}", True)
+            m[f"{hf}.fc2.bias"] = (f"dec.b2.{i}", False)
+            m[f"{hf}.final_layer_norm.weight"] = (f"dec.ln3_w.{i}", False)
+            m[f"{hf}.final_layer_norm.bias"] = (f"dec.ln3_b.{i}", False)
+        return m
+
+    def postprocess_weight(self, leaf_path: str, arr):
+        if leaf_path == "final_logits_bias":
+            return arr.reshape(-1)  # HF stores [1, V]
+        return arr
+
+    def load_params(self, path: str, dtype=None, shardings=None) -> dict:
+        from vllm_tpu.models.loader import load_safetensors_params
+
+        return load_safetensors_params(
+            self, path, dtype or self.dtype, shardings
+        )
+
+    # ------------------------------------------------------------------
+    # Encoder (runs ONCE per request, via the runner's encoder hook)
+    # ------------------------------------------------------------------
+
+    def encode_cross(
+        self, params: dict, enc_ids: jnp.ndarray, enc_len: jnp.ndarray
+    ) -> jnp.ndarray:
+        """Encoder forward + per-DECODER-layer cross K/V projection.
+
+        ``enc_ids`` is padded to ``max_encoder_len``; returns the cross
+        KV block ``[L_dec, S_max, kv_rows, lanes]`` ready to drop into
+        the request's cross-cache slot (padding rows are garbage — reads
+        are masked by the stored ``enc_len``)."""
+        s = enc_ids.shape[0]
+        D, H, Dh = self.hidden_size, self.enc_heads, self.head_dim
+        valid = jnp.arange(s) < enc_len  # [S]
+
+        x = params["embed"][enc_ids].astype(self.dtype) * self.embed_scale
+        x = x + params["enc_pos"][jnp.arange(s) + 2].astype(self.dtype)
+        x = _layer_norm(x, params["ln_emb_enc_w"], params["ln_emb_enc_b"])
+
+        def layer(x, lp):
+            h = x
+            q = (h @ lp["s_wq"] + lp["s_bq"]).reshape(s, H, Dh)
+            k = (h @ lp["s_wk"] + lp["s_bk"]).reshape(s, H, Dh)
+            v = (h @ lp["s_wv"] + lp["s_bv"]).reshape(s, H, Dh)
+            scores = jnp.einsum(
+                "qhd,khd->hqk", q.astype(jnp.float32),
+                k.astype(jnp.float32),
+            ) * self.scale
+            scores = jnp.where(valid[None, None, :], scores, -jnp.inf)
+            probs = jax.nn.softmax(scores, axis=-1)
+            probs = jnp.where(jnp.isnan(probs), 0.0, probs)
+            attn = jnp.einsum(
+                "hqk,khd->qhd", probs, v.astype(jnp.float32)
+            ).reshape(s, H * Dh).astype(self.dtype)
+            x = _layer_norm(
+                x + (attn @ lp["s_wo"] + lp["s_bo"]), lp["ln1_w"], lp["ln1_b"]
+            )
+            f = jax.nn.gelu(
+                (x @ lp["fc1"] + lp["b1"]).astype(jnp.float32), approximate=False
+            ).astype(self.dtype)
+            return _layer_norm(
+                x + (f @ lp["fc2"] + lp["b2"]), lp["ln2_w"], lp["ln2_b"]
+            ), None
+
+        x, _ = jax.lax.scan(lambda c, lp: layer(c, lp), x, params["enc"])
+
+        # Per-decoder-layer cross K/V, packed in the cache row layout.
+        KH = self.num_kv_heads
+        dec = params["dec"]
+        k_c = jnp.einsum("sd,lde->lse", x, dec["c_wk"]) + dec["c_bk"][:, None]
+        v_c = jnp.einsum("sd,lde->lse", x, dec["c_wv"]) + dec["c_bv"][:, None]
+        k_c = k_c.reshape(self.num_layers, s, KH, Dh)
+        v_c = v_c.reshape(self.num_layers, s, KH, Dh)
+        if packed_kv_layout(Dh):
+            return jnp.concatenate([k_c, v_c], axis=-1).astype(self.dtype)
+        return jnp.stack([k_c, v_c], axis=3).reshape(
+            self.num_layers, s, 2 * KH, Dh
+        ).astype(self.dtype)
+
+    # ------------------------------------------------------------------
+    # Decoder (the engine's per-step forward)
+    # ------------------------------------------------------------------
+
+    def apply(
+        self,
+        params: dict,
+        kv_cache: dict,  # {"paged", "cross", "cross_len"}
+        input_ids: jnp.ndarray,  # [T] decoder tokens
+        md: AttentionMetadata,
+        token_lora_slot: jnp.ndarray | None = None,  # unused
+    ) -> tuple[jnp.ndarray, dict]:
+        t = input_ids.shape[0]
+        D, H, KH, Dh = (
+            self.hidden_size, self.num_heads, self.num_kv_heads,
+            self.head_dim,
+        )
+        paged = kv_cache["paged"]
+        cross = kv_cache["cross"]  # [Ld, slots, S, rows, lanes]
+        cross_len = kv_cache["cross_len"]  # [slots]
+        assert md.state_slots is not None, "enc-dec model needs state slots"
+        tok_slot = md.state_slots[
+            jnp.clip(md.token_req_idx, 0, md.state_slots.shape[0] - 1)
+        ]  # [T]
+        s_max = cross.shape[2]
+        packed = packed_kv_layout(Dh)
+        kv_scale = kv_dequant_scale(paged)
+
+        x = params["embed"][input_ids].astype(self.dtype) * self.embed_scale
+        x = x + params["dec_pos"][
+            jnp.clip(md.positions + 2, 0, params["dec_pos"].shape[0] - 1)
+        ].astype(self.dtype)
+        x = _layer_norm(x, params["ln_emb_dec_w"], params["ln_emb_dec_b"])
+
+        tok_valid = (
+            jnp.arange(s_max)[None, :] < cross_len[tok_slot][:, None]
+        )  # [T, S]
+
+        def layer(carry, inp):
+            x, paged = carry
+            lp, li = inp
+            # Self-attention over the paged decoder cache.
+            q = (x @ lp["s_wq"] + lp["s_bq"]).reshape(t, H, Dh)
+            k = (x @ lp["s_wk"] + lp["s_bk"]).reshape(t, KH, Dh)
+            v = (x @ lp["s_wv"] + lp["s_bv"]).reshape(t, KH, Dh)
+            paged = write_kv(paged, li, k, v, md.slot_mapping)
+            attn = paged_attention(
+                q, paged, li, md, self.scale,
+                k_scale=kv_scale, v_scale=kv_scale,
+            ).reshape(t, H * Dh)
+            x = _layer_norm(
+                x + (attn @ lp["s_wo"] + lp["s_bo"]), lp["ln1_w"], lp["ln1_b"]
+            )
+            # Cross-attention over the request's encoder slot (read-only).
+            qc = (x @ lp["c_wq"] + lp["c_bq"]).reshape(t, H, Dh)
+            kv_rows = cross[li][tok_slot]  # [T, S, rows, lanes]
+            if packed:
+                k_c = kv_rows[..., :Dh]
+                v_c = kv_rows[..., Dh:]
+            else:
+                k_c = kv_rows[:, :, 0::2]
+                v_c = kv_rows[:, :, 1::2]
+            scores = jnp.einsum(
+                "thd,tshd->ths", qc.astype(jnp.float32),
+                k_c.astype(jnp.float32),
+            ) * self.scale
+            scores = jnp.where(tok_valid[:, None, :], scores, -jnp.inf)
+            probs = jax.nn.softmax(scores, axis=-1)
+            probs = jnp.where(jnp.isnan(probs), 0.0, probs)
+            attn_c = jnp.einsum(
+                "ths,tshd->thd", probs, v_c.astype(jnp.float32)
+            ).reshape(t, H * Dh).astype(self.dtype)
+            x = _layer_norm(
+                x + (attn_c @ lp["c_wo"] + lp["c_bo"]),
+                lp["ln2_w"], lp["ln2_b"],
+            )
+            f = jax.nn.gelu(
+                (x @ lp["fc1"] + lp["b1"]).astype(jnp.float32),
+                approximate=False,
+            ).astype(self.dtype)
+            x = _layer_norm(
+                x + (f @ lp["fc2"] + lp["b2"]), lp["ln3_w"], lp["ln3_b"]
+            )
+            return (x, paged), None
+
+        (x, paged), _ = jax.lax.scan(
+            layer, (x, paged),
+            (params["dec"], jnp.arange(self.num_layers, dtype=jnp.int32)),
+        )
+        return x, {"paged": paged, "cross": cross, "cross_len": cross_len}
+
+    def compute_logits(self, params: dict, hidden: jnp.ndarray) -> jnp.ndarray:
+        logits = hidden @ params["embed"].T.astype(hidden.dtype)
+        return logits.astype(jnp.float32) + params["final_logits_bias"]
+
+    # ------------------------------------------------------------------
+    # Runner contracts
+    # ------------------------------------------------------------------
+
+    def get_kv_cache_spec(self, block_size: int, dtype_bytes: int) -> dict[str, KVCacheSpec]:
+        spec = FullAttentionSpec(
+            block_size=block_size,
+            num_kv_heads=self.num_kv_heads,
+            head_size=self.head_dim,
+            dtype_bytes=dtype_bytes,
+        )
+        return {f"dec.{i}": spec for i in range(self.num_layers)}
+
+    def fixed_state_bytes(self, max_slots: int) -> int:
+        """Cross-KV budget: the slot buffer the paged-cache sizing must
+        leave room for (CrossAttentionSpec analog). Uses the buffer's
+        REAL element size (it is allocated in the model dtype)."""
+        elem = jnp.dtype(self.dtype).itemsize
+        rows_bytes = 2 * self.num_kv_heads * self.head_dim * elem
+        return (
+            self.num_layers * (max_slots + 1) * self.max_encoder_len
+            * rows_bytes
+        )
+
+    def alloc_kv_cache(self, num_blocks: int, block_size: int, dtype) -> dict:
+        s = self.max_state_slots + 1  # last slot = padding scratch
+        return {
+            "paged": jnp.zeros(
+                kv_cache_shape(
+                    self.num_layers, num_blocks, block_size,
+                    self.num_kv_heads, self.head_dim,
+                ),
+                dtype,
+            ),
+            "cross": jnp.zeros(
+                # Same row layout as the paged cache, with slots in place
+                # of blocks and the max encoder length as "block size".
+                kv_cache_shape(
+                    self.num_layers, s, self.max_encoder_len,
+                    self.num_kv_heads, self.head_dim,
+                ),
+                self.dtype,
+            ),
+            "cross_len": jnp.zeros((s,), jnp.int32),
+        }
+
+    def kv_cache_sharding(self, model_axis: str = "tp"):
+        from jax.sharding import PartitionSpec as P
+
+        return {
+            "paged": P(None, None, None, model_axis, None),
+            "cross": P(None, None, None, model_axis, None),
+            "cross_len": P(None),
+        }

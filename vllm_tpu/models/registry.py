@@ -35,6 +35,10 @@ _REGISTRY: dict[str, tuple[str, str]] = {
     "Olmo2ForCausalLM": ("vllm_tpu.models.olmo2", "Olmo2ForCausalLM"),
     "StableLmForCausalLM": ("vllm_tpu.models.stablelm", "StableLmForCausalLM"),
     "LlavaForConditionalGeneration": ("vllm_tpu.models.llava", "LlavaForConditionalGeneration"),
+    # (MBart is NOT aliased here: it needs per-language forced-BOS
+    # decoder prompts and its config may leave decoder_start_token_id
+    # unset — advertising it would serve wrong-language output.)
+    "BartForConditionalGeneration": ("vllm_tpu.models.bart", "BartForConditionalGeneration"),
 }
 
 

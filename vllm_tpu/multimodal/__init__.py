@@ -25,11 +25,15 @@ CLIP_STD = np.asarray([0.26862954, 0.26130258, 0.27577711], np.float32)
 
 @dataclass
 class MMInput:
-    """One placeholder span in the expanded prompt + its pixel data."""
+    """One placeholder span in the expanded prompt + its encoder data."""
 
     offset: int  # first placeholder position in the expanded prompt
     num_tokens: int  # number of placeholder positions (= encoder tokens)
     pixel_values: Any = field(repr=False, default=None)  # np [3, H, W] f32
+    # Encoder-decoder models: the request's encoder token ids (the span
+    # is then the single first decoder position, gating WHEN the encoder
+    # must have run, not an embedding overlay).
+    encoder_token_ids: Any = field(repr=False, default=None)
 
 
 def preprocess_image(

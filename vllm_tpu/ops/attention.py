@@ -147,6 +147,14 @@ def paged_attention(
 
     if md.tree_mask is not None:
         # Tree-verification step: ancestor-masked window + paged context.
+        # Single choke point for the no-sliding-window contract — the
+        # window floor is undefined for tree positions, so silently
+        # dropping the argument would compute full attention on windowed
+        # layers (runner init also rejects known windowed models early).
+        assert sliding_window is None, (
+            "tree spec verification does not support sliding-window "
+            "attention"
+        )
         return tree_verify_attention(
             q, kv_cache, layer, md, scale,
             soft_cap=soft_cap, k_scale=k_scale, v_scale=v_scale,

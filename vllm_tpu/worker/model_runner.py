@@ -296,9 +296,14 @@ class ModelRunner:
         # by VLLM_TPU_STEP_TIMING=1; read via .timing after a run.
         from vllm_tpu import envs
 
-        # Hybrid attention+SSM: stable per-request Mamba state slots
-        # (reference: HybridKVCacheCoordinator per-type groups).
-        self._is_hybrid = getattr(model, "is_hybrid_ssm", False)
+        # Per-request state slots: hybrid attention+SSM Mamba state
+        # (reference: HybridKVCacheCoordinator per-type groups) and
+        # encoder-decoder cross-attention KV (reference:
+        # CrossAttentionManager) share the slot lifecycle.
+        self.is_encdec = getattr(model, "is_encoder_decoder", False)
+        self._is_hybrid = (
+            getattr(model, "is_hybrid_ssm", False) or self.is_encdec
+        )
         self._state_slot_free = list(range(sched.max_num_seqs - 1, -1, -1))
         self._state_slot_of: dict[str, int] = {}
 
@@ -306,9 +311,22 @@ class ModelRunner:
         # (req_id, mm_input_index); budget enforced scheduler-side.
         self.is_mm = getattr(self.model, "is_multimodal", False)
         self._mm_cache: dict[tuple[str, int], jax.Array] = {}
-        self._encode_fn = (
-            jax.jit(self.model.encode_images) if self.is_mm else None
-        )
+        if self.is_mm:
+            self._encode_fn = jax.jit(self.model.encode_images)
+        elif self.is_encdec:
+            # Encoder forward + cross-KV projection, slot write donated
+            # in place (runs once per request, outside the step jit).
+            def _encode_and_store(kv_cache, params, enc_ids, enc_len, slot):
+                block = self.model.encode_cross(params, enc_ids, enc_len)
+                return {
+                    **kv_cache,
+                    "cross": kv_cache["cross"].at[:, slot].set(block),
+                    "cross_len": kv_cache["cross_len"].at[slot].set(enc_len),
+                }
+
+            self._encode_fn = jax.jit(_encode_and_store, donate_argnums=(0,))
+        else:
+            self._encode_fn = None
 
         self._timing_enabled = envs.VLLM_TPU_STEP_TIMING
         self._nan_check = envs.VLLM_TPU_NAN_CHECK
@@ -1015,6 +1033,22 @@ class ModelRunner:
             if state is None or not state.mm_inputs:
                 logger.error("encoder scheduled for unknown request %s", rid)
                 continue
+            if self.is_encdec:
+                # Encoder-decoder: run the encoder once and write the
+                # request's cross-KV slot (re-runs after preemption —
+                # the slot was released and resume restarts at 0).
+                enc = np.asarray(
+                    state.mm_inputs[0].encoder_token_ids, np.int32
+                )
+                s_max = self.model.max_encoder_len
+                padded = np.zeros(s_max, np.int32)
+                padded[: len(enc)] = enc[:s_max]
+                slot = self._state_slot_of[rid]
+                self.kv_cache = self._encode_fn(
+                    self.kv_cache, self.params, jnp.asarray(padded),
+                    jnp.int32(min(len(enc), s_max)), jnp.int32(slot),
+                )
+                continue
             for i in idxs:
                 pixels = jnp.asarray(state.mm_inputs[i].pixel_values)
                 self._mm_cache[(rid, i)] = self._encode_fn(
@@ -1601,7 +1635,7 @@ class ModelRunner:
         failed_loads: set[str] = set()
         if so.kv_connector_load:
             failed_loads = self._kv_connector_loads(so.kv_connector_load)
-        if self.is_mm:
+        if self.is_mm or self.is_encdec:
             self._run_encoders(so)
         (arrays, req_order, do_sample, flags,
          prompt_rows, mm_arrays) = self._prepare_inputs(so)

@@ -532,18 +532,27 @@ class Worker:
             if cache.enable_prefix_caching:
                 logger.info("prefix caching disabled for SSM model")
                 cache.enable_prefix_caching = False
-        if getattr(self.model, "is_hybrid_ssm", False):
-            # Hybrid attention+SSM (Jamba/Bamba-class): paged attention KV
-            # stays block-addressed, but the Mamba state is a per-request
-            # slot — prefix hits cannot restore it, so caching is off.
+        if getattr(self.model, "is_hybrid_ssm", False) or getattr(
+            self.model, "is_encoder_decoder", False
+        ):
+            # Per-request slot state: hybrid attention+SSM Mamba state
+            # (Jamba/Bamba-class) or encoder-decoder cross-attention KV
+            # (BART-class, reference: CrossAttentionManager). Paged KV
+            # stays block-addressed, but prefix hits cannot restore slot
+            # state, so caching is off; spec-decode verification would
+            # need slot-state rollback.
+            kind = (
+                "hybrid SSM" if getattr(self.model, "is_hybrid_ssm", False)
+                else "encoder-decoder"
+            )
             cache = self.config.cache_config
             if cache.enable_prefix_caching:
-                logger.info("prefix caching disabled for hybrid SSM model")
+                logger.info("prefix caching disabled for %s model", kind)
                 cache.enable_prefix_caching = False
             if self.config.speculative_config.enabled:
                 raise ValueError(
-                    "speculative decoding with hybrid SSM models is not "
-                    "supported yet (draft verification would need SSM "
+                    f"speculative decoding with {kind} models is not "
+                    "supported yet (verification would need per-request "
                     "state rollback)"
                 )
             self.model.max_state_slots = (
