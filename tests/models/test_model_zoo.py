@@ -448,3 +448,144 @@ def test_stablelm_hf_parity(tmp_path_factory):
         SamplingParams(temperature=0.0, max_tokens=8, ignore_eos=True),
     )[0].outputs[0].token_ids
     assert want and got[: len(want)] == want
+
+
+# ----------------------------------------------------------------------
+# GPT-classic families (round 4): flags + weight maps on the Llama graph
+# ----------------------------------------------------------------------
+
+
+def make_gpt2(tmp_path_factory):
+    import torch
+    from transformers import GPT2Config, GPT2LMHeadModel
+
+    torch.manual_seed(0)
+    cfg = GPT2Config(
+        vocab_size=128, n_embd=64, n_layer=2, n_head=4, n_positions=256,
+        n_inner=None, activation_function="gelu_new",
+    )
+    return _save(tmp_path_factory, "tiny_gpt2", GPT2LMHeadModel(cfg))
+
+
+def make_gpt_bigcode(tmp_path_factory):
+    import torch
+    from transformers import GPTBigCodeConfig, GPTBigCodeForCausalLM
+
+    torch.manual_seed(0)
+    cfg = GPTBigCodeConfig(
+        vocab_size=128, n_embd=64, n_layer=2, n_head=4, n_positions=256,
+        n_inner=128, activation_function="gelu_pytorch_tanh",
+        multi_query=True,
+    )
+    return _save(
+        tmp_path_factory, "tiny_bigcode", GPTBigCodeForCausalLM(cfg)
+    )
+
+
+def make_opt(tmp_path_factory):
+    import torch
+    from transformers import OPTConfig, OPTForCausalLM
+
+    torch.manual_seed(0)
+    cfg = OPTConfig(
+        vocab_size=128, hidden_size=64, num_hidden_layers=2,
+        num_attention_heads=4, ffn_dim=128, max_position_embeddings=256,
+        word_embed_proj_dim=64, do_layer_norm_before=True,
+        activation_function="relu",
+    )
+    return _save(tmp_path_factory, "tiny_opt", OPTForCausalLM(cfg))
+
+
+def make_gpt_neox(tmp_path_factory):
+    import torch
+    from transformers import GPTNeoXConfig, GPTNeoXForCausalLM
+
+    torch.manual_seed(0)
+    cfg = GPTNeoXConfig(
+        vocab_size=128, hidden_size=64, num_hidden_layers=2,
+        num_attention_heads=4, intermediate_size=128,
+        max_position_embeddings=256, rotary_pct=0.5,
+        use_parallel_residual=True, tie_word_embeddings=False,
+    )
+    return _save(tmp_path_factory, "tiny_neox", GPTNeoXForCausalLM(cfg))
+
+
+def make_falcon(tmp_path_factory):
+    import torch
+    from transformers import FalconConfig, FalconForCausalLM
+
+    torch.manual_seed(0)
+    cfg = FalconConfig(
+        vocab_size=128, hidden_size=64, num_hidden_layers=2,
+        num_attention_heads=4, multi_query=True, parallel_attn=True,
+        new_decoder_architecture=False, bias=False,
+        max_position_embeddings=256, alibi=False,
+    )
+    return _save(tmp_path_factory, "tiny_falcon", FalconForCausalLM(cfg))
+
+
+def make_phi(tmp_path_factory):
+    import torch
+    from transformers import PhiConfig, PhiForCausalLM
+
+    torch.manual_seed(0)
+    cfg = PhiConfig(
+        vocab_size=128, hidden_size=64, num_hidden_layers=2,
+        num_attention_heads=4, intermediate_size=128,
+        max_position_embeddings=256, partial_rotary_factor=0.5,
+        tie_word_embeddings=False,
+    )
+    return _save(tmp_path_factory, "tiny_phi", PhiForCausalLM(cfg))
+
+
+GPT_MAKERS = {
+    "gpt2": make_gpt2,
+    "gpt_bigcode": make_gpt_bigcode,
+    "opt": make_opt,
+    "gpt_neox": make_gpt_neox,
+    "falcon": make_falcon,
+    "phi": make_phi,
+}
+MAKERS.update(GPT_MAKERS)
+
+
+@pytest.mark.parametrize("name", list(GPT_MAKERS))
+def test_gpt_classic_prefill_logits_match_hf(name, tmp_path_factory):
+    path = GPT_MAKERS[name](tmp_path_factory)
+    rng = np.random.default_rng(0)
+    input_ids = rng.integers(10, 120, size=21).tolist()
+    expected = hf_logits(path, input_ids)
+    got = ours_logits(path, input_ids)
+    np.testing.assert_allclose(got, expected, rtol=4e-3, atol=4e-3)
+
+
+@pytest.mark.parametrize("name", list(GPT_MAKERS))
+def test_gpt_classic_greedy_e2e_matches_hf(name, tmp_path_factory):
+    import torch
+    from transformers import AutoModelForCausalLM
+
+    from vllm_tpu import LLM, SamplingParams
+
+    path = GPT_MAKERS[name](tmp_path_factory)
+    rng = np.random.default_rng(1)
+    prompt = rng.integers(10, 120, size=11).tolist()
+    n_steps = 8
+
+    hf = AutoModelForCausalLM.from_pretrained(path, torch_dtype=torch.float32)
+    hf.eval()
+    hf_tokens = list(prompt)
+    with torch.no_grad():
+        for _ in range(n_steps):
+            logits = hf(torch.tensor([hf_tokens])).logits[0, -1]
+            hf_tokens.append(int(logits.argmax()))
+
+    llm = LLM(
+        model=path, dtype="float32", max_model_len=128, block_size=16,
+        num_gpu_blocks_override=64, max_num_seqs=4,
+        max_num_batched_tokens=128,
+    )
+    outs = llm.generate(
+        [{"prompt_token_ids": prompt}],
+        SamplingParams(temperature=0.0, max_tokens=n_steps, ignore_eos=True),
+    )
+    assert outs[0].outputs[0].token_ids == hf_tokens[len(prompt):]

@@ -1,0 +1,422 @@
+"""GPT-classic decoder families on the flagged Llama graph.
+
+Reference analogs: ``vllm/model_executor/models/{gpt2,opt,gpt_neox,
+falcon,phi,gpt_bigcode}.py``. Each class is flags + a weight map (plus a
+fused-qkv split hook where the checkpoint fuses projections); the
+compute graph is ``llama.py``'s, extended with LayerNorm, plain
+(non-gated) MLPs, learned absolute positions, parallel residuals, and
+projection biases.
+
+Covered here:
+- GPT-2: learned positions, Conv1D fused c_attn, gelu_new, tied head.
+- OPT: learned positions with the +2 offset, ReLU, tied head.
+- GPT-NeoX (Pythia): partial rotary, per-head-interleaved fused qkv,
+  parallel residual, untied head.
+- Falcon (7B-class): multi-query attention, parallel residual with a
+  SINGLE shared layernorm, fused qkv, no biases, untied head.
+- Phi (phi-1/2): partial rotary, parallel residual with a single shared
+  layernorm, biases everywhere, lm_head bias.
+- GPT-BigCode (santacoder/starcoder): GPT-2 layout + multi-query
+  attention, gelu_pytorch_tanh.
+
+Not covered (documented gaps): GPT-J (interleaved rotate-every-two
+rope), MPT (ALiBi), remote-code-only families (InternLM2, ExaONE).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax.numpy as jnp
+
+from vllm_tpu.models.llama import LlamaForCausalLM
+
+
+def _ln_eps(c) -> float:
+    return getattr(
+        c, "layer_norm_epsilon", getattr(c, "layer_norm_eps", 1e-5)
+    )
+
+
+class _GPTLikeBase(LlamaForCausalLM):
+    """Shared flags of the GPT-classic families: LayerNorm, plain MLP,
+    ungated QUANT_KEYS; LoRA/quantized-embedding wiring not exercised."""
+
+    norm_type = "layer"
+    mlp_type = "plain"
+    supports_lora = False
+    supports_quantized_embedding = False
+    QUANT_KEYS = ("wq", "wk", "wv", "wo", "wup", "wdown")
+
+
+class GPT2LMHeadModel(_GPTLikeBase):
+    mlp_act = "gelu_new"
+    mlp_bias = True
+    attention_bias = True
+    attention_out_bias = True
+    position_embedding = "learned"
+    SPLIT_SUFFIXES = (".attn.c_attn.weight", ".attn.c_attn.bias")
+
+    def __init__(self, hf_config: Any, dtype=jnp.bfloat16,
+                 quantization: str | None = None) -> None:
+        c = hf_config
+        if getattr(c, "intermediate_size", None) is None:
+            c.intermediate_size = (
+                c.n_inner if getattr(c, "n_inner", None) else 4 * c.hidden_size
+            )
+        c.tie_word_embeddings = True
+        super().__init__(hf_config, dtype, quantization)
+        self.mlp_act = {
+            "gelu_new": "gelu_new", "gelu_pytorch_tanh": "gelu_new",
+            "gelu": "gelu", "relu": "relu",
+        }[getattr(c, "activation_function", "gelu_new")]
+        self.rms_eps = _ln_eps(c)
+
+    def split_hf_tensor(self, hf_name: str, arr):
+        # Conv1D fused c_attn: weight [D, (H+2KH)*Dh] (already [in, out]),
+        # bias [(H+2KH)*Dh]. Split along the LAST axis.
+        d_q = self.num_heads * self.head_dim
+        d_kv = self.num_kv_heads * self.head_dim
+        base = hf_name.rsplit("c_attn", 1)[0]
+        kind = hf_name.rsplit(".", 1)[1]  # weight | bias
+        return [
+            (f"{base}q.{kind}", arr[..., :d_q]),
+            (f"{base}k.{kind}", arr[..., d_q : d_q + d_kv]),
+            (f"{base}v.{kind}", arr[..., d_q + d_kv :]),
+        ]
+
+    def hf_weight_map(self) -> dict:
+        m = {
+            "transformer.wte.weight": ("embed", False),
+            "transformer.wpe.weight": ("pos_embed", False),
+            "transformer.ln_f.weight": ("final_norm", False),
+            "transformer.ln_f.bias": ("final_norm_b", False),
+        }
+        for i in range(self.num_layers):
+            hf = f"transformer.h.{i}"
+            b = f"layers"
+            m[f"{hf}.ln_1.weight"] = (f"{b}.input_norm.{i}", False)
+            m[f"{hf}.ln_1.bias"] = (f"{b}.input_norm_b.{i}", False)
+            # Synthetic names emitted by split_hf_tensor (Conv1D: no
+            # transpose — weights are stored [in, out]).
+            m[f"{hf}.attn.q.weight"] = (f"{b}.wq.{i}", False)
+            m[f"{hf}.attn.k.weight"] = (f"{b}.wk.{i}", False)
+            m[f"{hf}.attn.v.weight"] = (f"{b}.wv.{i}", False)
+            m[f"{hf}.attn.q.bias"] = (f"{b}.bq.{i}", False)
+            m[f"{hf}.attn.k.bias"] = (f"{b}.bk.{i}", False)
+            m[f"{hf}.attn.v.bias"] = (f"{b}.bv.{i}", False)
+            m[f"{hf}.attn.c_proj.weight"] = (f"{b}.wo.{i}", False)
+            m[f"{hf}.attn.c_proj.bias"] = (f"{b}.bo.{i}", False)
+            m[f"{hf}.ln_2.weight"] = (f"{b}.post_norm.{i}", False)
+            m[f"{hf}.ln_2.bias"] = (f"{b}.post_norm_b.{i}", False)
+            m[f"{hf}.mlp.c_fc.weight"] = (f"{b}.wup.{i}", False)
+            m[f"{hf}.mlp.c_fc.bias"] = (f"{b}.b_up.{i}", False)
+            m[f"{hf}.mlp.c_proj.weight"] = (f"{b}.wdown.{i}", False)
+            m[f"{hf}.mlp.c_proj.bias"] = (f"{b}.b_down.{i}", False)
+        return m
+
+
+class GPTBigCodeForCausalLM(GPT2LMHeadModel):
+    """Santacoder/Starcoder: GPT-2 layout + multi-query attention; HF
+    uses torch Linear (transposed storage), unlike GPT-2's Conv1D."""
+
+    def __init__(self, hf_config: Any, dtype=jnp.bfloat16,
+                 quantization: str | None = None) -> None:
+        c = hf_config
+        if not getattr(c, "multi_query", True):
+            raise ValueError(
+                "GPTBigCode with multi_query=False stores c_attn per-head "
+                "interleaved, which this importer does not unscramble"
+            )
+        c.num_key_value_heads = 1
+        super().__init__(c, dtype, quantization)
+
+    def split_hf_tensor(self, hf_name: str, arr):
+        # Linear fused c_attn: weight [(H+2KH)*Dh, D] (rows = outputs),
+        # bias [(H+2KH)*Dh]. Split along the FIRST axis; the map entries
+        # transpose the weights.
+        d_q = self.num_heads * self.head_dim
+        d_kv = self.num_kv_heads * self.head_dim
+        base = hf_name.rsplit("c_attn", 1)[0]
+        kind = hf_name.rsplit(".", 1)[1]
+        return [
+            (f"{base}q.{kind}", arr[:d_q]),
+            (f"{base}k.{kind}", arr[d_q : d_q + d_kv]),
+            (f"{base}v.{kind}", arr[d_q + d_kv :]),
+        ]
+
+    def hf_weight_map(self) -> dict:
+        m = super().hf_weight_map()
+        for i in range(self.num_layers):
+            hf = f"transformer.h.{i}"
+            # Linear storage: transpose weights (biases unchanged).
+            for ours in ("q", "k", "v"):
+                m[f"{hf}.attn.{ours}.weight"] = (f"layers.w{ours}.{i}", True)
+            m[f"{hf}.attn.c_proj.weight"] = (f"layers.wo.{i}", True)
+            m[f"{hf}.mlp.c_fc.weight"] = (f"layers.wup.{i}", True)
+            m[f"{hf}.mlp.c_proj.weight"] = (f"layers.wdown.{i}", True)
+        return m
+
+
+class OPTForCausalLM(_GPTLikeBase):
+    mlp_act = "relu"
+    mlp_bias = True
+    attention_bias = True
+    attention_out_bias = True
+    position_embedding = "learned"
+    learned_pos_offset = 2  # OPTLearnedPositionalEmbedding semantics
+
+    def __init__(self, hf_config: Any, dtype=jnp.bfloat16,
+                 quantization: str | None = None) -> None:
+        c = hf_config
+        c.intermediate_size = c.ffn_dim
+        if c.word_embed_proj_dim != c.hidden_size:
+            raise ValueError(
+                "OPT word_embed_proj_dim != hidden_size (project_in/out) "
+                "is not supported"
+            )
+        if not getattr(c, "do_layer_norm_before", True):
+            raise ValueError("OPT with do_layer_norm_before=False (350m) "
+                             "is not supported")
+        super().__init__(c, dtype, quantization)
+        self.mlp_act = {"relu": "relu", "gelu": "gelu"}[
+            getattr(c, "activation_function", "relu")
+        ]
+        self.rms_eps = _ln_eps(c)
+
+    def hf_weight_map(self) -> dict:
+        m = {
+            "model.decoder.embed_tokens.weight": ("embed", False),
+            "model.decoder.embed_positions.weight": ("pos_embed", False),
+            "model.decoder.final_layer_norm.weight": ("final_norm", False),
+            "model.decoder.final_layer_norm.bias": ("final_norm_b", False),
+        }
+        for i in range(self.num_layers):
+            hf = f"model.decoder.layers.{i}"
+            b = "layers"
+            for hf_n, ours in (("q_proj", "q"), ("k_proj", "k"),
+                               ("v_proj", "v"), ("out_proj", "o")):
+                m[f"{hf}.self_attn.{hf_n}.weight"] = (f"{b}.w{ours}.{i}", True)
+                m[f"{hf}.self_attn.{hf_n}.bias"] = (f"{b}.b{ours}.{i}", False)
+            m[f"{hf}.self_attn_layer_norm.weight"] = (f"{b}.input_norm.{i}", False)
+            m[f"{hf}.self_attn_layer_norm.bias"] = (f"{b}.input_norm_b.{i}", False)
+            m[f"{hf}.final_layer_norm.weight"] = (f"{b}.post_norm.{i}", False)
+            m[f"{hf}.final_layer_norm.bias"] = (f"{b}.post_norm_b.{i}", False)
+            m[f"{hf}.fc1.weight"] = (f"{b}.wup.{i}", True)
+            m[f"{hf}.fc1.bias"] = (f"{b}.b_up.{i}", False)
+            m[f"{hf}.fc2.weight"] = (f"{b}.wdown.{i}", True)
+            m[f"{hf}.fc2.bias"] = (f"{b}.b_down.{i}", False)
+        return m
+
+
+class GPTNeoXForCausalLM(_GPTLikeBase):
+    """Pythia/NeoX: partial rotary, parallel residual, fused qkv with
+    PER-HEAD interleaved (q, k, v) row groups."""
+
+    mlp_act = "gelu"
+    mlp_bias = True
+    attention_bias = True
+    attention_out_bias = True
+    SPLIT_SUFFIXES = (
+        ".attention.query_key_value.weight",
+        ".attention.query_key_value.bias",
+    )
+
+    def __init__(self, hf_config: Any, dtype=jnp.bfloat16,
+                 quantization: str | None = None) -> None:
+        c = hf_config
+        pct = getattr(c, "rotary_pct", 1.0)
+        if pct and pct < 1.0:
+            c.partial_rotary_factor = pct
+        c.rope_theta = getattr(c, "rotary_emb_base", 10000)
+        super().__init__(c, dtype, quantization)
+        self.attention_bias = getattr(c, "attention_bias", True)
+        self.parallel_residual = getattr(c, "use_parallel_residual", True)
+        self.mlp_act = {"gelu": "gelu", "gelu_new": "gelu_new",
+                        "relu": "relu"}[getattr(c, "hidden_act", "gelu")]
+        self.rms_eps = _ln_eps(c)
+
+    def split_hf_tensor(self, hf_name: str, arr):
+        import numpy as np
+
+        h, dh = self.num_heads, self.head_dim
+        base = hf_name.rsplit("query_key_value", 1)[0]
+        kind = hf_name.rsplit(".", 1)[1]
+        # [H*3*Dh, ...]: head-major, (q, k, v) within each head.
+        grouped = arr.reshape(h, 3, dh, *arr.shape[1:])
+        return [
+            (f"{base}q.{kind}", np.ascontiguousarray(
+                grouped[:, 0].reshape(h * dh, *arr.shape[1:]))),
+            (f"{base}k.{kind}", np.ascontiguousarray(
+                grouped[:, 1].reshape(h * dh, *arr.shape[1:]))),
+            (f"{base}v.{kind}", np.ascontiguousarray(
+                grouped[:, 2].reshape(h * dh, *arr.shape[1:]))),
+        ]
+
+    def hf_weight_map(self) -> dict:
+        m = {
+            "gpt_neox.embed_in.weight": ("embed", False),
+            "gpt_neox.final_layer_norm.weight": ("final_norm", False),
+            "gpt_neox.final_layer_norm.bias": ("final_norm_b", False),
+        }
+        if not self.tie_embeddings:
+            m["embed_out.weight"] = ("lm_head", True)
+        for i in range(self.num_layers):
+            hf = f"gpt_neox.layers.{i}"
+            b = "layers"
+            m[f"{hf}.input_layernorm.weight"] = (f"{b}.input_norm.{i}", False)
+            m[f"{hf}.input_layernorm.bias"] = (f"{b}.input_norm_b.{i}", False)
+            m[f"{hf}.post_attention_layernorm.weight"] = (f"{b}.post_norm.{i}", False)
+            m[f"{hf}.post_attention_layernorm.bias"] = (f"{b}.post_norm_b.{i}", False)
+            for ours in ("q", "k", "v"):
+                m[f"{hf}.attention.{ours}.weight"] = (f"{b}.w{ours}.{i}", True)
+                m[f"{hf}.attention.{ours}.bias"] = (f"{b}.b{ours}.{i}", False)
+            m[f"{hf}.attention.dense.weight"] = (f"{b}.wo.{i}", True)
+            m[f"{hf}.attention.dense.bias"] = (f"{b}.bo.{i}", False)
+            m[f"{hf}.mlp.dense_h_to_4h.weight"] = (f"{b}.wup.{i}", True)
+            m[f"{hf}.mlp.dense_h_to_4h.bias"] = (f"{b}.b_up.{i}", False)
+            m[f"{hf}.mlp.dense_4h_to_h.weight"] = (f"{b}.wdown.{i}", True)
+            m[f"{hf}.mlp.dense_4h_to_h.bias"] = (f"{b}.b_down.{i}", False)
+        return m
+
+
+class FalconForCausalLM(_GPTLikeBase):
+    """Falcon-7B-class: MQA, parallel residual reading ONE shared
+    layernorm (the split hook duplicates it onto both norm leaves)."""
+
+    mlp_act = "gelu"
+    SPLIT_SUFFIXES = (
+        ".self_attention.query_key_value.weight",
+        ".input_layernorm.weight",
+        ".input_layernorm.bias",
+    )
+
+    def __init__(self, hf_config: Any, dtype=jnp.bfloat16,
+                 quantization: str | None = None) -> None:
+        c = hf_config
+        if getattr(c, "new_decoder_architecture", False):
+            raise ValueError(
+                "Falcon new_decoder_architecture (40B/180B ln_attn+ln_mlp)"
+                " is not supported yet"
+            )
+        if not getattr(c, "parallel_attn", True):
+            raise ValueError("Falcon with parallel_attn=False is not "
+                             "supported")
+        if getattr(c, "alibi", False):
+            raise ValueError(
+                "Falcon with ALiBi position bias is not supported (the "
+                "graph would silently apply rope instead)"
+            )
+        if not getattr(c, "multi_query", True):
+            raise ValueError(
+                "Falcon with multi_query=False stores query_key_value "
+                "per-head interleaved, which this importer does not "
+                "unscramble"
+            )
+        if getattr(c, "bias", False):
+            raise ValueError(
+                "Falcon with bias=True is not supported (the weight map "
+                "carries no bias tensors)"
+            )
+        c.num_key_value_heads = 1
+        c.intermediate_size = getattr(c, "ffn_hidden_size", None) or (
+            4 * c.hidden_size
+        )
+        super().__init__(c, dtype, quantization)
+        self.parallel_residual = True
+        self.rms_eps = _ln_eps(c)
+
+    def split_hf_tensor(self, hf_name: str, arr):
+        if ".input_layernorm." in hf_name:
+            # One shared LN feeds BOTH the attention and the MLP in the
+            # parallel block: duplicate onto both norm leaves.
+            kind = hf_name.rsplit(".", 1)[1]
+            base = hf_name.rsplit("input_layernorm", 1)[0]
+            return [
+                (f"{base}ln_dup_a.{kind}", arr),
+                (f"{base}ln_dup_b.{kind}", arr),
+            ]
+        d_q = self.num_heads * self.head_dim
+        d_kv = self.num_kv_heads * self.head_dim
+        base = hf_name.rsplit("query_key_value", 1)[0]
+        kind = hf_name.rsplit(".", 1)[1]
+        return [
+            (f"{base}q.{kind}", arr[:d_q]),
+            (f"{base}k.{kind}", arr[d_q : d_q + d_kv]),
+            (f"{base}v.{kind}", arr[d_q + d_kv :]),
+        ]
+
+    def hf_weight_map(self) -> dict:
+        m = {
+            "transformer.word_embeddings.weight": ("embed", False),
+            "transformer.ln_f.weight": ("final_norm", False),
+            "transformer.ln_f.bias": ("final_norm_b", False),
+        }
+        if not self.tie_embeddings:
+            m["lm_head.weight"] = ("lm_head", True)
+        for i in range(self.num_layers):
+            hf = f"transformer.h.{i}"
+            b = "layers"
+            m[f"{hf}.ln_dup_a.weight"] = (f"{b}.input_norm.{i}", False)
+            m[f"{hf}.ln_dup_a.bias"] = (f"{b}.input_norm_b.{i}", False)
+            m[f"{hf}.ln_dup_b.weight"] = (f"{b}.post_norm.{i}", False)
+            m[f"{hf}.ln_dup_b.bias"] = (f"{b}.post_norm_b.{i}", False)
+            for ours in ("q", "k", "v"):
+                m[f"{hf}.self_attention.{ours}.weight"] = (f"{b}.w{ours}.{i}", True)
+            m[f"{hf}.self_attention.dense.weight"] = (f"{b}.wo.{i}", True)
+            m[f"{hf}.mlp.dense_h_to_4h.weight"] = (f"{b}.wup.{i}", True)
+            m[f"{hf}.mlp.dense_4h_to_h.weight"] = (f"{b}.wdown.{i}", True)
+        return m
+
+
+class PhiForCausalLM(_GPTLikeBase):
+    """Phi-1/2: partial rotary, parallel residual with one shared LN,
+    biases everywhere including the lm_head."""
+
+    mlp_act = "gelu_new"
+    mlp_bias = True
+    attention_bias = True
+    attention_out_bias = True
+    parallel_residual = True
+    lm_head_bias = True
+    SPLIT_SUFFIXES = (
+        ".input_layernorm.weight", ".input_layernorm.bias",
+    )
+
+    def __init__(self, hf_config: Any, dtype=jnp.bfloat16,
+                 quantization: str | None = None) -> None:
+        super().__init__(hf_config, dtype, quantization)
+        self.rms_eps = _ln_eps(hf_config)
+
+    def split_hf_tensor(self, hf_name: str, arr):
+        kind = hf_name.rsplit(".", 1)[1]
+        base = hf_name.rsplit("input_layernorm", 1)[0]
+        return [
+            (f"{base}ln_dup_a.{kind}", arr),
+            (f"{base}ln_dup_b.{kind}", arr),
+        ]
+
+    def hf_weight_map(self) -> dict:
+        m = {
+            "model.embed_tokens.weight": ("embed", False),
+            "model.final_layernorm.weight": ("final_norm", False),
+            "model.final_layernorm.bias": ("final_norm_b", False),
+            "lm_head.weight": ("lm_head", True),
+            "lm_head.bias": ("lm_head_b", False),
+        }
+        for i in range(self.num_layers):
+            hf = f"model.layers.{i}"
+            b = "layers"
+            m[f"{hf}.ln_dup_a.weight"] = (f"{b}.input_norm.{i}", False)
+            m[f"{hf}.ln_dup_a.bias"] = (f"{b}.input_norm_b.{i}", False)
+            m[f"{hf}.ln_dup_b.weight"] = (f"{b}.post_norm.{i}", False)
+            m[f"{hf}.ln_dup_b.bias"] = (f"{b}.post_norm_b.{i}", False)
+            for hf_n, ours in (("q_proj", "q"), ("k_proj", "k"),
+                               ("v_proj", "v"), ("dense", "o")):
+                m[f"{hf}.self_attn.{hf_n}.weight"] = (f"{b}.w{ours}.{i}", True)
+                m[f"{hf}.self_attn.{hf_n}.bias"] = (f"{b}.b{ours}.{i}", False)
+            m[f"{hf}.mlp.fc1.weight"] = (f"{b}.wup.{i}", True)
+            m[f"{hf}.mlp.fc1.bias"] = (f"{b}.b_up.{i}", False)
+            m[f"{hf}.mlp.fc2.weight"] = (f"{b}.wdown.{i}", True)
+            m[f"{hf}.mlp.fc2.bias"] = (f"{b}.b_down.{i}", False)
+        return m

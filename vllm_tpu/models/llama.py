@@ -89,12 +89,27 @@ class LlamaForCausalLM:
     # Norm flavor: "rms" (Llama) or "layer" (StableLM-class: classic
     # LayerNorm with bias leaves input_norm_b/post_norm_b/final_norm_b).
     norm_type = "rms"
+    # MLP flavor: "gated_silu" (Llama wgate/wup/wdown) or "plain"
+    # (GPT-class fc1/fc2 on the wup/wdown leaves, activation mlp_act).
+    mlp_type = "gated_silu"
+    mlp_act = "silu"  # "gelu" | "gelu_new" | "relu" for plain MLPs
+    mlp_bias = False  # b_up/b_down leaves (GPT-class)
+    attention_out_bias = False  # bo leaf on the output projection
+    # "rope" or "learned" (GPT-2/OPT-class absolute position table on
+    # the pos_embed leaf, looked up at positions + learned_pos_offset).
+    position_embedding = "rope"
+    learned_pos_offset = 0
+    # GPT-NeoX/Falcon parallel residual: x + attn(ln1(x)) + mlp(ln2(x))
+    # — the MLP reads a norm of the BLOCK INPUT, not of x + attn.
+    parallel_residual = False
     # Norm placement: True = pre-norm (Llama); False = post-sublayer
     # norms on the same weight leaves (OLMo-2).
     pre_norm = True
     # qk-norm over the full projected vector, pre-head-split (OLMo-2),
     # vs the per-head qk_norm flag (Qwen3).
     qk_norm_full = False
+    # Phi-class biased lm_head (lm_head_b leaf).
+    lm_head_bias = False
     # Granite-style scalar modulation hooks (all 1.0 = plain Llama).
     embedding_multiplier = 1.0
     residual_multiplier = 1.0
@@ -182,10 +197,16 @@ class LlamaForCausalLM:
             "wv": init_w(keys[2], (L, D, KH * Dh), D, "wv"),
             "wo": init_w(keys[3], (L, H * Dh, D), H * Dh, "wo"),
             "post_norm": jnp.ones((L, D), dtype),
-            "wgate": init_w(keys[4], (L, D, F), D, "wgate"),
             "wup": init_w(keys[5], (L, D, F), D, "wup"),
             "wdown": init_w(keys[6], (L, F, D), F, "wdown"),
         }
+        if self.mlp_type == "gated_silu":
+            layers["wgate"] = init_w(keys[4], (L, D, F), D, "wgate")
+        if self.mlp_bias:
+            layers["b_up"] = jnp.zeros((L, F), dtype)
+            layers["b_down"] = jnp.zeros((L, D), dtype)
+        if self.attention_out_bias:
+            layers["bo"] = jnp.zeros((L, D), dtype)
         if self.attention_bias:
             layers["bq"] = jnp.zeros((L, H * Dh), dtype)
             layers["bk"] = jnp.zeros((L, KH * Dh), dtype)
@@ -213,6 +234,11 @@ class LlamaForCausalLM:
             "layers": layers,
             "final_norm": jnp.ones((D,), dtype),
         }
+        if self.position_embedding == "learned":
+            params["pos_embed"] = init(
+                jax.random.fold_in(rng, 99),
+                (self.max_position + self.learned_pos_offset, D), D,
+            )
         if self.norm_type == "layer":
             params["final_norm_b"] = jnp.zeros((D,), dtype)
         if not self.tie_embeddings:
@@ -224,6 +250,8 @@ class LlamaForCausalLM:
                 w.delete()
             else:
                 params["lm_head"] = init(keys[8], (D, V), D)
+            if self.lm_head_bias:
+                params["lm_head_b"] = jnp.zeros((V,), dtype)
         return params
 
     # HF checkpoint name -> (our path, transpose, stack-axis layer index fn)
@@ -294,6 +322,13 @@ class LlamaForCausalLM:
         )  # [T, D]
         if self.embedding_multiplier != 1.0:
             x = x * self.embedding_multiplier
+        if self.position_embedding == "learned":
+            x = x + params["pos_embed"][
+                jnp.clip(
+                    md.positions + self.learned_pos_offset,
+                    0, params["pos_embed"].shape[0] - 1,
+                )
+            ].astype(self.dtype)
         if self.pp_size > 1:
             return self._apply_pp(params, kv_cache, x, md)
         layer_fn = self._make_layer_fn(
@@ -381,10 +416,11 @@ class LlamaForCausalLM:
                 q = rms_norm(q, lp["q_norm"], self.rms_eps)
                 k = rms_norm(k, lp["k_norm"], self.rms_eps)
 
-            cos = rope_cos[md.positions][:, None, :]
-            sin = rope_sin[md.positions][:, None, :]
-            q = _apply_rotate_half(q, cos, sin, self.rope.rotary_dim)
-            k = _apply_rotate_half(k, cos, sin, self.rope.rotary_dim)
+            if self.position_embedding == "rope":
+                cos = rope_cos[md.positions][:, None, :]
+                sin = rope_sin[md.positions][:, None, :]
+                q = _apply_rotate_half(q, cos, sin, self.rope.rotary_dim)
+                k = _apply_rotate_half(k, cos, sin, self.rope.rotary_dim)
 
             kv_scale = kv_dequant_scale(kv)
             if self.cp_size > 1:
@@ -404,17 +440,41 @@ class LlamaForCausalLM:
                     k_scale=kv_scale, v_scale=kv_scale,
                 )
             attn_out = proj(attn.reshape(t, H * Dh), lp, "wo")
+            if self.attention_out_bias:
+                attn_out = attn_out + lp["bo"]
             if not self.pre_norm:
                 attn_out = self._norm(attn_out, lp, "input_norm")
-            x = x + self.residual_multiplier * attn_out
+            if self.parallel_residual:
+                # NeoX/Falcon: the MLP reads a norm of the BLOCK input.
+                h2 = self._norm(x, lp, "post_norm")
+                x = x + self.residual_multiplier * attn_out
+            else:
+                x = x + self.residual_multiplier * attn_out
+                h2 = self._norm(x, lp, "post_norm") if self.pre_norm else x
 
-            h2 = self._norm(x, lp, "post_norm") if self.pre_norm else x
-            gate = proj(h2, lp, "wgate")
-            up = proj(h2, lp, "wup")
-            ffn_out = proj(
-                silu_and_mul(jnp.concatenate([gate, up], axis=-1)),
-                lp, "wdown",
-            )
+            if self.mlp_type == "gated_silu":
+                gate = proj(h2, lp, "wgate")
+                up = proj(h2, lp, "wup")
+                ffn_out = proj(
+                    silu_and_mul(jnp.concatenate([gate, up], axis=-1)),
+                    lp, "wdown",
+                )
+            else:
+                up = proj(h2, lp, "wup")
+                if self.mlp_bias:
+                    up = up + lp["b_up"]
+                act = {
+                    "gelu": lambda v: jax.nn.gelu(
+                        v.astype(jnp.float32), approximate=False
+                    ).astype(v.dtype),
+                    "gelu_new": lambda v: jax.nn.gelu(
+                        v.astype(jnp.float32), approximate=True
+                    ).astype(v.dtype),
+                    "relu": lambda v: jax.nn.relu(v),
+                }[self.mlp_act]
+                ffn_out = proj(act(up), lp, "wdown")
+                if self.mlp_bias:
+                    ffn_out = ffn_out + lp["b_down"]
             if not self.pre_norm:
                 ffn_out = self._norm(ffn_out, lp, "post_norm")
             x = x + self.residual_multiplier * ffn_out
@@ -550,6 +610,8 @@ class LlamaForCausalLM:
         else:
             logits = qmm(hidden, params["lm_head"])
         logits = logits.astype(jnp.float32)
+        if "lm_head_b" in params:  # Phi-class biased head
+            logits = logits + params["lm_head_b"].astype(jnp.float32)
         if self.logits_scaling != 1.0:
             logits = logits / self.logits_scaling  # Granite semantics
         return logits
@@ -591,10 +653,15 @@ class LlamaForCausalLM:
             "wv": P(None, None, tp),
             "wo": P(None, tp, None),
             "post_norm": P(None, None),
-            "wgate": P(None, None, tp),
             "wup": P(None, None, tp),
             "wdown": P(None, tp, None),
         }
+        if self.mlp_type == "gated_silu":
+            layers["wgate"] = P(None, None, tp)
+        if self.mlp_bias:
+            layers |= {"b_up": P(None, tp), "b_down": P(None, None)}
+        if self.attention_out_bias:
+            layers["bo"] = P(None, None)
         if self.attention_bias:
             layers |= {"bq": P(None, tp), "bk": P(None, tp), "bv": P(None, tp)}
         if self.qk_norm:
@@ -613,12 +680,16 @@ class LlamaForCausalLM:
             # Packed nibbles shard like the weight; group scale/zero
             # shard like (group axis replicated, output axis as weight).
             for k in self.QUANT_KEYS:
+                if k not in layers:
+                    continue
                 w = layers[k]
                 gs = P(w[0], None, w[-1])
                 layers[k] = Int4Linear(q=w, scale=gs, zero=gs)
         elif self.quantization:
             # Scale vectors shard like the weight's output axis.
             for k in self.QUANT_KEYS:
+                if k not in layers:
+                    continue
                 w = layers[k]
                 layers[k] = QuantizedLinear(q=w, scale=P(w[0], w[-1]))
         if self.pp_size > 1:
@@ -648,12 +719,16 @@ class LlamaForCausalLM:
         }
         if self.norm_type == "layer":
             out["final_norm_b"] = P(None)
+        if self.position_embedding == "learned":
+            out["pos_embed"] = P(None, None)
         if not self.tie_embeddings:
             out["lm_head"] = (
                 QuantizedLinear(q=P(None, tp), scale=P(tp))
                 if q_extra
                 else P(None, tp)
             )
+            if self.lm_head_bias:
+                out["lm_head_b"] = P(tp)
         return out
 
     def kv_cache_sharding(self, model_axis: str = "tp") -> P:

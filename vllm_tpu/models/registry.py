@@ -35,6 +35,12 @@ _REGISTRY: dict[str, tuple[str, str]] = {
     "Olmo2ForCausalLM": ("vllm_tpu.models.olmo2", "Olmo2ForCausalLM"),
     "StableLmForCausalLM": ("vllm_tpu.models.stablelm", "StableLmForCausalLM"),
     "LlavaForConditionalGeneration": ("vllm_tpu.models.llava", "LlavaForConditionalGeneration"),
+    "GPT2LMHeadModel": ("vllm_tpu.models.gpt_like", "GPT2LMHeadModel"),
+    "GPTBigCodeForCausalLM": ("vllm_tpu.models.gpt_like", "GPTBigCodeForCausalLM"),
+    "OPTForCausalLM": ("vllm_tpu.models.gpt_like", "OPTForCausalLM"),
+    "GPTNeoXForCausalLM": ("vllm_tpu.models.gpt_like", "GPTNeoXForCausalLM"),
+    "FalconForCausalLM": ("vllm_tpu.models.gpt_like", "FalconForCausalLM"),
+    "PhiForCausalLM": ("vllm_tpu.models.gpt_like", "PhiForCausalLM"),
     # (MBart is NOT aliased here: it needs per-language forced-BOS
     # decoder prompts and its config may leave decoder_start_token_id
     # unset — advertising it would serve wrong-language output.)
