@@ -48,6 +48,13 @@ class _GPTLikeBase(LlamaForCausalLM):
     supports_quantized_embedding = False
     QUANT_KEYS = ("wq", "wk", "wv", "wo", "wup", "wdown")
 
+    def __init__(self, hf_config: Any, dtype=jnp.bfloat16,
+                 quantization: str | None = None) -> None:
+        super().__init__(hf_config, dtype, quantization)
+        # LayerNorm families keep their eps under layer_norm_epsilon /
+        # layer_norm_eps, not rms_norm_eps.
+        self.rms_eps = _ln_eps(hf_config)
+
 
 class GPT2LMHeadModel(_GPTLikeBase):
     mlp_act = "gelu_new"
@@ -70,7 +77,6 @@ class GPT2LMHeadModel(_GPTLikeBase):
             "gelu_new": "gelu_new", "gelu_pytorch_tanh": "gelu_new",
             "gelu": "gelu", "relu": "relu",
         }[getattr(c, "activation_function", "gelu_new")]
-        self.rms_eps = _ln_eps(c)
 
     def split_hf_tensor(self, hf_name: str, arr):
         # Conv1D fused c_attn: weight [D, (H+2KH)*Dh] (already [in, out]),
@@ -182,7 +188,6 @@ class OPTForCausalLM(_GPTLikeBase):
         self.mlp_act = {"relu": "relu", "gelu": "gelu"}[
             getattr(c, "activation_function", "relu")
         ]
-        self.rms_eps = _ln_eps(c)
 
     def hf_weight_map(self) -> dict:
         m = {
@@ -191,6 +196,8 @@ class OPTForCausalLM(_GPTLikeBase):
             "model.decoder.final_layer_norm.weight": ("final_norm", False),
             "model.decoder.final_layer_norm.bias": ("final_norm_b", False),
         }
+        if not self.tie_embeddings:
+            m["lm_head.weight"] = ("lm_head", True)
         for i in range(self.num_layers):
             hf = f"model.decoder.layers.{i}"
             b = "layers"
@@ -234,7 +241,6 @@ class GPTNeoXForCausalLM(_GPTLikeBase):
         self.parallel_residual = getattr(c, "use_parallel_residual", True)
         self.mlp_act = {"gelu": "gelu", "gelu_new": "gelu_new",
                         "relu": "relu"}[getattr(c, "hidden_act", "gelu")]
-        self.rms_eps = _ln_eps(c)
 
     def split_hf_tensor(self, hf_name: str, arr):
         import numpy as np
@@ -324,7 +330,6 @@ class FalconForCausalLM(_GPTLikeBase):
         )
         super().__init__(c, dtype, quantization)
         self.parallel_residual = True
-        self.rms_eps = _ln_eps(c)
 
     def split_hf_tensor(self, hf_name: str, arr):
         if ".input_layernorm." in hf_name:
@@ -382,11 +387,6 @@ class PhiForCausalLM(_GPTLikeBase):
     SPLIT_SUFFIXES = (
         ".input_layernorm.weight", ".input_layernorm.bias",
     )
-
-    def __init__(self, hf_config: Any, dtype=jnp.bfloat16,
-                 quantization: str | None = None) -> None:
-        super().__init__(hf_config, dtype, quantization)
-        self.rms_eps = _ln_eps(hf_config)
 
     def split_hf_tensor(self, hf_name: str, arr):
         kind = hf_name.rsplit(".", 1)[1]

@@ -1,0 +1,302 @@
+"""Mamba1 (original selective-state-space decoder, mamba-130m..2.8b).
+
+Reference analog: ``vllm/model_executor/models/mamba.py`` +
+``vllm/v1/attention/backends/mamba1_attn.py`` and the CUDA
+``selective_scan_fwd`` kernel. HF semantics
+(``transformers/models/mamba/modeling_mamba.py`` slow path) are matched
+exactly; the recurrence runs as one segment-aware associative scan with
+PER-(channel, state) decay (``ops/mamba.ragged_mamba1_scan`` — Mamba2's
+scalar-per-head A is the special case that unlocks its matmul form).
+
+State cache contract is Mamba2's: constant-size per-request slots
+(``{"conv": [L, NB, I, K-1], "ssm": [L, NB, I, N]}``), slot = the
+request's single MambaSpec block, prefix caching off.
+
+Param tree::
+
+    embed        [V, D]
+    layers/      every leaf stacked [L, ...]
+      norm       [L, D]
+      in_proj    [L, D, 2I]      (x | gate)
+      conv_w     [L, I, K]       conv_b [L, I]
+      x_proj     [L, I, R+2N]    (dt_low | B | C)
+      dt_w       [L, R, I]       dt_b [L, I]
+      a_log      [L, I, N]       d_skip [L, I]
+      out_proj   [L, I, D]
+    final_norm   [D]             (lm_head = embed.T when tied)
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from vllm_tpu.core.kv_cache_utils import KVCacheSpec, MambaSpec
+from vllm_tpu.layers.layernorm import rms_norm
+from vllm_tpu.logger import init_logger
+from vllm_tpu.ops.attention import AttentionMetadata
+from vllm_tpu.ops.mamba import ragged_causal_conv, ragged_mamba1_scan
+
+logger = init_logger(__name__)
+
+
+class MambaForCausalLM:
+    supports_lora = False
+    enable_lora = False
+    is_stateful_ssm = True
+
+    def __init__(self, hf_config: Any, dtype=jnp.bfloat16,
+                 quantization: str | None = None) -> None:
+        if quantization:
+            logger.warning(
+                "weight quantization is not yet supported for SSM models; "
+                "running %s unquantized", type(self).__name__,
+            )
+        c = hf_config
+        self.hf_config = c
+        self.dtype = dtype
+        self.quantization = None
+        self.num_layers = c.num_hidden_layers
+        self.hidden_size = c.hidden_size
+        self.vocab_size = c.vocab_size
+        self.rms_eps = getattr(c, "layer_norm_epsilon", 1e-5)
+        self.tie_embeddings = getattr(c, "tie_word_embeddings", True)
+
+        self.state_size = c.state_size  # N
+        self.conv_kernel = c.conv_kernel  # K
+        self.intermediate = int(
+            getattr(c, "intermediate_size", None)
+            or getattr(c, "expand", 2) * c.hidden_size
+        )
+        tr = getattr(c, "time_step_rank", "auto")
+        self.dt_rank = (
+            math.ceil(c.hidden_size / 16) if tr == "auto" else int(tr)
+        )
+        self.use_conv_bias = getattr(c, "use_conv_bias", True)
+        self.use_bias = getattr(c, "use_bias", False)
+        if self.use_bias:
+            raise ValueError(
+                "Mamba1 with use_bias=True (in/out projection biases) is "
+                "not wired yet"
+            )
+        # Runner protocol fillers (cache is the SSM state).
+        self.num_heads = 1
+        self.head_dim = self.intermediate
+        self.num_kv_heads = 1
+
+    # ------------------------------------------------------------------
+    # Params
+    # ------------------------------------------------------------------
+
+    def init_dummy_params(self, rng: jax.Array, dtype=None) -> dict:
+        dtype = dtype or self.dtype
+        L, D, I, N, R = (
+            self.num_layers, self.hidden_size, self.intermediate,
+            self.state_size, self.dt_rank,
+        )
+        keys = jax.random.split(rng, 8)
+
+        def init(key, shape, fan_in):
+            return (
+                jax.random.normal(key, shape, jnp.float32)
+                / math.sqrt(fan_in)
+            ).astype(dtype)
+
+        layers = {
+            "norm": jnp.ones((L, D), dtype),
+            "in_proj": init(keys[0], (L, D, 2 * I), D),
+            "conv_w": init(keys[1], (L, I, self.conv_kernel), self.conv_kernel),
+            "x_proj": init(keys[2], (L, I, R + 2 * N), I),
+            "dt_w": init(keys[3], (L, R, I), R),
+            "dt_b": jnp.ones((L, I), dtype),
+            "a_log": jnp.log(
+                jnp.broadcast_to(
+                    jnp.arange(1, N + 1, dtype=jnp.float32), (L, I, N)
+                )
+            ).astype(jnp.float32),
+            "d_skip": jnp.ones((L, I), dtype),
+            "out_proj": init(keys[4], (L, I, D), I),
+        }
+        if self.use_conv_bias:
+            layers["conv_b"] = jnp.zeros((L, I), dtype)
+        params = {
+            "embed": init(keys[5], (self.vocab_size, D), D),
+            "layers": layers,
+            "final_norm": jnp.ones((D,), dtype),
+        }
+        if not self.tie_embeddings:
+            params["lm_head"] = init(keys[6], (D, self.vocab_size), D)
+        return params
+
+    def hf_weight_map(self) -> dict:
+        m = {
+            "backbone.embeddings.weight": ("embed", False),
+            "backbone.norm_f.weight": ("final_norm", False),
+        }
+        if not self.tie_embeddings:
+            m["lm_head.weight"] = ("lm_head", True)
+        per_layer = {
+            "norm.weight": ("norm", False),
+            "mixer.in_proj.weight": ("in_proj", True),
+            "mixer.conv1d.weight": ("conv_w", False),  # [I,1,K] squeezed
+            "mixer.x_proj.weight": ("x_proj", True),
+            "mixer.dt_proj.weight": ("dt_w", True),
+            "mixer.dt_proj.bias": ("dt_b", False),
+            "mixer.A_log": ("a_log", False),
+            "mixer.D": ("d_skip", False),
+            "mixer.out_proj.weight": ("out_proj", True),
+        }
+        if self.use_conv_bias:
+            per_layer["mixer.conv1d.bias"] = ("conv_b", False)
+        for i in range(self.num_layers):
+            for hf_name, (ours, tr) in per_layer.items():
+                m[f"backbone.layers.{i}.{hf_name}"] = (f"layers.{ours}.{i}", tr)
+        return m
+
+    def postprocess_weight(self, leaf_path: str, arr):
+        import numpy as np
+
+        if leaf_path == "layers.conv_w":
+            return arr.squeeze(2)  # [L, I, 1, K] -> [L, I, K]
+        if leaf_path == "layers.a_log":
+            return arr.astype(np.float32)
+        return arr
+
+    def load_params(self, path: str, dtype=None, shardings: Any | None = None) -> dict:
+        from vllm_tpu.models.loader import load_safetensors_params
+
+        return load_safetensors_params(self, path, dtype or self.dtype, shardings)
+
+    # ------------------------------------------------------------------
+    # Forward
+    # ------------------------------------------------------------------
+
+    def apply(
+        self,
+        params: dict,
+        kv_cache: dict,  # {"conv": [L,NB,I,K-1], "ssm": [L,NB,I,N]}
+        input_ids: jnp.ndarray,  # [T]
+        md: AttentionMetadata,
+        token_lora_slot: jnp.ndarray | None = None,  # unused
+    ) -> tuple[jnp.ndarray, dict]:
+        x = params["embed"][input_ids].astype(self.dtype)
+        t = x.shape[0]
+        I, N, R = self.intermediate, self.state_size, self.dt_rank
+
+        slots = md.block_tables[:, 0]  # [R] single MambaSpec block
+        first_pos = md.positions[jnp.clip(md.query_start_loc[:-1], 0, t - 1)]
+        fresh = first_pos == 0  # [R]
+
+        def layer_fn(carry, inputs):
+            x, conv_c, ssm_c = carry
+            lp, li = inputs
+            h = rms_norm(x, lp["norm"], self.rms_eps)
+            proj = h @ lp["in_proj"]  # [T, 2I]
+            xs = proj[:, :I]
+            gate = proj[:, I:]
+
+            conv_seed = jnp.where(
+                fresh[:, None, None], 0.0, conv_c[li, slots]
+            )
+            x_conv, new_conv = ragged_causal_conv(
+                xs, conv_seed, lp["conv_w"], lp.get("conv_b"),
+                md.token_req_idx, md.query_start_loc,
+            )
+            x_conv = jax.nn.silu(x_conv.astype(jnp.float32))
+
+            ssm_in = x_conv.astype(self.dtype) @ lp["x_proj"]  # [T, R+2N]
+            dt_low = ssm_in[:, :R]
+            b = ssm_in[:, R : R + N].astype(jnp.float32)
+            c = ssm_in[:, R + N :].astype(jnp.float32)
+            dt = jax.nn.softplus(
+                (dt_low @ lp["dt_w"]).astype(jnp.float32)
+                + lp["dt_b"].astype(jnp.float32)
+            )  # [T, I]
+
+            ssm_seed = jnp.where(
+                fresh[:, None, None], 0.0, ssm_c[li, slots]
+            )
+            y, new_ssm = ragged_mamba1_scan(
+                x_conv, dt, lp["a_log"], b, c, ssm_seed,
+                md.token_req_idx, md.query_start_loc,
+            )
+            y = y + lp["d_skip"].astype(jnp.float32)[None, :] * x_conv
+            y = y * jax.nn.silu(gate.astype(jnp.float32))
+
+            x = x + y.astype(self.dtype) @ lp["out_proj"]
+            conv_c = conv_c.at[li, slots].set(new_conv)
+            ssm_c = ssm_c.at[li, slots].set(new_ssm)
+            return (x, conv_c, ssm_c), None
+
+        (x, conv_c, ssm_c), _ = jax.lax.scan(
+            layer_fn,
+            (x, kv_cache["conv"], kv_cache["ssm"]),
+            (params["layers"], jnp.arange(self.num_layers, dtype=jnp.int32)),
+        )
+        x = rms_norm(x, params["final_norm"], self.rms_eps)
+        return x, {"conv": conv_c, "ssm": ssm_c}
+
+    def compute_logits(self, params: dict, hidden: jnp.ndarray) -> jnp.ndarray:
+        head = params["embed"].T if self.tie_embeddings else params["lm_head"]
+        return (hidden @ head.astype(hidden.dtype)).astype(jnp.float32)
+
+    # ------------------------------------------------------------------
+    # Runner contracts
+    # ------------------------------------------------------------------
+
+    def _state_elems_per_layer(self) -> int:
+        return (
+            self.intermediate * (self.conv_kernel - 1)
+            + self.intermediate * self.state_size
+        )
+
+    def get_kv_cache_spec(self, block_size: int, dtype_bytes: int) -> dict[str, KVCacheSpec]:
+        spec = MambaSpec(
+            block_size=block_size,
+            num_kv_heads=1,
+            head_size=self.intermediate,
+            dtype_bytes=4,
+            state_shape=(self._state_elems_per_layer(),),
+        )
+        return {f"layers.{i}": spec for i in range(self.num_layers)}
+
+    def alloc_kv_cache(self, num_blocks: int, block_size: int, dtype) -> dict:
+        L, K = self.num_layers, self.conv_kernel
+        return {
+            "conv": jnp.zeros(
+                (L, num_blocks, self.intermediate, K - 1), jnp.float32
+            ),
+            "ssm": jnp.zeros(
+                (L, num_blocks, self.intermediate, self.state_size),
+                jnp.float32,
+            ),
+        }
+
+    def param_shardings(self, data_axis: str | None = None, model_axis: str = "tp") -> dict:
+        layers = {
+            k: P(*([None] * 3))
+            for k in ("in_proj", "conv_w", "x_proj", "dt_w", "a_log",
+                      "out_proj")
+        }
+        for k in ("norm", "dt_b", "d_skip"):
+            layers[k] = P(None, None)
+        if self.use_conv_bias:
+            layers["conv_b"] = P(None, None)
+        out = {
+            "embed": P(None, None),
+            "layers": layers,
+            "final_norm": P(None),
+        }
+        if not self.tie_embeddings:
+            out["lm_head"] = P(None, None)
+        return out
+
+    def kv_cache_sharding(self, model_axis: str = "tp") -> dict:
+        return {
+            "conv": P(None, None, None, None),
+            "ssm": P(None, None, None, None),
+        }

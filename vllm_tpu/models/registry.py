@@ -29,7 +29,9 @@ _REGISTRY: dict[str, tuple[str, str]] = {
     "DeepseekV2ForCausalLM": ("vllm_tpu.models.deepseek", "DeepseekV2ForCausalLM"),
     "DeepseekV3ForCausalLM": ("vllm_tpu.models.deepseek", "DeepseekV3ForCausalLM"),
     "Mamba2ForCausalLM": ("vllm_tpu.models.mamba2", "Mamba2ForCausalLM"),
+    "MambaForCausalLM": ("vllm_tpu.models.mamba1", "MambaForCausalLM"),
     "BambaForCausalLM": ("vllm_tpu.models.bamba", "BambaForCausalLM"),
+    "JambaForCausalLM": ("vllm_tpu.models.jamba", "JambaForCausalLM"),
     "Phi3ForCausalLM": ("vllm_tpu.models.phi3", "Phi3ForCausalLM"),
     "GraniteForCausalLM": ("vllm_tpu.models.granite", "GraniteForCausalLM"),
     "Olmo2ForCausalLM": ("vllm_tpu.models.olmo2", "Olmo2ForCausalLM"),

@@ -76,6 +76,52 @@ def ragged_causal_conv(
     return y.astype(x.dtype), new_state.astype(conv_state.dtype)
 
 
+def ragged_mamba1_scan(
+    x: jnp.ndarray,  # [T, I] conv-activated inputs
+    dt: jnp.ndarray,  # [T, I] softplus-ed step sizes
+    a_log: jnp.ndarray,  # [I, N] A_log parameter (A = -exp(A_log))
+    b: jnp.ndarray,  # [T, N] input gate (shared across channels)
+    c: jnp.ndarray,  # [T, N] output gate
+    h0: jnp.ndarray,  # [R, I, N] cached state per request (seeded)
+    token_req_idx: jnp.ndarray,  # [T]
+    query_start_loc: jnp.ndarray,  # [R+1]
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Mamba1 selective scan: identical first-order linear recurrence to
+    the SSD scan, but the decay is PER-(channel, state) —
+    ``dA[t, i, n] = exp(dt[t, i] * A[i, n])`` (Mamba2 collapses A to a
+    scalar per head, which is what unlocks its matmul formulation).
+    Reference analog: ``csrc/mamba/mamba_ssm/selective_scan_fwd.cu``.
+
+    Returns (y [T, I], new_state [R, I, N])."""
+    t = x.shape[0]
+    xf = x.astype(jnp.float32)
+    dtf = dt.astype(jnp.float32)
+    af = -jnp.exp(a_log.astype(jnp.float32))  # [I, N], negative
+    decay = jnp.exp(dtf[..., None] * af[None])  # [T, I, N]
+
+    u = (
+        (dtf * xf)[..., None] * b.astype(jnp.float32)[:, None, :]
+    )  # [T, I, N] = dt*x (outer) B
+
+    ts = jnp.arange(t, dtype=jnp.int32)
+    is_first = ts == query_start_loc[token_req_idx]
+    h0_tok = h0[token_req_idx]  # [T, I, N]
+    u = u + jnp.where(is_first[:, None, None], decay * h0_tok, 0.0)
+    decay = jnp.where(is_first[:, None, None], 0.0, decay)
+
+    def combine(left, right):
+        a1, u1 = left
+        a2, u2 = right
+        return a1 * a2, a2 * u1 + u2
+
+    _, h_all = jax.lax.associative_scan(combine, (decay, u), axis=0)
+    y = jnp.einsum("tin,tn->ti", h_all, c.astype(jnp.float32))
+
+    last = jnp.maximum(query_start_loc[1:] - 1, 0)
+    new_state = h_all[last]  # [R, I, N]
+    return y.astype(x.dtype), new_state.astype(h0.dtype)
+
+
 def ragged_ssd_scan(
     x: jnp.ndarray,  # [T, H, P] conv-activated inputs
     dt: jnp.ndarray,  # [T, H] softplus-ed, clamped step sizes
