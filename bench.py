@@ -27,6 +27,35 @@ the median/worst quantify the spread honestly. The JSON also carries a
 roofline context: estimated HBM bytes per decode step -> implied
 bandwidth utilization at the scored rate, model FLOPs/token -> MFU, and
 the host/dispatch/wait step-time split (VLLM_TPU_STEP_TIMING).
+
+Roofline analysis of the 1B scored rung (round 4, measured on the
+shared v5e through the axon tunnel):
+
+- Floor: 2.85 GiB weight read (3.5 ms) + ~1 GiB KV/context read (~1 ms)
+  per 128-request decode step => ~4.5 ms; measured ~12 ms/step =>
+  hbm_bw_util ~0.36. At batch 128 the 1B model sits near the
+  compute/bandwidth crossover (FLOP time ~3.4 ms), so ~4.5 ms is a hard
+  floor even with perfect overlap.
+- NOT host/tunnel launch overhead: sweeping in-jit decode depth
+  K in {4, 8, 16, 32} leaves tok/s flat (10.2k / 10.2k / 9.8k / 9.8k) —
+  deeper amortization of the dispatch round trip buys nothing, so the
+  residual is device-side.
+- NOT DMA wave count: page-size sweep (16/32/64/128) at fixed context
+  is flat, so per-page DMA issue cost is not the limiter.
+- Prime suspect: the general ragged kernel's PER-SEQUENCE while_loop
+  (one DMA wait + one tiny matmul per sequence per layer — ~2k
+  iterations/step at decode shapes, ~us-scale fixed cost each). A
+  grouped decode kernel (ops/decode_attention.py: G sequences per grid
+  step, batched copies + batched einsum) was built to attack this; it
+  passes parity everywhere but measures SLOWER in-engine on this chip
+  (microbenchmarks there are unreliable — XLA CSE folds repeated kernel
+  calls — so the engine number is the arbiter). It ships opt-in
+  (VLLM_TPU_GROUPED_DECODE=1) pending real profiling.
+- Residual attribution therefore: device-side step time ~2.5x the
+  bandwidth floor, most plausibly kernel loop overhead + the tunnel's
+  shared-chip interference (identical configs vary 9.3k-10.6k tok/s
+  run to run, and other tenants' HBM traffic shares the bandwidth the
+  roofline assumes exclusive).
 """
 
 from __future__ import annotations

@@ -1,0 +1,119 @@
+"""Accuracy gate: a small lm-eval-style loglikelihood harness run in CI.
+
+Reference analog: ``tests/evals/`` + ``.buildkite/lm-eval-harness/``. The
+reference gates releases on GSM8K-class scores from real checkpoints;
+offline CI can't download models, so the same PROTOCOL runs against a
+fixed tiny checkpoint: a bank of fixed prompts, each scored as a
+two-way multiple choice (the model's own greedy continuation vs a
+shuffled distractor) by summed continuation loglikelihood through the
+ENGINE's prompt-logprobs path. Kernel, sampler, or quantization
+regressions that rot likelihoods (without crashing) push the choice
+accuracy or the mean per-token LL out of tolerance and fail the gate —
+exactly the silent-quality-rot class the lm-eval gate exists to catch.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+N_PROMPTS = 24
+CONT_LEN = 6
+
+
+@pytest.fixture(scope="module")
+def ckpt(tmp_path_factory):
+    from tests.models.utils import tiny_llama_dir
+
+    return tiny_llama_dir(tmp_path_factory.mktemp("tiny_llama_eval"))
+
+
+@pytest.fixture(scope="module")
+def bank(ckpt):
+    """Fixed (prompt, true_continuation, distractor) triples. The true
+    continuation is HF's greedy rollout; the distractor shuffles it."""
+    import torch
+    from transformers import AutoModelForCausalLM
+
+    rng = np.random.default_rng(1234)
+    hf = AutoModelForCausalLM.from_pretrained(
+        ckpt, torch_dtype=torch.float32
+    )
+    hf.eval()
+    items = []
+    for _ in range(N_PROMPTS):
+        prompt = rng.integers(5, 120, size=int(rng.integers(6, 16))).tolist()
+        toks = list(prompt)
+        with torch.no_grad():
+            for _ in range(CONT_LEN):
+                logits = hf(torch.tensor([toks])).logits[0, -1]
+                toks.append(int(logits.argmax()))
+        true_cont = toks[len(prompt):]
+        distractor = list(true_cont)
+        rng.shuffle(distractor)
+        if distractor == true_cont:
+            distractor = distractor[::-1]
+        items.append((prompt, true_cont, distractor))
+    return items
+
+
+def _engine_ll(llm, prompt, cont):
+    """Summed loglikelihood of ``cont`` given ``prompt`` via the engine's
+    prompt-logprobs path (the lm-eval 'loglikelihood' request type)."""
+    from vllm_tpu import SamplingParams
+
+    ids = prompt + cont
+    out = llm.generate(
+        [{"prompt_token_ids": ids}],
+        SamplingParams(
+            temperature=0.0, max_tokens=1, prompt_logprobs=0,
+            ignore_eos=True,
+        ),
+    )[0]
+    plp = out.prompt_logprobs
+    return sum(
+        plp[i][ids[i]].logprob for i in range(len(prompt), len(ids))
+    )
+
+
+def test_loglikelihood_choice_accuracy_and_calibration(ckpt, bank):
+    """The engine must (a) prefer every greedy continuation over its
+    shuffled distractor and (b) reproduce HF's summed loglikelihood
+    within a tight per-token tolerance."""
+    import torch
+    from transformers import AutoModelForCausalLM
+
+    from vllm_tpu import LLM
+
+    llm = LLM(
+        model=ckpt, dtype="float32", max_model_len=64, block_size=16,
+        num_gpu_blocks_override=64, max_num_seqs=4,
+        max_num_batched_tokens=64,
+    )
+    hf = AutoModelForCausalLM.from_pretrained(
+        ckpt, torch_dtype=torch.float32
+    )
+    hf.eval()
+
+    def hf_ll(prompt, cont):
+        ids = prompt + cont
+        with torch.no_grad():
+            logits = hf(torch.tensor([ids])).logits[0]
+        lp = torch.log_softmax(logits, dim=-1)
+        return sum(
+            float(lp[i - 1, ids[i]]) for i in range(len(prompt), len(ids))
+        )
+
+    correct = 0
+    ll_err = []
+    for prompt, true_cont, distractor in bank:
+        ll_true = _engine_ll(llm, prompt, true_cont)
+        ll_false = _engine_ll(llm, prompt, distractor)
+        correct += ll_true > ll_false
+        ll_err.append(abs(ll_true - hf_ll(prompt, true_cont)) / CONT_LEN)
+
+    accuracy = correct / len(bank)
+    assert accuracy >= 0.95, f"choice accuracy {accuracy} (quality rot?)"
+    assert float(np.mean(ll_err)) < 0.01, (
+        f"mean per-token |LL - HF| = {np.mean(ll_err):.4f}"
+    )

@@ -1,0 +1,179 @@
+"""Qwen2-VL tests: m-rope position parity with HF's get_rope_index,
+vision-tower + engine e2e greedy parity, and the text-only degenerate.
+
+Reference analog: ``vllm/model_executor/models/qwen2_vl.py`` parity tier.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+IMG_SIZE = 56  # grid 4x4 patches -> 2x2 merged tokens per image
+VSTART, VEND, IMG_TOK = 120, 121, 122
+
+
+def tiny_qwen2vl_config():
+    from transformers import Qwen2VLConfig
+
+    return Qwen2VLConfig(
+        text_config=dict(
+            vocab_size=128,
+            hidden_size=48,
+            intermediate_size=96,
+            num_hidden_layers=2,
+            num_attention_heads=4,
+            num_key_value_heads=2,
+            max_position_embeddings=256,
+            tie_word_embeddings=False,
+            rope_scaling={
+                "type": "mrope", "mrope_section": [2, 2, 2]
+            },  # head_dim 12 -> 6 freqs
+        ),
+        vision_config=dict(
+            depth=2,
+            embed_dim=32,
+            num_heads=4,
+            mlp_ratio=2,
+            patch_size=14,
+            spatial_merge_size=2,
+            temporal_patch_size=2,
+            in_channels=3,
+            hidden_size=48,  # merger output = text dim
+        ),
+        image_token_id=IMG_TOK,
+        vision_start_token_id=VSTART,
+        vision_end_token_id=VEND,
+        vocab_size=128,
+    )
+
+
+@pytest.fixture(scope="module")
+def tiny_qwen2vl(tmp_path_factory):
+    import torch
+    from transformers import Qwen2VLForConditionalGeneration
+
+    torch.manual_seed(0)
+    model = Qwen2VLForConditionalGeneration(
+        tiny_qwen2vl_config()
+    ).to(torch.float32)
+    path = tmp_path_factory.mktemp("tiny_qwen2vl")
+    model.save_pretrained(str(path), safe_serialization=True)
+    return str(path)
+
+
+@pytest.fixture(autouse=True)
+def small_image_size(monkeypatch):
+    from vllm_tpu.models.qwen2_vl import Qwen2VLForConditionalGeneration
+
+    monkeypatch.setattr(
+        Qwen2VLForConditionalGeneration, "default_image_size", IMG_SIZE
+    )
+
+
+def _pixels(seed):
+    rng = np.random.default_rng(seed)
+    return rng.standard_normal((3, IMG_SIZE, IMG_SIZE)).astype(np.float32)
+
+
+def _hf_inputs(chw_images):
+    """HF pixel_values/grid from OUR normalized CHW arrays (processor
+    does only the patch reshape — same content both sides)."""
+    import torch
+    from transformers.models.qwen2_vl.image_processing_qwen2_vl import (
+        Qwen2VLImageProcessor,
+    )
+
+    proc = Qwen2VLImageProcessor(
+        do_resize=False, do_rescale=False, do_normalize=False,
+        do_convert_rgb=False, patch_size=14, merge_size=2,
+        temporal_patch_size=2,
+    )
+    out = proc(
+        images=[img.transpose(1, 2, 0) for img in chw_images],
+        return_tensors="pt",
+    )
+    return out["pixel_values"].to(torch.float32), out["image_grid_thw"]
+
+
+def _hf_generate(path, input_ids, chw_images, n):
+    import torch
+    from transformers import Qwen2VLForConditionalGeneration
+
+    model = Qwen2VLForConditionalGeneration.from_pretrained(
+        path, torch_dtype=torch.float32
+    )
+    model.eval()
+    kw = {}
+    if chw_images:
+        pv, grid = _hf_inputs(chw_images)
+        kw = dict(pixel_values=pv, image_grid_thw=grid)
+    with torch.no_grad():
+        out = model.generate(
+            torch.tensor([input_ids]), max_new_tokens=n, do_sample=False,
+            pad_token_id=0, eos_token_id=None, **kw,
+        )
+    return out[0, len(input_ids):].tolist()
+
+
+def test_mrope_positions_match_hf(tiny_qwen2vl):
+    """Host-side mrope table equals HF get_rope_index."""
+    import torch
+    from transformers import Qwen2VLForConditionalGeneration
+
+    from vllm_tpu.models.qwen2_vl import mrope_positions
+
+    tpi = 4  # (56/14/2)^2
+    ids = [5, 11, VSTART] + [IMG_TOK] * tpi + [VEND, 23, 42]
+    model = Qwen2VLForConditionalGeneration.from_pretrained(tiny_qwen2vl)
+    grid = torch.tensor([[1, 4, 4]])
+    want, want_delta = model.model.get_rope_index(
+        torch.tensor([ids]), image_grid_thw=grid
+    )
+    got, delta = mrope_positions(len(ids), [(3, 2, 2)])
+    np.testing.assert_array_equal(got, want[:, 0].numpy())
+    assert delta == int(want_delta[0])
+
+
+@pytest.mark.parametrize("budget", [128, 16])  # 16 forces chunked prefill
+def test_qwen2vl_e2e_greedy_matches_hf(tiny_qwen2vl, budget):
+    from vllm_tpu import LLM, SamplingParams
+
+    px = _pixels(1)
+    tpi = 4
+    prompt = [5, 11, VSTART, IMG_TOK, VEND, 23, 42]
+    expanded = [5, 11, VSTART] + [IMG_TOK] * tpi + [VEND, 23, 42]
+    want = _hf_generate(tiny_qwen2vl, expanded, [px], 6)
+
+    llm = LLM(
+        model=tiny_qwen2vl, dtype="float32", max_model_len=128,
+        block_size=16, num_gpu_blocks_override=64, max_num_seqs=4,
+        max_num_batched_tokens=budget,
+    )
+    [out] = llm.generate(
+        [{
+            "prompt_token_ids": prompt,
+            "multi_modal_data": {"image": px},
+        }],
+        SamplingParams(temperature=0.0, max_tokens=6, ignore_eos=True),
+    )
+    assert out.outputs[0].token_ids == want
+
+
+def test_qwen2vl_text_only_matches_hf(tiny_qwen2vl):
+    """No images: all three mrope streams equal the 1D position; output
+    must match HF exactly."""
+    from vllm_tpu import LLM, SamplingParams
+
+    prompt = [5, 9, 33, 47, 8, 14, 2, 77]
+    want = _hf_generate(tiny_qwen2vl, prompt, [], 8)
+    llm = LLM(
+        model=tiny_qwen2vl, dtype="float32", max_model_len=128,
+        block_size=16, num_gpu_blocks_override=64, max_num_seqs=4,
+        max_num_batched_tokens=128,
+    )
+    [out] = llm.generate(
+        [{"prompt_token_ids": prompt}],
+        SamplingParams(temperature=0.0, max_tokens=8, ignore_eos=True),
+    )
+    assert out.outputs[0].token_ids == want

@@ -1,0 +1,68 @@
+"""TPU smoke for the REAL `jax.lax.ragged_all_to_all` EP dispatch path.
+
+The CPU mesh has no lowering for the ragged collective, so every CI test
+exercises ``ep_moe``'s all_gather emulation; this script runs the
+``use_ragged_a2a=True`` branch on real TPU hardware (an ep=1 mesh over
+the local chip — the offset math, sorts, and grouped GEMM all execute;
+only the cross-chip hop is trivial) and asserts bit-parity against both
+the emulation and the dense one-hot reference.
+
+Run: ``python tools/ep_ragged_a2a_smoke.py`` (requires a TPU backend).
+Reference analog: ``vllm/distributed/device_communicators/all2all.py:40``.
+"""
+
+from __future__ import annotations
+
+import sys
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh
+
+
+def main() -> int:
+    if jax.default_backend() != "tpu":
+        print("SKIP: needs a TPU backend (ragged_all_to_all lowering)")
+        return 1
+
+    from vllm_tpu.layers.moe import ep_moe, fused_experts, select_experts
+
+    rng = np.random.default_rng(0)
+    t, d, f, e, k = 32, 64, 128, 8, 2
+    hidden = jnp.asarray(rng.standard_normal((t, d)), jnp.float32)
+    wg = jnp.asarray(rng.standard_normal((e, d, f)) * 0.1, jnp.float32)
+    wu = jnp.asarray(rng.standard_normal((e, d, f)) * 0.1, jnp.float32)
+    wd = jnp.asarray(rng.standard_normal((e, f, d)) * 0.1, jnp.float32)
+    logits = jnp.asarray(rng.standard_normal((t, e)), jnp.float32)
+    weights, ids = select_experts(logits, k)
+
+    mesh = Mesh(np.asarray(jax.devices()[:1]), ("ep",))
+    out_ragged = ep_moe(
+        hidden, wg, wu, wd, weights, ids, mesh=mesh, axis="ep",
+        use_ragged_a2a=True,
+    )
+    out_emul = ep_moe(
+        hidden, wg, wu, wd, weights, ids, mesh=mesh, axis="ep",
+        use_ragged_a2a=False,
+    )
+    out_dense = fused_experts(hidden, wg, wu, wd, weights, ids)
+
+    a, b, c = (np.asarray(x) for x in (out_ragged, out_emul, out_dense))
+    if not np.array_equal(a, b):
+        print(f"FAIL: ragged vs emulation max diff {np.abs(a - b).max()}")
+        return 2
+    if not np.allclose(a, c, rtol=2e-5, atol=2e-5):
+        print(f"FAIL: ragged vs dense max diff {np.abs(a - c).max()}")
+        return 3
+    print(
+        "OK: ragged_all_to_all EP dispatch executed on",
+        jax.devices()[0].device_kind,
+        "— bit-parity with the all_gather emulation, matches dense",
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

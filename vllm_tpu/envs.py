@@ -46,6 +46,11 @@ _readers: dict[str, Callable[[], Any]] = {
     # Compilation / runner
     "VLLM_TPU_DISABLE_PALLAS": _bool("VLLM_TPU_DISABLE_PALLAS", False),
     "VLLM_TPU_PALLAS_INTERPRET": _bool("VLLM_TPU_PALLAS_INTERPRET", False),
+    # Experimental grouped decode-attention kernel (ops/decode_attention
+    # .py). In-engine measurements on the shared v5e currently favor the
+    # general kernel; microbenchmarks are unreliable there (XLA CSE), so
+    # this stays opt-in until profiled properly.
+    "VLLM_TPU_GROUPED_DECODE": _bool("VLLM_TPU_GROUPED_DECODE", False),
     "VLLM_TPU_COMPILE_CACHE_DIR": _str("VLLM_TPU_COMPILE_CACHE_DIR", None),
     # LRU size bound for the persistent compilation cache directory.
     "VLLM_TPU_COMPILE_CACHE_MAX_GB": _int("VLLM_TPU_COMPILE_CACHE_MAX_GB", 32),

@@ -371,7 +371,7 @@ class LlamaForCausalLM:
 
     def _make_layer_fn(self, md: AttentionMetadata, t: int, *,
                        token_lora_slot=None, lora_scale=None,
-                       attn_fn=paged_attention):
+                       attn_fn=paged_attention, rope_cos_sin=None):
         """One decoder layer as a ``lax.scan`` body over (lp, layer_idx)
         with carry (hidden, kv_cache); shared by the plain and pipelined
         forward paths."""
@@ -416,7 +416,13 @@ class LlamaForCausalLM:
                 q = rms_norm(q, lp["q_norm"], self.rms_eps)
                 k = rms_norm(k, lp["k_norm"], self.rms_eps)
 
-            if self.position_embedding == "rope":
+            if rope_cos_sin is not None:
+                # Precomputed per-token tables (Qwen2-VL m-rope).
+                cos = rope_cos_sin[0][:, None, :]
+                sin = rope_cos_sin[1][:, None, :]
+                q = _apply_rotate_half(q, cos, sin, self.rope.rotary_dim)
+                k = _apply_rotate_half(k, cos, sin, self.rope.rotary_dim)
+            elif self.position_embedding == "rope":
                 cos = rope_cos[md.positions][:, None, :]
                 sin = rope_sin[md.positions][:, None, :]
                 q = _apply_rotate_half(q, cos, sin, self.rope.rotary_dim)

@@ -37,6 +37,7 @@ _REGISTRY: dict[str, tuple[str, str]] = {
     "Olmo2ForCausalLM": ("vllm_tpu.models.olmo2", "Olmo2ForCausalLM"),
     "StableLmForCausalLM": ("vllm_tpu.models.stablelm", "StableLmForCausalLM"),
     "LlavaForConditionalGeneration": ("vllm_tpu.models.llava", "LlavaForConditionalGeneration"),
+    "Qwen2VLForConditionalGeneration": ("vllm_tpu.models.qwen2_vl", "Qwen2VLForConditionalGeneration"),
     "GPT2LMHeadModel": ("vllm_tpu.models.gpt_like", "GPT2LMHeadModel"),
     "GPTBigCodeForCausalLM": ("vllm_tpu.models.gpt_like", "GPTBigCodeForCausalLM"),
     "OPTForCausalLM": ("vllm_tpu.models.gpt_like", "OPTForCausalLM"),

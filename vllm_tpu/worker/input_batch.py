@@ -28,6 +28,7 @@ class CachedRequestState:
         "logit_bias_items",
         "pooling_params",
         "mm_inputs",
+        "mrope",
     )
 
     def __init__(self, req_id: str, sampling_params: SamplingParams,
@@ -42,6 +43,7 @@ class CachedRequestState:
         self.in_batch_row = -1
         self.eos_token_id = eos_token_id
         self.mm_inputs = None  # multimodal placeholder spans + pixels
+        self.mrope = None  # Qwen2-VL: ([3, prompt_len] pos table, delta)
         p = sampling_params
         # Per-request logits-processor work (bias / bans / min-tokens EOS
         # suppression); cached so the no-adjustment common path costs one

@@ -556,6 +556,7 @@ class ModelRunner:
         mask_table,
         mm_embeds=None,  # [T, D] encoder-output overlay (multimodal)
         mm_mask=None,  # [T] bool, True at overlaid positions
+        mrope_positions=None,  # [3, T] i32 (Qwen2-VL m-rope streams)
         *,
         t_pad: int,
         r_pad: int,
@@ -605,11 +606,12 @@ class ModelRunner:
             md, tree_active = self._build_tree_metadata(
                 md, spec, t_pad, r_pad
             )
-        mm_kw = (
-            {"mm_embeds": mm_embeds, "mm_mask": mm_mask}
-            if mm_embeds is not None
-            else {}
-        )
+        mm_kw = {}
+        if mm_embeds is not None:
+            mm_kw["mm_embeds"] = mm_embeds
+            mm_kw["mm_mask"] = mm_mask
+        if mrope_positions is not None:
+            mm_kw["mrope_positions"] = mrope_positions
         moe_counts = None
         out = self.model.apply(
             params, kv_cache, token_ids, md, token_lora_slot=token_lora,
@@ -1020,6 +1022,16 @@ class ModelRunner:
             if self.lora_manager is not None:
                 self.input_batch.lora_slot[row] = self.lora_manager.slot_of(
                     new.lora_name
+                )
+            if getattr(self.model, "needs_mrope", False):
+                from vllm_tpu.models.qwen2_vl import mrope_positions
+
+                spans = [
+                    (mi.offset, self.model.llm_grid, self.model.llm_grid)
+                    for mi in (new.mm_inputs or [])
+                ]
+                self.input_batch.req_states[new.req_id].mrope = (
+                    mrope_positions(len(new.prompt_token_ids), spans)
                 )
 
     def _run_encoders(self, so: SchedulerOutput) -> None:
@@ -1445,6 +1457,31 @@ class ModelRunner:
                     (dst, 0),
                 )
             mm_arrays = (overlay, jnp.asarray(mm_mask_np))
+        if getattr(self.model, "needs_mrope", False):
+            # Multimodal 3D rope (Qwen2-VL): per-token (t, h, w) position
+            # streams; prompt tokens read the request's get_rope_index
+            # table, generated tokens run at position + delta.
+            mrope_np = np.zeros((3, t_pad), np.int32)
+            off2 = 0
+            for i, rid in enumerate(req_order):
+                state = batch.req_states[rid]
+                n = num_sched[rid]
+                start = int(batch.num_computed_tokens[rows[i]])
+                table, delta = state.mrope
+                k = max(0, min(n, table.shape[1] - start))
+                if k:
+                    mrope_np[:, off2 : off2 + k] = (
+                        table[:, start : start + k]
+                    )
+                if k < n:
+                    mrope_np[:, off2 + k : off2 + n] = (
+                        np.arange(start + k, start + n, dtype=np.int32)
+                        + delta
+                    )
+                off2 += n
+            if mm_arrays is None:
+                mm_arrays = (None, None)
+            mm_arrays = mm_arrays + (jnp.asarray(mrope_np),)
         return (arrays, req_order, do_sample[:r_live], dims | flags,
                 prompt_rows, mm_arrays)
 
@@ -1518,6 +1555,7 @@ class ModelRunner:
             logits_indices=rows_r,
             num_seqs=md.num_seqs,
             state_slots=md.state_slots,
+            decode_grouped=True,
         )
 
     def _logit_adjustments(self, rows: list[int], req_order: list[str],
@@ -1647,11 +1685,13 @@ class ModelRunner:
             t1 = time.perf_counter()
             self.timing["prep_s"] += t1 - t0
         prev = self._last_sampled if self._last_sampled is not None else self._zero_sampled
-        mm_kwargs = (
-            {"mm_embeds": mm_arrays[0], "mm_mask": mm_arrays[1]}
-            if mm_arrays is not None
-            else {}
-        )
+        mm_kwargs = {}
+        if mm_arrays is not None:
+            if mm_arrays[0] is not None:
+                mm_kwargs["mm_embeds"] = mm_arrays[0]
+                mm_kwargs["mm_mask"] = mm_arrays[1]
+            if len(mm_arrays) > 2:
+                mm_kwargs["mrope_positions"] = mm_arrays[2]
         (self.kv_cache, self.draft_kv, sampled, lp, drafts, pooled,
          nan_count, prompt_lp, moe_counts) = self._step_fn(
             self.params, self.kv_cache, self.draft_kv, *arrays, prev,

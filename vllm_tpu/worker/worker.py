@@ -200,6 +200,19 @@ class Worker:
         self.model = model_cls(
             hf_config, dtype=mc.jax_dtype, quantization=mc.quantization
         )
+        if getattr(self.model, "needs_mrope", False):
+            sched = self.config.scheduler_config
+            if sched.num_decode_steps > 1:
+                raise ValueError(
+                    "m-rope models (Qwen2-VL) do not support "
+                    "num_decode_steps > 1 yet (the in-jit decode chain "
+                    "does not thread the mrope delta)"
+                )
+            if self.config.speculative_config.enabled:
+                raise ValueError(
+                    "speculative decoding with m-rope models is not "
+                    "supported yet"
+                )
         if mc.quantize_embedding_layers:
             if not getattr(self.model, "supports_quantized_embedding", False):
                 raise ValueError(
