@@ -190,3 +190,43 @@ def test_mamba2_state_cache_setup(tiny_mamba2):
     assert set(kv) == {"conv", "ssm"}
     assert kv["conv"].shape == (2, 8, 64 + 2 * 16, 3)
     assert kv["ssm"].shape == (2, 8, 4, 16, 16)
+
+
+@pytest.mark.parametrize("chunk", [4, 8, 64])
+def test_chunked_ssd_matches_flat_scan(chunk):
+    """The chunked (matmul) SSD formulation equals the flat associative
+    scan bit-for-tolerance: mixed segment lengths (boundaries inside and
+    across chunks), nonzero seeded states, and T not a chunk multiple."""
+    from vllm_tpu.ops.mamba import ragged_ssd_scan, ragged_ssd_scan_chunked
+
+    rng = np.random.default_rng(7)
+    lens = [5, 11, 3, 17, 2]  # T = 38
+    t = sum(lens)
+    h, p, n = 3, 4, 6
+    r = len(lens)
+    x = rng.standard_normal((t, h, p)).astype(np.float32)
+    dt = rng.uniform(0.05, 1.5, (t, h)).astype(np.float32)
+    a_log = rng.uniform(-1, 1.5, h).astype(np.float32)
+    b = rng.standard_normal((t, h, n)).astype(np.float32)
+    c = rng.standard_normal((t, h, n)).astype(np.float32)
+    h0 = rng.standard_normal((r, h, p, n)).astype(np.float32)
+
+    token_req = np.repeat(np.arange(r), lens).astype(np.int32)
+    qsl = np.concatenate([[0], np.cumsum(lens)]).astype(np.int32)
+
+    want_y, want_s = ragged_ssd_scan(
+        jnp.asarray(x), jnp.asarray(dt), jnp.asarray(a_log),
+        jnp.asarray(b), jnp.asarray(c), jnp.asarray(h0),
+        jnp.asarray(token_req), jnp.asarray(qsl),
+    )
+    got_y, got_s = ragged_ssd_scan_chunked(
+        jnp.asarray(x), jnp.asarray(dt), jnp.asarray(a_log),
+        jnp.asarray(b), jnp.asarray(c), jnp.asarray(h0),
+        jnp.asarray(token_req), jnp.asarray(qsl), chunk=chunk,
+    )
+    np.testing.assert_allclose(
+        np.asarray(got_y), np.asarray(want_y), rtol=2e-4, atol=2e-4
+    )
+    np.testing.assert_allclose(
+        np.asarray(got_s), np.asarray(want_s), rtol=2e-4, atol=2e-4
+    )

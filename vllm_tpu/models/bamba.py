@@ -45,7 +45,11 @@ from vllm_tpu.ops.attention import (
     paged_attention,
     write_kv,
 )
-from vllm_tpu.ops.mamba import ragged_causal_conv, ragged_ssd_scan
+from vllm_tpu.ops.mamba import (
+    ragged_causal_conv,
+    ragged_ssd_scan,
+    ragged_ssd_scan_chunked,
+)
 
 logger = init_logger(__name__)
 
@@ -337,7 +341,13 @@ class BambaForCausalLM:
             ssm_seed = jnp.where(
                 fresh[:, None, None, None], 0.0, ssm_c[m_li, slots]
             )
-            y, new_ssm = ragged_ssd_scan(
+            # Long prefills use the chunked (matmul) formulation: the
+            # flat scan materializes dBx at O(T*H*P*N). T is a static
+            # trace-time shape, so the choice costs nothing at run time.
+            scan_fn = (
+                ragged_ssd_scan_chunked if t >= 256 else ragged_ssd_scan
+            )
+            y, new_ssm = scan_fn(
                 xs, dt, lp["a_log"].astype(jnp.float32), b, c, ssm_seed,
                 md.token_req_idx, md.query_start_loc,
             )

@@ -41,7 +41,11 @@ from vllm_tpu.core.kv_cache_utils import KVCacheSpec, MambaSpec
 from vllm_tpu.layers.layernorm import rms_norm
 from vllm_tpu.logger import init_logger
 from vllm_tpu.ops.attention import AttentionMetadata
-from vllm_tpu.ops.mamba import ragged_causal_conv, ragged_ssd_scan
+from vllm_tpu.ops.mamba import (
+    ragged_causal_conv,
+    ragged_ssd_scan,
+    ragged_ssd_scan_chunked,
+)
 
 logger = init_logger(__name__)
 
@@ -225,7 +229,13 @@ class Mamba2ForCausalLM:
             ssm_seed = jnp.where(
                 fresh[:, None, None, None], 0.0, ssm_c[li, slots]
             )  # [R, H, P, N]
-            y, new_ssm = ragged_ssd_scan(
+            # Long prefills use the chunked (matmul) formulation: the
+            # flat scan materializes dBx at O(T*H*P*N). T is a static
+            # trace-time shape, so the choice costs nothing at run time.
+            scan_fn = (
+                ragged_ssd_scan_chunked if t >= 256 else ragged_ssd_scan
+            )
+            y, new_ssm = scan_fn(
                 xs, dt, lp["a_log"].astype(jnp.float32), b, c, ssm_seed,
                 md.token_req_idx, md.query_start_loc,
             )

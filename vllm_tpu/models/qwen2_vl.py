@@ -249,7 +249,7 @@ class Qwen2VLForConditionalGeneration:
             if hf_name.startswith("model."):
                 m["model.language_model." + hf_name[len("model."):]] = dest
         v = "model.visual"
-        m[f"{v}.patch_embed.proj.weight"] = ("vision.patch_w", "conv3d")
+        m[f"{v}.patch_embed.proj.weight"] = ("vision.patch_w", False)
         for i in range(self.vision_depth):
             b = f"{v}.blocks.{i}"
             d = f"vision.blocks"
@@ -278,30 +278,18 @@ class Qwen2VLForConditionalGeneration:
         return m
 
     def postprocess_weight(self, leaf_path: str, arr):
+        if leaf_path == "vision.patch_w":
+            # Conv3d with kernel == stride is a linear over the flattened
+            # patch: [E, C, Tp, P, P] -> [C*Tp*P*P, E].
+            return arr.reshape(arr.shape[0], -1).T
         return arr
 
     def load_params(self, path: str, dtype=None, shardings: Any | None = None) -> dict:
         from vllm_tpu.models.loader import load_safetensors_params
 
-        # The conv3d patch embed needs a flatten+transpose the generic
-        # loader doesn't do: mark it with a sentinel and fix up after.
-        wm = self.hf_weight_map()
-        fixed = {
-            k: (d, False if tr == "conv3d" else tr) for k, (d, tr) in wm.items()
-        }
-        self.hf_weight_map = lambda: fixed  # type: ignore[method-assign]
-        try:
-            params = load_safetensors_params(
-                self, path, dtype or self.dtype, shardings
-            )
-        finally:
-            del self.hf_weight_map  # restore the class method
-        pw = params["vision"]["patch_w"]
-        # [E, C, Tp, P, P] -> [C*Tp*P*P, E]
-        params["vision"]["patch_w"] = pw.reshape(pw.shape[0], -1).T.astype(
-            (dtype or self.dtype)
+        return load_safetensors_params(
+            self, path, dtype or self.dtype, shardings
         )
-        return params
 
     # ------------------------------------------------------------------
     # Vision tower (runs once per image via the runner's encoder hook)

@@ -122,6 +122,198 @@ def ragged_mamba1_scan(
     return y.astype(x.dtype), new_state.astype(h0.dtype)
 
 
+def ragged_ssd_scan_chunked(
+    x: jnp.ndarray,  # [T, H, P]
+    dt: jnp.ndarray,  # [T, H]
+    a_log: jnp.ndarray,  # [H]
+    b: jnp.ndarray,  # [T, H, N]
+    c: jnp.ndarray,  # [T, H, N]
+    h0: jnp.ndarray,  # [R, H, P, N]
+    token_req_idx: jnp.ndarray,  # [T]
+    query_start_loc: jnp.ndarray,  # [R+1]
+    *,
+    chunk: int = 64,
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Chunked SSD: the matmul formulation of :func:`ragged_ssd_scan`.
+
+    The flat scan materializes dBx at O(T*H*P*N); this computes the same
+    recurrence as (reference role: the CUDA ``mamba_chunk_scan`` kernels
+    next to ``selective_scan_fwd.cu``):
+
+    1. INTRA-chunk: an attention-like masked GEMM per chunk —
+       ``S[i, j] = (C_i . B_j) dt_j exp(cumA_i - cumA_j)`` for j <= i in
+       the same request, then ``y_intra = S @ x``.
+    2. INTER-chunk: per-chunk outflow states ``Z[c] = sum_j w_j B_j
+       (dt_j x_j)^T`` (tokens whose request reaches the chunk end) chain
+       through a tiny first-order scan over chunks; token i receives
+       ``coef_i C_i^T H_init(c)`` when its request started before the
+       chunk.
+    3. SEEDS: the recurrence is linear in (h0, u), so cached states
+       contribute independently: ``y_seed_i = g_i C_i^T h0[r_i]`` with
+       ``g_i`` the segment-cumulative decay — scalar per head (Mamba2's
+       A is scalar-per-head; this term is what breaks rank-1 chunking if
+       folded into u, so it rides separately).
+
+    All einsums pin ``Precision.HIGHEST``: TPU's default matmul
+    precision is bf16, which silently diverges from the elementwise f32
+    flat scan by ~1e-2 at these shapes.
+
+    Request boundaries never need log-of-zero sentinels: within-segment
+    decay products only involve REAL decays (exp(dt*A) > 0, and
+    ln(decay) = dt*A exactly); cross-boundary flow is killed by explicit
+    same-request masks and by chunk products that include a boundary
+    token's masked factor.
+    """
+    t, h, p_dim = x.shape
+    n = b.shape[-1]
+    r = h0.shape[0]
+    nc = -(-t // chunk)
+    t_pad = nc * chunk
+    if t_pad != t:
+        pad = [(0, t_pad - t)]
+        x = jnp.pad(x, pad + [(0, 0)] * 2)
+        dt = jnp.pad(dt, pad + [(0, 0)])
+        b = jnp.pad(b, pad + [(0, 0)] * 2)
+        c = jnp.pad(c, pad + [(0, 0)] * 2)
+        # Pad tokens: own segment id (never matches a live request).
+        token_req_idx = jnp.pad(
+            token_req_idx, pad, constant_values=r + 1
+        )
+
+    xf = x.astype(jnp.float32)
+    dtf = dt.astype(jnp.float32)
+    af = -jnp.exp(a_log.astype(jnp.float32))  # [H]
+    ln_a = dtf * af[None]  # [Tp, H] = log(real decay), exact
+    ts = jnp.arange(t_pad, dtype=jnp.int32)
+    is_first = ts == query_start_loc[jnp.clip(token_req_idx, 0, r)]
+    seg = token_req_idx  # segment id per token
+
+    # ---- per-chunk views ----
+    def ck(v):
+        return v.reshape((nc, chunk) + v.shape[1:])
+
+    seg_c = ck(seg)  # [NC, Q]
+    ln_c = ck(ln_a)  # [NC, Q, H]
+    dt_c = ck(dtf)
+    x_c = ck(xf)  # [NC, Q, H, P]
+    b_c = ck(b.astype(jnp.float32))
+    c_c = ck(c.astype(jnp.float32))
+    first_c = ck(is_first)
+
+    # Within-segment products exp(cum_i - cum_j) for j < i never include
+    # a segment-start decay, so zero it out of the cumsum; j == i adds
+    # nothing (difference 0).
+    ln_nf = jnp.where(first_c[..., None], 0.0, ln_c)
+    cum = jnp.cumsum(ln_nf, axis=1)  # [NC, Q, H] inclusive
+
+    # 1. Intra-chunk masked GEMM.
+    g_bc = jnp.einsum("kqhn,kjhn->khqj", c_c, b_c, precision=jax.lax.Precision.HIGHEST)  # [NC, H, Q, Q]
+    same = seg_c[:, :, None] == seg_c[:, None, :]  # [NC, Q, Q]
+    causal = (
+        jnp.arange(chunk)[:, None] >= jnp.arange(chunk)[None, :]
+    )
+    decay_ij = jnp.exp(
+        cum[:, :, None] - cum[:, None, :]
+    )  # [NC, Q, Q, H] (i, j)
+    w_ij = jnp.where(
+        (same & causal)[..., None], decay_ij * dt_c[:, None, :, :], 0.0
+    )  # [NC, Q, Q, H]
+    y = jnp.einsum(
+        "khqj,kqjh,kjhp->kqhp", g_bc, w_ij, x_c
+    , precision=jax.lax.Precision.HIGHEST)  # [NC, Q, H, P]
+
+    # 2. Inter-chunk state chain.
+    # Outflow weight: decay from j (exclusive) to chunk end, masked to
+    # tokens whose request reaches the chunk's last token.
+    last_seg = seg_c[:, -1]  # [NC]
+    reach = seg_c == last_seg[:, None]  # [NC, Q]
+    w_out = jnp.where(
+        reach[..., None],
+        jnp.exp(cum[:, -1:, :] - cum) * dt_c,
+        0.0,
+    )  # [NC, Q, H]
+    z = jnp.einsum(
+        "kqhn,kqh,kqhp->khpn", b_c, w_out, x_c
+    , precision=jax.lax.Precision.HIGHEST)  # [NC, H, P, N]
+    # Chunk decay product INCLUDING boundary-masked factors: a chunk
+    # containing a segment start forwards nothing.
+    a_chunk = jnp.exp(jnp.sum(ln_c, axis=1)) * jnp.all(
+        ~first_c, axis=1
+    ).astype(jnp.float32)[:, None]  # [NC, H]
+
+    def comb(left, right):
+        a1, z1 = left
+        a2, z2 = right
+        return a1 * a2, a2[..., None, None] * z1 + z2
+
+    a_sc, z_sc = jax.lax.associative_scan(comb, (a_chunk, z), axis=0)
+    # H_init for chunk k = scanned state of chunk k-1 (exclusive).
+    h_init = jnp.concatenate(
+        [jnp.zeros_like(z_sc[:1]), z_sc[:-1]], axis=0
+    )  # [NC, H, P, N]
+
+    # Inflow: decay from chunk start through i inclusive (all real
+    # factors), valid when i's request started BEFORE this chunk — i.e.
+    # i shares the chunk's first token's request and that token is a
+    # continuation, so no boundary sits in [chunk_start, i].
+    coef = jnp.exp(jnp.cumsum(ln_c, axis=1))  # [NC, Q, H]
+    cont = (seg_c == seg_c[:, :1]) & ~first_c[:, :1]  # [NC, Q]
+    y_inter = jnp.einsum(
+        "kqhn,khpn->kqhp", c_c * coef[..., None], h_init
+    , precision=jax.lax.Precision.HIGHEST)
+    y = y + y_inter * jnp.where(cont, 1.0, 0.0)[..., None, None]
+
+    y = y.reshape(t_pad, h, p_dim)[:t]
+
+    # 3. Seeds (linearity): g_i = segment-cumulative REAL decay.
+    cs = jnp.cumsum(ln_a, axis=0)  # [Tp, H]
+    start_idx = query_start_loc[jnp.clip(token_req_idx, 0, r)]
+    base = cs[jnp.clip(start_idx, 0, t_pad - 1)] - ln_a[
+        jnp.clip(start_idx, 0, t_pad - 1)
+    ]
+    g = jnp.exp(cs - base)[:t]  # [T, H]
+    h0_tok = h0[jnp.clip(token_req_idx[:t], 0, r - 1)]  # [T, H, P, N]
+    y_seed = jnp.einsum(
+        "thn,thpn->thp", (c.astype(jnp.float32)[:t] * g[..., None]),
+        h0_tok,
+        precision=jax.lax.Precision.HIGHEST,
+    )
+    y = y + y_seed
+
+    # Final per-request states at each request's last scheduled token.
+    last = jnp.maximum(query_start_loc[1:] - 1, 0)  # [R]
+    lc = last // chunk
+    li = last % chunk
+    rows = jnp.arange(r)
+    # u-part: H_init(chunk) * coef + intra sum at the last token.
+    coef_l = coef[lc, li] * jnp.where(
+        (seg_c[lc, 0] == token_req_idx[last]) & ~first_c[lc, 0], 1.0, 0.0
+    )[:, None]  # [R, H]
+    state_u = h_init[lc] * coef_l[..., None, None]
+    w_last = jnp.where(
+        (
+            (seg_c[lc] == token_req_idx[last][:, None])
+            & (jnp.arange(chunk)[None] <= li[:, None])
+        )[..., None],
+        jnp.exp(cum[lc, li][:, None] - cum[lc]) * dt_c[lc],
+        0.0,
+    )  # [R, Q, H]
+    state_u = state_u + jnp.einsum(
+        "rqhn,rqh,rqhp->rhpn", b_c[lc], w_last, x_c[lc]
+    , precision=jax.lax.Precision.HIGHEST)
+    g_last = g[jnp.clip(last, 0, t - 1)]  # [R, H]
+    new_state = state_u + g_last[..., None, None] * h0
+    return y.astype(x.dtype), new_state.astype(h0.dtype)
+
+
+def select_ssd_scan(t: int):
+    """Chunked (matmul) formulation for long prefills, flat associative
+    scan otherwise — ``t`` is a static trace-time shape, so the choice
+    costs nothing at run time. The crossover reflects where the flat
+    scan's O(T*H*P*N) dBx materialization starts to dominate."""
+    return ragged_ssd_scan_chunked if t >= 256 else ragged_ssd_scan
+
+
 def ragged_ssd_scan(
     x: jnp.ndarray,  # [T, H, P] conv-activated inputs
     dt: jnp.ndarray,  # [T, H] softplus-ed, clamped step sizes
