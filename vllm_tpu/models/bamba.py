@@ -45,11 +45,7 @@ from vllm_tpu.ops.attention import (
     paged_attention,
     write_kv,
 )
-from vllm_tpu.ops.mamba import (
-    ragged_causal_conv,
-    ragged_ssd_scan,
-    ragged_ssd_scan_chunked,
-)
+from vllm_tpu.ops.mamba import ragged_causal_conv, select_ssd_scan
 
 logger = init_logger(__name__)
 
@@ -64,6 +60,10 @@ class BambaForCausalLM:
     # Set by the worker before alloc_kv_cache: number of Mamba state
     # slots (= scheduler max_num_seqs).
     max_state_slots = 256
+
+    # Decay parameters stay f32 at load (bf16 rounding of the
+    # recurrence decays compounds over long sequences).
+    KEEP_F32_SUFFIXES = ("a_log", "dt_bias")
 
     def __init__(self, hf_config: Any, dtype=jnp.bfloat16,
                  quantization: str | None = None) -> None:
@@ -344,10 +344,7 @@ class BambaForCausalLM:
             # Long prefills use the chunked (matmul) formulation: the
             # flat scan materializes dBx at O(T*H*P*N). T is a static
             # trace-time shape, so the choice costs nothing at run time.
-            scan_fn = (
-                ragged_ssd_scan_chunked if t >= 256 else ragged_ssd_scan
-            )
-            y, new_ssm = scan_fn(
+            y, new_ssm = select_ssd_scan(t)(
                 xs, dt, lp["a_log"].astype(jnp.float32), b, c, ssm_seed,
                 md.token_req_idx, md.query_start_loc,
             )

@@ -54,6 +54,10 @@ class JambaForCausalLM:
     is_hybrid_ssm = True
     max_state_slots = 256  # set by the worker
 
+    # Decay parameters stay f32 at load (bf16 rounding of the
+    # recurrence decays compounds over long sequences).
+    KEEP_F32_SUFFIXES = ("a_log", "dt_b")
+
     def __init__(self, hf_config: Any, dtype=jnp.bfloat16,
                  quantization: str | None = None) -> None:
         if quantization:

@@ -176,6 +176,10 @@ def load_safetensors_params(
     )
 
     postprocess = getattr(model, "postprocess_weight", None)
+    # Leaves that must stay f32 regardless of the model dtype (SSM decay
+    # parameters: -exp(a_log)/softplus(dt) from bf16-rounded values
+    # compounds error over long recurrences).
+    keep_f32 = tuple(getattr(model, "KEEP_F32_SUFFIXES", ()))
 
     def _lookup_sharding(leaf_path: str):
         if shardings is None:
@@ -237,7 +241,12 @@ def load_safetensors_params(
             qn, sn, zn = quantize_int4_np(arr, group_size=group)
             put_int4(leaf_path, qn, sn, zn)
             return
-        x = jnp.asarray(arr, dtype=dtype)
+        leaf_dtype = (
+            jnp.float32
+            if keep_f32 and leaf_path.endswith(keep_f32)
+            else dtype
+        )
+        x = jnp.asarray(arr, dtype=leaf_dtype)
         if sharding is not None:
             x = jax.device_put(x, sharding)
         _set_path(params, leaf_path, x)

@@ -49,6 +49,10 @@ class MambaForCausalLM:
     enable_lora = False
     is_stateful_ssm = True
 
+    # Decay parameters stay f32 at load (bf16 rounding of the
+    # recurrence decays compounds over long sequences).
+    KEEP_F32_SUFFIXES = ("a_log", "dt_b")
+
     def __init__(self, hf_config: Any, dtype=jnp.bfloat16,
                  quantization: str | None = None) -> None:
         if quantization:

@@ -41,11 +41,7 @@ from vllm_tpu.core.kv_cache_utils import KVCacheSpec, MambaSpec
 from vllm_tpu.layers.layernorm import rms_norm
 from vllm_tpu.logger import init_logger
 from vllm_tpu.ops.attention import AttentionMetadata
-from vllm_tpu.ops.mamba import (
-    ragged_causal_conv,
-    ragged_ssd_scan,
-    ragged_ssd_scan_chunked,
-)
+from vllm_tpu.ops.mamba import ragged_causal_conv, select_ssd_scan
 
 logger = init_logger(__name__)
 
@@ -56,6 +52,10 @@ class Mamba2ForCausalLM:
     # Pure-SSM: the worker flips the cache to one-block-per-request and
     # disables prefix caching when it sees this.
     is_stateful_ssm = True
+
+    # Decay parameters stay f32 at load (bf16 rounding of the
+    # recurrence decays compounds over long sequences).
+    KEEP_F32_SUFFIXES = ("a_log", "dt_bias")
 
     def __init__(self, hf_config: Any, dtype=jnp.bfloat16,
                  quantization: str | None = None) -> None:
@@ -232,10 +232,7 @@ class Mamba2ForCausalLM:
             # Long prefills use the chunked (matmul) formulation: the
             # flat scan materializes dBx at O(T*H*P*N). T is a static
             # trace-time shape, so the choice costs nothing at run time.
-            scan_fn = (
-                ragged_ssd_scan_chunked if t >= 256 else ragged_ssd_scan
-            )
-            y, new_ssm = scan_fn(
+            y, new_ssm = select_ssd_scan(t)(
                 xs, dt, lp["a_log"].astype(jnp.float32), b, c, ssm_seed,
                 md.token_req_idx, md.query_start_loc,
             )
