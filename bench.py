@@ -56,6 +56,24 @@ shared v5e through the axon tunnel):
   shared-chip interference (identical configs vary 9.3k-10.6k tok/s
   run to run, and other tenants' HBM traffic shares the bandwidth the
   roofline assumes exclusive).
+
+Round-4 addendum — co-tenant congestion dominates the variance:
+
+- A 16-deep in-jit [128,2048]x[2048,8192] matmul chain (the
+  ``_bw_probe`` below) measures 213 GB/s effective in a quiet window
+  and 15 GB/s under a co-tenant burst — a 14x swing that dwarfs every
+  framework-side effect. The first completed 8B-int4 rung (412 tok/s,
+  vs_baseline 0.21) was timed in such a burst: the same window's probe
+  showed ~36 GB/s on plain bf16 matmuls too.
+- The w4a16 kernel is NOT the int4 bottleneck: in-jit chains measure
+  bf16 3.2 / int8 3.4 / int4 3.9 ms per [64,4096]x[4096,14336] matmul
+  in the same window — int4 within 1.2x of bf16.
+- At the quiet-window 213 GB/s, the int4 rung's 4.64 GiB weight read
+  prices a 64-deep decode step at ~22 ms -> ~2900 tok/s/chip, above
+  the 2000 north star. Hence ``_wait_for_quiet``: scoring now polls
+  the probe (up to 5 min) for a >=100 GB/s window and records the
+  final probe value in the JSON (``chip_bw_probe_gbs``) so every score
+  carries its congestion context.
 """
 
 from __future__ import annotations
@@ -74,6 +92,52 @@ os.environ.setdefault("HF_HUB_OFFLINE", "1")
 os.environ.setdefault("VLLM_TPU_STEP_TIMING", "1")
 
 BASELINE_TOK_S_PER_CHIP = 2000.0
+
+
+def _bw_probe() -> float:
+    """Effective HBM bandwidth (GB/s) of a 16-deep in-jit matmul chain —
+    a CONGESTION INDEX for the shared chip. Round-4 measurements: the
+    same probe reads 213 GB/s in a quiet window and 15 GB/s under a
+    co-tenant burst (14x); a throughput score taken in a congested
+    window says nothing about the framework. Recorded in the JSON and
+    used to wait for a quiet window before scoring."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    k, n, reps = 2048, 8192, 16
+    rng = np.random.default_rng(0)
+    w = jnp.asarray(rng.standard_normal((k, n)), jnp.bfloat16) * 0.02
+    wd = jnp.asarray(rng.standard_normal((n, k)), jnp.bfloat16) * 0.02
+    x0 = jnp.asarray(rng.standard_normal((128, k)), jnp.bfloat16)
+
+    @jax.jit
+    def chain(x):
+        def body(i, x):
+            return ((x @ w) @ wd * 1e-3).astype(jnp.bfloat16)
+        return jax.lax.fori_loop(0, reps, body, x)
+
+    chain(x0).block_until_ready()
+    t0 = time.monotonic()
+    for _ in range(2):
+        chain(x0).block_until_ready()
+    dt = (time.monotonic() - t0) / (2 * reps * 2)
+    return round((k * n * 2) / dt / 1e9, 1)
+
+
+def _wait_for_quiet(min_gbs: float = 100.0, max_wait_s: float = 300.0) -> float:
+    """Poll the congestion probe until the chip looks quiet (or the wait
+    budget runs out); returns the last probe value."""
+    deadline = time.monotonic() + max_wait_s
+    bw = _bw_probe()
+    while bw < min_gbs and time.monotonic() < deadline:
+        print(
+            f"[bench] chip congested ({bw} GB/s effective); waiting",
+            file=sys.stderr,
+        )
+        time.sleep(30)
+        bw = _bw_probe()
+    return bw
 # v5e per-chip peak: 197 TFLOP/s bf16, ~819 GB/s HBM.
 PEAK_FLOPS = {"TPU v5 lite": 197e12, "TPU v5e": 197e12,
               "TPU v4": 275e12, "TPU v6 lite": 918e12}
@@ -154,12 +218,14 @@ def main() -> None:
 
         failures: list[dict] = []
         for i, (shape, quant) in enumerate(ladder):
-            attempts = 2 if shape["hidden_size"] == 4096 else 1
+            attempts = 3 if shape["hidden_size"] == 4096 else 1
             for att in range(attempts):
                 if att:
                     # Tenant spikes on the shared chip decorrelate over
-                    # tens of seconds; don't burn the retry immediately.
-                    time.sleep(45)
+                    # tens of seconds to minutes (round-4 measurement: a
+                    # 15 GiB working set fits at 10:01, a 6 GiB one OOMs
+                    # at 10:12); don't burn the retries immediately.
+                    time.sleep(45 * att)
                 env = dict(os.environ, VLLM_TPU_BENCH_CONFIG=json.dumps(
                     [shape, quant]
                 ))
@@ -240,6 +306,12 @@ def main() -> None:
     # compiles every (tokens, reqs, blocks) bucket (the persistent
     # compilation cache makes the SECOND cold start skip even these).
     llm.generate(prompts, params)
+
+    # Score in a QUIET window when possible: co-tenant bursts depress
+    # the shared chip's effective bandwidth up to 14x (see _bw_probe).
+    bw_probe = None
+    if jax.default_backend() == "tpu":
+        bw_probe = _wait_for_quiet()
 
     try:
         # engine_core is an InprocClient wrapping the real EngineCore.
@@ -344,6 +416,7 @@ def main() -> None:
         "passes": passes,
         "median_value": rate(statistics.median(times)),
         "worst_pass_value": rate(max(times)),
+        **({"chip_bw_probe_gbs": bw_probe} if bw_probe is not None else {}),
         **extras,
         **({"ladder_failures": ladder_failures} if ladder_failures else {}),
     }))

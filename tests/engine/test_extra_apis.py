@@ -1,0 +1,344 @@
+"""OpenAI API tail: /v1/responses, /score, /v1/audio/transcriptions.
+
+Reference analog: ``vllm/entrypoints/openai/responses/``,
+``generative_scoring/``, ``speech_to_text/`` + their
+``tests/entrypoints`` coverage; here the aiohttp app runs in-proc.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import io
+import json
+import struct
+import wave
+
+import numpy as np
+import pytest
+
+from tests.models.utils import tiny_llama_dir_with_tokenizer
+from vllm_tpu.engine.arg_utils import AsyncEngineArgs
+from vllm_tpu.engine.async_llm import AsyncLLM
+
+
+@pytest.fixture(scope="module")
+def chat_engine(tmp_path_factory):
+    path = tiny_llama_dir_with_tokenizer(
+        tmp_path_factory.mktemp("tiny_llama_extra")
+    )
+    engine = AsyncLLM.from_engine_args(
+        AsyncEngineArgs(
+            model=path, dtype="float32", max_model_len=128, block_size=16,
+            num_gpu_blocks_override=64, max_num_seqs=8,
+            max_num_batched_tokens=128,
+        )
+    )
+    yield engine
+    engine.shutdown()
+
+
+def _client_run(engine, coro_fn):
+    from aiohttp.test_utils import TestClient, TestServer
+
+    from vllm_tpu.entrypoints.openai.api_server import build_app
+    from vllm_tpu.metrics.prometheus import PrometheusRegistry
+
+    async def run():
+        app = build_app(engine, "tiny-llama", PrometheusRegistry())
+        async with TestClient(TestServer(app)) as client:
+            return await coro_fn(client)
+
+    return asyncio.run(run())
+
+
+# ----------------------------------------------------------------------
+# /v1/responses
+# ----------------------------------------------------------------------
+
+def test_responses_basic(chat_engine):
+    async def go(client):
+        resp = await client.post("/v1/responses", json={
+            "model": "tiny-llama",
+            "input": "say abc",
+            "max_output_tokens": 6,
+            "temperature": 0.0,
+        })
+        assert resp.status == 200, await resp.text()
+        return await resp.json()
+
+    body = _client_run(chat_engine, go)
+    assert body["object"] == "response"
+    assert body["status"] == "completed"
+    assert body["output"][0]["role"] == "assistant"
+    part = body["output"][0]["content"][0]
+    assert part["type"] == "output_text"
+    assert isinstance(part["text"], str)
+    assert body["usage"]["output_tokens"] == 6
+
+
+def test_responses_structured_input(chat_engine):
+    async def go(client):
+        resp = await client.post("/v1/responses", json={
+            "model": "tiny-llama",
+            "instructions": "be terse",
+            "input": [
+                {"type": "message", "role": "user", "content": [
+                    {"type": "input_text", "text": "abc "},
+                    {"type": "input_text", "text": "def"},
+                ]},
+            ],
+            "max_output_tokens": 4,
+            "temperature": 0.0,
+        })
+        assert resp.status == 200, await resp.text()
+        return await resp.json()
+
+    body = _client_run(chat_engine, go)
+    assert body["status"] == "completed"
+
+
+def test_responses_streaming(chat_engine):
+    async def go(client):
+        resp = await client.post("/v1/responses", json={
+            "model": "tiny-llama",
+            "input": "abc",
+            "max_output_tokens": 5,
+            "temperature": 0.0,
+            "stream": True,
+        })
+        assert resp.status == 200
+        raw = (await resp.read()).decode()
+        return raw
+
+    raw = _client_run(chat_engine, go)
+    events = []
+    for block in raw.strip().split("\n\n"):
+        lines = dict(
+            ln.split(": ", 1) for ln in block.splitlines() if ": " in ln
+        )
+        if "event" in lines:
+            events.append((lines["event"], json.loads(lines["data"])))
+    kinds = [e for e, _ in events]
+    assert kinds[0] == "response.created"
+    assert kinds[-1] == "response.completed"
+    assert "response.output_text.delta" in kinds
+    final = events[-1][1]["response"]
+    deltas = "".join(
+        d["delta"] for e, d in events if e == "response.output_text.delta"
+    )
+    assert final["output"][0]["content"][0]["text"] == deltas
+    # Sequence numbers are strictly increasing.
+    seqs = [d["sequence_number"] for _, d in events]
+    assert seqs == sorted(seqs) and len(set(seqs)) == len(seqs)
+
+
+def test_responses_rejects_bad_input(chat_engine):
+    async def go(client):
+        r1 = await client.post("/v1/responses", json={"model": "m"})
+        r2 = await client.post("/v1/responses", json={
+            "input": [{"type": "reasoning"}],
+        })
+        r3 = await client.post("/v1/responses", json={
+            "input": "x", "previous_response_id": "resp_123",
+        })
+        return r1.status, r2.status, r3.status
+
+    assert _client_run(chat_engine, go) == (400, 400, 400)
+
+
+# ----------------------------------------------------------------------
+# /score
+# ----------------------------------------------------------------------
+
+def test_score_endpoint(chat_engine):
+    async def go(client):
+        resp = await client.post("/score", json={
+            "model": "tiny-llama",
+            "text_1": "abc def",
+            "text_2": ["abc def", "12345", "abc def"],
+        })
+        assert resp.status == 200, await resp.text()
+        return await resp.json()
+
+    body = _client_run(chat_engine, go)
+    scores = [d["score"] for d in body["data"]]
+    assert len(scores) == 3
+    # Identical texts embed identically (normalized): cosine == 1.
+    assert scores[0] == pytest.approx(1.0, abs=1e-4)
+    assert scores[2] == pytest.approx(1.0, abs=1e-4)
+    assert scores[1] < 1.0 - 1e-4
+
+
+def test_score_mismatched_lengths(chat_engine):
+    async def go(client):
+        resp = await client.post("/v1/score", json={
+            "text_1": ["a", "b"], "text_2": ["a", "b", "c"],
+        })
+        return resp.status
+
+    assert _client_run(chat_engine, go) == 400
+
+
+# ----------------------------------------------------------------------
+# /v1/audio/transcriptions
+# ----------------------------------------------------------------------
+
+def _wav_bytes(seconds: float = 0.5, rate: int = 16000) -> bytes:
+    t = np.arange(int(seconds * rate)) / rate
+    tone = (0.3 * np.sin(2 * np.pi * 440 * t) * 32767).astype(np.int16)
+    buf = io.BytesIO()
+    with wave.open(buf, "wb") as w:
+        w.setnchannels(1)
+        w.setsampwidth(2)
+        w.setframerate(rate)
+        w.writeframes(tone.tobytes())
+    return buf.getvalue()
+
+
+@pytest.fixture(scope="module")
+def whisper_engine(tmp_path_factory):
+    import torch
+    from transformers import WhisperForConditionalGeneration
+
+    from tests.models.test_whisper import tiny_whisper_config
+    from tests.models.utils import tiny_tokenizer
+
+    torch.manual_seed(0)
+    # Feature-extractor-shaped source window: 80 mel bins, 3000 frames
+    # (0.5 s of audio covers 50 frames; the rest is the padded window).
+    cfg = tiny_whisper_config(num_mel_bins=80, max_source_positions=1500)
+    model = WhisperForConditionalGeneration(cfg).to(torch.float32)
+    path = tmp_path_factory.mktemp("tiny_whisper_api")
+    model.save_pretrained(str(path), safe_serialization=True)
+    tiny_tokenizer().save_pretrained(str(path))
+    engine = AsyncLLM.from_engine_args(
+        AsyncEngineArgs(
+            model=str(path), dtype="float32", max_model_len=64,
+            block_size=16, num_gpu_blocks_override=32, max_num_seqs=4,
+            max_num_batched_tokens=64,
+        )
+    )
+    yield engine
+    engine.shutdown()
+
+
+def test_transcriptions_endpoint(whisper_engine):
+    import aiohttp
+
+    async def go(client):
+        form = aiohttp.FormData()
+        form.add_field("file", _wav_bytes(), filename="a.wav",
+                       content_type="audio/wav")
+        form.add_field("model", "tiny-whisper")
+        resp = await client.post("/v1/audio/transcriptions", data=form)
+        assert resp.status == 200, await resp.text()
+        return await resp.json()
+
+    body = _client_run(whisper_engine, go)
+    assert "text" in body
+    assert isinstance(body["text"], str)
+
+
+def test_transcriptions_text_format(whisper_engine):
+    import aiohttp
+
+    async def go(client):
+        form = aiohttp.FormData()
+        form.add_field("file", _wav_bytes(0.3), filename="b.wav",
+                       content_type="audio/wav")
+        form.add_field("response_format", "text")
+        resp = await client.post("/v1/audio/translations", data=form)
+        assert resp.status == 200
+        assert resp.content_type == "text/plain"
+        return await resp.text()
+
+    text = _client_run(whisper_engine, go)
+    assert isinstance(text, str)
+
+
+def test_transcriptions_rejects_non_audio_model(chat_engine):
+    async def go(client):
+        resp = await client.post(
+            "/v1/audio/transcriptions", data=b"RIFFxxxx"
+        )
+        return resp.status
+
+    assert _client_run(chat_engine, go) == 400
+
+
+def test_transcriptions_rejects_bad_wav(whisper_engine):
+    async def go(client):
+        resp = await client.post(
+            "/v1/audio/transcriptions", data=b"not a wav file"
+        )
+        return resp.status
+
+    assert _client_run(whisper_engine, go) == 400
+
+
+# ----------------------------------------------------------------------
+# /v1/realtime (websocket)
+# ----------------------------------------------------------------------
+
+def test_realtime_session(chat_engine):
+    async def go(client):
+        events = []
+        async with client.ws_connect("/v1/realtime") as ws:
+            events.append(await ws.receive_json())  # session.created
+
+            await ws.send_json({
+                "type": "session.update",
+                "session": {"instructions": "be brief",
+                            "temperature": 0.0,
+                            "max_response_output_tokens": 5},
+            })
+            events.append(await ws.receive_json())  # session.updated
+
+            await ws.send_json({
+                "type": "conversation.item.create",
+                "item": {
+                    "type": "message", "role": "user",
+                    "content": [{"type": "input_text", "text": "abc"}],
+                },
+            })
+            events.append(await ws.receive_json())  # item.created
+
+            await ws.send_json({"type": "response.create"})
+            while True:
+                ev = await ws.receive_json()
+                events.append(ev)
+                if ev["type"] == "response.done":
+                    break
+        return events
+
+    events = _client_run(chat_engine, go)
+    kinds = [e["type"] for e in events]
+    assert kinds[0] == "session.created"
+    assert kinds[1] == "session.updated"
+    assert events[1]["session"]["instructions"] == "be brief"
+    assert kinds[2] == "conversation.item.created"
+    assert "response.created" in kinds
+    assert "response.text.delta" in kinds
+    assert kinds[-1] == "response.done"
+    done = events[-1]["response"]
+    assert done["status"] == "completed"
+    deltas = "".join(
+        e["delta"] for e in events if e["type"] == "response.text.delta"
+    )
+    assert done["output"][0]["content"][0]["text"] == deltas
+    assert done["usage"]["output_tokens"] == 5
+
+
+def test_realtime_rejects_audio_modality(chat_engine):
+    async def go(client):
+        async with client.ws_connect("/v1/realtime") as ws:
+            await ws.receive_json()  # session.created
+            await ws.send_json({
+                "type": "session.update",
+                "session": {"modalities": ["audio", "text"]},
+            })
+            return await ws.receive_json()
+
+    ev = _client_run(chat_engine, go)
+    assert ev["type"] == "error"
+    assert "text" in ev["error"]["message"]

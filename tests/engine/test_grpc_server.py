@@ -62,12 +62,12 @@ def _ident(b: bytes) -> bytes:
 def test_grpc_health_and_models(grpc_addr):
     with grpc.insecure_channel(grpc_addr) as ch:
         health = ch.unary_unary(
-            "/vllmtpu.LLM/Health", request_serializer=_ident,
+            "/vllmtpu.LLMJson/Health", request_serializer=_ident,
             response_deserializer=_ident,
         )
         assert json.loads(health(b"{}"))["status"] == "SERVING"
         models = ch.unary_unary(
-            "/vllmtpu.LLM/Models", request_serializer=_ident,
+            "/vllmtpu.LLMJson/Models", request_serializer=_ident,
             response_deserializer=_ident,
         )
         assert len(json.loads(models(b"{}"))["models"]) == 1
@@ -76,7 +76,7 @@ def test_grpc_health_and_models(grpc_addr):
 def test_grpc_generate_stream(grpc_addr):
     with grpc.insecure_channel(grpc_addr) as ch:
         gen = ch.unary_stream(
-            "/vllmtpu.LLM/Generate", request_serializer=_ident,
+            "/vllmtpu.LLMJson/Generate", request_serializer=_ident,
             response_deserializer=_ident,
         )
         req = {
@@ -96,7 +96,7 @@ def test_grpc_generate_stream(grpc_addr):
 def test_grpc_bad_request_is_invalid_argument(grpc_addr):
     with grpc.insecure_channel(grpc_addr) as ch:
         gen = ch.unary_stream(
-            "/vllmtpu.LLM/Generate", request_serializer=_ident,
+            "/vllmtpu.LLMJson/Generate", request_serializer=_ident,
             response_deserializer=_ident,
         )
         with pytest.raises(grpc.RpcError) as err:
@@ -105,3 +105,82 @@ def test_grpc_bad_request_is_invalid_argument(grpc_addr):
                 "sampling_params": {"definitely_not_a_knob": 1},
             }).encode()))
         assert err.value.code() == grpc.StatusCode.INVALID_ARGUMENT
+
+
+# ----------------------------------------------------------------------
+# Typed protobuf service (canonical /vllmtpu.LLM, proto/llm.proto stubs)
+# ----------------------------------------------------------------------
+
+def test_typed_health_and_models(grpc_addr):
+    from vllm_tpu.entrypoints.proto import llm_pb2
+    from vllm_tpu.entrypoints.proto.llm_pb2_grpc import LLMStub
+
+    with grpc.insecure_channel(grpc_addr) as ch:
+        stub = LLMStub(ch)
+        assert stub.Health(llm_pb2.HealthRequest()).status == "SERVING"
+        models = stub.Models(llm_pb2.ModelsRequest()).models
+        assert len(models) == 1
+
+
+def test_typed_generate_stream(grpc_addr):
+    from vllm_tpu.entrypoints.proto import llm_pb2
+    from vllm_tpu.entrypoints.proto.llm_pb2_grpc import LLMStub
+
+    req = llm_pb2.GenerateRequest(
+        prompt_token_ids=[3, 5, 7, 11],
+        request_id="typed-1",
+        sampling_params=llm_pb2.SamplingParamsProto(
+            temperature=0.0, max_tokens=6, ignore_eos=True,
+        ),
+    )
+    with grpc.insecure_channel(grpc_addr) as ch:
+        stub = LLMStub(ch)
+        tokens = []
+        finished = False
+        for resp in stub.Generate(req):
+            assert resp.request_id == "typed-1"
+            tokens.extend(resp.token_ids)
+            finished = resp.finished
+        assert finished and len(tokens) == 6
+
+
+def test_typed_matches_json(grpc_addr):
+    """Same request through the typed and JSON services -> same tokens."""
+    from vllm_tpu.entrypoints.proto import llm_pb2
+    from vllm_tpu.entrypoints.proto.llm_pb2_grpc import LLMStub
+
+    with grpc.insecure_channel(grpc_addr) as ch:
+        stub = LLMStub(ch)
+        typed = []
+        for resp in stub.Generate(llm_pb2.GenerateRequest(
+            prompt_token_ids=[2, 4, 6],
+            sampling_params=llm_pb2.SamplingParamsProto(
+                temperature=0.0, max_tokens=5, ignore_eos=True,
+            ),
+        )):
+            typed.extend(resp.token_ids)
+
+        gen = ch.unary_stream(
+            "/vllmtpu.LLMJson/Generate", request_serializer=_ident,
+            response_deserializer=_ident,
+        )
+        js = []
+        for raw in gen(json.dumps({
+            "prompt_token_ids": [2, 4, 6],
+            "sampling_params": {
+                "temperature": 0.0, "max_tokens": 5, "ignore_eos": True,
+            },
+        }).encode()):
+            js.extend(json.loads(raw)["token_ids"])
+    assert typed == js
+
+
+def test_typed_rejects_empty_prompt(grpc_addr):
+    from vllm_tpu.entrypoints.proto import llm_pb2
+    from vllm_tpu.entrypoints.proto.llm_pb2_grpc import LLMStub
+
+    with grpc.insecure_channel(grpc_addr) as ch:
+        stub = LLMStub(ch)
+        with pytest.raises(grpc.RpcError) as exc:
+            list(stub.Generate(llm_pb2.GenerateRequest()))
+        assert exc.value.code() == grpc.StatusCode.INVALID_ARGUMENT

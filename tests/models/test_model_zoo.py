@@ -85,11 +85,152 @@ def make_gemma3(tmp_path_factory):
     return _save(tmp_path_factory, "tiny_gemma3", HFG3(cfg))
 
 
+def make_cohere(tmp_path_factory):
+    import torch
+    from transformers import CohereConfig, CohereForCausalLM
+
+    torch.manual_seed(4)
+    cfg = CohereConfig(
+        vocab_size=128, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=256, logit_scale=0.25,
+        use_qk_norm=False, tie_word_embeddings=True,
+    )
+    return _save(tmp_path_factory, "tiny_cohere", CohereForCausalLM(cfg))
+
+
+def make_olmo(tmp_path_factory):
+    import torch
+    from transformers import OlmoConfig, OlmoForCausalLM
+
+    torch.manual_seed(5)
+    cfg = OlmoConfig(
+        vocab_size=128, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=256, clip_qkv=0.5,
+        tie_word_embeddings=False,
+    )
+    return _save(tmp_path_factory, "tiny_olmo", OlmoForCausalLM(cfg))
+
+
+def make_glm(tmp_path_factory):
+    import torch
+    from transformers import GlmConfig, GlmForCausalLM
+
+    torch.manual_seed(6)
+    cfg = GlmConfig(
+        vocab_size=128, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        head_dim=16, partial_rotary_factor=0.5,
+        max_position_embeddings=256, attention_bias=True,
+        tie_word_embeddings=False, pad_token_id=0,
+    )
+    return _save(tmp_path_factory, "tiny_glm", GlmForCausalLM(cfg))
+
+
+def make_nemotron(tmp_path_factory):
+    import torch
+    from transformers import NemotronConfig, NemotronForCausalLM
+
+    torch.manual_seed(7)
+    cfg = NemotronConfig(
+        vocab_size=128, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        partial_rotary_factor=0.5, max_position_embeddings=256,
+        norm_eps=1e-5, tie_word_embeddings=False,
+    )
+    return _save(tmp_path_factory, "tiny_nemotron", NemotronForCausalLM(cfg))
+
+
+def make_starcoder2(tmp_path_factory):
+    import torch
+    from transformers import Starcoder2Config, Starcoder2ForCausalLM
+
+    torch.manual_seed(8)
+    cfg = Starcoder2Config(
+        vocab_size=128, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=256, use_bias=True, sliding_window=None,
+        tie_word_embeddings=True,
+    )
+    return _save(
+        tmp_path_factory, "tiny_starcoder2", Starcoder2ForCausalLM(cfg)
+    )
+
+
+def make_gptj(tmp_path_factory):
+    import torch
+    from transformers import GPTJConfig, GPTJForCausalLM
+
+    torch.manual_seed(9)
+    cfg = GPTJConfig(
+        vocab_size=128, n_embd=64, n_inner=128, n_layer=2, n_head=4,
+        rotary_dim=8, n_positions=256, tie_word_embeddings=False,
+    )
+    return _save(tmp_path_factory, "tiny_gptj", GPTJForCausalLM(cfg))
+
+
+def make_olmoe(tmp_path_factory):
+    import torch
+    from transformers import OlmoeConfig, OlmoeForCausalLM
+
+    torch.manual_seed(10)
+    cfg = OlmoeConfig(
+        vocab_size=128, hidden_size=64, intermediate_size=96,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        num_experts=4, num_experts_per_tok=2, norm_topk_prob=False,
+        max_position_embeddings=256, tie_word_embeddings=False,
+    )
+    return _save(tmp_path_factory, "tiny_olmoe", OlmoeForCausalLM(cfg))
+
+
+def make_granitemoe(tmp_path_factory):
+    import torch
+    from transformers import GraniteMoeConfig, GraniteMoeForCausalLM
+
+    torch.manual_seed(11)
+    cfg = GraniteMoeConfig(
+        vocab_size=128, hidden_size=64, intermediate_size=96,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        num_local_experts=4, num_experts_per_tok=2,
+        max_position_embeddings=256, tie_word_embeddings=False,
+        embedding_multiplier=2.0, residual_multiplier=0.5,
+        logits_scaling=2.0, attention_multiplier=0.3,
+    )
+    return _save(
+        tmp_path_factory, "tiny_granitemoe", GraniteMoeForCausalLM(cfg)
+    )
+
+
+def make_dbrx(tmp_path_factory):
+    import torch
+    from transformers import DbrxConfig, DbrxForCausalLM
+
+    torch.manual_seed(12)
+    cfg = DbrxConfig(
+        d_model=64, n_heads=4, n_layers=2, max_seq_len=256, vocab_size=128,
+        ffn_config={"ffn_hidden_size": 96, "moe_num_experts": 4,
+                    "moe_top_k": 2},
+        attn_config={"kv_n_heads": 2, "clip_qkv": 8.0},
+        tie_word_embeddings=False,
+    )
+    return _save(tmp_path_factory, "tiny_dbrx", DbrxForCausalLM(cfg))
+
+
 MAKERS = {
     "qwen3": make_qwen3,
     "qwen3_moe": make_qwen3_moe,
     "gemma2": make_gemma2,
     "gemma3": make_gemma3,
+    "cohere": make_cohere,
+    "olmo": make_olmo,
+    "glm": make_glm,
+    "nemotron": make_nemotron,
+    "starcoder2": make_starcoder2,
+    "gptj": make_gptj,
+    "olmoe": make_olmoe,
+    "granitemoe": make_granitemoe,
+    "dbrx": make_dbrx,
 }
 
 

@@ -13,7 +13,10 @@ Reference analog: ``vllm/distributed/device_communicators/all2all.py:40``.
 
 from __future__ import annotations
 
+import os
 import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import numpy as np
 

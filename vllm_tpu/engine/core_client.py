@@ -87,6 +87,12 @@ class InprocClient:
     def update_weights(self, path: str) -> bool:
         return self.engine_core.update_weights(path)
 
+    def reinitialize_distributed(self, new_tp: int) -> bool:
+        return self.engine_core.reinitialize_distributed(new_tp)
+
+    def save_sharded_state(self, path: str) -> bool:
+        return self.engine_core.save_sharded_state(path)
+
     def add_lora(self, name: str, path: str) -> bool:
         return self.engine_core.add_lora(name, path)
 
@@ -237,6 +243,15 @@ class _ZMQClientBase:
 
     def update_weights(self, path: str) -> bool:
         return self._utility("update_weights", path)
+
+    def reinitialize_distributed(self, new_tp: int) -> bool:
+        # Weight resharding + runner rebuild + bucket recompiles.
+        return self._utility(
+            "reinitialize_distributed", new_tp, timeout_ms=600_000
+        )
+
+    def save_sharded_state(self, path: str) -> bool:
+        return self._utility("save_sharded_state", path, timeout_ms=600_000)
 
     def add_lora(self, name: str, path: str) -> bool:
         return self._utility("add_lora", name, path)

@@ -43,6 +43,9 @@ class EngineCore:
 
             scheduler_cls = AsyncScheduler
         self._inflight: deque = deque()
+        # Outputs finalized outside step() (elastic-resize drain) waiting
+        # for the next step() call to deliver them.
+        self._drained_outputs: deque = deque()
         self._max_inflight = (
             min(
                 config.scheduler_config.async_pipeline_depth,
@@ -150,6 +153,10 @@ class EngineCore:
         step overlaps the next step's compute (reference
         ``step_with_batch_queue`` core.py:443 + AsyncScheduler).
         """
+        if self._drained_outputs:
+            # Tokens finalized during an elastic-resize drain: deliver
+            # before any new work.
+            return self._drained_outputs.popleft()
         if self.kv_connector is not None:
             # Persist freed requests' blocks BEFORE any new scheduling can
             # hand those blocks to someone else (in-flight steps were
@@ -236,6 +243,55 @@ class EngineCore:
 
     def is_sleeping(self) -> bool:
         return getattr(self, "_asleep", False)
+
+    def save_sharded_state(self, path: str) -> bool:
+        """Dump the assembled weights for fast reload (reference:
+        ``save_sharded_state`` gpu_worker.py:939)."""
+        self.executor.collective_rpc("save_sharded_state", path)
+        return True
+
+    def reinitialize_distributed(self, new_tp: int) -> bool:
+        """Elastic EP: resize the tp/ep world at runtime (reference:
+        ``EngineCore.reinitialize_distributed`` core.py:1865 +
+        ``vllm/distributed/elastic_ep/``).
+
+        Serving pauses for the re-mesh: in-flight steps drain (their
+        executables belong to the old mesh), running requests are
+        preempted (KV content does not survive the resize), the prefix
+        cache resets, and the worker reshards weights over the new mesh
+        and rebuilds its runner. Preempted requests resume from their
+        token ids on the next step — nothing is aborted.
+        """
+        assert not getattr(self, "_asleep", False), (
+            "cannot resize a sleeping engine; wake_up first"
+        )
+        # Drain in-flight handles WITHOUT scheduling new work (step()
+        # would keep refilling the pipeline while requests are active
+        # and never converge). Outputs produced here are buffered and
+        # returned by the next step() calls — tokens must not be lost.
+        while self._inflight:
+            scheduler_output, handle = self._inflight.popleft()
+            runner_output = self.executor.finalize(handle)
+            outputs = self.scheduler.update_from_output(
+                scheduler_output, runner_output
+            )
+            if outputs.outputs:
+                self._drained_outputs.append(outputs)
+        if self.kv_connector is not None:
+            # Pending external saves read KV payloads by block id — they
+            # must flush BEFORE the re-mesh discards the cache content.
+            saves = self.scheduler.take_pending_kv_saves()
+            if saves:
+                self.executor.collective_rpc("kv_connector_save", saves)
+        sched = self.scheduler
+        # Reversed so the per-victim prepend restores FCFS order in the
+        # waiting queue.
+        for request in reversed(sched.running):
+            sched._preempt(request)
+        sched.running.clear()
+        self.reset_prefix_cache()
+        self.executor.collective_rpc("reinitialize_parallel", new_tp)
+        return True
 
     def update_weights(self, path: str) -> bool:
         assert not self.scheduler.has_unfinished_requests(), (

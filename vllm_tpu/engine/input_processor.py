@@ -21,6 +21,13 @@ PromptType = Union[str, dict]
 
 
 def get_tokenizer(model_config) -> Any:
+    from vllm_tpu.utils.tekken import load_tekken_if_present
+
+    tekken = load_tekken_if_present(model_config.tokenizer)
+    if tekken is not None:
+        # Mistral-family checkpoint shipping only tekken.json — the
+        # self-contained reader (no mistral_common in the image).
+        return tekken
     from transformers import AutoTokenizer
 
     return AutoTokenizer.from_pretrained(
@@ -50,7 +57,13 @@ class InputProcessor:
             if getattr(cls, "is_encoder_decoder", False):
                 self._encdec_info_cache = dict(
                     decoder_start_token_id=hf_config.decoder_start_token_id,
-                    max_encoder_len=hf_config.max_position_embeddings,
+                    max_encoder_len=getattr(
+                        hf_config, "max_position_embeddings", None
+                    ) or hf_config.max_source_positions,
+                    # Whisper-class: the prompt is DECODER-side; audio
+                    # features arrive via multi_modal_data["audio"].
+                    audio=getattr(cls, "audio_encoder_decoder", False),
+                    num_mel_bins=getattr(hf_config, "num_mel_bins", None),
                 )
             else:
                 self._encdec_info_cache = {}
@@ -122,7 +135,39 @@ class InputProcessor:
 
         mm_inputs = None
         encdec = self._encdec_info()
-        if encdec is not None:
+        if encdec is not None and encdec.get("audio"):
+            # Whisper-class audio encoder-decoder: the prompt IS the
+            # decoder prompt (forced decoder ids); the mel features ride
+            # the encoder-input plumbing via multi_modal_data["audio"].
+            from vllm_tpu.multimodal import MMInput
+
+            mm_data = (
+                prompt.get("multi_modal_data")
+                if isinstance(prompt, dict) else None
+            ) or {}
+            audio = mm_data.get("audio")
+            if audio is None:
+                raise ValueError(
+                    "audio encoder-decoder model needs "
+                    'multi_modal_data={"audio": mel_features}'
+                )
+            import numpy as np
+
+            feats = np.asarray(audio, np.float32)
+            mels = encdec.get("num_mel_bins")
+            if feats.ndim != 2:
+                raise ValueError(
+                    f"audio features must be 2-D mel frames, got "
+                    f"shape {feats.shape}"
+                )
+            if mels and feats.shape[0] == mels and feats.shape[1] != mels:
+                feats = feats.T  # HF [n_mels, frames] -> [frames, n_mels]
+            if not prompt_token_ids:
+                prompt_token_ids = [encdec["decoder_start_token_id"]]
+            mm_inputs = [MMInput(
+                offset=0, num_tokens=1, encoder_features=feats,
+            )]
+        elif encdec is not None:
             # Encoder-decoder model: the user's prompt is the ENCODER
             # input; generation happens decoder-side from the start
             # token. The encoder tokens ride the encoder-input plumbing
@@ -139,7 +184,11 @@ class InputProcessor:
                 encoder_token_ids=list(prompt_token_ids),
             )]
             prompt_token_ids = [encdec["decoder_start_token_id"]]
-        mm_data = prompt.get("multi_modal_data") if isinstance(prompt, dict) else None
+        mm_data = (
+            prompt.get("multi_modal_data")
+            if isinstance(prompt, dict) and encdec is None
+            else None
+        )
         if mm_data:
             from vllm_tpu.multimodal import expand_mm_prompt
 

@@ -2,22 +2,19 @@
 
 Reference analog: ``vllm/entrypoints/grpc_server.py`` (an AsyncLLM-backed
 gRPC service; the reference delegates its servicer to an optional
-package). This build is self-contained: the image carries ``grpcio`` but
-no protoc python plugin, so the service uses grpc GENERIC method handlers
-with JSON payloads — schema-light, language-neutral, and streaming.
+package). Two services on one port:
 
-Service ``vllmtpu.LLM``:
-
-- ``Generate`` (unary-stream): request ``{"prompt": str |
-  "prompt_token_ids": [int], "sampling_params": {...SamplingParams
-  fields}, "request_id": str?}``; streams ``{"request_id", "text",
-  "token_ids", "finished", "finish_reason"}`` deltas.
-- ``Health`` (unary-unary): ``{}`` -> ``{"status": "SERVING"}``.
-- ``Models`` (unary-unary): ``{}`` -> ``{"models": [name]}``.
+- ``vllmtpu.LLM`` — the canonical TYPED protobuf service. Schema:
+  ``entrypoints/proto/llm.proto`` (committed python stubs alongside;
+  other languages run protoc on the same file). ``Generate``
+  (unary-stream), ``Health``, ``Models``.
+- ``vllmtpu.LLMJson`` — legacy JSON-over-generic-handlers variant for
+  schema-light clients: same methods with JSON-encoded bytes, request
+  ``{"prompt": str | "prompt_token_ids": [int], "sampling_params":
+  {...SamplingParams fields}, "request_id": str?}``.
 
 Usage: ``python -m vllm_tpu.entrypoints.grpc_server --model ... --port
-50051``; call with any gRPC client via method paths like
-``/vllmtpu.LLM/Generate`` using JSON-encoded bytes.
+50051``.
 """
 
 from __future__ import annotations
@@ -52,7 +49,81 @@ def _build_sampling_params(spec: dict) -> SamplingParams:
     return SamplingParams(**spec)
 
 
+def _params_from_proto(sp) -> SamplingParams:
+    kw: dict = {}
+    # Explicit-presence fields ('optional' in the proto): zero is a
+    # meaningful value (temperature=0 -> greedy), so presence gates.
+    for field in ("temperature", "top_p", "top_k", "min_p", "max_tokens",
+                  "presence_penalty", "frequency_penalty",
+                  "repetition_penalty", "seed"):
+        if sp.HasField(field):
+            kw[field] = getattr(sp, field)
+    if sp.stop:
+        kw["stop"] = list(sp.stop)
+    if sp.ignore_eos:
+        kw["ignore_eos"] = True
+    if sp.min_tokens:
+        kw["min_tokens"] = sp.min_tokens
+    if sp.logprobs:
+        kw["logprobs"] = sp.logprobs
+    return SamplingParams(**kw)
+
+
 def make_server(engine, model_name: str) -> grpc.aio.Server:
+    from vllm_tpu.entrypoints.proto import llm_pb2
+    from vllm_tpu.entrypoints.proto.llm_pb2_grpc import (
+        LLMServicer,
+        add_LLMServicer_to_server,
+    )
+
+    # Canonical TYPED service ``vllmtpu.LLM`` (proto stubs in
+    # ``entrypoints/proto/``): any language's protoc-generated client
+    # interoperates.
+    class Servicer(LLMServicer):
+        async def Generate(self, request, context):
+            if request.prompt_token_ids:
+                prompt = {
+                    "prompt_token_ids": list(request.prompt_token_ids)
+                }
+            elif request.prompt:
+                prompt = request.prompt
+            else:
+                await context.abort(
+                    grpc.StatusCode.INVALID_ARGUMENT,
+                    "one of prompt / prompt_token_ids is required",
+                )
+                return
+            try:
+                params = _params_from_proto(request.sampling_params)
+            except (TypeError, ValueError) as exc:
+                await context.abort(
+                    grpc.StatusCode.INVALID_ARGUMENT, str(exc)
+                )
+                return
+            rid = request.request_id or f"grpc-{uuid.uuid4().hex[:16]}"
+            sent_text = sent_tok = 0
+            async for out in engine.generate(prompt, params, rid):
+                comp = out.outputs[0]
+                yield llm_pb2.GenerateResponse(
+                    request_id=rid,
+                    text=comp.text[sent_text:],
+                    token_ids=list(comp.token_ids[sent_tok:]),
+                    finished=out.finished,
+                    finish_reason=comp.finish_reason or "",
+                )
+                sent_text = len(comp.text)
+                sent_tok = len(comp.token_ids)
+
+        async def Health(self, request, context):
+            return llm_pb2.HealthResponse(status="SERVING")
+
+        async def Models(self, request, context):
+            return llm_pb2.ModelsResponse(models=[model_name])
+
+    # JSON-over-generic-handlers service for schema-light clients.
+    # NOTE: this service MOVED from ``vllmtpu.LLM`` to ``vllmtpu.LLMJson``
+    # when the typed protobuf service took the canonical name — JSON
+    # callers must update their method paths.
     async def generate(request: bytes, context):
         try:
             req = json.loads(request)
@@ -88,7 +159,7 @@ def make_server(engine, model_name: str) -> grpc.aio.Server:
         return _dumps({"models": [model_name]})
 
     ident = lambda b: b  # JSON bytes in/out; no protobuf schema
-    handlers = grpc.method_handlers_generic_handler(_SERVICE, {
+    handlers = grpc.method_handlers_generic_handler(_SERVICE + "Json", {
         "Generate": grpc.unary_stream_rpc_method_handler(
             generate, request_deserializer=ident, response_serializer=ident
         ),
@@ -100,6 +171,7 @@ def make_server(engine, model_name: str) -> grpc.aio.Server:
         ),
     })
     server = grpc.aio.server()
+    add_LLMServicer_to_server(Servicer(), server)
     server.add_generic_rpc_handlers((handlers,))
     return server
 

@@ -75,6 +75,18 @@ class LLM:
     def update_weights(self, path: str) -> bool:
         return self.llm_engine.engine_core.update_weights(path)
 
+    def reinitialize_distributed(self, new_tp: int) -> bool:
+        """Elastic EP: resize the tp/ep world at runtime (reference:
+        ``vllm/distributed/elastic_ep/``). In-flight requests are
+        preempted and resume on the new mesh."""
+        return self.llm_engine.engine_core.reinitialize_distributed(new_tp)
+
+    def save_sharded_state(self, path: str) -> bool:
+        """Dump assembled weights for fast reload; the directory becomes
+        a self-contained ``model=`` path (reference: save_sharded_state
+        ``gpu_worker.py:939``)."""
+        return self.llm_engine.engine_core.save_sharded_state(path)
+
     # ------------------------------------------------------------------
 
     def add_lora(self, name: str, path: str) -> bool:

@@ -552,6 +552,20 @@ def build_app(engine: AsyncLLM, model_name: str, metrics=None,
     app.router.add_get("/health", handle_health)
     app.router.add_get("/ping", handle_health)
     app.router.add_get("/metrics", handle_metrics)
+    from vllm_tpu.entrypoints.openai.extra_apis import (
+        handle_realtime,
+        handle_responses,
+        handle_score,
+        handle_transcriptions,
+        handle_translations,
+    )
+
+    app.router.add_post("/v1/responses", handle_responses)
+    app.router.add_post("/score", handle_score)
+    app.router.add_post("/v1/score", handle_score)
+    app.router.add_post("/v1/audio/transcriptions", handle_transcriptions)
+    app.router.add_post("/v1/audio/translations", handle_translations)
+    app.router.add_get("/v1/realtime", handle_realtime)
     return app
 
 

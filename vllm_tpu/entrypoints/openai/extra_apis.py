@@ -1,0 +1,631 @@
+"""OpenAI API surface tail: Responses API, scoring, speech-to-text.
+
+Reference analog: ``vllm/entrypoints/openai/responses/``,
+``generative_scoring/`` (the /score route) and ``speech_to_text/``
+(transcriptions/translations backed by Whisper-class models).
+"""
+
+from __future__ import annotations
+
+import io
+import json
+import time
+import uuid
+from typing import Any
+
+import numpy as np
+from aiohttp import web
+
+
+def _now() -> int:
+    return int(time.time())
+
+
+def _rid(prefix: str) -> str:
+    return f"{prefix}_{uuid.uuid4().hex[:24]}"
+
+
+def _err(status: int, message: str) -> web.Response:
+    return web.json_response(
+        {"error": {"message": message, "type": "invalid_request_error"}},
+        status=status,
+    )
+
+
+# ----------------------------------------------------------------------
+# /v1/responses
+# ----------------------------------------------------------------------
+
+def _responses_to_messages(body: dict) -> list[dict]:
+    """OpenAI Responses ``input`` (+ ``instructions``) -> chat messages."""
+    messages: list[dict] = []
+    instructions = body.get("instructions")
+    if instructions:
+        messages.append({"role": "system", "content": instructions})
+    inp = body.get("input")
+    if inp is None:
+        raise ValueError("'input' is required")
+    if isinstance(inp, str):
+        messages.append({"role": "user", "content": inp})
+        return messages
+    if not isinstance(inp, list):
+        raise ValueError("'input' must be a string or a list of items")
+    for item in inp:
+        if not isinstance(item, dict):
+            raise ValueError("input items must be objects")
+        itype = item.get("type", "message")
+        if itype != "message":
+            raise ValueError(
+                f"unsupported input item type {itype!r} (message only)"
+            )
+        content = item.get("content")
+        if isinstance(content, list):
+            parts = []
+            for part in content:
+                ptype = part.get("type")
+                if ptype in ("input_text", "output_text", "text"):
+                    parts.append(part.get("text", ""))
+                else:
+                    raise ValueError(
+                        f"unsupported content part type {ptype!r}"
+                    )
+            content = "".join(parts)
+        messages.append({"role": item.get("role", "user"),
+                         "content": content or ""})
+    return messages
+
+
+def _response_object(
+    resp_id: str, model: str, text: str, status: str,
+    usage: dict | None = None,
+) -> dict:
+    return {
+        "id": resp_id,
+        "object": "response",
+        "created_at": _now(),
+        "status": status,
+        "model": model,
+        "output": [{
+            "type": "message",
+            "id": _rid("msg"),
+            "status": status,
+            "role": "assistant",
+            "content": [{
+                "type": "output_text", "text": text, "annotations": [],
+            }],
+        }],
+        "usage": usage or {},
+    }
+
+
+async def handle_responses(request: web.Request) -> web.StreamResponse:
+    from vllm_tpu.entrypoints.openai.api_server import (
+        ENGINE_KEY,
+        MODEL_KEY,
+        _sse_response,
+    )
+    from vllm_tpu.sampling_params import SamplingParams
+
+    engine = request.app[ENGINE_KEY]
+    try:
+        body = await request.json()
+    except json.JSONDecodeError:
+        return _err(400, "invalid JSON body")
+    if body.get("previous_response_id"):
+        return _err(400, "previous_response_id is not supported")
+    tokenizer = engine.tokenizer
+    if tokenizer is None:
+        return _err(400, "server has no tokenizer; responses API unavailable")
+    try:
+        messages = _responses_to_messages(body)
+        prompt_ids = tokenizer.apply_chat_template(
+            messages, add_generation_prompt=True
+        )
+    except (ValueError, TypeError) as e:
+        return _err(400, str(e))
+
+    from vllm_tpu.sampling_params import RequestOutputKind
+
+    params = SamplingParams(
+        temperature=float(body.get("temperature", 1.0)),
+        top_p=float(body.get("top_p", 1.0)),
+        max_tokens=int(body.get("max_output_tokens") or 1024),
+        # Streaming consumes per-event DELTAS; the default CUMULATIVE
+        # kind would re-send the whole prefix in every event.
+        output_kind=(
+            RequestOutputKind.DELTA if body.get("stream")
+            else RequestOutputKind.CUMULATIVE
+        ),
+    )
+    resp_id = _rid("resp")
+    model = body.get("model") or request.app[MODEL_KEY]
+    prompt = {"prompt_token_ids": list(prompt_ids)}
+
+    if body.get("stream"):
+        resp = _sse_response(request)
+        await resp.prepare(request)
+        seq = 0
+
+        async def emit(event: str, payload: dict) -> None:
+            nonlocal seq
+            payload = {"type": event, "sequence_number": seq, **payload}
+            seq += 1
+            await resp.write(
+                f"event: {event}\ndata: {json.dumps(payload)}\n\n".encode()
+            )
+
+        await emit("response.created", {
+            "response": _response_object(resp_id, model, "", "in_progress"),
+        })
+        text = ""
+        n_out = 0
+        try:
+            async for out in engine.generate(prompt, params, resp_id):
+                c = out.outputs[0]
+                if c.text:
+                    text += c.text
+                    await emit("response.output_text.delta", {
+                        "item_id": resp_id, "output_index": 0,
+                        "content_index": 0, "delta": c.text,
+                    })
+                n_out += len(c.token_ids)
+        except Exception as e:  # pragma: no cover - engine failure path
+            await emit("response.failed", {"error": {"message": str(e)}})
+            await resp.write_eof()
+            return resp
+        usage = {
+            "input_tokens": len(prompt_ids), "output_tokens": n_out,
+            "total_tokens": len(prompt_ids) + n_out,
+        }
+        await emit("response.completed", {
+            "response": _response_object(
+                resp_id, model, text, "completed", usage
+            ),
+        })
+        await resp.write_eof()
+        return resp
+
+    from vllm_tpu.entrypoints.openai.api_server import _collect
+
+    try:
+        final = await _collect(engine, prompt, params, resp_id)
+    except (ValueError, TypeError) as e:
+        return _err(400, str(e))
+    text = final.outputs[0].text or ""
+    n_out = len(final.outputs[0].token_ids)
+    usage = {
+        "input_tokens": len(prompt_ids), "output_tokens": n_out,
+        "total_tokens": len(prompt_ids) + n_out,
+    }
+    return web.json_response(
+        _response_object(resp_id, model, text, "completed", usage)
+    )
+
+
+# ----------------------------------------------------------------------
+# /score (embedding-similarity scoring)
+# ----------------------------------------------------------------------
+
+async def handle_score(request: web.Request) -> web.Response:
+    """Similarity scoring between text_1 and text_2 via the pooling path
+    (reference: vllm's /score API; embedding-model route)."""
+    import asyncio
+
+    from vllm_tpu.entrypoints.openai.api_server import ENGINE_KEY, MODEL_KEY
+    from vllm_tpu.sampling_params import PoolingParams, SamplingParams
+
+    engine = request.app[ENGINE_KEY]
+    try:
+        body = await request.json()
+    except json.JSONDecodeError:
+        return _err(400, "invalid JSON body")
+    t1 = body.get("text_1")
+    t2 = body.get("text_2")
+    if t1 is None or t2 is None:
+        return _err(400, "'text_1' and 'text_2' are required")
+    ones = [t1] if isinstance(t1, str) else list(t1)
+    twos = [t2] if isinstance(t2, str) else list(t2)
+    if len(ones) == 1 and len(twos) > 1:
+        ones = ones * len(twos)
+    if len(ones) != len(twos):
+        return _err(
+            400,
+            f"text_1 ({len(ones)}) and text_2 ({len(twos)}) must match "
+            "(or text_1 must be a single string)",
+        )
+
+    pooling = PoolingParams(pooling_type="last", normalize=True)
+
+    async def embed(text: str):
+        final = None
+        async for out in engine.generate(
+            text, SamplingParams(max_tokens=1), _rid("score"),
+            pooling_params=pooling,
+        ):
+            final = out
+        if final is None or final.pooled is None:
+            raise ValueError(
+                "model does not produce embeddings (scoring needs a "
+                "pooling model)"
+            )
+        return final
+
+    # Embed each UNIQUE text once (text_1 broadcast against a long
+    # text_2 list would otherwise re-embed the same prompt per pair).
+    unique = list(dict.fromkeys(ones + twos))
+    try:
+        finals = await asyncio.gather(*(embed(t) for t in unique))
+    except (ValueError, TypeError) as e:
+        return _err(400, str(e))
+    by_text = dict(zip(unique, finals))
+    total = sum(len(f.prompt_token_ids) for f in finals)
+    data = []
+    for i in range(len(ones)):
+        a = np.asarray(by_text[ones[i]].pooled, np.float32)
+        b = np.asarray(by_text[twos[i]].pooled, np.float32)
+        data.append({
+            "index": i, "object": "score", "score": float(a @ b),
+        })
+    return web.json_response({
+        "id": _rid("score"),
+        "object": "list",
+        "created": _now(),
+        "model": request.app[MODEL_KEY],
+        "data": data,
+        "usage": {"prompt_tokens": total, "total_tokens": total},
+    })
+
+
+# ----------------------------------------------------------------------
+# /v1/audio/transcriptions + /v1/audio/translations
+# ----------------------------------------------------------------------
+
+def _decode_wav(raw: bytes) -> tuple[np.ndarray, int]:
+    """WAV bytes -> (mono float32 [-1, 1], sample_rate). PCM 16/32-bit
+    and 32-bit float supported via the stdlib wave reader."""
+    import wave
+
+    with wave.open(io.BytesIO(raw), "rb") as w:
+        rate = w.getframerate()
+        n_ch = w.getnchannels()
+        width = w.getsampwidth()
+        frames = w.readframes(w.getnframes())
+    if width == 2:
+        audio = np.frombuffer(frames, np.int16).astype(np.float32) / 32768.0
+    elif width == 4:
+        # Could be int32 or float32; WAVE_FORMAT float files are rare
+        # through this path — treat as int32 PCM.
+        audio = (
+            np.frombuffer(frames, np.int32).astype(np.float32) / 2147483648.0
+        )
+    elif width == 1:
+        audio = (
+            np.frombuffer(frames, np.uint8).astype(np.float32) - 128.0
+        ) / 128.0
+    else:
+        raise ValueError(f"unsupported WAV sample width {width}")
+    if n_ch > 1:
+        audio = audio.reshape(-1, n_ch).mean(axis=1)
+    return audio, rate
+
+
+def _resample(audio: np.ndarray, rate: int, target: int) -> np.ndarray:
+    if rate == target:
+        return audio
+    n_out = int(round(len(audio) * target / rate))
+    x_old = np.linspace(0.0, 1.0, num=len(audio), endpoint=False)
+    x_new = np.linspace(0.0, 1.0, num=n_out, endpoint=False)
+    return np.interp(x_new, x_old, audio).astype(np.float32)
+
+
+def _whisper_prompt_ids(tokenizer, hf_config, language: str | None,
+                        task: str) -> list[int]:
+    """``<|startoftranscript|>[<|lang|>][<|task|>]<|notimestamps|>`` with
+    graceful degradation when the tokenizer lacks the special tokens."""
+    ids = [hf_config.decoder_start_token_id]
+    unk = getattr(tokenizer, "unk_token_id", None)
+
+    def tok(t: str) -> int | None:
+        try:
+            i = tokenizer.convert_tokens_to_ids(t)
+        except Exception:
+            return None
+        return None if i is None or i == unk else i
+
+    if language:
+        lang = tok(f"<|{language}|>")
+        if lang is not None:
+            ids.append(lang)
+    task_id = tok(f"<|{task}|>")
+    if task_id is not None:
+        ids.append(task_id)
+    nots = tok("<|notimestamps|>")
+    if nots is not None:
+        ids.append(nots)
+    return ids
+
+
+async def _handle_audio(request: web.Request, task: str) -> web.Response:
+    from vllm_tpu.entrypoints.openai.api_server import (
+        ENGINE_KEY,
+        MODEL_KEY,
+        _collect,
+    )
+    from vllm_tpu.sampling_params import SamplingParams
+
+    engine = request.app[ENGINE_KEY]
+    from vllm_tpu.worker.worker import load_hf_config
+
+    hf_config = load_hf_config(engine.config.model_config)
+    if not hasattr(hf_config, "num_mel_bins"):
+        return _err(
+            400, "the served model is not a speech-to-text model"
+        )
+    tokenizer = engine.tokenizer
+
+    raw = None
+    language = None
+    temperature = 0.0
+    response_format = "json"
+    if request.content_type and "multipart" in request.content_type:
+        reader = await request.multipart()
+        async for part in reader:
+            if part.name == "file":
+                raw = await part.read(decode=False)
+            elif part.name == "language":
+                language = (await part.text()).strip() or None
+            elif part.name == "temperature":
+                temperature = float(await part.text() or 0.0)
+            elif part.name == "response_format":
+                response_format = (await part.text()).strip() or "json"
+            else:
+                await part.read(decode=False)
+    else:
+        raw = await request.read()
+    if not raw:
+        return _err(400, "missing audio 'file'")
+
+    try:
+        audio, rate = _decode_wav(raw)
+    except Exception as e:
+        return _err(400, f"could not decode WAV audio: {e}")
+
+    from transformers import WhisperFeatureExtractor
+
+    extractor = WhisperFeatureExtractor(
+        feature_size=hf_config.num_mel_bins,
+        chunk_length=2 * hf_config.max_source_positions // 100,
+    )
+    audio = _resample(audio, rate, extractor.sampling_rate)
+    feats = extractor(
+        audio, sampling_rate=extractor.sampling_rate, return_tensors="np"
+    ).input_features[0]  # [n_mels, frames]
+
+    if tokenizer is not None:
+        prompt_ids = _whisper_prompt_ids(
+            tokenizer, hf_config, language, task
+        )
+    else:
+        prompt_ids = [hf_config.decoder_start_token_id]
+    params = SamplingParams(
+        temperature=temperature,
+        max_tokens=hf_config.max_target_positions - len(prompt_ids) - 1,
+    )
+    prompt = {
+        "prompt_token_ids": prompt_ids,
+        "multi_modal_data": {"audio": feats},
+    }
+    try:
+        final = await _collect(engine, prompt, params, _rid("transcribe"))
+    except (ValueError, TypeError) as e:
+        return _err(400, str(e))
+    out_ids = final.outputs[0].token_ids
+    if tokenizer is not None:
+        text = tokenizer.decode(out_ids, skip_special_tokens=True)
+    else:
+        text = final.outputs[0].text or " ".join(map(str, out_ids))
+    if response_format == "text":
+        return web.Response(text=text, content_type="text/plain")
+    if response_format == "verbose_json":
+        return web.json_response({
+            "task": task,
+            "language": language or "",
+            "duration": round(len(audio) / extractor.sampling_rate, 3),
+            "text": text,
+        })
+    return web.json_response({"text": text})
+
+
+async def handle_transcriptions(request: web.Request) -> web.Response:
+    return await _handle_audio(request, "transcribe")
+
+
+async def handle_translations(request: web.Request) -> web.Response:
+    return await _handle_audio(request, "translate")
+
+
+# ----------------------------------------------------------------------
+# /v1/realtime (websocket, text modality)
+# ----------------------------------------------------------------------
+
+async def handle_realtime(request: web.Request) -> web.WebSocketResponse:
+    """OpenAI Realtime API over websocket, text modality (reference:
+    ``vllm/entrypoints/openai/realtime/``). Event surface:
+
+    client -> ``session.update``, ``conversation.item.create``,
+    ``response.create``, ``response.cancel``;
+    server -> ``session.created/updated``,
+    ``conversation.item.created``, ``response.created``,
+    ``response.text.delta``, ``response.text.done``, ``response.done``,
+    ``error``. Audio modalities are rejected in ``session.update``.
+    """
+    from vllm_tpu.entrypoints.openai.api_server import ENGINE_KEY, MODEL_KEY
+    from vllm_tpu.sampling_params import SamplingParams
+
+    engine = request.app[ENGINE_KEY]
+    tokenizer = engine.tokenizer
+    ws = web.WebSocketResponse()
+    await ws.prepare(request)
+
+    session_id = _rid("sess")
+    session = {
+        "id": session_id,
+        "object": "realtime.session",
+        "model": request.app[MODEL_KEY],
+        "modalities": ["text"],
+        "instructions": "",
+        "temperature": 0.8,
+        "max_response_output_tokens": 512,
+    }
+    items: list[dict] = []
+    seq = 0
+
+    async def emit(etype: str, **payload) -> None:
+        nonlocal seq
+        seq += 1
+        await ws.send_json({
+            "type": etype, "event_id": f"event_{seq:06d}", **payload,
+        })
+
+    async def emit_error(message: str) -> None:
+        await emit("error", error={
+            "type": "invalid_request_error", "message": message,
+        })
+
+    await emit("session.created", session=session)
+    if tokenizer is None:
+        await emit_error("server has no tokenizer; realtime unavailable")
+        await ws.close()
+        return ws
+
+    import aiohttp as _aiohttp
+
+    async for msg in ws:
+        if msg.type != _aiohttp.WSMsgType.TEXT:
+            break
+        try:
+            event = json.loads(msg.data)
+        except json.JSONDecodeError:
+            await emit_error("invalid JSON event")
+            continue
+        etype = event.get("type")
+
+        if etype == "session.update":
+            patch = event.get("session") or {}
+            mods = patch.get("modalities")
+            if mods and any(m != "text" for m in mods):
+                await emit_error(
+                    "only the text modality is supported"
+                )
+                continue
+            for key in ("instructions", "temperature",
+                        "max_response_output_tokens"):
+                if key in patch:
+                    session[key] = patch[key]
+            await emit("session.updated", session=session)
+
+        elif etype == "conversation.item.create":
+            item = event.get("item") or {}
+            if item.get("type") != "message":
+                await emit_error(
+                    f"unsupported item type {item.get('type')!r}"
+                )
+                continue
+            item = {**item, "id": item.get("id") or _rid("item")}
+            items.append(item)
+            await emit("conversation.item.created", item=item)
+
+        elif etype == "response.create":
+            overrides = event.get("response") or {}
+            messages = []
+            instructions = (
+                overrides.get("instructions") or session["instructions"]
+            )
+            if instructions:
+                messages.append({"role": "system", "content": instructions})
+            for it in items:
+                parts = it.get("content") or []
+                text = "".join(
+                    p.get("text", "") for p in parts
+                    if p.get("type") in ("input_text", "text")
+                )
+                messages.append({
+                    "role": it.get("role", "user"), "content": text,
+                })
+            try:
+                prompt_ids = tokenizer.apply_chat_template(
+                    messages, add_generation_prompt=True
+                )
+            except Exception as e:
+                await emit_error(f"chat template failed: {e}")
+                continue
+            limit = (
+                overrides.get("max_response_output_tokens")
+                or session["max_response_output_tokens"]
+            )
+            from vllm_tpu.sampling_params import RequestOutputKind
+
+            params = SamplingParams(
+                temperature=float(
+                    overrides.get("temperature", session["temperature"])
+                ),
+                max_tokens=int(limit) if limit != "inf" else 4096,
+                # Deltas per event (default CUMULATIVE re-sends prefixes).
+                output_kind=RequestOutputKind.DELTA,
+            )
+            resp_id = _rid("resp")
+            item_id = _rid("item")
+            await emit("response.created", response={
+                "id": resp_id, "object": "realtime.response",
+                "status": "in_progress", "output": [],
+            })
+            text = ""
+            n_out = 0
+            try:
+                async for out in engine.generate(
+                    {"prompt_token_ids": list(prompt_ids)}, params, resp_id
+                ):
+                    c = out.outputs[0]
+                    if c.text:
+                        text += c.text
+                        await emit(
+                            "response.text.delta",
+                            response_id=resp_id, item_id=item_id,
+                            output_index=0, content_index=0, delta=c.text,
+                        )
+                    n_out += len(c.token_ids)
+            except Exception as e:  # pragma: no cover - engine failure
+                await emit_error(str(e))
+                continue
+            await emit(
+                "response.text.done",
+                response_id=resp_id, item_id=item_id,
+                output_index=0, content_index=0, text=text,
+            )
+            assistant_item = {
+                "id": item_id, "type": "message", "role": "assistant",
+                "content": [{"type": "text", "text": text}],
+            }
+            items.append(assistant_item)
+            await emit("response.done", response={
+                "id": resp_id, "object": "realtime.response",
+                "status": "completed",
+                "output": [assistant_item],
+                "usage": {
+                    "input_tokens": len(prompt_ids),
+                    "output_tokens": n_out,
+                    "total_tokens": len(prompt_ids) + n_out,
+                },
+            })
+
+        elif etype == "response.cancel":
+            # No response runs between events in this serial loop;
+            # nothing to cancel, mirror OpenAI's no-op answer.
+            await emit("response.done", response={
+                "id": _rid("resp"), "object": "realtime.response",
+                "status": "cancelled", "output": [],
+            })
+        else:
+            await emit_error(f"unknown event type {etype!r}")
+
+    return ws

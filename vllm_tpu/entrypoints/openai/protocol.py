@@ -46,30 +46,39 @@ def _logit_bias(d: dict) -> dict[int, float] | None:
 
 def _structured_outputs(d: dict) -> StructuredOutputParams | None:
     """OpenAI ``response_format`` plus the reference's ``guided_*``
-    extension fields -> StructuredOutputParams."""
+    extension fields -> StructuredOutputParams. ``structured_max_depth``
+    overrides the CFG/JSON-schema recursion bound per request."""
+    depth = d.get("structured_max_depth")
+    depth = int(depth) if depth is not None else None
+
+    def make(**kw) -> StructuredOutputParams:
+        return StructuredOutputParams(max_depth=depth, **kw)
+
     rf = d.get("response_format")
     if isinstance(rf, dict):
         t = rf.get("type")
         if t == "json_object":
-            return StructuredOutputParams(json_schema="{}")
+            return make(json_schema="{}")
         if t == "json_schema":
             schema = (rf.get("json_schema") or {}).get("schema")
             if not isinstance(schema, dict):
                 raise ValidationError(
                     "response_format.json_schema.schema must be an object"
                 )
-            return StructuredOutputParams(json_schema=schema)
+            return make(json_schema=schema)
         if t not in (None, "text"):
             raise ValidationError(f"unsupported response_format type {t!r}")
     if d.get("guided_regex") is not None:
-        return StructuredOutputParams(regex=str(d["guided_regex"]))
+        return make(regex=str(d["guided_regex"]))
     if d.get("guided_json") is not None:
-        return StructuredOutputParams(json_schema=d["guided_json"])
+        return make(json_schema=d["guided_json"])
+    if d.get("guided_grammar") is not None:
+        return make(grammar=str(d["guided_grammar"]))
     if d.get("guided_choice") is not None:
         choice = d["guided_choice"]
         if not isinstance(choice, list) or not choice:
             raise ValidationError("guided_choice must be a non-empty list")
-        return StructuredOutputParams(choice=[str(c) for c in choice])
+        return make(choice=[str(c) for c in choice])
     return None
 
 

@@ -1,0 +1,6 @@
+"""Committed protobuf schema + stubs for the gRPC service.
+
+``llm_pb2.py`` is protoc-generated from ``llm.proto``;
+``llm_pb2_grpc.py`` is hand-written (same surface grpc_python_plugin
+would emit) so builds need no protoc plugin.
+"""

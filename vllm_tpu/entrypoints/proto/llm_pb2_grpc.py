@@ -1,0 +1,69 @@
+"""gRPC client stub + servicer glue for ``llm.proto``.
+
+Hand-written equivalent of grpc_python_plugin output (the build image
+carries protoc but not the grpc plugin); the wire surface is identical,
+so any language's generated client interoperates.
+"""
+
+from __future__ import annotations
+
+import grpc
+
+from vllm_tpu.entrypoints.proto import llm_pb2
+
+
+class LLMStub:
+    """Typed client stub for service ``vllmtpu.LLM``."""
+
+    def __init__(self, channel: grpc.Channel) -> None:
+        self.Generate = channel.unary_stream(
+            "/vllmtpu.LLM/Generate",
+            request_serializer=llm_pb2.GenerateRequest.SerializeToString,
+            response_deserializer=llm_pb2.GenerateResponse.FromString,
+        )
+        self.Health = channel.unary_unary(
+            "/vllmtpu.LLM/Health",
+            request_serializer=llm_pb2.HealthRequest.SerializeToString,
+            response_deserializer=llm_pb2.HealthResponse.FromString,
+        )
+        self.Models = channel.unary_unary(
+            "/vllmtpu.LLM/Models",
+            request_serializer=llm_pb2.ModelsRequest.SerializeToString,
+            response_deserializer=llm_pb2.ModelsResponse.FromString,
+        )
+
+
+class LLMServicer:
+    """Subclass and implement; register with add_LLMServicer_to_server."""
+
+    async def Generate(self, request, context):  # pragma: no cover
+        raise NotImplementedError
+
+    async def Health(self, request, context):  # pragma: no cover
+        raise NotImplementedError
+
+    async def Models(self, request, context):  # pragma: no cover
+        raise NotImplementedError
+
+
+def add_LLMServicer_to_server(servicer: LLMServicer, server) -> None:
+    handlers = {
+        "Generate": grpc.unary_stream_rpc_method_handler(
+            servicer.Generate,
+            request_deserializer=llm_pb2.GenerateRequest.FromString,
+            response_serializer=llm_pb2.GenerateResponse.SerializeToString,
+        ),
+        "Health": grpc.unary_unary_rpc_method_handler(
+            servicer.Health,
+            request_deserializer=llm_pb2.HealthRequest.FromString,
+            response_serializer=llm_pb2.HealthResponse.SerializeToString,
+        ),
+        "Models": grpc.unary_unary_rpc_method_handler(
+            servicer.Models,
+            request_deserializer=llm_pb2.ModelsRequest.FromString,
+            response_serializer=llm_pb2.ModelsResponse.SerializeToString,
+        ),
+    }
+    server.add_generic_rpc_handlers((
+        grpc.method_handlers_generic_handler("vllmtpu.LLM", handlers),
+    ))

@@ -1,0 +1,179 @@
+"""compressed-tensors checkpoint import.
+
+Reference analog: ``vllm/model_executor/layers/quantization/
+compressed_tensors/`` — the llm-compressor ecosystem's checkpoint format.
+The HF config carries ``quantization_config`` with
+``quant_method: "compressed-tensors"`` and ``config_groups`` describing
+per-target weight schemes; the checkpoint stores, per quantized Linear:
+
+- int-quantized (w8):   ``weight`` int8 [N, K] + ``weight_scale``
+  ([N, 1] channel / scalar tensor strategy)
+- float-quantized (w8): ``weight`` float8_e4m3 [N, K] + ``weight_scale``
+- pack-quantized (w4):  ``weight_packed`` int32 [N, K/8] (8 SIGNED
+  nibbles per word, nibble i at bits 4i) + ``weight_scale`` [N, G]
+  (+ ``weight_zero_point`` when asymmetric, ``weight_shape`` [2])
+
+All convert to the framework's native formats (``QuantizedLinear`` /
+``Int4Linear``, ``layers/quant.py``): int8/fp8 per-out-channel
+``w = q * scale``; int4 unsigned-nibble group ``w = (nib - zero) *
+scale``.  Activation-quant specs (w8a8's dynamic input scheme) are
+accepted but served weight-only — matmuls run in the activation dtype,
+a numerical superset of the reference's quantized-activation path.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import numpy as np
+
+
+class CTImportError(ValueError):
+    pass
+
+
+@dataclass(frozen=True)
+class CTScheme:
+    """Parsed config_groups weight scheme."""
+
+    native_method: str  # "int8" | "fp8" | "int4"
+    fmt: str  # "int-quantized" | "float-quantized" | "pack-quantized"
+    strategy: str  # "channel" | "tensor" | "group"
+    group_size: int
+    symmetric: bool
+    ignore: tuple[str, ...] = ()
+
+
+def parse_ct_config(qc: dict) -> CTScheme:
+    """Parse an HF ``quantization_config`` dict (quant_method
+    "compressed-tensors") into the one weight scheme we serve.
+
+    Reference: ``compressed_tensors/quantization/quant_scheme.py``
+    preset schemes (W8A8, W8A16, W4A16, FP8).
+    """
+    groups = qc.get("config_groups") or {}
+    if len(groups) != 1:
+        raise CTImportError(
+            f"compressed-tensors: exactly one config group supported, "
+            f"got {sorted(groups)}"
+        )
+    (group,) = groups.values()
+    w = group.get("weights") or {}
+    num_bits = int(w.get("num_bits", 8))
+    wtype = w.get("type", "int")
+    strategy = w.get("strategy", "channel")
+    symmetric = bool(w.get("symmetric", True))
+    group_size = int(w.get("group_size") or 0)
+    fmt = qc.get("format", "")
+
+    if wtype == "float":
+        if num_bits != 8:
+            raise CTImportError(f"float weights need num_bits=8, got {num_bits}")
+        native, expect_fmt = "fp8", "float-quantized"
+    elif num_bits == 8:
+        native, expect_fmt = "int8", "int-quantized"
+    elif num_bits == 4:
+        native, expect_fmt = "int4", "pack-quantized"
+    else:
+        raise CTImportError(
+            f"compressed-tensors num_bits={num_bits} type={wtype!r} is "
+            "not supported (int8 / fp8 / packed int4)"
+        )
+    if fmt and fmt != expect_fmt and fmt != "dense":
+        raise CTImportError(
+            f"compressed-tensors format {fmt!r} does not match the "
+            f"weight scheme (expected {expect_fmt})"
+        )
+    if native in ("int8", "fp8"):
+        if strategy not in ("channel", "tensor"):
+            raise CTImportError(
+                f"{native} strategy {strategy!r} unsupported (channel/tensor)"
+            )
+        if not symmetric:
+            raise CTImportError(f"asymmetric {native} weights unsupported")
+    else:
+        if strategy != "group" or group_size <= 0:
+            raise CTImportError(
+                f"int4 needs group strategy with group_size, got "
+                f"{strategy!r}/{group_size}"
+            )
+    return CTScheme(
+        native_method=native, fmt=expect_fmt, strategy=strategy,
+        group_size=group_size, symmetric=symmetric,
+        ignore=tuple(qc.get("ignore") or ()),
+    )
+
+
+def detect_ct(hf_config) -> CTScheme | None:
+    qc = getattr(hf_config, "quantization_config", None)
+    if qc is None:
+        return None
+    if not isinstance(qc, dict):
+        qc = qc.to_dict() if hasattr(qc, "to_dict") else dict(qc)
+    if qc.get("quant_method") != "compressed-tensors":
+        return None
+    return parse_ct_config(qc)
+
+
+def ct_int8_to_qlinear(
+    weight: np.ndarray,  # [N, K] int8 (or f8 bytes via view)
+    scale: np.ndarray,  # [N, 1] / [N] / scalar
+    k_dim: int,
+) -> tuple[np.ndarray, np.ndarray]:
+    """-> (q [K, N], scale [N]) for QuantizedLinear."""
+    q = np.ascontiguousarray(weight.T)
+    s = np.asarray(scale, np.float32).reshape(-1)
+    n = q.shape[-1]
+    if s.size == 1:
+        s = np.full((n,), float(s[0]), np.float32)
+    if s.shape != (n,):
+        raise CTImportError(f"weight_scale shape {scale.shape} vs N={n}")
+    if q.shape[0] != k_dim:
+        raise CTImportError(f"weight K {q.shape[0]} != expected {k_dim}")
+    return q, s
+
+
+def _unpack_signed_nibbles(packed: np.ndarray) -> np.ndarray:
+    """[N, K/8] int32 -> [N, K] signed nibbles (int8 in [-8, 7]);
+    nibble i of each word at bits 4i (compressed_tensors pack_to_int32)."""
+    u = packed.astype(np.uint32)
+    shifts = 4 * np.arange(8, dtype=np.uint32)
+    nib = ((u[..., None] >> shifts) & 0xF).astype(np.int8)  # [N, K/8, 8]
+    nib = np.where(nib >= 8, nib - 16, nib)
+    return nib.reshape(packed.shape[0], packed.shape[1] * 8)
+
+
+def ct_pack_to_int4(
+    weight_packed: np.ndarray,  # [N, K/8] int32
+    scale: np.ndarray,  # [N, G]
+    zero_point: np.ndarray | None,  # [N, G] signed, or None (symmetric)
+    shape: np.ndarray | None,  # [2] = (N, K), trims K padding
+    group_size: int,
+) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """-> (packed uint8 [K/2, N], scale [G, N], zero [G, N]) for
+    Int4Linear: unsigned nibbles with ``w = (nib - zero) * scale``;
+    signed value v maps to v+8, so zero = 8 + stored zero_point."""
+    nib_s = _unpack_signed_nibbles(weight_packed)  # [N, Kpad]
+    if shape is not None:
+        n, k = (int(x) for x in np.asarray(shape).reshape(-1)[:2])
+        nib_s = nib_s[:n, :k]
+    nib = (nib_s + 8).astype(np.uint8).T  # [K, N] unsigned
+    k = nib.shape[0]
+    if k % 2:
+        raise CTImportError(f"odd input dim {k}")
+    packed = (nib[0::2, :] | (nib[1::2, :] << 4)).astype(np.uint8)
+    sc = np.asarray(scale, np.float32).T  # [G, N]
+    g = -(-k // group_size)
+    if sc.shape[0] != g:
+        raise CTImportError(
+            f"weight_scale groups {sc.shape[0]} != K/group {g}"
+        )
+    if zero_point is not None:
+        zero = np.asarray(zero_point, np.float32).T + 8.0
+    else:
+        zero = np.full_like(sc, 8.0)
+    return (
+        np.ascontiguousarray(packed),
+        np.ascontiguousarray(sc),
+        np.ascontiguousarray(zero),
+    )

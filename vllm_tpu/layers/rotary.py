@@ -211,3 +211,20 @@ def _apply_rotate_half(
     if rest.shape[-1]:
         return jnp.concatenate([rot_out, rest], axis=-1)
     return rot_out
+
+
+def _apply_interleaved(
+    x: jnp.ndarray, cos: jnp.ndarray, sin: jnp.ndarray, rotary_dim: int
+) -> jnp.ndarray:
+    """GPT-J/GLM/Cohere rope layout: rotation PAIRS are adjacent lanes
+    (x[2i], x[2i+1]) instead of rotate_half's (x[i], x[i+rd/2])."""
+    dtype = x.dtype
+    rot, rest = x[..., :rotary_dim], x[..., rotary_dim:]
+    x1 = rot[..., 0::2].astype(jnp.float32)
+    x2 = rot[..., 1::2].astype(jnp.float32)
+    out1 = x1 * cos - x2 * sin
+    out2 = x2 * cos + x1 * sin
+    rot_out = jnp.stack([out1, out2], axis=-1).reshape(rot.shape).astype(dtype)
+    if rest.shape[-1]:
+        return jnp.concatenate([rot_out, rest], axis=-1)
+    return rot_out

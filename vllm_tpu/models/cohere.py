@@ -1,0 +1,87 @@
+"""Cohere Command-R family.
+
+Reference analog: ``vllm/model_executor/models/commandr.py``. A Llama
+graph with: bias-free LayerNorm (not RMSNorm), a SINGLE shared
+pre-norm feeding a parallel attention+MLP residual
+(``x + attn(ln(x)) + mlp(ln(x))``), interleaved rope pairs, tied
+embeddings, and logits scaled by ``logit_scale``.
+
+The shared-LN parallel block rides the Falcon trick: the split hook
+duplicates ``input_layernorm.weight`` onto both norm leaves (and
+synthesizes the zero biases the bias-free LayerNorm lacks).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax.numpy as jnp
+import numpy as np
+
+from vllm_tpu.models.llama import LlamaForCausalLM
+
+
+class CohereForCausalLM(LlamaForCausalLM):
+    norm_type = "layer"
+    parallel_residual = True
+    rope_interleaved = True
+    supports_lora = False
+    SPLIT_SUFFIXES = (".input_layernorm.weight", "model.norm.weight")
+
+    def __init__(self, hf_config: Any, dtype=jnp.bfloat16,
+                 quantization: str | None = None) -> None:
+        c = hf_config
+        if getattr(c, "use_qk_norm", False):
+            raise ValueError(
+                "Cohere use_qk_norm=True (per-head LayerNorm on q/k) is "
+                "not supported yet"
+            )
+        super().__init__(c, dtype, quantization)
+        # Cohere uses layer_norm_eps (LayerNorm), not rms_norm_eps.
+        self.rms_eps = getattr(c, "layer_norm_eps", 1e-5)
+        # HF multiplies logits by logit_scale; our hook divides.
+        ls = float(getattr(c, "logit_scale", 1.0) or 1.0)
+        self.logits_scaling = 1.0 / ls
+
+    def split_hf_tensor(self, hf_name: str, arr):
+        zeros = np.zeros_like(np.asarray(arr))
+        if hf_name == "model.norm.weight":
+            return [
+                ("model.final_ln.weight", arr),
+                ("model.final_ln.bias", zeros),
+            ]
+        # One shared LN feeds BOTH branches of the parallel block.
+        base = hf_name.rsplit("input_layernorm", 1)[0]
+        return [
+            (f"{base}ln_dup_a.weight", arr),
+            (f"{base}ln_dup_a.bias", zeros),
+            (f"{base}ln_dup_b.weight", arr),
+            (f"{base}ln_dup_b.bias", zeros),
+        ]
+
+    def hf_weight_map(self) -> dict:
+        m = {
+            "model.embed_tokens.weight": ("embed", False),
+            "model.final_ln.weight": ("final_norm", False),
+            "model.final_ln.bias": ("final_norm_b", False),
+        }
+        if not self.tie_embeddings:
+            m["lm_head.weight"] = ("lm_head", True)
+        for i in range(self.num_layers):
+            hf = f"model.layers.{i}"
+            b = "layers"
+            m[f"{hf}.ln_dup_a.weight"] = (f"{b}.input_norm.{i}", False)
+            m[f"{hf}.ln_dup_a.bias"] = (f"{b}.input_norm_b.{i}", False)
+            m[f"{hf}.ln_dup_b.weight"] = (f"{b}.post_norm.{i}", False)
+            m[f"{hf}.ln_dup_b.bias"] = (f"{b}.post_norm_b.{i}", False)
+            for ours, hf_n in (("q", "q_proj"), ("k", "k_proj"),
+                               ("v", "v_proj"), ("o", "o_proj")):
+                m[f"{hf}.self_attn.{hf_n}.weight"] = (f"{b}.w{ours}.{i}", True)
+            if self.attention_bias:
+                for ours, hf_n in (("q", "q_proj"), ("k", "k_proj"),
+                                   ("v", "v_proj")):
+                    m[f"{hf}.self_attn.{hf_n}.bias"] = (f"{b}.b{ours}.{i}", False)
+            m[f"{hf}.mlp.gate_proj.weight"] = (f"{b}.wgate.{i}", True)
+            m[f"{hf}.mlp.up_proj.weight"] = (f"{b}.wup.{i}", True)
+            m[f"{hf}.mlp.down_proj.weight"] = (f"{b}.wdown.{i}", True)
+        return m

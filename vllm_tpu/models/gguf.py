@@ -1,0 +1,326 @@
+"""GGUF checkpoint support: self-contained parser + dequantization.
+
+Reference analog: ``vllm/model_executor/layers/quantization/gguf.py`` and
+``model_loader/gguf_loader`` (which delegate to the ``gguf`` package and
+CUDA dequant kernels ``csrc/quantization/gguf/``). This is a dependency-
+free reader for the GGUF v2/v3 container and numpy dequantizers for the
+common ggml tensor codes (F32/F16/BF16, Q8_0, Q4_0, Q4_1, Q5_0, Q5_1,
+Q4_K, Q6_K); llama.cpp tensor names map onto HF Llama names so the
+standard loader path (and native int8/int4 requantization) applies.
+
+Layouts follow ggml's ``block_*`` structs (ggml/src/ggml-quants.h; all
+little-endian):
+- Q8_0: blocks of 32 — f16 d, 32×i8;            w = q*d
+- Q4_0: blocks of 32 — f16 d, 16 B nibbles;     w = (q-8)*d
+- Q4_1: blocks of 32 — f16 d, f16 m, 16 B;      w = q*d + m
+- Q5_0: blocks of 32 — f16 d, 4 B high bits, 16 B; w = (q-16)*d
+- Q5_1: blocks of 32 — f16 d, f16 m, 4 B, 16 B; w = q*d + m
+- Q4_K: superblocks of 256 — f16 d, f16 dmin, 12 B packed 6-bit
+  (scale, min) pairs for 8 sub-blocks of 32, 128 B nibbles;
+  w = q*(d*sc) - (dmin*m)
+- Q6_K: superblocks of 256 — 128 B low nibbles, 64 B high 2-bit,
+  16×i8 sub-block scales, f16 d; w = (q-32)*d*sc
+"""
+
+from __future__ import annotations
+
+import struct
+from typing import Any, BinaryIO, Iterator
+
+import numpy as np
+
+GGUF_MAGIC = b"GGUF"
+
+# Metadata value types.
+_SIMPLE = {
+    0: ("<B", 1), 1: ("<b", 1), 2: ("<H", 2), 3: ("<h", 2),
+    4: ("<I", 4), 5: ("<i", 4), 6: ("<f", 4), 7: ("<?", 1),
+    10: ("<Q", 8), 11: ("<q", 8), 12: ("<d", 8),
+}
+_STRING, _ARRAY = 8, 9
+
+# ggml tensor type -> (block width in weights, bytes per block).
+GGML_TYPES = {
+    0: ("F32", 1, 4),
+    1: ("F16", 1, 2),
+    2: ("Q4_0", 32, 18),
+    3: ("Q4_1", 32, 20),
+    6: ("Q5_0", 32, 22),
+    7: ("Q5_1", 32, 24),
+    8: ("Q8_0", 32, 34),
+    12: ("Q4_K", 256, 144),
+    14: ("Q6_K", 256, 210),
+    30: ("BF16", 1, 2),
+}
+
+
+def _read_str(f: BinaryIO) -> str:
+    (n,) = struct.unpack("<Q", f.read(8))
+    return f.read(n).decode("utf-8", errors="replace")
+
+
+def _read_value(f: BinaryIO, vtype: int) -> Any:
+    if vtype in _SIMPLE:
+        fmt, size = _SIMPLE[vtype]
+        return struct.unpack(fmt, f.read(size))[0]
+    if vtype == _STRING:
+        return _read_str(f)
+    if vtype == _ARRAY:
+        (etype,) = struct.unpack("<I", f.read(4))
+        (n,) = struct.unpack("<Q", f.read(8))
+        return [_read_value(f, etype) for _ in range(n)]
+    raise ValueError(f"unknown GGUF value type {vtype}")
+
+
+class GGUFFile:
+    """Parsed GGUF container: ``metadata`` dict + tensor directory."""
+
+    def __init__(self, path: str) -> None:
+        self.path = path
+        self.metadata: dict[str, Any] = {}
+        # name -> (ggml_type, shape tuple (ggml order), abs data offset)
+        self.tensors: dict[str, tuple[int, tuple[int, ...], int]] = {}
+        with open(path, "rb") as f:
+            if f.read(4) != GGUF_MAGIC:
+                raise ValueError(f"{path}: not a GGUF file")
+            (version,) = struct.unpack("<I", f.read(4))
+            if version not in (2, 3):
+                raise ValueError(f"GGUF version {version} unsupported")
+            n_tensors, n_kv = struct.unpack("<QQ", f.read(16))
+            for _ in range(n_kv):
+                key = _read_str(f)
+                (vtype,) = struct.unpack("<I", f.read(4))
+                self.metadata[key] = _read_value(f, vtype)
+            infos = []
+            for _ in range(n_tensors):
+                name = _read_str(f)
+                (n_dims,) = struct.unpack("<I", f.read(4))
+                dims = struct.unpack(f"<{n_dims}Q", f.read(8 * n_dims))
+                ttype, offset = struct.unpack("<IQ", f.read(12))
+                infos.append((name, ttype, dims, offset))
+            align = int(self.metadata.get("general.alignment", 32))
+            base = f.tell()
+            base += (-base) % align
+            for name, ttype, dims, offset in infos:
+                self.tensors[name] = (ttype, dims, base + offset)
+
+    def read_tensor(self, name: str) -> np.ndarray:
+        """Dequantized f32/f16 tensor in NUMPY (row-major) orientation:
+        ggml dims are column-major (dims[0] = contiguous), so an HF
+        ``[out, in]`` Linear weight stored as ggml ``[in, out]`` comes
+        back ``[out, in]`` — identical to the safetensors layout."""
+        ttype, dims, offset = self.tensors[name]
+        if ttype not in GGML_TYPES:
+            raise ValueError(
+                f"{name}: ggml tensor type {ttype} unsupported "
+                f"(have {sorted(v[0] for v in GGML_TYPES.values())})"
+            )
+        tname, block, bpb = GGML_TYPES[ttype]
+        n = 1
+        for d in dims:
+            n *= int(d)
+        if n % block:
+            raise ValueError(f"{name}: {n} weights not /{block} blocks")
+        with open(self.path, "rb") as f:
+            f.seek(offset)
+            raw = f.read(n // block * bpb)
+        flat = _dequant(tname, np.frombuffer(raw, np.uint8), n)
+        # ggml dims[0] is fastest-varying -> numpy shape is reversed dims.
+        return flat.reshape(tuple(int(d) for d in reversed(dims)))
+
+
+def _f16(b: np.ndarray) -> np.ndarray:
+    return b.view(np.float16).astype(np.float32)
+
+
+def _dequant(tname: str, b: np.ndarray, n: int) -> np.ndarray:
+    if tname == "F32":
+        return b.view(np.float32)
+    if tname == "F16":
+        return b.view(np.float16).astype(np.float32)
+    if tname == "BF16":
+        return (
+            (b.view(np.uint16).astype(np.uint32) << 16)
+            .view(np.float32)
+        )
+    if tname == "Q8_0":
+        blk = b.reshape(n // 32, 34)
+        d = _f16(blk[:, :2].reshape(-1))[:, None]
+        q = blk[:, 2:].view(np.int8).astype(np.float32)
+        return (q * d).reshape(-1)
+    if tname == "Q4_0":
+        blk = b.reshape(n // 32, 18)
+        d = _f16(blk[:, :2].reshape(-1))[:, None]
+        nib = blk[:, 2:]
+        # ggml nibble order: low nibbles are weights 0..15, high 16..31.
+        q = np.concatenate([nib & 0xF, nib >> 4], axis=1).astype(np.float32)
+        return ((q - 8.0) * d).reshape(-1)
+    if tname == "Q4_1":
+        blk = b.reshape(n // 32, 20)
+        d = _f16(blk[:, :2].reshape(-1))[:, None]
+        m = _f16(blk[:, 2:4].reshape(-1))[:, None]
+        nib = blk[:, 4:]
+        q = np.concatenate([nib & 0xF, nib >> 4], axis=1).astype(np.float32)
+        return (q * d + m).reshape(-1)
+    if tname in ("Q5_0", "Q5_1"):
+        has_m = tname == "Q5_1"
+        w = 24 if has_m else 22
+        blk = b.reshape(n // 32, w)
+        d = _f16(blk[:, :2].reshape(-1))[:, None]
+        off = 2
+        m = None
+        if has_m:
+            m = _f16(blk[:, 2:4].reshape(-1))[:, None]
+            off = 4
+        qh = blk[:, off:off + 4].copy().view(np.uint32)[:, 0]  # [B]
+        nib = blk[:, off + 4:]
+        q = np.concatenate([nib & 0xF, nib >> 4], axis=1).astype(np.uint32)
+        hi = (qh[:, None] >> np.arange(32, dtype=np.uint32)) & 1
+        q = (q | (hi << 4)).astype(np.float32)
+        if has_m:
+            return (q * d + m).reshape(-1)
+        return ((q - 16.0) * d).reshape(-1)
+    if tname == "Q4_K":
+        blk = b.reshape(n // 256, 144)
+        d = _f16(blk[:, :2].reshape(-1))[:, None]  # [B, 1]
+        dmin = _f16(blk[:, 2:4].reshape(-1))[:, None]
+        sc, mn = _unpack_k_scales(blk[:, 4:16])  # [B, 8] each
+        nib = blk[:, 16:144]  # [B, 128]
+        # Sub-blocks j=0..7 of 32: pairs (2j, 2j+1) share bytes
+        # 32j/2..: ggml lays q4 as 4 chunks of 32 bytes, each chunk
+        # holding sub-block 2c (low nibbles) and 2c+1 (high nibbles).
+        chunks = nib.reshape(-1, 4, 32)
+        lo = chunks & 0xF
+        hi = chunks >> 4
+        q = np.stack([lo, hi], axis=2).reshape(-1, 8, 32).astype(np.float32)
+        scale = (d * sc)[:, :, None]  # [B, 8, 1]
+        minv = (dmin * mn)[:, :, None]
+        return (q * scale - minv).reshape(-1)
+    if tname == "Q6_K":
+        blk = b.reshape(n // 256, 210)
+        ql = blk[:, :128]
+        qh = blk[:, 128:192]
+        scales = blk[:, 192:208].view(np.int8).astype(np.float32)  # [B, 16]
+        d = _f16(blk[:, 208:210].reshape(-1))[:, None]
+        q = np.empty((blk.shape[0], 256), np.float32)
+        # ggml dequant loop (two halves of 128, l = 0..63 each).
+        for half in range(2):
+            lo = ql[:, 64 * half:64 * half + 64]
+            hi = qh[:, 32 * half:32 * half + 32]
+            l32 = np.arange(32)
+            q1 = (lo[:, l32] & 0xF) | (((hi[:, l32] >> 0) & 3) << 4)
+            q2 = (lo[:, l32 + 32] & 0xF) | (((hi[:, l32] >> 2) & 3) << 4)
+            q3 = (lo[:, l32] >> 4) | (((hi[:, l32] >> 4) & 3) << 4)
+            q4 = (lo[:, l32 + 32] >> 4) | (((hi[:, l32] >> 6) & 3) << 4)
+            base = 128 * half
+            q[:, base:base + 32] = q1.astype(np.int8) - 32
+            q[:, base + 32:base + 64] = q2.astype(np.int8) - 32
+            q[:, base + 64:base + 96] = q3.astype(np.int8) - 32
+            q[:, base + 96:base + 128] = q4.astype(np.int8) - 32
+        # Sub-block scales: 16 groups of 16 weights.
+        sc = np.repeat(scales, 16, axis=1)  # [B, 256]
+        return (q * sc * d).reshape(-1)
+    raise AssertionError(tname)
+
+
+def _unpack_k_scales(raw12: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
+    """K-quant 12-byte packed 6-bit (scale, min) pairs for 8 sub-blocks
+    (ggml ``get_scale_min_k4``): j<4: sc=q[j]&63, m=q[j+4]&63;
+    j>=4: sc=(q[j+4]&0xF)|((q[j-4]>>6)<<4), m=(q[j+4]>>4)|((q[j]>>6)<<4)."""
+    q = raw12.astype(np.uint8)
+    sc = np.empty((q.shape[0], 8), np.float32)
+    mn = np.empty((q.shape[0], 8), np.float32)
+    for j in range(4):
+        sc[:, j] = (q[:, j] & 63).astype(np.float32)
+        mn[:, j] = (q[:, j + 4] & 63).astype(np.float32)
+    for j in range(4, 8):
+        sc[:, j] = (
+            (q[:, j + 4] & 0xF) | ((q[:, j - 4] >> 6) << 4)
+        ).astype(np.float32)
+        mn[:, j] = (
+            (q[:, j + 4] >> 4) | ((q[:, j] >> 6) << 4)
+        ).astype(np.float32)
+    return sc, mn
+
+
+# llama.cpp tensor names -> HF Llama names.
+_GGUF_NAME_MAP = {
+    "token_embd.weight": "model.embed_tokens.weight",
+    "output_norm.weight": "model.norm.weight",
+    "output.weight": "lm_head.weight",
+}
+_GGUF_BLK_MAP = {
+    "attn_q.weight": "self_attn.q_proj.weight",
+    "attn_k.weight": "self_attn.k_proj.weight",
+    "attn_v.weight": "self_attn.v_proj.weight",
+    "attn_output.weight": "self_attn.o_proj.weight",
+    "ffn_gate.weight": "mlp.gate_proj.weight",
+    "ffn_up.weight": "mlp.up_proj.weight",
+    "ffn_down.weight": "mlp.down_proj.weight",
+    "attn_norm.weight": "input_layernorm.weight",
+    "ffn_norm.weight": "post_attention_layernorm.weight",
+    "attn_q.bias": "self_attn.q_proj.bias",
+    "attn_k.bias": "self_attn.k_proj.bias",
+    "attn_v.bias": "self_attn.v_proj.bias",
+}
+
+
+def gguf_to_hf_name(name: str) -> str | None:
+    if name in _GGUF_NAME_MAP:
+        return _GGUF_NAME_MAP[name]
+    if name.startswith("blk."):
+        _, idx, rest = name.split(".", 2)
+        mapped = _GGUF_BLK_MAP.get(rest)
+        if mapped is not None:
+            return f"model.layers.{idx}.{mapped}"
+    return None
+
+
+def iter_hf_tensors(gf: GGUFFile) -> Iterator[tuple[str, np.ndarray]]:
+    """(hf_name, dequantized array) for every mappable tensor."""
+    for name in gf.tensors:
+        hf_name = gguf_to_hf_name(name)
+        if hf_name is not None:
+            yield hf_name, gf.read_tensor(name)
+
+
+def config_from_gguf(path: str):
+    """Build a transformers ``LlamaConfig``/``Qwen2Config`` from GGUF
+    metadata (``llama.*`` / ``qwen2.*`` keys)."""
+    from transformers import LlamaConfig, Qwen2Config
+
+    gf = GGUFFile(path)
+    md = gf.metadata
+    arch = md.get("general.architecture", "llama")
+    if arch not in ("llama", "qwen2"):
+        raise ValueError(
+            f"GGUF architecture {arch!r} unsupported (llama/qwen2)"
+        )
+
+    def g(key: str, default=None):
+        return md.get(f"{arch}.{key}", default)
+
+    heads = int(g("attention.head_count"))
+    vocab = md.get(f"{arch}.vocab_size")
+    if vocab is None:
+        # Fall back to the embedding table's vocab dim.
+        _, dims, _ = gf.tensors["token_embd.weight"]
+        vocab = int(dims[1])
+    kwargs = dict(
+        vocab_size=int(vocab),
+        hidden_size=int(g("embedding_length")),
+        intermediate_size=int(g("feed_forward_length")),
+        num_hidden_layers=int(g("block_count")),
+        num_attention_heads=heads,
+        num_key_value_heads=int(g("attention.head_count_kv", heads)),
+        max_position_embeddings=int(g("context_length", 4096)),
+        rms_norm_eps=float(g("attention.layer_norm_rms_epsilon", 1e-5)),
+        rope_theta=float(g("rope.freq_base", 10000.0)),
+        tie_word_embeddings="output.weight" not in gf.tensors,
+    )
+    cls = LlamaConfig if arch == "llama" else Qwen2Config
+    cfg = cls(**kwargs)
+    cfg.architectures = [
+        "LlamaForCausalLM" if arch == "llama" else "Qwen2ForCausalLM"
+    ]
+    return cfg

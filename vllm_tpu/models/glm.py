@@ -1,0 +1,44 @@
+"""GLM-4 (GlmForCausalLM) — Llama graph with interleaved partial rope,
+qkv bias, and a fused gate_up projection.
+
+Reference analog: ``vllm/model_executor/models/glm.py``. Flags: qkv bias
+(no o bias), ``partial_rotary_factor`` (0.5), INTERLEAVED rope pairs,
+gated-silu MLP whose checkpoint stores ``mlp.gate_up_proj`` fused (the
+split hook halves it).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax.numpy as jnp
+import numpy as np
+
+from vllm_tpu.models.llama import LlamaForCausalLM
+
+
+class GlmForCausalLM(LlamaForCausalLM):
+    attention_bias = True
+    rope_interleaved = True
+    supports_lora = False
+    SPLIT_SUFFIXES = (".mlp.gate_up_proj.weight",)
+
+    def __init__(self, hf_config: Any, dtype=jnp.bfloat16,
+                 quantization: str | None = None) -> None:
+        super().__init__(hf_config, dtype, quantization)
+
+    def split_hf_tensor(self, hf_name: str, arr):
+        # [2F, D]: gate rows then up rows.
+        f = arr.shape[0] // 2
+        base = hf_name.rsplit("gate_up_proj", 1)[0]
+        return [
+            (f"{base}gate_proj.weight", np.ascontiguousarray(arr[:f])),
+            (f"{base}up_proj.weight", np.ascontiguousarray(arr[f:])),
+        ]
+
+    def hf_weight_map(self) -> dict:
+        m = super().hf_weight_map()
+        # GLM has qkv biases but NO o_proj bias; the base map only adds
+        # bias entries for q/k/v (attention_out_bias is False), so the
+        # inherited map is already right. gate/up arrive via the split.
+        return m

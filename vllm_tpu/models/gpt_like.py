@@ -19,8 +19,12 @@ Covered here:
 - GPT-BigCode (santacoder/starcoder): GPT-2 layout + multi-query
   attention, gelu_pytorch_tanh.
 
-Not covered (documented gaps): GPT-J (interleaved rotate-every-two
-rope), MPT (ALiBi), remote-code-only families (InternLM2, ExaONE).
+- StarCoder2: Llama names + LayerNorm, plain gelu MLP, biases, GQA.
+- GPT-J: interleaved partial rotary, single-shared-LN parallel
+  residual, biased lm_head.
+
+Not covered (documented gaps): MPT/Bloom (ALiBi position bias),
+remote-code-only families (InternLM2, ExaONE, MiniCPM, Baichuan).
 """
 
 from __future__ import annotations
@@ -419,4 +423,115 @@ class PhiForCausalLM(_GPTLikeBase):
             m[f"{hf}.mlp.fc1.bias"] = (f"{b}.b_up.{i}", False)
             m[f"{hf}.mlp.fc2.weight"] = (f"{b}.wdown.{i}", True)
             m[f"{hf}.mlp.fc2.bias"] = (f"{b}.b_down.{i}", False)
+        return m
+
+
+class Starcoder2ForCausalLM(_GPTLikeBase):
+    """StarCoder2: Llama layout names with LayerNorm + plain
+    gelu_pytorch_tanh MLP (``mlp.c_fc``/``c_proj``), biases everywhere
+    (``use_bias``), GQA, rope."""
+
+    mlp_act = "gelu_new"
+    mlp_bias = True
+    attention_bias = True
+    attention_out_bias = True
+
+    def __init__(self, hf_config: Any, dtype=jnp.bfloat16,
+                 quantization: str | None = None) -> None:
+        c = hf_config
+        c.tie_word_embeddings = getattr(c, "tie_word_embeddings", True)
+        super().__init__(c, dtype, quantization)
+        self.rms_eps = getattr(c, "norm_epsilon", 1e-5)
+        use_bias = getattr(c, "use_bias", True)
+        self.attention_bias = use_bias
+        self.attention_out_bias = use_bias
+        self.mlp_bias = use_bias
+        # HF and the reference honor the configured sliding window.
+        self.sliding_window = getattr(c, "sliding_window", None)
+
+    def hf_weight_map(self) -> dict:
+        m = {
+            "model.embed_tokens.weight": ("embed", False),
+            "model.norm.weight": ("final_norm", False),
+            "model.norm.bias": ("final_norm_b", False),
+        }
+        if not self.tie_embeddings:
+            m["lm_head.weight"] = ("lm_head", True)
+        for i in range(self.num_layers):
+            hf = f"model.layers.{i}"
+            b = "layers"
+            m[f"{hf}.input_layernorm.weight"] = (f"{b}.input_norm.{i}", False)
+            m[f"{hf}.input_layernorm.bias"] = (f"{b}.input_norm_b.{i}", False)
+            m[f"{hf}.post_attention_layernorm.weight"] = (f"{b}.post_norm.{i}", False)
+            m[f"{hf}.post_attention_layernorm.bias"] = (f"{b}.post_norm_b.{i}", False)
+            for ours, hf_n in (("q", "q_proj"), ("k", "k_proj"),
+                               ("v", "v_proj")):
+                m[f"{hf}.self_attn.{hf_n}.weight"] = (f"{b}.w{ours}.{i}", True)
+                if self.attention_bias:
+                    m[f"{hf}.self_attn.{hf_n}.bias"] = (f"{b}.b{ours}.{i}", False)
+            m[f"{hf}.self_attn.o_proj.weight"] = (f"{b}.wo.{i}", True)
+            if self.attention_out_bias:
+                m[f"{hf}.self_attn.o_proj.bias"] = (f"{b}.bo.{i}", False)
+            m[f"{hf}.mlp.c_fc.weight"] = (f"{b}.wup.{i}", True)
+            m[f"{hf}.mlp.c_proj.weight"] = (f"{b}.wdown.{i}", True)
+            if self.mlp_bias:
+                m[f"{hf}.mlp.c_fc.bias"] = (f"{b}.b_up.{i}", False)
+                m[f"{hf}.mlp.c_proj.bias"] = (f"{b}.b_down.{i}", False)
+        return m
+
+
+class GPTJForCausalLM(_GPTLikeBase):
+    """GPT-J 6B-class: INTERLEAVED partial rotary (rotate-every-two),
+    parallel residual reading ONE shared ln_1 (duplicated by the split
+    hook), plain gelu_new MLP with biases, biased lm_head."""
+
+    mlp_act = "gelu_new"
+    mlp_bias = True
+    parallel_residual = True
+    rope_interleaved = True
+    lm_head_bias = True
+    SPLIT_SUFFIXES = (".ln_1.weight", ".ln_1.bias")
+
+    def __init__(self, hf_config: Any, dtype=jnp.bfloat16,
+                 quantization: str | None = None) -> None:
+        c = hf_config
+        if getattr(c, "intermediate_size", None) is None:
+            c.intermediate_size = (
+                c.n_inner if getattr(c, "n_inner", None) else 4 * c.hidden_size
+            )
+        rd = getattr(c, "rotary_dim", None)
+        if rd:
+            c.partial_rotary_factor = rd / (c.hidden_size // c.n_head)
+        super().__init__(c, dtype, quantization)
+
+    def split_hf_tensor(self, hf_name: str, arr):
+        kind = hf_name.rsplit(".", 1)[1]
+        base = hf_name.rsplit("ln_1", 1)[0]
+        return [
+            (f"{base}ln_dup_a.{kind}", arr),
+            (f"{base}ln_dup_b.{kind}", arr),
+        ]
+
+    def hf_weight_map(self) -> dict:
+        m = {
+            "transformer.wte.weight": ("embed", False),
+            "transformer.ln_f.weight": ("final_norm", False),
+            "transformer.ln_f.bias": ("final_norm_b", False),
+            "lm_head.weight": ("lm_head", True),
+            "lm_head.bias": ("lm_head_b", False),
+        }
+        for i in range(self.num_layers):
+            hf = f"transformer.h.{i}"
+            b = "layers"
+            m[f"{hf}.ln_dup_a.weight"] = (f"{b}.input_norm.{i}", False)
+            m[f"{hf}.ln_dup_a.bias"] = (f"{b}.input_norm_b.{i}", False)
+            m[f"{hf}.ln_dup_b.weight"] = (f"{b}.post_norm.{i}", False)
+            m[f"{hf}.ln_dup_b.bias"] = (f"{b}.post_norm_b.{i}", False)
+            for ours, hf_n in (("q", "q_proj"), ("k", "k_proj"),
+                               ("v", "v_proj"), ("o", "out_proj")):
+                m[f"{hf}.attn.{hf_n}.weight"] = (f"{b}.w{ours}.{i}", True)
+            m[f"{hf}.mlp.fc_in.weight"] = (f"{b}.wup.{i}", True)
+            m[f"{hf}.mlp.fc_in.bias"] = (f"{b}.b_up.{i}", False)
+            m[f"{hf}.mlp.fc_out.weight"] = (f"{b}.wdown.{i}", True)
+            m[f"{hf}.mlp.fc_out.bias"] = (f"{b}.b_down.{i}", False)
         return m

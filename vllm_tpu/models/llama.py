@@ -40,7 +40,11 @@ from vllm_tpu.layers.quant import (
     quantize_jnp,
 )
 from vllm_tpu.lora.layers import lora_delta
-from vllm_tpu.layers.rotary import RotaryEmbedding, _apply_rotate_half
+from vllm_tpu.layers.rotary import (
+    RotaryEmbedding,
+    _apply_interleaved,
+    _apply_rotate_half,
+)
 from vllm_tpu.logger import init_logger
 from vllm_tpu.ops.attention import (
     AttentionMetadata,
@@ -110,6 +114,11 @@ class LlamaForCausalLM:
     qk_norm_full = False
     # Phi-class biased lm_head (lm_head_b leaf).
     lm_head_bias = False
+    # Rope pair layout: False = rotate_half (Llama/NeoX halves), True =
+    # interleaved adjacent lanes (GPT-J / GLM / Cohere).
+    rope_interleaved = False
+    # QKV clipping (OLMo-1 clip_qkv): clamp projections to +-value.
+    clip_qkv = None
     # Granite-style scalar modulation hooks (all 1.0 = plain Llama).
     embedding_multiplier = 1.0
     residual_multiplier = 1.0
@@ -181,6 +190,30 @@ class LlamaForCausalLM:
             # quantizing would peak at full-precision model size — an 8B
             # int8 dummy on a 16 GiB chip would OOM.
             if self.quantization and name in self.QUANT_KEYS:
+                if shape[0] >= 8 and math.prod(shape) >= 2**28:
+                    # Big stacks quantize LAYER-BY-LAYER: the bf16
+                    # transient shrinks from the full [L, ...] stack
+                    # (3.5 GiB for an 8B wup) to one layer — on the
+                    # shared bench chip that headroom decides whether
+                    # the 8B rungs fit at all.
+                    subkeys = jax.random.split(key, shape[0])
+                    per = []
+                    for i in range(shape[0]):
+                        w = (
+                            jax.random.normal(
+                                subkeys[i], shape[1:], jnp.bfloat16
+                            ) / math.sqrt(fan_in)
+                        ).astype(jnp.bfloat16)
+                        q = quantize_jnp(w, self.quantization)
+                        w.delete()
+                        per.append(q)
+                    stacked = jax.tree_util.tree_map(
+                        lambda *xs: jnp.stack(xs), *per
+                    )
+                    for p in per:
+                        for leaf in jax.tree_util.tree_leaves(p):
+                            leaf.delete()
+                    return stacked
                 w = (
                     jax.random.normal(key, shape, jnp.bfloat16)
                     / math.sqrt(fan_in)
@@ -200,6 +233,8 @@ class LlamaForCausalLM:
             "wup": init_w(keys[5], (L, D, F), D, "wup"),
             "wdown": init_w(keys[6], (L, F, D), F, "wdown"),
         }
+        if self.norm_type == "nonparam_layer":
+            del layers["input_norm"], layers["post_norm"]
         if self.mlp_type == "gated_silu":
             layers["wgate"] = init_w(keys[4], (L, D, F), D, "wgate")
         if self.mlp_bias:
@@ -241,6 +276,8 @@ class LlamaForCausalLM:
             )
         if self.norm_type == "layer":
             params["final_norm_b"] = jnp.zeros((D,), dtype)
+        if self.norm_type == "nonparam_layer":
+            del params["final_norm"]
         if not self.tie_embeddings:
             if q_extra:
                 # Per-out-channel int8 regardless of the projection
@@ -269,10 +306,11 @@ class LlamaForCausalLM:
             "self_attn.v_proj.weight": ("wv", True),
             "self_attn.o_proj.weight": ("wo", True),
             "post_attention_layernorm.weight": ("post_norm", False),
-            "mlp.gate_proj.weight": ("wgate", True),
             "mlp.up_proj.weight": ("wup", True),
             "mlp.down_proj.weight": ("wdown", True),
         }
+        if self.mlp_type == "gated_silu":
+            per_layer["mlp.gate_proj.weight"] = ("wgate", True)
         if self.attention_bias:
             per_layer |= {
                 "self_attn.q_proj.bias": ("bq", False),
@@ -285,6 +323,11 @@ class LlamaForCausalLM:
                 "input_layernorm.bias": ("input_norm_b", False),
                 "post_attention_layernorm.bias": ("post_norm_b", False),
             }
+        if self.norm_type == "nonparam_layer":
+            # OLMo-1: the checkpoint has NO norm weights at all.
+            del m["model.norm.weight"]
+            del per_layer["input_layernorm.weight"]
+            del per_layer["post_attention_layernorm.weight"]
         if self.qk_norm or self.qk_norm_full:
             per_layer |= {
                 "self_attn.q_norm.weight": ("q_norm", False),
@@ -296,9 +339,9 @@ class LlamaForCausalLM:
         return m
 
     def load_params(self, path: str, dtype=None, shardings: Any | None = None) -> dict:
-        from vllm_tpu.models.loader import load_safetensors_params
+        from vllm_tpu.models.loader import load_params_from
 
-        return load_safetensors_params(self, path, dtype or self.dtype, shardings)
+        return load_params_from(self, path, dtype or self.dtype, shardings)
 
     # ------------------------------------------------------------------
     # Forward
@@ -367,6 +410,18 @@ class LlamaForCausalLM:
             from vllm_tpu.layers.layernorm import layer_norm
 
             return layer_norm(x, p[name], p[name + "_b"], self.rms_eps)
+        if self.norm_type == "nonparam_layer":
+            # OLMo-1: LayerNorm without learnable parameters.
+            import jax.numpy as _jnp
+
+            xf = x.astype(_jnp.float32)
+            mu = xf.mean(-1, keepdims=True)
+            var = ((xf - mu) ** 2).mean(-1, keepdims=True)
+            import jax as _jax
+
+            return ((xf - mu) * _jax.lax.rsqrt(var + self.rms_eps)).astype(
+                x.dtype
+            )
         return rms_norm(x, p[name], self.rms_eps)
 
     def _make_layer_fn(self, md: AttentionMetadata, t: int, *,
@@ -404,6 +459,10 @@ class LlamaForCausalLM:
                 q = q + lp["bq"]
                 k = k + lp["bk"]
                 v = v + lp["bv"]
+            if self.clip_qkv is not None:
+                q = jnp.clip(q, -self.clip_qkv, self.clip_qkv)
+                k = jnp.clip(k, -self.clip_qkv, self.clip_qkv)
+                v = jnp.clip(v, -self.clip_qkv, self.clip_qkv)
             if self.qk_norm_full:
                 # OLMo-2: RMSNorm over the FULL projected vector,
                 # pre-head-split (vs Qwen3's per-head norm below).
@@ -416,17 +475,21 @@ class LlamaForCausalLM:
                 q = rms_norm(q, lp["q_norm"], self.rms_eps)
                 k = rms_norm(k, lp["k_norm"], self.rms_eps)
 
+            rope_apply = (
+                _apply_interleaved if self.rope_interleaved
+                else _apply_rotate_half
+            )
             if rope_cos_sin is not None:
                 # Precomputed per-token tables (Qwen2-VL m-rope).
                 cos = rope_cos_sin[0][:, None, :]
                 sin = rope_cos_sin[1][:, None, :]
-                q = _apply_rotate_half(q, cos, sin, self.rope.rotary_dim)
-                k = _apply_rotate_half(k, cos, sin, self.rope.rotary_dim)
+                q = rope_apply(q, cos, sin, self.rope.rotary_dim)
+                k = rope_apply(k, cos, sin, self.rope.rotary_dim)
             elif self.position_embedding == "rope":
                 cos = rope_cos[md.positions][:, None, :]
                 sin = rope_sin[md.positions][:, None, :]
-                q = _apply_rotate_half(q, cos, sin, self.rope.rotary_dim)
-                k = _apply_rotate_half(k, cos, sin, self.rope.rotary_dim)
+                q = rope_apply(q, cos, sin, self.rope.rotary_dim)
+                k = rope_apply(k, cos, sin, self.rope.rotary_dim)
 
             kv_scale = kv_dequant_scale(kv)
             if self.cp_size > 1:
@@ -477,6 +540,8 @@ class LlamaForCausalLM:
                         v.astype(jnp.float32), approximate=True
                     ).astype(v.dtype),
                     "relu": lambda v: jax.nn.relu(v),
+                    # Nemotron/Persimmon squared ReLU.
+                    "relu2": lambda v: jnp.square(jax.nn.relu(v)),
                 }[self.mlp_act]
                 ffn_out = proj(act(up), lp, "wdown")
                 if self.mlp_bias:
@@ -680,6 +745,8 @@ class LlamaForCausalLM:
                 "input_norm_b": P(None, None),
                 "post_norm_b": P(None, None),
             }
+        if self.norm_type == "nonparam_layer":
+            del layers["input_norm"], layers["post_norm"]
         from vllm_tpu.layers.quant import Int4Linear
 
         if self.quantization in ("int4", "gptq", "awq"):
@@ -725,6 +792,8 @@ class LlamaForCausalLM:
         }
         if self.norm_type == "layer":
             out["final_norm_b"] = P(None)
+        if self.norm_type == "nonparam_layer":
+            del out["final_norm"]
         if self.position_embedding == "learned":
             out["pos_embed"] = P(None, None)
         if not self.tie_embeddings:

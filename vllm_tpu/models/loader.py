@@ -60,6 +60,65 @@ def _iter_safetensor_files(path: str) -> list[str]:
     raise FileNotFoundError(f"no safetensors checkpoint under {path}")
 
 
+def load_params_from(
+    model: Any, path: str, dtype: Any, shardings: Any | None = None
+) -> dict:
+    """Checkpoint dispatch: native (pre-assembled), ``.gguf`` file, or
+    HF safetensors dir."""
+    from vllm_tpu.models.native_ckpt import is_native_checkpoint
+
+    if is_native_checkpoint(path):
+        from vllm_tpu.models.native_ckpt import load_native
+
+        return load_native(path, shardings)
+    if path.endswith(".gguf"):
+        return load_gguf_params(model, path, dtype, shardings)
+    return load_safetensors_params(model, path, dtype, shardings)
+
+
+def load_gguf_params(
+    model: Any, path: str, dtype: Any, shardings: Any | None = None
+) -> dict:
+    """Build the model's param tree from a GGUF file.
+
+    Tensors are dequantized to f32 host-side (``models/gguf.py``) and run
+    through the same staging/quantize-at-load pipeline as safetensors —
+    with ``--quantization int8/int4`` the dequantized weights requantize
+    into the native formats, preserving the GGUF file's size advantage
+    on device.
+    """
+    from vllm_tpu.models.gguf import GGUFFile, iter_hf_tensors
+
+    weight_map = model.hf_weight_map()
+    staged: dict[str, Any] = {}
+    stacked: dict[str, list] = {}
+    stacked2: dict[str, dict] = {}
+    seen = set()
+    gf = GGUFFile(path)
+    for hf_name, arr in iter_hf_tensors(gf):
+        if hf_name not in weight_map:
+            continue
+        dest, transpose = weight_map[hf_name]
+        _stage(dest, arr.T if transpose else arr, staged, stacked, stacked2)
+        seen.add(hf_name)
+    seen_dests = {weight_map[n][0] for n in seen}
+    missing = {d for d, _ in weight_map.values() if d not in seen_dests}
+    if missing and "lm_head" in missing and getattr(
+        model.hf_config, "tie_word_embeddings", False
+    ):
+        # GGUF drops output.weight for tied embeddings.
+        missing.discard("lm_head")
+        if "embed" in staged:
+            staged["lm_head"] = staged["embed"].T
+    if missing:
+        raise ValueError(
+            f"GGUF missing {len(missing)} weights, e.g. {sorted(missing)[:3]}"
+        )
+    return _assemble_params(
+        model, staged, stacked, stacked2, dtype, shardings, path
+    )
+
+
 def load_safetensors_params(
     model: Any, path: str, dtype: Any, shardings: Any | None = None
 ) -> dict:
@@ -89,6 +148,17 @@ def load_safetensors_params(
     _Q4_SUFFIXES = (".qweight", ".qzeros", ".scales", ".g_idx")
     q4_raw: dict[str, dict[str, np.ndarray]] = {}
 
+    # compressed-tensors: quantized projections carry an int8/fp8
+    # ``.weight`` (or int32 ``.weight_packed``) plus ``.weight_scale``
+    # (+ zero_point/shape); collected per destination, converted after
+    # the scan (``layers/compressed_tensors.py``).
+    ct_scheme = getattr(model, "ckpt_ct_scheme", None)
+    _CT_SUFFIXES = (
+        ".weight_scale", ".weight_packed", ".weight_zero_point",
+        ".weight_shape",
+    )
+    ct_raw: dict[str, dict[str, np.ndarray]] = {}
+
     for file in _iter_safetensor_files(path):
         with safe_open(file, framework="numpy") as f:
             for raw_name in f.keys():
@@ -110,6 +180,37 @@ def load_safetensors_params(
                         )
                         seen.add(stem + ".weight")
                     continue
+                if ct_scheme is not None and hf_name.endswith(_CT_SUFFIXES):
+                    stem, _, kind = hf_name.rpartition(".")
+                    mapped = weight_map.get(stem + ".weight")
+                    if mapped is not None:
+                        ct_raw.setdefault(mapped[0], {})[kind] = (
+                            f.get_tensor(raw_name)
+                        )
+                        seen.add(stem + ".weight")
+                    continue
+                if (
+                    ct_scheme is not None
+                    and hf_name.endswith(".weight")
+                    and hf_name in weight_map
+                ):
+                    arr = f.get_tensor(raw_name)
+                    if (
+                        arr.dtype == np.int8
+                        or "float8" in str(arr.dtype)
+                        # safetensors/numpy surfaces F8_E4M3 as raw uint8.
+                        or (
+                            ct_scheme.native_method == "fp8"
+                            and arr.dtype == np.uint8
+                        )
+                    ):
+                        # Quantized payload: route to the CT converter
+                        # (NOT the requantize-at-load path).
+                        ct_raw.setdefault(weight_map[hf_name][0], {})[
+                            "weight"
+                        ] = arr
+                        seen.add(hf_name)
+                        continue
                 # Fused-checkpoint split (e.g. Phi-3's qkv_proj /
                 # gate_up_proj): the model may explode one tensor into
                 # several, each then mapping normally.
@@ -159,6 +260,28 @@ def load_safetensors_params(
     if missing:
         raise ValueError(f"checkpoint missing {len(missing)} weights, e.g. {sorted(missing)[:3]}")
 
+    return _assemble_params(
+        model, staged, stacked, stacked2, dtype, shardings, path,
+        q4_raw=q4_raw, ckpt_quant=ckpt_quant, ct_raw=ct_raw,
+        ct_scheme=ct_scheme,
+    )
+
+
+def _assemble_params(
+    model: Any,
+    staged: dict,
+    stacked: dict,
+    stacked2: dict,
+    dtype: Any,
+    shardings: Any | None,
+    path: str,
+    q4_raw: dict | None = None,
+    ckpt_quant: str | None = None,
+    ct_raw: dict | None = None,
+    ct_scheme: Any | None = None,
+) -> dict:
+    """Shared finalize: stage dicts -> quantize-at-load -> stacked jax
+    param pytree (used by the safetensors and GGUF loaders)."""
     params: dict = {}
     quant_method = getattr(model, "quantization", None)
     # int8/fp8/int4 quantize plain fp weights at load; gptq/awq normally
@@ -298,6 +421,70 @@ def load_safetensors_params(
                 np.stack([by_idx[i][2] for i in range(n)]),
             )
 
+    if ct_raw:
+        from vllm_tpu.layers.compressed_tensors import (
+            ct_int8_to_qlinear,
+            ct_pack_to_int4,
+        )
+        from vllm_tpu.layers.quant import QuantizedLinear
+
+        def put_qlinear(base: str, q: np.ndarray, sc: np.ndarray) -> None:
+            if ct_scheme.native_method == "fp8" and q.dtype == np.uint8:
+                import ml_dtypes
+
+                q = q.view(ml_dtypes.float8_e4m3fn)
+            jq = jnp.asarray(q)
+            leaf = QuantizedLinear(q=jq, scale=jnp.asarray(sc))
+            node = _lookup_sharding(base)
+            if isinstance(node, QuantizedLinear):
+                leaf = QuantizedLinear(
+                    q=jax.device_put(leaf.q, node.q),
+                    scale=jax.device_put(leaf.scale, node.scale),
+                )
+            _set_path(params, base, leaf)
+
+        ct_by_base: dict[str, dict[int, tuple]] = {}
+        for dest, parts in ct_raw.items():
+            if ct_scheme.native_method == "int4":
+                if "weight_packed" not in parts:
+                    raise ValueError(
+                        f"compressed-tensors pack-quantized tensor for "
+                        f"{dest} missing weight_packed"
+                    )
+                conv = ct_pack_to_int4(
+                    parts["weight_packed"], parts["weight_scale"],
+                    parts.get("weight_zero_point"),
+                    parts.get("weight_shape"), ct_scheme.group_size,
+                )
+            else:
+                w = parts.get("weight")
+                if w is None:
+                    raise ValueError(
+                        f"compressed-tensors tensor for {dest} missing "
+                        "its quantized weight"
+                    )
+                conv = ct_int8_to_qlinear(
+                    w, parts["weight_scale"], w.shape[1]
+                )
+            p = dest.split(".")
+            if p[-1].isdigit():
+                ct_by_base.setdefault(".".join(p[:-1]), {})[int(p[-1])] = conv
+            elif len(conv) == 3:
+                put_int4(dest, *conv)
+            else:
+                put_qlinear(dest, *conv)
+        for base, by_idx in ct_by_base.items():
+            n = max(by_idx) + 1
+            assert len(by_idx) == n, f"missing layers for {base}"
+            stacked_parts = [
+                np.stack([by_idx[i][j] for i in range(n)])
+                for j in range(len(by_idx[0]))
+            ]
+            if len(stacked_parts) == 3:
+                put_int4(base, *stacked_parts)
+            else:
+                put_qlinear(base, *stacked_parts)
+
     for dest, arr in staged.items():
         put(dest, arr)
     for base, by_idx in stacked.items():
@@ -317,6 +504,7 @@ def load_safetensors_params(
     logger.info("loaded %d params (%.2f GB) from %s", n_params,
                 n_params * np.dtype(np.float16).itemsize / 1e9, path)
     return params
+
 
 
 def init_dummy_params(model: Any, seed: int, dtype: Any, shardings: Any | None = None) -> dict:

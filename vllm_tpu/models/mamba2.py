@@ -166,9 +166,9 @@ class Mamba2ForCausalLM:
         return arr
 
     def load_params(self, path: str, dtype=None, shardings: Any | None = None) -> dict:
-        from vllm_tpu.models.loader import load_safetensors_params
+        from vllm_tpu.models.loader import load_params_from
 
-        return load_safetensors_params(self, path, dtype or self.dtype, shardings)
+        return load_params_from(self, path, dtype or self.dtype, shardings)
 
     # ------------------------------------------------------------------
     # Forward

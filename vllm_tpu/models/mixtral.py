@@ -17,7 +17,7 @@ from jax.sharding import PartitionSpec as P
 
 from vllm_tpu.layers.layernorm import rms_norm
 from vllm_tpu.layers.moe import fused_experts, select_experts
-from vllm_tpu.layers.rotary import _apply_rotate_half
+from vllm_tpu.layers.rotary import _apply_interleaved, _apply_rotate_half
 from vllm_tpu.models.llama import LlamaForCausalLM
 from vllm_tpu.ops.attention import (
     AttentionMetadata,
@@ -135,17 +135,31 @@ class MixtralForCausalLM(LlamaForCausalLM):
         from vllm_tpu.layers.quant import embedding_lookup
 
         x = embedding_lookup(params["embed"], input_ids, self.dtype)
+        if self.embedding_multiplier != 1.0:
+            x = x * self.embedding_multiplier
         t = x.shape[0]
         H, KH, Dh = self.num_heads, self.num_kv_heads, self.head_dim
         rope_cos, rope_sin = self.rope.cos, self.rope.sin
 
+        rope_apply = (
+            _apply_interleaved if self.rope_interleaved
+            else _apply_rotate_half
+        )
+
         def layer_fn(carry, inputs):
             x, kv = carry
             lp, li = inputs
-            h = rms_norm(x, lp["input_norm"], self.rms_eps)
+            h = self._norm(x, lp, "input_norm")
             q, k, v = h @ lp["wq"], h @ lp["wk"], h @ lp["wv"]
             if self.attention_bias:
                 q, k, v = q + lp["bq"], k + lp["bk"], v + lp["bv"]
+            if self.clip_qkv is not None:
+                q = jnp.clip(q, -self.clip_qkv, self.clip_qkv)
+                k = jnp.clip(k, -self.clip_qkv, self.clip_qkv)
+                v = jnp.clip(v, -self.clip_qkv, self.clip_qkv)
+            if self.qk_norm_full:
+                q = rms_norm(q, lp["q_norm"], self.rms_eps)
+                k = rms_norm(k, lp["k_norm"], self.rms_eps)
             q = q.reshape(t, H, Dh)
             k = k.reshape(t, KH, Dh)
             v = v.reshape(t, KH, Dh)
@@ -154,17 +168,19 @@ class MixtralForCausalLM(LlamaForCausalLM):
                 k = rms_norm(k, lp["k_norm"], self.rms_eps)
             cos = rope_cos[md.positions][:, None, :]
             sin = rope_sin[md.positions][:, None, :]
-            q = _apply_rotate_half(q, cos, sin, self.rope.rotary_dim)
-            k = _apply_rotate_half(k, cos, sin, self.rope.rotary_dim)
+            q = rope_apply(q, cos, sin, self.rope.rotary_dim)
+            k = rope_apply(k, cos, sin, self.rope.rotary_dim)
             kv = write_kv(kv, li, k, v, md.slot_mapping)
             kv_scale = kv_dequant_scale(kv)
             attn = paged_attention(
                 q, kv, li, md, self.scale, sliding_window=self.sliding_window,
                 k_scale=kv_scale, v_scale=kv_scale,
             )
-            x = x + attn.reshape(t, H * Dh) @ lp["wo"]
+            x = x + self.residual_multiplier * (
+                attn.reshape(t, H * Dh) @ lp["wo"]
+            )
 
-            h2 = rms_norm(x, lp["post_norm"], self.rms_eps)
+            h2 = self._norm(x, lp, "post_norm")
             logits = (
                 h2.astype(jnp.float32) @ lp["router"].astype(jnp.float32)
             )
@@ -208,7 +224,7 @@ class MixtralForCausalLM(LlamaForCausalLM):
                 )
                 shared = silu_and_mul(gate_up) @ lp["ws_down"]
                 moe_out = moe_out + jax.nn.sigmoid(h2 @ lp["wsg"]) * shared
-            return (x + moe_out, kv), counts_l
+            return (x + self.residual_multiplier * moe_out, kv), counts_l
 
         # Whole cache in the carry: in-place paged KV (see models/llama.py).
         (x, new_kv), counts = jax.lax.scan(
@@ -216,7 +232,7 @@ class MixtralForCausalLM(LlamaForCausalLM):
             (x, kv_cache),
             (params["layers"], jnp.arange(self.num_layers, dtype=jnp.int32)),
         )
-        x = rms_norm(x, params["final_norm"], self.rms_eps)
+        x = self._norm(x, params, "final_norm")
         if self.enable_eplb:
             return x, new_kv, counts  # counts [L, E]
         return x, new_kv

@@ -1,0 +1,221 @@
+"""MoE zoo breadth on the Mixtral graph: OLMoE, GraniteMoE, DBRX.
+
+Reference analogs: ``vllm/model_executor/models/{olmoe,granitemoe,
+dbrx}.py``. Each is flags + a weight map over ``mixtral.py``'s fused-MoE
+graph (which honors the full llama flag set: norm flavor, qk-norm,
+clip_qkv, interleaved rope, Granite multipliers).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax.numpy as jnp
+import numpy as np
+
+from vllm_tpu.models.mixtral import MixtralForCausalLM
+
+
+class OlmoeForCausalLM(MixtralForCausalLM):
+    """OLMoE-1B-7B: full-width q/k RMSNorm, every layer sparse, router
+    ``mlp.gate`` + per-expert ``mlp.experts.{j}.*_proj``."""
+
+    qk_norm_full = True
+
+    def __init__(self, hf_config: Any, dtype=jnp.bfloat16,
+                 quantization: str | None = None) -> None:
+        c = hf_config
+        if not hasattr(c, "num_local_experts"):
+            c.num_local_experts = c.num_experts
+        super().__init__(c, dtype, quantization)
+        self.renormalize = bool(getattr(c, "norm_topk_prob", False))
+        self.sliding_window = None
+
+    def hf_weight_map(self) -> dict:
+        m = super().hf_weight_map()
+        for i in range(self.num_layers):
+            hf = f"model.layers.{i}"
+            del m[f"{hf}.block_sparse_moe.gate.weight"]
+            m[f"{hf}.mlp.gate.weight"] = (f"layers.router.{i}", True)
+            for j in range(self.num_experts):
+                old = f"{hf}.block_sparse_moe.experts.{j}"
+                for k in ("w1", "w2", "w3"):
+                    del m[f"{old}.{k}.weight"]
+                new = f"{hf}.mlp.experts.{j}"
+                m[f"{new}.gate_proj.weight"] = (f"layers.we_gate.{i}.{j}", True)
+                m[f"{new}.up_proj.weight"] = (f"layers.we_up.{i}.{j}", True)
+                m[f"{new}.down_proj.weight"] = (f"layers.we_down.{i}.{j}", True)
+        return m
+
+
+class GraniteMoeForCausalLM(MixtralForCausalLM):
+    """Granite-3 MoE: Granite scalar multipliers + FUSED per-layer
+    expert tensors (``input_linear`` [E, 2F, D] = gate|up rows,
+    ``output_linear`` [E, D, F]) split per expert at load. Granite's
+    top-k-then-softmax gating equals softmax-then-top-k-renormalize
+    (softmax is monotonic; renormalizing the selected probabilities
+    reproduces a softmax over the selected logits)."""
+
+    SPLIT_SUFFIXES = (
+        ".block_sparse_moe.input_linear.weight",
+        ".block_sparse_moe.output_linear.weight",
+    )
+
+    def __init__(self, hf_config: Any, dtype=jnp.bfloat16,
+                 quantization: str | None = None) -> None:
+        c = hf_config
+        super().__init__(c, dtype, quantization)
+        self.renormalize = True
+        self.sliding_window = None
+        self.embedding_multiplier = float(
+            getattr(c, "embedding_multiplier", 1.0)
+        )
+        self.residual_multiplier = float(
+            getattr(c, "residual_multiplier", 1.0)
+        )
+        self.logits_scaling = float(getattr(c, "logits_scaling", 1.0))
+        am = getattr(c, "attention_multiplier", None)
+        if am is not None:
+            self.scale = float(am)
+
+    def split_hf_tensor(self, hf_name: str, arr):
+        arr = np.asarray(arr)
+        base = hf_name.rsplit(".", 2)[0]  # ...block_sparse_moe
+        out = []
+        if "input_linear" in hf_name:
+            e, two_f, _d = arr.shape
+            f = two_f // 2
+            for j in range(e):
+                out.append((f"{base}.split.{j}.gate.weight",
+                            np.ascontiguousarray(arr[j, :f])))
+                out.append((f"{base}.split.{j}.up.weight",
+                            np.ascontiguousarray(arr[j, f:])))
+        else:  # output_linear [E, D, F]
+            for j in range(arr.shape[0]):
+                out.append((f"{base}.split.{j}.down.weight",
+                            np.ascontiguousarray(arr[j])))
+        return out
+
+    def hf_weight_map(self) -> dict:
+        m = super().hf_weight_map()
+        for i in range(self.num_layers):
+            hf = f"model.layers.{i}"
+            del m[f"{hf}.block_sparse_moe.gate.weight"]
+            m[f"{hf}.block_sparse_moe.router.layer.weight"] = (
+                f"layers.router.{i}", True)
+            for j in range(self.num_experts):
+                old = f"{hf}.block_sparse_moe.experts.{j}"
+                for k in ("w1", "w2", "w3"):
+                    del m[f"{old}.{k}.weight"]
+                s = f"{hf}.block_sparse_moe.split.{j}"
+                # gate/up rows are [F, D] -> transpose to [D, F];
+                # output_linear slices are [D, F] -> transpose to [F, D].
+                m[f"{s}.gate.weight"] = (f"layers.we_gate.{i}.{j}", True)
+                m[f"{s}.up.weight"] = (f"layers.we_up.{i}.{j}", True)
+                m[f"{s}.down.weight"] = (f"layers.we_down.{i}.{j}", True)
+        return m
+
+
+class DbrxForCausalLM(MixtralForCausalLM):
+    """DBRX: bias-free LayerNorm (zero biases synthesized at load),
+    fused Wqkv, clip_qkv, experts stored as flat [E*F, D] stacks
+    (``w1``=gate, ``v1``=up row-transposed; ``w2``=down already
+    [F, D])."""
+
+    norm_type = "layer"
+
+    def __init__(self, hf_config: Any, dtype=jnp.bfloat16,
+                 quantization: str | None = None) -> None:
+        c = hf_config
+        ffn = getattr(c, "ffn_config", None)
+        attn = getattr(c, "attn_config", None)
+        get = (lambda o, k, d=None: (
+            o.get(k, d) if isinstance(o, dict) else getattr(o, k, d)
+        ))
+        c.num_local_experts = get(ffn, "moe_num_experts")
+        c.num_experts_per_tok = get(ffn, "moe_top_k")
+        c.intermediate_size = get(ffn, "ffn_hidden_size")
+        c.num_key_value_heads = get(attn, "kv_n_heads")
+        c.rope_theta = get(attn, "rope_theta", 10000.0)
+        norm_p = get(ffn, "moe_normalize_expert_weights", 1)
+        if norm_p not in (1, 1.0, None):
+            raise ValueError(
+                f"DBRX moe_normalize_expert_weights={norm_p} unsupported "
+                "(L1 only)"
+            )
+        c.tie_word_embeddings = False
+        super().__init__(c, dtype, quantization)
+        self.renormalize = norm_p is not None
+        clip = get(attn, "clip_qkv", None)
+        self.clip_qkv = float(clip) if clip else None
+        self.rms_eps = 1e-5
+        self.sliding_window = None
+
+    # --- fused/flat checkpoint tensors -------------------------------
+    SPLIT_SUFFIXES = (
+        ".attn.Wqkv.weight",
+        ".ffn.experts.mlp.w1",
+        ".ffn.experts.mlp.v1",
+        ".ffn.experts.mlp.w2",
+        ".norm_1.weight",
+        ".norm_2.weight",
+        "transformer.norm_f.weight",
+    )
+
+    def split_hf_tensor(self, hf_name: str, arr):
+        arr = np.asarray(arr)
+        if hf_name.endswith((".norm_1.weight", ".norm_2.weight",
+                             "norm_f.weight")):
+            # Bias-free LayerNorm: synthesize the zero bias leaf.
+            stem = hf_name[: -len(".weight")]
+            return [
+                (f"{stem}.w.weight", arr),
+                (f"{stem}.b.bias", np.zeros_like(arr)),
+            ]
+        if hf_name.endswith(".Wqkv.weight"):
+            d_q = self.num_heads * self.head_dim
+            d_kv = self.num_kv_heads * self.head_dim
+            base = hf_name.rsplit("Wqkv", 1)[0]
+            return [
+                (f"{base}q.weight", arr[:d_q]),
+                (f"{base}k.weight", arr[d_q:d_q + d_kv]),
+                (f"{base}v.weight", arr[d_q + d_kv:]),
+            ]
+        # Flat expert stacks [E*F, D].
+        e, f = self.num_experts, self.moe_intermediate
+        kind = hf_name.rsplit(".", 1)[1]  # w1 | v1 | w2
+        base = hf_name.rsplit(".", 1)[0]
+        per = arr.reshape(e, f, arr.shape[-1])
+        return [
+            (f"{base}.{kind}.split.{j}.weight",
+             np.ascontiguousarray(per[j]))
+            for j in range(e)
+        ]
+
+    def hf_weight_map(self) -> dict:
+        m = {
+            "transformer.wte.weight": ("embed", False),
+            "transformer.norm_f.w.weight": ("final_norm", False),
+            "transformer.norm_f.b.bias": ("final_norm_b", False),
+            "lm_head.weight": ("lm_head", True),
+        }
+        for i in range(self.num_layers):
+            hf = f"transformer.blocks.{i}"
+            b = "layers"
+            nan = f"{hf}.norm_attn_norm"
+            m[f"{nan}.norm_1.w.weight"] = (f"{b}.input_norm.{i}", False)
+            m[f"{nan}.norm_1.b.bias"] = (f"{b}.input_norm_b.{i}", False)
+            m[f"{nan}.norm_2.w.weight"] = (f"{b}.post_norm.{i}", False)
+            m[f"{nan}.norm_2.b.bias"] = (f"{b}.post_norm_b.{i}", False)
+            for ours in ("q", "k", "v"):
+                m[f"{nan}.attn.{ours}.weight"] = (f"{b}.w{ours}.{i}", True)
+            m[f"{nan}.attn.out_proj.weight"] = (f"{b}.wo.{i}", True)
+            m[f"{hf}.ffn.router.layer.weight"] = (f"{b}.router.{i}", True)
+            for j in range(self.num_experts):
+                mlp = f"{hf}.ffn.experts.mlp"
+                # w1/v1 slices are [F, D] -> transpose; w2 slices are
+                # already [F, D] = our down layout (no transpose).
+                m[f"{mlp}.w1.split.{j}.weight"] = (f"{b}.we_gate.{i}.{j}", True)
+                m[f"{mlp}.v1.split.{j}.weight"] = (f"{b}.we_up.{i}.{j}", True)
+                m[f"{mlp}.w2.split.{j}.weight"] = (f"{b}.we_down.{i}.{j}", False)
+        return m

@@ -1,0 +1,49 @@
+"""Nemotron (Minitron/Nemotron-4) — Llama graph with squared-ReLU plain
+MLP and LayerNorm1p.
+
+Reference analog: ``vllm/model_executor/models/nemotron.py``. Flags:
+plain (ungated) MLP with ``relu2`` activation, partial rotary, and
+"layernorm1p" — LayerNorm whose effective weight is ``1 + w`` (the
+checkpoint stores zero-centered weights; ``postprocess_weight`` adds 1
+at load so the standard LayerNorm path applies).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax.numpy as jnp
+import numpy as np
+
+from vllm_tpu.models.llama import LlamaForCausalLM
+
+
+class NemotronForCausalLM(LlamaForCausalLM):
+    norm_type = "layer"
+    mlp_type = "plain"
+    mlp_act = "relu2"
+    supports_lora = False
+
+    def __init__(self, hf_config: Any, dtype=jnp.bfloat16,
+                 quantization: str | None = None) -> None:
+        c = hf_config
+        prf = getattr(c, "partial_rotary_factor", None)
+        if prf is None:
+            prf = getattr(c, "rope_percent", getattr(c, "rope_percentage", 0.5))
+        c.partial_rotary_factor = prf
+        super().__init__(c, dtype, quantization)
+        self.rms_eps = getattr(c, "norm_eps", 1e-5)
+
+    def postprocess_weight(self, leaf_path: str, arr):
+        # layernorm1p: weight acts as (1 + w).
+        if leaf_path.endswith(("input_norm", "post_norm", "final_norm")):
+            return np.asarray(arr) + 1.0
+        return arr
+
+    def hf_weight_map(self) -> dict:
+        m = super().hf_weight_map()
+        # Nemotron names the plain-MLP projections up_proj/down_proj —
+        # the base plain-MLP map expects them on wup/wdown already via
+        # the llama names; drop the gate entry the base never adds for
+        # plain MLPs. Only the norm bias names match LayerNorm defaults.
+        return m

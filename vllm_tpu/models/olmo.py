@@ -1,0 +1,35 @@
+"""OLMo (v1) — Llama graph with NON-PARAMETRIC LayerNorm.
+
+Reference analog: ``vllm/model_executor/models/olmo.py``. Differences
+from Llama: every norm is ``F.layer_norm`` with no learnable weight or
+bias (``norm_type = "nonparam_layer"`` — the checkpoint carries no norm
+tensors at all), optional ``clip_qkv`` clamps the q/k/v projections, no
+biases anywhere, untied head.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax.numpy as jnp
+
+from vllm_tpu.models.llama import LlamaForCausalLM
+
+
+class OlmoForCausalLM(LlamaForCausalLM):
+    norm_type = "nonparam_layer"
+    supports_lora = False
+
+    def __init__(self, hf_config: Any, dtype=jnp.bfloat16,
+                 quantization: str | None = None) -> None:
+        super().__init__(hf_config, dtype, quantization)
+        # OLMo's LayerNorm runs at eps 1e-5 (config carries no
+        # rms_norm_eps).
+        self.rms_eps = 1e-5
+        clip = getattr(hf_config, "clip_qkv", None)
+        self.clip_qkv = float(clip) if clip else None
+
+    def hf_weight_map(self) -> dict:
+        m = super().hf_weight_map()
+        # The nonparam-norm base map already dropped the norm entries.
+        return m

@@ -269,9 +269,9 @@ class Qwen3NextForCausalLM:
         return arr
 
     def load_params(self, path: str, dtype=None, shardings=None) -> dict:
-        from vllm_tpu.models.loader import load_safetensors_params
+        from vllm_tpu.models.loader import load_params_from
 
-        return load_safetensors_params(
+        return load_params_from(
             self, path, dtype or self.dtype, shardings
         )
 

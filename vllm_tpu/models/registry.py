@@ -49,6 +49,16 @@ _REGISTRY: dict[str, tuple[str, str]] = {
     # decoder prompts and its config may leave decoder_start_token_id
     # unset — advertising it would serve wrong-language output.)
     "BartForConditionalGeneration": ("vllm_tpu.models.bart", "BartForConditionalGeneration"),
+    "WhisperForConditionalGeneration": ("vllm_tpu.models.whisper", "WhisperForConditionalGeneration"),
+    "CohereForCausalLM": ("vllm_tpu.models.cohere", "CohereForCausalLM"),
+    "OlmoForCausalLM": ("vllm_tpu.models.olmo", "OlmoForCausalLM"),
+    "GlmForCausalLM": ("vllm_tpu.models.glm", "GlmForCausalLM"),
+    "NemotronForCausalLM": ("vllm_tpu.models.nemotron", "NemotronForCausalLM"),
+    "Starcoder2ForCausalLM": ("vllm_tpu.models.gpt_like", "Starcoder2ForCausalLM"),
+    "GPTJForCausalLM": ("vllm_tpu.models.gpt_like", "GPTJForCausalLM"),
+    "OlmoeForCausalLM": ("vllm_tpu.models.moe_zoo", "OlmoeForCausalLM"),
+    "GraniteMoeForCausalLM": ("vllm_tpu.models.moe_zoo", "GraniteMoeForCausalLM"),
+    "DbrxForCausalLM": ("vllm_tpu.models.moe_zoo", "DbrxForCausalLM"),
 }
 
 

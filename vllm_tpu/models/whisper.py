@@ -1,21 +1,19 @@
-"""BART-class encoder-decoder with cross-attention KV state.
+"""Whisper: audio encoder-decoder for speech-to-text.
 
-Reference analog: ``vllm/model_executor/models/bart.py`` +
-``vllm/v1/core/single_type_kv_cache_manager.py:1069``
-(``CrossAttentionManager``) and ``kv_cache_interface.py:568``
-(``CrossAttentionSpec``). The reference allocates cross-attention KV in
-paged blocks sized by the encoder length; TPU-first the cross KV is a
-SLOT-ADDRESSED constant-size state (like the Mamba state slots): one
-``[L_dec, slots, S_enc_max, kv_rows, lanes]`` buffer, written ONCE per
-request when its encoder runs, read-only during decode. The engine
-plumbing rides the multimodal encoder machinery (the encoder input is
-the request's "image": scheduled once, freed with the request) and the
-hybrid-model state-slot machinery (``md.state_slots``).
+Reference analog: ``vllm/model_executor/models/whisper.py`` and the
+``speech_to_text`` OpenAI API surface (``vllm/entrypoints/openai/
+speech_to_text/``). Rides the same TPU-first cross-attention machinery
+as BART (``models/bart.py``): the encoder runs ONCE per request through
+the runner's encoder hook and writes a slot-addressed cross-KV buffer;
+the decoder is the engine's paged per-step forward.
 
-HF semantics (transformers ``modeling_bart.py``): post-LN residual
-blocks, learned positions with a +2 offset, ``layernorm_embedding``
-after (scaled) token+position embedding, GELU MLPs, biases everywhere,
-tied lm_head plus ``final_logits_bias``.
+HF semantics (transformers ``modeling_whisper.py``): log-mel input
+``[n_mels, 3000]`` -> conv1d(k=3, pad 1) -> GELU -> conv1d(k=3, stride
+2, pad 1) -> GELU -> +sinusoidal positions -> PRE-norm encoder blocks ->
+final LN. Decoder: token embed + LEARNED positions (no offset), pre-norm
+blocks (self-attn, cross-attn, MLP), final LN, tied lm_head. No k-proj
+bias anywhere (HF sets it zero); audio is always padded to 30 s, so the
+encoder attends all ``max_source_positions`` (no cross mask).
 """
 
 from __future__ import annotations
@@ -47,13 +45,17 @@ def _layer_norm(x, w, b, eps=1e-5):
     ).astype(x.dtype)
 
 
-class BartForConditionalGeneration:
-    """Encoder-decoder generation; the engine's "prompt" is the ENCODER
-    input, the decoder starts from ``decoder_start_token_id``."""
+class WhisperForConditionalGeneration:
+    """The engine's "prompt" is the DECODER prompt (forced decoder ids:
+    ``<|startoftranscript|><|lang|><|task|>...``); the audio features
+    arrive as ``multi_modal_data={"audio": mel}``."""
 
     is_encoder_decoder = True
+    # The prompt is decoder-side; audio rides multi_modal_data (the
+    # input processor keys on this to skip BART's prompt-as-encoder-input
+    # convention).
+    audio_encoder_decoder = True
     supports_lora = False
-    # Set by the worker before alloc_kv_cache (cross-KV slot count).
     max_state_slots = 256
 
     def __init__(self, hf_config: Any, dtype=jnp.bfloat16,
@@ -68,26 +70,22 @@ class BartForConditionalGeneration:
         self.hidden_size = c.d_model
         self.vocab_size = c.vocab_size
         self.enc_layers = c.encoder_layers
-        self.num_layers = c.decoder_layers  # loader/runner convention
+        self.num_layers = c.decoder_layers
         self.enc_heads = c.encoder_attention_heads
         self.num_heads = c.decoder_attention_heads
-        self.num_kv_heads = c.decoder_attention_heads  # no GQA in BART
+        self.num_kv_heads = c.decoder_attention_heads
         self.head_dim = c.d_model // c.decoder_attention_heads
         self.enc_ffn = c.encoder_ffn_dim
         self.dec_ffn = c.decoder_ffn_dim
         self.scale = self.head_dim ** -0.5
-        self.embed_scale = (
-            math.sqrt(c.d_model) if getattr(c, "scale_embedding", False)
-            else 1.0
-        )
-        self.max_position = c.max_position_embeddings
-        self.max_encoder_len = c.max_position_embeddings
+        self.n_mels = c.num_mel_bins
+        # Encoder positions AFTER the stride-2 conv; raw mel frames = 2x.
+        self.max_encoder_len = c.max_source_positions
+        self.max_source_frames = 2 * c.max_source_positions
+        self.max_position = c.max_target_positions
         self.decoder_start_token_id = c.decoder_start_token_id
-        self.pad_token_id = getattr(c, "pad_token_id", 0) or 0
         self.sliding_window = None
 
-    # ------------------------------------------------------------------
-    # Params
     # ------------------------------------------------------------------
 
     def init_dummy_params(self, rng: jax.Array, dtype=None) -> dict:
@@ -105,7 +103,7 @@ class BartForConditionalGeneration:
             hd = h * Dh
             return {
                 "wq": init((le, D, hd), D), "bq": jnp.zeros((le, hd), dtype),
-                "wk": init((le, D, hd), D), "bk": jnp.zeros((le, hd), dtype),
+                "wk": init((le, D, hd), D),
                 "wv": init((le, D, hd), D), "bv": jnp.zeros((le, hd), dtype),
                 "wo": init((le, hd, D), hd), "bo": jnp.zeros((le, D), dtype),
             }
@@ -130,40 +128,60 @@ class BartForConditionalGeneration:
         dec["ln1_w"], dec["ln1_b"] = ln(Ld)
         dec["ln2_w"], dec["ln2_b"] = ln(Ld)
         dec["ln3_w"], dec["ln3_b"] = ln(Ld)
+        # Sinusoidal encoder positions (HF stores them as a buffer-like
+        # weight; synthesize the same table for dummy init).
+        pos = self._sinusoids(self.max_encoder_len, D).astype(dtype)
         return {
             "embed": init((V, D), D),
-            "enc_pos": init((self.max_position + 2, D), D),
-            "dec_pos": init((self.max_position + 2, D), D),
-            "ln_emb_enc_w": jnp.ones((D,), dtype),
-            "ln_emb_enc_b": jnp.zeros((D,), dtype),
-            "ln_emb_dec_w": jnp.ones((D,), dtype),
-            "ln_emb_dec_b": jnp.zeros((D,), dtype),
+            "conv1_w": init((3, self.n_mels, D), 3 * self.n_mels),
+            "conv1_b": jnp.zeros((D,), dtype),
+            "conv2_w": init((3, D, D), 3 * D),
+            "conv2_b": jnp.zeros((D,), dtype),
+            "enc_pos": pos,
+            "dec_pos": init((self.max_position, D), D),
+            "ln_enc_w": jnp.ones((D,), dtype),
+            "ln_enc_b": jnp.zeros((D,), dtype),
+            "ln_dec_w": jnp.ones((D,), dtype),
+            "ln_dec_b": jnp.zeros((D,), dtype),
             "enc": enc,
             "dec": dec,
-            "final_logits_bias": jnp.zeros((V,), jnp.float32),
         }
+
+    @staticmethod
+    def _sinusoids(length: int, channels: int) -> jnp.ndarray:
+        """HF ``sinusoids()``: interleaved [sin | cos] halves."""
+        log_timescale = math.log(10000.0) / (channels // 2 - 1)
+        inv = jnp.exp(-log_timescale * jnp.arange(channels // 2))
+        t = jnp.arange(length)[:, None].astype(jnp.float32) * inv[None, :]
+        return jnp.concatenate([jnp.sin(t), jnp.cos(t)], axis=1)
 
     def hf_weight_map(self) -> dict:
         m = {
-            "model.shared.weight": ("embed", False),
+            "model.decoder.embed_tokens.weight": ("embed", False),
+            "model.encoder.conv1.weight": ("conv1_w", False),
+            "model.encoder.conv1.bias": ("conv1_b", False),
+            "model.encoder.conv2.weight": ("conv2_w", False),
+            "model.encoder.conv2.bias": ("conv2_b", False),
             "model.encoder.embed_positions.weight": ("enc_pos", False),
             "model.decoder.embed_positions.weight": ("dec_pos", False),
-            "model.encoder.layernorm_embedding.weight": ("ln_emb_enc_w", False),
-            "model.encoder.layernorm_embedding.bias": ("ln_emb_enc_b", False),
-            "model.decoder.layernorm_embedding.weight": ("ln_emb_dec_w", False),
-            "model.decoder.layernorm_embedding.bias": ("ln_emb_dec_b", False),
-            "final_logits_bias": ("final_logits_bias", False),
+            "model.encoder.layer_norm.weight": ("ln_enc_w", False),
+            "model.encoder.layer_norm.bias": ("ln_enc_b", False),
+            "model.decoder.layer_norm.weight": ("ln_dec_w", False),
+            "model.decoder.layer_norm.bias": ("ln_dec_b", False),
         }
 
-        def attn_map(hf_base, dest_base, i):
+        def attn_map(hf_base, dest_base, i, k_bias: bool):
             for hf_n, ours in (("q_proj", "q"), ("k_proj", "k"),
                                ("v_proj", "v"), ("out_proj", "o")):
                 m[f"{hf_base}.{hf_n}.weight"] = (f"{dest_base}w{ours}.{i}", True)
-                m[f"{hf_base}.{hf_n}.bias"] = (f"{dest_base}b{ours}.{i}", False)
+                if hf_n != "k_proj":
+                    m[f"{hf_base}.{hf_n}.bias"] = (
+                        f"{dest_base}b{ours}.{i}", False
+                    )
 
         for i in range(self.enc_layers):
             hf = f"model.encoder.layers.{i}"
-            attn_map(f"{hf}.self_attn", "enc.s_", i)
+            attn_map(f"{hf}.self_attn", "enc.s_", i, False)
             m[f"{hf}.self_attn_layer_norm.weight"] = (f"enc.ln1_w.{i}", False)
             m[f"{hf}.self_attn_layer_norm.bias"] = (f"enc.ln1_b.{i}", False)
             m[f"{hf}.fc1.weight"] = (f"enc.fc1.{i}", True)
@@ -174,8 +192,8 @@ class BartForConditionalGeneration:
             m[f"{hf}.final_layer_norm.bias"] = (f"enc.ln2_b.{i}", False)
         for i in range(self.num_layers):
             hf = f"model.decoder.layers.{i}"
-            attn_map(f"{hf}.self_attn", "dec.s_", i)
-            attn_map(f"{hf}.encoder_attn", "dec.c_", i)
+            attn_map(f"{hf}.self_attn", "dec.s_", i, False)
+            attn_map(f"{hf}.encoder_attn", "dec.c_", i, False)
             m[f"{hf}.self_attn_layer_norm.weight"] = (f"dec.ln1_w.{i}", False)
             m[f"{hf}.self_attn_layer_norm.bias"] = (f"dec.ln1_b.{i}", False)
             m[f"{hf}.encoder_attn_layer_norm.weight"] = (f"dec.ln2_w.{i}", False)
@@ -189,69 +207,80 @@ class BartForConditionalGeneration:
         return m
 
     def postprocess_weight(self, leaf_path: str, arr):
-        if leaf_path == "final_logits_bias":
-            return arr.reshape(-1)  # HF stores [1, V]
+        if leaf_path in ("conv1_w", "conv2_w"):
+            # HF conv1d weight [out, in, k] -> our [k, in, out] (matches
+            # jnp.einsum over a gathered window below).
+            return arr.transpose(2, 1, 0)
         return arr
 
     def load_params(self, path: str, dtype=None, shardings=None) -> dict:
         from vllm_tpu.models.loader import load_params_from
 
-        return load_params_from(
-            self, path, dtype or self.dtype, shardings
-        )
+        return load_params_from(self, path, dtype or self.dtype, shardings)
 
     # ------------------------------------------------------------------
-    # Encoder (runs ONCE per request, via the runner's encoder hook)
+    # Encoder (runner hook; runs once per request)
     # ------------------------------------------------------------------
 
     def encode_cross(
-        self, params: dict, enc_ids: jnp.ndarray, enc_len: jnp.ndarray
+        self, params: dict, features: jnp.ndarray, n_frames: jnp.ndarray
     ) -> jnp.ndarray:
-        """Encoder forward + per-DECODER-layer cross K/V projection.
-
-        ``enc_ids`` is padded to ``max_encoder_len``; returns the cross
-        KV block ``[L_dec, S_max, kv_rows, lanes]`` ready to drop into
-        the request's cross-cache slot (padding rows are garbage — reads
-        are masked by the stored ``enc_len``)."""
-        s = enc_ids.shape[0]
+        """``features [2*S, n_mels]`` (mel frames, zero-padded to 30 s
+        like the HF feature extractor) -> cross-KV block
+        ``[L_dec, S, kv_rows, lanes]``. ``n_frames`` is unused (Whisper
+        attends the full padded window) but kept for hook symmetry."""
+        del n_frames
         D, H, Dh = self.hidden_size, self.enc_heads, self.head_dim
-        valid = jnp.arange(s) < enc_len  # [S]
+        frames = features.shape[0]
+        s = frames // 2
 
-        x = params["embed"][enc_ids].astype(self.dtype) * self.embed_scale
-        x = x + params["enc_pos"][jnp.arange(s) + 2].astype(self.dtype)
-        x = _layer_norm(x, params["ln_emb_enc_w"], params["ln_emb_enc_b"])
+        x = features.astype(self.dtype)  # [F, M]
+
+        def conv1d(x, w, b, stride):
+            # x [F, C_in], w [k, C_in, C_out], 'same' padding (k=3).
+            xp = jnp.pad(x, ((1, 1), (0, 0)))
+            windows = jnp.stack(
+                [xp[i:i + x.shape[0]:stride] for i in range(3)], axis=1
+            )  # [F_out, 3, C_in]
+            return jnp.einsum("fkc,kcd->fd", windows, w) + b
+
+        x = jax.nn.gelu(
+            conv1d(x, params["conv1_w"], params["conv1_b"], 1)
+            .astype(jnp.float32), approximate=False,
+        ).astype(self.dtype)
+        x = jax.nn.gelu(
+            conv1d(x, params["conv2_w"], params["conv2_b"], 2)
+            .astype(jnp.float32), approximate=False,
+        ).astype(self.dtype)  # [S, D]
+        x = x + params["enc_pos"][:s].astype(self.dtype)
 
         def layer(x, lp):
-            h = x
+            h = _layer_norm(x, lp["ln1_w"], lp["ln1_b"])
             q = (h @ lp["s_wq"] + lp["s_bq"]).reshape(s, H, Dh)
-            k = (h @ lp["s_wk"] + lp["s_bk"]).reshape(s, H, Dh)
+            k = (h @ lp["s_wk"]).reshape(s, H, Dh)
             v = (h @ lp["s_wv"] + lp["s_bv"]).reshape(s, H, Dh)
             scores = jnp.einsum(
                 "qhd,khd->hqk", q.astype(jnp.float32),
                 k.astype(jnp.float32),
             ) * self.scale
-            scores = jnp.where(valid[None, None, :], scores, -jnp.inf)
             probs = jax.nn.softmax(scores, axis=-1)
-            probs = jnp.where(jnp.isnan(probs), 0.0, probs)
             attn = jnp.einsum(
                 "hqk,khd->qhd", probs, v.astype(jnp.float32)
             ).reshape(s, H * Dh).astype(self.dtype)
-            x = _layer_norm(
-                x + (attn @ lp["s_wo"] + lp["s_bo"]), lp["ln1_w"], lp["ln1_b"]
-            )
+            x = x + (attn @ lp["s_wo"] + lp["s_bo"])
+            h = _layer_norm(x, lp["ln2_w"], lp["ln2_b"])
             f = jax.nn.gelu(
-                (x @ lp["fc1"] + lp["b1"]).astype(jnp.float32), approximate=False
+                (h @ lp["fc1"] + lp["b1"]).astype(jnp.float32),
+                approximate=False,
             ).astype(self.dtype)
-            return _layer_norm(
-                x + (f @ lp["fc2"] + lp["b2"]), lp["ln2_w"], lp["ln2_b"]
-            ), None
+            return x + (f @ lp["fc2"] + lp["b2"]), None
 
         x, _ = jax.lax.scan(lambda c, lp: layer(c, lp), x, params["enc"])
+        x = _layer_norm(x, params["ln_enc_w"], params["ln_enc_b"])
 
-        # Per-decoder-layer cross K/V, packed in the cache row layout.
         KH = self.num_kv_heads
         dec = params["dec"]
-        k_c = jnp.einsum("sd,lde->lse", x, dec["c_wk"]) + dec["c_bk"][:, None]
+        k_c = jnp.einsum("sd,lde->lse", x, dec["c_wk"])
         v_c = jnp.einsum("sd,lde->lse", x, dec["c_wv"]) + dec["c_bv"][:, None]
         k_c = k_c.reshape(self.num_layers, s, KH, Dh)
         v_c = v_c.reshape(self.num_layers, s, KH, Dh)
@@ -262,61 +291,56 @@ class BartForConditionalGeneration:
         ).astype(self.dtype)
 
     # ------------------------------------------------------------------
-    # Decoder (the engine's per-step forward)
+    # Decoder
     # ------------------------------------------------------------------
 
     def apply(
         self,
         params: dict,
         kv_cache: dict,  # {"paged", "cross", "cross_len"}
-        input_ids: jnp.ndarray,  # [T] decoder tokens
+        input_ids: jnp.ndarray,
         md: AttentionMetadata,
         token_lora_slot: jnp.ndarray | None = None,  # unused
     ) -> tuple[jnp.ndarray, dict]:
         t = input_ids.shape[0]
-        D, H, KH, Dh = (
-            self.hidden_size, self.num_heads, self.num_kv_heads,
-            self.head_dim,
-        )
+        H, KH, Dh = self.num_heads, self.num_kv_heads, self.head_dim
         paged = kv_cache["paged"]
-        cross = kv_cache["cross"]  # [Ld, slots, S, rows, lanes]
-        cross_len = kv_cache["cross_len"]  # [slots]
+        cross = kv_cache["cross"]
+        cross_len = kv_cache["cross_len"]
         assert md.state_slots is not None, "enc-dec model needs state slots"
         tok_slot = md.state_slots[
             jnp.clip(md.token_req_idx, 0, md.state_slots.shape[0] - 1)
-        ]  # [T]
+        ]
         s_max = cross.shape[2]
         packed = packed_kv_layout(Dh)
         kv_scale = kv_dequant_scale(paged)
 
-        x = params["embed"][input_ids].astype(self.dtype) * self.embed_scale
+        x = params["embed"][input_ids].astype(self.dtype)
         x = x + params["dec_pos"][
-            jnp.clip(md.positions + 2, 0, params["dec_pos"].shape[0] - 1)
+            jnp.clip(md.positions, 0, params["dec_pos"].shape[0] - 1)
         ].astype(self.dtype)
-        x = _layer_norm(x, params["ln_emb_dec_w"], params["ln_emb_dec_b"])
 
         tok_valid = (
             jnp.arange(s_max)[None, :] < cross_len[tok_slot][:, None]
-        )  # [T, S]
+        )
 
         def layer(carry, inp):
             x, paged = carry
             lp, li = inp
-            # Self-attention over the paged decoder cache.
-            q = (x @ lp["s_wq"] + lp["s_bq"]).reshape(t, H, Dh)
-            k = (x @ lp["s_wk"] + lp["s_bk"]).reshape(t, KH, Dh)
-            v = (x @ lp["s_wv"] + lp["s_bv"]).reshape(t, KH, Dh)
+            h = _layer_norm(x, lp["ln1_w"], lp["ln1_b"])
+            q = (h @ lp["s_wq"] + lp["s_bq"]).reshape(t, H, Dh)
+            k = (h @ lp["s_wk"]).reshape(t, KH, Dh)
+            v = (h @ lp["s_wv"] + lp["s_bv"]).reshape(t, KH, Dh)
             paged = write_kv(paged, li, k, v, md.slot_mapping)
             attn = paged_attention(
                 q, paged, li, md, self.scale,
                 k_scale=kv_scale, v_scale=kv_scale,
             ).reshape(t, H * Dh)
-            x = _layer_norm(
-                x + (attn @ lp["s_wo"] + lp["s_bo"]), lp["ln1_w"], lp["ln1_b"]
-            )
-            # Cross-attention over the request's encoder slot (read-only).
-            qc = (x @ lp["c_wq"] + lp["c_bq"]).reshape(t, H, Dh)
-            kv_rows = cross[li][tok_slot]  # [T, S, rows, lanes]
+            x = x + (attn @ lp["s_wo"] + lp["s_bo"])
+
+            h = _layer_norm(x, lp["ln2_w"], lp["ln2_b"])
+            qc = (h @ lp["c_wq"] + lp["c_bq"]).reshape(t, H, Dh)
+            kv_rows = cross[li][tok_slot]
             if packed:
                 k_c = kv_rows[..., :Dh]
                 v_c = kv_rows[..., Dh:]
@@ -333,31 +357,30 @@ class BartForConditionalGeneration:
             attn_c = jnp.einsum(
                 "ths,tshd->thd", probs, v_c.astype(jnp.float32)
             ).reshape(t, H * Dh).astype(self.dtype)
-            x = _layer_norm(
-                x + (attn_c @ lp["c_wo"] + lp["c_bo"]),
-                lp["ln2_w"], lp["ln2_b"],
-            )
+            x = x + (attn_c @ lp["c_wo"] + lp["c_bo"])
+
+            h = _layer_norm(x, lp["ln3_w"], lp["ln3_b"])
             f = jax.nn.gelu(
-                (x @ lp["fc1"] + lp["b1"]).astype(jnp.float32),
+                (h @ lp["fc1"] + lp["b1"]).astype(jnp.float32),
                 approximate=False,
             ).astype(self.dtype)
-            x = _layer_norm(
-                x + (f @ lp["fc2"] + lp["b2"]), lp["ln3_w"], lp["ln3_b"]
-            )
+            x = x + (f @ lp["fc2"] + lp["b2"])
             return (x, paged), None
 
         (x, paged), _ = jax.lax.scan(
             layer, (x, paged),
             (params["dec"], jnp.arange(self.num_layers, dtype=jnp.int32)),
         )
+        x = _layer_norm(x, params["ln_dec_w"], params["ln_dec_b"])
         return x, {"paged": paged, "cross": cross, "cross_len": cross_len}
 
     def compute_logits(self, params: dict, hidden: jnp.ndarray) -> jnp.ndarray:
-        logits = hidden @ params["embed"].T.astype(hidden.dtype)
-        return logits.astype(jnp.float32) + params["final_logits_bias"]
+        return (hidden @ params["embed"].T.astype(hidden.dtype)).astype(
+            jnp.float32
+        )
 
     # ------------------------------------------------------------------
-    # Runner contracts
+    # Runner contracts (identical shape to BART's)
     # ------------------------------------------------------------------
 
     def get_kv_cache_spec(self, block_size: int, dtype_bytes: int) -> dict[str, KVCacheSpec]:
@@ -370,9 +393,6 @@ class BartForConditionalGeneration:
         return {f"dec.{i}": spec for i in range(self.num_layers)}
 
     def fixed_state_bytes(self, max_slots: int) -> int:
-        """Cross-KV budget: the slot buffer the paged-cache sizing must
-        leave room for (CrossAttentionSpec analog). Uses the buffer's
-        REAL element size (it is allocated in the model dtype)."""
         elem = jnp.dtype(self.dtype).itemsize
         rows_bytes = 2 * self.num_kv_heads * self.head_dim * elem
         return (
@@ -381,7 +401,7 @@ class BartForConditionalGeneration:
         )
 
     def alloc_kv_cache(self, num_blocks: int, block_size: int, dtype) -> dict:
-        s = self.max_state_slots + 1  # last slot = padding scratch
+        s = self.max_state_slots + 1
         return {
             "paged": jnp.zeros(
                 kv_cache_shape(
@@ -391,8 +411,6 @@ class BartForConditionalGeneration:
                 dtype,
             ),
             "cross": jnp.zeros(
-                # Same row layout as the paged cache, with slots in place
-                # of blocks and the max encoder length as "block size".
                 kv_cache_shape(
                     self.num_layers, s, self.max_encoder_len,
                     self.num_kv_heads, self.head_dim,

@@ -34,6 +34,9 @@ class MMInput:
     # is then the single first decoder position, gating WHEN the encoder
     # must have run, not an embedding overlay).
     encoder_token_ids: Any = field(repr=False, default=None)
+    # Audio encoder-decoder (Whisper-class): mel features
+    # np [frames, n_mels] f32 in place of encoder token ids.
+    encoder_features: Any = field(repr=False, default=None)
 
 
 def preprocess_image(

@@ -27,6 +27,11 @@ class StructuredOutputParams:
     regex: str | None = None
     grammar: str | None = None
     choice: list[str] | None = None
+    # Per-request recursion bound for the depth-bounded CFG/JSON-schema
+    # expansion (None -> VLLM_TPU_GRAMMAR_MAX_DEPTH). Deeply-nested
+    # grammars that the default rejects can raise it; simple grammars
+    # can lower it for faster compiles.
+    max_depth: int | None = None
 
     @property
     def is_set(self) -> bool:

@@ -41,13 +41,19 @@ def spec_to_regex(so: StructuredOutputParams) -> str:
     if so.json_schema is not None:
         if so.json_schema in ("", {}, "{}"):  # json_object mode
             return any_json_value_regex()
-        return build_regex_from_schema(so.json_schema)
+        return build_regex_from_schema(
+            so.json_schema, max_depth=so.max_depth
+        )
     if so.grammar is not None:
         from vllm_tpu import envs
         from vllm_tpu.structured_output.ebnf import ebnf_to_regex
 
         return ebnf_to_regex(
-            so.grammar, max_depth=envs.VLLM_TPU_GRAMMAR_MAX_DEPTH
+            so.grammar,
+            max_depth=(
+                so.max_depth if so.max_depth is not None
+                else envs.VLLM_TPU_GRAMMAR_MAX_DEPTH
+            ),
         )
     raise ValueError("empty structured output spec")
 
@@ -61,6 +67,7 @@ def _spec_key(so: StructuredOutputParams) -> str:
             "regex": so.regex,
             "choice": so.choice,
             "grammar": so.grammar,
+            "max_depth": so.max_depth,
         },
         sort_keys=True,
     )

@@ -1,0 +1,219 @@
+"""Self-contained mistral-tekken tokenizer (``tekken.json``).
+
+Reference analog: ``vllm/tokenizers/mistral.py`` — which delegates to the
+``mistral_common`` package (not in this image). This is a dependency-free
+reader for the tekken format: a tiktoken-style byte-BPE with a unicode
+split pattern, base64 token bytes ranked by merge priority, and a block
+of special tokens occupying the first ids.
+
+Exposes the tokenizer surface the engine consumes (``encode``,
+``decode``, ``convert_tokens_to_ids``, ``eos_token_id``,
+``apply_chat_template``), so a Mistral-family checkpoint shipping only
+``tekken.json`` serves text prompts and chat without ``mistral_common``.
+"""
+
+from __future__ import annotations
+
+import base64
+import json
+import os
+from typing import Any
+
+_FALLBACK_SPECIALS = [
+    "<unk>", "<s>", "</s>", "[INST]", "[/INST]",
+    "[AVAILABLE_TOOLS]", "[/AVAILABLE_TOOLS]", "[TOOL_RESULTS]",
+    "[/TOOL_RESULTS]", "[TOOL_CALLS]",
+]
+
+
+class TekkenTokenizer:
+    def __init__(self, path: str) -> None:
+        """``path``: a tekken.json file or a directory containing one."""
+        if os.path.isdir(path):
+            path = os.path.join(path, "tekken.json")
+        with open(path) as f:
+            data = json.load(f)
+        cfg = data.get("config", {})
+        self.pattern = cfg.get("pattern")
+        vocab = data.get("vocab", [])
+        n_special = int(cfg.get("default_num_special_tokens", 1000))
+        vocab_size = int(cfg.get("default_vocab_size") or
+                         n_special + len(vocab))
+        self.num_special = n_special
+        self.vocab_size = vocab_size
+
+        # rank -> bytes for regular tokens; merge table bytes -> rank.
+        n_regular = vocab_size - n_special
+        self._rank_bytes: list[bytes] = []
+        self._ranks: dict[bytes, int] = {}
+        for i, entry in enumerate(vocab[:n_regular]):
+            b = base64.b64decode(entry["token_bytes"])
+            self._rank_bytes.append(b)
+            self._ranks.setdefault(b, i)
+
+        self._special_str: dict[int, str] = {}
+        self._special_ids: dict[str, int] = {}
+        specials = data.get("special_tokens")
+        if specials:
+            for entry in specials:
+                rank = int(entry["rank"])
+                s = entry.get("token_str") or f"<SPECIAL_{rank}>"
+                self._special_str[rank] = s
+                self._special_ids[s] = rank
+        else:
+            # Older tekken files leave the special block implicit; the
+            # first ids carry the mistral-common defaults.
+            for i, s in enumerate(_FALLBACK_SPECIALS):
+                self._special_str[i] = s
+                self._special_ids[s] = i
+
+        self.bos_token_id = self._special_ids.get("<s>", 1)
+        self.eos_token_id = self._special_ids.get("</s>", 2)
+        self.unk_token_id = self._special_ids.get("<unk>", 0)
+        self.bos_token = "<s>"
+        self.eos_token = "</s>"
+        self.is_fast = False
+
+        self._re = None
+        if self.pattern:
+            try:
+                import regex
+
+                self._re = regex.compile(self.pattern)
+            except Exception:
+                self._re = None
+
+    # ------------------------------------------------------------------
+
+    def __len__(self) -> int:
+        return self.vocab_size
+
+    def _split(self, text: str) -> list[str]:
+        if self._re is not None:
+            return self._re.findall(text)
+        # Degraded split: words with leading space, runs of digits.
+        import re
+
+        return re.findall(r"\s*\S+|\s+", text)
+
+    def _bpe(self, piece: bytes) -> list[int]:
+        """tiktoken-style byte-pair merge by ascending rank."""
+        ranks = self._ranks
+        if piece in ranks:
+            return [ranks[piece] + self.num_special]
+        parts = [piece[i:i + 1] for i in range(len(piece))]
+        while len(parts) > 1:
+            best = None
+            best_rank = None
+            for i in range(len(parts) - 1):
+                r = ranks.get(parts[i] + parts[i + 1])
+                if r is not None and (best_rank is None or r < best_rank):
+                    best, best_rank = i, r
+            if best is None:
+                break
+            parts = (
+                parts[:best] + [parts[best] + parts[best + 1]]
+                + parts[best + 2:]
+            )
+        out = []
+        for p in parts:
+            r = ranks.get(p)
+            out.append(
+                (r + self.num_special) if r is not None else self.unk_token_id
+            )
+        return out
+
+    def encode(self, text: str, add_special_tokens: bool = True) -> list[int]:
+        ids: list[int] = []
+        if add_special_tokens:
+            ids.append(self.bos_token_id)
+        for piece in self._split(text):
+            ids.extend(self._bpe(piece.encode("utf-8")))
+        return ids
+
+    def decode(self, ids, skip_special_tokens: bool = True) -> str:
+        out: list[bytes] = []
+        for i in ids:
+            i = int(i)
+            if i < self.num_special:
+                if not skip_special_tokens:
+                    out.append(self._special_str.get(i, "").encode())
+                continue
+            r = i - self.num_special
+            if 0 <= r < len(self._rank_bytes):
+                out.append(self._rank_bytes[r])
+        return b"".join(out).decode("utf-8", errors="replace")
+
+    def convert_tokens_to_ids(self, token: str):
+        if isinstance(token, (list, tuple)):
+            return [self.convert_tokens_to_ids(t) for t in token]
+        if token in self._special_ids:
+            return self._special_ids[token]
+        r = self._ranks.get(token.encode("utf-8"))
+        return (r + self.num_special) if r is not None else None
+
+    def convert_ids_to_tokens(self, ids):
+        if isinstance(ids, int):
+            ids = [ids]
+        out = []
+        for i in ids:
+            if i < self.num_special:
+                out.append(self._special_str.get(i, "<unk>"))
+            else:
+                r = i - self.num_special
+                out.append(
+                    self._rank_bytes[r].decode("utf-8", errors="replace")
+                    if r < len(self._rank_bytes) else "<unk>"
+                )
+        return out
+
+    def apply_chat_template(
+        self, messages: list[dict], chat_template: str | None = None,
+        add_generation_prompt: bool = True, **kwargs: Any,
+    ) -> list[int]:
+        """Mistral instruct format: ``<s>[INST] sys\n\nuser [/INST] asst</s>``
+        per turn (the v3/tekken convention, built from token ids)."""
+        del chat_template, add_generation_prompt, kwargs
+        inst = self._special_ids.get("[INST]")
+        inst_end = self._special_ids.get("[/INST]")
+        ids = [self.bos_token_id]
+        system = ""
+        for m in messages:
+            if m.get("role") == "system":
+                system = m.get("content") or ""
+        user_turns = [m for m in messages if m.get("role") == "user"]
+        asst_turns = [m for m in messages if m.get("role") == "assistant"]
+        for i, m in enumerate(user_turns):
+            content = m.get("content") or ""
+            if system and i == len(user_turns) - 1:
+                content = f"{system}\n\n{content}"
+            if inst is not None:
+                ids.append(inst)
+            body = content if inst is not None else f"[INST] {content} [/INST]"
+            ids.extend(self.encode(body, add_special_tokens=False))
+            if inst_end is not None:
+                ids.append(inst_end)
+            if i < len(asst_turns):
+                ids.extend(self.encode(
+                    asst_turns[i].get("content") or "",
+                    add_special_tokens=False,
+                ))
+                ids.append(self.eos_token_id)
+        return ids
+
+
+def load_tekken_if_present(path: str) -> TekkenTokenizer | None:
+    """A TekkenTokenizer when ``path`` (a model dir) ships ONLY
+    tekken.json. Repos that also carry a full HF tokenizer
+    (tokenizer.json / tokenizer_config.json — e.g. official Mistral HF
+    checkpoints) keep AutoTokenizer: its chat template and pretokenizer
+    are authoritative."""
+    if not os.path.isdir(path):
+        return None
+    if not os.path.exists(os.path.join(path, "tekken.json")):
+        return None
+    for hf_file in ("tokenizer.json", "tokenizer_config.json",
+                    "tokenizer.model"):
+        if os.path.exists(os.path.join(path, hf_file)):
+            return None
+    return TekkenTokenizer(path)

@@ -1001,6 +1001,13 @@ class ModelRunner:
             if cached.resumed_from_preemption[i]:
                 tokens = cached.resumed_req_token_ids[i]
                 assert tokens is not None
+                if req_id not in self.input_batch.req_states:
+                    # Resume into a FRESH runner (elastic re-mesh rebuilt
+                    # it — worker.reinitialize_parallel): rebuild the row
+                    # from the scheduler's request ref; the resumed path
+                    # already carries full token ids / blocks / positions.
+                    self._resume_unknown_request(so, i, req_id, tokens)
+                    continue
                 self.input_batch.reset_for_resume(
                     req_id, tokens, cached.new_block_ids[i], cached.num_computed_tokens[i]
                 )
@@ -1034,6 +1041,47 @@ class ModelRunner:
                     mrope_positions(len(new.prompt_token_ids), spans)
                 )
 
+    def _resume_unknown_request(
+        self, so: SchedulerOutput, i: int, req_id: str, tokens: list[int]
+    ) -> None:
+        """Preemption-resume for a request this runner has never seen
+        (the elastic re-mesh rebuilt the runner with an empty batch)."""
+        from vllm_tpu.core.sched_output import NewRequestData
+
+        cached = so.scheduled_cached_reqs
+        req = so.req_refs[req_id]
+        row = self.input_batch.add_request(NewRequestData(
+            req_id=req_id,
+            prompt_token_ids=tokens,
+            sampling_params=req.sampling_params,
+            block_ids=cached.new_block_ids[i],
+            num_computed_tokens=cached.num_computed_tokens[i],
+            lora_name=req.lora_name,
+            mm_inputs=req.mm_inputs or None,
+            eos_token_id=req.eos_token_id,
+            pooling_params=req.pooling_params,
+        ))
+        state = self.input_batch.req_states[req_id]
+        # Restore the prompt/output split: seeded PRNG streams, penalties
+        # and min-tokens all key off `generated`.
+        state.generated = len(tokens) - len(req.prompt_token_ids)
+        if self._is_hybrid:
+            self._take_state_slot(req_id)
+        if self.lora_manager is not None:
+            self.input_batch.lora_slot[row] = self.lora_manager.slot_of(
+                req.lora_name
+            )
+        if getattr(self.model, "needs_mrope", False):
+            from vllm_tpu.models.qwen2_vl import mrope_positions
+
+            spans = [
+                (mi.offset, self.model.llm_grid, self.model.llm_grid)
+                for mi in (req.mm_inputs or [])
+            ]
+            self.input_batch.req_states[req_id].mrope = mrope_positions(
+                len(req.prompt_token_ids), spans
+            )
+
     def _run_encoders(self, so: SchedulerOutput) -> None:
         """Drop freed encoder outputs, run newly scheduled ones (one jit
         per image geometry; outputs stay on device until their placeholder
@@ -1049,13 +1097,33 @@ class ModelRunner:
                 # Encoder-decoder: run the encoder once and write the
                 # request's cross-KV slot (re-runs after preemption —
                 # the slot was released and resume restarts at 0).
+                slot = self._state_slot_of[rid]
+                feats = getattr(
+                    state.mm_inputs[0], "encoder_features", None
+                )
+                if feats is not None:
+                    # Whisper-class: mel frames, zero-padded to the full
+                    # 30 s window (HF feature-extractor semantics); the
+                    # cross length is the post-conv position count.
+                    f_max = self.model.max_source_frames
+                    feats = np.asarray(feats, np.float32)
+                    padded_f = np.zeros(
+                        (f_max, feats.shape[1]), np.float32
+                    )
+                    padded_f[: len(feats)] = feats[:f_max]
+                    self.kv_cache = self._encode_fn(
+                        self.kv_cache, self.params,
+                        jnp.asarray(padded_f),
+                        jnp.int32(self.model.max_encoder_len),
+                        jnp.int32(slot),
+                    )
+                    continue
                 enc = np.asarray(
                     state.mm_inputs[0].encoder_token_ids, np.int32
                 )
                 s_max = self.model.max_encoder_len
                 padded = np.zeros(s_max, np.int32)
                 padded[: len(enc)] = enc[:s_max]
-                slot = self._state_slot_of[rid]
                 self.kv_cache = self._encode_fn(
                     self.kv_cache, self.params, jnp.asarray(padded),
                     jnp.int32(min(len(enc), s_max)), jnp.int32(slot),

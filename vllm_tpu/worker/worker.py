@@ -143,6 +143,12 @@ def _per_device_param_bytes(params, device) -> int:
 def load_hf_config(model_config) -> Any:
     if model_config.hf_config is not None:
         return model_config.hf_config
+    if model_config.model.endswith(".gguf"):
+        from vllm_tpu.models.gguf import config_from_gguf
+
+        cfg = config_from_gguf(model_config.model)
+        model_config.hf_config = cfg
+        return cfg
     from transformers import AutoConfig
 
     cfg = AutoConfig.from_pretrained(
@@ -164,6 +170,9 @@ class Worker:
         self.model: Any = None
         self.params: Any = None
         self.runner: ModelRunner | None = None
+        # name -> adapter path, for re-application across an elastic
+        # runner rebuild (reinitialize_parallel).
+        self._lora_paths: dict[str, str] = {}
 
     # ------------------------------------------------------------------
 
@@ -178,18 +187,45 @@ class Worker:
     def load_model(self) -> None:
         mc = self.config.model_config
         hf_config = load_hf_config(mc)
+        from vllm_tpu.models.native_ckpt import native_meta
+
+        nmeta = native_meta(mc.model)
+        if nmeta:
+            # Native (pre-assembled) checkpoint: quantization flags were
+            # decided at save time and ride the index.
+            if mc.quantization is None:
+                mc.quantization = nmeta.get("quantization")
+            if nmeta.get("quantize_embedding_layers"):
+                mc.quantize_embedding_layers = True
         if mc.max_model_len is None:
-            mc.max_model_len = getattr(hf_config, "max_position_embeddings", 8192)
+            mc.max_model_len = (
+                getattr(hf_config, "max_position_embeddings", None)
+                # Whisper-class: the decoder position table is
+                # max_target_positions long; a larger default would
+                # silently clip positions past it.
+                or getattr(hf_config, "max_target_positions", None)
+                or 8192
+            )
         self.config.scheduler_config.max_model_len = mc.max_model_len
         quant_zero_bias = None
+        ct_scheme = None
         if getattr(hf_config, "quantization_config", None) is not None:
-            # Pre-quantized checkpoint (GPTQ/AWQ): the quant method comes
-            # from the checkpoint, not the CLI.
-            from vllm_tpu.layers.gptq_import import detect_checkpoint_quant
+            # Pre-quantized checkpoint: the quant method comes from the
+            # checkpoint, not the CLI. compressed-tensors maps onto the
+            # native int8/fp8/int4 formats; GPTQ/AWQ onto int4.
+            from vllm_tpu.layers.compressed_tensors import detect_ct
 
-            method, _bits, quant_zero_bias = detect_checkpoint_quant(
-                hf_config
-            )
+            ct_scheme = detect_ct(hf_config)
+            if ct_scheme is not None:
+                method = ct_scheme.native_method
+            else:
+                from vllm_tpu.layers.gptq_import import (
+                    detect_checkpoint_quant,
+                )
+
+                method, _bits, quant_zero_bias = detect_checkpoint_quant(
+                    hf_config
+                )
             if mc.quantization not in (None, method):
                 raise ValueError(
                     f"--quantization={mc.quantization} conflicts with the "
@@ -200,6 +236,15 @@ class Worker:
         self.model = model_cls(
             hf_config, dtype=mc.jax_dtype, quantization=mc.quantization
         )
+        if getattr(self.model, "is_encoder_decoder", False):
+            cap = getattr(self.model, "max_position", None)
+            if cap and mc.max_model_len > cap:
+                # Finite learned decoder position tables (BART/Whisper):
+                # positions past the table would silently clip.
+                raise ValueError(
+                    f"max_model_len ({mc.max_model_len}) exceeds the "
+                    f"decoder position table ({cap})"
+                )
         if getattr(self.model, "needs_mrope", False):
             sched = self.config.scheduler_config
             if sched.num_decode_steps > 1:
@@ -231,6 +276,10 @@ class Worker:
             # gptq_v2/AWQ store the zero directly; AutoGPTQ v1 stores
             # zero-1 (the loader passes this to the importer).
             self.model.quant_zero_bias = quant_zero_bias
+        if ct_scheme is not None:
+            # The loader routes quantized payloads through the
+            # compressed-tensors converters instead of requantizing.
+            self.model.ckpt_ct_scheme = ct_scheme
         pc = self.config.parallel_config
         if pc.enable_eplb:
             if not getattr(self.model, "supports_eplb", False):
@@ -660,6 +709,175 @@ class Worker:
         assert self.runner is not None
         self.runner.update_weights(path)
 
+    def save_sharded_state(self, path: str) -> None:
+        """Dump the ASSEMBLED param tree for fast reload (reference:
+        ``gpu_worker.py:939 save_sharded_state`` + sharded_state_loader).
+        The saved directory is a self-contained model path: HF config +
+        native index + leaf payloads; pointing ``--model`` at it skips
+        HF name mapping, stacking, and quantize-at-load."""
+        import json as _json
+
+        from vllm_tpu.models.native_ckpt import save_native
+
+        assert self.params is not None, "load_model() before saving"
+        mc = self.config.model_config
+        # The runner's tree is authoritative once it exists (RL weight
+        # updates land there; worker.params is the load-time snapshot).
+        params = self.runner.params if self.runner is not None else self.params
+        save_native(params, path, meta={
+            "quantization": mc.quantization,
+            "quantize_embedding_layers": bool(
+                getattr(self.model, "quantize_embedding_layers", False)
+            ),
+        })
+        hf_config = mc.hf_config
+        cfg = _json.loads(hf_config.to_json_string())
+        cfg.setdefault("architectures", getattr(
+            hf_config, "architectures", None
+        ) or [type(self.model).__name__])
+        # GPTQ/AWQ configs carry quantization_config; the native payload
+        # is already converted — a reload must not re-trigger importers.
+        cfg.pop("quantization_config", None)
+        with open(os.path.join(path, "config.json"), "w") as f:
+            _json.dump(cfg, f, indent=1)
+        # Tokenizer files ride along so the directory really is a
+        # self-contained --model path (a reload runs AutoTokenizer on it).
+        import shutil
+
+        src_dir = self.config.model_config.tokenizer or mc.model
+        if os.path.isdir(src_dir):
+            for name in (
+                "tokenizer.json", "tokenizer_config.json",
+                "special_tokens_map.json", "vocab.json", "merges.txt",
+                "tokenizer.model", "added_tokens.json", "tekken.json",
+                "chat_template.jinja",
+            ):
+                src = os.path.join(src_dir, name)
+                if os.path.exists(src):
+                    shutil.copy2(src, os.path.join(path, name))
+
+    def reinitialize_parallel(self, new_tp: int) -> int:
+        """Elastic EP: resize the expert/tensor-parallel world at runtime.
+
+        Reference analog: ``vllm/distributed/elastic_ep/elastic_state.py``
+        and ``EngineCore.reinitialize_distributed`` (``core.py:1865``) —
+        there, NCCL groups are torn down and rebuilt and expert weights are
+        shuffled point-to-point. The TPU formulation: parallelism is a mesh
+        plus sharding annotations, so scaling the EP world is (1) build a
+        mesh over the new device set, (2) ``device_put`` the params onto it
+        (XLA moves the shards over ICI; done leaf-by-leaf with eager
+        deletion so peak overhead is one leaf, not a second full copy),
+        (3) rebuild the runner so every jitted executable re-traces against
+        the new mesh. KV-cache content is discarded — the engine preempts
+        running requests first, so they recompute from their token ids
+        (the reference also drops KV across a reconfigure).
+
+        Returns the KV block count (unchanged — the scheduler's block pool
+        stays valid; only the content was dropped).
+        """
+        assert self.runner is not None, "initialize() before resizing"
+        pc = self.config.parallel_config
+        old_tp = pc.tensor_parallel_size
+        num_blocks = self.config.cache_config.num_gpu_blocks
+        if new_tp == old_tp:
+            return num_blocks
+        if new_tp < 1:
+            raise ValueError(f"tensor_parallel_size must be >= 1, got {new_tp}")
+        if (
+            pc.pipeline_parallel_size > 1
+            or pc.context_parallel_size > 1
+            or pc.data_parallel_size > 1
+        ):
+            raise ValueError(
+                "elastic resize supports tp/ep-only meshes (pp/cp/dp "
+                "axes must be 1)"
+            )
+        avail = len(jax.devices())
+        if new_tp > avail:
+            raise ValueError(
+                f"elastic resize to tp={new_tp} needs {new_tp} devices, "
+                f"have {avail}"
+            )
+        if pc.enable_expert_parallel and new_tp > 1:
+            e = getattr(self.model, "num_experts", 0) or 0
+            if e % new_tp:
+                raise ValueError(
+                    f"num_experts ({e}) not divisible by new EP size {new_tp}"
+                )
+        kvh = getattr(self.model, "num_kv_heads", 0) or 0
+        if new_tp > 1 and kvh and kvh % new_tp:
+            raise ValueError(
+                f"num_kv_heads ({kvh}) not divisible by tp size {new_tp} "
+                "(KV-cache head sharding)"
+            )
+        if self.runner._host_params is not None:
+            raise RuntimeError("cannot resize a sleeping engine; wake_up first")
+
+        pc.tensor_parallel_size = new_tp
+        new_mesh = None
+        if pc.world_size > 1:
+            from vllm_tpu.parallel.mesh import build_mesh
+
+            new_mesh = build_mesh(pc)
+
+        def _reshard(tree, model):
+            if tree is None:
+                return None
+            if new_mesh is not None:
+                from vllm_tpu.parallel.mesh import named_shardings
+
+                shardings = named_shardings(new_mesh, model.param_shardings())
+            else:
+                from jax.sharding import SingleDeviceSharding
+
+                one = SingleDeviceSharding(jax.devices()[0])
+                shardings = jax.tree_util.tree_map(lambda _: one, tree)
+
+            def _put(x, s):
+                # donate=True lets the runtime reuse old shards in the
+                # new layout where device sets overlap. NO explicit
+                # delete: the result may alias source buffers on shared
+                # devices without marking the source deleted (observed on
+                # the CPU backend), so a manual delete would corrupt the
+                # resharded array. Non-aliased old shards free when the
+                # old tree's references drop below.
+                return jax.device_put(x, s, donate=True)
+
+            return jax.tree_util.tree_map(_put, tree, shardings)
+
+        self.params = _reshard(self.params, self.model)
+        if self.draft_params is not None and self.draft_model is not None:
+            self.draft_params = _reshard(self.draft_params, self.draft_model)
+        self.mesh = new_mesh
+        if getattr(self.model, "expert_parallel", False):
+            self.model.ep_mesh = new_mesh
+
+        # Rebuild the runner: jitted executables and the KV cache are
+        # mesh-shaped. Cross-step wiring (grammar tables, KV connector,
+        # LoRA adapters) is re-applied onto the fresh runner.
+        old = self.runner
+        som = old.structured_output_manager
+        connector = getattr(old, "kv_connector", None)
+        old.kv_cache = None  # free before the new runner allocates
+        old.draft_kv = None
+        self.runner = ModelRunner(
+            self.config, self.model, self.params, num_blocks, new_mesh,
+            draft_model=self.draft_model, draft_params=self.draft_params,
+        )
+        if som is not None:
+            self.runner.structured_output_manager = som
+        if connector is not None:
+            self.runner.kv_connector = connector
+        if self.runner.lora_manager is not None:
+            for name, path in self._lora_paths.items():
+                self.runner.lora_manager.add_lora(name, path)
+        logger.info(
+            "elastic resize: tp/ep %d -> %d (mesh %s)", old_tp, new_tp,
+            None if new_mesh is None else
+            dict(zip(new_mesh.axis_names, new_mesh.devices.shape)),
+        )
+        return num_blocks
+
     def set_kv_connector(self, connector) -> None:
         assert self.runner is not None
         self.runner.kv_connector = connector
@@ -672,10 +890,14 @@ class Worker:
         assert self.runner is not None and self.runner.lora_manager is not None, (
             "LoRA serving requires enable_lora=True"
         )
-        return self.runner.lora_manager.add_lora(name, path)
+        ok = self.runner.lora_manager.add_lora(name, path)
+        if ok:
+            self._lora_paths[name] = path
+        return ok
 
     def remove_lora(self, name: str) -> bool:
         assert self.runner is not None and self.runner.lora_manager is not None
+        self._lora_paths.pop(name, None)
         return self.runner.lora_manager.remove_lora(name)
 
     def list_loras(self) -> list[str]:
