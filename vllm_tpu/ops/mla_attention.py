@@ -69,12 +69,35 @@ def mla_paged_attention(
     """Ragged causal attention in latent space -> [T, H, value_dim].
 
     MQA structure (one shared latent row per position); the per-head value
-    up-projection W_uv is applied by the caller. XLA path — a Pallas MLA
-    kernel (rpa_kernel fork with kh=1, score width DL, value width
-    ``value_dim``) is the optimization seam.
+    up-projection W_uv is applied by the caller. On TPU (or under
+    VLLM_TPU_PALLAS_INTERPRET off-TPU) this routes to the Pallas MLA
+    kernel (``ops/mla_kernel.py``: rpa fork with kh=1, score width DL,
+    value width ``value_dim`` — streams pages through VMEM); the XLA
+    gather below is the reference path, which materializes ``[T, C, DL]``
+    and only survives short contexts.
     """
     t, h, dl = q_abs.shape
     nl, nb, bs, _one, _dl = kv_cache.shape
+
+    from vllm_tpu import envs
+
+    on_tpu = jax.default_backend() == "tpu"
+    interpret = bool(envs.VLLM_TPU_PALLAS_INTERPRET) and not on_tpu
+    if (on_tpu or interpret) and not envs.VLLM_TPU_DISABLE_PALLAS:
+        from vllm_tpu.ops.mla_kernel import mla_ragged_paged_attention
+
+        return mla_ragged_paged_attention(
+            q_abs,
+            kv_cache,
+            jnp.asarray(layer, jnp.int32).reshape(1),
+            md.seq_lens,
+            md.block_tables,
+            md.query_start_loc,
+            md.num_seqs,
+            sm_scale=scale,
+            value_dim=value_dim,
+            interpret=interpret,
+        )
 
     pages = kv_cache[layer, md.block_tables]  # [R, B, BS, 1, DL]
     r, b = md.block_tables.shape

@@ -1,0 +1,390 @@
+"""Pallas TPU kernel: ragged paged attention in MLA latent space.
+
+The MLA fork of ``ops/rpa_kernel.py`` (itself derived from JAX's
+Apache-2.0 ``ragged_paged_attention``), specialized to the absorbed MLA
+formulation (DeepSeek-V2, arXiv:2405.04434) over the framework's paged
+latent cache. Reference analog: ``csrc/attention/mla/`` decode kernels
+(flashmla / sm100_cutlass_mla) + ``vllm/v1/attention/backends/mla/``.
+
+Differences from the general kernel, all forced by the MLA cache
+contract (``mla_attention.mla_kv_cache_shape``: one latent row
+``[c_kv (value_dim) || k_pe]`` per token — no per-head K/V planes):
+
+- ONE shared "KV head" (MQA): no heads grid dim, no K/V interleave or
+  packed-lane split — a page DMA delivers latent rows directly.
+- Scores contract over the FULL latent width ``DL = value_dim +
+  rope_dim`` (q_abs = [q_lat || q_pe]); the value is the first
+  ``value_dim`` lanes of the same rows, so K and V share one VMEM
+  buffer and one DMA.
+- Flash accumulator is ``[q_blk, H, value_dim]`` — the per-head output
+  stays in latent space; the caller applies the absorbed ``W_uv``.
+
+No sliding-window / striped-context support: MLA models use full
+attention, and CP for MLA rides the XLA reference path for now.
+
+Why it exists (VERDICT r4 missing #1): the XLA reference
+(``mla_attention.mla_paged_attention``) materializes ``[T, C, DL]`` —
+quadratic memory that dies at real context lengths; this kernel streams
+pages through a fixed VMEM working set like the general kernel.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+DEFAULT_MASK_VALUE = -0.7 * float(jnp.finfo(jnp.dtype("float32")).max)
+
+
+class _LatentPageCopy:
+    """Async copy of one latent block's pages HBM -> VMEM, layer-indexed."""
+
+    def __init__(self, pages_hbm_ref, vmem_buf, sem, page_indices_ref,
+                 layer, seq_id, start_page_idx, end_page_idx):
+        self._vmem_buf = vmem_buf
+        self._copies = []
+        for i in range(vmem_buf.shape[0]):
+            page_idx = start_page_idx + i
+            page_idx = lax.select(page_idx < end_page_idx, page_idx, 0)
+            self._copies.append(
+                pltpu.make_async_copy(
+                    pages_hbm_ref.at[layer, page_indices_ref[seq_id, page_idx]],
+                    vmem_buf.at[i],
+                    sem,
+                )
+            )
+
+    def start(self):
+        for c in self._copies:
+            c.start()
+
+    def wait(self):
+        for c in self._copies:
+            c.wait()
+        return self._vmem_buf
+
+
+def _mla_kernel(
+    # Scalar prefetch
+    kv_lens_ref,  # [max_num_seqs]
+    page_indices_ref,  # [max_num_seqs, pages_per_seq]
+    cu_q_lens_ref,  # [max_num_seqs + 1]
+    seq_buf_idx_ref,  # [2] mutable (seq_idx, buf_idx) carried across grid
+    num_seqs_ref,  # [1]
+    layer_ref,  # [1]
+    # Inputs
+    q_ref,  # [num_q_per_blk, num_q_heads, latent_dim]
+    lat_pages_hbm_ref,  # [L, NB, page_size, 1, latent_dim]
+    # Outputs
+    o_ref,  # [num_q_per_blk, num_q_heads, value_dim]
+    # Scratch
+    lat_bufs,  # [2, pages_per_blk, page_size, 1, latent_dim]
+    sems,  # [2]
+    l_ref,  # [num_q_per_blk * H, 128]
+    m_ref,  # [num_q_per_blk * H, 128]
+    acc_ref,  # [num_q_per_blk, H, value_dim]
+    *,
+    sm_scale: float,
+    mask_value: float,
+    value_dim: int,
+):
+    num_q_per_blk, num_q_heads, latent_dim = q_ref.shape
+    pages_per_seq = page_indices_ref.shape[-1]
+    num_seqs = num_seqs_ref[0]
+    layer = layer_ref[0]
+    _, num_pages_per_blk, page_size, _one, _dl = lat_bufs.shape
+    num_kv_per_blk = num_pages_per_blk * page_size
+    q_blk_idx = pl.program_id(0)
+    init_seq_idx = seq_buf_idx_ref[0]
+    init_buf_idx = seq_buf_idx_ref[1]
+    q_len_start = q_blk_idx * num_q_per_blk
+    q_len_end = q_len_start + num_q_per_blk
+
+    def make_page_copy(seq_idx, kv_blk_idx, buf_idx):
+        start_page = kv_blk_idx * num_pages_per_blk
+        end_page = jnp.minimum(
+            pages_per_seq, pl.cdiv(kv_lens_ref[seq_idx], page_size)
+        )
+        return _LatentPageCopy(
+            lat_pages_hbm_ref, lat_bufs.at[buf_idx], sems.at[buf_idx],
+            page_indices_ref, layer, seq_idx, start_page, end_page,
+        )
+
+    @pl.when(q_blk_idx == 0)
+    def prefetch_first_blk():
+        make_page_copy(init_seq_idx, 0, init_buf_idx).start()
+
+    def is_cur_q_blk_needed(q_states):
+        done, cur_seq_idx, _ = q_states
+        should_run = jnp.logical_and(
+            q_len_start < cu_q_lens_ref[num_seqs], cur_seq_idx < num_seqs
+        )
+        return jnp.logical_and(done == 0, should_run)
+
+    def compute_with_cur_q_blk(q_states):
+        done, cur_seq_idx, cur_buf_idx = q_states
+        q_start = cu_q_lens_ref[cur_seq_idx]
+        q_end = cu_q_lens_ref[cur_seq_idx + 1]
+        q_len = q_end - q_start
+        kv_len = kv_lens_ref[cur_seq_idx]
+        # Floor 1: a zero-context seq still runs one fully-masked block so
+        # the double-buffer prefetch chain stays uniform (see rpa_kernel).
+        local_bound = jnp.maximum(kv_len, 1)
+
+        def get_next_prefetch_ids(cur_seq_idx, kv_blk_idx, cur_buf_idx):
+            next_kv_blk_idx = kv_blk_idx + 1
+            is_last_kv_blk = next_kv_blk_idx * num_kv_per_blk >= local_bound
+            is_seq_end_in_blk = q_end <= q_len_end
+            next_seq_idx = lax.select(
+                is_last_kv_blk,
+                lax.select(is_seq_end_in_blk, cur_seq_idx + 1, cur_seq_idx),
+                cur_seq_idx,
+            )
+            done_all = next_seq_idx == num_seqs
+            next_seq_idx = lax.select(done_all, 0, next_seq_idx)
+            next_kv_blk_idx = lax.select(is_last_kv_blk, 0, next_kv_blk_idx)
+            next_buf_idx = lax.select(cur_buf_idx == 0, 1, 0)
+            return done_all, next_seq_idx, next_kv_blk_idx, next_buf_idx
+
+        def flash_attention(q, lat, kv_blk_idx):
+            """One latent block's flash step. ``q [NQ*H, DL]``,
+            ``lat [num_kv_per_blk, DL]``."""
+            kv_len_start = kv_blk_idx * num_kv_per_blk
+
+            def masked_store(ref, val, start, end, group=1):
+                iota = lax.broadcasted_iota(jnp.int32, ref.shape, 0) // group
+                pltpu.store(
+                    ref, val, mask=jnp.logical_and(iota >= start, iota < end)
+                )
+
+            def load_with_init(ref, init_val):
+                return jnp.where(
+                    kv_blk_idx == 0, jnp.full_like(ref, init_val), ref[...]
+                )
+
+            # Rows beyond the context are garbage; zero them.
+            kv_pos = kv_len_start + lax.broadcasted_iota(
+                jnp.int32, lat.shape, 0
+            )
+            lat = jnp.where(
+                kv_pos < kv_len, lat.astype(jnp.float32), 0
+            ).astype(lat.dtype)
+
+            qk = (
+                jnp.einsum("nd,md->nm", q, lat,
+                           preferred_element_type=jnp.float32)
+                * sm_scale
+            )
+            store_start = jnp.maximum(q_start - q_len_start, 0)
+            store_end = jnp.minimum(q_end - q_len_start, num_q_per_blk)
+
+            row_ids = (
+                (kv_len - q_len)
+                + q_len_start
+                - q_start
+                + lax.broadcasted_iota(jnp.int32, qk.shape, 0)
+                // num_q_heads
+            )
+            col_ids = kv_len_start + lax.broadcasted_iota(
+                jnp.int32, qk.shape, 1
+            )
+            qk += jnp.where(row_ids < col_ids, mask_value, 0.0)
+            m_curr = jnp.max(qk, axis=1, keepdims=True)
+            s_curr = jnp.exp(qk - m_curr)
+            qkv = jnp.dot(
+                s_curr, lat[:, :value_dim],
+                preferred_element_type=jnp.float32,
+            )
+            lm_store_shape = m_ref.shape
+            m_curr = jnp.broadcast_to(m_curr, lm_store_shape)
+            l_curr = jnp.broadcast_to(
+                s_curr.sum(axis=1, keepdims=True), lm_store_shape
+            )
+            m_prev = load_with_init(m_ref, -jnp.inf)
+            l_prev = load_with_init(l_ref, 0.0)
+            m_next = jnp.maximum(m_prev, m_curr)
+            masked_store(m_ref, m_next, store_start, store_end, num_q_heads)
+            alpha = jnp.exp(m_prev - m_next)
+            beta = jnp.exp(m_curr - m_next)
+            l_alpha = alpha * l_prev
+            l_next = l_alpha + beta * l_curr
+            l_next_safe = jnp.where(l_next == 0.0, 1.0, l_next)
+            masked_store(l_ref, l_next_safe, store_start, store_end,
+                         num_q_heads)
+
+            def lanes(arr):
+                """l/m columns -> value_dim lanes (value broadcast)."""
+                if arr.shape[1] == value_dim:
+                    return arr
+                if value_dim < arr.shape[1]:
+                    return arr[:, :value_dim]
+                return jnp.concatenate(
+                    [arr] * (value_dim // arr.shape[1]), axis=1
+                )
+
+            o_curr = load_with_init(acc_ref, 0.0).reshape(-1, value_dim)
+            out = (
+                lanes(l_alpha) * o_curr + lanes(beta) * qkv
+            ) / lanes(l_next_safe)
+            masked_store(
+                acc_ref, out.reshape(acc_ref.shape), store_start, store_end
+            )
+
+        def is_valid_kv_blk(kv_states):
+            kv_blk_idx, _ = kv_states
+            return kv_blk_idx * num_kv_per_blk < local_bound
+
+        def compute_with_kv_blk(kv_states):
+            kv_blk_idx, cur_buf_idx = kv_states
+            done_all, next_seq_idx, next_kv_blk_idx, next_buf_idx = (
+                get_next_prefetch_ids(cur_seq_idx, kv_blk_idx, cur_buf_idx)
+            )
+
+            @pl.when(jnp.logical_not(done_all))
+            def prefetch_next_blk():
+                make_page_copy(
+                    next_seq_idx, next_kv_blk_idx, next_buf_idx
+                ).start()
+
+            lat_buf = make_page_copy(
+                cur_seq_idx, kv_blk_idx, cur_buf_idx
+            ).wait()  # [pages, page_size, 1, DL]
+            lat = lat_buf[:, :, 0, :].reshape(num_kv_per_blk, latent_dim)
+            q = q_ref[...].reshape(
+                num_q_per_blk * num_q_heads, latent_dim
+            )
+            flash_attention(q, lat, kv_blk_idx)
+            return kv_blk_idx + 1, next_buf_idx
+
+        _, next_buf_idx = lax.while_loop(
+            is_valid_kv_blk, compute_with_kv_blk, (0, cur_buf_idx)
+        )
+        next_seq_idx = lax.select(q_end <= q_len_end, cur_seq_idx + 1,
+                                  cur_seq_idx)
+        done = lax.select(q_end < q_len_end, done, 1)
+        return done, next_seq_idx, next_buf_idx
+
+    _, seq_idx, buf_idx = lax.while_loop(
+        is_cur_q_blk_needed,
+        compute_with_cur_q_blk,
+        (0, init_seq_idx, init_buf_idx),
+    )
+    seq_buf_idx_ref[0] = lax.select(seq_idx < num_seqs, seq_idx, 0)
+    seq_buf_idx_ref[1] = buf_idx
+    o_ref[...] = acc_ref[...].astype(q_ref.dtype)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=[
+        "sm_scale", "value_dim", "mask_value", "num_kv_pages_per_block",
+        "num_queries_per_block", "vmem_limit_bytes", "interpret",
+    ],
+)
+def mla_ragged_paged_attention(
+    q_abs: jax.Array,  # [T, H, DL] absorbed queries (q_lat || q_pe)
+    lat_pages: jax.Array,  # [L, NB, page_size, 1, DL] latent cache
+    layer: jax.Array,  # i32[1]
+    kv_lens: jax.Array,  # i32[max_num_seqs]
+    page_indices: jax.Array,  # i32[max_num_seqs, pages_per_seq]
+    cu_q_lens: jax.Array,  # i32[max_num_seqs + 1]
+    num_seqs: jax.Array,  # i32[1]
+    *,
+    sm_scale: float,
+    value_dim: int,
+    mask_value: float | None = None,
+    num_kv_pages_per_block: int | None = None,
+    num_queries_per_block: int | None = None,
+    vmem_limit_bytes: int | None = None,
+    interpret: bool = False,
+) -> jax.Array:
+    """Mixed prefill+decode MLA flash attention -> ``[T, H, value_dim]``.
+
+    Ragged contract matches ``ragged_paged_attention`` (token i of seq s
+    sits at ``cu_q_lens[s] + i``; causal against the seq's ``kv_lens``
+    context, queries occupying the final positions)."""
+    t, num_q_heads, latent_dim = q_abs.shape
+    nl, nb, page_size, one, dl = lat_pages.shape
+    if one != 1 or dl != latent_dim:
+        raise ValueError(f"latent cache {lat_pages.shape} vs q {q_abs.shape}")
+    if not 0 < value_dim <= latent_dim:
+        raise ValueError(f"{value_dim=} out of range for {latent_dim=}")
+    max_num_seqs, pages_per_seq = page_indices.shape
+    if kv_lens.shape != (max_num_seqs,):
+        raise ValueError(f"{kv_lens.shape=} != ({max_num_seqs},)")
+    if cu_q_lens.shape != (max_num_seqs + 1,):
+        raise ValueError(f"{cu_q_lens.shape=} != ({max_num_seqs + 1},)")
+    if mask_value is None:
+        mask_value = DEFAULT_MASK_VALUE
+
+    # Block sizes: the latent row is wide (DL ~ 576) so fewer pages per
+    # block than the general kernel; q blocks sized to the folded
+    # [NQ*H, DL] score matmul.
+    if num_queries_per_block is None:
+        num_queries_per_block = max(8, 512 // max(num_q_heads, 1))
+    num_q_per_blk = min(num_queries_per_block, max(t, 1))
+    if num_kv_pages_per_block is None:
+        num_kv_pages_per_block = max(1, min(pages_per_seq, 128 // page_size))
+    num_pages_per_blk = min(num_kv_pages_per_block, pages_per_seq)
+
+    num_q_blks = pl.cdiv(t, num_q_per_blk)
+    grid = (num_q_blks,)
+
+    q_block_spec = pl.BlockSpec(
+        (num_q_per_blk, num_q_heads, latent_dim),
+        lambda qb, *_: (qb, 0, 0),
+    )
+    lm_shape = (num_q_per_blk * num_q_heads, 128)
+    scratch_shapes = [
+        pltpu.VMEM(
+            (2, num_pages_per_blk, page_size, 1, latent_dim),
+            lat_pages.dtype,
+        ),
+        pltpu.SemaphoreType.DMA((2,)),
+        pltpu.VMEM(lm_shape, jnp.float32),  # l
+        pltpu.VMEM(lm_shape, jnp.float32),  # m
+        pltpu.VMEM((num_q_per_blk, num_q_heads, value_dim), jnp.float32),
+    ]
+    scalar_prefetches = (
+        kv_lens,
+        page_indices,
+        cu_q_lens,
+        jnp.array((0, 0), jnp.int32),  # seq_idx, buf_idx
+        num_seqs,
+        layer.astype(jnp.int32).reshape(1),
+    )
+    kernel = pl.pallas_call(
+        functools.partial(
+            _mla_kernel,
+            sm_scale=sm_scale,
+            mask_value=mask_value,
+            value_dim=value_dim,
+        ),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=len(scalar_prefetches),
+            in_specs=[q_block_spec, pl.BlockSpec(memory_space=pl.ANY)],
+            out_specs=[
+                pl.BlockSpec(
+                    (num_q_per_blk, num_q_heads, value_dim),
+                    lambda qb, *_: (qb, 0, 0),
+                )
+            ],
+            grid=grid,
+            scratch_shapes=scratch_shapes,
+        ),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("arbitrary",),
+            vmem_limit_bytes=vmem_limit_bytes,
+        ),
+        out_shape=[
+            jax.ShapeDtypeStruct((t, num_q_heads, value_dim), q_abs.dtype)
+        ],
+        name="mla_kernel",
+        interpret=interpret,
+    )
+    return kernel(*scalar_prefetches, q_abs, lat_pages)[0]
