@@ -23,6 +23,7 @@ def create_scheduler(
     max_model_len: int = 2048,
     enable_prefix_caching: bool = True,
     policy: str = "fcfs",
+    sliding_window: int | None = None,
 ) -> Scheduler:
     sched_config = SchedulerConfig(
         max_num_batched_tokens=max_num_batched_tokens,
@@ -33,6 +34,7 @@ def create_scheduler(
     cache_config = CacheConfig(
         block_size=block_size,
         enable_prefix_caching=enable_prefix_caching,
+        sliding_window=sliding_window,
     )
     cache_config.num_gpu_blocks = num_blocks
     return Scheduler(sched_config, cache_config)

@@ -50,12 +50,11 @@ class KVCacheManager:
         self.num_stripes = num_stripes
         # Sliding-window models free blocks that fall fully out of the
         # window (reference: single_type_kv_cache_manager.py:507
-        # SlidingWindowManager.remove_skipped_blocks) — prefix caching is
-        # disabled for them (a cached block may be a freed null stand-in;
-        # the reference's window-aware hit logic is future work).
+        # SlidingWindowManager.remove_skipped_blocks) and use the
+        # window-aware hit logic in get_computed_blocks (longest cached
+        # suffix RUN covering the window; out-of-window prefix blocks are
+        # null stand-ins — find_longest_cache_hit, same file).
         self.sliding_window = sliding_window
-        if sliding_window is not None:
-            enable_caching = False  # safety net; the worker flips the flag
         self.enable_caching = enable_caching
         self.block_pool = BlockPool(
             num_blocks, enable_caching,
@@ -89,15 +88,48 @@ class KVCacheManager:
         if not self.enable_caching or not request.block_hashes:
             return [], 0
         max_hit_blocks = (request.num_tokens - 1) // self.block_size
-        hit_blocks: list[KVCacheBlock] = []
-        for block_hash in request.block_hashes[:max_hit_blocks]:
-            block = self.block_pool.get_cached_block(block_hash)
-            if block is None:
-                break
-            hit_blocks.append(block)
+        if self.sliding_window is None:
+            hit_blocks: list[KVCacheBlock] = []
+            for block_hash in request.block_hashes[:max_hit_blocks]:
+                block = self.block_pool.get_cached_block(block_hash)
+                if block is None:
+                    break
+                hit_blocks.append(block)
+        else:
+            hit_blocks = self._window_aware_hit(request, max_hit_blocks)
         num_hit_tokens = len(hit_blocks) * self.block_size
         self.prefix_cache_stats.observe(request.num_tokens, num_hit_tokens)
         return hit_blocks, num_hit_tokens
+
+    def _window_aware_hit(
+        self, request: Request, max_hit_blocks: int
+    ) -> list[KVCacheBlock]:
+        """Sliding-window hit: the first scheduled query (position P =
+        hit_tokens) only attends keys in ``(P - window, P)``, so a hit
+        needs a contiguous cached RUN of ``ceil((window-1)/bs)`` blocks
+        ending at P — everything before the run is served as null
+        stand-ins (window-masked reads, never written). Scan backward for
+        the LAST such run; a run anchored at block 0 is a plain prefix
+        hit and counts at any length. Reference:
+        ``single_type_kv_cache_manager.py:507``
+        ``SlidingWindowManager.find_longest_cache_hit``."""
+        required = -(-(self.sliding_window - 1) // self.block_size)
+        hashes = request.block_hashes[:max_hit_blocks]
+        null = self.block_pool.null_block
+        blocks = [null] * len(hashes)
+        run = 0
+        for i in range(len(hashes) - 1, -1, -1):
+            block = self.block_pool.get_cached_block(hashes[i])
+            if block is None:
+                run = 0
+                continue
+            blocks[i] = block
+            run += 1
+            if run >= required:
+                return blocks[: i + run]
+        # Loop exhausted: the only usable run is the one anchored at
+        # block 0 (plain prefix semantics).
+        return blocks[:run]
 
     # ------------------------------------------------------------------
     # Slot allocation (every scheduling of a request)

@@ -351,15 +351,6 @@ class Worker:
         # configs carry sliding_window for archs that ignore it).
         window = getattr(self.model, "sliding_window", None)
         self.config.cache_config.sliding_window = window
-        if window is not None and self.config.cache_config.enable_prefix_caching:
-            # Out-of-window blocks are freed and replaced by null stand-ins,
-            # which a prefix hit could resurrect; also saves the per-request
-            # block hashing the engine would do for a cache that never hits.
-            logger.info(
-                "prefix caching disabled: sliding-window KV (window=%d)",
-                window,
-            )
-            self.config.cache_config.enable_prefix_caching = False
 
         shardings = None
         if self.mesh is not None:
