@@ -259,6 +259,21 @@ def test_iteration_stats_flow(async_engine):
         assert reg.prompt_tokens.value >= 3
         assert reg.ttft.total >= 1
         assert reg.e2e.total >= 1
+        # Depth metrics (VERDICT r4 #9): queue time, bucket-cache
+        # counters, pipeline stall, finish-reason counter family.
+        assert reg.queue_time.total >= 1
+        assert reg.bucket_compiles.value >= 1
+        assert reg.request_success.values.get("length", 0) >= 1
+        rendered = reg.render()
+        for name in (
+            "vllm:request_queue_time_seconds",
+            "vllm:spec_decode_acceptance_length",
+            "vllm:step_bucket_compiles",
+            "vllm:step_bucket_hits",
+            "vllm:pipeline_stall_seconds",
+            'vllm:request_success_total{finished_reason="length"}',
+        ):
+            assert name in rendered, name
     finally:
         async_engine.stat_loggers.remove(reg)
 

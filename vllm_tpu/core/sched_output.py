@@ -149,6 +149,17 @@ class SchedulerStats:
     # Spec decode (cumulative): proposed draft tokens and accepted ones.
     spec_num_draft_tokens: int = 0
     spec_num_accepted_tokens: int = 0
+    # Per-step (drained each snapshot): waiting->running queue delays of
+    # requests first scheduled this step; per-request generated-token run
+    # lengths of spec verification steps (accepted + bonus).
+    queue_times: list[float] = field(default_factory=list)
+    spec_accept_lengths: list[int] = field(default_factory=list)
+    # Worker/engine-side cumulative counters attached by EngineCore:
+    # bucket-compile vs bucket-hit counts of the jitted step cache, and
+    # time the lag-N pipeline spent blocked fetching device results.
+    bucket_compiles: int = 0
+    bucket_hits: int = 0
+    pipeline_stall_s: float = 0.0
 
 
 @dataclass

@@ -165,6 +165,11 @@ class Scheduler:
         # Cumulative spec-decode accounting (acceptance-rate metric).
         self._spec_num_draft_tokens = 0
         self._spec_num_accepted_tokens = 0
+        # Per-step observability (drained by make_stats): queue delays of
+        # requests first scheduled this step; spec verification
+        # generated-run lengths (accepted + bonus) per request per step.
+        self._queue_times: list[float] = []
+        self._spec_accept_lengths: list[int] = []
         # Requests failed engine-side (e.g. grammar compile error) awaiting
         # an output record to the frontend.
         self._failed_requests: list[Request] = []
@@ -617,6 +622,13 @@ class Scheduler:
 
             self.waiting.popleft()
             resumed = request.status == RequestStatus.PREEMPTED
+            if not resumed:
+                # First scheduling: queue delay = arrival -> now
+                # (reference: request queue_time metric,
+                # vllm/v1/metrics/loggers.py request_queue_time_seconds).
+                self._queue_times.append(
+                    max(0.0, time.monotonic() - request.arrival_time)
+                )
             request.status = RequestStatus.RUNNING
             self.running.append(request)
             if request.num_cached_tokens < 0:
@@ -907,6 +919,7 @@ class Scheduler:
                     else len(scheduled_spec)
                 )
                 self._spec_num_accepted_tokens += max(0, len(generated) - 1)
+                self._spec_accept_lengths.append(len(generated))
                 # Verification: len(generated) = accepted drafts + 1 bonus.
                 # Rejected draft positions hold garbage KV; roll computed
                 # count back so they are recomputed (reference:
@@ -1062,6 +1075,10 @@ class Scheduler:
 
     def make_stats(self) -> SchedulerStats:
         stats = self.kv_cache_manager.prefix_cache_stats
+        queue_times, self._queue_times = self._queue_times, []
+        accept_lengths, self._spec_accept_lengths = (
+            self._spec_accept_lengths, []
+        )
         return SchedulerStats(
             num_running_reqs=len(self.running),
             num_waiting_reqs=len(self.waiting),
@@ -1071,4 +1088,6 @@ class Scheduler:
             num_preempted_reqs=self._num_preempted_total,
             spec_num_draft_tokens=self._spec_num_draft_tokens,
             spec_num_accepted_tokens=self._spec_num_accepted_tokens,
+            queue_times=queue_times,
+            spec_accept_lengths=accept_lengths,
         )

@@ -7,6 +7,7 @@ in-proc core both paths share.
 
 from __future__ import annotations
 
+import time
 from collections import deque
 from typing import Iterable
 
@@ -43,6 +44,9 @@ class EngineCore:
 
             scheduler_cls = AsyncScheduler
         self._inflight: deque = deque()
+        # Cumulative seconds blocked fetching device results (lag-pipeline
+        # stall; exported via SchedulerStats.pipeline_stall_s).
+        self._stall_s = 0.0
         # Outputs finalized outside step() (elastic-resize drain) waiting
         # for the next step() call to deliver them.
         self._drained_outputs: deque = deque()
@@ -195,10 +199,15 @@ class EngineCore:
             return failed if failed is not None else EngineCoreOutputs()
         scheduler_output, handle = self._inflight.popleft()
         with trace_span("finalize"):
+            t0 = time.monotonic()
             runner_output = self.executor.finalize(handle)
+            # Time blocked on the device fetch: ~0 when the lag-N overlap
+            # is winning, the whole device step when it is not.
+            self._stall_s += time.monotonic() - t0
         outputs = self.scheduler.update_from_output(
             scheduler_output, runner_output
         )
+        self._attach_engine_stats(outputs)
         for o in outputs.outputs:
             if o.finish_reason is not None:
                 trace_instant(
@@ -206,6 +215,22 @@ class EngineCore:
                     finish_reason=str(o.finish_reason),
                 )
         return outputs
+
+    def _attach_engine_stats(self, outputs: EngineCoreOutputs) -> None:
+        """Fold engine/worker-side counters into the step's stats snapshot
+        (bucket compile/hit counts of the jitted-step cache, pipeline
+        stall time). Reference analog: the compile/stall observability of
+        ``vllm/v1/metrics`` around CUDA-graph capture."""
+        stats = outputs.scheduler_stats
+        if stats is None:
+            return
+        stats.pipeline_stall_s = self._stall_s
+        runner = getattr(
+            getattr(self.executor, "worker", None), "runner", None
+        )
+        if runner is not None:
+            stats.bucket_compiles = getattr(runner, "bucket_compiles", 0)
+            stats.bucket_hits = getattr(runner, "bucket_hits", 0)
 
     def reset_prefix_cache(self) -> bool:
         ok = self.scheduler.kv_cache_manager.reset_prefix_cache()

@@ -176,6 +176,7 @@ class OutputProcessor:
             if finish_reason is not None:
                 state.metrics.finished_time = now
                 stats.e2e_latencies.append(now - state.metrics.arrival_time)
+                stats.finished_reasons.append(str(finish_reason))
                 # Pop BEFORE delivering the final output: once the client
                 # sees `finished` it may re-use the request id; popping
                 # after delivery could delete the successor's state.

@@ -69,6 +69,29 @@ class Histogram:
         return "\n".join(out) + "\n"
 
 
+class LabeledCounter:
+    """One counter family with a single label dimension (e.g. finish
+    reason)."""
+
+    def __init__(self, name: str, doc: str, label: str) -> None:
+        self.name, self.doc, self.label = name, doc, label
+        self.values: dict[str, float] = {}
+
+    def inc(self, key: str, v: float = 1.0) -> None:
+        self.values[key] = self.values.get(key, 0.0) + v
+
+    def render(self) -> str:
+        out = [
+            f"# HELP {self.name} {self.doc}",
+            f"# TYPE {self.name} counter",
+        ]
+        for key in sorted(self.values):
+            out.append(
+                f'{self.name}{{{self.label}="{key}"}} {self.values[key]}'
+            )
+        return "\n".join(out) + "\n"
+
+
 class PrometheusRegistry:
     """StatLogger + /metrics renderer."""
 
@@ -104,16 +127,40 @@ class PrometheusRegistry:
         self.e2e = Histogram(
             "vllm:e2e_request_latency_seconds", "Request E2E latency",
             [0.1, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0, 120.0])
+        self.queue_time = Histogram(
+            "vllm:request_queue_time_seconds",
+            "Time spent waiting before first schedule",
+            [0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1.0, 5.0, 20.0, 60.0])
+        self.accept_length = Histogram(
+            "vllm:spec_decode_acceptance_length",
+            "Generated tokens per spec verification step (accepted+bonus)",
+            [1.0, 2.0, 3.0, 4.0, 5.0, 6.0, 8.0, 12.0])
+        self.bucket_compiles = Counter(
+            "vllm:step_bucket_compiles",
+            "Jitted-step bucket cache misses (new (tokens,reqs,blocks))")
+        self.bucket_hits = Counter(
+            "vllm:step_bucket_hits", "Jitted-step bucket cache hits")
+        self.pipeline_stall = Counter(
+            "vllm:pipeline_stall_seconds",
+            "Seconds the async lag-N pipeline blocked on device results")
+        self.request_success = LabeledCounter(
+            "vllm:request_success_total",
+            "Finished requests by reason", "finished_reason")
         self._metrics = [
             self.num_running, self.num_waiting, self.kv_usage,
             self.prefix_queries, self.prefix_hits, self.preempted,
             self.spec_draft, self.spec_accepted,
             self.generation_tokens, self.prompt_tokens,
             self.ttft, self.tpot, self.e2e,
+            self.queue_time, self.accept_length,
+            self.bucket_compiles, self.bucket_hits, self.pipeline_stall,
+            self.request_success,
         ]
         self._last_prefix = (0, 0)
         self._last_preempted = 0
         self._last_spec = (0, 0)
+        self._last_buckets = (0, 0)
+        self._last_stall = 0.0
 
     # StatLoggerBase interface -----------------------------------------
 
@@ -136,6 +183,18 @@ class PrometheusRegistry:
             self._last_spec = (
                 s.spec_num_draft_tokens, s.spec_num_accepted_tokens,
             )
+            for t in s.queue_times:
+                self.queue_time.observe(t)
+            for n in s.spec_accept_lengths:
+                self.accept_length.observe(n)
+            lc, lh = self._last_buckets
+            self.bucket_compiles.inc(max(0, s.bucket_compiles - lc))
+            self.bucket_hits.inc(max(0, s.bucket_hits - lh))
+            self._last_buckets = (s.bucket_compiles, s.bucket_hits)
+            self.pipeline_stall.inc(
+                max(0.0, s.pipeline_stall_s - self._last_stall)
+            )
+            self._last_stall = s.pipeline_stall_s
         if iteration_stats is not None:
             self.generation_tokens.inc(iteration_stats.num_generation_tokens)
             self.prompt_tokens.inc(iteration_stats.num_prompt_tokens)
@@ -145,6 +204,8 @@ class PrometheusRegistry:
                 self.tpot.observe(t)
             for t in iteration_stats.e2e_latencies:
                 self.e2e.observe(t)
+            for reason in iteration_stats.finished_reasons:
+                self.request_success.inc(reason)
 
     def render(self) -> str:
         return "".join(m.render() for m in self._metrics)

@@ -13,3 +13,7 @@ class IterationStats:
     ttfts: list[float] = field(default_factory=list)
     inter_token_latencies: list[float] = field(default_factory=list)
     e2e_latencies: list[float] = field(default_factory=list)
+    # Finish reasons of requests completed this iteration ("stop",
+    # "length", "abort", ...) — exported as the labeled
+    # vllm:request_success_total counter family.
+    finished_reasons: list[str] = field(default_factory=list)

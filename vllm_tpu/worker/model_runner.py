@@ -336,6 +336,10 @@ class ModelRunner:
             from vllm_tpu.native import get_host_prep
 
             self._native_prep = get_host_prep()
+        # Bucket-cache counters (exported via SchedulerStats).
+        self._seen_buckets: set[tuple] = set()
+        self.bucket_compiles = 0
+        self.bucket_hits = 0
         self.timing = {"prep_s": 0.0, "dispatch_s": 0.0, "wait_s": 0.0,
                        "steps": 0}
 
@@ -1154,6 +1158,15 @@ class ModelRunner:
             (int(batch.num_blocks[row]) for row in rows), default=1
         )
         b_pad = _bucket(max(max_blocks, 1), self.block_buckets)
+        # Bucket-cache observability: first sight of a (tokens, reqs,
+        # blocks) triple compiles a new jitted-step variant (possibly
+        # served from the persistent XLA cache), later sights reuse it.
+        bkey = (t_pad, r_pad, b_pad)
+        if bkey in self._seen_buckets:
+            self.bucket_hits += 1
+        else:
+            self._seen_buckets.add(bkey)
+            self.bucket_compiles += 1
 
         # Packed i32 buffer; layout must match _unpack.
         t, r, b = t_pad, r_pad, b_pad
