@@ -1,0 +1,210 @@
+"""Tool-parser matrix: new families (deepseek_v3, granite, glm, internlm)
+and the incremental streaming wrapper, on recorded-output fixtures.
+
+Reference analog: ``tests/tool_use`` + per-parser tests under
+``tests/entrypoints/openai/tool_parsers`` (fixture text -> expected
+calls, non-stream and stream).
+"""
+
+from __future__ import annotations
+
+import json
+
+import pytest
+
+from vllm_tpu.parsers import get_tool_parser
+from vllm_tpu.parsers.tools import StreamingToolParser
+
+WEATHER_ARGS = {"location": "Tokyo", "unit": "celsius"}
+
+FIXTURES = {
+    "deepseek_v3": (
+        "<｜tool▁calls▁begin｜><｜tool▁call▁begin｜>function"
+        "<｜tool▁sep｜>get_weather\n```json\n"
+        + json.dumps(WEATHER_ARGS)
+        + "\n```<｜tool▁call▁end｜><｜tool▁calls▁end｜>"
+    ),
+    "granite": "<|tool_call|>"
+    + json.dumps([{"name": "get_weather", "arguments": WEATHER_ARGS}]),
+    "glm": (
+        "<tool_call>get_weather\n"
+        "<arg_key>location</arg_key>\n<arg_value>Tokyo</arg_value>\n"
+        "<arg_key>unit</arg_key>\n<arg_value>celsius</arg_value>\n"
+        "</tool_call>"
+    ),
+    "internlm": (
+        "I'll check the weather.<|action_start|><|plugin|>"
+        + json.dumps({"name": "get_weather", "parameters": WEATHER_ARGS})
+        + "<|action_end|>"
+    ),
+    "hermes": (
+        "<tool_call>"
+        + json.dumps({"name": "get_weather", "arguments": WEATHER_ARGS})
+        + "</tool_call>"
+    ),
+    "mistral": "[TOOL_CALLS]"
+    + json.dumps([{"name": "get_weather", "arguments": WEATHER_ARGS}]),
+}
+
+
+@pytest.mark.parametrize("family", sorted(FIXTURES))
+def test_family_parses_weather_call(family):
+    out = get_tool_parser(family).parse(FIXTURES[family])
+    assert len(out.tool_calls) == 1, (family, out)
+    call = out.tool_calls[0]
+    assert call.name == "get_weather"
+    assert json.loads(call.arguments) == WEATHER_ARGS
+
+
+@pytest.mark.parametrize("family", sorted(FIXTURES))
+def test_family_plain_text_passthrough(family):
+    text = "The weather in Tokyo is sunny today."
+    out = get_tool_parser(family).parse(text)
+    assert out.tool_calls == []
+    assert out.content == text
+
+
+def test_deepseek_v3_multiple_calls_with_content():
+    text = (
+        "Let me check both.\n<｜tool▁calls▁begin｜>"
+        "<｜tool▁call▁begin｜>function<｜tool▁sep｜>get_weather\n"
+        '```json\n{"location": "Tokyo"}\n```<｜tool▁call▁end｜>'
+        "<｜tool▁call▁begin｜>function<｜tool▁sep｜>get_time\n"
+        '```json\n{"tz": "JST"}\n```<｜tool▁call▁end｜>'
+        "<｜tool▁calls▁end｜>"
+    )
+    out = get_tool_parser("deepseek_v3").parse(text)
+    assert [c.name for c in out.tool_calls] == ["get_weather", "get_time"]
+    assert out.content == "Let me check both."
+
+
+def test_glm_json_values_decode():
+    text = (
+        "<tool_call>search\n"
+        "<arg_key>query</arg_key>\n<arg_value>tpu kernels</arg_value>\n"
+        "<arg_key>top_k</arg_key>\n<arg_value>3</arg_value>\n"
+        "</tool_call>"
+    )
+    out = get_tool_parser("glm4_moe").parse(text)
+    args = json.loads(out.tool_calls[0].arguments)
+    assert args == {"query": "tpu kernels", "top_k": 3}
+
+
+def test_internlm_content_around_call():
+    out = get_tool_parser("internlm").parse(FIXTURES["internlm"])
+    assert out.content == "I'll check the weather."
+    assert json.loads(out.tool_calls[0].arguments) == WEATHER_ARGS
+
+
+def test_granite_bad_json_surfaces_as_content():
+    text = "<|tool_call|>[{\"name\": broken"
+    out = get_tool_parser("granite").parse(text)
+    assert out.tool_calls == []
+    assert out.content == text
+
+
+def _stream(family: str, text: str, chunk: int = 7):
+    sp = StreamingToolParser(get_tool_parser(family))
+    content, calls = "", []
+    for i in range(0, len(text), chunk):
+        c, new = sp.push(text[i : i + chunk])
+        content += c
+        calls.extend(new)
+    tail_c, tail_calls = sp.finish()
+    return content + tail_c, calls, tail_calls, sp
+
+
+@pytest.mark.parametrize("family", sorted(FIXTURES))
+def test_streaming_matches_full_parse(family):
+    """Chunked streaming yields the same calls + content as one-shot."""
+    text = FIXTURES[family]
+    full = get_tool_parser(family).parse(text)
+    content, calls, tail_calls, _ = _stream(family, text)
+    all_calls = calls + tail_calls
+    assert [c.name for c in all_calls] == [c.name for c in full.tool_calls]
+    assert [json.loads(c.arguments) for c in all_calls] == [
+        json.loads(c.arguments) for c in full.tool_calls
+    ]
+    assert content.strip() == (full.content or "").strip()
+
+
+def test_streaming_content_flows_before_call():
+    """Prose before the call marker streams immediately (not buffered to
+    the end)."""
+    sp = StreamingToolParser(get_tool_parser("hermes"))
+    c1, calls1 = sp.push("Sure, let me look that up. ")
+    assert c1 == "Sure, let me look that up. " and not calls1
+    c2, calls2 = sp.push("<tool_call>")
+    assert c2 == "" and not calls2
+    c3, calls3 = sp.push(
+        json.dumps({"name": "f", "arguments": {}}) + "</tool_call>"
+    )
+    assert calls3 and calls3[0].name == "f"
+    _, tail = sp.finish()
+    assert not tail
+
+
+def test_streaming_holds_partial_marker():
+    """A trailing partial marker ('<tool_') is held, not leaked as
+    content, until disambiguated."""
+    sp = StreamingToolParser(get_tool_parser("hermes"))
+    c1, _ = sp.push("answer <tool_")
+    assert c1 == "answer "
+    c2, _ = sp.push("ing is fun")  # disambiguates: not a marker
+    tail_c, tail_calls = sp.finish()
+    assert (c1 + c2 + tail_c) == "answer <tool_ing is fun"
+    assert not tail_calls
+
+
+def test_streaming_call_emitted_mid_stream():
+    """With two calls, the first is emitted before the second arrives."""
+    call = json.dumps({"name": "a", "arguments": {}})
+    sp = StreamingToolParser(get_tool_parser("hermes"))
+    _, calls = sp.push(f"<tool_call>{call}</tool_call>")
+    assert [c.name for c in calls] == ["a"]
+    call2 = json.dumps({"name": "b", "arguments": {}})
+    _, calls = sp.push(f"<tool_call>{call2}</tool_call>")
+    assert [c.name for c in calls] == ["b"]
+
+
+def test_streaming_json_no_premature_emit():
+    """Whole-message formats must not emit mid-stream: a transiently
+    valid JSON prefix + trailing prose would otherwise emit a call AND
+    re-surface its JSON as content (review finding)."""
+    call_json = json.dumps({"name": "f", "arguments": {}})
+    # Clean case: the call is emitted exactly once, at finish.
+    sp = StreamingToolParser(get_tool_parser("json"))
+    _, calls = sp.push(call_json)
+    assert not calls  # held, not emitted mid-stream
+    tail_c, tail_calls = sp.finish()
+    assert [c.name for c in tail_calls] == ["f"] and not tail_c
+    # Dirty case: trailing prose invalidates the whole-message parse; the
+    # text surfaces once as content, no call, no duplication.
+    sp = StreamingToolParser(get_tool_parser("json"))
+    c1, calls1 = sp.push(call_json)
+    c2, calls2 = sp.push(" Done.")
+    tail_c, tail_calls = sp.finish()
+    assert not calls1 and not calls2 and not tail_calls
+    assert (c1 + c2 + tail_c) == call_json + " Done."
+
+
+def test_streaming_deepseek_malformed_block_survives():
+    """A malformed call block neither vanishes nor kills the good one."""
+    good = (
+        "<｜tool▁call▁begin｜>function<｜tool▁sep｜>ok\n"
+        '```json\n{"a": 1}\n```<｜tool▁call▁end｜>'
+    )
+    bad = (
+        "<｜tool▁call▁begin｜>function<｜tool▁sep｜>broken\n"
+        "```json\n{not json}\n```<｜tool▁call▁end｜>"
+    )
+    text = f"<｜tool▁calls▁begin｜>{good}{bad}<｜tool▁calls▁end｜>"
+    out = get_tool_parser("deepseek_v3").parse(text)
+    assert [c.name for c in out.tool_calls] == ["ok"]
+    assert "broken" in (out.content or "")  # malformed block surfaced
+
+
+def test_registry_has_families():
+    for name in ("qwen", "qwen3", "deepseek_v3", "granite", "glm",
+                 "glm4_moe", "internlm", "llama4_pythonic"):
+        assert get_tool_parser(name) is not None

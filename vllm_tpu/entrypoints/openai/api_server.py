@@ -204,11 +204,20 @@ async def handle_chat_completions(request: web.Request) -> web.StreamResponse:
             reasoning = get_reasoning_parser(reasoning_name)
         else:
             reasoning = None
-        # With tools active the text must be parsed as a whole: buffer and
-        # emit the parsed message in one final chunk (parity with the
-        # non-streaming path beats streaming raw <tool_call> markers).
+        # With tools active, stream incrementally: content before any
+        # possible call marker flows immediately; each call is emitted as
+        # a tool_calls delta the moment its block closes (reference:
+        # extract_tool_calls_streaming in vllm/tool_parsers/).
         buffer_tools = tools_active and tool_parser_name is not None
-        buffered = ""
+        stream_tools = None
+        n_calls = 0
+        if buffer_tools:
+            from vllm_tpu.parsers import get_tool_parser
+            from vllm_tpu.parsers.tools import StreamingToolParser
+
+            stream_tools = StreamingToolParser(
+                get_tool_parser(tool_parser_name)
+            )
 
         async def emit(delta: dict, finish: str | None) -> None:
             await _sse_send(resp, {
@@ -232,7 +241,23 @@ async def handle_chat_completions(request: web.Request) -> web.StreamResponse:
                     first = False
                 text = c.text or ""
                 if buffer_tools:
-                    buffered += text
+                    # Reasoning splits FIRST (matching the non-streaming
+                    # path): tool-call syntax inside a <think> block is
+                    # reasoning text, never a real call.
+                    if reasoning is not None and text:
+                        chunk = reasoning.parse_delta(text)
+                        if chunk.reasoning_delta:
+                            delta["reasoning_content"] = chunk.reasoning_delta
+                        text = chunk.content_delta or ""
+                    content_delta, new_calls = stream_tools.push(text)
+                    if content_delta:
+                        delta["content"] = content_delta
+                    if new_calls:
+                        delta["tool_calls"] = [
+                            {"index": n_calls + i, **t.to_openai()}
+                            for i, t in enumerate(new_calls)
+                        ]
+                        n_calls += len(new_calls)
                 elif reasoning is not None and text:
                     chunk = reasoning.parse_delta(text)
                     if chunk.reasoning_delta:
@@ -243,29 +268,21 @@ async def handle_chat_completions(request: web.Request) -> web.StreamResponse:
                     delta["content"] = text
                 finish = c.finish_reason if out.finished else None
                 if out.finished and buffer_tools:
-                    from vllm_tpu.parsers import (
-                        get_reasoning_parser,
-                        get_tool_parser,
-                    )
-
-                    content = buffered
-                    if reasoning_name:
-                        r, content = get_reasoning_parser(
-                            reasoning_name
-                        ).parse_full(content)
-                        if r:
-                            delta["reasoning_content"] = r
-                    parsed = get_tool_parser(tool_parser_name).parse(content)
-                    if parsed.tool_calls:
+                    # Reasoning already split upstream; the held tail is
+                    # plain content + any still-unemitted calls.
+                    tail_content, tail_calls = stream_tools.finish()
+                    if tail_calls:
+                        delta.setdefault("tool_calls", []).extend(
+                            {"index": n_calls + i, **t.to_openai()}
+                            for i, t in enumerate(tail_calls)
+                        )
+                        n_calls += len(tail_calls)
+                    if tail_content:
+                        delta["content"] = (
+                            delta.get("content", "") + tail_content
+                        )
+                    if stream_tools.saw_calls:
                         finish = "tool_calls"
-                        delta["tool_calls"] = [
-                            {"index": i, **t.to_openai()}
-                            for i, t in enumerate(parsed.tool_calls)
-                        ]
-                        if parsed.content:
-                            delta["content"] = parsed.content
-                    elif content:
-                        delta["content"] = content
                 if delta or out.finished:
                     await emit(delta, finish)
         except (ConnectionResetError, asyncio.CancelledError):

@@ -272,15 +272,261 @@ class PythonicToolParser(ToolParser):
         )
 
 
+class DeepSeekV3ToolParser(ToolParser):
+    """DeepSeek-V3/R1 format::
+
+        <｜tool▁calls▁begin｜><｜tool▁call▁begin｜>function<｜tool▁sep｜>NAME
+        ```json
+        {...args...}
+        ```<｜tool▁call▁end｜>...<｜tool▁calls▁end｜>
+
+    Reference: ``vllm/tool_parsers/deepseek_v3_tool_parser.py``."""
+
+    CALLS_BEGIN = "<｜tool▁calls▁begin｜>"
+    CALLS_END = "<｜tool▁calls▁end｜>"
+    STREAM_MARKERS = (CALLS_BEGIN, "<｜tool▁call▁begin｜>")
+    _CALL = re.compile(
+        r"<｜tool▁call▁begin｜>\s*\w*\s*<｜tool▁sep｜>\s*([\w.\-]+)\s*\n"
+        r"```json\s*\n(.*?)\n\s*```\s*<｜tool▁call▁end｜>",
+        re.S,
+    )
+
+    def parse(self, text: str) -> ParsedToolOutput:
+        calls: list[ToolCall] = []
+
+        def replace(m: re.Match) -> str:
+            name, args = m.group(1), m.group(2)
+            try:
+                obj = json.loads(args)
+            except json.JSONDecodeError:
+                # Unparseable payload must surface as content, not vanish.
+                return m.group(0)
+            calls.append(ToolCall(name=name, arguments=json.dumps(obj)))
+            return ""
+
+        content = self._CALL.sub(replace, text)
+        if not calls:
+            return ParsedToolOutput(content=text or None, tool_calls=[])
+        for tok in (self.CALLS_BEGIN, self.CALLS_END):
+            content = content.replace(tok, "")
+        return ParsedToolOutput(
+            content=content.strip() or None, tool_calls=calls
+        )
+
+
+class GraniteToolParser(ToolParser):
+    """IBM Granite-3 format: optional ``<|tool_call|>`` bot token, then a
+    JSON array of ``{"name", "arguments"}``. Reference:
+    ``vllm/tool_parsers/granite_tool_parser.py``."""
+
+    TOKEN = "<|tool_call|>"
+    STREAM_MARKERS = (TOKEN, "[")
+
+    def parse(self, text: str) -> ParsedToolOutput:
+        stripped = text.strip()
+        if stripped.startswith(self.TOKEN):
+            stripped = stripped[len(self.TOKEN):].lstrip()
+        if not stripped.startswith("["):
+            return ParsedToolOutput(content=text or None, tool_calls=[])
+        try:
+            obj, end = json.JSONDecoder().raw_decode(stripped)
+        except json.JSONDecodeError:
+            return ParsedToolOutput(content=text or None, tool_calls=[])
+        calls = [
+            c for item in (obj if isinstance(obj, list) else [obj])
+            if isinstance(item, dict)
+            if (c := _coerce_call(item)) is not None
+        ]
+        if not calls:
+            return ParsedToolOutput(content=text or None, tool_calls=[])
+        tail = stripped[end:].strip()
+        return ParsedToolOutput(content=tail or None, tool_calls=calls)
+
+
+class Glm4ToolParser(ToolParser):
+    """GLM-4.x format::
+
+        <tool_call>NAME
+        <arg_key>K</arg_key>
+        <arg_value>V</arg_value>
+        ...</tool_call>
+
+    Values parse as JSON when possible, else stay strings. Reference:
+    ``vllm/tool_parsers/glm4_moe_tool_parser.py``."""
+
+    STREAM_MARKERS = ("<tool_call>",)
+    _BLOCK = re.compile(r"<tool_call>\s*(.*?)\s*</tool_call>", re.S)
+    _ARG = re.compile(
+        r"<arg_key>\s*(.*?)\s*</arg_key>\s*<arg_value>\s*(.*?)\s*</arg_value>",
+        re.S,
+    )
+
+    def parse(self, text: str) -> ParsedToolOutput:
+        calls: list[ToolCall] = []
+        for block in self._BLOCK.findall(text):
+            name = block.split("\n", 1)[0].split("<arg_key>", 1)[0].strip()
+            if not name:
+                continue
+            args: dict = {}
+            for k, v in self._ARG.findall(block):
+                try:
+                    args[k] = json.loads(v)
+                except json.JSONDecodeError:
+                    args[k] = v
+            calls.append(ToolCall(name=name, arguments=json.dumps(args)))
+        if not calls:
+            return ParsedToolOutput(content=text or None, tool_calls=[])
+        content = self._BLOCK.sub("", text).strip()
+        return ParsedToolOutput(content=content or None, tool_calls=calls)
+
+
+class InternLMToolParser(ToolParser):
+    """InternLM2 format: ``content<|action_start|><|plugin|>{json}
+    <|action_end|>``. Reference:
+    ``vllm/tool_parsers/internlm2_tool_parser.py``."""
+
+    START, PLUGIN, END = "<|action_start|>", "<|plugin|>", "<|action_end|>"
+    STREAM_MARKERS = (START,)
+
+    def parse(self, text: str) -> ParsedToolOutput:
+        if self.START not in text:
+            return ParsedToolOutput(content=text or None, tool_calls=[])
+        content, _, rest = text.partition(self.START)
+        rest = rest.removeprefix(self.PLUGIN).strip()
+        payload, _, tail = rest.partition(self.END)
+        try:
+            obj = json.loads(payload.strip())
+        except json.JSONDecodeError:
+            return ParsedToolOutput(content=text or None, tool_calls=[])
+        call = _coerce_call(obj) if isinstance(obj, dict) else None
+        if call is None:
+            return ParsedToolOutput(content=text or None, tool_calls=[])
+        full = " ".join(s for s in (content.strip(), tail.strip()) if s)
+        return ParsedToolOutput(content=full or None, tool_calls=[call])
+
+
+# Streaming markers for the original families (class attribute keeps the
+# wrapper generic): content before a marker is safe to stream.
+HermesToolParser.STREAM_MARKERS = ("<tool_call>",)
+MistralToolParser.STREAM_MARKERS = (MistralToolParser.TOKEN,)
+PythonTagToolParser.STREAM_MARKERS = (PythonTagToolParser.TAG, "{", "[")
+PythonicToolParser.STREAM_MARKERS = ("[",)
+JsonToolParser.STREAM_MARKERS = ("{", "[", "```")
+
+# Mid-stream call emission is only sound for formats whose calls have an
+# explicit END marker: once closed, later text cannot extend or invalidate
+# the call. STREAM_END_HINTS doubles as the reparse trigger (a delta
+# without a hint cannot have closed a block — skip the O(buffer) parse).
+# Whole-message formats (json / python-tag / pythonic) stay buffer-to-
+# finish: a transiently-valid JSON prefix would emit a call that trailing
+# text later invalidates.
+HermesToolParser.STREAM_END_HINTS = ("</tool_call>",)
+DeepSeekV3ToolParser.STREAM_END_HINTS = ("<｜tool▁call▁end｜>",)
+Glm4ToolParser.STREAM_END_HINTS = ("</tool_call>",)
+InternLMToolParser.STREAM_END_HINTS = (InternLMToolParser.END,)
+MistralToolParser.STREAM_END_HINTS = ("]", "}")
+GraniteToolParser.STREAM_END_HINTS = ("]",)
+
+
+class StreamingToolParser:
+    """Incremental tool-call extraction over a streamed completion.
+
+    Contract (reference: the ``extract_tool_calls_streaming`` methods of
+    ``vllm/tool_parsers/``): text that cannot be part of a tool call
+    streams out as content immediately; text from the first possible
+    call marker on is held; each completed call is emitted as soon as its
+    block closes (detected by the wrapped parser's full ``parse`` on the
+    held region yielding more calls than already emitted). ``finish()``
+    reconciles: trailing content after the calls is flushed, and an
+    unparseable held region surfaces as content, never vanishes.
+    """
+
+    def __init__(self, parser: ToolParser) -> None:
+        self.parser = parser
+        self.markers: tuple[str, ...] = getattr(
+            parser, "STREAM_MARKERS", ()
+        )
+        # End-marker formats emit each call as its block closes; formats
+        # without END_HINTS (whole-message JSON styles) only emit at
+        # finish() — a transiently-parseable prefix must not emit a call
+        # that later text invalidates.
+        self.end_hints: tuple[str, ...] = getattr(
+            parser, "STREAM_END_HINTS", ()
+        )
+        self.buf = ""  # held (potential tool-call) text
+        self.emitted = 0
+
+    def _split_safe(self) -> str:
+        """Flushable prefix of the held buffer: everything before the
+        first marker occurrence or a trailing partial marker."""
+        if not self.markers:
+            return ""  # whole-message format: hold everything
+        first = min(
+            (i for m in self.markers if (i := self.buf.find(m)) >= 0),
+            default=-1,
+        )
+        if first >= 0:
+            return self.buf[:first]
+        # No full marker: hold only a suffix that could still become one.
+        max_keep = 0
+        for m in self.markers:
+            for k in range(min(len(m) - 1, len(self.buf)), 0, -1):
+                if self.buf.endswith(m[:k]):
+                    max_keep = max(max_keep, k)
+                    break
+        return self.buf[: len(self.buf) - max_keep]
+
+    def push(self, delta: str) -> tuple[str, list[ToolCall]]:
+        """Feed a text delta; returns (content_delta, newly closed calls)."""
+        self.buf += delta
+        new_calls: list[ToolCall] = []
+        # Reparse only when this delta could have CLOSED a block (keeps
+        # the wrapper off the O(buffer) path on every token).
+        if self.end_hints and any(h in delta for h in self.end_hints):
+            parsed = self.parser.parse(self.buf)
+            if len(parsed.tool_calls) > self.emitted:
+                new_calls = parsed.tool_calls[self.emitted:]
+                self.emitted = len(parsed.tool_calls)
+        if self.emitted:
+            # Once calls have been emitted, remaining content is only
+            # finalized at finish() (trailing prose may still grow).
+            return "", new_calls
+        content = self._split_safe()
+        self.buf = self.buf[len(content):]
+        return content, new_calls
+
+    def finish(self) -> tuple[str, list[ToolCall]]:
+        """End of stream: flush held text (as parsed content) and any
+        still-unemitted calls."""
+        parsed = self.parser.parse(self.buf)
+        self.buf = ""
+        new_calls = parsed.tool_calls[self.emitted:]
+        self.emitted = len(parsed.tool_calls)
+        if parsed.tool_calls:
+            return (parsed.content or ""), new_calls
+        return (parsed.content or ""), []
+
+    @property
+    def saw_calls(self) -> bool:
+        return self.emitted > 0
+
+
 _TOOL_PARSERS = {
     "hermes": HermesToolParser,
     "qwen": HermesToolParser,
+    "qwen3": HermesToolParser,
     "json": JsonToolParser,
     "llama3_json": JsonToolParser,
     "llama": PythonTagToolParser,
     "llama3": PythonTagToolParser,
+    "llama4_pythonic": PythonicToolParser,
     "mistral": MistralToolParser,
     "pythonic": PythonicToolParser,
+    "deepseek_v3": DeepSeekV3ToolParser,
+    "granite": GraniteToolParser,
+    "glm": Glm4ToolParser,
+    "glm4_moe": Glm4ToolParser,
+    "internlm": InternLMToolParser,
 }
 
 
