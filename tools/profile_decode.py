@@ -1,0 +1,95 @@
+"""Op-level TPU profile of the 8B decode step (the bench workload).
+
+Runs the bench engine briefly under jax.profiler, parses the xplane with
+jax.profiler.ProfileData, and prints the top device ops by total time —
+the ground truth for where the 36.7 ms decode step goes.
+"""
+
+from __future__ import annotations
+
+import collections
+import glob
+import os
+import sys
+import tempfile
+
+os.environ.setdefault("VLLM_TPU_LOG_LEVEL", "WARNING")
+os.environ.setdefault("HF_HUB_OFFLINE", "1")
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> None:
+    import jax
+    from transformers import LlamaConfig
+
+    from vllm_tpu.entrypoints.llm import LLM
+    from vllm_tpu.sampling_params import SamplingParams
+
+    shape = dict(
+        hidden_size=4096, intermediate_size=14336, num_hidden_layers=32,
+        num_attention_heads=32, num_key_value_heads=8, vocab_size=128256,
+    )
+    cfg = LlamaConfig(
+        max_position_embeddings=4096, tie_word_embeddings=False, **shape
+    )
+    cfg.architectures = ["LlamaForCausalLM"]
+    n_req = 64
+    llm = LLM(
+        model="dummy-llama", hf_config=cfg, load_format="dummy",
+        quantization="int8", max_model_len=2048,
+        max_num_batched_tokens=512, max_num_seqs=n_req,
+        quantize_embedding_layers=True, kv_cache_dtype="fp8",
+        num_gpu_blocks_override=704, num_decode_steps=4,
+    )
+    prompts = [
+        {"prompt_token_ids": [(7 * i + j) % 32000 for j in range(32)]}
+        for i in range(n_req)
+    ]
+    params = SamplingParams(temperature=0.0, max_tokens=32, ignore_eos=True)
+    llm.generate(prompts, params)  # warmup/compile
+
+    trace_dir = tempfile.mkdtemp(prefix="prof_decode_")
+    jax.profiler.start_trace(trace_dir)
+    llm.generate(prompts, params)
+    jax.profiler.stop_trace()
+
+    paths = glob.glob(
+        os.path.join(trace_dir, "**", "*.xplane.pb"), recursive=True
+    )
+    assert paths, f"no xplane under {trace_dir}"
+    from jax.profiler import ProfileData
+
+    data = ProfileData.from_file(paths[0])
+    for plane in data.planes:
+        if "TPU" not in plane.name and "tpu" not in plane.name:
+            continue
+        print(f"=== plane: {plane.name} ===")
+        per_op: dict[str, float] = collections.defaultdict(float)
+        per_op_n: dict[str, int] = collections.defaultdict(int)
+        total = 0.0
+        for line in plane.lines:
+            lname = line.name
+            if "XLA Ops" not in lname and "Steps" not in lname and True:
+                pass
+            for ev in line.events:
+                # Aggregate leaf op events only (XLA Ops line).
+                if "XLA Ops" in lname:
+                    key = ev.name
+                    # Collapse fused op instances: strip trailing .N ids.
+                    key = key.rstrip("0123456789").rstrip(".")
+                    per_op[key] += ev.duration_ns
+                    per_op_n[key] += 1
+                    total += ev.duration_ns
+        if not per_op:
+            continue
+        print(f"total device op time: {total / 1e6:.1f} ms")
+        top = sorted(per_op.items(), key=lambda kv: -kv[1])[:30]
+        for name, ns in top:
+            print(
+                f"{ns / 1e6:9.2f} ms  x{per_op_n[name]:<5d} "
+                f"{name[:100]}"
+            )
+
+
+if __name__ == "__main__":
+    sys.exit(main())
