@@ -31,6 +31,37 @@ def _prompts(n: int, input_len: int, vocab: int = 30000):
     ]
 
 
+def _dataset_requests(args, tokenizer=None):
+    """(engine prompts, per-request SamplingParams) from --dataset."""
+    from vllm_tpu.benchmarks.datasets import sample_dataset
+    from vllm_tpu.sampling_params import SamplingParams
+
+    reqs = sample_dataset(args, tokenizer)
+    prompts = [
+        r.prompt if r.prompt is not None
+        else {"prompt_token_ids": r.prompt_token_ids}
+        for r in reqs
+    ]
+    params = [
+        SamplingParams(
+            temperature=0.0, max_tokens=r.output_len, ignore_eos=True
+        )
+        for r in reqs
+    ]
+    return prompts, params
+
+
+def _prefix_hit_rate(llm) -> float | None:
+    try:
+        stats = (
+            llm.llm_engine.engine_core.engine_core.scheduler
+            .kv_cache_manager.prefix_cache_stats
+        )
+        return round(stats.hit_rate, 4)
+    except AttributeError:  # MP client: stats live in the engine proc
+        return None
+
+
 def _emit(result: dict, json_out: str | None):
     print(json.dumps(result, indent=2))
     if json_out:
@@ -71,19 +102,22 @@ def run_bench(args) -> dict:
             "p99_s": float(np.percentile(iters, 99)),
         }
     else:  # throughput
-        prompts = _prompts(args.num_prompts, args.input_len)
+        tok = getattr(llm.llm_engine, "tokenizer", None)
+        prompts, per_req_params = _dataset_requests(args, tok)
         t0 = time.monotonic()
-        outs = llm.generate(prompts, params)
+        outs = llm.generate(prompts, per_req_params)
         dt = time.monotonic() - t0
         n_out = sum(len(o.outputs[0].token_ids) for o in outs)
         n_in = sum(len(o.prompt_token_ids) for o in outs)
         result = {
             "mode": "throughput",
+            "dataset": getattr(args, "dataset", None) or "random",
             "num_prompts": args.num_prompts,
             "elapsed_s": dt,
             "requests_per_s": args.num_prompts / dt,
             "output_tokens_per_s": n_out / dt,
             "total_tokens_per_s": (n_in + n_out) / dt,
+            "prefix_cache_hit_rate": _prefix_hit_rate(llm),
         }
     _emit(result, args.json_out)
     llm.shutdown()
@@ -140,8 +174,13 @@ def _run_serve(args, params) -> dict:
 
 
 def _serve_one(engine, args, params, qps: float, warmup: bool = False) -> dict:
-    n = min(4, args.num_prompts) if warmup else args.num_prompts
-    prompts = _prompts(n, args.input_len)
+    from dataclasses import replace as _rep
+
+    tok = getattr(getattr(engine, "input_processor", None), "tokenizer", None)
+    prompts, per_req = _dataset_requests(args, tok)
+    per_req = [_rep(params, max_tokens=p.max_tokens) for p in per_req]
+    if warmup:
+        prompts, per_req = prompts[:4], per_req[:4]
     rng = np.random.default_rng(0)
 
     async def one(i, prompt, start_at, stats):
@@ -150,7 +189,7 @@ def _serve_one(engine, args, params, qps: float, warmup: bool = False) -> dict:
         first = None
         last = t0
         itls = []
-        async for out in engine.generate(prompt, params, f"bench-{i}"):
+        async for out in engine.generate(prompt, per_req[i], f"bench-{i}"):
             t = time.monotonic()
             if first is None:
                 first = t - t0

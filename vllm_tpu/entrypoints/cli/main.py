@@ -71,6 +71,15 @@ def _add_bench(sub: argparse._SubParsersAction) -> None:
     p.add_argument("--num-prompts", type=int, default=100)
     p.add_argument("--input-len", type=int, default=32)
     p.add_argument("--output-len", type=int, default=128)
+    p.add_argument(
+        "--dataset", choices=["random", "sharegpt", "synthetic-conv"],
+        default="random",
+        help="workload: fixed-length random ids, a ShareGPT-format JSON "
+             "(--dataset-path), or the conversation-shaped synthetic "
+             "distribution (shared prefixes + lognormal lengths)",
+    )
+    p.add_argument("--dataset-path", default=None)
+    # Dataset sampling reuses the engine --seed (fixed-seed protocol).
     p.add_argument("--batch-size", type=int, default=8)
     p.add_argument("--qps", type=float, default=0.0, help="serve mode request rate (0=inf)")
     p.add_argument(
