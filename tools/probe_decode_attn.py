@@ -1,0 +1,100 @@
+"""Device-time probe: general rpa kernel vs grouped decode kernel at the
+bench's decode shape, inside a 32-layer chain (layer index varies per
+iteration — XLA cannot CSE the calls). Ground truth for the
+default-or-delete decision on the decode path.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import time
+
+os.environ.setdefault("VLLM_TPU_LOG_LEVEL", "WARNING")
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+# Bench decode shape: 64 seqs, 1 query each, ctx ~96-160, fp8 KV,
+# 32 q heads / 8 kv heads / 128 head dim, page 16, 704 blocks, 32 layers.
+S, H, KH, D, BS, NB, L = 64, 32, 8, 128, 16, 704, 32
+CTX_LO, CTX_HI = 96, 160
+PAGES = 16  # block-table width (b_pad bucket)
+
+rng = np.random.default_rng(0)
+q = jnp.asarray(rng.standard_normal((S, H, D)), jnp.bfloat16)
+kv = jnp.asarray(
+    rng.standard_normal((L, NB, BS, 2 * KH, D)) * 0.1, jnp.float8_e4m3fn
+)
+kv_lens = jnp.asarray(rng.integers(CTX_LO, CTX_HI, size=S), jnp.int32)
+# Distinct pages per seq (1 + s*PAGES + p), clipped to NB.
+pt = (1 + np.arange(S)[:, None] * PAGES + np.arange(PAGES)[None, :]) % NB
+page_tables = jnp.asarray(pt, jnp.int32)
+cu = jnp.asarray(np.arange(S + 1), jnp.int32)
+num_seqs = jnp.asarray([S], jnp.int32)
+scale = D ** -0.5
+
+
+def chain(attn_fn):
+    @jax.jit
+    def f(q, kv):
+        def body(li, acc):
+            out = attn_fn(q, kv, li)
+            return acc + out.astype(jnp.float32)
+
+        return jax.lax.fori_loop(0, L, body, jnp.zeros((S, H, D), jnp.float32))
+    return f
+
+
+def rpa_fn(q, kv, li):
+    from vllm_tpu.ops.rpa_kernel import ragged_paged_attention
+
+    return ragged_paged_attention(
+        q, kv, jnp.asarray(li, jnp.int32).reshape(1), kv_lens,
+        page_tables, cu, num_seqs, sm_scale=scale,
+        k_scale=0.05, v_scale=0.05,
+    )
+
+
+def grouped_fn_args(g, cb):
+    def fn(q, kv, li):
+        from vllm_tpu.ops.decode_attention import grouped_decode_attention
+
+        return grouped_decode_attention(
+            q, kv, jnp.asarray(li, jnp.int32).reshape(1), kv_lens,
+            page_tables, sm_scale=scale, k_scale=0.05, v_scale=0.05,
+            group_size=g, pages_per_iter=cb,
+        )
+    return fn
+
+
+def bench(name, f):
+    out = f(q, kv)
+    out.block_until_ready()
+    best = float("inf")
+    for _ in range(5):
+        t0 = time.monotonic()
+        f(q, kv).block_until_ready()
+        best = min(best, time.monotonic() - t0)
+    per_layer_us = best / L * 1e6
+    print(f"{name:24s} {best * 1e3:8.2f} ms/32-layer  "
+          f"{per_layer_us:7.1f} us/layer")
+    return out, best
+
+
+def main():
+    print("device:", jax.devices()[0])
+    ref, t_rpa = bench("rpa (general)", chain(rpa_fn))
+    for g, cb in [(8, 4), (8, 10), (16, 10), (32, 10), (64, 10), (16, 4)]:
+        try:
+            got, t = bench(f"grouped g={g} cb={cb}", chain(grouped_fn_args(g, cb)))
+            err = float(jnp.max(jnp.abs(got - ref)) / jnp.max(jnp.abs(ref)))
+            print(f"    vs rpa: {t_rpa / t:5.2f}x   rel err {err:.4f}")
+        except Exception as e:  # noqa: BLE001
+            print(f"    grouped g={g} cb={cb} failed: {type(e).__name__}: {e}")
+
+
+if __name__ == "__main__":
+    sys.exit(main())

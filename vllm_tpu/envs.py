@@ -46,11 +46,6 @@ _readers: dict[str, Callable[[], Any]] = {
     # Compilation / runner
     "VLLM_TPU_DISABLE_PALLAS": _bool("VLLM_TPU_DISABLE_PALLAS", False),
     "VLLM_TPU_PALLAS_INTERPRET": _bool("VLLM_TPU_PALLAS_INTERPRET", False),
-    # Experimental grouped decode-attention kernel (ops/decode_attention
-    # .py). In-engine measurements on the shared v5e currently favor the
-    # general kernel; microbenchmarks are unreliable there (XLA CSE), so
-    # this stays opt-in until profiled properly.
-    "VLLM_TPU_GROUPED_DECODE": _bool("VLLM_TPU_GROUPED_DECODE", False),
     # INT8 weight matmuls via native int8xint8 MXU dot with per-token
     # dynamic activation quantization (w8a8). "auto" = on TPU only (the
     # dequant-into-bf16 path materializes a full-width weight copy there:

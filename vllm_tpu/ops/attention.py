@@ -63,7 +63,7 @@ class AttentionMetadata:
     )
     # STATIC: this step's tokens are one-query-per-sequence (token i IS
     # sequence i — the in-jit K-step decode chain shape). Dispatches the
-    # grouped decode fast path (``ops/decode_attention.py``).
+    # Pure-decode step (one query per sequence; in-jit decode chain).
     decode_grouped: bool = field(default=False, metadata=dict(static=True))
     # Hybrid attention+SSM models (Jamba/Bamba-class): per-request state
     # slot for the constant-size Mamba caches ([R] i32; None for pure
@@ -206,34 +206,6 @@ def dispatch_ragged_attention(
     interpret = allow_interpret and bool(envs.VLLM_TPU_PALLAS_INTERPRET)
     kernel_ok = q.shape[-1] in (64, 128, 256)
     on_tpu = _on_tpu()
-    if (
-        md.decode_grouped
-        and envs.VLLM_TPU_GROUPED_DECODE
-        and kernel_ok
-        and on_tpu
-        and not envs.VLLM_TPU_DISABLE_PALLAS
-        and not return_lse
-        and sliding_window is None
-        and isinstance(ctx_stride, int)
-        and ctx_stride == 1
-    ):
-        # One-query-per-sequence step (in-jit decode chain): the grouped
-        # decode kernel batches G sequences per grid step — the general
-        # kernel's per-sequence loop overhead dominates at this shape
-        # (~10x off the KV-read roofline on v5e).
-        from vllm_tpu.ops.decode_attention import grouped_decode_attention
-
-        return grouped_decode_attention(
-            q,
-            kv_cache,
-            jnp.asarray(layer, jnp.int32).reshape(1),
-            md.seq_lens,
-            md.block_tables,
-            sm_scale=scale,
-            soft_cap=soft_cap,
-            k_scale=k_scale,
-            v_scale=v_scale,
-        )
     if (
         not envs.VLLM_TPU_DISABLE_PALLAS
         and kernel_ok
