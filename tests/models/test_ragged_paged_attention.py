@@ -319,44 +319,3 @@ def test_pallas_kernel_striped_context(cp, d):
     ))
     np.testing.assert_allclose(merged_k, full, rtol=3e-4, atol=3e-4)
     np.testing.assert_allclose(merged_r, full, rtol=3e-4, atol=3e-4)
-
-
-def test_grouped_decode_kernel_matches_ref(d):
-    """The grouped decode fast path (one query per sequence) matches the
-    gather reference, including padding rows with kv_len 0, fp8-style
-    scales, and soft-cap."""
-    from vllm_tpu.ops.decode_attention import grouped_decode_attention
-
-    rng = np.random.default_rng(11)
-    kh, h, bs = 2, 4, 8
-    t = 16  # 16 seqs, one query each
-    kv_lens = [0] * t  # two pad rows at the end
-    for i in range(t - 2):
-        kv_lens[i] = int(rng.integers(1, 40))
-    q_lens = [1] * t
-    q, kv_cache, md = _random_case(
-        rng, t, q_lens, [max(kv, 1) for kv in kv_lens], kh, h, d, bs,
-        num_blocks=128,
-    )
-    kv_lens_arr = jnp.asarray(kv_lens, jnp.int32)
-    scale = d ** -0.5
-    for kw in ({}, {"soft_cap": 5.0}, {"k_scale": 0.5, "v_scale": 2.0}):
-        got = grouped_decode_attention(
-            q, kv_cache, jnp.asarray([0], jnp.int32), kv_lens_arr,
-            md.block_tables, sm_scale=scale, interpret=True,
-            group_size=8, pages_per_iter=2, **kw,
-        )
-        import dataclasses
-
-        md_ref = dataclasses.replace(
-            md, seq_lens=kv_lens_arr,
-            positions=jnp.maximum(kv_lens_arr - 1, 0),
-        )
-        want = ref_ragged_paged_attention(
-            q, kv_cache, jnp.int32(0), md_ref, scale, **kw
-        )
-        live = np.asarray(kv_lens) > 0
-        np.testing.assert_allclose(
-            np.asarray(got)[live], np.asarray(want)[live],
-            rtol=2e-4, atol=2e-4,
-        )
