@@ -1,0 +1,223 @@
+"""BERT/RoBERTa encoder family: HF parity (hidden states, CLS pooler,
+classification logits) + engine e2e embeddings and classification.
+
+Protocol of the reference's ``tests/models/language/pooling`` applied to
+the encoder-only family (``vllm/model_executor/models/bert.py``).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+
+def tiny_bert_config(**overrides):
+    from transformers import BertConfig
+
+    kw = dict(
+        vocab_size=128, hidden_size=48, intermediate_size=96,
+        num_hidden_layers=2, num_attention_heads=4,
+        max_position_embeddings=128, type_vocab_size=2, num_labels=3,
+    )
+    kw.update(overrides)
+    return BertConfig(**kw)
+
+
+@pytest.fixture(scope="module")
+def bert_cls_ckpt(tmp_path_factory):
+    import torch
+    from transformers import BertForSequenceClassification
+
+    torch.manual_seed(0)
+    hf = BertForSequenceClassification(tiny_bert_config()).to(torch.float32)
+    path = tmp_path_factory.mktemp("tiny_bert") / "m"
+    hf.save_pretrained(str(path), safe_serialization=True)
+    return str(path)
+
+
+@pytest.fixture(scope="module")
+def roberta_ckpt(tmp_path_factory):
+    import torch
+    from transformers import RobertaConfig, RobertaForSequenceClassification
+
+    torch.manual_seed(1)
+    cfg = RobertaConfig(
+        vocab_size=120, hidden_size=32, intermediate_size=64,
+        num_hidden_layers=2, num_attention_heads=2,
+        max_position_embeddings=130, num_labels=2,
+    )
+    hf = RobertaForSequenceClassification(cfg).to(torch.float32)
+    path = tmp_path_factory.mktemp("tiny_roberta") / "m"
+    hf.save_pretrained(str(path), safe_serialization=True)
+    return str(path)
+
+
+def test_bert_hidden_and_classify_parity(bert_cls_ckpt):
+    """Model-level: per-token hidden states and classification logits
+    match HF on a two-request ragged batch."""
+    import torch
+    from transformers import AutoConfig, BertForSequenceClassification
+
+    from tests.models.utils import build_prefill_metadata
+    from vllm_tpu.models.bert import (
+        BertForSequenceClassification as JaxBert,
+    )
+    from vllm_tpu.ops.attention import AttentionMetadata
+
+    cfg = AutoConfig.from_pretrained(bert_cls_ckpt)
+    model = JaxBert(cfg, dtype=jnp.float32)
+    params = model.load_params(bert_cls_ckpt, jnp.float32)
+
+    rng = np.random.default_rng(0)
+    a = rng.integers(5, 120, size=9).tolist()
+    b = rng.integers(5, 120, size=5).tolist()
+    ids = jnp.asarray(a + b, jnp.int32)
+    t = len(a) + len(b)
+    md = AttentionMetadata(
+        positions=jnp.asarray(
+            list(range(len(a))) + list(range(len(b))), jnp.int32
+        ),
+        slot_mapping=jnp.zeros(t, jnp.int32),
+        block_tables=jnp.zeros((2, 2), jnp.int32),
+        seq_lens=jnp.asarray([len(a), len(b)], jnp.int32),
+        query_start_loc=jnp.asarray([0, len(a), t], jnp.int32),
+        token_req_idx=jnp.asarray(
+            [0] * len(a) + [1] * len(b), jnp.int32
+        ),
+        logits_indices=jnp.asarray([len(a) - 1, t - 1], jnp.int32),
+        num_seqs=jnp.asarray([2], jnp.int32),
+    )
+    kv = jnp.zeros(model.kv_cache_shape(4, 16), jnp.float32)
+    hidden, _ = model.apply(params, kv, ids, md)
+    logits = np.asarray(model.pooled_extra(params, hidden, md, 2))
+
+    hf = BertForSequenceClassification.from_pretrained(
+        bert_cls_ckpt, torch_dtype=torch.float32
+    )
+    hf.eval()
+    with torch.no_grad():
+        hf_h_a = hf.bert(torch.tensor([a])).last_hidden_state[0].numpy()
+        want_a = hf(torch.tensor([a])).logits[0].numpy()
+        want_b = hf(torch.tensor([b])).logits[0].numpy()
+    got_h_a = np.asarray(hidden[: len(a)])
+    np.testing.assert_allclose(got_h_a, hf_h_a, rtol=2e-4, atol=2e-4)
+    np.testing.assert_allclose(logits[0], want_a, rtol=2e-4, atol=2e-4)
+    np.testing.assert_allclose(logits[1], want_b, rtol=2e-4, atol=2e-4)
+
+
+def test_roberta_classify_parity(roberta_ckpt):
+    import torch
+    from transformers import AutoConfig, RobertaForSequenceClassification
+
+    from vllm_tpu.models.bert import (
+        RobertaForSequenceClassification as JaxRoberta,
+    )
+    from vllm_tpu.ops.attention import AttentionMetadata
+
+    cfg = AutoConfig.from_pretrained(roberta_ckpt)
+    model = JaxRoberta(cfg, dtype=jnp.float32)
+    params = model.load_params(roberta_ckpt, jnp.float32)
+    rng = np.random.default_rng(2)
+    a = rng.integers(5, 110, size=7).tolist()
+    ids = jnp.asarray(a, jnp.int32)
+    md = AttentionMetadata(
+        positions=jnp.arange(len(a), dtype=jnp.int32),
+        slot_mapping=jnp.zeros(len(a), jnp.int32),
+        block_tables=jnp.zeros((1, 2), jnp.int32),
+        seq_lens=jnp.asarray([len(a)], jnp.int32),
+        query_start_loc=jnp.asarray([0, len(a)], jnp.int32),
+        token_req_idx=jnp.zeros(len(a), jnp.int32),
+        logits_indices=jnp.asarray([len(a) - 1], jnp.int32),
+        num_seqs=jnp.asarray([1], jnp.int32),
+    )
+    kv = jnp.zeros(model.kv_cache_shape(4, 16), jnp.float32)
+    hidden, _ = model.apply(params, kv, ids, md)
+    got = np.asarray(model.pooled_extra(params, hidden, md, 1))[0]
+    hf = RobertaForSequenceClassification.from_pretrained(
+        roberta_ckpt, torch_dtype=torch.float32
+    )
+    hf.eval()
+    with torch.no_grad():
+        want = hf(torch.tensor([a])).logits[0].numpy()
+    np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-4)
+
+
+def test_bert_engine_classify_and_cls(bert_cls_ckpt):
+    """Engine e2e: classify + cls pooling through LLM.embed; generation
+    requests are rejected for encoder-only models."""
+    import torch
+    from transformers import BertForSequenceClassification
+
+    from vllm_tpu import LLM, SamplingParams
+    from vllm_tpu.sampling_params import PoolingParams
+
+    llm = LLM(
+        model=bert_cls_ckpt, dtype="float32", max_model_len=64,
+        block_size=16, num_gpu_blocks_override=16, max_num_seqs=4,
+        max_num_batched_tokens=128,
+    )
+    rng = np.random.default_rng(3)
+    prompts = [rng.integers(5, 120, size=n).tolist() for n in (11, 4, 7)]
+    outs = llm.embed(
+        [{"prompt_token_ids": p} for p in prompts],
+        PoolingParams(pooling_type="classify", normalize=False),
+    )
+    hf = BertForSequenceClassification.from_pretrained(
+        bert_cls_ckpt, torch_dtype=torch.float32
+    )
+    hf.eval()
+    for p, o in zip(prompts, outs):
+        with torch.no_grad():
+            want = hf(torch.tensor([p])).logits[0].numpy()
+        np.testing.assert_allclose(
+            np.asarray(o.pooled), want, rtol=1e-3, atol=1e-3
+        )
+
+    # 'cls' on a classification checkpoint is rejected loudly (the plane
+    # holds classifier logits, not the pooler vector).
+    with pytest.raises(Exception, match="cls"):
+        llm.embed(
+            [{"prompt_token_ids": prompts[0]}],
+            PoolingParams(pooling_type="cls", normalize=False),
+        )
+
+    with pytest.raises(Exception, match="pooling|encoder"):
+        llm.generate(
+            [{"prompt_token_ids": prompts[0]}],
+            SamplingParams(max_tokens=2),
+        )
+
+
+def test_bert_base_model_cls_embeddings(tmp_path_factory):
+    """Bare BertModel: 'cls' pooling returns the tanh pooler vector,
+    matching HF's pooler_output."""
+    import torch
+    from transformers import BertModel as HFBert
+
+    from vllm_tpu import LLM
+    from vllm_tpu.sampling_params import PoolingParams
+
+    torch.manual_seed(2)
+    hf = HFBert(tiny_bert_config()).to(torch.float32)
+    path = str(tmp_path_factory.mktemp("tiny_bert_base") / "m")
+    hf.save_pretrained(path, safe_serialization=True)
+    hf.eval()
+
+    llm = LLM(
+        model=path, dtype="float32", max_model_len=64, block_size=16,
+        num_gpu_blocks_override=16, max_num_seqs=4,
+        max_num_batched_tokens=128,
+    )
+    rng = np.random.default_rng(4)
+    p = rng.integers(5, 120, size=9).tolist()
+    outs = llm.embed(
+        [{"prompt_token_ids": p}],
+        PoolingParams(pooling_type="cls", normalize=False),
+    )
+    with torch.no_grad():
+        want = hf(torch.tensor([p])).pooler_output[0].numpy()
+    np.testing.assert_allclose(
+        np.asarray(outs[0].pooled), want, rtol=1e-3, atol=1e-3
+    )

@@ -44,6 +44,19 @@ class InputProcessor:
         self._tokenizer_loaded = tokenizer is not None
         self._mm_info_cache: dict | None = None
         self._encdec_info_cache: dict | None = None
+        self._model_class_cache: Any = None
+
+    def _model_class(self) -> Any:
+        """Resolved model class (admission checks: encoder-only, pooler
+        head availability)."""
+        if self._model_class_cache is None:
+            from vllm_tpu.models.registry import get_model_class
+            from vllm_tpu.worker.worker import load_hf_config
+
+            self._model_class_cache = get_model_class(
+                load_hf_config(self.config.model_config)
+            )
+        return self._model_class_cache
 
     def _encdec_info(self) -> dict | None:
         """Encoder-decoder facts from the model class (None for decoder-
@@ -239,18 +252,46 @@ class InputProcessor:
                     f"gpu_memory_utilization or num_gpu_blocks_override"
                 )
 
+        model_cls = self._model_class()
+        encoder_only = getattr(model_cls, "is_encoder_only", False)
+        if encoder_only and pooling_params is None:
+            raise ValueError(
+                "encoder-only models serve pooling/scoring requests only "
+                "(no generation); pass pooling_params"
+            )
         if pooling_params is not None:
             sc = self.config.scheduler_config
             chunk_cap = sc.max_num_batched_tokens
             if sc.long_prefill_token_threshold > 0:
                 chunk_cap = min(chunk_cap, sc.long_prefill_token_threshold)
+            # Mean pooling segments one chunk; encoder-only bidirectional
+            # attention cannot be chunk-prefilled at all.
             if (
-                pooling_params.pooling_type == "mean"
-                and len(prompt_token_ids) > chunk_cap
+                pooling_params.pooling_type == "mean" or encoder_only
+            ) and len(prompt_token_ids) > chunk_cap:
+                raise ValueError(
+                    f"{'encoder-only' if encoder_only else 'mean'} pooling "
+                    "requires the prompt to fit one scheduler chunk "
+                    f"({chunk_cap} tokens)"
+                )
+            if pooling_params.pooling_type in ("cls", "classify") and not (
+                hasattr(model_cls, "pooled_extra")
             ):
                 raise ValueError(
-                    "mean pooling requires the prompt to fit one scheduler "
-                    f"chunk ({chunk_cap} tokens)"
+                    f"pooling_type {pooling_params.pooling_type!r} needs an "
+                    "encoder-only model with a pooler head"
+                )
+            has_classifier = getattr(model_cls, "classifier_head", False)
+            if pooling_params.pooling_type == "classify" and not has_classifier:
+                raise ValueError(
+                    "pooling_type 'classify' needs a SequenceClassification "
+                    "checkpoint"
+                )
+            if pooling_params.pooling_type == "cls" and has_classifier:
+                raise ValueError(
+                    "pooling_type 'cls' returns the pooler vector; this "
+                    "checkpoint has a classification head — use 'classify' "
+                    "(or load the base *Model checkpoint for embeddings)"
                 )
             params = SamplingParams(max_tokens=1)
         params = self._finalize_params(params, len(prompt_token_ids))

@@ -234,6 +234,61 @@ async def handle_score(request: web.Request) -> web.Response:
             "(or text_1 must be a single string)",
         )
 
+    # Cross-encoder checkpoints (BERT/RoBERTa SequenceClassification)
+    # score each PAIR jointly through the classification head — the
+    # reference's true /score semantics (``bert.py
+    # BertForSequenceClassification``); embedding models fall back to
+    # cosine similarity of independent embeddings below.
+    model_cls = None
+    try:
+        model_cls = engine.input_processor._model_class()
+    except Exception:  # noqa: BLE001 - resolution is best-effort
+        pass
+    if getattr(model_cls, "classifier_head", False):
+        tok = engine.input_processor.tokenizer
+        if tok is None:
+            return _err(400, "cross-encoder scoring needs a tokenizer")
+
+        async def score_pair(i: int, a: str, b: str):
+            ids = tok(a, b)["input_ids"]
+            final = None
+            async for out in engine.generate(
+                {"prompt_token_ids": ids},
+                SamplingParams(max_tokens=1), _rid("score"),
+                pooling_params=PoolingParams(
+                    pooling_type="classify", normalize=False
+                ),
+            ):
+                final = out
+            logits = np.asarray(final.pooled, np.float32)
+            # 1 label -> sigmoid relevance; N labels -> P(label 1)
+            # (the cross-encoder convention: label 1 = relevant).
+            if logits.shape[0] == 1:
+                score = float(1.0 / (1.0 + np.exp(-logits[0])))
+            else:
+                e = np.exp(logits - logits.max())
+                score = float((e / e.sum())[1])
+            return i, score, len(final.prompt_token_ids)
+
+        try:
+            results = await asyncio.gather(*(
+                score_pair(i, ones[i], twos[i]) for i in range(len(ones))
+            ))
+        except (ValueError, TypeError) as e:
+            return _err(400, str(e))
+        total = sum(r[2] for r in results)
+        return web.json_response({
+            "id": _rid("score"),
+            "object": "list",
+            "created": _now(),
+            "model": request.app[MODEL_KEY],
+            "data": [
+                {"index": i, "object": "score", "score": s}
+                for i, s, _ in sorted(results)
+            ],
+            "usage": {"prompt_tokens": total, "total_tokens": total},
+        })
+
     pooling = PoolingParams(pooling_type="last", normalize=True)
 
     async def embed(text: str):

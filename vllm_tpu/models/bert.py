@@ -1,0 +1,334 @@
+"""BERT/RoBERTa encoder-only models: embeddings, classification, scoring.
+
+Reference analog: ``vllm/model_executor/models/bert.py`` (BertModel,
+BertForSequenceClassification cross-encoder) and ``roberta.py``, plus the
+pooler heads of ``vllm/model_executor/layers/pooler/`` (CLS pool,
+classification head). VERDICT r4 missing #4.
+
+TPU-first shape: an encoder-only forward is ONE dense bidirectional
+attention pass over the ragged token batch — no KV cache, no paging, no
+decode. Attention masks block-diagonally by ``token_req_idx`` (tokens
+attend within their own request only), so a whole pooling batch runs in
+one jitted step like any other model, and the runner's pooling path
+(last/mean + the ``pooled_extra`` hook below for CLS / classification
+logits) does the rest. Requests are single-chunk by construction
+(bidirectional attention cannot be chunk-prefilled; enforced at
+admission via ``is_encoder_only``).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+from vllm_tpu.core.kv_cache_utils import FullAttentionSpec, KVCacheSpec
+from vllm_tpu.ops.attention import AttentionMetadata
+
+
+def _layer_norm(x, w, b, eps):
+    xf = x.astype(jnp.float32)
+    mu = xf.mean(-1, keepdims=True)
+    var = ((xf - mu) ** 2).mean(-1, keepdims=True)
+    return ((xf - mu) * jax.lax.rsqrt(var + eps) * w.astype(jnp.float32)
+            + b.astype(jnp.float32)).astype(x.dtype)
+
+
+class BertModel:
+    """Encoder-only trunk -> per-token hidden states (embeddings via the
+    engine pooling path: CLS through ``pooled_extra``, mean via the
+    runner's segment mean)."""
+
+    is_encoder_only = True
+    supports_lora = False
+    supports_quantized_embedding = False
+    quantize_embedding_layers = False
+    scan_layers = True
+    enable_lora = False
+    # Parallel/runtime hooks (worker-set; encoder models run tp via GSPMD
+    # weights sharding only).
+    pp_size = 1
+    pp_mesh = None
+    pp_microbatches = 0
+    cp_size = 1
+    cp_mesh = None
+    num_experts = 0
+    expert_parallel = False
+    enable_eplb = False
+    ep_mesh = None
+    # RoBERTa flags (subclass).
+    position_offset = 0  # RoBERTa: padding_idx + 1 = 2
+    classifier_head = False  # SequenceClassification subclasses
+
+    def __init__(self, hf_config: Any, dtype=jnp.float32,
+                 quantization: str | None = None) -> None:
+        if quantization is not None:
+            raise NotImplementedError(
+                "quantization for encoder-only models is not wired yet"
+            )
+        c = hf_config
+        self.hf_config = c
+        self.dtype = dtype
+        self.quantization = None
+        self.num_layers = c.num_hidden_layers
+        self.hidden_size = c.hidden_size
+        self.num_heads = c.num_attention_heads
+        self.num_kv_heads = c.num_attention_heads
+        self.head_dim = c.hidden_size // c.num_attention_heads
+        self.intermediate_size = c.intermediate_size
+        self.vocab_size = c.vocab_size
+        self.max_position = c.max_position_embeddings
+        self.type_vocab = getattr(c, "type_vocab_size", 2)
+        self.eps = getattr(c, "layer_norm_eps", 1e-12)
+        self.scale = 1.0 / math.sqrt(self.head_dim)
+        self.sliding_window = None
+        self.num_labels = int(getattr(c, "num_labels", 2) or 2)
+        self.act = getattr(c, "hidden_act", "gelu")
+
+    # ------------------------------------------------------------------
+    # Params
+    # ------------------------------------------------------------------
+
+    def init_dummy_params(self, rng: jax.Array, dtype=None) -> dict:
+        dtype = dtype or self.dtype
+        D, I, L, V = (self.hidden_size, self.intermediate_size,
+                      self.num_layers, self.vocab_size)
+        keys = iter(jax.random.split(rng, 64))
+
+        def init(shape, fan_in):
+            return (jax.random.normal(next(keys), shape, dtype)
+                    / math.sqrt(fan_in))
+
+        layers = {
+            "wq": init((L, D, D), D), "bq": jnp.zeros((L, D), dtype),
+            "wk": init((L, D, D), D), "bk": jnp.zeros((L, D), dtype),
+            "wv": init((L, D, D), D), "bv": jnp.zeros((L, D), dtype),
+            "wo": init((L, D, D), D), "bo": jnp.zeros((L, D), dtype),
+            "ln1_w": jnp.ones((L, D), dtype),
+            "ln1_b": jnp.zeros((L, D), dtype),
+            "wi": init((L, D, I), D), "bi": jnp.zeros((L, I), dtype),
+            "wo2": init((L, I, D), I), "bo2": jnp.zeros((L, D), dtype),
+            "ln2_w": jnp.ones((L, D), dtype),
+            "ln2_b": jnp.zeros((L, D), dtype),
+        }
+        params = {
+            "embed": init((V, D), D),
+            "pos_embed": init((self.max_position, D), D),
+            "type_embed": init((self.type_vocab, D), D),
+            "emb_ln_w": jnp.ones((D,), dtype),
+            "emb_ln_b": jnp.zeros((D,), dtype),
+            "layers": layers,
+            "pool_w": init((D, D), D),
+            "pool_b": jnp.zeros((D,), dtype),
+        }
+        if self.classifier_head:
+            params["cls_w"] = init((D, self.num_labels), D)
+            params["cls_b"] = jnp.zeros((self.num_labels,), dtype)
+        return params
+
+    def hf_weight_map(self) -> dict:
+        p = self.hf_prefix
+        m = {
+            f"{p}embeddings.word_embeddings.weight": ("embed", False),
+            f"{p}embeddings.position_embeddings.weight": ("pos_embed", False),
+            f"{p}embeddings.token_type_embeddings.weight": ("type_embed", False),
+            f"{p}embeddings.LayerNorm.weight": ("emb_ln_w", False),
+            f"{p}embeddings.LayerNorm.bias": ("emb_ln_b", False),
+            f"{p}pooler.dense.weight": ("pool_w", True),
+            f"{p}pooler.dense.bias": ("pool_b", False),
+        }
+        for i in range(self.num_layers):
+            hf = f"{p}encoder.layer.{i}"
+            for hf_n, ours in (("query", "q"), ("key", "k"), ("value", "v")):
+                m[f"{hf}.attention.self.{hf_n}.weight"] = (
+                    f"layers.w{ours}.{i}", True)
+                m[f"{hf}.attention.self.{hf_n}.bias"] = (
+                    f"layers.b{ours}.{i}", False)
+            m[f"{hf}.attention.output.dense.weight"] = ("layers.wo." + str(i), True)
+            m[f"{hf}.attention.output.dense.bias"] = ("layers.bo." + str(i), False)
+            m[f"{hf}.attention.output.LayerNorm.weight"] = (
+                f"layers.ln1_w.{i}", False)
+            m[f"{hf}.attention.output.LayerNorm.bias"] = (
+                f"layers.ln1_b.{i}", False)
+            m[f"{hf}.intermediate.dense.weight"] = (f"layers.wi.{i}", True)
+            m[f"{hf}.intermediate.dense.bias"] = (f"layers.bi.{i}", False)
+            m[f"{hf}.output.dense.weight"] = (f"layers.wo2.{i}", True)
+            m[f"{hf}.output.dense.bias"] = (f"layers.bo2.{i}", False)
+            m[f"{hf}.output.LayerNorm.weight"] = (f"layers.ln2_w.{i}", False)
+            m[f"{hf}.output.LayerNorm.bias"] = (f"layers.ln2_b.{i}", False)
+        if self.classifier_head:
+            m.update(self.classifier_weight_map())
+        else:
+            # Bare *Model checkpoints (BertModel.save_pretrained) store
+            # the same tensors WITHOUT the task-model prefix; accept both.
+            m.update({
+                k[len(p):]: v for k, v in m.items() if k.startswith(p)
+            })
+        return m
+
+    hf_prefix = "bert."
+
+    def classifier_weight_map(self) -> dict:
+        return {
+            "classifier.weight": ("cls_w", True),
+            "classifier.bias": ("cls_b", False),
+        }
+
+    def load_params(self, path: str, dtype=None, shardings=None) -> dict:
+        from vllm_tpu.models.loader import load_params_from
+
+        return load_params_from(self, path, dtype or self.dtype, shardings)
+
+    def param_shardings(self, mesh_axes: dict) -> Any:
+        return None  # replicated; GSPMD shards the batched matmuls
+
+    # ------------------------------------------------------------------
+    # KV cache contract (vestigial: nothing is cached)
+    # ------------------------------------------------------------------
+
+    def get_kv_cache_spec(self, block_size: int, dtype_bytes: int) -> dict[str, KVCacheSpec]:
+        # One token-sized page keeps the block-pool machinery happy while
+        # costing nothing (no KV is ever written or read).
+        spec = FullAttentionSpec(
+            block_size=block_size, num_kv_heads=1, head_size=1,
+            dtype_bytes=dtype_bytes,
+        )
+        return {"encoder": spec}
+
+    def kv_cache_shape(self, num_blocks: int, block_size: int):
+        return (1, num_blocks, block_size, 2, 1)
+
+    def kv_cache_sharding(self):
+        from jax.sharding import PartitionSpec as P
+
+        return P()
+
+    # ------------------------------------------------------------------
+    # Forward
+    # ------------------------------------------------------------------
+
+    def apply(
+        self,
+        params: dict,
+        kv_cache: jnp.ndarray,
+        input_ids: jnp.ndarray,  # [T]
+        md: AttentionMetadata,
+        token_lora_slot: jnp.ndarray | None = None,
+        inputs_embeds: jnp.ndarray | None = None,
+    ) -> tuple[jnp.ndarray, jnp.ndarray]:
+        t = input_ids.shape[0]
+        H, Dh = self.num_heads, self.head_dim
+        pos = jnp.clip(
+            md.positions + self.position_offset, 0, self.max_position - 1
+        )
+        x = (
+            params["embed"][input_ids]
+            + params["pos_embed"][pos]
+            + params["type_embed"][0]
+        ).astype(self.dtype)
+        x = _layer_norm(x, params["emb_ln_w"], params["emb_ln_b"], self.eps)
+
+        # Bidirectional block-diagonal mask: token j is visible to token i
+        # iff same request AND j is a live token.
+        t_live = md.query_start_loc[md.num_seqs[0]]
+        live = jnp.arange(t) < t_live
+        same = md.token_req_idx[:, None] == md.token_req_idx[None, :]
+        mask = same & live[None, :] & live[:, None]  # [T, T]
+
+        act = {
+            "gelu": lambda v: jax.nn.gelu(
+                v.astype(jnp.float32), approximate=False
+            ).astype(v.dtype),
+            "gelu_new": lambda v: jax.nn.gelu(
+                v.astype(jnp.float32), approximate=True
+            ).astype(v.dtype),
+            "relu": jax.nn.relu,
+        }[self.act]
+
+        def layer_fn(x, lp):
+            q = (x @ lp["wq"] + lp["bq"]).reshape(t, H, Dh)
+            k = (x @ lp["wk"] + lp["bk"]).reshape(t, H, Dh)
+            v = (x @ lp["wv"] + lp["bv"]).reshape(t, H, Dh)
+            scores = (
+                jnp.einsum("thd,shd->hts", q, k,
+                           preferred_element_type=jnp.float32) * self.scale
+            )
+            scores = jnp.where(mask[None, :, :], scores, -jnp.inf)
+            probs = jax.nn.softmax(scores, axis=-1)
+            probs = jnp.where(jnp.isnan(probs), 0.0, probs)  # dead rows
+            ctx = jnp.einsum(
+                "hts,shd->thd", probs.astype(x.dtype), v
+            ).reshape(t, H * Dh)
+            x2 = _layer_norm(
+                x + (ctx @ lp["wo"] + lp["bo"]),
+                lp["ln1_w"], lp["ln1_b"], self.eps,
+            )
+            h = act(x2 @ lp["wi"] + lp["bi"])
+            return _layer_norm(
+                x2 + (h @ lp["wo2"] + lp["bo2"]),
+                lp["ln2_w"], lp["ln2_b"], self.eps,
+            ), None
+
+        x, _ = jax.lax.scan(layer_fn, x, params["layers"])
+        return x, kv_cache
+
+    def compute_logits(self, params: dict, hidden: jnp.ndarray) -> jnp.ndarray:
+        # Encoder-only models cannot generate; admission rejects sampling
+        # requests, and the runner's unconditional logits call gets a
+        # harmless single-column zero.
+        return jnp.zeros((hidden.shape[0], 1), jnp.float32)
+
+    # ------------------------------------------------------------------
+    # Pooling hook (runner): CLS vector / classification logits
+    # ------------------------------------------------------------------
+
+    def pooled_extra(
+        self, params: dict, hidden: jnp.ndarray, md: AttentionMetadata,
+        r_pad: int,
+    ) -> jnp.ndarray:
+        """Per-request CLS-position output: the tanh pooler vector
+        (BertModel) or classification logits (SequenceClassification)."""
+        starts = jnp.clip(md.query_start_loc[:r_pad], 0, hidden.shape[0] - 1)
+        cls_h = hidden[starts]  # [R, D]
+        if not self.classifier_head:
+            pooled = jnp.tanh(
+                (cls_h @ params["pool_w"] + params["pool_b"])
+                .astype(jnp.float32)
+            )
+            return pooled
+        return self.classify(params, cls_h).astype(jnp.float32)
+
+    def classify(self, params: dict, cls_h: jnp.ndarray) -> jnp.ndarray:
+        """BERT classification: tanh pooler -> linear classifier."""
+        pooled = jnp.tanh((cls_h @ params["pool_w"] + params["pool_b"])
+                          .astype(jnp.float32)).astype(cls_h.dtype)
+        return pooled @ params["cls_w"] + params["cls_b"]
+
+
+class BertForSequenceClassification(BertModel):
+    """Cross-encoder scoring / classification (reference:
+    ``bert.py BertForSequenceClassification`` + the /score endpoint)."""
+
+    classifier_head = True
+
+
+class RobertaModel(BertModel):
+    hf_prefix = "roberta."
+    # RoBERTa position ids start at padding_idx + 1 = 2.
+    position_offset = 2
+
+
+class RobertaForSequenceClassification(RobertaModel):
+    """RoBERTa head: dense+tanh -> out_proj on <s> (no shared pooler)."""
+
+    classifier_head = True
+
+    def classifier_weight_map(self) -> dict:
+        return {
+            "classifier.dense.weight": ("pool_w", True),
+            "classifier.dense.bias": ("pool_b", False),
+            "classifier.out_proj.weight": ("cls_w", True),
+            "classifier.out_proj.bias": ("cls_b", False),
+        }

@@ -56,6 +56,12 @@ _REGISTRY: dict[str, tuple[str, str]] = {
     "NemotronForCausalLM": ("vllm_tpu.models.nemotron", "NemotronForCausalLM"),
     "Starcoder2ForCausalLM": ("vllm_tpu.models.gpt_like", "Starcoder2ForCausalLM"),
     "GPTJForCausalLM": ("vllm_tpu.models.gpt_like", "GPTJForCausalLM"),
+    "BertModel": ("vllm_tpu.models.bert", "BertModel"),
+    "BertForSequenceClassification": ("vllm_tpu.models.bert", "BertForSequenceClassification"),
+    "RobertaModel": ("vllm_tpu.models.bert", "RobertaModel"),
+    "RobertaForSequenceClassification": ("vllm_tpu.models.bert", "RobertaForSequenceClassification"),
+    "XLMRobertaModel": ("vllm_tpu.models.bert", "RobertaModel"),
+    "XLMRobertaForSequenceClassification": ("vllm_tpu.models.bert", "RobertaForSequenceClassification"),
     "OlmoeForCausalLM": ("vllm_tpu.models.moe_zoo", "OlmoeForCausalLM"),
     "GraniteMoeForCausalLM": ("vllm_tpu.models.moe_zoo", "GraniteMoeForCausalLM"),
     "DbrxForCausalLM": ("vllm_tpu.models.moe_zoo", "DbrxForCausalLM"),

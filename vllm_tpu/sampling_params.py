@@ -46,11 +46,14 @@ class PoolingParams:
     ``vllm/pooling_params.py``). Causal-LM pooling: hidden state of the
     last token or the masked mean over the prompt."""
 
-    pooling_type: str = "last"  # "last" | "mean"
+    pooling_type: str = "last"  # "last" | "mean" | "cls" | "classify"
     normalize: bool = True
 
     def __post_init__(self) -> None:
-        if self.pooling_type not in ("last", "mean"):
+        # "cls" (first-position pooler vector) and "classify"
+        # (classification-head logits) require an encoder-only model with
+        # a pooled_extra hook (models/bert.py); validated at admission.
+        if self.pooling_type not in ("last", "mean", "cls", "classify"):
             raise ValueError(f"unknown pooling_type {self.pooling_type!r}")
 
 

@@ -732,6 +732,12 @@ class ModelRunner:
             )
             mean = sums / counts_seg[:, None]
             pooled = (last.astype(jnp.float32), mean)
+            if hasattr(self.model, "pooled_extra"):
+                # Model-defined third pooling plane: CLS pooler vector or
+                # classification logits (encoder-only family).
+                pooled = pooled + (
+                    self.model.pooled_extra(params, hidden, md, r_pad),
+                )
         logits = self.model.compute_logits(params, last)  # [R, V] f32
         if self._nan_check:
             nan_count = jnp.isnan(logits).sum()
@@ -1905,11 +1911,10 @@ class ModelRunner:
                 and state_i.pooling_params is not None
             ):
                 pp = state_i.pooling_params
-                vec = (
-                    pooled_np[1][i]
-                    if pp.pooling_type == "mean"
-                    else pooled_np[0][i]
-                )
+                # Plane 2 (cls / classify) exists only for models with a
+                # pooled_extra hook; admission validates the pairing.
+                plane = {"last": 0, "mean": 1}.get(pp.pooling_type, 2)
+                vec = pooled_np[plane][i]
                 if pp.normalize:
                     vec = vec / max(float(np.linalg.norm(vec)), 1e-12)
                 out.pooler_outputs[rid] = [float(x) for x in vec]
