@@ -1,0 +1,226 @@
+"""Qwen2.5-VL: windowed vision tower + Qwen2.5 decoder with m-rope.
+
+Reference analog: ``vllm/model_executor/models/qwen2_5_vl.py`` (VERDICT
+r4 missing #5). Deltas from Qwen2-VL (``qwen2_vl.py`` here, which this
+subclasses):
+
+- vision blocks use RMSNorm (weight-only) and a gated-silu MLP
+  (gate/up/down, biased) instead of LayerNorm + fc1/fc2;
+- WINDOW attention: every block except ``fullatt_block_indexes`` attends
+  within ``window_size``-pixel windows. With this framework's static
+  square grid the window partition is a STATIC permutation of merge
+  units (HF's get_window_index specialized to one image): patches are
+  permuted to window order once after patch embed, windowed blocks run
+  batched per-window attention ([n_win, win_len] — one einsum, no
+  ragged seqlens), full blocks attend globally (order-invariant), and
+  the inverse permutation restores merge-major order for the merger;
+- the merger's ln_q is RMSNorm and projects to ``out_hidden_size``.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from vllm_tpu.logger import init_logger
+from vllm_tpu.models.qwen2_vl import (
+    Qwen2VLForConditionalGeneration,
+    _rotate_half,
+)
+
+logger = init_logger(__name__)
+
+
+def _rms(x, w, eps=1e-6):
+    xf = x.astype(jnp.float32)
+    n = xf * jax.lax.rsqrt((xf * xf).mean(-1, keepdims=True) + eps)
+    return (n * w.astype(jnp.float32)).astype(x.dtype)
+
+
+class Qwen25VLForConditionalGeneration(Qwen2VLForConditionalGeneration):
+    def __init__(self, hf_config: Any, dtype=jnp.bfloat16,
+                 quantization: str | None = None) -> None:
+        super().__init__(hf_config, dtype, quantization)
+        vc = hf_config.vision_config
+        self.out_hidden = getattr(vc, "out_hidden_size", self.hidden_size)
+        self.vision_act = getattr(vc, "hidden_act", "silu")
+        self.fullatt_blocks = set(
+            getattr(vc, "fullatt_block_indexes", None) or []
+        )
+        window_px = getattr(vc, "window_size", 112)
+        wu = max(1, window_px // (self.merge * self.patch_size))
+        if self.llm_grid % wu:
+            logger.warning(
+                "vision grid %d not divisible by window units %d; all "
+                "blocks run full attention", self.llm_grid, wu,
+            )
+            self.win_units = None
+            self._win_perm = None
+            self._win_inv = None
+            self.n_windows = 1
+            self.win_patches = self.num_patches
+        else:
+            self.win_units = wu
+            # Merge-unit permutation to window order (static grid).
+            lg = self.llm_grid
+            units = np.arange(lg * lg).reshape(lg, lg)
+            units = (
+                units.reshape(lg // wu, wu, lg // wu, wu)
+                .transpose(0, 2, 1, 3).reshape(-1)
+            )
+            m2 = self.merge * self.merge
+            perm = (units[:, None] * m2 + np.arange(m2)[None, :]).reshape(-1)
+            inv = np.empty_like(perm)
+            inv[perm] = np.arange(perm.size)
+            self._win_perm = jnp.asarray(perm, jnp.int32)
+            self._win_inv = jnp.asarray(inv, jnp.int32)
+            self.n_windows = (lg // wu) ** 2
+            self.win_patches = (wu * self.merge) ** 2
+
+    # ------------------------------------------------------------------
+    # Params (RMS norms, gated MLP, out_hidden merger)
+    # ------------------------------------------------------------------
+
+    def init_dummy_params(self, rng: jax.Array, dtype=None) -> dict:
+        dtype = dtype or self.dtype
+        params = self.lang.init_dummy_params(jax.random.fold_in(rng, 1), dtype)
+        Dv, Lv, F = self.vision_dim, self.vision_depth, self.vision_mlp
+        patch_in = (
+            self.in_channels * self.temporal_patch_size
+            * self.patch_size * self.patch_size
+        )
+        mh = Dv * self.merge * self.merge
+        key = iter(jax.random.split(rng, 12))
+
+        def init(shape, fan_in):
+            return (
+                jax.random.normal(next(key), shape, jnp.float32)
+                / math.sqrt(fan_in)
+            ).astype(dtype)
+
+        params["vision"] = {
+            "patch_w": init((patch_in, Dv), patch_in),
+            "blocks": {
+                "ln1_w": jnp.ones((Lv, Dv), dtype),
+                "qkv_w": init((Lv, Dv, 3 * Dv), Dv),
+                "qkv_b": jnp.zeros((Lv, 3 * Dv), dtype),
+                "proj_w": init((Lv, Dv, Dv), Dv),
+                "proj_b": jnp.zeros((Lv, Dv), dtype),
+                "ln2_w": jnp.ones((Lv, Dv), dtype),
+                "gate_w": init((Lv, Dv, F), Dv),
+                "gate_b": jnp.zeros((Lv, F), dtype),
+                "up_w": init((Lv, Dv, F), Dv),
+                "up_b": jnp.zeros((Lv, F), dtype),
+                "down_w": init((Lv, F, Dv), F),
+                "down_b": jnp.zeros((Lv, Dv), dtype),
+            },
+            "merger_ln_w": jnp.ones((Dv,), dtype),
+            "merger_fc1_w": init((mh, mh), mh),
+            "merger_fc1_b": jnp.zeros((mh,), dtype),
+            "merger_fc2_w": init((mh, self.out_hidden), mh),
+            "merger_fc2_b": jnp.zeros((self.out_hidden,), dtype),
+        }
+        return params
+
+    def hf_weight_map(self) -> dict:
+        m = {}
+        for hf_name, dest in self.lang.hf_weight_map().items():
+            m[hf_name] = dest
+            if hf_name.startswith("model."):
+                m["model.language_model." + hf_name[len("model."):]] = dest
+        v = "model.visual"
+        m[f"{v}.patch_embed.proj.weight"] = ("vision.patch_w", False)
+        for i in range(self.vision_depth):
+            b = f"{v}.blocks.{i}"
+            d = "vision.blocks"
+            m[f"{b}.norm1.weight"] = (f"{d}.ln1_w.{i}", False)
+            m[f"{b}.attn.qkv.weight"] = (f"{d}.qkv_w.{i}", True)
+            m[f"{b}.attn.qkv.bias"] = (f"{d}.qkv_b.{i}", False)
+            m[f"{b}.attn.proj.weight"] = (f"{d}.proj_w.{i}", True)
+            m[f"{b}.attn.proj.bias"] = (f"{d}.proj_b.{i}", False)
+            m[f"{b}.norm2.weight"] = (f"{d}.ln2_w.{i}", False)
+            m[f"{b}.mlp.gate_proj.weight"] = (f"{d}.gate_w.{i}", True)
+            m[f"{b}.mlp.gate_proj.bias"] = (f"{d}.gate_b.{i}", False)
+            m[f"{b}.mlp.up_proj.weight"] = (f"{d}.up_w.{i}", True)
+            m[f"{b}.mlp.up_proj.bias"] = (f"{d}.up_b.{i}", False)
+            m[f"{b}.mlp.down_proj.weight"] = (f"{d}.down_w.{i}", True)
+            m[f"{b}.mlp.down_proj.bias"] = (f"{d}.down_b.{i}", False)
+        m[f"{v}.merger.ln_q.weight"] = ("vision.merger_ln_w", False)
+        m[f"{v}.merger.mlp.0.weight"] = ("vision.merger_fc1_w", True)
+        m[f"{v}.merger.mlp.0.bias"] = ("vision.merger_fc1_b", False)
+        m[f"{v}.merger.mlp.2.weight"] = ("vision.merger_fc2_w", True)
+        m[f"{v}.merger.mlp.2.bias"] = ("vision.merger_fc2_b", False)
+        for k in list(m):
+            if k.startswith("model.visual."):
+                m["visual." + k[len("model.visual."):]] = m[k]
+        return m
+
+    # ------------------------------------------------------------------
+    # Vision tower
+    # ------------------------------------------------------------------
+
+    def encode_images(self, params: dict, images: jnp.ndarray) -> jnp.ndarray:
+        vp = params["vision"]
+        patches = self._patchify(images)
+        b, n, _ = patches.shape
+        x = patches.astype(self.dtype) @ vp["patch_w"]  # [B, N, Dv]
+        cos, sin = self._vision_rope
+        if self._win_perm is not None:
+            # Window-major order once; rope tables follow.
+            x = x[:, self._win_perm]
+            cos = cos[self._win_perm]
+            sin = sin[self._win_perm]
+        hd, H = self.vision_head_dim, self.vision_heads
+
+        def attention(h, lp, windowed: bool):
+            qkv = h @ lp["qkv_w"] + lp["qkv_b"]
+            q, k, v = jnp.split(qkv, 3, axis=-1)
+            q = q.reshape(b, n, H, hd).astype(jnp.float32)
+            k = k.reshape(b, n, H, hd).astype(jnp.float32)
+            v = v.reshape(b, n, H, hd).astype(jnp.float32)
+            q = q * cos[None, :, None, :] + _rotate_half(q) * sin[None, :, None, :]
+            k = k * cos[None, :, None, :] + _rotate_half(k) * sin[None, :, None, :]
+            if windowed:
+                w, wl = self.n_windows, self.win_patches
+                q = q.reshape(b, w, wl, H, hd)
+                k = k.reshape(b, w, wl, H, hd)
+                v = v.reshape(b, w, wl, H, hd)
+                scores = jnp.einsum("bwqhd,bwkhd->bwhqk", q, k) / math.sqrt(hd)
+                probs = jax.nn.softmax(scores, axis=-1)
+                attn = jnp.einsum("bwhqk,bwkhd->bwqhd", probs, v)
+            else:
+                scores = jnp.einsum("bqhd,bkhd->bhqk", q, k) / math.sqrt(hd)
+                probs = jax.nn.softmax(scores, axis=-1)
+                attn = jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+            return attn.reshape(b, n, self.vision_dim).astype(self.dtype)
+
+        # fullatt_block_indexes is a static python set -> two traced
+        # variants inside one unrolled loop (depth is small for ViTs).
+        blocks = jax.tree_util.tree_map(lambda a: a, vp["blocks"])
+        for i in range(self.vision_depth):
+            lp = {k: v[i] for k, v in blocks.items()}
+            h = _rms(x, lp["ln1_w"])
+            attn = attention(h, lp, windowed=i not in self.fullatt_blocks)
+            x = x + (attn @ lp["proj_w"] + lp["proj_b"])
+            h2 = _rms(x, lp["ln2_w"])
+            gate = h2 @ lp["gate_w"] + lp["gate_b"]
+            up = h2 @ lp["up_w"] + lp["up_b"]
+            act = (
+                jax.nn.silu(gate.astype(jnp.float32)).astype(self.dtype) * up
+            )
+            x = x + (act @ lp["down_w"] + lp["down_b"])
+
+        if self._win_inv is not None:
+            x = x[:, self._win_inv]  # back to merge-major for the merger
+        x = _rms(x, vp["merger_ln_w"])
+        mh = self.vision_dim * self.merge * self.merge
+        x = x.reshape(b, self.tokens_per_image, mh)
+        x = x @ vp["merger_fc1_w"] + vp["merger_fc1_b"]
+        x = jax.nn.gelu(x.astype(jnp.float32), approximate=False).astype(
+            self.dtype
+        )
+        return x @ vp["merger_fc2_w"] + vp["merger_fc2_b"]

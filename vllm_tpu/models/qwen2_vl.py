@@ -143,7 +143,11 @@ class Qwen2VLForConditionalGeneration:
         self.vision_depth = vc.depth
         self.vision_heads = vc.num_heads
         self.vision_head_dim = self.vision_dim // vc.num_heads
-        self.vision_mlp = int(self.vision_dim * vc.mlp_ratio)
+        self.vision_mlp = (
+            int(vc.intermediate_size)
+            if getattr(vc, "intermediate_size", None)
+            else int(self.vision_dim * vc.mlp_ratio)
+        )
         self.vision_act = getattr(vc, "hidden_act", "quick_gelu")
         self.patch_size = vc.patch_size
         self.temporal_patch_size = getattr(vc, "temporal_patch_size", 2)

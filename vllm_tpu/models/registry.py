@@ -24,7 +24,7 @@ _REGISTRY: dict[str, tuple[str, str]] = {
     "Qwen2MoeForCausalLM": ("vllm_tpu.models.qwen3_moe", "Qwen2MoeForCausalLM"),
     "Gemma2ForCausalLM": ("vllm_tpu.models.gemma", "Gemma2ForCausalLM"),
     "Gemma3ForCausalLM": ("vllm_tpu.models.gemma", "Gemma3ForCausalLM"),
-    "Gemma3ForConditionalGeneration": ("vllm_tpu.models.gemma", "Gemma3ForCausalLM"),
+    "Gemma3ForConditionalGeneration": ("vllm_tpu.models.gemma", "Gemma3TextOnlyFromVLM"),
     "MixtralForCausalLM": ("vllm_tpu.models.mixtral", "MixtralForCausalLM"),
     "DeepseekV2ForCausalLM": ("vllm_tpu.models.deepseek", "DeepseekV2ForCausalLM"),
     "DeepseekV3ForCausalLM": ("vllm_tpu.models.deepseek", "DeepseekV3ForCausalLM"),
@@ -39,6 +39,7 @@ _REGISTRY: dict[str, tuple[str, str]] = {
     "StableLmForCausalLM": ("vllm_tpu.models.stablelm", "StableLmForCausalLM"),
     "LlavaForConditionalGeneration": ("vllm_tpu.models.llava", "LlavaForConditionalGeneration"),
     "Qwen2VLForConditionalGeneration": ("vllm_tpu.models.qwen2_vl", "Qwen2VLForConditionalGeneration"),
+    "Qwen2_5_VLForConditionalGeneration": ("vllm_tpu.models.qwen2_5_vl", "Qwen25VLForConditionalGeneration"),
     "GPT2LMHeadModel": ("vllm_tpu.models.gpt_like", "GPT2LMHeadModel"),
     "GPTBigCodeForCausalLM": ("vllm_tpu.models.gpt_like", "GPTBigCodeForCausalLM"),
     "OPTForCausalLM": ("vllm_tpu.models.gpt_like", "OPTForCausalLM"),
