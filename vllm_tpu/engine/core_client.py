@@ -87,6 +87,9 @@ class InprocClient:
     def update_weights(self, path: str) -> bool:
         return self.engine_core.update_weights(path)
 
+    def receive_weights(self, port: int, timeout: float = 300.0) -> int:
+        return self.engine_core.receive_weights(port, timeout)
+
     def reinitialize_distributed(self, new_tp: int) -> bool:
         return self.engine_core.reinitialize_distributed(new_tp)
 
@@ -243,6 +246,12 @@ class _ZMQClientBase:
 
     def update_weights(self, path: str) -> bool:
         return self._utility("update_weights", path)
+
+    def receive_weights(self, port: int, timeout: float = 300.0) -> int:
+        return self._utility(
+            "receive_weights", port, timeout,
+            timeout_ms=int(timeout * 1000) + 30_000,
+        )
 
     def reinitialize_distributed(self, new_tp: int) -> bool:
         # Weight resharding + runner rebuild + bucket recompiles.

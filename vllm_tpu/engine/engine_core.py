@@ -327,6 +327,18 @@ class EngineCore:
         self.executor.collective_rpc("update_weights", path)
         return True
 
+    def receive_weights(self, port: int, timeout: float = 300.0) -> int:
+        """Disk-free RL weight push: listen on ``port`` for one streamed
+        transfer and apply it in place (reference:
+        ``distributed/weight_transfer/`` collective push)."""
+        assert not self.scheduler.has_unfinished_requests(), (
+            "cannot swap weights with unfinished requests"
+        )
+        while self._inflight:
+            self.step()
+        [n] = self.executor.collective_rpc("receive_weights", port, timeout)
+        return n
+
     def add_lora(self, name: str, path: str) -> bool:
         ok = self.executor.collective_rpc("add_lora", name, path)[0]
         if ok:

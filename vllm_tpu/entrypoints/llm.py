@@ -75,6 +75,12 @@ class LLM:
     def update_weights(self, path: str) -> bool:
         return self.llm_engine.engine_core.update_weights(path)
 
+    def receive_weight_push(self, port: int, timeout: float = 300.0) -> int:
+        """Block until a trainer pushes weights to ``port`` (disk-free RL
+        update; see kv_connector/weight_transfer.py). Returns the number
+        of leaves applied."""
+        return self.llm_engine.engine_core.receive_weights(port, timeout)
+
     def reinitialize_distributed(self, new_tp: int) -> bool:
         """Elastic EP: resize the tp/ep world at runtime (reference:
         ``vllm/distributed/elastic_ep/``). In-flight requests are

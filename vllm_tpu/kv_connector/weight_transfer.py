@@ -1,0 +1,152 @@
+"""Streaming (disk-free) weight transfer for RL rollouts.
+
+Reference analog: ``vllm/distributed/weight_transfer/nccl_engine.py`` —
+the trainer pushes updated weights straight into the serving engine
+without touching storage. TPU-native shape: there is no NCCL; the
+engine's host process opens a TCP listener, the trainer streams
+length-prefixed ``(leaf_path, dtype, shape, bytes)`` frames, and each
+leaf is ``device_put`` with the RESIDENT leaf's sharding as it arrives
+(host->device upload overlaps the network receive; GSPMD resharding is
+the device-side transfer the NCCL broadcast performs on GPU).
+
+Leaf paths are the dotted flatten-with-path names of the runner's param
+tree (dict keys / dataclass fields, e.g. ``layers.wq`` or
+``layers.wq.q`` for quantized leaves) — the same tree the trainer gets
+from :func:`leaf_paths` on its own copy. Mismatched names, shapes, or
+dtypes fail loudly; partial pushes (e.g. only the trainable subset)
+are allowed.
+
+Wire format (one TCP connection per push):
+    [8-byte magic b"VLTWT001"]
+    repeat: [4-byte LE header length][json header][raw leaf bytes]
+        header = {"path", "dtype", "shape"}
+    [4-byte zero] = end -> receiver replies b"OK" (or b"ER" + message)
+"""
+
+from __future__ import annotations
+
+import json
+import socket
+import struct
+from typing import Any, Iterable
+
+import numpy as np
+
+MAGIC = b"VLTWT001"
+
+
+def leaf_paths(tree: Any) -> dict[str, Any]:
+    """Dotted-path -> leaf mapping (the wire naming convention)."""
+    import jax
+
+    flat, _ = jax.tree_util.tree_flatten_with_path(tree)
+    out = {}
+    for path, leaf in flat:
+        parts = []
+        for p in path:
+            if hasattr(p, "key"):
+                parts.append(str(p.key))
+            elif hasattr(p, "name"):
+                parts.append(str(p.name))
+            else:
+                parts.append(str(getattr(p, "idx", p)))
+        out[".".join(parts)] = leaf
+    return out
+
+
+def _recv_exact(conn: socket.socket, n: int) -> bytes:
+    buf = bytearray()
+    while len(buf) < n:
+        chunk = conn.recv(min(1 << 20, n - len(buf)))
+        if not chunk:
+            raise ConnectionError("weight push truncated")
+        buf.extend(chunk)
+    return bytes(buf)
+
+
+def receive_weights(
+    apply_leaf,
+    port: int = 0,
+    host: str = "0.0.0.0",
+    timeout: float = 300.0,
+    ready_cb=None,
+) -> int:
+    """Listen for ONE push; call ``apply_leaf(path, np_array)`` per leaf.
+
+    Returns the number of leaves applied. ``ready_cb(port)`` fires once
+    the listener is bound (the engine returns the ephemeral port to the
+    caller through it)."""
+    srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+    srv.bind((host, port))
+    srv.listen(1)
+    srv.settimeout(timeout)
+    if ready_cb is not None:
+        ready_cb(srv.getsockname()[1])
+    try:
+        conn, _ = srv.accept()
+    finally:
+        srv.close()
+    conn.settimeout(timeout)
+    n_applied = 0
+    try:
+        if _recv_exact(conn, len(MAGIC)) != MAGIC:
+            conn.sendall(b"ER" + b"bad magic")
+            raise ValueError("weight push: bad magic")
+        while True:
+            (hlen,) = struct.unpack("<I", _recv_exact(conn, 4))
+            if hlen == 0:
+                break
+            header = json.loads(_recv_exact(conn, hlen))
+            dtype = np.dtype(header["dtype"])
+            shape = tuple(header["shape"])
+            nbytes = int(dtype.itemsize * np.prod(shape, dtype=np.int64))
+            raw = _recv_exact(conn, nbytes)
+            arr = np.frombuffer(raw, dtype=dtype).reshape(shape)
+            try:
+                apply_leaf(header["path"], arr)
+            except Exception as e:
+                conn.sendall(b"ER" + str(e)[:500].encode())
+                raise
+            n_applied += 1
+        conn.sendall(b"OK")
+    finally:
+        conn.close()
+    return n_applied
+
+
+def push_weights(
+    addr: tuple[str, int],
+    leaves: Iterable[tuple[str, np.ndarray]],
+    timeout: float = 300.0,
+) -> None:
+    """Trainer side: stream ``(path, array)`` pairs to a listening
+    engine. ``ml_dtypes`` dtypes (bfloat16, fp8) ride their numpy dtype
+    names."""
+    conn = socket.create_connection(addr, timeout=timeout)
+    conn.settimeout(timeout)
+    try:
+        conn.sendall(MAGIC)
+        for path, arr in leaves:
+            arr = np.ascontiguousarray(arr)
+            header = json.dumps({
+                "path": path,
+                "dtype": arr.dtype.name,
+                "shape": list(arr.shape),
+            }).encode()
+            conn.sendall(struct.pack("<I", len(header)))
+            conn.sendall(header)
+            conn.sendall(arr.tobytes())
+        conn.sendall(struct.pack("<I", 0))
+        resp = _recv_exact(conn, 2)
+        if resp != b"OK":
+            tail = b""
+            try:
+                tail = conn.recv(500)
+            except OSError:
+                pass
+            raise RuntimeError(
+                f"weight push rejected: {(resp + tail).decode(errors='replace')}"
+            )
+    finally:
+        conn.close()

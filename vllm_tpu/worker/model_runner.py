@@ -2158,6 +2158,53 @@ class ModelRunner:
             host_tree, shardings,
         )
 
+    def receive_weights_push(self, port: int, timeout: float = 300.0) -> int:
+        """Disk-free RL weight update: accept ONE streamed push on
+        ``port`` and apply each leaf in place with the resident leaf's
+        sharding (reference: weight_transfer/nccl_engine.py semantics;
+        see kv_connector/weight_transfer.py for the wire contract)."""
+        import dataclasses
+
+        from vllm_tpu.kv_connector.weight_transfer import (
+            leaf_paths,
+            receive_weights,
+        )
+
+        resident = leaf_paths(self.params)
+
+        def set_by_path(node, parts, leaf):
+            k = parts[0]
+            if len(parts) == 1:
+                if isinstance(node, dict):
+                    node[k] = leaf
+                    return node
+                return dataclasses.replace(node, **{k: leaf})
+            child = node[k] if isinstance(node, dict) else getattr(node, k)
+            new_child = set_by_path(child, parts[1:], leaf)
+            if isinstance(node, dict):
+                node[k] = new_child
+                return node
+            return dataclasses.replace(node, **{k: new_child})
+
+        def apply_leaf(path: str, arr) -> None:
+            leaf = resident.get(path)
+            if leaf is None:
+                raise KeyError(
+                    f"unknown param leaf {path!r} (trainer/serving trees "
+                    "out of sync)"
+                )
+            if tuple(leaf.shape) != tuple(arr.shape):
+                raise ValueError(
+                    f"{path}: shape {tuple(arr.shape)} != resident "
+                    f"{tuple(leaf.shape)}"
+                )
+            new_leaf = jnp.asarray(arr).astype(leaf.dtype)
+            if getattr(leaf, "sharding", None) is not None:
+                new_leaf = jax.device_put(new_leaf, leaf.sharding)
+            set_by_path(self.params, path.split("."), new_leaf)
+
+        return receive_weights(apply_leaf, port=port, timeout=timeout)
+
     def update_weights(self, path: str) -> None:
         """In-place weight swap for RL rollouts (reference:
         ``gpu_worker.py update_weights :978``). Loads a new checkpoint with

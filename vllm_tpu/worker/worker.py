@@ -700,6 +700,10 @@ class Worker:
         assert self.runner is not None
         self.runner.update_weights(path)
 
+    def receive_weights(self, port: int, timeout: float = 300.0) -> int:
+        assert self.runner is not None
+        return self.runner.receive_weights_push(port, timeout)
+
     def save_sharded_state(self, path: str) -> None:
         """Dump the ASSEMBLED param tree for fast reload (reference:
         ``gpu_worker.py:939 save_sharded_state`` + sharded_state_loader).
