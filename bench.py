@@ -270,8 +270,10 @@ def main() -> None:
         # costs far less than half the throughput while halving the KV
         # footprint; per-row-int8 embedding + int8 lm_head shave the
         # 2.1 GiB bf16 table/head, and fp8 KV halves the cache. Resident
-        # totals: int8 ~7.7 GiB, int4 ~5.3 GiB.
-        n_req = 64
+        # totals: int8 ~7.7 GiB, int4 ~5.3 GiB. The batch is env-sweepable
+        # (weight-read cost amortizes over requests; bigger batches win
+        # when the chip's free memory allows the KV).
+        n_req = int(os.environ.get("VLLM_TPU_BENCH_NREQ", 64))
         prompts = prompts[:n_req]
         extra_kw = dict(
             quantize_embedding_layers=True, kv_cache_dtype="fp8"
@@ -281,6 +283,17 @@ def main() -> None:
         max_position_embeddings=4096, tie_word_embeddings=False, **shape
     )
     cfg.architectures = ["LlamaForCausalLM"]
+    # KV page size: larger pages mean fewer per-page DMA issues in the
+    # attention kernel's per-seq loop (the decode step's scalar-core
+    # bottleneck candidate); sweepable via env.
+    block_size = int(os.environ.get("VLLM_TPU_BENCH_BLOCK_SIZE", 16))
+    blocks_16 = (
+        None if shape["hidden_size"] < 1024
+        else (
+            704 * max(1, n_req) // 64
+            if shape["hidden_size"] == 4096 else 1536
+        )
+    )
     llm = LLM(
         model="dummy-llama",
         hf_config=cfg,
@@ -289,11 +302,12 @@ def main() -> None:
         max_model_len=2048,
         max_num_batched_tokens=512,
         max_num_seqs=min(n_req, 128),
+        block_size=block_size,
         # Explicit KV budget: the workload is known (n_req x 160 tokens
         # -> 10 blocks/req) and headroom is scarce next to 8B weights.
         num_gpu_blocks_override=(
-            None if shape["hidden_size"] < 1024
-            else (704 if shape["hidden_size"] == 4096 else 1536)
+            None if blocks_16 is None
+            else max(n_req * 4, blocks_16 * 16 // block_size)
         ),
         **extra_kw,
         # In-jit multi-step decode amortizes per-launch host/tunnel

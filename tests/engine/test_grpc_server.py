@@ -184,3 +184,59 @@ def test_typed_rejects_empty_prompt(grpc_addr):
         with pytest.raises(grpc.RpcError) as exc:
             list(stub.Generate(llm_pb2.GenerateRequest()))
         assert exc.value.code() == grpc.StatusCode.INVALID_ARGUMENT
+
+
+def test_proto_logprobs_zero_expressible():
+    """logprobs=0 (sampled-token logprob only) survives the typed proto
+    (ADVICE r4 #2: presence-gated, not truthiness-gated)."""
+    from vllm_tpu.entrypoints.grpc_server import _params_from_proto
+    from vllm_tpu.entrypoints.proto import llm_pb2
+
+    sp = llm_pb2.SamplingParamsProto()
+    sp.logprobs = 0
+    sp.min_tokens = 0
+    params = _params_from_proto(sp)
+    assert params.logprobs == 0  # explicit 0, not None
+    unset = _params_from_proto(llm_pb2.SamplingParamsProto())
+    assert unset.logprobs is None
+
+
+def test_json_on_typed_service_gets_migration_hint():
+    """Legacy JSON clients calling /vllmtpu.LLM get a descriptive
+    FAILED_PRECONDITION pointing at /vllmtpu.LLMJson (ADVICE r4 #4)."""
+    import json as _json
+
+    import grpc
+    import pytest
+
+    from vllm_tpu.entrypoints.proto.llm_pb2_grpc import (
+        _guard_unary,
+        _lenient,
+        JsonPayloadOnTypedService,
+    )
+    from vllm_tpu.entrypoints.proto import llm_pb2
+
+    de = _lenient(llm_pb2.GenerateRequest)
+    req = de(_json.dumps({"prompt": "hi"}).encode())
+    assert isinstance(req, JsonPayloadOnTypedService)
+    # Valid protobuf still parses.
+    msg = llm_pb2.GenerateRequest(prompt="hi")
+    assert de(msg.SerializeToString()).prompt == "hi"
+
+    class Ctx:
+        def __init__(self):
+            self.code = self.details = None
+
+        async def abort(self, code, details):
+            self.code, self.details = code, details
+            raise grpc.RpcError(details)
+
+    async def handler(request, context):
+        return "should-not-run"
+
+    import asyncio
+
+    ctx = Ctx()
+    with pytest.raises(grpc.RpcError, match="LLMJson"):
+        asyncio.run(_guard_unary(handler)(JsonPayloadOnTypedService(), ctx))
+    assert ctx.code == grpc.StatusCode.FAILED_PRECONDITION

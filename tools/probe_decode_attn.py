@@ -48,13 +48,13 @@ def chain(attn_fn):
     return f
 
 
-def rpa_fn(q, kv, li):
+def rpa_fn(q, kv, li, **kw):
     from vllm_tpu.ops.rpa_kernel import ragged_paged_attention
 
     return ragged_paged_attention(
         q, kv, jnp.asarray(li, jnp.int32).reshape(1), kv_lens,
         page_tables, cu, num_seqs, sm_scale=scale,
-        k_scale=0.05, v_scale=0.05,
+        k_scale=0.05, v_scale=0.05, **kw,
     )
 
 
@@ -85,15 +85,22 @@ def bench(name, f):
 
 
 def main():
+    import functools
     print("device:", jax.devices()[0])
-    ref, t_rpa = bench("rpa (general)", chain(rpa_fn))
-    for g, cb in [(8, 4), (8, 10), (16, 10), (32, 10), (64, 10), (16, 4)]:
-        try:
-            got, t = bench(f"grouped g={g} cb={cb}", chain(grouped_fn_args(g, cb)))
-            err = float(jnp.max(jnp.abs(got - ref)) / jnp.max(jnp.abs(ref)))
-            print(f"    vs rpa: {t_rpa / t:5.2f}x   rel err {err:.4f}")
-        except Exception as e:  # noqa: BLE001
-            print(f"    grouped g={g} cb={cb} failed: {type(e).__name__}: {e}")
+    ref, t_rpa = bench("rpa (tuned)", chain(rpa_fn))
+    for nq in (4, 8, 16, 32, 64):
+        for pg in (4, 8, 16):
+            try:
+                fn = functools.partial(
+                    rpa_fn, num_queries_per_block=nq,
+                    num_kv_pages_per_block=pg,
+                )
+                got, t = bench(f"rpa nq={nq} pg={pg}", chain(fn))
+                err = float(jnp.max(jnp.abs(got - ref)) / jnp.max(jnp.abs(ref)))
+                print(f"    vs tuned: {t_rpa / t:5.2f}x   rel err {err:.4f}")
+            except Exception as e:  # noqa: BLE001
+                print(f"    nq={nq} pg={pg} failed: {type(e).__name__}: "
+                      f"{str(e)[:120]}")
 
 
 if __name__ == "__main__":

@@ -290,6 +290,10 @@ class EngineCore:
         assert not getattr(self, "_asleep", False), (
             "cannot resize a sleeping engine; wake_up first"
         )
+        # Validate constraints BEFORE the destructive drain/preempt/reset:
+        # a rejected resize must not pay preemption or lose the prefix
+        # cache (ADVICE r4 #1).
+        self.executor.collective_rpc("validate_parallel_resize", new_tp)
         # Drain in-flight handles WITHOUT scheduling new work (step()
         # would keep refilling the pipeline while requests are active
         # and never converge). Outputs produced here are buffered and

@@ -62,10 +62,11 @@ def _params_from_proto(sp) -> SamplingParams:
         kw["stop"] = list(sp.stop)
     if sp.ignore_eos:
         kw["ignore_eos"] = True
-    if sp.min_tokens:
-        kw["min_tokens"] = sp.min_tokens
-    if sp.logprobs:
-        kw["logprobs"] = sp.logprobs
+    # Presence-gated like the floats above: logprobs=0 (sampled-token
+    # logprob only) is a meaningful request (ADVICE r4 #2).
+    for field in ("min_tokens", "logprobs"):
+        if sp.HasField(field):
+            kw[field] = getattr(sp, field)
     return SamplingParams(**kw)
 
 

@@ -9,6 +9,7 @@ from __future__ import annotations
 
 import io
 import json
+import struct
 import time
 import uuid
 from typing import Any
@@ -335,31 +336,60 @@ async def handle_score(request: web.Request) -> web.Response:
 # /v1/audio/transcriptions + /v1/audio/translations
 # ----------------------------------------------------------------------
 
-def _decode_wav(raw: bytes) -> tuple[np.ndarray, int]:
-    """WAV bytes -> (mono float32 [-1, 1], sample_rate). PCM 16/32-bit
-    and 32-bit float supported via the stdlib wave reader."""
-    import wave
+def _wav_chunks(raw: bytes):
+    """Iterate (chunk_id, payload) over a RIFF/WAVE byte string."""
+    if raw[:4] != b"RIFF" or raw[8:12] != b"WAVE":
+        raise ValueError("not a RIFF/WAVE file")
+    off = 12
+    while off + 8 <= len(raw):
+        cid = raw[off:off + 4]
+        (size,) = struct.unpack_from("<I", raw, off + 4)
+        yield cid, raw[off + 8: off + 8 + size]
+        off += 8 + size + (size & 1)  # chunks are word-aligned
 
-    with wave.open(io.BytesIO(raw), "rb") as w:
-        rate = w.getframerate()
-        n_ch = w.getnchannels()
-        width = w.getsampwidth()
-        frames = w.readframes(w.getnframes())
-    if width == 2:
-        audio = np.frombuffer(frames, np.int16).astype(np.float32) / 32768.0
-    elif width == 4:
-        # Could be int32 or float32; WAVE_FORMAT float files are rare
-        # through this path — treat as int32 PCM.
-        audio = (
-            np.frombuffer(frames, np.int32).astype(np.float32) / 2147483648.0
-        )
-    elif width == 1:
-        audio = (
-            np.frombuffer(frames, np.uint8).astype(np.float32) - 128.0
-        ) / 128.0
+
+def _decode_wav(raw: bytes) -> tuple[np.ndarray, int]:
+    """WAV bytes -> (mono float32 [-1, 1], sample_rate). PCM 8/16/32-bit
+    and IEEE float32/64 supported; the fmt chunk's format code is
+    sniffed directly (stdlib wave mislabels float and extensible files —
+    ADVICE r4 #3)."""
+    fmt = data = None
+    for cid, payload in _wav_chunks(raw):
+        if cid == b"fmt " and fmt is None:
+            fmt = payload
+        elif cid == b"data" and data is None:
+            data = payload
+    if fmt is None or data is None or len(fmt) < 16:
+        raise ValueError("missing fmt/data chunk")
+    code, n_ch, rate, _br, _ba, bits = struct.unpack_from("<HHIIHH", fmt, 0)
+    if code == 0xFFFE and len(fmt) >= 26:
+        # WAVE_FORMAT_EXTENSIBLE: the real code leads the SubFormat GUID.
+        (code,) = struct.unpack_from("<H", fmt, 24)
+    if code == 3:  # IEEE float
+        if bits == 32:
+            audio = np.frombuffer(data, np.float32).astype(np.float32)
+        elif bits == 64:
+            audio = np.frombuffer(data, np.float64).astype(np.float32)
+        else:
+            raise ValueError(f"unsupported float WAV bit depth {bits}")
+    elif code == 1:  # integer PCM
+        if bits == 16:
+            audio = np.frombuffer(data, np.int16).astype(np.float32) / 32768.0
+        elif bits == 32:
+            audio = (
+                np.frombuffer(data, np.int32).astype(np.float32)
+                / 2147483648.0
+            )
+        elif bits == 8:
+            audio = (
+                np.frombuffer(data, np.uint8).astype(np.float32) - 128.0
+            ) / 128.0
+        else:
+            raise ValueError(f"unsupported PCM WAV bit depth {bits}")
     else:
-        raise ValueError(f"unsupported WAV sample width {width}")
+        raise ValueError(f"unsupported WAV format code {code}")
     if n_ch > 1:
+        audio = audio[: len(audio) - len(audio) % n_ch]
         audio = audio.reshape(-1, n_ch).mean(axis=1)
     return audio, rate
 

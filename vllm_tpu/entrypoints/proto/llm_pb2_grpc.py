@@ -46,21 +46,68 @@ class LLMServicer:
         raise NotImplementedError
 
 
+class JsonPayloadOnTypedService:
+    """Sentinel request: a JSON body arrived on the typed protobuf
+    service — the JSON surface moved to ``/vllmtpu.LLMJson`` (legacy
+    clients get a descriptive FAILED_PRECONDITION instead of a raw
+    deserialization error)."""
+
+
+_JSON_MOVED_MSG = (
+    "this method speaks protobuf; the JSON-over-gRPC surface moved to "
+    "/vllmtpu.LLMJson/<Method> — update your client's method path"
+)
+
+
+def _lenient(msg_cls):
+    def deserialize(raw: bytes):
+        try:
+            msg = msg_cls()
+            msg.MergeFromString(raw)
+            return msg
+        except Exception:
+            if raw.lstrip()[:1] in (b"{", b"["):
+                return JsonPayloadOnTypedService()
+            raise
+    return deserialize
+
+
+def _guard_unary(fn):
+    async def wrapped(request, context):
+        if isinstance(request, JsonPayloadOnTypedService):
+            await context.abort(
+                grpc.StatusCode.FAILED_PRECONDITION, _JSON_MOVED_MSG
+            )
+        return await fn(request, context)
+    return wrapped
+
+
+def _guard_stream(fn):
+    async def wrapped(request, context):
+        if isinstance(request, JsonPayloadOnTypedService):
+            await context.abort(
+                grpc.StatusCode.FAILED_PRECONDITION, _JSON_MOVED_MSG
+            )
+        async for item in fn(request, context):
+            yield item
+    return wrapped
+
+
 def add_LLMServicer_to_server(servicer: LLMServicer, server) -> None:
     handlers = {
         "Generate": grpc.unary_stream_rpc_method_handler(
-            servicer.Generate,
-            request_deserializer=llm_pb2.GenerateRequest.FromString,
+            _guard_stream(servicer.Generate),
+            request_deserializer=_lenient(llm_pb2.GenerateRequest),
             response_serializer=llm_pb2.GenerateResponse.SerializeToString,
         ),
         "Health": grpc.unary_unary_rpc_method_handler(
-            servicer.Health,
-            request_deserializer=llm_pb2.HealthRequest.FromString,
+            _guard_unary(servicer.Health),
+            request_deserializer=_lenient(llm_pb2.HealthRequest),
             response_serializer=llm_pb2.HealthResponse.SerializeToString,
         ),
         "Models": grpc.unary_unary_rpc_method_handler(
-            servicer.Models,
-            request_deserializer=llm_pb2.ModelsRequest.FromString,
+            _guard_unary(servicer.Models),
+            request_deserializer=_lenient(llm_pb2.ModelsRequest),
             response_serializer=llm_pb2.ModelsResponse.SerializeToString,
         ),
     }

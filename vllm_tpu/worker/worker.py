@@ -751,31 +751,14 @@ class Worker:
                 if os.path.exists(src):
                     shutil.copy2(src, os.path.join(path, name))
 
-    def reinitialize_parallel(self, new_tp: int) -> int:
-        """Elastic EP: resize the expert/tensor-parallel world at runtime.
-
-        Reference analog: ``vllm/distributed/elastic_ep/elastic_state.py``
-        and ``EngineCore.reinitialize_distributed`` (``core.py:1865``) —
-        there, NCCL groups are torn down and rebuilt and expert weights are
-        shuffled point-to-point. The TPU formulation: parallelism is a mesh
-        plus sharding annotations, so scaling the EP world is (1) build a
-        mesh over the new device set, (2) ``device_put`` the params onto it
-        (XLA moves the shards over ICI; done leaf-by-leaf with eager
-        deletion so peak overhead is one leaf, not a second full copy),
-        (3) rebuild the runner so every jitted executable re-traces against
-        the new mesh. KV-cache content is discarded — the engine preempts
-        running requests first, so they recompute from their token ids
-        (the reference also drops KV across a reconfigure).
-
-        Returns the KV block count (unchanged — the scheduler's block pool
-        stays valid; only the content was dropped).
-        """
-        assert self.runner is not None, "initialize() before resizing"
+    def validate_parallel_resize(self, new_tp: int) -> bool:
+        """Side-effect-free constraint check for an elastic resize — the
+        engine calls this BEFORE the destructive drain/preempt/cache-
+        reset so a rejected resize (bad divisibility, too few devices)
+        costs nothing (ADVICE r4 #1)."""
         pc = self.config.parallel_config
-        old_tp = pc.tensor_parallel_size
-        num_blocks = self.config.cache_config.num_gpu_blocks
-        if new_tp == old_tp:
-            return num_blocks
+        if new_tp == pc.tensor_parallel_size:
+            return True
         if new_tp < 1:
             raise ValueError(f"tensor_parallel_size must be >= 1, got {new_tp}")
         if (
@@ -805,6 +788,34 @@ class Worker:
                 f"num_kv_heads ({kvh}) not divisible by tp size {new_tp} "
                 "(KV-cache head sharding)"
             )
+        return True
+
+    def reinitialize_parallel(self, new_tp: int) -> int:
+        """Elastic EP: resize the expert/tensor-parallel world at runtime.
+
+        Reference analog: ``vllm/distributed/elastic_ep/elastic_state.py``
+        and ``EngineCore.reinitialize_distributed`` (``core.py:1865``) —
+        there, NCCL groups are torn down and rebuilt and expert weights are
+        shuffled point-to-point. The TPU formulation: parallelism is a mesh
+        plus sharding annotations, so scaling the EP world is (1) build a
+        mesh over the new device set, (2) ``device_put`` the params onto it
+        (XLA moves the shards over ICI; done leaf-by-leaf with eager
+        deletion so peak overhead is one leaf, not a second full copy),
+        (3) rebuild the runner so every jitted executable re-traces against
+        the new mesh. KV-cache content is discarded — the engine preempts
+        running requests first, so they recompute from their token ids
+        (the reference also drops KV across a reconfigure).
+
+        Returns the KV block count (unchanged — the scheduler's block pool
+        stays valid; only the content was dropped).
+        """
+        assert self.runner is not None, "initialize() before resizing"
+        pc = self.config.parallel_config
+        old_tp = pc.tensor_parallel_size
+        num_blocks = self.config.cache_config.num_gpu_blocks
+        if new_tp == old_tp:
+            return num_blocks
+        self.validate_parallel_resize(new_tp)
         if self.runner._host_params is not None:
             raise RuntimeError("cannot resize a sleeping engine; wake_up first")
 
