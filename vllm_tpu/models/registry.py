@@ -66,6 +66,7 @@ _REGISTRY: dict[str, tuple[str, str]] = {
     "OlmoeForCausalLM": ("vllm_tpu.models.moe_zoo", "OlmoeForCausalLM"),
     "GraniteMoeForCausalLM": ("vllm_tpu.models.moe_zoo", "GraniteMoeForCausalLM"),
     "DbrxForCausalLM": ("vllm_tpu.models.moe_zoo", "DbrxForCausalLM"),
+    "GptOssForCausalLM": ("vllm_tpu.models.gpt_oss", "GptOssForCausalLM"),
 }
 
 
