@@ -325,3 +325,59 @@ def test_protocol_structured_max_depth():
     assert so is not None and so.max_depth == 12 and so.grammar
     so = _structured_outputs({"guided_regex": "[0-9]+"})
     assert so is not None and so.max_depth is None
+
+
+# ----------------------------------------------------------------------
+# Direct-recursion linearization (exact, unbounded)
+# ----------------------------------------------------------------------
+
+def test_right_recursive_list_is_unbounded():
+    """`root ::= item | item "," root` compiles to an exact loop — a
+    30-element list matches even at max_depth=2 (the depth-bounded
+    expansion alone would truncate at 2)."""
+    g = r"""
+root ::= item | item "," root
+item ::= [0-9]+
+"""
+    regex = ebnf_to_regex(g, max_depth=2)
+    assert _matches(regex, ",".join(["7"] * 30))
+    assert _matches(regex, "42")
+    assert not _matches(regex, "1,,2")
+    assert not _matches(regex, "1,")
+
+
+def test_left_recursive_rule_is_unbounded():
+    """`root ::= root "+" t | t` (left recursion) linearizes to
+    t ("+" t)*."""
+    g = r"""
+root ::= root "+" t | t
+t ::= [a-z]
+"""
+    regex = ebnf_to_regex(g, max_depth=2)
+    assert _matches(regex, "+".join(["a"] * 25))
+    assert _matches(regex, "z")
+    assert not _matches(regex, "+a")
+    assert not _matches(regex, "a+")
+
+
+def test_center_recursion_keeps_depth_bound():
+    """Balanced parens (center recursion) are NOT regular: the depth
+    bound still applies (and still truncates loudly, not loosely)."""
+    g = r"""
+root ::= "(" root ")" | [0-9]
+"""
+    regex = ebnf_to_regex(g, max_depth=3)
+    assert _matches(regex, "((7))")
+    assert not _matches(regex, "((((7))))")  # beyond bound: unreachable
+
+
+def test_mixed_recursion_keeps_depth_bound():
+    """A rule that recurses on BOTH ends stays depth-bounded (a loop
+    rewrite would change the language)."""
+    g = r"""
+root ::= "a" root | root "b" | "c"
+"""
+    regex = ebnf_to_regex(g, max_depth=6)
+    assert _matches(regex, "aacbb")
+    # Bound still bites somewhere deep; exact shape depends on expansion.
+    assert not _matches(regex, "a" * 40 + "c" + "b" * 40)
