@@ -248,7 +248,7 @@ class SpeculativeConfig:
     """Speculative decoding. Reference analog: ``vllm/config/speculative.py``."""
 
     method: Literal[
-        "ngram", "eagle", "draft_model", "suffix", "medusa"
+        "ngram", "eagle", "eagle3", "draft_model", "suffix", "medusa"
     ] | None = None
     num_speculative_tokens: int = 0
     # ngram proposer window
@@ -401,7 +401,8 @@ class EngineConfig:
             sc.spec_max_accept_per_step = tree.num_levels
         if (
             self.speculative_config.enabled
-            and self.speculative_config.method in ("eagle", "draft_model")
+            and self.speculative_config.method in ("eagle", "eagle3",
+                                                   "draft_model")
         ):
             # In-jit draft chains write draft KV at speculative positions:
             # EAGLE's chain reaches pos0+k-1, a draft model's pos0+k.

@@ -201,3 +201,182 @@ class EagleDraftModel:
             jnp.concatenate([gate, up], axis=-1)
         ) @ params["wdown"]
         return x, draft_kv
+
+
+class Eagle3DraftModel(EagleDraftModel):
+    """EAGLE-3 draft head (reference: ``vllm/v1/spec_decode/eagle.py`` +
+    ``model_executor/models/llama_eagle3.py``).
+
+    Deltas from EAGLE: the draft conditions on THREE of the target's
+    intermediate hidden states (fused ``[T, 3*Dt] @ fc3 -> [T, D]``)
+    instead of the final hidden; the midlayer reads
+    ``cat(input_norm(embed), hidden_norm(h))`` (2D-wide projections,
+    separate norms, residual on ``h``); and the head is the draft's OWN
+    reduced-vocab lm_head with a ``d2t`` draft->target id offset table.
+    Chained steps feed the draft's own hidden (no re-fuse)."""
+
+    is_eagle3 = True
+
+    def __init__(self, hf_config: Any, dtype=jnp.bfloat16) -> None:
+        super().__init__(hf_config, dtype)
+        c = hf_config
+        self.target_hidden = int(
+            getattr(c, "target_hidden_size", None) or c.hidden_size
+        )
+        self.draft_vocab = int(
+            getattr(c, "draft_vocab_size", None) or c.vocab_size
+        )
+        # Which target layer OUTPUTS to capture (low/mid/high); stored on
+        # the draft config by exporters, else the reference default
+        # (inputs of layers 2, N/2, N-3 = outputs of 1, N/2-1, N-4).
+        self.aux_layers = getattr(c, "eagle_aux_layers", None)
+
+    def default_aux_layers(self, target_layers: int) -> tuple[int, int, int]:
+        if self.aux_layers:
+            return tuple(int(x) for x in self.aux_layers)[:3]
+        lo = min(1, target_layers - 1)
+        mid = max(0, target_layers // 2 - 1)
+        hi = max(0, target_layers - 4)
+        return (lo, mid, hi)
+
+    def init_dummy_params(self, rng: jax.Array, dtype=None) -> dict:
+        dtype = dtype or self.dtype
+        D, H, KH, Dh, F = (
+            self.hidden_size, self.num_heads, self.num_kv_heads,
+            self.head_dim, self.intermediate_size,
+        )
+        keys = jax.random.split(rng, 10)
+
+        def init(key, shape, fan_in):
+            return (
+                jax.random.normal(key, shape, jnp.float32) / math.sqrt(fan_in)
+            ).astype(dtype)
+
+        return {
+            "fc3": init(keys[0], (3 * self.target_hidden, D),
+                        3 * self.target_hidden),
+            "input_norm": jnp.ones((D,), dtype),
+            "hidden_norm": jnp.ones((D,), dtype),
+            "wq": init(keys[1], (2 * D, H * Dh), 2 * D),
+            "wk": init(keys[2], (2 * D, KH * Dh), 2 * D),
+            "wv": init(keys[3], (2 * D, KH * Dh), 2 * D),
+            "wo": init(keys[4], (H * Dh, D), H * Dh),
+            "post_norm": jnp.ones((D,), dtype),
+            "wgate": init(keys[5], (D, F), D),
+            "wup": init(keys[6], (D, F), D),
+            "wdown": init(keys[7], (F, D), F),
+            "final_norm": jnp.ones((D,), dtype),
+            "lm_head": init(keys[8], (D, self.draft_vocab), D),
+            "d2t": jnp.zeros((self.draft_vocab,), jnp.int32),
+        }
+
+    def load_params(self, path: str, dtype=None) -> dict:
+        """EAGLE-3 checkpoint: ``fc.weight`` [D, 3Dt], midlayer.* (2D-wide
+        projections, input/hidden norms), ``norm``, reduced ``lm_head``,
+        ``d2t`` (and optionally its own ``embed_tokens``)."""
+        import numpy as np
+        from safetensors import safe_open
+
+        from vllm_tpu.models.loader import _iter_safetensor_files
+
+        dtype = dtype or self.dtype
+        base = {
+            "fc.weight": ("fc3", True),
+            "midlayer.input_layernorm.weight": ("input_norm", False),
+            "midlayer.hidden_norm.weight": ("hidden_norm", False),
+            "midlayer.self_attn.q_proj.weight": ("wq", True),
+            "midlayer.self_attn.k_proj.weight": ("wk", True),
+            "midlayer.self_attn.v_proj.weight": ("wv", True),
+            "midlayer.self_attn.o_proj.weight": ("wo", True),
+            "midlayer.post_attention_layernorm.weight": ("post_norm", False),
+            "midlayer.mlp.gate_proj.weight": ("wgate", True),
+            "midlayer.mlp.up_proj.weight": ("wup", True),
+            "midlayer.mlp.down_proj.weight": ("wdown", True),
+            "norm.weight": ("final_norm", False),
+            "lm_head.weight": ("lm_head", True),
+            "d2t": ("d2t", False),
+            "embed_tokens.weight": ("embed_d", False),
+        }
+        name_map = dict(base)
+        for k, v in base.items():
+            name_map["model." + k] = v
+        params: dict = {}
+        for file in _iter_safetensor_files(path):
+            with safe_open(file, framework="numpy") as f:
+                for hf_name in f.keys():
+                    if hf_name not in name_map:
+                        continue
+                    dest, transpose = name_map[hf_name]
+                    arr = f.get_tensor(hf_name)
+                    if arr.dtype == np.uint16:
+                        arr = arr.view(jnp.bfloat16)
+                    if transpose:
+                        arr = arr.T
+                    params[dest] = jnp.asarray(
+                        arr, jnp.int32 if dest == "d2t" else dtype
+                    )
+        required = {"fc3", "wq", "wk", "wv", "wo", "wgate", "wup",
+                    "wdown", "lm_head"}
+        missing = required - set(params)
+        if missing:
+            raise ValueError(f"EAGLE3 checkpoint missing {sorted(missing)}")
+        for n in ("input_norm", "hidden_norm", "post_norm", "final_norm"):
+            params.setdefault(n, jnp.ones((self.hidden_size,), dtype))
+        params.setdefault(
+            "d2t", jnp.zeros((params["lm_head"].shape[1],), jnp.int32)
+        )
+        return params
+
+    def forward(
+        self,
+        params: dict,
+        embed: jnp.ndarray,  # [V, Dt] target embedding (shared)
+        draft_kv: jnp.ndarray,
+        token_ids: jnp.ndarray,  # [T]
+        hidden: jnp.ndarray,  # fuse: [T, 3*Dt] aux concat; else [T, D]
+        md: AttentionMetadata,
+        *,
+        fuse: bool = True,
+    ) -> tuple[jnp.ndarray, jnp.ndarray]:
+        t = token_ids.shape[0]
+        H, KH, Dh = self.num_heads, self.num_kv_heads, self.head_dim
+        from vllm_tpu.layers.quant import embedding_lookup
+
+        table = params.get("embed_d", embed)
+        emb = embedding_lookup(table, token_ids, self.dtype)
+        h_in = (
+            (hidden.astype(self.dtype) @ params["fc3"]) if fuse
+            else hidden.astype(self.dtype)
+        )
+        x2 = jnp.concatenate(
+            [
+                rms_norm(emb, params["input_norm"], self.rms_eps),
+                rms_norm(h_in, params["hidden_norm"], self.rms_eps),
+            ],
+            axis=-1,
+        )  # [T, 2D]
+        q = (x2 @ params["wq"]).reshape(t, H, Dh)
+        k = (x2 @ params["wk"]).reshape(t, KH, Dh)
+        v = (x2 @ params["wv"]).reshape(t, KH, Dh)
+        cos = self.rope.cos[md.positions][:, None, :]
+        sin = self.rope.sin[md.positions][:, None, :]
+        q = _apply_rotate_half(q, cos, sin, self.rope.rotary_dim)
+        k = _apply_rotate_half(k, cos, sin, self.rope.rotary_dim)
+        draft_kv = write_kv(draft_kv, jnp.int32(0), k, v, md.slot_mapping)
+        attn = paged_attention(q, draft_kv, jnp.int32(0), md, self.scale)
+        x = h_in + attn.reshape(t, H * Dh) @ params["wo"]
+        h2 = rms_norm(x, params["post_norm"], self.rms_eps)
+        gate = h2 @ params["wgate"]
+        up = h2 @ params["wup"]
+        x = x + silu_and_mul(
+            jnp.concatenate([gate, up], axis=-1)
+        ) @ params["wdown"]
+        return x, draft_kv
+
+    def draft_argmax(self, params: dict, h: jnp.ndarray) -> jnp.ndarray:
+        """Greedy draft token in TARGET-vocab ids (own head + d2t)."""
+        logits = rms_norm(
+            h, params["final_norm"], self.rms_eps
+        ) @ params["lm_head"]
+        did = jnp.argmax(logits.astype(jnp.float32), axis=-1).astype(jnp.int32)
+        return did + params["d2t"][did]

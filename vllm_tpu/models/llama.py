@@ -123,6 +123,9 @@ class LlamaForCausalLM:
     embedding_multiplier = 1.0
     residual_multiplier = 1.0
     logits_scaling = 1.0
+    # EAGLE-3: layer indices whose OUTPUT hidden states feed the draft
+    # (set by the worker; apply() then returns (hidden, kv, aux_concat)).
+    aux_hidden_layers = None
     # lax.scan over the stacked layer weights vs an unrolled Python loop.
     # Scan compiles fast and is the default; its xs layout assignment can
     # materialize a run-time copy of the WHOLE weight stack, so large
@@ -380,15 +383,34 @@ class LlamaForCausalLM:
             token_lora_slot=token_lora_slot,
             lora_scale=params.get("lora_scaling"),
         )
+        # EAGLE-3 aux capture: collect the OUTPUT hidden of three layer
+        # indices for the draft's fused conditioning (reference:
+        # aux_hidden_state_layers in vllm's llama.py).
+        aux_idx = getattr(self, "aux_hidden_layers", None)
+        if aux_idx is not None:
+            idxs = jnp.asarray(aux_idx, jnp.int32)
+            aux0 = jnp.zeros((len(aux_idx),) + x.shape, x.dtype)
+            inner_fn = layer_fn
+
+            def layer_fn(carry, inputs):  # noqa: F811 - deliberate wrap
+                xc, kv, aux = carry
+                (xc, kv), _ = inner_fn((xc, kv), inputs)
+                match = (idxs == inputs[1])[:, None, None]
+                aux = jnp.where(match, xc[None].astype(aux.dtype), aux)
+                return (xc, kv, aux), None
+
         if self.scan_layers:
             # Scan over the layer stack with the WHOLE cache in the carry:
             # the per-layer scatter + page gathers touch only live slots,
             # and the donated buffer is updated in place (per-layer xs/ys
             # would double-buffer the cache and copy a full layer per
             # iteration).
-            (x, new_kv), _ = jax.lax.scan(
+            carry0 = (
+                (x, kv_cache) if aux_idx is None else (x, kv_cache, aux0)
+            )
+            carry, _ = jax.lax.scan(
                 layer_fn,
-                (x, kv_cache),
+                carry0,
                 (params["layers"],
                  jnp.arange(self.num_layers, dtype=jnp.int32)),
             )
@@ -398,11 +420,17 @@ class LlamaForCausalLM:
             # the model, which OOMs large quantized models that otherwise
             # fit. The unrolled loop slices one layer at a time (bigger
             # HLO, slower compile; the persistent cache amortizes it).
-            carry = (x, kv_cache)
+            carry = (x, kv_cache) if aux_idx is None else (x, kv_cache, aux0)
             for i in range(self.num_layers):
                 lp = jax.tree.map(lambda a: a[i], params["layers"])
                 carry, _ = layer_fn(carry, (lp, jnp.int32(i)))
-            x, new_kv = carry
+        if aux_idx is not None:
+            x, new_kv, aux = carry
+            t = x.shape[0]
+            aux_cat = aux.transpose(1, 0, 2).reshape(t, -1)  # [T, 3D]
+            x = self._norm(x, params, "final_norm")
+            return x, new_kv, aux_cat
+        x, new_kv = carry
         x = self._norm(x, params, "final_norm")
         return x, new_kv
 

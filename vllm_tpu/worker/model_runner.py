@@ -193,7 +193,7 @@ class ModelRunner:
             # Heads ride the params tree so they flow through the jit (a
             # captured array would be folded into the executable).
             self.params = {**self.params, "medusa": self.medusa_params}
-        elif spec.enabled and spec.method in ("eagle", "draft_model"):
+        elif spec.enabled and spec.method in ("eagle", "eagle3", "draft_model"):
             assert draft_model is not None and draft_params is not None, (
                 f"{spec.method} spec decode needs a loaded draft model"
             )
@@ -201,6 +201,12 @@ class ModelRunner:
             self.draft_params = draft_params
             if spec.method == "draft_model":
                 self._in_jit_drafts = self._draft_lm_drafts
+            if spec.method == "eagle3":
+                # Target captures three intermediate hiddens for the
+                # draft's fused conditioning.
+                self.model.aux_hidden_layers = draft_model.default_aux_layers(
+                    self.model.num_layers
+                )
 
         # EPLB: logical->physical expert indirection + load accumulator.
         self._eplb = getattr(model, "enable_eplb", False)
@@ -621,8 +627,11 @@ class ModelRunner:
             params, kv_cache, token_ids, md, token_lora_slot=token_lora,
             **mm_kw,
         )
+        aux_h = None
         if self._eplb:
             hidden, kv_cache, moe_counts = out  # counts [L, E]
+        elif getattr(self.model, "aux_hidden_layers", None) is not None:
+            hidden, kv_cache, aux_h = out  # EAGLE-3 fused conditioning
         else:
             hidden, kv_cache = out
         if num_spec > 0:
@@ -684,7 +693,8 @@ class ModelRunner:
                 anchor = spec["sample_pos"][rows_r, num_out - 1]
                 emitted = out_tokens[rows_r, num_out - 1]
                 drafts, draft_kv = self._in_jit_drafts(
-                    params, draft_kv, token_ids, hidden, md, anchor,
+                    params, draft_kv, token_ids,
+                    aux_h if aux_h is not None else hidden, md, anchor,
                     emitted, draft_next, r_pad,
                 )
             elif self.medusa is not None:
@@ -824,7 +834,8 @@ class ModelRunner:
             # computed position — skipping it would leave permanent holes
             # that poison later proposals.
             drafts, draft_kv = self._in_jit_drafts(
-                params, draft_kv, token_ids, hidden, md,
+                params, draft_kv, token_ids,
+                aux_h if aux_h is not None else hidden, md,
                 md.logits_indices, sampled, draft_next, r_pad,
             )
         elif self.medusa is not None:
@@ -881,21 +892,32 @@ class ModelRunner:
         shifted = shifted.at[anchor_idx].set(anchor_tok, mode="drop")
 
         embed = params["embed"]
-        h_d, draft_kv = dm.forward(dp, embed, draft_kv, shifted, hidden, md)
-        d_tok = jnp.argmax(
-            self.model.compute_logits(params, h_d[anchor]), axis=-1
-        ).astype(jnp.int32)
+        is_e3 = getattr(dm, "is_eagle3", False)
+        if is_e3:
+            # EAGLE-3: own reduced-vocab head + d2t target-id mapping;
+            # chained steps feed the draft hidden without re-fusing.
+            def tok_of(h):
+                return dm.draft_argmax(dp, h)
+            fuse0, fusek = {"fuse": True}, {"fuse": False}
+        else:
+            def tok_of(h):
+                return jnp.argmax(
+                    self.model.compute_logits(params, h), axis=-1
+                ).astype(jnp.int32)
+            fuse0 = fusek = {}
+        h_d, draft_kv = dm.forward(
+            dp, embed, draft_kv, shifted, hidden, md, **fuse0
+        )
+        d_tok = tok_of(h_d[anchor])
         drafts = [d_tok]
         h_prev = h_d[anchor]  # [R, D]
         pos0 = md.positions[anchor]
         for k in range(1, k_spec):
             md_k = self._single_pos_metadata(md, pos0 + k, r_pad)
             h_prev, draft_kv = dm.forward(
-                dp, embed, draft_kv, d_tok, h_prev, md_k
+                dp, embed, draft_kv, d_tok, h_prev, md_k, **fusek
             )
-            d_tok = jnp.argmax(
-                self.model.compute_logits(params, h_prev), axis=-1
-            ).astype(jnp.int32)
+            d_tok = tok_of(h_prev)
             drafts.append(d_tok)
         return jnp.stack(drafts, axis=1), draft_kv
 

@@ -367,22 +367,23 @@ class Worker:
         self.draft_model = None
         self.draft_params = None
         spec = self.config.speculative_config
-        if spec.enabled and spec.method == "eagle":
+        if spec.enabled and spec.method in ("eagle", "eagle3"):
             self._load_eagle(spec, mc)
         elif spec.enabled and spec.method == "draft_model":
             self._load_draft_lm(spec, mc)
 
     def _load_eagle(self, spec, mc) -> None:
-        """Load the EAGLE draft head (reference: eagle.py load path)."""
+        """Load the EAGLE / EAGLE-3 draft head (reference: eagle.py)."""
         import jax
 
-        from vllm_tpu.models.eagle import EagleDraftModel
+        from vllm_tpu.models.eagle import EagleDraftModel, Eagle3DraftModel
 
+        cls = Eagle3DraftModel if spec.method == "eagle3" else EagleDraftModel
         if spec.model:
             from transformers import AutoConfig
 
             draft_cfg = AutoConfig.from_pretrained(spec.model)
-            self.draft_model = EagleDraftModel(draft_cfg, mc.jax_dtype)
+            self.draft_model = cls(draft_cfg, mc.jax_dtype)
             self.draft_params = self.draft_model.load_params(
                 spec.model, mc.jax_dtype
             )
@@ -391,7 +392,7 @@ class Worker:
             assert mc.load_format == "dummy", (
                 "eagle spec decode needs speculative_config.model"
             )
-            self.draft_model = EagleDraftModel(mc.hf_config, mc.jax_dtype)
+            self.draft_model = cls(mc.hf_config, mc.jax_dtype)
             self.draft_params = self.draft_model.init_dummy_params(
                 jax.random.PRNGKey(mc.seed + 1), mc.jax_dtype
             )
