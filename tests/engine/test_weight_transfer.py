@@ -78,6 +78,14 @@ def test_wire_roundtrip_and_errors():
     t.join(10)
 
 
+def _free_port() -> int:
+    import socket
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
 def _swallow(fn):
     try:
         fn()
@@ -127,7 +135,7 @@ def test_engine_streamed_update_matches_file_update(tmp_path_factory):
     before = llm.generate(prompt, params)[0].outputs[0].token_ids
     assert before != want  # different checkpoints really differ
 
-    port = 29517
+    port = _free_port()
     pusher = threading.Thread(
         target=lambda: push_weights(("127.0.0.1", port), b_leaves, timeout=60)
     )
@@ -151,7 +159,7 @@ def test_engine_rejects_bad_push(tmp_path_factory):
     prompt = [{"prompt_token_ids": [4, 8, 2]}]
     before = llm.generate(prompt, params)[0].outputs[0].token_ids
 
-    port = 29518
+    port = _free_port()
     errs: list[Exception] = []
 
     def push_bad():

@@ -71,8 +71,8 @@ def test_bert_hidden_and_classify_parity(bert_cls_ckpt):
     params = model.load_params(bert_cls_ckpt, jnp.float32)
 
     rng = np.random.default_rng(0)
-    a = rng.integers(5, 120, size=9).tolist()
-    b = rng.integers(5, 120, size=5).tolist()
+    a = rng.integers(5, 100, size=9).tolist()
+    b = rng.integers(5, 100, size=5).tolist()
     ids = jnp.asarray(a + b, jnp.int32)
     t = len(a) + len(b)
     md = AttentionMetadata(
@@ -159,7 +159,7 @@ def test_bert_engine_classify_and_cls(bert_cls_ckpt):
         max_num_batched_tokens=128,
     )
     rng = np.random.default_rng(3)
-    prompts = [rng.integers(5, 120, size=n).tolist() for n in (11, 4, 7)]
+    prompts = [rng.integers(5, 100, size=n).tolist() for n in (11, 4, 7)]
     outs = llm.embed(
         [{"prompt_token_ids": p} for p in prompts],
         PoolingParams(pooling_type="classify", normalize=False),
@@ -211,7 +211,7 @@ def test_bert_base_model_cls_embeddings(tmp_path_factory):
         max_num_batched_tokens=128,
     )
     rng = np.random.default_rng(4)
-    p = rng.integers(5, 120, size=9).tolist()
+    p = rng.integers(5, 100, size=9).tolist()
     outs = llm.embed(
         [{"prompt_token_ids": p}],
         PoolingParams(pooling_type="cls", normalize=False),
@@ -221,3 +221,53 @@ def test_bert_base_model_cls_embeddings(tmp_path_factory):
     np.testing.assert_allclose(
         np.asarray(outs[0].pooled), want, rtol=1e-3, atol=1e-3
     )
+
+def test_bert_pair_segment_ids_match_hf(bert_cls_ckpt):
+    """Cross-encoder pair layout: segment ids derived from [SEP] counts
+    reproduce HF's token_type_ids path exactly (review finding: the
+    second text must read segment-1 embeddings)."""
+    import torch
+    from transformers import AutoConfig, BertForSequenceClassification
+
+    import jax.numpy as jnp
+
+    from vllm_tpu.models.bert import (
+        BertForSequenceClassification as JaxBert,
+    )
+    from vllm_tpu.ops.attention import AttentionMetadata
+
+    cfg = AutoConfig.from_pretrained(bert_cls_ckpt)
+    sep = 102 % cfg.vocab_size  # keep in-vocab for the tiny config
+    model = JaxBert(cfg, dtype=jnp.float32)
+    model.sep_token_id = sep
+    params = model.load_params(bert_cls_ckpt, jnp.float32)
+
+    rng = np.random.default_rng(9)
+    a = rng.integers(5, 100, size=5).tolist()
+    b = rng.integers(5, 100, size=4).tolist()
+    ids = [101 % cfg.vocab_size] + a + [sep] + b + [sep]
+    types = [0] * (len(a) + 2) + [1] * (len(b) + 1)
+    t = len(ids)
+    md = AttentionMetadata(
+        positions=jnp.arange(t, dtype=jnp.int32),
+        slot_mapping=jnp.zeros(t, jnp.int32),
+        block_tables=jnp.zeros((1, 2), jnp.int32),
+        seq_lens=jnp.asarray([t], jnp.int32),
+        query_start_loc=jnp.asarray([0, t], jnp.int32),
+        token_req_idx=jnp.zeros(t, jnp.int32),
+        logits_indices=jnp.asarray([t - 1], jnp.int32),
+        num_seqs=jnp.asarray([1], jnp.int32),
+    )
+    kv = jnp.zeros(model.kv_cache_shape(4, 16), jnp.float32)
+    hidden, _ = model.apply(params, kv, jnp.asarray(ids, jnp.int32), md)
+    got = np.asarray(model.pooled_extra(params, hidden, md, 1))[0]
+
+    hf = BertForSequenceClassification.from_pretrained(
+        bert_cls_ckpt, torch_dtype=torch.float32
+    )
+    hf.eval()
+    with torch.no_grad():
+        want = hf(
+            torch.tensor([ids]), token_type_ids=torch.tensor([types])
+        ).logits[0].numpy()
+    np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-4)

@@ -1,7 +1,7 @@
-"""Device-time probe: general rpa kernel vs grouped decode kernel at the
-bench's decode shape, inside a 32-layer chain (layer index varies per
-iteration — XLA cannot CSE the calls). Ground truth for the
-default-or-delete decision on the decode path.
+"""Device-time probe: rpa kernel block-size sweep at the bench's decode
+shape, inside a 32-layer chain (layer index varies per iteration — XLA
+cannot CSE the calls). The grouped-decode comparison that used to live
+here concluded in round 5: grouped measured slower and was deleted.
 """
 
 from __future__ import annotations
@@ -56,18 +56,6 @@ def rpa_fn(q, kv, li, **kw):
         page_tables, cu, num_seqs, sm_scale=scale,
         k_scale=0.05, v_scale=0.05, **kw,
     )
-
-
-def grouped_fn_args(g, cb):
-    def fn(q, kv, li):
-        from vllm_tpu.ops.decode_attention import grouped_decode_attention
-
-        return grouped_decode_attention(
-            q, kv, jnp.asarray(li, jnp.int32).reshape(1), kv_lens,
-            page_tables, sm_scale=scale, k_scale=0.05, v_scale=0.05,
-            group_size=g, pages_per_iter=cb,
-        )
-    return fn
 
 
 def bench(name, f):

@@ -335,6 +335,13 @@ class EngineCore:
         """Disk-free RL weight push: listen on ``port`` for one streamed
         transfer and apply it in place (reference:
         ``distributed/weight_transfer/`` collective push)."""
+        if port <= 0:
+            # The blocking utility RPC cannot hand an OS-chosen ephemeral
+            # port back to the trainer; require an explicit one.
+            raise ValueError(
+                "receive_weights needs an explicit port (port=0 would "
+                "bind an undiscoverable ephemeral port)"
+            )
         assert not self.scheduler.has_unfinished_requests(), (
             "cannot swap weights with unfinished requests"
         )

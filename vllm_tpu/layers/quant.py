@@ -236,11 +236,11 @@ def _use_w8a8() -> bool:
     from vllm_tpu import envs
 
     mode = envs.VLLM_TPU_W8A8
-    if mode == "0":
-        return False
-    if mode == "auto":
+    if mode in ("1", "true", "True", "force"):
+        return True
+    if mode == "auto" or mode is None:
         return jax.default_backend() == "tpu"
-    return True
+    return False  # "0"/"false"/anything unrecognized: safe default off
 
 
 def quantize_activation_int8(x: jnp.ndarray):

@@ -86,6 +86,15 @@ class BertModel:
         self.sliding_window = None
         self.num_labels = int(getattr(c, "num_labels", 2) or 2)
         self.act = getattr(c, "hidden_act", "gelu")
+        # Segment (token_type) ids are derived IN-MODEL from [SEP]
+        # structure: tokens after the first [SEP] of a request are
+        # segment 1 (the cross-encoder pair layout [CLS] a [SEP] b [SEP]).
+        # The flat engine prompt carries no token_type_ids; without this
+        # a pair's second text would read segment-0 embeddings and
+        # classification scores would silently diverge from HF.
+        self.sep_token_id = getattr(c, "sep_token_id", None)
+        if self.sep_token_id is None and self.type_vocab > 1:
+            self.sep_token_id = 102  # the canonical BERT [SEP]
 
     # ------------------------------------------------------------------
     # Params
@@ -223,10 +232,25 @@ class BertModel:
         pos = jnp.clip(
             md.positions + self.position_offset, 0, self.max_position - 1
         )
+        if self.type_vocab > 1 and self.sep_token_id is not None:
+            # Per-request segment ids from [SEP] counts: token i's segment
+            # = number of SEPs strictly before it WITHIN its request
+            # (clipped to the type vocabulary) — reproduces the tokenizer
+            # pair layout [CLS] a [SEP](seg0) b [SEP](seg1).
+            is_sep = (input_ids == self.sep_token_id).astype(jnp.int32)
+            csum = jnp.cumsum(is_sep) - is_sep  # SEPs strictly before i
+            starts = jnp.concatenate(
+                [jnp.zeros(1, csum.dtype), jnp.cumsum(is_sep)]
+            )[md.query_start_loc[:-1]]  # SEPs before each request start
+            seg = jnp.clip(
+                csum - starts[md.token_req_idx], 0, self.type_vocab - 1
+            )
+        else:
+            seg = jnp.zeros_like(input_ids)
         x = (
             params["embed"][input_ids]
             + params["pos_embed"][pos]
-            + params["type_embed"][0]
+            + params["type_embed"][seg]
         ).astype(self.dtype)
         x = _layer_norm(x, params["emb_ln_w"], params["emb_ln_b"], self.eps)
 

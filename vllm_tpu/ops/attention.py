@@ -61,10 +61,6 @@ class AttentionMetadata:
     num_common_prefix_blocks: int = field(
         default=0, metadata=dict(static=True)
     )
-    # STATIC: this step's tokens are one-query-per-sequence (token i IS
-    # sequence i — the in-jit K-step decode chain shape). Dispatches the
-    # Pure-decode step (one query per sequence; in-jit decode chain).
-    decode_grouped: bool = field(default=False, metadata=dict(static=True))
     # Hybrid attention+SSM models (Jamba/Bamba-class): per-request state
     # slot for the constant-size Mamba caches ([R] i32; None for pure
     # attention models). Reference: HybridKVCacheCoordinator per-type

@@ -1664,7 +1664,6 @@ class ModelRunner:
             logits_indices=rows_r,
             num_seqs=md.num_seqs,
             state_slots=md.state_slots,
-            decode_grouped=True,
         )
 
     def _logit_adjustments(self, rows: list[int], req_order: list[str],
