@@ -48,18 +48,27 @@ class KVCacheManager:
         # Context parallelism: a request's k-th context block comes from
         # pool color k % num_stripes (= the cp rank holding that page).
         self.num_stripes = num_stripes
-        # Sliding-window models free blocks that fall fully out of the
-        # window (reference: single_type_kv_cache_manager.py:507
-        # SlidingWindowManager.remove_skipped_blocks) and use the
-        # window-aware hit logic in get_computed_blocks (longest cached
-        # suffix RUN covering the window; out-of-window prefix blocks are
-        # null stand-ins — find_longest_cache_hit, same file).
         self.sliding_window = sliding_window
         self.enable_caching = enable_caching
         self.block_pool = BlockPool(
             num_blocks, enable_caching,
             event_sink=event_sink, block_size=block_size,
             num_colors=num_stripes,
+        )
+        # Per-attention-type policy (reference:
+        # single_type_kv_cache_manager.py family under the unitary
+        # coordinator): full attention vs sliding window (window-aware
+        # prefix hits + out-of-window freeing). Hybrid per-group
+        # coordination plugs in here.
+        from vllm_tpu.core.single_type_managers import (
+            FullAttentionManager,
+            SlidingWindowManager,
+        )
+
+        self.type_manager = (
+            SlidingWindowManager(self.block_pool, block_size, sliding_window)
+            if sliding_window is not None
+            else FullAttentionManager(self.block_pool, block_size)
         )
 
         self.req_to_blocks: dict[str, list[KVCacheBlock]] = {}
@@ -88,48 +97,12 @@ class KVCacheManager:
         if not self.enable_caching or not request.block_hashes:
             return [], 0
         max_hit_blocks = (request.num_tokens - 1) // self.block_size
-        if self.sliding_window is None:
-            hit_blocks: list[KVCacheBlock] = []
-            for block_hash in request.block_hashes[:max_hit_blocks]:
-                block = self.block_pool.get_cached_block(block_hash)
-                if block is None:
-                    break
-                hit_blocks.append(block)
-        else:
-            hit_blocks = self._window_aware_hit(request, max_hit_blocks)
+        hit_blocks = self.type_manager.find_longest_cache_hit(
+            request, max_hit_blocks
+        )
         num_hit_tokens = len(hit_blocks) * self.block_size
         self.prefix_cache_stats.observe(request.num_tokens, num_hit_tokens)
         return hit_blocks, num_hit_tokens
-
-    def _window_aware_hit(
-        self, request: Request, max_hit_blocks: int
-    ) -> list[KVCacheBlock]:
-        """Sliding-window hit: the first scheduled query (position P =
-        hit_tokens) only attends keys in ``(P - window, P)``, so a hit
-        needs a contiguous cached RUN of ``ceil((window-1)/bs)`` blocks
-        ending at P — everything before the run is served as null
-        stand-ins (window-masked reads, never written). Scan backward for
-        the LAST such run; a run anchored at block 0 is a plain prefix
-        hit and counts at any length. Reference:
-        ``single_type_kv_cache_manager.py:507``
-        ``SlidingWindowManager.find_longest_cache_hit``."""
-        required = -(-(self.sliding_window - 1) // self.block_size)
-        hashes = request.block_hashes[:max_hit_blocks]
-        null = self.block_pool.null_block
-        blocks = [null] * len(hashes)
-        run = 0
-        for i in range(len(hashes) - 1, -1, -1):
-            block = self.block_pool.get_cached_block(hashes[i])
-            if block is None:
-                run = 0
-                continue
-            blocks[i] = block
-            run += 1
-            if run >= required:
-                return blocks[: i + run]
-        # Loop exhausted: the only usable run is the one anchored at
-        # block 0 (plain prefix semantics).
-        return blocks[:run]
 
     # ------------------------------------------------------------------
     # Slot allocation (every scheduling of a request)
@@ -204,35 +177,13 @@ class KVCacheManager:
     def _free_out_of_window(
         self, request: Request, req_blocks: list[KVCacheBlock]
     ) -> None:
-        """Replace blocks wholly below the attention window with the null
-        block and return them to the pool. Freed entries stay in the
-        runner's block table; reads of them are window-masked, and the
-        slots are never written again.
-
-        The floor uses only ROLLBACK-PROOF tokens: the pre-step computed
-        count minus in-flight placeholders and pending drafts (async
-        scheduling advances counts optimistically; spec verification can
-        roll computed back within the current step's range)."""
-        confirmed = (
-            request.num_computed_tokens
-            - request.num_output_placeholders
-            - len(request.spec_token_ids)
-        )
-        # Query at position p attends keys in (p - window, p].
-        first_needed_tok = max(0, confirmed - self.sliding_window + 1)
-        first_needed_blk = min(
-            first_needed_tok // self.block_size, len(req_blocks)
-        )
-        null = self.block_pool.null_block
+        """Per-type freeing policy (SlidingWindowManager nulls blocks
+        wholly below the window; full attention frees nothing)."""
         start = self._first_live_blk.get(request.request_id, 0)
-        for i in range(start, first_needed_blk):
-            b = req_blocks[i]
-            if b.is_null:
-                continue
-            req_blocks[i] = null
-            self.block_pool.free_blocks([b])
-        self._first_live_blk[request.request_id] = max(
-            start, first_needed_blk
+        self._first_live_blk[request.request_id] = (
+            self.type_manager.remove_skipped_blocks(
+                request, req_blocks, start
+            )
         )
 
     def defer_caching_from(self, request_id: str, token_floor: int) -> None:

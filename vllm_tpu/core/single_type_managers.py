@@ -1,0 +1,114 @@
+"""Per-attention-type block management policies.
+
+Reference analog: ``vllm/v1/core/single_type_kv_cache_manager.py``
+(FullAttentionManager :xx, SlidingWindowManager :507). The policies —
+how a cache-type finds prefix hits and which blocks it may free — are
+factored out of :class:`~vllm_tpu.core.kv_cache_manager.KVCacheManager`
+so hybrid per-group coordination (different policies for different
+layer groups, ``kv_cache_coordinator.py:392``) has its seam; today the
+engine runs ONE group (unitary coordinator semantics) and the facade
+delegates to exactly one of these.
+"""
+
+from __future__ import annotations
+
+from typing import TYPE_CHECKING
+
+from vllm_tpu.core.kv_cache_utils import KVCacheBlock
+
+if TYPE_CHECKING:  # pragma: no cover
+    from vllm_tpu.core.block_pool import BlockPool
+    from vllm_tpu.request import Request
+
+
+class FullAttentionManager:
+    """Plain causal attention: hits are the longest CONTIGUOUS cached
+    prefix; nothing is ever freed early."""
+
+    def __init__(self, block_pool: "BlockPool", block_size: int) -> None:
+        self.block_pool = block_pool
+        self.block_size = block_size
+
+    def find_longest_cache_hit(
+        self, request: "Request", max_hit_blocks: int
+    ) -> list[KVCacheBlock]:
+        hit: list[KVCacheBlock] = []
+        for block_hash in request.block_hashes[:max_hit_blocks]:
+            block = self.block_pool.get_cached_block(block_hash)
+            if block is None:
+                break
+            hit.append(block)
+        return hit
+
+    def remove_skipped_blocks(
+        self, request: "Request", req_blocks: list[KVCacheBlock],
+        first_live: int,
+    ) -> int:
+        return first_live  # nothing falls out of a full-attention window
+
+
+class SlidingWindowManager:
+    """Sliding-window attention: hits are the LAST cached run covering
+    the window (out-of-window prefix served as null stand-ins), and
+    blocks wholly below the window are freed as the sequence advances.
+    Reference: ``single_type_kv_cache_manager.py:507``."""
+
+    def __init__(
+        self, block_pool: "BlockPool", block_size: int, sliding_window: int
+    ) -> None:
+        self.block_pool = block_pool
+        self.block_size = block_size
+        self.sliding_window = sliding_window
+
+    def find_longest_cache_hit(
+        self, request: "Request", max_hit_blocks: int
+    ) -> list[KVCacheBlock]:
+        """The first scheduled query (position P = hit tokens) only
+        attends keys in ``(P - window, P)``: a hit needs a contiguous
+        cached RUN of ``ceil((window-1)/bs)`` blocks ending at P. Scan
+        backward for the LAST such run; a run anchored at block 0 is a
+        plain prefix hit at any length."""
+        required = -(-(self.sliding_window - 1) // self.block_size)
+        hashes = request.block_hashes[:max_hit_blocks]
+        null = self.block_pool.null_block
+        blocks: list[KVCacheBlock] = [null] * len(hashes)
+        run = 0
+        for i in range(len(hashes) - 1, -1, -1):
+            block = self.block_pool.get_cached_block(hashes[i])
+            if block is None:
+                run = 0
+                continue
+            blocks[i] = block
+            run += 1
+            if run >= required:
+                return blocks[: i + run]
+        # Loop exhausted: the only usable run is the one anchored at
+        # block 0 (plain prefix semantics).
+        return blocks[:run]
+
+    def remove_skipped_blocks(
+        self, request: "Request", req_blocks: list[KVCacheBlock],
+        first_live: int,
+    ) -> int:
+        """Replace blocks wholly below the window with the null block and
+        free them; returns the new first-live index. Entries stay in the
+        table (reads are window-masked, slots never rewritten). The floor
+        uses only ROLLBACK-PROOF tokens (async scheduling advances counts
+        optimistically; spec verification can roll back)."""
+        confirmed = (
+            request.num_computed_tokens
+            - request.num_output_placeholders
+            - len(request.spec_token_ids)
+        )
+        first_needed_tok = max(0, confirmed - self.sliding_window + 1)
+        first_needed_blk = min(
+            first_needed_tok // self.block_size, len(req_blocks)
+        )
+        null = self.block_pool.null_block
+        for i in range(first_live, first_needed_blk):
+            b = req_blocks[i]
+            if b.is_null:
+                continue
+            req_blocks[i] = null
+            self.block_pool.free_blocks([b])
+        return max(first_live, first_needed_blk)
