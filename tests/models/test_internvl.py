@@ -1,0 +1,118 @@
+"""InternVL tests: InternViT tower + pixel-shuffle projector parity with
+HF, and engine e2e greedy parity.
+
+Reference analog: ``vllm/model_executor/models/internvl.py`` parity tier
+(VERDICT r4 missing #5).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+IMG_SIZE = 56  # grid 4x4 -> pixel-shuffle 0.5 -> 2x2 = 4 tokens/image
+IMG_TOK = 120
+TPI = 4
+
+
+def tiny_internvl_config():
+    from transformers import InternVLConfig
+
+    return InternVLConfig(
+        vision_config=dict(
+            hidden_size=32, num_hidden_layers=2, num_attention_heads=2,
+            intermediate_size=64, image_size=[IMG_SIZE, IMG_SIZE],
+            patch_size=[14, 14], use_absolute_position_embeddings=True,
+        ),
+        text_config=dict(
+            model_type="qwen2",
+            vocab_size=128, hidden_size=48, intermediate_size=96,
+            num_hidden_layers=2, num_attention_heads=4,
+            num_key_value_heads=2, max_position_embeddings=256,
+            tie_word_embeddings=True,
+        ),
+        image_token_id=IMG_TOK,
+        downsample_ratio=0.5,
+    )
+
+
+@pytest.fixture(scope="module")
+def tiny_internvl(tmp_path_factory):
+    import torch
+    from transformers import InternVLForConditionalGeneration as HFInternVL
+
+    torch.manual_seed(0)
+    model = HFInternVL(tiny_internvl_config()).to(torch.float32)
+    path = tmp_path_factory.mktemp("tiny_internvl")
+    model.save_pretrained(str(path), safe_serialization=True)
+    return str(path)
+
+
+def _pixels(seed):
+    rng = np.random.default_rng(seed)
+    return rng.standard_normal((3, IMG_SIZE, IMG_SIZE)).astype(np.float32)
+
+
+def test_vision_tower_matches_hf(tiny_internvl):
+    """CLS/pos embeddings, layer-scale residuals, pixel shuffle, and the
+    LN+MLP projector match HF's get_image_features."""
+    import torch
+    from transformers import AutoConfig
+    from transformers import InternVLForConditionalGeneration as HFInternVL
+
+    import jax.numpy as jnp
+
+    from vllm_tpu.models.internvl import (
+        InternVLForConditionalGeneration as JaxVL,
+    )
+
+    cfg = AutoConfig.from_pretrained(tiny_internvl)
+    model = JaxVL(cfg, dtype=jnp.float32)
+    assert model.tokens_per_image == TPI
+    params = model.load_params(tiny_internvl, jnp.float32)
+    px = _pixels(0)
+    got = np.asarray(model.encode_images(params, jnp.asarray(px[None])))[0]
+
+    hf = HFInternVL.from_pretrained(tiny_internvl, torch_dtype=torch.float32)
+    hf.eval()
+    with torch.no_grad():
+        want = hf.model.get_image_features(
+            torch.tensor(px[None])
+        )[0].numpy()
+    assert want.shape == got.shape
+    np.testing.assert_allclose(got, want, rtol=2e-3, atol=2e-3)
+
+
+def test_internvl_e2e_greedy_matches_hf(tiny_internvl):
+    import torch
+    from transformers import InternVLForConditionalGeneration as HFInternVL
+
+    from vllm_tpu import LLM, SamplingParams
+
+    px = _pixels(1)
+    prompt = [5, 11, IMG_TOK, 23, 42]
+    expanded = [5, 11] + [IMG_TOK] * TPI + [23, 42]
+
+    hf = HFInternVL.from_pretrained(tiny_internvl, torch_dtype=torch.float32)
+    hf.eval()
+    with torch.no_grad():
+        want = hf.generate(
+            torch.tensor([expanded]),
+            pixel_values=torch.tensor(px[None]),
+            max_new_tokens=6, do_sample=False, pad_token_id=0,
+            eos_token_id=None,
+        )[0, len(expanded):].tolist()
+
+    llm = LLM(
+        model=tiny_internvl, dtype="float32", max_model_len=128,
+        block_size=16, num_gpu_blocks_override=64, max_num_seqs=4,
+        max_num_batched_tokens=128,
+    )
+    [out] = llm.generate(
+        [{
+            "prompt_token_ids": prompt,
+            "multi_modal_data": {"image": px},
+        }],
+        SamplingParams(temperature=0.0, max_tokens=6, ignore_eos=True),
+    )
+    assert out.outputs[0].token_ids == want

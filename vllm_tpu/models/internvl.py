@@ -1,0 +1,389 @@
+"""InternVL 2.5/3: InternViT tower + pixel-shuffle projector + Qwen2 LM.
+
+Reference analog: ``vllm/model_executor/models/internvl.py`` (VERDICT r4
+missing #5). Same shape discipline as ``llava.py``: the tower runs as a
+fixed-geometry jit per image, features are cached by the encoder-cache
+manager, and the decoder consumes a ``[T, D]`` overlay at placeholder
+positions. InternViT specifics handled here: CLS token + absolute
+position embeddings, layer-scale (lambda_1/lambda_2) residuals,
+pre/post LayerNorms (or RMS per ``norm_type``), optional full-width
+q/k RMSNorm, and the 0.5 pixel-shuffle downsample feeding the
+LayerNorm+MLP projector.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+from vllm_tpu.logger import init_logger
+from vllm_tpu.models.llava import _TEXT_ARCHS, _layer_norm
+from vllm_tpu.ops.attention import AttentionMetadata
+
+logger = init_logger(__name__)
+
+
+def _rms(x, w, eps):
+    xf = x.astype(jnp.float32)
+    n = xf * jax.lax.rsqrt((xf * xf).mean(-1, keepdims=True) + eps)
+    return (n * w.astype(jnp.float32)).astype(x.dtype)
+
+
+def _pair(v) -> int:
+    return int(v[0]) if isinstance(v, (list, tuple)) else int(v)
+
+
+class InternVLForConditionalGeneration:
+    is_multimodal = True
+    supports_lora = False
+    enable_lora = False
+
+    def __init__(self, hf_config: Any, dtype=jnp.bfloat16,
+                 quantization: str | None = None) -> None:
+        if quantization:
+            logger.warning(
+                "weight quantization is not yet supported for multimodal "
+                "models; running %s unquantized", type(self).__name__,
+            )
+        self.hf_config = hf_config
+        self.dtype = dtype
+        self.quantization = None
+        tc, vc = hf_config.text_config, hf_config.vision_config
+        import importlib
+
+        mod, cls = _TEXT_ARCHS.get(tc.model_type, _TEXT_ARCHS["llama"])
+        self.lang = getattr(importlib.import_module(mod), cls)(tc, dtype)
+
+        # Runner contracts proxy the decoder.
+        self.num_layers = self.lang.num_layers
+        self.num_kv_heads = self.lang.num_kv_heads
+        self.head_dim = self.lang.head_dim
+        self.hidden_size = self.lang.hidden_size
+        self.vocab_size = self.lang.vocab_size
+        self.sliding_window = self.lang.sliding_window
+
+        self.image_size = _pair(vc.image_size)
+        self.patch_size = _pair(vc.patch_size)
+        self.grid = self.image_size // self.patch_size
+        self.num_patches = self.grid * self.grid
+        self.vision_dim = vc.hidden_size
+        self.vision_heads = vc.num_attention_heads
+        self.vision_layers = vc.num_hidden_layers
+        self.vision_intermediate = vc.intermediate_size
+        self.vision_eps = getattr(vc, "layer_norm_eps", 1e-6)
+        self.vision_rms = getattr(vc, "norm_type", "layer_norm") == "rms_norm"
+        self.vision_qk_norm = bool(getattr(vc, "use_qk_norm", False))
+        self.vision_attn_bias = bool(getattr(vc, "attention_bias", False))
+        # use_mean_pooling=True (the shipped checkpoints): the tower's
+        # final layernorm is Identity.
+        self.vision_final_ln = not getattr(vc, "use_mean_pooling", True)
+        self.downsample = float(getattr(hf_config, "downsample_ratio", 0.5))
+        self.scale_hw = int(round(1 / self.downsample))
+        assert self.grid % self.scale_hw == 0, (self.grid, self.downsample)
+        self.tokens_per_image = (self.grid // self.scale_hw) ** 2
+        self.proj_in = self.vision_dim * self.scale_hw * self.scale_hw
+        self.image_token_id = hf_config.image_token_id
+
+    @classmethod
+    def mm_info(cls, hf_config: Any) -> dict:
+        vc = hf_config.vision_config
+        grid = _pair(vc.image_size) // _pair(vc.patch_size)
+        s = int(round(1 / float(getattr(hf_config, "downsample_ratio", 0.5))))
+        return {
+            "image_token_id": hf_config.image_token_id,
+            "tokens_per_image": (grid // s) ** 2,
+            "image_size": _pair(vc.image_size),
+        }
+
+    # ------------------------------------------------------------------
+    # Params
+    # ------------------------------------------------------------------
+
+    def init_dummy_params(self, rng: jax.Array, dtype=None) -> dict:
+        dtype = dtype or self.dtype
+        Dv, Di, Lv = (
+            self.vision_dim, self.vision_intermediate, self.vision_layers,
+        )
+        Dt = self.hidden_size
+        p = self.patch_size
+        key = iter(jax.random.split(rng, 32))
+
+        def init(shape, fan_in):
+            return (
+                jax.random.normal(next(key), shape, jnp.float32)
+                / math.sqrt(fan_in)
+            ).astype(dtype)
+
+        vision = {
+            "patch_embed": init((Dv, 3, p, p), 3 * p * p),
+            "patch_embed_b": jnp.zeros((Dv,), dtype),
+            "cls_token": init((Dv,), Dv),
+            "pos_emb": init((self.num_patches + 1, Dv), Dv),
+            "layers": {
+                "ln1_w": jnp.ones((Lv, Dv), dtype),
+                "ln1_b": jnp.zeros((Lv, Dv), dtype),
+                "wq": init((Lv, Dv, Dv), Dv),
+                "wk": init((Lv, Dv, Dv), Dv),
+                "wv": init((Lv, Dv, Dv), Dv),
+                "wo": init((Lv, Dv, Dv), Dv),
+                "bo": jnp.zeros((Lv, Dv), dtype),
+                "lambda1": jnp.full((Lv, Dv), 0.1, dtype),
+                "lambda2": jnp.full((Lv, Dv), 0.1, dtype),
+                "ln2_w": jnp.ones((Lv, Dv), dtype),
+                "ln2_b": jnp.zeros((Lv, Dv), dtype),
+                "fc1": init((Lv, Dv, Di), Dv),
+                "fc1_b": jnp.zeros((Lv, Di), dtype),
+                "fc2": init((Lv, Di, Dv), Di),
+                "fc2_b": jnp.zeros((Lv, Dv), dtype),
+            },
+        }
+        if self.vision_attn_bias:
+            vision["layers"]["bq"] = jnp.zeros((Lv, Dv), dtype)
+            vision["layers"]["bk"] = jnp.zeros((Lv, Dv), dtype)
+            vision["layers"]["bv"] = jnp.zeros((Lv, Dv), dtype)
+        if self.vision_qk_norm:
+            vision["layers"]["qn_w"] = jnp.ones((Lv, Dv), dtype)
+            vision["layers"]["kn_w"] = jnp.ones((Lv, Dv), dtype)
+        if self.vision_final_ln:
+            vision["final_ln_w"] = jnp.ones((Dv,), dtype)
+            vision["final_ln_b"] = jnp.zeros((Dv,), dtype)
+        projector = {
+            "ln_w": jnp.ones((self.proj_in,), dtype),
+            "ln_b": jnp.zeros((self.proj_in,), dtype),
+            "w1": init((self.proj_in, Dt), self.proj_in),
+            "b1": jnp.zeros((Dt,), dtype),
+            "w2": init((Dt, Dt), Dt),
+            "b2": jnp.zeros((Dt,), dtype),
+        }
+        return {
+            "language": self.lang.init_dummy_params(next(key), dtype),
+            "vision": vision,
+            "projector": projector,
+        }
+
+    def hf_weight_map(self) -> dict:
+        m = {
+            hf: (f"language.{dest}", tr)
+            for hf, (dest, tr) in self.lang.hf_weight_map().items()
+        }
+        vt = "model.vision_tower"
+        m |= {
+            f"{vt}.embeddings.patch_embeddings.projection.weight": (
+                "vision.patch_embed", False),
+            f"{vt}.embeddings.patch_embeddings.projection.bias": (
+                "vision.patch_embed_b", False),
+            f"{vt}.embeddings.cls_token": ("vision.cls_token", False),
+            f"{vt}.embeddings.position_embeddings": ("vision.pos_emb", False),
+        }
+        if self.vision_final_ln:
+            m |= {
+                f"{vt}.layernorm.weight": ("vision.final_ln_w", False),
+                f"{vt}.layernorm.bias": ("vision.final_ln_b", False),
+            }
+        per_layer = {
+            "layernorm_before.weight": ("ln1_w", False),
+            "layernorm_before.bias": ("ln1_b", False),
+            "attention.q_proj.weight": ("wq", True),
+            "attention.k_proj.weight": ("wk", True),
+            "attention.v_proj.weight": ("wv", True),
+            "attention.projection_layer.weight": ("wo", True),
+            "attention.projection_layer.bias": ("bo", False),
+            "lambda_1": ("lambda1", False),
+            "lambda_2": ("lambda2", False),
+            "layernorm_after.weight": ("ln2_w", False),
+            "layernorm_after.bias": ("ln2_b", False),
+            "mlp.fc1.weight": ("fc1", True),
+            "mlp.fc1.bias": ("fc1_b", False),
+            "mlp.fc2.weight": ("fc2", True),
+            "mlp.fc2.bias": ("fc2_b", False),
+        }
+        if self.vision_attn_bias:
+            per_layer |= {
+                "attention.q_proj.bias": ("bq", False),
+                "attention.k_proj.bias": ("bk", False),
+                "attention.v_proj.bias": ("bv", False),
+            }
+        if self.vision_qk_norm:
+            per_layer |= {
+                "attention.q_norm.weight": ("qn_w", False),
+                "attention.k_norm.weight": ("kn_w", False),
+            }
+        for i in range(self.vision_layers):
+            for hf_name, (ours, tr) in per_layer.items():
+                m[f"{vt}.encoder.layer.{i}.{hf_name}"] = (
+                    f"vision.layers.{ours}.{i}", tr)
+        mp = "model.multi_modal_projector"
+        m |= {
+            f"{mp}.layer_norm.weight": ("projector.ln_w", False),
+            f"{mp}.layer_norm.bias": ("projector.ln_b", False),
+            f"{mp}.linear_1.weight": ("projector.w1", True),
+            f"{mp}.linear_1.bias": ("projector.b1", False),
+            f"{mp}.linear_2.weight": ("projector.w2", True),
+            f"{mp}.linear_2.bias": ("projector.b2", False),
+        }
+        # Both HF naming eras: save_pretrained emits top-level
+        # "vision_tower./multi_modal_projector./language_model.model.*"
+        # (no "model." wrapper); hub checkpoints nest under "model.".
+        for k in list(m):
+            if k.startswith("model.") and not k.startswith(
+                "model.language_model."
+            ):
+                m[k[len("model."):]] = m[k]
+        for hf, dest in self.lang.hf_weight_map().items():
+            if hf.startswith("model."):
+                alias = "language_model." + hf
+            else:
+                alias = "language_model." + hf  # lm_head.weight etc.
+            m[alias] = (f"language.{dest[0]}", dest[1])
+        return m
+
+    def postprocess_weight(self, leaf_path: str, arr):
+        if leaf_path == "vision.cls_token":
+            return arr.reshape(-1)  # HF stores [1, 1, Dv]
+        if leaf_path == "vision.pos_emb":
+            return arr.reshape(arr.shape[-2], arr.shape[-1])  # [1, N+1, Dv]
+        return arr
+
+    def load_params(self, path: str, dtype=None, shardings: Any | None = None) -> dict:
+        from vllm_tpu.models.loader import load_params_from
+
+        return load_params_from(self, path, dtype or self.dtype, shardings)
+
+    # ------------------------------------------------------------------
+    # Vision tower
+    # ------------------------------------------------------------------
+
+    def encode_images(self, params: dict, pixels: jnp.ndarray) -> jnp.ndarray:
+        """[B, 3, S, S] f32 -> [B, tokens_per_image, D_text]."""
+        v = params["vision"]
+        bsz = pixels.shape[0]
+        p, n = self.patch_size, self.grid
+        Dv = self.vision_dim
+
+        patches = (
+            pixels.astype(self.dtype)
+            .reshape(bsz, 3, n, p, n, p)
+            .transpose(0, 2, 4, 1, 3, 5)
+            .reshape(bsz, n * n, 3 * p * p)
+        )
+        w = v["patch_embed"].reshape(Dv, 3 * p * p).T
+        x = patches @ w + v["patch_embed_b"]
+        cls = jnp.broadcast_to(v["cls_token"], (bsz, 1, Dv)).astype(x.dtype)
+        x = jnp.concatenate([cls, x], axis=1) + v["pos_emb"].astype(x.dtype)
+
+        def norm(h, wn, bn):
+            if self.vision_rms:
+                return _rms(h, wn, self.vision_eps)
+            return _layer_norm(h, wn, bn, self.vision_eps)
+
+        hv = self.vision_heads
+        dh = Dv // hv
+        scale = dh ** -0.5
+        seq = x.shape[1]
+
+        def layer_fn(x, lp):
+            h = norm(x, lp["ln1_w"], lp["ln1_b"])
+            q = h @ lp["wq"]
+            k = h @ lp["wk"]
+            val = h @ lp["wv"]
+            if self.vision_attn_bias:
+                q, k, val = q + lp["bq"], k + lp["bk"], val + lp["bv"]
+            if self.vision_qk_norm:
+                # Full-width RMS on the projected vectors, pre-head-split.
+                q = _rms(q, lp["qn_w"], self.vision_eps)
+                k = _rms(k, lp["kn_w"], self.vision_eps)
+            q = q.reshape(bsz, seq, hv, dh)
+            k = k.reshape(bsz, seq, hv, dh)
+            val = val.reshape(bsz, seq, hv, dh)
+            scores = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+            probs = jax.nn.softmax(scores.astype(jnp.float32), axis=-1)
+            attn = jnp.einsum(
+                "bhqk,bkhd->bqhd", probs.astype(val.dtype), val
+            ).reshape(bsz, seq, Dv)
+            attn = attn @ lp["wo"] + lp["bo"]
+            x = x + lp["lambda1"] * attn
+            h = norm(x, lp["ln2_w"], lp["ln2_b"])
+            mlp = jax.nn.gelu(
+                (h @ lp["fc1"] + lp["fc1_b"]).astype(jnp.float32),
+                approximate=False,
+            ).astype(x.dtype) @ lp["fc2"] + lp["fc2_b"]
+            return x + lp["lambda2"] * mlp, None
+
+        x, _ = jax.lax.scan(layer_fn, x, v["layers"])
+        if self.vision_final_ln:
+            x = _layer_norm(
+                x, v["final_ln_w"], v["final_ln_b"], self.vision_eps
+            )
+
+        x = x[:, 1:]  # drop CLS (vision_feature_select_strategy=default)
+        # Pixel shuffle (HF InternVLModel.pixel_shuffle, s = downsample):
+        # [B, f, f, C] -> [B, f*s, f*s, C/s^2], matching its two
+        # transpose steps exactly.
+        f, s = self.grid, self.downsample
+        x = x.reshape(bsz, f, f, Dv)
+        x = x.reshape(bsz, f, int(f * s), int(Dv / s))
+        x = x.transpose(0, 2, 1, 3)
+        x = x.reshape(bsz, int(f * s), int(f * s), int(Dv / (s * s)))
+        x = x.transpose(0, 2, 1, 3)
+        x = x.reshape(bsz, self.tokens_per_image, self.proj_in)
+
+        pj = params["projector"]
+        x = _layer_norm(x, pj["ln_w"], pj["ln_b"], 1e-5)
+        x = jax.nn.gelu(
+            (x @ pj["w1"] + pj["b1"]).astype(jnp.float32), approximate=False
+        ).astype(self.dtype)
+        return x @ pj["w2"] + pj["b2"]  # [B, TPI, D_text]
+
+    # ------------------------------------------------------------------
+    # Decoder delegation
+    # ------------------------------------------------------------------
+
+    def apply(
+        self,
+        params: dict,
+        kv_cache: jnp.ndarray,
+        input_ids: jnp.ndarray,
+        md: AttentionMetadata,
+        token_lora_slot: jnp.ndarray | None = None,
+        mm_embeds: jnp.ndarray | None = None,  # [T, D_text]
+        mm_mask: jnp.ndarray | None = None,  # [T] bool
+    ) -> tuple[jnp.ndarray, jnp.ndarray]:
+        lp = params["language"]
+        emb = lp["embed"][input_ids].astype(self.dtype)
+        if mm_embeds is not None:
+            emb = jnp.where(
+                mm_mask[:, None], mm_embeds.astype(emb.dtype), emb
+            )
+        return self.lang.apply(
+            lp, kv_cache, input_ids, md, inputs_embeds=emb
+        )
+
+    def compute_logits(self, params: dict, hidden: jnp.ndarray) -> jnp.ndarray:
+        return self.lang.compute_logits(params["language"], hidden)
+
+    # ------------------------------------------------------------------
+    # Runner contracts (proxy the decoder)
+    # ------------------------------------------------------------------
+
+    def get_kv_cache_spec(self, block_size: int, dtype_bytes: int):
+        return self.lang.get_kv_cache_spec(block_size, dtype_bytes)
+
+    def param_shardings(self, data_axis: str | None = None,
+                        model_axis: str = "tp") -> dict:
+        from jax.sharding import PartitionSpec as P
+
+        out = {
+            "language": self.lang.param_shardings(data_axis, model_axis),
+        }
+        shapes = jax.eval_shape(
+            lambda: self.init_dummy_params(jax.random.PRNGKey(0))
+        )
+        for part in ("vision", "projector"):
+            out[part] = jax.tree_util.tree_map(lambda _: P(), shapes[part])
+        return out
+
+    def kv_cache_sharding(self, model_axis: str = "tp"):
+        return self.lang.kv_cache_sharding(model_axis)

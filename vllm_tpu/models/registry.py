@@ -40,6 +40,7 @@ _REGISTRY: dict[str, tuple[str, str]] = {
     "LlavaForConditionalGeneration": ("vllm_tpu.models.llava", "LlavaForConditionalGeneration"),
     "Qwen2VLForConditionalGeneration": ("vllm_tpu.models.qwen2_vl", "Qwen2VLForConditionalGeneration"),
     "Qwen2_5_VLForConditionalGeneration": ("vllm_tpu.models.qwen2_5_vl", "Qwen25VLForConditionalGeneration"),
+    "InternVLForConditionalGeneration": ("vllm_tpu.models.internvl", "InternVLForConditionalGeneration"),
     "GPT2LMHeadModel": ("vllm_tpu.models.gpt_like", "GPT2LMHeadModel"),
     "GPTBigCodeForCausalLM": ("vllm_tpu.models.gpt_like", "GPTBigCodeForCausalLM"),
     "OPTForCausalLM": ("vllm_tpu.models.gpt_like", "OPTForCausalLM"),
