@@ -215,3 +215,39 @@ def test_gemma3_vlm_rejects_images_loudly(tmp_path_factory, caplog):
             }],
             SamplingParams(temperature=0.0, max_tokens=2),
         )
+
+
+def test_vision_tower_video_matches_hf(tiny_qwen25vl):
+    """Video path: per-temporal-group windows + full-attention blocks
+    across the clip match HF's visual tower on a (t=2, 8, 8) grid."""
+    import torch
+    from transformers import AutoConfig, Qwen2_5_VLForConditionalGeneration
+
+    import jax.numpy as jnp
+
+    from vllm_tpu.models.qwen2_5_vl import Qwen25VLForConditionalGeneration as JaxVL
+
+    cfg = AutoConfig.from_pretrained(tiny_qwen25vl)
+    model = JaxVL(cfg, dtype=jnp.float32)
+    params = model.load_params(tiny_qwen25vl, jnp.float32)
+    rng = np.random.default_rng(7)
+    frames = rng.standard_normal((4, 3, IMG_SIZE, IMG_SIZE)).astype(
+        np.float32
+    )
+    got = np.asarray(
+        model.encode_videos(params, jnp.asarray(frames[None]))
+    )[0]
+
+    hf = Qwen2_5_VLForConditionalGeneration.from_pretrained(
+        tiny_qwen25vl, torch_dtype=torch.float32
+    )
+    hf.eval()
+    patches = np.asarray(
+        model._patchify_video(jnp.asarray(frames[None]))
+    )[0]
+    with torch.no_grad():
+        want = hf.model.visual(
+            torch.tensor(patches), grid_thw=torch.tensor([[2, 8, 8]])
+        ).numpy()
+    assert want.shape == got.shape
+    np.testing.assert_allclose(got, want, rtol=3e-3, atol=3e-3)

@@ -177,3 +177,93 @@ def test_qwen2vl_text_only_matches_hf(tiny_qwen2vl):
         SamplingParams(temperature=0.0, max_tokens=8, ignore_eos=True),
     )
     assert out.outputs[0].token_ids == want
+
+
+VID_TOK = 123
+
+
+def _hf_video_patches(frames: np.ndarray, tps=2, p=14, m=2):
+    """HF Qwen2VLImageProcessor._preprocess's video patch layout,
+    replicated verbatim (torchvision is absent so the real video
+    processor cannot run here): [T, C, H, W] -> [gt*gh*gw, C*tps*p*p]."""
+    t, c, hpx, wpx = frames.shape
+    gt, gh, gw = t // tps, hpx // p, wpx // p
+    x = frames.reshape(gt, tps, c, gh // m, m, p, gw // m, m, p)
+    x = x.transpose(0, 3, 6, 4, 7, 2, 1, 5, 8)
+    return x.reshape(gt * gh * gw, c * tps * p * p), (gt, gh, gw)
+
+
+def test_qwen2vl_video_e2e_matches_hf(tiny_qwen2vl):
+    """Video inputs: temporal patch pairs, per-group m-rope t stream, and
+    the encoder-cache plumbing match HF's pixel_values_videos path."""
+    import torch
+    from transformers import Qwen2VLForConditionalGeneration
+
+    from vllm_tpu import LLM, SamplingParams
+
+    rng = np.random.default_rng(3)
+    frames = rng.standard_normal((4, 3, IMG_SIZE, IMG_SIZE)).astype(
+        np.float32
+    )
+    tpi, t_groups = 4, 2  # (56/14/2)^2 spatial, 4 frames / tps 2
+    tokens = t_groups * tpi
+    prompt = [5, 11, VSTART, VID_TOK, VEND, 23, 42]
+    expanded = [5, 11, VSTART] + [VID_TOK] * tokens + [VEND, 23, 42]
+
+    hf = Qwen2VLForConditionalGeneration.from_pretrained(
+        tiny_qwen2vl, torch_dtype=torch.float32
+    )
+    hf.eval()
+    hf.config.video_token_id = VID_TOK
+    pv, (gt, gh, gw) = _hf_video_patches(frames)
+    with torch.no_grad():
+        want = hf.generate(
+            torch.tensor([expanded]),
+            pixel_values_videos=torch.tensor(pv),
+            video_grid_thw=torch.tensor([[gt, gh, gw]]),
+            max_new_tokens=6, do_sample=False, pad_token_id=0,
+            eos_token_id=None,
+        )[0, len(expanded):].tolist()
+
+    from vllm_tpu.models.qwen2_vl import Qwen2VLForConditionalGeneration as JaxVL
+
+    llm = LLM(
+        model=tiny_qwen2vl, dtype="float32", max_model_len=128,
+        block_size=16, num_gpu_blocks_override=64, max_num_seqs=4,
+        max_num_batched_tokens=128,
+        hf_overrides={"video_token_id": VID_TOK},
+    )
+    try:
+        # Fixed frame count = the clip length (tiny-config test).
+        old = JaxVL.default_video_frames
+        JaxVL.default_video_frames = 4
+        [out] = llm.generate(
+            [{
+                "prompt_token_ids": prompt,
+                "multi_modal_data": {"video": frames},
+            }],
+            SamplingParams(temperature=0.0, max_tokens=6, ignore_eos=True),
+        )
+    finally:
+        JaxVL.default_video_frames = old
+    assert out.outputs[0].token_ids == want
+
+
+def test_video_mrope_positions_match_hf(tiny_qwen2vl):
+    """Video spans (temporal groups) in the host mrope table equal HF's
+    get_rope_index with video_grid_thw."""
+    import torch
+    from transformers import Qwen2VLForConditionalGeneration
+
+    from vllm_tpu.models.qwen2_vl import mrope_positions
+
+    tokens = 2 * 4  # t_groups * spatial
+    ids = [5, 11, VSTART] + [VID_TOK] * tokens + [VEND, 23, 42]
+    model = Qwen2VLForConditionalGeneration.from_pretrained(tiny_qwen2vl)
+    model.config.video_token_id = VID_TOK
+    want, want_delta = model.model.get_rope_index(
+        torch.tensor([ids]), video_grid_thw=torch.tensor([[2, 4, 4]])
+    )
+    got, delta = mrope_positions(len(ids), [(3, 2, 2, 2)])
+    np.testing.assert_array_equal(got, want[:, 0].numpy())
+    assert delta == int(want_delta[0])

@@ -206,26 +206,51 @@ class InputProcessor:
             from vllm_tpu.multimodal import expand_mm_prompt
 
             images = mm_data.get("image")
-            if images is None:
+            videos = mm_data.get("video")
+            unknown = set(mm_data) - {"image", "video"}
+            if unknown or (images is None and videos is None):
                 raise ValueError(
                     f"unsupported multi_modal_data keys: {list(mm_data)}"
                 )
-            if not isinstance(images, list):
+            if images is not None and not isinstance(images, list):
                 images = [images]
             info = self._mm_info()
+            if videos is not None:
+                if info.get("video_token_id") is None:
+                    raise ValueError(
+                        "this model does not accept video inputs"
+                    )
+                # Normalize to a LIST OF CLIPS: a clip is a 4-D array or
+                # a list of frames; a bare list of frames is one clip.
+                if isinstance(videos, list):
+                    is_clip_list = videos and (
+                        isinstance(videos[0], list)
+                        or getattr(videos[0], "ndim", 0) == 4
+                    )
+                    videos = videos if is_clip_list else [videos]
+                else:
+                    videos = [videos]
             # A span larger than the whole encoder budget could never be
             # scheduled — the engine would trim its chunk to zero forever.
             budget = self.config.scheduler_config.encoder_cache_budget
-            if info["tokens_per_image"] > budget:
+            worst = max(
+                info["tokens_per_image"] if images else 0,
+                info.get("tokens_per_video", 0) if videos else 0,
+            )
+            if worst > budget:
                 raise ValueError(
-                    f"one image needs {info['tokens_per_image']} encoder "
-                    f"tokens but encoder_cache_budget is {budget}"
+                    f"one multimodal item needs {worst} encoder tokens "
+                    f"but encoder_cache_budget is {budget}"
                 )
             prompt_token_ids, mm_inputs = expand_mm_prompt(
-                prompt_token_ids, images,
+                prompt_token_ids, images or [],
                 image_token_id=info["image_token_id"],
                 tokens_per_image=info["tokens_per_image"],
                 image_size=info["image_size"],
+                videos=videos,
+                video_token_id=info.get("video_token_id"),
+                tokens_per_video=info.get("tokens_per_video"),
+                video_frames=info.get("video_frames"),
             )
 
         max_len = self.config.scheduler_config.max_model_len

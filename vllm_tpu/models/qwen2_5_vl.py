@@ -164,16 +164,38 @@ class Qwen25VLForConditionalGeneration(Qwen2VLForConditionalGeneration):
     # ------------------------------------------------------------------
 
     def encode_images(self, params: dict, images: jnp.ndarray) -> jnp.ndarray:
+        return self._tower(
+            params, self._patchify(images), *self._vision_rope, n_groups=1
+        )
+
+    def encode_videos(self, params: dict, frames: jnp.ndarray) -> jnp.ndarray:
+        """Windows apply PER TEMPORAL GROUP (HF get_window_index iterates
+        the (t, h, w) grid with spatial windows per t); full-attention
+        blocks span the whole clip."""
+        fg = frames.shape[1] // self.temporal_patch_size
+        cos, sin = self._vision_rope
+        return self._tower(
+            params, self._patchify_video(frames),
+            jnp.tile(cos, (fg, 1)), jnp.tile(sin, (fg, 1)), n_groups=fg,
+        )
+
+    def _tower(self, params: dict, patches: jnp.ndarray, cos, sin,
+               n_groups: int) -> jnp.ndarray:
         vp = params["vision"]
-        patches = self._patchify(images)
         b, n, _ = patches.shape
         x = patches.astype(self.dtype) @ vp["patch_w"]  # [B, N, Dv]
-        cos, sin = self._vision_rope
         if self._win_perm is not None:
-            # Window-major order once; rope tables follow.
-            x = x[:, self._win_perm]
-            cos = cos[self._win_perm]
-            sin = sin[self._win_perm]
+            # Window-major order once, applied within each temporal
+            # group; rope tables follow.
+            perm = self._win_perm
+            if n_groups > 1:
+                offs = (
+                    jnp.arange(n_groups)[:, None] * self.num_patches
+                )
+                perm = (perm[None, :] + offs).reshape(-1)
+            x = x[:, perm]
+            cos = cos[perm]
+            sin = sin[perm]
         hd, H = self.vision_head_dim, self.vision_heads
 
         def attention(h, lp, windowed: bool):
@@ -185,7 +207,7 @@ class Qwen25VLForConditionalGeneration(Qwen2VLForConditionalGeneration):
             q = q * cos[None, :, None, :] + _rotate_half(q) * sin[None, :, None, :]
             k = k * cos[None, :, None, :] + _rotate_half(k) * sin[None, :, None, :]
             if windowed:
-                w, wl = self.n_windows, self.win_patches
+                w, wl = n_groups * self.n_windows, self.win_patches
                 q = q.reshape(b, w, wl, H, hd)
                 k = k.reshape(b, w, wl, H, hd)
                 v = v.reshape(b, w, wl, H, hd)
@@ -215,10 +237,14 @@ class Qwen25VLForConditionalGeneration(Qwen2VLForConditionalGeneration):
             x = x + (act @ lp["down_w"] + lp["down_b"])
 
         if self._win_inv is not None:
-            x = x[:, self._win_inv]  # back to merge-major for the merger
+            inv = self._win_inv
+            if n_groups > 1:
+                offs = jnp.arange(n_groups)[:, None] * self.num_patches
+                inv = (inv[None, :] + offs).reshape(-1)
+            x = x[:, inv]  # back to merge-major for the merger
         x = _rms(x, vp["merger_ln_w"])
         mh = self.vision_dim * self.merge * self.merge
-        x = x.reshape(b, self.tokens_per_image, mh)
+        x = x.reshape(b, n_groups * self.tokens_per_image, mh)
         x = x @ vp["merger_fc1_w"] + vp["merger_fc1_b"]
         x = jax.nn.gelu(x.astype(jnp.float32), approximate=False).astype(
             self.dtype

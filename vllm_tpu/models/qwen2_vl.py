@@ -54,35 +54,40 @@ def _rotate_half(x):
 
 def mrope_positions(
     prompt_len: int,
-    spans: list[tuple[int, int, int]],  # (offset, llm_h, llm_w) per image
+    spans: list[tuple],  # (offset, llm_h, llm_w) or (offset, t, lh, lw)
 ) -> tuple[np.ndarray, int]:
     """Host-side ``get_rope_index`` for one request.
 
-    Returns ``(pos3 [3, prompt_len] i32, delta)``: image tokens get
-    (constant t, row, col) positions over their POST-MERGE grid; text
-    resumes at ``max(previous) + 1``; decode position ``p`` (0-based
-    engine position) maps to ``p + delta`` on all three streams.
+    Returns ``(pos3 [3, prompt_len] i32, delta)``: image/video tokens get
+    (t, row, col) positions over their POST-MERGE grid (images: one
+    temporal index; videos: one per temporal group); text resumes at
+    ``max(previous) + 1``; decode position ``p`` (0-based engine
+    position) maps to ``p + delta`` on all three streams.
     """
     pos3 = np.zeros((3, prompt_len), np.int32)
     cursor = 0  # next position value for text
     idx = 0
-    for off, lh, lw in sorted(spans):
-        # Text run before the image.
+    for span in sorted(spans):
+        off, tg, lh, lw = (
+            span if len(span) == 4 else (span[0], 1, span[1], span[2])
+        )
+        # Text run before the image/video.
         n_text = off - idx
         for j in range(n_text):
             pos3[:, idx + j] = cursor + j
         cursor += n_text
         idx = off
-        # Image grid: t constant, h rows, w cols.
-        n_img = lh * lw
-        t_pos = np.full(n_img, cursor, np.int64)
-        h_pos = np.repeat(np.arange(lh), lw) + cursor
-        w_pos = np.tile(np.arange(lw), lh) + cursor
-        pos3[0, idx : idx + n_img] = t_pos
-        pos3[1, idx : idx + n_img] = h_pos
-        pos3[2, idx : idx + n_img] = w_pos
-        cursor += max(lh, lw)
-        idx += n_img
+        # Grid: t per temporal group, h rows, w cols (tiled per group).
+        n_spatial = lh * lw
+        n_tok = tg * n_spatial
+        t_pos = np.repeat(np.arange(tg), n_spatial) + cursor
+        h_pos = np.tile(np.repeat(np.arange(lh), lw), tg) + cursor
+        w_pos = np.tile(np.tile(np.arange(lw), lh), tg) + cursor
+        pos3[0, idx : idx + n_tok] = t_pos
+        pos3[1, idx : idx + n_tok] = h_pos
+        pos3[2, idx : idx + n_tok] = w_pos
+        cursor += max(tg, lh, lw)
+        idx += n_tok
     for j in range(prompt_len - idx):
         pos3[:, idx + j] = cursor + j
     max_pos = int(pos3.max()) if prompt_len else -1
@@ -99,6 +104,9 @@ class Qwen2VLForConditionalGeneration:
     # Fixed input geometry (HF's dynamic resolution is deferred — every
     # image is resized square; parity tests feed the same size to HF).
     default_image_size = 224
+    # Fixed video frame count (static tower shapes): clips are linearly
+    # resampled to this many frames; temporal groups = frames / tps.
+    default_video_frames = 8
 
     def __init__(self, hf_config: Any, dtype=jnp.bfloat16,
                  quantization: str | None = None) -> None:
@@ -191,10 +199,16 @@ class Qwen2VLForConditionalGeneration:
         vc = hf_config.vision_config
         merge = getattr(vc, "spatial_merge_size", 2)
         grid = cls.default_image_size // vc.patch_size
+        tpi = (grid // merge) ** 2
+        tps = getattr(vc, "temporal_patch_size", 2)
+        t_groups = cls.default_video_frames // tps
         return {
             "image_token_id": hf_config.image_token_id,
-            "tokens_per_image": (grid // merge) ** 2,
+            "tokens_per_image": tpi,
             "image_size": cls.default_image_size,
+            "video_token_id": getattr(hf_config, "video_token_id", None),
+            "tokens_per_video": t_groups * tpi,
+            "video_frames": cls.default_video_frames,
         }
 
     # ------------------------------------------------------------------
@@ -314,15 +328,47 @@ class Qwen2VLForConditionalGeneration:
         )
         return x.reshape(b, self.num_patches, -1)
 
+    def _patchify_video(self, frames: jnp.ndarray) -> jnp.ndarray:
+        """[B, F, C, S, S] -> [B, Fg*N, C*Tp*P*P]: temporal-group-major,
+        merge-window-major within each group, REAL consecutive-frame
+        temporal patches (the image path duplicates its one frame)."""
+        b, f = frames.shape[:2]
+        tps = self.temporal_patch_size
+        fg = f // tps
+        m, p, ghm = self.merge, self.patch_size, self.grid // self.merge
+        x = frames.reshape(
+            b, fg, tps, self.in_channels, ghm, m, p, ghm, m, p
+        )
+        x = x.transpose(0, 1, 4, 7, 5, 8, 3, 2, 6, 9)
+        return x.reshape(b, fg * self.num_patches, -1)
+
+    def encode_videos(self, params: dict, frames: jnp.ndarray) -> jnp.ndarray:
+        """[B, F, 3, S, S] -> merged features [B, tokens_per_video, Dt].
+        The tower attends across the WHOLE clip (HF semantics); vision
+        rope is spatial-only, tiled per temporal group."""
+        fg = frames.shape[1] // self.temporal_patch_size
+        patches = self._patchify_video(frames)
+        cos, sin = self._vision_rope
+        return self._tower(
+            params, patches,
+            jnp.tile(cos, (fg, 1)), jnp.tile(sin, (fg, 1)),
+            n_groups=fg,
+        )
+
     def encode_images(self, params: dict, images: jnp.ndarray) -> jnp.ndarray:
         """Preprocessed CHW images ``[B, C, S, S]`` -> merged features
         ``[B, tokens_per_image, Dt]``."""
-        vp = params["vision"]
         patches = self._patchify(images)
-        b, n, _ = patches.shape
-        assert n == self.num_patches, (n, self.num_patches)
-        x = patches.astype(self.dtype) @ vp["patch_w"]  # [B, N, Dv]
+        assert patches.shape[1] == self.num_patches
         cos, sin = self._vision_rope
+        return self._tower(params, patches, cos, sin, n_groups=1)
+
+    def _tower(self, params: dict, patches: jnp.ndarray, cos, sin,
+               n_groups: int) -> jnp.ndarray:
+        """Shared ViT body over [B, n_groups*N, patch_dim] patches."""
+        vp = params["vision"]
+        b, n, _ = patches.shape
+        x = patches.astype(self.dtype) @ vp["patch_w"]  # [B, N, Dv]
         hd = self.vision_head_dim
         H = self.vision_heads
 
@@ -353,7 +399,7 @@ class Qwen2VLForConditionalGeneration:
         x, _ = jax.lax.scan(block, x, vp["blocks"])
         x = _layer_norm(x, vp["merger_ln_w"], vp["merger_ln_b"])
         mh = self.vision_dim * self.merge * self.merge
-        x = x.reshape(b, self.tokens_per_image, mh)
+        x = x.reshape(b, n_groups * self.tokens_per_image, mh)
         x = x @ vp["merger_fc1_w"] + vp["merger_fc1_b"]
         x = jax.nn.gelu(x.astype(jnp.float32), approximate=False).astype(
             self.dtype

@@ -29,7 +29,9 @@ class MMInput:
 
     offset: int  # first placeholder position in the expanded prompt
     num_tokens: int  # number of placeholder positions (= encoder tokens)
-    pixel_values: Any = field(repr=False, default=None)  # np [3, H, W] f32
+    # np [3, H, W] f32 (image) or [F, 3, H, W] f32 (video frames).
+    pixel_values: Any = field(repr=False, default=None)
+    is_video: bool = False
     # Encoder-decoder models: the request's encoder token ids (the span
     # is then the single first decoder position, gating WHEN the encoder
     # must have run, not an embedding overlay).
@@ -81,15 +83,59 @@ def preprocess_image(
     return x.transpose(2, 0, 1)  # CHW
 
 
+def preprocess_video(
+    video: Any, image_size: int, num_frames: int,
+    mean: np.ndarray = CLIP_MEAN, std: np.ndarray = CLIP_STD,
+) -> np.ndarray:
+    """Frames (list of HWC images, [F, H, W, 3] array, or ready-made
+    [F, 3, S, S] float) -> normalized ``[num_frames, 3, S, S]`` f32.
+
+    Frame count is FIXED (static tower shapes): longer clips are
+    linearly resampled, shorter ones repeat their last frame.
+    """
+    arr = np.asarray(video) if not isinstance(video, list) else video
+    if (
+        not isinstance(arr, list)
+        and arr.ndim == 4
+        and arr.shape[1] == 3
+        and arr.dtype in (np.float32, np.float64)
+    ):
+        frames = [f for f in arr.astype(np.float32)]
+        ready = True
+    else:
+        frames = list(arr)
+        ready = False
+    if not frames:
+        raise ValueError("empty video")
+    idx = np.linspace(0, len(frames) - 1, num_frames).round().astype(int)
+    picked = [frames[i] for i in idx]
+    if ready:
+        for f in picked:
+            if f.shape != (3, image_size, image_size):
+                raise ValueError(
+                    f"preprocessed video frames must be [3, {image_size}, "
+                    f"{image_size}], got {f.shape}"
+                )
+        return np.stack(picked).astype(np.float32)
+    return np.stack(
+        [preprocess_image(f, image_size, mean, std) for f in picked]
+    )
+
+
 def expand_mm_prompt(
     prompt_token_ids: list[int],
     images: list[Any],
     image_token_id: int,
     tokens_per_image: int,
     image_size: int,
+    videos: list[Any] | None = None,
+    video_token_id: int | None = None,
+    tokens_per_video: int | None = None,
+    video_frames: int | None = None,
 ) -> tuple[list[int], list[MMInput]]:
-    """Replace each image placeholder token with ``tokens_per_image``
-    copies; returns (expanded ids, MMInput per image, in order)."""
+    """Replace each image/video placeholder token with its span of
+    copies; returns (expanded ids, MMInput per item, in prompt order)."""
+    videos = videos or []
     positions = [
         i for i, t in enumerate(prompt_token_ids) if t == image_token_id
     ]
@@ -98,9 +144,21 @@ def expand_mm_prompt(
             f"prompt has {len(positions)} image placeholder(s) but "
             f"{len(images)} image(s) were provided"
         )
+    if video_token_id is not None:
+        v_positions = [
+            i for i, t in enumerate(prompt_token_ids) if t == video_token_id
+        ]
+        if len(v_positions) != len(videos):
+            raise ValueError(
+                f"prompt has {len(v_positions)} video placeholder(s) but "
+                f"{len(videos)} video(s) were provided"
+            )
+    elif videos:
+        raise ValueError("model does not accept video inputs")
     out: list[int] = []
     mm_inputs: list[MMInput] = []
     img_iter = iter(images)
+    vid_iter = iter(videos)
     for i, tok in enumerate(prompt_token_ids):
         if tok == image_token_id:
             mm_inputs.append(MMInput(
@@ -109,6 +167,16 @@ def expand_mm_prompt(
                 pixel_values=preprocess_image(next(img_iter), image_size),
             ))
             out.extend([image_token_id] * tokens_per_image)
+        elif video_token_id is not None and tok == video_token_id:
+            mm_inputs.append(MMInput(
+                offset=len(out),
+                num_tokens=tokens_per_video,
+                pixel_values=preprocess_video(
+                    next(vid_iter), image_size, video_frames
+                ),
+                is_video=True,
+            ))
+            out.extend([video_token_id] * tokens_per_video)
         else:
             out.append(tok)
     return out, mm_inputs

@@ -319,6 +319,8 @@ class ModelRunner:
         self._mm_cache: dict[tuple[str, int], jax.Array] = {}
         if self.is_mm:
             self._encode_fn = jax.jit(self.model.encode_images)
+            if hasattr(self.model, "encode_videos"):
+                self._encode_video_fn = jax.jit(self.model.encode_videos)
         elif self.is_encdec:
             # Encoder forward + cross-KV projection, slot write donated
             # in place (runs once per request, outside the step jit).
@@ -1065,8 +1067,10 @@ class ModelRunner:
             if getattr(self.model, "needs_mrope", False):
                 from vllm_tpu.models.qwen2_vl import mrope_positions
 
+                tpi = self.model.tokens_per_image
                 spans = [
-                    (mi.offset, self.model.llm_grid, self.model.llm_grid)
+                    (mi.offset, mi.num_tokens // tpi,
+                     self.model.llm_grid, self.model.llm_grid)
                     for mi in (new.mm_inputs or [])
                 ]
                 self.input_batch.req_states[new.req_id].mrope = (
@@ -1106,8 +1110,10 @@ class ModelRunner:
         if getattr(self.model, "needs_mrope", False):
             from vllm_tpu.models.qwen2_vl import mrope_positions
 
+            tpi = self.model.tokens_per_image
             spans = [
-                (mi.offset, self.model.llm_grid, self.model.llm_grid)
+                (mi.offset, mi.num_tokens // tpi,
+                 self.model.llm_grid, self.model.llm_grid)
                 for mi in (req.mm_inputs or [])
             ]
             self.input_batch.req_states[req_id].mrope = mrope_positions(
@@ -1162,10 +1168,14 @@ class ModelRunner:
                 )
                 continue
             for i in idxs:
-                pixels = jnp.asarray(state.mm_inputs[i].pixel_values)
-                self._mm_cache[(rid, i)] = self._encode_fn(
-                    self.params, pixels[None]
-                )[0]
+                mi = state.mm_inputs[i]
+                pixels = jnp.asarray(mi.pixel_values)
+                fn = (
+                    self._encode_video_fn
+                    if getattr(mi, "is_video", False)
+                    else self._encode_fn
+                )
+                self._mm_cache[(rid, i)] = fn(self.params, pixels[None])[0]
 
     def _prepare_inputs(self, so: SchedulerOutput):
         batch = self.input_batch
