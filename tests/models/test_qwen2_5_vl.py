@@ -251,3 +251,35 @@ def test_vision_tower_video_matches_hf(tiny_qwen25vl):
         ).numpy()
     assert want.shape == got.shape
     np.testing.assert_allclose(got, want, rtol=3e-3, atol=3e-3)
+
+
+def test_video_mrope_t_interval_matches_hf(tiny_qwen25vl):
+    """Qwen2.5-VL temporal positions step by tokens_per_second (HF
+    get_rope_index with second_per_grid_ts defaulted) — review finding:
+    without the interval every post-video position diverges."""
+    import torch
+    from transformers import Qwen2_5_VLForConditionalGeneration
+
+    import jax.numpy as jnp
+
+    from vllm_tpu.models.qwen2_5_vl import Qwen25VLForConditionalGeneration as JaxVL
+    from vllm_tpu.models.qwen2_vl import mrope_positions
+
+    VID = 123
+    tokens = 2 * TPI  # t_groups * spatial
+    ids = [5, 11, VSTART] + [VID] * tokens + [VEND, 23, 42]
+    hf = Qwen2_5_VLForConditionalGeneration.from_pretrained(tiny_qwen25vl)
+    hf.config.video_token_id = VID
+    want, want_delta = hf.model.get_rope_index(
+        torch.tensor([ids]),
+        video_grid_thw=torch.tensor([[2, 8, 8]]),
+        second_per_grid_ts=None,
+    )
+    from transformers import AutoConfig
+
+    model = JaxVL(AutoConfig.from_pretrained(tiny_qwen25vl), jnp.float32)
+    got, delta = mrope_positions(
+        len(ids), [(3, 2, 4, 4, model.video_t_step)]
+    )
+    np.testing.assert_array_equal(got, want[:, 0].numpy())
+    assert delta == int(want_delta[0])

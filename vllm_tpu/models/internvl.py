@@ -232,12 +232,6 @@ class InternVLForConditionalGeneration:
                 "model.language_model."
             ):
                 m[k[len("model."):]] = m[k]
-        for hf, dest in self.lang.hf_weight_map().items():
-            if hf.startswith("model."):
-                alias = "language_model." + hf
-            else:
-                alias = "language_model." + hf  # lm_head.weight etc.
-            m[alias] = (f"language.{dest[0]}", dest[1])
         return m
 
     def postprocess_weight(self, leaf_path: str, arr):

@@ -51,6 +51,10 @@ class Qwen25VLForConditionalGeneration(Qwen2VLForConditionalGeneration):
         self.fullatt_blocks = set(
             getattr(vc, "fullatt_block_indexes", None) or []
         )
+        # HF get_rope_index: t_index = arange(t) * second_per_grid_t *
+        # tokens_per_second; with no fps metadata second_per_grid defaults
+        # to 1.0 (the HF None case), leaving the integer interval below.
+        self.video_t_step = int(getattr(vc, "tokens_per_second", 2))
         window_px = getattr(vc, "window_size", 112)
         wu = max(1, window_px // (self.merge * self.patch_size))
         if self.llm_grid % wu:

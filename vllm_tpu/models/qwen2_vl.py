@@ -68,25 +68,30 @@ def mrope_positions(
     cursor = 0  # next position value for text
     idx = 0
     for span in sorted(spans):
-        off, tg, lh, lw = (
-            span if len(span) == 4 else (span[0], 1, span[1], span[2])
-        )
+        if len(span) == 3:
+            off, tg, lh, lw, t_step = span[0], 1, span[1], span[2], 1
+        elif len(span) == 4:
+            (off, tg, lh, lw), t_step = span, 1
+        else:
+            off, tg, lh, lw, t_step = span
         # Text run before the image/video.
         n_text = off - idx
         for j in range(n_text):
             pos3[:, idx + j] = cursor + j
         cursor += n_text
         idx = off
-        # Grid: t per temporal group, h rows, w cols (tiled per group).
+        # Grid: t per temporal group (scaled by t_step = tokens_per_second
+        # x second_per_grid, the Qwen2.5-VL interval; 1 for images and the
+        # 2-VL family), h rows, w cols (tiled per group).
         n_spatial = lh * lw
         n_tok = tg * n_spatial
-        t_pos = np.repeat(np.arange(tg), n_spatial) + cursor
+        t_pos = np.repeat(np.arange(tg) * t_step, n_spatial) + cursor
         h_pos = np.tile(np.repeat(np.arange(lh), lw), tg) + cursor
         w_pos = np.tile(np.tile(np.arange(lw), lh), tg) + cursor
         pos3[0, idx : idx + n_tok] = t_pos
         pos3[1, idx : idx + n_tok] = h_pos
         pos3[2, idx : idx + n_tok] = w_pos
-        cursor += max(tg, lh, lw)
+        cursor += max((tg - 1) * t_step + 1, lh, lw)
         idx += n_tok
     for j in range(prompt_len - idx):
         pos3[:, idx + j] = cursor + j
@@ -107,6 +112,9 @@ class Qwen2VLForConditionalGeneration:
     # Fixed video frame count (static tower shapes): clips are linearly
     # resampled to this many frames; temporal groups = frames / tps.
     default_video_frames = 8
+    # Temporal m-rope interval per group (Qwen2.5-VL scales by
+    # tokens_per_second; the 2-VL family steps by 1).
+    video_t_step = 1
 
     def __init__(self, hf_config: Any, dtype=jnp.bfloat16,
                  quantization: str | None = None) -> None:

@@ -1068,9 +1068,11 @@ class ModelRunner:
                 from vllm_tpu.models.qwen2_vl import mrope_positions
 
                 tpi = self.model.tokens_per_image
+                vstep = getattr(self.model, "video_t_step", 1)
                 spans = [
                     (mi.offset, mi.num_tokens // tpi,
-                     self.model.llm_grid, self.model.llm_grid)
+                     self.model.llm_grid, self.model.llm_grid,
+                     vstep if getattr(mi, "is_video", False) else 1)
                     for mi in (new.mm_inputs or [])
                 ]
                 self.input_batch.req_states[new.req_id].mrope = (
@@ -1111,9 +1113,11 @@ class ModelRunner:
             from vllm_tpu.models.qwen2_vl import mrope_positions
 
             tpi = self.model.tokens_per_image
+            vstep = getattr(self.model, "video_t_step", 1)
             spans = [
                 (mi.offset, mi.num_tokens // tpi,
-                 self.model.llm_grid, self.model.llm_grid)
+                 self.model.llm_grid, self.model.llm_grid,
+                 vstep if getattr(mi, "is_video", False) else 1)
                 for mi in (req.mm_inputs or [])
             ]
             self.input_batch.req_states[req_id].mrope = mrope_positions(
