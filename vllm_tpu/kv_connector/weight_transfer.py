@@ -119,11 +119,24 @@ def push_weights(
     addr: tuple[str, int],
     leaves: Iterable[tuple[str, np.ndarray]],
     timeout: float = 300.0,
+    connect_timeout: float = 30.0,
 ) -> None:
     """Trainer side: stream ``(path, array)`` pairs to a listening
     engine. ``ml_dtypes`` dtypes (bfloat16, fp8) ride their numpy dtype
-    names."""
-    conn = socket.create_connection(addr, timeout=timeout)
+    names. Connects with RETRY for up to ``connect_timeout``: the engine
+    binds its listener only after draining in-flight steps, so the
+    trainer naturally races the bind."""
+    import time
+
+    deadline = time.monotonic() + connect_timeout
+    while True:
+        try:
+            conn = socket.create_connection(addr, timeout=timeout)
+            break
+        except (ConnectionRefusedError, OSError):
+            if time.monotonic() >= deadline:
+                raise
+            time.sleep(0.1)
     conn.settimeout(timeout)
     try:
         conn.sendall(MAGIC)
