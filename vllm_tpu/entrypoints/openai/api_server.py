@@ -372,8 +372,19 @@ async def handle_embeddings(request: web.Request) -> web.Response:
         prompts = _normalize_prompts(inputs)
         from vllm_tpu.sampling_params import PoolingParams, SamplingParams
 
+        # Encoder-only models (BERT family) embed via the CLS pooler by
+        # convention; causal LMs via the last-token hidden.
+        default_pool = "last"
+        try:
+            cls = engine.input_processor._model_class()
+            if getattr(cls, "is_encoder_only", False) and not getattr(
+                cls, "classifier_head", False
+            ):
+                default_pool = "cls"
+        except Exception:  # noqa: BLE001 - resolution is best-effort
+            pass
         pooling = PoolingParams(
-            pooling_type=body.get("pooling_type", "last"),
+            pooling_type=body.get("pooling_type", default_pool),
             normalize=bool(body.get("normalize", True)),
         )
     except (ValidationError, ValueError, TypeError) as e:
