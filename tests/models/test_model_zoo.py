@@ -54,6 +54,19 @@ def make_qwen3_moe(tmp_path_factory):
     return _save(tmp_path_factory, "tiny_qwen3moe", Qwen3MoeForCausalLM(cfg))
 
 
+def make_gemma1(tmp_path_factory):
+    import torch
+    from transformers import GemmaConfig, GemmaForCausalLM
+
+    torch.manual_seed(12)
+    cfg = GemmaConfig(
+        vocab_size=128, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=3, num_attention_heads=4, num_key_value_heads=2,
+        head_dim=16, max_position_embeddings=256,
+    )
+    return _save(tmp_path_factory, "tiny_gemma1", GemmaForCausalLM(cfg))
+
+
 def make_gemma2(tmp_path_factory):
     import torch
     from transformers import Gemma2Config, Gemma2ForCausalLM
@@ -220,6 +233,7 @@ def make_dbrx(tmp_path_factory):
 MAKERS = {
     "qwen3": make_qwen3,
     "qwen3_moe": make_qwen3_moe,
+    "gemma1": make_gemma1,
     "gemma2": make_gemma2,
     "gemma3": make_gemma3,
     "cohere": make_cohere,

@@ -273,3 +273,102 @@ class Gemma3TextOnlyFromVLM(Gemma3ForCausalLM):
             "rejected; text behavior matches Gemma3ForCausalLM."
         )
         super().__init__(hf_config, dtype, quantization)
+
+
+class GemmaForCausalLM(Gemma2ForCausalLM):
+    """Gemma-1 (reference: ``vllm/model_executor/models/gemma.py``): the
+    two-norm pre-norm layout (no post-sublayer norms, no windows, no
+    soft caps) with the Gemma family's shared quirks — sqrt(D) embedding
+    scale, zero-centered (1+w) RMSNorm weights, tanh-GeGLU MLP, tied
+    embeddings."""
+
+    def __init__(self, hf_config: Any, dtype=jnp.bfloat16,
+                 quantization: str | None = None) -> None:
+        super().__init__(hf_config, dtype, quantization)
+        self.attn_soft_cap = None
+        self.final_soft_cap = None
+        self.window = None
+        self.scale = 1.0 / math.sqrt(self.head_dim)
+
+    def init_dummy_params(self, rng: jax.Array, dtype=None) -> dict:
+        params = super().init_dummy_params(rng, dtype)
+        layers = params["layers"]
+        # Two-norm layout: post_norm (pre-ffn) instead of gemma-2's three
+        # extra norms.
+        L, D = self.num_layers, self.hidden_size
+        layers["post_norm"] = jnp.ones((L, D), dtype or self.dtype)
+        for k in ("post_attn_norm", "pre_ffn_norm", "post_ffn_norm"):
+            del layers[k]
+        return params
+
+    def hf_weight_map(self) -> dict:
+        m = super().hf_weight_map()
+        for i in range(self.num_layers):
+            for hf in ("pre_feedforward_layernorm",
+                       "post_feedforward_layernorm"):
+                m.pop(f"model.layers.{i}.{hf}.weight", None)
+            m[f"model.layers.{i}.post_attention_layernorm.weight"] = (
+                f"layers.post_norm.{i}", False)
+        return m
+
+    def postprocess_weight(self, dest: str, arr: np.ndarray) -> np.ndarray:
+        leaf = dest.split(".")[-2] if dest.split(".")[-1].isdigit() else dest
+        name = leaf.split(".")[-1]
+        if name in ("input_norm", "post_norm") or dest == "final_norm":
+            return np.asarray(arr, np.float32) + 1.0
+        return arr
+
+    def param_shardings(self, data_axis: str | None = None,
+                        model_axis: str = "tp") -> dict:
+        out = LlamaForCausalLM.param_shardings(self, data_axis, model_axis)
+        out["layers"].pop("lora_a_wq", None)  # no LoRA leaves
+        out.pop("lm_head", None)
+        return out
+
+    def apply(
+        self,
+        params: dict,
+        kv_cache: jnp.ndarray,
+        input_ids: jnp.ndarray,
+        md: AttentionMetadata,
+        token_lora_slot: jnp.ndarray | None = None,  # unused
+    ) -> tuple[jnp.ndarray, jnp.ndarray]:
+        from vllm_tpu.layers.quant import embedding_lookup
+
+        x = embedding_lookup(params["embed"], input_ids, self.dtype)
+        x = x * jnp.asarray(math.sqrt(self.hidden_size), self.dtype)
+        t = x.shape[0]
+        H, KH, Dh = self.num_heads, self.num_kv_heads, self.head_dim
+
+        def layer_fn(carry, inputs):
+            x, kv = carry
+            lp, li = inputs
+            h = rms_norm(x, lp["input_norm"], self.rms_eps)
+            q = (h @ lp["wq"]).reshape(t, H, Dh)
+            k = (h @ lp["wk"]).reshape(t, KH, Dh)
+            v = (h @ lp["wv"]).reshape(t, KH, Dh)
+            cos, sin = self._rope(li, md.positions)
+            q = _apply_rotate_half(q, cos, sin, Dh)
+            k = _apply_rotate_half(k, cos, sin, Dh)
+            kv = write_kv(kv, li, k, v, md.slot_mapping)
+            attn = paged_attention(
+                q, kv, li, md, self.scale,
+                k_scale=kv_dequant_scale(kv), v_scale=kv_dequant_scale(kv),
+            )
+            x = x + attn.reshape(t, H * Dh) @ lp["wo"]
+
+            h2 = rms_norm(x, lp["post_norm"], self.rms_eps)
+            gate = h2 @ lp["wgate"]
+            up = h2 @ lp["wup"]
+            x = x + gelu_and_mul(
+                jnp.concatenate([gate, up], axis=-1)
+            ) @ lp["wdown"]
+            return (x, kv), None
+
+        (x, new_kv), _ = jax.lax.scan(
+            layer_fn,
+            (x, kv_cache),
+            (params["layers"], jnp.arange(self.num_layers, dtype=jnp.int32)),
+        )
+        x = rms_norm(x, params["final_norm"], self.rms_eps)
+        return x, new_kv

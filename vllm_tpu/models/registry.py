@@ -22,6 +22,7 @@ _REGISTRY: dict[str, tuple[str, str]] = {
     "Qwen3ForCausalLM": ("vllm_tpu.models.llama", "Qwen3ForCausalLM"),
     "Qwen3MoeForCausalLM": ("vllm_tpu.models.qwen3_moe", "Qwen3MoeForCausalLM"),
     "Qwen2MoeForCausalLM": ("vllm_tpu.models.qwen3_moe", "Qwen2MoeForCausalLM"),
+    "GemmaForCausalLM": ("vllm_tpu.models.gemma", "GemmaForCausalLM"),
     "Gemma2ForCausalLM": ("vllm_tpu.models.gemma", "Gemma2ForCausalLM"),
     "Gemma3ForCausalLM": ("vllm_tpu.models.gemma", "Gemma3ForCausalLM"),
     "Gemma3ForConditionalGeneration": ("vllm_tpu.models.gemma", "Gemma3TextOnlyFromVLM"),
