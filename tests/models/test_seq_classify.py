@@ -1,0 +1,102 @@
+"""Causal-LM classification / reward heads: HF parity + engine e2e.
+
+Reference analog: the *ForSequenceClassification adapters + reward
+poolers (``vllm/model_executor/layers/pooler/``).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+
+@pytest.fixture(scope="module")
+def llama_cls_ckpt(tmp_path_factory):
+    import torch
+    from transformers import LlamaForSequenceClassification
+
+    from tests.models.utils import tiny_llama_config
+
+    torch.manual_seed(0)
+    cfg = tiny_llama_config()
+    cfg.num_labels = 3
+    cfg.pad_token_id = 0
+    hf = LlamaForSequenceClassification(cfg).to(torch.float32)
+    path = str(tmp_path_factory.mktemp("tiny_llama_cls"))
+    hf.save_pretrained(path, safe_serialization=True)
+    return path
+
+
+def test_llama_classify_matches_hf(llama_cls_ckpt):
+    """Engine 'classify' pooling equals HF's last-token score logits."""
+    import torch
+    from transformers import LlamaForSequenceClassification
+
+    from vllm_tpu import LLM, SamplingParams
+    from vllm_tpu.sampling_params import PoolingParams
+
+    llm = LLM(
+        model=llama_cls_ckpt, dtype="float32", max_model_len=64,
+        block_size=16, num_gpu_blocks_override=32, max_num_seqs=4,
+        max_num_batched_tokens=64,
+    )
+    rng = np.random.default_rng(1)
+    prompts = [rng.integers(5, 120, size=n).tolist() for n in (9, 4, 13)]
+    outs = llm.embed(
+        [{"prompt_token_ids": p} for p in prompts],
+        PoolingParams(pooling_type="classify", normalize=False),
+    )
+    hf = LlamaForSequenceClassification.from_pretrained(
+        llama_cls_ckpt, torch_dtype=torch.float32
+    )
+    hf.eval()
+    for p, o in zip(prompts, outs):
+        with torch.no_grad():
+            want = hf(torch.tensor([p])).logits[0].numpy()
+        np.testing.assert_allclose(
+            np.asarray(o.pooled), want, rtol=1e-3, atol=1e-3
+        )
+
+    # Generation on a classification checkpoint is rejected loudly.
+    with pytest.raises(Exception, match="pooling"):
+        llm.generate(
+            [{"prompt_token_ids": prompts[0]}],
+            SamplingParams(max_tokens=2),
+        )
+
+
+def test_reward_head_single_label(tmp_path_factory):
+    """num_labels=1 (reward model shape): one scalar per request."""
+    import torch
+    from transformers import Qwen2Config, Qwen2ForSequenceClassification
+
+    from vllm_tpu import LLM
+    from vllm_tpu.sampling_params import PoolingParams
+
+    torch.manual_seed(1)
+    cfg = Qwen2Config(
+        vocab_size=128, hidden_size=48, intermediate_size=96,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=128, num_labels=1, pad_token_id=0,
+        tie_word_embeddings=False,
+    )
+    hf = Qwen2ForSequenceClassification(cfg).to(torch.float32)
+    path = str(tmp_path_factory.mktemp("tiny_qwen_reward"))
+    hf.save_pretrained(path, safe_serialization=True)
+    hf.eval()
+
+    llm = LLM(
+        model=path, dtype="float32", max_model_len=64, block_size=16,
+        num_gpu_blocks_override=32, max_num_seqs=2,
+        max_num_batched_tokens=64,
+    )
+    p = [7, 3, 19, 22, 4]
+    [out] = llm.embed(
+        [{"prompt_token_ids": p}],
+        PoolingParams(pooling_type="classify", normalize=False),
+    )
+    with torch.no_grad():
+        want = hf(torch.tensor([p])).logits[0].numpy()
+    assert len(out.pooled) == 1
+    np.testing.assert_allclose(np.asarray(out.pooled), want, rtol=1e-3,
+                               atol=1e-3)

@@ -279,9 +279,12 @@ class InputProcessor:
 
         model_cls = self._model_class()
         encoder_only = getattr(model_cls, "is_encoder_only", False)
-        if encoder_only and pooling_params is None:
+        pooling_only = encoder_only or getattr(
+            model_cls, "pooling_only", False
+        )
+        if pooling_only and pooling_params is None:
             raise ValueError(
-                "encoder-only models serve pooling/scoring requests only "
+                "this model serves pooling/scoring requests only "
                 "(no generation); pass pooling_params"
             )
         if pooling_params is not None:

@@ -69,6 +69,11 @@ _REGISTRY: dict[str, tuple[str, str]] = {
     "GraniteMoeForCausalLM": ("vllm_tpu.models.moe_zoo", "GraniteMoeForCausalLM"),
     "DbrxForCausalLM": ("vllm_tpu.models.moe_zoo", "DbrxForCausalLM"),
     "GptOssForCausalLM": ("vllm_tpu.models.gpt_oss", "GptOssForCausalLM"),
+    "LlamaForSequenceClassification": ("vllm_tpu.models.seq_classify", "LlamaForSequenceClassification"),
+    "MistralForSequenceClassification": ("vllm_tpu.models.seq_classify", "MistralForSequenceClassification"),
+    "Qwen2ForSequenceClassification": ("vllm_tpu.models.seq_classify", "Qwen2ForSequenceClassification"),
+    "Qwen3ForSequenceClassification": ("vllm_tpu.models.seq_classify", "Qwen3ForSequenceClassification"),
+    "Gemma2ForSequenceClassification": ("vllm_tpu.models.seq_classify", "Gemma2ForSequenceClassification"),
 }
 
 
