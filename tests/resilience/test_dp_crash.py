@@ -1,0 +1,93 @@
+"""Fault-injection e2e, DP flavor: SIGKILL one of two DP engine cores and
+assert degraded-mode serving — the interrupted request is replayed onto a
+surviving rank, new requests keep flowing while the crashed rank
+re-initializes in the background, and the rank rejoins on READY.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import os
+import signal
+import time
+
+import pytest
+
+from tests.models.utils import tiny_llama_dir
+from vllm_tpu.engine.arg_utils import AsyncEngineArgs
+from vllm_tpu.engine.async_llm import AsyncLLM
+from vllm_tpu.engine.core_client import DPLBClient
+from vllm_tpu.sampling_params import RequestOutputKind, SamplingParams
+
+pytestmark = pytest.mark.fault_injection
+
+
+@pytest.fixture(scope="module")
+def ckpt(tmp_path_factory):
+    return tiny_llama_dir(tmp_path_factory.mktemp("tiny_llama_dp_fault"))
+
+
+def test_dp_rank_crash_serves_degraded_and_rejoins(ckpt):
+    engine = AsyncLLM.from_engine_args(
+        AsyncEngineArgs(
+            model=ckpt, dtype="float32", max_model_len=128, block_size=16,
+            num_gpu_blocks_override=64, max_num_seqs=4,
+            max_num_batched_tokens=128, data_parallel_engines=2,
+            enable_engine_recovery=True, max_engine_restarts=2,
+            max_request_retries=2, restart_backoff_s=0.05,
+        )
+    )
+    client = engine.engine_core
+    assert isinstance(client, DPLBClient)
+
+    async def stream(rid, max_tokens, kill=False):
+        sp = SamplingParams(
+            temperature=0.0, max_tokens=max_tokens, ignore_eos=True,
+            output_kind=RequestOutputKind.DELTA,
+        )
+        tokens, killed = [], False
+        async for out in engine.generate(
+            {"prompt_token_ids": [5, 9, 11]}, sp, rid
+        ):
+            tokens.extend(out.outputs[0].token_ids)
+            if kill and not killed and len(tokens) >= 2:
+                killed = True
+                eid = client._live[rid]
+                os.kill(client._procs[eid].pid, signal.SIGKILL)
+        return tokens
+
+    async def run():
+        # Kill the rank serving crash-dp mid-stream: the journal replays
+        # it and degraded routing sends the resume to a surviving rank,
+        # so the stream completes long before the dead rank reloads.
+        tokens = await stream("crash-dp", 12, kill=True)
+        assert len(tokens) == 12
+        # Serving continues (possibly degraded) for fresh requests.
+        more = await asyncio.gather(
+            stream("post-0", 6), stream("post-1", 6))
+        assert all(len(t) == 6 for t in more)
+
+    try:
+        asyncio.run(asyncio.wait_for(run(), timeout=300))
+        status = engine.resilience_status()
+        assert sum(
+            e["restarts"] for e in status["engines"].values()
+        ) == 1
+        assert status["requests_replayed_total"] == 1
+        # The crashed rank re-initializes in the background and rejoins.
+        deadline = time.monotonic() + 240
+        while time.monotonic() < deadline:
+            if engine.is_ready():
+                break
+            # READY frames are consumed by the busy-loop thread; nudge it
+            # even when idle by polling through a request.
+            asyncio.run(stream(f"nudge-{time.monotonic()}", 1))
+            time.sleep(0.5)
+        status = engine.resilience_status()
+        assert all(e["up"] for e in status["engines"].values()), status
+        assert engine.is_ready()
+    finally:
+        try:
+            engine.shutdown()
+        except Exception:
+            pass

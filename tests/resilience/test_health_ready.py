@@ -1,0 +1,99 @@
+"""/health (JSON liveness) and /ready (readiness) endpoint tests against a
+stub engine — no model, tier-1 fast."""
+
+from __future__ import annotations
+
+import asyncio
+
+from vllm_tpu.entrypoints.openai.api_server import build_app
+from vllm_tpu.metrics.prometheus import PrometheusRegistry
+
+
+class StubEngine:
+    def __init__(self, *, dead=False, engines=None, ready=True,
+                 replayed=0, failed=0):
+        self._dead = dead
+        self._engines = engines if engines is not None else {
+            "0": {"up": True, "restarts": 0},
+        }
+        self._ready = ready
+        self._replayed = replayed
+        self._failed = failed
+
+    def resilience_status(self):
+        return {
+            "engine_dead": self._dead,
+            "recovery_enabled": True,
+            "engines": self._engines,
+            "requests_replayed_total": self._replayed,
+            "requests_failed_on_crash_total": self._failed,
+        }
+
+    def is_ready(self):
+        return self._ready and not self._dead
+
+
+def _get(engine, path, metrics=None):
+    from aiohttp.test_utils import TestClient, TestServer
+
+    async def run():
+        app = build_app(engine, "stub", metrics)
+        async with TestClient(TestServer(app)) as client:
+            resp = await client.get(path)
+            body = (await resp.json()) if path != "/metrics" else (
+                await resp.text()
+            )
+            return resp.status, body
+
+    return asyncio.run(run())
+
+
+def test_health_healthy():
+    status, body = _get(StubEngine(), "/health")
+    assert status == 200
+    assert body["status"] == "healthy"
+    assert body["engines"] == {"0": {"up": True, "restarts": 0}}
+    assert body["requests_replayed_total"] == 0
+
+
+def test_health_degraded_reports_down_engine():
+    engine = StubEngine(engines={
+        "0": {"up": True, "restarts": 0},
+        "1": {"up": False, "restarts": 2},
+    }, ready=False, replayed=3, failed=1)
+    status, body = _get(engine, "/health")
+    # Degraded DP still serves: liveness stays 200, detail shows which
+    # rank is down and its restart count.
+    assert status == 200
+    assert body["status"] == "degraded"
+    assert body["engines"]["1"] == {"up": False, "restarts": 2}
+    assert body["requests_replayed_total"] == 3
+    assert body["requests_failed_on_crash_total"] == 1
+
+
+def test_health_dead_is_503():
+    status, body = _get(StubEngine(dead=True), "/health")
+    assert status == 503
+    assert body["status"] == "dead"
+
+
+def test_ready_tracks_engine_readiness():
+    assert _get(StubEngine(), "/ready") == (200, {"ready": True})
+    status, body = _get(StubEngine(ready=False), "/ready")
+    assert (status, body) == (503, {"ready": False})
+    assert _get(StubEngine(dead=True), "/ready")[0] == 503
+
+
+def test_metrics_reflect_resilience_status():
+    engine = StubEngine(engines={
+        "0": {"up": True, "restarts": 1},
+        "1": {"up": False, "restarts": 2},
+    }, replayed=4, failed=2)
+    reg = PrometheusRegistry(engine)
+    status, text = _get(engine, "/metrics", metrics=reg)
+    assert status == 200
+    assert 'vllm:engine_up{engine_id="0"} 1.0' in text
+    assert 'vllm:engine_up{engine_id="1"} 0.0' in text
+    assert 'vllm:engine_restarts_total{engine_id="1"} 2.0' in text
+    assert "vllm:requests_replayed_total 4.0" in text
+    assert "vllm:requests_failed_on_crash_total 2.0" in text

@@ -1,0 +1,94 @@
+"""RequestJournal / JournalEntry unit tests (vllm_tpu/resilience/journal.py).
+
+Pure frontend state — no engine, no model, tier-1 fast.
+"""
+
+from __future__ import annotations
+
+import pytest
+
+from vllm_tpu.request import EngineCoreRequest
+from vllm_tpu.resilience import RequestJournal
+from vllm_tpu.sampling_params import SamplingParams, StructuredOutputParams
+
+
+def _req(rid="r1", prompt=(1, 2, 3), **params):
+    params.setdefault("max_tokens", 8)
+    return EngineCoreRequest(
+        request_id=rid,
+        prompt_token_ids=list(prompt),
+        sampling_params=SamplingParams(**params),
+        eos_token_id=7,
+        priority=2,
+    )
+
+
+def test_record_lifecycle():
+    j = RequestJournal()
+    j.record_admitted(_req())
+    assert len(j) == 1
+    j.record_tokens("r1", [10, 11])
+    j.record_tokens("r1", [12])
+    assert j.get("r1").emitted_token_ids == [10, 11, 12]
+    # Tokens for unknown ids are ignored (request finished/aborted races).
+    j.record_tokens("ghost", [1])
+    j.record_finished("r1")
+    assert j.get("r1") is None and len(j) == 0
+
+
+def test_discard_and_counters():
+    j = RequestJournal()
+    j.record_admitted(_req("a"))
+    j.record_admitted(_req("b"))
+    j.discard("a")
+    assert j.get("a") is None
+    j.note_replayed("b")
+    assert j.get("b").retries == 1
+    assert j.requests_replayed_total == 1
+    j.note_failed("b")
+    assert j.get("b") is None
+    assert j.requests_failed_on_crash_total == 1
+
+
+def test_remaining_tokens():
+    j = RequestJournal()
+    entry = j.record_admitted(_req(max_tokens=4))
+    assert entry.remaining_tokens == 4
+    j.record_tokens("r1", [5, 6, 7, 8])
+    assert entry.remaining_tokens == 0
+    unbounded = j.record_admitted(_req("u", max_tokens=None))
+    assert unbounded.remaining_tokens is None
+
+
+def test_make_resume_request_extends_prompt_and_decrements_budget():
+    j = RequestJournal()
+    j.record_admitted(_req(max_tokens=8, min_tokens=3))
+    j.record_tokens("r1", [10, 11])
+    resume = j.get("r1").make_resume_request()
+    # Same id: the frontend stream/detokenizer state keys on it.
+    assert resume.request_id == "r1"
+    assert resume.prompt_token_ids == [1, 2, 3, 10, 11]
+    assert resume.sampling_params.max_tokens == 6
+    assert resume.sampling_params.min_tokens == 1
+    assert resume.eos_token_id == 7 and resume.priority == 2
+    # The original params must not be mutated (a second crash resumes
+    # from the journal again, re-decrementing from the original budget).
+    assert j.get("r1").sampling_params.max_tokens == 8
+
+
+def test_make_resume_request_requires_remaining_budget():
+    j = RequestJournal()
+    j.record_admitted(_req(max_tokens=2))
+    j.record_tokens("r1", [10, 11])
+    with pytest.raises(AssertionError):
+        j.get("r1").make_resume_request()
+
+
+def test_structured_outputs_not_replayable():
+    j = RequestJournal()
+    j.record_admitted(_req(
+        "so", structured_outputs=StructuredOutputParams(regex="a+"),
+    ))
+    j.record_admitted(_req("plain"))
+    assert not j.get("so").replayable
+    assert j.get("plain").replayable

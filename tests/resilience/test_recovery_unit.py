@@ -1,0 +1,283 @@
+"""AsyncLLM crash-recovery logic against a scripted fake engine client.
+
+No model, no subprocess, no ZMQ — the fake client raises
+EngineRestartedError on a scripted schedule exactly like
+``_ZMQClientBase._handle_engine_death`` does after a successful respawn,
+so the full busy-loop -> journal-replay -> stream-continuation path runs
+in milliseconds (tier-1).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import queue
+import threading
+
+import pytest
+
+from vllm_tpu.core.sched_output import EngineCoreOutput, EngineCoreOutputs
+from vllm_tpu.engine.async_llm import AsyncLLM
+from vllm_tpu.engine.output_processor import OutputProcessor
+from vllm_tpu.request import EngineCoreRequest
+from vllm_tpu.resilience import (
+    EngineRestartedError,
+    RequestFailedOnCrashError,
+    RequestJournal,
+    ResilienceConfig,
+)
+from vllm_tpu.sampling_params import (
+    RequestOutputKind,
+    SamplingParams,
+    StructuredOutputParams,
+)
+
+
+class FakeClient:
+    """Scripted engine-core client.
+
+    Emits one deterministic token per live request per ``get_output`` call
+    (token value = current sequence length, so a resumed request — whose
+    prompt was extended with the emitted prefix — continues the exact same
+    sequence). After ``crash_after`` calls it raises EngineRestartedError
+    once, dropping every live request, mimicking a respawned engine.
+    """
+
+    def __init__(self, crash_after=None):
+        self.crash_after = crash_after
+        self.calls = 0
+        self.added = []       # every add_request, including resumes
+        self.aborted = []
+        self.restarts = 0
+        self._live = {}       # rid -> [req, tokens_done_this_incarnation]
+        self.inflight = False
+
+    def add_request(self, req):
+        self.added.append(req)
+        self._live[req.request_id] = [req, 0]
+
+    def abort_requests(self, rids):
+        for rid in rids:
+            self._live.pop(rid, None)
+            self.aborted.append(rid)
+
+    def has_unfinished_requests(self):
+        return bool(self._live)
+
+    def get_output(self, timeout=None):
+        self.calls += 1
+        if (self.crash_after is not None and self.calls > self.crash_after
+                and self._live):
+            self.crash_after = None  # crash once
+            self.restarts += 1
+            lost = sorted(self._live)
+            self._live.clear()
+            raise EngineRestartedError(lost, engine_id=0)
+        outs = []
+        for rid, slot in list(self._live.items()):
+            req, done = slot
+            tok = len(req.prompt_token_ids) + done
+            slot[1] = done = done + 1
+            finish = (req.sampling_params.max_tokens is not None
+                      and done >= req.sampling_params.max_tokens)
+            outs.append(EngineCoreOutput(
+                req_id=rid, new_token_ids=[tok],
+                finish_reason="length" if finish else None,
+            ))
+            if finish:
+                del self._live[rid]
+        return EngineCoreOutputs(outputs=outs)
+
+    def engine_status(self):
+        return {"0": {"up": True, "restarts": self.restarts}}
+
+    def is_ready(self):
+        return True
+
+    def shutdown(self):
+        pass
+
+
+class FakeInputProcessor:
+    tokenizer = None
+
+    def process(self, request_id, prompt, sampling_params, priority=0,
+                pooling_params=None):
+        return EngineCoreRequest(
+            request_id=request_id,
+            prompt_token_ids=list(prompt["prompt_token_ids"]),
+            sampling_params=sampling_params,
+            priority=priority,
+            pooling_params=pooling_params,
+        )
+
+
+def make_engine(client, *, recovery=True, max_request_retries=1,
+                start=True):
+    """AsyncLLM wired to the fake client/input-processor, bypassing
+    EngineConfig (which wants a real model checkpoint)."""
+    llm = AsyncLLM.__new__(AsyncLLM)
+    llm.config = None
+    llm.resilience = ResilienceConfig(
+        enable_recovery=recovery, max_request_retries=max_request_retries,
+    ).finalize()
+    llm.journal = RequestJournal() if recovery else None
+    llm.engine_core = client
+    llm.input_processor = FakeInputProcessor()
+    llm.output_processor = OutputProcessor(None, journal=llm.journal)
+    llm.stat_loggers = []
+    llm._input_queue = queue.Queue()
+    llm._loop = None
+    llm._dead = False
+    llm._shutdown = threading.Event()
+    llm._thread = None
+    if start:
+        llm.start()
+    return llm
+
+
+def _params(max_tokens, **kw):
+    kw.setdefault("output_kind", RequestOutputKind.DELTA)
+    return SamplingParams(
+        temperature=0.0, max_tokens=max_tokens, ignore_eos=True,
+        detokenize=False, **kw,
+    )
+
+
+async def _collect(llm, rid, max_tokens, **kw):
+    tokens = []
+    async for out in llm.generate(
+        {"prompt_token_ids": [1, 2, 3]}, _params(max_tokens, **kw), rid
+    ):
+        tokens.extend(out.outputs[0].token_ids)
+        if out.finished:
+            return tokens, out
+    return tokens, None
+
+
+def test_replay_resumes_stream_after_crash():
+    client = FakeClient(crash_after=2)
+    llm = make_engine(client)
+    try:
+        tokens, final = asyncio.run(_collect(llm, "r1", 6))
+        # len(prompt)=3 -> uninterrupted sequence is 3,4,5,6,7,8; the
+        # crash after 2 emitted tokens must not duplicate or skip any.
+        assert tokens == [3, 4, 5, 6, 7, 8]
+        assert final.outputs[0].finish_reason == "length"
+        # The resume request carried the extended prompt + shrunk budget.
+        assert [r.request_id for r in client.added] == ["r1", "r1"]
+        resume = client.added[1]
+        assert resume.prompt_token_ids == [1, 2, 3, 3, 4]
+        assert resume.sampling_params.max_tokens == 4
+        assert llm.journal.requests_replayed_total == 1
+        assert llm.journal.requests_failed_on_crash_total == 0
+        assert len(llm.journal) == 0  # finished -> journal entry dropped
+    finally:
+        llm.shutdown()
+
+
+def test_retry_budget_exhausted_fails_request_not_engine():
+    client = FakeClient(crash_after=2)
+    llm = make_engine(client, max_request_retries=0)
+    try:
+        with pytest.raises(RequestFailedOnCrashError) as ei:
+            asyncio.run(_collect(llm, "r1", 6))
+        assert ei.value.request_id == "r1"
+        assert llm.journal.requests_failed_on_crash_total == 1
+        # The engine survived: a fresh request completes normally.
+        tokens, final = asyncio.run(_collect(llm, "r2", 4))
+        assert len(tokens) == 4 and final.finished
+        assert not llm._dead
+    finally:
+        llm.shutdown()
+
+
+def test_structured_output_request_fails_instead_of_replaying():
+    client = FakeClient(crash_after=2)
+    llm = make_engine(client)
+    try:
+        with pytest.raises(RequestFailedOnCrashError) as ei:
+            asyncio.run(_collect(
+                llm, "so", 6,
+                structured_outputs=StructuredOutputParams(regex="a+"),
+            ))
+        assert "structured-output" in str(ei.value)
+        # Never re-added: the grammar FSM can't be re-entered mid-prompt.
+        assert [r.request_id for r in client.added] == ["so"]
+    finally:
+        llm.shutdown()
+
+
+def test_second_crash_consumes_second_retry():
+    # Two crashes, budget of 2: both replays happen, stream completes.
+    client = FakeClient(crash_after=2)
+    llm = make_engine(client, max_request_retries=2)
+    orig_get = client.get_output
+    crashed_twice = []
+
+    def get_output(timeout=None):
+        # Re-arm one more crash after the first recovery replay lands.
+        if client.crash_after is None and not crashed_twice and \
+                len(client.added) == 2 and client._live:
+            crashed_twice.append(True)
+            client.restarts += 1
+            lost = sorted(client._live)
+            client._live.clear()
+            raise EngineRestartedError(lost, engine_id=0)
+        return orig_get(timeout)
+
+    client.get_output = get_output
+    try:
+        tokens, final = asyncio.run(_collect(llm, "r1", 6))
+        assert tokens == [3, 4, 5, 6, 7, 8]
+        assert final.finished
+        assert llm.journal.requests_replayed_total == 2
+    finally:
+        llm.shutdown()
+
+
+def test_completed_budget_closes_as_length_finish():
+    # All max_tokens already emitted when the crash hits: the stream is
+    # closed out as a normal length finish, not replayed or failed.
+    client = FakeClient()
+    llm = make_engine(client, start=False)
+    done_q = queue.Queue()
+
+    class Sink:
+        def put_nowait(self, item):
+            done_q.put(item)
+
+    llm.output_processor.add_request(
+        "r1", None, [1, 2, 3], _params(2), 0.0, queue=Sink())
+    llm.journal.record_admitted(EngineCoreRequest(
+        request_id="r1", prompt_token_ids=[1, 2, 3],
+        sampling_params=_params(2)))
+    llm.journal.record_tokens("r1", [3, 4])
+    llm._recover_requests(EngineRestartedError(["r1"], engine_id=0))
+    out = done_q.get_nowait()
+    assert out.finished and out.outputs[0].finish_reason == "length"
+    assert llm.journal.requests_replayed_total == 0
+    assert llm.journal.requests_failed_on_crash_total == 0
+
+
+def test_lost_id_without_state_is_discarded():
+    # Request aborted while the crash was in flight: no stream to feed,
+    # the stale journal entry is dropped without counting as a failure.
+    client = FakeClient()
+    llm = make_engine(client, start=False)
+    llm.journal.record_admitted(EngineCoreRequest(
+        request_id="gone", prompt_token_ids=[1],
+        sampling_params=_params(4)))
+    llm._recover_requests(EngineRestartedError(["gone"], engine_id=0))
+    assert llm.journal.get("gone") is None
+    assert llm.journal.requests_failed_on_crash_total == 0
+
+
+def test_resilience_status_shape():
+    client = FakeClient()
+    llm = make_engine(client, start=False)
+    status = llm.resilience_status()
+    assert status["engine_dead"] is False
+    assert status["recovery_enabled"] is True
+    assert status["engines"] == {"0": {"up": True, "restarts": 0}}
+    assert status["requests_replayed_total"] == 0
+    assert llm.is_ready()

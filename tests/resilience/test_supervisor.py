@@ -1,0 +1,63 @@
+"""EngineSupervisor policy tests (vllm_tpu/resilience/supervisor.py)."""
+
+from __future__ import annotations
+
+import pytest
+
+from vllm_tpu.resilience import EngineSupervisor, ResilienceConfig
+
+
+def _cfg(**kw):
+    kw.setdefault("enable_recovery", True)
+    return ResilienceConfig(**kw).finalize()
+
+
+def test_recovery_disabled_never_restarts():
+    sup = EngineSupervisor(ResilienceConfig(enable_recovery=False))
+    assert not sup.may_restart(0)
+
+
+def test_restart_budget():
+    sup = EngineSupervisor(_cfg(max_engine_restarts=2))
+    assert sup.may_restart(0)
+    assert sup.record_failure(0) == 1
+    assert sup.may_restart(0)
+    assert sup.record_failure(0) == 2
+    assert not sup.may_restart(0)
+    sup.record_dead(0)
+    assert not sup.is_up(0)
+
+
+def test_backoff_schedule_doubles_and_caps():
+    sup = EngineSupervisor(_cfg(
+        max_engine_restarts=10, restart_backoff_s=0.5,
+        restart_backoff_max_s=3.0,
+    ))
+    assert sup.backoff_s(0) == 0.0  # before any failure
+    observed = []
+    for _ in range(5):
+        sup.record_failure(0)
+        observed.append(sup.backoff_s(0))
+    assert observed == [0.5, 1.0, 2.0, 3.0, 3.0]
+
+
+def test_status_and_liveness_snapshot():
+    sup = EngineSupervisor(_cfg(), num_engines=2)
+    assert sup.all_up()
+    sup.record_failure(1)
+    assert sup.is_up(0) and not sup.is_up(1)
+    assert not sup.all_up()
+    assert sup.status() == {
+        "0": {"up": True, "restarts": 0},
+        "1": {"up": False, "restarts": 1},
+    }
+    sup.record_ready(1)
+    assert sup.all_up()
+    assert sup.status()["1"] == {"up": True, "restarts": 1}
+
+
+def test_config_validation():
+    with pytest.raises(ValueError):
+        ResilienceConfig(max_engine_restarts=-1).finalize()
+    with pytest.raises(ValueError):
+        ResilienceConfig(restart_backoff_s=-0.1).finalize()

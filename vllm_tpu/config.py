@@ -13,6 +13,7 @@ from dataclasses import dataclass, field
 from typing import Any, Literal
 
 from vllm_tpu.logger import init_logger
+from vllm_tpu.resilience.config import ResilienceConfig
 
 logger = init_logger(__name__)
 
@@ -372,9 +373,11 @@ class EngineConfig:
     lora_config: LoRAConfig = field(default_factory=LoRAConfig)
     observability_config: ObservabilityConfig = field(default_factory=ObservabilityConfig)
     compilation_config: CompilationConfig = field(default_factory=CompilationConfig)
+    resilience_config: ResilienceConfig = field(default_factory=ResilienceConfig)
 
     def finalize(self) -> "EngineConfig":
         """Cross-validate and derive dependent fields. Idempotent."""
+        self.resilience_config.finalize()
         mc, sc = self.model_config, self.scheduler_config
         if mc.max_model_len is not None:
             sc.max_model_len = mc.max_model_len

@@ -24,6 +24,7 @@ from vllm_tpu.config import (
     SchedulerConfig,
     SpeculativeConfig,
 )
+from vllm_tpu.resilience.config import ResilienceConfig
 
 
 @dataclass
@@ -82,6 +83,13 @@ class EngineArgs:
     enable_lora: bool = False
     max_lora_rank: int = 16
     max_loras: int = 4
+
+    # Resilience (vllm_tpu/resilience): opt-in engine-core crash recovery.
+    enable_engine_recovery: bool = False
+    max_engine_restarts: int = 3
+    max_request_retries: int = 1
+    restart_backoff_s: float = 0.5
+    heartbeat_timeout_s: float = 0.0
 
     disable_log_stats: bool = False
     precompile: bool = False
@@ -166,6 +174,13 @@ class EngineArgs:
             compilation_config=CompilationConfig(
                 precompile=self.precompile,
                 max_step_compilations=self.max_step_compilations,
+            ),
+            resilience_config=ResilienceConfig(
+                enable_recovery=self.enable_engine_recovery,
+                max_engine_restarts=self.max_engine_restarts,
+                max_request_retries=self.max_request_retries,
+                restart_backoff_s=self.restart_backoff_s,
+                heartbeat_timeout_s=self.heartbeat_timeout_s,
             ),
         )
         # If the model's max length is unknown and unset, derive after the HF

@@ -23,6 +23,11 @@ from vllm_tpu.engine.input_processor import InputProcessor, PromptType
 from vllm_tpu.engine.output_processor import OutputProcessor
 from vllm_tpu.logger import init_logger
 from vllm_tpu.outputs import RequestOutput
+from vllm_tpu.resilience import (
+    EngineRestartedError,
+    RequestFailedOnCrashError,
+    RequestJournal,
+)
 from vllm_tpu.sampling_params import RequestOutputKind, SamplingParams
 
 logger = init_logger(__name__)
@@ -58,10 +63,19 @@ class AsyncStream:
 
 class AsyncLLM:
     def __init__(self, config: EngineConfig, start: bool = True) -> None:
-        self.config = config
-        self.engine_core = make_client(config.finalize())
+        self.config = config = config.finalize()
+        self.resilience = config.resilience_config
+        # Crash-recovery journal: every admitted request's prompt, params
+        # and emitted tokens, so requests in flight on a crashed engine
+        # core can be resumed on its replacement (vllm_tpu/resilience).
+        self.journal = (
+            RequestJournal() if self.resilience.enable_recovery else None
+        )
+        self.engine_core = make_client(config)
         self.input_processor = InputProcessor(config)
-        self.output_processor = OutputProcessor(self.input_processor.tokenizer)
+        self.output_processor = OutputProcessor(
+            self.input_processor.tokenizer, journal=self.journal
+        )
         self.stat_loggers: list[Any] = []
 
         self._input_queue: queue.Queue = queue.Queue()
@@ -116,6 +130,8 @@ class AsyncLLM:
             core_req.arrival_time,
             queue=out_q,
         )
+        if self.journal is not None:
+            self.journal.record_admitted(core_req)
         self._input_queue.put(("add", core_req))
         finished = False
         try:
@@ -130,12 +146,19 @@ class AsyncLLM:
         finally:
             # Generator dropped early (client disconnect) -> abort.
             if not finished:
-                self._input_queue.put(("abort", [request_id]))
-                self.output_processor.abort_requests([request_id])
+                self._abort_requests([request_id])
 
     async def abort(self, request_id: str) -> None:
-        self._input_queue.put(("abort", [request_id]))
-        self.output_processor.abort_requests([request_id])
+        self._abort_requests([request_id])
+
+    def _abort_requests(self, request_ids: list[str]) -> None:
+        """Frontend-side cleanup always runs; the engine-side abort is
+        only enqueued while the engine is alive — a dead engine has no
+        request state to abort, and piling aborts onto its queue would
+        never drain."""
+        self.output_processor.abort_requests(request_ids)
+        if not self._dead:
+            self._input_queue.put(("abort", request_ids))
 
     # ------------------------------------------------------------------
     # Engine side (background thread)
@@ -145,33 +168,16 @@ class AsyncLLM:
         try:
             stalled = False
             while not self._shutdown.is_set():
-                # `stalled`: unfinished requests exist but the last step()
-                # dispatched nothing and produced nothing (e.g. a prompt
-                # whose KV footprint can't be allocated yet). Block on the
-                # input queue with a timeout instead of hot-spinning.
-                self._drain_input_queue(
-                    block=stalled
-                    or not self.engine_core.has_unfinished_requests()
-                )
-                if self._shutdown.is_set():
-                    return
-                if not self.engine_core.has_unfinished_requests():
-                    continue
-                outputs = self.engine_core.get_output(timeout=0.2)
-                stalled = not outputs.outputs and not self.engine_core.inflight
-                # process_outputs delivers straight into each request's
-                # AsyncStream (thread-safe); nothing to re-publish here.
-                processed = self.output_processor.process_outputs(
-                    outputs.outputs
-                )
-                if processed.reqs_to_abort:
-                    self.engine_core.abort_requests(processed.reqs_to_abort)
-                for logger_ in self.stat_loggers:
-                    logger_.record(
-                        scheduler_stats=outputs.scheduler_stats,
-                        iteration_stats=processed.iteration_stats,
-                    )
-        except Exception as e:  # engine death -> fail all waiters
+                try:
+                    stalled = self._step_once(stalled)
+                except EngineRestartedError as e:
+                    # An engine core crashed and the client respawned it
+                    # (or is respawning it, DP): replay/fail the
+                    # interrupted requests and keep serving — crash
+                    # recovery must never take down the whole frontend.
+                    self._recover_requests(e)
+                    stalled = False
+        except Exception as e:  # permanent engine death -> fail all waiters
             logger.exception("engine core loop died: %s", e)
             self._dead = True
             err = EngineDeadError(f"engine core died: {e!r}")
@@ -179,22 +185,155 @@ class AsyncLLM:
                 if state.queue is not None:
                     state.queue.put_nowait(err)
 
+    def _step_once(self, stalled: bool) -> bool:
+        # `stalled`: unfinished requests exist but the last step()
+        # dispatched nothing and produced nothing (e.g. a prompt
+        # whose KV footprint can't be allocated yet). Block on the
+        # input queue with a timeout instead of hot-spinning.
+        self._drain_input_queue(
+            block=stalled
+            or not self.engine_core.has_unfinished_requests()
+        )
+        if self._shutdown.is_set():
+            return stalled
+        if not self.engine_core.has_unfinished_requests():
+            return stalled
+        outputs = self.engine_core.get_output(timeout=0.2)
+        stalled = not outputs.outputs and not self.engine_core.inflight
+        # process_outputs delivers straight into each request's
+        # AsyncStream (thread-safe); nothing to re-publish here.
+        processed = self.output_processor.process_outputs(
+            outputs.outputs
+        )
+        if processed.reqs_to_abort:
+            self.engine_core.abort_requests(processed.reqs_to_abort)
+        for logger_ in self.stat_loggers:
+            logger_.record(
+                scheduler_stats=outputs.scheduler_stats,
+                iteration_stats=processed.iteration_stats,
+            )
+        return stalled
+
+    def _recover_requests(self, err: EngineRestartedError) -> None:
+        """Requests lost with a crashed engine are replayed from the
+        journal (resuming from the tokens already delivered) or failed
+        with a per-request error — never silently hung."""
+        from vllm_tpu.core.sched_output import EngineCoreOutput
+
+        logger.warning(
+            "engine core %d restarted; recovering %d in-flight requests",
+            err.engine_id, len(err.lost_req_ids),
+        )
+        for rid in err.lost_req_ids:
+            state = self.output_processor.request_states.get(rid)
+            if state is None:
+                # Aborted/finished while the crash was being handled.
+                if self.journal is not None:
+                    self.journal.discard(rid)
+                continue
+            entry = (
+                self.journal.get(rid) if self.journal is not None else None
+            )
+            if entry is None:
+                self._fail_request(rid, state, 1, "no journal entry")
+                continue
+            remaining = entry.remaining_tokens
+            if remaining is not None and remaining <= 0:
+                # Full budget already delivered: close the stream out as
+                # a normal length finish instead of replaying a request
+                # that has nothing left to generate.
+                self.output_processor.process_outputs([
+                    EngineCoreOutput(
+                        req_id=rid, new_token_ids=[],
+                        finish_reason="length",
+                    )
+                ])
+            elif not entry.replayable:
+                self._fail_request(
+                    rid, state, entry.retries + 1,
+                    "structured-output requests cannot be resumed",
+                )
+            elif entry.retries >= self.resilience.max_request_retries:
+                self._fail_request(
+                    rid, state, entry.retries + 1,
+                    "crash-replay budget exhausted",
+                )
+            else:
+                self.journal.note_replayed(rid)
+                logger.info(
+                    "replaying request %s onto recovered engine "
+                    "(attempt %d/%d, resuming after %d emitted tokens)",
+                    rid, entry.retries,
+                    self.resilience.max_request_retries,
+                    len(entry.emitted_token_ids),
+                )
+                self._input_queue.put(("add", entry.make_resume_request()))
+
+    def _fail_request(self, rid: str, state, attempts: int,
+                      detail: str) -> None:
+        if self.journal is not None:
+            self.journal.note_failed(rid)
+        self.output_processor.request_states.pop(rid, None)
+        err = RequestFailedOnCrashError(rid, attempts, detail)
+        logger.error("%s", err)
+        if state.queue is not None:
+            state.queue.put_nowait(err)
+
     def _drain_input_queue(self, block: bool) -> None:
         try:
             op, payload = self._input_queue.get(timeout=0.1 if block else 0)
         except queue.Empty:
             return
         while True:
-            if op == "add":
-                self.engine_core.add_request(payload)
-            elif op == "abort":
-                self.engine_core.abort_requests(payload)
+            try:
+                if op == "add":
+                    self.engine_core.add_request(payload)
+                elif op == "abort":
+                    self.engine_core.abort_requests(payload)
+            except EngineRestartedError:
+                # The op raced the crash. Aborts are moot (the request
+                # state died with the engine); an add must not be lost —
+                # requeue it, then let the busy loop recover the rest.
+                if op == "add":
+                    self._input_queue.put((op, payload))
+                raise
             try:
                 op, payload = self._input_queue.get_nowait()
             except queue.Empty:
                 return
 
     # ------------------------------------------------------------------
+
+    def resilience_status(self) -> dict:
+        """JSON-shaped liveness/restart snapshot (feeds /health and the
+        resilience metrics)."""
+        client = self.engine_core
+        engines = (
+            client.engine_status()
+            if hasattr(client, "engine_status") else {}
+        )
+        return {
+            "engine_dead": self._dead,
+            "recovery_enabled": self.resilience.enable_recovery,
+            "engines": engines,
+            "requests_replayed_total": (
+                self.journal.requests_replayed_total
+                if self.journal is not None else 0
+            ),
+            "requests_failed_on_crash_total": (
+                self.journal.requests_failed_on_crash_total
+                if self.journal is not None else 0
+            ),
+        }
+
+    def is_ready(self) -> bool:
+        """All engines initialized and up (readiness, distinct from
+        liveness: a respawning rank makes the server NOT ready while
+        /health still reports it serving degraded)."""
+        if self._dead:
+            return False
+        client = self.engine_core
+        return client.is_ready() if hasattr(client, "is_ready") else True
 
     def shutdown(self) -> None:
         self._shutdown.set()

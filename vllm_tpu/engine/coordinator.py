@@ -98,7 +98,13 @@ def run_coordinator(report_addr: str, pub_addr: str,
                     msg = serial_utils.decode(report.recv())
                     if msg.get("shutdown"):
                         return
-                    if "client_inflight" in msg:
+                    if "engine_down" in msg:
+                        # A rank crashed: its last load report is stale.
+                        # Zeroing it lets the wave close (lockstep ranks
+                        # would otherwise dummy-step against a ghost load
+                        # until the replacement's first report).
+                        loads[int(msg["engine_down"])] = (0, 0)
+                    elif "client_inflight" in msg:
                         client_inflight = int(msg["client_inflight"])
                     else:
                         eid = int(msg["engine_id"])

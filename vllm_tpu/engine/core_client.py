@@ -10,7 +10,15 @@ interface serves both the sync LLMEngine and the AsyncLLM thread loop:
 - ``has_unfinished_requests`` is tracked client-side in MP mode (adds
   minus finish records) so the frontend never round-trips for it.
 
-Engine death surfaces as EngineDeadError from any call.
+Engine death surfaces as EngineDeadError from any call — unless crash
+recovery (``config.resilience_config.enable_recovery``) is on, in which
+case the client respawns the dead engine-core process under the
+supervisor's restart budget and raises EngineRestartedError carrying the
+request ids that were in flight on it (the frontend replays or fails
+those per-request; see ``vllm_tpu/resilience``). The single-engine
+MPClient respawns *blocking* (there is nothing else to serve meanwhile);
+the DP client respawns *non-blocking* and keeps routing to surviving
+ranks (degraded mode) until the replacement reports READY.
 """
 
 from __future__ import annotations
@@ -19,6 +27,7 @@ import atexit
 import os
 import pickle
 import tempfile
+import time
 import uuid
 from typing import Any
 
@@ -26,6 +35,7 @@ from vllm_tpu.config import EngineConfig
 from vllm_tpu.core.sched_output import EngineCoreOutputs
 from vllm_tpu.logger import init_logger
 from vllm_tpu.request import EngineCoreRequest
+from vllm_tpu.resilience import EngineRestartedError, EngineSupervisor
 
 logger = init_logger(__name__)
 
@@ -115,6 +125,12 @@ class InprocClient:
     def inflight(self) -> bool:
         return bool(self.engine_core._inflight)
 
+    def engine_status(self) -> dict:
+        return {"0": {"up": True, "restarts": 0}}
+
+    def is_ready(self) -> bool:
+        return True
+
     def shutdown(self) -> None:
         self.engine_core.shutdown()
 
@@ -124,8 +140,12 @@ class _ZMQClientBase:
 
     Subclass contract: set ``_serial``, ``_proc_mod``, ``_ctx``,
     ``_output`` (shared PULL), ``_procs`` (list of engine processes),
-    ``_pending``, ``_dead``; implement ``_utility`` (single-engine call vs
-    broadcast) and ``_on_finished`` (drop a finished request id).
+    ``_pending``, ``_dead``, ``_resilience``, ``_supervisor``,
+    ``_started``; implement ``_utility`` (single-engine call vs
+    broadcast), ``_on_finished`` (drop a finished request id),
+    ``_respawn_engine`` (tear down + relaunch one engine, returning the
+    request ids lost with it) and ``_on_engine_ready`` (a respawned
+    engine reported READY).
     """
 
     def _recv(self, timeout_ms: int) -> list[bytes] | None:
@@ -135,43 +155,168 @@ class _ZMQClientBase:
         while True:
             if self._output.poll(min(step, max(deadline, 0))):
                 frames = self._output.recv_multipart()
-                if frames[0] == self._proc_mod.MSG_DEAD:
-                    self._dead = True
-                    raise EngineDeadError(
-                        f"engine core died:\n{frames[1].decode()}"
+                kind = frames[0]
+                if kind == self._proc_mod.MSG_DEAD:
+                    eid = int(frames[2]) if len(frames) > 2 else 0
+                    self._handle_engine_death(
+                        [eid], f"engine core died:\n{frames[1].decode()}"
                     )
+                    continue  # unreachable (death handler raises)
+                if kind == self._proc_mod.MSG_READY and self._started:
+                    # A respawned engine finished re-initialization.
+                    self._on_engine_ready(self._serial.decode(frames[1]))
+                    continue
                 return frames
             deadline -= step
-            if any(not p.is_alive() for p in self._procs):
-                self._dead = True
-                raise EngineDeadError("an engine core process exited")
+            dead = [
+                i for i, p in enumerate(self._procs) if not p.is_alive()
+            ]
+            if dead:
+                self._handle_engine_death(
+                    dead, "an engine core process exited"
+                )
             if deadline <= 0:
                 return None
 
     def _check_alive(self) -> None:
-        if self._dead or any(not p.is_alive() for p in self._procs):
-            self._dead = True
+        if self._dead:
             raise EngineDeadError("engine core process is not running")
+        dead = [i for i, p in enumerate(self._procs) if not p.is_alive()]
+        if dead:
+            self._handle_engine_death(
+                dead, "engine core process is not running"
+            )
+
+    def _handle_engine_death(self, engine_ids: list[int],
+                             reason: str) -> None:
+        """Dead engine(s) detected. Always raises: EngineDeadError when
+        recovery is off / mid-init / budget-exhausted (reference
+        semantics), EngineRestartedError (with the interrupted request
+        ids) after a successful respawn kick-off."""
+        if not self._started or not self._resilience.enable_recovery:
+            self._dead = True
+            raise EngineDeadError(reason)
+        lost: list[str] = []
+        for eid in engine_ids:
+            if not self._supervisor.may_restart(eid):
+                self._supervisor.record_dead(eid)
+                self._dead = True
+                raise EngineDeadError(
+                    f"{reason} (engine {eid} exhausted its "
+                    f"{self._resilience.max_engine_restarts}-restart budget)"
+                )
+            n = self._supervisor.record_failure(eid)
+            logger.error(
+                "engine core %d died (%s); respawning (restart %d/%d)",
+                eid, reason.splitlines()[0], n,
+                self._resilience.max_engine_restarts,
+            )
+            lost.extend(self._respawn_engine(eid))
+        raise EngineRestartedError(
+            lost, engine_id=engine_ids[0], reason=reason.splitlines()[0]
+        )
+
+    def _drain_stale_outputs(self, lost: set[str]) -> None:
+        """Drop frames from a dead engine incarnation that would corrupt
+        replayed streams: OUTPUT frames for interrupted requests get
+        filtered (their requests are about to be re-admitted under the
+        same ids), MSG_DEAD frames for the death being handled get
+        dropped. Best-effort — frames still in the kernel buffer when
+        this runs are caught by the req-id filter downstream only if
+        another death occurs, so the respawn path drains *after* joining
+        the dead process."""
+        kept: list[list[bytes]] = []
+
+        def filter_frames(frames: list[bytes]) -> list[bytes] | None:
+            if frames[0] == self._proc_mod.MSG_DEAD:
+                return None
+            if frames[0] != self._proc_mod.MSG_OUTPUTS:
+                return frames
+            outs: EngineCoreOutputs = self._serial.decode(frames[1])
+            filtered = [o for o in outs.outputs if o.req_id not in lost]
+            if len(filtered) == len(outs.outputs):
+                return frames
+            if not filtered and outs.scheduler_stats is None:
+                return None
+            outs.outputs = filtered
+            return [self._proc_mod.MSG_OUTPUTS, self._serial.encode(outs)]
+
+        for frames in self._pending:
+            f = filter_frames(frames)
+            if f is not None:
+                kept.append(f)
+        while self._output.poll(0):
+            f = filter_frames(self._output.recv_multipart())
+            if f is not None:
+                kept.append(f)
+        self._pending = kept
+
+    def _respawn_engine(self, engine_id: int) -> list[str]:
+        raise NotImplementedError
+
+    def _on_engine_ready(self, payload: dict) -> None:
+        raise NotImplementedError
+
+    def _has_live_requests(self) -> bool:
+        return bool(self._live)
+
+    def _engines_with_work(self) -> list[int]:
+        return list(range(len(self._procs)))
+
+    def _check_heartbeat(self) -> None:
+        """Hang detection (opt-in): an engine that holds unfinished
+        requests but has produced no frame for heartbeat_timeout_s is
+        killed; the normal death path then recovers it."""
+        hb = self._resilience.heartbeat_timeout_s
+        if not hb or not self._has_live_requests():
+            self._last_progress = time.monotonic()
+            return
+        if time.monotonic() - self._last_progress <= hb:
+            return
+        self._last_progress = time.monotonic()
+        for eid in self._engines_with_work():
+            p = self._procs[eid]
+            if p.is_alive():
+                logger.error(
+                    "engine core %d heartbeat timeout (%.0fs with "
+                    "unfinished requests and no output); killing it",
+                    eid, hb,
+                )
+                p.terminate()
 
     def get_output(self, timeout: float | None = None) -> EngineCoreOutputs:
         """Next batch of outputs; empty EngineCoreOutputs on timeout."""
         self._check_alive()
-        if self._pending:
-            frames = self._pending.pop(0)
-        else:
-            frames = self._recv(
-                timeout_ms=int(
-                    (timeout if timeout is not None else 0.2) * 1000
+        self._check_heartbeat()
+        while True:
+            if self._pending:
+                frames = self._pending.pop(0)
+            else:
+                frames = self._recv(
+                    timeout_ms=int(
+                        (timeout if timeout is not None else 0.2) * 1000
+                    )
                 )
-            )
-        if frames is None:
-            return EngineCoreOutputs()
+            if frames is None:
+                return EngineCoreOutputs()
+            if frames[0] == self._proc_mod.MSG_READY:
+                # READY parked in _pending by a stale-frame drain.
+                self._on_engine_ready(self._serial.decode(frames[1]))
+                continue
+            break
+        self._last_progress = time.monotonic()
         assert frames[0] == self._proc_mod.MSG_OUTPUTS, frames[0]
         outputs: EngineCoreOutputs = self._serial.decode(frames[1])
         for o in outputs.outputs:
             if o.finish_reason is not None:
                 self._on_finished(o.req_id)
         return outputs
+
+    def engine_status(self) -> dict:
+        return self._supervisor.status()
+
+    def is_ready(self) -> bool:
+        return not self._dead and self._supervisor.all_up()
 
     def _collect_utility_replies(
         self, method: str, count: int, timeout_ms: int
@@ -290,25 +435,26 @@ class MPClient(_ZMQClientBase):
 
         self._serial = serial_utils
         self._proc_mod = core_proc
+        self._mp_ctx = multiprocessing.get_context("spawn")
         self._run_dir = run_dir = tempfile.mkdtemp(prefix="vllm-tpu-ipc-")
         suffix = uuid.uuid4().hex[:8]
         input_addr = f"ipc://{run_dir}/input-{suffix}.sock"
         output_addr = f"ipc://{run_dir}/output-{suffix}.sock"
+        self._output_addr = output_addr
+
+        self._resilience = config.resilience_config
+        self._supervisor = EngineSupervisor(self._resilience, 1)
+        self._started = False
+        self._ready_timeout_s = ready_timeout_s
+        # Same bytes respawn the engine with the same config.
+        self._config_bytes = pickle.dumps(config)
 
         self._ctx = zmq.Context(1)
-        self._input = self._ctx.socket(zmq.PUSH)
-        self._input.bind(input_addr)
         self._output = self._ctx.socket(zmq.PULL)
         self._output.bind(output_addr)
-
-        mp_ctx = multiprocessing.get_context("spawn")
-        self._proc = mp_ctx.Process(
-            target=core_proc.run_engine_core,
-            args=(pickle.dumps(config), input_addr, output_addr),
-            name="vllm-tpu-engine-core",
-            daemon=True,
-        )
-        self._proc.start()
+        self._input = self._ctx.socket(zmq.PUSH)
+        self._input.bind(input_addr)
+        self._proc = self._spawn_proc(input_addr)
         self._procs = [self._proc]
         self._inputs = [self._input]
         atexit.register(self.shutdown)
@@ -318,6 +464,7 @@ class MPClient(_ZMQClientBase):
         # engine-side finish record cannot double-count).
         self._live: set[str] = set()
         self._pending: list[list[bytes]] = []  # OUT frames read early
+        self._last_progress = time.monotonic()
         # Block until the engine proc finishes init (model load + KV
         # sizing + warm-up can take minutes on first compile).
         frames = self._recv(timeout_ms=int(ready_timeout_s * 1000))
@@ -327,10 +474,111 @@ class MPClient(_ZMQClientBase):
             )
         ready = serial_utils.decode(frames[1])
         config.cache_config.num_gpu_blocks = ready["num_gpu_blocks"]
+        self._num_gpu_blocks = ready["num_gpu_blocks"]
+        self._started = True
         logger.info(
             "engine core proc up (pid %s, %d KV blocks)",
             self._proc.pid, ready["num_gpu_blocks"],
         )
+
+    def _spawn_proc(self, input_addr: str):
+        proc = self._mp_ctx.Process(
+            target=self._proc_mod.run_engine_core,
+            args=(self._config_bytes, input_addr, self._output_addr),
+            name="vllm-tpu-engine-core",
+            daemon=True,
+        )
+        proc.start()
+        return proc
+
+    # -- crash recovery ------------------------------------------------
+
+    def _respawn_engine(self, engine_id: int) -> list[str]:
+        """Blocking respawn of THE engine: backoff, fresh input socket,
+        relaunch, wait for READY (retrying under the restart budget if
+        the replacement dies during init). Single-engine client — there
+        is nothing else to serve while the engine is down, so blocking
+        here is the right trade."""
+        import zmq
+
+        lost = sorted(self._live)
+        self._live.clear()
+        self._join_dead_proc()
+        self._drain_stale_outputs(set(lost))
+        while True:
+            time.sleep(self._supervisor.backoff_s(0))
+            # Fresh input socket per attempt: the dead incarnation's
+            # queued input frames must not reach the replacement, and a
+            # terminated proc can leave the ipc file behind.
+            self._input.close(linger=0)
+            suffix = uuid.uuid4().hex[:8]
+            input_addr = f"ipc://{self._run_dir}/input-{suffix}.sock"
+            self._input = self._ctx.socket(zmq.PUSH)
+            self._input.bind(input_addr)
+            self._inputs = [self._input]
+            self._proc = self._spawn_proc(input_addr)
+            self._procs = [self._proc]
+            timeout_s = (
+                self._resilience.respawn_ready_timeout_s
+                or self._ready_timeout_s
+            )
+            ready = self._await_ready(timeout_s)
+            if ready is not None:
+                break
+            if not self._supervisor.may_restart(0):
+                self._supervisor.record_dead(0)
+                self._dead = True
+                raise EngineDeadError(
+                    "engine core failed to re-initialize and exhausted "
+                    f"its {self._resilience.max_engine_restarts}-restart "
+                    "budget"
+                )
+            n = self._supervisor.record_failure(0)
+            logger.error(
+                "respawned engine core died during init (restart %d/%d)",
+                n, self._resilience.max_engine_restarts,
+            )
+            self._join_dead_proc()
+        if ready["num_gpu_blocks"] != self._num_gpu_blocks:
+            logger.warning(
+                "respawned engine core sized %d KV blocks (was %d)",
+                ready["num_gpu_blocks"], self._num_gpu_blocks,
+            )
+        self._supervisor.record_ready(0)
+        self._last_progress = time.monotonic()
+        logger.info(
+            "engine core proc respawned (pid %s); %d in-flight requests "
+            "interrupted", self._proc.pid, len(lost),
+        )
+        return lost
+
+    def _join_dead_proc(self) -> None:
+        self._proc.join(timeout=2)
+        if self._proc.is_alive():
+            self._proc.terminate()
+            self._proc.join(timeout=2)
+
+    def _await_ready(self, timeout_s: float) -> dict | None:
+        """Wait for the respawned engine's READY, dropping stale frames
+        from the previous incarnation. None = this incarnation failed."""
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            if self._output.poll(200):
+                frames = self._output.recv_multipart()
+                if frames[0] == self._proc_mod.MSG_READY:
+                    return self._serial.decode(frames[1])
+                continue  # stale OUT/UTILREP/DEAD from the old proc
+            if not self._proc.is_alive():
+                return None
+        return None
+
+    def _on_engine_ready(self, payload: dict) -> None:
+        # The blocking respawn consumes READY itself; one arriving here
+        # is a late duplicate — just mark the engine up.
+        self._supervisor.record_ready(0)
+
+    def _engines_with_work(self) -> list[int]:
+        return [0] if self._live else []
 
     # ------------------------------------------------------------------
 
@@ -408,11 +656,16 @@ class DPLBClient(_ZMQClientBase):
         self._proc_mod = core_proc
         pc = config.parallel_config
         self._num_engines = n = pc.data_parallel_engines
+        self._resilience = config.resilience_config
+        self._supervisor = EngineSupervisor(self._resilience, n)
+        self._started = False
+        self._ready_timeout_s = ready_timeout_s
         self._run_dir = run_dir = tempfile.mkdtemp(prefix="vllm-tpu-dp-")
         suffix = uuid.uuid4().hex[:8]
         output_addr = f"ipc://{run_dir}/out-{suffix}.sock"
         report_addr = f"ipc://{run_dir}/rep-{suffix}.sock"
         pub_addr = f"ipc://{run_dir}/pub-{suffix}.sock"
+        self._output_addr = output_addr
 
         self._ctx = zmq.Context(1)
         self._output = self._ctx.socket(zmq.PULL)
@@ -451,6 +704,8 @@ class DPLBClient(_ZMQClientBase):
         )
         self._inputs = []
         self._procs = []
+        self._engine_cfg_bytes: list[bytes] = []
+        self._engine_kwargs: list[dict] = []
         for eid in range(n):
             engine_config = copy.deepcopy(config)
             engine_config.parallel_config.data_parallel_engines = 1
@@ -485,21 +740,15 @@ class DPLBClient(_ZMQClientBase):
                 if pin_chips
                 else {}
             )
-            proc = mp_ctx.Process(
-                target=core_proc.run_engine_core,
-                args=(pickle.dumps(engine_config), input_addr, output_addr),
-                kwargs=dict(
-                    engine_id=eid,
-                    coord_report_addr=report_addr,
-                    coord_pub_addr=pub_addr,
-                    lockstep=pc.data_parallel_lockstep,
-                    extra_env=extra_env,
-                ),
-                name=f"vllm-tpu-engine-core-dp{eid}",
-                daemon=True,
-            )
-            proc.start()
-            self._procs.append(proc)
+            self._engine_cfg_bytes.append(pickle.dumps(engine_config))
+            self._engine_kwargs.append(dict(
+                engine_id=eid,
+                coord_report_addr=report_addr,
+                coord_pub_addr=pub_addr,
+                lockstep=pc.data_parallel_lockstep,
+                extra_env=extra_env,
+            ))
+            self._procs.append(self._spawn_dp_engine(eid, input_addr))
         atexit.register(self.shutdown)
 
         self._dead = False
@@ -514,6 +763,9 @@ class DPLBClient(_ZMQClientBase):
         # so a dropped final 0 cannot wedge the wave open).
         self._report_unsent: int | None = None
         self._pending: list[list[bytes]] = []
+        # Degraded-mode routing mask: False while a rank is respawning.
+        self._engine_up = [True] * n
+        self._last_progress = time.monotonic()
         ready = 0
         blocks: list[int] = []
         deadline_ms = int(ready_timeout_s * 1000)
@@ -528,9 +780,89 @@ class DPLBClient(_ZMQClientBase):
             )
             ready += 1
         config.cache_config.num_gpu_blocks = min(blocks)
+        self._started = True
         logger.info(
             "%d DP engine cores up (KV blocks per engine: %s)", n, blocks
         )
+
+    def _spawn_dp_engine(self, eid: int, input_addr: str):
+        proc = self._mp_ctx.Process(
+            target=self._proc_mod.run_engine_core,
+            args=(self._engine_cfg_bytes[eid], input_addr,
+                  self._output_addr),
+            kwargs=self._engine_kwargs[eid],
+            name=f"vllm-tpu-engine-core-dp{eid}",
+            daemon=True,
+        )
+        proc.start()
+        return proc
+
+    # -- crash recovery (degraded-mode serving) ------------------------
+
+    def _respawn_engine(self, engine_id: int) -> list[str]:
+        """NON-blocking respawn of one DP rank: the replacement process is
+        launched immediately and re-initializes in the background (its
+        READY arrives interleaved on the shared output socket), while
+        routing excludes the rank — surviving ranks keep serving."""
+        import zmq
+
+        eid = engine_id
+        self._engine_up[eid] = False
+        proc = self._procs[eid]
+        proc.join(timeout=2)
+        if proc.is_alive():
+            proc.terminate()
+            proc.join(timeout=2)
+        lost = sorted(
+            rid for rid, e in self._live.items() if e == eid
+        )
+        for rid in lost:
+            del self._live[rid]
+        self._engine_inflight[eid] = 0
+        self._drain_stale_outputs(set(lost))
+        # Zero the dead rank's load at the coordinator: a stale nonzero
+        # load would hold the wave open with lockstep ranks
+        # dummy-stepping until the replacement's first report.
+        try:
+            self._report.send(
+                self._serial.encode({"engine_down": eid})
+            )
+        except Exception:
+            pass
+        # Bounded inline backoff (capped low — this blocks routing to the
+        # surviving ranks too); the restart budget bounds crash loops.
+        time.sleep(min(self._supervisor.backoff_s(eid), 2.0))
+        self._inputs[eid].close(linger=0)
+        suffix = uuid.uuid4().hex[:8]
+        input_addr = f"ipc://{self._run_dir}/in{eid}-{suffix}.sock"
+        sock = self._ctx.socket(zmq.PUSH)
+        sock.bind(input_addr)
+        self._inputs[eid] = sock
+        self._procs[eid] = self._spawn_dp_engine(eid, input_addr)
+        self._report_inflight()
+        logger.info(
+            "DP engine core %d respawning in background (pid %s); %d "
+            "in-flight requests interrupted; serving degraded on %d/%d "
+            "ranks", eid, self._procs[eid].pid, len(lost),
+            sum(self._engine_up), self._num_engines,
+        )
+        return lost
+
+    def _on_engine_ready(self, payload: dict) -> None:
+        eid = int(payload.get("engine_id", 0))
+        self._engine_up[eid] = True
+        self._supervisor.record_ready(eid)
+        logger.info(
+            "DP engine core %d recovered (%d KV blocks); %d/%d ranks up",
+            eid, payload.get("num_gpu_blocks", -1),
+            sum(self._engine_up), self._num_engines,
+        )
+
+    def _engines_with_work(self) -> list[int]:
+        return [
+            i for i, c in enumerate(self._engine_inflight)
+            if c > 0 and self._engine_up[i]
+        ]
 
     # ------------------------------------------------------------------
 
@@ -589,8 +921,15 @@ class DPLBClient(_ZMQClientBase):
     def add_request(self, req: EngineCoreRequest) -> None:
         self._check_alive()
         self._drain_loads()
+        # Degraded mode: route around ranks that are respawning. If every
+        # rank is down (mass-crash window), fall back to all — the bind
+        # side of the fresh input socket buffers the add until the
+        # replacement connects, so nothing is dropped.
+        candidates = [
+            i for i in range(self._num_engines) if self._engine_up[i]
+        ] or list(range(self._num_engines))
         eid = min(
-            range(self._num_engines),
+            candidates,
             key=lambda i: self._engine_inflight[i],
         )
         self._live[req.request_id] = eid
@@ -630,18 +969,28 @@ class DPLBClient(_ZMQClientBase):
         return bool(self._live)
 
     def _utility(self, method: str, *args, timeout_ms: int = 600_000):
-        """Broadcast to all engines; returns the lowest engine id's result.
-        All replies are drained even on error (stray replies on the shared
-        socket would corrupt the output stream)."""
+        """Broadcast to all UP engines; returns the lowest engine id's
+        result. All replies are drained even on error (stray replies on
+        the shared socket would corrupt the output stream). Ranks mid-
+        respawn are skipped — they rebuild their state from config on
+        READY and cannot answer."""
         self._check_alive()
-        for sock in self._inputs:
-            sock.send_multipart([
+        up = [
+            i for i in range(self._num_engines) if self._engine_up[i]
+        ]
+        if not up:
+            raise RuntimeError(
+                f"utility {method}: no engine cores available "
+                "(all ranks restarting)"
+            )
+        for eid in up:
+            self._inputs[eid].send_multipart([
                 self._proc_mod.MSG_UTILITY,
                 method.encode(),
                 self._serial.encode(list(args)),
             ])
         replies = self._collect_utility_replies(
-            method, self._num_engines, timeout_ms
+            method, len(up), timeout_ms
         )
         replies.sort(key=lambda r: r.get("engine_id", 0))
         return replies[0]["ok"]

@@ -198,7 +198,11 @@ def run_engine_core(config_bytes: bytes, input_addr: str,
         tb = traceback.format_exc()
         logger.error("engine core proc died:\n%s", tb)
         try:
-            out.send_multipart([MSG_DEAD, tb.encode()])
+            # Third frame identifies WHICH engine died so the DP client's
+            # supervisor respawns the right rank.
+            out.send_multipart(
+                [MSG_DEAD, tb.encode(), str(engine_id).encode()]
+            )
         except Exception:
             pass
     finally:

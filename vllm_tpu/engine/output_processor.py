@@ -97,9 +97,15 @@ class ProcessedOutputs:
 
 
 class OutputProcessor:
-    def __init__(self, tokenizer: Any | None = None) -> None:
+    def __init__(self, tokenizer: Any | None = None,
+                 journal: Any | None = None) -> None:
         self.tokenizer = tokenizer
         self.request_states: dict[str, RequestState] = {}
+        # Optional crash-recovery journal (vllm_tpu/resilience): emitted
+        # tokens are recorded here as they are processed, so a request
+        # interrupted by an engine crash can resume from exactly what the
+        # client has already seen.
+        self.journal = journal
 
     def add_request(
         self,
@@ -125,6 +131,8 @@ class OutputProcessor:
     def abort_requests(self, request_ids) -> None:
         for rid in request_ids:
             self.request_states.pop(rid, None)
+            if self.journal is not None:
+                self.journal.discard(rid)
 
     def get_num_unfinished_requests(self) -> int:
         return len(self.request_states)
@@ -143,6 +151,9 @@ class OutputProcessor:
             state = self.request_states.get(eco.req_id)
             if state is None:
                 continue  # aborted earlier
+
+            if self.journal is not None and eco.new_token_ids:
+                self.journal.record_tokens(eco.req_id, eco.new_token_ids)
 
             if eco.new_token_ids:
                 stats.num_generation_tokens += len(eco.new_token_ids)
@@ -181,6 +192,8 @@ class OutputProcessor:
                 # sees `finished` it may re-use the request id; popping
                 # after delivery could delete the successor's state.
                 self.request_states.pop(eco.req_id, None)
+                if self.journal is not None:
+                    self.journal.record_finished(eco.req_id)
 
             out = state.make_request_output(
                 eco.new_token_ids, finish_reason, stop_reason

@@ -6,7 +6,8 @@ Reference analog: ``vllm/entrypoints/openai/api_server.py:671 run_server``
   POST /v1/completions          (stream + non-stream)
   POST /v1/chat/completions     (stream + non-stream)
   GET  /v1/models
-  GET  /health /ping
+  GET  /health /ping            (JSON liveness + per-engine restart counts)
+  GET  /ready                   (503 until all engine cores initialized)
   GET  /metrics                 (Prometheus text format)
 
 Streaming uses SSE (``data: {...}\\n\\n`` ... ``data: [DONE]``), matching the
@@ -452,10 +453,46 @@ async def handle_stop_profile(request: web.Request) -> web.Response:
 
 
 async def handle_health(request: web.Request) -> web.Response:
+    """Liveness with per-engine detail: 200 while the server can serve
+    anything (including degraded DP, some ranks respawning), 503 once the
+    engine is permanently dead. Body is JSON so load balancers and
+    operators see WHICH engine is down and how often it restarted."""
     engine: AsyncLLM = request.app[ENGINE_KEY]
-    if engine._dead:
-        return web.Response(status=503, text="engine dead")
-    return web.Response(text="OK")
+    status = (
+        engine.resilience_status()
+        if hasattr(engine, "resilience_status")
+        else {"engine_dead": engine._dead, "engines": {}}
+    )
+    engines = status.get("engines", {})
+    dead = status.get("engine_dead", False)
+    if dead:
+        health = "dead"
+    elif engines and not all(e.get("up") for e in engines.values()):
+        health = "degraded"
+    else:
+        health = "healthy"
+    body = {
+        "status": health,
+        "engines": engines,
+        "requests_replayed_total": status.get(
+            "requests_replayed_total", 0),
+        "requests_failed_on_crash_total": status.get(
+            "requests_failed_on_crash_total", 0),
+    }
+    return web.json_response(body, status=503 if dead else 200)
+
+
+async def handle_ready(request: web.Request) -> web.Response:
+    """Readiness, distinct from liveness: 503 until every engine is
+    initialized and up, so load balancers drain a degraded replica
+    without killing it."""
+    engine: AsyncLLM = request.app[ENGINE_KEY]
+    ready = engine.is_ready() if hasattr(engine, "is_ready") else (
+        not engine._dead
+    )
+    return web.json_response(
+        {"ready": ready}, status=200 if ready else 503
+    )
 
 
 async def handle_metrics(request: web.Request) -> web.Response:
@@ -579,6 +616,7 @@ def build_app(engine: AsyncLLM, model_name: str, metrics=None,
     app.router.add_get("/v1/models", handle_models)
     app.router.add_get("/health", handle_health)
     app.router.add_get("/ping", handle_health)
+    app.router.add_get("/ready", handle_ready)
     app.router.add_get("/metrics", handle_metrics)
     from vllm_tpu.entrypoints.openai.extra_apis import (
         handle_realtime,

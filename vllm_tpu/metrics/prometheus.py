@@ -80,10 +80,35 @@ class LabeledCounter:
     def inc(self, key: str, v: float = 1.0) -> None:
         self.values[key] = self.values.get(key, 0.0) + v
 
+    def set(self, key: str, v: float) -> None:
+        self.values[key] = v
+
     def render(self) -> str:
         out = [
             f"# HELP {self.name} {self.doc}",
             f"# TYPE {self.name} counter",
+        ]
+        for key in sorted(self.values):
+            out.append(
+                f'{self.name}{{{self.label}="{key}"}} {self.values[key]}'
+            )
+        return "\n".join(out) + "\n"
+
+
+class LabeledGauge:
+    """One gauge family with a single label dimension (e.g. engine id)."""
+
+    def __init__(self, name: str, doc: str, label: str) -> None:
+        self.name, self.doc, self.label = name, doc, label
+        self.values: dict[str, float] = {}
+
+    def set(self, key: str, v: float) -> None:
+        self.values[key] = v
+
+    def render(self) -> str:
+        out = [
+            f"# HELP {self.name} {self.doc}",
+            f"# TYPE {self.name} gauge",
         ]
         for key in sorted(self.values):
             out.append(
@@ -146,6 +171,22 @@ class PrometheusRegistry:
         self.request_success = LabeledCounter(
             "vllm:request_success_total",
             "Finished requests by reason", "finished_reason")
+        # Resilience (vllm_tpu/resilience): refreshed from the engine's
+        # live snapshot at render time, so /metrics reflects the crash/
+        # recovery state without event plumbing through stat records.
+        self.engine_up = LabeledGauge(
+            "vllm:engine_up",
+            "Engine-core liveness (1 = serving, 0 = down/respawning)",
+            "engine_id")
+        self.engine_restarts = LabeledCounter(
+            "vllm:engine_restarts_total",
+            "Engine-core process respawns", "engine_id")
+        self.requests_replayed = Counter(
+            "vllm:requests_replayed_total",
+            "Requests resumed on a respawned engine core")
+        self.requests_failed_on_crash = Counter(
+            "vllm:requests_failed_on_crash_total",
+            "Requests failed because an engine core crashed")
         self._metrics = [
             self.num_running, self.num_waiting, self.kv_usage,
             self.prefix_queries, self.prefix_hits, self.preempted,
@@ -155,7 +196,10 @@ class PrometheusRegistry:
             self.queue_time, self.accept_length,
             self.bucket_compiles, self.bucket_hits, self.pipeline_stall,
             self.request_success,
+            self.engine_up, self.engine_restarts,
+            self.requests_replayed, self.requests_failed_on_crash,
         ]
+        self._engine = engine
         self._last_prefix = (0, 0)
         self._last_preempted = 0
         self._last_spec = (0, 0)
@@ -207,7 +251,24 @@ class PrometheusRegistry:
             for reason in iteration_stats.finished_reasons:
                 self.request_success.inc(reason)
 
+    def _refresh_resilience(self) -> None:
+        engine = self._engine
+        if engine is None or not hasattr(engine, "resilience_status"):
+            return
+        try:
+            status = engine.resilience_status()
+        except Exception:
+            return
+        for eid, st in status.get("engines", {}).items():
+            self.engine_up.set(eid, 1.0 if st.get("up") else 0.0)
+            self.engine_restarts.set(eid, float(st.get("restarts", 0)))
+        self.requests_replayed.value = float(
+            status.get("requests_replayed_total", 0))
+        self.requests_failed_on_crash.value = float(
+            status.get("requests_failed_on_crash_total", 0))
+
     def render(self) -> str:
+        self._refresh_resilience()
         return "".join(m.render() for m in self._metrics)
 
 

@@ -1,0 +1,77 @@
+"""Resilience subsystem: engine-core supervision, request journaling &
+replay, degraded-mode DP serving.
+
+The reference stack treats engine-core death as terminal (``vllm/v1/engine/
+exceptions.py`` — one failed health check flips the client dead forever and
+every in-flight request is lost). This package goes beyond that: with
+``enable_engine_recovery`` on, a crashed engine-core process is respawned
+under a restart budget with exponential backoff, admitted requests are
+journaled frontend-side so they can be *resumed* on the recovered engine
+(or failed individually, never silently hung), and a DP deployment keeps
+serving on surviving ranks while a crashed rank re-initializes.
+
+Pieces:
+
+- :class:`ResilienceConfig` — the knob surface (restart budget, backoff,
+  per-request retry cap, heartbeat timeout).
+- :class:`EngineSupervisor` — restart-budget accounting + backoff schedule
+  + per-engine up/down status (feeds ``/health`` and ``engine_up``).
+- :class:`RequestJournal` — per-request prompt/params/progress record;
+  builds resume requests (prompt extended with emitted tokens, token
+  budget decremented).
+- :class:`EngineRestartedError` — raised by a client call when an engine
+  died and was respawned; carries the request ids that were in flight on
+  the dead engine so the frontend can replay or fail them.
+- :class:`RequestFailedOnCrashError` — the per-request error delivered to
+  a stream whose request exhausted its crash-retry budget.
+"""
+
+from vllm_tpu.resilience.config import ResilienceConfig
+from vllm_tpu.resilience.journal import JournalEntry, RequestJournal
+from vllm_tpu.resilience.supervisor import EngineSupervisor
+
+
+class EngineRestartedError(RuntimeError):
+    """An engine core died and was (or is being) respawned.
+
+    NOT a subclass of EngineDeadError: callers treating death as terminal
+    must not confuse a recovered engine with a dead one. ``lost_req_ids``
+    are the requests that were in flight on the crashed engine; the
+    frontend decides replay-vs-fail per request.
+    """
+
+    def __init__(self, lost_req_ids: list[str], engine_id: int = 0,
+                 reason: str = "engine core restarted") -> None:
+        super().__init__(
+            f"{reason} (engine {engine_id}, "
+            f"{len(lost_req_ids)} in-flight requests interrupted)"
+        )
+        self.lost_req_ids = list(lost_req_ids)
+        self.engine_id = engine_id
+
+
+class RequestFailedOnCrashError(RuntimeError):
+    """Per-request terminal error: the request's engine crashed and the
+    request exhausted its replay budget (or cannot be replayed)."""
+
+    def __init__(self, request_id: str, attempts: int,
+                 detail: str = "") -> None:
+        msg = (
+            f"request {request_id} failed: engine core crashed and the "
+            f"request could not be recovered after {attempts} attempt(s)"
+        )
+        if detail:
+            msg += f" ({detail})"
+        super().__init__(msg)
+        self.request_id = request_id
+        self.attempts = attempts
+
+
+__all__ = [
+    "EngineRestartedError",
+    "EngineSupervisor",
+    "JournalEntry",
+    "RequestFailedOnCrashError",
+    "RequestJournal",
+    "ResilienceConfig",
+]

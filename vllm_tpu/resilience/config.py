@@ -1,0 +1,54 @@
+"""Resilience knob surface (part of EngineConfig).
+
+Defaults keep the reference failure model (recovery OFF): existing callers
+that rely on fail-fast EngineDeadError semantics — including the sync
+LLMEngine and anything scripted around it — see no behavior change unless
+they opt in.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+
+@dataclass
+class ResilienceConfig:
+    # Master switch: respawn crashed engine-core processes and surface
+    # EngineRestartedError (with the interrupted request ids) instead of
+    # flipping the client permanently dead on first failure.
+    enable_recovery: bool = False
+    # Total respawns allowed per engine before the client declares
+    # permanent death (EngineDeadError, reference semantics).
+    max_engine_restarts: int = 3
+    # Crash-replay budget per request: how many times one request may be
+    # re-admitted after losing its engine before it is failed with a
+    # per-request RequestFailedOnCrashError.
+    max_request_retries: int = 1
+    # Exponential backoff between respawns of the same engine:
+    # min(base * 2**(restarts-1), max). Bounds crash-loop spin when an
+    # engine dies instantly on startup (e.g. OOM on model load).
+    restart_backoff_s: float = 0.5
+    restart_backoff_max_s: float = 30.0
+    # Re-initialization budget for a respawned engine (model load + KV
+    # alloc + warm-up); 0 falls back to the client's construction timeout.
+    respawn_ready_timeout_s: float = 600.0
+    # Hang detection: if >0 and an engine has unfinished requests but has
+    # produced no output frame for this long, the supervisor declares it
+    # hung, kills it, and runs the normal crash-recovery path. Off by
+    # default — first-token compile on a cold cache can take minutes.
+    heartbeat_timeout_s: float = 0.0
+
+    def finalize(self) -> "ResilienceConfig":
+        if self.max_engine_restarts < 0:
+            raise ValueError(
+                f"max_engine_restarts must be >= 0, got "
+                f"{self.max_engine_restarts}"
+            )
+        if self.max_request_retries < 0:
+            raise ValueError(
+                f"max_request_retries must be >= 0, got "
+                f"{self.max_request_retries}"
+            )
+        if self.restart_backoff_s < 0 or self.restart_backoff_max_s < 0:
+            raise ValueError("restart backoff values must be >= 0")
+        return self

@@ -1,0 +1,157 @@
+"""Frontend request journal: enough state to resume a request after its
+engine-core process crashes.
+
+One entry per admitted request: the processed prompt token ids, sampling
+params, and every token emitted so far. On a crash, the frontend builds a
+*resume* request from the entry — the original prompt extended with the
+already-emitted tokens becomes the new prompt, and the token budget is
+decremented by what was already delivered — so the recovered engine
+continues the stream instead of regenerating from scratch (the client
+already holds the emitted prefix; re-emitting it would corrupt the
+stream).
+
+Thread-safe: ``record_admitted``/``discard`` run on the event loop
+(generate()/abort()), token recording runs on the engine busy-loop thread.
+
+Known resume caveats (documented, not silently wrong):
+- seeded sampling resumes with the same seed over a longer prompt, so the
+  post-crash RNG stream differs from the uninterrupted one;
+- structured-output (grammar) requests are NOT resumable — the FSM state
+  implied by the emitted tokens cannot be re-entered mid-prompt — so they
+  are failed per-request instead (``JournalEntry.replayable``).
+"""
+
+from __future__ import annotations
+
+import copy
+import threading
+from dataclasses import dataclass, field
+from typing import Any
+
+from vllm_tpu.request import EngineCoreRequest
+
+
+@dataclass
+class JournalEntry:
+    request_id: str
+    prompt_token_ids: list[int]
+    sampling_params: Any
+    eos_token_id: int | None = None
+    priority: int = 0
+    lora_name: str | None = None
+    mm_inputs: list[Any] | None = None
+    pooling_params: Any = None
+    arrival_time: float = 0.0
+    prompt_text: str | None = None
+    # Tokens already emitted to the client (resume prefix).
+    emitted_token_ids: list[int] = field(default_factory=list)
+    # Crash-replay attempts consumed so far.
+    retries: int = 0
+
+    @property
+    def remaining_tokens(self) -> int | None:
+        """Output-token budget left after what was already emitted.
+        None = unbounded (max_tokens is None)."""
+        mt = self.sampling_params.max_tokens
+        if mt is None:
+            return None
+        return mt - len(self.emitted_token_ids)
+
+    @property
+    def replayable(self) -> bool:
+        so = getattr(self.sampling_params, "structured_outputs", None)
+        if so is not None and getattr(so, "is_set", False):
+            return False
+        return True
+
+    def make_resume_request(self) -> EngineCoreRequest:
+        """EngineCoreRequest continuing this request from its journal.
+
+        Same request_id (the frontend's detokenizer/stream state keys on
+        it); prompt = original prompt + emitted tokens; max/min_tokens
+        decremented by the emitted count. Caller must check
+        ``remaining_tokens``/``replayable`` first.
+        """
+        params = copy.deepcopy(self.sampling_params)
+        done = len(self.emitted_token_ids)
+        if params.max_tokens is not None:
+            params.max_tokens = params.max_tokens - done
+            assert params.max_tokens >= 1, "caller must finish, not resume"
+        if getattr(params, "min_tokens", 0):
+            params.min_tokens = max(0, params.min_tokens - done)
+        req = EngineCoreRequest(
+            request_id=self.request_id,
+            prompt_token_ids=self.prompt_token_ids
+            + self.emitted_token_ids,
+            sampling_params=params,
+            arrival_time=self.arrival_time,
+            eos_token_id=self.eos_token_id,
+            priority=self.priority,
+            lora_name=self.lora_name,
+            mm_inputs=self.mm_inputs,
+            pooling_params=self.pooling_params,
+        )
+        if self.prompt_text is not None:
+            req.prompt_text = self.prompt_text
+        return req
+
+
+class RequestJournal:
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._entries: dict[str, JournalEntry] = {}
+        # Cumulative event counters (exported via /metrics).
+        self.requests_replayed_total = 0
+        self.requests_failed_on_crash_total = 0
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._entries)
+
+    def record_admitted(self, req: EngineCoreRequest) -> JournalEntry:
+        entry = JournalEntry(
+            request_id=req.request_id,
+            prompt_token_ids=list(req.prompt_token_ids),
+            sampling_params=req.sampling_params,
+            eos_token_id=req.eos_token_id,
+            priority=req.priority,
+            lora_name=req.lora_name,
+            mm_inputs=req.mm_inputs,
+            pooling_params=req.pooling_params,
+            arrival_time=req.arrival_time,
+            prompt_text=getattr(req, "prompt_text", None),
+        )
+        with self._lock:
+            self._entries[req.request_id] = entry
+        return entry
+
+    def record_tokens(self, request_id: str,
+                      token_ids: list[int]) -> None:
+        with self._lock:
+            entry = self._entries.get(request_id)
+            if entry is not None and token_ids:
+                entry.emitted_token_ids.extend(token_ids)
+
+    def record_finished(self, request_id: str) -> None:
+        with self._lock:
+            self._entries.pop(request_id, None)
+
+    def discard(self, request_id: str) -> None:
+        with self._lock:
+            self._entries.pop(request_id, None)
+
+    def get(self, request_id: str) -> JournalEntry | None:
+        with self._lock:
+            return self._entries.get(request_id)
+
+    def note_replayed(self, request_id: str) -> None:
+        with self._lock:
+            entry = self._entries.get(request_id)
+            if entry is not None:
+                entry.retries += 1
+            self.requests_replayed_total += 1
+
+    def note_failed(self, request_id: str) -> None:
+        with self._lock:
+            self._entries.pop(request_id, None)
+            self.requests_failed_on_crash_total += 1

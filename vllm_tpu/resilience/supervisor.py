@@ -1,0 +1,98 @@
+"""Engine-core supervisor: restart budget, backoff schedule, liveness map.
+
+The supervisor is policy + bookkeeping only — the respawn *mechanics*
+(socket teardown, process spawn, READY wait) live in the owning client,
+which knows its wire topology. Thread-safe: the AsyncLLM busy-loop thread
+mutates it while the event loop (``/health``, ``/ready``, ``/metrics``)
+reads snapshots.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass, field
+
+from vllm_tpu.resilience.config import ResilienceConfig
+
+
+@dataclass
+class EngineStatus:
+    up: bool = True
+    restarts: int = 0
+    last_failure_t: float = 0.0
+    last_ready_t: float = field(default_factory=time.monotonic)
+
+
+class EngineSupervisor:
+    def __init__(self, config: ResilienceConfig,
+                 num_engines: int = 1) -> None:
+        self.config = config
+        self._lock = threading.Lock()
+        self._engines = {i: EngineStatus() for i in range(num_engines)}
+
+    # -- policy --------------------------------------------------------
+
+    def may_restart(self, engine_id: int) -> bool:
+        """True while the engine's restart budget is not exhausted."""
+        if not self.config.enable_recovery:
+            return False
+        with self._lock:
+            st = self._engines.setdefault(engine_id, EngineStatus())
+            return st.restarts < self.config.max_engine_restarts
+
+    def backoff_s(self, engine_id: int) -> float:
+        """Backoff before the NEXT spawn attempt: base * 2**(restarts-1),
+        capped. Call after record_failure (restarts >= 1)."""
+        with self._lock:
+            restarts = self._engines[engine_id].restarts
+        if restarts <= 0:
+            return 0.0
+        return min(
+            self.config.restart_backoff_s * (2 ** (restarts - 1)),
+            self.config.restart_backoff_max_s,
+        )
+
+    # -- bookkeeping ---------------------------------------------------
+
+    def record_failure(self, engine_id: int) -> int:
+        """Mark the engine down and consume one unit of restart budget.
+        Returns the new restart count."""
+        with self._lock:
+            st = self._engines.setdefault(engine_id, EngineStatus())
+            st.up = False
+            st.restarts += 1
+            st.last_failure_t = time.monotonic()
+            return st.restarts
+
+    def record_ready(self, engine_id: int) -> None:
+        with self._lock:
+            st = self._engines.setdefault(engine_id, EngineStatus())
+            st.up = True
+            st.last_ready_t = time.monotonic()
+
+    def record_dead(self, engine_id: int) -> None:
+        """Permanent death: down with no further restarts allowed."""
+        with self._lock:
+            st = self._engines.setdefault(engine_id, EngineStatus())
+            st.up = False
+            st.restarts = max(st.restarts, self.config.max_engine_restarts)
+
+    # -- snapshots -----------------------------------------------------
+
+    def is_up(self, engine_id: int) -> bool:
+        with self._lock:
+            st = self._engines.get(engine_id)
+            return bool(st and st.up)
+
+    def all_up(self) -> bool:
+        with self._lock:
+            return all(st.up for st in self._engines.values())
+
+    def status(self) -> dict:
+        """JSON-shaped snapshot for /health and /metrics."""
+        with self._lock:
+            return {
+                str(eid): {"up": st.up, "restarts": st.restarts}
+                for eid, st in sorted(self._engines.items())
+            }
