@@ -278,6 +278,66 @@ def test_iteration_stats_flow(async_engine):
         async_engine.stat_loggers.remove(reg)
 
 
+def test_step_phase_metrics_and_debug_requests(async_engine):
+    """Serving populates the engine-step phase histogram family and the
+    /debug/requests snapshot's recently-finished per-phase timings."""
+    import time
+
+    from vllm_tpu.metrics.prometheus import PrometheusRegistry
+
+    reg = PrometheusRegistry()
+    async_engine.stat_loggers.append(reg)
+    try:
+        async def run():
+            params = SamplingParams(
+                temperature=0.0, max_tokens=5, ignore_eos=True,
+                output_kind=RequestOutputKind.FINAL_ONLY,
+            )
+            async for _ in async_engine.generate(
+                {"prompt_token_ids": [2, 4, 6, 8]}, params, "phase-req"
+            ):
+                pass
+
+        asyncio.run(run())
+        for _ in range(50):
+            sched = reg.step_duration.series.get("schedule")
+            if sched is not None and sched.total >= 1:
+                break
+            time.sleep(0.05)
+        for phase in ("schedule", "dispatch", "finalize"):
+            h = reg.step_duration.series.get(phase)
+            assert h is not None and h.total >= 1, phase
+        rendered = reg.render()
+        for line in (
+            'vllm:engine_step_duration_seconds_bucket{phase="schedule"',
+            'vllm:engine_step_duration_seconds_count{phase="dispatch"}',
+            'vllm:engine_step_duration_seconds_sum{phase="finalize"}',
+            "vllm:engine_batch_tokens",
+            "vllm:engine_batch_occupancy",
+            "vllm:engine_step_interval_seconds",
+        ):
+            assert line in rendered, line
+        assert reg.batch_occupancy.value <= 1.0
+
+        snapshot = async_engine.debug_requests()
+        assert snapshot["num_in_flight"] == len(snapshot["in_flight"])
+        entry = next(
+            e for e in snapshot["recently_finished"]
+            if e["request_id"] == "phase-req"
+        )
+        assert entry["finish_reason"] == "length"
+        assert entry["num_output_tokens"] == 5
+        phases = entry["phases"]
+        assert phases["e2e_s"] > 0
+        assert phases["queue_s"] is not None and phases["queue_s"] >= 0
+        assert phases["prefill_s"] is not None and phases["prefill_s"] >= 0
+        assert phases["decode_s"] is not None and phases["decode_s"] >= 0
+        assert phases["detokenize_s"] >= 0
+        assert entry["peak_kv_blocks"] >= 1
+    finally:
+        async_engine.stat_loggers.remove(reg)
+
+
 def test_validation_errors(api_client):
     async def go(client):
         resp = await client.post("/v1/completions", json={"max_tokens": 4})

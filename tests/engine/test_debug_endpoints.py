@@ -1,0 +1,116 @@
+"""/debug/requests introspection endpoint and the /start_profile
+trace_dir body param, against a stub engine — no model, tier-1 fast."""
+
+from __future__ import annotations
+
+import asyncio
+
+from vllm_tpu.entrypoints.openai.api_server import build_app
+
+
+class StubCore:
+    def __init__(self):
+        self.calls = []
+
+    def start_profile(self, trace_dir=None):
+        self.calls.append(("start", trace_dir))
+
+    def stop_profile(self):
+        self.calls.append(("stop",))
+
+
+class StubEngine:
+    _dead = False
+
+    def __init__(self, snapshot=None):
+        self.engine_core = StubCore()
+        self._snapshot = snapshot if snapshot is not None else {
+            "num_in_flight": 1,
+            "in_flight": [{
+                "request_id": "r1", "trace_id": "ab12", "state": "decode",
+                "age_s": 0.5, "num_prompt_tokens": 3, "tokens_emitted": 7,
+                "kv_blocks_held": 2, "queue_s": 0.01, "ttft_s": 0.2,
+            }],
+            "recently_finished": [{
+                "request_id": "r0", "trace_id": "cd34",
+                "finish_reason": "length", "num_prompt_tokens": 4,
+                "num_output_tokens": 8, "num_cached_tokens": 0,
+                "peak_kv_blocks": 3,
+                "phases": {"queue_s": 0.02, "prefill_s": 0.1,
+                           "decode_s": 0.3, "detokenize_s": 0.001,
+                           "e2e_s": 0.42},
+            }],
+        }
+
+    def debug_requests(self):
+        return self._snapshot
+
+
+def _request(engine, method, path, **kw):
+    from aiohttp.test_utils import TestClient, TestServer
+
+    async def run():
+        app = build_app(engine, "stub")
+        async with TestClient(TestServer(app)) as client:
+            resp = await client.request(method, path, **kw)
+            return resp.status, await resp.json()
+
+    return asyncio.run(run())
+
+
+def test_debug_requests_returns_both_views():
+    engine = StubEngine()
+    status, body = _request(engine, "GET", "/debug/requests")
+    assert status == 200
+    assert body == engine.debug_requests()
+    assert body["in_flight"][0]["state"] == "decode"
+    assert body["recently_finished"][0]["phases"]["e2e_s"] == 0.42
+
+
+def test_debug_requests_unsupported_engine_is_501():
+    class Bare:
+        _dead = False
+
+    status, body = _request(Bare(), "GET", "/debug/requests")
+    assert status == 501
+    assert "error" in body
+
+
+def test_start_profile_passes_trace_dir():
+    engine = StubEngine()
+    status, body = _request(engine, "POST", "/start_profile",
+                            json={"trace_dir": "/tmp/prof"})
+    assert status == 200
+    assert body["trace_dir"] == "/tmp/prof"
+    assert engine.engine_core.calls == [("start", "/tmp/prof")]
+
+
+def test_start_profile_without_body_defaults():
+    engine = StubEngine()
+    status, body = _request(engine, "POST", "/start_profile")
+    assert status == 200
+    assert body["trace_dir"] is None
+    assert engine.engine_core.calls == [("start", None)]
+
+
+def test_start_profile_rejects_bad_body():
+    engine = StubEngine()
+    status, _ = _request(engine, "POST", "/start_profile",
+                         data=b"not json",
+                         headers={"Content-Type": "application/json"})
+    assert status == 400
+    status, _ = _request(engine, "POST", "/start_profile",
+                         json={"trace_dir": 42})
+    assert status == 400
+    assert engine.engine_core.calls == []
+
+
+def test_stop_profile_roundtrip():
+    engine = StubEngine()
+    status, _ = _request(engine, "POST", "/start_profile",
+                         json={"trace_dir": "/tmp/p"})
+    assert status == 200
+    status, body = _request(engine, "POST", "/stop_profile")
+    assert status == 200
+    assert body == {"status": "profiling stopped"}
+    assert engine.engine_core.calls == [("start", "/tmp/p"), ("stop",)]

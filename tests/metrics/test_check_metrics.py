@@ -1,0 +1,31 @@
+"""Tier-1 wrapper for the registry lint in ``tools/check_metrics.py``:
+every metric attribute renders on /metrics, names match the vllm:
+namespace grammar, docs are non-empty."""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__))))
+
+
+def test_registry_lint_clean():
+    sys.path.insert(0, os.path.join(_REPO_ROOT, "tools"))
+    try:
+        import check_metrics
+    finally:
+        sys.path.pop(0)
+    assert check_metrics.check() == []
+
+
+def test_lint_cli_exit_code():
+    proc = subprocess.run(
+        [sys.executable, os.path.join(_REPO_ROOT, "tools",
+                                      "check_metrics.py")],
+        capture_output=True, text=True, timeout=120,
+    )
+    assert proc.returncode == 0, proc.stderr
+    assert "ok:" in proc.stdout

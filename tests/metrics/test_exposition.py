@@ -1,0 +1,189 @@
+"""Strict Prometheus text-exposition grammar check of the full /metrics
+render, plus the engine-step phase metric family and the monotonic
+resilience-counter refresh. Stub-only, tier-1 fast."""
+
+from __future__ import annotations
+
+import re
+
+from vllm_tpu.core.sched_output import SchedulerStats
+from vllm_tpu.metrics.prometheus import PrometheusRegistry
+from vllm_tpu.metrics.stats import IterationStats
+
+HELP_RE = re.compile(r"^# HELP (vllm:[a-z0-9_]+) (\S.*)$")
+TYPE_RE = re.compile(r"^# TYPE (vllm:[a-z0-9_]+) (counter|gauge|histogram)$")
+VALUE = r"-?\d+(?:\.\d+)?(?:[eE][+-]?\d+)?"
+SAMPLE_RE = re.compile(
+    r"^(vllm:[a-z0-9_]+)"
+    r'(\{[a-zA-Z_][a-zA-Z0-9_]*="[^"\\\n]*"'
+    r'(?:,[a-zA-Z_][a-zA-Z0-9_]*="[^"\\\n]*")*\})?'
+    rf" ({VALUE})$"
+)
+
+
+class _StubEngine:
+    def __init__(self):
+        self.restarts = {"0": 1.0, "1": 3.0}
+
+    def resilience_status(self):
+        return {
+            "engine_dead": False,
+            "engines": {
+                eid: {"up": True, "restarts": n}
+                for eid, n in self.restarts.items()
+            },
+            "requests_replayed_total": 5,
+            "requests_failed_on_crash_total": 2,
+        }
+
+
+def _populated_registry() -> PrometheusRegistry:
+    reg = PrometheusRegistry(_StubEngine())
+    stats = SchedulerStats(
+        num_running_reqs=2, num_waiting_reqs=1, kv_cache_usage=0.25,
+        queue_times=[0.01, 0.3], spec_accept_lengths=[2],
+        bucket_compiles=1, bucket_hits=9, pipeline_stall_s=0.1,
+        step_schedule_times=[0.0002, 0.0009],
+        step_dispatch_times=[0.004],
+        step_finalize_times=[0.0001],
+        batch_num_tokens=96, batch_num_reqs=3, batch_occupancy=0.75,
+        step_interval_s=0.006,
+    )
+    it = IterationStats(
+        num_generation_tokens=12, num_prompt_tokens=7,
+        ttfts=[0.05], inter_token_latencies=[0.01, 0.02],
+        e2e_latencies=[0.4], finished_reasons=["stop", "length"],
+    )
+    reg.record(stats, it)
+    return reg
+
+
+def _labels_without_le(labels: str | None) -> str:
+    if not labels:
+        return ""
+    parts = [p for p in labels[1:-1].split(",") if not p.startswith("le=")]
+    return ",".join(parts)
+
+
+def test_full_render_line_grammar():
+    """Every line of the full /metrics render is either a HELP, the TYPE
+    paired right after it, or a well-formed sample of the current family;
+    histogram families satisfy the +Inf/_sum/_count invariants per label
+    set with cumulative bucket counts."""
+    text = _populated_registry().render()
+    assert text.endswith("\n")
+
+    current: str | None = None  # family name from the last HELP
+    typed: dict[str, str] = {}
+    samples: dict[str, list] = {}
+    prev_line_was_help = False
+    for line in text.splitlines():
+        m = HELP_RE.match(line)
+        if m:
+            name = m.group(1)
+            assert name not in typed, f"duplicate family {name}"
+            current = name
+            prev_line_was_help = True
+            continue
+        m = TYPE_RE.match(line)
+        if m:
+            assert prev_line_was_help, f"TYPE without HELP: {line}"
+            assert m.group(1) == current, f"TYPE name mismatch: {line}"
+            typed[current] = m.group(2)
+            prev_line_was_help = False
+            continue
+        prev_line_was_help = False
+        assert not line.startswith("#"), f"unknown comment line: {line!r}"
+        m = SAMPLE_RE.match(line)
+        assert m, f"malformed sample line: {line!r}"
+        name, labels, value = m.group(1), m.group(2), m.group(3)
+        assert current in typed, f"sample before TYPE: {line!r}"
+        base = name
+        for suffix in ("_bucket", "_sum", "_count"):
+            if typed[current] == "histogram" and name.endswith(suffix):
+                base = name[: -len(suffix)]
+                break
+        assert base == current, (
+            f"sample {name} outside its family block ({current})")
+        if typed[current] == "histogram":
+            assert name != current, (
+                f"bare histogram sample {line!r}: histograms expose only "
+                f"_bucket/_sum/_count series")
+        samples.setdefault(current, []).append((name, labels, float(value)))
+
+    assert typed, "no metric families rendered"
+    # Every family carries its declared TYPE; histogram invariants hold
+    # per label set.
+    for family, typ in typed.items():
+        if typ != "histogram":
+            continue
+        by_labelset: dict[str, dict] = {}
+        for name, labels, value in samples.get(family, []):
+            key = _labels_without_le(labels)
+            d = by_labelset.setdefault(
+                key, {"buckets": [], "sum": None, "count": None})
+            if name.endswith("_bucket"):
+                le = re.search(r'le="([^"]+)"', labels or "")
+                assert le, f"bucket without le: {name}{labels}"
+                d["buckets"].append((le.group(1), value))
+            elif name.endswith("_sum"):
+                assert d["sum"] is None, f"duplicate _sum for {family}"
+                d["sum"] = value
+            elif name.endswith("_count"):
+                assert d["count"] is None, f"duplicate _count for {family}"
+                d["count"] = value
+        assert by_labelset, f"histogram {family} rendered no samples"
+        for key, d in by_labelset.items():
+            les = [b[0] for b in d["buckets"]]
+            assert les[-1] == "+Inf", f"{family}{{{key}}}: no +Inf bucket"
+            counts = [b[1] for b in d["buckets"]]
+            assert counts == sorted(counts), (
+                f"{family}{{{key}}}: bucket counts not cumulative")
+            assert d["sum"] is not None, f"{family}{{{key}}}: missing _sum"
+            assert d["count"] is not None, (
+                f"{family}{{{key}}}: missing _count")
+            assert d["count"] == counts[-1], (
+                f"{family}{{{key}}}: +Inf bucket != _count")
+
+
+def test_step_phase_family_renders_per_phase():
+    text = _populated_registry().render()
+    assert (
+        'vllm:engine_step_duration_seconds_count{phase="schedule"} 2'
+        in text
+    )
+    assert (
+        'vllm:engine_step_duration_seconds_count{phase="dispatch"} 1'
+        in text
+    )
+    assert (
+        'vllm:engine_step_duration_seconds_count{phase="finalize"} 1'
+        in text
+    )
+    assert "vllm:engine_batch_tokens 96" in text
+    assert "vllm:engine_batch_requests 3" in text
+    assert "vllm:engine_batch_occupancy 0.75" in text
+    assert "vllm:engine_step_interval_seconds 0.006" in text
+
+
+def test_resilience_counters_never_decrease():
+    """A render racing an engine respawn (snapshot counters briefly reset
+    to zero) must not show a counter decrease — scrapers read that as a
+    process restart and corrupt rate() windows."""
+    engine = _StubEngine()
+    reg = PrometheusRegistry(engine)
+    text = reg.render()
+    assert 'vllm:engine_restarts_total{engine_id="1"} 3.0' in text
+    assert "vllm:requests_replayed_total 5.0" in text
+
+    # Snapshot resets (fresh supervisor state after a respawn).
+    engine.restarts = {"0": 0.0, "1": 0.0}
+    text = reg.render()
+    assert 'vllm:engine_restarts_total{engine_id="0"} 1.0' in text
+    assert 'vllm:engine_restarts_total{engine_id="1"} 3.0' in text
+
+    # And the ratchet still follows genuine increases.
+    engine.restarts = {"0": 2.0, "1": 4.0}
+    text = reg.render()
+    assert 'vllm:engine_restarts_total{engine_id="0"} 2.0' in text
+    assert 'vllm:engine_restarts_total{engine_id="1"} 4.0' in text

@@ -1,0 +1,93 @@
+#!/usr/bin/env python3
+"""Lint the Prometheus registry for exposition hygiene.
+
+Checks, against a freshly constructed ``PrometheusRegistry``:
+
+- every metric attribute on the registry is listed in ``_metrics`` (a
+  metric recorded but missing from the list silently never renders on
+  ``/metrics``), and vice versa (no orphans in the render list);
+- metric names match ``vllm:[a-z0-9_]+`` and are unique;
+- every metric has non-empty HELP documentation.
+
+Run standalone (``python tools/check_metrics.py``, exit 1 on failure)
+or via the tier-1 wrapper ``tests/metrics/test_check_metrics.py``.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import sys
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO_ROOT not in sys.path:
+    sys.path.insert(0, _REPO_ROOT)
+
+NAME_RE = re.compile(r"^vllm:[a-z0-9_]+$")
+
+
+def check() -> list[str]:
+    """Return a list of lint errors (empty = clean)."""
+    from vllm_tpu.metrics.prometheus import (
+        Counter,
+        Gauge,
+        Histogram,
+        LabeledCounter,
+        LabeledGauge,
+        LabeledHistogram,
+        PrometheusRegistry,
+    )
+
+    metric_types = (Counter, Gauge, Histogram, LabeledCounter,
+                    LabeledGauge, LabeledHistogram)
+    reg = PrometheusRegistry()
+    errors: list[str] = []
+
+    attr_metrics = [
+        (attr, m) for attr, m in vars(reg).items()
+        if isinstance(m, metric_types)
+    ]
+    listed_ids = {id(m) for m in reg._metrics}
+    attr_ids = {id(m) for _, m in attr_metrics}
+
+    for attr, m in attr_metrics:
+        if id(m) not in listed_ids:
+            errors.append(
+                f"registry.{attr} ({m.name}) is not in _metrics — "
+                f"it will never render on /metrics")
+    for m in reg._metrics:
+        if id(m) not in attr_ids:
+            errors.append(
+                f"_metrics entry {m.name} is not a registry attribute")
+
+    seen: dict[str, str] = {}
+    for attr, m in attr_metrics:
+        if not NAME_RE.match(m.name):
+            errors.append(
+                f"registry.{attr}: name {m.name!r} does not match "
+                f"vllm:[a-z0-9_]+")
+        if not (getattr(m, "doc", "") or "").strip():
+            errors.append(f"registry.{attr} ({m.name}): empty HELP doc")
+        if m.name in seen:
+            errors.append(
+                f"duplicate metric name {m.name} "
+                f"(registry.{seen[m.name]} and registry.{attr})")
+        else:
+            seen[m.name] = attr
+
+    return errors
+
+
+def main() -> int:
+    errors = check()
+    for err in errors:
+        print(f"ERROR: {err}", file=sys.stderr)
+    if errors:
+        return 1
+    from vllm_tpu.metrics.prometheus import PrometheusRegistry
+    print(f"ok: {len(PrometheusRegistry()._metrics)} metrics checked")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,166 @@
+#!/usr/bin/env python3
+"""Merge per-process chrome-trace files into one Perfetto timeline.
+
+Each vllm-tpu process (frontend, spawned engine cores) writes its own
+``trace-<pid>.json`` under ``VLLM_TPU_TRACE_DIR`` (see
+``vllm_tpu/tracing.py``). This tool fuses them into a single
+chrome-trace JSON object loadable in https://ui.perfetto.dev:
+
+- per-process files are concatenated onto one timeline — timestamps are
+  ``perf_counter_ns`` (CLOCK_MONOTONIC), the same epoch for every
+  process on a host, so no clock translation is needed;
+- async request spans (``ph: b/e``) are rewritten to globally-scoped
+  ids (``id2.global``) so one request's queue/prefill/decode spans from
+  the engine-core process join the frontend's end-to-end span on a
+  single async track;
+- a flow arrow (``ph: s/t/f``) is emitted per request trace id, linking
+  its events across processes in submission order;
+- process metadata names each pid by role (engine / frontend) inferred
+  from the event categories it emitted.
+
+Files left unterminated by a killed process (trailing ``},`` with no
+closing ``]``) are repaired on read.
+
+Usage:
+    python tools/merge_traces.py TRACE_DIR [-o merged.json]
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import sys
+
+
+def load_events(path: str) -> list[dict]:
+    """Read one per-process trace file, repairing an unterminated array
+    (process killed before the atexit close ran)."""
+    with open(path, "rb") as f:
+        raw = f.read()
+    try:
+        events = json.loads(raw)
+    except json.JSONDecodeError:
+        text = raw.decode("utf-8", errors="replace").rstrip()
+        if text.endswith(","):
+            text = text[:-1]
+        if not text.endswith("]"):
+            text += "\n]"
+        events = json.loads(text)
+    if not isinstance(events, list):
+        raise ValueError(f"{path}: expected a JSON array of events")
+    return [ev for ev in events if isinstance(ev, dict)]
+
+
+def _trace_id_of(ev: dict) -> str | None:
+    args = ev.get("args")
+    if isinstance(args, dict) and args.get("trace_id"):
+        return str(args["trace_id"])
+    if ev.get("ph") in ("b", "e") and ev.get("id"):
+        return str(ev["id"])
+    return None
+
+
+def _flow_event(ph: str, flow_id: int, ev: dict) -> dict:
+    out = {
+        "name": "request",
+        "cat": "request_flow",
+        "ph": ph,
+        "id": flow_id,
+        "ts": ev.get("ts", 0),
+        "pid": ev.get("pid", 0),
+        "tid": ev.get("tid", 0),
+    }
+    if ph == "f":
+        out["bp"] = "e"  # bind to the enclosing slice's end
+    return out
+
+
+def merge(trace_dir: str) -> dict:
+    """Fuse every ``trace-*.json`` under `trace_dir` into one
+    chrome-trace object (``{"traceEvents": [...]}``)."""
+    files = sorted(glob.glob(os.path.join(trace_dir, "trace-*.json")))
+    if not files:
+        raise FileNotFoundError(f"no trace-*.json files under {trace_dir}")
+
+    events: list[dict] = []
+    for path in files:
+        try:
+            events.extend(load_events(path))
+        except (ValueError, json.JSONDecodeError) as e:
+            print(f"warning: skipping unreadable {path}: {e}",
+                  file=sys.stderr)
+
+    # Globally-scoped async ids: spans for one request emitted by
+    # different processes land on one async track instead of one per pid.
+    for ev in events:
+        if ev.get("ph") in ("b", "e") and "id" in ev:
+            ev["id2"] = {"global": str(ev.pop("id"))}
+
+    # One flow arrow per request, through its events in time order.
+    by_trace: dict[str, list[dict]] = {}
+    for ev in events:
+        tid = _trace_id_of(ev)
+        if tid is not None:
+            by_trace.setdefault(tid, []).append(ev)
+    flows: list[dict] = []
+    for trace_id, evs in by_trace.items():
+        if len(evs) < 2:
+            continue
+        evs.sort(key=lambda e: e.get("ts", 0))
+        flow_id = int(trace_id, 16) if all(
+            c in "0123456789abcdef" for c in trace_id
+        ) else abs(hash(trace_id))
+        flows.append(_flow_event("s", flow_id, evs[0]))
+        last_pid = evs[0].get("pid")
+        for ev in evs[1:-1]:
+            if ev.get("pid") != last_pid:
+                flows.append(_flow_event("t", flow_id, ev))
+                last_pid = ev.get("pid")
+        flows.append(_flow_event("f", flow_id, evs[-1]))
+
+    # Name each process by the categories it emitted: engine-step spans
+    # only come from an engine core; a pure frontend has none.
+    pid_cats: dict[int, set] = {}
+    for ev in events:
+        pid_cats.setdefault(ev.get("pid", 0), set()).add(ev.get("cat"))
+    meta = []
+    for pid, cats in sorted(pid_cats.items()):
+        role = "engine-core" if "engine" in cats else "frontend"
+        meta.append({
+            "name": "process_name", "ph": "M", "pid": pid, "tid": 0,
+            "args": {"name": f"vllm-tpu {role} (pid {pid})"},
+        })
+
+    events.sort(key=lambda e: e.get("ts", 0))
+    return {"traceEvents": meta + events + flows,
+            "displayTimeUnit": "ms"}
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(
+        description=__doc__.splitlines()[0],
+    )
+    ap.add_argument("trace_dir",
+                    help="directory holding per-process trace-*.json files")
+    ap.add_argument("-o", "--output", default=None,
+                    help="output path (default: TRACE_DIR/merged.json)")
+    args = ap.parse_args(argv)
+    try:
+        merged = merge(args.trace_dir)
+    except FileNotFoundError as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 1
+    out = args.output or os.path.join(args.trace_dir, "merged.json")
+    with open(out, "w") as f:
+        json.dump(merged, f)
+    n_req = sum(1 for ev in merged["traceEvents"]
+                if ev.get("ph") == "s" and ev.get("cat") == "request_flow")
+    print(f"wrote {out}: {len(merged['traceEvents'])} events, "
+          f"{n_req} request flows")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

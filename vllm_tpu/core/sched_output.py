@@ -134,6 +134,11 @@ class EngineCoreOutput:
     # Prompt logprobs covered by this step's chunk:
     # (chunk_start, [(topk_ids, topk_vals, token, token_lp, rank), ...]).
     prompt_logprobs_delta: Any = None
+    # Observability (feeds the frontend's per-request RequestTimings and
+    # /debug/requests): waiting->running delay measured at first schedule,
+    # and the KV blocks currently held engine-side for this request.
+    queue_time: float | None = None
+    kv_blocks_held: int = 0
 
 
 @dataclass
@@ -160,6 +165,18 @@ class SchedulerStats:
     bucket_compiles: int = 0
     bucket_hits: int = 0
     pipeline_stall_s: float = 0.0
+    # Engine-step phase durations (drained each snapshot, seconds) —
+    # attached by EngineCore from the schedule/dispatch/finalize sites;
+    # feed the vllm:engine_step_duration_seconds histogram family.
+    step_schedule_times: list[float] = field(default_factory=list)
+    step_dispatch_times: list[float] = field(default_factory=list)
+    step_finalize_times: list[float] = field(default_factory=list)
+    # Last dispatched batch occupancy (tokens, requests, and the fraction
+    # of the token budget used) + wall time between step completions.
+    batch_num_tokens: int = 0
+    batch_num_reqs: int = 0
+    batch_occupancy: float = 0.0
+    step_interval_s: float = 0.0
 
 
 @dataclass

@@ -626,9 +626,10 @@ class Scheduler:
                 # First scheduling: queue delay = arrival -> now
                 # (reference: request queue_time metric,
                 # vllm/v1/metrics/loggers.py request_queue_time_seconds).
-                self._queue_times.append(
-                    max(0.0, time.monotonic() - request.arrival_time)
+                request.queue_time = max(
+                    0.0, time.monotonic() - request.arrival_time
                 )
+                self._queue_times.append(request.queue_time)
             request.status = RequestStatus.RUNNING
             self.running.append(request)
             if request.num_cached_tokens < 0:
@@ -994,6 +995,12 @@ class Scheduler:
                         new_logprobs=new_logprobs,
                         prompt_logprobs_delta=prompt_lp_delta,
                         num_cached_tokens=max(request.num_cached_tokens, 0),
+                        queue_time=request.queue_time,
+                        kv_blocks_held=len(
+                            self.kv_cache_manager.req_to_blocks.get(
+                                req_id, ()
+                            )
+                        ),
                     )
                 )
 

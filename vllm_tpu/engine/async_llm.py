@@ -129,6 +129,7 @@ class AsyncLLM:
             core_req.sampling_params,
             core_req.arrival_time,
             queue=out_q,
+            trace_id=core_req.trace_id,
         )
         if self.journal is not None:
             self.journal.record_admitted(core_req)
@@ -325,6 +326,13 @@ class AsyncLLM:
                 if self.journal is not None else 0
             ),
         }
+
+    def debug_requests(self) -> dict:
+        """Live request introspection (/debug/requests): in-flight
+        requests (state, age, tokens emitted, KV blocks held) plus the
+        bounded ring of recently finished requests with their per-phase
+        timing breakdown."""
+        return self.output_processor.debug_snapshot()
 
     def is_ready(self) -> bool:
         """All engines initialized and up (readiness, distinct from

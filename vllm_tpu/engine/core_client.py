@@ -36,6 +36,7 @@ from vllm_tpu.core.sched_output import EngineCoreOutputs
 from vllm_tpu.logger import init_logger
 from vllm_tpu.request import EngineCoreRequest
 from vllm_tpu.resilience import EngineRestartedError, EngineSupervisor
+from vllm_tpu.tracing import trace_instant
 
 logger = init_logger(__name__)
 
@@ -584,6 +585,11 @@ class MPClient(_ZMQClientBase):
 
     def add_request(self, req: EngineCoreRequest) -> None:
         self._check_alive()
+        # The trace id crosses the process boundary inside the encoded
+        # request; this instant marks the frontend side of the hop.
+        trace_instant(
+            "request_send", req_id=req.request_id, trace_id=req.trace_id,
+        )
         self._input.send_multipart(
             [self._proc_mod.MSG_ADD, self._serial.encode(req)]
         )
@@ -934,6 +940,10 @@ class DPLBClient(_ZMQClientBase):
         )
         self._live[req.request_id] = eid
         self._engine_inflight[eid] += 1
+        trace_instant(
+            "request_send", req_id=req.request_id, trace_id=req.trace_id,
+            engine_id=eid,
+        )
         self._report_inflight()  # before the add: wave opens first
         self._inputs[eid].send_multipart(
             [self._proc_mod.MSG_ADD, self._serial.encode(req)]

@@ -17,7 +17,13 @@ from vllm_tpu.core.sched_output import EngineCoreOutputs
 from vllm_tpu.core.scheduler import Scheduler
 from vllm_tpu.engine.executor import Executor
 from vllm_tpu.logger import init_logger
-from vllm_tpu.tracing import trace_instant, trace_span
+from vllm_tpu.tracing import (
+    trace_async_begin,
+    trace_async_end,
+    trace_enabled,
+    trace_instant,
+    trace_span,
+)
 from vllm_tpu.request import EngineCoreRequest, Request, RequestStatus
 
 logger = init_logger(__name__)
@@ -47,6 +53,21 @@ class EngineCore:
         # Cumulative seconds blocked fetching device results (lag-pipeline
         # stall; exported via SchedulerStats.pipeline_stall_s).
         self._stall_s = 0.0
+        # Per-phase step durations accumulated since the last stats
+        # snapshot (drained into SchedulerStats by _attach_engine_stats).
+        self._phase_times: dict[str, list[float]] = {
+            "schedule": [], "dispatch": [], "finalize": [],
+        }
+        # Last dispatched batch occupancy + step-completion timestamps
+        # (step-interval gauge).
+        self._last_batch: tuple[int, int] = (0, 0)
+        self._last_step_end: float | None = None
+        self._step_interval_s = 0.0
+        # Request lifecycle phase per in-flight request, keyed by req id:
+        # (trace_id, "queue" | "prefill" | "decode"). Only populated while
+        # tracing is enabled — the async b/e span bookkeeping is pure
+        # overhead otherwise.
+        self._req_trace_phase: dict[str, tuple[str, str]] = {}
         # Outputs finalized outside step() (elastic-resize drain) waiting
         # for the next step() call to deliver them.
         self._drained_outputs: deque = deque()
@@ -124,11 +145,23 @@ class EngineCore:
         req = Request.from_engine_core_request(request, self._block_hasher)
         trace_instant(
             "request_arrival", req_id=request.request_id,
+            trace_id=request.trace_id,
             prompt_tokens=len(request.prompt_token_ids),
         )
+        if trace_enabled() and request.trace_id is not None:
+            self._req_trace_phase[request.request_id] = (
+                request.trace_id, "queue"
+            )
+            trace_async_begin(
+                "queue", request.trace_id, req_id=request.request_id
+            )
         self.scheduler.add_request(req)
 
     def abort_requests(self, request_ids: Iterable[str]) -> None:
+        for rid in request_ids:
+            entry = self._req_trace_phase.pop(rid, None)
+            if entry is not None:
+                trace_async_end(entry[1], entry[0], req_id=rid)
         self.scheduler.finish_requests(request_ids, RequestStatus.FINISHED_ABORTED)
 
     def has_unfinished_requests(self) -> bool:
@@ -172,8 +205,10 @@ class EngineCore:
             len(self._inflight) < self._max_inflight
             and self.scheduler.has_unfinished_requests()
         ):
+            t0 = time.monotonic()
             with trace_span("schedule"):
                 scheduler_output = self.scheduler.schedule()
+            self._phase_times["schedule"].append(time.monotonic() - t0)
             if scheduler_output.total_num_scheduled_tokens == 0:
                 # Not dispatched: hand the drained finished ids (and any
                 # encoder-cache frees) back so the runner still gets them
@@ -187,12 +222,33 @@ class EngineCore:
                     + self.scheduler._pending_encoder_frees
                 )
                 break
+            if self._req_trace_phase:
+                # Newly scheduled requests leave the queue and enter
+                # prefill (resumed-from-preemption requests live in the
+                # cached set and keep their current phase).
+                for nrd in scheduler_output.scheduled_new_reqs:
+                    entry = self._req_trace_phase.get(nrd.req_id)
+                    if entry is not None and entry[1] == "queue":
+                        trace_async_end("queue", entry[0], req_id=nrd.req_id)
+                        trace_async_begin(
+                            "prefill", entry[0], req_id=nrd.req_id,
+                            prompt_tokens=len(nrd.prompt_token_ids),
+                        )
+                        self._req_trace_phase[nrd.req_id] = (
+                            entry[0], "prefill"
+                        )
+            t0 = time.monotonic()
             with trace_span(
                 "dispatch",
                 tokens=scheduler_output.total_num_scheduled_tokens,
                 reqs=scheduler_output.num_reqs,
             ):
                 handle = self.executor.dispatch(scheduler_output)
+            self._phase_times["dispatch"].append(time.monotonic() - t0)
+            self._last_batch = (
+                scheduler_output.total_num_scheduled_tokens,
+                scheduler_output.num_reqs,
+            )
             self._inflight.append((scheduler_output, handle))
         if not self._inflight:
             failed = self.scheduler.drain_failed()
@@ -203,18 +259,46 @@ class EngineCore:
             runner_output = self.executor.finalize(handle)
             # Time blocked on the device fetch: ~0 when the lag-N overlap
             # is winning, the whole device step when it is not.
-            self._stall_s += time.monotonic() - t0
+            stall = time.monotonic() - t0
+            self._stall_s += stall
+        self._phase_times["finalize"].append(stall)
         outputs = self.scheduler.update_from_output(
             scheduler_output, runner_output
         )
+        now = time.monotonic()
+        if self._last_step_end is not None:
+            self._step_interval_s = now - self._last_step_end
+        self._last_step_end = now
         self._attach_engine_stats(outputs)
         for o in outputs.outputs:
+            if self._req_trace_phase:
+                self._trace_request_progress(o)
             if o.finish_reason is not None:
                 trace_instant(
                     "request_finish", req_id=o.req_id,
                     finish_reason=str(o.finish_reason),
                 )
         return outputs
+
+    def _trace_request_progress(self, o) -> None:
+        """Advance a request's async lifecycle span on its outputs: first
+        token closes prefill and opens decode; a finish closes whatever
+        phase the request was in."""
+        entry = self._req_trace_phase.get(o.req_id)
+        if entry is None:
+            return
+        trace_id, phase = entry
+        if o.new_token_ids and phase == "prefill":
+            trace_async_end("prefill", trace_id, req_id=o.req_id)
+            trace_async_begin("decode", trace_id, req_id=o.req_id)
+            phase = "decode"
+            self._req_trace_phase[o.req_id] = (trace_id, phase)
+        if o.finish_reason is not None:
+            trace_async_end(
+                phase, trace_id, req_id=o.req_id,
+                finish_reason=str(o.finish_reason),
+            )
+            del self._req_trace_phase[o.req_id]
 
     def _attach_engine_stats(self, outputs: EngineCoreOutputs) -> None:
         """Fold engine/worker-side counters into the step's stats snapshot
@@ -225,6 +309,18 @@ class EngineCore:
         if stats is None:
             return
         stats.pipeline_stall_s = self._stall_s
+        # Drain the per-phase step durations accumulated since the last
+        # snapshot into this one (exactly-once export).
+        stats.step_schedule_times = self._phase_times["schedule"]
+        stats.step_dispatch_times = self._phase_times["dispatch"]
+        stats.step_finalize_times = self._phase_times["finalize"]
+        self._phase_times = {"schedule": [], "dispatch": [], "finalize": []}
+        stats.batch_num_tokens, stats.batch_num_reqs = self._last_batch
+        budget = self.config.scheduler_config.max_num_batched_tokens
+        stats.batch_occupancy = (
+            stats.batch_num_tokens / budget if budget else 0.0
+        )
+        stats.step_interval_s = self._step_interval_s
         runner = getattr(
             getattr(self.executor, "worker", None), "runner", None
         )

@@ -327,6 +327,8 @@ class InputProcessor:
         if self.tokenizer is not None:
             eos_token_id = self.tokenizer.eos_token_id
 
+        from vllm_tpu.tracing import new_trace_id, trace_enabled
+
         req = EngineCoreRequest(
             request_id=request_id,
             prompt_token_ids=prompt_token_ids,
@@ -336,6 +338,10 @@ class InputProcessor:
             priority=priority,
             pooling_params=pooling_params,
             mm_inputs=mm_inputs,
+            # Trace correlation is assigned HERE, at the frontend: the id
+            # rides the core-client wire so engine-core / worker spans for
+            # this request fuse with the frontend's in a merged timeline.
+            trace_id=new_trace_id() if trace_enabled() else None,
         )
         req.prompt_text = prompt_text  # carried for outputs
         return req

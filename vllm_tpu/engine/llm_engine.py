@@ -58,8 +58,13 @@ class LLMEngine:
             core_req.prompt_token_ids,
             core_req.sampling_params,
             core_req.arrival_time,
+            trace_id=core_req.trace_id,
         )
         self.engine_core.add_request(core_req)
+
+    def debug_requests(self) -> dict:
+        """Live request introspection (mirrors AsyncLLM.debug_requests)."""
+        return self.output_processor.debug_snapshot()
 
     def abort_request(self, request_ids: list[str]) -> None:
         self.engine_core.abort_requests(request_ids)

@@ -8,6 +8,7 @@ aborts flowing back to the engine core.
 from __future__ import annotations
 
 import time
+from collections import deque
 from dataclasses import dataclass, field
 from typing import Any
 
@@ -20,6 +21,7 @@ from vllm_tpu.outputs import (
     RequestOutput,
 )
 from vllm_tpu.sampling_params import RequestOutputKind, SamplingParams
+from vllm_tpu.tracing import trace_async_begin, trace_async_end, trace_span
 
 
 class RequestState:
@@ -32,6 +34,7 @@ class RequestState:
         tokenizer: Any,
         arrival_time: float,
         queue: Any | None = None,
+        trace_id: str | None = None,
     ) -> None:
         self.request_id = request_id
         self.prompt_text = prompt_text
@@ -43,6 +46,15 @@ class RequestState:
         self.metrics = RequestMetrics(arrival_time=arrival_time)
         self.last_token_time = arrival_time
         self.logprobs: list[dict[int, Logprob]] = []
+        # Observability: trace correlation id + per-request timing fields
+        # folded into a RequestTimings record at finish (/debug/requests).
+        self.trace_id = trace_id
+        self.queue_time: float | None = None  # engine-reported
+        self.detokenize_s = 0.0
+        self.kv_blocks_held = 0  # last engine-reported
+        self.peak_kv_blocks = 0
+        self.num_output_tokens = 0
+        self.num_cached_tokens = 0
         # Prompt logprobs: None for position 0, then one dict per prompt
         # token (assembled across prefill chunks).
         self.prompt_logprobs: list | None = (
@@ -97,6 +109,9 @@ class ProcessedOutputs:
 
 
 class OutputProcessor:
+    # Recently finished requests kept for /debug/requests introspection.
+    FINISHED_RING_SIZE = 128
+
     def __init__(self, tokenizer: Any | None = None,
                  journal: Any | None = None) -> None:
         self.tokenizer = tokenizer
@@ -106,6 +121,11 @@ class OutputProcessor:
         # interrupted by an engine crash can resume from exactly what the
         # client has already seen.
         self.journal = journal
+        # Bounded ring of RequestTimings for recently finished requests
+        # (the live-introspection "where did request X spend its time"
+        # view; appended engine-thread-side, snapshotted via
+        # debug_snapshot()).
+        self.finished_timings: deque = deque(maxlen=self.FINISHED_RING_SIZE)
 
     def add_request(
         self,
@@ -115,6 +135,7 @@ class OutputProcessor:
         params: SamplingParams,
         arrival_time: float,
         queue: Any | None = None,
+        trace_id: str | None = None,
     ) -> RequestState:
         state = RequestState(
             request_id,
@@ -124,13 +145,24 @@ class OutputProcessor:
             self.tokenizer,
             arrival_time,
             queue,
+            trace_id=trace_id,
         )
         self.request_states[request_id] = state
+        # Frontend-side end-to-end request span: opened at admission,
+        # closed when the final output is processed (its engine-side
+        # children — queue/prefill/decode — share the trace id).
+        trace_async_begin("request", trace_id, req_id=request_id)
         return state
 
     def abort_requests(self, request_ids) -> None:
         for rid in request_ids:
-            self.request_states.pop(rid, None)
+            state = self.request_states.pop(rid, None)
+            if state is not None:
+                trace_async_end(
+                    "request", state.trace_id, req_id=rid,
+                    finish_reason="abort",
+                )
+                self._record_finished(state, time.monotonic(), "abort")
             if self.journal is not None:
                 self.journal.discard(rid)
 
@@ -155,7 +187,18 @@ class OutputProcessor:
             if self.journal is not None and eco.new_token_ids:
                 self.journal.record_tokens(eco.req_id, eco.new_token_ids)
 
+            if eco.queue_time is not None:
+                state.queue_time = eco.queue_time
+            if eco.kv_blocks_held:
+                state.kv_blocks_held = eco.kv_blocks_held
+                state.peak_kv_blocks = max(
+                    state.peak_kv_blocks, eco.kv_blocks_held
+                )
+            if eco.num_cached_tokens:
+                state.num_cached_tokens = eco.num_cached_tokens
+
             if eco.new_token_ids:
+                state.num_output_tokens += len(eco.new_token_ids)
                 stats.num_generation_tokens += len(eco.new_token_ids)
                 if state.metrics.first_token_time is None:
                     state.metrics.first_token_time = now
@@ -167,7 +210,13 @@ class OutputProcessor:
                     )
                 state.last_token_time = now
 
-            stop_str = state.detokenizer.update(eco.new_token_ids)
+            t_detok = time.perf_counter()
+            with trace_span(
+                "detokenize", category="request", req_id=eco.req_id,
+                trace_id=state.trace_id,
+            ):
+                stop_str = state.detokenizer.update(eco.new_token_ids)
+            state.detokenize_s += time.perf_counter() - t_detok
             finish_reason = eco.finish_reason
             stop_reason = eco.stop_reason
             if stop_str is not None and finish_reason is None:
@@ -188,6 +237,11 @@ class OutputProcessor:
                 state.metrics.finished_time = now
                 stats.e2e_latencies.append(now - state.metrics.arrival_time)
                 stats.finished_reasons.append(str(finish_reason))
+                trace_async_end(
+                    "request", state.trace_id, req_id=eco.req_id,
+                    finish_reason=str(finish_reason),
+                )
+                self._record_finished(state, now, str(finish_reason))
                 # Pop BEFORE delivering the final output: once the client
                 # sees `finished` it may re-use the request id; popping
                 # after delivery could delete the successor's state.
@@ -208,6 +262,73 @@ class OutputProcessor:
                 else:
                     result.request_outputs.append(out)
         return result
+
+    # -- live introspection (/debug/requests) --------------------------
+
+    def _record_finished(self, state: RequestState, now: float,
+                         finish_reason: str) -> None:
+        """Fold a finished request's state into a RequestTimings record
+        and push it onto the bounded recently-finished ring."""
+        from vllm_tpu.metrics.stats import RequestTimings
+
+        m = state.metrics
+        queue_s = state.queue_time
+        prefill_s = decode_s = None
+        if m.first_token_time is not None:
+            prefill_s = m.first_token_time - m.arrival_time
+            if queue_s is not None:
+                prefill_s = max(0.0, prefill_s - queue_s)
+            decode_s = max(0.0, state.last_token_time - m.first_token_time)
+        self.finished_timings.append(RequestTimings(
+            request_id=state.request_id,
+            trace_id=state.trace_id,
+            arrival_time=m.arrival_time,
+            finished_time=now,
+            finish_reason=finish_reason,
+            num_prompt_tokens=len(state.prompt_token_ids),
+            num_output_tokens=state.num_output_tokens,
+            num_cached_tokens=state.num_cached_tokens,
+            peak_kv_blocks=state.peak_kv_blocks,
+            queue_s=queue_s,
+            prefill_s=prefill_s,
+            decode_s=decode_s,
+            detokenize_s=state.detokenize_s,
+            e2e_s=max(0.0, now - m.arrival_time),
+        ))
+
+    def debug_snapshot(self) -> dict:
+        """In-flight + recently-finished request views (JSON-shaped; the
+        /debug/requests endpoint body). Safe to call from any thread: it
+        only reads, and iterates over list() copies of the shared dict."""
+        now = time.monotonic()
+        in_flight = []
+        for state in list(self.request_states.values()):
+            m = state.metrics
+            if m.first_token_time is not None:
+                phase = "decode"
+            elif state.queue_time is not None:
+                phase = "prefill"
+            else:
+                phase = "queued"
+            in_flight.append({
+                "request_id": state.request_id,
+                "trace_id": state.trace_id,
+                "state": phase,
+                "age_s": max(0.0, now - m.arrival_time),
+                "num_prompt_tokens": len(state.prompt_token_ids),
+                "tokens_emitted": state.num_output_tokens,
+                "kv_blocks_held": state.kv_blocks_held,
+                "queue_s": state.queue_time,
+                "ttft_s": m.ttft,
+            })
+        recent = [
+            t.as_dict() for t in reversed(list(self.finished_timings))
+        ]
+        return {
+            "num_in_flight": len(in_flight),
+            "in_flight": in_flight,
+            "recently_finished": recent,
+        }
 
     def _append_prompt_logprobs(self, state: RequestState, delta) -> None:
         """delta = (chunk_start, entries); entries cover prompt tokens

@@ -442,8 +442,21 @@ async def handle_models(request: web.Request) -> web.Response:
 
 async def handle_start_profile(request: web.Request) -> web.Response:
     engine: AsyncLLM = request.app[ENGINE_KEY]
-    engine.engine_core.start_profile()
-    return web.json_response({"status": "profiling started"})
+    trace_dir = None
+    if request.can_read_body:
+        try:
+            body = await request.json()
+        except Exception:
+            return web.json_response(
+                {"error": "request body must be JSON"}, status=400)
+        if isinstance(body, dict):
+            trace_dir = body.get("trace_dir")
+            if trace_dir is not None and not isinstance(trace_dir, str):
+                return web.json_response(
+                    {"error": "trace_dir must be a string"}, status=400)
+    engine.engine_core.start_profile(trace_dir=trace_dir)
+    return web.json_response(
+        {"status": "profiling started", "trace_dir": trace_dir})
 
 
 async def handle_stop_profile(request: web.Request) -> web.Response:
@@ -493,6 +506,18 @@ async def handle_ready(request: web.Request) -> web.Response:
     return web.json_response(
         {"ready": ready}, status=200 if ready else 503
     )
+
+
+async def handle_debug_requests(request: web.Request) -> web.Response:
+    """Live request introspection: in-flight requests (state, age, tokens
+    emitted, KV blocks held) plus a bounded ring of recently finished
+    requests with their per-phase timing breakdown."""
+    engine: AsyncLLM = request.app[ENGINE_KEY]
+    if not hasattr(engine, "debug_requests"):
+        return web.json_response(
+            {"error": "engine does not support request introspection"},
+            status=501)
+    return web.json_response(engine.debug_requests())
 
 
 async def handle_metrics(request: web.Request) -> web.Response:
@@ -618,6 +643,7 @@ def build_app(engine: AsyncLLM, model_name: str, metrics=None,
     app.router.add_get("/ping", handle_health)
     app.router.add_get("/ready", handle_ready)
     app.router.add_get("/metrics", handle_metrics)
+    app.router.add_get("/debug/requests", handle_debug_requests)
     from vllm_tpu.entrypoints.openai.extra_apis import (
         handle_realtime,
         handle_responses,

@@ -20,6 +20,13 @@ class Counter:
     def inc(self, v: float = 1.0) -> None:
         self.value += v
 
+    def inc_to(self, v: float) -> None:
+        """Monotonic ratchet: adopt an externally tracked cumulative value
+        without ever letting the rendered counter decrease (a render
+        racing the source's reset/respawn must not show a decrease)."""
+        if v > self.value:
+            self.value = v
+
     def render(self) -> str:
         return (
             f"# HELP {self.name} {self.doc}\n# TYPE {self.name} counter\n"
@@ -69,6 +76,38 @@ class Histogram:
         return "\n".join(out) + "\n"
 
 
+class LabeledHistogram:
+    """One histogram family with a single label dimension (e.g. engine
+    step phase): per-key bucket vectors rendered under one HELP/TYPE."""
+
+    def __init__(self, name: str, doc: str, label: str,
+                 buckets: list[float]) -> None:
+        self.name, self.doc, self.label = name, doc, label
+        self.buckets = sorted(buckets)
+        self.series: dict[str, Histogram] = {}
+
+    def observe(self, key: str, v: float) -> None:
+        h = self.series.get(key)
+        if h is None:
+            h = self.series[key] = Histogram(self.name, self.doc, self.buckets)
+        h.observe(v)
+
+    def render(self) -> str:
+        out = [
+            f"# HELP {self.name} {self.doc}",
+            f"# TYPE {self.name} histogram",
+        ]
+        for key in sorted(self.series):
+            h = self.series[key]
+            kv = f'{self.label}="{key}"'
+            for b, c in zip(h.buckets, h.counts):
+                out.append(f'{self.name}_bucket{{{kv},le="{b}"}} {c}')
+            out.append(f'{self.name}_bucket{{{kv},le="+Inf"}} {h.total}')
+            out.append(f'{self.name}_sum{{{kv}}} {h.sum}')
+            out.append(f'{self.name}_count{{{kv}}} {h.total}')
+        return "\n".join(out) + "\n"
+
+
 class LabeledCounter:
     """One counter family with a single label dimension (e.g. finish
     reason)."""
@@ -80,8 +119,11 @@ class LabeledCounter:
     def inc(self, key: str, v: float = 1.0) -> None:
         self.values[key] = self.values.get(key, 0.0) + v
 
-    def set(self, key: str, v: float) -> None:
-        self.values[key] = v
+    def inc_to(self, key: str, v: float) -> None:
+        """Monotonic ratchet (see Counter.inc_to): counters refreshed from
+        a live snapshot must never render a decrease."""
+        if v > self.values.get(key, 0.0):
+            self.values[key] = v
 
     def render(self) -> str:
         out = [
@@ -171,6 +213,27 @@ class PrometheusRegistry:
         self.request_success = LabeledCounter(
             "vllm:request_success_total",
             "Finished requests by reason", "finished_reason")
+        # Engine-step phase timing (plumbed from the trace_span sites in
+        # engine_core.step via SchedulerStats): one histogram family
+        # labeled by phase, plus batch-occupancy / step-interval gauges.
+        self.step_duration = LabeledHistogram(
+            "vllm:engine_step_duration_seconds",
+            "Engine step phase duration (schedule / dispatch / finalize)",
+            "phase",
+            [0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025,
+             0.05, 0.1, 0.25, 0.5, 1.0, 2.5])
+        self.batch_tokens = Gauge(
+            "vllm:engine_batch_tokens",
+            "Tokens in the last dispatched engine batch")
+        self.batch_requests = Gauge(
+            "vllm:engine_batch_requests",
+            "Requests in the last dispatched engine batch")
+        self.batch_occupancy = Gauge(
+            "vllm:engine_batch_occupancy",
+            "Fraction of the token budget used by the last dispatched batch")
+        self.step_interval = Gauge(
+            "vllm:engine_step_interval_seconds",
+            "Wall time between the last two engine step completions")
         # Resilience (vllm_tpu/resilience): refreshed from the engine's
         # live snapshot at render time, so /metrics reflects the crash/
         # recovery state without event plumbing through stat records.
@@ -196,6 +259,8 @@ class PrometheusRegistry:
             self.queue_time, self.accept_length,
             self.bucket_compiles, self.bucket_hits, self.pipeline_stall,
             self.request_success,
+            self.step_duration, self.batch_tokens, self.batch_requests,
+            self.batch_occupancy, self.step_interval,
             self.engine_up, self.engine_restarts,
             self.requests_replayed, self.requests_failed_on_crash,
         ]
@@ -239,6 +304,16 @@ class PrometheusRegistry:
                 max(0.0, s.pipeline_stall_s - self._last_stall)
             )
             self._last_stall = s.pipeline_stall_s
+            for t in s.step_schedule_times:
+                self.step_duration.observe("schedule", t)
+            for t in s.step_dispatch_times:
+                self.step_duration.observe("dispatch", t)
+            for t in s.step_finalize_times:
+                self.step_duration.observe("finalize", t)
+            self.batch_tokens.set(s.batch_num_tokens)
+            self.batch_requests.set(s.batch_num_reqs)
+            self.batch_occupancy.set(s.batch_occupancy)
+            self.step_interval.set(s.step_interval_s)
         if iteration_stats is not None:
             self.generation_tokens.inc(iteration_stats.num_generation_tokens)
             self.prompt_tokens.inc(iteration_stats.num_prompt_tokens)
@@ -261,11 +336,14 @@ class PrometheusRegistry:
             return
         for eid, st in status.get("engines", {}).items():
             self.engine_up.set(eid, 1.0 if st.get("up") else 0.0)
-            self.engine_restarts.set(eid, float(st.get("restarts", 0)))
-        self.requests_replayed.value = float(
-            status.get("requests_replayed_total", 0))
-        self.requests_failed_on_crash.value = float(
-            status.get("requests_failed_on_crash_total", 0))
+            # Ratchet, don't assign: a render racing an engine respawn
+            # (snapshot briefly rebuilt from scratch) must never show a
+            # counter decrease, which scrapers read as a process restart.
+            self.engine_restarts.inc_to(eid, float(st.get("restarts", 0)))
+        self.requests_replayed.inc_to(
+            float(status.get("requests_replayed_total", 0)))
+        self.requests_failed_on_crash.inc_to(
+            float(status.get("requests_failed_on_crash_total", 0)))
 
     def render(self) -> str:
         self._refresh_resilience()

@@ -54,6 +54,10 @@ class EngineCoreRequest:
     mm_inputs: list[Any] | None = None
     # Pooling/embedding request (None = generation).
     pooling_params: Any = None
+    # Frontend-assigned trace correlation id: spans emitted for this
+    # request in ANY process (frontend, engine core, worker) carry it, so
+    # per-process chrome-trace files fuse into one per-request flow.
+    trace_id: str | None = None
 
 
 class Request:
@@ -71,8 +75,10 @@ class Request:
         block_hasher: Any = None,
         pooling_params: Any = None,
         mm_inputs: list[Any] | None = None,
+        trace_id: str | None = None,
     ) -> None:
         self.request_id = request_id
+        self.trace_id = trace_id
         self.prompt_token_ids = prompt_token_ids
         self.sampling_params = sampling_params
         self.eos_token_id = eos_token_id
@@ -92,6 +98,9 @@ class Request:
         self.num_computed_tokens = 0
         # Prefix-cache hit length at first schedule (stats).
         self.num_cached_tokens = -1
+        # Waiting->running delay, set at first schedule (rides the first
+        # EngineCoreOutput so the frontend's RequestTimings has it).
+        self.queue_time: float | None = None
         # Draft tokens proposed for this request, verified next step.
         self.spec_token_ids: list[int] = []
         # Async scheduling: sampling steps dispatched but whose output token
@@ -140,6 +149,7 @@ class Request:
             lora_name=req.lora_name,
             block_hasher=block_hasher,
             mm_inputs=req.mm_inputs,
+            trace_id=req.trace_id,
         )
 
     # ------------------------------------------------------------------

@@ -10,33 +10,50 @@ OTLP exporter is the transport seam: `trace_span` is the single
 instrumentation point to rebind.
 
 Spans cover the serving lifecycle the reference traces per request
-(arrival -> queue -> prefill -> decode -> finish) plus the engine step
-phases (schedule / dispatch / finalize).
+(arrival -> queue -> prefill -> decode -> detokenize -> finish) plus the
+engine step phases (schedule / dispatch / finalize). Request lifecycle
+phases are *async* spans (``ph: b/e``) keyed by a trace id the frontend
+assigns at admission and carries across the ZMQ process split, so
+``tools/merge_traces.py`` can fuse the per-process files into one
+timeline with a flow per request.
+
+Timestamps are ``time.perf_counter_ns`` (CLOCK_MONOTONIC on Linux), the
+same epoch in every process on a host — cross-process spans line up in
+the merged view without clock translation.
 """
 
 from __future__ import annotations
 
+import atexit
 import json
 import os
 import threading
 import time
+import uuid
 from contextlib import contextmanager
 
 _lock = threading.Lock()
 _file = None
 _enabled: bool | None = None
+_wrote_any = False
 
 
 def _trace_file():
-    global _file, _enabled
+    global _file, _enabled, _wrote_any
     if _enabled is None:
         trace_dir = os.environ.get("VLLM_TPU_TRACE_DIR")
         _enabled = bool(trace_dir)
         if _enabled:
             os.makedirs(trace_dir, exist_ok=True)
             path = os.path.join(trace_dir, f"trace-{os.getpid()}.json")
-            _file = open(path, "w")
-            _file.write("[\n")
+            _file = open(path, "wb")
+            _wrote_any = False
+            _file.write(b"[\n")
+            # Terminate the JSON array on interpreter exit so the file on
+            # disk is valid JSON, not a dangling ``[...,`` (crashed
+            # processes still leave the dangling form; readers strip the
+            # trailing comma as a fallback).
+            atexit.register(close_trace)
     return _file
 
 
@@ -45,13 +62,60 @@ def trace_enabled() -> bool:
     return bool(_enabled)
 
 
+def close_trace() -> None:
+    """Terminate the JSON event array and close this process's trace file.
+
+    Idempotent; registered via atexit at first emission, callable early
+    (e.g. by tests or an orderly shutdown path). Further emissions after
+    close are dropped.
+    """
+    global _file, _enabled, _wrote_any
+    with _lock:
+        f, _file = _file, None
+        if f is None:
+            return
+        _enabled = False
+        if _wrote_any:
+            # Events are written as ``{...},\n``: back over the trailing
+            # separator so the terminator yields strict JSON.
+            f.seek(-2, os.SEEK_END)
+            f.truncate()
+            f.write(b"\n]\n")
+        else:
+            f.write(b"]\n")
+        f.close()
+        _wrote_any = False
+
+
+def new_trace_id() -> str:
+    """Frontend-assigned per-request correlation id, carried across the
+    core-client wire so every process's spans for one request share it."""
+    return uuid.uuid4().hex[:16]
+
+
 def _emit(event: dict) -> None:
     f = _trace_file()
     if f is None:
         return
     with _lock:
-        f.write(json.dumps(event) + ",\n")
+        if _file is None:  # closed concurrently
+            return
+        global _wrote_any
+        _wrote_any = True
+        f.write(json.dumps(event).encode() + b",\n")
         f.flush()
+
+
+def _base(name: str, category: str, ph: str, **attrs) -> dict:
+    return {
+        "name": name,
+        "cat": category,
+        "ph": ph,
+        "ts": time.perf_counter_ns() // 1000,
+        "pid": os.getpid(),
+        "tid": threading.get_ident() % 2**31,
+        "args": {k: v for k, v in attrs.items() if v is not None},
+    }
 
 
 @contextmanager
@@ -81,13 +145,33 @@ def trace_instant(name: str, category: str = "request", **attrs) -> None:
     """Point event (request arrival, finish, preemption...)."""
     if not trace_enabled():
         return
-    _emit({
-        "name": name,
-        "cat": category,
-        "ph": "i",
-        "s": "p",
-        "ts": time.perf_counter_ns() // 1000,
-        "pid": os.getpid(),
-        "tid": threading.get_ident() % 2**31,
-        "args": {k: v for k, v in attrs.items() if v is not None},
-    })
+    ev = _base(name, category, "i", **attrs)
+    ev["s"] = "p"
+    _emit(ev)
+
+
+def trace_async_begin(name: str, trace_id: str | None,
+                      category: str = "request", **attrs) -> None:
+    """Open an async (``ph: b``) span keyed by the request's trace id.
+
+    Async spans may begin and end in different threads — or, with the
+    trace id carried over the core-client wire, different *processes* —
+    which is exactly the request lifecycle shape (queue/prefill/decode
+    progress in the engine core while the frontend holds the request
+    span open end-to-end).
+    """
+    if trace_id is None or not trace_enabled():
+        return
+    ev = _base(name, category, "b", trace_id=trace_id, **attrs)
+    ev["id"] = trace_id
+    _emit(ev)
+
+
+def trace_async_end(name: str, trace_id: str | None,
+                    category: str = "request", **attrs) -> None:
+    """Close the matching async span (same name/category/trace id)."""
+    if trace_id is None or not trace_enabled():
+        return
+    ev = _base(name, category, "e", trace_id=trace_id, **attrs)
+    ev["id"] = trace_id
+    _emit(ev)
