@@ -109,7 +109,9 @@ def test_restart_visible_in_health_and_metrics(engine):
 
             resp = await client.get("/ready")
             assert resp.status == 200
-            assert (await resp.json()) == {"ready": True}
+            ready_body = await resp.json()
+            assert ready_body["ready"] is True
+            assert ready_body["draining"] is False
 
             text = await (await client.get("/metrics")).text()
             assert 'vllm:engine_restarts_total{engine_id="0"}' in text

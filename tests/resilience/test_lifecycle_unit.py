@@ -1,0 +1,273 @@
+"""Unit tests for the overload-protection building blocks: LifecycleConfig
+validation, AdmissionController accounting, bounded AsyncStream backpressure,
+and journal disk persistence. No model, tier-1 fast."""
+
+from __future__ import annotations
+
+import asyncio
+import os
+from types import SimpleNamespace
+
+import pytest
+
+from vllm_tpu.engine.async_llm import AsyncStream
+from vllm_tpu.request import EngineCoreRequest
+from vllm_tpu.resilience import (
+    AdmissionController,
+    LifecycleConfig,
+    RequestShedError,
+    SlowClientError,
+    make_shed_error,
+)
+from vllm_tpu.resilience.journal import RequestJournal
+from vllm_tpu.sampling_params import SamplingParams
+
+
+# -- LifecycleConfig ----------------------------------------------------
+
+
+def test_config_defaults_are_all_off():
+    cfg = LifecycleConfig().finalize()
+    assert cfg.max_inflight_requests == 0
+    assert cfg.max_queued_prompt_tokens == 0
+    assert cfg.default_deadline_s == 0.0
+    assert cfg.ttft_timeout_s == 0.0
+    assert cfg.stream_buffer_size == 0
+
+
+@pytest.mark.parametrize("kw", [
+    {"max_inflight_requests": -1},
+    {"max_queued_prompt_tokens": -1},
+    {"default_deadline_s": -0.1},
+    {"ttft_timeout_s": -1.0},
+    {"stream_buffer_size": -2},
+    {"stream_overflow_policy": "explode"},
+    {"drain_timeout_s": -1.0},
+    {"retry_after_s": -1.0},
+])
+def test_config_rejects_bad_values(kw):
+    with pytest.raises(ValueError):
+        LifecycleConfig(**kw).finalize()
+
+
+def test_sampling_params_reject_nonpositive_deadline():
+    with pytest.raises(ValueError):
+        SamplingParams(deadline_s=0.0)
+    with pytest.raises(ValueError):
+        SamplingParams(deadline_s=-3.0)
+    assert SamplingParams(deadline_s=2.5).deadline_s == 2.5
+
+
+# -- AdmissionController ------------------------------------------------
+
+
+def test_admission_request_cap():
+    a = AdmissionController(LifecycleConfig(max_inflight_requests=2))
+    assert a.try_admit("r1", 10) is None
+    assert a.try_admit("r2", 10) is None
+    assert a.try_admit("r3", 10) == "saturated_requests"
+    a.release("r1")
+    assert a.try_admit("r4", 10) is None
+    assert a.shed_total == {"saturated_requests": 1}
+
+
+def test_admission_token_cap_admits_one_when_empty():
+    a = AdmissionController(LifecycleConfig(max_queued_prompt_tokens=100))
+    # A single over-cap prompt must not be unservable.
+    assert a.try_admit("huge", 500) is None
+    assert a.try_admit("next", 1) == "saturated_tokens"
+    a.release("huge")
+    assert a.try_admit("next", 1) is None
+    assert a.inflight_prompt_tokens == 1
+
+
+def test_admission_release_is_idempotent():
+    a = AdmissionController(LifecycleConfig(max_queued_prompt_tokens=100))
+    a.try_admit("r1", 40)
+    a.try_admit("r2", 40)
+    a.release("r1")
+    a.release("r1")  # double release must not free r2's reservation
+    assert a.inflight_prompt_tokens == 40
+    assert a.inflight_requests == 1
+
+
+def test_admission_drain_latch():
+    a = AdmissionController(LifecycleConfig())
+    assert a.precheck() is None
+    a.start_drain()
+    assert a.precheck() == "draining"
+    assert a.try_admit("r1", 1) == "draining"
+    assert a.status()["draining"] is True
+    assert a.status()["shed"] == {"draining": 1}
+
+
+def test_precheck_does_not_reserve():
+    a = AdmissionController(LifecycleConfig(max_inflight_requests=1))
+    assert a.precheck() is None
+    assert a.inflight_requests == 0
+    assert a.try_admit("r1", 1) is None
+    assert a.precheck() == "saturated_requests"
+
+
+def test_shed_error_http_mapping():
+    cfg = LifecycleConfig(retry_after_s=7.0)
+    draining = make_shed_error("draining", cfg)
+    saturated = make_shed_error("saturated_requests", cfg)
+    assert isinstance(draining, RequestShedError)
+    assert draining.http_status == 503
+    assert saturated.http_status == 429
+    assert saturated.retry_after_s == 7.0
+    assert make_shed_error("saturated_tokens", cfg).http_status == 429
+
+
+# -- AsyncStream backpressure -------------------------------------------
+
+
+def _out(i, finished=False):
+    return SimpleNamespace(i=i, finished=finished)
+
+
+def test_stream_unbounded_passthrough():
+    async def run():
+        s = AsyncStream(asyncio.get_running_loop())
+        for i in range(5):
+            s.put_nowait(_out(i, finished=(i == 4)))
+        got = [await s.get() for _ in range(5)]
+        assert [g.i for g in got] == list(range(5))
+        assert s.dropped_total == 0
+        assert not any(hasattr(g, "num_dropped_outputs") for g in got)
+
+    asyncio.run(run())
+
+
+def test_stream_drop_oldest_flags_gap():
+    drops = []
+
+    async def run():
+        s = AsyncStream(
+            asyncio.get_running_loop(), maxsize=2,
+            overflow_policy="drop_oldest", request_id="r1",
+            on_drop=drops.append,
+        )
+        for i in range(4):
+            s.put_nowait(_out(i))
+        s.put_nowait(_out(4, finished=True))
+        # put_nowait trampolines via call_soon_threadsafe; yield so the
+        # callbacks run before we start consuming.
+        await asyncio.sleep(0)
+        first = await s.get()
+        # Oldest two were discarded; the gap is surfaced on delivery.
+        assert first.i == 2
+        assert first.num_dropped_outputs == 2
+        second = await s.get()
+        assert second.i == 3
+        assert not hasattr(second, "num_dropped_outputs")
+        last = await s.get()
+        assert last.i == 4 and last.finished
+        assert s.dropped_total == 2
+        assert drops == [1, 1]
+
+    asyncio.run(run())
+
+
+def test_stream_terminal_items_never_dropped():
+    async def run():
+        s = AsyncStream(
+            asyncio.get_running_loop(), maxsize=1,
+            overflow_policy="drop_oldest",
+        )
+        s.put_nowait(_out(0))
+        s.put_nowait(_out(1, finished=True))  # over bound, but terminal
+        await asyncio.sleep(0)
+        assert (await s.get()).i == 0
+        assert (await s.get()).finished
+
+    asyncio.run(run())
+
+
+def test_stream_abort_policy_delivers_slow_client_error():
+    aborted = []
+
+    async def run():
+        s = AsyncStream(
+            asyncio.get_running_loop(), maxsize=2,
+            overflow_policy="abort", request_id="r9",
+            on_slow_client=aborted.append,
+        )
+        for i in range(3):
+            s.put_nowait(_out(i))
+        s.put_nowait(_out(3))  # after abort: ignored
+        await asyncio.sleep(0)
+        assert (await s.get()).i == 0
+        assert (await s.get()).i == 1
+        with pytest.raises(SlowClientError) as exc_info:
+            while True:
+                item = await s.get()
+                if isinstance(item, Exception):
+                    raise item
+        assert exc_info.value.request_id == "r9"
+        assert aborted == ["r9"]
+
+    asyncio.run(run())
+
+
+# -- Journal disk persistence -------------------------------------------
+
+
+def _req(rid, max_tokens=8):
+    return EngineCoreRequest(
+        request_id=rid,
+        prompt_token_ids=[1, 2, 3],
+        sampling_params=SamplingParams(max_tokens=max_tokens),
+        arrival_time=123.0,
+    )
+
+
+def test_journal_persistence_roundtrip(tmp_path):
+    d = str(tmp_path / "journal")
+    j = RequestJournal(persist_dir=d)
+    j.record_admitted(_req("a"))
+    j.record_admitted(_req("b"))
+    assert len(os.listdir(d)) == 2
+    j.record_finished("a")
+    assert len(os.listdir(d)) == 1
+    j.discard("b")
+    assert os.listdir(d) == []
+
+
+def test_journal_restart_reports_lost_requests(tmp_path):
+    d = str(tmp_path / "journal")
+    j1 = RequestJournal(persist_dir=d)
+    j1.record_admitted(_req("lost-1", max_tokens=4))
+    j1.record_admitted(_req("done-1"))
+    j1.record_finished("done-1")
+    # Simulate a frontend crash: j1 goes away with lost-1 in flight.
+    j2 = RequestJournal(persist_dir=d)
+    assert j2.requests_lost_on_restart_total == 1
+    (entry,) = j2.lost_on_restart
+    assert entry["request_id"] == "lost-1"
+    assert entry["num_prompt_tokens"] == 3
+    assert entry["max_tokens"] == 4
+    # The scan clears the files: a third restart reports nothing.
+    assert RequestJournal(persist_dir=d).requests_lost_on_restart_total == 0
+
+
+def test_journal_restart_tolerates_garbage(tmp_path):
+    d = tmp_path / "journal"
+    d.mkdir()
+    (d / "garbage.json").write_text("{not json")
+    (d / "ignored.txt").write_text("not a snapshot")
+    j = RequestJournal(persist_dir=str(d))
+    assert j.requests_lost_on_restart_total == 0
+    assert not (d / "garbage.json").exists()  # cleared, not re-reported
+
+
+def test_journal_unsafe_request_ids(tmp_path):
+    d = str(tmp_path / "journal")
+    j = RequestJournal(persist_dir=d)
+    rid = "../weird/../../id with spaces/☃"
+    j.record_admitted(_req(rid))
+    names = os.listdir(d)
+    assert len(names) == 1 and names[0].endswith(".json")
+    j2 = RequestJournal(persist_dir=d)
+    assert j2.lost_on_restart[0]["request_id"] == rid

@@ -1,0 +1,288 @@
+"""Overload-protection integration tests against a real in-proc engine:
+admission saturation over HTTP (429 + Retry-After + balanced shed
+accounting), deadline expiry mid-decode, TTFT timeout while queued,
+slow-consumer stream overflow, and client-disconnect abort.
+
+Reference analog: ``tests/v1/engine/test_async_llm.py`` — same tiny-model
+wiring; the lifecycle knobs here are deliberately tight so a small burst
+saturates them.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import re
+import time
+
+import pytest
+
+from tests.models.utils import tiny_llama_dir
+from vllm_tpu.engine.arg_utils import AsyncEngineArgs
+from vllm_tpu.engine.async_llm import AsyncLLM
+from vllm_tpu.resilience import TIMEOUT_FINISH_REASON, RequestShedError
+from vllm_tpu.sampling_params import RequestOutputKind, SamplingParams
+
+
+@pytest.fixture(scope="module")
+def tiny_llama(tmp_path_factory):
+    return tiny_llama_dir(tmp_path_factory.mktemp("tiny_llama_overload"))
+
+
+@pytest.fixture(scope="module")
+def capped_engine(tiny_llama):
+    """Tight admission caps + a small bounded stream buffer."""
+    engine = AsyncLLM.from_engine_args(
+        AsyncEngineArgs(
+            model=tiny_llama,
+            dtype="float32",
+            max_model_len=128,
+            block_size=16,
+            num_gpu_blocks_override=64,
+            max_num_seqs=8,
+            max_num_batched_tokens=128,
+            max_inflight_requests=2,
+            retry_after_s=3.0,
+            stream_buffer_size=4,
+            stream_overflow_policy="drop_oldest",
+        )
+    )
+    yield engine
+    engine.shutdown()
+
+
+@pytest.fixture(scope="module")
+def deadline_engine(tiny_llama):
+    """Single-slot engine with a long context: decode runs ~seconds, so
+    sub-second deadlines expire mid-decode with a wide timing margin."""
+    engine = AsyncLLM.from_engine_args(
+        AsyncEngineArgs(
+            model=tiny_llama,
+            dtype="float32",
+            max_model_len=2048,
+            block_size=16,
+            num_gpu_blocks_override=160,
+            max_num_seqs=1,
+            max_num_batched_tokens=128,
+            ttft_timeout_s=0.5,
+        )
+    )
+
+    async def warmup():
+        params = SamplingParams(
+            temperature=0.0, max_tokens=4, ignore_eos=True,
+            output_kind=RequestOutputKind.FINAL_ONLY,
+        )
+        async for _ in engine.generate(
+            {"prompt_token_ids": [3, 5, 7]}, params, "warmup"
+        ):
+            pass
+
+    # First-step compile would otherwise eat into the test deadlines.
+    asyncio.run(warmup())
+    yield engine
+    engine.shutdown()
+
+
+def _delta_params(max_tokens, **kw):
+    return SamplingParams(
+        temperature=0.0, max_tokens=max_tokens, ignore_eos=True,
+        output_kind=RequestOutputKind.DELTA, **kw,
+    )
+
+
+# -- admission saturation over HTTP -------------------------------------
+
+
+def _shed_counts(metrics_text):
+    return {
+        m.group(1): float(m.group(2))
+        for m in re.finditer(
+            r'vllm:requests_shed_total\{reason="([^"]+)"\}\s+([0-9.]+)',
+            metrics_text,
+        )
+    }
+
+
+def test_http_burst_sheds_with_429_and_retry_after(capped_engine):
+    from aiohttp.test_utils import TestClient, TestServer
+
+    from vllm_tpu.entrypoints.openai.api_server import build_app
+    from vllm_tpu.metrics.prometheus import PrometheusRegistry
+
+    burst = 8
+
+    async def run():
+        reg = PrometheusRegistry(capped_engine)
+        app = build_app(capped_engine, "tiny-llama", reg)
+        async with TestClient(TestServer(app)) as client:
+            before = _shed_counts(await (await client.get("/metrics")).text())
+
+            async def one(i):
+                resp = await client.post("/v1/completions", json={
+                    "model": "tiny-llama",
+                    "prompt": [3, 5, 7, 11 + i],
+                    "max_tokens": 50,
+                    "ignore_eos": True,
+                    "temperature": 0.0,
+                })
+                return resp.status, resp.headers, await resp.json()
+
+            results = await asyncio.gather(*[one(i) for i in range(burst)])
+            after = _shed_counts(await (await client.get("/metrics")).text())
+            ready = await client.get("/ready")
+            ready_body = await ready.json()
+            return results, before, after, ready_body
+
+    results, before, after, ready_body = asyncio.run(run())
+    served = [r for r in results if r[0] == 200]
+    shed = [r for r in results if r[0] == 429]
+    assert len(served) + len(shed) == burst  # nothing hung or 500'd
+    assert served and shed  # caps are tighter than the burst
+    for _, headers, body in shed:
+        assert headers["Retry-After"] == "3"
+        assert body["error"]["type"] == "overloaded_error"
+        assert body["error"]["message"]
+    counter_delta = (
+        after.get("saturated_requests", 0)
+        - before.get("saturated_requests", 0)
+    )
+    assert counter_delta == len(shed)  # books balance
+    # /ready reports lifecycle state while healthy.
+    assert ready_body["draining"] is False
+
+
+# -- deadlines -----------------------------------------------------------
+
+
+def test_deadline_expires_mid_decode(deadline_engine):
+    async def run():
+        outs = []
+        t0 = time.monotonic()
+        async for out in deadline_engine.generate(
+            {"prompt_token_ids": [3, 5, 7, 11]},
+            _delta_params(1500, deadline_s=0.5),
+            "deadline-mid",
+        ):
+            outs.append(out)
+        return outs, time.monotonic() - t0
+
+    outs, elapsed = asyncio.run(run())
+    last = outs[-1]
+    assert last.finished
+    assert last.outputs[0].finish_reason == TIMEOUT_FINISH_REASON
+    # Expired mid-decode: some tokens delivered, far fewer than asked.
+    n_tokens = sum(len(o.outputs[0].token_ids) for o in outs)
+    assert 0 < n_tokens < 1500
+    assert elapsed < 2.0  # did not run to completion (~seconds)
+    assert deadline_engine.timeouts_total.get("deadline", 0) >= 1
+    assert deadline_engine.admission.inflight_requests == 0
+
+
+def test_ttft_timeout_while_queued(deadline_engine):
+    """A request stuck queued behind a saturated single-slot engine times
+    out via the TTFT cutoff; the request hogging the engine is unharmed."""
+
+    async def run():
+        hog_gen = deadline_engine.generate(
+            {"prompt_token_ids": [3, 5, 7, 11]},
+            _delta_params(1500), "hog",
+        )
+        first = await hog_gen.__anext__()  # hog is now decoding
+        assert first is not None
+
+        queued_outs = []
+        async for out in deadline_engine.generate(
+            {"prompt_token_ids": [13, 17, 19]},
+            _delta_params(50), "queued",
+        ):
+            queued_outs.append(out)
+        await hog_gen.aclose()  # disconnect: abort the hog
+        return queued_outs
+
+    outs = asyncio.run(run())
+    last = outs[-1]
+    assert last.finished
+    assert last.outputs[0].finish_reason == TIMEOUT_FINISH_REASON
+    # Never scheduled: timed out with zero tokens, via the "ttft" kind.
+    assert sum(len(o.outputs[0].token_ids) for o in outs) == 0
+    assert deadline_engine.timeouts_total.get("ttft", 0) >= 1
+
+
+# -- slow-client backpressure -------------------------------------------
+
+
+def test_slow_consumer_drop_oldest(capped_engine):
+    async def run():
+        drops_before = capped_engine.stream_drops_total
+        outs = []
+        async for out in capped_engine.generate(
+            {"prompt_token_ids": [3, 5, 7]},
+            _delta_params(100), "slowpoke",
+        ):
+            outs.append(out)
+            if not out.finished:
+                await asyncio.sleep(0.03)  # engine decodes ~10x faster
+        return outs, capped_engine.stream_drops_total - drops_before
+
+    outs, dropped = asyncio.run(run())
+    last = outs[-1]
+    assert last.finished
+    assert last.outputs[0].finish_reason == "length"  # not an error
+    assert dropped > 0
+    # The gap is surfaced to the consumer on the next delivered output.
+    flagged = sum(
+        getattr(o, "num_dropped_outputs", 0) for o in outs
+    )
+    assert flagged == dropped
+    # Delivered + dropped outputs account for the whole stream.
+    n_tokens = sum(len(o.outputs[0].token_ids) for o in outs)
+    assert n_tokens < 100
+    assert capped_engine.admission.inflight_requests == 0
+
+
+# -- client disconnect ---------------------------------------------------
+
+
+def test_disconnect_aborts_and_releases_admission(capped_engine):
+    async def run():
+        gen = capped_engine.generate(
+            {"prompt_token_ids": [3, 5, 7]},
+            _delta_params(100), "walkaway",
+        )
+        await gen.__anext__()
+        await gen.aclose()  # client disconnect mid-stream
+        deadline = time.monotonic() + 5.0
+        while time.monotonic() < deadline:
+            if (capped_engine.num_inflight == 0
+                    and capped_engine.admission.inflight_requests == 0):
+                return True
+            await asyncio.sleep(0.02)
+        return False
+
+    assert asyncio.run(run()), "abort did not release request state"
+
+
+# -- shed exception surface ----------------------------------------------
+
+
+def test_generate_raises_shed_error_when_draining(capped_engine):
+    # Use a throwaway AdmissionController drain on a COPY via precheck:
+    # flipping the shared engine to draining would poison later tests, so
+    # exercise the generate() path through a temporary latch.
+    async def run():
+        capped_engine.admission.draining = True
+        try:
+            with pytest.raises(RequestShedError) as exc_info:
+                async for _ in capped_engine.generate(
+                    {"prompt_token_ids": [1, 2]},
+                    _delta_params(4), "drained-out",
+                ):
+                    pass
+            return exc_info.value
+        finally:
+            capped_engine.admission.draining = False
+
+    err = asyncio.run(run())
+    assert err.http_status == 503
+    assert err.reason == "draining"
+    assert capped_engine.admission.inflight_requests == 0

@@ -20,7 +20,9 @@ from vllm_tpu.engine.async_llm import AsyncLLM
 from vllm_tpu.engine.output_processor import OutputProcessor
 from vllm_tpu.request import EngineCoreRequest
 from vllm_tpu.resilience import (
+    AdmissionController,
     EngineRestartedError,
+    LifecycleConfig,
     RequestFailedOnCrashError,
     RequestJournal,
     ResilienceConfig,
@@ -121,6 +123,12 @@ def make_engine(client, *, recovery=True, max_request_retries=1,
         enable_recovery=recovery, max_request_retries=max_request_retries,
     ).finalize()
     llm.journal = RequestJournal() if recovery else None
+    llm.lifecycle = LifecycleConfig().finalize()
+    llm.admission = AdmissionController(llm.lifecycle)
+    llm.timeouts_total = {}
+    llm.stream_drops_total = 0
+    llm.slow_client_aborts_total = 0
+    llm._last_deadline_sweep = 0.0
     llm.engine_core = client
     llm.input_processor = FakeInputProcessor()
     llm.output_processor = OutputProcessor(None, journal=llm.journal)

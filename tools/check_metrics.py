@@ -7,7 +7,9 @@ Checks, against a freshly constructed ``PrometheusRegistry``:
   metric recorded but missing from the list silently never renders on
   ``/metrics``), and vice versa (no orphans in the render list);
 - metric names match ``vllm:[a-z0-9_]+`` and are unique;
-- every metric has non-empty HELP documentation.
+- every metric has non-empty HELP documentation;
+- the overload/lifecycle metric names the README documents are present
+  (a rename here silently breaks dashboards and runbooks).
 
 Run standalone (``python tools/check_metrics.py``, exit 1 on failure)
 or via the tier-1 wrapper ``tests/metrics/test_check_metrics.py``.
@@ -24,6 +26,18 @@ if _REPO_ROOT not in sys.path:
     sys.path.insert(0, _REPO_ROOT)
 
 NAME_RE = re.compile(r"^vllm:[a-z0-9_]+$")
+
+# Documented in the README ("Overload & lifecycle" / "Resilience");
+# keep in sync with PrometheusRegistry.
+REQUIRED_LIFECYCLE_METRICS = {
+    "vllm:requests_shed_total",
+    "vllm:request_timeouts_total",
+    "vllm:stream_outputs_dropped_total",
+    "vllm:requests_aborted_slow_client_total",
+    "vllm:lifecycle_draining",
+    "vllm:inflight_prompt_tokens",
+    "vllm:requests_lost_on_restart_total",
+}
 
 
 def check() -> list[str]:
@@ -74,6 +88,11 @@ def check() -> list[str]:
                 f"(registry.{seen[m.name]} and registry.{attr})")
         else:
             seen[m.name] = attr
+
+    for name in sorted(REQUIRED_LIFECYCLE_METRICS - set(seen)):
+        errors.append(
+            f"required lifecycle metric {name} is missing from the "
+            f"registry (documented in README)")
 
     return errors
 

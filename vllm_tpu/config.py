@@ -14,6 +14,7 @@ from typing import Any, Literal
 
 from vllm_tpu.logger import init_logger
 from vllm_tpu.resilience.config import ResilienceConfig
+from vllm_tpu.resilience.lifecycle import LifecycleConfig
 
 logger = init_logger(__name__)
 
@@ -374,10 +375,12 @@ class EngineConfig:
     observability_config: ObservabilityConfig = field(default_factory=ObservabilityConfig)
     compilation_config: CompilationConfig = field(default_factory=CompilationConfig)
     resilience_config: ResilienceConfig = field(default_factory=ResilienceConfig)
+    lifecycle_config: LifecycleConfig = field(default_factory=LifecycleConfig)
 
     def finalize(self) -> "EngineConfig":
         """Cross-validate and derive dependent fields. Idempotent."""
         self.resilience_config.finalize()
+        self.lifecycle_config.finalize()
         mc, sc = self.model_config, self.scheduler_config
         if mc.max_model_len is not None:
             sc.max_model_len = mc.max_model_len

@@ -25,6 +25,7 @@ from vllm_tpu.config import (
     SpeculativeConfig,
 )
 from vllm_tpu.resilience.config import ResilienceConfig
+from vllm_tpu.resilience.lifecycle import LifecycleConfig
 
 
 @dataclass
@@ -90,6 +91,18 @@ class EngineArgs:
     max_request_retries: int = 1
     restart_backoff_s: float = 0.5
     heartbeat_timeout_s: float = 0.0
+    journal_dir: str | None = None
+
+    # Lifecycle (vllm_tpu/resilience/lifecycle): overload protection.
+    # All off by default; see LifecycleConfig for semantics.
+    max_inflight_requests: int = 0
+    max_queued_prompt_tokens: int = 0
+    default_deadline_s: float = 0.0
+    ttft_timeout_s: float = 0.0
+    stream_buffer_size: int = 0
+    stream_overflow_policy: str = "drop_oldest"
+    drain_timeout_s: float = 30.0
+    retry_after_s: float = 1.0
 
     disable_log_stats: bool = False
     precompile: bool = False
@@ -181,6 +194,17 @@ class EngineArgs:
                 max_request_retries=self.max_request_retries,
                 restart_backoff_s=self.restart_backoff_s,
                 heartbeat_timeout_s=self.heartbeat_timeout_s,
+                journal_dir=self.journal_dir,
+            ),
+            lifecycle_config=LifecycleConfig(
+                max_inflight_requests=self.max_inflight_requests,
+                max_queued_prompt_tokens=self.max_queued_prompt_tokens,
+                default_deadline_s=self.default_deadline_s,
+                ttft_timeout_s=self.ttft_timeout_s,
+                stream_buffer_size=self.stream_buffer_size,
+                stream_overflow_policy=self.stream_overflow_policy,  # type: ignore[arg-type]
+                drain_timeout_s=self.drain_timeout_s,
+                retry_after_s=self.retry_after_s,
             ),
         )
         # If the model's max length is unknown and unset, derive after the HF

@@ -15,18 +15,25 @@ from __future__ import annotations
 import asyncio
 import queue
 import threading
+import time
+from collections import deque
 from typing import Any, AsyncGenerator
 
 from vllm_tpu.config import EngineConfig
+from vllm_tpu.core.sched_output import EngineCoreOutput
 from vllm_tpu.engine.core_client import make_client
 from vllm_tpu.engine.input_processor import InputProcessor, PromptType
 from vllm_tpu.engine.output_processor import OutputProcessor
 from vllm_tpu.logger import init_logger
 from vllm_tpu.outputs import RequestOutput
 from vllm_tpu.resilience import (
+    TIMEOUT_FINISH_REASON,
+    AdmissionController,
     EngineRestartedError,
     RequestFailedOnCrashError,
     RequestJournal,
+    SlowClientError,
+    make_shed_error,
 )
 from vllm_tpu.sampling_params import RequestOutputKind, SamplingParams
 
@@ -40,41 +47,121 @@ from vllm_tpu.engine.core_client import EngineDeadError  # noqa: E402,F401
 
 
 class AsyncStream:
-    """Thread-safe per-request output stream.
+    """Thread-safe per-request output stream with an optional buffer bound.
 
     Reference analog: ``RequestOutputCollector`` (async_llm.py). The engine
     thread calls ``put_nowait`` (the OutputProcessor treats it like a queue);
     delivery hops onto the consumer's event loop via call_soon_threadsafe so
     the awaiting generator wakes up.
+
+    Slow-client backpressure: with ``maxsize > 0``, a consumer that stops
+    reading cannot buffer output without limit. On overflow the stream
+    either discards the oldest undelivered output (``drop_oldest`` — the
+    next delivered output carries ``num_dropped_outputs``; CUMULATIVE and
+    FINAL_ONLY consumers lose nothing since later outputs supersede) or
+    delivers :class:`SlowClientError` and reports the request for abort
+    (``abort`` policy). Terminal items (exceptions, finished outputs) are
+    never dropped and are appended even over the bound — a stream always
+    terminates.
     """
 
-    def __init__(self, loop: asyncio.AbstractEventLoop) -> None:
+    def __init__(
+        self,
+        loop: asyncio.AbstractEventLoop,
+        maxsize: int = 0,
+        overflow_policy: str = "drop_oldest",
+        request_id: str | None = None,
+        on_drop: Any | None = None,
+        on_slow_client: Any | None = None,
+    ) -> None:
         self._loop = loop
-        self._queue: asyncio.Queue = asyncio.Queue()
+        self._maxsize = maxsize
+        self._policy = overflow_policy
+        self._request_id = request_id
+        self._on_drop = on_drop  # callable(n) — drop accounting
+        self._on_slow_client = on_slow_client  # callable(request_id)
+        # Consumed and mutated only on the event-loop thread (put_nowait
+        # trampolines through call_soon_threadsafe).
+        self._items: deque = deque()
+        self._ready = asyncio.Event()
+        self._aborted = False
+        self._undelivered_drops = 0
+        self.dropped_total = 0
+
+    @staticmethod
+    def _is_terminal(item: Any) -> bool:
+        return isinstance(item, Exception) or bool(
+            getattr(item, "finished", False))
 
     def put_nowait(self, item: Any) -> None:
         if self._loop.is_closed():  # pragma: no cover - shutdown race
             return
-        self._loop.call_soon_threadsafe(self._queue.put_nowait, item)
+        self._loop.call_soon_threadsafe(self._put, item)
+
+    def _put(self, item: Any) -> None:
+        # Event-loop thread only.
+        if self._aborted:
+            return
+        if (
+            self._maxsize
+            and len(self._items) >= self._maxsize
+            and not self._is_terminal(item)
+        ):
+            if self._policy == "abort":
+                self._aborted = True
+                self._items.append(
+                    SlowClientError(self._request_id or "?",
+                                    len(self._items)))
+                self._ready.set()
+                if self._on_slow_client is not None:
+                    self._on_slow_client(self._request_id)
+                return
+            # drop_oldest: the front of the deque is never terminal (a
+            # terminal item ends the stream, nothing is put after it).
+            self._items.popleft()
+            self.dropped_total += 1
+            self._undelivered_drops += 1
+            if self._on_drop is not None:
+                self._on_drop(1)
+        self._items.append(item)
+        self._ready.set()
 
     async def get(self) -> Any:
-        return await self._queue.get()
+        while not self._items:
+            self._ready.clear()
+            await self._ready.wait()
+        item = self._items.popleft()
+        if self._undelivered_drops and not isinstance(item, Exception):
+            # Surface the gap to delta consumers; cumulative consumers can
+            # ignore it (their next output already contains everything).
+            item.num_dropped_outputs = self._undelivered_drops
+            self._undelivered_drops = 0
+        return item
 
 
 class AsyncLLM:
     def __init__(self, config: EngineConfig, start: bool = True) -> None:
         self.config = config = config.finalize()
         self.resilience = config.resilience_config
+        self.lifecycle = config.lifecycle_config
+        # Overload protection: bounded admission + drain latch + shed
+        # accounting (vllm_tpu/resilience/lifecycle).
+        self.admission = AdmissionController(self.lifecycle)
         # Crash-recovery journal: every admitted request's prompt, params
         # and emitted tokens, so requests in flight on a crashed engine
         # core can be resumed on its replacement (vllm_tpu/resilience).
+        # journal_dir alone also creates one (persistence needs entries).
         self.journal = (
-            RequestJournal() if self.resilience.enable_recovery else None
+            RequestJournal(persist_dir=self.resilience.journal_dir)
+            if self.resilience.enable_recovery
+            or self.resilience.journal_dir is not None
+            else None
         )
         self.engine_core = make_client(config)
         self.input_processor = InputProcessor(config)
         self.output_processor = OutputProcessor(
-            self.input_processor.tokenizer, journal=self.journal
+            self.input_processor.tokenizer, journal=self.journal,
+            on_request_closed=self.admission.release,
         )
         self.stat_loggers: list[Any] = []
 
@@ -83,6 +170,11 @@ class AsyncLLM:
         self._dead = False
         self._shutdown = threading.Event()
         self._thread: threading.Thread | None = None
+        # Lifecycle counters (ints under the GIL; read by /metrics).
+        self.timeouts_total: dict[str, int] = {}
+        self.stream_drops_total = 0
+        self.slow_client_aborts_total = 0
+        self._last_deadline_sweep = 0.0
         if start:
             self.start()
 
@@ -113,7 +205,13 @@ class AsyncLLM:
         priority: int = 0,
         pooling_params=None,
     ) -> AsyncGenerator[RequestOutput, None]:
-        """Feed a request and yield RequestOutputs as tokens arrive."""
+        """Feed a request and yield RequestOutputs as tokens arrive.
+
+        Raises :class:`RequestShedError` when admission control rejects
+        the request (saturated or draining) — nothing is queued in that
+        case, and the shed is counted in
+        ``vllm:requests_shed_total{reason=...}``.
+        """
         if self._dead:
             raise EngineDeadError("engine core died")
         self._loop = asyncio.get_running_loop()
@@ -121,8 +219,23 @@ class AsyncLLM:
             request_id, prompt, sampling_params, priority=priority,
             pooling_params=pooling_params,
         )
-        out_q = AsyncStream(asyncio.get_running_loop())
-        self.output_processor.add_request(
+        # Admission AFTER input processing: a malformed request is a 400,
+        # not a shed; capacity is reserved only for well-formed work.
+        shed_reason = self.admission.try_admit(
+            request_id, len(core_req.prompt_token_ids)
+        )
+        if shed_reason is not None:
+            raise make_shed_error(shed_reason, self.lifecycle)
+        lc = self.lifecycle
+        out_q = AsyncStream(
+            asyncio.get_running_loop(),
+            maxsize=lc.stream_buffer_size,
+            overflow_policy=lc.stream_overflow_policy,
+            request_id=request_id,
+            on_drop=self._note_stream_drop,
+            on_slow_client=self._abort_slow_client,
+        )
+        state = self.output_processor.add_request(
             request_id,
             getattr(core_req, "prompt_text", None),
             core_req.prompt_token_ids,
@@ -131,6 +244,14 @@ class AsyncLLM:
             queue=out_q,
             trace_id=core_req.trace_id,
         )
+        # Deadline resolution: per-request override > server default;
+        # enforced by the engine-thread sweep (_expire_deadlines).
+        now = time.monotonic()
+        deadline_s = sampling_params.deadline_s or lc.default_deadline_s
+        if deadline_s:
+            state.deadline_t = now + deadline_s
+        if lc.ttft_timeout_s:
+            state.ttft_deadline_t = now + lc.ttft_timeout_s
         if self.journal is not None:
             self.journal.record_admitted(core_req)
         self._input_queue.put(("add", core_req))
@@ -161,6 +282,22 @@ class AsyncLLM:
         if not self._dead:
             self._input_queue.put(("abort", request_ids))
 
+    # -- slow-client backpressure (callbacks from AsyncStream) ---------
+
+    def _note_stream_drop(self, n: int) -> None:
+        self.stream_drops_total += n
+
+    def _abort_slow_client(self, request_id: str) -> None:
+        # Runs on the event-loop thread (AsyncStream._put). The stream has
+        # already delivered SlowClientError to the consumer; kill the
+        # request everywhere else.
+        self.slow_client_aborts_total += 1
+        logger.warning(
+            "aborting request %s: output stream overflowed (slow client)",
+            request_id,
+        )
+        self._abort_requests([request_id])
+
     # ------------------------------------------------------------------
     # Engine side (background thread)
     # ------------------------------------------------------------------
@@ -183,6 +320,7 @@ class AsyncLLM:
             self._dead = True
             err = EngineDeadError(f"engine core died: {e!r}")
             for state in list(self.output_processor.request_states.values()):
+                self.admission.release(state.request_id)
                 if state.queue is not None:
                     state.queue.put_nowait(err)
 
@@ -197,6 +335,10 @@ class AsyncLLM:
         )
         if self._shutdown.is_set():
             return stalled
+        # Deadline/TTFT sweep runs even when the engine is idle or
+        # stalled — a request stuck queued is exactly the one a TTFT
+        # timeout exists for.
+        self._expire_deadlines()
         if not self.engine_core.has_unfinished_requests():
             return stalled
         outputs = self.engine_core.get_output(timeout=0.2)
@@ -215,12 +357,56 @@ class AsyncLLM:
             )
         return stalled
 
+    def _expire_deadlines(self) -> None:
+        """Engine-thread sweep: requests past their deadline (or TTFT
+        cutoff while still waiting for a first token) are aborted
+        engine-side and finished with ``finish_reason="timeout"`` —
+        never silently hung. Throttled; runs even when the engine is
+        idle (the busy loop ticks ~10Hz via the input-queue timeout)."""
+        now = time.monotonic()
+        if now - self._last_deadline_sweep < 0.05:
+            return
+        self._last_deadline_sweep = now
+        expired: list[tuple[str, str]] = []
+        for rid, state in list(self.output_processor.request_states.items()):
+            if state.deadline_t is not None and now >= state.deadline_t:
+                expired.append((rid, "deadline"))
+            elif (
+                state.ttft_deadline_t is not None
+                and state.metrics.first_token_time is None
+                and now >= state.ttft_deadline_t
+            ):
+                expired.append((rid, "ttft"))
+        if not expired:
+            return
+        rids = [rid for rid, _ in expired]
+        logger.warning("expiring %d request(s) past deadline: %s",
+                       len(rids), rids)
+        # Engine-side abort first (frees KV blocks / scheduler slots); if
+        # it raises EngineRestartedError the sweep retries next tick —
+        # counters and finishes below must not run twice.
+        self.engine_core.abort_requests(rids)
+        for _, kind in expired:
+            self.timeouts_total[kind] = self.timeouts_total.get(kind, 0) + 1
+        # Finish through the normal output path (same as crash recovery)
+        # so stats, journal, tracing, and admission release all fire.
+        processed = self.output_processor.process_outputs([
+            EngineCoreOutput(
+                req_id=rid, new_token_ids=[],
+                finish_reason=TIMEOUT_FINISH_REASON,
+            )
+            for rid in rids
+        ])
+        for logger_ in self.stat_loggers:
+            logger_.record(
+                scheduler_stats=None,
+                iteration_stats=processed.iteration_stats,
+            )
+
     def _recover_requests(self, err: EngineRestartedError) -> None:
         """Requests lost with a crashed engine are replayed from the
         journal (resuming from the tokens already delivered) or failed
         with a per-request error — never silently hung."""
-        from vllm_tpu.core.sched_output import EngineCoreOutput
-
         logger.warning(
             "engine core %d restarted; recovering %d in-flight requests",
             err.engine_id, len(err.lost_req_ids),
@@ -275,6 +461,7 @@ class AsyncLLM:
         if self.journal is not None:
             self.journal.note_failed(rid)
         self.output_processor.request_states.pop(rid, None)
+        self.admission.release(rid)
         err = RequestFailedOnCrashError(rid, attempts, detail)
         logger.error("%s", err)
         if state.queue is not None:
@@ -291,11 +478,31 @@ class AsyncLLM:
                     self.engine_core.add_request(payload)
                 elif op == "abort":
                     self.engine_core.abort_requests(payload)
+                elif op == "finish":
+                    # Drain stragglers: abort engine-side, then close the
+                    # streams with a final output ON THIS THREAD (racing
+                    # process_outputs from another thread would corrupt
+                    # per-request state).
+                    rids, reason = payload
+                    rids = [
+                        r for r in rids
+                        if r in self.output_processor.request_states
+                    ]
+                    if rids:
+                        self.engine_core.abort_requests(rids)
+                        self.output_processor.process_outputs([
+                            EngineCoreOutput(
+                                req_id=r, new_token_ids=[],
+                                finish_reason=reason,
+                            )
+                            for r in rids
+                        ])
             except EngineRestartedError:
                 # The op raced the crash. Aborts are moot (the request
                 # state died with the engine); an add must not be lost —
                 # requeue it, then let the busy loop recover the rest.
-                if op == "add":
+                # A drain "finish" hasn't closed its streams yet: requeue.
+                if op in ("add", "finish"):
                     self._input_queue.put((op, payload))
                 raise
             try:
@@ -304,6 +511,69 @@ class AsyncLLM:
                 return
 
     # ------------------------------------------------------------------
+    # Graceful drain
+    # ------------------------------------------------------------------
+
+    @property
+    def num_inflight(self) -> int:
+        return len(self.output_processor.request_states)
+
+    def check_admission(self) -> None:
+        """Cheap pre-check (no reservation) for streaming handlers that
+        must reject BEFORE committing to an SSE response. Raises
+        RequestShedError; the authoritative check is in generate()."""
+        reason = self.admission.precheck()
+        if reason is not None:
+            raise make_shed_error(reason, self.lifecycle)
+
+    def start_drain(self) -> None:
+        """Stop admitting work: /ready flips 503, new requests shed with
+        reason="draining", supervisor respawns are suspended (a drain
+        must never race a respawn back to life). In-flight requests keep
+        running; use drain() to wait them out."""
+        if self.admission.draining:
+            return
+        logger.info("drain started: admission closed, respawns suspended")
+        self.admission.start_drain()
+        if hasattr(self.engine_core, "suspend_recovery"):
+            self.engine_core.suspend_recovery()
+
+    async def drain(self, timeout_s: float | None = None) -> None:
+        """Graceful drain: stop admission, let in-flight requests finish
+        under the drain budget, then abort stragglers (their streams get
+        a final finish_reason="timeout" output — closed, not hung)."""
+        self.start_drain()
+        if timeout_s is None:
+            timeout_s = self.lifecycle.drain_timeout_s
+        deadline = time.monotonic() + timeout_s
+        while self.num_inflight and time.monotonic() < deadline:
+            await asyncio.sleep(0.05)
+        if self.num_inflight:
+            rids = list(self.output_processor.request_states)
+            logger.warning(
+                "drain budget (%.1fs) exhausted: aborting %d straggler(s)",
+                timeout_s, len(rids),
+            )
+            self._input_queue.put(
+                ("finish", (rids, TIMEOUT_FINISH_REASON)))
+            grace = time.monotonic() + 5.0
+            while self.num_inflight and time.monotonic() < grace:
+                await asyncio.sleep(0.05)
+        logger.info("drain complete (%d request(s) still open)",
+                    self.num_inflight)
+
+    # ------------------------------------------------------------------
+
+    def lifecycle_status(self) -> dict:
+        """JSON-shaped overload/lifecycle snapshot (feeds /metrics,
+        /ready, and /debug/requests)."""
+        status = self.admission.status()
+        status.update(
+            timeouts=dict(self.timeouts_total),
+            stream_outputs_dropped_total=self.stream_drops_total,
+            slow_client_aborts_total=self.slow_client_aborts_total,
+        )
+        return status
 
     def resilience_status(self) -> dict:
         """JSON-shaped liveness/restart snapshot (feeds /health and the
@@ -325,6 +595,10 @@ class AsyncLLM:
                 self.journal.requests_failed_on_crash_total
                 if self.journal is not None else 0
             ),
+            "requests_lost_on_restart_total": (
+                self.journal.requests_lost_on_restart_total
+                if self.journal is not None else 0
+            ),
         }
 
     def debug_requests(self) -> dict:
@@ -337,13 +611,20 @@ class AsyncLLM:
     def is_ready(self) -> bool:
         """All engines initialized and up (readiness, distinct from
         liveness: a respawning rank makes the server NOT ready while
-        /health still reports it serving degraded)."""
-        if self._dead:
+        /health still reports it serving degraded). A draining server is
+        NOT ready: the load balancer must stop routing to it while
+        in-flight requests run out."""
+        if self._dead or self.admission.draining:
             return False
         client = self.engine_core
         return client.is_ready() if hasattr(client, "is_ready") else True
 
     def shutdown(self) -> None:
+        # Ordering matters: suspend respawns FIRST, so the busy loop (or
+        # a ZMQ input thread) observing a dead engine while we tear down
+        # cannot race a respawn back to life against closing sockets.
+        if hasattr(self.engine_core, "suspend_recovery"):
+            self.engine_core.suspend_recovery()
         self._shutdown.set()
         if self._thread is not None:
             self._thread.join(timeout=5)

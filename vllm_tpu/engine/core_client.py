@@ -132,6 +132,9 @@ class InprocClient:
     def is_ready(self) -> bool:
         return True
 
+    def suspend_recovery(self) -> None:
+        """No-op: the in-proc client has no respawn machinery."""
+
     def shutdown(self) -> None:
         self.engine_core.shutdown()
 
@@ -148,6 +151,18 @@ class _ZMQClientBase:
     request ids lost with it) and ``_on_engine_ready`` (a respawned
     engine reported READY).
     """
+
+    # Shutdown/drain latch: once set, crash recovery is OFF — a death
+    # observed while tearing down raises EngineDeadError instead of
+    # respawning. Without it, shutdown could race a respawn back to life
+    # against the ZMQ sockets being closed (satellite of ISSUE 3).
+    _closing = False
+
+    def suspend_recovery(self) -> None:
+        """Permanently disable respawns on this client (graceful drain /
+        shutdown). In-flight work keeps running on live engines; only the
+        reaction to a *death* changes (EngineDeadError, fail-fast)."""
+        self._closing = True
 
     def _recv(self, timeout_ms: int) -> list[bytes] | None:
         """One message, honoring death of any engine process."""
@@ -194,7 +209,11 @@ class _ZMQClientBase:
         recovery is off / mid-init / budget-exhausted (reference
         semantics), EngineRestartedError (with the interrupted request
         ids) after a successful respawn kick-off."""
-        if not self._started or not self._resilience.enable_recovery:
+        if (
+            not self._started
+            or self._closing
+            or not self._resilience.enable_recovery
+        ):
             self._dead = True
             raise EngineDeadError(reason)
         lost: list[str] = []
@@ -507,6 +526,11 @@ class MPClient(_ZMQClientBase):
         self._join_dead_proc()
         self._drain_stale_outputs(set(lost))
         while True:
+            if self._closing:
+                self._dead = True
+                raise EngineDeadError(
+                    "engine core died during shutdown/drain; not respawning"
+                )
             time.sleep(self._supervisor.backoff_s(0))
             # Fresh input socket per attempt: the dead incarnation's
             # queued input frames must not reach the replacement, and a
@@ -564,6 +588,8 @@ class MPClient(_ZMQClientBase):
         from the previous incarnation. None = this incarnation failed."""
         deadline = time.monotonic() + timeout_s
         while time.monotonic() < deadline:
+            if self._closing:
+                return None
             if self._output.poll(200):
                 frames = self._output.recv_multipart()
                 if frames[0] == self._proc_mod.MSG_READY:
@@ -627,6 +653,10 @@ class MPClient(_ZMQClientBase):
         return bool(self._live)
 
     def shutdown(self) -> None:
+        # Halt the respawn path BEFORE touching sockets: a concurrent
+        # _handle_engine_death observing the latch fails fast instead of
+        # relaunching an engine against sockets mid-teardown.
+        self._closing = True
         if getattr(self, "_proc", None) is None:
             return
         self._teardown([self._input, self._output])
@@ -884,7 +914,7 @@ class DPLBClient(_ZMQClientBase):
         """The coordinator is supervision, not the data path: if it dies,
         respawn it (a dead coordinator would otherwise silently freeze the
         wave state and leave lockstep ranks dummy-stepping forever)."""
-        if self._coord.is_alive():
+        if self._coord.is_alive() or self._closing:
             return
         self._coord_respawns += 1
         logger.warning(
@@ -1010,6 +1040,9 @@ class DPLBClient(_ZMQClientBase):
         return bool(self._live)
 
     def shutdown(self) -> None:
+        # Respawn latch first (engines AND coordinator): teardown must
+        # never race a background respawn back to life.
+        self._closing = True
         if not getattr(self, "_procs", None):
             return
         try:

@@ -62,6 +62,11 @@ class RequestState:
         )
         self.num_sent_chars = 0
         self.queue = queue  # per-request asyncio queue (streaming mode)
+        # Lifecycle hardening (vllm_tpu/resilience/lifecycle): absolute
+        # monotonic deadline (None = none) and the TTFT cutoff, both set
+        # by AsyncLLM at admission and swept on the engine thread.
+        self.deadline_t: float | None = None
+        self.ttft_deadline_t: float | None = None
 
     def make_request_output(
         self, new_token_ids: list[int], finish_reason: str | None, stop_reason
@@ -113,9 +118,15 @@ class OutputProcessor:
     FINISHED_RING_SIZE = 128
 
     def __init__(self, tokenizer: Any | None = None,
-                 journal: Any | None = None) -> None:
+                 journal: Any | None = None,
+                 on_request_closed: Any | None = None) -> None:
         self.tokenizer = tokenizer
         self.request_states: dict[str, RequestState] = {}
+        # Lifecycle hook: called with the request_id whenever a request's
+        # frontend state is removed (finish, abort, crash-fail) — the
+        # AdmissionController releases its capacity reservation here.
+        # Must be idempotent: a request can be aborted twice.
+        self.on_request_closed = on_request_closed
         # Optional crash-recovery journal (vllm_tpu/resilience): emitted
         # tokens are recorded here as they are processed, so a request
         # interrupted by an engine crash can resume from exactly what the
@@ -165,6 +176,8 @@ class OutputProcessor:
                 self._record_finished(state, time.monotonic(), "abort")
             if self.journal is not None:
                 self.journal.discard(rid)
+            if self.on_request_closed is not None:
+                self.on_request_closed(rid)
 
     def get_num_unfinished_requests(self) -> int:
         return len(self.request_states)
@@ -248,6 +261,8 @@ class OutputProcessor:
                 self.request_states.pop(eco.req_id, None)
                 if self.journal is not None:
                     self.journal.record_finished(eco.req_id)
+                if self.on_request_closed is not None:
+                    self.on_request_closed(eco.req_id)
 
             out = state.make_request_output(
                 eco.new_token_ids, finish_reason, stop_reason
@@ -320,6 +335,10 @@ class OutputProcessor:
                 "kv_blocks_held": state.kv_blocks_held,
                 "queue_s": state.queue_time,
                 "ttft_s": m.ttft,
+                "deadline_remaining_s": (
+                    state.deadline_t - now
+                    if state.deadline_t is not None else None
+                ),
             })
         recent = [
             t.as_dict() for t in reversed(list(self.finished_timings))

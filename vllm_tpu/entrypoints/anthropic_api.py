@@ -15,9 +15,26 @@ from typing import Any
 from aiohttp import web
 
 from vllm_tpu.entrypoints.openai.protocol import ValidationError, random_id
+from vllm_tpu.resilience import RequestShedError
 from vllm_tpu.sampling_params import RequestOutputKind, SamplingParams
 
 _STOP_MAP = {"stop": "end_turn", "length": "max_tokens", "abort": "end_turn"}
+
+
+def _shed_response(e: RequestShedError) -> web.Response:
+    """Anthropic-shaped overload error (the native ``overloaded_error``
+    type), keeping the 429/503 split and Retry-After semantics of the
+    OpenAI surface."""
+    import math
+
+    return web.json_response(
+        {
+            "type": "error",
+            "error": {"type": "overloaded_error", "message": str(e)},
+        },
+        status=e.http_status,
+        headers={"Retry-After": str(int(math.ceil(e.retry_after_s)))},
+    )
 
 
 def _content_text(content: Any) -> str:
@@ -96,8 +113,11 @@ async def handle_messages(request: web.Request) -> web.Response:
 
     if not body.get("stream"):
         final = None
-        async for out in engine.generate(prompt, params, rid):
-            final = out
+        try:
+            async for out in engine.generate(prompt, params, rid):
+                final = out
+        except RequestShedError as e:
+            return _shed_response(e)
         assert final is not None
         c = final.outputs[0]
         return web.json_response({
@@ -116,7 +136,14 @@ async def handle_messages(request: web.Request) -> web.Response:
             },
         })
 
-    # Streaming: the Anthropic event-stream protocol.
+    # Streaming: the Anthropic event-stream protocol. Shed BEFORE
+    # committing to the event stream — a clean 429/503, not a 200 that
+    # errors mid-stream (the native protocol's "overloaded_error").
+    try:
+        if hasattr(engine, "check_admission"):
+            engine.check_admission()
+    except RequestShedError as e:
+        return _shed_response(e)
     resp = web.StreamResponse(
         status=200,
         headers={

@@ -28,11 +28,20 @@ import grpc
 
 from vllm_tpu.engine.arg_utils import AsyncEngineArgs
 from vllm_tpu.logger import init_logger
+from vllm_tpu.resilience import RequestShedError
 from vllm_tpu.sampling_params import SamplingParams
 
 logger = init_logger(__name__)
 
 _SERVICE = "vllmtpu.LLM"
+
+
+def _shed_code(e: RequestShedError) -> grpc.StatusCode:
+    # Draining replica -> UNAVAILABLE (clients fail over); transient
+    # saturation -> RESOURCE_EXHAUSTED (clients back off and retry).
+    if e.reason == "draining":
+        return grpc.StatusCode.UNAVAILABLE
+    return grpc.StatusCode.RESOURCE_EXHAUSTED
 
 
 def _dumps(obj: dict) -> bytes:
@@ -103,17 +112,20 @@ def make_server(engine, model_name: str) -> grpc.aio.Server:
                 return
             rid = request.request_id or f"grpc-{uuid.uuid4().hex[:16]}"
             sent_text = sent_tok = 0
-            async for out in engine.generate(prompt, params, rid):
-                comp = out.outputs[0]
-                yield llm_pb2.GenerateResponse(
-                    request_id=rid,
-                    text=comp.text[sent_text:],
-                    token_ids=list(comp.token_ids[sent_tok:]),
-                    finished=out.finished,
-                    finish_reason=comp.finish_reason or "",
-                )
-                sent_text = len(comp.text)
-                sent_tok = len(comp.token_ids)
+            try:
+                async for out in engine.generate(prompt, params, rid):
+                    comp = out.outputs[0]
+                    yield llm_pb2.GenerateResponse(
+                        request_id=rid,
+                        text=comp.text[sent_text:],
+                        token_ids=list(comp.token_ids[sent_tok:]),
+                        finished=out.finished,
+                        finish_reason=comp.finish_reason or "",
+                    )
+                    sent_text = len(comp.text)
+                    sent_tok = len(comp.token_ids)
+            except RequestShedError as exc:
+                await context.abort(_shed_code(exc), str(exc))
 
         async def Health(self, request, context):
             return llm_pb2.HealthResponse(status="SERVING")
@@ -141,17 +153,20 @@ def make_server(engine, model_name: str) -> grpc.aio.Server:
             return
         rid = req.get("request_id") or f"grpc-{uuid.uuid4().hex[:16]}"
         sent_text = sent_tok = 0
-        async for out in engine.generate(prompt, params, rid):
-            comp = out.outputs[0]
-            yield _dumps({
-                "request_id": rid,
-                "text": comp.text[sent_text:],
-                "token_ids": list(comp.token_ids[sent_tok:]),
-                "finished": out.finished,
-                "finish_reason": comp.finish_reason,
-            })
-            sent_text = len(comp.text)
-            sent_tok = len(comp.token_ids)
+        try:
+            async for out in engine.generate(prompt, params, rid):
+                comp = out.outputs[0]
+                yield _dumps({
+                    "request_id": rid,
+                    "text": comp.text[sent_text:],
+                    "token_ids": list(comp.token_ids[sent_tok:]),
+                    "finished": out.finished,
+                    "finish_reason": comp.finish_reason,
+                })
+                sent_text = len(comp.text)
+                sent_tok = len(comp.token_ids)
+        except RequestShedError as exc:
+            await context.abort(_shed_code(exc), str(exc))
 
     async def health(request: bytes, context):
         return _dumps({"status": "SERVING"})

@@ -18,6 +18,7 @@ from __future__ import annotations
 
 import asyncio
 import json
+import math
 from typing import Any, AsyncIterator
 
 from aiohttp import web
@@ -32,8 +33,13 @@ from vllm_tpu.entrypoints.openai.protocol import (
 )
 from vllm_tpu.logger import init_logger
 from vllm_tpu.outputs import RequestOutput
+from vllm_tpu.resilience import RequestShedError
 
 logger = init_logger(__name__)
+
+# Per-request deadline override header (seconds); the body's deadline_s
+# field wins when both are present.
+DEADLINE_HEADER = "X-Request-Deadline-S"
 
 ENGINE_KEY = web.AppKey("engine", AsyncLLM)
 MODEL_KEY = web.AppKey("model_name", str)
@@ -47,6 +53,39 @@ def _error(status: int, message: str, err_type: str = "invalid_request_error"):
         {"error": {"message": message, "type": err_type, "code": status}},
         status=status,
     )
+
+
+def _shed_response(e: RequestShedError) -> web.Response:
+    """Load-shed / draining rejection: OpenAI-style error body, 429
+    (saturated, back off and retry) or 503 (draining, fail over), with a
+    Retry-After header either way."""
+    err_type = (
+        "service_unavailable_error" if e.reason == "draining"
+        else "overloaded_error"
+    )
+    return web.json_response(
+        {"error": {
+            "message": str(e), "type": err_type, "code": e.http_status,
+        }},
+        status=e.http_status,
+        headers={"Retry-After": str(int(math.ceil(e.retry_after_s)))},
+    )
+
+
+def _apply_deadline_header(request: web.Request, params) -> str | None:
+    """Fold the X-Request-Deadline-S header into SamplingParams (body
+    field wins). Returns an error message for a malformed header."""
+    hdr = request.headers.get(DEADLINE_HEADER)
+    if hdr is None or params.deadline_s is not None:
+        return None
+    try:
+        deadline = float(hdr)
+    except ValueError:
+        return f"{DEADLINE_HEADER} must be a number, got {hdr!r}"
+    if deadline <= 0:
+        return f"{DEADLINE_HEADER} must be > 0, got {hdr!r}"
+    params.deadline_s = deadline
+    return None
 
 
 # ----------------------------------------------------------------------
@@ -67,7 +106,12 @@ async def handle_completions(request: web.Request) -> web.StreamResponse:
         return _error(400, "'n' must be >= 1")
     if req.stream and (len(prompts) != 1 or req.n != 1):
         return _error(400, "streaming supports a single prompt with n=1")
-    params = req.to_sampling_params(req.stream)
+    try:
+        params = req.to_sampling_params(req.stream)
+    except ValueError as e:
+        return _error(400, str(e))
+    if (msg := _apply_deadline_header(request, params)) is not None:
+        return _error(400, msg)
     req_id = random_id("cmpl")
 
     if req.stream:
@@ -87,6 +131,8 @@ async def handle_completions(request: web.Request) -> web.StreamResponse:
             jobs.append(_collect(engine, p, sp, f"{req_id}-{i}-{j}"))
     try:
         results = await asyncio.gather(*jobs)
+    except RequestShedError as e:
+        return _shed_response(e)
     except EngineDeadError as e:
         return _error(500, str(e), "internal_error")
     choices = []
@@ -122,6 +168,15 @@ async def handle_completions(request: web.Request) -> web.StreamResponse:
 async def _stream_completion(
     request, engine, req, prompt, params, req_id
 ) -> web.StreamResponse:
+    # Admission pre-check BEFORE committing to an SSE response: a shed
+    # must be a clean 429/503 with Retry-After, not a 200 event stream
+    # that errors on its first event. generate() re-checks
+    # authoritatively (reserving); the rare lost race is handled below.
+    try:
+        if hasattr(engine, "check_admission"):
+            engine.check_admission()
+    except RequestShedError as e:
+        return _shed_response(e)
     resp = _sse_response(request)
     await resp.prepare(request)
     model = req.model or request.app[MODEL_KEY]
@@ -144,6 +199,11 @@ async def _stream_completion(
                 await _sse_send(resp, chunk)
     except (ConnectionResetError, asyncio.CancelledError):
         return resp
+    except RequestShedError as e:
+        await _sse_send(resp, {"error": {
+            "message": str(e), "type": "overloaded_error",
+            "code": e.http_status,
+        }})
     except EngineDeadError as e:
         await _sse_send(resp, {"error": {"message": str(e)}})
     await _sse_done(resp)
@@ -188,12 +248,22 @@ async def handle_chat_completions(request: web.Request) -> web.StreamResponse:
         return _error(400, "'n' must be >= 1")
     if req.stream and req.n != 1:
         return _error(400, "streaming supports n=1")
-    params = req.to_sampling_params(req.stream)
+    try:
+        params = req.to_sampling_params(req.stream)
+    except ValueError as e:
+        return _error(400, str(e))
+    if (msg := _apply_deadline_header(request, params)) is not None:
+        return _error(400, msg)
     req_id = random_id("chatcmpl")
     prompt = {"prompt_token_ids": list(prompt_ids)}
     model = req.model or request.app[MODEL_KEY]
 
     if req.stream:
+        try:
+            if hasattr(engine, "check_admission"):
+                engine.check_admission()
+        except RequestShedError as e:
+            return _shed_response(e)
         resp = _sse_response(request)
         await resp.prepare(request)
         first = True
@@ -288,6 +358,11 @@ async def handle_chat_completions(request: web.Request) -> web.StreamResponse:
                     await emit(delta, finish)
         except (ConnectionResetError, asyncio.CancelledError):
             return resp
+        except RequestShedError as e:
+            await _sse_send(resp, {"error": {
+                "message": str(e), "type": "overloaded_error",
+                "code": e.http_status,
+            }})
         except EngineDeadError as e:
             await _sse_send(resp, {"error": {"message": str(e)}})
         await _sse_done(resp)
@@ -303,6 +378,8 @@ async def handle_chat_completions(request: web.Request) -> web.StreamResponse:
         jobs.append(_collect(engine, prompt, sp, f"{req_id}-{j}"))
     try:
         results = await asyncio.gather(*jobs)
+    except RequestShedError as e:
+        return _shed_response(e)
     except EngineDeadError as e:
         return _error(500, str(e), "internal_error")
     tool_parser_name = request.app.get(TOOL_PARSER_KEY)
@@ -407,6 +484,8 @@ async def handle_embeddings(request: web.Request) -> web.Response:
 
     try:
         finals = await asyncio.gather(*(one(p) for p in prompts))
+    except RequestShedError as e:
+        return _shed_response(e)
     except (ValueError, TypeError) as e:
         return _error(400, str(e))
     data = []
@@ -503,9 +582,12 @@ async def handle_ready(request: web.Request) -> web.Response:
     ready = engine.is_ready() if hasattr(engine, "is_ready") else (
         not engine._dead
     )
-    return web.json_response(
-        {"ready": ready}, status=200 if ready else 503
-    )
+    body = {"ready": ready}
+    if hasattr(engine, "lifecycle_status"):
+        ls = engine.lifecycle_status()
+        body["draining"] = ls["draining"]
+        body["inflight_requests"] = ls["inflight_requests"]
+    return web.json_response(body, status=200 if ready else 503)
 
 
 async def handle_debug_requests(request: web.Request) -> web.Response:
@@ -517,7 +599,10 @@ async def handle_debug_requests(request: web.Request) -> web.Response:
         return web.json_response(
             {"error": "engine does not support request introspection"},
             status=501)
-    return web.json_response(engine.debug_requests())
+    snapshot = engine.debug_requests()
+    if hasattr(engine, "lifecycle_status"):
+        snapshot["lifecycle"] = engine.lifecycle_status()
+    return web.json_response(snapshot)
 
 
 async def handle_metrics(request: web.Request) -> web.Response:
@@ -664,6 +749,20 @@ def build_app(engine: AsyncLLM, model_name: str, metrics=None,
 def run_server(engine_args, host: str = "0.0.0.0", port: int = 8000,
                tool_parser: str | None = None,
                reasoning_parser: str | None = None) -> None:
+    """Serve until SIGTERM/SIGINT, then drain gracefully.
+
+    The drain sequence (see README "Overload & lifecycle"): the signal
+    closes ADMISSION, not the listener — new requests get a clean 503 +
+    Retry-After (and /ready flips 503 so the load balancer stops routing
+    here) while in-flight requests keep streaming. Supervisor respawns
+    are suspended so teardown can never race a respawn back to life.
+    After the drain budget, stragglers are finished with
+    finish_reason="timeout"; only then do the listener and engine come
+    down. web.run_app would do the opposite — stop the listener first,
+    turning every late request into a connection error.
+    """
+    import signal
+
     from vllm_tpu.metrics.prometheus import PrometheusRegistry
 
     engine = AsyncLLM.from_engine_args(engine_args)
@@ -674,7 +773,25 @@ def run_server(engine_args, host: str = "0.0.0.0", port: int = 8000,
         tool_parser=tool_parser, reasoning_parser=reasoning_parser,
     )
     logger.info("serving %s on %s:%d", engine_args.model, host, port)
+
+    async def _serve() -> None:
+        runner = web.AppRunner(app)
+        await runner.setup()
+        site = web.TCPSite(runner, host, port)
+        await site.start()
+        loop = asyncio.get_running_loop()
+        stop = asyncio.Event()
+        for sig in (signal.SIGTERM, signal.SIGINT):
+            try:
+                loop.add_signal_handler(sig, stop.set)
+            except NotImplementedError:  # pragma: no cover - non-unix
+                pass
+        await stop.wait()
+        logger.info("shutdown signal received; draining")
+        await engine.drain()
+        await runner.cleanup()
+
     try:
-        web.run_app(app, host=host, port=port, print=None)
+        asyncio.run(_serve())
     finally:
         engine.shutdown()

@@ -121,6 +121,10 @@ class CompletionRequest:
     logit_bias: dict[int, float] | None = None
     bad_words: list[str] = field(default_factory=list)
     allowed_token_ids: list[int] | None = None
+    # Lifecycle extension: per-request end-to-end deadline (seconds);
+    # overrides the server default. Also settable via the
+    # X-Request-Deadline-S header (body wins).
+    deadline_s: float | None = None
 
     @classmethod
     def from_json(cls, d: dict) -> "CompletionRequest":
@@ -152,6 +156,7 @@ class CompletionRequest:
             logit_bias=_logit_bias(d),
             bad_words=list(d.get("bad_words") or []),
             allowed_token_ids=_token_id_list(d, "allowed_token_ids"),
+            deadline_s=_get(d, "deadline_s", (int, float)),
         )
 
     def to_sampling_params(self, stream: bool) -> SamplingParams:
@@ -173,6 +178,10 @@ class CompletionRequest:
             logit_bias=self.logit_bias,
             bad_words=self.bad_words,
             allowed_token_ids=self.allowed_token_ids,
+            deadline_s=(
+                float(self.deadline_s)
+                if self.deadline_s is not None else None
+            ),
             output_kind=(
                 RequestOutputKind.DELTA if stream
                 else RequestOutputKind.FINAL_ONLY
@@ -208,6 +217,7 @@ class ChatCompletionRequest:
     logit_bias: dict[int, float] | None = None
     bad_words: list[str] = field(default_factory=list)
     allowed_token_ids: list[int] | None = None
+    deadline_s: float | None = None
 
     @classmethod
     def from_json(cls, d: dict) -> "ChatCompletionRequest":
@@ -248,6 +258,7 @@ class ChatCompletionRequest:
             logit_bias=_logit_bias(d),
             bad_words=list(d.get("bad_words") or []),
             allowed_token_ids=_token_id_list(d, "allowed_token_ids"),
+            deadline_s=_get(d, "deadline_s", (int, float)),
         )
 
     def to_sampling_params(self, stream: bool) -> SamplingParams:
@@ -272,6 +283,10 @@ class ChatCompletionRequest:
             logit_bias=self.logit_bias,
             bad_words=self.bad_words,
             allowed_token_ids=self.allowed_token_ids,
+            deadline_s=(
+                float(self.deadline_s)
+                if self.deadline_s is not None else None
+            ),
             output_kind=(
                 RequestOutputKind.DELTA if stream
                 else RequestOutputKind.FINAL_ONLY

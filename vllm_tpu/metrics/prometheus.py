@@ -250,6 +250,33 @@ class PrometheusRegistry:
         self.requests_failed_on_crash = Counter(
             "vllm:requests_failed_on_crash_total",
             "Requests failed because an engine core crashed")
+        self.requests_lost_on_restart = Counter(
+            "vllm:requests_lost_on_restart_total",
+            "Requests found in the persisted journal after a frontend "
+            "restart (lost in flight)")
+        # Lifecycle / overload protection (vllm_tpu/resilience/lifecycle):
+        # refreshed from the engine's live snapshot at render time, same
+        # scheme as the resilience metrics above.
+        self.requests_shed = LabeledCounter(
+            "vllm:requests_shed_total",
+            "Requests rejected by admission control", "reason")
+        self.request_timeouts = LabeledCounter(
+            "vllm:request_timeouts_total",
+            "Requests finished by deadline enforcement", "kind")
+        self.stream_outputs_dropped = Counter(
+            "vllm:stream_outputs_dropped_total",
+            "Intermediate outputs dropped on bounded streams "
+            "(slow clients, drop_oldest policy)")
+        self.slow_client_aborts = Counter(
+            "vllm:requests_aborted_slow_client_total",
+            "Requests aborted because the client consumed too slowly "
+            "(abort policy)")
+        self.lifecycle_draining = Gauge(
+            "vllm:lifecycle_draining",
+            "1 while the server is draining (admission closed), else 0")
+        self.inflight_prompt_tokens = Gauge(
+            "vllm:inflight_prompt_tokens",
+            "Prompt tokens reserved by admitted in-flight requests")
         self._metrics = [
             self.num_running, self.num_waiting, self.kv_usage,
             self.prefix_queries, self.prefix_hits, self.preempted,
@@ -263,6 +290,10 @@ class PrometheusRegistry:
             self.batch_occupancy, self.step_interval,
             self.engine_up, self.engine_restarts,
             self.requests_replayed, self.requests_failed_on_crash,
+            self.requests_lost_on_restart,
+            self.requests_shed, self.request_timeouts,
+            self.stream_outputs_dropped, self.slow_client_aborts,
+            self.lifecycle_draining, self.inflight_prompt_tokens,
         ]
         self._engine = engine
         self._last_prefix = (0, 0)
@@ -344,9 +375,32 @@ class PrometheusRegistry:
             float(status.get("requests_replayed_total", 0)))
         self.requests_failed_on_crash.inc_to(
             float(status.get("requests_failed_on_crash_total", 0)))
+        self.requests_lost_on_restart.inc_to(
+            float(status.get("requests_lost_on_restart_total", 0)))
+
+    def _refresh_lifecycle(self) -> None:
+        engine = self._engine
+        if engine is None or not hasattr(engine, "lifecycle_status"):
+            return
+        try:
+            status = engine.lifecycle_status()
+        except Exception:
+            return
+        for reason, n in status.get("shed", {}).items():
+            self.requests_shed.inc_to(reason, float(n))
+        for kind, n in status.get("timeouts", {}).items():
+            self.request_timeouts.inc_to(kind, float(n))
+        self.stream_outputs_dropped.inc_to(
+            float(status.get("stream_outputs_dropped_total", 0)))
+        self.slow_client_aborts.inc_to(
+            float(status.get("slow_client_aborts_total", 0)))
+        self.lifecycle_draining.set(1.0 if status.get("draining") else 0.0)
+        self.inflight_prompt_tokens.set(
+            float(status.get("inflight_prompt_tokens", 0)))
 
     def render(self) -> str:
         self._refresh_resilience()
+        self._refresh_lifecycle()
         return "".join(m.render() for m in self._metrics)
 
 

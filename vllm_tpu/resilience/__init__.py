@@ -24,10 +24,28 @@ Pieces:
   the dead engine so the frontend can replay or fail them.
 - :class:`RequestFailedOnCrashError` — the per-request error delivered to
   a stream whose request exhausted its crash-retry budget.
+
+Overload protection (request-lifecycle hardening) lives in
+:mod:`vllm_tpu.resilience.lifecycle`:
+
+- :class:`LifecycleConfig` — admission caps, deadlines, stream-buffer
+  policy, drain budget.
+- :class:`AdmissionController` — bounded admission + drain latch + shed
+  accounting.
+- :class:`RequestShedError` / :class:`SlowClientError` — load-shed and
+  slow-consumer-abort errors.
 """
 
 from vllm_tpu.resilience.config import ResilienceConfig
 from vllm_tpu.resilience.journal import JournalEntry, RequestJournal
+from vllm_tpu.resilience.lifecycle import (
+    TIMEOUT_FINISH_REASON,
+    AdmissionController,
+    LifecycleConfig,
+    RequestShedError,
+    SlowClientError,
+    make_shed_error,
+)
 from vllm_tpu.resilience.supervisor import EngineSupervisor
 
 
@@ -68,10 +86,16 @@ class RequestFailedOnCrashError(RuntimeError):
 
 
 __all__ = [
+    "AdmissionController",
     "EngineRestartedError",
     "EngineSupervisor",
     "JournalEntry",
+    "LifecycleConfig",
     "RequestFailedOnCrashError",
     "RequestJournal",
+    "RequestShedError",
     "ResilienceConfig",
+    "SlowClientError",
+    "TIMEOUT_FINISH_REASON",
+    "make_shed_error",
 ]

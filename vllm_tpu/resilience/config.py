@@ -37,6 +37,13 @@ class ResilienceConfig:
     # hung, kills it, and runs the normal crash-recovery path. Off by
     # default — first-token compile on a cold cache can take minutes.
     heartbeat_timeout_s: float = 0.0
+    # Opt-in journal persistence: directory where the RequestJournal
+    # snapshots admitted requests. On frontend restart, leftover snapshots
+    # identify requests that were lost in flight (reported via
+    # vllm:requests_lost_on_restart_total, never silently dropped).
+    # None = in-memory journal only. Works with or without
+    # enable_recovery (persistence alone creates a journal).
+    journal_dir: str | None = None
 
     def finalize(self) -> "ResilienceConfig":
         if self.max_engine_restarts < 0:

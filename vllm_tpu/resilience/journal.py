@@ -24,11 +24,17 @@ Known resume caveats (documented, not silently wrong):
 from __future__ import annotations
 
 import copy
+import hashlib
+import json
+import logging
+import os
 import threading
 from dataclasses import dataclass, field
 from typing import Any
 
 from vllm_tpu.request import EngineCoreRequest
+
+logger = logging.getLogger(__name__)
 
 
 @dataclass
@@ -97,12 +103,93 @@ class JournalEntry:
 
 
 class RequestJournal:
-    def __init__(self) -> None:
+    def __init__(self, persist_dir: str | None = None) -> None:
         self._lock = threading.Lock()
         self._entries: dict[str, JournalEntry] = {}
         # Cumulative event counters (exported via /metrics).
         self.requests_replayed_total = 0
         self.requests_failed_on_crash_total = 0
+        # Opt-in disk persistence: one small JSON snapshot per admitted
+        # request, unlinked on finish/abort. Whatever survives a frontend
+        # restart was lost in flight — reported on the next startup, never
+        # silently dropped.
+        self._persist_dir = persist_dir
+        self.lost_on_restart: list[dict] = []
+        self.requests_lost_on_restart_total = 0
+        if persist_dir is not None:
+            os.makedirs(persist_dir, exist_ok=True)
+            self._scan_lost_requests()
+
+    # -- persistence ----------------------------------------------------
+
+    @staticmethod
+    def _snapshot_name(request_id: str) -> str:
+        # Request ids are client-supplied and may contain filesystem-unsafe
+        # characters; name snapshots by digest, store the id inside.
+        digest = hashlib.sha1(request_id.encode()).hexdigest()
+        return f"{digest}.json"
+
+    def _persist_admitted(self, entry: JournalEntry) -> None:
+        if self._persist_dir is None:
+            return
+        path = os.path.join(
+            self._persist_dir, self._snapshot_name(entry.request_id))
+        snapshot = {
+            "request_id": entry.request_id,
+            "arrival_time": entry.arrival_time,
+            "num_prompt_tokens": len(entry.prompt_token_ids),
+            "max_tokens": entry.sampling_params.max_tokens
+            if entry.sampling_params is not None else None,
+        }
+        try:
+            tmp = path + ".tmp"
+            with open(tmp, "w") as f:
+                json.dump(snapshot, f)
+            os.replace(tmp, path)
+        except OSError as e:
+            logger.warning("journal: failed to persist %s: %s",
+                           entry.request_id, e)
+
+    def _unpersist(self, request_id: str) -> None:
+        if self._persist_dir is None:
+            return
+        path = os.path.join(
+            self._persist_dir, self._snapshot_name(request_id))
+        try:
+            os.unlink(path)
+        except FileNotFoundError:
+            pass
+        except OSError as e:
+            logger.warning("journal: failed to remove snapshot for %s: %s",
+                           request_id, e)
+
+    def _scan_lost_requests(self) -> None:
+        """Startup scan: snapshots left behind by a previous frontend are
+        requests that died with it. Report them, then clear the files so
+        the next restart doesn't double-count."""
+        assert self._persist_dir is not None
+        for name in sorted(os.listdir(self._persist_dir)):
+            if not name.endswith(".json"):
+                continue
+            path = os.path.join(self._persist_dir, name)
+            try:
+                with open(path) as f:
+                    self.lost_on_restart.append(json.load(f))
+            except (OSError, ValueError) as e:
+                logger.warning("journal: unreadable snapshot %s: %s",
+                               name, e)
+            try:
+                os.unlink(path)
+            except OSError:
+                pass
+        self.requests_lost_on_restart_total = len(self.lost_on_restart)
+        if self.lost_on_restart:
+            logger.warning(
+                "journal: %d request(s) were in flight when the previous "
+                "frontend exited and were lost: %s",
+                len(self.lost_on_restart),
+                [e.get("request_id") for e in self.lost_on_restart],
+            )
 
     def __len__(self) -> int:
         with self._lock:
@@ -123,6 +210,7 @@ class RequestJournal:
         )
         with self._lock:
             self._entries[req.request_id] = entry
+        self._persist_admitted(entry)
         return entry
 
     def record_tokens(self, request_id: str,
@@ -135,10 +223,12 @@ class RequestJournal:
     def record_finished(self, request_id: str) -> None:
         with self._lock:
             self._entries.pop(request_id, None)
+        self._unpersist(request_id)
 
     def discard(self, request_id: str) -> None:
         with self._lock:
             self._entries.pop(request_id, None)
+        self._unpersist(request_id)
 
     def get(self, request_id: str) -> JournalEntry | None:
         with self._lock:
@@ -155,3 +245,4 @@ class RequestJournal:
         with self._lock:
             self._entries.pop(request_id, None)
             self.requests_failed_on_crash_total += 1
+        self._unpersist(request_id)

@@ -1,0 +1,254 @@
+"""Request-lifecycle hardening: admission control, deadlines, slow-client
+backpressure, graceful drain.
+
+PR 1 (supervision + journaling) made *crashes* survivable; this module
+makes *overload* survivable. The reference vLLM stack leans on the load
+balancer for 503s and on clients for timeouts; a production TPU stack
+needs the protections natively:
+
+- :class:`LifecycleConfig` — the knob surface (admission caps, deadline
+  defaults, stream buffer policy, drain budget), living beside
+  :class:`~vllm_tpu.resilience.config.ResilienceConfig` in EngineConfig.
+- :class:`AdmissionController` — bounded admission: caps on concurrently
+  admitted requests and on their total prompt tokens, a draining latch
+  that stops admission during graceful shutdown, and per-reason shed
+  counters (``vllm:requests_shed_total{reason=...}``).
+- :class:`RequestShedError` — raised by ``AsyncLLM.generate`` instead of
+  queuing unboundedly; the HTTP layer maps it to an OpenAI-style 429
+  (saturated) / 503 (draining) error body with a ``Retry-After`` header.
+- :class:`SlowClientError` — delivered to a stream whose consumer stalled
+  past its buffer bound under the ``abort`` overflow policy.
+
+Defaults keep every protection OFF (caps 0 = unlimited, deadlines 0 =
+none, buffers unbounded): existing callers see no behavior change unless
+they opt in.
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass, field
+from typing import Literal
+
+# The finish_reason delivered for a request that hit its deadline or TTFT
+# timeout (streamed like "stop"/"length"; never an exception — a timeout
+# is an expected lifecycle outcome, not a server fault).
+TIMEOUT_FINISH_REASON = "timeout"
+
+
+class RequestShedError(RuntimeError):
+    """Admission rejected a request (load shed or draining).
+
+    ``reason`` is the shed-counter label: ``saturated_requests``,
+    ``saturated_tokens``, or ``draining``. ``retry_after_s`` feeds the
+    HTTP ``Retry-After`` header.
+    """
+
+    def __init__(self, reason: str, message: str,
+                 retry_after_s: float = 1.0) -> None:
+        super().__init__(message)
+        self.reason = reason
+        self.retry_after_s = retry_after_s
+
+    @property
+    def http_status(self) -> int:
+        # Draining is the replica going away (503, load balancer should
+        # fail over); saturation is transient backpressure (429, client
+        # should back off and retry the same replica).
+        return 503 if self.reason == "draining" else 429
+
+
+class SlowClientError(RuntimeError):
+    """A request was aborted because its output stream overflowed (the
+    consumer stopped reading) under the ``abort`` overflow policy."""
+
+    def __init__(self, request_id: str, buffered: int) -> None:
+        super().__init__(
+            f"request {request_id} aborted: output stream overflowed "
+            f"({buffered} undelivered outputs; the client stopped reading)"
+        )
+        self.request_id = request_id
+
+
+@dataclass
+class LifecycleConfig:
+    """Overload-protection knob surface (part of EngineConfig)."""
+
+    # Admission control: max concurrently admitted (queued + in-flight)
+    # requests, 0 = unlimited. Past the cap, new requests are shed with
+    # RequestShedError("saturated_requests") instead of queuing.
+    max_inflight_requests: int = 0
+    # Cap on the total prompt tokens of admitted-but-unfinished requests
+    # (bounds frontend+engine queue memory for prompt-heavy bursts);
+    # 0 = unlimited. One over-cap request is still admitted when the pool
+    # is empty — a single huge prompt must not be unservable.
+    max_queued_prompt_tokens: int = 0
+    # Server-default end-to-end deadline per request, seconds; 0 = none.
+    # A request past its deadline is aborted engine-side and finished
+    # with finish_reason="timeout". Per-request override:
+    # SamplingParams.deadline_s / the X-Request-Deadline-S header.
+    default_deadline_s: float = 0.0
+    # Time-to-first-token timeout, seconds; 0 = off. A request still
+    # waiting for its first token after this long (stuck queued behind a
+    # saturated engine) times out even without a full deadline.
+    ttft_timeout_s: float = 0.0
+    # Slow-client backpressure: max undelivered outputs buffered per
+    # request stream; 0 = unbounded (reference behavior).
+    stream_buffer_size: int = 0
+    # On stream overflow: "drop_oldest" discards the oldest undelivered
+    # output (safe for CUMULATIVE/FINAL_ONLY kinds where later outputs
+    # supersede earlier ones; delta consumers see num_dropped_outputs on
+    # the next output) or "abort" kills the request with SlowClientError.
+    stream_overflow_policy: Literal["drop_oldest", "abort"] = "drop_oldest"
+    # Graceful drain: how long SIGTERM/drain() lets in-flight requests
+    # finish before aborting stragglers and exiting.
+    drain_timeout_s: float = 30.0
+    # Retry-After header value on 429/503 shed responses.
+    retry_after_s: float = 1.0
+
+    def finalize(self) -> "LifecycleConfig":
+        if self.max_inflight_requests < 0:
+            raise ValueError(
+                f"max_inflight_requests must be >= 0, got "
+                f"{self.max_inflight_requests}"
+            )
+        if self.max_queued_prompt_tokens < 0:
+            raise ValueError(
+                f"max_queued_prompt_tokens must be >= 0, got "
+                f"{self.max_queued_prompt_tokens}"
+            )
+        if self.default_deadline_s < 0 or self.ttft_timeout_s < 0:
+            raise ValueError("deadline/timeout values must be >= 0")
+        if self.stream_buffer_size < 0:
+            raise ValueError(
+                f"stream_buffer_size must be >= 0, got "
+                f"{self.stream_buffer_size}"
+            )
+        if self.stream_overflow_policy not in ("drop_oldest", "abort"):
+            raise ValueError(
+                f"unknown stream_overflow_policy "
+                f"{self.stream_overflow_policy!r}"
+            )
+        if self.drain_timeout_s < 0:
+            raise ValueError("drain_timeout_s must be >= 0")
+        if self.retry_after_s < 0:
+            raise ValueError("retry_after_s must be >= 0")
+        return self
+
+
+class AdmissionController:
+    """Bounded admission + drain latch + shed accounting.
+
+    Thread-safe: ``try_admit`` runs on the event loop (generate()),
+    ``release`` on whichever thread closes the request (engine busy loop
+    for finishes/timeouts, event loop for disconnect aborts), and the
+    drain latch flips from a signal handler's task.
+    """
+
+    def __init__(self, config: LifecycleConfig) -> None:
+        self.config = config
+        self._lock = threading.Lock()
+        # request_id -> reserved prompt tokens (idempotent release).
+        self._admitted: dict[str, int] = {}
+        self._inflight_tokens = 0
+        self.draining = False
+        # Cumulative shed events by reason (feeds
+        # vllm:requests_shed_total{reason=...}).
+        self.shed_total: dict[str, int] = {}
+
+    # -- admission -----------------------------------------------------
+
+    def precheck(self) -> str | None:
+        """Cheap admission probe WITHOUT reserving (streaming handlers
+        check before committing to an SSE response). Returns the shed
+        reason, or None if a request would currently be admitted."""
+        cfg = self.config
+        with self._lock:
+            if self.draining:
+                return "draining"
+            if (
+                cfg.max_inflight_requests
+                and len(self._admitted) >= cfg.max_inflight_requests
+            ):
+                return "saturated_requests"
+        return None
+
+    def try_admit(self, request_id: str, num_prompt_tokens: int) -> str | None:
+        """Admit (reserving capacity) or return the shed reason. A shed
+        is counted here so served + shed accounting always balances."""
+        cfg = self.config
+        with self._lock:
+            reason = None
+            if self.draining:
+                reason = "draining"
+            elif (
+                cfg.max_inflight_requests
+                and len(self._admitted) >= cfg.max_inflight_requests
+            ):
+                reason = "saturated_requests"
+            elif (
+                cfg.max_queued_prompt_tokens
+                and self._admitted  # an empty pool always admits one
+                and self._inflight_tokens + num_prompt_tokens
+                > cfg.max_queued_prompt_tokens
+            ):
+                reason = "saturated_tokens"
+            if reason is not None:
+                self.shed_total[reason] = self.shed_total.get(reason, 0) + 1
+                return reason
+            self._admitted[request_id] = num_prompt_tokens
+            self._inflight_tokens += num_prompt_tokens
+            return None
+
+    def release(self, request_id: str) -> None:
+        with self._lock:
+            tokens = self._admitted.pop(request_id, None)
+            if tokens is not None:
+                self._inflight_tokens -= tokens
+
+    # -- drain ---------------------------------------------------------
+
+    def start_drain(self) -> None:
+        with self._lock:
+            self.draining = True
+
+    # -- snapshots -----------------------------------------------------
+
+    @property
+    def inflight_requests(self) -> int:
+        with self._lock:
+            return len(self._admitted)
+
+    @property
+    def inflight_prompt_tokens(self) -> int:
+        with self._lock:
+            return self._inflight_tokens
+
+    def status(self) -> dict:
+        """JSON-shaped snapshot (feeds /debug/requests and /metrics)."""
+        cfg = self.config
+        with self._lock:
+            return {
+                "draining": self.draining,
+                "inflight_requests": len(self._admitted),
+                "inflight_prompt_tokens": self._inflight_tokens,
+                "max_inflight_requests": cfg.max_inflight_requests,
+                "max_queued_prompt_tokens": cfg.max_queued_prompt_tokens,
+                "shed": dict(self.shed_total),
+            }
+
+
+def make_shed_error(reason: str, config: LifecycleConfig) -> RequestShedError:
+    """The one place shed reasons become user-facing messages."""
+    messages = {
+        "draining": "the server is shutting down and not accepting new "
+                    "requests",
+        "saturated_requests": "the server is at its in-flight request "
+                              "capacity; retry shortly",
+        "saturated_tokens": "the server is at its queued prompt-token "
+                            "capacity; retry shortly",
+    }
+    return RequestShedError(
+        reason, messages.get(reason, reason),
+        retry_after_s=config.retry_after_s,
+    )

@@ -85,6 +85,11 @@ class SamplingParams:
     allowed_token_ids: list[int] | None = None
     logit_bias: dict[int, float] | None = None
     structured_outputs: StructuredOutputParams | None = None
+    # Per-request end-to-end deadline, seconds from admission; None falls
+    # back to LifecycleConfig.default_deadline_s. Past the deadline the
+    # request is aborted engine-side and finished with
+    # finish_reason="timeout" (enforced in AsyncLLM, not the engine core).
+    deadline_s: float | None = None
     # Extension hook carried through untouched.
     extra_args: dict[str, Any] | None = None
 
@@ -124,6 +129,8 @@ class SamplingParams:
             raise ValueError("frequency_penalty must be in [-2, 2]")
         if self.repetition_penalty <= 0:
             raise ValueError("repetition_penalty must be > 0")
+        if self.deadline_s is not None and self.deadline_s <= 0:
+            raise ValueError(f"deadline_s must be > 0, got {self.deadline_s}")
 
     @property
     def sampling_type(self) -> str:
