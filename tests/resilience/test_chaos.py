@@ -1,0 +1,242 @@
+"""Seeded chaos harness tests.
+
+Tier-1 (fast, in-process): plan determinism, InvariantLedger semantics,
+and a full seeded chaos run over the scripted FakeClient — the client IS
+the transport, so frontend failpoint seams (`core_client.recv`) are
+exercised for real while a scripted mid-run crash drives the journal
+replay path.
+
+Slow (multi-process): the acceptance scenario — DP=2 real engines, a
+SIGKILLed coordinator plus a `core_client.recv` fault schedule, asserting
+every admitted request reaches exactly one terminal state, the frontend
+serves throughout (degraded round-robin routing while the snapshot is
+stale), and ``vllm:coordinator_restarts_total`` advances.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+
+import pytest
+
+from tests.resilience.test_recovery_unit import FakeClient, make_engine
+from vllm_tpu.core.sched_output import EngineCoreOutputs
+from vllm_tpu.resilience import failpoints
+from vllm_tpu.resilience.chaos import (
+    OUTCOME_FINISHED,
+    InvariantLedger,
+    make_plan,
+    run_chaos,
+)
+
+
+# -- plan determinism ---------------------------------------------------
+
+
+def _plan(seed):
+    return make_plan(
+        seed, duration_s=10.0, num_engines=2, engine_kills=2,
+        coordinator_kills=1,
+        failpoint_specs=["core_client.recv=5*25%delay(0.1)"])
+
+
+def test_same_seed_same_plan():
+    assert [str(e) for e in _plan(7).events] == \
+        [str(e) for e in _plan(7).events]
+
+
+def test_different_seed_different_plan():
+    assert [str(e) for e in _plan(7).events] != \
+        [str(e) for e in _plan(8).events]
+
+
+def test_faults_land_in_middle_80_percent():
+    for seed in range(20):
+        for ev in _plan(seed).events:
+            assert 1.0 <= ev.at_s <= 9.0
+
+
+# -- ledger semantics ---------------------------------------------------
+
+
+def test_ledger_flags_second_terminal_state():
+    led = InvariantLedger()
+    led.record_admitted("r")
+    led.record_outcome("r", "finished")
+    led.record_outcome("r", "error")
+    assert any("second terminal state" in v for v in led.violations)
+
+
+def test_ledger_flags_admitted_without_terminal_state():
+    led = InvariantLedger()
+    led.record_admitted("r")
+    assert any("no terminal state" in v for v in led.check(object()))
+
+
+def test_ledger_flags_hung_and_post_final():
+    led = InvariantLedger()
+    led.record_admitted("r")
+    led.record_outcome("r", "hung")
+    led.record_post_final_item("r")
+    violations = led.check(object())
+    assert any("hung" in v for v in violations)
+    assert any("after its final" in v for v in violations)
+
+
+def test_ledger_clean_run_has_no_violations():
+    led = InvariantLedger()
+    for i in range(4):
+        led.record_admitted(f"r{i}")
+        led.record_outcome(f"r{i}", OUTCOME_FINISHED)
+    led.record_shed("shed-1")
+    assert led.check(object()) == []
+    assert led.summary()["outcomes"] == {OUTCOME_FINISHED: 4}
+
+
+# -- in-process seeded chaos run (tier-1) -------------------------------
+
+
+class ChaosFakeClient(FakeClient):
+    """FakeClient that exercises the real frontend failpoint seam: it IS
+    the transport, so it evaluates `core_client.recv` itself — drop
+    models a frame lost in transit (the token arrives on a later poll,
+    since the scripted engine state is not advanced)."""
+
+    def get_output(self, timeout=None):
+        if failpoints.fail_point("core_client.recv") == "drop":
+            return EngineCoreOutputs()
+        return super().get_output(timeout)
+
+
+def test_inprocess_seeded_chaos_invariants_hold():
+    """A seeded schedule (frontend recv faults) over a scripted mid-run
+    engine crash: every request must finish exactly once, the journal
+    must drain, admission must balance — and the report must say so."""
+    client = ChaosFakeClient(crash_after=6)
+    llm = make_engine(client, max_request_retries=2)
+    plan = make_plan(
+        42, duration_s=0.6, num_engines=1, engine_kills=0,
+        failpoint_specs=[
+            "core_client.recv=10*off;5*drop;3*delay(0.01)"])
+    try:
+        report = asyncio.run(run_chaos(
+            llm, plan, num_requests=10, max_tokens=6, concurrency=4,
+            request_timeout_s=60.0))
+    finally:
+        llm.shutdown()
+    assert report.ok, report.ledger.violations
+    s = report.ledger.summary()
+    assert s["admitted"] == 10
+    assert s["outcomes"] == {OUTCOME_FINISHED: 10}
+    # The scripted crash really happened and was replayed.
+    assert client.restarts == 1
+    assert llm.journal.requests_replayed_total >= 1
+    # The harness disarms its failpoints on the way out.
+    assert not failpoints.is_active()
+    d = report.to_dict()
+    assert d["ok"] and d["seed"] == 42
+
+
+def test_inprocess_chaos_is_reproducible():
+    """Same seed, same scripted client -> identical outcome summary."""
+
+    def run(seed):
+        client = ChaosFakeClient(crash_after=4)
+        llm = make_engine(client, max_request_retries=2)
+        plan = make_plan(
+            seed, duration_s=0.4, num_engines=1, engine_kills=0,
+            failpoint_specs=["core_client.recv=4*off;2*drop"])
+        try:
+            report = asyncio.run(run_chaos(
+                llm, plan, num_requests=6, max_tokens=4, concurrency=3,
+                request_timeout_s=60.0))
+        finally:
+            llm.shutdown()
+        assert report.ok, report.ledger.violations
+        return report.ledger.summary()
+
+    assert run(1234) == run(1234)
+
+
+# -- multi-process DP acceptance scenario (slow) ------------------------
+
+
+@pytest.mark.slow
+def test_dp_chaos_coordinator_kill_with_recv_faults():
+    from tests.models.utils import tiny_llama_dir
+    from vllm_tpu.engine.arg_utils import AsyncEngineArgs
+    from vllm_tpu.engine.async_llm import AsyncLLM
+    from vllm_tpu.engine.core_client import DPLBClient
+    from vllm_tpu.metrics.prometheus import PrometheusRegistry
+
+    import tempfile
+
+    with tempfile.TemporaryDirectory() as td:
+        ckpt = tiny_llama_dir(__import__("pathlib").Path(td))
+        engine = AsyncLLM.from_engine_args(AsyncEngineArgs(
+            model=ckpt, dtype="float32", max_model_len=128, block_size=16,
+            num_gpu_blocks_override=64, max_num_seqs=4,
+            max_num_batched_tokens=128, data_parallel_engines=2,
+            enable_engine_recovery=True, max_engine_restarts=2,
+            max_request_retries=2,
+            # A 1 s first-respawn backoff makes the coordinator outage
+            # reliably outlast the 1.2 s staleness threshold, so the
+            # degraded-routing window is deterministically observable.
+            restart_backoff_s=1.0,
+            max_coordinator_restarts=5, coordinator_stale_after_s=1.2,
+        ))
+        client = engine.engine_core
+        assert isinstance(client, DPLBClient)
+
+        plan = make_plan(
+            7, duration_s=6.0, num_engines=2, engine_kills=0,
+            coordinator_kills=1,
+            failpoint_specs=["core_client.recv=8*off;4*drop;4*delay(0.05)"])
+
+        observed = {"degraded": False, "max_age": 0.0}
+
+        async def watch():
+            # Poll the status surface while faults land: the frontend
+            # must keep serving and must flag the degraded window.
+            end = time.monotonic() + plan.duration_s + 2.0
+            while time.monotonic() < end:
+                st = engine.resilience_status()["coordinator"]
+                observed["max_age"] = max(
+                    observed["max_age"], st["snapshot_age_s"])
+                if st["routing_degraded"]:
+                    observed["degraded"] = True
+                await asyncio.sleep(0.05)
+
+        async def run():
+            watcher = asyncio.create_task(watch())
+            report = await run_chaos(
+                engine, plan, num_requests=12, max_tokens=8,
+                concurrency=4, request_timeout_s=120.0)
+            await watcher
+            return report
+
+        try:
+            report = asyncio.run(asyncio.wait_for(run(), timeout=300))
+            assert report.ok, report.ledger.violations
+            s = report.ledger.summary()
+            assert s["admitted"] == 12
+            assert s["outcomes"] == {OUTCOME_FINISHED: 12}
+            # The coordinator kill was delivered and recovered from.
+            assert any("kill_coordinator" in a for a in report.applied)
+            coord = engine.resilience_status()["coordinator"]
+            assert coord["restarts"] >= 1
+            assert coord["up"] is True
+            # The outage was visible: the snapshot aged past the
+            # threshold and routing flipped to round-robin meanwhile.
+            assert observed["max_age"] > 1.2
+            assert observed["degraded"] is True
+            # ... and the counter is on /metrics under its wire name.
+            text = PrometheusRegistry(engine).render()
+            assert "vllm:coordinator_restarts_total" in text
+            assert any(
+                line.startswith("vllm:coordinator_restarts_total ")
+                and float(line.split()[1]) >= 1
+                for line in text.splitlines())
+        finally:
+            engine.shutdown()

@@ -1,0 +1,243 @@
+"""DPLBClient coordinator-failover unit tests: supervised respawn with
+backoff + budget, stale-snapshot round-robin routing, and the
+coordinator_status surface. No processes, no ZMQ — the client is
+constructed bare (``__new__``) over fake sockets/procs, the same idiom as
+test_recovery_unit's FakeClient."""
+
+from __future__ import annotations
+
+import time
+
+from vllm_tpu.engine import core_proc, serial_utils
+from vllm_tpu.engine.core_client import DPLBClient
+from vllm_tpu.request import EngineCoreRequest
+from vllm_tpu.resilience import EngineSupervisor, ResilienceConfig
+from vllm_tpu.resilience.supervisor import COORDINATOR_ID
+from vllm_tpu.sampling_params import SamplingParams
+
+
+class _FakeSock:
+    def __init__(self):
+        self.sent = []
+
+    def poll(self, *a):
+        return 0
+
+    def send(self, *a, **k):
+        pass
+
+    def send_multipart(self, frames):
+        self.sent.append(frames)
+
+
+class _FakeProc:
+    def __init__(self, alive=True):
+        self.alive = alive
+        self.pid = 12345
+        self.exitcode = None if alive else -9
+
+    def is_alive(self):
+        return self.alive
+
+
+def make_client(num_engines=2, **resilience_kw) -> DPLBClient:
+    c = DPLBClient.__new__(DPLBClient)
+    c._serial = serial_utils
+    c._proc_mod = core_proc
+    c._resilience = ResilienceConfig(
+        restart_backoff_s=0.01, **resilience_kw).finalize()
+    c._supervisor = EngineSupervisor(c._resilience, num_engines)
+    c._started = True
+    c._dead = False
+    c._closing = False
+    c._num_engines = num_engines
+    c._procs = [_FakeProc() for _ in range(num_engines)]
+    c._inputs = [_FakeSock() for _ in range(num_engines)]
+    c._sub = _FakeSock()
+    c._report = _FakeSock()
+    c._coord = _FakeProc()
+    c._coord_respawn_at = None
+    c._coord_gave_up = False
+    c._coord_epoch = None
+    c._snapshot_t = time.monotonic()
+    c._routing_degraded = False
+    c._rr = 0
+    c._live = {}
+    c._engine_inflight = [0] * num_engines
+    c._coord_loads = [0] * num_engines
+    c._report_unsent = None
+    c._pending = []
+    c._engine_up = [True] * num_engines
+    c._last_progress = time.monotonic()
+    return c
+
+
+def _req(rid):
+    return EngineCoreRequest(
+        request_id=rid, prompt_token_ids=[1, 2, 3],
+        sampling_params=SamplingParams(max_tokens=4))
+
+
+def _routed(client):
+    """Engine each ADD frame went to, from the fake input sockets."""
+    return [
+        eid for rid, eid in client._live.items()
+    ]
+
+
+# -- routing policy -----------------------------------------------------
+
+
+def test_fresh_snapshot_routes_least_loaded():
+    c = make_client()
+    c._engine_inflight = [5, 0]
+    for i in range(3):
+        c.add_request(_req(f"r{i}"))
+    # All three land on the (initially) less-loaded engine 1.
+    assert [c._live[f"r{i}"] for i in range(3)] == [1, 1, 1]
+    assert c._routing_degraded is False
+
+
+def test_stale_snapshot_falls_back_to_round_robin():
+    c = make_client()
+    c._engine_inflight = [5, 0]  # least-loaded would pick 1 every time
+    c._snapshot_t = time.monotonic() - 60.0
+    for i in range(4):
+        c.add_request(_req(f"r{i}"))
+    assert c._routing_degraded is True
+    # Uniform spread, ignoring the (untrusted) load imbalance.
+    assert [c._live[f"r{i}"] for i in range(4)] == [0, 1, 0, 1]
+
+
+def test_routing_recovers_when_snapshot_freshens():
+    c = make_client()
+    c._snapshot_t = time.monotonic() - 60.0
+    c.add_request(_req("stale"))
+    assert c._routing_degraded is True
+    c._snapshot_t = time.monotonic()
+    c._engine_inflight = [5, 1]
+    c.add_request(_req("fresh"))
+    assert c._routing_degraded is False
+    assert c._live["fresh"] == 1
+
+
+def test_round_robin_skips_down_ranks():
+    c = make_client(num_engines=3)
+    c._snapshot_t = time.monotonic() - 60.0
+    c._engine_up = [True, False, True]
+    for i in range(4):
+        c.add_request(_req(f"r{i}"))
+    assert [c._live[f"r{i}"] for i in range(4)] == [0, 2, 0, 2]
+
+
+# -- coordinator supervision -------------------------------------------
+
+
+def test_coordinator_respawn_with_backoff_and_budget():
+    c = make_client(max_coordinator_restarts=2)
+    c._coord = _FakeProc(alive=False)
+    spawned = []
+
+    def fake_spawn():
+        p = _FakeProc()
+        spawned.append(p)
+        return p
+
+    c._spawn_coordinator = fake_spawn
+    # First check: death observed, respawn scheduled (not yet executed).
+    c._check_coordinator()
+    assert spawned == []
+    assert c._supervisor.restarts(COORDINATOR_ID) == 1
+    assert c._coord_respawn_at is not None
+    # After the backoff elapses the respawn happens and re-seeds the
+    # client-inflight report for the fresh coordinator.
+    c._live = {"r1": 0}
+    time.sleep(0.02)
+    c._check_coordinator()
+    assert len(spawned) == 1
+    assert c._coord is spawned[0]
+    assert c._report_unsent == 1
+
+
+def test_coordinator_budget_exhaustion_stops_respawns():
+    c = make_client(max_coordinator_restarts=1)
+    c._coord = _FakeProc(alive=False)
+    spawned = []
+    c._spawn_coordinator = lambda: spawned.append(1) or _FakeProc(False)
+    c._check_coordinator()          # consume the only budget unit
+    time.sleep(0.02)
+    c._check_coordinator()          # respawn (dies immediately)
+    assert len(spawned) == 1
+    c._check_coordinator()          # budget gone: give up, keep serving
+    c._check_coordinator()
+    assert len(spawned) == 1
+    assert c._coord_gave_up is True
+    assert c.coordinator_status()["up"] is False
+    # Data-plane readiness is untouched by coordinator death.
+    assert c._supervisor.all_up()
+    c.add_request(_req("still-serving"))
+    assert "still-serving" in c._live
+
+
+def test_closing_latch_halts_coordinator_respawn():
+    c = make_client()
+    c._coord = _FakeProc(alive=False)
+    c._spawn_coordinator = lambda: (_ for _ in ()).throw(
+        AssertionError("respawned during drain"))
+    c.suspend_recovery()
+    c._check_coordinator()
+    assert c._supervisor.restarts(COORDINATOR_ID) == 0
+
+
+def test_engine_death_never_consumes_coordinator_budget():
+    c = make_client(max_coordinator_restarts=3)
+    c._supervisor.record_failure(0)
+    c._supervisor.record_failure(0)
+    assert c._supervisor.may_restart_coordinator()
+    assert c._supervisor.restarts(COORDINATOR_ID) == 0
+
+
+# -- status surfaces ----------------------------------------------------
+
+
+def test_coordinator_status_shape():
+    c = make_client()
+    st = c.coordinator_status()
+    assert st["up"] is True
+    assert st["restarts"] == 0
+    assert st["snapshot_age_s"] >= 0.0
+    assert st["routing_degraded"] is False
+    # The coordinator never appears in the per-engine status map.
+    assert set(c.engine_status()) == {"0", "1"}
+
+
+def test_epoch_change_reseeds_client_inflight_report():
+    import zmq  # noqa: F401  (serial roundtrip only, no sockets)
+
+    class _SubWithSnapshot(_FakeSock):
+        def __init__(self, payloads):
+            super().__init__()
+            self.payloads = list(payloads)
+
+        def poll(self, *a):
+            return 1 if self.payloads else 0
+
+        def recv_multipart(self):
+            return [b"dp", self.payloads.pop(0)]
+
+    def snap(epoch):
+        return serial_utils.encode({
+            "loads": {"0": [0, 0], "1": [0, 0]},
+            "wave": 0, "global_unfinished": False, "epoch": epoch,
+        })
+
+    c = make_client()
+    c._live = {"r1": 0, "r2": 1}
+    c._sub = _SubWithSnapshot([snap("e1")])
+    c._drain_loads()
+    assert c._coord_epoch == "e1"
+    assert c._report_unsent is None  # first epoch: nothing to re-seed
+    c._sub = _SubWithSnapshot([snap("e2")])
+    c._drain_loads()
+    assert c._coord_epoch == "e2"
+    assert c._report_unsent == 2  # fresh incarnation: re-report inflight

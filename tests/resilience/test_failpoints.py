@@ -1,0 +1,193 @@
+"""Failpoint framework unit tests: spec grammar, triggers (nth-hit,
+seeded probability, once), cross-run determinism, and the zero-overhead
+contract of disabled sites."""
+
+from __future__ import annotations
+
+import pytest
+
+from vllm_tpu.resilience import failpoints as fp
+
+
+@pytest.fixture(autouse=True)
+def _disarm():
+    fp.deactivate()
+    yield
+    fp.deactivate()
+
+
+# -- grammar ------------------------------------------------------------
+
+
+def test_parse_single_site_single_term():
+    sites = fp.parse_spec("core_client.recv=raise")
+    assert list(sites) == ["core_client.recv"]
+    (term,) = sites["core_client.recv"]
+    assert term.action == "raise"
+    assert term.count is None and term.prob is None and term.arg is None
+
+
+def test_parse_full_grammar():
+    sites = fp.parse_spec(
+        "a.b=3*delay(0.5);once*50%raise(OSError);drop, c.d=2*off;exit(3)"
+    )
+    a, c = sites["a.b"], sites["c.d"]
+    assert [(t.action, t.count, t.prob, t.arg) for t in a] == [
+        ("delay", 3, None, "0.5"),
+        ("raise", 1, 0.5, "OSError"),
+        ("drop", None, None, None),
+    ]
+    assert [(t.action, t.count, t.arg) for t in c] == [
+        ("off", 2, None), ("exit", None, "3"),
+    ]
+
+
+@pytest.mark.parametrize("bad", [
+    "no_equals_sign",
+    "site=notanaction",
+    "site=2*",
+    "site=raise(KeyboardInterrupt)",  # not whitelisted
+    "site=",
+    "site=;;",
+])
+def test_parse_rejects_malformed(bad):
+    with pytest.raises(ValueError):
+        fp.parse_spec(bad)
+
+
+# -- triggers -----------------------------------------------------------
+
+
+def test_nth_hit_via_counted_off():
+    """`3*off;1*raise` = fire on exactly the 4th hit."""
+    fp.configure("s=3*off;1*raise")
+    for _ in range(3):
+        assert fp.fail_point("s") is None
+    with pytest.raises(fp.FailpointError, match="hit #4"):
+        fp.fail_point("s")
+    # Term list exhausted: further hits are inert.
+    assert fp.fail_point("s") is None
+    assert fp.snapshot()["s"] == {"hits": 5, "fires": 1}
+
+
+def test_once_alias_and_drop():
+    fp.configure("s=once*drop")
+    assert fp.fail_point("s") == "drop"
+    assert fp.fail_point("s") is None
+
+
+def test_terminal_term_governs_every_remaining_hit():
+    fp.configure("s=drop")
+    assert all(fp.fail_point("s") == "drop" for _ in range(10))
+
+
+def test_raise_whitelisted_exception_type():
+    fp.configure("s=raise(OSError)")
+    with pytest.raises(OSError):
+        fp.fail_point("s")
+
+
+def test_raise_includes_lazy_context():
+    fp.configure("s=raise")
+    with pytest.raises(fp.FailpointError, match=r"\[req=abc\]"):
+        fp.fail_point("s", lambda: "req=abc")
+
+
+def test_unknown_site_is_inert_while_active():
+    fp.configure("s=raise")
+    assert fp.fail_point("other.site") is None
+
+
+# -- seeded determinism -------------------------------------------------
+
+
+def _prob_schedule(seed: int, n: int = 64) -> list[bool]:
+    fp.configure("s=50%drop", seed=seed)
+    fired = [fp.fail_point("s") == "drop" for _ in range(n)]
+    fp.deactivate()
+    return fired
+
+
+def test_same_seed_same_schedule():
+    assert _prob_schedule(1234) == _prob_schedule(1234)
+
+
+def test_different_seed_different_schedule():
+    a, b = _prob_schedule(1), _prob_schedule(2)
+    assert a != b
+    # Sanity: probability actually gates (neither all-fire nor no-fire).
+    assert 0 < sum(a) < len(a)
+
+
+def test_schedule_independent_of_other_sites():
+    """A site's fire schedule depends only on (seed, site, hit number),
+    never on how OTHER sites interleave with it."""
+    fp.configure("s=50%drop,t=50%drop", seed=9)
+    alone = [fp.fail_point("s") == "drop" for _ in range(32)]
+    fp.configure("s=50%drop,t=50%drop", seed=9)
+    interleaved = []
+    for _ in range(32):
+        fp.fail_point("t")
+        interleaved.append(fp.fail_point("s") == "drop")
+        fp.fail_point("t")
+    assert alone == interleaved
+
+
+def test_counted_probability_composes():
+    """`2*100%drop;off` fires on exactly the first two governed hits."""
+    fp.configure("s=2*100%drop;off", seed=0)
+    assert fp.fail_point("s") == "drop"
+    assert fp.fail_point("s") == "drop"
+    assert fp.fail_point("s") is None
+
+
+# -- zero-overhead contract --------------------------------------------
+
+
+def test_disabled_site_never_evaluates_ctx():
+    def boom():
+        raise AssertionError("ctx evaluated on the disabled path")
+
+    assert not fp.is_active()
+    assert fp.fail_point("s", boom) is None
+    # Active, but the site doesn't raise: ctx still untouched (it is
+    # only for raise-time detail).
+    fp.configure("s=drop")
+    assert fp.fail_point("s", boom) == "drop"
+
+
+def test_deactivate_restores_fast_path():
+    fp.configure("s=raise")
+    fp.deactivate()
+    assert fp.fail_point("s") is None
+    assert fp.snapshot() == {}
+
+
+# -- env inheritance ----------------------------------------------------
+
+
+def test_env_arming_reaches_spawned_process(tmp_path):
+    """One env var arms the whole process tree: a spawned interpreter
+    importing the module starts with the sites armed."""
+    import subprocess
+    import sys
+
+    code = (
+        "from vllm_tpu.resilience import failpoints as fp\n"
+        "assert fp.is_active()\n"
+        "assert fp.fail_point('s') == 'drop'\n"
+        "print('armed')\n"
+    )
+    import os
+
+    repo_root = os.path.dirname(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    env = dict(os.environ, VLLM_TPU_FAILPOINTS="s=drop",
+               JAX_PLATFORMS="cpu",
+               PYTHONPATH=repo_root)
+    out = subprocess.run(
+        [sys.executable, "-c", code], env=env, cwd=str(tmp_path),
+        capture_output=True, text=True, timeout=60,
+    )
+    assert out.returncode == 0, out.stderr
+    assert "armed" in out.stdout

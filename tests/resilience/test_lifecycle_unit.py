@@ -252,14 +252,23 @@ def test_journal_restart_reports_lost_requests(tmp_path):
     assert RequestJournal(persist_dir=d).requests_lost_on_restart_total == 0
 
 
-def test_journal_restart_tolerates_garbage(tmp_path):
+def test_journal_restart_counts_garbage_as_lost(tmp_path):
+    """A corrupt snapshot is STILL a lost request (a torn write means the
+    frontend died mid-persist): it is reported, not silently skipped.
+    Files that are not snapshots at all are left alone."""
     d = tmp_path / "journal"
     d.mkdir()
     (d / "garbage.json").write_text("{not json")
     (d / "ignored.txt").write_text("not a snapshot")
     j = RequestJournal(persist_dir=str(d))
-    assert j.requests_lost_on_restart_total == 0
+    assert j.requests_lost_on_restart_total == 1
+    (entry,) = j.lost_on_restart
+    assert entry["corrupt"] is True
+    assert entry["request_id"] is None  # nothing salvageable
     assert not (d / "garbage.json").exists()  # cleared, not re-reported
+    assert (d / "ignored.txt").exists()
+    # Third restart reports nothing (the scan cleared the file).
+    assert RequestJournal(persist_dir=str(d)).requests_lost_on_restart_total == 0
 
 
 def test_journal_unsafe_request_ids(tmp_path):
@@ -271,3 +280,62 @@ def test_journal_unsafe_request_ids(tmp_path):
     assert len(names) == 1 and names[0].endswith(".json")
     j2 = RequestJournal(persist_dir=d)
     assert j2.lost_on_restart[0]["request_id"] == rid
+
+
+def test_journal_torn_write_via_failpoint(tmp_path):
+    """The `journal.write` failpoint's drop action produces a real torn
+    write (half the serialized bytes at the final path, no atomic
+    replace). On restart the valid prefix of the directory parses
+    normally and the torn snapshot is reported as lost with its
+    request_id salvaged from the partial JSON."""
+    from vllm_tpu.resilience import failpoints
+
+    d = str(tmp_path / "journal")
+    j1 = RequestJournal(persist_dir=d)
+    j1.record_admitted(_req("intact-1"))
+    failpoints.configure("journal.write=once*drop")
+    try:
+        j1.record_admitted(_req("torn-1"))
+    finally:
+        failpoints.deactivate()
+    # Both snapshots exist; the torn one is half-length.
+    assert len(os.listdir(d)) == 2
+    j2 = RequestJournal(persist_dir=d)
+    assert j2.requests_lost_on_restart_total == 2
+    by_id = {e["request_id"]: e for e in j2.lost_on_restart}
+    assert by_id["intact-1"].get("corrupt") is None
+    assert by_id["intact-1"]["num_prompt_tokens"] == 3
+    assert by_id["torn-1"]["corrupt"] is True  # salvaged from partial JSON
+
+
+def test_journal_write_failure_via_failpoint_keeps_serving(tmp_path):
+    """raise(OSError) at `journal.write` models a failed disk write: the
+    request keeps serving unjournaled-on-disk (logged), and the in-memory
+    entry is intact for crash replay."""
+    from vllm_tpu.resilience import failpoints
+
+    d = str(tmp_path / "journal")
+    j = RequestJournal(persist_dir=d)
+    failpoints.configure("journal.write=once*raise(OSError)")
+    try:
+        j.record_admitted(_req("unpersisted"))
+    finally:
+        failpoints.deactivate()
+    assert os.listdir(d) == []  # nothing hit the disk
+    assert j.get("unpersisted") is not None  # in-memory entry intact
+
+
+def test_journal_scan_picks_up_orphan_tmp_files(tmp_path):
+    """A crash between the tmp write and the atomic replace leaves a
+    .json.tmp orphan — still a lost request, still cleared."""
+    import json as _json
+
+    d = tmp_path / "journal"
+    d.mkdir()
+    (d / "abc.json.tmp").write_text(_json.dumps(
+        {"request_id": "orphan-1", "arrival_time": 1.0,
+         "num_prompt_tokens": 3, "max_tokens": 8}))
+    j = RequestJournal(persist_dir=str(d))
+    assert j.requests_lost_on_restart_total == 1
+    assert j.lost_on_restart[0]["request_id"] == "orphan-1"
+    assert not (d / "abc.json.tmp").exists()

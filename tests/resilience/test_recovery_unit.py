@@ -131,7 +131,10 @@ def make_engine(client, *, recovery=True, max_request_retries=1,
     llm._last_deadline_sweep = 0.0
     llm.engine_core = client
     llm.input_processor = FakeInputProcessor()
-    llm.output_processor = OutputProcessor(None, journal=llm.journal)
+    llm.output_processor = OutputProcessor(
+        None, journal=llm.journal,
+        on_request_closed=llm.admission.release,
+    )
     llm.stat_loggers = []
     llm._input_queue = queue.Queue()
     llm._loop = None

@@ -1,0 +1,129 @@
+#!/usr/bin/env python3
+"""Seeded chaos run against a real serving stack.
+
+Builds an AsyncLLM (crash recovery ON), expands ``--seed`` into a
+deterministic fault schedule (engine-core SIGKILLs, coordinator SIGKILLs,
+failpoint activations), streams a seeded workload through the engine
+while the faults land, then sweeps the global invariants:
+
+- every admitted request reaches exactly one terminal state;
+- admission slots/token reservations balance to zero after the drain;
+- no stream delivers an item after its final;
+- the journal is empty and its counters consistent.
+
+The same ``--seed`` always produces the same schedule — a failing run is
+a repro command, not an anecdote. Exit status 0 iff every invariant held.
+
+Examples:
+
+    # 2-way DP, one engine kill and one coordinator kill per run
+    python tools/chaos_run.py --model /path/to/ckpt --dp 2 \
+        --engine-kills 1 --coordinator-kills 1 --seed 7
+
+    # add a frontend transport fault schedule on top
+    python tools/chaos_run.py --model /path/to/ckpt --seed 7 \
+        --failpoints 'core_client.recv=5*25%delay(0.2)'
+
+Engine-core/coordinator *processes* inherit failpoints through the
+environment (export VLLM_TPU_FAILPOINTS before running this tool);
+``--failpoints`` arms the frontend process mid-run via the chaos plan.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import os
+import sys
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO_ROOT not in sys.path:
+    sys.path.insert(0, _REPO_ROOT)
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    p.add_argument("--model", required=True, help="model path or HF id")
+    p.add_argument("--seed", type=int, default=0,
+                   help="chaos seed (same seed = same schedule)")
+    p.add_argument("--duration", type=float, default=10.0,
+                   help="schedule window in seconds")
+    p.add_argument("--dp", type=int, default=1,
+                   help="data_parallel_engines")
+    p.add_argument("--engine-kills", type=int, default=1,
+                   help="engine-core SIGKILLs in the schedule")
+    p.add_argument("--coordinator-kills", type=int, default=0,
+                   help="coordinator SIGKILLs in the schedule (DP only)")
+    p.add_argument("--failpoints", action="append", default=[],
+                   metavar="SPEC",
+                   help="frontend failpoint spec to arm at a seeded time "
+                        "(repeatable); see vllm_tpu/resilience/failpoints")
+    p.add_argument("--requests", type=int, default=16)
+    p.add_argument("--max-tokens", type=int, default=8)
+    p.add_argument("--concurrency", type=int, default=4)
+    p.add_argument("--request-timeout", type=float, default=120.0,
+                   help="per-request hang verdict timeout (seconds)")
+    p.add_argument("--max-model-len", type=int, default=128)
+    p.add_argument("--json", action="store_true",
+                   help="emit the full report as JSON on stdout")
+    return p
+
+
+def main(argv: list[str] | None = None) -> int:
+    args = build_parser().parse_args(argv)
+
+    from vllm_tpu.engine.arg_utils import AsyncEngineArgs
+    from vllm_tpu.engine.async_llm import AsyncLLM
+    from vllm_tpu.resilience.chaos import make_plan, run_chaos
+
+    plan = make_plan(
+        args.seed,
+        duration_s=args.duration,
+        num_engines=args.dp,
+        engine_kills=args.engine_kills,
+        coordinator_kills=args.coordinator_kills if args.dp > 1 else 0,
+        failpoint_specs=args.failpoints,
+    )
+    print(f"chaos plan (seed {plan.seed}):", file=sys.stderr)
+    for ev in plan.events:
+        print(f"  {ev}", file=sys.stderr)
+
+    engine = AsyncLLM.from_engine_args(AsyncEngineArgs(
+        model=args.model,
+        max_model_len=args.max_model_len,
+        data_parallel_engines=args.dp,
+        enable_engine_recovery=True,
+        max_engine_restarts=max(4, 2 * args.engine_kills),
+        max_request_retries=2,
+        restart_backoff_s=0.05,
+    ))
+    try:
+        report = asyncio.run(run_chaos(
+            engine, plan,
+            num_requests=args.requests,
+            max_tokens=args.max_tokens,
+            concurrency=args.concurrency,
+            request_timeout_s=args.request_timeout,
+        ))
+    finally:
+        engine.shutdown()
+
+    if args.json:
+        print(json.dumps(report.to_dict(), indent=2))
+    else:
+        summary = report.ledger.summary()
+        print(f"applied: {report.applied}", file=sys.stderr)
+        print(
+            f"admitted={summary['admitted']} shed={summary['shed']} "
+            f"outcomes={summary['outcomes']} wall={report.wall_s:.1f}s")
+    for v in report.ledger.violations:
+        print(f"VIOLATION: {v}", file=sys.stderr)
+    print("ok" if report.ok else "FAILED", file=sys.stderr)
+    return 0 if report.ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

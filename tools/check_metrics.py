@@ -39,6 +39,16 @@ REQUIRED_LIFECYCLE_METRICS = {
     "vllm:requests_lost_on_restart_total",
 }
 
+# Documented in the README ("Fault injection & chaos testing");
+# dashboards for coordinator failover alert on these names.
+REQUIRED_CHAOS_METRICS = {
+    "vllm:coordinator_up",
+    "vllm:coordinator_restarts_total",
+    "vllm:dp_snapshot_age_seconds",
+    "vllm:dp_routing_degraded",
+    "vllm:failpoints_fired_total",
+}
+
 
 def check() -> list[str]:
     """Return a list of lint errors (empty = clean)."""
@@ -93,6 +103,10 @@ def check() -> list[str]:
         errors.append(
             f"required lifecycle metric {name} is missing from the "
             f"registry (documented in README)")
+    for name in sorted(REQUIRED_CHAOS_METRICS - set(seen)):
+        errors.append(
+            f"required coordinator/chaos metric {name} is missing from "
+            f"the registry (documented in README)")
 
     return errors
 

@@ -91,6 +91,8 @@ class EngineArgs:
     max_request_retries: int = 1
     restart_backoff_s: float = 0.5
     heartbeat_timeout_s: float = 0.0
+    max_coordinator_restarts: int = 10
+    coordinator_stale_after_s: float = 5.0
     journal_dir: str | None = None
 
     # Lifecycle (vllm_tpu/resilience/lifecycle): overload protection.
@@ -194,6 +196,8 @@ class EngineArgs:
                 max_request_retries=self.max_request_retries,
                 restart_backoff_s=self.restart_backoff_s,
                 heartbeat_timeout_s=self.heartbeat_timeout_s,
+                max_coordinator_restarts=self.max_coordinator_restarts,
+                coordinator_stale_after_s=self.coordinator_stale_after_s,
                 journal_dir=self.journal_dir,
             ),
             lifecycle_config=LifecycleConfig(

@@ -583,10 +583,17 @@ class AsyncLLM:
             client.engine_status()
             if hasattr(client, "engine_status") else {}
         )
+        # DP deployments expose the coordinator as a separate
+        # control-plane entry (never folded into engine readiness).
+        coordinator = (
+            client.coordinator_status()
+            if hasattr(client, "coordinator_status") else None
+        )
         return {
             "engine_dead": self._dead,
             "recovery_enabled": self.resilience.enable_recovery,
             "engines": engines,
+            "coordinator": coordinator,
             "requests_replayed_total": (
                 self.journal.requests_replayed_total
                 if self.journal is not None else 0

@@ -41,27 +41,54 @@ import time
 TOPIC = b"dp"
 
 
+def _unlink_ipc_sockets(addrs: tuple[str, ...]) -> None:
+    import os
+
+    for addr in addrs:
+        if addr.startswith("ipc://"):
+            try:
+                os.unlink(addr[len("ipc://"):])
+            except OSError:
+                pass
+
+
 def run_coordinator(report_addr: str, pub_addr: str,
                     num_engines: int) -> None:
     """Process entry point (spawn target)."""
+    import atexit
+    import os
+    import signal
+    import sys
+
     import zmq
 
     from vllm_tpu.engine import serial_utils
     from vllm_tpu.logger import init_logger
+    from vllm_tpu.resilience.failpoints import fail_point
 
     logger = init_logger("vllm_tpu.engine.coordinator")
 
     # A predecessor killed uncleanly (OOM/SIGKILL) leaves its ipc socket
     # files behind, and bind() on them raises EADDRINUSE — which would
     # turn the client's respawn loop into instantly-dying processes.
-    import os
+    _unlink_ipc_sockets((report_addr, pub_addr))
 
-    for addr in (report_addr, pub_addr):
-        if addr.startswith("ipc://"):
-            try:
-                os.unlink(addr[len("ipc://"):])
-            except FileNotFoundError:
-                pass
+    # Shutdown hygiene: remove OUR socket files on every clean exit, not
+    # only on successor-bind — atexit covers sys.exit paths, and a
+    # SIGTERM handler turns the client's terminate() into a clean exit
+    # (the default SIGTERM disposition would skip finally/atexit).
+    atexit.register(_unlink_ipc_sockets, (report_addr, pub_addr))
+    try:
+        signal.signal(signal.SIGTERM, lambda *_: sys.exit(0))
+    except ValueError:
+        pass  # non-main thread (in-process tests drive run_coordinator)
+
+    # Incarnation epoch, carried in every published snapshot: subscribers
+    # (engines, the frontend client) that observe an epoch change know a
+    # fresh coordinator lost all load state and re-report theirs — a
+    # steady-load engine would otherwise never re-send (reports are
+    # change-driven) and the new coordinator would route/wave on zeros.
+    epoch = f"{os.getpid()}"
 
     ctx = zmq.Context(1)
     report = ctx.socket(zmq.PULL)
@@ -81,12 +108,15 @@ def run_coordinator(report_addr: str, pub_addr: str,
     last_pub = 0.0
 
     def publish() -> None:
+        if fail_point("coordinator.publish") == "drop":
+            return
         pub.send_multipart([
             TOPIC,
             serial_utils.encode({
                 "loads": {str(k): list(v) for k, v in loads.items()},
                 "wave": wave,
                 "global_unfinished": global_unfinished,
+                "epoch": epoch,
             }),
         ])
 

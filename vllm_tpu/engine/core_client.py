@@ -36,6 +36,8 @@ from vllm_tpu.core.sched_output import EngineCoreOutputs
 from vllm_tpu.logger import init_logger
 from vllm_tpu.request import EngineCoreRequest
 from vllm_tpu.resilience import EngineRestartedError, EngineSupervisor
+from vllm_tpu.resilience.failpoints import fail_point
+from vllm_tpu.resilience.supervisor import COORDINATOR_ID
 from vllm_tpu.tracing import trace_instant
 
 logger = init_logger(__name__)
@@ -166,6 +168,10 @@ class _ZMQClientBase:
 
     def _recv(self, timeout_ms: int) -> list[bytes] | None:
         """One message, honoring death of any engine process."""
+        # drop = pretend the poll timed out (frame lost in transit);
+        # delay/raise model a slow or failing transport.
+        if fail_point("core_client.recv") == "drop":
+            return None
         deadline = timeout_ms
         step = 200
         while True:
@@ -616,9 +622,14 @@ class MPClient(_ZMQClientBase):
         trace_instant(
             "request_send", req_id=req.request_id, trace_id=req.trace_id,
         )
-        self._input.send_multipart(
-            [self._proc_mod.MSG_ADD, self._serial.encode(req)]
-        )
+        # drop = the ADD frame is lost in transit: the request stays live
+        # client-side but never reaches the engine (a hang the deadline /
+        # heartbeat machinery must catch).
+        if fail_point("core_client.send",
+                      lambda: f"req={req.request_id}") != "drop":
+            self._input.send_multipart(
+                [self._proc_mod.MSG_ADD, self._serial.encode(req)]
+            )
         self._live.add(req.request_id)
 
     def abort_requests(self, request_ids: list[str]) -> None:
@@ -717,16 +728,23 @@ class DPLBClient(_ZMQClientBase):
         # without ever meaningfully stalling routing.
         self._report.setsockopt(zmq.SNDTIMEO, 50)
 
-        self._mp_ctx = mp_ctx = multiprocessing.get_context("spawn")
+        self._mp_ctx = multiprocessing.get_context("spawn")
         self._coord_args = (report_addr, pub_addr, n)
-        self._coord = mp_ctx.Process(
-            target=coordinator.run_coordinator,
-            args=self._coord_args,
-            name="vllm-tpu-dp-coordinator",
-            daemon=True,
-        )
-        self._coord.start()
-        self._coord_respawns = 0
+        self._coord = self._spawn_coordinator()
+        # Coordinator failover state: supervised under COORDINATOR_ID
+        # (restart budget = max_coordinator_restarts, exponential
+        # backoff), respawn timing is NON-blocking — `_coord_respawn_at`
+        # holds the earliest next attempt so the busy loop never sleeps.
+        self._coord_respawn_at: float | None = None
+        self._coord_gave_up = False
+        self._coord_epoch: str | None = None
+        # Freshness of the last coordinator snapshot; routing degrades to
+        # round-robin past coordinator_stale_after_s. Seeded to "fresh at
+        # construction" — the first publish lands within the 1 Hz
+        # heartbeat.
+        self._snapshot_t = time.monotonic()
+        self._routing_degraded = False
+        self._rr = 0  # round-robin cursor for the degraded path
 
         # Each engine is a full single-engine config: the per-engine mesh
         # (tp/ep/...) stays as configured; DP fan-out happens here. On a
@@ -821,6 +839,18 @@ class DPLBClient(_ZMQClientBase):
             "%d DP engine cores up (KV blocks per engine: %s)", n, blocks
         )
 
+    def _spawn_coordinator(self):
+        from vllm_tpu.engine import coordinator
+
+        proc = self._mp_ctx.Process(
+            target=coordinator.run_coordinator,
+            args=self._coord_args,
+            name="vllm-tpu-dp-coordinator",
+            daemon=True,
+        )
+        proc.start()
+        return proc
+
     def _spawn_dp_engine(self, eid: int, input_addr: str):
         proc = self._mp_ctx.Process(
             target=self._proc_mod.run_engine_core,
@@ -903,35 +933,88 @@ class DPLBClient(_ZMQClientBase):
     # ------------------------------------------------------------------
 
     def _drain_loads(self) -> None:
-        """Record coordinator snapshots (wave state / observability)."""
+        """Record coordinator snapshots (wave state / observability) and
+        track their freshness + the coordinator's incarnation epoch."""
         while self._sub.poll(0):
             frames = self._sub.recv_multipart()
             state = self._serial.decode(frames[1])
             for eid_s, (w, r) in state["loads"].items():
                 self._coord_loads[int(eid_s)] = w + r
+            self._snapshot_t = time.monotonic()
+            self._supervisor.record_ready(COORDINATOR_ID)
+            epoch = state.get("epoch")
+            if epoch != self._coord_epoch:
+                if self._coord_epoch is not None:
+                    # A coordinator we did not respawn ourselves (or one
+                    # whose READY beat our liveness check) came up fresh:
+                    # re-seed its view of the client's in-flight count.
+                    self._report_unsent = len(self._live)
+                self._coord_epoch = epoch
 
     def _check_coordinator(self) -> None:
-        """The coordinator is supervision, not the data path: if it dies,
-        respawn it (a dead coordinator would otherwise silently freeze the
-        wave state and leave lockstep ranks dummy-stepping forever)."""
-        if self._coord.is_alive() or self._closing:
+        """Coordinator failover. The coordinator is supervision, not the
+        data path: if it dies, respawn it (a dead coordinator would
+        otherwise silently freeze the wave state and leave lockstep ranks
+        dummy-stepping forever) — under the supervisor's backoff schedule
+        and max_coordinator_restarts budget, never blocking the busy loop
+        (the next attempt time is latched in ``_coord_respawn_at``). Past
+        the budget the client stops respawning and keeps serving on the
+        stale-snapshot degraded path (round-robin routing)."""
+        if self._closing or self._coord_gave_up:
             return
-        self._coord_respawns += 1
-        logger.warning(
-            "DP coordinator died (exit %s); respawning (#%d)",
-            self._coord.exitcode, self._coord_respawns,
-        )
-        from vllm_tpu.engine import coordinator
-
-        self._coord = self._mp_ctx.Process(
-            target=coordinator.run_coordinator,
-            args=self._coord_args,
-            name="vllm-tpu-dp-coordinator",
-            daemon=True,
-        )
-        self._coord.start()
-        # Re-seed the fresh coordinator's client view.
+        if self._coord.is_alive():
+            return
+        now = time.monotonic()
+        if self._coord_respawn_at is None:
+            # First observation of this death: consume budget, schedule.
+            if not self._supervisor.may_restart_coordinator():
+                self._coord_gave_up = True
+                logger.error(
+                    "DP coordinator died (exit %s) and exhausted its "
+                    "%d-restart budget; serving degraded (round-robin "
+                    "routing, no wave lockstep)",
+                    self._coord.exitcode,
+                    self._resilience.max_coordinator_restarts,
+                )
+                return
+            n = self._supervisor.record_failure(COORDINATOR_ID)
+            backoff = self._supervisor.backoff_s(COORDINATOR_ID)
+            self._coord_respawn_at = now + backoff
+            logger.warning(
+                "DP coordinator died (exit %s); respawn %d/%d in %.1fs",
+                self._coord.exitcode, n,
+                self._resilience.max_coordinator_restarts, backoff,
+            )
+        if now < self._coord_respawn_at:
+            return
+        self._coord_respawn_at = None
+        self._coord = self._spawn_coordinator()
+        # Re-seed the fresh coordinator's client view; engines re-report
+        # on their own when they observe the new incarnation's epoch.
         self._report_unsent = len(self._live)
+        logger.info(
+            "DP coordinator respawned (pid %s, restart %d)",
+            self._coord.pid, self._supervisor.restarts(COORDINATOR_ID),
+        )
+
+    def _snapshot_stale(self) -> bool:
+        return (
+            time.monotonic() - self._snapshot_t
+            > self._resilience.coordinator_stale_after_s
+        )
+
+    def coordinator_status(self) -> dict:
+        """JSON-shaped snapshot for /health /metrics (control-plane view:
+        never part of data-plane readiness). routing_degraded is computed
+        live — "a request arriving now would be round-robin routed" —
+        not echoed from the last routing decision, so an outage is
+        visible even on an idle frontend."""
+        return {
+            "up": self._coord.is_alive(),
+            "restarts": self._supervisor.restarts(COORDINATOR_ID),
+            "snapshot_age_s": time.monotonic() - self._snapshot_t,
+            "routing_degraded": self._snapshot_stale(),
+        }
 
     def _report_inflight(self) -> None:
         """Tell the coordinator how many requests this client has live, so
@@ -943,9 +1026,11 @@ class DPLBClient(_ZMQClientBase):
         self._flush_report()
 
     def _flush_report(self) -> None:
+        # Liveness check runs unconditionally: coordinator death must be
+        # noticed (and the respawn scheduled) even with nothing to send.
+        self._check_coordinator()
         if self._report_unsent is None:
             return
-        self._check_coordinator()
         try:
             self._report.send(self._serial.encode(
                 {"client_inflight": self._report_unsent}
@@ -964,10 +1049,30 @@ class DPLBClient(_ZMQClientBase):
         candidates = [
             i for i in range(self._num_engines) if self._engine_up[i]
         ] or list(range(self._num_engines))
-        eid = min(
-            candidates,
-            key=lambda i: self._engine_inflight[i],
-        )
+        # Coordinator-snapshot freshness gates the routing policy: fresh
+        # -> least-loaded on the client-side exact counters; stale (the
+        # coordinator is gone or wedged past coordinator_stale_after_s)
+        # -> round-robin. The exact counters are client-local and stay
+        # correct without the coordinator, but a stale global view means
+        # engine-side conditions (wave state, a rank quietly wedged) are
+        # invisible — spreading uniformly is the conservative choice, and
+        # the flip doubles as the degraded-routing signal for /metrics.
+        stale = self._snapshot_stale()
+        if stale != self._routing_degraded:
+            self._routing_degraded = stale
+            logger.warning(
+                "coordinator snapshot %s; %s routing",
+                "stale" if stale else "fresh again",
+                "round-robin" if stale else "least-loaded",
+            )
+        if stale:
+            eid = candidates[self._rr % len(candidates)]
+            self._rr += 1
+        else:
+            eid = min(
+                candidates,
+                key=lambda i: self._engine_inflight[i],
+            )
         self._live[req.request_id] = eid
         self._engine_inflight[eid] += 1
         trace_instant(
@@ -975,9 +1080,11 @@ class DPLBClient(_ZMQClientBase):
             engine_id=eid,
         )
         self._report_inflight()  # before the add: wave opens first
-        self._inputs[eid].send_multipart(
-            [self._proc_mod.MSG_ADD, self._serial.encode(req)]
-        )
+        if fail_point("core_client.send",
+                      lambda: f"req={req.request_id}") != "drop":
+            self._inputs[eid].send_multipart(
+                [self._proc_mod.MSG_ADD, self._serial.encode(req)]
+            )
 
     def abort_requests(self, request_ids: list[str]) -> None:
         if self._dead or not request_ids:
@@ -1001,6 +1108,7 @@ class DPLBClient(_ZMQClientBase):
             self._report_inflight()
 
     def get_output(self, timeout: float | None = None) -> EngineCoreOutputs:
+        self._drain_loads()  # keep snapshot freshness current when idle
         self._flush_report()  # retry a dropped inflight report
         return super().get_output(timeout)
 

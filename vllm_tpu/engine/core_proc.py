@@ -61,6 +61,7 @@ def run_engine_core(config_bytes: bytes, input_addr: str,
     from vllm_tpu.engine import serial_utils
     from vllm_tpu.engine.engine_core import EngineCore
     from vllm_tpu.plugins import load_general_plugins
+    from vllm_tpu.resilience.failpoints import fail_point
 
     # Spawned interpreters don't inherit the frontend's plugin state:
     # out-of-tree registrations must happen where the model is built.
@@ -85,6 +86,7 @@ def run_engine_core(config_bytes: bytes, input_addr: str,
         coord_sub.setsockopt(zmq.SUBSCRIBE, TOPIC)
     last_load: tuple[int, int] | None = None
     global_unfinished = False
+    coord_epoch: str | None = None
 
     def report_load() -> None:
         nonlocal last_load
@@ -92,6 +94,9 @@ def run_engine_core(config_bytes: bytes, input_addr: str,
             return
         load = core.get_load()
         if load != last_load:
+            if fail_point("coordinator.report",
+                          lambda: f"engine={engine_id}") == "drop":
+                return  # last_load untouched -> retried next iteration
             coord_push.send(serial_utils.encode({
                 "engine_id": engine_id,
                 "waiting": load[0],
@@ -100,13 +105,22 @@ def run_engine_core(config_bytes: bytes, input_addr: str,
             last_load = load
 
     def drain_coordinator() -> None:
-        nonlocal global_unfinished
+        nonlocal global_unfinished, last_load, coord_epoch
         if coord_sub is None:
             return
         while coord_sub.poll(0):
             frames = coord_sub.recv_multipart()
             state = serial_utils.decode(frames[1])
             global_unfinished = bool(state["global_unfinished"])
+            epoch = state.get("epoch")
+            if epoch != coord_epoch:
+                # New coordinator incarnation: it booted with zeroed
+                # loads, and change-driven reporting would never resend
+                # a steady load. Forget last_load so the next
+                # report_load() re-reports unconditionally.
+                if coord_epoch is not None:
+                    last_load = None
+                coord_epoch = epoch
 
     core = None
     try:

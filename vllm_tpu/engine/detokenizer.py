@@ -8,6 +8,7 @@ accumulated tokens decode to a stable string.
 
 from __future__ import annotations
 
+from vllm_tpu.resilience.failpoints import fail_point
 from vllm_tpu.sampling_params import SamplingParams
 
 _REPLACEMENT = "�"
@@ -41,6 +42,8 @@ class IncrementalDetokenizer:
     def update(self, new_token_ids: list[int]) -> str | None:
         """Append tokens, grow output text. Returns the matched stop string
         if one fired (output_text is already truncated), else None."""
+        fail_point("detokenizer.update",
+                   lambda: f"n_tokens={len(new_token_ids)}")
         if self.tokenizer is None:
             self.token_ids.extend(new_token_ids)
             return None

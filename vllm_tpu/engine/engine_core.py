@@ -25,6 +25,7 @@ from vllm_tpu.tracing import (
     trace_span,
 )
 from vllm_tpu.request import EngineCoreRequest, Request, RequestStatus
+from vllm_tpu.resilience.failpoints import fail_point
 
 logger = init_logger(__name__)
 
@@ -205,6 +206,7 @@ class EngineCore:
             len(self._inflight) < self._max_inflight
             and self.scheduler.has_unfinished_requests()
         ):
+            fail_point("engine_core.step.schedule")
             t0 = time.monotonic()
             with trace_span("schedule"):
                 scheduler_output = self.scheduler.schedule()
@@ -237,6 +239,11 @@ class EngineCore:
                         self._req_trace_phase[nrd.req_id] = (
                             entry[0], "prefill"
                         )
+            fail_point(
+                "engine_core.step.dispatch",
+                lambda: f"tokens="
+                f"{scheduler_output.total_num_scheduled_tokens}",
+            )
             t0 = time.monotonic()
             with trace_span(
                 "dispatch",
@@ -254,6 +261,7 @@ class EngineCore:
             failed = self.scheduler.drain_failed()
             return failed if failed is not None else EngineCoreOutputs()
         scheduler_output, handle = self._inflight.popleft()
+        fail_point("engine_core.step.finalize")
         with trace_span("finalize"):
             t0 = time.monotonic()
             runner_output = self.executor.finalize(handle)

@@ -43,6 +43,12 @@ _readers: dict[str, Callable[[], Any]] = {
     # Engine
     "VLLM_TPU_ENABLE_MULTIPROCESSING": _bool("VLLM_TPU_ENABLE_MULTIPROCESSING", False),
     "VLLM_TPU_ENGINE_ITERATION_TIMEOUT_S": _int("VLLM_TPU_ENGINE_ITERATION_TIMEOUT_S", 60),
+    # Fault injection (vllm_tpu/resilience/failpoints). NOTE: the
+    # failpoints module reads these from os.environ directly at import so
+    # spawned engine/coordinator processes inherit arming through the
+    # environment; registered here for discoverability only.
+    "VLLM_TPU_FAILPOINTS": _str("VLLM_TPU_FAILPOINTS", None),
+    "VLLM_TPU_FAILPOINT_SEED": _int("VLLM_TPU_FAILPOINT_SEED", 0),
     # Compilation / runner
     "VLLM_TPU_DISABLE_PALLAS": _bool("VLLM_TPU_DISABLE_PALLAS", False),
     "VLLM_TPU_PALLAS_INTERPRET": _bool("VLLM_TPU_PALLAS_INTERPRET", False),

@@ -254,6 +254,27 @@ class PrometheusRegistry:
             "vllm:requests_lost_on_restart_total",
             "Requests found in the persisted journal after a frontend "
             "restart (lost in flight)")
+        # DP coordinator failover + fault injection (refreshed from the
+        # live engine snapshot at render time, same scheme as above).
+        self.coordinator_up = Gauge(
+            "vllm:coordinator_up",
+            "DP coordinator liveness (1 = running, 0 = down/respawning); "
+            "control-plane only, never part of data-plane readiness")
+        self.coordinator_restarts = Counter(
+            "vllm:coordinator_restarts_total",
+            "DP coordinator process respawns")
+        self.coordinator_snapshot_age = Gauge(
+            "vllm:dp_snapshot_age_seconds",
+            "Age of the newest coordinator load snapshot (heartbeats at "
+            "1 Hz; staleness flips routing to round-robin)")
+        self.routing_degraded = Gauge(
+            "vllm:dp_routing_degraded",
+            "1 while DP routing runs round-robin on a stale coordinator "
+            "snapshot, else 0")
+        self.failpoints_fired = LabeledCounter(
+            "vllm:failpoints_fired_total",
+            "Fault injections fired, by failpoint site "
+            "(nonzero only under VLLM_TPU_FAILPOINTS)", "site")
         # Lifecycle / overload protection (vllm_tpu/resilience/lifecycle):
         # refreshed from the engine's live snapshot at render time, same
         # scheme as the resilience metrics above.
@@ -291,6 +312,9 @@ class PrometheusRegistry:
             self.engine_up, self.engine_restarts,
             self.requests_replayed, self.requests_failed_on_crash,
             self.requests_lost_on_restart,
+            self.coordinator_up, self.coordinator_restarts,
+            self.coordinator_snapshot_age, self.routing_degraded,
+            self.failpoints_fired,
             self.requests_shed, self.request_timeouts,
             self.stream_outputs_dropped, self.slow_client_aborts,
             self.lifecycle_draining, self.inflight_prompt_tokens,
@@ -377,6 +401,23 @@ class PrometheusRegistry:
             float(status.get("requests_failed_on_crash_total", 0)))
         self.requests_lost_on_restart.inc_to(
             float(status.get("requests_lost_on_restart_total", 0)))
+        coord = status.get("coordinator")
+        if coord is not None:
+            self.coordinator_up.set(1.0 if coord.get("up") else 0.0)
+            self.coordinator_restarts.inc_to(
+                float(coord.get("restarts", 0)))
+            self.coordinator_snapshot_age.set(
+                float(coord.get("snapshot_age_s", 0.0)))
+            self.routing_degraded.set(
+                1.0 if coord.get("routing_degraded") else 0.0)
+
+    def _refresh_failpoints(self) -> None:
+        from vllm_tpu.resilience import failpoints
+
+        if not failpoints.is_active():
+            return
+        for site, counts in failpoints.snapshot().items():
+            self.failpoints_fired.inc_to(site, float(counts["fires"]))
 
     def _refresh_lifecycle(self) -> None:
         engine = self._engine
@@ -401,6 +442,7 @@ class PrometheusRegistry:
     def render(self) -> str:
         self._refresh_resilience()
         self._refresh_lifecycle()
+        self._refresh_failpoints()
         return "".join(m.render() for m in self._metrics)
 
 

@@ -34,6 +34,11 @@ Overload protection (request-lifecycle hardening) lives in
   accounting.
 - :class:`RequestShedError` / :class:`SlowClientError` — load-shed and
   slow-consumer-abort errors.
+
+Fault injection lives in :mod:`vllm_tpu.resilience.failpoints` (named
+failpoint sites compiled into the hot seams, armed via
+``VLLM_TPU_FAILPOINTS``) and :mod:`vllm_tpu.resilience.chaos` (seeded
+chaos schedules + global-invariant checking over a live engine).
 """
 
 from vllm_tpu.resilience.config import ResilienceConfig

@@ -1,0 +1,373 @@
+"""Seeded chaos harness: randomized-but-reproducible fault schedules
+driven against a live serving stack, with global-invariant checking.
+
+Crash-only design (Candea & Fox) says recovery code is only trustworthy
+if it is exercised as routinely as the happy path. PRs 1–3 built the
+recovery machinery (supervision, journaling & replay, lifecycle
+hardening, coordinator failover); this module exercises it with
+*machine-generated* faults instead of hand-written SIGKILLs:
+
+- :func:`make_plan` expands a seed into a deterministic schedule of
+  :class:`ChaosEvent`s — engine-core kills, coordinator kills, and
+  failpoint activations (:mod:`vllm_tpu.resilience.failpoints`);
+- :class:`ChaosDriver` applies the schedule to an ``AsyncLLM`` while a
+  seeded workload streams through it;
+- :class:`InvariantLedger` asserts the properties that must hold under
+  ANY schedule:
+
+  * every admitted request reaches **exactly one** terminal state
+    (a finished output, or exactly one terminal exception — never zero,
+    never two, never a silent hang);
+  * admission slots balance to zero once the workload drains
+    (``inflight_requests == 0``, ``inflight_prompt_tokens == 0``);
+  * no stream delivers a second item after its final;
+  * the journal is empty after recovery and its counters are consistent
+    with the ledger's view.
+
+The same seed always produces the same plan (``random.Random(seed)``
+only — no wall-clock or entropy inputs), so a failing schedule is a
+repro, not an anecdote. Used by ``tools/chaos_run.py`` (CLI, real
+engines) and ``tests/resilience/test_chaos.py`` (tier-1 in-process +
+multi-process scenarios).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import os
+import random
+import signal
+import time
+from dataclasses import dataclass, field
+from typing import Any
+
+from vllm_tpu.logger import init_logger
+from vllm_tpu.resilience import failpoints
+
+logger = init_logger(__name__)
+
+# Terminal outcomes a request stream can reach. Anything else (timeout
+# waiting on the stream) is a HUNG verdict — the one thing the resilience
+# stack promises can never happen.
+OUTCOME_FINISHED = "finished"
+OUTCOME_ERROR = "error"
+OUTCOME_HUNG = "hung"
+
+
+@dataclass
+class ChaosEvent:
+    at_s: float          # offset from run start
+    kind: str            # kill_engine | kill_coordinator | failpoints
+    target: int | None = None   # engine id for kill_engine
+    spec: str | None = None     # failpoint spec for kind == failpoints
+
+    def __str__(self) -> str:
+        extra = ""
+        if self.target is not None:
+            extra = f" target={self.target}"
+        if self.spec is not None:
+            extra = f" spec={self.spec!r}"
+        return f"@{self.at_s:.2f}s {self.kind}{extra}"
+
+
+@dataclass
+class ChaosPlan:
+    seed: int
+    duration_s: float
+    events: list[ChaosEvent]
+
+
+def make_plan(
+    seed: int,
+    duration_s: float = 10.0,
+    *,
+    num_engines: int = 1,
+    engine_kills: int = 1,
+    coordinator_kills: int = 0,
+    failpoint_specs: list[str] | None = None,
+) -> ChaosPlan:
+    """Expand a seed into a deterministic fault schedule.
+
+    ``failpoint_specs`` entries are full VLLM_TPU_FAILPOINTS strings; one
+    is armed at a seeded time and runs for the rest of the schedule
+    (failpoint term lists already encode their own finite budgets).
+    """
+    rng = random.Random(seed)
+    events: list[ChaosEvent] = []
+    # Faults land in the middle 80% of the run: the stack must be warm
+    # enough for the fault to interrupt real work, and must have time to
+    # recover before the invariant sweep.
+    lo, hi = 0.1 * duration_s, 0.9 * duration_s
+    for _ in range(engine_kills):
+        events.append(ChaosEvent(
+            at_s=rng.uniform(lo, hi), kind="kill_engine",
+            target=rng.randrange(num_engines)))
+    for _ in range(coordinator_kills):
+        events.append(ChaosEvent(
+            at_s=rng.uniform(lo, hi), kind="kill_coordinator"))
+    for spec in failpoint_specs or []:
+        events.append(ChaosEvent(
+            at_s=rng.uniform(lo, hi), kind="failpoints", spec=spec))
+    events.sort(key=lambda e: e.at_s)
+    return ChaosPlan(seed=seed, duration_s=duration_s, events=events)
+
+
+class InvariantLedger:
+    """Request-outcome bookkeeping + the global-invariant sweep."""
+
+    def __init__(self) -> None:
+        self.admitted: set[str] = set()
+        self.shed: set[str] = set()
+        self.outcomes: dict[str, str] = {}
+        self.violations: list[str] = []
+
+    # -- recording (workload side) -------------------------------------
+
+    def record_admitted(self, request_id: str) -> None:
+        self.admitted.add(request_id)
+
+    def record_shed(self, request_id: str) -> None:
+        self.shed.add(request_id)
+
+    def record_outcome(self, request_id: str, outcome: str) -> None:
+        prior = self.outcomes.get(request_id)
+        if prior is not None:
+            self.violations.append(
+                f"request {request_id}: second terminal state {outcome} "
+                f"after {prior}")
+            return
+        self.outcomes[request_id] = outcome
+
+    def record_post_final_item(self, request_id: str) -> None:
+        self.violations.append(
+            f"request {request_id}: stream delivered an item after its "
+            f"final")
+
+    # -- the sweep ------------------------------------------------------
+
+    def check(self, engine: Any) -> list[str]:
+        """Run the post-drain invariant sweep; returns violations (empty
+        = the schedule was survived correctly)."""
+        for rid in sorted(self.admitted):
+            out = self.outcomes.get(rid)
+            if out is None:
+                self.violations.append(
+                    f"request {rid}: admitted but reached no terminal "
+                    f"state")
+            elif out == OUTCOME_HUNG:
+                self.violations.append(
+                    f"request {rid}: hung (no terminal state within the "
+                    f"harness timeout)")
+        for rid in sorted(set(self.outcomes) - self.admitted):
+            self.violations.append(
+                f"request {rid}: terminal state without admission")
+        admission = getattr(engine, "admission", None)
+        if admission is not None:
+            if admission.inflight_requests != 0:
+                self.violations.append(
+                    f"admission slots leak: {admission.inflight_requests} "
+                    f"request(s) still admitted after drain")
+            if admission.inflight_prompt_tokens != 0:
+                self.violations.append(
+                    f"admission token reservation leak: "
+                    f"{admission.inflight_prompt_tokens} tokens still "
+                    f"reserved after drain")
+        journal = getattr(engine, "journal", None)
+        if journal is not None:
+            if len(journal) != 0:
+                self.violations.append(
+                    f"journal leak: {len(journal)} entr(ies) survive the "
+                    f"drain")
+            errors = sum(
+                1 for o in self.outcomes.values() if o == OUTCOME_ERROR)
+            if journal.requests_failed_on_crash_total > errors:
+                self.violations.append(
+                    f"journal counted {journal.requests_failed_on_crash_total} "
+                    f"crash-failures but only {errors} request(s) saw a "
+                    f"terminal error")
+        return self.violations
+
+    def summary(self) -> dict:
+        counts: dict[str, int] = {}
+        for out in self.outcomes.values():
+            counts[out] = counts.get(out, 0) + 1
+        return {
+            "admitted": len(self.admitted),
+            "shed": len(self.shed),
+            "outcomes": counts,
+            "violations": list(self.violations),
+        }
+
+
+class ChaosDriver:
+    """Applies a :class:`ChaosPlan` against a live AsyncLLM.
+
+    Kills are delivered with SIGKILL (no cleanup, like the real OOM
+    killer); failpoint events arm the in-process sites of the *frontend*
+    (engine-core processes inherit env-armed sites at spawn instead —
+    runtime re-arming cannot cross the process boundary).
+    """
+
+    def __init__(self, engine: Any, plan: ChaosPlan) -> None:
+        self.engine = engine
+        self.plan = plan
+        self.applied: list[str] = []
+
+    def _kill(self, pid: int | None, what: str) -> None:
+        if not pid:
+            self.applied.append(f"{what}: no pid (skipped)")
+            return
+        try:
+            os.kill(pid, signal.SIGKILL)
+            self.applied.append(f"{what}: SIGKILL pid {pid}")
+        except ProcessLookupError:
+            self.applied.append(f"{what}: pid {pid} already gone")
+
+    def apply(self, event: ChaosEvent) -> None:
+        logger.info("chaos: applying %s", event)
+        client = self.engine.engine_core
+        if event.kind == "kill_engine":
+            procs = getattr(client, "_procs", None)
+            if not procs:
+                # In-process client: no engine process to kill; the
+                # scripted client injects crashes itself.
+                self.applied.append("kill_engine: in-process (skipped)")
+                return
+            eid = (event.target or 0) % len(procs)
+            self._kill(getattr(procs[eid], "pid", None),
+                       f"kill_engine[{eid}]")
+        elif event.kind == "kill_coordinator":
+            coord = getattr(client, "_coord", None)
+            if coord is None:
+                self.applied.append("kill_coordinator: no coordinator")
+                return
+            self._kill(getattr(coord, "pid", None), "kill_coordinator")
+        elif event.kind == "failpoints":
+            failpoints.configure(event.spec or "", seed=self.plan.seed)
+            self.applied.append(f"failpoints: armed {event.spec!r}")
+        else:
+            raise ValueError(f"unknown chaos event kind {event.kind!r}")
+
+    async def run(self) -> None:
+        """Deliver every event at its scheduled offset."""
+        start = time.monotonic()
+        for event in self.plan.events:
+            delay = event.at_s - (time.monotonic() - start)
+            if delay > 0:
+                await asyncio.sleep(delay)
+            self.apply(event)
+
+
+@dataclass
+class ChaosReport:
+    plan: ChaosPlan
+    ledger: InvariantLedger
+    applied: list[str] = field(default_factory=list)
+    wall_s: float = 0.0
+
+    @property
+    def ok(self) -> bool:
+        return not self.ledger.violations
+
+    def to_dict(self) -> dict:
+        return {
+            "seed": self.plan.seed,
+            "duration_s": self.plan.duration_s,
+            "events": [str(e) for e in self.plan.events],
+            "applied": self.applied,
+            "wall_s": round(self.wall_s, 3),
+            **self.ledger.summary(),
+            "ok": self.ok,
+        }
+
+
+async def run_chaos(
+    engine: Any,
+    plan: ChaosPlan,
+    *,
+    num_requests: int = 16,
+    max_tokens: int = 8,
+    concurrency: int = 4,
+    request_timeout_s: float = 120.0,
+    prompt_token_ids: list[int] | None = None,
+) -> ChaosReport:
+    """Stream a seeded workload through ``engine`` while ``plan``'s faults
+    land, then sweep the invariants.
+
+    The workload itself is seeded from the plan (request sizes vary
+    deterministically); request *interleaving* is of course scheduler-
+    dependent — the invariants are exactly the properties that must hold
+    under any interleaving.
+    """
+    from vllm_tpu.sampling_params import RequestOutputKind, SamplingParams
+    from vllm_tpu.resilience.lifecycle import RequestShedError
+
+    rng = random.Random(plan.seed ^ 0x5EED)
+    ledger = InvariantLedger()
+    driver = ChaosDriver(engine, plan)
+    sem = asyncio.Semaphore(concurrency)
+    t0 = time.monotonic()
+
+    async def one_request(i: int) -> None:
+        rid = f"chaos-{plan.seed}-{i}"
+        params = SamplingParams(
+            temperature=0.0,
+            max_tokens=max(1, rng.randint(max_tokens // 2, max_tokens)),
+            ignore_eos=True,
+            detokenize=False,
+            output_kind=RequestOutputKind.DELTA,
+        )
+        prompt = {
+            "prompt_token_ids": prompt_token_ids or [1, 2, 3],
+        }
+        async with sem:
+            finished = False
+            try:
+                async def consume() -> None:
+                    nonlocal finished
+                    async for out in engine.generate(prompt, params, rid):
+                        if finished:
+                            ledger.record_post_final_item(rid)
+                        if out.finished:
+                            finished = True
+
+                # generate() raising on the FIRST await means the request
+                # was shed/refused pre-admission; after admission, any
+                # exception is a terminal state.
+                ledger.record_admitted(rid)
+                await asyncio.wait_for(consume(), request_timeout_s)
+                if finished:
+                    ledger.record_outcome(rid, OUTCOME_FINISHED)
+                else:
+                    # Generator exhausted without a final output.
+                    ledger.record_outcome(
+                        rid, OUTCOME_ERROR)
+            except RequestShedError:
+                # Shed before anything was queued: not admitted.
+                ledger.admitted.discard(rid)
+                ledger.record_shed(rid)
+            except asyncio.TimeoutError:
+                ledger.record_outcome(rid, OUTCOME_HUNG)
+            except Exception:
+                ledger.record_outcome(rid, OUTCOME_ERROR)
+
+    async def workload() -> None:
+        tasks = []
+        for i in range(num_requests):
+            tasks.append(asyncio.create_task(one_request(i)))
+            # Seeded arrival jitter keeps faults landing between
+            # admissions, not only around one burst.
+            await asyncio.sleep(rng.uniform(0.0, 0.05))
+        await asyncio.gather(*tasks)
+
+    fault_task = asyncio.create_task(driver.run())
+    try:
+        await workload()
+    finally:
+        await fault_task
+        failpoints.deactivate()
+    ledger.check(engine)
+    return ChaosReport(
+        plan=plan, ledger=ledger, applied=driver.applied,
+        wall_s=time.monotonic() - t0,
+    )

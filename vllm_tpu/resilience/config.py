@@ -37,6 +37,18 @@ class ResilienceConfig:
     # hung, kills it, and runs the normal crash-recovery path. Off by
     # default — first-token compile on a cold cache can take minutes.
     heartbeat_timeout_s: float = 0.0
+    # DP coordinator supervision (always on for DP deployments — the
+    # coordinator was respawned unconditionally before; these bound it):
+    # respawn budget for the coordinator process. Past it, the frontend
+    # stops respawning and serves on the stale-snapshot degraded path
+    # (round-robin routing) instead of crash-looping.
+    max_coordinator_restarts: int = 10
+    # Age after which the coordinator's load snapshot is considered
+    # stale: the DP client stops routing least-loaded on dead data and
+    # falls back to round-robin across up ranks. The coordinator
+    # heartbeats snapshots at 1 Hz, so anything over ~3 s means it is
+    # gone or wedged.
+    coordinator_stale_after_s: float = 5.0
     # Opt-in journal persistence: directory where the RequestJournal
     # snapshots admitted requests. On frontend restart, leftover snapshots
     # identify requests that were lost in flight (reported via
@@ -58,4 +70,14 @@ class ResilienceConfig:
             )
         if self.restart_backoff_s < 0 or self.restart_backoff_max_s < 0:
             raise ValueError("restart backoff values must be >= 0")
+        if self.max_coordinator_restarts < 0:
+            raise ValueError(
+                f"max_coordinator_restarts must be >= 0, got "
+                f"{self.max_coordinator_restarts}"
+            )
+        if self.coordinator_stale_after_s <= 0:
+            raise ValueError(
+                f"coordinator_stale_after_s must be > 0, got "
+                f"{self.coordinator_stale_after_s}"
+            )
         return self

@@ -1,0 +1,307 @@
+"""Named fault-injection sites ("failpoints") compiled into the hot seams
+of the serving stack.
+
+FreeBSD/etcd-gofail style: a *site* is a named call like
+``fail_point("core_client.recv")`` placed at a seam where partial failure
+happens in production (a ZMQ hop, a disk write, a busy-loop phase). Sites
+are inert — strictly a module-flag check — unless activated through
+``VLLM_TPU_FAILPOINTS``:
+
+    VLLM_TPU_FAILPOINTS="core_client.recv=3*delay(0.5);1*raise,journal.write=drop"
+
+Grammar (sites separated by ``,``; per-site *terms* separated by ``;`` and
+evaluated in order):
+
+    term    := [count '*'] [prob '%'] action ['(' arg ')']
+    count   := integer | 'once'        # term governs this many hits, then
+                                       # control advances to the next term
+    prob    := float                   # fire with this % probability per
+                                       # governed hit (seeded, per-site RNG)
+    action  := raise | delay | hang | exit | drop | off
+
+Actions:
+
+- ``raise[(ExcName)]``  raise :class:`FailpointError` (or a whitelisted
+  exception type: OSError, TimeoutError, ConnectionError, RuntimeError);
+- ``delay[(seconds)]``  sleep (default 0.1 s);
+- ``hang[(seconds)]``   sleep a long time (default 3600 s) — models a
+  wedged peer rather than a dead one;
+- ``exit[(code)]``      ``os._exit`` — models SIGKILL/OOM of the process
+  hosting the site (never runs finally blocks, exactly like the real
+  thing);
+- ``drop``              return ``"drop"`` to the call site, which skips
+  the guarded side effect (message not sent, frame discarded, write torn);
+- ``off``               no-op — combined with a count it *skips* hits, so
+  "fire on exactly the 4th hit" is ``3*off;1*raise``.
+
+Triggers compose: ``2*50%delay(1)`` governs the first two hits and fires
+each with seeded probability 0.5. A term with no count governs every
+remaining hit (terminal). ``once`` is an alias for ``1``.
+
+Determinism: probability draws come from a per-site
+``random.Random(f"{seed}:{site}")`` stream seeded by
+``VLLM_TPU_FAILPOINT_SEED`` (default 0), so the same seed and spec produce
+the same fire schedule at every site regardless of how sites interleave
+across threads. Spawned engine-core / coordinator processes inherit the
+environment, so one env var arms the whole process tree.
+
+Zero overhead when unset: ``fail_point`` first checks a module-level bool
+and returns immediately — no dict lookup, no arg evaluation. Call sites
+that want failure context in the error message pass a zero-arg callable
+(``fail_point("x", lambda: f"...")``) which is only evaluated when a
+``raise`` actually fires.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import random
+import threading
+import time
+from dataclasses import dataclass
+from typing import Any, Callable
+
+__all__ = [
+    "FailpointError",
+    "fail_point",
+    "configure",
+    "deactivate",
+    "is_active",
+    "parse_spec",
+    "snapshot",
+    "SITE_CATALOG",
+]
+
+ENV_SPEC = "VLLM_TPU_FAILPOINTS"
+ENV_SEED = "VLLM_TPU_FAILPOINT_SEED"
+
+# The compiled-in site catalog (name -> where it lives / what "drop"
+# means there). Purely documentation + chaos-harness introspection; sites
+# not listed here still work.
+SITE_CATALOG: dict[str, str] = {
+    "core_client.send": (
+        "MP/DPLB client, before an ADD is pushed to an engine-core input "
+        "socket; drop = the request is never delivered (recovered by TTFT/"
+        "deadline enforcement)"),
+    "core_client.recv": (
+        "MP/DPLB client, after a frame arrives on the shared output "
+        "socket; drop = the frame is discarded (outputs lost in transit)"),
+    "engine_core.step.schedule": (
+        "EngineCore.step, before the scheduler runs; exit = engine-core "
+        "process dies mid-loop (crash-recovery path)"),
+    "engine_core.step.dispatch": (
+        "EngineCore.step, before a batch is dispatched to the device"),
+    "engine_core.step.finalize": (
+        "EngineCore.step, before device results are fetched"),
+    "journal.write": (
+        "RequestJournal persistence, around the snapshot write; drop = "
+        "torn write (half the serialized bytes hit disk, no atomic "
+        "replace), raise(OSError) = disk write failure"),
+    "coordinator.report": (
+        "engine-core/frontend load report push to the DP coordinator; "
+        "drop = report silently lost"),
+    "coordinator.publish": (
+        "DP coordinator snapshot publish; drop = snapshot never sent, "
+        "exit = coordinator process dies (failover path)"),
+    "detokenizer.update": (
+        "incremental detokenization of new tokens in the frontend"),
+}
+
+_EXC_WHITELIST: dict[str, type[BaseException]] = {
+    "OSError": OSError,
+    "TimeoutError": TimeoutError,
+    "ConnectionError": ConnectionError,
+    "RuntimeError": RuntimeError,
+}
+
+
+class FailpointError(RuntimeError):
+    """The default exception a ``raise`` action throws."""
+
+
+@dataclass
+class _Term:
+    action: str
+    arg: str | None = None
+    count: int | None = None   # None = governs every remaining hit
+    prob: float | None = None  # None = fires on every governed hit
+
+
+_TERM_RE = re.compile(
+    r"^(?:(\d+|once)\*)?"          # count
+    r"(?:(\d+(?:\.\d+)?)%)?"       # probability (percent)
+    r"([a-z_]+)"                   # action
+    r"(?:\((.*)\))?$"              # optional arg
+)
+
+_ACTIONS = {"raise", "delay", "hang", "exit", "drop", "off"}
+
+
+def parse_spec(spec: str) -> dict[str, list[_Term]]:
+    """Parse a full VLLM_TPU_FAILPOINTS value into {site: [terms]}.
+    Raises ValueError on malformed input (a typo'd chaos schedule must
+    fail loudly, not silently inject nothing)."""
+    sites: dict[str, list[_Term]] = {}
+    for site_part in filter(None, (p.strip() for p in spec.split(","))):
+        if "=" not in site_part:
+            raise ValueError(
+                f"failpoint spec {site_part!r}: expected 'site=terms'")
+        name, _, terms_s = site_part.partition("=")
+        name = name.strip()
+        terms: list[_Term] = []
+        for term_s in filter(None, (t.strip() for t in terms_s.split(";"))):
+            m = _TERM_RE.match(term_s)
+            if m is None:
+                raise ValueError(
+                    f"failpoint {name}: malformed term {term_s!r}")
+            count_s, prob_s, action, arg = m.groups()
+            if action not in _ACTIONS:
+                raise ValueError(
+                    f"failpoint {name}: unknown action {action!r} "
+                    f"(expected one of {sorted(_ACTIONS)})")
+            count = None
+            if count_s is not None:
+                count = 1 if count_s == "once" else int(count_s)
+            prob = None
+            if prob_s is not None:
+                prob = float(prob_s) / 100.0
+            if action == "raise" and arg and arg not in _EXC_WHITELIST:
+                raise ValueError(
+                    f"failpoint {name}: raise({arg}) — exception must be "
+                    f"one of {sorted(_EXC_WHITELIST)}")
+            terms.append(_Term(action=action, arg=arg or None,
+                               count=count, prob=prob))
+        if not terms:
+            raise ValueError(f"failpoint {name}: empty term list")
+        sites[name] = terms
+    return sites
+
+
+class _Site:
+    """Runtime state of one armed site (hit counter, term cursor, RNG)."""
+
+    def __init__(self, name: str, terms: list[_Term], seed: int) -> None:
+        self.name = name
+        self.terms = terms
+        self.hits = 0
+        self.fires = 0
+        self._idx = 0
+        self._consumed = 0  # hits governed by the current counted term
+        # Per-site stream: the schedule at this site depends only on
+        # (seed, site, hit number), never on cross-site interleaving.
+        self._rng = random.Random(f"{seed}:{name}")
+        self._lock = threading.Lock()
+
+    def evaluate(self, ctx: Callable[[], Any] | None) -> str | None:
+        with self._lock:
+            self.hits += 1
+            term = None
+            while self._idx < len(self.terms):
+                t = self.terms[self._idx]
+                if t.count is not None and self._consumed >= t.count:
+                    self._idx += 1
+                    self._consumed = 0
+                    continue
+                if t.count is not None:
+                    self._consumed += 1
+                term = t
+                break
+            if term is None:
+                return None
+            if term.prob is not None and self._rng.random() >= term.prob:
+                return None
+            if term.action == "off":
+                return None
+            self.fires += 1
+            hit = self.hits
+        # Execute OUTSIDE the lock: delay/hang at one site must not block
+        # other threads hitting the same site's bookkeeping.
+        return self._execute(term, hit, ctx)
+
+    def _execute(self, term: _Term, hit: int,
+                 ctx: Callable[[], Any] | None) -> str | None:
+        if term.action == "drop":
+            return "drop"
+        if term.action == "delay":
+            time.sleep(float(term.arg) if term.arg else 0.1)
+            return None
+        if term.action == "hang":
+            time.sleep(float(term.arg) if term.arg else 3600.0)
+            return None
+        if term.action == "exit":
+            os._exit(int(term.arg) if term.arg else 1)
+        # raise
+        detail = ""
+        if ctx is not None:
+            try:
+                detail = f" [{ctx()}]"
+            except Exception:
+                pass
+        exc_cls = _EXC_WHITELIST.get(term.arg or "", FailpointError)
+        raise exc_cls(
+            f"failpoint {self.name} fired (hit #{hit}){detail}")
+
+
+# Fast-path flag: fail_point() returns before any other work when False.
+_active = False
+_sites: dict[str, _Site] = {}
+
+
+def fail_point(name: str, ctx: Callable[[], Any] | None = None) -> str | None:
+    """Evaluate the named site.
+
+    Returns None (site inert or action was delay/off/non-firing) or
+    ``"drop"`` (the call site must skip its guarded side effect). May
+    raise (action ``raise``), sleep (``delay``/``hang``), or kill the
+    process (``exit``). ``ctx``, when given, is a zero-arg callable
+    evaluated ONLY if a raise fires — never on the disabled path.
+    """
+    if not _active:
+        return None
+    site = _sites.get(name)
+    if site is None:
+        return None
+    return site.evaluate(ctx)
+
+
+def configure(spec: str, seed: int | None = None) -> None:
+    """Arm sites from a spec string (tests / chaos harness). Replaces any
+    previously armed configuration."""
+    global _active, _sites
+    if seed is None:
+        seed = int(os.environ.get(ENV_SEED, "0"))
+    parsed = parse_spec(spec)
+    _sites = {n: _Site(n, terms, seed) for n, terms in parsed.items()}
+    _active = bool(_sites)
+
+
+def deactivate() -> None:
+    """Disarm every site (back to the zero-overhead path)."""
+    global _active, _sites
+    _active = False
+    _sites = {}
+
+
+def is_active() -> bool:
+    return _active
+
+
+def snapshot() -> dict[str, dict[str, int]]:
+    """Per-site hit/fire counters (chaos-harness assertions and the
+    ``vllm:failpoints_fired_total`` metric)."""
+    return {
+        name: {"hits": s.hits, "fires": s.fires}
+        for name, s in _sites.items()
+    }
+
+
+def _init_from_env() -> None:
+    spec = os.environ.get(ENV_SPEC)
+    if spec:
+        configure(spec)
+
+
+# Spawned engine-core / coordinator processes import this module fresh and
+# inherit the parent's environment: one env var arms the whole tree.
+_init_from_env()

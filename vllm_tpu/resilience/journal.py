@@ -28,11 +28,13 @@ import hashlib
 import json
 import logging
 import os
+import re
 import threading
 from dataclasses import dataclass, field
 from typing import Any
 
 from vllm_tpu.request import EngineCoreRequest
+from vllm_tpu.resilience.failpoints import fail_point
 
 logger = logging.getLogger(__name__)
 
@@ -142,9 +144,20 @@ class RequestJournal:
             if entry.sampling_params is not None else None,
         }
         try:
+            data = json.dumps(snapshot)
+            # Failpoint `journal.write`: raise(OSError) models a failed
+            # disk write (logged, request keeps serving unjournaled on
+            # disk); drop models a TORN write — half the bytes land at
+            # the final path with no atomic replace, exactly what a crash
+            # mid-write leaves behind for the restart scan to handle.
+            if fail_point("journal.write",
+                          lambda: f"req={entry.request_id}") == "drop":
+                with open(path, "w") as f:
+                    f.write(data[: max(1, len(data) // 2)])
+                return
             tmp = path + ".tmp"
             with open(tmp, "w") as f:
-                json.dump(snapshot, f)
+                f.write(data)
             os.replace(tmp, path)
         except OSError as e:
             logger.warning("journal: failed to persist %s: %s",
@@ -166,22 +179,48 @@ class RequestJournal:
     def _scan_lost_requests(self) -> None:
         """Startup scan: snapshots left behind by a previous frontend are
         requests that died with it. Report them, then clear the files so
-        the next restart doesn't double-count."""
+        the next restart doesn't double-count.
+
+        The valid prefix of the directory parses normally; a truncated or
+        corrupt snapshot (torn write — the frontend died mid-persist) is
+        STILL a lost request: it is reported with whatever fields survive
+        (request_id recovered from the partial JSON when possible) and
+        counted in ``vllm:requests_lost_on_restart_total`` rather than
+        silently skipped."""
         assert self._persist_dir is not None
         for name in sorted(os.listdir(self._persist_dir)):
-            if not name.endswith(".json"):
+            if not (name.endswith(".json") or name.endswith(".json.tmp")):
                 continue
             path = os.path.join(self._persist_dir, name)
             try:
                 with open(path) as f:
-                    self.lost_on_restart.append(json.load(f))
-            except (OSError, ValueError) as e:
+                    raw = f.read()
+            except OSError as e:
                 logger.warning("journal: unreadable snapshot %s: %s",
                                name, e)
+                self.lost_on_restart.append(
+                    {"request_id": None, "snapshot": name,
+                     "corrupt": True})
+                continue
+            finally:
+                try:
+                    os.unlink(path)
+                except OSError:
+                    pass
             try:
-                os.unlink(path)
-            except OSError:
-                pass
+                self.lost_on_restart.append(json.loads(raw))
+            except ValueError:
+                # Torn write: salvage the request id from the partial
+                # JSON if the field survived the truncation.
+                m = re.search(r'"request_id":\s*"([^"]*)"', raw)
+                logger.warning(
+                    "journal: corrupt snapshot %s (%d bytes); counting "
+                    "as lost", name, len(raw))
+                self.lost_on_restart.append({
+                    "request_id": m.group(1) if m else None,
+                    "snapshot": name,
+                    "corrupt": True,
+                })
         self.requests_lost_on_restart_total = len(self.lost_on_restart)
         if self.lost_on_restart:
             logger.warning(

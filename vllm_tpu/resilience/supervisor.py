@@ -15,6 +15,15 @@ from dataclasses import dataclass, field
 
 from vllm_tpu.resilience.config import ResilienceConfig
 
+# Pseudo engine id under which the DP coordinator process is adopted by
+# the supervisor: same restart bookkeeping and backoff schedule, but the
+# coordinator is control-plane — it is excluded from data-plane readiness
+# (all_up) and from the per-engine status map, and its restart budget is
+# ResilienceConfig.max_coordinator_restarts, independent of
+# enable_recovery (the coordinator was always respawned; only engines'
+# recovery is opt-in).
+COORDINATOR_ID = -1
+
 
 @dataclass
 class EngineStatus:
@@ -41,11 +50,22 @@ class EngineSupervisor:
             st = self._engines.setdefault(engine_id, EngineStatus())
             return st.restarts < self.config.max_engine_restarts
 
+    def may_restart_coordinator(self) -> bool:
+        """Coordinator restart budget. Independent of enable_recovery:
+        coordinator supervision is always on for a DP deployment (a dead
+        coordinator silently freezes the wave state)."""
+        with self._lock:
+            st = self._engines.setdefault(COORDINATOR_ID, EngineStatus())
+            return st.restarts < self.config.max_coordinator_restarts
+
     def backoff_s(self, engine_id: int) -> float:
         """Backoff before the NEXT spawn attempt: base * 2**(restarts-1),
         capped. Call after record_failure (restarts >= 1)."""
         with self._lock:
-            restarts = self._engines[engine_id].restarts
+            # setdefault like may_restart: a failure-recording race with
+            # registration must not KeyError mid-recovery.
+            restarts = self._engines.setdefault(
+                engine_id, EngineStatus()).restarts
         if restarts <= 0:
             return 0.0
         return min(
@@ -86,13 +106,26 @@ class EngineSupervisor:
             return bool(st and st.up)
 
     def all_up(self) -> bool:
+        """Data-plane readiness: every ENGINE is up. The coordinator is
+        deliberately excluded — a respawning coordinator degrades routing
+        but the server still serves."""
         with self._lock:
-            return all(st.up for st in self._engines.values())
+            return all(
+                st.up for eid, st in self._engines.items()
+                if eid != COORDINATOR_ID
+            )
+
+    def restarts(self, engine_id: int) -> int:
+        with self._lock:
+            st = self._engines.get(engine_id)
+            return st.restarts if st is not None else 0
 
     def status(self) -> dict:
-        """JSON-shaped snapshot for /health and /metrics."""
+        """JSON-shaped per-engine snapshot for /health and /metrics (the
+        coordinator reports separately via coordinator_status)."""
         with self._lock:
             return {
                 str(eid): {"up": st.up, "restarts": st.restarts}
                 for eid, st in sorted(self._engines.items())
+                if eid != COORDINATOR_ID
             }
