@@ -42,6 +42,14 @@ def test_parse_full_grammar():
     ]
 
 
+def test_parse_new_actions_and_match_guard():
+    sites = fp.parse_spec(
+        "model_runner.step=2*nan;hang_step(0.1)@poison-7")
+    a, b = sites["model_runner.step"]
+    assert (a.action, a.count, a.match) == ("nan", 2, None)
+    assert (b.action, b.arg, b.match) == ("hang_step", "0.1", "poison-7")
+
+
 @pytest.mark.parametrize("bad", [
     "no_equals_sign",
     "site=notanaction",
@@ -96,6 +104,36 @@ def test_raise_includes_lazy_context():
 def test_unknown_site_is_inert_while_active():
     fp.configure("s=raise")
     assert fp.fail_point("other.site") is None
+
+
+def test_nan_and_hang_step_actions():
+    import time
+
+    fp.configure("s=once*nan;hang_step(0.01)")
+    assert fp.fail_point("s") == "nan"
+    t0 = time.monotonic()
+    assert fp.fail_point("s") == "hang_step"
+    assert time.monotonic() - t0 >= 0.01
+
+
+def test_match_guard_gates_without_consuming_count():
+    fp.configure("s=2*drop@poison")
+    # Non-matching hits are not governed at all: no fire, no count
+    # consumed — however many clean batches run in between.
+    assert fp.fail_point("s", lambda: "reqs=['a', 'b']") is None
+    assert fp.fail_point("s") is None  # no ctx -> cannot match
+    assert fp.fail_point("s", lambda: "reqs=['a', 'poison-0']") == "drop"
+    assert fp.fail_point("s", lambda: "reqs=['poison-0']") == "drop"
+    # Only the two MATCHING hits consumed the count.
+    assert fp.fail_point("s", lambda: "reqs=['poison-0']") is None
+    assert fp.snapshot()["s"]["fires"] == 2
+
+
+def test_match_guard_targets_raise_at_request():
+    fp.configure("s=raise@poison")
+    assert fp.fail_point("s", lambda: "reqs=['clean-1']") is None
+    with pytest.raises(fp.FailpointError, match="poison"):
+        fp.fail_point("s", lambda: "reqs=['poison-1', 'clean-1']")
 
 
 # -- seeded determinism -------------------------------------------------

@@ -1,0 +1,411 @@
+"""Poison-request bisection & quarantine tests.
+
+Tier-1 (fast, in-process): QuarantineManager strike accounting and
+bisection state machine, DeadLetterStore round-trips, StepWatchdog
+deadline mechanics, the ``tools/deadletter.py`` CLI, and the acceptance
+scenario over the scripted FakeClient — a request that deterministically
+crashes every engine incarnation that schedules it must converge to the
+dead-letter store while background traffic finishes untouched.
+
+Slow (multi-process): the same convergence against a real spawned
+engine-core process, with the crash injected at the env-armed
+``model_runner.step`` failpoint (``raise@<rid>`` match guard) so only
+steps scheduling the poison request die.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import os
+import sys
+import threading
+import time
+
+import pytest
+
+from tests.resilience.test_recovery_unit import (
+    FakeClient,
+    _collect,
+    make_engine,
+)
+from vllm_tpu.resilience import (
+    EngineRestartedError,
+    RequestFailedOnCrashError,
+)
+from vllm_tpu.resilience.quarantine import (
+    DeadLetterStore,
+    QuarantineManager,
+    make_deadletter_record,
+)
+from vllm_tpu.worker.watchdog import StepWatchdog
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__))))
+
+
+# -- QuarantineManager unit tests ---------------------------------------
+
+
+def test_first_strike_replays_everything():
+    q = QuarantineManager(max_suspect_strikes=2)
+    d = q.on_crash(["a", "b"], ["a"])
+    assert d == {"a": "replay", "b": "replay"}
+    assert q.strikes("a") == 1
+    assert q.strikes("b") == 0  # lost but not on the device: no blame
+
+
+def test_single_hot_suspect_is_deadlettered():
+    q = QuarantineManager(max_suspect_strikes=2)
+    q.on_crash(["a", "b"], ["a"])
+    d = q.on_crash(["a", "b"], ["a"])
+    assert d["a"] == "deadletter"
+    assert d["b"] == "replay"
+
+
+def test_unattributed_death_blames_nobody():
+    # SIGKILL/OOM deaths carry no batch frame: no strikes, so repeated
+    # EXTERNAL kills can never quarantine innocent traffic.
+    q = QuarantineManager(max_suspect_strikes=1)
+    for _ in range(5):
+        d = q.on_crash(["a", "b"], None)
+    assert d == {"a": "replay", "b": "replay"}
+    assert q.strikes("a") == 0 and q.strikes("b") == 0
+
+
+def test_terminal_state_exonerates_suspect():
+    q = QuarantineManager(max_suspect_strikes=2)
+    q.on_crash(["a"], ["a"])
+    assert q.strikes("a") == 1
+    q.note_terminal("a")
+    assert q.strikes("a") == 0
+    # Strikes restart from zero: still one short of hot.
+    assert q.on_crash(["a"], ["a"])["a"] == "replay"
+
+
+def test_bisection_probes_half_and_releases_on_drain():
+    released: list[str] = []
+    q = QuarantineManager(max_suspect_strikes=2,
+                          on_release=released.extend)
+    batch = ["a", "b", "c", "d"]
+    q.on_crash(batch, batch)
+    d = q.on_crash(batch, batch)
+    # All four are hot and ambiguous: probe the first (sorted) half.
+    assert d == {"a": "replay", "b": "replay", "c": "hold", "d": "hold"}
+    assert q.status()["probing"] == ["a", "b"]
+    assert q.status()["held"] == ["c", "d"]
+    # The probe drains cleanly: exonerated, and the held half released.
+    q.note_terminal("a")
+    assert released == []
+    q.note_terminal("b")
+    assert released == ["c", "d"]
+    assert q.strikes("a") == 0
+    # The released pair crashes again: bisect once more, probe c, hold d.
+    d = q.on_crash(["c", "d"], ["c", "d"])
+    assert d == {"c": "replay", "d": "hold"}
+    # c crashes alone: unambiguous culprit.
+    d = q.on_crash(["c"], ["c"])
+    assert d == {"c": "deadletter"}
+    # Dead-lettering is terminal: it resolves the probe and frees d.
+    q.note_deadlettered("c", None, "boom")
+    assert released == ["c", "d", "d"]
+    assert q.requests_quarantined_total == 1
+    assert [r["request_id"] for r in q.deadletter.list()] == ["c"]
+
+
+def test_probation_cap_spills_to_held():
+    q = QuarantineManager(max_suspect_strikes=1, probation_cap=2)
+    batch = [f"r{i}" for i in range(8)]
+    d = q.on_crash(batch, batch)
+    # 8 hot suspects, half = 4, capped at 2 in probation.
+    assert sorted(r for r, disp in d.items() if disp != "hold") == \
+        ["r0", "r1"]
+    assert q.status()["probing"] == ["r0", "r1"]
+    assert len(q.status()["held"]) == 6
+
+
+def test_safety_bound_breaks_permanent_ambiguity():
+    # Two suspects that ALWAYS crash together and never drain: the hard
+    # cap dead-letters both rather than crash-looping forever.
+    q = QuarantineManager(max_suspect_strikes=1)
+    d = {}
+    for _ in range(7):  # max_suspect_strikes + _SAFETY_MARGIN
+        d = q.on_crash(["a", "b"], ["a", "b"])
+    assert d == {"a": "deadletter", "b": "deadletter"}
+
+
+def test_deadletter_record_shapes():
+    rec = make_deadletter_record(None, "r1", 3, "line one\nline two")
+    assert rec["request_id"] == "r1" and rec["strikes"] == 3
+    assert "prompt_token_ids" not in rec  # no journal entry to mine
+
+
+# -- DeadLetterStore ----------------------------------------------------
+
+
+def test_deadletter_store_memory_roundtrip():
+    store = DeadLetterStore(None)
+    store.add({"request_id": "x", "strikes": 2})
+    assert len(store) == 1
+    assert store.get("x")["strikes"] == 2
+    assert store.remove("x")["strikes"] == 2
+    assert store.get("x") is None and len(store) == 0
+
+
+def test_deadletter_store_disk_roundtrip(tmp_path):
+    rid = "weird/id: with spacesé"  # filesystem-unsafe id
+    store = DeadLetterStore(str(tmp_path))
+    store.add({"request_id": rid, "strikes": 3})
+    # A fresh store over the same dir (new frontend incarnation) sees it.
+    store2 = DeadLetterStore(str(tmp_path))
+    assert [r["request_id"] for r in store2.list()] == [rid]
+    assert store2.get(rid)["strikes"] == 3
+    assert store2.remove(rid)["strikes"] == 3
+    assert DeadLetterStore(str(tmp_path)).get(rid) is None
+
+
+# -- StepWatchdog -------------------------------------------------------
+
+
+def test_watchdog_trips_on_wedged_step():
+    tripped = threading.Event()
+    seen = {}
+
+    def on_trip(req_ids, elapsed):
+        seen["req_ids"] = req_ids
+        seen["elapsed"] = elapsed
+        tripped.set()
+
+    wd = StepWatchdog(0.05, on_trip=on_trip)
+    try:
+        wd.arm(["r1", "r2"])
+        assert tripped.wait(5.0), "watchdog never tripped"
+        assert seen["req_ids"] == ["r1", "r2"]
+        assert seen["elapsed"] >= 0.05
+        assert wd.trips == 1
+        assert wd.status()["steps_in_flight"] == 0
+    finally:
+        wd.stop()
+
+
+def test_watchdog_disarm_before_deadline_is_silent():
+    wd = StepWatchdog(0.1)
+    try:
+        for _ in range(3):
+            wd.arm(["r1"])
+            wd.disarm()
+        time.sleep(0.3)
+        assert wd.trips == 0
+    finally:
+        wd.stop()
+
+
+def test_watchdog_fifo_tracks_pipelined_steps():
+    # Two steps in flight; completing the older one leaves the younger
+    # armed from ITS dispatch time, not the older one's.
+    wd = StepWatchdog(0.15)
+    try:
+        wd.arm(["old"])
+        time.sleep(0.05)
+        wd.arm(["young"])
+        wd.disarm()  # oldest (old) completes
+        time.sleep(0.05)
+        assert wd.trips == 0  # young has not exceeded its own deadline
+        assert wd.status()["steps_in_flight"] == 1
+    finally:
+        wd.stop()
+
+
+# -- deadletter CLI smoke -----------------------------------------------
+
+
+def _deadletter_tool():
+    sys.path.insert(0, os.path.join(_REPO_ROOT, "tools"))
+    try:
+        import deadletter
+    finally:
+        sys.path.pop(0)
+    return deadletter
+
+
+def test_deadletter_cli_list_show_readmit(tmp_path, capsys):
+    tool = _deadletter_tool()
+    store = DeadLetterStore(str(tmp_path))
+    store.add({
+        "request_id": "bad-1", "strikes": 2,
+        "prompt_token_ids": [1, 2], "emitted_token_ids": [3],
+        "max_tokens": 8, "quarantined_at": 0.0,
+    })
+    assert tool.main(["list", "--journal-dir", str(tmp_path)]) == 0
+    out = capsys.readouterr().out
+    assert "bad-1" in out and "strikes=2" in out
+    assert tool.main(
+        ["show", "bad-1", "--journal-dir", str(tmp_path)]) == 0
+    assert '"request_id": "bad-1"' in capsys.readouterr().out
+    assert tool.main(
+        ["show", "nope", "--journal-dir", str(tmp_path)]) == 1
+    capsys.readouterr()
+    # readmit without --url releases the record from the store.
+    assert tool.main(
+        ["readmit", "bad-1", "--journal-dir", str(tmp_path)]) == 0
+    assert "removed dead-letter record" in capsys.readouterr().out
+    assert DeadLetterStore(str(tmp_path)).get("bad-1") is None
+
+
+# -- acceptance: seeded poison converges (tier-1, in-process) ------------
+
+
+class PoisonClient(FakeClient):
+    """FakeClient whose engine dies whenever the poison request is
+    scheduled, reporting the scheduled batch as the suspect set — the
+    same shape a real MSG_DEAD carries after a device crash."""
+
+    def __init__(self, poison_rid: str):
+        super().__init__()
+        self.poison_rid = poison_rid
+
+    def get_output(self, timeout=None):
+        if self.poison_rid in self._live:
+            self.restarts += 1
+            lost = sorted(self._live)
+            self._live.clear()
+            raise EngineRestartedError(
+                lost, engine_id=0, suspect_req_ids=lost)
+        return super().get_output(timeout)
+
+
+def test_poison_request_converges_to_deadletter():
+    client = PoisonClient("poison")
+    llm = make_engine(client, max_request_retries=8)
+    try:
+        async def run():
+            tasks = [
+                asyncio.create_task(_collect(llm, "bg-1", 4)),
+                asyncio.create_task(_collect(llm, "bg-2", 4)),
+                asyncio.create_task(_collect(llm, "poison", 4)),
+            ]
+            return await asyncio.gather(*tasks, return_exceptions=True)
+
+        bg1, bg2, poison = asyncio.run(
+            asyncio.wait_for(run(), timeout=60))
+        # The poison request failed with a quarantine error...
+        assert isinstance(poison, RequestFailedOnCrashError)
+        assert "quarantined" in str(poison)
+        # ...and every background request finished its full budget.
+        for res in (bg1, bg2):
+            tokens, final = res
+            assert final is not None and final.finished
+            assert len(tokens) == 4
+        # Dead-letter record present and introspectable.
+        dl = llm.debug_deadletter()
+        assert dl["enabled"] is True
+        assert [r["request_id"] for r in dl["records"]] == ["poison"]
+        assert llm.quarantine.requests_quarantined_total == 1
+        # Convergence bound: strikes to go hot plus bisection rounds
+        # (hard safety cap), never a crash-loop to budget death.
+        assert 2 <= client.restarts <= \
+            llm.resilience.max_suspect_strikes + 6
+        # Innocent co-suspects were exonerated on finish.
+        assert llm.quarantine.strikes("bg-1") == 0
+        assert llm.quarantine.strikes("bg-2") == 0
+        # The quarantine surfaces in resilience_status.
+        st = llm.resilience_status()
+        assert st["requests_quarantined_total"] == 1
+        assert st["quarantine"]["quarantined_total"] == 1
+        assert llm.journal is not None and len(llm.journal) == 0
+        assert not llm._dead
+    finally:
+        llm.shutdown()
+
+
+def test_poison_convergence_is_reproducible():
+    def run_once():
+        client = PoisonClient("poison")
+        llm = make_engine(client, max_request_retries=8)
+        try:
+            async def run():
+                tasks = [
+                    asyncio.create_task(_collect(llm, f"bg-{i}", 3))
+                    for i in range(3)
+                ]
+                tasks.append(
+                    asyncio.create_task(_collect(llm, "poison", 3)))
+                return await asyncio.gather(
+                    *tasks, return_exceptions=True)
+
+            results = asyncio.run(asyncio.wait_for(run(), timeout=60))
+            dl = [r["request_id"]
+                  for r in llm.debug_deadletter()["records"]]
+            finished = sum(
+                1 for r in results
+                if not isinstance(r, BaseException) and r[1] is not None)
+            return dl, finished
+        finally:
+            llm.shutdown()
+
+    assert run_once() == run_once() == (["poison"], 3)
+
+
+# -- acceptance: real engine process (slow) ------------------------------
+
+
+@pytest.mark.slow
+def test_poison_request_quarantined_multiprocess(tmp_path, monkeypatch):
+    """Env-armed ``model_runner.step=raise@<rid>`` inside a real spawned
+    engine-core: every incarnation that schedules the poison request
+    dies (each respawn re-arms from the inherited environment), and the
+    frontend must dead-letter it while other requests complete."""
+    from tests.models.utils import tiny_llama_dir
+    from vllm_tpu.engine.arg_utils import AsyncEngineArgs
+    from vllm_tpu.engine.async_llm import AsyncLLM
+
+    poison_rid = "poison-mp-1"
+    monkeypatch.setenv(
+        "VLLM_TPU_FAILPOINTS",
+        f"model_runner.step=raise@{poison_rid}")
+    monkeypatch.setenv("VLLM_TPU_FAILPOINT_SEED", "0")
+
+    ckpt = tiny_llama_dir(tmp_path)
+    engine = AsyncLLM.from_engine_args(AsyncEngineArgs(
+        model=ckpt, dtype="float32", max_model_len=128, block_size=16,
+        num_gpu_blocks_override=64, max_num_seqs=4,
+        max_num_batched_tokens=128, distributed_executor_backend="mp",
+        enable_engine_recovery=True, max_engine_restarts=8,
+        max_request_retries=4, restart_backoff_s=0.05,
+        max_suspect_strikes=2, journal_dir=str(tmp_path / "journal"),
+    ))
+    try:
+        from vllm_tpu.sampling_params import (
+            RequestOutputKind,
+            SamplingParams,
+        )
+
+        async def one(rid, max_tokens=6):
+            sp = SamplingParams(
+                temperature=0.0, max_tokens=max_tokens, ignore_eos=True,
+                output_kind=RequestOutputKind.DELTA)
+            tokens = []
+            async for out in engine.generate(
+                    {"prompt_token_ids": [5, 9, 11]}, sp, rid):
+                tokens.extend(out.outputs[0].token_ids)
+            return tokens
+
+        async def run():
+            tasks = [asyncio.create_task(one(f"bg-{i}"))
+                     for i in range(3)]
+            tasks.append(asyncio.create_task(one(poison_rid)))
+            return await asyncio.gather(*tasks, return_exceptions=True)
+
+        results = asyncio.run(asyncio.wait_for(run(), timeout=300))
+        *bg, poison = results
+        assert isinstance(poison, RequestFailedOnCrashError)
+        assert "quarantined" in str(poison)
+        for tokens in bg:
+            assert not isinstance(tokens, BaseException), tokens
+            assert len(tokens) == 6
+        dl = engine.debug_deadletter()
+        assert [r["request_id"] for r in dl["records"]] == [poison_rid]
+        # The record survived to disk beside the journal.
+        on_disk = DeadLetterStore(str(tmp_path / "journal"))
+        assert on_disk.get(poison_rid) is not None
+    finally:
+        engine.shutdown()

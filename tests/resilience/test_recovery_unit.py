@@ -23,6 +23,7 @@ from vllm_tpu.resilience import (
     AdmissionController,
     EngineRestartedError,
     LifecycleConfig,
+    QuarantineManager,
     RequestFailedOnCrashError,
     RequestJournal,
     ResilienceConfig,
@@ -125,15 +126,23 @@ def make_engine(client, *, recovery=True, max_request_retries=1,
     llm.journal = RequestJournal() if recovery else None
     llm.lifecycle = LifecycleConfig().finalize()
     llm.admission = AdmissionController(llm.lifecycle)
+    llm.quarantine = (
+        QuarantineManager(
+            max_suspect_strikes=llm.resilience.max_suspect_strikes,
+            probation_cap=llm.resilience.quarantine_probation_cap,
+            on_release=llm._release_held_requests,
+        ) if recovery else None
+    )
     llm.timeouts_total = {}
     llm.stream_drops_total = 0
     llm.slow_client_aborts_total = 0
+    llm.replays_dropped_aborted_total = 0
     llm._last_deadline_sweep = 0.0
     llm.engine_core = client
     llm.input_processor = FakeInputProcessor()
     llm.output_processor = OutputProcessor(
         None, journal=llm.journal,
-        on_request_closed=llm.admission.release,
+        on_request_closed=llm._on_request_closed,
     )
     llm.stat_loggers = []
     llm._input_queue = queue.Queue()
@@ -280,6 +289,35 @@ def test_lost_id_without_state_is_discarded():
         sampling_params=_params(4)))
     llm._recover_requests(EngineRestartedError(["gone"], engine_id=0))
     assert llm.journal.get("gone") is None
+    assert llm.journal.requests_failed_on_crash_total == 0
+
+
+def test_replay_dropped_for_request_aborted_during_recovery():
+    # The crash handler decides to replay r1, but the client aborts it
+    # before the busy loop drains the replay op: the stale replay must be
+    # dropped (no ghost re-admission engine-side), the journal entry
+    # discarded, and the drop counted.
+    client = FakeClient()
+    llm = make_engine(client, start=False)
+    done_q = queue.Queue()
+
+    class Sink:
+        def put_nowait(self, item):
+            done_q.put(item)
+
+    llm.output_processor.add_request(
+        "r1", None, [1, 2, 3], _params(6), 0.0, queue=Sink())
+    llm.journal.record_admitted(EngineCoreRequest(
+        request_id="r1", prompt_token_ids=[1, 2, 3],
+        sampling_params=_params(6)))
+    llm._recover_requests(EngineRestartedError(["r1"], engine_id=0))
+    assert llm.journal.requests_replayed_total == 1  # replay was queued
+    # Abort lands before the drain: stream state torn down.
+    llm.output_processor.request_states.pop("r1")
+    llm._drain_input_queue(block=False)
+    assert client.added == []  # never re-admitted engine-side
+    assert llm.replays_dropped_aborted_total == 1
+    assert llm.journal.get("r1") is None
     assert llm.journal.requests_failed_on_crash_total == 0
 
 

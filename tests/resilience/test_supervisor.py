@@ -61,3 +61,61 @@ def test_config_validation():
         ResilienceConfig(max_engine_restarts=-1).finalize()
     with pytest.raises(ValueError):
         ResilienceConfig(restart_backoff_s=-0.1).finalize()
+    with pytest.raises(ValueError):
+        ResilienceConfig(restart_budget_heal_s=-1.0).finalize()
+    with pytest.raises(ValueError):
+        ResilienceConfig(max_suspect_strikes=0).finalize()
+
+
+def test_restart_budget_heals_with_uptime():
+    # One restart unit is credited back per restart_budget_heal_s of
+    # healthy uptime, so a long-lived engine is not killed for good by
+    # crashes spread over weeks.
+    sup = EngineSupervisor(_cfg(
+        max_engine_restarts=2, restart_budget_heal_s=100.0))
+    now = [0.0]
+    sup._clock = lambda: now[0]
+
+    sup.record_failure(0)
+    sup.record_ready(0)
+    sup.record_failure(0)
+    sup.record_ready(0)
+    assert not sup.may_restart(0)  # budget exhausted at 2/2
+
+    now[0] += 99.0
+    assert not sup.may_restart(0)  # not yet a full heal interval
+
+    now[0] += 1.0
+    assert sup.may_restart(0)      # one unit healed: 1/2 used
+    assert sup.status()["0"]["restarts"] == 1
+
+    now[0] += 250.0                # 2.5 intervals, but only 1 unit spent
+    assert sup.may_restart(0)
+    assert sup.status()["0"]["restarts"] == 0
+
+
+def test_heal_anchor_resets_on_ready():
+    # Downtime must not count toward healing: the anchor restarts at the
+    # moment the engine comes back up.
+    sup = EngineSupervisor(_cfg(
+        max_engine_restarts=1, restart_budget_heal_s=10.0))
+    now = [0.0]
+    sup._clock = lambda: now[0]
+
+    sup.record_failure(0)
+    assert not sup.may_restart(0)
+    now[0] += 25.0                 # time passes while the engine is DOWN
+    sup.record_ready(0)
+    assert not sup.may_restart(0)  # no credit for downtime
+    now[0] += 10.0                 # one healthy interval
+    assert sup.may_restart(0)
+
+
+def test_heal_disabled_by_default():
+    sup = EngineSupervisor(_cfg(max_engine_restarts=1))
+    now = [0.0]
+    sup._clock = lambda: now[0]
+    sup.record_failure(0)
+    sup.record_ready(0)
+    now[0] += 1e9
+    assert not sup.may_restart(0)

@@ -24,6 +24,12 @@ Examples:
     python tools/chaos_run.py --model /path/to/ckpt --seed 7 \
         --failpoints 'core_client.recv=5*25%delay(0.2)'
 
+    # seeded poison request: every step scheduling it crashes the
+    # engine; the run passes iff it converges to the dead-letter store
+    # while the background traffic all finishes
+    python tools/chaos_run.py --model /path/to/ckpt --seed 7 \
+        --engine-kills 0 --poison-mode raise
+
 Engine-core/coordinator *processes* inherit failpoints through the
 environment (export VLLM_TPU_FAILPOINTS before running this tool);
 ``--failpoints`` arms the frontend process mid-run via the chaos plan.
@@ -61,6 +67,17 @@ def build_parser() -> argparse.ArgumentParser:
                    metavar="SPEC",
                    help="frontend failpoint spec to arm at a seeded time "
                         "(repeatable); see vllm_tpu/resilience/failpoints")
+    p.add_argument("--poison-mode", default="off",
+                   choices=["off", "raise", "hang_step", "nan"],
+                   help="inject one deterministic poison request "
+                        "(id poison-<seed>) whose scheduled steps fire "
+                        "the chosen model_runner.step action; 'raise'/"
+                        "'hang_step' must converge to quarantine, 'nan' "
+                        "exercises numeric-guard containment")
+    p.add_argument("--max-suspect-strikes", type=int, default=2,
+                   help="crash strikes before a suspect is dead-lettered")
+    p.add_argument("--step-watchdog", type=float, default=5.0,
+                   help="step watchdog deadline used by hang_step mode")
     p.add_argument("--requests", type=int, default=16)
     p.add_argument("--max-tokens", type=int, default=8)
     p.add_argument("--concurrency", type=int, default=4)
@@ -72,12 +89,64 @@ def build_parser() -> argparse.ArgumentParser:
     return p
 
 
+def _check_poison(engine, report, rid: str, mode: str) -> bool:
+    """Assert the poison request converged: a terminal state, and (for
+    the crash-inducing modes) a dead-letter record."""
+    from vllm_tpu.resilience.chaos import OUTCOME_HUNG
+
+    ok = True
+    outcome = report.ledger.outcomes.get(rid)
+    print(f"poison {rid}: outcome={outcome}", file=sys.stderr)
+    if outcome is None or outcome == OUTCOME_HUNG:
+        print(f"POISON: {rid} reached no terminal state", file=sys.stderr)
+        ok = False
+    if mode in ("raise", "hang_step"):
+        dl = (engine.debug_deadletter()
+              if hasattr(engine, "debug_deadletter") else {})
+        ids = [r.get("request_id") for r in dl.get("records", [])]
+        if rid in ids:
+            q = dl.get("quarantine") or {}
+            print(
+                f"poison {rid}: dead-lettered "
+                f"(quarantined_total={q.get('quarantined_total')})",
+                file=sys.stderr)
+        else:
+            print(f"POISON: {rid} missing from dead-letter store "
+                  f"(records: {ids})", file=sys.stderr)
+            ok = False
+    return ok
+
+
 def main(argv: list[str] | None = None) -> int:
     args = build_parser().parse_args(argv)
 
     from vllm_tpu.engine.arg_utils import AsyncEngineArgs
     from vllm_tpu.engine.async_llm import AsyncLLM
+    from vllm_tpu.resilience import failpoints
     from vllm_tpu.resilience.chaos import make_plan, run_chaos
+
+    poison_rid = None
+    if args.poison_mode != "off":
+        poison_rid = f"poison-{args.seed}"
+        if args.poison_mode == "hang_step":
+            action = f"hang_step({args.step_watchdog * 3:.1f})"
+        else:
+            action = args.poison_mode
+        # The guard means only steps that schedule the poison request
+        # fire; the terminal (uncounted) term is safe because once the
+        # request is dead-lettered it is never scheduled again.
+        poison_spec = f"model_runner.step={action}@{poison_rid}"
+        prior = os.environ.get(failpoints.ENV_SPEC)
+        merged = f"{prior},{poison_spec}" if prior else poison_spec
+        # Env must be set before the engine spawns (core procs inherit
+        # it at import); the frontend process already imported the
+        # module, so re-arm it explicitly too.
+        os.environ[failpoints.ENV_SPEC] = merged
+        os.environ.setdefault(failpoints.ENV_SEED, str(args.seed))
+        failpoints.configure(
+            merged, seed=int(os.environ[failpoints.ENV_SEED]))
+        print(f"poison request {poison_rid}: armed {poison_spec!r}",
+              file=sys.stderr)
 
     plan = make_plan(
         args.seed,
@@ -91,14 +160,29 @@ def main(argv: list[str] | None = None) -> int:
     for ev in plan.events:
         print(f"  {ev}", file=sys.stderr)
 
+    # A poison run needs restart budget for its strike/bisection crashes
+    # on top of the scheduled kills, and background requests caught in
+    # those crashes need matching retry headroom.
+    poison_crashes = (
+        args.max_suspect_strikes + 4
+        if args.poison_mode in ("raise", "hang_step") else 0)
     engine = AsyncLLM.from_engine_args(AsyncEngineArgs(
         model=args.model,
         max_model_len=args.max_model_len,
         data_parallel_engines=args.dp,
+        # Crash containment needs a real engine-core process to die and
+        # respawn; the in-process client has no recovery path.
+        distributed_executor_backend=(
+            "mp" if args.dp == 1 and args.poison_mode != "off"
+            else "uniproc"),
         enable_engine_recovery=True,
-        max_engine_restarts=max(4, 2 * args.engine_kills),
-        max_request_retries=2,
+        max_engine_restarts=max(4, 2 * args.engine_kills) + poison_crashes,
+        max_request_retries=2 + poison_crashes,
         restart_backoff_s=0.05,
+        max_suspect_strikes=args.max_suspect_strikes,
+        step_watchdog_s=(args.step_watchdog
+                         if args.poison_mode == "hang_step" else 0.0),
+        numeric_guard=(args.poison_mode == "nan"),
     ))
     try:
         report = asyncio.run(run_chaos(
@@ -107,7 +191,12 @@ def main(argv: list[str] | None = None) -> int:
             max_tokens=args.max_tokens,
             concurrency=args.concurrency,
             request_timeout_s=args.request_timeout,
+            poison_request_id=poison_rid,
         ))
+        poison_ok = True
+        if poison_rid is not None:
+            poison_ok = _check_poison(
+                engine, report, poison_rid, args.poison_mode)
     finally:
         engine.shutdown()
 
@@ -121,8 +210,9 @@ def main(argv: list[str] | None = None) -> int:
             f"outcomes={summary['outcomes']} wall={report.wall_s:.1f}s")
     for v in report.ledger.violations:
         print(f"VIOLATION: {v}", file=sys.stderr)
-    print("ok" if report.ok else "FAILED", file=sys.stderr)
-    return 0 if report.ok else 1
+    ok = report.ok and poison_ok
+    print("ok" if ok else "FAILED", file=sys.stderr)
+    return 0 if ok else 1
 
 
 if __name__ == "__main__":

@@ -49,6 +49,14 @@ REQUIRED_CHAOS_METRICS = {
     "vllm:failpoints_fired_total",
 }
 
+# Documented in the README ("Execution guards & quarantine");
+# the quarantine chaos scenario asserts on these names.
+REQUIRED_CONTAINMENT_METRICS = {
+    "vllm:numeric_guard_trips_total",
+    "vllm:step_watchdog_trips_total",
+    "vllm:requests_quarantined_total",
+}
+
 
 def check() -> list[str]:
     """Return a list of lint errors (empty = clean)."""
@@ -106,6 +114,10 @@ def check() -> list[str]:
     for name in sorted(REQUIRED_CHAOS_METRICS - set(seen)):
         errors.append(
             f"required coordinator/chaos metric {name} is missing from "
+            f"the registry (documented in README)")
+    for name in sorted(REQUIRED_CONTAINMENT_METRICS - set(seen)):
+        errors.append(
+            f"required containment metric {name} is missing from "
             f"the registry (documented in README)")
 
     return errors

@@ -1,0 +1,175 @@
+#!/usr/bin/env python3
+"""Inspect and re-admit quarantined (dead-lettered) requests.
+
+The quarantine manager (``vllm_tpu/resilience/quarantine.py``) dead-
+letters a request that repeatedly crashed the engine executing it: one
+JSON record per request under ``<journal-dir>/deadletter/``, carrying
+the prompt token ids and the unspent token budget. This tool works on
+that directory (offline) or on a live server's ``GET /debug/deadletter``
+(read-only):
+
+    python tools/deadletter.py list --journal-dir /var/lib/vllm/journal
+    python tools/deadletter.py list --url http://localhost:8000
+    python tools/deadletter.py show  <request-id> --journal-dir DIR
+    python tools/deadletter.py readmit <request-id> --journal-dir DIR \
+        --url http://localhost:8000 [--model NAME] [--keep]
+
+``readmit`` resubmits the recorded prompt to a running server (e.g.
+after the bug the request tickled was fixed) via ``/v1/completions``
+and, on success, removes the dead-letter record (``--keep`` retains
+it). Stdlib only — no client dependencies.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import urllib.error
+import urllib.request
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO_ROOT not in sys.path:
+    sys.path.insert(0, _REPO_ROOT)
+
+
+def _store(journal_dir: str):
+    from vllm_tpu.resilience.quarantine import DeadLetterStore
+
+    return DeadLetterStore(journal_dir)
+
+
+def _fetch_url(url: str) -> list[dict]:
+    with urllib.request.urlopen(
+            url.rstrip("/") + "/debug/deadletter", timeout=10) as resp:
+        body = json.load(resp)
+    return body.get("records", [])
+
+
+def _load_records(args) -> list[dict]:
+    if args.journal_dir:
+        return _store(args.journal_dir).list()
+    return _fetch_url(args.url)
+
+
+def cmd_list(args) -> int:
+    records = _load_records(args)
+    if not records:
+        print("dead-letter store is empty")
+        return 0
+    for rec in records:
+        print(
+            f"{rec.get('request_id')}  strikes={rec.get('strikes')}  "
+            f"prompt_tokens={len(rec.get('prompt_token_ids') or [])}  "
+            f"quarantined_at={rec.get('quarantined_at')}"
+        )
+    return 0
+
+def cmd_show(args) -> int:
+    records = _load_records(args)
+    for rec in records:
+        if rec.get("request_id") == args.request_id:
+            print(json.dumps(rec, indent=2, default=str))
+            return 0
+    print(f"no dead-letter record for {args.request_id!r}",
+          file=sys.stderr)
+    return 1
+
+
+def cmd_readmit(args) -> int:
+    store = _store(args.journal_dir)
+    rec = store.get(args.request_id)
+    if rec is None:
+        print(f"no dead-letter record for {args.request_id!r}",
+              file=sys.stderr)
+        return 1
+    prompt = rec.get("prompt_token_ids")
+    if not prompt and not rec.get("prompt_text"):
+        print("record carries no prompt; cannot re-admit",
+              file=sys.stderr)
+        return 1
+    if args.url:
+        emitted = rec.get("emitted_token_ids") or []
+        max_tokens = rec.get("max_tokens")
+        if max_tokens is not None:
+            max_tokens = max(1, max_tokens - len(emitted))
+        payload = {
+            # Resume where the dead request left off, like a journal
+            # replay: original prompt + already-emitted tokens.
+            "prompt": (list(prompt) + list(emitted)) if prompt
+            else rec["prompt_text"],
+            "max_tokens": max_tokens if max_tokens is not None else 16,
+        }
+        if args.model:
+            payload["model"] = args.model
+        req = urllib.request.Request(
+            args.url.rstrip("/") + "/v1/completions",
+            data=json.dumps(payload).encode(),
+            headers={"Content-Type": "application/json"},
+        )
+        try:
+            with urllib.request.urlopen(req, timeout=args.timeout) as resp:
+                body = json.load(resp)
+        except urllib.error.HTTPError as e:
+            print(f"re-admission failed: HTTP {e.code} {e.read()!r}",
+                  file=sys.stderr)
+            return 1
+        text = ""
+        try:
+            text = body["choices"][0].get("text", "")
+        except (KeyError, IndexError):
+            pass
+        print(f"re-admitted {args.request_id}: {text!r}")
+    else:
+        print(f"no --url given: releasing {args.request_id} from the "
+              "dead-letter store without resubmitting")
+    if not args.keep:
+        store.remove(args.request_id)
+        print(f"removed dead-letter record for {args.request_id}")
+    return 0
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(
+        description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    sub = parser.add_subparsers(dest="cmd", required=True)
+
+    def add_source(p, need_dir: bool = False):
+        p.add_argument("--journal-dir", default=None,
+                       help="journal directory (reads <dir>/deadletter/)")
+        p.add_argument("--url", default=None,
+                       help="base URL of a running server")
+        p.set_defaults(_need_dir=need_dir)
+
+    p = sub.add_parser("list", help="list dead-lettered requests")
+    add_source(p)
+    p.set_defaults(func=cmd_list)
+
+    p = sub.add_parser("show", help="dump one record as JSON")
+    p.add_argument("request_id")
+    add_source(p)
+    p.set_defaults(func=cmd_show)
+
+    p = sub.add_parser(
+        "readmit", help="resubmit a dead-lettered request and clear it")
+    p.add_argument("request_id")
+    add_source(p, need_dir=True)
+    p.add_argument("--model", default=None,
+                   help="model name for the completion payload")
+    p.add_argument("--timeout", type=float, default=120.0)
+    p.add_argument("--keep", action="store_true",
+                   help="keep the dead-letter record after re-admission")
+    p.set_defaults(func=cmd_readmit)
+
+    args = parser.parse_args(argv)
+    if args.journal_dir is None and (args._need_dir or args.url is None):
+        parser.error(
+            "--journal-dir is required"
+            + ("" if args._need_dir else " (or --url)"))
+    return args.func(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

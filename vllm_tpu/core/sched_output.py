@@ -115,6 +115,10 @@ class ModelRunnerOutput:
     # scheduler reschedules them for recompute (reference: invalid-block
     # recovery, scheduler.py:2123/2226).
     invalid_req_ids: set[str] = field(default_factory=set)
+    # Requests whose numeric-integrity guard tripped this step (NaN/Inf
+    # logits or out-of-range sampled token): terminal per-request error
+    # (finish_reason="error"), never an engine failure.
+    numeric_error_req_ids: set[str] = field(default_factory=set)
 
 
 EMPTY_MODEL_RUNNER_OUTPUT = ModelRunnerOutput()
@@ -165,6 +169,10 @@ class SchedulerStats:
     bucket_compiles: int = 0
     bucket_hits: int = 0
     pipeline_stall_s: float = 0.0
+    # Numeric-guard trips (cumulative, by kind: "nan" / "sampled") and
+    # step-watchdog trips, attached by EngineCore from the runner.
+    numeric_guard_trips: dict[str, int] = field(default_factory=dict)
+    step_watchdog_trips: int = 0
     # Engine-step phase durations (drained each snapshot, seconds) —
     # attached by EngineCore from the schedule/dispatch/finalize sites;
     # feed the vllm:engine_step_duration_seconds histogram family.

@@ -867,6 +867,25 @@ class Scheduler:
                 # drain its placeholders without materializing tokens.
                 self._drain_invalid(request, req_id, runner_output, req_index)
                 continue
+            if req_id in runner_output.numeric_error_req_ids:
+                # Numeric guard tripped on this request's row (NaN/Inf
+                # logits or out-of-range sampled token): terminal
+                # per-request error — the batch's other rows and the
+                # engine itself keep going.
+                request.status = RequestStatus.FINISHED_ERROR
+                if request in self.running:
+                    self.running.remove(request)
+                elif request in self.waiting:
+                    self.waiting.remove(request)
+                self._free_request(request)
+                outputs.append(
+                    EngineCoreOutput(
+                        req_id=req_id,
+                        new_token_ids=[],
+                        finish_reason=request.get_finished_reason(),
+                    )
+                )
+                continue
             if req_id in scheduler_output.kv_connector_load:
                 # The step that performed this request's external KV load
                 # finalized clean: its span is trustworthy, lift the

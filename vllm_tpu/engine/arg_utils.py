@@ -94,6 +94,13 @@ class EngineArgs:
     max_coordinator_restarts: int = 10
     coordinator_stale_after_s: float = 5.0
     journal_dir: str | None = None
+    # Execution-layer fault containment (PR 5): step watchdog, restart
+    # budget healing, numeric guards, poison-request quarantine.
+    step_watchdog_s: float = 0.0
+    restart_budget_heal_s: float = 0.0
+    numeric_guard: bool = False
+    max_suspect_strikes: int = 2
+    quarantine_probation_cap: int = 8
 
     # Lifecycle (vllm_tpu/resilience/lifecycle): overload protection.
     # All off by default; see LifecycleConfig for semantics.
@@ -199,6 +206,11 @@ class EngineArgs:
                 max_coordinator_restarts=self.max_coordinator_restarts,
                 coordinator_stale_after_s=self.coordinator_stale_after_s,
                 journal_dir=self.journal_dir,
+                step_watchdog_s=self.step_watchdog_s,
+                restart_budget_heal_s=self.restart_budget_heal_s,
+                numeric_guard=self.numeric_guard,
+                max_suspect_strikes=self.max_suspect_strikes,
+                quarantine_probation_cap=self.quarantine_probation_cap,
             ),
             lifecycle_config=LifecycleConfig(
                 max_inflight_requests=self.max_inflight_requests,

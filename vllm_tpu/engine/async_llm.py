@@ -30,6 +30,7 @@ from vllm_tpu.resilience import (
     TIMEOUT_FINISH_REASON,
     AdmissionController,
     EngineRestartedError,
+    QuarantineManager,
     RequestFailedOnCrashError,
     RequestJournal,
     SlowClientError,
@@ -157,11 +158,25 @@ class AsyncLLM:
             or self.resilience.journal_dir is not None
             else None
         )
+        # Poison-request bisection & quarantine: strike accounting over
+        # crash suspect sets; a request repeatedly implicated in engine
+        # deaths is dead-lettered instead of crash-looping the engine
+        # (vllm_tpu/resilience/quarantine).
+        self.quarantine = (
+            QuarantineManager(
+                max_suspect_strikes=self.resilience.max_suspect_strikes,
+                probation_cap=self.resilience.quarantine_probation_cap,
+                persist_dir=self.resilience.journal_dir,
+                on_release=self._release_held_requests,
+            )
+            if self.resilience.enable_recovery
+            else None
+        )
         self.engine_core = make_client(config)
         self.input_processor = InputProcessor(config)
         self.output_processor = OutputProcessor(
             self.input_processor.tokenizer, journal=self.journal,
-            on_request_closed=self.admission.release,
+            on_request_closed=self._on_request_closed,
         )
         self.stat_loggers: list[Any] = []
 
@@ -174,6 +189,10 @@ class AsyncLLM:
         self.timeouts_total: dict[str, int] = {}
         self.stream_drops_total = 0
         self.slow_client_aborts_total = 0
+        # Journal replays skipped because the client aborted the request
+        # between the crash and its re-admission (satellite fix: a stale
+        # replay would generate for a consumer that already left).
+        self.replays_dropped_aborted_total = 0
         self._last_deadline_sweep = 0.0
         if start:
             self.start()
@@ -281,6 +300,22 @@ class AsyncLLM:
         self.output_processor.abort_requests(request_ids)
         if not self._dead:
             self._input_queue.put(("abort", request_ids))
+
+    def _on_request_closed(self, request_id: str) -> None:
+        """OutputProcessor callback: the request reached a terminal state
+        (final output delivered or aborted). Frees its admission slot and
+        clears its quarantine strikes — a request that terminated cleanly
+        cannot be the deterministic poison."""
+        self.admission.release(request_id)
+        if self.quarantine is not None:
+            self.quarantine.note_terminal(request_id)
+
+    def _release_held_requests(self, req_ids: list[str]) -> None:
+        """Quarantine callback: the bisection probe resolved, the held
+        half may re-admit. May fire on any thread (terminal notifications
+        come from both the busy loop and the event loop), so only enqueue
+        — the busy loop replays them with full journal checks."""
+        self._input_queue.put(("release", list(req_ids)))
 
     # -- slow-client backpressure (callbacks from AsyncStream) ---------
 
@@ -405,63 +440,114 @@ class AsyncLLM:
 
     def _recover_requests(self, err: EngineRestartedError) -> None:
         """Requests lost with a crashed engine are replayed from the
-        journal (resuming from the tokens already delivered) or failed
-        with a per-request error — never silently hung."""
+        journal (resuming from the tokens already delivered), parked or
+        dead-lettered by the quarantine bisection, or failed with a
+        per-request error — never silently hung."""
         logger.warning(
-            "engine core %d restarted; recovering %d in-flight requests",
-            err.engine_id, len(err.lost_req_ids),
+            "engine core %d restarted (%s); recovering %d in-flight "
+            "requests", err.engine_id,
+            "device hang" if err.hang else "crash",
+            len(err.lost_req_ids),
         )
+        dispositions: dict[str, str] = {}
+        if self.quarantine is not None and err.lost_req_ids:
+            dispositions = self.quarantine.on_crash(
+                err.lost_req_ids, err.suspect_req_ids
+            )
         for rid in err.lost_req_ids:
+            disposition = dispositions.get(rid, "replay")
             state = self.output_processor.request_states.get(rid)
             if state is None:
                 # Aborted/finished while the crash was being handled.
                 if self.journal is not None:
                     self.journal.discard(rid)
+                if self.quarantine is not None:
+                    self.quarantine.note_terminal(rid)
+                self.replays_dropped_aborted_total += 1
                 continue
-            entry = (
-                self.journal.get(rid) if self.journal is not None else None
+            if disposition == "deadletter":
+                entry = (
+                    self.journal.get(rid)
+                    if self.journal is not None else None
+                )
+                rec = self.quarantine.note_deadlettered(
+                    rid, entry, str(err))
+                self._fail_request(
+                    rid, state,
+                    (entry.retries + 1) if entry is not None else 1,
+                    f"quarantined as poison request after "
+                    f"{rec['strikes']} crash strike(s); dead-lettered",
+                )
+                continue
+            if disposition == "hold":
+                # Parked: journal entry and stream stay open; re-admitted
+                # via _release_held_requests when the probe resolves.
+                continue
+            # Bisection-probe replays bypass the generic retry budget —
+            # the strike cap bounds them instead (a poison request must
+            # stay replayable long enough to be isolated). Ordinary
+            # one-strike suspects still spend from max_request_retries.
+            self._replay_or_fail(
+                rid, state,
+                bypass_retry_budget=(
+                    self.quarantine is not None
+                    and self.quarantine.is_probing(rid)
+                ),
             )
-            if entry is None:
-                self._fail_request(rid, state, 1, "no journal entry")
-                continue
-            remaining = entry.remaining_tokens
-            if remaining is not None and remaining <= 0:
-                # Full budget already delivered: close the stream out as
-                # a normal length finish instead of replaying a request
-                # that has nothing left to generate.
-                self.output_processor.process_outputs([
-                    EngineCoreOutput(
-                        req_id=rid, new_token_ids=[],
-                        finish_reason="length",
-                    )
-                ])
-            elif not entry.replayable:
-                self._fail_request(
-                    rid, state, entry.retries + 1,
-                    "structured-output requests cannot be resumed",
+
+    def _replay_or_fail(self, rid: str, state,
+                        bypass_retry_budget: bool = False) -> None:
+        entry = (
+            self.journal.get(rid) if self.journal is not None else None
+        )
+        if entry is None:
+            self._fail_request(rid, state, 1, "no journal entry")
+            return
+        remaining = entry.remaining_tokens
+        if remaining is not None and remaining <= 0:
+            # Full budget already delivered: close the stream out as
+            # a normal length finish instead of replaying a request
+            # that has nothing left to generate.
+            self.output_processor.process_outputs([
+                EngineCoreOutput(
+                    req_id=rid, new_token_ids=[],
+                    finish_reason="length",
                 )
-            elif entry.retries >= self.resilience.max_request_retries:
-                self._fail_request(
-                    rid, state, entry.retries + 1,
-                    "crash-replay budget exhausted",
-                )
-            else:
-                self.journal.note_replayed(rid)
-                logger.info(
-                    "replaying request %s onto recovered engine "
-                    "(attempt %d/%d, resuming after %d emitted tokens)",
-                    rid, entry.retries,
-                    self.resilience.max_request_retries,
-                    len(entry.emitted_token_ids),
-                )
-                self._input_queue.put(("add", entry.make_resume_request()))
+            ])
+        elif not entry.replayable:
+            self._fail_request(
+                rid, state, entry.retries + 1,
+                "structured-output requests cannot be resumed",
+            )
+        elif (
+            entry.retries >= self.resilience.max_request_retries
+            and not bypass_retry_budget
+        ):
+            self._fail_request(
+                rid, state, entry.retries + 1,
+                "crash-replay budget exhausted",
+            )
+        else:
+            self.journal.note_replayed(rid)
+            logger.info(
+                "replaying request %s onto recovered engine "
+                "(attempt %d/%d, resuming after %d emitted tokens)",
+                rid, entry.retries,
+                self.resilience.max_request_retries,
+                len(entry.emitted_token_ids),
+            )
+            # "replay" (not "add"): re-checked against the live request
+            # set at drain time — an abort landing between here and the
+            # actual add must not resurrect the request engine-side.
+            self._input_queue.put(
+                ("replay", (rid, entry.make_resume_request())))
 
     def _fail_request(self, rid: str, state, attempts: int,
                       detail: str) -> None:
         if self.journal is not None:
             self.journal.note_failed(rid)
         self.output_processor.request_states.pop(rid, None)
-        self.admission.release(rid)
+        self._on_request_closed(rid)
         err = RequestFailedOnCrashError(rid, attempts, detail)
         logger.error("%s", err)
         if state.queue is not None:
@@ -476,6 +562,39 @@ class AsyncLLM:
             try:
                 if op == "add":
                     self.engine_core.add_request(payload)
+                elif op == "replay":
+                    # Journal replay of a crash-interrupted request. The
+                    # client may have aborted it between the crash and
+                    # this drain (the abort already tore down its state)
+                    # — re-admitting would create a consumer-less ghost
+                    # request, so drop the replay and count it.
+                    rid, req = payload
+                    if rid in self.output_processor.request_states:
+                        self.engine_core.add_request(req)
+                    else:
+                        self.replays_dropped_aborted_total += 1
+                        if self.journal is not None:
+                            self.journal.discard(rid)
+                        if self.quarantine is not None:
+                            self.quarantine.note_terminal(rid)
+                        logger.info(
+                            "dropping journal replay of %s: aborted "
+                            "before re-admission", rid)
+                elif op == "release":
+                    # Quarantine released held suspects: replay them with
+                    # the full journal checks, on this thread.
+                    for rid in payload:
+                        state = (
+                            self.output_processor.request_states.get(rid))
+                        if state is None:
+                            self.replays_dropped_aborted_total += 1
+                            if self.journal is not None:
+                                self.journal.discard(rid)
+                            if self.quarantine is not None:
+                                self.quarantine.note_terminal(rid)
+                            continue
+                        self._replay_or_fail(
+                            rid, state, bypass_retry_budget=True)
                 elif op == "abort":
                     self.engine_core.abort_requests(payload)
                 elif op == "finish":
@@ -502,7 +621,9 @@ class AsyncLLM:
                 # state died with the engine); an add must not be lost —
                 # requeue it, then let the busy loop recover the rest.
                 # A drain "finish" hasn't closed its streams yet: requeue.
-                if op in ("add", "finish"):
+                # A "replay"/"release" hadn't reached the engine, so its
+                # request is not in the crash's lost set: requeue too.
+                if op in ("add", "finish", "replay", "release"):
                     self._input_queue.put((op, payload))
                 raise
             try:
@@ -606,6 +727,31 @@ class AsyncLLM:
                 self.journal.requests_lost_on_restart_total
                 if self.journal is not None else 0
             ),
+            # Step-watchdog trips observed client-side (MP engines that
+            # hard-exited on a wedged device step).
+            "step_watchdog_trips_total": getattr(
+                self.engine_core, "watchdog_trips", 0),
+            "replays_dropped_aborted_total": (
+                self.replays_dropped_aborted_total),
+            "requests_quarantined_total": (
+                self.quarantine.requests_quarantined_total
+                if self.quarantine is not None else 0
+            ),
+            "quarantine": (
+                self.quarantine.status()
+                if self.quarantine is not None else None
+            ),
+        }
+
+    def debug_deadletter(self) -> dict:
+        """Dead-letter introspection (/debug/deadletter): quarantined
+        poison requests with their strike history."""
+        if self.quarantine is None:
+            return {"enabled": False, "records": []}
+        return {
+            "enabled": True,
+            "records": self.quarantine.deadletter.list(),
+            "quarantine": self.quarantine.status(),
         }
 
     def debug_requests(self) -> dict:

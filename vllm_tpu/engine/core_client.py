@@ -180,8 +180,17 @@ class _ZMQClientBase:
                 kind = frames[0]
                 if kind == self._proc_mod.MSG_DEAD:
                     eid = int(frames[2]) if len(frames) > 2 else 0
+                    # Optional fourth frame: request ids in flight at
+                    # death (the quarantine suspect set).
+                    suspects = None
+                    if len(frames) > 3:
+                        try:
+                            suspects = self._serial.decode(frames[3])
+                        except Exception:
+                            suspects = None
                     self._handle_engine_death(
-                        [eid], f"engine core died:\n{frames[1].decode()}"
+                        [eid], f"engine core died:\n{frames[1].decode()}",
+                        suspects=suspects,
                     )
                     continue  # unreachable (death handler raises)
                 if kind == self._proc_mod.MSG_READY and self._started:
@@ -210,11 +219,22 @@ class _ZMQClientBase:
             )
 
     def _handle_engine_death(self, engine_ids: list[int],
-                             reason: str) -> None:
+                             reason: str,
+                             suspects: list[str] | None = None) -> None:
         """Dead engine(s) detected. Always raises: EngineDeadError when
         recovery is off / mid-init / budget-exhausted (reference
         semantics), EngineRestartedError (with the interrupted request
-        ids) after a successful respawn kick-off."""
+        ids) after a successful respawn kick-off.
+
+        ``suspects`` is the batch that was on the device at death (from
+        the MSG_DEAD suspect frame); None means the death carried no
+        batch info (SIGKILL, proc-exit detection) and the conservative
+        default — every lost request is a suspect — applies."""
+        hang = "device hang" in reason
+        if hang:
+            # Distinct failure class from busy-loop heartbeat loss: the
+            # step watchdog inside the engine proc fired and hard-exited.
+            self.watchdog_trips = getattr(self, "watchdog_trips", 0) + 1
         if (
             not self._started
             or self._closing
@@ -239,7 +259,8 @@ class _ZMQClientBase:
             )
             lost.extend(self._respawn_engine(eid))
         raise EngineRestartedError(
-            lost, engine_id=engine_ids[0], reason=reason.splitlines()[0]
+            lost, engine_id=engine_ids[0], reason=reason.splitlines()[0],
+            suspect_req_ids=suspects, hang=hang,
         )
 
     def _drain_stale_outputs(self, lost: set[str]) -> None:

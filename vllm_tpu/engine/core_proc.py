@@ -122,10 +122,62 @@ def run_engine_core(config_bytes: bytes, input_addr: str,
                     last_load = None
                 coord_epoch = epoch
 
+    def send_dead(reason: str, suspects: list[str]) -> None:
+        # Third frame identifies WHICH engine died so the DP client's
+        # supervisor respawns the right rank; fourth carries the request
+        # ids that were in flight at death — the quarantine manager's
+        # suspect set for poison-request bisection.
+        out.send_multipart([
+            MSG_DEAD,
+            reason.encode(),
+            str(engine_id).encode(),
+            serial_utils.encode(suspects),
+        ])
+
+    def install_watchdog_escalation(engine_core) -> None:
+        """Make a step-watchdog trip look like an engine crash.
+
+        The watchdog thread can't reuse ``out`` (ZMQ sockets are not
+        thread-safe) so it opens its own PUSH socket for the one dying
+        message, then hard-exits: the busy loop is wedged inside the
+        device step and will never unwind through the normal exception
+        path.
+        """
+        runner = getattr(
+            getattr(engine_core.executor, "worker", None), "runner", None
+        )
+        watchdog = getattr(runner, "watchdog", None)
+        if watchdog is None:
+            return
+
+        def escalate(req_ids: list[str], elapsed: float) -> None:
+            try:
+                suspects = engine_core.suspect_req_ids() or list(req_ids)
+            except Exception:
+                suspects = list(req_ids)
+            try:
+                death = ctx.socket(zmq.PUSH)
+                death.connect(output_addr)
+                death.send_multipart([
+                    MSG_DEAD,
+                    (f"device hang: step exceeded "
+                     f"{watchdog.timeout_s:.1f}s watchdog deadline "
+                     f"(elapsed {elapsed:.1f}s)").encode(),
+                    str(engine_id).encode(),
+                    serial_utils.encode(suspects),
+                ])
+                death.close(linger=1000)
+            except Exception:
+                logger.exception("watchdog escalation send failed")
+            os._exit(1)
+
+        watchdog.on_trip = escalate
+
     core = None
     try:
         config = pickle.loads(config_bytes)
         core = EngineCore(config)
+        install_watchdog_escalation(core)
         out.send_multipart([
             MSG_READY,
             serial_utils.encode(
@@ -212,11 +264,13 @@ def run_engine_core(config_bytes: bytes, input_addr: str,
         tb = traceback.format_exc()
         logger.error("engine core proc died:\n%s", tb)
         try:
-            # Third frame identifies WHICH engine died so the DP client's
-            # supervisor respawns the right rank.
-            out.send_multipart(
-                [MSG_DEAD, tb.encode(), str(engine_id).encode()]
-            )
+            suspects: list[str] = []
+            if core is not None:
+                try:
+                    suspects = core.suspect_req_ids()
+                except Exception:
+                    suspects = []
+            send_dead(tb, suspects)
         except Exception:
             pass
     finally:

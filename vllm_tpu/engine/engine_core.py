@@ -51,6 +51,13 @@ class EngineCore:
 
             scheduler_cls = AsyncScheduler
         self._inflight: deque = deque()
+        # The step currently inside executor.dispatch()/finalize() — not
+        # (or no longer) tracked by _inflight, but very much on the
+        # device. suspect_req_ids() must see it: a crash that unwinds
+        # out of dispatch would otherwise blame only the PREVIOUS
+        # pipelined batch and the quarantine would strike innocents
+        # while the poison batch goes unrecorded.
+        self._executing: SchedulerOutput | None = None
         # Cumulative seconds blocked fetching device results (lag-pipeline
         # stall; exported via SchedulerStats.pipeline_stall_s).
         self._stall_s = 0.0
@@ -245,6 +252,10 @@ class EngineCore:
                 f"{scheduler_output.total_num_scheduled_tokens}",
             )
             t0 = time.monotonic()
+            # Track the batch across the dispatch call: if it raises (or
+            # wedges under the step watchdog), suspect_req_ids() must
+            # report THIS batch, which _inflight does not know yet.
+            self._executing = scheduler_output
             with trace_span(
                 "dispatch",
                 tokens=scheduler_output.total_num_scheduled_tokens,
@@ -257,10 +268,13 @@ class EngineCore:
                 scheduler_output.num_reqs,
             )
             self._inflight.append((scheduler_output, handle))
+            self._executing = None
         if not self._inflight:
             failed = self.scheduler.drain_failed()
             return failed if failed is not None else EngineCoreOutputs()
-        scheduler_output, handle = self._inflight.popleft()
+        # Peek, finalize, then pop: a crash inside finalize() must still
+        # attribute THIS batch (suspect_req_ids walks _inflight).
+        scheduler_output, handle = self._inflight[0]
         fail_point("engine_core.step.finalize")
         with trace_span("finalize"):
             t0 = time.monotonic()
@@ -269,6 +283,7 @@ class EngineCore:
             # is winning, the whole device step when it is not.
             stall = time.monotonic() - t0
             self._stall_s += stall
+        self._inflight.popleft()
         self._phase_times["finalize"].append(stall)
         outputs = self.scheduler.update_from_output(
             scheduler_output, runner_output
@@ -335,6 +350,32 @@ class EngineCore:
         if runner is not None:
             stats.bucket_compiles = getattr(runner, "bucket_compiles", 0)
             stats.bucket_hits = getattr(runner, "bucket_hits", 0)
+            stats.numeric_guard_trips = dict(
+                getattr(runner, "numeric_guard_trips", {})
+            )
+            watchdog = getattr(runner, "watchdog", None)
+            if watchdog is not None:
+                stats.step_watchdog_trips = watchdog.trips
+
+    def suspect_req_ids(self) -> list[str]:
+        """Request ids that were scheduled on the device when this call
+        runs — the suspect set attached to a crash/hang notification so
+        the frontend's quarantine can attribute the death to the batch
+        that was executing, not every journaled request. The batch whose
+        dispatch is unwinding (``_executing``) comes first: it is the
+        most likely culprit and is NOT in ``_inflight`` yet."""
+        ids: list[str] = []
+        executing = self._executing
+        if executing is not None:
+            ids.extend(executing.num_scheduled_tokens.keys())
+        for scheduler_output, _handle in self._inflight:
+            ids.extend(scheduler_output.num_scheduled_tokens.keys())
+        # A crash outside any dispatch/finalize (scheduler bug, stats
+        # path) leaves both empty; fall back to the running batch.
+        if not ids:
+            ids = [r.request_id for r in self.scheduler.running]
+        seen: set[str] = set()
+        return [r for r in ids if not (r in seen or seen.add(r))]
 
     def reset_prefix_cache(self) -> bool:
         ok = self.scheduler.kv_cache_manager.reset_prefix_cache()

@@ -605,6 +605,19 @@ async def handle_debug_requests(request: web.Request) -> web.Response:
     return web.json_response(snapshot)
 
 
+async def handle_debug_deadletter(request: web.Request) -> web.Response:
+    """Dead-letter introspection: requests quarantined as poison (they
+    repeatedly crashed the engine that executed them), with strike
+    history and the live bisection state. Re-admission goes through
+    ``tools/deadletter.py``."""
+    engine: AsyncLLM = request.app[ENGINE_KEY]
+    if not hasattr(engine, "debug_deadletter"):
+        return web.json_response(
+            {"error": "engine does not support quarantine introspection"},
+            status=501)
+    return web.json_response(engine.debug_deadletter())
+
+
 async def handle_metrics(request: web.Request) -> web.Response:
     reg = request.app.get(METRICS_KEY)
     text = reg.render() if reg is not None else ""
@@ -729,6 +742,7 @@ def build_app(engine: AsyncLLM, model_name: str, metrics=None,
     app.router.add_get("/ready", handle_ready)
     app.router.add_get("/metrics", handle_metrics)
     app.router.add_get("/debug/requests", handle_debug_requests)
+    app.router.add_get("/debug/deadletter", handle_debug_deadletter)
     from vllm_tpu.entrypoints.openai.extra_apis import (
         handle_realtime,
         handle_responses,

@@ -79,6 +79,10 @@ _readers: dict[str, Callable[[], Any]] = {
     # Count NaNs in the step logits and log an error when any appear
     # (reference: _get_nans_in_logits, gpu_model_runner.py:5193).
     "VLLM_TPU_NAN_CHECK": _bool("VLLM_TPU_NAN_CHECK", False),
+    # Numeric integrity guard (env override of --numeric-guard): per-row
+    # isfinite reduction on step logits + sampled-token range check; a
+    # trip fails only the afflicted requests with finish_reason="error".
+    "VLLM_TPU_NUMERIC_GUARD": _bool("VLLM_TPU_NUMERIC_GUARD", False),
     # Opt-out local usage telemetry (reference: VLLM_NO_USAGE_STATS).
     "VLLM_TPU_NO_USAGE_STATS": _bool("VLLM_TPU_NO_USAGE_STATS", False),
     # Disable the C++ host-prep fast path (pure-python fallback).

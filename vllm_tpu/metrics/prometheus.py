@@ -298,6 +298,20 @@ class PrometheusRegistry:
         self.inflight_prompt_tokens = Gauge(
             "vllm:inflight_prompt_tokens",
             "Prompt tokens reserved by admitted in-flight requests")
+        # Execution-layer fault containment (PR 5): numeric guards,
+        # step watchdog, poison-request quarantine.
+        self.numeric_guard_trips = LabeledCounter(
+            "vllm:numeric_guard_trips_total",
+            "Requests failed by the numeric integrity guard "
+            "(nan = non-finite logits row, sampled = out-of-range token)",
+            "kind")
+        self.step_watchdog_trips = Counter(
+            "vllm:step_watchdog_trips_total",
+            "Device steps that exceeded the step-watchdog deadline "
+            "(wedged device step, escalated to an engine restart)")
+        self.requests_quarantined = Counter(
+            "vllm:requests_quarantined_total",
+            "Requests dead-lettered by poison-request quarantine")
         self._metrics = [
             self.num_running, self.num_waiting, self.kv_usage,
             self.prefix_queries, self.prefix_hits, self.preempted,
@@ -318,6 +332,8 @@ class PrometheusRegistry:
             self.requests_shed, self.request_timeouts,
             self.stream_outputs_dropped, self.slow_client_aborts,
             self.lifecycle_draining, self.inflight_prompt_tokens,
+            self.numeric_guard_trips, self.step_watchdog_trips,
+            self.requests_quarantined,
         ]
         self._engine = engine
         self._last_prefix = (0, 0)
@@ -369,6 +385,11 @@ class PrometheusRegistry:
             self.batch_requests.set(s.batch_num_reqs)
             self.batch_occupancy.set(s.batch_occupancy)
             self.step_interval.set(s.step_interval_s)
+            # Runner-side cumulative counters (cross the proc boundary
+            # inside SchedulerStats): ratchet, never assign.
+            for kind, n in s.numeric_guard_trips.items():
+                self.numeric_guard_trips.inc_to(kind, float(n))
+            self.step_watchdog_trips.inc_to(float(s.step_watchdog_trips))
         if iteration_stats is not None:
             self.generation_tokens.inc(iteration_stats.num_generation_tokens)
             self.prompt_tokens.inc(iteration_stats.num_prompt_tokens)
@@ -401,6 +422,13 @@ class PrometheusRegistry:
             float(status.get("requests_failed_on_crash_total", 0)))
         self.requests_lost_on_restart.inc_to(
             float(status.get("requests_lost_on_restart_total", 0)))
+        self.requests_quarantined.inc_to(
+            float(status.get("requests_quarantined_total", 0)))
+        # MP engines hard-exit on a watchdog trip (their stats never
+        # flow), so the client-side count is the authoritative source
+        # there; in-proc trips arrive via SchedulerStats instead.
+        self.step_watchdog_trips.inc_to(
+            float(status.get("step_watchdog_trips_total", 0)))
         coord = status.get("coordinator")
         if coord is not None:
             self.coordinator_up.set(1.0 if coord.get("up") else 0.0)

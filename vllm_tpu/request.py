@@ -25,6 +25,10 @@ class RequestStatus(enum.IntEnum):
     FINISHED_LENGTH_CAPPED = 4
     FINISHED_ABORTED = 5
     FINISHED_IGNORED = 6
+    # Terminal execution error contained to this request (numeric-guard
+    # trip: NaN/Inf logits or an out-of-range sampled token) — the engine
+    # keeps serving everything else.
+    FINISHED_ERROR = 7
 
     @staticmethod
     def is_finished(status: "RequestStatus") -> bool:
@@ -36,6 +40,7 @@ _FINISH_REASON = {
     RequestStatus.FINISHED_LENGTH_CAPPED: "length",
     RequestStatus.FINISHED_ABORTED: "abort",
     RequestStatus.FINISHED_IGNORED: "length",
+    RequestStatus.FINISHED_ERROR: "error",
 }
 
 

@@ -51,6 +51,10 @@ from vllm_tpu.resilience.lifecycle import (
     SlowClientError,
     make_shed_error,
 )
+from vllm_tpu.resilience.quarantine import (
+    DeadLetterStore,
+    QuarantineManager,
+)
 from vllm_tpu.resilience.supervisor import EngineSupervisor
 
 
@@ -64,13 +68,24 @@ class EngineRestartedError(RuntimeError):
     """
 
     def __init__(self, lost_req_ids: list[str], engine_id: int = 0,
-                 reason: str = "engine core restarted") -> None:
+                 reason: str = "engine core restarted",
+                 suspect_req_ids: list[str] | None = None,
+                 hang: bool = False) -> None:
         super().__init__(
             f"{reason} (engine {engine_id}, "
             f"{len(lost_req_ids)} in-flight requests interrupted)"
         )
         self.lost_req_ids = list(lost_req_ids)
         self.engine_id = engine_id
+        # The batch that was on the device when the engine died (None =
+        # unknown — proc vanished without a crash report; quarantine then
+        # conservatively treats every lost request as a suspect).
+        self.suspect_req_ids = (
+            list(suspect_req_ids) if suspect_req_ids is not None else None
+        )
+        # True when the death was a step-watchdog trip (wedged device
+        # step), not an exception unwinding through the busy loop.
+        self.hang = hang
 
 
 class RequestFailedOnCrashError(RuntimeError):
@@ -92,10 +107,12 @@ class RequestFailedOnCrashError(RuntimeError):
 
 __all__ = [
     "AdmissionController",
+    "DeadLetterStore",
     "EngineRestartedError",
     "EngineSupervisor",
     "JournalEntry",
     "LifecycleConfig",
+    "QuarantineManager",
     "RequestFailedOnCrashError",
     "RequestJournal",
     "RequestShedError",

@@ -290,6 +290,7 @@ async def run_chaos(
     concurrency: int = 4,
     request_timeout_s: float = 120.0,
     prompt_token_ids: list[int] | None = None,
+    poison_request_id: str | None = None,
 ) -> ChaosReport:
     """Stream a seeded workload through ``engine`` while ``plan``'s faults
     land, then sweep the invariants.
@@ -298,6 +299,13 @@ async def run_chaos(
     deterministically); request *interleaving* is of course scheduler-
     dependent — the invariants are exactly the properties that must hold
     under any interleaving.
+
+    ``poison_request_id`` injects one extra request with that exact id
+    ahead of the background traffic. Paired with a request-targeted
+    failpoint (``model_runner.step=raise@<id>``) it models a poison
+    request: every step that schedules it dies, and the quarantine
+    machinery must converge on dead-lettering it (terminal outcome
+    ERROR) while the background requests all finish.
     """
     from vllm_tpu.sampling_params import RequestOutputKind, SamplingParams
     from vllm_tpu.resilience.lifecycle import RequestShedError
@@ -308,8 +316,8 @@ async def run_chaos(
     sem = asyncio.Semaphore(concurrency)
     t0 = time.monotonic()
 
-    async def one_request(i: int) -> None:
-        rid = f"chaos-{plan.seed}-{i}"
+    async def one_request(i: int, rid: str | None = None) -> None:
+        rid = rid or f"chaos-{plan.seed}-{i}"
         params = SamplingParams(
             temperature=0.0,
             max_tokens=max(1, rng.randint(max_tokens // 2, max_tokens)),
@@ -353,6 +361,12 @@ async def run_chaos(
 
     async def workload() -> None:
         tasks = []
+        if poison_request_id is not None:
+            # Submitted first so the targeted failpoint has the whole run
+            # to converge; uses an index past the background range so the
+            # seeded size draw doesn't collide with request 0's.
+            tasks.append(asyncio.create_task(
+                one_request(num_requests, rid=poison_request_id)))
         for i in range(num_requests):
             tasks.append(asyncio.create_task(one_request(i)))
             # Seeded arrival jitter keeps faults landing between

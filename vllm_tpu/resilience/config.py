@@ -56,6 +56,34 @@ class ResilienceConfig:
     # None = in-memory journal only. Works with or without
     # enable_recovery (persistence alone creates a journal).
     journal_dir: str | None = None
+    # Step watchdog: if >0, a step whose device dispatch+finalize exceeds
+    # this many seconds is classified as a *device hang* (distinct from
+    # busy-loop heartbeat loss: the busy loop is alive, the accelerator
+    # is not) and escalates to a supervised engine restart annotated with
+    # the in-flight batch's request ids. Off by default — the first
+    # compile of a new bucket shape can legitimately take minutes, so
+    # set this well above worst-case compile time (or pre-warm).
+    step_watchdog_s: float = 0.0
+    # Restart-budget healing: if >0, one consumed restart unit is
+    # forgiven per this many seconds of healthy uptime, so long-running
+    # servers survive rare sporadic crashes instead of accumulating
+    # toward permanent death. 0 = never replenish (seed behavior).
+    restart_budget_heal_s: float = 0.0
+    # Numeric integrity guard: opt-in isfinite reduction on the step's
+    # logits inside the jitted step (rides the existing device-feedback
+    # fetch, no extra sync) plus a host-side sampled-token range check.
+    # A tripped guard fails only the afflicted requests
+    # (finish_reason="error"), never the engine.
+    numeric_guard: bool = False
+    # Poison-request quarantine: a request involved in this many engine
+    # deaths/hangs (strikes) becomes "hot"; a single hot suspect is
+    # dead-lettered, several hot suspects are bisected (replayed in
+    # halves) until the culprit is isolated.
+    max_suspect_strikes: int = 2
+    # Max suspect requests re-admitted concurrently during a bisection
+    # probe (the probation cap); the rest are held until the probe's
+    # requests reach a terminal state. 0 = no cap.
+    quarantine_probation_cap: int = 8
 
     def finalize(self) -> "ResilienceConfig":
         if self.max_engine_restarts < 0:
@@ -79,5 +107,24 @@ class ResilienceConfig:
             raise ValueError(
                 f"coordinator_stale_after_s must be > 0, got "
                 f"{self.coordinator_stale_after_s}"
+            )
+        if self.step_watchdog_s < 0:
+            raise ValueError(
+                f"step_watchdog_s must be >= 0, got {self.step_watchdog_s}"
+            )
+        if self.restart_budget_heal_s < 0:
+            raise ValueError(
+                f"restart_budget_heal_s must be >= 0, got "
+                f"{self.restart_budget_heal_s}"
+            )
+        if self.max_suspect_strikes < 1:
+            raise ValueError(
+                f"max_suspect_strikes must be >= 1, got "
+                f"{self.max_suspect_strikes}"
+            )
+        if self.quarantine_probation_cap < 0:
+            raise ValueError(
+                f"quarantine_probation_cap must be >= 0, got "
+                f"{self.quarantine_probation_cap}"
             )
         return self

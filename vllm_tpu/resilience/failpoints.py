@@ -12,12 +12,16 @@ are inert — strictly a module-flag check — unless activated through
 Grammar (sites separated by ``,``; per-site *terms* separated by ``;`` and
 evaluated in order):
 
-    term    := [count '*'] [prob '%'] action ['(' arg ')']
+    term    := [count '*'] [prob '%'] action ['(' arg ')'] ['@' match]
     count   := integer | 'once'        # term governs this many hits, then
                                        # control advances to the next term
     prob    := float                   # fire with this % probability per
                                        # governed hit (seeded, per-site RNG)
-    action  := raise | delay | hang | exit | drop | off
+    action  := raise | delay | hang | exit | drop | off | nan | hang_step
+    match   := substring               # term only governs hits whose call-
+                                       # site context contains it (request-
+                                       # targeted faults: the model_runner
+                                       # context lists the batch's req ids)
 
 Actions:
 
@@ -32,11 +36,20 @@ Actions:
 - ``drop``              return ``"drop"`` to the call site, which skips
   the guarded side effect (message not sent, frame discarded, write torn);
 - ``off``               no-op — combined with a count it *skips* hits, so
-  "fire on exactly the 4th hit" is ``3*off;1*raise``.
+  "fire on exactly the 4th hit" is ``3*off;1*raise``;
+- ``nan``               return ``"nan"`` to the call site
+  (``model_runner.step`` poisons the step's logits so the numeric-guard
+  containment path runs for real);
+- ``hang_step[(seconds)]`` sleep *inside* the step window (default
+  3600 s) — models a wedged device dispatch the step watchdog must catch.
 
 Triggers compose: ``2*50%delay(1)`` governs the first two hits and fires
 each with seeded probability 0.5. A term with no count governs every
-remaining hit (terminal). ``once`` is an alias for ``1``.
+remaining hit (terminal). ``once`` is an alias for ``1``. An ``@`` guard
+restricts the term to hits whose call-site context contains the given
+substring — non-matching hits do not consume the term's count, so
+``2*raise@poison`` crashes exactly the first two steps that schedule a
+request whose id contains "poison".
 
 Determinism: probability draws come from a per-site
 ``random.Random(f"{seed}:{site}")`` stream seeded by
@@ -49,7 +62,7 @@ Zero overhead when unset: ``fail_point`` first checks a module-level bool
 and returns immediately — no dict lookup, no arg evaluation. Call sites
 that want failure context in the error message pass a zero-arg callable
 (``fail_point("x", lambda: f"...")``) which is only evaluated when a
-``raise`` actually fires.
+``raise`` actually fires or the governing term carries an ``@`` guard.
 """
 
 from __future__ import annotations
@@ -106,6 +119,11 @@ SITE_CATALOG: dict[str, str] = {
         "exit = coordinator process dies (failover path)"),
     "detokenizer.update": (
         "incremental detokenization of new tokens in the frontend"),
+    "model_runner.step": (
+        "ModelRunner.dispatch, before the jitted step launches; nan = "
+        "poison this step's logits (numeric-guard containment path), "
+        "hang_step = stall inside the step window (step-watchdog path), "
+        "raise = crash the step (poison-request quarantine path)"),
 }
 
 _EXC_WHITELIST: dict[str, type[BaseException]] = {
@@ -126,16 +144,20 @@ class _Term:
     arg: str | None = None
     count: int | None = None   # None = governs every remaining hit
     prob: float | None = None  # None = fires on every governed hit
+    match: str | None = None   # None = governs every hit; otherwise only
+                               # hits whose ctx string contains this
 
 
 _TERM_RE = re.compile(
     r"^(?:(\d+|once)\*)?"          # count
     r"(?:(\d+(?:\.\d+)?)%)?"       # probability (percent)
     r"([a-z_]+)"                   # action
-    r"(?:\((.*)\))?$"              # optional arg
+    r"(?:\((.*)\))?"               # optional arg
+    r"(?:@([^@]+))?$"              # optional context-match guard
 )
 
-_ACTIONS = {"raise", "delay", "hang", "exit", "drop", "off"}
+_ACTIONS = {"raise", "delay", "hang", "exit", "drop", "off",
+            "nan", "hang_step"}
 
 
 def parse_spec(spec: str) -> dict[str, list[_Term]]:
@@ -155,7 +177,7 @@ def parse_spec(spec: str) -> dict[str, list[_Term]]:
             if m is None:
                 raise ValueError(
                     f"failpoint {name}: malformed term {term_s!r}")
-            count_s, prob_s, action, arg = m.groups()
+            count_s, prob_s, action, arg, match = m.groups()
             if action not in _ACTIONS:
                 raise ValueError(
                     f"failpoint {name}: unknown action {action!r} "
@@ -171,7 +193,8 @@ def parse_spec(spec: str) -> dict[str, list[_Term]]:
                     f"failpoint {name}: raise({arg}) — exception must be "
                     f"one of {sorted(_EXC_WHITELIST)}")
             terms.append(_Term(action=action, arg=arg or None,
-                               count=count, prob=prob))
+                               count=count, prob=prob,
+                               match=match or None))
         if not terms:
             raise ValueError(f"failpoint {name}: empty term list")
         sites[name] = terms
@@ -193,6 +216,15 @@ class _Site:
         self._rng = random.Random(f"{seed}:{name}")
         self._lock = threading.Lock()
 
+    @staticmethod
+    def _ctx_matches(substr: str, ctx: Callable[[], Any] | None) -> bool:
+        if ctx is None:
+            return False
+        try:
+            return substr in str(ctx())
+        except Exception:
+            return False
+
     def evaluate(self, ctx: Callable[[], Any] | None) -> str | None:
         with self._lock:
             self.hits += 1
@@ -203,6 +235,13 @@ class _Site:
                     self._idx += 1
                     self._consumed = 0
                     continue
+                if t.match is not None and not self._ctx_matches(t.match, ctx):
+                    # A guarded term does not govern non-matching hits at
+                    # all: the count is not consumed, so e.g.
+                    # ``2*raise@poison-0`` crashes exactly the first two
+                    # steps that carry request poison-0, however many
+                    # clean batches run in between.
+                    return None
                 if t.count is not None:
                     self._consumed += 1
                 term = t
@@ -223,6 +262,16 @@ class _Site:
                  ctx: Callable[[], Any] | None) -> str | None:
         if term.action == "drop":
             return "drop"
+        if term.action == "nan":
+            # The call site (model_runner.step) poisons the step's logits
+            # so the numeric-guard containment path runs end to end.
+            return "nan"
+        if term.action == "hang_step":
+            # Sleep INSIDE the step window (dispatch), so the elapsed step
+            # time exceeds the step watchdog's deadline — models a wedged
+            # device dispatch rather than a dead process.
+            time.sleep(float(term.arg) if term.arg else 3600.0)
+            return "hang_step"
         if term.action == "delay":
             time.sleep(float(term.arg) if term.arg else 0.1)
             return None
@@ -255,7 +304,8 @@ def fail_point(name: str, ctx: Callable[[], Any] | None = None) -> str | None:
     ``"drop"`` (the call site must skip its guarded side effect). May
     raise (action ``raise``), sleep (``delay``/``hang``), or kill the
     process (``exit``). ``ctx``, when given, is a zero-arg callable
-    evaluated ONLY if a raise fires — never on the disabled path.
+    evaluated only if a raise fires or the governing term has an ``@``
+    match guard — never on the disabled path.
     """
     if not _active:
         return None

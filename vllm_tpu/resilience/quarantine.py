@@ -1,0 +1,344 @@
+"""Poison-request bisection & quarantine.
+
+A *poison request* deterministically kills the engine that executes it —
+a pathological input shape tickling a compiler bug, a grammar that wedges
+the mask builder, a prompt that lands on a bad HBM page. Crash recovery
+alone livelocks on it: every incarnation replays the request, crashes,
+and burns a restart-budget unit until the whole engine is declared dead,
+taking the innocent traffic with it.
+
+This module converges on the culprit instead:
+
+- every engine death carries a *suspect set* — the batch that was on the
+  device when it died (``EngineRestartedError.suspect_req_ids``; an
+  unattributed death — SIGKILL, OOM — blames nobody, so external kills
+  never quarantine innocent traffic);
+- each suspect involved in a crash accrues a *strike*; reaching
+  ``max_suspect_strikes`` makes it *hot*;
+- one hot suspect is the culprit: it is dead-lettered (on-disk record
+  beside the journal dir, inspectable via ``GET /debug/deadletter`` and
+  ``tools/deadletter.py``, re-admittable via tooling) and its stream is
+  failed with a per-request error;
+- several hot suspects are ambiguous (they always crashed together):
+  *bisection replay* re-admits the first half as a probation probe —
+  capped at ``quarantine_probation_cap`` in flight — and holds the rest.
+  The probe either crashes again (strikes accrue, bisect again) or
+  drains cleanly (the probe is exonerated, its strikes reset); either
+  way the held half is released when the probe resolves. log2 rounds
+  isolate a single deterministic culprit.
+
+Innocent requests that merely shared a batch with the culprit lose their
+strikes the moment they reach any terminal state (``note_terminal``).
+A hard safety bound (``max_suspect_strikes + _SAFETY_MARGIN`` strikes)
+dead-letters a request regardless of ambiguity so nondeterministic
+near-poison can't crash-loop forever.
+
+Thread-safety: called from the AsyncLLM busy-loop thread (crash
+handling) and the event loop (terminal notifications); everything is
+behind one lock.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from typing import Callable
+
+from vllm_tpu.logger import init_logger
+from vllm_tpu.resilience.journal import JournalEntry
+
+logger = init_logger(__name__)
+
+# Strikes past max_suspect_strikes before ambiguity stops mattering:
+# covers log2 of any realistic batch plus slack for flaky co-suspects.
+_SAFETY_MARGIN = 6
+
+
+class DeadLetterStore:
+    """Terminal records for quarantined requests.
+
+    On-disk when a directory is given (one JSON file per request id,
+    beside the journal snapshots so both survive frontend restarts),
+    in-memory otherwise. File names use the digest scheme of the journal
+    (client-supplied request ids may be filesystem-unsafe); the id lives
+    inside the record.
+    """
+
+    def __init__(self, persist_dir: str | None = None) -> None:
+        self._lock = threading.Lock()
+        self._mem: dict[str, dict] = {}
+        self._dir = None
+        if persist_dir is not None:
+            self._dir = os.path.join(persist_dir, "deadletter")
+            os.makedirs(self._dir, exist_ok=True)
+
+    @staticmethod
+    def _name(request_id: str) -> str:
+        import hashlib
+
+        return hashlib.sha1(request_id.encode()).hexdigest() + ".json"
+
+    def add(self, record: dict) -> None:
+        rid = record["request_id"]
+        with self._lock:
+            self._mem[rid] = record
+            if self._dir is not None:
+                path = os.path.join(self._dir, self._name(rid))
+                try:
+                    tmp = path + ".tmp"
+                    with open(tmp, "w") as f:
+                        f.write(json.dumps(record, indent=2, default=str))
+                    os.replace(tmp, path)
+                except OSError as e:
+                    logger.warning(
+                        "deadletter: failed to persist %s: %s", rid, e)
+
+    def list(self) -> list[dict]:
+        """All records (disk is authoritative when persistent: records
+        written by a previous frontend incarnation are included)."""
+        with self._lock:
+            records = dict(self._mem)
+            if self._dir is not None:
+                for name in sorted(os.listdir(self._dir)):
+                    if not name.endswith(".json"):
+                        continue
+                    try:
+                        with open(os.path.join(self._dir, name)) as f:
+                            rec = json.load(f)
+                        records.setdefault(rec.get("request_id"), rec)
+                    except (OSError, ValueError) as e:
+                        logger.warning(
+                            "deadletter: unreadable record %s: %s", name, e)
+            return [records[k] for k in sorted(records, key=str)]
+
+    def get(self, request_id: str) -> dict | None:
+        with self._lock:
+            rec = self._mem.get(request_id)
+            if rec is None and self._dir is not None:
+                path = os.path.join(self._dir, self._name(request_id))
+                try:
+                    with open(path) as f:
+                        rec = json.load(f)
+                except (OSError, ValueError):
+                    rec = None
+            return rec
+
+    def remove(self, request_id: str) -> dict | None:
+        """Pop a record (re-admission tooling)."""
+        with self._lock:
+            rec = self._mem.pop(request_id, None)
+            if self._dir is not None:
+                path = os.path.join(self._dir, self._name(request_id))
+                if rec is None:
+                    try:
+                        with open(path) as f:
+                            rec = json.load(f)
+                    except (OSError, ValueError):
+                        rec = None
+                try:
+                    os.unlink(path)
+                except FileNotFoundError:
+                    pass
+                except OSError as e:
+                    logger.warning(
+                        "deadletter: failed to remove %s: %s",
+                        request_id, e)
+            return rec
+
+    def __len__(self) -> int:
+        return len(self.list())
+
+
+def make_deadletter_record(entry: JournalEntry | None, request_id: str,
+                           strikes: int, reason: str) -> dict:
+    """JSON-safe dead-letter record. Carries the prompt token ids and the
+    sampling budget so ``tools/deadletter.py readmit`` can resubmit the
+    request against a (fixed) server without the original client."""
+    rec = {
+        "request_id": request_id,
+        "strikes": strikes,
+        "reason": reason,
+        "quarantined_at": time.time(),
+    }
+    if entry is not None:
+        mt = None
+        if entry.sampling_params is not None:
+            mt = getattr(entry.sampling_params, "max_tokens", None)
+        rec.update({
+            "prompt_token_ids": list(entry.prompt_token_ids),
+            "prompt_text": entry.prompt_text,
+            "emitted_token_ids": list(entry.emitted_token_ids),
+            "max_tokens": mt,
+            "arrival_time": entry.arrival_time,
+        })
+    return rec
+
+
+class QuarantineManager:
+    """Strike accounting + bisection state machine.
+
+    ``on_crash`` maps each lost request to a disposition:
+
+    - ``"replay"``  — re-admit through the normal journal-replay path;
+    - ``"hold"``    — keep journaled but do NOT re-admit yet (the other
+      bisection half is probing); released via ``on_release`` when the
+      probe resolves;
+    - ``"deadletter"`` — isolated culprit: record it and fail the stream.
+
+    ``on_release(req_ids)`` is invoked (under no lock) when held requests
+    become eligible for re-admission.
+    """
+
+    def __init__(
+        self,
+        max_suspect_strikes: int = 2,
+        probation_cap: int = 8,
+        persist_dir: str | None = None,
+        on_release: Callable[[list[str]], None] | None = None,
+    ) -> None:
+        assert max_suspect_strikes >= 1
+        self.max_suspect_strikes = max_suspect_strikes
+        self.probation_cap = probation_cap
+        self.on_release = on_release
+        self.deadletter = DeadLetterStore(persist_dir)
+        self.requests_quarantined_total = 0
+        self._lock = threading.Lock()
+        self._strikes: dict[str, int] = {}
+        # Bisection state: probe = suspects currently re-admitted under
+        # probation; held = suspects parked until the probe resolves.
+        self._probe: set[str] = set()
+        self._held: list[str] = []
+
+    # -- crash handling (busy-loop thread) ------------------------------
+
+    def on_crash(self, lost_req_ids: list[str],
+                 suspect_req_ids: list[str] | None) -> dict[str, str]:
+        """Disposition for every lost request after an engine death."""
+        lost = list(dict.fromkeys(lost_req_ids))
+        with self._lock:
+            lost_set = set(lost)
+            if suspect_req_ids is None:
+                # Unattributed death (SIGKILL, OOM, legacy notification
+                # without a batch frame): blame nobody. Striking every
+                # lost request would let repeated EXTERNAL kills — chaos
+                # schedules, OOM-killer pressure — dead-letter innocent
+                # traffic; the per-request retry budget still bounds
+                # replays on this path.
+                suspects = []
+            else:
+                suspects = [r for r in dict.fromkeys(suspect_req_ids)
+                            if r in lost_set]
+            for rid in suspects:
+                self._strikes[rid] = self._strikes.get(rid, 0) + 1
+            # Requests that died with the engine but were NOT on the
+            # device (queued, waiting) carry no blame.
+            dispositions = {rid: "replay" for rid in lost}
+            hard_cap = self.max_suspect_strikes + _SAFETY_MARGIN
+            hot = [r for r in suspects
+                   if self._strikes[r] >= self.max_suspect_strikes]
+            over = [r for r in hot if self._strikes[r] >= hard_cap]
+            for rid in over:
+                dispositions[rid] = "deadletter"
+            hot = [r for r in hot if r not in set(over)]
+            if len(hot) == 1:
+                # Unambiguous culprit.
+                dispositions[hot[0]] = "deadletter"
+            elif len(hot) > 1:
+                # Ambiguous: they always crashed together. Probe the
+                # first half (deterministic order), hold the rest.
+                hot.sort()
+                probe = hot[: max(1, len(hot) // 2)]
+                if self.probation_cap > 0:
+                    spill = probe[self.probation_cap:]
+                    probe = probe[: self.probation_cap]
+                else:
+                    spill = []
+                held = spill + hot[max(1, len(hot) // 2):]
+                self._probe = set(probe)
+                for rid in held:
+                    dispositions[rid] = "hold"
+                    if rid not in self._held:
+                        self._held.append(rid)
+                logger.warning(
+                    "quarantine: %d ambiguous suspects; probing %s, "
+                    "holding %s", len(hot), probe, held,
+                )
+            # A probe member that just got parked or dead-lettered is no
+            # longer probing; a stale entry would keep the held half
+            # parked forever. (note_deadlettered also clears its id, but
+            # the "hold" disposition has no other removal path.)
+            self._probe -= {
+                r for r, d in dispositions.items() if d == "hold"
+            }
+        return dispositions
+
+    def register_probe(self, req_ids: list[str]) -> None:
+        """Mark re-admitted suspects as the active probe (callers that
+        re-admit outside on_crash, e.g. released holds)."""
+        with self._lock:
+            self._probe |= set(req_ids)
+
+    # -- terminal notifications (event loop / output thread) ------------
+
+    def note_terminal(self, request_id: str) -> None:
+        """A request reached any terminal state. Clears its strikes (a
+        request that finished cannot be the deterministic poison) and
+        advances the bisection when the probe drains."""
+        release: list[str] = []
+        with self._lock:
+            self._strikes.pop(request_id, None)
+            self._probe.discard(request_id)
+            if not self._probe and self._held:
+                release = self._held
+                self._held = []
+        if release:
+            logger.info(
+                "quarantine: probe resolved; releasing %d held "
+                "request(s): %s", len(release), release)
+            if self.on_release is not None:
+                self.on_release(release)
+
+    def note_deadlettered(self, request_id: str,
+                          entry: JournalEntry | None,
+                          reason: str) -> dict:
+        """Record the culprit; returns the dead-letter record."""
+        with self._lock:
+            strikes = self._strikes.get(request_id, 0)
+        rec = make_deadletter_record(entry, request_id, strikes, reason)
+        self.deadletter.add(rec)
+        self.requests_quarantined_total += 1
+        logger.error(
+            "quarantine: dead-lettered poison request %s after %d "
+            "strike(s): %s", request_id, strikes, reason.splitlines()[0],
+        )
+        # Dead-letter IS terminal: clear strikes / advance bisection.
+        self.note_terminal(request_id)
+        return rec
+
+    # -- introspection --------------------------------------------------
+
+    def strikes(self, request_id: str) -> int:
+        with self._lock:
+            return self._strikes.get(request_id, 0)
+
+    def is_probing(self, request_id: str) -> bool:
+        """True while the request is a bisection probe member. Probe
+        replays bypass the generic crash-retry budget — the strike cap
+        bounds them instead, and cutting a probe short would leave the
+        held half parked with the culprit unisolated."""
+        with self._lock:
+            return request_id in self._probe
+
+    def status(self) -> dict:
+        with self._lock:
+            return {
+                "max_suspect_strikes": self.max_suspect_strikes,
+                "probation_cap": self.probation_cap,
+                "suspects": dict(self._strikes),
+                "probing": sorted(self._probe),
+                "held": list(self._held),
+                "quarantined_total": self.requests_quarantined_total,
+                "deadletter_size": len(self.deadletter),
+            }

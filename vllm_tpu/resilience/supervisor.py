@@ -31,6 +31,10 @@ class EngineStatus:
     restarts: int = 0
     last_failure_t: float = 0.0
     last_ready_t: float = field(default_factory=time.monotonic)
+    # Anchor for restart-budget healing: healthy uptime is measured from
+    # here, and advances as whole heal units are credited (so partial
+    # progress toward the next unit is never lost or double-counted).
+    heal_anchor_t: float = field(default_factory=time.monotonic)
 
 
 class EngineSupervisor:
@@ -39,6 +43,23 @@ class EngineSupervisor:
         self.config = config
         self._lock = threading.Lock()
         self._engines = {i: EngineStatus() for i in range(num_engines)}
+        # Injectable for tests (budget-heal timing without sleeping).
+        self._clock = time.monotonic
+
+    def _heal(self, st: EngineStatus) -> None:
+        """Decay one restart unit per ``restart_budget_heal_s`` of
+        healthy uptime (satellite fix: without this the budget never
+        replenishes, so any long-lived deployment eventually dies of
+        accumulated unrelated crashes). Caller holds the lock."""
+        heal_s = self.config.restart_budget_heal_s
+        if heal_s <= 0 or not st.up or st.restarts <= 0:
+            return
+        units = int((self._clock() - st.heal_anchor_t) // heal_s)
+        if units <= 0:
+            return
+        credited = min(units, st.restarts)
+        st.restarts -= credited
+        st.heal_anchor_t += units * heal_s
 
     # -- policy --------------------------------------------------------
 
@@ -48,6 +69,7 @@ class EngineSupervisor:
             return False
         with self._lock:
             st = self._engines.setdefault(engine_id, EngineStatus())
+            self._heal(st)
             return st.restarts < self.config.max_engine_restarts
 
     def may_restart_coordinator(self) -> bool:
@@ -56,6 +78,7 @@ class EngineSupervisor:
         coordinator silently freezes the wave state)."""
         with self._lock:
             st = self._engines.setdefault(COORDINATOR_ID, EngineStatus())
+            self._heal(st)
             return st.restarts < self.config.max_coordinator_restarts
 
     def backoff_s(self, engine_id: int) -> float:
@@ -80,16 +103,23 @@ class EngineSupervisor:
         Returns the new restart count."""
         with self._lock:
             st = self._engines.setdefault(engine_id, EngineStatus())
+            # Credit healthy uptime accrued BEFORE this failure, so a
+            # crash after a long quiet stretch spends from a healed
+            # budget, not the historical count.
+            self._heal(st)
             st.up = False
             st.restarts += 1
-            st.last_failure_t = time.monotonic()
+            st.last_failure_t = self._clock()
             return st.restarts
 
     def record_ready(self, engine_id: int) -> None:
         with self._lock:
             st = self._engines.setdefault(engine_id, EngineStatus())
             st.up = True
-            st.last_ready_t = time.monotonic()
+            st.last_ready_t = self._clock()
+            # Healing measures HEALTHY uptime: the clock starts when the
+            # engine comes (back) up, not across its downtime.
+            st.heal_anchor_t = st.last_ready_t
 
     def record_dead(self, engine_id: int) -> None:
         """Permanent death: down with no further restarts allowed."""

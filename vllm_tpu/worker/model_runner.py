@@ -27,6 +27,7 @@ from vllm_tpu.config import EngineConfig
 from vllm_tpu.core.sched_output import ModelRunnerOutput, SchedulerOutput
 from vllm_tpu.logger import init_logger
 from vllm_tpu.ops.attention import AttentionMetadata
+from vllm_tpu.resilience.failpoints import fail_point
 from vllm_tpu.sample.sampler import SamplingMetadata, sample
 from vllm_tpu.worker.input_batch import InputBatch
 
@@ -54,6 +55,11 @@ class StepHandle:
         self.prompt_lp = None  # (vals, ids, tok_lp, rank) over [T]
         self.prompt_rows = None  # [(row_i, offset, start, n, prompt_len)]
         self.moe_counts = None  # [L, E] expert token counts (EPLB)
+        # Numeric integrity guard (opt-in): per-row "logits not finite"
+        # device bool [r_pad]; forced_nan simulates a fully poisoned
+        # logits tensor (model_runner.step failpoint, action `nan`).
+        self.row_bad = None
+        self.forced_nan = False
         # Requests whose external KV load failed this step: their outputs
         # are garbage and the scheduler must reschedule them (reference:
         # invalid-block recovery, scheduler.py:2123).
@@ -338,6 +344,25 @@ class ModelRunner:
 
         self._timing_enabled = envs.VLLM_TPU_STEP_TIMING
         self._nan_check = envs.VLLM_TPU_NAN_CHECK
+        # Execution-layer fault containment (resilience config / env):
+        # per-row isfinite guard on the step logits (rides the existing
+        # device-feedback fetch) + host-side sampled-token range check. A
+        # trip fails only the afflicted requests, never the engine.
+        rc = getattr(config, "resilience_config", None)
+        self._guard_numerics = bool(
+            getattr(rc, "numeric_guard", False) or envs.VLLM_TPU_NUMERIC_GUARD
+        )
+        self.numeric_guard_trips: dict[str, int] = {}
+        # Step watchdog: a dispatched step (device enqueue + finalize
+        # fetch) exceeding the deadline is a device hang — the busy loop
+        # is alive but the accelerator is wedged. core_proc overrides
+        # watchdog.on_trip to escalate to a supervised engine restart.
+        self.watchdog = None
+        watchdog_s = float(getattr(rc, "step_watchdog_s", 0.0) or 0.0)
+        if watchdog_s > 0:
+            from vllm_tpu.worker.watchdog import StepWatchdog
+
+            self.watchdog = StepWatchdog(watchdog_s)
         # Native (C++) step-input assembly; None -> python loop.
         self._native_prep = None
         if not envs.VLLM_TPU_DISABLE_NATIVE_PREP:
@@ -649,6 +674,12 @@ class ModelRunner:
             spec_nan = (
                 jnp.isnan(logits3).sum() if self._nan_check else None
             )
+            # Per-row numeric guard: any non-finite logit at any draft
+            # position poisons the row (rides the same feedback fetch).
+            spec_row_bad = (
+                ~jnp.all(jnp.isfinite(logits3), axis=(1, 2))
+                if self._guard_numerics else None
+            )
             if self.tree is not None:
                 from vllm_tpu.sample.tree_rejection import (
                     tree_rejection_sample,
@@ -678,7 +709,8 @@ class ModelRunner:
                     params["medusa"], hidden[anchor], self.tree
                 )
                 return (kv_cache, draft_kv, (out_tokens, num_out), None,
-                        drafts, None, spec_nan, None, moe_counts)
+                        drafts, None, spec_nan, None, moe_counts,
+                        spec_row_bad)
             out_tokens, num_out = rejection_sample(
                 logits3,
                 spec["draft_ids"],
@@ -706,7 +738,7 @@ class ModelRunner:
                     params["medusa"], hidden[anchor]
                 )
             return (kv_cache, draft_kv, (out_tokens, num_out), None, drafts,
-                    None, spec_nan, None, moe_counts)
+                    None, spec_nan, None, moe_counts, spec_row_bad)
         last = hidden[md.logits_indices]  # [R, D]
         nan_count = None
         pooled = None
@@ -753,6 +785,13 @@ class ModelRunner:
         logits = self.model.compute_logits(params, last)  # [R, V] f32
         if self._nan_check:
             nan_count = jnp.isnan(logits).sum()
+        # Per-row numeric guard on the RAW logits (before grammar/adjust
+        # masking injects intentional -1e30s): a row with any NaN/Inf is
+        # failed individually downstream, never the engine.
+        row_bad = (
+            ~jnp.all(jnp.isfinite(logits), axis=-1)
+            if self._guard_numerics else None
+        )
         if needs_grammar:
             # Gather each row's packed grammar bitmask from the
             # device-resident table and unpack bits (bit v%32 of word v//32
@@ -858,7 +897,7 @@ class ModelRunner:
         else:
             lp = None
         return (kv_cache, draft_kv, sampled, lp, drafts, pooled, nan_count,
-                prompt_lp, moe_counts)
+                prompt_lp, moe_counts, row_bad)
 
     def _eagle_drafts(self, params, draft_kv, token_ids, hidden, md,
                       anchor, emitted, draft_next, r_pad):
@@ -1814,8 +1853,21 @@ class ModelRunner:
                 mm_kwargs["mm_mask"] = mm_arrays[1]
             if len(mm_arrays) > 2:
                 mm_kwargs["mrope_positions"] = mm_arrays[2]
+        # Watchdog window opens HERE — before the failpoint, so an
+        # injected hang_step lands inside it exactly like a wedged XLA
+        # dispatch would. It closes when this step's finalize completes.
+        # (An exception below crashes the engine core anyway, so a stale
+        # arm never outlives the process that would observe it.)
+        if self.watchdog is not None:
+            self.watchdog.arm(req_order)
+        # Failpoint `model_runner.step`: nan = poison this step's logits
+        # (numeric-guard path), hang_step = stall inside the watchdog
+        # window, raise = crash the step (quarantine path).
+        forced_nan = fail_point(
+            "model_runner.step", lambda: f"reqs={req_order}"
+        ) == "nan"
         (self.kv_cache, self.draft_kv, sampled, lp, drafts, pooled,
-         nan_count, prompt_lp, moe_counts) = self._step_fn(
+         nan_count, prompt_lp, moe_counts, row_bad) = self._step_fn(
             self.params, self.kv_cache, self.draft_kv, *arrays, prev,
             mask_table, **mm_kwargs, **flags,
         )
@@ -1849,6 +1901,8 @@ class ModelRunner:
         if prompt_lp is not None:
             for x in prompt_lp:
                 x.copy_to_host_async()
+        if row_bad is not None:
+            row_bad.copy_to_host_async()
         handle = StepHandle(
             req_order=req_order, do_sample=do_sample, sampled=sampled, lp=lp,
             row_states=[self.input_batch.req_states[r] for r in req_order],
@@ -1863,6 +1917,8 @@ class ModelRunner:
             prompt_rows if flags["num_prompt_logprobs"] else None
         )
         handle.failed_loads = failed_loads
+        handle.row_bad = row_bad
+        handle.forced_nan = forced_nan
         return handle
 
     def finalize(self, handle: "StepHandle") -> ModelRunnerOutput:
@@ -1903,6 +1959,14 @@ class ModelRunner:
                     "NaNs detected in step logits: %d values (reference "
                     "analog: _get_nans_in_logits)", n_nan,
                 )
+        # Numeric guard, kind "nan": per-row non-finite logits. A forced
+        # trip (failpoint action `nan`) models a fully poisoned logits
+        # tensor, so every sampled row of the batch is afflicted.
+        bad_rows = None
+        if handle.row_bad is not None:
+            bad_rows = np.asarray(jax.device_get(handle.row_bad))
+        if handle.forced_nan:
+            bad_rows = np.ones(len(req_order), bool)
         if handle.moe_counts is not None and self.eplb_state is not None:
             self.eplb_state.update(
                 np.asarray(jax.device_get(handle.moe_counts))
@@ -1962,6 +2026,27 @@ class ModelRunner:
                     toks = [int(x) for x in sampled_np[i]]
                 else:
                     toks = [int(sampled_np[i])]
+                bad_kind = None
+                if bad_rows is not None and i < len(bad_rows) and bad_rows[i]:
+                    bad_kind = "nan"
+                elif self._guard_numerics and any(
+                    t < 0 or t >= self.model.vocab_size for t in toks
+                ):
+                    bad_kind = "sampled"
+                if bad_kind is not None:
+                    # Contain to this request: emit nothing and don't fold
+                    # garbage tokens into host state; the scheduler
+                    # finishes it with finish_reason="error".
+                    if (
+                        self.input_batch.req_states.get(rid)
+                        is handle.row_states[i]
+                    ):
+                        out.numeric_error_req_ids.add(rid)
+                        self.numeric_guard_trips[bad_kind] = (
+                            self.numeric_guard_trips.get(bad_kind, 0) + 1
+                        )
+                    out.sampled_token_ids.append([])
+                    continue
                 # The request may have finished (async: stop detected while
                 # this step was in flight) and its row dropped — or even
                 # replaced by a new request reusing the id (identity check).
@@ -1993,6 +2078,15 @@ class ModelRunner:
                 sampled_token_ranks=sampled_rank[: len(req_order)].tolist(),
                 sampled_logprobs=sampled_lp[: len(req_order)].tolist(),
             )
+        if out.numeric_error_req_ids:
+            logger.error(
+                "numeric guard tripped: failing %d request(s) with "
+                "finish_reason=error: %s (engine keeps serving)",
+                len(out.numeric_error_req_ids),
+                sorted(out.numeric_error_req_ids),
+            )
+        if self.watchdog is not None:
+            self.watchdog.disarm()
         return out
 
     def execute_model(self, so: SchedulerOutput) -> ModelRunnerOutput:

@@ -1,0 +1,122 @@
+"""Step watchdog: monotonic-deadline detection of wedged device steps.
+
+A *device hang* is distinct from busy-loop heartbeat loss: the engine's
+busy loop is alive (heartbeats flow) but a dispatched XLA step never
+completes — a wedged DMA, a deadlocked collective, a driver fault. The
+client-side heartbeat can't see it because the busy loop blocks inside
+``jax.device_get`` forever without ever going quiet on the wire.
+
+The runner arms the watchdog when a step is dispatched (with the batch's
+request ids) and disarms it when that step's finalize completes. Arms
+form a FIFO — the async engine pipeline can have more than one step in
+flight — and the watchdog thread checks only the *oldest* outstanding
+deadline: steps complete in dispatch order on the device stream.
+
+On a trip, ``on_trip(req_ids, elapsed_s)`` runs exactly once per armed
+step. The default handler logs and counts; the engine-core process
+(``core_proc.py``) overrides it to escalate — send a MSG_DEAD crash
+notification carrying the suspect request ids, then ``os._exit`` so the
+supervisor runs the normal crash-recovery + quarantine path.
+
+Off by default (``step_watchdog_s = 0``): the first compile of a new
+bucket shape legitimately blocks for minutes, so enable this only with a
+deadline comfortably above worst-case compile time (or pre-warm with
+``--precompile``).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from typing import Callable
+
+from vllm_tpu.logger import init_logger
+
+logger = init_logger(__name__)
+
+
+class StepWatchdog:
+    def __init__(
+        self,
+        timeout_s: float,
+        on_trip: Callable[[list[str], float], None] | None = None,
+        clock: Callable[[], float] = time.monotonic,
+    ) -> None:
+        assert timeout_s > 0
+        self.timeout_s = timeout_s
+        # Replaceable AFTER construction: core_proc installs its
+        # escalation handler once the runner exists.
+        self.on_trip = on_trip
+        self.trips = 0
+        self._clock = clock
+        self._lock = threading.Lock()
+        # FIFO of (armed_at, req_ids) for steps in flight.
+        self._pending: deque[tuple[float, list[str]]] = deque()
+        self._stop = threading.Event()
+        self._wake = threading.Event()
+        self._thread = threading.Thread(
+            target=self._run, name="step-watchdog", daemon=True
+        )
+        self._thread.start()
+
+    # -- runner-side API ------------------------------------------------
+
+    def arm(self, req_ids: list[str]) -> None:
+        with self._lock:
+            self._pending.append((self._clock(), list(req_ids)))
+        self._wake.set()
+
+    def disarm(self) -> None:
+        with self._lock:
+            if self._pending:
+                self._pending.popleft()
+
+    def stop(self) -> None:
+        self._stop.set()
+        self._wake.set()
+        self._thread.join(timeout=5.0)
+
+    def status(self) -> dict:
+        with self._lock:
+            return {
+                "timeout_s": self.timeout_s,
+                "steps_in_flight": len(self._pending),
+                "trips": self.trips,
+            }
+
+    # -- monitor thread -------------------------------------------------
+
+    def _run(self) -> None:
+        # Poll granularity: fine enough to catch a hang promptly without
+        # spinning; a trip fires within ~10% of the deadline.
+        tick = max(0.01, min(self.timeout_s / 10.0, 1.0))
+        while not self._stop.is_set():
+            self._wake.wait(timeout=tick)
+            self._wake.clear()
+            if self._stop.is_set():
+                return
+            with self._lock:
+                if not self._pending:
+                    continue
+                armed_at, req_ids = self._pending[0]
+                elapsed = self._clock() - armed_at
+                if elapsed < self.timeout_s:
+                    continue
+                # Fire once for this step: drop it so a (theoretical)
+                # later completion doesn't double-trip.
+                self._pending.popleft()
+                self.trips += 1
+            self._fire(req_ids, elapsed)
+
+    def _fire(self, req_ids: list[str], elapsed: float) -> None:
+        logger.error(
+            "step watchdog tripped: device step exceeded %.1fs "
+            "(elapsed %.1fs, %d requests in flight: %s)",
+            self.timeout_s, elapsed, len(req_ids), req_ids,
+        )
+        if self.on_trip is not None:
+            try:
+                self.on_trip(req_ids, elapsed)
+            except Exception:
+                logger.exception("step watchdog on_trip handler failed")
