@@ -1,0 +1,188 @@
+"""Golden wire-format coverage for the kv_events PUB stream.
+
+External routers (``vllm_tpu/router/prefix_index.py`` here, but the
+protocol is public — the reference's prefix-aware LBs speak it too)
+depend on the exact on-wire shape: topic frame, msgpack batch schema,
+monotonically increasing ``seq``, and ``BlockStored.parent_block_hash``
+chaining to the previously stored block. A silent change to any of
+these desyncs every subscriber, so this test pins them down.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+
+import msgpack
+import pytest
+import zmq
+
+from vllm_tpu.core.kv_cache_utils import NONE_HASH, hash_block_tokens
+from vllm_tpu.core.kv_events import (
+    TOPIC,
+    AllBlocksCleared,
+    BlockRemoved,
+    BlockStored,
+    KVEventPublisher,
+)
+
+BLOCK = 16
+
+
+@pytest.fixture
+def pub_sub(tmp_path):
+    endpoint = f"ipc://{tmp_path}/kv-wire.sock"
+    pub = KVEventPublisher(endpoint, block_size=BLOCK)
+    ctx = zmq.Context(1)
+    sub = ctx.socket(zmq.SUB)
+    sub.setsockopt(zmq.SUBSCRIBE, b"")
+    sub.connect(endpoint)
+    # PUB/SUB join is async: wait until a probe batch comes through,
+    # then drain it so tests see only their own traffic.
+    deadline = time.monotonic() + 10.0
+    joined = False
+    while time.monotonic() < deadline and not joined:
+        pub.record(AllBlocksCleared())
+        pub.flush()
+        joined = sub.poll(100) != 0
+    assert joined, "SUB never joined the publisher"
+    while sub.poll(0):
+        sub.recv_multipart()
+    yield pub, sub
+    sub.close(linger=0)
+    ctx.term()
+    pub.close()
+
+
+def _recv_batch(sub) -> tuple[bytes, dict]:
+    assert sub.poll(5000), "no batch published within 5s"
+    frames = sub.recv_multipart()
+    assert len(frames) == 2, "wire format is [topic, payload]"
+    return frames[0], msgpack.unpackb(frames[1], raw=False)
+
+
+def test_batch_schema_and_topic(pub_sub):
+    pub, sub = pub_sub
+    h0 = hash_block_tokens(NONE_HASH, list(range(BLOCK)))
+    pub.record(BlockStored(
+        block_hashes=[h0], parent_block_hash=None, block_size=BLOCK))
+    pub.record(BlockRemoved(block_hashes=[h0]))
+    pub.record(AllBlocksCleared())
+    assert pub.flush() == 3
+
+    topic, batch = _recv_batch(sub)
+    assert topic == TOPIC == b"kv-events"
+    assert set(batch) == {"seq", "ts", "events"}
+    assert isinstance(batch["seq"], int)
+    assert isinstance(batch["ts"], float)
+
+    stored, removed, cleared = batch["events"]
+    # Exact event schemas — keys AND msgpack types (hashes must round-
+    # trip as bytes: use_bin_type on pack, raw=False on unpack).
+    assert set(stored) == {
+        "type", "block_hashes", "parent_block_hash", "block_size"}
+    assert stored["type"] == "BlockStored"
+    assert stored["block_hashes"] == [h0]
+    assert isinstance(stored["block_hashes"][0], bytes)
+    assert stored["parent_block_hash"] is None
+    assert stored["block_size"] == BLOCK
+    assert set(removed) == {"type", "block_hashes"}
+    assert removed["type"] == "BlockRemoved"
+    assert removed["block_hashes"] == [h0]
+    assert cleared == {"type": "AllBlocksCleared"}
+
+
+def test_seq_monotonic_and_batched_per_flush(pub_sub):
+    pub, sub = pub_sub
+    seqs = []
+    for i in range(3):
+        pub.record(AllBlocksCleared())
+        pub.record(AllBlocksCleared())
+        assert pub.flush() == 2
+        _, batch = _recv_batch(sub)
+        assert len(batch["events"]) == 2
+        seqs.append(batch["seq"])
+    assert seqs == [seqs[0], seqs[0] + 1, seqs[0] + 2]
+    # Empty buffer -> no publish, and seq must NOT advance (a skipped
+    # seq would read as a dropped batch and resync every subscriber).
+    assert pub.flush() == 0
+    pub.record(AllBlocksCleared())
+    pub.flush()
+    _, batch = _recv_batch(sub)
+    assert batch["seq"] == seqs[-1] + 1
+
+
+def test_block_stored_parent_hash_chaining(pub_sub):
+    """A continuation BlockStored carries the LAST previously-cached
+    block's hash as parent — subscribers verify the chain links up with
+    ``hash_block_tokens``, exactly as the engine computes it."""
+    pub, sub = pub_sub
+    tokens = [(11 * i + 5) % 101 for i in range(BLOCK * 3)]
+    h = []
+    prev = NONE_HASH
+    for i in range(3):
+        prev = hash_block_tokens(prev, tokens[i * BLOCK:(i + 1) * BLOCK])
+        h.append(prev)
+
+    # Prefill stores blocks 0-1 (no parent: chain starts at NONE_HASH)...
+    pub.record(BlockStored(
+        block_hashes=h[:2], parent_block_hash=None, block_size=BLOCK))
+    pub.flush()
+    # ...decode completes block 2, parented on block 1.
+    pub.record(BlockStored(
+        block_hashes=[h[2]], parent_block_hash=h[1], block_size=BLOCK))
+    pub.flush()
+
+    _, first = _recv_batch(sub)
+    _, second = _recv_batch(sub)
+    assert first["events"][0]["parent_block_hash"] is None
+    ev = second["events"][0]
+    assert ev["parent_block_hash"] == first["events"][0]["block_hashes"][-1]
+    # The chain is recomputable from tokens alone: parent + block tokens
+    # reproduce the stored hash.
+    assert hash_block_tokens(
+        ev["parent_block_hash"], tokens[2 * BLOCK:3 * BLOCK]
+    ) == ev["block_hashes"][0]
+
+
+def test_block_pool_emits_parented_continuation(tmp_path):
+    """The real BlockPool emission chains parents the same way."""
+    from vllm_tpu.core.block_pool import BlockPool
+    from vllm_tpu.core.kv_cache_utils import BlockHash
+
+    events: list = []
+    pool = BlockPool(num_blocks=8, enable_caching=True,
+                     event_sink=events.append, block_size=BLOCK)
+    tokens = list(range(BLOCK * 2))
+    hashes = [
+        BlockHash(hash_block_tokens(NONE_HASH, tokens[:BLOCK])),
+    ]
+    hashes.append(BlockHash(hash_block_tokens(hashes[0], tokens[BLOCK:])))
+    blocks = pool.get_new_blocks(2)
+    pool.cache_full_blocks(blocks, hashes, num_cached_blocks=0,
+                           num_full_blocks=1)
+    pool.cache_full_blocks(blocks, hashes, num_cached_blocks=1,
+                           num_full_blocks=2)
+    stored = [e for e in events if isinstance(e, BlockStored)]
+    assert len(stored) == 2
+    assert stored[0].parent_block_hash is None
+    assert stored[0].block_hashes == [bytes(hashes[0])]
+    assert stored[1].parent_block_hash == bytes(hashes[0])
+    assert stored[1].block_hashes == [bytes(hashes[1])]
+
+
+def test_ipc_socket_unlinked_on_close(tmp_path):
+    """Satellite of the same PR: engines must not leave ipc socket files
+    behind (a stale file makes the NEXT engine's bind fail)."""
+    path = os.path.join(tmp_path, "kv-unlink.sock")
+    endpoint = f"ipc://{path}"
+    pub = KVEventPublisher(endpoint, block_size=BLOCK)
+    assert os.path.exists(path)
+    pub.close()
+    assert not os.path.exists(path)
+    # Stale file from an uncleanly-killed predecessor: bind succeeds.
+    with open(path, "w") as f:
+        f.write("stale")
+    pub2 = KVEventPublisher(endpoint, block_size=BLOCK)
+    pub2.close()
+    assert not os.path.exists(path)

@@ -1,0 +1,136 @@
+"""Routing-policy units: frontend hashing parity, decision ladder,
+stats drain semantics, and topology helpers (cap sharding, admin ports).
+"""
+
+from __future__ import annotations
+
+from types import SimpleNamespace
+
+from vllm_tpu.core.kv_cache_utils import NONE_HASH, make_block_hasher
+from vllm_tpu.router.policy import (
+    PrefixAwareRouter,
+    RoutingDecision,
+    RoutingStats,
+    request_prefix_hashes,
+)
+from vllm_tpu.router.prefix_index import PrefixCacheIndex
+from vllm_tpu.router.topology import admin_port_for, shard_cap
+
+BLOCK = 16
+
+
+def _req(tokens, lora_name=None, mm_inputs=None, pooling_params=None):
+    return SimpleNamespace(
+        prompt_token_ids=list(tokens),
+        lora_name=lora_name,
+        mm_inputs=mm_inputs or [],
+        pooling_params=pooling_params,
+    )
+
+
+def test_prefix_hashes_match_engine_hasher():
+    """The frontend MUST reproduce the engine's chain hashes bit-for-bit
+    or every index lookup silently misses."""
+    tokens = [(3 * i + 1) % 97 for i in range(BLOCK * 3 + 5)]
+    engine_req = SimpleNamespace(
+        block_hashes=[], all_token_ids=tokens, lora_name=None)
+    engine_hashes = make_block_hasher(BLOCK)(engine_req)
+    assert len(engine_hashes) == 3  # partial 4th block not hashed
+
+    frontend_hashes = request_prefix_hashes(_req(tokens), BLOCK)
+    assert frontend_hashes == engine_hashes
+
+
+def test_prefix_hashes_chain_from_none_hash():
+    tokens = list(range(BLOCK))
+    from vllm_tpu.core.kv_cache_utils import hash_block_tokens
+
+    assert request_prefix_hashes(_req(tokens), BLOCK) == [
+        hash_block_tokens(NONE_HASH, tokens)
+    ]
+
+
+def test_prefix_hashes_skip_unreplicable_requests():
+    tokens = list(range(BLOCK * 2))
+    # LoRA requests hash with extra keys the frontend doesn't replicate;
+    # multimodal/pooling KV content isn't token-only either.
+    assert request_prefix_hashes(_req(tokens, lora_name="ada"), BLOCK) == []
+    assert request_prefix_hashes(
+        _req(tokens, mm_inputs=[object()]), BLOCK) == []
+    assert request_prefix_hashes(
+        _req(tokens, pooling_params=object()), BLOCK) == []
+    # Sub-block prompts have no full block to match.
+    assert request_prefix_hashes(_req(tokens[:BLOCK - 1]), BLOCK) == []
+
+
+def test_prefix_hashes_cap():
+    tokens = list(range(BLOCK * 10))
+    assert len(request_prefix_hashes(_req(tokens), BLOCK, max_blocks=4)) == 4
+
+
+def test_router_chooses_longest_hit_then_least_loaded():
+    idx = PrefixCacheIndex()
+    tokens = [(5 * i + 2) % 89 for i in range(BLOCK * 3)]
+    hashes = request_prefix_hashes(_req(tokens), BLOCK)
+
+    def stored(hs):
+        return {"type": "BlockStored", "block_hashes": hs,
+                "parent_block_hash": None, "block_size": BLOCK}
+
+    idx.apply_batch(0, {"seq": 0, "ts": 0, "events": [stored(hashes[:1])]})
+    idx.apply_batch(1, {"seq": 0, "ts": 0, "events": [stored(hashes[:3])]})
+    router = PrefixAwareRouter(idx, BLOCK)
+
+    d = router.choose(_req(tokens), [0, 1], {0: 0, 1: 5})
+    assert (d.engine_id, d.kind, d.hit_blocks) == (1, "prefix", 3)
+
+    # Ties break to the least-loaded of the tied engines.
+    idx.apply_batch(0, {"seq": 1, "ts": 0, "events": [stored(hashes[1:3])]})
+    assert router.choose(_req(tokens), [0, 1], {0: 9, 1: 2}).engine_id == 1
+    assert router.choose(_req(tokens), [0, 1], {0: 1, 1: 2}).engine_id == 0
+
+    # Candidate filter: a dead engine's hits must not route to it.
+    assert router.choose(_req(tokens), [0], {0: 9}).engine_id == 0
+
+    # No hit anywhere -> None (caller falls through to least-loaded).
+    other = [(7 * i + 3) % 83 for i in range(BLOCK)]
+    assert router.choose(_req(other), [0, 1], {}) is None
+
+
+def test_routing_stats_drain_semantics():
+    stats = RoutingStats()
+    stats.note(RoutingDecision(0, "prefix", hit_blocks=3))
+    stats.note(RoutingDecision(1, "least_loaded"))
+    stats.note(RoutingDecision(0, "prefix", hit_blocks=5))
+
+    # Peek (health endpoint) leaves pending hit lengths in place.
+    peek = stats.snapshot(drain=False)
+    assert peek["decisions"] == {
+        "prefix": 2, "least_loaded": 1, "round_robin": 0}
+    assert peek["hit_blocks"] == [3, 5]
+
+    # Drain (metrics renderer) takes ownership exactly once.
+    assert stats.snapshot(drain=True)["hit_blocks"] == [3, 5]
+    after = stats.snapshot(drain=True)
+    assert after["hit_blocks"] == []
+    # Counters are cumulative, never reset by the drain.
+    assert after["decisions"]["prefix"] == 2
+
+
+def test_shard_cap():
+    # Ceil-split: shards may admit one extra, the SUM never under-admits
+    # the global cap.
+    assert shard_cap(8, 4) == 2
+    assert shard_cap(9, 4) == 3
+    assert shard_cap(1, 4) == 1
+    # 0 = unlimited stays unlimited per shard.
+    assert shard_cap(0, 4) == 0
+    assert shard_cap(-1, 4) == 0
+    # Single-frontend: cap passes through.
+    assert shard_cap(7, 1) == 7
+
+
+def test_admin_ports_distinct_from_public():
+    ports = [admin_port_for(8000, k) for k in range(4)]
+    assert ports == [8001, 8002, 8003, 8004]
+    assert 8000 not in ports

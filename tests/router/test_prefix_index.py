@@ -1,0 +1,145 @@
+"""PrefixCacheIndex unit coverage: ingestion, resync, queries.
+
+The index is the router's view of which KV block hashes are resident on
+each DP engine, fed by the engines' kv_events streams. These tests drive
+``apply_batch`` directly with decoded-batch dicts (the exact shape
+``KVEventSubscriber`` hands over after msgpack decode).
+"""
+
+from __future__ import annotations
+
+from vllm_tpu.router.prefix_index import PrefixCacheIndex
+
+
+def _batch(seq: int, *events: dict) -> dict:
+    return {"seq": seq, "ts": 0.0, "events": list(events)}
+
+
+def _stored(*hashes: bytes, parent: bytes | None = None) -> dict:
+    return {
+        "type": "BlockStored",
+        "block_hashes": list(hashes),
+        "parent_block_hash": parent,
+        "block_size": 16,
+    }
+
+
+def _removed(*hashes: bytes) -> dict:
+    return {"type": "BlockRemoved", "block_hashes": list(hashes)}
+
+
+H = [bytes([i]) * 16 for i in range(8)]
+
+
+def test_store_remove_and_longest_prefix():
+    idx = PrefixCacheIndex()
+    idx.apply_batch(0, _batch(0, _stored(H[0], H[1], H[2])))
+    idx.apply_batch(1, _batch(0, _stored(H[0])))
+
+    # Engine 0 holds blocks 0..2, engine 1 only block 0.
+    assert idx.longest_prefix([H[0], H[1], H[2]]) == {0: 3, 1: 1}
+    # Consecutive-from-the-start only: a hole stops the count even if a
+    # later block is resident.
+    idx.apply_batch(2, _batch(0, _stored(H[0], H[2])))
+    assert idx.longest_prefix([H[0], H[1], H[2]])[2] == 1
+
+    # Eviction shortens the match.
+    idx.apply_batch(0, _batch(1, _removed(H[1])))
+    assert idx.longest_prefix([H[0], H[1], H[2]])[0] == 1
+    # Zero-hit engines are omitted entirely.
+    idx.apply_batch(1, _batch(1, _removed(H[0])))
+    assert 1 not in idx.longest_prefix([H[0], H[1]])
+
+
+def test_candidate_filter():
+    idx = PrefixCacheIndex()
+    idx.apply_batch(0, _batch(0, _stored(H[0])))
+    idx.apply_batch(1, _batch(0, _stored(H[0], H[1])))
+    assert idx.longest_prefix([H[0], H[1]], candidates=[0]) == {0: 1}
+
+
+def test_seq_gap_resyncs_to_empty():
+    idx = PrefixCacheIndex()
+    idx.apply_batch(0, _batch(0, _stored(H[0], H[1])))
+    idx.apply_batch(0, _batch(1, _stored(H[2])))
+    assert idx.resyncs == 0
+    # Dropped batch 2: everything believed about engine 0 is suspect.
+    idx.apply_batch(0, _batch(3, _stored(H[3])))
+    assert idx.resyncs == 1
+    assert idx.longest_prefix([H[0], H[1]]) == {}
+    assert idx.longest_prefix([H[3]]) == {0: 1}
+    # Stream is trusted again from the resync point.
+    idx.apply_batch(0, _batch(4, _stored(H[4])))
+    assert idx.resyncs == 1
+
+
+def test_engine_restart_seq_reset_resyncs():
+    """A respawned engine restarts its seq at 0 — a regression, not just
+    a gap — and must also drop the stale map."""
+    idx = PrefixCacheIndex()
+    idx.apply_batch(0, _batch(0, _stored(H[0])))
+    idx.apply_batch(0, _batch(1, _stored(H[1])))
+    idx.apply_batch(0, _batch(0, _stored(H[5])))
+    assert idx.resyncs == 1
+    assert idx.longest_prefix([H[0]]) == {}
+    assert idx.longest_prefix([H[5]]) == {0: 1}
+
+
+def test_all_blocks_cleared():
+    idx = PrefixCacheIndex()
+    idx.apply_batch(0, _batch(0, _stored(H[0], H[1])))
+    idx.apply_batch(0, _batch(1, {"type": "AllBlocksCleared"}))
+    assert idx.longest_prefix([H[0]]) == {}
+    # Not a resync — the clear arrived in-sequence.
+    assert idx.resyncs == 0
+    idx.apply_batch(0, _batch(2, _stored(H[2])))
+    assert idx.longest_prefix([H[2]]) == {0: 1}
+
+
+def test_drop_engine_forgets_seq_state():
+    idx = PrefixCacheIndex()
+    idx.apply_batch(0, _batch(0, _stored(H[0])))
+    idx.apply_batch(0, _batch(1, _stored(H[1])))
+    idx.drop_engine(0)
+    assert idx.longest_prefix([H[0]]) == {}
+    # A replacement engine starts at seq 0 without tripping a resync.
+    idx.apply_batch(0, _batch(0, _stored(H[2])))
+    assert idx.resyncs == 0
+    assert idx.longest_prefix([H[2]]) == {0: 1}
+
+
+def test_status_shape():
+    idx = PrefixCacheIndex()
+    idx.apply_batch(0, _batch(0, _stored(H[0], H[1])))
+    st = idx.status()
+    assert st["engines"] == {"0": 2}
+    assert st["batches_applied"] == 1
+    assert st["resyncs"] == 0
+
+
+def test_subscriber_end_to_end(tmp_path):
+    """Real publisher -> real SUB thread -> index: the full transport."""
+    import time
+
+    from vllm_tpu.core.kv_events import BlockStored, KVEventPublisher
+    from vllm_tpu.router.prefix_index import KVEventSubscriber
+
+    endpoint = f"ipc://{tmp_path}/kv0.sock"
+    pub = KVEventPublisher(endpoint, block_size=16)
+    idx = PrefixCacheIndex()
+    sub = KVEventSubscriber(idx, {0: endpoint})
+    try:
+        # PUB/SUB joins are async; retry-publish until the index sees it.
+        deadline = time.monotonic() + 10.0
+        while time.monotonic() < deadline:
+            pub.record(BlockStored(
+                block_hashes=[H[0], H[1]], parent_block_hash=None,
+                block_size=16))
+            pub.flush()
+            if idx.longest_prefix([H[0], H[1]]).get(0) == 2:
+                break
+            time.sleep(0.05)
+        assert idx.longest_prefix([H[0], H[1]]) == {0: 2}
+    finally:
+        sub.close()
+        pub.close()

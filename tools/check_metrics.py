@@ -57,6 +57,15 @@ REQUIRED_CONTAINMENT_METRICS = {
     "vllm:requests_quarantined_total",
 }
 
+# Documented in the README ("Frontend scale-out & KV-aware routing");
+# the session-affinity acceptance test asserts on these names.
+REQUIRED_ROUTER_METRICS = {
+    "vllm:dp_routing_decisions_total",
+    "vllm:dp_prefix_hit_blocks",
+    "vllm:api_server_index",
+    "vllm:api_server_count",
+}
+
 
 def check() -> list[str]:
     """Return a list of lint errors (empty = clean)."""
@@ -118,6 +127,10 @@ def check() -> list[str]:
     for name in sorted(REQUIRED_CONTAINMENT_METRICS - set(seen)):
         errors.append(
             f"required containment metric {name} is missing from "
+            f"the registry (documented in README)")
+    for name in sorted(REQUIRED_ROUTER_METRICS - set(seen)):
+        errors.append(
+            f"required router metric {name} is missing from "
             f"the registry (documented in README)")
 
     return errors

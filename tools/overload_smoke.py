@@ -12,7 +12,7 @@ asserts the books balance:
 - the ``vllm:requests_shed_total`` counter delta on ``/metrics``
   equals the number of 429/503 responses observed by the client.
 
-Two modes:
+Three modes:
 
 - default (no flags): self-contained — builds a tiny random-weight
   checkpoint, an in-proc AsyncLLM with ``max_inflight_requests=2``,
@@ -20,7 +20,12 @@ Two modes:
   (same wiring as ``tests/resilience/test_overload.py``);
 - ``--base-url http://host:port``: bursts against a live server (its
   caps must be low enough for the burst to shed, e.g.
-  ``--max-inflight-requests 2``).
+  ``--max-inflight-requests 2``);
+- ``--api-server-count N`` (N > 1): self-contained multi-frontend —
+  launches the sharded topology as a subprocess, bursts the shared
+  port, and sums served/shed across every frontend shard's admin-port
+  ``/metrics`` — the books must balance **in aggregate** even though
+  each shard only sees its slice of the burst.
 
 Run: ``JAX_PLATFORMS=cpu python tools/overload_smoke.py``
 Exit 0 on balanced books, non-zero otherwise.
@@ -171,6 +176,119 @@ async def _remote(base_url: str, burst: int, max_tokens: int) -> int:
             session, base_url.rstrip("/"), burst, max_tokens)
 
 
+async def _shard_metrics_total(session, admin_urls: list[str]) -> float:
+    """Sum the shed counter across every frontend shard's admin port."""
+    total = 0.0
+    for url in admin_urls:
+        async with session.get(f"{url}/metrics") as resp:
+            total += _shed_total(await resp.text())
+    return total
+
+
+async def _multi_burst(base_url: str, admin_urls: list[str], burst: int,
+                       max_tokens: int) -> int:
+    """Burst the shared port; balance the books against the SUM of
+    per-shard shed counters (each frontend owns its slice of the
+    admission budget and its own metrics registry)."""
+    import aiohttp
+
+    async with aiohttp.ClientSession() as session:
+        shed_before = await _shard_metrics_total(session, admin_urls)
+        served, shed, errors = await _burst(
+            session, base_url, burst, max_tokens)
+        shed_after = await _shard_metrics_total(session, admin_urls)
+
+    print(f"burst={burst} served={served} shed={shed} "
+          f"shard_shed_delta={shed_after - shed_before:g} "
+          f"shards={len(admin_urls)}")
+    for err in errors:
+        print(f"ERROR: {err}")
+    if errors:
+        return 2
+    if served + shed != burst:
+        print(f"FAIL: served + shed = {served + shed} != burst {burst}")
+        return 3
+    if shed_after - shed_before != shed:
+        print(f"FAIL: summed vllm:requests_shed_total across "
+              f"{len(admin_urls)} shards moved by "
+              f"{shed_after - shed_before:g}, client saw {shed} sheds")
+        return 4
+    if shed == 0:
+        print("WARN: nothing was shed — caps not tight enough for this "
+              "burst; accounting check is vacuous")
+    print("ok: shed-vs-served accounting balances across frontend shards")
+    return 0
+
+
+async def _wait_ready(urls: list[str], timeout_s: float) -> None:
+    import aiohttp
+
+    deadline = asyncio.get_event_loop().time() + timeout_s
+    async with aiohttp.ClientSession() as session:
+        for url in urls:
+            while True:
+                try:
+                    async with session.get(
+                        f"{url}/ready",
+                        timeout=aiohttp.ClientTimeout(total=2),
+                    ) as resp:
+                        if resp.status == 200:
+                            break
+                except Exception:  # noqa: BLE001 - still booting
+                    pass
+                if asyncio.get_event_loop().time() > deadline:
+                    raise TimeoutError(f"{url}/ready never came up")
+                await asyncio.sleep(0.5)
+
+
+def _multi(n_frontends: int, burst: int, max_tokens: int) -> int:
+    import signal
+    import socket
+    import subprocess
+
+    from tests.models.utils import tiny_llama_dir
+    from vllm_tpu.router.topology import admin_port_for
+
+    with tempfile.TemporaryDirectory() as tmp:
+        ckpt = tiny_llama_dir(os.path.join(tmp, "ckpt"))
+        with socket.socket() as s:
+            s.bind(("127.0.0.1", 0))
+            port = s.getsockname()[1]
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "vllm_tpu.entrypoints.cli.main",
+             "serve", ckpt,
+             "--host", "127.0.0.1", "--port", str(port),
+             "--api-server-count", str(n_frontends),
+             "--dtype", "float32", "--max-model-len", "128",
+             "--block-size", "16", "--num-gpu-blocks-override", "64",
+             "--max-num-seqs", "8", "--max-num-batched-tokens", "128",
+             "--max-inflight-requests", "4"],
+            env=env,
+        )
+        try:
+            admin_urls = [
+                f"http://127.0.0.1:{admin_port_for(port, k)}"
+                for k in range(n_frontends)
+            ]
+            asyncio.run(_wait_ready(admin_urls, timeout_s=180.0))
+            rc = asyncio.run(_multi_burst(
+                f"http://127.0.0.1:{port}", admin_urls, burst, max_tokens))
+        finally:
+            proc.send_signal(signal.SIGTERM)
+            try:
+                exit_code = proc.wait(timeout=60)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+                proc.wait()
+                print("FAIL: topology did not drain on SIGTERM")
+                return 5
+        if exit_code != 0:
+            print(f"FAIL: topology exited {exit_code} on SIGTERM drain")
+            return 6
+        return rc
+
+
 def main() -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--base-url", default=None,
@@ -181,11 +299,16 @@ def main() -> int:
     ap.add_argument("--max-tokens", type=int, default=32,
                     help="decode length per request — long enough that "
                          "the burst overlaps (default 32)")
+    ap.add_argument("--api-server-count", type=int, default=1,
+                    help="launch a sharded multi-frontend topology and "
+                         "assert the books balance summed across shards")
     args = ap.parse_args()
 
     if args.base_url:
         return asyncio.run(_remote(args.base_url, args.burst,
                                    args.max_tokens))
+    if args.api_server_count > 1:
+        return _multi(args.api_server_count, args.burst, args.max_tokens)
     return asyncio.run(_selftest(args.burst, args.max_tokens))
 
 

@@ -1,4 +1,4 @@
-"""Benchmark harness: latency / throughput / serve.
+"""Benchmark harness: latency / throughput / serve / sessions.
 
 Protocol mirrors the reference's `vllm bench {latency,throughput,serve}`
 (``vllm/benchmarks/``, .buildkite/performance-benchmarks-descriptions.md):
@@ -6,6 +6,11 @@ Protocol mirrors the reference's `vllm bench {latency,throughput,serve}`
   throughput — N prompts, continuous batching, req/s + tok/s
   serve      — Poisson arrivals at --qps against the AsyncLLM engine,
                TTFT / ITL / e2e percentiles
+  sessions   — multi-turn chat traffic (--sessions concurrent chats x
+               --turns-per-session turns; each turn re-sends the growing
+               conversation) — the prefix-cache / KV-aware-routing
+               workload: reports prefix-hit rate and the frontend's
+               detokenizer CPU share alongside tok/s
 """
 
 from __future__ import annotations
@@ -77,6 +82,8 @@ def run_bench(args) -> dict:
     )
     if args.mode == "serve":
         return _run_serve(args, params)
+    if args.mode == "sessions":
+        return _run_sessions(args, params)
 
     llm = _build_llm(args)
     # Warmup compile.
@@ -167,6 +174,124 @@ def _run_serve(args, params) -> dict:
             _emit(combined, args.json_out)
             return combined
         result = _serve_one(engine, args, params, args.qps)
+        _emit(result, args.json_out)
+        return result
+    finally:
+        engine.shutdown()
+
+
+def _run_sessions(args, params) -> dict:
+    """Multi-turn chat benchmark against an in-proc AsyncLLM.
+
+    ``--sessions`` concurrent chats run ``--turns-per-session`` turns
+    each; turn t re-sends the whole conversation so far (seed prompt +
+    every prior completion) plus a fresh ``--input-len``-token user
+    chunk, so turns >= 2 share a long cached prefix with their own
+    session and nothing with other sessions. This is the workload
+    prefix-cache-aware DP routing exists for: with
+    ``--data-parallel-engines N`` the follow-up turns only hit cache if
+    they land on the engine that served the session's earlier turns.
+
+    Reports, alongside output tok/s:
+
+    - ``prefix_hit_rate`` (cached / prompt tokens, engine-reported per
+      request — survives the MP boundary, unlike the scheduler-side
+      counter) overall and for follow-up turns only;
+    - ``detok_cpu_share``: this frontend's cumulative detokenizer
+      seconds over wall time — the per-frontend number that motivates
+      ``--api-server-count`` scale-out (each shard of a multi-server
+      topology exposes its own via the admin-port ``/debug/requests``).
+    """
+    from dataclasses import replace as _rep
+
+    from vllm_tpu.engine.arg_utils import AsyncEngineArgs
+    from vllm_tpu.engine.async_llm import AsyncLLM
+    from vllm_tpu.sampling_params import RequestOutputKind
+
+    fields = {f.name for f in __import__("dataclasses").fields(AsyncEngineArgs)}
+    engine_args = AsyncEngineArgs(
+        **{k: v for k, v in vars(args).items() if k in fields}
+    )
+    params = _rep(params, output_kind=RequestOutputKind.DELTA)
+    n_sessions = args.sessions
+    n_turns = args.turns_per_session
+    vocab = 30000
+    engine = AsyncLLM.from_engine_args(engine_args)
+    try:
+        # turns[i] = (turn_index, prompt_tokens, cached_tokens, gen_tokens)
+        turns: list = []
+        detok_s = [0.0]
+
+        def _turn_detok(req_id: str) -> float:
+            # Frontend-side detokenizer cost lives in the finished-
+            # timings ring (in-proc frontend only; bounded, so read it
+            # right after each turn finishes).
+            try:
+                ring = engine.output_processor.finished_timings
+            except AttributeError:
+                return 0.0
+            for t in reversed(list(ring)):
+                if t.request_id == req_id:
+                    return t.detokenize_s
+            return 0.0
+
+        async def one_session(g: int) -> None:
+            convo = [(1009 * g + 7 * j) % vocab
+                     for j in range(args.input_len)]
+            for turn in range(n_turns):
+                req_id = f"sess{g}-t{turn}"
+                gen: list[int] = []
+                cached = 0
+                async for out in engine.generate(
+                        {"prompt_token_ids": list(convo)}, params, req_id):
+                    gen.extend(out.outputs[0].token_ids)
+                    cached = max(cached, out.num_cached_tokens)
+                turns.append((turn, len(convo), cached, len(gen)))
+                detok_s[0] += _turn_detok(req_id)
+                convo.extend(gen)
+                convo.extend((1009 * g + 13 * (turn + 1) + 7 * j) % vocab
+                             for j in range(args.input_len))
+
+        async def driver() -> float:
+            t0 = time.monotonic()
+            await asyncio.gather(*[
+                one_session(g) for g in range(n_sessions)])
+            return time.monotonic() - t0
+
+        # Warmup compile outside the timed window.
+        async def warmup() -> None:
+            async for _ in engine.generate(
+                    {"prompt_token_ids": [3, 5, 7, 11]},
+                    _rep(params, max_tokens=2), "sessions-warmup"):
+                pass
+
+        asyncio.run(warmup())
+        wall = asyncio.run(driver())
+
+        prompt_tok = sum(t[1] for t in turns)
+        cached_tok = sum(t[2] for t in turns)
+        gen_tok = sum(t[3] for t in turns)
+        fu = [t for t in turns if t[0] > 0]  # follow-up turns
+        fu_prompt = sum(t[1] for t in fu)
+        fu_cached = sum(t[2] for t in fu)
+        result = {
+            "mode": "sessions",
+            "sessions": n_sessions,
+            "turns_per_session": n_turns,
+            "input_len": args.input_len,
+            "output_len": args.output_len,
+            "elapsed_s": wall,
+            "output_tokens_per_s": gen_tok / wall,
+            "total_tokens_per_s": (prompt_tok + gen_tok) / wall,
+            "prefix_hit_rate": (
+                round(cached_tok / prompt_tok, 4) if prompt_tok else None),
+            "prefix_hit_rate_followup_turns": (
+                round(fu_cached / fu_prompt, 4) if fu_prompt else None),
+            "detok_cpu_share": round(detok_s[0] / wall, 4),
+        }
+        routing = engine.routing_status()
+        if routing is not None:
+            result["routing_decisions"] = routing.get("decisions")
         _emit(result, args.json_out)
         return result
     finally:

@@ -171,6 +171,10 @@ class ParallelConfig:
     # Backend for engine<->worker transport: in-proc by default on TPU since
     # one host drives all local chips via a single jax client.
     distributed_executor_backend: Literal["uniproc", "mp", "external"] = "uniproc"
+    # Frontend scale-out (reference: the `A` in `A + DP + N` — many API
+    # server processes sharing one engine pool over ZMQ; see
+    # vllm_tpu/router/topology.py). 1 = classic single-process frontend.
+    api_server_count: int = 1
 
     @property
     def world_size(self) -> int:

@@ -24,6 +24,16 @@ logger = init_logger(__name__)
 TOPIC = b"kv-events"
 
 
+def _unlink_ipc_socket(endpoint: str) -> None:
+    if endpoint.startswith("ipc://"):
+        import os
+
+        try:
+            os.unlink(endpoint[len("ipc://"):])
+        except OSError:
+            pass
+
+
 @dataclass
 class BlockStored:
     block_hashes: list[bytes]
@@ -61,14 +71,24 @@ class KVEventPublisher:
     ``event_sink``)."""
 
     def __init__(self, endpoint: str, block_size: int) -> None:
+        import atexit
+
         import zmq
 
         self.block_size = block_size
+        self._endpoint = endpoint
+        # A predecessor engine killed uncleanly (OOM/SIGKILL) leaves its
+        # ipc socket file behind and bind() raises EADDRINUSE — unlink
+        # stale files first, exactly like the coordinator does.
+        _unlink_ipc_socket(endpoint)
         self._ctx = zmq.Context(1)
         self._pub = self._ctx.socket(zmq.PUB)
         self._pub.bind(endpoint)
         self._buffer: list[Any] = []
         self._seq = 0
+        # close() unlinks on orderly shutdown; atexit covers sys.exit
+        # paths where the engine tears down without calling close().
+        self._atexit_cb = atexit.register(_unlink_ipc_socket, endpoint)
         logger.info("KV events publishing on %s", endpoint)
 
     # BlockPool sink interface ----------------------------------------
@@ -104,3 +124,7 @@ class KVEventPublisher:
     def close(self) -> None:
         self._pub.close(linger=0)
         self._ctx.term()
+        # atexit stays registered: re-unlinking an already-removed path
+        # is a no-op, and unregistering here would drop OTHER publishers'
+        # callbacks for the same function in-process (tests).
+        _unlink_ipc_socket(self._endpoint)

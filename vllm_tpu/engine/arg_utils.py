@@ -67,6 +67,9 @@ class EngineArgs:
     enable_expert_parallel: bool = False
     distributed_executor_backend: str = "uniproc"
     data_parallel_engines: int = 1
+    # Frontend scale-out: N API-server processes sharing the listen
+    # socket (SO_REUSEPORT) in front of one shared engine pool.
+    api_server_count: int = 1
     data_parallel_lockstep: bool = False
     pipeline_microbatches: int = 0
     enable_eplb: bool = False
@@ -159,6 +162,7 @@ class EngineArgs:
                 enable_expert_parallel=self.enable_expert_parallel,
                 distributed_executor_backend=self.distributed_executor_backend,  # type: ignore[arg-type]
                 data_parallel_engines=self.data_parallel_engines,
+                api_server_count=self.api_server_count,
                 data_parallel_lockstep=self.data_parallel_lockstep,
                 pipeline_microbatches=self.pipeline_microbatches,
                 enable_eplb=self.enable_eplb,

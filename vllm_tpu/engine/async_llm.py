@@ -141,7 +141,8 @@ class AsyncStream:
 
 
 class AsyncLLM:
-    def __init__(self, config: EngineConfig, start: bool = True) -> None:
+    def __init__(self, config: EngineConfig, start: bool = True,
+                 client: Any | None = None) -> None:
         self.config = config = config.finalize()
         self.resilience = config.resilience_config
         self.lifecycle = config.lifecycle_config
@@ -172,7 +173,11 @@ class AsyncLLM:
             if self.resilience.enable_recovery
             else None
         )
-        self.engine_core = make_client(config)
+        # ``client`` injects a pre-built engine client (the multi-API-
+        # server topology's SharedDPClient, which talks to an engine
+        # pool owned by the launcher, not by this process).
+        self.engine_core = client if client is not None else (
+            make_client(config))
         self.input_processor = InputProcessor(config)
         self.output_processor = OutputProcessor(
             self.input_processor.tokenizer, journal=self.journal,
@@ -742,6 +747,16 @@ class AsyncLLM:
                 if self.quarantine is not None else None
             ),
         }
+
+    def routing_status(self, drain: bool = False) -> dict | None:
+        """DP routing-decision counters (prefix / least-loaded /
+        round-robin) + prefix-index health, or None when the client does
+        not do prefix-aware routing. Feeds /metrics (drain=True: takes
+        ownership of pending prefix-hit lengths) and /health."""
+        client = self.engine_core
+        if hasattr(client, "routing_status"):
+            return client.routing_status(drain=drain)
+        return None
 
     def debug_deadletter(self) -> dict:
         """Dead-letter introspection (/debug/deadletter): quarantined

@@ -99,10 +99,13 @@ def run_coordinator(report_addr: str, pub_addr: str,
     loads: dict[int, tuple[int, int]] = {
         i: (0, 0) for i in range(num_engines)
     }
-    # Requests the frontend has accepted but engines may not have dequeued
-    # yet: counting them keeps the wave open across the client->engine hop
-    # (the reference attaches wave numbers to requests for the same race).
-    client_inflight = 0
+    # Requests the frontend(s) have accepted but engines may not have
+    # dequeued yet: counting them keeps the wave open across the
+    # client->engine hop (the reference attaches wave numbers to
+    # requests for the same race). Keyed per frontend client — with
+    # --api-server-count N there are N reporters whose counts must SUM,
+    # not overwrite (reports without a client_id share key "0").
+    client_inflight: dict[str, int] = {}
     wave = 0
     global_unfinished = False
     last_pub = 0.0
@@ -135,7 +138,9 @@ def run_coordinator(report_addr: str, pub_addr: str,
                         # until the replacement's first report).
                         loads[int(msg["engine_down"])] = (0, 0)
                     elif "client_inflight" in msg:
-                        client_inflight = int(msg["client_inflight"])
+                        client_inflight[str(msg.get("client_id", "0"))] = (
+                            int(msg["client_inflight"])
+                        )
                     else:
                         eid = int(msg["engine_id"])
                         loads[eid] = (
@@ -143,7 +148,7 @@ def run_coordinator(report_addr: str, pub_addr: str,
                         )
                     changed = True
             now_unfinished = (
-                client_inflight > 0
+                any(c > 0 for c in client_inflight.values())
                 or any(w + r > 0 for w, r in loads.values())
             )
             if global_unfinished and not now_unfinished:

@@ -781,6 +781,7 @@ class DPLBClient(_ZMQClientBase):
         self._procs = []
         self._engine_cfg_bytes: list[bytes] = []
         self._engine_kwargs: list[dict] = []
+        kv_endpoints: dict[int, str] = {}
         for eid in range(n):
             engine_config = copy.deepcopy(config)
             engine_config.parallel_config.data_parallel_engines = 1
@@ -799,6 +800,10 @@ class DPLBClient(_ZMQClientBase):
                     engine_config.cache_config.kv_events_endpoint = (
                         f"{ep}.dp{eid}"
                     )
+            if engine_config.cache_config.kv_events_endpoint:
+                kv_endpoints[eid] = (
+                    engine_config.cache_config.kv_events_endpoint
+                )
             input_addr = f"ipc://{run_dir}/in{eid}-{suffix}.sock"
             sock = self._ctx.socket(zmq.PUSH)
             sock.bind(input_addr)
@@ -825,6 +830,29 @@ class DPLBClient(_ZMQClientBase):
             ))
             self._procs.append(self._spawn_dp_engine(eid, input_addr))
         atexit.register(self.shutdown)
+
+        # Prefix-cache-aware routing (opt-in via --kv-events-endpoint):
+        # SUBscribe to every engine's block-lifecycle stream and place
+        # requests on the engine already holding their longest prefix.
+        self._prefix_router = None
+        self._prefix_index = None
+        self._kv_subscriber = None
+        self._routing_stats = None
+        if kv_endpoints:
+            from vllm_tpu.router.policy import PrefixAwareRouter, RoutingStats
+            from vllm_tpu.router.prefix_index import (
+                KVEventSubscriber,
+                PrefixCacheIndex,
+            )
+
+            self._prefix_index = PrefixCacheIndex()
+            self._kv_subscriber = KVEventSubscriber(
+                self._prefix_index, kv_endpoints
+            )
+            self._prefix_router = PrefixAwareRouter(
+                self._prefix_index, config.cache_config.block_size
+            )
+            self._routing_stats = RoutingStats()
 
         self._dead = False
         self._live: dict[str, int] = {}  # req_id -> engine_id
@@ -906,6 +934,10 @@ class DPLBClient(_ZMQClientBase):
         for rid in lost:
             del self._live[rid]
         self._engine_inflight[eid] = 0
+        if getattr(self, "_prefix_index", None) is not None:
+            # The replacement boots with an empty prefix cache; waiting
+            # for its seq-gap resync would mis-route in the meantime.
+            self._prefix_index.drop_engine(eid)
         self._drain_stale_outputs(set(lost))
         # Zero the dead rank's load at the coordinator: a stale nonzero
         # load would hold the wave open with lockstep ranks
@@ -1024,6 +1056,18 @@ class DPLBClient(_ZMQClientBase):
             > self._resilience.coordinator_stale_after_s
         )
 
+    def routing_status(self, drain: bool = False) -> dict | None:
+        """Routing-decision counters + index health for /metrics and
+        /health, or None when prefix-aware routing is not configured.
+        ``drain=True`` (metrics renderer only) hands over the pending
+        prefix-hit lengths for histogram observation."""
+        if getattr(self, "_routing_stats", None) is None:
+            return None
+        status = self._routing_stats.snapshot(drain=drain)
+        if getattr(self, "_prefix_index", None) is not None:
+            status["index"] = self._prefix_index.status()
+        return status
+
     def coordinator_status(self) -> dict:
         """JSON-shaped snapshot for /health /metrics (control-plane view:
         never part of data-plane readiness). routing_degraded is computed
@@ -1086,13 +1130,35 @@ class DPLBClient(_ZMQClientBase):
                 "stale" if stale else "fresh again",
                 "round-robin" if stale else "least-loaded",
             )
-        if stale:
+        # Routing ladder: prefix hit > least-loaded > round-robin. The
+        # prefix index is fed DIRECTLY by engine kv_events (not via the
+        # coordinator), so prefix placement stays valid even when the
+        # load snapshot is stale.
+        decision = None
+        # getattr: unit tests build clients bare via __new__ without the
+        # optional routing attributes (the FakeClient idiom).
+        if getattr(self, "_prefix_router", None) is not None:
+            decision = self._prefix_router.choose(
+                req, candidates,
+                {i: self._engine_inflight[i] for i in candidates},
+            )
+        if decision is not None:
+            eid = decision.engine_id
+        elif stale:
             eid = candidates[self._rr % len(candidates)]
             self._rr += 1
         else:
             eid = min(
                 candidates,
                 key=lambda i: self._engine_inflight[i],
+            )
+        if getattr(self, "_routing_stats", None) is not None:
+            from vllm_tpu.router.policy import RoutingDecision
+
+            self._routing_stats.note(
+                decision if decision is not None else RoutingDecision(
+                    eid, "round_robin" if stale else "least_loaded"
+                )
             )
         self._live[req.request_id] = eid
         self._engine_inflight[eid] += 1
@@ -1174,6 +1240,12 @@ class DPLBClient(_ZMQClientBase):
         self._closing = True
         if not getattr(self, "_procs", None):
             return
+        if getattr(self, "_kv_subscriber", None) is not None:
+            try:
+                self._kv_subscriber.close()
+            except Exception:
+                pass
+            self._kv_subscriber = None
         try:
             if self._coord.is_alive():
                 self._coord.terminate()

@@ -27,17 +27,26 @@ MSG_UTILITY_REPLY = b"UTILREP"
 
 
 def run_engine_core(config_bytes: bytes, input_addr: str,
-                    output_addr: str, engine_id: int = 0,
+                    output_addr: "str | list[str]", engine_id: int = 0,
                     coord_report_addr: str | None = None,
                     coord_pub_addr: str | None = None,
                     lockstep: bool = False,
-                    extra_env: dict[str, str] | None = None) -> None:
+                    extra_env: dict[str, str] | None = None,
+                    bind_input: bool = False) -> None:
     """Process entry point (spawn target).
 
     With ``coord_*`` addresses set this is the DP variant (reference
     ``DPEngineCoreProc``, ``core.py:1622``): the proc reports its load to
     the coordinator after every iteration and, when ``lockstep`` is on,
     runs dummy batches while other DP ranks still have work in the wave.
+
+    Multi-API-server topology (reference: many API servers, one engine
+    pool): ``output_addr`` may be a LIST of per-frontend addresses — the
+    engine opens one PUSH per frontend and routes each request's outputs
+    back to output socket ``request.client_index``; READY/DEAD broadcast
+    to every frontend. ``bind_input=True`` flips the input topology: the
+    engine BINDS its PULL socket and the N frontends connect PUSH — so
+    frontends can come and go (crash/respawn) without the engine caring.
     """
     import os
 
@@ -70,9 +79,32 @@ def run_engine_core(config_bytes: bytes, input_addr: str,
     logger = init_logger("vllm_tpu.engine.core_proc")
     ctx = zmq.Context(1)
     inp = ctx.socket(zmq.PULL)
-    inp.connect(input_addr)
-    out = ctx.socket(zmq.PUSH)
-    out.connect(output_addr)
+    if bind_input:
+        # Engine owns the input endpoint; unlink a stale ipc file left
+        # by an uncleanly-killed predecessor (same hygiene as the
+        # coordinator and KVEventPublisher).
+        if input_addr.startswith("ipc://"):
+            try:
+                os.unlink(input_addr[len("ipc://"):])
+            except OSError:
+                pass
+        inp.bind(input_addr)
+    else:
+        inp.connect(input_addr)
+    output_addrs = (
+        [output_addr] if isinstance(output_addr, str) else list(output_addr)
+    )
+    outs = []
+    for addr in output_addrs:
+        sock = ctx.socket(zmq.PUSH)
+        sock.connect(addr)
+        outs.append(sock)
+    out = outs[0]
+    # request_id -> frontend output index, for multi-frontend routing.
+    req_client: dict[str, int] = {}
+
+    def out_for(req_id: str):
+        return outs[req_client.get(req_id, 0) % len(outs)]
 
     # DP coordinator plumbing (absent for the single-engine path).
     coord_push = coord_sub = None
@@ -126,13 +158,15 @@ def run_engine_core(config_bytes: bytes, input_addr: str,
         # Third frame identifies WHICH engine died so the DP client's
         # supervisor respawns the right rank; fourth carries the request
         # ids that were in flight at death — the quarantine manager's
-        # suspect set for poison-request bisection.
-        out.send_multipart([
-            MSG_DEAD,
-            reason.encode(),
-            str(engine_id).encode(),
-            serial_utils.encode(suspects),
-        ])
+        # suspect set for poison-request bisection. Every frontend gets
+        # the notice: each must stop routing to this rank.
+        for sock in outs:
+            sock.send_multipart([
+                MSG_DEAD,
+                reason.encode(),
+                str(engine_id).encode(),
+                serial_utils.encode(suspects),
+            ])
 
     def install_watchdog_escalation(engine_core) -> None:
         """Make a step-watchdog trip look like an engine crash.
@@ -156,17 +190,18 @@ def run_engine_core(config_bytes: bytes, input_addr: str,
             except Exception:
                 suspects = list(req_ids)
             try:
-                death = ctx.socket(zmq.PUSH)
-                death.connect(output_addr)
-                death.send_multipart([
-                    MSG_DEAD,
-                    (f"device hang: step exceeded "
-                     f"{watchdog.timeout_s:.1f}s watchdog deadline "
-                     f"(elapsed {elapsed:.1f}s)").encode(),
-                    str(engine_id).encode(),
-                    serial_utils.encode(suspects),
-                ])
-                death.close(linger=1000)
+                for addr in output_addrs:
+                    death = ctx.socket(zmq.PUSH)
+                    death.connect(addr)
+                    death.send_multipart([
+                        MSG_DEAD,
+                        (f"device hang: step exceeded "
+                         f"{watchdog.timeout_s:.1f}s watchdog deadline "
+                         f"(elapsed {elapsed:.1f}s)").encode(),
+                        str(engine_id).encode(),
+                        serial_utils.encode(suspects),
+                    ])
+                    death.close(linger=1000)
             except Exception:
                 logger.exception("watchdog escalation send failed")
             os._exit(1)
@@ -178,13 +213,14 @@ def run_engine_core(config_bytes: bytes, input_addr: str,
         config = pickle.loads(config_bytes)
         core = EngineCore(config)
         install_watchdog_escalation(core)
-        out.send_multipart([
-            MSG_READY,
-            serial_utils.encode(
-                {"num_gpu_blocks": config.cache_config.num_gpu_blocks,
-                 "engine_id": engine_id}
-            ),
-        ])
+        for sock in outs:
+            sock.send_multipart([
+                MSG_READY,
+                serial_utils.encode(
+                    {"num_gpu_blocks": config.cache_config.num_gpu_blocks,
+                     "engine_id": engine_id}
+                ),
+            ])
 
         while True:
             busy = core.has_unfinished_requests()
@@ -197,6 +233,9 @@ def run_engine_core(config_bytes: bytes, input_addr: str,
                 kind = frames[0]
                 if kind == MSG_ADD:
                     req = serial_utils.decode(frames[1])
+                    if len(outs) > 1:
+                        req_client[req.request_id] = int(
+                            getattr(req, "client_index", 0))
                     try:
                         core.add_request(req)
                     except Exception as e:
@@ -209,7 +248,7 @@ def run_engine_core(config_bytes: bytes, input_addr: str,
                             EngineCoreOutputs,
                         )
 
-                        out.send_multipart([
+                        out_for(req.request_id).send_multipart([
                             MSG_OUTPUTS,
                             serial_utils.encode(EngineCoreOutputs(
                                 outputs=[EngineCoreOutput(
@@ -219,14 +258,25 @@ def run_engine_core(config_bytes: bytes, input_addr: str,
                                 )],
                             )),
                         ])
+                        req_client.pop(req.request_id, None)
                 elif kind == MSG_ABORT:
-                    core.abort_requests(serial_utils.decode(frames[1]))
+                    abort_ids = serial_utils.decode(frames[1])
+                    core.abort_requests(abort_ids)
+                    for rid in abort_ids:
+                        req_client.pop(rid, None)
                 elif kind == MSG_UTILITY:
                     method = frames[1].decode()
                     args = (
                         serial_utils.decode(frames[2])
                         if len(frames) > 2
                         else []
+                    )
+                    # Optional 4th frame: which frontend asked — the
+                    # reply must land on ITS output socket (older
+                    # 3-frame clients implicitly mean frontend 0).
+                    reply_to = (
+                        int(frames[3]) % len(outs) if len(frames) > 3
+                        else 0
                     )
                     # A failing utility (e.g. sleep with active requests,
                     # bad reload path) fails the CALL, not the engine.
@@ -237,7 +287,7 @@ def run_engine_core(config_bytes: bytes, input_addr: str,
                         logger.error("utility %s failed: %s", method, e)
                         result = {"error": f"{type(e).__name__}: {e}",
                                   "engine_id": engine_id}
-                    out.send_multipart([
+                    outs[reply_to].send_multipart([
                         MSG_UTILITY_REPLY, serial_utils.encode(result)
                     ])
                 elif kind == MSG_SHUTDOWN:
@@ -256,10 +306,34 @@ def run_engine_core(config_bytes: bytes, input_addr: str,
                 continue
             outputs = core.step()
             report_load()
-            if outputs.outputs:
+            if not outputs.outputs:
+                pass
+            elif len(outs) == 1:
                 out.send_multipart(
                     [MSG_OUTPUTS, serial_utils.encode(outputs)]
                 )
+            else:
+                # Multi-frontend: split the step's outputs by owning
+                # frontend; each non-empty slice rides its own socket
+                # with the step's scheduler_stats attached (every
+                # frontend's metrics see engine-level stats).
+                by_client: dict[int, list] = {}
+                for o in outputs.outputs:
+                    idx = req_client.get(o.req_id, 0) % len(outs)
+                    by_client.setdefault(idx, []).append(o)
+                    if o.finish_reason is not None:
+                        req_client.pop(o.req_id, None)
+                from vllm_tpu.core.sched_output import EngineCoreOutputs
+
+                for idx, slice_outs in by_client.items():
+                    outs[idx].send_multipart([
+                        MSG_OUTPUTS,
+                        serial_utils.encode(EngineCoreOutputs(
+                            outputs=slice_outs,
+                            scheduler_stats=outputs.scheduler_stats,
+                            timestamp=outputs.timestamp,
+                        )),
+                    ])
     except Exception:
         tb = traceback.format_exc()
         logger.error("engine core proc died:\n%s", tb)
@@ -277,7 +351,13 @@ def run_engine_core(config_bytes: bytes, input_addr: str,
         if core is not None:
             core.shutdown()
         inp.close(linger=0)
-        out.close(linger=0)
+        for sock in outs:
+            sock.close(linger=0)
+        if bind_input and input_addr.startswith("ipc://"):
+            try:
+                os.unlink(input_addr[len("ipc://"):])
+            except OSError:
+                pass
         if coord_push is not None:
             coord_push.close(linger=0)
             coord_sub.close(linger=0)

@@ -64,8 +64,10 @@ def _run_complete(args: argparse.Namespace) -> None:
 
 
 def _add_bench(sub: argparse._SubParsersAction) -> None:
-    p = sub.add_parser("bench", help="Benchmarks (latency/throughput/serve)")
-    p.add_argument("mode", choices=["latency", "throughput", "serve"])
+    p = sub.add_parser(
+        "bench", help="Benchmarks (latency/throughput/serve/sessions)")
+    p.add_argument("mode",
+                   choices=["latency", "throughput", "serve", "sessions"])
     p.add_argument("--json", dest="json_out", default=None)
     EngineArgs.add_cli_args(p)
     p.add_argument("--num-prompts", type=int, default=100)
@@ -86,6 +88,15 @@ def _add_bench(sub: argparse._SubParsersAction) -> None:
         "--qps-sweep", default=None,
         help='serve mode QPS grid, e.g. "1,4,16,0" (0=inf); one engine, '
              "one combined result (the reference's bench serve sweep)",
+    )
+    p.add_argument(
+        "--sessions", type=int, default=8,
+        help="sessions mode: concurrent multi-turn chats",
+    )
+    p.add_argument(
+        "--turns-per-session", type=int, default=4,
+        help="sessions mode: turns per chat (each turn re-sends the "
+             "growing conversation — the prefix-cache workload)",
     )
     p.set_defaults(func=_run_bench)
 

@@ -19,6 +19,7 @@ from __future__ import annotations
 import asyncio
 import json
 import math
+import os
 from typing import Any, AsyncIterator
 
 from aiohttp import web
@@ -565,12 +566,28 @@ async def handle_health(request: web.Request) -> web.Response:
         health = "healthy"
     body = {
         "status": health,
+        # In the multi-API-server topology each frontend is a separate
+        # process behind a shared port; pid lets operators (and the
+        # crash-replay test) target a specific shard.
+        "pid": os.getpid(),
         "engines": engines,
         "requests_replayed_total": status.get(
             "requests_replayed_total", 0),
         "requests_failed_on_crash_total": status.get(
             "requests_failed_on_crash_total", 0),
+        "requests_lost_on_restart_total": status.get(
+            "requests_lost_on_restart_total", 0),
     }
+    # Multi-API-server topology: WHICH frontend shard answered, plus its
+    # DP routing-decision view (prefix/least-loaded/round-robin counts).
+    client = getattr(engine, "engine_core", None)
+    if client is not None and hasattr(client, "client_index"):
+        body["api_server_index"] = client.client_index
+    if hasattr(engine, "routing_status"):
+        routing = engine.routing_status()
+        if routing is not None:
+            body["routing"] = routing["decisions"]
+            body["prefix_index"] = routing.get("index")
     return web.json_response(body, status=503 if dead else 200)
 
 
@@ -778,6 +795,18 @@ def run_server(engine_args, host: str = "0.0.0.0", port: int = 8000,
     import signal
 
     from vllm_tpu.metrics.prometheus import PrometheusRegistry
+
+    # Frontend scale-out: N API-server processes sharing the listen
+    # socket in front of one shared engine pool (vllm_tpu/router/).
+    # The launcher owns the whole topology and never returns.
+    if getattr(engine_args, "api_server_count", 1) > 1:
+        from vllm_tpu.router.topology import run_multi_server
+
+        run_multi_server(
+            engine_args, host=host, port=port,
+            tool_parser=tool_parser, reasoning_parser=reasoning_parser,
+        )
+        return
 
     engine = AsyncLLM.from_engine_args(engine_args)
     metrics = PrometheusRegistry(engine)

@@ -312,6 +312,26 @@ class PrometheusRegistry:
         self.requests_quarantined = Counter(
             "vllm:requests_quarantined_total",
             "Requests dead-lettered by poison-request quarantine")
+        # Frontend scale-out + prefix-cache-aware DP routing (PR 6):
+        # decision counters drained from the client's RoutingStats at
+        # render time (drain=True — each prefix-hit length must land in
+        # the histogram exactly once; /health peeks with drain=False).
+        self.dp_routing_decisions = LabeledCounter(
+            "vllm:dp_routing_decisions_total",
+            "DP routing decisions by ladder rung "
+            "(prefix = cached-prefix placement, least_loaded = fewest "
+            "in-flight, round_robin = stale-snapshot fallback)", "kind")
+        self.dp_prefix_hit_blocks = Histogram(
+            "vllm:dp_prefix_hit_blocks",
+            "Cached-prefix length (blocks) of prefix-routed requests",
+            [1.0, 2.0, 4.0, 8.0, 16.0, 32.0, 64.0, 128.0])
+        self.api_server_index = Gauge(
+            "vllm:api_server_index",
+            "This frontend's shard index (0-based; 0 when single-server)")
+        self.api_server_count = Gauge(
+            "vllm:api_server_count",
+            "Number of API-server frontends sharing the listen port")
+        self.api_server_count.set(1.0)
         self._metrics = [
             self.num_running, self.num_waiting, self.kv_usage,
             self.prefix_queries, self.prefix_hits, self.preempted,
@@ -334,6 +354,8 @@ class PrometheusRegistry:
             self.lifecycle_draining, self.inflight_prompt_tokens,
             self.numeric_guard_trips, self.step_watchdog_trips,
             self.requests_quarantined,
+            self.dp_routing_decisions, self.dp_prefix_hit_blocks,
+            self.api_server_index, self.api_server_count,
         ]
         self._engine = engine
         self._last_prefix = (0, 0)
@@ -447,6 +469,29 @@ class PrometheusRegistry:
         for site, counts in failpoints.snapshot().items():
             self.failpoints_fired.inc_to(site, float(counts["fires"]))
 
+    def set_frontend(self, index: int, count: int) -> None:
+        """Stamp this registry with its API-server shard identity
+        (called by the multi-server topology launcher)."""
+        self.api_server_index.set(float(index))
+        self.api_server_count.set(float(count))
+
+    def _refresh_routing(self) -> None:
+        engine = self._engine
+        if engine is None or not hasattr(engine, "routing_status"):
+            return
+        try:
+            status = engine.routing_status(drain=True)
+        except Exception:
+            return
+        if not status:
+            return
+        # Decision totals are cumulative in RoutingStats → ratchet; hit
+        # lengths arrive drained (since last render) → observe each once.
+        for kind, n in status.get("decisions", {}).items():
+            self.dp_routing_decisions.inc_to(kind, float(n))
+        for blocks in status.get("hit_blocks", []):
+            self.dp_prefix_hit_blocks.observe(float(blocks))
+
     def _refresh_lifecycle(self) -> None:
         engine = self._engine
         if engine is None or not hasattr(engine, "lifecycle_status"):
@@ -470,6 +515,7 @@ class PrometheusRegistry:
     def render(self) -> str:
         self._refresh_resilience()
         self._refresh_lifecycle()
+        self._refresh_routing()
         self._refresh_failpoints()
         return "".join(m.render() for m in self._metrics)
 

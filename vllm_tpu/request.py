@@ -63,6 +63,10 @@ class EngineCoreRequest:
     # request in ANY process (frontend, engine core, worker) carry it, so
     # per-process chrome-trace files fuse into one per-request flow.
     trace_id: str | None = None
+    # Which frontend (API-server shard) submitted this request. Engines
+    # with multiple output sockets route this request's outputs back to
+    # output socket [client_index]; single-frontend topologies leave 0.
+    client_index: int = 0
 
 
 class Request:

@@ -1,0 +1,22 @@
+"""Frontend scale-out: multi-API-server topology + KV-aware DP routing.
+
+Reference analog: vLLM's ``A + DP + N (+1 coordinator)`` process
+architecture — many API-server processes in front of many engine-core
+processes over ZMQ — plus the external prefix-aware load balancers that
+``vllm/distributed/kv_events.py`` was built to feed.
+
+Layout:
+
+- ``prefix_index``  — PrefixCacheIndex (per-engine resident block-hash
+  map fed by kv_events) + KVEventSubscriber (ZMQ SUB fan-in thread).
+- ``policy``        — PrefixAwareRouter (longest-cached-prefix scoring,
+  least-loaded tiebreak) + RoutingStats (decision counters for
+  ``vllm:dp_routing_decisions_total``).
+- ``shared_client`` — SharedDPClient: frontend-side engine client for
+  the multi-API-server topology (engines bind, frontends connect).
+- ``topology``      — launcher: ``--api-server-count N`` spawns the
+  engine pool + coordinator once and N frontend processes that share
+  the listen socket via SO_REUSEPORT.
+- ``balancer``      — tiny accept-loop TCP balancer fallback for
+  platforms without SO_REUSEPORT.
+"""

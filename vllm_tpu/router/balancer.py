@@ -1,0 +1,105 @@
+"""Accept-loop TCP balancer: SO_REUSEPORT fallback.
+
+On platforms where the kernel can't fan connections out across N
+listeners on one port (no SO_REUSEPORT), the launcher runs this tiny
+process instead: it owns the public port and splices each accepted
+connection to one frontend's private (admin) port, round-robin. Layer-4
+only — no HTTP parsing, so SSE streaming, chunked bodies and websockets
+pass through untouched.
+"""
+
+from __future__ import annotations
+
+import asyncio
+
+from vllm_tpu.logger import init_logger
+
+logger = init_logger(__name__)
+
+
+async def _splice(reader: asyncio.StreamReader,
+                  writer: asyncio.StreamWriter) -> None:
+    try:
+        while True:
+            data = await reader.read(65536)
+            if not data:
+                break
+            writer.write(data)
+            await writer.drain()
+    except (ConnectionError, asyncio.CancelledError):
+        pass
+    finally:
+        try:
+            writer.close()
+        except Exception:
+            pass
+
+
+class AcceptLoopBalancer:
+    """Round-robin L4 proxy from (host, port) to ``backends``."""
+
+    def __init__(self, host: str, port: int,
+                 backends: list[tuple[str, int]]) -> None:
+        self.host = host
+        self.port = port
+        self.backends = backends
+        self._rr = 0
+        self._server: asyncio.AbstractServer | None = None
+
+    async def _handle(self, reader: asyncio.StreamReader,
+                      writer: asyncio.StreamWriter) -> None:
+        # Try every backend once starting at the cursor: a draining or
+        # crashed frontend just gets skipped.
+        last_err: Exception | None = None
+        for i in range(len(self.backends)):
+            host, port = self.backends[(self._rr + i) % len(self.backends)]
+            try:
+                up_reader, up_writer = await asyncio.open_connection(
+                    host, port)
+            except OSError as e:
+                last_err = e
+                continue
+            self._rr = (self._rr + i + 1) % len(self.backends)
+            await asyncio.gather(
+                _splice(reader, up_writer),
+                _splice(up_reader, writer),
+            )
+            return
+        logger.warning("no frontend reachable: %s", last_err)
+        writer.close()
+
+    async def start(self) -> None:
+        self._server = await asyncio.start_server(
+            self._handle, self.host, self.port)
+        logger.info(
+            "accept-loop balancer on %s:%d -> %s",
+            self.host, self.port, self.backends,
+        )
+
+    async def close(self) -> None:
+        if self._server is not None:
+            self._server.close()
+            await self._server.wait_closed()
+
+
+def run_balancer(host: str, port: int,
+                 backends: list[tuple[str, int]]) -> None:
+    """Process entry point (spawn target)."""
+    import signal
+    import sys
+
+    async def _main() -> None:
+        bal = AcceptLoopBalancer(host, port, backends)
+        await bal.start()
+        stop = asyncio.Event()
+        loop = asyncio.get_running_loop()
+        for sig in (signal.SIGTERM, signal.SIGINT):
+            try:
+                loop.add_signal_handler(sig, stop.set)
+            except NotImplementedError:
+                pass
+        await stop.wait()
+        await bal.close()
+
+    asyncio.run(_main())
+    sys.exit(0)

@@ -1,0 +1,137 @@
+"""Prefix-cache-aware DP routing policy.
+
+Decision ladder (each rung falls through to the next):
+
+1. **prefix** — the request's leading block hashes hit ≥1 candidate
+   engine's resident-block index: route to the longest hit (ties broken
+   least-loaded). Chat turn-2 lands on the engine that prefilled
+   turn-1.
+2. **least_loaded** — no prefix hit: route to the candidate with the
+   fewest in-flight requests (the pre-existing DP policy).
+3. **round_robin** — the load snapshot is stale (coordinator down):
+   blind rotation (the pre-existing degraded fallback).
+
+The policy object is shared by ``DPLBClient`` (single frontend) and
+``SharedDPClient`` (multi-API-server topology); the ladder's rungs 2-3
+stay in the client, which owns load/staleness state — this module owns
+rung 1 and the decision accounting.
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass
+
+from vllm_tpu.core.kv_cache_utils import NONE_HASH, hash_block_tokens
+from vllm_tpu.logger import init_logger
+
+logger = init_logger(__name__)
+
+# Cap hashing work per request: 128 blocks at the default block size
+# covers any realistic chat prefix, and keeps routing O(1)-ish for
+# megaprompts (whose tails can't be shared anyway).
+DEFAULT_MAX_PREFIX_BLOCKS = 128
+
+
+@dataclass
+class RoutingDecision:
+    engine_id: int
+    kind: str  # "prefix" | "least_loaded" | "round_robin"
+    hit_blocks: int = 0
+
+
+class RoutingStats:
+    """Thread-safe decision counters + pending prefix-hit lengths.
+
+    The metrics registry drains :meth:`snapshot` at render time
+    (pull-model, like the resilience/lifecycle refreshes).
+    """
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._decisions: dict[str, int] = {
+            "prefix": 0, "least_loaded": 0, "round_robin": 0,
+        }
+        self._pending_hits: list[int] = []
+
+    def note(self, decision: RoutingDecision) -> None:
+        with self._lock:
+            self._decisions[decision.kind] = (
+                self._decisions.get(decision.kind, 0) + 1)
+            if decision.kind == "prefix":
+                self._pending_hits.append(decision.hit_blocks)
+
+    def snapshot(self, drain: bool = True) -> dict:
+        """Counter totals plus hit lengths since the last DRAINING call.
+        Only the metrics renderer drains (each hit length must be
+        observed exactly once by the histogram); /health peeks."""
+        with self._lock:
+            if drain:
+                hits, self._pending_hits = self._pending_hits, []
+            else:
+                hits = list(self._pending_hits)
+            return {"decisions": dict(self._decisions), "hit_blocks": hits}
+
+
+def request_prefix_hashes(
+    request,
+    block_size: int,
+    max_blocks: int = DEFAULT_MAX_PREFIX_BLOCKS,
+) -> list[bytes]:
+    """Chain-hash the request's full prompt blocks, frontend-side.
+
+    Must produce byte-identical hashes to the engine's
+    ``make_block_hasher`` for the index lookup to mean anything — same
+    ``hash_block_tokens`` chain from ``NONE_HASH``. Requests whose KV
+    content depends on more than token ids (LoRA adapters, multimodal
+    embeddings) or that never populate the decode prefix cache
+    (pooling) return [] — the engine hashes those with extra keys we
+    don't replicate here, so scoring them would mismatch.
+    """
+    if (request.lora_name is not None or request.mm_inputs
+            or request.pooling_params is not None):
+        return []
+    tokens = request.prompt_token_ids
+    num_full = min(len(tokens) // block_size, max_blocks)
+    hashes: list[bytes] = []
+    prev = NONE_HASH
+    for i in range(num_full):
+        prev = hash_block_tokens(
+            prev, tokens[i * block_size:(i + 1) * block_size])
+        hashes.append(prev)
+    return hashes
+
+
+class PrefixAwareRouter:
+    """Rung 1 of the ladder: longest-cached-prefix placement."""
+
+    def __init__(
+        self,
+        index,
+        block_size: int,
+        max_blocks: int = DEFAULT_MAX_PREFIX_BLOCKS,
+    ) -> None:
+        self.index = index
+        self.block_size = block_size
+        self.max_blocks = max_blocks
+
+    def choose(
+        self,
+        request,
+        candidates: list[int],
+        inflight: dict[int, int],
+    ) -> RoutingDecision | None:
+        """Best prefix-hit engine among ``candidates``, or None when no
+        candidate holds any of the request's prefix (caller falls
+        through to least-loaded)."""
+        hashes = request_prefix_hashes(
+            request, self.block_size, self.max_blocks)
+        if not hashes:
+            return None
+        hits = self.index.longest_prefix(hashes, candidates)
+        if not hits:
+            return None
+        best_len = max(hits.values())
+        best = [eid for eid, n in hits.items() if n == best_len]
+        eid = min(best, key=lambda i: inflight.get(i, 0))
+        return RoutingDecision(eid, "prefix", best_len)

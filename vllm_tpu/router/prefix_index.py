@@ -1,0 +1,220 @@
+"""Per-engine resident-block index fed by kv_events.
+
+Reference analog: the consumer side of ``vllm/distributed/kv_events.py``
+— an external prefix-aware load balancer subscribes to every engine's
+block lifecycle (BlockStored / BlockRemoved / AllBlocksCleared) and
+keeps a per-engine map of which content hashes are cache-resident, so
+the router can score an incoming request by its longest cached prefix
+on each engine.
+
+Correctness model: the index is a *hint*, never authoritative. A false
+positive (hash listed but since evicted) costs one cold prefill on the
+chosen engine; a false negative (resident but unlisted) costs a missed
+affinity hit. Both are safe, so consistency handling is deliberately
+blunt: any sequence gap or regression on an engine's event stream drops
+that engine's map to empty and rebuilds from live traffic
+(resync-to-empty), and ``AllBlocksCleared`` clears it outright.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Iterable
+
+from vllm_tpu.logger import init_logger
+
+logger = init_logger(__name__)
+
+
+class PrefixCacheIndex:
+    """Thread-safe map engine_id -> set of resident KV block hashes.
+
+    Fed by :class:`KVEventSubscriber` (or directly in tests) via
+    :meth:`apply_batch`; queried by the router via
+    :meth:`longest_prefix`.
+    """
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._blocks: dict[int, set[bytes]] = {}
+        # Last applied batch seq per engine; None = accept any next seq
+        # (first contact, or just resynced). A SUB that joins late sees
+        # an arbitrary starting seq — that only loses history (false
+        # negatives), so the first batch is always accepted.
+        self._last_seq: dict[int, int | None] = {}
+        self.resyncs = 0
+        self.batches_applied = 0
+
+    # Event ingestion --------------------------------------------------
+
+    def apply_batch(self, engine_id: int, batch: dict) -> None:
+        """Apply one published kv_events batch (decoded msgpack dict:
+        ``{"seq": int, "ts": float, "events": [...]}``)."""
+        seq = int(batch["seq"])
+        with self._lock:
+            blocks = self._blocks.setdefault(engine_id, set())
+            last = self._last_seq.get(engine_id)
+            if last is not None and seq != last + 1:
+                # Gap (PUB drop / engine restart resetting seq to 0):
+                # everything we believed about this engine is suspect.
+                logger.warning(
+                    "kv_events seq gap on engine %d (last=%d, got=%d): "
+                    "resyncing index to empty", engine_id, last, seq)
+                blocks.clear()
+                self.resyncs += 1
+            self._last_seq[engine_id] = seq
+            for ev in batch.get("events", ()):
+                kind = ev.get("type")
+                if kind == "BlockStored":
+                    blocks.update(bytes(h) for h in ev["block_hashes"])
+                elif kind == "BlockRemoved":
+                    for h in ev["block_hashes"]:
+                        blocks.discard(bytes(h))
+                elif kind == "AllBlocksCleared":
+                    blocks.clear()
+            self.batches_applied += 1
+
+    def drop_engine(self, engine_id: int) -> None:
+        """Forget an engine entirely (rank died / replaced)."""
+        with self._lock:
+            self._blocks.pop(engine_id, None)
+            self._last_seq.pop(engine_id, None)
+
+    # Router queries ---------------------------------------------------
+
+    def longest_prefix(
+        self,
+        block_hashes: list[bytes],
+        candidates: Iterable[int] | None = None,
+    ) -> dict[int, int]:
+        """Per-engine count of consecutive leading blocks resident.
+
+        ``block_hashes`` is the request's chain-hash list (block i's
+        hash covers blocks 0..i, so consecutive-from-the-start is the
+        only match that means anything). Engines with zero hits are
+        omitted.
+        """
+        with self._lock:
+            engines = (
+                list(candidates) if candidates is not None
+                else list(self._blocks)
+            )
+            out: dict[int, int] = {}
+            for eid in engines:
+                blocks = self._blocks.get(eid)
+                if not blocks:
+                    continue
+                n = 0
+                for h in block_hashes:
+                    if h not in blocks:
+                        break
+                    n += 1
+                if n:
+                    out[eid] = n
+            return out
+
+    def status(self) -> dict:
+        with self._lock:
+            return {
+                "engines": {
+                    str(eid): len(blocks)
+                    for eid, blocks in self._blocks.items()
+                },
+                "resyncs": self.resyncs,
+                "batches_applied": self.batches_applied,
+            }
+
+
+class KVEventSubscriber:
+    """Background SUB fan-in: one socket per engine endpoint, one poll
+    thread applying decoded batches to a :class:`PrefixCacheIndex`."""
+
+    def __init__(
+        self,
+        index: PrefixCacheIndex,
+        endpoints: dict[int, str],
+    ) -> None:
+        import zmq
+
+        self.index = index
+        self._ctx = zmq.Context(1)
+        self._socks: dict[Any, int] = {}
+        self._poller = zmq.Poller()
+        # ipc endpoints whose socket file doesn't exist yet (engine still
+        # booting): connect-before-bind to a missing ipc path leaves the
+        # SUB in a slow retry limbo that drops the first seconds of
+        # publishes (measured: the engine's very first BlockStored batch
+        # is lost, which is precisely the one a fresh frontend needs).
+        # Defer those connects to the poll loop, which watches for the
+        # file to appear. tcp endpoints connect eagerly — their
+        # reconnect path is prompt.
+        self._pending: dict[int, str] = {}
+        for eid, endpoint in endpoints.items():
+            if self._endpoint_ready(endpoint):
+                self._connect(eid, endpoint)
+            else:
+                self._pending[eid] = endpoint
+        self._stop = threading.Event()
+        self._thread = threading.Thread(
+            target=self._run, name="kv-event-sub", daemon=True)
+        self._thread.start()
+        logger.info(
+            "KV-event subscriber following %d engine(s)", len(endpoints))
+
+    @staticmethod
+    def _endpoint_ready(endpoint: str) -> bool:
+        if not endpoint.startswith("ipc://"):
+            return True
+        import os
+
+        return os.path.exists(endpoint[len("ipc://"):])
+
+    def _connect(self, eid: int, endpoint: str) -> None:
+        import zmq
+
+        sock = self._ctx.socket(zmq.SUB)
+        sock.setsockopt(zmq.SUBSCRIBE, b"")
+        # SUB reconnects automatically if the publisher's ipc path
+        # is re-bound by a respawned engine.
+        sock.connect(endpoint)
+        self._socks[sock] = eid
+        self._poller.register(sock, zmq.POLLIN)
+
+    def _run(self) -> None:
+        import msgpack
+        import zmq
+
+        while not self._stop.is_set():
+            if self._pending:
+                for eid, endpoint in list(self._pending.items()):
+                    if self._endpoint_ready(endpoint):
+                        self._connect(eid, endpoint)
+                        del self._pending[eid]
+            try:
+                # Short ticks while connects are pending: an engine's
+                # first BlockStored batch can follow its bind within
+                # tens of ms, and PUB drops everything sent before the
+                # subscription lands.
+                ready = dict(self._poller.poll(
+                    timeout=10 if self._pending else 200))
+            except zmq.ZMQError:
+                return  # context terminated under us
+            for sock, eid in self._socks.items():
+                if sock not in ready:
+                    continue
+                try:
+                    frames = sock.recv_multipart(flags=zmq.NOBLOCK)
+                    batch = msgpack.unpackb(frames[-1], raw=False)
+                    self.index.apply_batch(eid, batch)
+                except Exception as e:  # never kill the thread
+                    if not self._stop.is_set():
+                        logger.warning(
+                            "kv_events batch from engine %d dropped: %s",
+                            eid, e)
+
+    def close(self) -> None:
+        self._stop.set()
+        self._thread.join(timeout=2.0)
+        for sock in self._socks:
+            sock.close(linger=0)
+        self._ctx.term()

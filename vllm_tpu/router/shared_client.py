@@ -1,0 +1,481 @@
+"""SharedDPClient: one frontend's view of a shared DP engine pool.
+
+Reference analog: the client half of vLLM's ``A + DP + N`` topology —
+N API-server processes all talk to the same engine-core pool. Unlike
+``DPLBClient`` (which SPAWNS and supervises the pool), this client only
+*connects*: the pool (engines + coordinator) is owned by the topology
+launcher (``vllm_tpu/router/topology.py``), which also handles engine
+respawn. Socket topology is inverted accordingly:
+
+- each engine BINDS its input PULL; every frontend connects a PUSH —
+  frontends can crash/respawn without the engine noticing;
+- each frontend BINDS its own output PULL at a per-frontend address;
+  engines hold one PUSH per frontend and route each request's outputs
+  by ``EngineCoreRequest.client_index``;
+- READY / DEAD broadcast to every frontend (each must track rank
+  liveness independently);
+- UTILITY calls carry a 4th frame (client index) so the reply lands on
+  the calling frontend's socket.
+
+Engine death: MSG_DEAD marks the rank down and raises
+EngineRestartedError carrying THIS frontend's lost request ids (the
+journal replays them onto surviving ranks); the launcher respawns the
+rank and its fresh READY flips it back up. Known limitation: a
+SIGKILLed engine emits no MSG_DEAD, so frontends only learn of it when
+the launcher's replacement binds and READYs.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from dataclasses import dataclass, field
+
+from vllm_tpu.config import EngineConfig
+from vllm_tpu.core.sched_output import EngineCoreOutputs
+from vllm_tpu.engine.core_client import EngineDeadError, _ZMQClientBase
+from vllm_tpu.logger import init_logger
+from vllm_tpu.request import EngineCoreRequest
+from vllm_tpu.resilience import EngineRestartedError, EngineSupervisor
+from vllm_tpu.resilience.failpoints import fail_point
+from vllm_tpu.tracing import trace_instant
+
+logger = init_logger(__name__)
+
+
+@dataclass
+class EnginePoolAddresses:
+    """Wire addresses of a launcher-owned engine pool, passed (pickled)
+    to every frontend process."""
+
+    # Per-engine input addresses (engine binds PULL, frontends connect).
+    engine_inputs: list[str]
+    # Per-frontend output addresses (frontend k binds output_addrs[k]).
+    output_addrs: list[str]
+    coord_report_addr: str
+    coord_pub_addr: str
+    # Per-engine kv_events endpoints for the prefix index ({} = no
+    # prefix-aware routing).
+    kv_endpoints: dict[int, str] = field(default_factory=dict)
+
+
+class SharedDPClient(_ZMQClientBase):
+    """Engine client for one frontend shard of the multi-API-server
+    topology."""
+
+    def __init__(
+        self,
+        config: EngineConfig,
+        pool: EnginePoolAddresses,
+        client_index: int,
+        ready_timeout_s: float = 600.0,
+    ) -> None:
+        import zmq
+
+        from vllm_tpu.engine import coordinator, core_proc, serial_utils
+
+        self._serial = serial_utils
+        self._proc_mod = core_proc
+        self.client_index = client_index
+        self._num_engines = n = len(pool.engine_inputs)
+        self._resilience = config.resilience_config
+        self._supervisor = EngineSupervisor(self._resilience, n)
+        self._started = False
+        # Not this process's to clean up: the launcher owns the run dir
+        # and every engine/coordinator process.
+        self._procs = []
+        self._run_dir = None
+
+        output_addr = pool.output_addrs[client_index]
+        self._ctx = zmq.Context(1)
+        self._output = self._ctx.socket(zmq.PULL)
+        if output_addr.startswith("ipc://"):
+            # A crashed predecessor of THIS frontend index leaves its
+            # socket file behind; engines' PUSH sockets reconnect to the
+            # re-bound path automatically.
+            try:
+                os.unlink(output_addr[len("ipc://"):])
+            except OSError:
+                pass
+        self._output.bind(output_addr)
+        self._inputs = []
+        for addr in pool.engine_inputs:
+            sock = self._ctx.socket(zmq.PUSH)
+            sock.connect(addr)
+            self._inputs.append(sock)
+        self._sub = self._ctx.socket(zmq.SUB)
+        self._sub.connect(pool.coord_pub_addr)
+        self._sub.setsockopt(zmq.SUBSCRIBE, coordinator.TOPIC)
+        self._report = self._ctx.socket(zmq.PUSH)
+        self._report.connect(pool.coord_report_addr)
+        self._report.setsockopt(zmq.SNDTIMEO, 50)
+
+        self._dead = False
+        self._live: dict[str, int] = {}  # req_id -> engine_id
+        self._engine_inflight = [0] * n
+        self._coord_loads = [0] * n
+        self._coord_epoch: str | None = None
+        self._snapshot_t = time.monotonic()
+        self._routing_degraded = False
+        self._rr = client_index  # offset cursors so shards interleave
+        self._report_unsent: int | None = None
+        self._pending: list[list[bytes]] = []
+        self._engine_up = [True] * n
+        self._last_progress = time.monotonic()
+
+        # Prefix-cache-aware routing (same ladder as DPLBClient).
+        self._prefix_router = None
+        self._prefix_index = None
+        self._kv_subscriber = None
+        self._routing_stats = None
+        if pool.kv_endpoints:
+            from vllm_tpu.router.policy import PrefixAwareRouter, RoutingStats
+            from vllm_tpu.router.prefix_index import (
+                KVEventSubscriber,
+                PrefixCacheIndex,
+            )
+
+            self._prefix_index = PrefixCacheIndex()
+            self._kv_subscriber = KVEventSubscriber(
+                self._prefix_index, dict(pool.kv_endpoints)
+            )
+            self._prefix_router = PrefixAwareRouter(
+                self._prefix_index, config.cache_config.block_size
+            )
+            self._routing_stats = RoutingStats()
+
+        self._await_engines(ready_timeout_s)
+        self._started = True
+        logger.info(
+            "frontend %d connected to %d shared DP engine core(s)",
+            client_index, n,
+        )
+
+    # -- readiness barrier ---------------------------------------------
+
+    def _await_engines(self, timeout_s: float) -> None:
+        """Block until every engine has answered this frontend.
+
+        The barrier is a cheap ``get_load`` utility probe (with our
+        client-index reply frame): ZMQ queues it until the engine's busy
+        loop serves it, so it works both for initial boot and for a
+        respawned frontend (whose boot-time READY broadcasts are long
+        gone). Only the probe REPLY completes the barrier — on initial
+        boot the engine's READY precedes its reply on the same ordered
+        pipe, and counting the READY would leave the reply queued to
+        crash a later ``get_output``.
+        """
+        for eid in range(self._num_engines):
+            self._inputs[eid].send_multipart([
+                self._proc_mod.MSG_UTILITY,
+                b"get_load",
+                self._serial.encode([]),
+                str(self.client_index).encode(),
+            ])
+        heard: set[int] = set()
+        deadline = time.monotonic() + timeout_s
+        while len(heard) < self._num_engines:
+            remaining = deadline - time.monotonic()
+            if remaining <= 0 or not self._output.poll(
+                    min(int(remaining * 1000), 200)):
+                if time.monotonic() >= deadline:
+                    raise EngineDeadError(
+                        f"frontend {self.client_index}: only "
+                        f"{len(heard)}/{self._num_engines} shared engines "
+                        f"answered within {timeout_s:.0f}s"
+                    )
+                continue
+            frames = self._output.recv_multipart()
+            kind = frames[0]
+            if kind == self._proc_mod.MSG_READY:
+                pass  # the engine's probe reply follows on this pipe
+            elif kind == self._proc_mod.MSG_UTILITY_REPLY:
+                payload = self._serial.decode(frames[1])
+                heard.add(int(payload.get("engine_id", 0)))
+            elif kind == self._proc_mod.MSG_DEAD:
+                eid = int(frames[2]) if len(frames) > 2 else 0
+                raise EngineDeadError(
+                    f"shared engine {eid} died during frontend attach:\n"
+                    f"{frames[1].decode()}"
+                )
+            # OUT frames can't exist yet for this fresh client: drop.
+
+    # -- engine death (launcher owns respawn) --------------------------
+
+    def _handle_engine_death(self, engine_ids: list[int], reason: str,
+                             suspects: list[str] | None = None) -> None:
+        hang = "device hang" in reason
+        if hang:
+            self.watchdog_trips = getattr(self, "watchdog_trips", 0) + 1
+        if (
+            not self._started
+            or self._closing
+            or not self._resilience.enable_recovery
+        ):
+            self._dead = True
+            raise EngineDeadError(reason)
+        lost: list[str] = []
+        for eid in engine_ids:
+            self._engine_up[eid] = False
+            self._supervisor.record_failure(eid)
+            mine = sorted(
+                rid for rid, e in self._live.items() if e == eid
+            )
+            for rid in mine:
+                del self._live[rid]
+            self._engine_inflight[eid] = 0
+            if self._prefix_index is not None:
+                self._prefix_index.drop_engine(eid)
+            lost.extend(mine)
+            logger.error(
+                "shared DP engine %d died (%s); frontend %d lost %d "
+                "in-flight request(s), serving degraded on %d/%d ranks "
+                "until the launcher's replacement READYs",
+                eid, reason.splitlines()[0], self.client_index,
+                len(mine), sum(self._engine_up), self._num_engines,
+            )
+        self._drain_stale_outputs(set(lost))
+        self._report_inflight()
+        raise EngineRestartedError(
+            lost, engine_id=engine_ids[0], reason=reason.splitlines()[0],
+            suspect_req_ids=suspects, hang=hang,
+        )
+
+    def _on_engine_ready(self, payload: dict) -> None:
+        eid = int(payload.get("engine_id", 0))
+        self._engine_up[eid] = True
+        self._supervisor.record_ready(eid)
+        logger.info(
+            "shared DP engine %d (re)joined; frontend %d sees %d/%d "
+            "ranks up", eid, self.client_index,
+            sum(self._engine_up), self._num_engines,
+        )
+
+    def _check_alive(self) -> None:
+        # No owned processes to poll: liveness is wire-driven (MSG_DEAD).
+        if self._dead:
+            raise EngineDeadError("shared engine pool is not reachable")
+
+    def _engines_with_work(self) -> list[int]:
+        return [
+            i for i, c in enumerate(self._engine_inflight)
+            if c > 0 and self._engine_up[i]
+        ]
+
+    def _check_heartbeat(self) -> None:
+        # Heartbeat kill needs process ownership; the launcher (or the
+        # engine's own step watchdog) covers hang detection here.
+        pass
+
+    # -- coordinator plumbing (same protocol as DPLBClient) ------------
+
+    def _drain_loads(self) -> None:
+        while self._sub.poll(0):
+            frames = self._sub.recv_multipart()
+            state = self._serial.decode(frames[1])
+            for eid_s, (w, r) in state["loads"].items():
+                self._coord_loads[int(eid_s)] = w + r
+            self._snapshot_t = time.monotonic()
+            epoch = state.get("epoch")
+            if epoch != self._coord_epoch:
+                if self._coord_epoch is not None:
+                    self._report_unsent = len(self._live)
+                self._coord_epoch = epoch
+
+    def _snapshot_stale(self) -> bool:
+        return (
+            time.monotonic() - self._snapshot_t
+            > self._resilience.coordinator_stale_after_s
+        )
+
+    def coordinator_status(self) -> dict:
+        return {
+            # Liveness by snapshot freshness: this process doesn't own
+            # the coordinator proc (the launcher does).
+            "up": not self._snapshot_stale(),
+            "restarts": 0,
+            "snapshot_age_s": time.monotonic() - self._snapshot_t,
+            "routing_degraded": self._snapshot_stale(),
+        }
+
+    def routing_status(self, drain: bool = False) -> dict | None:
+        if self._routing_stats is None:
+            return None
+        status = self._routing_stats.snapshot(drain=drain)
+        if self._prefix_index is not None:
+            status["index"] = self._prefix_index.status()
+        return status
+
+    def _report_inflight(self) -> None:
+        self._report_unsent = len(self._live)
+        self._flush_report()
+
+    def _flush_report(self) -> None:
+        if self._report_unsent is None:
+            return
+        try:
+            self._report.send(self._serial.encode({
+                "client_inflight": self._report_unsent,
+                "client_id": str(self.client_index),
+            }))
+            self._report_unsent = None
+        except Exception:
+            pass  # retried on the next call
+
+    # -- data path ------------------------------------------------------
+
+    def add_request(self, req: EngineCoreRequest) -> None:
+        self._check_alive()
+        self._drain_loads()
+        req.client_index = self.client_index
+        candidates = [
+            i for i in range(self._num_engines) if self._engine_up[i]
+        ] or list(range(self._num_engines))
+        stale = self._snapshot_stale()
+        if stale != self._routing_degraded:
+            self._routing_degraded = stale
+            logger.warning(
+                "frontend %d: coordinator snapshot %s; %s routing",
+                self.client_index, "stale" if stale else "fresh again",
+                "round-robin" if stale else "least-loaded",
+            )
+        # Routing ladder: prefix hit > least-loaded > round-robin. The
+        # prefix index is fed directly by engine kv_events, so prefix
+        # placement survives a stale coordinator snapshot.
+        decision = None
+        if self._prefix_router is not None:
+            decision = self._prefix_router.choose(
+                req, candidates,
+                {i: self._engine_inflight[i] for i in candidates},
+            )
+        if decision is not None:
+            eid = decision.engine_id
+        elif stale:
+            eid = candidates[self._rr % len(candidates)]
+            self._rr += 1
+        else:
+            # Coordinator loads see EVERY frontend's requests (client-
+            # local counters only see ours); local inflight breaks ties
+            # for requests still in flight to the engine.
+            eid = min(
+                candidates,
+                key=lambda i: (
+                    self._coord_loads[i], self._engine_inflight[i]
+                ),
+            )
+        if self._routing_stats is not None:
+            from vllm_tpu.router.policy import RoutingDecision
+
+            self._routing_stats.note(
+                decision if decision is not None else RoutingDecision(
+                    eid, "round_robin" if stale else "least_loaded"
+                )
+            )
+        self._live[req.request_id] = eid
+        self._engine_inflight[eid] += 1
+        trace_instant(
+            "request_send", req_id=req.request_id, trace_id=req.trace_id,
+            engine_id=eid,
+        )
+        self._report_inflight()  # before the add: wave opens first
+        if fail_point("core_client.send",
+                      lambda: f"req={req.request_id}") != "drop":
+            self._inputs[eid].send_multipart(
+                [self._proc_mod.MSG_ADD, self._serial.encode(req)]
+            )
+
+    def abort_requests(self, request_ids: list[str]) -> None:
+        if self._dead or not request_ids:
+            return
+        by_engine: dict[int, list[str]] = {}
+        unknown: list[str] = []
+        for rid in request_ids:
+            eid = self._live.pop(rid, None)
+            if eid is not None:
+                self._engine_inflight[eid] -= 1
+                by_engine.setdefault(eid, []).append(rid)
+            else:
+                unknown.append(rid)
+        for eid, rids in by_engine.items():
+            self._inputs[eid].send_multipart(
+                [self._proc_mod.MSG_ABORT, self._serial.encode(rids)]
+            )
+        if unknown:
+            # Not in our live map — e.g. journaled ghosts from a crashed
+            # predecessor of this frontend shard. The owning engine is
+            # unknown, so broadcast (aborting an unknown id is a no-op).
+            for sock in self._inputs:
+                sock.send_multipart(
+                    [self._proc_mod.MSG_ABORT, self._serial.encode(unknown)]
+                )
+        self._report_inflight()
+
+    def _on_finished(self, req_id: str) -> None:
+        eid = self._live.pop(req_id, None)
+        if eid is not None:
+            self._engine_inflight[eid] -= 1
+            self._report_inflight()
+
+    def get_output(self, timeout: float | None = None) -> EngineCoreOutputs:
+        self._drain_loads()
+        self._flush_report()
+        return super().get_output(timeout)
+
+    def has_unfinished_requests(self) -> bool:
+        self._flush_report()
+        return bool(self._live)
+
+    def _utility(self, method: str, *args, timeout_ms: int = 600_000):
+        """Broadcast to all UP engines with our reply-routing frame;
+        returns the lowest engine id's result."""
+        self._check_alive()
+        up = [
+            i for i in range(self._num_engines) if self._engine_up[i]
+        ]
+        if not up:
+            raise RuntimeError(
+                f"utility {method}: no engine cores available "
+                "(all ranks restarting)"
+            )
+        for eid in up:
+            self._inputs[eid].send_multipart([
+                self._proc_mod.MSG_UTILITY,
+                method.encode(),
+                self._serial.encode(list(args)),
+                str(self.client_index).encode(),
+            ])
+        replies = self._collect_utility_replies(method, len(up), timeout_ms)
+        replies.sort(key=lambda r: r.get("engine_id", 0))
+        return replies[0]["ok"]
+
+    @property
+    def inflight(self) -> bool:
+        return bool(self._live)
+
+    def engine_status(self) -> dict:
+        # Supervisor tracks up/down from READY/DEAD frames; restart
+        # counts live with the launcher.
+        return self._supervisor.status()
+
+    def is_ready(self) -> bool:
+        return not self._dead and all(self._engine_up)
+
+    def shutdown(self) -> None:
+        """Close THIS frontend's sockets. The engine pool stays up — it
+        belongs to the launcher (other frontends are still serving)."""
+        self._closing = True
+        if getattr(self, "_ctx", None) is None:
+            return
+        if self._kv_subscriber is not None:
+            try:
+                self._kv_subscriber.close()
+            except Exception:
+                pass
+            self._kv_subscriber = None
+        for sock in [*self._inputs, self._output, self._sub, self._report]:
+            try:
+                sock.close(linger=0)
+            except Exception:
+                pass
+        self._ctx.term()
+        self._ctx = None

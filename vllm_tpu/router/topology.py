@@ -1,0 +1,438 @@
+"""Multi-API-server launcher: ``--api-server-count N``.
+
+Reference analog: PAPER.md's ``A + DP + N (+1 coordinator)`` process
+architecture. The launcher (this process) owns the shared engine pool —
+DP engine cores, the coordinator, the ipc run dir — and spawns N
+frontend processes, each a full AsyncLLM frontend (tokenize/detok,
+admission shard, journal shard, HTTP) connected to the pool through a
+:class:`~vllm_tpu.router.shared_client.SharedDPClient`.
+
+Socket layout under the run dir (engines BIND input so frontends can
+crash/respawn freely; each frontend BINDS its own output):
+
+    in{e}.sock      engine e PULL   <- every frontend PUSH
+    out-f{k}.sock   frontend k PULL <- every engine PUSH (one per pair)
+    rep/pub.sock    coordinator load reports / snapshots
+    kv{e}.sock      engine e kv_events PUB (auto-assigned if unset)
+
+Port layout: all frontends share the public port via SO_REUSEPORT (the
+kernel fans connections out); each also binds a private admin port
+(``port + 1 + k``) so /health /ready /metrics are addressable
+PER-frontend. Without SO_REUSEPORT a tiny accept-loop balancer process
+owns the public port instead (``router/balancer.py``).
+
+Supervision: a crashed frontend is respawned with the SAME index — same
+journal shard, so only that shard's in-flight requests are replayed; a
+crashed engine is respawned (when recovery is on) and frontends re-admit
+it on its READY broadcast. SIGTERM drains: forwarded to every frontend
+(admission closes, in-flight requests finish), then the engines are shut
+down; the launcher exits 0 iff every frontend drained to exit 0.
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+import shutil
+import signal
+import sys
+import tempfile
+import time
+
+from vllm_tpu.logger import init_logger
+from vllm_tpu.router.shared_client import EnginePoolAddresses
+
+logger = init_logger(__name__)
+
+
+def admin_port_for(port: int, client_index: int) -> int:
+    """Per-frontend private port: public port + 1 + index."""
+    return port + 1 + client_index
+
+
+def shard_cap(cap: int, n: int) -> int:
+    """Per-frontend share of a global admission cap (0 = unlimited
+    stays 0; otherwise ceil so N shards always cover the global cap)."""
+    return 0 if cap <= 0 else -(-cap // n)
+
+
+def _has_reuse_port() -> bool:
+    import socket
+
+    return hasattr(socket, "SO_REUSEPORT")
+
+
+# ----------------------------------------------------------------------
+# Frontend process
+# ----------------------------------------------------------------------
+
+def run_frontend(engine_args_bytes: bytes, pool: EnginePoolAddresses,
+                 client_index: int, host: str, port: int,
+                 tool_parser: str | None, reasoning_parser: str | None,
+                 bind_shared: bool) -> None:
+    """Process entry point (spawn target): one API-server shard."""
+    import asyncio
+
+    from aiohttp import web
+
+    from vllm_tpu.engine.async_llm import AsyncLLM
+    from vllm_tpu.entrypoints.openai.api_server import build_app
+    from vllm_tpu.metrics.prometheus import PrometheusRegistry
+    from vllm_tpu.router.shared_client import SharedDPClient
+
+    engine_args = pickle.loads(engine_args_bytes)
+    n = max(1, engine_args.api_server_count)
+    # Admission state is SHARDED: each frontend owns ceil(cap/N) of the
+    # global budget, so the aggregate admitted load stays bounded by
+    # (roughly) the configured caps with no cross-process coordination.
+    engine_args.max_inflight_requests = shard_cap(
+        engine_args.max_inflight_requests, n)
+    engine_args.max_queued_prompt_tokens = shard_cap(
+        engine_args.max_queued_prompt_tokens, n)
+    # Journal state is SHARDED: each frontend journals under its own
+    # directory, so a crashed frontend's replacement replays only ITS
+    # requests (the other shards' journals are untouched).
+    if engine_args.journal_dir:
+        engine_args.journal_dir = os.path.join(
+            engine_args.journal_dir, f"shard-{client_index}")
+        os.makedirs(engine_args.journal_dir, exist_ok=True)
+
+    config = engine_args.create_engine_config()
+    client = SharedDPClient(config, pool, client_index)
+    engine = AsyncLLM(config, client=client)
+    # Requests lost by a crashed predecessor of THIS shard are already
+    # counted/reported by the journal scan; their engine-side ghosts
+    # (still decoding for a dead consumer) must be aborted.
+    if engine.journal is not None and engine.journal.lost_on_restart:
+        ghost_ids = [
+            r["request_id"] for r in engine.journal.lost_on_restart
+            if r.get("request_id")
+        ]
+        if ghost_ids:
+            logger.info(
+                "frontend %d: aborting %d engine-side ghost(s) from the "
+                "previous incarnation", client_index, len(ghost_ids))
+            client.abort_requests(ghost_ids)
+
+    metrics = PrometheusRegistry(engine)
+    if hasattr(metrics, "set_frontend"):
+        metrics.set_frontend(client_index, n)
+    engine.stat_loggers.append(metrics)
+    app = build_app(
+        engine, engine_args.model, metrics,
+        tool_parser=tool_parser, reasoning_parser=reasoning_parser,
+    )
+
+    async def _serve() -> None:
+        runner = web.AppRunner(app)
+        await runner.setup()
+        sites = []
+        if bind_shared:
+            sites.append(web.TCPSite(runner, host, port, reuse_port=True))
+        # Admin port: always bound, per-frontend addressable
+        # /health /ready /metrics (and the balancer's backend).
+        sites.append(
+            web.TCPSite(runner, host, admin_port_for(port, client_index)))
+        for site in sites:
+            await site.start()
+        logger.info(
+            "frontend %d/%d serving %s on %s:%d (admin :%d)",
+            client_index, n, engine_args.model, host, port,
+            admin_port_for(port, client_index),
+        )
+        loop = asyncio.get_running_loop()
+        stop = asyncio.Event()
+        for sig in (signal.SIGTERM, signal.SIGINT):
+            try:
+                loop.add_signal_handler(sig, stop.set)
+            except NotImplementedError:  # pragma: no cover - non-unix
+                pass
+        await stop.wait()
+        logger.info("frontend %d: shutdown signal; draining", client_index)
+        await engine.drain()
+        await runner.cleanup()
+
+    try:
+        asyncio.run(_serve())
+    finally:
+        engine.shutdown()
+    sys.exit(0)
+
+
+# ----------------------------------------------------------------------
+# Launcher
+# ----------------------------------------------------------------------
+
+class _EnginePool:
+    """Launcher-side ownership of engines + coordinator."""
+
+    def __init__(self, config, run_dir: str, num_frontends: int) -> None:
+        import copy
+        import multiprocessing
+
+        from vllm_tpu.engine import coordinator, core_proc
+
+        self._core_proc = core_proc
+        self._mp = multiprocessing.get_context("spawn")
+        self.run_dir = run_dir
+        pc = config.parallel_config
+        self.num_engines = n = max(1, pc.data_parallel_engines)
+        self.resilience = config.resilience_config
+
+        report_addr = f"ipc://{run_dir}/rep.sock"
+        pub_addr = f"ipc://{run_dir}/pub.sock"
+        self.addresses = EnginePoolAddresses(
+            engine_inputs=[
+                f"ipc://{run_dir}/in{e}.sock" for e in range(n)
+            ],
+            output_addrs=[
+                f"ipc://{run_dir}/out-f{k}.sock"
+                for k in range(num_frontends)
+            ],
+            coord_report_addr=report_addr,
+            coord_pub_addr=pub_addr,
+            kv_endpoints={},
+        )
+
+        # Per-engine configs: same derivation as DPLBClient (dp=1 per
+        # proc, per-engine kv endpoint, disjoint chip subsets on TPU) —
+        # except kv_events is ON by default here: prefix-aware routing
+        # is the point of this topology.
+        chips_per_engine = pc.world_size
+        pin_chips = (
+            os.environ.get("JAX_PLATFORMS", "").lower() not in ("cpu",)
+            and "TPU_VISIBLE_DEVICES" not in os.environ
+        )
+        self._engine_cfg_bytes: list[bytes] = []
+        self._engine_kwargs: list[dict] = []
+        for eid in range(n):
+            engine_config = copy.deepcopy(config)
+            engine_config.parallel_config.data_parallel_engines = 1
+            engine_config.parallel_config.api_server_count = 1
+            ep = engine_config.cache_config.kv_events_endpoint
+            if not ep:
+                engine_config.cache_config.kv_events_endpoint = (
+                    f"ipc://{run_dir}/kv{eid}.sock")
+            elif eid > 0:
+                if ep.startswith("tcp://") and ":" in ep.rsplit("/", 1)[-1]:
+                    head, p = ep.rsplit(":", 1)
+                    engine_config.cache_config.kv_events_endpoint = (
+                        f"{head}:{int(p) + eid}")
+                else:
+                    engine_config.cache_config.kv_events_endpoint = (
+                        f"{ep}.dp{eid}")
+            self.addresses.kv_endpoints[eid] = (
+                engine_config.cache_config.kv_events_endpoint)
+            extra_env = (
+                {
+                    "TPU_VISIBLE_DEVICES": ",".join(
+                        str(c) for c in range(
+                            eid * chips_per_engine,
+                            (eid + 1) * chips_per_engine,
+                        )
+                    ),
+                }
+                if pin_chips
+                else {}
+            )
+            self._engine_cfg_bytes.append(pickle.dumps(engine_config))
+            self._engine_kwargs.append(dict(
+                engine_id=eid,
+                coord_report_addr=report_addr,
+                coord_pub_addr=pub_addr,
+                lockstep=pc.data_parallel_lockstep,
+                extra_env=extra_env,
+                bind_input=True,
+            ))
+
+        self.coordinator = self._mp.Process(
+            target=coordinator.run_coordinator,
+            args=(report_addr, pub_addr, n),
+            name="vllm-tpu-dp-coordinator",
+            daemon=True,
+        )
+        self.coordinator.start()
+        self.engines = [self._spawn_engine(e) for e in range(n)]
+        self.engine_restarts = [0] * n
+
+    def _spawn_engine(self, eid: int):
+        proc = self._mp.Process(
+            target=self._core_proc.run_engine_core,
+            args=(self._engine_cfg_bytes[eid],
+                  self.addresses.engine_inputs[eid],
+                  list(self.addresses.output_addrs)),
+            kwargs=self._engine_kwargs[eid],
+            name=f"vllm-tpu-engine-core-dp{eid}",
+            daemon=True,
+        )
+        proc.start()
+        return proc
+
+    def supervise(self) -> None:
+        """One supervision tick: respawn dead engines / coordinator."""
+        for eid, proc in enumerate(self.engines):
+            if proc.is_alive():
+                continue
+            proc.join(timeout=0)
+            if not self.resilience.enable_recovery:
+                continue  # frontends already saw MSG_DEAD; rank stays down
+            if self.engine_restarts[eid] >= (
+                    self.resilience.max_engine_restarts):
+                continue
+            self.engine_restarts[eid] += 1
+            logger.error(
+                "engine %d exited (%s); respawning (restart %d/%d)",
+                eid, proc.exitcode, self.engine_restarts[eid],
+                self.resilience.max_engine_restarts,
+            )
+            self.engines[eid] = self._spawn_engine(eid)
+        if not self.coordinator.is_alive():
+            self.coordinator.join(timeout=0)
+            logger.warning("coordinator exited; respawning")
+            from vllm_tpu.engine import coordinator as coord_mod
+
+            self.coordinator = self._mp.Process(
+                target=coord_mod.run_coordinator,
+                args=(self.addresses.coord_report_addr,
+                      self.addresses.coord_pub_addr, self.num_engines),
+                name="vllm-tpu-dp-coordinator",
+                daemon=True,
+            )
+            self.coordinator.start()
+
+    def shutdown(self) -> None:
+        import zmq
+
+        from vllm_tpu.engine.core_proc import MSG_SHUTDOWN
+
+        ctx = zmq.Context(1)
+        try:
+            for eid, proc in enumerate(self.engines):
+                if not proc.is_alive():
+                    continue
+                sock = ctx.socket(zmq.PUSH)
+                sock.connect(self.addresses.engine_inputs[eid])
+                sock.send_multipart([MSG_SHUTDOWN])
+                sock.close(linger=1000)
+            for proc in self.engines:
+                proc.join(timeout=5)
+                if proc.is_alive():
+                    proc.terminate()
+                    proc.join(timeout=2)
+        finally:
+            ctx.term()
+        if self.coordinator.is_alive():
+            self.coordinator.terminate()
+            self.coordinator.join(timeout=2)
+
+
+def run_multi_server(engine_args, host: str = "0.0.0.0", port: int = 8000,
+                     tool_parser: str | None = None,
+                     reasoning_parser: str | None = None) -> None:
+    """Launcher entry point (called by ``run_server`` when
+    ``--api-server-count > 1``). Blocks until SIGTERM/SIGINT + drain;
+    exits 0 iff every frontend drained cleanly."""
+    import multiprocessing
+
+    num_frontends = max(1, engine_args.api_server_count)
+    config = engine_args.create_engine_config()
+    run_dir = tempfile.mkdtemp(prefix="vllm-tpu-topo-")
+    mp = multiprocessing.get_context("spawn")
+    pool = _EnginePool(config, run_dir, num_frontends)
+    engine_args_bytes = pickle.dumps(engine_args)
+
+    reuse_port = _has_reuse_port()
+    balancer_proc = None
+    if not reuse_port:
+        from vllm_tpu.router.balancer import run_balancer
+
+        backends = [
+            (("127.0.0.1" if host == "0.0.0.0" else host),
+             admin_port_for(port, k))
+            for k in range(num_frontends)
+        ]
+        balancer_proc = mp.Process(
+            target=run_balancer, args=(host, port, backends),
+            name="vllm-tpu-balancer", daemon=True,
+        )
+        balancer_proc.start()
+        logger.warning(
+            "SO_REUSEPORT unavailable: accept-loop balancer owns %s:%d",
+            host, port,
+        )
+
+    def spawn_frontend(k: int):
+        proc = mp.Process(
+            target=run_frontend,
+            args=(engine_args_bytes, pool.addresses, k, host, port,
+                  tool_parser, reasoning_parser, reuse_port),
+            name=f"vllm-tpu-frontend-{k}",
+            daemon=False,  # frontends must outlive a dying launcher long
+            # enough to drain; they get SIGTERM explicitly
+        )
+        proc.start()
+        return proc
+
+    frontends = [spawn_frontend(k) for k in range(num_frontends)]
+    logger.info(
+        "topology up: %d frontend(s) x %d engine(s) on %s:%d "
+        "(%s, run dir %s)",
+        num_frontends, pool.num_engines, host, port,
+        "SO_REUSEPORT" if reuse_port else "accept-loop balancer", run_dir,
+    )
+
+    stopping = {"flag": False}
+
+    def _on_signal(signum, frame):
+        stopping["flag"] = True
+
+    for sig in (signal.SIGTERM, signal.SIGINT):
+        signal.signal(sig, _on_signal)
+
+    exit_code = 0
+    try:
+        while not stopping["flag"]:
+            time.sleep(0.25)
+            if stopping["flag"]:
+                break  # don't respawn anything the signal just felled
+            pool.supervise()
+            for k, proc in enumerate(frontends):
+                if proc.is_alive() or stopping["flag"]:
+                    continue
+                proc.join(timeout=0)
+                logger.error(
+                    "frontend %d exited (%s); respawning with the same "
+                    "shard index (journal shard-%d replays only its own "
+                    "requests)", k, proc.exitcode, k,
+                )
+                frontends[k] = spawn_frontend(k)
+
+        # Graceful drain: every frontend gets SIGTERM, finishes its
+        # in-flight requests under its drain budget, exits 0.
+        logger.info("shutdown signal: draining %d frontend(s)",
+                    len(frontends))
+        for proc in frontends:
+            if proc.is_alive():
+                try:
+                    os.kill(proc.pid, signal.SIGTERM)
+                except OSError:
+                    pass
+        drain_deadline = time.monotonic() + (
+            config.lifecycle_config.drain_timeout_s + 30.0)
+        for proc in frontends:
+            proc.join(timeout=max(0.5, drain_deadline - time.monotonic()))
+            if proc.is_alive():
+                logger.error("frontend %s did not drain; killing", proc.name)
+                proc.terminate()
+                proc.join(timeout=2)
+                exit_code = 1
+            elif proc.exitcode not in (0, -signal.SIGTERM.value):
+                exit_code = 1
+    finally:
+        if balancer_proc is not None and balancer_proc.is_alive():
+            balancer_proc.terminate()
+            balancer_proc.join(timeout=2)
+        pool.shutdown()
+        shutil.rmtree(run_dir, ignore_errors=True)
+    logger.info("topology down (exit %d)", exit_code)
+    sys.exit(exit_code)
