@@ -1,0 +1,167 @@
+"""Re-entrant jax.distributed bootstrap (the mesh-shrink prerequisite).
+
+The original ``init_distributed`` was one-shot: calling it twice was a
+silent no-op and there was no teardown, so a surviving host could never
+re-form a smaller world after losing a peer. These tests pin the
+re-entrancy contract:
+
+- uniproc: init -> shutdown -> init cycles cleanly, and init is
+  idempotent while up (in-process, no subprocesses);
+- the ``dist.barrier`` failpoint site guards the barrier even in the
+  uniproc degenerate (chaos runs inject partition delays there);
+- two real processes bootstrap a world of 2, tear it down, and the
+  survivor re-bootstraps ALONE at world size 1 on a fresh coordinator —
+  the exact sequence mesh-shrink recovery drives.
+"""
+
+from __future__ import annotations
+
+import os
+import socket
+import subprocess
+import sys
+
+import pytest
+
+from vllm_tpu.parallel import distributed as dist
+from vllm_tpu.resilience import failpoints as fp
+from vllm_tpu.resilience.failpoints import FailpointError
+
+
+@pytest.fixture(autouse=True)
+def _isolate_state(monkeypatch):
+    """Snapshot/restore the module bootstrap state and keep the
+    VLLM_TPU_DIST_* env of an outer launcher out of the picture."""
+    for var in ("VLLM_TPU_DIST_COORDINATOR", "VLLM_TPU_DIST_NUM_PROCESSES",
+                "VLLM_TPU_DIST_PROCESS_ID"):
+        monkeypatch.delenv(var, raising=False)
+    state, world = dist._state, dist._world
+    fp.deactivate()
+    yield
+    dist._state, dist._world = state, world
+    fp.deactivate()
+
+
+def test_uniproc_init_shutdown_reinit_cycle():
+    dist._state, dist._world = "uninit", None
+    # No coordinator anywhere -> single-process fallback, not an error.
+    dist.init_distributed()
+    assert dist._state == "uniproc"
+    assert dist.is_distributed_initialized()
+    assert dist.distributed_world() is None
+
+    # Idempotent while up: a second init must not re-bootstrap.
+    dist.init_distributed()
+    assert dist._state == "uniproc"
+
+    dist.shutdown_distributed()
+    assert dist._state == "uninit"
+    assert not dist.is_distributed_initialized()
+
+    # The full cycle again: teardown must leave the module re-usable.
+    dist.init_distributed()
+    assert dist._state == "uniproc"
+    dist.shutdown_distributed()
+    assert dist._state == "uninit"
+
+
+def test_shutdown_when_never_initialized_is_a_noop():
+    dist._state, dist._world = "uninit", None
+    dist.shutdown_distributed()  # must not raise or clear caches
+    assert dist._state == "uninit"
+
+
+def test_dist_barrier_failpoint_site():
+    # Uniproc barriers are no-ops on the collective side, but the
+    # failpoint still guards them so chaos specs can model partitions
+    # uniformly across topologies.
+    dist._state, dist._world = "uninit", None
+    dist.init_distributed()
+    fp.configure("dist.barrier=raise")
+    with pytest.raises(FailpointError, match=r"dist\.barrier"):
+        dist.dist_barrier("test")
+    fp.configure("dist.barrier=once*delay(0.01)")
+    dist.dist_barrier("test")  # delay under the timeout: no error
+    dist.shutdown_distributed()
+
+
+# -- two-process bootstrap -> teardown -> smaller re-bootstrap ----------
+
+_CHILD = r"""
+import os, sys
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+os.environ["JAX_PLATFORMS"] = "cpu"
+import jax
+jax.config.update("jax_platforms", "cpu")
+
+from vllm_tpu.parallel import distributed as dist
+
+rank = int(os.environ["VLLM_TPU_DIST_PROCESS_ID"])
+coord = os.environ["VLLM_TPU_DIST_COORDINATOR"]
+
+# Phase 1: the full world of 2 comes up from the environment.
+dist.init_distributed()
+assert dist._state == "multiproc", dist._state
+assert dist.distributed_world() == (coord, 2, rank)
+assert jax.process_count() == 2, jax.process_count()
+assert len(jax.devices()) == 8, len(jax.devices())
+dist.dist_barrier("world-of-2")
+print("WORLD2_OK", rank, flush=True)
+
+# Phase 2: supervised teardown on every rank.
+dist.shutdown_distributed()
+assert dist._state == "uninit"
+assert dist.distributed_world() is None
+
+# Phase 3: rank 0 is the survivor and re-forms ALONE at world size 1 on
+# a fresh coordinator (explicit overrides, not env mutation — the same
+# call signature mesh-shrink recovery uses). Rank 1 is the "dead" host
+# and simply exits.
+if rank == 0:
+    recoord = os.environ["TEST_RE_COORDINATOR"]
+    dist.init_distributed(
+        coordinator_address=recoord, num_processes=1, process_id=0)
+    assert dist._state == "multiproc", dist._state
+    assert dist.distributed_world() == (recoord, 1, 0)
+    assert jax.process_count() == 1, jax.process_count()
+    assert len(jax.devices()) == 4, len(jax.devices())
+    # The shrunken world must actually compute, not just report sizes.
+    import numpy as np
+    import jax.numpy as jnp
+    x = jnp.arange(8.0)
+    assert float(jnp.sum(x * 2.0)) == float(np.sum(np.arange(8.0) * 2))
+    dist.dist_barrier("world-of-1")
+    dist.shutdown_distributed()
+print("CHILD_OK", rank, flush=True)
+"""
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def test_two_process_teardown_and_smaller_rebootstrap(tmp_path):
+    port, report = _free_port(), _free_port()
+    script = tmp_path / "child.py"
+    script.write_text(_CHILD)
+    procs = []
+    for i in range(2):
+        env = dict(
+            os.environ,
+            VLLM_TPU_DIST_COORDINATOR=f"127.0.0.1:{port}",
+            VLLM_TPU_DIST_NUM_PROCESSES="2",
+            VLLM_TPU_DIST_PROCESS_ID=str(i),
+            TEST_RE_COORDINATOR=f"127.0.0.1:{report}",
+            PYTHONPATH=os.getcwd(),
+        )
+        procs.append(subprocess.Popen(
+            [sys.executable, str(script)], env=env,
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        ))
+    outs = [p.communicate(timeout=300)[0] for p in procs]
+    for i, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"proc {i} failed:\n{out[-3000:]}"
+        assert f"WORLD2_OK {i}" in out
+        assert f"CHILD_OK {i}" in out

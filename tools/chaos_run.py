@@ -30,6 +30,13 @@ Examples:
     python tools/chaos_run.py --model /path/to/ckpt --seed 7 \
         --engine-kills 0 --poison-mode raise
 
+    # seeded host death on a 2-rank heartbeat ring (+ optional rejoin):
+    # the peer is SIGKILLed mid-run; the engine must shrink the mesh,
+    # replay every interrupted request to a terminal state, and (with
+    # --host-rejoin) grow back to full size
+    python tools/chaos_run.py --model /path/to/ckpt --seed 7 \
+        --engine-kills 0 --host-death --host-rejoin
+
 Engine-core/coordinator *processes* inherit failpoints through the
 environment (export VLLM_TPU_FAILPOINTS before running this tool);
 ``--failpoints`` arms the frontend process mid-run via the chaos plan.
@@ -67,6 +74,20 @@ def build_parser() -> argparse.ArgumentParser:
                    metavar="SPEC",
                    help="frontend failpoint spec to arm at a seeded time "
                         "(repeatable); see vllm_tpu/resilience/failpoints")
+    p.add_argument("--host-death", action="store_true",
+                   help="arm a 2-rank heartbeat ring (engine = rank 0, a "
+                        "jax-free peer process = rank 1), SIGKILL the "
+                        "peer at a seeded time, and assert the engine "
+                        "runs a supervised mesh shrink with every "
+                        "admitted request still reaching exactly one "
+                        "terminal state")
+    p.add_argument("--host-rejoin", action="store_true",
+                   help="with --host-death: respawn the killed peer "
+                        "later in the window and assert the mesh grows "
+                        "back to full size")
+    p.add_argument("--mesh-death-timeout", type=float, default=1.0,
+                   help="heartbeat silence classified as host death "
+                        "(shorter = transient partition)")
     p.add_argument("--poison-mode", default="off",
                    choices=["off", "raise", "hang_step", "nan"],
                    help="inject one deterministic poison request "
@@ -117,6 +138,50 @@ def _check_poison(engine, report, rid: str, mode: str) -> bool:
     return ok
 
 
+def _check_mesh(engine, rejoin: bool, settle_s: float = 10.0) -> bool:
+    """Assert the host-death schedule drove a supervised mesh recovery:
+    at least one shrink completed; with --host-rejoin the mesh must also
+    have grown back to full size.
+
+    The rejoin event can land at the very end of the schedule, so the
+    grow recovery (first beat heard -> busy-loop poll -> re-mesh) may
+    still be in flight when the run returns — poll until the mesh
+    settles instead of reading one instantaneous status."""
+    import time
+
+    def _mesh():
+        status = (engine.resilience_status()
+                  if hasattr(engine, "resilience_status") else {})
+        return status.get("mesh") or {}
+
+    mesh = _mesh()
+    deadline = time.monotonic() + settle_s
+    want_recoveries = 2 if rejoin else 1
+    while (time.monotonic() < deadline
+           and mesh.get("recoveries_total", 0) < want_recoveries):
+        time.sleep(0.1)
+        mesh = _mesh()
+    print(f"mesh: {mesh}", file=sys.stderr)
+    ok = True
+    if mesh.get("rank_losses_total", 0) < 1:
+        print("MESH: no rank loss was ever declared", file=sys.stderr)
+        ok = False
+    if mesh.get("recoveries_total", 0) < 1:
+        print("MESH: no mesh recovery completed", file=sys.stderr)
+        ok = False
+    if rejoin:
+        if mesh.get("size") != mesh.get("world_size"):
+            print(f"MESH: rejoin did not restore full size "
+                  f"({mesh.get('size')}/{mesh.get('world_size')})",
+                  file=sys.stderr)
+            ok = False
+    elif mesh.get("state") != "degraded":
+        print(f"MESH: expected degraded state after shrink, got "
+              f"{mesh.get('state')!r}", file=sys.stderr)
+        ok = False
+    return ok
+
+
 def main(argv: list[str] | None = None) -> int:
     args = build_parser().parse_args(argv)
 
@@ -148,6 +213,36 @@ def main(argv: list[str] | None = None) -> int:
         print(f"poison request {poison_rid}: armed {poison_spec!r}",
               file=sys.stderr)
 
+    host_peers = None
+    if args.host_death:
+        import socket
+
+        from vllm_tpu.parallel.mesh_monitor import ENV_HB_ADDRS
+        from vllm_tpu.resilience.chaos import HeartbeatPeerManager
+        from vllm_tpu.resilience.mesh_recovery import ENV_HB_RANK
+
+        # Two free UDP ports -> a 2-rank ring: this process (the engine)
+        # is rank 0, a jax-free peer process is rank 1. Env must be set
+        # before the engine is built (the monitor arms in EngineCore).
+        socks = [socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+                 for _ in range(2)]
+        for s in socks:
+            s.bind(("127.0.0.1", 0))
+        ports = [s.getsockname()[1] for s in socks]
+        for s in socks:
+            s.close()
+        spec = ",".join(f"127.0.0.1:{p}" for p in ports)
+        os.environ[ENV_HB_ADDRS] = spec
+        os.environ[ENV_HB_RANK] = "0"
+        host_peers = HeartbeatPeerManager(
+            spec, [1],
+            heartbeat_interval_s=min(0.1, args.mesh_death_timeout / 4),
+            death_timeout_s=args.mesh_death_timeout)
+        host_peers.start_all()
+        host_peers.wait_up()
+        print(f"heartbeat ring armed: {spec} (peer rank 1 up)",
+              file=sys.stderr)
+
     plan = make_plan(
         args.seed,
         duration_s=args.duration,
@@ -155,6 +250,8 @@ def main(argv: list[str] | None = None) -> int:
         engine_kills=args.engine_kills,
         coordinator_kills=args.coordinator_kills if args.dp > 1 else 0,
         failpoint_specs=args.failpoints,
+        host_kills=1 if args.host_death else 0,
+        host_rejoin=args.host_rejoin,
     )
     print(f"chaos plan (seed {plan.seed}):", file=sys.stderr)
     for ev in plan.events:
@@ -179,6 +276,8 @@ def main(argv: list[str] | None = None) -> int:
         max_engine_restarts=max(4, 2 * args.engine_kills) + poison_crashes,
         max_request_retries=2 + poison_crashes,
         restart_backoff_s=0.05,
+        mesh_death_timeout_s=args.mesh_death_timeout,
+        mesh_heartbeat_interval_s=min(0.1, args.mesh_death_timeout / 4),
         max_suspect_strikes=args.max_suspect_strikes,
         step_watchdog_s=(args.step_watchdog
                          if args.poison_mode == "hang_step" else 0.0),
@@ -192,13 +291,19 @@ def main(argv: list[str] | None = None) -> int:
             concurrency=args.concurrency,
             request_timeout_s=args.request_timeout,
             poison_request_id=poison_rid,
+            host_peers=host_peers,
         ))
         poison_ok = True
         if poison_rid is not None:
             poison_ok = _check_poison(
                 engine, report, poison_rid, args.poison_mode)
+        mesh_ok = True
+        if args.host_death:
+            mesh_ok = _check_mesh(engine, rejoin=args.host_rejoin)
     finally:
         engine.shutdown()
+        if host_peers is not None:
+            host_peers.stop_all()
 
     if args.json:
         print(json.dumps(report.to_dict(), indent=2))
@@ -210,7 +315,7 @@ def main(argv: list[str] | None = None) -> int:
             f"outcomes={summary['outcomes']} wall={report.wall_s:.1f}s")
     for v in report.ledger.violations:
         print(f"VIOLATION: {v}", file=sys.stderr)
-    ok = report.ok and poison_ok
+    ok = report.ok and poison_ok and mesh_ok
     print("ok" if ok else "FAILED", file=sys.stderr)
     return 0 if ok else 1
 

@@ -66,6 +66,15 @@ REQUIRED_ROUTER_METRICS = {
     "vllm:api_server_count",
 }
 
+# Documented in the README ("Multi-host fault tolerance"); the mesh
+# shrink/rejoin acceptance tests assert on these names.
+REQUIRED_MESH_METRICS = {
+    "vllm:mesh_rank_losses_total",
+    "vllm:mesh_recoveries_total",
+    "vllm:mesh_size",
+    "vllm:mesh_recovery_duration_seconds",
+}
+
 
 def check() -> list[str]:
     """Return a list of lint errors (empty = clean)."""
@@ -131,6 +140,10 @@ def check() -> list[str]:
     for name in sorted(REQUIRED_ROUTER_METRICS - set(seen)):
         errors.append(
             f"required router metric {name} is missing from "
+            f"the registry (documented in README)")
+    for name in sorted(REQUIRED_MESH_METRICS - set(seen)):
+        errors.append(
+            f"required mesh metric {name} is missing from "
             f"the registry (documented in README)")
 
     return errors

@@ -104,6 +104,11 @@ class EngineArgs:
     numeric_guard: bool = False
     max_suspect_strikes: int = 2
     quarantine_probation_cap: int = 8
+    # Multi-host mesh fault tolerance (armed via VLLM_TPU_MESH_HB_ADDRS):
+    # silence > death timeout = host death (supervised shrink); less is a
+    # transient partition (no action).
+    mesh_death_timeout_s: float = 2.0
+    mesh_heartbeat_interval_s: float = 0.2
 
     # Lifecycle (vllm_tpu/resilience/lifecycle): overload protection.
     # All off by default; see LifecycleConfig for semantics.
@@ -215,6 +220,8 @@ class EngineArgs:
                 numeric_guard=self.numeric_guard,
                 max_suspect_strikes=self.max_suspect_strikes,
                 quarantine_probation_cap=self.quarantine_probation_cap,
+                mesh_death_timeout_s=self.mesh_death_timeout_s,
+                mesh_heartbeat_interval_s=self.mesh_heartbeat_interval_s,
             ),
             lifecycle_config=LifecycleConfig(
                 max_inflight_requests=self.max_inflight_requests,

@@ -379,6 +379,14 @@ class AsyncLLM:
         # stalled — a request stuck queued is exactly the one a TTFT
         # timeout exists for.
         self._expire_deadlines()
+        # Mesh-membership poll (in-proc client only; MP engines poll in
+        # their own busy loop and report over MSG_MESH). Runs even when
+        # idle: /health must reflect a host death with no traffic, and a
+        # rejoin must grow the mesh back. Raises EngineRestartedError on
+        # a shrink/grow so the interrupted requests journal-replay.
+        poll_mesh = getattr(self.engine_core, "poll_mesh", None)
+        if poll_mesh is not None:
+            poll_mesh()
         if not self.engine_core.has_unfinished_requests():
             return stalled
         outputs = self.engine_core.get_output(timeout=0.2)
@@ -745,6 +753,12 @@ class AsyncLLM:
             "quarantine": (
                 self.quarantine.status()
                 if self.quarantine is not None else None
+            ),
+            # Multi-host mesh membership/recovery (None unless the
+            # heartbeat ring is armed via VLLM_TPU_MESH_HB_ADDRS).
+            "mesh": (
+                client.mesh_status()
+                if hasattr(client, "mesh_status") else None
             ),
         }
 

@@ -131,6 +131,22 @@ class InprocClient:
     def engine_status(self) -> dict:
         return {"0": {"up": True, "restarts": 0}}
 
+    def mesh_status(self) -> dict | None:
+        return self.engine_core.mesh_status()
+
+    def poll_mesh(self) -> None:
+        """Drive mesh-membership recovery (no-op unless the heartbeat
+        ring is armed). A shrink/grow surfaces as EngineRestartedError —
+        the engine is ALIVE and recovered, but every interrupted request
+        must go through the frontend's journal-replay path. Suspects are
+        explicitly empty: a host death is not the requests' fault, so
+        the quarantine must not strike them."""
+        ev = self.engine_core.poll_mesh_recovery()
+        if ev is not None and ev["lost_req_ids"]:
+            raise EngineRestartedError(
+                ev["lost_req_ids"], engine_id=0, reason=ev["reason"],
+                suspect_req_ids=[])
+
     def is_ready(self) -> bool:
         return True
 
@@ -159,6 +175,39 @@ class _ZMQClientBase:
     # respawning. Without it, shutdown could race a respawn back to life
     # against the ZMQ sockets being closed (satellite of ISSUE 3).
     _closing = False
+
+    # Last mesh status pushed by an engine proc (MSG_MESH), keyed by
+    # engine id; None until a mesh-monitoring engine reports.
+    _mesh: dict[int, dict] | None = None
+
+    def mesh_status(self) -> dict | None:
+        if not self._mesh:
+            return None
+        # Single-engine deployments are the mesh case today; for DP just
+        # surface engine 0's view (each rank monitors the same ring).
+        return next(iter(self._mesh.values()))
+
+    def poll_mesh(self) -> None:
+        """MP mode: mesh recovery runs inside the engine proc's busy loop
+        and arrives via MSG_MESH on the output socket — nothing to drive
+        from the frontend."""
+
+    def _on_mesh_msg(self, frames: list[bytes]) -> None:
+        payload = self._serial.decode(frames[1])
+        eid = int(payload.get("engine_id", 0))
+        if self._mesh is None:
+            self._mesh = {}
+        self._mesh[eid] = payload.get("status") or {}
+        lost = payload.get("lost_req_ids") or []
+        if lost and not self._closing:
+            # The engine survived and recovered (shrunk/regrown mesh) —
+            # this is NOT a death, so no respawn: just hand the
+            # interrupted requests to the journal-replay path. Empty
+            # suspect set: a host death is not the requests' fault.
+            raise EngineRestartedError(
+                lost, engine_id=eid,
+                reason=payload.get("reason", "mesh recovery"),
+                suspect_req_ids=[])
 
     def suspend_recovery(self) -> None:
         """Permanently disable respawns on this client (graceful drain /
@@ -193,6 +242,9 @@ class _ZMQClientBase:
                         suspects=suspects,
                     )
                     continue  # unreachable (death handler raises)
+                if kind == self._proc_mod.MSG_MESH:
+                    self._on_mesh_msg(frames)  # raises on a recovery
+                    continue
                 if kind == self._proc_mod.MSG_READY and self._started:
                     # A respawned engine finished re-initialization.
                     self._on_engine_ready(self._serial.decode(frames[1]))

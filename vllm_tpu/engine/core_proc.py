@@ -24,6 +24,12 @@ MSG_READY = b"READY"
 MSG_OUTPUTS = b"OUT"
 MSG_DEAD = b"DEAD"
 MSG_UTILITY_REPLY = b"UTILREP"
+# Mesh membership/recovery report (multi-host fault tolerance): payload is
+# {"status": <mesh status dict>, "lost_req_ids": [...], "reason": str,
+# "engine_id": int}. A non-empty lost_req_ids means the engine JUST
+# recovered from a mesh shrink/grow and those requests need journal
+# replay — the engine itself is alive (no respawn).
+MSG_MESH = b"MESH"
 
 
 def run_engine_core(config_bytes: bytes, input_addr: str,
@@ -222,6 +228,43 @@ def run_engine_core(config_bytes: bytes, input_addr: str,
                 ),
             ])
 
+        def send_mesh(lost_req_ids: list[str], reason: str) -> None:
+            status = core.mesh_status()
+            for sock in outs:
+                sock.send_multipart([
+                    MSG_MESH,
+                    serial_utils.encode({
+                        "status": status,
+                        "lost_req_ids": lost_req_ids,
+                        "reason": reason,
+                        "engine_id": engine_id,
+                    }),
+                ])
+
+        last_mesh_epoch = None
+        if core.mesh_recovery is not None:
+            # Initial report so frontends render /health mesh state
+            # before any membership change.
+            send_mesh([], "mesh monitoring armed")
+            last_mesh_epoch = core.mesh_recovery.monitor.epoch
+
+        def poll_mesh() -> None:
+            nonlocal last_mesh_epoch
+            if core.mesh_recovery is None:
+                return
+            # Recovery (shrink/grow + request replay hand-off)...
+            ev = core.poll_mesh_recovery()
+            if ev is not None:
+                send_mesh(ev["lost_req_ids"], ev["reason"])
+                last_mesh_epoch = ev["status"]["epoch"]
+                return
+            # ...and plain status refreshes (epoch moved without a
+            # recovery decision, e.g. a rejoin observed mid-recovery).
+            epoch = core.mesh_recovery.monitor.epoch
+            if epoch != last_mesh_epoch:
+                send_mesh([], "mesh membership changed")
+                last_mesh_epoch = epoch
+
         while True:
             busy = core.has_unfinished_requests()
             # Idle: block on input (bounded so shutdown stays responsive).
@@ -294,6 +337,13 @@ def run_engine_core(config_bytes: bytes, input_addr: str,
                     return
                 timeout = 0
             drain_coordinator()
+            # Mesh membership: notice host death/rejoin and run the
+            # supervised shrink/grow BEFORE stepping — a step dispatched
+            # onto a mesh with a dead host wedges in the collective. A
+            # failed recovery raises (MeshRecoveryError) and unwinds
+            # through the generic death path below: cleanly dead, never
+            # half-meshed.
+            poll_mesh()
             # Report BEFORE stepping: step() can block inside a cross-rank
             # collective, and idle ranks only join once the coordinator has
             # seen this rank's load (reference: DPEngineCoreProc reports at

@@ -125,6 +125,15 @@ class EngineCore:
             else None
         )
         self._lora_names: set[str] = set()
+        # Multi-host mesh fault tolerance: armed only when the launcher
+        # provides a heartbeat ring (VLLM_TPU_MESH_HB_ADDRS); None on
+        # single-host deployments — zero overhead.
+        from vllm_tpu.resilience.mesh_recovery import MeshRecoveryManager
+
+        self.mesh_recovery = MeshRecoveryManager.from_env(
+            getattr(config, "resilience_config", None))
+        if self.mesh_recovery is not None:
+            self.mesh_recovery.start()
 
     def _make_structured_output_manager(self):
         from vllm_tpu.engine.input_processor import get_tokenizer
@@ -467,6 +476,85 @@ class EngineCore:
         self.executor.collective_rpc("reinitialize_parallel", new_tp)
         return True
 
+    # ------------------------------------------------------------------
+    # Multi-host mesh fault tolerance (host death -> supervised shrink)
+    # ------------------------------------------------------------------
+
+    def mesh_status(self) -> dict | None:
+        """Mesh membership/recovery status for /health, or None when mesh
+        monitoring is not armed."""
+        if self.mesh_recovery is None:
+            return None
+        return self.mesh_recovery.status()
+
+    def poll_mesh_recovery(self) -> dict | None:
+        """Busy-loop hook: notice membership changes and drive recovery.
+
+        Returns None when nothing happened, else a recovery report
+        ``{"lost_req_ids", "reason", "status"}`` the client layer turns
+        into an EngineRestartedError so the frontend journal-replays the
+        interrupted requests. A recovery that FAILS raises
+        MeshRecoveryError — the busy loop must let it unwind so the
+        process dies cleanly (never serve half-meshed).
+        """
+        if self.mesh_recovery is None:
+            return None
+        decision = self.mesh_recovery.poll()
+        if decision is None:
+            return None
+        return self._recover_mesh(decision)
+
+    def _recover_mesh(self, decision: dict) -> dict:
+        from vllm_tpu.resilience.mesh_recovery import MeshRecoveryError
+
+        action = decision["action"]
+        logger.warning("mesh %s: lost=%s rejoined=%s epoch=%d — starting "
+                       "supervised recovery", action, decision["lost"],
+                       decision["rejoined"], decision["epoch"])
+        self.mesh_recovery.begin_recovery()
+        try:
+            # Every unfinished request is interrupted: the in-flight
+            # steps' device arrays span the dead world (shrink) or the
+            # stale one (grow), and KV content does not survive the
+            # re-mesh either way. Collect BEFORE aborting.
+            lost_req_ids: list[str] = []
+            for scheduler_output, _handle in self._inflight:
+                lost_req_ids.extend(
+                    scheduler_output.num_scheduled_tokens.keys())
+            if self._executing is not None:
+                lost_req_ids.extend(
+                    self._executing.num_scheduled_tokens.keys())
+            lost_req_ids.extend(
+                r.request_id for r in self.scheduler.running)
+            lost_req_ids.extend(
+                r.request_id for r in self.scheduler.waiting)
+            lost_req_ids = list(dict.fromkeys(lost_req_ids))
+            # DISCARD in-flight handles without finalizing: a finalize is
+            # a device sync that can hang forever on a collective whose
+            # peer is dead. The arrays are garbage now anyway.
+            self._inflight.clear()
+            self._executing = None
+            self._drained_outputs.clear()
+            self.abort_requests(lost_req_ids)
+            self.reset_prefix_cache()
+            # Re-bootstrap the surviving hosts at the new world size and
+            # reshard/reload weights over the shrunken (or regrown) mesh.
+            world = self.mesh_recovery.survivor_world()
+            self.executor.collective_rpc(
+                "reinitialize_mesh",
+                *(world if world is not None else (None, None, None)))
+        except Exception as exc:
+            self.mesh_recovery.finish_recovery(ok=False)
+            raise MeshRecoveryError(
+                f"mesh {action} recovery failed: {exc}") from exc
+        self.mesh_recovery.finish_recovery(ok=True)
+        return {
+            "lost_req_ids": lost_req_ids,
+            "reason": (f"mesh {action}: lost ranks "
+                       f"{decision['lost'] or decision['rejoined']}"),
+            "status": self.mesh_recovery.status(),
+        }
+
     def update_weights(self, path: str) -> bool:
         assert not self.scheduler.has_unfinished_requests(), (
             "cannot swap weights with unfinished requests"
@@ -518,6 +606,8 @@ class EngineCore:
         return True
 
     def shutdown(self) -> None:
+        if self.mesh_recovery is not None:
+            self.mesh_recovery.stop()
         if self.structured_output_manager is not None:
             self.structured_output_manager.shutdown()
         if self.scheduler.kv_event_publisher is not None:

@@ -558,9 +558,16 @@ async def handle_health(request: web.Request) -> web.Response:
     )
     engines = status.get("engines", {})
     dead = status.get("engine_dead", False)
+    mesh = status.get("mesh")
     if dead:
         health = "dead"
     elif engines and not all(e.get("up") for e in engines.values()):
+        health = "degraded"
+    elif mesh is not None and mesh.get("state") in ("degraded",
+                                                    "recovering"):
+        # A shrunken (or mid-recovery) mesh still serves — at reduced
+        # capacity. Liveness stays 200; the state tells operators why
+        # throughput dropped.
         health = "degraded"
     else:
         health = "healthy"
@@ -578,6 +585,15 @@ async def handle_health(request: web.Request) -> web.Response:
         "requests_lost_on_restart_total": status.get(
             "requests_lost_on_restart_total", 0),
     }
+    if mesh is not None:
+        body["mesh"] = {
+            "size": mesh.get("size"),
+            "world_size": mesh.get("world_size"),
+            "lost_ranks": mesh.get("lost_ranks", []),
+            "epoch": mesh.get("epoch", 0),
+            "state": mesh.get("state", "healthy"),
+            "recoveries_total": mesh.get("recoveries_total", 0),
+        }
     # Multi-API-server topology: WHICH frontend shard answered, plus its
     # DP routing-decision view (prefix/least-loaded/round-robin counts).
     client = getattr(engine, "engine_core", None)

@@ -332,6 +332,23 @@ class PrometheusRegistry:
             "vllm:api_server_count",
             "Number of API-server frontends sharing the listen port")
         self.api_server_count.set(1.0)
+        # Multi-host mesh fault tolerance (PR 7): refreshed from the
+        # engine's mesh status at render time; all zero/absent-valued
+        # unless the heartbeat ring (VLLM_TPU_MESH_HB_ADDRS) is armed.
+        self.mesh_rank_losses = Counter(
+            "vllm:mesh_rank_losses_total",
+            "Mesh ranks declared lost (silent past the death timeout)")
+        self.mesh_recoveries = Counter(
+            "vllm:mesh_recoveries_total",
+            "Completed mesh recoveries (supervised shrink or grow-back)")
+        self.mesh_size = Gauge(
+            "vllm:mesh_size",
+            "Live mesh member count (world size minus lost ranks)")
+        self.mesh_recovery_duration = Histogram(
+            "vllm:mesh_recovery_duration_seconds",
+            "Wall time of a mesh recovery (loss/rejoin noticed -> "
+            "re-bootstrapped, resharded, serving)",
+            [0.1, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0, 120.0, 300.0])
         self._metrics = [
             self.num_running, self.num_waiting, self.kv_usage,
             self.prefix_queries, self.prefix_hits, self.preempted,
@@ -356,6 +373,8 @@ class PrometheusRegistry:
             self.requests_quarantined,
             self.dp_routing_decisions, self.dp_prefix_hit_blocks,
             self.api_server_index, self.api_server_count,
+            self.mesh_rank_losses, self.mesh_recoveries,
+            self.mesh_size, self.mesh_recovery_duration,
         ]
         self._engine = engine
         self._last_prefix = (0, 0)
@@ -460,6 +479,20 @@ class PrometheusRegistry:
                 float(coord.get("snapshot_age_s", 0.0)))
             self.routing_degraded.set(
                 1.0 if coord.get("routing_degraded") else 0.0)
+        mesh = status.get("mesh")
+        if mesh is not None:
+            self.mesh_size.set(float(mesh.get("size", 0)))
+            self.mesh_rank_losses.inc_to(
+                float(mesh.get("rank_losses_total", 0)))
+            self.mesh_recoveries.inc_to(
+                float(mesh.get("recoveries_total", 0)))
+            # The durations list is cumulative (it also feeds /health) —
+            # a high-water mark keeps each recovery observed exactly once.
+            durations = mesh.get("recovery_durations", []) or []
+            seen = getattr(self, "_mesh_durations_seen", 0)
+            for d in durations[seen:]:
+                self.mesh_recovery_duration.observe(float(d))
+            self._mesh_durations_seen = max(seen, len(durations))
 
     def _refresh_failpoints(self) -> None:
         from vllm_tpu.resilience import failpoints

@@ -1,0 +1,340 @@
+"""Per-rank mesh liveness over a side-channel heartbeat ring.
+
+A ``jax.distributed`` mesh has no failure detector: a dead host leaves
+every survivor wedged inside the next cross-host collective. This module
+adds one OUTSIDE the XLA runtime — plain UDP datagrams on a side channel,
+so it keeps working precisely when the collective fabric does not, and it
+imports no jax, so a lightweight peer (or a tier-1 test) can speak the
+protocol without owning devices.
+
+Topology: the ranks form a ring over the *live* member set. Each rank
+beats its ring successor every ``heartbeat_interval_s`` and watches its
+ring predecessor; a predecessor silent for ``death_timeout_s`` is
+declared LOST — anything shorter is a transient partition and declares
+nothing (that classification IS the ``--mesh-death-timeout-s`` knob).
+Membership changes are propagated as LOST/REJOIN control messages
+forwarded around the ring (a node forwards only when the message changed
+its own view, so flooding terminates), and successor/predecessor are
+recomputed over the shrunken ring so the detector keeps full coverage
+with members missing.
+
+A lost rank that comes back announces itself by simply beating again:
+the first rank to hear a beat from a lost member emits REJOIN and
+forwards it. The ``epoch`` counter increments on every membership
+change; the recovery orchestrator uses it to name recovery generations.
+
+Failpoint ``mesh.heartbeat`` guards the beat send: ``drop`` makes this
+rank fall silent (peers classify host death), ``delay`` makes beats late
+but under the timeout (transient partition — no loss declared).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import socket
+import threading
+import time
+from dataclasses import dataclass, field
+
+from vllm_tpu.logger import init_logger
+
+logger = init_logger(__name__)
+
+ENV_HB_ADDRS = "VLLM_TPU_MESH_HB_ADDRS"
+
+_MAX_DGRAM = 8192
+
+
+def parse_hb_addrs(spec: str | None = None) -> list[tuple[str, int]]:
+    """Parse ``VLLM_TPU_MESH_HB_ADDRS`` (comma-separated ``host:port``,
+    rank-indexed) into address tuples. Empty/missing -> []."""
+    if spec is None:
+        spec = os.environ.get(ENV_HB_ADDRS, "")
+    addrs: list[tuple[str, int]] = []
+    for part in filter(None, (p.strip() for p in spec.split(","))):
+        host, _, port = part.rpartition(":")
+        if not host or not port.isdigit():
+            raise ValueError(
+                f"{ENV_HB_ADDRS}: malformed address {part!r} "
+                "(expected host:port)")
+        addrs.append((host, int(port)))
+    return addrs
+
+
+@dataclass
+class MeshEvent:
+    kind: str            # "lost" | "rejoin"
+    rank: int            # the rank that changed state
+    epoch: int           # membership epoch AFTER the change
+    at: float = field(default_factory=time.monotonic)
+
+
+class MeshMonitor:
+    """Liveness detector for one rank of the heartbeat ring.
+
+    Thread model: a sender thread (beat + predecessor deadline check) and
+    a receiver thread (datagram dispatch) run after :meth:`start`; state
+    is guarded by one lock. Consumers either pass ``on_event`` (called on
+    monitor threads — must not block) or drain :meth:`poll_events` from
+    their own loop (the engine-core busy loop does the latter).
+    """
+
+    def __init__(
+        self,
+        rank: int,
+        addrs: list[tuple[str, int]],
+        *,
+        heartbeat_interval_s: float = 0.2,
+        death_timeout_s: float = 2.0,
+        on_event=None,
+    ) -> None:
+        if not (0 <= rank < len(addrs)):
+            raise ValueError(
+                f"rank {rank} out of range for {len(addrs)} addresses")
+        if death_timeout_s <= heartbeat_interval_s:
+            raise ValueError(
+                "death_timeout_s must exceed heartbeat_interval_s "
+                f"({death_timeout_s} <= {heartbeat_interval_s})")
+        self.rank = rank
+        self.world_size = len(addrs)
+        self._addrs = list(addrs)
+        self._interval = heartbeat_interval_s
+        self._timeout = death_timeout_s
+        self._on_event = on_event
+
+        self._lock = threading.Lock()
+        self._lost: set[int] = set()
+        self._epoch = 0
+        self._last_seen: dict[int, float] = {}
+        self._events: list[MeshEvent] = []
+        self.beats_sent = 0
+        self.beats_received = 0
+
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        self._sock.bind(addrs[rank])
+        self._stop = threading.Event()
+        self._threads: list[threading.Thread] = []
+
+    # -- ring geometry (callers hold the lock) --------------------------
+
+    def _live(self) -> list[int]:
+        return [r for r in range(self.world_size)
+                if r == self.rank or r not in self._lost]
+
+    def _successor(self) -> int | None:
+        live = self._live()
+        if len(live) < 2:
+            return None
+        return live[(live.index(self.rank) + 1) % len(live)]
+
+    def _predecessor(self) -> int | None:
+        live = self._live()
+        if len(live) < 2:
+            return None
+        return live[live.index(self.rank) - 1]
+
+    # -- lifecycle ------------------------------------------------------
+
+    def start(self) -> None:
+        if self.world_size < 2:
+            return  # nothing to monitor
+        now = time.monotonic()
+        with self._lock:
+            # Startup grace: every peer gets a full timeout to produce
+            # its first beat before it can be declared lost.
+            for r in range(self.world_size):
+                if r != self.rank:
+                    self._last_seen[r] = now
+        self._threads = [
+            threading.Thread(target=self._send_loop,
+                             name=f"mesh-hb-send-{self.rank}", daemon=True),
+            threading.Thread(target=self._recv_loop,
+                             name=f"mesh-hb-recv-{self.rank}", daemon=True),
+        ]
+        for t in self._threads:
+            t.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+        for t in self._threads:
+            t.join(timeout=2.0)
+        self._threads = []
+
+    # -- wire -----------------------------------------------------------
+
+    def _send(self, msg: dict, to_rank: int) -> None:
+        try:
+            self._sock.sendto(
+                json.dumps(msg).encode(), self._addrs[to_rank])
+        except OSError:
+            pass  # a dead destination is exactly what we detect elsewhere
+
+    def _send_loop(self) -> None:
+        # Imported here, not at module top: resilience.__init__ imports
+        # the recovery manager which imports this module, so a top-level
+        # import of the failpoint framework would be circular.
+        from vllm_tpu.resilience.failpoints import fail_point
+        while not self._stop.wait(self._interval):
+            # Failpoint first: "drop" silences this rank entirely (its
+            # peers see host death), "delay" ships the beat late.
+            if fail_point("mesh.heartbeat",
+                          lambda: f"rank={self.rank}") == "drop":
+                continue
+            with self._lock:
+                succ = self._successor()
+                pred = self._predecessor()
+                epoch = self._epoch
+            if succ is not None:
+                self._send({"t": "beat", "rank": self.rank,
+                            "epoch": epoch}, succ)
+                with self._lock:
+                    self.beats_sent += 1
+            if pred is not None:
+                self._check_deadline(pred)
+
+    def _check_deadline(self, pred: int) -> None:
+        now = time.monotonic()
+        with self._lock:
+            last = self._last_seen.get(pred, now)
+            if now - last <= self._timeout or pred in self._lost:
+                return
+            ev = self._declare_lost_locked(pred)
+        self._emit(ev)
+        # Propagate around the (shrunken) ring.
+        with self._lock:
+            succ = self._successor()
+            epoch = self._epoch
+        if succ is not None:
+            self._send({"t": "lost", "rank": pred, "origin": self.rank,
+                        "epoch": epoch}, succ)
+
+    def _recv_loop(self) -> None:
+        self._sock.settimeout(self._interval)
+        while not self._stop.is_set():
+            try:
+                data, _ = self._sock.recvfrom(_MAX_DGRAM)
+            except socket.timeout:
+                continue
+            except OSError:
+                return  # socket closed by stop()
+            try:
+                msg = json.loads(data.decode())
+            except ValueError:
+                continue
+            self._dispatch(msg)
+
+    def _dispatch(self, msg: dict) -> None:
+        kind = msg.get("t")
+        rank = msg.get("rank")
+        if not isinstance(rank, int) or not (0 <= rank < self.world_size):
+            return
+        if kind == "beat":
+            self._on_beat(rank)
+        elif kind == "lost" and rank != self.rank:
+            self._on_lost_msg(rank)
+        elif kind == "rejoin" and rank != self.rank:
+            self._on_rejoin_msg(rank)
+
+    def _on_beat(self, rank: int) -> None:
+        now = time.monotonic()
+        ev = None
+        with self._lock:
+            self.beats_received += 1
+            self._last_seen[rank] = now
+            if rank in self._lost:
+                # A lost member is beating again: it came back.
+                ev = self._declare_rejoin_locked(rank)
+            succ = self._successor()
+        if ev is not None:
+            self._emit(ev)
+            if succ is not None:
+                self._send({"t": "rejoin", "rank": rank,
+                            "origin": self.rank, "epoch": ev.epoch}, succ)
+
+    def _on_lost_msg(self, rank: int) -> None:
+        ev = None
+        with self._lock:
+            # Guard against a stale LOST racing a rejoin: ignore the
+            # report if we heard the rank ourselves within an interval.
+            fresh = (time.monotonic()
+                     - self._last_seen.get(rank, 0.0)) < self._interval
+            if rank not in self._lost and not fresh:
+                ev = self._declare_lost_locked(rank)
+            succ = self._successor()
+        if ev is not None:  # state changed -> keep forwarding
+            self._emit(ev)
+            if succ is not None:
+                self._send({"t": "lost", "rank": rank,
+                            "origin": self.rank, "epoch": ev.epoch}, succ)
+
+    def _on_rejoin_msg(self, rank: int) -> None:
+        ev = None
+        with self._lock:
+            if rank in self._lost:
+                ev = self._declare_rejoin_locked(rank)
+            succ = self._successor()
+        if ev is not None:
+            self._emit(ev)
+            if succ is not None:
+                self._send({"t": "rejoin", "rank": rank,
+                            "origin": self.rank, "epoch": ev.epoch}, succ)
+
+    # -- membership (callers hold the lock) -----------------------------
+
+    def _declare_lost_locked(self, rank: int) -> MeshEvent:
+        self._lost.add(rank)
+        self._epoch += 1
+        logger.warning(
+            "mesh: rank %d declared LOST (silent > %.3fs); live=%s "
+            "epoch=%d", rank, self._timeout, self._live(), self._epoch)
+        return MeshEvent("lost", rank, self._epoch)
+
+    def _declare_rejoin_locked(self, rank: int) -> MeshEvent:
+        self._lost.discard(rank)
+        self._last_seen[rank] = time.monotonic()
+        self._epoch += 1
+        logger.info("mesh: rank %d REJOINED; live=%s epoch=%d",
+                    rank, self._live(), self._epoch)
+        return MeshEvent("rejoin", rank, self._epoch)
+
+    def _emit(self, ev: MeshEvent) -> None:
+        with self._lock:
+            self._events.append(ev)
+        if self._on_event is not None:
+            try:
+                self._on_event(ev)
+            except Exception:
+                logger.exception("mesh: on_event callback failed")
+
+    # -- consumer API ---------------------------------------------------
+
+    def poll_events(self) -> list[MeshEvent]:
+        """Drain pending membership events (engine busy-loop pull path)."""
+        with self._lock:
+            evs, self._events = self._events, []
+        return evs
+
+    def lost_ranks(self) -> list[int]:
+        with self._lock:
+            return sorted(self._lost)
+
+    @property
+    def epoch(self) -> int:
+        with self._lock:
+            return self._epoch
+
+    def status(self) -> dict:
+        with self._lock:
+            lost = sorted(self._lost)
+            return {
+                "size": self.world_size - len(lost),
+                "world_size": self.world_size,
+                "lost_ranks": lost,
+                "epoch": self._epoch,
+                "state": "degraded" if lost else "healthy",
+            }

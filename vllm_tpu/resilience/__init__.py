@@ -43,6 +43,10 @@ chaos schedules + global-invariant checking over a live engine).
 
 from vllm_tpu.resilience.config import ResilienceConfig
 from vllm_tpu.resilience.journal import JournalEntry, RequestJournal
+from vllm_tpu.resilience.mesh_recovery import (
+    MeshRecoveryError,
+    MeshRecoveryManager,
+)
 from vllm_tpu.resilience.lifecycle import (
     TIMEOUT_FINISH_REASON,
     AdmissionController,
@@ -112,6 +116,8 @@ __all__ = [
     "EngineSupervisor",
     "JournalEntry",
     "LifecycleConfig",
+    "MeshRecoveryError",
+    "MeshRecoveryManager",
     "QuarantineManager",
     "RequestFailedOnCrashError",
     "RequestJournal",

@@ -57,8 +57,9 @@ OUTCOME_HUNG = "hung"
 @dataclass
 class ChaosEvent:
     at_s: float          # offset from run start
-    kind: str            # kill_engine | kill_coordinator | failpoints
-    target: int | None = None   # engine id for kill_engine
+    # kill_engine | kill_coordinator | failpoints | kill_host | rejoin_host
+    kind: str
+    target: int | None = None   # engine id / heartbeat-ring rank
     spec: str | None = None     # failpoint spec for kind == failpoints
 
     def __str__(self) -> str:
@@ -85,12 +86,20 @@ def make_plan(
     engine_kills: int = 1,
     coordinator_kills: int = 0,
     failpoint_specs: list[str] | None = None,
+    host_kills: int = 0,
+    host_rejoin: bool = False,
+    num_hosts: int = 2,
 ) -> ChaosPlan:
     """Expand a seed into a deterministic fault schedule.
 
     ``failpoint_specs`` entries are full VLLM_TPU_FAILPOINTS strings; one
     is armed at a seeded time and runs for the rest of the schedule
     (failpoint term lists already encode their own finite budgets).
+
+    ``host_kills`` SIGKILLs a heartbeat-ring *peer* (never rank 0 — that
+    is the engine under test) at a seeded time; with ``host_rejoin`` the
+    same rank respawns later in the window, so the run exercises shrink
+    AND grow-back.
     """
     rng = random.Random(seed)
     events: list[ChaosEvent] = []
@@ -108,8 +117,104 @@ def make_plan(
     for spec in failpoint_specs or []:
         events.append(ChaosEvent(
             at_s=rng.uniform(lo, hi), kind="failpoints", spec=spec))
+    for _ in range(host_kills):
+        rank = rng.randrange(1, max(2, num_hosts))
+        # Kill early enough that a rejoin (and its second recovery) fits
+        # before the invariant sweep.
+        kill_at = rng.uniform(lo, lo + 0.4 * (hi - lo))
+        events.append(ChaosEvent(
+            at_s=kill_at, kind="kill_host", target=rank))
+        if host_rejoin:
+            events.append(ChaosEvent(
+                at_s=rng.uniform(kill_at + 0.3 * (hi - kill_at), hi),
+                kind="rejoin_host", target=rank))
     events.sort(key=lambda e: e.at_s)
     return ChaosPlan(seed=seed, duration_s=duration_s, events=events)
+
+
+# Stand-in for a remote host on the heartbeat ring: speaks the mesh
+# liveness protocol (vllm_tpu/parallel/mesh_monitor) and nothing else —
+# no jax, no devices — so chaos runs and tier-1 tests can kill/respawn
+# "hosts" cheaply. The addrs spec rides the child's environment.
+_PEER_SCRIPT = """\
+import sys, time
+from vllm_tpu.parallel.mesh_monitor import MeshMonitor, parse_hb_addrs
+rank = int(sys.argv[1])
+mon = MeshMonitor(rank, parse_hb_addrs(),
+                  heartbeat_interval_s=float(sys.argv[2]),
+                  death_timeout_s=float(sys.argv[3]))
+mon.start()
+print("PEER_UP", rank, flush=True)
+while True:
+    time.sleep(1.0)
+"""
+
+
+class HeartbeatPeerManager:
+    """Spawns/kills/respawns heartbeat-ring peer processes (the chaos
+    harness's model of remote hosts dying and coming back)."""
+
+    def __init__(self, addrs_spec: str, ranks: list[int], *,
+                 heartbeat_interval_s: float = 0.1,
+                 death_timeout_s: float = 1.0) -> None:
+        self.addrs_spec = addrs_spec
+        self.ranks = list(ranks)
+        self.interval = heartbeat_interval_s
+        self.timeout = death_timeout_s
+        self.procs: dict[int, Any] = {}
+
+    def _spawn(self, rank: int):
+        import subprocess
+        import sys as _sys
+
+        from vllm_tpu.parallel.mesh_monitor import ENV_HB_ADDRS
+
+        env = dict(os.environ)
+        env[ENV_HB_ADDRS] = self.addrs_spec
+        env.setdefault("PYTHONPATH", os.getcwd())
+        return subprocess.Popen(
+            [_sys.executable, "-c", _PEER_SCRIPT, str(rank),
+             str(self.interval), str(self.timeout)],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            text=True)
+
+    def start_all(self) -> None:
+        for rank in self.ranks:
+            self.procs[rank] = self._spawn(rank)
+
+    def wait_up(self, timeout_s: float = 30.0) -> None:
+        """Block until every peer printed its PEER_UP banner (its monitor
+        is bound and beating)."""
+        deadline = time.monotonic() + timeout_s
+        for rank, proc in self.procs.items():
+            line = proc.stdout.readline()
+            if "PEER_UP" not in line:
+                raise RuntimeError(
+                    f"heartbeat peer {rank} failed to start: {line!r}")
+            if time.monotonic() > deadline:
+                raise TimeoutError("heartbeat peers did not come up")
+
+    def kill(self, rank: int) -> str:
+        proc = self.procs.get(rank)
+        if proc is None or proc.poll() is not None:
+            return f"kill_host[{rank}]: not running"
+        os.kill(proc.pid, signal.SIGKILL)
+        proc.wait()
+        return f"kill_host[{rank}]: SIGKILL pid {proc.pid}"
+
+    def respawn(self, rank: int) -> str:
+        old = self.procs.get(rank)
+        if old is not None and old.poll() is None:
+            return f"rejoin_host[{rank}]: already running"
+        self.procs[rank] = self._spawn(rank)
+        return f"rejoin_host[{rank}]: respawned pid {self.procs[rank].pid}"
+
+    def stop_all(self) -> None:
+        for proc in self.procs.values():
+            if proc.poll() is None:
+                proc.kill()
+                proc.wait()
+        self.procs.clear()
 
 
 class InvariantLedger:
@@ -208,9 +313,11 @@ class ChaosDriver:
     runtime re-arming cannot cross the process boundary).
     """
 
-    def __init__(self, engine: Any, plan: ChaosPlan) -> None:
+    def __init__(self, engine: Any, plan: ChaosPlan,
+                 host_peers: "HeartbeatPeerManager | None" = None) -> None:
         self.engine = engine
         self.plan = plan
+        self.host_peers = host_peers
         self.applied: list[str] = []
 
     def _kill(self, pid: int | None, what: str) -> None:
@@ -245,6 +352,15 @@ class ChaosDriver:
         elif event.kind == "failpoints":
             failpoints.configure(event.spec or "", seed=self.plan.seed)
             self.applied.append(f"failpoints: armed {event.spec!r}")
+        elif event.kind in ("kill_host", "rejoin_host"):
+            if self.host_peers is None:
+                self.applied.append(f"{event.kind}: no peer manager")
+                return
+            if event.kind == "kill_host":
+                self.applied.append(self.host_peers.kill(event.target or 1))
+            else:
+                self.applied.append(
+                    self.host_peers.respawn(event.target or 1))
         else:
             raise ValueError(f"unknown chaos event kind {event.kind!r}")
 
@@ -291,6 +407,7 @@ async def run_chaos(
     request_timeout_s: float = 120.0,
     prompt_token_ids: list[int] | None = None,
     poison_request_id: str | None = None,
+    host_peers: "HeartbeatPeerManager | None" = None,
 ) -> ChaosReport:
     """Stream a seeded workload through ``engine`` while ``plan``'s faults
     land, then sweep the invariants.
@@ -312,7 +429,7 @@ async def run_chaos(
 
     rng = random.Random(plan.seed ^ 0x5EED)
     ledger = InvariantLedger()
-    driver = ChaosDriver(engine, plan)
+    driver = ChaosDriver(engine, plan, host_peers=host_peers)
     sem = asyncio.Semaphore(concurrency)
     t0 = time.monotonic()
 

@@ -84,6 +84,16 @@ class ResilienceConfig:
     # probe (the probation cap); the rest are held until the probe's
     # requests reach a terminal state. 0 = no cap.
     quarantine_probation_cap: int = 8
+    # Multi-host mesh fault tolerance (vllm_tpu/resilience/mesh_recovery):
+    # a rank of the heartbeat ring silent for this long is classified as
+    # HOST DEATH and triggers a supervised mesh shrink; shorter silences
+    # are transient partitions and trigger nothing. Monitoring itself is
+    # armed by VLLM_TPU_MESH_HB_ADDRS (the ring's rank-indexed side-
+    # channel addresses) — without it this knob is inert.
+    mesh_death_timeout_s: float = 2.0
+    # Beat period on the heartbeat ring. Must be well under the death
+    # timeout (a single delayed datagram must not look like a death).
+    mesh_heartbeat_interval_s: float = 0.2
 
     def finalize(self) -> "ResilienceConfig":
         if self.max_engine_restarts < 0:
@@ -126,5 +136,17 @@ class ResilienceConfig:
             raise ValueError(
                 f"quarantine_probation_cap must be >= 0, got "
                 f"{self.quarantine_probation_cap}"
+            )
+        if self.mesh_heartbeat_interval_s <= 0:
+            raise ValueError(
+                f"mesh_heartbeat_interval_s must be > 0, got "
+                f"{self.mesh_heartbeat_interval_s}"
+            )
+        if self.mesh_death_timeout_s <= self.mesh_heartbeat_interval_s:
+            raise ValueError(
+                f"mesh_death_timeout_s ({self.mesh_death_timeout_s}) must "
+                f"exceed mesh_heartbeat_interval_s "
+                f"({self.mesh_heartbeat_interval_s}): a single late beat "
+                "must not classify as host death"
             )
         return self

@@ -124,6 +124,21 @@ SITE_CATALOG: dict[str, str] = {
         "poison this step's logits (numeric-guard containment path), "
         "hang_step = stall inside the step window (step-watchdog path), "
         "raise = crash the step (poison-request quarantine path)"),
+    "mesh.heartbeat": (
+        "MeshMonitor, before a liveness beat is sent to the ring "
+        "successor; drop = this rank falls silent (peers classify host "
+        "death after mesh_death_timeout_s), delay = transient partition "
+        "(beats late but under the death timeout: no loss declared), "
+        "exit = the host actually dies mid-beat"),
+    "dist.barrier": (
+        "dist_barrier, before the cross-host sync collective; delay = "
+        "transient partition stalling the barrier, hang = a wedged peer "
+        "holding the collective open (step-watchdog territory)"),
+    "worker.reinitialize_mesh": (
+        "Worker.reinitialize_mesh, before the survivors' re-bootstrap + "
+        "reshard; raise = mesh recovery fails mid-flight — the engine "
+        "must come out fully recovered or cleanly dead, never "
+        "half-meshed"),
 }
 
 _EXC_WHITELIST: dict[str, type[BaseException]] = {

@@ -1,0 +1,198 @@
+"""Mesh-level recovery orchestration: host death -> supervised shrink.
+
+The :class:`~vllm_tpu.parallel.mesh_monitor.MeshMonitor` answers WHO is
+alive; this module decides WHAT to do about it. It owns the monitor, the
+recovery state machine (``healthy -> recovering -> degraded`` on shrink,
+``-> recovering -> healthy`` on grow-back), and the counters behind the
+``vllm:mesh_*`` metric series. The engine core drives it from the busy
+loop: :meth:`MeshRecoveryManager.poll` drains membership events and
+coalesces them into at most one recovery decision per call; the engine
+then executes the decision (abort in-flight step, re-bootstrap the
+survivors, reshard/reload weights, journal-replay requests) bracketed by
+:meth:`begin_recovery` / :meth:`finish_recovery`.
+
+Classification contract (the ``--mesh-death-timeout-s`` knob): only the
+monitor declares loss, and it only does so after ``death_timeout_s`` of
+silence — a transient partition (shorter silence, or ``dist.barrier``
+delay injection) produces NO event and therefore no recovery. A failed
+recovery is fatal by design: :class:`MeshRecoveryError` propagates out of
+the busy loop so the process dies cleanly for its supervisor — a
+half-meshed engine must never keep serving.
+
+Environment:
+
+    VLLM_TPU_MESH_HB_ADDRS  rank-indexed host:port list -> arms monitoring
+    VLLM_TPU_MESH_HB_RANK   this process's ring rank (defaults to
+                            VLLM_TPU_DIST_PROCESS_ID, then 0)
+
+The heartbeat ring rank is assumed to equal the jax.distributed process
+id — the launcher writes both from the same topology, and
+:meth:`survivor_world` relies on it to map lost ring ranks onto the
+shrunken bootstrap world.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+
+from vllm_tpu.logger import init_logger
+from vllm_tpu.parallel.mesh_monitor import (ENV_HB_ADDRS, MeshMonitor,
+                                            parse_hb_addrs)
+
+logger = init_logger(__name__)
+
+ENV_HB_RANK = "VLLM_TPU_MESH_HB_RANK"
+
+
+class MeshRecoveryError(RuntimeError):
+    """Mesh recovery itself failed (e.g. the re-bootstrap or reshard
+    raised mid-flight). The engine must not survive this: the busy loop
+    lets it propagate so the process exits and the supervisor respawns a
+    whole fresh engine rather than ever serving half-meshed."""
+
+
+class MeshRecoveryManager:
+    """Owns the mesh monitor + the shrink/grow recovery state machine."""
+
+    def __init__(
+        self,
+        rank: int,
+        addrs: list[tuple[str, int]],
+        *,
+        heartbeat_interval_s: float = 0.2,
+        death_timeout_s: float = 2.0,
+    ) -> None:
+        self.rank = rank
+        self.monitor = MeshMonitor(
+            rank, addrs,
+            heartbeat_interval_s=heartbeat_interval_s,
+            death_timeout_s=death_timeout_s,
+        )
+        self._recovering = False
+        # Observability (drained by the metrics layer via status()):
+        self.rank_losses_total = 0
+        self.recoveries_total = 0
+        self._recovery_durations: list[float] = []
+        self._recovery_started_at: float | None = None
+
+    @classmethod
+    def from_env(cls, resilience_config=None) -> "MeshRecoveryManager | None":
+        """Build from ``VLLM_TPU_MESH_HB_*`` env, or None when mesh
+        monitoring is not armed (no ring addresses configured)."""
+        addrs = parse_hb_addrs()
+        if len(addrs) < 2:
+            if addrs:
+                logger.warning(
+                    "%s has a single address — mesh monitoring needs >= 2 "
+                    "ranks, ignoring", ENV_HB_ADDRS)
+            return None
+        rank_env = os.environ.get(
+            ENV_HB_RANK, os.environ.get("VLLM_TPU_DIST_PROCESS_ID", "0"))
+        rank = int(rank_env)
+        interval = 0.2
+        timeout = 2.0
+        if resilience_config is not None:
+            interval = resilience_config.mesh_heartbeat_interval_s
+            timeout = resilience_config.mesh_death_timeout_s
+        return cls(rank, addrs,
+                   heartbeat_interval_s=interval, death_timeout_s=timeout)
+
+    # -- lifecycle ------------------------------------------------------
+
+    def start(self) -> None:
+        self.monitor.start()
+        logger.info(
+            "mesh monitoring armed: rank %d of %d, interval=%.3fs "
+            "death_timeout=%.3fs", self.rank, self.monitor.world_size,
+            self.monitor._interval, self.monitor._timeout)
+
+    def stop(self) -> None:
+        self.monitor.stop()
+
+    # -- decisions ------------------------------------------------------
+
+    def poll(self) -> dict | None:
+        """Drain membership events; coalesce into one recovery decision.
+
+        Returns None (nothing happened / already recovering) or
+        ``{"action": "shrink"|"grow", "lost": [...], "rejoined": [...],
+        "epoch": int}``. Any loss in the batch makes the decision a
+        shrink (the grow is picked up on a later poll once the rejoin
+        lands in a quiet batch) — shrink must never wait behind grow.
+        """
+        events = self.monitor.poll_events()
+        if not events or self._recovering:
+            # Events drained while a recovery executes are intentionally
+            # dropped: the recovery re-reads lost_ranks() at commit time.
+            return None
+        lost = sorted({e.rank for e in events if e.kind == "lost"})
+        rejoined = sorted({e.rank for e in events if e.kind == "rejoin"})
+        self.rank_losses_total += len(lost)
+        epoch = events[-1].epoch
+        if lost:
+            return {"action": "shrink", "lost": lost,
+                    "rejoined": rejoined, "epoch": epoch}
+        if rejoined:
+            return {"action": "grow", "lost": [],
+                    "rejoined": rejoined, "epoch": epoch}
+        return None
+
+    def begin_recovery(self) -> None:
+        self._recovering = True
+        self._recovery_started_at = time.monotonic()
+
+    def finish_recovery(self, ok: bool) -> None:
+        duration = 0.0
+        if self._recovery_started_at is not None:
+            duration = time.monotonic() - self._recovery_started_at
+        self._recovery_started_at = None
+        self._recovering = False
+        if ok:
+            self.recoveries_total += 1
+            self._recovery_durations.append(duration)
+            logger.info("mesh recovery #%d completed in %.3fs; %s",
+                        self.recoveries_total, duration, self.status())
+        else:
+            logger.error("mesh recovery FAILED after %.3fs", duration)
+
+    def survivor_world(self) -> tuple[str, int, int] | None:
+        """Map the current live set onto a fresh jax.distributed world:
+        ``(coordinator_address, num_processes, process_id)`` for THIS
+        process's re-bootstrap, or None when the original launch was not
+        an explicit-coordinator multi-process one (uniproc: nothing to
+        re-mesh, the degenerate recovery is just request replay).
+
+        Coordinator placement: keep the original coordinator if rank 0
+        survives; otherwise the lowest surviving rank hosts it, on its
+        heartbeat host + the original coordinator port (the heartbeat
+        address is the only per-rank host fact the survivors share).
+        """
+        coordinator = os.environ.get("VLLM_TPU_DIST_COORDINATOR")
+        if not coordinator:
+            return None
+        lost = set(self.monitor.lost_ranks())
+        live = [r for r in range(self.monitor.world_size) if r not in lost]
+        if self.rank not in live or len(live) < 1:
+            return None
+        if 0 in live:
+            new_coord = coordinator
+        else:
+            host = self.monitor._addrs[live[0]][0]
+            port = coordinator.rpartition(":")[2]
+            new_coord = f"{host}:{port}"
+        return (new_coord, len(live), live.index(self.rank))
+
+    # -- observability --------------------------------------------------
+
+    def status(self) -> dict:
+        st = self.monitor.status()
+        if self._recovering:
+            st["state"] = "recovering"
+        st["rank_losses_total"] = self.rank_losses_total
+        st["recoveries_total"] = self.recoveries_total
+        # Cumulative (recoveries are rare; the metrics layer keeps a
+        # high-water mark so each duration lands in the histogram once
+        # even though /health and /metrics both read this snapshot).
+        st["recovery_durations"] = list(self._recovery_durations)
+        return st

@@ -650,6 +650,13 @@ class Worker:
 
     def execute_model(self, scheduler_output: SchedulerOutput) -> ModelRunnerOutput:
         assert self.runner is not None
+        if getattr(self, "_mesh_poisoned", False):
+            # A failed reinitialize_mesh left partially-rebuilt state; a
+            # step here could compute on garbage. The engine is supposed
+            # to be dying already — make sure of it.
+            raise RuntimeError(
+                "worker is half-meshed after a failed mesh recovery; "
+                "refusing to execute")
         return self.runner.execute_model(scheduler_output)
 
     def execute_dummy_batch(self) -> None:
@@ -883,6 +890,123 @@ class Worker:
             None if new_mesh is None else
             dict(zip(new_mesh.axis_names, new_mesh.devices.shape)),
         )
+        return num_blocks
+
+    def reinitialize_mesh(
+        self,
+        coordinator_address: str | None,
+        num_processes: int | None,
+        process_id: int | None,
+    ) -> int:
+        """Mesh-shrink/grow recovery: tear down the jax.distributed
+        runtime and re-bootstrap it over the given survivor world, then
+        rebuild mesh + weights + runner against the new global device set.
+
+        Differs from :meth:`reinitialize_parallel` in one crucial way:
+        the OLD global arrays are invalid (their device set includes the
+        dead host / the old backend is gone), so weights cannot be
+        resharded in place — they are reloaded from the checkpoint onto
+        the new mesh. A ``None`` world means the original launch was
+        uniproc (or metadata-discovered): there is no runtime to re-form,
+        and the recovery degenerates to the request-replay the engine
+        already performed — weights and runner are untouched.
+
+        Any failure after the teardown leaves this worker poisoned
+        (``_mesh_poisoned``): the exception propagates as a fatal
+        MeshRecoveryError upstream, and no step may run on the
+        half-built state in between — fully recovered or cleanly dead,
+        never half-meshed.
+        """
+        from vllm_tpu.resilience.failpoints import fail_point
+
+        fail_point("worker.reinitialize_mesh",
+                   lambda: f"world={coordinator_address},{num_processes},"
+                           f"{process_id}")
+        num_blocks = self.config.cache_config.num_gpu_blocks
+        if coordinator_address is None:
+            return num_blocks
+        from vllm_tpu.parallel.distributed import (init_distributed,
+                                                   shutdown_distributed)
+
+        self._mesh_poisoned = True
+        try:
+            old_ndev = len(jax.devices())
+            pc = self.config.parallel_config
+            old_tp = pc.tensor_parallel_size
+            # Drop every reference into the old world BEFORE the
+            # teardown: live Device/Array handles keep the old backend —
+            # and through its collectives, the old coordination client —
+            # alive. An undead client that later polls the NEW world's
+            # coordination service aborts the process from a C++ thread.
+            old_runner = self.runner
+            som = (old_runner.structured_output_manager
+                   if old_runner is not None else None)
+            connector = getattr(old_runner, "kv_connector", None)
+            old_runner = None
+            self.runner = None
+            self.params = None
+            self.mesh = None
+            if getattr(self.model, "expert_parallel", False):
+                self.model.ep_mesh = None
+            # Forced teardown: on a shrink a peer is already dead and can
+            # never join the cooperative shutdown barrier; on a grow the
+            # old (shrunken) world is being abandoned anyway.
+            shutdown_distributed(force=True)
+            init_distributed(
+                coordinator_address=coordinator_address,
+                num_processes=num_processes,
+                process_id=process_id,
+            )
+            new_ndev = len(jax.devices())
+            # Scale tp proportionally with the device count (a 2-host
+            # tp=8 world losing one host re-forms at tp=4). Other mesh
+            # axes were validated =1 by validate_parallel_resize rules.
+            new_tp = max(1, old_tp * new_ndev // old_ndev)
+            pc.tensor_parallel_size = new_tp
+            new_mesh = None
+            shardings = None
+            if pc.world_size > 1:
+                from vllm_tpu.parallel.mesh import (build_mesh,
+                                                    named_shardings)
+
+                new_mesh = build_mesh(pc)
+                shardings = named_shardings(
+                    new_mesh, self.model.param_shardings())
+            mc = self.config.model_config
+            # Reload, don't reshard: the dead host's shards are gone and
+            # the old arrays belong to a torn-down backend.
+            if mc.load_format == "dummy":
+                from vllm_tpu.models.loader import init_dummy_params
+
+                self.params = init_dummy_params(
+                    self.model, mc.seed, mc.jax_dtype, shardings)
+            else:
+                self.params = self.model.load_params(
+                    mc.model, mc.jax_dtype, shardings)
+            self.mesh = new_mesh
+            if getattr(self.model, "expert_parallel", False):
+                self.model.ep_mesh = new_mesh
+            self.runner = ModelRunner(
+                self.config, self.model, self.params, num_blocks, new_mesh,
+                draft_model=self.draft_model,
+                draft_params=self.draft_params,
+            )
+            if som is not None:
+                self.runner.structured_output_manager = som
+            if connector is not None:
+                self.runner.kv_connector = connector
+            if self.runner.lora_manager is not None:
+                for name, path in self._lora_paths.items():
+                    self.runner.lora_manager.add_lora(name, path)
+            logger.info(
+                "mesh recovery: re-bootstrapped %d processes "
+                "(process %s), devices %d -> %d, tp %d -> %d",
+                num_processes, process_id, old_ndev, new_ndev,
+                old_tp, new_tp)
+        except Exception:
+            logger.exception("mesh re-bootstrap failed; worker poisoned")
+            raise
+        self._mesh_poisoned = False
         return num_blocks
 
     def set_kv_connector(self, connector) -> None:
