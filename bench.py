@@ -334,9 +334,11 @@ def main() -> None:
         ),
         **extra_kw,
         # In-jit multi-step decode amortizes per-launch host/tunnel
-        # overhead; exact for greedy.
+        # overhead; exact for greedy. Deepened 4 -> 8 alongside the
+        # sequence-pipelined decode kernel: a faster device step raises
+        # the fixed per-launch share, so deeper amortization pays more.
         num_decode_steps=int(
-            os.environ.get("VLLM_TPU_BENCH_DECODE_STEPS", 4)
+            os.environ.get("VLLM_TPU_BENCH_DECODE_STEPS", 8)
         ),
     )
     # Warmup doubles as the fit check: one full dress-rehearsal pass
@@ -432,6 +434,25 @@ def main() -> None:
                 k: round(v / n * 1e3, 2) for k, v in tm.items()
             }
             extras["step_ms"]["wall"] = round(sum(times) / n * 1e3, 2)
+        # Device-side attention/matmul/sampler split of one profiled
+        # pass (same classifier as tools/profile_decode.py —
+        # vllm_tpu/metrics/op_split.py). attn_ms_per_layer divides the
+        # traced attention time over the pass's jitted-step launches and
+        # layer count: the number the per-layer roofline argues about.
+        if os.environ.get("VLLM_TPU_BENCH_OP_SPLIT", "1") != "0":
+            from vllm_tpu.metrics.op_split import profile_op_split
+
+            launches0 = getattr(runner, "step_launches", 0)
+            split = profile_op_split(
+                lambda: llm.generate(prompts, params)
+            )
+            if split is not None:
+                extras["device_ms"] = split
+                launches = getattr(runner, "step_launches", 0) - launches0
+                if launches > 0:
+                    extras["attn_ms_per_layer"] = round(
+                        split["attention"] / launches
+                        / shape["num_hidden_layers"], 4)
 
     # vs_baseline is honest only for the 8B shapes (the 2000 tok/s target
     # is defined for Llama-3-8B); the congested-chip 1B fallback reports

@@ -74,4 +74,46 @@ int32_t fill_step_inputs(
   return offset;
 }
 
+// Sampling-row gather: the packed f32 buffer's six R-vectors plus top_k,
+// seed and PRNG counter in ONE pass over the scheduled rows (previously
+// eight separate numpy fancy-gathers + a per-row Python loop for the
+// `generated` counter). `fbuf` is the 6*r_pad head of the step's f32
+// upload; `prng` is the [r_pad, 2] (seed, counter) region of the i32
+// upload. Padding rows get the neutral values (top_p = rep = 1).
+// Returns 1 when any live row carries a non-neutral penalty.
+int32_t fill_sampling_inputs(
+    const int32_t* rows, int32_t n_rows, int32_t r_pad,
+    // persistent batch sampling columns
+    const float* temperature, const float* top_p, const float* min_p,
+    const float* presence, const float* frequency, const float* repetition,
+    const int32_t* top_k, const int32_t* seeds, const int32_t* generated,
+    // outputs
+    float* fbuf, int32_t* top_k_out, int32_t* prng) {
+  float* t = fbuf;
+  float* tp = fbuf + r_pad;
+  float* mp = fbuf + 2 * (int64_t)r_pad;
+  float* pp = fbuf + 3 * (int64_t)r_pad;
+  float* fp = fbuf + 4 * (int64_t)r_pad;
+  float* rp = fbuf + 5 * (int64_t)r_pad;
+  int32_t needs_penalties = 0;
+  for (int32_t i = 0; i < n_rows; ++i) {
+    const int32_t row = rows[i];
+    t[i] = temperature[row];
+    tp[i] = top_p[row];
+    mp[i] = min_p[row];
+    pp[i] = presence[row];
+    fp[i] = frequency[row];
+    rp[i] = repetition[row];
+    top_k_out[i] = top_k[row];
+    prng[2 * i] = seeds[row];
+    prng[2 * i + 1] = generated[row];
+    if (pp[i] != 0.f || fp[i] != 0.f || rp[i] != 1.f) needs_penalties = 1;
+  }
+  for (int32_t i = n_rows; i < r_pad; ++i) {
+    tp[i] = 1.f;
+    rp[i] = 1.f;
+  }
+  return needs_penalties;
+}
+
 }  // extern "C"

@@ -1,0 +1,388 @@
+"""Decode-specialized ragged attention kernel (``ops/rpa_decode_kernel.py``)
+exact-equivalence tests against the XLA reference, in Pallas interpret mode
+on CPU, plus the dispatcher eligibility contract: decode-only batches take
+the sequence-pipelined kernel, everything else (mixed prefill+decode, LSE,
+striped context, the env escape hatch) stays on the general ragged kernel.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from vllm_tpu.ops.attention import (
+    AttentionMetadata,
+    dispatch_ragged_attention,
+    kv_cache_shape,
+    ref_ragged_paged_attention,
+    write_kv,
+)
+from vllm_tpu.ops.rpa_decode_kernel import decode_paged_attention
+
+# Small explicit blocks so interpret runs exercise multi-tile loops, the
+# cross-program DMA chain, AND partial sequence blocks.
+BLK = dict(num_seqs_per_block=2, num_kv_pages_per_block=2)
+
+
+def _decode_case(rng, kv_lens, kh, h, d, bs, num_blocks, r_pad=None,
+                 kv_dtype=jnp.float32, q_dtype=jnp.float32, num_layers=1,
+                 layer=0, extra_tokens=0):
+    """Build a decode-only batch: ONE query token per row at position
+    kv_len - 1, rows past ``len(kv_lens)`` dead padding (zero kv_len,
+    null block table). ``extra_tokens`` reserves block capacity for
+    chained multi-step tests."""
+    num_seqs = len(kv_lens)
+    r = r_pad if r_pad is not None else num_seqs
+    assert r >= num_seqs
+    q = jnp.asarray(rng.standard_normal((r, h, d)), q_dtype)
+
+    max_blocks = max(-(-(kv + extra_tokens) // bs) for kv in kv_lens) + 1
+    block_tables = np.zeros((r, max_blocks), np.int32)
+    kv_cache = jnp.asarray(
+        rng.standard_normal(
+            kv_cache_shape(num_layers, num_blocks, bs, kh, d)
+        ),
+        jnp.float32,
+    ).astype(kv_dtype)
+
+    positions = np.zeros(r, np.int32)
+    slot_mapping = np.zeros(r, np.int32)
+    seq_lens = np.zeros(r, np.int32)
+    seq_lens[:num_seqs] = kv_lens
+
+    next_block = 1
+    for i in range(num_seqs):
+        nb = -(-(kv_lens[i] + extra_tokens) // bs)
+        blocks = np.arange(next_block, next_block + nb, dtype=np.int32)
+        next_block += nb
+        block_tables[i, :nb] = blocks
+        pos = kv_lens[i] - 1
+        positions[i] = pos
+        slot_mapping[i] = blocks[pos // bs] * bs + pos % bs
+    assert next_block <= num_blocks
+
+    md = AttentionMetadata(
+        positions=jnp.asarray(positions),
+        slot_mapping=jnp.asarray(slot_mapping),
+        block_tables=jnp.asarray(block_tables),
+        seq_lens=jnp.asarray(seq_lens),
+        query_start_loc=jnp.arange(r + 1, dtype=jnp.int32),
+        token_req_idx=jnp.arange(r, dtype=jnp.int32),
+        logits_indices=jnp.arange(r, dtype=jnp.int32),
+        num_seqs=jnp.asarray([num_seqs], jnp.int32),
+        decode_only=True,
+    )
+    k_new = jnp.asarray(rng.standard_normal((r, kh, d)), jnp.float32)
+    v_new = jnp.asarray(rng.standard_normal((r, kh, d)), jnp.float32)
+    kv_cache = write_kv(
+        kv_cache, jnp.int32(layer), k_new, v_new, md.slot_mapping
+    )
+    return q, kv_cache, md
+
+
+def _run_decode_kernel(q, kv_cache, layer, md, scale, **kw):
+    kw = {**BLK, **kw}
+    return decode_paged_attention(
+        q,
+        kv_cache,
+        jnp.asarray([layer], jnp.int32),
+        md.seq_lens,
+        md.block_tables,
+        md.num_seqs,
+        sm_scale=scale,
+        interpret=True,
+        **kw,
+    )
+
+
+@pytest.mark.parametrize(
+    "kh,h", [(1, 1), (2, 4), (2, 8), (4, 4)]  # GQA ratios 1, 2, 4
+)
+@pytest.mark.parametrize("d", [64, 128])
+def test_decode_kernel_matches_reference(kh, h, d):
+    """Ragged decode batch incl. single-page short seqs and dead padding
+    rows (r_pad > num_seqs): live rows match the XLA reference."""
+    rng = np.random.default_rng(0)
+    bs = 8
+    kv_lens = [33, 1, 17, 2, 9]  # 1- and 2-token seqs: one page each
+    q, kv_cache, md = _decode_case(
+        rng, kv_lens, kh, h, d, bs, num_blocks=64, r_pad=8
+    )
+    scale = d ** -0.5
+    got = _run_decode_kernel(q, kv_cache, 0, md, scale)
+    want = ref_ragged_paged_attention(q, kv_cache, jnp.int32(0), md, scale)
+    n = len(kv_lens)
+    np.testing.assert_allclose(
+        np.asarray(got)[:n], np.asarray(want)[:n], rtol=2e-4, atol=2e-4
+    )
+
+
+def test_decode_kernel_bf16_odd_gqa():
+    """bf16 q/cache with an odd GQA ratio exercises the packed strided
+    K/V load and the fold-to-f32 path."""
+    rng = np.random.default_rng(1)
+    kh, h, d, bs = 1, 3, 128, 8
+    q, kv_cache, md = _decode_case(
+        rng, [21, 5, 12], kh, h, d, bs, num_blocks=64,
+        kv_dtype=jnp.bfloat16, q_dtype=jnp.bfloat16,
+    )
+    scale = d ** -0.5
+    got = _run_decode_kernel(q, kv_cache, 0, md, scale)
+    want = ref_ragged_paged_attention(q, kv_cache, jnp.int32(0), md, scale)
+    np.testing.assert_allclose(
+        np.asarray(got, np.float32), np.asarray(want, np.float32),
+        rtol=2e-2, atol=2e-2,
+    )
+
+
+@pytest.mark.parametrize("fp8", [jnp.float8_e4m3fn, jnp.float8_e5m2])
+def test_decode_kernel_fp8_kv_scale(fp8):
+    """fp8 KV cache with dequant scales: kernel and reference dequantize
+    identically."""
+    rng = np.random.default_rng(2)
+    kh, h, d, bs = 2, 4, 128, 8
+    q, kv_cache, md = _decode_case(
+        rng, [19, 7, 30], kh, h, d, bs, num_blocks=64, kv_dtype=fp8
+    )
+    scale = d ** -0.5
+    got = _run_decode_kernel(
+        q, kv_cache, 0, md, scale, k_scale=0.5, v_scale=2.0
+    )
+    want = ref_ragged_paged_attention(
+        q, kv_cache, jnp.int32(0), md, scale, k_scale=0.5, v_scale=2.0
+    )
+    np.testing.assert_allclose(
+        np.asarray(got)[:3], np.asarray(want)[:3], rtol=2e-2, atol=2e-2
+    )
+
+
+def test_decode_kernel_sliding_window():
+    rng = np.random.default_rng(3)
+    kh, h, d, bs = 2, 4, 128, 8
+    q, kv_cache, md = _decode_case(
+        rng, [60, 9, 41], kh, h, d, bs, num_blocks=64
+    )
+    scale = d ** -0.5
+    got = _run_decode_kernel(q, kv_cache, 0, md, scale, sliding_window=16)
+    want = ref_ragged_paged_attention(
+        q, kv_cache, jnp.int32(0), md, scale, sliding_window=16
+    )
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(want), rtol=2e-4, atol=2e-4
+    )
+
+
+def test_decode_kernel_soft_cap_and_layer_indexing():
+    rng = np.random.default_rng(4)
+    kh, h, d, bs = 2, 4, 64, 8
+    q, kv_cache, md = _decode_case(
+        rng, [11, 26], kh, h, d, bs, num_blocks=32, num_layers=3, layer=2
+    )
+    scale = d ** -0.5
+    got = _run_decode_kernel(q, kv_cache, 2, md, scale, soft_cap=30.0)
+    want = ref_ragged_paged_attention(
+        q, kv_cache, jnp.int32(2), md, scale, soft_cap=30.0
+    )
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(want), rtol=2e-4, atol=2e-4
+    )
+
+
+def test_decode_kernel_multi_step_chain():
+    """num_decode_steps > 1 shape: K successive single-position calls
+    with K/V appended between steps (what ``_single_pos_metadata``
+    produces inside the multi-step decode loop) each match the
+    reference."""
+    import dataclasses
+
+    rng = np.random.default_rng(5)
+    kh, h, d, bs = 2, 4, 128, 8
+    kv_lens = [17, 5, 40]
+    q, kv_cache, md = _decode_case(
+        rng, kv_lens, kh, h, d, bs, num_blocks=64, extra_tokens=3
+    )
+    scale = d ** -0.5
+    r = q.shape[0]
+    for step in range(3):
+        got = _run_decode_kernel(q, kv_cache, 0, md, scale)
+        want = ref_ragged_paged_attention(
+            q, kv_cache, jnp.int32(0), md, scale
+        )
+        np.testing.assert_allclose(
+            np.asarray(got), np.asarray(want), rtol=2e-4, atol=2e-4,
+            err_msg=f"step {step}",
+        )
+        # Append the next token per sequence: pos = old kv_len.
+        pos = np.asarray(md.seq_lens)
+        bt = np.asarray(md.block_tables)
+        slots = bt[np.arange(r), pos // bs] * bs + pos % bs
+        k_new = jnp.asarray(rng.standard_normal((r, kh, d)), jnp.float32)
+        v_new = jnp.asarray(rng.standard_normal((r, kh, d)), jnp.float32)
+        kv_cache = write_kv(
+            kv_cache, jnp.int32(0), k_new, v_new, jnp.asarray(slots)
+        )
+        q = jnp.asarray(rng.standard_normal((r, h, d)), jnp.float32)
+        md = dataclasses.replace(
+            md,
+            positions=jnp.asarray(pos),
+            slot_mapping=jnp.asarray(slots),
+            seq_lens=jnp.asarray(pos + 1),
+        )
+
+
+# ----------------------------------------------------------------------
+# Dispatcher eligibility (ops/attention.py)
+# ----------------------------------------------------------------------
+
+
+@pytest.fixture
+def pallas_interpret_env(monkeypatch):
+    import vllm_tpu.envs as envs
+
+    def setenv(**kw):
+        for key, val in kw.items():
+            monkeypatch.setenv(key, val)
+        envs.refresh()
+
+    setenv(VLLM_TPU_PALLAS_INTERPRET="1")
+    yield setenv
+    monkeypatch.undo()
+    envs.refresh()
+
+
+def _spy(monkeypatch, module, name, call_real=True):
+    calls = []
+    real = getattr(module, name)
+
+    def wrapper(*args, **kwargs):
+        calls.append(name)
+        if call_real:
+            return real(*args, **kwargs)
+        return jnp.zeros_like(args[0])
+
+    monkeypatch.setattr(module, name, wrapper)
+    return calls
+
+
+def _dispatch(q, kv_cache, md, scale, **kw):
+    return dispatch_ragged_attention(
+        q, kv_cache, jnp.int32(0), md, scale, allow_interpret=True, **kw
+    )
+
+
+def test_dispatch_decode_only_takes_decode_kernel(
+    monkeypatch, pallas_interpret_env
+):
+    import vllm_tpu.ops.rpa_decode_kernel as dk
+
+    rng = np.random.default_rng(6)
+    kh, h, d, bs = 2, 4, 128, 8
+    q, kv_cache, md = _decode_case(
+        rng, [9, 22], kh, h, d, bs, num_blocks=32
+    )
+    calls = _spy(monkeypatch, dk, "decode_paged_attention")
+    got = _dispatch(q, kv_cache, md, d ** -0.5)
+    assert calls, "decode-only batch did not route to the decode kernel"
+    want = ref_ragged_paged_attention(
+        q, kv_cache, jnp.int32(0), md, d ** -0.5
+    )
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(want), rtol=2e-4, atol=2e-4
+    )
+
+
+def test_dispatch_mixed_batch_takes_general_kernel(
+    monkeypatch, pallas_interpret_env
+):
+    """A mixed prefill+decode batch (decode_only unset) must stay on the
+    general ragged kernel even though some rows are decodes."""
+    import dataclasses
+
+    import vllm_tpu.ops.rpa_decode_kernel as dk
+    import vllm_tpu.ops.rpa_kernel as rk
+
+    rng = np.random.default_rng(7)
+    kh, h, d, bs = 2, 4, 128, 8
+    q, kv_cache, md = _decode_case(
+        rng, [9, 22], kh, h, d, bs, num_blocks=32
+    )
+    md = dataclasses.replace(md, decode_only=False)
+    decode_calls = _spy(monkeypatch, dk, "decode_paged_attention")
+    # Routing-only: this jax's interpret mode can't discharge the general
+    # kernel's ref-closing while_loop, so don't execute it.
+    general_calls = _spy(
+        monkeypatch, rk, "ragged_paged_attention", call_real=False
+    )
+    _dispatch(q, kv_cache, md, d ** -0.5)
+    assert general_calls and not decode_calls
+
+
+def test_dispatch_lse_takes_general_kernel(
+    monkeypatch, pallas_interpret_env
+):
+    import vllm_tpu.ops.rpa_decode_kernel as dk
+    import vllm_tpu.ops.rpa_kernel as rk
+
+    rng = np.random.default_rng(8)
+    kh, h, d, bs = 2, 4, 128, 8
+    q, kv_cache, md = _decode_case(
+        rng, [9, 22], kh, h, d, bs, num_blocks=32
+    )
+    decode_calls = _spy(monkeypatch, dk, "decode_paged_attention")
+    general_calls = _spy(
+        monkeypatch, rk, "ragged_paged_attention", call_real=False
+    )
+    _dispatch(q, kv_cache, md, d ** -0.5, return_lse=True)
+    assert general_calls and not decode_calls
+
+
+def test_dispatch_env_escape_hatch(monkeypatch, pallas_interpret_env):
+    import vllm_tpu.ops.rpa_decode_kernel as dk
+    import vllm_tpu.ops.rpa_kernel as rk
+
+    pallas_interpret_env(VLLM_TPU_DISABLE_DECODE_KERNEL="1")
+    rng = np.random.default_rng(9)
+    kh, h, d, bs = 2, 4, 128, 8
+    q, kv_cache, md = _decode_case(
+        rng, [9, 22], kh, h, d, bs, num_blocks=32
+    )
+    decode_calls = _spy(monkeypatch, dk, "decode_paged_attention")
+    general_calls = _spy(
+        monkeypatch, rk, "ragged_paged_attention", call_real=False
+    )
+    _dispatch(q, kv_cache, md, d ** -0.5)
+    assert general_calls and not decode_calls
+
+
+def test_dispatch_token_row_mismatch_takes_general_kernel(
+    monkeypatch, pallas_interpret_env
+):
+    """decode_only metadata with T != R (defensive: a caller that didn't
+    force t_pad == r_pad) must not reach the decode kernel."""
+    import vllm_tpu.ops.rpa_decode_kernel as dk
+    import vllm_tpu.ops.rpa_kernel as rk
+
+    rng = np.random.default_rng(10)
+    kh, h, d, bs = 2, 4, 128, 8
+    q, kv_cache, md = _decode_case(
+        rng, [9, 22], kh, h, d, bs, num_blocks=32
+    )
+    q_wide = jnp.concatenate([q, q], axis=0)  # T = 2R
+    import dataclasses
+
+    md = dataclasses.replace(
+        md,
+        query_start_loc=jnp.concatenate(
+            [md.query_start_loc, md.query_start_loc[-1:].repeat(2)]
+        ),
+    )
+    decode_calls = _spy(monkeypatch, dk, "decode_paged_attention")
+    # The widened batch is deliberately inconsistent for the general
+    # kernel too (block tables stay [R, P]); only routing is under test.
+    general_calls = _spy(
+        monkeypatch, rk, "ragged_paged_attention", call_real=False
+    )
+    _dispatch(q_wide, kv_cache, md, d ** -0.5)
+    assert general_calls and not decode_calls

@@ -66,6 +66,14 @@ REQUIRED_ROUTER_METRICS = {
     "vllm:api_server_count",
 }
 
+# Documented in the README ("Decode performance"); bench dashboards
+# track decode-batch purity and multi-step amortization by these names.
+REQUIRED_DECODE_METRICS = {
+    "vllm:decode_batch_ratio",
+    "vllm:sampled_tokens_per_launch",
+    "vllm:prep_fallback_rows_total",
+}
+
 # Documented in the README ("Multi-host fault tolerance"); the mesh
 # shrink/rejoin acceptance tests assert on these names.
 REQUIRED_MESH_METRICS = {
@@ -144,6 +152,10 @@ def check() -> list[str]:
     for name in sorted(REQUIRED_MESH_METRICS - set(seen)):
         errors.append(
             f"required mesh metric {name} is missing from "
+            f"the registry (documented in README)")
+    for name in sorted(REQUIRED_DECODE_METRICS - set(seen)):
+        errors.append(
+            f"required decode metric {name} is missing from "
             f"the registry (documented in README)")
 
     return errors

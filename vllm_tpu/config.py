@@ -221,6 +221,13 @@ class SchedulerConfig:
     # greedy and seeded sampling; steps carrying prefill, spec, pooling,
     # grammar, logprobs, or logits processors fall back to 1.
     num_decode_steps: int = 1
+    # Decode-specialized attention: batches where every row is a pure
+    # decode (one query token) dispatch to the sequence-pipelined kernel
+    # (ops/rpa_decode_kernel.py) instead of the general ragged kernel.
+    # Off routes everything to the general kernel; the
+    # VLLM_TPU_DISABLE_DECODE_KERNEL env is the no-restart escape hatch
+    # for the same switch.
+    enable_decode_attention: bool = True
     # Slots allocated beyond the scheduled tokens (EAGLE writes draft KV at
     # speculative positions); set at EngineConfig.finalize.
     num_lookahead_tokens: int = 0

@@ -173,6 +173,16 @@ class SchedulerStats:
     # step-watchdog trips, attached by EngineCore from the runner.
     numeric_guard_trips: dict[str, int] = field(default_factory=dict)
     step_watchdog_trips: int = 0
+    # Decode-path observability (cumulative, attached by EngineCore from
+    # the runner): jitted-step launches, launches whose batch was
+    # decode-only (one token per row — sequence-pipelined kernel shape),
+    # tokens sampled across launches (tokens/launch = multi-step
+    # amortization), and step-input rows assembled by the Python loop
+    # instead of the native fill.
+    step_launches: int = 0
+    decode_only_launches: int = 0
+    launch_sampled_tokens: int = 0
+    prep_fallback_rows: int = 0
     # Engine-step phase durations (drained each snapshot, seconds) —
     # attached by EngineCore from the schedule/dispatch/finalize sites;
     # feed the vllm:engine_step_duration_seconds histogram family.

@@ -59,6 +59,7 @@ class EngineArgs:
     num_decode_steps: int = 1
     encoder_cache_budget: int = 4096
     enable_cascade_attention: bool = False
+    enable_decode_attention: bool = True
 
     tensor_parallel_size: int = 1
     data_parallel_size: int = 1
@@ -183,6 +184,7 @@ class EngineArgs:
                 num_decode_steps=self.num_decode_steps,
                 encoder_cache_budget=self.encoder_cache_budget,
                 enable_cascade_attention=self.enable_cascade_attention,
+                enable_decode_attention=self.enable_decode_attention,
             ),
             device_config=DeviceConfig(device=self.device),  # type: ignore[arg-type]
             speculative_config=SpeculativeConfig(

@@ -359,6 +359,16 @@ class EngineCore:
         if runner is not None:
             stats.bucket_compiles = getattr(runner, "bucket_compiles", 0)
             stats.bucket_hits = getattr(runner, "bucket_hits", 0)
+            stats.step_launches = getattr(runner, "step_launches", 0)
+            stats.decode_only_launches = getattr(
+                runner, "decode_only_launches", 0
+            )
+            stats.launch_sampled_tokens = getattr(
+                runner, "launch_sampled_tokens", 0
+            )
+            stats.prep_fallback_rows = getattr(
+                runner, "prep_fallback_rows", 0
+            )
             stats.numeric_guard_trips = dict(
                 getattr(runner, "numeric_guard_trips", {})
             )

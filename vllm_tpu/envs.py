@@ -60,6 +60,21 @@ _readers: dict[str, Callable[[], Any]] = {
     # (tests), "0" restores weight-only dequant everywhere.
     # Reference analog: csrc/quantization/w8a8/ scaled_mm semantics.
     "VLLM_TPU_W8A8": _str("VLLM_TPU_W8A8", "auto"),
+    # Escape hatch for the decode-specialized ragged attention kernel
+    # (ops/rpa_decode_kernel.py): decode-only batches fall back to the
+    # general ragged kernel when set. A/B this before filing kernel bugs.
+    "VLLM_TPU_DISABLE_DECODE_KERNEL": _bool(
+        "VLLM_TPU_DISABLE_DECODE_KERNEL", False
+    ),
+    # Decode-kernel block-shape overrides (0 = tuned defaults): sequences
+    # per grid program and KV pages per sequence per tile. Sweep with
+    # tools/probe_decode_attn.py before changing the defaults.
+    "VLLM_TPU_DECODE_SEQS_PER_BLOCK": _int(
+        "VLLM_TPU_DECODE_SEQS_PER_BLOCK", 0
+    ),
+    "VLLM_TPU_DECODE_KV_PAGES_PER_BLOCK": _int(
+        "VLLM_TPU_DECODE_KV_PAGES_PER_BLOCK", 0
+    ),
     "VLLM_TPU_COMPILE_CACHE_DIR": _str("VLLM_TPU_COMPILE_CACHE_DIR", None),
     # LRU size bound for the persistent compilation cache directory.
     "VLLM_TPU_COMPILE_CACHE_MAX_GB": _int("VLLM_TPU_COMPILE_CACHE_MAX_GB", 32),

@@ -1,0 +1,229 @@
+"""Decode-step device-time attribution from an xplane trace.
+
+One classifier, two consumers: ``tools/profile_decode.py`` (interactive
+top-op listing) and ``bench.py`` (attention/matmul/sampler split in the
+scored JSON). Keeping the name->phase mapping here means the bench JSON
+and the profiler agree on what counts as "attention".
+
+Classification is a substring heuristic over XLA/Mosaic op names — the
+TPU xplane names leaf ops after the HLO instruction (fusions keep their
+root's name), and Pallas kernels surface as custom calls carrying the
+kernel function's name.
+
+Trace parsing prefers ``jax.profiler.ProfileData`` (newer jax); older
+jax ships no xplane reader, so a minimal protobuf wire-format parser for
+the (long-stable) XSpace schema serves as the fallback — no extra
+dependency either way.
+"""
+
+from __future__ import annotations
+
+import collections
+import glob
+import os
+
+PHASES = ("attention", "matmul", "sampler", "other")
+
+# Ordered: first hit wins. Attention before matmul — the attention
+# kernels contain dots but their time belongs to the attention budget.
+_ATTENTION_MARKS = (
+    "ragged_paged_attention",
+    "decode_kernel",
+    "decode_paged_attention",
+    "mla_kernel",
+    "flash_attention",
+    "paged_attn",
+    "tpu_custom_call",  # Pallas kernels in the decode step are attention
+)
+_MATMUL_MARKS = ("dot", "matmul", "einsum", "convolution", "gemm")
+_SAMPLER_MARKS = (
+    "sort", "top-k", "top_k", "topk", "rng", "random", "threefry",
+    "sample", "argmax", "gumbel", "categorical", "cumsum",
+)
+
+
+def classify_op(name: str) -> str:
+    """Phase bucket ("attention" | "matmul" | "sampler" | "other") for a
+    device op name."""
+    low = name.lower()
+    for mark in _ATTENTION_MARKS:
+        if mark in low:
+            return "attention"
+    for mark in _MATMUL_MARKS:
+        if mark in low:
+            return "matmul"
+    for mark in _SAMPLER_MARKS:
+        if mark in low:
+            return "sampler"
+    return "other"
+
+
+# ---------------------------------------------------------------------------
+# Minimal xplane (XSpace) reader.
+#
+# Wire schema (tsl/profiler/protobuf/xplane.proto, unchanged for years):
+#   XSpace.planes = 1 (msg)
+#   XPlane.name = 2 (str), .lines = 3 (msg),
+#     .event_metadata = 4 (map<int64, XEventMetadata>)
+#   XLine.name = 2 (str), .events = 4 (msg)
+#   XEvent.metadata_id = 1, .duration_ps = 3
+#   XEventMetadata.id = 1, .name = 2
+# ---------------------------------------------------------------------------
+
+
+def _varint(buf: bytes, i: int) -> tuple[int, int]:
+    val = shift = 0
+    while True:
+        b = buf[i]
+        i += 1
+        val |= (b & 0x7F) << shift
+        if not b & 0x80:
+            return val, i
+        shift += 7
+
+
+def _fields(buf: bytes):
+    """Yield ``(field_number, wire_type, value)`` over a message body."""
+    i, n = 0, len(buf)
+    while i < n:
+        tag, i = _varint(buf, i)
+        field, wt = tag >> 3, tag & 7
+        if wt == 0:
+            val, i = _varint(buf, i)
+        elif wt == 1:
+            val, i = buf[i:i + 8], i + 8
+        elif wt == 2:
+            ln, i = _varint(buf, i)
+            val, i = buf[i:i + ln], i + ln
+        elif wt == 5:
+            val, i = buf[i:i + 4], i + 4
+        else:
+            raise ValueError(f"unsupported wire type {wt}")
+        yield field, wt, val
+
+
+def _parse_event(buf: bytes) -> tuple[int, int]:
+    meta_id = dur_ps = 0
+    for field, _, val in _fields(buf):
+        if field == 1:
+            meta_id = val
+        elif field == 3:
+            dur_ps = val
+    return meta_id, dur_ps
+
+
+def _parse_line(buf: bytes) -> tuple[str, list[tuple[int, int]]]:
+    name, events = "", []
+    for field, _, val in _fields(buf):
+        if field == 2:
+            name = val.decode("utf-8", "replace")
+        elif field == 4:
+            events.append(_parse_event(val))
+    return name, events
+
+
+def _parse_plane(buf: bytes) -> tuple[str, list, dict[int, str]]:
+    name, lines, metadata = "", [], {}
+    for field, _, val in _fields(buf):
+        if field == 2:
+            name = val.decode("utf-8", "replace")
+        elif field == 3:
+            lines.append(_parse_line(val))
+        elif field == 4:  # map entry {key=1: int64, value=2: XEventMetadata}
+            key, meta_name = 0, ""
+            for mf, _, mv in _fields(val):
+                if mf == 1:
+                    key = mv
+                elif mf == 2:
+                    for ef, _, ev in _fields(mv):
+                        if ef == 1:
+                            key = key or ev
+                        elif ef == 2:
+                            meta_name = ev.decode("utf-8", "replace")
+            metadata[key] = meta_name
+    return name, lines, metadata
+
+
+def parse_trace(trace_dir: str) -> list[tuple[str, list]]:
+    """``[(plane_name, [(line_name, [(op_name, duration_ns), ...])])]``
+    for every xplane file under ``trace_dir``."""
+    paths = glob.glob(
+        os.path.join(trace_dir, "**", "*.xplane.pb"), recursive=True
+    )
+    planes: list[tuple[str, list]] = []
+    try:
+        from jax.profiler import ProfileData  # jax >= 0.5
+    except ImportError:
+        ProfileData = None
+    for path in paths:
+        if ProfileData is not None:
+            data = ProfileData.from_file(path)
+            for plane in data.planes:
+                lines = [
+                    (line.name,
+                     [(ev.name, ev.duration_ns) for ev in line.events])
+                    for line in plane.lines
+                ]
+                planes.append((plane.name, lines))
+            continue
+        with open(path, "rb") as f:
+            buf = f.read()
+        for field, _, val in _fields(buf):
+            if field != 1:  # XSpace.planes
+                continue
+            name, raw_lines, metadata = _parse_plane(val)
+            lines = [
+                (line_name,
+                 [(metadata.get(mid, f"op.{mid}"), dur_ps / 1e3)
+                  for mid, dur_ps in events])
+                for line_name, events in raw_lines
+            ]
+            planes.append((name, lines))
+    return planes
+
+
+def iter_xla_ops(trace_dir: str):
+    """Yield ``(op_name, duration_ns)`` for every leaf device op (the
+    "XLA Ops" lines) in every xplane under ``trace_dir`` — empty when the
+    backend emitted none (CPU traces carry no such line)."""
+    for _, lines in parse_trace(trace_dir):
+        for line_name, events in lines:
+            if "XLA Ops" not in line_name:
+                continue
+            yield from events
+
+
+def op_split_ms(trace_dir: str) -> dict[str, float] | None:
+    """Aggregate a trace into ``{phase: ms}`` (+ ``total``), or None when
+    the trace has no device ops (CPU backend)."""
+    totals: dict[str, float] = collections.defaultdict(float)
+    found = False
+    for name, ns in iter_xla_ops(trace_dir):
+        found = True
+        totals[classify_op(name)] += ns
+    if not found:
+        return None
+    split = {phase: round(totals.get(phase, 0.0) / 1e6, 2)
+             for phase in PHASES}
+    split["total"] = round(sum(totals.values()) / 1e6, 2)
+    return split
+
+
+def profile_op_split(fn) -> dict[str, float] | None:
+    """Run ``fn()`` under ``jax.profiler`` and return its device-op
+    split (None on backends that emit no device ops)."""
+    import shutil
+    import tempfile
+
+    import jax
+
+    trace_dir = tempfile.mkdtemp(prefix="op_split_")
+    try:
+        jax.profiler.start_trace(trace_dir)
+        try:
+            fn()
+        finally:
+            jax.profiler.stop_trace()
+        return op_split_ms(trace_dir)
+    finally:
+        shutil.rmtree(trace_dir, ignore_errors=True)

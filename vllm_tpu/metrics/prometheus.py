@@ -210,6 +210,23 @@ class PrometheusRegistry:
         self.pipeline_stall = Counter(
             "vllm:pipeline_stall_seconds",
             "Seconds the async lag-N pipeline blocked on device results")
+        # Decode-path efficiency (runner cumulative counters -> derived
+        # gauges): what fraction of jitted-step launches took the
+        # decode-only shape (sequence-pipelined attention kernel), and
+        # how many sampled tokens each launch amortizes (multi-step
+        # decode: K tokens per launch; 1.0 = no amortization).
+        self.decode_batch_ratio = Gauge(
+            "vllm:decode_batch_ratio",
+            "Fraction of jitted-step launches that were decode-only "
+            "(cumulative since engine start)")
+        self.tokens_per_launch = Gauge(
+            "vllm:sampled_tokens_per_launch",
+            "Sampled tokens per jitted-step launch (cumulative average; "
+            "in-jit multi-step decode amortization)")
+        self.prep_fallback_rows = Counter(
+            "vllm:prep_fallback_rows_total",
+            "Step-input rows assembled by the Python fallback instead of "
+            "the native host-prep fill")
         self.request_success = LabeledCounter(
             "vllm:request_success_total",
             "Finished requests by reason", "finished_reason")
@@ -357,6 +374,8 @@ class PrometheusRegistry:
             self.ttft, self.tpot, self.e2e,
             self.queue_time, self.accept_length,
             self.bucket_compiles, self.bucket_hits, self.pipeline_stall,
+            self.decode_batch_ratio, self.tokens_per_launch,
+            self.prep_fallback_rows,
             self.request_success,
             self.step_duration, self.batch_tokens, self.batch_requests,
             self.batch_occupancy, self.step_interval,
@@ -382,6 +401,7 @@ class PrometheusRegistry:
         self._last_spec = (0, 0)
         self._last_buckets = (0, 0)
         self._last_stall = 0.0
+        self._last_prep_fallback = 0
 
     # StatLoggerBase interface -----------------------------------------
 
@@ -416,6 +436,14 @@ class PrometheusRegistry:
                 max(0.0, s.pipeline_stall_s - self._last_stall)
             )
             self._last_stall = s.pipeline_stall_s
+            if s.step_launches > 0:
+                self.decode_batch_ratio.set(
+                    s.decode_only_launches / s.step_launches)
+                self.tokens_per_launch.set(
+                    s.launch_sampled_tokens / s.step_launches)
+            self.prep_fallback_rows.inc(
+                max(0, s.prep_fallback_rows - self._last_prep_fallback))
+            self._last_prep_fallback = s.prep_fallback_rows
             for t in s.step_schedule_times:
                 self.step_duration.observe("schedule", t)
             for t in s.step_dispatch_times:

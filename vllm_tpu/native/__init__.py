@@ -64,6 +64,7 @@ def get_host_prep():
         return None
     i32p = ctypes.POINTER(ctypes.c_int32)
     u8p = ctypes.POINTER(ctypes.c_uint8)
+    f32p = ctypes.POINTER(ctypes.c_float)
     lib.fill_step_inputs.restype = ctypes.c_int32
     lib.fill_step_inputs.argtypes = [
         i32p, ctypes.c_int64,  # batch tokens + stride
@@ -73,6 +74,13 @@ def get_host_prep():
         ctypes.c_int32, ctypes.c_int32, ctypes.c_int32,
         i32p, i32p, i32p, i32p, i32p, i32p, i32p, u8p, i32p,
         i32p, i32p,            # lora out (nullable), batch lora slots
+    ]
+    lib.fill_sampling_inputs.restype = ctypes.c_int32
+    lib.fill_sampling_inputs.argtypes = [
+        i32p, ctypes.c_int32, ctypes.c_int32,  # rows, n_rows, r_pad
+        f32p, f32p, f32p, f32p, f32p, f32p,    # six sampling columns
+        i32p, i32p, i32p,                      # top_k, seeds, generated
+        f32p, i32p, i32p,                      # fbuf, top_k out, prng out
     ]
     _LIB = lib
     return _LIB
@@ -92,3 +100,18 @@ def ptr_u8(arr):
 
     assert arr.dtype == np.uint8 and arr.flags.c_contiguous
     return arr.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8))
+
+
+def ptr_f32(arr):
+    import numpy as np
+
+    assert arr.dtype == np.float32 and arr.flags.c_contiguous
+    return arr.ctypes.data_as(ctypes.POINTER(ctypes.c_float))
+
+
+def ptr_i32_cast(arr):
+    """i32 pointer to a same-width buffer (u32 seeds, u32 PRNG views)."""
+    import numpy as np
+
+    assert arr.dtype.itemsize == 4 and arr.flags.c_contiguous
+    return arr.ctypes.data_as(ctypes.POINTER(ctypes.c_int32))

@@ -61,6 +61,13 @@ class AttentionMetadata:
     num_common_prefix_blocks: int = field(
         default=0, metadata=dict(static=True)
     )
+    # True when EVERY live row of this step is a single-position decode
+    # (one scheduled token per request, token i belongs to row i, so
+    # T == R). Unlocks the decode-specialized sequence-pipelined kernel
+    # (``ops/rpa_decode_kernel.py``). STATIC: dispatch happens inside
+    # jit, and the runner forces ``t_pad == r_pad`` when setting it, so
+    # the extra trace count is bounded by the request buckets.
+    decode_only: bool = field(default=False, metadata=dict(static=True))
     # Hybrid attention+SSM models (Jamba/Bamba-class): per-request state
     # slot for the constant-size Mamba caches ([R] i32; None for pure
     # attention models). Reference: HybridKVCacheCoordinator per-type
@@ -202,6 +209,56 @@ def dispatch_ragged_attention(
     interpret = allow_interpret and bool(envs.VLLM_TPU_PALLAS_INTERPRET)
     kernel_ok = q.shape[-1] in (64, 128, 256)
     on_tpu = _on_tpu()
+    # Decode-only fast path: every live row is a single-position decode
+    # (T == R, token i == row i), so the sequence-pipelined kernel can
+    # batch KV DMAs across sequences instead of walking them serially.
+    # Striped-context (CP) and LSE callers stay on the general kernel.
+    decode_ok = (
+        md.decode_only
+        and not return_lse
+        and isinstance(ctx_stride, int)
+        and ctx_stride == 1
+        and isinstance(ctx_phase, int)
+        and ctx_phase == 0
+        and q.shape[0] == md.seq_lens.shape[0]
+        and not envs.VLLM_TPU_DISABLE_DECODE_KERNEL
+    )
+    if (
+        decode_ok
+        and not envs.VLLM_TPU_DISABLE_PALLAS
+        and kernel_ok
+        and (on_tpu or interpret)
+    ):
+        from vllm_tpu.ops.rpa_decode_kernel import decode_paged_attention
+
+        run_interpret = interpret and not on_tpu
+        if run_interpret:
+            blk_kw = dict(num_seqs_per_block=2, num_kv_pages_per_block=2)
+        else:
+            blk_kw = {}
+            if envs.VLLM_TPU_DECODE_SEQS_PER_BLOCK > 0:
+                blk_kw["num_seqs_per_block"] = (
+                    envs.VLLM_TPU_DECODE_SEQS_PER_BLOCK
+                )
+            if envs.VLLM_TPU_DECODE_KV_PAGES_PER_BLOCK > 0:
+                blk_kw["num_kv_pages_per_block"] = (
+                    envs.VLLM_TPU_DECODE_KV_PAGES_PER_BLOCK
+                )
+        return decode_paged_attention(
+            q,
+            kv_cache,
+            jnp.asarray(layer, jnp.int32).reshape(1),
+            md.seq_lens,
+            md.block_tables,
+            md.num_seqs,
+            sm_scale=scale,
+            sliding_window=sliding_window,
+            soft_cap=soft_cap,
+            k_scale=k_scale,
+            v_scale=v_scale,
+            interpret=run_interpret,
+            **blk_kw,
+        )
     if (
         not envs.VLLM_TPU_DISABLE_PALLAS
         and kernel_ok

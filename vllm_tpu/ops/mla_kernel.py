@@ -38,6 +38,8 @@ from jax import lax
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from vllm_tpu.ops.rpa_kernel import store_with_mask
+
 DEFAULT_MASK_VALUE = -0.7 * float(jnp.finfo(jnp.dtype("float32")).max)
 
 
@@ -158,8 +160,8 @@ def _mla_kernel(
 
             def masked_store(ref, val, start, end, group=1):
                 iota = lax.broadcasted_iota(jnp.int32, ref.shape, 0) // group
-                pltpu.store(
-                    ref, val, mask=jnp.logical_and(iota >= start, iota < end)
+                store_with_mask(
+                    ref, val, jnp.logical_and(iota >= start, iota < end)
                 )
 
             def load_with_init(ref, init_val):

@@ -37,15 +37,94 @@ from jax import lax
 from jax._src import dtypes
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
-from jax.experimental.pallas.ops.tpu.ragged_paged_attention.tuned_block_sizes import (
-    get_tuned_block_sizes,
-)
+try:
+    from jax.experimental.pallas.ops.tpu.ragged_paged_attention.tuned_block_sizes import (  # noqa: E501
+        get_tuned_block_sizes,
+    )
+except ImportError:
+    # Older jax wheels don't bundle the ragged-paged-attention tuning
+    # tables; fall back to one serviceable block shape so the module
+    # stays importable (CPU interpret tests, older TPU images). Callers
+    # that care about peak performance pass explicit block sizes or env
+    # overrides.
+    def get_tuned_block_sizes(
+        q_dtype, kv_dtype, num_q_heads_per_blk, num_kv_heads_per_blk,
+        head_dim, page_size, max_num_tokens, pages_per_seq,
+    ):
+        del q_dtype, kv_dtype, num_q_heads_per_blk, num_kv_heads_per_blk
+        del head_dim, pages_per_seq
+        num_kv_pages_per_blk = max(1, 128 // page_size)
+        num_queries_per_blk = max(8, min(32, max_num_tokens))
+        return num_kv_pages_per_blk, num_queries_per_blk
 
 DEFAULT_MASK_VALUE = -0.7 * float(jnp.finfo(jnp.dtype("float32")).max)
 
+# jax renamed TPUCompilerParams -> CompilerParams; support both.
+CompilerParams = getattr(
+    pltpu, "CompilerParams", getattr(pltpu, "TPUCompilerParams", None)
+)
+
+
+def store_with_mask(ref, val, mask):
+    """Whole-ref masked store; older jax lacks ``pltpu.store`` (and its
+    interpret mode can't discharge ``pl.store(mask=)``), so fall back to
+    a read-modify-write select, which Mosaic fuses anyway."""
+    if hasattr(pltpu, "store"):
+        pltpu.store(ref, val, mask=mask)
+    else:
+        ref[...] = jnp.where(mask, val, ref[...])
+
 
 def _dtype_packing(dtype) -> int:
-    return 32 // dtypes.itemsize_bits(dtype)
+    # dtypes.itemsize_bits is absent on older jax; byte-sized dtypes
+    # (every KV cache dtype we support) make itemsize*8 equivalent.
+    if hasattr(dtypes, "itemsize_bits"):
+        return 32 // dtypes.itemsize_bits(dtype)
+    return 32 // (jnp.dtype(dtype).itemsize * 8)
+
+
+def strided_load_kv(ref, start, step):
+    """Split interleaved K/V rows; handles sub-32-bit packed dtypes.
+
+    ``ref`` is a flat ``[N_rows, lanes]`` VMEM view whose rows interleave
+    K/V heads with period ``step``; returns the K rows starting at
+    ``start`` and the V rows starting at ``start + 1`` (lists, because a
+    packed dtype yields several heads per 32-bit load). Shared by the
+    general ragged kernel and the decode-specialized kernel
+    (``rpa_decode_kernel.py``)."""
+    packing = _dtype_packing(ref.dtype)
+    if packing == 1:
+        return [ref[start::step, :]], [ref[start + 1 :: step, :]]
+    assert packing in (2, 4, 8)
+    assert step % packing == 0
+    k_list, v_list = [], []
+    b_ref = ref.bitcast(jnp.uint32)
+    b = b_ref[start // packing :: step // packing, :]
+    if ref.dtype == jnp.bfloat16:
+        bk = b << 16
+        bv = b & jnp.uint32(0xFFFF0000)
+        k_list.append(pltpu.bitcast(bk, jnp.float32).astype(jnp.bfloat16))
+        v_list.append(pltpu.bitcast(bv, jnp.float32).astype(jnp.bfloat16))
+    else:
+        bitwidth = 32 // packing
+        dst = jnp.dtype(f"uint{bitwidth}")
+        for i in range(0, packing, 2):
+            bk = b >> (i * bitwidth)
+            k_list.append(pltpu.bitcast(bk.astype(dst), ref.dtype))
+            bv = b >> ((i + 1) * bitwidth)
+            v_list.append(pltpu.bitcast(bv.astype(dst), ref.dtype))
+    return k_list, v_list
+
+
+def fold_on_2nd_minor(vec):
+    """Fold leading axes into rows; casts to f32 when the second-minor
+    axis is not divisible by the dtype packing (Mosaic reshape rule)."""
+    assert vec.dtype in (jnp.bfloat16, jnp.float32)
+    assert len(vec.shape) >= 2
+    packing = _dtype_packing(vec.dtype)
+    if vec.shape[-2] % packing != 0:
+        vec = vec.astype(jnp.float32)
+    return vec.reshape(-1, vec.shape[-1])
 
 
 class _PageCopy:
@@ -191,39 +270,6 @@ def _rpa_kernel(
             end_page,
         )
 
-    def strided_load_kv(ref, start, step):
-        """Split interleaved K/V rows; handles sub-32-bit packed dtypes."""
-        packing = _dtype_packing(ref.dtype)
-        if packing == 1:
-            return [ref[start::step, :]], [ref[start + 1 :: step, :]]
-        assert packing in (2, 4, 8)
-        assert step % packing == 0
-        k_list, v_list = [], []
-        b_ref = ref.bitcast(jnp.uint32)
-        b = b_ref[start // packing :: step // packing, :]
-        if ref.dtype == jnp.bfloat16:
-            bk = b << 16
-            bv = b & jnp.uint32(0xFFFF0000)
-            k_list.append(pltpu.bitcast(bk, jnp.float32).astype(jnp.bfloat16))
-            v_list.append(pltpu.bitcast(bv, jnp.float32).astype(jnp.bfloat16))
-        else:
-            bitwidth = 32 // packing
-            dst = jnp.dtype(f"uint{bitwidth}")
-            for i in range(0, packing, 2):
-                bk = b >> (i * bitwidth)
-                k_list.append(pltpu.bitcast(bk.astype(dst), ref.dtype))
-                bv = b >> ((i + 1) * bitwidth)
-                v_list.append(pltpu.bitcast(bv.astype(dst), ref.dtype))
-        return k_list, v_list
-
-    def fold_on_2nd_minor(vec):
-        assert vec.dtype in (jnp.bfloat16, jnp.float32)
-        assert len(vec.shape) >= 2
-        packing = _dtype_packing(vec.dtype)
-        if vec.shape[-2] % packing != 0:
-            vec = vec.astype(jnp.float32)
-        return vec.reshape(-1, vec.shape[-1])
-
     @pl.when(heads_blk_idx + q_blk_idx == 0)
     def prefetch_first_kv_blk():
         make_page_copy(
@@ -280,8 +326,8 @@ def _rpa_kernel(
 
             def masked_store(ref, val, start, end, group=1):
                 iota = lax.broadcasted_iota(jnp.int32, ref.shape, 0) // group
-                pltpu.store(
-                    ref, val, mask=jnp.logical_and(iota >= start, iota < end)
+                store_with_mask(
+                    ref, val, jnp.logical_and(iota >= start, iota < end)
                 )
 
             def load_with_init(ref, init_val):
@@ -697,7 +743,7 @@ def ragged_paged_attention(
             grid=grid,
             scratch_shapes=scratch_shapes,
         ),
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=CompilerParams(
             dimension_semantics=("arbitrary", "arbitrary"),
             vmem_limit_bytes=vmem_limit_bytes,
         ),

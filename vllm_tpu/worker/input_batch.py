@@ -94,6 +94,9 @@ class InputBatch:
         self.seeds = np.zeros(n, dtype=np.uint32)
         self.num_logprobs = np.zeros(n, dtype=np.int32)  # 0 => off
         self.lora_slot = np.zeros(n, dtype=np.int32)  # 0 => no adapter
+        # Dense mirror of CachedRequestState.generated (seeded PRNG
+        # counter) so step assembly gathers it without a Python row loop.
+        self.generated = np.zeros(n, dtype=np.int32)
 
     # ------------------------------------------------------------------
 
@@ -133,6 +136,7 @@ class InputBatch:
         seed = p.seed if p.seed is not None else (0xC0FFEE ^ hash(req_id))
         self.seeds[row] = np.uint32(seed & 0xFFFFFFFF)
         self.num_logprobs[row] = p.logprobs or 0
+        self.generated[row] = 0
         return row
 
     def remove_request(self, req_id: str) -> None:
@@ -164,6 +168,7 @@ class InputBatch:
                 self.seeds,
                 self.num_logprobs,
                 self.lora_slot,
+                self.generated,
             ):
                 vec[row] = vec[last]
             self.req_ids[row] = moved_id
@@ -209,6 +214,7 @@ class InputBatch:
         self.num_tokens[row] = n + 1
         state.num_tokens = int(n) + 1
         state.generated += 1
+        self.generated[row] = state.generated
 
     def row_of(self, req_id: str) -> int:
         return self.req_states[req_id].in_batch_row

@@ -301,6 +301,7 @@ class ModelRunner:
                 "num_decode_steps",
                 "cascade_blocks",
                 "has_state_slots",
+                "decode_only",
             ),
             donate_argnums=(1, 2) if self.draft_model is not None else (1,),
         )
@@ -373,6 +374,17 @@ class ModelRunner:
         self._seen_buckets: set[tuple] = set()
         self.bucket_compiles = 0
         self.bucket_hits = 0
+        # Rows assembled by the Python loop instead of the native fill
+        # (native unavailable/disabled, or draft-row patch-up on spec
+        # batches). Exported via SchedulerStats -> prometheus.
+        self.prep_fallback_rows = 0
+        # Decode-path observability: jitted-step launches, launches whose
+        # batch was decode-only (eligible for the sequence-pipelined
+        # kernel), and rows*steps sampled — tokens/launch measures the
+        # multi-step amortization. Exported via SchedulerStats.
+        self.step_launches = 0
+        self.decode_only_launches = 0
+        self.launch_sampled_tokens = 0
         self.timing = {"prep_s": 0.0, "dispatch_s": 0.0, "wait_s": 0.0,
                        "steps": 0}
 
@@ -382,7 +394,7 @@ class ModelRunner:
 
     def _unpack(self, ibuf, fbuf, counts, prompt_mask, t, r, b, num_spec=0,
                 num_adj=0, num_allow=0, num_prompt_logprobs=0,
-                cascade_blocks=0, has_state_slots=0):
+                cascade_blocks=0, has_state_slots=0, decode_only=False):
         """Split the two packed host buffers back into metadata pytrees.
 
         One contiguous i32 upload + one f32 upload per step instead of ~12
@@ -410,6 +422,7 @@ class ModelRunner:
             num_seqs=take(1),
             block_tables=take(r * b).reshape(r, b),
             num_common_prefix_blocks=cascade_blocks,
+            decode_only=bool(decode_only),
         )
         top_k = take(r)
         prng_keys = jax.lax.bitcast_convert_type(
@@ -612,12 +625,13 @@ class ModelRunner:
         num_decode_steps: int = 1,
         cascade_blocks: int = 0,
         has_state_slots: int = 0,
+        decode_only: bool = False,
     ):
         (token_ids, md, sampling, feedback, grammar_rows, logit_adjust,
          draft_next, token_lora, plp_next, spec) = self._unpack(
             ibuf, fbuf, counts, prompt_mask, t_pad, r_pad, b_pad, num_spec,
             num_adj, num_allow, num_prompt_logprobs, cascade_blocks,
-            has_state_slots,
+            has_state_slots, decode_only,
         )
         # Device-side token feedback (async scheduling): a decode row whose
         # input token was sampled by the still-in-flight previous step reads
@@ -1142,6 +1156,7 @@ class ModelRunner:
         # Restore the prompt/output split: seeded PRNG streams, penalties
         # and min-tokens all key off `generated`.
         state.generated = len(tokens) - len(req.prompt_token_ids)
+        self.input_batch.generated[row] = state.generated
         if self._is_hybrid:
             self._take_state_slot(req_id)
         if self.lora_manager is not None:
@@ -1233,8 +1248,21 @@ class ModelRunner:
         r_live = len(rows)
         t_live = so.total_num_scheduled_tokens
 
+        # Decode-only batches (the steady-state throughput shape): one
+        # scheduled token per row, no draft verification. Forcing the
+        # token bucket to the row bucket gives the step the T == R
+        # layout (token i IS row i, padding included) the
+        # sequence-pipelined decode kernel requires.
+        decode_only = (
+            self.config.scheduler_config.enable_decode_attention
+            and bool(r_live)
+            and not so.scheduled_spec_decode_tokens
+            and t_live == r_live
+        )
         t_pad = _bucket(max(t_live, 1), self.token_buckets)
         r_pad = _bucket(max(r_live, 1), self.request_buckets)
+        if decode_only:
+            t_pad = r_pad
         max_blocks = max(
             (int(batch.num_blocks[row]) for row in rows), default=1
         )
@@ -1352,8 +1380,10 @@ class ModelRunner:
         feedback = ibuf[o : o + r]; o += r
         feedback[:] = -1
         grammar_rows = ibuf[o : o + r]; o += r
-        for i, rid in enumerate(req_order):
-            grammar_rows[i] = so.structured_output_request_ids.get(rid, 0)
+        sor = so.structured_output_request_ids
+        if sor:  # skip the row loop entirely on unconstrained batches
+            for i, rid in enumerate(req_order):
+                grammar_rows[i] = sor.get(rid, 0)
         v_pad = self.model.vocab_size  # out-of-range id -> scatter drop
         if num_adj:
             adj_ids = ibuf[o : o + r * num_adj].reshape(r, num_adj); o += r * num_adj
@@ -1398,7 +1428,12 @@ class ModelRunner:
         bs = self.block_size
         offset = 0
         pending_rows: list[int] = []
-        use_native = self._native_prep is not None and not s
+        # The native fill runs on EVERY batch shape (the old `and not s`
+        # guard sent whole spec-decode batches down the Python loop);
+        # only the rows that actually carry draft tokens re-patch in
+        # Python afterwards, and those are counted as fallbacks.
+        use_native = self._native_prep is not None
+        draft_rows: set[int] = set()
         if use_native:
             from vllm_tpu.native import ptr, ptr_u8
 
@@ -1424,12 +1459,40 @@ class ModelRunner:
                 lora_ptr, ptr(batch.lora_slot),
             ))
             do_sample[:r_live] = ds_u8[:r_live].astype(bool)
-            # Rows whose latest tokens are still in flight (device-side
-            # feedback) — the native fill copied stale values there, which
-            # the jitted step overwrites.
             ends = starts_np + counts_np
             known_live = batch.num_tokens[rows_np]
+            if s:
+                # Draft-verification rows: the native fill copied stale
+                # tokens past the known prefix; overlay the draft ids and
+                # the per-row sample positions (token tail = drafts).
+                for i, rid in enumerate(req_order):
+                    off = int(query_start_loc[i])
+                    n = num_sched[rid]
+                    drafts = spec_map.get(rid)
+                    if drafts:
+                        draft_rows.add(int(i))
+                        n_known = min(
+                            n, int(known_live[i]) - int(starts_np[i])
+                        )
+                        nd = min(len(drafts), n - n_known)
+                        token_ids[off + n_known : off + n] = drafts[:nd]
+                        draft_ids[i, :nd] = drafts[:nd]
+                        num_draft[i] = nd
+                        base = off + n - 1 - nd
+                        sample_pos[i, : nd + 1] = np.arange(
+                            base, base + nd + 1
+                        )
+                        sample_pos[i, nd + 1 :] = base + nd
+                    else:
+                        sample_pos[i, :] = off + n - 1
+                self.prep_fallback_rows += len(draft_rows)
+            # Rows whose latest tokens are still in flight (device-side
+            # feedback) — the native fill copied stale values there, which
+            # the jitted step overwrites. Draft rows extend past the known
+            # prefix by construction and are NOT in-flight feedback.
             for i in np.nonzero(ends > known_live)[0]:
+                if int(i) in draft_rows:
+                    continue
                 rid = req_order[i]
                 lag = int(ends[i] - known_live[i])
                 prev_row = self._prev_rows.get(rid, -1)
@@ -1445,6 +1508,8 @@ class ModelRunner:
                     row = rows[i]
                     end = int(ends[i])
                     draft_next[i] = batch.token_ids[row, end]
+        if not use_native:
+            self.prep_fallback_rows += r_live
         for i, row in enumerate(rows) if not use_native else ():
             rid = req_order[i]
             n = num_sched[rid]
@@ -1461,6 +1526,9 @@ class ModelRunner:
                     batch.token_ids[row, start : start + n_known]
                 )
                 token_ids[offset + n_known : offset + n] = drafts[:nd]
+                # Rejection sampling verifies against these ids; the
+                # token stream alone is not consulted.
+                draft_ids[i, :nd] = drafts[:nd]
                 num_draft[i] = nd
                 base = offset + n - 1 - nd
                 sample_pos[i, : nd + 1] = np.arange(base, base + nd + 1)
@@ -1524,32 +1592,53 @@ class ModelRunner:
                 for j, (_tok, val) in enumerate(lst):
                     adj_vals[i, j] = val
 
-        def gather_into(dst, col, pad_value=0):
-            dst[:] = pad_value
-            if r_live:
-                dst[:r_live] = col[idx]
-            return dst
+        temperature = fbuf[0:r]
+        top_p = fbuf[r : 2 * r]
+        min_p = fbuf[2 * r : 3 * r]
+        presence = fbuf[3 * r : 4 * r]
+        frequency = fbuf[4 * r : 5 * r]
+        repetition = fbuf[5 * r : 6 * r]
+        if use_native:
+            # One C pass gathers all nine sampling columns (incl. the
+            # PRNG seed/counter pair) instead of eight numpy fancy-
+            # gathers plus a per-row Python loop.
+            from vllm_tpu.native import ptr_f32, ptr_i32_cast
 
-        temperature = gather_into(fbuf[0:r], batch.temperature)
-        top_p = gather_into(fbuf[r : 2 * r], batch.top_p, 1.0)
-        min_p = gather_into(fbuf[2 * r : 3 * r], batch.min_p)
-        presence = gather_into(fbuf[3 * r : 4 * r], batch.presence_penalty)
-        frequency = gather_into(fbuf[4 * r : 5 * r], batch.frequency_penalty)
-        repetition = gather_into(fbuf[5 * r : 6 * r], batch.repetition_penalty, 1.0)
-        gather_into(top_k, batch.top_k)
-        gather_into(prng[:, 0], batch.seeds)
-        for i, row in enumerate(rows):
-            prng[i, 1] = batch.req_states[req_order[i]].generated
+            needs_penalties = bool(self._native_prep.fill_sampling_inputs(
+                ptr(rows_np), np.int32(r_live), np.int32(r),
+                ptr_f32(batch.temperature), ptr_f32(batch.top_p),
+                ptr_f32(batch.min_p), ptr_f32(batch.presence_penalty),
+                ptr_f32(batch.frequency_penalty),
+                ptr_f32(batch.repetition_penalty),
+                ptr(batch.top_k), ptr_i32_cast(batch.seeds),
+                ptr(batch.generated),
+                ptr_f32(fbuf), ptr(top_k), ptr_i32_cast(prng),
+            ))
+        else:
+            def gather_into(dst, col, pad_value=0):
+                dst[:] = pad_value
+                if r_live:
+                    dst[:r_live] = col[idx]
+                return dst
+
+            gather_into(temperature, batch.temperature)
+            gather_into(top_p, batch.top_p, 1.0)
+            gather_into(min_p, batch.min_p)
+            gather_into(presence, batch.presence_penalty)
+            gather_into(frequency, batch.frequency_penalty)
+            gather_into(repetition, batch.repetition_penalty, 1.0)
+            gather_into(top_k, batch.top_k)
+            gather_into(prng[:, 0], batch.seeds)
+            gather_into(prng[:, 1], batch.generated)
+            needs_penalties = bool(
+                np.any(presence[:r_live] != 0)
+                or np.any(frequency[:r_live] != 0)
+                or np.any(repetition[:r_live] != 1.0)
+            )
         for i, lag in pending_rows:
             # The in-flight token(s) haven't been appended yet; advance the
             # PRNG counter so this step's Gumbel stream doesn't repeat.
             prng[i, 1] += lag
-
-        needs_penalties = bool(
-            np.any(presence[:r_live] != 0)
-            or np.any(frequency[:r_live] != 0)
-            or np.any(repetition[:r_live] != 1.0)
-        )
         if needs_penalties:
             counts_np, mask_np = self._penalty_tensors(rows, r_pad)
             counts, prompt_mask = jnp.asarray(counts_np), jnp.asarray(mask_np)
@@ -1601,7 +1690,16 @@ class ModelRunner:
             num_adj=num_adj,
             num_allow=num_allow,
             num_decode_steps=so.num_decode_steps,
+            # Cascade rewrites the attention call shape; keep such
+            # batches on the general kernel.
+            decode_only=decode_only and cascade_blocks == 0,
         )
+        self.step_launches += 1
+        if flags["decode_only"]:
+            self.decode_only_launches += 1
+        # Multi-step only ever schedules all-decode batches, so the
+        # emission estimate r_live * K holds whenever K > 1.
+        self.launch_sampled_tokens += r_live * flags["num_decode_steps"]
         arrays = (jnp.asarray(ibuf), jnp.asarray(fbuf), counts, prompt_mask)
         mm_arrays = None
         if self.is_mm:
@@ -1703,7 +1801,10 @@ class ModelRunner:
 
     def _single_pos_metadata(self, md, p, r_pad):
         """Per-row single-position AttentionMetadata (decode chain /
-        EAGLE chain): query at position p[row], same block tables."""
+        EAGLE chain): query at position p[row], same block tables. One
+        token per row by construction, so the decode-specialized kernel
+        is eligible whenever the config allows it."""
+        decode_ok = self.config.scheduler_config.enable_decode_attention
         bs = self.block_size
         rows_r = jnp.arange(r_pad, dtype=jnp.int32)
         slot = md.block_tables[rows_r, p // bs] * bs + p % bs
@@ -1717,6 +1818,7 @@ class ModelRunner:
             logits_indices=rows_r,
             num_seqs=md.num_seqs,
             state_slots=md.state_slots,
+            decode_only=decode_ok,
         )
 
     def _logit_adjustments(self, rows: list[int], req_order: list[str],
