@@ -2,6 +2,8 @@
 
 - ``tools/probe_decode_attn.py --smoke``: the decode kernel's block-size
   sweep in Pallas interpret mode against the XLA reference;
+- ``tools/probe_sampler.py --smoke``: the fused sampling kernel's
+  block-shape sweep, bit-exact against the XLA sampling epilogue;
 - ``tools/profile_decode.py``: the full engine-under-profiler path at a
   tiny CPU shape (xplane written, graceful no-device-ops report);
 - the op classifier feeding both the profiler's phase table and
@@ -35,6 +37,16 @@ def test_probe_decode_attn_smoke():
     # Every sweep point reported and matched the reference.
     assert proc.stdout.count("MISMATCH") == 0
     assert proc.stdout.count("decode sb=") == 9
+
+
+def test_probe_sampler_smoke():
+    """Fused sampling kernel bit-exact vs the XLA reference across the
+    interpret-mode block-shape sweep."""
+    proc = _run_tool("probe_sampler.py", "--smoke")
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    assert "smoke sweep ok" in proc.stdout
+    assert proc.stdout.count("MISMATCH") == 0
+    assert proc.stdout.count("kernel rb=") == 4
 
 
 @pytest.mark.slow

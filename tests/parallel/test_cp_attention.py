@@ -66,7 +66,7 @@ def test_cp_attention_matches_single_device(cp):
 
 
 def _run_cp_case(cp):
-    from jax import shard_map
+    from vllm_tpu.parallel.mesh import shard_map
 
     rng = np.random.default_rng(1)
     kh, h, d, bs = 2, 4, 32, 8

@@ -44,7 +44,11 @@ def _per_rank_flops(model, params, kv, ids, md) -> float:
     compiled = (
         jax.jit(model.apply).lower(params, kv, ids, md).compile()
     )
-    return float(compiled.cost_analysis()["flops"])
+    # Older jax returns a one-element list of per-device dicts.
+    cost = compiled.cost_analysis()
+    if isinstance(cost, (list, tuple)):
+        cost = cost[0]
+    return float(cost["flops"])
 
 
 def test_cp2_prefill_halves_per_rank_flops(model_and_inputs):

@@ -74,6 +74,13 @@ REQUIRED_DECODE_METRICS = {
     "vllm:prep_fallback_rows_total",
 }
 
+# Documented in the README ("Sampling performance"); the A/B protocol
+# reads these to confirm the fused sampler actually ran.
+REQUIRED_SAMPLER_METRICS = {
+    "vllm:sampler_kernel_launches_total",
+    "vllm:sampler_fallback_rows_total",
+}
+
 # Documented in the README ("Multi-host fault tolerance"); the mesh
 # shrink/rejoin acceptance tests assert on these names.
 REQUIRED_MESH_METRICS = {
@@ -156,6 +163,10 @@ def check() -> list[str]:
     for name in sorted(REQUIRED_DECODE_METRICS - set(seen)):
         errors.append(
             f"required decode metric {name} is missing from "
+            f"the registry (documented in README)")
+    for name in sorted(REQUIRED_SAMPLER_METRICS - set(seen)):
+        errors.append(
+            f"required sampler metric {name} is missing from "
             f"the registry (documented in README)")
 
     return errors

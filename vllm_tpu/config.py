@@ -212,7 +212,12 @@ class SchedulerConfig:
     # device-side from the previous step's sampled array, so any depth is
     # exact for greedy/seeded sampling (penalty-bearing requests are capped
     # at 2 in flight — the device-side count correction covers one token).
-    async_pipeline_depth: int = 6
+    # Default retuned 6 -> 3 after PR 8: at the post-PR8 step phase split
+    # (BENCH_r05: ~3ms host prep+dispatch vs ~188ms device wall) depth 2
+    # already hides the host turnaround; 3 keeps one step of slack for
+    # scheduler jitter while halving the stale-work window on aborts and
+    # the depth-capped penalty-row exposure. See README knobs table.
+    async_pipeline_depth: int = 3
     enable_chunked_prefill: bool = True
     # In-jit multi-step decode (reference analog: vLLM v0
     # --num-scheduler-steps): when every scheduled request is a pure
@@ -228,6 +233,14 @@ class SchedulerConfig:
     # VLLM_TPU_DISABLE_DECODE_KERNEL env is the no-restart escape hatch
     # for the same switch.
     enable_decode_attention: bool = True
+    # Fused sort-free sampling kernel (ops/sampler_kernel.py): sampling
+    # batches (any non-greedy row) run the whole sampling epilogue —
+    # penalties, temperature, top-k/top-p/min-p, seeded Gumbel draw — in
+    # one Pallas kernel reading the logits from HBM exactly once, instead
+    # of the XLA path's multiple [R, V] passes. Bit-exact vs the XLA
+    # reference (sample/sampler.py); the VLLM_TPU_DISABLE_SAMPLER_KERNEL
+    # env is the no-restart escape hatch for the same switch.
+    enable_sampler_kernel: bool = True
     # Slots allocated beyond the scheduled tokens (EAGLE writes draft KV at
     # speculative positions); set at EngineConfig.finalize.
     num_lookahead_tokens: int = 0

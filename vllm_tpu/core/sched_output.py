@@ -183,6 +183,11 @@ class SchedulerStats:
     decode_only_launches: int = 0
     launch_sampled_tokens: int = 0
     prep_fallback_rows: int = 0
+    # Sampling-epilogue routing: in-jit sample() calls routed to the
+    # fused sort-free kernel vs sampling rows that fell back to the XLA
+    # reference path (all-greedy launches count as neither).
+    sampler_kernel_launches: int = 0
+    sampler_fallback_rows: int = 0
     # Engine-step phase durations (drained each snapshot, seconds) —
     # attached by EngineCore from the schedule/dispatch/finalize sites;
     # feed the vllm:engine_step_duration_seconds histogram family.

@@ -60,6 +60,7 @@ class EngineArgs:
     encoder_cache_budget: int = 4096
     enable_cascade_attention: bool = False
     enable_decode_attention: bool = True
+    enable_sampler_kernel: bool = True
 
     tensor_parallel_size: int = 1
     data_parallel_size: int = 1
@@ -185,6 +186,7 @@ class EngineArgs:
                 encoder_cache_budget=self.encoder_cache_budget,
                 enable_cascade_attention=self.enable_cascade_attention,
                 enable_decode_attention=self.enable_decode_attention,
+                enable_sampler_kernel=self.enable_sampler_kernel,
             ),
             device_config=DeviceConfig(device=self.device),  # type: ignore[arg-type]
             speculative_config=SpeculativeConfig(

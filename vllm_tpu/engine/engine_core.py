@@ -369,6 +369,12 @@ class EngineCore:
             stats.prep_fallback_rows = getattr(
                 runner, "prep_fallback_rows", 0
             )
+            stats.sampler_kernel_launches = getattr(
+                runner, "sampler_kernel_launches", 0
+            )
+            stats.sampler_fallback_rows = getattr(
+                runner, "sampler_fallback_rows", 0
+            )
             stats.numeric_guard_trips = dict(
                 getattr(runner, "numeric_guard_trips", {})
             )

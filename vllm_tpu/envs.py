@@ -75,6 +75,18 @@ _readers: dict[str, Callable[[], Any]] = {
     "VLLM_TPU_DECODE_KV_PAGES_PER_BLOCK": _int(
         "VLLM_TPU_DECODE_KV_PAGES_PER_BLOCK", 0
     ),
+    # Escape hatch for the fused sort-free sampling kernel
+    # (ops/sampler_kernel.py): sampling batches fall back to the XLA
+    # sort-free reference in sample/sampler.py when set. Both paths are
+    # bit-exact; A/B this before filing kernel bugs.
+    "VLLM_TPU_DISABLE_SAMPLER_KERNEL": _bool(
+        "VLLM_TPU_DISABLE_SAMPLER_KERNEL", False
+    ),
+    # Sampler-kernel block-shape overrides (0 = tuned defaults): request
+    # rows per grid program and logits lanes per streamed DMA tile.
+    # Sweep with tools/probe_sampler.py before changing the defaults.
+    "VLLM_TPU_SAMPLER_ROW_BLOCK": _int("VLLM_TPU_SAMPLER_ROW_BLOCK", 0),
+    "VLLM_TPU_SAMPLER_LOGITS_TILE": _int("VLLM_TPU_SAMPLER_LOGITS_TILE", 0),
     "VLLM_TPU_COMPILE_CACHE_DIR": _str("VLLM_TPU_COMPILE_CACHE_DIR", None),
     # LRU size bound for the persistent compilation cache directory.
     "VLLM_TPU_COMPILE_CACHE_MAX_GB": _int("VLLM_TPU_COMPILE_CACHE_MAX_GB", 32),

@@ -315,7 +315,9 @@ def ep_moe(
 
     from jax.sharding import PartitionSpec as P
 
-    out = jax.shard_map(
+    from vllm_tpu.parallel.mesh import shard_map
+
+    out = shard_map(
         local_fn,
         mesh=mesh,
         in_specs=(

@@ -24,8 +24,12 @@ import os
 
 PHASES = ("attention", "matmul", "sampler", "other")
 
-# Ordered: first hit wins. Attention before matmul — the attention
+# Ordered: first hit wins. Sampler kernels before attention — the
+# "tpu_custom_call" catch-all below would otherwise claim the fused
+# sampling kernel (it is a Pallas custom call too, but its time belongs
+# to the sampler budget). Attention before matmul — the attention
 # kernels contain dots but their time belongs to the attention budget.
+_SAMPLER_KERNEL_MARKS = ("fused_sampler_kernel", "sampler_kernel")
 _ATTENTION_MARKS = (
     "ragged_paged_attention",
     "decode_kernel",
@@ -46,6 +50,9 @@ def classify_op(name: str) -> str:
     """Phase bucket ("attention" | "matmul" | "sampler" | "other") for a
     device op name."""
     low = name.lower()
+    for mark in _SAMPLER_KERNEL_MARKS:
+        if mark in low:
+            return "sampler"
     for mark in _ATTENTION_MARKS:
         if mark in low:
             return "attention"

@@ -227,6 +227,14 @@ class PrometheusRegistry:
             "vllm:prep_fallback_rows_total",
             "Step-input rows assembled by the Python fallback instead of "
             "the native host-prep fill")
+        self.sampler_kernel_launches = Counter(
+            "vllm:sampler_kernel_launches_total",
+            "In-jit sample() calls routed to the fused sort-free sampling "
+            "kernel")
+        self.sampler_fallback_rows = Counter(
+            "vllm:sampler_fallback_rows_total",
+            "Sampling (non-greedy) rows sampled by the XLA reference path "
+            "because the fused sampling kernel was ineligible or disabled")
         self.request_success = LabeledCounter(
             "vllm:request_success_total",
             "Finished requests by reason", "finished_reason")
@@ -376,6 +384,7 @@ class PrometheusRegistry:
             self.bucket_compiles, self.bucket_hits, self.pipeline_stall,
             self.decode_batch_ratio, self.tokens_per_launch,
             self.prep_fallback_rows,
+            self.sampler_kernel_launches, self.sampler_fallback_rows,
             self.request_success,
             self.step_duration, self.batch_tokens, self.batch_requests,
             self.batch_occupancy, self.step_interval,
@@ -402,6 +411,8 @@ class PrometheusRegistry:
         self._last_buckets = (0, 0)
         self._last_stall = 0.0
         self._last_prep_fallback = 0
+        self._last_sampler_kernel = 0
+        self._last_sampler_fallback = 0
 
     # StatLoggerBase interface -----------------------------------------
 
@@ -444,6 +455,12 @@ class PrometheusRegistry:
             self.prep_fallback_rows.inc(
                 max(0, s.prep_fallback_rows - self._last_prep_fallback))
             self._last_prep_fallback = s.prep_fallback_rows
+            self.sampler_kernel_launches.inc(
+                max(0, s.sampler_kernel_launches - self._last_sampler_kernel))
+            self._last_sampler_kernel = s.sampler_kernel_launches
+            self.sampler_fallback_rows.inc(
+                max(0, s.sampler_fallback_rows - self._last_sampler_fallback))
+            self._last_sampler_fallback = s.sampler_fallback_rows
             for t in s.step_schedule_times:
                 self.step_duration.observe("schedule", t)
             for t in s.step_dispatch_times:

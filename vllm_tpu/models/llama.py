@@ -638,6 +638,7 @@ class LlamaForCausalLM:
         from jax.sharding import PartitionSpec as P
 
         from vllm_tpu.ops.attention import ref_ragged_paged_attention
+        from vllm_tpu.parallel.mesh import pcast_varying, shard_map
 
         S = self.pp_size
         mesh = self.pp_mesh
@@ -662,7 +663,7 @@ class LlamaForCausalLM:
             return ref_ragged_paged_attention(q, kv, li, md_m, scale, **kw)
 
         @_partial(
-            jax.shard_map,
+            shard_map,
             mesh=mesh,
             in_specs=(P("pp"), P("pp"), P(), P(), P(), P(), P(), P(), P(),
                       P(), P()),
@@ -672,7 +673,7 @@ class LlamaForCausalLM:
         def run(layers_local, kv_local, chunks, pos_m, slot_m, tri_m,
                 block_tables, seq_lens, qsl, logits_idx, num_seqs):
             stage = jax.lax.axis_index("pp")
-            varying = _partial(jax.lax.pcast, axis_name=("pp",), to="varying")
+            varying = _partial(pcast_varying, axis_name=("pp",))
             buf = varying(jnp.zeros((tm, d), x.dtype))
             outs = varying(jnp.zeros((m, tm, d), x.dtype))
             li_local = jnp.arange(ls, dtype=jnp.int32)

@@ -37,6 +37,7 @@ from vllm_tpu.ops.attention import (
     AttentionMetadata,
     ref_ragged_paged_attention,
 )
+from vllm_tpu.parallel.mesh import shard_map
 
 
 def merge_attn_states(
@@ -207,7 +208,7 @@ def cp_write_and_attend(
         return kv_l, lse_merge_collective(out, lse, axis).astype(q.dtype)
 
     kv_spec = P(None, axis, None, None, None)
-    return jax.shard_map(
+    return shard_map(
         local_fn,
         mesh=mesh,
         in_specs=(kv_spec, P(), P(), P(), P(), P()),

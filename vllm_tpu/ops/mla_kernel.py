@@ -38,6 +38,8 @@ from jax import lax
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from vllm_tpu.ops.rpa_kernel import CompilerParams
+
 from vllm_tpu.ops.rpa_kernel import store_with_mask
 
 DEFAULT_MASK_VALUE = -0.7 * float(jnp.finfo(jnp.dtype("float32")).max)
@@ -379,7 +381,7 @@ def mla_ragged_paged_attention(
             grid=grid,
             scratch_shapes=scratch_shapes,
         ),
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=CompilerParams(
             dimension_semantics=("arbitrary",),
             vmem_limit_bytes=vmem_limit_bytes,
         ),

@@ -10,6 +10,13 @@ with greedy rows (temperature 0) taking argmax. Gumbel-max avoids a full
 cumulative-sort sample: sampling = argmax(logits/T + Gumbel noise) after the
 top-k/top-p mask, which is exactly categorical sampling over the masked
 distribution (the Model-Runner-V2 trick, ``docs/design/model_runner_v2.md``).
+
+Unlike the reference, top-k/top-p are SORT-FREE: the masking, reductions and
+the seeded Gumbel stream are the shared primitives of
+``ops/sampler_kernel.py`` (rank-space bisection + counter-based Threefry),
+so this XLA path is bit-exact against the fused Pallas sampling kernel —
+``dispatch_sample`` below routes between them per the usual eligibility +
+escape-hatch rules (mirrors ``ops/attention.py:dispatch_ragged_attention``).
 """
 
 from __future__ import annotations
@@ -18,8 +25,11 @@ from dataclasses import dataclass
 
 import jax
 import jax.numpy as jnp
+from jax import lax
 
-_NEG_INF = jnp.float32(-1e30)
+from vllm_tpu.ops import sampler_kernel as _sk
+
+_NEG_INF = jnp.float32(_sk.MASK_VALUE)
 
 
 @jax.tree_util.register_dataclass
@@ -46,44 +56,45 @@ class SamplingMetadata:
 def apply_penalties(logits: jnp.ndarray, md: SamplingMetadata) -> jnp.ndarray:
     """Repetition / presence / frequency penalties (HF/OpenAI semantics,
     reference: ``vllm/v1/sample/ops/penalties.py``)."""
-    counts = md.output_token_counts.astype(jnp.float32)  # [R, V]
-    seen_out = counts > 0
-    seen_any = seen_out | md.prompt_token_mask
-    rep = md.repetition_penalty[:, None]
-    logits = jnp.where(
-        seen_any & (logits > 0), logits / rep, jnp.where(seen_any, logits * rep, logits)
+    return _sk.penalize_block(
+        logits,
+        md.output_token_counts,
+        md.prompt_token_mask,
+        md.repetition_penalty[:, None],
+        md.frequency_penalty[:, None],
+        md.presence_penalty[:, None],
     )
-    logits = logits - md.frequency_penalty[:, None] * counts
-    logits = logits - md.presence_penalty[:, None] * seen_out.astype(jnp.float32)
-    return logits
+
+
+def _pad_vocab(logits: jnp.ndarray) -> jnp.ndarray:
+    """Pad the vocab axis to the shared power-of-two width with -inf
+    (zero weight, never wins an argmax)."""
+    v = logits.shape[-1]
+    v2 = _sk.padded_vocab(v)
+    if v2 == v:
+        return logits
+    return jnp.pad(logits, ((0, 0), (0, v2 - v)), constant_values=-jnp.inf)
 
 
 def _mask_top_k(logits: jnp.ndarray, top_k: jnp.ndarray) -> jnp.ndarray:
+    """Keep each row's top-k logits (0 disables) — sort-free radix
+    selection of the k-th value; ties with it are kept, matching the old
+    sorted formulation."""
     v = logits.shape[-1]
-    # Per-row threshold: value of the k-th largest logit. Full sort once,
-    # gather per-row kth value (top_k is per-request).
-    sorted_desc = jnp.sort(logits, axis=-1)[:, ::-1]  # [R, V]
-    k = jnp.where(top_k > 0, top_k, v).astype(jnp.int32)
-    kth = jnp.take_along_axis(sorted_desc, (k - 1)[:, None], axis=-1)  # [R, 1]
-    return jnp.where(logits < kth, _NEG_INF, logits)
+    x = _pad_vocab(logits)
+    out = _sk.mask_top_k_block(x, top_k[:, None].astype(jnp.int32), v)
+    return out[:, :v]
 
 
 def _mask_top_p_min_p(
     logits: jnp.ndarray, top_p: jnp.ndarray, min_p: jnp.ndarray
 ) -> jnp.ndarray:
-    probs = jax.nn.softmax(logits, axis=-1)
-    sorted_probs = jnp.sort(probs, axis=-1)[:, ::-1]
-    cumsum = jnp.cumsum(sorted_probs, axis=-1)
-    # Smallest prefix with cumulative mass >= top_p stays; find per-row
-    # probability threshold.
-    keep_sorted = cumsum - sorted_probs < top_p[:, None]
-    # Threshold = min prob among kept sorted entries.
-    thresh_p = jnp.min(jnp.where(keep_sorted, sorted_probs, 2.0), axis=-1)  # [R]
-    keep = probs >= thresh_p[:, None]
-    # min-p: drop tokens below min_p * max_prob.
-    max_p = jnp.max(probs, axis=-1)
-    keep &= probs >= (min_p * max_p)[:, None]
-    return jnp.where(keep, logits, _NEG_INF)
+    """Nucleus + min-p truncation without softmax-sort-cumsum: bisect the
+    weight-space cutoff (see ``ops/sampler_kernel.py``)."""
+    v = logits.shape[-1]
+    x = _pad_vocab(logits)
+    out = _sk.mask_top_p_min_p_block(x, top_p[:, None], min_p[:, None])
+    return out[:, :v]
 
 
 def sample(
@@ -99,41 +110,162 @@ def sample(
     pre-masking distribution — what logprob reporting uses).
 
     The ``needs_*`` flags are static: an all-greedy or vanilla-temperature
-    batch skips the [R, V] sorts — and, with ``needs_gumbel=False``, the
-    [R, V] Gumbel draw — entirely (separate jit trace per combo). An
-    all-greedy batch (the throughput-bench shape) is a single fused
-    argmax behind the logits matmul.
+    batch skips the [R, V] truncation passes — and, with
+    ``needs_gumbel=False``, the [R, V] Gumbel draw — entirely (separate
+    jit trace per combo). An all-greedy batch (the throughput-bench shape)
+    is a single fused argmax behind the logits matmul.
     """
     raw_logprobs = jax.nn.log_softmax(logits, axis=-1)
 
     if needs_penalties:
         logits = apply_penalties(logits, md)
 
-    greedy_pick = jnp.argmax(logits, axis=-1).astype(jnp.int32)
     if not needs_gumbel:
         # Statically all-greedy: temperature scaling, masking, and noise
         # cannot change an argmax; skip them (~5 [R, V] passes saved).
-        return greedy_pick, raw_logprobs
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32), raw_logprobs
 
-    greedy = md.temperature == 0.0
-    temp = jnp.where(greedy, 1.0, md.temperature)
-    scaled = logits / temp[:, None]
-    if needs_top_k:
-        scaled = _mask_top_k(scaled, md.top_k)
-    if needs_top_p_min_p:
-        scaled = _mask_top_p_min_p(scaled, md.top_p, md.min_p)
+    keys = md.prng_keys.astype(jnp.uint32)
+    sampled = _sk.sample_block(
+        _pad_vocab(logits),
+        md.temperature[:, None],
+        md.top_k[:, None].astype(jnp.int32),
+        md.top_p[:, None],
+        md.min_p[:, None],
+        keys[:, 0:1],
+        keys[:, 1:2],
+        vocab=logits.shape[-1],
+        needs_top_k=needs_top_k,
+        needs_top_p_min_p=needs_top_p_min_p,
+    )
+    return sampled[:, 0], raw_logprobs
 
-    noise = _per_row_gumbel(md.prng_keys, logits.shape[-1])
-    random_pick = jnp.argmax(scaled + noise, axis=-1).astype(jnp.int32)
-    sampled = jnp.where(greedy, greedy_pick, random_pick)
+
+def sampler_kernel_eligible(
+    vocab: int,
+    *,
+    needs_gumbel: bool,
+    enable_kernel: bool = True,
+    allow_interpret: bool = False,
+) -> tuple[bool, bool]:
+    """(use_kernel, interpret) for a batch shape — the single eligibility
+    rule, shared by ``dispatch_sample`` (trace time) and the runner's
+    launch/fallback counters (host side). All-greedy batches
+    (``needs_gumbel=False``) are NOT kernel work: the XLA argmax path is
+    already a single fused reduction behind the logits matmul."""
+    import vllm_tpu.envs as envs
+
+    if not needs_gumbel or not enable_kernel:
+        return False, False
+    if envs.VLLM_TPU_DISABLE_PALLAS or envs.VLLM_TPU_DISABLE_SAMPLER_KERNEL:
+        return False, False
+    on_tpu = jax.default_backend() == "tpu"
+    interpret = (
+        bool(allow_interpret and envs.VLLM_TPU_PALLAS_INTERPRET)
+        and not on_tpu
+    )
+    if not (on_tpu or interpret):
+        return False, False
+    if not interpret:
+        # Mosaic path: 128-lane-aligned vocab, big enough to beat the
+        # fused XLA epilogue, small enough that a [row_block, V2] f32
+        # working set fits VMEM.
+        if vocab % 128 != 0 or vocab < 2048:
+            return False, False
+        if _sk.padded_vocab(vocab) > 131072:
+            return False, False
+    return True, interpret
+
+
+def dispatch_sample(
+    logits: jnp.ndarray,
+    md: SamplingMetadata,
+    *,
+    needs_penalties: bool = False,
+    needs_top_k: bool = True,
+    needs_top_p_min_p: bool = True,
+    needs_gumbel: bool = True,
+    enable_kernel: bool = True,
+    allow_interpret: bool = False,
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Kernel-vs-reference dispatch for the sampling epilogue (the
+    ``dispatch_ragged_attention`` pattern): the fused Pallas kernel when
+    eligible — one HBM logits read, no sorts — else the XLA sort-free
+    reference above. Both produce bit-identical samples; A/B with
+    ``VLLM_TPU_DISABLE_SAMPLER_KERNEL=1`` before filing kernel bugs."""
+    import vllm_tpu.envs as envs
+
+    use_kernel, interpret = sampler_kernel_eligible(
+        logits.shape[-1],
+        needs_gumbel=needs_gumbel,
+        enable_kernel=enable_kernel,
+        allow_interpret=allow_interpret,
+    )
+    if not use_kernel:
+        return sample(
+            logits,
+            md,
+            needs_penalties=needs_penalties,
+            needs_top_k=needs_top_k,
+            needs_top_p_min_p=needs_top_p_min_p,
+            needs_gumbel=needs_gumbel,
+        )
+
+    # Logprob reporting reads the pre-masking distribution; computed here
+    # (not in-kernel) so it dead-code-eliminates when the caller drops it.
+    raw_logprobs = jax.nn.log_softmax(logits, axis=-1)
+
+    num_rows = logits.shape[0]
+    params_f = jnp.pad(
+        jnp.stack(
+            [
+                md.temperature,
+                md.top_p,
+                md.min_p,
+                md.repetition_penalty,
+                md.frequency_penalty,
+                md.presence_penalty,
+            ],
+            axis=1,
+        ),
+        ((0, 0), (0, 122)),
+    )
+    keys_i = lax.bitcast_convert_type(
+        md.prng_keys.astype(jnp.uint32), jnp.int32
+    )
+    params_i = jnp.pad(
+        jnp.stack(
+            [md.top_k.astype(jnp.int32), keys_i[:, 0], keys_i[:, 1]],
+            axis=1,
+        ),
+        ((0, 0), (0, 125)),
+    )
+    if needs_penalties:
+        counts = md.output_token_counts.astype(jnp.int32)
+        pmask = md.prompt_token_mask.astype(jnp.int8)
+    else:
+        counts = jnp.zeros((1, 128), jnp.int32)
+        pmask = jnp.zeros((1, 128), jnp.int8)
+
+    if interpret:
+        blk_kw = dict(row_block=2, logits_tile=256)
+    else:
+        blk_kw = {}
+        if envs.VLLM_TPU_SAMPLER_ROW_BLOCK > 0:
+            blk_kw["row_block"] = envs.VLLM_TPU_SAMPLER_ROW_BLOCK
+        if envs.VLLM_TPU_SAMPLER_LOGITS_TILE > 0:
+            blk_kw["logits_tile"] = envs.VLLM_TPU_SAMPLER_LOGITS_TILE
+
+    sampled = _sk.fused_sample(
+        logits,
+        params_f,
+        params_i,
+        counts,
+        pmask,
+        needs_penalties=needs_penalties,
+        needs_top_k=needs_top_k,
+        needs_top_p_min_p=needs_top_p_min_p,
+        interpret=interpret,
+        **blk_kw,
+    )
     return sampled, raw_logprobs
-
-
-def _per_row_gumbel(prng_keys: jnp.ndarray, vocab: int) -> jnp.ndarray:
-    def one(key_pair):
-        key = jax.random.PRNGKey(0)
-        key = jax.random.fold_in(key, key_pair[0])
-        key = jax.random.fold_in(key, key_pair[1])
-        return jax.random.gumbel(key, (vocab,), jnp.float32)
-
-    return jax.vmap(one)(prng_keys)

@@ -28,7 +28,12 @@ from vllm_tpu.core.sched_output import ModelRunnerOutput, SchedulerOutput
 from vllm_tpu.logger import init_logger
 from vllm_tpu.ops.attention import AttentionMetadata
 from vllm_tpu.resilience.failpoints import fail_point
-from vllm_tpu.sample.sampler import SamplingMetadata, sample
+from vllm_tpu.sample.sampler import (
+    SamplingMetadata,
+    dispatch_sample,
+    sample,
+    sampler_kernel_eligible,
+)
 from vllm_tpu.worker.input_batch import InputBatch
 
 logger = init_logger(__name__)
@@ -385,6 +390,11 @@ class ModelRunner:
         self.step_launches = 0
         self.decode_only_launches = 0
         self.launch_sampled_tokens = 0
+        # Sampling-epilogue routing: in-jit sample() calls routed to the
+        # fused sort-free kernel vs sampling rows that fell back to the
+        # XLA reference (greedy-only launches count as neither).
+        self.sampler_kernel_launches = 0
+        self.sampler_fallback_rows = 0
         self.timing = {"prep_s": 0.0, "dispatch_s": 0.0, "wait_s": 0.0,
                        "steps": 0}
 
@@ -831,13 +841,15 @@ class ModelRunner:
             ].set(True, mode="drop")
             allow = allow | (allow_active == 0)[:, None]
             logits = jnp.where(allow, logits, jnp.float32(-1e30))
-        sampled, raw_logprobs = sample(
+        sampled, raw_logprobs = dispatch_sample(
             logits,
             sampling,
             needs_penalties=needs_penalties,
             needs_top_k=needs_top_k,
             needs_top_p_min_p=needs_top_p_min_p,
             needs_gumbel=needs_gumbel,
+            enable_kernel=self.config.scheduler_config.enable_sampler_kernel,
+            allow_interpret=True,
         )
         if num_decode_steps > 1:
             # In-jit multi-step decode: chain K-1 more single-position
@@ -871,13 +883,17 @@ class ModelRunner:
                     sampling,
                     prng_keys=sampling.prng_keys.at[:, 1].add(k),
                 )
-                tok, _ = sample(
+                tok, _ = dispatch_sample(
                     logits_k,
                     sampling_k,
                     needs_penalties=False,
                     needs_top_k=needs_top_k,
                     needs_top_p_min_p=needs_top_p_min_p,
                     needs_gumbel=needs_gumbel,
+                    enable_kernel=(
+                        self.config.scheduler_config.enable_sampler_kernel
+                    ),
+                    allow_interpret=True,
                 )
                 outs.append(tok)
             sampled = jnp.stack(outs, axis=1)  # [R, K]
@@ -1700,6 +1716,25 @@ class ModelRunner:
         # Multi-step only ever schedules all-decode batches, so the
         # emission estimate r_live * K holds whenever K > 1.
         self.launch_sampled_tokens += r_live * flags["num_decode_steps"]
+        # Sampler-kernel routing accounting (the device decision is made
+        # at trace time by dispatch_sample; this mirrors it host-side).
+        # All-greedy launches are neither: the XLA argmax path is not a
+        # fallback, it's the design for that shape.
+        if flags["needs_gumbel"]:
+            use_kernel, _ = sampler_kernel_eligible(
+                self.model.vocab_size,
+                needs_gumbel=True,
+                enable_kernel=(
+                    self.config.scheduler_config.enable_sampler_kernel
+                ),
+                allow_interpret=True,
+            )
+            if use_kernel:
+                self.sampler_kernel_launches += flags["num_decode_steps"]
+            else:
+                self.sampler_fallback_rows += int(np.sum(nongreedy)) * flags[
+                    "num_decode_steps"
+                ]
         arrays = (jnp.asarray(ibuf), jnp.asarray(fbuf), counts, prompt_mask)
         mm_arrays = None
         if self.is_mm:
