@@ -150,11 +150,10 @@ def _wait_for_quiet(min_gbs: float = 100.0, max_wait_s: float = 300.0) -> float:
         time.sleep(30)
         bw = _bw_probe()
     return bw
-# v5e per-chip peak: 197 TFLOP/s bf16, ~819 GB/s HBM.
-PEAK_FLOPS = {"TPU v5 lite": 197e12, "TPU v5e": 197e12,
-              "TPU v4": 275e12, "TPU v6 lite": 918e12}
-PEAK_HBM = {"TPU v5 lite": 819e9, "TPU v5e": 819e9,
-            "TPU v4": 1200e9, "TPU v6 lite": 1640e9}
+# Per-chip peaks live in the roofline module now (shared with the
+# engine's live perfwatch telemetry); re-exported here for callers that
+# imported them from bench.
+from vllm_tpu.metrics.roofline import PEAK_FLOPS, PEAK_HBM  # noqa: E402
 
 
 def _pick_model() -> tuple[list, int, int, int]:
@@ -389,30 +388,24 @@ def main() -> None:
     )
     extras: dict = {}
     if worker is not None:
-        import numpy as np
+        from vllm_tpu.metrics import roofline as rl
 
-        weight_bytes = sum(
-            x.size * x.dtype.itemsize
-            for x in jax.tree_util.tree_leaves(worker.params)
-        )
-        L, KH, Dh = (shape["num_hidden_layers"],
-                     shape["num_key_value_heads"],
-                     shape["hidden_size"] // shape["num_attention_heads"])
-        kv_byte = 1 if extra_kw.get("kv_cache_dtype") == "fp8" else 2
-        kv_tok = 2 * L * KH * Dh * kv_byte  # KV bytes per token
-        avg_ctx = prompt_len + output_len / 2
-        kv_read = n_req * avg_ctx * kv_tok  # per decode step (batch full)
-        dev_kind = getattr(jax.devices()[0], "device_kind", "")
-        best_rate = n_out / min(times) / n_chips
-        steps_s = best_rate / n_req  # decode steps/sec (one token/req/step)
-        bw = (weight_bytes + kv_read) * steps_s
+        weight_bytes = rl.weight_bytes(worker.params)
+        kv_tok = rl.kv_bytes_per_token(
+            shape["num_hidden_layers"], shape["num_key_value_heads"],
+            shape["hidden_size"] // shape["num_attention_heads"],
+            1 if extra_kw.get("kv_cache_dtype") == "fp8" else 2)
         # 2 FLOPs/param/token over non-embedding LOGICAL params (int4
         # packs two params per uint8 byte).
-        active = sum(
-            x.size * (2 if str(x.dtype) == "uint8" else 1)
-            for x in jax.tree_util.tree_leaves(worker.params)
-        ) - shape["vocab_size"] * shape["hidden_size"]
-        flops = best_rate * 2 * active
+        active = (rl.logical_params(worker.params)
+                  - shape["vocab_size"] * shape["hidden_size"])
+        model = rl.RooflineModel(
+            weight_bytes=weight_bytes, active_params=active,
+            kv_tok_bytes=kv_tok,
+            device_kind=getattr(jax.devices()[0], "device_kind", ""))
+        avg_ctx = prompt_len + output_len / 2
+        best_rate = n_out / min(times) / n_chips
+        steps_s = best_rate / n_req  # decode steps/sec (one token/req/step)
         size = {4096: "8B", 2048: "1B-class"}.get(
             shape["hidden_size"], "tiny-cpu"
         )
@@ -423,9 +416,8 @@ def main() -> None:
             "batch": n_req,
             "weight_gib": round(weight_bytes / 2**30, 2),
             "hbm_bw_util_est": round(
-                bw / PEAK_HBM.get(dev_kind, 819e9), 3
-            ),
-            "mfu_est": round(flops / PEAK_FLOPS.get(dev_kind, 197e12), 4),
+                model.hbm_bw_util(steps_s, int(n_req * avg_ctx)), 3),
+            "mfu_est": round(model.mfu(best_rate), 4),
         }
         if runner is not None and runner.timing.get("steps"):
             tm = dict(runner.timing)
@@ -453,6 +445,23 @@ def main() -> None:
                     extras["attn_ms_per_layer"] = round(
                         split["attention"] / launches
                         / shape["num_hidden_layers"], 4)
+        # In-engine quiet-window kernel A/B (perfwatch): the engine is
+        # idle here (scoring passes done), so run the sampler-kernel /
+        # decode-attention on-vs-off replay against the retained batch
+        # shape and record the deltas next to the score they explain.
+        if os.environ.get("VLLM_TPU_BENCH_AB", "1") != "0":
+            try:
+                core = llm.llm_engine.engine_core.engine_core
+                ab = core.perf_ab({"steps": None})
+                if ab and not ab.get("error") and not ab.get("aborted"):
+                    extras["ab"] = ab.get("ab")
+                    extras["ab_batch"] = ab.get("batch")
+                elif ab:
+                    extras["ab_error"] = (
+                        ab.get("error") or ab.get("aborted_reason")
+                        or "aborted")
+            except Exception as exc:  # never fail the scored run on A/B
+                extras["ab_error"] = f"{type(exc).__name__}: {exc}"
 
     # vs_baseline is honest only for the 8B shapes (the 2000 tok/s target
     # is defined for Llama-3-8B); the congested-chip 1B fallback reports

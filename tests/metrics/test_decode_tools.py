@@ -72,7 +72,25 @@ def test_classify_op_phases():
     assert classify_op("sort.1") == "sampler"
     assert classify_op("threefry2x32") == "sampler"
     assert classify_op("copy.5") == "other"
-    assert set(PHASES) == {"attention", "matmul", "sampler", "other"}
+    # Collectives classify as comms even through the tpu_custom_call
+    # catch-all (Pallas collectives are custom calls too: the comms
+    # marks are checked first — ordered-first-hit contract).
+    assert classify_op("all-reduce.1") == "comms"
+    assert classify_op("fusion.all_gather.3") == "comms"
+    assert classify_op("reduce-scatter.2") == "comms"
+    assert classify_op("collective-permute.1") == "comms"
+    assert classify_op("ppermute_tpu_custom_call") == "comms"
+    assert set(PHASES) == {
+        "attention", "matmul", "sampler", "comms", "other"}
+
+
+def test_perf_ab_smoke():
+    """In-proc quiet-window kernel A/B end to end on CPU: tiny engine,
+    synthetic replay batch, sampler/decode-attention variants, artifact
+    schema validated (device_ms null on CPU, wall-clock source)."""
+    proc = _run_tool("perf_ab.py", "--smoke")
+    assert proc.returncode == 0, (proc.stdout[-2000:], proc.stderr[-2000:])
+    assert "perf_ab smoke ok" in proc.stdout
 
 
 def test_op_split_ms_empty_dir(tmp_path):

@@ -48,6 +48,11 @@ def _populated_registry() -> PrometheusRegistry:
         step_finalize_times=[0.0001],
         batch_num_tokens=96, batch_num_reqs=3, batch_occupancy=0.75,
         step_interval_s=0.006,
+        perfwatch_captures=2, perfwatch_captures_aborted=1,
+        perfwatch_device_ms={"attention": 3.2, "matmul": 1.1,
+                             "sampler": 0.4, "comms": 0.2, "other": 0.1,
+                             "total": 5.0},
+        perfwatch_mfu_est=0.16, perfwatch_hbm_bw_util_est=0.7,
     )
     it = IterationStats(
         num_generation_tokens=12, num_prompt_tokens=7,
@@ -164,6 +169,26 @@ def test_step_phase_family_renders_per_phase():
     assert "vllm:engine_batch_requests 3" in text
     assert "vllm:engine_batch_occupancy 0.75" in text
     assert "vllm:engine_step_interval_seconds 0.006" in text
+
+
+def test_perfwatch_family_renders():
+    """The perfwatch capture's attribution lands as a phase-labeled
+    gauge family plus roofline gauges and ratcheting counters."""
+    text = _populated_registry().render()
+    assert 'vllm:device_time_ms_per_step{phase="attention"} 3.2' in text
+    assert 'vllm:device_time_ms_per_step{phase="comms"} 0.2' in text
+    assert 'vllm:device_time_ms_per_step{phase="total"} 5.0' in text
+    assert "vllm:mfu_est 0.16" in text
+    assert "vllm:hbm_bw_util_est 0.7" in text
+    assert "vllm:perfwatch_captures_total 2.0" in text
+    assert "vllm:perfwatch_captures_aborted_total 1.0" in text
+
+    # Counters ratchet: a stats snapshot from a respawned engine (zeros)
+    # must not decrease the rendered totals.
+    reg = _populated_registry()
+    reg.record(SchedulerStats())
+    text = reg.render()
+    assert "vllm:perfwatch_captures_total 2.0" in text
 
 
 def test_resilience_counters_never_decrease():

@@ -90,6 +90,16 @@ REQUIRED_MESH_METRICS = {
     "vllm:mesh_recovery_duration_seconds",
 }
 
+# Documented in the README ("Performance observability"); roofline
+# dashboards and the quiet-window A/B protocol read these names.
+REQUIRED_PERFWATCH_METRICS = {
+    "vllm:device_time_ms_per_step",
+    "vllm:mfu_est",
+    "vllm:hbm_bw_util_est",
+    "vllm:perfwatch_captures_total",
+    "vllm:perfwatch_captures_aborted_total",
+}
+
 
 def check() -> list[str]:
     """Return a list of lint errors (empty = clean)."""
@@ -167,6 +177,10 @@ def check() -> list[str]:
     for name in sorted(REQUIRED_SAMPLER_METRICS - set(seen)):
         errors.append(
             f"required sampler metric {name} is missing from "
+            f"the registry (documented in README)")
+    for name in sorted(REQUIRED_PERFWATCH_METRICS - set(seen)):
+        errors.append(
+            f"required perfwatch metric {name} is missing from "
             f"the registry (documented in README)")
 
     return errors

@@ -318,6 +318,19 @@ class ObservabilityConfig:
     otlp_traces_endpoint: str | None = None
     log_stats: bool = True
     log_stats_interval_s: float = 10.0
+    # Perfwatch (vllm_tpu/metrics/perfwatch.py): periodic in-engine
+    # profiling windows + quiet-window kernel A/B. 0 = disabled (the
+    # engine core then carries no perfwatch state at all; on-demand
+    # captures via POST /debug/perf/capture still work and lazily
+    # create the subsystem).
+    perfwatch_interval_s: float = 0.0
+    # Decode/prefill steps per profiling window.
+    perfwatch_capture_steps: int = 8
+    # Profiled steps per kernel variant in the quiet-window A/B.
+    perfwatch_ab_steps: int = 8
+    # Continuous idle seconds before the engine counts as "quiet"
+    # (eligible for an A/B replay).
+    perfwatch_quiet_settle_s: float = 2.0
 
 
 @dataclass

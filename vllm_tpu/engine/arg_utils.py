@@ -124,6 +124,12 @@ class EngineArgs:
     retry_after_s: float = 1.0
 
     disable_log_stats: bool = False
+    # Perfwatch: periodic in-engine profiling windows (0 = off; the
+    # /debug/perf/capture endpoint still works on demand).
+    perfwatch_interval_s: float = 0.0
+    perfwatch_capture_steps: int = 8
+    perfwatch_ab_steps: int = 8
+    perfwatch_quiet_settle_s: float = 2.0
     precompile: bool = False
     # Cap on token-bucket x request-bucket step compilations (derived
     # bucket ladders are thinned to fit; see CompilationConfig).
@@ -204,7 +210,11 @@ class EngineArgs:
                 max_loras=self.max_loras,
             ),
             observability_config=ObservabilityConfig(
-                log_stats=not self.disable_log_stats
+                log_stats=not self.disable_log_stats,
+                perfwatch_interval_s=self.perfwatch_interval_s,
+                perfwatch_capture_steps=self.perfwatch_capture_steps,
+                perfwatch_ab_steps=self.perfwatch_ab_steps,
+                perfwatch_quiet_settle_s=self.perfwatch_quiet_settle_s,
             ),
             compilation_config=CompilationConfig(
                 precompile=self.precompile,

@@ -387,6 +387,13 @@ class AsyncLLM:
         poll_mesh = getattr(self.engine_core, "poll_mesh", None)
         if poll_mesh is not None:
             poll_mesh()
+        # Perfwatch capture/A-B scheduling rides the same tick: this IS
+        # the engine loop thread, so a due quiet-window replay can step
+        # the engine right here without racing live traffic. (In-proc
+        # client only; MP engines poll in their own busy loop.)
+        poll_perfwatch = getattr(self.engine_core, "poll_perfwatch", None)
+        if poll_perfwatch is not None:
+            poll_perfwatch()
         if not self.engine_core.has_unfinished_requests():
             return stalled
         outputs = self.engine_core.get_output(timeout=0.2)

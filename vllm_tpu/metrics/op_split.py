@@ -22,14 +22,24 @@ import collections
 import glob
 import os
 
-PHASES = ("attention", "matmul", "sampler", "other")
+PHASES = ("attention", "matmul", "sampler", "comms", "other")
 
 # Ordered: first hit wins. Sampler kernels before attention — the
 # "tpu_custom_call" catch-all below would otherwise claim the fused
 # sampling kernel (it is a Pallas custom call too, but its time belongs
-# to the sampler budget). Attention before matmul — the attention
-# kernels contain dots but their time belongs to the attention budget.
+# to the sampler budget). Collectives before attention for the same
+# reason (a Pallas collective-permute kernel is a custom call too).
+# Attention before matmul — the attention kernels contain dots but
+# their time belongs to the attention budget.
 _SAMPLER_KERNEL_MARKS = ("fused_sampler_kernel", "sampler_kernel")
+_COMMS_MARKS = (
+    "all-reduce", "all_reduce", "allreduce",
+    "all-gather", "all_gather", "allgather",
+    "reduce-scatter", "reduce_scatter",
+    "collective-permute", "collective_permute",
+    "all-to-all", "all_to_all",
+    "ppermute", "psum",
+)
 _ATTENTION_MARKS = (
     "ragged_paged_attention",
     "decode_kernel",
@@ -47,12 +57,15 @@ _SAMPLER_MARKS = (
 
 
 def classify_op(name: str) -> str:
-    """Phase bucket ("attention" | "matmul" | "sampler" | "other") for a
-    device op name."""
+    """Phase bucket ("attention" | "matmul" | "sampler" | "comms" |
+    "other") for a device op name."""
     low = name.lower()
     for mark in _SAMPLER_KERNEL_MARKS:
         if mark in low:
             return "sampler"
+    for mark in _COMMS_MARKS:
+        if mark in low:
+            return "comms"
     for mark in _ATTENTION_MARKS:
         if mark in low:
             return "attention"
@@ -200,20 +213,55 @@ def iter_xla_ops(trace_dir: str):
             yield from events
 
 
+class OpSplitStream:
+    """Streaming-mode phase accumulator.
+
+    Feed device ops one at a time (``add(name, duration_ns)``) or whole
+    trace directories (``add_trace(dir)``); read the running attribution
+    at any point with ``split_ms()``. This is what the in-engine
+    perfwatch capture loop uses — it folds each short profiling window
+    into the stream as it closes instead of re-parsing an ever-growing
+    trace, and the offline ``op_split_ms`` below is the one-shot wrapper
+    over the same accumulator (same classifier, same rounding).
+    """
+
+    def __init__(self) -> None:
+        self.totals: dict[str, float] = collections.defaultdict(float)
+        self.ops = 0
+
+    def add(self, name: str, duration_ns: float) -> None:
+        self.totals[classify_op(name)] += duration_ns
+        self.ops += 1
+
+    def add_trace(self, trace_dir: str) -> int:
+        """Fold every leaf device op under ``trace_dir`` into the stream;
+        returns how many ops the trace contributed (0 = CPU backend)."""
+        before = self.ops
+        for name, ns in iter_xla_ops(trace_dir):
+            self.add(name, ns)
+        return self.ops - before
+
+    def split_ms(self, scale: float = 1.0) -> dict[str, float] | None:
+        """``{phase: ms}`` (+ ``total``) of everything streamed so far,
+        optionally scaled (e.g. ``1/steps`` for a per-step split); None
+        when no device op has been seen."""
+        if not self.ops:
+            return None
+        split = {
+            phase: round(self.totals.get(phase, 0.0) * scale / 1e6, 2)
+            for phase in PHASES
+        }
+        split["total"] = round(
+            sum(self.totals.values()) * scale / 1e6, 2)
+        return split
+
+
 def op_split_ms(trace_dir: str) -> dict[str, float] | None:
     """Aggregate a trace into ``{phase: ms}`` (+ ``total``), or None when
     the trace has no device ops (CPU backend)."""
-    totals: dict[str, float] = collections.defaultdict(float)
-    found = False
-    for name, ns in iter_xla_ops(trace_dir):
-        found = True
-        totals[classify_op(name)] += ns
-    if not found:
-        return None
-    split = {phase: round(totals.get(phase, 0.0) / 1e6, 2)
-             for phase in PHASES}
-    split["total"] = round(sum(totals.values()) / 1e6, 2)
-    return split
+    stream = OpSplitStream()
+    stream.add_trace(trace_dir)
+    return stream.split_ms()
 
 
 def profile_op_split(fn) -> dict[str, float] | None:

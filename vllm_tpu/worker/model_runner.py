@@ -96,6 +96,18 @@ class ModelRunner:
         sched = config.scheduler_config
         cache = config.cache_config
         self.block_size = cache.block_size
+        # Runtime kernel-dispatch toggles, seeded from the scheduler
+        # config; the perfwatch A/B flips them between variants via
+        # Worker.set_kernel_flags. enable_sampler_kernel must flow into
+        # the jitted step as a STATIC argument (a closure read would pin
+        # the value into every cached executable — flipping the config
+        # would silently keep serving the old kernel choice);
+        # enable_decode_attention only gates the already-static
+        # decode_only flag host-side.
+        self.enable_decode_attention = sched.enable_decode_attention
+        self.enable_sampler_kernel = sched.enable_sampler_kernel
+        # Perfwatch: most-recent live batch shape (A/B replays mirror it).
+        self.last_batch_shape: dict | None = None
 
         self.max_blocks_per_req = -(-sched.max_model_len // cache.block_size)
         # Device-resident empty placeholders (avoid a per-step 0-byte upload;
@@ -307,6 +319,7 @@ class ModelRunner:
                 "cascade_blocks",
                 "has_state_slots",
                 "decode_only",
+                "enable_sampler_kernel",
             ),
             donate_argnums=(1, 2) if self.draft_model is not None else (1,),
         )
@@ -636,6 +649,7 @@ class ModelRunner:
         cascade_blocks: int = 0,
         has_state_slots: int = 0,
         decode_only: bool = False,
+        enable_sampler_kernel: bool = True,
     ):
         (token_ids, md, sampling, feedback, grammar_rows, logit_adjust,
          draft_next, token_lora, plp_next, spec) = self._unpack(
@@ -848,7 +862,7 @@ class ModelRunner:
             needs_top_k=needs_top_k,
             needs_top_p_min_p=needs_top_p_min_p,
             needs_gumbel=needs_gumbel,
-            enable_kernel=self.config.scheduler_config.enable_sampler_kernel,
+            enable_kernel=enable_sampler_kernel,
             allow_interpret=True,
         )
         if num_decode_steps > 1:
@@ -890,9 +904,7 @@ class ModelRunner:
                     needs_top_k=needs_top_k,
                     needs_top_p_min_p=needs_top_p_min_p,
                     needs_gumbel=needs_gumbel,
-                    enable_kernel=(
-                        self.config.scheduler_config.enable_sampler_kernel
-                    ),
+                    enable_kernel=enable_sampler_kernel,
                     allow_interpret=True,
                 )
                 outs.append(tok)
@@ -1270,7 +1282,7 @@ class ModelRunner:
         # layout (token i IS row i, padding included) the
         # sequence-pipelined decode kernel requires.
         decode_only = (
-            self.config.scheduler_config.enable_decode_attention
+            self.enable_decode_attention
             and bool(r_live)
             and not so.scheduled_spec_decode_tokens
             and t_live == r_live
@@ -1292,6 +1304,17 @@ class ModelRunner:
         else:
             self._seen_buckets.add(bkey)
             self.bucket_compiles += 1
+        # Perfwatch batch-shape retention: the quiet-window A/B replays
+        # a synthetic batch mirroring the last real traffic shape.
+        # ctx proxy = the widest request's block footprint (what the
+        # attention kernel actually walks).
+        if r_live:
+            self.last_batch_shape = {
+                "num_reqs": r_live,
+                "num_tokens": t_live,
+                "decode_only": bool(decode_only),
+                "ctx_tokens_per_req": max_blocks * self.block_size,
+            }
 
         # Packed i32 buffer; layout must match _unpack.
         t, r, b = t_pad, r_pad, b_pad
@@ -1709,6 +1732,7 @@ class ModelRunner:
             # Cascade rewrites the attention call shape; keep such
             # batches on the general kernel.
             decode_only=decode_only and cascade_blocks == 0,
+            enable_sampler_kernel=self.enable_sampler_kernel,
         )
         self.step_launches += 1
         if flags["decode_only"]:
@@ -1724,9 +1748,7 @@ class ModelRunner:
             use_kernel, _ = sampler_kernel_eligible(
                 self.model.vocab_size,
                 needs_gumbel=True,
-                enable_kernel=(
-                    self.config.scheduler_config.enable_sampler_kernel
-                ),
+                enable_kernel=self.enable_sampler_kernel,
                 allow_interpret=True,
             )
             if use_kernel:
@@ -1839,7 +1861,7 @@ class ModelRunner:
         EAGLE chain): query at position p[row], same block tables. One
         token per row by construction, so the decode-specialized kernel
         is eligible whenever the config allows it."""
-        decode_ok = self.config.scheduler_config.enable_decode_attention
+        decode_ok = self.enable_decode_attention
         bs = self.block_size
         rows_r = jnp.arange(r_pad, dtype=jnp.int32)
         slot = md.block_tables[rows_r, p // bs] * bs + p % bs
@@ -2003,11 +2025,14 @@ class ModelRunner:
         forced_nan = fail_point(
             "model_runner.step", lambda: f"reqs={req_order}"
         ) == "nan"
-        (self.kv_cache, self.draft_kv, sampled, lp, drafts, pooled,
-         nan_count, prompt_lp, moe_counts, row_bad) = self._step_fn(
-            self.params, self.kv_cache, self.draft_kv, *arrays, prev,
-            mask_table, **mm_kwargs, **flags,
-        )
+        # The TraceAnnotation is a step marker for perfwatch profiling
+        # windows (an unstarted profiler makes it a no-op TraceMe).
+        with jax.profiler.TraceAnnotation("vllm_tpu.step_dispatch"):
+            (self.kv_cache, self.draft_kv, sampled, lp, drafts, pooled,
+             nan_count, prompt_lp, moe_counts, row_bad) = self._step_fn(
+                self.params, self.kv_cache, self.draft_kv, *arrays, prev,
+                mask_table, **mm_kwargs, **flags,
+            )
         if self._timing_enabled:
             self.timing["dispatch_s"] += time.perf_counter() - t1
             self.timing["steps"] += 1

@@ -1053,3 +1053,51 @@ class Worker:
 
         jax.profiler.stop_trace()
         logger.info("profiler stopped")
+
+    def set_kernel_flags(self, flags: dict) -> dict:
+        """Flip the runner's runtime kernel-dispatch toggles (perfwatch
+        A/B variants). Keys: ``enable_sampler_kernel``,
+        ``enable_decode_attention``. Returns the PREVIOUS values so the
+        caller can restore them."""
+        assert self.runner is not None
+        prev = {
+            "enable_sampler_kernel": self.runner.enable_sampler_kernel,
+            "enable_decode_attention": self.runner.enable_decode_attention,
+        }
+        if "enable_sampler_kernel" in flags:
+            self.runner.enable_sampler_kernel = bool(
+                flags["enable_sampler_kernel"])
+        if "enable_decode_attention" in flags:
+            self.runner.enable_decode_attention = bool(
+                flags["enable_decode_attention"])
+        return prev
+
+    def roofline_info(self) -> dict:
+        """The model's roofline parameters (msgpack-able; feeds the
+        perfwatch live MFU / HBM-bandwidth estimates — same math as
+        ``bench.py`` via ``vllm_tpu/metrics/roofline.py``)."""
+        from vllm_tpu.metrics import roofline as rf
+
+        assert self.params is not None
+        hf = load_hf_config(self.config.model_config)
+        wbytes = rf.weight_bytes(self.params)
+        logical = rf.logical_params(self.params)
+        vocab = int(getattr(hf, "vocab_size", 0) or 0)
+        hidden = int(getattr(hf, "hidden_size", 0) or 0)
+        active = max(0, logical - vocab * hidden)
+        heads = int(getattr(hf, "num_attention_heads", 1) or 1)
+        kv_heads = int(getattr(hf, "num_key_value_heads", heads) or heads)
+        head_dim = int(
+            getattr(hf, "head_dim", None) or (hidden // max(heads, 1))
+        )
+        layers = int(getattr(hf, "num_hidden_layers", 0) or 0)
+        kv_byte = (
+            1 if self.config.cache_config.cache_dtype == "fp8" else 2
+        )
+        return {
+            "weight_bytes": wbytes,
+            "active_params": active,
+            "kv_tok_bytes": rf.kv_bytes_per_token(
+                layers, kv_heads, head_dim, kv_byte),
+            "device_kind": getattr(self.device, "device_kind", ""),
+        }
