@@ -309,6 +309,13 @@ def main() -> None:
     # attention kernel's per-seq loop (the decode step's scalar-core
     # bottleneck candidate); sweepable via env.
     block_size = int(os.environ.get("VLLM_TPU_BENCH_BLOCK_SIZE", 16))
+    decode_steps_env = os.environ.get(
+        "VLLM_TPU_BENCH_DECODE_STEPS", "dynamic"
+    ).strip().lower()
+    decode_steps_dynamic = decode_steps_env == "dynamic"
+    if not decode_steps_dynamic:
+        # A numeric K scores the fixed unrolled chain in isolation.
+        os.environ["VLLM_TPU_DISABLE_DYNAMIC_DECODE"] = "1"
     blocks_16 = (
         None if shape["hidden_size"] < 1024
         else (
@@ -336,9 +343,12 @@ def main() -> None:
         # overhead; exact for greedy. Deepened 4 -> 8 alongside the
         # sequence-pipelined decode kernel: a faster device step raises
         # the fixed per-launch share, so deeper amortization pays more.
-        num_decode_steps=int(
-            os.environ.get("VLLM_TPU_BENCH_DECODE_STEPS", 8)
-        ),
+        # VLLM_TPU_BENCH_DECODE_STEPS accepts "dynamic" (default — the
+        # device-resident lax.while_loop path, chain-depth gate 8) or a
+        # numeric fixed K (which also disables the dynamic loop so the
+        # score really measures the fixed-K unrolled chain).
+        num_decode_steps=(8 if decode_steps_dynamic
+                          else int(decode_steps_env)),
     )
     # Warmup doubles as the fit check: one full dress-rehearsal pass
     # compiles every (tokens, reqs, blocks) bucket (the persistent
@@ -426,6 +436,30 @@ def main() -> None:
                 k: round(v / n * 1e3, 2) for k, v in tm.items()
             }
             extras["step_ms"]["wall"] = round(sum(times) / n * 1e3, 2)
+        # Which decode path was scored, and (dynamic mode) the realized
+        # per-launch step-length distribution: {realized K: launches},
+        # read from the scheduler's cumulative histogram. A distribution
+        # pinned at low K with distant stops means the loop exited on
+        # budget/bounds, not stop tokens — a tuning signal, not a bug.
+        extras["decode_mode"] = (
+            "dynamic" if decode_steps_dynamic
+            else f"fixed-{decode_steps_env}"
+        )
+        try:
+            hist = dict(
+                llm.llm_engine.engine_core.engine_core
+                .scheduler.decode_len_hist
+            )
+        except AttributeError:
+            hist = {}
+        if hist:
+            launches = sum(hist.values())
+            toks = sum(k * v for k, v in hist.items())
+            extras["decode_steps_realized"] = {
+                "launches": launches,
+                "mean": round(toks / launches, 2),
+                "hist": {str(k): v for k, v in sorted(hist.items())},
+            }
         # Device-side attention/matmul/sampler split of one profiled
         # pass (same classifier as tools/profile_decode.py —
         # vllm_tpu/metrics/op_split.py). attn_ms_per_layer divides the
@@ -447,8 +481,10 @@ def main() -> None:
                         / shape["num_hidden_layers"], 4)
         # In-engine quiet-window kernel A/B (perfwatch): the engine is
         # idle here (scoring passes done), so run the sampler-kernel /
-        # decode-attention on-vs-off replay against the retained batch
-        # shape and record the deltas next to the score they explain.
+        # decode-attention / dynamic-decode on-vs-off replay against the
+        # retained batch shape and record the deltas
+        # (ab.dynamic_decode.device_ms_{on,off} + delta_pct) next to the
+        # score they explain.
         if os.environ.get("VLLM_TPU_BENCH_AB", "1") != "0":
             try:
                 core = llm.llm_engine.engine_core.engine_core

@@ -1,0 +1,178 @@
+"""Dynamic multi-step decode: scheduler-side claim/reconcile accounting.
+
+Real AsyncScheduler, synthetic requests, no model (the protocol of
+``test_async_scheduler.py``). Covers the claim math near the
+max_model_len / max_tokens caps, full- and partial-realization
+reconciliation (the device loop exiting early on a stop), the
+in-flight gate, and the routing rules back to the fixed-K chain.
+"""
+
+from __future__ import annotations
+
+from vllm_tpu.config import CacheConfig, SchedulerConfig
+from vllm_tpu.core.async_scheduler import AsyncScheduler
+from vllm_tpu.core.sched_output import ModelRunnerOutput
+from vllm_tpu.request import EngineCoreRequest, Request
+from vllm_tpu.sampling_params import SamplingParams
+
+EOS = 2
+
+
+def make_scheduler(num_blocks=128, block_size=4, max_seqs=8, budget=256,
+                   max_model_len=128, kmax=128, cfg_k=8):
+    sched_cfg = SchedulerConfig(
+        max_num_batched_tokens=budget,
+        max_num_seqs=max_seqs,
+        max_model_len=max_model_len,
+        async_scheduling=True,
+        num_decode_steps=cfg_k,
+        max_decode_steps_per_launch=kmax,
+    )
+    cache_cfg = CacheConfig(block_size=block_size,
+                            enable_prefix_caching=False)
+    cache_cfg.num_gpu_blocks = num_blocks
+    return AsyncScheduler(sched_cfg, cache_cfg)
+
+
+def make_request(rid: str, prompt_len: int, max_tokens: int = 16,
+                 **params) -> Request:
+    params.setdefault("ignore_eos", True)
+    core = EngineCoreRequest(
+        request_id=rid,
+        prompt_token_ids=list(range(3, 3 + prompt_len)),
+        sampling_params=SamplingParams(max_tokens=max_tokens, **params),
+        eos_token_id=EOS,
+    )
+    return Request.from_engine_core_request(core, None)
+
+
+def run_out(so, tokens_per_req: dict[str, int] | int = 1,
+            token: int = 7) -> ModelRunnerOutput:
+    """Runner output realizing N tokens per scheduled request."""
+    rids = list(so.num_scheduled_tokens)
+    if isinstance(tokens_per_req, int):
+        tokens_per_req = {rid: tokens_per_req for rid in rids}
+    return ModelRunnerOutput(
+        req_ids=rids,
+        sampled_token_ids=[[token] * tokens_per_req[rid] for rid in rids],
+    )
+
+
+def prefill_to_decode(s, req):
+    """Admit + prefill + materialize the first sampled token, leaving the
+    request a plain decode row with no placeholders."""
+    s.add_request(req)
+    so = s.schedule()
+    assert so.num_scheduled_tokens[req.request_id] == req.num_prompt_tokens
+    s.update_from_output(so, run_out(so))
+    assert req.num_output_placeholders == 0
+    assert req.num_computed_tokens == req.num_tokens - 1
+
+
+def test_claim_capped_by_max_tokens_and_full_realization():
+    s = make_scheduler()
+    req = make_request("a", prompt_len=6, max_tokens=16)
+    prefill_to_decode(s, req)
+
+    so = s.schedule()
+    assert so.dynamic_decode
+    # 1 output token exists -> 15 of max_tokens remain; kmax (128) and
+    # model-len headroom (128 - 6 - 1) don't bind.
+    assert so.decode_claims == {"a": 15}
+    assert so.num_scheduled_tokens == {"a": 1}
+    # The full claim is placeholdered and computed advances to C + claim.
+    assert req.num_output_placeholders == 15
+    assert req.num_computed_tokens == 6 + 15
+
+    # In-flight gate: the row is untouchable until the claim reconciles.
+    assert s.schedule().total_num_scheduled_tokens == 0
+
+    s.update_from_output(so, run_out(so, 15))
+    assert req.num_output_placeholders == 0
+    assert req.num_output_tokens == 16
+    assert req.num_computed_tokens == req.num_tokens - 1
+    assert req.is_finished  # length-capped at max_tokens
+    assert s.decode_len_hist == {15: 1}
+    assert s._decode_early_exits == 0
+
+
+def test_claim_capped_by_max_model_len():
+    s = make_scheduler(max_model_len=64)
+    req = make_request("a", prompt_len=58, max_tokens=100)
+    prefill_to_decode(s, req)
+
+    so = s.schedule()
+    # Position headroom: 64 - 58(computed) - 1 = 5.
+    assert so.decode_claims == {"a": 5}
+    s.update_from_output(so, run_out(so, 5))
+    assert req.num_tokens == 64
+    assert req.num_computed_tokens == 63
+    assert req.is_finished
+
+
+def test_early_exit_rolls_back_and_continues():
+    s = make_scheduler()
+    req = make_request("a", prompt_len=6, max_tokens=16)
+    prefill_to_decode(s, req)
+
+    so = s.schedule()
+    assert so.decode_claims == {"a": 15}
+    # Device loop exited after 4 of 15 claimed steps (a stop hit): the
+    # unrealized 11 computed positions roll back, placeholders drain
+    # fully, and the invariant computed == num_tokens - 1 is restored.
+    s.update_from_output(so, run_out(so, 4))
+    assert req.num_output_placeholders == 0
+    assert req.num_tokens == 6 + 1 + 4
+    assert req.num_computed_tokens == req.num_tokens - 1
+    assert s._decode_early_exits == 1
+    assert s.decode_len_hist == {4: 1}
+
+    # The row schedules again with a shrunken max_tokens cap.
+    so2 = s.schedule()
+    assert so2.decode_claims == {"a": 16 - 5}
+    s.update_from_output(so2, run_out(so2, 11))
+    assert req.is_finished
+
+
+def test_wide_stop_set_routes_to_fixed_chain():
+    s = make_scheduler(cfg_k=4)
+    req = make_request("a", prompt_len=6, max_tokens=32,
+                       stop_token_ids=list(range(100, 109)))  # 9 > 8 lanes
+    prefill_to_decode(s, req)
+
+    so = s.schedule()
+    assert not so.dynamic_decode and not so.decode_claims
+    assert so.num_decode_steps == 4  # the fixed unrolled chain instead
+    assert req.num_output_placeholders == 4
+
+
+def test_disable_switch_routes_to_fixed_chain():
+    s = make_scheduler(cfg_k=4)
+    s.disable_dynamic_decode = True
+    req = make_request("a", prompt_len=6, max_tokens=32)
+    prefill_to_decode(s, req)
+
+    so = s.schedule()
+    assert not so.dynamic_decode
+    assert so.num_decode_steps == 4
+
+
+def test_mixed_rows_claim_independently():
+    s = make_scheduler()
+    a = make_request("a", prompt_len=6, max_tokens=4)
+    b = make_request("b", prompt_len=6, max_tokens=40)
+    s.add_request(a)
+    s.add_request(b)
+    so = s.schedule()  # both prefills fit one step's budget
+    assert so.num_scheduled_tokens == {"a": 6, "b": 6}
+    s.update_from_output(so, run_out(so))
+
+    so = s.schedule()
+    assert so.dynamic_decode
+    assert so.decode_claims == {"a": 3, "b": 39}
+    # Rows realize different lengths; each reconciles independently.
+    s.update_from_output(so, run_out(so, {"a": 3, "b": 20}))
+    assert a.is_finished
+    assert b.num_tokens == 6 + 1 + 20
+    assert b.num_computed_tokens == b.num_tokens - 1
+    assert sorted(s.decode_len_hist.items()) == [(3, 1), (20, 1)]

@@ -1,0 +1,140 @@
+"""Device-resident dynamic multi-step decode (in-jit lax.while_loop with
+on-device stop detection): outputs must be BIT-IDENTICAL to single-step
+decoding — including rows that stop mid-loop — and one launch must
+amortize far more than the fixed chain's K tokens when stops are far.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from tests.models.utils import tiny_llama_dir
+from vllm_tpu import LLM, SamplingParams
+
+
+@pytest.fixture(scope="module")
+def ckpt(tmp_path_factory):
+    return tiny_llama_dir(tmp_path_factory.mktemp("tiny_llama_dyn"))
+
+
+def _mk(ckpt, k=1, **kw):
+    return LLM(
+        model=ckpt, dtype="float32", max_model_len=128, block_size=16,
+        num_gpu_blocks_override=64, max_num_seqs=4,
+        max_num_batched_tokens=128, num_decode_steps=k, **kw,
+    )
+
+
+def _prompts(sizes, seed=0):
+    rng = np.random.default_rng(seed)
+    return [
+        {"prompt_token_ids": rng.integers(5, 120, size=n).tolist()}
+        for n in sizes
+    ]
+
+
+def _sched(llm):
+    return llm.llm_engine.engine_core.engine_core.scheduler
+
+
+def _runner(llm):
+    return llm.llm_engine.engine_core.engine_core.executor.worker.runner
+
+
+def test_seeded_sampling_bit_exact_vs_single_step(ckpt):
+    prompts = _prompts((5, 9, 3), seed=1)
+    sp = SamplingParams(
+        temperature=0.9, top_k=20, top_p=0.95, seed=11, max_tokens=40,
+        ignore_eos=True,
+    )
+    ref = [o.outputs[0].token_ids for o in _mk(ckpt).generate(prompts, sp)]
+    llm = _mk(ckpt, k=8)
+    got = [o.outputs[0].token_ids for o in llm.generate(prompts, sp)]
+    assert got == ref
+    # The dynamic loop actually ran (realized lengths recorded), and its
+    # launches ran deeper than the fixed chain's 8.
+    hist = _sched(llm).decode_len_hist
+    assert hist and max(hist) > 8
+
+
+def test_stop_token_mid_loop_bit_exact(ckpt):
+    """Rows stopping inside the device loop emit NO tokens past the stop
+    and match the single-step reference exactly (the on-device stop
+    detector and the host-side _check_stop agree)."""
+    prompts = _prompts((6, 11), seed=2)
+    sp = SamplingParams(temperature=0.0, max_tokens=48, ignore_eos=True)
+    ref = [o.outputs[0].token_ids for o in _mk(ckpt).generate(prompts, sp)]
+    # Pick stops from the reference stream itself so each row halts at a
+    # different mid-loop iteration.
+    stops = sorted({ref[0][7], ref[1][13]})
+    sp_stop = SamplingParams(
+        temperature=0.0, max_tokens=48, ignore_eos=True,
+        stop_token_ids=stops, include_stop_str_in_output=True,
+    )
+    ref_stop = [
+        o.outputs[0] for o in _mk(ckpt).generate(prompts, sp_stop)
+    ]
+    llm = _mk(ckpt, k=8)
+    got_stop = [o.outputs[0] for o in llm.generate(prompts, sp_stop)]
+    for g, r in zip(got_stop, ref_stop):
+        assert g.token_ids == r.token_ids
+        assert g.finish_reason == r.finish_reason
+    # At least one row genuinely stopped early (not length-capped), and
+    # no tokens ride past its stop token.
+    assert any(g.finish_reason == "stop" for g in got_stop)
+    for g in got_stop:
+        if g.finish_reason == "stop":
+            assert len(g.token_ids) < 48
+            assert g.token_ids[-1] in stops
+            assert not any(t in stops for t in g.token_ids[:-1])
+    assert _sched(llm)._decode_early_exits > 0
+
+
+def test_dynamic_vs_fixed_chain_bit_exact(ckpt):
+    """The escape hatch routes back to the fixed-K chain with identical
+    output (same seeds, same trims)."""
+    import os
+
+    import vllm_tpu.envs as envs
+
+    prompts = _prompts((7, 4), seed=3)
+    sp = SamplingParams(
+        temperature=0.8, top_k=16, seed=5, max_tokens=24, ignore_eos=True,
+    )
+    dyn = [o.outputs[0].token_ids
+           for o in _mk(ckpt, k=8).generate(prompts, sp)]
+    os.environ["VLLM_TPU_DISABLE_DYNAMIC_DECODE"] = "1"
+    envs.refresh()  # the lazy reader caches on first access
+    try:
+        llm = _mk(ckpt, k=8)
+        fixed = [o.outputs[0].token_ids for o in llm.generate(prompts, sp)]
+    finally:
+        os.environ.pop("VLLM_TPU_DISABLE_DYNAMIC_DECODE", None)
+        envs.refresh()
+    assert dyn == fixed
+    assert not _sched(llm).decode_len_hist  # dynamic never engaged
+
+
+def test_tokens_per_launch_scales_past_fixed_k(ckpt):
+    """With stops far away, one dynamic launch emits ~the whole decode
+    run per row: tokens/launch blows past the fixed chain's 8 x batch
+    ceiling and the realized-K telemetry is populated."""
+    prompts = _prompts((6, 9), seed=4)
+    sp = SamplingParams(temperature=0.0, max_tokens=100, ignore_eos=True)
+    llm = _mk(ckpt, k=8)
+    outs = llm.generate(prompts, sp)
+    assert all(len(o.outputs[0].token_ids) == 100 for o in outs)
+
+    runner = _runner(llm)
+    assert runner.step_launches > 0
+    per_launch = runner.launch_sampled_tokens / runner.step_launches
+    assert per_launch > 8 * len(prompts)
+
+    hist = _sched(llm).decode_len_hist
+    assert hist and max(hist) > 8
+    # Realized counts account for every decode-loop token: total output
+    # minus the per-row prefill sample.
+    realized = sum(k * v for k, v in hist.items())
+    assert realized == sum(
+        len(o.outputs[0].token_ids) for o in outs) - len(prompts)

@@ -79,25 +79,32 @@ def test_feature_request_disables_chaining(ckpt):
 
 def test_staggered_arrivals(ckpt):
     """Requests admitted at different times (prefill interleaves with
-    chained decode) still match single-step output."""
+    chained decode) still match single-step output. The per-launch
+    dynamic budget is capped so the early arrivals are still mid-decode
+    when the late ones prefill (an uncapped dynamic loop would finish a
+    12-token request within the first few launches)."""
     sp = SamplingParams(temperature=0.0, max_tokens=12, ignore_eos=True)
     prompts = _prompts((9, 14, 5, 11), seed=4)
 
     def run(k):
-        llm = _mk(ckpt, k=k)
+        llm = _mk(ckpt, k=k, max_decode_steps_per_launch=4)
         eng = llm.llm_engine
+        outs = {}
+
+        def drain(step_outs):
+            for o in step_outs:
+                if o.finished:
+                    outs[o.request_id] = o.outputs[0].token_ids
+
         # Feed the first two, step a few times, then feed the rest.
         for i, p in enumerate(prompts[:2]):
             eng.add_request(str(i), p, sp)
         for _ in range(3):
-            eng.step()
+            drain(eng.step())
         for i, p in enumerate(prompts[2:], start=2):
             eng.add_request(str(i), p, sp)
-        outs = {}
         while eng.has_unfinished_requests():
-            for o in eng.step():
-                if o.finished:
-                    outs[o.request_id] = o.outputs[0].token_ids
+            drain(eng.step())
         return [outs[str(i)] for i in range(4)]
 
     assert run(4) == run(1)

@@ -99,6 +99,9 @@ def run_smoke() -> int:
     llm = LLM(
         model="dummy-llama", hf_config=cfg, load_format="dummy",
         max_model_len=512, max_num_batched_tokens=256, max_num_seqs=4,
+        # Multi-step on so the A/B also exercises its dynamic-decode
+        # (device while_loop) on/off variant.
+        num_decode_steps=4,
     )
     prompts = [
         {"prompt_token_ids": [(7 * i + j) % 1000 for j in range(8)]}
@@ -112,7 +115,7 @@ def run_smoke() -> int:
     assert result.get("error") is None, result
     assert result["aborted"] is False, result
     ab = result["ab"]
-    for kernel in ("sampler_kernel", "decode_attention"):
+    for kernel in ("sampler_kernel", "decode_attention", "dynamic_decode"):
         d = ab[kernel]
         for key in ("device_ms_on", "device_ms_off", "delta_pct",
                     "wall_ms_on", "wall_ms_off", "source"):

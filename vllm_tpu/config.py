@@ -226,6 +226,19 @@ class SchedulerConfig:
     # greedy and seeded sampling; steps carrying prefill, spec, pooling,
     # grammar, logprobs, or logits processors fall back to 1.
     num_decode_steps: int = 1
+    # Device-resident dynamic multi-step decode: when a multi-step launch
+    # is eligible (num_decode_steps > 1 and every row passes the same
+    # plain-decode gate as fixed K), the jitted step runs a lax.while_loop
+    # with ON-DEVICE stop detection — per-row eos/stop-token-id match
+    # (gated on min_tokens) and per-row max_tokens / max_model_len bounds
+    # — exiting early once every row has finished. One launch then emits
+    # up to this many tokens per row instead of exactly num_decode_steps.
+    # This is the host-interaction budget: larger values amortize more
+    # per-launch overhead but lengthen the worst-case latency to the next
+    # host touch (streaming chunks, aborts). 0 disables the dynamic loop
+    # (fixed-K unrolled chain only); the VLLM_TPU_DISABLE_DYNAMIC_DECODE
+    # env is the no-restart escape hatch for the same switch.
+    max_decode_steps_per_launch: int = 128
     # Decode-specialized attention: batches where every row is a pure
     # decode (one query token) dispatch to the sequence-pipelined kernel
     # (ops/rpa_decode_kernel.py) instead of the general ragged kernel.
@@ -260,6 +273,37 @@ class SchedulerConfig:
     def __post_init__(self) -> None:
         if self.max_num_batched_tokens < 1:
             raise ValueError("max_num_batched_tokens must be >= 1")
+        if self.max_decode_steps_per_launch < 0:
+            raise ValueError("max_decode_steps_per_launch must be >= 0")
+
+    def validate_decode_steps(
+        self, *, spec_enabled: bool, needs_mrope: bool = False
+    ) -> None:
+        """Single source of truth for multi-step-decode compatibility.
+
+        Called once at ``EngineConfig.finalize`` (config-time facts) and
+        again by the worker after model load (m-rope is a trait of the
+        resolved model class, unknowable at config time). Both call sites
+        share this one implementation so the checks and messages cannot
+        drift apart.
+        """
+        if self.num_decode_steps <= 1:
+            return
+        if spec_enabled:
+            raise ValueError(
+                "num_decode_steps > 1 is incompatible with speculative "
+                "decoding: spec already emits multiple tokens per launch, "
+                "and its in-jit draft/verify chain owns the device loop "
+                "that both fixed-K and dynamic multi-step decode would "
+                "occupy"
+            )
+        if needs_mrope:
+            raise ValueError(
+                "m-rope models (Qwen2-VL) do not support "
+                "num_decode_steps > 1 yet (neither the unrolled decode "
+                "chain nor the dynamic lax.while_loop threads the mrope "
+                "delta across in-loop positions)"
+            )
 
 
 @dataclass
@@ -456,11 +500,7 @@ class EngineConfig:
         self.compilation_config.finalize(sc)
         if self.speculative_config.enabled and self.parallel_config.pipeline_parallel_size > 1:
             raise ValueError("speculative decoding is incompatible with pipeline parallelism")
-        if self.speculative_config.enabled and sc.num_decode_steps > 1:
-            raise ValueError(
-                "num_decode_steps > 1 is incompatible with speculative "
-                "decoding (spec already emits multiple tokens per step)"
-            )
+        sc.validate_decode_steps(spec_enabled=self.speculative_config.enabled)
         return self
 
     def compute_hash(self) -> str:

@@ -31,7 +31,13 @@ class AsyncScheduler(Scheduler):
             # This step samples output token(s) not yet known host-side.
             # In-jit multi-step decode samples K per launch; the chained
             # tokens' KV is written in-jit, so computed advances with them.
-            k = getattr(self, "_decode_k", 1)
+            # Dynamic multi-step claims a per-request budget instead of a
+            # global K; update_from_output rolls back whatever the device
+            # loop did not realize.
+            k = (
+                getattr(self, "_decode_claims", {}).get(request.request_id)
+                or getattr(self, "_decode_k", 1)
+            )
             request.num_output_placeholders += k
             request.num_computed_tokens += k - 1
             request.num_inflight_steps += 1

@@ -11,6 +11,12 @@ from typing import Any
 
 from vllm_tpu.sampling_params import SamplingParams
 
+# Dynamic multi-step decode ships each row's stop set (eos + stop token
+# ids) to the device as a fixed-width [rows, MAX_DYNAMIC_STOP_IDS] i32
+# lane, padded with -1. The scheduler routes requests with wider stop
+# sets to the fixed-K unrolled chain instead.
+MAX_DYNAMIC_STOP_IDS = 8
+
 
 @dataclass
 class NewRequestData:
@@ -64,6 +70,14 @@ class SchedulerOutput:
     preempted_req_ids: set[str] = field(default_factory=set)
     # In-jit multi-step decode: tokens sampled per request this step.
     num_decode_steps: int = 1
+    # Device-resident dynamic multi-step decode: when True the runner runs
+    # the lax.while_loop body with on-device stop detection instead of the
+    # fixed-K unrolled chain; decode_claims carries the per-request step
+    # budget (<= max_decode_steps_per_launch, bounded per row by
+    # max_tokens / max_model_len headroom). The realized per-row length
+    # comes back through ModelRunnerOutput.sampled_token_ids.
+    dynamic_decode: bool = False
+    decode_claims: dict[str, int] = field(default_factory=dict)
     # KV connector: req_id -> (device block ids, content keys) to LOAD
     # into the cache before this step runs (saves flow separately via an
     # eager engine->worker RPC at free time).
@@ -188,6 +202,13 @@ class SchedulerStats:
     # reference path (all-greedy launches count as neither).
     sampler_kernel_launches: int = 0
     sampler_fallback_rows: int = 0
+    # Dynamic multi-step decode: per-request realized step counts of
+    # dynamic launches that completed this snapshot (drained each
+    # snapshot — feeds the vllm:decode_steps_per_launch histogram), and
+    # the cumulative count of dynamic launches that exited the device
+    # loop before exhausting their claimed budget (a row stopped early).
+    decode_step_lengths: list[int] = field(default_factory=list)
+    decode_early_exits: int = 0
     # Engine-step phase durations (drained each snapshot, seconds) —
     # attached by EngineCore from the schedule/dispatch/finalize sites;
     # feed the vllm:engine_step_duration_seconds histogram family.

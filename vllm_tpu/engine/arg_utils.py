@@ -57,6 +57,7 @@ class EngineArgs:
     scheduling_policy: str = "fcfs"
     async_scheduling: bool = True
     num_decode_steps: int = 1
+    max_decode_steps_per_launch: int = 128
     encoder_cache_budget: int = 4096
     enable_cascade_attention: bool = False
     enable_decode_attention: bool = True
@@ -189,6 +190,7 @@ class EngineArgs:
                 policy=self.scheduling_policy,  # type: ignore[arg-type]
                 async_scheduling=self.async_scheduling,
                 num_decode_steps=self.num_decode_steps,
+                max_decode_steps_per_launch=self.max_decode_steps_per_launch,
                 encoder_cache_budget=self.encoder_cache_budget,
                 enable_cascade_attention=self.enable_cascade_attention,
                 enable_decode_attention=self.enable_decode_attention,

@@ -880,10 +880,22 @@ class EngineCore:
         sched_cfg = self.config.scheduler_config
         num_reqs = max(1, min(int(shape.get("num_reqs", 4)),
                               sched_cfg.max_num_seqs))
+        # Dynamic multi-step decode A/B: only meaningful when the serving
+        # config can engage the device loop at all (multi-step on, a
+        # per-launch budget > 1, and the async pipeline dynamic needs).
+        dyn_capable = (sched_cfg.num_decode_steps > 1
+                       and sched_cfg.max_decode_steps_per_launch > 1
+                       and self.async_scheduling)
         # Prompt length approximates the retained context depth, bounded
-        # so prompt + replay decodes fit the model length.
-        max_tokens = max(steps * max(sched_cfg.num_decode_steps, 1) + 32,
-                         64)
+        # so prompt + replay decodes fit the model length. Dynamic-on
+        # variants may realize up to the per-launch budget each step, so
+        # size max_tokens for the larger of the two amortization knobs —
+        # rows finishing by length mid-window would deflate the batch.
+        per_launch = max(sched_cfg.num_decode_steps, 1)
+        if dyn_capable:
+            per_launch = max(per_launch,
+                             sched_cfg.max_decode_steps_per_launch)
+        max_tokens = max(steps * per_launch + 32, 64)
         prompt_len = max(8, min(
             int(shape.get("ctx_tokens_per_req", 64)),
             sched_cfg.max_model_len - max_tokens - 1,
@@ -898,11 +910,23 @@ class EngineCore:
             "decode_attn_off": {"enable_sampler_kernel": True,
                                 "enable_decode_attention": False},
         }
+        if dyn_capable:
+            # Kernel flags stay at serving defaults; the off-switch is
+            # the scheduler's A/B attribute (no worker RPC — routing
+            # back to the fixed-K chain is a schedule-time decision).
+            variants["dynamic_off"] = {"enable_sampler_kernel": True,
+                                       "enable_decode_attention": True,
+                                       "_disable_dynamic": True}
         measured: dict[str, dict] = {}
         aborted_reason: str | None = None
         prev_flags = None
+        prev_dyn = self.scheduler.disable_dynamic_decode
         try:
-            for name, flags in variants.items():
+            for name, spec in variants.items():
+                flags = {k: v for k, v in spec.items()
+                         if not k.startswith("_")}
+                self.scheduler.disable_dynamic_decode = bool(
+                    spec.get("_disable_dynamic", prev_dyn))
                 prev = self.executor.collective_rpc(
                     "set_kernel_flags", flags)[0]
                 if prev_flags is None:
@@ -973,6 +997,7 @@ class EngineCore:
                 pass
             aborted_reason = f"error: {exc}"
         finally:
+            self.scheduler.disable_dynamic_decode = prev_dyn
             if prev_flags is not None:
                 self.executor.collective_rpc(
                     "set_kernel_flags", prev_flags)
@@ -1008,6 +1033,8 @@ class EngineCore:
                 "num_reqs": num_reqs,
                 "prompt_len": prompt_len,
                 "num_decode_steps": sched_cfg.num_decode_steps,
+                "max_decode_steps_per_launch":
+                    sched_cfg.max_decode_steps_per_launch,
             },
             "split_on": measured.get("on", {}).get("split"),
             "ab": {
@@ -1015,6 +1042,11 @@ class EngineCore:
                 "decode_attention": pair("decode_attn_off"),
             },
         }
+        if "dynamic_off" in measured:
+            # Per-step device time with the in-jit dynamic decode loop vs
+            # the fixed-K chain; note the ON side amortizes many tokens
+            # per launch, so compare per-TOKEN cost when interpreting.
+            result["ab"]["dynamic_decode"] = pair("dynamic_off")
         logger.info("perfwatch A/B: %s", result["ab"])
         return pw.note_ab(result)
 

@@ -197,6 +197,10 @@ class PerfWatch:
         sess, self.active = self.active, None
         dt = max(self._clock() - sess["t0"], 1e-9)
         c0, c1 = sess["counters0"], dict(counters or {})
+        # launch_sampled_tokens counts REALIZED emissions (finalize-side
+        # accumulation) — exact under dynamic multi-step decode, where a
+        # launch's per-row token run varies with on-device stop exits; a
+        # fixed rows*K estimate here would overstate tok_per_s.
         tokens = max(0, c1.get("launch_sampled_tokens", 0)
                      - c0.get("launch_sampled_tokens", 0))
         launches = max(0, c1.get("step_launches", 0)

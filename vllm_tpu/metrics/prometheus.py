@@ -235,6 +235,21 @@ class PrometheusRegistry:
             "vllm:sampler_fallback_rows_total",
             "Sampling (non-greedy) rows sampled by the XLA reference path "
             "because the fused sampling kernel was ineligible or disabled")
+        # Dynamic multi-step decode: realized per-request step counts of
+        # device-resident lax.while_loop launches (how far each row ran
+        # before an on-device stop / budget exit), and launches that
+        # exited before exhausting their claimed step budget.
+        self.decode_steps_per_launch = Histogram(
+            "vllm:decode_steps_per_launch",
+            "Realized per-request decode steps of a dynamic multi-step "
+            "launch (device loop iterations a row consumed before stop "
+            "detection or the per-launch budget ended it)",
+            [1.0, 2.0, 4.0, 8.0, 16.0, 32.0, 64.0, 96.0, 128.0, 256.0])
+        self.decode_early_exits = Counter(
+            "vllm:decode_early_exits_total",
+            "Dynamic decode launches whose device loop exited before the "
+            "claimed per-request step budget (a row hit a stop token or "
+            "all rows finished)")
         self.request_success = LabeledCounter(
             "vllm:request_success_total",
             "Finished requests by reason", "finished_reason")
@@ -413,6 +428,7 @@ class PrometheusRegistry:
             self.decode_batch_ratio, self.tokens_per_launch,
             self.prep_fallback_rows,
             self.sampler_kernel_launches, self.sampler_fallback_rows,
+            self.decode_steps_per_launch, self.decode_early_exits,
             self.request_success,
             self.step_duration, self.batch_tokens, self.batch_requests,
             self.batch_occupancy, self.step_interval,
@@ -443,6 +459,7 @@ class PrometheusRegistry:
         self._last_prep_fallback = 0
         self._last_sampler_kernel = 0
         self._last_sampler_fallback = 0
+        self._last_decode_early_exits = 0
 
     # StatLoggerBase interface -----------------------------------------
 
@@ -491,6 +508,11 @@ class PrometheusRegistry:
             self.sampler_fallback_rows.inc(
                 max(0, s.sampler_fallback_rows - self._last_sampler_fallback))
             self._last_sampler_fallback = s.sampler_fallback_rows
+            for n in s.decode_step_lengths:
+                self.decode_steps_per_launch.observe(n)
+            self.decode_early_exits.inc(
+                max(0, s.decode_early_exits - self._last_decode_early_exits))
+            self._last_decode_early_exits = s.decode_early_exits
             for t in s.step_schedule_times:
                 self.step_duration.observe("schedule", t)
             for t in s.step_dispatch_times:

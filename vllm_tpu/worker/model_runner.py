@@ -24,7 +24,11 @@ import jax.numpy as jnp
 import numpy as np
 
 from vllm_tpu.config import EngineConfig
-from vllm_tpu.core.sched_output import ModelRunnerOutput, SchedulerOutput
+from vllm_tpu.core.sched_output import (
+    MAX_DYNAMIC_STOP_IDS,
+    ModelRunnerOutput,
+    SchedulerOutput,
+)
 from vllm_tpu.logger import init_logger
 from vllm_tpu.ops.attention import AttentionMetadata
 from vllm_tpu.resilience.failpoints import fail_point
@@ -43,12 +47,21 @@ class StepHandle:
     """A dispatched-but-not-fetched step (device arrays + row bookkeeping)."""
 
     def __init__(self, req_order=None, do_sample=None, sampled=None, lp=None,
-                 row_states=None, empty: bool = False, spec: bool = False) -> None:
+                 row_states=None, empty: bool = False, spec: bool = False,
+                 dynamic: bool = False) -> None:
         self.req_order = req_order or []
         self.do_sample = do_sample
         self.sampled = sampled  # [R] ids, or (out_tokens [R,S+1], num_out [R])
         self.lp = lp
         self.spec = spec
+        # Dynamic multi-step decode: sampled is (out_tokens [R, Kmax],
+        # num_out [R]) with per-row REALIZED lengths (the device loop
+        # stopped each row at its stop token or claimed budget).
+        self.dynamic = dynamic
+        # Deferred sampler-routing accounting for dynamic launches:
+        # (use_kernel, nongreedy_rows) — the realized step count is only
+        # known at finalize.
+        self.dyn_sampler_acct = None
         # CachedRequestState identities at dispatch time: finalize only folds
         # a token into a row still owned by the same request instance (the
         # id may have been reused while this step was in flight).
@@ -316,6 +329,7 @@ class ModelRunner:
                 "num_adj",
                 "num_allow",
                 "num_decode_steps",
+                "dynamic_decode",
                 "cascade_blocks",
                 "has_state_slots",
                 "decode_only",
@@ -408,6 +422,9 @@ class ModelRunner:
         # XLA reference (greedy-only launches count as neither).
         self.sampler_kernel_launches = 0
         self.sampler_fallback_rows = 0
+        # Deferred sampler accounting for the in-flight dynamic launch
+        # (set by _prepare_inputs, moved onto the StepHandle by dispatch).
+        self._dyn_sampler_acct = None
         self.timing = {"prep_s": 0.0, "dispatch_s": 0.0, "wait_s": 0.0,
                        "steps": 0}
 
@@ -417,7 +434,8 @@ class ModelRunner:
 
     def _unpack(self, ibuf, fbuf, counts, prompt_mask, t, r, b, num_spec=0,
                 num_adj=0, num_allow=0, num_prompt_logprobs=0,
-                cascade_blocks=0, has_state_slots=0, decode_only=False):
+                cascade_blocks=0, has_state_slots=0, decode_only=False,
+                dynamic_decode=False):
         """Split the two packed host buffers back into metadata pytrees.
 
         One contiguous i32 upload + one f32 upload per step instead of ~12
@@ -484,6 +502,18 @@ class ModelRunner:
         if has_state_slots:
             # Hybrid attention+SSM: per-request Mamba state slot.
             md.state_slots = take(r)
+        dyn = None
+        if dynamic_decode:
+            # Dynamic multi-step decode: per-row stop set (-1 pads), step
+            # budget (0 on padding rows -> done before the loop body ever
+            # runs), and min_tokens floor for the in-loop stop check.
+            dyn = (
+                take(r * MAX_DYNAMIC_STOP_IDS).reshape(
+                    r, MAX_DYNAMIC_STOP_IDS
+                ),
+                take(r),
+                take(r),
+            )
         adj_vals = (
             fbuf[6 * r : 6 * r + r * num_adj].reshape(r, num_adj)
             if num_adj
@@ -503,7 +533,7 @@ class ModelRunner:
         )
         logit_adjust = (adj_ids, adj_vals, allow_ids, allow_active)
         return (token_ids, md, sampling, feedback, grammar_rows, logit_adjust,
-                draft_next, token_lora, plp_next, spec)
+                draft_next, token_lora, plp_next, spec, dyn)
 
     def _build_tree_metadata(self, md, spec, t_pad: int, r_pad: int):
         """In-jit tree-verify views (host prep stays the chain layout).
@@ -646,16 +676,17 @@ class ModelRunner:
         num_adj: int = 0,
         num_allow: int = 0,
         num_decode_steps: int = 1,
+        dynamic_decode: bool = False,
         cascade_blocks: int = 0,
         has_state_slots: int = 0,
         decode_only: bool = False,
         enable_sampler_kernel: bool = True,
     ):
         (token_ids, md, sampling, feedback, grammar_rows, logit_adjust,
-         draft_next, token_lora, plp_next, spec) = self._unpack(
+         draft_next, token_lora, plp_next, spec, dyn) = self._unpack(
             ibuf, fbuf, counts, prompt_mask, t_pad, r_pad, b_pad, num_spec,
             num_adj, num_allow, num_prompt_logprobs, cascade_blocks,
-            has_state_slots, decode_only,
+            has_state_slots, decode_only, dynamic_decode,
         )
         # Device-side token feedback (async scheduling): a decode row whose
         # input token was sampled by the still-in-flight previous step reads
@@ -865,6 +896,118 @@ class ModelRunner:
             enable_kernel=enable_sampler_kernel,
             allow_interpret=True,
         )
+        if dynamic_decode:
+            # Device-resident dynamic multi-step decode: a lax.while_loop
+            # whose condition does ON-DEVICE stop detection. Each
+            # iteration runs the single-position body over all rows; a
+            # row finishes when its fresh token hits the row's stop set
+            # (eos + stop_token_ids, min_tokens-gated) or its claimed
+            # step budget (max_tokens / max_model_len headroom, bounded
+            # host-side). The loop exits once every row is done — one
+            # launch emits up to num_decode_steps (= the host-interaction
+            # budget) tokens per row with zero host roundtrips between
+            # them. Scheduler guarantees every row is a plain decode.
+            from dataclasses import replace as _dreplace
+
+            from vllm_tpu.sample.sampler import stop_token_hit
+
+            stop_ids, max_steps, min_out = dyn
+            kmax = num_decode_steps
+            rows_r = jnp.arange(r_pad, dtype=jnp.int32)
+            pos0 = md.positions[md.logits_indices]
+            row_lora = (
+                token_lora[md.logits_indices]
+                if token_lora is not None
+                else None
+            )
+            out0 = jnp.zeros((r_pad, kmax), jnp.int32).at[:, 0].set(sampled)
+            n_out0 = jnp.ones(r_pad, jnp.int32)
+            # Padding rows ship max_steps 0 -> done before the body runs.
+            done0 = stop_token_hit(sampled, stop_ids, n_out0, min_out) | (
+                n_out0 >= max_steps
+            )
+
+            def _cond(carry):
+                _, k, _, _, _, done, _ = carry
+                return (k < kmax) & ~jnp.all(done)
+
+            def _body(carry):
+                kv, k, tok, out, n_out, done, moe = carry
+                # Each row's query sits at its own realized position; done
+                # rows stop advancing (their n_out is frozen).
+                md_k = self._single_pos_metadata(md, pos0 + n_out, r_pad)
+                # Done rows park their KV write in the null block (slot 0
+                # — write-only garbage, the padding convention): their
+                # frozen position's slot already holds trusted KV that a
+                # re-write with a stale token would poison.
+                md_k = _dreplace(
+                    md_k,
+                    slot_mapping=jnp.where(done, 0, md_k.slot_mapping),
+                )
+                out_k = self.model.apply(
+                    params, kv, tok, md_k, token_lora_slot=row_lora
+                )
+                if self._eplb:
+                    hidden_k, kv, counts_k = out_k
+                    moe = moe + counts_k
+                else:
+                    hidden_k, kv = out_k
+                logits_k = self.model.compute_logits(params, hidden_k)
+                # Global k == the row's output index for every live row (a
+                # row emits on every iteration until done), so seeded
+                # streams match the fixed-K chain bit-for-bit.
+                sampling_k = _dreplace(
+                    sampling,
+                    prng_keys=sampling.prng_keys.at[:, 1].add(
+                        k.astype(sampling.prng_keys.dtype)
+                    ),
+                )
+                # allow_interpret=False: Pallas interpret mode does not
+                # discharge inside lax.while_loop on jax 0.4.37 (see
+                # tests/pallas_compat.py); off-TPU the XLA reference path
+                # is bit-identical anyway.
+                tok_new, _ = dispatch_sample(
+                    logits_k,
+                    sampling_k,
+                    needs_penalties=False,
+                    needs_top_k=needs_top_k,
+                    needs_top_p_min_p=needs_top_p_min_p,
+                    needs_gumbel=needs_gumbel,
+                    enable_kernel=enable_sampler_kernel,
+                    allow_interpret=False,
+                )
+                run = ~done
+                # Done rows scatter to column kmax (dropped).
+                col = jnp.where(run, n_out, kmax)
+                out = out.at[rows_r, col].set(tok_new, mode="drop")
+                n_out = n_out + run.astype(jnp.int32)
+                tok = jnp.where(run, tok_new, tok)
+                done = done | (
+                    run
+                    & (
+                        stop_token_hit(tok_new, stop_ids, n_out, min_out)
+                        | (n_out >= max_steps)
+                    )
+                )
+                return (kv, k + 1, tok, out, n_out, done, moe)
+
+            (kv_cache, _, _, out_tokens, num_out, _, moe_counts) = (
+                jax.lax.while_loop(
+                    _cond,
+                    _body,
+                    (
+                        kv_cache,
+                        jnp.int32(1),
+                        sampled,
+                        out0,
+                        n_out0,
+                        done0,
+                        moe_counts,
+                    ),
+                )
+            )
+            return (kv_cache, draft_kv, (out_tokens, num_out), None, None,
+                    None, nan_count, None, moe_counts, row_bad)
         if num_decode_steps > 1:
             # In-jit multi-step decode: chain K-1 more single-position
             # iterations, feeding each sampled token back device-side.
@@ -1398,10 +1541,22 @@ class ModelRunner:
         # + top_k(r) + prng(2r) + feedback(r) + grammar_rows(r)
         # [+ adj_ids(r*num_adj)] [+ allow_ids(r*num_allow) + allow_flag(r)]
         # [+ num_draft(r) + draft(r*s) + sample_pos(r*(s+1))]
+        # [+ state_slots(r)] [+ stop_ids(r*8) + max_steps(r) + min_out(r)]
         state_len = r if self._is_hybrid else 0
+        # Device-resident dynamic multi-step decode: active when the
+        # scheduler claimed per-row step budgets. Runner-side fallback for
+        # hybrid-state models (SSM / cross-attention slots): a done row
+        # cannot park its per-request STATE write the way KV parks in the
+        # null block, so those models stay on the fixed chain — the
+        # scheduler's full-claim reconciliation is realized-length based
+        # and stays correct when fewer tokens come back.
+        dynamic = bool(
+            so.dynamic_decode and so.decode_claims and not self._is_hybrid
+        )
+        dyn_len = r * (MAX_DYNAMIC_STOP_IDS + 2) if dynamic else 0
         ibuf = np.zeros(
             4 * t + 7 * r + (r + 1) + 1 + r * b + lp_len + eagle_len
-            + lora_len + plp_len + spec_len + state_len,
+            + lora_len + plp_len + spec_len + state_len + dyn_len,
             np.int32,
         )
         token_ids = ibuf[0:t]
@@ -1461,6 +1616,32 @@ class ModelRunner:
             state_slots[:] = self.config.scheduler_config.max_num_seqs
             for i, rid in enumerate(req_order):
                 state_slots[i] = self._state_slot_of[rid]
+        if dynamic:
+            w = MAX_DYNAMIC_STOP_IDS
+            dyn_stop_ids = ibuf[o : o + r * w].reshape(r, w); o += r * w
+            dyn_stop_ids[:] = -1  # sampled ids are >= 0: pads never match
+            dyn_max_steps = ibuf[o : o + r]; o += r  # 0 pads -> done row
+            dyn_min_out = ibuf[o : o + r]; o += r
+            for i, rid in enumerate(req_order):
+                state = batch.req_states[rid]
+                p = state.sampling_params
+                stops: list[int] = []
+                if not p.ignore_eos and state.eos_token_id is not None:
+                    stops.append(int(state.eos_token_id))
+                for tok_id in p.all_stop_token_ids:
+                    if tok_id not in stops:
+                        stops.append(int(tok_id))
+                # The scheduler routes wider stop sets to the fixed
+                # chain; a truncated set only over-generates — the host
+                # fold trims past the first stop either way.
+                stops = stops[:w]
+                dyn_stop_ids[i, : len(stops)] = stops
+                dyn_max_steps[i] = so.decode_claims[rid]
+                # min_tokens rows never reach the dynamic path (the
+                # plain-decode gate excludes logits processors), so the
+                # floor is 0 — the lane keeps the device contract
+                # explicit for future relaxations of that gate.
+                dyn_min_out[i] = 0
         token_req_idx[:] = max(r_pad - 1, 0)
         do_sample = np.zeros(r_pad, bool)
 
@@ -1728,7 +1909,15 @@ class ModelRunner:
             has_state_slots=int(self._is_hybrid),
             num_adj=num_adj,
             num_allow=num_allow,
-            num_decode_steps=so.num_decode_steps,
+            # Dynamic decode reuses num_decode_steps as the LOOP BOUND
+            # (the host-interaction budget) — a config constant, so the
+            # dynamic trace compiles exactly once per batch shape.
+            num_decode_steps=(
+                self.config.scheduler_config.max_decode_steps_per_launch
+                if dynamic
+                else so.num_decode_steps
+            ),
+            dynamic_decode=dynamic,
             # Cascade rewrites the attention call shape; keep such
             # batches on the general kernel.
             decode_only=decode_only and cascade_blocks == 0,
@@ -1737,13 +1926,14 @@ class ModelRunner:
         self.step_launches += 1
         if flags["decode_only"]:
             self.decode_only_launches += 1
-        # Multi-step only ever schedules all-decode batches, so the
-        # emission estimate r_live * K holds whenever K > 1.
-        self.launch_sampled_tokens += r_live * flags["num_decode_steps"]
+        # launch_sampled_tokens counts REALIZED emissions — finalize
+        # accumulates the per-row token runs it actually folds, which is
+        # exact for every path (fixed K, dynamic, spec, prefill).
         # Sampler-kernel routing accounting (the device decision is made
         # at trace time by dispatch_sample; this mirrors it host-side).
         # All-greedy launches are neither: the XLA argmax path is not a
         # fallback, it's the design for that shape.
+        self._dyn_sampler_acct = None
         if flags["needs_gumbel"]:
             use_kernel, _ = sampler_kernel_eligible(
                 self.model.vocab_size,
@@ -1751,7 +1941,11 @@ class ModelRunner:
                 enable_kernel=self.enable_sampler_kernel,
                 allow_interpret=True,
             )
-            if use_kernel:
+            if dynamic:
+                # Realized step count is unknown until finalize; stash
+                # the routing decision for deferred accounting.
+                self._dyn_sampler_acct = (use_kernel, int(np.sum(nongreedy)))
+            elif use_kernel:
                 self.sampler_kernel_launches += flags["num_decode_steps"]
             else:
                 self.sampler_fallback_rows += int(np.sum(nongreedy)) * flags[
@@ -2037,10 +2231,24 @@ class ModelRunner:
             self.timing["dispatch_s"] += time.perf_counter() - t1
             self.timing["steps"] += 1
         is_spec = flags["num_spec"] > 0
+        is_dynamic = bool(flags.get("dynamic_decode"))
         if not is_spec:
             # Multi-step decode returns [R, K]; the feedback source for the
-            # next step is the LAST sampled column.
-            last_col = sampled[:, -1] if sampled.ndim == 2 else sampled
+            # next step is the LAST sampled column. Dynamic decode returns
+            # (out_tokens [R, Kmax], num_out [R]): gather each row's last
+            # REALIZED token (the scheduler never schedules a dynamic row
+            # into feedback, but keeping the source exact costs one [R]
+            # gather).
+            if is_dynamic:
+                out_t, n_t = sampled
+                last_col = out_t[
+                    jnp.arange(out_t.shape[0]),
+                    jnp.clip(n_t - 1, 0, out_t.shape[1] - 1),
+                ]
+            elif sampled.ndim == 2:
+                last_col = sampled[:, -1]
+            else:
+                last_col = sampled
             self._last_sampled = (
                 last_col
                 if last_col.shape[0] == self._max_r
@@ -2050,7 +2258,7 @@ class ModelRunner:
         # Kick off the D2H copy now: it runs as soon as the step completes,
         # so finalize()'s device_get is a no-op wait instead of paying the
         # full host<->device round trip on the critical path.
-        for x in sampled if is_spec else (sampled,):
+        for x in sampled if (is_spec or is_dynamic) else (sampled,):
             x.copy_to_host_async()
         if lp is not None:
             for x in lp:
@@ -2068,8 +2276,10 @@ class ModelRunner:
         handle = StepHandle(
             req_order=req_order, do_sample=do_sample, sampled=sampled, lp=lp,
             row_states=[self.input_batch.req_states[r] for r in req_order],
-            spec=is_spec,
+            spec=is_spec, dynamic=is_dynamic,
         )
+        handle.dyn_sampler_acct = self._dyn_sampler_acct
+        self._dyn_sampler_acct = None
         handle.drafts = drafts
         handle.pooled = pooled
         handle.nan_count = nan_count
@@ -2090,7 +2300,7 @@ class ModelRunner:
             return ModelRunnerOutput()
         t0 = time.perf_counter() if self._timing_enabled else 0.0
         req_order, do_sample = handle.req_order, handle.do_sample
-        if handle.spec:
+        if handle.spec or handle.dynamic:
             out_tokens = np.asarray(jax.device_get(handle.sampled[0]))
             num_out = np.asarray(jax.device_get(handle.sampled[1]))
         else:
@@ -2182,7 +2392,9 @@ class ModelRunner:
                 out.sampled_token_ids.append([])
                 continue
             if do_sample[i]:
-                if handle.spec:
+                if handle.spec or handle.dynamic:
+                    # Variable-length run: spec accept length, or the
+                    # dynamic loop's realized per-row step count.
                     toks = [int(x) for x in out_tokens[i, : num_out[i]]]
                 elif sampled_np.ndim == 2:  # multi-step decode [R, K]
                     toks = [int(x) for x in sampled_np[i]]
@@ -2230,6 +2442,23 @@ class ModelRunner:
                 out.sampled_token_ids.append(toks)
             else:
                 out.sampled_token_ids.append([])
+        # Realized emission count: exact for every path (fixed K,
+        # dynamic variable-length runs, spec accepts, prefill = 0) —
+        # vllm:sampled_tokens_per_launch and the perfwatch per-launch
+        # math read this, so estimates would skew both.
+        self.launch_sampled_tokens += sum(
+            len(toks) for toks in out.sampled_token_ids
+        )
+        if handle.dyn_sampler_acct is not None:
+            # Dynamic launch sampler routing, deferred until the realized
+            # step count (the number of in-loop dispatch_sample calls =
+            # the longest row's run) is known.
+            use_kernel, n_nongreedy = handle.dyn_sampler_acct
+            steps = int(num_out.max()) if len(req_order) else 0
+            if use_kernel:
+                self.sampler_kernel_launches += steps
+            else:
+                self.sampler_fallback_rows += n_nongreedy * steps
         if lp_np is not None:
             from vllm_tpu.core.sched_output import LogprobsLists
 

@@ -246,13 +246,10 @@ class Worker:
                     f"decoder position table ({cap})"
                 )
         if getattr(self.model, "needs_mrope", False):
-            sched = self.config.scheduler_config
-            if sched.num_decode_steps > 1:
-                raise ValueError(
-                    "m-rope models (Qwen2-VL) do not support "
-                    "num_decode_steps > 1 yet (the in-jit decode chain "
-                    "does not thread the mrope delta)"
-                )
+            self.config.scheduler_config.validate_decode_steps(
+                spec_enabled=self.config.speculative_config.enabled,
+                needs_mrope=True,
+            )
             if self.config.speculative_config.enabled:
                 raise ValueError(
                     "speculative decoding with m-rope models is not "
