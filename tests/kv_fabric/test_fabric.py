@@ -1,0 +1,335 @@
+"""Tiered KV fabric unit tests: host tier LRU + quantized storage, the
+peer wire, cost-gated cross-engine fetches, dead-peer degradation, and
+the router's fabric-armed spillover rung."""
+
+from __future__ import annotations
+
+import pickle
+
+import numpy as np
+import pytest
+
+from vllm_tpu.kv_fabric import FetchCostModel, HostTier, KVFabric, PeerServer
+from vllm_tpu.kv_fabric.peer import PeerClient
+from vllm_tpu.ops.kv_quant import QuantizedBlock, encoded_nbytes
+
+BLOCK_SIZE = 16
+# Runner D2H payload layout [layers, block_size, rows, lanes].
+PAYLOAD_SHAPE = (2, BLOCK_SIZE, 2, 8)
+
+
+def _payload(seed: int) -> np.ndarray:
+    rng = np.random.default_rng(seed)
+    return rng.normal(size=PAYLOAD_SHAPE).astype(np.float32)
+
+
+def _hashes(n: int, salt: int = 0) -> list[bytes]:
+    return [bytes([salt]) * 4 + i.to_bytes(4, "big") for i in range(n)]
+
+
+# ---------------------------------------------------------------------------
+# HostTier
+
+
+def test_host_tier_match_and_lru_eviction():
+    one = _payload(0).nbytes
+    tier = HostTier(max_bytes=3 * one)
+    keys = [f"k{i}" for i in range(3)]
+    tier.put(keys, [_payload(i) for i in range(3)])
+    assert len(tier) == 3
+    assert tier.match(keys) == 3
+    assert tier.match(["k0", "k1", "nope", "k2"]) == 2
+
+    # k0 was just LRU-touched by the match; inserting a 4th block must
+    # evict the coldest (k2 was touched last among survivors... k0/k1
+    # touched by the second match, so k2 is coldest).
+    tier.put(["k3"], [_payload(3)])
+    assert len(tier) == 3
+    assert tier.stats()["evictions"] == 1
+    assert not tier.contains("k2")
+    assert tier.contains("k0") and tier.contains("k1") and tier.contains("k3")
+
+
+def test_host_tier_quantized_storage_roundtrip():
+    tier = HostTier(max_bytes=1 << 20, quant="int8")
+    p = _payload(5)
+    tier.put(["a"], [p])
+    stored = tier.get_encoded(["a"])[0]
+    assert isinstance(stored, QuantizedBlock)
+    assert encoded_nbytes(stored) < p.nbytes / 3
+    out = tier.load(["a"])[0]
+    assert out.shape == p.shape
+    assert np.max(np.abs(out - p)) < 0.05
+
+
+def test_host_tier_get_missing_raises():
+    tier = HostTier(max_bytes=1 << 20)
+    with pytest.raises(KeyError):
+        tier.get_encoded(["ghost"])
+
+
+# ---------------------------------------------------------------------------
+# Fabric: local (host tier only)
+
+
+def test_fabric_host_roundtrip_connector_seams():
+    fab = KVFabric(host_bytes=1 << 20, quant="int8")
+    hashes = _hashes(3)
+    payloads = [_payload(i) for i in range(3)]
+
+    # Nothing cached yet: everything needs persisting.
+    assert fab.request_finished(hashes) == [0, 1, 2]
+    fab.save_blocks(hashes, payloads)
+    assert fab.request_finished(hashes) == []
+
+    got = fab.get_num_new_matched_tokens(hashes, 0, BLOCK_SIZE)
+    assert got == 3 * BLOCK_SIZE
+    # Device already computed block 0: only the tail counts.
+    assert fab.get_num_new_matched_tokens(
+        hashes, BLOCK_SIZE, BLOCK_SIZE) == 2 * BLOCK_SIZE
+
+    out = fab.load_blocks(hashes)
+    for o, p in zip(out, payloads):
+        assert o.shape == p.shape
+        assert np.max(np.abs(o - p)) < 0.05
+
+    s = fab.stats()
+    assert s["blocks"] == 3           # legacy scalar surface
+    assert s["hits"] >= 2
+    assert s["tier_hits"]["host"] >= 2
+    assert s["tier_blocks"]["host"] == 3
+
+
+def test_fabric_load_unknown_block_raises():
+    fab = KVFabric(host_bytes=1 << 20)
+    with pytest.raises(KeyError):
+        fab.load_blocks(_hashes(1, salt=9))
+
+
+def test_fabric_pickles_without_live_sockets():
+    fab = KVFabric(host_bytes=1 << 20, quant="int8", bind="127.0.0.1:0")
+    try:
+        fab.save_blocks(_hashes(2), [_payload(0), _payload(1)])
+        clone = pickle.loads(pickle.dumps(fab))
+        assert clone._server is None and clone._clients == {}
+        assert len(clone.host) == 2
+        assert clone.get_num_new_matched_tokens(
+            _hashes(2), 0, BLOCK_SIZE) == 2 * BLOCK_SIZE
+    finally:
+        fab.close()
+
+
+# ---------------------------------------------------------------------------
+# Fabric: peer tier
+
+
+def _fabric_pair(quant="int8", **kw_b):
+    """Engine A serving its host tier; engine B peering at it."""
+    a = KVFabric(host_bytes=1 << 22, quant=quant, bind="127.0.0.1:0")
+    b = KVFabric(host_bytes=1 << 22, quant=quant,
+                 peers=[a._server.url], **kw_b)
+    return a, b
+
+
+def test_peer_hit_fetches_and_promotes():
+    a, b = _fabric_pair()
+    try:
+        hashes = _hashes(4)
+        payloads = [_payload(i) for i in range(4)]
+        a.save_blocks(hashes, payloads)
+
+        # B has nothing locally; the peer sweep finds A's 4 blocks and
+        # the cost model accepts (first fetch is latency-only: no block-
+        # size estimate yet).
+        got = b.get_num_new_matched_tokens(hashes, 0, BLOCK_SIZE)
+        assert got == 4 * BLOCK_SIZE
+        assert b.fetch_outcomes["fetched"] == 1
+        assert b.hits["peer"] == 1
+
+        out = b.load_blocks(hashes)
+        for o, p in zip(out, payloads):
+            assert np.max(np.abs(o - p)) < 0.05
+        assert b.fetch_bytes > 0
+        # Promotion: the blocks now live in B's host tier too.
+        assert len(b.host) == 4
+        assert b.host.match([k.hex() for k in hashes]) == 4
+        # The timed transfer fed the link EWMA (unpinned model).
+        assert b.cost.stats()["transfers_observed"] == 1
+    finally:
+        a.close()
+        b.close()
+
+
+def test_quantized_blocks_cross_wire_quantized():
+    a, b = _fabric_pair(quant="int4")
+    try:
+        hashes = _hashes(2)
+        a.save_blocks(hashes, [_payload(0), _payload(1)])
+        b.get_num_new_matched_tokens(hashes, 0, BLOCK_SIZE)
+        b.load_blocks(hashes)
+        # B's promoted copies are still in stored (int4) form — the wire
+        # carried nibbles, not fp32.
+        stored = b.host.get_encoded([hashes[0].hex()])[0]
+        assert isinstance(stored, QuantizedBlock)
+        assert stored.mode == "int4"
+        raw = _payload(0).nbytes
+        assert b.fetch_bytes < raw  # compressed transfer
+    finally:
+        a.close()
+        b.close()
+
+
+def test_expensive_link_flips_peer_hit_to_recompute():
+    """The forced-expensive knob: with a pinned microscopic bandwidth
+    and a known block size, the peer hit is planned away as recompute."""
+    a, b = _fabric_pair(link_gbps=1e-6)  # 1 KB/s
+    try:
+        hashes = _hashes(3)
+        a.save_blocks(hashes, [_payload(i) for i in range(3)])
+        b._block_bytes = float(_payload(0).nbytes)  # seen blocks before
+        got = b.get_num_new_matched_tokens(hashes, 0, BLOCK_SIZE)
+        assert got == 0
+        assert b.fetch_outcomes["recompute"] == 1
+        assert b.fetch_outcomes["fetched"] == 0
+    finally:
+        a.close()
+        b.close()
+
+
+def test_cheap_link_keeps_the_fetch():
+    a, b = _fabric_pair(link_gbps=1000.0)
+    try:
+        hashes = _hashes(3)
+        a.save_blocks(hashes, [_payload(i) for i in range(3)])
+        b._block_bytes = float(_payload(0).nbytes)
+        assert b.get_num_new_matched_tokens(
+            hashes, 0, BLOCK_SIZE) == 3 * BLOCK_SIZE
+        assert b.fetch_outcomes["fetched"] == 1
+    finally:
+        a.close()
+        b.close()
+
+
+def test_dead_peer_degrades_to_miss_not_crash():
+    # Nothing listens on this port (bind-then-close reserves a dead one).
+    import socket
+
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    b = KVFabric(host_bytes=1 << 20, peers=[f"127.0.0.1:{port}"])
+    try:
+        got = b.get_num_new_matched_tokens(_hashes(2), 0, BLOCK_SIZE)
+        assert got == 0
+        assert b.fetch_outcomes["miss"] == 1
+    finally:
+        b.close()
+
+
+def test_peer_death_mid_fetch_raises_for_invalid_load_recovery():
+    """Admission planned a peer fetch, then the peer died: load_blocks
+    must RAISE (the worker's invalid-load recovery recomputes) — never
+    return garbage."""
+    a, b = _fabric_pair()
+    try:
+        hashes = _hashes(2)
+        a.save_blocks(hashes, [_payload(0), _payload(1)])
+        assert b.get_num_new_matched_tokens(
+            hashes, 0, BLOCK_SIZE) == 2 * BLOCK_SIZE
+        a.close()  # peer dies between admission and load
+        # Shrink the retry budget so the test doesn't sit in backoff.
+        for c in b._clients.values():
+            c.max_retries = 0
+            c.timeout_s = 0.5
+        with pytest.raises((ConnectionError, OSError, KeyError)):
+            b.load_blocks(hashes)
+        b.note_fetch_failure("req-0")  # what the worker seam does next
+        assert b.fetch_outcomes["failed"] == 1
+    finally:
+        a.close()
+        b.close()
+
+
+def test_store_writethrough_and_peer_query():
+    """A standalone block store behaves as an always-on peer: saves are
+    written through, and a third engine with no peers but the store URL
+    still sees the prefix."""
+    store_tier = HostTier(max_bytes=1 << 22, quant="int8")
+    server = PeerServer(store_tier).start()
+    try:
+        a = KVFabric(host_bytes=1 << 22, quant="int8",
+                     store_url=server.url)
+        hashes = _hashes(3)
+        a.save_blocks(hashes, [_payload(i) for i in range(3)])
+        assert a.demotions["store"] == 3
+        assert len(store_tier) == 3
+
+        c = KVFabric(host_bytes=1 << 22, quant="int8",
+                     store_url=server.url)
+        assert c.get_num_new_matched_tokens(
+            hashes, 0, BLOCK_SIZE) == 3 * BLOCK_SIZE
+        out = c.load_blocks(hashes)
+        assert len(out) == 3
+        a.close()
+        c.close()
+    finally:
+        server.shutdown()
+
+
+def test_peer_client_stats_op():
+    tier = HostTier(max_bytes=1 << 20)
+    tier.put(["x"], [_payload(0)])
+    server = PeerServer(tier).start()
+    try:
+        client = PeerClient(server.url, timeout_s=2.0)
+        s = client.stats()
+        assert s["blocks"] == 1
+        client.close()
+    finally:
+        server.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# Router spillover rung (fabric-armed)
+
+
+class _FixedIndex:
+    def __init__(self, hits):
+        self._hits = hits
+
+    def longest_prefix(self, hashes, candidates=None):
+        return dict(self._hits)
+
+
+def _req(n_tokens):
+    from types import SimpleNamespace
+
+    return SimpleNamespace(
+        prompt_token_ids=list(range(3, 3 + n_tokens)), lora_name=None,
+        mm_inputs=[], pooling_params=None)
+
+
+def test_spill_threshold_routes_to_coolest_engine():
+    from vllm_tpu.router.policy import PrefixAwareRouter
+
+    router = PrefixAwareRouter(
+        _FixedIndex({0: 3}), block_size=BLOCK_SIZE, spill_threshold=4)
+    # Prefix holder (0) is 5 requests hotter than engine 1: spill.
+    d = router.choose(_req(48), [0, 1], {0: 6, 1: 1})
+    assert d.kind == "prefix_spill"
+    assert d.engine_id == 1
+    assert d.hit_blocks == 3
+    # Below the threshold: strict affinity.
+    d = router.choose(_req(48), [0, 1], {0: 3, 1: 1})
+    assert d.kind == "prefix"
+    assert d.engine_id == 0
+
+
+def test_spill_disabled_preserves_affinity():
+    from vllm_tpu.router.policy import PrefixAwareRouter
+
+    router = PrefixAwareRouter(_FixedIndex({0: 3}), block_size=BLOCK_SIZE)
+    d = router.choose(_req(48), [0, 1], {0: 100, 1: 0})
+    assert d.kind == "prefix"
+    assert d.engine_id == 0

@@ -1,0 +1,215 @@
+"""Cross-engine KV fabric e2e on the dp=2 CPU mesh.
+
+The acceptance scenario behind the tiered fabric: a session's prefix
+lives on the engine that served turn 1 (device cache + host-tier
+demotion at finish). When that engine can no longer take the follow-up
+turn, the request lands on the OTHER engine, whose fabric finds the
+prefix on the peer, the cost model accepts, and the worker pulls the
+blocks over the wire instead of re-prefilling — with byte-identical
+greedy output to the recompute reference.
+
+The chaos variant arms the ``kv_fabric.fetch`` failpoint: a torn
+transfer / dead peer mid-fetch must degrade to recompute via the
+invalid-load recovery path, with the request finishing normally and the
+failure counted — never a crash or a lost request.
+"""
+
+from __future__ import annotations
+
+import time
+from types import SimpleNamespace
+
+import pytest
+
+from tests.models.utils import tiny_llama_dir
+from vllm_tpu import LLM, SamplingParams
+from vllm_tpu.router.policy import request_prefix_hashes
+
+BLOCK = 16
+
+
+@pytest.fixture(scope="module")
+def ckpt(tmp_path_factory):
+    return tiny_llama_dir(tmp_path_factory.mktemp("tiny_llama_fabric"))
+
+
+def _llm(ckpt, tmp_path, **kw):
+    return LLM(
+        model=ckpt, dtype="float32", max_model_len=256, block_size=BLOCK,
+        num_gpu_blocks_override=96, max_num_seqs=4,
+        max_num_batched_tokens=128,
+        kv_events_endpoint=f"ipc://{tmp_path}/kv.sock",
+        data_parallel_engines=2,
+        kv_connector="fabric",
+        **kw,
+    )
+
+
+def _hashes(tokens):
+    return request_prefix_hashes(
+        SimpleNamespace(prompt_token_ids=list(tokens), lora_name=None,
+                        mm_inputs=[], pooling_params=None),
+        BLOCK,
+    )
+
+
+def _warm_pipes(llm, client, n_engines: int, timeout_s: float = 60.0):
+    sp = SamplingParams(temperature=0.0, max_tokens=2, ignore_eos=True)
+    deadline = time.monotonic() + timeout_s
+    i = 0
+    while time.monotonic() < deadline:
+        status = client._prefix_index.status()
+        if sum(1 for n in status["engines"].values() if n > 0) >= n_engines:
+            return
+        llm.generate([
+            {"prompt_token_ids": [
+                (7919 * (i + k) + 31 * j) % 120 + 3 for j in range(BLOCK)
+            ]}
+            for k in range(n_engines)
+        ], sp)
+        i += n_engines
+        time.sleep(0.3)
+    raise TimeoutError(
+        f"index never heard from {n_engines} engines: "
+        f"{client._prefix_index.status()}")
+
+
+def _wait_indexed(client, hashes, engine_id, min_blocks,
+                  timeout_s: float = 20.0) -> None:
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        hits = client._prefix_index.longest_prefix(hashes)
+        if hits.get(engine_id, 0) >= min_blocks:
+            return
+        time.sleep(0.05)
+    raise TimeoutError(
+        f"engine {engine_id} never indexed {min_blocks} prefix blocks: "
+        f"hits={client._prefix_index.longest_prefix(hashes)}")
+
+
+def _wait_host_tier(client, engine_id, min_blocks=1, timeout_s: float = 30.0):
+    """Idle engines flush pending demotions within one idle tick; wait
+    until the owner's host tier actually holds the prefix."""
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        status = client.kv_fabric_status()
+        snap = status.get("engines", {}).get(str(engine_id), {})
+        if snap.get("tier_blocks", {}).get("host", 0) >= min_blocks:
+            return status
+        time.sleep(0.1)
+    raise TimeoutError(
+        f"engine {engine_id} host tier never reached {min_blocks} "
+        f"blocks: {client.kv_fabric_status()}")
+
+
+def _routing_spy(client):
+    routed: list[int] = []
+    orig_add = client.add_request
+
+    def spy(req):
+        orig_add(req)
+        routed.append(client._live[req.request_id])
+
+    client.add_request = spy
+    return routed
+
+
+def test_cross_engine_prefix_fetch_matches_recompute(ckpt, tmp_path):
+    # quant="none": the fetched KV must reproduce the owner's bytes
+    # exactly, so the greedy continuation is token-identical to the
+    # device-cache reference (quantized numerics are covered by
+    # test_kv_quant's attention-tolerance bounds).
+    llm = _llm(ckpt, tmp_path, kv_fabric_quant="none")
+    try:
+        client = llm.llm_engine.engine_core
+        assert client._prefix_router is not None
+        _warm_pipes(llm, client, n_engines=2)
+        routed = _routing_spy(client)
+        sp = SamplingParams(temperature=0.0, max_tokens=8, ignore_eos=True)
+
+        # Turn 1: 3 full blocks of prompt land somewhere.
+        convo = [(1009 + 7 * j) % 120 + 3 for j in range(48)]
+        out1 = llm.generate([{"prompt_token_ids": list(convo)}], sp)[0]
+        owner = routed[-1]
+        _wait_indexed(client, _hashes(convo), owner, min_blocks=3)
+        convo.extend(out1.outputs[0].token_ids)
+        convo.extend((1013 + 7 * j) % 120 + 3 for j in range(16))
+
+        # Reference follow-up: prefix routing sends it to the owner,
+        # whose device cache serves the prefix — these are the tokens a
+        # non-fabric engine would produce.
+        ref = llm.generate([{"prompt_token_ids": list(convo)}], sp)[0]
+        assert routed[-1] == owner, "reference turn must hit the owner"
+        ref_tokens = list(ref.outputs[0].token_ids)
+
+        # The owner's finished requests demote their blocks to its host
+        # tier (flushed from the idle loop) — the fabric's peer surface.
+        _wait_host_tier(client, owner, min_blocks=3)
+
+        # The owner can no longer take the turn: the request lands on
+        # the peer, which pulls the prefix through the fabric.
+        client._engine_up[owner] = False
+        try:
+            out2 = llm.generate([{"prompt_token_ids": list(convo)}], sp)[0]
+        finally:
+            client._engine_up[owner] = True
+        fetcher = routed[-1]
+        assert fetcher != owner
+
+        assert list(out2.outputs[0].token_ids) == ref_tokens, (
+            "fabric-fetched KV must reproduce the recompute reference")
+        # The scheduler counted the external hit as cached tokens, the
+        # same signal bench sessions' prefix_hit_rate aggregates.
+        assert out2.num_cached_tokens >= 3 * BLOCK
+
+        status = client.kv_fabric_status()
+        fetch = status["engines"][str(fetcher)]["fetch"]
+        assert fetch["fetched"] >= 1, status
+        assert status["engines"][str(fetcher)]["fetch_bytes"] > 0
+        assert status["engines"][str(fetcher)]["tier_hits"]["peer"] >= 1
+        # Merged view sums the pool (both engines up again).
+        assert status["fetch"]["fetched"] >= 1
+    finally:
+        llm.llm_engine.shutdown()
+
+
+def test_peer_death_mid_fetch_degrades_to_recompute(ckpt, tmp_path,
+                                                    monkeypatch):
+    # Arm the torn-transfer failpoint BEFORE the engines spawn (spawn
+    # context: children re-read the env). First fetch attempt raises
+    # ConnectionError in the worker's load path; the invalid-load
+    # recovery must recompute and finish the request normally.
+    monkeypatch.setenv(
+        "VLLM_TPU_FAILPOINTS", "kv_fabric.fetch=once*raise(ConnectionError)")
+    llm = _llm(ckpt, tmp_path, kv_fabric_quant="int8")
+    try:
+        client = llm.llm_engine.engine_core
+        _warm_pipes(llm, client, n_engines=2)
+        routed = _routing_spy(client)
+        sp = SamplingParams(temperature=0.0, max_tokens=8, ignore_eos=True)
+
+        convo = [(2003 + 7 * j) % 120 + 3 for j in range(48)]
+        out1 = llm.generate([{"prompt_token_ids": list(convo)}], sp)[0]
+        owner = routed[-1]
+        _wait_indexed(client, _hashes(convo), owner, min_blocks=3)
+        _wait_host_tier(client, owner, min_blocks=3)
+        convo.extend(out1.outputs[0].token_ids)
+        convo.extend((2017 + 7 * j) % 120 + 3 for j in range(16))
+
+        client._engine_up[owner] = False
+        try:
+            out2 = llm.generate([{"prompt_token_ids": list(convo)}], sp)[0]
+        finally:
+            client._engine_up[owner] = True
+        assert routed[-1] != owner
+
+        # Zero lost requests: the turn finished with a full completion.
+        assert len(out2.outputs[0].token_ids) == 8
+        assert out2.finished
+
+        status = client.kv_fabric_status()
+        fetch = status["engines"][str(routed[-1])]["fetch"]
+        assert fetch["fetched"] >= 1, status   # the fetch was planned...
+        assert fetch["failed"] >= 1, status    # ...tore, and was counted
+    finally:
+        llm.llm_engine.shutdown()

@@ -1,0 +1,148 @@
+"""Cold-tier KV quantization round-trip guarantees.
+
+The fabric stores demoted blocks as symmetric per-slice int8 (opt-in
+int4) and dequantizes on promotion back into the paged cache. These
+tests pin the two properties serving correctness rests on:
+
+- the element-wise round-trip error never exceeds the analytic bound
+  ``max_abs_error_bound`` (half a quantization step at the largest
+  scale), for fp32 and bf16 payloads alike;
+- pushing a quantized-round-tripped K/V through the attention math
+  moves the attention *output* by at most a small tolerance — the
+  number that actually decides whether promoted blocks are usable.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from vllm_tpu.ops.kv_quant import (
+    QuantizedBlock,
+    dequantize_block,
+    encoded_nbytes,
+    max_abs_error_bound,
+    maybe_dequantize,
+    maybe_quantize,
+    quantize_block,
+)
+
+# The runner's D2H payload layout: [num_layers, block_size, rows, lanes].
+BLOCK_SHAPE = (2, 16, 4, 32)
+
+
+def _payload(shape=BLOCK_SHAPE, seed=0, dtype=np.float32):
+    rng = np.random.default_rng(seed)
+    a = rng.normal(scale=2.0, size=shape)
+    # A few outliers, like real KV activations.
+    a.flat[:: 97] *= 8.0
+    return a.astype(dtype)
+
+
+@pytest.mark.parametrize("mode", ["int8", "int4"])
+def test_roundtrip_error_within_analytic_bound_fp32(mode):
+    a = _payload()
+    qb = quantize_block(a, mode)
+    out = dequantize_block(qb)
+    assert out.shape == a.shape
+    assert out.dtype == a.dtype
+    err = np.max(np.abs(out - a))
+    bound = max_abs_error_bound(qb)
+    assert err <= bound * (1 + 1e-6), f"{mode}: err {err} > bound {bound}"
+    # And the bound is what it says: half an LSB of the coarsest slice.
+    qmax = {"int8": 127.0, "int4": 7.0}[mode]
+    assert bound == pytest.approx(float(np.max(qb.scale)) / (2 * qmax))
+
+
+@pytest.mark.parametrize("mode", ["int8", "int4"])
+def test_roundtrip_error_within_bound_bf16(mode):
+    import ml_dtypes
+
+    a = _payload(dtype=ml_dtypes.bfloat16)
+    qb = quantize_block(a, mode)
+    out = dequantize_block(qb)
+    assert out.dtype == a.dtype
+    f_in = a.astype(np.float32)
+    f_out = out.astype(np.float32)
+    # The final cast back to bf16 adds up to ~2^-8 relative error on top
+    # of the quantization bound.
+    bound = max_abs_error_bound(qb) + float(np.max(np.abs(f_in))) * 2.0 ** -8
+    assert np.max(np.abs(f_out - f_in)) <= bound * (1 + 1e-6)
+
+
+def test_int8_beats_int4_on_error():
+    a = _payload(seed=3)
+    e8 = np.max(np.abs(dequantize_block(quantize_block(a, "int8")) - a))
+    e4 = np.max(np.abs(dequantize_block(quantize_block(a, "int4")) - a))
+    assert e8 < e4
+
+
+def test_zero_block_is_exact():
+    a = np.zeros(BLOCK_SHAPE, np.float32)
+    out = dequantize_block(quantize_block(a, "int8"))
+    assert np.array_equal(out, a)
+
+
+def test_int4_odd_last_axis():
+    a = _payload(shape=(2, 3, 4, 7), seed=1)
+    qb = quantize_block(a, "int4")
+    out = dequantize_block(qb)
+    assert out.shape == a.shape
+    assert np.max(np.abs(out - a)) <= max_abs_error_bound(qb) * (1 + 1e-6)
+
+
+@pytest.mark.parametrize("mode", ["int8", "int4"])
+def test_wire_roundtrip_identical(mode):
+    a = _payload(seed=2)
+    qb = quantize_block(a, mode)
+    meta, blobs = qb.to_wire()
+    assert meta["kind"] == "q"
+    back = QuantizedBlock.from_wire(meta, *blobs)
+    assert np.array_equal(dequantize_block(back), dequantize_block(qb))
+
+
+def test_compression_ratios():
+    a = _payload()
+    n8 = encoded_nbytes(quantize_block(a, "int8"))
+    n4 = encoded_nbytes(quantize_block(a, "int4"))
+    raw = a.nbytes
+    # Scales add a small overhead on top of the 4x / 8x payload shrink.
+    assert n8 < raw / 3
+    assert n4 < raw / 6
+    assert n4 < n8
+
+
+def test_maybe_quantize_none_is_identity():
+    a = _payload()
+    v = maybe_quantize(a, "none")
+    assert isinstance(v, np.ndarray)
+    assert np.array_equal(maybe_dequantize(v), a)
+
+
+def test_unknown_mode_raises():
+    with pytest.raises(ValueError):
+        quantize_block(_payload(), "fp8")
+
+
+def _attention(q, k, v):
+    scores = (q @ k.T) / np.sqrt(q.shape[-1])
+    w = np.exp(scores - scores.max(axis=-1, keepdims=True))
+    w /= w.sum(axis=-1, keepdims=True)
+    return w @ v
+
+
+@pytest.mark.parametrize("mode,atol", [("int8", 0.02), ("int4", 0.25)])
+def test_attention_output_tolerance(mode, atol):
+    """The acceptance check behind cold-tier quantization: attention run
+    against round-tripped K/V stays within tolerance of exact."""
+    rng = np.random.default_rng(7)
+    T, d = 64, 32
+    q = rng.normal(size=(4, d)).astype(np.float32)
+    k = rng.normal(size=(T, d)).astype(np.float32)
+    v = rng.normal(size=(T, d)).astype(np.float32)
+    exact = _attention(q, k, v)
+    kq = dequantize_block(quantize_block(k, mode))
+    vq = dequantize_block(quantize_block(v, mode))
+    approx = _attention(q, kq, vq)
+    assert np.max(np.abs(approx - exact)) < atol, (
+        f"{mode}: attention drift {np.max(np.abs(approx - exact))}")

@@ -1,0 +1,155 @@
+"""Remote KV store / peer transport robustness: a *stalled* server
+(accepts the connection, never replies) must surface as a bounded
+``ConnectionError`` after timeout + retries — never a hung scheduler —
+and the scheduler-side calls must degrade to a cache miss."""
+
+from __future__ import annotations
+
+import socket
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from vllm_tpu.kv_connector.remote import RemoteKVConnector
+from vllm_tpu.kv_fabric.peer import PeerClient
+
+
+class StalledServer:
+    """Accepts connections, reads forever, never sends a byte."""
+
+    def __init__(self):
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._sock.bind(("127.0.0.1", 0))
+        self._sock.listen(8)
+        self.port = self._sock.getsockname()[1]
+        self.accepted = 0
+        self._running = True
+        self._conns: list[socket.socket] = []
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread.start()
+
+    @property
+    def url(self) -> str:
+        return f"127.0.0.1:{self.port}"
+
+    def _loop(self):
+        while self._running:
+            try:
+                conn, _ = self._sock.accept()
+            except OSError:
+                return
+            self.accepted += 1
+            self._conns.append(conn)
+            threading.Thread(
+                target=self._swallow, args=(conn,), daemon=True).start()
+
+    @staticmethod
+    def _swallow(conn):
+        try:
+            while conn.recv(1 << 16):
+                pass
+        except OSError:
+            pass
+
+    def close(self):
+        self._running = False
+        # shutdown() wakes the thread blocked in accept(); close() alone
+        # leaves the kernel socket in LISTEN until that syscall returns,
+        # which keeps the port unbindable.
+        try:
+            self._sock.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+        for c in self._conns:
+            try:
+                c.close()
+            except OSError:
+                pass
+        self._thread.join(timeout=2.0)
+
+
+@pytest.fixture
+def stalled():
+    server = StalledServer()
+    yield server
+    server.close()
+
+
+def test_remote_connector_bounded_time_on_stalled_store(stalled):
+    conn = RemoteKVConnector(
+        stalled.url, timeout_s=0.2, max_retries=1, backoff_s=0.01)
+    t0 = time.monotonic()
+    with pytest.raises(ConnectionError) as ei:
+        conn.load_blocks([b"\x01" * 8])
+    elapsed = time.monotonic() - t0
+    # 2 attempts x 0.2 s timeout + one 10 ms backoff, with slack.
+    assert elapsed < 3.0, f"stalled store held the caller {elapsed:.1f}s"
+    assert "unreachable after 2 attempts" in str(ei.value)
+    assert stalled.accepted >= 2  # it really reconnected between tries
+
+
+def test_remote_scheduler_side_degrades_to_miss(stalled):
+    """get_num_new_matched_tokens / request_finished swallow the outage:
+    a stalled store is a cache miss (recompute), never a crash."""
+    conn = RemoteKVConnector(
+        stalled.url, timeout_s=0.2, max_retries=0, backoff_s=0.01)
+    assert conn.get_num_new_matched_tokens([b"\x01" * 8], 0, 16) == 0
+    assert conn.request_finished([b"\x01" * 8]) == []
+    assert conn.outages == 2
+
+
+def test_remote_save_blocks_swallows_outage(stalled):
+    conn = RemoteKVConnector(
+        stalled.url, timeout_s=0.2, max_retries=0, backoff_s=0.01)
+    conn.save_blocks(
+        [b"\x02" * 8], [np.zeros((1, 4, 2, 2), np.float32)])
+    assert conn.outages == 1
+
+
+def test_remote_env_timeout_default(monkeypatch):
+    monkeypatch.setenv("VLLM_TPU_KV_STORE_TIMEOUT_S", "0.75")
+    conn = RemoteKVConnector("127.0.0.1:1")
+    assert conn.timeout_s == 0.75
+
+
+def test_peer_client_bounded_time_on_stalled_peer(stalled):
+    client = PeerClient(
+        stalled.url, timeout_s=0.2, max_retries=1, backoff_s=0.01)
+    t0 = time.monotonic()
+    with pytest.raises(ConnectionError):
+        client.query(["aa"])
+    assert time.monotonic() - t0 < 3.0
+    client.close()
+
+
+def test_peer_client_env_timeout(monkeypatch):
+    monkeypatch.setenv("VLLM_TPU_KV_FABRIC_TIMEOUT_S", "1.5")
+    client = PeerClient("127.0.0.1:1")
+    assert client.timeout_s == 1.5
+
+
+def test_remote_recovers_after_transient_stall(stalled):
+    """The retry loop reconnects: once a real server is listening on the
+    same port, the next RPC succeeds."""
+    from vllm_tpu.kv_connector.remote import KVStoreServer
+
+    conn = RemoteKVConnector(
+        stalled.url, timeout_s=0.3, max_retries=0, backoff_s=0.01)
+    assert conn.get_num_new_matched_tokens([b"\x01" * 8], 0, 16) == 0
+    port = stalled.port
+    stalled.close()
+    time.sleep(0.05)
+    server = KVStoreServer(host="127.0.0.1", port=port).start()
+    try:
+        # New socket, live store: scheduler-side query works again.
+        assert conn.get_num_new_matched_tokens([b"\x01" * 8], 0, 16) == 0
+        assert conn.outages == 1  # no new outage on the healthy store
+    finally:
+        server.shutdown()

@@ -201,7 +201,7 @@ def test_per_frontend_identity(topo):
         indexes.add(health["api_server_index"])
         pids.add(health["pid"])
         assert health["routing"].keys() == {
-            "prefix", "least_loaded", "round_robin"}
+            "prefix", "prefix_spill", "least_loaded", "round_robin"}
         port_k = admin_port_for(topo.port, k)
         assert _metric(port_k, "vllm:api_server_index") == float(k)
         assert _metric(port_k, "vllm:api_server_count") == float(N_FRONTENDS)

@@ -106,7 +106,7 @@ def test_routing_stats_drain_semantics():
     # Peek (health endpoint) leaves pending hit lengths in place.
     peek = stats.snapshot(drain=False)
     assert peek["decisions"] == {
-        "prefix": 2, "least_loaded": 1, "round_robin": 0}
+        "prefix": 2, "prefix_spill": 0, "least_loaded": 1, "round_robin": 0}
     assert peek["hit_blocks"] == [3, 5]
 
     # Drain (metrics renderer) takes ownership exactly once.

@@ -37,6 +37,13 @@ Examples:
     python tools/chaos_run.py --model /path/to/ckpt --seed 7 \
         --engine-kills 0 --host-death --host-rejoin
 
+    # tiered KV fabric under fire: dead peer / torn transfer during a
+    # fabric fetch must degrade to recompute with zero lost requests
+    # (env spec reaches the engine-core procs before spawn)
+    VLLM_TPU_FAILPOINTS='kv_fabric.fetch=2*raise(ConnectionError)' \
+    python tools/chaos_run.py --model /path/to/ckpt --seed 7 \
+        --dp 2 --kv-fabric --engine-kills 0
+
 Engine-core/coordinator *processes* inherit failpoints through the
 environment (export VLLM_TPU_FAILPOINTS before running this tool);
 ``--failpoints`` arms the frontend process mid-run via the chaos plan.
@@ -99,6 +106,11 @@ def build_parser() -> argparse.ArgumentParser:
                    help="crash strikes before a suspect is dead-lettered")
     p.add_argument("--step-watchdog", type=float, default=5.0,
                    help="step watchdog deadline used by hang_step mode")
+    p.add_argument("--kv-fabric", action="store_true",
+                   help="enable the tiered KV fabric connector "
+                        "(kv_connector='fabric'); combine with "
+                        "kv_fabric.fetch / kv_fabric.demote failpoints "
+                        "to chaos-test fetch/demotion degradation")
     p.add_argument("--requests", type=int, default=16)
     p.add_argument("--max-tokens", type=int, default=8)
     p.add_argument("--concurrency", type=int, default=4)
@@ -282,6 +294,7 @@ def main(argv: list[str] | None = None) -> int:
         step_watchdog_s=(args.step_watchdog
                          if args.poison_mode == "hang_step" else 0.0),
         numeric_guard=(args.poison_mode == "nan"),
+        kv_connector="fabric" if args.kv_fabric else None,
     ))
     try:
         report = asyncio.run(run_chaos(

@@ -102,6 +102,15 @@ REQUIRED_PERFWATCH_METRICS = {
     "vllm:perfwatch_captures_aborted_total",
 }
 
+# Documented in the README ("Tiered KV fabric"); the cross-engine
+# prefix-hit acceptance test and chaos scenarios assert on these names.
+REQUIRED_KV_FABRIC_METRICS = {
+    "vllm:kv_fabric_tier_blocks",
+    "vllm:kv_fabric_fetch_total",
+    "vllm:kv_fabric_demotions_total",
+    "vllm:kv_fabric_fetch_bytes_total",
+}
+
 
 def check() -> list[str]:
     """Return a list of lint errors (empty = clean)."""
@@ -183,6 +192,10 @@ def check() -> list[str]:
     for name in sorted(REQUIRED_PERFWATCH_METRICS - set(seen)):
         errors.append(
             f"required perfwatch metric {name} is missing from "
+            f"the registry (documented in README)")
+    for name in sorted(REQUIRED_KV_FABRIC_METRICS - set(seen)):
+        errors.append(
+            f"required kv-fabric metric {name} is missing from "
             f"the registry (documented in README)")
 
     return errors

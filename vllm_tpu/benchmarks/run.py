@@ -201,6 +201,14 @@ def _run_sessions(args, params) -> dict:
       seconds over wall time — the per-frontend number that motivates
       ``--api-server-count`` scale-out (each shard of a multi-server
       topology exposes its own via the admin-port ``/debug/requests``).
+
+    When a KV connector is configured (``--kv-connector fabric``) the
+    benchmark first runs the identical workload with the connector
+    disabled and records it under ``pre_fabric_baseline`` — the
+    apples-to-apples same-run reference the acceptance criterion
+    compares follow-up-turn hit rate against — then runs the fabric
+    pass and attaches ``kv_fabric`` (per-tier hit breakdown, fetch
+    outcomes, fetch bytes) to the scored JSON.
     """
     from dataclasses import replace as _rep
 
@@ -209,14 +217,38 @@ def _run_sessions(args, params) -> dict:
     from vllm_tpu.sampling_params import RequestOutputKind
 
     fields = {f.name for f in __import__("dataclasses").fields(AsyncEngineArgs)}
-    engine_args = AsyncEngineArgs(
+    base_args = AsyncEngineArgs(
         **{k: v for k, v in vars(args).items() if k in fields}
     )
     params = _rep(params, output_kind=RequestOutputKind.DELTA)
     n_sessions = args.sessions
     n_turns = args.turns_per_session
     vocab = 30000
-    engine = AsyncLLM.from_engine_args(engine_args)
+
+    def _one_pass(engine_args) -> dict:
+        engine = AsyncLLM.from_engine_args(engine_args)
+        return _sessions_pass(engine, args, params, n_sessions, n_turns,
+                              vocab)
+
+    if getattr(base_args, "kv_connector", None):
+        baseline = _one_pass(_rep(base_args, kv_connector=None))
+        result = _one_pass(base_args)
+        result["pre_fabric_baseline"] = {
+            k: baseline.get(k)
+            for k in ("prefix_hit_rate", "prefix_hit_rate_followup_turns",
+                      "output_tokens_per_s", "elapsed_s")
+        }
+    else:
+        result = _one_pass(base_args)
+    _emit(result, args.json_out)
+    return result
+
+
+def _sessions_pass(engine, args, params, n_sessions: int, n_turns: int,
+                   vocab: int) -> dict:
+    """One full measured sessions run against ``engine`` (owns shutdown)."""
+    from dataclasses import replace as _rep
+
     try:
         # turns[i] = (turn_index, prompt_tokens, cached_tokens, gen_tokens)
         turns: list = []
@@ -292,7 +324,16 @@ def _run_sessions(args, params) -> dict:
         routing = engine.routing_status()
         if routing is not None:
             result["routing_decisions"] = routing.get("decisions")
-        _emit(result, args.json_out)
+        fab = getattr(engine, "kv_fabric_status", None)
+        fab = fab() if fab is not None else {}
+        if fab:
+            result["kv_fabric"] = {
+                "tier_hits": fab.get("tier_hits"),
+                "tier_blocks": fab.get("tier_blocks"),
+                "fetch": fab.get("fetch"),
+                "fetch_bytes": fab.get("fetch_bytes"),
+                "demotions": fab.get("demotions"),
+            }
         return result
     finally:
         engine.shutdown()

@@ -112,12 +112,26 @@ class CacheConfig:
     # full attention); drives out-of-window block freeing.
     sliding_window: int | None = None
     # External KV store ("host_offload" = content-addressed host-RAM tier
-    # reloading evicted prefixes; seam for disaggregated prefill).
+    # reloading evicted prefixes; "fabric" = the full tiered KV fabric:
+    # host RAM + peer engines behind a fetch-vs-recompute cost model).
     kv_connector: str | None = None
     kv_connector_cache_gb: float = 4.0
-    # "host:port" of the shared KV block store (kv_connector="remote"):
-    # the disaggregated-prefill transport between engines.
+    # "host:port" of the shared KV block store: the disaggregated-prefill
+    # transport (kv_connector="remote"), or a write-through shared cold
+    # tier (kv_connector="fabric").
     kv_connector_url: str | None = None
+    # Tiered KV fabric (kv_connector="fabric"): cold-tier codec applied
+    # on demotion to host RAM and on the peer wire ("none"|"int8"|"int4").
+    kv_fabric_quant: str = "int8"
+    # "host:port" this engine serves its host tier on (None = don't serve
+    # peers). In DP pools the client assigns per-engine binds/peers.
+    kv_fabric_bind: str | None = None
+    # Peer fabric endpoints, comma-separated string or sequence of
+    # "host:port".
+    kv_fabric_peers: str | tuple | list | None = None
+    # Pin the cost model's link bandwidth (GB/s); None = live EWMA over
+    # observed transfers (env VLLM_TPU_KV_FABRIC_LINK_GBPS also pins).
+    kv_fabric_link_gbps: float | None = None
     # KV-cache event publishing endpoint (ZMQ PUB, e.g. tcp://*:5557) for
     # cache-aware routers; None disables (reference: kv_events.py).
     kv_events_endpoint: str | None = None
@@ -130,6 +144,19 @@ class CacheConfig:
             "float32",
         ):
             raise ValueError(f"unknown cache_dtype {self.cache_dtype!r}")
+        if self.kv_fabric_quant not in ("none", "int8", "int4"):
+            raise ValueError(
+                f"unknown kv_fabric_quant {self.kv_fabric_quant!r}; "
+                "expected 'none', 'int8' or 'int4'")
+
+    @property
+    def kv_fabric_peer_list(self) -> list[str]:
+        peers = self.kv_fabric_peers
+        if not peers:
+            return []
+        if isinstance(peers, str):
+            return [p.strip() for p in peers.split(",") if p.strip()]
+        return list(peers)
 
 
 @dataclass

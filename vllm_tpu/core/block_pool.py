@@ -52,6 +52,11 @@ class BlockPool:
         # KV event sink (``kv_events.KVEventPublisher.record``): block
         # store/evict/clear notifications for cache-aware routers.
         self.event_sink = event_sink
+        # KV-fabric demotion sink (``KVFabric.note_device_eviction``):
+        # called with the block hash when the LAST resident copy of a
+        # cached block leaves HBM. Wired by EngineCore when the fabric
+        # connector is active.
+        self.demote_sink = None
         self.block_size = block_size
 
         self.blocks = [KVCacheBlock(block_id=i) for i in range(num_blocks)]
@@ -198,12 +203,17 @@ class BlockPool:
                 del self.cached_block_hash_to_block[key]
                 removed_last = True
         block.reset_hash()
-        if removed_last and self.event_sink is not None:
-            from vllm_tpu.core.kv_events import BlockRemoved
+        if removed_last:
+            if self.event_sink is not None:
+                from vllm_tpu.core.kv_events import BlockRemoved
 
-            self.event_sink(BlockRemoved(
-                block_hashes=[bytes(key.block_hash)]
-            ))
+                self.event_sink(BlockRemoved(
+                    block_hashes=[bytes(key.block_hash)]
+                ))
+            if self.demote_sink is not None:
+                # KV-fabric demotion hook: this prefix is no longer
+                # resident in HBM anywhere (last copy evicted).
+                self.demote_sink(bytes(key.block_hash))
         return True
 
     def touch(self, blocks: list[KVCacheBlock]) -> None:

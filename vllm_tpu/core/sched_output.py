@@ -230,6 +230,10 @@ class SchedulerStats:
     perfwatch_device_ms: dict | None = None
     perfwatch_mfu_est: float | None = None
     perfwatch_hbm_bw_util_est: float | None = None
+    # Tiered KV fabric snapshot (attached by EngineCore when the fabric
+    # connector is active): per-tier resident blocks, cumulative fetch
+    # outcomes / demotions / transferred bytes. None = fabric off.
+    kv_fabric: dict | None = None
 
 
 @dataclass

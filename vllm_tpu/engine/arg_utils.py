@@ -49,6 +49,10 @@ class EngineArgs:
     kv_connector: str | None = None
     kv_connector_cache_gb: float = 4.0
     kv_connector_url: str | None = None
+    kv_fabric_quant: str = "int8"
+    kv_fabric_bind: str | None = None
+    kv_fabric_peers: str | None = None
+    kv_fabric_link_gbps: float | None = None
     kv_events_endpoint: str | None = None
 
     max_num_batched_tokens: int = 8192
@@ -166,6 +170,10 @@ class EngineArgs:
                 kv_connector=self.kv_connector,
                 kv_connector_cache_gb=self.kv_connector_cache_gb,
                 kv_connector_url=self.kv_connector_url,
+                kv_fabric_quant=self.kv_fabric_quant,
+                kv_fabric_bind=self.kv_fabric_bind,
+                kv_fabric_peers=self.kv_fabric_peers,
+                kv_fabric_link_gbps=self.kv_fabric_link_gbps,
                 kv_events_endpoint=self.kv_events_endpoint,
             ),
             parallel_config=ParallelConfig(

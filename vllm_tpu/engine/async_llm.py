@@ -779,6 +779,15 @@ class AsyncLLM:
             return client.routing_status(drain=drain)
         return None
 
+    def kv_fabric_status(self) -> dict:
+        """Tiered-KV-fabric snapshot (per-tier occupancy, fetch
+        outcomes, demotions, transferred bytes) — pool-merged under the
+        DP client; {} when no fabric connector is configured."""
+        client = self.engine_core
+        if hasattr(client, "kv_fabric_status"):
+            return client.kv_fabric_status()
+        return {}
+
     def debug_deadletter(self) -> dict:
         """Dead-letter introspection (/debug/deadletter): quarantined
         poison requests with their strike history."""

@@ -65,6 +65,28 @@ def make_client(config: EngineConfig):
     return MPClient(config) if mp else InprocClient(config)
 
 
+def _merge_numeric(acc: dict, snap: dict) -> dict:
+    """Fold one engine's fabric snapshot into the pool aggregate: numeric
+    leaves sum, dicts recurse, anything else keeps the first value seen
+    (booleans are config echoes, not counters — excluded from summing)."""
+    out = dict(acc)
+    for k, v in snap.items():
+        if isinstance(v, dict):
+            # A leaf can be None on one engine and a dict on another
+            # (e.g. cost.last_decision before that engine ever decided).
+            prev = out.get(k)
+            out[k] = _merge_numeric(prev if isinstance(prev, dict) else {}, v)
+        elif isinstance(v, bool):
+            out.setdefault(k, v)
+        elif isinstance(v, (int, float)) and isinstance(
+            out.get(k), (int, float)
+        ):
+            out[k] = out[k] + v
+        else:
+            out.setdefault(k, v)
+    return out
+
+
 class InprocClient:
     """Direct in-process EngineCore (the default single-host path)."""
 
@@ -132,6 +154,9 @@ class InprocClient:
 
     def perf_ab(self, opts: dict | None = None) -> dict:
         return self.engine_core.perf_ab(opts)
+
+    def kv_fabric_status(self) -> dict:
+        return self.engine_core.kv_fabric_status()
 
     def poll_perfwatch(self) -> None:
         """Drive perfwatch capture/A-B scheduling (no-op when disabled).
@@ -550,6 +575,9 @@ class _ZMQClientBase:
         # make this slow on first use.
         return self._utility("perf_ab", opts, timeout_ms=600_000)
 
+    def kv_fabric_status(self) -> dict:
+        return self._utility("kv_fabric_status", timeout_ms=60_000)
+
 
 class MPClient(_ZMQClientBase):
     """Engine core in a spawned process, msgpack over ipc ZMQ sockets."""
@@ -863,9 +891,37 @@ class DPLBClient(_ZMQClientBase):
         self._engine_cfg_bytes: list[bytes] = []
         self._engine_kwargs: list[dict] = []
         kv_endpoints: dict[int, str] = {}
+        # Tiered KV fabric in a DP pool: each engine serves its host tier
+        # on a pre-assigned loopback port and peers with every other
+        # engine's, so a prefix demoted to any engine's host RAM is
+        # fetchable pool-wide. Explicit binds/peers in config win.
+        fabric_binds: list[str] | None = None
+        if (
+            config.cache_config.kv_connector == "fabric"
+            and n > 1
+            and not config.cache_config.kv_fabric_bind
+        ):
+            import socket as _socket
+
+            picked = []
+            for _ in range(n):
+                s = _socket.socket()
+                s.bind(("127.0.0.1", 0))
+                picked.append(s)
+            fabric_binds = [
+                f"127.0.0.1:{s.getsockname()[1]}" for s in picked
+            ]
+            for s in picked:
+                s.close()
         for eid in range(n):
             engine_config = copy.deepcopy(config)
             engine_config.parallel_config.data_parallel_engines = 1
+            if fabric_binds is not None:
+                engine_config.cache_config.kv_fabric_bind = (
+                    fabric_binds[eid])
+                engine_config.cache_config.kv_fabric_peers = [
+                    b for i, b in enumerate(fabric_binds) if i != eid
+                ]
             ep = engine_config.cache_config.kv_events_endpoint
             if ep and eid > 0:
                 # Each engine binds its OWN endpoint; rank 0 keeps the
@@ -930,8 +986,16 @@ class DPLBClient(_ZMQClientBase):
             self._kv_subscriber = KVEventSubscriber(
                 self._prefix_index, kv_endpoints
             )
+            # With the tiered fabric, a spilled request's prefix is
+            # fetchable from the owning peer — arm the spillover rung so
+            # affinity yields to load balance under imbalance.
             self._prefix_router = PrefixAwareRouter(
-                self._prefix_index, config.cache_config.block_size
+                self._prefix_index, config.cache_config.block_size,
+                spill_threshold=(
+                    int(os.environ.get(
+                        "VLLM_TPU_PREFIX_SPILL_THRESHOLD", "4"))
+                    if config.cache_config.kv_connector == "fabric"
+                    else None),
             )
             self._routing_stats = RoutingStats()
 
@@ -1310,6 +1374,36 @@ class DPLBClient(_ZMQClientBase):
         )
         replies.sort(key=lambda r: r.get("engine_id", 0))
         return replies[0]["ok"]
+
+    def kv_fabric_status(self) -> dict:
+        """Pool-wide fabric snapshot: broadcast to every UP engine and
+        merge numeric leaves (counter sums, tier-occupancy totals), with
+        the per-engine snapshots preserved under "engines"."""
+        self._check_alive()
+        up = [
+            i for i in range(self._num_engines) if self._engine_up[i]
+        ]
+        if not up:
+            return {}
+        for eid in up:
+            self._inputs[eid].send_multipart([
+                self._proc_mod.MSG_UTILITY,
+                b"kv_fabric_status",
+                self._serial.encode([]),
+            ])
+        replies = self._collect_utility_replies(
+            "kv_fabric_status", len(up), 60_000
+        )
+        replies.sort(key=lambda r: r.get("engine_id", 0))
+        per_engine = {
+            r.get("engine_id", i): r["ok"]
+            for i, r in enumerate(replies) if r.get("ok")
+        }
+        merged: dict = {}
+        for snap in per_engine.values():
+            merged = _merge_numeric(merged, snap)
+        merged["engines"] = {str(k): v for k, v in per_engine.items()}
+        return merged
 
     @property
     def inflight(self) -> bool:

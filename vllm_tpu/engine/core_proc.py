@@ -354,6 +354,10 @@ def run_engine_core(config_bytes: bytes, input_addr: str,
             # the top of the busy loop).
             report_load()
             if not core.has_unfinished_requests():
+                # Saves queued at the finish of the last running request
+                # must not wait for the next request's step: peers query
+                # this engine's host tier through the KV fabric.
+                core.flush_kv_saves()
                 if lockstep and global_unfinished:
                     # Other DP ranks are mid-wave: keep collectives alive.
                     core.execute_dummy_batch()

@@ -94,6 +94,10 @@ class EngineCore:
             config.cache_config.kv_connector,
             config.cache_config.kv_connector_cache_gb,
             config.cache_config.kv_connector_url,
+            quant=config.cache_config.kv_fabric_quant,
+            bind=config.cache_config.kv_fabric_bind,
+            peers=config.cache_config.kv_fabric_peer_list,
+            link_gbps=config.cache_config.kv_fabric_link_gbps,
         )
         if (
             self.kv_connector is not None
@@ -119,6 +123,27 @@ class EngineCore:
         )
         if self.kv_connector is not None:
             self.executor.collective_rpc("set_kv_connector", self.kv_connector)
+            if hasattr(self.kv_connector, "set_roofline"):
+                # Hand the fabric's cost model the worker's measured
+                # RooflineModel: the fetch-vs-recompute arbiter and the
+                # engine's perf telemetry agree on device capability by
+                # construction.
+                try:
+                    from vllm_tpu.metrics.roofline import RooflineModel
+
+                    info = self.executor.collective_rpc("roofline_info")[0]
+                    if info:
+                        self.kv_connector.set_roofline(
+                            RooflineModel.from_dict(info))
+                except Exception as exc:
+                    logger.warning(
+                        "kv fabric: roofline unavailable (%s); cost model "
+                        "uses defaults", exc)
+            if hasattr(self.kv_connector, "note_device_eviction"):
+                # Demotion hook: HBM prefix-cache evictions are reported
+                # as device-tier demotions.
+                self.scheduler.kv_cache_manager.block_pool.demote_sink = (
+                    self.kv_connector.note_device_eviction)
         self._block_hasher = (
             make_block_hasher(config.cache_config.block_size)
             if config.cache_config.enable_prefix_caching
@@ -222,13 +247,10 @@ class EngineCore:
             # Tokens finalized during an elastic-resize drain: deliver
             # before any new work.
             return self._drained_outputs.popleft()
-        if self.kv_connector is not None:
-            # Persist freed requests' blocks BEFORE any new scheduling can
-            # hand those blocks to someone else (in-flight steps were
-            # scheduled before the free, so the payload is still intact).
-            saves = self.scheduler.take_pending_kv_saves()
-            if saves:
-                self.executor.collective_rpc("kv_connector_save", saves)
+        # Persist freed requests' blocks BEFORE any new scheduling can
+        # hand those blocks to someone else (in-flight steps were
+        # scheduled before the free, so the payload is still intact).
+        self.flush_kv_saves()
         while (
             len(self._inflight) < self._max_inflight
             and self.scheduler.has_unfinished_requests()
@@ -400,6 +422,37 @@ class EngineCore:
         if self.perfwatch is not None:
             for key, value in self.perfwatch.stats_fields().items():
                 setattr(stats, key, value)
+        if self.kv_connector is not None and hasattr(
+            self.kv_connector, "fabric_stats"
+        ):
+            stats.kv_fabric = self.kv_fabric_status()
+
+    def flush_kv_saves(self) -> None:
+        """Ship pending request-finish KV saves to the worker connector.
+
+        Called at the top of every step, and by the engine-core proc's
+        idle branch: a block demoted at the finish of the LAST running
+        request must still reach the host tier promptly — peer engines
+        query it over the fabric — not wait for this engine's next
+        request to trigger a step."""
+        if self.kv_connector is not None:
+            saves = self.scheduler.take_pending_kv_saves()
+            if saves:
+                self.executor.collective_rpc("kv_connector_save", saves)
+
+    def kv_fabric_status(self) -> dict:
+        """Tiered-fabric snapshot (tier occupancy, fetch outcomes,
+        demotions, transferred bytes) with the device tier folded in from
+        the block pool's resident-hash map."""
+        if self.kv_connector is None or not hasattr(
+            self.kv_connector, "fabric_stats"
+        ):
+            return {}
+        snap = self.kv_connector.fabric_stats()
+        snap["tier_blocks"]["device"] = len(
+            self.scheduler.kv_cache_manager.block_pool
+            .cached_block_hash_to_block)
+        return snap
 
     def suspect_req_ids(self) -> list[str]:
         """Request ids that were scheduled on the device when this call

@@ -10,9 +10,11 @@ Reference analog: ``vllm/distributed/kv_transfer/kv_connector/v1/base.py``
 - worker side: ``load_blocks`` / ``save_blocks`` moving block payloads
   between the device cache and the external medium.
 
-``host_offload`` ships in-tree: a content-addressed host-RAM tier that
-survives device prefix-cache eviction. Disaggregated prefill over DCN
-plugs into the same seam.
+As of the tiered KV fabric (``vllm_tpu/kv_fabric/``), ``host_offload``
+is a single-tier fabric (host RAM, no quantization, no peers) and
+``fabric`` is the full ladder — host tier + cold-tier quantization +
+peer engines behind the fetch-vs-recompute cost model. ``remote`` keeps
+the legacy standalone TCP block store for disaggregated prefill.
 """
 
 from vllm_tpu.kv_connector.base import KVConnectorBase
@@ -20,12 +22,34 @@ from vllm_tpu.kv_connector.host_offload import HostOffloadKVConnector
 
 
 def make_kv_connector(
-    name: str | None, cache_gb: float = 4.0, url: str | None = None
+    name: str | None,
+    cache_gb: float = 4.0,
+    url: str | None = None,
+    quant: str = "none",
+    bind: str | None = None,
+    peers=(),
+    link_gbps: float | None = None,
 ):
     if name is None:
         return None
+    max_bytes = int(cache_gb * (1 << 30))
     if name == "host_offload":
-        return HostOffloadKVConnector(max_bytes=int(cache_gb * (1 << 30)))
+        # Absorbed by the fabric: same behavior (lossless, local-only),
+        # one code path.
+        from vllm_tpu.kv_fabric.fabric import KVFabric
+
+        return KVFabric(host_bytes=max_bytes, quant="none")
+    if name == "fabric":
+        from vllm_tpu.kv_fabric.fabric import KVFabric
+
+        return KVFabric(
+            host_bytes=max_bytes,
+            quant=quant,
+            bind=bind,
+            peers=tuple(peers or ()),
+            store_url=url,
+            link_gbps=link_gbps,
+        )
     if name == "remote":
         from vllm_tpu.kv_connector.remote import RemoteKVConnector
 
@@ -36,7 +60,7 @@ def make_kv_connector(
         return RemoteKVConnector(url)
     raise ValueError(
         f"unknown kv connector {name!r}; available: "
-        "['host_offload', 'remote']"
+        "['host_offload', 'fabric', 'remote']"
     )
 
 

@@ -20,6 +20,7 @@ transports — no auth): length-prefixed frames, each a JSON header
 from __future__ import annotations
 
 import json
+import os
 import socket
 import struct
 import threading
@@ -247,11 +248,30 @@ class KVStoreServer:
 
 class RemoteKVConnector(KVConnectorBase):
     """Client half: both the prefill and decode engines point at the same
-    store URL ("host:port")."""
+    store URL ("host:port").
 
-    def __init__(self, url: str) -> None:
+    Every socket carries a timeout (``timeout_s``, or env
+    ``VLLM_TPU_KV_STORE_TIMEOUT_S``, default 30 s) so a stalled store —
+    accepted connection, no reply — surfaces as ``socket.timeout``
+    (an ``OSError``) instead of blocking the scheduler forever, and RPCs
+    retry with exponential backoff up to ``max_retries`` reconnects
+    before raising."""
+
+    def __init__(
+        self,
+        url: str,
+        timeout_s: float | None = None,
+        max_retries: int = 2,
+        backoff_s: float = 0.05,
+    ) -> None:
         host, _, port = url.rpartition(":")
         self.addr = (host or "127.0.0.1", int(port))
+        if timeout_s is None:
+            timeout_s = float(
+                os.environ.get("VLLM_TPU_KV_STORE_TIMEOUT_S", 30.0))
+        self.timeout_s = timeout_s
+        self.max_retries = max_retries
+        self.backoff_s = backoff_s
         self._sock: socket.socket | None = None
         self._lock = threading.Lock()
         self.queries = 0
@@ -260,25 +280,35 @@ class RemoteKVConnector(KVConnectorBase):
 
     # -- transport -----------------------------------------------------
 
+    def _connect(self) -> socket.socket:
+        sock = socket.create_connection(self.addr, timeout=self.timeout_s)
+        sock.settimeout(self.timeout_s)
+        sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        return sock
+
     def _rpc(self, header: dict, blobs: list[bytes]) -> tuple[dict, bytes]:
         with self._lock:
-            if self._sock is None:
-                self._sock = socket.create_connection(self.addr, timeout=30)
-                self._sock.setsockopt(
-                    socket.IPPROTO_TCP, socket.TCP_NODELAY, 1
-                )
-            try:
-                _send_frame(self._sock, header, blobs)
-                return _recv_frame(self._sock)
-            except (ConnectionError, OSError):
-                # One reconnect attempt (store restarts are survivable).
+            last_exc: Exception | None = None
+            for attempt in range(self.max_retries + 1):
                 try:
-                    self._sock.close()
-                except OSError:
-                    pass
-                self._sock = socket.create_connection(self.addr, timeout=30)
-                _send_frame(self._sock, header, blobs)
-                return _recv_frame(self._sock)
+                    if self._sock is None:
+                        self._sock = self._connect()
+                    _send_frame(self._sock, header, blobs)
+                    return _recv_frame(self._sock)
+                except (ConnectionError, OSError) as exc:
+                    last_exc = exc
+                    if self._sock is not None:
+                        try:
+                            self._sock.close()
+                        except OSError:
+                            pass
+                        self._sock = None
+                    if attempt < self.max_retries:
+                        time.sleep(self.backoff_s * (2 ** attempt))
+            raise ConnectionError(
+                f"kv store {self.addr} unreachable after "
+                f"{self.max_retries + 1} attempts: {last_exc}"
+            ) from last_exc
 
     @staticmethod
     def _hex(keys: Sequence[Any]) -> list[str]:

@@ -1,0 +1,16 @@
+"""Tiered KV fabric: device HBM -> host RAM -> peer engines, behind one
+lookup/fetch/evict interface with a fetch-vs-recompute cost model and
+cold-tier quantization."""
+
+from vllm_tpu.kv_fabric.cost_model import CostDecision, FetchCostModel
+from vllm_tpu.kv_fabric.fabric import HostTier, KVFabric
+from vllm_tpu.kv_fabric.peer import PeerClient, PeerServer
+
+__all__ = [
+    "CostDecision",
+    "FetchCostModel",
+    "HostTier",
+    "KVFabric",
+    "PeerClient",
+    "PeerServer",
+]

@@ -1,0 +1,402 @@
+"""Unified tiered KV fabric: device HBM -> host RAM -> peer engines.
+
+One lookup/fetch/evict surface over every cached KV byte in the pool,
+replacing the three disjoint stores that predate it (device prefix cache,
+host-offload connector, remote block store):
+
+- **device** — the paged HBM cache (`core/kv_cache_manager.py`). The
+  fabric does not own it; the scheduler consults it first and reports
+  HBM evictions into the fabric via ``note_device_eviction`` (the
+  block-pool demote sink).
+- **host** — :class:`HostTier`, byte-budgeted LRU over host RAM, holding
+  blocks demoted from HBM at request finish. Cold-tier quantization
+  (``ops/kv_quant.py``) happens on the way in; promotion dequantizes.
+- **peers** — other engines' host tiers (and optionally a standalone
+  block store), reached over :mod:`~vllm_tpu.kv_fabric.peer`. Blocks
+  cross the wire in their stored (quantized) form.
+
+Whether a peer hit is worth taking is not free-for-all: the
+:class:`~vllm_tpu.kv_fabric.cost_model.FetchCostModel` compares transfer
+time over the measured link against re-prefilling on the device
+roofline, and the fabric only plans a fetch when it wins. Every remote
+decision is counted (fetched / recompute / miss / failed) and exported
+through ``fabric_stats()`` into the engine's Prometheus families.
+
+The fabric implements :class:`KVConnectorBase`, so the scheduler and
+worker drive it through the exact seams the old connectors used —
+admission match, request-finish persistence, batched D2H save, batched
+H2D load with invalid-load recovery on failure.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import OrderedDict
+from typing import Any, Sequence
+
+import numpy as np
+
+from vllm_tpu.kv_connector.base import KVConnectorBase
+from vllm_tpu.kv_fabric.cost_model import FetchCostModel
+from vllm_tpu.kv_fabric.peer import PeerClient, PeerServer
+from vllm_tpu.logger import init_logger
+from vllm_tpu.ops.kv_quant import (
+    encoded_nbytes,
+    maybe_dequantize,
+    maybe_quantize,
+)
+
+logger = init_logger(__name__)
+
+# Planned-fetch map cap: entries are consumed at load time; anything
+# beyond this is a leak from preempted/abandoned admissions.
+_MAX_PLANNED = 4096
+
+
+class HostTier:
+    """Byte-budgeted LRU host-RAM tier, storing blocks in encoded form
+    (raw ndarray for quant="none", :class:`QuantizedBlock` otherwise).
+    Thread-safe: the owning engine and the peer server hit it
+    concurrently."""
+
+    def __init__(self, max_bytes: int, quant: str = "none") -> None:
+        self.max_bytes = max_bytes
+        self.quant = quant
+        self._store: OrderedDict[str, Any] = OrderedDict()
+        self._bytes = 0
+        self._lock = threading.RLock()
+        self.evictions = 0
+
+    def __getstate__(self) -> dict:
+        state = self.__dict__.copy()
+        del state["_lock"]
+        return state
+
+    def __setstate__(self, state: dict) -> None:
+        self.__dict__.update(state)
+        self._lock = threading.RLock()
+
+    # ------------------------------------------------------------------
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._store)
+
+    @property
+    def bytes_used(self) -> int:
+        with self._lock:
+            return self._bytes
+
+    def contains(self, key: str) -> bool:
+        with self._lock:
+            return key in self._store
+
+    def match(self, keys: Sequence[str]) -> int:
+        """Length of the consecutive prefix of ``keys`` present here
+        (LRU-touching the hits)."""
+        n = 0
+        with self._lock:
+            for k in keys:
+                if k not in self._store:
+                    break
+                self._store.move_to_end(k)
+                n += 1
+        return n
+
+    def put(self, keys: Sequence[str], payloads: Sequence[Any]) -> None:
+        """Demotion path: encode (quantize) raw device payloads in."""
+        self.put_encoded(
+            keys, [maybe_quantize(p, self.quant) for p in payloads])
+
+    def put_encoded(self, keys: Sequence[str], values: Sequence[Any]) -> None:
+        """Insert already-encoded entries (peer puts, promotions)."""
+        with self._lock:
+            for k, v in zip(keys, values):
+                if k in self._store:
+                    continue
+                self._store[k] = v
+                self._bytes += encoded_nbytes(v)
+            while self._bytes > self.max_bytes and self._store:
+                _, ev = self._store.popitem(last=False)
+                self._bytes -= encoded_nbytes(ev)
+                self.evictions += 1
+
+    def get_encoded(self, keys: Sequence[str]) -> list[Any]:
+        """Stored-form entries for keys; KeyError on any miss."""
+        with self._lock:
+            out = [self._store[k] for k in keys]
+            for k in keys:
+                self._store.move_to_end(k)
+            return out
+
+    def load(self, keys: Sequence[str]) -> list[np.ndarray]:
+        """Promotion path: decoded (dequantized) payloads."""
+        return [maybe_dequantize(v) for v in self.get_encoded(keys)]
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "blocks": len(self._store),
+                "bytes": self._bytes,
+                "quant": self.quant,
+                "evictions": self.evictions,
+            }
+
+
+class KVFabric(KVConnectorBase):
+    """The tiered fabric behind the standard KV-connector seams.
+
+    Parameters
+    ----------
+    host_bytes: host-RAM tier budget.
+    quant: cold-tier codec ("none" | "int8" | "int4") applied on
+        demotion to host RAM; peers receive/serve the encoded form.
+    bind: "host:port" to serve this engine's host tier to peers
+        (``None`` disables the peer server — single-engine mode).
+    peers: URLs of other engines' fabric servers (and/or a standalone
+        ``python -m vllm_tpu.kv_fabric.peer`` store).
+    store_url: optional always-on block store that additionally receives
+        every persisted block (write-through), queried like a peer.
+    link_gbps: pin the cost model's link bandwidth (tests / known
+        fabrics); default is a live EWMA over observed transfers.
+    """
+
+    def __init__(
+        self,
+        host_bytes: int,
+        quant: str = "none",
+        bind: str | None = None,
+        peers: Sequence[str] = (),
+        store_url: str | None = None,
+        link_gbps: float | None = None,
+        cost_model: FetchCostModel | None = None,
+    ) -> None:
+        self.host = HostTier(host_bytes, quant)
+        self.quant = quant
+        self.bind = bind
+        self.store_url = store_url
+        self.peer_urls = tuple(dict.fromkeys(
+            list(peers) + ([store_url] if store_url else [])))
+        self.cost = cost_model or FetchCostModel(
+            link_bw=link_gbps * 1e9 if link_gbps else None)
+        self._clients: dict[str, PeerClient] = {}
+        self._server: PeerServer | None = None
+        self._plan: OrderedDict[str, str] = OrderedDict()  # key -> peer url
+        self._block_bytes: float | None = None  # EWMA of encoded block size
+        self.queries = 0
+        self.hits = {"host": 0, "peer": 0}
+        self.fetch_outcomes = {
+            "fetched": 0, "recompute": 0, "miss": 0, "failed": 0}
+        self.demotions = {"device": 0, "host": 0, "store": 0}
+        self.fetch_bytes = 0
+        if bind is not None:
+            host, _, port = bind.rpartition(":")
+            self._server = PeerServer(
+                self.host, host or "127.0.0.1", int(port)).start()
+
+    # -- plumbing ------------------------------------------------------
+
+    def __getstate__(self) -> dict:
+        # Live sockets don't pickle; a spawned copy rebuilds clients
+        # lazily and does NOT restart the peer server (the originating
+        # process keeps serving).
+        state = self.__dict__.copy()
+        state["_clients"] = {}
+        state["_server"] = None
+        return state
+
+    def close(self) -> None:
+        if self._server is not None:
+            self._server.shutdown()
+            self._server = None
+        for c in self._clients.values():
+            c.close()
+        self._clients.clear()
+
+    def _client(self, url: str) -> PeerClient:
+        c = self._clients.get(url)
+        if c is None:
+            c = self._clients[url] = PeerClient(url)
+        return c
+
+    @staticmethod
+    def _hex(keys: Sequence[Any]) -> list[str]:
+        return [
+            k.hex() if isinstance(k, (bytes, bytearray)) else str(k)
+            for k in keys
+        ]
+
+    def set_roofline(self, roofline) -> None:
+        self.cost.set_roofline(roofline)
+
+    def note_device_eviction(self, key: Any) -> None:
+        """Block-pool demote sink: a cached block fell out of HBM."""
+        self.demotions["device"] += 1
+
+    def note_fetch_failure(self, req_id: str | None = None) -> None:
+        """Worker-side hook: a planned fabric fetch tore mid-load. The
+        scheduler's invalid-load recovery recomputes the request; count
+        the outcome so chaos runs can assert the degradation."""
+        self.fetch_outcomes["failed"] += 1
+
+    def _note_block_bytes(self, values: Sequence[Any]) -> None:
+        for v in values:
+            n = encoded_nbytes(v)
+            if self._block_bytes is None:
+                self._block_bytes = float(n)
+            else:
+                self._block_bytes += 0.25 * (n - self._block_bytes)
+
+    def _remember_plan(self, key: str, url: str) -> None:
+        self._plan[key] = url
+        self._plan.move_to_end(key)
+        while len(self._plan) > _MAX_PLANNED:
+            self._plan.popitem(last=False)
+
+    # -- scheduler side ------------------------------------------------
+
+    def get_num_new_matched_tokens(
+        self, block_hashes: Sequence[Any], num_device_computed_tokens: int,
+        block_size: int,
+    ) -> int:
+        start = num_device_computed_tokens // block_size
+        keys = self._hex(list(block_hashes)[start:])
+        self.queries += 1
+        if not keys:
+            return 0
+        n_host = self.host.match(keys)
+        best_n, best_peer = n_host, None
+        if self.peer_urls and n_host < len(keys):
+            for url in self.peer_urls:
+                try:
+                    found = self._client(url).query(keys)
+                except (ConnectionError, OSError):
+                    continue  # dead peer == miss on that peer
+                n = 0
+                for f in found:
+                    if not f:
+                        break
+                    n += 1
+                if n > best_n:
+                    best_n, best_peer = n, url
+        if best_peer is not None:
+            extra = best_n - n_host
+            # Encoded bytes on the wire; before the first observed block
+            # the estimate is 0 (optimistic — latency-only fetch cost).
+            nbytes = int(extra * (self._block_bytes or 0))
+            decision = self.cost.decide(extra * block_size, nbytes)
+            if decision.fetch:
+                self.fetch_outcomes["fetched"] += 1
+                for k in keys[n_host:best_n]:
+                    self._remember_plan(k, best_peer)
+                if n_host:
+                    self.hits["host"] += 1
+                self.hits["peer"] += 1
+                return best_n * block_size
+            self.fetch_outcomes["recompute"] += 1
+        elif self.peer_urls and n_host < len(keys):
+            self.fetch_outcomes["miss"] += 1
+        if n_host:
+            self.hits["host"] += 1
+        return n_host * block_size
+
+    def request_finished(self, block_hashes: Sequence[Any]) -> list[int]:
+        keys = self._hex(block_hashes)
+        return [i for i, k in enumerate(keys) if not self.host.contains(k)]
+
+    # -- worker side ---------------------------------------------------
+
+    def save_blocks(self, keys: Sequence[Any], payloads) -> None:
+        """Demotion: encode device payloads into the host tier (and
+        write-through to the block store when configured)."""
+        hex_keys = self._hex(keys)
+        values = [maybe_quantize(p, self.quant) for p in payloads]
+        self._note_block_bytes(values)
+        ev_before = self.host.evictions
+        self.host.put_encoded(hex_keys, values)
+        self.demotions["host"] += self.host.evictions - ev_before
+        if self.store_url:
+            try:
+                self._client(self.store_url).put(hex_keys, values)
+                self.demotions["store"] += len(hex_keys)
+            except (ConnectionError, OSError) as exc:
+                logger.warning(
+                    "KV fabric store %s put failed (%s); blocks stay "
+                    "host-tier only", self.store_url, exc)
+
+    def load_blocks(self, keys: Sequence[Any]):
+        """Promotion: host tier first, then planned peer fetches. Any
+        unresolvable key RAISES — the scheduler already counted these
+        tokens as computed, and the invalid-load path recomputes."""
+        hex_keys = self._hex(keys)
+        encoded: dict[str, Any] = {}
+        missing: list[str] = []
+        for k in hex_keys:
+            try:
+                encoded[k] = self.host.get_encoded([k])[0]
+            except KeyError:
+                missing.append(k)
+        by_peer: dict[str, list[str]] = {}
+        for k in missing:
+            url = self._plan.get(k)
+            if url is None and self.peer_urls:
+                # Unplanned miss (e.g. host eviction raced the load):
+                # fall back to the first peer that claims it.
+                for u in self.peer_urls:
+                    try:
+                        if self._client(u).query([k])[0]:
+                            url = u
+                            break
+                    except (ConnectionError, OSError):
+                        continue
+            if url is None:
+                raise KeyError(f"KV fabric has no tier holding block {k}")
+            by_peer.setdefault(url, []).append(k)
+        try:
+            for url, ks in by_peer.items():
+                t0 = time.perf_counter()
+                values = self._client(url).get(ks)
+                dt = time.perf_counter() - t0
+                nbytes = sum(encoded_nbytes(v) for v in values)
+                self.fetch_bytes += nbytes
+                self.cost.observe_transfer(nbytes, dt)
+                self._note_block_bytes(values)
+                # Promote into the local host tier: the next request with
+                # this prefix hits locally.
+                self.host.put_encoded(ks, values)
+                for k, v in zip(ks, values):
+                    encoded[k] = v
+        finally:
+            for k in missing:
+                self._plan.pop(k, None)
+        return [maybe_dequantize(encoded[k]) for k in hex_keys]
+
+    # -- telemetry -----------------------------------------------------
+
+    def fabric_stats(self) -> dict:
+        return {
+            "tier_blocks": {"host": len(self.host)},
+            "fetch": dict(self.fetch_outcomes),
+            "demotions": dict(self.demotions),
+            "fetch_bytes": self.fetch_bytes,
+            "tier_hits": dict(self.hits),
+            "queries": self.queries,
+            "host_bytes": self.host.bytes_used,
+            "quant": self.quant,
+            "peers": list(self.peer_urls),
+            "bind": self._server.url if self._server else self.bind,
+            "cost": self.cost.stats(),
+        }
+
+    def stats(self) -> dict:
+        # Superset of the legacy host-offload connector's stats surface
+        # (scalar blocks/bytes/queries/hits) so existing dashboards and
+        # tests read the fabric unchanged.
+        s = self.fabric_stats()
+        s.update(
+            blocks=len(self.host),
+            bytes=self.host.bytes_used,
+            hits=self.hits["host"] + self.hits["peer"],
+        )
+        return s

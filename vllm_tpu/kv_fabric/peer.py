@@ -1,0 +1,296 @@
+"""Peer tier transport: engines serving their host-RAM KV tier to peers.
+
+Every engine in a data-parallel pool can expose its host tier through a
+:class:`PeerServer`; other engines reach it with a :class:`PeerClient`.
+The wire reuses the length-prefixed frame protocol from
+``kv_connector/remote.py`` (8-byte frame length, JSON header, raw
+blobs), extended with a quantization-aware entry encoding: each block
+travels either raw (``kind: "raw"``, one blob) or as a cold-tier
+quantized payload (``kind: "q"``, data + scale blobs) — quantized
+blocks cross the wire quantized, so int8 halves and int4 quarters the
+transfer bytes the cost model has to pay for.
+
+The same server doubles as the fabric's standalone block store
+(``python -m vllm_tpu.kv_fabric.peer --port 7799``) for pools that want
+a shared cold tier instead of / in addition to per-engine host RAM.
+"""
+
+from __future__ import annotations
+
+import os
+import socket
+import struct
+import threading
+import time
+from typing import Any, Sequence
+
+import numpy as np
+
+from vllm_tpu.kv_connector.remote import (
+    _recv_frame,
+    _send_frame,
+)
+from vllm_tpu.logger import init_logger
+from vllm_tpu.ops.kv_quant import QuantizedBlock
+
+logger = init_logger(__name__)
+
+ENV_TIMEOUT_S = "VLLM_TPU_KV_FABRIC_TIMEOUT_S"
+DEFAULT_TIMEOUT_S = 5.0
+
+
+# ---------------------------------------------------------------------------
+# Entry codec: raw ndarrays and QuantizedBlocks share one frame.
+
+def pack_entries(values: Sequence[Any]) -> tuple[list[dict], list[bytes]]:
+    metas: list[dict] = []
+    blobs: list[bytes] = []
+    for v in values:
+        if isinstance(v, QuantizedBlock):
+            meta, vblobs = v.to_wire()
+            metas.append(meta)
+            blobs.extend(vblobs)
+        else:
+            a = np.ascontiguousarray(v)
+            metas.append({
+                "kind": "raw",
+                "dtype": str(a.dtype),
+                "shape": list(a.shape),
+            })
+            blobs.append(a.tobytes())
+    return metas, blobs
+
+
+def unpack_entries(metas: Sequence[dict], body: bytes) -> list[Any]:
+    out: list[Any] = []
+    off = 0
+
+    def take(n: int) -> bytes:
+        nonlocal off
+        chunk = body[off:off + n]
+        off += n
+        return chunk
+
+    for meta in metas:
+        if meta["kind"] == "q":
+            data_dtype = np.dtype(meta["data_dtype"])
+            data_n = int(np.prod(meta["data_shape"])) * data_dtype.itemsize
+            scale_n = int(np.prod(meta["scale_shape"])) * 4
+            out.append(QuantizedBlock.from_wire(
+                meta, take(data_n), take(scale_n)))
+        else:
+            dtype = np.dtype(meta["dtype"])
+            n = int(np.prod(meta["shape"])) * dtype.itemsize
+            out.append(np.frombuffer(take(n), dtype=dtype).reshape(
+                meta["shape"]))
+    return out
+
+
+# ---------------------------------------------------------------------------
+
+
+class PeerClient:
+    """Blocking client for a peer's host tier, with socket timeouts and
+    bounded retry-with-backoff (a dead peer costs milliseconds, not a
+    hung engine). Raises on exhaustion — the fabric maps that to a
+    degrade-to-recompute."""
+
+    def __init__(
+        self,
+        url: str,
+        timeout_s: float | None = None,
+        max_retries: int = 2,
+        backoff_s: float = 0.05,
+    ) -> None:
+        host, _, port = url.rpartition(":")
+        self.url = url
+        self.addr = (host or "127.0.0.1", int(port))
+        if timeout_s is None:
+            timeout_s = float(
+                os.environ.get(ENV_TIMEOUT_S, DEFAULT_TIMEOUT_S))
+        self.timeout_s = timeout_s
+        self.max_retries = max_retries
+        self.backoff_s = backoff_s
+        self._sock: socket.socket | None = None
+        self._lock = threading.Lock()
+
+    def close(self) -> None:
+        with self._lock:
+            if self._sock is not None:
+                try:
+                    self._sock.close()
+                except OSError:
+                    pass
+                self._sock = None
+
+    def _connect(self) -> socket.socket:
+        sock = socket.create_connection(self.addr, timeout=self.timeout_s)
+        sock.settimeout(self.timeout_s)
+        sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        return sock
+
+    def _rpc(self, header: dict, blobs: list[bytes]) -> tuple[dict, bytes]:
+        with self._lock:
+            last_exc: Exception | None = None
+            for attempt in range(self.max_retries + 1):
+                try:
+                    if self._sock is None:
+                        self._sock = self._connect()
+                    _send_frame(self._sock, header, blobs)
+                    return _recv_frame(self._sock)
+                except (ConnectionError, OSError) as exc:
+                    # socket.timeout is an OSError subclass: a stalled
+                    # peer lands here too.
+                    last_exc = exc
+                    if self._sock is not None:
+                        try:
+                            self._sock.close()
+                        except OSError:
+                            pass
+                        self._sock = None
+                    if attempt < self.max_retries:
+                        time.sleep(self.backoff_s * (2 ** attempt))
+            raise ConnectionError(
+                f"peer {self.url} unreachable after "
+                f"{self.max_retries + 1} attempts: {last_exc}"
+            ) from last_exc
+
+    # ------------------------------------------------------------------
+
+    def query(self, keys: Sequence[str]) -> list[bool]:
+        header, _ = self._rpc({"op": "query", "keys": list(keys)}, [])
+        return list(header["found"])
+
+    def get(self, keys: Sequence[str]) -> list[Any]:
+        header, body = self._rpc({"op": "get", "keys": list(keys)}, [])
+        if "error" in header:
+            raise KeyError(header["error"])
+        return unpack_entries(header["entries"], body)
+
+    def put(self, keys: Sequence[str], values: Sequence[Any]) -> None:
+        metas, blobs = pack_entries(values)
+        self._rpc(
+            {"op": "put", "keys": list(keys), "entries": metas}, blobs)
+
+    def stats(self) -> dict:
+        header, _ = self._rpc({"op": "stats"}, [])
+        return header
+
+
+class PeerServer:
+    """Threaded server exposing a host tier to the pool.
+
+    ``tier`` is duck-typed: it needs ``contains(key)``, ``get_encoded
+    (keys)`` (stored form — raw or QuantizedBlock), ``put_encoded(keys,
+    values)``, and ``stats()``; :class:`~vllm_tpu.kv_fabric.fabric.
+    HostTier` provides all four."""
+
+    def __init__(self, tier, host: str = "127.0.0.1", port: int = 0) -> None:
+        self.tier = tier
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._sock.bind((host, port))
+        self._sock.listen(16)
+        self.host, self.port = self._sock.getsockname()
+        self._running = True
+        self._conns: list[socket.socket] = []
+        self._accept_thread = threading.Thread(
+            target=self._accept_loop, daemon=True)
+
+    @property
+    def url(self) -> str:
+        return f"{self.host}:{self.port}"
+
+    def start(self) -> "PeerServer":
+        self._accept_thread.start()
+        logger.info("KV fabric peer tier serving on %s", self.url)
+        return self
+
+    def shutdown(self) -> None:
+        self._running = False
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+        for conn in list(self._conns):
+            try:
+                conn.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+    # ------------------------------------------------------------------
+
+    def _accept_loop(self) -> None:
+        while self._running:
+            try:
+                conn, _ = self._sock.accept()
+            except OSError:
+                return
+            self._conns.append(conn)
+            threading.Thread(
+                target=self._serve_conn, args=(conn,), daemon=True
+            ).start()
+
+    def _serve_conn(self, conn: socket.socket) -> None:
+        try:
+            conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            while self._running:
+                header, body = _recv_frame(conn)
+                self._handle(conn, header, body)
+        except (ConnectionError, OSError):
+            pass
+        finally:
+            conn.close()
+
+    def _handle(self, conn, header: dict, body: bytes) -> None:
+        op = header["op"]
+        keys = header.get("keys", [])
+        if op == "query":
+            found = [self.tier.contains(k) for k in keys]
+            _send_frame(conn, {"found": found}, [])
+        elif op == "get":
+            try:
+                values = self.tier.get_encoded(keys)
+            except KeyError as exc:
+                _send_frame(conn, {"error": f"missing key {exc}"}, [])
+                return
+            metas, blobs = pack_entries(values)
+            _send_frame(conn, {"entries": metas}, blobs)
+        elif op == "put":
+            values = unpack_entries(header["entries"], body)
+            self.tier.put_encoded(keys, values)
+            _send_frame(conn, {"ok": True}, [])
+        elif op == "stats":
+            _send_frame(conn, self.tier.stats(), [])
+        else:
+            _send_frame(conn, {"error": f"unknown op {op!r}"}, [])
+
+
+def main() -> None:  # pragma: no cover - CLI utility
+    import argparse
+
+    from vllm_tpu.kv_fabric.fabric import HostTier
+
+    p = argparse.ArgumentParser(
+        description="standalone KV fabric block store (shared cold tier)")
+    p.add_argument("--host", default="0.0.0.0")
+    p.add_argument("--port", type=int, default=7799)
+    p.add_argument("--cache-gb", type=float, default=16.0)
+    p.add_argument("--quant", default="none",
+                   choices=("none", "int8", "int4"))
+    args = p.parse_args()
+    tier = HostTier(
+        max_bytes=int(args.cache_gb * (1 << 30)), quant=args.quant)
+    server = PeerServer(tier, args.host, args.port).start()
+    try:
+        threading.Event().wait()
+    except KeyboardInterrupt:
+        server.shutdown()
+
+
+if __name__ == "__main__":  # pragma: no cover
+    main()

@@ -417,6 +417,28 @@ class PrometheusRegistry:
             "vllm:perfwatch_captures_aborted_total",
             "Perfwatch windows aborted before completion (engine went "
             "idle mid-capture, or live traffic arrived mid-A/B)")
+        # Tiered KV fabric (vllm_tpu/kv_fabric): per-tier occupancy and
+        # the fetch-vs-recompute decision counters, attached to
+        # SchedulerStats by EngineCore when the fabric connector is
+        # active (all absent-valued otherwise).
+        self.kv_fabric_tier_blocks = LabeledGauge(
+            "vllm:kv_fabric_tier_blocks",
+            "KV blocks resident per fabric tier (device = HBM prefix "
+            "cache, host = host-RAM cold tier)", "tier")
+        self.kv_fabric_fetches = LabeledCounter(
+            "vllm:kv_fabric_fetch_total",
+            "Fabric remote-prefix decisions by outcome (fetched = "
+            "cost model accepted a peer fetch, recompute = fetch costed "
+            "out, miss = no peer held the prefix, failed = transfer "
+            "tore and the request fell back to recompute)", "outcome")
+        self.kv_fabric_demotions = LabeledCounter(
+            "vllm:kv_fabric_demotions_total",
+            "Blocks demoted down the tier ladder (device = last HBM "
+            "copy evicted, host = host-tier LRU eviction, store = "
+            "write-through to the shared block store)", "tier")
+        self.kv_fabric_fetch_bytes = Counter(
+            "vllm:kv_fabric_fetch_bytes_total",
+            "Encoded bytes pulled over the fabric wire by peer fetches")
         self._metrics = [
             self.num_running, self.num_waiting, self.kv_usage,
             self.prefix_queries, self.prefix_hits, self.preempted,
@@ -449,6 +471,8 @@ class PrometheusRegistry:
             self.mesh_size, self.mesh_recovery_duration,
             self.perf_device_ms, self.perf_mfu, self.perf_hbm_bw,
             self.perf_captures, self.perf_captures_aborted,
+            self.kv_fabric_tier_blocks, self.kv_fabric_fetches,
+            self.kv_fabric_demotions, self.kv_fabric_fetch_bytes,
         ]
         self._engine = engine
         self._last_prefix = (0, 0)
@@ -540,6 +564,18 @@ class PrometheusRegistry:
                 self.perf_mfu.set(s.perfwatch_mfu_est)
             if s.perfwatch_hbm_bw_util_est is not None:
                 self.perf_hbm_bw.set(s.perfwatch_hbm_bw_util_est)
+            if s.kv_fabric:
+                fab = s.kv_fabric
+                for tier, n in (fab.get("tier_blocks") or {}).items():
+                    self.kv_fabric_tier_blocks.set(tier, float(n))
+                # Cumulative engine-side counters crossing the proc
+                # boundary: ratchet, never assign.
+                for outcome, n in (fab.get("fetch") or {}).items():
+                    self.kv_fabric_fetches.inc_to(outcome, float(n))
+                for tier, n in (fab.get("demotions") or {}).items():
+                    self.kv_fabric_demotions.inc_to(tier, float(n))
+                self.kv_fabric_fetch_bytes.inc_to(
+                    float(fab.get("fetch_bytes", 0)))
         if iteration_stats is not None:
             self.generation_tokens.inc(iteration_stats.num_generation_tokens)
             self.prompt_tokens.inc(iteration_stats.num_prompt_tokens)

@@ -1,0 +1,161 @@
+"""Cold-tier KV block quantization (int8 / packed int4).
+
+The device cache already has an 8-bit rung (fp8 KV via
+``CacheConfig.cache_dtype``); this module extends the precision ladder
+*off*-device: blocks demoted from HBM to host RAM — and shipped between
+engines over the fabric wire — are stored as symmetric per-token int8
+(or opt-in int4) with float32 scales, and dequantized on promotion back
+into the paged cache.
+
+Layout convention: a block payload is the runner's D2H slice
+``[num_layers, block_size, rows, lanes]`` (see
+``model_runner.kv_connector_save``). Scales are computed per leading
+index over the last two axes — one scale per (layer, token-slot) — so a
+single outlier token cannot wash out the whole block's resolution.
+
+Everything here is host-side numpy: quantization runs on the CPU during
+demotion (off the device hot path), never inside a jitted step.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+
+QUANT_MODES = ("none", "int8", "int4")
+
+# Symmetric ranges: zero stays exact, and +/- amax map to the endpoints.
+_QMAX = {"int8": 127.0, "int4": 7.0}
+
+
+def _np_dtype(name: str) -> np.dtype:
+    """numpy dtype for a dtype string, routing bfloat16 (and friends)
+    through ml_dtypes (a jax dependency, always present here)."""
+    try:
+        return np.dtype(name)
+    except TypeError:
+        import ml_dtypes
+
+        return np.dtype(getattr(ml_dtypes, name))
+
+
+@dataclasses.dataclass
+class QuantizedBlock:
+    """One KV block's quantized payload + the metadata to invert it."""
+
+    mode: str            # "int8" | "int4"
+    data: np.ndarray     # int8, or uint8 with two nibbles per byte
+    scale: np.ndarray    # float32 amax per (leading...) slice, keepdims
+    shape: tuple         # original array shape
+    dtype: str           # original dtype string ("float32", "bfloat16", ...)
+
+    @property
+    def nbytes(self) -> int:
+        return self.data.nbytes + self.scale.nbytes
+
+    # Wire form: (meta dict, blob list) — composes with the fabric's
+    # length-prefixed frame protocol.
+    def to_wire(self) -> tuple[dict, list[bytes]]:
+        meta = {
+            "kind": "q",
+            "mode": self.mode,
+            "shape": list(self.shape),
+            "dtype": self.dtype,
+            "data_shape": list(self.data.shape),
+            "data_dtype": str(self.data.dtype),
+            "scale_shape": list(self.scale.shape),
+        }
+        return meta, [self.data.tobytes(), self.scale.tobytes()]
+
+    @classmethod
+    def from_wire(cls, meta: dict, data: bytes, scale: bytes
+                  ) -> "QuantizedBlock":
+        return cls(
+            mode=meta["mode"],
+            data=np.frombuffer(
+                data, dtype=np.dtype(meta["data_dtype"])
+            ).reshape(meta["data_shape"]),
+            scale=np.frombuffer(scale, dtype=np.float32).reshape(
+                meta["scale_shape"]),
+            shape=tuple(meta["shape"]),
+            dtype=meta["dtype"],
+        )
+
+
+def quantize_block(arr, mode: str) -> QuantizedBlock:
+    """Symmetric per-slice quantization of one block payload.
+
+    Scales reduce over the last two axes (per layer x token-slot for the
+    runner's ``[L, BS, rows, lanes]`` layout); 1-D inputs reduce over the
+    whole array.
+    """
+    if mode not in _QMAX:
+        raise ValueError(f"unknown KV quant mode {mode!r}")
+    a = np.asarray(arr)
+    orig_dtype = str(a.dtype)
+    f = a.astype(np.float32)
+    red = tuple(range(max(0, f.ndim - 2), f.ndim))
+    amax = np.max(np.abs(f), axis=red, keepdims=True)
+    # Zero slices quantize to zeros against a unit scale (no div-by-0).
+    scale = np.where(amax > 0.0, amax, 1.0).astype(np.float32)
+    qmax = _QMAX[mode]
+    q = np.clip(np.rint(f / scale * qmax), -qmax, qmax).astype(np.int8)
+    if mode == "int4":
+        if q.shape[-1] % 2:
+            pad = [(0, 0)] * (q.ndim - 1) + [(0, 1)]
+            q = np.pad(q, pad)
+        lo = q[..., 0::2]
+        hi = q[..., 1::2]
+        data = ((lo & 0x0F) | ((hi & 0x0F) << 4)).astype(np.uint8)
+    else:
+        data = q
+    return QuantizedBlock(
+        mode=mode, data=data, scale=scale, shape=a.shape, dtype=orig_dtype
+    )
+
+
+def dequantize_block(qb: QuantizedBlock) -> np.ndarray:
+    """Invert :func:`quantize_block`, restoring the original dtype/shape."""
+    qmax = _QMAX[qb.mode]
+    if qb.mode == "int4":
+        b = qb.data
+        lo = (b & 0x0F).astype(np.int8)
+        hi = ((b >> 4) & 0x0F).astype(np.int8)
+        lo = np.where(lo > 7, lo - 16, lo).astype(np.int8)
+        hi = np.where(hi > 7, hi - 16, hi).astype(np.int8)
+        q = np.empty(b.shape[:-1] + (b.shape[-1] * 2,), np.int8)
+        q[..., 0::2] = lo
+        q[..., 1::2] = hi
+        q = q[..., : qb.shape[-1]]
+    else:
+        q = qb.data
+    f = q.astype(np.float32) * (qb.scale / qmax)
+    return f.reshape(qb.shape).astype(_np_dtype(qb.dtype))
+
+
+def max_abs_error_bound(qb: QuantizedBlock) -> float:
+    """Analytic worst-case absolute error of the round-trip: half a
+    quantization step at the largest scale."""
+    return float(np.max(qb.scale)) / (2.0 * _QMAX[qb.mode])
+
+
+def encoded_nbytes(value) -> int:
+    """Stored bytes of a tier entry (raw ndarray or QuantizedBlock)."""
+    if isinstance(value, QuantizedBlock):
+        return value.nbytes
+    return value.nbytes
+
+
+def maybe_quantize(arr, mode: str):
+    """Demotion-path encode: identity for mode "none"."""
+    if mode == "none":
+        return np.ascontiguousarray(arr)
+    return quantize_block(arr, mode)
+
+
+def maybe_dequantize(value) -> np.ndarray:
+    """Promotion-path decode: identity for raw entries."""
+    if isinstance(value, QuantizedBlock):
+        return dequantize_block(value)
+    return value

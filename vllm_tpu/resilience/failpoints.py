@@ -139,6 +139,17 @@ SITE_CATALOG: dict[str, str] = {
         "reshard; raise = mesh recovery fails mid-flight — the engine "
         "must come out fully recovered or cleanly dead, never "
         "half-meshed"),
+    "kv_fabric.fetch": (
+        "ModelRunner._kv_connector_loads, before a request's external/"
+        "peer KV blocks are pulled through the fabric; drop or "
+        "raise(ConnectionError) = torn transfer / dead peer — the "
+        "request degrades to recompute via invalid-load recovery, never "
+        "a crash or a lost request"),
+    "kv_fabric.demote": (
+        "ModelRunner.kv_connector_save, before freed blocks are demoted "
+        "(D2H + quantize) into the fabric's host tier; drop = the "
+        "demotion batch is lost — blocks stay recomputable, only "
+        "persistence is sacrificed"),
 }
 
 _EXC_WHITELIST: dict[str, type[BaseException]] = {

@@ -36,7 +36,7 @@ DEFAULT_MAX_PREFIX_BLOCKS = 128
 @dataclass
 class RoutingDecision:
     engine_id: int
-    kind: str  # "prefix" | "least_loaded" | "round_robin"
+    kind: str  # "prefix" | "prefix_spill" | "least_loaded" | "round_robin"
     hit_blocks: int = 0
 
 
@@ -50,7 +50,8 @@ class RoutingStats:
     def __init__(self) -> None:
         self._lock = threading.Lock()
         self._decisions: dict[str, int] = {
-            "prefix": 0, "least_loaded": 0, "round_robin": 0,
+            "prefix": 0, "prefix_spill": 0, "least_loaded": 0,
+            "round_robin": 0,
         }
         self._pending_hits: list[int] = []
 
@@ -103,17 +104,26 @@ def request_prefix_hashes(
 
 
 class PrefixAwareRouter:
-    """Rung 1 of the ladder: longest-cached-prefix placement."""
+    """Rung 1 of the ladder: longest-cached-prefix placement.
+
+    ``spill_threshold`` (requests) arms the KV-fabric spillover rung:
+    when the best prefix-hit engine is at least that much busier than
+    the least-loaded candidate, the request spills to the least-loaded
+    engine instead ("prefix_spill") — with a tiered fabric the target
+    pulls the blocks from the owner, so locality no longer has to beat
+    load balance. ``None`` (no fabric) preserves strict affinity."""
 
     def __init__(
         self,
         index,
         block_size: int,
         max_blocks: int = DEFAULT_MAX_PREFIX_BLOCKS,
+        spill_threshold: int | None = None,
     ) -> None:
         self.index = index
         self.block_size = block_size
         self.max_blocks = max_blocks
+        self.spill_threshold = spill_threshold
 
     def choose(
         self,
@@ -134,4 +144,10 @@ class PrefixAwareRouter:
         best_len = max(hits.values())
         best = [eid for eid, n in hits.items() if n == best_len]
         eid = min(best, key=lambda i: inflight.get(i, 0))
+        if self.spill_threshold is not None:
+            coolest = min(
+                candidates, key=lambda i: inflight.get(i, 0))
+            imbalance = inflight.get(eid, 0) - inflight.get(coolest, 0)
+            if coolest != eid and imbalance >= self.spill_threshold:
+                return RoutingDecision(coolest, "prefix_spill", best_len)
         return RoutingDecision(eid, "prefix", best_len)

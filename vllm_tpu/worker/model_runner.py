@@ -2002,6 +2002,12 @@ class ModelRunner:
         request, so the pre-extraction content is intact (in-flight steps
         never touch freed blocks)."""
         assert self.kv_connector is not None
+        if fail_point(
+            "kv_fabric.demote", lambda: f"blocks={len(entries)}"
+        ) == "drop":
+            # Chaos: a torn demotion loses persistence, never data — the
+            # blocks stay recomputable from the prompt.
+            return
         ids = jnp.asarray([bid for bid, _ in entries], jnp.int32)
         payloads = np.asarray(jax.device_get(self.kv_cache[:, ids]))
         # [L, N, BS, rows, lanes] -> per-block [L, BS, rows, lanes]
@@ -2025,12 +2031,21 @@ class ModelRunner:
         failed: set[str] = set()
         for rid, (block_ids, keys) in load_map.items():
             try:
+                if fail_point(
+                    "kv_fabric.fetch", lambda: f"req={rid}"
+                ) == "drop":
+                    raise ConnectionError(
+                        "torn fabric transfer (failpoint)")
                 arrs = self.kv_connector.load_blocks(keys)
             except Exception as exc:
                 logger.warning(
                     "external KV load failed for %s (%s); rescheduling "
                     "for recompute", rid, exc,
                 )
+                note = getattr(
+                    self.kv_connector, "note_fetch_failure", None)
+                if note is not None:
+                    note(rid)
                 failed.add(rid)
                 continue
             vals = np.stack(arrs, axis=1)  # [L, N, BS, ...]
