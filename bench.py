@@ -371,15 +371,49 @@ def main() -> None:
     except AttributeError:
         runner = None
 
+    try:
+        core = llm.llm_engine.engine_core.engine_core
+    except AttributeError:
+        core = None
+
     # The tunnel to the shared chip is noisy (consecutive identical runs
     # vary several-fold): best-of-N scores the framework, median/worst
     # report the spread.
     passes = max(1, int(os.environ.get("VLLM_TPU_BENCH_PASSES", 5)))
     times = []
-    for _ in range(passes):
+    goodput = None
+    for i in range(passes):
+        # The last pass doubles as the goodput window: per-step ITL
+        # samples + the spec-accepted counter delta score accepted
+        # tokens/s under the ITL SLO (spec off: accepted == emitted).
+        instrument = i == passes - 1 and core is not None
+        if instrument:
+            core.drain_itl_samples()
+            acc0 = core.scheduler._spec_num_accepted_tokens
+            draft0 = core.scheduler._spec_num_draft_tokens
         t0 = time.monotonic()
         outs = llm.generate(prompts, params)
-        times.append(time.monotonic() - t0)
+        dt = time.monotonic() - t0
+        times.append(dt)
+        if instrument:
+            from vllm_tpu.metrics.goodput import goodput_summary
+
+            spec_on = core.scheduler._spec_num_draft_tokens > draft0
+            pass_tokens = sum(
+                len(o.outputs[0].token_ids) for o in outs
+            )
+            goodput = goodput_summary(
+                core.drain_itl_samples(),
+                elapsed_s=dt,
+                accepted_tokens=(
+                    core.scheduler._spec_num_accepted_tokens - acc0
+                    if spec_on else None
+                ),
+                emitted_tokens=pass_tokens,
+                slo_itl_ms=float(
+                    os.environ.get("VLLM_TPU_BENCH_SLO_ITL_MS", 50.0)
+                ),
+            )
 
     n_out = sum(len(o.outputs[0].token_ids) for o in outs)
     n_chips = max(
@@ -520,6 +554,7 @@ def main() -> None:
         "passes": passes,
         "median_value": rate(statistics.median(times)),
         "worst_pass_value": rate(max(times)),
+        **({"goodput": goodput} if goodput is not None else {}),
         **({"chip_bw_probe_gbs": bw_probe} if bw_probe is not None else {}),
         **extras,
         **({"ladder_failures": ladder_failures} if ladder_failures else {}),

@@ -87,13 +87,64 @@ def test_classify_op_phases():
 def test_perf_ab_smoke():
     """In-proc quiet-window kernel A/B end to end on CPU: tiny engine,
     synthetic replay batch, sampler/decode-attention variants, artifact
-    schema validated (device_ms null on CPU, wall-clock source)."""
+    schema validated (device_ms null on CPU, wall-clock source).
+    ``--base-only`` skips the second (adaptive-spec) engine — that
+    variant is covered by the slow-tier full smoke below."""
+    proc = _run_tool("perf_ab.py", "--smoke", "--base-only")
+    assert proc.returncode == 0, (proc.stdout[-2000:], proc.stderr[-2000:])
+    assert "perf_ab smoke ok" in proc.stdout
+
+
+@pytest.mark.slow
+def test_perf_ab_smoke_adaptive():
+    """Full smoke including the second ngram + --spec-adaptive engine:
+    validates the ``ab.adaptive_spec`` on/off pair schema (slow: builds
+    two engines back to back)."""
     proc = _run_tool("perf_ab.py", "--smoke")
     assert proc.returncode == 0, (proc.stdout[-2000:], proc.stderr[-2000:])
     assert "perf_ab smoke ok" in proc.stdout
+    assert '"adaptive_spec"' in proc.stdout
 
 
 def test_op_split_ms_empty_dir(tmp_path):
     from vllm_tpu.metrics.op_split import op_split_ms
 
     assert op_split_ms(str(tmp_path)) is None
+
+
+def test_goodput_summary_schema():
+    """bench.py's ``goodput`` block: accepted tokens/s under the ITL
+    SLO, scored by the pure helper (vllm_tpu/metrics/goodput.py)."""
+    from vllm_tpu.metrics.goodput import goodput_summary
+
+    # Spec on: 10ms steps bursting 2 tokens -> 5ms per-token gaps.
+    g = goodput_summary(
+        [(0.010, 2)] * 50, elapsed_s=2.0,
+        accepted_tokens=80, emitted_tokens=100, slo_itl_ms=8.0)
+    for key in ("accepted_tok_s", "slo_attainment", "slo_met",
+                "p99_itl_ms", "slo_itl_ms", "itl_samples",
+                "token_source"):
+        assert key in g, key
+    assert g["accepted_tok_s"] == 40.0
+    assert g["token_source"] == "spec_accepted"
+    assert g["slo_attainment"] == 1.0 and g["slo_met"] is True
+    assert g["p99_itl_ms"] == 5.0
+    assert g["itl_samples"] == 100
+
+    # Spec off: falls back to emitted tokens/s; a tail sample past the
+    # SLO flips slo_met and dents attainment.
+    g = goodput_summary(
+        [(0.010, 1)] * 95 + [(0.200, 1)] * 5, elapsed_s=1.0,
+        emitted_tokens=100, slo_itl_ms=50.0)
+    assert g["token_source"] == "emitted"
+    assert g["accepted_tok_s"] == 100.0
+    assert g["slo_attainment"] == 0.95 and g["slo_met"] is False
+    assert g["p99_itl_ms"] == 200.0
+
+
+def test_goodput_summary_empty_window():
+    from vllm_tpu.metrics.goodput import goodput_summary
+
+    g = goodput_summary([], elapsed_s=0.0, slo_itl_ms=50.0)
+    assert g["accepted_tok_s"] is None
+    assert g["slo_attainment"] is None and g["p99_itl_ms"] is None

@@ -102,6 +102,15 @@ REQUIRED_PERFWATCH_METRICS = {
     "vllm:perfwatch_captures_aborted_total",
 }
 
+# Documented in the README ("Adaptive speculation"); the goodput bench
+# and the adaptive-spec A/B protocol read these names.
+REQUIRED_ADAPTIVE_SPEC_METRICS = {
+    "vllm:spec_decode_acceptance_rate",
+    "vllm:spec_decode_draft_len",
+    "vllm:spec_decode_suspended",
+    "vllm:spec_decode_suspensions_total",
+}
+
 # Documented in the README ("Tiered KV fabric"); the cross-engine
 # prefix-hit acceptance test and chaos scenarios assert on these names.
 REQUIRED_KV_FABRIC_METRICS = {
@@ -192,6 +201,10 @@ def check() -> list[str]:
     for name in sorted(REQUIRED_PERFWATCH_METRICS - set(seen)):
         errors.append(
             f"required perfwatch metric {name} is missing from "
+            f"the registry (documented in README)")
+    for name in sorted(REQUIRED_ADAPTIVE_SPEC_METRICS - set(seen)):
+        errors.append(
+            f"required adaptive-spec metric {name} is missing from "
             f"the registry (documented in README)")
     for name in sorted(REQUIRED_KV_FABRIC_METRICS - set(seen)):
         errors.append(

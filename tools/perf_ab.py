@@ -84,7 +84,7 @@ def run_http(args: argparse.Namespace) -> int:
     return 1
 
 
-def run_smoke() -> int:
+def run_smoke(base_only: bool = False) -> int:
     from transformers import LlamaConfig
 
     from vllm_tpu.entrypoints.llm import LLM
@@ -123,6 +123,38 @@ def run_smoke() -> int:
         assert d["wall_ms_on"] is not None and d["wall_ms_on"] > 0, d
     status = core.perf_status()
     assert status["ab_runs_total"] >= 1, status
+
+    # Second tiny engine for the adaptive-speculation variant: spec
+    # decoding pins num_decode_steps=1 (so the dynamic-decode variant
+    # can't ride the same engine), and the adaptive controller only
+    # exists when --spec-adaptive is on.
+    if base_only:
+        print("perf_ab smoke ok (base only)")
+        return 0
+    llm2 = LLM(
+        model="dummy-llama", hf_config=cfg, load_format="dummy",
+        max_model_len=256, max_num_batched_tokens=128, max_num_seqs=2,
+        speculative_method="ngram", num_speculative_tokens=3,
+        spec_adaptive=True,
+    )
+    # Repetitive prompts so the ngram proposer actually drafts.
+    spec_prompts = [
+        {"prompt_token_ids": [5, 6, 7, 5, 6, 7, 5, 6]},
+        {"prompt_token_ids": [9, 9, 9, 9, 9, 9, 9, 9]},
+    ]
+    llm2.generate(spec_prompts, SamplingParams(
+        temperature=0.0, max_tokens=4, ignore_eos=True))
+    core2 = llm2.llm_engine.engine_core.engine_core
+    assert core2.scheduler.adaptive_spec is not None
+    result2 = core2.perf_ab({"steps": 2})
+    print(json.dumps(result2, indent=2))
+    assert result2.get("error") is None, result2
+    assert result2["aborted"] is False, result2
+    d = result2["ab"]["adaptive_spec"]
+    for key in ("device_ms_on", "device_ms_off", "delta_pct",
+                "wall_ms_on", "wall_ms_off", "source"):
+        assert key in d, ("adaptive_spec", key, d)
+    assert d["wall_ms_on"] is not None and d["wall_ms_on"] > 0, d
     print("perf_ab smoke ok")
     return 0
 
@@ -145,9 +177,13 @@ def main() -> int:
                     help="seconds to wait for the window to land")
     ap.add_argument("--smoke", action="store_true",
                     help="in-proc tiny-engine self-test (no server)")
+    ap.add_argument("--base-only", action="store_true",
+                    help="with --smoke: skip the second (ngram + "
+                         "adaptive-spec) engine — the fast CPU test "
+                         "tier uses this; the full smoke covers both")
     args = ap.parse_args()
     if args.smoke:
-        return run_smoke()
+        return run_smoke(base_only=args.base_only)
     return run_http(args)
 
 

@@ -296,6 +296,20 @@ class SchedulerConfig:
     # flash kernel unless the shared prefix dominates the context.
     enable_cascade_attention: bool = False
     policy: Literal["fcfs", "priority"] = "fcfs"
+    # Hard off-switch for the dynamic lax.while_loop decode path (the
+    # fixed-K unrolled chain still runs when num_decode_steps > 1). CLI
+    # spelling --disable-dynamic-decode; the
+    # VLLM_TPU_DISABLE_DYNAMIC_DECODE env is the no-restart equivalent.
+    disable_dynamic_decode: bool = False
+    # Adaptive speculation (copied from SpeculativeConfig at
+    # EngineConfig.finalize — the controller lives scheduler-side and the
+    # scheduler only sees this config).
+    spec_adaptive: bool = False
+    spec_num_speculative_tokens: int = 0
+    spec_tree_spec: str | None = None
+    spec_adaptive_high_watermark: float = 0.85
+    spec_adaptive_low_watermark: float = 0.60
+    spec_adaptive_ema_half_life_s: float = 10.0
 
     def __post_init__(self) -> None:
         if self.max_num_batched_tokens < 1:
@@ -322,7 +336,10 @@ class SchedulerConfig:
                 "decoding: spec already emits multiple tokens per launch, "
                 "and its in-jit draft/verify chain owns the device loop "
                 "that both fixed-K and dynamic multi-step decode would "
-                "occupy"
+                "occupy. Pass --num-decode-steps 1 (and "
+                "--disable-dynamic-decode to also pin the dynamic "
+                "while-loop path off) when enabling "
+                "--num-speculative-tokens"
             )
         if needs_mrope:
             raise ValueError(
@@ -359,6 +376,18 @@ class SpeculativeConfig:
     # information-flow channel in multi-tenant serving (draft acceptance
     # patterns are observable via timing) — flip off there.
     suffix_cross_request_corpus: bool = True
+    # Adaptive speculation: a scheduler-side controller ratchets each
+    # request's draft budget on a measured acceptance-rate EMA (seeded
+    # from a global per-proposer EMA), prunes tree topology to the
+    # per-depth acceptance curve, and suspends speculation batch-wide
+    # when batch occupancy crosses high_watermark (resuming under
+    # low_watermark, with hysteresis). Changes proposals only — accepted
+    # text is verification-identical to static drafting. The
+    # VLLM_TPU_DISABLE_ADAPTIVE_SPEC env is the no-restart escape hatch.
+    adaptive: bool = False
+    adaptive_high_watermark: float = 0.85
+    adaptive_low_watermark: float = 0.60
+    adaptive_ema_half_life_s: float = 10.0
     # Tree verification (Medusa): a static branching spec like "2x2x1"
     # — depth-d candidates = head d's top-b_d tokens, verified as a TREE
     # in one step (tree-masked attention + rejection sampling over
@@ -527,7 +556,33 @@ class EngineConfig:
         self.compilation_config.finalize(sc)
         if self.speculative_config.enabled and self.parallel_config.pipeline_parallel_size > 1:
             raise ValueError("speculative decoding is incompatible with pipeline parallelism")
-        sc.validate_decode_steps(spec_enabled=self.speculative_config.enabled)
+        spec = self.speculative_config
+        if spec.enabled:
+            # The scheduler owns the adaptive controller but only sees
+            # SchedulerConfig — copy what it needs across here.
+            sc.spec_num_speculative_tokens = spec.num_speculative_tokens
+            sc.spec_tree_spec = spec.spec_tree
+            sc.spec_adaptive = spec.adaptive
+            sc.spec_adaptive_high_watermark = spec.adaptive_high_watermark
+            sc.spec_adaptive_low_watermark = spec.adaptive_low_watermark
+            sc.spec_adaptive_ema_half_life_s = spec.adaptive_ema_half_life_s
+            if spec.adaptive and not (
+                0.0 < spec.adaptive_low_watermark
+                < spec.adaptive_high_watermark <= 1.0
+            ):
+                raise ValueError(
+                    "adaptive speculation watermarks must satisfy "
+                    "0 < low < high <= 1, got "
+                    f"low={spec.adaptive_low_watermark} "
+                    f"high={spec.adaptive_high_watermark}"
+                )
+        elif spec.adaptive:
+            raise ValueError(
+                "--spec-adaptive requires speculative decoding to be "
+                "enabled (set --speculative-method and "
+                "--num-speculative-tokens)"
+            )
+        sc.validate_decode_steps(spec_enabled=spec.enabled)
         return self
 
     def compute_hash(self) -> str:

@@ -78,6 +78,14 @@ class SchedulerOutput:
     # comes back through ModelRunnerOutput.sampled_token_ids.
     dynamic_decode: bool = False
     decode_claims: dict[str, int] = field(default_factory=dict)
+    # Adaptive speculation: when True the occupancy gate has suspended
+    # drafting batch-wide — the runner skips proposer work entirely this
+    # step; spec_draft_budgets carries each scheduled request's current
+    # draft budget (tokens for chains, tree-node prefix count for trees)
+    # so next-step proposals are clipped at the source. Empty dict =
+    # controller off (static drafting).
+    spec_suspended: bool = False
+    spec_draft_budgets: dict[str, int] = field(default_factory=dict)
     # KV connector: req_id -> (device block ids, content keys) to LOAD
     # into the cache before this step runs (saves flow separately via an
     # eager engine->worker RPC at free time).
@@ -177,6 +185,17 @@ class SchedulerStats:
     # lengths of spec verification steps (accepted + bonus).
     queue_times: list[float] = field(default_factory=list)
     spec_accept_lengths: list[int] = field(default_factory=list)
+    # Adaptive speculation: realized per-request draft lengths of spec
+    # verification steps (drained each snapshot — feeds the
+    # vllm:spec_decode_draft_len histogram; populated with or without
+    # the adaptive controller), the controller's global acceptance-rate
+    # EMA (None = no controller or no observations yet), whether the
+    # occupancy gate currently suspends drafting, and the cumulative
+    # suspension count.
+    spec_draft_lens: list[int] = field(default_factory=list)
+    spec_acceptance_rate_ema: float | None = None
+    spec_suspended: bool = False
+    spec_suspensions: int = 0
     # Worker/engine-side cumulative counters attached by EngineCore:
     # bucket-compile vs bucket-hit counts of the jitted step cache, and
     # time the lag-N pipeline spent blocked fetching device results.

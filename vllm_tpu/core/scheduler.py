@@ -204,8 +204,43 @@ class Scheduler:
         # No-restart disable switch for the dynamic loop: the in-engine
         # perf A/B harness flips this directly to measure dynamic-vs-fixed
         # on live traffic; VLLM_TPU_DISABLE_DYNAMIC_DECODE is the env
-        # spelling of the same switch.
-        self.disable_dynamic_decode = False
+        # spelling and --disable-dynamic-decode the config spelling of
+        # the same switch.
+        self.disable_dynamic_decode = scheduler_config.disable_dynamic_decode
+        # Adaptive speculation: acceptance-driven draft budgets + the
+        # occupancy-gated shutoff (spec_decode/adaptive.py). The
+        # controller is a pure host-side state machine the scheduler
+        # consults at schedule time and feeds from verification results;
+        # disable_adaptive_spec is the no-restart A/B switch (the perf
+        # harness flips it; VLLM_TPU_DISABLE_ADAPTIVE_SPEC is the env
+        # spelling).
+        self.adaptive_spec = None
+        if (
+            scheduler_config.spec_adaptive
+            and scheduler_config.spec_num_speculative_tokens > 0
+        ):
+            from vllm_tpu.spec_decode.adaptive import AdaptiveSpecController
+
+            tree = None
+            if scheduler_config.spec_tree_spec:
+                from vllm_tpu.spec_decode.tree import build_tree
+
+                tree = build_tree(scheduler_config.spec_tree_spec)
+            self.adaptive_spec = AdaptiveSpecController(
+                scheduler_config.spec_num_speculative_tokens,
+                high_watermark=(
+                    scheduler_config.spec_adaptive_high_watermark
+                ),
+                low_watermark=scheduler_config.spec_adaptive_low_watermark,
+                ema_half_life_s=(
+                    scheduler_config.spec_adaptive_ema_half_life_s
+                ),
+                tree=tree,
+            )
+        self.disable_adaptive_spec = False
+        # Realized per-request draft lengths of spec verification steps
+        # (drained by make_stats — feeds vllm:spec_decode_draft_len).
+        self._spec_draft_lens: list[int] = []
 
     # ------------------------------------------------------------------
     # Request lifecycle
@@ -247,6 +282,8 @@ class Scheduler:
 
     def _free_request(self, request: Request) -> None:
         self._dynamic_inflight.discard(request.request_id)
+        if self.adaptive_spec is not None:
+            self.adaptive_spec.forget(request.request_id)
         self._free_encoder_for_request(request)
         if (
             self.kv_connector is not None
@@ -298,6 +335,14 @@ class Scheduler:
     # ------------------------------------------------------------------
     # schedule()
     # ------------------------------------------------------------------
+
+    def _adaptive_spec_on(self) -> bool:
+        """Controller present and not disabled by the A/B switch or env."""
+        return (
+            self.adaptive_spec is not None
+            and not self.disable_adaptive_spec
+            and not envs.VLLM_TPU_DISABLE_ADAPTIVE_SPEC
+        )
 
     def schedule(self) -> SchedulerOutput:
         token_budget = self.config.max_num_batched_tokens
@@ -402,6 +447,23 @@ class Scheduler:
             # masks nor bias/ban adjustments.)
             for r in self.running:
                 r.spec_token_ids = []
+
+        # Adaptive speculation: clip each request's pending drafts to its
+        # acceptance-ratcheted budget (0 while the occupancy gate holds).
+        # Proposal-side only — verification semantics are untouched, so
+        # accepted text is identical to static drafting. For trees the
+        # budget counts breadth-first node-prefix positions (any depth
+        # cutoff is a contiguous prefix of the window layout).
+        adaptive_on = self._adaptive_spec_on()
+        if adaptive_on:
+            for r in self.running:
+                if not r.spec_token_ids:
+                    continue
+                budget = self.adaptive_spec.draft_budget(r.request_id)
+                if budget <= 0:
+                    r.spec_token_ids = []
+                elif budget < len(r.spec_token_ids):
+                    r.spec_token_ids = r.spec_token_ids[:budget]
 
         # Phase 1: running requests, in order (decode + in-flight prefills).
         req_index = 0
@@ -801,10 +863,30 @@ class Scheduler:
             and len(claims_out) == len(num_scheduled_tokens)
             and bool(claims_out)
         )
+        # Adaptive speculation: feed the occupancy gate from this step's
+        # realized token-budget fill (same definition as the
+        # vllm:engine_batch_occupancy gauge) and ship the verdicts — the
+        # runner skips proposer work under suspension and clips next-step
+        # proposals to the per-request budgets.
+        spec_suspended = False
+        spec_budgets: dict[str, int] = {}
+        if adaptive_on:
+            if total > 0:
+                self.adaptive_spec.observe_occupancy(
+                    total / self.config.max_num_batched_tokens
+                )
+            spec_suspended = self.adaptive_spec.suspended
+            if not spec_suspended:
+                spec_budgets = {
+                    rid: self.adaptive_spec.draft_budget(rid)
+                    for rid in num_scheduled_tokens
+                }
         output = SchedulerOutput(
             num_decode_steps=self._decode_k,
             dynamic_decode=dynamic_out,
             decode_claims=claims_out if dynamic_out else {},
+            spec_suspended=spec_suspended,
+            spec_draft_budgets=spec_budgets,
             kv_connector_load=kv_connector_load,
             scheduled_new_reqs=scheduled_new_reqs,
             scheduled_cached_reqs=cached,
@@ -1096,6 +1178,15 @@ class Scheduler:
                 )
                 self._spec_num_accepted_tokens += max(0, len(generated) - 1)
                 self._spec_accept_lengths.append(len(generated))
+                self._spec_draft_lens.append(len(scheduled_spec))
+                if self.adaptive_spec is not None:
+                    # Feed the controller even while the A/B switch holds
+                    # it out of the decision path: the EMAs stay warm so
+                    # re-enabling adapts from live evidence, not a reset.
+                    self.adaptive_spec.observe(
+                        req_id, len(scheduled_spec),
+                        max(0, len(generated) - 1),
+                    )
                 # Verification: len(generated) = accepted drafts + 1 bonus.
                 # Rejected draft positions hold garbage KV; roll computed
                 # count back so they are recomputed (reference:
@@ -1264,6 +1355,8 @@ class Scheduler:
         decode_lengths, self._decode_step_lengths = (
             self._decode_step_lengths, []
         )
+        draft_lens, self._spec_draft_lens = self._spec_draft_lens, []
+        ctl = self.adaptive_spec
         return SchedulerStats(
             num_running_reqs=len(self.running),
             num_waiting_reqs=len(self.waiting),
@@ -1275,6 +1368,16 @@ class Scheduler:
             spec_num_accepted_tokens=self._spec_num_accepted_tokens,
             queue_times=queue_times,
             spec_accept_lengths=accept_lengths,
+            spec_draft_lens=draft_lens,
+            spec_acceptance_rate_ema=(
+                ctl.acceptance_rate() if ctl is not None else None
+            ),
+            spec_suspended=(
+                self._adaptive_spec_on() and ctl.suspended
+            ),
+            spec_suspensions=(
+                ctl.suspensions_total if ctl is not None else 0
+            ),
             decode_step_lengths=decode_lengths,
             decode_early_exits=self._decode_early_exits,
         )

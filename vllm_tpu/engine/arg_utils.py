@@ -90,6 +90,13 @@ class EngineArgs:
     speculative_model: str | None = None
     spec_tree: str | None = None
     suffix_cross_request_corpus: bool = True
+    # Adaptive speculation (--spec-adaptive): acceptance-driven draft
+    # budgets + occupancy-gated shutoff; see SpeculativeConfig.
+    spec_adaptive: bool = False
+    spec_adaptive_high_watermark: float = 0.85
+    spec_adaptive_low_watermark: float = 0.60
+    spec_adaptive_ema_half_life_s: float = 10.0
+    disable_dynamic_decode: bool = False
 
     enable_lora: bool = False
     max_lora_rank: int = 16
@@ -203,6 +210,7 @@ class EngineArgs:
                 enable_cascade_attention=self.enable_cascade_attention,
                 enable_decode_attention=self.enable_decode_attention,
                 enable_sampler_kernel=self.enable_sampler_kernel,
+                disable_dynamic_decode=self.disable_dynamic_decode,
             ),
             device_config=DeviceConfig(device=self.device),  # type: ignore[arg-type]
             speculative_config=SpeculativeConfig(
@@ -212,6 +220,12 @@ class EngineArgs:
                 spec_tree=self.spec_tree,
                 suffix_cross_request_corpus=(
                     self.suffix_cross_request_corpus
+                ),
+                adaptive=self.spec_adaptive,
+                adaptive_high_watermark=self.spec_adaptive_high_watermark,
+                adaptive_low_watermark=self.spec_adaptive_low_watermark,
+                adaptive_ema_half_life_s=(
+                    self.spec_adaptive_ema_half_life_s
                 ),
             ),
             lora_config=LoRAConfig(

@@ -71,6 +71,11 @@ class EngineCore:
         self._last_batch: tuple[int, int] = (0, 0)
         self._last_step_end: float | None = None
         self._step_interval_s = 0.0
+        # Per-finalized-step (interval_s, max tokens emitted by any one
+        # request) samples for the bench's goodput/ITL scoring: a step
+        # that emits k tokens for a request spreads its interval over k
+        # inter-token gaps. Bounded; drained by drain_itl_samples().
+        self._itl_samples: deque[tuple[float, int]] = deque(maxlen=4096)
         # Request lifecycle phase per in-flight request, keyed by req id:
         # (trace_id, "queue" | "prefill" | "decode"). Only populated while
         # tracing is enabled — the async b/e span bookkeeping is pure
@@ -228,6 +233,13 @@ class EngineCore:
             len(self.scheduler.running) + len(self._inflight),
         )
 
+    def drain_itl_samples(self) -> list[tuple[float, int]]:
+        """Drain the (step_interval_s, max tokens emitted per request)
+        samples collected since the last call (bench goodput scoring)."""
+        out = list(self._itl_samples)
+        self._itl_samples.clear()
+        return out
+
     def execute_dummy_batch(self) -> None:
         """One no-request device step, so idle DP ranks keep participating
         in cross-rank collectives during a wave (reference: ``core.py:731``
@@ -333,6 +345,11 @@ class EngineCore:
         now = time.monotonic()
         if self._last_step_end is not None:
             self._step_interval_s = now - self._last_step_end
+            burst = max(
+                (len(o.new_token_ids) for o in outputs.outputs), default=0
+            )
+            if burst > 0:
+                self._itl_samples.append((self._step_interval_s, burst))
         self._last_step_end = now
         self._attach_engine_stats(outputs)
         if self.perfwatch is not None and self.perfwatch.active is not None:
@@ -970,16 +987,29 @@ class EngineCore:
             variants["dynamic_off"] = {"enable_sampler_kernel": True,
                                        "enable_decode_attention": True,
                                        "_disable_dynamic": True}
+        if getattr(self.scheduler, "adaptive_spec", None) is not None:
+            # Adaptive speculation on/off: the off side pins every
+            # request at the full static draft budget (the controller
+            # keeps learning; only its schedule-time verdicts are
+            # bypassed), so the pair isolates the drafting policy.
+            variants["adaptive_spec_off"] = {
+                "enable_sampler_kernel": True,
+                "enable_decode_attention": True,
+                "_disable_adaptive_spec": True,
+            }
         measured: dict[str, dict] = {}
         aborted_reason: str | None = None
         prev_flags = None
         prev_dyn = self.scheduler.disable_dynamic_decode
+        prev_adaptive = self.scheduler.disable_adaptive_spec
         try:
             for name, spec in variants.items():
                 flags = {k: v for k, v in spec.items()
                          if not k.startswith("_")}
                 self.scheduler.disable_dynamic_decode = bool(
                     spec.get("_disable_dynamic", prev_dyn))
+                self.scheduler.disable_adaptive_spec = bool(
+                    spec.get("_disable_adaptive_spec", prev_adaptive))
                 prev = self.executor.collective_rpc(
                     "set_kernel_flags", flags)[0]
                 if prev_flags is None:
@@ -1051,6 +1081,7 @@ class EngineCore:
             aborted_reason = f"error: {exc}"
         finally:
             self.scheduler.disable_dynamic_decode = prev_dyn
+            self.scheduler.disable_adaptive_spec = prev_adaptive
             if prev_flags is not None:
                 self.executor.collective_rpc(
                     "set_kernel_flags", prev_flags)
@@ -1100,6 +1131,11 @@ class EngineCore:
             # the fixed-K chain; note the ON side amortizes many tokens
             # per launch, so compare per-TOKEN cost when interpreting.
             result["ab"]["dynamic_decode"] = pair("dynamic_off")
+        if "adaptive_spec_off" in measured:
+            # Adaptive drafting vs the static budget. Device time alone
+            # undersells the ON side (shorter drafts also shift work off
+            # the wire); the goodput bench is the accepted-tokens view.
+            result["ab"]["adaptive_spec"] = pair("adaptive_spec_off")
         logger.info("perfwatch A/B: %s", result["ab"])
         return pw.note_ab(result)
 

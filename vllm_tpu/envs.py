@@ -83,6 +83,14 @@ _readers: dict[str, Callable[[], Any]] = {
     "VLLM_TPU_DISABLE_DYNAMIC_DECODE": _bool(
         "VLLM_TPU_DISABLE_DYNAMIC_DECODE", False
     ),
+    # Escape hatch for the adaptive speculation controller
+    # (spec_decode/adaptive.py): draft budgets revert to the static
+    # num_speculative_tokens and the occupancy gate never suspends.
+    # Accepted text is verification-identical either way; A/B this
+    # before filing adaptive-spec bugs.
+    "VLLM_TPU_DISABLE_ADAPTIVE_SPEC": _bool(
+        "VLLM_TPU_DISABLE_ADAPTIVE_SPEC", False
+    ),
     # Escape hatch for the fused sort-free sampling kernel
     # (ops/sampler_kernel.py): sampling batches fall back to the XLA
     # sort-free reference in sample/sampler.py when set. Both paths are

@@ -176,6 +176,16 @@ class PeerClient:
         header, _ = self._rpc({"op": "stats"}, [])
         return header
 
+    def corpus_put(self, header: dict, blob: bytes) -> int:
+        """Push a suffix-corpus share frame (header carries ``op`` +
+        per-sequence ``lens``; blob is the packed int32 token stream).
+        Returns the number of sequences the peer folded in."""
+        reply, _ = self._rpc(dict(header, op="corpus_put"), [blob])
+        if "error" in reply:
+            raise ConnectionError(
+                f"peer {self.url} rejected corpus share: {reply['error']}")
+        return int(reply.get("ok", 0))
+
 
 class PeerServer:
     """Threaded server exposing a host tier to the pool.
@@ -187,6 +197,10 @@ class PeerServer:
 
     def __init__(self, tier, host: str = "127.0.0.1", port: int = 0) -> None:
         self.tier = tier
+        # Optional suffix-corpus sink (adaptive speculation's DP-pool
+        # corpus share): callable(header, body) -> count folded in.
+        # None = corpus frames are rejected like any unknown op.
+        self.corpus_sink = None
         self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         self._sock.bind((host, port))
@@ -266,6 +280,18 @@ class PeerServer:
             _send_frame(conn, {"ok": True}, [])
         elif op == "stats":
             _send_frame(conn, self.tier.stats(), [])
+        elif op == "corpus_put":
+            sink = self.corpus_sink
+            if sink is None:
+                _send_frame(
+                    conn, {"error": "no corpus sink on this peer"}, [])
+                return
+            try:
+                added = sink(header, body)
+            except Exception as exc:  # a bad frame must not kill the conn
+                _send_frame(conn, {"error": f"corpus ingest: {exc}"}, [])
+                return
+            _send_frame(conn, {"ok": int(added)}, [])
         else:
             _send_frame(conn, {"error": f"unknown op {op!r}"}, [])
 

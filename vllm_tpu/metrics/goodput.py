@@ -1,0 +1,91 @@
+"""Goodput scoring helpers: accepted tokens/s under an inter-token
+latency SLO.
+
+Raw decode throughput rewards speculation for *proposing* tokens; what
+a serving deployment sells is tokens the verifier actually emitted,
+delivered within a latency objective. The bench's goodput mode scores
+exactly that:
+
+- ``accepted_tok_s``: spec-accepted (drafted-and-verified) tokens per
+  second when speculation is on, falling back to emitted tokens/s when
+  it is off — the two coincide for non-spec runs, so the number is
+  comparable across A/B sides.
+- ``slo_attainment``: the fraction of per-token inter-token gaps at or
+  under the SLO target. The engine records one (step_interval_s,
+  max-tokens-emitted-per-request) sample per finalized step; a step
+  that hands a request k tokens amortizes its interval over k gaps,
+  which is how a streaming client experiences multi-token spec bursts.
+- ``p99_itl_ms`` / ``slo_met``: the tail itself, and whether it clears
+  the target.
+
+Everything here is pure (no engine, no clock) so the scoring contract
+is unit-testable; the bench supplies the samples and counters.
+"""
+
+from __future__ import annotations
+
+ITLSample = tuple[float, int]  # (step interval seconds, tokens emitted)
+
+
+def expand_itl_ms(samples: list[ITLSample]) -> list[float]:
+    """Per-token inter-token latencies (ms) from per-step samples: a
+    step emitting ``k`` tokens for a request contributes ``k`` gaps of
+    ``interval / k`` each. Non-positive samples are dropped."""
+    out: list[float] = []
+    for interval_s, burst in samples:
+        burst = int(burst)
+        if burst <= 0 or interval_s <= 0:
+            continue
+        out.extend([interval_s * 1000.0 / burst] * burst)
+    return out
+
+
+def percentile(values: list[float], q: float) -> float | None:
+    """Nearest-rank percentile (q in [0, 1]); None on empty input."""
+    if not values:
+        return None
+    ordered = sorted(values)
+    idx = max(0, min(len(ordered) - 1, int(round(q * len(ordered))) - 1))
+    if q <= 0:
+        idx = 0
+    return ordered[idx]
+
+
+def goodput_summary(
+    samples: list[ITLSample],
+    *,
+    elapsed_s: float,
+    accepted_tokens: int | None = None,
+    emitted_tokens: int | None = None,
+    slo_itl_ms: float | None = None,
+) -> dict:
+    """Score a bench window. ``accepted_tokens`` is the spec-accepted
+    counter delta over the window (None when speculation is off, in
+    which case ``emitted_tokens`` supplies the comparable rate)."""
+    itls = expand_itl_ms(samples)
+    p99 = percentile(itls, 0.99)
+    tokens = accepted_tokens if accepted_tokens is not None else emitted_tokens
+    rate = (
+        round(tokens / elapsed_s, 3)
+        if tokens is not None and elapsed_s > 0
+        else None
+    )
+    attainment = None
+    slo_met = None
+    if slo_itl_ms is not None and itls:
+        attainment = round(
+            sum(1 for t in itls if t <= slo_itl_ms) / len(itls), 4
+        )
+        slo_met = p99 is not None and p99 <= slo_itl_ms
+    return {
+        "accepted_tok_s": rate,
+        "accepted_tokens": tokens,
+        "token_source": (
+            "spec_accepted" if accepted_tokens is not None else "emitted"
+        ),
+        "slo_attainment": attainment,
+        "slo_met": slo_met,
+        "slo_itl_ms": slo_itl_ms,
+        "p99_itl_ms": round(p99, 3) if p99 is not None else None,
+        "itl_samples": len(itls),
+    }

@@ -202,6 +202,19 @@ class PrometheusRegistry:
             "vllm:spec_decode_acceptance_length",
             "Generated tokens per spec verification step (accepted+bonus)",
             [1.0, 2.0, 3.0, 4.0, 5.0, 6.0, 8.0, 12.0])
+        self.spec_acceptance_rate = Gauge(
+            "vllm:spec_decode_acceptance_rate",
+            "Global per-position draft acceptance rate (adaptive EMA)")
+        self.spec_draft_len = Histogram(
+            "vllm:spec_decode_draft_len",
+            "Draft tokens scheduled per spec verification step",
+            [1.0, 2.0, 3.0, 4.0, 6.0, 8.0, 12.0, 16.0])
+        self.spec_suspended = Gauge(
+            "vllm:spec_decode_suspended",
+            "1 while adaptive speculation is occupancy-suspended")
+        self.spec_suspensions = Counter(
+            "vllm:spec_decode_suspensions_total",
+            "Occupancy-gated speculation suspensions (high-watermark trips)")
         self.bucket_compiles = Counter(
             "vllm:step_bucket_compiles",
             "Jitted-step bucket cache misses (new (tokens,reqs,blocks))")
@@ -446,6 +459,8 @@ class PrometheusRegistry:
             self.generation_tokens, self.prompt_tokens,
             self.ttft, self.tpot, self.e2e,
             self.queue_time, self.accept_length,
+            self.spec_acceptance_rate, self.spec_draft_len,
+            self.spec_suspended, self.spec_suspensions,
             self.bucket_compiles, self.bucket_hits, self.pipeline_stall,
             self.decode_batch_ratio, self.tokens_per_launch,
             self.prep_fallback_rows,
@@ -510,6 +525,12 @@ class PrometheusRegistry:
                 self.queue_time.observe(t)
             for n in s.spec_accept_lengths:
                 self.accept_length.observe(n)
+            if s.spec_acceptance_rate_ema is not None:
+                self.spec_acceptance_rate.set(s.spec_acceptance_rate_ema)
+            for n in s.spec_draft_lens:
+                self.spec_draft_len.observe(n)
+            self.spec_suspended.set(1.0 if s.spec_suspended else 0.0)
+            self.spec_suspensions.inc_to(s.spec_suspensions)
             lc, lh = self._last_buckets
             self.bucket_compiles.inc(max(0, s.bucket_compiles - lc))
             self.bucket_hits.inc(max(0, s.bucket_hits - lh))

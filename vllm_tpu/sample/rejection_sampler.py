@@ -30,6 +30,36 @@ from vllm_tpu.sample.sampler import (
 )
 
 
+def per_position_acceptance(
+    num_scheduled: int, num_accepted: int, *, tree=None
+) -> list[bool]:
+    """Host-side per-position acceptance surfacing for one verification
+    step (feeds the adaptive controller's acceptance curve; pure, no
+    device work — the in-jit samplers already encode the same contract).
+
+    Chain verification accepts a PREFIX: position ``i`` (0-based draft
+    position) was accepted iff ``i < num_accepted``. Tree verification
+    accepts a root-to-leaf path prefix: ``num_scheduled`` counts nodes
+    (a breadth-first level prefix) and ``num_accepted`` is the accepted
+    depth, so level ``d`` (1-based) was accepted iff
+    ``d <= num_accepted``; the returned list has one entry per
+    *scheduled level*.
+    """
+    if num_scheduled <= 0:
+        return []
+    if tree is None:
+        n = num_scheduled
+        return [i < num_accepted for i in range(n)]
+    covered, levels, size = 0, 0, 1
+    for d, b in enumerate(tree.branching, start=1):
+        size *= b
+        covered += size
+        levels = d
+        if num_scheduled <= covered:
+            break
+    return [d <= num_accepted for d in range(1, levels + 1)]
+
+
 def _per_pos_uniform(prng_keys: jnp.ndarray, s1: int) -> jnp.ndarray:
     """[R, S+1] uniforms + [R, S+1] gumbel streams from per-row keys."""
 

@@ -49,7 +49,15 @@ def tree_rejection_sample(
     tree: DraftTree,
     md: SamplingMetadata,
     *,
-    active: jnp.ndarray | None = None,  # [R] bool: row has a full tree
+    active: jnp.ndarray | None = None,  # [R] bool: row has a tree
+    # [R] i32: per-row scheduled node count (breadth-first level prefix;
+    # adaptive pruning). None = every active row carries the full tree.
+    # Children beyond a row's prefix are never accepted and their
+    # (garbage-padded) tokens never touch the residual; a row that
+    # accepts its whole pruned path emits its level-d "recovery" token
+    # from the untouched residual at the deepest node — which IS the
+    # bonus distribution, so pruned rows still emit accepted+1 tokens.
+    num_draft: jnp.ndarray | None = None,
     needs_penalties: bool = False,
     needs_top_k: bool,
     needs_top_p_min_p: bool,
@@ -120,6 +128,11 @@ def tree_rejection_sample(
         chosen_tok = tgt_d
         for rank in range(b_d):
             c = child_tab[cur, rank]  # [R]
+            in_budget = c >= 0
+            if num_draft is not None:
+                # Window indices 1..num_draft hold the row's scheduled
+                # node prefix; anything past it is unverifiable padding.
+                in_budget &= c <= num_draft
             tok_c = draft_ids[rows, jnp.clip(c, 0, w - 1)]
             if needs_gumbel:
                 m = jnp.sum(residual, axis=-1)
@@ -134,14 +147,17 @@ def tree_rejection_sample(
                 accept = jnp.where(greedy, tok_c == tgt_d, accept_rand)
             else:
                 accept = tok_c == tgt_d
-            hit = alive & ~acc_hit & (c >= 0) & accept
+            hit = alive & ~acc_hit & in_budget & accept
             nxt = jnp.where(hit, c, nxt)
             chosen_tok = jnp.where(hit, tok_c, chosen_tok)
             acc_hit |= hit
             if needs_gumbel:
                 # Zero the tried token's mass for later siblings/recovery
-                # (only where the row is still searching at this node).
-                searching = alive & ~acc_hit
+                # (only where the row is still searching at this node —
+                # and only for children actually in the row's budget:
+                # out-of-budget padding tokens were never proposed, so
+                # their mass stays available to recovery).
+                searching = alive & ~acc_hit & in_budget
                 residual = residual.at[rows, tok_c].multiply(
                     jnp.where(searching, 0.0, 1.0)
                 )

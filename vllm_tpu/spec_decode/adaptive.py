@@ -1,0 +1,543 @@
+"""Adaptive speculation: acceptance-driven drafting with an
+occupancy-gated shutoff, plus cross-engine suffix-corpus sharing.
+
+The spec-decode stack (n-gram, suffix corpus, EAGLE, Medusa, draft
+models, tree verification) is statically configured — a fixed
+``num_speculative_tokens`` / tree topology chosen at launch — while
+production acceptance rates vary per request and speculation flips
+from bandwidth-saver to FLOPs-waster as the batch fills. This module
+closes the measure→decide→act loop scheduler-side:
+
+- :class:`AdaptiveSpecController` — a pure state machine (injectable
+  clock, no engine dependencies) that the scheduler consults every
+  step. It keeps a time-decayed acceptance-rate EMA per request
+  (seeded from a global per-proposer EMA), ratchets each request's
+  draft budget ±1 per verification step within
+  ``[0, num_speculative_tokens]``, prunes static draft-tree topology
+  to the measured per-depth acceptance curve, and suspends speculation
+  batch-wide when batch occupancy crosses a high-water mark (resuming
+  under a low-water mark, with hysteresis so the gate never flaps in
+  the band between them).
+
+- :class:`SuffixCorpusShare` — piggybacks finished-generation token
+  sequences onto the kv-fabric peer channel so every engine in the DP
+  pool drafts from the union of observed completions. Sequences are
+  deduplicated (bounded seen-set on both sides), pushes are
+  best-effort with bounded retry inherited from
+  :class:`~vllm_tpu.kv_fabric.peer.PeerClient`, and a dead peer
+  degrades the share to local-only drafting — counted, never fatal.
+
+Safety invariant (covered by ``tests/spec_decode/test_adaptive.py``):
+adaptation changes *proposals only*. Rejection sampling still verifies
+every draft against the target model's distribution, so adaptive
+on/off produce token-identical output for seeded runs; the controller
+can only change how much speculative work is attempted, never what is
+accepted.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import OrderedDict, deque
+from collections.abc import Callable, Sequence
+from dataclasses import dataclass, field
+
+import numpy as np
+
+__all__ = ["AdaptiveSpecController", "SuffixCorpusShare"]
+
+
+# ----------------------------------------------------------------------
+# Time-decayed EMA
+# ----------------------------------------------------------------------
+
+
+@dataclass
+class _Ema:
+    """Irregular-interval EMA: an observation's weight halves every
+    ``half_life_s`` seconds of wall time, independent of how many
+    observations arrive in between. ``value is None`` until the first
+    observation (callers treat "no data" as optimistic)."""
+
+    half_life_s: float
+    value: float | None = None
+    t_last: float = 0.0
+
+    def update(self, x: float, now: float) -> float:
+        if self.value is None:
+            self.value = float(x)
+        else:
+            dt = max(0.0, now - self.t_last)
+            w = 0.5 ** (dt / self.half_life_s) if self.half_life_s > 0 else 0.0
+            # ``w`` is the surviving weight of history; the new
+            # observation supplies the rest. dt=0 ⇒ w=1 would ignore the
+            # observation entirely, so floor the blend-in fraction.
+            alpha = max(1.0 - w, 0.1)
+            self.value = (1.0 - alpha) * self.value + alpha * float(x)
+        self.t_last = now
+        return self.value
+
+
+@dataclass
+class _ReqState:
+    ema: _Ema
+    budget: int  # draft tokens (chain) or tree depth levels (tree)
+    t_last_obs: float = 0.0
+
+
+# ----------------------------------------------------------------------
+# Controller
+# ----------------------------------------------------------------------
+
+
+class AdaptiveSpecController:
+    """Acceptance-driven draft budgeting + occupancy-gated shutoff.
+
+    Pure host-side state machine: the scheduler calls
+    :meth:`observe` after each verification step, :meth:`observe_occupancy`
+    after each schedule, and :meth:`draft_budget` when trimming a
+    request's pending drafts. Everything is deterministic given the
+    injected ``clock`` (tests drive a fake clock; no engine required).
+
+    Units: for chain proposers budgets count draft *tokens*; for tree
+    proposers the internal ratchet counts tree *depth levels* and
+    :meth:`draft_budget` converts to a breadth-first node-prefix count
+    (window indices are breadth-first after the root, so any depth
+    cutoff is a contiguous node prefix — the runner's tree metadata and
+    the tree rejection sampler both honor per-row node counts).
+    """
+
+    def __init__(
+        self,
+        num_speculative_tokens: int,
+        *,
+        high_watermark: float = 0.85,
+        low_watermark: float = 0.60,
+        ema_half_life_s: float = 10.0,
+        up_threshold: float = 0.7,
+        down_threshold: float = 0.4,
+        position_floor: float = 0.15,
+        probe_interval_s: float | None = None,
+        tree=None,
+        clock: Callable[[], float] = time.monotonic,
+    ) -> None:
+        if num_speculative_tokens <= 0:
+            raise ValueError("adaptive speculation requires k > 0")
+        if not (0.0 < low_watermark < high_watermark <= 1.0):
+            raise ValueError(
+                f"watermarks must satisfy 0 < low < high <= 1, got "
+                f"low={low_watermark} high={high_watermark}")
+        if not (0.0 <= down_threshold < up_threshold <= 1.0):
+            raise ValueError(
+                f"ratchet thresholds must satisfy 0 <= down < up <= 1, "
+                f"got down={down_threshold} up={up_threshold}")
+        self.k = num_speculative_tokens
+        self.high_watermark = high_watermark
+        self.low_watermark = low_watermark
+        self.half_life_s = ema_half_life_s
+        self.up_threshold = up_threshold
+        self.down_threshold = down_threshold
+        self.position_floor = position_floor
+        # A request ratcheted to budget 0 generates no more verification
+        # evidence, so it could never recover; probe with a single draft
+        # token (depth-1 level for trees) at a decaying cadence instead.
+        self.probe_interval_s = (
+            probe_interval_s if probe_interval_s is not None
+            else 2.0 * ema_half_life_s)
+        self.tree = tree
+        self._clock = clock
+
+        # Tree bookkeeping: nodes are breadth-first by depth, so the
+        # node-prefix length for a depth cutoff is a running sum of
+        # level sizes (cartesian level d has prod(b_1..b_d) nodes).
+        if tree is not None:
+            self._max_depth = tree.num_levels
+            sizes, n = [], 1
+            for b in tree.branching:
+                n *= b
+                sizes.append(n)
+            self._nodes_at_depth = [0]
+            for s in sizes:
+                self._nodes_at_depth.append(self._nodes_at_depth[-1] + s)
+            # depth reached by an accepted path of length a == a (paths
+            # descend one level per accepted token).
+            self._max_units = self._max_depth
+        else:
+            self._max_depth = 0
+            self._nodes_at_depth = []
+            self._max_units = self.k
+
+        self._global = _Ema(ema_half_life_s)
+        self._requests: dict[str, _ReqState] = {}
+        # Per-position acceptance curve: index i is draft position i
+        # (chain) or depth i+1 (tree). Feeds tree pruning; exported for
+        # debugging either way.
+        self._pos = [_Ema(ema_half_life_s) for _ in range(self._max_units)]
+
+        self.suspended = False
+        self.suspensions_total = 0
+        # Totals for snapshots/debugging (scheduler keeps its own
+        # cumulative accept counters; these are controller-local).
+        self.observations = 0
+
+    # -- acceptance accounting -----------------------------------------
+
+    def observe(
+        self, req_id: str, num_scheduled: int, num_accepted: int
+    ) -> None:
+        """Fold one verification step's outcome into the EMAs and
+        ratchet the request's budget.
+
+        ``num_scheduled``: drafts actually verified this step — tokens
+        for chains, *nodes* for trees. ``num_accepted``: accepted draft
+        tokens (excludes the bonus token); for trees this is the
+        accepted path depth.
+        """
+        if num_scheduled <= 0:
+            return
+        now = self._clock()
+        # Canonical per-position surfacing lives next to the samplers
+        # whose contract it mirrors (lazy import: rejection_sampler
+        # pulls jax, which the pure controller otherwise never needs).
+        from vllm_tpu.sample.rejection_sampler import (
+            per_position_acceptance,
+        )
+
+        hits = per_position_acceptance(
+            num_scheduled, num_accepted, tree=self.tree
+        )[: self._max_units]
+        if not hits:
+            return
+        units_scheduled = len(hits)
+        accepted = sum(hits)
+        rate = accepted / units_scheduled
+
+        self._global.update(rate, now)
+        for i, hit in enumerate(hits):
+            self._pos[i].update(1.0 if hit else 0.0, now)
+
+        st = self._requests.get(req_id)
+        if st is None:
+            st = self._seed_request(now)
+            self._requests[req_id] = st
+        ema = st.ema.update(rate, now)
+        st.t_last_obs = now
+        if ema >= self.up_threshold:
+            st.budget = min(st.budget + 1, self._max_units)
+        elif ema <= self.down_threshold:
+            st.budget = max(st.budget - 1, 0)
+        self.observations += 1
+
+    def _seed_request(self, now: float) -> _ReqState:
+        ema = _Ema(self.half_life_s)
+        seed = self._global.value
+        if seed is None:
+            # No fleet evidence yet: draft optimistically at full budget
+            # (verification is the safety net, the only cost is FLOPs).
+            budget = self._max_units
+        else:
+            ema.value, ema.t_last = seed, now
+            budget = max(1, min(
+                self._max_units, round(seed * self._max_units)))
+        return _ReqState(ema=ema, budget=budget, t_last_obs=now)
+
+    def forget(self, req_id: str) -> None:
+        self._requests.pop(req_id, None)
+
+    # -- budgets --------------------------------------------------------
+
+    def draft_budget(self, req_id: str) -> int:
+        """Max drafts to schedule for this request *now* — tokens for
+        chains, breadth-first node-prefix count for trees. Returns 0
+        while speculation is suspended batch-wide."""
+        if self.suspended:
+            return 0
+        now = self._clock()
+        st = self._requests.get(req_id)
+        if st is None:
+            st = self._seed_request(now)
+            self._requests[req_id] = st
+        units = st.budget
+        if units <= 0:
+            # Zero-budget probe: spend one unit occasionally so a
+            # request whose text turned predictable can climb back.
+            if now - st.t_last_obs >= self.probe_interval_s:
+                units = 1
+            else:
+                return 0
+        if self.tree is None:
+            return units
+        depth = min(units, self._curve_depth())
+        return self._nodes_at_depth[depth]
+
+    def _curve_depth(self) -> int:
+        """Deepest tree level worth drafting per the measured per-depth
+        acceptance curve; unmeasured levels pass (optimistic). Floors
+        at 1 so tree speculation can always regenerate evidence."""
+        for d in range(1, self._max_depth + 1):
+            v = self._pos[d - 1].value
+            if v is not None and v < self.position_floor:
+                return max(1, d - 1)
+        return self._max_depth
+
+    def _depth_of_nodes(self, num_nodes: int) -> int:
+        """Depth of the deepest level fully/partially covered by a
+        breadth-first node prefix of this length."""
+        for d in range(1, self._max_depth + 1):
+            if num_nodes <= self._nodes_at_depth[d]:
+                return d
+        return self._max_depth
+
+    # -- occupancy gate -------------------------------------------------
+
+    def observe_occupancy(self, occupancy: float) -> bool:
+        """Update the batch-wide gate; returns the new suspended state.
+        Hysteresis: suspend at ``occ >= high``, resume at
+        ``occ <= low``; inside the band the state holds (no flapping)."""
+        if not self.suspended and occupancy >= self.high_watermark:
+            self.suspended = True
+            self.suspensions_total += 1
+        elif self.suspended and occupancy <= self.low_watermark:
+            self.suspended = False
+        return self.suspended
+
+    # -- introspection --------------------------------------------------
+
+    def acceptance_rate(self) -> float | None:
+        """Global acceptance-rate EMA (None before any observation)."""
+        return self._global.value
+
+    def request_budget(self, req_id: str) -> int | None:
+        st = self._requests.get(req_id)
+        return None if st is None else st.budget
+
+    def position_curve(self) -> list[float | None]:
+        return [e.value for e in self._pos]
+
+    def snapshot(self) -> dict:
+        return {
+            "acceptance_rate_ema": self._global.value,
+            "suspended": self.suspended,
+            "suspensions_total": self.suspensions_total,
+            "tracked_requests": len(self._requests),
+            "observations": self.observations,
+            "position_curve": self.position_curve(),
+            "tree_curve_depth": (
+                self._curve_depth() if self.tree is not None else None),
+        }
+
+
+# ----------------------------------------------------------------------
+# Cross-engine suffix-corpus sharing
+# ----------------------------------------------------------------------
+
+
+class SuffixCorpusShare:
+    """Share finished-generation token sequences across the DP pool so
+    every engine's :class:`SuffixProposer` drafts from the union of
+    observed completions.
+
+    Rides the kv-fabric peer channel: outbound sequences are framed as
+    a ``corpus_put`` op (JSON header with per-sequence lengths + one
+    packed int32 blob) and pushed to each peer's
+    :class:`~vllm_tpu.kv_fabric.peer.PeerServer`, whose ``corpus_sink``
+    hands them to :meth:`ingest` on the receiving engine.
+
+    Failure semantics: a push that exhausts the client's bounded
+    retries marks that peer dead and drops it — counted in
+    ``peer_failures`` — and when the last peer dies the share degrades
+    to local-only drafting (``local_only``) instead of erroring the
+    serving path. Duplicates are suppressed on both sides by a bounded
+    seen-hash set, so a sequence bounced between engines is folded into
+    each corpus at most once; corpus *size* stays bounded by the
+    proposer's own token cap.
+    """
+
+    OP = "corpus_put"
+
+    def __init__(
+        self,
+        proposer,
+        peer_urls: Sequence[str] = (),
+        *,
+        max_seq_len: int = 512,
+        min_seq_len: int = 4,
+        max_pending: int = 256,
+        seen_cap: int = 4096,
+        client_factory: Callable | None = None,
+        async_flush: bool = True,
+    ) -> None:
+        self.proposer = proposer
+        self.max_seq_len = max_seq_len
+        self.min_seq_len = min_seq_len
+        self.max_pending = max_pending
+        if client_factory is None:
+            from vllm_tpu.kv_fabric.peer import PeerClient
+
+            client_factory = PeerClient
+        self._clients = {url: client_factory(url) for url in peer_urls}
+        # Bounded FIFO of content hashes seen locally (sent or ingested).
+        self._seen: OrderedDict[int, None] = OrderedDict()
+        self._seen_cap = seen_cap
+        self._pending: deque[np.ndarray] = deque()
+        self._lock = threading.Lock()
+        self.shared_out = 0
+        self.ingested = 0
+        self.dropped_dup = 0
+        self.dropped_overflow = 0
+        self.peer_failures = 0
+        self._thread: threading.Thread | None = None
+        self._wake = threading.Event()
+        self._stop = False
+        if async_flush and self._clients:
+            self._thread = threading.Thread(
+                target=self._run, name="suffix-corpus-share", daemon=True)
+            self._thread.start()
+
+    @property
+    def local_only(self) -> bool:
+        return not self._clients
+
+    # -- dedup ----------------------------------------------------------
+
+    def _mark_seen(self, key: int) -> bool:
+        """Record ``key``; returns False if it was already present."""
+        if key in self._seen:
+            self._seen.move_to_end(key)
+            return False
+        self._seen[key] = None
+        while len(self._seen) > self._seen_cap:
+            self._seen.popitem(last=False)
+        return True
+
+    @staticmethod
+    def _key(seq: np.ndarray) -> int:
+        return hash(seq.tobytes())
+
+    # -- sender side ----------------------------------------------------
+
+    def observe(self, token_ids) -> None:
+        """Queue a locally finished generation for the pool. Keeps the
+        most recent ``max_seq_len`` tokens (suffix matching cares about
+        the tail); dedups against everything already sent or ingested."""
+        if self.local_only:
+            return
+        seq = np.asarray(token_ids, np.int32)
+        if len(seq) < self.min_seq_len:
+            return
+        if len(seq) > self.max_seq_len:
+            seq = seq[-self.max_seq_len:]
+        with self._lock:
+            if not self._mark_seen(self._key(seq)):
+                self.dropped_dup += 1
+                return
+            if len(self._pending) >= self.max_pending:
+                self._pending.popleft()
+                self.dropped_overflow += 1
+            self._pending.append(seq)
+        if self._thread is not None:
+            self._wake.set()
+
+    def flush(self) -> int:
+        """Push every pending sequence to every live peer; returns the
+        number of sequences shipped (0 under local-only degradation)."""
+        with self._lock:
+            if not self._pending:
+                return 0
+            batch = list(self._pending)
+            self._pending.clear()
+        if not self._clients:
+            return 0
+        header = {"op": self.OP, "lens": [len(s) for s in batch]}
+        blob = (np.concatenate(batch) if batch
+                else np.zeros(0, np.int32)).astype(np.int32).tobytes()
+        shipped = 0
+        for url, client in list(self._clients.items()):
+            try:
+                client.corpus_put(header, blob)
+                shipped = len(batch)
+            except (ConnectionError, OSError):
+                # Peer died mid-share: drop it and keep serving — the
+                # proposer still drafts from the local corpus.
+                self.peer_failures += 1
+                self._clients.pop(url, None)
+                try:
+                    client.close()
+                except Exception:
+                    pass
+        self.shared_out += shipped
+        return shipped
+
+    def _run(self) -> None:
+        while not self._stop:
+            self._wake.wait(timeout=1.0)
+            self._wake.clear()
+            if self._stop:
+                return
+            try:
+                self.flush()
+            except Exception:
+                pass  # never let the share thread die loudly
+
+    # -- receiver side --------------------------------------------------
+
+    def ingest(self, seqs: Sequence[np.ndarray]) -> int:
+        """Fold peer-shared sequences into the local proposer corpus.
+        Dedups against the seen-set (a sequence we originated, or
+        already received from another peer, is skipped). Returns the
+        number actually added; corpus size stays bounded by the
+        proposer's own eviction cap."""
+        added = 0
+        for seq in seqs:
+            seq = np.asarray(seq, np.int32)
+            if len(seq) < self.min_seq_len:
+                continue
+            with self._lock:
+                fresh = self._mark_seen(self._key(seq))
+            if not fresh:
+                self.dropped_dup += 1
+                continue
+            self.proposer.observe_finished(seq.astype(np.int64))
+            self.ingested += 1
+            added += 1
+        return added
+
+    @staticmethod
+    def decode_frame(header: dict, body: bytes) -> list[np.ndarray]:
+        """Unpack a ``corpus_put`` frame into per-sequence arrays."""
+        lens = [int(n) for n in header.get("lens", [])]
+        flat = np.frombuffer(body, np.int32)
+        if sum(lens) != len(flat):
+            raise ValueError(
+                f"corpus frame length mismatch: lens sum {sum(lens)} "
+                f"!= blob {len(flat)}")
+        out, off = [], 0
+        for n in lens:
+            out.append(flat[off:off + n].copy())
+            off += n
+        return out
+
+    def stats(self) -> dict:
+        return {
+            "shared_out": self.shared_out,
+            "ingested": self.ingested,
+            "dropped_dup": self.dropped_dup,
+            "dropped_overflow": self.dropped_overflow,
+            "peer_failures": self.peer_failures,
+            "local_only": self.local_only,
+            "peers": len(self._clients),
+        }
+
+    def close(self) -> None:
+        self._stop = True
+        self._wake.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2.0)
+            self._thread = None
+        for c in self._clients.values():
+            try:
+                c.close()
+            except Exception:
+                pass
+        self._clients.clear()

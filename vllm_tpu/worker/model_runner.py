@@ -67,6 +67,11 @@ class StepHandle:
         # id may have been reused while this step was in flight).
         self.row_states = row_states or []
         self.empty = empty
+        # Adaptive speculation verdicts for THIS step (from the
+        # SchedulerOutput): suspended = skip all proposer work at
+        # finalize; budgets clip next-step proposals per request.
+        self.spec_suspended = False
+        self.spec_draft_budgets: dict[str, int] = {}
         self.drafts = None  # EAGLE proposals [R, K] (device array)
         self.pooled = None  # (last [R, D], mean [R, D]) pooling outputs
         self.nan_count = None  # device scalar when VLLM_TPU_NAN_CHECK
@@ -243,6 +248,12 @@ class ModelRunner:
                 self.model.aux_hidden_layers = draft_model.default_aux_layers(
                     self.model.num_layers
                 )
+        # DP-pool suffix-corpus share (adaptive speculation): built
+        # lazily once the kv-fabric connector attaches (its peer wiring
+        # is the transport). None until then; "dead" stops re-probing
+        # after a build failure.
+        self._suffix_share = None
+        self._suffix_share_dead = False
 
         # EPLB: logical->physical expert indirection + load accumulator.
         self._eplb = getattr(model, "enable_eplb", False)
@@ -562,13 +573,21 @@ class ModelRunner:
         s = tree.num_nodes
         t = t_pad
         base_idx = spec["sample_pos"][:, 0]  # [R] stream idx of the root
-        active = spec["num_draft"] == s  # [R] full tree scheduled
+        # Per-row node count: s for a full tree, fewer when the adaptive
+        # controller prunes to a breadth-first level prefix (a prefix is
+        # a valid subtree — every node's parent precedes it, so the
+        # window layout, ancestor mask, and KV consolidation all hold
+        # with the per-row bound below).
+        num_draft = spec["num_draft"]  # [R]
+        active = num_draft > 0  # [R] row has a (possibly pruned) tree
         row = jnp.clip(md.token_req_idx, 0, r_pad - 1)  # [T]
         tok = jnp.arange(t, dtype=jnp.int32)
         t_live = md.query_start_loc[jnp.clip(md.num_seqs[0], 0, r_pad)]
         live = tok < t_live
         off = tok - base_idx[row]
-        in_nodes = active[row] & (off >= 1) & (off <= s) & live
+        in_nodes = (
+            active[row] & (off >= 1) & (off <= num_draft[row]) & live
+        )
 
         depth_nodes = jnp.asarray(np.asarray(tree.depth[1:], np.int32))
         off_n = jnp.clip(off - 1, 0, s - 1)
@@ -761,6 +780,7 @@ class ModelRunner:
                 out_tokens, num_out, kv_src = tree_rejection_sample(
                     logits3, draft_full, self.tree, sampling,
                     active=tree_active,
+                    num_draft=spec["num_draft"],
                     needs_penalties=needs_penalties,
                     needs_top_k=needs_top_k,
                     needs_top_p_min_p=needs_top_p_min_p,
@@ -1225,6 +1245,40 @@ class ModelRunner:
             )
         self._state_slot_of[req_id] = self._state_slot_free.pop()
 
+    def _suffix_corpus_share(self):
+        """DP-pool suffix-corpus share, built lazily once the kv-fabric
+        connector (the transport) is attached with peer wiring. The
+        local PeerServer — when the fabric binds one — gets this share's
+        ingest as its corpus sink, so every engine both pushes finished
+        generations pool-wide and folds peers' generations into its own
+        proposer corpus. Returns None when there is no connector, no
+        peers, or a prior build failed (local-only drafting — the
+        proposer works unchanged)."""
+        if self._suffix_share is not None or self._suffix_share_dead:
+            return self._suffix_share
+        conn = self.kv_connector
+        if conn is None:
+            return None
+        peers = tuple(getattr(conn, "peer_urls", ()) or ())
+        server = getattr(conn, "_server", None)
+        if not peers and server is None:
+            self._suffix_share_dead = True  # fabric without peer wiring
+            return None
+        from vllm_tpu.spec_decode.adaptive import SuffixCorpusShare
+
+        try:
+            share = SuffixCorpusShare(self.proposer, peers)
+            if server is not None:
+                server.corpus_sink = (
+                    lambda header, body, _s=share: _s.ingest(
+                        SuffixCorpusShare.decode_frame(header, body)
+                    )
+                )
+            self._suffix_share = share
+        except Exception:
+            self._suffix_share_dead = True
+        return self._suffix_share
+
     def _update_states(self, so: SchedulerOutput) -> None:
         if self._is_hybrid:
             # Preempted requests recompute from position 0 with zero SSM
@@ -1250,9 +1304,11 @@ class ModelRunner:
             ):
                 row = state.in_batch_row
                 n_tok = int(self.input_batch.num_tokens[row])
-                self.proposer.observe_finished(
-                    self.input_batch.token_ids[row, :n_tok]
-                )
+                toks = self.input_batch.token_ids[row, :n_tok]
+                self.proposer.observe_finished(toks)
+                share = self._suffix_corpus_share()
+                if share is not None:
+                    share.observe(toks)
             self.input_batch.remove_request(req_id)
         cached = so.scheduled_cached_reqs
         for i, req_id in enumerate(cached.req_ids):
@@ -2295,6 +2351,8 @@ class ModelRunner:
         )
         handle.dyn_sampler_acct = self._dyn_sampler_acct
         self._dyn_sampler_acct = None
+        handle.spec_suspended = so.spec_suspended
+        handle.spec_draft_budgets = so.spec_draft_budgets
         handle.drafts = drafts
         handle.pooled = pooled
         handle.nan_count = nan_count
@@ -2442,18 +2500,36 @@ class ModelRunner:
                 if self.input_batch.req_states.get(rid) is handle.row_states[i]:
                     for tok in toks:
                         self.input_batch.append_token(rid, tok)
-                    if self.proposer is not None and not batch_has_logprobs:
+                    # Adaptive speculation: under occupancy suspension all
+                    # proposer work is skipped (drafting cost is pure
+                    # overhead in a compute-bound batch); otherwise clip
+                    # proposals to the request's acceptance-ratcheted
+                    # budget at the source. None budget = controller off.
+                    budget = (
+                        0 if handle.spec_suspended
+                        else handle.spec_draft_budgets.get(rid)
+                    )
+                    if budget == 0:
+                        pass
+                    elif self.proposer is not None and not batch_has_logprobs:
                         row = self.input_batch.row_of(rid)
                         n_tok = int(self.input_batch.num_tokens[row])
                         drafts = self.proposer.propose(
                             self.input_batch.token_ids[row, :n_tok]
                         )
+                        if drafts and budget is not None:
+                            drafts = drafts[:budget]
                         if drafts:
                             out.draft_token_ids[rid] = drafts
                     elif drafts_np is not None and not batch_has_logprobs:
-                        out.draft_token_ids[rid] = [
-                            int(x) for x in drafts_np[i]
-                        ]
+                        dtoks = [int(x) for x in drafts_np[i]]
+                        if budget is not None:
+                            # In-jit proposals are fixed-shape; the clip
+                            # keeps the BFS node prefix (trees) or chain
+                            # prefix the scheduler would re-trim anyway.
+                            dtoks = dtoks[:budget]
+                        if dtoks:
+                            out.draft_token_ids[rid] = dtoks
                 out.sampled_token_ids.append(toks)
             else:
                 out.sampled_token_ids.append([])
