@@ -1,0 +1,138 @@
+"""Disaggregated prefill/decode e2e on the dp=2 CPU mesh.
+
+The tentpole acceptance scenario: with ``--engine-roles
+prefill,decode`` an eligible request runs its prompt on the prefill
+engine, streams its prompt KV to the decode engine over the fabric's
+``kv_push`` wire op, and resumes decoding there — with byte-identical
+greedy output to the same workload on an ordinary unified pool.
+
+The chaos variant arms the ``kv_fabric.push`` failpoint: a torn push
+chunk must degrade to decode-side recompute (counted in the handoff
+outcomes), with the request finishing normally — never a crash or a
+lost request.
+"""
+
+from __future__ import annotations
+
+import pytest
+
+from tests.models.utils import tiny_llama_dir
+from vllm_tpu import LLM, SamplingParams
+
+BLOCK = 16
+# 6 full blocks: long enough for the phase rung to call it
+# prefill-heavy and for the push manifest to be multi-chunk.
+LONG = [(3001 + 7 * j) % 120 + 3 for j in range(96)]
+# Under one block: ineligible for handoff, rides the normal path.
+SHORT = [(4001 + 7 * j) % 120 + 3 for j in range(8)]
+
+
+@pytest.fixture(scope="module")
+def ckpt(tmp_path_factory):
+    return tiny_llama_dir(tmp_path_factory.mktemp("tiny_llama_disagg"))
+
+
+def _llm(ckpt, **kw):
+    return LLM(
+        model=ckpt, dtype="float32", max_model_len=256, block_size=BLOCK,
+        num_gpu_blocks_override=96, max_num_seqs=4,
+        max_num_batched_tokens=128,
+        data_parallel_engines=2,
+        kv_connector="fabric",
+        # Pushed KV must reproduce the prefill engine's bytes exactly
+        # for token-identity (quantized numerics are covered by
+        # test_kv_quant's tolerance bounds).
+        kv_fabric_quant="none",
+        **kw,
+    )
+
+
+def _generate(llm, sp):
+    outs = llm.generate([
+        {"prompt_token_ids": list(LONG)},
+        {"prompt_token_ids": list(SHORT)},
+    ], sp)
+    return [list(o.outputs[0].token_ids) for o in outs], outs
+
+
+def test_disagg_token_identical_to_unified(ckpt):
+    sp = SamplingParams(temperature=0.0, max_tokens=8, ignore_eos=True)
+
+    llm = _llm(ckpt)
+    try:
+        ref_tokens, _ = _generate(llm, sp)
+    finally:
+        llm.llm_engine.shutdown()
+    assert all(len(t) == 8 for t in ref_tokens)
+
+    llm = _llm(ckpt, engine_roles="prefill,decode")
+    try:
+        client = llm.llm_engine.engine_core
+        assert client._disagg is not None, "coordinator must be armed"
+        routed: list[int] = []
+        orig_add = client.add_request
+
+        def spy(req):
+            orig_add(req)
+            routed.append(client._live[req.request_id])
+
+        client.add_request = spy
+        tokens, outs = _generate(llm, sp)
+
+        assert tokens == ref_tokens, (
+            "disaggregated run must be token-identical to unified")
+
+        status = client.disagg_status()
+        assert status["active"]
+        assert status["pending"] == 0
+        # The long request handed off on pushed KV; the short one never
+        # entered the protocol.
+        assert status["outcomes"]["pushed"] == 1, status
+        assert sum(status["outcomes"].values()) == 1, status
+        # Decode side admitted the push as cached prompt — the same
+        # signal the coordinator classified on.
+        assert outs[0].num_cached_tokens >= 6 * BLOCK
+
+        fab = client.kv_fabric_status()
+        assert fab["engines"]["0"]["push"]["pushed"] >= 1, fab
+        assert fab["engines"]["0"]["push_bytes"] > 0
+        assert fab["engines"]["1"]["push"]["received"] >= 6, fab
+        assert fab["engines"]["1"]["tier_bytes"]["host"] > 0
+
+        # The prefill leg routed to the prefill engine; its resume (the
+        # same request re-added) and the short request stayed off it.
+        assert routed[0] == 0
+    finally:
+        llm.llm_engine.shutdown()
+
+
+def test_torn_push_degrades_to_recompute(ckpt, monkeypatch):
+    # Arm BEFORE the engines spawn (spawn context re-reads the env).
+    # Both rungs under the push must tear: with only the push chunk
+    # dropped, the decode engine quietly heals the missing prefix by
+    # peer-fetching it from the prefill engine's host tier (the normal
+    # fetch ladder), so recompute needs the fetch torn too.
+    monkeypatch.setenv(
+        "VLLM_TPU_FAILPOINTS",
+        "kv_fabric.push=once*drop,kv_fabric.fetch=once*drop")
+    sp = SamplingParams(temperature=0.0, max_tokens=8, ignore_eos=True)
+    llm = _llm(ckpt, engine_roles="prefill,decode")
+    try:
+        client = llm.llm_engine.engine_core
+        out = llm.generate([{"prompt_token_ids": list(LONG)}], sp)[0]
+
+        # Zero lost requests/tokens: a full completion despite the tear.
+        assert out.finished
+        assert len(out.outputs[0].token_ids) == 8
+
+        status = client.disagg_status()
+        assert status["outcomes"]["recompute"] == 1, status
+        assert status["pending"] == 0
+        # The re-accounted cache hit reflects the recompute, not the
+        # scheduling-time account that the failed load invalidated.
+        assert out.num_cached_tokens < 6 * BLOCK
+        # Only the surviving chunk landed on the decode side.
+        fab = client.kv_fabric_status()
+        assert 0 < fab["engines"]["1"]["push"]["received"] < 6, fab
+    finally:
+        llm.llm_engine.shutdown()

@@ -1,0 +1,207 @@
+"""HandoffRecord codec, resume-request construction, and the
+coordinator's handoff state machine."""
+
+from __future__ import annotations
+
+import pytest
+
+from vllm_tpu.disagg import DisaggCoordinator, HandoffRecord, RolePlan
+from vllm_tpu.disagg.handoff import make_resume_request
+from vllm_tpu.request import EngineCoreRequest
+from vllm_tpu.sampling_params import SamplingParams
+
+BLOCK = 16
+
+
+def _record(**kw) -> HandoffRecord:
+    base = dict(
+        request_id="r1",
+        prompt_token_ids=list(range(40)),
+        emitted_token_ids=[7],
+        from_engine=0,
+        to_engine=1,
+        block_hashes=["ab" * 4, "cd" * 4],
+    )
+    base.update(kw)
+    return HandoffRecord(**base)
+
+
+def _request(n_prompt=2 * BLOCK, **param_kw) -> EngineCoreRequest:
+    param_kw.setdefault("max_tokens", 8)
+    params = SamplingParams(temperature=0.0, **param_kw)
+    return EngineCoreRequest(
+        request_id="r1",
+        prompt_token_ids=list(range(n_prompt)),
+        sampling_params=params,
+        eos_token_id=2,
+        priority=3,
+        trace_id="t-1",
+        client_index=5,
+    )
+
+
+def _coordinator(**kw) -> DisaggCoordinator:
+    plan = RolePlan.from_spec("prefill,decode", 2)
+    return DisaggCoordinator(plan, block_size=BLOCK, **kw)
+
+
+# ---------------------------------------------------------------------------
+# HandoffRecord codec
+
+
+def test_record_roundtrip():
+    rec = _record()
+    back = HandoffRecord.decode(rec.encode())
+    assert back == rec
+    assert back.num_blocks == 2
+
+
+def test_record_unknown_version_raises():
+    data = _record().encode().replace(b'"v": 1', b'"v": 99')
+    with pytest.raises(ValueError, match="wire version"):
+        HandoffRecord.decode(data)
+
+
+# ---------------------------------------------------------------------------
+# make_resume_request
+
+
+def test_resume_request_extends_prompt_and_decrements_budget():
+    original = _request(min_tokens=3)
+    rec = _record(prompt_token_ids=list(original.prompt_token_ids))
+    resume = make_resume_request(rec, original)
+    assert resume.request_id == original.request_id
+    assert resume.prompt_token_ids == original.prompt_token_ids + [7]
+    assert resume.sampling_params.max_tokens == 7
+    assert resume.sampling_params.min_tokens == 2
+    # Identity the frontend keys on must survive the migration.
+    assert resume.eos_token_id == 2
+    assert resume.priority == 3
+    assert resume.trace_id == "t-1"
+    assert resume.client_index == 5
+    # The original's params are untouched (deep copy).
+    assert original.sampling_params.max_tokens == 8
+
+
+def test_resume_request_requires_remaining_budget():
+    original = _request(max_tokens=1)
+    rec = _record()
+    with pytest.raises(AssertionError):
+        make_resume_request(rec, original)
+
+
+# ---------------------------------------------------------------------------
+# Coordinator: eligibility
+
+
+def test_eligibility_matrix():
+    co = _coordinator()
+    assert co.eligible(_request())
+    # Short prompts push nothing (no full block).
+    assert not co.eligible(_request(n_prompt=BLOCK - 1))
+    # Budget 1 has no decode leg.
+    assert not co.eligible(_request(max_tokens=1))
+    assert not co.eligible(_request(logprobs=1))
+    assert not co.eligible(_request(prompt_logprobs=0))
+    assert not co.eligible(_request(n=2))
+    req = _request()
+    req.lora_name = "adapter"
+    assert not co.eligible(req)
+    req = _request()
+    req.pooling_params = object()
+    assert not co.eligible(req)
+
+
+def test_min_prompt_tokens_threshold():
+    co = _coordinator(min_prompt_tokens=4 * BLOCK)
+    assert not co.eligible(_request(n_prompt=2 * BLOCK))
+    assert co.eligible(_request(n_prompt=4 * BLOCK))
+
+
+# ---------------------------------------------------------------------------
+# Coordinator: full handoff lifecycle
+
+
+def test_happy_path_pushed():
+    co = _coordinator()
+    original = _request()
+    leg = co.begin(original, from_engine=0, to_engine=1,
+                   push_addr="127.0.0.1:9")
+    assert leg.sampling_params.max_tokens == 1
+    assert leg.disagg_push_to == "127.0.0.1:9"
+    assert leg.request_id == original.request_id
+    assert co.num_pending == 1
+    assert co.reserve_blocks_for(original) == 2
+
+    resume = co.note_prefill_finished("r1", [42], "length")
+    assert resume is not None
+    assert resume.prompt_token_ids[-1] == 42
+    assert resume.sampling_params.max_tokens == 7
+    assert co.pending("r1").resumed
+
+    # Decode side reports the whole prompt cached: the push landed.
+    co.note_decode_first_tokens("r1", num_cached_tokens=2 * BLOCK)
+    co.note_finished("r1")
+    assert co.num_pending == 0
+    st = co.status()
+    assert st["outcomes"]["pushed"] == 1
+    assert len(st["durations_s"]) == 1
+
+
+def test_torn_push_counts_recompute():
+    co = _coordinator()
+    co.begin(_request(), 0, 1, "127.0.0.1:9")
+    assert co.note_prefill_finished("r1", [42], "length") is not None
+    # Fewer cached blocks than the prompt: the decode engine recomputed.
+    co.note_decode_first_tokens("r1", num_cached_tokens=BLOCK)
+    co.note_finished("r1")
+    assert co.status()["outcomes"]["recompute"] == 1
+
+
+def test_stop_on_first_token_finishes_locally():
+    co = _coordinator()
+    co.begin(_request(), 0, 1, "127.0.0.1:9")
+    assert co.note_prefill_finished("r1", [2], "stop") is None
+    assert co.num_pending == 0
+    assert co.status()["outcomes"]["local"] == 1
+
+
+def test_error_finish_counts_aborted():
+    co = _coordinator()
+    co.begin(_request(), 0, 1, "127.0.0.1:9")
+    assert co.note_prefill_finished("r1", [], "error") is None
+    assert co.status()["outcomes"]["aborted"] == 1
+
+
+def test_abort_and_engine_death():
+    co = _coordinator()
+    co.begin(_request(), 0, 1, "127.0.0.1:9")
+    co.note_abort("r1")
+    assert co.status()["outcomes"]["aborted"] == 1
+
+    req2 = _request()
+    req2.request_id = "r2"
+    co.begin(req2, 0, 1, "127.0.0.1:9")
+    co.note_engine_death(["r2", "unrelated"])
+    assert co.num_pending == 0
+    assert co.status()["outcomes"]["recompute"] == 1
+
+
+def test_finish_without_classification_is_conservative():
+    # FINAL_ONLY delivery: the first decode output IS the finish; a
+    # resumed-but-unclassified handoff counts recompute, never pushed.
+    co = _coordinator()
+    co.begin(_request(), 0, 1, "127.0.0.1:9")
+    co.note_prefill_finished("r1", [42], "length")
+    co.note_finished("r1")
+    assert co.status()["outcomes"]["recompute"] == 1
+    assert co.num_pending == 0
+
+
+def test_status_drain_swaps_durations():
+    co = _coordinator()
+    co.begin(_request(), 0, 1, "127.0.0.1:9")
+    co.note_abort("r1")
+    assert len(co.status()["durations_s"]) == 1  # peek keeps it
+    assert len(co.status(drain=True)["durations_s"]) == 1
+    assert co.status()["durations_s"] == []      # drained

@@ -1,0 +1,115 @@
+"""Role specs, the role plan's candidate sets, and the router's
+phase rung."""
+
+from __future__ import annotations
+
+from types import SimpleNamespace
+
+import pytest
+
+from vllm_tpu.disagg import RolePlan, parse_engine_roles
+from vllm_tpu.router.policy import phase_rung, request_phase
+
+BLOCK = 16
+
+
+def _req(n_tokens: int):
+    return SimpleNamespace(prompt_token_ids=list(range(n_tokens)))
+
+
+# ---------------------------------------------------------------------------
+# parse_engine_roles
+
+
+def test_parse_defaults_to_unified():
+    assert parse_engine_roles(None, 3) == ["unified"] * 3
+    assert parse_engine_roles("", 2) == ["unified"] * 2
+
+
+def test_parse_aliases_and_case():
+    assert parse_engine_roles("P,d, Unified", 3) == [
+        "prefill", "decode", "unified"]
+
+
+def test_parse_single_entry_broadcasts():
+    assert parse_engine_roles("decode", 4) == ["decode"] * 4
+
+
+def test_parse_unknown_role_raises():
+    with pytest.raises(ValueError, match="unknown engine role"):
+        parse_engine_roles("prefill,verify", 2)
+
+
+def test_parse_length_mismatch_raises():
+    with pytest.raises(ValueError, match="names 2 engines"):
+        parse_engine_roles("prefill,decode", 3)
+
+
+# ---------------------------------------------------------------------------
+# RolePlan
+
+
+def test_plan_candidate_sets():
+    plan = RolePlan.from_spec("prefill,decode,unified,decode", 4)
+    assert plan.prefill_ids == [0]
+    assert plan.decode_ids == [1, 3]
+    assert plan.unified_ids == [2]
+    assert plan.active
+    assert plan.candidates_for_phase("prefill") == [0, 2]
+    assert plan.candidates_for_phase("decode") == [1, 3, 2]
+
+
+def test_plan_without_both_sides_is_inactive():
+    # Role-biased routing only; no dedicated decode capacity to push to.
+    assert not RolePlan.from_spec("prefill,unified", 2).active
+    assert not RolePlan.from_spec("decode,decode", 2).active
+    assert not RolePlan.from_spec(None, 2).active
+    assert RolePlan.from_spec("prefill,decode", 2).active
+
+
+def test_plan_phase_with_no_dedicated_engine_falls_to_unified():
+    plan = RolePlan.from_spec("decode,unified", 2)
+    assert plan.candidates_for_phase("prefill") == [1]
+    assert plan.candidates_for_phase("decode") == [0, 1]
+
+
+# ---------------------------------------------------------------------------
+# request_phase / phase_rung
+
+
+def test_request_phase_by_prompt_length():
+    assert request_phase(_req(4 * BLOCK), BLOCK) == "prefill"
+    assert request_phase(_req(4 * BLOCK - 1), BLOCK) == "decode"
+    assert request_phase(_req(0), BLOCK) == "decode"
+
+
+def test_phase_rung_narrows_to_role_capacity():
+    plan = RolePlan.from_spec("prefill,decode", 2)
+    narrowed, phase = phase_rung(plan, _req(8 * BLOCK), [0, 1], BLOCK)
+    assert (narrowed, phase) == ([0], "prefill")
+    narrowed, phase = phase_rung(plan, _req(BLOCK), [0, 1], BLOCK)
+    assert (narrowed, phase) == ([1], "decode")
+
+
+def test_phase_rung_explicit_phase_overrides_classification():
+    # Resume legs carry phase="decode" even though their prompt is long.
+    plan = RolePlan.from_spec("prefill,decode", 2)
+    narrowed, phase = phase_rung(
+        plan, _req(8 * BLOCK), [0, 1], BLOCK, phase="decode")
+    assert (narrowed, phase) == ([1], "decode")
+
+
+def test_phase_rung_never_strands_on_empty_capacity():
+    # The phase's only engine is down (not in candidates): fall back to
+    # the full candidate set rather than an empty one.
+    plan = RolePlan.from_spec("prefill,decode", 2)
+    narrowed, phase = phase_rung(plan, _req(8 * BLOCK), [1], BLOCK)
+    assert (narrowed, phase) == ([1], None)
+
+
+def test_phase_rung_unified_pool_is_passthrough():
+    plan = RolePlan.from_spec(None, 3)
+    narrowed, phase = phase_rung(plan, _req(8 * BLOCK), [0, 1, 2], BLOCK)
+    assert (narrowed, phase) == ([0, 1, 2], None)
+    narrowed, phase = phase_rung(None, _req(8 * BLOCK), [0, 1], BLOCK)
+    assert (narrowed, phase) == ([0, 1], None)

@@ -1,0 +1,136 @@
+"""Scheduler handoff queue + the engine-core same-step flush hoist.
+
+The handoff push is on the request's critical path (the decode side is
+waiting), so two engine-side properties matter:
+
+- the scheduler queues the FULL confirmed prompt prefix for a
+  handoff-tagged request the moment it finishes (aborts excluded);
+- the engine core drains that queue in the same step, flushing pending
+  cold-tier saves FIRST so every pushed key is host-tier-resident
+  (regression guard for the prompt-finish-under-load flush gap).
+"""
+
+from __future__ import annotations
+
+from tests.core.utils import create_request, create_scheduler, \
+    make_runner_output
+from vllm_tpu.engine.engine_core import EngineCore
+from vllm_tpu.request import RequestStatus
+
+BLOCK = 16
+URL = "127.0.0.1:9009"
+
+
+class _FakeConnector:
+    """request_finished contract: indices NOT host-resident yet."""
+
+    def request_finished(self, block_hashes):
+        return list(range(len(block_hashes)))
+
+    def get_num_new_matched_tokens(self, *a, **kw):
+        return 0
+
+
+def _run_to_finish(sched, req):
+    sched.add_request(req)
+    for _ in range(64):
+        out = sched.schedule()
+        sched.update_from_output(out, make_runner_output(out))
+        if req.request_id not in sched.requests:
+            return
+    raise AssertionError("request never finished")
+
+
+def test_finished_handoff_queues_full_prefix():
+    sched = create_scheduler()
+    sched.kv_connector = _FakeConnector()
+    req = create_request(prompt_len=3 * BLOCK, max_tokens=2)
+    req.disagg_push_to = URL
+    _run_to_finish(sched, req)
+
+    handoffs = sched.take_pending_handoffs()
+    assert len(handoffs) == 1
+    rid, url, keys = handoffs[0]
+    assert rid == req.request_id
+    assert url == URL
+    # Full confirmed prefix: 3 prompt blocks (+ the sampled token's
+    # partial block never completes), not just host-tier misses.
+    assert keys == req.block_hashes[:3]
+    # Drain semantics: a second take returns nothing.
+    assert sched.take_pending_handoffs() == []
+    # The ordinary save queue saw the same finish independently.
+    assert len(sched.take_pending_kv_saves()) >= 3
+
+
+def test_untagged_request_queues_no_handoff():
+    sched = create_scheduler()
+    sched.kv_connector = _FakeConnector()
+    _run_to_finish(sched, create_request(prompt_len=3 * BLOCK, max_tokens=2))
+    assert sched.take_pending_handoffs() == []
+
+
+def test_aborted_handoff_is_not_pushed():
+    sched = create_scheduler()
+    sched.kv_connector = _FakeConnector()
+    req = create_request(prompt_len=3 * BLOCK, max_tokens=8)
+    req.disagg_push_to = URL
+    sched.add_request(req)
+    out = sched.schedule()
+    sched.update_from_output(out, make_runner_output(out))
+    sched.finish_requests([req.request_id],
+                          RequestStatus.FINISHED_ABORTED)
+    assert sched.take_pending_handoffs() == []
+
+
+def test_engine_core_flush_hoists_saves_before_push():
+    """Regression: handoff-tagged finishes must flush the cold-tier
+    saves in the SAME step as the push RPC, and before it — under
+    sustained load the regular save flush only runs at the NEXT step's
+    top, which would push keys that aren't host-resident yet."""
+    calls: list = []
+
+    class _Sched:
+        def take_pending_handoffs(self):
+            return [("r1", URL, [b"k0", b"k1"])]
+
+        def take_pending_kv_saves(self):
+            return [(3, b"k0"), (4, b"k1")]
+
+    class _Exec:
+        def collective_rpc(self, method, *args):
+            calls.append((method,) + args)
+            return [True]
+
+    core = object.__new__(EngineCore)
+    core.kv_connector = object()
+    core.scheduler = _Sched()
+    core.executor = _Exec()
+
+    core._flush_handoff_pushes()
+    assert calls == [
+        ("kv_connector_save", [(3, b"k0"), (4, b"k1")]),
+        ("kv_connector_push", "r1", URL, [b"k0", b"k1"]),
+    ]
+
+
+def test_engine_core_no_handoffs_skips_save_flush_rpc():
+    calls: list = []
+
+    class _Sched:
+        def take_pending_handoffs(self):
+            return []
+
+        def take_pending_kv_saves(self):  # pragma: no cover - not hit
+            raise AssertionError("saves must not be drained off-path")
+
+    class _Exec:
+        def collective_rpc(self, method, *args):
+            calls.append(method)
+            return [True]
+
+    core = object.__new__(EngineCore)
+    core.kv_connector = object()
+    core.scheduler = _Sched()
+    core.executor = _Exec()
+    core._flush_handoff_pushes()
+    assert calls == []

@@ -1,0 +1,195 @@
+"""Handoff push path: the kv_push wire op, int8 cold-tier wire
+encoding round-trip, decode-side reservations, and torn-transfer
+degradation via the kv_fabric.push failpoint."""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from vllm_tpu.kv_fabric import HostTier, KVFabric
+from vllm_tpu.resilience import failpoints
+
+BLOCK_SIZE = 16
+PAYLOAD_SHAPE = (2, BLOCK_SIZE, 2, 8)
+
+
+def _payload(seed: int) -> np.ndarray:
+    rng = np.random.default_rng(seed)
+    return rng.normal(size=PAYLOAD_SHAPE).astype(np.float32)
+
+
+def _hashes(n: int, salt: int = 0) -> list[bytes]:
+    return [bytes([salt]) * 4 + i.to_bytes(4, "big") for i in range(n)]
+
+
+def _pair(quant="int8"):
+    """Prefill engine a pushing into decode engine b's host tier."""
+    b = KVFabric(host_bytes=1 << 22, quant=quant, bind="127.0.0.1:0")
+    a = KVFabric(host_bytes=1 << 22, quant=quant)
+    return a, b
+
+
+@pytest.fixture(autouse=True)
+def _no_failpoints():
+    failpoints.deactivate()
+    yield
+    failpoints.deactivate()
+
+
+def test_push_lands_in_peer_host_tier_int8_roundtrip():
+    a, b = _pair(quant="int8")
+    try:
+        hashes = _hashes(6)
+        payloads = [_payload(i) for i in range(6)]
+        a.save_blocks(hashes, payloads)
+
+        assert a.push_blocks(hashes, b._server.url, req_id="r1")
+        assert a.push_outcomes["pushed"] == 1
+        assert a.push_bytes > 0
+        # int8 wire encoding: pushed bytes are the quantized footprint,
+        # far below the float32 payloads.
+        assert a.push_bytes < sum(p.nbytes for p in payloads) / 3
+        assert b.push_outcomes["received"] == 6
+
+        # The decode side sees the full prefix locally (match is
+        # consecutive-from-start) and the dequantized payloads are
+        # within int8 tolerance of the originals.
+        assert b.host.match([k.hex() for k in hashes]) == 6
+        out = b.load_blocks(hashes)
+        for o, p in zip(out, payloads):
+            assert o.shape == p.shape
+            assert np.max(np.abs(o - p)) < 0.05
+    finally:
+        a.close()
+        b.close()
+
+
+def test_push_skips_evicted_keys_and_pushes_partial_prefix():
+    a, b = _pair()
+    try:
+        hashes = _hashes(3)
+        a.save_blocks(hashes[:2], [_payload(0), _payload(1)])
+        # Key 2 was never saved (evicted between finish and flush):
+        # the push still ships what it has.
+        assert a.push_blocks(hashes, b._server.url, req_id="r1")
+        assert b.push_outcomes["received"] == 2
+        assert b.host.match([k.hex() for k in hashes]) == 2
+    finally:
+        a.close()
+        b.close()
+
+
+def test_push_with_nothing_resident_counts_failed():
+    a, b = _pair()
+    try:
+        assert not a.push_blocks(_hashes(2), b._server.url, req_id="r1")
+        assert a.push_outcomes["failed"] == 1
+    finally:
+        a.close()
+        b.close()
+
+
+def test_push_to_dead_peer_counts_failed_never_raises():
+    b = KVFabric(host_bytes=1 << 20, bind="127.0.0.1:0")
+    url = b._server.url
+    b.close()  # peer is gone
+    a = KVFabric(host_bytes=1 << 20)
+    try:
+        hashes = _hashes(2)
+        a.save_blocks(hashes, [_payload(0), _payload(1)])
+        assert not a.push_blocks(hashes, url, req_id="r1")
+        assert a.push_outcomes["failed"] == 1
+    finally:
+        a.close()
+
+
+def test_torn_chunk_failpoint_yields_partial_transfer():
+    a, b = _pair()
+    try:
+        # 6 blocks = 2 chunks of PUSH_CHUNK_BLOCKS=4; drop the first.
+        failpoints.configure("kv_fabric.push=once*drop", seed=7)
+        hashes = _hashes(6)
+        a.save_blocks(hashes, [_payload(i) for i in range(6)])
+        a.push_blocks(hashes, b._server.url, req_id="r1")
+        # Only the second chunk landed: blocks 4..5 are resident but the
+        # consecutive-prefix match from block 0 is zero — exactly the
+        # signal that classifies the handoff as recompute.
+        assert b.push_outcomes["received"] == 2
+        assert b.host.match([k.hex() for k in hashes]) == 0
+        assert failpoints.snapshot()["kv_fabric.push"]["fires"] == 1
+    finally:
+        a.close()
+        b.close()
+
+
+# ---------------------------------------------------------------------------
+# Reservations
+
+
+def test_host_tier_reservation_counts_against_budget():
+    one = _payload(0).nbytes
+    tier = HostTier(max_bytes=3 * one)
+    tier.put(["k0", "k1"], [_payload(0), _payload(1)])
+    tier.reserve(2 * one)
+    assert tier.bytes_reserved == 2 * one
+    # bytes + reserved > budget: inserting evicts down to fit.
+    tier.put(["k2"], [_payload(2)])
+    assert len(tier) < 3
+    tier.release(2 * one)
+    assert tier.bytes_reserved == 0
+    tier.release(one)  # over-release clamps at zero, never negative
+    assert tier.bytes_reserved == 0
+
+
+def test_reserve_push_settles_on_last_chunk():
+    a, b = _pair()
+    try:
+        hashes = _hashes(2)
+        payloads = [_payload(0), _payload(1)]
+        a.save_blocks(hashes, payloads)
+        # Teach the decode side its per-block size, then reserve.
+        b.save_blocks(_hashes(1, salt=9), [_payload(9)])
+        reserved = b.reserve_push("r1", 2)
+        assert reserved > 0
+        assert b.host.bytes_reserved == reserved
+
+        assert a.push_blocks(hashes, b._server.url, req_id="r1")
+        # The arriving frames settled the reservation.
+        assert b.host.bytes_reserved == 0
+        assert "r1" not in b._push_reservations
+    finally:
+        a.close()
+        b.close()
+
+
+def test_reserve_push_is_idempotent_and_releasable():
+    b = KVFabric(host_bytes=1 << 20)
+    try:
+        b.save_blocks(_hashes(1, salt=9), [_payload(9)])
+        first = b.reserve_push("r1", 4)
+        again = b.reserve_push("r1", 4)  # re-reserve replaces, not adds
+        assert first == again
+        assert b.host.bytes_reserved == again
+        b.release_push("r1")
+        assert b.host.bytes_reserved == 0
+        b.release_push("r1")  # double release is a no-op
+    finally:
+        b.close()
+
+
+def test_fabric_stats_surface_push_and_tier_bytes():
+    a, b = _pair()
+    try:
+        hashes = _hashes(2)
+        a.save_blocks(hashes, [_payload(0), _payload(1)])
+        a.push_blocks(hashes, b._server.url, req_id="r1")
+        sa, sb = a.fabric_stats(), b.fabric_stats()
+        assert sa["push"]["pushed"] == 1
+        assert sa["push_bytes"] > 0
+        assert sb["push"]["received"] == 2
+        assert sb["tier_bytes"]["host"] > 0
+        assert sa["reserved_bytes"] == 0
+    finally:
+        a.close()
+        b.close()

@@ -111,6 +111,15 @@ def build_parser() -> argparse.ArgumentParser:
                         "(kv_connector='fabric'); combine with "
                         "kv_fabric.fetch / kv_fabric.demote failpoints "
                         "to chaos-test fetch/demotion degradation")
+    p.add_argument("--disagg", action="store_true",
+                   help="disaggregated prefill/decode under fire: "
+                        "engine 0 serves prefill, the rest decode "
+                        "(forces dp>=2 + the KV fabric), a kv_fabric."
+                        "push chunk is torn, and every scheduled engine "
+                        "kill retargets the prefill engine mid-handoff; "
+                        "the run passes iff every request still reaches "
+                        "one terminal state and at least one handoff "
+                        "degraded to decode-side recompute")
     p.add_argument("--requests", type=int, default=16)
     p.add_argument("--max-tokens", type=int, default=8)
     p.add_argument("--concurrency", type=int, default=4)
@@ -194,6 +203,33 @@ def _check_mesh(engine, rejoin: bool, settle_s: float = 10.0) -> bool:
     return ok
 
 
+def _check_disagg(engine, report) -> bool:
+    """Assert the disagg schedule exercised the degrade path: handoffs
+    happened, and at least one fell back to decode-side recompute
+    (torn push chunk, or the prefill engine dying mid-handoff)."""
+    status = (engine.disagg_status()
+              if hasattr(engine, "disagg_status") else None)
+    print(f"disagg: {status}", file=sys.stderr)
+    ok = True
+    if not status or not status.get("active"):
+        print("DISAGG: coordinator never activated (roles/fabric "
+              "misconfigured?)", file=sys.stderr)
+        return False
+    outcomes = status.get("outcomes", {})
+    if sum(outcomes.values()) < 1:
+        print("DISAGG: no handoff was ever attempted", file=sys.stderr)
+        ok = False
+    if outcomes.get("recompute", 0) < 1:
+        print(f"DISAGG: no handoff degraded to recompute "
+              f"(outcomes: {outcomes})", file=sys.stderr)
+        ok = False
+    if status.get("pending", 0) != 0:
+        print(f"DISAGG: {status['pending']} handoff(s) leaked past the "
+              f"drain", file=sys.stderr)
+        ok = False
+    return ok
+
+
 def main(argv: list[str] | None = None) -> int:
     args = build_parser().parse_args(argv)
 
@@ -201,6 +237,26 @@ def main(argv: list[str] | None = None) -> int:
     from vllm_tpu.engine.async_llm import AsyncLLM
     from vllm_tpu.resilience import failpoints
     from vllm_tpu.resilience.chaos import make_plan, run_chaos
+
+    prompt_token_ids = None
+    engine_roles = None
+    if args.disagg:
+        args.dp = max(2, args.dp)
+        args.kv_fabric = True
+        engine_roles = ",".join(["prefill"] + ["decode"] * (args.dp - 1))
+        # Long prompts keep every request handoff-eligible (>= 1 full
+        # block) and phase-routed to the prefill engine.
+        prompt_token_ids = [(i % 50) + 1 for i in range(96)]
+        # Tear one push chunk so the first handoff deterministically
+        # lands short on the decode side and degrades to recompute.
+        # Must reach the env before the engine-core procs spawn.
+        tear = "kv_fabric.push=1*drop"
+        prior = os.environ.get(failpoints.ENV_SPEC)
+        os.environ[failpoints.ENV_SPEC] = (
+            f"{prior},{tear}" if prior else tear)
+        os.environ.setdefault(failpoints.ENV_SEED, str(args.seed))
+        print(f"disagg: roles={engine_roles}, armed {tear!r}",
+              file=sys.stderr)
 
     poison_rid = None
     if args.poison_mode != "off":
@@ -265,6 +321,13 @@ def main(argv: list[str] | None = None) -> int:
         host_kills=1 if args.host_death else 0,
         host_rejoin=args.host_rejoin,
     )
+    if args.disagg:
+        # Every scheduled engine kill hits the prefill engine: dying
+        # mid-handoff is the scenario under test (in-flight prefill legs
+        # replay; their handoffs are charged as recompute).
+        for ev in plan.events:
+            if ev.kind == "kill_engine":
+                ev.target = 0
     print(f"chaos plan (seed {plan.seed}):", file=sys.stderr)
     for ev in plan.events:
         print(f"  {ev}", file=sys.stderr)
@@ -295,6 +358,7 @@ def main(argv: list[str] | None = None) -> int:
                          if args.poison_mode == "hang_step" else 0.0),
         numeric_guard=(args.poison_mode == "nan"),
         kv_connector="fabric" if args.kv_fabric else None,
+        engine_roles=engine_roles,
     ))
     try:
         report = asyncio.run(run_chaos(
@@ -303,6 +367,7 @@ def main(argv: list[str] | None = None) -> int:
             max_tokens=args.max_tokens,
             concurrency=args.concurrency,
             request_timeout_s=args.request_timeout,
+            prompt_token_ids=prompt_token_ids,
             poison_request_id=poison_rid,
             host_peers=host_peers,
         ))
@@ -313,6 +378,9 @@ def main(argv: list[str] | None = None) -> int:
         mesh_ok = True
         if args.host_death:
             mesh_ok = _check_mesh(engine, rejoin=args.host_rejoin)
+        disagg_ok = True
+        if args.disagg:
+            disagg_ok = _check_disagg(engine, report)
     finally:
         engine.shutdown()
         if host_peers is not None:
@@ -328,7 +396,8 @@ def main(argv: list[str] | None = None) -> int:
             f"outcomes={summary['outcomes']} wall={report.wall_s:.1f}s")
     for v in report.ledger.violations:
         print(f"VIOLATION: {v}", file=sys.stderr)
-    ok = report.ok and poison_ok and mesh_ok
+    ok = report.ok and poison_ok and mesh_ok and (
+        disagg_ok if args.disagg else True)
     print("ok" if ok else "FAILED", file=sys.stderr)
     return 0 if ok else 1
 

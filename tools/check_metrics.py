@@ -115,9 +115,18 @@ REQUIRED_ADAPTIVE_SPEC_METRICS = {
 # prefix-hit acceptance test and chaos scenarios assert on these names.
 REQUIRED_KV_FABRIC_METRICS = {
     "vllm:kv_fabric_tier_blocks",
+    "vllm:kv_fabric_tier_bytes",
     "vllm:kv_fabric_fetch_total",
     "vllm:kv_fabric_demotions_total",
     "vllm:kv_fabric_fetch_bytes_total",
+}
+
+# Documented in the README ("Disaggregated serving"); the chaos
+# --disagg scenario and the parity acceptance test assert on these.
+REQUIRED_DISAGG_METRICS = {
+    "vllm:disagg_handoffs_total",
+    "vllm:disagg_push_bytes_total",
+    "vllm:disagg_handoff_duration_seconds",
 }
 
 
@@ -209,6 +218,10 @@ def check() -> list[str]:
     for name in sorted(REQUIRED_KV_FABRIC_METRICS - set(seen)):
         errors.append(
             f"required kv-fabric metric {name} is missing from "
+            f"the registry (documented in README)")
+    for name in sorted(REQUIRED_DISAGG_METRICS - set(seen)):
+        errors.append(
+            f"required disagg metric {name} is missing from "
             f"the registry (documented in README)")
 
     return errors

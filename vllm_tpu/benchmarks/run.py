@@ -230,7 +230,20 @@ def _run_sessions(args, params) -> dict:
         return _sessions_pass(engine, args, params, n_sessions, n_turns,
                               vocab)
 
-    if getattr(base_args, "kv_connector", None):
+    if getattr(base_args, "engine_roles", None):
+        # Disaggregated prefill/decode A/B: the SAME workload runs once
+        # with roles stripped (unified pool) and once disaggregated, so
+        # the sessions sub-block compares p99 TTFT/ITL apples-to-apples
+        # within a single invocation.
+        unified = _one_pass(_rep(base_args, engine_roles=None))
+        result = _one_pass(base_args)
+        tail = ("ttft_p50_s", "ttft_p99_s", "itl_p50_s", "itl_p99_s",
+                "output_tokens_per_s", "elapsed_s")
+        result["sessions_disagg_ab"] = {
+            "unified": {k: unified.get(k) for k in tail},
+            "disagg": {k: result.get(k) for k in tail},
+        }
+    elif getattr(base_args, "kv_connector", None):
         baseline = _one_pass(_rep(base_args, kv_connector=None))
         result = _one_pass(base_args)
         result["pre_fabric_baseline"] = {
@@ -267,6 +280,9 @@ def _sessions_pass(engine, args, params, n_sessions: int, n_turns: int,
                     return t.detokenize_s
             return 0.0
 
+        ttfts: list[float] = []
+        itls: list[float] = []
+
         async def one_session(g: int) -> None:
             convo = [(1009 * g + 7 * j) % vocab
                      for j in range(args.input_len)]
@@ -274,8 +290,17 @@ def _sessions_pass(engine, args, params, n_sessions: int, n_turns: int,
                 req_id = f"sess{g}-t{turn}"
                 gen: list[int] = []
                 cached = 0
+                t0 = time.monotonic()
+                last = None
                 async for out in engine.generate(
                         {"prompt_token_ids": list(convo)}, params, req_id):
+                    now = time.monotonic()
+                    if out.outputs[0].token_ids:
+                        if last is None:
+                            ttfts.append(now - t0)
+                        else:
+                            itls.append(now - last)
+                        last = now
                     gen.extend(out.outputs[0].token_ids)
                     cached = max(cached, out.num_cached_tokens)
                 turns.append((turn, len(convo), cached, len(gen)))
@@ -320,6 +345,10 @@ def _sessions_pass(engine, args, params, n_sessions: int, n_turns: int,
             "prefix_hit_rate_followup_turns": (
                 round(fu_cached / fu_prompt, 4) if fu_prompt else None),
             "detok_cpu_share": round(detok_s[0] / wall, 4),
+            "ttft_p50_s": float(np.percentile(ttfts, 50)) if ttfts else None,
+            "ttft_p99_s": float(np.percentile(ttfts, 99)) if ttfts else None,
+            "itl_p50_s": float(np.percentile(itls, 50)) if itls else None,
+            "itl_p99_s": float(np.percentile(itls, 99)) if itls else None,
         }
         routing = engine.routing_status()
         if routing is not None:
@@ -330,9 +359,18 @@ def _sessions_pass(engine, args, params, n_sessions: int, n_turns: int,
             result["kv_fabric"] = {
                 "tier_hits": fab.get("tier_hits"),
                 "tier_blocks": fab.get("tier_blocks"),
+                "tier_bytes": fab.get("tier_bytes"),
                 "fetch": fab.get("fetch"),
                 "fetch_bytes": fab.get("fetch_bytes"),
+                "push_bytes": fab.get("push_bytes"),
                 "demotions": fab.get("demotions"),
+            }
+        dis = getattr(engine, "disagg_status", None)
+        dis = dis() if dis is not None else None
+        if dis and dis.get("active"):
+            result["disagg"] = {
+                "roles": dis.get("roles"),
+                "outcomes": dis.get("outcomes"),
             }
         return result
     finally:

@@ -180,6 +180,17 @@ class ParallelConfig:
     # Distinct from ``data_parallel_size``, which is the in-mesh GSPMD
     # batch-sharding axis within ONE engine.
     data_parallel_engines: int = 1
+    # Disaggregated prefill/decode: comma-separated per-engine roles
+    # ("prefill"/"decode"/"unified" or P/D/U), one entry per DP engine
+    # (a single entry broadcasts). None = all unified = today's
+    # behavior. With at least one prefill AND one decode engine, the
+    # DP client hands eligible requests off: prompt runs on prefill
+    # capacity, KV streams to a decode peer over the fabric, decoding
+    # resumes there (see vllm_tpu/disagg/).
+    engine_roles: str | None = None
+    # Prompts shorter than this many tokens skip the handoff (the
+    # transfer isn't worth it); they still route via the phase rung.
+    disagg_min_prompt_tokens: int = 0
     # MoE wave lockstep: idle DP engines run dummy batches while any rank
     # has work, so expert groups spanning DP ranks keep their collectives
     # alive (reference ``DPEngineCoreProc.run_busy_loop``).
@@ -583,6 +594,17 @@ class EngineConfig:
                 "--num-speculative-tokens)"
             )
         sc.validate_decode_steps(spec_enabled=spec.enabled)
+        pc = self.parallel_config
+        if pc.engine_roles:
+            from vllm_tpu.disagg.roles import parse_engine_roles
+
+            roles = parse_engine_roles(pc.engine_roles,
+                                       pc.data_parallel_engines)
+            if (any(r != "unified" for r in roles)
+                    and self.cache_config.kv_connector != "fabric"):
+                raise ValueError(
+                    "--engine-roles needs the KV fabric for the prefill->"
+                    "decode handoff; set --kv-connector fabric")
         return self
 
     def compute_hash(self) -> str:

@@ -139,6 +139,12 @@ class Scheduler:
         self.kv_connector = kv_connector
         # (block_ids, keys) save records awaiting shipment to the runner.
         self._pending_kv_saves: list[tuple] = []
+        # Disaggregated handoffs: (req_id, peer_url, keys) for finished
+        # requests whose prompt-prefix KV must be pushed to a decode
+        # engine. Drained by the engine core in the SAME step the
+        # request finishes (take_pending_handoffs) — handoff latency is
+        # on the request's critical path, unlike ordinary cold saves.
+        self._pending_handoff_pushes: list[tuple] = []
 
         from vllm_tpu.core.encoder_cache_manager import EncoderCacheManager
 
@@ -280,6 +286,11 @@ class Scheduler:
         self._pending_kv_saves = []
         return out
 
+    def take_pending_handoffs(self) -> list[tuple]:
+        out = self._pending_handoff_pushes
+        self._pending_handoff_pushes = []
+        return out
+
     def _free_request(self, request: Request) -> None:
         self._dynamic_inflight.discard(request.request_id)
         if self.adaptive_spec is not None:
@@ -310,6 +321,20 @@ class Scheduler:
             ]
             if save:
                 self._pending_kv_saves.extend(save)
+            if (request.disagg_push_to
+                    and request.status != RequestStatus.FINISHED_ABORTED):
+                # Handoff: push the FULL confirmed prefix to the decode
+                # peer (not just host-tier misses — the peer has none of
+                # it). The engine core flushes saves first, so every key
+                # here is host-tier-resident by push time.
+                n = min(len(block_ids), confirmed_blocks)
+                keys = [
+                    request.block_hashes[i]
+                    for i in range(n) if block_ids[i] != 0
+                ]
+                if keys:
+                    self._pending_handoff_pushes.append(
+                        (request.request_id, request.disagg_push_to, keys))
         self.kv_cache_manager.free(request)
         self.finished_req_ids.add(request.request_id)
         del self.requests[request.request_id]
@@ -1056,6 +1081,11 @@ class Scheduler:
                 )
                 request.skip_external_kv = True
                 request.dropping_invalid = True
+                # The scheduling-time cache-hit account included the
+                # blocks whose load just failed; re-account on the
+                # reschedule so telemetry (and the disagg handoff
+                # classifier) see what was actually served from cache.
+                request.num_cached_tokens = -1
                 # Belt-and-braces: registration of the external span was
                 # deferred, but evict anything this request did register.
                 self.kv_cache_manager.invalidate_cached_blocks(request)

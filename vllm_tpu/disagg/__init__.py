@@ -1,0 +1,30 @@
+"""Disaggregated prefill/decode serving over the tiered KV fabric.
+
+Role-aware engine pools: the launcher designates engines prefill-heavy
+or decode-heavy (``--engine-roles``), prefill engines run the prompt
+and stream the finished KV to a decode engine over the fabric peer
+channel (``kv_push``), and a client-side handoff protocol migrates the
+request so decode resumes on decode capacity. See
+:mod:`vllm_tpu.disagg.coordinator` for the protocol walkthrough.
+"""
+
+from vllm_tpu.disagg.coordinator import DisaggCoordinator
+from vllm_tpu.disagg.handoff import HandoffRecord, make_resume_request
+from vllm_tpu.disagg.roles import (
+    ROLE_DECODE,
+    ROLE_PREFILL,
+    ROLE_UNIFIED,
+    RolePlan,
+    parse_engine_roles,
+)
+
+__all__ = [
+    "DisaggCoordinator",
+    "HandoffRecord",
+    "make_resume_request",
+    "parse_engine_roles",
+    "RolePlan",
+    "ROLE_PREFILL",
+    "ROLE_DECODE",
+    "ROLE_UNIFIED",
+]

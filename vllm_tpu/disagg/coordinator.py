@@ -1,0 +1,261 @@
+"""Client-side handoff protocol for disaggregated prefill/decode.
+
+The coordinator lives in the DP load-balancing client (one per pool)
+and migrates each eligible request across two engines:
+
+1. **Admit (prefill leg).** ``begin()`` clones the request with its
+   token budget clamped to 1 and tags it with the decode peer's fabric
+   address (``disagg_push_to``). The router's phase rung lands it on a
+   prefill engine. The decode side's KV reservation is made *before*
+   the clamped leg is sent, so a burst can't strand half-shipped
+   prefixes.
+2. **Prefill finishes.** The clamped leg emits the sampled first token
+   and finishes with reason ``"length"``; engine-side, the scheduler
+   queues the prompt-prefix KV for a ``kv_push`` to the decode peer and
+   the engine core flushes it in the same step. Client-side,
+   ``note_prefill_finished()`` journals a :class:`HandoffRecord`; the
+   first token still streams to the user, but the finish is swallowed
+   and a resume request (prompt + token1, budget - 1, same request id)
+   is re-routed to the decode engine. If the first token already ended
+   the request (EOS / stop / budget was 1), the finish passes through —
+   outcome ``"local"``.
+3. **Decode resumes.** The decode engine's prefix cache sees the pushed
+   blocks as local host-tier hits (same content-addressed hashes). Its
+   first output tells us whether the transfer landed:
+   ``num_cached_tokens`` covering the prompt ⇒ outcome ``"pushed"``,
+   else the engine recomputed (torn transfer degraded via the existing
+   invalid-load recovery) ⇒ ``"recompute"``. Either way the request
+   finishes; a handoff can degrade but never lose tokens.
+
+The whole protocol is a pure state machine here — the client does the
+I/O. ``status(drain=True)`` feeds the Prometheus adapter the same way
+``RoutingStats`` does.
+"""
+
+from __future__ import annotations
+
+import copy
+import time
+from dataclasses import dataclass
+
+from vllm_tpu.disagg.handoff import HandoffRecord, make_resume_request
+from vllm_tpu.disagg.roles import RolePlan
+from vllm_tpu.request import EngineCoreRequest
+
+# Terminal outcomes for vllm:disagg_handoffs_total{outcome=...}.
+OUTCOME_PUSHED = "pushed"        # decode leg resumed on transferred KV
+OUTCOME_RECOMPUTE = "recompute"  # decode leg recomputed the prompt
+OUTCOME_LOCAL = "local"          # finished on the prefill leg (EOS/stop)
+OUTCOME_ABORTED = "aborted"      # client abort / engine death mid-handoff
+
+
+@dataclass
+class PendingHandoff:
+    record: HandoffRecord
+    original: EngineCoreRequest
+    # True once the resume request has been sent to the decode engine.
+    resumed: bool = False
+
+
+class DisaggCoordinator:
+
+    def __init__(
+        self,
+        plan: RolePlan,
+        *,
+        min_prompt_tokens: int = 0,
+        block_size: int = 16,
+    ) -> None:
+        self.plan = plan
+        self.min_prompt_tokens = min_prompt_tokens
+        self.block_size = block_size
+        self._pending: dict[str, PendingHandoff] = {}
+        self._outcomes = {
+            OUTCOME_PUSHED: 0,
+            OUTCOME_RECOMPUTE: 0,
+            OUTCOME_LOCAL: 0,
+            OUTCOME_ABORTED: 0,
+        }
+        self._durations_s: list[float] = []
+
+    # ------------------------------------------------------------------
+    # Admission
+
+    def eligible(self, request: EngineCoreRequest) -> bool:
+        """Requests the two-leg protocol can migrate losslessly.
+
+        Structured output is excluded because the decode engine would
+        absorb the first token as prompt without advancing the FSM;
+        pooling/multimodal/LoRA and logprobs are excluded because their
+        state doesn't survive the re-add; a budget of 1 has no decode
+        leg; and short prompts aren't worth the transfer (the phase
+        rung still routes them to decode/unified capacity).
+        """
+        params = request.sampling_params
+        if params is None or request.pooling_params is not None:
+            return False
+        if request.mm_inputs or request.lora_name is not None:
+            return False
+        if getattr(params, "structured_outputs", None) is not None:
+            return False
+        if params.logprobs is not None or params.prompt_logprobs is not None:
+            return False
+        if getattr(params, "n", 1) != 1:
+            return False
+        if params.max_tokens is None or params.max_tokens < 2:
+            return False
+        if len(request.prompt_token_ids) < self.min_prompt_tokens:
+            return False
+        # A prompt shorter than one block pushes nothing (only full
+        # blocks are content-addressed) — let it decode where it lands.
+        if len(request.prompt_token_ids) < self.block_size:
+            return False
+        return True
+
+    def begin(
+        self,
+        request: EngineCoreRequest,
+        from_engine: int,
+        to_engine: int,
+        push_addr: str,
+    ) -> EngineCoreRequest:
+        """Journal the handoff and return the clamped prefill leg."""
+        params = copy.deepcopy(request.sampling_params)
+        params.max_tokens = 1
+        if getattr(params, "min_tokens", 0):
+            params.min_tokens = min(params.min_tokens, 1)
+        leg = EngineCoreRequest(
+            request_id=request.request_id,
+            prompt_token_ids=request.prompt_token_ids,
+            sampling_params=params,
+            arrival_time=request.arrival_time,
+            eos_token_id=request.eos_token_id,
+            priority=request.priority,
+            lora_name=request.lora_name,
+            mm_inputs=request.mm_inputs,
+            pooling_params=request.pooling_params,
+            trace_id=request.trace_id,
+            client_index=request.client_index,
+        )
+        prompt_text = getattr(request, "prompt_text", None)
+        if prompt_text is not None:
+            leg.prompt_text = prompt_text
+        leg.disagg_push_to = push_addr
+        record = HandoffRecord(
+            request_id=request.request_id,
+            prompt_token_ids=list(request.prompt_token_ids),
+            emitted_token_ids=[],
+            from_engine=from_engine,
+            to_engine=to_engine,
+            t_start=time.monotonic(),
+        )
+        self._pending[request.request_id] = PendingHandoff(record, request)
+        return leg
+
+    def pending(self, request_id: str) -> PendingHandoff | None:
+        return self._pending.get(request_id)
+
+    @property
+    def num_pending(self) -> int:
+        return len(self._pending)
+
+    def reserve_blocks_for(self, request: EngineCoreRequest) -> int:
+        """KV blocks the decode side must hold for the pushed prefix."""
+        return len(request.prompt_token_ids) // self.block_size
+
+    # ------------------------------------------------------------------
+    # Prefill leg completion
+
+    def note_prefill_finished(
+        self,
+        request_id: str,
+        new_token_ids: list[int],
+        finish_reason: str | None,
+    ) -> EngineCoreRequest | None:
+        """Returns the resume request to send to the decode engine, or
+        ``None`` if the finish should pass through to the user (the
+        request genuinely ended on the prefill leg, or the finish was
+        an error — the client's normal replay path owns errors)."""
+        ph = self._pending.get(request_id)
+        if ph is None or ph.resumed:
+            return None
+        ph.record.emitted_token_ids.extend(new_token_ids)
+        if finish_reason != "length" or not ph.record.emitted_token_ids:
+            # EOS/stop on the very first token, or an engine error:
+            # nothing left to hand off.
+            self._finish(request_id, OUTCOME_LOCAL if finish_reason
+                         in ("stop", "length") else OUTCOME_ABORTED)
+            return None
+        ph.record.stage = "decode"
+        ph.resumed = True
+        return make_resume_request(ph.record, ph.original)
+
+    # ------------------------------------------------------------------
+    # Decode leg
+
+    def note_decode_first_tokens(
+        self, request_id: str, num_cached_tokens: int
+    ) -> None:
+        """Classify the transfer once the decode leg starts producing.
+
+        The resume prompt is original prompt + emitted tokens; if the
+        engine reports at least the original prompt's full blocks as
+        cached, the pushed KV landed. Anything less means the decode
+        engine recomputed (possibly after an invalid-load preemption).
+        """
+        ph = self._pending.get(request_id)
+        if ph is None or not ph.resumed or ph.record.stage == "done":
+            return
+        prompt_blocks = len(ph.record.prompt_token_ids) // self.block_size
+        cached_blocks = num_cached_tokens // self.block_size
+        outcome = (OUTCOME_PUSHED if prompt_blocks > 0
+                   and cached_blocks >= prompt_blocks else OUTCOME_RECOMPUTE)
+        ph.record.stage = "done"
+        self._outcomes[outcome] += 1
+        self._durations_s.append(time.monotonic() - ph.record.t_start)
+
+    def note_finished(self, request_id: str) -> None:
+        ph = self._pending.get(request_id)
+        if ph is None:
+            return
+        if ph.record.stage != "done":
+            # Finished without us seeing a classifiable first decode
+            # output (e.g. FINAL_ONLY delivery) — count it conservatively.
+            self._finish(request_id, OUTCOME_RECOMPUTE if ph.resumed
+                         else OUTCOME_LOCAL)
+        else:
+            del self._pending[request_id]
+
+    def note_abort(self, request_id: str) -> None:
+        if request_id in self._pending:
+            self._finish(request_id, OUTCOME_ABORTED)
+
+    def note_engine_death(self, request_ids: list[str]) -> None:
+        """A handoff leg died with its engine. The client's normal
+        journal replay will resubmit the request under the same id; we
+        just record that this handoff degraded to recompute and get out
+        of the way so the replayed request runs the plain path."""
+        for rid in request_ids:
+            if rid in self._pending:
+                self._finish(rid, OUTCOME_RECOMPUTE)
+
+    def _finish(self, request_id: str, outcome: str) -> None:
+        ph = self._pending.pop(request_id)
+        self._outcomes[outcome] += 1
+        self._durations_s.append(time.monotonic() - ph.record.t_start)
+
+    # ------------------------------------------------------------------
+    # Introspection
+
+    def status(self, drain: bool = False) -> dict:
+        snap = {
+            "active": self.plan.active,
+            "roles": list(self.plan.roles),
+            "pending": len(self._pending),
+            "outcomes": dict(self._outcomes),
+        }
+        if drain:
+            snap["durations_s"], self._durations_s = self._durations_s, []
+        else:
+            snap["durations_s"] = list(self._durations_s)
+        return snap

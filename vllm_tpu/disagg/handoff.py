@@ -1,0 +1,104 @@
+"""Handoff records: the migration unit of disaggregated serving.
+
+When a prefill engine finishes a request's prompt pass (its sampled
+first token arrives with finish_reason="length" on the clamped leg),
+the client journals a :class:`HandoffRecord` — everything needed to
+resume the request on a decode engine, or to recompute it from scratch
+if the KV transfer tore. The record is JSON on the wire/disk (same
+durability trade as the crash journal: small, human-inspectable,
+versioned), and :func:`make_resume_request` turns it back into an
+``EngineCoreRequest`` using the exact resume idiom of
+``resilience/journal.py`` — prompt extended with the emitted tokens,
+token budget decremented — so the decode engine's detokenizer/stream
+state keys stay valid under the original request id.
+"""
+
+from __future__ import annotations
+
+import copy
+import json
+import time
+from dataclasses import dataclass, field
+
+from vllm_tpu.request import EngineCoreRequest
+
+_WIRE_VERSION = 1
+
+
+@dataclass
+class HandoffRecord:
+    request_id: str
+    prompt_token_ids: list[int]
+    # Tokens sampled on the prefill engine (the clamped leg emits one).
+    emitted_token_ids: list[int]
+    from_engine: int
+    to_engine: int
+    # Hex manifest of the prompt KV blocks pushed to the decode peer;
+    # empty when the push was skipped (no fabric / failpoint).
+    block_hashes: list[str] = field(default_factory=list)
+    t_start: float = field(default_factory=time.monotonic)
+    # "prefill" while the clamped leg runs, "decode" once resumed.
+    stage: str = "prefill"
+
+    @property
+    def num_blocks(self) -> int:
+        return len(self.block_hashes)
+
+    def encode(self) -> bytes:
+        return json.dumps({
+            "v": _WIRE_VERSION,
+            "request_id": self.request_id,
+            "prompt_token_ids": self.prompt_token_ids,
+            "emitted_token_ids": self.emitted_token_ids,
+            "from_engine": self.from_engine,
+            "to_engine": self.to_engine,
+            "block_hashes": self.block_hashes,
+            "t_start": self.t_start,
+            "stage": self.stage,
+        }).encode()
+
+    @classmethod
+    def decode(cls, data: bytes) -> "HandoffRecord":
+        obj = json.loads(data.decode())
+        v = obj.pop("v", None)
+        if v != _WIRE_VERSION:
+            raise ValueError(f"unknown HandoffRecord wire version {v!r}")
+        return cls(**obj)
+
+
+def make_resume_request(
+    record: HandoffRecord, original: EngineCoreRequest
+) -> EngineCoreRequest:
+    """Decode-side continuation of a handed-off request.
+
+    Same request id (frontend stream/detokenizer state keys on it);
+    prompt = original prompt + the prefill leg's emitted tokens, so the
+    decode engine's block hashes line up with the pushed KV manifest;
+    max/min_tokens decremented by the emitted count (caller guarantees
+    the original budget exceeded the clamped leg's).
+    """
+    params = copy.deepcopy(original.sampling_params)
+    done = len(record.emitted_token_ids)
+    assert params.max_tokens is not None and params.max_tokens - done >= 1, (
+        "handoff requires remaining output budget; finish locally instead")
+    params.max_tokens = params.max_tokens - done
+    if getattr(params, "min_tokens", 0):
+        params.min_tokens = max(0, params.min_tokens - done)
+    req = EngineCoreRequest(
+        request_id=record.request_id,
+        prompt_token_ids=list(record.prompt_token_ids)
+        + list(record.emitted_token_ids),
+        sampling_params=params,
+        arrival_time=original.arrival_time,
+        eos_token_id=original.eos_token_id,
+        priority=original.priority,
+        lora_name=original.lora_name,
+        mm_inputs=original.mm_inputs,
+        pooling_params=original.pooling_params,
+        trace_id=original.trace_id,
+        client_index=original.client_index,
+    )
+    prompt_text = getattr(original, "prompt_text", None)
+    if prompt_text is not None:
+        req.prompt_text = prompt_text
+    return req

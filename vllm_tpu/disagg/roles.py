@@ -1,0 +1,95 @@
+"""Engine-role specs for disaggregated prefill/decode pools.
+
+The launcher assigns each DP engine a role with ``--engine-roles``:
+a comma-separated list, one entry per engine, each ``prefill`` /
+``decode`` / ``unified`` (or the single letters ``P`` / ``D`` / ``U``).
+``"prefill,decode"`` on a dp=2 pool is the canonical disaggregated
+topology; omitting the flag (or an all-``unified`` spec) preserves
+today's behavior exactly.
+
+Disaggregation is *active* only when the spec names at least one
+prefill AND at least one decode engine — a spec like ``"prefill,
+unified"`` degenerates to role-biased routing with no handoff, because
+there is no dedicated decode capacity to hand off to.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+ROLE_PREFILL = "prefill"
+ROLE_DECODE = "decode"
+ROLE_UNIFIED = "unified"
+
+_ALIASES = {
+    "p": ROLE_PREFILL,
+    "prefill": ROLE_PREFILL,
+    "d": ROLE_DECODE,
+    "decode": ROLE_DECODE,
+    "u": ROLE_UNIFIED,
+    "unified": ROLE_UNIFIED,
+}
+
+
+def parse_engine_roles(spec: str | None, num_engines: int) -> list[str]:
+    """Expand an ``--engine-roles`` spec into one role per engine.
+
+    ``None``/empty means every engine is unified. A single role entry
+    broadcasts to the whole pool; otherwise the list length must match
+    ``num_engines``. Raises ``ValueError`` on unknown roles or a length
+    mismatch — config.finalize surfaces this at launch, not mid-serve.
+    """
+    if not spec:
+        return [ROLE_UNIFIED] * num_engines
+    raw = [part.strip().lower() for part in spec.split(",")]
+    roles = []
+    for part in raw:
+        role = _ALIASES.get(part)
+        if role is None:
+            raise ValueError(
+                f"unknown engine role {part!r} in --engine-roles "
+                f"(expected prefill/decode/unified or P/D/U)")
+        roles.append(role)
+    if len(roles) == 1:
+        roles = roles * num_engines
+    if len(roles) != num_engines:
+        raise ValueError(
+            f"--engine-roles names {len(roles)} engines but the pool has "
+            f"{num_engines} (data_parallel_engines)")
+    return roles
+
+
+@dataclass
+class RolePlan:
+    """Parsed role assignment plus the derived candidate sets."""
+
+    roles: list[str]
+    prefill_ids: list[int] = field(init=False)
+    decode_ids: list[int] = field(init=False)
+    unified_ids: list[int] = field(init=False)
+
+    def __post_init__(self) -> None:
+        self.prefill_ids = [
+            i for i, r in enumerate(self.roles) if r == ROLE_PREFILL]
+        self.decode_ids = [
+            i for i, r in enumerate(self.roles) if r == ROLE_DECODE]
+        self.unified_ids = [
+            i for i, r in enumerate(self.roles) if r == ROLE_UNIFIED]
+
+    @classmethod
+    def from_spec(cls, spec: str | None, num_engines: int) -> "RolePlan":
+        return cls(parse_engine_roles(spec, num_engines))
+
+    @property
+    def active(self) -> bool:
+        """Handoff requires dedicated capacity on both sides."""
+        return bool(self.prefill_ids) and bool(self.decode_ids)
+
+    def candidates_for_phase(self, phase: str) -> list[int]:
+        """Engines that should serve ``phase`` ("prefill" | "decode").
+        Unified engines serve both phases; a phase with no dedicated
+        engine falls back to the unified set (and the router falls back
+        further to the full pool if that is empty too)."""
+        dedicated = (
+            self.prefill_ids if phase == ROLE_PREFILL else self.decode_ids)
+        return dedicated + self.unified_ids

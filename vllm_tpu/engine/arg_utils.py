@@ -74,6 +74,10 @@ class EngineArgs:
     enable_expert_parallel: bool = False
     distributed_executor_backend: str = "uniproc"
     data_parallel_engines: int = 1
+    # Disaggregated prefill/decode: per-engine roles ("prefill,decode",
+    # P/D/U aliases). Needs --kv-connector fabric; see vllm_tpu/disagg/.
+    engine_roles: str | None = None
+    disagg_min_prompt_tokens: int = 0
     # Frontend scale-out: N API-server processes sharing the listen
     # socket (SO_REUSEPORT) in front of one shared engine pool.
     api_server_count: int = 1
@@ -191,6 +195,8 @@ class EngineArgs:
                 enable_expert_parallel=self.enable_expert_parallel,
                 distributed_executor_backend=self.distributed_executor_backend,  # type: ignore[arg-type]
                 data_parallel_engines=self.data_parallel_engines,
+                engine_roles=self.engine_roles,
+                disagg_min_prompt_tokens=self.disagg_min_prompt_tokens,
                 api_server_count=self.api_server_count,
                 data_parallel_lockstep=self.data_parallel_lockstep,
                 pipeline_microbatches=self.pipeline_microbatches,

@@ -788,6 +788,16 @@ class AsyncLLM:
             return client.kv_fabric_status()
         return {}
 
+    def disagg_status(self, drain: bool = False) -> dict | None:
+        """Disaggregated prefill/decode handoff snapshot (roles, pending
+        handoffs, outcome counters, drained durations), or None when the
+        pool has no engine roles. Feeds /metrics (drain=True takes
+        ownership of pending handoff durations) and /health."""
+        client = self.engine_core
+        if hasattr(client, "disagg_status"):
+            return client.disagg_status(drain=drain)
+        return None
+
     def debug_deadletter(self) -> dict:
         """Dead-letter introspection (/debug/deadletter): quarantined
         poison requests with their strike history."""

@@ -916,6 +916,10 @@ class DPLBClient(_ZMQClientBase):
         for eid in range(n):
             engine_config = copy.deepcopy(config)
             engine_config.parallel_config.data_parallel_engines = 1
+            # Roles are a pool-level concept the client routes on; each
+            # engine proc is a dp=1 pool and would fail the roles/pool
+            # size validation in finalize().
+            engine_config.parallel_config.engine_roles = None
             if fabric_binds is not None:
                 engine_config.cache_config.kv_fabric_bind = (
                     fabric_binds[eid])
@@ -999,6 +1003,35 @@ class DPLBClient(_ZMQClientBase):
             )
             self._routing_stats = RoutingStats()
 
+        # Disaggregated prefill/decode (vllm_tpu/disagg): parse the role
+        # plan; build the handoff coordinator only when the topology can
+        # actually hand off — dedicated capacity on both sides AND
+        # auto-assigned fabric peer addresses to push KV over. Roles
+        # without a coordinator still bias routing (the phase rung).
+        self._role_plan = None
+        self._disagg = None
+        self._disagg_peer_addr: dict[int, str] = {}
+        self._block_size = config.cache_config.block_size
+        if pc.engine_roles:
+            from vllm_tpu import envs
+            from vllm_tpu.disagg import DisaggCoordinator, RolePlan
+
+            self._role_plan = RolePlan.from_spec(pc.engine_roles, n)
+            if (self._role_plan.active and fabric_binds is not None
+                    and not envs.VLLM_TPU_DISABLE_DISAGG):
+                self._disagg = DisaggCoordinator(
+                    self._role_plan,
+                    min_prompt_tokens=pc.disagg_min_prompt_tokens,
+                    block_size=self._block_size,
+                )
+                self._disagg_peer_addr = dict(enumerate(fabric_binds))
+            if self._routing_stats is None:
+                # Phase-rung decisions must be countable even without
+                # prefix-aware routing (no --kv-events-endpoint).
+                from vllm_tpu.router.policy import RoutingStats
+
+                self._routing_stats = RoutingStats()
+
         self._dead = False
         self._live: dict[str, int] = {}  # req_id -> engine_id
         # Exact per-engine in-flight (adds minus finishes seen here) —
@@ -1079,6 +1112,12 @@ class DPLBClient(_ZMQClientBase):
         for rid in lost:
             del self._live[rid]
         self._engine_inflight[eid] = 0
+        if getattr(self, "_disagg", None) is not None:
+            # Handoff legs died with the engine: count them recomputed
+            # and clear the records — the frontend's journal replay
+            # resubmits each request under the same id on a clean slate
+            # (prompt + tokens already streamed, budget decremented).
+            self._disagg.note_engine_death(lost)
         if getattr(self, "_prefix_index", None) is not None:
             # The replacement boots with an empty prefix cache; waiting
             # for its seq-gap resync would mis-route in the meantime.
@@ -1275,6 +1314,30 @@ class DPLBClient(_ZMQClientBase):
                 "stale" if stale else "fresh again",
                 "round-robin" if stale else "least-loaded",
             )
+        # Disaggregated handoff: an eligible new request becomes a
+        # max_tokens=1 prefill leg tagged with a decode peer's fabric
+        # address; the finish interception in get_output migrates it.
+        # A resume leg (pending handoff, resumed) routes as decode.
+        phase_hint = None
+        disagg = getattr(self, "_disagg", None)
+        if disagg is not None:
+            ph = disagg.pending(req.request_id)
+            if ph is not None and ph.resumed:
+                phase_hint = "decode"
+            elif ph is None and disagg.eligible(req):
+                req, phase_hint = self._disagg_begin(req)
+        # Rung 0 (role-aware pools): narrow to the engines serving this
+        # request's phase; long prompts land on prefill capacity, so
+        # decode engines keep their batches dense.
+        if getattr(self, "_role_plan", None) is not None:
+            from vllm_tpu.router.policy import phase_rung
+
+            candidates, pk = phase_rung(
+                self._role_plan, req, candidates, self._block_size,
+                phase=phase_hint,
+            )
+            if pk is not None and self._routing_stats is not None:
+                self._routing_stats.note_phase(pk)
         # Routing ladder: prefix hit > least-loaded > round-robin. The
         # prefix index is fed DIRECTLY by engine kv_events (not via the
         # coordinator), so prefix placement stays valid even when the
@@ -1307,6 +1370,10 @@ class DPLBClient(_ZMQClientBase):
             )
         self._live[req.request_id] = eid
         self._engine_inflight[eid] += 1
+        if disagg is not None:
+            ph = disagg.pending(req.request_id)
+            if ph is not None and not ph.resumed:
+                ph.record.from_engine = eid
         trace_instant(
             "request_send", req_id=req.request_id, trace_id=req.trace_id,
             engine_id=eid,
@@ -1318,9 +1385,161 @@ class DPLBClient(_ZMQClientBase):
                 [self._proc_mod.MSG_ADD, self._serial.encode(req)]
             )
 
+    # -- disaggregated prefill/decode handoff --------------------------
+
+    def _disagg_begin(self, req: EngineCoreRequest):
+        """Prepare the prefill leg of a handoff: pick the decode target
+        (least-loaded dedicated decode engine), reserve its host-tier
+        budget, clamp the request to one token. Any obstacle — armed
+        ``disagg.handoff`` failpoint, no decode capacity up, no peer
+        address — leaves the request unmodified; it serves unified."""
+        if fail_point("disagg.handoff",
+                      lambda: f"req={req.request_id}") == "drop":
+            return req, None
+        disagg = self._disagg
+        decode_up = [
+            i for i in disagg.plan.decode_ids if self._engine_up[i]
+        ]
+        if not decode_up:
+            return req, None
+        to_engine = min(
+            decode_up, key=lambda i: self._engine_inflight[i])
+        push_addr = self._disagg_peer_addr.get(to_engine)
+        if push_addr is None:
+            return req, None
+        # No point migrating a request onto the engine that prefilled
+        # it: if the only up prefill-phase capacity IS the decode
+        # target (the prefill side died), serve unified instead.
+        prefill_up = [
+            i for i in disagg.plan.candidates_for_phase("prefill")
+            if self._engine_up[i] and i != to_engine
+        ]
+        if not prefill_up:
+            return req, None
+        leg = disagg.begin(
+            req, from_engine=-1, to_engine=to_engine,
+            push_addr=push_addr)
+        # Reserve decode-side KV budget BEFORE the prefill leg is sent,
+        # so a demotion burst on the decode engine can't strand the
+        # half-shipped prefix. Best-effort: a failed reservation only
+        # weakens eviction protection, never the handoff.
+        try:
+            self._utility_on(
+                to_engine, "disagg_reserve", req.request_id,
+                disagg.reserve_blocks_for(req), timeout_ms=10_000)
+        except Exception as exc:
+            logger.debug(
+                "disagg reserve on engine %d failed (%s); pushing "
+                "unreserved", to_engine, exc)
+        return leg, "prefill"
+
+    def _disagg_process(
+        self, outputs: EngineCoreOutputs
+    ) -> EngineCoreOutputs:
+        """Migrate handoffs at the output seam: a clamped prefill leg's
+        "length" finish is swallowed (its first token still streams) and
+        the request re-adds on the decode target; the decode leg's first
+        output classifies whether the pushed KV landed."""
+        disagg = self._disagg
+        resumes = []
+        for o in outputs.outputs:
+            ph = disagg.pending(o.req_id)
+            if ph is None:
+                continue
+            if not ph.resumed:
+                if o.finish_reason is None:
+                    # Multi-step engines can stream the token before the
+                    # finish frame; bank it for the resume prompt.
+                    ph.record.emitted_token_ids.extend(o.new_token_ids)
+                    continue
+                resume = disagg.note_prefill_finished(
+                    o.req_id, list(o.new_token_ids), o.finish_reason)
+                if resume is not None:
+                    # One stream, two engines: the frontend must not see
+                    # this leg boundary.
+                    o.finish_reason = None
+                    o.stop_reason = None
+                    resumes.append(resume)
+            else:
+                if o.new_token_ids or o.finish_reason is not None:
+                    disagg.note_decode_first_tokens(
+                        o.req_id, o.num_cached_tokens)
+                if o.finish_reason is not None:
+                    disagg.note_finished(o.req_id)
+        for r in resumes:
+            self._disagg_resume(r)
+        return outputs
+
+    def _disagg_resume(self, req: EngineCoreRequest) -> None:
+        """Send the decode leg straight to the engine the KV was pushed
+        to (its host tier holds the prefix; the ladder would have to
+        rediscover that over the wire). A dead target falls back to the
+        normal ladder — any engine can serve it via peer fetch or plain
+        recompute."""
+        ph = self._disagg.pending(req.request_id)
+        eid = ph.record.to_engine if ph is not None else None
+        if eid is None or not self._engine_up[eid]:
+            self.add_request(req)
+            return
+        self._live[req.request_id] = eid
+        self._engine_inflight[eid] += 1
+        trace_instant(
+            "request_send", req_id=req.request_id,
+            trace_id=req.trace_id, engine_id=eid,
+        )
+        self._report_inflight()
+        if fail_point("core_client.send",
+                      lambda: f"req={req.request_id}") != "drop":
+            self._inputs[eid].send_multipart(
+                [self._proc_mod.MSG_ADD, self._serial.encode(req)]
+            )
+
+    def disagg_status(self, drain: bool = False) -> dict | None:
+        """Handoff-protocol snapshot for /metrics and /health, or None
+        when the pool has no engine roles. Mirrors routing_status's
+        drain contract: only the metrics renderer drains (durations
+        must be observed exactly once by the histogram)."""
+        disagg = getattr(self, "_disagg", None)
+        if disagg is not None:
+            return disagg.status(drain=drain)
+        plan = getattr(self, "_role_plan", None)
+        if plan is None:
+            return None
+        return {
+            "active": False,
+            "roles": list(plan.roles),
+            "pending": 0,
+            "outcomes": {},
+            "durations_s": [],
+        }
+
+    def _utility_on(
+        self, eid: int, method: str, *args, timeout_ms: int = 30_000
+    ):
+        """Targeted utility call to ONE engine (``_utility``
+        broadcasts); used for decode-side handoff reservations."""
+        self._check_alive()
+        if not self._engine_up[eid]:
+            raise RuntimeError(
+                f"utility {method}: engine {eid} is restarting")
+        self._inputs[eid].send_multipart([
+            self._proc_mod.MSG_UTILITY,
+            method.encode(),
+            self._serial.encode(list(args)),
+        ])
+        return self._collect_utility_replies(method, 1, timeout_ms)[0]["ok"]
+
+    # ------------------------------------------------------------------
+
     def abort_requests(self, request_ids: list[str]) -> None:
         if self._dead or not request_ids:
             return
+        if getattr(self, "_disagg", None) is not None:
+            # A frontend abort (client cancel, stop string detected
+            # frontend-side) can land mid-handoff; drop the pending
+            # record so the resume leg is never sent.
+            for rid in request_ids:
+                self._disagg.note_abort(rid)
         by_engine: dict[int, list[str]] = {}
         for rid in request_ids:
             eid = self._live.pop(rid, None)
@@ -1342,7 +1561,10 @@ class DPLBClient(_ZMQClientBase):
     def get_output(self, timeout: float | None = None) -> EngineCoreOutputs:
         self._drain_loads()  # keep snapshot freshness current when idle
         self._flush_report()  # retry a dropped inflight report
-        return super().get_output(timeout)
+        outputs = super().get_output(timeout)
+        if getattr(self, "_disagg", None) is not None and outputs.outputs:
+            outputs = self._disagg_process(outputs)
+        return outputs
 
     def has_unfinished_requests(self) -> bool:
         self._flush_report()  # retry a dropped inflight report

@@ -126,8 +126,14 @@ class EngineCore:
         self.executor.collective_rpc(
             "set_structured_output_manager", self.structured_output_manager
         )
+        self._device_block_bytes = 0
         if self.kv_connector is not None:
             self.executor.collective_rpc("set_kv_connector", self.kv_connector)
+            try:
+                self._device_block_bytes = int(self.executor.collective_rpc(
+                    "kv_cache_block_bytes")[0])
+            except Exception:
+                pass  # byte gauge reads 0 for the device tier
             if hasattr(self.kv_connector, "set_roofline"):
                 # Hand the fabric's cost model the worker's measured
                 # RooflineModel: the fetch-vs-recompute arbiter and the
@@ -342,6 +348,11 @@ class EngineCore:
         outputs = self.scheduler.update_from_output(
             scheduler_output, runner_output
         )
+        # Disaggregated handoffs flush IN this step, not at the top of
+        # the next one: the decode engine is stalled on the push, so its
+        # latency is on the request's critical path — unlike ordinary
+        # cold saves, which can wait out a sustained-load streak.
+        self._flush_handoff_pushes()
         now = time.monotonic()
         if self._last_step_end is not None:
             self._step_interval_s = now - self._last_step_end
@@ -457,18 +468,47 @@ class EngineCore:
             if saves:
                 self.executor.collective_rpc("kv_connector_save", saves)
 
+    def _flush_handoff_pushes(self) -> None:
+        """Ship this step's finished-handoff KV to decode peers. Hoists
+        the save flush so every pushed key is host-tier-resident first
+        (take_pending_kv_saves covers the same finishes)."""
+        if self.kv_connector is None:
+            return
+        handoffs = self.scheduler.take_pending_handoffs()
+        if not handoffs:
+            return
+        self.flush_kv_saves()
+        for req_id, url, keys in handoffs:
+            self.executor.collective_rpc(
+                "kv_connector_push", req_id, url, keys)
+
+    def disagg_reserve(self, req_id: str, n_blocks: int) -> int:
+        """Decode-side handoff admission (client utility RPC): reserve
+        host-tier bytes for an incoming push."""
+        if self.kv_connector is None:
+            return 0
+        res = self.executor.collective_rpc(
+            "kv_connector_reserve", req_id, n_blocks)
+        return int(res[0]) if res else 0
+
     def kv_fabric_status(self) -> dict:
-        """Tiered-fabric snapshot (tier occupancy, fetch outcomes,
-        demotions, transferred bytes) with the device tier folded in from
-        the block pool's resident-hash map."""
+        """Tiered-fabric snapshot (tier occupancy in blocks AND bytes,
+        fetch/push outcomes, demotions, transferred bytes) with the
+        device tier folded in from the block pool's resident-hash map."""
         if self.kv_connector is None or not hasattr(
             self.kv_connector, "fabric_stats"
         ):
             return {}
         snap = self.kv_connector.fabric_stats()
-        snap["tier_blocks"]["device"] = len(
-            self.scheduler.kv_cache_manager.block_pool
-            .cached_block_hash_to_block)
+        pool = self.scheduler.kv_cache_manager.block_pool
+        n_device = len(pool.cached_block_hash_to_block)
+        snap["tier_blocks"]["device"] = n_device
+        if "tier_bytes" in snap:
+            # Device blocks live unquantized at the cache dtype; size
+            # them from the fabric's encoded-block EWMA is wrong, so use
+            # the runner-reported per-block byte size when known.
+            snap["tier_bytes"]["device"] = n_device * getattr(
+                self, "_device_block_bytes", 0)
         return snap
 
     def suspect_req_ids(self) -> list[str]:

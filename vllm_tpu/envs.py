@@ -91,6 +91,12 @@ _readers: dict[str, Callable[[], Any]] = {
     "VLLM_TPU_DISABLE_ADAPTIVE_SPEC": _bool(
         "VLLM_TPU_DISABLE_ADAPTIVE_SPEC", False
     ),
+    # Escape hatch for disaggregated prefill/decode (vllm_tpu/disagg/):
+    # --engine-roles keeps its phase-aware ROUTING bias but no request
+    # is handed off between engines (no clamped prefill leg, no KV
+    # push). Outputs are token-identical either way under greedy
+    # decoding; A/B this before filing disagg bugs.
+    "VLLM_TPU_DISABLE_DISAGG": _bool("VLLM_TPU_DISABLE_DISAGG", False),
     # Escape hatch for the fused sort-free sampling kernel
     # (ops/sampler_kernel.py): sampling batches fall back to the XLA
     # sort-free reference in sample/sampler.py when set. Both paths are

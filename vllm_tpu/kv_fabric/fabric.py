@@ -65,6 +65,10 @@ class HostTier:
         self.quant = quant
         self._store: OrderedDict[str, Any] = OrderedDict()
         self._bytes = 0
+        # Bytes promised to in-flight handoff pushes (disaggregated
+        # prefill): they shrink the effective eviction budget so a burst
+        # of local demotions can't strand a half-shipped prefix.
+        self._reserved = 0
         self._lock = threading.RLock()
         self.evictions = 0
 
@@ -87,6 +91,20 @@ class HostTier:
     def bytes_used(self) -> int:
         with self._lock:
             return self._bytes
+
+    @property
+    def bytes_reserved(self) -> int:
+        with self._lock:
+            return self._reserved
+
+    def reserve(self, nbytes: int) -> None:
+        """Hold budget for an incoming push; eviction honors it."""
+        with self._lock:
+            self._reserved += max(0, nbytes)
+
+    def release(self, nbytes: int) -> None:
+        with self._lock:
+            self._reserved = max(0, self._reserved - max(0, nbytes))
 
     def contains(self, key: str) -> bool:
         with self._lock:
@@ -117,7 +135,8 @@ class HostTier:
                     continue
                 self._store[k] = v
                 self._bytes += encoded_nbytes(v)
-            while self._bytes > self.max_bytes and self._store:
+            while (self._bytes + self._reserved > self.max_bytes
+                   and self._store):
                 _, ev = self._store.popitem(last=False)
                 self._bytes -= encoded_nbytes(ev)
                 self.evictions += 1
@@ -139,6 +158,7 @@ class HostTier:
             return {
                 "blocks": len(self._store),
                 "bytes": self._bytes,
+                "reserved_bytes": self._reserved,
                 "quant": self.quant,
                 "evictions": self.evictions,
             }
@@ -190,10 +210,16 @@ class KVFabric(KVConnectorBase):
             "fetched": 0, "recompute": 0, "miss": 0, "failed": 0}
         self.demotions = {"device": 0, "host": 0, "store": 0}
         self.fetch_bytes = 0
+        # Disaggregated-prefill push path (kv_push wire op).
+        self.push_outcomes = {"pushed": 0, "failed": 0, "received": 0}
+        self.push_bytes = 0
+        # Decode-side reservations: req_id -> (bytes still held, t0).
+        self._push_reservations: dict[str, tuple[int, float]] = {}
         if bind is not None:
             host, _, port = bind.rpartition(":")
             self._server = PeerServer(
                 self.host, host or "127.0.0.1", int(port)).start()
+            self._server.push_sink = self._accept_push
 
     # -- plumbing ------------------------------------------------------
 
@@ -325,6 +351,108 @@ class KVFabric(KVConnectorBase):
                     "KV fabric store %s put failed (%s); blocks stay "
                     "host-tier only", self.store_url, exc)
 
+    # -- disaggregated-prefill push path -------------------------------
+
+    # Blocks per kv_push frame: bounds frame size (a block is all layers
+    # of one page) while amortizing the round trip.
+    PUSH_CHUNK_BLOCKS = 4
+    # Reservations a crashed prefill engine never settles expire.
+    RESERVATION_TTL_S = 60.0
+
+    def push_blocks(
+        self, keys: Sequence[Any], url: str, req_id: str | None = None
+    ) -> bool:
+        """Handoff: stream this request's prefix blocks (encoded form —
+        int8/int4 cold-tier wire encoding rides for free) into the
+        decode peer's host tier. Chunked so a torn transfer loses one
+        frame, not the manifest. Returns False on any failure; the
+        caller only counts it — the decode side degrades to recompute
+        through the normal invalid-load path, never an error."""
+        from vllm_tpu.resilience.failpoints import fail_point
+
+        hex_keys = self._hex(keys)
+        entries: list[tuple[str, Any]] = []
+        for k in hex_keys:
+            try:
+                entries.append((k, self.host.get_encoded([k])[0]))
+            except KeyError:
+                # Evicted between finish and flush: push what remains —
+                # partial prefixes still shorten the decode-side prefill.
+                continue
+        if not entries:
+            self.push_outcomes["failed"] += 1
+            return False
+        client = self._client(url)
+        sent = 0
+        total = (len(entries) + self.PUSH_CHUNK_BLOCKS - 1) \
+            // self.PUSH_CHUNK_BLOCKS
+        try:
+            for seq in range(total):
+                chunk = entries[seq * self.PUSH_CHUNK_BLOCKS:
+                                (seq + 1) * self.PUSH_CHUNK_BLOCKS]
+                if fail_point(
+                    "kv_fabric.push",
+                    lambda: f"req={req_id} seq={seq}/{total} -> {url}",
+                ) == "drop":
+                    continue  # frame torn on the wire
+                ks = [k for k, _ in chunk]
+                vs = [v for _, v in chunk]
+                nbytes = sum(encoded_nbytes(v) for v in vs)
+                t0 = time.perf_counter()
+                client.kv_push(ks, vs, {
+                    "req_id": req_id, "seq": seq, "total": total})
+                self.cost.observe_transfer(
+                    nbytes, time.perf_counter() - t0)
+                self.push_bytes += nbytes
+                sent += len(ks)
+        except (ConnectionError, OSError) as exc:
+            logger.warning(
+                "KV handoff push to %s failed after %d/%d blocks (%s); "
+                "decode side will recompute", url, sent, len(entries), exc)
+            self.push_outcomes["failed"] += 1
+            return False
+        self.push_outcomes["pushed"] += 1
+        return True
+
+    def reserve_push(self, req_id: str, n_blocks: int) -> int:
+        """Decode-side admission: hold host-tier budget for an incoming
+        handoff before the push starts. Returns the bytes reserved."""
+        now = time.monotonic()
+        for rid, (nbytes, t0) in list(self._push_reservations.items()):
+            if now - t0 > self.RESERVATION_TTL_S:
+                self.host.release(nbytes)
+                del self._push_reservations[rid]
+        self.release_push(req_id)  # re-reserve idempotently
+        nbytes = int(n_blocks * (self._block_bytes or 0))
+        if nbytes > 0:
+            self.host.reserve(nbytes)
+            self._push_reservations[req_id] = (nbytes, now)
+        return nbytes
+
+    def release_push(self, req_id: str) -> None:
+        held = self._push_reservations.pop(req_id, None)
+        if held is not None:
+            self.host.release(held[0])
+
+    def _accept_push(self, keys, values, header: dict) -> int:
+        """Peer-server sink for kv_push frames: land the blocks, settle
+        the reservation as bytes arrive."""
+        req_id = header.get("req_id")
+        nbytes = sum(encoded_nbytes(v) for v in values)
+        self._note_block_bytes(values)
+        held = self._push_reservations.get(req_id) if req_id else None
+        if held is not None:
+            remaining = max(0, held[0] - nbytes)
+            last = header.get("seq", 0) + 1 >= header.get("total", 1)
+            if last or remaining == 0:
+                self.release_push(req_id)
+            else:
+                self.host.release(nbytes)
+                self._push_reservations[req_id] = (remaining, held[1])
+        self.host.put_encoded(self._hex(keys), values)
+        self.push_outcomes["received"] += len(keys)
+        return len(keys)
+
     def load_blocks(self, keys: Sequence[Any]):
         """Promotion: host tier first, then planned peer fetches. Any
         unresolvable key RAISES — the scheduler already counted these
@@ -377,9 +505,13 @@ class KVFabric(KVConnectorBase):
     def fabric_stats(self) -> dict:
         return {
             "tier_blocks": {"host": len(self.host)},
+            "tier_bytes": {"host": self.host.bytes_used},
             "fetch": dict(self.fetch_outcomes),
             "demotions": dict(self.demotions),
             "fetch_bytes": self.fetch_bytes,
+            "push": dict(self.push_outcomes),
+            "push_bytes": self.push_bytes,
+            "reserved_bytes": self.host.bytes_reserved,
             "tier_hits": dict(self.hits),
             "queries": self.queries,
             "host_bytes": self.host.bytes_used,

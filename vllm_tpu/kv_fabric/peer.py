@@ -176,6 +176,28 @@ class PeerClient:
         header, _ = self._rpc({"op": "stats"}, [])
         return header
 
+    def kv_push(
+        self,
+        keys: Sequence[str],
+        values: Sequence[Any],
+        meta: dict | None = None,
+    ) -> int:
+        """Disaggregated-prefill handoff: push encoded blocks INTO the
+        peer's tier (the inverse of ``get``; ``put`` exists but push
+        frames carry handoff metadata — request id, chunk seq — and are
+        acknowledged against the peer's reservation sink). Returns the
+        number of blocks the peer accepted; raises ``ConnectionError``
+        when the peer refuses the push (no sink / ingest error), so the
+        fabric counts a failed handoff and the decode side recomputes."""
+        metas, blobs = pack_entries(values)
+        header = dict(meta or {},
+                      op="kv_push", keys=list(keys), entries=metas)
+        reply, _ = self._rpc(header, blobs)
+        if "error" in reply:
+            raise ConnectionError(
+                f"peer {self.url} rejected kv_push: {reply['error']}")
+        return int(reply.get("ok", 0))
+
     def corpus_put(self, header: dict, blob: bytes) -> int:
         """Push a suffix-corpus share frame (header carries ``op`` +
         per-sequence ``lens``; blob is the packed int32 token stream).
@@ -201,6 +223,11 @@ class PeerServer:
         # corpus share): callable(header, body) -> count folded in.
         # None = corpus frames are rejected like any unknown op.
         self.corpus_sink = None
+        # Optional handoff-push sink (disaggregated prefill): callable
+        # (keys, values, header) -> count accepted. None = kv_push
+        # frames fall back to a plain tier.put_encoded (standalone
+        # block-store mode has no reservation accounting to settle).
+        self.push_sink = None
         self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         self._sock.bind((host, port))
@@ -280,6 +307,19 @@ class PeerServer:
             _send_frame(conn, {"ok": True}, [])
         elif op == "stats":
             _send_frame(conn, self.tier.stats(), [])
+        elif op == "kv_push":
+            values = unpack_entries(header["entries"], body)
+            sink = self.push_sink
+            try:
+                if sink is not None:
+                    accepted = sink(keys, values, header)
+                else:
+                    self.tier.put_encoded(keys, values)
+                    accepted = len(keys)
+            except Exception as exc:  # a bad push must not kill the conn
+                _send_frame(conn, {"error": f"kv_push ingest: {exc}"}, [])
+                return
+            _send_frame(conn, {"ok": int(accepted)}, [])
         elif op == "corpus_put":
             sink = self.corpus_sink
             if sink is None:

@@ -452,6 +452,35 @@ class PrometheusRegistry:
         self.kv_fabric_fetch_bytes = Counter(
             "vllm:kv_fabric_fetch_bytes_total",
             "Encoded bytes pulled over the fabric wire by peer fetches")
+        self.kv_fabric_tier_bytes = LabeledGauge(
+            "vllm:kv_fabric_tier_bytes",
+            "Encoded KV bytes resident per fabric tier (device = HBM "
+            "prefix cache estimated from block bytes, host = host-RAM "
+            "cold tier actual encoded footprint)", "tier")
+        # Disaggregated prefill/decode serving (vllm_tpu/disagg):
+        # handoff outcomes are refreshed from the client coordinator's
+        # live snapshot at render time (same pull scheme as routing);
+        # push bytes ride SchedulerStats from the prefill engine.
+        self.disagg_handoffs = LabeledCounter(
+            "vllm:disagg_handoffs_total",
+            "Prefill->decode handoffs by outcome (pushed = decode side "
+            "resumed on pushed KV, recompute = push torn/missed and the "
+            "decode side re-prefilled locally, local = request finished "
+            "during its prefill leg, aborted = client/engine abort "
+            "mid-handoff)", "outcome")
+        self.disagg_push_bytes = Counter(
+            "vllm:disagg_push_bytes_total",
+            "Encoded KV bytes pushed over the fabric wire by "
+            "prefill->decode handoffs")
+        self.disagg_handoff_duration = Histogram(
+            "vllm:disagg_handoff_duration_seconds",
+            "Handoff wall time (prefill admission -> decode side's first "
+            "post-resume tokens)",
+            [0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0])
+        self.disagg_pending = Gauge(
+            "vllm:disagg_pending_handoffs",
+            "Handoffs currently in flight (clamped prefill leg admitted, "
+            "decode side not yet producing)")
         self._metrics = [
             self.num_running, self.num_waiting, self.kv_usage,
             self.prefix_queries, self.prefix_hits, self.preempted,
@@ -488,6 +517,9 @@ class PrometheusRegistry:
             self.perf_captures, self.perf_captures_aborted,
             self.kv_fabric_tier_blocks, self.kv_fabric_fetches,
             self.kv_fabric_demotions, self.kv_fabric_fetch_bytes,
+            self.kv_fabric_tier_bytes,
+            self.disagg_handoffs, self.disagg_push_bytes,
+            self.disagg_handoff_duration, self.disagg_pending,
         ]
         self._engine = engine
         self._last_prefix = (0, 0)
@@ -597,6 +629,10 @@ class PrometheusRegistry:
                     self.kv_fabric_demotions.inc_to(tier, float(n))
                 self.kv_fabric_fetch_bytes.inc_to(
                     float(fab.get("fetch_bytes", 0)))
+                for tier, n in (fab.get("tier_bytes") or {}).items():
+                    self.kv_fabric_tier_bytes.set(tier, float(n))
+                self.disagg_push_bytes.inc_to(
+                    float(fab.get("push_bytes", 0)))
         if iteration_stats is not None:
             self.generation_tokens.inc(iteration_stats.num_generation_tokens)
             self.prompt_tokens.inc(iteration_stats.num_prompt_tokens)
@@ -688,8 +724,31 @@ class PrometheusRegistry:
         # lengths arrive drained (since last render) → observe each once.
         for kind, n in status.get("decisions", {}).items():
             self.dp_routing_decisions.inc_to(kind, float(n))
+        # Phase-rung narrowings (disagg pools) ride the same labeled
+        # counter; they are not terminal rungs, so they live apart from
+        # the decision totals in the snapshot.
+        for phase, n in status.get("phases", {}).items():
+            self.dp_routing_decisions.inc_to(f"phase_{phase}", float(n))
         for blocks in status.get("hit_blocks", []):
             self.dp_prefix_hit_blocks.observe(float(blocks))
+
+    def _refresh_disagg(self) -> None:
+        engine = self._engine
+        if engine is None or not hasattr(engine, "disagg_status"):
+            return
+        try:
+            status = engine.disagg_status(drain=True)
+        except Exception:
+            return
+        if not status:
+            return
+        # Outcome totals are cumulative in the coordinator → ratchet;
+        # durations arrive drained (since last render) → observe once.
+        for outcome, n in status.get("outcomes", {}).items():
+            self.disagg_handoffs.inc_to(outcome, float(n))
+        for d in status.get("durations_s", []):
+            self.disagg_handoff_duration.observe(float(d))
+        self.disagg_pending.set(float(status.get("pending", 0)))
 
     def _refresh_lifecycle(self) -> None:
         engine = self._engine
@@ -715,6 +774,7 @@ class PrometheusRegistry:
         self._refresh_resilience()
         self._refresh_lifecycle()
         self._refresh_routing()
+        self._refresh_disagg()
         self._refresh_failpoints()
         return "".join(m.render() for m in self._metrics)
 

@@ -67,6 +67,12 @@ class EngineCoreRequest:
     # with multiple output sockets route this request's outputs back to
     # output socket [client_index]; single-frontend topologies leave 0.
     client_index: int = 0
+    # Disaggregated prefill: fabric peer address ("host:port") of the
+    # decode engine this request's prompt KV must be pushed to when the
+    # request finishes. None = no handoff (the overwhelmingly common
+    # case). Optional field: wire-safe against old peers (serial_utils
+    # filters unknown dataclass kwargs at decode).
+    disagg_push_to: str | None = None
 
 
 class Request:
@@ -85,6 +91,7 @@ class Request:
         pooling_params: Any = None,
         mm_inputs: list[Any] | None = None,
         trace_id: str | None = None,
+        disagg_push_to: str | None = None,
     ) -> None:
         self.request_id = request_id
         self.trace_id = trace_id
@@ -96,6 +103,7 @@ class Request:
         self.lora_name = lora_name
         self.pooling_params = pooling_params
         self.mm_inputs = mm_inputs or []
+        self.disagg_push_to = disagg_push_to
 
         self.status = RequestStatus.WAITING
         self.stop_reason: int | str | None = None
@@ -159,6 +167,7 @@ class Request:
             block_hasher=block_hasher,
             mm_inputs=req.mm_inputs,
             trace_id=req.trace_id,
+            disagg_push_to=getattr(req, "disagg_push_to", None),
         )
 
     # ------------------------------------------------------------------

@@ -150,6 +150,16 @@ SITE_CATALOG: dict[str, str] = {
         "(D2H + quantize) into the fabric's host tier; drop = the "
         "demotion batch is lost — blocks stay recomputable, only "
         "persistence is sacrificed"),
+    "kv_fabric.push": (
+        "KVFabric.push_blocks, before each chunked kv_push to the decode "
+        "peer; drop = that chunk is silently lost (torn handoff — the "
+        "decode side re-prefills the missing prefix via the normal "
+        "recompute path), raise(ConnectionError) = dead decode peer"),
+    "disagg.handoff": (
+        "DPLBClient._disagg_begin, before a request is clamped into a "
+        "prefill leg; drop = the handoff is never started and the "
+        "request runs unified on one engine (disagg bypass, never a "
+        "lost request)"),
 }
 
 _EXC_WHITELIST: dict[str, type[BaseException]] = {

@@ -2,6 +2,12 @@
 
 Decision ladder (each rung falls through to the next):
 
+0. **phase** — role-aware pools only (``--engine-roles``): the
+   candidate set narrows to the engines serving the request's phase —
+   long-prompt / prefill-leg traffic to prefill capacity, short-prompt
+   / resume-leg traffic to decode capacity (decode engines keep their
+   batches dense). A phase with no live capacity falls back to the
+   full candidate set; the rungs below then pick within it.
 1. **prefix** — the request's leading block hashes hit ≥1 candidate
    engine's resident-block index: route to the longest hit (ties broken
    least-loaded). Chat turn-2 lands on the engine that prefilled
@@ -32,6 +38,10 @@ logger = init_logger(__name__)
 # megaprompts (whose tails can't be shared anyway).
 DEFAULT_MAX_PREFIX_BLOCKS = 128
 
+# Phase rung: prompts spanning at least this many full blocks count as
+# prefill-heavy; anything shorter is decode-dominated traffic.
+DEFAULT_LONG_PROMPT_BLOCKS = 4
+
 
 @dataclass
 class RoutingDecision:
@@ -53,7 +63,15 @@ class RoutingStats:
             "prefix": 0, "prefix_spill": 0, "least_loaded": 0,
             "round_robin": 0,
         }
+        # Phase-rung narrowings are counted apart from the terminal
+        # decisions: the lower rungs still pick the engine within the
+        # narrowed set, so folding them in would double-count requests.
+        self._phases: dict[str, int] = {"prefill": 0, "decode": 0}
         self._pending_hits: list[int] = []
+
+    def note_phase(self, phase: str) -> None:
+        with self._lock:
+            self._phases[phase] = self._phases.get(phase, 0) + 1
 
     def note(self, decision: RoutingDecision) -> None:
         with self._lock:
@@ -71,7 +89,11 @@ class RoutingStats:
                 hits, self._pending_hits = self._pending_hits, []
             else:
                 hits = list(self._pending_hits)
-            return {"decisions": dict(self._decisions), "hit_blocks": hits}
+            return {
+                "decisions": dict(self._decisions),
+                "phases": dict(self._phases),
+                "hit_blocks": hits,
+            }
 
 
 def request_prefix_hashes(
@@ -101,6 +123,43 @@ def request_prefix_hashes(
             prev, tokens[i * block_size:(i + 1) * block_size])
         hashes.append(prev)
     return hashes
+
+
+def request_phase(
+    request,
+    block_size: int,
+    long_prompt_blocks: int = DEFAULT_LONG_PROMPT_BLOCKS,
+) -> str:
+    """Which phase dominates this request's device time: "prefill" for
+    long prompts, "decode" otherwise. Handoff legs override this (the
+    clamped prefill leg and the resume leg carry their phase
+    explicitly); this classifies everything else."""
+    if len(request.prompt_token_ids) >= long_prompt_blocks * block_size:
+        return "prefill"
+    return "decode"
+
+
+def phase_rung(
+    plan,
+    request,
+    candidates: list[int],
+    block_size: int,
+    phase: str | None = None,
+    long_prompt_blocks: int = DEFAULT_LONG_PROMPT_BLOCKS,
+) -> tuple[list[int], str | None]:
+    """Rung 0: narrow ``candidates`` to the engines serving the
+    request's phase. Returns ``(narrowed, phase)`` — or ``(candidates,
+    None)`` when the pool has no roles or the phase has no live
+    capacity (never strands a request on an empty set)."""
+    if plan is None or not any(r != "unified" for r in plan.roles):
+        return candidates, None
+    if phase is None:
+        phase = request_phase(request, block_size, long_prompt_blocks)
+    allowed = set(plan.candidates_for_phase(phase))
+    narrowed = [c for c in candidates if c in allowed]
+    if not narrowed:
+        return candidates, None
+    return narrowed, phase
 
 
 class PrefixAwareRouter:

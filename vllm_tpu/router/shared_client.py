@@ -144,6 +144,22 @@ class SharedDPClient(_ZMQClientBase):
             )
             self._routing_stats = RoutingStats()
 
+        # Role-aware phase rung (routing bias only): shared frontends
+        # keep prefill-heavy traffic on prefill capacity, but the KV
+        # handoff protocol itself is orchestrated by DPLBClient — this
+        # topology's frontends don't clamp/resume requests.
+        self._role_plan = None
+        self._block_size = config.cache_config.block_size
+        roles = config.parallel_config.engine_roles
+        if roles:
+            from vllm_tpu.disagg import RolePlan
+
+            self._role_plan = RolePlan.from_spec(roles, n)
+            if self._routing_stats is None:
+                from vllm_tpu.router.policy import RoutingStats
+
+                self._routing_stats = RoutingStats()
+
         self._await_engines(ready_timeout_s)
         self._started = True
         logger.info(
@@ -331,6 +347,13 @@ class SharedDPClient(_ZMQClientBase):
         candidates = [
             i for i in range(self._num_engines) if self._engine_up[i]
         ] or list(range(self._num_engines))
+        if self._role_plan is not None:
+            from vllm_tpu.router.policy import phase_rung
+
+            candidates, phase_kind = phase_rung(
+                self._role_plan, req, candidates, self._block_size)
+            if phase_kind is not None and self._routing_stats is not None:
+                self._routing_stats.note_phase(phase_kind)
         stale = self._snapshot_stale()
         if stale != self._routing_degraded:
             self._routing_degraded = stale

@@ -209,6 +209,9 @@ class _EnginePool:
             engine_config = copy.deepcopy(config)
             engine_config.parallel_config.data_parallel_engines = 1
             engine_config.parallel_config.api_server_count = 1
+            # Pool-level concept; a dp=1 engine config would fail the
+            # roles/pool size validation in finalize().
+            engine_config.parallel_config.engine_roles = None
             ep = engine_config.cache_config.kv_events_endpoint
             if not ep:
                 engine_config.cache_config.kv_events_endpoint = (

@@ -2072,6 +2072,26 @@ class ModelRunner:
             [payloads[:, i] for i in range(payloads.shape[1])],
         )
 
+    def kv_connector_push(self, req_id: str, url: str, keys: list) -> bool:
+        """Disaggregated handoff: stream a finished request's prefix
+        blocks (already demoted to the host tier by the preceding save
+        flush) to the decode peer at ``url``. Best-effort: a failed push
+        is only counted — the decode side recomputes."""
+        assert self.kv_connector is not None
+        push = getattr(self.kv_connector, "push_blocks", None)
+        if push is None:
+            return False
+        return push(keys, url, req_id=req_id)
+
+    def kv_connector_reserve(self, req_id: str, n_blocks: int) -> int:
+        """Decode-side handoff admission: hold host-tier budget for an
+        incoming push before the prefill engine starts streaming."""
+        assert self.kv_connector is not None
+        reserve = getattr(self.kv_connector, "reserve_push", None)
+        if reserve is None:
+            return 0
+        return reserve(req_id, n_blocks)
+
     def _kv_connector_loads(self, load_map: dict) -> set[str]:
         """Fill freshly allocated blocks from the external store before
         the step that reads them enqueues. Block counts pad to power-of-2

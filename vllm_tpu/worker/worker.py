@@ -1014,6 +1014,25 @@ class Worker:
         assert self.runner is not None
         self.runner.kv_connector_save(entries)
 
+    def kv_cache_block_bytes(self) -> int:
+        """Device bytes per KV block (all layers) — sizes the fabric's
+        device-tier byte gauge."""
+        assert self.runner is not None
+        cache = getattr(self.runner, "kv_cache", None)
+        if cache is None or cache.shape[1] == 0:
+            return 0
+        return int(cache.nbytes // cache.shape[1])
+
+    def kv_connector_push(
+        self, req_id: str, url: str, keys: list
+    ) -> bool:
+        assert self.runner is not None
+        return self.runner.kv_connector_push(req_id, url, keys)
+
+    def kv_connector_reserve(self, req_id: str, n_blocks: int) -> int:
+        assert self.runner is not None
+        return self.runner.kv_connector_reserve(req_id, n_blocks)
+
     def add_lora(self, name: str, path: str) -> bool:
         assert self.runner is not None and self.runner.lora_manager is not None, (
             "LoRA serving requires enable_lora=True"
