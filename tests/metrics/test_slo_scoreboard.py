@@ -1,0 +1,357 @@
+"""SLO scoreboard unit coverage: per-class percentile math, SLO spec
+parsing, attainment edge cases (empty class, single sample, all-miss),
+trace record/load round-trip, synthesis determinism, and the cluster
+exposition merge. Pure (no engine), tier-1 fast."""
+
+from __future__ import annotations
+
+import json
+import os
+
+import pytest
+
+from vllm_tpu.metrics.goodput import (
+    class_scoreboard,
+    parse_duration_ms,
+    parse_slo_spec,
+    percentile,
+    request_meets_slo,
+)
+
+
+# ---------------------------------------------------------------------------
+# SLO spec parsing.
+# ---------------------------------------------------------------------------
+
+
+def test_parse_duration_ms():
+    assert parse_duration_ms("200ms") == 200.0
+    assert parse_duration_ms("5s") == 5000.0
+    assert parse_duration_ms("2m") == 120000.0
+    assert parse_duration_ms("500us") == 0.5
+    assert parse_duration_ms("75") == 75.0  # bare number = ms
+    assert parse_duration_ms(" 1.5S ") == 1500.0
+
+
+def test_parse_slo_spec():
+    slo = parse_slo_spec("interactive=ttft:200ms,itl:50ms;batch=ttft:5s")
+    assert slo == {
+        "interactive": {"ttft_ms": 200.0, "itl_ms": 50.0},
+        "batch": {"ttft_ms": 5000.0},
+    }
+    assert parse_slo_spec(None) == {}
+    assert parse_slo_spec("") == {}
+
+
+@pytest.mark.parametrize("bad", [
+    "interactive",            # missing '='
+    "=ttft:200ms",            # empty class
+    "a=latency:200ms",        # unknown target key
+    "a=",                     # clause with no targets
+    "a=ttft:",                # target with no value
+])
+def test_parse_slo_spec_rejects(bad):
+    with pytest.raises(ValueError):
+        parse_slo_spec(bad)
+
+
+# ---------------------------------------------------------------------------
+# Nearest-rank percentile + per-request verdict edges.
+# ---------------------------------------------------------------------------
+
+
+def test_percentile_edges():
+    assert percentile([], 0.5) is None
+    assert percentile([7.0], 0.50) == 7.0   # single sample: every rank
+    assert percentile([7.0], 0.99) == 7.0
+    vals = list(range(1, 101))
+    assert percentile(vals, 0.50) == 50
+    assert percentile(vals, 0.99) == 99
+    assert percentile(vals, 0.0) == 1
+    assert percentile(vals, 1.0) == 100
+
+
+def test_request_meets_slo():
+    t = {"ttft_ms": 100.0, "itl_ms": 50.0}
+    assert request_meets_slo(80.0, [10.0, 20.0], t) is True
+    assert request_meets_slo(150.0, [10.0], t) is False      # ttft miss
+    assert request_meets_slo(80.0, [10.0, 90.0], t) is False  # itl p99 miss
+    assert request_meets_slo(None, [10.0], t) is False        # no first token
+    # No targets -> nothing to attain (None, not a vacuous pass).
+    assert request_meets_slo(80.0, [10.0], None) is None
+    assert request_meets_slo(80.0, [10.0], {}) is None
+    # ITL target but no gaps recorded (single-token request): only the
+    # ttft axis is judged.
+    assert request_meets_slo(80.0, [], t) is True
+
+
+def test_class_scoreboard_basic():
+    slo = parse_slo_spec("interactive=ttft:100ms,itl:50ms")
+    reqs = [
+        {"slo_class": "interactive", "ttft_ms": 50.0,
+         "itls_ms": [10.0, 20.0]},
+        {"slo_class": "interactive", "ttft_ms": 150.0, "itls_ms": [10.0]},
+        {"slo_class": "batch", "ttft_ms": 900.0, "itls_ms": [100.0]},
+    ]
+    board = class_scoreboard(reqs, slo)
+    inter = board["interactive"]
+    assert inter["requests"] == 2
+    assert inter["ttft_ms"]["p50"] == 50.0
+    assert inter["ttft_ms"]["p99"] == 150.0
+    assert inter["itl_ms"]["p99"] == 20.0
+    assert inter["slo_attainment"] == 0.5
+    assert inter["slo_met_requests"] == 1
+    # Class with no targets: percentiles still reported, attainment None.
+    batch = board["batch"]
+    assert batch["slo_attainment"] is None
+    assert batch["slo_met_requests"] is None
+    assert batch["ttft_ms"]["p50"] == 900.0
+
+
+def test_class_scoreboard_edge_cases():
+    assert class_scoreboard([]) == {}  # empty run: no classes at all
+    slo = parse_slo_spec("a=ttft:10ms")
+    # Single sample: p50 == p99 == the sample.
+    board = class_scoreboard(
+        [{"slo_class": "a", "ttft_ms": 5.0, "itls_ms": []}], slo)
+    assert board["a"]["ttft_ms"] == {"p50": 5.0, "p99": 5.0}
+    assert board["a"]["slo_attainment"] == 1.0
+    # All-miss class: attainment 0.0 (not None).
+    board = class_scoreboard(
+        [{"slo_class": "a", "ttft_ms": 50.0, "itls_ms": []},
+         {"slo_class": "a", "ttft_ms": None, "itls_ms": []}], slo)
+    assert board["a"]["slo_attainment"] == 0.0
+    assert board["a"]["slo_met_requests"] == 0
+    # TTFT percentiles skip never-started requests; ITL block is empty.
+    assert board["a"]["ttft_ms"]["p99"] == 50.0
+    assert board["a"]["itl_ms"] == {"p50": None, "p99": None}
+
+
+# ---------------------------------------------------------------------------
+# Trace capture round-trip (recorder -> load_trace) + synthesis.
+# ---------------------------------------------------------------------------
+
+
+def _timings(req_id: str, **kw):
+    from vllm_tpu.metrics.stats import RequestTimings
+
+    defaults = dict(
+        request_id=req_id, finish_reason="length", num_prompt_tokens=8,
+        num_output_tokens=4, num_cached_tokens=0, queue_s=0.01,
+        prefill_s=0.02, decode_s=0.1, e2e_s=0.2, detokenize_s=0.001,
+        arrival_time=100.0, slo_class="interactive", tenant_id="acme",
+    )
+    defaults.update(kw)
+    fields = {
+        f.name for f in __import__("dataclasses").fields(RequestTimings)
+    }
+    return RequestTimings(**{k: v for k, v in defaults.items()
+                             if k in fields})
+
+
+def test_reqtrace_roundtrip(tmp_path):
+    from vllm_tpu.metrics.reqtrace import RequestTraceRecorder, load_trace
+    from vllm_tpu.sampling_params import SamplingParams
+
+    rec = RequestTraceRecorder(str(tmp_path))
+    params = SamplingParams(temperature=0.0, max_tokens=4, ignore_eos=True,
+                            slo_class="interactive", tenant_id="acme")
+    rec.record_request(_timings("r1", arrival_time=rec._t0_mono + 0.5),
+                       params, ttft_ms=42.0, itls_ms=[5.0, 6.0, 7.0])
+    rec.record_request(
+        _timings("r2", slo_class=None, tenant_id=None,
+                 arrival_time=rec._t0_mono + 1.0),
+        SamplingParams(temperature=0.0, max_tokens=4), ttft_ms=10.0)
+    assert rec.records_total == 2
+    assert rec.status()["active"]
+    rec.close()
+
+    records = load_trace(str(tmp_path))
+    assert [r["request_id"] for r in records] == ["r1", "r2"]  # by arrival
+    r1 = records[0]
+    assert r1["slo_class"] == "interactive"
+    assert r1["tenant_id"] == "acme"
+    assert r1["arrival_offset_s"] == 0.5
+    assert r1["prompt_len"] == 8
+    assert r1["output_len"] == 4
+    assert r1["sampling"]["max_tokens"] == 4
+    assert r1["ttft_ms"] == 42.0
+    assert r1["itl_ms"]["count"] == 3
+    assert r1["itl_ms"]["p99"] == 7.0
+    assert records[1]["slo_class"] is None
+
+
+def test_load_trace_skips_torn_tail(tmp_path):
+    from vllm_tpu.metrics.reqtrace import RequestTraceRecorder, load_trace
+    from vllm_tpu.sampling_params import SamplingParams
+
+    rec = RequestTraceRecorder(str(tmp_path))
+    rec.record_request(_timings("r1"), SamplingParams())
+    rec.close()
+    # Simulate a crash mid-write: torn, unterminated JSON on the tail.
+    with open(rec.path, "a") as f:
+        f.write('{"kind": "request", "request_id": "torn')
+    records = load_trace(rec.path)
+    assert [r["request_id"] for r in records] == ["r1"]
+
+
+def test_synthesize_trace_deterministic():
+    from vllm_tpu.metrics.reqtrace import (
+        replay_prompt_token_ids,
+        synthesize_trace,
+    )
+
+    classes = [
+        {"slo_class": "interactive", "tenant_id": "a", "share": 0.7,
+         "prompt_len": 16, "max_tokens": 8},
+        {"slo_class": "batch", "tenant_id": "b", "share": 0.3,
+         "prompt_len": 32, "max_tokens": 16},
+    ]
+    t1 = synthesize_trace(classes, num_requests=50, qps=10.0, seed=7)
+    t2 = synthesize_trace(classes, num_requests=50, qps=10.0, seed=7)
+    assert t1 == t2  # fully deterministic
+    assert len(t1) == 50
+    labels = {r["slo_class"] for r in t1}
+    assert labels == {"interactive", "batch"}
+    offsets = [r["arrival_offset_s"] for r in t1]
+    assert offsets == sorted(offsets)
+    # Replay prompts: deterministic, right length, distinct per request.
+    p1 = replay_prompt_token_ids(t1[0])
+    assert p1 == replay_prompt_token_ids(t2[0])
+    assert len(p1) == t1[0]["prompt_len"]
+    assert p1 != replay_prompt_token_ids(t1[1])
+    assert all(0 <= t < 32000 for t in p1)
+
+
+def test_parse_trace_classes():
+    from vllm_tpu.benchmarks.run import DEFAULT_TRACE_MIX, _parse_trace_classes
+
+    classes = _parse_trace_classes(
+        "interactive=share:0.7,prompt:32,output:16,tenant:acme;"
+        "batch=share:0.3,prompt:64,output:48")
+    assert classes[0] == {"slo_class": "interactive", "tenant_id": "acme",
+                          "share": 0.7, "prompt_len": 32, "max_tokens": 16}
+    assert classes[1]["tenant_id"] is None
+    assert len(_parse_trace_classes(DEFAULT_TRACE_MIX)) == 2
+    with pytest.raises(ValueError):
+        _parse_trace_classes("noequals")
+    with pytest.raises(ValueError):
+        _parse_trace_classes("a=bogus:1")
+
+
+def test_score_replay_shape():
+    from vllm_tpu.benchmarks.run import score_replay
+
+    slo = parse_slo_spec("interactive=ttft:100ms")
+    done = [
+        ("interactive", "acme", 50.0, [5.0], 2, False),
+        ("interactive", "acme", 500.0, [5.0], 2, True),
+        ("batch", "bulk", 900.0, [50.0], 2, False),
+    ]
+    result = score_replay(done, {"batch": 1}, 2.0, slo, num_requests=4)
+    assert result["replayed"] == 3
+    assert result["shed"] == 1
+    assert result["classes"]["interactive"]["slo_attainment"] == 0.5
+    assert result["classes"]["interactive"]["timeouts"] == 1
+    assert result["classes"]["batch"]["shed"] == 1
+    assert result["by_tenant"] == {"acme": 2, "bulk": 1}
+    assert result["output_token_throughput"] == 3.0
+    # Goodput excludes the SLO-missing interactive request's tokens;
+    # batch has no targets so its tokens are not penalized.
+    assert result["goodput_tokens_per_s"] == 2.0
+
+
+# ---------------------------------------------------------------------------
+# Cluster exposition merge (/metrics/cluster).
+# ---------------------------------------------------------------------------
+
+
+def test_merge_expositions():
+    from vllm_tpu.metrics.prometheus import merge_expositions
+
+    fe0 = (
+        "# HELP vllm:generation_tokens_total count\n"
+        "# TYPE vllm:generation_tokens_total counter\n"
+        "vllm:generation_tokens_total 5\n"
+        "# HELP vllm:request_ttft_seconds ttft\n"
+        "# TYPE vllm:request_ttft_seconds histogram\n"
+        'vllm:request_ttft_seconds_bucket{slo_class="a",le="0.5"} 1\n'
+        'vllm:request_ttft_seconds_bucket{slo_class="a",le="+Inf"} 1\n'
+        'vllm:request_ttft_seconds_sum{slo_class="a"} 0.2\n'
+        'vllm:request_ttft_seconds_count{slo_class="a"} 1\n'
+        "# HELP vllm:slo_attainment frac\n"
+        "# TYPE vllm:slo_attainment gauge\n"
+        'vllm:slo_attainment{slo_class="a"} 0.9\n'
+    )
+    fe1 = (
+        "# HELP vllm:generation_tokens_total count\n"
+        "# TYPE vllm:generation_tokens_total counter\n"
+        "vllm:generation_tokens_total 7\n"
+        "# HELP vllm:request_ttft_seconds ttft\n"
+        "# TYPE vllm:request_ttft_seconds histogram\n"
+        'vllm:request_ttft_seconds_bucket{slo_class="a",le="0.5"} 2\n'
+        'vllm:request_ttft_seconds_bucket{slo_class="a",le="+Inf"} 2\n'
+        'vllm:request_ttft_seconds_sum{slo_class="a"} 0.3\n'
+        'vllm:request_ttft_seconds_count{slo_class="a"} 2\n'
+        "# HELP vllm:slo_attainment frac\n"
+        "# TYPE vllm:slo_attainment gauge\n"
+        'vllm:slo_attainment{slo_class="a"} 0.5\n'
+    )
+    merged = merge_expositions({"0": fe0, "1": fe1})
+    lines = merged.splitlines()
+    # Counters and histogram samples sum across frontends.
+    assert "vllm:generation_tokens_total 12.0" in lines
+    assert ('vllm:request_ttft_seconds_bucket{slo_class="a",le="0.5"} 3.0'
+            in lines)
+    assert 'vllm:request_ttft_seconds_count{slo_class="a"} 3.0' in lines
+    # Gauges stay per-frontend, distinguished by an injected label.
+    assert ('vllm:slo_attainment{frontend="0",slo_class="a"} 0.9'
+            in lines)
+    assert ('vllm:slo_attainment{frontend="1",slo_class="a"} 0.5'
+            in lines)
+    # HELP/TYPE emitted once per family.
+    assert merged.count("# TYPE vllm:generation_tokens_total counter") == 1
+
+
+def test_merge_traces_disagg_handoff(tmp_path):
+    import sys
+
+    sys.path.insert(0, os.path.join(
+        os.path.dirname(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__)))), "tools"))
+    try:
+        from merge_traces import merge
+    finally:
+        sys.path.pop(0)
+
+    tid = "feedc0de01"
+
+    def ev(name, ph, ts, pid):
+        return {"name": name, "cat": "request", "ph": ph, "ts": ts,
+                "pid": pid, "tid": pid, "id": tid,
+                "args": {"trace_id": tid, "req_id": "r1"}}
+
+    # Frontend 100 holds the request span; prefill leg on engine 200
+    # hands off to decode leg on engine 300 (resume keeps the trace id).
+    traces = {
+        100: [ev("request", "b", 1000, 100), ev("request", "e", 9000, 100)],
+        200: [ev("queue", "b", 1100, 200), ev("queue", "e", 1200, 200),
+              ev("prefill", "b", 1200, 200), ev("prefill", "e", 3000, 200)],
+        300: [ev("queue", "b", 3500, 300), ev("queue", "e", 3600, 300),
+              ev("decode", "b", 3600, 300), ev("decode", "e", 8800, 300)],
+    }
+    for pid, evs in traces.items():
+        with open(tmp_path / f"trace-{pid}.json", "w") as f:
+            json.dump(evs, f)
+    out = merge(str(tmp_path))
+    handoff = [e for e in out["traceEvents"]
+               if e.get("cat") == "disagg_flow"]
+    assert [e["ph"] for e in handoff] == ["s", "f"]
+    assert handoff[0]["pid"] == 200  # leaves the prefill leg...
+    assert handoff[1]["pid"] == 300  # ...lands on the decode leg
+    assert handoff[0]["id"] == handoff[1]["id"]
+    names = {e["pid"]: e["args"]["name"]
+             for e in out["traceEvents"] if e.get("name") == "process_name"}
+    assert "prefill leg" in names[200]
+    assert "decode leg" in names[300]
+    assert "frontend" in names[100]

@@ -265,11 +265,10 @@ def test_frontend_crash_replays_only_its_shard(topo):
     pid0 = _get(topo.admin(0) + "/health")["pid"]
 
     # A long stream admitted by shard 0 (admin port pins the frontend),
-    # journaled in shard-0's journal dir. Don't wait for SSE data — a
-    # tokenizerless checkpoint emits no text deltas, so the first event
-    # only arrives at completion; the on-disk snapshot (written
-    # synchronously at admission, unlinked on finish) is the reliable
-    # "in flight right now" signal.
+    # journaled in shard-0's journal dir. Don't wait for SSE data — the
+    # first event only arrives after first-step compile; the on-disk
+    # snapshot (written synchronously at admission, unlinked on finish)
+    # is the reliable "in flight right now" signal.
     shard0 = os.path.join(topo.journal, "shard-0")
     stream = _post(topo.admin(0), {
         "model": "topo", "prompt": [3, 5, 7, 11],

@@ -129,6 +129,20 @@ REQUIRED_DISAGG_METRICS = {
     "vllm:disagg_handoff_duration_seconds",
 }
 
+# Documented in the README ("SLO scoreboard"); the replay bench and
+# per-class dashboards read these names.
+REQUIRED_SLO_METRICS = {
+    "vllm:request_ttft_seconds",
+    "vllm:request_itl_seconds",
+    "vllm:slo_attainment",
+    "vllm:request_trace_records_total",
+}
+
+# Floor on the registry size: a refactor that silently drops metrics
+# from the render list must fail the lint even if no required-set name
+# is among the casualties. Bump when adding metrics.
+MIN_METRICS = 80
+
 
 def check() -> list[str]:
     """Return a list of lint errors (empty = clean)."""
@@ -223,6 +237,16 @@ def check() -> list[str]:
         errors.append(
             f"required disagg metric {name} is missing from "
             f"the registry (documented in README)")
+    for name in sorted(REQUIRED_SLO_METRICS - set(seen)):
+        errors.append(
+            f"required SLO-scoreboard metric {name} is missing from "
+            f"the registry (documented in README)")
+
+    if len(reg._metrics) < MIN_METRICS:
+        errors.append(
+            f"registry renders {len(reg._metrics)} metrics, below the "
+            f"MIN_METRICS floor of {MIN_METRICS} — something was dropped "
+            f"from the render list")
 
     return errors
 

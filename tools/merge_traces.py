@@ -15,8 +15,15 @@ chrome-trace JSON object loadable in https://ui.perfetto.dev:
   single async track;
 - a flow arrow (``ph: s/t/f``) is emitted per request trace id, linking
   its events across processes in submission order;
-- process metadata names each pid by role (engine / frontend) inferred
-  from the event categories it emitted.
+- disaggregated prefill/decode handoffs are stitched: a request whose
+  phase spans (queue/prefill/decode) come from more than one engine-core
+  pid was handed off mid-flight (the resume request reuses the frontend
+  trace id), and each leg boundary gets a direct ``handoff`` flow arrow
+  from the prefill leg's last phase event to the decode leg's first —
+  one linked request instead of unrelated per-engine tracks;
+- process metadata names each pid by role (engine / frontend — with the
+  prefill/decode leg called out for disaggregated pools) inferred from
+  the events it emitted.
 
 Files left unterminated by a killed process (trailing ``},`` with no
 closing ``]``) are repaired on read.
@@ -62,10 +69,11 @@ def _trace_id_of(ev: dict) -> str | None:
     return None
 
 
-def _flow_event(ph: str, flow_id: int, ev: dict) -> dict:
+def _flow_event(ph: str, flow_id: int, ev: dict,
+                name: str = "request", cat: str = "request_flow") -> dict:
     out = {
-        "name": "request",
-        "cat": "request_flow",
+        "name": name,
+        "cat": cat,
         "ph": ph,
         "id": flow_id,
         "ts": ev.get("ts", 0),
@@ -75,6 +83,48 @@ def _flow_event(ph: str, flow_id: int, ev: dict) -> dict:
     if ph == "f":
         out["bp"] = "e"  # bind to the enclosing slice's end
     return out
+
+
+# Engine-side request phase spans (engine_core.py). A request whose
+# phase spans come from two different pids crossed an engine boundary
+# mid-flight — the disaggregated prefill->decode handoff.
+_PHASE_SPANS = ("queue", "prefill", "decode")
+
+
+def _handoff_flows(by_trace: dict[str, list[dict]]) -> tuple[list[dict],
+                                                             dict[int, str]]:
+    """Direct prefill-leg -> decode-leg arrows for handed-off requests.
+
+    Returns (flow events, pid -> leg-role hints). The generic request
+    flow threads through every pid in time order (frontend included);
+    these arrows connect the legs engine-to-engine so the handoff reads
+    as one request, and the role hints let process naming call out which
+    engine served which leg.
+    """
+    flows: list[dict] = []
+    leg_roles: dict[int, set] = {}
+    for trace_id, evs in by_trace.items():
+        legs: list[tuple[int, list[dict]]] = []  # (pid, phase events)
+        for ev in evs:  # already ts-sorted by the caller
+            if ev.get("name") in _PHASE_SPANS and ev.get("ph") in ("b", "e"):
+                pid = ev.get("pid", 0)
+                if not legs or legs[-1][0] != pid:
+                    legs.append((pid, []))
+                legs[-1][1].append(ev)
+        if len(legs) < 2:
+            continue
+        for i, ((from_pid, prev), (to_pid, nxt)) in enumerate(
+                zip(legs, legs[1:])):
+            flow_id = abs(hash((trace_id, "handoff", i))) % 2**31
+            flows.append(_flow_event(
+                "s", flow_id, prev[-1], name="handoff", cat="disagg_flow"))
+            flows.append(_flow_event(
+                "f", flow_id, nxt[0], name="handoff", cat="disagg_flow"))
+            leg_roles.setdefault(from_pid, set()).add("prefill leg")
+            leg_roles.setdefault(to_pid, set()).add("decode leg")
+    return flows, {
+        pid: "/".join(sorted(roles)) for pid, roles in leg_roles.items()
+    }
 
 
 def merge(trace_dir: str) -> dict:
@@ -120,14 +170,22 @@ def merge(trace_dir: str) -> dict:
                 last_pid = ev.get("pid")
         flows.append(_flow_event("f", flow_id, evs[-1]))
 
+    # Disagg handoffs: stitch multi-engine legs of one request together.
+    handoff_flows, leg_roles = _handoff_flows(by_trace)
+    flows.extend(handoff_flows)
+
     # Name each process by the categories it emitted: engine-step spans
-    # only come from an engine core; a pure frontend has none.
+    # only come from an engine core; a pure frontend has none. Engines
+    # that served a handoff leg get the leg role appended.
     pid_cats: dict[int, set] = {}
     for ev in events:
         pid_cats.setdefault(ev.get("pid", 0), set()).add(ev.get("cat"))
     meta = []
     for pid, cats in sorted(pid_cats.items()):
         role = "engine-core" if "engine" in cats else "frontend"
+        leg = leg_roles.get(pid)
+        if leg:
+            role = f"{role}, {leg}"
         meta.append({
             "name": "process_name", "ph": "M", "pid": pid, "tid": 0,
             "args": {"name": f"vllm-tpu {role} (pid {pid})"},
@@ -157,8 +215,10 @@ def main(argv: list[str] | None = None) -> int:
         json.dump(merged, f)
     n_req = sum(1 for ev in merged["traceEvents"]
                 if ev.get("ph") == "s" and ev.get("cat") == "request_flow")
+    n_handoff = sum(1 for ev in merged["traceEvents"]
+                    if ev.get("ph") == "s" and ev.get("cat") == "disagg_flow")
     print(f"wrote {out}: {len(merged['traceEvents'])} events, "
-          f"{n_req} request flows")
+          f"{n_req} request flows, {n_handoff} disagg handoffs")
     return 0
 
 

@@ -12,6 +12,11 @@ asserts the books balance:
 - the ``vllm:requests_shed_total`` counter delta on ``/metrics``
   equals the number of 429/503 responses observed by the client.
 
+The burst alternates ``X-SLO-Class`` labels (interactive/batch) across
+requests and reports served/shed split per class — admission today is
+class-blind, so roughly proportional sheds are expected; this makes the
+mixed-traffic behavior visible before priority handling lands.
+
 Three modes:
 
 - default (no flags): self-contained — builds a tiny random-weight
@@ -57,14 +62,25 @@ def _shed_total(metrics_text: str) -> float:
     return total
 
 
+# Mixed-tenant burst labels: request i carries BURST_CLASSES[i % 2] in
+# its X-SLO-Class header, so per-class accounting always sees both.
+BURST_CLASSES = ("interactive", "batch")
+
+
 async def _burst(session, base_url: str, n: int,
-                 max_tokens: int) -> tuple[int, int, list[str]]:
-    """Returns (served, shed, errors)."""
+                 max_tokens: int) -> tuple[int, int, list[str], dict]:
+    """Returns (served, shed, errors, by_class).
+
+    ``by_class`` maps slo_class -> {"served": n, "shed": n}."""
     served = shed = 0
     errors: list[str] = []
+    by_class: dict[str, dict] = {
+        cls: {"served": 0, "shed": 0} for cls in BURST_CLASSES
+    }
 
     async def one(i: int) -> None:
         nonlocal served, shed
+        cls = BURST_CLASSES[i % len(BURST_CLASSES)]
         # Token-id prompt: valid OpenAI completions form, and works
         # against tokenizer-less selftest checkpoints too.
         body = {
@@ -77,12 +93,15 @@ async def _burst(session, base_url: str, n: int,
         try:
             async with session.post(
                 f"{base_url}/v1/completions", json=body,
+                headers={"X-SLO-Class": cls},
             ) as resp:
                 payload = await resp.json()
                 if resp.status == 200:
                     served += 1
+                    by_class[cls]["served"] += 1
                 elif resp.status in (429, 503):
                     shed += 1
+                    by_class[cls]["shed"] += 1
                     if "Retry-After" not in resp.headers:
                         errors.append(
                             f"req {i}: shed ({resp.status}) without a "
@@ -100,7 +119,12 @@ async def _burst(session, base_url: str, n: int,
             errors.append(f"req {i}: transport error {type(e).__name__}: {e}")
 
     await asyncio.gather(*[one(i) for i in range(n)])
-    return served, shed, errors
+    return served, shed, errors, by_class
+
+
+def _print_by_class(by_class: dict) -> None:
+    for cls, c in sorted(by_class.items()):
+        print(f"  class={cls}: served={c['served']} shed={c['shed']}")
 
 
 async def _run_against(session, base_url: str, burst: int,
@@ -108,13 +132,15 @@ async def _run_against(session, base_url: str, burst: int,
     async with session.get(f"{base_url}/metrics") as resp:
         shed_before = _shed_total(await resp.text())
 
-    served, shed, errors = await _burst(session, base_url, burst, max_tokens)
+    served, shed, errors, by_class = await _burst(
+        session, base_url, burst, max_tokens)
 
     async with session.get(f"{base_url}/metrics") as resp:
         shed_after = _shed_total(await resp.text())
 
     print(f"burst={burst} served={served} shed={shed} "
           f"shed_counter_delta={shed_after - shed_before:g}")
+    _print_by_class(by_class)
     for err in errors:
         print(f"ERROR: {err}")
     if errors:
@@ -194,13 +220,14 @@ async def _multi_burst(base_url: str, admin_urls: list[str], burst: int,
 
     async with aiohttp.ClientSession() as session:
         shed_before = await _shard_metrics_total(session, admin_urls)
-        served, shed, errors = await _burst(
+        served, shed, errors, by_class = await _burst(
             session, base_url, burst, max_tokens)
         shed_after = await _shard_metrics_total(session, admin_urls)
 
     print(f"burst={burst} served={served} shed={shed} "
           f"shard_shed_delta={shed_after - shed_before:g} "
           f"shards={len(admin_urls)}")
+    _print_by_class(by_class)
     for err in errors:
         print(f"ERROR: {err}")
     if errors:

@@ -1,0 +1,267 @@
+#!/usr/bin/env python3
+"""Replay a request trace over HTTP against a live vllm-tpu pool.
+
+The HTTP twin of ``vllm-tpu bench trace``: loads a ``--request-trace-dir``
+recording (or synthesizes a mixed-tenant trace), re-sends each request as
+a streaming ``/v1/completions`` call carrying its ``X-SLO-Class`` /
+``X-Tenant-Id`` headers, open-loop at the recorded (or ``--qps-scale``d)
+arrival times, and emits the same SLO scoreboard artifact: per-class
+p50/p99 TTFT and ITL, attainment against ``--slo`` targets, goodput,
+and per-class shed/timeout counts.
+
+Because requests go through the real frontend — admission control,
+header parsing, SSE streaming, and (with ``--api-server-count`` > 1)
+the shared-port load balancer — this measures what a tenant actually
+sees, where ``bench trace`` measures the engine in isolation.
+
+Modes:
+
+- ``--base-url http://host:port``: replay against a live server;
+- default (no ``--base-url``): self-contained — builds a tiny
+  random-weight checkpoint, an in-proc AsyncLLM, and drives the real
+  aiohttp app through aiohttp's test server (same wiring as
+  ``tools/overload_smoke.py``).
+
+Run: ``JAX_PLATFORMS=cpu python tools/serve_replay.py``
+Exit 0 when every replayed request resolved (served or cleanly shed).
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import os
+import sys
+import tempfile
+import time
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO_ROOT not in sys.path:
+    sys.path.insert(0, _REPO_ROOT)
+
+
+def _load_records(args) -> tuple[list[dict], str]:
+    from vllm_tpu.benchmarks.run import DEFAULT_TRACE_MIX, _parse_trace_classes
+    from vllm_tpu.metrics.reqtrace import load_trace, synthesize_trace
+
+    if args.trace:
+        return load_trace(args.trace), args.trace
+    records = synthesize_trace(
+        _parse_trace_classes(args.trace_classes or DEFAULT_TRACE_MIX),
+        num_requests=args.num_requests,
+        qps=args.qps,
+        seed=args.seed,
+    )
+    return records, "synthetic"
+
+
+async def _replay(session, base_url: str, records: list[dict], *,
+                  slo, qps_scale: float, model: str,
+                  vocab: int) -> tuple[dict, list[str]]:
+    from vllm_tpu.benchmarks.run import score_replay
+    from vllm_tpu.entrypoints.openai.api_server import (
+        SLO_CLASS_HEADER,
+        TENANT_HEADER,
+    )
+    from vllm_tpu.metrics.reqtrace import replay_prompt_token_ids
+    from vllm_tpu.metrics.stats import DEFAULT_SLO_CLASS
+
+    scale = qps_scale if qps_scale > 0 else 1.0
+    base_off = records[0].get("arrival_offset_s") or 0.0
+    # (slo_label, tenant_id, ttft_ms, itls_ms, out_tokens, timed_out)
+    done: list[tuple] = []
+    shed: dict[str, int] = {}
+    errors: list[str] = []
+
+    async def one(i: int, rec: dict, t0: float) -> None:
+        offset = max(
+            0.0, ((rec.get("arrival_offset_s") or 0.0) - base_off) / scale)
+        await asyncio.sleep(max(0.0, t0 + offset - time.monotonic()))
+        label = rec.get("slo_class") or DEFAULT_SLO_CLASS
+        s = rec.get("sampling") or {}
+        out_len = int(rec.get("output_len") or s.get("max_tokens") or 16)
+        body = {
+            "model": model,
+            "prompt": replay_prompt_token_ids(rec, vocab),
+            "max_tokens": max(1, out_len),
+            "ignore_eos": True,
+            "temperature": float(s.get("temperature") or 0.0),
+            "stream": True,
+        }
+        headers = {}
+        if rec.get("slo_class"):
+            headers[SLO_CLASS_HEADER] = rec["slo_class"]
+        if rec.get("tenant_id"):
+            headers[TENANT_HEADER] = rec["tenant_id"]
+        ts = time.monotonic()
+        first = None
+        last = ts
+        itls: list[float] = []
+        ntok = 0
+        finish = None
+        try:
+            async with session.post(
+                f"{base_url}/v1/completions", json=body, headers=headers,
+            ) as resp:
+                if resp.status in (429, 503):
+                    shed[label] = shed.get(label, 0) + 1
+                    await resp.read()
+                    return
+                if resp.status != 200:
+                    errors.append(
+                        f"req {i}: unexpected status {resp.status}: "
+                        f"{(await resp.text())[:200]!r}")
+                    return
+                async for raw in resp.content:
+                    line = raw.decode("utf-8", errors="replace").strip()
+                    if not line.startswith("data:"):
+                        continue
+                    payload = line[len("data:"):].strip()
+                    if payload == "[DONE]":
+                        break
+                    t = time.monotonic()
+                    choice = (json.loads(payload).get("choices") or [{}])[0]
+                    # Every SSE data event is a decode-step event (the
+                    # server emits one per step even when the delta
+                    # text is empty, e.g. tokenizer-less checkpoints).
+                    if first is None:
+                        first = (t - ts) * 1000.0
+                    else:
+                        itls.append((t - last) * 1000.0)
+                    last = t
+                    ntok += 1
+                    if choice.get("finish_reason"):
+                        finish = choice["finish_reason"]
+        except Exception as e:  # noqa: BLE001 - accounting, not handling
+            errors.append(f"req {i}: transport error {type(e).__name__}: {e}")
+            return
+        done.append((label, rec.get("tenant_id"), first, itls, ntok,
+                     finish == "timeout"))
+
+    t0 = time.monotonic()
+    await asyncio.gather(*[one(i, rec, t0) for i, rec in enumerate(records)])
+    wall = time.monotonic() - t0
+
+    result = score_replay(done, shed, wall, slo,
+                          num_requests=len(records))
+    result["qps_scale"] = scale
+    result["transport"] = "http"
+    return result, errors
+
+
+async def _remote(args, records: list[dict], slo) -> int:
+    import aiohttp
+
+    async with aiohttp.ClientSession() as session:
+        result, errors = await _replay(
+            session, args.base_url.rstrip("/"), records, slo=slo,
+            qps_scale=args.qps_scale, model=args.model, vocab=args.vocab)
+    return _finish(args, result, errors)
+
+
+async def _selftest(args, records: list[dict], slo) -> int:
+    from aiohttp.test_utils import TestClient, TestServer
+
+    from tests.models.utils import tiny_llama_dir
+    from vllm_tpu.engine.arg_utils import AsyncEngineArgs
+    from vllm_tpu.engine.async_llm import AsyncLLM
+    from vllm_tpu.entrypoints.openai.api_server import build_app
+    from vllm_tpu.metrics.prometheus import PrometheusRegistry
+
+    with tempfile.TemporaryDirectory() as tmp:
+        ckpt = tiny_llama_dir(os.path.join(tmp, "ckpt"))
+        engine = AsyncLLM.from_engine_args(
+            AsyncEngineArgs(
+                model=ckpt,
+                dtype="float32",
+                max_model_len=128,
+                block_size=16,
+                num_gpu_blocks_override=64,
+                max_num_seqs=8,
+                max_num_batched_tokens=128,
+                slo_targets=args.slo,
+            )
+        )
+        try:
+            metrics = PrometheusRegistry(engine)
+            engine.stat_loggers.append(metrics)
+            app = build_app(engine, "replay", metrics)
+            async with TestClient(TestServer(app)) as client:
+                base = str(client.make_url("")).rstrip("/")
+                result, errors = await _replay(
+                    client.session, base, records, slo=slo,
+                    qps_scale=args.qps_scale, model="replay",
+                    vocab=args.vocab)
+        finally:
+            engine.shutdown()
+    return _finish(args, result, errors)
+
+
+def _finish(args, result: dict, errors: list[str]) -> int:
+    print(json.dumps(result, indent=2))
+    if args.json_out:
+        with open(args.json_out, "w") as f:
+            json.dump(result, f)
+    for err in errors:
+        print(f"ERROR: {err}", file=sys.stderr)
+    if errors:
+        return 2
+    if result["replayed"] + result["shed"] != result["num_requests"]:
+        print(f"FAIL: replayed {result['replayed']} + shed "
+              f"{result['shed']} != {result['num_requests']} requests",
+              file=sys.stderr)
+        return 3
+    print(f"ok: {result['replayed']} replayed, {result['shed']} shed, "
+          f"{len(result['classes'])} SLO classes scored", file=sys.stderr)
+    return 0
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--base-url", default=None,
+                    help="replay against a live server instead of the "
+                         "in-proc selftest")
+    ap.add_argument("--trace", default=None,
+                    help="reqtrace-*.jsonl file or --request-trace-dir "
+                         "directory; omit to synthesize from "
+                         "--trace-classes")
+    ap.add_argument("--trace-classes", default=None,
+                    help="synthesis mix (see `vllm-tpu bench trace "
+                         "--trace-classes`)")
+    ap.add_argument("--num-requests", type=int, default=24,
+                    help="synthesis: number of requests")
+    ap.add_argument("--qps", type=float, default=8.0,
+                    help="synthesis: Poisson arrival rate")
+    ap.add_argument("--seed", type=int, default=0,
+                    help="synthesis: RNG seed")
+    ap.add_argument("--qps-scale", type=float, default=1.0,
+                    help="divide recorded inter-arrival gaps by this "
+                         "(2.0 = twice the recorded rate)")
+    ap.add_argument("--slo", default=None,
+                    help='per-class targets, e.g. "interactive=ttft:'
+                         '200ms,itl:50ms;batch=ttft:5s"')
+    ap.add_argument("--model", default="replay",
+                    help="model name sent in request bodies")
+    ap.add_argument("--vocab", type=int, default=30000,
+                    help="vocab bound for synthetic replay prompts")
+    ap.add_argument("--json", dest="json_out", default=None,
+                    help="also write the scoreboard JSON here")
+    args = ap.parse_args()
+
+    from vllm_tpu.metrics.goodput import parse_slo_spec
+
+    slo = parse_slo_spec(args.slo)
+    records, source = _load_records(args)
+    if not records:
+        print(f"error: no request records from {source!r}", file=sys.stderr)
+        return 1
+    print(f"replaying {len(records)} requests from {source} "
+          f"(qps_scale={args.qps_scale})", file=sys.stderr)
+    if args.base_url:
+        return asyncio.run(_remote(args, records, slo))
+    return asyncio.run(_selftest(args, records, slo))
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,4 +1,4 @@
-"""Benchmark harness: latency / throughput / serve / sessions.
+"""Benchmark harness: latency / throughput / serve / sessions / trace.
 
 Protocol mirrors the reference's `vllm bench {latency,throughput,serve}`
 (``vllm/benchmarks/``, .buildkite/performance-benchmarks-descriptions.md):
@@ -11,6 +11,11 @@ Protocol mirrors the reference's `vllm bench {latency,throughput,serve}`
                conversation) — the prefix-cache / KV-aware-routing
                workload: reports prefix-hit rate and the frontend's
                detokenizer CPU share alongside tok/s
+  trace      — replay a ``--request-trace-dir`` recording (or a
+               synthesized mixed-tenant trace) open-loop at its original
+               or ``--qps-scale``d arrival times, and emit the SLO
+               scoreboard: per-class TTFT/ITL percentiles, attainment
+               against ``--slo`` targets, goodput, shed/timeout counts
 """
 
 from __future__ import annotations
@@ -84,6 +89,8 @@ def run_bench(args) -> dict:
         return _run_serve(args, params)
     if args.mode == "sessions":
         return _run_sessions(args, params)
+    if args.mode == "trace":
+        return _run_trace(args)
 
     llm = _build_llm(args)
     # Warmup compile.
@@ -353,25 +360,7 @@ def _sessions_pass(engine, args, params, n_sessions: int, n_turns: int,
         routing = engine.routing_status()
         if routing is not None:
             result["routing_decisions"] = routing.get("decisions")
-        fab = getattr(engine, "kv_fabric_status", None)
-        fab = fab() if fab is not None else {}
-        if fab:
-            result["kv_fabric"] = {
-                "tier_hits": fab.get("tier_hits"),
-                "tier_blocks": fab.get("tier_blocks"),
-                "tier_bytes": fab.get("tier_bytes"),
-                "fetch": fab.get("fetch"),
-                "fetch_bytes": fab.get("fetch_bytes"),
-                "push_bytes": fab.get("push_bytes"),
-                "demotions": fab.get("demotions"),
-            }
-        dis = getattr(engine, "disagg_status", None)
-        dis = dis() if dis is not None else None
-        if dis and dis.get("active"):
-            result["disagg"] = {
-                "roles": dis.get("roles"),
-                "outcomes": dis.get("outcomes"),
-            }
+        _attach_engine_substatus(result, engine)
         return result
     finally:
         engine.shutdown()
@@ -434,3 +423,276 @@ def _serve_one(engine, args, params, qps: float, warmup: bool = False) -> dict:
         "e2e_p50_s": float(np.median(e2es)) if e2es else None,
     }
     return result
+
+
+def _attach_engine_substatus(result: dict, engine) -> None:
+    """Attach the kv-fabric / disagg sub-blocks to a scored result (the
+    scoreboard shows where time went, these show why)."""
+    fab = getattr(engine, "kv_fabric_status", None)
+    fab = fab() if fab is not None else {}
+    if fab:
+        result["kv_fabric"] = {
+            "tier_hits": fab.get("tier_hits"),
+            "tier_blocks": fab.get("tier_blocks"),
+            "tier_bytes": fab.get("tier_bytes"),
+            "fetch": fab.get("fetch"),
+            "fetch_bytes": fab.get("fetch_bytes"),
+            "push_bytes": fab.get("push_bytes"),
+            "demotions": fab.get("demotions"),
+        }
+    dis = getattr(engine, "disagg_status", None)
+    dis = dis() if dis is not None else None
+    if dis and dis.get("active"):
+        result["disagg"] = {
+            "roles": dis.get("roles"),
+            "outcomes": dis.get("outcomes"),
+        }
+
+
+# ---------------------------------------------------------------------------
+# `bench trace`: replay a recorded (or synthesized) trace -> SLO scoreboard.
+# ---------------------------------------------------------------------------
+
+# Default mixed-tenant synthesis when no --trace recording is given: a
+# latency-sensitive interactive class sharing the pool with a batch class.
+DEFAULT_TRACE_MIX = (
+    "interactive=share:0.7,prompt:32,output:16,tenant:acme;"
+    "batch=share:0.3,prompt:64,output:48,tenant:bulk"
+)
+
+
+def _parse_trace_classes(spec: str) -> list[dict]:
+    """``"interactive=share:0.7,prompt:32,output:16,tenant:acme;..."``
+    -> class entries for :func:`synthesize_trace`."""
+    classes: list[dict] = []
+    for clause in (spec or "").split(";"):
+        clause = clause.strip()
+        if not clause:
+            continue
+        name, eq, body = clause.partition("=")
+        name = name.strip()
+        if not eq or not name:
+            raise ValueError(
+                f"trace class clause needs '<name>=...': {clause!r}")
+        entry: dict = {"slo_class": name, "tenant_id": None, "share": 1.0,
+                       "prompt_len": 32, "max_tokens": 16}
+        for item in body.split(","):
+            item = item.strip()
+            if not item:
+                continue
+            key, _, val = item.partition(":")
+            key, val = key.strip(), val.strip()
+            if key == "share":
+                entry["share"] = float(val)
+            elif key == "prompt":
+                entry["prompt_len"] = int(val)
+            elif key == "output":
+                entry["max_tokens"] = int(val)
+            elif key == "tenant":
+                entry["tenant_id"] = val or None
+            else:
+                raise ValueError(
+                    f"unknown trace-class key {key!r} in {clause!r} "
+                    "(expected share/prompt/output/tenant)")
+        classes.append(entry)
+    return classes
+
+
+def _run_trace(args) -> dict:
+    from vllm_tpu.engine.arg_utils import AsyncEngineArgs
+    from vllm_tpu.engine.async_llm import AsyncLLM
+    from vllm_tpu.metrics.goodput import parse_slo_spec
+    from vllm_tpu.metrics.reqtrace import load_trace, synthesize_trace
+
+    if args.trace:
+        records = load_trace(args.trace)
+        source = args.trace
+    else:
+        qps = args.qps if args.qps > 0 else 8.0
+        records = synthesize_trace(
+            _parse_trace_classes(args.trace_classes or DEFAULT_TRACE_MIX),
+            num_requests=args.num_prompts, qps=qps,
+            seed=getattr(args, "seed", None) or 0,
+        )
+        source = "synthetic"
+    if not records:
+        raise SystemExit(f"bench trace: no request records from {source!r}")
+
+    fields = {f.name for f in __import__("dataclasses").fields(AsyncEngineArgs)}
+    engine_args = AsyncEngineArgs(
+        **{k: v for k, v in vars(args).items() if k in fields}
+    )
+    engine = AsyncLLM.from_engine_args(engine_args)
+    try:
+        result = replay_trace(
+            engine, records,
+            slo=parse_slo_spec(getattr(args, "slo", None)),
+            qps_scale=getattr(args, "qps_scale", 1.0) or 1.0,
+        )
+        result["trace"] = source
+        _emit(result, args.json_out)
+        return result
+    finally:
+        engine.shutdown()
+
+
+def replay_trace(engine, records: list[dict], *, slo=None,
+                 qps_scale: float = 1.0, vocab: int = 30000,
+                 warmup: bool = True) -> dict:
+    """Replay trace ``records`` open-loop against an AsyncLLM engine and
+    score the run per SLO class.
+
+    Arrival offsets are rebased to the first record and divided by
+    ``qps_scale`` (2.0 = twice the recorded rate). Each request re-sends
+    the recorded sampling knobs, its SLO/tenant labels, and a
+    deterministic synthetic prompt of the recorded length; decode length
+    is pinned to the recorded ``output_len`` (ignore_eos) so the replay
+    reproduces the recorded schedule shape. Returns the scoreboard:
+    per-class p50/p99 TTFT and ITL, attainment against ``slo`` targets
+    (from :func:`~vllm_tpu.metrics.goodput.parse_slo_spec`), goodput,
+    and per-class shed/timeout counts.
+    """
+    from vllm_tpu.metrics.reqtrace import replay_prompt_token_ids
+    from vllm_tpu.metrics.stats import DEFAULT_SLO_CLASS
+    from vllm_tpu.sampling_params import RequestOutputKind, SamplingParams
+
+    scale = qps_scale if qps_scale > 0 else 1.0
+    base = records[0].get("arrival_offset_s") or 0.0
+    jobs = []
+    for i, rec in enumerate(records):
+        s = rec.get("sampling") or {}
+        out_len = int(rec.get("output_len") or s.get("max_tokens") or 16)
+        sp = SamplingParams(
+            temperature=float(s.get("temperature") or 0.0),
+            top_p=float(s.get("top_p") or 1.0),
+            top_k=int(s.get("top_k") or 0),
+            min_p=float(s.get("min_p") or 0.0),
+            max_tokens=max(1, out_len),
+            ignore_eos=True,
+            seed=s.get("seed"),
+            slo_class=rec.get("slo_class"),
+            tenant_id=rec.get("tenant_id"),
+            output_kind=RequestOutputKind.DELTA,
+        )
+        offset = max(
+            0.0, ((rec.get("arrival_offset_s") or 0.0) - base) / scale)
+        jobs.append((i, rec, sp, offset))
+
+    # (slo_label, tenant_id, ttft_ms, itls_ms, out_tokens, timed_out)
+    done: list[tuple] = []
+    shed: dict[str, int] = {}
+
+    async def one(i, rec, sp, offset, t0):
+        await asyncio.sleep(max(0.0, t0 + offset - time.monotonic()))
+        label = rec.get("slo_class") or DEFAULT_SLO_CLASS
+        prompt = {"prompt_token_ids": replay_prompt_token_ids(rec, vocab)}
+        ts = time.monotonic()
+        first = None
+        last = ts
+        itls: list[float] = []
+        ntok = 0
+        finish = None
+        try:
+            async for out in engine.generate(prompt, sp, f"replay-{i}"):
+                t = time.monotonic()
+                if out.outputs[0].token_ids:
+                    if first is None:
+                        first = (t - ts) * 1000.0
+                    else:
+                        itls.append((t - last) * 1000.0)
+                    last = t
+                    ntok += len(out.outputs[0].token_ids)
+                if out.outputs[0].finish_reason is not None:
+                    finish = out.outputs[0].finish_reason
+        except Exception:
+            # Admission control (RequestShedError) or an engine failure:
+            # either way the request got no service — count it shed.
+            shed[label] = shed.get(label, 0) + 1
+            return
+        done.append((label, rec.get("tenant_id"), first, itls, ntok,
+                     finish == "timeout"))
+
+    async def warmup_one():
+        wp = SamplingParams(temperature=0.0, max_tokens=2, ignore_eos=True,
+                            output_kind=RequestOutputKind.DELTA)
+        async for _ in engine.generate(
+                {"prompt_token_ids": [3, 5, 7, 11]}, wp, "replay-warmup"):
+            pass
+
+    async def driver():
+        t0 = time.monotonic()
+        await asyncio.gather(*[
+            one(i, rec, sp, off, t0) for i, rec, sp, off in jobs])
+        return time.monotonic() - t0
+
+    if warmup:
+        asyncio.run(warmup_one())
+    wall = asyncio.run(driver())
+
+    result = score_replay(done, shed, wall, slo,
+                          num_requests=len(records))
+    result["qps_scale"] = scale
+    live = getattr(engine, "slo_status", None)
+    live = live() if live is not None else None
+    if live is not None:
+        result["live_slo"] = live
+    _attach_engine_substatus(result, engine)
+    return result
+
+
+def score_replay(done: list[tuple], shed: dict[str, int], wall: float,
+                 slo=None, *, num_requests: int) -> dict:
+    """Assemble the SLO scoreboard from replay measurements.
+
+    ``done`` entries are ``(slo_label, tenant_id, ttft_ms, itls_ms,
+    out_tokens, timed_out)``; ``shed`` maps class label -> requests that
+    got no service. Shared by the in-proc ``bench trace`` mode and the
+    HTTP replayer (``tools/serve_replay.py``) so both emit the same
+    artifact shape.
+    """
+    from vllm_tpu.metrics.goodput import class_scoreboard, request_meets_slo
+
+    slo = slo or {}
+    classes = class_scoreboard(
+        [{"slo_class": d[0], "ttft_ms": d[2], "itls_ms": d[3]}
+         for d in done],
+        slo,
+    )
+    for block in classes.values():
+        block["shed"] = 0
+        block["timeouts"] = 0
+    for d in done:
+        if d[5]:
+            classes[d[0]]["timeouts"] += 1
+    for label, n in shed.items():
+        block = classes.setdefault(
+            label, {"requests": 0, "shed": 0, "timeouts": 0})
+        block["shed"] = n
+
+    # Goodput: output tokens from requests NOT violating their class SLO
+    # (requests in a class with no targets are not penalized).
+    out_tokens = 0
+    good_tokens = 0
+    by_tenant: dict[str, int] = {}
+    for label, tenant, ttft_ms, itls, ntok, _timed_out in done:
+        out_tokens += ntok
+        if request_meets_slo(ttft_ms, itls, slo.get(label)) is not False:
+            good_tokens += ntok
+        key = tenant or "-"
+        by_tenant[key] = by_tenant.get(key, 0) + 1
+
+    return {
+        "mode": "trace",
+        "num_requests": num_requests,
+        "replayed": len(done),
+        "shed": sum(shed.values()),
+        "elapsed_s": round(wall, 3),
+        "request_throughput": (
+            round(len(done) / wall, 3) if wall > 0 else None),
+        "output_token_throughput": (
+            round(out_tokens / wall, 3) if wall > 0 else None),
+        "goodput_tokens_per_s": (
+            round(good_tokens / wall, 3) if wall > 0 else None),
+        "classes": classes,
+        "by_tenant": dict(sorted(by_tenant.items())),
+    }

@@ -442,6 +442,14 @@ class ObservabilityConfig:
     # Continuous idle seconds before the engine counts as "quiet"
     # (eligible for an A/B replay).
     perfwatch_quiet_settle_s: float = 2.0
+    # SLO scoreboard (vllm_tpu/metrics/reqtrace.py): directory for the
+    # append-only request-trace JSONL. None = capture fully disabled
+    # (no recorder object, no per-request work).
+    request_trace_dir: str | None = None
+    # Per-class latency targets for the live sliding-window
+    # vllm:slo_attainment{slo_class} gauge, e.g.
+    # "interactive=ttft:200ms,itl:50ms;batch=ttft:5s". None = gauge off.
+    slo_targets: str | None = None
 
 
 @dataclass

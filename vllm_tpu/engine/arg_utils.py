@@ -146,6 +146,11 @@ class EngineArgs:
     perfwatch_capture_steps: int = 8
     perfwatch_ab_steps: int = 8
     perfwatch_quiet_settle_s: float = 2.0
+    # SLO scoreboard: request-trace capture directory (None = off) and
+    # the per-class latency targets feeding the live attainment gauge
+    # ("interactive=ttft:200ms,itl:50ms;batch=ttft:5s").
+    request_trace_dir: str | None = None
+    slo_targets: str | None = None
     precompile: bool = False
     # Cap on token-bucket x request-bucket step compilations (derived
     # bucket ladders are thinned to fit; see CompilationConfig).
@@ -245,6 +250,8 @@ class EngineArgs:
                 perfwatch_capture_steps=self.perfwatch_capture_steps,
                 perfwatch_ab_steps=self.perfwatch_ab_steps,
                 perfwatch_quiet_settle_s=self.perfwatch_quiet_settle_s,
+                request_trace_dir=self.request_trace_dir,
+                slo_targets=self.slo_targets,
             ),
             compilation_config=CompilationConfig(
                 precompile=self.precompile,

@@ -179,9 +179,25 @@ class AsyncLLM:
         self.engine_core = client if client is not None else (
             make_client(config))
         self.input_processor = InputProcessor(config)
+        # SLO scoreboard: optional request-trace capture + live per-class
+        # attainment targets (vllm_tpu/metrics/reqtrace, metrics/goodput).
+        # Both default off, leaving the output processor's per-request
+        # path untouched.
+        obs = config.observability_config
+        self.reqtrace = None
+        if obs.request_trace_dir:
+            from vllm_tpu.metrics.reqtrace import RequestTraceRecorder
+
+            self.reqtrace = RequestTraceRecorder(obs.request_trace_dir)
+        slo_targets = None
+        if obs.slo_targets:
+            from vllm_tpu.metrics.goodput import parse_slo_spec
+
+            slo_targets = parse_slo_spec(obs.slo_targets)
         self.output_processor = OutputProcessor(
             self.input_processor.tokenizer, journal=self.journal,
             on_request_closed=self._on_request_closed,
+            reqtrace=self.reqtrace, slo_targets=slo_targets,
         )
         self.stat_loggers: list[Any] = []
 
@@ -814,7 +830,28 @@ class AsyncLLM:
         requests (state, age, tokens emitted, KV blocks held) plus the
         bounded ring of recently finished requests with their per-phase
         timing breakdown."""
-        return self.output_processor.debug_snapshot()
+        snapshot = self.output_processor.debug_snapshot()
+        slo = self.slo_status()
+        if slo is not None:
+            snapshot["slo"] = slo
+        return snapshot
+
+    def slo_status(self) -> dict | None:
+        """SLO scoreboard snapshot: per-class sliding-window attainment
+        (when targets are configured) and trace-capture counters (when
+        recording). None when both are off — the scoreboard then has no
+        live state to report."""
+        op = self.output_processor
+        reqtrace = getattr(self, "reqtrace", None)
+        if reqtrace is None and not op.slo_targets:
+            return None
+        status: dict = {
+            "targets": op.slo_targets or None,
+            "attainment": op.slo_attainment_snapshot(),
+        }
+        if reqtrace is not None:
+            status["trace"] = reqtrace.status()
+        return status
 
     def is_ready(self) -> bool:
         """All engines initialized and up (readiness, distinct from
@@ -837,3 +874,8 @@ class AsyncLLM:
         if self._thread is not None:
             self._thread.join(timeout=5)
         self.engine_core.shutdown()
+        # getattr: resilience tests build AsyncLLM via __new__, skipping
+        # __init__ (and with it the recorder wiring).
+        reqtrace = getattr(self, "reqtrace", None)
+        if reqtrace is not None:
+            reqtrace.close()

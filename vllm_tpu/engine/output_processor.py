@@ -40,6 +40,17 @@ class RequestState:
         self.prompt_text = prompt_text
         self.prompt_token_ids = prompt_token_ids
         self.params = params
+        # Effective SLO class label for per-class telemetry (unlabeled
+        # requests land in DEFAULT_SLO_CLASS so classes partition
+        # traffic). A reference to an existing string — no allocation.
+        from vllm_tpu.metrics.stats import DEFAULT_SLO_CLASS
+
+        self.slo_label = params.slo_class or DEFAULT_SLO_CLASS
+        # Per-request ITL samples (ms), kept ONLY when the processor
+        # needs per-request verdicts (trace recording or configured SLO
+        # targets); None otherwise so the default hot path allocates
+        # nothing per token.
+        self.itl_track: list[float] | None = None
         self.detokenizer = IncrementalDetokenizer(
             tokenizer if params.detokenize else None, prompt_token_ids, params
         )
@@ -116,12 +127,27 @@ class ProcessedOutputs:
 class OutputProcessor:
     # Recently finished requests kept for /debug/requests introspection.
     FINISHED_RING_SIZE = 128
+    # Sliding window of per-request SLO verdicts feeding the
+    # vllm:slo_attainment{slo_class} gauge.
+    SLO_WINDOW_SIZE = 512
 
     def __init__(self, tokenizer: Any | None = None,
                  journal: Any | None = None,
-                 on_request_closed: Any | None = None) -> None:
+                 on_request_closed: Any | None = None,
+                 reqtrace: Any | None = None,
+                 slo_targets: dict | None = None) -> None:
         self.tokenizer = tokenizer
         self.request_states: dict[str, RequestState] = {}
+        # Request-trace recorder (vllm_tpu/metrics/reqtrace); None keeps
+        # the capture path entirely out of the per-request flow.
+        self.reqtrace = reqtrace
+        # Parsed per-class SLO targets ({class: {"ttft_ms", "itl_ms"}})
+        # for the live attainment gauge; {} / None disables it.
+        self.slo_targets = slo_targets or {}
+        # (slo_class, met: bool) verdicts for recently finished requests.
+        self.slo_window: deque = deque(maxlen=self.SLO_WINDOW_SIZE)
+        # Whether finish-time verdicts need per-request ITL samples.
+        self._track_itls = reqtrace is not None or bool(self.slo_targets)
         # Lifecycle hook: called with the request_id whenever a request's
         # frontend state is removed (finish, abort, crash-fail) — the
         # AdmissionController releases its capacity reservation here.
@@ -158,6 +184,8 @@ class OutputProcessor:
             queue,
             trace_id=trace_id,
         )
+        if self._track_itls:
+            state.itl_track = []
         self.request_states[request_id] = state
         # Frontend-side end-to-end request span: opened at admission,
         # closed when the final output is processed (its engine-side
@@ -216,11 +244,15 @@ class OutputProcessor:
                 if state.metrics.first_token_time is None:
                     state.metrics.first_token_time = now
                     stats.num_prompt_tokens += len(state.prompt_token_ids)
-                    stats.ttfts.append(now - state.metrics.arrival_time)
+                    ttft = now - state.metrics.arrival_time
+                    stats.ttfts.append(ttft)
+                    stats.ttfts_by_class.append((state.slo_label, ttft))
                 else:
-                    stats.inter_token_latencies.append(
-                        now - state.last_token_time
-                    )
+                    itl = now - state.last_token_time
+                    stats.inter_token_latencies.append(itl)
+                    stats.itls_by_class.append((state.slo_label, itl))
+                    if state.itl_track is not None:
+                        state.itl_track.append(itl * 1000.0)
                 state.last_token_time = now
 
             t_detok = time.perf_counter()
@@ -294,9 +326,11 @@ class OutputProcessor:
             if queue_s is not None:
                 prefill_s = max(0.0, prefill_s - queue_s)
             decode_s = max(0.0, state.last_token_time - m.first_token_time)
-        self.finished_timings.append(RequestTimings(
+        timings = RequestTimings(
             request_id=state.request_id,
             trace_id=state.trace_id,
+            slo_class=state.params.slo_class,
+            tenant_id=state.params.tenant_id,
             arrival_time=m.arrival_time,
             finished_time=now,
             finish_reason=finish_reason,
@@ -309,7 +343,23 @@ class OutputProcessor:
             decode_s=decode_s,
             detokenize_s=state.detokenize_s,
             e2e_s=max(0.0, now - m.arrival_time),
-        ))
+        )
+        self.finished_timings.append(timings)
+        ttft_ms = m.ttft * 1000.0 if m.ttft is not None else None
+        if self.slo_targets:
+            from vllm_tpu.metrics.goodput import request_meets_slo
+
+            met = request_meets_slo(
+                ttft_ms, state.itl_track or [],
+                self.slo_targets.get(state.slo_label),
+            )
+            if met is not None:
+                self.slo_window.append((state.slo_label, met))
+        if self.reqtrace is not None:
+            self.reqtrace.record_request(
+                timings, state.params, ttft_ms=ttft_ms,
+                itls_ms=state.itl_track,
+            )
 
     def debug_snapshot(self) -> dict:
         """In-flight + recently-finished request views (JSON-shaped; the
@@ -328,6 +378,8 @@ class OutputProcessor:
             in_flight.append({
                 "request_id": state.request_id,
                 "trace_id": state.trace_id,
+                "slo_class": state.params.slo_class,
+                "tenant_id": state.params.tenant_id,
                 "state": phase,
                 "age_s": max(0.0, now - m.arrival_time),
                 "num_prompt_tokens": len(state.prompt_token_ids),
@@ -347,6 +399,20 @@ class OutputProcessor:
             "num_in_flight": len(in_flight),
             "in_flight": in_flight,
             "recently_finished": recent,
+        }
+
+    def slo_attainment_snapshot(self) -> dict[str, dict]:
+        """Per-class attainment over the sliding verdict window:
+        ``{class: {"attainment": fraction, "window": n}}``. Empty when
+        no SLO targets are configured (the gauge then has nothing to
+        say). Thread-safe: iterates a list() copy of the deque."""
+        counts: dict[str, list[int]] = {}
+        for cls, met in list(self.slo_window):
+            met_n, total = counts.setdefault(cls, [0, 0])
+            counts[cls] = [met_n + int(met), total + 1]
+        return {
+            cls: {"attainment": round(met_n / total, 4), "window": total}
+            for cls, (met_n, total) in sorted(counts.items())
         }
 
     def _append_prompt_logprobs(self, state: RequestState, delta) -> None:

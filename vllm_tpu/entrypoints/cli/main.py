@@ -65,9 +65,10 @@ def _run_complete(args: argparse.Namespace) -> None:
 
 def _add_bench(sub: argparse._SubParsersAction) -> None:
     p = sub.add_parser(
-        "bench", help="Benchmarks (latency/throughput/serve/sessions)")
+        "bench", help="Benchmarks (latency/throughput/serve/sessions/trace)")
     p.add_argument("mode",
-                   choices=["latency", "throughput", "serve", "sessions"])
+                   choices=["latency", "throughput", "serve", "sessions",
+                            "trace"])
     p.add_argument("--json", dest="json_out", default=None)
     EngineArgs.add_cli_args(p)
     p.add_argument("--num-prompts", type=int, default=100)
@@ -97,6 +98,29 @@ def _add_bench(sub: argparse._SubParsersAction) -> None:
         "--turns-per-session", type=int, default=4,
         help="sessions mode: turns per chat (each turn re-sends the "
              "growing conversation — the prefix-cache workload)",
+    )
+    p.add_argument(
+        "--trace", default=None,
+        help="trace mode: a reqtrace-*.jsonl file or a "
+             "--request-trace-dir directory to replay; omit to "
+             "synthesize a mixed-tenant trace from --trace-classes",
+    )
+    p.add_argument(
+        "--trace-classes", default=None,
+        help='trace mode synthesis mix, e.g. "interactive=share:0.7,'
+             'prompt:32,output:16,tenant:acme;batch=share:0.3,...." '
+             "(uses --num-prompts and --qps)",
+    )
+    p.add_argument(
+        "--qps-scale", type=float, default=1.0,
+        help="trace mode: divide recorded inter-arrival gaps by this "
+             "(2.0 = replay at twice the recorded rate)",
+    )
+    p.add_argument(
+        "--slo", default=None,
+        help='SLO targets per class, e.g. "interactive=ttft:200ms,'
+             'itl:50ms;batch=ttft:5s" — scored in the trace-mode '
+             "scoreboard",
     )
     p.set_defaults(func=_run_bench)
 

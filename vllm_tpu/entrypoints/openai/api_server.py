@@ -42,12 +42,20 @@ logger = init_logger(__name__)
 # Per-request deadline override header (seconds); the body's deadline_s
 # field wins when both are present.
 DEADLINE_HEADER = "X-Request-Deadline-S"
+# SLO scoreboard labels; the body's slo_class / tenant_id fields win
+# when both are present.
+SLO_CLASS_HEADER = "X-SLO-Class"
+TENANT_HEADER = "X-Tenant-Id"
 
 ENGINE_KEY = web.AppKey("engine", AsyncLLM)
 MODEL_KEY = web.AppKey("model_name", str)
 METRICS_KEY = web.AppKey("metrics", object)
 TOOL_PARSER_KEY = web.AppKey("tool_parser", str)
 REASONING_PARSER_KEY = web.AppKey("reasoning_parser", str)
+# Multi-frontend topology info for /metrics/cluster: {"port": public
+# port, "count": frontend count}. Set by the router launcher; absent in
+# single-process mode (the cluster is then this one registry).
+CLUSTER_KEY = web.AppKey("cluster", dict)
 
 
 def _error(status: int, message: str, err_type: str = "invalid_request_error"):
@@ -90,6 +98,23 @@ def _apply_deadline_header(request: web.Request, params) -> str | None:
     return None
 
 
+def _apply_slo_headers(request: web.Request, params) -> str | None:
+    """Fold X-SLO-Class / X-Tenant-Id into SamplingParams (body fields
+    win). Returns an error message for a malformed header."""
+    for header, attr in (
+        (SLO_CLASS_HEADER, "slo_class"),
+        (TENANT_HEADER, "tenant_id"),
+    ):
+        hdr = request.headers.get(header)
+        if hdr is None or getattr(params, attr) is not None:
+            continue
+        hdr = hdr.strip()
+        if not hdr or len(hdr) > 64:
+            return f"{header} must be a non-empty string of <= 64 chars"
+        setattr(params, attr, hdr)
+    return None
+
+
 # ----------------------------------------------------------------------
 # /v1/completions
 # ----------------------------------------------------------------------
@@ -113,6 +138,8 @@ async def handle_completions(request: web.Request) -> web.StreamResponse:
     except ValueError as e:
         return _error(400, str(e))
     if (msg := _apply_deadline_header(request, params)) is not None:
+        return _error(400, msg)
+    if (msg := _apply_slo_headers(request, params)) is not None:
         return _error(400, msg)
     req_id = random_id("cmpl")
 
@@ -185,7 +212,10 @@ async def _stream_completion(
     try:
         async for out in engine.generate(prompt, params, req_id):
             c = out.outputs[0]
-            if c.text or out.finished:
+            # Emit on new tokens even when the delta text is empty
+            # (tokenizer-less checkpoints): SSE clients measuring
+            # TTFT/ITL need one event per decode step.
+            if c.text or c.token_ids or out.finished:
                 chunk = {
                     "id": req_id,
                     "object": "text_completion",
@@ -255,6 +285,8 @@ async def handle_chat_completions(request: web.Request) -> web.StreamResponse:
     except ValueError as e:
         return _error(400, str(e))
     if (msg := _apply_deadline_header(request, params)) is not None:
+        return _error(400, msg)
+    if (msg := _apply_slo_headers(request, params)) is not None:
         return _error(400, msg)
     req_id = random_id("chatcmpl")
     prompt = {"prompt_token_ids": list(prompt_ids)}
@@ -711,6 +743,47 @@ async def handle_metrics(request: web.Request) -> web.Response:
     return web.Response(text=text, content_type="text/plain")
 
 
+async def handle_metrics_cluster(request: web.Request) -> web.Response:
+    """Pool-wide metrics: scrape every sibling frontend's admin-port
+    /metrics and merge (counters/histograms summed, gauges re-labeled
+    per frontend). Single-process topology degrades to the local
+    registry — the cluster of one."""
+    cluster = request.app.get(CLUSTER_KEY)
+    reg = request.app.get(METRICS_KEY)
+    if not cluster or cluster.get("count", 1) <= 1:
+        text = reg.render() if reg is not None else ""
+        return web.Response(text=text, content_type="text/plain")
+
+    import aiohttp
+
+    from vllm_tpu.metrics.prometheus import merge_expositions
+    from vllm_tpu.router.topology import admin_port_for
+
+    port, count = cluster["port"], cluster["count"]
+    texts: list[str | None] = [None] * count
+    timeout = aiohttp.ClientTimeout(total=5)
+
+    async def scrape(session, k: int) -> None:
+        url = f"http://127.0.0.1:{admin_port_for(port, k)}/metrics"
+        try:
+            async with session.get(url) as rsp:
+                if rsp.status == 200:
+                    texts[k] = await rsp.text()
+        except (aiohttp.ClientError, asyncio.TimeoutError, OSError):
+            pass  # a dead/respawning frontend drops out of the merge
+
+    async with aiohttp.ClientSession(timeout=timeout) as session:
+        await asyncio.gather(*(scrape(session, k) for k in range(count)))
+    merged = merge_expositions(
+        {str(k): t for k, t in enumerate(texts) if t is not None}
+    )
+    header = (
+        f"# cluster: {sum(t is not None for t in texts)}/{count} "
+        "frontends scraped\n"
+    )
+    return web.Response(text=header + merged, content_type="text/plain")
+
+
 # ----------------------------------------------------------------------
 # plumbing
 # ----------------------------------------------------------------------
@@ -828,6 +901,7 @@ def build_app(engine: AsyncLLM, model_name: str, metrics=None,
     app.router.add_get("/ping", handle_health)
     app.router.add_get("/ready", handle_ready)
     app.router.add_get("/metrics", handle_metrics)
+    app.router.add_get("/metrics/cluster", handle_metrics_cluster)
     app.router.add_get("/debug/requests", handle_debug_requests)
     app.router.add_get("/debug/deadletter", handle_debug_deadletter)
     app.router.add_get("/debug/perf", handle_debug_perf)

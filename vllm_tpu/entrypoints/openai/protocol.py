@@ -125,6 +125,10 @@ class CompletionRequest:
     # overrides the server default. Also settable via the
     # X-Request-Deadline-S header (body wins).
     deadline_s: float | None = None
+    # SLO scoreboard labels; also settable via the X-SLO-Class /
+    # X-Tenant-Id headers (body wins).
+    slo_class: str | None = None
+    tenant_id: str | None = None
 
     @classmethod
     def from_json(cls, d: dict) -> "CompletionRequest":
@@ -157,6 +161,8 @@ class CompletionRequest:
             bad_words=list(d.get("bad_words") or []),
             allowed_token_ids=_token_id_list(d, "allowed_token_ids"),
             deadline_s=_get(d, "deadline_s", (int, float)),
+            slo_class=_get(d, "slo_class", str),
+            tenant_id=_get(d, "tenant_id", str),
         )
 
     def to_sampling_params(self, stream: bool) -> SamplingParams:
@@ -182,6 +188,8 @@ class CompletionRequest:
                 float(self.deadline_s)
                 if self.deadline_s is not None else None
             ),
+            slo_class=self.slo_class,
+            tenant_id=self.tenant_id,
             output_kind=(
                 RequestOutputKind.DELTA if stream
                 else RequestOutputKind.FINAL_ONLY
@@ -218,6 +226,8 @@ class ChatCompletionRequest:
     bad_words: list[str] = field(default_factory=list)
     allowed_token_ids: list[int] | None = None
     deadline_s: float | None = None
+    slo_class: str | None = None
+    tenant_id: str | None = None
 
     @classmethod
     def from_json(cls, d: dict) -> "ChatCompletionRequest":
@@ -259,6 +269,8 @@ class ChatCompletionRequest:
             bad_words=list(d.get("bad_words") or []),
             allowed_token_ids=_token_id_list(d, "allowed_token_ids"),
             deadline_s=_get(d, "deadline_s", (int, float)),
+            slo_class=_get(d, "slo_class", str),
+            tenant_id=_get(d, "tenant_id", str),
         )
 
     def to_sampling_params(self, stream: bool) -> SamplingParams:
@@ -287,6 +299,8 @@ class ChatCompletionRequest:
                 float(self.deadline_s)
                 if self.deadline_s is not None else None
             ),
+            slo_class=self.slo_class,
+            tenant_id=self.tenant_id,
             output_kind=(
                 RequestOutputKind.DELTA if stream
                 else RequestOutputKind.FINAL_ONLY

@@ -89,3 +89,129 @@ def goodput_summary(
         "p99_itl_ms": round(p99, 3) if p99 is not None else None,
         "itl_samples": len(itls),
     }
+
+
+# ---------------------------------------------------------------------------
+# Per-class scoreboard (SLO classes / multi-tenant traffic).
+#
+# The replay bench and the live attainment gauge share this contract: a
+# request *meets* its class SLO iff its TTFT clears the class TTFT target
+# (when one is set) AND the nearest-rank p99 of its own inter-token gaps
+# clears the class ITL target (when one is set) — the same tail semantics
+# as the global ``slo_met`` above. A class with no targets scores
+# ``slo_attainment: None`` rather than a vacuous 1.0.
+# ---------------------------------------------------------------------------
+
+_DURATION_UNITS = {"us": 0.001, "ms": 1.0, "s": 1000.0, "m": 60000.0}
+
+
+def parse_duration_ms(text: str) -> float:
+    """``"200ms"`` -> 200.0, ``"5s"`` -> 5000.0; a bare number is ms."""
+    text = text.strip().lower()
+    for unit in ("us", "ms", "s", "m"):
+        if text.endswith(unit):
+            return float(text[: -len(unit)]) * _DURATION_UNITS[unit]
+    return float(text)
+
+
+def parse_slo_spec(spec: str | None) -> dict[str, dict[str, float]]:
+    """Parse ``"interactive=ttft:200ms,itl:50ms;batch=ttft:5s"`` into
+    ``{class: {"ttft_ms": ..., "itl_ms": ...}}`` (absent keys mean no
+    target on that axis). Empty/None spec -> ``{}``."""
+    out: dict[str, dict[str, float]] = {}
+    if not spec:
+        return out
+    for clause in spec.split(";"):
+        clause = clause.strip()
+        if not clause:
+            continue
+        if "=" not in clause:
+            raise ValueError(f"bad SLO clause (missing '='): {clause!r}")
+        cls, _, targets = clause.partition("=")
+        cls = cls.strip()
+        if not cls:
+            raise ValueError(f"bad SLO clause (empty class): {clause!r}")
+        parsed: dict[str, float] = {}
+        for item in targets.split(","):
+            item = item.strip()
+            if not item:
+                continue
+            key, _, value = item.partition(":")
+            key = key.strip().lower()
+            if key not in ("ttft", "itl") or not value:
+                raise ValueError(f"bad SLO target {item!r} (want ttft:/itl:)")
+            parsed[f"{key}_ms"] = parse_duration_ms(value)
+        if not parsed:
+            raise ValueError(f"bad SLO clause (no targets): {clause!r}")
+        out[cls] = parsed
+    return out
+
+
+def request_meets_slo(
+    ttft_ms: float | None,
+    itls_ms: list[float],
+    targets: dict[str, float] | None,
+) -> bool | None:
+    """Per-request SLO verdict against class targets; None when the class
+    has no targets (nothing to attain)."""
+    if not targets:
+        return None
+    ttft_target = targets.get("ttft_ms")
+    if ttft_target is not None:
+        if ttft_ms is None or ttft_ms > ttft_target:
+            return False
+    itl_target = targets.get("itl_ms")
+    if itl_target is not None and itls_ms:
+        p99 = percentile(itls_ms, 0.99)
+        if p99 is not None and p99 > itl_target:
+            return False
+    return True
+
+
+def class_scoreboard(
+    requests: list[dict],
+    slo: dict[str, dict[str, float]] | None = None,
+) -> dict[str, dict]:
+    """Per-class latency scoreboard. Each request dict carries
+    ``slo_class`` (str), ``ttft_ms`` (float | None), and ``itls_ms``
+    (list of per-token gaps, ms). Returns per class: request count,
+    nearest-rank p50/p99 TTFT and ITL, the class targets, and
+    attainment (fraction of requests meeting all their targets; None
+    when the class has no targets)."""
+    slo = slo or {}
+    by_class: dict[str, list[dict]] = {}
+    for req in requests:
+        by_class.setdefault(str(req.get("slo_class")), []).append(req)
+    out: dict[str, dict] = {}
+    for cls in sorted(by_class):
+        reqs = by_class[cls]
+        ttfts = [r["ttft_ms"] for r in reqs if r.get("ttft_ms") is not None]
+        itls = [t for r in reqs for t in r.get("itls_ms") or []]
+        targets = slo.get(cls)
+        verdicts = [
+            request_meets_slo(r.get("ttft_ms"), r.get("itls_ms") or [], targets)
+            for r in reqs
+        ]
+        judged = [v for v in verdicts if v is not None]
+        entry: dict = {
+            "requests": len(reqs),
+            "ttft_ms": {
+                "p50": _r(percentile(ttfts, 0.50)),
+                "p99": _r(percentile(ttfts, 0.99)),
+            },
+            "itl_ms": {
+                "p50": _r(percentile(itls, 0.50)),
+                "p99": _r(percentile(itls, 0.99)),
+            },
+            "slo": targets,
+            "slo_attainment": (
+                round(sum(judged) / len(judged), 4) if judged else None
+            ),
+            "slo_met_requests": sum(judged) if judged else None,
+        }
+        out[cls] = entry
+    return out
+
+
+def _r(v: float | None) -> float | None:
+    return round(v, 3) if v is not None else None

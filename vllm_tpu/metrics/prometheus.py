@@ -92,6 +92,13 @@ class LabeledHistogram:
             h = self.series[key] = Histogram(self.name, self.doc, self.buckets)
         h.observe(v)
 
+    def touch(self, key: str) -> None:
+        """Pre-create an empty series so the family renders zeroed
+        buckets before the first observation (scrapers and the render
+        grammar expect every histogram family to carry samples)."""
+        if key not in self.series:
+            self.series[key] = Histogram(self.name, self.doc, self.buckets)
+
     def render(self) -> str:
         out = [
             f"# HELP {self.name} {self.doc}",
@@ -481,6 +488,31 @@ class PrometheusRegistry:
             "vllm:disagg_pending_handoffs",
             "Handoffs currently in flight (clamped prefill leg admitted, "
             "decode side not yet producing)")
+        # SLO scoreboard (vllm_tpu/metrics/reqtrace + goodput): per-class
+        # latency families fed from the class-labeled IterationStats
+        # samples, a sliding-window attainment gauge pulled from the
+        # engine at render time, and the trace-capture counter.
+        self.slo_ttft = LabeledHistogram(
+            "vllm:request_ttft_seconds",
+            "Time to first token by SLO class (unlabeled requests land "
+            "in the 'default' class)", "slo_class",
+            [0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0])
+        self.slo_itl = LabeledHistogram(
+            "vllm:request_itl_seconds",
+            "Inter-token latency by SLO class (unlabeled requests land "
+            "in the 'default' class)", "slo_class",
+            [0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0])
+        self.slo_attainment = LabeledGauge(
+            "vllm:slo_attainment",
+            "Sliding-window fraction of finished requests meeting their "
+            "class SLO targets (--slo-targets; absent classes have no "
+            "configured targets)", "slo_class")
+        self.trace_records = Counter(
+            "vllm:request_trace_records_total",
+            "Requests journaled to the --request-trace-dir JSONL trace")
+        from vllm_tpu.metrics.stats import DEFAULT_SLO_CLASS
+        self.slo_ttft.touch(DEFAULT_SLO_CLASS)
+        self.slo_itl.touch(DEFAULT_SLO_CLASS)
         self._metrics = [
             self.num_running, self.num_waiting, self.kv_usage,
             self.prefix_queries, self.prefix_hits, self.preempted,
@@ -520,6 +552,8 @@ class PrometheusRegistry:
             self.kv_fabric_tier_bytes,
             self.disagg_handoffs, self.disagg_push_bytes,
             self.disagg_handoff_duration, self.disagg_pending,
+            self.slo_ttft, self.slo_itl, self.slo_attainment,
+            self.trace_records,
         ]
         self._engine = engine
         self._last_prefix = (0, 0)
@@ -642,6 +676,10 @@ class PrometheusRegistry:
                 self.tpot.observe(t)
             for t in iteration_stats.e2e_latencies:
                 self.e2e.observe(t)
+            for cls, t in iteration_stats.ttfts_by_class:
+                self.slo_ttft.observe(cls, t)
+            for cls, t in iteration_stats.itls_by_class:
+                self.slo_itl.observe(cls, t)
             for reason in iteration_stats.finished_reasons:
                 self.request_success.inc(reason)
 
@@ -770,13 +808,128 @@ class PrometheusRegistry:
         self.inflight_prompt_tokens.set(
             float(status.get("inflight_prompt_tokens", 0)))
 
+    def _refresh_slo(self) -> None:
+        engine = self._engine
+        if engine is None or not hasattr(engine, "slo_status"):
+            return
+        try:
+            status = engine.slo_status()
+        except Exception:
+            return
+        if not status:
+            return
+        for cls, entry in status.get("attainment", {}).items():
+            self.slo_attainment.set(cls, float(entry["attainment"]))
+        trace = status.get("trace")
+        if trace is not None:
+            self.trace_records.inc_to(float(trace.get("records_total", 0)))
+
     def render(self) -> str:
         self._refresh_resilience()
         self._refresh_lifecycle()
         self._refresh_routing()
         self._refresh_disagg()
         self._refresh_failpoints()
+        self._refresh_slo()
         return "".join(m.render() for m in self._metrics)
+
+
+_SAMPLE_RE = None  # compiled lazily in merge_expositions
+
+
+def merge_expositions(texts: dict[str, str]) -> str:
+    """Merge per-frontend Prometheus expositions into one pool view
+    (the /metrics/cluster endpoint body).
+
+    Counter and histogram samples with identical name+labels are SUMMED
+    across frontends — a pool-wide total is the only coherent reading of
+    a cumulative series. Gauges (and untyped samples) are NOT summable
+    in general (an attainment fraction summed over frontends is
+    nonsense), so each keeps its per-frontend value under an added
+    ``frontend="<key>"`` label. HELP/TYPE headers come from the first
+    frontend that carries the metric; metric order follows first
+    appearance."""
+    global _SAMPLE_RE
+    import re
+
+    if _SAMPLE_RE is None:
+        _SAMPLE_RE = re.compile(
+            r"^([A-Za-z_:][A-Za-z0-9_:]*)(\{.*\})?\s+(\S+)$"
+        )
+
+    order: list[str] = []
+    headers: dict[str, list[str]] = {}
+    types: dict[str, str] = {}
+    # base -> {(sample_name, labels): value} for summable metrics
+    summed: dict[str, dict[tuple[str, str], float]] = {}
+    # base -> [(frontend, sample_name, labels, raw_value)] otherwise
+    labeled: dict[str, list[tuple[str, str, str, str]]] = {}
+
+    for fe in sorted(texts):
+        local_types: dict[str, str] = {}
+        for line in texts[fe].splitlines():
+            line = line.rstrip()
+            if not line:
+                continue
+            if line.startswith("#"):
+                parts = line.split(None, 3)
+                if len(parts) >= 4 and parts[1] in ("HELP", "TYPE"):
+                    name = parts[2]
+                    if parts[1] == "TYPE":
+                        local_types[name] = parts[3]
+                        types.setdefault(name, parts[3])
+                    if name not in headers:
+                        headers[name] = []
+                        order.append(name)
+                    if len(headers[name]) < 2 and line not in headers[name]:
+                        # First frontend's HELP + TYPE pair only.
+                        if not any(
+                            h.split(None, 2)[1] == parts[1]
+                            for h in headers[name]
+                        ):
+                            headers[name].append(line)
+                continue
+            m = _SAMPLE_RE.match(line)
+            if m is None:
+                continue
+            sample_name, labels, raw = m.group(1), m.group(2) or "", m.group(3)
+            base = sample_name
+            for suffix in ("_bucket", "_sum", "_count"):
+                candidate = sample_name[: -len(suffix)] if sample_name.endswith(suffix) else None
+                if candidate and local_types.get(candidate) == "histogram":
+                    base = candidate
+                    break
+            if base not in headers:
+                headers[base] = []
+                order.append(base)
+            mtype = types.get(base)
+            if mtype in ("counter", "histogram"):
+                try:
+                    value = float(raw)
+                except ValueError:
+                    continue
+                bucket = summed.setdefault(base, {})
+                key = (sample_name, labels)
+                bucket[key] = bucket.get(key, 0.0) + value
+            else:
+                labeled.setdefault(base, []).append(
+                    (fe, sample_name, labels, raw)
+                )
+
+    out: list[str] = []
+    for base in order:
+        out.extend(headers.get(base, []))
+        if base in summed:
+            for (sample_name, labels), value in summed[base].items():
+                out.append(f"{sample_name}{labels} {value}")
+        for fe, sample_name, labels, raw in labeled.get(base, []):
+            fe_label = f'frontend="{fe}"'
+            if labels:
+                merged = "{" + fe_label + "," + labels[1:]
+            else:
+                merged = "{" + fe_label + "}"
+            out.append(f"{sample_name}{merged} {raw}")
+    return "\n".join(out) + ("\n" if out else "")
 
 
 class LoggingStatLogger:

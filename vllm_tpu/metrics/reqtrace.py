@@ -1,0 +1,256 @@
+"""Request-trace capture: one JSONL record per served request.
+
+The SLO scoreboard's raw material. With ``--request-trace-dir`` set, the
+output processor hands every finished request here and the recorder
+appends one line — arrival offset from the capture epoch, tenant/SLO
+labels, prompt/decode lengths, the sampling knobs that shape its cost,
+and the realized RequestTimings breakdown. The trace is the unit the
+replay bench (``bench trace`` / ``tools/serve_replay.py``) re-runs
+open-loop at original or scaled QPS.
+
+Crash-safety follows the journal's discipline: append-only, one record
+per line, flushed per write — a crash tears at most the final line, and
+``load_trace`` skips a torn tail instead of failing the whole file.
+Zero-overhead when disabled: AsyncLLM leaves the output processor's
+``reqtrace`` slot None and no per-request work or allocation happens.
+
+Prompts are NOT journaled (size + tenant privacy): records carry the
+prompt *length*, and replay reconstructs deterministic synthetic
+token-id prompts of that length, which preserves the schedule shape —
+prefill cost, decode length, arrival pattern — that the scoreboard
+measures.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Any
+
+from vllm_tpu.logger import init_logger
+
+logger = init_logger(__name__)
+
+TRACE_VERSION = 1
+
+
+class RequestTraceRecorder:
+    """Append-only JSONL trace writer; one per frontend process (the
+    file is pid-suffixed so multi-frontend topologies never interleave
+    writes within a line)."""
+
+    def __init__(self, trace_dir: str) -> None:
+        self.trace_dir = trace_dir
+        os.makedirs(trace_dir, exist_ok=True)
+        self.path = os.path.join(
+            trace_dir, f"reqtrace-{os.getpid()}.jsonl"
+        )
+        # Capture epoch: monotonic anchor for arrival offsets + the wall
+        # clock it corresponds to (so offsets can be mapped back to real
+        # time when correlating with external logs).
+        self._t0_mono = time.monotonic()
+        self._t0_wall = time.time()
+        self.records_total = 0
+        self._f: Any | None = None
+        try:
+            self._f = open(self.path, "a", buffering=1)
+            self._write({
+                "kind": "meta",
+                "version": TRACE_VERSION,
+                "pid": os.getpid(),
+                "t0_wall": round(self._t0_wall, 6),
+            })
+        except OSError as e:
+            logger.warning("reqtrace: cannot open %s: %s", self.path, e)
+            self._f = None
+
+    def _write(self, record: dict) -> None:
+        assert self._f is not None
+        self._f.write(json.dumps(record) + "\n")
+        self._f.flush()
+
+    def record_request(
+        self,
+        timings: Any,
+        params: Any,
+        *,
+        ttft_ms: float | None = None,
+        itls_ms: list[float] | None = None,
+    ) -> None:
+        """Journal one finished request (called from the output
+        processor's finish path; never raises — a failed write logs and
+        disables the recorder rather than failing serving)."""
+        if self._f is None:
+            return
+        record = {
+            "kind": "request",
+            "request_id": timings.request_id,
+            "trace_id": timings.trace_id,
+            "slo_class": timings.slo_class,
+            "tenant_id": timings.tenant_id,
+            "arrival_offset_s": round(
+                max(0.0, timings.arrival_time - self._t0_mono), 6
+            ),
+            "finish_reason": timings.finish_reason,
+            "prompt_len": timings.num_prompt_tokens,
+            "output_len": timings.num_output_tokens,
+            "cached_tokens": timings.num_cached_tokens,
+            "sampling": {
+                "temperature": params.temperature,
+                "top_p": params.top_p,
+                "top_k": params.top_k,
+                "min_p": params.min_p,
+                "max_tokens": params.max_tokens,
+                "min_tokens": params.min_tokens,
+                "seed": params.seed,
+                "ignore_eos": params.ignore_eos,
+            },
+            "ttft_ms": round(ttft_ms, 3) if ttft_ms is not None else None,
+            "phases": {
+                "queue_s": timings.queue_s,
+                "prefill_s": timings.prefill_s,
+                "decode_s": timings.decode_s,
+                "detokenize_s": round(timings.detokenize_s, 6),
+                "e2e_s": timings.e2e_s,
+            },
+        }
+        if itls_ms:
+            from vllm_tpu.metrics.goodput import percentile
+
+            record["itl_ms"] = {
+                "count": len(itls_ms),
+                "p50": round(percentile(itls_ms, 0.50), 3),
+                "p99": round(percentile(itls_ms, 0.99), 3),
+            }
+        try:
+            self._write(record)
+            self.records_total += 1
+        except OSError as e:
+            logger.warning(
+                "reqtrace: write failed (%s); trace capture disabled", e
+            )
+            self.close()
+
+    def status(self) -> dict:
+        return {
+            "path": self.path,
+            "records_total": self.records_total,
+            "active": self._f is not None,
+        }
+
+    def close(self) -> None:
+        if self._f is not None:
+            try:
+                self._f.close()
+            except OSError:
+                pass
+            self._f = None
+
+
+# ---------------------------------------------------------------------------
+# Trace loading / synthesis (replay side).
+# ---------------------------------------------------------------------------
+
+
+def load_trace(path: str) -> list[dict]:
+    """Load request records from a trace file or a ``--request-trace-dir``
+    directory (all ``reqtrace-*.jsonl`` files merged). Torn trailing
+    lines — a crash mid-write — are skipped, matching the recorder's
+    crash-safety contract. Records come back sorted by arrival offset."""
+    if os.path.isdir(path):
+        files = sorted(
+            os.path.join(path, name)
+            for name in os.listdir(path)
+            if name.startswith("reqtrace-") and name.endswith(".jsonl")
+        )
+    else:
+        files = [path]
+    records: list[dict] = []
+    for fname in files:
+        with open(fname) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    rec = json.loads(line)
+                except json.JSONDecodeError:
+                    # Torn tail (or mid-file corruption): skip the line,
+                    # keep the parseable rest.
+                    logger.warning(
+                        "reqtrace: skipping unparseable line in %s", fname
+                    )
+                    continue
+                if rec.get("kind") == "request":
+                    records.append(rec)
+    records.sort(key=lambda r: r.get("arrival_offset_s") or 0.0)
+    return records
+
+
+def synthesize_trace(
+    classes: list[dict],
+    *,
+    num_requests: int,
+    qps: float,
+    seed: int = 0,
+) -> list[dict]:
+    """Deterministic mixed-tenant trace for benching without a recording.
+
+    ``classes`` entries: ``{"slo_class", "tenant_id", "share",
+    "prompt_len", "max_tokens"}`` (share weights are normalized).
+    Arrivals are open-loop Poisson at ``qps``; everything is seeded, so
+    the same inputs always produce the same trace."""
+    import random
+
+    if not classes or num_requests <= 0 or qps <= 0:
+        return []
+    rng = random.Random(seed)
+    total_share = sum(float(c.get("share", 1.0)) for c in classes) or 1.0
+    t = 0.0
+    records: list[dict] = []
+    for i in range(num_requests):
+        t += rng.expovariate(qps)
+        pick = rng.uniform(0, total_share)
+        acc = 0.0
+        cls = classes[-1]
+        for c in classes:
+            acc += float(c.get("share", 1.0))
+            if pick <= acc:
+                cls = c
+                break
+        records.append({
+            "kind": "request",
+            "request_id": f"synth-{i}",
+            "trace_id": None,
+            "slo_class": cls.get("slo_class"),
+            "tenant_id": cls.get("tenant_id"),
+            "arrival_offset_s": round(t, 6),
+            "prompt_len": int(cls.get("prompt_len", 32)),
+            "output_len": int(cls.get("max_tokens", 16)),
+            "sampling": {
+                "temperature": 0.0,
+                "top_p": 1.0,
+                "top_k": 0,
+                "min_p": 0.0,
+                "max_tokens": int(cls.get("max_tokens", 16)),
+                "min_tokens": 0,
+                "seed": seed + i,
+                "ignore_eos": True,
+            },
+        })
+    return records
+
+
+def replay_prompt_token_ids(record: dict, vocab_size: int = 32000) -> list[int]:
+    """Deterministic synthetic prompt of the recorded length. Seeded by
+    the record's position-independent fields so the same trace always
+    replays the same token ids (prefix-cache behavior included: distinct
+    requests get distinct prompts, repeated replays get identical ones)."""
+    import zlib
+
+    n = max(1, int(record.get("prompt_len") or 1))
+    # crc32, not hash(): str hashing is salted per process and replays
+    # must be reproducible across runs.
+    base = zlib.crc32((record.get("request_id") or "").encode())
+    return [(base + 7 * j + 3) % vocab_size for j in range(n)]

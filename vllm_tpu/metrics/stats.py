@@ -5,6 +5,11 @@ from __future__ import annotations
 
 from dataclasses import dataclass, field
 
+# Label applied to requests that carry no explicit SLO class: every
+# request lands in exactly one class, so per-class histograms partition
+# the traffic instead of sampling it.
+DEFAULT_SLO_CLASS = "default"
+
 
 @dataclass
 class IterationStats:
@@ -13,6 +18,11 @@ class IterationStats:
     ttfts: list[float] = field(default_factory=list)
     inter_token_latencies: list[float] = field(default_factory=list)
     e2e_latencies: list[float] = field(default_factory=list)
+    # Class-labeled twins of ttfts / inter_token_latencies:
+    # (slo_class, seconds) pairs feeding the per-class
+    # vllm:request_ttft_seconds / vllm:request_itl_seconds histograms.
+    ttfts_by_class: list[tuple[str, float]] = field(default_factory=list)
+    itls_by_class: list[tuple[str, float]] = field(default_factory=list)
     # Finish reasons of requests completed this iteration ("stop",
     # "length", "abort", ...) — exported as the labeled
     # vllm:request_success_total counter family.
@@ -32,6 +42,10 @@ class RequestTimings:
 
     request_id: str
     trace_id: str | None = None
+    # Tenant/SLO labels (from SamplingParams; None when the request
+    # carried none). Surfaced on /debug/requests and in trace records.
+    slo_class: str | None = None
+    tenant_id: str | None = None
     arrival_time: float = 0.0
     finished_time: float | None = None
     finish_reason: str | None = None
@@ -50,6 +64,8 @@ class RequestTimings:
         return {
             "request_id": self.request_id,
             "trace_id": self.trace_id,
+            "slo_class": self.slo_class,
+            "tenant_id": self.tenant_id,
             "finish_reason": self.finish_reason,
             "num_prompt_tokens": self.num_prompt_tokens,
             "num_output_tokens": self.num_output_tokens,

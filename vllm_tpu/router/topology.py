@@ -122,6 +122,11 @@ def run_frontend(engine_args_bytes: bytes, pool: EnginePoolAddresses,
         engine, engine_args.model, metrics,
         tool_parser=tool_parser, reasoning_parser=reasoning_parser,
     )
+    # /metrics/cluster: any frontend can scrape-merge its siblings'
+    # admin ports into one pool view.
+    from vllm_tpu.entrypoints.openai.api_server import CLUSTER_KEY
+
+    app[CLUSTER_KEY] = {"port": port, "count": n}
 
     async def _serve() -> None:
         runner = web.AppRunner(app)

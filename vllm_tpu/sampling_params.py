@@ -90,6 +90,13 @@ class SamplingParams:
     # request is aborted engine-side and finished with
     # finish_reason="timeout" (enforced in AsyncLLM, not the engine core).
     deadline_s: float | None = None
+    # SLO/tenant labels (``X-SLO-Class`` / ``X-Tenant-Id`` headers or the
+    # matching body fields). Ride the existing EngineCoreRequest wire
+    # inside sampling_params, so old peers decode them transparently;
+    # consumed frontend-side by the output processor (per-class latency
+    # histograms, sliding-window attainment) and the trace recorder.
+    slo_class: str | None = None
+    tenant_id: str | None = None
     # Extension hook carried through untouched.
     extra_args: dict[str, Any] | None = None
 
@@ -131,6 +138,14 @@ class SamplingParams:
             raise ValueError("repetition_penalty must be > 0")
         if self.deadline_s is not None and self.deadline_s <= 0:
             raise ValueError(f"deadline_s must be > 0, got {self.deadline_s}")
+        for label_name in ("slo_class", "tenant_id"):
+            label = getattr(self, label_name)
+            if label is None:
+                continue
+            if not isinstance(label, str) or not label or len(label) > 64:
+                raise ValueError(
+                    f"{label_name} must be a non-empty string of <= 64 chars"
+                )
 
     @property
     def sampling_type(self) -> str:
