@@ -1,0 +1,328 @@
+"""Elastic capacity: controller units on a fake clock, plus the
+dp=2->3->2 e2e on the CPU mesh.
+
+The controller section proves the decision machine alone: hysteresis
+dead zone, hold persistence (one burst never scales), cooldown after
+every event, hard pool bounds, the event latch, and role-rebalance
+gating — all deterministic under an injected clock, no engines.
+
+The e2e section proves the execution layer: ``scale_up()`` boots a
+dummy-initialized newcomer and re-seeds it from a live peer over the
+weight-transfer push path (outcome ``reseeded`` — the checkpoint is
+never read on the happy path), the grown pool serves token-identically
+with the newcomer taking traffic, and ``scale_down()`` drains the
+victim gracefully with zero lost requests.
+"""
+
+from __future__ import annotations
+
+import pickle
+import time
+
+import pytest
+
+from vllm_tpu.resilience.autoscale import AutoscaleController
+
+
+class FakeClock:
+    def __init__(self) -> None:
+        self.t = 100.0
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, dt: float) -> None:
+        self.t += dt
+
+
+def mk(**kw):
+    clock = kw.pop("clock", None) or FakeClock()
+    kw.setdefault("min_engines", 1)
+    kw.setdefault("max_engines", 4)
+    kw.setdefault("up_queue_depth", 4.0)
+    kw.setdefault("down_queue_depth", 0.5)
+    kw.setdefault("hold_s", 5.0)
+    kw.setdefault("cooldown_s", 30.0)
+    # Half-life 0 = each observation adopted instantly; the unit tests
+    # exercise the timers, not the smoothing.
+    kw.setdefault("ema_half_life_s", 0.0)
+    return AutoscaleController(clock=clock, **kw), clock
+
+
+class TestControllerValidation:
+    def test_bad_bounds(self):
+        with pytest.raises(ValueError):
+            AutoscaleController(min_engines=0)
+        with pytest.raises(ValueError):
+            AutoscaleController(min_engines=4, max_engines=2)
+
+    def test_bad_watermarks(self):
+        with pytest.raises(ValueError):
+            AutoscaleController(up_queue_depth=1.0, down_queue_depth=2.0)
+        with pytest.raises(ValueError):
+            AutoscaleController(up_queue_depth=1.0, down_queue_depth=1.0)
+
+    def test_bad_fractions_and_timers(self):
+        with pytest.raises(ValueError):
+            AutoscaleController(slo_floor=1.5)
+        with pytest.raises(ValueError):
+            AutoscaleController(occupancy_high=0.0)
+        with pytest.raises(ValueError):
+            AutoscaleController(hold_s=-1.0)
+        with pytest.raises(ValueError):
+            AutoscaleController(rebalance_ratio=1.0)
+
+
+class TestControllerDecisions:
+    def test_dead_zone_never_decides(self):
+        ctrl, clock = mk()
+        for _ in range(20):
+            ctrl.observe(2.0)  # between the watermarks
+            assert ctrl.decide(2) is None
+            clock.advance(10.0)
+        assert ctrl.desired == 2
+
+    def test_pressure_must_hold_before_up(self):
+        ctrl, clock = mk()
+        ctrl.observe(8.0)
+        assert ctrl.decide(2) is None  # hold timer arms
+        clock.advance(4.9)
+        ctrl.observe(8.0)
+        assert ctrl.decide(2) is None  # not held long enough
+        clock.advance(0.2)
+        ctrl.observe(8.0)
+        assert ctrl.decide(2) == "up"
+        assert ctrl.desired == 3
+
+    def test_one_burst_never_scales(self):
+        ctrl, clock = mk()
+        ctrl.observe(8.0)
+        assert ctrl.decide(2) is None
+        clock.advance(2.0)
+        ctrl.observe(0.3)  # burst over: pressure gone, timer resets
+        assert ctrl.decide(2) is None
+        clock.advance(10.0)
+        ctrl.observe(8.0)
+        assert ctrl.decide(2) is None  # hold restarts from scratch
+        clock.advance(5.1)
+        ctrl.observe(8.0)
+        assert ctrl.decide(2) == "up"
+
+    def test_slack_down_and_min_bound(self):
+        ctrl, clock = mk()
+        ctrl.observe(0.1)
+        assert ctrl.decide(2) is None
+        clock.advance(5.1)
+        ctrl.observe(0.1)
+        assert ctrl.decide(2) == "down"
+        assert ctrl.desired == 1
+        # At the floor the same slack never proposes another shrink.
+        ctrl2, clock2 = mk()
+        ctrl2.observe(0.1)
+        ctrl2.decide(1)
+        clock2.advance(50.0)
+        ctrl2.observe(0.1)
+        assert ctrl2.decide(1) is None
+
+    def test_max_bound_blocks_up(self):
+        ctrl, clock = mk(max_engines=2)
+        ctrl.observe(8.0)
+        ctrl.decide(2)
+        clock.advance(50.0)
+        ctrl.observe(8.0)
+        assert ctrl.decide(2) is None
+
+    def test_busy_latch_and_cooldown(self):
+        ctrl, clock = mk()
+        ctrl.observe(8.0)
+        ctrl.decide(2)
+        clock.advance(5.1)
+        ctrl.observe(8.0)
+        assert ctrl.decide(2) == "up"
+        ctrl.note_scale_started("up")
+        assert ctrl.busy == "up"
+        clock.advance(60.0)
+        ctrl.observe(8.0)
+        assert ctrl.decide(2) is None  # latched: one event at a time
+        ctrl.note_scale_finished("up", "reseeded")
+        assert ctrl.busy is None
+        ctrl.observe(8.0)
+        assert ctrl.decide(3) is None  # cooling down
+        clock.advance(31.0)
+        ctrl.observe(8.0)
+        assert ctrl.decide(3) is None  # hold re-arms after the cooldown
+        clock.advance(5.1)
+        ctrl.observe(8.0)
+        assert ctrl.decide(3) == "up"
+        snap = ctrl.snapshot()
+        assert snap["scale_events_total"] == {"up/reseeded": 1}
+
+    def test_slo_and_occupancy_pressure(self):
+        ctrl, clock = mk(slo_floor=0.9)
+        ctrl.observe(1.0, slo_attainment=0.5)  # queue quiet, SLO burning
+        assert ctrl.snapshot()["pressure"] == "slo_attainment"
+        ctrl.decide(2)
+        clock.advance(5.1)
+        ctrl.observe(1.0, slo_attainment=0.5)
+        assert ctrl.decide(2) == "up"
+
+        ctrl, clock = mk(occupancy_high=0.95)
+        ctrl.observe(0.1, occupancy=0.99)
+        assert ctrl.snapshot()["pressure"] == "kv_occupancy"
+        # Occupancy pressure also vetoes slack: never a down decision.
+        ctrl.decide(2)
+        clock.advance(50.0)
+        ctrl.observe(0.1, occupancy=0.99)
+        assert ctrl.decide(2) != "down"
+
+    def test_rebalance_hold_and_donor_floor(self):
+        ctrl, clock = mk(rebalance_ratio=4.0)
+        assert ctrl.decide_rebalance(8.0, 0.5, 1, 2) is None  # arms
+        clock.advance(5.1)
+        assert ctrl.decide_rebalance(8.0, 0.5, 1, 2) == "prefill"
+        # Direction flip resets the hold.
+        assert ctrl.decide_rebalance(0.5, 8.0, 2, 1) is None
+        # The donating side must keep at least one engine.
+        clock.advance(5.1)
+        assert ctrl.decide_rebalance(8.0, 0.5, 1, 1) is None
+
+    def test_reseed_counters(self):
+        ctrl, _ = mk()
+        ctrl.note_reseed("ok")
+        ctrl.note_reseed("ok")
+        ctrl.note_reseed("fallback")
+        assert ctrl.snapshot()["weight_reseed_total"] == {
+            "ok": 2, "fallback": 1}
+
+
+# ---------------------------------------------------------------------
+# e2e: dp=2 -> 3 (peer re-seed) -> 2 (graceful drain) on the CPU mesh
+# ---------------------------------------------------------------------
+
+from tests.models.utils import tiny_llama_dir  # noqa: E402
+from vllm_tpu import LLM, SamplingParams  # noqa: E402
+
+BLOCK = 16
+PROMPTS = [
+    [(1000 * (i + 3) + 7 * j) % 120 + 3 for j in range(24)]
+    for i in range(4)
+]
+
+
+@pytest.fixture(scope="module")
+def ckpt(tmp_path_factory):
+    return tiny_llama_dir(tmp_path_factory.mktemp("tiny_llama_autoscale"))
+
+
+def _llm(ckpt, **kw):
+    return LLM(
+        model=ckpt, dtype="float32", max_model_len=256, block_size=BLOCK,
+        num_gpu_blocks_override=96, max_num_seqs=4,
+        max_num_batched_tokens=128,
+        data_parallel_engines=2,
+        kv_connector="fabric",
+        kv_fabric_quant="none",
+        enable_engine_recovery=True,
+        **kw,
+    )
+
+
+def _generate(llm, sp):
+    outs = llm.generate(
+        [{"prompt_token_ids": list(p)} for p in PROMPTS], sp)
+    return [list(o.outputs[0].token_ids) for o in outs]
+
+
+def _pump_scale(client, timeout_s=180.0):
+    """Drive an in-flight scale event to completion from the test
+    thread (the role the AsyncLLM busy loop plays when serving):
+    get_output pumps READY frames, poll_scale advances the event."""
+    deadline = time.monotonic() + timeout_s
+    while client.pool_status()["scale_event"] is not None:
+        assert time.monotonic() < deadline, client.pool_status()
+        client.get_output(timeout=0.05)
+        client.poll_scale()
+    return client.pool_status()
+
+
+def test_autoscale_e2e_scale_up_reseed_then_drain(ckpt):
+    sp = SamplingParams(temperature=0.0, max_tokens=8, ignore_eos=True)
+
+    llm = _llm(ckpt)
+    try:
+        ref = _generate(llm, sp)
+    finally:
+        llm.llm_engine.shutdown()
+    assert all(len(t) == 8 for t in ref)
+
+    llm = _llm(ckpt)
+    try:
+        client = llm.llm_engine.engine_core
+        assert _generate(llm, sp) == ref
+
+        # -- scale up: dp=2 -> 3, newcomer re-seeded from a live peer --
+        eid = client.scale_up()
+        assert eid == 2
+        # The slot's stored (respawn-fallback) config is the checkpoint;
+        # the spawn itself boots dummy-initialized and adopts peer
+        # weights — the checkpoint is never read on the happy path.
+        stored = pickle.loads(client._engine_cfg_bytes[eid])
+        assert stored.model_config.load_format != "dummy"
+
+        pool = _pump_scale(client)
+        assert pool["actual"] == 3
+        assert pool["seeding"] == []
+        ev = pool["events"][-1]
+        assert ev["direction"] == "up"
+        assert ev["outcome"] == "reseeded", pool["events"]
+        assert ev["reseed"] == "ok"
+
+        # Token-identical on the grown pool, with the newcomer serving.
+        routed: list[int] = []
+        orig_add = client.add_request
+
+        def spy(req):
+            orig_add(req)
+            routed.append(client._live[req.request_id])
+
+        client.add_request = spy
+        tokens = _generate(llm, sp)
+        client.add_request = orig_add
+        assert tokens == ref, (
+            "re-seeded pool must be token-identical to the dp=2 pool")
+        assert eid in routed, routed
+
+        # -- scale down: 3 -> 2 with requests in flight, zero lost --
+        for i, p in enumerate(PROMPTS):
+            llm.llm_engine.add_request(
+                f"drain-{i}", {"prompt_token_ids": list(p)}, sp)
+        victim = client.scale_down()
+        assert victim == eid
+        assert victim in client.pool_status()["draining"]
+
+        finals: dict[str, list[int]] = {}
+        deadline = time.monotonic() + 180.0
+        while (llm.llm_engine.has_unfinished_requests()
+               or client.pool_status()["scale_event"] is not None):
+            assert time.monotonic() < deadline, client.pool_status()
+            for out in llm.llm_engine.step():
+                if out.finished:
+                    finals[out.request_id] = list(
+                        out.outputs[0].token_ids)
+            client.poll_scale()
+
+        pool = client.pool_status()
+        assert pool["actual"] == 2
+        assert victim in pool["removed"]
+        ev = pool["events"][-1]
+        assert ev["direction"] == "down"
+        assert ev["outcome"] in ("drained", "deadline_replay"), ev
+        # Zero lost: every request admitted before the drain reached its
+        # full, token-identical completion.
+        assert [finals[f"drain-{i}"] for i in range(len(PROMPTS))] == ref
+        assert pool["drain_durations_s"], pool
+        # Slots are append-only: the pool keeps the retired slot's id.
+        assert pool["size"] == 3
+        assert pool["draining"] == [] and pool["seeding"] == []
+    finally:
+        llm.llm_engine.shutdown()

@@ -120,6 +120,35 @@ def build_parser() -> argparse.ArgumentParser:
                         "the run passes iff every request still reaches "
                         "one terminal state and at least one handoff "
                         "degraded to decode-side recompute")
+    p.add_argument("--traffic-ramp", action="store_true",
+                   help="elastic-capacity scenario instead of the seeded "
+                        "fault schedule: offered QPS ramps high until "
+                        "the autoscale controller grows the pool (time-"
+                        "to-capacity asserted against --capacity-"
+                        "deadline), then drops to a trickle until the "
+                        "pool drains back down; passes iff every request "
+                        "finishes (zero lost), both scale events "
+                        "complete, and SLO attainment holds through "
+                        "them. Combine with --ramp-kill for the chaos "
+                        "proof")
+    p.add_argument("--ramp-kill", default="none",
+                   choices=["none", "newcomer", "victim"],
+                   help="with --traffic-ramp: SIGKILL the scale event's "
+                        "target engine mid-event (newcomer = during "
+                        "spawn/re-seed, must degrade to checkpoint "
+                        "fallback; victim = during drain, stragglers "
+                        "must replay on survivors) — zero lost either "
+                        "way")
+    p.add_argument("--ramp-qps", type=float, default=8.0,
+                   help="offered load during the high phase")
+    p.add_argument("--ramp-low-qps", type=float, default=0.5,
+                   help="offered load during warmup and cooldown")
+    p.add_argument("--capacity-deadline", type=float, default=120.0,
+                   help="max seconds from ramp start to the grown pool "
+                        "serving (and back down after the cooldown)")
+    p.add_argument("--slo-floor", type=float, default=0.9,
+                   help="minimum per-class SLO attainment through the "
+                        "scale events (--traffic-ramp)")
     p.add_argument("--requests", type=int, default=16)
     p.add_argument("--max-tokens", type=int, default=8)
     p.add_argument("--concurrency", type=int, default=4)
@@ -230,8 +259,208 @@ def _check_disagg(engine, report) -> bool:
     return ok
 
 
+def _run_traffic_ramp(args) -> int:
+    """Elastic-capacity scenario: drive a QPS ramp through an autoscaled
+    pool and assert the scale events actually tracked it.
+
+    Phase 1 (warmup) trickles traffic at --ramp-low-qps. Phase 2 offers
+    --ramp-qps until the pool reaches dp+1 routable engines and the
+    scale-up event completes (time-to-capacity, asserted against
+    --capacity-deadline). Phase 3 drops back to the trickle until the
+    pool drains down to dp again. ``--ramp-kill`` SIGKILLs the scale
+    event's target engine mid-event — the run must then degrade to the
+    recovery substrate (checkpoint fallback / straggler replay) with
+    zero lost requests.
+    """
+    import signal
+    import time
+
+    from vllm_tpu.engine.arg_utils import AsyncEngineArgs
+    from vllm_tpu.engine.async_llm import AsyncLLM
+
+    dp0 = max(2, args.dp)
+    engine = AsyncLLM.from_engine_args(AsyncEngineArgs(
+        model=args.model,
+        max_model_len=args.max_model_len,
+        data_parallel_engines=dp0,
+        enable_engine_recovery=True,
+        max_engine_restarts=8,
+        max_request_retries=4,
+        restart_backoff_s=0.05,
+        kv_connector="fabric" if args.kv_fabric else None,
+        # Generous targets: the assertion is that attainment does not
+        # collapse THROUGH the scale events, not absolute latency.
+        slo_targets=f"default=ttft:{args.request_timeout:.0f}s",
+        autoscale=True,
+        autoscale_min_engines=dp0,
+        autoscale_max_engines=dp0 + 1,
+        autoscale_up_queue_depth=2.0,
+        autoscale_down_queue_depth=0.25,
+        autoscale_hold_s=0.5,
+        autoscale_cooldown_s=2.0,
+        autoscale_interval_s=0.2,
+        autoscale_drain_deadline_s=15.0,
+        autoscale_reseed_timeout_s=60.0,
+    ))
+
+    async def body() -> bool:
+        from vllm_tpu.sampling_params import (
+            RequestOutputKind,
+            SamplingParams,
+        )
+
+        results = {"submitted": 0, "ok": 0, "errors": []}
+        state = {"pool": {}, "t_capacity": None, "killed": None}
+        stop = asyncio.Event()
+        t_ramp = [time.monotonic()]
+
+        async def one(i: int) -> None:
+            rid = f"ramp-{i}"
+            params = SamplingParams(
+                temperature=0.0,
+                max_tokens=args.max_tokens,
+                ignore_eos=True,
+                detokenize=False,
+                output_kind=RequestOutputKind.DELTA,
+            )
+            prompt = {"prompt_token_ids": [(i % 50) + 1] * 8}
+            results["submitted"] += 1
+            try:
+                finished = False
+
+                async def consume() -> None:
+                    nonlocal finished
+                    async for out in engine.generate(prompt, params, rid):
+                        if out.finished:
+                            finished = True
+
+                await asyncio.wait_for(consume(), args.request_timeout)
+                if finished:
+                    results["ok"] += 1
+                else:
+                    results["errors"].append((rid, "no final output"))
+            except Exception as e:  # timeout or terminal error = lost
+                results["errors"].append((rid, repr(e)))
+
+        async def watcher() -> None:
+            while not stop.is_set():
+                status = engine.autoscale_status() or {}
+                pool = status.get("pool") or {}
+                state["pool"] = pool
+                ev = pool.get("scale_event")
+                want = {"newcomer": "up", "victim": "down"}.get(
+                    args.ramp_kill)
+                if (ev is not None and want is not None
+                        and state["killed"] is None
+                        and ev["kind"] == want):
+                    eid = ev["engine"]
+                    proc = engine.engine_core._procs[eid]
+                    if proc.pid is not None and proc.is_alive():
+                        os.kill(proc.pid, signal.SIGKILL)
+                        state["killed"] = (eid, ev["kind"], ev["phase"])
+                        print(f"ramp: SIGKILLed engine {eid} mid-"
+                              f"{ev['kind']} (phase {ev['phase']})",
+                              file=sys.stderr)
+                if (state["t_capacity"] is None
+                        and pool.get("actual", 0) >= dp0 + 1):
+                    state["t_capacity"] = time.monotonic() - t_ramp[0]
+                    print(f"ramp: capacity {dp0}->{dp0 + 1} reached in "
+                          f"{state['t_capacity']:.1f}s", file=sys.stderr)
+                await asyncio.sleep(0.1)
+
+        tasks: list[asyncio.Task] = []
+        idx = [0]
+
+        async def offer(qps: float, max_s: float, pred) -> None:
+            deadline = time.monotonic() + max_s
+            while time.monotonic() < deadline and not pred():
+                tasks.append(asyncio.create_task(one(idx[0])))
+                idx[0] += 1
+                await asyncio.sleep(1.0 / qps)
+
+        watch = asyncio.create_task(watcher())
+        try:
+            # Warmup at trickle QPS: engines serving, queue empty.
+            await offer(args.ramp_low_qps, 3.0, lambda: False)
+            # Ramp: high QPS until the grown pool serves and the
+            # scale-up event (plus any mid-event kill recovery) is done.
+            t_ramp[0] = time.monotonic()
+            await offer(
+                args.ramp_qps, args.capacity_deadline,
+                lambda: (state["t_capacity"] is not None
+                         and state["pool"].get("scale_event") is None))
+            # Cooldown: trickle until the pool drains back down.
+            await offer(
+                args.ramp_low_qps, args.capacity_deadline,
+                lambda: (state["pool"].get("actual", 0) <= dp0
+                         and state["pool"].get("scale_event") is None))
+            await asyncio.gather(*tasks)
+        finally:
+            stop.set()
+            await watch
+
+        events = state["pool"].get("events", [])
+        print(f"ramp: scale events: {events}", file=sys.stderr)
+        print(f"ramp: {results['ok']}/{results['submitted']} finished",
+              file=sys.stderr)
+        ok = True
+        if results["errors"]:
+            for rid, err in results["errors"][:8]:
+                print(f"RAMP: lost request {rid}: {err}", file=sys.stderr)
+            print(f"RAMP: {len(results['errors'])} request(s) lost",
+                  file=sys.stderr)
+            ok = False
+        if state["t_capacity"] is None:
+            print("RAMP: pool never reached capacity "
+                  f"({dp0 + 1} engines) within "
+                  f"{args.capacity_deadline:.0f}s", file=sys.stderr)
+            ok = False
+        up = [e for e in events if e["direction"] == "up"]
+        down = [e for e in events if e["direction"] == "down"]
+        if not any(e["outcome"] in ("reseeded", "fallback_checkpoint")
+                   for e in up):
+            print(f"RAMP: no completed scale-up event (saw {up})",
+                  file=sys.stderr)
+            ok = False
+        if args.ramp_kill == "none" and up and up[0][
+                "outcome"] != "reseeded":
+            print(f"RAMP: undisturbed scale-up should re-seed from a "
+                  f"peer, got {up[0]['outcome']!r}", file=sys.stderr)
+            ok = False
+        good_down = ("drained", "deadline_replay", "died_draining")
+        if not any(e["outcome"] in good_down for e in down):
+            print(f"RAMP: no completed scale-down event (saw {down})",
+                  file=sys.stderr)
+            ok = False
+        if args.ramp_kill != "none" and state["killed"] is None:
+            print(f"RAMP: --ramp-kill={args.ramp_kill} never fired "
+                  f"(no matching scale event window)", file=sys.stderr)
+            ok = False
+        snap = engine.slo_status() or {}
+        for cls, entry in (snap.get("attainment") or {}).items():
+            att = float(entry["attainment"])
+            print(f"ramp: slo[{cls}] attainment={att:.3f} "
+                  f"(window={entry.get('window')})", file=sys.stderr)
+            if att < args.slo_floor:
+                print(f"RAMP: SLO attainment for {cls!r} fell to "
+                      f"{att:.3f} < floor {args.slo_floor}",
+                      file=sys.stderr)
+                ok = False
+        return ok
+
+    try:
+        ok = asyncio.run(body())
+    finally:
+        engine.shutdown()
+    print("ok" if ok else "FAILED", file=sys.stderr)
+    return 0 if ok else 1
+
+
 def main(argv: list[str] | None = None) -> int:
     args = build_parser().parse_args(argv)
+
+    if args.traffic_ramp:
+        return _run_traffic_ramp(args)
 
     from vllm_tpu.engine.arg_utils import AsyncEngineArgs
     from vllm_tpu.engine.async_llm import AsyncLLM

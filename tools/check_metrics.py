@@ -138,15 +138,27 @@ REQUIRED_SLO_METRICS = {
     "vllm:request_trace_records_total",
 }
 
+# Documented in the README ("Elastic capacity"); the traffic-ramp chaos
+# scenario and capacity dashboards assert on these names.
+REQUIRED_AUTOSCALE_METRICS = {
+    "vllm:pool_size_desired",
+    "vllm:pool_size_actual",
+    "vllm:scale_events_total",
+    "vllm:engine_drain_duration_seconds",
+    "vllm:weight_reseed_total",
+    "vllm:kv_fabric_tier_occupancy",
+}
+
 # Floor on the registry size: a refactor that silently drops metrics
 # from the render list must fail the lint even if no required-set name
 # is among the casualties. Bump when adding metrics.
-MIN_METRICS = 80
+MIN_METRICS = 86
 
 
 def check() -> list[str]:
     """Return a list of lint errors (empty = clean)."""
     from vllm_tpu.metrics.prometheus import (
+        BiLabeledCounter,
         Counter,
         Gauge,
         Histogram,
@@ -156,8 +168,8 @@ def check() -> list[str]:
         PrometheusRegistry,
     )
 
-    metric_types = (Counter, Gauge, Histogram, LabeledCounter,
-                    LabeledGauge, LabeledHistogram)
+    metric_types = (BiLabeledCounter, Counter, Gauge, Histogram,
+                    LabeledCounter, LabeledGauge, LabeledHistogram)
     reg = PrometheusRegistry()
     errors: list[str] = []
 
@@ -240,6 +252,10 @@ def check() -> list[str]:
     for name in sorted(REQUIRED_SLO_METRICS - set(seen)):
         errors.append(
             f"required SLO-scoreboard metric {name} is missing from "
+            f"the registry (documented in README)")
+    for name in sorted(REQUIRED_AUTOSCALE_METRICS - set(seen)):
+        errors.append(
+            f"required elastic-capacity metric {name} is missing from "
             f"the registry (documented in README)")
 
     if len(reg._metrics) < MIN_METRICS:

@@ -127,6 +127,21 @@ class EngineArgs:
     # transient partition (no action).
     mesh_death_timeout_s: float = 2.0
     mesh_heartbeat_interval_s: float = 0.2
+    # Elastic capacity (vllm_tpu/resilience/autoscale): traffic-driven
+    # scale-up (peer weight re-seed) / scale-down (graceful drain) of the
+    # DP engine pool. Opt-in via --autoscale; requires engine recovery.
+    autoscale: bool = False
+    autoscale_min_engines: int = 1
+    autoscale_max_engines: int = 0  # 0 = initial pool size
+    autoscale_up_queue_depth: float = 4.0
+    autoscale_down_queue_depth: float = 0.5
+    autoscale_slo_floor: float = 0.0
+    autoscale_occupancy_high: float = 0.95
+    autoscale_hold_s: float = 5.0
+    autoscale_cooldown_s: float = 30.0
+    autoscale_interval_s: float = 1.0
+    autoscale_drain_deadline_s: float = 30.0
+    autoscale_reseed_timeout_s: float = 120.0
 
     # Lifecycle (vllm_tpu/resilience/lifecycle): overload protection.
     # All off by default; see LifecycleConfig for semantics.
@@ -273,6 +288,18 @@ class EngineArgs:
                 quarantine_probation_cap=self.quarantine_probation_cap,
                 mesh_death_timeout_s=self.mesh_death_timeout_s,
                 mesh_heartbeat_interval_s=self.mesh_heartbeat_interval_s,
+                autoscale=self.autoscale,
+                autoscale_min_engines=self.autoscale_min_engines,
+                autoscale_max_engines=self.autoscale_max_engines,
+                autoscale_up_queue_depth=self.autoscale_up_queue_depth,
+                autoscale_down_queue_depth=self.autoscale_down_queue_depth,
+                autoscale_slo_floor=self.autoscale_slo_floor,
+                autoscale_occupancy_high=self.autoscale_occupancy_high,
+                autoscale_hold_s=self.autoscale_hold_s,
+                autoscale_cooldown_s=self.autoscale_cooldown_s,
+                autoscale_interval_s=self.autoscale_interval_s,
+                autoscale_drain_deadline_s=self.autoscale_drain_deadline_s,
+                autoscale_reseed_timeout_s=self.autoscale_reseed_timeout_s,
             ),
             lifecycle_config=LifecycleConfig(
                 max_inflight_requests=self.max_inflight_requests,

@@ -215,6 +215,38 @@ class AsyncLLM:
         # replay would generate for a consumer that already left).
         self.replays_dropped_aborted_total = 0
         self._last_deadline_sweep = 0.0
+        # Elastic capacity (vllm_tpu/resilience/autoscale): the
+        # controller decides, the DP client executes (spawn + peer
+        # weight re-seed up, graceful drain down). Armed only for a DP
+        # pool with --autoscale; VLLM_TPU_DISABLE_AUTOSCALE is the
+        # escape hatch that severs the decision loop while keeping
+        # manual scale_up()/scale_down() available.
+        self._autoscale = None
+        self._autoscale_next_t = 0.0
+        self._autoscale_occ: float | None = None
+        self._autoscale_occ_t = 0.0
+        rc = self.resilience
+        if rc.autoscale and hasattr(self.engine_core, "scale_up"):
+            from vllm_tpu import envs
+
+            if envs.VLLM_TPU_DISABLE_AUTOSCALE:
+                logger.warning(
+                    "autoscale configured but disabled via "
+                    "VLLM_TPU_DISABLE_AUTOSCALE")
+            else:
+                from vllm_tpu.resilience import AutoscaleController
+
+                n0 = config.parallel_config.data_parallel_engines
+                self._autoscale = AutoscaleController(
+                    min_engines=rc.autoscale_min_engines,
+                    max_engines=rc.autoscale_max_engines or n0,
+                    up_queue_depth=rc.autoscale_up_queue_depth,
+                    down_queue_depth=rc.autoscale_down_queue_depth,
+                    slo_floor=rc.autoscale_slo_floor,
+                    occupancy_high=rc.autoscale_occupancy_high,
+                    hold_s=rc.autoscale_hold_s,
+                    cooldown_s=rc.autoscale_cooldown_s,
+                )
         if start:
             self.start()
 
@@ -410,6 +442,13 @@ class AsyncLLM:
         poll_perfwatch = getattr(self.engine_core, "poll_perfwatch", None)
         if poll_perfwatch is not None:
             poll_perfwatch()
+        # Elastic-capacity tick (DP pool only): advance any in-flight
+        # scale event and run the controller. Runs even when idle — a
+        # drained-quiet pool is exactly when scale-down fires. May raise
+        # EngineRestartedError (drain deadline replays stragglers onto
+        # survivors) — recovered by the busy loop like any crash.
+        if getattr(self.engine_core, "poll_scale", None) is not None:
+            self.poll_autoscale()
         if not self.engine_core.has_unfinished_requests():
             return stalled
         outputs = self.engine_core.get_output(timeout=0.2)
@@ -730,6 +769,97 @@ class AsyncLLM:
             stream_outputs_dropped_total=self.stream_drops_total,
             slow_client_aborts_total=self.slow_client_aborts_total,
         )
+        return status
+
+    def poll_autoscale(self) -> None:
+        """Elastic-capacity tick (engine-loop thread): advance the DP
+        client's in-flight scale event, feed completed-event records to
+        the controller's counters, sample the traffic signals at
+        ``autoscale_interval_s``, and execute the controller's decision.
+        A drain past its deadline raises EngineRestartedError from the
+        client — the busy loop then journal-replays the stragglers onto
+        the surviving engines, exactly like a crash minus the crash."""
+        client = self.engine_core
+        events = client.poll_scale()
+        ctrl = getattr(self, "_autoscale", None)
+        if ctrl is None:
+            return
+        for ev in events:
+            ctrl.note_scale_finished(ev["direction"], ev["outcome"])
+            if ev.get("reseed"):
+                ctrl.note_reseed(ev["reseed"])
+        now = time.monotonic()
+        if now < self._autoscale_next_t:
+            return
+        self._autoscale_next_t = now + self.resilience.autoscale_interval_s
+        pool = client.pool_status()
+        actual = pool["actual"]
+        if actual <= 0:
+            return
+        # Waiting+running per routable engine: every open request state
+        # is either queued client-side or in flight on an engine.
+        depth = len(self.output_processor.request_states) / actual
+        slo = None
+        snap = self.output_processor.slo_attainment_snapshot()
+        if snap:
+            slo = min(v["attainment"] for v in snap.values())
+        ctrl.observe(depth, slo, self._sample_occupancy(now))
+        if ctrl.busy is not None or pool["scale_event"] is not None:
+            return
+        decision = ctrl.decide(actual)
+        if decision == "up":
+            if client.scale_up() is not None:
+                ctrl.note_scale_started("up")
+        elif decision == "down":
+            if client.scale_down() is not None:
+                ctrl.note_scale_started("down")
+
+    def _sample_occupancy(self, now: float) -> float | None:
+        """Worst kv-fabric tier occupancy across the pool, sampled at a
+        slower cadence than the controller tick (the status call is a
+        pool-wide utility broadcast) and cached for /health. None when
+        no fabric is configured."""
+        if self.config.cache_config.kv_connector != "fabric":
+            return None
+        if (now - self._autoscale_occ_t
+                < 5 * self.resilience.autoscale_interval_s):
+            return self._autoscale_occ
+        self._autoscale_occ_t = now
+        try:
+            snap = self.engine_core.kv_fabric_status() or {}
+        except Exception:
+            return self._autoscale_occ
+        # Pool-merged snapshots carry per-engine views under "engines";
+        # a single-engine client returns one flat snapshot.
+        engines = snap.get("engines")
+        if not isinstance(engines, dict):
+            engines = {"0": snap}
+        worst: float | None = None
+        for eng in engines.values():
+            if not isinstance(eng, dict):
+                continue
+            for frac in (eng.get("tier_occupancy") or {}).values():
+                if isinstance(frac, (int, float)):
+                    worst = frac if worst is None else max(worst, frac)
+        self._autoscale_occ = worst
+        return worst
+
+    def autoscale_status(self, drain: bool = False) -> dict | None:
+        """Elastic-capacity snapshot (pool membership + controller) for
+        /health and /metrics, or None when the client has no engine
+        pool. ``drain=True`` (metrics renderer only) takes ownership of
+        the pending drain-duration observations."""
+        client = self.engine_core
+        if not hasattr(client, "pool_status"):
+            return None
+        ctrl = getattr(self, "_autoscale", None)
+        status: dict = {
+            "enabled": ctrl is not None,
+            "pool": client.pool_status(drain=drain),
+        }
+        if ctrl is not None:
+            status["controller"] = ctrl.snapshot()
+            status["kv_occupancy"] = getattr(self, "_autoscale_occ", None)
         return status
 
     def resilience_status(self) -> dict:

@@ -291,9 +291,7 @@ class _ZMQClientBase:
                     continue
                 return frames
             deadline -= step
-            dead = [
-                i for i, p in enumerate(self._procs) if not p.is_alive()
-            ]
+            dead = self._dead_proc_ids()
             if dead:
                 self._handle_engine_death(
                     dead, "an engine core process exited"
@@ -301,10 +299,16 @@ class _ZMQClientBase:
             if deadline <= 0:
                 return None
 
+    def _dead_proc_ids(self) -> list[int]:
+        """Engine slots whose process exited unexpectedly. The DP client
+        overrides this to skip retired slots: an autoscale drain victim
+        exits on purpose, and that exit must not read as a death."""
+        return [i for i, p in enumerate(self._procs) if not p.is_alive()]
+
     def _check_alive(self) -> None:
         if self._dead:
             raise EngineDeadError("engine core process is not running")
-        dead = [i for i, p in enumerate(self._procs) if not p.is_alive()]
+        dead = self._dead_proc_ids()
         if dead:
             self._handle_engine_death(
                 dead, "engine core process is not running"
@@ -441,6 +445,16 @@ class _ZMQClientBase:
             if frames[0] == self._proc_mod.MSG_READY:
                 # READY parked in _pending by a stale-frame drain.
                 self._on_engine_ready(self._serial.decode(frames[1]))
+                continue
+            if frames[0] == self._proc_mod.MSG_UTILITY_REPLY:
+                # Stray reply from an abandoned utility collection (a
+                # peer death interrupted it mid-way — e.g. a weight
+                # re-seed cut short by chaos): drop it rather than let
+                # it crash the output-stream assert below.
+                logger.debug(
+                    "dropping stray utility reply: %s",
+                    self._serial.decode(frames[1]),
+                )
                 continue
             break
         self._last_progress = time.monotonic()
@@ -1046,6 +1060,23 @@ class DPLBClient(_ZMQClientBase):
         self._pending: list[list[bytes]] = []
         # Degraded-mode routing mask: False while a rank is respawning.
         self._engine_up = [True] * n
+        # Elastic capacity (vllm_tpu/resilience/autoscale). Slots are
+        # append-only: a scale-down retires its slot into ``_removed``
+        # (the id is never reused), so every per-engine list stays
+        # index-aligned forever. One scale event runs at a time
+        # (``_scale_state``); all mutation happens on the frontend's
+        # engine-loop thread — the same thread that owns add_request /
+        # get_output — so none of this needs locking.
+        self._draining: set[int] = set()  # victims finishing their work
+        self._seeding: set[int] = set()   # newcomers awaiting weights
+        self._removed: set[int] = set()   # retired slots (exited on purpose)
+        self._scale_state: dict | None = None
+        self._scale_log: list[dict] = []
+        self._scale_events_pending: list[dict] = []
+        self._drain_durations: list[float] = []
+        self._fabric_binds = fabric_binds
+        self._ipc_suffix = suffix
+        self._pin_chips = pin_chips
         self._last_progress = time.monotonic()
         ready = 0
         blocks: list[int] = []
@@ -1078,10 +1109,12 @@ class DPLBClient(_ZMQClientBase):
         proc.start()
         return proc
 
-    def _spawn_dp_engine(self, eid: int, input_addr: str):
+    def _spawn_dp_engine(self, eid: int, input_addr: str,
+                         cfg_bytes: bytes | None = None):
         proc = self._mp_ctx.Process(
             target=self._proc_mod.run_engine_core,
-            args=(self._engine_cfg_bytes[eid], input_addr,
+            args=(cfg_bytes if cfg_bytes is not None
+                  else self._engine_cfg_bytes[eid], input_addr,
                   self._output_addr),
             kwargs=self._engine_kwargs[eid],
             name=f"vllm-tpu-engine-core-dp{eid}",
@@ -1100,6 +1133,22 @@ class DPLBClient(_ZMQClientBase):
         import zmq
 
         eid = engine_id
+        st = getattr(self, "_scale_state", None)
+        if st is not None and st.get("kind") == "up":
+            if eid == st.get("eid"):
+                # The seeding newcomer died (chaos mid-re-seed, dummy
+                # boot crash): its slot's respawn config keeps the real
+                # checkpoint load_format, so the relaunch below IS the
+                # checkpoint-reload fallback — mark the event so the
+                # replacement's READY joins it without a re-seed.
+                st["fallback"] = True
+                st["phase"] = "awaiting_fallback"
+            elif (eid == st.get("donor")
+                    and st.get("phase") == "reseeding"):
+                # The re-seed donor died mid-push: the newcomer holds a
+                # part-garbage tree. Reboot it from checkpoint; the
+                # donor's own recovery proceeds normally below.
+                self._abort_reseed(st)
         self._engine_up[eid] = False
         proc = self._procs[eid]
         proc.join(timeout=2)
@@ -1153,6 +1202,27 @@ class DPLBClient(_ZMQClientBase):
 
     def _on_engine_ready(self, payload: dict) -> None:
         eid = int(payload.get("engine_id", 0))
+        if eid in getattr(self, "_seeding", ()):
+            # Scale-up newcomer: NOT routable yet. A dummy-weights boot
+            # waits for the peer re-seed (poll_scale drives it off the
+            # phase latch); a checkpoint-fallback respawn joins at once
+            # — it already holds real weights.
+            st = self._scale_state
+            if st is not None and st.get("eid") == eid:
+                if st.get("fallback"):
+                    self._finish_scale_up(eid, outcome="fallback_checkpoint")
+                else:
+                    st["phase"] = "ready_for_reseed"
+                    logger.info(
+                        "scale-up: engine %d booted (dummy weights, %s "
+                        "KV blocks); awaiting peer re-seed",
+                        eid, payload.get("num_gpu_blocks", -1),
+                    )
+                return
+            # No live event claims this seeding slot (the event was
+            # abandoned): retire it rather than serve dummy weights.
+            self._retire_slot(eid, outcome="orphaned")
+            return
         self._engine_up[eid] = True
         self._supervisor.record_ready(eid)
         logger.info(
@@ -1167,6 +1237,52 @@ class DPLBClient(_ZMQClientBase):
             if c > 0 and self._engine_up[i]
         ]
 
+    def _dead_proc_ids(self) -> list[int]:
+        # Retired slots exited on purpose — not deaths.
+        removed = getattr(self, "_removed", ())
+        return [
+            i for i, p in enumerate(self._procs)
+            if i not in removed and not p.is_alive()
+        ]
+
+    def _handle_engine_death(self, engine_ids: list[int],
+                             reason: str,
+                             suspects: list[str] | None = None) -> None:
+        """Route drain victims around the restart budget: a victim that
+        dies mid-drain was leaving anyway, so its death must never
+        consume restart budget — nor, budget-exhausted, kill the whole
+        pool. Retire the slot and hand its in-flight requests straight
+        to journal replay; any OTHER dead engine in the same batch takes
+        the normal respawn path (its raise carries both lost sets)."""
+        victims = [
+            e for e in engine_ids
+            if e in getattr(self, "_draining", ())
+        ] if (self._started and not self._closing
+              and self._resilience.enable_recovery) else []
+        if not victims:
+            return super()._handle_engine_death(
+                engine_ids, reason, suspects)
+        lost: list[str] = []
+        for eid in victims:
+            logger.warning(
+                "engine %d died while draining (%s); finalizing its "
+                "retirement instead of respawning",
+                eid, reason.splitlines()[0],
+            )
+            lost.extend(self._retire_slot(eid, outcome="died_draining"))
+        rest = [e for e in engine_ids if e not in victims]
+        if rest:
+            try:
+                super()._handle_engine_death(rest, reason, suspects)
+            except EngineRestartedError as e:
+                e.lost_req_ids = sorted({*e.lost_req_ids, *lost})
+                raise
+        raise EngineRestartedError(
+            lost, engine_id=victims[0],
+            reason="engine died while draining (autoscale)",
+            suspect_req_ids=[],
+        )
+
     # ------------------------------------------------------------------
 
     def _drain_loads(self) -> None:
@@ -1176,7 +1292,9 @@ class DPLBClient(_ZMQClientBase):
             frames = self._sub.recv_multipart()
             state = self._serial.decode(frames[1])
             for eid_s, (w, r) in state["loads"].items():
-                self._coord_loads[int(eid_s)] = w + r
+                e = int(eid_s)
+                if e < len(self._coord_loads):
+                    self._coord_loads[e] = w + r
             self._snapshot_t = time.monotonic()
             self._supervisor.record_ready(COORDINATOR_ID)
             epoch = state.get("epoch")
@@ -1291,13 +1409,23 @@ class DPLBClient(_ZMQClientBase):
     def add_request(self, req: EngineCoreRequest) -> None:
         self._check_alive()
         self._drain_loads()
-        # Degraded mode: route around ranks that are respawning. If every
-        # rank is down (mass-crash window), fall back to all — the bind
-        # side of the fresh input socket buffers the add until the
-        # replacement connects, so nothing is dropped.
+        # Degraded mode: route around ranks that are respawning, and
+        # around autoscale drain victims (their in-flight work finishes
+        # but no NEW work lands). If every rank is down (mass-crash
+        # window), fall back — first to draining-but-alive ranks, then
+        # to every non-retired slot: the bind side of the fresh input
+        # socket buffers the add until the replacement connects, so
+        # nothing is dropped.
+        draining = getattr(self, "_draining", ())
+        removed = getattr(self, "_removed", ())
         candidates = [
+            i for i in range(self._num_engines)
+            if self._engine_up[i] and i not in draining
+        ] or [
             i for i in range(self._num_engines) if self._engine_up[i]
-        ] or list(range(self._num_engines))
+        ] or [
+            i for i in range(self._num_engines) if i not in removed
+        ]
         # Coordinator-snapshot freshness gates the routing policy: fresh
         # -> least-loaded on the client-side exact counters; stale (the
         # coordinator is gone or wedged past coordinator_stale_after_s)
@@ -1397,8 +1525,10 @@ class DPLBClient(_ZMQClientBase):
                       lambda: f"req={req.request_id}") == "drop":
             return req, None
         disagg = self._disagg
+        draining = getattr(self, "_draining", ())
         decode_up = [
-            i for i in disagg.plan.decode_ids if self._engine_up[i]
+            i for i in disagg.plan.decode_ids
+            if self._engine_up[i] and i not in draining
         ]
         if not decode_up:
             return req, None
@@ -1413,6 +1543,7 @@ class DPLBClient(_ZMQClientBase):
         prefill_up = [
             i for i in disagg.plan.candidates_for_phase("prefill")
             if self._engine_up[i] and i != to_engine
+            and i not in draining
         ]
         if not prefill_up:
             return req, None
@@ -1478,7 +1609,8 @@ class DPLBClient(_ZMQClientBase):
         recompute."""
         ph = self._disagg.pending(req.request_id)
         eid = ph.record.to_engine if ph is not None else None
-        if eid is None or not self._engine_up[eid]:
+        if (eid is None or not self._engine_up[eid]
+                or eid in getattr(self, "_draining", ())):
             self.add_request(req)
             return
         self._live[req.request_id] = eid
@@ -1512,6 +1644,446 @@ class DPLBClient(_ZMQClientBase):
             "outcomes": {},
             "durations_s": [],
         }
+
+    # -- elastic capacity (autoscale execution layer) -------------------
+
+    def _routable_ids(self) -> list[int]:
+        """Engines a new request may land on right now."""
+        return [
+            i for i in range(self._num_engines)
+            if self._engine_up[i] and i not in self._draining
+        ]
+
+    def _broadcast_best_effort(self, method: str, *args,
+                               skip: int | None = None) -> None:
+        """Fire ``method`` at every routable engine, swallowing per-
+        engine failures: fabric peer-list edits are advisory — a missed
+        removal only costs one failed fetch later."""
+        for i in self._routable_ids():
+            if i == skip:
+                continue
+            try:
+                self._utility_on(i, method, *args, timeout_ms=30_000)
+            except Exception as exc:
+                logger.debug("%s on engine %d failed: %s",
+                             method, i, exc)
+
+    def _note_scale_event(self, direction: str, outcome: str,
+                          duration_s: float,
+                          reseed: str | None = None) -> None:
+        ev: dict = {
+            "direction": direction, "outcome": outcome,
+            "duration_s": round(duration_s, 3),
+        }
+        if reseed is not None:
+            ev["reseed"] = reseed
+        self._scale_log.append(ev)
+        self._scale_events_pending.append(ev)
+
+    def _drain_scale_events(self) -> list[dict]:
+        evs, self._scale_events_pending = self._scale_events_pending, []
+        return evs
+
+    def scale_up(self) -> int | None:
+        """Begin adding one engine to the pool (non-blocking).
+
+        The newcomer boots with ``load_format="dummy"`` — allocated,
+        garbage weights, NO checkpoint read on the hot path — and stays
+        masked from routing (``_seeding``) until :meth:`poll_scale`
+        re-seeds its weights from a live peer over the streaming
+        weight-transfer push. Its slot's respawn config keeps the real
+        checkpoint ``load_format``, so any crash (or a failed re-seed)
+        degrades to the existing recovery path: respawn from checkpoint.
+        Returns the new engine id, or None when no event can start
+        (one scale event at a time)."""
+        import copy
+        import socket as _socket
+
+        import zmq
+
+        if (self._scale_state is not None or self._closing
+                or self._dead or not self._started):
+            return None
+        if self._pin_chips:
+            # Chip pinning partitions a fixed host inventory at launch;
+            # there is no spare chip set to pin a newcomer to.
+            logger.warning(
+                "scale_up refused: engines are chip-pinned "
+                "(fixed host chip inventory)")
+            return None
+        eid = len(self._procs)
+        engine_config = pickle.loads(self._engine_cfg_bytes[0])
+        new_bind = None
+        if self._fabric_binds is not None:
+            s = _socket.socket()
+            s.bind(("127.0.0.1", 0))
+            new_bind = f"127.0.0.1:{s.getsockname()[1]}"
+            s.close()
+            self._fabric_binds.append(new_bind)
+            engine_config.cache_config.kv_fabric_bind = new_bind
+            engine_config.cache_config.kv_fabric_peers = [
+                b for i, b in enumerate(self._fabric_binds)
+                if i != eid and i not in self._removed
+            ]
+        # The kv-events subscriber set is fixed at construction: the
+        # newcomer publishes no events (no prefix affinity) and serves
+        # via the phase/load rungs instead.
+        engine_config.cache_config.kv_events_endpoint = None
+        self._engine_cfg_bytes.append(pickle.dumps(engine_config))
+        self._engine_kwargs.append(dict(
+            engine_id=eid,
+            coord_report_addr=self._coord_args[0],
+            coord_pub_addr=self._coord_args[1],
+            lockstep=self._engine_kwargs[0]["lockstep"],
+            extra_env={},
+        ))
+        dummy_config = copy.deepcopy(engine_config)
+        dummy_config.model_config.load_format = "dummy"
+        input_addr = (
+            f"ipc://{self._run_dir}/in{eid}-{self._ipc_suffix}.sock"
+        )
+        sock = self._ctx.socket(zmq.PUSH)
+        sock.bind(input_addr)
+        self._inputs.append(sock)
+        self._engine_inflight.append(0)
+        self._coord_loads.append(0)
+        self._engine_up.append(False)
+        self._seeding.add(eid)
+        self._num_engines += 1
+        self._procs.append(self._spawn_dp_engine(
+            eid, input_addr, cfg_bytes=pickle.dumps(dummy_config)))
+        self._scale_state = {
+            "kind": "up", "eid": eid, "phase": "spawning",
+            "t0": time.monotonic(), "bind": new_bind, "donor": None,
+            "fallback": False,
+        }
+        logger.info(
+            "scale-up: engine %d spawning with dummy weights (pid %s); "
+            "peer re-seed to follow", eid, self._procs[eid].pid)
+        return eid
+
+    def scale_down(self, engine_id: int | None = None) -> int | None:
+        """Begin a graceful drain of one engine (non-blocking). The
+        victim is masked from routing immediately; :meth:`poll_scale`
+        retires the slot once its in-flight requests finish (demoting
+        its hot host-tier KV to peers first), or journal-replays the
+        stragglers onto survivors past ``autoscale_drain_deadline_s``.
+        Returns the victim id, or None when no event can start."""
+        if (self._scale_state is not None or self._closing
+                or self._dead or not self._started):
+            return None
+        cands = self._routable_ids()
+        if engine_id is not None:
+            if engine_id not in cands or len(cands) <= 1:
+                return None
+            victim = engine_id
+        else:
+            if len(cands) <= 1:
+                return None
+            # Highest id: keeps the dense low-id prefix (and with it
+            # the original chip pinning / role layout) intact.
+            victim = max(cands)
+        self._draining.add(victim)
+        self._scale_state = {
+            "kind": "down", "eid": victim, "phase": "draining",
+            "t0": time.monotonic(),
+        }
+        logger.info(
+            "scale-down: engine %d draining (%d in flight, deadline "
+            "%.0fs)", victim, self._engine_inflight[victim],
+            self._resilience.autoscale_drain_deadline_s)
+        return victim
+
+    def rebalance_role(self, engine_id: int, role: str) -> bool:
+        """Convert one engine's role (prefill/decode/unified) via a
+        short drain: the engine is masked from routing until its
+        current work finishes, then the role plan flips. No process
+        restart — roles are a client-side routing concept (every engine
+        proc runs role-free)."""
+        if role not in ("prefill", "decode", "unified"):
+            raise ValueError(f"unknown engine role: {role}")
+        if (self._scale_state is not None or self._closing
+                or self._dead or not self._started
+                or getattr(self, "_role_plan", None) is None
+                or engine_id not in self._routable_ids()
+                or self._role_plan.roles[engine_id] == role):
+            return False
+        self._draining.add(engine_id)
+        self._scale_state = {
+            "kind": "rebalance", "eid": engine_id, "phase": "draining",
+            "t0": time.monotonic(), "role": role,
+        }
+        logger.info(
+            "rebalance: engine %d draining for re-role %s -> %s",
+            engine_id, self._role_plan.roles[engine_id], role)
+        return True
+
+    def poll_scale(self) -> list[dict]:
+        """Advance the in-flight scale event (if any) one step and hand
+        back completed-event records for the controller's counters.
+        Called from the frontend's engine loop — the thread that owns
+        add_request/get_output, so no locking. The re-seed round-trip
+        is the one blocking stretch: weights stream peer-to-peer
+        (seconds), never from a checkpoint."""
+        st = self._scale_state
+        if st is None or self._closing or self._dead:
+            return self._drain_scale_events()
+        now = time.monotonic()
+        if st["kind"] == "up":
+            eid = st["eid"]
+            if st["phase"] == "ready_for_reseed":
+                self._start_reseed(st)
+            elif (now - st["t0"]
+                    > self._resilience.autoscale_reseed_timeout_s
+                    and st["phase"] in ("spawning", "awaiting_fallback")):
+                # Newcomer never became seedable (wedged boot, repeated
+                # fallback crashes): give the slot up.
+                logger.error(
+                    "scale-up of engine %d timed out after %.0fs; "
+                    "retiring the slot", eid, now - st["t0"])
+                self._retire_slot(eid, outcome="timeout")
+        elif st["kind"] == "down":
+            eid = st["eid"]
+            if self._engine_inflight[eid] == 0:
+                # Graceful completion: demote the victim's hot host-tier
+                # KV to peers (best-effort), then retire the slot.
+                if self._fabric_binds is not None:
+                    try:
+                        shipped = self._utility_on(
+                            eid, "kv_fabric_drain", timeout_ms=60_000)
+                        logger.info(
+                            "engine %d demoted %s host-tier blocks to "
+                            "peers before exit", eid, shipped)
+                    except Exception as exc:
+                        logger.warning(
+                            "kv drain on engine %d failed (%s); its "
+                            "host tier is lost (recompute covers it)",
+                            eid, exc)
+                self._retire_slot(eid, outcome="drained")
+            elif (now - st["t0"]
+                    > self._resilience.autoscale_drain_deadline_s):
+                # Past the drain deadline: journal-replay the stragglers
+                # onto the survivors — zero lost requests, same path a
+                # crash takes, minus the crash.
+                lost = self._retire_slot(eid, outcome="deadline_replay")
+                raise EngineRestartedError(
+                    lost, engine_id=eid,
+                    reason="autoscale drain deadline; replaying "
+                           "stragglers on survivors",
+                    suspect_req_ids=[],
+                )
+        elif st["kind"] == "rebalance":
+            eid = st["eid"]
+            deadline = (now - st["t0"]
+                        > self._resilience.autoscale_drain_deadline_s)
+            if self._engine_inflight[eid] == 0 or deadline:
+                # A role flip needs no empty engine, just a quiet one;
+                # past the deadline flip anyway — the phase rung only
+                # steers NEW requests, running work is unaffected.
+                self._role_plan.roles[eid] = st["role"]
+                self._role_plan.__post_init__()
+                self._draining.discard(eid)
+                self._note_scale_event(
+                    "rebalance",
+                    "deadline_flip" if deadline else "ok",
+                    now - st["t0"])
+                self._scale_state = None
+                logger.info("engine %d re-roled to %s", eid, st["role"])
+        return self._drain_scale_events()
+
+    def _start_reseed(self, st: dict) -> None:
+        """Blocking peer re-seed: the newcomer listens, the least-loaded
+        live peer pushes its full param tree over the streaming weight-
+        transfer path. On failure the newcomer reboots from its
+        checkpoint config — the pool never admits dummy weights."""
+        import socket as _socket
+
+        eid = st["eid"]
+        donors = [i for i in self._routable_ids() if i != eid]
+        if not donors:
+            # Nobody to seed from (mass-crash window): checkpoint it.
+            self._abort_reseed(st)
+            return
+        donor = min(donors, key=lambda i: self._engine_inflight[i])
+        s = _socket.socket()
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+        s.close()
+        timeout = self._resilience.autoscale_reseed_timeout_s
+        st["phase"] = "reseeding"
+        st["donor"] = donor
+        logger.info(
+            "re-seeding engine %d from peer %d (port %d)",
+            eid, donor, port)
+        # Receiver first (it binds the listener), pusher second; the
+        # pusher's connect loop absorbs the bind race. Raw sends — the
+        # newcomer is not "up" so _utility_on would refuse it.
+        for target, method, args in (
+            (eid, "receive_weights", [port, timeout]),
+            (donor, "push_weights_to", ["127.0.0.1", port, timeout]),
+        ):
+            self._inputs[target].send_multipart([
+                self._proc_mod.MSG_UTILITY,
+                method.encode(),
+                self._serial.encode(args),
+            ])
+        try:
+            self._collect_utility_replies(
+                "weight_reseed", 2, int(timeout * 1000) + 30_000)
+        except EngineRestartedError:
+            raise  # a peer died; _respawn_engine arranged the fallback
+        except Exception as exc:
+            logger.warning(
+                "peer re-seed of engine %d failed (%s); rebooting it "
+                "from checkpoint", eid, exc)
+            self._abort_reseed(st)
+            return
+        self._finish_scale_up(eid, outcome="reseeded")
+
+    def _abort_reseed(self, st: dict) -> None:
+        """Re-seed cannot complete (donor died mid-push, reseed error,
+        no donors): reboot the newcomer from its slot config — which
+        keeps the real checkpoint load_format — and let its READY join
+        the pool via the fallback branch of _on_engine_ready."""
+        import zmq
+
+        nid = st["eid"]
+        proc = self._procs[nid]
+        if proc.is_alive():
+            proc.terminate()
+            proc.join(timeout=2)
+        self._inputs[nid].close(linger=0)
+        suffix = uuid.uuid4().hex[:8]
+        input_addr = f"ipc://{self._run_dir}/in{nid}-{suffix}.sock"
+        sock = self._ctx.socket(zmq.PUSH)
+        sock.bind(input_addr)
+        self._inputs[nid] = sock
+        self._procs[nid] = self._spawn_dp_engine(nid, input_addr)
+        st["fallback"] = True
+        st["phase"] = "awaiting_fallback"
+        st["t0"] = time.monotonic()  # fresh budget for the reload
+        logger.warning(
+            "engine %d rebooting from checkpoint (re-seed fallback)",
+            nid)
+
+    def _finish_scale_up(self, eid: int, outcome: str) -> None:
+        """Join a seeded (or checkpoint-reloaded) newcomer: survivors
+        learn its fabric tier, the role plan grows, routing unmasks."""
+        st = self._scale_state
+        bind = st.get("bind") if st is not None else None
+        if getattr(self, "_role_plan", None) is not None:
+            while len(self._role_plan.roles) <= eid:
+                self._role_plan.roles.append("unified")
+            self._role_plan.__post_init__()
+        if bind:
+            # Survivors learn the newcomer's host tier (the newcomer
+            # already has the full peer list baked into its config).
+            self._broadcast_best_effort(
+                "kv_fabric_add_peer", bind, skip=eid)
+            if getattr(self, "_disagg", None) is not None:
+                self._disagg_peer_addr[eid] = bind
+        self._seeding.discard(eid)
+        self._engine_up[eid] = True
+        self._supervisor.record_ready(eid)
+        dur = time.monotonic() - st["t0"] if st is not None else 0.0
+        self._note_scale_event(
+            "up", outcome, dur,
+            reseed="ok" if outcome == "reseeded" else "fallback")
+        self._scale_state = None
+        self._report_inflight()
+        logger.info(
+            "scale-up complete: engine %d joined (%s, %.1fs); pool now "
+            "%d routable", eid, outcome, dur, len(self._routable_ids()))
+
+    def _retire_slot(self, eid: int, outcome: str) -> list[str]:
+        """Retire one engine slot for good. Terminal: the id is never
+        reused, per-engine lists keep their length (index alignment),
+        and the slot is masked everywhere via ``_removed``. Returns the
+        request ids still live on the slot (non-empty only on a forced
+        or chaos retirement) for journal replay."""
+        st = self._scale_state
+        # BEFORE any proc poke: the victim's exit must not read as a
+        # death to the liveness checks.
+        self._removed.add(eid)
+        self._draining.discard(eid)
+        self._seeding.discard(eid)
+        self._engine_up[eid] = False
+        proc = self._procs[eid]
+        if proc.is_alive():
+            # Clean shutdown first; terminate as the backstop.
+            try:
+                self._inputs[eid].send_multipart(
+                    [self._proc_mod.MSG_SHUTDOWN])
+            except Exception:
+                pass
+            proc.join(timeout=10)
+            if proc.is_alive():
+                proc.terminate()
+                proc.join(timeout=2)
+        else:
+            proc.join(timeout=2)
+        lost = sorted(r for r, e in self._live.items() if e == eid)
+        for rid in lost:
+            del self._live[rid]
+        self._engine_inflight[eid] = 0
+        if getattr(self, "_disagg", None) is not None:
+            self._disagg.note_engine_death(lost)
+        if getattr(self, "_prefix_index", None) is not None:
+            self._prefix_index.drop_engine(eid)
+        self._drain_stale_outputs(set(lost))
+        self._disagg_peer_addr.pop(eid, None)
+        # Forget the slot entirely: readiness must not wait on a rank
+        # that left on purpose.
+        self._supervisor.remove(eid)
+        try:
+            self._report.send(
+                self._serial.encode({"engine_down": eid}))
+        except Exception:
+            pass
+        # Survivors forget the retired peer's fabric tier.
+        if (self._fabric_binds is not None
+                and eid < len(self._fabric_binds)):
+            self._broadcast_best_effort(
+                "kv_fabric_remove_peer", self._fabric_binds[eid])
+        if st is not None and st.get("eid") == eid:
+            dur = time.monotonic() - st["t0"]
+            if st["kind"] == "down":
+                self._drain_durations.append(dur)
+            self._note_scale_event(st["kind"], outcome, dur)
+            self._scale_state = None
+        self._report_inflight()
+        logger.info(
+            "engine %d retired (%s); pool now %d routable",
+            eid, outcome, len(self._routable_ids()))
+        return lost
+
+    def pool_status(self, drain: bool = False) -> dict:
+        """Elastic-capacity snapshot for /health and /metrics.
+        ``drain=True`` (metrics renderer only) hands over the pending
+        drain durations for exactly-once histogram observation."""
+        st = self._scale_state
+        durations = list(self._drain_durations)
+        if drain:
+            self._drain_durations = []
+        return {
+            "size": self._num_engines,
+            "actual": len(self._routable_ids()),
+            "draining": sorted(self._draining),
+            "seeding": sorted(self._seeding),
+            "removed": sorted(self._removed),
+            "scale_event": (
+                {
+                    "kind": st["kind"], "engine": st["eid"],
+                    "phase": st["phase"],
+                    "age_s": round(time.monotonic() - st["t0"], 3),
+                }
+                if st is not None else None
+            ),
+            "events": list(self._scale_log)[-20:],
+            "drain_durations_s": durations,
+        }
+
+    # ------------------------------------------------------------------
 
     def _utility_on(
         self, eid: int, method: str, *args, timeout_ms: int = 30_000

@@ -728,6 +728,51 @@ class EngineCore:
         [n] = self.executor.collective_rpc("receive_weights", port, timeout)
         return n
 
+    def push_weights_to(self, host: str, port: int,
+                        timeout: float = 300.0) -> int:
+        """Elastic scale-up re-seed, donor side: stream this engine's
+        resident weights to a newcomer listening on ``host:port`` over
+        the weight-transfer push path. Unlike :meth:`receive_weights`
+        this does NOT require a quiesced engine — params are immutable
+        device arrays, so a serving peer can donate (the utility RPC
+        stalls its step loop for the transfer, which is why the client
+        picks the least-loaded donor)."""
+        [n] = self.executor.collective_rpc(
+            "push_weights_to", host, port, timeout)
+        return n
+
+    # -- live fabric peer membership (elastic capacity) ----------------
+
+    def kv_fabric_add_peer(self, url: str) -> bool:
+        """Admit a scaled-up engine's fabric server to the peer list."""
+        if self.kv_connector is None or not hasattr(
+            self.kv_connector, "add_peer"
+        ):
+            return False
+        self.kv_connector.add_peer(url)
+        return True
+
+    def kv_fabric_remove_peer(self, url: str) -> bool:
+        """Retire a drained engine's fabric server from the peer list."""
+        if self.kv_connector is None or not hasattr(
+            self.kv_connector, "remove_peer"
+        ):
+            return False
+        self.kv_connector.remove_peer(url)
+        return True
+
+    def kv_fabric_drain(self) -> int:
+        """Scale-down demotion: flush pending saves, then ship this
+        engine's host-tier KV to surviving peers. Returns the number of
+        blocks shipped (0 when no fabric / no peers — best-effort, the
+        fabric is a cache)."""
+        if self.kv_connector is None or not hasattr(
+            self.kv_connector, "drain_host_to_peers"
+        ):
+            return 0
+        self.flush_kv_saves()
+        return int(self.kv_connector.drain_host_to_peers())
+
     def add_lora(self, name: str, path: str) -> bool:
         ok = self.executor.collective_rpc("add_lora", name, path)[0]
         if ok:

@@ -690,6 +690,29 @@ async def handle_health(request: web.Request) -> web.Response:
         if routing is not None:
             body["routing"] = routing["decisions"]
             body["prefix_index"] = routing.get("index")
+    # Elastic capacity: desired vs actual pool size, in-flight scale
+    # events, recent event history. Operators watch this during a ramp
+    # to see the pool track traffic (and autoscaled LBs use actual).
+    if hasattr(engine, "autoscale_status"):
+        auto = engine.autoscale_status()
+        if auto is not None:
+            pool = auto["pool"]
+            ctrl = auto.get("controller")
+            body["pool"] = {
+                "desired": (ctrl["desired"] if ctrl is not None
+                            else pool["actual"]),
+                "actual": pool["actual"],
+                "size": pool["size"],
+                "draining": pool["draining"],
+                "seeding": pool["seeding"],
+                "scale_event": pool["scale_event"],
+                "events": pool["events"],
+                "autoscale_enabled": auto["enabled"],
+            }
+            if ctrl is not None:
+                body["pool"]["controller"] = ctrl
+            if auto.get("kv_occupancy") is not None:
+                body["pool"]["kv_occupancy"] = auto["kv_occupancy"]
     return web.json_response(body, status=503 if dead else 200)
 
 

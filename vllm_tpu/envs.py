@@ -97,6 +97,15 @@ _readers: dict[str, Callable[[], Any]] = {
     # push). Outputs are token-identical either way under greedy
     # decoding; A/B this before filing disagg bugs.
     "VLLM_TPU_DISABLE_DISAGG": _bool("VLLM_TPU_DISABLE_DISAGG", False),
+    # Escape hatch for elastic capacity (vllm_tpu/resilience/autoscale):
+    # --autoscale stops DRIVING scale events (no controller is built)
+    # while the execution layer stays available for manual
+    # scale_up()/scale_down() calls and in-flight drains still finish.
+    # Serving behavior is otherwise identical; A/B this before filing
+    # autoscale bugs.
+    "VLLM_TPU_DISABLE_AUTOSCALE": _bool(
+        "VLLM_TPU_DISABLE_AUTOSCALE", False
+    ),
     # Escape hatch for the fused sort-free sampling kernel
     # (ops/sampler_kernel.py): sampling batches fall back to the XLA
     # sort-free reference in sample/sampler.py when set. Both paths are

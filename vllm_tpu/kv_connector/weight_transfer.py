@@ -21,18 +21,32 @@ Wire format (one TCP connection per push):
     repeat: [4-byte LE header length][json header][raw leaf bytes]
         header = {"path", "dtype", "shape"}
     [4-byte zero] = end -> receiver replies b"OK" (or b"ER" + message)
+
+Failure semantics (matching ``kv_connector/remote.py``): every socket
+carries a bounded per-I/O timeout (``VLLM_TPU_WEIGHT_IO_TIMEOUT_S``,
+default 30 s) so a peer that dies mid-transfer stalls one read, not the
+whole ``timeout`` budget; both sides retry a failed transfer with
+exponential backoff up to ``max_retries`` within the overall deadline.
+Re-applying a leaf is idempotent (``device_put`` overwrites), so a
+retried push that restarts from the magic is safe.
 """
 
 from __future__ import annotations
 
 import json
+import os
 import socket
 import struct
+import time
 from typing import Any, Iterable
 
 import numpy as np
 
 MAGIC = b"VLTWT001"
+
+# Per-I/O socket timeout: bounds how long ONE recv/send may stall on a
+# dead peer (the overall `timeout` argument bounds the whole transfer).
+_IO_TIMEOUT_S = float(os.environ.get("VLLM_TPU_WEIGHT_IO_TIMEOUT_S", "30"))
 
 
 def leaf_paths(tree: Any) -> dict[str, Any]:
@@ -64,30 +78,8 @@ def _recv_exact(conn: socket.socket, n: int) -> bytes:
     return bytes(buf)
 
 
-def receive_weights(
-    apply_leaf,
-    port: int = 0,
-    host: str = "0.0.0.0",
-    timeout: float = 300.0,
-    ready_cb=None,
-) -> int:
-    """Listen for ONE push; call ``apply_leaf(path, np_array)`` per leaf.
-
-    Returns the number of leaves applied. ``ready_cb(port)`` fires once
-    the listener is bound (the engine returns the ephemeral port to the
-    caller through it)."""
-    srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
-    srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
-    srv.bind((host, port))
-    srv.listen(1)
-    srv.settimeout(timeout)
-    if ready_cb is not None:
-        ready_cb(srv.getsockname()[1])
-    try:
-        conn, _ = srv.accept()
-    finally:
-        srv.close()
-    conn.settimeout(timeout)
+def _receive_one(conn: socket.socket, apply_leaf) -> int:
+    """Drain one framed push off an accepted connection."""
     n_applied = 0
     try:
         if _recv_exact(conn, len(MAGIC)) != MAGIC:
@@ -115,29 +107,63 @@ def receive_weights(
     return n_applied
 
 
-def push_weights(
-    addr: tuple[str, int],
-    leaves: Iterable[tuple[str, np.ndarray]],
+def receive_weights(
+    apply_leaf,
+    port: int = 0,
+    host: str = "0.0.0.0",
     timeout: float = 300.0,
-    connect_timeout: float = 30.0,
-) -> None:
-    """Trainer side: stream ``(path, array)`` pairs to a listening
-    engine. ``ml_dtypes`` dtypes (bfloat16, fp8) ride their numpy dtype
-    names. Connects with RETRY for up to ``connect_timeout``: the engine
-    binds its listener only after draining in-flight steps, so the
-    trainer naturally races the bind."""
-    import time
+    ready_cb=None,
+    max_retries: int = 2,
+    backoff_s: float = 0.1,
+) -> int:
+    """Listen for ONE push; call ``apply_leaf(path, np_array)`` per leaf.
 
-    deadline = time.monotonic() + connect_timeout
-    while True:
-        try:
-            conn = socket.create_connection(addr, timeout=timeout)
-            break
-        except (ConnectionRefusedError, OSError):
-            if time.monotonic() >= deadline:
-                raise
-            time.sleep(0.1)
-    conn.settimeout(timeout)
+    Returns the number of leaves applied. ``ready_cb(port)`` fires once
+    the listener is bound (the engine returns the ephemeral port to the
+    caller through it).
+
+    A pusher that dies mid-stream fails its connection after one
+    ``_IO_TIMEOUT_S``-bounded read — not the full ``timeout`` — and the
+    listener stays open for a fresh attempt (the sender re-pushes from
+    the magic; leaves are idempotent to re-apply). After ``max_retries``
+    failed connections, or past the overall deadline, raises
+    ConnectionError."""
+    deadline = time.monotonic() + timeout
+    srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+    srv.bind((host, port))
+    srv.listen(1)
+    if ready_cb is not None:
+        ready_cb(srv.getsockname()[1])
+    last_exc: Exception | None = None
+    try:
+        for attempt in range(max_retries + 1):
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                break
+            srv.settimeout(remaining)
+            try:
+                conn, _ = srv.accept()
+            except (socket.timeout, OSError) as e:
+                last_exc = e
+                break  # nobody connected within the budget — no retry
+            conn.settimeout(min(_IO_TIMEOUT_S, max(0.1, remaining)))
+            try:
+                return _receive_one(conn, apply_leaf)
+            except (socket.timeout, ConnectionError, OSError) as e:
+                # Dead/stalled pusher: wait for a fresh connection
+                # instead of burning the rest of the budget on this one.
+                last_exc = e
+                time.sleep(backoff_s * (2 ** attempt))
+    finally:
+        srv.close()
+    raise ConnectionError(
+        f"weight receive failed after {max_retries + 1} attempt(s): "
+        f"{last_exc!r}")
+
+
+def _push_once(conn: socket.socket,
+               leaves: Iterable[tuple[str, np.ndarray]]) -> None:
     try:
         conn.sendall(MAGIC)
         for path, arr in leaves:
@@ -163,3 +189,56 @@ def push_weights(
             )
     finally:
         conn.close()
+
+
+def push_weights(
+    addr: tuple[str, int],
+    leaves: Iterable[tuple[str, np.ndarray]],
+    timeout: float = 300.0,
+    connect_timeout: float = 30.0,
+    max_retries: int = 2,
+    backoff_s: float = 0.1,
+) -> None:
+    """Trainer/peer side: stream ``(path, array)`` pairs to a listening
+    engine. ``ml_dtypes`` dtypes (bfloat16, fp8) ride their numpy dtype
+    names. Connects with RETRY for up to ``connect_timeout``: the engine
+    binds its listener only after draining in-flight steps, so the
+    pusher naturally races the bind.
+
+    A receiver that dies mid-stream fails one I/O-bounded send/recv and
+    the whole push is retried on a fresh connection (a fresh stream
+    restarts from the magic — leaves are idempotent to re-apply) up to
+    ``max_retries`` times within the overall ``timeout`` deadline, after
+    which ConnectionError is raised. ``leaves`` must therefore be
+    re-iterable (a dict ``.items()`` view or list, not a one-shot
+    generator)."""
+    leaves = list(leaves)
+    deadline = time.monotonic() + timeout
+    last_exc: Exception | None = None
+    for attempt in range(max_retries + 1):
+        remaining = deadline - time.monotonic()
+        if remaining <= 0:
+            break
+        connect_deadline = time.monotonic() + min(connect_timeout, remaining)
+        conn = None
+        while conn is None:
+            try:
+                conn = socket.create_connection(
+                    addr, timeout=min(_IO_TIMEOUT_S, remaining))
+            except (ConnectionRefusedError, OSError) as e:
+                last_exc = e
+                if time.monotonic() >= connect_deadline:
+                    break
+                time.sleep(0.1)
+        if conn is None:
+            break  # connect budget exhausted — no point re-attempting
+        conn.settimeout(min(_IO_TIMEOUT_S, max(0.1, remaining)))
+        try:
+            _push_once(conn, leaves)
+            return
+        except (socket.timeout, ConnectionError, OSError) as e:
+            last_exc = e
+            time.sleep(backoff_s * (2 ** attempt))
+    raise ConnectionError(
+        f"weight push to {addr} failed after {max_retries + 1} "
+        f"attempt(s): {last_exc!r}")

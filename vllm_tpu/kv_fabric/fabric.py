@@ -153,6 +153,11 @@ class HostTier:
         """Promotion path: decoded (dequantized) payloads."""
         return [maybe_dequantize(v) for v in self.get_encoded(keys)]
 
+    def keys(self) -> list[str]:
+        """LRU-ordered key snapshot (oldest first)."""
+        with self._lock:
+            return list(self._store)
+
     def stats(self) -> dict:
         with self._lock:
             return {
@@ -255,6 +260,59 @@ class KVFabric(KVConnectorBase):
 
     def set_roofline(self, roofline) -> None:
         self.cost.set_roofline(roofline)
+
+    # -- live peer membership (elastic capacity) -----------------------
+
+    def add_peer(self, url: str) -> None:
+        """Admit a scaled-up engine's fabric server to the peer list."""
+        if url and url not in self.peer_urls:
+            self.peer_urls = tuple(dict.fromkeys([*self.peer_urls, url]))
+
+    def remove_peer(self, url: str) -> None:
+        """Retire a drained engine's fabric server. Its planned fetches
+        are dropped (the invalid-load path recomputes them); an open
+        client socket is closed."""
+        self.peer_urls = tuple(u for u in self.peer_urls if u != url)
+        for k, u in list(self._plan.items()):
+            if u == url:
+                del self._plan[k]
+        client = self._clients.pop(url, None)
+        if client is not None:
+            try:
+                client.close()
+            except Exception:
+                pass
+
+    def drain_host_to_peers(self, max_blocks: int | None = None) -> int:
+        """Scale-down demotion: ship this engine's host-tier blocks to a
+        surviving peer so the pool keeps the hot KV after the proc
+        exits. Newest (most recently used) blocks go first; a dead peer
+        falls through to the next; blocks no peer will take are simply
+        lost (the fabric is a cache — losers recompute). Returns the
+        number of blocks shipped."""
+        keys = list(reversed(self.host.keys()))
+        if max_blocks is not None:
+            keys = keys[:max_blocks]
+        if not keys or not self.peer_urls:
+            return 0
+        peers = [u for u in self.peer_urls if u != self.store_url]
+        shipped = 0
+        for seq in range(0, len(keys), self.PUSH_CHUNK_BLOCKS):
+            chunk = keys[seq:seq + self.PUSH_CHUNK_BLOCKS]
+            try:
+                values = self.host.get_encoded(chunk)
+            except KeyError:
+                continue  # evicted under us
+            for url in peers:
+                try:
+                    self._client(url).put(chunk, values)
+                    shipped += len(chunk)
+                    break
+                except (ConnectionError, OSError):
+                    continue
+        if shipped:
+            self.demotions["host"] += shipped
+        return shipped
 
     def note_device_eviction(self, key: Any) -> None:
         """Block-pool demote sink: a cached block fell out of HBM."""
@@ -506,6 +564,13 @@ class KVFabric(KVConnectorBase):
         return {
             "tier_blocks": {"host": len(self.host)},
             "tier_bytes": {"host": self.host.bytes_used},
+            "tier_budget_bytes": {"host": self.host.max_bytes},
+            # bytes/budget per tier — the autoscaler's occupancy signal
+            # and vllm:kv_fabric_tier_occupancy read the same number.
+            "tier_occupancy": {
+                "host": (self.host.bytes_used / self.host.max_bytes
+                         if self.host.max_bytes > 0 else 0.0),
+            },
             "fetch": dict(self.fetch_outcomes),
             "demotions": dict(self.demotions),
             "fetch_bytes": self.fetch_bytes,

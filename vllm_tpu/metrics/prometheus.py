@@ -144,6 +144,38 @@ class LabeledCounter:
         return "\n".join(out) + "\n"
 
 
+class BiLabeledCounter:
+    """One counter family with two label dimensions (e.g. scale
+    direction x outcome)."""
+
+    def __init__(self, name: str, doc: str, label1: str,
+                 label2: str) -> None:
+        self.name, self.doc = name, doc
+        self.label1, self.label2 = label1, label2
+        self.values: dict[tuple[str, str], float] = {}
+
+    def inc(self, key1: str, key2: str, v: float = 1.0) -> None:
+        self.values[(key1, key2)] = self.values.get((key1, key2), 0.0) + v
+
+    def inc_to(self, key1: str, key2: str, v: float) -> None:
+        """Monotonic ratchet (see Counter.inc_to): counters refreshed from
+        a live snapshot must never render a decrease."""
+        if v > self.values.get((key1, key2), 0.0):
+            self.values[(key1, key2)] = v
+
+    def render(self) -> str:
+        out = [
+            f"# HELP {self.name} {self.doc}",
+            f"# TYPE {self.name} counter",
+        ]
+        for (k1, k2) in sorted(self.values):
+            out.append(
+                f'{self.name}{{{self.label1}="{k1}",{self.label2}="{k2}"}}'
+                f" {self.values[(k1, k2)]}"
+            )
+        return "\n".join(out) + "\n"
+
+
 class LabeledGauge:
     """One gauge family with a single label dimension (e.g. engine id)."""
 
@@ -464,6 +496,13 @@ class PrometheusRegistry:
             "Encoded KV bytes resident per fabric tier (device = HBM "
             "prefix cache estimated from block bytes, host = host-RAM "
             "cold tier actual encoded footprint)", "tier")
+        self.kv_fabric_tier_occupancy = LabeledGauge(
+            "vllm:kv_fabric_tier_occupancy",
+            "Fraction of each fabric tier's budget in use (host = "
+            "encoded bytes over the --kv-connector-cache-gb budget, "
+            "device = HBM prefix-cache blocks over capacity); feeds "
+            "the elastic-capacity controller's memory-pressure signal",
+            "tier")
         # Disaggregated prefill/decode serving (vllm_tpu/disagg):
         # handoff outcomes are refreshed from the client coordinator's
         # live snapshot at render time (same pull scheme as routing);
@@ -488,6 +527,36 @@ class PrometheusRegistry:
             "vllm:disagg_pending_handoffs",
             "Handoffs currently in flight (clamped prefill leg admitted, "
             "decode side not yet producing)")
+        # Elastic capacity (vllm_tpu/resilience/autoscale): pool sizing
+        # and scale-event outcomes, refreshed from the AsyncLLM pool
+        # snapshot at render time (same pull scheme as routing/disagg).
+        self.pool_size_desired = Gauge(
+            "vllm:pool_size_desired",
+            "Engine count the elastic-capacity controller wants (tracks "
+            "actual when no controller is armed)")
+        self.pool_size_actual = Gauge(
+            "vllm:pool_size_actual",
+            "Routable engines right now (up, not draining, not retired)")
+        self.scale_events = BiLabeledCounter(
+            "vllm:scale_events_total",
+            "Completed pool scale events by direction and outcome "
+            "(reseeded = newcomer booted from a live peer's weights, "
+            "fallback_checkpoint = peer re-seed failed and the slot "
+            "reloaded from checkpoint, drained = victim retired after "
+            "its in-flight requests finished, deadline_replay = drain "
+            "deadline hit and stragglers replayed on survivors, "
+            "timeout/died_draining/orphaned = chaos paths)",
+            "direction", "outcome")
+        self.engine_drain_duration = Histogram(
+            "vllm:engine_drain_duration_seconds",
+            "Wall time from scale-down victim selection to slot "
+            "retirement",
+            [0.1, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0, 120.0])
+        self.weight_reseed = LabeledCounter(
+            "vllm:weight_reseed_total",
+            "Peer weight re-seed attempts by outcome (ok = newcomer "
+            "adopted a live peer's weights over the fabric push path, "
+            "fallback = checkpoint reload)", "outcome")
         # SLO scoreboard (vllm_tpu/metrics/reqtrace + goodput): per-class
         # latency families fed from the class-labeled IterationStats
         # samples, a sliding-window attainment gauge pulled from the
@@ -549,9 +618,12 @@ class PrometheusRegistry:
             self.perf_captures, self.perf_captures_aborted,
             self.kv_fabric_tier_blocks, self.kv_fabric_fetches,
             self.kv_fabric_demotions, self.kv_fabric_fetch_bytes,
-            self.kv_fabric_tier_bytes,
+            self.kv_fabric_tier_bytes, self.kv_fabric_tier_occupancy,
             self.disagg_handoffs, self.disagg_push_bytes,
             self.disagg_handoff_duration, self.disagg_pending,
+            self.pool_size_desired, self.pool_size_actual,
+            self.scale_events, self.engine_drain_duration,
+            self.weight_reseed,
             self.slo_ttft, self.slo_itl, self.slo_attainment,
             self.trace_records,
         ]
@@ -665,6 +737,8 @@ class PrometheusRegistry:
                     float(fab.get("fetch_bytes", 0)))
                 for tier, n in (fab.get("tier_bytes") or {}).items():
                     self.kv_fabric_tier_bytes.set(tier, float(n))
+                for tier, n in (fab.get("tier_occupancy") or {}).items():
+                    self.kv_fabric_tier_occupancy.set(tier, float(n))
                 self.disagg_push_bytes.inc_to(
                     float(fab.get("push_bytes", 0)))
         if iteration_stats is not None:
@@ -788,6 +862,35 @@ class PrometheusRegistry:
             self.disagg_handoff_duration.observe(float(d))
         self.disagg_pending.set(float(status.get("pending", 0)))
 
+    def _refresh_autoscale(self) -> None:
+        engine = self._engine
+        if engine is None or not hasattr(engine, "autoscale_status"):
+            return
+        try:
+            status = engine.autoscale_status(drain=True)
+        except Exception:
+            return
+        if not status:
+            return
+        pool = status.get("pool", {})
+        ctrl = status.get("controller")
+        actual = float(pool.get("actual", 0))
+        self.pool_size_actual.set(actual)
+        self.pool_size_desired.set(
+            float(ctrl["desired"]) if ctrl is not None else actual)
+        # Event totals are cumulative in the controller snapshot →
+        # ratchet; drain durations arrive drained (since last render)
+        # → observe each once.
+        if ctrl is not None:
+            for key, n in (ctrl.get("scale_events_total") or {}).items():
+                direction, _, outcome = key.partition("/")
+                self.scale_events.inc_to(direction, outcome, float(n))
+            for outcome, n in (ctrl.get("weight_reseed_total")
+                               or {}).items():
+                self.weight_reseed.inc_to(outcome, float(n))
+        for d in pool.get("drain_durations_s", []):
+            self.engine_drain_duration.observe(float(d))
+
     def _refresh_lifecycle(self) -> None:
         engine = self._engine
         if engine is None or not hasattr(engine, "lifecycle_status"):
@@ -829,6 +932,7 @@ class PrometheusRegistry:
         self._refresh_lifecycle()
         self._refresh_routing()
         self._refresh_disagg()
+        self._refresh_autoscale()
         self._refresh_failpoints()
         self._refresh_slo()
         return "".join(m.render() for m in self._metrics)

@@ -41,6 +41,7 @@ failpoint sites compiled into the hot seams, armed via
 chaos schedules + global-invariant checking over a live engine).
 """
 
+from vllm_tpu.resilience.autoscale import AutoscaleController
 from vllm_tpu.resilience.config import ResilienceConfig
 from vllm_tpu.resilience.journal import JournalEntry, RequestJournal
 from vllm_tpu.resilience.mesh_recovery import (
@@ -111,6 +112,7 @@ class RequestFailedOnCrashError(RuntimeError):
 
 __all__ = [
     "AdmissionController",
+    "AutoscaleController",
     "DeadLetterStore",
     "EngineRestartedError",
     "EngineSupervisor",

@@ -94,6 +94,42 @@ class ResilienceConfig:
     # Beat period on the heartbeat ring. Must be well under the death
     # timeout (a single delayed datagram must not look like a death).
     mesh_heartbeat_interval_s: float = 0.2
+    # ------------------------------------------------------------------
+    # Elastic capacity (vllm_tpu/resilience/autoscale): traffic-driven
+    # pool resizing on the recovery substrate. Off by default; requires
+    # a DP pool (data_parallel_engines > 1) to do anything. Escape hatch
+    # VLLM_TPU_DISABLE_AUTOSCALE overrides the flag at runtime.
+    autoscale: bool = False
+    # Pool-size bounds. max=0 means "initial pool size" (scale-down
+    # only); both are clamped against data_parallel_engines at wiring
+    # time, not here (this config doesn't know the pool size).
+    autoscale_min_engines: int = 1
+    autoscale_max_engines: int = 0
+    # Queue-depth watermarks (waiting+running requests per up engine,
+    # EMA-smoothed). Pressure at >= up, slack at <= down; the band
+    # between them is the hysteresis dead zone.
+    autoscale_up_queue_depth: float = 4.0
+    autoscale_down_queue_depth: float = 0.5
+    # Scale up when the worst per-class sliding-window SLO attainment
+    # drops below this floor (0 disables the signal — attainment is
+    # only meaningful when --slo-targets is configured).
+    autoscale_slo_floor: float = 0.0
+    # Scale up when any kv-fabric tier's occupancy (bytes/budget)
+    # crosses this fraction.
+    autoscale_occupancy_high: float = 0.95
+    # A pressure/slack signal must persist this long before it acts;
+    # after any scale event the controller holds off for the cooldown.
+    autoscale_hold_s: float = 5.0
+    autoscale_cooldown_s: float = 30.0
+    # Sampling cadence for the signal poll in the engine busy loop.
+    autoscale_interval_s: float = 1.0
+    # Graceful scale-down: the drained engine gets this long for its
+    # in-flight requests to finish; past it, stragglers journal-replay
+    # onto the surviving engines (zero lost, same path as a crash).
+    autoscale_drain_deadline_s: float = 30.0
+    # Budget for re-seeding a new engine's weights from a peer over the
+    # weight-transfer push path before falling back to checkpoint reload.
+    autoscale_reseed_timeout_s: float = 120.0
 
     def finalize(self) -> "ResilienceConfig":
         if self.max_engine_restarts < 0:
@@ -148,5 +184,51 @@ class ResilienceConfig:
                 f"exceed mesh_heartbeat_interval_s "
                 f"({self.mesh_heartbeat_interval_s}): a single late beat "
                 "must not classify as host death"
+            )
+        if self.autoscale_min_engines < 1:
+            raise ValueError(
+                f"autoscale_min_engines must be >= 1, got "
+                f"{self.autoscale_min_engines}"
+            )
+        if self.autoscale_max_engines < 0:
+            raise ValueError(
+                f"autoscale_max_engines must be >= 0 (0 = initial pool "
+                f"size), got {self.autoscale_max_engines}"
+            )
+        if not (0.0 <= self.autoscale_down_queue_depth
+                < self.autoscale_up_queue_depth):
+            raise ValueError(
+                f"autoscale queue watermarks must satisfy 0 <= down < up, "
+                f"got down={self.autoscale_down_queue_depth} "
+                f"up={self.autoscale_up_queue_depth}"
+            )
+        if not (0.0 <= self.autoscale_slo_floor <= 1.0):
+            raise ValueError(
+                f"autoscale_slo_floor must be in [0, 1], got "
+                f"{self.autoscale_slo_floor}"
+            )
+        if not (0.0 < self.autoscale_occupancy_high <= 1.0):
+            raise ValueError(
+                f"autoscale_occupancy_high must be in (0, 1], got "
+                f"{self.autoscale_occupancy_high}"
+            )
+        if self.autoscale_hold_s < 0 or self.autoscale_cooldown_s < 0:
+            raise ValueError(
+                "autoscale_hold_s and autoscale_cooldown_s must be >= 0"
+            )
+        if self.autoscale_interval_s <= 0:
+            raise ValueError(
+                f"autoscale_interval_s must be > 0, got "
+                f"{self.autoscale_interval_s}"
+            )
+        if self.autoscale_drain_deadline_s <= 0:
+            raise ValueError(
+                f"autoscale_drain_deadline_s must be > 0, got "
+                f"{self.autoscale_drain_deadline_s}"
+            )
+        if self.autoscale_reseed_timeout_s <= 0:
+            raise ValueError(
+                f"autoscale_reseed_timeout_s must be > 0, got "
+                f"{self.autoscale_reseed_timeout_s}"
             )
         return self

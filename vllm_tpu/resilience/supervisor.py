@@ -128,6 +128,13 @@ class EngineSupervisor:
             st.up = False
             st.restarts = max(st.restarts, self.config.max_engine_restarts)
 
+    def remove(self, engine_id: int) -> None:
+        """Forget a retired engine slot (autoscale scale-down): a drained
+        engine that exited on purpose must not count against readiness
+        or linger in /health."""
+        with self._lock:
+            self._engines.pop(engine_id, None)
+
     # -- snapshots -----------------------------------------------------
 
     def is_up(self, engine_id: int) -> bool:

@@ -2836,6 +2836,27 @@ class ModelRunner:
 
         return receive_weights(apply_leaf, port=port, timeout=timeout)
 
+    def push_weights_to(self, host: str, port: int,
+                        timeout: float = 300.0) -> int:
+        """Elastic scale-up re-seed, donor side: stream every resident
+        param leaf to a peer's :meth:`receive_weights_push` listener.
+        Leaves are device_get on the way out (params are immutable, so
+        a serving engine can donate without quiescing); the receiver
+        re-applies them with its own resident shardings."""
+        import jax
+
+        from vllm_tpu.kv_connector.weight_transfer import (
+            leaf_paths,
+            push_weights,
+        )
+
+        leaves = [
+            (path, np.asarray(jax.device_get(leaf)))
+            for path, leaf in leaf_paths(self.params).items()
+        ]
+        push_weights((host, port), leaves, timeout=timeout)
+        return len(leaves)
+
     def update_weights(self, path: str) -> None:
         """In-place weight swap for RL rollouts (reference:
         ``gpu_worker.py update_weights :978``). Loads a new checkpoint with

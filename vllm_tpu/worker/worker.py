@@ -709,6 +709,11 @@ class Worker:
         assert self.runner is not None
         return self.runner.receive_weights_push(port, timeout)
 
+    def push_weights_to(self, host: str, port: int,
+                        timeout: float = 300.0) -> int:
+        assert self.runner is not None
+        return self.runner.push_weights_to(host, port, timeout)
+
     def save_sharded_state(self, path: str) -> None:
         """Dump the ASSEMBLED param tree for fast reload (reference:
         ``gpu_worker.py:939 save_sharded_state`` + sharded_state_loader).
