@@ -94,13 +94,16 @@ def _delta_params(max_tokens, **kw):
 
 
 def _shed_counts(metrics_text):
-    return {
-        m.group(1): float(m.group(2))
-        for m in re.finditer(
-            r'vllm:requests_shed_total\{reason="([^"]+)"\}\s+([0-9.]+)',
-            metrics_text,
-        )
-    }
+    # {reason,tenant} breakdown since the QoS PR: sum over tenants to
+    # recover the per-reason totals these tests assert on.
+    counts: dict = {}
+    for m in re.finditer(
+        r'vllm:requests_shed_total\{reason="([^"]+)"'
+        r'(?:,tenant="[^"]*")?\}\s+([0-9.]+)',
+        metrics_text,
+    ):
+        counts[m.group(1)] = counts.get(m.group(1), 0.0) + float(m.group(2))
+    return counts
 
 
 def test_http_burst_sheds_with_429_and_retry_after(capped_engine):

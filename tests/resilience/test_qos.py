@@ -1,0 +1,323 @@
+"""Unit tests for the QoS layer (resilience/qos.py): tenant-weight
+parsing, the weighted fair queue's share/debt math, the brownout
+ladder's escalation/hysteresis state machine, and the
+AdmissionController's work-conserving WFQ shed rule.
+
+Everything here is pure and clockless (``observe`` takes ``now``
+explicitly), so these run in tier-1.
+"""
+
+from __future__ import annotations
+
+import pytest
+
+from vllm_tpu.resilience.lifecycle import (
+    AdmissionController,
+    LifecycleConfig,
+    make_shed_error,
+)
+from vllm_tpu.resilience.qos import (
+    BrownoutConfig,
+    BrownoutController,
+    TenantFairQueue,
+    parse_tenant_weights,
+)
+
+# ---------------------------------------------------------------------------
+# parse_tenant_weights
+# ---------------------------------------------------------------------------
+
+
+def test_parse_tenant_weights_basic():
+    assert parse_tenant_weights(None) == {}
+    assert parse_tenant_weights("") == {}
+    assert parse_tenant_weights("acme:3,bulk:1") == {"acme": 3.0, "bulk": 1.0}
+    # Whitespace and trailing separators are tolerated.
+    assert parse_tenant_weights(" acme : 2.5 , ") == {"acme": 2.5}
+
+
+@pytest.mark.parametrize("spec", ["acme", ":3", "acme:x", "acme:0", "a:-1"])
+def test_parse_tenant_weights_rejects(spec):
+    with pytest.raises(ValueError):
+        parse_tenant_weights(spec)
+
+
+# ---------------------------------------------------------------------------
+# TenantFairQueue
+# ---------------------------------------------------------------------------
+
+
+def test_wfq_lone_tenant_gets_whole_budget():
+    q = TenantFairQueue()
+    assert q.share("a", 100) == 100.0
+    assert not q.would_exceed_share("a", 100, 100)
+    # budget 0 = unlimited: never over-share.
+    assert not q.would_exceed_share("a", 10**9, 0)
+
+
+def test_wfq_weighted_shares_among_active_tenants():
+    q = TenantFairQueue({"a": 3.0, "b": 1.0})
+    q.admit("r1", "a", 10)
+    q.admit("r2", "b", 10)
+    assert q.share("a", 100) == pytest.approx(75.0)
+    assert q.share("b", 100) == pytest.approx(25.0)
+    # A tenant with no inflight still counts itself when probing.
+    assert q.share("c", 100) == pytest.approx(100 * 1 / 5)
+
+
+def test_wfq_work_conserving_shed_rule():
+    # Storm tenant a holds 80 of a 100-token budget alongside light
+    # tenant b: b stays under its 25-token share and would still admit,
+    # while a is far over its 75 and would shed.
+    q = TenantFairQueue({"a": 3.0, "b": 1.0})
+    q.admit("ra", "a", 80)
+    q.admit("rb", "b", 10)
+    assert not q.would_exceed_share("b", 10, 100)
+    assert q.would_exceed_share("a", 10, 100)
+
+
+def test_wfq_admit_idempotent_release_exactly_once():
+    q = TenantFairQueue()
+    q.admit("r1", "a", 10)
+    q.admit("r1", "a", 10)  # duplicate admit is a no-op
+    assert q.inflight("a") == 10
+    q.release("r1")
+    assert q.inflight("a") == 0
+    q.release("r1")  # duplicate release is a no-op
+    assert q.inflight("a") == 0
+    assert q.snapshot()["inflight_tokens"].get("a", 0) == 0
+
+
+def test_wfq_requeue_recharges_debt_not_reservation():
+    q = TenantFairQueue()
+    q.admit("r1", "a", 10)
+    debt0 = q.debt("a")
+    assert debt0 == pytest.approx(10.0)
+    q.note_requeue("r1")
+    # The preempt/resume cycle pays twice in virtual time ...
+    assert q.debt("a") == pytest.approx(20.0)
+    # ... but the token reservation is untouched.
+    assert q.inflight("a") == 10
+    assert q.snapshot()["requeues"] == {"a": 1}
+    q.note_requeue("nonexistent")  # unknown rid: no-op
+    assert q.snapshot()["requeues"] == {"a": 1}
+    q.release("r1")
+    assert q.inflight("a") == 0
+
+
+def test_wfq_vclock_catches_up_when_idle():
+    # An idle pool advances the virtual clock to the max finish time so
+    # idle tenants don't bank unbounded credit against the next burst.
+    q = TenantFairQueue()
+    q.admit("r1", "a", 50)
+    assert q.debt("a") > 0
+    q.release("r1")
+    assert q.debt("a") == 0.0
+
+
+def test_wfq_debt_favors_light_tenant():
+    q = TenantFairQueue({"heavy": 1.0, "light": 1.0})
+    q.admit("h1", "heavy", 40)
+    q.admit("l1", "light", 10)
+    assert q.debt("heavy") > q.debt("light")
+
+
+# ---------------------------------------------------------------------------
+# BrownoutController
+# ---------------------------------------------------------------------------
+
+
+def _ctrl(**overrides) -> BrownoutController:
+    kw = dict(
+        enabled=True,
+        occupancy_high=0.9,
+        queue_depth_high=8.0,
+        # Near-zero half life => the EMA tracks each sample exactly, so
+        # the state machine (not the smoother) is what's under test.
+        ema_half_life_s=1e-6,
+        step_up_hold_s=1.0,
+        step_down_hold_s=5.0,
+        disengage_margin=0.1,
+        max_rung=4,
+    )
+    kw.update(overrides)
+    return BrownoutController(BrownoutConfig(**kw).finalize())
+
+
+def test_brownout_first_rung_immediate_then_dwell():
+    c = _ctrl()
+    # Rung 0 -> 1 on the very first pressured observation.
+    assert c.observe(occupancy=1.0, queue_depth=0.0, now=0.0) == 1
+    # Further rungs need the dwell to elapse.
+    assert c.observe(occupancy=1.0, queue_depth=0.0, now=0.5) == 1
+    assert c.observe(occupancy=1.0, queue_depth=0.0, now=1.0) == 2
+    assert c.observe(occupancy=1.0, queue_depth=0.0, now=2.0) == 3
+    assert c.observe(occupancy=1.0, queue_depth=0.0, now=3.0) == 4
+    # Capped at max_rung.
+    assert c.observe(occupancy=1.0, queue_depth=0.0, now=4.0) == 4
+    snap = c.snapshot()
+    assert snap["action"] == "batch_preempt"
+    assert snap["transitions"] == {"1:up": 1, "2:up": 1, "3:up": 1,
+                                   "4:up": 1}
+
+
+def test_brownout_queue_depth_and_slo_floor_also_engage():
+    c = _ctrl()
+    assert c.observe(occupancy=0.1, queue_depth=9.0, now=0.0) == 1
+    c2 = _ctrl(slo_floor=0.95)
+    assert c2.observe(occupancy=0.1, queue_depth=0.0,
+                      slo_attainment=0.5, now=0.0) == 1
+
+
+def test_brownout_hysteresis_band_holds_rung():
+    c = _ctrl()
+    assert c.observe(occupancy=1.0, queue_depth=0.0, now=0.0) == 1
+    # 0.85 is below the engage watermark (0.9) but above the disengage
+    # watermark (0.9 - 0.1): neither escalate nor step down, forever.
+    for t in (1.0, 10.0, 100.0):
+        assert c.observe(occupancy=0.85, queue_depth=0.0, now=t) == 1
+
+
+def test_brownout_step_down_one_rung_per_hold():
+    c = _ctrl()
+    c.observe(occupancy=1.0, queue_depth=0.0, now=0.0)
+    c.observe(occupancy=1.0, queue_depth=0.0, now=1.0)  # rung 2
+    assert c.rung == 2
+    assert c.observe(occupancy=0.0, queue_depth=0.0, now=2.0) == 2
+    assert c.observe(occupancy=0.0, queue_depth=0.0, now=6.9) == 2
+    assert c.observe(occupancy=0.0, queue_depth=0.0, now=7.0) == 1
+    assert c.observe(occupancy=0.0, queue_depth=0.0, now=12.0) == 0
+    assert c.snapshot()["transitions"]["1:down"] == 1
+    assert c.snapshot()["transitions"]["0:down"] == 1
+
+
+def test_brownout_pressure_resets_disengage_hold():
+    c = _ctrl()
+    c.observe(occupancy=1.0, queue_depth=0.0, now=0.0)
+    c.observe(occupancy=0.0, queue_depth=0.0, now=1.0)  # clear starts
+    c.observe(occupancy=1.0, queue_depth=0.0, now=2.0)  # pressure again
+    # The earlier clear window must not count toward the hold.
+    assert c.observe(occupancy=0.0, queue_depth=0.0, now=3.0) == 1
+    assert c.observe(occupancy=0.0, queue_depth=0.0, now=7.9) == 1
+    assert c.observe(occupancy=0.0, queue_depth=0.0, now=8.0) == 0
+
+
+def test_brownout_time_at_rung_accounting():
+    c = _ctrl()
+    c.observe(occupancy=1.0, queue_depth=0.0, now=0.0)  # -> rung 1
+    c.observe(occupancy=1.0, queue_depth=0.0, now=2.0)  # 2s at rung 1
+    snap = c.snapshot()
+    assert snap["time_at_rung"]["1"] == pytest.approx(2.0)
+
+
+def test_brownout_retry_after_scales_with_rung():
+    c = _ctrl()
+    assert c.retry_after_s(1.5) == 1.5
+    c.observe(occupancy=1.0, queue_depth=0.0, now=0.0)
+    c.observe(occupancy=1.0, queue_depth=0.0, now=1.0)
+    c.observe(occupancy=1.0, queue_depth=0.0, now=2.0)  # rung 3
+    assert c.retry_after_s(1.5) == pytest.approx(4.5)
+
+
+def test_brownout_config_validation():
+    with pytest.raises(ValueError):
+        BrownoutConfig(max_rung=0).finalize()
+    with pytest.raises(ValueError):
+        BrownoutConfig(occupancy_high=0.0).finalize()
+    with pytest.raises(ValueError):
+        BrownoutConfig(disengage_margin=0.95).finalize()
+    assert (BrownoutConfig(shed_classes="batch, best_effort")
+            .shed_class_set() == {"batch", "best_effort"})
+
+
+# ---------------------------------------------------------------------------
+# AdmissionController WFQ integration
+# ---------------------------------------------------------------------------
+
+
+def _admission(**overrides) -> AdmissionController:
+    kw = dict(max_queued_prompt_tokens=100,
+              tenant_weights="heavy:1,light:1")
+    kw.update(overrides)
+    return AdmissionController(LifecycleConfig(**kw).finalize())
+
+
+def test_admission_single_tenant_degrades_to_global_cap():
+    ac = _admission(tenant_weights=None)
+    assert ac.try_admit("r1", 60) is None
+    # A lone tenant's share IS the whole budget, so the WFQ rule adds
+    # nothing beyond the plain global cap.
+    assert ac.try_admit("r2", 50) == "saturated_tokens"
+    ac.release("r1")
+    assert ac.try_admit("r2", 50) is None
+    ac.release("r2")
+    assert ac.inflight_prompt_tokens == 0
+
+
+def test_admission_wfq_protects_light_tenant():
+    ac = _admission()
+    assert ac.try_admit("h1", 80, tenant_id="heavy") is None
+    # Global budget exhausted, but light is under its 50-token share:
+    # work-conserving admit.
+    assert ac.try_admit("l1", 30, tenant_id="light") is None
+    # The storm tenant is over its share: shed.
+    assert ac.try_admit("h2", 10, tenant_id="heavy") == "saturated_tokens"
+    st = ac.status()
+    assert st["shed"] == {"saturated_tokens": 1}
+    assert st["shed_by_tenant"] == {"saturated_tokens": {"heavy": 1}}
+    # FIFO A/B toggle: with WFQ off the same light request sheds too.
+    ac.wfq_enabled = False
+    assert ac.try_admit("l2", 5, tenant_id="light") == "saturated_tokens"
+    ac.wfq_enabled = True
+    # Per-reason totals always equal the tenant breakdown's sum.
+    st = ac.status()
+    for reason, total in st["shed"].items():
+        assert sum(st["shed_by_tenant"][reason].values()) == total
+    ac.release("h1")
+    ac.release("l1")
+    assert ac.inflight_requests == 0
+    assert ac.inflight_prompt_tokens == 0
+    assert all(v == 0 for v in ac.status()["wfq"]["inflight_tokens"].values())
+
+
+def test_admission_note_requeue_charges_wfq_debt():
+    ac = _admission()
+    ac.try_admit("r1", 40, tenant_id="heavy")
+    debt0 = ac.status()["wfq"]["debt"]["heavy"]
+    ac.note_requeue("r1")
+    st = ac.status()
+    assert st["wfq"]["requeues"] == {"heavy": 1}
+    assert st["wfq"]["debt"]["heavy"] > debt0
+    # Reservation untouched: release is still exactly-once.
+    assert st["inflight_prompt_tokens"] == 40
+    ac.release("r1")
+    assert ac.inflight_prompt_tokens == 0
+
+
+def test_admission_count_shed_external_reason():
+    # Frontend-decided sheds (brownout rung 3) flow through count_shed
+    # and land in both maps, keeping the balance invariant.
+    ac = _admission()
+    ac.count_shed("brownout", "bulk")
+    ac.count_shed("brownout", "bulk")
+    ac.count_shed("brownout")
+    st = ac.status()
+    assert st["shed"]["brownout"] == 3
+    assert st["shed_by_tenant"]["brownout"] == {"bulk": 2, "default": 1}
+
+
+def test_lifecycle_config_validates_qos_knobs():
+    with pytest.raises(ValueError):
+        LifecycleConfig(tenant_weights="acme:nope").finalize()
+    with pytest.raises(ValueError):
+        LifecycleConfig(brownout_max_rung=9).finalize()
+
+
+def test_make_shed_error_brownout_retry_after_override():
+    cfg = LifecycleConfig(retry_after_s=1.0).finalize()
+    err = make_shed_error("brownout", cfg, retry_after_s=4.0)
+    assert err.reason == "brownout"
+    assert err.retry_after_s == 4.0
+    assert err.http_status == 429
+    assert make_shed_error("draining", cfg).http_status == 503
+    assert make_shed_error("saturated_tokens", cfg).retry_after_s == 1.0

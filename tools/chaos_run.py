@@ -139,6 +139,19 @@ def build_parser() -> argparse.ArgumentParser:
                         "fallback; victim = during drain, stragglers "
                         "must replay on survivors) — zero lost either "
                         "way")
+    p.add_argument("--overload-storm", action="store_true",
+                   help="QoS-under-pressure scenario instead of the "
+                        "seeded fault schedule: a seeded mixed-priority "
+                        "(interactive p0 / batch p10), mixed-tenant "
+                        "burst hits a deliberately slowed engine "
+                        "(engine_core.step delay failpoints) with the "
+                        "brownout ladder, WFQ admission, and pressure "
+                        "preemption armed; passes iff zero requests are "
+                        "lost, terminals are exactly-once, the per-"
+                        "tenant shed counters balance the per-reason "
+                        "totals, the ladder actually engaged, and no "
+                        "interactive (priority-0) request was ever "
+                        "preempted")
     p.add_argument("--ramp-qps", type=float, default=8.0,
                    help="offered load during the high phase")
     p.add_argument("--ramp-low-qps", type=float, default=0.5,
@@ -456,11 +469,190 @@ def _run_traffic_ramp(args) -> int:
     return 0 if ok else 1
 
 
+def _run_overload_storm(args) -> int:
+    """QoS-under-pressure scenario: a seeded mixed-priority, mixed-tenant
+    burst against a deliberately slowed engine, with WFQ admission, the
+    brownout ladder, and pressure preemption all armed.
+
+    The storm must be *survived correctly*, not avoided: every request
+    reaches exactly one terminal state (served or cleanly shed — zero
+    lost, zero hung), the ``{reason,tenant}`` shed breakdown balances the
+    per-reason totals, the brownout ladder actually engaged, and no
+    interactive (priority-0) request was ever preempted — rung 4 and
+    pressure preemption may only victimize batch decodes.
+    """
+    import random
+    import time
+
+    from vllm_tpu.engine.arg_utils import AsyncEngineArgs
+    from vllm_tpu.engine.async_llm import AsyncLLM
+    from vllm_tpu.resilience import failpoints
+    from vllm_tpu.resilience.chaos import (
+        OUTCOME_ERROR,
+        OUTCOME_FINISHED,
+        OUTCOME_HUNG,
+        InvariantLedger,
+    )
+    from vllm_tpu.resilience.lifecycle import RequestShedError
+    from vllm_tpu.sampling_params import RequestOutputKind, SamplingParams
+
+    # Slow the scheduler's step loop so the burst builds real queue
+    # pressure. Armed in-process BEFORE the engine is built; the uniproc
+    # client shares this process's failpoint registry.
+    storm_spec = "engine_core.step.schedule=64*delay(0.02)"
+    failpoints.configure(storm_spec, seed=args.seed)
+    print(f"storm: armed {storm_spec!r}", file=sys.stderr)
+
+    interactive_tenants = ("acme", "beta")
+    batch_tenant = "bulk"
+    engine = AsyncLLM.from_engine_args(AsyncEngineArgs(
+        model=args.model,
+        max_model_len=args.max_model_len,
+        max_num_seqs=4,
+        # Tight token budget so WFQ actually arbitrates the burst.
+        max_queued_prompt_tokens=512,
+        tenant_weights="acme:3,beta:3,bulk:1",
+        brownout=True,
+        brownout_occupancy_high=0.6,
+        brownout_queue_depth_high=3.0,
+        brownout_step_up_hold_s=0.05,
+        # Stay engaged through the whole storm (no mid-run flapping).
+        brownout_step_down_hold_s=30.0,
+        brownout_interval_s=0.01,
+        pressure_preemption_s=0.1,
+        max_preemptions_per_step=1,
+    ))
+
+    rng = random.Random(args.seed ^ 0x570B)
+    n = max(args.requests, 24)
+    # Seeded class draw: ~60% interactive, ~40% batch.
+    is_interactive = [rng.random() < 0.6 for _ in range(n)]
+    jitter = [rng.uniform(0.0, 0.02) for _ in range(n)]
+    ledger = InvariantLedger()
+
+    async def one(i: int) -> None:
+        interactive = is_interactive[i]
+        rid = f"storm-{args.seed}-{'i' if interactive else 'b'}{i}"
+        params = SamplingParams(
+            temperature=0.0,
+            max_tokens=args.max_tokens,
+            ignore_eos=True,
+            detokenize=False,
+            slo_class="interactive" if interactive else "batch",
+            tenant_id=(interactive_tenants[i % 2] if interactive
+                       else batch_tenant),
+            priority=0 if interactive else 10,
+            output_kind=RequestOutputKind.DELTA,
+        )
+        plen = 8 if interactive else 24
+        prompt = {"prompt_token_ids": [(7 * i + 3) % 50 + 1] * plen}
+        await asyncio.sleep(jitter[i])
+        finished = False
+        try:
+            ledger.record_admitted(rid)
+
+            async def consume() -> None:
+                nonlocal finished
+                async for out in engine.generate(prompt, params, rid):
+                    if finished:
+                        ledger.record_post_final_item(rid)
+                    if out.finished:
+                        finished = True
+
+            await asyncio.wait_for(consume(), args.request_timeout)
+            ledger.record_outcome(
+                rid, OUTCOME_FINISHED if finished else OUTCOME_ERROR)
+        except RequestShedError:
+            # Shed before anything was queued: not admitted.
+            ledger.admitted.discard(rid)
+            ledger.record_shed(rid)
+        except asyncio.TimeoutError:
+            ledger.record_outcome(rid, OUTCOME_HUNG)
+        except Exception:
+            ledger.record_outcome(rid, OUTCOME_ERROR)
+
+    async def body() -> None:
+        t0 = time.monotonic()
+        # One open-loop burst — no client-side concurrency cap; shaping
+        # the storm is the QoS layer's job, not the harness's.
+        await asyncio.gather(*[one(i) for i in range(n)])
+        print(f"storm: burst drained in {time.monotonic() - t0:.1f}s",
+              file=sys.stderr)
+
+    try:
+        asyncio.run(body())
+        qos = engine.qos_status() or {}
+        status = engine.admission.status()
+        violations = ledger.check(engine)
+    finally:
+        failpoints.deactivate()
+        engine.shutdown()
+
+    summary = ledger.summary()
+    print(f"storm: admitted={summary['admitted']} "
+          f"shed={summary['shed']} outcomes={summary['outcomes']}",
+          file=sys.stderr)
+    print(f"storm: shed_by_tenant={status.get('shed_by_tenant')}",
+          file=sys.stderr)
+    brownout = qos.get("brownout") or {}
+    print(f"storm: brownout transitions={brownout.get('transitions')} "
+          f"time_at_rung={brownout.get('time_at_rung')}", file=sys.stderr)
+    wfq = (qos.get("wfq") or {})
+    print(f"storm: wfq requeues={wfq.get('requeues')}", file=sys.stderr)
+
+    ok = True
+    for v in violations:
+        print(f"VIOLATION: {v}", file=sys.stderr)
+        ok = False
+
+    # Per-tenant shed counters must balance the per-reason totals, and
+    # the grand total must equal what the clients observed.
+    shed_total = status.get("shed") or {}
+    shed_by_tenant = status.get("shed_by_tenant") or {}
+    for reason, total in shed_total.items():
+        tenant_sum = sum((shed_by_tenant.get(reason) or {}).values())
+        if tenant_sum != total:
+            print(f"STORM: shed[{reason}] tenant breakdown sums to "
+                  f"{tenant_sum}, reason total is {total}",
+                  file=sys.stderr)
+            ok = False
+    if sum(shed_total.values()) != len(ledger.shed):
+        print(f"STORM: admission counted {sum(shed_total.values())} "
+              f"shed(s) but clients observed {len(ledger.shed)}",
+              file=sys.stderr)
+        ok = False
+
+    # The storm must actually have engaged the ladder, else nothing was
+    # exercised.
+    ups = [k for k in (brownout.get("transitions") or {})
+           if k.endswith(":up")]
+    if not ups:
+        print("STORM: brownout ladder never engaged (no up transition)",
+              file=sys.stderr)
+        ok = False
+
+    # No interactive (priority-0) request may ever be preempted: every
+    # preemption requeue is charged to its tenant's WFQ debt, so the
+    # interactive tenants must show zero requeues.
+    requeues = wfq.get("requeues") or {}
+    for tenant in interactive_tenants:
+        if requeues.get(tenant, 0) > 0:
+            print(f"STORM: interactive tenant {tenant!r} was preempted "
+                  f"{requeues[tenant]}x (requeues={requeues})",
+                  file=sys.stderr)
+            ok = False
+
+    print("ok" if ok else "FAILED", file=sys.stderr)
+    return 0 if ok else 1
+
+
 def main(argv: list[str] | None = None) -> int:
     args = build_parser().parse_args(argv)
 
     if args.traffic_ramp:
         return _run_traffic_ramp(args)
+    if args.overload_storm:
+        return _run_overload_storm(args)
 
     from vllm_tpu.engine.arg_utils import AsyncEngineArgs
     from vllm_tpu.engine.async_llm import AsyncLLM

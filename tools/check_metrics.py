@@ -149,10 +149,21 @@ REQUIRED_AUTOSCALE_METRICS = {
     "vllm:kv_fabric_tier_occupancy",
 }
 
+# Documented in the README ("QoS & brownout"); the overload-storm chaos
+# scenario and the bench FIFO-vs-QoS A/B assert on these names.
+REQUIRED_QOS_METRICS = {
+    "vllm:brownout_rung",
+    "vllm:brownout_transitions_total",
+    "vllm:brownout_time_at_rung_seconds",
+    "vllm:pressure_preemptions_total",
+    "vllm:tenant_inflight_tokens",
+    "vllm:tenant_debt",
+}
+
 # Floor on the registry size: a refactor that silently drops metrics
 # from the render list must fail the lint even if no required-set name
 # is among the casualties. Bump when adding metrics.
-MIN_METRICS = 86
+MIN_METRICS = 92
 
 
 def check() -> list[str]:
@@ -256,6 +267,10 @@ def check() -> list[str]:
     for name in sorted(REQUIRED_AUTOSCALE_METRICS - set(seen)):
         errors.append(
             f"required elastic-capacity metric {name} is missing from "
+            f"the registry (documented in README)")
+    for name in sorted(REQUIRED_QOS_METRICS - set(seen)):
+        errors.append(
+            f"required QoS/brownout metric {name} is missing from "
             f"the registry (documented in README)")
 
     if len(reg._metrics) < MIN_METRICS:

@@ -50,7 +50,8 @@ if _REPO_ROOT not in sys.path:
     sys.path.insert(0, _REPO_ROOT)
 
 SHED_RE = re.compile(
-    r'^vllm:requests_shed_total\{reason="[^"]+"\}\s+([0-9.]+)$')
+    r'^vllm:requests_shed_total\{reason="[^"]+"'
+    r'(?:,tenant="[^"]*")?\}\s+([0-9.]+)$')
 
 
 def _shed_total(metrics_text: str) -> float:

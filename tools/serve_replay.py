@@ -61,6 +61,7 @@ async def _replay(session, base_url: str, records: list[dict], *,
                   vocab: int) -> tuple[dict, list[str]]:
     from vllm_tpu.benchmarks.run import score_replay
     from vllm_tpu.entrypoints.openai.api_server import (
+        PRIORITY_HEADER,
         SLO_CLASS_HEADER,
         TENANT_HEADER,
     )
@@ -69,7 +70,8 @@ async def _replay(session, base_url: str, records: list[dict], *,
 
     scale = qps_scale if qps_scale > 0 else 1.0
     base_off = records[0].get("arrival_offset_s") or 0.0
-    # (slo_label, tenant_id, ttft_ms, itls_ms, out_tokens, timed_out)
+    # (slo_label, tenant_id, ttft_ms, itls_ms, out_tokens, timed_out,
+    #  priority)
     done: list[tuple] = []
     shed: dict[str, int] = {}
     errors: list[str] = []
@@ -94,6 +96,8 @@ async def _replay(session, base_url: str, records: list[dict], *,
             headers[SLO_CLASS_HEADER] = rec["slo_class"]
         if rec.get("tenant_id"):
             headers[TENANT_HEADER] = rec["tenant_id"]
+        if rec.get("priority") is not None:
+            headers[PRIORITY_HEADER] = str(rec["priority"])
         ts = time.monotonic()
         first = None
         last = ts
@@ -137,7 +141,7 @@ async def _replay(session, base_url: str, records: list[dict], *,
             errors.append(f"req {i}: transport error {type(e).__name__}: {e}")
             return
         done.append((label, rec.get("tenant_id"), first, itls, ntok,
-                     finish == "timeout"))
+                     finish == "timeout", rec.get("priority")))
 
     t0 = time.monotonic()
     await asyncio.gather(*[one(i, rec, t0) for i, rec in enumerate(records)])
@@ -147,6 +151,21 @@ async def _replay(session, base_url: str, records: list[dict], *,
                           num_requests=len(records))
     result["qps_scale"] = scale
     result["transport"] = "http"
+    # Brownout sub-block straight off the frontend's /health QoS report
+    # (works against a live pool and the in-proc selftest alike).
+    try:
+        async with session.get(f"{base_url}/health") as resp:
+            health = await resp.json()
+        b = (health.get("qos") or {}).get("brownout") or None
+        if b:
+            result["brownout"] = {
+                "rung": b.get("rung"),
+                "action": b.get("action"),
+                "time_at_rung_s": b.get("time_at_rung"),
+                "transitions": b.get("transitions"),
+            }
+    except Exception:  # noqa: BLE001 - telemetry garnish, never fatal
+        pass
     return result, errors
 
 

@@ -447,6 +447,16 @@ def _attach_engine_substatus(result: dict, engine) -> None:
             "roles": dis.get("roles"),
             "outcomes": dis.get("outcomes"),
         }
+    qs = getattr(engine, "qos_status", None)
+    qs = qs() if qs is not None else None
+    if qs and qs.get("brownout"):
+        b = qs["brownout"]
+        result["brownout"] = {
+            "rung": b.get("rung"),
+            "action": b.get("action"),
+            "time_at_rung_s": b.get("time_at_rung"),
+            "transitions": b.get("transitions"),
+        }
 
 
 # ---------------------------------------------------------------------------
@@ -456,8 +466,8 @@ def _attach_engine_substatus(result: dict, engine) -> None:
 # Default mixed-tenant synthesis when no --trace recording is given: a
 # latency-sensitive interactive class sharing the pool with a batch class.
 DEFAULT_TRACE_MIX = (
-    "interactive=share:0.7,prompt:32,output:16,tenant:acme;"
-    "batch=share:0.3,prompt:64,output:48,tenant:bulk"
+    "interactive=share:0.7,prompt:32,output:16,tenant:acme,priority:0;"
+    "batch=share:0.3,prompt:64,output:48,tenant:bulk,priority:10"
 )
 
 
@@ -490,10 +500,14 @@ def _parse_trace_classes(spec: str) -> list[dict]:
                 entry["max_tokens"] = int(val)
             elif key == "tenant":
                 entry["tenant_id"] = val or None
+            elif key == "priority":
+                # Key is only set when spec'd, so priority-less specs
+                # keep their exact historical entry shape.
+                entry["priority"] = int(val)
             else:
                 raise ValueError(
                     f"unknown trace-class key {key!r} in {clause!r} "
-                    "(expected share/prompt/output/tenant)")
+                    "(expected share/prompt/output/tenant/priority)")
         classes.append(entry)
     return classes
 
@@ -524,16 +538,63 @@ def _run_trace(args) -> dict:
     )
     engine = AsyncLLM.from_engine_args(engine_args)
     try:
-        result = replay_trace(
-            engine, records,
-            slo=parse_slo_spec(getattr(args, "slo", None)),
-            qps_scale=getattr(args, "qps_scale", 1.0) or 1.0,
-        )
+        slo = parse_slo_spec(getattr(args, "slo", None))
+        scale = getattr(args, "qps_scale", 1.0) or 1.0
+        if getattr(args, "qos_ab", False) and hasattr(engine, "set_qos"):
+            # Same-run FIFO-vs-QoS A/B: replay the identical records
+            # twice at (at least) 2x the recorded rate — once with the
+            # QoS layer off (plain FIFO admission, no brownout, no
+            # pressure preemption), once with it on — so the per-class
+            # attainment delta is apples-to-apples within one engine.
+            ab_scale = max(2.0, scale)
+            engine.set_qos(False)
+            fifo = replay_trace(engine, records, slo=slo,
+                                qps_scale=ab_scale)
+            engine.set_qos(True)
+            if not engine.engine_core.reset_prefix_cache():
+                print("WARNING: prefix-cache reset failed between A/B "
+                      "passes; QoS pass may be warm-cache inflated")
+            result = replay_trace(engine, records, slo=slo,
+                                  qps_scale=ab_scale, warmup=False)
+            result["qos_ab"] = _qos_ab_block(fifo, result, ab_scale)
+        else:
+            result = replay_trace(engine, records, slo=slo,
+                                  qps_scale=scale)
         result["trace"] = source
         _emit(result, args.json_out)
         return result
     finally:
         engine.shutdown()
+
+
+def _qos_ab_block(fifo: dict, qos: dict, ab_scale: float) -> dict:
+    """Condense two replay scoreboards into the A/B comparison block:
+    per-class attainment / tail TTFT / shed on each side, plus the
+    attainment delta (qos - fifo; positive = QoS helped the class)."""
+    def side(res: dict) -> dict:
+        return {
+            "replayed": res.get("replayed"),
+            "shed": res.get("shed"),
+            "goodput_tokens_per_s": res.get("goodput_tokens_per_s"),
+            "classes": {
+                cls: {
+                    "slo_attainment": blk.get("slo_attainment"),
+                    "ttft_p99_ms": (blk.get("ttft_ms") or {}).get("p99"),
+                    "shed": blk.get("shed", 0),
+                }
+                for cls, blk in (res.get("classes") or {}).items()
+            },
+        }
+
+    f, q = side(fifo), side(qos)
+    delta: dict = {}
+    for cls in sorted(set(f["classes"]) | set(q["classes"])):
+        fa = f["classes"].get(cls, {}).get("slo_attainment")
+        qa = q["classes"].get(cls, {}).get("slo_attainment")
+        delta[cls] = (
+            round(qa - fa, 4) if fa is not None and qa is not None else None)
+    return {"qps_scale": ab_scale, "fifo": f, "qos": q,
+            "delta_attainment": delta}
 
 
 def replay_trace(engine, records: list[dict], *, slo=None,
@@ -572,13 +633,15 @@ def replay_trace(engine, records: list[dict], *, slo=None,
             seed=s.get("seed"),
             slo_class=rec.get("slo_class"),
             tenant_id=rec.get("tenant_id"),
+            priority=rec.get("priority"),
             output_kind=RequestOutputKind.DELTA,
         )
         offset = max(
             0.0, ((rec.get("arrival_offset_s") or 0.0) - base) / scale)
         jobs.append((i, rec, sp, offset))
 
-    # (slo_label, tenant_id, ttft_ms, itls_ms, out_tokens, timed_out)
+    # (slo_label, tenant_id, ttft_ms, itls_ms, out_tokens, timed_out,
+    #  priority)
     done: list[tuple] = []
     shed: dict[str, int] = {}
 
@@ -610,7 +673,7 @@ def replay_trace(engine, records: list[dict], *, slo=None,
             shed[label] = shed.get(label, 0) + 1
             return
         done.append((label, rec.get("tenant_id"), first, itls, ntok,
-                     finish == "timeout"))
+                     finish == "timeout", rec.get("priority")))
 
     async def warmup_one():
         wp = SamplingParams(temperature=0.0, max_tokens=2, ignore_eos=True,
@@ -645,10 +708,11 @@ def score_replay(done: list[tuple], shed: dict[str, int], wall: float,
     """Assemble the SLO scoreboard from replay measurements.
 
     ``done`` entries are ``(slo_label, tenant_id, ttft_ms, itls_ms,
-    out_tokens, timed_out)``; ``shed`` maps class label -> requests that
-    got no service. Shared by the in-proc ``bench trace`` mode and the
-    HTTP replayer (``tools/serve_replay.py``) so both emit the same
-    artifact shape.
+    out_tokens, timed_out[, priority])`` — the trailing QoS priority is
+    optional for back-compat with len-6 producers; ``shed`` maps class
+    label -> requests that got no service. Shared by the in-proc
+    ``bench trace`` mode and the HTTP replayer
+    (``tools/serve_replay.py``) so both emit the same artifact shape.
     """
     from vllm_tpu.metrics.goodput import class_scoreboard, request_meets_slo
 
@@ -669,12 +733,22 @@ def score_replay(done: list[tuple], shed: dict[str, int], wall: float,
             label, {"requests": 0, "shed": 0, "timeouts": 0})
         block["shed"] = n
 
+    # Per-priority rows: the same scoreboard math keyed "p<priority>"
+    # (unset priority = p0, the interactive default). SLO targets are
+    # class-keyed, so priority rows report latency tails only.
+    by_priority = class_scoreboard(
+        [{"slo_class": f"p{d[6] if len(d) > 6 and d[6] is not None else 0}",
+          "ttft_ms": d[2], "itls_ms": d[3]}
+         for d in done],
+    )
+
     # Goodput: output tokens from requests NOT violating their class SLO
     # (requests in a class with no targets are not penalized).
     out_tokens = 0
     good_tokens = 0
     by_tenant: dict[str, int] = {}
-    for label, tenant, ttft_ms, itls, ntok, _timed_out in done:
+    for d in done:
+        label, tenant, ttft_ms, itls, ntok = d[0], d[1], d[2], d[3], d[4]
         out_tokens += ntok
         if request_meets_slo(ttft_ms, itls, slo.get(label)) is not False:
             good_tokens += ntok
@@ -694,5 +768,6 @@ def score_replay(done: list[tuple], shed: dict[str, int], wall: float,
         "goodput_tokens_per_s": (
             round(good_tokens / wall, 3) if wall > 0 else None),
         "classes": classes,
+        "by_priority": by_priority,
         "by_tenant": dict(sorted(by_tenant.items())),
     }

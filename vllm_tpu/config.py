@@ -321,12 +321,29 @@ class SchedulerConfig:
     spec_adaptive_high_watermark: float = 0.85
     spec_adaptive_low_watermark: float = 0.60
     spec_adaptive_ema_half_life_s: float = 10.0
+    # QoS pressure preemption (the scheduler half of the brownout/QoS
+    # layer, resilience/qos.py): when a higher-priority request has
+    # waited longer than pressure_preemption_s and the step is out of
+    # request slots, preempt the lowest-priority running decode (it
+    # resumes token-identically via the normal PREEMPTED path). 0 =
+    # derive from the lifecycle TTFT timeout (half of it) at
+    # EngineConfig.finalize, or stay off when no TTFT budget is set;
+    # < 0 = explicitly off. Bounded per step and per victim so nothing
+    # starves. The VLLM_TPU_DISABLE_QOS env is the no-restart off
+    # switch.
+    pressure_preemption_s: float = 0.0
+    max_preemptions_per_step: int = 1
+    max_preemptions_per_request: int = 4
 
     def __post_init__(self) -> None:
         if self.max_num_batched_tokens < 1:
             raise ValueError("max_num_batched_tokens must be >= 1")
         if self.max_decode_steps_per_launch < 0:
             raise ValueError("max_decode_steps_per_launch must be >= 0")
+        if self.max_preemptions_per_step < 0:
+            raise ValueError("max_preemptions_per_step must be >= 0")
+        if self.max_preemptions_per_request < 0:
+            raise ValueError("max_preemptions_per_request must be >= 0")
 
     def validate_decode_steps(
         self, *, spec_enabled: bool, needs_mrope: bool = False
@@ -540,6 +557,13 @@ class EngineConfig:
         mc, sc = self.model_config, self.scheduler_config
         if mc.max_model_len is not None:
             sc.max_model_len = mc.max_model_len
+        if (sc.pressure_preemption_s == 0.0
+                and self.lifecycle_config.ttft_timeout_s > 0):
+            # Ride the PR 3 deadline sweep: preempt for a waiting
+            # higher-priority request at half its TTFT budget, before
+            # the timeout fires.
+            sc.pressure_preemption_s = (
+                self.lifecycle_config.ttft_timeout_s / 2)
         if not sc.enable_chunked_prefill:
             sc.max_num_batched_tokens = max(sc.max_num_batched_tokens, sc.max_model_len)
         if self.speculative_config.spec_tree is not None:

@@ -253,6 +253,14 @@ class SchedulerStats:
     # connector is active): per-tier resident blocks, cumulative fetch
     # outcomes / demotions / transferred bytes. None = fabric off.
     kv_fabric: dict | None = None
+    # QoS (resilience/qos.py): request ids preempted since the last
+    # snapshot (drained — the frontend re-charges each one's tenant WFQ
+    # debt on requeue), the cumulative pressure-preemption count, and
+    # the brownout rung the scheduler is currently acting on (echo of
+    # the rung the frontend ladder pushed; 0 when QoS is disabled).
+    preempted_req_ids: list[str] = field(default_factory=list)
+    pressure_preemptions: int = 0
+    brownout_rung: int = 0
 
 
 @dataclass

@@ -247,6 +247,20 @@ class Scheduler:
         # Realized per-request draft lengths of spec verification steps
         # (drained by make_stats — feeds vllm:spec_decode_draft_len).
         self._spec_draft_lens: list[int] = []
+        # QoS (resilience/qos.py): the brownout rung pushed live by the
+        # frontend ladder (0 = normal; >= 1 suspends speculation, >= 2
+        # shrinks prefill chunks, >= 4 preempts batch-class decodes) and
+        # the no-restart FIFO-vs-QoS A/B switch (the trace bench flips
+        # it; VLLM_TPU_DISABLE_QOS is the env spelling).
+        self.brownout_rung = 0
+        self.disable_qos = False
+        # Pressure-preemption accounting: cumulative count, plus every
+        # preempted request id since the last stats snapshot (drained by
+        # make_stats — the frontend re-charges the tenant's WFQ debt on
+        # requeue from this list, so preempt/resume can't double-spend
+        # an admission allocation).
+        self._pressure_preemptions_total = 0
+        self._preempted_rids: list[str] = []
 
     # ------------------------------------------------------------------
     # Request lifecycle
@@ -369,6 +383,25 @@ class Scheduler:
             and not envs.VLLM_TPU_DISABLE_ADAPTIVE_SPEC
         )
 
+    def _qos_on(self) -> bool:
+        """QoS actions (brownout rungs, pressure preemption) enabled."""
+        return not self.disable_qos and not envs.VLLM_TPU_DISABLE_QOS
+
+    def _effective_chunk_threshold(self) -> int:
+        """Long-prefill chunk cap, shrunk under brownout rung 2+ so a
+        batch prompt can't monopolize a step while interactive requests
+        wait on TTFT. Quarter of the configured cap (or of the step
+        budget when no cap is set), floored at 128 tokens."""
+        base = self.config.long_prefill_token_threshold
+        if self.brownout_rung >= 2 and self._qos_on():
+            cap = max(
+                128,
+                (base if base > 0
+                 else self.config.max_num_batched_tokens) // 4,
+            )
+            return cap if base <= 0 else min(base, cap)
+        return base
+
     def schedule(self) -> SchedulerOutput:
         token_budget = self.config.max_num_batched_tokens
         num_scheduled_tokens: dict[str, int] = {}
@@ -385,6 +418,10 @@ class Scheduler:
         # these captured values, not the live counter.
         starts: dict[str, int] = {}
         kv_connector_load: dict[str, tuple] = {}
+
+        # QoS pressure preemption, before any scheduling decisions: the
+        # freed request slots are admittable in this same step's phase 2.
+        preempted_in_step |= self._pressure_preempt()
 
         # In-jit multi-step decode: eligible only when EVERY live request
         # is a pure single-token decode with no feature that needs host
@@ -490,6 +527,15 @@ class Scheduler:
                 elif budget < len(r.spec_token_ids):
                     r.spec_token_ids = r.spec_token_ids[:budget]
 
+        # Brownout rung 1+: suspend speculation pool-wide. Drafts are a
+        # throughput hedge; under pressure their verify positions go to
+        # guaranteed tokens instead (acts immediately, unlike the
+        # adaptive controller's EMA-gated shutoff).
+        if self.brownout_rung >= 1 and self._qos_on():
+            for r in self.running:
+                if r.spec_token_ids:
+                    r.spec_token_ids = []
+
         # Phase 1: running requests, in order (decode + in-flight prefills).
         req_index = 0
         while req_index < len(self.running) and token_budget > 0:
@@ -549,10 +595,9 @@ class Scheduler:
                 + request.num_output_placeholders
                 - request.num_computed_tokens
             )
-            if self.config.long_prefill_token_threshold > 0:
-                num_new_tokens = min(
-                    num_new_tokens, self.config.long_prefill_token_threshold
-                )
+            chunk_cap = self._effective_chunk_threshold()
+            if chunk_cap > 0:
+                num_new_tokens = min(num_new_tokens, chunk_cap)
             num_new_tokens = min(num_new_tokens, token_budget)
             num_new_tokens = min(
                 num_new_tokens,
@@ -731,10 +776,9 @@ class Scheduler:
                 - request.num_computed_tokens
                 - num_new_computed_tokens
             )
-            if self.config.long_prefill_token_threshold > 0:
-                num_new_tokens = min(
-                    num_new_tokens, self.config.long_prefill_token_threshold
-                )
+            chunk_cap = self._effective_chunk_threshold()
+            if chunk_cap > 0:
+                num_new_tokens = min(num_new_tokens, chunk_cap)
             num_new_tokens = min(num_new_tokens, token_budget)
             assert num_new_tokens > 0
             # Encoder gate (see phase 1). The window starts after any
@@ -1028,7 +1072,87 @@ class Scheduler:
         ):
             request.dropping_invalid = False
 
-    def _preempt(self, request: Request) -> None:
+    def _pressure_preempt(self) -> set[str]:
+        """Load-based priority preemption (the scheduler half of the QoS
+        layer). Two triggers, both bounded by max_preemptions_per_step
+        and the per-victim preemption cap (so nothing starves):
+
+        - A strictly higher-priority request has waited past the
+          pressure budget (half its TTFT budget by default) while the
+          step is out of request slots: preempt the lowest-priority
+          running decode so phase 2 can admit it this step.
+        - Brownout rung 4: preempt batch-class (priority > 0) decodes
+          on pressure alone so interactive requests recover; an
+          interactive (priority 0) request is NEVER a rung-4 victim.
+
+        Victims resume token-identically via the normal PREEMPTED path
+        and are journal-backed frontend-side like any preemption."""
+        if not self._qos_on():
+            return set()
+        rung4 = self.brownout_rung >= 4
+        budget_s = self.config.pressure_preemption_s
+        max_step = self.config.max_preemptions_per_step
+        if max_step <= 0 or (not rung4 and budget_s <= 0):
+            return set()
+        now = time.monotonic()
+        preempted: set[str] = set()
+
+        def victim_ok(r: Request) -> bool:
+            return (
+                r.pooling_params is None
+                # Decode phase only: a prefill victim would just re-run
+                # the same prefill, freeing nothing durable.
+                and (r.num_output_tokens > 0
+                     or r.num_output_placeholders > 0)
+                # A dynamic launch in flight holds an unreconciled
+                # claim; let it settle rather than discard the window.
+                and r.request_id not in self._dynamic_inflight
+                and r.num_preemptions
+                < self.config.max_preemptions_per_request
+            )
+
+        while len(preempted) < max_step:
+            victim = None
+            slots_full = len(self.running) >= self.config.max_num_seqs
+            if self.waiting and slots_full:
+                head = self.waiting.peek()
+                triggered = rung4 or (
+                    budget_s > 0
+                    and head.status == RequestStatus.WAITING
+                    and now - head.arrival_time >= budget_s
+                )
+                if triggered:
+                    candidates = [
+                        r for r in self.running
+                        if r.priority > head.priority and victim_ok(r)
+                        and (not rung4 or r.priority > 0)
+                    ]
+                    if candidates:
+                        victim = max(
+                            candidates,
+                            key=lambda r: (r.priority, r.arrival_time),
+                        )
+            elif rung4 and any(r.priority == 0 for r in self.running):
+                # Rung 4 without queue pressure: shed batch-class decodes
+                # from the batch so interactive ITL recovers.
+                candidates = [
+                    r for r in self.running
+                    if r.priority > 0 and victim_ok(r)
+                ]
+                if candidates:
+                    victim = max(
+                        candidates,
+                        key=lambda r: (r.priority, r.arrival_time),
+                    )
+            if victim is None:
+                break
+            self.running.remove(victim)
+            self._preempt(victim, to_tail=True)
+            self._pressure_preemptions_total += 1
+            preempted.add(victim.request_id)
+        return preempted
+
+    def _preempt(self, request: Request, *, to_tail: bool = False) -> None:
         self.kv_cache_manager.free(request)
         # Encoder outputs are tied to computed positions; a resume restarts
         # prefill from 0 and re-encodes.
@@ -1042,7 +1166,14 @@ class Scheduler:
         request.num_preemptions += 1
         request.spec_token_ids = []
         self._num_preempted_total += 1
-        self.waiting.prepend(request)
+        self._preempted_rids.append(request.request_id)
+        if to_tail:
+            # Pressure/rung-4 victims re-queue at the tail (re-sorted by
+            # priority under the priority policy): the higher-priority
+            # request they yielded to must admit first, not the victim.
+            self.waiting.add(request)
+        else:
+            self.waiting.prepend(request)
 
     # ------------------------------------------------------------------
     # update_from_output()
@@ -1386,6 +1517,7 @@ class Scheduler:
             self._decode_step_lengths, []
         )
         draft_lens, self._spec_draft_lens = self._spec_draft_lens, []
+        preempted_rids, self._preempted_rids = self._preempted_rids, []
         ctl = self.adaptive_spec
         return SchedulerStats(
             num_running_reqs=len(self.running),
@@ -1410,4 +1542,7 @@ class Scheduler:
             ),
             decode_step_lengths=decode_lengths,
             decode_early_exits=self._decode_early_exits,
+            preempted_req_ids=preempted_rids,
+            pressure_preemptions=self._pressure_preemptions_total,
+            brownout_rung=self.brownout_rung if self._qos_on() else 0,
         )

@@ -153,6 +153,23 @@ class EngineArgs:
     stream_overflow_policy: str = "drop_oldest"
     drain_timeout_s: float = 30.0
     retry_after_s: float = 1.0
+    # QoS (vllm_tpu/resilience/qos): per-tenant weighted fair queueing
+    # over the prompt-token budget ("acme:3,bulk:1"), the brownout
+    # degradation ladder, and pressure preemption of low-priority
+    # decodes. Escape hatch: VLLM_TPU_DISABLE_QOS=1.
+    tenant_weights: str | None = None
+    brownout: bool = False
+    brownout_occupancy_high: float = 0.92
+    brownout_queue_depth_high: float = 8.0
+    brownout_slo_floor: float = 0.0
+    brownout_step_up_hold_s: float = 0.25
+    brownout_step_down_hold_s: float = 2.0
+    brownout_interval_s: float = 0.05
+    brownout_max_rung: int = 4
+    brownout_shed_classes: str = "batch"
+    pressure_preemption_s: float = 0.0
+    max_preemptions_per_step: int = 1
+    max_preemptions_per_request: int = 4
 
     disable_log_stats: bool = False
     # Perfwatch: periodic in-engine profiling windows (0 = off; the
@@ -237,6 +254,11 @@ class EngineArgs:
                 enable_decode_attention=self.enable_decode_attention,
                 enable_sampler_kernel=self.enable_sampler_kernel,
                 disable_dynamic_decode=self.disable_dynamic_decode,
+                pressure_preemption_s=self.pressure_preemption_s,
+                max_preemptions_per_step=self.max_preemptions_per_step,
+                max_preemptions_per_request=(
+                    self.max_preemptions_per_request
+                ),
             ),
             device_config=DeviceConfig(device=self.device),  # type: ignore[arg-type]
             speculative_config=SpeculativeConfig(
@@ -310,6 +332,20 @@ class EngineArgs:
                 stream_overflow_policy=self.stream_overflow_policy,  # type: ignore[arg-type]
                 drain_timeout_s=self.drain_timeout_s,
                 retry_after_s=self.retry_after_s,
+                tenant_weights=self.tenant_weights,
+                brownout=self.brownout,
+                brownout_occupancy_high=self.brownout_occupancy_high,
+                brownout_queue_depth_high=(
+                    self.brownout_queue_depth_high
+                ),
+                brownout_slo_floor=self.brownout_slo_floor,
+                brownout_step_up_hold_s=self.brownout_step_up_hold_s,
+                brownout_step_down_hold_s=(
+                    self.brownout_step_down_hold_s
+                ),
+                brownout_interval_s=self.brownout_interval_s,
+                brownout_max_rung=self.brownout_max_rung,
+                brownout_shed_classes=self.brownout_shed_classes,
             ),
         )
         # If the model's max length is unknown and unset, derive after the HF

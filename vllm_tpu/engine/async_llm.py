@@ -141,6 +141,15 @@ class AsyncStream:
 
 
 class AsyncLLM:
+    # Class-level QoS defaults so harnesses that assemble an engine via
+    # __new__ around a fake client (the recovery/chaos/quarantine unit
+    # rigs) get a working no-brownout configuration without tracking
+    # every new attribute.
+    _brownout = None
+    _brownout_next_t = 0.0
+    _brownout_push_t = 0.0
+    _qos_enabled = True
+
     def __init__(self, config: EngineConfig, start: bool = True,
                  client: Any | None = None) -> None:
         self.config = config = config.finalize()
@@ -247,6 +256,32 @@ class AsyncLLM:
                     hold_s=rc.autoscale_hold_s,
                     cooldown_s=rc.autoscale_cooldown_s,
                 )
+        # QoS brownout ladder (vllm_tpu/resilience/qos): the controller
+        # decides the rung from the same pressure signals the autoscaler
+        # watches but on a millisecond cadence; the rung is pushed to
+        # every engine core (spec suspension / chunk shrink / pressure
+        # preemption) and enforced frontend-side (rung-3 batch-class
+        # sheds). VLLM_TPU_DISABLE_QOS is the escape hatch that turns
+        # off the ladder, WFQ admission, and pressure preemption at
+        # once; set_qos(False) is the live FIFO-vs-QoS A/B toggle.
+        self._brownout = None
+        self._brownout_next_t = 0.0
+        self._brownout_push_t = 0.0
+        self._qos_enabled = True
+        from vllm_tpu import envs
+
+        if envs.VLLM_TPU_DISABLE_QOS:
+            self._qos_enabled = False
+            self.admission.wfq_enabled = False
+            if self.lifecycle.brownout:
+                logger.warning(
+                    "brownout configured but disabled via "
+                    "VLLM_TPU_DISABLE_QOS")
+        elif self.lifecycle.brownout:
+            from vllm_tpu.resilience import BrownoutController
+
+            self._brownout = BrownoutController(
+                self.lifecycle.make_brownout_config())
         if start:
             self.start()
 
@@ -287,14 +322,36 @@ class AsyncLLM:
         if self._dead:
             raise EngineDeadError("engine core died")
         self._loop = asyncio.get_running_loop()
+        # Request-level priority (SamplingParams.priority, fed by the
+        # body or the X-Priority header) wins over the call-site default.
+        # Lower = more urgent; 0 = interactive.
+        if sampling_params.priority is not None:
+            priority = sampling_params.priority
         core_req = self.input_processor.process(
             request_id, prompt, sampling_params, priority=priority,
             pooling_params=pooling_params,
         )
+        tenant_id = sampling_params.tenant_id
+        # Brownout rung 3+: shed batch-class work before reserving
+        # capacity, with a Retry-After scaled by the rung. Interactive
+        # requests (priority 0, non-shed SLO class) pass through to the
+        # normal admission check.
+        ctrl = self._brownout
+        if (
+            ctrl is not None and self._qos_enabled and ctrl.rung >= 3
+            and self._is_batch_class(priority, sampling_params)
+        ):
+            self.admission.count_shed("brownout", tenant_id)
+            raise make_shed_error(
+                "brownout", self.lifecycle,
+                retry_after_s=ctrl.retry_after_s(
+                    self.lifecycle.retry_after_s),
+            )
         # Admission AFTER input processing: a malformed request is a 400,
         # not a shed; capacity is reserved only for well-formed work.
         shed_reason = self.admission.try_admit(
-            request_id, len(core_req.prompt_token_ids)
+            request_id, len(core_req.prompt_token_ids),
+            tenant_id=tenant_id,
         )
         if shed_reason is not None:
             raise make_shed_error(shed_reason, self.lifecycle)
@@ -449,10 +506,22 @@ class AsyncLLM:
         # survivors) — recovered by the busy loop like any crash.
         if getattr(self.engine_core, "poll_scale", None) is not None:
             self.poll_autoscale()
+        # Brownout tick: runs even when idle so the ladder de-escalates
+        # once pressure clears (rung 0 must be reachable with no traffic).
+        if self._brownout is not None and self._qos_enabled:
+            self.poll_brownout()
         if not self.engine_core.has_unfinished_requests():
             return stalled
         outputs = self.engine_core.get_output(timeout=0.2)
         stalled = not outputs.outputs and not self.engine_core.inflight
+        stats = outputs.scheduler_stats
+        if stats is not None and stats.preempted_req_ids:
+            # A preempt/resume cycle consumes scheduler capacity twice:
+            # re-charge the tenant's WFQ virtual-time debt per preempted
+            # request. The token reservation is untouched, so the
+            # admission release stays exactly-once.
+            for rid in stats.preempted_req_ids:
+                self.admission.note_requeue(rid)
         # process_outputs delivers straight into each request's
         # AsyncStream (thread-safe); nothing to re-publish here.
         processed = self.output_processor.process_outputs(
@@ -843,6 +912,120 @@ class AsyncLLM:
                     worst = frac if worst is None else max(worst, frac)
         self._autoscale_occ = worst
         return worst
+
+    # -- QoS: brownout ladder + FIFO-vs-QoS A/B ------------------------
+
+    def _is_batch_class(self, priority: int, params: SamplingParams) -> bool:
+        """Whether a request is sheddable batch-class work under the
+        brownout ladder: any priority > 0, or an SLO class listed in
+        --brownout-shed-classes."""
+        if priority and priority > 0:
+            return True
+        ctrl = self._brownout
+        if ctrl is None or not params.slo_class:
+            return False
+        return params.slo_class in ctrl.config.shed_class_set()
+
+    def poll_brownout(self) -> None:
+        """Brownout-ladder tick (engine-loop thread): sample admission
+        occupancy, per-engine queue depth, and worst-class SLO
+        attainment; advance the ladder; push rung changes to every
+        engine core. Throttled by --brownout-interval-s. The rung is
+        re-pushed every second while elevated so an engine respawned
+        mid-brownout (fresh scheduler at rung 0) converges back."""
+        ctrl = self._brownout
+        if ctrl is None:
+            return
+        now = time.monotonic()
+        if now < self._brownout_next_t:
+            return
+        self._brownout_next_t = now + ctrl.config.interval_s
+        lc = self.lifecycle
+        inflight = len(self.output_processor.request_states)
+        # Occupancy = how full the admission envelope is (whichever of
+        # the request / prompt-token caps is more saturated). With no
+        # caps configured this stays 0 and queue depth alone drives the
+        # ladder.
+        occ = 0.0
+        if lc.max_inflight_requests:
+            occ = inflight / lc.max_inflight_requests
+        if lc.max_queued_prompt_tokens:
+            occ = max(
+                occ,
+                self.admission.inflight_prompt_tokens
+                / lc.max_queued_prompt_tokens,
+            )
+        engines = 1
+        if hasattr(self.engine_core, "pool_status"):
+            try:
+                engines = max(
+                    1, self.engine_core.pool_status().get("actual", 1))
+            except Exception:
+                engines = 1
+        slo = None
+        snap = self.output_processor.slo_attainment_snapshot()
+        if snap:
+            slo = min(v["attainment"] for v in snap.values())
+        prev = ctrl.rung
+        rung = ctrl.observe(
+            occupancy=occ, queue_depth=inflight / engines,
+            slo_attainment=slo, now=now,
+        )
+        if rung == prev and not (
+            rung > 0 and now - self._brownout_push_t >= 1.0
+        ):
+            return
+        if rung != prev:
+            from vllm_tpu.resilience.qos import RUNG_ACTIONS
+
+            logger.warning(
+                "brownout rung %d -> %d (%s; occ=%.2f, depth=%.1f, "
+                "slo=%s)", prev, rung,
+                RUNG_ACTIONS.get(rung, "?"), occ, inflight / engines,
+                "n/a" if slo is None else f"{slo:.2f}")
+        self._brownout_push_t = now
+        try:
+            self.engine_core.set_brownout_rung(rung)
+        except EngineRestartedError:
+            raise
+        except Exception:
+            logger.exception("failed to push brownout rung to engines")
+
+    def set_qos(self, enabled: bool) -> bool:
+        """Live FIFO-vs-QoS A/B toggle (bench trace): flips WFQ
+        admission, the brownout ladder's enforcement, and the
+        engine-side QoS actions (spec suspension, chunk shrink, pressure
+        preemption) in one switch. Returns the new state."""
+        enabled = bool(enabled)
+        self._qos_enabled = enabled
+        self.admission.wfq_enabled = enabled
+        try:
+            if hasattr(self.engine_core, "set_qos_enabled"):
+                self.engine_core.set_qos_enabled(enabled)
+            if (enabled and self._brownout is not None
+                    and self._brownout.rung > 0
+                    and hasattr(self.engine_core, "set_brownout_rung")):
+                self.engine_core.set_brownout_rung(self._brownout.rung)
+        except Exception:
+            logger.exception("failed to push QoS toggle to engines")
+        return enabled
+
+    def qos_status(self) -> dict:
+        """QoS snapshot (WFQ state, per-tenant shed accounting, brownout
+        ladder, preemption knobs) for /health and /metrics."""
+        adm = self.admission.status()
+        ctrl = self._brownout
+        sc = self.config.scheduler_config
+        return {
+            "enabled": self._qos_enabled,
+            "wfq_enabled": adm["wfq_enabled"],
+            "wfq": adm["wfq"],
+            "shed_by_tenant": adm["shed_by_tenant"],
+            "brownout": ctrl.snapshot() if ctrl is not None else None,
+            "pressure_preemption_s": sc.pressure_preemption_s,
+            "max_preemptions_per_step": sc.max_preemptions_per_step,
+            "max_preemptions_per_request": sc.max_preemptions_per_request,
+        }
 
     def autoscale_status(self, drain: bool = False) -> dict | None:
         """Elastic-capacity snapshot (pool membership + controller) for

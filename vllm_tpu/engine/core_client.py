@@ -110,6 +110,12 @@ class InprocClient:
     def reset_prefix_cache(self) -> bool:
         return self.engine_core.reset_prefix_cache()
 
+    def set_brownout_rung(self, rung: int) -> bool:
+        return self.engine_core.set_brownout_rung(rung)
+
+    def set_qos_enabled(self, enabled: bool) -> bool:
+        return self.engine_core.set_qos_enabled(enabled)
+
     def sleep(self, level: int = 1) -> bool:
         return self.engine_core.sleep(level)
 
@@ -532,6 +538,14 @@ class _ZMQClientBase:
 
     def reset_prefix_cache(self) -> bool:
         return self._utility("reset_prefix_cache", timeout_ms=30_000)
+
+    def set_brownout_rung(self, rung: int) -> bool:
+        # DPLB's _utility broadcasts, so the rung reaches every engine
+        # in the pool with one call.
+        return self._utility("set_brownout_rung", rung, timeout_ms=30_000)
+
+    def set_qos_enabled(self, enabled: bool) -> bool:
+        return self._utility("set_qos_enabled", enabled, timeout_ms=30_000)
 
     def sleep(self, level: int = 1) -> bool:
         return self._utility("sleep", level)

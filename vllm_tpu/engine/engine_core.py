@@ -539,6 +539,23 @@ class EngineCore:
             self.scheduler.kv_event_publisher.flush()
         return ok
 
+    def set_brownout_rung(self, rung: int) -> bool:
+        """Apply a brownout-ladder rung pushed by the frontend QoS
+        controller (resilience/qos.py). The scheduler acts on it from
+        the next schedule(): >= 1 suspends speculation, >= 2 shrinks
+        prefill chunks, >= 4 preempts batch-class decodes."""
+        self.scheduler.brownout_rung = max(0, int(rung))
+        return True
+
+    def set_qos_enabled(self, enabled: bool) -> bool:
+        """Live FIFO-vs-QoS A/B switch (the trace bench flips it): off
+        disables pressure preemption and zeroes the brownout rung;
+        VLLM_TPU_DISABLE_QOS is the env spelling of the same switch."""
+        self.scheduler.disable_qos = not enabled
+        if not enabled:
+            self.scheduler.brownout_rung = 0
+        return True
+
     # ------------------------------------------------------------------
     # Sleep / wake / weight reload (reference: core.py:673 sleep, :711
     # wake_up; gpu_worker.py:978 update_weights)

@@ -69,12 +69,19 @@ def parse_messages_request(d: dict, tokenizer) -> tuple[dict, SamplingParams]:
     token_ids = tokenizer.apply_chat_template(
         conv, add_generation_prompt=True
     )
+    priority = d.get("priority")
+    if priority is not None and (
+        isinstance(priority, bool) or not isinstance(priority, int)
+        or not 0 <= priority <= 100
+    ):
+        raise ValidationError("'priority' must be an integer in [0, 100]")
     params = SamplingParams(
         max_tokens=max_tokens,
         temperature=float(d.get("temperature", 1.0)),
         top_p=float(d.get("top_p", 1.0)),
         top_k=int(d.get("top_k", 0) or 0),
         stop=list(d.get("stop_sequences") or []),
+        priority=priority,
         output_kind=(
             RequestOutputKind.DELTA
             if d.get("stream")
@@ -95,6 +102,7 @@ async def handle_messages(request: web.Request) -> web.Response:
     from vllm_tpu.entrypoints.openai.api_server import (
         ENGINE_KEY,
         MODEL_KEY,
+        _apply_priority_header,
         _error,
     )
 
@@ -107,6 +115,8 @@ async def handle_messages(request: web.Request) -> web.Response:
         prompt, params = parse_messages_request(body, engine.tokenizer)
     except (ValidationError, ValueError, TypeError) as e:
         return _error(400, str(e))
+    if (msg := _apply_priority_header(request, params)) is not None:
+        return _error(400, msg)
 
     rid = random_id("msg")
     model_name = request.app[MODEL_KEY]

@@ -122,6 +122,12 @@ def _add_bench(sub: argparse._SubParsersAction) -> None:
              'itl:50ms;batch=ttft:5s" — scored in the trace-mode '
              "scoreboard",
     )
+    p.add_argument(
+        "--qos-ab", action="store_true",
+        help="trace mode: replay the same records twice at >=2x the "
+             "recorded rate — QoS layer off (FIFO) then on — and emit "
+             "the per-class attainment delta under 'qos_ab'",
+    )
     p.set_defaults(func=_run_bench)
 
 

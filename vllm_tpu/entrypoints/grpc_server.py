@@ -48,6 +48,26 @@ def _dumps(obj: dict) -> bytes:
     return json.dumps(obj).encode()
 
 
+def _apply_priority_metadata(context, params: SamplingParams) -> str | None:
+    """Fold the ``x-priority`` gRPC metadata entry into SamplingParams
+    (the request body/proto field wins, mirroring the HTTP X-Priority
+    header). Returns an error message for a malformed value."""
+    if params.priority is not None:
+        return None
+    md = dict(context.invocation_metadata() or ())
+    raw = md.get("x-priority")
+    if raw is None:
+        return None
+    try:
+        priority = int(str(raw).strip())
+    except ValueError:
+        return f"x-priority metadata must be an integer, got {raw!r}"
+    if not 0 <= priority <= 100:
+        return f"x-priority metadata must be in [0, 100], got {raw!r}"
+    params.priority = priority
+    return None
+
+
 def _build_sampling_params(spec: dict) -> SamplingParams:
     import dataclasses
 
@@ -110,6 +130,9 @@ def make_server(engine, model_name: str) -> grpc.aio.Server:
                     grpc.StatusCode.INVALID_ARGUMENT, str(exc)
                 )
                 return
+            if (msg := _apply_priority_metadata(context, params)) is not None:
+                await context.abort(grpc.StatusCode.INVALID_ARGUMENT, msg)
+                return
             rid = request.request_id or f"grpc-{uuid.uuid4().hex[:16]}"
             sent_text = sent_tok = 0
             try:
@@ -150,6 +173,9 @@ def make_server(engine, model_name: str) -> grpc.aio.Server:
             await context.abort(
                 grpc.StatusCode.INVALID_ARGUMENT, str(exc)
             )
+            return
+        if (msg := _apply_priority_metadata(context, params)) is not None:
+            await context.abort(grpc.StatusCode.INVALID_ARGUMENT, msg)
             return
         rid = req.get("request_id") or f"grpc-{uuid.uuid4().hex[:16]}"
         sent_text = sent_tok = 0

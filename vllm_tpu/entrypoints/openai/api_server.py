@@ -46,6 +46,9 @@ DEADLINE_HEADER = "X-Request-Deadline-S"
 # when both are present.
 SLO_CLASS_HEADER = "X-SLO-Class"
 TENANT_HEADER = "X-Tenant-Id"
+# QoS scheduling priority (lower = more urgent, 0 = interactive); the
+# body's priority field wins when both are present.
+PRIORITY_HEADER = "X-Priority"
 
 ENGINE_KEY = web.AppKey("engine", AsyncLLM)
 MODEL_KEY = web.AppKey("model_name", str)
@@ -115,6 +118,22 @@ def _apply_slo_headers(request: web.Request, params) -> str | None:
     return None
 
 
+def _apply_priority_header(request: web.Request, params) -> str | None:
+    """Fold X-Priority into SamplingParams (body field wins). Returns an
+    error message for a malformed header."""
+    hdr = request.headers.get(PRIORITY_HEADER)
+    if hdr is None or params.priority is not None:
+        return None
+    try:
+        priority = int(hdr.strip())
+    except ValueError:
+        return f"{PRIORITY_HEADER} must be an integer, got {hdr!r}"
+    if not 0 <= priority <= 100:
+        return f"{PRIORITY_HEADER} must be in [0, 100], got {hdr!r}"
+    params.priority = priority
+    return None
+
+
 # ----------------------------------------------------------------------
 # /v1/completions
 # ----------------------------------------------------------------------
@@ -140,6 +159,8 @@ async def handle_completions(request: web.Request) -> web.StreamResponse:
     if (msg := _apply_deadline_header(request, params)) is not None:
         return _error(400, msg)
     if (msg := _apply_slo_headers(request, params)) is not None:
+        return _error(400, msg)
+    if (msg := _apply_priority_header(request, params)) is not None:
         return _error(400, msg)
     req_id = random_id("cmpl")
 
@@ -287,6 +308,8 @@ async def handle_chat_completions(request: web.Request) -> web.StreamResponse:
     if (msg := _apply_deadline_header(request, params)) is not None:
         return _error(400, msg)
     if (msg := _apply_slo_headers(request, params)) is not None:
+        return _error(400, msg)
+    if (msg := _apply_priority_header(request, params)) is not None:
         return _error(400, msg)
     req_id = random_id("chatcmpl")
     prompt = {"prompt_token_ids": list(prompt_ids)}
@@ -713,6 +736,10 @@ async def handle_health(request: web.Request) -> web.Response:
                 body["pool"]["controller"] = ctrl
             if auto.get("kv_occupancy") is not None:
                 body["pool"]["kv_occupancy"] = auto["kv_occupancy"]
+    # QoS under pressure: current brownout rung + per-tenant WFQ state,
+    # so operators see WHY batch traffic is being shed or preempted.
+    if hasattr(engine, "qos_status"):
+        body["qos"] = engine.qos_status()
     return web.json_response(body, status=503 if dead else 200)
 
 

@@ -86,6 +86,17 @@ class ValidationError(ValueError):
     pass
 
 
+def _priority(d: dict) -> int | None:
+    """QoS ``priority`` body field (lower = more urgent, 0 = interactive,
+    None = unset so the X-Priority header can fill it in)."""
+    v = d.get("priority")
+    if v is None:
+        return None
+    if isinstance(v, bool) or not isinstance(v, int) or not 0 <= v <= 100:
+        raise ValidationError("'priority' must be an integer in [0, 100]")
+    return v
+
+
 def _get(d: dict, key: str, typ, default=None):
     v = d.get(key, default)
     if v is None:
@@ -129,6 +140,9 @@ class CompletionRequest:
     # X-Tenant-Id headers (body wins).
     slo_class: str | None = None
     tenant_id: str | None = None
+    # QoS scheduling priority (lower = more urgent, 0 = interactive);
+    # also settable via the X-Priority header (body wins).
+    priority: int | None = None
 
     @classmethod
     def from_json(cls, d: dict) -> "CompletionRequest":
@@ -163,6 +177,7 @@ class CompletionRequest:
             deadline_s=_get(d, "deadline_s", (int, float)),
             slo_class=_get(d, "slo_class", str),
             tenant_id=_get(d, "tenant_id", str),
+            priority=_priority(d),
         )
 
     def to_sampling_params(self, stream: bool) -> SamplingParams:
@@ -190,6 +205,7 @@ class CompletionRequest:
             ),
             slo_class=self.slo_class,
             tenant_id=self.tenant_id,
+            priority=self.priority,
             output_kind=(
                 RequestOutputKind.DELTA if stream
                 else RequestOutputKind.FINAL_ONLY
@@ -228,6 +244,7 @@ class ChatCompletionRequest:
     deadline_s: float | None = None
     slo_class: str | None = None
     tenant_id: str | None = None
+    priority: int | None = None
 
     @classmethod
     def from_json(cls, d: dict) -> "ChatCompletionRequest":
@@ -271,6 +288,7 @@ class ChatCompletionRequest:
             deadline_s=_get(d, "deadline_s", (int, float)),
             slo_class=_get(d, "slo_class", str),
             tenant_id=_get(d, "tenant_id", str),
+            priority=_priority(d),
         )
 
     def to_sampling_params(self, stream: bool) -> SamplingParams:
@@ -301,6 +319,7 @@ class ChatCompletionRequest:
             ),
             slo_class=self.slo_class,
             tenant_id=self.tenant_id,
+            priority=self.priority,
             output_kind=(
                 RequestOutputKind.DELTA if stream
                 else RequestOutputKind.FINAL_ONLY

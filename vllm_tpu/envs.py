@@ -149,6 +149,13 @@ _readers: dict[str, Callable[[], Any]] = {
     # memory analysis) instead of assuming a fixed activation-headroom
     # fraction. Costs one AOT compile at startup; 0 restores the fraction.
     "VLLM_TPU_PROFILE_KV_SIZING": _bool("VLLM_TPU_PROFILE_KV_SIZING", True),
+    # Escape hatch for the QoS layer (vllm_tpu/resilience/qos.py):
+    # per-tenant weighted fair queueing degrades to the plain global
+    # prompt-token cap, the brownout ladder never engages, and pressure
+    # preemption is off — admission caps, deadlines, and KV-exhaustion
+    # preemption all still work. Serving is otherwise identical; A/B
+    # this before filing QoS bugs.
+    "VLLM_TPU_DISABLE_QOS": _bool("VLLM_TPU_DISABLE_QOS", False),
     # API server
     "VLLM_TPU_API_KEY": _str("VLLM_TPU_API_KEY", None),
     # Testing

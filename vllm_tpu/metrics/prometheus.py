@@ -370,9 +370,11 @@ class PrometheusRegistry:
         # Lifecycle / overload protection (vllm_tpu/resilience/lifecycle):
         # refreshed from the engine's live snapshot at render time, same
         # scheme as the resilience metrics above.
-        self.requests_shed = LabeledCounter(
+        self.requests_shed = BiLabeledCounter(
             "vllm:requests_shed_total",
-            "Requests rejected by admission control", "reason")
+            "Requests rejected by admission control, by reason and "
+            "tenant (the per-reason sums across tenants equal the "
+            "pre-QoS reason-only totals)", "reason", "tenant")
         self.request_timeouts = LabeledCounter(
             "vllm:request_timeouts_total",
             "Requests finished by deadline enforcement", "kind")
@@ -390,6 +392,41 @@ class PrometheusRegistry:
         self.inflight_prompt_tokens = Gauge(
             "vllm:inflight_prompt_tokens",
             "Prompt tokens reserved by admitted in-flight requests")
+        # QoS under pressure (vllm_tpu/resilience/qos): brownout-ladder
+        # state, per-tenant WFQ accounting, and load-based priority
+        # preemptions. Ladder/WFQ families refresh from the engine's
+        # qos_status() at render time; the rung gauge and preemption
+        # counter also ride SchedulerStats from the engine core.
+        self.brownout_rung = Gauge(
+            "vllm:brownout_rung",
+            "Current brownout-ladder rung (0 = normal, 1 = speculation "
+            "suspended, 2 = prefill chunks shrunk, 3 = batch-class "
+            "admissions shed, 4 = batch decodes preempted)")
+        self.brownout_transitions = BiLabeledCounter(
+            "vllm:brownout_transitions_total",
+            "Brownout-ladder transitions, by rung entered and direction "
+            "(up = escalation, down = hysteresis-gated disengage)",
+            "rung", "direction")
+        self.brownout_time_at_rung = LabeledGauge(
+            "vllm:brownout_time_at_rung_seconds",
+            "Cumulative seconds the brownout ladder has spent at each "
+            "rung (the time-at-rung histogram for bench artifacts)",
+            "rung")
+        self.pressure_preemptions = Counter(
+            "vllm:pressure_preemptions_total",
+            "Running decodes preempted by the load-based priority "
+            "trigger (queued higher-priority work missing its TTFT "
+            "budget, or brownout rung 4); journal-backed, token-"
+            "identical resume")
+        self.tenant_inflight_tokens = LabeledGauge(
+            "vllm:tenant_inflight_tokens",
+            "Prompt tokens reserved per tenant in the weighted-fair-"
+            "queueing admission ledger", "tenant")
+        self.tenant_debt = LabeledGauge(
+            "vllm:tenant_debt",
+            "Per-tenant WFQ virtual-time debt (how far ahead of its "
+            "weighted share the tenant has consumed; 0 = at or below "
+            "share)", "tenant")
         # Execution-layer fault containment (PR 5): numeric guards,
         # step watchdog, poison-request quarantine.
         self.numeric_guard_trips = LabeledCounter(
@@ -608,6 +645,9 @@ class PrometheusRegistry:
             self.requests_shed, self.request_timeouts,
             self.stream_outputs_dropped, self.slow_client_aborts,
             self.lifecycle_draining, self.inflight_prompt_tokens,
+            self.brownout_rung, self.brownout_transitions,
+            self.brownout_time_at_rung, self.pressure_preemptions,
+            self.tenant_inflight_tokens, self.tenant_debt,
             self.numeric_guard_trips, self.step_watchdog_trips,
             self.requests_quarantined,
             self.dp_routing_decisions, self.dp_prefix_hit_blocks,
@@ -711,6 +751,9 @@ class PrometheusRegistry:
             for kind, n in s.numeric_guard_trips.items():
                 self.numeric_guard_trips.inc_to(kind, float(n))
             self.step_watchdog_trips.inc_to(float(s.step_watchdog_trips))
+            self.brownout_rung.set(float(s.brownout_rung))
+            self.pressure_preemptions.inc_to(
+                float(s.pressure_preemptions))
             # Perfwatch: counters ratchet (cumulative across the proc
             # boundary); the attribution gauges adopt the last capture.
             self.perf_captures.inc_to(float(s.perfwatch_captures))
@@ -899,8 +942,16 @@ class PrometheusRegistry:
             status = engine.lifecycle_status()
         except Exception:
             return
-        for reason, n in status.get("shed", {}).items():
-            self.requests_shed.inc_to(reason, float(n))
+        shed_by_tenant = status.get("shed_by_tenant")
+        if shed_by_tenant is not None:
+            for reason, by_tenant in shed_by_tenant.items():
+                for tenant, n in by_tenant.items():
+                    self.requests_shed.inc_to(reason, tenant, float(n))
+        else:
+            # Older snapshot shape (engine stubs): fold the reason-only
+            # totals into the default tenant.
+            for reason, n in status.get("shed", {}).items():
+                self.requests_shed.inc_to(reason, "default", float(n))
         for kind, n in status.get("timeouts", {}).items():
             self.request_timeouts.inc_to(kind, float(n))
         self.stream_outputs_dropped.inc_to(
@@ -910,6 +961,31 @@ class PrometheusRegistry:
         self.lifecycle_draining.set(1.0 if status.get("draining") else 0.0)
         self.inflight_prompt_tokens.set(
             float(status.get("inflight_prompt_tokens", 0)))
+
+    def _refresh_qos(self) -> None:
+        engine = self._engine
+        if engine is None or not hasattr(engine, "qos_status"):
+            return
+        try:
+            status = engine.qos_status()
+        except Exception:
+            return
+        wfq = status.get("wfq") or {}
+        for tenant, n in (wfq.get("inflight_tokens") or {}).items():
+            self.tenant_inflight_tokens.set(tenant, float(n))
+        for tenant, d in (wfq.get("debt") or {}).items():
+            self.tenant_debt.set(tenant, float(d))
+        brown = status.get("brownout")
+        if brown is not None:
+            self.brownout_rung.set(float(brown.get("rung", 0)))
+            for rung, t in (brown.get("time_at_rung") or {}).items():
+                self.brownout_time_at_rung.set(rung, float(t))
+            # Transition totals are cumulative in the controller →
+            # ratchet ("<rung>:<direction>" keys in the snapshot).
+            for key, n in (brown.get("transitions") or {}).items():
+                rung, _, direction = key.partition(":")
+                self.brownout_transitions.inc_to(
+                    rung, direction, float(n))
 
     def _refresh_slo(self) -> None:
         engine = self._engine
@@ -930,6 +1006,7 @@ class PrometheusRegistry:
     def render(self) -> str:
         self._refresh_resilience()
         self._refresh_lifecycle()
+        self._refresh_qos()
         self._refresh_routing()
         self._refresh_disagg()
         self._refresh_autoscale()

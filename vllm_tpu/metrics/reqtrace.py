@@ -89,6 +89,7 @@ class RequestTraceRecorder:
             "trace_id": timings.trace_id,
             "slo_class": timings.slo_class,
             "tenant_id": timings.tenant_id,
+            "priority": getattr(params, "priority", None),
             "arrival_offset_s": round(
                 max(0.0, timings.arrival_time - self._t0_mono), 6
             ),
@@ -225,6 +226,7 @@ def synthesize_trace(
             "trace_id": None,
             "slo_class": cls.get("slo_class"),
             "tenant_id": cls.get("tenant_id"),
+            "priority": cls.get("priority"),
             "arrival_offset_s": round(t, 6),
             "prompt_len": int(cls.get("prompt_len", 32)),
             "output_len": int(cls.get("max_tokens", 16)),

@@ -56,6 +56,12 @@ from vllm_tpu.resilience.lifecycle import (
     SlowClientError,
     make_shed_error,
 )
+from vllm_tpu.resilience.qos import (
+    BrownoutConfig,
+    BrownoutController,
+    TenantFairQueue,
+    parse_tenant_weights,
+)
 from vllm_tpu.resilience.quarantine import (
     DeadLetterStore,
     QuarantineManager,
@@ -113,6 +119,8 @@ class RequestFailedOnCrashError(RuntimeError):
 __all__ = [
     "AdmissionController",
     "AutoscaleController",
+    "BrownoutConfig",
+    "BrownoutController",
     "DeadLetterStore",
     "EngineRestartedError",
     "EngineSupervisor",
@@ -127,5 +135,7 @@ __all__ = [
     "ResilienceConfig",
     "SlowClientError",
     "TIMEOUT_FINISH_REASON",
+    "TenantFairQueue",
     "make_shed_error",
+    "parse_tenant_weights",
 ]

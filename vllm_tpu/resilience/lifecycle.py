@@ -30,6 +30,13 @@ import threading
 from dataclasses import dataclass, field
 from typing import Literal
 
+from vllm_tpu.resilience.qos import (
+    DEFAULT_TENANT,
+    BrownoutConfig,
+    TenantFairQueue,
+    parse_tenant_weights,
+)
+
 # The finish_reason delivered for a request that hit its deadline or TTFT
 # timeout (streamed like "stop"/"length"; never an exception — a timeout
 # is an expected lifecycle outcome, not a server fault).
@@ -105,6 +112,39 @@ class LifecycleConfig:
     drain_timeout_s: float = 30.0
     # Retry-After header value on 429/503 shed responses.
     retry_after_s: float = 1.0
+    # Per-tenant weighted fair queueing over max_queued_prompt_tokens:
+    # "acme:3,bulk:1" gives acme 3x bulk's share of the budget under
+    # contention. Unlisted tenants weigh 1.0; None/empty = equal weights
+    # (the budget still degrades to the plain global cap for a single
+    # tenant). See resilience/qos.py.
+    tenant_weights: str | None = None
+    # Brownout ladder (resilience/qos.py): opt-in ordered degradation
+    # under pressure. Rung 1 suspends speculation, rung 2 shrinks
+    # chunked-prefill chunks, rung 3 sheds batch-class admissions,
+    # rung 4 preempts batch decodes. Escape hatch:
+    # VLLM_TPU_DISABLE_QOS=1.
+    brownout: bool = False
+    brownout_occupancy_high: float = 0.92
+    brownout_queue_depth_high: float = 8.0
+    brownout_slo_floor: float = 0.0
+    brownout_step_up_hold_s: float = 0.25
+    brownout_step_down_hold_s: float = 2.0
+    brownout_interval_s: float = 0.05
+    brownout_max_rung: int = 4
+    brownout_shed_classes: str = "batch"
+
+    def make_brownout_config(self) -> BrownoutConfig:
+        return BrownoutConfig(
+            enabled=self.brownout,
+            occupancy_high=self.brownout_occupancy_high,
+            queue_depth_high=self.brownout_queue_depth_high,
+            slo_floor=self.brownout_slo_floor,
+            step_up_hold_s=self.brownout_step_up_hold_s,
+            step_down_hold_s=self.brownout_step_down_hold_s,
+            interval_s=self.brownout_interval_s,
+            max_rung=self.brownout_max_rung,
+            shed_classes=self.brownout_shed_classes,
+        ).finalize()
 
     def finalize(self) -> "LifecycleConfig":
         if self.max_inflight_requests < 0:
@@ -133,6 +173,10 @@ class LifecycleConfig:
             raise ValueError("drain_timeout_s must be >= 0")
         if self.retry_after_s < 0:
             raise ValueError("retry_after_s must be >= 0")
+        # Raises ValueError on malformed specs; the parsed dict is
+        # rebuilt by the AdmissionController at construction time.
+        parse_tenant_weights(self.tenant_weights)
+        self.make_brownout_config()
         return self
 
 
@@ -155,6 +199,14 @@ class AdmissionController:
         # Cumulative shed events by reason (feeds
         # vllm:requests_shed_total{reason=...}).
         self.shed_total: dict[str, int] = {}
+        # reason -> tenant -> count (the {reason,tenant} breakdown of
+        # the same counter; the sums must always agree).
+        self.shed_by_tenant: dict[str, dict[str, int]] = {}
+        # Weighted fair queueing over the prompt-token budget; the
+        # wfq_enabled flag is the live FIFO-vs-QoS A/B toggle.
+        self.fair_queue = TenantFairQueue(
+            parse_tenant_weights(config.tenant_weights))
+        self.wfq_enabled = True
 
     # -- admission -----------------------------------------------------
 
@@ -173,10 +225,18 @@ class AdmissionController:
                 return "saturated_requests"
         return None
 
-    def try_admit(self, request_id: str, num_prompt_tokens: int) -> str | None:
+    def try_admit(self, request_id: str, num_prompt_tokens: int,
+                  tenant_id: str | None = None) -> str | None:
         """Admit (reserving capacity) or return the shed reason. A shed
-        is counted here so served + shed accounting always balances."""
+        is counted here so served + shed accounting always balances.
+
+        The prompt-token budget is a weighted fair queue over tenants:
+        once the global budget is exhausted, a request sheds only if its
+        tenant is also over its weighted share — so a tenant that was
+        crowded out while under its share still admits (work-conserving),
+        and a single tenant degrades to the plain global cap."""
         cfg = self.config
+        tenant = tenant_id or DEFAULT_TENANT
         with self._lock:
             reason = None
             if self.draining:
@@ -191,20 +251,47 @@ class AdmissionController:
                 and self._admitted  # an empty pool always admits one
                 and self._inflight_tokens + num_prompt_tokens
                 > cfg.max_queued_prompt_tokens
+                and (
+                    not self.wfq_enabled
+                    or self.fair_queue.would_exceed_share(
+                        tenant, num_prompt_tokens,
+                        cfg.max_queued_prompt_tokens)
+                )
             ):
                 reason = "saturated_tokens"
             if reason is not None:
-                self.shed_total[reason] = self.shed_total.get(reason, 0) + 1
+                self._count_shed_locked(reason, tenant)
                 return reason
             self._admitted[request_id] = num_prompt_tokens
             self._inflight_tokens += num_prompt_tokens
+            self.fair_queue.admit(request_id, tenant, num_prompt_tokens)
             return None
+
+    def count_shed(self, reason: str, tenant_id: str | None = None) -> None:
+        """Count a shed decided outside try_admit (e.g. a brownout
+        rung-3 shed in the frontend) so total accounting balances."""
+        with self._lock:
+            self._count_shed_locked(reason, tenant_id or DEFAULT_TENANT)
+
+    def _count_shed_locked(self, reason: str, tenant: str) -> None:
+        self.shed_total[reason] = self.shed_total.get(reason, 0) + 1
+        by_tenant = self.shed_by_tenant.setdefault(reason, {})
+        by_tenant[tenant] = by_tenant.get(tenant, 0) + 1
+
+    def note_requeue(self, request_id: str) -> None:
+        """A scheduler preemption re-queued this request: re-charge its
+        tenant's WFQ debt (a preempt/resume cycle consumes capacity
+        twice) without touching the token reservation, so release stays
+        exactly-once."""
+        with self._lock:
+            self.fair_queue.note_requeue(request_id)
 
     def release(self, request_id: str) -> None:
         with self._lock:
             tokens = self._admitted.pop(request_id, None)
             if tokens is not None:
                 self._inflight_tokens -= tokens
+            self.fair_queue.release(request_id)
 
     # -- drain ---------------------------------------------------------
 
@@ -235,11 +322,21 @@ class AdmissionController:
                 "max_inflight_requests": cfg.max_inflight_requests,
                 "max_queued_prompt_tokens": cfg.max_queued_prompt_tokens,
                 "shed": dict(self.shed_total),
+                "shed_by_tenant": {
+                    reason: dict(by_tenant)
+                    for reason, by_tenant in self.shed_by_tenant.items()
+                },
+                "wfq_enabled": self.wfq_enabled,
+                "wfq": self.fair_queue.snapshot(),
             }
 
 
-def make_shed_error(reason: str, config: LifecycleConfig) -> RequestShedError:
-    """The one place shed reasons become user-facing messages."""
+def make_shed_error(reason: str, config: LifecycleConfig,
+                    retry_after_s: float | None = None) -> RequestShedError:
+    """The one place shed reasons become user-facing messages.
+
+    ``retry_after_s`` overrides the configured default (the brownout
+    ladder scales it with the rung)."""
     messages = {
         "draining": "the server is shutting down and not accepting new "
                     "requests",
@@ -247,8 +344,11 @@ def make_shed_error(reason: str, config: LifecycleConfig) -> RequestShedError:
                               "capacity; retry shortly",
         "saturated_tokens": "the server is at its queued prompt-token "
                             "capacity; retry shortly",
+        "brownout": "the server is browning out batch-class traffic to "
+                    "protect interactive SLOs; retry with backoff",
     }
     return RequestShedError(
         reason, messages.get(reason, reason),
-        retry_after_s=config.retry_after_s,
+        retry_after_s=(config.retry_after_s if retry_after_s is None
+                       else retry_after_s),
     )

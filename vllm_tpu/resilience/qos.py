@@ -1,0 +1,360 @@
+"""QoS under pressure: per-tenant fair queueing and the brownout ladder.
+
+Two pure, engine-agnostic mechanisms in the injectable-clock style of
+``resilience/autoscale.py``:
+
+- ``TenantFairQueue``: weighted fair queueing over the shared
+  ``max_queued_prompt_tokens`` admission budget. Each tenant gets a
+  weighted share of the budget; the shed rule is *work-conserving* — a
+  request sheds only when the global budget is exhausted AND its tenant
+  is over its weighted share, so a lone tenant still gets the whole
+  budget and a storm tenant cannot crowd out light tenants. Virtual-time
+  debt accounting survives preemption/resume (``note_requeue`` re-charges
+  debt without touching the token reservation, keeping ``release``
+  exactly-once) and crash-replay (the queue lives frontend-side; journal
+  replay never re-admits).
+
+- ``BrownoutController``: an ordered ladder of degradation rungs engaged
+  by the same occupancy / queue-depth / SLO signals the autoscaler
+  watches, but acting in milliseconds instead of scale-event seconds.
+  Rung 1 suspends speculation pool-wide, rung 2 shrinks the chunked-
+  prefill chunk size to bound interactive TTFT, rung 3 sheds batch-class
+  admissions with a class-aware ``Retry-After``, rung 4 preempts batch
+  decodes. Escalation is one rung at a time with a dwell; disengage has
+  hysteresis (margin below the engage watermark plus a longer hold).
+
+Escape hatch: ``VLLM_TPU_DISABLE_QOS=1`` disables both mechanisms
+(checked at the construction sites, not here — these classes stay pure).
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass
+
+# Requests without a tenant label all share one bucket; same convention
+# as DEFAULT_SLO_CLASS in metrics/stats.py.
+DEFAULT_TENANT = "default"
+
+# What each rung does, for /health and log lines.
+RUNG_ACTIONS = {
+    0: "normal",
+    1: "spec_suspended",
+    2: "chunk_shrunk",
+    3: "batch_shed",
+    4: "batch_preempt",
+}
+
+
+def parse_tenant_weights(spec: str | None) -> dict[str, float]:
+    """Parse ``--tenant-weights`` (``"acme:3,bulk:1"``) into a dict.
+
+    Unlisted tenants default to weight 1.0 at lookup time. Raises
+    ``ValueError`` on malformed entries or non-positive weights.
+    """
+    weights: dict[str, float] = {}
+    if not spec:
+        return weights
+    for part in spec.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        name, sep, raw = part.partition(":")
+        name = name.strip()
+        if not sep or not name:
+            raise ValueError(
+                f"tenant-weights entry {part!r}: expected 'tenant:weight'")
+        try:
+            weight = float(raw.strip())
+        except ValueError:
+            raise ValueError(
+                f"tenant-weights entry {part!r}: weight is not a number"
+            ) from None
+        if weight <= 0:
+            raise ValueError(
+                f"tenant-weights entry {part!r}: weight must be > 0")
+        weights[name] = weight
+    return weights
+
+
+class TenantFairQueue:
+    """Weighted fair queueing over a shared prompt-token budget.
+
+    Tracks per tenant the prompt tokens currently reserved and a
+    virtual finish time; the gap between a tenant's virtual time and the
+    global virtual clock is its *debt* — how far ahead of its fair share
+    it has consumed. Thread safety is the caller's job (the
+    ``AdmissionController`` holds its lock across every call).
+    """
+
+    def __init__(self, weights: dict[str, float] | None = None,
+                 default_weight: float = 1.0):
+        self._weights = dict(weights or {})
+        self._default_weight = float(default_weight)
+        self._vclock = 0.0
+        self._vtime: dict[str, float] = {}
+        self._inflight: dict[str, int] = {}
+        # request_id -> (tenant, tokens); survives preempt/resume so a
+        # requeue can find its reservation without re-admitting.
+        self._by_request: dict[str, tuple[str, int]] = {}
+        self._requeues: dict[str, int] = {}
+
+    def weight(self, tenant: str) -> float:
+        return self._weights.get(tenant, self._default_weight)
+
+    def share(self, tenant: str, budget: int) -> float:
+        """Tenant's weighted share of ``budget`` among *active* tenants
+        (tenants with tokens inflight, plus ``tenant`` itself). A lone
+        tenant's share is the whole budget."""
+        active = {t for t, v in self._inflight.items() if v > 0}
+        active.add(tenant)
+        total_w = sum(self.weight(t) for t in active)
+        if total_w <= 0:
+            return float(budget)
+        return budget * self.weight(tenant) / total_w
+
+    def would_exceed_share(self, tenant: str, tokens: int,
+                           budget: int) -> bool:
+        if budget <= 0:
+            return False
+        return (self._inflight.get(tenant, 0) + tokens
+                > self.share(tenant, budget))
+
+    def admit(self, request_id: str, tenant: str, tokens: int) -> None:
+        if request_id in self._by_request:
+            return
+        self._by_request[request_id] = (tenant, tokens)
+        self._inflight[tenant] = self._inflight.get(tenant, 0) + tokens
+        self._charge(tenant, tokens)
+
+    def release(self, request_id: str) -> None:
+        entry = self._by_request.pop(request_id, None)
+        if entry is None:
+            return
+        tenant, tokens = entry
+        left = self._inflight.get(tenant, 0) - tokens
+        if left > 0:
+            self._inflight[tenant] = left
+        else:
+            self._inflight.pop(tenant, None)
+        self._advance_vclock()
+
+    def note_requeue(self, request_id: str) -> None:
+        """Re-charge a preempted request's virtual-time debt.
+
+        A preempt/resume cycle consumes scheduler capacity twice, so the
+        tenant pays twice in virtual time — but the token reservation is
+        untouched, so ``release`` stays exactly-once and the admission
+        ledger still balances."""
+        entry = self._by_request.get(request_id)
+        if entry is None:
+            return
+        tenant, tokens = entry
+        self._charge(tenant, tokens)
+        self._requeues[tenant] = self._requeues.get(tenant, 0) + 1
+
+    def debt(self, tenant: str) -> float:
+        return max(0.0, self._vtime.get(tenant, 0.0) - self._vclock)
+
+    def inflight(self, tenant: str) -> int:
+        return self._inflight.get(tenant, 0)
+
+    def _charge(self, tenant: str, tokens: int) -> None:
+        start = max(self._vclock, self._vtime.get(tenant, 0.0))
+        self._vtime[tenant] = start + tokens / self.weight(tenant)
+
+    def _advance_vclock(self) -> None:
+        active = [self._vtime.get(t, 0.0)
+                  for t, v in self._inflight.items() if v > 0]
+        if active:
+            self._vclock = max(self._vclock, min(active))
+        elif self._vtime:
+            # Pool idle: catch the clock up so idle tenants don't bank
+            # unbounded credit against the next burst.
+            self._vclock = max(self._vclock, max(self._vtime.values()))
+
+    def snapshot(self) -> dict:
+        tenants = sorted(set(self._vtime) | set(self._weights)
+                         | set(self._inflight))
+        return {
+            "weights": {t: self.weight(t) for t in tenants},
+            "inflight_tokens": {t: self._inflight.get(t, 0)
+                                for t in tenants},
+            "debt": {t: round(self.debt(t), 3) for t in tenants},
+            "requeues": dict(self._requeues),
+        }
+
+
+@dataclass
+class BrownoutConfig:
+    """Knobs for the brownout ladder; all validated in ``finalize``."""
+
+    enabled: bool = False
+    # Engage when smoothed occupancy or queue depth crosses these (or
+    # SLO attainment drops below the floor, when a floor is set).
+    occupancy_high: float = 0.92
+    queue_depth_high: float = 8.0
+    slo_floor: float = 0.0
+    ema_half_life_s: float = 2.0
+    # Escalate one rung per dwell while pressure persists; disengage one
+    # rung per (longer) hold once clearly below the watermarks.
+    step_up_hold_s: float = 0.25
+    step_down_hold_s: float = 2.0
+    disengage_margin: float = 0.08
+    max_rung: int = 4
+    # Poll throttle in the frontend step loop.
+    interval_s: float = 0.05
+    # SLO classes rung 3 sheds (comma list); priority > 0 requests are
+    # always considered batch-class.
+    shed_classes: str = "batch"
+
+    def finalize(self) -> "BrownoutConfig":
+        if not 0.0 < self.occupancy_high <= 1.0:
+            raise ValueError(
+                f"brownout occupancy_high must be in (0, 1], got "
+                f"{self.occupancy_high}")
+        if self.queue_depth_high <= 0:
+            raise ValueError(
+                f"brownout queue_depth_high must be > 0, got "
+                f"{self.queue_depth_high}")
+        if not 0.0 <= self.slo_floor <= 1.0:
+            raise ValueError(
+                f"brownout slo_floor must be in [0, 1], got "
+                f"{self.slo_floor}")
+        if not 1 <= self.max_rung <= 4:
+            raise ValueError(
+                f"brownout max_rung must be in [1, 4], got {self.max_rung}")
+        for name in ("ema_half_life_s", "step_up_hold_s",
+                     "step_down_hold_s", "interval_s"):
+            if getattr(self, name) < 0:
+                raise ValueError(f"brownout {name} must be >= 0")
+        if not 0.0 <= self.disengage_margin < self.occupancy_high:
+            raise ValueError(
+                f"brownout disengage_margin must be in [0, "
+                f"occupancy_high), got {self.disengage_margin}")
+        return self
+
+    def shed_class_set(self) -> set[str]:
+        return {c.strip() for c in self.shed_classes.split(",")
+                if c.strip()}
+
+
+class _Ema:
+    """Time-decayed EMA (same shape as the autoscaler's smoother)."""
+
+    def __init__(self, half_life_s: float):
+        self.half_life_s = max(1e-6, half_life_s)
+        self.value: float | None = None
+        self.t_last: float | None = None
+
+    def update(self, now: float, sample: float) -> float:
+        if self.value is None or self.t_last is None:
+            self.value = sample
+        else:
+            dt = max(0.0, now - self.t_last)
+            w = 0.5 ** (dt / self.half_life_s)
+            alpha = max(1.0 - w, 0.1)
+            self.value = (1.0 - alpha) * self.value + alpha * sample
+        self.t_last = now
+        return self.value
+
+
+class BrownoutController:
+    """The rung ladder. Pure decision logic: callers sample signals and
+    apply the returned rung (suspend spec, shrink chunks, shed, preempt).
+
+    Escalation: rung 0 -> 1 fires on the first pressured observation
+    (milliseconds matter); each further rung requires pressure to
+    persist for ``step_up_hold_s``. Disengage: one rung per
+    ``step_down_hold_s`` once signals are clearly below the watermarks
+    (hysteresis margin), so the ladder doesn't flap around the
+    threshold."""
+
+    def __init__(self, config: BrownoutConfig,
+                 *, clock=None):
+        self.config = config
+        self._clock = clock or time.monotonic
+        self.rung = 0
+        self._occ = _Ema(config.ema_half_life_s)
+        self._depth = _Ema(config.ema_half_life_s)
+        self._pressure_since: float | None = None
+        self._clear_since: float | None = None
+        self._last_observe_t: float | None = None
+        # (rung entered, "up"|"down") -> count
+        self.transitions: dict[tuple[int, str], int] = {}
+        self.time_at_rung: dict[int, float] = {
+            r: 0.0 for r in range(config.max_rung + 1)}
+
+    def observe(self, *, occupancy: float, queue_depth: float,
+                slo_attainment: float | None = None,
+                now: float | None = None) -> int:
+        now = self._clock() if now is None else now
+        if self._last_observe_t is not None:
+            dt = max(0.0, now - self._last_observe_t)
+            self.time_at_rung[self.rung] = (
+                self.time_at_rung.get(self.rung, 0.0) + dt)
+        self._last_observe_t = now
+
+        occ = self._occ.update(now, occupancy)
+        depth = self._depth.update(now, queue_depth)
+        cfg = self.config
+        slo_bad = (cfg.slo_floor > 0.0 and slo_attainment is not None
+                   and slo_attainment < cfg.slo_floor)
+        pressure = (occ >= cfg.occupancy_high
+                    or depth >= cfg.queue_depth_high or slo_bad)
+        clear = (occ < cfg.occupancy_high - cfg.disengage_margin
+                 and depth < cfg.queue_depth_high * 0.5 and not slo_bad)
+
+        if pressure:
+            self._clear_since = None
+            first = self._pressure_since is None
+            if first:
+                self._pressure_since = now
+            if self.rung == 0 or (not first and
+                                  now - self._pressure_since
+                                  >= cfg.step_up_hold_s):
+                if self.rung < cfg.max_rung:
+                    self._step(+1)
+                    self._pressure_since = now  # re-arm dwell per rung
+        elif clear:
+            self._pressure_since = None
+            if self.rung > 0:
+                if self._clear_since is None:
+                    self._clear_since = now
+                if now - self._clear_since >= cfg.step_down_hold_s:
+                    self._step(-1)
+                    self._clear_since = now
+            else:
+                self._clear_since = None
+        else:
+            # Hysteresis band: hold the current rung, reset both dwells.
+            self._pressure_since = None
+            self._clear_since = None
+        return self.rung
+
+    def retry_after_s(self, base: float) -> float:
+        """Class-aware Retry-After: deeper rungs push clients back
+        harder."""
+        return max(base, base * self.rung)
+
+    def _step(self, direction: int) -> None:
+        new = max(0, min(self.config.max_rung, self.rung + direction))
+        if new == self.rung:
+            return
+        self.rung = new
+        key = (new, "up" if direction > 0 else "down")
+        self.transitions[key] = self.transitions.get(key, 0) + 1
+
+    def snapshot(self) -> dict:
+        return {
+            "rung": self.rung,
+            "action": RUNG_ACTIONS.get(self.rung, "unknown"),
+            "max_rung": self.config.max_rung,
+            "occupancy_ema": round(self._occ.value or 0.0, 4),
+            "queue_depth_ema": round(self._depth.value or 0.0, 3),
+            "time_at_rung": {str(r): round(t, 3)
+                             for r, t in sorted(self.time_at_rung.items())},
+            "transitions": {f"{r}:{d}": n
+                            for (r, d), n in sorted(self.transitions.items())},
+            "shed_classes": sorted(self.config.shed_class_set()),
+        }

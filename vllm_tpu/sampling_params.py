@@ -97,6 +97,13 @@ class SamplingParams:
     # histograms, sliding-window attainment) and the trace recorder.
     slo_class: str | None = None
     tenant_id: str | None = None
+    # Scheduling priority (``X-Priority`` header or the matching body
+    # field): lower = more urgent, 0 = interactive default. Resolved into
+    # Request.priority at admission; under --scheduling-policy priority
+    # it orders the waiting queue, and the QoS layer (resilience/qos.py)
+    # treats priority > 0 as batch-class for brownout shed/preemption.
+    # None = unset (lets header-vs-body precedence detect a body value).
+    priority: int | None = None
     # Extension hook carried through untouched.
     extra_args: dict[str, Any] | None = None
 
@@ -145,6 +152,14 @@ class SamplingParams:
             if not isinstance(label, str) or not label or len(label) > 64:
                 raise ValueError(
                     f"{label_name} must be a non-empty string of <= 64 chars"
+                )
+        if self.priority is not None:
+            if (isinstance(self.priority, bool)
+                    or not isinstance(self.priority, int)
+                    or not 0 <= self.priority <= 100):
+                raise ValueError(
+                    f"priority must be an integer in [0, 100], got "
+                    f"{self.priority!r}"
                 )
 
     @property
