@@ -1,0 +1,582 @@
+"""Zero-downtime operations: rolling-upgrade controller units on a fake
+clock, live-config vetting, and the dp=2 CPU-mesh e2e.
+
+The controller section proves the decision machine alone: the
+one-upgrade-at-a-time latch, the spawn/boot/gate/promote/drain slot
+sequence, gate failure paths (probe failure, gate deadline, SLO floor,
+newcomer death), abort at every safe point, and the one-probe-in-flight
+timer — all deterministic under an injected clock, no engines.
+
+The e2e section proves the execution layer against the real DPLB pool:
+a full rolling cycle replaces every slot with token-identical streams
+spanning the swap (zero lost requests), the new weights fingerprint
+becomes visible in the per-engine version blocks, and a failed health
+gate rolls back to a pool that serves byte-identically with the
+original slots intact.
+"""
+
+from __future__ import annotations
+
+import shutil
+import time
+
+import pytest
+
+from vllm_tpu.resilience.rolling import (
+    LiveConfigError,
+    RollingUpgradeController,
+    live_config_keys,
+    vet_live_config,
+)
+
+
+class FakeClock:
+    def __init__(self) -> None:
+        self.t = 100.0
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, dt: float) -> None:
+        self.t += dt
+
+
+def mk(**kw):
+    clock = kw.pop("clock", None) or FakeClock()
+    kw.setdefault("gate_requests", 2)
+    kw.setdefault("gate_timeout_s", 60.0)
+    # 0 = the fake clock never waits between probes; the unit tests
+    # exercise the gate logic, not the pacing.
+    kw.setdefault("probe_interval_s", 0.0)
+    return RollingUpgradeController(clock=clock, **kw), clock
+
+
+def to_gating(ctrl, newcomer=2):
+    """Walk the current slot from spawning into its health gate."""
+    action = ctrl.next_action()
+    assert action["op"] == "spawn"
+    ctrl.note_spawned(newcomer)
+    assert ctrl.phase == "booting"
+    ctrl.note_newcomer_up()
+    assert ctrl.phase == "gating"
+
+
+def pass_gate(ctrl, n):
+    """Run n successful probes (one in flight at a time)."""
+    for _ in range(n):
+        action = ctrl.next_action()
+        assert action["op"] == "probe"
+        ctrl.note_probe(True)
+
+
+class TestControllerValidation:
+    def test_bad_gate_knobs(self):
+        with pytest.raises(ValueError):
+            RollingUpgradeController(gate_requests=0)
+        with pytest.raises(ValueError):
+            RollingUpgradeController(gate_timeout_s=0.0)
+        with pytest.raises(ValueError):
+            RollingUpgradeController(slo_floor=1.5)
+
+
+class TestControllerSequence:
+    def test_full_cycle_two_slots(self):
+        ctrl, _ = mk()
+        assert ctrl.start([0, 1], checkpoint="/ckpt/v2",
+                          config={"a.b": 1})
+        # One upgrade at a time, no exceptions.
+        assert not ctrl.start([0])
+
+        action = ctrl.next_action()
+        assert action == {"op": "spawn", "victim": 0,
+                          "checkpoint": "/ckpt/v2", "config": {"a.b": 1}}
+        # Refused spawn (scale-event latch busy): re-issued next tick.
+        ctrl.note_spawned(None)
+        assert ctrl.phase == "spawning"
+        assert ctrl.next_action()["op"] == "spawn"
+        ctrl.note_spawned(2)
+        assert ctrl.phase == "booting"
+        assert ctrl.next_action() is None  # waiting on boot
+        ctrl.note_newcomer_up()
+        pass_gate(ctrl, 2)
+        action = ctrl.next_action()
+        assert action == {"op": "promote", "newcomer": 2, "victim": 0}
+        assert ctrl.phase == "draining"
+        assert ctrl.next_action() is None  # drain owned by executor
+        ctrl.note_victim_retired()
+
+        # Slot 1 cycles next with a fresh newcomer.
+        assert ctrl.phase == "spawning"
+        assert ctrl.next_action()["victim"] == 1
+        ctrl.note_spawned(3)
+        ctrl.note_newcomer_up()
+        pass_gate(ctrl, 2)
+        assert ctrl.next_action()["op"] == "promote"
+        ctrl.note_victim_retired()
+
+        assert not ctrl.active
+        assert ctrl.last_outcome == "ok"
+        assert ctrl.upgrade_events_total == {"ok": 1}
+        assert ctrl.probes_total == {"ok": 4}
+        # The finished controller can start the next cycle.
+        assert ctrl.start([2, 3])
+
+    def test_start_refuses_empty_slots(self):
+        ctrl, _ = mk()
+        assert not ctrl.start([])
+        assert not ctrl.active
+
+    def test_one_probe_in_flight(self):
+        ctrl, clock = mk(gate_requests=2, probe_interval_s=0.5)
+        ctrl.start([0])
+        to_gating(ctrl)
+        assert ctrl.next_action()["op"] == "probe"
+        # Probe in flight: no second probe until note_probe re-arms.
+        assert ctrl.next_action() is None
+        ctrl.note_probe(True)
+        assert ctrl.next_action() is None  # pacing interval not elapsed
+        clock.advance(0.6)
+        assert ctrl.next_action()["op"] == "probe"
+
+    def test_probe_interrupted_rearms_without_counting(self):
+        ctrl, clock = mk(gate_requests=1, probe_interval_s=0.5)
+        ctrl.start([0])
+        to_gating(ctrl)
+        assert ctrl.next_action()["op"] == "probe"
+        # A bystander engine death raced the probe: neither pass nor
+        # fail, and the gate must not stall into its deadline.
+        ctrl.note_probe_interrupted()
+        assert ctrl.probes_total == {}
+        clock.advance(0.6)
+        assert ctrl.next_action()["op"] == "probe"
+        ctrl.note_probe(True)
+        assert ctrl.next_action()["op"] == "promote"
+
+    def test_probe_interrupted_noop_outside_gating(self):
+        ctrl, _ = mk()
+        ctrl.note_probe_interrupted()  # idle: no crash, no state
+        assert not ctrl.active
+
+
+class TestGateFailure:
+    def test_probe_failure_rolls_back(self):
+        ctrl, _ = mk(gate_requests=3)
+        ctrl.start([0])
+        to_gating(ctrl)
+        pass_gate(ctrl, 2)
+        assert ctrl.next_action()["op"] == "probe"
+        ctrl.note_probe(False)
+        action = ctrl.next_action()
+        assert action == {"op": "rollback", "newcomer": 2, "victim": 0}
+        assert ctrl.phase == "rolling_back"
+        ctrl.note_rolled_back()
+        assert ctrl.last_outcome == "rolled_back"
+        assert ctrl.snapshot()["fail_reason"] == "probe failed"
+        assert ctrl.upgrade_events_total == {"rolled_back": 1}
+
+    def test_gate_deadline_rolls_back(self):
+        ctrl, clock = mk(gate_requests=2, gate_timeout_s=10.0)
+        ctrl.start([0])
+        to_gating(ctrl)
+        assert ctrl.next_action()["op"] == "probe"
+        ctrl.note_probe(True)
+        clock.advance(10.1)
+        action = ctrl.next_action()
+        assert action["op"] == "rollback"
+        assert "gate deadline" in ctrl.snapshot()["fail_reason"]
+        assert "1/2 probes ok" in ctrl.snapshot()["fail_reason"]
+        ctrl.note_rolled_back()
+        assert ctrl.last_outcome == "rolled_back"
+
+    def test_slo_floor_blocks_promotion(self):
+        ctrl, clock = mk(gate_requests=1, slo_floor=0.9,
+                         gate_timeout_s=10.0)
+        ctrl.start([0])
+        to_gating(ctrl)
+        assert ctrl.next_action(0.5)["op"] == "probe"
+        ctrl.note_probe(True)
+        # Probes satisfied but the pool is degraded: keep holding (more
+        # probes), never promote under the floor.
+        assert ctrl.next_action(0.5)["op"] == "probe"
+        ctrl.note_probe(True)
+        # Attainment recovers: promote.
+        assert ctrl.next_action(0.95)["op"] == "promote"
+
+    def test_slo_floor_deadline_names_the_floor(self):
+        ctrl, clock = mk(gate_requests=1, slo_floor=0.9,
+                         gate_timeout_s=10.0)
+        ctrl.start([0])
+        to_gating(ctrl)
+        assert ctrl.next_action(0.5)["op"] == "probe"
+        ctrl.note_probe(True)
+        clock.advance(10.1)
+        assert ctrl.next_action(0.5)["op"] == "rollback"
+        assert "slo 0.500 < floor 0.9" in ctrl.snapshot()["fail_reason"]
+
+    def test_missing_slo_window_does_not_block(self):
+        ctrl, _ = mk(gate_requests=1, slo_floor=0.9)
+        ctrl.start([0])
+        to_gating(ctrl)
+        assert ctrl.next_action(None)["op"] == "probe"
+        ctrl.note_probe(True)
+        # No scoreboard window at all: the floor cannot be evaluated
+        # and must not wedge the upgrade.
+        assert ctrl.next_action(None)["op"] == "promote"
+
+    def test_newcomer_death_is_automatic_rollback(self):
+        for phase_setup in ("booting", "gating"):
+            ctrl, _ = mk()
+            ctrl.start([0, 1])
+            action = ctrl.next_action()
+            ctrl.note_spawned(2)
+            if phase_setup == "gating":
+                ctrl.note_newcomer_up()
+            ctrl.note_newcomer_dead()
+            assert not ctrl.active
+            assert ctrl.last_outcome == "rolled_back"
+            assert "newcomer died" in (
+                ctrl.snapshot()["fail_reason"] or "")
+
+
+class TestAbort:
+    def test_abort_while_spawning(self):
+        ctrl, _ = mk()
+        ctrl.start([0, 1])
+        assert ctrl.request_abort()
+        assert ctrl.next_action() is None
+        assert not ctrl.active
+        assert ctrl.last_outcome == "aborted"
+
+    def test_abort_while_gating_rolls_back(self):
+        ctrl, _ = mk()
+        ctrl.start([0])
+        to_gating(ctrl)
+        ctrl.request_abort()
+        action = ctrl.next_action()
+        assert action["op"] == "rollback"
+        ctrl.note_rolled_back()
+        assert ctrl.last_outcome == "aborted"
+        assert ctrl.upgrade_events_total == {"aborted": 1}
+
+    def test_abort_while_draining_finishes_the_drain(self):
+        # Un-draining a promoted victim would lose requests: the abort
+        # lands after the in-flight slot completes, before the next.
+        ctrl, _ = mk()
+        ctrl.start([0, 1])
+        to_gating(ctrl)
+        pass_gate(ctrl, 2)
+        assert ctrl.next_action()["op"] == "promote"
+        ctrl.request_abort()
+        assert ctrl.next_action() is None  # drain keeps running
+        ctrl.note_victim_retired()
+        assert not ctrl.active
+        assert ctrl.last_outcome == "aborted"
+        assert ctrl.snapshot()["slots_done"] == 1
+
+    def test_abort_when_idle_is_refused(self):
+        ctrl, _ = mk()
+        assert not ctrl.request_abort()
+
+
+class TestSnapshot:
+    def test_snapshot_shape(self):
+        ctrl, clock = mk(gate_timeout_s=60.0)
+        ctrl.start([0, 1], checkpoint="/ckpt/v2")
+        to_gating(ctrl)
+        clock.advance(15.0)
+        snap = ctrl.snapshot()
+        assert snap["active"] and snap["phase"] == "gating"
+        assert snap["victim"] == 0 and snap["newcomer"] == 2
+        assert snap["checkpoint"] == "/ckpt/v2"
+        assert snap["slots_remaining"] == 2
+        assert snap["slots_done"] == 0
+        assert snap["gate_remaining_s"] == pytest.approx(45.0)
+        # Outside the gate the countdown is meaningless, not 0.
+        ctrl.request_abort()
+        ctrl.next_action()
+        ctrl.note_rolled_back()
+        assert ctrl.snapshot()["gate_remaining_s"] is None
+
+
+class TestLiveConfig:
+    def test_split_by_scope(self):
+        frontend, engine = vet_live_config({
+            "tenant_weights": "acme:3,bulk:1",
+            "brownout_occupancy_high": 0.7,
+            "long_prefill_token_threshold": 256,
+            "pressure_preemption_s": 1.5,
+        })
+        assert frontend == {
+            "tenant_weights": "acme:3,bulk:1",
+            "brownout_occupancy_high": 0.7,
+        }
+        assert engine == {
+            "long_prefill_token_threshold": 256,
+            "pressure_preemption_s": 1.5,
+        }
+
+    def test_unknown_keys_rejected_whole(self):
+        with pytest.raises(LiveConfigError) as exc:
+            vet_live_config({
+                "brownout_occupancy_high": 0.7,
+                "max_model_len": 4096,
+                "dtype": "bfloat16",
+            })
+        assert exc.value.keys == ["dtype", "max_model_len"]
+        assert "rolling upgrade" in str(exc.value)
+
+    def test_invalid_value_rejected(self):
+        with pytest.raises(LiveConfigError) as exc:
+            vet_live_config({"brownout_occupancy_high": 1.5})
+        assert exc.value.keys == ["brownout_occupancy_high"]
+        with pytest.raises(LiveConfigError):
+            vet_live_config({"long_prefill_token_threshold": -1})
+        with pytest.raises(LiveConfigError):
+            vet_live_config({"tenant_weights": "not a spec::"})
+
+    def test_empty_update_rejected(self):
+        with pytest.raises(LiveConfigError):
+            vet_live_config({})
+        with pytest.raises(LiveConfigError):
+            vet_live_config("tenant_weights=1")  # type: ignore
+
+    def test_registry_scopes(self):
+        keys = live_config_keys()
+        assert keys["tenant_weights"] == "frontend"
+        assert keys["autoscale_up_queue_depth"] == "frontend"
+        assert keys["long_prefill_token_threshold"] == "engine"
+        assert keys["spec_adaptive_high_watermark"] == "engine"
+
+
+# ---------------------------------------------------------------------
+# e2e: dp=2 rolling cycle on the CPU mesh — token-identical streams
+# spanning the swap, new fingerprints visible, rollback byte-identical
+# ---------------------------------------------------------------------
+
+from tests.models.utils import tiny_llama_dir  # noqa: E402
+from vllm_tpu import LLM, SamplingParams  # noqa: E402
+
+BLOCK = 16
+PROMPTS = [
+    [(1000 * (i + 3) + 7 * j) % 120 + 3 for j in range(24)]
+    for i in range(4)
+]
+
+
+@pytest.fixture(scope="module")
+def ckpt(tmp_path_factory):
+    return tiny_llama_dir(tmp_path_factory.mktemp("tiny_llama_rolling"))
+
+
+@pytest.fixture(scope="module")
+def ckpt_v2(ckpt, tmp_path_factory):
+    """The 'new release': identical weights under a new path, so the
+    upgraded pool must be token-identical while its weights fingerprint
+    visibly changes."""
+    dst = tmp_path_factory.mktemp("tiny_llama_rolling_v2") / "ckpt"
+    shutil.copytree(ckpt, dst)
+    return str(dst)
+
+
+def _llm(ckpt, **kw):
+    return LLM(
+        model=ckpt, dtype="float32", max_model_len=256, block_size=BLOCK,
+        num_gpu_blocks_override=96, max_num_seqs=4,
+        max_num_batched_tokens=128,
+        data_parallel_engines=2,
+        kv_connector="fabric",
+        kv_fabric_quant="none",
+        enable_engine_recovery=True,
+        **kw,
+    )
+
+
+def _generate(llm, sp):
+    outs = llm.generate(
+        [{"prompt_token_ids": list(p)} for p in PROMPTS], sp)
+    return [list(o.outputs[0].token_ids) for o in outs]
+
+
+def _drive_upgrade(llm, ctrl, finals, probe=None, on_tick=None,
+                   timeout_s=300.0):
+    """The test-thread driver: the role AsyncLLM.poll_upgrade plays when
+    serving, executed synchronously against the DPLB client."""
+    client = llm.llm_engine.engine_core
+    pending_down = [None]
+    deadline = time.monotonic() + timeout_s
+    while ctrl.active:
+        assert time.monotonic() < deadline, ctrl.snapshot()
+        if llm.llm_engine.has_unfinished_requests():
+            for out in llm.llm_engine.step():
+                if out.finished:
+                    finals[out.request_id] = list(out.outputs[0].token_ids)
+        else:
+            client.get_output(timeout=0.05)
+        client.poll_scale()
+
+        snap = ctrl.snapshot()
+        if on_tick is not None:
+            on_tick(snap)
+        newcomer, victim = snap["newcomer"], snap["victim"]
+        phase = snap["phase"]
+        if newcomer is not None and phase in (
+                "booting", "gating", "rolling_back"):
+            state = client.slot_state(newcomer)
+            if state == "up" and phase == "booting":
+                ctrl.note_newcomer_up()
+            elif state == "removed":
+                ctrl.note_newcomer_dead()
+        elif phase == "draining" and victim is not None:
+            if client.slot_state(victim) == "removed":
+                ctrl.note_victim_retired()
+            elif pending_down[0] is not None:
+                if client.scale_down(
+                        engine_id=pending_down[0]) is not None:
+                    pending_down[0] = None
+        if not ctrl.active:
+            break
+
+        action = ctrl.next_action()
+        if action is None:
+            continue
+        op = action["op"]
+        if op == "spawn":
+            eid = client.scale_up(
+                checkpoint=action["checkpoint"],
+                config_overrides=action["config"], gating=True)
+            ctrl.note_spawned(eid)
+        elif op == "probe":
+            try:
+                (probe or client.probe_engine)(action["newcomer"])
+                ctrl.note_probe(True)
+            except Exception:
+                ctrl.note_probe(False)
+        elif op == "promote":
+            assert client.open_gate(action["newcomer"])
+            if client.scale_down(engine_id=action["victim"]) is None:
+                pending_down[0] = action["victim"]
+        elif op == "rollback":
+            lost = client.retire_engine(action["newcomer"])
+            # A gated newcomer never held routed traffic.
+            assert lost == []
+            ctrl.note_rolled_back()
+
+    # The cycle can finish with streams still in flight (e.g. a wave
+    # submitted during the final drain): run them to completion.
+    while (llm.llm_engine.has_unfinished_requests()
+           or client.pool_status()["scale_event"] is not None):
+        assert time.monotonic() < deadline, client.pool_status()
+        for out in llm.llm_engine.step():
+            if out.finished:
+                finals[out.request_id] = list(out.outputs[0].token_ids)
+        client.poll_scale()
+
+
+def test_rolling_upgrade_e2e_full_cycle(ckpt, ckpt_v2):
+    sp = SamplingParams(temperature=0.0, max_tokens=8, ignore_eos=True)
+
+    llm = _llm(ckpt)
+    try:
+        client = llm.llm_engine.engine_core
+        ref = _generate(llm, sp)
+        assert all(len(t) == 8 for t in ref)
+        fp_before = {
+            eid: block.get("weights_fingerprint")
+            for eid, block in client.engine_versions().items()
+        }
+        assert set(fp_before) == {"0", "1"}
+
+        ctrl = RollingUpgradeController(
+            gate_requests=2, gate_timeout_s=180.0, probe_interval_s=0.0)
+        assert ctrl.start([0, 1], checkpoint=ckpt_v2)
+        assert not ctrl.start([0])  # the one-cycle latch holds
+
+        # Submit request waves as the cycle progresses so streams span
+        # every swap transition (old pool, mixed pool, upgraded pool).
+        finals: dict[str, list[int]] = {}
+        waves: list[str] = []
+        seen: set = set()
+
+        def wave(tag: str) -> None:
+            if tag in seen:
+                return
+            seen.add(tag)
+            waves.append(tag)
+            for i, p in enumerate(PROMPTS):
+                llm.llm_engine.add_request(
+                    f"{tag}-{i}", {"prompt_token_ids": list(p)}, sp)
+
+        def on_tick(snap) -> None:
+            if snap["phase"] in ("gating", "draining"):
+                wave(f"{snap['phase']}{snap['slots_done']}")
+
+        wave("pre")
+        _drive_upgrade(llm, ctrl, finals, on_tick=on_tick)
+
+        assert ctrl.last_outcome == "ok"
+        assert ctrl.upgrade_events_total == {"ok": 1}
+        assert ctrl.snapshot()["slots_done"] == 2
+        pool = client.pool_status()
+        assert pool["actual"] == 2
+        assert pool["removed"] == [0, 1]
+        assert pool["draining"] == [] and pool["gating"] == []
+
+        # Zero lost: every stream that spanned the swap finished with
+        # the full, token-identical completion.
+        assert len(waves) >= 3, waves
+        for tag in waves:
+            got = [finals[f"{tag}-{i}"] for i in range(len(PROMPTS))]
+            assert got == ref, f"wave {tag} diverged across the swap"
+
+        # The upgraded pool serves token-identically and its version
+        # blocks show the new checkpoint's fingerprint on new slots.
+        assert _generate(llm, sp) == ref
+        versions = client.engine_versions()
+        assert set(versions) == {"2", "3"}
+        for eid, block in versions.items():
+            assert block["weights_fingerprint"] is not None
+            assert block["weights_fingerprint"] not in fp_before.values()
+            assert block["model"] == ckpt_v2
+    finally:
+        llm.llm_engine.shutdown()
+
+
+def test_rolling_upgrade_failed_gate_rolls_back(ckpt, ckpt_v2):
+    sp = SamplingParams(temperature=0.0, max_tokens=8, ignore_eos=True)
+
+    llm = _llm(ckpt)
+    try:
+        client = llm.llm_engine.engine_core
+        ref = _generate(llm, sp)
+
+        ctrl = RollingUpgradeController(
+            gate_requests=2, gate_timeout_s=180.0, probe_interval_s=0.0)
+        assert ctrl.start([0], checkpoint=ckpt_v2)
+
+        def failing_probe(eid):
+            raise RuntimeError("synthetic gate failure")
+
+        finals: dict[str, list[int]] = {}
+        for i, p in enumerate(PROMPTS):
+            llm.llm_engine.add_request(
+                f"rb-{i}", {"prompt_token_ids": list(p)}, sp)
+        _drive_upgrade(llm, ctrl, finals, probe=failing_probe)
+
+        assert ctrl.last_outcome == "rolled_back"
+        assert ctrl.upgrade_events_total == {"rolled_back": 1}
+        assert ctrl.probes_total == {"fail": 1}
+
+        # Byte-identical rollback: the original slots keep serving, the
+        # newcomer slot is retired, in-flight streams all finished.
+        pool = client.pool_status()
+        assert pool["actual"] == 2
+        assert pool["removed"] == [2]
+        assert 0 not in pool["removed"] and 1 not in pool["removed"]
+        assert [finals[f"rb-{i}"] for i in range(len(PROMPTS))] == ref
+        assert _generate(llm, sp) == ref
+        versions = client.engine_versions()
+        assert set(versions) == {"0", "1"}
+        for block in versions.values():
+            assert block["model"] == ckpt
+    finally:
+        llm.llm_engine.shutdown()

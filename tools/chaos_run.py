@@ -152,6 +152,26 @@ def build_parser() -> argparse.ArgumentParser:
                         "totals, the ladder actually engaged, and no "
                         "interactive (priority-0) request was ever "
                         "preempted")
+    p.add_argument("--rolling-upgrade", action="store_true",
+                   help="zero-downtime scenario instead of the seeded "
+                        "fault schedule: cycle the whole pool through a "
+                        "health-gated rolling upgrade while sustained "
+                        "traffic streams; passes iff every request "
+                        "finishes (zero lost) and the cycle reaches the "
+                        "expected terminal outcome. Combine with "
+                        "--upgrade-kill for the chaos proof")
+    p.add_argument("--upgrade-kill", default="none",
+                   choices=["none", "newcomer", "victim"],
+                   help="with --rolling-upgrade: SIGKILL the cycle's "
+                        "target engine mid-upgrade (newcomer = during "
+                        "its health gate, the cycle must roll back and "
+                        "keep the old slot; victim = during its drain, "
+                        "stragglers must replay and the cycle still "
+                        "finishes ok) — zero lost either way")
+    p.add_argument("--upgrade-checkpoint", default=None,
+                   help="with --rolling-upgrade: boot replacements from "
+                        "this checkpoint path (default: re-load the "
+                        "serving checkpoint — an in-place binary cycle)")
     p.add_argument("--ramp-qps", type=float, default=8.0,
                    help="offered load during the high phase")
     p.add_argument("--ramp-low-qps", type=float, default=0.5,
@@ -469,6 +489,180 @@ def _run_traffic_ramp(args) -> int:
     return 0 if ok else 1
 
 
+def _run_rolling_upgrade(args) -> int:
+    """Zero-downtime scenario: cycle a dp>=2 pool through a health-gated
+    rolling upgrade under sustained traffic.
+
+    The controller replaces one slot at a time — boot a gated newcomer,
+    probe it to the gate, shift routing, drain the old engine — so the
+    pool never dips below capacity. ``--upgrade-kill newcomer`` SIGKILLs
+    the replacement during its health gate: the cycle must roll back and
+    the old slot keeps serving. ``--upgrade-kill victim`` SIGKILLs the
+    old engine mid-drain: its stragglers must replay on survivors and
+    the cycle still finishes ``ok``. Every path must lose zero requests.
+    """
+    import signal
+    import time
+
+    from vllm_tpu.engine.arg_utils import AsyncEngineArgs
+    from vllm_tpu.engine.async_llm import AsyncLLM
+
+    dp0 = max(2, args.dp)
+    engine = AsyncLLM.from_engine_args(AsyncEngineArgs(
+        model=args.model,
+        max_model_len=args.max_model_len,
+        data_parallel_engines=dp0,
+        enable_engine_recovery=True,
+        max_engine_restarts=8,
+        max_request_retries=4,
+        restart_backoff_s=0.05,
+        kv_connector="fabric" if args.kv_fabric else None,
+        # Generous targets: the assertion is zero lost requests through
+        # the swap, not absolute latency.
+        slo_targets=f"default=ttft:{args.request_timeout:.0f}s",
+        upgrade_gate_requests=2,
+        upgrade_gate_timeout_s=max(60.0, args.request_timeout),
+        autoscale_drain_deadline_s=15.0,
+    ))
+
+    async def body() -> bool:
+        from vllm_tpu.sampling_params import (
+            RequestOutputKind,
+            SamplingParams,
+        )
+
+        results = {"submitted": 0, "ok": 0, "errors": []}
+        state = {"snap": {}, "killed": None}
+        stop = asyncio.Event()
+
+        async def one(i: int) -> None:
+            rid = f"upg-{i}"
+            params = SamplingParams(
+                temperature=0.0,
+                max_tokens=args.max_tokens,
+                ignore_eos=True,
+                detokenize=False,
+                output_kind=RequestOutputKind.DELTA,
+            )
+            prompt = {"prompt_token_ids": [(i % 50) + 1] * 8}
+            results["submitted"] += 1
+            try:
+                finished = False
+
+                async def consume() -> None:
+                    nonlocal finished
+                    async for out in engine.generate(prompt, params, rid):
+                        if out.finished:
+                            finished = True
+
+                await asyncio.wait_for(consume(), args.request_timeout)
+                if finished:
+                    results["ok"] += 1
+                else:
+                    results["errors"].append((rid, "no final output"))
+            except Exception as e:  # timeout or terminal error = lost
+                results["errors"].append((rid, repr(e)))
+
+        def _sigkill(eid: int, role: str, phase: str) -> None:
+            proc = engine.engine_core._procs.get(eid)
+            if proc is not None and proc.pid is not None \
+                    and proc.is_alive():
+                os.kill(proc.pid, signal.SIGKILL)
+                state["killed"] = (eid, role, phase)
+                print(f"upgrade: SIGKILLed {role} engine {eid} "
+                      f"(phase {phase})", file=sys.stderr)
+
+        async def watcher() -> None:
+            while not stop.is_set():
+                status = engine.upgrade_status() or {}
+                snap = status.get("controller") or {}
+                state["snap"] = snap
+                phase = snap.get("phase")
+                if state["killed"] is None:
+                    if (args.upgrade_kill == "newcomer"
+                            and phase == "gating"
+                            and snap.get("newcomer") is not None):
+                        _sigkill(snap["newcomer"], "newcomer", phase)
+                    elif (args.upgrade_kill == "victim"
+                            and phase == "draining"
+                            and snap.get("victim") is not None):
+                        _sigkill(snap["victim"], "victim", phase)
+                await asyncio.sleep(0.05)
+
+        tasks: list[asyncio.Task] = []
+        idx = [0]
+
+        async def offer(qps: float, max_s: float, pred) -> None:
+            deadline = time.monotonic() + max_s
+            while time.monotonic() < deadline and not pred():
+                tasks.append(asyncio.create_task(one(idx[0])))
+                idx[0] += 1
+                await asyncio.sleep(1.0 / qps)
+
+        watch = asyncio.create_task(watcher())
+        try:
+            # Warmup: every slot serving before the cycle starts.
+            await offer(args.ramp_low_qps, 2.0, lambda: False)
+            started = engine.start_upgrade(
+                checkpoint=args.upgrade_checkpoint)
+            print(f"upgrade: started {started}", file=sys.stderr)
+            # Sustained traffic until the controller goes idle (the
+            # zero-downtime claim is about requests spanning the swap).
+            await offer(
+                args.ramp_qps, args.capacity_deadline,
+                lambda: (state["snap"] and not state["snap"]["active"]))
+            await asyncio.gather(*tasks)
+        finally:
+            stop.set()
+            await watch
+
+        snap = state["snap"] or {}
+        events = snap.get("upgrade_events_total") or {}
+        print(f"upgrade: outcome={snap.get('last_outcome')} "
+              f"events={events} probes={snap.get('probes_total')}",
+              file=sys.stderr)
+        print(f"upgrade: {results['ok']}/{results['submitted']} finished",
+              file=sys.stderr)
+        ok = True
+        if results["errors"]:
+            for rid, err in results["errors"][:8]:
+                print(f"UPGRADE: lost request {rid}: {err}",
+                      file=sys.stderr)
+            print(f"UPGRADE: {len(results['errors'])} request(s) lost",
+                  file=sys.stderr)
+            ok = False
+        if snap.get("active", True):
+            print(f"UPGRADE: cycle never finished within "
+                  f"{args.capacity_deadline:.0f}s (phase "
+                  f"{snap.get('phase')!r})", file=sys.stderr)
+            ok = False
+        want = ("rolled_back" if args.upgrade_kill == "newcomer"
+                else "ok")
+        if events.get(want, 0) < 1:
+            print(f"UPGRADE: expected outcome {want!r} never counted "
+                  f"(events: {events})", file=sys.stderr)
+            ok = False
+        if args.upgrade_kill != "none" and state["killed"] is None:
+            print(f"UPGRADE: --upgrade-kill={args.upgrade_kill} never "
+                  f"fired (no matching phase window)", file=sys.stderr)
+            ok = False
+        pool = (engine.autoscale_status() or {}).get("pool") or {}
+        if pool.get("actual", 0) != dp0:
+            print(f"UPGRADE: pool settled at {pool.get('actual')} "
+                  f"engines, expected {dp0}", file=sys.stderr)
+            ok = False
+        versions = (engine.version_status() or {}).get("engines") or {}
+        print(f"upgrade: engine versions={versions}", file=sys.stderr)
+        return ok
+
+    try:
+        ok = asyncio.run(body())
+    finally:
+        engine.shutdown()
+    print("ok" if ok else "FAILED", file=sys.stderr)
+    return 0 if ok else 1
+
+
 def _run_overload_storm(args) -> int:
     """QoS-under-pressure scenario: a seeded mixed-priority, mixed-tenant
     burst against a deliberately slowed engine, with WFQ admission, the
@@ -651,6 +845,8 @@ def main(argv: list[str] | None = None) -> int:
 
     if args.traffic_ramp:
         return _run_traffic_ramp(args)
+    if args.rolling_upgrade:
+        return _run_rolling_upgrade(args)
     if args.overload_storm:
         return _run_overload_storm(args)
 

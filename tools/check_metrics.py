@@ -160,10 +160,20 @@ REQUIRED_QOS_METRICS = {
     "vllm:tenant_debt",
 }
 
+# Documented in the README ("Zero-downtime operations"); the rolling-
+# upgrade chaos scenario and mixed-pool dashboards assert on these.
+REQUIRED_UPGRADE_METRICS = {
+    "vllm:upgrade_events_total",
+    "vllm:upgrade_in_progress",
+    "vllm:engine_version_info",
+    "vllm:config_reloads_total",
+    "vllm:schema_mismatch_total",
+}
+
 # Floor on the registry size: a refactor that silently drops metrics
 # from the render list must fail the lint even if no required-set name
 # is among the casualties. Bump when adding metrics.
-MIN_METRICS = 92
+MIN_METRICS = 97
 
 
 def check() -> list[str]:
@@ -173,6 +183,7 @@ def check() -> list[str]:
         Counter,
         Gauge,
         Histogram,
+        InfoGauge,
         LabeledCounter,
         LabeledGauge,
         LabeledHistogram,
@@ -180,7 +191,8 @@ def check() -> list[str]:
     )
 
     metric_types = (BiLabeledCounter, Counter, Gauge, Histogram,
-                    LabeledCounter, LabeledGauge, LabeledHistogram)
+                    InfoGauge, LabeledCounter, LabeledGauge,
+                    LabeledHistogram)
     reg = PrometheusRegistry()
     errors: list[str] = []
 
@@ -271,6 +283,10 @@ def check() -> list[str]:
     for name in sorted(REQUIRED_QOS_METRICS - set(seen)):
         errors.append(
             f"required QoS/brownout metric {name} is missing from "
+            f"the registry (documented in README)")
+    for name in sorted(REQUIRED_UPGRADE_METRICS - set(seen)):
+        errors.append(
+            f"required zero-downtime metric {name} is missing from "
             f"the registry (documented in README)")
 
     if len(reg._metrics) < MIN_METRICS:

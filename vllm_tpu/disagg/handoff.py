@@ -21,6 +21,7 @@ import time
 from dataclasses import dataclass, field
 
 from vllm_tpu.request import EngineCoreRequest
+from vllm_tpu.versioning import SCHEMA_VERSION, check_schema
 
 _WIRE_VERSION = 1
 
@@ -47,6 +48,7 @@ class HandoffRecord:
     def encode(self) -> bytes:
         return json.dumps({
             "v": _WIRE_VERSION,
+            "schema": SCHEMA_VERSION,
             "request_id": self.request_id,
             "prompt_token_ids": self.prompt_token_ids,
             "emitted_token_ids": self.emitted_token_ids,
@@ -63,6 +65,11 @@ class HandoffRecord:
         v = obj.pop("v", None)
         if v != _WIRE_VERSION:
             raise ValueError(f"unknown HandoffRecord wire version {v!r}")
+        # Schema handshake: a handoff from a peer running a different
+        # package schema (mid-rolling-upgrade across a schema boundary)
+        # is a typed, counted rejection — never a silent misparse.
+        check_schema("handoff", obj.pop("schema", None),
+                     detail=f"request {obj.get('request_id', '?')}")
         return cls(**obj)
 
 

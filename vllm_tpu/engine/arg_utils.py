@@ -142,6 +142,12 @@ class EngineArgs:
     autoscale_interval_s: float = 1.0
     autoscale_drain_deadline_s: float = 30.0
     autoscale_reseed_timeout_s: float = 120.0
+    # Rolling upgrades (vllm_tpu/resilience/rolling): health gate for the
+    # replacement engine each cycle slot boots. Escape hatch:
+    # VLLM_TPU_DISABLE_ROLLING=1.
+    upgrade_gate_requests: int = 4
+    upgrade_gate_timeout_s: float = 120.0
+    upgrade_slo_floor: float = 0.0
 
     # Lifecycle (vllm_tpu/resilience/lifecycle): overload protection.
     # All off by default; see LifecycleConfig for semantics.
@@ -322,6 +328,9 @@ class EngineArgs:
                 autoscale_interval_s=self.autoscale_interval_s,
                 autoscale_drain_deadline_s=self.autoscale_drain_deadline_s,
                 autoscale_reseed_timeout_s=self.autoscale_reseed_timeout_s,
+                upgrade_gate_requests=self.upgrade_gate_requests,
+                upgrade_gate_timeout_s=self.upgrade_gate_timeout_s,
+                upgrade_slo_floor=self.upgrade_slo_floor,
             ),
             lifecycle_config=LifecycleConfig(
                 max_inflight_requests=self.max_inflight_requests,

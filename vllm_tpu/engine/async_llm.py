@@ -13,10 +13,12 @@ DP; the AsyncLLM surface is identical either way.
 from __future__ import annotations
 
 import asyncio
+import os
 import queue
 import threading
 import time
 from collections import deque
+from concurrent.futures import Future
 from typing import Any, AsyncGenerator
 
 from vllm_tpu.config import EngineConfig
@@ -30,11 +32,14 @@ from vllm_tpu.resilience import (
     TIMEOUT_FINISH_REASON,
     AdmissionController,
     EngineRestartedError,
+    LiveConfigError,
     QuarantineManager,
     RequestFailedOnCrashError,
     RequestJournal,
     SlowClientError,
+    live_config_keys,
     make_shed_error,
+    vet_live_config,
 )
 from vllm_tpu.sampling_params import RequestOutputKind, SamplingParams
 
@@ -149,6 +154,11 @@ class AsyncLLM:
     _brownout_next_t = 0.0
     _brownout_push_t = 0.0
     _qos_enabled = True
+    # Rolling-upgrade defaults for the same __new__-built rigs.
+    _rolling = None
+    _rolling_pending_down = None
+    _engine_versions = None
+    _versions_next_t = 0.0
 
     def __init__(self, config: EngineConfig, start: bool = True,
                  client: Any | None = None) -> None:
@@ -282,6 +292,33 @@ class AsyncLLM:
 
             self._brownout = BrownoutController(
                 self.lifecycle.make_brownout_config())
+        # Zero-downtime operations (vllm_tpu/resilience/rolling): the
+        # rolling-upgrade controller sequences the pool one slot at a
+        # time; the busy loop executes its commands against the DP
+        # client's upgrade primitives. Armed for any engine-pool
+        # client; VLLM_TPU_DISABLE_ROLLING severs the driver (POST
+        # /admin/upgrade refuses) while the manual primitives and the
+        # live-config set_config RPC stay available.
+        self._rolling = None
+        self._rolling_pending_down = None
+        # Per-engine /health version blocks, refreshed on the engine
+        # loop (the client's utility sockets are single-threaded).
+        self._engine_versions = None
+        self._versions_next_t = 0.0
+        self.config_reloads_total: dict[str, int] = {}
+        if hasattr(self.engine_core, "scale_up"):
+            if envs.VLLM_TPU_DISABLE_ROLLING:
+                logger.warning(
+                    "rolling upgrades disabled via "
+                    "VLLM_TPU_DISABLE_ROLLING")
+            else:
+                from vllm_tpu.resilience import RollingUpgradeController
+
+                self._rolling = RollingUpgradeController(
+                    gate_requests=rc.upgrade_gate_requests,
+                    gate_timeout_s=rc.upgrade_gate_timeout_s,
+                    slo_floor=rc.upgrade_slo_floor,
+                )
         if start:
             self.start()
 
@@ -506,6 +543,14 @@ class AsyncLLM:
         # survivors) — recovered by the busy loop like any crash.
         if getattr(self.engine_core, "poll_scale", None) is not None:
             self.poll_autoscale()
+        # Rolling-upgrade tick: observe slot state the scale machinery
+        # advanced, execute the controller's next command, and keep the
+        # per-engine /health version cache fresh. Runs even when idle —
+        # upgrades of a quiet pool must still progress.
+        if hasattr(self.engine_core, "engine_versions"):
+            self.poll_versions()
+        if self._rolling is not None:
+            self.poll_upgrade()
         # Brownout tick: runs even when idle so the ladder de-escalates
         # once pressure clears (rung 0 must be reachable with no traffic).
         if self._brownout is not None and self._qos_enabled:
@@ -741,6 +786,21 @@ class AsyncLLM:
                             rid, state, bypass_retry_budget=True)
                 elif op == "abort":
                     self.engine_core.abort_requests(payload)
+                elif op == "set_config":
+                    # Live-config push: the client's utility sockets
+                    # belong to this thread; the API handler waits on
+                    # the future. An engine death mid-broadcast must
+                    # still reach the busy loop's recovery path.
+                    updates, fut = payload
+                    if fut.set_running_or_notify_cancel():
+                        try:
+                            fut.set_result(
+                                self.engine_core.set_config(updates))
+                        except EngineRestartedError as e:
+                            fut.set_exception(e)
+                            raise
+                        except BaseException as e:
+                            fut.set_exception(e)
                 elif op == "finish":
                     # Drain stragglers: abort engine-side, then close the
                     # streams with a final output ON THIS THREAD (racing
@@ -873,6 +933,12 @@ class AsyncLLM:
         if snap:
             slo = min(v["attainment"] for v in snap.values())
         ctrl.observe(depth, slo, self._sample_occupancy(now))
+        rolling = getattr(self, "_rolling", None)
+        if rolling is not None and rolling.active:
+            # A rolling upgrade owns the scale machinery: the
+            # autoscaler keeps observing (its windows stay warm) but
+            # must not race spawn/drain decisions into the cycle.
+            return
         if ctrl.busy is not None or pool["scale_event"] is not None:
             return
         decision = ctrl.decide(actual)
@@ -912,6 +978,321 @@ class AsyncLLM:
                     worst = frac if worst is None else max(worst, frac)
         self._autoscale_occ = worst
         return worst
+
+    # -- zero-downtime operations: rolling upgrade + live config -------
+
+    def poll_versions(self) -> None:
+        """Refresh the per-engine version cache (engine-loop thread —
+        the client's utility sockets are not shareable with the event
+        loop). Fast cadence while an upgrade is in flight so /health
+        shows the new weights fingerprint as soon as the swap lands."""
+        now = time.monotonic()
+        if now < self._versions_next_t:
+            return
+        rolling = getattr(self, "_rolling", None)
+        active = rolling is not None and rolling.active
+        self._versions_next_t = now + (1.0 if active else 15.0)
+        try:
+            self._engine_versions = self.engine_core.engine_versions()
+        except EngineRestartedError:
+            raise
+        except Exception:
+            logger.debug("engine version refresh failed", exc_info=True)
+
+    def poll_upgrade(self) -> None:
+        """Rolling-upgrade tick (engine-loop thread): report slot state
+        back to the controller, then execute its next command against
+        the DP client's upgrade primitives. The controller is pure;
+        every process-touching step happens here, on the one thread
+        that owns the client."""
+        ctrl = self._rolling
+        if ctrl is None or not ctrl.active:
+            return
+        client = self.engine_core
+        snap = ctrl.snapshot()
+        newcomer, victim, phase = (
+            snap["newcomer"], snap["victim"], snap["phase"])
+        if newcomer is not None and phase in (
+                "booting", "gating", "rolling_back"):
+            state = client.slot_state(newcomer)
+            if state == "up" and phase == "booting":
+                logger.info(
+                    "upgrade: engine %d is up (gated); health gate "
+                    "opens (%d probe(s) required)", newcomer,
+                    ctrl.gate_requests)
+                ctrl.note_newcomer_up()
+            elif state == "removed":
+                # The death path already retired the slot; the gated
+                # newcomer never received routed traffic, so this is an
+                # automatic rollback by construction.
+                ctrl.note_newcomer_dead()
+                logger.warning(
+                    "upgrade: newcomer %d died before its gate opened; "
+                    "victim %d keeps serving (outcome=%s)",
+                    newcomer, victim, ctrl.last_outcome)
+        elif phase == "draining" and victim is not None:
+            if client.slot_state(victim) == "removed":
+                self._rolling_pending_down = None
+                ctrl.note_victim_retired()
+                self._versions_next_t = 0.0  # new fingerprint is live
+            elif self._rolling_pending_down is not None:
+                # scale_down was refused (a prior scale event was still
+                # settling): retry until the latch frees.
+                if client.scale_down(
+                        engine_id=self._rolling_pending_down) is not None:
+                    self._rolling_pending_down = None
+        if not ctrl.active:
+            return
+        slo = None
+        slo_snap = self.output_processor.slo_attainment_snapshot()
+        if slo_snap:
+            slo = min(v["attainment"] for v in slo_snap.values())
+        action = ctrl.next_action(slo)
+        if action is None:
+            return
+        op = action["op"]
+        if op == "spawn":
+            eid = None
+            try:
+                eid = client.scale_up(
+                    checkpoint=action["checkpoint"],
+                    config_overrides=action["config"],
+                    gating=True,
+                )
+            except EngineRestartedError:
+                raise
+            except Exception:
+                logger.exception(
+                    "upgrade: spawn of the replacement for slot %s "
+                    "failed; aborting the cycle", action["victim"])
+                ctrl.request_abort()
+            ctrl.note_spawned(eid)
+            if eid is not None:
+                logger.info(
+                    "upgrade: engine %d booting as gated replacement "
+                    "for %s", eid, action["victim"])
+        elif op == "probe":
+            try:
+                client.probe_engine(action["newcomer"])
+                ctrl.note_probe(True)
+            except EngineRestartedError:
+                # The probe raced an engine death elsewhere; its result
+                # is unknowable — neither a pass nor a gate failure.
+                ctrl.note_probe_interrupted()
+                raise
+            except Exception as e:
+                logger.warning(
+                    "upgrade: health probe failed on engine %s: %s",
+                    action["newcomer"], e)
+                ctrl.note_probe(False)
+        elif op == "promote":
+            client.open_gate(action["newcomer"])
+            logger.info(
+                "upgrade: gate passed on engine %s; draining victim %s",
+                action["newcomer"], action["victim"])
+            if client.scale_down(engine_id=action["victim"]) is None:
+                self._rolling_pending_down = action["victim"]
+        elif op == "rollback":
+            lost = client.retire_engine(action["newcomer"])
+            if lost:  # a gated slot holds no routed traffic
+                logger.error(
+                    "upgrade rollback of engine %s lost %d request(s)",
+                    action["newcomer"], len(lost))
+            ctrl.note_rolled_back()
+            logger.warning(
+                "upgrade: rolled back engine %s (%s); victim %s keeps "
+                "serving", action["newcomer"],
+                ctrl.snapshot().get("fail_reason") or "gate failed",
+                action["victim"])
+
+    def start_upgrade(self, checkpoint: str | None = None,
+                      config: dict | None = None,
+                      slots: list[int] | None = None,
+                      gate_requests: int | None = None,
+                      slo_floor: float | None = None) -> dict:
+        """Arm a rolling-upgrade cycle (POST /admin/upgrade). The
+        checkpoint path and config overrides are validated up front — a
+        cycle that cannot possibly succeed is refused at the API, not
+        rolled back one engine boot later. Raises ValueError on bad
+        input or when a cycle is already in flight."""
+        ctrl = self._rolling
+        if ctrl is None:
+            from vllm_tpu import envs
+
+            raise ValueError(
+                "rolling upgrades unavailable: "
+                + ("disabled via VLLM_TPU_DISABLE_ROLLING"
+                   if envs.VLLM_TPU_DISABLE_ROLLING
+                   else "requires a data-parallel engine pool"))
+        if checkpoint is None and not config:
+            raise ValueError(
+                "nothing to upgrade: provide a new checkpoint and/or "
+                "config overrides")
+        if checkpoint is not None and not os.path.exists(checkpoint):
+            raise ValueError(
+                f"upgrade checkpoint not found: {checkpoint}")
+        if config:
+            import copy
+
+            from vllm_tpu.engine.core_client import (
+                _apply_config_overrides)
+
+            # Dry-run against a copy of our own config: unknown dotted
+            # paths are a 400 here, not a failed boot mid-cycle.
+            _apply_config_overrides(copy.deepcopy(self.config), config)
+        # Per-cycle gate overrides (the CLI's --upgrade-gate-requests /
+        # --upgrade-slo-floor); the server defaults stay for the next
+        # cycle only if never overridden.
+        if gate_requests is not None:
+            if int(gate_requests) < 1:
+                raise ValueError(
+                    f"gate_requests must be >= 1, got {gate_requests}")
+            ctrl.gate_requests = int(gate_requests)
+        if slo_floor is not None:
+            if not (0.0 <= float(slo_floor) <= 1.0):
+                raise ValueError(
+                    f"slo_floor must be in [0, 1], got {slo_floor}")
+            ctrl.slo_floor = float(slo_floor)
+        if slots is None:
+            pool = self.engine_core.pool_status()
+            busy = (set(pool["draining"]) | set(pool["seeding"])
+                    | set(pool["gating"]) | set(pool["removed"]))
+            slots = [i for i in range(pool["size"]) if i not in busy]
+        if not ctrl.start(slots, checkpoint=checkpoint, config=config):
+            raise ValueError(
+                "an upgrade cycle is already in flight (one at a "
+                "time); abort it first" if ctrl.active
+                else "no slots to upgrade")
+        self._rolling_pending_down = None
+        logger.info(
+            "rolling upgrade started over slots %s (checkpoint=%s, "
+            "config=%s)", slots, checkpoint, config)
+        return {"started": True, **ctrl.snapshot()}
+
+    def abort_upgrade(self) -> dict:
+        """Abort the in-flight cycle at the next safe point: a gated
+        newcomer rolls back; a slot already past promotion finishes its
+        drain before the cycle stops."""
+        ctrl = self._rolling
+        accepted = ctrl.request_abort() if ctrl is not None else False
+        status = ctrl.snapshot() if ctrl is not None else {}
+        return {"abort_requested": accepted, **status}
+
+    def upgrade_status(self) -> dict | None:
+        """Rolling-upgrade snapshot for /health and /metrics, or None
+        when the client has no engine pool (nothing to roll)."""
+        if not hasattr(self.engine_core, "scale_up"):
+            return None
+        ctrl = getattr(self, "_rolling", None)
+        return {
+            "enabled": ctrl is not None,
+            "controller": ctrl.snapshot() if ctrl is not None else None,
+            "live_config_keys": live_config_keys(),
+            "config_reloads_total": dict(
+                getattr(self, "config_reloads_total", None) or {}),
+        }
+
+    def version_status(self) -> dict:
+        """/health ``version`` block: this frontend's package/schema/
+        config identity, the cached per-engine blocks (refreshed on the
+        engine-loop thread), and schema-mismatch rejection counts."""
+        from vllm_tpu import versioning
+        from vllm_tpu.versioning import version_block
+
+        # check_schema() rejections anywhere in this process — READY
+        # handshakes (attach + respawn), handoff/trace decodes — plus
+        # the journal scan's inline stamp comparison.
+        mismatches = dict(versioning.mismatch_total)
+        journal = getattr(self, "journal", None)
+        journal_mm = getattr(journal, "schema_mismatch_total", 0)
+        if journal_mm:
+            mismatches["journal"] = (
+                mismatches.get("journal", 0) + journal_mm)
+        config = getattr(self, "config", None)
+        return {
+            "frontend": version_block(
+                config,
+                config.model_config.model if config is not None
+                else None),
+            "engines": dict(
+                getattr(self, "_engine_versions", None) or {}),
+            "schema_mismatch_total": mismatches,
+        }
+
+    def set_live_config(self, updates: dict,
+                        timeout_s: float = 30.0) -> dict:
+        """Apply a vetted live-config update pool-wide without restart
+        (POST /admin/config). Frontend-scope knobs apply in this
+        process; engine-scope knobs broadcast over the ``set_config``
+        utility RPC, marshalled onto the engine-loop thread (which owns
+        the client sockets). Raises :class:`LiveConfigError` — the
+        whole request is rejected — on any unknown key or out-of-range
+        value."""
+        try:
+            frontend, engine = vet_live_config(updates)
+        except LiveConfigError:
+            self._count_config_reload("rejected")
+            raise
+        applied: list[str] = []
+        inert: list[str] = []
+        for key, value in frontend.items():
+            (applied if self._apply_frontend_config(key, value)
+             else inert).append(key)
+        if engine:
+            try:
+                result = self._engine_set_config(engine, timeout_s)
+            except Exception as e:
+                self._count_config_reload("error")
+                raise RuntimeError(
+                    f"engine config push failed: {e}") from e
+            applied += [f"{k} (engines)"
+                        for k in result.get("applied", ())]
+            inert += [f"{k} (engines)" for k in result.get("inert", ())]
+        self._count_config_reload("ok")
+        logger.info("live config applied: %s%s", applied,
+                    f" (inert: {inert})" if inert else "")
+        return {"applied": applied, "inert": inert}
+
+    def _count_config_reload(self, outcome: str) -> None:
+        counts = getattr(self, "config_reloads_total", None)
+        if counts is None:
+            counts = self.config_reloads_total = {}
+        counts[outcome] = counts.get(outcome, 0) + 1
+
+    def _apply_frontend_config(self, key: str, value: Any) -> bool:
+        """One frontend-scope knob; returns False when the owning
+        subsystem is not armed (the knob is inert, not an error)."""
+        if key == "tenant_weights":
+            from vllm_tpu.resilience.qos import parse_tenant_weights
+
+            self.admission.fair_queue.set_weights(
+                parse_tenant_weights(value))
+            self.lifecycle.tenant_weights = value
+            return True
+        if key.startswith("brownout_"):
+            ctrl = self._brownout
+            if ctrl is None:
+                return False
+            setattr(ctrl.config, key[len("brownout_"):], value)
+            return True
+        if key.startswith("autoscale_"):
+            ctrl = getattr(self, "_autoscale", None)
+            if ctrl is None:
+                return False
+            setattr(ctrl, key[len("autoscale_"):], value)
+            return True
+        return False
+
+    def _engine_set_config(self, updates: dict,
+                           timeout_s: float) -> dict:
+        client = self.engine_core
+        if not hasattr(client, "set_config"):
+            return {"applied": [], "inert": sorted(updates)}
+        if self._thread is None or not self._thread.is_alive():
+            return client.set_config(updates)
+        fut: Future = Future()
+        self._input_queue.put(("set_config", (updates, fut)))
+        return fut.result(timeout=timeout_s)
 
     # -- QoS: brownout ladder + FIFO-vs-QoS A/B ------------------------
 

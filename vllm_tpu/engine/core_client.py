@@ -39,6 +39,7 @@ from vllm_tpu.resilience import EngineRestartedError, EngineSupervisor
 from vllm_tpu.resilience.failpoints import fail_point
 from vllm_tpu.resilience.supervisor import COORDINATOR_ID
 from vllm_tpu.tracing import trace_instant
+from vllm_tpu.versioning import SchemaVersionError, check_schema
 
 logger = init_logger(__name__)
 
@@ -87,6 +88,23 @@ def _merge_numeric(acc: dict, snap: dict) -> dict:
     return out
 
 
+def _apply_config_overrides(config: EngineConfig, overrides: dict) -> None:
+    """Dotted-path overrides for an upgrade replacement's config, e.g.
+    ``{"scheduler_config.max_num_seqs": 8}``. Unknown paths raise BEFORE
+    any process is spawned — a knob that silently failed to apply would
+    make the health gate vouch for the wrong config."""
+    for path, value in overrides.items():
+        obj: Any = config
+        parts = str(path).split(".")
+        for attr in parts[:-1]:
+            if not hasattr(obj, attr):
+                raise ValueError(f"unknown engine config path: {path!r}")
+            obj = getattr(obj, attr)
+        if not hasattr(obj, parts[-1]):
+            raise ValueError(f"unknown engine config path: {path!r}")
+        setattr(obj, parts[-1], value)
+
+
 class InprocClient:
     """Direct in-process EngineCore (the default single-host path)."""
 
@@ -115,6 +133,12 @@ class InprocClient:
 
     def set_qos_enabled(self, enabled: bool) -> bool:
         return self.engine_core.set_qos_enabled(enabled)
+
+    def set_config(self, updates: dict) -> dict:
+        return self.engine_core.set_config(updates)
+
+    def engine_versions(self) -> dict:
+        return {"0": self.engine_core.version_status()}
 
     def sleep(self, level: int = 1) -> bool:
         return self.engine_core.sleep(level)
@@ -547,6 +571,15 @@ class _ZMQClientBase:
     def set_qos_enabled(self, enabled: bool) -> bool:
         return self._utility("set_qos_enabled", enabled, timeout_ms=30_000)
 
+    def set_config(self, updates: dict) -> dict:
+        # DPLB's _utility broadcasts: one call applies the vetted live
+        # knobs pool-wide (every UP engine, gated newcomers included).
+        return self._utility("set_config", updates, timeout_ms=60_000)
+
+    def engine_versions(self) -> dict:
+        """Per-engine /health ``version`` blocks, keyed by engine id."""
+        return {"0": self._utility("version_status", timeout_ms=30_000)}
+
     def sleep(self, level: int = 1) -> bool:
         return self._utility("sleep", level)
 
@@ -657,6 +690,12 @@ class MPClient(_ZMQClientBase):
                 "engine core process failed to initialize"
             )
         ready = serial_utils.decode(frames[1])
+        # Version handshake: an engine proc from a different schema
+        # generation (rolling binary upgrade gone sideways, stale ipc
+        # leftovers) must be refused at attach — one clean typed error
+        # beats a misparsed frame three messages later.
+        check_schema("ready", ready.get("schema"),
+                     detail=f"engine proc pid {self._proc.pid}")
         config.cache_config.num_gpu_blocks = ready["num_gpu_blocks"]
         self._num_gpu_blocks = ready["num_gpu_blocks"]
         self._started = True
@@ -1084,6 +1123,14 @@ class DPLBClient(_ZMQClientBase):
         self._draining: set[int] = set()  # victims finishing their work
         self._seeding: set[int] = set()   # newcomers awaiting weights
         self._removed: set[int] = set()   # retired slots (exited on purpose)
+        # Rolling-upgrade health gate: engines that are UP (answer
+        # utility probes, receive config broadcasts) but must not
+        # receive routed traffic until the gate opens. Rollback retires
+        # a gated slot with zero routed requests by construction.
+        self._gating: set[int] = set()
+        # Version-handshake rejections by kind (feeds the
+        # vllm:schema_mismatch_total metric via version_status).
+        self.schema_mismatch_total: dict[str, int] = {}
         self._scale_state: dict | None = None
         self._scale_log: list[dict] = []
         self._scale_events_pending: list[dict] = []
@@ -1101,9 +1148,11 @@ class DPLBClient(_ZMQClientBase):
                 raise EngineDeadError(
                     "DP engine core processes failed to initialize"
                 )
-            blocks.append(
-                serial_utils.decode(frames[1])["num_gpu_blocks"]
-            )
+            payload = serial_utils.decode(frames[1])
+            check_schema(
+                "ready", payload.get("schema"),
+                detail=f"DP engine {payload.get('engine_id', '?')}")
+            blocks.append(payload["num_gpu_blocks"])
             ready += 1
         config.cache_config.num_gpu_blocks = min(blocks)
         self._started = True
@@ -1216,6 +1265,23 @@ class DPLBClient(_ZMQClientBase):
 
     def _on_engine_ready(self, payload: dict) -> None:
         eid = int(payload.get("engine_id", 0))
+        try:
+            check_schema("ready", payload.get("schema"),
+                         detail=f"DP engine {eid}")
+        except SchemaVersionError as exc:
+            # A respawned/newcomer engine speaking a different schema
+            # must not rejoin the pool: count it, kill the proc, and let
+            # the budget-bounded death path decide what happens next
+            # (for an upgrade newcomer that means automatic rollback).
+            counts = getattr(self, "schema_mismatch_total", None)
+            if counts is not None:
+                counts["ready"] = counts.get("ready", 0) + 1
+            logger.error("%s; refusing attach and terminating the proc",
+                         exc)
+            proc = self._procs[eid]
+            if proc.is_alive():
+                proc.terminate()
+            return
         if eid in getattr(self, "_seeding", ()):
             # Scale-up newcomer: NOT routable yet. A dummy-weights boot
             # waits for the peer re-seed (poll_scale drives it off the
@@ -1224,7 +1290,10 @@ class DPLBClient(_ZMQClientBase):
             st = self._scale_state
             if st is not None and st.get("eid") == eid:
                 if st.get("fallback"):
-                    self._finish_scale_up(eid, outcome="fallback_checkpoint")
+                    self._finish_scale_up(
+                        eid,
+                        outcome=(st.get("ready_outcome")
+                                 or "fallback_checkpoint"))
                 else:
                     st["phase"] = "ready_for_reseed"
                     logger.info(
@@ -1267,13 +1336,24 @@ class DPLBClient(_ZMQClientBase):
         consume restart budget — nor, budget-exhausted, kill the whole
         pool. Retire the slot and hand its in-flight requests straight
         to journal replay; any OTHER dead engine in the same batch takes
-        the normal respawn path (its raise carries both lost sets)."""
+        the normal respawn path (its raise carries both lost sets).
+
+        A routing-gated upgrade newcomer gets the same treatment with a
+        different meaning: it serves no routed traffic, so its death
+        retires the slot with zero lost requests and the rolling
+        controller reads the removal as an automatic rollback — the old
+        engine was never masked."""
+        recovering = (self._started and not self._closing
+                      and self._resilience.enable_recovery)
         victims = [
             e for e in engine_ids
             if e in getattr(self, "_draining", ())
-        ] if (self._started and not self._closing
-              and self._resilience.enable_recovery) else []
-        if not victims:
+        ] if recovering else []
+        newcomers = [
+            e for e in engine_ids
+            if e in getattr(self, "_gating", ()) and e not in victims
+        ] if recovering else []
+        if not victims and not newcomers:
             return super()._handle_engine_death(
                 engine_ids, reason, suspects)
         lost: list[str] = []
@@ -1284,7 +1364,16 @@ class DPLBClient(_ZMQClientBase):
                 eid, reason.splitlines()[0],
             )
             lost.extend(self._retire_slot(eid, outcome="died_draining"))
-        rest = [e for e in engine_ids if e not in victims]
+        for eid in newcomers:
+            logger.warning(
+                "upgrade newcomer %d died before its gate opened (%s); "
+                "retiring the slot — the old engine keeps serving",
+                eid, reason.splitlines()[0],
+            )
+            lost.extend(
+                self._retire_slot(eid, outcome="upgrade_newcomer_died"))
+        handled = victims + newcomers
+        rest = [e for e in engine_ids if e not in handled]
         if rest:
             try:
                 super()._handle_engine_death(rest, reason, suspects)
@@ -1292,8 +1381,9 @@ class DPLBClient(_ZMQClientBase):
                 e.lost_req_ids = sorted({*e.lost_req_ids, *lost})
                 raise
         raise EngineRestartedError(
-            lost, engine_id=victims[0],
-            reason="engine died while draining (autoscale)",
+            lost, engine_id=handled[0],
+            reason=("engine died while draining (autoscale)" if victims
+                    else "upgrade newcomer died before its gate opened"),
             suspect_req_ids=[],
         )
 
@@ -1432,9 +1522,11 @@ class DPLBClient(_ZMQClientBase):
         # nothing is dropped.
         draining = getattr(self, "_draining", ())
         removed = getattr(self, "_removed", ())
+        gating = getattr(self, "_gating", ())
         candidates = [
             i for i in range(self._num_engines)
             if self._engine_up[i] and i not in draining
+            and i not in gating
         ] or [
             i for i in range(self._num_engines) if self._engine_up[i]
         ] or [
@@ -1666,6 +1758,7 @@ class DPLBClient(_ZMQClientBase):
         return [
             i for i in range(self._num_engines)
             if self._engine_up[i] and i not in self._draining
+            and i not in self._gating
         ]
 
     def _broadcast_best_effort(self, method: str, *args,
@@ -1698,7 +1791,10 @@ class DPLBClient(_ZMQClientBase):
         evs, self._scale_events_pending = self._scale_events_pending, []
         return evs
 
-    def scale_up(self) -> int | None:
+    def scale_up(self, checkpoint: str | None = None,
+                 config_overrides: dict | None = None,
+                 from_disk: bool = False,
+                 gating: bool = False) -> int | None:
         """Begin adding one engine to the pool (non-blocking).
 
         The newcomer boots with ``load_format="dummy"`` — allocated,
@@ -1709,7 +1805,16 @@ class DPLBClient(_ZMQClientBase):
         checkpoint ``load_format``, so any crash (or a failed re-seed)
         degrades to the existing recovery path: respawn from checkpoint.
         Returns the new engine id, or None when no event can start
-        (one scale event at a time)."""
+        (one scale event at a time).
+
+        Rolling-upgrade variants: ``checkpoint`` boots the replacement
+        on *new* weights (forces a disk load — peers hold the old
+        weights, so donor re-seed would defeat the upgrade);
+        ``config_overrides`` applies dotted-path engine config changes
+        (validated before spawn); ``from_disk`` skips the peer re-seed
+        even without a new checkpoint; ``gating`` keeps the newcomer
+        routing-masked after it joins — up and utility-reachable for
+        health probes, but serving nothing until :meth:`open_gate`."""
         import copy
         import socket as _socket
 
@@ -1727,6 +1832,13 @@ class DPLBClient(_ZMQClientBase):
             return None
         eid = len(self._procs)
         engine_config = pickle.loads(self._engine_cfg_bytes[0])
+        if checkpoint is not None:
+            engine_config.model_config.model = checkpoint
+            from_disk = True
+        if config_overrides:
+            # Raises on an unknown path — before any slot state mutates
+            # or any process spawns.
+            _apply_config_overrides(engine_config, config_overrides)
         new_bind = None
         if self._fabric_binds is not None:
             s = _socket.socket()
@@ -1751,8 +1863,9 @@ class DPLBClient(_ZMQClientBase):
             lockstep=self._engine_kwargs[0]["lockstep"],
             extra_env={},
         ))
-        dummy_config = copy.deepcopy(engine_config)
-        dummy_config.model_config.load_format = "dummy"
+        boot_config = copy.deepcopy(engine_config)
+        if not from_disk:
+            boot_config.model_config.load_format = "dummy"
         input_addr = (
             f"ipc://{self._run_dir}/in{eid}-{self._ipc_suffix}.sock"
         )
@@ -1763,17 +1876,26 @@ class DPLBClient(_ZMQClientBase):
         self._coord_loads.append(0)
         self._engine_up.append(False)
         self._seeding.add(eid)
+        if gating:
+            self._gating.add(eid)
         self._num_engines += 1
         self._procs.append(self._spawn_dp_engine(
-            eid, input_addr, cfg_bytes=pickle.dumps(dummy_config)))
+            eid, input_addr, cfg_bytes=pickle.dumps(boot_config)))
         self._scale_state = {
             "kind": "up", "eid": eid, "phase": "spawning",
             "t0": time.monotonic(), "bind": new_bind, "donor": None,
-            "fallback": False,
+            # from_disk boots real weights: its READY joins directly via
+            # the fallback branch (no re-seed round-trip).
+            "fallback": from_disk,
+            "ready_outcome": "from_disk" if from_disk else None,
         }
         logger.info(
-            "scale-up: engine %d spawning with dummy weights (pid %s); "
-            "peer re-seed to follow", eid, self._procs[eid].pid)
+            "scale-up: engine %d spawning (pid %s, %s)%s",
+            eid, self._procs[eid].pid,
+            f"checkpoint {checkpoint}" if checkpoint is not None
+            else ("disk load" if from_disk
+                  else "dummy weights; peer re-seed to follow"),
+            "; routing gated" if gating else "")
         return eid
 
     def scale_down(self, engine_id: int | None = None) -> int | None:
@@ -1857,35 +1979,7 @@ class DPLBClient(_ZMQClientBase):
                     "retiring the slot", eid, now - st["t0"])
                 self._retire_slot(eid, outcome="timeout")
         elif st["kind"] == "down":
-            eid = st["eid"]
-            if self._engine_inflight[eid] == 0:
-                # Graceful completion: demote the victim's hot host-tier
-                # KV to peers (best-effort), then retire the slot.
-                if self._fabric_binds is not None:
-                    try:
-                        shipped = self._utility_on(
-                            eid, "kv_fabric_drain", timeout_ms=60_000)
-                        logger.info(
-                            "engine %d demoted %s host-tier blocks to "
-                            "peers before exit", eid, shipped)
-                    except Exception as exc:
-                        logger.warning(
-                            "kv drain on engine %d failed (%s); its "
-                            "host tier is lost (recompute covers it)",
-                            eid, exc)
-                self._retire_slot(eid, outcome="drained")
-            elif (now - st["t0"]
-                    > self._resilience.autoscale_drain_deadline_s):
-                # Past the drain deadline: journal-replay the stragglers
-                # onto the survivors — zero lost requests, same path a
-                # crash takes, minus the crash.
-                lost = self._retire_slot(eid, outcome="deadline_replay")
-                raise EngineRestartedError(
-                    lost, engine_id=eid,
-                    reason="autoscale drain deadline; replaying "
-                           "stragglers on survivors",
-                    suspect_req_ids=[],
-                )
+            self._drain_to_retire(st["eid"], st["t0"])
         elif st["kind"] == "rebalance":
             eid = st["eid"]
             deadline = (now - st["t0"]
@@ -1904,6 +1998,46 @@ class DPLBClient(_ZMQClientBase):
                 self._scale_state = None
                 logger.info("engine %d re-roled to %s", eid, st["role"])
         return self._drain_scale_events()
+
+    def _drain_to_retire(self, eid: int, started_t: float,
+                         outcome: str = "drained") -> list[str] | None:
+        """THE drain-to-retire sequence, shared by every path that ends
+        an engine's service on purpose — autoscale scale-down, the
+        rolling upgrade's victim drain, and the frontend's SIGTERM drain
+        (which retires slots through scale_down + this poll).
+
+        Returns the lost request ids when the slot retired on this call
+        (empty on a graceful finish), or None while the drain is still
+        in progress. A graceful finish first demotes the victim's hot
+        host-tier KV to surviving peers (best-effort). Past
+        ``autoscale_drain_deadline_s`` the slot is retired anyway and
+        the stragglers journal-replay onto survivors via the raised
+        EngineRestartedError — zero lost requests, the same path a crash
+        takes, minus the crash."""
+        if self._engine_inflight[eid] == 0:
+            if self._fabric_binds is not None:
+                try:
+                    shipped = self._utility_on(
+                        eid, "kv_fabric_drain", timeout_ms=60_000)
+                    logger.info(
+                        "engine %d demoted %s host-tier blocks to "
+                        "peers before exit", eid, shipped)
+                except Exception as exc:
+                    logger.warning(
+                        "kv drain on engine %d failed (%s); its "
+                        "host tier is lost (recompute covers it)",
+                        eid, exc)
+            return self._retire_slot(eid, outcome=outcome)
+        if (time.monotonic() - started_t
+                > self._resilience.autoscale_drain_deadline_s):
+            lost = self._retire_slot(eid, outcome="deadline_replay")
+            raise EngineRestartedError(
+                lost, engine_id=eid,
+                reason="drain deadline; replaying stragglers on "
+                       "survivors",
+                suspect_req_ids=[],
+            )
+        return None
 
     def _start_reseed(self, st: dict) -> None:
         """Blocking peer re-seed: the newcomer listens, the least-loaded
@@ -2021,6 +2155,7 @@ class DPLBClient(_ZMQClientBase):
         self._removed.add(eid)
         self._draining.discard(eid)
         self._seeding.discard(eid)
+        self._gating.discard(eid)
         self._engine_up[eid] = False
         proc = self._procs[eid]
         if proc.is_alive():
@@ -2084,6 +2219,7 @@ class DPLBClient(_ZMQClientBase):
             "actual": len(self._routable_ids()),
             "draining": sorted(self._draining),
             "seeding": sorted(self._seeding),
+            "gating": sorted(self._gating),
             "removed": sorted(self._removed),
             "scale_event": (
                 {
@@ -2095,6 +2231,71 @@ class DPLBClient(_ZMQClientBase):
             ),
             "events": list(self._scale_log)[-20:],
             "drain_durations_s": durations,
+        }
+
+    # -- rolling-upgrade primitives (resilience/rolling.py executor) ----
+
+    def slot_state(self, eid: int) -> str:
+        """"up" | "removed" | "pending" — the upgrade driver's view of
+        one slot. "pending" covers spawning/booting/seeding; a retired
+        slot is "removed" forever (ids are never reused)."""
+        if eid in self._removed:
+            return "removed"
+        if 0 <= eid < len(self._engine_up) and self._engine_up[eid]:
+            return "up"
+        return "pending"
+
+    def open_gate(self, eid: int) -> bool:
+        """Shift routing onto a gated newcomer: the health gate passed,
+        new requests may land on it from the next add_request."""
+        if eid not in self._gating:
+            return False
+        self._gating.discard(eid)
+        logger.info(
+            "upgrade: routing gate opened for engine %d; pool now %d "
+            "routable", eid, len(self._routable_ids()))
+        return True
+
+    def retire_engine(self, eid: int,
+                      outcome: str = "upgrade_rolled_back") -> list[str]:
+        """Roll back / abort: retire one slot outright. For a gated
+        newcomer the returned lost list is empty by construction — it
+        never received routed traffic — which is exactly the
+        "pool byte-identical to pre-upgrade" guarantee."""
+        if eid in self._removed:
+            return []
+        return self._retire_slot(eid, outcome=outcome)
+
+    def probe_engine(self, eid: int, n_tokens: int = 4) -> list[int]:
+        """One health-gate probe: a tiny deterministic generation run
+        end-to-end inside the gated newcomer (EngineCore.probe). Raises
+        on any failure — the raise IS the gate-fail signal. The generous
+        timeout covers a first-token compile on a cold cache."""
+        return self._utility_on(
+            eid, "probe", n_tokens, timeout_ms=600_000)
+
+    def engine_versions(self) -> dict:
+        """Per-engine /health ``version`` blocks keyed by engine id
+        (package + schema version, config hash, weights fingerprint) —
+        a mixed-version pool at a glance, plus this client's schema-
+        handshake rejection counts."""
+        self._check_alive()
+        up = [
+            i for i in range(self._num_engines) if self._engine_up[i]
+        ]
+        if not up:
+            return {}
+        for eid in up:
+            self._inputs[eid].send_multipart([
+                self._proc_mod.MSG_UTILITY,
+                b"version_status",
+                self._serial.encode([]),
+            ])
+        replies = self._collect_utility_replies(
+            "version_status", len(up), 30_000)
+        return {
+            str(r.get("engine_id", i)): r["ok"]
+            for i, r in enumerate(replies) if r.get("ok")
         }
 
     # ------------------------------------------------------------------

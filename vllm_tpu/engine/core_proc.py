@@ -219,12 +219,18 @@ def run_engine_core(config_bytes: bytes, input_addr: str,
         config = pickle.loads(config_bytes)
         core = EngineCore(config)
         install_watchdog_escalation(core)
+        from vllm_tpu.versioning import SCHEMA_VERSION
         for sock in outs:
             sock.send_multipart([
                 MSG_READY,
                 serial_utils.encode(
                     {"num_gpu_blocks": config.cache_config.num_gpu_blocks,
-                     "engine_id": engine_id}
+                     "engine_id": engine_id,
+                     # Wire handshake: a frontend from a different
+                     # schema generation must refuse the attach instead
+                     # of misparsing frames later (rolling binary
+                     # upgrades make mixed pools a planned state).
+                     "schema": SCHEMA_VERSION}
                 ),
             ])
 

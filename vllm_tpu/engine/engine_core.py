@@ -556,6 +556,97 @@ class EngineCore:
             self.scheduler.brownout_rung = 0
         return True
 
+    def set_config(self, updates: dict) -> dict:
+        """Live-config RPC (resilience/rolling.py): apply engine-scope
+        knobs from the vetted live-updatable set without a restart. The
+        scheduler re-reads its config fields every schedule(), so a
+        plain field write takes effect on the next step. Keys are vetted
+        frontend-side (vet_live_config); an unknown key arriving here
+        anyway is a bug and raises (the utility reply carries it back as
+        a loud typed error, never a silent no-op). Returns
+        ``{"applied": [...], "inert": [...]}`` — "inert" keys were
+        accepted but target a subsystem this engine doesn't run (e.g.
+        adaptive-spec watermarks without --spec-adaptive)."""
+        applied: list[str] = []
+        inert: list[str] = []
+        sched = self.scheduler
+        for key, value in updates.items():
+            if key == "long_prefill_token_threshold":
+                sched.config.long_prefill_token_threshold = int(value)
+            elif key == "pressure_preemption_s":
+                sched.config.pressure_preemption_s = float(value)
+            elif key == "max_preemptions_per_step":
+                sched.config.max_preemptions_per_step = int(value)
+            elif key in ("spec_adaptive_high_watermark",
+                         "spec_adaptive_low_watermark"):
+                adaptive = getattr(sched, "adaptive_spec", None)
+                if adaptive is None:
+                    inert.append(key)
+                    continue
+                attr = ("high_watermark" if key.endswith("high_watermark")
+                        else "low_watermark")
+                setattr(adaptive, attr, float(value))
+            else:
+                raise ValueError(
+                    f"set_config: {key!r} is not an engine-scope "
+                    f"live-updatable knob")
+            applied.append(key)
+        return {"applied": applied, "inert": inert}
+
+    def probe(self, n_tokens: int = 4,
+              prompt_token_ids: list[int] | None = None) -> list[int]:
+        """Health-gate probe (resilience/rolling.py): run one tiny
+        self-contained generation through the full schedule -> execute ->
+        update path and return the sampled token ids. The rolling
+        upgrade gates a routing-masked newcomer on N of these
+        succeeding; greedy + ignore_eos makes the result deterministic
+        for a given checkpoint, so the driver can additionally compare
+        probe outputs across engines. Raises on any failure — a probe
+        that can't produce tokens IS the gate signal."""
+        from vllm_tpu.sampling_params import SamplingParams
+
+        self._probe_seq = getattr(self, "_probe_seq", 0) + 1
+        rid = f"_probe-{self._probe_seq}"
+        self.add_request(EngineCoreRequest(
+            request_id=rid,
+            prompt_token_ids=list(prompt_token_ids or (1, 2, 3, 4)),
+            sampling_params=SamplingParams(
+                temperature=0.0, max_tokens=max(1, int(n_tokens)),
+                ignore_eos=True),
+        ))
+        tokens: list[int] = []
+        for _ in range(512):
+            outputs = self.step()
+            for out in outputs.outputs:
+                if out.req_id != rid:
+                    continue
+                tokens.extend(out.new_token_ids)
+                if out.finish_reason is not None:
+                    if out.finish_reason == "error":
+                        raise RuntimeError(
+                            f"probe request failed: {out.stop_reason!r}")
+                    if not tokens:
+                        raise RuntimeError(
+                            "probe finished without emitting tokens")
+                    return tokens
+        self.abort_requests([rid])
+        raise RuntimeError(
+            f"probe did not finish within the step budget "
+            f"({len(tokens)}/{n_tokens} tokens)")
+
+    def version_status(self) -> dict:
+        """The /health ``version`` block for this engine (utility RPC):
+        package + schema version, config hash, checkpoint path and its
+        mtime-derived weights fingerprint. update_weights() changes the
+        fingerprint the next time this is asked — the upgrade e2e
+        asserts the newcomer's differs from the victim's."""
+        from vllm_tpu.versioning import version_block
+
+        return version_block(
+            config=self.config,
+            model_path=self.config.model_config.model,
+        )
+
     # ------------------------------------------------------------------
     # Sleep / wake / weight reload (reference: core.py:673 sleep, :711
     # wake_up; gpu_worker.py:978 update_weights)
@@ -724,6 +815,9 @@ class EngineCore:
         while self._inflight:
             self.step()
         self.executor.collective_rpc("update_weights", path)
+        # version_status()'s weights fingerprint must track what is
+        # actually resident, not what the engine booted with.
+        self.config.model_config.model = path
         return True
 
     def receive_weights(self, port: int, timeout: float = 300.0) -> int:

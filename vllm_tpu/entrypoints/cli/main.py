@@ -159,6 +159,131 @@ def _run_run_batch(args: argparse.Namespace) -> None:
         engine.shutdown()
 
 
+def _add_upgrade(sub: argparse._SubParsersAction) -> None:
+    p = sub.add_parser(
+        "upgrade",
+        help="Drive a health-gated rolling upgrade on a running server")
+    p.add_argument(
+        "--url", default="http://localhost:8000",
+        help="base URL of the serving frontend (POST /admin/upgrade)")
+    p.add_argument(
+        "--upgrade-checkpoint", default=None,
+        help="path to the new weights; omit to cycle the pool onto the "
+             "current checkpoint (config-only upgrade)")
+    p.add_argument(
+        "--upgrade-config", default=None,
+        help='JSON object of dotted-path config overrides for the '
+             'replacement engines, e.g. '
+             '\'{"scheduler_config.max_num_seqs": 8}\'')
+    p.add_argument(
+        "--upgrade-gate-requests", type=int, default=None,
+        help="successful probe requests a newcomer must serve before "
+             "promotion (overrides the server default for this cycle)")
+    p.add_argument(
+        "--upgrade-slo-floor", type=float, default=None,
+        help="minimum pool SLO attainment [0,1] required to promote "
+             "(overrides the server default for this cycle)")
+    p.add_argument(
+        "--slots", default=None,
+        help='comma-separated engine ids to cycle, e.g. "0,1" '
+             "(default: every healthy slot)")
+    p.add_argument("--status", action="store_true",
+                   help="print the controller snapshot and exit")
+    p.add_argument("--abort", action="store_true",
+                   help="abort the in-flight cycle at the next safe point")
+    p.add_argument(
+        "--wait", action="store_true",
+        help="after starting, poll until the cycle finishes and exit "
+             "non-zero unless the outcome is 'ok'")
+    p.set_defaults(func=_run_upgrade)
+
+
+def _run_upgrade(args: argparse.Namespace) -> None:
+    import json
+    import time
+    import urllib.error
+    import urllib.request
+
+    base = args.url.rstrip("/")
+
+    def call(path: str, body: dict | None = None) -> dict:
+        req = urllib.request.Request(
+            base + path,
+            data=(json.dumps(body).encode() if body is not None else None),
+            headers={"Content-Type": "application/json"},
+            method="POST" if body is not None else "GET",
+        )
+        try:
+            with urllib.request.urlopen(req, timeout=30) as resp:
+                return json.loads(resp.read())
+        except urllib.error.HTTPError as e:
+            try:
+                detail = json.loads(e.read()).get("error", "")
+            except Exception:
+                detail = ""
+            print(f"error: HTTP {e.code} {detail or e.reason}",
+                  file=sys.stderr)
+            raise SystemExit(1) from e
+        except urllib.error.URLError as e:
+            print(f"error: cannot reach {base}: {e.reason}",
+                  file=sys.stderr)
+            raise SystemExit(1) from e
+
+    if args.status:
+        print(json.dumps(call("/admin/upgrade"), indent=2))
+        return
+    if args.abort:
+        print(json.dumps(call("/admin/upgrade/abort", {}), indent=2))
+        return
+
+    body: dict = {}
+    if args.upgrade_checkpoint:
+        body["checkpoint"] = args.upgrade_checkpoint
+    if args.upgrade_config:
+        try:
+            config = json.loads(args.upgrade_config)
+        except json.JSONDecodeError as e:
+            print(f"error: --upgrade-config is not valid JSON: {e}",
+                  file=sys.stderr)
+            raise SystemExit(2) from e
+        if not isinstance(config, dict):
+            print("error: --upgrade-config must be a JSON object",
+                  file=sys.stderr)
+            raise SystemExit(2)
+        body["config"] = config
+    if args.upgrade_gate_requests is not None:
+        body["gate_requests"] = args.upgrade_gate_requests
+    if args.upgrade_slo_floor is not None:
+        body["slo_floor"] = args.upgrade_slo_floor
+    if args.slots:
+        try:
+            body["slots"] = [int(s) for s in args.slots.split(",") if s]
+        except ValueError as e:
+            print("error: --slots must be comma-separated integers",
+                  file=sys.stderr)
+            raise SystemExit(2) from e
+
+    started = call("/admin/upgrade", body)
+    print(json.dumps(started, indent=2))
+    if not args.wait:
+        return
+    # Poll until the controller goes idle; the cycle's terminal outcome
+    # is the last_outcome the snapshot reports.
+    while True:
+        time.sleep(1.0)
+        snap = call("/admin/upgrade").get("controller", {})
+        phase = snap.get("phase", "?")
+        print(f"phase={phase} victim={snap.get('victim')} "
+              f"newcomer={snap.get('newcomer')} "
+              f"slots_done={snap.get('slots_done')}", file=sys.stderr)
+        if not snap.get("active"):
+            outcome = snap.get("last_outcome")
+            print(json.dumps(snap, indent=2))
+            if outcome != "ok":
+                raise SystemExit(1)
+            return
+
+
 def main(argv: list[str] | None = None) -> None:
     parser = argparse.ArgumentParser(prog="vllm-tpu")
     sub = parser.add_subparsers(required=True)
@@ -166,6 +291,7 @@ def main(argv: list[str] | None = None) -> None:
     _add_complete(sub)
     _add_bench(sub)
     _add_run_batch(sub)
+    _add_upgrade(sub)
     args = parser.parse_args(argv)
     args.func(args)
 

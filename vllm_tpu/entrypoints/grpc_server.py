@@ -195,10 +195,79 @@ def make_server(engine, model_name: str) -> grpc.aio.Server:
             await context.abort(_shed_code(exc), str(exc))
 
     async def health(request: bytes, context):
-        return _dumps({"status": "SERVING"})
+        body: dict = {"status": "SERVING"}
+        # Zero-downtime operations: version identity + upgrade state,
+        # mirroring the HTTP /health blocks.
+        if hasattr(engine, "version_status"):
+            body["version"] = engine.version_status()
+        if hasattr(engine, "upgrade_status"):
+            up = engine.upgrade_status()
+            if up is not None:
+                body["upgrade"] = up["controller"]
+        return _dumps(body)
 
     async def models(request: bytes, context):
         return _dumps({"models": [model_name]})
+
+    async def upgrade(request: bytes, context):
+        """Rolling upgrade over JSON: ``{}`` = status,
+        ``{"abort": true}`` = abort, anything else starts a cycle
+        (``checkpoint`` / ``config`` / ``slots`` as POST
+        /admin/upgrade)."""
+        if (not hasattr(engine, "upgrade_status")
+                or engine.upgrade_status() is None):
+            await context.abort(
+                grpc.StatusCode.UNIMPLEMENTED,
+                "rolling upgrades need a data-parallel engine pool")
+            return
+        try:
+            req = json.loads(request) if request else {}
+        except json.JSONDecodeError as exc:
+            await context.abort(
+                grpc.StatusCode.INVALID_ARGUMENT, str(exc))
+            return
+        if not isinstance(req, dict):
+            await context.abort(
+                grpc.StatusCode.INVALID_ARGUMENT,
+                "body must be a JSON object")
+            return
+        if req.get("abort"):
+            return _dumps(engine.abort_upgrade())
+        if not req:
+            return _dumps(engine.upgrade_status())
+        try:
+            return _dumps(engine.start_upgrade(
+                checkpoint=req.get("checkpoint"),
+                config=req.get("config"), slots=req.get("slots"),
+                gate_requests=req.get("gate_requests"),
+                slo_floor=req.get("slo_floor")))
+        except ValueError as exc:
+            await context.abort(
+                grpc.StatusCode.INVALID_ARGUMENT, str(exc))
+
+    async def set_config(request: bytes, context):
+        """Live-config push (``{key: value}``); unknown keys reject the
+        whole request, matching POST /admin/config."""
+        from vllm_tpu.resilience import LiveConfigError
+
+        try:
+            req = json.loads(request)
+        except json.JSONDecodeError as exc:
+            await context.abort(
+                grpc.StatusCode.INVALID_ARGUMENT, str(exc))
+            return
+        loop = asyncio.get_running_loop()
+        try:
+            result = await loop.run_in_executor(
+                None, engine.set_live_config, req)
+        except LiveConfigError as exc:
+            await context.abort(
+                grpc.StatusCode.INVALID_ARGUMENT, str(exc))
+            return
+        except Exception as exc:
+            await context.abort(grpc.StatusCode.INTERNAL, str(exc))
+            return
+        return _dumps(result)
 
     ident = lambda b: b  # JSON bytes in/out; no protobuf schema
     handlers = grpc.method_handlers_generic_handler(_SERVICE + "Json", {
@@ -210,6 +279,14 @@ def make_server(engine, model_name: str) -> grpc.aio.Server:
         ),
         "Models": grpc.unary_unary_rpc_method_handler(
             models, request_deserializer=ident, response_serializer=ident
+        ),
+        "Upgrade": grpc.unary_unary_rpc_method_handler(
+            upgrade, request_deserializer=ident,
+            response_serializer=ident
+        ),
+        "SetConfig": grpc.unary_unary_rpc_method_handler(
+            set_config, request_deserializer=ident,
+            response_serializer=ident
         ),
     })
     server = grpc.aio.server()

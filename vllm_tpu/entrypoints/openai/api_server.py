@@ -740,6 +740,19 @@ async def handle_health(request: web.Request) -> web.Response:
     # so operators see WHY batch traffic is being shed or preempted.
     if hasattr(engine, "qos_status"):
         body["qos"] = engine.qos_status()
+    # Zero-downtime operations: package/schema/config/weights identity
+    # for the frontend and every engine (a mixed-version pool at a
+    # glance), plus the rolling-upgrade cycle state.
+    if hasattr(engine, "version_status"):
+        body["version"] = engine.version_status()
+    if hasattr(engine, "upgrade_status"):
+        up = engine.upgrade_status()
+        if up is not None:
+            body["upgrade"] = {
+                "enabled": up["enabled"],
+                "controller": up["controller"],
+                "config_reloads_total": up["config_reloads_total"],
+            }
     return web.json_response(body, status=503 if dead else 200)
 
 
@@ -785,6 +798,128 @@ async def handle_debug_deadletter(request: web.Request) -> web.Response:
             {"error": "engine does not support quarantine introspection"},
             status=501)
     return web.json_response(engine.debug_deadletter())
+
+
+async def handle_admin_upgrade(request: web.Request) -> web.Response:
+    """POST /admin/upgrade: start a health-gated rolling upgrade.
+    Body: ``{"checkpoint": path?, "config": {dotted.path: value}?,
+    "slots": [engine_id]?}``. The pool cycles one slot at a time — boot
+    a gated replacement with the new checkpoint/config, probe it,
+    shift routing, drain the old engine — rolling back automatically on
+    a failed gate. One cycle at a time; bad input is a 400 here, not a
+    failed boot mid-cycle."""
+    engine: AsyncLLM = request.app[ENGINE_KEY]
+    if (not hasattr(engine, "upgrade_status")
+            or engine.upgrade_status() is None):
+        return web.json_response(
+            {"error": "rolling upgrades need a data-parallel engine "
+             "pool (--data-parallel-engines >= 2)"}, status=501)
+    body: dict = {}
+    if request.can_read_body:
+        try:
+            parsed = await request.json()
+        except Exception:
+            return web.json_response(
+                {"error": "request body must be JSON"}, status=400)
+        if isinstance(parsed, dict):
+            body = parsed
+    config = body.get("config")
+    if config is not None and not isinstance(config, dict):
+        return web.json_response(
+            {"error": "config must be an object of dotted-path: value "
+             "pairs"}, status=400)
+    slots = body.get("slots")
+    if slots is not None and not (
+            isinstance(slots, list)
+            and all(isinstance(s, int) for s in slots)):
+        return web.json_response(
+            {"error": "slots must be a list of engine ids"}, status=400)
+    gate_requests = body.get("gate_requests")
+    if gate_requests is not None and not isinstance(gate_requests, int):
+        return web.json_response(
+            {"error": "gate_requests must be an integer"}, status=400)
+    slo_floor = body.get("slo_floor")
+    if slo_floor is not None and not isinstance(slo_floor, (int, float)):
+        return web.json_response(
+            {"error": "slo_floor must be a number"}, status=400)
+    try:
+        started = engine.start_upgrade(
+            checkpoint=body.get("checkpoint"), config=config,
+            slots=slots, gate_requests=gate_requests,
+            slo_floor=slo_floor)
+    except ValueError as e:
+        return web.json_response({"error": str(e)}, status=400)
+    return web.json_response(started)
+
+
+async def handle_admin_upgrade_status(
+        request: web.Request) -> web.Response:
+    """GET /admin/upgrade: the rolling-upgrade controller snapshot
+    (phase, victim/newcomer, probe counts, gate budget, outcomes)."""
+    engine: AsyncLLM = request.app[ENGINE_KEY]
+    status = (engine.upgrade_status()
+              if hasattr(engine, "upgrade_status") else None)
+    if status is None:
+        return web.json_response(
+            {"error": "rolling upgrades need a data-parallel engine "
+             "pool"}, status=501)
+    return web.json_response(status)
+
+
+async def handle_admin_upgrade_abort(
+        request: web.Request) -> web.Response:
+    """POST /admin/upgrade/abort: stop the in-flight cycle at the next
+    safe point (a gated newcomer rolls back; a promoted slot finishes
+    its drain first)."""
+    engine: AsyncLLM = request.app[ENGINE_KEY]
+    if not hasattr(engine, "abort_upgrade"):
+        return web.json_response(
+            {"error": "engine does not support rolling upgrades"},
+            status=501)
+    return web.json_response(engine.abort_upgrade())
+
+
+async def handle_admin_config(request: web.Request) -> web.Response:
+    """POST /admin/config: apply a live-updatable config subset
+    pool-wide without restart (body: ``{key: value}``). Unknown or
+    out-of-range keys reject the WHOLE request with a 400 listing the
+    updatable set. GET lists the vetted keys and reload counters."""
+    engine: AsyncLLM = request.app[ENGINE_KEY]
+    from vllm_tpu.resilience import LiveConfigError, live_config_keys
+
+    if request.method == "GET" or not hasattr(engine,
+                                              "set_live_config"):
+        if request.method != "GET":
+            return web.json_response(
+                {"error": "engine does not support live config"},
+                status=501)
+        return web.json_response({
+            "live_config_keys": live_config_keys(),
+            "config_reloads_total": dict(
+                getattr(engine, "config_reloads_total", None) or {}),
+        })
+    try:
+        parsed = await request.json()
+    except Exception:
+        return web.json_response(
+            {"error": "request body must be JSON"}, status=400)
+    if not isinstance(parsed, dict):
+        return web.json_response(
+            {"error": "body must be an object of key: value pairs"},
+            status=400)
+    loop = asyncio.get_running_loop()
+    try:
+        # Blocks briefly on the engine-loop handshake for engine-scope
+        # keys — run off the event loop.
+        result = await loop.run_in_executor(
+            None, engine.set_live_config, parsed)
+    except LiveConfigError as e:
+        return web.json_response(
+            {"error": str(e), "keys": e.keys,
+             "live_config_keys": live_config_keys()}, status=400)
+    except Exception as e:
+        return web.json_response({"error": str(e)}, status=500)
+    return web.json_response(result)
 
 
 async def handle_metrics(request: web.Request) -> web.Response:
@@ -954,6 +1089,12 @@ def build_app(engine: AsyncLLM, model_name: str, metrics=None,
     app.router.add_get("/metrics/cluster", handle_metrics_cluster)
     app.router.add_get("/debug/requests", handle_debug_requests)
     app.router.add_get("/debug/deadletter", handle_debug_deadletter)
+    app.router.add_get("/admin/upgrade", handle_admin_upgrade_status)
+    app.router.add_post("/admin/upgrade", handle_admin_upgrade)
+    app.router.add_post("/admin/upgrade/abort",
+                        handle_admin_upgrade_abort)
+    app.router.add_get("/admin/config", handle_admin_config)
+    app.router.add_post("/admin/config", handle_admin_config)
     app.router.add_get("/debug/perf", handle_debug_perf)
     app.router.add_post("/debug/perf/capture", handle_debug_perf_capture)
     from vllm_tpu.entrypoints.openai.extra_apis import (

@@ -106,6 +106,13 @@ _readers: dict[str, Callable[[], Any]] = {
     "VLLM_TPU_DISABLE_AUTOSCALE": _bool(
         "VLLM_TPU_DISABLE_AUTOSCALE", False
     ),
+    # Escape hatch for rolling upgrades (vllm_tpu/resilience/rolling):
+    # POST /admin/upgrade refuses to start a cycle (no controller is
+    # built) while the manual client primitives (scale_up/scale_down/
+    # probe_engine) and the live-config set_config RPC stay available.
+    "VLLM_TPU_DISABLE_ROLLING": _bool(
+        "VLLM_TPU_DISABLE_ROLLING", False
+    ),
     # Escape hatch for the fused sort-free sampling kernel
     # (ops/sampler_kernel.py): sampling batches fall back to the XLA
     # sort-free reference in sample/sampler.py when set. Both paths are

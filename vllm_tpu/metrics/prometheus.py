@@ -176,6 +176,43 @@ class BiLabeledCounter:
         return "\n".join(out) + "\n"
 
 
+class InfoGauge:
+    """Info-style gauge family: every sample has value 1 and the labels
+    ARE the payload (the Prometheus ``*_info`` convention — identity,
+    not a quantity). One series per key; ``set`` replaces the key's
+    labels wholesale so an upgraded engine's fingerprint swap renders as
+    one series changing, never two coexisting."""
+
+    def __init__(self, name: str, doc: str) -> None:
+        self.name, self.doc = name, doc
+        self.series: dict[str, dict[str, str]] = {}
+
+    def set(self, key: str, labels: dict) -> None:
+        self.series[key] = {
+            k: str(v) for k, v in labels.items() if v is not None
+        }
+
+    def prune(self, keys) -> None:
+        """Drop series whose key is no longer live (a retired engine
+        slot must not keep exporting its old version forever)."""
+        keep = set(keys)
+        for key in list(self.series):
+            if key not in keep:
+                del self.series[key]
+
+    def render(self) -> str:
+        out = [
+            f"# HELP {self.name} {self.doc}",
+            f"# TYPE {self.name} gauge",
+        ]
+        for key in sorted(self.series):
+            kv = ",".join(
+                f'{k}="{v}"' for k, v in sorted(self.series[key].items())
+            )
+            out.append(f"{self.name}{{{kv}}} 1.0")
+        return "\n".join(out) + "\n"
+
+
 class LabeledGauge:
     """One gauge family with a single label dimension (e.g. engine id)."""
 
@@ -594,6 +631,38 @@ class PrometheusRegistry:
             "Peer weight re-seed attempts by outcome (ok = newcomer "
             "adopted a live peer's weights over the fabric push path, "
             "fallback = checkpoint reload)", "outcome")
+        # Zero-downtime operations (vllm_tpu/resilience/rolling +
+        # versioning): upgrade-cycle outcomes, live-config pushes, and
+        # the version identity of every pool member, refreshed from the
+        # AsyncLLM upgrade/version snapshots at render time.
+        self.upgrade_events = LabeledCounter(
+            "vllm:upgrade_events_total",
+            "Completed rolling-upgrade cycles by outcome (ok = every "
+            "slot promoted, rolled_back = a newcomer failed its health "
+            "gate and the old slot kept serving, aborted = operator "
+            "abort honored at the next safe point)", "outcome")
+        self.upgrade_in_progress = Gauge(
+            "vllm:upgrade_in_progress",
+            "1 while a rolling-upgrade cycle is active (spawning/"
+            "booting/gating/draining/rolling_back), else 0")
+        self.engine_version_info = InfoGauge(
+            "vllm:engine_version_info",
+            "Version identity per pool member (engine slots plus the "
+            "frontend): package version, wire-schema version, config "
+            "hash, weights fingerprint; value is always 1")
+        self.config_reloads_total = LabeledCounter(
+            "vllm:config_reloads_total",
+            "Live-config push attempts by outcome (ok = applied "
+            "pool-wide without restart, rejected = a non-updatable key "
+            "was refused, error = the engine-side apply failed)",
+            "outcome")
+        self.schema_mismatch = LabeledCounter(
+            "vllm:schema_mismatch_total",
+            "Version-stamped artifacts rejected for speaking a "
+            "different wire/journal schema, by boundary kind (ready = "
+            "ZMQ engine handshake, journal = crash-journal snapshot, "
+            "handoff = disagg KV handoff record, trace = request-trace "
+            "replay)", "kind")
         # SLO scoreboard (vllm_tpu/metrics/reqtrace + goodput): per-class
         # latency families fed from the class-labeled IterationStats
         # samples, a sliding-window attainment gauge pulled from the
@@ -664,6 +733,9 @@ class PrometheusRegistry:
             self.pool_size_desired, self.pool_size_actual,
             self.scale_events, self.engine_drain_duration,
             self.weight_reseed,
+            self.upgrade_events, self.upgrade_in_progress,
+            self.engine_version_info, self.config_reloads_total,
+            self.schema_mismatch,
             self.slo_ttft, self.slo_itl, self.slo_attainment,
             self.trace_records,
         ]
@@ -934,6 +1006,48 @@ class PrometheusRegistry:
         for d in pool.get("drain_durations_s", []):
             self.engine_drain_duration.observe(float(d))
 
+    def _refresh_upgrade(self) -> None:
+        engine = self._engine
+        if engine is None:
+            return
+        if hasattr(engine, "upgrade_status"):
+            try:
+                status = engine.upgrade_status()
+            except Exception:
+                status = None
+            if status is not None:
+                ctrl = status.get("controller") or {}
+                self.upgrade_in_progress.set(
+                    1.0 if ctrl.get("active") else 0.0)
+                # Cycle/reload totals are cumulative in the controller
+                # snapshot → ratchet.
+                for outcome, n in (ctrl.get("upgrade_events_total")
+                                   or {}).items():
+                    self.upgrade_events.inc_to(outcome, float(n))
+                for outcome, n in (status.get("config_reloads_total")
+                                   or {}).items():
+                    self.config_reloads_total.inc_to(outcome, float(n))
+        if hasattr(engine, "version_status"):
+            try:
+                versions = engine.version_status()
+            except Exception:
+                return
+            live: list[str] = []
+            frontend = versions.get("frontend")
+            if frontend:
+                live.append("frontend")
+                self.engine_version_info.set(
+                    "frontend", {"member": "frontend", **frontend})
+            for eid, block in (versions.get("engines") or {}).items():
+                key = f"engine-{eid}"
+                live.append(key)
+                self.engine_version_info.set(
+                    key, {"member": key, **(block or {})})
+            self.engine_version_info.prune(live)
+            for kind, n in (versions.get("schema_mismatch_total")
+                            or {}).items():
+                self.schema_mismatch.inc_to(kind, float(n))
+
     def _refresh_lifecycle(self) -> None:
         engine = self._engine
         if engine is None or not hasattr(engine, "lifecycle_status"):
@@ -1010,6 +1124,7 @@ class PrometheusRegistry:
         self._refresh_routing()
         self._refresh_disagg()
         self._refresh_autoscale()
+        self._refresh_upgrade()
         self._refresh_failpoints()
         self._refresh_slo()
         return "".join(m.render() for m in self._metrics)

@@ -29,6 +29,7 @@ import time
 from typing import Any
 
 from vllm_tpu.logger import init_logger
+from vllm_tpu.versioning import SCHEMA_VERSION, check_schema
 
 logger = init_logger(__name__)
 
@@ -58,6 +59,9 @@ class RequestTraceRecorder:
             self._write({
                 "kind": "meta",
                 "version": TRACE_VERSION,
+                # Package schema stamp: replay across a binary upgrade
+                # is detected at load, never guessed at.
+                "schema": SCHEMA_VERSION,
                 "pid": os.getpid(),
                 "t0_wall": round(self._t0_wall, 6),
             })
@@ -183,7 +187,13 @@ def load_trace(path: str) -> list[dict]:
                         "reqtrace: skipping unparseable line in %s", fname
                     )
                     continue
-                if rec.get("kind") == "request":
+                if rec.get("kind") == "meta":
+                    # Typed, counted rejection of a trace recorded by a
+                    # different package schema (SchemaVersionError) —
+                    # replaying it would bench the wrong record shape.
+                    check_schema("trace", rec.get("schema"),
+                                 detail=fname)
+                elif rec.get("kind") == "request":
                     records.append(rec)
     records.sort(key=lambda r: r.get("arrival_offset_s") or 0.0)
     return records

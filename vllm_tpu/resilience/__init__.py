@@ -66,6 +66,12 @@ from vllm_tpu.resilience.quarantine import (
     DeadLetterStore,
     QuarantineManager,
 )
+from vllm_tpu.resilience.rolling import (
+    LiveConfigError,
+    RollingUpgradeController,
+    live_config_keys,
+    vet_live_config,
+)
 from vllm_tpu.resilience.supervisor import EngineSupervisor
 
 
@@ -126,6 +132,7 @@ __all__ = [
     "EngineSupervisor",
     "JournalEntry",
     "LifecycleConfig",
+    "LiveConfigError",
     "MeshRecoveryError",
     "MeshRecoveryManager",
     "QuarantineManager",
@@ -133,9 +140,11 @@ __all__ = [
     "RequestJournal",
     "RequestShedError",
     "ResilienceConfig",
+    "RollingUpgradeController",
     "SlowClientError",
     "TIMEOUT_FINISH_REASON",
     "TenantFairQueue",
+    "live_config_keys",
     "make_shed_error",
     "parse_tenant_weights",
 ]

@@ -130,6 +130,19 @@ class ResilienceConfig:
     # Budget for re-seeding a new engine's weights from a peer over the
     # weight-transfer push path before falling back to checkpoint reload.
     autoscale_reseed_timeout_s: float = 120.0
+    # ------------------------------------------------------------------
+    # Rolling upgrades (vllm_tpu/resilience/rolling): health gate for the
+    # replacement engine booted during each slot of a rolling upgrade.
+    # Successful probe requests required before routing shifts onto the
+    # newcomer.
+    upgrade_gate_requests: int = 4
+    # Wall budget for the gate; a newcomer that can't pass in time is
+    # rolled back (retired; the old slot keeps serving).
+    upgrade_gate_timeout_s: float = 120.0
+    # Gate additionally requires the pool's worst per-class SLO
+    # attainment to sit at or above this floor (0 disables; attainment
+    # needs --slo-targets to exist at all).
+    upgrade_slo_floor: float = 0.0
 
     def finalize(self) -> "ResilienceConfig":
         if self.max_engine_restarts < 0:
@@ -230,5 +243,20 @@ class ResilienceConfig:
             raise ValueError(
                 f"autoscale_reseed_timeout_s must be > 0, got "
                 f"{self.autoscale_reseed_timeout_s}"
+            )
+        if self.upgrade_gate_requests < 1:
+            raise ValueError(
+                f"upgrade_gate_requests must be >= 1, got "
+                f"{self.upgrade_gate_requests}"
+            )
+        if self.upgrade_gate_timeout_s <= 0:
+            raise ValueError(
+                f"upgrade_gate_timeout_s must be > 0, got "
+                f"{self.upgrade_gate_timeout_s}"
+            )
+        if not (0.0 <= self.upgrade_slo_floor <= 1.0):
+            raise ValueError(
+                f"upgrade_slo_floor must be in [0, 1], got "
+                f"{self.upgrade_slo_floor}"
             )
         return self

@@ -35,6 +35,7 @@ from typing import Any
 
 from vllm_tpu.request import EngineCoreRequest
 from vllm_tpu.resilience.failpoints import fail_point
+from vllm_tpu.versioning import SCHEMA_VERSION
 
 logger = logging.getLogger(__name__)
 
@@ -118,6 +119,10 @@ class RequestJournal:
         self._persist_dir = persist_dir
         self.lost_on_restart: list[dict] = []
         self.requests_lost_on_restart_total = 0
+        # Snapshots stamped by a different journal schema (upgrade
+        # crossed a schema boundary): still counted as lost, flagged
+        # and counted here instead of misparsed as current.
+        self.schema_mismatch_total = 0
         if persist_dir is not None:
             os.makedirs(persist_dir, exist_ok=True)
             self._scan_lost_requests()
@@ -137,6 +142,9 @@ class RequestJournal:
         path = os.path.join(
             self._persist_dir, self._snapshot_name(entry.request_id))
         snapshot = {
+            # Schema stamp: a snapshot written by a different journal
+            # schema is reported as lost, never misparsed as current.
+            "schema": SCHEMA_VERSION,
             "request_id": entry.request_id,
             "arrival_time": entry.arrival_time,
             "num_prompt_tokens": len(entry.prompt_token_ids),
@@ -208,7 +216,18 @@ class RequestJournal:
                 except OSError:
                     pass
             try:
-                self.lost_on_restart.append(json.loads(raw))
+                snap = json.loads(raw)
+                if snap.get("schema") != SCHEMA_VERSION:
+                    # A snapshot from a pre/post-upgrade frontend: the
+                    # request is still lost; the mismatch is surfaced
+                    # (flag + counter), never a parse guess.
+                    logger.warning(
+                        "journal: snapshot %s has schema %r (this "
+                        "frontend speaks %s)", name,
+                        snap.get("schema"), SCHEMA_VERSION)
+                    snap["schema_mismatch"] = True
+                    self.schema_mismatch_total += 1
+                self.lost_on_restart.append(snap)
             except ValueError:
                 # Torn write: salvage the request id from the partial
                 # JSON if the field survived the truncation.

@@ -102,6 +102,12 @@ class TenantFairQueue:
     def weight(self, tenant: str) -> float:
         return self._weights.get(tenant, self._default_weight)
 
+    def set_weights(self, weights: dict[str, float] | None) -> None:
+        """Live-config update: replace the weight table in place.
+        In-flight reservations and virtual times are untouched — debts
+        re-settle under the new weights as requests finish."""
+        self._weights = dict(weights or {})
+
     def share(self, tenant: str, budget: int) -> float:
         """Tenant's weighted share of ``budget`` among *active* tenants
         (tenants with tokens inflight, plus ``tenant`` itself). A lone

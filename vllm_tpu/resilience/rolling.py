@@ -1,0 +1,474 @@
+"""Zero-downtime operations: health-gated rolling engine upgrades.
+
+Every primitive a rolling upgrade needs already exists as a reaction to
+failure or traffic — supervised respawn, journal replay, drain-to-retire
+(``scale_down``), dummy-init + streaming weight re-seed (``scale_up``),
+SLO attainment windows. This module composes them into an *intentional*
+upgrade path:
+
+- :class:`RollingUpgradeController` — a pure state machine (injectable
+  clock, no engine dependencies; same design discipline as
+  ``AutoscaleController``) that sequences the pool through an upgrade
+  one slot at a time. For each slot it asks the executor to boot a
+  replacement engine with the new checkpoint/config, health-gates the
+  newcomer (ready + N successful probe requests + an SLO-window floor),
+  shifts routing onto it, then drains and retires the old engine via
+  the scale-down path (journal replay for stragglers). A failed gate —
+  probe failure, gate deadline, or the newcomer dying — **rolls back**:
+  the newcomer is retired, the old slot keeps serving, and the pool is
+  byte-identical to its pre-upgrade state. The whole cycle is abortable
+  mid-flight.
+
+- The controller never touches processes. The DPLB client owns
+  execution (``scale_up(checkpoint=..., gating=True)`` /
+  ``probe_engine`` / ``open_gate`` / ``scale_down`` /
+  ``retire_engine``); the AsyncLLM busy loop is the driver that turns
+  :meth:`next_action` commands into client calls and reports results
+  back through the ``note_*`` methods, exactly like the autoscale
+  controller/executor split.
+
+- The second axis is *live-updatable config*: :func:`vet_live_config`
+  gates a vetted subset of knobs (QoS tenant weights, brownout
+  thresholds, autoscale watermarks, prefill chunk size, adaptive-spec
+  watermarks) that apply pool-wide via the ``set_config`` utility RPC
+  without any restart. Non-updatable keys are rejected loudly with a
+  typed :class:`LiveConfigError` — a knob that silently didn't apply is
+  worse than one that can't.
+
+Escape hatch: ``VLLM_TPU_DISABLE_ROLLING`` severs the driver loop (no
+``POST /admin/upgrade`` cycle will start) while leaving the manual
+client primitives and the live-config RPC available.
+"""
+
+from __future__ import annotations
+
+import time
+from collections.abc import Callable
+
+__all__ = [
+    "LiveConfigError",
+    "RollingUpgradeController",
+    "live_config_keys",
+    "vet_live_config",
+]
+
+
+# ----------------------------------------------------------------------
+# Live-updatable config: the vetted knob registry
+# ----------------------------------------------------------------------
+
+
+class LiveConfigError(ValueError):
+    """A live-config update named keys that are not live-updatable (or
+    carried values outside a knob's vetted range). The request is
+    rejected whole — partial application of a config push is exactly
+    the mixed state live config exists to avoid."""
+
+    def __init__(self, detail: str, keys: list[str]) -> None:
+        super().__init__(detail)
+        self.keys = list(keys)
+
+
+def _frac(lo: float = 0.0, hi: float = 1.0):
+    def check(v):
+        v = float(v)
+        if not (lo <= v <= hi):
+            raise ValueError(f"must be in [{lo}, {hi}]")
+        return v
+    return check
+
+
+def _pos_float(v) -> float:
+    v = float(v)
+    if v <= 0:
+        raise ValueError("must be > 0")
+    return v
+
+
+def _nonneg_float(v) -> float:
+    v = float(v)
+    if v < 0:
+        raise ValueError("must be >= 0")
+    return v
+
+
+def _nonneg_int(v) -> int:
+    if isinstance(v, bool) or int(v) != v:
+        raise ValueError("must be an integer")
+    v = int(v)
+    if v < 0:
+        raise ValueError("must be >= 0")
+    return v
+
+
+def _weights_str(v):
+    if v is None:
+        return None
+    if not isinstance(v, str):
+        raise ValueError("must be a 'tenant:weight,...' string")
+    from vllm_tpu.resilience.qos import parse_tenant_weights
+    parse_tenant_weights(v)  # raises on malformed specs
+    return v
+
+
+# key -> (scope, validator). Scope "frontend" knobs apply in the
+# AsyncLLM process (admission WFQ, brownout ladder, autoscale
+# controller); scope "engine" knobs broadcast to every engine core over
+# the set_config utility RPC (scheduler-config fields the scheduler
+# re-reads each schedule()).
+_LIVE_KEYS: dict[str, tuple[str, Callable]] = {
+    # QoS weights
+    "tenant_weights": ("frontend", _weights_str),
+    # Brownout thresholds
+    "brownout_occupancy_high": ("frontend", _frac(0.0, 1.0)),
+    "brownout_queue_depth_high": ("frontend", _pos_float),
+    "brownout_slo_floor": ("frontend", _frac(0.0, 1.0)),
+    # Autoscale watermarks
+    "autoscale_up_queue_depth": ("frontend", _pos_float),
+    "autoscale_down_queue_depth": ("frontend", _nonneg_float),
+    # Prefill chunk size (0 = uncapped)
+    "long_prefill_token_threshold": ("engine", _nonneg_int),
+    # Adaptive speculative-decoding watermarks
+    "spec_adaptive_high_watermark": ("engine", _frac(0.0, 1.0)),
+    "spec_adaptive_low_watermark": ("engine", _frac(0.0, 1.0)),
+    # Pressure-preemption knobs (QoS under pressure)
+    "pressure_preemption_s": ("engine", _nonneg_float),
+    "max_preemptions_per_step": ("engine", _nonneg_int),
+}
+
+
+def live_config_keys() -> dict[str, str]:
+    """key -> scope, for /admin/config introspection and the README
+    live-config table."""
+    return {k: scope for k, (scope, _) in sorted(_LIVE_KEYS.items())}
+
+
+def vet_live_config(updates: dict) -> tuple[dict, dict]:
+    """Split a live-config update into (frontend, engine) dicts of
+    validated values, rejecting the whole request on any unknown key or
+    out-of-range value (:class:`LiveConfigError`)."""
+    if not isinstance(updates, dict) or not updates:
+        raise LiveConfigError("live config update must be a non-empty "
+                              "object of key: value pairs", [])
+    unknown = sorted(set(updates) - set(_LIVE_KEYS))
+    if unknown:
+        raise LiveConfigError(
+            f"not live-updatable: {unknown}; updatable keys are "
+            f"{sorted(_LIVE_KEYS)} (anything else needs a rolling "
+            f"upgrade)", unknown)
+    frontend: dict = {}
+    engine: dict = {}
+    for key, raw in updates.items():
+        scope, validate = _LIVE_KEYS[key]
+        try:
+            value = validate(raw)
+        except (TypeError, ValueError) as e:
+            raise LiveConfigError(
+                f"invalid value for {key}: {raw!r} ({e})", [key]) from e
+        (frontend if scope == "frontend" else engine)[key] = value
+    return frontend, engine
+
+
+# ----------------------------------------------------------------------
+# Rolling-upgrade controller
+# ----------------------------------------------------------------------
+
+
+class RollingUpgradeController:
+    """One rolling upgrade cycle, sequenced one slot at a time.
+
+    Driver protocol (the AsyncLLM busy loop):
+
+    1. :meth:`start` arms a cycle over ``slots`` (refused while one is
+       active — the one-upgrade-at-a-time latch).
+    2. Each tick, call :meth:`next_action`; execute the returned
+       command against the DPLB client; report results via the
+       ``note_*`` methods. ``None`` means wait.
+    3. The cycle ends when :meth:`active` flips False; the outcome
+       ("ok" | "rolled_back" | "aborted") lands in
+       ``upgrade_events_total``.
+
+    Commands (dicts keyed by ``op``):
+
+    - ``spawn``    — boot the replacement for ``victim`` with the new
+      checkpoint/config, routing-masked (gating). Report with
+      :meth:`note_spawned`.
+    - ``probe``    — run one probe request on the gated ``newcomer``.
+      Report with :meth:`note_probe`.
+    - ``promote``  — gate passed: open the routing gate on the
+      newcomer and start draining ``victim`` down the scale-down path.
+      Completion arrives via :meth:`note_victim_retired`.
+    - ``rollback`` — retire ``newcomer``, keep ``victim`` serving.
+      Report with :meth:`note_rolled_back`.
+
+    Everything is deterministic under the injected ``clock``; the
+    fake-clock unit tests drive the whole machine without an engine.
+    """
+
+    def __init__(
+        self,
+        *,
+        gate_requests: int = 4,
+        gate_timeout_s: float = 120.0,
+        probe_interval_s: float = 0.25,
+        slo_floor: float = 0.0,
+        clock: Callable[[], float] = time.monotonic,
+    ) -> None:
+        if gate_requests < 1:
+            raise ValueError(
+                f"upgrade_gate_requests must be >= 1, got {gate_requests}")
+        if gate_timeout_s <= 0:
+            raise ValueError(
+                f"upgrade_gate_timeout_s must be > 0, got {gate_timeout_s}")
+        if not (0.0 <= slo_floor <= 1.0):
+            raise ValueError(
+                f"upgrade_slo_floor must be in [0, 1], got {slo_floor}")
+        self.gate_requests = gate_requests
+        self.gate_timeout_s = gate_timeout_s
+        self.probe_interval_s = probe_interval_s
+        self.slo_floor = slo_floor
+        self._clock = clock
+
+        self._phase = "idle"
+        self._slots: list[int] = []
+        self._slots_done = 0
+        self._victim: int | None = None
+        self._newcomer: int | None = None
+        self._checkpoint: str | None = None
+        self._config: dict | None = None
+        self._probe_ok = 0
+        self._probe_fail = 0
+        self._next_probe_t = 0.0
+        self._gate_deadline = 0.0
+        self._abort = False
+        self._fail_reason: str | None = None
+        self.last_outcome: str | None = None
+
+        # Outcome accounting (pull-drained by the metrics registry).
+        self.upgrade_events_total: dict[str, int] = {}
+        self.probes_total: dict[str, int] = {}
+
+    # -- lifecycle ------------------------------------------------------
+
+    @property
+    def active(self) -> bool:
+        return self._phase != "idle"
+
+    @property
+    def phase(self) -> str:
+        return self._phase
+
+    @property
+    def aborting(self) -> bool:
+        return self._abort
+
+    def start(self, slots: list[int], checkpoint: str | None = None,
+              config: dict | None = None) -> bool:
+        """Arm one upgrade cycle over ``slots`` (engine ids, upgraded in
+        order). Returns False while a cycle is active — one upgrade at
+        a time, no exceptions."""
+        if self.active:
+            return False
+        if not slots:
+            return False
+        self._phase = "spawning"
+        self._slots = list(slots)
+        self._slots_done = 0
+        self._victim = self._slots[0]
+        self._newcomer = None
+        self._checkpoint = checkpoint
+        self._config = dict(config) if config else None
+        self._probe_ok = self._probe_fail = 0
+        self._abort = False
+        self._fail_reason = None
+        return True
+
+    def request_abort(self) -> bool:
+        """Abort the cycle at the next safe point: a gated newcomer is
+        rolled back; a slot already past promotion finishes its drain
+        (un-draining a victim mid-retire would lose requests) and the
+        cycle stops before the next slot. Returns False when idle."""
+        if not self.active:
+            return False
+        self._abort = True
+        return True
+
+    def _finish(self, outcome: str) -> None:
+        self.upgrade_events_total[outcome] = (
+            self.upgrade_events_total.get(outcome, 0) + 1)
+        self.last_outcome = outcome
+        self._phase = "idle"
+        self._victim = self._newcomer = None
+        self._abort = False
+
+    # -- driver results -------------------------------------------------
+
+    def note_spawned(self, newcomer: int | None) -> None:
+        """The spawn command ran: ``newcomer`` is the new slot id, or
+        None when the client refused (another scale event in flight) —
+        the spawn is simply re-issued next tick."""
+        if self._phase != "spawning" or newcomer is None:
+            return
+        self._newcomer = newcomer
+        self._phase = "booting"
+
+    def note_newcomer_up(self) -> None:
+        """The replacement finished init (and its weight load/re-seed):
+        the health gate opens now."""
+        if self._phase != "booting":
+            return
+        now = self._clock()
+        self._phase = "gating"
+        self._probe_ok = self._probe_fail = 0
+        self._next_probe_t = now
+        self._gate_deadline = now + self.gate_timeout_s
+
+    def note_newcomer_dead(self) -> None:
+        """The replacement died (crash, SIGKILL, failed boot past its
+        restart budget). The executor has already retired the slot;
+        the old engine was never masked, so this is an automatic
+        rollback by construction."""
+        if self._phase not in ("booting", "gating", "rolling_back"):
+            return
+        self._fail_reason = self._fail_reason or "newcomer died"
+        self._finish("aborted" if self._abort else "rolled_back")
+
+    def note_probe(self, ok: bool) -> None:
+        if self._phase != "gating":
+            return
+        self.probes_total["ok" if ok else "fail"] = (
+            self.probes_total.get("ok" if ok else "fail", 0) + 1)
+        if ok:
+            self._probe_ok += 1
+        else:
+            self._probe_fail += 1
+        self._next_probe_t = self._clock() + self.probe_interval_s
+
+    def note_probe_interrupted(self) -> None:
+        """The driver's probe raced an engine death elsewhere in the
+        pool (its result is unknowable — neither a pass nor a gate
+        failure): re-arm the probe timer without counting, so the next
+        tick probes again instead of stalling into the gate deadline."""
+        if self._phase != "gating":
+            return
+        self._next_probe_t = self._clock() + self.probe_interval_s
+
+    def note_victim_retired(self) -> None:
+        """The drained victim's slot is retired; the newcomer owns the
+        slot. Advance to the next slot, or finish the cycle."""
+        if self._phase != "draining":
+            return
+        self._slots_done += 1
+        self._slots.pop(0)
+        if self._abort:
+            self._finish("aborted")
+        elif not self._slots:
+            self._finish("ok")
+        else:
+            self._phase = "spawning"
+            self._victim = self._slots[0]
+            self._newcomer = None
+
+    def note_rolled_back(self) -> None:
+        """The rollback command ran: newcomer retired, old slot kept."""
+        if self._phase != "rolling_back":
+            return
+        self._finish("aborted" if self._abort else "rolled_back")
+
+    # -- decisions ------------------------------------------------------
+
+    def _gate_verdict(self, slo_attainment: float | None) -> str | None:
+        """"pass" | "fail" | None (keep probing). A probe failure or the
+        gate deadline fails the gate; passing needs ``gate_requests``
+        successful probes AND (when a floor is set and the scoreboard
+        has a window) SLO attainment at or above the floor."""
+        if self._probe_fail > 0:
+            self._fail_reason = "probe failed"
+            return "fail"
+        now = self._clock()
+        slo_ok = (self.slo_floor <= 0.0 or slo_attainment is None
+                  or slo_attainment >= self.slo_floor)
+        if self._probe_ok >= self.gate_requests and slo_ok:
+            return "pass"
+        if now >= self._gate_deadline:
+            self._fail_reason = (
+                "gate deadline: "
+                f"{self._probe_ok}/{self.gate_requests} probes ok"
+                + ("" if slo_ok else
+                   f", slo {slo_attainment:.3f} < floor {self.slo_floor}"))
+            return "fail"
+        return None
+
+    def next_action(self, slo_attainment: float | None = None) -> dict | None:
+        """The command the driver should execute this tick (None =
+        wait). Pure given the clock and the reported state."""
+        ph = self._phase
+        if ph == "idle":
+            return None
+        if ph == "spawning":
+            if self._abort:
+                self._finish("aborted")
+                return None
+            return {
+                "op": "spawn",
+                "victim": self._victim,
+                "checkpoint": self._checkpoint,
+                "config": self._config,
+            }
+        if ph == "booting":
+            # Waiting on note_newcomer_up / note_newcomer_dead from the
+            # executor's scale-event machinery. An abort here unwinds
+            # through rollback once the newcomer settles; if it is
+            # already up-and-gated the rollback happens immediately.
+            return None
+        if ph == "gating":
+            if self._abort:
+                self._phase = "rolling_back"
+                self._fail_reason = "aborted"
+                return {"op": "rollback", "newcomer": self._newcomer,
+                        "victim": self._victim}
+            verdict = self._gate_verdict(slo_attainment)
+            if verdict == "pass":
+                self._phase = "draining"
+                return {"op": "promote", "newcomer": self._newcomer,
+                        "victim": self._victim}
+            if verdict == "fail":
+                self._phase = "rolling_back"
+                return {"op": "rollback", "newcomer": self._newcomer,
+                        "victim": self._victim}
+            if self._clock() >= self._next_probe_t:
+                # One probe in flight at a time: the driver's probe is
+                # synchronous, and note_probe re-arms the timer.
+                self._next_probe_t = self._clock() + self.gate_timeout_s
+                return {"op": "probe", "newcomer": self._newcomer}
+            return None
+        # "draining" and "rolling_back" wait on their note_* callbacks;
+        # the executor owns those transitions.
+        return None
+
+    # -- introspection --------------------------------------------------
+
+    def snapshot(self) -> dict:
+        now = self._clock()
+        return {
+            "active": self.active,
+            "phase": self._phase,
+            "aborting": self._abort,
+            "victim": self._victim,
+            "newcomer": self._newcomer,
+            "checkpoint": self._checkpoint,
+            "config": self._config,
+            "slots_remaining": len(self._slots),
+            "slots_done": self._slots_done,
+            "probe_ok": self._probe_ok,
+            "probe_fail": self._probe_fail,
+            "gate_requests": self.gate_requests,
+            "slo_floor": self.slo_floor,
+            "gate_remaining_s": (
+                max(0.0, self._gate_deadline - now)
+                if self._phase == "gating" else None),
+            "fail_reason": self._fail_reason,
+            "last_outcome": self.last_outcome,
+            "upgrade_events_total": dict(self.upgrade_events_total),
+            "probes_total": dict(self.probes_total),
+        }

@@ -1,0 +1,118 @@
+"""Schema/version identity for wire frames, journal records, and pools.
+
+Rolling upgrades (resilience/rolling.py) make mixed-version pools a
+*planned* state instead of an accident, which means every long-lived
+artifact that crosses a process or restart boundary needs a version
+stamp it can be checked against:
+
+- ZMQ handshake frames (the engine proc's READY payload) carry
+  :data:`SCHEMA_VERSION`; a frontend attaching to an engine speaking a
+  different schema gets a typed :class:`SchemaVersionError` (and a
+  counted ``vllm:schema_mismatch_total`` sample) instead of a silent
+  misparse three frames later.
+- Journal snapshots, disagg handoff records, and request-trace records
+  carry the same stamp so replay across a binary upgrade is detected,
+  not guessed at.
+- ``/health`` exposes a per-engine and per-frontend ``version`` block
+  (package version, config hash, weights fingerprint) so operators and
+  the upgrade gate can see a mixed pool at a glance.
+
+The schema version is derived from the package ``__version__``
+(major.minor — a patch release must never break the wire), so rolling a
+binary bumps it exactly when the release process says it should.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+
+from vllm_tpu import __version__
+
+# major.minor of the package version: the wire/journal compatibility
+# surface. Patch releases are wire-compatible by definition.
+SCHEMA_VERSION = ".".join(__version__.split(".")[:2])
+
+# Process-wide mismatch accounting by boundary kind, incremented by
+# check_schema() on every rejection (feeds vllm:schema_mismatch_total).
+# Ints mutated under the GIL; readers copy.
+mismatch_total: dict[str, int] = {}
+
+
+class SchemaVersionError(RuntimeError):
+    """A peer (engine proc, journal snapshot, handoff/trace record)
+    speaks a different schema version than this process.
+
+    ``kind`` names the boundary ("ready" handshake, "journal" snapshot,
+    "handoff" record, "trace" record) so the counted metric and the
+    error message both say WHERE the mismatch was caught.
+    """
+
+    def __init__(self, kind: str, got: object, want: str = SCHEMA_VERSION,
+                 detail: str = "") -> None:
+        msg = (f"schema version mismatch on {kind}: peer speaks {got!r}, "
+               f"this process speaks {want!r}")
+        if detail:
+            msg += f" ({detail})"
+        super().__init__(msg)
+        self.kind = kind
+        self.got = got
+        self.want = want
+
+
+def check_schema(kind: str, got: object, detail: str = "") -> None:
+    """Raise :class:`SchemaVersionError` unless ``got`` matches this
+    process's :data:`SCHEMA_VERSION`. A missing stamp (``None``) counts
+    as a mismatch: pre-versioning peers are exactly the ones that must
+    not be silently attached across an upgrade."""
+    if got != SCHEMA_VERSION:
+        mismatch_total[kind] = mismatch_total.get(kind, 0) + 1
+        raise SchemaVersionError(kind, got, detail=detail)
+
+
+def weights_fingerprint(path: str | None) -> str | None:
+    """Cheap checkpoint identity: digest of the resolved path plus the
+    newest mtime under it (the weight files themselves are many GB —
+    hashing content is not a health-endpoint operation). Two engines
+    showing different fingerprints are serving different weights; the
+    upgrade e2e asserts the newcomer's fingerprint differs from the
+    victim's. None when the path does not exist (e.g. a hub model id
+    resolved elsewhere)."""
+    if not path or not os.path.exists(path):
+        return None
+    newest = os.path.getmtime(path)
+    if os.path.isdir(path):
+        for name in os.listdir(path):
+            try:
+                newest = max(newest,
+                             os.path.getmtime(os.path.join(path, name)))
+            except OSError:
+                continue
+    digest = hashlib.sha1(
+        f"{os.path.abspath(path)}:{newest:.6f}".encode()).hexdigest()
+    return digest[:16]
+
+
+def config_hash(config: object) -> str:
+    """Stable-enough digest of an engine config for the /health version
+    block: operators compare hashes across the pool to spot a slot
+    running different knobs, they never decode it. Dataclass reprs are
+    deterministic within a process, which is the comparison that
+    matters (mixed-config pools exist only while one frontend drives an
+    upgrade)."""
+    return hashlib.sha1(repr(config).encode()).hexdigest()[:16]
+
+
+def version_block(config: object = None,
+                  model_path: str | None = None) -> dict:
+    """The /health ``version`` dict for one process/engine."""
+    block: dict = {
+        "package": __version__,
+        "schema": SCHEMA_VERSION,
+    }
+    if config is not None:
+        block["config_hash"] = config_hash(config)
+    if model_path is not None:
+        block["model"] = model_path
+        block["weights_fingerprint"] = weights_fingerprint(model_path)
+    return block
